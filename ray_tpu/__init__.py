@@ -1,0 +1,67 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+A ground-up rebuild of the capabilities of Ray (reference: vitsai/ray) for
+TPU pods: a task/actor/object runtime orchestrating SPMD JAX/XLA programs,
+with sharding-first parallelism (dp/fsdp/tp/pp/sp/ep over jax.sharding.Mesh),
+XLA collectives over ICI instead of NCCL, Pallas kernels for the hot ops,
+streaming data ingest into HBM, and TPU-serving with continuous batching.
+
+Public surface mirrors the reference's `ray.*` core API
+(ref: python/ray/__init__.py:172-203) plus the TPU-first libraries:
+``ray_tpu.parallel``, ``ray_tpu.ops``, ``ray_tpu.models``, ``ray_tpu.train``,
+``ray_tpu.data``, ``ray_tpu.tune``, ``ray_tpu.serve``.
+"""
+
+from ._version import __version__  # noqa: F401
+from .core import (  # noqa: F401
+    ActorClass,
+    ActorDiedError,
+    ActorHandle,
+    GetTimeoutError,
+    ObjectRef,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "get_runtime_context",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "RayTpuError",
+    "TaskError",
+    "ActorDiedError",
+    "WorkerCrashedError",
+    "GetTimeoutError",
+    "TaskCancelledError",
+]

@@ -1,0 +1,31 @@
+"""ray_tpu.core: the task/actor/object runtime (Ray-core equivalent)."""
+
+from .api import (  # noqa: F401
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from .actor import ActorClass, ActorHandle, ActorMethod  # noqa: F401
+from .exceptions import (  # noqa: F401
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID  # noqa: F401
+from .reference import ObjectRef  # noqa: F401
+from .remote_function import RemoteFunction  # noqa: F401
+from .runtime_context import get_runtime_context  # noqa: F401

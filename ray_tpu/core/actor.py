@@ -1,0 +1,137 @@
+"""Actor classes, handles and methods.
+
+Ref analogue: python/ray/actor.py — ActorClass (:489) created by @remote on a
+class, ActorHandle (:113) with ActorMethod proxies; method calls become
+ACTOR_TASK specs routed through the control plane to the actor's dedicated
+worker, which executes them in submission order.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from .config import get_config
+from .ids import ActorID, TaskID
+from .remote_function import _build_resources
+from .runtime_context import current_runtime
+from .task_spec import TaskSpec, TaskType
+
+
+class ActorMethod:
+    def __init__(self, actor_handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = actor_handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(
+            self._handle, self._method_name, opts.get("num_returns", 1)
+        )
+
+    def remote(self, *args, **kwargs):
+        rt = current_runtime()
+        spec_args, spec_kwargs, keepalive = rt.prepare_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            task_type=TaskType.ACTOR_TASK,
+            function_id=self._handle._class_function_id,
+            args=spec_args,
+            kwargs=spec_kwargs,
+            num_returns=self._num_returns,
+            name=f"{self._handle._class_name}.{self._method_name}",
+            actor_id=self._handle._actor_id,
+            method_name=self._method_name,
+        )
+        refs = rt.submit(spec)
+        del keepalive
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError("Actor methods must be called with '.remote()'.")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = "",
+                 class_function_id: str = ""):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._class_function_id = class_function_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:8]})"
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._class_name, self._class_function_id),
+        )
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        functools.update_wrapper(self, cls, updated=[])
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        rt = current_runtime()
+        function_id = rt.ensure_function(self._cls)
+        spec_args, spec_kwargs, keepalive = rt.prepare_args(args, kwargs)
+        actor_id = ActorID.from_random()
+        max_restarts = self._options.get("max_restarts", 0)
+        # Actors hold their resources for their lifetime. Like the reference,
+        # the default is 0 CPUs for a running actor (actor.py: actors don't
+        # occupy CPUs after creation unless num_cpus is set explicitly).
+        resources = _build_resources(self._options, default_num_cpus=0)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            function_id=function_id,
+            args=spec_args,
+            kwargs=spec_kwargs,
+            num_returns=1,
+            resources=resources,
+            name=self._options.get("name", ""),
+            actor_id=actor_id,
+            max_restarts=max_restarts,
+        )
+        rt.submit(spec)
+        del keepalive
+        return ActorHandle(
+            actor_id,
+            class_name=self._cls.__name__,
+            class_function_id=function_id,
+        )
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated "
+            "directly; use '.remote()'."
+        )
+
+
+def get_actor(name: str) -> ActorHandle:
+    """Look up a named actor (ref analogue: ray.get_actor)."""
+    rt = current_runtime()
+    spec = rt.get_named_actor_spec(name)
+    if spec is None:
+        raise ValueError(f"Failed to look up actor with name '{name}'")
+    return ActorHandle(
+        spec.actor_id, class_name=spec.name, class_function_id=spec.function_id
+    )

@@ -1,0 +1,173 @@
+"""Public core API: init/shutdown/remote/get/put/wait/kill/cancel.
+
+Ref analogue: the global API in python/ray/_private/worker.py (ray.init:1221,
+ray.get:2563, ray.put, ray.wait, ray.kill, ray.cancel) and the @ray.remote
+decorator in python/ray/__init__.py.
+"""
+
+from __future__ import annotations
+
+import atexit
+import inspect
+import os
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, Optional, Sequence
+
+from .actor import ActorClass, ActorHandle, get_actor  # noqa: F401
+from .config import Config, get_config, reset_config
+from .exceptions import RuntimeNotInitializedError
+from .ids import JobID, NodeID
+from .node_manager import NodeManager
+from .reference import ObjectRef
+from .remote_function import RemoteFunction
+from .runtime import DriverRuntime
+from . import runtime_context
+
+
+def init(
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    system_config: Optional[Dict[str, Any]] = None,
+    ignore_reinit_error: bool = False,
+) -> "DriverRuntime":
+    """Start the single-node runtime in-process (head mode).
+
+    Ref analogue: ray.init starting a local cluster
+    (python/ray/_private/worker.py:1221 → node.py start_head_processes).
+    """
+    existing = runtime_context.current_runtime_or_none()
+    if existing is not None:
+        if ignore_reinit_error:
+            return existing
+        raise RuntimeError("ray_tpu.init() called twice; use shutdown() first.")
+
+    reset_config()
+    config = get_config()
+    config.apply_overrides(system_config)
+    if object_store_memory is not None:
+        config.object_store_memory = object_store_memory
+
+    res: Dict[str, float] = dict(resources or {})
+    res.setdefault("CPU", num_cpus if num_cpus is not None else os.cpu_count() or 1)
+    if num_tpus is not None:
+        res["TPU"] = num_tpus
+    else:
+        detected = _detect_tpu_chips()
+        if detected:
+            res.setdefault("TPU", detected)
+
+    session_dir = os.path.join(
+        tempfile.gettempdir(),
+        "ray_tpu",
+        f"session-{int(time.time())}-{uuid.uuid4().hex[:8]}",
+    )
+    os.makedirs(session_dir, exist_ok=True)
+
+    node_id = NodeID.from_random()
+    nm = NodeManager(node_id, session_dir, res, config)
+    nm.start()
+    rt = DriverRuntime(nm, job_id=JobID.from_random())
+    runtime_context.set_runtime(rt)
+    atexit.register(_atexit_shutdown)
+    return rt
+
+
+def _detect_tpu_chips() -> int:
+    """Count local TPU chips without importing jax (ref analogue:
+    _private/accelerators/tpu.py device detection)."""
+    try:
+        import glob
+
+        return len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/*"))
+    except Exception:
+        return 0
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def shutdown():
+    rt = runtime_context.current_runtime_or_none()
+    if rt is None:
+        return
+    runtime_context.set_runtime(None)
+    rt.shutdown()
+
+
+def is_initialized() -> bool:
+    return runtime_context.is_initialized()
+
+
+def remote(*args, **kwargs):
+    """@remote decorator for functions and classes, with or without options
+    (ref: python/ray/__init__.py ray.remote)."""
+    if len(args) == 1 and not kwargs and (
+        inspect.isfunction(args[0]) or inspect.isclass(args[0])
+    ):
+        target = args[0]
+        if inspect.isclass(target):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("remote() takes keyword options only, e.g. "
+                        "@remote(num_cpus=2)")
+
+    def decorator(target):
+        if inspect.isclass(target):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    return decorator
+
+
+def put(value) -> ObjectRef:
+    return runtime_context.current_runtime().put(value)
+
+
+def get(refs, *, timeout: Optional[float] = None):
+    return runtime_context.current_runtime().get(refs, timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+):
+    return runtime_context.current_runtime().wait(refs, num_returns, timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    runtime_context.current_runtime().kill_actor(actor.actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    runtime_context.current_runtime().cancel_task(ref.id().task_id(), force)
+
+
+def cluster_resources() -> Dict[str, float]:
+    return runtime_context.current_runtime().cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return runtime_context.current_runtime().available_resources()
+
+
+def nodes():
+    rt = runtime_context.current_runtime()
+    return [
+        {
+            "NodeID": rt.node_id.hex(),
+            "Alive": True,
+            "Resources": rt.cluster_resources(),
+        }
+    ]

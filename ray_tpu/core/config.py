@@ -1,0 +1,88 @@
+"""Flag/config system.
+
+Plays the role of the reference's RAY_CONFIG macro table (ref:
+src/ray/common/ray_config_def.h — 219 flags overridable via RAY_* env vars or
+the _system_config dict). Here: a typed dataclass of flags, each overridable
+via a ``RAY_TPU_<NAME>`` environment variable or the ``system_config`` dict
+passed to ``ray_tpu.init``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+
+def _env_override(name: str, default: Any) -> Any:
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+@dataclasses.dataclass
+class Config:
+    # Objects smaller than this are stored inline in the in-process memory
+    # store / control messages rather than in shared memory (ref analogue:
+    # max_direct_call_object_size, ray_config_def.h).
+    max_inline_object_size: int = 100 * 1024
+    # Cap on shared-memory object store usage, bytes (0 = 30% of system mem,
+    # like the reference's default plasma sizing in _private/services.py).
+    object_store_memory: int = 0
+    # Number of workers prestarted per node (ref: worker_pool prestart).
+    num_prestart_workers: int = 2
+    # Hard cap on worker processes a node may spawn (includes workers started
+    # to relieve blocked-on-get workers).
+    max_workers: int = 64
+    # Seconds a worker may sit idle before the pool reaps it down to the
+    # prestart floor (ref: idle_worker_killing_time_threshold_ms).
+    idle_worker_ttl_s: float = 60.0
+    # Batched refcount release interval.
+    refcount_flush_interval_s: float = 0.5
+    # Grace period before an unreferenced object is actually freed; absorbs
+    # out-of-order refcount flushes from different processes.
+    gc_grace_period_s: float = 5.0
+    # Health-check / heartbeat period for workers (ref: GcsHealthCheckManager).
+    health_check_period_s: float = 5.0
+    # Default max task retries on worker crash (ref: task_manager.h retries).
+    default_max_retries: int = 3
+    # Scheduler: spread threshold for the hybrid policy (ref:
+    # policy/hybrid_scheduling_policy.h scheduler_spread_threshold).
+    scheduler_spread_threshold: float = 0.5
+    # Chunk size for inter-node object transfer (ref:
+    # object_manager_default_chunk_size = 5 MiB).
+    object_transfer_chunk_bytes: int = 5 * 1024 * 1024
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+
+    def apply_overrides(self, system_config: dict | None):
+        if not system_config:
+            return
+        for k, v in system_config.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown system config key: {k}")
+            setattr(self, k, v)
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def reset_config():
+    global _global_config
+    _global_config = None

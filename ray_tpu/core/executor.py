@@ -1,0 +1,123 @@
+"""Task execution: resolve args, run the function, package results.
+
+Ref analogue: the execute_task path in python/ray/_raylet.pyx:1644 — resolve
+top-level ObjectRef args, look up the function by descriptor, invoke, and
+store returns (small inline, large to the shared-memory store).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+from .config import get_config
+from .exceptions import TaskError
+from .ids import ObjectID
+from .object_store import InlineLocation, Location
+from .serialization import deserialize, serialize
+from .task_spec import RefArg, TaskSpec, TaskType, ValueArg
+
+
+def pack_value(value) -> bytes:
+    return serialize(value).to_bytes()
+
+
+def unpack_value(data: bytes):
+    return deserialize(memoryview(data))
+
+
+def resolve_args(spec: TaskSpec, fetch: Callable[[List[ObjectID]], List[Any]]):
+    """Materialize the call's positional/keyword arguments. ``fetch`` returns
+    deserialized values for a list of ObjectIDs (blocking until available)."""
+    ref_ids = [a.object_id for a in spec.args if isinstance(a, RefArg)]
+    ref_ids += [a.object_id for a in spec.kwargs.values() if isinstance(a, RefArg)]
+    values = fetch(ref_ids) if ref_ids else []
+    by_id = dict(zip(ref_ids, values))
+    args = [
+        by_id[a.object_id] if isinstance(a, RefArg) else unpack_value(a.data)
+        for a in spec.args
+    ]
+    kwargs = {
+        k: by_id[a.object_id] if isinstance(a, RefArg) else unpack_value(a.data)
+        for k, a in spec.kwargs.items()
+    }
+    return args, kwargs
+
+
+def package_results(
+    spec: TaskSpec, value, store_large: Callable[[ObjectID, Any], Location]
+) -> List[Tuple[ObjectID, Location]]:
+    """Split the return value into the task's return slots and produce
+    (ObjectID, Location) pairs. ``store_large`` writes one serialized object
+    to shm and returns its location."""
+    return_ids = spec.return_ids()
+    if spec.num_returns == 1:
+        values = [value]
+    else:
+        if not isinstance(value, (tuple, list)) or len(value) != spec.num_returns:
+            raise ValueError(
+                f"task {spec.name!r} declared num_returns={spec.num_returns} but "
+                f"returned {type(value).__name__} of length "
+                f"{len(value) if hasattr(value, '__len__') else 'n/a'}"
+            )
+        values = list(value)
+    cfg = get_config()
+    out: List[Tuple[ObjectID, Location]] = []
+    for oid, v in zip(return_ids, values):
+        sobj = serialize(v)
+        if sobj.total_size <= cfg.max_inline_object_size:
+            out.append((oid, InlineLocation(sobj.to_bytes())))
+        else:
+            out.append((oid, store_large(oid, sobj)))
+    return out
+
+
+class ActorContainer:
+    """Holds the live actor instance in an actor worker."""
+
+    def __init__(self):
+        self.instance = None
+        self.cls = None
+
+    def create(self, cls, args, kwargs):
+        self.cls = cls
+        self.instance = cls(*args, **kwargs)
+
+    def call(self, method_name: str, args, kwargs):
+        if self.instance is None:
+            raise RuntimeError("actor instance not created")
+        method = getattr(self.instance, method_name)
+        return method(*args, **kwargs)
+
+
+def execute_task(
+    spec: TaskSpec,
+    load_function: Callable[[str], Any],
+    fetch: Callable[[List[ObjectID]], List[Any]],
+    store_large: Callable[[ObjectID, Any], Location],
+    actor: ActorContainer,
+) -> Tuple[List[Tuple[ObjectID, Location]], bool]:
+    """Run one task; returns (results, failed)."""
+    try:
+        args, kwargs = resolve_args(spec, fetch)
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            cls = load_function(spec.function_id)
+            actor.create(cls, args, kwargs)
+            value = None
+        elif spec.task_type == TaskType.ACTOR_TASK:
+            value = actor.call(spec.method_name, args, kwargs)
+        else:
+            fn = load_function(spec.function_id)
+            value = fn(*args, **kwargs)
+        return package_results(spec, value, store_large), False
+    except Exception as e:  # noqa: BLE001 — user exceptions become TaskError
+        err = e if isinstance(e, TaskError) else TaskError.from_exception(
+            e, spec.name or spec.method_name
+        )
+        cfg = get_config()
+        sobj = serialize(err)
+        if sobj.total_size <= cfg.max_inline_object_size:
+            loc: Location = InlineLocation(sobj.to_bytes())
+            results = [(oid, loc) for oid in spec.return_ids()]
+        else:
+            results = [(oid, store_large(oid, sobj)) for oid in spec.return_ids()]
+        return results, True

@@ -1,0 +1,41 @@
+"""Function/actor-class distribution by content hash.
+
+Ref analogue: python/ray/_private/function_manager.py — functions and actor
+classes are pickled once, exported to the cluster function table (GCS KV in
+the reference, the node manager's table here), and fetched lazily by workers
+keyed by descriptor.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Tuple
+
+import cloudpickle
+
+
+def export_function(fn) -> Tuple[str, bytes]:
+    blob = cloudpickle.dumps(fn, protocol=5)
+    return hashlib.sha256(blob).hexdigest()[:32], blob
+
+
+class FunctionCache:
+    """Per-process cache of deserialized functions/classes."""
+
+    def __init__(self):
+        self._blobs: Dict[str, bytes] = {}
+        self._loaded: Dict[str, Any] = {}
+
+    def add_blob(self, function_id: str, blob: bytes):
+        self._blobs[function_id] = blob
+
+    def has(self, function_id: str) -> bool:
+        return function_id in self._blobs or function_id in self._loaded
+
+    def load(self, function_id: str):
+        if function_id not in self._loaded:
+            blob = self._blobs.get(function_id)
+            if blob is None:
+                raise KeyError(f"function {function_id} not in cache")
+            self._loaded[function_id] = cloudpickle.loads(blob)
+        return self._loaded[function_id]

@@ -1,0 +1,106 @@
+"""Unique identifiers for jobs, tasks, actors, objects, nodes and workers.
+
+Mirrors the role of the reference's ID types (ref: src/ray/common/id.h —
+JobID/ActorID/TaskID/ObjectID with embedded ownership bits), simplified: all
+IDs are fixed-width random byte strings with a type tag. ObjectIDs embed the
+ID of the task that created them plus a return/put index, which is enough for
+an owner-based object directory.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+_ID_SIZE = 16
+
+
+class BaseID:
+    """Immutable fixed-width binary identifier."""
+
+    __slots__ = ("_bytes",)
+    SIZE = _ID_SIZE
+
+    def __init__(self, id_bytes: bytes):
+        if len(id_bytes) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
+            )
+        self._bytes = bytes(id_bytes)
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * cls.SIZE)
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.SIZE
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+
+class NodeID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class TaskID(BaseID):
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        return cls(job_id.binary() + b"\x00" * (cls.SIZE - JobID.SIZE))
+
+
+class ObjectID(BaseID):
+    """Embeds the creating task's ID plus a 4-byte index (return slot or put
+    counter), mirroring how the reference derives ObjectIDs from TaskIDs
+    (ref: src/ray/common/id.h ObjectID::FromIndex)."""
+
+    SIZE = TaskID.SIZE + 4
+
+    @classmethod
+    def from_index(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(task_id.binary() + struct.pack("<I", index))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[: TaskID.SIZE])
+
+    def index(self) -> int:
+        return struct.unpack("<I", self._bytes[TaskID.SIZE :])[0]

@@ -1,0 +1,1128 @@
+"""Single-node control plane: scheduler + worker pool + object directory.
+
+This is the raylet-equivalent (ref: src/ray/raylet/node_manager.h NodeManager,
+worker_pool.h WorkerPool, scheduling/cluster_task_manager.h +
+local_task_manager.h) fused with the GCS-lite services a single node needs
+(function table, KV store, named actors — ref: src/ray/gcs/gcs_server/). It
+runs an asyncio event loop in a background thread of the head process; workers
+connect over a unix socket with framed pickled messages (protocol.py).
+
+The multi-node design splits along the same seams as the reference: this
+class's public coroutines are the RPC surface a remote raylet/GCS would
+expose; nothing below the coroutine layer assumes the caller is in-process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import struct
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
+
+import cloudpickle
+
+from .config import Config
+from .exceptions import (
+    ActorDiedError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from .object_store import (
+    InlineLocation,
+    Location,
+    ObjectDirectory,
+    ShmLocation,
+)
+from .resources import CPU, NodeResources, ResourceSet
+from .task_spec import TaskSpec, TaskType
+
+_HEADER = struct.Struct("<I")
+
+
+def _task_worker_type(spec: TaskSpec) -> str:
+    """Tasks/actors requesting TPU resources run in workers that keep the
+    accelerator environment; everything else runs in fast-starting CPU
+    workers (the chip is exclusive-access, so TPU workers are scarce)."""
+    return "tpu" if spec.resources.get("TPU") > 0 else "cpu"
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Dict[str, Any]:
+    header = await reader.readexactly(_HEADER.size)
+    (length,) = _HEADER.unpack(header)
+    payload = await reader.readexactly(length)
+    return pickle.loads(payload)
+
+
+class _FramedWriter:
+    def __init__(self, writer: asyncio.StreamWriter):
+        self._writer = writer
+        self._lock = asyncio.Lock()
+
+    async def send(self, message: Dict[str, Any]):
+        payload = cloudpickle.dumps(message, protocol=5)
+        async with self._lock:
+            self._writer.write(_HEADER.pack(len(payload)) + payload)
+            await self._writer.drain()
+
+    def close(self):
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+
+
+@dataclass
+class TaskRecord:
+    spec: TaskSpec
+    state: str = "waiting"  # waiting | ready | running | finished | failed | cancelled
+    worker_id: Optional[WorkerID] = None
+    resources_held: bool = False
+    deps_unpinned: bool = False
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    writer: _FramedWriter
+    proc: Optional[subprocess.Popen] = None
+    state: str = "idle"  # idle | busy | blocked | actor | dead
+    worker_type: str = "cpu"  # cpu | tpu — tpu workers own the accelerator env
+    current: Optional[TaskRecord] = None
+    known_functions: Set[str] = field(default_factory=set)
+    actor_id: Optional[ActorID] = None
+    last_active: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    creation_spec: TaskSpec
+    state: str = "pending"  # pending | alive | restarting | dead
+    worker_id: Optional[WorkerID] = None
+    queued: Deque[TaskSpec] = field(default_factory=deque)
+    inflight: Dict[TaskID, TaskRecord] = field(default_factory=dict)
+    restarts_left: int = 0
+    restart_count: int = 0
+    name: str = ""
+    death_cause: str = ""
+
+
+class NodeManager:
+    def __init__(
+        self,
+        node_id: NodeID,
+        session_dir: str,
+        resources: Dict[str, float],
+        config: Config,
+    ):
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.socket_path = os.path.join(session_dir, "node.sock")
+        self.config = config
+        self.node_resources = NodeResources(ResourceSet(resources))
+        capacity = config.object_store_memory
+        self.directory = ObjectDirectory(capacity)
+
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run_loop, name="ray_tpu-node-manager", daemon=True
+        )
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._started = threading.Event()
+        self._shutdown = False
+
+        # Scheduling state (loop-thread only).
+        self._ready: Deque[TaskRecord] = deque()
+        self._waiting: Dict[TaskID, Tuple[TaskRecord, Set[ObjectID]]] = {}
+        self._dep_index: Dict[ObjectID, Set[TaskID]] = {}
+        self._tasks: Dict[TaskID, TaskRecord] = {}
+
+        self._workers: Dict[WorkerID, WorkerHandle] = {}
+        self._idle: Dict[str, Deque[WorkerID]] = {"cpu": deque(), "tpu": deque()}
+        self._starting_workers = {"cpu": 0, "tpu": 0}
+        self._pending_types: Dict[WorkerID, str] = {}
+
+        self._actors: Dict[ActorID, ActorInfo] = {}
+        self._named_actors: Dict[str, ActorID] = {}
+
+        self._functions: Dict[str, bytes] = {}
+        self._kv: Dict[str, bytes] = {}
+
+        self._sealed: Set[ObjectID] = set()
+        self._seal_events: Dict[ObjectID, asyncio.Event] = {}
+        self._pending_procs: Dict[WorkerID, subprocess.Popen] = {}
+
+        self._stats = {
+            "tasks_submitted": 0,
+            "tasks_finished": 0,
+            "tasks_failed": 0,
+            "tasks_retried": 0,
+            "workers_started": 0,
+            "actors_created": 0,
+        }
+
+    # ------------------------------------------------------------------ boot
+
+    def start(self):
+        self._thread.start()
+        self._started.wait(timeout=30)
+        for _ in range(self.config.num_prestart_workers):
+            self._loop.call_soon_threadsafe(self._spawn_worker)
+
+    def _run_loop(self):
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._start_server())
+        self._started.set()
+        self._loop.run_forever()
+        # Drain pending callbacks after stop().
+        self._loop.run_until_complete(self._loop.shutdown_asyncgens())
+        self._loop.close()
+
+    async def _start_server(self):
+        self._server = await asyncio.start_unix_server(
+            self._handle_connection, path=self.socket_path
+        )
+        self._gc_task = asyncio.ensure_future(self._gc_loop())
+        self._health_task = asyncio.ensure_future(self._health_loop())
+
+    async def _health_loop(self):
+        """Detect workers that died before registering (e.g. import errors)
+        so pending tasks fail loudly instead of hanging (ref analogue:
+        WorkerPool startup-failure handling + GcsHealthCheckManager)."""
+        consecutive_failures = 0
+        while not self._shutdown:
+            await asyncio.sleep(0.5)
+            for worker_id, proc in list(self._pending_procs.items()):
+                if proc.poll() is None:
+                    continue
+                self._pending_procs.pop(worker_id, None)
+                wtype = self._pending_types.pop(worker_id, "cpu")
+                self._starting_workers[wtype] = max(
+                    0, self._starting_workers[wtype] - 1
+                )
+                consecutive_failures += 1
+                log = os.path.join(
+                    self.session_dir, "logs", f"worker-{worker_id.hex()[:8]}.log"
+                )
+                detail = ""
+                try:
+                    with open(log, "r") as f:
+                        detail = f.read()[-2000:]
+                except OSError:
+                    pass
+                sys.stderr.write(
+                    f"[ray_tpu] worker {worker_id.hex()[:8]} exited during "
+                    f"startup (code {proc.returncode}). Log tail:\n{detail}\n"
+                )
+                if consecutive_failures >= 3:
+                    # Workers cannot start at all: fail queued work loudly.
+                    while self._ready:
+                        rec = self._ready.popleft()
+                        self._fail_task(
+                            rec,
+                            TaskError(
+                                None,
+                                rec.spec.name,
+                                f"worker processes fail to start; last log:\n"
+                                f"{detail}",
+                            ),
+                        )
+                else:
+                    self._schedule()
+            if self._workers:
+                consecutive_failures = 0
+
+    def _call(self, coro):
+        """Run a coroutine on the loop from a foreign thread."""
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    def call_sync(self, coro, timeout: Optional[float] = None):
+        return self._call(coro).result(timeout)
+
+    # ------------------------------------------------------- worker lifecycle
+
+    def _spawn_worker(self, worker_type: str = "cpu"):
+        """Synchronous spawn entry: reserves the starting-worker slot
+        immediately so back-to-back scheduler passes can't over-spawn."""
+        self._starting_workers[worker_type] += 1
+        asyncio.ensure_future(self._spawn_worker_async(worker_type))
+
+    async def _spawn_worker_async(self, worker_type: str = "cpu") -> WorkerID:
+        worker_id = WorkerID.from_random()
+        log_path = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_path, exist_ok=True)
+        out = open(os.path.join(log_path, f"worker-{worker_id.hex()[:8]}.log"), "wb")
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_NODE_SOCKET"] = self.socket_path
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_WORKER_TYPE"] = worker_type
+        # Ensure the worker can import this package even when the driver was
+        # launched from elsewhere with ray_tpu on sys.path but not installed.
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        existing_pp = env.get("PYTHONPATH", "")
+        if pkg_root not in existing_pp.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + existing_pp if existing_pp else "")
+            )
+        if worker_type == "cpu":
+            # CPU workers skip accelerator-runtime registration at interpreter
+            # start (it costs seconds per process and the chip is exclusive);
+            # only "tpu"-typed workers keep the accelerator environment.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            if env.get("JAX_PLATFORMS", "") in ("", "axon", "tpu"):
+                env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        out.close()
+        self._stats["workers_started"] += 1
+        # The handle is registered when the worker connects and registers.
+        self._pending_procs[worker_id] = proc
+        self._pending_types[worker_id] = worker_type
+        return worker_id
+
+    async def _handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ):
+        framed = _FramedWriter(writer)
+        handle: Optional[WorkerHandle] = None
+        try:
+            msg = await _read_frame(reader)
+            if msg.get("type") != "register":
+                framed.close()
+                return
+            worker_id = WorkerID.from_hex(msg["worker_id"])
+            proc = self._pending_procs.pop(worker_id, None)
+            wtype = self._pending_types.pop(worker_id, "cpu")
+            handle = WorkerHandle(
+                worker_id=worker_id, writer=framed, proc=proc, worker_type=wtype
+            )
+            self._workers[worker_id] = handle
+            self._starting_workers[wtype] = max(0, self._starting_workers[wtype] - 1)
+            self._idle[wtype].append(worker_id)
+            await framed.send({"type": "registered", "node_id": self.node_id.hex()})
+            self._schedule()
+            while True:
+                msg = await _read_frame(reader)
+                await self._dispatch_message(handle, msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            if handle is not None:
+                await self._on_worker_death(handle)
+            framed.close()
+
+    async def _dispatch_message(self, w: WorkerHandle, msg: Dict[str, Any]):
+        mtype = msg["type"]
+        w.last_active = time.monotonic()
+        if mtype == "task_done":
+            await self._on_task_done(w, msg)
+        elif mtype == "submit":
+            await self.submit_task(msg["spec"])
+        elif mtype == "get_locations":
+            asyncio.ensure_future(self._reply_locations(w, msg))
+        elif mtype == "wait":
+            asyncio.ensure_future(self._reply_wait(w, msg))
+        elif mtype == "put":
+            await self.put_object(msg["object_id"], msg["loc"], msg.get("refs", 1))
+        elif mtype == "add_refs":
+            for oid in msg["object_ids"]:
+                self.directory.add_ref(oid)
+        elif mtype == "remove_refs":
+            for oid, count in msg["counts"].items():
+                self._remove_ref(oid, count)
+        elif mtype == "fetch_function":
+            await w.writer.send(
+                {
+                    "type": "reply",
+                    "msg_id": msg["msg_id"],
+                    "blob": self._functions.get(msg["function_id"]),
+                }
+            )
+        elif mtype == "register_function":
+            self._functions[msg["function_id"]] = msg["blob"]
+        elif mtype == "blocked":
+            self._on_worker_blocked(w)
+        elif mtype == "unblocked":
+            self._on_worker_unblocked(w)
+        elif mtype == "kv":
+            await self._handle_kv(w, msg)
+        elif mtype == "actor_exit":
+            await self._on_actor_graceful_exit(w, msg)
+        elif mtype == "kill_actor":
+            await self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
+        elif mtype == "cancel_task":
+            await self.cancel_task(msg["task_id"], msg.get("force", False))
+        elif mtype == "get_named_actor":
+            spec = await self.get_named_actor(msg["name"])
+            await w.writer.send(
+                {"type": "reply", "msg_id": msg["msg_id"], "spec": spec}
+            )
+        elif mtype == "ping":
+            await w.writer.send({"type": "reply", "msg_id": msg["msg_id"]})
+        else:
+            raise RuntimeError(f"unknown message type {mtype}")
+
+    async def _on_worker_death(self, w: WorkerHandle):
+        if w.state == "dead":
+            return
+        prev_state = w.state
+        w.state = "dead"
+        self._workers.pop(w.worker_id, None)
+        try:
+            self._idle[w.worker_type].remove(w.worker_id)
+        except ValueError:
+            pass
+        if w.actor_id is not None:
+            await self._on_actor_worker_death(w)
+        elif w.current is not None:
+            record = w.current
+            w.current = None
+            if record.resources_held:
+                self.node_resources.release(record.spec.resources)
+                record.resources_held = False
+            if record.state == "cancelled":
+                pass
+            elif record.spec.retries_left > 0:
+                record.spec.retries_left -= 1
+                record.state = "ready"
+                record.worker_id = None
+                self._stats["tasks_retried"] += 1
+                self._ready.append(record)
+            else:
+                self._fail_task(record, WorkerCrashedError(record.spec.name))
+        elif prev_state in ("busy", "blocked"):
+            pass
+        if w.proc is not None and w.proc.poll() is None:
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        self._schedule()
+
+    # ------------------------------------------------------------- scheduling
+
+    async def submit_task(self, spec: TaskSpec):
+        """Entry point for both driver and nested worker submissions
+        (ref analogue: ClusterTaskManager::QueueAndScheduleTask)."""
+        self._stats["tasks_submitted"] += 1
+        record = TaskRecord(spec=spec)
+        self._tasks[spec.task_id] = record
+        for oid in spec.return_ids():
+            # Return slots exist in the directory from submission time so
+            # consumers can hold refs before the task runs.
+            self.directory.add(oid, InlineLocation(b""), initial_refs=0)
+        # Pin dependencies for the task's lifetime so owners dropping their
+        # refs mid-flight cannot free an argument (ref analogue: submitted
+        # task references in ReferenceCounter).
+        for oid in spec.dependency_ids():
+            self.directory.add_ref(oid)
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            # Placement may wait for resources/workers; never block the
+            # submitter (or the message-dispatch loop) on it.
+            asyncio.ensure_future(self._schedule_actor_creation(record))
+            return
+        if spec.task_type == TaskType.ACTOR_TASK:
+            self._route_actor_task(record)
+            return
+        missing = {oid for oid in spec.dependency_ids() if oid not in self._sealed}
+        if missing:
+            record.state = "waiting"
+            self._waiting[spec.task_id] = (record, missing)
+            for oid in missing:
+                self._dep_index.setdefault(oid, set()).add(spec.task_id)
+        else:
+            record.state = "ready"
+            self._ready.append(record)
+        self._schedule()
+
+    def _schedule(self):
+        """Dispatch ready tasks to idle workers while resources allow
+        (ref analogue: LocalTaskManager::DispatchScheduledTasksToWorkers)."""
+        if self._shutdown:
+            return
+        # One bounded pass over the queue: dispatch everything that fits,
+        # skip (in order) what doesn't — a task waiting on a busy resource
+        # class must not head-of-line-block other resource classes (ref
+        # analogue: ClusterTaskManager keeps per-scheduling-class queues).
+        deferred: Deque[TaskRecord] = deque()
+        spawn_needed: Set[str] = set()
+        while self._ready:
+            record = self._ready.popleft()
+            if record.state == "cancelled":
+                continue
+            if not self.node_resources.can_fit(record.spec.resources):
+                if not self.node_resources.is_feasible(record.spec.resources):
+                    self._fail_task(
+                        record,
+                        TaskError(
+                            None,
+                            record.spec.name,
+                            f"infeasible resource request "
+                            f"{record.spec.resources.to_dict()} on node with "
+                            f"{self.node_resources.total.to_dict()}",
+                        ),
+                    )
+                    continue
+                deferred.append(record)
+                continue
+            wtype = _task_worker_type(record.spec)
+            worker = self._take_idle_worker(wtype)
+            if worker is None:
+                spawn_needed.add(wtype)
+                deferred.append(record)
+                continue
+            self.node_resources.acquire(record.spec.resources)
+            record.resources_held = True
+            record.state = "running"
+            record.worker_id = worker.worker_id
+            worker.state = "busy"
+            worker.current = record
+            asyncio.ensure_future(self._send_execute(worker, record.spec))
+        self._ready = deferred
+        for wtype in spawn_needed:
+            self._maybe_spawn_worker(wtype)
+
+    def _take_idle_worker(self, worker_type: str = "cpu") -> Optional[WorkerHandle]:
+        pool = self._idle[worker_type]
+        while pool:
+            wid = pool.popleft()
+            w = self._workers.get(wid)
+            if w is not None and w.state == "idle":
+                return w
+        return None
+
+    def _num_starting(self) -> int:
+        return sum(self._starting_workers.values())
+
+    def _maybe_spawn_worker(self, worker_type: str = "cpu"):
+        """Spawn workers demand-driven but bounded by schedulable slots:
+        more worker processes than CPU slots can dispatch is pure thrash
+        (ref analogue: worker_pool.h PopWorker-triggered starts bounded by
+        maximum_startup_concurrency)."""
+        demand = sum(
+            1 for r in self._ready if _task_worker_type(r.spec) == worker_type
+        )
+        if demand == 0:
+            return
+        capacity = len(self._workers) + self._num_starting()
+        if capacity >= self.config.max_workers:
+            return
+        cpu_total = max(1, int(self.node_resources.total.get(CPU)))
+        n_blocked = sum(1 for w in self._workers.values() if w.state == "blocked")
+        # Blocked workers released their CPU, so extra tasks may run.
+        want = min(demand, cpu_total + n_blocked)
+        n_idle = len(self._idle[worker_type])
+        usable = n_idle + self._starting_workers[worker_type]
+        if usable < want:
+            self._spawn_worker(worker_type)
+
+    async def _send_execute(self, worker: WorkerHandle, spec: TaskSpec):
+        blob = None
+        if spec.function_id not in worker.known_functions:
+            blob = self._functions.get(spec.function_id)
+            worker.known_functions.add(spec.function_id)
+        try:
+            await worker.writer.send(
+                {"type": "execute", "spec": spec, "function_blob": blob}
+            )
+        except Exception:
+            await self._on_worker_death(worker)
+
+    async def _on_task_done(self, w: WorkerHandle, msg: Dict[str, Any]):
+        task_id: TaskID = msg["task_id"]
+        record = self._tasks.get(task_id)
+        results: List[Tuple[ObjectID, Location]] = msg["results"]
+        if record is None:
+            return
+        for oid, loc in results:
+            self._seal_object(oid, loc)
+        if msg.get("failed"):
+            self._stats["tasks_failed"] += 1
+            record.state = "failed"
+        else:
+            self._stats["tasks_finished"] += 1
+            record.state = "finished"
+        # Creation-task deps stay pinned while the actor may restart (the
+        # creation spec re-executes with the same arguments). Terminal
+        # normal/actor-task records are dropped to keep the head's memory
+        # bounded (the spec holds serialized args).
+        if record.spec.task_type != TaskType.ACTOR_CREATION_TASK:
+            self._unpin_deps(record)
+            self._tasks.pop(task_id, None)
+        elif msg.get("failed"):
+            self._unpin_deps(record)
+        if w.actor_id is not None:
+            info = self._actors.get(w.actor_id)
+            if info is not None:
+                info.inflight.pop(task_id, None)
+                if record.spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                    if msg.get("failed"):
+                        info.state = "dead"
+                        info.death_cause = "actor constructor failed"
+                        info.restarts_left = 0
+                        self._fail_actor_queue(info)
+                        if info.name:
+                            self._named_actors.pop(info.name, None)
+                        await self.kill_actor(info.actor_id)
+                    else:
+                        info.state = "alive"
+                        self._flush_actor_queue(info)
+        else:
+            if record.resources_held:
+                self.node_resources.release(record.spec.resources)
+                record.resources_held = False
+            w.current = None
+            if w.state != "dead":
+                w.state = "idle"
+                self._idle[w.worker_type].append(w.worker_id)
+        self._schedule()
+
+    def _seal_object(self, oid: ObjectID, loc: Location):
+        existing = self.directory.lookup(oid)
+        if existing is not None and oid in self._sealed:
+            return
+        if existing is None:
+            self.directory.add(oid, loc, initial_refs=0)
+        else:
+            self.directory.seal_over_placeholder(oid, loc)
+        self._sealed.add(oid)
+        ev = self._seal_events.pop(oid, None)
+        if ev is not None:
+            ev.set()
+        waiters = self._dep_index.pop(oid, None)
+        if waiters:
+            for tid in waiters:
+                entry = self._waiting.get(tid)
+                if entry is None:
+                    continue
+                rec, missing = entry
+                missing.discard(oid)
+                if not missing:
+                    del self._waiting[tid]
+                    rec.state = "ready"
+                    self._ready.append(rec)
+            self._schedule()
+
+    def _unpin_deps(self, record: TaskRecord):
+        if record.deps_unpinned:
+            return
+        record.deps_unpinned = True
+        for oid in record.spec.dependency_ids():
+            self.directory.remove_ref(oid)
+
+    def _fail_task(self, record: TaskRecord, error: TaskError):
+        record.state = "failed"
+        self._stats["tasks_failed"] += 1
+        self._unpin_deps(record)
+        if record.spec.task_type != TaskType.ACTOR_CREATION_TASK:
+            self._tasks.pop(record.spec.task_id, None)
+        try:
+            from .serialization import serialize
+
+            blob = serialize(error).to_bytes()
+        except Exception:
+            from .serialization import serialize
+
+            blob = serialize(
+                TaskError(None, record.spec.name, "unserializable failure")
+            ).to_bytes()
+        for oid in record.spec.return_ids():
+            self._seal_object(oid, InlineLocation(blob))
+
+    # ------------------------------------------------------------------ actors
+
+    async def _schedule_actor_creation(self, record: TaskRecord):
+        spec = record.spec
+        info = ActorInfo(
+            actor_id=spec.actor_id,
+            creation_spec=spec,
+            restarts_left=spec.max_restarts,
+            name=spec.name,
+        )
+        self._actors[spec.actor_id] = info
+        if spec.name:
+            if spec.name in self._named_actors:
+                self._fail_task(
+                    record,
+                    TaskError(None, spec.name, f"actor name {spec.name!r} taken"),
+                )
+                return
+            self._named_actors[spec.name] = spec.actor_id
+        await self._place_actor(info, record)
+
+    async def _place_actor(self, info: ActorInfo, record: TaskRecord):
+        spec = info.creation_spec
+        if not self.node_resources.is_feasible(spec.resources):
+            self._fail_task(
+                record,
+                TaskError(
+                    None, spec.name, f"infeasible actor resources "
+                    f"{spec.resources.to_dict()}"
+                ),
+            )
+            info.state = "dead"
+            return
+        wtype = _task_worker_type(spec)
+        # Atomically acquire resources (acquire() both checks and takes, so
+        # two concurrently-placing actors can't share an exclusive resource),
+        # then wait for a worker without blocking the loop.
+        while not self.node_resources.acquire(spec.resources):
+            await asyncio.sleep(0.01)
+            if self._shutdown:
+                return
+        worker = self._take_idle_worker(wtype)
+        while worker is None:
+            self._maybe_spawn_worker_for_actor(wtype)
+            await asyncio.sleep(0.01)
+            if self._shutdown:
+                self.node_resources.release(spec.resources)
+                return
+            worker = self._take_idle_worker(wtype)
+        worker.state = "actor"
+        worker.actor_id = spec.actor_id
+        info.worker_id = worker.worker_id
+        record.state = "running"
+        record.worker_id = worker.worker_id
+        record.resources_held = True
+        info.inflight[spec.task_id] = record
+        self._stats["actors_created"] += 1
+        # The actor transitions to "alive" (or "dead") in _on_task_done when
+        # the creation task reports back.
+        await self._send_execute(worker, spec)
+
+    def _maybe_spawn_worker_for_actor(self, worker_type: str = "cpu"):
+        capacity = len(self._workers) + self._num_starting()
+        if capacity < self.config.max_workers and not self._idle[worker_type] \
+                and self._starting_workers[worker_type] == 0:
+            self._spawn_worker(worker_type)
+
+    def _route_actor_task(self, record: TaskRecord):
+        spec = record.spec
+        info = self._actors.get(spec.actor_id)
+        if info is None or info.state == "dead":
+            cause = info.death_cause if info else "actor not found"
+            self._fail_task(record, ActorDiedError(spec.name, cause))
+            return
+        if info.state in ("pending", "restarting"):
+            info.queued.append(spec)
+            record.state = "queued"
+            return
+        self._forward_actor_task(info, record)
+
+    def _forward_actor_task(self, info: ActorInfo, record: TaskRecord):
+        worker = self._workers.get(info.worker_id)
+        if worker is None:
+            info.queued.append(record.spec)
+            return
+        record.state = "running"
+        record.worker_id = worker.worker_id
+        info.inflight[record.spec.task_id] = record
+        asyncio.ensure_future(self._send_execute(worker, record.spec))
+
+    def _flush_actor_queue(self, info: ActorInfo):
+        while info.queued:
+            spec = info.queued.popleft()
+            record = self._tasks.get(spec.task_id)
+            if record is None or record.state == "cancelled":
+                continue
+            self._forward_actor_task(info, record)
+
+    def _fail_actor_queue(self, info: ActorInfo, cause: str = "actor died"):
+        for spec in info.queued:
+            rec = self._tasks.get(spec.task_id)
+            if rec is not None:
+                self._fail_task(rec, ActorDiedError(spec.name, cause))
+        info.queued.clear()
+
+    async def _on_actor_worker_death(self, w: WorkerHandle):
+        info = self._actors.get(w.actor_id)
+        if info is None:
+            return
+        creation_record = self._tasks.get(info.creation_spec.task_id)
+        if creation_record is not None and creation_record.resources_held:
+            self.node_resources.release(info.creation_spec.resources)
+            creation_record.resources_held = False
+        graceful = getattr(w, "_graceful_exit", False)
+        cause = "graceful exit" if graceful else "actor worker process died"
+        inflight = list(info.inflight.values())
+        info.inflight.clear()
+        # A creation task that never reported back counts as failed.
+        creation_pending = any(
+            rec.spec.task_type == TaskType.ACTOR_CREATION_TASK for rec in inflight
+        )
+        if info.state == "dead":
+            return
+        if not graceful and info.restarts_left != 0:
+            info.state = "restarting"
+            if info.restarts_left > 0:
+                info.restarts_left -= 1
+            info.restart_count += 1
+            # Actor tasks are NOT retried by default (ref: max_task_retries=0
+            # in the reference); interrupted calls fail with ActorDiedError
+            # unless they carry retries, in which case they resubmit in order.
+            for rec in reversed(inflight):
+                if rec.spec.task_type != TaskType.ACTOR_TASK:
+                    continue
+                if rec.spec.retries_left > 0:
+                    rec.spec.retries_left -= 1
+                    info.queued.appendleft(rec.spec)
+                else:
+                    self._fail_task(
+                        rec, ActorDiedError(rec.spec.name, "actor restarting")
+                    )
+            new_record = TaskRecord(spec=info.creation_spec)
+            asyncio.ensure_future(self._restart_actor(info, new_record))
+        else:
+            info.state = "dead"
+            info.death_cause = cause
+            if creation_pending and creation_record is not None:
+                self._fail_task(
+                    creation_record, ActorDiedError(info.creation_spec.name, cause)
+                )
+            for rec in inflight:
+                if rec.spec.task_type == TaskType.ACTOR_TASK:
+                    self._fail_task(rec, ActorDiedError(rec.spec.name, cause))
+            self._fail_actor_queue(info, cause)
+            if creation_record is not None:
+                self._unpin_deps(creation_record)
+            if info.name:
+                self._named_actors.pop(info.name, None)
+
+    async def _restart_actor(self, info: ActorInfo, record: TaskRecord):
+        # Re-run the creation task on a fresh worker (ref analogue:
+        # GcsActorManager::RestartActor).
+        spec = info.creation_spec
+        self._tasks[spec.task_id] = record
+        ev = self._seal_events.get(spec.return_ids()[0])
+        if ev is not None:
+            ev.clear()
+        self._sealed.discard(spec.return_ids()[0])
+        await self._place_actor(info, record)
+
+    async def _on_actor_graceful_exit(self, w: WorkerHandle, msg):
+        w._graceful_exit = True
+
+    async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        info = self._actors.get(actor_id)
+        if info is None:
+            return
+        if no_restart:
+            info.restarts_left = 0
+        worker = self._workers.get(info.worker_id) if info.worker_id else None
+        if worker is not None:
+            try:
+                await worker.writer.send({"type": "kill"})
+            except Exception:
+                pass
+            if worker.proc is not None:
+                try:
+                    worker.proc.kill()
+                except Exception:
+                    pass
+
+    async def get_named_actor(self, name: str) -> Optional[TaskSpec]:
+        actor_id = self._named_actors.get(name)
+        if actor_id is None:
+            return None
+        return self._actors[actor_id].creation_spec
+
+    # ---------------------------------------------------------------- objects
+
+    async def put_object(self, object_id: ObjectID, loc: Location, refs: int = 1):
+        self.directory.add(object_id, loc, initial_refs=refs)
+        self._seal_object(object_id, loc)
+
+    async def get_locations(
+        self, object_ids: List[ObjectID], timeout: Optional[float] = None
+    ) -> List[Tuple[ObjectID, Location]]:
+        events = []
+        for oid in object_ids:
+            if oid not in self._sealed:
+                if self.directory.lookup(oid) is None:
+                    # Never registered or already freed: waiting would hang
+                    # forever. (Nested refs inside serialized args are not
+                    # pinned by the control plane yet — full borrower
+                    # accounting is future work; this turns the silent hang
+                    # into a loud error.)
+                    from .exceptions import ObjectLostError
+
+                    raise ObjectLostError(
+                        f"object {oid.hex()} is unknown or has been freed; "
+                        "if it was only referenced from inside a container "
+                        "argument, keep a live ObjectRef to it"
+                    )
+                events.append(self._seal_events.setdefault(oid, asyncio.Event()))
+        if events:
+            waiters = [ev.wait() for ev in events if not ev.is_set()]
+            if waiters:
+                await asyncio.wait_for(asyncio.gather(*waiters), timeout)
+        return [(oid, self.directory.lookup(oid)) for oid in object_ids]
+
+    async def wait_objects(
+        self,
+        object_ids: List[ObjectID],
+        num_returns: int,
+        timeout: Optional[float],
+    ) -> List[ObjectID]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ready = [oid for oid in object_ids if oid in self._sealed]
+            if len(ready) >= num_returns:
+                return ready
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return ready
+            # Event-driven: wake when any unsealed object seals.
+            pending = [
+                self._seal_events.setdefault(oid, asyncio.Event())
+                for oid in object_ids
+                if oid not in self._sealed
+            ]
+            tasks = [asyncio.ensure_future(ev.wait()) for ev in pending]
+            try:
+                await asyncio.wait(
+                    tasks,
+                    timeout=remaining,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+            finally:
+                for t in tasks:
+                    t.cancel()
+
+    def _remove_ref(self, object_id: ObjectID, count: int = 1):
+        self.directory.remove_ref(object_id, count)
+
+    async def _gc_loop(self):
+        grace = self.config.gc_grace_period_s
+        while not self._shutdown:
+            await asyncio.sleep(min(1.0, grace / 2))
+            for oid, loc in self.directory.collect_garbage(grace):
+                self._sealed.discard(oid)
+                self._seal_events.pop(oid, None)
+                if isinstance(loc, ShmLocation):
+                    try:
+                        from multiprocessing import shared_memory
+
+                        seg = shared_memory.SharedMemory(name=loc.name)
+                        seg.close()
+                        seg.unlink()
+                    except FileNotFoundError:
+                        pass
+                    except Exception:
+                        pass
+
+    async def _reply_locations(self, w: WorkerHandle, msg):
+        try:
+            locs = await self.get_locations(msg["object_ids"], msg.get("timeout"))
+            await w.writer.send(
+                {"type": "reply", "msg_id": msg["msg_id"], "locations": locs}
+            )
+        except asyncio.TimeoutError:
+            await w.writer.send(
+                {"type": "reply", "msg_id": msg["msg_id"], "timeout": True}
+            )
+        except Exception as e:  # connection gone etc.
+            try:
+                await w.writer.send(
+                    {"type": "reply", "msg_id": msg["msg_id"], "error": str(e)}
+                )
+            except Exception:
+                pass
+
+    async def _reply_wait(self, w: WorkerHandle, msg):
+        ready = await self.wait_objects(
+            msg["object_ids"], msg["num_returns"], msg.get("timeout")
+        )
+        await w.writer.send({"type": "reply", "msg_id": msg["msg_id"], "ready": ready})
+
+    # --------------------------------------------------------------------- kv
+
+    async def _handle_kv(self, w: WorkerHandle, msg):
+        op = msg["op"]
+        out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
+        if op == "put":
+            overwrite = msg.get("overwrite", True)
+            if not overwrite and msg["key"] in self._kv:
+                out["added"] = False
+            else:
+                self._kv[msg["key"]] = msg["value"]
+                out["added"] = True
+        elif op == "get":
+            out["value"] = self._kv.get(msg["key"])
+        elif op == "del":
+            out["deleted"] = self._kv.pop(msg["key"], None) is not None
+        elif op == "keys":
+            prefix = msg.get("prefix", "")
+            out["keys"] = [k for k in self._kv if k.startswith(prefix)]
+        await w.writer.send(out)
+
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        async def _put():
+            if not overwrite and key in self._kv:
+                return False
+            self._kv[key] = value
+            return True
+
+        return self.call_sync(_put())
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        async def _get():
+            return self._kv.get(key)
+
+        return self.call_sync(_get())
+
+    # ----------------------------------------------------------- cancellation
+
+    async def cancel_task(self, task_id: TaskID, force: bool = False):
+        record = self._tasks.get(task_id)
+        if record is None or record.state in ("finished", "failed", "cancelled"):
+            return
+        if record.state in ("waiting", "ready", "queued"):
+            prev = record.state
+            record.state = "cancelled"
+            if prev == "waiting":
+                self._waiting.pop(task_id, None)
+            self._fail_task(record, TaskCancelledError(record.spec.name))
+            record.state = "cancelled"
+        elif record.state == "running" and force:
+            worker = self._workers.get(record.worker_id)
+            record.state = "cancelled"
+            self._fail_task(record, TaskCancelledError(record.spec.name))
+            record.state = "cancelled"
+            if worker is not None and worker.proc is not None:
+                try:
+                    worker.proc.kill()
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------ functions / stats
+
+    async def register_function(self, function_id: str, blob: bytes):
+        self._functions[function_id] = blob
+
+    async def stats(self) -> Dict[str, Any]:
+        return {
+            **self._stats,
+            "num_workers": len(self._workers),
+            "num_actors_alive": sum(
+                1 for a in self._actors.values() if a.state == "alive"
+            ),
+            "object_store_used_bytes": self.directory.used_bytes,
+            "num_objects": self.directory.num_objects(),
+            "available_resources": self.node_resources.available.to_dict(),
+            "total_resources": self.node_resources.total.to_dict(),
+            "pending_tasks": len(self._ready) + len(self._waiting),
+        }
+
+    # ---------------------------------------------------------------- blocked
+
+    def _on_worker_blocked(self, w: WorkerHandle):
+        """Worker blocked in get(): release its task's resources so other
+        tasks can run (ref analogue: NodeManager::HandleNotifyWorkerBlocked +
+        the CPU release in local_task_manager)."""
+        if w.state == "busy" and w.current is not None and w.current.resources_held:
+            self.node_resources.release(w.current.spec.resources)
+            w.current.resources_held = False
+            w.state = "blocked"
+            self._schedule()
+
+    def _on_worker_unblocked(self, w: WorkerHandle):
+        if w.state == "blocked" and w.current is not None:
+            # Oversubscribe if necessary: clamp availability at zero rather
+            # than deadlocking (the reference behaves the same way when a
+            # blocked worker resumes).
+            res = w.current.spec.resources
+            if not self.node_resources.acquire(res):
+                avail = self.node_resources.available
+                fixed = dict(avail._amounts)
+                for k, v in res._amounts.items():
+                    fixed[k] = max(0, fixed.get(k, 0) - v)
+                from .resources import ResourceSet as _RS
+
+                self.node_resources.available = _RS(_fixed=fixed)
+            w.current.resources_held = True
+            w.state = "busy"
+
+    # --------------------------------------------------------------- shutdown
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+
+        async def _stop():
+            if getattr(self, "_gc_task", None) is not None:
+                self._gc_task.cancel()
+            if getattr(self, "_health_task", None) is not None:
+                self._health_task.cancel()
+            for w in list(self._workers.values()):
+                try:
+                    await asyncio.wait_for(w.writer.send({"type": "kill"}), 1.0)
+                except Exception:
+                    pass
+            if self._server is not None:
+                self._server.close()
+
+        try:
+            self._call(_stop()).result(timeout=5)
+        except Exception:
+            pass
+        for w in list(self._workers.values()):
+            if w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+        for w in list(self._workers.values()):
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=2)
+                except Exception:
+                    try:
+                        w.proc.kill()
+                    except Exception:
+                        pass
+        for proc in getattr(self, "_pending_procs", {}).values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        # Unlink all remaining shm segments we know about.
+        for oid in list(self.directory._entries):
+            loc = self.directory._entries.get(oid)
+            if isinstance(loc, ShmLocation):
+                try:
+                    from multiprocessing import shared_memory
+
+                    seg = shared_memory.SharedMemory(name=loc.name)
+                    seg.close()
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
+                except Exception:
+                    pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass

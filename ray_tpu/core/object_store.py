@@ -1,0 +1,257 @@
+"""Shared-memory object store (plasma-equivalent).
+
+Plays the role of the reference's plasma store + store providers (ref:
+src/ray/object_manager/plasma/store.h PlasmaStore,
+object_lifecycle_manager.h, python side store_provider/plasma_store_provider.h):
+immutable, sealed-once objects in POSIX shared memory, read zero-copy by every
+process on the node via mmap. Differences by design: one shm segment per
+object (the kernel is the arena allocator) instead of a dlmalloc arena over a
+single mapping, and the object *directory* lives in the head process's
+control plane rather than a separate store daemon — on TPU hosts the store
+only needs to feed jax.device_put, so the simpler layout wins.
+
+Small objects bypass shm entirely and travel inline in control messages
+(ref analogue: the in-process CoreWorkerMemoryStore for small returns).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from multiprocessing import shared_memory, resource_tracker
+from typing import Dict, Optional, Union
+
+from .ids import ObjectID
+from .serialization import SerializedObject, deserialize
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class InlineLocation:
+    data: bytes
+
+
+@dataclass(frozen=True)
+class ShmLocation:
+    name: str
+    size: int
+
+
+Location = Union[InlineLocation, ShmLocation]
+
+
+def _shm_name(object_id: ObjectID) -> str:
+    return "rtpu-" + object_id.hex()[:24]
+
+
+def _attach_untracked(name: str) -> shared_memory.SharedMemory:
+    """Attach to an existing segment without registering with the
+    multiprocessing resource tracker (which would unlink it when *this*
+    process exits; the creating node manager owns cleanup)."""
+    seg = shared_memory.SharedMemory(name=name)
+    try:
+        resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+    return seg
+
+
+class LocalObjectStore:
+    """Per-process object store client.
+
+    Writers create + fill + seal segments; readers attach and get zero-copy
+    views. The authoritative directory (ObjectID -> Location) is kept by the
+    node's control plane; this class only manages segments and the local
+    attachment cache.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._segments: Dict[str, shared_memory.SharedMemory] = {}
+        self._created: Dict[str, shared_memory.SharedMemory] = {}
+
+    # -- write path ---------------------------------------------------------
+
+    def put_serialized(self, object_id: ObjectID, sobj: SerializedObject) -> ShmLocation:
+        name = _shm_name(object_id)
+        size = sobj.total_size
+        try:
+            seg = shared_memory.SharedMemory(name=name, create=True, size=size)
+        except FileExistsError:
+            # Same object id written twice (e.g. a task retry after the first
+            # writer crashed mid-write): never trust the old contents —
+            # rewrite, or recreate if the size doesn't match.
+            seg = _attach_untracked(name)
+            if seg.size < size:
+                seg.close()
+                shared_memory.SharedMemory(name=name).unlink()
+                seg = shared_memory.SharedMemory(name=name, create=True, size=size)
+            sobj.write_into(seg.buf)
+            with self._lock:
+                self._segments[name] = seg
+            return ShmLocation(name, size)
+        try:
+            resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
+        except Exception:
+            pass
+        sobj.write_into(seg.buf)
+        with self._lock:
+            self._created[name] = seg
+            self._segments[name] = seg
+        return ShmLocation(name, size)
+
+    # -- read path ----------------------------------------------------------
+
+    def get_view(self, loc: Location) -> memoryview:
+        if isinstance(loc, InlineLocation):
+            return memoryview(loc.data)
+        with self._lock:
+            seg = self._segments.get(loc.name)
+            if seg is None:
+                seg = _attach_untracked(loc.name)
+                self._segments[loc.name] = seg
+        return seg.buf[: loc.size]
+
+    def get_object(self, loc: Location):
+        return deserialize(self.get_view(loc))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def release(self, loc: ShmLocation, *, unlink: bool):
+        """Close the local mapping; unlink destroys the segment node-wide
+        (called only by the owner when the global refcount hits zero)."""
+        with self._lock:
+            seg = self._segments.pop(loc.name, None)
+            self._created.pop(loc.name, None)
+        if seg is not None:
+            try:
+                seg.close()
+            except BufferError:
+                # A deserialized view still pins the mapping; leave the
+                # mapping open (the segment file can still be unlinked).
+                self._segments[loc.name] = seg
+                seg = None
+        if unlink:
+            try:
+                shared_memory.SharedMemory(name=loc.name).unlink()
+            except FileNotFoundError:
+                pass
+
+    def shutdown(self, *, unlink_created: bool):
+        with self._lock:
+            segments = dict(self._segments)
+            created = set(self._created)
+            self._segments.clear()
+            self._created.clear()
+        for name, seg in segments.items():
+            try:
+                seg.close()
+            except BufferError:
+                pass
+            if unlink_created and name in created:
+                try:
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
+
+
+class ObjectDirectory:
+    """Node-wide object table kept by the control plane (head process).
+
+    Tracks location, size and per-process reference counts; frees segments
+    when the cluster-wide count drops to zero (ref analogue:
+    ReferenceCounter, src/ray/core_worker/reference_count.h, without
+    borrower/lineage chains — those live in the task manager layer).
+    """
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = capacity_bytes
+        self.used_bytes = 0
+        self._entries: Dict[ObjectID, Location] = {}
+        self._refcounts: Dict[ObjectID, int] = {}
+        self._zero_since: Dict[ObjectID, float] = {}
+        self._lock = threading.Lock()
+
+    def add(self, object_id: ObjectID, loc: Location, initial_refs: int = 1):
+        with self._lock:
+            if object_id in self._entries:
+                self._refcounts[object_id] += initial_refs
+                return
+            size = loc.size if isinstance(loc, ShmLocation) else len(loc.data)
+            if isinstance(loc, ShmLocation) and self.capacity_bytes > 0:
+                if self.used_bytes + size > self.capacity_bytes:
+                    raise ObjectStoreFullError(
+                        f"object store over capacity: {self.used_bytes + size} "
+                        f"> {self.capacity_bytes} bytes"
+                    )
+            self.used_bytes += size if isinstance(loc, ShmLocation) else 0
+            self._entries[object_id] = loc
+            self._refcounts[object_id] = initial_refs
+            if initial_refs <= 0:
+                import time
+
+                self._zero_since[object_id] = time.monotonic()
+
+    def lookup(self, object_id: ObjectID) -> Optional[Location]:
+        with self._lock:
+            return self._entries.get(object_id)
+
+    def seal_over_placeholder(self, object_id: ObjectID, loc: Location):
+        """Replace a pre-registered (placeholder) entry with its real
+        location once the producing task finishes."""
+        with self._lock:
+            self._entries[object_id] = loc
+            if isinstance(loc, ShmLocation):
+                self.used_bytes += loc.size
+
+    def add_ref(self, object_id: ObjectID, count: int = 1):
+        with self._lock:
+            if object_id in self._refcounts:
+                self._refcounts[object_id] += count
+                if self._refcounts[object_id] > 0:
+                    self._zero_since.pop(object_id, None)
+
+    def remove_ref(self, object_id: ObjectID, count: int = 1):
+        """Decrement; collection is deferred to ``collect_garbage`` so that
+        out-of-order refcount flushes from different processes cannot free a
+        still-referenced object (interim scheme until the full borrower
+        protocol of the reference's ReferenceCounter lands)."""
+        import time
+
+        with self._lock:
+            if object_id not in self._refcounts:
+                return
+            self._refcounts[object_id] -= count
+            if self._refcounts[object_id] <= 0:
+                self._zero_since.setdefault(object_id, time.monotonic())
+
+    def collect_garbage(self, grace_s: float):
+        """Pop and return [(oid, loc)] for entries at refcount <= 0 for
+        longer than ``grace_s`` seconds."""
+        import time
+
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            expired = [
+                oid
+                for oid, t in self._zero_since.items()
+                if now - t >= grace_s and self._refcounts.get(oid, 0) <= 0
+            ]
+            for oid in expired:
+                loc = self._entries.pop(oid, None)
+                self._refcounts.pop(oid, None)
+                self._zero_since.pop(oid, None)
+                if loc is None:
+                    continue
+                if isinstance(loc, ShmLocation):
+                    self.used_bytes -= loc.size
+                out.append((oid, loc))
+        return out
+
+    def num_objects(self) -> int:
+        with self._lock:
+            return len(self._entries)

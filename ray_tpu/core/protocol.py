@@ -1,0 +1,83 @@
+"""Framed duplex messaging over unix sockets.
+
+Plays the role of the reference's worker<->raylet connection (ref:
+src/ray/common/client_connection.h — length-prefixed flatbuffer messages over
+a unix socket). Here frames carry pickled dicts: ``u32 length | payload``.
+Each message has a ``type`` and optionally a ``msg_id`` for request/reply
+correlation, enabling full duplex use (the node manager pushes tasks down the
+same socket the worker issues requests on).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict
+
+import cloudpickle
+
+_HEADER = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+class Connection:
+    """Thread-safe framed connection over a connected socket."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 20)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
+
+    def send(self, message: Dict[str, Any]):
+        payload = cloudpickle.dumps(message, protocol=5)
+        if len(payload) >= MAX_FRAME:
+            raise ValueError("message too large for frame")
+        with self._send_lock:
+            try:
+                self._sock.sendall(_HEADER.pack(len(payload)) + payload)
+            except (BrokenPipeError, ConnectionResetError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
+
+    def recv(self) -> Dict[str, Any]:
+        with self._recv_lock:
+            header = self._recv_exact(_HEADER.size)
+            (length,) = _HEADER.unpack(header)
+            payload = self._recv_exact(length)
+        return pickle.loads(payload)
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        remaining = n
+        while remaining:
+            try:
+                chunk = self._sock.recv(min(remaining, 1 << 20))
+            except (ConnectionResetError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
+            if not chunk:
+                raise ConnectionClosed("socket closed")
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        return b"".join(chunks)
+
+    def close(self):
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def connect_unix(path: str, timeout: float = 30.0) -> Connection:
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    sock.connect(path)
+    sock.settimeout(None)
+    return Connection(sock)

@@ -1,0 +1,90 @@
+"""ObjectRef: a distributed future handle.
+
+Mirrors the reference's ObjectRef (ref: python/ray/includes/object_ref.pxi +
+distributed refcounting in src/ray/core_worker/reference_count.h): holding an
+ObjectRef pins the object; dropping the last ref lets the store free it.
+Refcount decrements are batched to the control plane (ref analogue: the
+batched ReleaseObject RPCs).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_release", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, _register: bool = False):
+        self._id = object_id
+        self._owner_release = None
+        from . import runtime_context
+
+        rt = runtime_context.current_runtime_or_none()
+        if rt is not None:
+            if _register:
+                rt.register_new_ref(object_id)
+            else:
+                rt.add_local_ref(object_id)
+            self._owner_release = rt.release_local_ref
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __del__(self):
+        release = self._owner_release
+        if release is not None:
+            try:
+                release(self._id)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Deserializing an ObjectRef in another process registers a new
+        # local ref there (borrower accounting happens in __init__).
+        return (_deserialize_ref, (self._id,))
+
+    # Allow `await ref` when used inside async code paths.
+    def __await__(self):
+        from .api import get
+
+        async def _get():
+            return get(self)
+
+        return _get().__await__()
+
+
+def _deserialize_ref(object_id: ObjectID) -> "ObjectRef":
+    return ObjectRef(object_id)
+
+
+def ref_without_registration(object_id: ObjectID) -> ObjectRef:
+    """Construct a ref whose count was already registered by the caller."""
+    ref = ObjectRef.__new__(ObjectRef)
+    ref._id = object_id
+    from . import runtime_context
+
+    rt = runtime_context.current_runtime_or_none()
+    ref._owner_release = rt.release_local_ref if rt is not None else None
+    return ref
+
+
+def maybe_unwrap(value) -> Optional[ObjectID]:
+    return value._id if isinstance(value, ObjectRef) else None

@@ -1,0 +1,119 @@
+"""Fixed-point resource arithmetic and resource sets.
+
+Mirrors the reference's scheduling resource model (ref:
+src/ray/common/scheduling/fixed_point.h — fixed-point with 1e4 scale;
+src/ray/common/scheduling/resource_set.h — ResourceSet). Fractional resources
+(e.g. num_cpus=0.5) are exact in fixed point, avoiding float drift when many
+fractional tasks run on one node.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+RESOURCE_SCALE = 10_000  # 1e4 fixed-point scale, same as the reference.
+
+CPU = "CPU"
+TPU = "TPU"
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+
+def to_fixed(value: float) -> int:
+    return round(value * RESOURCE_SCALE)
+
+
+def from_fixed(value: int) -> float:
+    return value / RESOURCE_SCALE
+
+
+class ResourceSet:
+    """A non-negative bag of named resources in fixed-point units."""
+
+    __slots__ = ("_amounts",)
+
+    def __init__(self, amounts: Mapping[str, float] | None = None, *, _fixed=None):
+        if _fixed is not None:
+            self._amounts: Dict[str, int] = {k: v for k, v in _fixed.items() if v != 0}
+        else:
+            self._amounts = {
+                k: to_fixed(v) for k, v in (amounts or {}).items() if v != 0
+            }
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: from_fixed(v) for k, v in self._amounts.items()}
+
+    def get(self, name: str) -> float:
+        return from_fixed(self._amounts.get(name, 0))
+
+    def is_empty(self) -> bool:
+        return not self._amounts
+
+    def is_subset_of(self, other: "ResourceSet") -> bool:
+        return all(v <= other._amounts.get(k, 0) for k, v in self._amounts.items())
+
+    def __add__(self, other: "ResourceSet") -> "ResourceSet":
+        out = dict(self._amounts)
+        for k, v in other._amounts.items():
+            out[k] = out.get(k, 0) + v
+        return ResourceSet(_fixed=out)
+
+    def __sub__(self, other: "ResourceSet") -> "ResourceSet":
+        out = dict(self._amounts)
+        for k, v in other._amounts.items():
+            out[k] = out.get(k, 0) - v
+            if out[k] < 0:
+                raise ValueError(
+                    f"Resource {k} would go negative: {from_fixed(out[k])}"
+                )
+        return ResourceSet(_fixed=out)
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self._amounts == other._amounts
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+    def __reduce__(self):
+        return (_resource_set_from_fixed, (dict(self._amounts),))
+
+
+def _resource_set_from_fixed(fixed):
+    return ResourceSet(_fixed=fixed)
+
+
+class NodeResources:
+    """Total + available resources of one node, with acquire/release
+    (ref analogue: NodeResources / LocalResourceManager,
+    src/ray/common/scheduling/cluster_resource_data.h)."""
+
+    def __init__(self, total: ResourceSet):
+        self.total = total
+        self.available = ResourceSet(_fixed=dict(total._amounts))
+
+    def can_fit(self, request: ResourceSet) -> bool:
+        return request.is_subset_of(self.available)
+
+    def is_feasible(self, request: ResourceSet) -> bool:
+        return request.is_subset_of(self.total)
+
+    def acquire(self, request: ResourceSet) -> bool:
+        if not self.can_fit(request):
+            return False
+        self.available = self.available - request
+        return True
+
+    def release(self, request: ResourceSet):
+        self.available = self.available + request
+
+    def utilization(self) -> float:
+        """Critical-resource utilization in [0, 1] — the max over resources,
+        as used by the hybrid scheduling policy's node scoring (ref:
+        policy/scorer.h LeastResourceScorer)."""
+        best = 0.0
+        for k, tot in self.total._amounts.items():
+            if tot <= 0:
+                continue
+            used = tot - self.available._amounts.get(k, 0)
+            best = max(best, used / tot)
+        return best

@@ -1,0 +1,407 @@
+"""Driver and worker runtimes: the per-process engine behind the public API.
+
+Ref analogue: the CoreWorker (src/ray/core_worker/core_worker.h — SubmitTask/
+Put/Get/Wait + ReferenceCounter) plus the Python Worker
+(python/ray/_private/worker.py). The driver's runtime calls the in-process
+NodeManager directly; worker runtimes speak the framed socket protocol. Both
+expose the same interface so ``ray_tpu.get`` etc. work anywhere.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .config import get_config
+from .exceptions import GetTimeoutError, TaskError
+from .function_table import FunctionCache, export_function
+from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from .object_store import InlineLocation, LocalObjectStore, Location, ShmLocation
+from .reference import ObjectRef, ref_without_registration
+from .serialization import serialize
+from .task_spec import RefArg, TaskSpec, TaskType, ValueArg
+
+
+class RefCountTable:
+    """Per-process local refcounts with batched delta flushing to the owner
+    directory (ref analogue: local refs in reference_count.h, flushed like
+    the batched release RPCs)."""
+
+    def __init__(self, flush_fn):
+        self._local: Dict[ObjectID, int] = {}
+        self._deltas: Dict[ObjectID, int] = {}
+        self._lock = threading.Lock()
+        self._flush_fn = flush_fn
+
+    def incr(self, oid: ObjectID):
+        with self._lock:
+            self._local[oid] = self._local.get(oid, 0) + 1
+            self._deltas[oid] = self._deltas.get(oid, 0) + 1
+
+    def decr(self, oid: ObjectID):
+        with self._lock:
+            self._local[oid] = self._local.get(oid, 0) - 1
+            if self._local[oid] <= 0:
+                del self._local[oid]
+            self._deltas[oid] = self._deltas.get(oid, 0) - 1
+
+    def flush(self):
+        with self._lock:
+            deltas = {k: v for k, v in self._deltas.items() if v != 0}
+            self._deltas.clear()
+        if deltas:
+            self._flush_fn(deltas)
+
+
+class BaseRuntime:
+    """Shared logic: argument preparation, object read path, ref accounting."""
+
+    def __init__(self, job_id: JobID, node_id: NodeID, worker_id: WorkerID):
+        self.job_id = job_id
+        self.node_id = node_id
+        self.worker_id = worker_id
+        self.store = LocalObjectStore()
+        self.function_cache = FunctionCache()
+        self.refs = RefCountTable(self._flush_deltas)
+        self._put_counter = itertools.count(1)
+        self.current_task_id: Optional[TaskID] = None
+        self.current_actor_id: Optional[ActorID] = None
+        self._registered_functions: set = set()
+        self._flusher_stop = threading.Event()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name="ray_tpu-ref-flusher", daemon=True
+        )
+        self._flusher.start()
+
+    # ---- subclass interface ------------------------------------------------
+
+    def _flush_deltas(self, deltas: Dict[ObjectID, int]):
+        raise NotImplementedError
+
+    def _submit_spec(self, spec: TaskSpec):
+        raise NotImplementedError
+
+    def _get_locations(
+        self, ids: List[ObjectID], timeout: Optional[float]
+    ) -> List[Tuple[ObjectID, Location]]:
+        raise NotImplementedError
+
+    def _wait(
+        self, ids: List[ObjectID], num_returns: int, timeout: Optional[float]
+    ) -> List[ObjectID]:
+        raise NotImplementedError
+
+    def _register_put(self, oid: ObjectID, loc: Location):
+        raise NotImplementedError
+
+    def _register_function_remote(self, function_id: str, blob: bytes):
+        raise NotImplementedError
+
+    # ---- ref plumbing ------------------------------------------------------
+
+    def register_new_ref(self, oid: ObjectID):
+        self.refs.incr(oid)
+
+    def add_local_ref(self, oid: ObjectID):
+        self.refs.incr(oid)
+
+    def release_local_ref(self, oid: ObjectID):
+        self.refs.decr(oid)
+
+    def _flush_loop(self):
+        cfg = get_config()
+        while not self._flusher_stop.wait(cfg.refcount_flush_interval_s):
+            try:
+                self.refs.flush()
+            except Exception:
+                pass
+
+    # ---- put / get / wait --------------------------------------------------
+
+    def _next_put_id(self) -> ObjectID:
+        base = self.current_task_id or TaskID.for_driver(self.job_id)
+        # High bit marks puts so they never collide with return slots.
+        return ObjectID.from_index(base, 0x8000_0000 | next(self._put_counter))
+
+    def put(self, value) -> ObjectRef:
+        oid = self._next_put_id()
+        loc = self._store_value(oid, value)
+        self._register_put(oid, loc)
+        return ObjectRef(oid, _register=True)
+
+    def _store_value(self, oid: ObjectID, value) -> Location:
+        sobj = serialize(value)
+        if sobj.total_size <= get_config().max_inline_object_size:
+            return InlineLocation(sobj.to_bytes())
+        return self.store.put_serialized(oid, sobj)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        ids = [r.id() for r in ref_list]
+        try:
+            locations = self._get_locations(ids, timeout)
+        except TimeoutError as e:
+            raise GetTimeoutError(
+                f"get() timed out after {timeout}s waiting for {len(ids)} objects"
+            ) from e
+        values = []
+        for oid, loc in locations:
+            if loc is None:
+                raise GetTimeoutError(f"object {oid.hex()} unavailable")
+            value = self.store.get_object(loc)
+            if isinstance(value, TaskError):
+                raise value.as_raisable()
+            values.append(value)
+        return values[0] if single else values
+
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+    ):
+        refs = list(refs)
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        ready_ids = set(self._wait([r.id() for r in refs], num_returns, timeout))
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.id() in ready_ids and len(ready) < num_returns
+             else not_ready).append(r)
+        return ready, not_ready
+
+    # ---- task submission ---------------------------------------------------
+
+    def prepare_args(self, args: Sequence[Any], kwargs: Dict[str, Any]):
+        """Convert call arguments into spec args: ObjectRefs pass by
+        reference; large values are promoted to objects (ref analogue:
+        put_threshold inlining in remote_function._remote)."""
+        cfg = get_config()
+        keepalive = []
+
+        def conv(v):
+            if isinstance(v, ObjectRef):
+                keepalive.append(v)
+                return RefArg(v.id())
+            sobj = serialize(v)
+            if sobj.total_size <= cfg.max_inline_object_size:
+                return ValueArg(sobj.to_bytes())
+            oid = self._next_put_id()
+            loc = self.store.put_serialized(oid, sobj)
+            self._register_put(oid, loc)
+            ref = ObjectRef(oid, _register=True)
+            keepalive.append(ref)
+            return RefArg(oid)
+
+        spec_args = [conv(a) for a in args]
+        spec_kwargs = {k: conv(v) for k, v in kwargs.items()}
+        return spec_args, spec_kwargs, keepalive
+
+    def ensure_function(self, fn) -> str:
+        function_id, blob = export_function(fn)
+        if function_id not in self._registered_functions:
+            self._register_function_remote(function_id, blob)
+            self._registered_functions.add(function_id)
+            self.function_cache.add_blob(function_id, blob)
+        return function_id
+
+    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._submit_spec(spec)
+        return [ObjectRef(oid, _register=True) for oid in spec.return_ids()]
+
+    def new_task_id(self) -> TaskID:
+        return TaskID.from_random()
+
+    def shutdown(self):
+        self._flusher_stop.set()
+
+
+class DriverRuntime(BaseRuntime):
+    """Runtime embedded in the driver process; owns the NodeManager."""
+
+    def __init__(self, node_manager, job_id: JobID):
+        self._nm = node_manager
+        super().__init__(
+            job_id=job_id,
+            node_id=node_manager.node_id,
+            worker_id=WorkerID.nil(),
+        )
+
+    def _flush_deltas(self, deltas: Dict[ObjectID, int]):
+        async def _apply():
+            for oid, d in deltas.items():
+                if d > 0:
+                    self._nm.directory.add_ref(oid, d)
+                else:
+                    self._nm._remove_ref(oid, -d)
+
+        self._nm._call(_apply())
+
+    def _submit_spec(self, spec: TaskSpec):
+        self._nm.call_sync(self._nm.submit_task(spec))
+
+    def _get_locations(self, ids, timeout):
+        # asyncio.TimeoutError is TimeoutError on py>=3.11, so callers'
+        # `except TimeoutError` handles loop-side timeouts directly.
+        return self._nm.call_sync(self._nm.get_locations(ids, timeout))
+
+    def _wait(self, ids, num_returns, timeout):
+        return self._nm.call_sync(self._nm.wait_objects(ids, num_returns, timeout))
+
+    def _register_put(self, oid: ObjectID, loc: Location):
+        self._nm.call_sync(self._nm.put_object(oid, loc, refs=0))
+
+    def _register_function_remote(self, function_id: str, blob: bytes):
+        self._nm.call_sync(self._nm.register_function(function_id, blob))
+
+    # Extra control-plane surface used by the public API.
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._nm.call_sync(self._nm.kill_actor(actor_id, no_restart))
+
+    def cancel_task(self, task_id: TaskID, force: bool = False):
+        self._nm.call_sync(self._nm.cancel_task(task_id, force))
+
+    def get_named_actor_spec(self, name: str):
+        return self._nm.call_sync(self._nm.get_named_actor(name))
+
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        return self._nm.kv_put(key, value, overwrite)
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self._nm.kv_get(key)
+
+    def stats(self) -> Dict[str, Any]:
+        return self._nm.call_sync(self._nm.stats())
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self._nm.node_resources.total.to_dict()
+
+    def available_resources(self) -> Dict[str, float]:
+        return self._nm.node_resources.available.to_dict()
+
+    def shutdown(self):
+        super().shutdown()
+        self.refs.flush()
+        self._nm.shutdown()
+        self.store.shutdown(unlink_created=True)
+
+
+class WorkerRuntime(BaseRuntime):
+    """Runtime inside a worker process; all control-plane calls go over the
+    node socket (duplex: replies are matched by msg_id by the reader thread,
+    which runs in worker_main)."""
+
+    def __init__(self, conn, job_id: JobID, node_id: NodeID, worker_id: WorkerID):
+        self._conn = conn
+        self._msg_counter = itertools.count(1)
+        self._pending: Dict[int, _PendingReply] = {}
+        self._pending_lock = threading.Lock()
+        super().__init__(job_id=job_id, node_id=node_id, worker_id=worker_id)
+
+    # Called by worker_main's reader thread.
+    def handle_reply(self, msg: Dict[str, Any]):
+        with self._pending_lock:
+            pending = self._pending.pop(msg.get("msg_id"), None)
+        if pending is not None:
+            pending.payload = msg
+            pending.event.set()
+
+    def request(self, msg: Dict[str, Any], timeout: Optional[float] = None):
+        msg_id = next(self._msg_counter)
+        msg["msg_id"] = msg_id
+        pending = _PendingReply()
+        with self._pending_lock:
+            self._pending[msg_id] = pending
+        self._conn.send(msg)
+        if not pending.event.wait(timeout if timeout is None else timeout + 5):
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+            raise TimeoutError("no reply from node manager")
+        return pending.payload
+
+    def _flush_deltas(self, deltas: Dict[ObjectID, int]):
+        adds = [oid for oid, d in deltas.items() for _ in range(max(0, d))]
+        removes = {oid: -d for oid, d in deltas.items() if d < 0}
+        if adds:
+            self._conn.send({"type": "add_refs", "object_ids": adds})
+        if removes:
+            self._conn.send({"type": "remove_refs", "counts": removes})
+
+    def _submit_spec(self, spec: TaskSpec):
+        spec.owner_id = self.worker_id
+        self._conn.send({"type": "submit", "spec": spec})
+
+    def _get_locations(self, ids, timeout):
+        self._conn.send({"type": "blocked"})
+        try:
+            reply = self.request(
+                {"type": "get_locations", "object_ids": ids, "timeout": timeout},
+                timeout=timeout,
+            )
+        finally:
+            try:
+                self._conn.send({"type": "unblocked"})
+            except Exception:
+                pass
+        if reply.get("timeout"):
+            raise TimeoutError()
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return reply["locations"]
+
+    def _wait(self, ids, num_returns, timeout):
+        self._conn.send({"type": "blocked"})
+        try:
+            reply = self.request(
+                {
+                    "type": "wait",
+                    "object_ids": ids,
+                    "num_returns": num_returns,
+                    "timeout": timeout,
+                },
+                timeout=timeout,
+            )
+        finally:
+            try:
+                self._conn.send({"type": "unblocked"})
+            except Exception:
+                pass
+        return reply["ready"]
+
+    def _register_put(self, oid: ObjectID, loc: Location):
+        self._conn.send({"type": "put", "object_id": oid, "loc": loc, "refs": 0})
+
+    def _register_function_remote(self, function_id: str, blob: bytes):
+        self._conn.send(
+            {"type": "register_function", "function_id": function_id, "blob": blob}
+        )
+
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        return self.request({"type": "kv", "op": "put", "key": key,
+                             "value": value, "overwrite": overwrite})["added"]
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self.request({"type": "kv", "op": "get", "key": key})["value"]
+
+    def get_named_actor_spec(self, name: str):
+        reply = self.request({"type": "get_named_actor", "name": name})
+        return reply["spec"]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._conn.send({"type": "kill_actor", "actor_id": actor_id,
+                         "no_restart": no_restart})
+
+    def cancel_task(self, task_id: TaskID, force: bool = False):
+        self._conn.send({"type": "cancel_task", "task_id": task_id, "force": force})
+
+
+class _PendingReply:
+    __slots__ = ("event", "payload")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.payload = None

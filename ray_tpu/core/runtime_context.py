@@ -1,0 +1,67 @@
+"""Process-global runtime registry.
+
+Both the driver runtime and worker runtimes register here so the public API
+(``ray_tpu.get`` etc.) and ObjectRef refcounting resolve the right engine in
+any process (ref analogue: python/ray/_private/worker.py global_worker +
+python/ray/runtime_context.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_current = None
+
+
+def set_runtime(rt):
+    global _current
+    _current = rt
+
+
+def current_runtime_or_none():
+    return _current
+
+
+def current_runtime():
+    from .exceptions import RuntimeNotInitializedError
+
+    if _current is None:
+        raise RuntimeNotInitializedError()
+    return _current
+
+
+def is_initialized() -> bool:
+    return _current is not None
+
+
+class RuntimeContext:
+    """User-visible runtime introspection (ref: python/ray/runtime_context.py
+    RuntimeContext — get_job_id/get_task_id/get_actor_id/get_worker_id)."""
+
+    def __init__(self, rt):
+        self._rt = rt
+
+    def get_job_id(self) -> str:
+        return self._rt.job_id.hex()
+
+    def get_node_id(self) -> str:
+        return self._rt.node_id.hex()
+
+    def get_worker_id(self) -> str:
+        return self._rt.worker_id.hex()
+
+    def get_actor_id(self) -> Optional[str]:
+        aid = getattr(self._rt, "current_actor_id", None)
+        return aid.hex() if aid is not None else None
+
+    def get_task_id(self) -> Optional[str]:
+        tid = getattr(self._rt, "current_task_id", None)
+        return tid.hex() if tid is not None else None
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return getattr(self._rt, "actor_restart_count", 0) > 0
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(current_runtime())

@@ -1,0 +1,69 @@
+"""Task specifications.
+
+Mirrors the reference's TaskSpecification (ref: src/ray/common/task/task_spec.h
+over protobuf common.proto TaskSpec): one record describing a normal task, an
+actor-creation task, or an actor method call. Functions are distributed by
+content hash through the head's function table (ref analogue:
+python/ray/_private/function_manager.py exporting pickled functions to GCS KV)
+so a function is pickled once per cluster, not once per call.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, ObjectID, TaskID, WorkerID
+from .resources import ResourceSet
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+@dataclass(frozen=True)
+class RefArg:
+    """A top-level ObjectRef argument: resolved to its value by the executing
+    worker before the function runs (nested refs pass through untouched, same
+    semantics as the reference)."""
+
+    object_id: ObjectID
+
+
+@dataclass(frozen=True)
+class ValueArg:
+    data: bytes  # framed SerializedObject bytes
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    task_type: TaskType
+    function_id: str  # content hash into the cluster function table
+    args: List[Any] = field(default_factory=list)  # RefArg | ValueArg
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+    num_returns: int = 1
+    resources: ResourceSet = field(default_factory=ResourceSet)
+    name: str = ""
+    max_retries: int = 0
+    retries_left: int = 0
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    method_name: str = ""
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    # Owner bookkeeping (worker that submitted the task; nil = driver)
+    owner_id: Optional[WorkerID] = None
+
+    def return_ids(self) -> Tuple[ObjectID, ...]:
+        return tuple(
+            ObjectID.from_index(self.task_id, i) for i in range(self.num_returns)
+        )
+
+    def dependency_ids(self) -> Tuple[ObjectID, ...]:
+        deps = [a.object_id for a in self.args if isinstance(a, RefArg)]
+        deps += [a.object_id for a in self.kwargs.values() if isinstance(a, RefArg)]
+        return tuple(deps)

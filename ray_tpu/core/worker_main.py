@@ -1,0 +1,146 @@
+"""Worker process entry point.
+
+Ref analogue: python/ray/_private/workers/default_worker.py + the task
+execution loop in _raylet.pyx (run_task_loop / task_execution_handler). A
+reader thread demultiplexes the duplex socket: execute requests go to the
+main-thread task queue; replies resolve pending runtime requests.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+from typing import List
+
+from .executor import ActorContainer, execute_task
+from .function_table import FunctionCache
+from .ids import JobID, NodeID, ObjectID, WorkerID
+from .object_store import Location
+from .protocol import Connection, ConnectionClosed, connect_unix
+from .runtime import WorkerRuntime
+from .serialization import SerializedObject
+from .task_spec import TaskSpec, TaskType
+from . import runtime_context
+
+
+class Worker:
+    def __init__(self, conn: Connection, worker_id: WorkerID):
+        self.conn = conn
+        self.worker_id = worker_id
+        self.task_queue: "queue.Queue" = queue.Queue()
+        self.actor = ActorContainer()
+        self.runtime: WorkerRuntime | None = None
+        self._alive = True
+
+    def start(self):
+        self.conn.send({"type": "register", "worker_id": self.worker_id.hex()})
+        ack = self.conn.recv()
+        assert ack["type"] == "registered", ack
+        node_id = NodeID.from_hex(ack["node_id"])
+        self.runtime = WorkerRuntime(
+            self.conn,
+            job_id=JobID.nil(),
+            node_id=node_id,
+            worker_id=self.worker_id,
+        )
+        runtime_context.set_runtime(self.runtime)
+        reader = threading.Thread(target=self._reader_loop, daemon=True)
+        reader.start()
+        self._main_loop()
+
+    def _reader_loop(self):
+        try:
+            while self._alive:
+                msg = self.conn.recv()
+                mtype = msg["type"]
+                if mtype == "execute":
+                    self.task_queue.put(msg)
+                elif mtype == "reply":
+                    self.runtime.handle_reply(msg)
+                elif mtype == "kill":
+                    self._alive = False
+                    self.task_queue.put(None)
+                    break
+        except (ConnectionClosed, OSError):
+            self._alive = False
+            self.task_queue.put(None)
+
+    def _main_loop(self):
+        while self._alive:
+            msg = self.task_queue.get()
+            if msg is None:
+                break
+            self._run_task(msg["spec"], msg.get("function_blob"))
+        # Flush refcounts before exit so the head's accounting stays sane.
+        try:
+            self.runtime.refs.flush()
+        except Exception:
+            pass
+        os._exit(0)
+
+    def _run_task(self, spec: TaskSpec, function_blob):
+        rt = self.runtime
+        cache: FunctionCache = rt.function_cache
+        if function_blob is not None:
+            cache.add_blob(spec.function_id, function_blob)
+
+        def load_function(function_id: str):
+            if not cache.has(function_id):
+                reply = rt.request(
+                    {"type": "fetch_function", "function_id": function_id}
+                )
+                if reply.get("blob") is None:
+                    raise RuntimeError(f"function {function_id} not found")
+                cache.add_blob(function_id, reply["blob"])
+            return cache.load(function_id)
+
+        def fetch(ids: List[ObjectID]):
+            from .reference import ref_without_registration
+
+            # Values come straight from locations; errors raise (propagating
+            # dependency failures, matching the reference's semantics).
+            locations = rt._get_locations(ids, None)
+            values = []
+            from .exceptions import TaskError
+
+            for oid, loc in locations:
+                value = rt.store.get_object(loc)
+                if isinstance(value, TaskError):
+                    raise value.as_raisable()
+                values.append(value)
+            return values
+
+        def store_large(oid: ObjectID, sobj: SerializedObject) -> Location:
+            return rt.store.put_serialized(oid, sobj)
+
+        rt.current_task_id = spec.task_id
+        if spec.task_type in (TaskType.ACTOR_CREATION_TASK, TaskType.ACTOR_TASK):
+            rt.current_actor_id = spec.actor_id
+        try:
+            results, failed = execute_task(
+                spec, load_function, fetch, store_large, self.actor
+            )
+        finally:
+            rt.current_task_id = None
+        self.conn.send(
+            {
+                "type": "task_done",
+                "task_id": spec.task_id,
+                "results": results,
+                "failed": failed,
+            }
+        )
+
+
+def main():
+    worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+    socket_path = os.environ["RAY_TPU_NODE_SOCKET"]
+    conn = connect_unix(socket_path)
+    worker = Worker(conn, worker_id)
+    worker.start()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

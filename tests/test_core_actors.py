@@ -1,0 +1,154 @@
+"""Actor tests (ref analogue: python/ray/tests/test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def incr(self, by=1):
+        self.value += by
+        return self.value
+
+    def read(self):
+        return self.value
+
+
+def test_actor_basic(ray_tpu_start):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote(5)) == 6
+    assert ray_tpu.get(c.read.remote()) == 6
+
+
+def test_actor_constructor_args(ray_tpu_start):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.read.remote()) == 100
+
+
+def test_actor_method_ordering(ray_tpu_start):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_two_actors_isolated(ray_tpu_start):
+    a, b = Counter.remote(), Counter.remote()
+    ray_tpu.get(a.incr.remote())
+    assert ray_tpu.get(b.read.remote()) == 0
+    assert ray_tpu.get(a.read.remote()) == 1
+
+
+def test_actor_method_error(ray_tpu_start):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return "fine"
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="actor method failed"):
+        ray_tpu.get(b.fail.remote())
+    # Actor survives a method exception.
+    assert ray_tpu.get(b.ok.remote()) == "fine"
+
+
+def test_actor_constructor_error(ray_tpu_start):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("bad init")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.m.remote())
+
+
+def test_kill_actor(ray_tpu_start):
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote())
+    ray_tpu.kill(c)
+    time.sleep(0.5)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(c.incr.remote())
+
+
+def test_named_actor(ray_tpu_start):
+    Counter.options(name="global_counter").remote(7)
+    time.sleep(0.3)
+    handle = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(handle.read.remote()) == 7
+
+
+def test_actor_handle_passing(ray_tpu_start):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.incr.remote())
+
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(c.read.remote()) == 1
+
+
+def test_actor_restart(ray_tpu_start):
+    import os
+
+    @ray_tpu.remote(max_restarts=1)
+    class Fragile:
+        def __init__(self):
+            self.count = 0
+
+        def crash(self):
+            os._exit(1)
+
+        def ping(self):
+            self.count += 1
+            return self.count
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.ping.remote()) == 1
+    try:
+        ray_tpu.get(f.crash.remote())
+    except Exception:
+        pass
+    # After restart, state resets and the actor serves again.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            assert ray_tpu.get(f.ping.remote(), timeout=5) >= 1
+            break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_actor_no_restart_dies(ray_tpu_start):
+    import os
+
+    @ray_tpu.remote
+    class Fragile:
+        def crash(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.ping.remote()) == "pong"
+    with pytest.raises(Exception):
+        ray_tpu.get(f.crash.remote())
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(f.ping.remote())

@@ -1,0 +1,194 @@
+"""Core task/object API tests (ref analogue: python/ray/tests/test_basic.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get(ray_tpu_start):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+
+
+def test_put_get_large_numpy(ray_tpu_start):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(ray_tpu_start):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_arg(ray_tpu_start):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    ref = ray_tpu.put(21)
+    assert ray_tpu.get(double.remote(ref)) == 42
+
+
+def test_task_chain(ray_tpu_start):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(9):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 10
+
+
+def test_many_parallel_tasks(ray_tpu_start):
+    @ray_tpu.remote
+    def square(i):
+        return i * i
+
+    refs = [square.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_large_task_output(ray_tpu_start):
+    @ray_tpu.remote
+    def make_array(n):
+        return np.ones(n, dtype=np.float64)
+
+    out = ray_tpu.get(make_array.remote(500_000))
+    assert out.shape == (500_000,)
+    assert out.sum() == 500_000
+
+
+def test_large_task_arg(ray_tpu_start):
+    arr = np.random.rand(300_000)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert abs(ray_tpu.get(total.remote(arr)) - arr.sum()) < 1e-6
+
+
+def test_multiple_returns(ray_tpu_start):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_tpu_start):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ValueError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_propagates_through_lineage(ray_tpu_start):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(Exception):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_wait(ray_tpu_start):
+    import time
+
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_wait_timeout_empty(ray_tpu_start):
+    import time
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    ready, not_ready = ray_tpu.wait([slow.remote()], num_returns=1, timeout=0.2)
+    assert ready == []
+    assert len(not_ready) == 1
+
+
+def test_get_timeout(ray_tpu_start):
+    import time
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_nested_tasks(ray_tpu_start):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_nested_ref_passthrough(ray_tpu_start):
+    @ray_tpu.remote
+    def make():
+        return 7
+
+    @ray_tpu.remote
+    def passthrough(refs):
+        # Nested (non-top-level) refs are not resolved automatically.
+        return ray_tpu.get(refs[0])
+
+    assert ray_tpu.get(passthrough.remote([make.remote()])) == 7
+
+
+def test_cluster_resources(ray_tpu_start):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4
+
+
+def test_kwargs(ray_tpu_start):
+    @ray_tpu.remote
+    def f(a, b=10):
+        return a + b
+
+    assert ray_tpu.get(f.remote(1)) == 11
+    assert ray_tpu.get(f.remote(1, b=2)) == 3
+
+
+def test_options_name(ray_tpu_start):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(name="custom").remote()) == 1
