@@ -1,0 +1,7 @@
+"""ray_tpu.ops: compute kernels (XLA reference paths + Pallas TPU kernels)."""
+
+from .attention import (  # noqa: F401
+    attention_block_accumulate,
+    attention_finalize,
+    mha_attention,
+)

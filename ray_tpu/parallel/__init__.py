@@ -1,0 +1,43 @@
+"""ray_tpu.parallel: meshes, shardings, collectives, sequence parallelism.
+
+TPU-native replacement for the reference's parallelism stack (SURVEY.md
+§2.5): instead of NCCL process groups + DDP/FSDP wrapper classes, every
+strategy is a named mesh axis + sharding rule, and collectives are emitted
+by XLA over ICI.
+"""
+
+from .mesh import (  # noqa: F401
+    AXIS_ORDER,
+    MeshConfig,
+    data_axes,
+    make_mesh,
+    mesh_axis_size,
+    num_data_shards,
+    single_device_mesh,
+)
+from .sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    logical_to_spec,
+    named_sharding,
+    prune_spec,
+    shard_pytree,
+    with_logical_constraint,
+)
+from .collectives import (  # noqa: F401
+    CollectiveGroup,
+    all_to_all,
+    allgather,
+    allreduce,
+    axis_index,
+    axis_size,
+    broadcast,
+    init_collective_group,
+    ppermute,
+    reducescatter,
+    shift,
+)
+from .ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_shard,
+    ulysses_attention_shard,
+)

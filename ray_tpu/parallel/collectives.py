@@ -1,0 +1,146 @@
+"""Collective communication.
+
+The reference ships ray.util.collective with NCCL/Gloo process groups
+(ref: util/collective/collective.py:258-615 — allreduce/allgather/
+reducescatter/broadcast/send/recv; NCCL group at
+collective_group/nccl_collective_group.py:127). On TPU the tensor plane is
+XLA over ICI: inside an SPMD region these are jax.lax collectives and XLA
+schedules them; there is no process-group object to manage. This module
+provides:
+
+1. The in-graph API (allreduce/allgather/...) — thin, named-axis versions
+   of jax.lax collectives for use under shard_map/pjit.
+2. A host-level CollectiveGroup with barrier/broadcast over the control
+   plane KV store, replacing the reference's NCCLUniqueIDStore named-actor
+   rendezvous (ref: nccl_collective_group.py:571).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+AxisName = Union[str, Sequence[str]]
+
+
+# ---------------------------------------------------------------- in-graph
+
+def allreduce(x, axis: AxisName = "dp", op: str = "sum"):
+    if op == "sum":
+        return jax.lax.psum(x, axis)
+    if op == "mean":
+        return jax.lax.pmean(x, axis)
+    if op == "max":
+        return jax.lax.pmax(x, axis)
+    if op == "min":
+        return jax.lax.pmin(x, axis)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def allgather(x, axis: AxisName = "dp", *, tiled: bool = True, gather_dim: int = 0):
+    return jax.lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reducescatter(x, axis: AxisName = "dp", *, scatter_dim: int = 0):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def broadcast(x, axis: AxisName = "dp", root: int = 0):
+    """Every rank takes root's value (in-graph select over axis index)."""
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis)
+
+
+def ppermute(x, axis: AxisName, perm):
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def shift(x, axis: AxisName, offset: int = 1):
+    """Rotate values around the ring by ``offset`` (the KV-rotation
+    primitive of ring attention)."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: AxisName, *, split_axis: int, concat_axis: int,
+               tiled: bool = True):
+    return jax.lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
+    )
+
+
+def axis_index(axis: AxisName = "dp"):
+    return jax.lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName = "dp"):
+    return jax.lax.axis_size(axis)
+
+
+# ------------------------------------------------------------- host level
+
+class CollectiveGroup:
+    """Host-side rendezvous/barrier/broadcast between actors of an SPMD
+    group, built on the control-plane KV (ref analogue: the
+    init_collective_group + NCCLUniqueIDStore rendezvous in
+    util/collective/collective.py:120; here no communicator needs creating —
+    this only synchronizes host processes around jax.distributed and
+    checkpoint/restore edges)."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._epoch = 0
+
+    def _kv(self):
+        from ..core.runtime_context import current_runtime
+
+        return current_runtime()
+
+    def barrier(self, timeout_s: float = 120.0):
+        rt = self._kv()
+        self._epoch += 1
+        key = f"__collective__/{self.group_name}/barrier/{self._epoch}/{self.rank}"
+        rt.kv_put(key, b"1")
+        deadline = time.monotonic() + timeout_s
+        prefix = f"__collective__/{self.group_name}/barrier/{self._epoch}/"
+        while time.monotonic() < deadline:
+            arrived = sum(
+                1
+                for r in range(self.world_size)
+                if rt.kv_get(prefix + str(r)) is not None
+            )
+            if arrived >= self.world_size:
+                return
+            time.sleep(0.01)
+        raise TimeoutError(
+            f"collective barrier {self.group_name!r} timed out "
+            f"({self.world_size} ranks expected)"
+        )
+
+    def broadcast_obj(self, obj: Any = None, root: int = 0, timeout_s: float = 120.0):
+        import cloudpickle
+
+        rt = self._kv()
+        key = f"__collective__/{self.group_name}/bcast/{self._epoch}"
+        if self.rank == root:
+            rt.kv_put(key, cloudpickle.dumps(obj))
+            return obj
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            blob = rt.kv_get(key)
+            if blob is not None:
+                return cloudpickle.loads(blob)
+            time.sleep(0.01)
+        raise TimeoutError(f"broadcast in {self.group_name!r} timed out")
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> CollectiveGroup:
+    return CollectiveGroup(group_name, world_size, rank)

@@ -1,0 +1,104 @@
+"""Device mesh construction for TPU slices.
+
+This replaces the reference's process-group world (torch.distributed NCCL
+groups set up by train/torch/config.py:62 _setup_torch_process_group) with
+the TPU-native model: one global `jax.sharding.Mesh` whose named axes carry
+every parallelism strategy (SURVEY.md §2.5):
+
+    dp    — data parallel (replica groups)
+    fsdp  — fully-sharded data parallel (ZeRO-equivalent parameter sharding)
+    ep    — expert parallel (MoE expert placement)
+    pp    — pipeline parallel (layer stages)
+    sp    — sequence/context parallel (ring attention axis)
+    tp    — tensor parallel (innermost: highest-bandwidth ICI neighbors)
+
+Axis order puts tp last so tensor-parallel collectives ride adjacent ICI
+links (jax orders devices so the trailing mesh dims are nearest neighbors).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER: Tuple[str, ...] = ("dp", "fsdp", "ep", "pp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes per parallelism axis; -1 on at most one axis means "absorb all
+    remaining devices"."""
+
+    dp: int = -1
+    fsdp: int = 1
+    ep: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXIS_ORDER)
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        sizes = list(self.sizes())
+        wildcard = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wildcard) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wildcard:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            sizes[wildcard[0]] = n_devices // fixed
+        elif fixed > n_devices:
+            raise ValueError(
+                f"mesh {dict(zip(AXIS_ORDER, sizes))} needs {fixed} devices, "
+                f"have {n_devices}"
+            )
+        # fixed < n_devices with all axes explicit: use a device subset.
+        return MeshConfig(**dict(zip(AXIS_ORDER, sizes)))
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    **axis_sizes: int,
+) -> Mesh:
+    """Build the global mesh.
+
+    make_mesh(dp=2, tp=4) or make_mesh(MeshConfig(...)). Unspecified axes
+    default to 1; dp absorbs leftover devices unless explicitly set.
+    """
+    if config is None:
+        config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    config = config.resolve(len(devices))
+    shape = config.sizes()
+    dev_array = np.asarray(devices[: math.prod(shape)]).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    device = device or jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape((1,) * len(AXIS_ORDER)), AXIS_ORDER)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+def data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Axes across which the global batch is split (dp + fsdp: fsdp ranks
+    see distinct data shards, ZeRO-style)."""
+    return tuple(a for a in ("dp", "fsdp") if mesh_axis_size(mesh, a) > 1) or ("dp",)
+
+
+def num_data_shards(mesh: Mesh) -> int:
+    return mesh_axis_size(mesh, "dp") * mesh_axis_size(mesh, "fsdp")

@@ -1,0 +1,109 @@
+"""Pipeline parallelism: stage-sharded execution with microbatch rotation.
+
+Absent from the reference (SURVEY.md §2.5 — Ray ships no PP); built
+TPU-native: layer stages live on the "pp" mesh axis, activations move
+stage-to-stage with collective-permute inside a lax.scan shift register
+(GPipe schedule: n_micro + n_stages - 1 ticks, bubble at the ends). Because
+the schedule is plain differentiable JAX (scan + ppermute), jax.grad gives
+the pipelined backward pass for free; wrap the stage body in jax.checkpoint
+to trade recompute for activation memory.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_shard(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,          # this device's stage parameters
+    x: jax.Array,               # [n_micro, mb, ...] microbatched input (replicated)
+    *,
+    axis_name: str = "pp",
+    remat: bool = True,
+) -> jax.Array:
+    """Call INSIDE shard_map. Every device runs the same schedule; stage 0
+    injects microbatches, the last stage's outputs are gathered into
+    [n_micro, mb, ...] (valid only on the last stage; callers psum-select)."""
+    n_stages = jax.lax.axis_size(axis_name)
+    stage_idx = jax.lax.axis_index(axis_name)
+    n_micro = x.shape[0]
+    ticks = n_micro + n_stages - 1
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        state, outbuf = carry
+        # Stage 0 reads microbatch t (clamped; masked out past the end).
+        mb_idx = jnp.minimum(t, n_micro - 1)
+        fresh = jax.lax.dynamic_index_in_dim(x, mb_idx, axis=0, keepdims=False)
+        inp = jnp.where(stage_idx == 0, fresh, state)
+        out = body(stage_params, inp)
+        # Last stage writes its finished microbatch t - (n_stages - 1).
+        done_idx = t - (n_stages - 1)
+        write_idx = jnp.clip(done_idx, 0, n_micro - 1)
+        should_write = done_idx >= 0
+        prev = jax.lax.dynamic_index_in_dim(
+            outbuf, write_idx, axis=0, keepdims=False
+        )
+        outbuf = jax.lax.dynamic_update_index_in_dim(
+            outbuf, jnp.where(should_write, out, prev), write_idx, axis=0
+        )
+        state = jax.lax.ppermute(out, axis_name, fwd_perm)
+        return (state, outbuf), None
+
+    state0 = jnp.zeros_like(x[0])
+    outbuf0 = jnp.zeros_like(x)
+    (_, outbuf), _ = jax.lax.scan(tick, (state0, outbuf0), jnp.arange(ticks))
+    return outbuf
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,        # pytree, leading dim n_stages on every leaf
+    x: jax.Array,               # [batch, ...] global input
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    axis_name: str = "pp",
+    remat: bool = True,
+) -> jax.Array:
+    """Global-view pipeline: shards stacked stage params over "pp", splits
+    the batch into microbatches, returns [batch, ...] outputs."""
+    n_stages = mesh.shape[axis_name]
+    B = x.shape[0]
+    assert B % n_microbatches == 0, (B, n_microbatches)
+    xm = x.reshape(n_microbatches, B // n_microbatches, *x.shape[1:])
+
+    def run(params_stacked, xm_local):
+        # Each device holds params_stacked with leading dim 1: its stage.
+        my_params = jax.tree.map(lambda p: p[0], params_stacked)
+        outbuf = pipeline_shard(
+            stage_fn, my_params, xm_local, axis_name=axis_name, remat=remat
+        )
+        # Only the last stage's buffer is valid; broadcast it to all stages
+        # so the result is replicated over pp.
+        last = jax.lax.axis_size(axis_name) - 1
+        mask = (jax.lax.axis_index(axis_name) == last).astype(outbuf.dtype)
+        return jax.lax.psum(outbuf * mask, axis_name)
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    out = jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, xm)
+    return out.reshape(B, *out.shape[2:])
+
+
+def pipeline_stage_params_spec(stacked_params: Any, axis_name: str = "pp"):
+    """PartitionSpec pytree for stage-stacked parameters."""
+    return jax.tree.map(lambda _: P(axis_name), stacked_params)

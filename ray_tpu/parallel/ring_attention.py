@@ -1,0 +1,131 @@
+"""Ring attention: sequence-parallel exact attention over the ICI ring.
+
+Absent from the reference (SURVEY.md §5.7 — no ring attention, Ulysses or
+context parallelism exists in Ray); built new here as first-class TPU
+capability. Design: the sequence axis is sharded over mesh axis "sp"; each
+device holds Q/K/V blocks [B, S/n, H, D]. n steps of online-softmax
+(flash-style) accumulation; between steps the KV block rotates one hop
+around the ring via ppermute, so every query block sees every KV block
+while per-device memory stays O(S/n) — the XLA scheduler overlaps the
+ppermute with the current block's compute.
+
+Also here: Ulysses-style all-to-all attention (reshard seq→heads, local
+attention, reshard back) — cheaper at moderate sequence lengths, limited
+by head count.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import (
+    attention_block_accumulate,
+    attention_finalize,
+    mha_attention,
+)
+from .collectives import shift
+
+
+def ring_attention_shard(
+    q: jax.Array,  # [B, Sl, H, D] local query block
+    k: jax.Array,  # [B, Sl, Hkv, D]
+    v: jax.Array,  # [B, Sl, Hkv, D]
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Call INSIDE shard_map with the sequence dim sharded over
+    ``axis_name``. Exact (not approximate) attention."""
+    B, Sl, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else D ** -0.5
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+
+    m0 = jnp.full((B, H, Sl), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((B, H, Sl), dtype=jnp.float32)
+    acc0 = jnp.zeros((B, Sl, H, D), dtype=jnp.float32)
+
+    q_pos = my * Sl + jnp.arange(Sl)  # global query positions
+
+    def step(carry, s):
+        k_cur, v_cur, m, l, acc = carry
+        kv_idx = (my - s) % n
+        if causal:
+            k_pos = kv_idx * Sl + jnp.arange(Sl)
+            mask = k_pos[None, :] <= q_pos[:, None]  # [Sl, Sl]
+        else:
+            mask = None
+        m, l, acc = attention_block_accumulate(
+            q, k_cur, v_cur, m, l, acc, scale=scale, mask=mask
+        )
+        # Rotate KV one hop; overlapped with the next block's compute by XLA.
+        k_nxt = shift(k_cur, axis_name, 1)
+        v_nxt = shift(v_cur, axis_name, 1)
+        return (k_nxt, v_nxt, m, l, acc), None
+
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n)
+    )
+    return attention_finalize(l, acc).astype(q.dtype)
+
+
+def ulysses_attention_shard(
+    q: jax.Array,  # [B, Sl, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """DeepSpeed-Ulysses-style: all-to-all reshard [B,S/n,H,D] →
+    [B,S,H/n,D], local full-sequence attention on a head subset, reshard
+    back. Requires H % n == 0. Two all-to-alls instead of n ppermutes."""
+    n = jax.lax.axis_size(axis_name)
+    H = q.shape[2]
+    assert H % n == 0, f"ulysses needs heads({H}) % sp({n}) == 0"
+    # split heads, gather sequence
+    qg = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kg = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vg = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = mha_attention(qg, kg, vg, causal=causal, scale=scale)
+    # gather heads back, re-split sequence
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S, H, D] global
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    impl: str = "ring",
+) -> jax.Array:
+    """Global-view entry: shards B over (dp, fsdp), S over sp, heads over tp
+    and runs the sequence-parallel kernel under shard_map."""
+    from .sharding import prune_spec
+
+    qspec = prune_spec(mesh, P(("dp", "fsdp"), "sp", "tp", None))
+    fn = ring_attention_shard if impl == "ring" else ulysses_attention_shard
+    wrapped = jax.shard_map(
+        functools.partial(fn, causal=causal, scale=scale, axis_name="sp"),
+        mesh=mesh,
+        in_specs=(qspec, qspec, qspec),
+        out_specs=qspec,
+        check_vma=False,
+    )
+    return wrapped(q, k, v)

@@ -7,6 +7,36 @@ set before jax is imported anywhere in the test process.
 """
 
 import os
+import sys
+
+# The axon TPU hook (sitecustomize) imports jax at interpreter start when
+# PALLAS_AXON_POOL_IPS is set, which locks the backend before
+# xla_force_host_platform_device_count can apply. pytest_configure below
+# re-execs pytest once with a cleaned environment so tests get the virtual
+# 8-device CPU mesh (after suspending pytest's fd capture, which would
+# otherwise swallow the re-exec'd process's output).
+
+
+def pytest_configure(config):
+    if os.environ.get("PALLAS_AXON_POOL_IPS") and not os.environ.get(
+        "RAY_TPU_TEST_REEXEC"
+    ):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["RAY_TPU_TEST_REEXEC"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        capman = config.pluginmanager.getplugin("capturemanager")
+        if capman is not None:
+            capman.stop_global_capturing()
+        os.execve(
+            sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env
+        )
+
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
