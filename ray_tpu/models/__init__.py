@@ -1,0 +1,13 @@
+"""ray_tpu.models: TPU-first model zoo for the benchmark configs
+(BASELINE.json): Llama-3 family (+ Mixtral MoE via n_experts), ResNet/CIFAR,
+ViT for image pipelines."""
+
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    causal_lm_loss,
+    forward,
+    init_params,
+    num_params,
+    param_logical_axes,
+)
+from .resnet import ResNet, resnet18, resnet50  # noqa: F401

@@ -1,0 +1,262 @@
+"""Llama-family transformer, TPU-first.
+
+The flagship model (BASELINE.json configs: Llama-3 8B/70B, Mixtral 8x7B via
+``n_experts``). Design choices for TPU/XLA:
+
+- Pure-functional: params are a pytree of arrays; sharding is declared as a
+  matching pytree of logical axes (parallel/sharding.py rules) — pjit/GSPMD
+  inserts the collectives for dp/fsdp/tp; ring attention (sp) is an explicit
+  shard_map island inside the jitted program.
+- Layers are *stacked* ([L, ...] leaves) and applied with lax.scan: one
+  layer gets compiled once regardless of depth (compile-time O(1) in L),
+  and the "layers" leading axis is what pipeline parallelism shards.
+- bfloat16 activations/weights with float32 RMSNorm/softmax/rope, the
+  standard TPU mixed-precision recipe (MXU eats bf16; norms need f32).
+- jax.checkpoint around each layer body for rematerialization.
+
+The reference has no model zoo — it orchestrates user models; this
+framework owns its compute path (SURVEY.md §7 phase 7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import with_logical_constraint
+from ..parallel.mesh import mesh_axis_size
+from ..parallel.ring_attention import ring_attention
+from ..parallel.moe import moe_ffn
+from ..ops.attention import mha_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32_000
+    hidden_size: int = 4096
+    intermediate_size: int = 14_336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: Optional[int] = None
+    rope_theta: float = 500_000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # MoE (Mixtral-style) when n_experts > 0.
+    n_experts: int = 0
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    remat: bool = True
+
+    @property
+    def dh(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_70b() -> "LlamaConfig":
+        return LlamaConfig(
+            hidden_size=8192, intermediate_size=28_672, num_layers=80,
+            num_heads=64, num_kv_heads=8,
+        )
+
+    @staticmethod
+    def mixtral_8x7b() -> "LlamaConfig":
+        return LlamaConfig(
+            hidden_size=4096, intermediate_size=14_336, num_layers=32,
+            num_heads=32, num_kv_heads=8, n_experts=8, top_k=2,
+        )
+
+    @staticmethod
+    def tiny(vocab: int = 256, moe: bool = False) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=vocab, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, rope_theta=10_000.0,
+            dtype=jnp.float32, n_experts=4 if moe else 0, top_k=2,
+        )
+
+
+# Logical axes for each parameter leaf (maps through DEFAULT_RULES:
+# embed→fsdp, heads/mlp/vocab→tp, expert→ep, layers→pp-or-scan).
+def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    layer = {
+        "attn_norm": ("layers", "norm"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", "norm"),
+    }
+    if cfg.n_experts > 0:
+        layer.update(
+            router=("layers", "embed", None),
+            w_gate=("layers", "expert", "embed", "mlp"),
+            w_up=("layers", "expert", "embed", "mlp"),
+            w_down=("layers", "expert", "mlp", "embed"),
+        )
+    else:
+        layer.update(
+            w_gate=("layers", "embed", "mlp"),
+            w_up=("layers", "embed", "mlp"),
+            w_down=("layers", "mlp", "embed"),
+        )
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    k = iter(jax.random.split(key, 16))
+    M, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    H, Hkv, Dh, V = cfg.num_heads, cfg.num_kv_heads, cfg.dh, cfg.vocab_size
+    dt = cfg.dtype
+
+    def norm_init(shape):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+    def winit(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * (fan_in ** -0.5)).astype(dt)
+
+    layers: Dict[str, Any] = {
+        "attn_norm": norm_init((L, M)),
+        "wq": winit(next(k), (L, M, H, Dh), M),
+        "wk": winit(next(k), (L, M, Hkv, Dh), M),
+        "wv": winit(next(k), (L, M, Hkv, Dh), M),
+        "wo": winit(next(k), (L, H, Dh, M), H * Dh),
+        "mlp_norm": norm_init((L, M)),
+    }
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        layers.update(
+            router=winit(next(k), (L, M, E), M).astype(jnp.float32),
+            w_gate=winit(next(k), (L, E, M, F), M),
+            w_up=winit(next(k), (L, E, M, F), M),
+            w_down=winit(next(k), (L, E, F, M), F),
+        )
+    else:
+        layers.update(
+            w_gate=winit(next(k), (L, M, F), M),
+            w_up=winit(next(k), (L, M, F), M),
+            w_down=winit(next(k), (L, F, M), F),
+        )
+    return {
+        "embed": winit(next(k), (V, M), M),
+        "layers": layers,
+        "final_norm": norm_init((M,)),
+        "lm_head": winit(next(k), (M, V), M),
+    }
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale * w).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x [B, S, H, D], positions [S] (global indices so
+    sequence-sharded blocks stay correct)."""
+    D = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(cfg: LlamaConfig, mesh, q, k, v):
+    if mesh is not None and mesh_axis_size(mesh, "sp") > 1:
+        return ring_attention(q, k, v, mesh, causal=True)
+    return mha_attention(q, k, v, causal=True)
+
+
+def _layer(cfg: LlamaConfig, mesh, positions, x, lp):
+    """One transformer block. x [B, S, M]."""
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = jnp.einsum("bsm,mhd->bshd", h, lp["wq"])
+    kk = jnp.einsum("bsm,mhd->bshd", h, lp["wk"])
+    vv = jnp.einsum("bsm,mhd->bshd", h, lp["wv"])
+    q = rope(q, positions, cfg.rope_theta)
+    kk = rope(kk, positions, cfg.rope_theta)
+    q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"),
+                                mesh=mesh)
+    attn = _attention(cfg, mesh, q, kk, vv)
+    x = x + jnp.einsum("bshd,hdm->bsm", attn, lp["wo"])
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    if cfg.n_experts > 0:
+        out, aux = moe_ffn(
+            h, lp["router"], lp["w_up"], lp["w_down"],
+            k=cfg.top_k, capacity_factor=cfg.capacity_factor,
+            w_gate=lp["w_gate"],
+        )
+        x = x + out
+        return x, aux
+    up = jnp.einsum("bsm,mf->bsf", h, lp["w_up"])
+    gate = jnp.einsum("bsm,mf->bsf", h, lp["w_gate"])
+    h = jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up
+    h = with_logical_constraint(h, ("batch", "seq", "mlp"), mesh=mesh)
+    x = x + jnp.einsum("bsf,fm->bsm", h, lp["w_down"])
+    return x, jnp.zeros((), dtype=jnp.float32)
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,  # [B, S] int32
+    cfg: LlamaConfig,
+    mesh=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (logits [B, S, V] float32, moe_aux_loss scalar)."""
+    B, S = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = with_logical_constraint(x, ("batch", "seq", "embed"), mesh=mesh)
+    positions = jnp.arange(S)
+
+    def body(x, lp):
+        fn = _layer
+        if cfg.remat:
+            fn = jax.checkpoint(
+                lambda x_, lp_: _layer(cfg, mesh, positions, x_, lp_)
+            )
+            out, aux = fn(x, lp)
+        else:
+            out, aux = fn(cfg, mesh, positions, x, lp)
+        out = with_logical_constraint(out, ("batch", "seq", "embed"), mesh=mesh)
+        return out, aux
+
+    x, aux = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = jnp.einsum("bsm,mv->bsv", x, params["lm_head"])
+    return logits.astype(jnp.float32), aux.sum()
+
+
+def causal_lm_loss(
+    params: Dict[str, Any],
+    tokens: jax.Array,       # [B, S]
+    cfg: LlamaConfig,
+    mesh=None,
+    *,
+    aux_weight: float = 0.01,
+) -> jax.Array:
+    """Next-token cross entropy (tokens shifted internally)."""
+    logits, aux = forward(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean() + aux_weight * aux
+
+
+def num_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

@@ -1,0 +1,99 @@
+"""ResNet for image classification (the PR1 reference config:
+ResNet-18 / CIFAR-10, BASELINE.json configs[0]).
+
+flax.linen implementation; NHWC layout (TPU conv-native), bfloat16 compute
+with float32 batch-norm statistics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    small_images: bool = True  # CIFAR stem (3x3, no max-pool)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+        )
+        x = x.astype(self.dtype)
+        if self.small_images:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        if not self.small_images:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = ResNetBlock(
+                    self.num_filters * 2 ** i, strides=strides,
+                    conv=conv, norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def resnet18(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(stage_sizes=[2, 2, 2, 2], num_classes=num_classes, **kw)
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    # Note: uses basic blocks (not bottleneck) — parity placeholder; the
+    # benchmark configs use ResNet-18.
+    return ResNet(stage_sizes=[3, 4, 6, 3], num_classes=num_classes, **kw)
+
+
+def create_train_state(model: ResNet, rng: jax.Array, input_shape, tx):
+    """Initialize params + batch stats + optimizer state."""
+    import optax  # noqa: F401
+
+    variables = model.init(rng, jnp.zeros(input_shape, jnp.float32), train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    opt_state = tx.init(params)
+    return {"params": params, "batch_stats": batch_stats,
+            "opt_state": opt_state, "step": 0}
