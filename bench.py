@@ -1,0 +1,120 @@
+"""Benchmark entry point (driver contract).
+
+Measures steady-state training throughput of the flagship Llama model on the
+available accelerator (single TPU chip under the driver) and prints ONE JSON
+line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no TPU tokens/sec numbers (BASELINE.md — published
+set is empty; north-star metrics are established by our own harness), so
+``vs_baseline`` reports model FLOPs utilization (achieved / peak hardware
+FLOPs): a hardware-normalized score that is comparable across rounds and
+chips. Higher is better; 1.0 would be the hardware roofline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def peak_flops_per_chip(backend: str) -> float:
+    if backend == "tpu" or backend == "axon":
+        # TPU v5e (v5 lite): 197 TFLOPs bf16 per chip. Conservative default
+        # for unknown TPU generations.
+        return 197e12
+    return 1e12  # CPU placeholder so MFU stays finite in dev runs
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import (
+        LlamaConfig,
+        causal_lm_loss,
+        init_params,
+        num_params,
+    )
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    if on_accel:
+        cfg = LlamaConfig(
+            vocab_size=32_768,
+            hidden_size=1024,
+            intermediate_size=3584,
+            num_layers=16,
+            num_heads=16,
+            num_kv_heads=8,
+            dtype=jnp.bfloat16,
+        )
+        batch, seqlen, measure_steps = 8, 1024, 10
+    else:
+        cfg = LlamaConfig(
+            vocab_size=1024, hidden_size=128, intermediate_size=256,
+            num_layers=2, num_heads=4, num_kv_heads=2, dtype=jnp.float32,
+        )
+        batch, seqlen, measure_steps = 4, 256, 3
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    p_count = num_params(params)
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def data(step):
+        return jax.random.randint(
+            jax.random.PRNGKey(step), (batch, seqlen + 1), 0, cfg.vocab_size
+        )
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: causal_lm_loss(p, tokens, cfg)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    # Warmup/compile. A host read of the loss (not just block_until_ready)
+    # guarantees execution completed — the tunneled TPU backend's
+    # block_until_ready can return before the computation lands.
+    tokens = data(0)
+    params, opt_state, loss = step(params, opt_state, tokens)
+    assert float(loss) == float(loss), "warmup loss is NaN"
+
+    t0 = time.perf_counter()
+    last = 0.0
+    for i in range(1, measure_steps + 1):
+        params, opt_state, loss = step(params, opt_state, data(i))
+        last = float(loss)  # host fetch serializes each step
+    dt = time.perf_counter() - t0
+    assert last == last, "loss went NaN during measurement"
+
+    tokens_per_step = batch * seqlen
+    tokens_per_sec = tokens_per_step * measure_steps / dt
+    # Training FLOPs/token: 6*P for the dense path + attention term
+    # 12*L*S*H*Dh (fwd 2x QK^T/AV matmuls, x3 for bwd).
+    flops_per_token = 6 * p_count + 12 * cfg.num_layers * seqlen * (
+        cfg.num_heads * cfg.dh
+    )
+    mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip(backend)
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
