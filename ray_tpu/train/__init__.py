@@ -1,0 +1,24 @@
+"""ray_tpu.train: distributed training (Ray Train equivalent, TPU-native).
+
+Public surface mirrors ray.train (SURVEY.md §2.3): JaxTrainer ~ TorchTrainer,
+session functions report/get_checkpoint/get_dataset_shard/get_world_rank,
+Checkpoint, ScalingConfig/RunConfig/CheckpointConfig/FailureConfig, Result.
+"""
+
+from .checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from .config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from .session import (  # noqa: F401
+    get_checkpoint,
+    get_dataset_shard,
+    get_session,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from .trainer import JaxTrainer, TrainWorkerGroupError  # noqa: F401

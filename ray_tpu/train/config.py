@@ -1,0 +1,72 @@
+"""Train/run configuration dataclasses.
+
+Ref analogue: python/ray/air/config.py — ScalingConfig, RunConfig,
+CheckpointConfig, FailureConfig (SURVEY.md §2.3 AIR common).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each needs (ref: air/config.py
+    ScalingConfig). ``use_tpu`` workers are scheduled into accelerator-
+    enabled worker processes (core worker_type="tpu")."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if self.use_tpu:
+            res.setdefault("TPU", 1)
+        else:
+            res.setdefault("CPU", 1)
+        return res
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Ref: air/config.py CheckpointConfig — top-k retention."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Ref: air/config.py FailureConfig — whole-group restart-from-
+    checkpoint on worker failure (SURVEY.md §2.5 elastic row)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig
+    )
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig
+    )
+
+
+@dataclasses.dataclass
+class Result:
+    """Ref analogue: python/ray/air/result.py Result."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional["Checkpoint"]  # noqa: F821
+    error: Optional[BaseException] = None
+    metrics_history: Optional[list] = None
+
+    @property
+    def best_checkpoint(self):
+        return self.checkpoint

@@ -1,0 +1,110 @@
+"""In-worker training session.
+
+Ref analogue: python/ray/train/_internal/session.py _TrainSession (:109) —
+``report(metrics, checkpoint)`` (:393,653), ``get_checkpoint`` (:711), rank
+accessors. Reports stream to the driver through the control-plane KV store
+(sequence-numbered keys) instead of the reference's in-actor queue, so the
+trainer can poll while the worker's actor method is still running.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from .checkpoint import Checkpoint
+
+_session: Optional["TrainSession"] = None
+
+
+class TrainSession:
+    def __init__(
+        self,
+        run_id: str,
+        world_rank: int,
+        world_size: int,
+        storage_dir: str,
+        start_checkpoint: Optional[Checkpoint],
+        dataset_shards: Optional[Dict[str, Any]] = None,
+        trial_info: Optional[Dict[str, Any]] = None,
+    ):
+        self.run_id = run_id
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.storage_dir = storage_dir
+        self.start_checkpoint = start_checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.trial_info = trial_info or {}
+        self._seq = 0
+
+    def _kv(self):
+        from ..core.runtime_context import current_runtime
+
+        return current_runtime()
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None):
+        payload = {
+            "metrics": dict(metrics),
+            "checkpoint_path": checkpoint.path if checkpoint else None,
+            "rank": self.world_rank,
+            "seq": self._seq,
+        }
+        self._kv().kv_put(
+            f"__train__/{self.run_id}/{self.world_rank}/{self._seq}",
+            cloudpickle.dumps(payload),
+        )
+        self._seq += 1
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.start_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        return self.dataset_shards.get(name)
+
+    def checkpoint_dir(self, step: int) -> str:
+        return os.path.join(
+            self.storage_dir, f"checkpoint_{step:06d}_rank{self.world_rank}"
+        )
+
+
+# ---- public session API (module functions, like ray.train.*) ----
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No training session active; these APIs only work inside "
+            "train_loop_per_worker."
+        )
+    return _session
+
+
+def set_session(session: Optional[TrainSession]):
+    global _session
+    _session = session
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_session().get_dataset_shard(name)
+
+
+def get_world_rank() -> int:
+    return get_session().world_rank
+
+
+def get_world_size() -> int:
+    return get_session().world_size
+
+
+def get_trial_name() -> str:
+    return get_session().trial_info.get("name", get_session().run_id)

@@ -1,0 +1,254 @@
+"""JaxTrainer: distributed data-parallel training over worker actors.
+
+Ref analogue: the TorchTrainer path (SURVEY.md §3.4) — BaseTrainer.fit
+(train/base_trainer.py:579) → BackendExecutor (start:124, start_training:438)
+→ WorkerGroup of actors (_internal/worker_group.py:102), with
+_setup_torch_process_group replaced by the TPU-native recipe: each worker is
+one jax process on one host of the slice; rank 0 publishes the coordinator
+address through the control-plane KV and every worker calls
+jax.distributed.initialize, after which the train loop is a single SPMD
+program over the slice's mesh (collectives on ICI via XLA, no NCCL).
+
+Failure handling follows SURVEY.md §2.5: whole-group restart from the last
+checkpoint, bounded by FailureConfig.max_failures.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+from .checkpoint import Checkpoint, CheckpointManager, default_storage_path
+from .config import FailureConfig, Result, RunConfig, ScalingConfig
+from .session import TrainSession, set_session
+
+
+class TrainWorkerGroupError(RuntimeError):
+    pass
+
+
+def _train_worker_entry(
+    fn_blob: bytes,
+    config: Optional[Dict[str, Any]],
+    run_id: str,
+    rank: int,
+    world_size: int,
+    storage_dir: str,
+    start_checkpoint_path: Optional[str],
+    dataset_shards: Dict[str, Any],
+    coordinator: Optional[str],
+    use_tpu: bool,
+):
+    """Runs inside a worker actor process."""
+    if coordinator is not None and world_size > 1 and use_tpu:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world_size,
+            process_id=rank,
+        )
+    fn = cloudpickle.loads(fn_blob)
+    start_ckpt = (
+        Checkpoint(start_checkpoint_path) if start_checkpoint_path else None
+    )
+    session = TrainSession(
+        run_id=run_id,
+        world_rank=rank,
+        world_size=world_size,
+        storage_dir=storage_dir,
+        start_checkpoint=start_ckpt,
+        dataset_shards=dataset_shards,
+    )
+    set_session(session)
+    try:
+        if config is not None:
+            fn(config)
+        else:
+            fn()
+    finally:
+        set_session(None)
+    return "done"
+
+
+class JaxTrainer:
+    """Data-parallel trainer (ref analogue: DataParallelTrainer /
+    TorchTrainer, train/data_parallel_trainer.py:432)."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self._fn = train_loop_per_worker
+        self._config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self._datasets = datasets or {}
+        self._resume = resume_from_checkpoint
+
+    # ------------------------------------------------------------------ fit
+
+    def fit(self) -> Result:
+        import ray_tpu
+
+        storage = self.run_config.storage_path or default_storage_path(
+            self.run_config.name
+        )
+        ckpt_cfg = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            storage,
+            num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order,
+        )
+        failures_left = self.run_config.failure_config.max_failures
+        start_ckpt = self._resume
+        history: List[Dict[str, Any]] = []
+        while True:
+            try:
+                metrics = self._run_attempt(manager, start_ckpt, history)
+                return Result(
+                    metrics=metrics,
+                    checkpoint=manager.best,
+                    metrics_history=history,
+                )
+            except TrainWorkerGroupError as e:
+                if failures_left == 0:
+                    return Result(
+                        metrics=history[-1] if history else {},
+                        checkpoint=manager.best,
+                        error=e,
+                        metrics_history=history,
+                    )
+                failures_left -= 1
+                start_ckpt = manager.latest or start_ckpt
+
+    def _shard_datasets(self, world_size: int) -> List[Dict[str, Any]]:
+        """Per-worker dataset shards; ray_tpu.data Datasets use
+        streaming_split, other values pass through whole."""
+        shards: List[Dict[str, Any]] = [dict() for _ in range(world_size)]
+        for name, ds in self._datasets.items():
+            split = None
+            if hasattr(ds, "streaming_split"):
+                split = ds.streaming_split(world_size)
+            for rank in range(world_size):
+                shards[rank][name] = split[rank] if split else ds
+        return shards
+
+    def _run_attempt(
+        self,
+        manager: CheckpointManager,
+        start_ckpt: Optional[Checkpoint],
+        history: List[Dict[str, Any]],
+    ) -> Dict[str, Any]:
+        import ray_tpu
+        from ..core.runtime_context import current_runtime
+
+        sc = self.scaling_config
+        world = sc.num_workers
+        run_id = uuid.uuid4().hex[:12]
+        rt = current_runtime()
+
+        fn_blob = cloudpickle.dumps(self._fn)
+        storage = manager.storage_dir
+        shards = self._shard_datasets(world)
+
+        res = sc.worker_resources()
+        worker_cls = ray_tpu.remote(
+            num_cpus=res.get("CPU", 0),
+            resources={k: v for k, v in res.items() if k != "CPU"},
+        )(_RemoteTrainWorker)
+
+        coordinator = None
+        if world > 1 and sc.use_tpu:
+            # Rank 0's host:port; workers resolve it before jax.distributed.
+            import socket
+
+            host = socket.gethostbyname(socket.gethostname())
+            coordinator = f"{host}:{29400 + (hash(run_id) % 1000)}"
+
+        actors = [worker_cls.remote() for _ in range(world)]
+        refs = [
+            a.run.remote(
+                fn_blob,
+                self._config,
+                run_id,
+                rank,
+                world,
+                storage,
+                start_ckpt.path if start_ckpt else None,
+                shards[rank],
+                coordinator,
+                sc.use_tpu,
+            )
+            for rank, a in enumerate(actors)
+        ]
+
+        next_seq = [0] * world
+        last_metrics: Dict[str, Any] = {}
+        error: Optional[BaseException] = None
+        try:
+            pending = list(refs)
+            while pending:
+                _, pending = ray_tpu.wait(
+                    pending, num_returns=len(pending), timeout=0.25
+                )
+                last_metrics, error = self._drain_reports(
+                    rt, run_id, world, next_seq, manager, history, last_metrics
+                )
+                if error:
+                    raise TrainWorkerGroupError(str(error)) from error
+            # Final drain + surface worker exceptions.
+            for ref in refs:
+                ray_tpu.get(ref)
+            last_metrics, _ = self._drain_reports(
+                rt, run_id, world, next_seq, manager, history, last_metrics
+            )
+            return last_metrics
+        except TrainWorkerGroupError:
+            raise
+        except Exception as e:
+            raise TrainWorkerGroupError(f"train worker failed: {e}") from e
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
+    def _drain_reports(self, rt, run_id, world, next_seq, manager, history,
+                       last_metrics):
+        for rank in range(world):
+            while True:
+                key = f"__train__/{run_id}/{rank}/{next_seq[rank]}"
+                blob = rt.kv_get(key)
+                if blob is None:
+                    break
+                next_seq[rank] += 1
+                payload = cloudpickle.loads(blob)
+                if rank == 0:
+                    metrics = payload["metrics"]
+                    history.append(metrics)
+                    last_metrics = metrics
+                    if payload.get("checkpoint_path"):
+                        ckpt = Checkpoint(payload["checkpoint_path"])
+                        manager.register(
+                            ckpt, metrics, metrics.get("step", len(history))
+                        )
+        return last_metrics, None
+
+
+class _RemoteTrainWorker:
+    """Actor wrapper so the worker body runs in a dedicated process."""
+
+    def run(self, *args):
+        return _train_worker_entry(*args)

@@ -1,0 +1,180 @@
+"""JaxTrainer tests: session plumbing, checkpointing, failure restart, and
+the PR1 e2e config (ResNet-18 on synthetic CIFAR, 1 CPU worker)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train as rt_train
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+def test_trainer_basic_report(ray_tpu_start, tmp_path):
+    def loop(config):
+        for step in range(3):
+            rt_train.report({"step": step, "loss": 1.0 / (step + 1)})
+
+    result = JaxTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path / "run1")),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_trainer_two_workers_ranks(ray_tpu_start, tmp_path):
+    def loop():
+        rank = rt_train.get_world_rank()
+        world = rt_train.get_world_size()
+        rt_train.report({"rank": rank, "world": world})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path / "run2")),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["rank"] == 0
+    assert result.metrics["world"] == 2
+
+
+def test_trainer_checkpoint_roundtrip(ray_tpu_start, tmp_path):
+    def loop(config):
+        import jax.numpy as jnp
+
+        sess = rt_train.get_session()
+        params = {"w": jnp.asarray([1.0, 2.0, 3.0]), "step": jnp.asarray(7)}
+        ckpt = Checkpoint.from_pytree(params, sess.checkpoint_dir(0))
+        rt_train.report({"step": 0, "loss": 0.5}, checkpoint=ckpt)
+
+    result = JaxTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path / "run3")),
+    ).fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    restored = result.checkpoint.as_pytree()
+    np.testing.assert_allclose(np.asarray(restored["w"]), [1.0, 2.0, 3.0])
+
+
+def test_trainer_failure_restart_from_checkpoint(ray_tpu_start, tmp_path):
+    marker = str(tmp_path / "crashed_once")
+
+    def loop(config):
+        import jax.numpy as jnp
+
+        sess = rt_train.get_session()
+        start = sess.get_checkpoint()
+        start_step = int(start.as_pytree()["step"]) + 1 if start else 0
+        for step in range(start_step, 4):
+            if step == 2 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                os._exit(1)  # hard crash mid-training
+            ckpt = Checkpoint.from_pytree(
+                {"step": jnp.asarray(step)}, sess.checkpoint_dir(step)
+            )
+            rt_train.report({"step": step}, checkpoint=ckpt)
+
+    result = JaxTrainer(
+        loop,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path=str(tmp_path / "run4"),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    ).fit()
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 3
+    assert os.path.exists(marker)
+
+
+def test_trainer_error_surfaces(ray_tpu_start, tmp_path):
+    def loop():
+        raise ValueError("train loop exploded")
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path / "run5")),
+    ).fit()
+    assert result.error is not None
+    assert "exploded" in str(result.error)
+
+
+def test_resnet_cifar_e2e(ray_tpu_start, tmp_path):
+    """The PR1 reference config: ResNet-18, synthetic CIFAR-10, 1 CPU worker
+    (BASELINE.json configs[0]) — loss must decrease."""
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models import resnet18
+        from ray_tpu.train.checkpoint import Checkpoint as Ckpt
+
+        model = resnet18(num_classes=10, dtype=jnp.float32)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (32, 32, 32, 3))
+        y = jax.random.randint(jax.random.PRNGKey(1), (32,), 0, 10)
+        variables = model.init(rng, x, train=True)
+        params, batch_stats = variables["params"], variables["batch_stats"]
+        tx = optax.adam(1e-2)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, batch_stats, opt_state):
+            def loss_fn(p):
+                logits, updates = model.apply(
+                    {"params": p, "batch_stats": batch_stats},
+                    x, train=True, mutable=["batch_stats"],
+                )
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y
+                ).mean()
+                return loss, updates["batch_stats"]
+
+            (loss, new_bs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), new_bs, opt_state, loss
+
+        sess = rt_train.get_session()
+        first = last = None
+        for i in range(8):
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state
+            )
+            loss = float(loss)
+            first = first if first is not None else loss
+            last = loss
+            rt_train.report({"step": i, "loss": loss})
+        ckpt = Ckpt.from_pytree({"params": params}, sess.checkpoint_dir(8))
+        rt_train.report({"step": 8, "loss": last, "first_loss": first},
+                        checkpoint=ckpt)
+
+    result = JaxTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path / "resnet")),
+    ).fit()
+    assert result.error is None, result.error
+    assert result.metrics["loss"] < result.metrics["first_loss"]
+    assert result.checkpoint is not None
