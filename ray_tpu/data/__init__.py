@@ -1,0 +1,20 @@
+"""ray_tpu.data: distributed datasets (Ray Data equivalent, TPU-native
+ingest: streaming block execution + HBM prefetch via iter_jax_batches)."""
+
+from .block import Block, BlockAccessor  # noqa: F401
+from .context import DataContext  # noqa: F401
+from .dataset import Dataset  # noqa: F401
+from .iterator import DataIterator  # noqa: F401
+from .read_api import (  # noqa: F401
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_images,
+    read_json,
+    read_numpy,
+    read_parquet,
+)

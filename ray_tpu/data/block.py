@@ -1,0 +1,150 @@
+"""Blocks: the unit of distributed data.
+
+Ref analogue: python/ray/data/block.py — Block (Arrow table) +
+BlockAccessor (:192) + BlockMetadata. Canonical block format is a
+pyarrow.Table; accessors convert to/from numpy-dict and row-dict views.
+Tensor columns (ndim > 1) are stored as FixedSizeList columns and restored
+to numpy with shape metadata.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+_SHAPE_META = b"ray_tpu:shape"
+
+
+def from_numpy_dict(data: Dict[str, np.ndarray]) -> Block:
+    """Build a block from named numpy arrays (tensor columns allowed)."""
+    arrays, fields = [], []
+    n = None
+    for name, arr in data.items():
+        arr = np.asarray(arr)
+        n = len(arr) if n is None else n
+        if len(arr) != n:
+            raise ValueError("column length mismatch")
+        if arr.ndim == 1:
+            pa_arr = pa.array(arr)
+            field = pa.field(name, pa_arr.type)
+        else:
+            inner = int(np.prod(arr.shape[1:]))
+            flat = np.ascontiguousarray(arr).reshape(n * inner)
+            values = pa.array(flat)
+            pa_arr = pa.FixedSizeListArray.from_arrays(values, inner)
+            field = pa.field(
+                name, pa_arr.type,
+                metadata={_SHAPE_META: repr(arr.shape[1:]).encode()},
+            )
+        arrays.append(pa_arr)
+        fields.append(field)
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def from_rows(rows: List[Dict[str, Any]]) -> Block:
+    if not rows:
+        return pa.table({})
+    cols: Dict[str, list] = {k: [] for k in rows[0]}
+    for r in rows:
+        for k in cols:
+            cols[k].append(r.get(k))
+    np_cols = {}
+    for k, v in cols.items():
+        arr = np.asarray(v)
+        np_cols[k] = arr
+    return from_numpy_dict(np_cols)
+
+
+class BlockAccessor:
+    """Read-side view over a block (ref: data/block.py BlockAccessor)."""
+
+    def __init__(self, block: Block):
+        self.block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        return self.block.num_rows
+
+    def size_bytes(self) -> int:
+        return self.block.nbytes
+
+    def schema(self):
+        return self.block.schema
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for i, name in enumerate(self.block.schema.names):
+            col = self.block.column(i)
+            field = self.block.schema.field(i)
+            meta = field.metadata or {}
+            if _SHAPE_META in meta:
+                shape = eval(meta[_SHAPE_META].decode())  # noqa: S307 (own metadata)
+                flat = col.combine_chunks().flatten()
+                arr = flat.to_numpy(zero_copy_only=False).reshape(
+                    (self.block.num_rows,) + tuple(shape)
+                )
+            else:
+                arr = col.to_numpy(zero_copy_only=False)
+            out[name] = arr
+        return out
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        cols = self.to_numpy()
+        names = list(cols)
+        for i in range(self.num_rows()):
+            yield {k: cols[k][i] for k in names}
+
+    def slice(self, start: int, end: int) -> Block:
+        return self.block.slice(start, end - start)
+
+    def take_indices(self, idx: np.ndarray) -> Block:
+        return self.block.take(pa.array(idx))
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+    if len(blocks) == 1:
+        return blocks[0]
+    # unify_schemas fails on metadata mismatch; use first schema.
+    return pa.concat_tables(
+        [b.cast(blocks[0].schema) for b in blocks]
+    ).combine_chunks()
+
+
+def normalize_to_block(data: Any) -> Block:
+    """Accept a block in any supported user format."""
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, dict):
+        return from_numpy_dict(data)
+    if isinstance(data, np.ndarray):
+        return from_numpy_dict({"data": data})
+    if isinstance(data, list):
+        return from_rows(
+            [r if isinstance(r, dict) else {"item": r} for r in data]
+        )
+    try:
+        import pandas as pd
+
+        if isinstance(data, pd.DataFrame):
+            return pa.Table.from_pandas(data, preserve_index=False)
+    except ImportError:
+        pass
+    raise TypeError(f"cannot convert {type(data)} to a Block")
+
+
+def batch_to_format(block: Block, batch_format: str):
+    acc = BlockAccessor(block)
+    if batch_format in ("numpy", "default"):
+        return acc.to_numpy()
+    if batch_format == "pandas":
+        return block.to_pandas()
+    if batch_format in ("pyarrow", "arrow"):
+        return block
+    raise ValueError(f"unknown batch_format {batch_format!r}")

@@ -1,0 +1,26 @@
+"""DataContext: execution knobs (ref: python/ray/data/context.py
+DataContext singleton)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    # Streaming backpressure: max concurrently in-flight block tasks per
+    # operator chain (ref analogue: backpressure policies in
+    # _internal/execution/backpressure_policy/).
+    max_in_flight_tasks: int = 8
+    # Prefetch depth for iter_batches / device feed.
+    prefetch_batches: int = 2
+    use_remote_tasks: bool = True
+
+    _instance = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance

@@ -1,0 +1,354 @@
+"""Dataset: lazy, streaming, distributed data over blocks.
+
+Ref analogue: python/ray/data/dataset.py Dataset (:158) with the logical
+plan + streaming execution model of _internal/execution/ (SURVEY.md §2.3):
+transforms build a lazy per-block operator chain; execution fuses the whole
+chain into ONE task per block (the same effect as the reference's
+MapOperator fusion) and streams block futures with a bounded in-flight
+window (backpressure). Global ops (shuffle/sort/repartition/groupby) insert
+materialization barriers.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .block import (
+    Block,
+    BlockAccessor,
+    batch_to_format,
+    concat_blocks,
+    from_numpy_dict,
+    normalize_to_block,
+)
+from .context import DataContext
+
+
+# ----------------------------------------------------------- logical plan
+
+class _Op:
+    """A per-block transform (fusable)."""
+
+    def apply(self, block: Block) -> Block:
+        raise NotImplementedError
+
+
+class _MapBatches(_Op):
+    def __init__(self, fn, batch_format: str, batch_size: Optional[int]):
+        self.fn = fn
+        self.batch_format = batch_format
+        self.batch_size = batch_size
+
+    def apply(self, block: Block) -> Block:
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        bs = self.batch_size or max(n, 1)
+        out = []
+        for start in range(0, max(n, 1), bs):
+            sub = acc.slice(start, min(start + bs, n)) if n else block
+            batch = batch_to_format(sub, self.batch_format)
+            res = self.fn(batch)
+            out.append(normalize_to_block(res))
+            if n == 0:
+                break
+        return concat_blocks(out) if out else block
+
+
+class _MapRows(_Op):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, block: Block) -> Block:
+        from .block import from_rows
+
+        rows = [self.fn(dict(r)) for r in BlockAccessor(block).iter_rows()]
+        return from_rows(rows)
+
+
+class _FlatMapRows(_Op):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, block: Block) -> Block:
+        from .block import from_rows
+
+        rows = []
+        for r in BlockAccessor(block).iter_rows():
+            rows.extend(self.fn(dict(r)))
+        return from_rows(rows)
+
+
+class _FilterRows(_Op):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, block: Block) -> Block:
+        acc = BlockAccessor(block)
+        keep = np.asarray(
+            [bool(self.fn(dict(r))) for r in acc.iter_rows()], dtype=bool
+        )
+        return acc.take_indices(np.nonzero(keep)[0])
+
+
+def _apply_chain(source: Callable[[], Block], ops: Sequence[_Op]) -> Block:
+    block = source()
+    for op in ops:
+        block = op.apply(block)
+    return block
+
+
+# -------------------------------------------------------------- the API
+
+class Dataset:
+    def __init__(self, sources: List[Callable[[], Block]],
+                 ops: Optional[List[_Op]] = None):
+        # sources: zero-arg callables producing the input blocks (read tasks
+        # or in-memory closures); ops: fused per-block transform chain.
+        self._sources = sources
+        self._ops = ops or []
+
+    # ---- construction helpers (used by read_api) ----
+
+    @classmethod
+    def from_blocks(cls, blocks: List[Block]) -> "Dataset":
+        return cls([(lambda b=b: b) for b in blocks])
+
+    # ---- lazy transforms (per-block: fused) ----
+
+    def _with_op(self, op: _Op) -> "Dataset":
+        return Dataset(self._sources, self._ops + [op])
+
+    def map_batches(self, fn, *, batch_format: str = "numpy",
+                    batch_size: Optional[int] = None) -> "Dataset":
+        return self._with_op(_MapBatches(fn, batch_format, batch_size))
+
+    def map(self, fn) -> "Dataset":
+        return self._with_op(_MapRows(fn))
+
+    def flat_map(self, fn) -> "Dataset":
+        return self._with_op(_FlatMapRows(fn))
+
+    def filter(self, fn) -> "Dataset":
+        return self._with_op(_FilterRows(fn))
+
+    def add_column(self, name: str, fn) -> "Dataset":
+        def add(batch: Dict[str, np.ndarray]):
+            batch[name] = np.asarray(fn(batch))
+            return batch
+
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {k: v for k, v in b.items() if k not in cols}
+        )
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {k: v for k, v in b.items() if k in cols}
+        )
+
+    # ---- global ops (materialization barriers) ----
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        full = self._materialize_table()
+        n = full.num_rows
+        sizes = [n // num_blocks + (1 if i < n % num_blocks else 0)
+                 for i in range(num_blocks)]
+        blocks, start = [], 0
+        for s in sizes:
+            blocks.append(full.slice(start, s))
+            start += s
+        return Dataset.from_blocks(blocks)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        full = self._materialize_table()
+        idx = np.random.RandomState(seed).permutation(full.num_rows)
+        shuffled = BlockAccessor(full).take_indices(idx)
+        num = max(1, len(self._sources))
+        return Dataset.from_blocks([shuffled]).repartition(num)
+
+    def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        full = self._materialize_table()
+        col = BlockAccessor(full).to_numpy()[key]
+        idx = np.argsort(col, kind="stable")
+        if descending:
+            idx = idx[::-1]
+        return Dataset.from_blocks([BlockAccessor(full).take_indices(idx)])
+
+    def union(self, other: "Dataset") -> "Dataset":
+        a = self.materialize()
+        b = other.materialize()
+        return Dataset(a._sources + b._sources)
+
+    def limit(self, n: int) -> "Dataset":
+        out, taken = [], 0
+        for block in self._iter_blocks():
+            if taken >= n:
+                break
+            take = min(n - taken, block.num_rows)
+            out.append(block.slice(0, take))
+            taken += take
+        return Dataset.from_blocks(out or [from_numpy_dict({})])
+
+    def groupby(self, key: str):
+        from .grouped_data import GroupedData
+
+        return GroupedData(self, key)
+
+    # ---- execution ----
+
+    def _iter_blocks(self) -> Iterator[Block]:
+        """Streaming execution: bounded window of fused block tasks
+        (ref analogue: StreamingExecutor._scheduling_loop_step +
+        backpressure, streaming_executor.py:242)."""
+        ctx = DataContext.get_current()
+        from ..core import runtime_context
+
+        use_remote = (
+            ctx.use_remote_tasks and runtime_context.is_initialized()
+        )
+        if not use_remote:
+            for src in self._sources:
+                yield _apply_chain(src, self._ops)
+            return
+
+        import ray_tpu
+
+        chain = ray_tpu.remote(_apply_chain)
+        window: List[Any] = []
+        sources = iter(self._sources)
+        exhausted = False
+        while window or not exhausted:
+            while not exhausted and len(window) < ctx.max_in_flight_tasks:
+                src = next(sources, None)
+                if src is None:
+                    exhausted = True
+                    break
+                window.append(chain.remote(src, self._ops))
+            if window:
+                yield ray_tpu.get(window.pop(0))
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        leftover: Optional[Block] = None
+        for block in self._iter_blocks():
+            if leftover is not None and leftover.num_rows:
+                block = concat_blocks([leftover, block])
+                leftover = None
+            if batch_size is None:
+                yield batch_to_format(block, batch_format)
+                continue
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            start = 0
+            while n - start >= batch_size:
+                yield batch_to_format(
+                    acc.slice(start, start + batch_size), batch_format
+                )
+                start += batch_size
+            if start < n:
+                leftover = acc.slice(start, n)
+        if leftover is not None and leftover.num_rows and not drop_last:
+            yield batch_to_format(leftover, batch_format)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self._iter_blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    def iter_jax_batches(self, *, batch_size: int = 256, device=None,
+                         drop_last: bool = True) -> Iterator[Any]:
+        """Batches as jax arrays with one-batch device prefetch (the HBM
+        double-buffering path — SURVEY.md §7 phase 8)."""
+        import jax
+
+        def put(batch):
+            return {
+                k: (jax.device_put(v, device) if device else jnp_asarray(v))
+                for k, v in batch.items()
+            }
+
+        import jax.numpy as jnp
+
+        def jnp_asarray(v):
+            return jnp.asarray(v)
+
+        it = self.iter_batches(batch_size=batch_size, drop_last=drop_last)
+        prev = None
+        for batch in it:
+            nxt = put(batch)  # enqueue transfer before yielding previous
+            if prev is not None:
+                yield prev
+            prev = nxt
+        if prev is not None:
+            yield prev
+
+    # ---- consumption ----
+
+    def _materialize_table(self) -> Block:
+        return concat_blocks(list(self._iter_blocks()))
+
+    def materialize(self) -> "Dataset":
+        return Dataset.from_blocks(list(self._iter_blocks()))
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        return list(itertools.islice(self.iter_rows(), n))
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(b.num_rows for b in self._iter_blocks())
+
+    def schema(self):
+        for block in self._iter_blocks():
+            return block.schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s else []
+
+    def num_blocks(self) -> int:
+        return len(self._sources)
+
+    def show(self, n: int = 20):
+        for row in self.take(n):
+            print(row)
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        return BlockAccessor(self._materialize_table()).to_numpy()
+
+    def to_pandas(self):
+        return self._materialize_table().to_pandas()
+
+    def stats(self) -> str:
+        return (f"Dataset(blocks={len(self._sources)}, "
+                f"ops={len(self._ops)})")
+
+    # ---- splitting for train ingest ----
+
+    def streaming_split(self, n: int, *, equal: bool = True
+                        ) -> List["DataIterator"]:
+        """Per-worker shard iterators (ref: dataset.py:1269
+        streaming_split). Shard i consumes source blocks i, i+n, ..."""
+        from .iterator import DataIterator
+
+        return [DataIterator(self, shard_index=i, num_shards=n)
+                for i in range(n)]
+
+    def split(self, n: int) -> List["Dataset"]:
+        return [
+            Dataset(self._sources[i::n], list(self._ops)) for i in range(n)
+        ]
+
+    def __repr__(self):
+        return self.stats()

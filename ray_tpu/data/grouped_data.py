@@ -1,0 +1,70 @@
+"""GroupedData aggregates (ref analogue: python/ray/data/grouped_data.py +
+data/aggregate/_aggregate.py — count/sum/min/max/mean/std + map_groups)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from .block import BlockAccessor, from_rows
+
+
+class GroupedData:
+    def __init__(self, dataset, key: str):
+        self._dataset = dataset
+        self._key = key
+
+    def _groups(self) -> Dict:
+        table = self._dataset._materialize_table()
+        cols = BlockAccessor(table).to_numpy()
+        keys = cols[self._key]
+        order = np.argsort(keys, kind="stable")
+        groups: Dict = {}
+        for i in order:
+            groups.setdefault(keys[i].item() if hasattr(keys[i], "item")
+                              else keys[i], []).append(int(i))
+        return {k: (cols, idx) for k, (idx) in
+                ((k, v) for k, v in groups.items())}
+
+    def _agg(self, on: str, fn: Callable, name: str):
+        rows: List[Dict] = []
+        for k, (cols, idx) in self._groups().items():
+            rows.append({self._key: k, f"{name}({on})": fn(cols[on][idx])})
+        from .dataset import Dataset
+
+        return Dataset.from_blocks([from_rows(rows)])
+
+    def count(self):
+        rows = [
+            {self._key: k, "count()": len(idx)}
+            for k, (cols, idx) in self._groups().items()
+        ]
+        from .dataset import Dataset
+
+        return Dataset.from_blocks([from_rows(rows)])
+
+    def sum(self, on: str):
+        return self._agg(on, np.sum, "sum")
+
+    def min(self, on: str):
+        return self._agg(on, np.min, "min")
+
+    def max(self, on: str):
+        return self._agg(on, np.max, "max")
+
+    def mean(self, on: str):
+        return self._agg(on, np.mean, "mean")
+
+    def std(self, on: str):
+        return self._agg(on, np.std, "std")
+
+    def map_groups(self, fn: Callable):
+        from .dataset import Dataset
+        from .block import concat_blocks, normalize_to_block
+
+        out = []
+        for k, (cols, idx) in self._groups().items():
+            group = {c: v[idx] for c, v in cols.items()}
+            out.append(normalize_to_block(fn(group)))
+        return Dataset.from_blocks([concat_blocks(out)])

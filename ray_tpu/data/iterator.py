@@ -1,0 +1,45 @@
+"""DataIterator: a per-worker shard view of a Dataset.
+
+Ref analogue: python/ray/data/iterator.py DataIterator
+(iter_batches:98, iter_torch_batches:242 → here iter_jax_batches). Picklable
+(carries the lazy plan) so trainers ship it to workers; blocks execute
+where the iterator is consumed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+
+class DataIterator:
+    def __init__(self, dataset, shard_index: int, num_shards: int):
+        self._dataset = dataset
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+
+    def _shard(self):
+        from .dataset import Dataset
+
+        ds = self._dataset
+        return Dataset(
+            ds._sources[self.shard_index :: self.num_shards], list(ds._ops)
+        )
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        return self._shard().iter_batches(**kw)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        return self._shard().iter_rows()
+
+    def iter_jax_batches(self, **kw) -> Iterator[Any]:
+        return self._shard().iter_jax_batches(**kw)
+
+    def count(self) -> int:
+        return self._shard().count()
+
+    def materialize(self):
+        return self._shard().materialize()
+
+    def __repr__(self):
+        return (f"DataIterator(shard={self.shard_index}/"
+                f"{self.num_shards})")

@@ -1,0 +1,191 @@
+"""Data sources.
+
+Ref analogue: python/ray/data/read_api.py (read_parquet:552, read_csv,
+read_json, read_images, read_binary_files, from_items, range, from_numpy,
+from_pandas, from_arrow). Each file becomes one read task (a source thunk);
+reads execute lazily inside the fused block task.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import builtins
+
+from .block import from_numpy_dict, from_rows, normalize_to_block
+from .dataset import Dataset
+
+# This module defines its own `range` (the Dataset source, matching the
+# reference API name) — internal loops use the builtin via this alias.
+_range = builtins.range
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(
+                sorted(
+                    os.path.join(p, f)
+                    for f in os.listdir(p)
+                    if not f.startswith(".")
+                )
+            )
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def from_items(items: List[Any], *, override_num_blocks: int = 8) -> Dataset:
+    n = min(override_num_blocks, max(1, len(items)))
+    chunks = [items[i::n] for i in _range(n)]
+    return Dataset(
+        [
+            (lambda c=c: from_rows(
+                [r if isinstance(r, dict) else {"item": r} for r in c]
+            ))
+            for c in chunks if c
+        ]
+    )
+
+
+def range(n: int, *, override_num_blocks: int = 8) -> Dataset:  # noqa: A001
+    nb = min(override_num_blocks, max(1, n))
+    bounds = np.linspace(0, n, nb + 1, dtype=np.int64)
+    return Dataset(
+        [
+            (lambda lo=lo, hi=hi: from_numpy_dict(
+                {"id": np.arange(lo, hi, dtype=np.int64)}
+            ))
+            for lo, hi in zip(bounds[:-1], bounds[1:])
+            if hi > lo
+        ]
+    )
+
+
+def from_numpy(arr: np.ndarray, *, column: str = "data",
+               override_num_blocks: int = 8) -> Dataset:
+    nb = min(override_num_blocks, max(1, len(arr)))
+    chunks = np.array_split(arr, nb)
+    return Dataset(
+        [(lambda c=c: from_numpy_dict({column: c})) for c in chunks
+         if len(c)]
+    )
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset([lambda: pa.Table.from_pandas(df, preserve_index=False)])
+
+
+def from_arrow(table: pa.Table) -> Dataset:
+    return Dataset([lambda: table])
+
+
+def read_parquet(paths, **kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            import pyarrow.parquet as pq
+
+            return pq.read_table(path)
+
+        return read
+
+    return Dataset([make(p) for p in files])
+
+
+def read_csv(paths, **kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            from pyarrow import csv as pacsv
+
+            return pacsv.read_csv(path)
+
+        return read
+
+    return Dataset([make(p) for p in files])
+
+
+def read_json(paths, **kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            from pyarrow import json as pajson
+
+            return pajson.read_json(path)
+
+        return read
+
+    return Dataset([make(p) for p in files])
+
+
+def read_numpy(paths, **kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            arr = np.load(path)
+            return from_numpy_dict({"data": arr})
+
+        return read
+
+    return Dataset([make(p) for p in files])
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            with open(path, "rb") as f:
+                data = f.read()
+            row: Dict[str, Any] = {"bytes": data}
+            if include_paths:
+                row["path"] = path
+            return from_rows([row])
+
+        return read
+
+    return Dataset([make(p) for p in files])
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                include_paths: bool = False) -> Dataset:
+    """Decode images into an 'image' tensor column (uint8 HWC). Uses PIL if
+    available; raw decode of .npy otherwise."""
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            try:
+                from PIL import Image
+
+                img = Image.open(path).convert("RGB")
+                if size is not None:
+                    img = img.resize(size)
+                arr = np.asarray(img, dtype=np.uint8)
+            except ImportError:
+                arr = np.load(path)
+            cols: Dict[str, Any] = {"image": arr[None]}
+            if include_paths:
+                cols["path"] = np.asarray([path])
+            return from_numpy_dict(cols)
+
+        return read
+
+    return Dataset([make(p) for p in files])

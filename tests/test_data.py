@@ -1,0 +1,156 @@
+"""ray_tpu.data tests (ref analogue: python/ray/data/tests/)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+
+
+def test_range_count_take():
+    ds = rd.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_from_items():
+    ds = rd.from_items([{"a": i, "b": i * 2} for i in range(10)])
+    assert ds.count() == 10
+    assert sorted(r["a"] for r in ds.take_all()) == list(range(10))
+
+
+def test_map_batches_numpy():
+    ds = rd.range(32).map_batches(lambda b: {"x": b["id"] * 2})
+    out = ds.to_numpy()
+    np.testing.assert_array_equal(np.sort(out["x"]),
+                                  np.arange(32, dtype=np.int64) * 2)
+
+
+def test_map_and_filter_rows():
+    ds = rd.range(20).map(lambda r: {"v": int(r["id"]) + 1})
+    ds = ds.filter(lambda r: r["v"] % 2 == 0)
+    vals = sorted(r["v"] for r in ds.take_all())
+    assert vals == [2, 4, 6, 8, 10, 12, 14, 16, 18, 20]
+
+
+def test_flat_map():
+    ds = rd.from_items([{"x": 1}, {"x": 2}]).flat_map(
+        lambda r: [{"y": r["x"]}, {"y": r["x"] * 10}]
+    )
+    assert sorted(r["y"] for r in ds.take_all()) == [1, 2, 10, 20]
+
+
+def test_iter_batches_sizes():
+    ds = rd.range(100, override_num_blocks=7)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
+    assert sum(sizes) == 100
+    assert all(s == 32 for s in sizes[:-1])
+
+
+def test_tensor_columns_roundtrip():
+    imgs = np.random.RandomState(0).randint(0, 255, (10, 8, 8, 3),
+                                            dtype=np.uint8)
+    ds = rd.from_numpy(imgs, column="image")
+    out = ds.to_numpy()["image"]
+    np.testing.assert_array_equal(np.sort(out.ravel()),
+                                  np.sort(imgs.ravel()))
+    assert out.shape == (10, 8, 8, 3)
+
+
+def test_sort_and_limit():
+    ds = rd.from_items([{"k": i % 5, "v": i} for i in range(20)])
+    s = ds.sort("v", descending=True)
+    assert [r["v"] for r in s.take(3)] == [19, 18, 17]
+    assert ds.limit(7).count() == 7
+
+
+def test_random_shuffle_preserves_rows():
+    ds = rd.range(50).random_shuffle(seed=42)
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(50))
+
+
+def test_repartition():
+    ds = rd.range(30).repartition(3)
+    assert ds.num_blocks() == 3
+    assert ds.count() == 30
+
+
+def test_groupby_aggregates():
+    ds = rd.from_items([{"k": i % 3, "v": float(i)} for i in range(12)])
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 4, 1: 4, 2: 4}
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums[0] == 0 + 3 + 6 + 9
+
+
+def test_streaming_split_shards():
+    ds = rd.range(40, override_num_blocks=8)
+    shards = ds.streaming_split(4)
+    total = sum(s.count() for s in shards)
+    assert total == 40
+    assert all(s.count() == 10 for s in shards)
+
+
+def test_csv_parquet_roundtrip(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = pa.table({"a": list(range(20)), "b": [i * 1.5 for i in range(20)]})
+    pq.write_table(table, str(tmp_path / "f1.parquet"))
+    pq.write_table(table, str(tmp_path / "f2.parquet"))
+    ds = rd.read_parquet(str(tmp_path) + "/*.parquet")
+    assert ds.count() == 40
+    assert ds.num_blocks() == 2
+
+    import csv
+
+    with open(tmp_path / "data.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["x", "y"])
+        for i in range(10):
+            w.writerow([i, i * i])
+    ds2 = rd.read_csv(str(tmp_path / "data.csv"))
+    assert ds2.count() == 10
+    assert ds2.take(3)[2]["y"] == 4
+
+
+def test_distributed_execution(ray_tpu_start):
+    """Blocks execute as remote tasks when the runtime is up."""
+    ds = rd.range(64, override_num_blocks=8).map_batches(
+        lambda b: {"sq": b["id"] ** 2}
+    )
+    out = np.sort(ds.to_numpy()["sq"])
+    np.testing.assert_array_equal(out, (np.arange(64) ** 2))
+
+
+def test_iter_jax_batches():
+    pytest.importorskip("jax")
+    ds = rd.range(32).map_batches(lambda b: {"x": b["id"].astype(np.float32)})
+    batches = list(ds.iter_jax_batches(batch_size=16))
+    assert len(batches) == 2
+    import jax
+
+    assert isinstance(batches[0]["x"], jax.Array)
+
+
+def test_trainer_dataset_integration(ray_tpu_start, tmp_path):
+    """Dataset shards flow into train workers via get_dataset_shard."""
+    import ray_tpu
+    from ray_tpu import train as rt_train
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    ds = rd.range(40, override_num_blocks=8)
+
+    def loop():
+        shard = rt_train.get_dataset_shard("train")
+        n = sum(len(b["id"]) for b in shard.iter_batches(batch_size=10))
+        rt_train.report({"rows": n, "rank": rt_train.get_world_rank()})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path / "di")),
+        datasets={"train": ds},
+    ).fit()
+    assert result.error is None, result.error
+    assert result.metrics["rows"] == 20
