@@ -1,0 +1,26 @@
+"""ray_tpu.tune: hyperparameter tuning (Ray Tune equivalent).
+
+Public surface mirrors ray.tune (SURVEY.md §2.3): Tuner/TuneConfig/
+ResultGrid, search-space DSL (uniform/loguniform/randint/choice/
+grid_search/sample_from), schedulers (ASHA, median stopping, FIFO).
+``report`` is shared with ray_tpu.train, like the reference's unified
+session."""
+
+from ..train.session import report  # noqa: F401  (tune.report == train.report)
+from .schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    TrialScheduler,
+)
+from .search_space import (  # noqa: F401
+    choice,
+    generate_variants,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .tuner import ResultGrid, TrialResult, TuneConfig, Tuner  # noqa: F401

@@ -1,0 +1,108 @@
+"""Trial schedulers (ref analogue: python/ray/tune/schedulers/ —
+FIFOScheduler, AsyncHyperBandScheduler/ASHA, MedianStoppingRule,
+HyperBandScheduler; SURVEY.md §2.3 Tune row)."""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict]):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous Successive Halving (ref:
+    tune/schedulers/async_hyperband.py). A trial reaching a rung must be in
+    the top 1/reduction_factor of results seen at that rung to continue."""
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 4,
+    ):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung thresholds: grace * rf^k up to max_t
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(int(t))
+            t *= reduction_factor
+        self._rung_results: Dict[int, List[float]] = defaultdict(list)
+        self._trial_rung: Dict[str, int] = {}
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        val = result.get(self.metric)
+        if t is None or val is None:
+            return CONTINUE
+        val = float(val) if self.mode == "max" else -float(val)
+        next_rung_idx = self._trial_rung.get(trial_id, 0)
+        if next_rung_idx >= len(self.rungs):
+            return CONTINUE if t < self.max_t else STOP
+        rung = self.rungs[next_rung_idx]
+        if t < rung:
+            return CONTINUE
+        results = self._rung_results[rung]
+        results.append(val)
+        self._trial_rung[trial_id] = next_rung_idx + 1
+        k = max(1, int(math.ceil(len(results) / self.rf)))
+        threshold = sorted(results, reverse=True)[k - 1]
+        return CONTINUE if val >= threshold else STOP
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is worse than the median of running
+    averages (ref: tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._histories: Dict[str, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        val = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if val is None:
+            return CONTINUE
+        sign = 1.0 if self.mode == "max" else -1.0
+        self._histories[trial_id].append(sign * float(val))
+        if t < self.grace_period or len(self._histories) < self.min_samples:
+            return CONTINUE
+        means = sorted(
+            sum(h) / len(h) for tid, h in self._histories.items()
+            if tid != trial_id
+        )
+        if not means:
+            return CONTINUE
+        median = means[len(means) // 2]
+        best = max(self._histories[trial_id])
+        return CONTINUE if best >= median else STOP

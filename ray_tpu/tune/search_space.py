@@ -1,0 +1,127 @@
+"""Search space DSL (ref analogue: python/ray/tune/search/sample.py —
+uniform/loguniform/randint/choice/grid_search + sample_from)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+
+class Domain:
+    def sample(self, rng: np.random.RandomState) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return float(rng.uniform(self.low, self.high))
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return float(math.exp(rng.uniform(math.log(self.low),
+                                          math.log(self.high))))
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return int(rng.randint(self.low, self.high))
+
+
+class QUniform(Domain):
+    def __init__(self, low: float, high: float, q: float):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        val = rng.uniform(self.low, self.high)
+        return float(np.round(val / self.q) * self.q)
+
+
+class Choice(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return self.categories[rng.randint(len(self.categories))]
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn({})
+
+
+class GridSearch:
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def quniform(low: float, high: float, q: float) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def choice(categories: List[Any]) -> Choice:
+    return Choice(categories)
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def sample_from(fn: Callable) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def generate_variants(
+    param_space: Dict[str, Any], num_samples: int, seed: int = 0
+) -> List[Dict[str, Any]]:
+    """Expand grid_search cross-products; draw ``num_samples`` of the
+    stochastic domains for each grid point (ref analogue:
+    tune/search/basic_variant.py BasicVariantGenerator)."""
+    rng = np.random.RandomState(seed)
+
+    grid_keys = [k for k, v in param_space.items()
+                 if isinstance(v, GridSearch)]
+    grids: List[Dict[str, Any]] = [{}]
+    for k in grid_keys:
+        grids = [dict(g, **{k: val}) for g in grids
+                 for val in param_space[k].values]
+
+    out = []
+    for g in grids:
+        for _ in range(num_samples):
+            cfg = dict(g)
+            for k, v in param_space.items():
+                if k in g:
+                    continue
+                if isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            out.append(cfg)
+    return out

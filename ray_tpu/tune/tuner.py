@@ -1,0 +1,243 @@
+"""Tuner + trial controller.
+
+Ref analogue: python/ray/tune/tuner.py Tuner (:54, fit:346) over the
+event-driven TuneController (tune/execution/tune_controller.py:72). Trials
+run as actors; reports stream through the control-plane KV (same channel as
+JaxTrainer sessions); schedulers may early-stop trials by killing their
+actor (ref analogue: the STOP decision path in TrialScheduler).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+from ..train.checkpoint import default_storage_path
+from ..train.config import RunConfig
+from ..train.session import TrainSession, set_session
+from .schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from .search_space import generate_variants
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    """Ref: tune/tune_config.py TuneConfig."""
+
+    num_samples: int = 1
+    metric: Optional[str] = None
+    mode: str = "max"
+    scheduler: Optional[TrialScheduler] = None
+    max_concurrent_trials: Optional[int] = None
+    search_seed: int = 0
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    metrics_history: List[Dict[str, Any]]
+    error: Optional[str] = None
+    early_stopped: bool = False
+
+    @property
+    def last_result(self):
+        return self.metrics
+
+
+class ResultGrid:
+    """Ref: tune/result_grid.py ResultGrid."""
+
+    def __init__(self, results: List[TrialResult], metric, mode):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self):
+        return [r for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self._results
+                  if not r.error and metric in r.metrics]
+        if not scored:
+            raise ValueError("no successful trials reported "
+                             f"metric {metric!r}")
+        pick = max if mode == "max" else min
+        return pick(scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = {f"config/{k}": v for k, v in r.config.items()}
+            row.update(r.metrics)
+            row["trial_id"] = r.trial_id
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+def _trial_entry(fn_blob: bytes, config: Dict[str, Any], trial_id: str,
+                 storage_dir: str):
+    fn = cloudpickle.loads(fn_blob)
+    session = TrainSession(
+        run_id=trial_id, world_rank=0, world_size=1,
+        storage_dir=storage_dir, start_checkpoint=None,
+        trial_info={"name": trial_id},
+    )
+    set_session(session)
+    try:
+        fn(config)
+    finally:
+        set_session(None)
+    return "done"
+
+
+class _TrialActor:
+    def run(self, *args):
+        return _trial_entry(*args)
+
+
+@dataclasses.dataclass
+class _Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    state: str = "pending"  # pending | running | done | error | stopped
+    actor: Any = None
+    ref: Any = None
+    next_seq: int = 0
+    history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable[[Dict[str, Any]], None],
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+        from ..core.runtime_context import current_runtime
+
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        storage = self.run_config.storage_path or default_storage_path(
+            self.run_config.name
+        )
+        variants = generate_variants(
+            self._param_space, tc.num_samples, tc.search_seed
+        )
+        trials = [
+            _Trial(trial_id=f"trial_{i:05d}_{uuid.uuid4().hex[:6]}",
+                   config=cfg)
+            for i, cfg in enumerate(variants)
+        ]
+        fn_blob = cloudpickle.dumps(self._trainable)
+        rt = current_runtime()
+        max_conc = tc.max_concurrent_trials or max(
+            1, int(ray_tpu.cluster_resources().get("CPU", 4))
+        )
+        actor_cls = ray_tpu.remote(_TrialActor)
+
+        def launch(trial: _Trial):
+            trial.actor = actor_cls.remote()
+            trial.ref = trial.actor.run.remote(
+                fn_blob, trial.config, trial.trial_id, storage
+            )
+            trial.state = "running"
+
+        def drain(trial: _Trial):
+            while True:
+                key = f"__train__/{trial.trial_id}/0/{trial.next_seq}"
+                blob = rt.kv_get(key)
+                if blob is None:
+                    return
+                trial.next_seq += 1
+                payload = cloudpickle.loads(blob)
+                metrics = dict(payload["metrics"])
+                metrics.setdefault("training_iteration", trial.next_seq)
+                metrics["trial_id"] = trial.trial_id
+                trial.history.append(metrics)
+                if trial.state == "running":
+                    if scheduler.on_result(trial.trial_id, metrics) == STOP:
+                        trial.state = "stopped"
+                        try:
+                            ray_tpu.kill(trial.actor)
+                        except Exception:
+                            pass
+
+        pending = list(trials)
+        running: List[_Trial] = []
+        while pending or running:
+            while pending and len(running) < max_conc:
+                t = pending.pop(0)
+                launch(t)
+                running.append(t)
+            refs = [t.ref for t in running]
+            ray_tpu.wait(refs, num_returns=len(refs), timeout=0.2)
+            still_running = []
+            for t in running:
+                drain(t)
+                if t.state == "stopped":
+                    scheduler.on_trial_complete(
+                        t.trial_id, t.history[-1] if t.history else None
+                    )
+                    continue
+                done, _ = ray_tpu.wait([t.ref], num_returns=1, timeout=0)
+                if done:
+                    drain(t)
+                    try:
+                        ray_tpu.get(t.ref)
+                        t.state = "done"
+                    except Exception as e:
+                        t.state = "error"
+                        t.error = str(e)
+                    scheduler.on_trial_complete(
+                        t.trial_id, t.history[-1] if t.history else None
+                    )
+                    try:
+                        ray_tpu.kill(t.actor)
+                    except Exception:
+                        pass
+                else:
+                    still_running.append(t)
+            running = still_running
+
+        results = [
+            TrialResult(
+                trial_id=t.trial_id,
+                config=t.config,
+                metrics=t.history[-1] if t.history else {},
+                metrics_history=t.history,
+                error=t.error,
+                early_stopped=(t.state == "stopped"),
+            )
+            for t in trials
+        ]
+        return ResultGrid(results, tc.metric, tc.mode)
