@@ -62,7 +62,9 @@ class ASHAScheduler(TrialScheduler):
         val = float(val) if self.mode == "max" else -float(val)
         next_rung_idx = self._trial_rung.get(trial_id, 0)
         if next_rung_idx >= len(self.rungs):
-            return CONTINUE if t < self.max_t else STOP
+            # Past the last rung: the trial survived every halving; running
+            # out its max_t budget is completion, not culling.
+            return CONTINUE
         rung = self.rungs[next_rung_idx]
         if t < rung:
             return CONTINUE
