@@ -434,9 +434,10 @@ class NodeManager:
         for oid in spec.dependency_ids():
             self.directory.add_ref(oid)
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
-            # Placement may wait for resources/workers; never block the
-            # submitter (or the message-dispatch loop) on it.
-            asyncio.ensure_future(self._schedule_actor_creation(record))
+            # Register the actor synchronously (so method calls submitted
+            # right after creation can route/queue), but never block the
+            # submitter on placement.
+            self._register_actor(record)
             return
         if spec.task_type == TaskType.ACTOR_TASK:
             self._route_actor_task(record)
@@ -648,7 +649,7 @@ class NodeManager:
 
     # ------------------------------------------------------------------ actors
 
-    async def _schedule_actor_creation(self, record: TaskRecord):
+    def _register_actor(self, record: TaskRecord):
         spec = record.spec
         info = ActorInfo(
             actor_id=spec.actor_id,
@@ -656,7 +657,6 @@ class NodeManager:
             restarts_left=spec.max_restarts,
             name=spec.name,
         )
-        self._actors[spec.actor_id] = info
         if spec.name:
             if spec.name in self._named_actors:
                 self._fail_task(
@@ -665,7 +665,8 @@ class NodeManager:
                 )
                 return
             self._named_actors[spec.name] = spec.actor_id
-        await self._place_actor(info, record)
+        self._actors[spec.actor_id] = info
+        asyncio.ensure_future(self._place_actor(info, record))
 
     async def _place_actor(self, info: ActorInfo, record: TaskRecord):
         spec = info.creation_spec

@@ -1,0 +1,16 @@
+"""ray_tpu.serve: online serving (Ray Serve equivalent, TPU-native:
+dynamic batching keeps the MXU fed; continuous-batched LLM decode to come
+on top of the same router)."""
+
+from .api import (  # noqa: F401
+    batch,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    scale,
+    shutdown,
+    status,
+)
+from .deployment import AutoscalingConfig, Deployment  # noqa: F401
+from .handle import DeploymentHandle, ServeFuture  # noqa: F401

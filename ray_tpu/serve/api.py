@@ -1,0 +1,129 @@
+"""Serve public API.
+
+Ref analogue: python/ray/serve/api.py — serve.run (:449), serve.batch,
+serve.delete, serve.shutdown, get_deployment_handle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from .controller import CONTROLLER_NAME, ServeControllerActor
+from .deployment import AutoscalingConfig, Deployment, deployment  # noqa: F401
+from .handle import DeploymentHandle
+from . import http_proxy
+
+_controller = None
+
+
+def _get_controller():
+    global _controller
+    if _controller is not None:
+        return _controller
+    import ray_tpu
+
+    try:
+        _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        _controller = ray_tpu.remote(ServeControllerActor).options(
+            name=CONTROLLER_NAME
+        ).remote()
+        # Wait until the controller is live before first use.
+        ray_tpu.get(_controller.list_deployments.remote())
+    return _controller
+
+
+def run(target: Deployment, *, name: Optional[str] = None,
+        route_prefix: Optional[str] = None, http_port: int = 0,
+        _blocking: bool = False) -> DeploymentHandle:
+    """Deploy and return a handle (ref: serve.run). Starts the HTTP proxy
+    lazily on first use; ``http_port=0`` picks a free port."""
+    import ray_tpu
+
+    controller = _get_controller()
+    dep_name = name or target.name
+    blob = cloudpickle.dumps(target.func_or_class)
+    batch_config = getattr(target.func_or_class, "_serve_batch_config", None)
+    replicas = ray_tpu.get(
+        controller.deploy.remote(
+            dep_name,
+            blob,
+            target._init_args,
+            target._init_kwargs,
+            target.num_replicas,
+            target.ray_actor_options,
+            batch_config,
+        )
+    )
+    handle = DeploymentHandle(dep_name, replicas, batch_config=batch_config)
+    port = http_proxy.start_proxy(http_port)
+    http_proxy.register_route(route_prefix or dep_name, handle)
+    handle.http_port = port
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    import ray_tpu
+
+    controller = _get_controller()
+    replicas = ray_tpu.get(controller.get_replicas.remote(name))
+    batch_config = ray_tpu.get(controller.get_batch_config.remote(name))
+    return DeploymentHandle(name, replicas, batch_config=batch_config)
+
+
+def scale(name: str, num_replicas: int) -> DeploymentHandle:
+    import ray_tpu
+
+    controller = _get_controller()
+    replicas = ray_tpu.get(controller.scale.remote(name, num_replicas))
+    batch_config = ray_tpu.get(controller.get_batch_config.remote(name))
+    return DeploymentHandle(name, replicas, batch_config=batch_config)
+
+
+def status() -> Dict[str, int]:
+    import ray_tpu
+
+    return ray_tpu.get(_get_controller().list_deployments.remote())
+
+
+def delete(name: str):
+    import ray_tpu
+
+    ray_tpu.get(_get_controller().delete.remote(name))
+
+
+def shutdown():
+    global _controller
+    import ray_tpu
+
+    http_proxy.stop_proxy()
+    if _controller is not None:
+        try:
+            ray_tpu.get(_controller.shutdown.remote())
+            ray_tpu.kill(_controller)
+        except Exception:
+            pass
+        _controller = None
+
+
+def batch(_func=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """@serve.batch: mark a callable for dynamic batching (ref:
+    serve/batching.py:65 _BatchQueue). The wrapped callable receives a LIST
+    of requests and returns a list of responses; the router coalesces
+    concurrent calls (continuous batching for model decode lives on top of
+    this in serve/llm.py)."""
+
+    def wrap(fn):
+        fn._serve_batch_config = {
+            "max_batch_size": max_batch_size,
+            "batch_wait_timeout_s": batch_wait_timeout_s,
+        }
+        return fn
+
+    if _func is not None:
+        return wrap(_func)
+    return wrap

@@ -1,0 +1,104 @@
+"""Serve controller + application state.
+
+Ref analogue: serve/_private/controller.py ServeController (:88) owning
+ApplicationState/DeploymentState (deployment_state.py:1193 — replica state
+machine, scaling). The controller is a named actor; deploy/scale/delete
+reconcile the replica actor set.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+CONTROLLER_NAME = "__serve_controller__"
+
+
+class ServeControllerActor:
+    """Runs as a named actor; holds deployment → replica handles."""
+
+    def __init__(self):
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+
+    def deploy(self, name: str, blob: bytes, init_args, init_kwargs,
+               num_replicas: int, ray_actor_options: Dict[str, Any],
+               batch_config: Optional[Dict[str, Any]]) -> List[Any]:
+        import ray_tpu
+        from .replica import Replica
+
+        existing = self._deployments.get(name)
+        if existing:
+            for h in existing["replicas"]:
+                try:
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
+        opts = dict(ray_actor_options)
+        actor_cls = ray_tpu.remote(**opts)(Replica) if opts else \
+            ray_tpu.remote(Replica)
+        replicas = [
+            actor_cls.remote(blob, init_args, init_kwargs)
+            for _ in range(num_replicas)
+        ]
+        # Block until every replica's constructor finished (gang readiness).
+        ray_tpu.get([r.ping.remote() for r in replicas])
+        self._deployments[name] = {
+            "blob": blob,
+            "init_args": init_args,
+            "init_kwargs": init_kwargs,
+            "replicas": replicas,
+            "ray_actor_options": ray_actor_options,
+            "batch_config": batch_config,
+        }
+        return replicas
+
+    def scale(self, name: str, num_replicas: int) -> List[Any]:
+        import ray_tpu
+        from .replica import Replica
+
+        d = self._deployments[name]
+        cur = d["replicas"]
+        if num_replicas > len(cur):
+            opts = dict(d["ray_actor_options"])
+            actor_cls = ray_tpu.remote(**opts)(Replica) if opts else \
+                ray_tpu.remote(Replica)
+            new = [
+                actor_cls.remote(d["blob"], d["init_args"], d["init_kwargs"])
+                for _ in range(num_replicas - len(cur))
+            ]
+            ray_tpu.get([r.ping.remote() for r in new])
+            cur.extend(new)
+        elif num_replicas < len(cur):
+            for h in cur[num_replicas:]:
+                try:
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
+            del cur[num_replicas:]
+        return cur
+
+    def get_replicas(self, name: str) -> List[Any]:
+        return self._deployments[name]["replicas"]
+
+    def get_batch_config(self, name: str):
+        return self._deployments[name]["batch_config"]
+
+    def list_deployments(self) -> Dict[str, int]:
+        return {k: len(v["replicas"]) for k, v in self._deployments.items()}
+
+    def delete(self, name: str):
+        import ray_tpu
+
+        d = self._deployments.pop(name, None)
+        if d:
+            for h in d["replicas"]:
+                try:
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
+
+    def shutdown(self):
+        for name in list(self._deployments):
+            self.delete(name)
+        return "ok"

@@ -1,0 +1,181 @@
+"""DeploymentHandle + router.
+
+Ref analogue: serve/handle.py DeploymentHandle → _private/router.py Router
+(:893) with PowerOfTwoChoicesReplicaScheduler (:290): each request samples
+two replicas and picks the one with fewer outstanding requests (queue
+lengths tracked by the caller; the reference queries replicas — local
+tracking is the single-process simplification of the same policy).
+
+Dynamic batching lives here too (ref analogue: serve/batching.py
+_BatchQueue:65): requests buffer until max_batch_size or batch_wait_timeout_s
+and flush as ONE replica call — on TPU this is what keeps the MXU fed with
+batched forward passes instead of single-row calls.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class _PendingBatch:
+    def __init__(self):
+        self.items: List[Tuple[Any, "ServeFuture"]] = []
+        self.created = time.monotonic()
+
+
+class ServeFuture:
+    """Resolves to the result of a routed request."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self._ref = None
+
+    def _set_ref(self, ref):
+        self._ref = ref
+        self._event.set()
+
+    def _set_value(self, value):
+        self._value = value
+        self._event.set()
+
+    def _set_error(self, err):
+        self._error = err
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve request timed out")
+        if self._error is not None:
+            raise self._error
+        if self._ref is not None:
+            import ray_tpu
+
+            return ray_tpu.get(self._ref, timeout=timeout)
+        return self._value
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, replicas: List[Any],
+                 *, batch_config: Optional[Dict[str, Any]] = None,
+                 method: str = "__call__"):
+        self.deployment_name = deployment_name
+        self._replicas = list(replicas)
+        self._outstanding: Dict[int, int] = {
+            i: 0 for i in range(len(replicas))
+        }
+        self._lock = threading.Lock()
+        self._method = method
+        self._batch = batch_config
+        self._pending: Optional[_PendingBatch] = None
+        self._flusher: Optional[threading.Thread] = None
+
+    # ---- replica selection -------------------------------------------------
+
+    def _pick_replica(self) -> int:
+        """Power of two choices on local outstanding counts."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 1:
+                return 0
+            a, b = random.sample(range(n), 2)
+            return a if self._outstanding[a] <= self._outstanding[b] else b
+
+    def _track(self, idx: int, ref) -> None:
+        import ray_tpu
+
+        with self._lock:
+            self._outstanding[idx] += 1
+
+        def _done():
+            try:
+                ray_tpu.wait([ref], num_returns=1, timeout=None)
+            finally:
+                with self._lock:
+                    self._outstanding[idx] -= 1
+
+        threading.Thread(target=_done, daemon=True).start()
+
+    # ---- request path ------------------------------------------------------
+
+    def options(self, method: Optional[str] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name, self._replicas,
+            batch_config=self._batch, method=method or self._method,
+        )
+        h._outstanding = self._outstanding  # share queue-depth view
+        h._lock = self._lock
+        return h
+
+    def remote(self, *args, **kwargs) -> ServeFuture:
+        if self._batch:
+            return self._remote_batched(args, kwargs)
+        fut = ServeFuture()
+        idx = self._pick_replica()
+        replica = self._replicas[idx]
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        self._track(idx, ref)
+        fut._set_ref(ref)
+        return fut
+
+    # ---- dynamic batching --------------------------------------------------
+
+    def _remote_batched(self, args, kwargs) -> ServeFuture:
+        fut = ServeFuture()
+        flush: Optional[_PendingBatch] = None
+        with self._lock:
+            if self._pending is None:
+                self._pending = _PendingBatch()
+                self._start_flusher()
+            self._pending.items.append(((args, kwargs), fut))
+            if len(self._pending.items) >= self._batch["max_batch_size"]:
+                flush = self._pending
+                self._pending = None
+        if flush is not None:
+            self._flush(flush)
+        return fut
+
+    def _start_flusher(self):
+        wait_s = self._batch["batch_wait_timeout_s"]
+
+        def run():
+            time.sleep(wait_s)
+            with self._lock:
+                flush, self._pending = self._pending, None
+            if flush is not None:
+                self._flush(flush)
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def _flush(self, batch: _PendingBatch):
+        import ray_tpu
+
+        idx = self._pick_replica()
+        replica = self._replicas[idx]
+        payload = [item for item, _ in batch.items]
+        ref = replica.handle_batch.remote(self._method, payload)
+        self._track(idx, ref)
+
+        def resolve():
+            try:
+                results = ray_tpu.get(ref)
+                for (_, fut), value in zip(batch.items, results):
+                    fut._set_value(value)
+            except BaseException as e:  # noqa: BLE001
+                for _, fut in batch.items:
+                    fut._set_error(e)
+
+        threading.Thread(target=resolve, daemon=True).start()
+
+    # ---- introspection -----------------------------------------------------
+
+    def num_replicas(self) -> int:
+        return len(self._replicas)
+
+    def queue_depths(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._outstanding)

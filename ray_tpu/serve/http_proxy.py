@@ -1,0 +1,90 @@
+"""HTTP ingress.
+
+Ref analogue: serve/_private/proxy.py ProxyActor (:1097) — the reference
+runs uvicorn/ASGI per node; here a threaded stdlib HTTP server in the
+driver process routes ``POST /<deployment>`` with a JSON body to the
+deployment handle and returns the JSON result. (uvicorn isn't a baked
+dependency; the stdlib server keeps ingress dependency-free.)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from .handle import DeploymentHandle
+
+
+class _ProxyState:
+    def __init__(self):
+        self.routes: Dict[str, DeploymentHandle] = {}
+
+
+_state = _ProxyState()
+_server: Optional[ThreadingHTTPServer] = None
+_thread: Optional[threading.Thread] = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # silence request logging
+        pass
+
+    def _reply(self, code: int, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/-/routes":
+            self._reply(200, sorted(_state.routes))
+        elif self.path == "/-/healthz":
+            self._reply(200, "ok")
+        else:
+            self.do_POST()
+
+    def do_POST(self):
+        name = self.path.strip("/").split("/")[0]
+        handle = _state.routes.get(name)
+        if handle is None:
+            self._reply(404, {"error": f"no deployment {name!r}"})
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b"null"
+        try:
+            arg = json.loads(raw) if raw else None
+        except json.JSONDecodeError:
+            self._reply(400, {"error": "invalid JSON body"})
+            return
+        try:
+            result = handle.remote(arg).result(timeout=60)
+            self._reply(200, {"result": result})
+        except Exception as e:  # noqa: BLE001
+            self._reply(500, {"error": str(e)})
+
+
+def start_proxy(port: int = 8000) -> int:
+    global _server, _thread
+    if _server is not None:
+        return _server.server_address[1]
+    _server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    _thread = threading.Thread(target=_server.serve_forever, daemon=True)
+    _thread.start()
+    return _server.server_address[1]
+
+
+def register_route(name: str, handle: DeploymentHandle):
+    _state.routes[name] = handle
+
+
+def stop_proxy():
+    global _server, _thread
+    if _server is not None:
+        _server.shutdown()
+        _server = None
+        _thread = None
+    _state.routes.clear()

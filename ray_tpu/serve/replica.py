@@ -1,0 +1,58 @@
+"""Replica actor: hosts one copy of a deployment.
+
+Ref analogue: python/ray/serve/_private/replica.py RayServeReplica (:510,
+call_user_method:851). Function deployments are called directly; class
+deployments are instantiated once and called via __call__ or a named
+method. ``handle_batch`` is the vectorized entry used by the router's
+dynamic batcher (ref analogue: serve/batching.py _BatchQueue flushing into
+the user's batch method).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, List, Tuple
+
+import cloudpickle
+
+
+class Replica:
+    def __init__(self, blob: bytes, init_args, init_kwargs):
+        target = cloudpickle.loads(blob)
+        if inspect.isclass(target):
+            self._callable = target(*init_args, **init_kwargs)
+            self._is_class = True
+        else:
+            self._callable = target
+            self._is_class = False
+        self._num_handled = 0
+
+    def handle_request(self, method: str, args: Tuple, kwargs: Dict) -> Any:
+        self._num_handled += 1
+        if self._is_class and method != "__call__":
+            fn = getattr(self._callable, method)
+        else:
+            fn = self._callable
+        return fn(*args, **kwargs)
+
+    def handle_batch(self, method: str, batched_args: List[Tuple]) -> List[Any]:
+        """One call per batch: user function receives a list of first
+        positional args and must return a list of equal length."""
+        self._num_handled += len(batched_args)
+        if self._is_class and method != "__call__":
+            fn = getattr(self._callable, method)
+        else:
+            fn = self._callable
+        items = [a[0][0] if a[0] else None for a in batched_args]
+        out = fn(items)
+        if not isinstance(out, (list, tuple)) or len(out) != len(items):
+            raise ValueError(
+                "batched deployment must return a list matching input length"
+            )
+        return list(out)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"num_handled": self._num_handled}
+
+    def ping(self) -> str:
+        return "pong"
