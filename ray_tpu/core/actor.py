@@ -110,6 +110,7 @@ class ActorClass:
             name=self._options.get("name", ""),
             actor_id=actor_id,
             max_restarts=max_restarts,
+            max_concurrency=self._options.get("max_concurrency", 1),
         )
         rt.submit(spec)
         del keepalive

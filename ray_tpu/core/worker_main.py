@@ -33,6 +33,11 @@ class Worker:
         self.actor = ActorContainer()
         self.runtime: WorkerRuntime | None = None
         self._alive = True
+        # Threaded actor concurrency (ref analogue: max_concurrency actors
+        # via ConcurrencyGroupManager, core_worker/transport/
+        # concurrency_group_manager.h): creation tasks with
+        # max_concurrency > 1 switch execution to a thread pool.
+        self._pool = None
 
     def start(self):
         self.conn.send({"type": "register", "worker_id": self.worker_id.hex()})
@@ -72,7 +77,22 @@ class Worker:
             msg = self.task_queue.get()
             if msg is None:
                 break
-            self._run_task(msg["spec"], msg.get("function_blob"))
+            spec = msg["spec"]
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK and \
+                    spec.max_concurrency > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(
+                    max_workers=spec.max_concurrency,
+                    thread_name_prefix="actor-concurrency",
+                )
+            if self._pool is not None and \
+                    spec.task_type == TaskType.ACTOR_TASK:
+                self._pool.submit(
+                    self._run_task, spec, msg.get("function_blob")
+                )
+                continue
+            self._run_task(spec, msg.get("function_blob"))
         # Flush refcounts before exit so the head's accounting stays sane.
         try:
             self.runtime.refs.flush()

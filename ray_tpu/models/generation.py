@@ -1,0 +1,171 @@
+"""Autoregressive generation with a static KV cache.
+
+TPU-first decode path for the Llama family: all shapes static (XLA traces
+once) — the cache is a fixed [L, B, T_max, Hkv, Dh] buffer updated with
+dynamic_update_slice; per-slot lengths mask attention. Prefill and decode
+are separate jitted programs (the standard TPU serving split: prefill is
+compute-bound on the MXU, decode is HBM-bandwidth-bound).
+
+No reference counterpart — Ray delegates model serving compute to user
+code; this framework owns it (continuous batching sits on top in
+ray_tpu.serve.llm).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, rms_norm, rope
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, T, Hkv, Dh]
+    v: jax.Array  # [L, B, T, Hkv, Dh]
+    lengths: jax.Array  # [B] int32 — valid tokens per slot
+
+    @staticmethod
+    def create(cfg: LlamaConfig, batch: int, max_len: int) -> "KVCache":
+        shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.dh)
+        return KVCache(
+            k=jnp.zeros(shape, dtype=cfg.dtype),
+            v=jnp.zeros(shape, dtype=cfg.dtype),
+            lengths=jnp.zeros((batch,), dtype=jnp.int32),
+        )
+
+
+def _attend_cached(q, ck, cv, q_pos, lengths, cfg):
+    """q [B,S,H,D] against cache ck/cv [B,T,Hkv,D]; positions of q rows are
+    q_pos [B,S]; cache rows >= lengths[b] (post-update) are masked."""
+    B, S, H, D = q.shape
+    T = ck.shape[1]
+    rep = H // ck.shape[2]
+    k = jnp.repeat(ck, rep, axis=2)
+    v = jnp.repeat(cv, rep, axis=2)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k,
+                        preferred_element_type=jnp.float32) * (D ** -0.5)
+    t_idx = jnp.arange(T)[None, None, :]  # [1,1,T]
+    causal = t_idx <= q_pos[:, :, None]  # [B,S,T]
+    scores = jnp.where(causal[:, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def _layer_cached(cfg, lp, x, cache_k, cache_v, start_pos, q_pos):
+    """One block over cached KV. x [B,S,M]; start_pos [B] write offset."""
+    B, S, M = x.shape
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = jnp.einsum("bsm,mhd->bshd", h, lp["wq"])
+    k = jnp.einsum("bsm,mhd->bshd", h, lp["wk"])
+    v = jnp.einsum("bsm,mhd->bshd", h, lp["wv"])
+    # Rotary with per-slot positions.
+    def rope_rows(x_b, pos_b):
+        return rope(x_b[None], pos_b, cfg.rope_theta)[0]
+
+    q = jax.vmap(rope_rows)(q, q_pos)
+    k = jax.vmap(rope_rows)(k, q_pos)
+
+    # Scatter new KV rows into the cache at start_pos per slot.
+    def upd(cache_b, new_b, start_b):
+        return jax.lax.dynamic_update_slice(
+            cache_b, new_b.astype(cache_b.dtype), (start_b, 0, 0)
+        )
+
+    cache_k = jax.vmap(upd)(cache_k, k, start_pos)
+    cache_v = jax.vmap(upd)(cache_v, v, start_pos)
+    attn = _attend_cached(q, cache_k, cache_v, q_pos,
+                          start_pos + S, cfg)
+    x = x + jnp.einsum("bshd,hdm->bsm", attn.astype(x.dtype), lp["wo"])
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    up = jnp.einsum("bsm,mf->bsf", h, lp["w_up"])
+    gate = jnp.einsum("bsm,mf->bsf", h, lp["w_gate"])
+    h2 = jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up
+    x = x + jnp.einsum("bsf,fm->bsm", h2, lp["w_down"])
+    return x, cache_k, cache_v
+
+
+def forward_with_cache(
+    params: Dict[str, Any],
+    tokens: jax.Array,      # [B, S] — S tokens appended to each slot
+    cache: KVCache,
+    cfg: LlamaConfig,
+    *,
+    active: Optional[jax.Array] = None,  # [B] bool — rows to update
+) -> Tuple[jax.Array, KVCache]:
+    """Append ``tokens`` to each slot's sequence and return logits for the
+    final appended position [B, V] plus the updated cache. Works for both
+    prefill (S = prompt length, lengths 0) and decode (S = 1)."""
+    B, S = tokens.shape
+    if cfg.n_experts > 0:
+        raise NotImplementedError("cached decode for MoE lands later")
+    start = cache.lengths
+    q_pos = start[:, None] + jnp.arange(S)[None, :]
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def body(carry, layer_in):
+        x = carry
+        lp, ck, cv = layer_in
+        x, ck, cv = _layer_cached(cfg, lp, x, ck, cv, start, q_pos)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    last = x[:, -1]
+    logits = jnp.einsum("bm,mv->bv", last, params["lm_head"])
+    active = jnp.ones((B,), bool) if active is None else active
+    lengths = jnp.where(active, cache.lengths + S, cache.lengths)
+    keep = active[:, None, None, None]
+    new_k = jnp.where(keep[None], new_k, cache.k)
+    new_v = jnp.where(keep[None], new_v, cache.v)
+    return logits.astype(jnp.float32), KVCache(new_k, new_v, lengths)
+
+
+def sample_logits(logits: jax.Array, rng: jax.Array, *,
+                  temperature: float = 0.0, top_k: int = 0) -> jax.Array:
+    """Greedy (temperature 0) or temperature/top-k sampling. [B,V] → [B]."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        vals, _ = jax.lax.top_k(logits, top_k)
+        kth = vals[:, -1][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    params: Dict[str, Any],
+    prompt: jax.Array,       # [B, S_prompt]
+    cfg: LlamaConfig,
+    *,
+    max_new_tokens: int,
+    max_len: Optional[int] = None,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    eos_token: Optional[int] = None,
+) -> jax.Array:
+    """Simple batch generation (prefill + scan decode). Returns
+    [B, max_new_tokens]."""
+    B, S = prompt.shape
+    max_len = max_len or (S + max_new_tokens)
+    cache = KVCache.create(cfg, B, max_len)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    logits, cache = forward_with_cache(params, prompt, cache, cfg)
+    first = sample_logits(logits, rng, temperature=temperature)
+    if max_new_tokens == 1:
+        return first[:, None]
+
+    def step(carry, key):
+        tok, cache = carry
+        logits, cache = forward_with_cache(params, tok[:, None], cache, cfg)
+        nxt = sample_logits(logits, key, temperature=temperature)
+        return (nxt, cache), nxt
+
+    keys = jax.random.split(rng, max_new_tokens - 1)
+    (_, _), rest = jax.lax.scan(step, (first, cache), keys)
+    return jnp.concatenate([first[:, None], rest.T], axis=1)

@@ -1,0 +1,241 @@
+"""Continuous-batched LLM serving on TPU.
+
+The capability the reference lacks (SURVEY.md §7 hard parts: "continuous
+batching + paged KV cache on TPU for Serve; reference has only
+request-level batching"): an engine with a static-shape slotted KV cache
+where requests JOIN and LEAVE the running decode loop — each decode step
+batches every active slot into one [B, 1] forward pass (HBM-bandwidth
+bound; batching amortizes the weight reads), while prefill runs per
+admission. All shapes static for XLA: the cache is [L, B_max, T_max, ...]
+and slot activity is a boolean mask.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class _Request:
+    def __init__(self, prompt: List[int], max_new_tokens: int,
+                 eos_token: Optional[int]):
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.eos_token = eos_token
+        self.output: List[int] = []
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.ttft_s: Optional[float] = None
+        self._t0 = None
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if self.error:
+            raise self.error
+        return self.output
+
+
+class LLMEngine:
+    """Slotted continuous-batching decode engine over the Llama family."""
+
+    def __init__(self, cfg, params, *, max_batch: int = 8,
+                 max_len: int = 512, temperature: float = 0.0):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.generation import (
+            KVCache,
+            forward_with_cache,
+            sample_logits,
+        )
+
+        self.cfg = cfg
+        self.params = params
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.temperature = temperature
+        self._jnp = jnp
+        self._jax = jax
+
+        self.cache = KVCache.create(cfg, max_batch, max_len)
+        self._slot_free = list(range(max_batch))
+        self._slot_req: Dict[int, _Request] = {}
+        self._last_tok = np.zeros((max_batch,), dtype=np.int32)
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._stop = False
+        self._step_count = 0
+
+        def decode_step(params, cache, last_tok, active, key):
+            logits, cache = forward_with_cache(
+                params, last_tok[:, None], cache, cfg, active=active
+            )
+            nxt = sample_logits(logits, key, temperature=temperature)
+            return nxt, cache
+
+        self._decode = jax.jit(decode_step)
+
+        # Prefill for one slot: compute a single-row cache then scatter its
+        # rows into the big cache at the slot index (compiled per prompt
+        # length; length bucketing is a follow-up optimization).
+        def prefill(params, cache, tokens, slot):
+            from ..models.generation import KVCache as KC
+
+            small = KC.create(cfg, 1, max_len)
+            logits, small = forward_with_cache(params, tokens, small, cfg)
+            k = jax.lax.dynamic_update_slice(
+                cache.k, small.k, (0, slot, 0, 0, 0)
+            )
+            v = jax.lax.dynamic_update_slice(
+                cache.v, small.v, (0, slot, 0, 0, 0)
+            )
+            lengths = cache.lengths.at[slot].set(small.lengths[0])
+            nxt = sample_logits(logits, jax.random.PRNGKey(0),
+                                temperature=temperature)
+            return KC(k, v, lengths), nxt[0]
+
+        self._prefill = jax.jit(prefill)
+        self._rng = jax.random.PRNGKey(0)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ---- public API --------------------------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 32,
+               eos_token: Optional[int] = None) -> _Request:
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt({len(prompt)}) + max_new({max_new_tokens}) exceeds "
+                f"engine max_len({self.max_len})"
+            )
+        req = _Request(prompt, max_new_tokens, eos_token)
+        import time
+
+        req._t0 = time.perf_counter()
+        self._queue.put(req)
+        return req
+
+    def generate(self, prompt: List[int], max_new_tokens: int = 32,
+                 eos_token: Optional[int] = None,
+                 timeout: float = 300.0) -> List[int]:
+        return self.submit(prompt, max_new_tokens, eos_token).result(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "active_slots": len(self._slot_req),
+                "free_slots": len(self._slot_free),
+                "decode_steps": self._step_count,
+            }
+
+    def shutdown(self):
+        self._stop = True
+        self._thread.join(timeout=5)
+
+    # ---- engine loop -------------------------------------------------------
+
+    def _admit(self):
+        import time
+
+        while self._slot_free:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            slot = self._slot_free.pop()
+            jnp = self._jnp
+            tokens = jnp.asarray([req.prompt], dtype=jnp.int32)
+            try:
+                self.cache, first = self._prefill(
+                    self.params, self.cache, tokens, slot
+                )
+                first = int(first)
+            except Exception as e:  # noqa: BLE001
+                req.error = e
+                req.done.set()
+                self._slot_free.append(slot)
+                continue
+            req.ttft_s = time.perf_counter() - req._t0
+            req.output.append(first)
+            with self._lock:
+                self._slot_req[slot] = req
+            self._last_tok[slot] = first
+            self._finish_if_done(slot, req, first)
+
+    def _finish_if_done(self, slot: int, req: _Request, tok: int):
+        if (len(req.output) >= req.max_new_tokens
+                or (req.eos_token is not None and tok == req.eos_token)):
+            with self._lock:
+                self._slot_req.pop(slot, None)
+            self._slot_free.append(slot)
+            req.done.set()
+
+    def _loop(self):
+        import time
+
+        jnp = self._jnp
+        jax = self._jax
+        while not self._stop:
+            self._admit()
+            with self._lock:
+                active_slots = dict(self._slot_req)
+            if not active_slots:
+                time.sleep(0.002)
+                continue
+            active = np.zeros((self.max_batch,), dtype=bool)
+            for s in active_slots:
+                active[s] = True
+            self._rng, key = jax.random.split(self._rng)
+            nxt, self.cache = self._decode(
+                self.params,
+                self.cache,
+                jnp.asarray(self._last_tok),
+                jnp.asarray(active),
+                key,
+            )
+            self._step_count += 1
+            nxt = np.asarray(nxt)
+            for slot, req in active_slots.items():
+                tok = int(nxt[slot])
+                req.output.append(tok)
+                self._last_tok[slot] = tok
+                self._finish_if_done(slot, req, tok)
+
+
+class LLMDeployment:
+    """Serve deployment wrapping an engine; deploy with
+    ray_actor_options={"max_concurrency": N} so concurrent requests join
+    the running decode loop (continuous batching)."""
+
+    def __init__(self, cfg=None, params=None, *, checkpoint_path=None,
+                 max_batch: int = 8, max_len: int = 512,
+                 temperature: float = 0.0, seed: int = 0):
+        from ..models import LlamaConfig, init_params
+
+        if cfg is None:
+            cfg = LlamaConfig.tiny()
+        if params is None and checkpoint_path:
+            from ..train.checkpoint import Checkpoint
+
+            params = Checkpoint(checkpoint_path).as_pytree()
+        if params is None:
+            import jax
+
+            params = init_params(cfg, jax.random.PRNGKey(seed))
+        self.engine = LLMEngine(cfg, params, max_batch=max_batch,
+                                max_len=max_len, temperature=temperature)
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        tokens = self.engine.generate(
+            list(request["prompt"]),
+            int(request.get("max_new_tokens", 32)),
+            request.get("eos_token"),
+        )
+        return {"tokens": tokens}
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
