@@ -1,0 +1,8 @@
+"""ray_tpu.rllib: reinforcement learning (RLlib equivalent, TPU-native:
+CPU EnvRunner actors + jax Learner on the accelerator)."""
+
+from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from .env_runner import EnvRunner  # noqa: F401
+from .policy import MLPPolicy  # noqa: F401
+from .ppo import PPO, PPOConfig  # noqa: F401
+from .sample_batch import SampleBatch, compute_gae  # noqa: F401

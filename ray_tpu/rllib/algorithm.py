@@ -1,0 +1,141 @@
+"""Algorithm + AlgorithmConfig.
+
+Ref analogue: rllib/algorithms/algorithm.py Algorithm (:190,
+training_step:1616) and algorithm_config.py AlgorithmConfig builder.
+``train()`` = one iteration: parallel EnvRunner sampling (CPU actors) →
+Learner update (jax, accelerator) → weight broadcast, matching the
+reference's SURVEY.md §3.6 loop with the NCCL learner group replaced by a
+jax learner.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class AlgorithmConfig:
+    def __init__(self):
+        self.env: Optional[Any] = None
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners: int = 2
+        self.rollout_fragment_length: int = 200
+        self.lr: float = 3e-4
+        self.gamma: float = 0.99
+        self.lambda_: float = 0.95
+        self.train_batch_size: int = 400
+        self.minibatch_size: int = 128
+        self.num_epochs: int = 8
+        self.hidden_size: int = 64
+        self.seed: int = 0
+
+    def environment(self, env=None, *, env_config=None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, *, num_env_runners=None,
+                    rollout_fragment_length=None) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw) -> "AlgorithmConfig":
+        for k, v in kw.items():
+            key = "lambda_" if k == "lambda" else k
+            if not hasattr(self, key):
+                raise ValueError(f"unknown training param {k!r}")
+            setattr(self, key, v)
+        return self
+
+    def debugging(self, *, seed=None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def env_creator(self) -> Callable[[], Any]:
+        env = self.env
+        cfg = dict(self.env_config)
+        if callable(env):
+            return lambda: env(**cfg) if cfg else env()
+        if isinstance(env, str):
+            def make():
+                import gymnasium
+
+                return gymnasium.make(env, **cfg)
+
+            return make
+        raise ValueError("config.environment(env=...) must be set to a "
+                         "callable or gymnasium env id")
+
+    def build(self) -> "Algorithm":
+        raise NotImplementedError
+
+
+class Algorithm:
+    """Base: owns EnvRunner actors + a Learner; subclasses implement
+    training_step()."""
+
+    def __init__(self, config: AlgorithmConfig):
+        import ray_tpu
+        from .env_runner import EnvRunner
+
+        self.config = config
+        self.iteration = 0
+        creator = config.env_creator()
+        probe = creator()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close() if hasattr(probe, "close") else None
+        self._obs_dim, self._num_actions = obs_dim, num_actions
+
+        from .policy import MLPPolicy
+
+        def policy_factory(obs_dim=obs_dim, num_actions=num_actions,
+                           hidden=config.hidden_size, seed=config.seed):
+            return MLPPolicy(obs_dim, num_actions, hidden, seed)
+
+        runner_cls = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            runner_cls.remote(
+                creator, policy_factory,
+                seed=config.seed + i,
+                rollout_fragment_length=config.rollout_fragment_length,
+                gamma=config.gamma, lam=config.lambda_,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self.learner = self._build_learner(policy_factory())
+
+    def _build_learner(self, policy):
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        result = self.training_step()
+        result["training_iteration"] = self.iteration
+        return result
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

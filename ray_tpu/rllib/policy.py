@@ -1,0 +1,67 @@
+"""Policies.
+
+Ref analogue: rllib/policy/ + new-stack rl_module. The rollout-side policy
+is pure numpy (CPU actors step envs without importing jax — SURVEY.md §3.6
+keeps env stepping light); the Learner trains the same parameter pytree
+with jax on the accelerator and broadcasts weights back.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def init_mlp_params(
+    rng: np.random.RandomState, sizes: List[int]
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    params = []
+    for fan_in, fan_out in zip(sizes[:-1], sizes[1:]):
+        W = rng.randn(fan_in, fan_out).astype(np.float32) * np.sqrt(
+            2.0 / fan_in
+        )
+        b = np.zeros(fan_out, dtype=np.float32)
+        params.append((W, b))
+    return params
+
+
+class MLPPolicy:
+    """Discrete-action actor-critic MLP; numpy inference."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: int = 64,
+                 seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.weights: Dict[str, List] = {
+            "trunk": init_mlp_params(rng, [obs_dim, hidden, hidden]),
+            "pi": init_mlp_params(rng, [hidden, num_actions]),
+            "vf": init_mlp_params(rng, [hidden, 1]),
+        }
+
+    def set_weights(self, weights):
+        self.weights = weights
+
+    def get_weights(self):
+        return self.weights
+
+    def _trunk(self, x: np.ndarray) -> np.ndarray:
+        for W, b in self.weights["trunk"]:
+            x = np.tanh(x @ W + b)
+        return x
+
+    def logits_and_value(self, obs: np.ndarray):
+        h = self._trunk(obs)
+        (Wp, bp), = self.weights["pi"]
+        (Wv, bv), = self.weights["vf"]
+        return h @ Wp + bp, (h @ Wv + bv)[..., 0]
+
+    def compute_action(self, obs: np.ndarray, rng: np.random.RandomState):
+        logits, value = self.logits_and_value(obs[None])
+        logits = logits[0] - logits[0].max()
+        probs = np.exp(logits)
+        probs /= probs.sum()
+        action = int(rng.choice(self.num_actions, p=probs))
+        logp = float(np.log(probs[action] + 1e-12))
+        return action, logp, float(value[0])

@@ -1,0 +1,152 @@
+"""PPO: clipped-surrogate policy optimization.
+
+Ref analogue: rllib/algorithms/ppo/ (ppo.py:392 training_step, torch
+learner) — here the Learner is jax (runs on the accelerator when present:
+SURVEY.md §3.6's LearnerGroup→GPU becomes Learner→TPU) and the rollout
+plane stays numpy on CPU actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    LOGPS,
+    OBS,
+    RETURNS,
+    SampleBatch,
+)
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param: float = 0.2
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+
+    def build(self) -> "PPO":
+        return PPO(self.copy())
+
+
+class PPOLearner:
+    """jax learner over the numpy policy pytree."""
+
+    def __init__(self, policy, lr: float, clip: float, vf_coeff: float,
+                 ent_coeff: float):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._policy = policy
+        self._tx = optax.adam(lr)
+        self._params = jax.tree.map(jnp.asarray, policy.get_weights())
+        self._opt_state = self._tx.init(self._params)
+
+        def forward(params, obs):
+            h = obs
+            for W, b in params["trunk"]:
+                h = jnp.tanh(h @ W + b)
+            (Wp, bp), = params["pi"]
+            (Wv, bv), = params["vf"]
+            return h @ Wp + bp, (h @ Wv + bv)[..., 0]
+
+        def loss_fn(params, obs, actions, old_logp, adv, returns):
+            logits, values = forward(params, obs)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, actions[:, None], axis=1
+            )[:, 0]
+            ratio = jnp.exp(logp - old_logp)
+            adv_n = (adv - adv.mean()) / (adv.std() + 1e-8)
+            surr = jnp.minimum(
+                ratio * adv_n,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv_n,
+            )
+            pi_loss = -surr.mean()
+            vf_loss = ((values - returns) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {
+                "policy_loss": pi_loss,
+                "vf_loss": vf_loss,
+                "entropy": entropy,
+            }
+
+        def update(params, opt_state, obs, actions, old_logp, adv, returns):
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, obs, actions, old_logp, adv, returns)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            stats["total_loss"] = loss
+            return params, opt_state, stats
+
+        self._update = jax.jit(update)
+
+    def update(self, batch: SampleBatch, *, epochs: int,
+               minibatch_size: int, rng: np.random.RandomState
+               ) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        stats = {}
+        for _ in range(epochs):
+            shuffled = batch.shuffle(rng)
+            for mb in shuffled.minibatches(min(minibatch_size, batch.count)):
+                self._params, self._opt_state, stats = self._update(
+                    self._params,
+                    self._opt_state,
+                    jnp.asarray(mb[OBS]),
+                    jnp.asarray(mb[ACTIONS], dtype=jnp.int32),
+                    jnp.asarray(mb[LOGPS]),
+                    jnp.asarray(mb[ADVANTAGES]),
+                    jnp.asarray(mb[RETURNS]),
+                )
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params)
+
+
+class PPO(Algorithm):
+    def _build_learner(self, policy):
+        c = self.config
+        self._rng = np.random.RandomState(c.seed)
+        return PPOLearner(
+            policy, c.lr, c.clip_param, c.vf_loss_coeff, c.entropy_coeff
+        )
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        batches: List[SampleBatch] = []
+        while sum(b.count for b in batches) < c.train_batch_size:
+            batches.extend(
+                ray_tpu.get([r.sample.remote() for r in self.runners])
+            )
+        batch = SampleBatch.concat(batches)
+        learner_stats = self.learner.update(
+            batch, epochs=c.num_epochs, minibatch_size=c.minibatch_size,
+            rng=self._rng,
+        )
+        weights = self.learner.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners])
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": batch.count,
+            **learner_stats,
+        }

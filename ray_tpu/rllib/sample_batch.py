@@ -1,0 +1,71 @@
+"""SampleBatch: columnar rollout storage.
+
+Ref analogue: rllib/policy/sample_batch.py SampleBatch — a dict of aligned
+arrays with standard column names.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+NEXT_OBS = "next_obs"
+LOGPS = "action_logp"
+VALUES = "values"
+ADVANTAGES = "advantages"
+RETURNS = "returns"
+
+
+class SampleBatch(dict):
+    @property
+    def count(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @staticmethod
+    def concat(batches: List["SampleBatch"]) -> "SampleBatch":
+        keys = batches[0].keys()
+        return SampleBatch(
+            {k: np.concatenate([np.asarray(b[k]) for b in batches]) for k in keys}
+        )
+
+    def shuffle(self, rng: np.random.RandomState) -> "SampleBatch":
+        idx = rng.permutation(self.count)
+        return SampleBatch({k: np.asarray(v)[idx] for k, v in self.items()})
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        n = self.count
+        for start in range(0, n - size + 1, size):
+            yield SampleBatch(
+                {k: np.asarray(v)[start:start + size] for k, v in self.items()}
+            )
+
+
+def compute_gae(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    dones: np.ndarray,
+    last_value: float,
+    *,
+    gamma: float,
+    lam: float,
+) -> Dict[str, np.ndarray]:
+    """Generalized advantage estimation (ref analogue:
+    rllib/evaluation/postprocessing.py compute_advantages)."""
+    T = len(rewards)
+    adv = np.zeros(T, dtype=np.float32)
+    last_gae = 0.0
+    for t in reversed(range(T)):
+        next_v = last_value if t == T - 1 else values[t + 1]
+        nonterminal = 1.0 - float(dones[t])
+        delta = rewards[t] + gamma * next_v * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+    returns = adv + values
+    return {ADVANTAGES: adv, RETURNS: returns.astype(np.float32)}
