@@ -1,0 +1,31 @@
+# Native components of ray_tpu. `make native` builds the CPython extension
+# in-place; ray_tpu/_native auto-invokes this on first import if the .so is
+# missing (g++ is part of the supported toolchain).
+
+PY       ?= python3
+PY_INC   := $(shell $(PY) -c "import sysconfig; print(sysconfig.get_paths()['include'])")
+CXX      ?= g++
+CXXFLAGS ?= -O2 -g -std=c++17 -fPIC -Wall -Wextra
+LDLIBS   := -lpthread -lrt
+
+STORE_SRC := src/store/rts_store.cc
+EXT       := ray_tpu/_native/_rtstore.so
+
+.PHONY: native native-test clean
+
+native: $(EXT)
+
+$(EXT): $(STORE_SRC) src/store/_rtstore_module.cc src/store/rts_store.h
+	$(CXX) $(CXXFLAGS) -shared -I$(PY_INC) -Isrc/store \
+	  $(STORE_SRC) src/store/_rtstore_module.cc -o $@ $(LDLIBS)
+
+build/rts_store_test: $(STORE_SRC) src/store/rts_store_test.cc src/store/rts_store.h
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -Isrc/store $(STORE_SRC) src/store/rts_store_test.cc \
+	  -o $@ $(LDLIBS)
+
+native-test: build/rts_store_test
+	./build/rts_store_test
+
+clean:
+	rm -rf build $(EXT)

@@ -1,0 +1,64 @@
+"""Loader for ray_tpu's native (C++) components.
+
+The CPython extension ``_rtstore`` (shared-memory object store, see
+src/store/) is built in-place by the repo Makefile. On first import, if the
+.so is missing and a toolchain is available, we build it on demand; callers
+fall back to the pure-Python store when the native module is unavailable, so
+the framework works (slower) on machines without g++.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+
+_lock = threading.Lock()
+_rtstore_mod = None
+_build_attempted = False
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
+
+
+def _try_import():
+    try:
+        from . import _rtstore  # type: ignore
+
+        return _rtstore
+    except ImportError:
+        return None
+
+
+def _try_build() -> bool:
+    makefile = os.path.join(_REPO_ROOT, "Makefile")
+    if not os.path.exists(makefile):
+        return False
+    try:
+        proc = subprocess.run(
+            ["make", "-C", _REPO_ROOT, "native", f"PY={sys.executable}"],
+            capture_output=True,
+            timeout=120,
+        )
+        return proc.returncode == 0
+    except Exception:
+        return False
+
+
+def load_rtstore():
+    """Return the _rtstore extension module, building it if needed, or None."""
+    global _rtstore_mod, _build_attempted
+    with _lock:
+        if _rtstore_mod is not None:
+            return _rtstore_mod
+        _rtstore_mod = _try_import()
+        if _rtstore_mod is None and not _build_attempted:
+            _build_attempted = True
+            if os.environ.get("RAY_TPU_NO_NATIVE_BUILD") != "1" and _try_build():
+                _rtstore_mod = _try_import()
+        return _rtstore_mod
+
+
+def native_store_available() -> bool:
+    return load_rtstore() is not None

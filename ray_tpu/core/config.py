@@ -59,6 +59,9 @@ class Config:
     # Chunk size for inter-node object transfer (ref:
     # object_manager_default_chunk_size = 5 MiB).
     object_transfer_chunk_bytes: int = 5 * 1024 * 1024
+    # Use the native C++ shared-memory arena store (src/store/) when the
+    # extension is importable/buildable; pure-Python per-object shm otherwise.
+    use_native_store: bool = True
 
     def __post_init__(self):
         for f in dataclasses.fields(self):

@@ -37,15 +37,41 @@ from .exceptions import (
 )
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import (
+    ArenaLocation,
     InlineLocation,
     Location,
     ObjectDirectory,
     ShmLocation,
+    current_arena,
+    init_arena,
+    shutdown_arena,
 )
 from .resources import CPU, NodeResources, ResourceSet
 from .task_spec import TaskSpec, TaskType
 
 _HEADER = struct.Struct("<I")
+
+
+def _free_location(loc) -> None:
+    """Release an object's storage: arena delete or shm unlink."""
+    if isinstance(loc, ArenaLocation):
+        arena = current_arena()
+        if arena is not None:
+            try:
+                arena.delete(loc.oid)
+            except Exception:
+                pass
+    elif isinstance(loc, ShmLocation):
+        try:
+            from multiprocessing import shared_memory
+
+            seg = shared_memory.SharedMemory(name=loc.name)
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+        except Exception:
+            pass
 
 
 def _task_worker_type(spec: TaskSpec) -> str:
@@ -131,6 +157,14 @@ class NodeManager:
         self.node_resources = NodeResources(ResourceSet(resources))
         capacity = config.object_store_memory
         self.directory = ObjectDirectory(capacity)
+        # Native C++ arena store (plasma-equivalent, src/store/): created by
+        # the head process; workers attach via RAY_TPU_ARENA. Pure-Python
+        # per-object shm remains the fallback when the toolchain is missing.
+        self.arena_name: Optional[str] = None
+        if config.use_native_store:
+            name = f"/rtpu-{node_id.hex()[:16]}"
+            if init_arena(name, capacity=capacity or (1 << 30), create=True):
+                self.arena_name = name
 
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
@@ -266,6 +300,8 @@ class NodeManager:
         env["RAY_TPU_NODE_SOCKET"] = self.socket_path
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_WORKER_TYPE"] = worker_type
+        if self.arena_name:
+            env["RAY_TPU_ARENA"] = self.arena_name
         # Ensure the worker can import this package even when the driver was
         # launched from elsewhere with ray_tpu on sys.path but not installed.
         pkg_root = os.path.dirname(
@@ -919,17 +955,16 @@ class NodeManager:
             for oid, loc in self.directory.collect_garbage(grace):
                 self._sealed.discard(oid)
                 self._seal_events.pop(oid, None)
-                if isinstance(loc, ShmLocation):
-                    try:
-                        from multiprocessing import shared_memory
-
-                        seg = shared_memory.SharedMemory(name=loc.name)
-                        seg.close()
-                        seg.unlink()
-                    except FileNotFoundError:
-                        pass
-                    except Exception:
-                        pass
+                _free_location(loc)
+            # Reclaim arena blocks stuck in pending-delete because a pinning
+            # reader died without unpinning (ref analogue: plasma client
+            # disconnect releasing its objects).
+            arena = current_arena()
+            if arena is not None:
+                try:
+                    arena.purge_dead_pins()
+                except Exception:
+                    pass
 
     async def _reply_locations(self, w: WorkerHandle, msg):
         try:
@@ -1107,20 +1142,11 @@ class NodeManager:
                 proc.terminate()
             except Exception:
                 pass
-        # Unlink all remaining shm segments we know about.
+        # Unlink all remaining shm segments we know about, then the arena.
         for oid in list(self.directory._entries):
-            loc = self.directory._entries.get(oid)
-            if isinstance(loc, ShmLocation):
-                try:
-                    from multiprocessing import shared_memory
-
-                    seg = shared_memory.SharedMemory(name=loc.name)
-                    seg.close()
-                    seg.unlink()
-                except FileNotFoundError:
-                    pass
-                except Exception:
-                    pass
+            _free_location(self.directory._entries.get(oid))
+        if self.arena_name:
+            shutdown_arena(unlink=True)
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=5)
         try:

@@ -40,7 +40,74 @@ class ShmLocation:
     size: int
 
 
-Location = Union[InlineLocation, ShmLocation]
+@dataclass(frozen=True)
+class ArenaLocation:
+    """Object stored in the node's native C++ arena store (src/store/).
+
+    Lookup is by object id (the arena keeps its own table); ``size`` is the
+    sealed payload size for directory accounting."""
+
+    arena: str
+    oid: bytes
+    size: int
+
+
+Location = Union[InlineLocation, ShmLocation, ArenaLocation]
+
+
+# ---------------------------------------------------------------------------
+# Native arena store (one per node, created by the node manager, attached by
+# every worker via the RAY_TPU_ARENA env var). Module-level singleton: all
+# runtimes in a process share one mapping.
+# ---------------------------------------------------------------------------
+
+_arena = None
+_arena_lock = threading.Lock()
+
+
+def init_arena(name: str, capacity: int = 0, create: bool = False) -> bool:
+    """Create or attach the node arena. Returns True when the native store
+    is active in this process; False leaves the pure-Python fallback."""
+    global _arena
+    from ray_tpu._native import load_rtstore
+
+    mod = load_rtstore()
+    if mod is None:
+        return False
+    with _arena_lock:
+        if _arena is not None:
+            return True
+        try:
+            if create:
+                _arena = mod.create(name, capacity)
+            else:
+                _arena = mod.attach(name)
+        except OSError:
+            _arena = None
+            return False
+    return True
+
+
+def current_arena():
+    return _arena
+
+
+def shutdown_arena(unlink: bool):
+    global _arena
+    with _arena_lock:
+        store, _arena = _arena, None
+    if store is not None:
+        name = store.name
+        store.close()
+        if unlink:
+            from ray_tpu._native import load_rtstore
+
+            mod = load_rtstore()
+            if mod is not None:
+                try:
+                    mod.unlink(name)
+                except OSError:
+                    pass
 
 
 def _shm_name(object_id: ObjectID) -> str:
@@ -75,7 +142,47 @@ class LocalObjectStore:
 
     # -- write path ---------------------------------------------------------
 
-    def put_serialized(self, object_id: ObjectID, sobj: SerializedObject) -> ShmLocation:
+    def put_serialized(self, object_id: ObjectID, sobj: SerializedObject) -> Location:
+        arena = current_arena()
+        if arena is not None:
+            loc = self._put_arena(arena, object_id, sobj)
+            if loc is not None:
+                return loc
+            # Arena full: fall through to a per-object segment (the
+            # plasma-equivalent of fallback allocation to filesystem shm).
+        return self._put_segment(object_id, sobj)
+
+    def _put_arena(self, arena, object_id: ObjectID, sobj: SerializedObject):
+        oid = object_id.binary()
+        size = sobj.total_size
+        try:
+            view = arena.alloc(oid, size)
+        except FileExistsError:
+            # Same id written twice (task retry after a crashed writer):
+            # replace — never trust old contents.
+            arena.delete(oid)
+            try:
+                view = arena.alloc(oid, size)
+            except (FileExistsError, MemoryError):
+                return None
+        except MemoryError:
+            return None
+        try:
+            mv = memoryview(view)
+            sobj.write_into(mv)
+            del mv
+            arena.seal(oid)
+        except BaseException:
+            try:
+                arena.abort(oid)
+            except Exception:
+                pass
+            raise
+        finally:
+            view.release()  # drop the creator pin
+        return ArenaLocation(arena.name, oid, size)
+
+    def _put_segment(self, object_id: ObjectID, sobj: SerializedObject) -> ShmLocation:
         name = _shm_name(object_id)
         size = sobj.total_size
         try:
@@ -108,6 +215,18 @@ class LocalObjectStore:
     def get_view(self, loc: Location) -> memoryview:
         if isinstance(loc, InlineLocation):
             return memoryview(loc.data)
+        if isinstance(loc, ArenaLocation):
+            arena = current_arena()
+            if arena is None:
+                raise RuntimeError(
+                    f"object in arena {loc.arena} but no arena attached"
+                )
+            view = arena.get(loc.oid)
+            if view is None:
+                raise KeyError(f"object {loc.oid.hex()} lost from arena")
+            # The memoryview keeps the View (and its pin) alive; numpy arrays
+            # deserialized zero-copy chain to it via their .base.
+            return memoryview(view)[: loc.size]
         with self._lock:
             seg = self._segments.get(loc.name)
             if seg is None:
@@ -180,14 +299,15 @@ class ObjectDirectory:
             if object_id in self._entries:
                 self._refcounts[object_id] += initial_refs
                 return
-            size = loc.size if isinstance(loc, ShmLocation) else len(loc.data)
-            if isinstance(loc, ShmLocation) and self.capacity_bytes > 0:
+            shared = isinstance(loc, (ShmLocation, ArenaLocation))
+            size = loc.size if shared else len(loc.data)
+            if shared and self.capacity_bytes > 0:
                 if self.used_bytes + size > self.capacity_bytes:
                     raise ObjectStoreFullError(
                         f"object store over capacity: {self.used_bytes + size} "
                         f"> {self.capacity_bytes} bytes"
                     )
-            self.used_bytes += size if isinstance(loc, ShmLocation) else 0
+            self.used_bytes += size if shared else 0
             self._entries[object_id] = loc
             self._refcounts[object_id] = initial_refs
             if initial_refs <= 0:
@@ -204,7 +324,7 @@ class ObjectDirectory:
         location once the producing task finishes."""
         with self._lock:
             self._entries[object_id] = loc
-            if isinstance(loc, ShmLocation):
+            if isinstance(loc, (ShmLocation, ArenaLocation)):
                 self.used_bytes += loc.size
 
     def add_ref(self, object_id: ObjectID, count: int = 1):
@@ -247,7 +367,7 @@ class ObjectDirectory:
                 self._zero_since.pop(oid, None)
                 if loc is None:
                     continue
-                if isinstance(loc, ShmLocation):
+                if isinstance(loc, (ShmLocation, ArenaLocation)):
                     self.used_bytes -= loc.size
                 out.append((oid, loc))
         return out

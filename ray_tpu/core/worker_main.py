@@ -157,6 +157,19 @@ class Worker:
 def main():
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
     socket_path = os.environ["RAY_TPU_NODE_SOCKET"]
+    arena = os.environ.get("RAY_TPU_ARENA")
+    if arena:
+        from .object_store import init_arena
+
+        if not init_arena(arena, create=False):
+            # Puts fall back to per-object shm, but gets of ArenaLocation
+            # objects will fail — make the root cause findable in the log.
+            print(
+                f"ray_tpu worker: failed to attach arena {arena}; "
+                "native store disabled in this worker",
+                file=sys.stderr,
+                flush=True,
+            )
     conn = connect_unix(socket_path)
     worker = Worker(conn, worker_id)
     worker.start()

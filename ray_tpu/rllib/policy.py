@@ -58,7 +58,9 @@ class MLPPolicy:
         return h @ Wp + bp, (h @ Wv + bv)[..., 0]
 
     def compute_action(self, obs: np.ndarray, rng: np.random.RandomState):
-        logits, value = self.logits_and_value(obs[None])
+        # The net is sized with np.prod(observation_space.shape); flatten so
+        # multi-dimensional observation spaces work.
+        logits, value = self.logits_and_value(np.asarray(obs).reshape(-1)[None])
         logits = logits[0] - logits[0].max()
         probs = np.exp(logits)
         probs /= probs.sum()

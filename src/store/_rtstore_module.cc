@@ -1,0 +1,404 @@
+// CPython binding for the native shared-memory object store (rts_store.h).
+//
+// Exposes two types:
+//   Store — a created/attached arena; alloc/seal/get/delete/evict/stats.
+//   View  — a buffer-protocol window over one object's payload. A View holds
+//           a pin on the object (and a reference on the Store); deserialized
+//           numpy arrays keep the View alive through the memoryview chain, so
+//           the block cannot be reused under a live zero-copy reader.
+//
+// pybind11 is not available in this environment; the plain CPython C API is.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <string.h>
+#include <unistd.h>
+
+#include "rts_store.h"
+
+namespace {
+
+struct StoreObject {
+  PyObject_HEAD
+  rts_store* handle;
+  int live_views;
+  int want_close;
+  char name[128];
+};
+
+struct ViewObject {
+  PyObject_HEAD
+  StoreObject* store;  // owned reference
+  uint8_t id[RTS_ID_SIZE];
+  uint8_t* ptr;
+  Py_ssize_t size;
+  int readonly;
+  int released;
+};
+
+extern PyTypeObject StoreType;
+extern PyTypeObject ViewType;
+
+void store_do_close(StoreObject* self) {
+  if (self->handle) {
+    rts_close(self->handle);
+    self->handle = nullptr;
+  }
+}
+
+// ---- View ------------------------------------------------------------------
+
+void View_release_pin(ViewObject* v) {
+  if (!v->released) {
+    v->released = 1;
+    if (v->store && v->store->handle) {
+      rts_unpin(v->store->handle, v->id, (int32_t)getpid());
+    }
+    if (v->store) {
+      v->store->live_views -= 1;
+      if (v->store->want_close && v->store->live_views == 0) {
+        store_do_close(v->store);
+      }
+    }
+  }
+}
+
+void View_dealloc(ViewObject* v) {
+  View_release_pin(v);
+  Py_XDECREF((PyObject*)v->store);
+  Py_TYPE(v)->tp_free((PyObject*)v);
+}
+
+int View_getbuffer(ViewObject* v, Py_buffer* view, int flags) {
+  if (v->released || !v->store || !v->store->handle) {
+    PyErr_SetString(PyExc_ValueError, "view released or store closed");
+    return -1;
+  }
+  return PyBuffer_FillInfo(view, (PyObject*)v, v->ptr, v->size, v->readonly,
+                           flags);
+}
+
+PyBufferProcs View_as_buffer = {
+    (getbufferproc)View_getbuffer,
+    nullptr,
+};
+
+PyObject* View_size(ViewObject* v, void*) { return PyLong_FromSsize_t(v->size); }
+
+PyObject* View_releasemeth(ViewObject* v, PyObject*) {
+  View_release_pin(v);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef View_methods[] = {
+    {"release", (PyCFunction)View_releasemeth, METH_NOARGS,
+     "Drop the pin early (the buffer must no longer be accessed)."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyGetSetDef View_getset[] = {
+    {"nbytes", (getter)View_size, nullptr, nullptr, nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr},
+};
+
+PyTypeObject ViewType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+ViewObject* make_view(StoreObject* store, const uint8_t* id, uint64_t off,
+                      uint64_t size, int readonly) {
+  ViewObject* v = PyObject_New(ViewObject, &ViewType);
+  if (!v) return nullptr;
+  Py_INCREF((PyObject*)store);
+  v->store = store;
+  memcpy(v->id, id, RTS_ID_SIZE);
+  v->ptr = rts_base(store->handle) + off;
+  v->size = (Py_ssize_t)size;
+  v->readonly = readonly;
+  v->released = 0;
+  store->live_views += 1;
+  return v;
+}
+
+// ---- Store -----------------------------------------------------------------
+
+void Store_dealloc(StoreObject* self) {
+  store_do_close(self);
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+int parse_id(PyObject* obj, uint8_t* out) {
+  char* buf;
+  Py_ssize_t len;
+  if (PyBytes_AsStringAndSize(obj, &buf, &len) != 0) return -1;
+  if (len != RTS_ID_SIZE) {
+    PyErr_SetString(PyExc_ValueError, "object id must be RTS_ID_SIZE bytes");
+    return -1;
+  }
+  memcpy(out, buf, RTS_ID_SIZE);
+  return 0;
+}
+
+int check_open(StoreObject* self) {
+  if (!self->handle) {
+    PyErr_SetString(PyExc_ValueError, "store is closed");
+    return -1;
+  }
+  return 0;
+}
+
+PyObject* raise_status(int rc) {
+  switch (rc) {
+    case RTS_NOT_FOUND:
+      PyErr_SetString(PyExc_KeyError, "object not found");
+      break;
+    case RTS_EXISTS:
+      PyErr_SetString(PyExc_FileExistsError, "object already exists");
+      break;
+    case RTS_FULL:
+      PyErr_SetString(PyExc_MemoryError, "object store full");
+      break;
+    case RTS_BAD_STATE:
+      PyErr_SetString(PyExc_RuntimeError, "object in wrong state");
+      break;
+    case RTS_TABLE_FULL:
+      PyErr_SetString(PyExc_MemoryError, "object table full");
+      break;
+    default:
+      PyErr_SetString(PyExc_RuntimeError, "object store I/O error");
+  }
+  return nullptr;
+}
+
+PyObject* Store_alloc(StoreObject* self, PyObject* args) {
+  PyObject* id_obj;
+  unsigned long long size;
+  if (!PyArg_ParseTuple(args, "OK", &id_obj, &size)) return nullptr;
+  uint8_t id[RTS_ID_SIZE];
+  if (parse_id(id_obj, id) != 0 || check_open(self) != 0) return nullptr;
+  uint64_t off = 0;
+  int rc = rts_alloc_pin(self->handle, id, size, (int32_t)getpid(), &off);
+  if (rc != RTS_OK) return raise_status(rc);
+  return (PyObject*)make_view(self, id, off, size, /*readonly=*/0);
+}
+
+PyObject* Store_seal(StoreObject* self, PyObject* args) {
+  PyObject* id_obj;
+  if (!PyArg_ParseTuple(args, "O", &id_obj)) return nullptr;
+  uint8_t id[RTS_ID_SIZE];
+  if (parse_id(id_obj, id) != 0 || check_open(self) != 0) return nullptr;
+  int rc = rts_seal(self->handle, id);
+  if (rc != RTS_OK) return raise_status(rc);
+  Py_RETURN_NONE;
+}
+
+PyObject* Store_abort(StoreObject* self, PyObject* args) {
+  PyObject* id_obj;
+  if (!PyArg_ParseTuple(args, "O", &id_obj)) return nullptr;
+  uint8_t id[RTS_ID_SIZE];
+  if (parse_id(id_obj, id) != 0 || check_open(self) != 0) return nullptr;
+  rts_abort(self->handle, id);
+  Py_RETURN_NONE;
+}
+
+PyObject* Store_get(StoreObject* self, PyObject* args) {
+  PyObject* id_obj;
+  if (!PyArg_ParseTuple(args, "O", &id_obj)) return nullptr;
+  uint8_t id[RTS_ID_SIZE];
+  if (parse_id(id_obj, id) != 0 || check_open(self) != 0) return nullptr;
+  uint64_t off = 0, size = 0;
+  int rc = rts_get_pin(self->handle, id, (int32_t)getpid(), &off, &size);
+  if (rc == RTS_NOT_FOUND || rc == RTS_BAD_STATE) Py_RETURN_NONE;
+  if (rc != RTS_OK) return raise_status(rc);
+  return (PyObject*)make_view(self, id, off, size, /*readonly=*/1);
+}
+
+PyObject* Store_contains(StoreObject* self, PyObject* args) {
+  PyObject* id_obj;
+  if (!PyArg_ParseTuple(args, "O", &id_obj)) return nullptr;
+  uint8_t id[RTS_ID_SIZE];
+  if (parse_id(id_obj, id) != 0 || check_open(self) != 0) return nullptr;
+  uint32_t state = 0;
+  int rc = rts_lookup(self->handle, id, nullptr, nullptr, &state);
+  // Sealed (3) or pending-delete (4) objects are readable.
+  if (rc == RTS_OK && (state == 3 || state == 4)) Py_RETURN_TRUE;
+  Py_RETURN_FALSE;
+}
+
+PyObject* Store_delete(StoreObject* self, PyObject* args) {
+  PyObject* id_obj;
+  if (!PyArg_ParseTuple(args, "O", &id_obj)) return nullptr;
+  uint8_t id[RTS_ID_SIZE];
+  if (parse_id(id_obj, id) != 0 || check_open(self) != 0) return nullptr;
+  rts_delete(self->handle, id);
+  Py_RETURN_NONE;
+}
+
+PyObject* Store_evict(StoreObject* self, PyObject* args) {
+  unsigned long long need;
+  int max_n = 256;
+  if (!PyArg_ParseTuple(args, "K|i", &need, &max_n)) return nullptr;
+  if (check_open(self) != 0) return nullptr;
+  if (max_n <= 0) max_n = 1;
+  uint8_t* ids = (uint8_t*)PyMem_Malloc((size_t)max_n * RTS_ID_SIZE);
+  if (!ids) return PyErr_NoMemory();
+  int n = rts_evict(self->handle, need, ids, max_n);
+  PyObject* out = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyList_SET_ITEM(out, i, PyBytes_FromStringAndSize((char*)ids + i * RTS_ID_SIZE, RTS_ID_SIZE));
+  }
+  PyMem_Free(ids);
+  return out;
+}
+
+PyObject* Store_purge_dead_pins(StoreObject* self, PyObject*) {
+  if (check_open(self) != 0) return nullptr;
+  rts_purge_dead_pins(self->handle);
+  Py_RETURN_NONE;
+}
+
+PyObject* Store_used(StoreObject* self, PyObject*) {
+  if (check_open(self) != 0) return nullptr;
+  return PyLong_FromUnsignedLongLong(rts_used(self->handle));
+}
+
+PyObject* Store_capacity(StoreObject* self, PyObject*) {
+  if (check_open(self) != 0) return nullptr;
+  return PyLong_FromUnsignedLongLong(rts_capacity(self->handle));
+}
+
+PyObject* Store_count(StoreObject* self, PyObject*) {
+  if (check_open(self) != 0) return nullptr;
+  return PyLong_FromUnsignedLong(rts_count(self->handle));
+}
+
+PyObject* Store_close(StoreObject* self, PyObject*) {
+  if (self->live_views > 0) {
+    self->want_close = 1;  // deferred until the last View drops its pin
+  } else {
+    store_do_close(self);
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject* Store_name(StoreObject* self, void*) {
+  return PyUnicode_FromString(self->name);
+}
+
+PyMethodDef Store_methods[] = {
+    {"alloc", (PyCFunction)Store_alloc, METH_VARARGS,
+     "alloc(id, size) -> writable View (pinned; seal(id) when written)"},
+    {"seal", (PyCFunction)Store_seal, METH_VARARGS, "seal(id)"},
+    {"abort", (PyCFunction)Store_abort, METH_VARARGS, "abort(id)"},
+    {"get", (PyCFunction)Store_get, METH_VARARGS,
+     "get(id) -> readonly View or None"},
+    {"contains", (PyCFunction)Store_contains, METH_VARARGS, "contains(id)"},
+    {"delete", (PyCFunction)Store_delete, METH_VARARGS, "delete(id)"},
+    {"evict", (PyCFunction)Store_evict, METH_VARARGS,
+     "evict(need_bytes, max_n=256) -> [evicted ids]"},
+    {"purge_dead_pins", (PyCFunction)Store_purge_dead_pins, METH_NOARGS, ""},
+    {"used", (PyCFunction)Store_used, METH_NOARGS, ""},
+    {"capacity", (PyCFunction)Store_capacity, METH_NOARGS, ""},
+    {"count", (PyCFunction)Store_count, METH_NOARGS, ""},
+    {"close", (PyCFunction)Store_close, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyGetSetDef Store_getset[] = {
+    {"name", (getter)Store_name, nullptr, nullptr, nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr},
+};
+
+PyTypeObject StoreType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+StoreObject* make_store(rts_store* handle, const char* name) {
+  StoreObject* s = PyObject_New(StoreObject, &StoreType);
+  if (!s) {
+    rts_close(handle);
+    return nullptr;
+  }
+  s->handle = handle;
+  s->live_views = 0;
+  s->want_close = 0;
+  snprintf(s->name, sizeof(s->name), "%s", name);
+  return s;
+}
+
+// ---- module ----------------------------------------------------------------
+
+PyObject* mod_create(PyObject*, PyObject* args) {
+  const char* name;
+  unsigned long long capacity;
+  unsigned int table_cap = 0;
+  if (!PyArg_ParseTuple(args, "sK|I", &name, &capacity, &table_cap))
+    return nullptr;
+  char err[256] = {0};
+  rts_store* h = rts_create(name, capacity, table_cap, err);
+  if (!h) {
+    PyErr_Format(PyExc_OSError, "rts_create: %s", err);
+    return nullptr;
+  }
+  return (PyObject*)make_store(h, name);
+}
+
+PyObject* mod_attach(PyObject*, PyObject* args) {
+  const char* name;
+  if (!PyArg_ParseTuple(args, "s", &name)) return nullptr;
+  char err[256] = {0};
+  rts_store* h = rts_attach(name, err);
+  if (!h) {
+    PyErr_Format(PyExc_OSError, "rts_attach: %s", err);
+    return nullptr;
+  }
+  return (PyObject*)make_store(h, name);
+}
+
+PyObject* mod_unlink(PyObject*, PyObject* args) {
+  const char* name;
+  if (!PyArg_ParseTuple(args, "s", &name)) return nullptr;
+  rts_unlink(name);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef module_methods[] = {
+    {"create", mod_create, METH_VARARGS,
+     "create(name, capacity, table_cap=0) -> Store"},
+    {"attach", mod_attach, METH_VARARGS, "attach(name) -> Store"},
+    {"unlink", mod_unlink, METH_VARARGS, "unlink(name)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef rtstore_module = {
+    PyModuleDef_HEAD_INIT, "_rtstore",
+    "Native shared-memory object store (plasma-equivalent).", -1,
+    module_methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__rtstore(void) {
+  StoreType.tp_name = "_rtstore.Store";
+  StoreType.tp_basicsize = sizeof(StoreObject);
+  StoreType.tp_dealloc = (destructor)Store_dealloc;
+  StoreType.tp_flags = Py_TPFLAGS_DEFAULT;
+  StoreType.tp_methods = Store_methods;
+  StoreType.tp_getset = Store_getset;
+  ViewType.tp_name = "_rtstore.View";
+  ViewType.tp_basicsize = sizeof(ViewObject);
+  ViewType.tp_dealloc = (destructor)View_dealloc;
+  ViewType.tp_flags = Py_TPFLAGS_DEFAULT;
+  ViewType.tp_as_buffer = &View_as_buffer;
+  ViewType.tp_methods = View_methods;
+  ViewType.tp_getset = View_getset;
+  if (PyType_Ready(&StoreType) < 0 || PyType_Ready(&ViewType) < 0)
+    return nullptr;
+  PyObject* m = PyModule_Create(&rtstore_module);
+  if (!m) return nullptr;
+  Py_INCREF(&StoreType);
+  PyModule_AddObject(m, "Store", (PyObject*)&StoreType);
+  Py_INCREF(&ViewType);
+  PyModule_AddObject(m, "View", (PyObject*)&ViewType);
+  return m;
+}

@@ -1,0 +1,607 @@
+// Implementation of the native shared-memory object store. See rts_store.h.
+#include "rts_store.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545053544f5245ull;  // "RTPSTORE"
+constexpr uint32_t kVersion = 1;
+constexpr uint64_t kAlign = 64;
+constexpr uint64_t kNil = ~0ull;
+constexpr int kPinSlots = 8;
+constexpr uint32_t kDefaultTableCap = 65536;
+
+// Block geometry (offsets relative to the data region, all multiples of 64).
+constexpr uint64_t kBlockHdr = 64;   // header chunk at block start
+constexpr uint64_t kBlockFtr = 64;   // footer chunk at block end
+constexpr uint64_t kBlockOverhead = kBlockHdr + kBlockFtr;
+constexpr uint64_t kMinBlock = kBlockOverhead + kAlign;
+
+enum State : uint32_t {
+  kEmpty = 0,
+  kTomb = 1,
+  kCreated = 2,
+  kSealed = 3,
+  kPendingDelete = 4,
+};
+
+struct PinSlot {
+  int32_t pid;
+  int32_t count;
+};
+
+struct Entry {
+  uint8_t id[RTS_ID_SIZE];
+  uint64_t offset;  // payload offset into the data region
+  uint64_t size;    // user-visible size
+  uint32_t state;
+  uint32_t reserved;
+  uint64_t lru;
+  int64_t pins;  // total pins (including any overflow beyond the slots)
+  PinSlot slots[kPinSlots];
+};
+static_assert(sizeof(Entry) <= 128, "Entry grew past its slot");
+
+struct Header {
+  uint64_t magic;
+  uint32_t version;
+  volatile uint32_t inited;
+  pthread_mutex_t mutex;
+  uint64_t capacity;  // data-region bytes
+  uint64_t used;      // bytes in allocated blocks (incl. overhead)
+  uint64_t lru_tick;
+  uint32_t table_cap;
+  uint32_t count;
+  uint64_t free_head;  // offset of first free block, kNil if none
+  uint64_t table_off;  // from mapping base
+  uint64_t data_off;   // from mapping base
+  uint64_t total_map;  // full mapping size
+};
+
+struct BlockHdr {
+  uint64_t size;  // whole block, incl. header+footer
+  uint64_t free_;
+  uint64_t next;  // free-list links (block offsets), kNil terminated
+  uint64_t prev;
+  uint8_t pad[32];
+};
+static_assert(sizeof(BlockHdr) == kBlockHdr, "block header must be 64B");
+
+struct BlockFtr {
+  uint64_t size;
+  uint64_t free_;
+};
+
+inline uint64_t align_up(uint64_t n) { return (n + kAlign - 1) / kAlign * kAlign; }
+
+}  // namespace
+
+struct rts_store {
+  int fd = -1;
+  uint8_t* map = nullptr;
+  uint64_t map_size = 0;
+  bool creator = false;
+  char name[128] = {0};
+
+  Header* hdr() { return reinterpret_cast<Header*>(map); }
+  Entry* table() { return reinterpret_cast<Entry*>(map + hdr()->table_off); }
+  uint8_t* data() { return map + hdr()->data_off; }
+
+  BlockHdr* block(uint64_t off) {
+    return reinterpret_cast<BlockHdr*>(data() + off);
+  }
+  BlockFtr* footer(uint64_t off) {
+    BlockHdr* b = block(off);
+    return reinterpret_cast<BlockFtr*>(data() + off + b->size - sizeof(BlockFtr));
+  }
+};
+
+namespace {
+
+void set_err(char* err, const char* msg) {
+  if (err) snprintf(err, 256, "%s (errno=%d %s)", msg, errno, strerror(errno));
+}
+
+// Robust lock: if the previous holder died mid-critical-section, take
+// ownership and mark the mutex consistent. The metadata is updated with
+// small, ordered writes so a torn update at worst leaks a block.
+void lock(rts_store* s) {
+  int rc = pthread_mutex_lock(&s->hdr()->mutex);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&s->hdr()->mutex);
+}
+void unlock(rts_store* s) { pthread_mutex_unlock(&s->hdr()->mutex); }
+
+uint64_t hash_id(const uint8_t* id) {
+  // Objects created by one task share a 16-byte prefix and differ only in
+  // the trailing 4-byte index, so mix both ends of the id.
+  uint64_t a, b;
+  memcpy(&a, id, sizeof(a));
+  memcpy(&b, id + RTS_ID_SIZE - sizeof(b), sizeof(b));
+  uint64_t h = (a ^ (b * 0x9E3779B97F4A7C15ull));
+  return h ? h : 1;
+}
+
+Entry* find_entry(rts_store* s, const uint8_t* id) {
+  Header* h = s->hdr();
+  Entry* t = s->table();
+  uint64_t cap = h->table_cap;
+  uint64_t i = hash_id(id) % cap;
+  for (uint64_t probes = 0; probes < cap; ++probes, i = (i + 1) % cap) {
+    Entry* e = &t[i];
+    if (e->state == kEmpty) return nullptr;
+    if (e->state != kTomb && memcmp(e->id, id, RTS_ID_SIZE) == 0) return e;
+  }
+  return nullptr;
+}
+
+Entry* insert_entry(rts_store* s, const uint8_t* id) {
+  Header* h = s->hdr();
+  Entry* t = s->table();
+  uint64_t cap = h->table_cap;
+  uint64_t i = hash_id(id) % cap;
+  Entry* slot = nullptr;
+  for (uint64_t probes = 0; probes < cap; ++probes, i = (i + 1) % cap) {
+    Entry* e = &t[i];
+    if (e->state == kEmpty) {
+      if (!slot) slot = e;
+      break;
+    }
+    if (e->state == kTomb) {
+      if (!slot) slot = e;
+      continue;
+    }
+    if (memcmp(e->id, id, RTS_ID_SIZE) == 0) return nullptr;  // exists
+  }
+  if (!slot) return nullptr;  // table full
+  memset(slot, 0, sizeof(Entry));
+  memcpy(slot->id, id, RTS_ID_SIZE);
+  return slot;
+}
+
+// ---- free-list allocator ---------------------------------------------------
+
+void freelist_remove(rts_store* s, uint64_t off) {
+  Header* h = s->hdr();
+  BlockHdr* b = s->block(off);
+  if (b->prev != kNil)
+    s->block(b->prev)->next = b->next;
+  else
+    h->free_head = b->next;
+  if (b->next != kNil) s->block(b->next)->prev = b->prev;
+}
+
+void freelist_push(rts_store* s, uint64_t off) {
+  Header* h = s->hdr();
+  BlockHdr* b = s->block(off);
+  b->free_ = 1;
+  b->prev = kNil;
+  b->next = h->free_head;
+  if (h->free_head != kNil) s->block(h->free_head)->prev = off;
+  h->free_head = off;
+  BlockFtr* f = s->footer(off);
+  f->size = b->size;
+  f->free_ = 1;
+}
+
+void write_used(rts_store* s, uint64_t off, uint64_t size) {
+  BlockHdr* b = s->block(off);
+  b->size = size;
+  b->free_ = 0;
+  b->next = b->prev = kNil;
+  BlockFtr* f = s->footer(off);
+  f->size = size;
+  f->free_ = 0;
+}
+
+// Returns block offset or kNil. First-fit with split.
+uint64_t alloc_block(rts_store* s, uint64_t payload) {
+  Header* h = s->hdr();
+  uint64_t need = kBlockOverhead + align_up(payload);
+  for (uint64_t off = h->free_head; off != kNil; off = s->block(off)->next) {
+    BlockHdr* b = s->block(off);
+    if (b->size < need) continue;
+    freelist_remove(s, off);
+    uint64_t rem = b->size - need;
+    if (rem >= kMinBlock) {
+      write_used(s, off, need);
+      uint64_t rest = off + need;
+      s->block(rest)->size = rem;
+      freelist_push(s, rest);
+    } else {
+      write_used(s, off, b->size);
+      need = b->size;
+    }
+    h->used += need;
+    return off;
+  }
+  return kNil;
+}
+
+void free_block(rts_store* s, uint64_t off) {
+  Header* h = s->hdr();
+  BlockHdr* b = s->block(off);
+  h->used -= b->size;
+  uint64_t start = off, size = b->size;
+  // Coalesce with previous physical block.
+  if (start > 0) {
+    BlockFtr* pf = reinterpret_cast<BlockFtr*>(s->data() + start - sizeof(BlockFtr));
+    if (pf->free_) {
+      uint64_t prev_off = start - pf->size;
+      freelist_remove(s, prev_off);
+      start = prev_off;
+      size += pf->size;
+    }
+  }
+  // Coalesce with next physical block.
+  uint64_t next_off = off + b->size;
+  if (next_off < h->capacity) {
+    BlockHdr* nb = s->block(next_off);
+    if (nb->free_) {
+      freelist_remove(s, next_off);
+      size += nb->size;
+    }
+  }
+  s->block(start)->size = size;
+  freelist_push(s, start);
+}
+
+bool pid_alive(int32_t pid) {
+  if (pid <= 0) return false;
+  return kill(pid, 0) == 0 || errno != ESRCH;
+}
+
+void drop_dead_pins(Entry* e) {
+  for (int i = 0; i < kPinSlots; ++i) {
+    if (e->slots[i].pid != 0 && !pid_alive(e->slots[i].pid)) {
+      e->pins -= e->slots[i].count;
+      e->slots[i].pid = 0;
+      e->slots[i].count = 0;
+    }
+  }
+  if (e->pins < 0) e->pins = 0;
+}
+
+void release_entry(rts_store* s, Entry* e) {
+  free_block(s, e->offset - kBlockHdr);
+  e->state = kTomb;
+  s->hdr()->count -= 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+rts_store* rts_create(const char* name, uint64_t capacity, uint32_t table_cap,
+                      char* err) {
+  if (table_cap == 0) table_cap = kDefaultTableCap;
+  capacity = align_up(capacity);
+  uint64_t table_bytes = align_up(uint64_t(table_cap) * sizeof(Entry));
+  uint64_t hdr_bytes = align_up(sizeof(Header));
+  uint64_t total = hdr_bytes + table_bytes + capacity;
+
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    set_err(err, "shm_open failed");
+    return nullptr;
+  }
+  if (ftruncate(fd, (off_t)total) != 0) {
+    set_err(err, "ftruncate failed");
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (map == MAP_FAILED) {
+    set_err(err, "mmap failed");
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  rts_store* s = new rts_store();
+  s->fd = fd;
+  s->map = static_cast<uint8_t*>(map);
+  s->map_size = total;
+  s->creator = true;
+  snprintf(s->name, sizeof(s->name), "%s", name);
+
+  Header* h = s->hdr();
+  memset(h, 0, sizeof(Header));
+  h->magic = kMagic;
+  h->version = kVersion;
+  h->capacity = capacity;
+  h->table_cap = table_cap;
+  h->table_off = hdr_bytes;
+  h->data_off = hdr_bytes + table_bytes;
+  h->total_map = total;
+  h->free_head = kNil;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  // One big free block spans the whole data region.
+  s->block(0)->size = capacity;
+  freelist_push(s, 0);
+
+  __atomic_store_n(&h->inited, 1, __ATOMIC_RELEASE);
+  return s;
+}
+
+rts_store* rts_attach(const char* name, char* err) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) {
+    set_err(err, "shm_open(attach) failed");
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)sizeof(Header)) {
+    set_err(err, "fstat failed or store too small");
+    close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (map == MAP_FAILED) {
+    set_err(err, "mmap(attach) failed");
+    close(fd);
+    return nullptr;
+  }
+  rts_store* s = new rts_store();
+  s->fd = fd;
+  s->map = static_cast<uint8_t*>(map);
+  s->map_size = st.st_size;
+  s->creator = false;
+  snprintf(s->name, sizeof(s->name), "%s", name);
+
+  Header* h = s->hdr();
+  for (int spin = 0; spin < 10000; ++spin) {
+    if (__atomic_load_n(&h->inited, __ATOMIC_ACQUIRE) == 1) break;
+    usleep(100);
+  }
+  if (h->magic != kMagic || !h->inited) {
+    set_err(err, "store not initialized or bad magic");
+    rts_close(s);
+    return nullptr;
+  }
+  return s;
+}
+
+void rts_close(rts_store* s) {
+  if (!s) return;
+  if (s->map) munmap(s->map, s->map_size);
+  if (s->fd >= 0) close(s->fd);
+  delete s;
+}
+
+int rts_unlink(const char* name) { return shm_unlink(name) == 0 ? RTS_OK : RTS_IO; }
+
+static void add_pin(Entry* e, int32_t pid) {
+  e->pins += 1;
+  for (int i = 0; i < kPinSlots; ++i) {
+    if (e->slots[i].pid == pid) {
+      e->slots[i].count += 1;
+      return;
+    }
+  }
+  for (int i = 0; i < kPinSlots; ++i) {
+    if (e->slots[i].pid == 0) {
+      e->slots[i].pid = pid;
+      e->slots[i].count = 1;
+      return;
+    }
+  }
+  // Slots full: the pin still counts in e->pins but can't be reclaimed if
+  // this pid dies. Bounded risk; 8 concurrent pinning pids per object.
+}
+
+int rts_alloc_pin(rts_store* s, const uint8_t* id, uint64_t size, int32_t pid,
+                  uint64_t* off) {
+  lock(s);
+  if (find_entry(s, id)) {
+    unlock(s);
+    return RTS_EXISTS;
+  }
+  uint64_t boff = alloc_block(s, size);
+  if (boff == kNil) {
+    unlock(s);
+    return RTS_FULL;
+  }
+  Entry* e = insert_entry(s, id);
+  if (!e) {
+    free_block(s, boff);
+    unlock(s);
+    return RTS_TABLE_FULL;
+  }
+  e->offset = boff + kBlockHdr;
+  e->size = size;
+  e->state = kCreated;
+  e->lru = ++s->hdr()->lru_tick;
+  add_pin(e, pid);
+  s->hdr()->count += 1;
+  *off = e->offset;
+  unlock(s);
+  return RTS_OK;
+}
+
+int rts_seal(rts_store* s, const uint8_t* id) {
+  lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e) {
+    unlock(s);
+    return RTS_NOT_FOUND;
+  }
+  if (e->state != kCreated) {
+    unlock(s);
+    return RTS_BAD_STATE;
+  }
+  e->state = kSealed;
+  unlock(s);
+  return RTS_OK;
+}
+
+int rts_abort(rts_store* s, const uint8_t* id) {
+  lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e) {
+    unlock(s);
+    return RTS_NOT_FOUND;
+  }
+  if (e->state != kCreated) {
+    unlock(s);
+    return RTS_BAD_STATE;
+  }
+  release_entry(s, e);
+  unlock(s);
+  return RTS_OK;
+}
+
+int rts_get_pin(rts_store* s, const uint8_t* id, int32_t pid, uint64_t* off,
+                uint64_t* size) {
+  lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e) {
+    unlock(s);
+    return RTS_NOT_FOUND;
+  }
+  if (e->state != kSealed && e->state != kPendingDelete) {
+    unlock(s);
+    return RTS_BAD_STATE;
+  }
+  add_pin(e, pid);
+  e->lru = ++s->hdr()->lru_tick;
+  *off = e->offset;
+  *size = e->size;
+  unlock(s);
+  return RTS_OK;
+}
+
+int rts_lookup(rts_store* s, const uint8_t* id, uint64_t* off, uint64_t* size,
+               uint32_t* state) {
+  lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e) {
+    unlock(s);
+    return RTS_NOT_FOUND;
+  }
+  if (off) *off = e->offset;
+  if (size) *size = e->size;
+  if (state) *state = e->state;
+  unlock(s);
+  return RTS_OK;
+}
+
+int rts_unpin(rts_store* s, const uint8_t* id, int32_t pid) {
+  lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e) {
+    unlock(s);
+    return RTS_NOT_FOUND;
+  }
+  for (int i = 0; i < kPinSlots; ++i) {
+    if (e->slots[i].pid == pid) {
+      e->slots[i].count -= 1;
+      if (e->slots[i].count <= 0) {
+        e->slots[i].pid = 0;
+        e->slots[i].count = 0;
+      }
+      break;
+    }
+  }
+  if (e->pins > 0) e->pins -= 1;
+  if (e->pins == 0 && e->state == kPendingDelete) release_entry(s, e);
+  unlock(s);
+  return RTS_OK;
+}
+
+int rts_delete(rts_store* s, const uint8_t* id) {
+  lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e) {
+    unlock(s);
+    return RTS_NOT_FOUND;
+  }
+  drop_dead_pins(e);
+  if (e->pins > 0) {
+    e->state = kPendingDelete;
+    unlock(s);
+    return RTS_OK;
+  }
+  release_entry(s, e);
+  unlock(s);
+  return RTS_OK;
+}
+
+int rts_evict(rts_store* s, uint64_t need, uint8_t* out_ids, int max_n) {
+  lock(s);
+  Header* h = s->hdr();
+  Entry* t = s->table();
+  std::vector<Entry*> candidates;
+  for (uint32_t i = 0; i < h->table_cap; ++i) {
+    Entry* e = &t[i];
+    if (e->state != kSealed) continue;
+    drop_dead_pins(e);
+    if (e->pins == 0) candidates.push_back(e);
+  }
+  std::sort(candidates.begin(), candidates.end(),
+            [](Entry* a, Entry* b) { return a->lru < b->lru; });
+  uint64_t freed = 0;
+  int n = 0;
+  for (Entry* e : candidates) {
+    if (freed >= need || n >= max_n) break;
+    freed += e->size + kBlockOverhead;
+    memcpy(out_ids + n * RTS_ID_SIZE, e->id, RTS_ID_SIZE);
+    release_entry(s, e);
+    ++n;
+  }
+  unlock(s);
+  return n;
+}
+
+void rts_purge_dead_pins(rts_store* s) {
+  lock(s);
+  Header* h = s->hdr();
+  Entry* t = s->table();
+  for (uint32_t i = 0; i < h->table_cap; ++i) {
+    Entry* e = &t[i];
+    if (e->state == kCreated || e->state == kSealed ||
+        e->state == kPendingDelete) {
+      drop_dead_pins(e);
+      if (e->pins == 0 && e->state == kPendingDelete) release_entry(s, e);
+    }
+  }
+  unlock(s);
+}
+
+uint64_t rts_used(rts_store* s) {
+  lock(s);
+  uint64_t u = s->hdr()->used;
+  unlock(s);
+  return u;
+}
+
+uint64_t rts_capacity(rts_store* s) { return s->hdr()->capacity; }
+
+uint32_t rts_count(rts_store* s) {
+  lock(s);
+  uint32_t c = s->hdr()->count;
+  unlock(s);
+  return c;
+}
+
+uint8_t* rts_base(rts_store* s) { return s->map + s->hdr()->data_off; }
+
+}  // extern "C"

@@ -1,0 +1,108 @@
+// Assert-based unit test for the native store (run via `make native-test`).
+#include "rts_store.h"
+
+#include <assert.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static void mkid(uint8_t* id, int n) {
+  memset(id, 0, RTS_ID_SIZE);
+  memcpy(id, &n, sizeof(n));
+  id[RTS_ID_SIZE - 1] = 0xAB;  // non-zero tail so hash != 0 for n == 0
+}
+
+int main() {
+  char name[64];
+  snprintf(name, sizeof(name), "/rts-test-%d", (int)getpid());
+  char err[256];
+  rts_store* s = rts_create(name, 1 << 20, 1024, err);
+  assert(s && "create failed");
+
+  uint8_t id1[RTS_ID_SIZE], id2[RTS_ID_SIZE], id3[RTS_ID_SIZE];
+  mkid(id1, 1);
+  mkid(id2, 2);
+  mkid(id3, 3);
+  int32_t pid = (int32_t)getpid();
+
+  // Alloc + write + seal + get round trip.
+  uint64_t off = 0, size = 0;
+  assert(rts_alloc_pin(s, id1, 1000, pid, &off) == RTS_OK);
+  memset(rts_base(s) + off, 0x5A, 1000);
+  assert(rts_get_pin(s, id1, pid, &off, &size) == RTS_BAD_STATE);  // unsealed
+  assert(rts_seal(s, id1) == RTS_OK);
+  assert(rts_unpin(s, id1, pid) == RTS_OK);  // drop creator pin
+  assert(rts_get_pin(s, id1, pid, &off, &size) == RTS_OK);
+  assert(size == 1000);
+  assert(rts_base(s)[off] == 0x5A && rts_base(s)[off + 999] == 0x5A);
+  assert((off % 64) == 0 && "payload must be 64B aligned");
+
+  // Duplicate alloc rejected.
+  uint64_t off2;
+  assert(rts_alloc_pin(s, id1, 10, pid, &off2) == RTS_EXISTS);
+
+  // Delete defers while pinned, frees after unpin.
+  assert(rts_delete(s, id1) == RTS_OK);
+  assert(rts_count(s) == 1);  // still pending
+  assert(rts_unpin(s, id1, pid) == RTS_OK);
+  assert(rts_count(s) == 0);
+  uint64_t used_after_free = rts_used(s);
+  assert(used_after_free == 0);
+
+  // Fill / coalesce: allocate three, free middle, then re-alloc bigger than
+  // a single fragment to force coalescing correctness.
+  assert(rts_alloc_pin(s, id1, 4096, pid, &off) == RTS_OK);
+  assert(rts_alloc_pin(s, id2, 4096, pid, &off) == RTS_OK);
+  assert(rts_alloc_pin(s, id3, 4096, pid, &off) == RTS_OK);
+  rts_seal(s, id1);
+  rts_seal(s, id2);
+  rts_seal(s, id3);
+  rts_unpin(s, id1, pid);
+  rts_unpin(s, id2, pid);
+  rts_unpin(s, id3, pid);
+  assert(rts_delete(s, id2) == RTS_OK);
+  assert(rts_delete(s, id1) == RTS_OK);  // coalesce with freed id2 block
+  uint8_t id4[RTS_ID_SIZE];
+  mkid(id4, 4);
+  assert(rts_alloc_pin(s, id4, 8192, pid, &off) == RTS_OK);  // fits coalesced
+  rts_seal(s, id4);
+  rts_unpin(s, id4, pid);
+
+  // Eviction: free everything via LRU eviction.
+  uint8_t evicted[RTS_ID_SIZE * 16];
+  int n = rts_evict(s, 1 << 20, evicted, 16);
+  assert(n == 2);  // id3 then id4 (id3 older)
+  assert(memcmp(evicted, id3, RTS_ID_SIZE) == 0);
+  assert(rts_count(s) == 0);
+
+  // Cross-process: child attaches, writes an object; parent reads it.
+  uint8_t idx[RTS_ID_SIZE];
+  mkid(idx, 99);
+  pid_t child = fork();
+  if (child == 0) {
+    rts_store* c = rts_attach(name, err);
+    if (!c) _exit(1);
+    uint64_t o;
+    if (rts_alloc_pin(c, idx, 64, (int32_t)getpid(), &o) != RTS_OK) _exit(2);
+    memset(rts_base(c) + o, 0x77, 64);
+    if (rts_seal(c, idx) != RTS_OK) _exit(3);
+    // Exit WITHOUT unpinning: parent must reclaim the dead pid's pin.
+    _exit(0);
+  }
+  int status = 0;
+  waitpid(child, &status, 0);
+  assert(WIFEXITED(status) && WEXITSTATUS(status) == 0);
+  assert(rts_get_pin(s, idx, pid, &off, &size) == RTS_OK);
+  assert(size == 64 && rts_base(s)[off] == 0x77);
+  rts_unpin(s, idx, pid);
+  // The dead child's creator pin blocks delete until purged.
+  rts_delete(s, idx);
+  rts_purge_dead_pins(s);
+  assert(rts_count(s) == 0);
+
+  rts_close(s);
+  rts_unlink(name);
+  printf("rts_store_test: OK\n");
+  return 0;
+}
