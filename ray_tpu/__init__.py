@@ -38,6 +38,10 @@ from .core import (  # noqa: F401
     shutdown,
     wait,
 )
+from .core import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+)
 
 __all__ = [
     "__version__",

@@ -1,0 +1,155 @@
+"""Single-machine multi-node test cluster.
+
+Mirrors the reference's workhorse distributed-test pattern (ref:
+python/ray/cluster_utils.py:108 Cluster — ``add_node`` at :174 starts extra
+raylet+plasma processes on the same machine; killing a node =
+``remove_node``). Here ``add_node`` spawns a ``ray_tpu.core.node_main``
+process that registers with the head's GCS; ``remove_node`` kills it (and
+its worker subprocesses), which the GCS detects as node death.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from . import core as _core  # noqa: F401  (ensures package import order)
+import ray_tpu
+
+
+@dataclass
+class NodeHandle:
+    proc: subprocess.Popen
+    session_dir: str
+    resources: Dict[str, float]
+    node_id_hex: Optional[str] = None
+
+
+class Cluster:
+    """Start a head (in-process driver) plus N simulated nodes."""
+
+    def __init__(
+        self,
+        head_resources: Optional[Dict[str, float]] = None,
+        system_config: Optional[Dict] = None,
+    ):
+        res = dict(head_resources or {"CPU": 2})
+        num_cpus = res.pop("CPU", 2)
+        self._driver = ray_tpu.init(
+            num_cpus=int(num_cpus),
+            resources=res or None,
+            system_config=system_config,
+        )
+        nm = self._driver._nm
+        assert nm.gcs_service is not None, "head must host the GCS"
+        host, port = nm.gcs_service.address
+        self.gcs_address = f"{host}:{port}"
+        self.head_node_id = nm.node_id.hex()
+        self._nodes: list[NodeHandle] = []
+
+    # ------------------------------------------------------------------ nodes
+
+    def add_node(
+        self,
+        *,
+        num_cpus: float = 1,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        wait: bool = True,
+    ) -> NodeHandle:
+        res = dict(resources or {})
+        res["CPU"] = num_cpus
+        session_dir = os.path.join(
+            tempfile.gettempdir(),
+            "ray_tpu",
+            f"node-{int(time.time())}-{uuid.uuid4().hex[:8]}",
+        )
+        os.makedirs(session_dir, exist_ok=True)
+        env = dict(os.environ)
+        env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
+        env["RAY_TPU_SESSION_DIR"] = session_dir
+        env["RAY_TPU_RESOURCES"] = json.dumps(res)
+        env["RAY_TPU_NODE_LABELS"] = json.dumps(labels or {})
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        existing_pp = env.get("PYTHONPATH", "")
+        if pkg_root not in existing_pp.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + existing_pp if existing_pp else "")
+            )
+        log = open(os.path.join(session_dir, "node.log"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_main"],
+            env=env,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        log.close()
+        handle = NodeHandle(proc=proc, session_dir=session_dir, resources=res)
+        self._nodes.append(handle)
+        if wait:
+            self.wait_for_nodes(len(self._nodes) + 1)
+            handle.node_id_hex = self._latest_node_id(exclude_known=True)
+        return handle
+
+    def _latest_node_id(self, exclude_known: bool = False) -> Optional[str]:
+        known = {self.head_node_id} | {
+            h.node_id_hex for h in self._nodes if h.node_id_hex
+        }
+        for view in self._driver.nodes():
+            if view["state"] == "alive" and view["node_id"] not in known:
+                return view["node_id"]
+        return None
+
+    def wait_for_nodes(self, count: int, timeout: float = 30.0):
+        """Block until ``count`` nodes (head included) are alive."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            alive = [
+                v for v in self._driver.nodes() if v["state"] == "alive"
+            ]
+            if len(alive) >= count:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"cluster did not reach {count} nodes within {timeout}s"
+        )
+
+    def remove_node(self, handle: NodeHandle, *, graceful: bool = False):
+        """Kill a node's process tree; the GCS notices the closed
+        connection and broadcasts node death (the chaos-test primitive —
+        ref analogue: Cluster.remove_node + kill_raylet)."""
+        self._nodes = [h for h in self._nodes if h is not handle]
+        try:
+            if graceful:
+                handle.proc.terminate()
+            else:
+                # Kill the whole process group (node manager + its workers).
+                os.killpg(os.getpgid(handle.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            handle.proc.wait(timeout=10)
+        except Exception:
+            handle.proc.kill()
+
+    # --------------------------------------------------------------- teardown
+
+    def shutdown(self):
+        for handle in list(self._nodes):
+            self.remove_node(handle)
+        ray_tpu.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

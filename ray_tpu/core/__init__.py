@@ -29,3 +29,7 @@ from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID  # noqa: F40
 from .reference import ObjectRef  # noqa: F401
 from .remote_function import RemoteFunction  # noqa: F401
 from .runtime_context import get_runtime_context  # noqa: F401
+from .scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+)

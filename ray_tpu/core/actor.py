@@ -111,6 +111,7 @@ class ActorClass:
             actor_id=actor_id,
             max_restarts=max_restarts,
             max_concurrency=self._options.get("max_concurrency", 1),
+            scheduling_strategy=self._options.get("scheduling_strategy"),
         )
         rt.submit(spec)
         del keepalive

@@ -163,11 +163,26 @@ def available_resources() -> Dict[str, float]:
 
 
 def nodes():
+    """Cluster node table (ref analogue: ray.nodes() backed by
+    GlobalStateAccessor over the GCS node table)."""
     rt = runtime_context.current_runtime()
+    views = getattr(rt, "nodes", None)
+    if views is None:
+        return [
+            {
+                "NodeID": rt.node_id.hex(),
+                "Alive": True,
+                "Resources": rt.cluster_resources(),
+            }
+        ]
     return [
         {
-            "NodeID": rt.node_id.hex(),
-            "Alive": True,
-            "Resources": rt.cluster_resources(),
+            "NodeID": v["node_id"],
+            "Alive": v["state"] == "alive",
+            "Resources": v["resources_total"],
+            "IsHead": v.get("is_head", False),
+            "Host": v.get("host"),
+            "Labels": v.get("labels", {}),
         }
+        for v in rt.nodes()
     ]

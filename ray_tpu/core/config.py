@@ -62,6 +62,22 @@ class Config:
     # Use the native C++ shared-memory arena store (src/store/) when the
     # extension is importable/buildable; pure-Python per-object shm otherwise.
     use_native_store: bool = True
+    # --- cluster plane (GCS + peer federation) -----------------------------
+    # Load-report period from each node to the GCS (ref analogue:
+    # raylet_report_resources_period_ms via the RaySyncer).
+    heartbeat_interval_s: float = 0.25
+    # GCS health sweep period (ref: GcsHealthCheckManager check interval).
+    gcs_health_check_period_s: float = 0.5
+    # Heartbeats missed for this long -> node marked dead (ref:
+    # health_check_failure_threshold * period).
+    node_death_timeout_s: float = 3.0
+    # Max times a task may be spilled back between nodes before it must queue
+    # where it is (ref analogue: bounded spillback in hybrid policy).
+    max_task_spillback: int = 4
+    # How long a directory miss waits for a location to appear in the GCS
+    # object directory before raising ObjectLostError. Generous because a
+    # miss may just mean the producing task is still running on its node.
+    object_locate_timeout_s: float = 30.0
 
     def __post_init__(self):
         for f in dataclasses.fields(self):

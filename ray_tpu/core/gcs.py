@@ -1,0 +1,612 @@
+"""GCS-equivalent cluster control plane.
+
+Plays the role of the reference's GCS server (ref: src/ray/gcs/gcs_server/
+gcs_server.h — GcsNodeManager, GcsActorManager's name registry, InternalKV
+via gcs_kv_manager.h, GcsHealthCheckManager) plus the resource-usage gossip
+of the RaySyncer (ref: src/ray/common/ray_syncer/ray_syncer.h:88). One
+instance runs on the head node's event loop; remote node managers connect
+over TCP with the same framed-pickle protocol the workers use and exchange:
+
+- node registration / heartbeat load reports (→ broadcast cluster view)
+- cluster KV (function table, user KV, rendezvous)
+- global named-actor registry and actor→node directory
+- object→node location directory for cross-node borrows
+- node-death broadcast (connection close or missed heartbeats)
+
+The head node manager talks to the same tables through ``LocalGcsHandle``
+(direct coroutine calls, no socket); remote nodes use ``RemoteGcsHandle``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+from .config import Config
+from .ids import ActorID, NodeID, ObjectID
+from .protocol import AioFramedWriter as _FramedWriter
+from .protocol import aio_read_frame as _read_frame
+
+
+@dataclass
+class NodeEntry:
+    """GCS-side record of one node (ref analogue: GcsNodeInfo in
+    gcs.proto + the per-node NodeState the syncer versions)."""
+
+    node_id: NodeID
+    host: str
+    peer_port: int
+    resources_total: Dict[str, float]
+    resources_available: Dict[str, float] = field(default_factory=dict)
+    pending_tasks: int = 0
+    is_head: bool = False
+    state: str = "alive"  # alive | dead
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def view(self) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id.hex(),
+            "host": self.host,
+            "peer_port": self.peer_port,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "pending_tasks": self.pending_tasks,
+            "is_head": self.is_head,
+            "state": self.state,
+            "labels": self.labels,
+        }
+
+
+class GcsService:
+    """The control-plane tables + TCP server. Lives on the head node
+    manager's asyncio loop; every public coroutine is loop-thread-only."""
+
+    def __init__(self, config: Config, loop: asyncio.AbstractEventLoop):
+        self.config = config
+        self._loop = loop
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.address: Optional[Tuple[str, int]] = None
+
+        self._nodes: Dict[NodeID, NodeEntry] = {}
+        self._conns: Dict[NodeID, _FramedWriter] = {}
+        self._kv: Dict[str, bytes] = {}
+        self._kv_events: Dict[str, asyncio.Event] = {}
+        self._functions: Dict[str, bytes] = {}
+        # name -> (actor_id, node_id, creation_spec)
+        self._named_actors: Dict[str, Tuple[ActorID, NodeID, Any]] = {}
+        self._actor_nodes: Dict[ActorID, NodeID] = {}
+        self._object_nodes: Dict[ObjectID, NodeID] = {}
+        self._object_events: Dict[ObjectID, asyncio.Event] = {}
+        self._job_counter = 0
+
+        # Callbacks into the head node manager (same loop, no locking).
+        self.on_node_added: Optional[Callable[[NodeEntry], None]] = None
+        self.on_node_dead: Optional[Callable[[NodeEntry], None]] = None
+        self.on_load_update: Optional[Callable[[Dict[str, Any]], None]] = None
+
+        self._health_task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------------ boot
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = await asyncio.start_server(
+            self._handle_connection, host=host, port=port
+        )
+        sock = self._server.sockets[0]
+        self.address = sock.getsockname()[:2]
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        # One coalesced cluster-view broadcast per interval, not one per
+        # received heartbeat (which would be O(n^2) messages per interval).
+        self._broadcast_task = asyncio.ensure_future(self._broadcast_loop())
+
+    async def _broadcast_loop(self):
+        while True:
+            await asyncio.sleep(self.config.heartbeat_interval_s)
+            if self._conns or self.on_load_update is not None:
+                await self._broadcast_load()
+
+    def stop(self):
+        if self._health_task is not None:
+            self._health_task.cancel()
+        if getattr(self, "_broadcast_task", None) is not None:
+            self._broadcast_task.cancel()
+        if self._server is not None:
+            self._server.close()
+        for conn in self._conns.values():
+            conn.close()
+
+    # --------------------------------------------------------------- serving
+
+    async def _handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ):
+        framed = _FramedWriter(writer)
+        node_id: Optional[NodeID] = None
+        try:
+            hello = await _read_frame(reader)
+            if hello.get("type") != "gcs_hello":
+                framed.close()
+                return
+            node_id = NodeID.from_hex(hello["node_id"])
+            self._conns[node_id] = framed
+            await framed.send({"type": "gcs_welcome"})
+            while True:
+                msg = await _read_frame(reader)
+                reply = await self._dispatch(node_id, msg)
+                if reply is not None:
+                    reply["type"] = "reply"
+                    reply["msg_id"] = msg.get("msg_id")
+                    await framed.send(reply)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            framed.close()
+            if node_id is not None:
+                self._conns.pop(node_id, None)
+                entry = self._nodes.get(node_id)
+                if entry is not None and entry.state == "alive":
+                    await self._mark_node_dead(entry, "connection closed")
+
+    async def _dispatch(
+        self, node_id: NodeID, msg: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        op = msg["op"]
+        if op == "register_node":
+            return await self.register_node(
+                node_id,
+                msg["host"],
+                msg["peer_port"],
+                msg["resources"],
+                labels=msg.get("labels") or {},
+            )
+        if op == "heartbeat":
+            self.heartbeat(node_id, msg["available"], msg["pending"])
+            return None  # fire-and-forget
+        if op == "kv_put":
+            added = self.kv_put(msg["key"], msg["value"], msg.get("overwrite", True))
+            return {"added": added}
+        if op == "kv_get":
+            if msg.get("wait_timeout"):
+                value = await self.kv_wait(msg["key"], msg["wait_timeout"])
+            else:
+                value = self._kv.get(msg["key"])
+            return {"value": value}
+        if op == "kv_del":
+            return {"deleted": self._kv.pop(msg["key"], None) is not None}
+        if op == "kv_keys":
+            prefix = msg.get("prefix", "")
+            return {"keys": [k for k in self._kv if k.startswith(prefix)]}
+        if op == "register_function":
+            self._functions[msg["function_id"]] = msg["blob"]
+            return {"ok": True}
+        if op == "fetch_function":
+            return {"blob": self._functions.get(msg["function_id"])}
+        if op == "register_named_actor":
+            ok = self.register_named_actor(
+                msg["name"],
+                ActorID.from_hex(msg["actor_id"]),
+                NodeID.from_hex(msg["node_id"]),
+                msg["spec"],
+            )
+            return {"added": ok}
+        if op == "get_named_actor":
+            entry = self._named_actors.get(msg["name"])
+            if entry is None:
+                return {"found": False}
+            aid, nid, spec = entry
+            return {
+                "found": True,
+                "actor_id": aid.hex(),
+                "node_id": nid.hex(),
+                "spec": spec,
+            }
+        if op == "drop_named_actor":
+            cur = self._named_actors.get(msg["name"])
+            if cur is not None and cur[0].hex() == msg["actor_id"]:
+                self._named_actors.pop(msg["name"], None)
+            return None
+        if op == "register_actor_node":
+            self._actor_nodes[ActorID.from_hex(msg["actor_id"])] = NodeID.from_hex(
+                msg["node_id"]
+            )
+            return None
+        if op == "get_actor_node":
+            nid = self._actor_nodes.get(ActorID.from_hex(msg["actor_id"]))
+            return {"node_id": nid.hex() if nid else None}
+        if op == "publish_object":
+            self.publish_object(msg["object_id"], node_id)
+            return None
+        if op == "unpublish_object":
+            self._object_nodes.pop(msg["object_id"], None)
+            return None
+        if op == "locate_object":
+            nid = await self.locate_object(msg["object_id"], msg.get("timeout", 0))
+            return {"node_id": nid.hex() if nid else None}
+        if op == "get_nodes":
+            return {"nodes": [e.view() for e in self._nodes.values()]}
+        raise RuntimeError(f"unknown GCS op {op}")
+
+    # ----------------------------------------------------------------- nodes
+
+    async def register_node(
+        self,
+        node_id: NodeID,
+        host: str,
+        peer_port: int,
+        resources: Dict[str, float],
+        *,
+        is_head: bool = False,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> Dict[str, Any]:
+        entry = NodeEntry(
+            node_id=node_id,
+            host=host,
+            peer_port=peer_port,
+            resources_total=dict(resources),
+            resources_available=dict(resources),
+            is_head=is_head,
+            labels=labels or {},
+        )
+        self._nodes[node_id] = entry
+        await self._broadcast(
+            {"type": "node_added", "node": entry.view()}, exclude=node_id
+        )
+        if self.on_node_added is not None:
+            self.on_node_added(entry)
+        return {"nodes": [e.view() for e in self._nodes.values()]}
+
+    def heartbeat(
+        self, node_id: NodeID, available: Dict[str, float], pending: int
+    ):
+        entry = self._nodes.get(node_id)
+        if entry is None or entry.state == "dead":
+            return
+        entry.resources_available = available
+        entry.pending_tasks = pending
+        entry.last_heartbeat = time.monotonic()
+
+    async def _broadcast_load(self):
+        views = [e.view() for e in self._nodes.values() if e.state == "alive"]
+        msg = {"type": "cluster_load", "nodes": views}
+        await self._broadcast(msg)
+        if self.on_load_update is not None:
+            self.on_load_update(msg)
+
+    async def _health_loop(self):
+        period = self.config.gcs_health_check_period_s
+        timeout = self.config.node_death_timeout_s
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for entry in list(self._nodes.values()):
+                if entry.is_head or entry.state == "dead":
+                    continue
+                if now - entry.last_heartbeat > timeout:
+                    await self._mark_node_dead(entry, "missed heartbeats")
+
+    async def _mark_node_dead(self, entry: NodeEntry, reason: str):
+        entry.state = "dead"
+        conn = self._conns.pop(entry.node_id, None)
+        if conn is not None:
+            conn.close()
+        # Purge location/actor records pointing at the dead node.
+        self._object_nodes = {
+            oid: nid for oid, nid in self._object_nodes.items()
+            if nid != entry.node_id
+        }
+        dead_actors = [
+            aid for aid, nid in self._actor_nodes.items() if nid == entry.node_id
+        ]
+        for aid in dead_actors:
+            del self._actor_nodes[aid]
+        self._named_actors = {
+            name: rec for name, rec in self._named_actors.items()
+            if rec[1] != entry.node_id
+        }
+        await self._broadcast(
+            {
+                "type": "node_dead",
+                "node_id": entry.node_id.hex(),
+                "reason": reason,
+                "dead_actors": [a.hex() for a in dead_actors],
+            }
+        )
+        if self.on_node_dead is not None:
+            self.on_node_dead(entry)
+
+    async def _broadcast(self, msg: Dict[str, Any], exclude: Optional[NodeID] = None):
+        for nid, conn in list(self._conns.items()):
+            if nid == exclude:
+                continue
+            try:
+                await conn.send(msg)
+            except Exception:
+                pass
+
+    # -------------------------------------------------------------------- kv
+
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        if not overwrite and key in self._kv:
+            return False
+        self._kv[key] = value
+        ev = self._kv_events.pop(key, None)
+        if ev is not None:
+            ev.set()
+        return True
+
+    async def kv_wait(self, key: str, timeout: float) -> Optional[bytes]:
+        """Blocking get used for rendezvous barriers (ref analogue: the
+        NCCLUniqueIDStore named actor the reference's collectives poll)."""
+        if key in self._kv:
+            return self._kv[key]
+        ev = self._kv_events.setdefault(key, asyncio.Event())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            return None
+        return self._kv.get(key)
+
+    # ---------------------------------------------------------------- actors
+
+    def register_named_actor(
+        self, name: str, actor_id: ActorID, node_id: NodeID, spec: Any
+    ) -> bool:
+        existing = self._named_actors.get(name)
+        if existing is not None:
+            # Idempotent for the same actor (restart re-claims its name).
+            return existing[0] == actor_id
+        self._named_actors[name] = (actor_id, node_id, spec)
+        return True
+
+    # --------------------------------------------------------------- objects
+
+    def publish_object(self, object_id: ObjectID, node_id: NodeID):
+        self._object_nodes[object_id] = node_id
+        ev = self._object_events.pop(object_id, None)
+        if ev is not None:
+            ev.set()
+
+    async def locate_object(
+        self, object_id: ObjectID, timeout: float = 0
+    ) -> Optional[NodeID]:
+        nid = self._object_nodes.get(object_id)
+        if nid is not None or timeout <= 0:
+            return nid
+        ev = self._object_events.setdefault(object_id, asyncio.Event())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            return None
+        return self._object_nodes.get(object_id)
+
+    def nodes_view(self) -> List[Dict[str, Any]]:
+        return [e.view() for e in self._nodes.values()]
+
+
+class GcsClient:
+    """Remote node manager's connection to the GCS, living on the node
+    manager's asyncio loop (ref analogue: gcs_client/gcs_client.h GcsClient
+    + the syncer's client side)."""
+
+    def __init__(self, node_id: NodeID, host: str, port: int):
+        self.node_id = node_id
+        self.host = host
+        self.port = port
+        self._writer: Optional[_FramedWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._msg_counter = 0
+        # Push handler installed by the node manager.
+        self.on_push: Optional[Callable[[Dict[str, Any]], Awaitable[None]]] = None
+        self.closed = False
+
+    async def connect(self):
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        self._writer = _FramedWriter(writer)
+        await self._writer.send(
+            {"type": "gcs_hello", "node_id": self.node_id.hex()}
+        )
+        welcome = await _read_frame(reader)
+        assert welcome["type"] == "gcs_welcome", welcome
+        self._reader_task = asyncio.ensure_future(self._reader_loop(reader))
+
+    async def _reader_loop(self, reader: asyncio.StreamReader):
+        try:
+            while True:
+                msg = await _read_frame(reader)
+                if msg.get("type") == "reply":
+                    fut = self._pending.pop(msg.get("msg_id"), None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+                elif self.on_push is not None:
+                    await self.on_push(msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            self.closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("GCS connection lost"))
+            self._pending.clear()
+
+    async def request(self, msg: Dict[str, Any], timeout: float = 30.0):
+        if self.closed or self._writer is None:
+            raise ConnectionError("GCS connection lost")
+        self._msg_counter += 1
+        msg_id = self._msg_counter
+        msg["msg_id"] = msg_id
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[msg_id] = fut
+        await self._writer.send(msg)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(msg_id, None)
+
+    async def notify(self, msg: Dict[str, Any]):
+        if self.closed or self._writer is None:
+            return
+        try:
+            await self._writer.send(msg)
+        except Exception:
+            self.closed = True
+
+    def close(self):
+        self.closed = True
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+
+
+class LocalGcsHandle:
+    """Head node manager's view of the in-process GCS (direct calls)."""
+
+    def __init__(self, service: GcsService):
+        self._svc = service
+
+    async def kv_put(self, key, value, overwrite=True) -> bool:
+        return self._svc.kv_put(key, value, overwrite)
+
+    async def kv_get(self, key, wait_timeout: float = 0):
+        if wait_timeout:
+            return await self._svc.kv_wait(key, wait_timeout)
+        return self._svc._kv.get(key)
+
+    async def kv_del(self, key) -> bool:
+        return self._svc._kv.pop(key, None) is not None
+
+    async def kv_keys(self, prefix=""):
+        return [k for k in self._svc._kv if k.startswith(prefix)]
+
+    async def register_function(self, function_id, blob):
+        self._svc._functions[function_id] = blob
+
+    async def fetch_function(self, function_id):
+        return self._svc._functions.get(function_id)
+
+    async def register_named_actor(self, name, actor_id, node_id, spec) -> bool:
+        return self._svc.register_named_actor(name, actor_id, node_id, spec)
+
+    async def get_named_actor(self, name):
+        entry = self._svc._named_actors.get(name)
+        if entry is None:
+            return None
+        return entry
+
+    async def drop_named_actor(self, name, actor_id):
+        cur = self._svc._named_actors.get(name)
+        if cur is not None and cur[0] == actor_id:
+            self._svc._named_actors.pop(name, None)
+
+    async def register_actor_node(self, actor_id, node_id):
+        self._svc._actor_nodes[actor_id] = node_id
+
+    async def get_actor_node(self, actor_id):
+        return self._svc._actor_nodes.get(actor_id)
+
+    async def publish_object(self, object_id, node_id):
+        self._svc.publish_object(object_id, node_id)
+
+    async def unpublish_object(self, object_id):
+        self._svc._object_nodes.pop(object_id, None)
+
+    async def locate_object(self, object_id, timeout=0):
+        return await self._svc.locate_object(object_id, timeout)
+
+
+class RemoteGcsHandle:
+    """Remote node manager's view of the GCS over its client connection."""
+
+    def __init__(self, client: GcsClient):
+        self._client = client
+
+    async def kv_put(self, key, value, overwrite=True) -> bool:
+        r = await self._client.request(
+            {"op": "kv_put", "key": key, "value": value, "overwrite": overwrite}
+        )
+        return r["added"]
+
+    async def kv_get(self, key, wait_timeout: float = 0):
+        r = await self._client.request(
+            {"op": "kv_get", "key": key, "wait_timeout": wait_timeout},
+            timeout=max(30.0, wait_timeout + 10.0),
+        )
+        return r["value"]
+
+    async def kv_del(self, key) -> bool:
+        return (await self._client.request({"op": "kv_del", "key": key}))["deleted"]
+
+    async def kv_keys(self, prefix=""):
+        return (await self._client.request({"op": "kv_keys", "prefix": prefix}))[
+            "keys"
+        ]
+
+    async def register_function(self, function_id, blob):
+        await self._client.request(
+            {"op": "register_function", "function_id": function_id, "blob": blob}
+        )
+
+    async def fetch_function(self, function_id):
+        r = await self._client.request(
+            {"op": "fetch_function", "function_id": function_id}
+        )
+        return r["blob"]
+
+    async def register_named_actor(self, name, actor_id, node_id, spec) -> bool:
+        r = await self._client.request(
+            {
+                "op": "register_named_actor",
+                "name": name,
+                "actor_id": actor_id.hex(),
+                "node_id": node_id.hex(),
+                "spec": spec,
+            }
+        )
+        return r["added"]
+
+    async def get_named_actor(self, name):
+        r = await self._client.request({"op": "get_named_actor", "name": name})
+        if not r["found"]:
+            return None
+        return (
+            ActorID.from_hex(r["actor_id"]),
+            NodeID.from_hex(r["node_id"]),
+            r["spec"],
+        )
+
+    async def drop_named_actor(self, name, actor_id):
+        await self._client.notify(
+            {"op": "drop_named_actor", "name": name, "actor_id": actor_id.hex(),
+             "msg_id": None}
+        )
+
+    async def register_actor_node(self, actor_id, node_id):
+        await self._client.notify(
+            {"op": "register_actor_node", "actor_id": actor_id.hex(),
+             "node_id": node_id.hex(), "msg_id": None}
+        )
+
+    async def get_actor_node(self, actor_id):
+        r = await self._client.request(
+            {"op": "get_actor_node", "actor_id": actor_id.hex()}
+        )
+        return NodeID.from_hex(r["node_id"]) if r["node_id"] else None
+
+    async def publish_object(self, object_id, node_id):
+        await self._client.notify(
+            {"op": "publish_object", "object_id": object_id, "msg_id": None}
+        )
+
+    async def unpublish_object(self, object_id):
+        await self._client.notify(
+            {"op": "unpublish_object", "object_id": object_id, "msg_id": None}
+        )
+
+    async def locate_object(self, object_id, timeout=0):
+        r = await self._client.request(
+            {"op": "locate_object", "object_id": object_id, "timeout": timeout},
+            timeout=max(30.0, timeout + 10.0),
+        )
+        return NodeID.from_hex(r["node_id"]) if r["node_id"] else None

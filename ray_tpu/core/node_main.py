@@ -1,0 +1,66 @@
+"""Remote node process entry point.
+
+Plays the role of the reference's ``raylet`` binary (ref:
+src/ray/raylet/main.cc): one process per node hosting the NodeManager, its
+worker pool, and its share of the object store, registered with the head's
+GCS. Spawned by ``cluster_utils.Cluster.add_node`` (the reference's
+single-machine multi-node test pattern, python/ray/cluster_utils.py:174) or
+by an operator on each host of a real deployment.
+
+Env contract:
+    RAY_TPU_GCS_ADDRESS  host:port of the head GCS
+    RAY_TPU_SESSION_DIR  this node's session directory
+    RAY_TPU_RESOURCES    JSON resource dict, e.g. {"CPU": 4}
+    RAY_TPU_NODE_LABELS  optional JSON label dict
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+
+from .config import get_config
+from .ids import NodeID
+from .node_manager import NodeManager
+
+
+def main() -> int:
+    gcs_addr = os.environ["RAY_TPU_GCS_ADDRESS"]
+    session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+    resources = json.loads(os.environ.get("RAY_TPU_RESOURCES", '{"CPU": 1}'))
+    labels = json.loads(os.environ.get("RAY_TPU_NODE_LABELS", "{}"))
+    host, port_s = gcs_addr.rsplit(":", 1)
+
+    os.makedirs(session_dir, exist_ok=True)
+    config = get_config()
+    node_id = NodeID.from_random()
+    nm = NodeManager(
+        node_id,
+        session_dir,
+        resources,
+        config,
+        is_head=False,
+        gcs_address=(host, int(port_s)),
+        labels=labels,
+    )
+    nm.start()
+    sys.stdout.write(f"node {node_id.hex()} up\n")
+    sys.stdout.flush()
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    nm.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,22 +1,28 @@
-"""Single-node control plane: scheduler + worker pool + object directory.
+"""Per-node control plane: scheduler + worker pool + object directory.
 
 This is the raylet-equivalent (ref: src/ray/raylet/node_manager.h NodeManager,
 worker_pool.h WorkerPool, scheduling/cluster_task_manager.h +
-local_task_manager.h) fused with the GCS-lite services a single node needs
-(function table, KV store, named actors — ref: src/ray/gcs/gcs_server/). It
-runs an asyncio event loop in a background thread of the head process; workers
-connect over a unix socket with framed pickled messages (protocol.py).
+local_task_manager.h). It runs an asyncio event loop in a background thread;
+workers connect over a unix socket with framed pickled messages
+(protocol.py), and peer nodes connect over TCP (peers.py).
 
-The multi-node design splits along the same seams as the reference: this
-class's public coroutines are the RPC surface a remote raylet/GCS would
-expose; nothing below the coroutine layer assumes the caller is in-process.
+Cluster mode: the head node hosts the GCS-equivalent control plane
+(gcs.py GcsService) on the same loop; remote nodes (spawned by
+cluster_utils.Cluster.add_node or node_main) register with it, gossip load
+reports, and learn the cluster view from its broadcasts (ref analogue: the
+RaySyncer resource gossip, src/ray/common/ray_syncer/ray_syncer.h:88).
+Tasks whose resources don't fit locally — or whose scheduling strategy says
+otherwise — are forwarded to the node picked by the hybrid/spread/affinity
+policies (scheduling_policy.py), the moral equivalent of the reference's
+spillback re-leasing (ref: ClusterTaskManager::ScheduleAndDispatchTasks).
+Objects are pulled between nodes on demand and re-homed into the local store
+(ref analogue: PullManager + ObjectManagerService Push/Pull).
 """
 
 from __future__ import annotations
 
 import asyncio
 import os
-import pickle
 import struct
 import subprocess
 import sys
@@ -26,27 +32,33 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
-import cloudpickle
 
 from .config import Config
 from .exceptions import (
     ActorDiedError,
+    ObjectLostError,
     TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
+from .gcs import GcsClient, GcsService, LocalGcsHandle, RemoteGcsHandle
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import (
     ArenaLocation,
     InlineLocation,
+    LocalObjectStore,
     Location,
     ObjectDirectory,
+    RemoteLocation,
     ShmLocation,
     current_arena,
     init_arena,
     shutdown_arena,
 )
+from .peers import PeerClient
+from .protocol import AioFramedWriter, aio_read_frame
 from .resources import CPU, NodeResources, ResourceSet
+from .scheduling_policy import pick_node
 from .task_spec import TaskSpec, TaskType
 
 _HEADER = struct.Struct("<I")
@@ -81,38 +93,24 @@ def _task_worker_type(spec: TaskSpec) -> str:
     return "tpu" if spec.resources.get("TPU") > 0 else "cpu"
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> Dict[str, Any]:
-    header = await reader.readexactly(_HEADER.size)
-    (length,) = _HEADER.unpack(header)
-    payload = await reader.readexactly(length)
-    return pickle.loads(payload)
-
-
-class _FramedWriter:
-    def __init__(self, writer: asyncio.StreamWriter):
-        self._writer = writer
-        self._lock = asyncio.Lock()
-
-    async def send(self, message: Dict[str, Any]):
-        payload = cloudpickle.dumps(message, protocol=5)
-        async with self._lock:
-            self._writer.write(_HEADER.pack(len(payload)) + payload)
-            await self._writer.drain()
-
-    def close(self):
-        try:
-            self._writer.close()
-        except Exception:
-            pass
+# Asyncio framing shared with the GCS/peer channels (protocol.py).
+_read_frame = aio_read_frame
+_FramedWriter = AioFramedWriter
 
 
 @dataclass
 class TaskRecord:
     spec: TaskSpec
-    state: str = "waiting"  # waiting | ready | running | finished | failed | cancelled
+    state: str = "waiting"  # waiting | ready | running | forwarded | finished | failed | cancelled
     worker_id: Optional[WorkerID] = None
     resources_held: bool = False
     deps_unpinned: bool = False
+    # Cluster fields: ``origin`` is the hex node id that forwarded this task
+    # here (results are pushed back to it); ``target`` is the node this
+    # record was forwarded to; ``spillbacks`` bounds forwarding hops.
+    origin: Optional[str] = None
+    target: Optional[str] = None
+    spillbacks: int = 0
 
 
 @dataclass
@@ -149,11 +147,19 @@ class NodeManager:
         session_dir: str,
         resources: Dict[str, float],
         config: Config,
+        *,
+        is_head: bool = True,
+        gcs_address: Optional[Tuple[str, int]] = None,
+        node_ip: str = "127.0.0.1",
+        labels: Optional[Dict[str, str]] = None,
     ):
         self.node_id = node_id
         self.session_dir = session_dir
         self.socket_path = os.path.join(session_dir, "node.sock")
         self.config = config
+        self.is_head = is_head
+        self.node_ip = node_ip
+        self.labels = labels or {}
         self.node_resources = NodeResources(ResourceSet(resources))
         capacity = config.object_store_memory
         self.directory = ObjectDirectory(capacity)
@@ -195,6 +201,22 @@ class NodeManager:
         self._seal_events: Dict[ObjectID, asyncio.Event] = {}
         self._pending_procs: Dict[WorkerID, subprocess.Popen] = {}
 
+        # Cluster plane.
+        self.gcs_service: Optional[GcsService] = None  # head only
+        self._gcs = None  # LocalGcsHandle | RemoteGcsHandle | None
+        self._gcs_client: Optional[GcsClient] = None  # remote only
+        self._gcs_address = gcs_address
+        self.peer_port: int = 0
+        self._peer_server: Optional[asyncio.AbstractServer] = None
+        self._cluster_view: Dict[str, Dict[str, Any]] = {}  # hex -> view
+        self._peers: Dict[str, PeerClient] = {}
+        self._forwarded: Dict[TaskID, TaskRecord] = {}
+        self._actor_homes: Dict[ActorID, str] = {}  # hex node or "dead"
+        self._pulls: Dict[ObjectID, asyncio.Future] = {}
+        self._heartbeat_task: Optional[asyncio.Task] = None
+        # NM-process store client for the pull/push data path.
+        self.local_store = LocalObjectStore()
+
         self._stats = {
             "tasks_submitted": 0,
             "tasks_finished": 0,
@@ -209,6 +231,10 @@ class NodeManager:
     def start(self):
         self._thread.start()
         self._started.wait(timeout=30)
+        if not self._started.is_set():
+            raise RuntimeError(
+                "node manager failed to start (GCS unreachable?)"
+            )
         for _ in range(self.config.num_prestart_workers):
             self._loop.call_soon_threadsafe(self._spawn_worker)
 
@@ -225,8 +251,140 @@ class NodeManager:
         self._server = await asyncio.start_unix_server(
             self._handle_connection, path=self.socket_path
         )
+        # Peer channel for node<->node traffic (spillback + object pulls).
+        self._peer_server = await asyncio.start_server(
+            self._handle_peer_connection, host=self.node_ip, port=0
+        )
+        self.peer_port = self._peer_server.sockets[0].getsockname()[1]
+        if self.is_head:
+            self.gcs_service = GcsService(self.config, self._loop)
+            await self.gcs_service.start(host=self.node_ip)
+            self.gcs_service.on_node_added = self._on_gcs_node_added
+            self.gcs_service.on_node_dead = self._on_gcs_node_dead
+            self.gcs_service.on_load_update = self._on_gcs_load_update
+            self._gcs = LocalGcsHandle(self.gcs_service)
+            reply = await self.gcs_service.register_node(
+                self.node_id,
+                self.node_ip,
+                self.peer_port,
+                self.node_resources.total.to_dict(),
+                is_head=True,
+                labels=self.labels,
+            )
+            self._apply_cluster_views(reply["nodes"])
+        elif self._gcs_address is not None:
+            self._gcs_client = GcsClient(
+                self.node_id, self._gcs_address[0], self._gcs_address[1]
+            )
+            self._gcs_client.on_push = self._on_gcs_push
+            await self._gcs_client.connect()
+            self._gcs = RemoteGcsHandle(self._gcs_client)
+            reply = await self._gcs_client.request(
+                {
+                    "op": "register_node",
+                    "host": self.node_ip,
+                    "peer_port": self.peer_port,
+                    "resources": self.node_resources.total.to_dict(),
+                    "labels": self.labels,
+                }
+            )
+            self._apply_cluster_views(reply["nodes"])
+        self._heartbeat_task = asyncio.ensure_future(self._heartbeat_loop())
         self._gc_task = asyncio.ensure_future(self._gc_loop())
         self._health_task = asyncio.ensure_future(self._health_loop())
+
+    # ------------------------------------------------------- cluster plumbing
+
+    @property
+    def _multi_node(self) -> bool:
+        return len(self._cluster_view) > 1
+
+    def _apply_cluster_views(self, views):
+        for v in views:
+            if v["state"] == "alive":
+                self._cluster_view[v["node_id"]] = v
+            else:
+                self._cluster_view.pop(v["node_id"], None)
+
+    def _local_view(self) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id.hex(),
+            "host": self.node_ip,
+            "peer_port": self.peer_port,
+            "resources_total": self.node_resources.total.to_dict(),
+            "resources_available": self.node_resources.available.to_dict(),
+            "pending_tasks": len(self._ready) + len(self._waiting),
+            "is_head": self.is_head,
+            "state": "alive",
+            "labels": self.labels,
+        }
+
+    def _on_gcs_node_added(self, entry):
+        was_single = not self._multi_node
+        self._cluster_view[entry.node_id.hex()] = entry.view()
+        if was_single and self._multi_node:
+            # Objects sealed while the head was alone were never published;
+            # back-publish so new nodes can locate them.
+            asyncio.ensure_future(self._publish_all_sealed())
+        self._schedule()
+
+    async def _publish_all_sealed(self):
+        for oid in list(self._sealed):
+            loc = self.directory.lookup(oid)
+            if loc is not None and not isinstance(loc, RemoteLocation):
+                try:
+                    await self._gcs.publish_object(oid, self.node_id)
+                except Exception:
+                    pass
+
+    def _on_gcs_node_dead(self, entry):
+        asyncio.ensure_future(
+            self._on_node_dead_hex(entry.node_id.hex(), dead_actors=None)
+        )
+
+    def _on_gcs_load_update(self, msg):
+        self._apply_cluster_views(msg["nodes"])
+
+    async def _on_gcs_push(self, msg: Dict[str, Any]):
+        mtype = msg["type"]
+        if mtype == "node_added":
+            self._apply_cluster_views([msg["node"]])
+            self._schedule()
+        elif mtype == "cluster_load":
+            self._apply_cluster_views(msg["nodes"])
+        elif mtype == "node_dead":
+            await self._on_node_dead_hex(
+                msg["node_id"], dead_actors=msg.get("dead_actors")
+            )
+
+    async def _heartbeat_loop(self):
+        interval = self.config.heartbeat_interval_s
+        while not self._shutdown:
+            await asyncio.sleep(interval)
+            view = self._local_view()
+            self._cluster_view[view["node_id"]] = view
+            if self.is_head and self.gcs_service is not None:
+                self.gcs_service.heartbeat(
+                    self.node_id,
+                    view["resources_available"],
+                    view["pending_tasks"],
+                )
+            elif self._gcs_client is not None and not self._gcs_client.closed:
+                try:
+                    await self._gcs_client.notify(
+                        {
+                            "op": "heartbeat",
+                            "available": view["resources_available"],
+                            "pending": view["pending_tasks"],
+                            "msg_id": None,
+                        }
+                    )
+                except Exception:
+                    pass
+            elif self._gcs_client is not None and self._gcs_client.closed:
+                # The head is gone: a remote node cannot outlive the cluster.
+                sys.stderr.write("[ray_tpu] GCS connection lost; exiting node\n")
+                os._exit(1)
 
     async def _health_loop(self):
         """Detect workers that died before registering (e.g. import errors)
@@ -388,11 +546,11 @@ class NodeManager:
                 {
                     "type": "reply",
                     "msg_id": msg["msg_id"],
-                    "blob": self._functions.get(msg["function_id"]),
+                    "blob": await self._function_blob(msg["function_id"]),
                 }
             )
         elif mtype == "register_function":
-            self._functions[msg["function_id"]] = msg["blob"]
+            await self.register_function(msg["function_id"], msg["blob"])
         elif mtype == "blocked":
             self._on_worker_blocked(w)
         elif mtype == "unblocked":
@@ -452,13 +610,253 @@ class NodeManager:
                 pass
         self._schedule()
 
+    # ------------------------------------------------------------ peer plane
+
+    async def _handle_peer_connection(self, reader, writer):
+        framed = AioFramedWriter(writer)
+        peer_hex = None
+        try:
+            hello = await aio_read_frame(reader)
+            if hello.get("type") != "peer_hello":
+                framed.close()
+                return
+            peer_hex = hello["node_id"]
+            while True:
+                msg = await aio_read_frame(reader)
+                reply = await self._dispatch_peer(peer_hex, msg)
+                if reply is not None:
+                    reply["type"] = "reply"
+                    reply["msg_id"] = msg.get("msg_id")
+                    await framed.send(reply)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            framed.close()
+
+    async def _dispatch_peer(
+        self, peer_hex: str, msg: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        mtype = msg["type"]
+        if mtype == "forward_task":
+            await self._on_forward_task(peer_hex, msg["spec"], msg["dep_locs"])
+            return None
+        if mtype == "task_result":
+            self._on_remote_task_result(msg)
+            return None
+        if mtype == "pull_object":
+            return self._serve_pull(msg["object_id"])
+        if mtype == "free_object":
+            self._remove_ref(msg["object_id"])
+            return None
+        if mtype == "kill_actor_peer":
+            await self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
+            return None
+        if mtype == "cancel_task_peer":
+            await self.cancel_task(msg["task_id"], msg.get("force", False))
+            return None
+        raise RuntimeError(f"unknown peer message {mtype}")
+
+    async def _get_peer(self, peer_hex: str) -> PeerClient:
+        peer = self._peers.get(peer_hex)
+        if peer is not None and not peer.closed:
+            return peer
+        view = self._cluster_view.get(peer_hex)
+        if view is None:
+            raise ConnectionError(f"node {peer_hex[:8]} not in cluster view")
+        peer = PeerClient(
+            peer_hex, view["host"], view["peer_port"], self.node_id.hex()
+        )
+        await peer.connect()
+        self._peers[peer_hex] = peer
+        return peer
+
+    def _serve_pull(self, object_id: ObjectID) -> Dict[str, Any]:
+        loc = self.directory.lookup(object_id)
+        if loc is None or isinstance(loc, RemoteLocation):
+            return {"data": None}
+        try:
+            return {"data": self.local_store.get_bytes(loc)}
+        except Exception as e:
+            return {"data": None, "error": str(e)}
+
+    def _build_dep_locs(self, spec: TaskSpec) -> Dict[ObjectID, Location]:
+        """Location hints shipped with a forwarded task so the target can
+        pull arguments without a directory round-trip (ref analogue: the
+        lease response's resolved dependency locations)."""
+        dep_locs: Dict[ObjectID, Location] = {}
+        for oid in spec.dependency_ids():
+            loc = self.directory.lookup(oid)
+            if loc is None:
+                continue
+            if isinstance(loc, (InlineLocation, RemoteLocation)):
+                dep_locs[oid] = loc
+            else:
+                dep_locs[oid] = RemoteLocation(self.node_id.hex(), loc.size)
+        return dep_locs
+
+    def _forward_record(self, record: TaskRecord, target_hex: str):
+        record.state = "forwarded"
+        record.target = target_hex
+        record.spillbacks += 1
+        self._forwarded[record.spec.task_id] = record
+        dep_locs = self._build_dep_locs(record.spec)
+        asyncio.ensure_future(self._forward_send(record, target_hex, dep_locs))
+
+    async def _forward_send(self, record, target_hex, dep_locs):
+        try:
+            peer = await self._get_peer(target_hex)
+            await peer.notify(
+                {
+                    "type": "forward_task",
+                    "spec": record.spec,
+                    "dep_locs": dep_locs,
+                }
+            )
+        except Exception:
+            # Target unreachable: treat like a node death for this record.
+            self._forwarded.pop(record.spec.task_id, None)
+            self._cluster_view.pop(target_hex, None)
+            self._requeue_forwarded(record, target_hex)
+
+    def _requeue_forwarded(self, record: TaskRecord, dead_hex: str):
+        """Re-place a record whose forward target is gone, respecting the
+        task type (an actor task must re-route via the actor directory, not
+        the normal ready queue)."""
+        record.state = "ready"
+        record.target = None
+        spec = record.spec
+        if spec.task_type == TaskType.ACTOR_TASK:
+            if self._actor_homes.get(spec.actor_id) == dead_hex:
+                self._actor_homes[spec.actor_id] = "dead"
+            self._route_actor_task_cluster(record)
+        elif spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            if self._actor_homes.get(spec.actor_id) == dead_hex:
+                self._actor_homes.pop(spec.actor_id, None)
+            self._task_ready(record)
+        else:
+            self._task_ready(record)
+
+    async def _on_forward_task(self, origin_hex, spec: TaskSpec, dep_locs):
+        for oid, loc in dep_locs.items():
+            # Only adopt the hint when the object is unknown here; a local
+            # placeholder means this node is itself producing it, and the
+            # local seal path must win (and will wake waiters).
+            if self.directory.lookup(oid) is None:
+                self._seal_object(oid, loc)
+        await self.submit_task(spec, origin=origin_hex)
+        # Hold the return slots on behalf of the origin until it frees them
+        # (the origin's directory entry maps here via RemoteLocation).
+        for oid in spec.return_ids():
+            self.directory.add_ref(oid)
+
+    def _notify_origin(self, record: TaskRecord, failed: bool):
+        """Push a forwarded task's results back to the node that sent it."""
+        results = []
+        for oid in record.spec.return_ids():
+            loc = self.directory.lookup(oid)
+            if loc is None:
+                continue
+            if isinstance(loc, (InlineLocation, RemoteLocation)):
+                results.append((oid, loc))
+                # Inline bytes travel with the message: the origin needs no
+                # hold on our copy, so release the one _on_forward_task took.
+                self.directory.remove_ref(oid)
+                if isinstance(loc, RemoteLocation) and loc.held:
+                    # The third-party hold transfers to the origin; clear our
+                    # copy's flag so our GC doesn't also free it.
+                    self.directory.replace_location(
+                        oid, RemoteLocation(loc.node_id, loc.size, held=False)
+                    )
+            else:
+                results.append(
+                    (oid, RemoteLocation(self.node_id.hex(), loc.size, held=True))
+                )
+        origin = record.origin
+
+        async def _send():
+            try:
+                peer = await self._get_peer(origin)
+                await peer.notify(
+                    {
+                        "type": "task_result",
+                        "task_id": record.spec.task_id,
+                        "results": results,
+                        "failed": failed,
+                    }
+                )
+            except Exception:
+                pass  # origin died; its successor will never ask
+
+        asyncio.ensure_future(_send())
+
+    def _on_remote_task_result(self, msg: Dict[str, Any]):
+        record = self._forwarded.pop(msg["task_id"], None)
+        if record is None:
+            return
+        for oid, loc in msg["results"]:
+            self._seal_object(oid, loc)
+        if msg.get("failed"):
+            record.state = "failed"
+            self._stats["tasks_failed"] += 1
+        else:
+            record.state = "finished"
+            self._stats["tasks_finished"] += 1
+        if record.spec.task_type != TaskType.ACTOR_CREATION_TASK:
+            self._unpin_deps(record)
+            self._tasks.pop(record.spec.task_id, None)
+
+    async def _on_node_dead_hex(self, node_hex: str, dead_actors=None):
+        """A peer died: fail/retry work bound to it (ref analogue:
+        NodeManager::NodeRemoved + TaskManager retry on node failure)."""
+        self._cluster_view.pop(node_hex, None)
+        peer = self._peers.pop(node_hex, None)
+        if peer is not None:
+            peer.close()
+        # Remote actors homed there are gone (mark before requeueing so
+        # re-routed actor tasks fail with ActorDiedError, not a plain-worker
+        # dispatch). Actor-restart-on-another-node is future work; creations
+        # still in flight do retry elsewhere below.
+        if dead_actors is None:
+            dead_actors = [
+                aid.hex() for aid, h in self._actor_homes.items() if h == node_hex
+            ]
+        for aid_hex in dead_actors:
+            aid = ActorID.from_hex(aid_hex)
+            if self._actor_homes.get(aid) == node_hex:
+                self._actor_homes[aid] = "dead"
+        # Forwarded tasks: retry elsewhere or fail.
+        for task_id, record in list(self._forwarded.items()):
+            if record.target != node_hex:
+                continue
+            del self._forwarded[task_id]
+            if record.spec.task_type == TaskType.ACTOR_TASK:
+                # The actor died with its node; retries can't help.
+                self._fail_task(
+                    record,
+                    ActorDiedError(
+                        record.spec.name, f"node {node_hex[:8]} died"
+                    ),
+                )
+            elif record.spec.retries_left > 0:
+                record.spec.retries_left -= 1
+                self._stats["tasks_retried"] += 1
+                self._requeue_forwarded(record, node_hex)
+            else:
+                self._fail_task(
+                    record,
+                    WorkerCrashedError(
+                        f"{record.spec.name} (node {node_hex[:8]} died)"
+                    ),
+                )
+        self._schedule()
+
     # ------------------------------------------------------------- scheduling
 
-    async def submit_task(self, spec: TaskSpec):
-        """Entry point for both driver and nested worker submissions
-        (ref analogue: ClusterTaskManager::QueueAndScheduleTask)."""
+    async def submit_task(self, spec: TaskSpec, origin: Optional[str] = None):
+        """Entry point for driver, nested worker, and peer-forwarded
+        submissions (ref analogue: ClusterTaskManager::QueueAndScheduleTask)."""
         self._stats["tasks_submitted"] += 1
-        record = TaskRecord(spec=spec)
+        record = TaskRecord(spec=spec, origin=origin)
         self._tasks[spec.task_id] = record
         for oid in spec.return_ids():
             # Return slots exist in the directory from submission time so
@@ -469,25 +867,175 @@ class NodeManager:
         # task references in ReferenceCounter).
         for oid in spec.dependency_ids():
             self.directory.add_ref(oid)
-        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
-            # Register the actor synchronously (so method calls submitted
-            # right after creation can route/queue), but never block the
-            # submitter on placement.
-            self._register_actor(record)
-            return
         if spec.task_type == TaskType.ACTOR_TASK:
-            self._route_actor_task(record)
+            # Actor tasks never wait for deps here: the actor's worker
+            # resolves arguments at execution, which preserves per-caller
+            # submission order (ref analogue: sequential_actor_submit_queue).
+            self._route_actor_task_cluster(record)
             return
         missing = {oid for oid in spec.dependency_ids() if oid not in self._sealed}
         if missing:
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                # Pre-register so method calls issued right after creation
+                # queue on the pending actor instead of failing (ref
+                # analogue: the synchronous RegisterActor before CreateActor,
+                # gcs_actor_manager.cc:255).
+                self._pre_register_actor(spec)
             record.state = "waiting"
             self._waiting[spec.task_id] = (record, missing)
             for oid in missing:
                 self._dep_index.setdefault(oid, set()).add(spec.task_id)
+                if self.directory.lookup(oid) is None:
+                    asyncio.ensure_future(self._locate_missing(oid))
         else:
-            record.state = "ready"
-            self._ready.append(record)
+            self._task_ready(record)
+
+    def _task_ready(self, record: TaskRecord):
+        """Dependencies are available: place the task (ref analogue: the
+        hand-off from DependencyManager to ClusterTaskManager dispatch)."""
+        spec = record.spec
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            self._place_creation(record)
+            return
+        record.state = "ready"
+        self._ready.append(record)
         self._schedule()
+
+    def _place_creation(self, record: TaskRecord):
+        """Pick a node for an actor (ref analogue: GcsActorScheduler
+        ScheduleByRaylet picking a forward target)."""
+        spec = record.spec
+        strategy = getattr(spec, "scheduling_strategy", None) or "DEFAULT"
+        if (
+            record.origin is None
+            and self._multi_node
+            and record.spillbacks < self.config.max_task_spillback
+        ):
+            self._cluster_view[self.node_id.hex()] = self._local_view()
+            target = pick_node(
+                spec.resources,
+                strategy,
+                self.node_id.hex(),
+                list(self._cluster_view.values()),
+                spread_threshold=self.config.scheduler_spread_threshold,
+            )
+            if target is None:
+                self._fail_task(
+                    record,
+                    TaskError(
+                        None,
+                        spec.name,
+                        f"infeasible actor resources {spec.resources.to_dict()} "
+                        f"on every node in the cluster",
+                    ),
+                )
+                info = self._actors.get(spec.actor_id)
+                if info is not None and info.state == "pending":
+                    info.state = "dead"
+                    info.death_cause = "infeasible actor resources"
+                    self._fail_actor_queue(info, info.death_cause)
+                return
+            if target != self.node_id.hex():
+                self._actor_homes[spec.actor_id] = target
+                # Calls that queued on the pending pre-registration follow
+                # the creation to its home.
+                info = self._actors.pop(spec.actor_id, None)
+                self._forward_record(record, target)
+                if info is not None:
+                    while info.queued:
+                        qspec = info.queued.popleft()
+                        qrec = self._tasks.get(qspec.task_id)
+                        if qrec is not None and qrec.state != "cancelled":
+                            self._forward_record(qrec, target)
+                return
+        self._register_actor(record)
+
+    def _route_actor_task_cluster(self, record: TaskRecord):
+        """Route an actor call to wherever the actor lives."""
+        spec = record.spec
+        info = self._actors.get(spec.actor_id)
+        if info is not None:
+            self._route_actor_task(record)
+            return
+        home = self._actor_homes.get(spec.actor_id)
+        if home == "dead":
+            self._fail_task(
+                record, ActorDiedError(spec.name, "actor's node died")
+            )
+            return
+        if home is not None:
+            self._forward_record(record, home)
+            return
+        if record.origin is not None or self._gcs is None:
+            self._fail_task(
+                record, ActorDiedError(spec.name, "actor not found")
+            )
+            return
+        asyncio.ensure_future(self._route_actor_via_gcs(record))
+
+    async def _route_actor_via_gcs(self, record: TaskRecord):
+        """Handle deserialized on a node that has never seen this actor:
+        resolve its home through the GCS actor directory, polling briefly in
+        case creation is still in flight elsewhere."""
+        spec = record.spec
+        deadline = time.monotonic() + self.config.object_locate_timeout_s
+        while True:
+            try:
+                nid = await self._gcs.get_actor_node(spec.actor_id)
+            except Exception:
+                nid = None
+            if nid is not None:
+                if nid == self.node_id:
+                    if self._actors.get(spec.actor_id) is not None:
+                        self._route_actor_task(record)
+                        return
+                else:
+                    self._actor_homes[spec.actor_id] = nid.hex()
+                    self._forward_record(record, nid.hex())
+                    return
+            if time.monotonic() > deadline:
+                self._fail_task(
+                    record, ActorDiedError(spec.name, "actor not found")
+                )
+                return
+            await asyncio.sleep(0.05)
+
+    async def _locate_missing(self, oid: ObjectID):
+        """A dependency unknown to this node: find it through the GCS object
+        directory, or fail the tasks waiting on it loudly."""
+        found = await self._locate_via_gcs(oid)
+        if found:
+            return  # _locate_via_gcs sealed it; waiters have been woken.
+        waiters = self._dep_index.pop(oid, set())
+        for tid in waiters:
+            entry = self._waiting.pop(tid, None)
+            if entry is None:
+                continue
+            rec, _missing = entry
+            self._fail_task(
+                rec,
+                TaskError(
+                    None,
+                    rec.spec.name,
+                    f"argument object {oid.hex()} is unknown or has been "
+                    "freed; keep a live ObjectRef to it",
+                ),
+            )
+
+    async def _locate_via_gcs(self, oid: ObjectID) -> bool:
+        if self._gcs is None or not self._multi_node:
+            return False
+        try:
+            nid = await self._gcs.locate_object(
+                oid, timeout=self.config.object_locate_timeout_s
+            )
+        except Exception:
+            return False
+        if nid is None or nid == self.node_id:
+            return False
+        self._seal_object(oid, RemoteLocation(nid.hex(), 0))
+        self.directory.add_ref(oid)
+        return True
 
     def _schedule(self):
         """Dispatch ready tasks to idle workers while resources allow
@@ -500,10 +1048,45 @@ class NodeManager:
         # analogue: ClusterTaskManager keeps per-scheduling-class queues).
         deferred: Deque[TaskRecord] = deque()
         spawn_needed: Set[str] = set()
+        if self._multi_node:
+            self._cluster_view[self.node_id.hex()] = self._local_view()
         while self._ready:
             record = self._ready.popleft()
             if record.state == "cancelled":
                 continue
+            spec = record.spec
+            strategy = getattr(spec, "scheduling_strategy", None) or "DEFAULT"
+            if (
+                record.origin is None
+                and self._multi_node
+                and record.spillbacks < self.config.max_task_spillback
+                and (
+                    strategy != "DEFAULT"
+                    or not self.node_resources.can_fit(spec.resources)
+                )
+            ):
+                target = pick_node(
+                    spec.resources,
+                    strategy,
+                    self.node_id.hex(),
+                    list(self._cluster_view.values()),
+                    spread_threshold=self.config.scheduler_spread_threshold,
+                )
+                if target is None:
+                    self._fail_task(
+                        record,
+                        TaskError(
+                            None,
+                            spec.name,
+                            f"infeasible resource request "
+                            f"{spec.resources.to_dict()} on every node in "
+                            f"the cluster",
+                        ),
+                    )
+                    continue
+                if target != self.node_id.hex():
+                    self._forward_record(record, target)
+                    continue
             if not self.node_resources.can_fit(record.spec.resources):
                 if not self.node_resources.is_feasible(record.spec.resources):
                     self._fail_task(
@@ -573,7 +1156,7 @@ class NodeManager:
     async def _send_execute(self, worker: WorkerHandle, spec: TaskSpec):
         blob = None
         if spec.function_id not in worker.known_functions:
-            blob = self._functions.get(spec.function_id)
+            blob = await self._function_blob(spec.function_id)
             worker.known_functions.add(spec.function_id)
         try:
             await worker.writer.send(
@@ -596,6 +1179,8 @@ class NodeManager:
         else:
             self._stats["tasks_finished"] += 1
             record.state = "finished"
+        if record.origin is not None:
+            self._notify_origin(record, failed=bool(msg.get("failed")))
         # Creation-task deps stay pinned while the actor may restart (the
         # creation spec re-executes with the same arguments). Terminal
         # normal/actor-task records are dropped to keep the head's memory
@@ -653,9 +1238,16 @@ class NodeManager:
                 missing.discard(oid)
                 if not missing:
                     del self._waiting[tid]
-                    rec.state = "ready"
-                    self._ready.append(rec)
-            self._schedule()
+                    self._task_ready(rec)
+        if self._gcs is not None and (self._multi_node or not self.is_head) \
+                and not isinstance(loc, RemoteLocation):
+            asyncio.ensure_future(self._publish_seal(oid))
+
+    async def _publish_seal(self, oid: ObjectID):
+        try:
+            await self._gcs.publish_object(oid, self.node_id)
+        except Exception:
+            pass
 
     def _unpin_deps(self, record: TaskRecord):
         if record.deps_unpinned:
@@ -682,30 +1274,66 @@ class NodeManager:
             ).to_bytes()
         for oid in record.spec.return_ids():
             self._seal_object(oid, InlineLocation(blob))
+        if record.origin is not None:
+            self._notify_origin(record, failed=True)
 
     # ------------------------------------------------------------------ actors
 
-    def _register_actor(self, record: TaskRecord):
-        spec = record.spec
-        info = ActorInfo(
+    def _pre_register_actor(self, spec: TaskSpec):
+        if spec.actor_id in self._actors:
+            return
+        self._actors[spec.actor_id] = ActorInfo(
             actor_id=spec.actor_id,
             creation_spec=spec,
             restarts_left=spec.max_restarts,
             name=spec.name,
         )
+
+    def _register_actor(self, record: TaskRecord):
+        spec = record.spec
+        info = self._actors.get(spec.actor_id)
+        if info is None:
+            info = ActorInfo(
+                actor_id=spec.actor_id,
+                creation_spec=spec,
+                restarts_left=spec.max_restarts,
+                name=spec.name,
+            )
+            self._actors[spec.actor_id] = info
+        if self._gcs is not None:
+            asyncio.ensure_future(
+                self._gcs.register_actor_node(spec.actor_id, self.node_id)
+            )
+        asyncio.ensure_future(self._place_actor(info, record))
+
+    async def _claim_actor_name(self, spec: TaskSpec) -> bool:
+        """Atomically claim a named-actor slot (ref analogue: the name
+        registry in GcsActorManager::HandleRegisterActor)."""
+        if self._gcs is not None:
+            try:
+                return await self._gcs.register_named_actor(
+                    spec.name, spec.actor_id, self.node_id, spec
+                )
+            except Exception:
+                return False
+        existing = self._named_actors.get(spec.name)
+        if existing is not None:
+            return existing == spec.actor_id
+        self._named_actors[spec.name] = spec.actor_id
+        return True
+
+    async def _place_actor(self, info: ActorInfo, record: TaskRecord):
+        spec = info.creation_spec
         if spec.name:
-            if spec.name in self._named_actors:
+            if not await self._claim_actor_name(spec):
                 self._fail_task(
                     record,
                     TaskError(None, spec.name, f"actor name {spec.name!r} taken"),
                 )
+                info.state = "dead"
+                info.death_cause = "name taken"
                 return
             self._named_actors[spec.name] = spec.actor_id
-        self._actors[spec.actor_id] = info
-        asyncio.ensure_future(self._place_actor(info, record))
-
-    async def _place_actor(self, info: ActorInfo, record: TaskRecord):
-        spec = info.creation_spec
         if not self.node_resources.is_feasible(spec.resources):
             self._fail_task(
                 record,
@@ -806,7 +1434,7 @@ class NodeManager:
         )
         if info.state == "dead":
             return
-        if not graceful and info.restarts_left != 0:
+        if not graceful and info.restarts_left != 0 and not self._shutdown:
             info.state = "restarting"
             if info.restarts_left > 0:
                 info.restarts_left -= 1
@@ -841,6 +1469,10 @@ class NodeManager:
                 self._unpin_deps(creation_record)
             if info.name:
                 self._named_actors.pop(info.name, None)
+                if self._gcs is not None:
+                    asyncio.ensure_future(
+                        self._gcs.drop_named_actor(info.name, info.actor_id)
+                    )
 
     async def _restart_actor(self, info: ActorInfo, record: TaskRecord):
         # Re-run the creation task on a fresh worker (ref analogue:
@@ -859,6 +1491,19 @@ class NodeManager:
     async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         info = self._actors.get(actor_id)
         if info is None:
+            home = self._actor_homes.get(actor_id)
+            if home and home != "dead":
+                try:
+                    peer = await self._get_peer(home)
+                    await peer.notify(
+                        {
+                            "type": "kill_actor_peer",
+                            "actor_id": actor_id,
+                            "no_restart": no_restart,
+                        }
+                    )
+                except Exception:
+                    pass
             return
         if no_restart:
             info.restarts_left = 0
@@ -875,6 +1520,14 @@ class NodeManager:
                     pass
 
     async def get_named_actor(self, name: str) -> Optional[TaskSpec]:
+        if self._gcs is not None:
+            entry = await self._gcs.get_named_actor(name)
+            if entry is None:
+                return None
+            actor_id, node_id, spec = entry
+            if node_id != self.node_id and actor_id not in self._actors:
+                self._actor_homes.setdefault(actor_id, node_id.hex())
+            return spec
         actor_id = self._named_actors.get(name)
         if actor_id is None:
             return None
@@ -893,13 +1546,12 @@ class NodeManager:
         for oid in object_ids:
             if oid not in self._sealed:
                 if self.directory.lookup(oid) is None:
-                    # Never registered or already freed: waiting would hang
-                    # forever. (Nested refs inside serialized args are not
-                    # pinned by the control plane yet — full borrower
-                    # accounting is future work; this turns the silent hang
-                    # into a loud error.)
-                    from .exceptions import ObjectLostError
-
+                    # Never registered here: try the GCS object directory
+                    # (cross-node borrow), else fail loudly — waiting would
+                    # hang forever (ref analogue: OwnershipBasedObjectDirectory
+                    # lookup before PullManager engages).
+                    if await self._locate_via_gcs(oid):
+                        continue
                     raise ObjectLostError(
                         f"object {oid.hex()} is unknown or has been freed; "
                         "if it was only referenced from inside a container "
@@ -910,7 +1562,63 @@ class NodeManager:
             waiters = [ev.wait() for ev in events if not ev.is_set()]
             if waiters:
                 await asyncio.wait_for(asyncio.gather(*waiters), timeout)
-        return [(oid, self.directory.lookup(oid)) for oid in object_ids]
+        out: List[Tuple[ObjectID, Location]] = []
+        for oid in object_ids:
+            loc = self.directory.lookup(oid)
+            if isinstance(loc, RemoteLocation):
+                loc = await self._ensure_local(oid, loc)
+            out.append((oid, loc))
+        return out
+
+    async def _ensure_local(self, oid: ObjectID, loc: RemoteLocation) -> Location:
+        """Pull a remote object's bytes and re-home them locally, deduping
+        concurrent pulls (ref analogue: PullManager bundles + the object
+        buffer pool's single in-flight chunk set per object)."""
+        fut = self._pulls.get(oid)
+        if fut is None:
+            fut = asyncio.ensure_future(self._pull_object(oid, loc))
+            self._pulls[oid] = fut
+
+            def _cleanup(f, oid=oid):
+                if self._pulls.get(oid) is f:
+                    del self._pulls[oid]
+
+            fut.add_done_callback(_cleanup)
+        return await asyncio.shield(fut)
+
+    async def _pull_object(self, oid: ObjectID, loc: RemoteLocation) -> Location:
+        try:
+            peer = await self._get_peer(loc.node_id)
+            reply = await peer.request(
+                {"type": "pull_object", "object_id": oid}
+            )
+        except Exception as e:
+            raise ObjectLostError(
+                f"object {oid.hex()} lives on unreachable node "
+                f"{loc.node_id[:8]}: {e}"
+            ) from e
+        data = reply.get("data")
+        if data is None:
+            raise ObjectLostError(
+                f"object {oid.hex()} was freed on node {loc.node_id[:8]}"
+                + (f" ({reply['error']})" if reply.get("error") else "")
+            )
+        if len(data) <= self.config.max_inline_object_size:
+            new_loc: Location = InlineLocation(bytes(data))
+        else:
+            new_loc = self.local_store.put_raw(oid, data)
+        self.directory.replace_location(oid, new_loc)
+        # The pulled copy is now the locatable one (the source may free and
+        # unpublish its copy once the hold is released).
+        if self._gcs is not None and (self._multi_node or not self.is_head):
+            asyncio.ensure_future(self._publish_seal(oid))
+        if loc.held:
+            # Release the hold the remote node keeps on our behalf.
+            try:
+                await peer.notify({"type": "free_object", "object_id": oid})
+            except Exception:
+                pass
+        return new_loc
 
     async def wait_objects(
         self,
@@ -955,7 +1663,16 @@ class NodeManager:
             for oid, loc in self.directory.collect_garbage(grace):
                 self._sealed.discard(oid)
                 self._seal_events.pop(oid, None)
-                _free_location(loc)
+                if isinstance(loc, RemoteLocation):
+                    if loc.held:
+                        # Release the hold the remote node keeps for us.
+                        asyncio.ensure_future(self._free_remote(loc.node_id, oid))
+                else:
+                    _free_location(loc)
+                    if self._gcs is not None and (
+                        self._multi_node or not self.is_head
+                    ):
+                        asyncio.ensure_future(self._unpublish(oid))
             # Reclaim arena blocks stuck in pending-delete because a pinning
             # reader died without unpinning (ref analogue: plasma client
             # disconnect releasing its objects).
@@ -965,6 +1682,19 @@ class NodeManager:
                     arena.purge_dead_pins()
                 except Exception:
                     pass
+
+    async def _free_remote(self, node_hex: str, oid: ObjectID):
+        try:
+            peer = await self._get_peer(node_hex)
+            await peer.notify({"type": "free_object", "object_id": oid})
+        except Exception:
+            pass
+
+    async def _unpublish(self, oid: ObjectID):
+        try:
+            await self._gcs.unpublish_object(oid)
+        except Exception:
+            pass
 
     async def _reply_locations(self, w: WorkerHandle, msg):
         try:
@@ -993,8 +1723,29 @@ class NodeManager:
     # --------------------------------------------------------------------- kv
 
     async def _handle_kv(self, w: WorkerHandle, msg):
+        """Cluster KV (ref analogue: GCS InternalKV, gcs_kv_manager.h) —
+        authoritative store lives at the GCS; the per-node dict is only a
+        fallback for GCS-less unit setups."""
         op = msg["op"]
         out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
+        if self._gcs is not None:
+            try:
+                if op == "put":
+                    out["added"] = await self._gcs.kv_put(
+                        msg["key"], msg["value"], msg.get("overwrite", True)
+                    )
+                elif op == "get":
+                    out["value"] = await self._gcs.kv_get(
+                        msg["key"], msg.get("wait_timeout") or 0
+                    )
+                elif op == "del":
+                    out["deleted"] = await self._gcs.kv_del(msg["key"])
+                elif op == "keys":
+                    out["keys"] = await self._gcs.kv_keys(msg.get("prefix", ""))
+            except Exception as e:
+                out["error"] = str(e)
+            await w.writer.send(out)
+            return
         if op == "put":
             overwrite = msg.get("overwrite", True)
             if not overwrite and msg["key"] in self._kv:
@@ -1013,6 +1764,8 @@ class NodeManager:
 
     def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
         async def _put():
+            if self._gcs is not None:
+                return await self._gcs.kv_put(key, value, overwrite)
             if not overwrite and key in self._kv:
                 return False
             self._kv[key] = value
@@ -1022,6 +1775,8 @@ class NodeManager:
 
     def kv_get(self, key: str) -> Optional[bytes]:
         async def _get():
+            if self._gcs is not None:
+                return await self._gcs.kv_get(key)
             return self._kv.get(key)
 
         return self.call_sync(_get())
@@ -1031,6 +1786,16 @@ class NodeManager:
     async def cancel_task(self, task_id: TaskID, force: bool = False):
         record = self._tasks.get(task_id)
         if record is None or record.state in ("finished", "failed", "cancelled"):
+            return
+        if record.state == "forwarded" and record.target is not None:
+            try:
+                peer = await self._get_peer(record.target)
+                await peer.notify(
+                    {"type": "cancel_task_peer", "task_id": task_id,
+                     "force": force}
+                )
+            except Exception:
+                pass
             return
         if record.state in ("waiting", "ready", "queued"):
             prev = record.state
@@ -1054,6 +1819,27 @@ class NodeManager:
 
     async def register_function(self, function_id: str, blob: bytes):
         self._functions[function_id] = blob
+        # Export to the cluster function table so every node can lazy-import
+        # (ref analogue: function_manager.py export to GCS KV).
+        if self._gcs is not None:
+            asyncio.ensure_future(self._export_function(function_id, blob))
+
+    async def _export_function(self, function_id: str, blob: bytes):
+        try:
+            await self._gcs.register_function(function_id, blob)
+        except Exception:
+            pass
+
+    async def _function_blob(self, function_id: str) -> Optional[bytes]:
+        blob = self._functions.get(function_id)
+        if blob is None and self._gcs is not None:
+            try:
+                blob = await self._gcs.fetch_function(function_id)
+            except Exception:
+                blob = None
+            if blob is not None:
+                self._functions[function_id] = blob
+        return blob
 
     async def stats(self) -> Dict[str, Any]:
         return {
@@ -1067,7 +1853,17 @@ class NodeManager:
             "available_resources": self.node_resources.available.to_dict(),
             "total_resources": self.node_resources.total.to_dict(),
             "pending_tasks": len(self._ready) + len(self._waiting),
+            "num_nodes": max(1, len(self._cluster_view)),
+            "tasks_forwarded": len(self._forwarded),
         }
+
+    async def cluster_nodes(self) -> List[Dict[str, Any]]:
+        """Alive-node views (ref analogue: ray.nodes() via
+        GlobalStateAccessor)."""
+        if self.is_head and self.gcs_service is not None:
+            return self.gcs_service.nodes_view()
+        self._cluster_view[self.node_id.hex()] = self._local_view()
+        return list(self._cluster_view.values())
 
     # ---------------------------------------------------------------- blocked
 
@@ -1110,6 +1906,16 @@ class NodeManager:
                 self._gc_task.cancel()
             if getattr(self, "_health_task", None) is not None:
                 self._health_task.cancel()
+            if self._heartbeat_task is not None:
+                self._heartbeat_task.cancel()
+            for peer in self._peers.values():
+                peer.close()
+            if self._gcs_client is not None:
+                self._gcs_client.close()
+            if self.gcs_service is not None:
+                self.gcs_service.stop()
+            if self._peer_server is not None:
+                self._peer_server.close()
             for w in list(self._workers.values()):
                 try:
                     await asyncio.wait_for(w.writer.send({"type": "kill"}), 1.0)

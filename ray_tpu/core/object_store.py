@@ -52,7 +52,41 @@ class ArenaLocation:
     size: int
 
 
-Location = Union[InlineLocation, ShmLocation, ArenaLocation]
+@dataclass(frozen=True)
+class RemoteLocation:
+    """Object whose bytes live on another node; resolved by pulling over the
+    peer channel and re-homing locally (ref analogue: an object-directory
+    entry whose location set names a remote plasma store, fetched via
+    ObjectManagerService Push/Pull — object_manager.proto:61).
+
+    ``held`` marks that the remote node keeps a refcount hold on our behalf
+    (forwarded-task return slots); the holder sends ``free_object`` exactly
+    once — after pulling or when its own entry is collected."""
+
+    node_id: str  # hex
+    size: int
+    held: bool = False
+
+
+Location = Union[InlineLocation, ShmLocation, ArenaLocation, RemoteLocation]
+
+
+class _RawPayload:
+    """Adapter presenting already-framed object bytes (as pulled from a
+    remote node) with the SerializedObject write interface."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data):
+        self.data = data
+
+    @property
+    def total_size(self) -> int:
+        return len(self.data)
+
+    def write_into(self, dest: memoryview) -> int:
+        dest[: len(self.data)] = self.data
+        return len(self.data)
 
 
 # ---------------------------------------------------------------------------
@@ -182,6 +216,19 @@ class LocalObjectStore:
             view.release()  # drop the creator pin
         return ArenaLocation(arena.name, oid, size)
 
+    def put_raw(self, object_id: ObjectID, data) -> Location:
+        """Store already-framed object bytes (pulled from a remote node)."""
+        return self.put_serialized(object_id, _RawPayload(data))
+
+    def get_bytes(self, loc: Location) -> bytes:
+        """Copy out the framed bytes of a local object (the push side of
+        inter-node transfer)."""
+        view = self.get_view(loc)
+        try:
+            return bytes(view)
+        finally:
+            view.release()
+
     def _put_segment(self, object_id: ObjectID, sobj: SerializedObject) -> ShmLocation:
         name = _shm_name(object_id)
         size = sobj.total_size
@@ -300,7 +347,10 @@ class ObjectDirectory:
                 self._refcounts[object_id] += initial_refs
                 return
             shared = isinstance(loc, (ShmLocation, ArenaLocation))
-            size = loc.size if shared else len(loc.data)
+            size = (
+                loc.size if shared
+                else len(loc.data) if isinstance(loc, InlineLocation) else 0
+            )
             if shared and self.capacity_bytes > 0:
                 if self.used_bytes + size > self.capacity_bytes:
                     raise ObjectStoreFullError(
@@ -323,9 +373,25 @@ class ObjectDirectory:
         """Replace a pre-registered (placeholder) entry with its real
         location once the producing task finishes."""
         with self._lock:
+            old = self._entries.get(object_id)
+            if isinstance(old, (ShmLocation, ArenaLocation)):
+                self.used_bytes -= old.size
             self._entries[object_id] = loc
             if isinstance(loc, (ShmLocation, ArenaLocation)):
                 self.used_bytes += loc.size
+
+    def replace_location(self, object_id: ObjectID, loc: Location):
+        """Swap an entry's location (remote -> pulled-local re-home),
+        preserving its refcount."""
+        with self._lock:
+            old = self._entries.get(object_id)
+            if old is None:
+                return
+            if isinstance(old, (ShmLocation, ArenaLocation)):
+                self.used_bytes -= old.size
+            if isinstance(loc, (ShmLocation, ArenaLocation)):
+                self.used_bytes += loc.size
+            self._entries[object_id] = loc
 
     def add_ref(self, object_id: ObjectID, count: int = 1):
         with self._lock:

@@ -1,0 +1,89 @@
+"""Node-to-node peer channel.
+
+Plays the role of the reference's raylet↔raylet RPC surface: task spillback
+re-leasing (ref: NodeManager::HandleRequestWorkerLease replying with a
+retry-at-different-node spillback, node_manager.cc:1767) and inter-node
+object transfer (ref: ObjectManagerService Push/Pull,
+src/ray/protobuf/object_manager.proto:61). Framed-pickle messages over TCP;
+one cached client connection per peer, opened lazily from the node manager's
+event loop. Non-reply messages received on a client connection (e.g.
+``task_result`` pushed back by the executing node) are handed to the node
+manager's peer dispatcher, so the channel is full duplex.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+from .protocol import AioFramedWriter as _FramedWriter
+from .protocol import aio_read_frame as _read_frame
+
+
+class PeerClient:
+    def __init__(self, peer_hex: str, host: str, port: int, self_hex: str):
+        self.peer_hex = peer_hex
+        self.host = host
+        self.port = port
+        self.self_hex = self_hex
+        self._writer: Optional[_FramedWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._msg_counter = 0
+        self.closed = False
+        self.on_push: Optional[
+            Callable[[str, Dict[str, Any]], Awaitable[None]]
+        ] = None
+
+    async def connect(self):
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        self._writer = _FramedWriter(writer)
+        await self._writer.send(
+            {"type": "peer_hello", "node_id": self.self_hex}
+        )
+        self._reader_task = asyncio.ensure_future(self._reader_loop(reader))
+
+    async def _reader_loop(self, reader: asyncio.StreamReader):
+        try:
+            while True:
+                msg = await _read_frame(reader)
+                if msg.get("type") == "reply":
+                    fut = self._pending.pop(msg.get("msg_id"), None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+                elif self.on_push is not None:
+                    await self.on_push(self.peer_hex, msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            self.close()
+
+    async def request(self, msg: Dict[str, Any], timeout: float = 60.0):
+        if self.closed or self._writer is None:
+            raise ConnectionError(f"peer {self.peer_hex[:8]} unreachable")
+        self._msg_counter += 1
+        msg_id = self._msg_counter
+        msg["msg_id"] = msg_id
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[msg_id] = fut
+        await self._writer.send(msg)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(msg_id, None)
+
+    async def notify(self, msg: Dict[str, Any]):
+        if self.closed or self._writer is None:
+            raise ConnectionError(f"peer {self.peer_hex[:8]} unreachable")
+        await self._writer.send(msg)
+
+    def close(self):
+        self.closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(
+                    ConnectionError(f"peer {self.peer_hex[:8]} connection lost")
+                )
+        self._pending.clear()
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            self._writer.close()

@@ -75,6 +75,36 @@ class Connection:
         self._sock.close()
 
 
+async def aio_read_frame(reader) -> Dict[str, Any]:
+    """Asyncio-side frame reader (node manager / GCS / peer loops)."""
+    header = await reader.readexactly(_HEADER.size)
+    (length,) = _HEADER.unpack(header)
+    payload = await reader.readexactly(length)
+    return pickle.loads(payload)
+
+
+class AioFramedWriter:
+    """Asyncio-side framed writer with per-connection send serialization."""
+
+    def __init__(self, writer):
+        import asyncio
+
+        self._writer = writer
+        self._lock = asyncio.Lock()
+
+    async def send(self, message: Dict[str, Any]):
+        payload = cloudpickle.dumps(message, protocol=5)
+        async with self._lock:
+            self._writer.write(_HEADER.pack(len(payload)) + payload)
+            await self._writer.drain()
+
+    def close(self):
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+
+
 def connect_unix(path: str, timeout: float = 30.0) -> Connection:
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.settimeout(timeout)

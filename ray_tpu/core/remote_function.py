@@ -60,6 +60,7 @@ class RemoteFunction:
             name=self._options.get("name", getattr(self._fn, "__name__", "task")),
             max_retries=max_retries,
             retries_left=max_retries,
+            scheduling_strategy=self._options.get("scheduling_strategy"),
         )
         refs = rt.submit(spec)
         del keepalive  # deps are pinned by the control plane from here on

@@ -278,10 +278,36 @@ class DriverRuntime(BaseRuntime):
         return self._nm.call_sync(self._nm.stats())
 
     def cluster_resources(self) -> Dict[str, float]:
-        return self._nm.node_resources.total.to_dict()
+        views = self.nodes()
+        if len(views) <= 1:
+            return self._nm.node_resources.total.to_dict()
+        total: Dict[str, float] = {}
+        for v in views:
+            if v.get("state") != "alive":
+                continue
+            for k, amt in v["resources_total"].items():
+                total[k] = total.get(k, 0.0) + amt
+        return total
 
     def available_resources(self) -> Dict[str, float]:
-        return self._nm.node_resources.available.to_dict()
+        views = self.nodes()
+        if len(views) <= 1:
+            return self._nm.node_resources.available.to_dict()
+        avail: Dict[str, float] = {}
+        for v in views:
+            if v.get("state") != "alive":
+                continue
+            src = (
+                self._nm.node_resources.available.to_dict()
+                if v["node_id"] == self._nm.node_id.hex()
+                else v["resources_available"]
+            )
+            for k, amt in src.items():
+                avail[k] = avail.get(k, 0.0) + amt
+        return avail
+
+    def nodes(self):
+        return self._nm.call_sync(self._nm.cluster_nodes())
 
     def shutdown(self):
         super().shutdown()

@@ -1,0 +1,120 @@
+"""Cluster scheduling policies.
+
+Mirrors the reference's policy framework (ref: src/ray/raylet/scheduling/
+policy/scheduling_policy.h ISchedulingPolicy): the default **hybrid** policy
+prefers the local node until its critical-resource utilization crosses the
+spread threshold, then picks the least-utilized feasible node (ref:
+policy/hybrid_scheduling_policy.h:29-49 + scorer.h LeastResourceScorer);
+**spread** round-robins over feasible nodes (spread_scheduling_policy.h:27);
+**node affinity** pins to a node with soft fallback
+(node_affinity_scheduling_policy.h:29).
+
+Inputs are plain dict views of the cluster (from the GCS load broadcast) so
+the policies are pure functions — unit-testable without a cluster, the same
+property the reference gets from ISchedulingPolicy over SchedulingContext.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+from .resources import ResourceSet
+
+_rr_counter = itertools.count()
+
+
+def _fits(request: ResourceSet, available: Dict[str, float]) -> bool:
+    return all(v <= available.get(k, 0.0) + 1e-9 for k, v in request.to_dict().items())
+
+
+def _feasible(request: ResourceSet, total: Dict[str, float]) -> bool:
+    return all(v <= total.get(k, 0.0) + 1e-9 for k, v in request.to_dict().items())
+
+
+def _utilization(view: Dict[str, Any]) -> float:
+    best = 0.0
+    for k, tot in view["resources_total"].items():
+        if tot <= 0:
+            continue
+        avail = view["resources_available"].get(k, 0.0)
+        best = max(best, (tot - avail) / tot)
+    return best
+
+
+def pick_node(
+    request: ResourceSet,
+    strategy: Any,
+    local_id: str,
+    nodes: List[Dict[str, Any]],
+    *,
+    spread_threshold: float = 0.5,
+) -> Optional[str]:
+    """Return the hex node id to run on, or None when the request is
+    infeasible cluster-wide. ``nodes`` are alive-node views (GCS format)."""
+    alive = [n for n in nodes if n["state"] == "alive"]
+    if not alive:
+        return None
+
+    strategy_name = strategy if isinstance(strategy, str) else strategy.kind()
+
+    if strategy_name == "NODE_AFFINITY":
+        target = strategy.node_id
+        for n in alive:
+            if n["node_id"] == target:
+                if _feasible(request, n["resources_total"]):
+                    return target
+                break
+        if getattr(strategy, "soft", False):
+            return pick_node(
+                request, "DEFAULT", local_id, nodes,
+                spread_threshold=spread_threshold,
+            )
+        return None
+
+    if strategy_name == "NODE_LABEL":
+        matched = [
+            n for n in alive
+            if all(n.get("labels", {}).get(k) == v
+                   for k, v in strategy.hard.items())
+            and _feasible(request, n["resources_total"])
+        ]
+        if not matched:
+            return None
+        return pick_node(
+            request, "DEFAULT", local_id, matched,
+            spread_threshold=spread_threshold,
+        )
+
+    feasible = [n for n in alive if _feasible(request, n["resources_total"])]
+    if not feasible:
+        return None
+
+    if strategy_name == "SPREAD":
+        fitting = [n for n in feasible if _fits(request, n["resources_available"])]
+        pool = fitting or feasible
+        pool = sorted(pool, key=lambda n: n["node_id"])
+        return pool[next(_rr_counter) % len(pool)]["node_id"]
+
+    # DEFAULT hybrid: local first while below the spread threshold, then the
+    # least-utilized node that fits; fall back to least-utilized feasible.
+    local = next((n for n in feasible if n["node_id"] == local_id), None)
+    if (
+        local is not None
+        and _fits(request, local["resources_available"])
+        and _utilization(local) < spread_threshold
+    ):
+        return local_id
+    fitting = [n for n in feasible if _fits(request, n["resources_available"])]
+    if fitting:
+        ranked = sorted(
+            fitting,
+            key=lambda n: (_utilization(n), n["node_id"] != local_id, n["node_id"]),
+        )
+        return ranked[0]["node_id"]
+    if local is not None:
+        return local_id  # queue locally until resources free up
+    ranked = sorted(
+        feasible, key=lambda n: (n["pending_tasks"], _utilization(n), n["node_id"])
+    )
+    return ranked[0]["node_id"]

@@ -1,0 +1,35 @@
+"""User-facing scheduling strategies.
+
+Ref analogue: python/ray/util/scheduling_strategies.py —
+NodeAffinitySchedulingStrategy (:41), NodeLabelSchedulingStrategy (:135) and
+the "DEFAULT"/"SPREAD" string strategies accepted by @ray.remote(
+scheduling_strategy=...). PlacementGroupSchedulingStrategy is provided by
+ray_tpu.core.placement_group.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    """Pin a task/actor to one node; ``soft=True`` falls back to the default
+    policy when the node is dead or infeasible."""
+
+    node_id: str
+    soft: bool = False
+
+    def kind(self) -> str:
+        return "NODE_AFFINITY"
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    """Restrict placement to nodes whose labels match ``hard`` exactly."""
+
+    hard: Dict[str, str] = field(default_factory=dict)
+
+    def kind(self) -> str:
+        return "NODE_LABEL"

@@ -57,6 +57,10 @@ class TaskSpec:
     max_concurrency: int = 1
     # Owner bookkeeping (worker that submitted the task; nil = driver)
     owner_id: Optional[WorkerID] = None
+    # Placement: "DEFAULT" | "SPREAD" | NodeAffinitySchedulingStrategy |
+    # NodeLabelSchedulingStrategy (ref analogue: TaskSpec scheduling_strategy
+    # in common.proto + util/scheduling_strategies.py)
+    scheduling_strategy: Any = None
 
     def return_ids(self) -> Tuple[ObjectID, ...]:
         return tuple(

@@ -1,0 +1,229 @@
+"""Multi-node cluster semantics, exercised the way the reference tests them:
+extra node-manager processes on one machine via the Cluster fixture
+(ref analogue: python/ray/tests/ using conftest ray_start_cluster over
+cluster_utils.Cluster.add_node)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.scheduling_policy import pick_node
+from ray_tpu.core.resources import ResourceSet
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(
+        head_resources={"CPU": 2},
+        system_config={
+            "num_prestart_workers": 1,
+            "gc_grace_period_s": 60.0,
+            "default_max_retries": 0,
+        },
+    )
+    yield c
+    c.shutdown()
+
+
+def test_nodes_register_and_report(cluster):
+    cluster.add_node(num_cpus=3, resources={"gadget": 2})
+    views = ray_tpu.nodes()
+    assert len(views) == 2
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 5
+    assert total["gadget"] == 2
+
+
+def test_task_spills_to_remote_node_and_result_returns(cluster):
+    cluster.add_node(num_cpus=1, resources={"gadget": 1})
+
+    @ray_tpu.remote(resources={"gadget": 1})
+    def where():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().get_node_id()
+
+    node_hex = ray_tpu.get(where.remote(), timeout=60)
+    assert node_hex != cluster.head_node_id
+
+
+def test_large_result_pulled_across_nodes(cluster):
+    cluster.add_node(num_cpus=1, resources={"gadget": 1})
+
+    @ray_tpu.remote(resources={"gadget": 1})
+    def make_array():
+        import numpy as np
+
+        return np.arange(300_000, dtype="int64")
+
+    arr = ray_tpu.get(make_array.remote(), timeout=60)
+    assert arr.shape == (300_000,)
+    assert int(arr[12345]) == 12345
+
+
+def test_cross_node_dependency(cluster):
+    cluster.add_node(num_cpus=1, resources={"gadget": 1})
+
+    @ray_tpu.remote(resources={"gadget": 1})
+    def produce():
+        import numpy as np
+
+        return np.ones(200_000, dtype="float32")
+
+    @ray_tpu.remote  # runs on the head
+    def consume(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    assert ray_tpu.get(consume.remote(ref), timeout=60) == 200_000.0
+
+
+def test_spread_strategy_uses_both_nodes(cluster):
+    cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def where():
+        import ray_tpu as rt
+        import time as _t
+
+        _t.sleep(0.2)
+        return rt.get_runtime_context().get_node_id()
+
+    refs = [where.remote() for _ in range(8)]
+    seen = set(ray_tpu.get(refs, timeout=120))
+    assert len(seen) == 2
+
+
+def test_node_affinity_strategy(cluster):
+    handle = cluster.add_node(num_cpus=1)
+    target = handle.node_id_hex
+    assert target is not None
+
+    @ray_tpu.remote(
+        scheduling_strategy=ray_tpu.NodeAffinitySchedulingStrategy(target)
+    )
+    def where():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().get_node_id()
+
+    assert ray_tpu.get(where.remote(), timeout=60) == target
+
+
+def test_actor_on_remote_node(cluster):
+    cluster.add_node(num_cpus=1, resources={"gadget": 1})
+
+    @ray_tpu.remote(resources={"gadget": 0.5})
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+        def node(self):
+            import ray_tpu as rt
+
+            return rt.get_runtime_context().get_node_id()
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.node.remote(), timeout=60) != cluster.head_node_id
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.incr.remote(5), timeout=60) == 6
+
+
+def test_named_actor_visible_across_nodes(cluster):
+    cluster.add_node(num_cpus=1, resources={"gadget": 1})
+
+    @ray_tpu.remote(resources={"gadget": 0.5}, name="reg")
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    _ = Registry.remote()
+    # Lookup from the driver resolves through the GCS name table.
+    time.sleep(0.2)
+    h = ray_tpu.get_actor("reg")
+    assert ray_tpu.get(h.ping.remote(), timeout=60) == "pong"
+
+
+def test_infeasible_in_cluster_fails_loudly(cluster):
+    cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(resources={"no_such_thing": 1})
+    def f():
+        return 1
+
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(f.remote(), timeout=60)
+
+
+def test_node_death_fails_forwarded_task(cluster):
+    handle = cluster.add_node(num_cpus=1, resources={"gadget": 1})
+
+    @ray_tpu.remote(resources={"gadget": 1}, max_retries=0)
+    def slow():
+        import time as _t
+
+        _t.sleep(30)
+        return "done"
+
+    ref = slow.remote()
+    time.sleep(1.0)  # let it get forwarded and start
+    cluster.remove_node(handle)
+    with pytest.raises((ray_tpu.WorkerCrashedError, ray_tpu.TaskError)):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_node_death_retries_on_surviving_node(cluster):
+    handle = cluster.add_node(num_cpus=1, resources={"gadget": 1})
+
+    @ray_tpu.remote(resources={"gadget": 0.5}, max_retries=2)
+    def work():
+        import time as _t
+
+        _t.sleep(3)
+        return "ok"
+
+    ref = work.remote()
+    time.sleep(1.0)
+    # Second node with the same custom resource lets the retry land there.
+    cluster.add_node(num_cpus=1, resources={"gadget": 1})
+    cluster.remove_node(handle)
+    assert ray_tpu.get(ref, timeout=120) == "ok"
+
+
+def test_pick_node_policies_pure():
+    nodes = [
+        {
+            "node_id": "aa", "state": "alive", "pending_tasks": 0,
+            "resources_total": {"CPU": 4}, "resources_available": {"CPU": 0},
+            "labels": {},
+        },
+        {
+            "node_id": "bb", "state": "alive", "pending_tasks": 0,
+            "resources_total": {"CPU": 4}, "resources_available": {"CPU": 4},
+            "labels": {"zone": "z2"},
+        },
+    ]
+    req = ResourceSet({"CPU": 1})
+    # Hybrid: local full -> least-utilized remote.
+    assert pick_node(req, "DEFAULT", "aa", nodes) == "bb"
+    # Affinity hard: dead/absent target -> None.
+    from ray_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        NodeLabelSchedulingStrategy,
+    )
+
+    assert pick_node(req, NodeAffinitySchedulingStrategy("cc"), "aa", nodes) is None
+    assert pick_node(req, NodeAffinitySchedulingStrategy("bb"), "aa", nodes) == "bb"
+    assert (
+        pick_node(req, NodeLabelSchedulingStrategy({"zone": "z2"}), "aa", nodes)
+        == "bb"
+    )
+    # Infeasible everywhere.
+    big = ResourceSet({"CPU": 64})
+    assert pick_node(big, "DEFAULT", "aa", nodes) is None
