@@ -81,6 +81,10 @@ class GcsService:
         self._object_nodes: Dict[ObjectID, NodeID] = {}
         self._object_events: Dict[ObjectID, asyncio.Event] = {}
         self._job_counter = 0
+        # Placement groups (ref analogue: GcsPlacementGroupManager +
+        # GcsPlacementGroupScheduler 2PC across raylets).
+        self._pgs: Dict[str, Dict[str, Any]] = {}
+        self._pg_peers: Dict[str, Any] = {}  # node hex -> PeerClient
 
         # Callbacks into the head node manager (same loop, no locking).
         self.on_node_added: Optional[Callable[[NodeEntry], None]] = None
@@ -117,6 +121,11 @@ class GcsService:
             self._server.close()
         for conn in self._conns.values():
             conn.close()
+        for peer in self._pg_peers.values():
+            if hasattr(peer, "close"):
+                peer.close()
+            else:
+                peer.cancel()
 
     # --------------------------------------------------------------- serving
 
@@ -135,11 +144,15 @@ class GcsService:
             await framed.send({"type": "gcs_welcome"})
             while True:
                 msg = await _read_frame(reader)
-                reply = await self._dispatch(node_id, msg)
-                if reply is not None:
-                    reply["type"] = "reply"
-                    reply["msg_id"] = msg.get("msg_id")
-                    await framed.send(reply)
+                if self._is_blocking_op(msg):
+                    # Long-poll ops must not stall this connection's
+                    # dispatch loop (heartbeats arrive on the same socket;
+                    # stalling them would false-positive the health sweep).
+                    asyncio.ensure_future(
+                        self._dispatch_and_reply(node_id, msg, framed)
+                    )
+                else:
+                    await self._dispatch_and_reply(node_id, msg, framed)
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
         finally:
@@ -149,6 +162,28 @@ class GcsService:
                 entry = self._nodes.get(node_id)
                 if entry is not None and entry.state == "alive":
                     await self._mark_node_dead(entry, "connection closed")
+
+    @staticmethod
+    def _is_blocking_op(msg: Dict[str, Any]) -> bool:
+        op = msg.get("op")
+        return (
+            op == "pg_wait"
+            or (op == "kv_get" and msg.get("wait_timeout"))
+            or (op == "locate_object" and msg.get("timeout"))
+        )
+
+    async def _dispatch_and_reply(self, node_id, msg, framed):
+        try:
+            reply = await self._dispatch(node_id, msg)
+        except Exception as e:
+            reply = {"error": str(e)}
+        if reply is not None:
+            reply["type"] = "reply"
+            reply["msg_id"] = msg.get("msg_id")
+            try:
+                await framed.send(reply)
+            except Exception:
+                pass
 
     async def _dispatch(
         self, node_id: NodeID, msg: Dict[str, Any]
@@ -227,7 +262,193 @@ class GcsService:
             return {"node_id": nid.hex() if nid else None}
         if op == "get_nodes":
             return {"nodes": [e.view() for e in self._nodes.values()]}
+        if op == "pg_create":
+            await self.pg_create(
+                msg["pg_id"], msg["bundles"], msg["strategy"], msg.get("name", "")
+            )
+            return {"ok": True}
+        if op == "pg_wait":
+            return {"ready": await self.pg_wait(msg["pg_id"], msg["timeout"])}
+        if op == "pg_remove":
+            await self.pg_remove(msg["pg_id"])
+            return {"ok": True}
+        if op == "pg_get":
+            return self.pg_get(msg["pg_id"])
+        if op == "pg_table":
+            return {"table": self.pg_table()}
         raise RuntimeError(f"unknown GCS op {op}")
+
+    # ------------------------------------------------------ placement groups
+
+    async def pg_create(
+        self, pg_id: str, bundles: List[Dict[str, float]], strategy: str,
+        name: str = "",
+    ):
+        self._pgs[pg_id] = {
+            "pg_id": pg_id,
+            "bundles": bundles,
+            "strategy": strategy,
+            "name": name,
+            "state": "pending",
+            "nodes": None,
+            "event": asyncio.Event(),
+        }
+        await self._try_place_pg(pg_id)
+
+    async def _try_place_pg(self, pg_id: str):
+        from .resources import ResourceSet
+        from .scheduling_policy import place_bundles
+
+        pg = self._pgs.get(pg_id)
+        if pg is None or pg["state"] != "pending" or pg.get("placing"):
+            return
+        pg["placing"] = True
+        try:
+            reqs = [ResourceSet(b) for b in pg["bundles"]]
+            chosen = place_bundles(reqs, pg["strategy"], self.nodes_view())
+            if chosen is None:
+                return  # stays pending; retried on node join / wait poll
+            # Two-phase commit: prepare everywhere, then commit; roll back
+            # the prepared subset on any failure or concurrent removal (ref:
+            # PrepareBundleResources / CommitBundleResources,
+            # node_manager.proto:382-386).
+            prepared: List[int] = []
+            ok = True
+            for idx, node_hex in enumerate(chosen):
+                try:
+                    peer = await self._pg_peer(node_hex)
+                    reply = await peer.request(
+                        {
+                            "type": "prepare_bundle",
+                            "pg_id": pg_id,
+                            "index": idx,
+                            "resources": pg["bundles"][idx],
+                        },
+                        timeout=10.0,
+                    )
+                    if not reply.get("ok"):
+                        ok = False
+                        break
+                    prepared.append(idx)
+                except Exception:
+                    ok = False
+                    break
+            # Removed (or node lost) while the prepares were in flight?
+            if self._pgs.get(pg_id, {}).get("state") != "pending":
+                ok = False
+            if not ok:
+                await self._release_prepared(pg_id, chosen, prepared)
+                return
+            for idx, node_hex in enumerate(chosen):
+                try:
+                    peer = await self._pg_peer(node_hex)
+                    await peer.notify(
+                        {"type": "commit_bundle", "pg_id": pg_id, "index": idx}
+                    )
+                except Exception:
+                    pass
+            if self._pgs.get(pg_id, {}).get("state") != "pending":
+                await self._release_prepared(pg_id, chosen, prepared)
+                return
+            pg["nodes"] = chosen
+            pg["state"] = "created"
+            pg["event"].set()
+        finally:
+            pg["placing"] = False
+
+    async def _release_prepared(self, pg_id, chosen, prepared):
+        for idx in prepared:
+            try:
+                peer = await self._pg_peer(chosen[idx])
+                await peer.notify(
+                    {"type": "release_bundle", "pg_id": pg_id, "index": idx}
+                )
+            except Exception:
+                pass
+
+    async def pg_wait(self, pg_id: str, timeout: float) -> bool:
+        pg = self._pgs.get(pg_id)
+        if pg is None:
+            return False
+        if pg["state"] == "created":
+            return True
+        await self._try_place_pg(pg_id)
+        pg = self._pgs.get(pg_id)
+        if pg is None:
+            return False
+        try:
+            await asyncio.wait_for(pg["event"].wait(), timeout)
+        except asyncio.TimeoutError:
+            return False
+        return pg["state"] == "created"
+
+    async def pg_remove(self, pg_id: str):
+        pg = self._pgs.get(pg_id)
+        if pg is None:
+            return
+        nodes = pg.get("nodes") or []
+        pg["state"] = "removed"
+        pg["event"].set()
+        for idx, node_hex in enumerate(nodes):
+            try:
+                peer = await self._pg_peer(node_hex)
+                await peer.notify(
+                    {"type": "release_bundle", "pg_id": pg_id, "index": idx}
+                )
+            except Exception:
+                pass
+
+    def pg_get(self, pg_id: str) -> Dict[str, Any]:
+        pg = self._pgs.get(pg_id)
+        if pg is None:
+            return {"state": "unknown", "bundle_nodes": None}
+        return {
+            "state": pg["state"],
+            "bundle_nodes": (
+                {i: n for i, n in enumerate(pg["nodes"])}
+                if pg["nodes"] is not None
+                else None
+            ),
+        }
+
+    def pg_table(self) -> Dict[str, Dict[str, Any]]:
+        return {
+            pg_id: {
+                "bundles": pg["bundles"],
+                "strategy": pg["strategy"],
+                "name": pg["name"],
+                "state": pg["state"],
+                "nodes": pg["nodes"],
+            }
+            for pg_id, pg in self._pgs.items()
+        }
+
+    async def _pg_peer(self, node_hex: str):
+        from .peers import PeerClient
+
+        peer = self._pg_peers.get(node_hex)
+        if isinstance(peer, asyncio.Future):
+            return await asyncio.shield(peer)
+        if peer is not None and not peer.closed:
+            return peer
+        entry = self._nodes.get(NodeID.from_hex(node_hex))
+        if entry is None or entry.state != "alive":
+            raise ConnectionError(f"node {node_hex[:8]} not alive")
+        fut: asyncio.Future = self._loop.create_future()
+        self._pg_peers[node_hex] = fut
+        try:
+            peer = PeerClient(node_hex, entry.host, entry.peer_port, "gcs")
+            await peer.connect()
+        except Exception as e:
+            self._pg_peers.pop(node_hex, None)
+            if not fut.done():
+                fut.set_exception(e)
+                fut.exception()
+            raise
+        self._pg_peers[node_hex] = peer
+        if not fut.done():
+            fut.set_result(peer)
+        return peer
 
     # ----------------------------------------------------------------- nodes
 
@@ -256,7 +477,14 @@ class GcsService:
         )
         if self.on_node_added is not None:
             self.on_node_added(entry)
+        # New capacity may unblock pending placement groups.
+        asyncio.ensure_future(self._retry_pending_pgs())
         return {"nodes": [e.view() for e in self._nodes.values()]}
+
+    async def _retry_pending_pgs(self):
+        for pg_id, pg in list(self._pgs.items()):
+            if pg["state"] == "pending":
+                await self._try_place_pg(pg_id)
 
     def heartbeat(
         self, node_id: NodeID, available: Dict[str, float], pending: int
@@ -440,9 +668,12 @@ class GcsClient:
         self._pending[msg_id] = fut
         await self._writer.send(msg)
         try:
-            return await asyncio.wait_for(fut, timeout)
+            reply = await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(msg_id, None)
+        if reply.get("error"):
+            raise RuntimeError(f"GCS error: {reply['error']}")
+        return reply
 
     async def notify(self, msg: Dict[str, Any]):
         if self.closed or self._writer is None:
@@ -514,6 +745,21 @@ class LocalGcsHandle:
 
     async def locate_object(self, object_id, timeout=0):
         return await self._svc.locate_object(object_id, timeout)
+
+    async def pg_create(self, pg_id, bundles, strategy, name=""):
+        await self._svc.pg_create(pg_id, bundles, strategy, name)
+
+    async def pg_wait(self, pg_id, timeout) -> bool:
+        return await self._svc.pg_wait(pg_id, timeout)
+
+    async def pg_remove(self, pg_id):
+        await self._svc.pg_remove(pg_id)
+
+    async def pg_get(self, pg_id):
+        return self._svc.pg_get(pg_id)
+
+    async def pg_table(self):
+        return self._svc.pg_table()
 
 
 class RemoteGcsHandle:
@@ -610,3 +856,25 @@ class RemoteGcsHandle:
             timeout=max(30.0, timeout + 10.0),
         )
         return NodeID.from_hex(r["node_id"]) if r["node_id"] else None
+
+    async def pg_create(self, pg_id, bundles, strategy, name=""):
+        await self._client.request(
+            {"op": "pg_create", "pg_id": pg_id, "bundles": bundles,
+             "strategy": strategy, "name": name}
+        )
+
+    async def pg_wait(self, pg_id, timeout) -> bool:
+        r = await self._client.request(
+            {"op": "pg_wait", "pg_id": pg_id, "timeout": timeout},
+            timeout=timeout + 15.0,
+        )
+        return r["ready"]
+
+    async def pg_remove(self, pg_id):
+        await self._client.request({"op": "pg_remove", "pg_id": pg_id})
+
+    async def pg_get(self, pg_id):
+        return await self._client.request({"op": "pg_get", "pg_id": pg_id})
+
+    async def pg_table(self):
+        return (await self._client.request({"op": "pg_table"}))["table"]

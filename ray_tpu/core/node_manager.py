@@ -56,9 +56,11 @@ from .object_store import (
     shutdown_arena,
 )
 from .peers import PeerClient
+from .placement_group import BundleState
 from .protocol import AioFramedWriter, aio_read_frame
 from .resources import CPU, NodeResources, ResourceSet
 from .scheduling_policy import pick_node
+from .scheduling_strategies import PlacementGroupSchedulingStrategy
 from .task_spec import TaskSpec, TaskType
 
 _HEADER = struct.Struct("<I")
@@ -111,6 +113,9 @@ class TaskRecord:
     origin: Optional[str] = None
     target: Optional[str] = None
     spillbacks: int = 0
+    # Bundle this task's resources were acquired from, if placed in a
+    # placement group: (pg_id, bundle_index).
+    bundle_key: Optional[Tuple[str, int]] = None
 
 
 @dataclass
@@ -216,6 +221,12 @@ class NodeManager:
         self._heartbeat_task: Optional[asyncio.Task] = None
         # NM-process store client for the pull/push data path.
         self.local_store = LocalObjectStore()
+        # Placement-group bundles reserved on this node + pg routing cache.
+        self._bundles: Dict[Tuple[str, int], BundleState] = {}
+        self._pg_nodes: Dict[str, Dict[int, str]] = {}
+        # Records parked on an in-flight pg-map resolution, keyed by pg id
+        # (one GCS round-trip per group, not per record).
+        self._pg_waiters: Dict[str, List[TaskRecord]] = {}
 
         self._stats = {
             "tasks_submitted": 0,
@@ -557,6 +568,8 @@ class NodeManager:
             self._on_worker_unblocked(w)
         elif mtype == "kv":
             await self._handle_kv(w, msg)
+        elif mtype == "pg":
+            asyncio.ensure_future(self._handle_pg(w, msg))
         elif mtype == "actor_exit":
             await self._on_actor_graceful_exit(w, msg)
         elif mtype == "kill_actor":
@@ -588,9 +601,7 @@ class NodeManager:
         elif w.current is not None:
             record = w.current
             w.current = None
-            if record.resources_held:
-                self.node_resources.release(record.spec.resources)
-                record.resources_held = False
+            self._release_task_resources(record)
             if record.state == "cancelled":
                 pass
             elif record.spec.retries_left > 0:
@@ -654,20 +665,205 @@ class NodeManager:
         if mtype == "cancel_task_peer":
             await self.cancel_task(msg["task_id"], msg.get("force", False))
             return None
+        if mtype == "prepare_bundle":
+            return {"ok": self._prepare_bundle(
+                msg["pg_id"], msg["index"], msg["resources"]
+            )}
+        if mtype == "commit_bundle":
+            bundle = self._bundles.get((msg["pg_id"], msg["index"]))
+            if bundle is not None:
+                bundle.state = "committed"
+            self._schedule()
+            return None
+        if mtype == "release_bundle":
+            self._release_bundle(msg["pg_id"], msg["index"])
+            return None
         raise RuntimeError(f"unknown peer message {mtype}")
+
+    # ------------------------------------------------------ bundle resources
+
+    def _prepare_bundle(self, pg_id: str, index: int, resources) -> bool:
+        """Reserve a bundle's resources from the node pool (ref:
+        PlacementGroupResourceManager::PrepareBundle)."""
+        key = (pg_id, index)
+        if key in self._bundles:
+            return True  # idempotent retry
+        req = ResourceSet(resources)
+        if not self.node_resources.acquire(req):
+            return False
+        self._bundles[key] = BundleState(
+            pg_id=pg_id,
+            index=index,
+            resources=req,
+            available=ResourceSet(_fixed=dict(req._amounts)),
+        )
+        return True
+
+    def _release_bundle(self, pg_id: str, index: int):
+        """Return a bundle's unused reservation to the node pool; resources
+        of still-running bundle tasks flow back on their completion (ref:
+        PlacementGroupResourceManager::ReturnBundle)."""
+        bundle = self._bundles.pop((pg_id, index), None)
+        if bundle is not None:
+            self.node_resources.release(bundle.available)
+        self._pg_nodes.pop(pg_id, None)
+        self._schedule()
+
+    def _find_local_bundle(
+        self, strategy: PlacementGroupSchedulingStrategy, req: ResourceSet
+    ) -> Optional[BundleState]:
+        idx = strategy.placement_group_bundle_index
+        if idx >= 0:
+            bundle = self._bundles.get((strategy.pg_id, idx))
+            if (
+                bundle is not None
+                and bundle.state == "committed"
+                and req.is_subset_of(bundle.available)
+            ):
+                return bundle
+            return None
+        for (pg_id, _i), bundle in sorted(self._bundles.items()):
+            if (
+                pg_id == strategy.pg_id
+                and bundle.state == "committed"
+                and req.is_subset_of(bundle.available)
+            ):
+                return bundle
+        return None
+
+    def _acquire_for_record(self, record: TaskRecord) -> bool:
+        """Bundle-aware resource acquisition; sets record.bundle_key."""
+        strategy = record.spec.scheduling_strategy
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            bundle = self._find_local_bundle(strategy, record.spec.resources)
+            if bundle is None:
+                return False
+            bundle.available = bundle.available - record.spec.resources
+            record.bundle_key = (bundle.pg_id, bundle.index)
+            return True
+        return self.node_resources.acquire(record.spec.resources)
+
+    def _release_task_resources(self, record: TaskRecord):
+        if not record.resources_held:
+            return
+        record.resources_held = False
+        res = record.spec.resources
+        if record.bundle_key is not None:
+            bundle = self._bundles.get(record.bundle_key)
+            if bundle is not None:
+                bundle.available = bundle.available + res
+                return
+            # Bundle released while the task ran: its reservation already
+            # excluded these resources, so they rejoin the node pool.
+        self.node_resources.release(res)
+
+    def _pg_targets(
+        self, strategy: PlacementGroupSchedulingStrategy
+    ) -> Optional[List[str]]:
+        mapping = self._pg_nodes.get(strategy.pg_id)
+        if mapping is None:
+            return None
+        idx = strategy.placement_group_bundle_index
+        if idx >= 0:
+            node = mapping.get(idx)
+            return [node] if node else []
+        return list(dict.fromkeys(mapping.values()))
+
+    def _queue_pg_resolve(self, record: TaskRecord):
+        """Park the record on this pg's (single) in-flight map resolution."""
+        pg_id = record.spec.scheduling_strategy.pg_id
+        waiters = self._pg_waiters.setdefault(pg_id, [])
+        waiters.append(record)
+        if len(waiters) == 1:
+            asyncio.ensure_future(self._resolve_pg(pg_id))
+
+    async def _resolve_pg(self, pg_id: str):
+        """Fetch the bundle->node map from the GCS, then re-place every
+        record parked on it."""
+        ok = False
+        if self._gcs is not None:
+            try:
+                ok = await self._gcs.pg_wait(
+                    pg_id, self.config.object_locate_timeout_s
+                )
+                if ok:
+                    info = await self._gcs.pg_get(pg_id)
+                    nodes = info.get("bundle_nodes")
+                    if nodes:
+                        self._pg_nodes[pg_id] = {
+                            int(k): v for k, v in nodes.items()
+                        }
+                    else:
+                        ok = False
+            except Exception:
+                ok = False
+        for record in self._pg_waiters.pop(pg_id, []):
+            if record.state == "cancelled":
+                continue
+            if ok:
+                self._task_ready(record)
+            else:
+                self._fail_task(
+                    record,
+                    TaskError(
+                        None,
+                        record.spec.name,
+                        f"placement group {pg_id[:8]} is not ready (pending, "
+                        "removed, or unknown)",
+                    ),
+                )
+
+    def _pg_unservable(
+        self, strategy: PlacementGroupSchedulingStrategy, req: ResourceSet
+    ) -> Optional[str]:
+        """A locally-routed PG request that can never be served: request
+        exceeds every candidate bundle's total, or the bundles are gone
+        (group removed). None means 'may fit later, keep waiting'."""
+        idx = strategy.placement_group_bundle_index
+        local = [
+            b for (pg, i), b in self._bundles.items()
+            if pg == strategy.pg_id and (idx < 0 or i == idx)
+        ]
+        if not local:
+            return (
+                f"placement group {strategy.pg_id[:8]} has no bundles on "
+                "this node (removed?)"
+            )
+        if all(not req.is_subset_of(b.resources) for b in local):
+            return (
+                f"request {req.to_dict()} exceeds placement group bundle "
+                f"resources"
+            )
+        return None
 
     async def _get_peer(self, peer_hex: str) -> PeerClient:
         peer = self._peers.get(peer_hex)
+        if isinstance(peer, asyncio.Future):
+            # A concurrent caller is connecting: share its connection so
+            # message order over one socket is preserved.
+            return await asyncio.shield(peer)
         if peer is not None and not peer.closed:
             return peer
         view = self._cluster_view.get(peer_hex)
         if view is None:
             raise ConnectionError(f"node {peer_hex[:8]} not in cluster view")
-        peer = PeerClient(
-            peer_hex, view["host"], view["peer_port"], self.node_id.hex()
-        )
-        await peer.connect()
+        fut: asyncio.Future = self._loop.create_future()
+        self._peers[peer_hex] = fut
+        try:
+            peer = PeerClient(
+                peer_hex, view["host"], view["peer_port"], self.node_id.hex()
+            )
+            await peer.connect()
+        except Exception as e:
+            self._peers.pop(peer_hex, None)
+            if not fut.done():
+                fut.set_exception(e)
+                # Consume if nobody awaited, to silence the loop warning.
+                fut.exception()
+            raise
         self._peers[peer_hex] = peer
+        if not fut.done():
+            fut.set_result(peer)
         return peer
 
     def _serve_pull(self, object_id: ObjectID) -> Dict[str, Any]:
@@ -810,8 +1006,10 @@ class NodeManager:
         NodeManager::NodeRemoved + TaskManager retry on node failure)."""
         self._cluster_view.pop(node_hex, None)
         peer = self._peers.pop(node_hex, None)
-        if peer is not None:
+        if isinstance(peer, PeerClient):
             peer.close()
+        elif peer is not None:
+            peer.cancel()
         # Remote actors homed there are gone (mark before requeueing so
         # re-routed actor tasks fail with ActorDiedError, not a plain-worker
         # dispatch). Actor-restart-on-another-node is future work; creations
@@ -867,6 +1065,12 @@ class NodeManager:
         # task references in ReferenceCounter).
         for oid in spec.dependency_ids():
             self.directory.add_ref(oid)
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            # Register the pending actor synchronously so method calls that
+            # land during async placement queue instead of failing (ref
+            # analogue: RegisterActor before CreateActor,
+            # gcs_actor_manager.cc:255).
+            self._pre_register_actor(spec)
         if spec.task_type == TaskType.ACTOR_TASK:
             # Actor tasks never wait for deps here: the actor's worker
             # resolves arguments at execution, which preserves per-caller
@@ -875,12 +1079,6 @@ class NodeManager:
             return
         missing = {oid for oid in spec.dependency_ids() if oid not in self._sealed}
         if missing:
-            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
-                # Pre-register so method calls issued right after creation
-                # queue on the pending actor instead of failing (ref
-                # analogue: the synchronous RegisterActor before CreateActor,
-                # gcs_actor_manager.cc:255).
-                self._pre_register_actor(spec)
             record.state = "waiting"
             self._waiting[spec.task_id] = (record, missing)
             for oid in missing:
@@ -905,7 +1103,35 @@ class NodeManager:
         """Pick a node for an actor (ref analogue: GcsActorScheduler
         ScheduleByRaylet picking a forward target)."""
         spec = record.spec
-        strategy = getattr(spec, "scheduling_strategy", None) or "DEFAULT"
+        raw_strategy = getattr(spec, "scheduling_strategy", None)
+        if isinstance(raw_strategy, PlacementGroupSchedulingStrategy):
+            targets = self._pg_targets(raw_strategy)
+            if targets is None:
+                self._queue_pg_resolve(record)
+                return
+            if not targets:
+                self._fail_task(
+                    record,
+                    TaskError(
+                        None, spec.name,
+                        "placement group bundle index out of range",
+                    ),
+                )
+                return
+            if self.node_id.hex() in targets or record.origin is not None:
+                self._register_actor(record)
+            else:
+                self._actor_homes[spec.actor_id] = targets[0]
+                info = self._actors.pop(spec.actor_id, None)
+                self._forward_record(record, targets[0])
+                if info is not None:
+                    while info.queued:
+                        qspec = info.queued.popleft()
+                        qrec = self._tasks.get(qspec.task_id)
+                        if qrec is not None and qrec.state != "cancelled":
+                            self._forward_record(qrec, targets[0])
+            return
+        strategy = raw_strategy or "DEFAULT"
         if (
             record.origin is None
             and self._multi_node
@@ -1055,60 +1281,98 @@ class NodeManager:
             if record.state == "cancelled":
                 continue
             spec = record.spec
-            strategy = getattr(spec, "scheduling_strategy", None) or "DEFAULT"
-            if (
-                record.origin is None
-                and self._multi_node
-                and record.spillbacks < self.config.max_task_spillback
-                and (
-                    strategy != "DEFAULT"
-                    or not self.node_resources.can_fit(spec.resources)
-                )
-            ):
-                target = pick_node(
-                    spec.resources,
-                    strategy,
-                    self.node_id.hex(),
-                    list(self._cluster_view.values()),
-                    spread_threshold=self.config.scheduler_spread_threshold,
-                )
-                if target is None:
+            raw_strategy = getattr(spec, "scheduling_strategy", None)
+            if isinstance(raw_strategy, PlacementGroupSchedulingStrategy):
+                # Placement-group routing: the bundle map decides the node;
+                # resources come from the bundle reservation.
+                targets = self._pg_targets(raw_strategy)
+                if targets is None:
+                    record.state = "pg_resolving"
+                    self._queue_pg_resolve(record)
+                    continue
+                if not targets:
                     self._fail_task(
                         record,
                         TaskError(
-                            None,
-                            spec.name,
-                            f"infeasible resource request "
-                            f"{spec.resources.to_dict()} on every node in "
-                            f"the cluster",
+                            None, spec.name,
+                            "placement group bundle index out of range",
                         ),
                     )
                     continue
-                if target != self.node_id.hex():
-                    self._forward_record(record, target)
+                if self.node_id.hex() not in targets:
+                    if record.origin is None:
+                        self._forward_record(record, targets[0])
+                    else:
+                        deferred.append(record)
                     continue
-            if not self.node_resources.can_fit(record.spec.resources):
-                if not self.node_resources.is_feasible(record.spec.resources):
-                    self._fail_task(
-                        record,
-                        TaskError(
-                            None,
-                            record.spec.name,
-                            f"infeasible resource request "
-                            f"{record.spec.resources.to_dict()} on node with "
-                            f"{self.node_resources.total.to_dict()}",
-                        ),
+                if self._find_local_bundle(raw_strategy, spec.resources) is None:
+                    reason = self._pg_unservable(raw_strategy, spec.resources)
+                    if reason is not None:
+                        self._fail_task(
+                            record, TaskError(None, spec.name, reason)
+                        )
+                    else:
+                        deferred.append(record)  # bundle busy, wait
+                    continue
+            else:
+                strategy = raw_strategy or "DEFAULT"
+                if (
+                    record.origin is None
+                    and self._multi_node
+                    and record.spillbacks < self.config.max_task_spillback
+                    and (
+                        strategy != "DEFAULT"
+                        or not self.node_resources.can_fit(spec.resources)
                     )
+                ):
+                    target = pick_node(
+                        spec.resources,
+                        strategy,
+                        self.node_id.hex(),
+                        list(self._cluster_view.values()),
+                        spread_threshold=self.config.scheduler_spread_threshold,
+                    )
+                    if target is None:
+                        self._fail_task(
+                            record,
+                            TaskError(
+                                None,
+                                spec.name,
+                                f"infeasible resource request "
+                                f"{spec.resources.to_dict()} on every node in "
+                                f"the cluster",
+                            ),
+                        )
+                        continue
+                    if target != self.node_id.hex():
+                        self._forward_record(record, target)
+                        continue
+                if not self.node_resources.can_fit(record.spec.resources):
+                    if not self.node_resources.is_feasible(record.spec.resources):
+                        self._fail_task(
+                            record,
+                            TaskError(
+                                None,
+                                record.spec.name,
+                                f"infeasible resource request "
+                                f"{record.spec.resources.to_dict()} on node with "
+                                f"{self.node_resources.total.to_dict()}",
+                            ),
+                        )
+                        continue
+                    deferred.append(record)
                     continue
-                deferred.append(record)
-                continue
             wtype = _task_worker_type(record.spec)
             worker = self._take_idle_worker(wtype)
             if worker is None:
                 spawn_needed.add(wtype)
                 deferred.append(record)
                 continue
-            self.node_resources.acquire(record.spec.resources)
+            if not self._acquire_for_record(record):
+                # Lost the race (bundle drained between check and acquire).
+                self._idle[worker.worker_type].appendleft(worker.worker_id)
+                deferred.append(record)
+                continue
             record.resources_held = True
             record.state = "running"
             record.worker_id = worker.worker_id
@@ -1207,9 +1471,7 @@ class NodeManager:
                         info.state = "alive"
                         self._flush_actor_queue(info)
         else:
-            if record.resources_held:
-                self.node_resources.release(record.spec.resources)
-                record.resources_held = False
+            self._release_task_resources(record)
             w.current = None
             if w.state != "dead":
                 w.state = "idle"
@@ -1347,8 +1609,18 @@ class NodeManager:
         wtype = _task_worker_type(spec)
         # Atomically acquire resources (acquire() both checks and takes, so
         # two concurrently-placing actors can't share an exclusive resource),
-        # then wait for a worker without blocking the loop.
-        while not self.node_resources.acquire(spec.resources):
+        # then wait for a worker without blocking the loop. PG-scheduled
+        # actors draw from their bundle reservation instead of the pool.
+        while not self._acquire_for_record(record):
+            strategy = spec.scheduling_strategy
+            if isinstance(strategy, PlacementGroupSchedulingStrategy):
+                reason = self._pg_unservable(strategy, spec.resources)
+                if reason is not None:
+                    self._fail_task(record, TaskError(None, spec.name, reason))
+                    info.state = "dead"
+                    info.death_cause = reason
+                    self._fail_actor_queue(info, reason)
+                    return
             await asyncio.sleep(0.01)
             if self._shutdown:
                 return
@@ -1357,7 +1629,8 @@ class NodeManager:
             self._maybe_spawn_worker_for_actor(wtype)
             await asyncio.sleep(0.01)
             if self._shutdown:
-                self.node_resources.release(spec.resources)
+                record.resources_held = True
+                self._release_task_resources(record)
                 return
             worker = self._take_idle_worker(wtype)
         worker.state = "actor"
@@ -1421,9 +1694,8 @@ class NodeManager:
         if info is None:
             return
         creation_record = self._tasks.get(info.creation_spec.task_id)
-        if creation_record is not None and creation_record.resources_held:
-            self.node_resources.release(info.creation_spec.resources)
-            creation_record.resources_held = False
+        if creation_record is not None:
+            self._release_task_resources(creation_record)
         graceful = getattr(w, "_graceful_exit", False)
         cause = "graceful exit" if graceful else "actor worker process died"
         inflight = list(info.inflight.values())
@@ -1762,6 +2034,38 @@ class NodeManager:
             out["keys"] = [k for k in self._kv if k.startswith(prefix)]
         await w.writer.send(out)
 
+    # ------------------------------------------------- placement-group proxy
+
+    async def _handle_pg(self, w: WorkerHandle, msg):
+        out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
+        try:
+            out.update(await self.pg_op(msg))
+        except Exception as e:
+            out["error"] = str(e)
+        try:
+            await w.writer.send(out)
+        except Exception:
+            pass
+
+    async def pg_op(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        if self._gcs is None:
+            raise RuntimeError("placement groups require the cluster GCS")
+        op = msg["op"]
+        if op == "create":
+            await self._gcs.pg_create(
+                msg["pg_id"], msg["bundles"], msg["strategy"], msg.get("name", "")
+            )
+            return {"ok": True}
+        if op == "wait":
+            return {"ready": await self._gcs.pg_wait(msg["pg_id"], msg["timeout"])}
+        if op == "remove":
+            await self._gcs.pg_remove(msg["pg_id"])
+            self._pg_nodes.pop(msg["pg_id"], None)
+            return {"ok": True}
+        if op == "table":
+            return {"table": await self._gcs.pg_table()}
+        raise RuntimeError(f"unknown pg op {op}")
+
     def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
         async def _put():
             if self._gcs is not None:
@@ -1872,8 +2176,9 @@ class NodeManager:
         tasks can run (ref analogue: NodeManager::HandleNotifyWorkerBlocked +
         the CPU release in local_task_manager)."""
         if w.state == "busy" and w.current is not None and w.current.resources_held:
-            self.node_resources.release(w.current.spec.resources)
-            w.current.resources_held = False
+            bundle_key = w.current.bundle_key  # keep for re-acquire
+            self._release_task_resources(w.current)
+            w.current.bundle_key = bundle_key
             w.state = "blocked"
             self._schedule()
 
@@ -1882,16 +2187,31 @@ class NodeManager:
             # Oversubscribe if necessary: clamp availability at zero rather
             # than deadlocking (the reference behaves the same way when a
             # blocked worker resumes).
-            res = w.current.spec.resources
-            if not self.node_resources.acquire(res):
-                avail = self.node_resources.available
+            record = w.current
+            res = record.spec.resources
+
+            def _force_take(avail: ResourceSet) -> ResourceSet:
                 fixed = dict(avail._amounts)
                 for k, v in res._amounts.items():
                     fixed[k] = max(0, fixed.get(k, 0) - v)
-                from .resources import ResourceSet as _RS
+                return ResourceSet(_fixed=fixed)
 
-                self.node_resources.available = _RS(_fixed=fixed)
-            w.current.resources_held = True
+            if record.bundle_key is not None and (
+                bundle := self._bundles.get(record.bundle_key)
+            ) is not None:
+                if res.is_subset_of(bundle.available):
+                    bundle.available = bundle.available - res
+                else:
+                    bundle.available = _force_take(bundle.available)
+            elif not self.node_resources.acquire(res):
+                # Includes the bundle-released-while-blocked case: the
+                # reservation rejoined the pool, so take from (and later
+                # release to) the pool.
+                record.bundle_key = None
+                self.node_resources.available = _force_take(
+                    self.node_resources.available
+                )
+            record.resources_held = True
             w.state = "busy"
 
     # --------------------------------------------------------------- shutdown
@@ -1909,7 +2229,10 @@ class NodeManager:
             if self._heartbeat_task is not None:
                 self._heartbeat_task.cancel()
             for peer in self._peers.values():
-                peer.close()
+                if isinstance(peer, PeerClient):
+                    peer.close()
+                else:
+                    peer.cancel()
             if self._gcs_client is not None:
                 self._gcs_client.close()
             if self.gcs_service is not None:

@@ -1,0 +1,133 @@
+"""Placement groups: gang reservation of resource bundles across nodes.
+
+Ref analogue: python/ray/util/placement_group.py (:41 PlacementGroup, :146
+placement_group()) over the GCS placement-group manager's two-phase
+prepare/commit across raylets (src/ray/gcs/gcs_server/
+gcs_placement_group_scheduler.h; node side
+raylet/placement_group_resource_manager.h — PrepareBundleResources /
+CommitBundleResources, node_manager.proto:382-386). Bundle placement
+policies pack/spread/strict_pack/strict_spread mirror
+raylet/scheduling/policy/bundle_scheduling_policy.h:82-106.
+
+On TPU pods a placement group whose bundles are the hosts of one slice is
+the SPMD gang primitive: `ray_tpu.parallel` schedules one host-actor per
+bundle and runs the same pjit program on each (SURVEY.md §7 item 5).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass
+from typing import Dict, List
+
+from .resources import ResourceSet
+from .runtime_context import current_runtime
+from .scheduling_strategies import PlacementGroupSchedulingStrategy  # noqa: F401
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+@dataclass
+class BundleState:
+    """Node-side record of one reserved bundle."""
+
+    pg_id: str
+    index: int
+    resources: ResourceSet
+    available: ResourceSet
+    state: str = "prepared"  # prepared | committed | released
+
+
+class PlacementGroup:
+    """Client handle; picklable (travels inside task specs)."""
+
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
+                 strategy: str = "PACK", name: str = ""):
+        self.id = pg_id
+        self._bundles = bundles
+        self.strategy = strategy
+        self.name = name
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return list(self._bundles)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self._bundles)
+
+    def ready(self):
+        """ObjectRef resolving once the group is reserved — implemented as a
+        no-op task scheduled into the group, so it also proves end-to-end
+        routing (ref: PlacementGroup.ready returning an ObjectRef)."""
+        from .remote_function import RemoteFunction
+
+        probe = RemoteFunction(
+            _pg_ready_probe,
+            {
+                "scheduling_strategy": PlacementGroupSchedulingStrategy(self),
+                "num_cpus": 0,
+                "name": f"pg-ready-{self.id[:8]}",
+                "max_retries": 0,
+            },
+        )
+        return probe.remote(self.id)
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        """Block until all bundles are committed (ref: PlacementGroup.wait)."""
+        return current_runtime().pg_wait(self.id, timeout_seconds)
+
+    def __reduce__(self):
+        return (
+            _rebuild_pg,
+            (self.id, self._bundles, self.strategy, self.name),
+        )
+
+    def __repr__(self):
+        return (
+            f"PlacementGroup(id={self.id[:8]}, bundles={self._bundles}, "
+            f"strategy={self.strategy})"
+        )
+
+
+def _rebuild_pg(pg_id, bundles, strategy, name):
+    return PlacementGroup(pg_id, bundles, strategy, name)
+
+
+def _pg_ready_probe(pg_id: str) -> str:
+    return pg_id
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+) -> PlacementGroup:
+    """Reserve ``bundles`` across the cluster (ref:
+    util/placement_group.py:146). Returns immediately; use .wait()/.ready()
+    for confirmation."""
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(
+            f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}"
+        )
+    if not bundles:
+        raise ValueError("placement group needs at least one bundle")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"invalid bundle {b!r}")
+    pg_id = uuid.uuid4().hex
+    rt = current_runtime()
+    rt.pg_create(pg_id, [dict(b) for b in bundles], strategy, name)
+    return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy, name)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    """Release the group's reservations (ref:
+    util/placement_group.py remove_placement_group)."""
+    current_runtime().pg_remove(pg.id)
+
+
+def placement_group_table() -> Dict[str, Dict]:
+    """Introspection over all groups (ref: util/placement_group.py
+    placement_group_table)."""
+    return current_runtime().pg_table()

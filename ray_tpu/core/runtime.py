@@ -309,6 +309,28 @@ class DriverRuntime(BaseRuntime):
     def nodes(self):
         return self._nm.call_sync(self._nm.cluster_nodes())
 
+    # Placement groups (ref analogue: the GCS PG RPCs the driver issues).
+
+    def pg_create(self, pg_id, bundles, strategy, name=""):
+        self._nm.call_sync(
+            self._nm.pg_op(
+                {"op": "create", "pg_id": pg_id, "bundles": bundles,
+                 "strategy": strategy, "name": name}
+            )
+        )
+
+    def pg_wait(self, pg_id, timeout) -> bool:
+        return self._nm.call_sync(
+            self._nm.pg_op({"op": "wait", "pg_id": pg_id, "timeout": timeout}),
+            timeout=timeout + 15.0,
+        )["ready"]
+
+    def pg_remove(self, pg_id):
+        self._nm.call_sync(self._nm.pg_op({"op": "remove", "pg_id": pg_id}))
+
+    def pg_table(self):
+        return self._nm.call_sync(self._nm.pg_op({"op": "table"}))["table"]
+
     def shutdown(self):
         super().shutdown()
         self.refs.flush()
@@ -423,6 +445,33 @@ class WorkerRuntime(BaseRuntime):
 
     def cancel_task(self, task_id: TaskID, force: bool = False):
         self._conn.send({"type": "cancel_task", "task_id": task_id, "force": force})
+
+    # Placement groups proxy through the node socket.
+
+    def _pg_request(self, msg, timeout=None):
+        msg["type"] = "pg"
+        reply = self.request(msg, timeout)
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return reply
+
+    def pg_create(self, pg_id, bundles, strategy, name=""):
+        self._pg_request(
+            {"op": "create", "pg_id": pg_id, "bundles": bundles,
+             "strategy": strategy, "name": name}
+        )
+
+    def pg_wait(self, pg_id, timeout) -> bool:
+        return self._pg_request(
+            {"op": "wait", "pg_id": pg_id, "timeout": timeout},
+            timeout=timeout + 15.0,
+        )["ready"]
+
+    def pg_remove(self, pg_id):
+        self._pg_request({"op": "remove", "pg_id": pg_id})
+
+    def pg_table(self):
+        return self._pg_request({"op": "table"})["table"]
 
 
 class _PendingReply:

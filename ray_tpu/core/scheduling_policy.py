@@ -118,3 +118,75 @@ def pick_node(
         feasible, key=lambda n: (n["pending_tasks"], _utilization(n), n["node_id"])
     )
     return ranked[0]["node_id"]
+
+
+def place_bundles(
+    bundles: List[ResourceSet],
+    strategy: str,
+    nodes: List[Dict[str, Any]],
+) -> Optional[List[str]]:
+    """Choose one node per bundle, or None if currently unplaceable
+    (ref: bundle policies in policy/bundle_scheduling_policy.h:82-106 —
+    pack/spread best-effort, strict variants hard requirements).
+
+    Placement is simulated against a copy of each node's *available*
+    resources so multiple bundles packing onto one node are accounted."""
+    alive = [n for n in nodes if n["state"] == "alive"]
+    if not alive:
+        return None
+    sim = {
+        n["node_id"]: dict(n["resources_available"]) for n in alive
+    }
+
+    def take(node_id: str, req: ResourceSet) -> bool:
+        avail = sim[node_id]
+        d = req.to_dict()
+        if not all(v <= avail.get(k, 0.0) + 1e-9 for k, v in d.items()):
+            return False
+        for k, v in d.items():
+            avail[k] = avail.get(k, 0.0) - v
+        return True
+
+    order = sorted(sim)  # deterministic
+    out: List[str] = []
+    if strategy == "STRICT_PACK":
+        # All bundles must share one node: try each node as the sole host.
+        for nid in order:
+            saved = {k: dict(v) for k, v in sim.items()}
+            if all(take(nid, req) for req in bundles):
+                return [nid] * len(bundles)
+            sim.update(saved)
+        return None
+    if strategy == "PACK":
+        for req in bundles:
+            placed = None
+            # Prefer the node already used most (pack), seeded by order.
+            for nid in sorted(order, key=lambda n: (-out.count(n), n)):
+                if take(nid, req):
+                    placed = nid
+                    break
+            if placed is None:
+                return None
+            out.append(placed)
+        return out
+    # SPREAD / STRICT_SPREAD: round-robin distinct nodes.
+    used: List[str] = []
+    for req in bundles:
+        candidates = [n for n in order if n not in used] or (
+            order if strategy == "SPREAD" else []
+        )
+        placed = None
+        for nid in candidates:
+            if take(nid, req):
+                placed = nid
+                break
+        if placed is None and strategy == "SPREAD":
+            for nid in order:
+                if take(nid, req):
+                    placed = nid
+                    break
+        if placed is None:
+            return None
+        out.append(placed)
+        used.append(placed)
+    return out

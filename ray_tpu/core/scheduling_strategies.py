@@ -33,3 +33,30 @@ class NodeLabelSchedulingStrategy:
 
     def kind(self) -> str:
         return "NODE_LABEL"
+
+
+class PlacementGroupSchedulingStrategy:
+    """Run inside a placement group's reserved bundles (ref:
+    util/scheduling_strategies.py:15). ``placement_group_bundle_index=-1``
+    means any bundle with room."""
+
+    def __init__(self, placement_group, placement_group_bundle_index: int = -1):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+
+    @property
+    def pg_id(self) -> str:
+        return self.placement_group.id
+
+    def kind(self) -> str:
+        return "PLACEMENT_GROUP"
+
+    def __reduce__(self):
+        return (
+            _rebuild_pg_strategy,
+            (self.placement_group, self.placement_group_bundle_index),
+        )
+
+
+def _rebuild_pg_strategy(pg, index):
+    return PlacementGroupSchedulingStrategy(pg, index)

@@ -1,0 +1,27 @@
+"""ray_tpu.util: utility APIs mirroring ray.util.
+
+Ref analogue: python/ray/util/__init__.py — placement groups,
+scheduling strategies, ActorPool, queue, metrics.
+"""
+
+from ray_tpu.core.placement_group import (  # noqa: F401
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.core.scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "PlacementGroup",
+    "placement_group",
+    "placement_group_table",
+    "remove_placement_group",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]

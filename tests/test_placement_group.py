@@ -1,0 +1,149 @@
+"""Placement groups: reservation, strategies, bundle-scoped scheduling
+(ref analogue: python/ray/tests/test_placement_group*.py over the
+single-machine multi-node Cluster fixture)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.core.scheduling_policy import place_bundles
+from ray_tpu.util import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(
+        head_resources={"CPU": 2},
+        system_config={"num_prestart_workers": 1, "default_max_retries": 0},
+    )
+    yield c
+    c.shutdown()
+
+
+def test_place_bundles_policies_pure():
+    nodes = [
+        {"node_id": "aa", "state": "alive",
+         "resources_available": {"CPU": 4}, "resources_total": {"CPU": 4}},
+        {"node_id": "bb", "state": "alive",
+         "resources_available": {"CPU": 4}, "resources_total": {"CPU": 4}},
+    ]
+    two = [ResourceSet({"CPU": 2}), ResourceSet({"CPU": 2})]
+    assert place_bundles(two, "STRICT_PACK", nodes) == ["aa", "aa"]
+    assert place_bundles(two, "STRICT_SPREAD", nodes) == ["aa", "bb"]
+    spread = place_bundles(two, "SPREAD", nodes)
+    assert sorted(set(spread)) == ["aa", "bb"]
+    # STRICT_PACK impossible when one node can't hold all bundles.
+    three = [ResourceSet({"CPU": 3}), ResourceSet({"CPU": 3})]
+    assert place_bundles(three, "STRICT_PACK", nodes) is None
+    # STRICT_SPREAD impossible with more bundles than nodes.
+    four = [ResourceSet({"CPU": 1})] * 3
+    assert place_bundles(four, "STRICT_SPREAD", nodes) is None
+
+
+def test_pg_single_node_reserve_and_run(cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 0),
+    )
+    def inside():
+        return "ran"
+
+    assert ray_tpu.get(inside.remote(), timeout=60) == "ran"
+    table = placement_group_table()
+    assert table[pg.id]["state"] == "created"
+    remove_placement_group(pg)
+
+
+def test_pg_ready_probe(cluster):
+    pg = placement_group([{"CPU": 1}])
+    assert ray_tpu.get(pg.ready(), timeout=60) == pg.id
+
+
+def test_pg_strict_spread_lands_on_distinct_nodes(cluster):
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(30)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().get_node_id()
+
+    a = where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 0)
+    ).remote()
+    b = where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 1)
+    ).remote()
+    na, nb = ray_tpu.get([a, b], timeout=90)
+    assert na != nb
+
+
+def test_pg_actor_in_bundle(cluster):
+    cluster.add_node(num_cpus=2, resources={"gadget": 1})
+    pg = placement_group([{"gadget": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote(
+        resources={"gadget": 1},
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 0),
+    )
+    class Pinned:
+        def where(self):
+            import ray_tpu as rt
+
+            return rt.get_runtime_context().get_node_id()
+
+    p = Pinned.remote()
+    assert ray_tpu.get(p.where.remote(), timeout=90) != cluster.head_node_id
+
+
+def test_pg_pending_until_capacity(cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}, {"CPU": 4}], "PACK")
+    assert not pg.wait(1.0)  # head alone (2 CPU) can't host the 4-CPU bundle
+    cluster.add_node(num_cpus=6)
+    assert pg.wait(30)
+
+
+def test_pg_removal_frees_resources(cluster):
+    pg = placement_group([{"CPU": 2}])
+    assert pg.wait(30)
+    remove_placement_group(pg)
+
+    # All head CPUs are usable again by plain tasks.
+    @ray_tpu.remote(num_cpus=2)
+    def f():
+        return 7
+
+    assert ray_tpu.get(f.remote(), timeout=60) == 7
+
+
+def test_pg_worker_can_create_and_use(cluster):
+    @ray_tpu.remote
+    def driver_like():
+        from ray_tpu.util import (
+            PlacementGroupSchedulingStrategy as S,
+            placement_group as make_pg,
+        )
+        import ray_tpu as rt
+
+        pg = make_pg([{"CPU": 1}])
+        assert pg.wait(30)
+
+        @rt.remote(num_cpus=1, scheduling_strategy=S(pg, 0))
+        def inner():
+            return 11
+
+        return rt.get(inner.remote(), timeout=60)
+
+    assert ray_tpu.get(driver_like.remote(), timeout=90) == 11
