@@ -78,6 +78,24 @@ class Config:
     # object directory before raising ObjectLostError. Generous because a
     # miss may just mean the producing task is still running on its node.
     object_locate_timeout_s: float = 30.0
+    # --- lineage reconstruction (ref: object_recovery_manager.h +
+    # TaskManager lineage re-execution, task_manager.h:195) ----------------
+    # Re-execute the creating task of a lost task-return object.
+    enable_lineage_reconstruction: bool = True
+    # Reconstruction budget per object (ref analogue:
+    # task_oom_retries / max object reconstructions bounding re-execution).
+    max_object_reconstructions: int = 3
+    # --- object spilling + memory pressure (ref: local_object_manager.h:41,
+    # common/memory_monitor.h:52, raylet/worker_killing_policy.h:34) -------
+    # Spill cold objects to session_dir/spill/ instead of refusing puts.
+    object_spilling_enabled: bool = True
+    # Store-usage fraction that starts a spill pass / where it stops.
+    spill_high_water_frac: float = 0.8
+    spill_low_water_frac: float = 0.5
+    # Node memory monitor: kill the newest retriable task's worker when
+    # system memory usage exceeds this fraction (<= 0 disables).
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 0.5
 
     def __post_init__(self):
         for f in dataclasses.fields(self):

@@ -78,7 +78,10 @@ class GcsService:
         # name -> (actor_id, node_id, creation_spec)
         self._named_actors: Dict[str, Tuple[ActorID, NodeID, Any]] = {}
         self._actor_nodes: Dict[ActorID, NodeID] = {}
-        self._object_nodes: Dict[ObjectID, NodeID] = {}
+        # Object location directory: per-node location *sets* so a node
+        # GC-ing its pulled replica cannot delete the producer's entry (ref
+        # analogue: ObjectDirectory's per-object node sets).
+        self._object_nodes: Dict[ObjectID, set] = {}
         self._object_events: Dict[ObjectID, asyncio.Event] = {}
         self._job_counter = 0
         # Placement groups (ref analogue: GcsPlacementGroupManager +
@@ -90,6 +93,7 @@ class GcsService:
         self.on_node_added: Optional[Callable[[NodeEntry], None]] = None
         self.on_node_dead: Optional[Callable[[NodeEntry], None]] = None
         self.on_load_update: Optional[Callable[[Dict[str, Any]], None]] = None
+        self.on_pgs_invalidated: Optional[Callable[[List[str]], None]] = None
 
         self._health_task: Optional[asyncio.Task] = None
 
@@ -111,6 +115,9 @@ class GcsService:
             await asyncio.sleep(self.config.heartbeat_interval_s)
             if self._conns or self.on_load_update is not None:
                 await self._broadcast_load()
+            # Resources freed by finishing tasks must retrigger placement of
+            # pending groups, not just node joins (advisor finding r1).
+            await self._retry_pending_pgs()
 
     def stop(self):
         if self._health_task is not None:
@@ -255,7 +262,7 @@ class GcsService:
             self.publish_object(msg["object_id"], node_id)
             return None
         if op == "unpublish_object":
-            self._object_nodes.pop(msg["object_id"], None)
+            self.unpublish_object(msg["object_id"], node_id)
             return None
         if op == "locate_object":
             nid = await self.locate_object(msg["object_id"], msg.get("timeout", 0))
@@ -520,11 +527,12 @@ class GcsService:
         conn = self._conns.pop(entry.node_id, None)
         if conn is not None:
             conn.close()
+        peer = self._pg_peers.pop(entry.node_id.hex(), None)
+        if peer is not None and hasattr(peer, "close"):
+            peer.close()
         # Purge location/actor records pointing at the dead node.
-        self._object_nodes = {
-            oid: nid for oid, nid in self._object_nodes.items()
-            if nid != entry.node_id
-        }
+        for oid in list(self._object_nodes):
+            self.unpublish_object(oid, entry.node_id)
         dead_actors = [
             aid for aid, nid in self._actor_nodes.items() if nid == entry.node_id
         ]
@@ -534,16 +542,34 @@ class GcsService:
             name: rec for name, rec in self._named_actors.items()
             if rec[1] != entry.node_id
         }
+        # Placement groups with a bundle on the dead node go back to pending
+        # and are re-placed; node managers drop their bundle reservations and
+        # routing caches so tasks re-resolve instead of forwarding into the
+        # void (ref analogue: GcsPlacementGroupManager::OnNodeDead
+        # rescheduling).
+        invalid_pgs: List[str] = []
+        dead_hex = entry.node_id.hex()
+        for pg_id, pg in self._pgs.items():
+            if pg["state"] == "created" and pg["nodes"] and dead_hex in pg["nodes"]:
+                pg["state"] = "pending"
+                pg["nodes"] = None
+                pg["event"] = asyncio.Event()
+                invalid_pgs.append(pg_id)
         await self._broadcast(
             {
                 "type": "node_dead",
-                "node_id": entry.node_id.hex(),
+                "node_id": dead_hex,
                 "reason": reason,
                 "dead_actors": [a.hex() for a in dead_actors],
+                "invalid_pgs": invalid_pgs,
             }
         )
+        if invalid_pgs and self.on_pgs_invalidated is not None:
+            self.on_pgs_invalidated(invalid_pgs)
         if self.on_node_dead is not None:
             self.on_node_dead(entry)
+        if invalid_pgs:
+            asyncio.ensure_future(self._retry_pending_pgs())
 
     async def _broadcast(self, msg: Dict[str, Any], exclude: Optional[NodeID] = None):
         for nid, conn in list(self._conns.items()):
@@ -592,15 +618,33 @@ class GcsService:
     # --------------------------------------------------------------- objects
 
     def publish_object(self, object_id: ObjectID, node_id: NodeID):
-        self._object_nodes[object_id] = node_id
+        self._object_nodes.setdefault(object_id, set()).add(node_id)
         ev = self._object_events.pop(object_id, None)
         if ev is not None:
             ev.set()
 
+    def unpublish_object(self, object_id: ObjectID, node_id: Optional[NodeID]):
+        """Remove only the *sender's* replica registration; other nodes'
+        copies stay locatable."""
+        nodes = self._object_nodes.get(object_id)
+        if nodes is None:
+            return
+        if node_id is not None:
+            nodes.discard(node_id)
+        if not nodes or node_id is None:
+            self._object_nodes.pop(object_id, None)
+
+    def _pick_object_node(self, object_id: ObjectID) -> Optional[NodeID]:
+        for nid in self._object_nodes.get(object_id, ()):  # any alive replica
+            entry = self._nodes.get(nid)
+            if entry is not None and entry.state == "alive":
+                return nid
+        return None
+
     async def locate_object(
         self, object_id: ObjectID, timeout: float = 0
     ) -> Optional[NodeID]:
-        nid = self._object_nodes.get(object_id)
+        nid = self._pick_object_node(object_id)
         if nid is not None or timeout <= 0:
             return nid
         ev = self._object_events.setdefault(object_id, asyncio.Event())
@@ -608,7 +652,7 @@ class GcsService:
             await asyncio.wait_for(ev.wait(), timeout)
         except asyncio.TimeoutError:
             return None
-        return self._object_nodes.get(object_id)
+        return self._pick_object_node(object_id)
 
     def nodes_view(self) -> List[Dict[str, Any]]:
         return [e.view() for e in self._nodes.values()]
@@ -740,8 +784,8 @@ class LocalGcsHandle:
     async def publish_object(self, object_id, node_id):
         self._svc.publish_object(object_id, node_id)
 
-    async def unpublish_object(self, object_id):
-        self._svc._object_nodes.pop(object_id, None)
+    async def unpublish_object(self, object_id, node_id=None):
+        self._svc.unpublish_object(object_id, node_id)
 
     async def locate_object(self, object_id, timeout=0):
         return await self._svc.locate_object(object_id, timeout)
@@ -845,7 +889,8 @@ class RemoteGcsHandle:
             {"op": "publish_object", "object_id": object_id, "msg_id": None}
         )
 
-    async def unpublish_object(self, object_id):
+    async def unpublish_object(self, object_id, node_id=None):
+        # The server attributes the removal to this connection's node.
         await self._client.notify(
             {"op": "unpublish_object", "object_id": object_id, "msg_id": None}
         )

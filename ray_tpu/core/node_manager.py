@@ -51,10 +51,12 @@ from .object_store import (
     ObjectDirectory,
     RemoteLocation,
     ShmLocation,
+    SpilledLocation,
     current_arena,
     init_arena,
     shutdown_arena,
 )
+from .spilling import SpillManager
 from .peers import PeerClient
 from .placement_group import BundleState
 from .protocol import AioFramedWriter, aio_read_frame
@@ -67,8 +69,14 @@ _HEADER = struct.Struct("<I")
 
 
 def _free_location(loc) -> None:
-    """Release an object's storage: arena delete or shm unlink."""
-    if isinstance(loc, ArenaLocation):
+    """Release an object's storage: arena delete, shm unlink, or spill-file
+    removal."""
+    if isinstance(loc, SpilledLocation):
+        try:
+            os.remove(loc.path)
+        except OSError:
+            pass
+    elif isinstance(loc, ArenaLocation):
         arena = current_arena()
         if arena is not None:
             try:
@@ -86,6 +94,23 @@ def _free_location(loc) -> None:
             pass
         except Exception:
             pass
+
+
+def _system_memory_usage_fraction() -> float:
+    """System memory usage in [0, 1] from /proc/meminfo (ref analogue:
+    MemoryMonitor::GetMemoryBytes, common/memory_monitor.h)."""
+    info = {}
+    with open("/proc/meminfo") as f:
+        for line in f:
+            key, _, rest = line.partition(":")
+            try:
+                info[key] = int(rest.strip().split()[0])
+            except (ValueError, IndexError):
+                pass
+    total = info.get("MemTotal", 0)
+    if total <= 0:
+        return 0.0
+    return 1.0 - info.get("MemAvailable", total) / total
 
 
 def _task_worker_type(spec: TaskSpec) -> str:
@@ -116,6 +141,7 @@ class TaskRecord:
     # Bundle this task's resources were acquired from, if placed in a
     # placement group: (pg_id, bundle_index).
     bundle_key: Optional[Tuple[str, int]] = None
+    created: float = field(default_factory=time.monotonic)
 
 
 @dataclass
@@ -168,6 +194,13 @@ class NodeManager:
         self.node_resources = NodeResources(ResourceSet(resources))
         capacity = config.object_store_memory
         self.directory = ObjectDirectory(capacity)
+        # Spilling: admit puts over capacity and relieve pressure by moving
+        # LRU objects to disk (ref: raylet/local_object_manager.h:41).
+        self.spill_manager = SpillManager(os.path.join(session_dir, "spill"))
+        if config.object_spilling_enabled:
+            self.directory.spill_enabled = True
+        self._spilling = False
+        self._restores: Dict[ObjectID, asyncio.Future] = {}
         # Native C++ arena store (plasma-equivalent, src/store/): created by
         # the head process; workers attach via RAY_TPU_ARENA. Pure-Python
         # per-object shm remains the fallback when the toolchain is missing.
@@ -228,6 +261,18 @@ class NodeManager:
         # (one GCS round-trip per group, not per record).
         self._pg_waiters: Dict[str, List[TaskRecord]] = {}
 
+        # Strong refs to fire-and-forget coroutines so they are neither
+        # GC'd mid-flight nor dropped unawaited at loop shutdown (advisor
+        # r1: drop_named_actor cleanup was lost that way).
+        self._bg_tasks: Set[asyncio.Task] = set()
+
+        # Lineage table: return object -> creating TaskSpec, pinned while
+        # the object's directory entry lives; re-executed to rebuild lost
+        # objects (ref analogue: lineage pinning in reference_count.h:61 +
+        # ObjectRecoveryManager re-execution via task_manager.h:195).
+        self._lineage: Dict[ObjectID, TaskSpec] = {}
+        self._reconstructions: Dict[ObjectID, int] = {}
+
         self._stats = {
             "tasks_submitted": 0,
             "tasks_finished": 0,
@@ -273,6 +318,7 @@ class NodeManager:
             self.gcs_service.on_node_added = self._on_gcs_node_added
             self.gcs_service.on_node_dead = self._on_gcs_node_dead
             self.gcs_service.on_load_update = self._on_gcs_load_update
+            self.gcs_service.on_pgs_invalidated = self._invalidate_pgs
             self._gcs = LocalGcsHandle(self.gcs_service)
             reply = await self.gcs_service.register_node(
                 self.node_id,
@@ -303,6 +349,7 @@ class NodeManager:
         self._heartbeat_task = asyncio.ensure_future(self._heartbeat_loop())
         self._gc_task = asyncio.ensure_future(self._gc_loop())
         self._health_task = asyncio.ensure_future(self._health_loop())
+        self._memmon_task = asyncio.ensure_future(self._memory_monitor_loop())
 
     # ------------------------------------------------------- cluster plumbing
 
@@ -364,6 +411,7 @@ class NodeManager:
         elif mtype == "cluster_load":
             self._apply_cluster_views(msg["nodes"])
         elif mtype == "node_dead":
+            self._invalidate_pgs(msg.get("invalid_pgs") or [])
             await self._on_node_dead_hex(
                 msg["node_id"], dead_actors=msg.get("dead_actors")
             )
@@ -611,7 +659,14 @@ class NodeManager:
                 self._stats["tasks_retried"] += 1
                 self._ready.append(record)
             else:
-                self._fail_task(record, WorkerCrashedError(record.spec.name))
+                detail = (
+                    "killed by the node memory monitor (out of memory)"
+                    if getattr(w, "_oom_killed", False)
+                    else ""
+                )
+                self._fail_task(
+                    record, WorkerCrashedError(record.spec.name, detail)
+                )
         elif prev_state in ("busy", "blocked"):
             pass
         if w.proc is not None and w.proc.poll() is None:
@@ -620,6 +675,14 @@ class NodeManager:
             except Exception:
                 pass
         self._schedule()
+
+    def _spawn_bg(self, coro) -> asyncio.Task:
+        """Run a cleanup coroutine with a strong reference held until done;
+        shutdown() drains these so best-effort cleanups actually happen."""
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     # ------------------------------------------------------------ peer plane
 
@@ -709,6 +772,16 @@ class NodeManager:
         self._pg_nodes.pop(pg_id, None)
         self._schedule()
 
+    def _invalidate_pgs(self, pg_ids: List[str]):
+        """A node death sent these groups back to pending: drop routing
+        caches and local bundle reservations so the GCS can re-place them
+        with fresh prepares; parked/queued tasks re-resolve via the GCS
+        instead of forwarding to a stale node (advisor finding r1)."""
+        for pg_id in pg_ids:
+            self._pg_nodes.pop(pg_id, None)
+            for key in [k for k in self._bundles if k[0] == pg_id]:
+                self._release_bundle(*key)
+
     def _find_local_bundle(
         self, strategy: PlacementGroupSchedulingStrategy, req: ResourceSet
     ) -> Optional[BundleState]:
@@ -779,28 +852,38 @@ class NodeManager:
 
     async def _resolve_pg(self, pg_id: str):
         """Fetch the bundle->node map from the GCS, then re-place every
-        record parked on it."""
+        record parked on it. A still-*pending* group keeps its records
+        parked (the reference queues tasks until the PG is placed or
+        removed, it never times them out); only a removed/unknown group
+        fails them."""
         ok = False
-        if self._gcs is not None:
+        while not self._shutdown:
+            state = "unknown"
+            if self._gcs is None:
+                break
             try:
                 ok = await self._gcs.pg_wait(
                     pg_id, self.config.object_locate_timeout_s
                 )
-                if ok:
-                    info = await self._gcs.pg_get(pg_id)
-                    nodes = info.get("bundle_nodes")
-                    if nodes:
-                        self._pg_nodes[pg_id] = {
-                            int(k): v for k, v in nodes.items()
-                        }
-                    else:
-                        ok = False
+                info = await self._gcs.pg_get(pg_id)
+                state = info.get("state", "unknown")
+                nodes = info.get("bundle_nodes")
+                if ok and state == "created" and nodes:
+                    self._pg_nodes[pg_id] = {int(k): v for k, v in nodes.items()}
+                else:
+                    ok = False
             except Exception:
                 ok = False
+            if ok or state in ("removed", "unknown"):
+                break
+            # Group exists but is still pending: poll again, keeping the
+            # records parked.
+            await asyncio.sleep(0.2)
         for record in self._pg_waiters.pop(pg_id, []):
             if record.state == "cancelled":
                 continue
             if ok:
+                record.spillbacks = 0  # fresh map: forwarding budget resets
                 self._task_ready(record)
             else:
                 self._fail_task(
@@ -808,8 +891,8 @@ class NodeManager:
                     TaskError(
                         None,
                         record.spec.name,
-                        f"placement group {pg_id[:8]} is not ready (pending, "
-                        "removed, or unknown)",
+                        f"placement group {pg_id[:8]} was removed or is "
+                        "unknown",
                     ),
                 )
 
@@ -1022,6 +1105,19 @@ class NodeManager:
             aid = ActorID.from_hex(aid_hex)
             if self._actor_homes.get(aid) == node_hex:
                 self._actor_homes[aid] = "dead"
+        # Objects whose only known copy was on the dead node: unseal the
+        # ones whose lineage we own so the next consumer (or a dependency
+        # resolution) re-executes the creating task instead of pulling from
+        # a ghost. Borrowed objects (no lineage here) keep their stale
+        # location and fail fast at pull with recovery via the GCS replica
+        # set (ref analogue: ObjectRecoveryManager on node removal).
+        for oid in self.directory.remote_entries(node_hex):
+            if oid in self._lineage:
+                self._sealed.discard(oid)
+                if oid in self._dep_index or oid in self._seal_events:
+                    # Consumers are already parked on this object: kick the
+                    # re-execution now, their seal waits stay valid.
+                    self._spawn_bg(self._reconstruct_object(oid))
         # Forwarded tasks: retry elsewhere or fail.
         for task_id, record in list(self._forwarded.items()):
             if record.target != node_hex:
@@ -1060,6 +1156,16 @@ class NodeManager:
             # Return slots exist in the directory from submission time so
             # consumers can hold refs before the task runs.
             self.directory.add(oid, InlineLocation(b""), initial_refs=0)
+        if (
+            origin is None
+            and spec.task_type == TaskType.NORMAL_TASK
+            and self.config.enable_lineage_reconstruction
+        ):
+            # This node owns the task: pin its spec so lost return objects
+            # can be rebuilt by re-execution (normal tasks only — actor
+            # state is recovered by actor restart, not task replay).
+            for oid in spec.return_ids():
+                self._lineage[oid] = spec
         # Pin dependencies for the task's lifetime so owners dropping their
         # refs mid-flight cannot free an argument (ref analogue: submitted
         # task references in ReferenceCounter).
@@ -1085,6 +1191,11 @@ class NodeManager:
                 self._dep_index.setdefault(oid, set()).add(spec.task_id)
                 if self.directory.lookup(oid) is None:
                     asyncio.ensure_future(self._locate_missing(oid))
+                elif oid in self._lineage:
+                    # Entry exists but is unsealed: either its creating task
+                    # is in flight (no-op) or its copy died with a node —
+                    # re-execute from lineage.
+                    self._spawn_bg(self._reconstruct_object(oid))
         else:
             self._task_ready(record)
 
@@ -1120,6 +1231,10 @@ class NodeManager:
                 return
             if self.node_id.hex() in targets or record.origin is not None:
                 self._register_actor(record)
+            elif record.spillbacks >= self.config.max_task_spillback:
+                # Stale routing cache: re-resolve through the GCS.
+                self._pg_nodes.pop(raw_strategy.pg_id, None)
+                self._queue_pg_resolve(record)
             else:
                 self._actor_homes[spec.actor_id] = targets[0]
                 info = self._actors.pop(spec.actor_id, None)
@@ -1228,10 +1343,13 @@ class NodeManager:
 
     async def _locate_missing(self, oid: ObjectID):
         """A dependency unknown to this node: find it through the GCS object
-        directory, or fail the tasks waiting on it loudly."""
+        directory, re-execute its creating task if we own the lineage, or
+        fail the tasks waiting on it loudly."""
         found = await self._locate_via_gcs(oid)
         if found:
             return  # _locate_via_gcs sealed it; waiters have been woken.
+        if await self._reconstruct_object(oid):
+            return  # waiters stay parked; the re-executed task's seal wakes them
         waiters = self._dep_index.pop(oid, set())
         for tid in waiters:
             entry = self._waiting.pop(tid, None)
@@ -1300,7 +1418,14 @@ class NodeManager:
                     )
                     continue
                 if self.node_id.hex() not in targets:
-                    if record.origin is None:
+                    if record.spillbacks >= self.config.max_task_spillback:
+                        # Routing cache may be stale (group re-placed after a
+                        # node death): drop it and re-resolve via the GCS
+                        # instead of spinning forward/requeue (advisor r1).
+                        self._pg_nodes.pop(raw_strategy.pg_id, None)
+                        record.state = "pg_resolving"
+                        self._queue_pg_resolve(record)
+                    elif record.origin is None:
                         self._forward_record(record, targets[0])
                     else:
                         deferred.append(record)
@@ -1487,6 +1612,7 @@ class NodeManager:
         else:
             self.directory.seal_over_placeholder(oid, loc)
         self._sealed.add(oid)
+        self._maybe_spill()
         ev = self._seal_events.pop(oid, None)
         if ev is not None:
             ev.set()
@@ -1742,7 +1868,7 @@ class NodeManager:
             if info.name:
                 self._named_actors.pop(info.name, None)
                 if self._gcs is not None:
-                    asyncio.ensure_future(
+                    self._spawn_bg(
                         self._gcs.drop_named_actor(info.name, info.actor_id)
                     )
 
@@ -1819,10 +1945,16 @@ class NodeManager:
             if oid not in self._sealed:
                 if self.directory.lookup(oid) is None:
                     # Never registered here: try the GCS object directory
-                    # (cross-node borrow), else fail loudly — waiting would
-                    # hang forever (ref analogue: OwnershipBasedObjectDirectory
-                    # lookup before PullManager engages).
+                    # (cross-node borrow), then lineage re-execution, else
+                    # fail loudly — waiting would hang forever (ref analogue:
+                    # OwnershipBasedObjectDirectory lookup before PullManager
+                    # engages).
                     if await self._locate_via_gcs(oid):
+                        continue
+                    if await self._reconstruct_object(oid):
+                        events.append(
+                            self._seal_events.setdefault(oid, asyncio.Event())
+                        )
                         continue
                     raise ObjectLostError(
                         f"object {oid.hex()} is unknown or has been freed; "
@@ -1830,6 +1962,10 @@ class NodeManager:
                         "argument, keep a live ObjectRef to it"
                     )
                 events.append(self._seal_events.setdefault(oid, asyncio.Event()))
+                if oid in self._lineage:
+                    # No-op while the creating task is in flight; re-executes
+                    # it when the entry was unsealed by a node death.
+                    await self._reconstruct_object(oid)
         if events:
             waiters = [ev.wait() for ev in events if not ev.is_set()]
             if waiters:
@@ -1839,24 +1975,223 @@ class NodeManager:
             loc = self.directory.lookup(oid)
             if isinstance(loc, RemoteLocation):
                 loc = await self._ensure_local(oid, loc)
+            if isinstance(loc, SpilledLocation):
+                loc = await self._restore_spilled(oid, loc)
             out.append((oid, loc))
         return out
 
     async def _ensure_local(self, oid: ObjectID, loc: RemoteLocation) -> Location:
         """Pull a remote object's bytes and re-home them locally, deduping
         concurrent pulls (ref analogue: PullManager bundles + the object
-        buffer pool's single in-flight chunk set per object)."""
-        fut = self._pulls.get(oid)
+        buffer pool's single in-flight chunk set per object). A failed pull
+        goes through object recovery (replica re-locate, then lineage
+        re-execution) before surfacing ObjectLostError."""
+        while True:
+            fut = self._pulls.get(oid)
+            if fut is None:
+                fut = asyncio.ensure_future(self._pull_object(oid, loc))
+                self._pulls[oid] = fut
+
+                def _cleanup(f, oid=oid):
+                    if self._pulls.get(oid) is f:
+                        del self._pulls[oid]
+
+                fut.add_done_callback(_cleanup)
+            try:
+                return await asyncio.shield(fut)
+            except ObjectLostError:
+                if not await self._recover_object(oid, exclude_hex=loc.node_id):
+                    raise
+                new_loc = await self._wait_recovered(oid)
+                if not isinstance(new_loc, RemoteLocation):
+                    return new_loc
+                loc = new_loc
+
+    # --------------------------------------------------------- object recovery
+
+    def _can_reconstruct(self, oid: ObjectID) -> bool:
+        return (
+            oid in self._lineage
+            and self._reconstructions.get(oid, 0)
+            < self.config.max_object_reconstructions
+        )
+
+    async def _recover_object(
+        self, oid: ObjectID, exclude_hex: Optional[str] = None
+    ) -> bool:
+        """Make a lost object readable again: prefer another live replica
+        from the GCS directory, else re-execute the creating task from
+        lineage (ref analogue: ObjectRecoveryManager::RecoverObject —
+        PinExistingObjectCopy first, ReconstructObject second)."""
+        self._sealed.discard(oid)
+        if self._gcs is not None and self._multi_node:
+            try:
+                nid = await self._gcs.locate_object(oid, timeout=0)
+            except Exception:
+                nid = None
+            if (
+                nid is not None
+                and nid != self.node_id
+                and nid.hex() != exclude_hex
+                and nid.hex() in self._cluster_view
+            ):
+                self.directory.replace_location(oid, RemoteLocation(nid.hex(), 0))
+                self._seal_object(oid, RemoteLocation(nid.hex(), 0))
+                return True
+        return await self._reconstruct_object(oid)
+
+    async def _reconstruct_object(self, oid: ObjectID) -> bool:
+        """Re-execute the creating task of a lost object, within the
+        per-object reconstruction budget."""
+        if not self._can_reconstruct(oid):
+            return False
+        spec = self._lineage[oid]
+        live = self._tasks.get(spec.task_id)
+        if live is not None and live.state in (
+            "waiting", "ready", "running", "forwarded", "pg_resolving"
+        ):
+            # The creating task is already in flight (sibling return slot
+            # kicked off recovery, or a retry is running): wait for its seal.
+            return True
+        self._reconstructions[oid] = self._reconstructions.get(oid, 0) + 1
+        self._stats["tasks_retried"] += 1
+        for rid in spec.return_ids():
+            self._sealed.discard(rid)
+        await self.submit_task(spec)
+        return True
+
+    async def _wait_recovered(self, oid: ObjectID) -> Location:
+        """Block until the recovered object (or its failure blob) seals."""
+        if oid not in self._sealed:
+            ev = self._seal_events.setdefault(oid, asyncio.Event())
+            await ev.wait()
+        return self.directory.lookup(oid)
+
+    # ----------------------------------------------------------- spilling
+
+    def _maybe_spill(self):
+        """Start one spill pass when store usage crosses the high-water
+        mark (ref analogue: LocalObjectManager::SpillObjectUptoMaxThroughput
+        triggered from the eviction path)."""
+        cap = self.directory.capacity_bytes
+        if (
+            not self.directory.spill_enabled
+            or self._spilling
+            or cap <= 0
+            or self.directory.used_bytes
+            <= cap * self.config.spill_high_water_frac
+        ):
+            return
+        self._spilling = True
+        self._spawn_bg(self._spill_pass())
+
+    async def _spill_pass(self):
+        """Move LRU local objects to disk until under the low-water mark.
+        Byte IO runs in executor threads; the directory entry swaps via
+        compare-and-swap so racing reads/GC stay correct."""
+        try:
+            target = int(
+                self.directory.capacity_bytes * self.config.spill_low_water_frac
+            )
+            need = self.directory.used_bytes - target
+            if need <= 0:
+                return
+            for oid, loc in self.directory.spill_candidates(need):
+                try:
+                    data = self.local_store.get_bytes(loc)
+                except Exception:
+                    continue  # lost the race with GC
+                sloc = await self._loop.run_in_executor(
+                    None, self.spill_manager.write, oid, data
+                )
+                if self.directory.replace_if(oid, loc, sloc):
+                    _free_location(loc)
+                else:
+                    self.spill_manager.delete(sloc)
+        finally:
+            self._spilling = False
+
+    async def _restore_spilled(
+        self, oid: ObjectID, sloc: SpilledLocation
+    ) -> Location:
+        """Bring a spilled object back into the store, deduping concurrent
+        restores (ref analogue: the restore IO-worker path of
+        LocalObjectManager + PinObjectIDs)."""
+        fut = self._restores.get(oid)
         if fut is None:
-            fut = asyncio.ensure_future(self._pull_object(oid, loc))
-            self._pulls[oid] = fut
+            fut = asyncio.ensure_future(self._restore_io(oid, sloc))
+            self._restores[oid] = fut
 
             def _cleanup(f, oid=oid):
-                if self._pulls.get(oid) is f:
-                    del self._pulls[oid]
+                if self._restores.get(oid) is f:
+                    del self._restores[oid]
 
             fut.add_done_callback(_cleanup)
         return await asyncio.shield(fut)
+
+    async def _restore_io(self, oid: ObjectID, sloc: SpilledLocation) -> Location:
+        data = await self._loop.run_in_executor(
+            None, self.spill_manager.read, sloc
+        )
+        if len(data) <= self.config.max_inline_object_size:
+            new_loc: Location = InlineLocation(bytes(data))
+        else:
+            new_loc = self.local_store.put_raw(oid, data)
+        if self.directory.replace_if(oid, sloc, new_loc):
+            self.spill_manager.delete(sloc)
+            self._maybe_spill()  # restoring may re-cross the high-water mark
+            return new_loc
+        cur = self.directory.lookup(oid)
+        return cur if cur is not None else new_loc
+
+    # ------------------------------------------------------ memory monitor
+
+    async def _memory_monitor_loop(self):
+        """Kill the newest retriable running task's worker under system
+        memory pressure (ref: MemoryMonitor common/memory_monitor.h:52 +
+        retriable-FIFO policy worker_killing_policy_retriable_fifo.h)."""
+        thresh = self.config.memory_usage_threshold
+        if thresh <= 0:
+            return
+        while not self._shutdown:
+            await asyncio.sleep(self.config.memory_monitor_interval_s)
+            try:
+                frac = _system_memory_usage_fraction()
+            except Exception:
+                continue
+            if frac < thresh:
+                continue
+            victim = self._pick_oom_victim()
+            if victim is None:
+                continue
+            worker, record = victim
+            sys.stderr.write(
+                f"[ray_tpu] memory pressure ({frac:.0%}): killing task "
+                f"'{record.spec.name}' (worker {worker.worker_id.hex()[:8]})\n"
+            )
+            worker._oom_killed = True
+            if worker.proc is not None:
+                try:
+                    worker.proc.kill()
+                except Exception:
+                    pass
+
+    def _pick_oom_victim(self):
+        """Newest running non-actor task, preferring one with retries left
+        so the kill is survivable (retriable-FIFO, ref:
+        worker_killing_policy_retriable_fifo.h:34)."""
+        retriable, any_task = None, None
+        for w in self._workers.values():
+            if w.state != "busy" or w.current is None or w.actor_id is not None:
+                continue
+            rec = w.current
+            if any_task is None or rec.created > any_task[1].created:
+                any_task = (w, rec)
+            if rec.spec.retries_left > 0 and (
+                retriable is None or rec.created > retriable[1].created
+            ):
+                retriable = (w, rec)
+        return retriable or any_task
 
     async def _pull_object(self, oid: ObjectID, loc: RemoteLocation) -> Location:
         try:
@@ -1935,6 +2270,8 @@ class NodeManager:
             for oid, loc in self.directory.collect_garbage(grace):
                 self._sealed.discard(oid)
                 self._seal_events.pop(oid, None)
+                self._lineage.pop(oid, None)
+                self._reconstructions.pop(oid, None)
                 if isinstance(loc, RemoteLocation):
                     if loc.held:
                         # Release the hold the remote node keeps for us.
@@ -1964,7 +2301,7 @@ class NodeManager:
 
     async def _unpublish(self, oid: ObjectID):
         try:
-            await self._gcs.unpublish_object(oid)
+            await self._gcs.unpublish_object(oid, self.node_id)
         except Exception:
             pass
 
@@ -2222,10 +2559,21 @@ class NodeManager:
         self._shutdown = True
 
         async def _stop():
+            if self._bg_tasks:
+                try:
+                    await asyncio.wait_for(
+                        asyncio.gather(*list(self._bg_tasks),
+                                       return_exceptions=True),
+                        2.0,
+                    )
+                except Exception:
+                    pass
             if getattr(self, "_gc_task", None) is not None:
                 self._gc_task.cancel()
             if getattr(self, "_health_task", None) is not None:
                 self._health_task.cancel()
+            if getattr(self, "_memmon_task", None) is not None:
+                self._memmon_task.cancel()
             if self._heartbeat_task is not None:
                 self._heartbeat_task.cancel()
             for peer in self._peers.values():

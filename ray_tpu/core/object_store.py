@@ -68,7 +68,20 @@ class RemoteLocation:
     held: bool = False
 
 
-Location = Union[InlineLocation, ShmLocation, ArenaLocation, RemoteLocation]
+@dataclass(frozen=True)
+class SpilledLocation:
+    """Object whose bytes were spilled to external storage under memory
+    pressure; restored into the store on next access (ref analogue: a
+    spilled-object URL pinned by LocalObjectManager,
+    raylet/local_object_manager.h:41)."""
+
+    path: str
+    size: int
+
+
+Location = Union[
+    InlineLocation, ShmLocation, ArenaLocation, RemoteLocation, SpilledLocation
+]
 
 
 class _RawPayload:
@@ -145,7 +158,10 @@ def shutdown_arena(unlink: bool):
 
 
 def _shm_name(object_id: ObjectID) -> str:
-    return "rtpu-" + object_id.hex()[:24]
+    # Full 40-char hex: driver puts share their 16-byte TaskID prefix and
+    # differ only in the trailing index, so truncation would collide every
+    # driver-put segment onto one name.
+    return "rtpu-" + object_id.hex()
 
 
 def _attach_untracked(name: str) -> shared_memory.SharedMemory:
@@ -262,6 +278,12 @@ class LocalObjectStore:
     def get_view(self, loc: Location) -> memoryview:
         if isinstance(loc, InlineLocation):
             return memoryview(loc.data)
+        if isinstance(loc, SpilledLocation):
+            # Direct read of a spilled object (normally the node manager
+            # restores it into the store first; this path keeps readers
+            # correct if they race a spill).
+            with open(loc.path, "rb") as f:
+                return memoryview(f.read())
         if isinstance(loc, ArenaLocation):
             arena = current_arena()
             if arena is None:
@@ -336,9 +358,15 @@ class ObjectDirectory:
     def __init__(self, capacity_bytes: int):
         self.capacity_bytes = capacity_bytes
         self.used_bytes = 0
+        # When True (node manager runs a spill loop), adds over capacity are
+        # admitted and relieved by spilling instead of refused (ref analogue:
+        # CreateRequestQueue fallback allocation vs. OutOfMemory reply).
+        self.spill_enabled = False
         self._entries: Dict[ObjectID, Location] = {}
         self._refcounts: Dict[ObjectID, int] = {}
         self._zero_since: Dict[ObjectID, float] = {}
+        self._access: Dict[ObjectID, int] = {}
+        self._access_counter = 0
         self._lock = threading.Lock()
 
     def add(self, object_id: ObjectID, loc: Location, initial_refs: int = 1):
@@ -351,7 +379,7 @@ class ObjectDirectory:
                 loc.size if shared
                 else len(loc.data) if isinstance(loc, InlineLocation) else 0
             )
-            if shared and self.capacity_bytes > 0:
+            if shared and self.capacity_bytes > 0 and not self.spill_enabled:
                 if self.used_bytes + size > self.capacity_bytes:
                     raise ObjectStoreFullError(
                         f"object store over capacity: {self.used_bytes + size} "
@@ -360,6 +388,8 @@ class ObjectDirectory:
             self.used_bytes += size if shared else 0
             self._entries[object_id] = loc
             self._refcounts[object_id] = initial_refs
+            self._access_counter += 1
+            self._access[object_id] = self._access_counter
             if initial_refs <= 0:
                 import time
 
@@ -367,7 +397,11 @@ class ObjectDirectory:
 
     def lookup(self, object_id: ObjectID) -> Optional[Location]:
         with self._lock:
-            return self._entries.get(object_id)
+            loc = self._entries.get(object_id)
+            if loc is not None:
+                self._access_counter += 1
+                self._access[object_id] = self._access_counter
+            return loc
 
     def seal_over_placeholder(self, object_id: ObjectID, loc: Location):
         """Replace a pre-registered (placeholder) entry with its real
@@ -431,6 +465,7 @@ class ObjectDirectory:
                 loc = self._entries.pop(oid, None)
                 self._refcounts.pop(oid, None)
                 self._zero_since.pop(oid, None)
+                self._access.pop(oid, None)
                 if loc is None:
                     continue
                 if isinstance(loc, (ShmLocation, ArenaLocation)):
@@ -441,3 +476,45 @@ class ObjectDirectory:
     def num_objects(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    def spill_candidates(self, bytes_needed: int):
+        """Least-recently-accessed local shared-memory objects summing to at
+        least ``bytes_needed`` (ref analogue: the LRU EvictionPolicy choosing
+        spill victims, object_manager/plasma/eviction_policy.h)."""
+        with self._lock:
+            local = [
+                (self._access.get(oid, 0), oid, loc)
+                for oid, loc in self._entries.items()
+                if isinstance(loc, (ShmLocation, ArenaLocation))
+            ]
+        local.sort()
+        out, total = [], 0
+        for _seq, oid, loc in local:
+            if total >= bytes_needed:
+                break
+            out.append((oid, loc))
+            total += loc.size
+        return out
+
+    def replace_if(self, object_id: ObjectID, old: Location, new: Location) -> bool:
+        """Compare-and-swap a location; False if the entry changed or was
+        collected while the caller (spill/restore IO) ran."""
+        with self._lock:
+            if self._entries.get(object_id) is not old:
+                return False
+            if isinstance(old, (ShmLocation, ArenaLocation)):
+                self.used_bytes -= old.size
+            if isinstance(new, (ShmLocation, ArenaLocation)):
+                self.used_bytes += new.size
+            self._entries[object_id] = new
+            return True
+
+    def remote_entries(self, node_hex: str):
+        """Snapshot of object ids whose location points at ``node_hex``
+        (used to invalidate locations when that node dies)."""
+        with self._lock:
+            return [
+                oid
+                for oid, loc in self._entries.items()
+                if isinstance(loc, RemoteLocation) and loc.node_id == node_hex
+            ]

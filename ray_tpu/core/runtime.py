@@ -151,11 +151,24 @@ class BaseRuntime:
         for oid, loc in locations:
             if loc is None:
                 raise GetTimeoutError(f"object {oid.hex()} unavailable")
-            value = self.store.get_object(loc)
+            value = self._read_object(oid, loc, timeout)
             if isinstance(value, TaskError):
                 raise value.as_raisable()
             values.append(value)
         return values[0] if single else values
+
+    def _read_object(self, oid: ObjectID, loc: Location, timeout):
+        """Read one object, retrying through fresh locations when the
+        storage moved underneath us (spilled/restored between the location
+        reply and the read — the window plasma closes with get-time pins)."""
+        for _ in range(5):
+            try:
+                return self.store.get_object(loc)
+            except (KeyError, FileNotFoundError):
+                (_, loc), = self._get_locations([oid], timeout)
+                if loc is None:
+                    break
+        return self.store.get_object(loc)
 
     def wait(
         self,

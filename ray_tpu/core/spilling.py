@@ -1,0 +1,67 @@
+"""Object spilling to external storage.
+
+Plays the role of the reference's spill pipeline (ref:
+src/ray/raylet/local_object_manager.h:41 LocalObjectManager — spill
+orchestration, restore, URL tracking; python/ray/_private/external_storage.py
+FileSystemStorage). Design differences: spilling is driven by the node
+manager's directory watermarks instead of dedicated IO worker processes, and
+the storage unit is one file per object under ``session_dir/spill/`` (the
+reference fuses small objects into batch files; our small objects are inline
+in the control plane and never spill, so per-object files stay few and
+large).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .ids import ObjectID
+from .object_store import SpilledLocation
+
+
+class SpillManager:
+    """File-system spill backend for one node. All byte IO runs in the
+    caller-provided executor so the node manager's event loop never blocks
+    on disk."""
+
+    def __init__(self, spill_dir: str):
+        self.spill_dir = spill_dir
+        self._made = False
+
+    def _path(self, oid: ObjectID) -> str:
+        return os.path.join(self.spill_dir, oid.hex())
+
+    def write(self, oid: ObjectID, data) -> SpilledLocation:
+        """Write an object's framed bytes to disk (blocking; call from an
+        executor thread)."""
+        if not self._made:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            self._made = True
+        path = self._path(oid)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic: readers never see partial spills
+        return SpilledLocation(path, len(data))
+
+    def read(self, loc: SpilledLocation) -> bytes:
+        with open(loc.path, "rb") as f:
+            return f.read()
+
+    def delete(self, loc: SpilledLocation) -> None:
+        try:
+            os.remove(loc.path)
+        except FileNotFoundError:
+            pass
+
+    def used_bytes(self) -> int:
+        if not self._made or not os.path.isdir(self.spill_dir):
+            return 0
+        total = 0
+        for name in os.listdir(self.spill_dir):
+            try:
+                total += os.path.getsize(os.path.join(self.spill_dir, name))
+            except OSError:
+                pass
+        return total

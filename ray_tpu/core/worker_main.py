@@ -126,7 +126,9 @@ class Worker:
             from .exceptions import TaskError
 
             for oid, loc in locations:
-                value = rt.store.get_object(loc)
+                # _read_object retries through fresh locations if the bytes
+                # were spilled/restored between the reply and the read.
+                value = rt._read_object(oid, loc, None)
                 if isinstance(value, TaskError):
                     raise value.as_raisable()
                 values.append(value)

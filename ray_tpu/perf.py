@@ -1,0 +1,178 @@
+"""Framework microbenchmark harness.
+
+Ref analogue: python/ray/_private/ray_perf.py (task/actor-call/put
+throughput) with the timeit runner of ray_microbenchmark_helpers.py:14.
+Run as ``python -m ray_tpu.perf`` for the full table, or call
+``run_microbenchmarks`` programmatically (bench.py and tests use reduced
+iteration counts).
+
+Each entry reports ops/s (mean of ``repeat`` timed windows). The suite
+exercises the real control plane: driver puts/gets through the shm arena,
+task submission through the node manager, actor round-trips over the worker
+socket protocol, and (when a cluster fixture adds nodes) cross-node object
+pulls.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def timeit(
+    name: str,
+    fn: Callable[[], None],
+    multiplier: float = 1.0,
+    *,
+    warmup: int = 1,
+    repeat: int = 3,
+    min_window_s: float = 0.5,
+) -> Tuple[str, float]:
+    """Run ``fn`` in timed windows and return (name, ops_per_sec * multiplier)
+    (ref analogue: _private/ray_microbenchmark_helpers.py timeit)."""
+    for _ in range(warmup):
+        fn()
+    rates: List[float] = []
+    for _ in range(repeat):
+        start = time.perf_counter()
+        count = 0
+        while True:
+            fn()
+            count += 1
+            elapsed = time.perf_counter() - start
+            if elapsed >= min_window_s:
+                break
+        rates.append(count * multiplier / elapsed)
+    return name, sum(rates) / len(rates)
+
+
+def run_microbenchmarks(
+    *,
+    batch: int = 100,
+    payload_mb: int = 10,
+    repeat: int = 3,
+    min_window_s: float = 0.5,
+    include: Optional[List[str]] = None,
+) -> Dict[str, float]:
+    """Run the suite against the already-initialized runtime. Returns
+    {benchmark_name: ops_per_sec}."""
+    import ray_tpu
+
+    results: Dict[str, float] = {}
+
+    def record(name, fn, multiplier=1.0):
+        if include and not any(pat in name for pat in include):
+            return
+        n, rate = timeit(
+            name, fn, multiplier, repeat=repeat, min_window_s=min_window_s
+        )
+        results[n] = rate
+
+    # --- object store -----------------------------------------------------
+    small_ref = ray_tpu.put(b"x")
+
+    def get_small():
+        ray_tpu.get(small_ref)
+
+    record("single client get calls", get_small)
+
+    def put_small():
+        ray_tpu.put(0)
+
+    record("single client put calls", put_small)
+
+    arr = np.zeros(payload_mb * 1024 * 1024 // 8, dtype=np.int64)
+
+    def put_large():
+        ray_tpu.put(arr)
+
+    record("single client put gigabytes", put_large, payload_mb / 1024.0)
+
+    # --- tasks ------------------------------------------------------------
+    @ray_tpu.remote
+    def small_value():
+        return b"ok"
+
+    def task_batch():
+        ray_tpu.get([small_value.remote() for _ in range(batch)])
+
+    record("tasks submit+get throughput", task_batch, batch)
+
+    # --- actors -----------------------------------------------------------
+    @ray_tpu.remote
+    class Sink:
+        def ping(self):
+            return b"ok"
+
+        def ping_arg(self, x):
+            return b"ok"
+
+    a = Sink.remote()
+    ray_tpu.get(a.ping.remote())  # actor creation outside the window
+
+    def actor_sync():
+        ray_tpu.get(a.ping.remote())
+
+    record("actor calls sync round-trip", actor_sync)
+
+    def actor_async_batch():
+        ray_tpu.get([a.ping.remote() for _ in range(batch)])
+
+    record("actor calls pipelined throughput", actor_async_batch, batch)
+
+    ref = ray_tpu.put(b"payload")
+
+    def actor_arg_batch():
+        ray_tpu.get([a.ping_arg.remote(ref) for _ in range(batch)])
+
+    record("actor calls with object arg", actor_arg_batch, batch)
+
+    return results
+
+
+def run_cluster_benchmarks(
+    cluster, *, payload_mb: int = 10, repeat: int = 3, min_window_s: float = 0.5
+) -> Dict[str, float]:
+    """Cross-node benchmarks over a cluster fixture with at least one node
+    carrying a ``{"gadget": 1}`` resource (object pull over the peer plane)."""
+    import ray_tpu
+
+    results: Dict[str, float] = {}
+    nbytes = payload_mb * 1024 * 1024
+
+    @ray_tpu.remote(resources={"gadget": 1})
+    def produce():
+        return np.zeros(nbytes // 8, dtype=np.int64)
+
+    def transfer():
+        # New object each window iteration: a cached pull would measure
+        # nothing.
+        ray_tpu.get(produce.remote(), timeout=120)
+
+    name, rate = timeit(
+        "cross-node object transfer gigabytes",
+        transfer,
+        payload_mb / 1024.0,
+        repeat=repeat,
+        min_window_s=min_window_s,
+    )
+    results[name] = rate
+    return results
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    results = run_microbenchmarks()
+    width = max(len(k) for k in results)
+    for name, rate in results.items():
+        unit = "GB/s" if "gigabytes" in name else "ops/s"
+        print(f"{name.ljust(width)}  {rate:12.2f} {unit}")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

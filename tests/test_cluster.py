@@ -227,3 +227,52 @@ def test_pick_node_policies_pure():
     # Infeasible everywhere.
     big = ResourceSet({"CPU": 64})
     assert pick_node(big, "DEFAULT", "aa", nodes) is None
+
+
+def test_lineage_reconstruction_after_node_death(cluster):
+    """A lost task-return object is rebuilt by re-executing its creating
+    task (ref analogue: core_worker/object_recovery_manager.h +
+    lineage pinning in reference_count.h:61)."""
+    handle = cluster.add_node(num_cpus=1, resources={"gadget": 1})
+
+    @ray_tpu.remote(resources={"gadget": 1}, max_retries=0)
+    def produce():
+        import numpy as np
+
+        return np.arange(200_000, dtype="int64")
+
+    ref = produce.remote()
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+    assert ready
+    cluster.remove_node(handle)
+    cluster.add_node(num_cpus=1, resources={"gadget": 1})
+    time.sleep(0.5)
+    out = ray_tpu.get(ref, timeout=120)
+    assert out.shape == (200_000,)
+    assert int(out[-1]) == 199_999
+
+
+def test_lineage_chain_reconstruction(cluster):
+    """Recovery recurses through dependencies: a lost object whose lost
+    argument must also be re-executed."""
+    handle = cluster.add_node(num_cpus=1, resources={"gadget": 1})
+
+    @ray_tpu.remote(resources={"gadget": 1}, max_retries=0)
+    def base():
+        import numpy as np
+
+        return np.ones(150_000, dtype="int64")
+
+    @ray_tpu.remote(resources={"gadget": 1}, max_retries=0)
+    def double(x):
+        return x * 2
+
+    a = base.remote()
+    b = double.remote(a)
+    ready, _ = ray_tpu.wait([b], num_returns=1, timeout=60)
+    assert ready
+    cluster.remove_node(handle)
+    cluster.add_node(num_cpus=1, resources={"gadget": 1})
+    time.sleep(0.5)
+    out = ray_tpu.get(b, timeout=120)
+    assert int(out.sum()) == 300_000

@@ -1,0 +1,40 @@
+"""Smoke tests for the framework microbenchmark harness (ref analogue:
+release/microbenchmark running _private/ray_perf.py)."""
+
+import ray_tpu
+from ray_tpu.perf import run_cluster_benchmarks, run_microbenchmarks, timeit
+
+
+def test_timeit_reports_rate():
+    name, rate = timeit("noop", lambda: None, repeat=1, min_window_s=0.05)
+    assert name == "noop"
+    assert rate > 1000  # a no-op must run far faster than 1k ops/s
+
+
+def test_microbenchmarks_run(ray_tpu_start):
+    results = run_microbenchmarks(
+        batch=20, payload_mb=1, repeat=1, min_window_s=0.05
+    )
+    assert len(results) == 7
+    for name, rate in results.items():
+        assert rate > 0, name
+    # Sanity floors: the control plane should do far better than these.
+    assert results["single client get calls"] > 50
+    assert results["tasks submit+get throughput"] > 20
+
+
+def test_cluster_transfer_benchmark():
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(
+        head_resources={"CPU": 2},
+        system_config={"num_prestart_workers": 1},
+    )
+    try:
+        c.add_node(num_cpus=1, resources={"gadget": 1})
+        results = run_cluster_benchmarks(
+            c, payload_mb=1, repeat=1, min_window_s=0.05
+        )
+        assert results["cross-node object transfer gigabytes"] > 0
+    finally:
+        c.shutdown()

@@ -1,0 +1,165 @@
+"""Object spilling + memory-pressure handling (ref analogue:
+python/ray/tests/test_object_spilling*.py and the OOM-killer tests over
+memory_monitor.h / worker_killing_policy*.h)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import runtime_context
+from ray_tpu.core.object_store import SpilledLocation
+
+MB = 1024 * 1024
+
+
+@pytest.fixture
+def small_store():
+    rt = ray_tpu.init(
+        num_cpus=2,
+        object_store_memory=8 * MB,
+        system_config={
+            "num_prestart_workers": 1,
+            "gc_grace_period_s": 60.0,
+            "refcount_flush_interval_s": 0.1,
+        },
+    )
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_put_twice_capacity_spills_and_restores(small_store):
+    """Puts totalling 2x store capacity all succeed; cold objects spill to
+    disk and every value reads back intact."""
+    nm = runtime_context.current_runtime()._nm
+    refs = []
+    for i in range(16):  # 16 x 1 MiB = 2x the 8 MiB capacity
+        refs.append(ray_tpu.put(np.full(131072, i, dtype="float64")))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and (
+        nm._spilling or nm.directory.used_bytes > nm.directory.capacity_bytes
+    ):
+        time.sleep(0.05)
+    # Pressure was relieved by spilling, not refusal.
+    assert nm.directory.used_bytes <= nm.directory.capacity_bytes
+    spill_dir = nm.spill_manager.spill_dir
+    assert os.path.isdir(spill_dir) and len(os.listdir(spill_dir)) > 0
+    for i, r in enumerate(refs):
+        arr = ray_tpu.get(r, timeout=60)
+        assert arr.shape == (131072,)
+        assert float(arr[0]) == i and float(arr[-1]) == i
+
+
+def test_task_results_spill(small_store):
+    """Task returns (not just driver puts) participate in spilling."""
+
+    @ray_tpu.remote
+    def make(i):
+        return np.full(131072, i, dtype="float64")
+
+    refs = [make.remote(i) for i in range(16)]
+    out = ray_tpu.get(refs, timeout=120)
+    for i, arr in enumerate(out):
+        assert float(arr[0]) == i
+    nm = runtime_context.current_runtime()._nm
+    assert nm.directory.used_bytes <= nm.directory.capacity_bytes
+
+
+def test_spilled_object_served_to_peer():
+    """A spilled object can still be pulled by another node."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(
+        head_resources={"CPU": 2},
+        system_config={
+            "num_prestart_workers": 1,
+            "object_store_memory": 4 * MB,
+            "gc_grace_period_s": 60.0,
+        },
+    )
+    try:
+        nm = runtime_context.current_runtime()._nm
+        refs = [
+            ray_tpu.put(np.full(131072, i, dtype="float64")) for i in range(8)
+        ]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+            nm._spilling
+            or not any(
+                isinstance(nm.directory.lookup(r.id()), SpilledLocation)
+                for r in refs
+            )
+        ):
+            time.sleep(0.05)
+        c.add_node(num_cpus=1, resources={"gadget": 1})
+
+        @ray_tpu.remote(resources={"gadget": 1})
+        def total(x):
+            return float(x.sum())
+
+        # First ref is the coldest -> most likely spilled; sum on the peer.
+        assert ray_tpu.get(total.remote(refs[0]), timeout=60) == 0.0
+        assert ray_tpu.get(total.remote(refs[7]), timeout=60) == 7.0 * 131072
+    finally:
+        c.shutdown()
+
+
+def test_oom_monitor_kills_newest_retriable_task():
+    """With an artificially low memory threshold the monitor kills the
+    running retriable task; retries exhaust and the error names the OOM
+    killer (ref analogue: test_memory_pressure killing policy tests)."""
+    rt = ray_tpu.init(
+        num_cpus=2,
+        system_config={
+            "num_prestart_workers": 1,
+            "memory_usage_threshold": 0.001,
+            "memory_monitor_interval_s": 0.1,
+            "default_max_retries": 1,
+        },
+    )
+    try:
+
+        @ray_tpu.remote(max_retries=1)
+        def hog():
+            time.sleep(30)
+            return "survived"
+
+        with pytest.raises(ray_tpu.WorkerCrashedError) as exc_info:
+            ray_tpu.get(hog.remote(), timeout=60)
+        assert "memory monitor" in str(exc_info.value)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_oom_victim_policy_prefers_retriable():
+    """Pure-logic check of the retriable-FIFO victim policy."""
+    from ray_tpu.core.node_manager import TaskRecord, WorkerHandle, NodeManager
+    from ray_tpu.core.task_spec import TaskSpec
+
+    class _Spec:
+        def __init__(self, retries):
+            self.retries_left = retries
+            self.name = "t"
+
+    class _Rec:
+        def __init__(self, retries, created):
+            self.spec = _Spec(retries)
+            self.created = created
+
+    class _W:
+        def __init__(self, rec, actor=None):
+            self.state = "busy"
+            self.current = rec
+            self.actor_id = actor
+
+    workers = {
+        1: _W(_Rec(0, 1.0)),
+        2: _W(_Rec(2, 2.0)),
+        3: _W(_Rec(2, 3.0)),
+        4: _W(_Rec(5, 9.0), actor="a"),  # actors are never OOM victims
+    }
+    fake = type("NM", (), {"_workers": workers})()
+    victim = NodeManager._pick_oom_victim(fake)
+    assert victim == (workers[3], workers[3].current)
