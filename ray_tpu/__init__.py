@@ -41,6 +41,9 @@ from .core import (  # noqa: F401
 from .core import (  # noqa: F401
     NodeAffinitySchedulingStrategy,
     NodeLabelSchedulingStrategy,
+    SpmdActorGroup,
+    SpmdGroupError,
+    tpu,
 )
 
 __all__ = [
@@ -68,4 +71,7 @@ __all__ = [
     "WorkerCrashedError",
     "GetTimeoutError",
     "TaskCancelledError",
+    "SpmdActorGroup",
+    "SpmdGroupError",
+    "tpu",
 ]

@@ -33,3 +33,5 @@ from .scheduling_strategies import (  # noqa: F401
     NodeAffinitySchedulingStrategy,
     NodeLabelSchedulingStrategy,
 )
+from .spmd import SpmdActorGroup, SpmdGroupError  # noqa: F401
+from . import tpu  # noqa: F401

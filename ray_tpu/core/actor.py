@@ -60,7 +60,10 @@ class ActorHandle:
         self._class_function_id = class_function_id
 
     def __getattr__(self, name: str) -> ActorMethod:
-        if name.startswith("_"):
+        # "__rtpu_ping__" is the built-in liveness probe every actor answers
+        # (executor.ActorContainer.call); other dunder/private lookups are
+        # python machinery, not remote methods.
+        if name.startswith("_") and name != "__rtpu_ping__":
             raise AttributeError(name)
         return ActorMethod(self, name)
 

@@ -68,8 +68,12 @@ def init(
     )
     os.makedirs(session_dir, exist_ok=True)
 
+    from .tpu import node_tpu_labels
+
     node_id = NodeID.from_random()
-    nm = NodeManager(node_id, session_dir, res, config)
+    nm = NodeManager(
+        node_id, session_dir, res, config, labels=node_tpu_labels()
+    )
     nm.start()
     rt = DriverRuntime(nm, job_id=JobID.from_random())
     runtime_context.set_runtime(rt)

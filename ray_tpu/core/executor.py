@@ -83,6 +83,10 @@ class ActorContainer:
         self.instance = cls(*args, **kwargs)
 
     def call(self, method_name: str, args, kwargs):
+        if method_name == "__rtpu_ping__":
+            # Built-in liveness probe usable on any actor class (SPMD group
+            # health checks; ref analogue: the __ray_ready__ system method).
+            return "ok" if self.instance is not None else "pending"
         if self.instance is None:
             raise RuntimeError("actor instance not created")
         method = getattr(self.instance, method_name)

@@ -271,7 +271,8 @@ class GcsService:
             return {"nodes": [e.view() for e in self._nodes.values()]}
         if op == "pg_create":
             await self.pg_create(
-                msg["pg_id"], msg["bundles"], msg["strategy"], msg.get("name", "")
+                msg["pg_id"], msg["bundles"], msg["strategy"], msg.get("name", ""),
+                label_selectors=msg.get("label_selectors"),
             )
             return {"ok": True}
         if op == "pg_wait":
@@ -289,13 +290,14 @@ class GcsService:
 
     async def pg_create(
         self, pg_id: str, bundles: List[Dict[str, float]], strategy: str,
-        name: str = "",
+        name: str = "", label_selectors: Optional[List[Dict[str, str]]] = None,
     ):
         self._pgs[pg_id] = {
             "pg_id": pg_id,
             "bundles": bundles,
             "strategy": strategy,
             "name": name,
+            "label_selectors": label_selectors,
             "state": "pending",
             "nodes": None,
             "event": asyncio.Event(),
@@ -312,7 +314,10 @@ class GcsService:
         pg["placing"] = True
         try:
             reqs = [ResourceSet(b) for b in pg["bundles"]]
-            chosen = place_bundles(reqs, pg["strategy"], self.nodes_view())
+            chosen = place_bundles(
+                reqs, pg["strategy"], self.nodes_view(),
+                label_selectors=pg.get("label_selectors"),
+            )
             if chosen is None:
                 return  # stays pending; retried on node join / wait poll
             # Two-phase commit: prepare everywhere, then commit; roll back
@@ -790,8 +795,11 @@ class LocalGcsHandle:
     async def locate_object(self, object_id, timeout=0):
         return await self._svc.locate_object(object_id, timeout)
 
-    async def pg_create(self, pg_id, bundles, strategy, name=""):
-        await self._svc.pg_create(pg_id, bundles, strategy, name)
+    async def pg_create(self, pg_id, bundles, strategy, name="",
+                        label_selectors=None):
+        await self._svc.pg_create(
+            pg_id, bundles, strategy, name, label_selectors=label_selectors
+        )
 
     async def pg_wait(self, pg_id, timeout) -> bool:
         return await self._svc.pg_wait(pg_id, timeout)
@@ -902,10 +910,12 @@ class RemoteGcsHandle:
         )
         return NodeID.from_hex(r["node_id"]) if r["node_id"] else None
 
-    async def pg_create(self, pg_id, bundles, strategy, name=""):
+    async def pg_create(self, pg_id, bundles, strategy, name="",
+                        label_selectors=None):
         await self._client.request(
             {"op": "pg_create", "pg_id": pg_id, "bundles": bundles,
-             "strategy": strategy, "name": name}
+             "strategy": strategy, "name": name,
+             "label_selectors": label_selectors}
         )
 
     async def pg_wait(self, pg_id, timeout) -> bool:

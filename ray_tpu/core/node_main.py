@@ -31,7 +31,10 @@ def main() -> int:
     gcs_addr = os.environ["RAY_TPU_GCS_ADDRESS"]
     session_dir = os.environ["RAY_TPU_SESSION_DIR"]
     resources = json.loads(os.environ.get("RAY_TPU_RESOURCES", '{"CPU": 1}'))
-    labels = json.loads(os.environ.get("RAY_TPU_NODE_LABELS", "{}"))
+    from .tpu import node_tpu_labels
+
+    labels = node_tpu_labels()  # auto-discovered slice membership, if any
+    labels.update(json.loads(os.environ.get("RAY_TPU_NODE_LABELS", "{}")))
     host, port_s = gcs_addr.rsplit(":", 1)
 
     os.makedirs(session_dir, exist_ok=True)

@@ -718,7 +718,7 @@ class NodeManager:
             self._on_remote_task_result(msg)
             return None
         if mtype == "pull_object":
-            return self._serve_pull(msg["object_id"])
+            return await self._serve_pull(msg["object_id"])
         if mtype == "free_object":
             self._remove_ref(msg["object_id"])
             return None
@@ -949,12 +949,17 @@ class NodeManager:
             fut.set_result(peer)
         return peer
 
-    def _serve_pull(self, object_id: ObjectID) -> Dict[str, Any]:
+    async def _serve_pull(self, object_id: ObjectID) -> Dict[str, Any]:
         loc = self.directory.lookup(object_id)
         if loc is None or isinstance(loc, RemoteLocation):
             return {"data": None}
         try:
-            return {"data": self.local_store.get_bytes(loc)}
+            # Off-loop: a spilled location is a (possibly multi-GB) blocking
+            # disk read; shm reads also copy. Keep the control plane live.
+            data = await self._loop.run_in_executor(
+                None, self.local_store.get_bytes, loc
+            )
+            return {"data": data}
         except Exception as e:
             return {"data": None, "error": str(e)}
 
@@ -2390,7 +2395,9 @@ class NodeManager:
         op = msg["op"]
         if op == "create":
             await self._gcs.pg_create(
-                msg["pg_id"], msg["bundles"], msg["strategy"], msg.get("name", "")
+                msg["pg_id"], msg["bundles"], msg["strategy"],
+                msg.get("name", ""),
+                label_selectors=msg.get("label_selectors"),
             )
             return {"ok": True}
         if op == "wait":

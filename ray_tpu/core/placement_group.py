@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import uuid
 from dataclasses import dataclass
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from .resources import ResourceSet
 from .runtime_context import current_runtime
@@ -102,10 +102,14 @@ def placement_group(
     bundles: List[Dict[str, float]],
     strategy: str = "PACK",
     name: str = "",
+    bundle_label_selectors: Optional[List[Dict[str, str]]] = None,
 ) -> PlacementGroup:
     """Reserve ``bundles`` across the cluster (ref:
     util/placement_group.py:146). Returns immediately; use .wait()/.ready()
-    for confirmation."""
+    for confirmation.
+
+    ``bundle_label_selectors[i]`` restricts bundle *i* to nodes whose labels
+    match — used by tpu.tpu_slice() to pin bundle i to slice worker i."""
     if strategy not in VALID_STRATEGIES:
         raise ValueError(
             f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}"
@@ -115,9 +119,14 @@ def placement_group(
     for b in bundles:
         if not b or any(v < 0 for v in b.values()):
             raise ValueError(f"invalid bundle {b!r}")
+    if bundle_label_selectors is not None and len(bundle_label_selectors) != len(bundles):
+        raise ValueError("bundle_label_selectors must match bundles 1:1")
     pg_id = uuid.uuid4().hex
     rt = current_runtime()
-    rt.pg_create(pg_id, [dict(b) for b in bundles], strategy, name)
+    rt.pg_create(
+        pg_id, [dict(b) for b in bundles], strategy, name,
+        label_selectors=bundle_label_selectors,
+    )
     return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy, name)
 
 

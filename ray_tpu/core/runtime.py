@@ -167,7 +167,10 @@ class BaseRuntime:
             except (KeyError, FileNotFoundError):
                 (_, loc), = self._get_locations([oid], timeout)
                 if loc is None:
-                    break
+                    raise GetTimeoutError(
+                        f"object {oid.hex()} lost while reading (no "
+                        "remaining location)"
+                    ) from None
         return self.store.get_object(loc)
 
     def wait(
@@ -324,11 +327,13 @@ class DriverRuntime(BaseRuntime):
 
     # Placement groups (ref analogue: the GCS PG RPCs the driver issues).
 
-    def pg_create(self, pg_id, bundles, strategy, name=""):
+    def pg_create(self, pg_id, bundles, strategy, name="",
+                  label_selectors=None):
         self._nm.call_sync(
             self._nm.pg_op(
                 {"op": "create", "pg_id": pg_id, "bundles": bundles,
-                 "strategy": strategy, "name": name}
+                 "strategy": strategy, "name": name,
+                 "label_selectors": label_selectors}
             )
         )
 
@@ -468,10 +473,12 @@ class WorkerRuntime(BaseRuntime):
             raise RuntimeError(reply["error"])
         return reply
 
-    def pg_create(self, pg_id, bundles, strategy, name=""):
+    def pg_create(self, pg_id, bundles, strategy, name="",
+                  label_selectors=None):
         self._pg_request(
             {"op": "create", "pg_id": pg_id, "bundles": bundles,
-             "strategy": strategy, "name": name}
+             "strategy": strategy, "name": name,
+             "label_selectors": label_selectors}
         )
 
     def pg_wait(self, pg_id, timeout) -> bool:

@@ -120,23 +120,41 @@ def pick_node(
     return ranked[0]["node_id"]
 
 
+def _labels_match(view: Dict[str, Any], selector: Dict[str, str]) -> bool:
+    labels = view.get("labels") or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
 def place_bundles(
     bundles: List[ResourceSet],
     strategy: str,
     nodes: List[Dict[str, Any]],
+    *,
+    label_selectors: Optional[List[Dict[str, str]]] = None,
 ) -> Optional[List[str]]:
     """Choose one node per bundle, or None if currently unplaceable
     (ref: bundle policies in policy/bundle_scheduling_policy.h:82-106 —
     pack/spread best-effort, strict variants hard requirements).
+
+    ``label_selectors`` optionally constrains bundle *i* to nodes matching
+    selector *i* exactly — the mechanism behind ICI-topology-aware gangs
+    (tpu.py pins bundle i to the slice host with worker-id i).
 
     Placement is simulated against a copy of each node's *available*
     resources so multiple bundles packing onto one node are accounted."""
     alive = [n for n in nodes if n["state"] == "alive"]
     if not alive:
         return None
+    views = {n["node_id"]: n for n in alive}
     sim = {
         n["node_id"]: dict(n["resources_available"]) for n in alive
     }
+
+    def selector_ok(bundle_idx: int, node_id: str) -> bool:
+        if not label_selectors:
+            return True
+        sel = label_selectors[bundle_idx] if bundle_idx < len(label_selectors) else None
+        return not sel or _labels_match(views[node_id], sel)
 
     def take(node_id: str, req: ResourceSet) -> bool:
         avail = sim[node_id]
@@ -152,17 +170,19 @@ def place_bundles(
     if strategy == "STRICT_PACK":
         # All bundles must share one node: try each node as the sole host.
         for nid in order:
+            if not all(selector_ok(i, nid) for i in range(len(bundles))):
+                continue
             saved = {k: dict(v) for k, v in sim.items()}
             if all(take(nid, req) for req in bundles):
                 return [nid] * len(bundles)
             sim.update(saved)
         return None
     if strategy == "PACK":
-        for req in bundles:
+        for idx, req in enumerate(bundles):
             placed = None
             # Prefer the node already used most (pack), seeded by order.
             for nid in sorted(order, key=lambda n: (-out.count(n), n)):
-                if take(nid, req):
+                if selector_ok(idx, nid) and take(nid, req):
                     placed = nid
                     break
             if placed is None:
@@ -171,18 +191,18 @@ def place_bundles(
         return out
     # SPREAD / STRICT_SPREAD: round-robin distinct nodes.
     used: List[str] = []
-    for req in bundles:
+    for idx, req in enumerate(bundles):
         candidates = [n for n in order if n not in used] or (
             order if strategy == "SPREAD" else []
         )
         placed = None
         for nid in candidates:
-            if take(nid, req):
+            if selector_ok(idx, nid) and take(nid, req):
                 placed = nid
                 break
         if placed is None and strategy == "SPREAD":
             for nid in order:
-                if take(nid, req):
+                if selector_ok(idx, nid) and take(nid, req):
                     placed = nid
                     break
         if placed is None:

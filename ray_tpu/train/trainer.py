@@ -1,16 +1,20 @@
-"""JaxTrainer: distributed data-parallel training over worker actors.
+"""JaxTrainer: distributed data-parallel training over an SPMD actor group.
 
 Ref analogue: the TorchTrainer path (SURVEY.md §3.4) — BaseTrainer.fit
 (train/base_trainer.py:579) → BackendExecutor (start:124, start_training:438)
 → WorkerGroup of actors (_internal/worker_group.py:102), with
-_setup_torch_process_group replaced by the TPU-native recipe: each worker is
-one jax process on one host of the slice; rank 0 publishes the coordinator
-address through the control-plane KV and every worker calls
-jax.distributed.initialize, after which the train loop is a single SPMD
+_setup_torch_process_group replaced by the TPU-native recipe: the worker
+gang is a :class:`ray_tpu.SpmdActorGroup` (gang-scheduled, one host-actor
+per placement-group bundle — on a TPU pod, one per slice host via
+``tpu.tpu_slice()``), rank 0 reserves a coordinator port on *its own* host
+and the address is published through the control-plane KV, then every worker
+calls ``jax.distributed.initialize`` and the train loop is a single SPMD
 program over the slice's mesh (collectives on ICI via XLA, no NCCL).
 
 Failure handling follows SURVEY.md §2.5: whole-group restart from the last
-checkpoint, bounded by FailureConfig.max_failures.
+checkpoint, bounded by FailureConfig.max_failures. Workers surface errors
+promptly through KV error keys (not only at join), so a hung 40-hour run
+does not hide a rank-3 crash.
 """
 
 from __future__ import annotations
@@ -43,6 +47,8 @@ def _train_worker_entry(
     use_tpu: bool,
 ):
     """Runs inside a worker actor process."""
+    from ..core.runtime_context import current_runtime
+
     if coordinator is not None and world_size > 1 and use_tpu:
         import jax
 
@@ -69,9 +75,40 @@ def _train_worker_entry(
             fn(config)
         else:
             fn()
+    except BaseException as e:  # noqa: BLE001 — surfaced via KV + re-raise
+        try:
+            current_runtime().kv_put(
+                f"__train__/{run_id}/{rank}/error",
+                cloudpickle.dumps(
+                    {"rank": rank, "error": repr(e)}
+                ),
+            )
+        except Exception:
+            pass
+        raise
     finally:
         set_session(None)
     return "done"
+
+
+class _RemoteTrainWorker:
+    """Actor wrapper so the worker body runs in a dedicated process."""
+
+    def reserve_coordinator(self) -> str:
+        """Bind a free port on THIS worker's host and return host:port —
+        the jax.distributed rendezvous address. Fixes the driver-host bug:
+        rank 0 may not share a machine with the driver in cluster mode."""
+        import socket
+
+        host = socket.gethostbyname(socket.gethostname())
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return f"{host}:{port}"
+
+    def run(self, *args):
+        return _train_worker_entry(*args)
 
 
 class JaxTrainer:
@@ -98,8 +135,6 @@ class JaxTrainer:
     # ------------------------------------------------------------------ fit
 
     def fit(self) -> Result:
-        import ray_tpu
-
         storage = self.run_config.storage_path or default_storage_path(
             self.run_config.name
         )
@@ -144,6 +179,55 @@ class JaxTrainer:
                 shards[rank][name] = split[rank] if split else ds
         return shards
 
+    def _make_worker_group(self):
+        """Gang-schedule the workers. On a cluster with registered TPU
+        slices and use_tpu, the gang is the hosts of one slice
+        (tpu.tpu_slice()); otherwise a SPREAD placement group sized by
+        ScalingConfig."""
+        import ray_tpu
+        from ..core.spmd import SpmdActorGroup
+        from ..core import tpu as tpu_mod
+
+        sc = self.scaling_config
+        pg = None
+        if sc.use_tpu:
+            try:
+                rt_nodes = ray_tpu.nodes()
+                slices = tpu_mod.list_slices(
+                    [
+                        {
+                            "state": "alive" if n.get("Alive", True) else "dead",
+                            "labels": n.get("Labels", {}),
+                            "resources_total": n.get("Resources", {}),
+                        }
+                        for n in rt_nodes
+                    ]
+                )
+                eligible = {
+                    name: hosts
+                    for name, hosts in slices.items()
+                    if len(hosts) >= sc.num_workers
+                }
+                if eligible:
+                    name = sorted(eligible)[0]
+                    pg = tpu_mod.tpu_slice(
+                        name, num_hosts=sc.num_workers
+                    )
+            except Exception:
+                pg = None  # no slice topology: plain gang below
+        res = sc.worker_resources()
+        return SpmdActorGroup(
+            _RemoteTrainWorker,
+            num_workers=sc.num_workers,
+            resources_per_worker=res,
+            placement_group=pg,
+            strategy="SPREAD",
+            name="jax-train",
+            # The slice PG is created here for this run; the group must tear
+            # it down with the gang or the slice reservation leaks forever.
+            owns_placement_group=True,
+        )
+
     def _run_attempt(
         self,
         manager: CheckpointManager,
@@ -162,41 +246,43 @@ class JaxTrainer:
         storage = manager.storage_dir
         shards = self._shard_datasets(world)
 
-        res = sc.worker_resources()
-        worker_cls = ray_tpu.remote(
-            num_cpus=res.get("CPU", 0),
-            resources={k: v for k, v in res.items() if k != "CPU"},
-        )(_RemoteTrainWorker)
-
-        coordinator = None
-        if world > 1 and sc.use_tpu:
-            # Rank 0's host:port; workers resolve it before jax.distributed.
-            import socket
-
-            host = socket.gethostbyname(socket.gethostname())
-            coordinator = f"{host}:{29400 + (hash(run_id) % 1000)}"
-
-        actors = [worker_cls.remote() for _ in range(world)]
-        refs = [
-            a.run.remote(
-                fn_blob,
-                self._config,
-                run_id,
-                rank,
-                world,
-                storage,
-                start_ckpt.path if start_ckpt else None,
-                shards[rank],
-                coordinator,
-                sc.use_tpu,
-            )
-            for rank, a in enumerate(actors)
-        ]
-
-        next_seq = [0] * world
-        last_metrics: Dict[str, Any] = {}
-        error: Optional[BaseException] = None
+        group = self._make_worker_group()
         try:
+            group.wait_ready(timeout=120.0)
+            coordinator = None
+            if world > 1 and sc.use_tpu:
+                # Rank 0 reserves the rendezvous port on its own host; the
+                # address is published through the control-plane KV
+                # (docstring contract; also consumed by state tooling).
+                coordinator = ray_tpu.get(
+                    group.actors[0].reserve_coordinator.remote()
+                )
+                rt.kv_put(
+                    f"__train__/{run_id}/coordinator",
+                    coordinator.encode(),
+                )
+
+            def rank_args(rank: int):
+                return (
+                    (
+                        fn_blob,
+                        self._config,
+                        run_id,
+                        rank,
+                        world,
+                        storage,
+                        start_ckpt.path if start_ckpt else None,
+                        shards[rank],
+                        coordinator,
+                        sc.use_tpu,
+                    ),
+                    {},
+                )
+
+            refs = group.submit("run", per_rank_args=rank_args)
+
+            next_seq = [0] * world
+            last_metrics: Dict[str, Any] = {}
             pending = list(refs)
             while pending:
                 _, pending = ray_tpu.wait(
@@ -206,28 +292,31 @@ class JaxTrainer:
                     rt, run_id, world, next_seq, manager, history, last_metrics
                 )
                 if error:
-                    raise TrainWorkerGroupError(str(error)) from error
-            # Final drain + surface worker exceptions.
+                    raise TrainWorkerGroupError(str(error))
+            # Final join surfaces worker exceptions not seen via KV.
             for ref in refs:
                 ray_tpu.get(ref)
-            last_metrics, _ = self._drain_reports(
+            last_metrics, error = self._drain_reports(
                 rt, run_id, world, next_seq, manager, history, last_metrics
             )
+            if error:
+                raise TrainWorkerGroupError(str(error))
             return last_metrics
         except TrainWorkerGroupError:
             raise
         except Exception as e:
             raise TrainWorkerGroupError(f"train worker failed: {e}") from e
         finally:
-            for a in actors:
-                try:
-                    ray_tpu.kill(a)
-                except Exception:
-                    pass
+            group.shutdown()
 
     def _drain_reports(self, rt, run_id, world, next_seq, manager, history,
                        last_metrics):
+        error = None
         for rank in range(world):
+            blob = rt.kv_get(f"__train__/{run_id}/{rank}/error")
+            if blob is not None and error is None:
+                payload = cloudpickle.loads(blob)
+                error = f"rank {payload['rank']}: {payload['error']}"
             while True:
                 key = f"__train__/{run_id}/{rank}/{next_seq[rank]}"
                 blob = rt.kv_get(key)
@@ -244,11 +333,4 @@ class JaxTrainer:
                         manager.register(
                             ckpt, metrics, metrics.get("step", len(history))
                         )
-        return last_metrics, None
-
-
-class _RemoteTrainWorker:
-    """Actor wrapper so the worker body runs in a dedicated process."""
-
-    def run(self, *args):
-        return _train_worker_entry(*args)
+        return last_metrics, error

@@ -1,0 +1,264 @@
+"""SPMD actor groups + TPU slice topology (SURVEY.md §7 phase 5 north star).
+
+Covers: env-driven slice discovery, slice labels on nodes, label-selector
+bundle placement, tpu_slice() gang reservation pinning rank i to slice
+worker i, SpmdActorGroup lock-step semantics, and whole-group restart after
+a member (or its node) dies — the consistent-restart contract a collective-
+running gang requires."""
+
+import time
+
+import pytest
+
+import ray_tpu
+import ray_tpu.util
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core import tpu
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.core.scheduling_policy import place_bundles
+from ray_tpu.core.spmd import SpmdActorGroup, SpmdGroupError
+
+
+# --------------------------------------------------------- discovery (pure)
+
+
+def test_detect_slice_from_env(monkeypatch):
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.setenv("TPU_NAME", "pod-a")
+    monkeypatch.setenv("TPU_WORKER_ID", "3")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-16")
+    monkeypatch.setenv("TPU_TOPOLOGY", "2x2x2")
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_OVERRIDE", "4")
+    info = tpu.detect_slice()
+    assert info is not None
+    assert info.slice_name == "pod-a"
+    assert info.worker_id == 3
+    assert info.num_hosts == 2  # v5p-16 = 16 cores = 8 chips / 4 per host
+    assert info.chips_per_host == 4
+    labels = info.labels()
+    assert labels[tpu.TPU_SLICE_LABEL] == "pod-a"
+    assert labels[tpu.TPU_WORKER_ID_LABEL] == "3"
+    assert labels[tpu.TPU_HOSTS_LABEL] == "2"
+
+
+def test_detect_slice_absent(monkeypatch):
+    monkeypatch.delenv("TPU_NAME", raising=False)
+    monkeypatch.delenv("RAY_TPU_SLICE_NAME", raising=False)
+    assert tpu.detect_slice() is None
+    assert tpu.node_tpu_labels() == {}
+
+
+def test_slice_host_accounting():
+    assert tpu.slice_num_hosts("v5p-16") == 2  # 16 cores = 8 chips / 4
+    assert tpu.slice_num_hosts("v4-8") == 1  # 8 cores = 4 chips, one host
+    assert tpu.slice_num_hosts("v3-32") == 4  # 32 cores = 16 chips / 4
+    assert tpu.slice_num_hosts("v5e-8") == 1  # v5e suffix counts chips
+    assert tpu.chips_per_host("v6e-256") == 8
+
+
+# ------------------------------------------------- label-selector placement
+
+
+def _node(nid, labels=None, cpu=4, tpu_chips=0):
+    res = {"CPU": cpu}
+    if tpu_chips:
+        res["TPU"] = tpu_chips
+    return {
+        "node_id": nid,
+        "state": "alive",
+        "labels": labels or {},
+        "resources_available": dict(res),
+        "resources_total": dict(res),
+    }
+
+
+def test_place_bundles_label_selectors():
+    nodes = [
+        _node("aa", {tpu.TPU_WORKER_ID_LABEL: "0"}, tpu_chips=4),
+        _node("bb", {tpu.TPU_WORKER_ID_LABEL: "1"}, tpu_chips=4),
+    ]
+    bundles = [ResourceSet({"TPU": 4}), ResourceSet({"TPU": 4})]
+    selectors = [
+        {tpu.TPU_WORKER_ID_LABEL: "1"},
+        {tpu.TPU_WORKER_ID_LABEL: "0"},
+    ]
+    # Selectors invert the default deterministic order.
+    assert place_bundles(
+        bundles, "STRICT_SPREAD", nodes, label_selectors=selectors
+    ) == ["bb", "aa"]
+    # Unsatisfiable selector -> unplaceable.
+    assert (
+        place_bundles(
+            bundles,
+            "STRICT_SPREAD",
+            nodes,
+            label_selectors=[{tpu.TPU_WORKER_ID_LABEL: "9"}] * 2,
+        )
+        is None
+    )
+
+
+# ------------------------------------------------------------ cluster tests
+
+
+def _slice_labels(name, worker_id, hosts, accel="v5p-16"):
+    return {
+        tpu.TPU_SLICE_LABEL: name,
+        tpu.TPU_WORKER_ID_LABEL: str(worker_id),
+        tpu.TPU_TYPE_LABEL: accel,
+        tpu.TPU_TOPOLOGY_LABEL: "2x2x2",
+        tpu.TPU_HOSTS_LABEL: str(hosts),
+    }
+
+
+@pytest.fixture
+def slice_cluster():
+    c = Cluster(
+        head_resources={"CPU": 2},
+        system_config={"num_prestart_workers": 1, "default_max_retries": 0},
+    )
+    for wid in range(2):
+        c.add_node(
+            num_cpus=2,
+            resources={"TPU": 4},
+            labels=_slice_labels("pod-test", wid, hosts=2),
+        )
+    yield c
+    c.shutdown()
+
+
+def _make_rank_probe():
+    """Defined per-test (classes in test modules aren't importable by
+    workers until runtime_env working_dir ships; the same pattern the other
+    cluster tests use)."""
+
+    class _RankProbe:
+        def __init__(self, rank=0):
+            self.rank = rank
+
+        def whoami(self):
+            import ray_tpu as rt
+
+            ctx = rt.get_runtime_context()
+            return {"rank": self.rank, "node_id": ctx.get_node_id()}
+
+        def echo(self, x):
+            return x
+
+    return _RankProbe
+
+
+def test_tpu_slice_pins_ranks_to_workers(slice_cluster):
+    _RankProbe = _make_rank_probe()
+    pg = tpu.tpu_slice("pod-test")
+    assert pg.bundle_count == 2
+    table = ray_tpu.util.placement_group_table()[pg.id]
+    chosen = table["nodes"]
+    # Bundle i must sit on the node labelled worker-id i.
+    views = {v["NodeID"]: v for v in ray_tpu.nodes()}
+    for i, node_hex in enumerate(chosen):
+        assert (
+            views[node_hex]["Labels"][tpu.TPU_WORKER_ID_LABEL] == str(i)
+        )
+    group = SpmdActorGroup(
+        _RankProbe,
+        placement_group=pg,
+        per_worker_args=lambda rank: ((rank,), {}),
+    )
+    out = group.run("whoami", timeout=30)
+    assert [o["rank"] for o in out] == [0, 1]
+    # Lock-step ranks landed on distinct slice hosts in worker order.
+    assert [o["node_id"] for o in out] == list(chosen)
+    group.shutdown()
+
+
+def test_tpu_slice_autoselect_and_errors(slice_cluster):
+    pg = tpu.tpu_slice()  # only one slice registered -> picked
+    assert pg.bundle_count == 2
+    ray_tpu.util.remove_placement_group(pg)
+    with pytest.raises(ValueError):
+        tpu.tpu_slice("no-such-slice")
+
+
+def test_spmd_group_gang_and_lockstep(ray_tpu_start):
+    _RankProbe = _make_rank_probe()
+    group = SpmdActorGroup(
+        _RankProbe,
+        num_workers=2,
+        resources_per_worker={"CPU": 1},
+        per_worker_args=lambda rank: ((rank,), {}),
+    )
+    group.wait_ready(timeout=30)
+    assert group.healthy()
+    out = group.run("whoami", timeout=30)
+    assert sorted(o["rank"] for o in out) == [0, 1]
+    echoed = group.run("echo", 42, timeout=30)
+    assert echoed == [42, 42]
+    group.shutdown()
+    assert group.broken
+
+
+def test_spmd_group_infeasible_gang_fails_fast(ray_tpu_start):
+    _RankProbe = _make_rank_probe()
+    with pytest.raises(SpmdGroupError):
+        SpmdActorGroup(
+            _RankProbe,
+            num_workers=2,
+            resources_per_worker={"CPU": 64},
+            ready_timeout=1.5,
+        )
+
+
+def test_spmd_group_member_death_breaks_group(ray_tpu_start):
+    _RankProbe = _make_rank_probe()
+    group = SpmdActorGroup(
+        _RankProbe,
+        num_workers=2,
+        resources_per_worker={"CPU": 1},
+        per_worker_args=lambda rank: ((rank,), {}),
+    )
+    group.wait_ready(timeout=30)
+    ray_tpu.kill(group.actors[1])
+    with pytest.raises(SpmdGroupError):
+        group.run("whoami", timeout=30)
+    assert group.broken
+    with pytest.raises(SpmdGroupError):
+        group.submit("whoami")
+    # Whole-group restart brings back a full healthy gang.
+    group.restart()
+    out = group.run("whoami", timeout=30)
+    assert sorted(o["rank"] for o in out) == [0, 1]
+    group.shutdown()
+
+
+def test_spmd_group_survives_node_death_with_replacement(slice_cluster):
+    """Kill a slice host mid-run; after a replacement host with the same
+    worker-id label joins, whole-group restart restores the gang (the
+    gang-restart contract from VERDICT item 1)."""
+    _RankProbe = _make_rank_probe()
+    pg = tpu.tpu_slice("pod-test")
+    group = SpmdActorGroup(
+        _RankProbe,
+        placement_group=pg,
+        per_worker_args=lambda rank: ((rank,), {}),
+    )
+    group.wait_ready(timeout=30)
+
+    victim = slice_cluster._nodes[-1]
+    slice_cluster.remove_node(victim)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if not group.healthy(timeout=5):
+            break
+    assert group.broken
+
+    # Replacement host registers with the dead worker's slice identity.
+    slice_cluster.add_node(
+        num_cpus=2,
+        resources={"TPU": 4},
+        labels=_slice_labels("pod-test", 1, hosts=2),
+    )
+    group.restart(ready_timeout=60)
+    out = group.run("whoami", timeout=30)
+    assert sorted(o["rank"] for o in out) == [0, 1]
+    group.shutdown()
