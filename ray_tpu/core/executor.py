@@ -86,7 +86,15 @@ class ActorContainer:
         if method_name == "__rtpu_ping__":
             # Built-in liveness probe usable on any actor class (SPMD group
             # health checks; ref analogue: the __ray_ready__ system method).
-            return "ok" if self.instance is not None else "pending"
+            # Method calls queue behind the creation task, so a None
+            # instance here means the constructor FAILED — report that
+            # rather than answering a healthy "ok" (gang barriers rely on
+            # this to reject a gang whose members never constructed).
+            if self.instance is None:
+                raise RuntimeError(
+                    "actor instance not created (constructor failed)"
+                )
+            return "ok"
         if self.instance is None:
             raise RuntimeError("actor instance not created")
         method = getattr(self.instance, method_name)

@@ -15,7 +15,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .config import get_config
-from .exceptions import GetTimeoutError, TaskError
+from .exceptions import GetTimeoutError, ObjectLostError, TaskError
 from .function_table import FunctionCache, export_function
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import InlineLocation, LocalObjectStore, Location, ShmLocation
@@ -167,7 +167,8 @@ class BaseRuntime:
             except (KeyError, FileNotFoundError):
                 (_, loc), = self._get_locations([oid], timeout)
                 if loc is None:
-                    raise GetTimeoutError(
+                    # Permanently gone, not slow: no node holds a copy.
+                    raise ObjectLostError(
                         f"object {oid.hex()} lost while reading (no "
                         "remaining location)"
                     ) from None

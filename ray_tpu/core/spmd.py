@@ -170,11 +170,17 @@ class SpmdActorGroup:
         the collective program cannot continue)."""
         import ray_tpu
 
+        from .exceptions import GetTimeoutError
+
         refs = self.submit(
             method, *args, per_rank_args=per_rank_args, **kwargs
         )
         try:
             return ray_tpu.get(refs, timeout=timeout)
+        except GetTimeoutError:
+            # Slow is not dead: a member busy with a long step must not
+            # brick the gang (restart() would kill live work).
+            raise
         except Exception as e:
             self._broken = True
             raise SpmdGroupError(
@@ -186,10 +192,12 @@ class SpmdActorGroup:
         self.run("__rtpu_ping__", timeout=timeout or self._ready_timeout)
 
     def healthy(self, timeout: float = 10.0) -> bool:
+        from .exceptions import GetTimeoutError
+
         try:
             self.run("__rtpu_ping__", timeout=timeout)
             return True
-        except SpmdGroupError:
+        except (SpmdGroupError, GetTimeoutError):
             return False
 
     # -------------------------------------------------------------- restart

@@ -6,6 +6,7 @@ from .api import (  # noqa: F401
     batch,
     delete,
     deployment,
+    details,
     get_deployment_handle,
     run,
     scale,

@@ -1,22 +1,50 @@
 """Serve public API.
 
 Ref analogue: python/ray/serve/api.py — serve.run (:449), serve.batch,
-serve.delete, serve.shutdown, get_deployment_handle.
+serve.delete, serve.shutdown, get_deployment_handle, serve.status.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Dict, Optional
 
 import cloudpickle
 
-from .controller import CONTROLLER_NAME, ServeControllerActor
+from .controller import (
+    CONTROLLER_MAX_CONCURRENCY,
+    CONTROLLER_NAME,
+    ServeControllerActor,
+)
 from .deployment import AutoscalingConfig, Deployment, deployment  # noqa: F401
 from .handle import DeploymentHandle
 from . import http_proxy
 
 _controller = None
+# One router-state family per deployment: redeploys and repeated
+# get_deployment_handle calls share the same long-poller instead of
+# leaking one thread per handle.
+_states: Dict[str, Any] = {}
+
+
+def _make_handle(name: str, snap: Dict[str, Any],
+                 batch_config=None) -> DeploymentHandle:
+    state = _states.get(name)
+    if state is not None and not state.closed:
+        handle = DeploymentHandle(
+            name, [], batch_config=batch_config, _state=state
+        )
+        state.force_refresh()
+        return handle
+    handle = DeploymentHandle(
+        name, snap["replicas"],
+        batch_config=batch_config,
+        controller=_get_controller(),
+        route_version=snap["version"],
+    )
+    _states[name] = handle._state
+    return handle
 
 
 def _get_controller():
@@ -29,7 +57,8 @@ def _get_controller():
         _controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
         _controller = ray_tpu.remote(ServeControllerActor).options(
-            name=CONTROLLER_NAME
+            name=CONTROLLER_NAME,
+            max_concurrency=CONTROLLER_MAX_CONCURRENCY,
         ).remote()
         # Wait until the controller is live before first use.
         ray_tpu.get(_controller.list_deployments.remote())
@@ -39,14 +68,19 @@ def _get_controller():
 def run(target: Deployment, *, name: Optional[str] = None,
         route_prefix: Optional[str] = None, http_port: int = 0,
         _blocking: bool = False) -> DeploymentHandle:
-    """Deploy and return a handle (ref: serve.run). Starts the HTTP proxy
-    lazily on first use; ``http_port=0`` picks a free port."""
+    """Deploy (or redeploy — rolling, zero-downtime) and return a handle
+    (ref: serve.run). Starts the HTTP proxy lazily on first use;
+    ``http_port=0`` picks a free port."""
     import ray_tpu
 
     controller = _get_controller()
     dep_name = name or target.name
     blob = cloudpickle.dumps(target.func_or_class)
     batch_config = getattr(target.func_or_class, "_serve_batch_config", None)
+    autoscaling = (
+        dataclasses.asdict(target.autoscaling_config)
+        if target.autoscaling_config is not None else None
+    )
     replicas = ray_tpu.get(
         controller.deploy.remote(
             dep_name,
@@ -56,9 +90,11 @@ def run(target: Deployment, *, name: Optional[str] = None,
             target.num_replicas,
             target.ray_actor_options,
             batch_config,
+            autoscaling,
         )
     )
-    handle = DeploymentHandle(dep_name, replicas, batch_config=batch_config)
+    snap = ray_tpu.get(controller.get_routing.remote(dep_name))
+    handle = _make_handle(dep_name, snap, batch_config)
     port = http_proxy.start_proxy(http_port)
     http_proxy.register_route(route_prefix or dep_name, handle)
     handle.http_port = port
@@ -69,18 +105,16 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
     import ray_tpu
 
     controller = _get_controller()
-    replicas = ray_tpu.get(controller.get_replicas.remote(name))
-    batch_config = ray_tpu.get(controller.get_batch_config.remote(name))
-    return DeploymentHandle(name, replicas, batch_config=batch_config)
+    snap = ray_tpu.get(controller.get_routing.remote(name))
+    return _make_handle(name, snap, snap["batch_config"])
 
 
 def scale(name: str, num_replicas: int) -> DeploymentHandle:
     import ray_tpu
 
     controller = _get_controller()
-    replicas = ray_tpu.get(controller.scale.remote(name, num_replicas))
-    batch_config = ray_tpu.get(controller.get_batch_config.remote(name))
-    return DeploymentHandle(name, replicas, batch_config=batch_config)
+    ray_tpu.get(controller.scale.remote(name, num_replicas))
+    return get_deployment_handle(name)
 
 
 def status() -> Dict[str, int]:
@@ -89,9 +123,20 @@ def status() -> Dict[str, int]:
     return ray_tpu.get(_get_controller().list_deployments.remote())
 
 
+def details() -> Dict[str, Dict[str, Any]]:
+    """Per-deployment state: replica count/target, version, autoscaling
+    (ref: serve.status() ApplicationDetails)."""
+    import ray_tpu
+
+    return ray_tpu.get(_get_controller().describe.remote())
+
+
 def delete(name: str):
     import ray_tpu
 
+    state = _states.pop(name, None)
+    if state is not None:
+        state.closed = True
     ray_tpu.get(_get_controller().delete.remote(name))
 
 
@@ -100,6 +145,9 @@ def shutdown():
     import ray_tpu
 
     http_proxy.stop_proxy()
+    for state in _states.values():
+        state.closed = True
+    _states.clear()
     if _controller is not None:
         try:
             ray_tpu.get(_controller.shutdown.remote())

@@ -1,104 +1,436 @@
-"""Serve controller + application state.
+"""Serve controller + deployment state machine.
 
 Ref analogue: serve/_private/controller.py ServeController (:88) owning
 ApplicationState/DeploymentState (deployment_state.py:1193 — replica state
-machine, scaling). The controller is a named actor; deploy/scale/delete
-reconcile the replica actor set.
+machine, scaling, rolling updates), autoscaling_policy.py (queue-depth
+driven replica count), long_poll.py (push of route changes to handles).
+
+The controller is a named actor created with max_concurrency so that
+long-poll calls from many handles block their own threads without stalling
+deploy/scale. A daemon reconcile thread drives autoscaling from metrics
+pushed by handles (ref analogue: handle-side autoscaling metrics,
+serve/_private/router.py metrics pusher).
+
+Rolling updates (ref: deployment_state.py _check_and_update_replicas):
+deploying a NEW VERSION over a live deployment starts one new-version
+replica at a time, waits for readiness, then retires one old-version
+replica — the route set never drops below the target count, so in-flight
+traffic always has somewhere to go (zero-downtime).
 """
 
 from __future__ import annotations
 
+import hashlib
+import threading
+import time
 from typing import Any, Dict, List, Optional
 
-import cloudpickle
-
 CONTROLLER_NAME = "__serve_controller__"
+CONTROLLER_MAX_CONCURRENCY = 16
+
+RECONCILE_INTERVAL_S = 0.2
+# Handle metric reports older than this are dropped (handle died / idle).
+METRIC_STALENESS_S = 2.0
+HEALTH_CHECK_PERIOD_S = 1.0
+HEALTH_CHECK_TIMEOUT_S = 2.0
+
+
+class _DeploymentState:
+    """Target + actual state for one deployment."""
+
+    def __init__(self):
+        self.blob: bytes = b""
+        self.init_args = ()
+        self.init_kwargs: Dict[str, Any] = {}
+        self.target_replicas: int = 1
+        self.ray_actor_options: Dict[str, Any] = {}
+        self.batch_config: Optional[Dict[str, Any]] = None
+        self.autoscaling: Optional[Dict[str, float]] = None
+        self.version: str = ""
+        # Live replica handles, each tagged with the version it was
+        # started under: list of (handle, version).
+        self.replicas: List[Any] = []
+        self.replica_versions: List[str] = []
+        # Bumped whenever the routable replica set changes; handles
+        # long-poll on this (ref: long_poll.py snapshot ids).
+        self.route_version: int = 0
+        # Autoscaler smoothing state.
+        self.upscale_since: Optional[float] = None
+        self.downscale_since: Optional[float] = None
+        # handle_id -> (total_outstanding, timestamp)
+        self.handle_metrics: Dict[str, Any] = {}
 
 
 class ServeControllerActor:
-    """Runs as a named actor; holds deployment → replica handles."""
+    """Runs as a named actor; reconciles replica sets toward target state."""
 
     def __init__(self):
-        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._lock = threading.RLock()
+        self._route_cond = threading.Condition(self._lock)
+        self._stopped = False
+        self._reconciler = threading.Thread(
+            target=self._reconcile_loop, daemon=True
+        )
+        self._reconciler.start()
+
+    # ---- replica lifecycle helpers ----------------------------------------
+
+    def _start_replicas(self, st: _DeploymentState, n: int,
+                        version: str) -> List[Any]:
+        import ray_tpu
+        from .replica import Replica
+
+        opts = dict(st.ray_actor_options)
+        actor_cls = ray_tpu.remote(**opts)(Replica) if opts else \
+            ray_tpu.remote(Replica)
+        new = [
+            actor_cls.remote(st.blob, st.init_args, st.init_kwargs, version)
+            for _ in range(n)
+        ]
+        # Block until every replica's constructor finished (readiness gate;
+        # ref: deployment_state.py waiting for replicas to be RUNNING).
+        ray_tpu.get([r.ping.remote() for r in new])
+        return new
+
+    def _kill_replica(self, handle) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(handle)
+        except Exception:
+            pass
+
+    def _bump_route(self, st: _DeploymentState) -> None:
+        st.route_version += 1
+        self._route_cond.notify_all()
+
+    # ---- public control API ------------------------------------------------
 
     def deploy(self, name: str, blob: bytes, init_args, init_kwargs,
                num_replicas: int, ray_actor_options: Dict[str, Any],
-               batch_config: Optional[Dict[str, Any]]) -> List[Any]:
-        import ray_tpu
-        from .replica import Replica
+               batch_config: Optional[Dict[str, Any]],
+               autoscaling: Optional[Dict[str, float]] = None,
+               version: Optional[str] = None) -> List[Any]:
+        if version is None:
+            version = hashlib.sha1(
+                blob + repr((init_args, init_kwargs)).encode()
+            ).hexdigest()[:12]
 
-        existing = self._deployments.get(name)
-        if existing:
-            for h in existing["replicas"]:
-                try:
-                    ray_tpu.kill(h)
-                except Exception:
-                    pass
-        opts = dict(ray_actor_options)
-        actor_cls = ray_tpu.remote(**opts)(Replica) if opts else \
-            ray_tpu.remote(Replica)
-        replicas = [
-            actor_cls.remote(blob, init_args, init_kwargs)
-            for _ in range(num_replicas)
-        ]
-        # Block until every replica's constructor finished (gang readiness).
-        ray_tpu.get([r.ping.remote() for r in replicas])
-        self._deployments[name] = {
-            "blob": blob,
-            "init_args": init_args,
-            "init_kwargs": init_kwargs,
-            "replicas": replicas,
-            "ray_actor_options": ray_actor_options,
-            "batch_config": batch_config,
-        }
-        return replicas
+        with self._lock:
+            st = self._deployments.get(name)
+            fresh = st is None
+            if fresh:
+                st = _DeploymentState()
+                self._deployments[name] = st
+            old_version = st.version
+            st.blob = blob
+            st.init_args = init_args
+            st.init_kwargs = dict(init_kwargs)
+            st.ray_actor_options = dict(ray_actor_options)
+            st.batch_config = batch_config
+            st.autoscaling = dict(autoscaling) if autoscaling else None
+            st.version = version
+            if st.autoscaling:
+                lo = int(st.autoscaling.get("min_replicas", 1))
+                hi = int(st.autoscaling.get("max_replicas", num_replicas))
+                num_replicas = min(max(num_replicas, lo), hi)
+            st.target_replicas = num_replicas
+
+        if fresh or not st.replicas:
+            new = self._start_replicas(st, num_replicas, version)
+            with self._lock:
+                st.replicas = new
+                st.replica_versions = [version] * len(new)
+                self._bump_route(st)
+            return list(st.replicas)
+
+        if old_version == version:
+            # Same code + args: just converge the replica count.
+            self._converge_count(name)
+            with self._lock:
+                return list(st.replicas)
+
+        self._rolling_update(name, version)
+        with self._lock:
+            return list(st.replicas)
+
+    def _rolling_update(self, name: str, version: str) -> None:
+        """Replace old-version replicas one at a time, new-first."""
+        while True:
+            with self._lock:
+                st = self._deployments.get(name)
+                if st is None or st.version != version:
+                    return  # deleted or superseded by a newer deploy
+                stale = [
+                    i for i, v in enumerate(st.replica_versions)
+                    if v != version
+                ]
+                if not stale and len(st.replicas) >= st.target_replicas:
+                    return
+            # Surge: start the replacement before retiring the old one so
+            # capacity never dips (ref: max_surge semantics).
+            new = self._start_replicas(st, 1, version)
+            with self._lock:
+                if st.version != version:
+                    break  # superseded mid-update; new replica is orphaned
+                st.replicas.extend(new)
+                st.replica_versions.extend([version] * len(new))
+                stale = [
+                    i for i, v in enumerate(st.replica_versions)
+                    if v != version
+                ]
+                victim = None
+                if stale and len(st.replicas) > st.target_replicas:
+                    i = stale[0]
+                    victim = st.replicas.pop(i)
+                    st.replica_versions.pop(i)
+                self._bump_route(st)
+            if victim is not None:
+                # Retired from the route set first; grace period lets
+                # in-flight calls drain before the actor dies.
+                self._drain_and_kill(victim)
+        # Superseded: clean up the orphan we just made.
+        for h in new:
+            self._kill_replica(h)
+
+    def _drain_and_kill(self, handle) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.get(handle.prepare_shutdown.remote(), timeout=5.0)
+        except Exception:
+            pass
+        self._kill_replica(handle)
+
+    def _converge_count(self, name: str) -> None:
+        """Bring the live replica count to target_replicas."""
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return
+            cur = len(st.replicas)
+            target = st.target_replicas
+            version = st.version
+            victims = []
+            if cur > target:
+                victims = st.replicas[target:]
+                del st.replicas[target:]
+                del st.replica_versions[target:]
+                self._bump_route(st)
+        if cur < target:
+            new = self._start_replicas(st, target - cur, version)
+            with self._lock:
+                if self._deployments.get(name) is st:
+                    st.replicas.extend(new)
+                    st.replica_versions.extend([version] * len(new))
+                    self._bump_route(st)
+                else:
+                    victims = new
+        for h in victims:
+            self._drain_and_kill(h)
 
     def scale(self, name: str, num_replicas: int) -> List[Any]:
-        import ray_tpu
-        from .replica import Replica
+        with self._lock:
+            st = self._deployments[name]
+            st.target_replicas = num_replicas
+        self._converge_count(name)
+        with self._lock:
+            return list(st.replicas)
 
-        d = self._deployments[name]
-        cur = d["replicas"]
-        if num_replicas > len(cur):
-            opts = dict(d["ray_actor_options"])
-            actor_cls = ray_tpu.remote(**opts)(Replica) if opts else \
-                ray_tpu.remote(Replica)
-            new = [
-                actor_cls.remote(d["blob"], d["init_args"], d["init_kwargs"])
-                for _ in range(num_replicas - len(cur))
+    # ---- autoscaling -------------------------------------------------------
+
+    def record_handle_metrics(self, name: str, handle_id: str,
+                              outstanding: int) -> None:
+        """Handles push their outstanding-request totals here (ref:
+        handle-side autoscaling metrics push, serve/_private/router.py)."""
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is not None:
+                st.handle_metrics[handle_id] = (outstanding, time.monotonic())
+
+    def _autoscale_once(self, name: str) -> None:
+        import math
+
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None or not st.autoscaling or not st.replicas:
+                return
+            cfg = st.autoscaling
+            now = time.monotonic()
+            total = sum(
+                v for v, ts in st.handle_metrics.values()
+                if now - ts < METRIC_STALENESS_S
+            )
+            cur = st.target_replicas
+            target_ongoing = float(cfg.get("target_ongoing_requests", 2.0))
+            desired = math.ceil(total / max(target_ongoing, 1e-9))
+            desired = min(
+                max(desired, int(cfg.get("min_replicas", 1))),
+                int(cfg.get("max_replicas", cur)),
+            )
+            if desired > cur:
+                st.downscale_since = None
+                if st.upscale_since is None:
+                    st.upscale_since = now
+                if now - st.upscale_since < float(
+                        cfg.get("upscale_delay_s", 2.0)):
+                    return
+            elif desired < cur:
+                st.upscale_since = None
+                if st.downscale_since is None:
+                    st.downscale_since = now
+                if now - st.downscale_since < float(
+                        cfg.get("downscale_delay_s", 10.0)):
+                    return
+            else:
+                st.upscale_since = None
+                st.downscale_since = None
+                return
+            st.upscale_since = None
+            st.downscale_since = None
+            st.target_replicas = desired
+        self._converge_count(name)
+
+    def _health_check_once(self, name: str) -> None:
+        """Remove replicas whose actor died (worker crash, node loss) from
+        the route set and start replacements (ref: deployment_state.py
+        health checking + replica recovery). A ping that merely times out
+        is 'busy', not dead — only actor-death errors evict."""
+        import ray_tpu
+        from ray_tpu.core.exceptions import (
+            ActorDiedError,
+            WorkerCrashedError,
+        )
+
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None or not st.replicas:
+                return
+            reps = list(st.replicas)
+        pings = [(r, r.ping.remote()) for r in reps]
+        dead = []
+        for r, ref in pings:
+            try:
+                ray_tpu.get(ref, timeout=HEALTH_CHECK_TIMEOUT_S)
+            except (ActorDiedError, WorkerCrashedError):
+                dead.append(r)
+            except Exception:
+                pass  # slow/busy is not dead
+        if not dead:
+            return
+        dead_ids = {id(r) for r in dead}
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return
+            keep = [
+                (r, v) for r, v in zip(st.replicas, st.replica_versions)
+                if id(r) not in dead_ids
             ]
-            ray_tpu.get([r.ping.remote() for r in new])
-            cur.extend(new)
-        elif num_replicas < len(cur):
-            for h in cur[num_replicas:]:
-                try:
-                    ray_tpu.kill(h)
-                except Exception:
-                    pass
-            del cur[num_replicas:]
-        return cur
+            st.replicas = [r for r, _ in keep]
+            st.replica_versions = [v for _, v in keep]
+            self._bump_route(st)
+        self._converge_count(name)
+
+    def _reconcile_loop(self) -> None:
+        # Wait for the worker runtime to finish wiring this actor up before
+        # issuing nested remote calls from a background thread.
+        time.sleep(RECONCILE_INTERVAL_S)
+        last_health = 0.0
+        while not self._stopped:
+            try:
+                check_health = (
+                    time.monotonic() - last_health > HEALTH_CHECK_PERIOD_S
+                )
+                if check_health:
+                    last_health = time.monotonic()
+                for name in list(self._deployments):
+                    self._autoscale_once(name)
+                    if check_health:
+                        self._health_check_once(name)
+            except Exception:
+                pass
+            time.sleep(RECONCILE_INTERVAL_S)
+
+    # ---- handle-facing query API -------------------------------------------
+
+    def get_routing(self, name: str) -> Dict[str, Any]:
+        with self._lock:
+            st = self._deployments[name]
+            return {
+                "version": st.route_version,
+                "replicas": list(st.replicas),
+                "batch_config": st.batch_config,
+            }
+
+    def listen_for_route_change(self, name: str, known_version: int,
+                                timeout_s: float = 10.0) -> Dict[str, Any]:
+        """Long-poll: returns as soon as the route set changes, or after
+        timeout with the current snapshot (ref: long_poll.py
+        LongPollClient/Host)."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                st = self._deployments.get(name)
+                if st is None:
+                    return {"version": -1, "replicas": [],
+                            "batch_config": None}
+                if st.route_version != known_version:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._route_cond.wait(remaining)
+            return {
+                "version": st.route_version,
+                "replicas": list(st.replicas),
+                "batch_config": st.batch_config,
+            }
 
     def get_replicas(self, name: str) -> List[Any]:
-        return self._deployments[name]["replicas"]
+        with self._lock:
+            return list(self._deployments[name].replicas)
 
     def get_batch_config(self, name: str):
-        return self._deployments[name]["batch_config"]
+        with self._lock:
+            return self._deployments[name].batch_config
 
     def list_deployments(self) -> Dict[str, int]:
-        return {k: len(v["replicas"]) for k, v in self._deployments.items()}
+        with self._lock:
+            return {
+                k: len(v.replicas) for k, v in self._deployments.items()
+            }
+
+    def describe(self) -> Dict[str, Dict[str, Any]]:
+        """Rich status (ref: serve.status() ApplicationDetails)."""
+        with self._lock:
+            return {
+                name: {
+                    "num_replicas": len(st.replicas),
+                    "target_replicas": st.target_replicas,
+                    "version": st.version,
+                    "replica_versions": list(st.replica_versions),
+                    "autoscaling": st.autoscaling,
+                    "route_version": st.route_version,
+                }
+                for name, st in self._deployments.items()
+            }
 
     def delete(self, name: str):
-        import ray_tpu
-
-        d = self._deployments.pop(name, None)
-        if d:
-            for h in d["replicas"]:
-                try:
-                    ray_tpu.kill(h)
-                except Exception:
-                    pass
+        with self._lock:
+            st = self._deployments.pop(name, None)
+            if st is not None:
+                victims = list(st.replicas)
+                st.replicas = []
+                st.replica_versions = []
+                self._bump_route(st)
+        if st is not None:
+            for h in victims:
+                self._kill_replica(h)
 
     def shutdown(self):
+        self._stopped = True
         for name in list(self._deployments):
             self.delete(name)
         return "ok"

@@ -6,6 +6,23 @@ two replicas and picks the one with fewer outstanding requests (queue
 lengths tracked by the caller; the reference queries replicas — local
 tracking is the single-process simplification of the same policy).
 
+Routing state (replica set + queue depths) lives in one shared
+``_RouterState`` per handle family: ``handle.options(method=...)`` clones
+share it, so a scale/rolling-update seen by any of them is seen by all.
+The state keeps itself fresh via a LONG-POLL to the controller (ref
+analogue: long_poll.py LongPollClient): a daemon thread blocks in
+``listen_for_route_change`` and swaps the routable set the moment the
+controller scales or rolls a deployment. The same thread pushes the
+handle's outstanding-request total to the controller, which is the input
+to queue-depth autoscaling (ref: handle-side autoscaling metrics). The
+thread holds only a WEAK reference to the state — dropping every handle
+ends the poller instead of leaking it.
+
+Requests that land on a replica retired mid-flight (rolling update,
+downscale, worker crash) evict that replica locally and retry against the
+refreshed set — this is what makes redeploys zero-downtime and replica
+crashes invisible to the caller.
+
 Dynamic batching lives here too (ref analogue: serve/batching.py
 _BatchQueue:65): requests buffer until max_batch_size or batch_wait_timeout_s
 and flush as ONE replica call — on TPU this is what keeps the MXU fed with
@@ -17,7 +34,185 @@ from __future__ import annotations
 import random
 import threading
 import time
+import uuid
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
+
+MAX_DEATH_RETRIES = 3
+# How long an evicted replica key stays filtered out of snapshots (covers
+# the gap until the controller's health check removes it server-side).
+DEAD_REPLICA_TTL_S = 10.0
+
+
+def _replica_key(replica) -> Any:
+    return getattr(replica, "_actor_id", None) or id(replica)
+
+
+class _RouterState:
+    """Shared routing view for one deployment (all handle clones)."""
+
+    def __init__(self, deployment_name: str, replicas: List[Any],
+                 controller, route_version: int):
+        self.deployment_name = deployment_name
+        self.lock = threading.Lock()
+        self.replicas = list(replicas)
+        self.route_version = route_version
+        self.outstanding: Dict[Any, int] = {}
+        self.controller = controller
+        self.handle_id = uuid.uuid4().hex[:12]
+        self.closed = False
+        # Keys of replicas we observed dead, with eviction time: filtered
+        # out of controller snapshots until the health checker has had time
+        # to remove them server-side (prevents re-routing to a corpse).
+        self.dead: Dict[Any, float] = {}
+        if controller is not None:
+            t = threading.Thread(
+                target=_refresh_loop, args=(weakref.ref(self),), daemon=True
+            )
+            t.start()
+
+    # ---- replica selection (power of two choices) -------------------------
+
+    def pick(self):
+        with self.lock:
+            reps = self.replicas
+            n = len(reps)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no replicas"
+                )
+            if n == 1:
+                return reps[0]
+            a, b = random.sample(range(n), 2)
+            da = self.outstanding.get(_replica_key(reps[a]), 0)
+            db = self.outstanding.get(_replica_key(reps[b]), 0)
+            return reps[a] if da <= db else reps[b]
+
+    def begin(self, replica) -> None:
+        with self.lock:
+            k = _replica_key(replica)
+            self.outstanding[k] = self.outstanding.get(k, 0) + 1
+
+    def end(self, replica) -> None:
+        with self.lock:
+            k = _replica_key(replica)
+            n = self.outstanding.get(k, 0) - 1
+            if n <= 0:
+                self.outstanding.pop(k, None)
+            else:
+                self.outstanding[k] = n
+
+    def evict(self, replica) -> None:
+        """Drop a replica observed dead so retries don't re-pick it."""
+        k = _replica_key(replica)
+        with self.lock:
+            self.dead[k] = time.monotonic()
+            self.replicas = [
+                r for r in self.replicas if _replica_key(r) != k
+            ]
+
+    def apply_snapshot(self, snap: Dict[str, Any]) -> None:
+        now = time.monotonic()
+        with self.lock:
+            for k, ts in list(self.dead.items()):
+                if now - ts > DEAD_REPLICA_TTL_S:
+                    del self.dead[k]
+            self.route_version = snap["version"]
+            self.replicas = [
+                r for r in snap["replicas"]
+                if _replica_key(r) not in self.dead
+            ]
+
+    def force_refresh(self) -> None:
+        """Synchronous route refresh after observing a dead replica."""
+        import ray_tpu
+
+        if self.controller is None:
+            return
+        try:
+            snap = ray_tpu.get(
+                self.controller.get_routing.remote(self.deployment_name),
+                timeout=5.0,
+            )
+            self.apply_snapshot(snap)
+        except Exception:
+            pass
+
+
+def _refresh_loop(state_ref: "weakref.ref[_RouterState]") -> None:
+    """Long-poll the controller for route changes and push metrics.
+
+    Holds only a weakref: when the last handle sharing the state is
+    garbage-collected, the loop exits — no immortal poller threads.
+    """
+    import ray_tpu
+
+    while True:
+        state = state_ref()
+        if state is None or state.closed:
+            return
+        try:
+            with state.lock:
+                total = sum(state.outstanding.values())
+                known = state.route_version
+            controller = state.controller
+            name = state.deployment_name
+            handle_id = state.handle_id
+            controller.record_handle_metrics.remote(name, handle_id, total)
+            ref = controller.listen_for_route_change.remote(name, known, 0.5)
+            del state  # don't pin the state across the blocking poll
+            snap = ray_tpu.get(ref, timeout=10.0)
+            state = state_ref()
+            if state is None or state.closed:
+                return
+            if snap["version"] < 0:
+                # Deployment deleted: back off instead of spinning on the
+                # controller's immediate not-found replies (it may come
+                # back on a future serve.run with the same name).
+                del state
+                time.sleep(0.5)
+                continue
+            if snap["version"] != known:
+                state.apply_snapshot(snap)
+            del state
+        except Exception:
+            time.sleep(0.2)
+
+
+def _route_with_retry(state: _RouterState, submit, deliver, deliver_error):
+    """Shared request path: pick a replica (p2c), submit, deliver the
+    result; on actor death evict + refresh + retry (bounded)."""
+    import ray_tpu
+    from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
+
+    last_err: Optional[BaseException] = None
+    for attempt in range(MAX_DEATH_RETRIES + 1):
+        try:
+            replica = state.pick()
+        except RuntimeError as e:
+            if last_err is not None:
+                # Mid-update empty window: refetch rather than fail.
+                state.force_refresh()
+                time.sleep(0.05 * (attempt + 1))
+                continue
+            deliver_error(e)
+            return
+        state.begin(replica)
+        try:
+            deliver(ray_tpu.get(submit(replica)))
+            return
+        except (ActorDiedError, WorkerCrashedError) as e:
+            # Replica retired/crashed under us (rolling update, node
+            # loss): evict it locally, refresh, retry elsewhere.
+            last_err = e
+            state.evict(replica)
+            state.force_refresh()
+        except BaseException as e:  # noqa: BLE001
+            deliver_error(e)
+            return
+        finally:
+            state.end(replica)
+    deliver_error(last_err)
 
 
 class _PendingBatch:
@@ -62,72 +257,58 @@ class ServeFuture:
 class DeploymentHandle:
     def __init__(self, deployment_name: str, replicas: List[Any],
                  *, batch_config: Optional[Dict[str, Any]] = None,
-                 method: str = "__call__"):
+                 method: str = "__call__", controller=None,
+                 route_version: int = 0, _state: Optional[_RouterState] = None):
         self.deployment_name = deployment_name
-        self._replicas = list(replicas)
-        self._outstanding: Dict[int, int] = {
-            i: 0 for i in range(len(replicas))
-        }
-        self._lock = threading.Lock()
+        self._state = _state or _RouterState(
+            deployment_name, replicas, controller, route_version
+        )
         self._method = method
         self._batch = batch_config
+        self._batch_lock = threading.Lock()
         self._pending: Optional[_PendingBatch] = None
-        self._flusher: Optional[threading.Thread] = None
 
-    # ---- replica selection -------------------------------------------------
-
-    def _pick_replica(self) -> int:
-        """Power of two choices on local outstanding counts."""
-        with self._lock:
-            n = len(self._replicas)
-            if n == 1:
-                return 0
-            a, b = random.sample(range(n), 2)
-            return a if self._outstanding[a] <= self._outstanding[b] else b
-
-    def _track(self, idx: int, ref) -> None:
-        import ray_tpu
-
-        with self._lock:
-            self._outstanding[idx] += 1
-
-        def _done():
-            try:
-                ray_tpu.wait([ref], num_returns=1, timeout=None)
-            finally:
-                with self._lock:
-                    self._outstanding[idx] -= 1
-
-        threading.Thread(target=_done, daemon=True).start()
+    def close(self):
+        self._state.closed = True
 
     # ---- request path ------------------------------------------------------
 
     def options(self, method: Optional[str] = None) -> "DeploymentHandle":
-        h = DeploymentHandle(
-            self.deployment_name, self._replicas,
+        """Clone bound to another method; shares routing + queue-depth
+        state with the parent (one long-poller per handle family)."""
+        return DeploymentHandle(
+            self.deployment_name, [],
             batch_config=self._batch, method=method or self._method,
+            _state=self._state,
         )
-        h._outstanding = self._outstanding  # share queue-depth view
-        h._lock = self._lock
-        return h
 
     def remote(self, *args, **kwargs) -> ServeFuture:
         if self._batch:
             return self._remote_batched(args, kwargs)
         fut = ServeFuture()
-        idx = self._pick_replica()
-        replica = self._replicas[idx]
-        ref = replica.handle_request.remote(self._method, args, kwargs)
-        self._track(idx, ref)
-        fut._set_ref(ref)
+        threading.Thread(
+            target=self._run_with_retry,
+            args=(fut, self._method, args, kwargs),
+            daemon=True,
+        ).start()
         return fut
+
+    def _run_with_retry(self, fut: ServeFuture, method, args, kwargs):
+        _route_with_retry(
+            self._state,
+            lambda replica: replica.handle_request.remote(
+                method, args, kwargs
+            ),
+            fut._set_value,
+            fut._set_error,
+        )
 
     # ---- dynamic batching --------------------------------------------------
 
     def _remote_batched(self, args, kwargs) -> ServeFuture:
         fut = ServeFuture()
         flush: Optional[_PendingBatch] = None
-        with self._lock:
+        with self._batch_lock:
             if self._pending is None:
                 self._pending = _PendingBatch()
                 self._start_flusher()
@@ -144,7 +325,7 @@ class DeploymentHandle:
 
         def run():
             time.sleep(wait_s)
-            with self._lock:
+            with self._batch_lock:
                 flush, self._pending = self._pending, None
             if flush is not None:
                 self._flush(flush)
@@ -152,30 +333,35 @@ class DeploymentHandle:
         threading.Thread(target=run, daemon=True).start()
 
     def _flush(self, batch: _PendingBatch):
-        import ray_tpu
-
-        idx = self._pick_replica()
-        replica = self._replicas[idx]
         payload = [item for item, _ in batch.items]
-        ref = replica.handle_batch.remote(self._method, payload)
-        self._track(idx, ref)
 
-        def resolve():
-            try:
-                results = ray_tpu.get(ref)
-                for (_, fut), value in zip(batch.items, results):
-                    fut._set_value(value)
-            except BaseException as e:  # noqa: BLE001
-                for _, fut in batch.items:
-                    fut._set_error(e)
+        def deliver(results):
+            for (_, fut), value in zip(batch.items, results):
+                fut._set_value(value)
 
-        threading.Thread(target=resolve, daemon=True).start()
+        def deliver_error(err):
+            for _, fut in batch.items:
+                fut._set_error(err)
+
+        threading.Thread(
+            target=_route_with_retry,
+            args=(
+                self._state,
+                lambda replica: replica.handle_batch.remote(
+                    self._method, payload
+                ),
+                deliver,
+                deliver_error,
+            ),
+            daemon=True,
+        ).start()
 
     # ---- introspection -----------------------------------------------------
 
     def num_replicas(self) -> int:
-        return len(self._replicas)
+        with self._state.lock:
+            return len(self._state.replicas)
 
-    def queue_depths(self) -> Dict[int, int]:
-        with self._lock:
-            return dict(self._outstanding)
+    def queue_depths(self) -> Dict[Any, int]:
+        with self._state.lock:
+            return dict(self._state.outstanding)

@@ -5,19 +5,23 @@ call_user_method:851). Function deployments are called directly; class
 deployments are instantiated once and called via __call__ or a named
 method. ``handle_batch`` is the vectorized entry used by the router's
 dynamic batcher (ref analogue: serve/batching.py _BatchQueue flushing into
-the user's batch method).
+the user's batch method). Each replica carries the deployment version it
+was started under (ref: deployment_version.py) so the controller can
+drive rolling updates.
 """
 
 from __future__ import annotations
 
 import inspect
+import threading
 from typing import Any, Dict, List, Tuple
 
 import cloudpickle
 
 
 class Replica:
-    def __init__(self, blob: bytes, init_args, init_kwargs):
+    def __init__(self, blob: bytes, init_args, init_kwargs,
+                 version: str = ""):
         target = cloudpickle.loads(blob)
         if inspect.isclass(target):
             self._callable = target(*init_args, **init_kwargs)
@@ -26,33 +30,60 @@ class Replica:
             self._callable = target
             self._is_class = False
         self._num_handled = 0
+        self._version = version
+        self._ongoing = 0
+        self._lock = threading.Lock()
+
+    def _resolve(self, method: str):
+        if self._is_class and method != "__call__":
+            return getattr(self._callable, method)
+        return self._callable
 
     def handle_request(self, method: str, args: Tuple, kwargs: Dict) -> Any:
-        self._num_handled += 1
-        if self._is_class and method != "__call__":
-            fn = getattr(self._callable, method)
-        else:
-            fn = self._callable
-        return fn(*args, **kwargs)
+        with self._lock:
+            self._num_handled += 1
+            self._ongoing += 1
+        try:
+            return self._resolve(method)(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
 
     def handle_batch(self, method: str, batched_args: List[Tuple]) -> List[Any]:
         """One call per batch: user function receives a list of first
         positional args and must return a list of equal length."""
-        self._num_handled += len(batched_args)
-        if self._is_class and method != "__call__":
-            fn = getattr(self._callable, method)
-        else:
-            fn = self._callable
-        items = [a[0][0] if a[0] else None for a in batched_args]
-        out = fn(items)
-        if not isinstance(out, (list, tuple)) or len(out) != len(items):
-            raise ValueError(
-                "batched deployment must return a list matching input length"
-            )
-        return list(out)
+        with self._lock:
+            self._num_handled += len(batched_args)
+            self._ongoing += 1
+        try:
+            fn = self._resolve(method)
+            items = [a[0][0] if a[0] else None for a in batched_args]
+            out = fn(items)
+            if not isinstance(out, (list, tuple)) or len(out) != len(items):
+                raise ValueError(
+                    "batched deployment must return a list matching input "
+                    "length"
+                )
+            return list(out)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
 
     def stats(self) -> Dict[str, Any]:
-        return {"num_handled": self._num_handled}
+        return {
+            "num_handled": self._num_handled,
+            "ongoing": self._ongoing,
+            "version": self._version,
+        }
+
+    def version(self) -> str:
+        return self._version
+
+    def prepare_shutdown(self) -> str:
+        """Drain hook: by the time this call is served, every request queued
+        before the controller retired this replica from the route set has
+        been executed (actor calls from one submitter are ordered)."""
+        return "drained"
 
     def ping(self) -> str:
         return "pong"

@@ -213,8 +213,19 @@ class JaxTrainer:
                     pg = tpu_mod.tpu_slice(
                         name, num_hosts=sc.num_workers
                     )
-            except Exception:
-                pg = None  # no slice topology: plain gang below
+            except Exception as e:
+                # Only the no-slices case is a silent fallback; anything
+                # else (selector mismatch, reservation timeout) degrades to
+                # non-topology placement and must be visible.
+                import sys
+
+                print(
+                    f"[ray_tpu.train] WARNING: tpu slice placement failed "
+                    f"({type(e).__name__}: {e}); falling back to plain "
+                    f"SPREAD gang (no ICI-topology affinity)",
+                    file=sys.stderr,
+                )
+                pg = None
         res = sc.worker_resources()
         return SpmdActorGroup(
             _RemoteTrainWorker,

@@ -105,3 +105,136 @@ def test_deployment_error_propagates(serve_cluster):
     handle = serve.run(bad.bind())
     with pytest.raises(ValueError, match="replica failed"):
         handle.remote(1).result(timeout=30)
+
+
+def test_autoscaling_up_and_down(serve_cluster):
+    """AutoscalingConfig drives the replica count from handle queue depth
+    (ref: autoscaling_policy.py): load pushes replicas up to max, idleness
+    brings them back down to min."""
+    import time
+
+    @serve.deployment(
+        num_replicas=1,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1,
+            max_replicas=3,
+            target_ongoing_requests=1.0,
+            upscale_delay_s=0.2,
+            downscale_delay_s=0.5,
+        ),
+    )
+    def slow(x):
+        time.sleep(0.25)
+        return x
+
+    handle = serve.run(slow.bind(), name="auto")
+    # Sustain enough concurrent load that total outstanding stays >> 1.
+    futs = [handle.remote(i) for i in range(40)]
+    grew_to = 1
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        grew_to = max(grew_to, serve.status()["auto"])
+        if grew_to >= 2:
+            break
+        time.sleep(0.1)
+    assert grew_to >= 2, f"autoscaler never scaled up (peak={grew_to})"
+    assert all(f.result(timeout=60) is not None for f in futs)
+    # Idle now: expect decay back to min_replicas.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if serve.status()["auto"] == 1:
+            break
+        time.sleep(0.1)
+    assert serve.status()["auto"] == 1, "autoscaler never scaled back down"
+
+
+def test_rolling_redeploy_zero_downtime(serve_cluster):
+    """Redeploying new code rolls replicas one at a time; requests issued
+    throughout the update all succeed and eventually see the new version
+    (ref: deployment_state.py rolling updates)."""
+    import threading
+    import time
+
+    def make(version):
+        @serve.deployment(num_replicas=2)
+        def versioned(x):
+            return {"version": version, "x": x}
+
+        return versioned
+
+    handle = serve.run(make("v1").bind(), name="roll")
+    assert handle.remote(0).result(timeout=30)["version"] == "v1"
+
+    results, errors = [], []
+    stop = threading.Event()
+
+    def spam():
+        while not stop.is_set():
+            try:
+                results.append(handle.remote(1).result(timeout=30))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            time.sleep(0.02)
+
+    t = threading.Thread(target=spam)
+    t.start()
+    time.sleep(0.3)
+    handle2 = serve.run(make("v2").bind(), name="roll")
+    # Wait until the new version is being served.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if results and results[-1]["version"] == "v2":
+            break
+        time.sleep(0.1)
+    time.sleep(0.3)
+    stop.set()
+    t.join(timeout=30)
+    assert not errors, f"requests failed during rolling update: {errors[:3]}"
+    versions = {r["version"] for r in results}
+    assert "v2" in versions, "update never took effect"
+    assert serve.details()["roll"]["replica_versions"] == \
+        [serve.details()["roll"]["version"]] * 2
+    assert handle2.remote(5).result(timeout=30)["version"] == "v2"
+
+
+def test_replica_crash_recovery(serve_cluster):
+    """A replica whose worker dies is evicted from routing and replaced by
+    the controller's health check; callers see retries, not errors (ref:
+    deployment_state.py health checks + recovery)."""
+    import os
+    import time
+
+    @serve.deployment(num_replicas=2)
+    class Victim:
+        def pid(self, _=None):
+            return os.getpid()
+
+        def die_if(self, pid):
+            # Targeted kill: retries that land on another replica no-op.
+            if os.getpid() == pid:
+                os._exit(1)
+            return "not me"
+
+        def __call__(self, x):
+            return x + 1
+
+    handle = serve.run(Victim.bind(), name="crashy")
+    pid_handle = handle.options(method="pid")
+    pids = {pid_handle.remote().result(timeout=30) for _ in range(20)}
+    assert len(pids) == 2
+    # Kill one replica process out from under the router.
+    handle.options(method="die_if").remote(next(iter(pids)))
+    time.sleep(0.5)
+    # Traffic keeps flowing throughout recovery.
+    for i in range(20):
+        assert handle.remote(i).result(timeout=30) == i + 1
+        time.sleep(0.05)
+    # Health check replaces the dead replica: back to 2 within its period.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if serve.status()["crashy"] == 2:
+            break
+        time.sleep(0.2)
+    assert serve.status()["crashy"] == 2
+    new_pids = {pid_handle.remote().result(timeout=30) for _ in range(20)}
+    assert len(new_pids) == 2
