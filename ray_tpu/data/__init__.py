@@ -5,6 +5,7 @@ from .block import Block, BlockAccessor  # noqa: F401
 from .context import DataContext  # noqa: F401
 from .dataset import Dataset  # noqa: F401
 from .iterator import DataIterator  # noqa: F401
+from . import preprocessors  # noqa: F401
 from .read_api import (  # noqa: F401
     from_arrow,
     from_items,

@@ -2,15 +2,16 @@
 
 Ref analogue: python/ray/data/dataset.py Dataset (:158) with the logical
 plan + streaming execution model of _internal/execution/ (SURVEY.md §2.3):
-transforms build a lazy per-block operator chain; execution fuses the whole
-chain into ONE task per block (the same effect as the reference's
-MapOperator fusion) and streams block futures with a bounded in-flight
-window (backpressure). Global ops (shuffle/sort/repartition/groupby) insert
-materialization barriers.
+transforms build a lazy STAGE pipeline (streaming_executor.py) — fused
+per-block task chains plus actor-pool stages for stateful transforms —
+executed with per-stage bounded in-flight windows (backpressure). Global
+ops (shuffle/sort/repartition) run as distributed two-stage shuffles
+(shuffle.py) whose intermediate partitions never touch the driver.
 """
 
 from __future__ import annotations
 
+import inspect
 import itertools
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
@@ -25,6 +26,7 @@ from .block import (
     normalize_to_block,
 )
 from .context import DataContext
+from .streaming_executor import ActorStage, TaskStage, execute, execute_refs
 
 
 # ----------------------------------------------------------- logical plan
@@ -104,25 +106,77 @@ def _apply_chain(source: Callable[[], Block], ops: Sequence[_Op]) -> Block:
 
 class Dataset:
     def __init__(self, sources: List[Callable[[], Block]],
-                 ops: Optional[List[_Op]] = None):
+                 ops: Optional[List[Any]] = None, *, _pin: Any = None):
         # sources: zero-arg callables producing the input blocks (read tasks
-        # or in-memory closures); ops: fused per-block transform chain.
+        # or in-memory closures); ops: stage pipeline — a legacy flat op
+        # list is wrapped into one fused TaskStage. _pin keeps upstream
+        # shuffle partitions alive while this dataset's refs are consumed.
         self._sources = sources
-        self._ops = ops or []
+        if ops and not isinstance(ops[0], (TaskStage, ActorStage)):
+            ops = [TaskStage(ops)]
+        self._stages: List[Any] = list(ops) if ops else [TaskStage([])]
+        self._pin = _pin
+
+    @property
+    def _ops(self) -> List[_Op]:
+        """Flat fused op chain (only valid for single-task-stage plans)."""
+        assert len(self._stages) == 1 and isinstance(
+            self._stages[0], TaskStage
+        ), "plan has actor stages; use _stages"
+        return self._stages[0].ops
 
     # ---- construction helpers (used by read_api) ----
 
     @classmethod
-    def from_blocks(cls, blocks: List[Block]) -> "Dataset":
-        return cls([(lambda b=b: b) for b in blocks])
+    def from_blocks(cls, blocks: List[Block], *, _pin: Any = None
+                    ) -> "Dataset":
+        return cls([(lambda b=b: b) for b in blocks], _pin=_pin)
+
+    @classmethod
+    def _from_refs(cls, refs: List[Any], *, _pin: Any = None) -> "Dataset":
+        """Blocks already in the object store (e.g. shuffle output): each
+        source pulls its ref where it executes — never via the driver."""
+
+        def make(ref):
+            def pull():
+                import ray_tpu
+
+                return ray_tpu.get(ref)
+
+            return pull
+
+        ds = cls([make(r) for r in refs], _pin=(_pin, refs))
+        return ds
 
     # ---- lazy transforms (per-block: fused) ----
 
     def _with_op(self, op: _Op) -> "Dataset":
-        return Dataset(self._sources, self._ops + [op])
+        last = self._stages[-1]
+        if isinstance(last, TaskStage):
+            stages = self._stages[:-1] + [last.with_op(op)]
+        else:
+            stages = self._stages + [TaskStage([op])]
+        return Dataset(self._sources, stages, _pin=self._pin)
 
     def map_batches(self, fn, *, batch_format: str = "numpy",
-                    batch_size: Optional[int] = None) -> "Dataset":
+                    batch_size: Optional[int] = None,
+                    concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None,
+                    ray_remote_args: Optional[dict] = None) -> "Dataset":
+        """Per-batch transform. A CLASS argument becomes a stateful
+        actor-pool stage of ``concurrency`` members, each constructing the
+        class once (ref: actor_pool_map_operator.py — the operator for
+        model-loading transforms)."""
+        if inspect.isclass(fn):
+            stage = ActorStage(
+                fn, fn_constructor_args, fn_constructor_kwargs or {},
+                concurrency or 2, batch_format, batch_size,
+                ray_remote_args,
+            )
+            return Dataset(
+                self._sources, self._stages + [stage], _pin=self._pin
+            )
         return self._with_op(_MapBatches(fn, batch_format, batch_size))
 
     def map(self, fn) -> "Dataset":
@@ -151,9 +205,54 @@ class Dataset:
             lambda b: {k: v for k, v in b.items() if k in cols}
         )
 
-    # ---- global ops (materialization barriers) ----
+    # ---- global ops (distributed two-stage shuffles) ----
+
+    def _use_remote(self) -> bool:
+        from ..core import runtime_context
+
+        ctx = DataContext.get_current()
+        return ctx.use_remote_tasks and runtime_context.is_initialized()
+
+    def _shuffle_plan(self, *, materialize: bool = False):
+        """(sources, fusable ops, hold) for a shuffle's map stage: the
+        fused op chain when the plan is one task stage, else the
+        pre-executed block refs (actor stages must run before
+        partitioning; sort also materializes so boundary sampling doesn't
+        execute the chain twice). ``hold`` must stay pinned until the
+        shuffle output is consumed — it keeps the intermediate refs alive
+        past this driver frame."""
+        single_task = (
+            len(self._stages) == 1 and isinstance(self._stages[0], TaskStage)
+        )
+        if single_task and not materialize:
+            return self._sources, self._stages[0].ops, None
+        refs = list(execute_refs(self._sources, self._stages))
+
+        def make(ref):
+            def pull():
+                import ray_tpu
+
+                return ray_tpu.get(ref)
+
+            return pull
+
+        return [make(r) for r in refs], [], refs
+
+    def _shuffled(self, num: int, assigner: str, arg=None,
+                  postprocess=None) -> "Dataset":
+        from . import shuffle as _shuffle
+
+        srcs, ops, hold = self._shuffle_plan()
+        reduce_refs, pin = _shuffle.shuffle(
+            srcs, ops, num, assigner, arg, postprocess
+        )
+        return Dataset._from_refs(
+            reduce_refs, _pin=(self._pin, pin, hold)
+        )
 
     def repartition(self, num_blocks: int) -> "Dataset":
+        if self._use_remote():
+            return self._shuffled(num_blocks, "contiguous")
         full = self._materialize_table()
         n = full.num_rows
         sizes = [n // num_blocks + (1 if i < n % num_blocks else 0)
@@ -165,6 +264,14 @@ class Dataset:
         return Dataset.from_blocks(blocks)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        if self._use_remote():
+            import random as _random
+
+            num = max(1, len(self._sources))
+            return self._shuffled(
+                num, "random",
+                seed if seed is not None else _random.randrange(2 ** 31),
+            )
         full = self._materialize_table()
         idx = np.random.RandomState(seed).permutation(full.num_rows)
         shuffled = BlockAccessor(full).take_indices(idx)
@@ -172,6 +279,21 @@ class Dataset:
         return Dataset.from_blocks([shuffled]).repartition(num)
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        if self._use_remote():
+            from . import shuffle as _shuffle
+
+            # Materialize once: boundary sampling + shuffle both read the
+            # stored blocks instead of re-running the upstream chain.
+            srcs, ops, hold = self._shuffle_plan(materialize=True)
+            num = max(1, len(srcs))
+            bounds = _shuffle.sample_sort_boundaries(srcs, ops, key, num)
+            reduce_refs, pin = _shuffle.shuffle(
+                srcs, ops, num, "range", (key, bounds, descending),
+                _shuffle._SortBlock(key, descending),
+            )
+            return Dataset._from_refs(
+                reduce_refs, _pin=(self._pin, pin, hold)
+            )
         full = self._materialize_table()
         col = BlockAccessor(full).to_numpy()[key]
         idx = np.argsort(col, kind="stable")
@@ -182,7 +304,8 @@ class Dataset:
     def union(self, other: "Dataset") -> "Dataset":
         a = self.materialize()
         b = other.materialize()
-        return Dataset(a._sources + b._sources)
+        return Dataset(a._sources + b._sources,
+                       _pin=(a._pin, b._pin))
 
     def limit(self, n: int) -> "Dataset":
         out, taken = [], 0
@@ -202,35 +325,10 @@ class Dataset:
     # ---- execution ----
 
     def _iter_blocks(self) -> Iterator[Block]:
-        """Streaming execution: bounded window of fused block tasks
-        (ref analogue: StreamingExecutor._scheduling_loop_step +
-        backpressure, streaming_executor.py:242)."""
-        ctx = DataContext.get_current()
-        from ..core import runtime_context
-
-        use_remote = (
-            ctx.use_remote_tasks and runtime_context.is_initialized()
-        )
-        if not use_remote:
-            for src in self._sources:
-                yield _apply_chain(src, self._ops)
-            return
-
-        import ray_tpu
-
-        chain = ray_tpu.remote(_apply_chain)
-        window: List[Any] = []
-        sources = iter(self._sources)
-        exhausted = False
-        while window or not exhausted:
-            while not exhausted and len(window) < ctx.max_in_flight_tasks:
-                src = next(sources, None)
-                if src is None:
-                    exhausted = True
-                    break
-                window.append(chain.remote(src, self._ops))
-            if window:
-                yield ray_tpu.get(window.pop(0))
+        """Streaming execution through the stage pipeline (per-stage
+        bounded windows = per-operator backpressure; see
+        streaming_executor.py)."""
+        yield from execute(self._sources, self._stages)
 
     def iter_batches(
         self,
@@ -297,6 +395,9 @@ class Dataset:
         return concat_blocks(list(self._iter_blocks()))
 
     def materialize(self) -> "Dataset":
+        if self._use_remote():
+            refs = list(execute_refs(self._sources, self._stages))
+            return Dataset._from_refs(refs, _pin=self._pin)
         return Dataset.from_blocks(list(self._iter_blocks()))
 
     def take(self, n: int = 20) -> List[Dict[str, Any]]:
@@ -331,8 +432,38 @@ class Dataset:
         return self._materialize_table().to_pandas()
 
     def stats(self) -> str:
+        nops = sum(
+            len(s.ops) if isinstance(s, TaskStage) else 1
+            for s in self._stages
+        )
         return (f"Dataset(blocks={len(self._sources)}, "
-                f"ops={len(self._ops)})")
+                f"stages={len(self._stages)}, ops={nops})")
+
+    # ---- write sinks (distributed per-block writes) ----
+
+    def write_parquet(self, path: str, **kw) -> List[str]:
+        """One parquet file per block, written by remote tasks (ref:
+        dataset.py write_parquet:2823)."""
+        from .datasink import write_blocks
+
+        return write_blocks(self, path, "parquet", **kw)
+
+    def write_csv(self, path: str, **kw) -> List[str]:
+        from .datasink import write_blocks
+
+        return write_blocks(self, path, "csv", **kw)
+
+    def write_json(self, path: str, **kw) -> List[str]:
+        from .datasink import write_blocks
+
+        return write_blocks(self, path, "json", **kw)
+
+    def write_numpy(self, path: str, *, column: str = "data") -> List[str]:
+        from .datasink import write_blocks
+
+        return write_blocks(
+            self.select_columns([column]), path, "npy"
+        )
 
     # ---- splitting for train ingest ----
 
@@ -347,7 +478,9 @@ class Dataset:
 
     def split(self, n: int) -> List["Dataset"]:
         return [
-            Dataset(self._sources[i::n], list(self._ops)) for i in range(n)
+            Dataset(self._sources[i::n], list(self._stages),
+                    _pin=self._pin)
+            for i in range(n)
         ]
 
     def __repr__(self):

@@ -22,7 +22,8 @@ class DataIterator:
 
         ds = self._dataset
         return Dataset(
-            ds._sources[self.shard_index :: self.num_shards], list(ds._ops)
+            ds._sources[self.shard_index :: self.num_shards],
+            list(ds._stages), _pin=ds._pin,
         )
 
     def iter_batches(self, **kw) -> Iterator[Any]:

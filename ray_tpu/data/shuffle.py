@@ -1,0 +1,176 @@
+"""Distributed two-stage shuffle.
+
+Ref analogue: python/ray/data/_internal/push_based_shuffle.py +
+planner/exchange/ (ShuffleTaskSpec, sort/repartition/random-shuffle task
+schedulers). Design (tpu-repo original): map tasks partition each input
+block and ``put`` every partition into the object store (so partitions
+live distributed, never on the driver); reduce tasks fetch their
+partition refs — cross-node pulls ride the object transfer protocol —
+and assemble the output block. The driver only moves ObjectRefs.
+
+partition assignment is a top-level function + args (picklable), one of:
+- random:   seeded per-block permutation → round-robin split (shuffle)
+- contiguous: row ranges (repartition)
+- range:    searchsorted against sampled boundaries (sort)
+- hash:     stable hash of key column mod R (groupby)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .block import BlockAccessor, concat_blocks
+
+
+# ---- partition assigners (return list of index arrays, one per reducer) --
+
+def _assign_random(block, num: int, seed) -> List[np.ndarray]:
+    n = BlockAccessor(block).num_rows()
+    idx = np.random.RandomState(seed).permutation(n)
+    return [idx[r::num] for r in range(num)]
+
+
+def _assign_contiguous(block, num: int, _arg) -> List[np.ndarray]:
+    n = BlockAccessor(block).num_rows()
+    bounds = np.linspace(0, n, num + 1).astype(np.int64)
+    all_idx = np.arange(n)
+    return [all_idx[bounds[r]:bounds[r + 1]] for r in range(num)]
+
+
+def _assign_range(block, num: int, arg) -> List[np.ndarray]:
+    key, boundaries, descending = arg
+    col = BlockAccessor(block).to_numpy()[key]
+    part = np.searchsorted(np.asarray(boundaries), col, side="right")
+    if descending:
+        part = (num - 1) - part
+    return [np.nonzero(part == r)[0] for r in range(num)]
+
+
+def _assign_hash(block, num: int, key) -> List[np.ndarray]:
+    col = BlockAccessor(block).to_numpy()[key]
+    if col.dtype.kind in "OUS":  # strings/objects: stable per-value hash
+        import zlib
+
+        part = np.asarray(
+            [zlib.crc32(str(v).encode()) % num for v in col],
+            dtype=np.int64,
+        )
+    else:
+        part = np.asarray(col).view(np.ndarray).astype(np.int64) % num
+    return [np.nonzero(part == r)[0] for r in range(num)]
+
+
+_ASSIGNERS = {
+    "random": _assign_random,
+    "contiguous": _assign_contiguous,
+    "range": _assign_range,
+    "hash": _assign_hash,
+}
+
+
+# ---- task bodies ---------------------------------------------------------
+
+def _shuffle_map(src: Callable[[], Any], ops: List[Any], assigner: str,
+                 num_reducers: int, arg) -> tuple:
+    """Run the fused upstream chain on one source block and split it into
+    ``num_reducers`` partitions, one per RETURN SLOT (``num_returns=R``,
+    the reference's shuffle_map signature — shuffle_op.py): return-slot
+    objects are owned/held by the submitting driver, so partitions stay
+    alive in the distributed store until every reducer consumed them."""
+    block = src()
+    for op in ops:
+        block = op.apply(block)
+    acc = BlockAccessor(block)
+    parts = _ASSIGNERS[assigner](block, num_reducers, arg)
+    out = tuple(acc.take_indices(idx) for idx in parts)
+    return out if num_reducers > 1 else out[0]
+
+
+def _shuffle_reduce(postprocess, *blocks) -> Any:
+    """Assemble one reducer's output from its partitions (passed as
+    top-level ref args: the runtime pulls cross-node copies as needed)."""
+    block = concat_blocks(list(blocks))
+    if postprocess is not None:
+        block = postprocess(block)
+    return block
+
+
+def _sample_block(src: Callable[[], Any], ops: List[Any], key: str,
+                  max_samples: int) -> np.ndarray:
+    block = src()
+    for op in ops:
+        block = op.apply(block)
+    col = BlockAccessor(block).to_numpy()[key]
+    if len(col) > max_samples:
+        sel = np.random.RandomState(0).choice(
+            len(col), max_samples, replace=False
+        )
+        col = col[sel]
+    return np.asarray(col)
+
+
+class _SortBlock:
+    def __init__(self, key: str, descending: bool):
+        self.key = key
+        self.descending = descending
+
+    def __call__(self, block):
+        acc = BlockAccessor(block)
+        col = acc.to_numpy()[self.key]
+        idx = np.argsort(col, kind="stable")
+        if self.descending:
+            idx = idx[::-1]
+        return acc.take_indices(idx)
+
+
+# ---- driver-side orchestration ------------------------------------------
+
+def shuffle(sources: Sequence[Callable[[], Any]], ops: List[Any],
+            num_reducers: int, assigner: str, arg=None,
+            postprocess=None) -> Tuple[List[Any], List[Any]]:
+    """Two-stage shuffle. Returns (reduce_refs, pin) — ``pin`` holds the
+    intermediate partition refs and must stay referenced until the reduce
+    outputs are consumed (it keeps the distributed partitions alive)."""
+    import ray_tpu
+
+    map_task = ray_tpu.remote(_shuffle_map).options(
+        num_returns=num_reducers
+    )
+    reduce_task = ray_tpu.remote(_shuffle_reduce)
+
+    part_lists: List[List[Any]] = []
+    for i, src in enumerate(sources):
+        refs = map_task.remote(
+            src, ops, assigner, num_reducers,
+            (arg ^ i if assigner == "random" else arg),
+        )
+        part_lists.append(refs if isinstance(refs, list) else [refs])
+    reduce_refs = [
+        reduce_task.remote(postprocess, *[pl[r] for pl in part_lists])
+        for r in range(num_reducers)
+    ]
+    return reduce_refs, part_lists
+
+
+def sample_sort_boundaries(sources: Sequence[Callable[[], Any]],
+                           ops: List[Any], key: str, num: int,
+                           max_samples_per_block: int = 128) -> np.ndarray:
+    """Stage 0 of distributed sort: sample each block's key column and cut
+    the sampled distribution into ``num`` quantile ranges (ref:
+    planner/exchange/sort_task_spec.py SortTaskSpec.sample_boundaries)."""
+    import ray_tpu
+
+    sampler = ray_tpu.remote(_sample_block)
+    samples = ray_tpu.get([
+        sampler.remote(src, ops, key, max_samples_per_block)
+        for src in sources
+    ])
+    allv = np.sort(np.concatenate([s for s in samples if len(s)]))
+    if len(allv) == 0:
+        return np.asarray([])
+    cuts = [
+        allv[int(len(allv) * (r + 1) / num) - 1] for r in range(num - 1)
+    ]
+    return np.asarray(cuts)

@@ -1,0 +1,220 @@
+"""Multi-operator streaming execution.
+
+Ref analogue: python/ray/data/_internal/execution/streaming_executor.py
+(:242 scheduling loop) + operators/map_operator.py +
+operators/actor_pool_map_operator.py. The plan is a list of STAGES:
+
+- ``TaskStage``: a fused chain of per-block ops, one remote task per block
+  (the reference's fused MapOperator). The first TaskStage fuses with the
+  read: source thunk + ops run inside one task.
+- ``ActorStage``: a pool of stateful actors each holding one instance of a
+  user callable class (the reference's ActorPoolMapOperator — the operator
+  for model-loading transforms where per-task construction would dominate).
+
+Execution is a chain of pull-based generators, one per stage, each with its
+own bounded in-flight window — per-operator backpressure: a slow stage
+stops pulling, which stops its upstream from submitting. Blocks stream
+between stages as ObjectRefs (never gathered on the driver).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+from .context import DataContext
+
+
+class TaskStage:
+    def __init__(self, ops: Optional[List[Any]] = None):
+        self.ops = list(ops or [])
+
+    def with_op(self, op) -> "TaskStage":
+        return TaskStage(self.ops + [op])
+
+
+class ActorStage:
+    """Stateful map_batches through a pool of actors."""
+
+    def __init__(self, fn_cls: type, fn_constructor_args: tuple,
+                 fn_constructor_kwargs: dict, pool_size: int,
+                 batch_format: str, batch_size: Optional[int],
+                 ray_remote_args: Optional[dict] = None):
+        self.fn_cls = fn_cls
+        self.fn_constructor_args = fn_constructor_args
+        self.fn_constructor_kwargs = fn_constructor_kwargs
+        self.pool_size = pool_size
+        self.batch_format = batch_format
+        self.batch_size = batch_size
+        self.ray_remote_args = ray_remote_args or {}
+
+
+# ---- task bodies (top-level: picklable by function table) ----------------
+
+def _run_chain_from_source(src: Callable[[], Any], ops: List[Any]):
+    block = src()
+    for op in ops:
+        block = op.apply(block)
+    return block
+
+
+def _run_chain_on_block(block, ops: List[Any]):
+    for op in ops:
+        block = op.apply(block)
+    return block
+
+
+class _ActorMapWorker:
+    """Pool member: holds one instance of the user's callable class."""
+
+    def __init__(self, blob: bytes, batch_format: str,
+                 batch_size: Optional[int]):
+        import cloudpickle
+
+        cls, args, kwargs = cloudpickle.loads(blob)
+        self._fn = cls(*args, **kwargs)
+        self._batch_format = batch_format
+        self._batch_size = batch_size
+
+    def apply(self, block):
+        from .dataset import _MapBatches
+
+        op = _MapBatches(self._fn, self._batch_format, self._batch_size)
+        return op.apply(block)
+
+
+# ---- local (no-runtime) execution ---------------------------------------
+
+def _execute_local(sources: Sequence[Callable[[], Any]],
+                   stages: Sequence[Any]) -> Iterator[Any]:
+    from .dataset import _MapBatches
+
+    # Instantiate each actor stage's callable once (pool of one).
+    insts = {}
+    for i, st in enumerate(stages):
+        if isinstance(st, ActorStage):
+            insts[i] = st.fn_cls(*st.fn_constructor_args,
+                                 **st.fn_constructor_kwargs)
+    for src in sources:
+        block = src()
+        for i, st in enumerate(stages):
+            if isinstance(st, TaskStage):
+                for op in st.ops:
+                    block = op.apply(block)
+            else:
+                op = _MapBatches(insts[i], st.batch_format, st.batch_size)
+                block = op.apply(block)
+        yield block
+
+
+# ---- distributed execution ----------------------------------------------
+
+def _task_stage_gen(upstream: Iterator[Any], stage: TaskStage,
+                    window: int, first: bool) -> Iterator[Any]:
+    """Submit one fused task per upstream item; yield result refs in order
+    with at most ``window`` in flight."""
+    import ray_tpu
+
+    fn = ray_tpu.remote(
+        _run_chain_from_source if first else _run_chain_on_block
+    )
+    inflight: List[Any] = []
+    up = iter(upstream)
+    done = False
+    while inflight or not done:
+        while not done and len(inflight) < window:
+            item = next(up, None)
+            if item is None:
+                done = True
+                break
+            inflight.append(fn.remote(item, stage.ops))
+        if inflight:
+            yield inflight.pop(0)
+
+
+def _actor_stage_gen(upstream: Iterator[Any],
+                     stage: ActorStage) -> Iterator[Any]:
+    """Round-robin blocks over the actor pool; yield in submission order
+    (per-actor queueing keeps each member busy without head-of-line
+    blocking the whole pool)."""
+    import cloudpickle
+
+    import ray_tpu
+
+    blob = cloudpickle.dumps(
+        (stage.fn_cls, stage.fn_constructor_args,
+         stage.fn_constructor_kwargs)
+    )
+    opts = dict(stage.ray_remote_args)
+    actor_cls = (ray_tpu.remote(**opts)(_ActorMapWorker) if opts
+                 else ray_tpu.remote(_ActorMapWorker))
+    pool = [
+        actor_cls.remote(blob, stage.batch_format, stage.batch_size)
+        for _ in range(stage.pool_size)
+    ]
+    try:
+        window = stage.pool_size * 2
+        inflight: List[Any] = []
+        up = iter(upstream)
+        done = False
+        i = 0
+        while inflight or not done:
+            while not done and len(inflight) < window:
+                item = next(up, None)
+                if item is None:
+                    done = True
+                    break
+                member = pool[i % len(pool)]
+                i += 1
+                inflight.append(member.apply.remote(item))
+            if inflight:
+                yield inflight.pop(0)
+    finally:
+        for a in pool:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+def execute(sources: Sequence[Callable[[], Any]],
+            stages: Sequence[Any]) -> Iterator[Any]:
+    """Run the stage pipeline; yields materialized blocks on the driver.
+    (Use :func:`execute_refs` to keep results remote.)"""
+    import ray_tpu
+
+    for item in execute_refs(sources, stages):
+        yield ray_tpu.get(item) if _is_ref(item) else item
+
+
+def _is_ref(x) -> bool:
+    from ..core.reference import ObjectRef
+
+    return isinstance(x, ObjectRef)
+
+
+def execute_refs(sources: Sequence[Callable[[], Any]],
+                 stages: Sequence[Any]) -> Iterator[Any]:
+    """Yield per-block results as ObjectRefs (driver never holds data),
+    falling back to local inline execution without a runtime."""
+    ctx = DataContext.get_current()
+    from ..core import runtime_context
+
+    if not (ctx.use_remote_tasks and runtime_context.is_initialized()):
+        yield from _execute_local(sources, stages)
+        return
+
+    stages = list(stages) or [TaskStage([])]
+    gen: Iterator[Any] = iter(sources)
+    first = True
+    for i, st in enumerate(stages):
+        if isinstance(st, TaskStage):
+            gen = _task_stage_gen(gen, st, ctx.max_in_flight_tasks, first)
+        else:
+            if first:
+                # Materialize sources into blocks before an actor stage.
+                gen = _task_stage_gen(
+                    gen, TaskStage([]), ctx.max_in_flight_tasks, True
+                )
+            gen = _actor_stage_gen(gen, st)
+        first = False
+    yield from gen
