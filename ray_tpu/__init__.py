@@ -13,6 +13,8 @@ Public surface mirrors the reference's `ray.*` core API
 """
 
 from ._version import __version__  # noqa: F401
+from . import job_submission  # noqa: F401
+from . import util  # noqa: F401
 from .core import (  # noqa: F401
     ActorClass,
     ActorDiedError,
@@ -32,6 +34,8 @@ from .core import (  # noqa: F401
     init,
     is_initialized,
     kill,
+    kv_get,
+    kv_put,
     nodes,
     put,
     remote,
@@ -62,6 +66,8 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "nodes",
+    "kv_put",
+    "kv_get",
     "ObjectRef",
     "ActorClass",
     "ActorHandle",

@@ -1,0 +1,8 @@
+"""``python -m ray_tpu`` → the rtpu CLI (ref: the `ray` console script)."""
+
+import sys
+
+from .scripts.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,8 @@ from .api import (  # noqa: F401
     init,
     is_initialized,
     kill,
+    kv_get,
+    kv_put,
     nodes,
     put,
     remote,

@@ -112,6 +112,7 @@ class ActorClass:
             resources=resources,
             name=self._options.get("name", ""),
             actor_id=actor_id,
+            class_name=self._cls.__name__,
             max_restarts=max_restarts,
             max_concurrency=self._options.get("max_concurrency", 1),
             scheduling_strategy=self._options.get("scheduling_strategy"),

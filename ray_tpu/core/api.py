@@ -27,6 +27,7 @@ from . import runtime_context
 
 
 def init(
+    address: Optional[str] = None,
     *,
     num_cpus: Optional[int] = None,
     num_tpus: Optional[int] = None,
@@ -35,16 +36,23 @@ def init(
     system_config: Optional[Dict[str, Any]] = None,
     ignore_reinit_error: bool = False,
 ) -> "DriverRuntime":
-    """Start the single-node runtime in-process (head mode).
+    """Start the runtime: head mode (no address) starts an in-process
+    node + GCS; ``address="host:port"`` (or env RAY_TPU_ADDRESS, set for
+    jobs and `rtpu submit` children) attaches this driver to an existing
+    cluster as its own zero-resource node, so its tasks spill to the
+    cluster's workers.
 
-    Ref analogue: ray.init starting a local cluster
-    (python/ray/_private/worker.py:1221 → node.py start_head_processes).
+    Ref analogue: ray.init starting a local cluster or connecting to an
+    existing one (python/ray/_private/worker.py:1221).
     """
     existing = runtime_context.current_runtime_or_none()
     if existing is not None:
         if ignore_reinit_error:
             return existing
         raise RuntimeError("ray_tpu.init() called twice; use shutdown() first.")
+
+    if address is None:
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
 
     reset_config()
     config = get_config()
@@ -53,10 +61,17 @@ def init(
         config.object_store_memory = object_store_memory
 
     res: Dict[str, float] = dict(resources or {})
-    res.setdefault("CPU", num_cpus if num_cpus is not None else os.cpu_count() or 1)
+    if address is None:
+        res.setdefault(
+            "CPU", num_cpus if num_cpus is not None else os.cpu_count() or 1
+        )
+    else:
+        # Attached drivers contribute no compute by default: work runs on
+        # the cluster, not in the client process's node.
+        res.setdefault("CPU", num_cpus if num_cpus is not None else 0)
     if num_tpus is not None:
         res["TPU"] = num_tpus
-    else:
+    elif address is None:
         detected = _detect_tpu_chips()
         if detected:
             res.setdefault("TPU", detected)
@@ -71,12 +86,25 @@ def init(
     from .tpu import node_tpu_labels
 
     node_id = NodeID.from_random()
+    gcs_address = None
+    if address is not None:
+        host, port_s = address.rsplit(":", 1)
+        gcs_address = (host, int(port_s))
     nm = NodeManager(
-        node_id, session_dir, res, config, labels=node_tpu_labels()
+        node_id, session_dir, res, config,
+        is_head=gcs_address is None,
+        gcs_address=gcs_address,
+        node_ip=config.node_ip,
+        labels=node_tpu_labels(),
     )
     nm.start()
     rt = DriverRuntime(nm, job_id=JobID.from_random())
     runtime_context.set_runtime(rt)
+    if config.log_to_driver:
+        from .log_monitor import LogMonitor
+
+        rt.log_monitor = LogMonitor(session_dir, nm)
+        rt.log_monitor.start()
     atexit.register(_atexit_shutdown)
     return rt
 
@@ -104,11 +132,24 @@ def shutdown():
     if rt is None:
         return
     runtime_context.set_runtime(None)
+    monitor = getattr(rt, "log_monitor", None)
+    if monitor is not None:
+        monitor.stop()
     rt.shutdown()
 
 
 def is_initialized() -> bool:
     return runtime_context.is_initialized()
+
+
+def kv_put(key: str, value: bytes, overwrite: bool = True) -> bool:
+    """Cluster KV store write (ref analogue: ray internal_kv, used by the
+    job table, train report channel, and user coordination)."""
+    return runtime_context.current_runtime().kv_put(key, value, overwrite)
+
+
+def kv_get(key: str):
+    return runtime_context.current_runtime().kv_get(key)
 
 
 def remote(*args, **kwargs):

@@ -63,6 +63,18 @@ class Config:
     # extension is importable/buildable; pure-Python per-object shm otherwise.
     use_native_store: bool = True
     # --- cluster plane (GCS + peer federation) -----------------------------
+    # Fixed GCS listen port for head nodes started via the CLI (0 = pick a
+    # free port; ref analogue: --port of `ray start`).
+    gcs_port: int = 0
+    # When set, the GCS persists its durable tables (KV, function table,
+    # named actors) to this file and restores them on head start (ref:
+    # gcs_storage flag, ray_config_def.h:412 — GCS fault tolerance).
+    gcs_storage_path: str = ""
+    # Bind/advertise IP for this node (ref: --node-ip-address).
+    node_ip: str = "127.0.0.1"
+    # Echo worker stdout/stderr to the driver with (pid=, node=) prefixes
+    # (ref analogue: log_monitor.py + worker log streaming to driver).
+    log_to_driver: bool = True
     # Load-report period from each node to the GCS (ref analogue:
     # raylet_report_resources_period_ms via the RaySyncer).
     heartbeat_interval_s: float = 0.25

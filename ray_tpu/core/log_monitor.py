@@ -1,0 +1,109 @@
+"""Worker log streaming to the driver.
+
+Ref analogue: python/ray/_private/log_monitor.py — tail every worker log
+file under the session's ``logs/`` directory and echo new lines to the
+driver's stdout prefixed ``(name pid=P, node=N)``, colorized the way task
+output interleaves in the reference. Workers write stdout/stderr to
+``logs/worker-<id8>.log`` (node_manager.py worker spawn); this monitor
+discovers files as they appear and follows growth.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+POLL_INTERVAL_S = 0.2
+
+
+class LogMonitor:
+    def __init__(self, session_dir: str, node_manager=None,
+                 out=None):
+        self._dir = os.path.join(session_dir, "logs")
+        self._nm = node_manager
+        self._out = out or sys.stdout
+        self._offsets: Dict[str, int] = {}
+        self._partial: Dict[str, bytes] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._node8 = (
+            node_manager.node_id.hex()[:8] if node_manager else "local"
+        )
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="ray_tpu-log-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * POLL_INTERVAL_S + 1)
+            self._thread = None
+
+    def _pid_for(self, path: str) -> str:
+        """Map worker-<id8>.log back to the worker's pid via the node
+        manager's worker table (best effort)."""
+        if self._nm is None:
+            return "?"
+        base = os.path.basename(path)
+        id8 = base[len("worker-"):-len(".log")]
+        try:
+            for wid, handle in list(self._nm._workers.items()):
+                if wid.hex().startswith(id8) and handle.proc is not None:
+                    return str(handle.proc.pid)
+        except Exception:
+            pass
+        return "?"
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._poll_once()
+            except Exception:
+                pass
+            self._stop.wait(POLL_INTERVAL_S)
+        # Final sweep so output printed just before shutdown still lands.
+        try:
+            self._poll_once()
+        except Exception:
+            pass
+
+    def _poll_once(self) -> None:
+        for path in glob.glob(os.path.join(self._dir, "worker-*.log")):
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            offset = self._offsets.get(path, 0)
+            if size <= offset:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(size - offset)
+            except OSError:
+                continue
+            self._offsets[path] = size
+            data = self._partial.pop(path, b"") + data
+            lines = data.split(b"\n")
+            if lines and lines[-1]:
+                self._partial[path] = lines[-1]
+            lines = lines[:-1]
+            if not lines:
+                continue
+            prefix = f"(pid={self._pid_for(path)}, node={self._node8})"
+            text = "".join(
+                f"{prefix} {line.decode('utf-8', 'replace')}\n"
+                for line in lines
+            )
+            try:
+                self._out.write(text)
+                self._out.flush()
+            except Exception:
+                pass

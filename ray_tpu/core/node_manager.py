@@ -314,7 +314,9 @@ class NodeManager:
         self.peer_port = self._peer_server.sockets[0].getsockname()[1]
         if self.is_head:
             self.gcs_service = GcsService(self.config, self._loop)
-            await self.gcs_service.start(host=self.node_ip)
+            await self.gcs_service.start(
+                host=self.node_ip, port=self.config.gcs_port
+            )
             self.gcs_service.on_node_added = self._on_gcs_node_added
             self.gcs_service.on_node_dead = self._on_gcs_node_dead
             self.gcs_service.on_load_update = self._on_gcs_load_update
@@ -517,6 +519,9 @@ class NodeManager:
         env["RAY_TPU_NODE_SOCKET"] = self.socket_path
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_WORKER_TYPE"] = worker_type
+        # Task print() output must reach the log file (and the driver's log
+        # monitor) as it happens, not at process exit.
+        env["PYTHONUNBUFFERED"] = "1"
         if self.arena_name:
             env["RAY_TPU_ARENA"] = self.arena_name
         # Ensure the worker can import this package even when the driver was
@@ -628,6 +633,11 @@ class NodeManager:
             spec = await self.get_named_actor(msg["name"])
             await w.writer.send(
                 {"type": "reply", "msg_id": msg["msg_id"], "spec": spec}
+            )
+        elif mtype == "state":
+            state = await self.cluster_state()
+            await w.writer.send(
+                {"type": "reply", "msg_id": msg["msg_id"], "state": state}
             )
         elif mtype == "ping":
             await w.writer.send({"type": "reply", "msg_id": msg["msg_id"]})
@@ -741,6 +751,8 @@ class NodeManager:
         if mtype == "release_bundle":
             self._release_bundle(msg["pg_id"], msg["index"])
             return None
+        if mtype == "state_snapshot":
+            return {"state": self._local_state_snapshot()}
         raise RuntimeError(f"unknown peer message {mtype}")
 
     # ------------------------------------------------------ bundle resources
@@ -2512,6 +2524,90 @@ class NodeManager:
             return self.gcs_service.nodes_view()
         self._cluster_view[self.node_id.hex()] = self._local_view()
         return list(self._cluster_view.values())
+
+    # ------------------------------------------------------------- state API
+
+    def _local_state_snapshot(self) -> Dict[str, List[Dict[str, Any]]]:
+        """This node's live-state tables in wire form (ref analogue: the
+        raylet's contribution to ray.util.state — NodeManagerService
+        GetTasksInfo / GetObjectsInfo handlers)."""
+        node = self.node_id.hex()
+        tasks = []
+        for tid, rec in self._tasks.items():
+            tasks.append({
+                "task_id": tid.hex(),
+                "name": rec.spec.name,
+                "state": rec.state,
+                "node_id": node,
+                "type": rec.spec.task_type.name,
+                "actor_id": (rec.spec.actor_id.hex()
+                             if rec.spec.actor_id else None),
+                "age_s": round(time.monotonic() - rec.created, 3),
+            })
+        actors = []
+        for aid, info in self._actors.items():
+            w = self._workers.get(info.worker_id)
+            actors.append({
+                "actor_id": aid.hex(),
+                "class_name": info.creation_spec.class_name,
+                "state": info.state,
+                "name": info.name,
+                "node_id": node,
+                "pid": (w.proc.pid if w is not None and w.proc else None),
+                "restart_count": info.restart_count,
+                "pending_calls": len(info.queued) + len(info.inflight),
+            })
+        workers = []
+        for wid, w in self._workers.items():
+            workers.append({
+                "worker_id": wid.hex(),
+                "pid": w.proc.pid if w.proc else None,
+                "state": w.state,
+                "worker_type": w.worker_type,
+                "node_id": node,
+                "actor_id": w.actor_id.hex() if w.actor_id else None,
+            })
+        objects = []
+        for oid, size, where in self.directory.entries_view():
+            objects.append({
+                "object_id": oid.hex(),
+                "size_bytes": size,
+                "where": where,
+                "node_id": node,
+            })
+        return {
+            "tasks": tasks,
+            "actors": actors,
+            "workers": workers,
+            "objects": objects,
+        }
+
+    async def cluster_state(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Aggregate state across every alive node: own snapshot plus a
+        fan-out ``state_snapshot`` peer query (ref analogue:
+        util/state/api.py querying the GCS + each raylet)."""
+        merged = self._local_state_snapshot()
+        me = self.node_id.hex()
+        peer_ids = [
+            hex_id for hex_id, view in self._cluster_view.items()
+            if hex_id != me and view.get("state", "alive") == "alive"
+        ]
+
+        async def query(hex_id: str):
+            try:
+                peer = await self._get_peer(hex_id)
+                reply = await peer.request(
+                    {"type": "state_snapshot"}, timeout=5.0
+                )
+                return reply.get("state")
+            except Exception:
+                return None
+
+        for snap in await asyncio.gather(*(query(h) for h in peer_ids)):
+            if snap:
+                for kind in merged:
+                    merged[kind].extend(snap.get(kind, []))
+        return merged
 
     # ---------------------------------------------------------------- blocked
 

@@ -477,6 +477,23 @@ class ObjectDirectory:
         with self._lock:
             return len(self._entries)
 
+    def entries_view(self):
+        """(object_id, size_bytes, where) rows for the state API."""
+        with self._lock:
+            out = []
+            for oid, loc in self._entries.items():
+                if isinstance(loc, (ShmLocation, ArenaLocation)):
+                    out.append((oid, loc.size, "shm"))
+                elif isinstance(loc, InlineLocation):
+                    out.append((oid, len(loc.data), "inline"))
+                elif isinstance(loc, SpilledLocation):
+                    out.append((oid, getattr(loc, "size", 0), "spilled"))
+                elif isinstance(loc, RemoteLocation):
+                    out.append((oid, 0, "remote"))
+                else:
+                    out.append((oid, 0, type(loc).__name__))
+            return out
+
     def spill_candidates(self, bytes_needed: int):
         """Least-recently-accessed local shared-memory objects summing to at
         least ``bytes_needed`` (ref analogue: the LRU EvictionPolicy choosing

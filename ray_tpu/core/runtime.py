@@ -294,6 +294,11 @@ class DriverRuntime(BaseRuntime):
     def stats(self) -> Dict[str, Any]:
         return self._nm.call_sync(self._nm.stats())
 
+    def cluster_state(self) -> Dict[str, Any]:
+        """Cluster-wide live-state tables (state API backing)."""
+        return self._nm.call_sync(self._nm.cluster_state())
+
+
     def cluster_resources(self) -> Dict[str, float]:
         views = self.nodes()
         if len(views) <= 1:
@@ -457,6 +462,9 @@ class WorkerRuntime(BaseRuntime):
     def get_named_actor_spec(self, name: str):
         reply = self.request({"type": "get_named_actor", "name": name})
         return reply["spec"]
+
+    def cluster_state(self) -> Dict[str, Any]:
+        return self.request({"type": "state"}, timeout=30.0)["state"]
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self._conn.send({"type": "kill_actor", "actor_id": actor_id,

@@ -53,6 +53,7 @@ class TaskSpec:
     # Actor fields
     actor_id: Optional[ActorID] = None
     method_name: str = ""
+    class_name: str = ""  # actor class, for the state API / debugging
     max_restarts: int = 0
     max_concurrency: int = 1
     # Owner bookkeeping (worker that submitted the task; nil = driver)

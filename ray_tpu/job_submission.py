@@ -1,0 +1,262 @@
+"""Job submission.
+
+Ref analogue: dashboard/modules/job/sdk.py JobSubmissionClient (:39) +
+job_manager.py JobSupervisor: a submitted job runs its shell entrypoint
+inside a supervisor ACTOR on the cluster (so the job lands where the
+scheduler puts it, not in the client process), with stdout/stderr captured
+to the GCS KV for `rtpu logs` streaming and a status record
+(PENDING → RUNNING → SUCCEEDED/FAILED/STOPPED) the client polls.
+
+The supervisor exports RAY_TPU_ADDRESS into the child so a script that
+calls ``ray_tpu.init()`` attaches to the SAME cluster as its own driver.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_STATUS_KEY = "job:{}:status"
+_LOGS_KEY = "job:{}:logs"
+_LIST_KEY = "jobs:index"
+MAX_LOG_BYTES = 1 << 20  # KV log tail cap per job
+
+
+class JobStatus(str, enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    @classmethod
+    def terminal(cls, s: "JobStatus") -> bool:
+        return s in (cls.SUCCEEDED, cls.FAILED, cls.STOPPED)
+
+
+class _JobSupervisor:
+    """Actor hosting one job's entrypoint subprocess (ref:
+    job_manager.py JobSupervisor)."""
+
+    def __init__(self, job_id: str, entrypoint: str,
+                 env: Optional[Dict[str, str]], working_dir: Optional[str]):
+        self._job_id = job_id
+        self._entrypoint = entrypoint
+        self._env = env or {}
+        self._working_dir = working_dir
+        self._proc: Optional[subprocess.Popen] = None
+        self._log_buf = bytearray()
+        self._lock = threading.Lock()
+        self._status = JobStatus.PENDING
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # -- KV helpers (run inside the worker: kv goes through the runtime) --
+
+    def _kv_put(self, key: str, value: bytes):
+        import ray_tpu
+
+        ray_tpu.kv_put(key, value)
+
+    def _set_status(self, status: JobStatus, message: str = ""):
+        self._status = status
+        self._kv_put(
+            _STATUS_KEY.format(self._job_id),
+            json.dumps({
+                "status": status.value,
+                "message": message,
+                "entrypoint": self._entrypoint,
+                "timestamp": time.time(),
+            }).encode(),
+        )
+
+    def _flush_logs(self):
+        with self._lock:
+            data = bytes(self._log_buf[-MAX_LOG_BYTES:])
+        self._kv_put(_LOGS_KEY.format(self._job_id), data)
+
+    def _run(self):
+        try:
+            env = dict(os.environ)
+            env.update(self._env)
+            # The job's own ray_tpu.init() must attach to this cluster.
+            addr = env.get("RAY_TPU_ADDRESS") or _gcs_address_of_runtime()
+            if addr:
+                env["RAY_TPU_ADDRESS"] = addr
+            env["RAY_TPU_JOB_ID"] = self._job_id
+            self._set_status(JobStatus.RUNNING)
+            self._proc = subprocess.Popen(
+                self._entrypoint,
+                shell=True,
+                cwd=self._working_dir or None,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            last_flush = 0.0
+            for line in iter(self._proc.stdout.readline, b""):
+                with self._lock:
+                    self._log_buf += line
+                now = time.monotonic()
+                if now - last_flush > 0.25:
+                    self._flush_logs()
+                    last_flush = now
+            code = self._proc.wait()
+            self._flush_logs()
+            if self._status == JobStatus.STOPPED:
+                return
+            if code == 0:
+                self._set_status(JobStatus.SUCCEEDED)
+            else:
+                self._set_status(JobStatus.FAILED, f"exit code {code}")
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._flush_logs()
+                self._set_status(JobStatus.FAILED, repr(e))
+            except Exception:
+                pass
+
+    # -- actor methods --
+
+    def status(self) -> str:
+        return self._status.value
+
+    def stop(self) -> str:
+        self._set_status(JobStatus.STOPPED, "stopped by user")
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        self._flush_logs()
+        return JobStatus.STOPPED.value
+
+    def ping(self) -> str:
+        return "ok"
+
+
+def _gcs_address_of_runtime() -> Optional[str]:
+    """The GCS address of the cluster this process is attached to."""
+    try:
+        from .core import runtime_context
+
+        rt = runtime_context.current_runtime_or_none()
+        nm = getattr(rt, "_nm", None)
+        if nm is not None and nm.gcs_service is not None:
+            host, port = nm.gcs_service.address
+            return f"{host}:{port}"
+        if nm is not None and nm.gcs_address is not None:
+            host, port = nm.gcs_address
+            return f"{host}:{port}"
+    except Exception:
+        pass
+    return os.environ.get("RAY_TPU_ADDRESS")
+
+
+class JobSubmissionClient:
+    """Submit/inspect/stop jobs on the connected cluster (ref:
+    JobSubmissionClient; address handling is implicit — the client uses
+    the runtime this process is already attached to)."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+
+    def submit_job(self, *, entrypoint: str,
+                   env: Optional[Dict[str, str]] = None,
+                   working_dir: Optional[str] = None,
+                   job_id: Optional[str] = None) -> str:
+        import ray_tpu
+
+        job_id = job_id or f"job-{uuid.uuid4().hex[:10]}"
+        supervisor = ray_tpu.remote(_JobSupervisor).options(
+            name=f"__job_supervisor_{job_id}__"
+        ).remote(job_id, entrypoint, env, working_dir)
+        ray_tpu.get(supervisor.ping.remote())
+        index = self.list_jobs()
+        index.append(job_id)
+        ray_tpu.kv_put(_LIST_KEY, json.dumps(index).encode())
+        # Pin the supervisor under its job id for stop()/status().
+        self._supervisors = getattr(self, "_supervisors", {})
+        self._supervisors[job_id] = supervisor
+        return job_id
+
+    def _supervisor(self, job_id: str):
+        import ray_tpu
+
+        sup = getattr(self, "_supervisors", {}).get(job_id)
+        if sup is not None:
+            return sup
+        return ray_tpu.get_actor(f"__job_supervisor_{job_id}__")
+
+    def get_job_status(self, job_id: str) -> JobStatus:
+        import ray_tpu
+
+        raw = ray_tpu.kv_get(_STATUS_KEY.format(job_id))
+        if raw is None:
+            return JobStatus.PENDING
+        return JobStatus(json.loads(raw)["status"])
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        import ray_tpu
+
+        raw = ray_tpu.kv_get(_STATUS_KEY.format(job_id))
+        return json.loads(raw) if raw else {"status": "PENDING"}
+
+    def get_job_logs(self, job_id: str) -> str:
+        import ray_tpu
+
+        raw = ray_tpu.kv_get(_LOGS_KEY.format(job_id))
+        return (raw or b"").decode("utf-8", "replace")
+
+    def tail_job_logs(self, job_id: str, *, poll_interval_s: float = 0.25):
+        """Generator of new log chunks until the job reaches a terminal
+        state (ref: tail_job_logs)."""
+        seen = 0
+        while True:
+            logs = self.get_job_logs(job_id)
+            if len(logs) > seen:
+                yield logs[seen:]
+                seen = len(logs)
+            status = self.get_job_status(job_id)
+            if JobStatus.terminal(status):
+                logs = self.get_job_logs(job_id)
+                if len(logs) > seen:
+                    yield logs[seen:]
+                return
+            time.sleep(poll_interval_s)
+
+    def stop_job(self, job_id: str) -> bool:
+        import ray_tpu
+
+        try:
+            sup = self._supervisor(job_id)
+            ray_tpu.get(sup.stop.remote(), timeout=10.0)
+            return True
+        except Exception:
+            return False
+
+    def list_jobs(self) -> List[str]:
+        import ray_tpu
+
+        raw = ray_tpu.kv_get(_LIST_KEY)
+        return json.loads(raw) if raw else []
+
+    def wait_until_finish(self, job_id: str, timeout: float = 300.0
+                          ) -> JobStatus:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            s = self.get_job_status(job_id)
+            if JobStatus.terminal(s):
+                return s
+            time.sleep(0.25)
+        raise TimeoutError(f"job {job_id} still running after {timeout}s")

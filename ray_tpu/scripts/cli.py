@@ -1,0 +1,415 @@
+"""rtpu — the cluster CLI.
+
+Ref analogue: python/ray/scripts/scripts.py (`ray start/stop/status`) +
+dashboard/modules/job/cli.py (`ray job submit/logs/list/stop`). Invoke as
+``python -m ray_tpu.scripts.cli`` or ``python -m ray_tpu``.
+
+Cluster bookkeeping lives under /tmp/ray_tpu/cluster/: the head writes
+``address`` (host:port of its GCS) and every started node appends a
+pidfile, which is what `rtpu stop` walks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+CLUSTER_DIR = "/tmp/ray_tpu/cluster"
+ADDRESS_FILE = os.path.join(CLUSTER_DIR, "address")
+PID_DIR = os.path.join(CLUSTER_DIR, "pids")
+LOG_DIR = os.path.join(CLUSTER_DIR, "logs")
+
+
+def _read_default_address() -> Optional[str]:
+    addr = os.environ.get("RAY_TPU_ADDRESS")
+    if addr:
+        return addr
+    try:
+        with open(ADDRESS_FILE) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or _read_default_address()
+    if not addr:
+        sys.exit(
+            "no cluster address: pass --address, set RAY_TPU_ADDRESS, or "
+            "run `rtpu start --head` on this machine first"
+        )
+    return addr
+
+
+def _record_pid(kind: str, pid: int) -> None:
+    os.makedirs(PID_DIR, exist_ok=True)
+    with open(os.path.join(PID_DIR, f"{kind}-{pid}.pid"), "w") as f:
+        f.write(str(pid))
+
+
+# ---------------------------------------------------------------- start
+
+def _run_head_blocking(args) -> int:
+    """Run a head node (GCS + node manager + worker pool) until SIGTERM
+    (ref: `ray start --head --block`)."""
+    from ray_tpu.core.config import get_config, reset_config
+    from ray_tpu.core.ids import NodeID
+    from ray_tpu.core.node_manager import NodeManager
+    from ray_tpu.core.tpu import node_tpu_labels
+
+    reset_config()
+    config = get_config()
+    config.gcs_port = args.port
+    config.node_ip = args.node_ip
+    res = json.loads(args.resources) if args.resources else {}
+    res.setdefault("CPU", args.num_cpus if args.num_cpus is not None
+                   else os.cpu_count() or 1)
+    if args.num_tpus is not None:
+        res["TPU"] = args.num_tpus
+
+    import tempfile
+    import uuid
+
+    session_dir = os.path.join(
+        tempfile.gettempdir(), "ray_tpu",
+        f"head-{int(time.time())}-{uuid.uuid4().hex[:8]}",
+    )
+    os.makedirs(session_dir, exist_ok=True)
+    nm = NodeManager(
+        NodeID.from_random(), session_dir, res, config,
+        is_head=True, node_ip=args.node_ip, labels=node_tpu_labels(),
+    )
+    nm.start()
+    host, port = nm.gcs_service.address
+    address = f"{host}:{port}"
+    os.makedirs(CLUSTER_DIR, exist_ok=True)
+    with open(ADDRESS_FILE, "w") as f:
+        f.write(address)
+    _record_pid("head", os.getpid())
+    print(f"ray_tpu head up at {address}")
+    print(f"  connect drivers with ray_tpu.init(address={address!r})")
+    print(f"  or: export RAY_TPU_ADDRESS={address}")
+    sys.stdout.flush()
+
+    stop = {"flag": False}
+
+    def _term(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    while not stop["flag"]:
+        time.sleep(0.2)
+    nm.shutdown()
+    return 0
+
+
+def _run_node_blocking(args) -> int:
+    """Run a non-head node joined to --address (ref: `ray start
+    --address`)."""
+    import tempfile
+    import uuid
+
+    env = dict(os.environ)
+    env["RAY_TPU_GCS_ADDRESS"] = _resolve_address(args)
+    env["RAY_TPU_SESSION_DIR"] = os.path.join(
+        tempfile.gettempdir(), "ray_tpu",
+        f"node-{int(time.time())}-{uuid.uuid4().hex[:8]}",
+    )
+    res = json.loads(args.resources) if args.resources else {}
+    res.setdefault("CPU", args.num_cpus if args.num_cpus is not None
+                   else os.cpu_count() or 1)
+    if args.num_tpus is not None:
+        res["TPU"] = args.num_tpus
+    env["RAY_TPU_RESOURCES"] = json.dumps(res)
+    _record_pid("node", os.getpid())
+    os.execvpe(
+        sys.executable,
+        [sys.executable, "-m", "ray_tpu.core.node_main"],
+        env,
+    )
+    return 0  # unreachable
+
+
+def cmd_start(args) -> int:
+    if args.block:
+        if args.head:
+            return _run_head_blocking(args)
+        return _run_node_blocking(args)
+    # Detach: re-exec this command with --block in a background child.
+    os.makedirs(LOG_DIR, exist_ok=True)
+    kind = "head" if args.head else "node"
+    log_path = os.path.join(LOG_DIR, f"{kind}-{int(time.time())}.log")
+    cmd = [sys.executable, "-m", "ray_tpu.scripts.cli", "start", "--block"]
+    for flag in ("head",):
+        if getattr(args, flag):
+            cmd.append(f"--{flag}")
+    if args.address:
+        cmd += ["--address", args.address]
+    cmd += ["--port", str(args.port), "--node-ip", args.node_ip]
+    if args.num_cpus is not None:
+        cmd += ["--num-cpus", str(args.num_cpus)]
+    if args.num_tpus is not None:
+        cmd += ["--num-tpus", str(args.num_tpus)]
+    if args.resources:
+        cmd += ["--resources", args.resources]
+    with open(log_path, "ab") as log:
+        proc = subprocess.Popen(
+            cmd, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+    _record_pid(kind, proc.pid)
+    # Wait for the head to publish its address.
+    if args.head:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            addr = _read_default_address()
+            if addr:
+                print(f"started head (pid {proc.pid}) at {addr}")
+                print(f"logs: {log_path}")
+                return 0
+            if proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        sys.exit(f"head failed to start; see {log_path}")
+    print(f"started node (pid {proc.pid}); logs: {log_path}")
+    return 0
+
+
+def cmd_stop(args) -> int:
+    """SIGTERM every recorded head/node process (ref: `ray stop`)."""
+    count = 0
+    if os.path.isdir(PID_DIR):
+        for name in os.listdir(PID_DIR):
+            path = os.path.join(PID_DIR, name)
+            try:
+                with open(path) as f:
+                    pid = int(f.read().strip())
+                os.kill(pid, signal.SIGTERM)
+                count += 1
+            except (OSError, ValueError):
+                pass
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    try:
+        os.unlink(ADDRESS_FILE)
+    except OSError:
+        pass
+    print(f"stopped {count} process(es)")
+    return 0
+
+
+# ---------------------------------------------------------------- status
+
+def _attached(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args), num_cpus=0)
+    return ray_tpu
+
+
+def cmd_status(args) -> int:
+    """Cluster summary (ref: `ray status`)."""
+    ray_tpu = _attached(args)
+    try:
+        nodes = ray_tpu.nodes()
+        total = ray_tpu.cluster_resources()
+        avail = ray_tpu.available_resources()
+        print(f"nodes: {sum(1 for n in nodes if n['Alive'])} alive "
+              f"/ {len(nodes)} total")
+        for n in nodes:
+            state = "alive" if n["Alive"] else "dead"
+            labels = {k: v for k, v in n.get("Labels", {}).items()}
+            print(f"  {n['NodeID'][:8]} {state:5s} host={n.get('Host')} "
+                  f"resources={n['Resources']}"
+                  + (f" labels={labels}" if labels else ""))
+        print("resources:")
+        for k in sorted(total):
+            print(f"  {k}: {avail.get(k, 0):g}/{total[k]:g} available")
+        from ray_tpu.util import state as state_api
+
+        print(f"tasks: {state_api.summarize_tasks()}")
+        print(f"actors: {state_api.summarize_actors()}")
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_state(args) -> int:
+    """List live tasks/actors/objects/workers/nodes (ref: `ray list`)."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.util import state as state_api
+
+        fn = {
+            "tasks": state_api.list_tasks,
+            "actors": state_api.list_actors,
+            "objects": state_api.list_objects,
+            "workers": state_api.list_workers,
+            "nodes": state_api.list_nodes,
+        }[args.kind]
+        rows = fn(limit=args.limit)
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- jobs
+
+def cmd_submit(args) -> int:
+    """Submit a job and stream its logs (ref: `ray job submit`)."""
+    import ray_tpu
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    ray_tpu.init(address=_resolve_address(args), num_cpus=0)
+    try:
+        client = JobSubmissionClient()
+        entrypoint = " ".join(args.entrypoint)
+        job_id = client.submit_job(
+            entrypoint=entrypoint,
+            working_dir=args.working_dir,
+        )
+        print(f"submitted {job_id}: {entrypoint}")
+        if args.no_wait:
+            return 0
+        for chunk in client.tail_job_logs(job_id):
+            sys.stdout.write(chunk)
+            sys.stdout.flush()
+        status = client.get_job_status(job_id)
+        print(f"\njob {job_id} {status.value}")
+        return 0 if status == JobStatus.SUCCEEDED else 1
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_jobs(args) -> int:
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    ray_tpu.init(address=_resolve_address(args), num_cpus=0)
+    try:
+        client = JobSubmissionClient()
+        for job_id in client.list_jobs():
+            info = client.get_job_info(job_id)
+            print(f"{job_id}  {info.get('status'):9s} "
+                  f"{info.get('entrypoint', '')}")
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_logs(args) -> int:
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    ray_tpu.init(address=_resolve_address(args), num_cpus=0)
+    try:
+        client = JobSubmissionClient()
+        if args.follow:
+            for chunk in client.tail_job_logs(args.job_id):
+                sys.stdout.write(chunk)
+                sys.stdout.flush()
+        else:
+            sys.stdout.write(client.get_job_logs(args.job_id))
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_stop_job(args) -> int:
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    ray_tpu.init(address=_resolve_address(args), num_cpus=0)
+    try:
+        ok = JobSubmissionClient().stop_job(args.job_id)
+        print("stopped" if ok else "stop failed")
+        return 0 if ok else 1
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- main
+
+def _add_address(p):
+    p.add_argument("--address", default=None,
+                   help="cluster GCS address host:port (default: "
+                        "$RAY_TPU_ADDRESS or the local head's)")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rtpu", description="ray_tpu cluster CLI"
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    _add_address(p)
+    p.add_argument("--port", type=int, default=6380)
+    p.add_argument("--node-ip", default="127.0.0.1")
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--resources", default=None, help="JSON dict")
+    p.add_argument("--block", action="store_true",
+                   help="run in the foreground")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop all locally-started nodes")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster summary")
+    _add_address(p)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("kind", choices=["tasks", "actors", "objects",
+                                    "workers", "nodes"])
+    p.add_argument("--limit", type=int, default=100)
+    _add_address(p)
+    p.set_defaults(fn=cmd_state)
+
+    p = sub.add_parser("submit", help="submit a job: rtpu submit -- cmd…")
+    _add_address(p)
+    p.add_argument("--working-dir", default=None)
+    p.add_argument("--no-wait", action="store_true")
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="command to run (after --)")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("jobs", help="list jobs")
+    _add_address(p)
+    p.set_defaults(fn=cmd_jobs)
+
+    p = sub.add_parser("logs", help="print or follow a job's logs")
+    p.add_argument("job_id")
+    p.add_argument("--follow", "-f", action="store_true")
+    _add_address(p)
+    p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("stop-job", help="stop a running job")
+    p.add_argument("job_id")
+    _add_address(p)
+    p.set_defaults(fn=cmd_stop_job)
+
+    args = parser.parse_args(argv)
+    if getattr(args, "entrypoint", None):
+        # argparse.REMAINDER keeps the leading "--"; drop it.
+        if args.entrypoint and args.entrypoint[0] == "--":
+            args.entrypoint = args.entrypoint[1:]
+        if not args.entrypoint:
+            parser.error("submit needs an entrypoint after --")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,8 +15,10 @@ from ray_tpu.core.scheduling_strategies import (  # noqa: F401
     NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
+from . import state  # noqa: F401
 
 __all__ = [
+    "state",
     "PlacementGroup",
     "placement_group",
     "placement_group_table",

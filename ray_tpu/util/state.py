@@ -1,0 +1,103 @@
+"""Cluster state API.
+
+Ref analogue: python/ray/util/state/api.py (list_tasks / list_actors /
+list_objects / list_nodes / list_workers / list_placement_groups /
+summarize_*). Backed by a fan-out state query: the local node manager
+merges its own live tables with a ``state_snapshot`` peer RPC to every
+alive node (api.py:1473's StateApiClient → raylet/GCS sources).
+
+Every ``list_*`` takes ``filters``: a list of (key, predicate, value)
+tuples with predicate "=" or "!=" (the reference's filter syntax).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core import runtime_context
+
+Filter = Tuple[str, str, Any]
+
+
+def _query(kind: str, filters: Optional[List[Filter]],
+           limit: int) -> List[Dict[str, Any]]:
+    rt = runtime_context.current_runtime()
+    state = rt.cluster_state()
+    rows = state.get(kind, [])
+    for key, pred, value in filters or []:
+        if pred == "=":
+            rows = [r for r in rows if r.get(key) == value]
+        elif pred == "!=":
+            rows = [r for r in rows if r.get(key) != value]
+        else:
+            raise ValueError(f"unsupported filter predicate {pred!r}")
+    return rows[:limit]
+
+
+def list_tasks(filters: Optional[List[Filter]] = None,
+               limit: int = 10_000) -> List[Dict[str, Any]]:
+    """Live task records across the cluster (queued/running/finished-
+    retained; ref: list_tasks)."""
+    return _query("tasks", filters, limit)
+
+
+def list_actors(filters: Optional[List[Filter]] = None,
+                limit: int = 10_000) -> List[Dict[str, Any]]:
+    return _query("actors", filters, limit)
+
+
+def list_objects(filters: Optional[List[Filter]] = None,
+                 limit: int = 10_000) -> List[Dict[str, Any]]:
+    return _query("objects", filters, limit)
+
+
+def list_workers(filters: Optional[List[Filter]] = None,
+                 limit: int = 10_000) -> List[Dict[str, Any]]:
+    return _query("workers", filters, limit)
+
+
+def list_nodes(filters: Optional[List[Filter]] = None,
+               limit: int = 10_000) -> List[Dict[str, Any]]:
+    import ray_tpu
+
+    rows = ray_tpu.nodes()
+    for key, pred, value in filters or []:
+        if pred == "=":
+            rows = [r for r in rows if r.get(key) == value]
+        elif pred == "!=":
+            rows = [r for r in rows if r.get(key) != value]
+    return rows[:limit]
+
+
+def list_placement_groups(limit: int = 10_000) -> List[Dict[str, Any]]:
+    import ray_tpu
+
+    table = ray_tpu.util.placement_group_table()
+    return list(table.values())[:limit]
+
+
+def summarize_tasks() -> Dict[str, int]:
+    """Task counts by state (ref: summarize_tasks)."""
+    out: Dict[str, int] = {}
+    for t in list_tasks():
+        out[t["state"]] = out.get(t["state"], 0) + 1
+    return out
+
+
+def summarize_actors() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for a in list_actors():
+        out[a["state"]] = out.get(a["state"], 0) + 1
+    return out
+
+
+def summarize_objects() -> Dict[str, Any]:
+    objs = list_objects()
+    return {
+        "total_objects": len(objs),
+        "total_size_bytes": sum(o["size_bytes"] for o in objs),
+        "by_location": {
+            where: sum(1 for o in objs if o["where"] == where)
+            for where in {o["where"] for o in objs}
+        },
+    }
