@@ -6,11 +6,16 @@ grid_search/sample_from), schedulers (ASHA, median stopping, FIFO).
 ``report`` is shared with ray_tpu.train, like the reference's unified
 session."""
 
-from ..train.session import report  # noqa: F401  (tune.report == train.report)
+from ..train.session import (  # noqa: F401  (tune.* == train.* session API)
+    get_checkpoint,
+    report,
+)
 from .schedulers import (  # noqa: F401
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PopulationBasedTraining,
     TrialScheduler,
 )
 from .search_space import (  # noqa: F401

@@ -1,19 +1,37 @@
 """Trial schedulers (ref analogue: python/ray/tune/schedulers/ —
 FIFOScheduler, AsyncHyperBandScheduler/ASHA, MedianStoppingRule,
-HyperBandScheduler; SURVEY.md §2.3 Tune row)."""
+HyperBandScheduler, PopulationBasedTraining; SURVEY.md §2.3 Tune row).
+
+Decisions: CONTINUE / STOP, or an ``Exploit`` object (PBT): the
+controller kills the trial and relaunches it from the donor trial's
+latest checkpoint with the mutated config."""
 
 from __future__ import annotations
 
+import dataclasses
 import math
+import random as _random
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
 
 
+@dataclasses.dataclass
+class Exploit:
+    """PBT decision: restart this trial from ``donor_trial_id``'s latest
+    checkpoint with ``new_config`` (ref: pbt.py _exploit)."""
+
+    donor_trial_id: str
+    new_config: Dict[str, Any]
+
+
 class TrialScheduler:
-    def on_result(self, trial_id: str, result: Dict) -> str:
+    def on_trial_start(self, trial_id: str, config: Dict[str, Any]):
+        pass
+
+    def on_result(self, trial_id: str, result: Dict):
         return CONTINUE
 
     def on_trial_complete(self, trial_id: str, result: Optional[Dict]):
@@ -108,3 +126,161 @@ class MedianStoppingRule(TrialScheduler):
         median = means[len(means) // 2]
         best = max(self._histories[trial_id])
         return CONTINUE if best >= median else STOP
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Bracketed successive halving (ref: tune/schedulers/hyperband.py).
+
+    Trials are assigned round-robin to brackets with geometrically spaced
+    starting budgets; within a bracket, each rung keeps the top
+    1/reduction_factor of reported scores and stops the rest. This is the
+    stop-based variant (the reference pauses and later resumes culled
+    trials; with one-shot function trainables, stopping is the equivalent
+    budget allocation)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.rf = reduction_factor
+        # s_max+1 brackets, bracket s starts at budget max_t / rf^s.
+        s_max = int(math.log(max_t) / math.log(reduction_factor))
+        self._brackets: List[List[int]] = []
+        for s in range(s_max, -1, -1):
+            start = max(1, int(max_t / (reduction_factor ** s)))
+            rungs = []
+            t = start
+            while t < max_t:
+                rungs.append(int(t))
+                t *= reduction_factor
+            self._brackets.append(rungs)
+        self._assignment: Dict[str, int] = {}
+        self._next_bracket = 0
+        self._trial_rung: Dict[str, int] = {}
+        self._rung_results: Dict[tuple, List[float]] = defaultdict(list)
+
+    def on_trial_start(self, trial_id: str, config: Dict[str, Any]):
+        self._assignment[trial_id] = self._next_bracket
+        self._next_bracket = (self._next_bracket + 1) % len(self._brackets)
+
+    def on_result(self, trial_id: str, result: Dict):
+        t = result.get(self.time_attr)
+        val = result.get(self.metric)
+        if t is None or val is None:
+            return CONTINUE
+        val = float(val) if self.mode == "max" else -float(val)
+        b = self._assignment.setdefault(trial_id, 0)
+        rungs = self._brackets[b]
+        idx = self._trial_rung.get(trial_id, 0)
+        if idx >= len(rungs):
+            return CONTINUE
+        rung = rungs[idx]
+        if t < rung:
+            return CONTINUE
+        results = self._rung_results[(b, rung)]
+        results.append(val)
+        self._trial_rung[trial_id] = idx + 1
+        k = max(1, int(math.ceil(len(results) / self.rf)))
+        threshold = sorted(results, reverse=True)[k - 1]
+        return CONTINUE if val >= threshold else STOP
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (ref: tune/schedulers/pbt.py PopulationBasedTraining): every
+    ``perturbation_interval`` reports, a bottom-quantile trial EXPLOITS a
+    top-quantile trial — restarting from the donor's latest checkpoint —
+    and EXPLORES by mutating the donor's hyperparameters (x0.8/x1.2
+    perturbation or resampling from the mutation distribution)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: int = 0):
+        assert mode in ("max", "min")
+        assert 0 < quantile_fraction <= 0.5
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self._rng = _random.Random(seed)
+        import numpy as _np
+
+        self._np_rng = _np.random.RandomState(seed)  # for Domain.sample
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._scores: Dict[str, float] = {}
+        self._last_perturb: Dict[str, int] = {}
+
+    def on_trial_start(self, trial_id: str, config: Dict[str, Any]):
+        self._configs[trial_id] = dict(config)
+        # A fresh (or exploited) trial starts a new perturbation window —
+        # anchored at its FIRST post-(re)start report, not at t=0
+        # (training_iteration keeps counting across relaunches, so a zero
+        # anchor would re-exploit an exploited trial immediately).
+        self._last_perturb.pop(trial_id, None)
+
+    def _quantiles(self):
+        ranked = sorted(self._scores, key=self._scores.get)
+        if self.mode == "min":
+            ranked = list(reversed(ranked))
+        n = max(1, int(math.ceil(len(ranked) * self.quantile)))
+        if len(ranked) < 2:
+            return [], []
+        return ranked[:n], ranked[-n:]  # (bottom, top)
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_p or key not in out:
+                out[key] = self._sample(spec)
+            else:
+                cur = out[key]
+                if isinstance(cur, (int, float)) and not isinstance(
+                        cur, bool):
+                    factor = 1.2 if self._rng.random() > 0.5 else 0.8
+                    out[key] = type(cur)(cur * factor)
+                else:
+                    out[key] = self._sample(spec)
+        return out
+
+    def _sample(self, spec):
+        if callable(getattr(spec, "sample", None)):
+            return spec.sample(self._np_rng)  # search-space Domain
+        if callable(spec):
+            return spec()
+        if isinstance(spec, (list, tuple)):
+            return self._rng.choice(list(spec))
+        return spec
+
+    def on_result(self, trial_id: str, result: Dict):
+        t = result.get(self.time_attr, 0)
+        val = result.get(self.metric)
+        if val is not None:
+            self._scores[trial_id] = (
+                float(val) if self.mode == "max" else -float(val)
+            )
+        if trial_id not in self._last_perturb:
+            self._last_perturb[trial_id] = t  # window anchor
+            return CONTINUE
+        if t - self._last_perturb[trial_id] < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        bottom, top = self._quantiles()
+        if trial_id not in bottom or not top:
+            return CONTINUE
+        donor = self._rng.choice(top)
+        if donor == trial_id:
+            return CONTINUE
+        new_config = self._explore(self._configs.get(donor, {}))
+        return Exploit(donor_trial_id=donor, new_config=new_config)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict]):
+        self._scores.pop(trial_id, None)

@@ -19,7 +19,13 @@ import cloudpickle
 from ..train.checkpoint import default_storage_path
 from ..train.config import RunConfig
 from ..train.session import TrainSession, set_session
-from .schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from .schedulers import (
+    CONTINUE,
+    STOP,
+    Exploit,
+    FIFOScheduler,
+    TrialScheduler,
+)
 from .search_space import generate_variants
 
 
@@ -95,11 +101,17 @@ class ResultGrid:
 
 
 def _trial_entry(fn_blob: bytes, config: Dict[str, Any], trial_id: str,
-                 storage_dir: str):
+                 storage_dir: str, run_id: Optional[str] = None,
+                 start_ckpt_path: Optional[str] = None):
+    from ..train.checkpoint import Checkpoint
+
     fn = cloudpickle.loads(fn_blob)
     session = TrainSession(
-        run_id=trial_id, world_rank=0, world_size=1,
-        storage_dir=storage_dir, start_checkpoint=None,
+        run_id=run_id or trial_id, world_rank=0, world_size=1,
+        storage_dir=storage_dir,
+        start_checkpoint=(
+            Checkpoint(start_ckpt_path) if start_ckpt_path else None
+        ),
         trial_info={"name": trial_id},
     )
     set_session(session)
@@ -125,6 +137,16 @@ class _Trial:
     next_seq: int = 0
     history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
     error: Optional[str] = None
+    # Restarts (PBT exploit / experiment restore): each (re)launch gets its
+    # own KV report channel run id so sequence numbers never collide.
+    epoch: int = 0
+    last_checkpoint: Optional[str] = None
+    start_checkpoint: Optional[str] = None
+
+    @property
+    def run_id(self) -> str:
+        return (self.trial_id if self.epoch == 0
+                else f"{self.trial_id}-r{self.epoch}")
 
 
 class Tuner:
@@ -141,6 +163,122 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
 
+    def _make_trials(self) -> List[_Trial]:
+        tc = self.tune_config
+        variants = generate_variants(
+            self._param_space, tc.num_samples, tc.search_seed
+        )
+        return [
+            _Trial(trial_id=f"trial_{i:05d}_{uuid.uuid4().hex[:6]}",
+                   config=cfg)
+            for i, cfg in enumerate(variants)
+        ]
+
+    # ---- experiment state persistence (ref: tune/execution/
+    # experiment_state.py _ExperimentCheckpointManager) -------------------
+
+    _STATE_FILE = "experiment_state.json"
+
+    def _save_state(self, storage: str, trials: List[_Trial]) -> None:
+        import json
+        import os
+
+        state = {
+            "param_space_pickle_hex": cloudpickle.dumps(
+                self._param_space).hex(),
+            "tune_config": {
+                "num_samples": self.tune_config.num_samples,
+                "metric": self.tune_config.metric,
+                "mode": self.tune_config.mode,
+                "search_seed": self.tune_config.search_seed,
+            },
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config_pickle_hex": cloudpickle.dumps(t.config).hex(),
+                    "state": t.state,
+                    "history": t.history,
+                    "error": t.error,
+                    "epoch": t.epoch,
+                    "last_checkpoint": t.last_checkpoint,
+                }
+                for t in trials
+            ],
+        }
+        def jsonable(o):
+            # Metrics histories routinely hold numpy/jax scalars.
+            import numpy as np
+
+            if isinstance(o, np.generic):
+                return o.item()
+            if isinstance(o, np.ndarray):
+                return o.tolist()
+            if hasattr(o, "item"):
+                return o.item()
+            return repr(o)
+
+        os.makedirs(storage, exist_ok=True)
+        tmp = os.path.join(storage, self._STATE_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, default=jsonable)
+        os.replace(tmp, os.path.join(storage, self._STATE_FILE))
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable[[Dict[str, Any]], None],
+                *, tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its storage directory
+        (ref: Tuner.restore, tuner.py:234): finished trials keep their
+        results; interrupted trials re-run from their latest checkpoint."""
+        import json
+        import os
+
+        with open(os.path.join(path, cls._STATE_FILE)) as f:
+            state = json.load(f)
+        param_space = cloudpickle.loads(
+            bytes.fromhex(state["param_space_pickle_hex"])
+        )
+        saved_tc = state["tune_config"]
+        tc = tune_config or TuneConfig(
+            num_samples=saved_tc["num_samples"],
+            metric=saved_tc["metric"],
+            mode=saved_tc["mode"],
+            search_seed=saved_tc["search_seed"],
+        )
+        import copy
+
+        # Never mutate the caller's RunConfig; a restore is pinned to the
+        # saved experiment's directory.
+        rc = copy.copy(run_config) if run_config else RunConfig()
+        rc.storage_path = path
+        tuner = cls(trainable, param_space=param_space, tune_config=tc,
+                    run_config=rc)
+        restored = []
+        for row in state["trials"]:
+            t = _Trial(
+                trial_id=row["trial_id"],
+                config=cloudpickle.loads(
+                    bytes.fromhex(row["config_pickle_hex"])
+                ),
+                state=row["state"],
+                history=row["history"],
+                error=row["error"],
+                epoch=row["epoch"],
+                last_checkpoint=row["last_checkpoint"],
+            )
+            if t.state in ("pending", "running"):
+                # Interrupted mid-flight: requeue from the last checkpoint
+                # under a fresh report channel.
+                t.state = "pending"
+                t.start_checkpoint = t.last_checkpoint
+                t.epoch += 1
+                t.ref = None
+                t.actor = None
+                t.next_seq = 0
+            restored.append(t)
+        tuner._restored_trials = restored
+        return tuner
+
     def fit(self) -> ResultGrid:
         import ray_tpu
         from ..core.runtime_context import current_runtime
@@ -150,14 +288,8 @@ class Tuner:
         storage = self.run_config.storage_path or default_storage_path(
             self.run_config.name
         )
-        variants = generate_variants(
-            self._param_space, tc.num_samples, tc.search_seed
-        )
-        trials = [
-            _Trial(trial_id=f"trial_{i:05d}_{uuid.uuid4().hex[:6]}",
-                   config=cfg)
-            for i, cfg in enumerate(variants)
-        ]
+        trials = getattr(self, "_restored_trials", None) or \
+            self._make_trials()
         fn_blob = cloudpickle.dumps(self._trainable)
         rt = current_runtime()
         max_conc = tc.max_concurrent_trials or max(
@@ -168,32 +300,61 @@ class Tuner:
         def launch(trial: _Trial):
             trial.actor = actor_cls.remote()
             trial.ref = trial.actor.run.remote(
-                fn_blob, trial.config, trial.trial_id, storage
+                fn_blob, trial.config, trial.trial_id, storage,
+                trial.run_id, trial.start_checkpoint,
             )
             trial.state = "running"
+            trial.next_seq = 0
+            scheduler.on_trial_start(trial.trial_id, trial.config)
+
+        def relaunch_exploit(trial: _Trial, decision: Exploit,
+                             donors: Dict[str, _Trial]):
+            """PBT exploit/explore: restart from the donor's checkpoint
+            with the mutated config (ref: pbt.py _exploit)."""
+            donor = donors.get(decision.donor_trial_id)
+            ckpt = donor.last_checkpoint if donor else None
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.config = dict(decision.new_config)
+            trial.start_checkpoint = ckpt
+            trial.epoch += 1
+            launch(trial)
+
+        by_id = {t.trial_id: t for t in trials}
 
         def drain(trial: _Trial):
             while True:
-                key = f"__train__/{trial.trial_id}/0/{trial.next_seq}"
+                key = f"__train__/{trial.run_id}/0/{trial.next_seq}"
                 blob = rt.kv_get(key)
                 if blob is None:
                     return
                 trial.next_seq += 1
                 payload = cloudpickle.loads(blob)
                 metrics = dict(payload["metrics"])
-                metrics.setdefault("training_iteration", trial.next_seq)
+                metrics.setdefault(
+                    "training_iteration", len(trial.history) + 1
+                )
                 metrics["trial_id"] = trial.trial_id
                 trial.history.append(metrics)
+                if payload.get("checkpoint_path"):
+                    trial.last_checkpoint = payload["checkpoint_path"]
                 if trial.state == "running":
-                    if scheduler.on_result(trial.trial_id, metrics) == STOP:
+                    decision = scheduler.on_result(trial.trial_id, metrics)
+                    if decision == STOP:
                         trial.state = "stopped"
                         try:
                             ray_tpu.kill(trial.actor)
                         except Exception:
                             pass
+                    elif isinstance(decision, Exploit):
+                        relaunch_exploit(trial, decision, by_id)
+                        return  # fresh channel; drain on the next pass
 
-        pending = list(trials)
+        pending = list(t for t in trials if t.state == "pending")
         running: List[_Trial] = []
+        last_save = 0.0
         while pending or running:
             while pending and len(running) < max_conc:
                 t = pending.pop(0)
@@ -212,23 +373,30 @@ class Tuner:
                 done, _ = ray_tpu.wait([t.ref], num_returns=1, timeout=0)
                 if done:
                     drain(t)
-                    try:
-                        ray_tpu.get(t.ref)
-                        t.state = "done"
-                    except Exception as e:
-                        t.state = "error"
-                        t.error = str(e)
-                    scheduler.on_trial_complete(
-                        t.trial_id, t.history[-1] if t.history else None
-                    )
-                    try:
-                        ray_tpu.kill(t.actor)
-                    except Exception:
-                        pass
-                else:
+                    if t.state == "running":  # not exploited mid-drain
+                        try:
+                            ray_tpu.get(t.ref)
+                            t.state = "done"
+                        except Exception as e:
+                            t.state = "error"
+                            t.error = str(e)
+                        scheduler.on_trial_complete(
+                            t.trial_id,
+                            t.history[-1] if t.history else None,
+                        )
+                        try:
+                            ray_tpu.kill(t.actor)
+                        except Exception:
+                            pass
+                if t.state == "running":
                     still_running.append(t)
             running = still_running
+            now = time.monotonic()
+            if now - last_save > 1.0:
+                self._save_state(storage, trials)
+                last_save = now
 
+        self._save_state(storage, trials)
         results = [
             TrialResult(
                 trial_id=t.trial_id,

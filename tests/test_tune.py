@@ -88,3 +88,169 @@ def test_asha_early_stops_bad_trials(ray_tpu_start, tmp_path):
     assert len(stopped) >= 1  # weak trials got culled
     # The strongest trial is never the one culled.
     assert all(r.config["slope"] != 2.0 for r in stopped)
+
+
+def test_hyperband_bracket_culling_unit():
+    """Deterministic bracket behavior: within a bracket, a trial reaching
+    a rung below the top-1/rf threshold is stopped."""
+    from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+    hb = tune.HyperBandScheduler(
+        metric="acc", mode="max", max_t=9, reduction_factor=3
+    )
+    # Round-robin assignment: a→bracket0, b→bracket1, c→bracket2,
+    # d→bracket0 (same bracket as a).
+    for tid in ("a", "b", "c", "d"):
+        hb.on_trial_start(tid, {})
+    # Bracket 0 rungs are [1, 3]. "a" reports first at rung 1 with a high
+    # score and survives; "d" arrives later with a low score and is culled.
+    assert hb.on_result("a", {"training_iteration": 1, "acc": 9.0}) \
+        == CONTINUE
+    assert hb.on_result("d", {"training_iteration": 1, "acc": 0.1}) == STOP
+    # "a" keeps surviving its next rung.
+    assert hb.on_result("a", {"training_iteration": 3, "acc": 27.0}) \
+        == CONTINUE
+    # Bracket 2 (largest starting budget) has no intermediate rungs:
+    # "c" is never culled regardless of score.
+    for t in range(1, 10):
+        assert hb.on_result("c", {"training_iteration": t, "acc": 0.0}) \
+            == CONTINUE
+
+
+def test_hyperband_integration(ray_tpu_start, tmp_path):
+    """End-to-end HyperBand run: the best config wins."""
+    def trainable(config):
+        for i in range(9):
+            tune.report({"acc": config["q"] * (i + 1)})
+
+    res = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([0.1, 0.2, 0.5, 1.0])},
+        tune_config=TuneConfig(
+            metric="acc", mode="max",
+            scheduler=tune.HyperBandScheduler(
+                metric="acc", mode="max", max_t=9, reduction_factor=3
+            ),
+            max_concurrent_trials=4,
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    best = res.get_best_result()
+    assert best.config["q"] == 1.0
+
+
+def test_pbt_exploits_and_mutates(ray_tpu_start, tmp_path):
+    """PBT: bottom-quantile trials restart from a top trial's checkpoint
+    with mutated hyperparameters and end up beating their original
+    config (ref: pbt.py exploit/explore)."""
+    import json
+
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    def trainable(config):
+        # State = accumulated score; good lr grows it fast, bad lr barely.
+        session_ckpt = tune.get_checkpoint()
+        total = 0.0
+        start = 0
+        if session_ckpt is not None:
+            with open(session_ckpt.path + "/state.json") as f:
+                st = json.load(f)
+            total, start = st["total"], st["step"]
+        import os
+
+        import time as _time
+
+        for step in range(start, 16):
+            total += config["lr"]
+            d = os.path.join(
+                tmp_path, f"ckpt-{os.getpid()}-{step}"
+            )
+            os.makedirs(d, exist_ok=True)
+            with open(d + "/state.json", "w") as f:
+                json.dump({"total": total, "step": step + 1}, f)
+            tune.report({"score": total}, checkpoint=Checkpoint(d))
+            # Pace reports so the population's scores interleave at the
+            # controller (PBT compares trials mid-flight).
+            _time.sleep(0.1)
+
+    pbt = tune.PopulationBasedTraining(
+        metric="score", mode="max",
+        perturbation_interval=4,
+        hyperparam_mutations={"lr": tune.uniform(0.5, 1.0)},
+        quantile_fraction=0.25,
+        seed=0,
+    )
+    res = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 0.02, 0.9, 1.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=pbt,
+                               max_concurrent_trials=4),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    # The bottom trials must have been mutated away from their original lr.
+    mutated = [r for r in res if r.config["lr"] not in
+               (0.01, 0.02, 0.9, 1.0)]
+    assert mutated, "no trial was exploited/mutated"
+    # And every final score reflects mostly-good-lr training.
+    best = res.get_best_result()
+    assert best.metrics["score"] > 10.0
+
+
+def test_tuner_restore_resumes_incomplete(ray_tpu_start, tmp_path):
+    """Tuner.restore: completed trials keep results; interrupted ones
+    re-run from their last checkpoint (ref: Tuner.restore)."""
+    import json
+    import os
+
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.tune.tuner import _Trial, Tuner as T
+
+    marker = tmp_path / "progress.json"
+
+    def trainable(config):
+        ck = tune.get_checkpoint()
+        start = 0
+        if ck is not None:
+            with open(os.path.join(ck.path, "s.json")) as f:
+                start = json.load(f)["step"]
+        for step in range(start, 4):
+            d = str(tmp_path / f"rck-{config['tag']}-{step}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "s.json"), "w") as f:
+                json.dump({"step": step + 1}, f)
+            tune.report({"step_done": step, "start": start},
+                        checkpoint=Checkpoint(d))
+
+    storage = str(tmp_path / "exp")
+    tuner = Tuner(
+        trainable,
+        param_space={"tag": tune.grid_search(["a", "b"])},
+        tune_config=TuneConfig(metric="step_done", mode="max"),
+        run_config=RunConfig(storage_path=storage),
+    )
+    res = tuner.fit()
+    assert all(r.metrics["step_done"] == 3 for r in res)
+
+    # Simulate an interruption: mark trial "a" as still running with a
+    # checkpoint at step 2.
+    state_path = os.path.join(storage, "experiment_state.json")
+    with open(state_path) as f:
+        state = json.load(f)
+    import cloudpickle
+
+    for row in state["trials"]:
+        cfg = cloudpickle.loads(bytes.fromhex(row["config_pickle_hex"]))
+        if cfg["tag"] == "a":
+            row["state"] = "running"
+            row["last_checkpoint"] = str(tmp_path / "rck-a-1")
+            row["history"] = row["history"][:2]
+    with open(state_path, "w") as f:
+        json.dump(state, f)
+
+    restored = Tuner.restore(storage, trainable)
+    res2 = restored.fit()
+    by_tag = {r.config["tag"]: r for r in res2}
+    # "b" kept its finished history; "a" re-ran from checkpoint step 2.
+    assert by_tag["b"].metrics["step_done"] == 3
+    assert by_tag["a"].metrics["step_done"] == 3
+    assert by_tag["a"].metrics["start"] == 2  # resumed, not restarted
