@@ -4,5 +4,10 @@ CPU EnvRunner actors + jax Learner on the accelerator)."""
 from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from .env_runner import EnvRunner  # noqa: F401
 from .policy import MLPPolicy  # noqa: F401
+from .dqn import DQN, DQNConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
+from .replay_buffers import (  # noqa: F401
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
 from .sample_batch import SampleBatch, compute_gae  # noqa: F401

@@ -98,13 +98,8 @@ class Algorithm:
         probe.close() if hasattr(probe, "close") else None
         self._obs_dim, self._num_actions = obs_dim, num_actions
 
-        from .policy import MLPPolicy
-
-        def policy_factory(obs_dim=obs_dim, num_actions=num_actions,
-                           hidden=config.hidden_size, seed=config.seed):
-            return MLPPolicy(obs_dim, num_actions, hidden, seed)
-
-        runner_cls = ray_tpu.remote(EnvRunner)
+        policy_factory = self._make_policy_factory(obs_dim, num_actions)
+        runner_cls = ray_tpu.remote(self._runner_class())
         self.runners = [
             runner_cls.remote(
                 creator, policy_factory,
@@ -115,6 +110,22 @@ class Algorithm:
             for i in range(config.num_env_runners)
         ]
         self.learner = self._build_learner(policy_factory())
+
+    def _make_policy_factory(self, obs_dim: int, num_actions: int):
+        from .policy import MLPPolicy
+
+        config = self.config
+
+        def policy_factory(obs_dim=obs_dim, num_actions=num_actions,
+                           hidden=config.hidden_size, seed=config.seed):
+            return MLPPolicy(obs_dim, num_actions, hidden, seed)
+
+        return policy_factory
+
+    def _runner_class(self):
+        from .env_runner import EnvRunner
+
+        return EnvRunner
 
     def _build_learner(self, policy):
         raise NotImplementedError

@@ -1,0 +1,210 @@
+"""DQN: off-policy Q-learning with replay and a target network.
+
+Ref analogue: rllib/algorithms/dqn/ (dqn.py training_step:623, double-Q +
+target network sync, n-step=1) — sampling stays on CPU EnvRunner actors
+(epsilon-greedy), learning is a jax double-DQN TD update on the
+accelerator, with uniform or prioritized replay
+(utils/replay_buffers/prioritized_replay_buffer.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env_runner import NEXT_OBS, TransitionEnvRunner
+from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+from .sample_batch import ACTIONS, DONES, OBS, REWARDS, SampleBatch
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size: int = 50_000
+        self.num_steps_sampled_before_learning_starts: int = 1_000
+        self.target_network_update_freq: int = 500  # env steps
+        self.num_updates_per_iteration: int = 32
+        self.epsilon_initial: float = 1.0
+        self.epsilon_final: float = 0.05
+        self.epsilon_timesteps: int = 10_000  # linear decay horizon
+        self.double_q: bool = True
+        self.prioritized_replay: bool = False
+        self.prioritized_replay_alpha: float = 0.6
+        self.prioritized_replay_beta: float = 0.4
+
+    def build(self) -> "DQN":
+        return DQN(self.copy())
+
+
+class DQNLearner:
+    """jax double-DQN learner with a lagged target network."""
+
+    def __init__(self, policy, lr: float, gamma: float, double_q: bool):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._tx = optax.adam(lr)
+        self._params = jax.tree.map(jnp.asarray, policy.get_weights())
+        self._target = jax.tree.map(jnp.asarray, self._params)
+        self._opt_state = self._tx.init(self._params)
+
+        def q_forward(params, obs):
+            h = obs
+            for W, b in params["trunk"]:
+                h = jnp.tanh(h @ W + b)
+            (Wq, bq), = params["q"]
+            return h @ Wq + bq
+
+        def loss_fn(params, target, obs, actions, rewards, dones,
+                    next_obs, weights):
+            q = q_forward(params, obs)
+            q_sa = jnp.take_along_axis(q, actions[:, None], axis=1)[:, 0]
+            q_next_target = q_forward(target, next_obs)
+            if double_q:
+                # Action selection by the online net, evaluation by the
+                # target net (van Hasselt 2016).
+                best = jnp.argmax(q_forward(params, next_obs), axis=1)
+            else:
+                best = jnp.argmax(q_next_target, axis=1)
+            q_next = jnp.take_along_axis(
+                q_next_target, best[:, None], axis=1
+            )[:, 0]
+            targets = rewards + gamma * (1.0 - dones) * q_next
+            td = q_sa - jax.lax.stop_gradient(targets)
+            loss = (weights * td * td).mean()
+            return loss, td
+
+        def update(params, opt_state, target, obs, actions, rewards,
+                   dones, next_obs, weights):
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, target, obs, actions, rewards, dones, next_obs,
+              weights)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._update = jax.jit(update)
+
+    def update(self, batch: SampleBatch) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        weights = batch.get("weights")
+        w = (jnp.asarray(weights) if weights is not None
+             else jnp.ones(batch.count, dtype=jnp.float32))
+        self._params, self._opt_state, loss, td = self._update(
+            self._params,
+            self._opt_state,
+            self._target,
+            jnp.asarray(batch[OBS]),
+            jnp.asarray(batch[ACTIONS], dtype=jnp.int32),
+            jnp.asarray(batch[REWARDS]),
+            jnp.asarray(batch[DONES], dtype=jnp.float32),
+            jnp.asarray(batch[NEXT_OBS]),
+            w,
+        )
+        return {"loss": float(loss), "td_error": np.asarray(td)}
+
+    def sync_target(self):
+        import jax
+
+        self._target = jax.tree.map(lambda x: x, self._params)
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params)
+
+
+class DQN(Algorithm):
+    def _make_policy_factory(self, obs_dim: int, num_actions: int):
+        from .policy import QPolicy
+
+        config = self.config
+
+        def policy_factory(obs_dim=obs_dim, num_actions=num_actions,
+                           hidden=config.hidden_size, seed=config.seed):
+            return QPolicy(obs_dim, num_actions, hidden, seed)
+
+        return policy_factory
+
+    def _runner_class(self):
+        return TransitionEnvRunner
+
+    def _build_learner(self, policy):
+        c = self.config
+        self._rng = np.random.RandomState(c.seed)
+        if c.prioritized_replay:
+            self.buffer: ReplayBuffer = PrioritizedReplayBuffer(
+                c.buffer_size, alpha=c.prioritized_replay_alpha,
+                beta=c.prioritized_replay_beta, seed=c.seed,
+            )
+        else:
+            self.buffer = ReplayBuffer(c.buffer_size, seed=c.seed)
+        self._env_steps = 0
+        self._last_target_sync = 0
+        return DQNLearner(policy, c.lr, c.gamma, c.double_q)
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._env_steps / max(1, c.epsilon_timesteps))
+        return c.epsilon_initial + frac * (
+            c.epsilon_final - c.epsilon_initial
+        )
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        # 1) sample transitions from every runner at the current epsilon.
+        eps = self._epsilon()
+        ray_tpu.get([r.set_epsilon.remote(eps) for r in self.runners])
+        batches: List[SampleBatch] = ray_tpu.get(
+            [r.sample.remote() for r in self.runners]
+        )
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += b.count
+
+        stats: Dict[str, Any] = {}
+        num_updates = 0
+        if self._env_steps >= c.num_steps_sampled_before_learning_starts:
+            # 2) learner updates on replayed minibatches.
+            for _ in range(c.num_updates_per_iteration):
+                mb = self.buffer.sample(c.minibatch_size)
+                out = self.learner.update(mb)
+                stats["loss"] = out["loss"]
+                if isinstance(self.buffer, PrioritizedReplayBuffer):
+                    self.buffer.update_priorities(
+                        mb["batch_indexes"], out["td_error"]
+                    )
+                num_updates += 1
+            # 3) lagged target sync by env-step budget.
+            if (self._env_steps - self._last_target_sync
+                    >= c.target_network_update_freq):
+                self.learner.sync_target()
+                self._last_target_sync = self._env_steps
+            # 4) broadcast fresh weights to the rollout plane.
+            weights = self.learner.get_weights()
+            ray_tpu.get(
+                [r.set_weights.remote(weights) for r in self.runners]
+            )
+
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": self._env_steps,
+            "num_learner_updates": num_updates,
+            "epsilon": eps,
+            "buffer_size": len(self.buffer),
+            **stats,
+        }

@@ -92,3 +92,47 @@ class EnvRunner:
             "episode_reward_mean": float(np.mean(recent)) if recent else 0.0,
         }
         return out
+
+
+NEXT_OBS = "next_obs"
+
+
+class TransitionEnvRunner(EnvRunner):
+    """Off-policy variant: collects raw (s, a, r, s', done) transitions for
+    a replay buffer instead of GAE-postprocessed fragments (ref analogue:
+    the rollout path feeding EpisodeReplayBuffer in the DQN stack)."""
+
+    def set_epsilon(self, epsilon: float):
+        self.policy.set_epsilon(epsilon)
+
+    def sample(self) -> SampleBatch:
+        obs_l, act_l, rew_l, done_l, next_l = [], [], [], [], []
+        for _ in range(self.fragment):
+            action, _, _ = self.policy.compute_action(
+                np.asarray(self._obs, dtype=np.float32), self.rng
+            )
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            done = bool(terminated or truncated)
+            obs_l.append(np.asarray(self._obs, dtype=np.float32).reshape(-1))
+            act_l.append(action)
+            rew_l.append(float(reward))
+            # Bootstrapping must stop at TERMINATION but not truncation
+            # (time limits are not environment death).
+            done_l.append(bool(terminated))
+            next_l.append(np.asarray(nxt, dtype=np.float32).reshape(-1))
+            self._episode_reward += float(reward)
+            self._episode_len += 1
+            if done:
+                self._episode_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._episode_len = 0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        return SampleBatch({
+            OBS: np.stack(obs_l),
+            ACTIONS: np.asarray(act_l),
+            REWARDS: np.asarray(rew_l, dtype=np.float32),
+            DONES: np.asarray(done_l),
+            NEXT_OBS: np.stack(next_l),
+        })

@@ -67,3 +67,46 @@ class MLPPolicy:
         action = int(rng.choice(self.num_actions, p=probs))
         logp = float(np.log(probs[action] + 1e-12))
         return action, logp, float(value[0])
+
+
+class QPolicy:
+    """Discrete-action Q-network MLP; numpy inference with epsilon-greedy
+    exploration (ref analogue: the DQN RLModule's inference path +
+    EpsilonGreedy exploration, rllib/utils/exploration/epsilon_greedy.py)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: int = 64,
+                 seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.epsilon = 1.0
+        self.weights: Dict[str, List] = {
+            "trunk": init_mlp_params(rng, [obs_dim, hidden, hidden]),
+            "q": init_mlp_params(rng, [hidden, num_actions]),
+        }
+
+    def set_weights(self, weights):
+        self.weights = weights
+
+    def get_weights(self):
+        return self.weights
+
+    def set_epsilon(self, epsilon: float):
+        self.epsilon = float(epsilon)
+
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        h = obs
+        for W, b in self.weights["trunk"]:
+            h = np.tanh(h @ W + b)
+        (Wq, bq), = self.weights["q"]
+        return h @ Wq + bq
+
+    def compute_action(self, obs: np.ndarray, rng: np.random.RandomState):
+        if rng.rand() < self.epsilon:
+            action = int(rng.randint(self.num_actions))
+        else:
+            q = self.q_values(np.asarray(obs).reshape(-1)[None])[0]
+            action = int(np.argmax(q))
+        # (action, logp, value) signature shared with MLPPolicy so runners
+        # are interchangeable; Q-learning has no logp/value at sample time.
+        return action, 0.0, 0.0
