@@ -20,6 +20,7 @@ The head node manager talks to the same tables through ``LocalGcsHandle``
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
@@ -96,10 +97,17 @@ class GcsService:
         self.on_pgs_invalidated: Optional[Callable[[List[str]], None]] = None
 
         self._health_task: Optional[asyncio.Task] = None
+        # Durable-table persistence (ref analogue: gcs_storage /
+        # RedisStoreClient behind GcsTableStorage — gcs_server keeps its
+        # tables restorable across head restarts).
+        self._storage_path: str = getattr(config, "gcs_storage_path", "")
+        self._dirty = False
 
     # ------------------------------------------------------------------ boot
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
+        if self._storage_path:
+            self._restore_snapshot()
         self._server = await asyncio.start_server(
             self._handle_connection, host=host, port=port
         )
@@ -118,8 +126,98 @@ class GcsService:
             # Resources freed by finishing tasks must retrigger placement of
             # pending groups, not just node joins (advisor finding r1).
             await self._retry_pending_pgs()
+            self._maybe_snapshot()
+
+    # --------------------------------------------------- durable persistence
+
+    SNAPSHOT_MIN_INTERVAL_S = 2.0
+
+    def mark_dirty(self):
+        self._dirty = True
+
+    def _maybe_snapshot(self, *, force: bool = False):
+        """Rate-limited; the table COPY happens on the loop (consistent
+        view) but pickling + file I/O run in the default executor so a
+        busy KV channel can't stall the control plane."""
+        if not self._storage_path or not self._dirty:
+            return
+        now = time.monotonic()
+        if not force:
+            if getattr(self, "_snapshot_inflight", False):
+                return
+            if now - getattr(self, "_last_snapshot", 0.0) < \
+                    self.SNAPSHOT_MIN_INTERVAL_S:
+                return
+        self._dirty = False
+        self._last_snapshot = now
+        snap = self._build_snapshot()
+        if force:
+            self._persist_snapshot(snap)
+            return
+        self._snapshot_inflight = True
+
+        def write():
+            try:
+                self._persist_snapshot(snap)
+            finally:
+                self._snapshot_inflight = False
+
+        try:
+            self._loop.run_in_executor(None, write)
+        except Exception:
+            self._snapshot_inflight = False
+
+    def _build_snapshot(self):
+        return {
+            "kv": dict(self._kv),
+            "functions": dict(self._functions),
+            "named_actors": {
+                name: (aid.hex(), nid.hex(), spec)
+                for name, (aid, nid, spec) in self._named_actors.items()
+            },
+            "job_counter": self._job_counter,
+        }
+
+    def _persist_snapshot(self, snap):
+        import pickle
+
+        try:
+            tmp = self._storage_path + ".tmp"
+            os.makedirs(os.path.dirname(self._storage_path) or ".",
+                        exist_ok=True)
+            with open(tmp, "wb") as f:
+                pickle.dump(snap, f)
+            os.replace(tmp, self._storage_path)
+        except Exception:
+            pass
+
+    def _restore_snapshot(self):
+        """Reload durable tables after a head restart (ref:
+        gcs_server restart path over persisted table storage). Node /
+        object / PG state is runtime state: nodes re-register and
+        republish; it is intentionally not restored."""
+        import pickle
+
+        try:
+            with open(self._storage_path, "rb") as f:
+                snap = pickle.load(f)
+        except FileNotFoundError:
+            return
+        except Exception:
+            return
+        self._kv.update(snap.get("kv", {}))
+        self._functions.update(snap.get("functions", {}))
+        for name, (aid_hex, nid_hex, spec) in snap.get(
+                "named_actors", {}).items():
+            self._named_actors[name] = (
+                ActorID.from_hex(aid_hex), NodeID.from_hex(nid_hex), spec
+            )
+        self._job_counter = max(
+            self._job_counter, snap.get("job_counter", 0)
+        )
 
     def stop(self):
+        self._maybe_snapshot(force=True)
         if self._health_task is not None:
             self._health_task.cancel()
         if getattr(self, "_broadcast_task", None) is not None:
@@ -217,12 +315,16 @@ class GcsService:
                 value = self._kv.get(msg["key"])
             return {"value": value}
         if op == "kv_del":
-            return {"deleted": self._kv.pop(msg["key"], None) is not None}
+            deleted = self._kv.pop(msg["key"], None) is not None
+            if deleted:
+                self._dirty = True
+            return {"deleted": deleted}
         if op == "kv_keys":
             prefix = msg.get("prefix", "")
             return {"keys": [k for k in self._kv if k.startswith(prefix)]}
         if op == "register_function":
             self._functions[msg["function_id"]] = msg["blob"]
+            self._dirty = True
             return {"ok": True}
         if op == "fetch_function":
             return {"blob": self._functions.get(msg["function_id"])}
@@ -249,6 +351,7 @@ class GcsService:
             cur = self._named_actors.get(msg["name"])
             if cur is not None and cur[0].hex() == msg["actor_id"]:
                 self._named_actors.pop(msg["name"], None)
+                self._dirty = True
             return None
         if op == "register_actor_node":
             self._actor_nodes[ActorID.from_hex(msg["actor_id"])] = NodeID.from_hex(
@@ -591,6 +694,7 @@ class GcsService:
         if not overwrite and key in self._kv:
             return False
         self._kv[key] = value
+        self._dirty = True
         ev = self._kv_events.pop(key, None)
         if ev is not None:
             ev.set()
@@ -618,6 +722,7 @@ class GcsService:
             # Idempotent for the same actor (restart re-claims its name).
             return existing[0] == actor_id
         self._named_actors[name] = (actor_id, node_id, spec)
+        self._dirty = True
         return True
 
     # --------------------------------------------------------------- objects
