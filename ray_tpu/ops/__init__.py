@@ -5,3 +5,4 @@ from .attention import (  # noqa: F401
     attention_finalize,
     mha_attention,
 )
+from .flash_attention import flash_attention  # noqa: F401

@@ -64,11 +64,29 @@ def ring_attention_shard(
         if causal:
             k_pos = kv_idx * Sl + jnp.arange(Sl)
             mask = k_pos[None, :] <= q_pos[:, None]  # [Sl, Sl]
+
+            # A KV block strictly ahead of this device's query block is
+            # FULLY masked: skip its two matmuls entirely instead of
+            # computing then discarding (r1 VERDICT: the jnp ring wasted
+            # ~2x FLOPs in the causal case). lax.cond executes only the
+            # taken branch at runtime.
+            def do(args):
+                q_, k_, v_, m_, l_, a_ = args
+                return attention_block_accumulate(
+                    q_, k_, v_, m_, l_, a_, scale=scale, mask=mask
+                )
+
+            def skip(args):
+                _, _, _, m_, l_, a_ = args
+                return m_, l_, a_
+
+            m, l, acc = jax.lax.cond(
+                kv_idx <= my, do, skip, (q, k_cur, v_cur, m, l, acc)
+            )
         else:
-            mask = None
-        m, l, acc = attention_block_accumulate(
-            q, k_cur, v_cur, m, l, acc, scale=scale, mask=mask
-        )
+            m, l, acc = attention_block_accumulate(
+                q, k_cur, v_cur, m, l, acc, scale=scale, mask=None
+            )
         # Rotate KV one hop; overlapped with the next block's compute by XLA.
         k_nxt = shift(k_cur, axis_name, 1)
         v_nxt = shift(v_cur, axis_name, 1)
