@@ -52,9 +52,9 @@ class Preprocessor:
         raise NotImplementedError
 
 
-def _col_stats(ds, columns: List[str], want) -> Dict[str, Dict[str, float]]:
-    """One streaming pass computing per-column aggregates. ``want`` is a
-    subset of {sum, sumsq, min, max, count}."""
+def _col_stats(ds, columns: List[str]) -> Dict[str, Dict[str, float]]:
+    """One streaming pass computing per-column aggregates
+    (sum/sumsq/min/max/count — one fused pass covers every scaler)."""
 
     def per_block(batch: Dict[str, np.ndarray]):
         out = {}
@@ -92,7 +92,7 @@ class StandardScaler(Preprocessor):
         self.stats_: Dict[str, tuple] = {}
 
     def _fit(self, ds) -> None:
-        stats = _col_stats(ds, self.columns, {"sum", "sumsq", "count"})
+        stats = _col_stats(ds, self.columns)
         for c, s in stats.items():
             mean = s["sum"] / max(s["count"], 1.0)
             var = s["sumsq"] / max(s["count"], 1.0) - mean * mean
@@ -113,7 +113,7 @@ class MinMaxScaler(Preprocessor):
         self.stats_: Dict[str, tuple] = {}
 
     def _fit(self, ds) -> None:
-        stats = _col_stats(ds, self.columns, {"min", "max"})
+        stats = _col_stats(ds, self.columns)
         for c, s in stats.items():
             self.stats_[c] = (s["min"], s["max"])
 

@@ -167,7 +167,12 @@ def _actor_stage_gen(upstream: Iterator[Any],
                 i += 1
                 inflight.append(member.apply.remote(item))
             if inflight:
-                yield inflight.pop(0)
+                ref = inflight.pop(0)
+                # Seal before yielding: the pool is killed when this
+                # generator closes, and a killed actor can't seal a result
+                # that downstream hasn't consumed yet.
+                ray_tpu.wait([ref], num_returns=1, timeout=None)
+                yield ref
     finally:
         for a in pool:
             try:
