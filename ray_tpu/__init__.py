@@ -13,6 +13,8 @@ Public surface mirrors the reference's `ray.*` core API
 """
 
 from ._version import __version__  # noqa: F401
+from . import dag  # noqa: F401
+from . import dashboard  # noqa: F401
 from . import job_submission  # noqa: F401
 from . import util  # noqa: F401
 from .core import (  # noqa: F401
@@ -21,6 +23,7 @@ from .core import (  # noqa: F401
     ActorHandle,
     GetTimeoutError,
     ObjectRef,
+    ObjectRefGenerator,
     RayTpuError,
     TaskCancelledError,
     TaskError,
@@ -69,6 +72,7 @@ __all__ = [
     "kv_put",
     "kv_get",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorClass",
     "ActorHandle",
     "RayTpuError",

@@ -36,4 +36,5 @@ from .scheduling_strategies import (  # noqa: F401
     NodeLabelSchedulingStrategy,
 )
 from .spmd import SpmdActorGroup, SpmdGroupError  # noqa: F401
+from .streaming import ObjectRefGenerator  # noqa: F401
 from . import tpu  # noqa: F401

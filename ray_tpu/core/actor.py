@@ -33,20 +33,30 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         rt = current_runtime()
         spec_args, spec_kwargs, keepalive = rt.prepare_args(args, kwargs)
+        num_returns = self._num_returns
+        streaming = num_returns in ("streaming", "dynamic")
+        if streaming:
+            num_returns = 1
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             task_type=TaskType.ACTOR_TASK,
             function_id=self._handle._class_function_id,
             args=spec_args,
             kwargs=spec_kwargs,
-            num_returns=self._num_returns,
+            num_returns=num_returns,
+            streaming=streaming,
+            runtime_env_key=rt.runtime_env_key,
             name=f"{self._handle._class_name}.{self._method_name}",
             actor_id=self._handle._actor_id,
             method_name=self._method_name,
         )
         refs = rt.submit(spec)
         del keepalive
-        return refs[0] if self._num_returns == 1 else refs
+        if streaming:
+            from .streaming import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, refs[0])
+        return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):
         raise TypeError("Actor methods must be called with '.remote()'.")
@@ -92,6 +102,12 @@ class ActorClass:
         merged.update(opts)
         return ActorClass(self._cls, merged)
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy actor DAG node (ref: ray.dag — cls.bind)."""
+        from ..dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> ActorHandle:
         rt = current_runtime()
         function_id = rt.ensure_function(self._cls)
@@ -113,6 +129,7 @@ class ActorClass:
             name=self._options.get("name", ""),
             actor_id=actor_id,
             class_name=self._cls.__name__,
+            runtime_env_key=rt.runtime_env_key,
             max_restarts=max_restarts,
             max_concurrency=self._options.get("max_concurrency", 1),
             scheduling_strategy=self._options.get("scheduling_strategy"),

@@ -7,7 +7,10 @@ store returns (small inline, large to the shared-memory store).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# Sentinel passed to stream_item after the last yielded value.
+_STREAM_END = object()
 
 from .config import get_config
 from .exceptions import TaskError
@@ -107,6 +110,7 @@ def execute_task(
     fetch: Callable[[List[ObjectID]], List[Any]],
     store_large: Callable[[ObjectID, Any], Location],
     actor: ActorContainer,
+    stream_item: Optional[Callable[[int, Any], None]] = None,
 ) -> Tuple[List[Tuple[ObjectID, Location]], bool]:
     """Run one task; returns (results, failed)."""
     try:
@@ -120,6 +124,22 @@ def execute_task(
         else:
             fn = load_function(spec.function_id)
             value = fn(*args, **kwargs)
+        if spec.streaming and stream_item is not None:
+            # Streaming generator: seal items as they are produced; the
+            # return slot carries the item count (ref: streaming
+            # generators' completion semantics).
+            import inspect
+
+            count = 0
+            if inspect.isgenerator(value) or hasattr(value, "__next__"):
+                for item in value:
+                    stream_item(count, item)
+                    count += 1
+            elif value is not None:
+                stream_item(0, value)
+                count = 1
+            stream_item(count, _STREAM_END)
+            value = count
         return package_results(spec, value, store_large), False
     except Exception as e:  # noqa: BLE001 — user exceptions become TaskError
         err = e if isinstance(e, TaskError) else TaskError.from_exception(

@@ -2118,15 +2118,24 @@ class NodeManager:
                     data = self.local_store.get_bytes(loc)
                 except Exception:
                     continue  # lost the race with GC
-                sloc = await self._loop.run_in_executor(
-                    None, self.spill_manager.write, oid, data
-                )
+                try:
+                    sloc = await self._loop.run_in_executor(
+                        None, self.spill_manager.write, oid, data
+                    )
+                except Exception:
+                    continue  # disk trouble: skip, keep relieving others
                 if self.directory.replace_if(oid, loc, sloc):
                     _free_location(loc)
                 else:
                     self.spill_manager.delete(sloc)
         finally:
             self._spilling = False
+            # Puts/restores that landed mid-pass can leave usage above the
+            # mark with no future trigger — re-check so pressure can't get
+            # stranded between passes. Delayed, so a pass that cannot make
+            # progress (full disk, all candidates raced) does not respawn
+            # itself in a tight loop.
+            self._loop.call_later(0.2, self._maybe_spill)
 
     async def _restore_spilled(
         self, oid: ObjectID, sloc: SpilledLocation
@@ -2440,6 +2449,22 @@ class NodeManager:
             return self._kv.get(key)
 
         return self.call_sync(_get())
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        async def _keys():
+            if self._gcs is not None:
+                return await self._gcs.kv_keys(prefix)
+            return [k for k in self._kv if k.startswith(prefix)]
+
+        return self.call_sync(_keys())
+
+    def kv_del(self, key: str) -> bool:
+        async def _del():
+            if self._gcs is not None:
+                return await self._gcs.kv_del(key)
+            return self._kv.pop(key, None) is not None
+
+        return self.call_sync(_del())
 
     # ----------------------------------------------------------- cancellation
 

@@ -41,11 +41,20 @@ class RemoteFunction:
         merged.update(opts)
         return RemoteFunction(self._fn, merged)
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node (ref: ray.dag — fn.bind)."""
+        from ..dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         rt = current_runtime()
         function_id = rt.ensure_function(self._fn)
         spec_args, spec_kwargs, keepalive = rt.prepare_args(args, kwargs)
         num_returns = self._options.get("num_returns", 1)
+        streaming = num_returns in ("streaming", "dynamic")
+        if streaming:
+            num_returns = 1  # the completion slot (item count / error)
         max_retries = self._options.get(
             "max_retries", get_config().default_max_retries
         )
@@ -56,6 +65,8 @@ class RemoteFunction:
             args=spec_args,
             kwargs=spec_kwargs,
             num_returns=num_returns,
+            streaming=streaming,
+            runtime_env_key=rt.runtime_env_key,
             resources=_build_resources(self._options, default_num_cpus=1),
             name=self._options.get("name", getattr(self._fn, "__name__", "task")),
             max_retries=max_retries,
@@ -64,6 +75,10 @@ class RemoteFunction:
         )
         refs = rt.submit(spec)
         del keepalive  # deps are pinned by the control plane from here on
+        if streaming:
+            from .streaming import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, refs[0])
         return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

@@ -67,6 +67,9 @@ class BaseRuntime:
         self.refs = RefCountTable(self._flush_deltas)
         self._put_counter = itertools.count(1)
         self.current_task_id: Optional[TaskID] = None
+        # KV key of this job's published runtime env ("" = none); stamped
+        # onto every TaskSpec submitted from this process.
+        self.runtime_env_key: str = ""
         self.current_actor_id: Optional[ActorID] = None
         self._registered_functions: set = set()
         self._flusher_stop = threading.Event()
@@ -291,6 +294,12 @@ class DriverRuntime(BaseRuntime):
     def kv_get(self, key: str) -> Optional[bytes]:
         return self._nm.kv_get(key)
 
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        return self._nm.kv_keys(prefix)
+
+    def kv_del(self, key: str) -> bool:
+        return self._nm.kv_del(key)
+
     def stats(self) -> Dict[str, Any]:
         return self._nm.call_sync(self._nm.stats())
 
@@ -458,6 +467,14 @@ class WorkerRuntime(BaseRuntime):
 
     def kv_get(self, key: str) -> Optional[bytes]:
         return self.request({"type": "kv", "op": "get", "key": key})["value"]
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        return self.request({"type": "kv", "op": "keys",
+                             "prefix": prefix})["keys"]
+
+    def kv_del(self, key: str) -> bool:
+        return self.request({"type": "kv", "op": "del",
+                             "key": key})["deleted"]
 
     def get_named_actor_spec(self, name: str):
         reply = self.request({"type": "get_named_actor", "name": name})

@@ -1,0 +1,163 @@
+"""Streaming generator returns.
+
+Ref analogue: ObjectRefGenerator / streaming_generator.py — a task
+declared ``num_returns="streaming"`` yields values; each yield is sealed
+into the object store AS IT IS PRODUCED (index-derived ObjectIDs), so the
+consumer iterates results while the producer is still running —
+backpressure-free pipelining for long producers.
+
+Protocol: the producing worker seals item i as
+``ObjectID.from_index(task_id, STREAM_BASE | (i+1))`` with one pinned
+ref, then writes a small KV record ``__stream__/<task>/<i>``; generator
+exhaustion writes an ``end`` record. The consumer polls the KV (cheap:
+single control-plane lookup), adopts each item ref (its +1 cancels the
+producer's pin via coalesced delta flushing), and raises StopIteration at
+the end marker. Works cross-node: item locations ride the GCS object
+directory like any sealed object.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import cloudpickle
+
+from .ids import ObjectID, TaskID
+from .reference import ObjectRef
+
+# High bit block distinct from return slots (small ints) and put-ids
+# (0x8000_0000 block).
+STREAM_BASE = 0x4000_0000
+
+POLL_INTERVAL_S = 0.02
+
+
+def stream_item_id(task_id: TaskID, index: int) -> ObjectID:
+    return ObjectID.from_index(task_id, STREAM_BASE | (index + 1))
+
+
+def stream_key(task_id: TaskID, index: int) -> str:
+    return f"__stream__/{task_id.hex()}/{index}"
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yielded ObjectRefs (ref:
+    ObjectRefGenerator). ``next()`` returns the NEXT item's ObjectRef as
+    soon as the producer sealed it; iteration ends when the producer's
+    generator is exhausted. The completion ref resolves to the item count
+    (and surfaces the task's exception, if any)."""
+
+    def __init__(self, task_id: TaskID, completion_ref: ObjectRef):
+        self._task_id = task_id
+        self._completion_ref = completion_ref
+        self._next = 0
+        self._count: Optional[int] = None
+
+    @property
+    def completed(self) -> ObjectRef:
+        """The task's completion ref (item count / error carrier)."""
+        return self._completion_ref
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        from .runtime_context import current_runtime
+
+        rt = current_runtime()
+        if self._count is not None and self._next >= self._count:
+            raise StopIteration
+        key = stream_key(self._task_id, self._next)
+        while True:
+            blob = rt.kv_get(key)
+            if blob is not None:
+                break
+            # Surface producer failure instead of hanging: the completion
+            # slot seals (with the error) when the task dies.
+            import ray_tpu
+
+            done, _ = ray_tpu.wait(
+                [self._completion_ref], num_returns=1, timeout=0
+            )
+            if done:
+                # Either finished (end marker imminent/count known) or
+                # failed (get raises the task error).
+                count = ray_tpu.get(self._completion_ref)
+                blob = rt.kv_get(key)
+                if blob is None:
+                    self._count = count
+                    raise StopIteration
+                break
+            time.sleep(POLL_INTERVAL_S)
+        payload = cloudpickle.loads(blob)
+        if "end" in payload:
+            self._count = payload["end"]
+            self._drop_all_kv()
+            raise StopIteration
+        idx = self._next
+        self._next += 1
+        oid = ObjectID.from_hex(payload["oid"])
+        ref = ObjectRef(oid, _register=True)
+        # Cancel the producer-side pin: the +1 just registered and this -1
+        # coalesce locally, leaving the seal-time pin as the user ref's
+        # count until the ref is dropped.
+        rt.refs.decr(oid)
+        # TOMBSTONE rather than delete: a retried producer checks this key
+        # to decide whether an index was already pinned — deleting it would
+        # make the retry re-pin consumed items (leak).
+        try:
+            rt.kv_put(stream_key(self._task_id, idx),
+                      cloudpickle.dumps({"consumed": True}))
+        except Exception:
+            pass
+        return ref
+
+    def _drop_all_kv(self) -> None:
+        """Stream finished: progress records (incl. tombstones) go away."""
+        from .runtime_context import current_runtime_or_none
+
+        rt = current_runtime_or_none()
+        if rt is None:
+            return
+        try:
+            prefix = f"__stream__/{self._task_id.hex()}/"
+            for key in rt.kv_keys(prefix):
+                try:
+                    rt.kv_del(key)
+                except Exception:
+                    pass
+        except Exception:
+            pass
+
+    def __del__(self):
+        """Abandoned mid-stream: release the producer pins of every
+        unconsumed item and drop all progress records, so a consumer that
+        stops early doesn't leak object-store memory."""
+        from .runtime_context import current_runtime_or_none
+
+        rt = current_runtime_or_none()
+        if rt is None:
+            return
+        try:
+            prefix = f"__stream__/{self._task_id.hex()}/"
+            for key in rt.kv_keys(prefix):
+                try:
+                    idx = int(key.rsplit("/", 1)[1])
+                except ValueError:
+                    continue
+                blob = rt.kv_get(key)
+                if blob and idx >= self._next:
+                    payload = cloudpickle.loads(blob)
+                    if "oid" in payload:
+                        rt.refs.decr(ObjectID.from_hex(payload["oid"]))
+                try:
+                    rt.kv_del(key)
+                except Exception:
+                    pass
+        except Exception:
+            pass
+
+    def __repr__(self):
+        return (f"ObjectRefGenerator(task={self._task_id.hex()[:8]}, "
+                f"next={self._next})")

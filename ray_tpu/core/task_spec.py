@@ -46,6 +46,15 @@ class TaskSpec:
     args: List[Any] = field(default_factory=list)  # RefArg | ValueArg
     kwargs: Dict[str, Any] = field(default_factory=dict)
     num_returns: int = 1
+    # Streaming generator task (ref: num_returns="streaming" →
+    # ObjectRefGenerator): yielded items are sealed one by one as
+    # stream-indexed objects; the single return slot carries the final
+    # item count.
+    streaming: bool = False
+    # KV key of the submitting job's runtime env ("" = none): workers
+    # apply the referenced env before executing (ref: per-job runtime_env
+    # propagated through the task spec).
+    runtime_env_key: str = ""
     resources: ResourceSet = field(default_factory=ResourceSet)
     name: str = ""
     max_retries: int = 0

@@ -55,6 +55,28 @@ class Worker:
         reader.start()
         self._main_loop()
 
+    def _apply_runtime_env(self, meta_key: str):
+        """Apply the env a task's spec references (job-scoped key, so
+        concurrent jobs don't cross-contaminate; "" = task has no env =
+        zero overhead). Idempotent per key."""
+        if not meta_key or getattr(self, "_renv_key", None) == meta_key:
+            return
+        try:
+            from . import runtime_env as renv
+
+            if renv.apply_in_worker(
+                self.runtime.kv_get,
+                os.environ.get("RAY_TPU_SESSION_DIR", "."),
+                meta_key,
+            ):
+                self._renv_key = meta_key
+                # Nested submissions from this task carry the same env.
+                self.runtime.runtime_env_key = meta_key
+        except Exception as e:  # noqa: BLE001 — env failure must be loud
+            self._renv_key = meta_key  # don't loop a broken env per task
+            print(f"ray_tpu worker: runtime_env setup failed: {e!r}",
+                  file=sys.stderr)
+
     def _reader_loop(self):
         try:
             while self._alive:
@@ -93,14 +115,22 @@ class Worker:
                 )
                 continue
             self._run_task(spec, msg.get("function_blob"))
-        # Flush refcounts before exit so the head's accounting stays sane.
+        # Flush refcounts + user metrics before exit (os._exit skips
+        # atexit, and the head's accounting must stay sane).
         try:
             self.runtime.refs.flush()
+        except Exception:
+            pass
+        try:
+            from ..util.metrics import _registry
+
+            _registry.flush()
         except Exception:
             pass
         os._exit(0)
 
     def _run_task(self, spec: TaskSpec, function_blob):
+        self._apply_runtime_env(spec.runtime_env_key)
         rt = self.runtime
         cache: FunctionCache = rt.function_cache
         if function_blob is not None:
@@ -137,12 +167,36 @@ class Worker:
         def store_large(oid: ObjectID, sobj: SerializedObject) -> Location:
             return rt.store.put_serialized(oid, sobj)
 
+        def stream_item(index: int, value):
+            """Seal one streamed yield + publish its KV progress record
+            (see core/streaming.py for the protocol)."""
+            import cloudpickle
+
+            from .executor import _STREAM_END
+            from .serialization import serialize as _ser
+            from .streaming import stream_item_id, stream_key
+
+            key = stream_key(spec.task_id, index)
+            if value is _STREAM_END:
+                rt.kv_put(key, cloudpickle.dumps({"end": index}))
+                return
+            oid = stream_item_id(spec.task_id, index)
+            loc = rt.store.put_serialized(oid, _ser(value))
+            # Seal with one pinned ref (consumed by the reader's adopt) —
+            # unless a prior attempt of this task (retry) already pinned
+            # this index, in which case re-sealing must not double-pin.
+            refs = 0 if rt.kv_get(key) is not None else 1
+            self.conn.send({"type": "put", "object_id": oid, "loc": loc,
+                            "refs": refs})
+            rt.kv_put(key, cloudpickle.dumps({"oid": oid.hex()}))
+
         rt.current_task_id = spec.task_id
         if spec.task_type in (TaskType.ACTOR_CREATION_TASK, TaskType.ACTOR_TASK):
             rt.current_actor_id = spec.actor_id
         try:
             results, failed = execute_task(
-                spec, load_function, fetch, store_large, self.actor
+                spec, load_function, fetch, store_large, self.actor,
+                stream_item=stream_item if spec.streaming else None,
             )
         finally:
             rt.current_task_id = None

@@ -1,0 +1,76 @@
+"""Streaming generator tests (ref analogue:
+python/ray/tests/test_streaming_generator.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_streaming_generator_basic(ray_tpu_start):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    refs = list(gen.remote(5))
+    assert [ray_tpu.get(r) for r in refs] == [0, 10, 20, 30, 40]
+
+
+def test_streaming_yields_before_completion(ray_tpu_start):
+    """Items are consumable while the producer is still running."""
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    ray_tpu.get(warm.remote())
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        for i in range(4):
+            time.sleep(0.3)
+            yield i
+
+    t0 = time.monotonic()
+    stamps = []
+    for ref in slow_gen.remote():
+        stamps.append(time.monotonic() - t0)
+        ray_tpu.get(ref)
+    # First item arrives ~0.3s in, not at the ~1.2s completion.
+    assert stamps[0] < stamps[-1] - 0.5, stamps
+
+
+def test_streaming_generator_error_propagates(ray_tpu_start):
+    @ray_tpu.remote(num_returns="streaming")
+    def bad():
+        yield 1
+        raise ValueError("stream broke")
+
+    vals = []
+    with pytest.raises(ValueError, match="stream broke"):
+        for r in bad.remote():
+            vals.append(ray_tpu.get(r))
+    assert vals == [1]
+
+
+def test_streaming_actor_method(ray_tpu_start):
+    @ray_tpu.remote
+    class Producer:
+        def chunks(self, n):
+            for i in range(n):
+                yield {"chunk": i}
+
+    p = Producer.remote()
+    gen = p.chunks.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r)["chunk"] for r in gen] == [0, 1, 2]
+
+
+def test_streaming_empty_generator(ray_tpu_start):
+    @ray_tpu.remote(num_returns="streaming")
+    def empty():
+        if False:
+            yield 1
+
+    assert list(empty.remote()) == []
