@@ -34,6 +34,7 @@ def init(
     resources: Optional[Dict[str, float]] = None,
     object_store_memory: Optional[int] = None,
     system_config: Optional[Dict[str, Any]] = None,
+    runtime_env: Optional[Dict[str, Any]] = None,
     ignore_reinit_error: bool = False,
 ) -> "DriverRuntime":
     """Start the runtime: head mode (no address) starts an in-process
@@ -100,6 +101,12 @@ def init(
     nm.start()
     rt = DriverRuntime(nm, job_id=JobID.from_random())
     runtime_context.set_runtime(rt)
+    if runtime_env:
+        from . import runtime_env as renv_mod
+
+        rt.runtime_env_key = renv_mod.publish(
+            runtime_env, rt.kv_put, rt.job_id.hex()
+        )
     if config.log_to_driver:
         from .log_monitor import LogMonitor
 

@@ -1,0 +1,126 @@
+"""Runtime environments.
+
+Ref analogue: python/ray/_private/runtime_env/ (working_dir.py packaging
+— zip upload through the GCS, per-worker download/extract — plus env-var
+injection). Job-level scope: ``ray_tpu.init(runtime_env={...})`` applies
+to every worker of the job; supported keys:
+
+- ``working_dir``: a local directory zipped (size-capped like the
+  reference's 100 MiB default) and stored in the cluster KV; every worker
+  extracts it into its session dir, chdirs into it, and prepends it to
+  sys.path — so multi-node workers import the user's local modules even
+  though cloudpickle only captures the entry function.
+- ``env_vars``: dict injected into every worker's os.environ.
+- ``py_modules``: list of local module directories, each shipped like
+  working_dir and added to sys.path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from typing import Any, Dict, Optional
+
+KV_META = "__runtime_env__/meta/{}"  # .format(job_id hex)
+KV_PKG = "__runtime_env__/pkg/{}"
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024  # ref: RAY_RUNTIME_ENV max size
+
+_EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules"}
+
+
+def _zip_dir(path: str, arc_prefix: str = "") -> bytes:
+    """``arc_prefix`` nests entries under a directory inside the archive —
+    py_modules need ``<pkg>/__init__.py`` (importable by package name once
+    the extract dir is on sys.path), while working_dir extracts flat."""
+    path = os.path.abspath(path)
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for name in files:
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, path)
+                if arc_prefix:
+                    rel = os.path.join(arc_prefix, rel)
+                total += os.path.getsize(full)
+                if total > MAX_PACKAGE_BYTES:
+                    raise ValueError(
+                        f"runtime_env working_dir exceeds "
+                        f"{MAX_PACKAGE_BYTES >> 20} MiB"
+                    )
+                zf.write(full, rel)
+    return buf.getvalue()
+
+
+def publish(runtime_env: Dict[str, Any], kv_put, job_id: str) -> str:
+    """Driver side: package + upload through the cluster KV under a
+    JOB-scoped key (concurrent drivers on one cluster must not
+    cross-contaminate envs). Returns the meta key, which travels on every
+    TaskSpec this job submits."""
+    import cloudpickle
+
+    meta: Dict[str, Any] = {"env_vars": dict(runtime_env.get("env_vars",
+                                                             {}))}
+    pkgs = []
+    dirs = []
+    if runtime_env.get("working_dir"):
+        dirs.append(("working_dir", runtime_env["working_dir"]))
+    for mod in runtime_env.get("py_modules", []) or []:
+        dirs.append(("py_module", mod))
+    for kind, path in dirs:
+        name = os.path.basename(os.path.abspath(path))
+        blob = _zip_dir(path, arc_prefix=name if kind == "py_module"
+                        else "")
+        digest = hashlib.sha1(blob).hexdigest()[:16]
+        kv_put(KV_PKG.format(digest), blob)
+        pkgs.append({"kind": kind, "digest": digest,
+                     "name": os.path.basename(os.path.abspath(path))})
+    meta["packages"] = pkgs
+    key = KV_META.format(job_id)
+    kv_put(key, cloudpickle.dumps(meta))
+    return key
+
+
+def apply_in_worker(kv_get, session_dir: str, meta_key: str) -> bool:
+    """Worker side: download/extract packages, set env vars, fix cwd and
+    sys.path. Idempotent per digest (shared extract dir per node).
+    Returns True once the referenced env was applied."""
+    import cloudpickle
+
+    blob = kv_get(meta_key)
+    if blob is None:
+        return False
+    meta = cloudpickle.loads(blob)
+    for k, v in meta.get("env_vars", {}).items():
+        os.environ[str(k)] = str(v)
+    workdir: Optional[str] = None
+    for pkg in meta.get("packages", []):
+        dest = os.path.join(session_dir, "runtime_env", pkg["digest"])
+        if not os.path.isdir(dest):
+            data = kv_get(KV_PKG.format(pkg["digest"]))
+            if data is None:
+                continue
+            tmp = dest + f".tmp{os.getpid()}"
+            os.makedirs(tmp, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(data)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.rename(tmp, dest)
+            except OSError:
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)  # raced: other won
+        if pkg["kind"] == "working_dir":
+            workdir = dest
+        if dest not in sys.path:
+            sys.path.insert(0, dest)
+    if workdir is not None:
+        try:
+            os.chdir(workdir)
+        except OSError:
+            pass
+    return True
