@@ -15,10 +15,15 @@ from ray_tpu.core.scheduling_strategies import (  # noqa: F401
     NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
+from . import metrics  # noqa: F401
 from . import state  # noqa: F401
+from .actor_pool import ActorPool  # noqa: F401
+from . import queue  # noqa: F401
 
 __all__ = [
     "state",
+    "ActorPool",
+    "queue",
     "PlacementGroup",
     "placement_group",
     "placement_group_table",

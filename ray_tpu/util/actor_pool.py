@@ -1,0 +1,115 @@
+"""ActorPool.
+
+Ref analogue: python/ray/util/actor_pool.py ActorPool — schedule work
+over a fixed set of actors, yielding results in submission order
+(``map``) or completion order (``map_unordered``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        if not actors:
+            raise ValueError("ActorPool needs at least one actor")
+        self._idle: List[Any] = list(actors)
+        # ref-id -> (actor, submission index)
+        self._inflight = {}
+        self._index_to_ref = {}
+        self._next_submit = 0
+        self._next_return = 0
+
+    # ---- submission --------------------------------------------------------
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) must return an ObjectRef, e.g.
+        ``pool.submit(lambda a, v: a.double.remote(v), 1)``."""
+        if not self._idle:
+            raise RuntimeError("no idle actor; call get_next* first")
+        actor = self._idle.pop()
+        ref = fn(actor, value)
+        self._inflight[ref.id()] = (ref, actor, self._next_submit)
+        self._index_to_ref[self._next_submit] = ref
+        self._next_submit += 1
+
+    def has_next(self) -> bool:
+        return bool(self._inflight)
+
+    # ---- retrieval ---------------------------------------------------------
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in SUBMISSION order."""
+        import ray_tpu
+
+        idx = self._next_return
+        ref = self._index_to_ref.get(idx)
+        if ref is None:
+            raise RuntimeError("no pending results")
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next timed out")
+        return self._finish(ref.id())
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Next result in COMPLETION order."""
+        import ray_tpu
+
+        if not self._inflight:
+            raise RuntimeError("no pending results")
+        refs = [entry[0] for entry in self._inflight.values()]
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        return self._finish(ready[0].id())
+
+    def _finish(self, ref_id) -> Any:
+        import ray_tpu
+
+        ref, actor, idx = self._inflight.pop(ref_id)
+        self._index_to_ref.pop(idx, None)
+        if idx == self._next_return:
+            while self._next_return not in self._index_to_ref and \
+                    self._next_return < self._next_submit:
+                self._next_return += 1
+        self._idle.append(actor)
+        return ray_tpu.get(ref)
+
+    # ---- bulk maps ---------------------------------------------------------
+
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterator[Any]:
+        """Results in submission order, streaming as actors free up."""
+        values = iter(values)
+        exhausted = False
+        while True:
+            while not exhausted and self.has_free():
+                try:
+                    self.submit(fn, next(values))
+                except StopIteration:
+                    exhausted = True
+            if not self.has_next():
+                if exhausted:
+                    return
+                continue
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]) -> Iterator[Any]:
+        values = iter(values)
+        exhausted = False
+        while True:
+            while not exhausted and self.has_free():
+                try:
+                    self.submit(fn, next(values))
+                except StopIteration:
+                    exhausted = True
+            if not self.has_next():
+                if exhausted:
+                    return
+                continue
+            yield self.get_next_unordered()

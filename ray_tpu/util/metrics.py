@@ -1,0 +1,182 @@
+"""User-defined metrics.
+
+Ref analogue: python/ray/util/metrics.py (Counter/Gauge/Histogram) over
+the metrics agent pipeline (src/ray/stats/) — here each process batches
+its metric values and flushes them to the cluster KV under
+``__metrics__/<process>``; ``get_metrics_report()`` aggregates across
+every process for dashboards/tests (the Prometheus exposition layer can
+read the same table).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import cloudpickle
+
+FLUSH_INTERVAL_S = 0.5
+KV_PREFIX = "__metrics__/"
+
+
+class _Registry:
+    def __init__(self):
+        self.lock = threading.Lock()
+        # name -> ("counter"|"gauge"|"histogram", {tags_key: value})
+        self.metrics: Dict[str, Tuple[str, Dict]] = {}
+        self._flusher: Optional[threading.Thread] = None
+        self._dirty = False
+
+    def ensure_flusher(self):
+        if self._flusher is None:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, daemon=True
+            )
+            self._flusher.start()
+            atexit.register(self.flush)
+
+    def record(self, name: str, kind: str, tags_key: tuple, update):
+        with self.lock:
+            kind_, series = self.metrics.setdefault(name, (kind, {}))
+            series[tags_key] = update(series.get(tags_key))
+            self._dirty = True
+        self.ensure_flusher()
+
+    def _flush_loop(self):
+        while True:
+            time.sleep(FLUSH_INTERVAL_S)
+            try:
+                self.flush()
+            except Exception:
+                pass
+
+    def flush(self):
+        from ..core import runtime_context
+
+        rt = runtime_context.current_runtime_or_none()
+        if rt is None:
+            return
+        with self.lock:
+            if not self._dirty:
+                return
+            self._dirty = False
+            snapshot = {
+                name: (kind, dict(series))
+                for name, (kind, series) in self.metrics.items()
+            }
+        rt.kv_put(
+            f"{KV_PREFIX}{os.getpid()}",
+            cloudpickle.dumps(snapshot),
+        )
+
+
+_registry = _Registry()
+
+
+class _Metric:
+    KIND = ""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return tuple(sorted(merged.items()))
+
+
+class Counter(_Metric):
+    KIND = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        _registry.record(
+            self._name, self.KIND, self._key(tags),
+            lambda cur: (cur or 0.0) + value,
+        )
+
+
+class Gauge(_Metric):
+    KIND = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        _registry.record(
+            self._name, self.KIND, self._key(tags), lambda cur: value
+        )
+
+
+class Histogram(_Metric):
+    KIND = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = sorted(boundaries or
+                                  [0.01, 0.1, 1.0, 10.0, 100.0])
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        bounds = self._boundaries
+
+        def update(cur):
+            cur = cur or {"count": 0, "sum": 0.0,
+                          "buckets": [0] * (len(bounds) + 1)}
+            cur["count"] += 1
+            cur["sum"] += value
+            for i, b in enumerate(bounds):
+                if value <= b:
+                    cur["buckets"][i] += 1
+                    break
+            else:
+                cur["buckets"][-1] += 1
+            return cur
+
+        _registry.record(self._name, self.KIND, self._key(tags), update)
+
+
+def get_metrics_report() -> Dict[str, Dict]:
+    """Aggregate every process's flushed metrics (ref analogue: scraping
+    the metrics agents). Counters/histograms sum across processes; gauges
+    keep the latest non-None value per tag set."""
+    from ..core import runtime_context
+
+    rt = runtime_context.current_runtime()
+    _registry.flush()
+    out: Dict[str, Dict] = {}
+    for key in rt.kv_keys(KV_PREFIX):
+        blob = rt.kv_get(key)
+        if blob is None:
+            continue
+        snapshot = cloudpickle.loads(blob)
+        for name, (kind, series) in snapshot.items():
+            entry = out.setdefault(name, {"type": kind, "series": {}})
+            for tags_key, value in series.items():
+                cur = entry["series"].get(tags_key)
+                if kind == "counter":
+                    entry["series"][tags_key] = (cur or 0.0) + value
+                elif kind == "gauge":
+                    entry["series"][tags_key] = value
+                else:  # histogram
+                    if cur is None:
+                        entry["series"][tags_key] = dict(value)
+                    else:
+                        cur["count"] += value["count"]
+                        cur["sum"] += value["sum"]
+                        cur["buckets"] = [
+                            a + b for a, b in zip(cur["buckets"],
+                                                  value["buckets"])
+                        ]
+    return out

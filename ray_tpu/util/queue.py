@@ -1,0 +1,135 @@
+"""Distributed FIFO queue.
+
+Ref analogue: python/ray/util/queue.py Queue — an actor-backed queue
+usable from any worker or the driver. Blocking put/get poll the actor
+(the actor itself never blocks, so one queue serves many producers and
+consumers without stalling its event loop).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._maxsize = maxsize
+        self._items: deque = deque()
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def put(self, item) -> bool:
+        if self._maxsize > 0 and len(self._items) >= self._maxsize:
+            return False
+        self._items.append(item)
+        return True
+
+    def put_batch(self, items: List[Any]) -> int:
+        n = 0
+        for item in items:
+            if not self.put(item):
+                break
+            n += 1
+        return n
+
+    def get(self):
+        if not self._items:
+            return False, None
+        return True, self._items.popleft()
+
+    def get_batch(self, max_items: int):
+        out = []
+        while self._items and len(out) < max_items:
+            out.append(self._items.popleft())
+        return out
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict]
+                 = None):
+        import ray_tpu
+
+        opts = actor_options or {}
+        cls = ray_tpu.remote(**opts)(_QueueActor) if opts else \
+            ray_tpu.remote(_QueueActor)
+        self._actor = cls.remote(maxsize)
+        self._maxsize = maxsize
+
+    def qsize(self) -> int:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self._maxsize > 0 and self.qsize() >= self._maxsize
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        import ray_tpu
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self._actor.put.remote(item)):
+                return
+            if not block:
+                raise Full
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full
+            time.sleep(0.01)
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        import ray_tpu
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self._actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty
+            time.sleep(0.01)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def put_batch(self, items: List[Any]) -> None:
+        import ray_tpu
+
+        remaining = list(items)
+        while remaining:
+            n = ray_tpu.get(self._actor.put_batch.remote(remaining))
+            remaining = remaining[n:]
+            if remaining:
+                time.sleep(0.01)
+
+    def get_batch(self, max_items: int) -> List[Any]:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.get_batch.remote(max_items))
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(self._actor)
+        except Exception:
+            pass

@@ -1,0 +1,184 @@
+"""util extras: ActorPool, Queue, metrics, runtime_env (ref analogue:
+python/ray/tests/test_actor_pool.py, test_queue.py, test_metrics_agent.py,
+test_runtime_env_working_dir.py)."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+def test_actor_pool_ordered_and_unordered(ray_tpu_start):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return x * 2
+
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    assert list(pool.map(lambda a, v: a.double.remote(v), range(8))) == \
+        [0, 2, 4, 6, 8, 10, 12, 14]
+    out = sorted(pool.map_unordered(
+        lambda a, v: a.double.remote(v), range(8)
+    ))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_actor_pool_submit_get_next(ray_tpu_start):
+    @ray_tpu.remote
+    class Echo:
+        def echo(self, x):
+            return x
+
+    pool = ActorPool([Echo.remote() for _ in range(2)])
+    pool.submit(lambda a, v: a.echo.remote(v), "a")
+    pool.submit(lambda a, v: a.echo.remote(v), "b")
+    assert pool.get_next() == "a"
+    assert pool.get_next() == "b"
+    assert not pool.has_next()
+
+
+def test_queue_fifo_and_limits(ray_tpu_start):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+
+
+def test_queue_cross_actor(ray_tpu_start):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return "done"
+
+    ref = producer.remote(q, 5)
+    got = [q.get(timeout=30) for _ in range(5)]
+    assert got == [0, 1, 2, 3, 4]
+    assert ray_tpu.get(ref) == "done"
+
+
+def test_metrics_counter_gauge_histogram(ray_tpu_start):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("requests_total", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    g = metrics.Gauge("replicas")
+    g.set(3.0)
+    h = metrics.Histogram("latency_s", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(5.0)
+
+    # Metrics recorded inside workers aggregate with the driver's.
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.util import metrics as m
+
+        m.Counter("requests_total", tag_keys=("route",)).inc(
+            5.0, tags={"route": "/a"}
+        )
+        m._registry.flush()
+        return 1
+
+    ray_tpu.get(work.remote())
+    report = metrics.get_metrics_report()
+    series = report["requests_total"]["series"]
+    assert series[(("route", "/a"),)] == 8.0
+    assert report["replicas"]["series"][()] == 3.0
+    hist = report["latency_s"]["series"][()]
+    assert hist["count"] == 2 and hist["buckets"][0] == 1 \
+        and hist["buckets"][-1] == 1
+
+
+def test_runtime_env_working_dir_and_env_vars(tmp_path):
+    """Workers import modules from the shipped working_dir and see the
+    injected env vars (ref: runtime_env working_dir packaging)."""
+    pkg = tmp_path / "proj"
+    pkg.mkdir()
+    (pkg / "mylib.py").write_text(
+        "MAGIC = 'runtime-env-works'\n"
+        "def compute():\n"
+        "    return MAGIC\n"
+    )
+    ray_tpu.init(
+        num_cpus=2,
+        runtime_env={
+            "working_dir": str(pkg),
+            "env_vars": {"MY_RUNTIME_FLAG": "on"},
+        },
+    )
+    try:
+        @ray_tpu.remote
+        def use_lib():
+            import os
+
+            import mylib  # resolvable only via the shipped working_dir
+
+            return mylib.compute(), os.environ.get("MY_RUNTIME_FLAG")
+
+        value, flag = ray_tpu.get(use_lib.remote())
+        assert value == "runtime-env-works"
+        assert flag == "on"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_runtime_env_py_modules(tmp_path):
+    """py_modules ship as importable packages (import <name> works in
+    workers)."""
+    pkg = tmp_path / "shippedpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("from .core import VALUE\n")
+    (pkg / "core.py").write_text("VALUE = 'py-modules-ok'\n")
+    ray_tpu.init(num_cpus=2,
+                 runtime_env={"py_modules": [str(pkg)]})
+    try:
+        @ray_tpu.remote
+        def use_pkg():
+            import shippedpkg
+
+            return shippedpkg.VALUE
+
+        assert ray_tpu.get(use_pkg.remote()) == "py-modules-ok"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_runtime_env_reaches_prestarted_workers(tmp_path):
+    """Workers prestarted before the driver published the env still apply
+    it at first task execution."""
+    pkg = tmp_path / "lateenv"
+    pkg.mkdir()
+    (pkg / "latelib.py").write_text("OK = 'late-apply'\n")
+    ray_tpu.init(
+        num_cpus=2,
+        system_config={"num_prestart_workers": 2},
+        runtime_env={"working_dir": str(pkg)},
+    )
+    try:
+        import time
+
+        time.sleep(1.0)  # let prestarted workers boot
+
+        @ray_tpu.remote
+        def use_late():
+            import latelib
+
+            return latelib.OK
+
+        assert ray_tpu.get(use_late.remote()) == "late-apply"
+    finally:
+        ray_tpu.shutdown()
