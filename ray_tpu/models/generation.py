@@ -53,8 +53,11 @@ def _attend_cached(q, ck, cv, q_pos, lengths, cfg):
     return jnp.einsum("bhst,bthd->bshd", probs, v)
 
 
-def _layer_cached(cfg, lp, x, cache_k, cache_v, start_pos, q_pos):
-    """One block over cached KV. x [B,S,M]; start_pos [B] write offset."""
+def _layer_cached(cfg, lp, x, cache_k, cache_v, start_pos, q_pos,
+                  active=None):
+    """One block over cached KV. x [B,S,M]; start_pos [B] write offset;
+    ``active`` [B] masks rows out of MoE routing (inactive decode slots
+    must not claim expert capacity)."""
     B, S, M = x.shape
     h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
     q = jnp.einsum("bsm,mhd->bshd", h, lp["wq"])
@@ -79,6 +82,24 @@ def _layer_cached(cfg, lp, x, cache_k, cache_v, start_pos, q_pos):
                           start_pos + S, cfg)
     x = x + jnp.einsum("bshd,hdm->bsm", attn.astype(x.dtype), lp["wo"])
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    if cfg.n_experts > 0:
+        # MoE cached decode: the same static-capacity expert dispatch as
+        # training (parallel/moe.py); the aux load-balancing loss is a
+        # training-only term and is discarded here.
+        from ..parallel.moe import moe_ffn
+
+        token_mask = None
+        if active is not None:
+            token_mask = jnp.broadcast_to(
+                active[:, None], h.shape[:2]
+            )
+        out, _aux = moe_ffn(
+            h, lp["router"], lp["w_up"], lp["w_down"],
+            k=cfg.top_k, capacity_factor=cfg.capacity_factor,
+            w_gate=lp["w_gate"], token_mask=token_mask,
+        )
+        x = x + out
+        return x, cache_k, cache_v
     up = jnp.einsum("bsm,mf->bsf", h, lp["w_up"])
     gate = jnp.einsum("bsm,mf->bsf", h, lp["w_gate"])
     h2 = jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up
@@ -93,13 +114,18 @@ def forward_with_cache(
     cfg: LlamaConfig,
     *,
     active: Optional[jax.Array] = None,  # [B] bool — rows to update
+    last_index: Optional[jax.Array] = None,  # [B] logits position override
+    append_len: Optional[jax.Array] = None,  # [B] real (unpadded) length
 ) -> Tuple[jax.Array, KVCache]:
     """Append ``tokens`` to each slot's sequence and return logits for the
     final appended position [B, V] plus the updated cache. Works for both
-    prefill (S = prompt length, lengths 0) and decode (S = 1)."""
+    prefill (S = prompt length, lengths 0) and decode (S = 1).
+
+    ``last_index``/``append_len`` support BUCKETED prefill: tokens padded
+    to a bucket length S still produce logits at the true final position
+    and advance each slot's length by its true prompt length (padded cache
+    rows beyond the length are never attended — masking is by length)."""
     B, S = tokens.shape
-    if cfg.n_experts > 0:
-        raise NotImplementedError("cached decode for MoE lands later")
     start = cache.lengths
     q_pos = start[:, None] + jnp.arange(S)[None, :]
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -107,17 +133,22 @@ def forward_with_cache(
     def body(carry, layer_in):
         x = carry
         lp, ck, cv = layer_in
-        x, ck, cv = _layer_cached(cfg, lp, x, ck, cv, start, q_pos)
+        x, ck, cv = _layer_cached(cfg, lp, x, ck, cv, start, q_pos,
+                                  active=active)
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache.k, cache.v)
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    last = x[:, -1]
+    if last_index is None:
+        last = x[:, -1]
+    else:
+        last = x[jnp.arange(B), last_index]
     logits = jnp.einsum("bm,mv->bv", last, params["lm_head"])
     active = jnp.ones((B,), bool) if active is None else active
-    lengths = jnp.where(active, cache.lengths + S, cache.lengths)
+    advance = append_len if append_len is not None else S
+    lengths = jnp.where(active, cache.lengths + advance, cache.lengths)
     keep = active[:, None, None, None]
     new_k = jnp.where(keep[None], new_k, cache.k)
     new_v = jnp.where(keep[None], new_v, cache.v)

@@ -22,15 +22,22 @@ def top_k_routing(
     router_logits: jax.Array,  # [tokens, E]
     k: int,
     capacity: int,
+    token_mask: Optional[jax.Array] = None,  # [T] 1=route, 0=ignore
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Compute dispatch/combine tensors for top-k token→expert routing with
     per-expert capacity. Returns (dispatch [T,E,C] bool-ish, combine
-    [T,E,C] float weights, aux_loss scalar: Switch load-balancing loss)."""
+    [T,E,C] float weights, aux_loss scalar: Switch load-balancing loss).
+
+    ``token_mask`` removes tokens from routing entirely — they claim no
+    expert capacity and produce zero output (the decode-engine case:
+    inactive batch slots must not steal capacity from live requests)."""
     T, E = router_logits.shape
     probs = jax.nn.softmax(router_logits, axis=-1)
     gate_vals, expert_idx = jax.lax.top_k(probs, k)            # [T,k]
     # One-hot per choice: [k, T, E]
     onehot = jax.nn.one_hot(expert_idx.T, E, dtype=jnp.float32)
+    if token_mask is not None:
+        onehot = onehot * token_mask.astype(jnp.float32)[None, :, None]
     # Position of each token within its expert's queue, counted over the
     # flattened (choice-major, then token) order so earlier choices win.
     flat = onehot.reshape(k * T, E)
@@ -60,6 +67,7 @@ def moe_ffn(
     capacity_factor: float = 1.25,
     w_gate: Optional[jax.Array] = None,  # [E, M, F] for gated (SwiGLU) experts
     activation=jax.nn.silu,
+    token_mask: Optional[jax.Array] = None,  # [B, S] 1=route, 0=ignore
 ) -> Tuple[jax.Array, jax.Array]:
     """Expert-parallel FFN block (Mixtral-style when w_gate given).
     Returns (output [B,S,M], aux_loss)."""
@@ -71,7 +79,11 @@ def moe_ffn(
     router_logits = jnp.einsum(
         "tm,me->te", xt.astype(jnp.float32), router_w.astype(jnp.float32)
     )
-    dispatch, combine, aux = top_k_routing(router_logits, k, capacity)
+    dispatch, combine, aux = top_k_routing(
+        router_logits, k, capacity,
+        token_mask=(token_mask.reshape(T) if token_mask is not None
+                    else None),
+    )
     # Dispatch tokens to expert buffers: [E, C, M]; "expert" shards over ep.
     expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(x.dtype), xt)
     expert_in = with_logical_constraint(expert_in, ("expert", None, None))
