@@ -1,0 +1,137 @@
+"""Lazy task/actor DAG API.
+
+Ref analogue: python/ray/dag/ (FunctionNode/ClassNode/InputNode,
+``fn.bind()`` building the graph, ``dag.execute()`` walking it). Nodes
+bind other nodes as arguments; ``execute`` submits the whole graph as
+tasks wired by ObjectRefs — intermediate results never touch the driver,
+and independent branches run concurrently (the scheduler sees the whole
+frontier at submission time).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    """Base: a lazily-bound computation with DAGNode-typed arguments."""
+
+    def __init__(self, args: Tuple, kwargs: Dict[str, Any]):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- building ----------------------------------------------------------
+
+    def _children(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    # -- execution ---------------------------------------------------------
+
+    def execute(self, *input_args, **input_kwargs):
+        """Run the DAG rooted here; returns the root's ObjectRef (or
+        actor handle for a ClassNode root)."""
+        cache: Dict[int, Any] = {}
+        input_val = input_args[0] if len(input_args) == 1 else (
+            input_args if input_args else None
+        )
+        return self._execute_node(cache, input_val, input_kwargs)
+
+    def _resolve_args(self, cache, input_val, input_kwargs):
+        def resolve(a):
+            if isinstance(a, DAGNode):
+                return a._execute_node(cache, input_val, input_kwargs)
+            return a
+
+        args = tuple(resolve(a) for a in self._bound_args)
+        kwargs = {k: resolve(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_node(self, cache, input_val, input_kwargs):
+        key = id(self)
+        if key not in cache:
+            cache[key] = self._execute_impl(cache, input_val, input_kwargs)
+        return cache[key]
+
+    def _execute_impl(self, cache, input_val, input_kwargs):
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for execute()-time input (ref: dag/input_node.py).
+    Usable as a context manager for parity with the reference:
+
+        with InputNode() as inp:
+            dag = f.bind(inp)
+        dag.execute(5)
+    """
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def _execute_impl(self, cache, input_val, input_kwargs):
+        return input_val
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_function, args, kwargs):
+        super().__init__(args, kwargs)
+        self._fn = remote_function
+
+    def _execute_impl(self, cache, input_val, input_kwargs):
+        args, kwargs = self._resolve_args(cache, input_val, input_kwargs)
+        return self._fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """A bound actor constructor; attribute access yields method nodes."""
+
+    def __init__(self, actor_class, args, kwargs):
+        super().__init__(args, kwargs)
+        self._actor_class = actor_class
+        self._lock = threading.Lock()
+
+    def __getattr__(self, name: str) -> "_ClassMethodBinder":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClassMethodBinder(self, name)
+
+    def _execute_impl(self, cache, input_val, input_kwargs):
+        args, kwargs = self._resolve_args(cache, input_val, input_kwargs)
+        return self._actor_class.remote(*args, **kwargs)
+
+
+class _ClassMethodBinder:
+    def __init__(self, class_node: ClassNode, method: str):
+        self._class_node = class_node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._class_node = class_node
+        self._method = method
+
+    def _execute_impl(self, cache, input_val, input_kwargs):
+        handle = self._class_node._execute_node(
+            cache, input_val, input_kwargs
+        )
+        args, kwargs = self._resolve_args(cache, input_val, input_kwargs)
+        return getattr(handle, self._method).remote(*args, **kwargs)
+
+
+MultiOutputNode = tuple  # reference-API alias: wrap roots in a tuple

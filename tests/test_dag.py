@@ -1,0 +1,59 @@
+"""DAG API tests (ref analogue: python/ray/dag/tests/)."""
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+def test_function_dag_diamond(ray_tpu_start):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        d = double.bind(inp)
+        i = inc.bind(inp)
+        dag = add.bind(d, i)
+    assert ray_tpu.get(dag.execute(10)) == 20 + 11
+    # Re-executable with different inputs.
+    assert ray_tpu.get(dag.execute(1)) == 2 + 2
+
+
+def test_shared_node_executes_once(ray_tpu_start):
+    import numpy as np
+
+    @ray_tpu.remote
+    def noisy():
+        return np.random.RandomState().randint(1 << 30)
+
+    @ray_tpu.remote
+    def pair(a, b):
+        return (a, b)
+
+    shared = noisy.bind()
+    dag = pair.bind(shared, shared)
+    a, b = ray_tpu.get(dag.execute())
+    assert a == b  # one execution, result reused
+
+
+def test_actor_dag(ray_tpu_start):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    with InputNode() as inp:
+        counter = Counter.bind(100)
+        dag = counter.add.bind(inp)
+    assert ray_tpu.get(dag.execute(5)) == 105
