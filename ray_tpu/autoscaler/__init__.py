@@ -1,0 +1,5 @@
+"""ray_tpu.autoscaler: demand-driven cluster scaling (ref analogue:
+python/ray/autoscaler/)."""
+
+from .autoscaler import Autoscaler, AutoscalerConfig  # noqa: F401
+from .node_provider import LocalNodeProvider, NodeProvider  # noqa: F401

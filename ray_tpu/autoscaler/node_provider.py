@@ -1,0 +1,89 @@
+"""Node providers: how the autoscaler obtains and releases hosts.
+
+Ref analogue: python/ray/autoscaler/node_provider.py NodeProvider (the
+cloud-agnostic interface) and _private/fake_multi_node/node_provider.py
+(nodes as local subprocesses — the testing provider). A TPU-pod provider
+implements the same three calls against the GCE TPU API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provider surface (ref: NodeProvider.create_node /
+    terminate_node / non_terminated_nodes)."""
+
+    def create_node(self, resources: Dict[str, float],
+                    labels: Optional[Dict[str, str]] = None) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Launches worker nodes as ``node_main`` subprocesses on this machine
+    (the reference's fake_multi_node pattern — also exactly what a
+    single-host TPU VM needs)."""
+
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    def create_node(self, resources: Dict[str, float],
+                    labels: Optional[Dict[str, str]] = None) -> str:
+        session_dir = os.path.join(
+            tempfile.gettempdir(), "ray_tpu",
+            f"autoscaled-{int(time.time())}-{uuid.uuid4().hex[:8]}",
+        )
+        os.makedirs(session_dir, exist_ok=True)
+        env = dict(os.environ)
+        env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
+        env["RAY_TPU_SESSION_DIR"] = session_dir
+        env["RAY_TPU_RESOURCES"] = json.dumps(resources)
+        env["RAY_TPU_NODE_LABELS"] = json.dumps(labels or {})
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        pp = env.get("PYTHONPATH", "")
+        if pkg_root not in pp.split(os.pathsep):
+            env["PYTHONPATH"] = pkg_root + (
+                os.pathsep + pp if pp else ""
+            )
+        log = open(os.path.join(session_dir, "node.log"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_main"],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        node_id = f"local-{proc.pid}"
+        self._procs[node_id] = proc
+        return node_id
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        proc = self._procs.pop(provider_node_id, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [
+            nid for nid, p in self._procs.items() if p.poll() is None
+        ]
+
+    def shutdown(self) -> None:
+        for nid in list(self._procs):
+            self.terminate_node(nid)

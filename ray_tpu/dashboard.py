@@ -1,0 +1,135 @@
+"""Cluster dashboard.
+
+Ref analogue: the dashboard/ package (dashboard.py + modules serving the
+state/metrics APIs to the UI). One stdlib HTTP server in the driver/head
+process: ``/api/*`` endpoints return the live state API tables as JSON;
+``/`` renders a self-refreshing overview page. No build step, no
+dependencies — the data layer is the same fan-out state query the CLI and
+``ray_tpu.util.state`` use.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+_server: Optional[ThreadingHTTPServer] = None
+_thread: Optional[threading.Thread] = None
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: monospace; margin: 2em; background: #fafafa; }
+ h1 { font-size: 1.2em; } h2 { font-size: 1em; margin-top: 1.5em; }
+ table { border-collapse: collapse; }
+ td, th { border: 1px solid #ccc; padding: 4px 8px; text-align: left; }
+ th { background: #eee; }
+</style></head>
+<body>
+<h1>ray_tpu cluster</h1>
+<div id="content">loading…</div>
+<script>
+async function refresh() {
+  const [nodes, tasks, actors, objects] = await Promise.all([
+    fetch('/api/nodes').then(r => r.json()),
+    fetch('/api/summary/tasks').then(r => r.json()),
+    fetch('/api/summary/actors').then(r => r.json()),
+    fetch('/api/summary/objects').then(r => r.json()),
+  ]);
+  let html = '<h2>nodes</h2><table><tr><th>id</th><th>alive</th>' +
+             '<th>host</th><th>resources</th><th>labels</th></tr>';
+  for (const n of nodes) {
+    html += `<tr><td>${n.NodeID.slice(0,8)}</td><td>${n.Alive}</td>` +
+            `<td>${n.Host||''}</td>` +
+            `<td>${JSON.stringify(n.Resources)}</td>` +
+            `<td>${JSON.stringify(n.Labels||{})}</td></tr>`;
+  }
+  html += '</table><h2>tasks by state</h2><pre>' +
+          JSON.stringify(tasks, null, 1) + '</pre>' +
+          '<h2>actors by state</h2><pre>' +
+          JSON.stringify(actors, null, 1) + '</pre>' +
+          '<h2>objects</h2><pre>' +
+          JSON.stringify(objects, null, 1) + '</pre>';
+  document.getElementById('content').innerHTML = html;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _json(self, payload: Any, code: int = 200):
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — stdlib API
+        from .util import metrics, state
+
+        try:
+            path = self.path.split("?")[0].rstrip("/")
+            if path in ("", "/index.html"):
+                body = _PAGE.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            routes = {
+                "/api/nodes": state.list_nodes,
+                "/api/tasks": state.list_tasks,
+                "/api/actors": state.list_actors,
+                "/api/objects": state.list_objects,
+                "/api/workers": state.list_workers,
+                "/api/summary/tasks": state.summarize_tasks,
+                "/api/summary/actors": state.summarize_actors,
+                "/api/summary/objects": state.summarize_objects,
+            }
+            if path == "/api/metrics":
+                report = metrics.get_metrics_report()
+                self._json({
+                    name: {
+                        "type": m["type"],
+                        "series": {
+                            json.dumps(dict(k)): v
+                            for k, v in m["series"].items()
+                        },
+                    }
+                    for name, m in report.items()
+                })
+                return
+            fn = routes.get(path)
+            if fn is None:
+                self._json({"error": f"unknown path {path}"}, 404)
+                return
+            self._json(fn())
+        except Exception as e:  # noqa: BLE001
+            self._json({"error": repr(e)}, 500)
+
+
+def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:
+    """Start the dashboard server; returns the bound port (ref: the
+    dashboard agent on :8265)."""
+    global _server, _thread
+    if _server is not None:
+        return _server.server_address[1]
+    _server = ThreadingHTTPServer((host, port), _Handler)
+    _thread = threading.Thread(target=_server.serve_forever, daemon=True)
+    _thread.start()
+    return _server.server_address[1]
+
+
+def stop_dashboard() -> None:
+    global _server, _thread
+    if _server is not None:
+        _server.shutdown()
+        _server = None
+        _thread = None

@@ -170,3 +170,37 @@ def test_cli_cluster_lifecycle(tmp_path):
             head.wait(timeout=10)
         except subprocess.TimeoutExpired:
             head.kill()
+
+
+def test_dashboard_serves_state(ray_tpu_start):
+    """The dashboard's JSON API mirrors the state API (ref: dashboard
+    modules)."""
+    import urllib.request
+
+    from ray_tpu import dashboard
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return 1
+
+    p = Pinger.remote()
+    ray_tpu.get(p.ping.remote())
+    port = dashboard.start_dashboard(port=0)
+    try:
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+                return json.loads(r.read())
+
+        nodes = fetch("/api/nodes")
+        assert nodes and nodes[0]["Alive"]
+        actors = fetch("/api/actors")
+        assert any(a["class_name"] == "Pinger" for a in actors)
+        summary = fetch("/api/summary/actors")
+        assert summary.get("alive", 0) >= 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=30) as r:
+            assert b"ray_tpu cluster" in r.read()
+    finally:
+        dashboard.stop_dashboard()
