@@ -6,6 +6,13 @@ Checkpoint, ScalingConfig/RunConfig/CheckpointConfig/FailureConfig, Result.
 """
 
 from .checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from .gbdt import GBDTTrainer  # noqa: F401
+from .predictor import (  # noqa: F401
+    BatchPredictor,
+    GBDTPredictor,
+    JaxPredictor,
+    Predictor,
+)
 from .config import (  # noqa: F401
     CheckpointConfig,
     FailureConfig,

@@ -1,0 +1,85 @@
+"""Gradient-boosted tree trainers.
+
+Ref analogue: python/ray/train/xgboost/xgboost_trainer.py +
+lightgbm_trainer.py (the AIR GBDT family). The boosting engine here is
+sklearn's histogram GBDT (xgboost isn't in the TPU image); the framework
+contract is identical: datasets flow in as ray_tpu Datasets, training
+runs in a remote worker, the fitted model ships back as a checkpoint
+usable by ``GBDTPredictor`` / ``BatchPredictor``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+from .checkpoint import Checkpoint, default_storage_path
+from .config import Result, RunConfig, ScalingConfig
+
+MODEL_FILE = "model.pkl"
+
+
+def _fit_gbdt(columns: Dict[str, Any], label_column: str, params: Dict,
+              objective: str, storage_dir: str) -> Dict[str, Any]:
+    """Runs in a remote worker: assemble the matrix, fit, checkpoint."""
+    import numpy as np
+
+    y = np.asarray(columns.pop(label_column))
+    feature_names = sorted(columns)
+    X = np.column_stack([np.asarray(columns[c]) for c in feature_names])
+    if objective == "classification":
+        from sklearn.ensemble import HistGradientBoostingClassifier
+
+        model = HistGradientBoostingClassifier(**params)
+    else:
+        from sklearn.ensemble import HistGradientBoostingRegressor
+
+        model = HistGradientBoostingRegressor(**params)
+    model.fit(X, y)
+    score = float(model.score(X, y))
+    os.makedirs(storage_dir, exist_ok=True)
+    ckpt_dir = os.path.join(storage_dir, "gbdt_checkpoint")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    with open(os.path.join(ckpt_dir, MODEL_FILE), "wb") as f:
+        pickle.dump(
+            {"model": model, "features": feature_names,
+             "label": label_column}, f
+        )
+    return {"train_score": score, "checkpoint_dir": ckpt_dir,
+            "num_rows": int(len(y))}
+
+
+class GBDTTrainer:
+    """Fit a boosted-tree model on a Dataset (ref: XGBoostTrainer API)."""
+
+    def __init__(self, *, datasets: Dict[str, Any], label_column: str,
+                 params: Optional[Dict[str, Any]] = None,
+                 objective: str = "classification",
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        if "train" not in datasets:
+            raise ValueError("datasets must include a 'train' Dataset")
+        self._datasets = datasets
+        self.label_column = label_column
+        self.params = dict(params or {})
+        self.objective = objective
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        import ray_tpu
+
+        storage = self.run_config.storage_path or default_storage_path(
+            self.run_config.name
+        )
+        columns = self._datasets["train"].to_numpy()
+        fit_remote = ray_tpu.remote(_fit_gbdt)
+        metrics = ray_tpu.get(
+            fit_remote.remote(
+                columns, self.label_column, self.params, self.objective,
+                storage,
+            )
+        )
+        ckpt = Checkpoint(metrics.pop("checkpoint_dir"))
+        return Result(metrics=metrics, checkpoint=ckpt,
+                      metrics_history=[metrics])

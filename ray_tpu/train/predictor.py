@@ -1,0 +1,116 @@
+"""Predictors + distributed batch inference.
+
+Ref analogue: python/ray/train/predictor.py Predictor +
+batch_predictor.py BatchPredictor (retired upstream into
+Dataset.map_batches — both surfaces exist here). A Predictor restores a
+model from a Checkpoint and scores numpy batches; BatchPredictor fans it
+out over a Dataset through the actor-pool map operator, so model loading
+happens once per pool member, not per block.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from .checkpoint import Checkpoint
+
+
+class Predictor:
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, **kw) -> "Predictor":
+        raise NotImplementedError
+
+    def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class GBDTPredictor(Predictor):
+    """Scores with a GBDTTrainer checkpoint (ref: XGBoostPredictor)."""
+
+    def __init__(self, model, features, label):
+        self._model = model
+        self._features = features
+        self._label = label
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint,
+                        **kw) -> "GBDTPredictor":
+        from .gbdt import MODEL_FILE
+
+        with open(os.path.join(checkpoint.path, MODEL_FILE), "rb") as f:
+            payload = pickle.load(f)
+        return cls(payload["model"], payload["features"],
+                   payload["label"])
+
+    def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        X = np.column_stack(
+            [np.asarray(batch[c]) for c in self._features]
+        )
+        return {"predictions": self._model.predict(X)}
+
+
+class JaxPredictor(Predictor):
+    """Scores with a jax apply fn + params pytree restored from an orbax
+    checkpoint (ref: TorchPredictor with the framework swapped)."""
+
+    def __init__(self, params, apply_fn: Callable,
+                 input_column: str = "x"):
+        self._params = params
+        self._apply = apply_fn
+        self._col = input_column
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, *,
+                        apply_fn: Callable, example_tree: Any = None,
+                        input_column: str = "x") -> "JaxPredictor":
+        params = checkpoint.as_pytree(example_tree)
+        return cls(params, apply_fn, input_column)
+
+    def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        out = self._apply(self._params, jnp.asarray(batch[self._col]))
+        return {"predictions": np.asarray(out)}
+
+
+class _PredictorWorker:
+    """Actor-pool member: one restored predictor per process."""
+
+    def __init__(self, predictor_cls_blob: bytes, checkpoint_path: str,
+                 from_ckpt_kwargs: Dict[str, Any]):
+        import cloudpickle
+
+        predictor_cls = cloudpickle.loads(predictor_cls_blob)
+        self._predictor = predictor_cls.from_checkpoint(
+            Checkpoint(checkpoint_path), **from_ckpt_kwargs
+        )
+
+    def __call__(self, batch):
+        return self._predictor.predict(batch)
+
+
+class BatchPredictor:
+    """Distributed inference over a Dataset (ref: batch_predictor.py)."""
+
+    def __init__(self, checkpoint: Checkpoint, predictor_cls,
+                 **from_ckpt_kwargs):
+        self._checkpoint = checkpoint
+        self._predictor_cls = predictor_cls
+        self._kwargs = from_ckpt_kwargs
+
+    def predict(self, dataset, *, concurrency: int = 2,
+                batch_size: Optional[int] = None):
+        import cloudpickle
+
+        blob = cloudpickle.dumps(self._predictor_cls)
+        return dataset.map_batches(
+            _PredictorWorker,
+            concurrency=concurrency,
+            batch_size=batch_size,
+            fn_constructor_args=(blob, self._checkpoint.path,
+                                 self._kwargs),
+        )

@@ -1,0 +1,56 @@
+"""GBDT trainer + predictor/batch-inference tests (ref analogue:
+python/ray/train/tests/test_xgboost_trainer.py + test_batch_predictor)."""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.train import BatchPredictor, GBDTPredictor, GBDTTrainer
+from ray_tpu.train.config import RunConfig
+
+
+def _make_ds(n=400, seed=0):
+    rs = np.random.RandomState(seed)
+    x0 = rs.randn(n)
+    x1 = rs.randn(n)
+    y = ((x0 + 0.5 * x1) > 0).astype(np.int64)
+    return rd.from_items(
+        [{"x0": float(x0[i]), "x1": float(x1[i]), "label": int(y[i])}
+         for i in range(n)],
+        override_num_blocks=4,
+    )
+
+
+def test_gbdt_train_and_predict(ray_tpu_start, tmp_path):
+    ds = _make_ds()
+    trainer = GBDTTrainer(
+        datasets={"train": ds},
+        label_column="label",
+        params={"max_iter": 30},
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.metrics["train_score"] > 0.9
+    assert result.checkpoint is not None
+
+    predictor = GBDTPredictor.from_checkpoint(result.checkpoint)
+    batch = {"x0": np.asarray([2.0, -2.0]), "x1": np.asarray([0.0, 0.0])}
+    preds = predictor.predict(batch)["predictions"]
+    assert list(preds) == [1, 0]
+
+
+def test_batch_predictor_over_dataset(ray_tpu_start, tmp_path):
+    ds = _make_ds()
+    result = GBDTTrainer(
+        datasets={"train": ds},
+        label_column="label",
+        params={"max_iter": 20},
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+
+    bp = BatchPredictor(result.checkpoint, GBDTPredictor)
+    scored = bp.predict(ds.drop_columns(["label"]), concurrency=2)
+    preds = scored.to_numpy()["predictions"]
+    truth = ds.to_numpy()
+    acc = (preds == ((truth["x0"] + 0.5 * truth["x1"]) > 0)).mean()
+    assert acc > 0.9
