@@ -18,6 +18,12 @@ from .schedulers import (  # noqa: F401
     PopulationBasedTraining,
     TrialScheduler,
 )
+from .search import (  # noqa: F401
+    BasicVariantGenerator,
+    BayesOptSearch,
+    ConcurrencyLimiter,
+    Searcher,
+)
 from .search_space import (  # noqa: F401
     choice,
     generate_variants,

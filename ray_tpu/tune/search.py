@@ -1,0 +1,206 @@
+"""Search algorithms.
+
+Ref analogue: python/ray/tune/search/ — Searcher (searcher.py),
+BasicVariantGenerator (basic_variant.py), BayesOptSearch
+(bayesopt/bayesopt_search.py), ConcurrencyLimiter. Searchers SUGGEST
+configs one at a time as trial slots free up and learn from completed
+results — unlike the static variant grid, the sample budget is spent
+where the metric surface looks promising.
+"""
+
+from __future__ import annotations
+
+import math
+import random as _random
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .search_space import Domain, GridSearch
+
+
+class Searcher:
+    """Base interface (ref: tune/search/searcher.py)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        self._space = config
+        return True
+
+
+class BasicVariantGenerator(Searcher):
+    """Random/grid sampling as a Searcher (ref: basic_variant.py)."""
+
+    def __init__(self, space: Dict[str, Any], *, seed: int = 0,
+                 metric: Optional[str] = None, mode: str = "max"):
+        super().__init__(metric, mode)
+        self.space = space
+        self._rng = np.random.RandomState(seed)
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        out = {}
+        for key, spec in self.space.items():
+            if isinstance(spec, GridSearch):
+                out[key] = spec.values[
+                    self._rng.randint(len(spec.values))
+                ]
+            elif isinstance(spec, Domain):
+                out[key] = spec.sample(self._rng)
+            else:
+                out[key] = spec
+        return out
+
+
+class BayesOptSearch(Searcher):
+    """Gaussian-process expected-improvement search over NUMERIC domains
+    (ref: bayesopt_search.py; the GP backend is sklearn instead of the
+    bayesian-optimization package). Non-numeric keys fall back to random
+    sampling."""
+
+    def __init__(self, space: Dict[str, Any], *, metric: str,
+                 mode: str = "max", n_initial: int = 5, seed: int = 0,
+                 n_candidates: int = 256):
+        super().__init__(metric, mode)
+        self.space = space
+        self.n_initial = n_initial
+        self.n_candidates = n_candidates
+        self._rng = np.random.RandomState(seed)
+        self._py_rng = _random.Random(seed)
+        # Numeric keys (uniform/loguniform/randint/quniform) become GP
+        # dimensions scaled to [0, 1]; everything else samples randomly.
+        self._dims: List[str] = []
+        self._bounds: Dict[str, tuple] = {}
+        for key, spec in space.items():
+            lo = getattr(spec, "low", None)
+            hi = getattr(spec, "high", None)
+            if lo is not None and hi is not None:
+                self._dims.append(key)
+                log = type(spec).__name__ == "LogUniform"
+                self._bounds[key] = (float(lo), float(hi), log)
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+        self._pending: Dict[str, List[float]] = {}
+
+    # -- unit-cube transforms --
+
+    def _to_unit(self, key: str, v: float) -> float:
+        lo, hi, log = self._bounds[key]
+        if log:
+            return (math.log(v) - math.log(lo)) / (
+                math.log(hi) - math.log(lo)
+            )
+        return (v - lo) / (hi - lo)
+
+    def _from_unit(self, key: str, u: float):
+        lo, hi, log = self._bounds[key]
+        if log:
+            v = math.exp(
+                math.log(lo) + u * (math.log(hi) - math.log(lo))
+            )
+        else:
+            v = lo + u * (hi - lo)
+        spec = self.space[key]
+        if type(spec).__name__ == "RandInt":
+            v = int(round(v))
+            v = min(max(v, int(lo)), int(hi) - 1)
+        return v
+
+    def _random_config(self) -> Dict[str, Any]:
+        out = {}
+        for key, spec in self.space.items():
+            if isinstance(spec, Domain):
+                out[key] = spec.sample(self._rng)
+            elif isinstance(spec, GridSearch):
+                out[key] = spec.values[
+                    self._rng.randint(len(spec.values))
+                ]
+            else:
+                out[key] = spec
+        return out
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        if len(self._y) < self.n_initial or not self._dims:
+            config = self._random_config()
+        else:
+            config = self._suggest_gp()
+        self._pending[trial_id] = [
+            self._to_unit(k, config[k]) for k in self._dims
+        ]
+        return config
+
+    def _suggest_gp(self) -> Dict[str, Any]:
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import Matern
+
+        X = np.asarray(self._X)
+        y = np.asarray(self._y)
+        y_std = y.std() or 1.0
+        gp = GaussianProcessRegressor(
+            kernel=Matern(nu=2.5), normalize_y=True,
+            alpha=1e-6, random_state=self._rng,
+        )
+        gp.fit(X, (y - y.mean()) / y_std)
+        cand = self._rng.rand(self.n_candidates, len(self._dims))
+        mu, sigma = gp.predict(cand, return_std=True)
+        best = ((y - y.mean()) / y_std).max()
+        sigma = np.maximum(sigma, 1e-9)
+        z = (mu - best) / sigma
+        from scipy.stats import norm  # scipy ships with sklearn's deps
+
+        ei = (mu - best) * norm.cdf(z) + sigma * norm.pdf(z)
+        u = cand[int(np.argmax(ei))]
+        config = self._random_config()  # non-GP keys sampled randomly
+        for i, key in enumerate(self._dims):
+            config[key] = self._from_unit(key, float(u[i]))
+        return config
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        x = self._pending.pop(trial_id, None)
+        if error or x is None or result is None:
+            return
+        val = result.get(self.metric)
+        if val is None:
+            return
+        val = float(val) if self.mode == "max" else -float(val)
+        self._X.append(x)
+        self._y.append(val)
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap in-flight suggestions (ref: ConcurrencyLimiter)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._live) >= self.max_concurrent:
+            return None
+        config = self.searcher.suggest(trial_id)
+        if config is not None:
+            self._live.add(trial_id)
+        return config
+
+    def on_trial_complete(self, trial_id: str, result=None,
+                          error: bool = False) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

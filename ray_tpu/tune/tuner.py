@@ -37,6 +37,10 @@ class TuneConfig:
     metric: Optional[str] = None
     mode: str = "max"
     scheduler: Optional[TrialScheduler] = None
+    # Iterative search algorithm (tune/search.py); when set, configs are
+    # SUGGESTED one at a time as slots free (learning from completions)
+    # instead of pre-generated from param_space.
+    search_alg: Optional[Any] = None
     max_concurrent_trials: Optional[int] = None
     search_seed: int = 0
 
@@ -288,8 +292,24 @@ class Tuner:
         storage = self.run_config.storage_path or default_storage_path(
             self.run_config.name
         )
-        trials = getattr(self, "_restored_trials", None) or \
-            self._make_trials()
+        search_alg = tc.search_alg
+        restored = getattr(self, "_restored_trials", None)
+        if search_alg is not None:
+            if search_alg.metric is None:
+                search_alg.metric = tc.metric
+                search_alg.mode = tc.mode
+            # A restored searcher experiment keeps its prior trials: the
+            # searcher re-learns from their results, finished ones stay in
+            # the grid, and only the remaining sample budget is suggested.
+            trials = list(restored) if restored else []
+            for t in trials:
+                if t.state in ("done", "stopped", "error") and t.history:
+                    search_alg.on_trial_complete(
+                        t.trial_id, t.history[-1],
+                        error=t.state == "error",
+                    )
+        else:
+            trials = restored or self._make_trials()
         fn_blob = cloudpickle.dumps(self._trainable)
         rt = current_runtime()
         max_conc = tc.max_concurrent_trials or max(
@@ -355,11 +375,34 @@ class Tuner:
         pending = list(t for t in trials if t.state == "pending")
         running: List[_Trial] = []
         last_save = 0.0
-        while pending or running:
+        # Restored trials count against the sample budget.
+        suggested = len(trials)
+
+        def spawn_from_searcher():
+            nonlocal suggested
+            while (search_alg is not None and suggested < tc.num_samples
+                   and len(running) < max_conc):
+                tid = f"trial_{suggested:05d}_{uuid.uuid4().hex[:6]}"
+                config = search_alg.suggest(tid)
+                if config is None:
+                    return  # limiter: retry when a slot frees
+                t = _Trial(trial_id=tid, config=config)
+                trials.append(t)
+                by_id[tid] = t
+                suggested += 1
+                launch(t)
+                running.append(t)
+
+        while (pending or running
+               or (search_alg is not None and suggested < tc.num_samples)):
+            spawn_from_searcher()
             while pending and len(running) < max_conc:
                 t = pending.pop(0)
                 launch(t)
                 running.append(t)
+            if not running:
+                time.sleep(0.05)
+                continue
             refs = [t.ref for t in running]
             ray_tpu.wait(refs, num_returns=len(refs), timeout=0.2)
             still_running = []
@@ -369,6 +412,11 @@ class Tuner:
                     scheduler.on_trial_complete(
                         t.trial_id, t.history[-1] if t.history else None
                     )
+                    if search_alg is not None:
+                        search_alg.on_trial_complete(
+                            t.trial_id,
+                            t.history[-1] if t.history else None,
+                        )
                     continue
                 done, _ = ray_tpu.wait([t.ref], num_returns=1, timeout=0)
                 if done:
@@ -384,6 +432,12 @@ class Tuner:
                             t.trial_id,
                             t.history[-1] if t.history else None,
                         )
+                        if search_alg is not None:
+                            search_alg.on_trial_complete(
+                                t.trial_id,
+                                t.history[-1] if t.history else None,
+                                error=t.state == "error",
+                            )
                         try:
                             ray_tpu.kill(t.actor)
                         except Exception:
