@@ -17,6 +17,7 @@ from . import dag  # noqa: F401
 from . import dashboard  # noqa: F401
 from . import job_submission  # noqa: F401
 from . import util  # noqa: F401
+from . import workflow  # noqa: F401
 from .core import (  # noqa: F401
     ActorClass,
     ActorDiedError,
@@ -43,6 +44,7 @@ from .core import (  # noqa: F401
     put,
     remote,
     shutdown,
+    timeline,
     wait,
 )
 from .core import (  # noqa: F401
@@ -69,6 +71,7 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "nodes",
+    "timeline",
     "kv_put",
     "kv_get",
     "ObjectRef",

@@ -1,0 +1,127 @@
+"""Task timeline / profiling events.
+
+Ref analogue: ray.timeline() over the profiling events workers push to
+the GCS (src/ray/core_worker task event buffer → dashboard timeline).
+Each worker buffers (task name, start, end) spans and flushes them to the
+cluster KV; ``ray_tpu.timeline(path)`` merges every worker's spans into
+chrome://tracing format (one row per worker process, durations in µs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+KV_PREFIX = "__timeline__/"
+MAX_EVENTS_PER_WORKER = 10_000
+FLUSH_INTERVAL_S = 0.5
+
+
+class TaskEventBuffer:
+    """Per-process span recorder (ref: TaskEventBuffer)."""
+
+    def __init__(self, node8: str = "local"):
+        self._events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._last_flush = 0.0
+        self._node8 = node8
+        self._timer: Optional[threading.Timer] = None
+
+    def record(self, name: str, start: float, end: float,
+               task_id: str = "") -> None:
+        with self._lock:
+            if len(self._events) >= MAX_EVENTS_PER_WORKER:
+                self._events.pop(0)
+            self._events.append({
+                "name": name,
+                "ts": start,
+                "dur": end - start,
+                "task_id": task_id,
+            })
+        now = time.monotonic()
+        if now - self._last_flush > FLUSH_INTERVAL_S:
+            self._last_flush = now
+            self.flush()
+        else:
+            # Throttled: ensure the tail still lands without another
+            # record() — a deferred one-shot flush.
+            with self._lock:
+                if self._timer is None:
+                    self._timer = threading.Timer(
+                        FLUSH_INTERVAL_S, self._deferred_flush
+                    )
+                    self._timer.daemon = True
+                    self._timer.start()
+
+    def _deferred_flush(self):
+        with self._lock:
+            self._timer = None
+        self._last_flush = time.monotonic()
+        self.flush()
+
+    def flush(self) -> None:
+        from . import runtime_context
+
+        rt = runtime_context.current_runtime_or_none()
+        if rt is None:
+            return
+        with self._lock:
+            events = list(self._events)
+        try:
+            rt.kv_put(
+                f"{KV_PREFIX}{self._node8}/{os.getpid()}",
+                cloudpickle.dumps(events),
+            )
+        except Exception:
+            pass
+
+
+_buffer: Optional[TaskEventBuffer] = None
+
+
+def get_buffer() -> TaskEventBuffer:
+    global _buffer
+    if _buffer is None:
+        # Scope the KV key by node id: pids collide across hosts, and the
+        # chrome trace groups rows by node.
+        from . import runtime_context
+
+        rt = runtime_context.current_runtime_or_none()
+        node8 = rt.node_id.hex()[:8] if rt is not None else "local"
+        _buffer = TaskEventBuffer(node8)
+    return _buffer
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Collect every worker's task spans as chrome-trace events; write to
+    ``filename`` if given (open in chrome://tracing / perfetto). Returns
+    the event list (ref: ray.timeline)."""
+    from . import runtime_context
+
+    rt = runtime_context.current_runtime()
+    get_buffer().flush()
+    trace: List[Dict[str, Any]] = []
+    for key in rt.kv_keys(KV_PREFIX):
+        blob = rt.kv_get(key)
+        if blob is None:
+            continue
+        _, node8, pid = key.rsplit("/", 2)
+        for ev in cloudpickle.loads(blob):
+            trace.append({
+                "name": ev["name"],
+                "ph": "X",  # complete event
+                "ts": ev["ts"] * 1e6,
+                "dur": ev["dur"] * 1e6,
+                "pid": f"node:{node8}",
+                "tid": f"worker:{pid}",
+                "args": {"task_id": ev.get("task_id", "")},
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -100,14 +100,30 @@ class Worker:
             if msg is None:
                 break
             spec = msg["spec"]
-            if spec.task_type == TaskType.ACTOR_CREATION_TASK and \
-                    spec.max_concurrency > 1:
-                from concurrent.futures import ThreadPoolExecutor
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                concurrency = spec.max_concurrency
+                if concurrency <= 1:
+                    # Async actor classes default to high concurrency
+                    # (ref: async actors' max_concurrency=1000 default) —
+                    # awaiting calls park on the actor's event loop.
+                    try:
+                        fn_blob = msg.get("function_blob")
+                        cache = self.runtime.function_cache
+                        if fn_blob is not None:
+                            cache.add_blob(spec.function_id, fn_blob)
+                        if cache.has(spec.function_id):
+                            cls = cache.load(spec.function_id)
+                            if ActorContainer.class_is_async(cls):
+                                concurrency = 100
+                    except Exception:
+                        pass
+                if concurrency > 1:
+                    from concurrent.futures import ThreadPoolExecutor
 
-                self._pool = ThreadPoolExecutor(
-                    max_workers=spec.max_concurrency,
-                    thread_name_prefix="actor-concurrency",
-                )
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=concurrency,
+                        thread_name_prefix="actor-concurrency",
+                    )
             if self._pool is not None and \
                     spec.task_type == TaskType.ACTOR_TASK:
                 self._pool.submit(
@@ -193,6 +209,9 @@ class Worker:
         rt.current_task_id = spec.task_id
         if spec.task_type in (TaskType.ACTOR_CREATION_TASK, TaskType.ACTOR_TASK):
             rt.current_actor_id = spec.actor_id
+        import time as _time
+
+        _t0 = _time.time()
         try:
             results, failed = execute_task(
                 spec, load_function, fetch, store_large, self.actor,
@@ -200,6 +219,15 @@ class Worker:
             )
         finally:
             rt.current_task_id = None
+            try:
+                from .timeline import get_buffer
+
+                get_buffer().record(
+                    spec.name or spec.method_name or "task",
+                    _t0, _time.time(), spec.task_id.hex(),
+                )
+            except Exception:
+                pass
         self.conn.send(
             {
                 "type": "task_done",
