@@ -10,7 +10,6 @@ frontier at submission time).
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 
@@ -99,7 +98,6 @@ class ClassNode(DAGNode):
     def __init__(self, actor_class, args, kwargs):
         super().__init__(args, kwargs)
         self._actor_class = actor_class
-        self._lock = threading.Lock()
 
     def __getattr__(self, name: str) -> "_ClassMethodBinder":
         if name.startswith("_"):
@@ -125,6 +123,11 @@ class ClassMethodNode(DAGNode):
         super().__init__(args, kwargs)
         self._class_node = class_node
         self._method = method
+
+    def _children(self) -> List["DAGNode"]:
+        # The bound actor is a dependency too (graph walkers — e.g. the
+        # workflow step order — must visit it).
+        return [self._class_node] + super()._children()
 
     def _execute_impl(self, cache, input_val, input_kwargs):
         handle = self._class_node._execute_node(
