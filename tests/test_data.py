@@ -276,3 +276,57 @@ def test_preprocessors():
                                  "y": np.asarray([3.0]),
                                  "label": np.asarray(["a"])})
     assert one["feat"].shape == (1, 2)
+
+
+def test_groupby_distributed_combiners(ray_tpu_start):
+    """Aggregates run as per-block combiners merged on the driver; the
+    dataset never materializes centrally."""
+    ds = rd.range(1000, override_num_blocks=8).map_batches(
+        lambda b: {"k": b["id"] % 5, "v": b["id"].astype(np.float64)}
+    )
+    out = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    expected = {}
+    for i in range(1000):
+        expected[i % 5] = expected.get(i % 5, 0.0) + float(i)
+    assert out == expected
+    means = {r["k"]: r["mean(v)"]
+             for r in ds.groupby("k").mean("v").take_all()}
+    assert all(abs(means[k] - expected[k] / 200) < 1e-9 for k in means)
+    counts = {r["k"]: r["count()"]
+              for r in ds.groupby("k").count().take_all()}
+    assert all(c == 200 for c in counts.values())
+
+
+def test_map_groups_via_hash_shuffle(ray_tpu_start):
+    ds = rd.range(100, override_num_blocks=5).map_batches(
+        lambda b: {"k": b["id"] % 4, "v": b["id"]}
+    )
+
+    def summarize(group):
+        return {"k": [int(group["k"][0])],
+                "total": [int(group["v"].sum())]}
+
+    out = {r["k"]: r["total"]
+           for r in ds.groupby("k").map_groups(summarize).take_all()}
+    expected = {}
+    for i in range(100):
+        expected[i % 4] = expected.get(i % 4, 0) + i
+    assert out == expected
+
+
+def test_groupby_string_minmax_and_int_sums(ray_tpu_start):
+    ds = rd.from_items(
+        [{"k": i % 2, "name": "abcdef"[i % 6], "v": int(i)}
+         for i in range(60)]
+    )
+    mins = {r["k"]: r["min(name)"]
+            for r in ds.groupby("k").min("name").take_all()}
+    assert mins == {0: "a", 1: "b"}
+    sums = {r["k"]: r["sum(v)"]
+            for r in ds.groupby("k").sum("v").take_all()}
+    assert sums[0] + sums[1] == sum(range(60))
+    assert all(isinstance(v, (int, np.integer)) for v in sums.values())
+    import pytest as _pytest
+
+    with _pytest.raises(TypeError, match="non-numeric"):
+        ds.groupby("k").sum("name").take_all()
