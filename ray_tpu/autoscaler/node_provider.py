@@ -54,6 +54,10 @@ class LocalNodeProvider(NodeProvider):
         env["RAY_TPU_SESSION_DIR"] = session_dir
         env["RAY_TPU_RESOURCES"] = json.dumps(resources)
         env["RAY_TPU_NODE_LABELS"] = json.dumps(labels or {})
+        from ray_tpu.core.config import get_config as _get_config
+
+        if _get_config().session_token:
+            env["RAY_TPU_SESSION_TOKEN"] = _get_config().session_token
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         pp = env.get("PYTHONPATH", "")

@@ -78,6 +78,10 @@ class Cluster:
         env["RAY_TPU_SESSION_DIR"] = session_dir
         env["RAY_TPU_RESOURCES"] = json.dumps(res)
         env["RAY_TPU_NODE_LABELS"] = json.dumps(labels or {})
+        from ray_tpu.core.config import get_config as _get_config
+
+        if _get_config().session_token:
+            env["RAY_TPU_SESSION_TOKEN"] = _get_config().session_token
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         existing_pp = env.get("PYTHONPATH", "")
         if pkg_root not in existing_pp.split(os.pathsep):

@@ -72,6 +72,11 @@ class Config:
     gcs_storage_path: str = ""
     # Bind/advertise IP for this node (ref: --node-ip-address).
     node_ip: str = "127.0.0.1"
+    # Shared secret gating GCS/peer TCP connections (hello frames must
+    # carry it when set; set RAY_TPU_SESSION_TOKEN on every node). The
+    # cross-host framing is pickle: never expose node_ip beyond a trusted
+    # network, token or not (advisor finding r1).
+    session_token: str = ""
     # Echo worker stdout/stderr to the driver with (pid=, node=) prefixes
     # (ref analogue: log_monitor.py + worker log streaming to driver).
     log_to_driver: bool = True

@@ -25,7 +25,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
-from .config import Config
+from .config import Config, get_config
 from .ids import ActorID, NodeID, ObjectID
 from .protocol import AioFramedWriter as _FramedWriter
 from .protocol import aio_read_frame as _read_frame
@@ -242,6 +242,24 @@ class GcsService:
         try:
             hello = await _read_frame(reader)
             if hello.get("type") != "gcs_hello":
+                framed.close()
+                return
+            expected = self.config.session_token
+            if expected and hello.get("token") != expected:
+                import sys
+
+                print(
+                    "ray_tpu gcs: rejected connection with bad session "
+                    "token", file=sys.stderr,
+                )
+                try:
+                    await framed.send(
+                        {"type": "gcs_error",
+                         "error": "bad or missing session token (set "
+                                  "RAY_TPU_SESSION_TOKEN on every node)"}
+                    )
+                except Exception:
+                    pass
                 framed.close()
                 return
             node_id = NodeID.from_hex(hello["node_id"])
@@ -789,9 +807,13 @@ class GcsClient:
         reader, writer = await asyncio.open_connection(self.host, self.port)
         self._writer = _FramedWriter(writer)
         await self._writer.send(
-            {"type": "gcs_hello", "node_id": self.node_id.hex()}
+            {"type": "gcs_hello", "node_id": self.node_id.hex(),
+             "token": get_config().session_token}
         )
         welcome = await _read_frame(reader)
+        if welcome.get("type") == "gcs_error":
+            raise ConnectionError(f"GCS refused connection: "
+                                  f"{welcome.get('error')}")
         assert welcome["type"] == "gcs_welcome", welcome
         self._reader_task = asyncio.ensure_future(self._reader_loop(reader))
 

@@ -704,6 +704,10 @@ class NodeManager:
             if hello.get("type") != "peer_hello":
                 framed.close()
                 return
+            expected = self.config.session_token
+            if expected and hello.get("token") != expected:
+                framed.close()
+                return
             peer_hex = hello["node_id"]
             while True:
                 msg = await aio_read_frame(reader)

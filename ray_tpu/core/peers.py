@@ -36,10 +36,13 @@ class PeerClient:
         ] = None
 
     async def connect(self):
+        from .config import get_config
+
         reader, writer = await asyncio.open_connection(self.host, self.port)
         self._writer = _FramedWriter(writer)
         await self._writer.send(
-            {"type": "peer_hello", "node_id": self.self_hex}
+            {"type": "peer_hello", "node_id": self.self_hex,
+             "token": get_config().session_token}
         )
         self._reader_task = asyncio.ensure_future(self._reader_loop(reader))
 

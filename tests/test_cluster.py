@@ -276,3 +276,43 @@ def test_lineage_chain_reconstruction(cluster):
     time.sleep(0.5)
     out = ray_tpu.get(b, timeout=120)
     assert int(out.sum()) == 300_000
+
+
+def test_session_token_gates_gcs_connections():
+    """With session_token set, hello frames lacking the token are
+    rejected before any pickle payload is processed (advisor r1: the
+    framed-pickle plane must not accept anonymous connections)."""
+    import socket as socklib
+    import struct
+
+    import cloudpickle
+
+    import ray_tpu
+    from ray_tpu.core.runtime_context import current_runtime
+
+    ray_tpu.init(num_cpus=1, system_config={"session_token": "s3cret"})
+    try:
+        host, port = current_runtime()._nm.gcs_service.address
+
+        def hello(token):
+            payload = cloudpickle.dumps(
+                {"type": "gcs_hello", "node_id": "ab" * 16,
+                 **({"token": token} if token else {})},
+                protocol=5,
+            )
+            s = socklib.create_connection((host, port), timeout=5)
+            s.sendall(struct.pack("<I", len(payload)) + payload)
+            s.settimeout(5)
+            try:
+                data = s.recv(4096)
+            finally:
+                s.close()
+            return data
+
+        # Wrong/absent token: an explicit rejection frame, then close.
+        assert b"session token" in hello(None)
+        assert b"session token" in hello("wrong")
+        # Correct token: welcomed.
+        assert b"gcs_welcome" in hello("s3cret")
+    finally:
+        ray_tpu.shutdown()
