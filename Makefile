@@ -29,3 +29,24 @@ native-test: build/rts_store_test
 
 clean:
 	rm -rf build $(EXT)
+
+# Sanitizer builds of the store test (ref analogue: the reference's
+# TSAN/ASAN CI jobs over the C++ core). `make native-tsan native-asan`
+# runs the full store test under each sanitizer.
+build/rts_store_test_tsan: $(STORE_SRC) src/store/rts_store_test.cc src/store/rts_store.h
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -fsanitize=thread -Isrc/store $(STORE_SRC) \
+	  src/store/rts_store_test.cc -o $@ $(LDLIBS)
+
+build/rts_store_test_asan: $(STORE_SRC) src/store/rts_store_test.cc src/store/rts_store.h
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -fsanitize=address,undefined -Isrc/store $(STORE_SRC) \
+	  src/store/rts_store_test.cc -o $@ $(LDLIBS)
+
+native-tsan: build/rts_store_test_tsan
+	TSAN_OPTIONS=halt_on_error=1 ./build/rts_store_test_tsan
+
+native-asan: build/rts_store_test_asan
+	ASAN_OPTIONS=detect_leaks=1 ./build/rts_store_test_asan
+
+sanitize: native-tsan native-asan

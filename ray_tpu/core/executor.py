@@ -75,14 +75,43 @@ def package_results(
 
 
 class ActorContainer:
-    """Holds the live actor instance in an actor worker."""
+    """Holds the live actor instance in an actor worker.
+
+    ASYNC ACTORS (ref analogue: async actors running on a per-actor
+    asyncio loop, core_worker fiber/asyncio execution): a class with any
+    ``async def`` method gets a dedicated event-loop thread; coroutine
+    results run there — concurrent in-flight calls interleave on the
+    loop (the caller-side thread pool just awaits), and instance state
+    stays loop-confined for async methods."""
 
     def __init__(self):
         self.instance = None
         self.cls = None
+        self.is_async = False
+        self._loop = None
+
+    @staticmethod
+    def class_is_async(cls) -> bool:
+        import inspect
+
+        return any(
+            inspect.iscoroutinefunction(v)
+            for v in vars(cls).values()
+        )
 
     def create(self, cls, args, kwargs):
         self.cls = cls
+        self.is_async = self.class_is_async(cls)
+        if self.is_async:
+            import asyncio
+            import threading
+
+            self._loop = asyncio.new_event_loop()
+            t = threading.Thread(
+                target=self._loop.run_forever,
+                name="ray_tpu-actor-asyncio", daemon=True,
+            )
+            t.start()
         self.instance = cls(*args, **kwargs)
 
     def call(self, method_name: str, args, kwargs):
@@ -101,7 +130,18 @@ class ActorContainer:
         if self.instance is None:
             raise RuntimeError("actor instance not created")
         method = getattr(self.instance, method_name)
-        return method(*args, **kwargs)
+        result = method(*args, **kwargs)
+        if self._loop is not None:
+            import asyncio
+            import inspect
+
+            if inspect.iscoroutine(result):
+                # Run on the actor's loop; this (pool) thread just waits,
+                # so other in-flight coroutines interleave.
+                return asyncio.run_coroutine_threadsafe(
+                    result, self._loop
+                ).result()
+        return result
 
 
 def execute_task(
