@@ -204,3 +204,27 @@ def test_dashboard_serves_state(ray_tpu_start):
             assert b"ray_tpu cluster" in r.read()
     finally:
         dashboard.stop_dashboard()
+
+
+def test_timeline_export(ray_tpu_start, tmp_path):
+    """ray_tpu.timeline() exports chrome-trace task spans from every
+    worker (ref: ray.timeline)."""
+    @ray_tpu.remote
+    def traced_work(x):
+        time.sleep(0.05)
+        return x
+
+    ray_tpu.get([traced_work.remote(i) for i in range(4)])
+    out = str(tmp_path / "trace.json")
+    deadline = time.monotonic() + 10
+    events = []
+    while time.monotonic() < deadline:
+        events = ray_tpu.timeline(out)
+        if any(e["name"] == "traced_work" for e in events):
+            break
+        time.sleep(0.2)
+    spans = [e for e in events if e["name"] == "traced_work"]
+    assert len(spans) == 4
+    assert all(e["ph"] == "X" and e["dur"] >= 0.04 * 1e6 for e in spans)
+    with open(out) as f:
+        assert json.load(f)
