@@ -50,6 +50,11 @@ class LlamaConfig:
     top_k: int = 2
     capacity_factor: float = 1.25
     remat: bool = True
+    # "full" (save only layer inputs), "dots" (save matmul outputs,
+    # recompute elementwise), or "save_all" (save every intermediate —
+    # no backward recompute). "dots"/"save_all" trade HBM for less
+    # backward recompute where memory allows.
+    remat_policy: str = "full"
     # Pallas flash attention kernel on TPU (ops/flash_attention.py);
     # automatically the XLA einsum path off-TPU or for odd shapes.
     # Off by default for TRAINING: under remat, the kernel's
@@ -239,8 +244,20 @@ def forward(
     def body(x, lp):
         fn = _layer
         if cfg.remat:
+            policy = None
+            if cfg.remat_policy == "dots":
+                # Save matmul outputs, recompute only the cheap
+                # elementwise work — less backward recompute where HBM
+                # allows (ref analogue: the scaling playbook's selective
+                # rematerialization).
+                policy = jax.checkpoint_policies.checkpoint_dots
+            elif cfg.remat_policy == "save_all":
+                # Save every intermediate (no backward recompute) while
+                # keeping scan-over-layers structure.
+                policy = jax.checkpoint_policies.everything_saveable
             fn = jax.checkpoint(
-                lambda x_, lp_: _layer(cfg, mesh, positions, x_, lp_)
+                lambda x_, lp_: _layer(cfg, mesh, positions, x_, lp_),
+                policy=policy,
             )
             out, aux = fn(x, lp)
         else:

@@ -1,0 +1,188 @@
+"""IMPALA: asynchronous actor-critic with V-trace off-policy correction.
+
+Ref analogue: rllib/algorithms/impala/ (Espeholt et al. 2018). Runners
+sample CONTINUOUSLY — the learner consumes whatever fragments are ready
+each step instead of barriering on every runner — so rollouts lag the
+learner's weights by a step or two; V-trace importance weights (rho/c
+truncation) correct exactly that staleness. Sampling stays on CPU actors,
+the V-trace learner is jax on the accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .sample_batch import ACTIONS, DONES, LOGPS, OBS, REWARDS, SampleBatch
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 6e-4
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.rho_clip: float = 1.0  # V-trace rho-bar
+        self.c_clip: float = 1.0    # V-trace c-bar
+        # Max fragments consumed per training_step (bounds staleness).
+        self.max_batches_per_step: int = 4
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self.copy())
+
+
+class IMPALALearner:
+    """jax V-trace actor-critic learner."""
+
+    def __init__(self, policy, lr: float, gamma: float, rho_clip: float,
+                 c_clip: float, vf_coeff: float, ent_coeff: float):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._tx = optax.adam(lr)
+        self._params = jax.tree.map(jnp.asarray, policy.get_weights())
+        self._opt_state = self._tx.init(self._params)
+
+        def forward(params, obs):
+            h = obs
+            for W, b in params["trunk"]:
+                h = jnp.tanh(h @ W + b)
+            (Wp, bp), = params["pi"]
+            (Wv, bv), = params["vf"]
+            return h @ Wp + bp, (h @ Wv + bv)[..., 0]
+
+        def vtrace(behav_logp, target_logp, rewards, dones, values,
+                   bootstrap):
+            """V-trace targets over one time-major fragment (Espeholt
+            eq. 1): vs = V(x_s) + sum_t gamma^(t-s) * prod(c) * dt_V."""
+            rho = jnp.exp(target_logp - behav_logp)
+            rho_bar = jnp.minimum(rho, rho_clip)
+            c_bar = jnp.minimum(rho, c_clip)
+            discounts = gamma * (1.0 - dones)
+            values_next = jnp.concatenate(
+                [values[1:], bootstrap[None]]
+            )
+            deltas = rho_bar * (
+                rewards + discounts * values_next - values
+            )
+
+            def scan_fn(acc, inp):
+                delta, disc, c = inp
+                acc = delta + disc * c * acc
+                return acc, acc
+
+            _, advs = jax.lax.scan(
+                scan_fn, jnp.zeros_like(bootstrap),
+                (deltas, discounts, c_bar), reverse=True,
+            )
+            vs = values + advs
+            vs_next = jnp.concatenate([vs[1:], bootstrap[None]])
+            pg_adv = rho_bar * (
+                rewards + discounts * vs_next - values
+            )
+            return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+        def loss_fn(params, obs, actions, behav_logp, rewards, dones):
+            logits, values = forward(params, obs)
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, actions[:, None], axis=1
+            )[:, 0]
+            bootstrap = values[-1]
+            vs, pg_adv = vtrace(
+                behav_logp, target_logp, rewards, dones, values, bootstrap
+            )
+            pg_loss = -(target_logp * pg_adv).mean()
+            vf_loss = ((values - vs) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, obs, actions, behav_logp, rewards,
+                   dones):
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, obs, actions, behav_logp, rewards, dones)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            stats["total_loss"] = loss
+            return params, opt_state, stats
+
+        self._update = jax.jit(update)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        self._params, self._opt_state, stats = self._update(
+            self._params,
+            self._opt_state,
+            jnp.asarray(batch[OBS]),
+            jnp.asarray(batch[ACTIONS], dtype=jnp.int32),
+            jnp.asarray(batch[LOGPS]),
+            jnp.asarray(batch[REWARDS]),
+            jnp.asarray(batch[DONES], dtype=jnp.float32),
+        )
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params)
+
+
+class IMPALA(Algorithm):
+    def _build_learner(self, policy):
+        c = self.config
+        learner = IMPALALearner(
+            policy, c.lr, c.gamma, c.rho_clip, c.c_clip,
+            c.vf_loss_coeff, c.entropy_coeff,
+        )
+        # Continuous sampling: every runner always has a fragment in
+        # flight; training_step consumes whatever finished.
+        self._pending = [(r, r.sample.remote()) for r in self.runners]
+        return learner
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        refs = [ref for _, ref in self._pending]
+        ready, _ = ray_tpu.wait(
+            refs, num_returns=1, timeout=30.0
+        )
+        ready_ids = {r.id() for r in ready}
+        stats: Dict[str, float] = {}
+        consumed = 0
+        still = []
+        for runner, ref in self._pending:
+            if ref.id() in ready_ids and consumed < c.max_batches_per_step:
+                batch = ray_tpu.get(ref)
+                stats = self.learner.update(batch)
+                consumed += 1
+                # Ship fresh weights, resubmit the runner immediately:
+                # the lag between these two is what V-trace corrects.
+                runner.set_weights.remote(self.learner.get_weights())
+                still.append((runner, runner.sample.remote()))
+            else:
+                still.append((runner, ref))
+        self._pending = still
+
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_batches_consumed": consumed,
+            **stats,
+        }
+
+    def stop(self):
+        self._pending = []
+        super().stop()

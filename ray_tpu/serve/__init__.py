@@ -8,6 +8,8 @@ from .api import (  # noqa: F401
     deployment,
     details,
     get_deployment_handle,
+    get_multiplexed_model_id,
+    multiplexed,
     run,
     scale,
     shutdown,

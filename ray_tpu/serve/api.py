@@ -19,6 +19,10 @@ from .controller import (
 )
 from .deployment import AutoscalingConfig, Deployment, deployment  # noqa: F401
 from .handle import DeploymentHandle
+from .multiplex import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)
 from . import http_proxy
 
 _controller = None

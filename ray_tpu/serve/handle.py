@@ -65,6 +65,8 @@ class _RouterState:
         # out of controller snapshots until the health checker has had time
         # to remove them server-side (prevents re-routing to a corpse).
         self.dead: Dict[Any, float] = {}
+        # multiplexed model id -> replica key that last served it.
+        self.model_affinity: Dict[str, Any] = {}
         if controller is not None:
             t = threading.Thread(
                 target=_refresh_loop, args=(weakref.ref(self),), daemon=True
@@ -73,7 +75,17 @@ class _RouterState:
 
     # ---- replica selection (power of two choices) -------------------------
 
-    def pick(self):
+    MAX_TRACKED_MODELS = 256
+    # A model spills onto another replica when its current holders are
+    # this many requests deeper than the cluster's least-loaded replica.
+    AFFINITY_SPILL_DEPTH = 2
+
+    def pick(self, model_id: Optional[str] = None):
+        """Power of two choices on local outstanding counts; multiplexed
+        requests prefer replicas that already hold their model (cache
+        affinity) but SPILL onto additional replicas when those are
+        saturated — affinity must not defeat load balancing (ref:
+        model-multiplex-aware request routing)."""
         with self.lock:
             reps = self.replicas
             n = len(reps)
@@ -81,12 +93,39 @@ class _RouterState:
                 raise RuntimeError(
                     f"deployment {self.deployment_name!r} has no replicas"
                 )
-            if n == 1:
-                return reps[0]
-            a, b = random.sample(range(n), 2)
-            da = self.outstanding.get(_replica_key(reps[a]), 0)
-            db = self.outstanding.get(_replica_key(reps[b]), 0)
-            return reps[a] if da <= db else reps[b]
+
+            def depth(r):
+                return self.outstanding.get(_replica_key(r), 0)
+
+            def p2c(cands):
+                if len(cands) == 1:
+                    return cands[0]
+                a, b = random.sample(range(len(cands)), 2)
+                return (cands[a] if depth(cands[a]) <= depth(cands[b])
+                        else cands[b])
+
+            if not model_id:
+                return p2c(reps)
+            live_keys = {_replica_key(r) for r in reps}
+            holders = self.model_affinity.setdefault(model_id, [])
+            holders[:] = [k for k in holders if k in live_keys]
+            holding = [r for r in reps if _replica_key(r) in holders]
+            min_depth = min((depth(r) for r in reps), default=0)
+            if holding and (
+                min(depth(r) for r in holding)
+                <= min_depth + self.AFFINITY_SPILL_DEPTH
+            ):
+                return p2c(holding)
+            # Saturated (or no holder yet): spread onto a new replica.
+            chosen = p2c(reps)
+            k = _replica_key(chosen)
+            if k not in holders:
+                holders.append(k)
+            if len(self.model_affinity) > self.MAX_TRACKED_MODELS:
+                self.model_affinity.pop(
+                    next(iter(self.model_affinity))
+                )
+            return chosen
 
     def begin(self, replica) -> None:
         with self.lock:
@@ -179,16 +218,18 @@ def _refresh_loop(state_ref: "weakref.ref[_RouterState]") -> None:
             time.sleep(0.2)
 
 
-def _route_with_retry(state: _RouterState, submit, deliver, deliver_error):
-    """Shared request path: pick a replica (p2c), submit, deliver the
-    result; on actor death evict + refresh + retry (bounded)."""
+def _route_with_retry(state: _RouterState, submit, deliver, deliver_error,
+                      model_id: Optional[str] = None):
+    """Shared request path: pick a replica (p2c + model affinity),
+    submit, deliver the result; on actor death evict + refresh + retry
+    (bounded)."""
     import ray_tpu
     from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
 
     last_err: Optional[BaseException] = None
     for attempt in range(MAX_DEATH_RETRIES + 1):
         try:
-            replica = state.pick()
+            replica = state.pick(model_id)
         except RuntimeError as e:
             if last_err is not None:
                 # Mid-update empty window: refetch rather than fail.
@@ -258,12 +299,14 @@ class DeploymentHandle:
     def __init__(self, deployment_name: str, replicas: List[Any],
                  *, batch_config: Optional[Dict[str, Any]] = None,
                  method: str = "__call__", controller=None,
-                 route_version: int = 0, _state: Optional[_RouterState] = None):
+                 route_version: int = 0, _state: Optional[_RouterState] = None,
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self._state = _state or _RouterState(
             deployment_name, replicas, controller, route_version
         )
         self._method = method
+        self._model_id = multiplexed_model_id
         self._batch = batch_config
         self._batch_lock = threading.Lock()
         self._pending: Optional[_PendingBatch] = None
@@ -273,13 +316,19 @@ class DeploymentHandle:
 
     # ---- request path ------------------------------------------------------
 
-    def options(self, method: Optional[str] = None) -> "DeploymentHandle":
-        """Clone bound to another method; shares routing + queue-depth
-        state with the parent (one long-poller per handle family)."""
+    def options(self, method: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        """Clone bound to another method / multiplexed model id; shares
+        routing + queue-depth state with the parent (one long-poller per
+        handle family)."""
         return DeploymentHandle(
             self.deployment_name, [],
             batch_config=self._batch, method=method or self._method,
             _state=self._state,
+            multiplexed_model_id=(self._model_id
+                                  if multiplexed_model_id is None
+                                  else multiplexed_model_id),
         )
 
     def remote(self, *args, **kwargs) -> ServeFuture:
@@ -294,13 +343,15 @@ class DeploymentHandle:
         return fut
 
     def _run_with_retry(self, fut: ServeFuture, method, args, kwargs):
+        model_id = self._model_id
         _route_with_retry(
             self._state,
             lambda replica: replica.handle_request.remote(
-                method, args, kwargs
+                method, args, kwargs, model_id
             ),
             fut._set_value,
             fut._set_error,
+            model_id=model_id or None,
         )
 
     # ---- dynamic batching --------------------------------------------------
@@ -334,6 +385,7 @@ class DeploymentHandle:
 
     def _flush(self, batch: _PendingBatch):
         payload = [item for item, _ in batch.items]
+        model_id = self._model_id
 
         def deliver(results):
             for (_, fut), value in zip(batch.items, results):
@@ -348,11 +400,12 @@ class DeploymentHandle:
             args=(
                 self._state,
                 lambda replica: replica.handle_batch.remote(
-                    self._method, payload
+                    self._method, payload, model_id
                 ),
                 deliver,
                 deliver_error,
             ),
+            kwargs={"model_id": model_id or None},
             daemon=True,
         ).start()
 

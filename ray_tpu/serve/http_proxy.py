@@ -51,6 +51,32 @@ class _Handler(BaseHTTPRequestHandler):
         name = self.path.strip("/").split("/")[0]
         handle = _state.routes.get(name)
         if handle is None:
+            # Dynamic discovery: any live deployment is routable without
+            # explicit registration (ref: the proxy's route table pushed
+            # by long-poll — here resolved lazily through the controller
+            # and cached, after which the handle long-polls on its own).
+            # A stray request must never SPAWN a controller, and a
+            # transient controller failure is 503, not 404.
+            import ray_tpu
+
+            from . import api as serve_api
+            from .controller import CONTROLLER_NAME
+
+            try:
+                ray_tpu.get_actor(CONTROLLER_NAME)
+            except ValueError:
+                self._reply(404, {"error": "serve is not running"})
+                return
+            try:
+                handle = serve_api.get_deployment_handle(name)
+                _state.routes[name] = handle
+            except KeyError:
+                self._reply(404, {"error": f"no deployment {name!r}"})
+                return
+            except Exception as e:  # noqa: BLE001
+                self._reply(503, {"error": f"controller error: {e}"})
+                return
+        if handle is None:
             self._reply(404, {"error": f"no deployment {name!r}"})
             return
         length = int(self.headers.get("Content-Length") or 0)
@@ -88,3 +114,52 @@ def stop_proxy():
         _server = None
         _thread = None
     _state.routes.clear()
+
+
+# ---------------------------------------------------------- per-node proxy
+
+class ProxyActor:
+    """One HTTP ingress per node (ref: serve/_private/proxy.py ProxyActor
+    — the reference runs one proxy on every node so any host serves
+    traffic). Runs the same threaded server inside an actor process;
+    routes resolve dynamically through the controller."""
+
+    def __init__(self, port: int = 0):
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def ping(self) -> str:
+        return "ok"
+
+    def shutdown(self) -> str:
+        self._server.shutdown()
+        return "ok"
+
+
+def start_per_node_proxies(port: int = 8000):
+    """Launch one ProxyActor on every alive node (node-affinity pinned);
+    returns {node_id: (actor, port)} (ref: proxies on each node serving
+    the same route table)."""
+    import ray_tpu
+    from ray_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    proxies = {}
+    for node in ray_tpu.nodes():
+        if not node.get("Alive", False):
+            continue
+        nid = node["NodeID"]
+        actor = ray_tpu.remote(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid),
+            max_concurrency=16,
+        )(ProxyActor).remote(port)
+        bound = ray_tpu.get(actor.port.remote())
+        proxies[nid] = (actor, bound)
+    return proxies

@@ -39,22 +39,30 @@ class Replica:
             return getattr(self._callable, method)
         return self._callable
 
-    def handle_request(self, method: str, args: Tuple, kwargs: Dict) -> Any:
+    def handle_request(self, method: str, args: Tuple, kwargs: Dict,
+                       model_id: str = "") -> Any:
+        from .multiplex import _set_model_id
+
         with self._lock:
             self._num_handled += 1
             self._ongoing += 1
+        _set_model_id(model_id)
         try:
             return self._resolve(method)(*args, **kwargs)
         finally:
             with self._lock:
                 self._ongoing -= 1
 
-    def handle_batch(self, method: str, batched_args: List[Tuple]) -> List[Any]:
+    def handle_batch(self, method: str, batched_args: List[Tuple],
+                     model_id: str = "") -> List[Any]:
         """One call per batch: user function receives a list of first
         positional args and must return a list of equal length."""
+        from .multiplex import _set_model_id
+
         with self._lock:
             self._num_handled += len(batched_args)
             self._ongoing += 1
+        _set_model_id(model_id)
         try:
             fn = self._resolve(method)
             items = [a[0][0] if a[0] else None for a in batched_args]

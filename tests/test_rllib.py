@@ -120,3 +120,32 @@ def test_dqn_learns_cartpole(ray_tpu_start):
         assert best > 60, (first, best)
     finally:
         algo.stop()
+
+
+def test_impala_learns_cartpole(ray_tpu_start):
+    pytest.importorskip("gymnasium")
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, rollout_fragment_length=256)
+        .training(lr=2e-3, entropy_coeff=0.02)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        first = None
+        best = 0.0
+        for _ in range(120):
+            result = algo.train()
+            if first is None and result["episodes_total"] > 0:
+                first = result["episode_reward_mean"]
+            best = max(best, result["episode_reward_mean"])
+            if best > 80:
+                break
+        assert first is not None
+        # Random CartPole is ~20 reward; V-trace must clearly improve.
+        assert best > 60, (first, best)
+    finally:
+        algo.stop()

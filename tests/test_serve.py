@@ -238,3 +238,74 @@ def test_replica_crash_recovery(serve_cluster):
     assert serve.status()["crashy"] == 2
     new_pids = {pid_handle.remote().result(timeout=30) for _ in range(20)}
     assert len(new_pids) == 2
+
+
+def test_per_node_proxy_actors(serve_cluster):
+    """One proxy actor per node serves HTTP with dynamic route discovery
+    (ref: per-node ProxyActor): a deployment created AFTER the proxy
+    started is still routable."""
+    import urllib.request
+
+    from ray_tpu.serve import http_proxy
+
+    proxies = http_proxy.start_per_node_proxies(port=0)
+    try:
+        assert len(proxies) >= 1
+
+        @serve.deployment
+        def late(x):
+            return {"via": "proxy-actor", "x": x}
+
+        serve.run(late.bind(), name="late")
+        (_, port), = [v for v in proxies.values()][:1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/late",
+            data=json.dumps(5).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert body == {"result": {"via": "proxy-actor", "x": 5}}
+    finally:
+        import ray_tpu
+
+        for actor, _ in proxies.values():
+            try:
+                ray_tpu.get(actor.shutdown.remote(), timeout=10)
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+
+
+def test_model_multiplexing(serve_cluster):
+    """@serve.multiplexed: one deployment serves many models with
+    per-replica LRU loading and model-affinity routing (ref:
+    serve.multiplexed / get_multiplexed_model_id)."""
+    import os
+
+    @serve.deployment(num_replicas=2)
+    class MultiModel:
+        def __init__(self):
+            self.loads = 0
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def load(self, model_id):
+            self.loads += 1
+            return {"model": model_id, "scale": len(model_id)}
+
+        def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = self.load(model_id)
+            return {"pid": os.getpid(), "model": model["model"],
+                    "y": x * model["scale"], "loads": self.loads}
+
+    handle = serve.run(MultiModel.bind(), name="mux")
+    mA = handle.options(multiplexed_model_id="modelA")
+    out = [mA.remote(2).result(timeout=30) for _ in range(6)]
+    assert all(o["model"] == "modelA" and o["y"] == 12 for o in out)
+    # Affinity: every modelA request landed on ONE replica, which loaded
+    # the model exactly once.
+    assert len({o["pid"] for o in out}) == 1
+    assert out[-1]["loads"] == 1
+    mB = handle.options(multiplexed_model_id="bb")
+    assert mB.remote(3).result(timeout=30)["y"] == 6
