@@ -1,35 +1,61 @@
 """Demand-driven cluster autoscaler.
 
 Ref analogue: python/ray/autoscaler/_private/autoscaler.py
-StandardAutoscaler (:169 update loop) + resource_demand_scheduler: scale
-UP while tasks are queued beyond the cluster's free capacity (sustained
-past ``upscale_delay_s``), scale DOWN worker nodes idle longer than
-``idle_timeout_s``. Demand is read from the GCS load reports every node
-already sends (pending task counts + available resources); nodes come and
-go through a pluggable NodeProvider.
+StandardAutoscaler (:169 update loop) +
+_private/resource_demand_scheduler.py: scale UP by the resource *shapes*
+of queued work (bin-packed against free capacity, then against candidate
+node types), scale DOWN worker nodes idle longer than ``idle_timeout_s``.
+Demand is read from the GCS load reports every node already sends
+(pending task shapes + available resources); nodes come and go through a
+pluggable NodeProvider. Each provider node stamps its id into the node's
+labels (``rtpu-provider-node-id``) so idleness is judged per-node, not
+cluster-wide.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
-from .node_provider import LocalNodeProvider, NodeProvider
+from .node_provider import PROVIDER_NODE_LABEL, LocalNodeProvider, NodeProvider
+
+
+def _fits(shape: Dict[str, float], avail: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= float(q) for k, q in shape.items()
+               if float(q) > 0)
+
+
+def _deduct(shape: Dict[str, float], avail: Dict[str, float]) -> None:
+    for k, q in shape.items():
+        avail[k] = avail.get(k, 0.0) - float(q)
 
 
 class AutoscalerConfig:
+    """``node_types`` maps a type name to ``{"resources": {...},
+    "labels": {...}}`` (ref: available_node_types in the cluster YAML).
+    ``worker_resources`` is shorthand for a single ``"worker"`` type."""
+
     def __init__(self, *, min_workers: int = 0, max_workers: int = 4,
                  worker_resources: Optional[Dict[str, float]] = None,
+                 node_types: Optional[Dict[str, Dict[str, Any]]] = None,
                  upscale_delay_s: float = 1.0,
                  idle_timeout_s: float = 10.0,
                  interval_s: float = 0.5):
         self.min_workers = min_workers
         self.max_workers = max_workers
-        self.worker_resources = worker_resources or {"CPU": 1}
+        if node_types is None:
+            node_types = {
+                "worker": {"resources": worker_resources or {"CPU": 1}},
+            }
+        self.node_types = node_types
         self.upscale_delay_s = upscale_delay_s
         self.idle_timeout_s = idle_timeout_s
         self.interval_s = interval_s
+
+    @property
+    def worker_resources(self) -> Dict[str, float]:
+        return next(iter(self.node_types.values()))["resources"]
 
 
 class Autoscaler:
@@ -53,9 +79,13 @@ class Autoscaler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._pending_since: Optional[float] = None
-        # provider node id -> time it became idle (None = busy)
+        # provider node id -> time its own view became idle
         self._idle_since: Dict[str, float] = {}
-        self._launched: List[str] = []
+        # provider node id -> node-type name, for nodes we launched that
+        # have not registered a cluster view yet (still booting). Their
+        # capacity must count against demand or every reconcile tick
+        # launches another node for the same unmet shape.
+        self._booting: Dict[str, str] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -74,17 +104,76 @@ class Autoscaler:
     def num_workers(self) -> int:
         return len(self.provider.non_terminated_nodes())
 
-    # -- reconcile ----------------------------------------------------------
+    # -- demand -------------------------------------------------------------
 
-    def _demand(self) -> Dict[str, Any]:
-        """Cluster pressure from the node views the GCS gossips."""
-        views = self._rt.nodes()
-        pending = sum(v.get("pending_tasks", 0) for v in views)
-        free_cpu = sum(
-            v.get("resources_available", {}).get("CPU", 0.0)
-            for v in views if v.get("state") == "alive"
-        )
-        return {"pending_tasks": pending, "free_cpu": free_cpu}
+    def _unmet_shapes(self, alive: List[Dict[str, Any]],
+                      extra_capacity: Optional[List[Dict]] = None
+                      ) -> List[Dict]:
+        """Pending task shapes that do NOT fit anywhere in the cluster's
+        current free capacity (ref: resource_demand_scheduler
+        get_bin_pack_residual). ``extra_capacity``: full node shapes of
+        launched-but-unregistered nodes, counted as free."""
+        units: List[Dict[str, float]] = []
+        for v in alive:
+            shapes = v.get("pending_shapes")
+            if shapes:
+                for shape, n in shapes:
+                    units.extend([shape] * int(n))
+            elif v.get("pending_tasks", 0):
+                # Node predates shape reporting: assume 1-CPU units.
+                units.extend([{"CPU": 1.0}] * int(v["pending_tasks"]))
+        if not units:
+            return []
+        avail = [dict(v.get("resources_available") or {}) for v in alive]
+        avail.extend(dict(c) for c in (extra_capacity or []))
+        unmet = []
+        for shape in units:
+            for a in avail:
+                if _fits(shape, a):
+                    _deduct(shape, a)
+                    break
+            else:
+                unmet.append(shape)
+        return unmet
+
+    def _plan_nodes(self, unmet: List[Dict]) -> List[str]:
+        """Greedy-pack unmet shapes into fresh nodes of fitting types;
+        returns the node-type names to launch. Shapes no type can hold are
+        skipped (they are infeasible, not a scaling problem)."""
+        plan: List[str] = []
+        open_nodes: List[Tuple[str, Dict[str, float]]] = []
+        for shape in unmet:
+            placed = False
+            for _, rem in open_nodes:
+                if _fits(shape, rem):
+                    _deduct(shape, rem)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for tname, tcfg in self.config.node_types.items():
+                total = tcfg.get("resources") or {}
+                if _fits(shape, dict(total)):
+                    rem = dict(total)
+                    _deduct(shape, rem)
+                    open_nodes.append((tname, rem))
+                    plan.append(tname)
+                    break
+        return plan
+
+    def _launch(self, type_name: str) -> str:
+        tcfg = self.config.node_types[type_name]
+        labels = dict(tcfg.get("labels") or {})
+        labels.setdefault("rtpu-node-type", type_name)
+        nid = self.provider.create_node(
+            dict(tcfg["resources"]), labels=labels)
+        self._booting[nid] = type_name
+        return nid
+
+    def _default_type(self) -> str:
+        return next(iter(self.config.node_types))
+
+    # -- reconcile ----------------------------------------------------------
 
     def _loop(self) -> None:
         cfg = self.config
@@ -102,49 +191,63 @@ class Autoscaler:
 
         # Floor.
         while len(live) < cfg.min_workers:
-            live.append(
-                self.provider.create_node(dict(cfg.worker_resources))
-            )
+            live.append(self._launch(self._default_type()))
 
-        d = self._demand()
-        starved = d["pending_tasks"] > 0 and d["free_cpu"] <= 0.0
-        if starved and len(live) < cfg.max_workers:
+        views = self._rt.nodes()
+        alive = [v for v in views if v.get("state") == "alive"]
+        by_provider: Dict[str, Dict[str, Any]] = {}
+        for v in alive:
+            pid = (v.get("labels") or {}).get(PROVIDER_NODE_LABEL)
+            if pid:
+                by_provider[pid] = v
+
+        # Booting bookkeeping: a node is no longer booting once its view
+        # registers (or the provider lost it).
+        live_set = set(live)
+        for nid in list(self._booting):
+            if nid in by_provider or nid not in live_set:
+                self._booting.pop(nid, None)
+        booting_capacity = [
+            dict(self.config.node_types[t]["resources"])
+            for t in self._booting.values()
+            if t in self.config.node_types
+        ]
+
+        # Upscale by shape: launch node types that fit the unmet demand,
+        # sustained past upscale_delay_s.
+        unmet = self._unmet_shapes(alive, booting_capacity)
+        if unmet and len(live) < cfg.max_workers:
             if self._pending_since is None:
                 self._pending_since = now
             elif now - self._pending_since >= cfg.upscale_delay_s:
-                self.provider.create_node(dict(cfg.worker_resources))
+                for tname in self._plan_nodes(unmet):
+                    if (len(self.provider.non_terminated_nodes())
+                            >= cfg.max_workers):
+                        break
+                    self._launch(tname)
                 self._pending_since = None
         else:
             self._pending_since = None
 
-        # Downscale: terminate workers idle past the timeout (never below
-        # min_workers). A node is idle when it reports full availability
-        # and no pending tasks.
-        views = {
-            v["node_id"]: v for v in self._rt.nodes()
-        }
-        # Map provider ids to cluster nodes by resource fingerprinting is
-        # fragile; LocalNodeProvider nodes are the only non-head nodes it
-        # launched, so count-based reconciliation is exact for it.
-        idle_workers = [
-            v for v in views.values()
-            if not v.get("is_head") and v.get("state") == "alive"
-            and v.get("pending_tasks", 0) == 0
-            and v.get("resources_available", {}) ==
-            v.get("resources_total", {})
-        ]
-        busy = len(live) - len(idle_workers)
+        # Downscale: terminate a worker only when ITS OWN view has been
+        # idle past the timeout (never below min_workers). Nodes that have
+        # not registered a view yet are still booting — treat as busy.
         for nid in list(live):
-            if len(self.provider.non_terminated_nodes()) <= max(
-                    cfg.min_workers, busy):
-                break
-            since = self._idle_since.get(nid)
-            if len(idle_workers) == 0:
+            v = by_provider.get(nid)
+            idle = (
+                v is not None
+                and v.get("pending_tasks", 0) == 0
+                and v.get("resources_available", {})
+                == v.get("resources_total", {})
+            )
+            if not idle:
                 self._idle_since.pop(nid, None)
                 continue
+            since = self._idle_since.get(nid)
             if since is None:
-                self._idle_since[nid] = time.monotonic()
-            elif time.monotonic() - since >= cfg.idle_timeout_s:
-                self.provider.terminate_node(nid)
-                self._idle_since.pop(nid, None)
-                idle_workers.pop()
+                self._idle_since[nid] = now
+            elif now - since >= cfg.idle_timeout_s:
+                if (len(self.provider.non_terminated_nodes())
+                        > cfg.min_workers):
+                    self.provider.terminate_node(nid)
+                    self._idle_since.pop(nid, None)

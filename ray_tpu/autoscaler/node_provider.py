@@ -18,9 +18,16 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 
+# Label every provider-launched node carries so the autoscaler can match
+# cluster views back to provider node ids (ref analogue: the
+# ray-node-name / instance-id tags cloud providers stamp on instances).
+PROVIDER_NODE_LABEL = "rtpu-provider-node-id"
+
+
 class NodeProvider:
     """Minimal provider surface (ref: NodeProvider.create_node /
-    terminate_node / non_terminated_nodes)."""
+    terminate_node / non_terminated_nodes). Implementations MUST stamp
+    ``PROVIDER_NODE_LABEL: <returned id>`` into the node's labels."""
 
     def create_node(self, resources: Dict[str, float],
                     labels: Optional[Dict[str, str]] = None) -> str:
@@ -44,16 +51,19 @@ class LocalNodeProvider(NodeProvider):
 
     def create_node(self, resources: Dict[str, float],
                     labels: Optional[Dict[str, str]] = None) -> str:
+        node_id = f"local-{uuid.uuid4().hex[:8]}"
         session_dir = os.path.join(
             tempfile.gettempdir(), "ray_tpu",
-            f"autoscaled-{int(time.time())}-{uuid.uuid4().hex[:8]}",
+            f"autoscaled-{int(time.time())}-{node_id}",
         )
         os.makedirs(session_dir, exist_ok=True)
+        labels = dict(labels or {})
+        labels[PROVIDER_NODE_LABEL] = node_id
         env = dict(os.environ)
         env["RAY_TPU_GCS_ADDRESS"] = self.gcs_address
         env["RAY_TPU_SESSION_DIR"] = session_dir
         env["RAY_TPU_RESOURCES"] = json.dumps(resources)
-        env["RAY_TPU_NODE_LABELS"] = json.dumps(labels or {})
+        env["RAY_TPU_NODE_LABELS"] = json.dumps(labels)
         from ray_tpu.core.config import get_config as _get_config
 
         if _get_config().session_token:
@@ -70,7 +80,6 @@ class LocalNodeProvider(NodeProvider):
             [sys.executable, "-m", "ray_tpu.core.node_main"],
             env=env, stdout=log, stderr=subprocess.STDOUT,
         )
-        node_id = f"local-{proc.pid}"
         self._procs[node_id] = proc
         return node_id
 

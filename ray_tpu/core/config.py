@@ -91,6 +91,12 @@ class Config:
     # Max times a task may be spilled back between nodes before it must queue
     # where it is (ref analogue: bounded spillback in hybrid policy).
     max_task_spillback: int = 4
+    # How long a task whose resource shape fits NO node may stay queued
+    # before failing (ref analogue: the reference never fails infeasible
+    # tasks — they pend until the autoscaler provisions a fitting node,
+    # autoscaler/_private/resource_demand_scheduler.py). 0 = fail fast.
+    # Set > 0 when running an autoscaler so pending shapes drive upscale.
+    infeasible_grace_s: float = 0.0
     # How long a directory miss waits for a location to appear in the GCS
     # object directory before raising ObjectLostError. Generous because a
     # miss may just mean the producing task is still running on its node.

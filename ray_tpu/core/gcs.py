@@ -42,6 +42,9 @@ class NodeEntry:
     resources_total: Dict[str, float]
     resources_available: Dict[str, float] = field(default_factory=dict)
     pending_tasks: int = 0
+    # [[shape_dict, count], ...] of queued work (autoscaler demand input;
+    # ref analogue: resource_load_by_shape in gcs.proto).
+    pending_shapes: List[Any] = field(default_factory=list)
     is_head: bool = False
     state: str = "alive"  # alive | dead
     last_heartbeat: float = field(default_factory=time.monotonic)
@@ -55,6 +58,7 @@ class NodeEntry:
             "resources_total": self.resources_total,
             "resources_available": self.resources_available,
             "pending_tasks": self.pending_tasks,
+            "pending_shapes": self.pending_shapes,
             "is_head": self.is_head,
             "state": self.state,
             "labels": self.labels,
@@ -321,7 +325,8 @@ class GcsService:
                 labels=msg.get("labels") or {},
             )
         if op == "heartbeat":
-            self.heartbeat(node_id, msg["available"], msg["pending"])
+            self.heartbeat(node_id, msg["available"], msg["pending"],
+                           msg.get("shapes"))
             return None  # fire-and-forget
         if op == "kv_put":
             added = self.kv_put(msg["key"], msg["value"], msg.get("overwrite", True))
@@ -620,13 +625,16 @@ class GcsService:
                 await self._try_place_pg(pg_id)
 
     def heartbeat(
-        self, node_id: NodeID, available: Dict[str, float], pending: int
+        self, node_id: NodeID, available: Dict[str, float], pending: int,
+        shapes: Optional[List[Any]] = None,
     ):
         entry = self._nodes.get(node_id)
         if entry is None or entry.state == "dead":
             return
         entry.resources_available = available
         entry.pending_tasks = pending
+        if shapes is not None:
+            entry.pending_shapes = shapes
         entry.last_heartbeat = time.monotonic()
 
     async def _broadcast_load(self):

@@ -142,6 +142,8 @@ class TaskRecord:
     # placement group: (pg_id, bundle_index).
     bundle_key: Optional[Tuple[str, int]] = None
     created: float = field(default_factory=time.monotonic)
+    # When the record first looked cluster-wide infeasible (grace timing).
+    infeasible_since: Optional[float] = None
 
 
 @dataclass
@@ -374,10 +376,29 @@ class NodeManager:
             "resources_total": self.node_resources.total.to_dict(),
             "resources_available": self.node_resources.available.to_dict(),
             "pending_tasks": len(self._ready) + len(self._waiting),
+            "pending_shapes": self._pending_shapes(),
             "is_head": self.is_head,
             "state": "alive",
             "labels": self.labels,
         }
+
+    def _pending_shapes(self, cap: int = 32):
+        """Aggregate queued-task resource shapes for the autoscaler (ref:
+        resource_load_by_shape in gcs.proto / resource_demand_scheduler.py).
+        Returns [[shape_dict, count], ...], at most ``cap`` distinct shapes.
+        """
+        counts: Dict[Tuple, int] = {}
+        recs = list(self._ready) + [rw[0] for rw in self._waiting.values()]
+        for rec in recs:
+            try:
+                shape = rec.spec.resources.to_dict()
+            except Exception:
+                continue
+            key = tuple(sorted(shape.items()))
+            if key not in counts and len(counts) >= cap:
+                continue  # cap DISTINCT shapes, keep counting known ones
+            counts[key] = counts.get(key, 0) + 1
+        return [[dict(k), n] for k, n in counts.items()]
 
     def _on_gcs_node_added(self, entry):
         was_single = not self._multi_node
@@ -429,6 +450,7 @@ class NodeManager:
                     self.node_id,
                     view["resources_available"],
                     view["pending_tasks"],
+                    view.get("pending_shapes"),
                 )
             elif self._gcs_client is not None and not self._gcs_client.closed:
                 try:
@@ -437,6 +459,7 @@ class NodeManager:
                             "op": "heartbeat",
                             "available": view["resources_available"],
                             "pending": view["pending_tasks"],
+                            "shapes": view.get("pending_shapes"),
                             "msg_id": None,
                         }
                     )
@@ -598,7 +621,10 @@ class NodeManager:
         elif mtype == "wait":
             asyncio.ensure_future(self._reply_wait(w, msg))
         elif mtype == "put":
-            await self.put_object(msg["object_id"], msg["loc"], msg.get("refs", 1))
+            await self.put_object(
+                msg["object_id"], msg["loc"], msg.get("refs", 1),
+                pin_if_new=msg.get("pin_if_new", False),
+            )
         elif mtype == "add_refs":
             for oid in msg["object_ids"]:
                 self.directory.add_ref(oid)
@@ -1402,6 +1428,25 @@ class NodeManager:
         self.directory.add_ref(oid)
         return True
 
+    def _infeasible_may_wait(self, record: TaskRecord) -> bool:
+        """Whether a cluster-wide-infeasible task should stay queued
+        (``infeasible_grace_s`` window) so an autoscaler can provision a
+        fitting node, instead of failing fast. Schedules a re-check at
+        grace expiry so the eventual failure does not need an event."""
+        grace = self.config.infeasible_grace_s
+        if grace <= 0:
+            return False
+        now = time.monotonic()
+        if record.infeasible_since is None:
+            record.infeasible_since = now
+            try:
+                loop = asyncio.get_event_loop()
+                loop.call_later(grace + 0.05, self._schedule)
+            except Exception:
+                pass
+            return True
+        return (now - record.infeasible_since) < grace
+
     def _schedule(self):
         """Dispatch ready tasks to idle workers while resources allow
         (ref analogue: LocalTaskManager::DispatchScheduledTasksToWorkers)."""
@@ -1479,6 +1524,9 @@ class NodeManager:
                         spread_threshold=self.config.scheduler_spread_threshold,
                     )
                     if target is None:
+                        if self._infeasible_may_wait(record):
+                            deferred.append(record)
+                            continue
                         self._fail_task(
                             record,
                             TaskError(
@@ -1495,6 +1543,9 @@ class NodeManager:
                         continue
                 if not self.node_resources.can_fit(record.spec.resources):
                     if not self.node_resources.is_feasible(record.spec.resources):
+                        if self._infeasible_may_wait(record):
+                            deferred.append(record)
+                            continue
                         self._fail_task(
                             record,
                             TaskError(
@@ -1954,7 +2005,13 @@ class NodeManager:
 
     # ---------------------------------------------------------------- objects
 
-    async def put_object(self, object_id: ObjectID, loc: Location, refs: int = 1):
+    async def put_object(self, object_id: ObjectID, loc: Location,
+                         refs: int = 1, *, pin_if_new: bool = False):
+        # pin_if_new: carry ``refs`` only when the directory has no entry
+        # yet (streaming re-seal after a retry — a surviving original entry
+        # keeps its original pin; adding more would leak it permanently).
+        if pin_if_new and self.directory.lookup(object_id) is not None:
+            refs = 0
         self.directory.add(object_id, loc, initial_refs=refs)
         self._seal_object(object_id, loc)
 

@@ -196,14 +196,26 @@ class Worker:
             if value is _STREAM_END:
                 rt.kv_put(key, cloudpickle.dumps({"end": index}))
                 return
+            # Retry of an index the consumer already consumed (tombstone
+            # record): nothing to re-seal — and the tombstone must survive
+            # so a THIRD attempt stays a no-op too.
+            prior = rt.kv_get(key)
+            if prior is not None:
+                try:
+                    if cloudpickle.loads(prior).get("consumed"):
+                        return
+                except Exception:
+                    pass
             oid = stream_item_id(spec.task_id, index)
             loc = rt.store.put_serialized(oid, _ser(value))
-            # Seal with one pinned ref (consumed by the reader's adopt) —
-            # unless a prior attempt of this task (retry) already pinned
-            # this index, in which case re-sealing must not double-pin.
-            refs = 0 if rt.kv_get(key) is not None else 1
+            # Seal with one pinned ref (consumed by the reader's adopt).
+            # pin_if_new: if a prior attempt's entry survived in this
+            # node's directory (worker crash, store alive), its pin is
+            # still held — adding another would leak; if the object died
+            # with its node, the fresh entry needs its own pin or the
+            # consumer's register/decr coalesce could GC it unread.
             self.conn.send({"type": "put", "object_id": oid, "loc": loc,
-                            "refs": refs})
+                            "refs": 1, "pin_if_new": True})
             rt.kv_put(key, cloudpickle.dumps({"oid": oid.hex()}))
 
         rt.current_task_id = spec.task_id

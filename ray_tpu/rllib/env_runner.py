@@ -15,6 +15,7 @@ import numpy as np
 
 from .sample_batch import (
     ACTIONS,
+    BOOTSTRAP_OBS,
     DONES,
     LOGPS,
     OBS,
@@ -78,6 +79,10 @@ class EnvRunner:
             DONES: np.asarray(done_l),
             LOGPS: np.asarray(logp_l, dtype=np.float32),
             VALUES: np.asarray(val_l, dtype=np.float32),
+            # Post-fragment observation for the learner's value bootstrap
+            # (if the fragment ended on done, V(s_{T+1}) is masked by
+            # (1-done) anyway, so the reset obs here is harmless).
+            BOOTSTRAP_OBS: np.asarray(self._obs, dtype=np.float32),
         })
         batch.update(compute_gae(
             batch[REWARDS], batch[VALUES], batch[DONES], float(last_value),

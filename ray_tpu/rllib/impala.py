@@ -15,7 +15,15 @@ from typing import Any, Dict, List
 import numpy as np
 
 from .algorithm import Algorithm, AlgorithmConfig
-from .sample_batch import ACTIONS, DONES, LOGPS, OBS, REWARDS, SampleBatch
+from .sample_batch import (
+    ACTIONS,
+    BOOTSTRAP_OBS,
+    DONES,
+    LOGPS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
 
 
 class IMPALAConfig(AlgorithmConfig):
@@ -85,13 +93,19 @@ class IMPALALearner:
             )
             return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
 
-        def loss_fn(params, obs, actions, behav_logp, rewards, dones):
-            logits, values = forward(params, obs)
+        def loss_fn(params, obs, actions, behav_logp, rewards, dones,
+                    boot_obs):
+            # Evaluate the fragment's T observations plus the one AFTER the
+            # last transition in a single forward: the bootstrap must be
+            # V(s_{T+1}), not V(s_T) (masked by (1-done) inside vtrace).
+            all_obs = jnp.concatenate([obs, boot_obs[None]], axis=0)
+            logits_all, values_all = forward(params, all_obs)
+            logits, values = logits_all[:-1], values_all[:-1]
+            bootstrap = values_all[-1]
             logp_all = jax.nn.log_softmax(logits)
             target_logp = jnp.take_along_axis(
                 logp_all, actions[:, None], axis=1
             )[:, 0]
-            bootstrap = values[-1]
             vs, pg_adv = vtrace(
                 behav_logp, target_logp, rewards, dones, values, bootstrap
             )
@@ -103,10 +117,10 @@ class IMPALALearner:
                            "entropy": entropy}
 
         def update(params, opt_state, obs, actions, behav_logp, rewards,
-                   dones):
+                   dones, boot_obs):
             (loss, stats), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
-            )(params, obs, actions, behav_logp, rewards, dones)
+            )(params, obs, actions, behav_logp, rewards, dones, boot_obs)
             updates, opt_state = self._tx.update(grads, opt_state)
             params = optax.apply_updates(params, updates)
             stats["total_loss"] = loss
@@ -117,14 +131,20 @@ class IMPALALearner:
     def update(self, batch: SampleBatch) -> Dict[str, float]:
         import jax.numpy as jnp
 
+        obs = jnp.asarray(batch[OBS])
+        # Fragments from older runners may lack the bootstrap column; fall
+        # back to the (biased) last-obs bootstrap rather than crash.
+        boot = batch.get(BOOTSTRAP_OBS)
+        boot = obs[-1] if boot is None else jnp.asarray(boot)
         self._params, self._opt_state, stats = self._update(
             self._params,
             self._opt_state,
-            jnp.asarray(batch[OBS]),
+            obs,
             jnp.asarray(batch[ACTIONS], dtype=jnp.int32),
             jnp.asarray(batch[LOGPS]),
             jnp.asarray(batch[REWARDS]),
             jnp.asarray(batch[DONES], dtype=jnp.float32),
+            boot,
         )
         return {k: float(v) for k, v in stats.items()}
 

@@ -15,35 +15,53 @@ ACTIONS = "actions"
 REWARDS = "rewards"
 DONES = "dones"
 NEXT_OBS = "next_obs"
+# Observation AFTER the fragment's last transition (for value
+# bootstrapping at fragment boundaries). Scalar row, not per-timestep.
+BOOTSTRAP_OBS = "bootstrap_obs"
 LOGPS = "action_logp"
 VALUES = "values"
 ADVANTAGES = "advantages"
 RETURNS = "returns"
 
+# Columns carrying ONE row per fragment rather than one per timestep.
+_PER_FRAGMENT_KEYS = frozenset({BOOTSTRAP_OBS})
+
 
 class SampleBatch(dict):
     @property
     def count(self) -> int:
+        if OBS in self:
+            return len(self[OBS])
         for v in self.values():
             return len(v)
         return 0
 
+    def _aligned_keys(self) -> List[str]:
+        # Per-fragment metadata (one row per fragment, not time-aligned)
+        # only makes sense on an un-merged fragment and is dropped by
+        # concat/shuffle/minibatches. Named explicitly — a length
+        # heuristic would misfire whenever obs_dim == fragment length.
+        return [k for k in self if k not in _PER_FRAGMENT_KEYS]
+
     @staticmethod
     def concat(batches: List["SampleBatch"]) -> "SampleBatch":
-        keys = batches[0].keys()
+        keys = batches[0]._aligned_keys()
         return SampleBatch(
             {k: np.concatenate([np.asarray(b[k]) for b in batches]) for k in keys}
         )
 
     def shuffle(self, rng: np.random.RandomState) -> "SampleBatch":
         idx = rng.permutation(self.count)
-        return SampleBatch({k: np.asarray(v)[idx] for k, v in self.items()})
+        return SampleBatch(
+            {k: np.asarray(self[k])[idx] for k in self._aligned_keys()}
+        )
 
     def minibatches(self, size: int) -> Iterator["SampleBatch"]:
         n = self.count
+        keys = self._aligned_keys()
         for start in range(0, n - size + 1, size):
             yield SampleBatch(
-                {k: np.asarray(v)[start:start + size] for k, v in self.items()}
+                {k: np.asarray(self[k])[start:start + size] for k in keys}
             )
 
 

@@ -231,12 +231,14 @@ def _route_with_retry(state: _RouterState, submit, deliver, deliver_error,
         try:
             replica = state.pick(model_id)
         except RuntimeError as e:
-            if last_err is not None:
-                # Mid-update empty window: refetch rather than fail.
+            # Empty replica set: the local snapshot is stale (evictions,
+            # or a just-created handle racing deploy). Refresh and retry
+            # even on the FIRST attempt; fail only once retries are spent.
+            if attempt < MAX_DEATH_RETRIES:
                 state.force_refresh()
                 time.sleep(0.05 * (attempt + 1))
                 continue
-            deliver_error(e)
+            deliver_error(last_err or e)
             return
         state.begin(replica)
         try:

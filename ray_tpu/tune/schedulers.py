@@ -228,9 +228,9 @@ class PopulationBasedTraining(TrialScheduler):
         self._last_perturb.pop(trial_id, None)
 
     def _quantiles(self):
+        # Scores are normalized higher-is-better in on_result (min mode is
+        # stored negated), so the ascending sort is correct for both modes.
         ranked = sorted(self._scores, key=self._scores.get)
-        if self.mode == "min":
-            ranked = list(reversed(ranked))
         n = max(1, int(math.ceil(len(ranked) * self.quantile)))
         if len(ranked) < 2:
             return [], []

@@ -49,6 +49,45 @@ def test_autoscaler_up_and_down():
         ray_tpu.shutdown()
 
 
+def test_autoscaler_provisions_by_shape():
+    """A pending {"TPU": 4} task must provision the TPU node type, not a
+    CPU worker (ref analogue: resource_demand_scheduler node-type
+    selection)."""
+    ray_tpu.init(num_cpus=1, system_config={
+        "heartbeat_interval_s": 0.1,
+        "infeasible_grace_s": 60.0,
+    })
+    scaler = None
+    try:
+        scaler = Autoscaler(AutoscalerConfig(
+            min_workers=0, max_workers=2,
+            node_types={
+                "cpu": {"resources": {"CPU": 2}},
+                "tpu": {"resources": {"CPU": 1, "TPU": 4},
+                        "labels": {"accel": "tpu-v5e"}},
+            },
+            upscale_delay_s=0.3, idle_timeout_s=30.0, interval_s=0.2,
+        )).start()
+
+        @ray_tpu.remote(resources={"TPU": 4})
+        def use_tpu():
+            return "ok"
+
+        assert ray_tpu.get(use_tpu.remote(), timeout=90) == "ok"
+        from ray_tpu.core.runtime_context import current_runtime
+
+        workers = [v for v in current_runtime().nodes()
+                   if not v.get("is_head") and v.get("state") == "alive"]
+        types = [(v.get("labels") or {}).get("rtpu-node-type")
+                 for v in workers]
+        assert "tpu" in types, f"no TPU-typed node launched: {types}"
+        assert "cpu" not in types, f"CPU node launched for TPU demand: {types}"
+    finally:
+        if scaler is not None:
+            scaler.shutdown()
+        ray_tpu.shutdown()
+
+
 def test_autoscaler_respects_min_workers():
     ray_tpu.init(num_cpus=1, system_config={"heartbeat_interval_s": 0.1})
     scaler = None
