@@ -41,7 +41,8 @@ class AutoscalerConfig:
                  node_types: Optional[Dict[str, Dict[str, Any]]] = None,
                  upscale_delay_s: float = 1.0,
                  idle_timeout_s: float = 10.0,
-                 interval_s: float = 0.5):
+                 interval_s: float = 0.5,
+                 boot_timeout_s: float = 60.0):
         self.min_workers = min_workers
         self.max_workers = max_workers
         if node_types is None:
@@ -52,6 +53,9 @@ class AutoscalerConfig:
         self.upscale_delay_s = upscale_delay_s
         self.idle_timeout_s = idle_timeout_s
         self.interval_s = interval_s
+        # How long a launched node may stay view-less before its phantom
+        # capacity stops masking demand (hung boot → replacement can come).
+        self.boot_timeout_s = boot_timeout_s
 
     @property
     def worker_resources(self) -> Dict[str, float]:
@@ -81,11 +85,12 @@ class Autoscaler:
         self._pending_since: Optional[float] = None
         # provider node id -> time its own view became idle
         self._idle_since: Dict[str, float] = {}
-        # provider node id -> node-type name, for nodes we launched that
-        # have not registered a cluster view yet (still booting). Their
-        # capacity must count against demand or every reconcile tick
-        # launches another node for the same unmet shape.
-        self._booting: Dict[str, str] = {}
+        # provider node id -> (node-type name, boot deadline), for nodes we
+        # launched that have not registered a cluster view yet. Their
+        # capacity counts against demand (or every tick would launch a
+        # duplicate), but only until boot_timeout_s — a hung boot must not
+        # mask demand forever.
+        self._booting: Dict[str, Tuple[str, float]] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -167,7 +172,9 @@ class Autoscaler:
         labels.setdefault("rtpu-node-type", type_name)
         nid = self.provider.create_node(
             dict(tcfg["resources"]), labels=labels)
-        self._booting[nid] = type_name
+        self._booting[nid] = (
+            type_name, time.monotonic() + self.config.boot_timeout_s
+        )
         return nid
 
     def _default_type(self) -> str:
@@ -202,14 +209,14 @@ class Autoscaler:
                 by_provider[pid] = v
 
         # Booting bookkeeping: a node is no longer booting once its view
-        # registers (or the provider lost it).
+        # registers, the provider lost it, or its boot deadline passed.
         live_set = set(live)
-        for nid in list(self._booting):
-            if nid in by_provider or nid not in live_set:
+        for nid, (_t, deadline) in list(self._booting.items()):
+            if nid in by_provider or nid not in live_set or now > deadline:
                 self._booting.pop(nid, None)
         booting_capacity = [
             dict(self.config.node_types[t]["resources"])
-            for t in self._booting.values()
+            for t, _deadline in self._booting.values()
             if t in self.config.node_types
         ]
 

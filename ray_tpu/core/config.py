@@ -38,6 +38,11 @@ class Config:
     object_store_memory: int = 0
     # Number of workers prestarted per node (ref: worker_pool prestart).
     num_prestart_workers: int = 2
+    # Tasks shipped to a busy worker's socket ahead of its completion
+    # (1 = off). Hides the dispatch round-trip between back-to-back small
+    # tasks (ref analogue: max_tasks_in_flight_per_worker pipelining).
+    # Resources stay held while queued; blocking workers are reclaimed.
+    worker_pipeline_depth: int = 2
     # Hard cap on worker processes a node may spawn (includes workers started
     # to relieve blocked-on-get workers).
     max_workers: int = 64

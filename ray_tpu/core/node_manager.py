@@ -144,6 +144,9 @@ class TaskRecord:
     created: float = field(default_factory=time.monotonic)
     # When the record first looked cluster-wide infeasible (grace timing).
     infeasible_since: Optional[float] = None
+    # Cached scheduling-class key (shape + strategy + worker type); records
+    # of one class are interchangeable for capacity decisions.
+    sched_class: Optional[Tuple] = None
 
 
 @dataclass
@@ -154,9 +157,67 @@ class WorkerHandle:
     state: str = "idle"  # idle | busy | blocked | actor | dead
     worker_type: str = "cpu"  # cpu | tpu — tpu workers own the accelerator env
     current: Optional[TaskRecord] = None
+    # Pipelined tasks shipped ahead of completion (ref analogue: actor
+    # submit pipelining via max_tasks_in_flight_per_worker). Resources are
+    # held while queued; a worker that blocks gets them reclaimed.
+    pending: Deque[TaskRecord] = field(default_factory=deque)
+    # Execute frames still being written by an async _send_execute (blob
+    # fetch in flight). While nonzero the send_nowait fast path is off so
+    # frames cannot overtake each other (per-caller actor call order).
+    slow_sends: int = 0
     known_functions: Set[str] = field(default_factory=set)
     actor_id: Optional[ActorID] = None
     last_active: float = field(default_factory=time.monotonic)
+
+
+class _ReadyQueue:
+    """Ready tasks bucketed by scheduling class (ref analogue:
+    ClusterTaskManager's per-SchedulingClass queues,
+    scheduling/cluster_task_manager.h): a dispatch pass visits each CLASS
+    once and stops at the first blocked head, so a deep homogeneous queue
+    costs O(#classes + #dispatched) — not O(#queued) resource checks."""
+
+    __slots__ = ("classes", "_count", "_keyfn")
+
+    def __init__(self, keyfn):
+        self.classes: Dict[Tuple, Deque[TaskRecord]] = {}
+        self._count = 0
+        self._keyfn = keyfn
+
+    def append(self, rec: "TaskRecord"):
+        self.classes.setdefault(self._keyfn(rec), deque()).append(rec)
+        self._count += 1
+
+    def popleft(self) -> "TaskRecord":
+        for cls, q in self.classes.items():
+            rec = q.popleft()
+            self._count -= 1
+            if not q:
+                del self.classes[cls]
+            return rec
+        raise IndexError("pop from empty ready queue")
+
+    def remove_head(self, cls: Tuple):
+        q = self.classes[cls]
+        q.popleft()
+        self._count -= 1
+        if not q:
+            del self.classes[cls]
+
+    def count_worker_type(self, wtype: str) -> int:
+        return sum(
+            len(q) for cls, q in self.classes.items() if cls[2] == wtype
+        )
+
+    def __len__(self) -> int:
+        return self._count
+
+    def __bool__(self) -> bool:
+        return self._count > 0
+
+    def __iter__(self):
+        for q in self.classes.values():
+            yield from q
 
 
 @dataclass
@@ -221,7 +282,8 @@ class NodeManager:
         self._shutdown = False
 
         # Scheduling state (loop-thread only).
-        self._ready: Deque[TaskRecord] = deque()
+        self._ready = _ReadyQueue(self._sched_class)
+        self._sched_pending = False
         self._waiting: Dict[TaskID, Tuple[TaskRecord, Set[ObjectID]]] = {}
         self._dep_index: Dict[ObjectID, Set[TaskID]] = {}
         self._tasks: Dict[TaskID, TaskRecord] = {}
@@ -368,35 +430,43 @@ class NodeManager:
             else:
                 self._cluster_view.pop(v["node_id"], None)
 
-    def _local_view(self) -> Dict[str, Any]:
-        return {
+    def _local_view(self, include_shapes: bool = False) -> Dict[str, Any]:
+        view = {
             "node_id": self.node_id.hex(),
             "host": self.node_ip,
             "peer_port": self.peer_port,
             "resources_total": self.node_resources.total.to_dict(),
             "resources_available": self.node_resources.available.to_dict(),
             "pending_tasks": len(self._ready) + len(self._waiting),
-            "pending_shapes": self._pending_shapes(),
             "is_head": self.is_head,
             "state": "alive",
             "labels": self.labels,
         }
+        if include_shapes:
+            # O(queue) — heartbeat-rate only, never per _schedule pass.
+            view["pending_shapes"] = self._pending_shapes()
+        return view
 
     def _pending_shapes(self, cap: int = 32):
         """Aggregate queued-task resource shapes for the autoscaler (ref:
         resource_load_by_shape in gcs.proto / resource_demand_scheduler.py).
         Returns [[shape_dict, count], ...], at most ``cap`` distinct shapes.
-        """
+        Ready records are already class-bucketed (shape at key index 1), so
+        this is O(#classes + #waiting), not O(#queued)."""
         counts: Dict[Tuple, int] = {}
-        recs = list(self._ready) + [rw[0] for rw in self._waiting.values()]
-        for rec in recs:
+        for cls, q in self._ready.classes.items():
+            key = cls[1]
+            if key not in counts and len(counts) >= cap:
+                continue  # cap DISTINCT shapes, keep counting known ones
+            counts[key] = counts.get(key, 0) + len(q)
+        for rec, _missing in self._waiting.values():
             try:
                 shape = rec.spec.resources.to_dict()
             except Exception:
                 continue
             key = tuple(sorted(shape.items()))
             if key not in counts and len(counts) >= cap:
-                continue  # cap DISTINCT shapes, keep counting known ones
+                continue
             counts[key] = counts.get(key, 0) + 1
         return [[dict(k), n] for k, n in counts.items()]
 
@@ -443,7 +513,7 @@ class NodeManager:
         interval = self.config.heartbeat_interval_s
         while not self._shutdown:
             await asyncio.sleep(interval)
-            view = self._local_view()
+            view = self._local_view(include_shapes=True)
             self._cluster_view[view["node_id"]] = view
             if self.is_head and self.gcs_service is not None:
                 self.gcs_service.heartbeat(
@@ -645,6 +715,8 @@ class NodeManager:
             self._on_worker_blocked(w)
         elif mtype == "unblocked":
             self._on_worker_unblocked(w)
+        elif mtype == "reclaimed":
+            self._on_tasks_reclaimed(w, msg)
         elif mtype == "kv":
             await self._handle_kv(w, msg)
         elif mtype == "pg":
@@ -682,27 +754,31 @@ class NodeManager:
             pass
         if w.actor_id is not None:
             await self._on_actor_worker_death(w)
-        elif w.current is not None:
-            record = w.current
+        elif w.current is not None or w.pending:
+            lost = ([w.current] if w.current is not None else []) + list(
+                w.pending
+            )
             w.current = None
-            self._release_task_resources(record)
-            if record.state == "cancelled":
-                pass
-            elif record.spec.retries_left > 0:
-                record.spec.retries_left -= 1
-                record.state = "ready"
-                record.worker_id = None
-                self._stats["tasks_retried"] += 1
-                self._ready.append(record)
-            else:
-                detail = (
-                    "killed by the node memory monitor (out of memory)"
-                    if getattr(w, "_oom_killed", False)
-                    else ""
-                )
-                self._fail_task(
-                    record, WorkerCrashedError(record.spec.name, detail)
-                )
+            w.pending.clear()
+            for record in lost:
+                self._release_task_resources(record)
+                if record.state == "cancelled":
+                    pass
+                elif record.spec.retries_left > 0:
+                    record.spec.retries_left -= 1
+                    record.state = "ready"
+                    record.worker_id = None
+                    self._stats["tasks_retried"] += 1
+                    self._ready.append(record)
+                else:
+                    detail = (
+                        "killed by the node memory monitor (out of memory)"
+                        if getattr(w, "_oom_killed", False)
+                        else ""
+                    )
+                    self._fail_task(
+                        record, WorkerCrashedError(record.spec.name, detail)
+                    )
         elif prev_state in ("busy", "blocked"):
             pass
         if w.proc is not None and w.proc.poll() is None:
@@ -1194,8 +1270,13 @@ class NodeManager:
     # ------------------------------------------------------------- scheduling
 
     async def submit_task(self, spec: TaskSpec, origin: Optional[str] = None):
+        self.submit_task_sync(spec, origin)
+
+    def submit_task_sync(self, spec: TaskSpec, origin: Optional[str] = None):
         """Entry point for driver, nested worker, and peer-forwarded
-        submissions (ref analogue: ClusterTaskManager::QueueAndScheduleTask)."""
+        submissions (ref analogue: ClusterTaskManager::QueueAndScheduleTask).
+        Never awaits — the driver's batched submit drain calls it straight
+        from a loop callback."""
         self._stats["tasks_submitted"] += 1
         record = TaskRecord(spec=spec, origin=origin)
         self._tasks[spec.task_id] = record
@@ -1447,138 +1528,209 @@ class NodeManager:
             return True
         return (now - record.infeasible_since) < grace
 
+    def _sched_class(self, record: TaskRecord) -> Tuple:
+        """Scheduling-class key (ref analogue: SchedulingClassDescriptor —
+        task_spec.h GetSchedulingClass): tasks with the same resource
+        shape, strategy, and worker type hit identical capacity walls, so
+        one representative's failure defers the whole class this pass."""
+        if record.sched_class is None:
+            spec = record.spec
+            strat = getattr(spec, "scheduling_strategy", None)
+            if isinstance(strat, PlacementGroupSchedulingStrategy):
+                skey = ("pg", strat.pg_id, getattr(strat, "bundle_index", -1))
+            elif strat is None or isinstance(strat, str):
+                skey = ("s", strat)
+            else:
+                # Unknown strategy object: never group (unique per record).
+                skey = ("u", id(record))
+            record.sched_class = (
+                skey,
+                tuple(sorted(spec.resources.to_dict().items())),
+                _task_worker_type(spec),
+                # Forwarded records route differently from locally-owned
+                # ones — never let one block the other's class.
+                record.origin is None,
+            )
+        return record.sched_class
+
     def _schedule(self):
+        """Request a dispatch pass. Debounced: any number of triggers in
+        one loop iteration (a burst of submits or completions) coalesce
+        into ONE pass on the next callback slot."""
+        if self._sched_pending or self._shutdown:
+            return
+        self._sched_pending = True
+        self._loop.call_soon(self._schedule_pass)
+
+    def _schedule_pass(self):
         """Dispatch ready tasks to idle workers while resources allow
-        (ref analogue: LocalTaskManager::DispatchScheduledTasksToWorkers)."""
+        (ref analogue: LocalTaskManager::DispatchScheduledTasksToWorkers).
+        Visits each scheduling class once, dispatching from its head until
+        the class hits a capacity wall — a deep homogeneous queue costs
+        O(#classes + #dispatched), not O(#queued)."""
+        self._sched_pending = False
         if self._shutdown:
             return
-        # One bounded pass over the queue: dispatch everything that fits,
-        # skip (in order) what doesn't — a task waiting on a busy resource
-        # class must not head-of-line-block other resource classes (ref
-        # analogue: ClusterTaskManager keeps per-scheduling-class queues).
-        deferred: Deque[TaskRecord] = deque()
         spawn_needed: Set[str] = set()
         if self._multi_node:
             self._cluster_view[self.node_id.hex()] = self._local_view()
-        while self._ready:
-            record = self._ready.popleft()
-            if record.state == "cancelled":
-                continue
-            spec = record.spec
-            raw_strategy = getattr(spec, "scheduling_strategy", None)
-            if isinstance(raw_strategy, PlacementGroupSchedulingStrategy):
-                # Placement-group routing: the bundle map decides the node;
-                # resources come from the bundle reservation.
-                targets = self._pg_targets(raw_strategy)
-                if targets is None:
+        ready = self._ready
+        for cls in list(ready.classes.keys()):
+            while True:
+                q = ready.classes.get(cls)
+                if q is None:
+                    break  # class drained (deque deleted by remove_head)
+                record = q[0]
+                if self._dispatch_record(record, spawn_needed):
+                    ready.remove_head(cls)
+                else:
+                    break  # head blocked on capacity: skip rest of class
+        for wtype in spawn_needed:
+            self._maybe_spawn_worker(wtype)
+
+    def _dispatch_record(self, record: TaskRecord,
+                         spawn_needed: Set[str]) -> bool:
+        """Try to place one ready record. True = record consumed (it was
+        dispatched, forwarded, failed, or re-queued elsewhere) — remove it
+        from its class queue; False = blocked on capacity, leave it at the
+        head and skip the rest of its class this pass."""
+        if record.state == "cancelled":
+            return True
+        spec = record.spec
+        raw_strategy = getattr(spec, "scheduling_strategy", None)
+        if isinstance(raw_strategy, PlacementGroupSchedulingStrategy):
+            # Placement-group routing: the bundle map decides the node;
+            # resources come from the bundle reservation.
+            targets = self._pg_targets(raw_strategy)
+            if targets is None:
+                record.state = "pg_resolving"
+                self._queue_pg_resolve(record)
+                return True
+            if not targets:
+                self._fail_task(
+                    record,
+                    TaskError(
+                        None, spec.name,
+                        "placement group bundle index out of range",
+                    ),
+                )
+                return True
+            if self.node_id.hex() not in targets:
+                if record.spillbacks >= self.config.max_task_spillback:
+                    # Routing cache may be stale (group re-placed after a
+                    # node death): drop it and re-resolve via the GCS
+                    # instead of spinning forward/requeue (advisor r1).
+                    self._pg_nodes.pop(raw_strategy.pg_id, None)
                     record.state = "pg_resolving"
                     self._queue_pg_resolve(record)
-                    continue
-                if not targets:
+                    return True
+                if record.origin is None:
+                    self._forward_record(record, targets[0])
+                    return True
+                return False
+            if self._find_local_bundle(raw_strategy, spec.resources) is None:
+                reason = self._pg_unservable(raw_strategy, spec.resources)
+                if reason is not None:
+                    self._fail_task(
+                        record, TaskError(None, spec.name, reason)
+                    )
+                    return True
+                return False  # bundle busy, wait
+        else:
+            strategy = raw_strategy or "DEFAULT"
+            if (
+                record.origin is None
+                and self._multi_node
+                and record.spillbacks < self.config.max_task_spillback
+                and (
+                    strategy != "DEFAULT"
+                    or not self.node_resources.can_fit(spec.resources)
+                )
+            ):
+                target = pick_node(
+                    spec.resources,
+                    strategy,
+                    self.node_id.hex(),
+                    list(self._cluster_view.values()),
+                    spread_threshold=self.config.scheduler_spread_threshold,
+                )
+                if target is None:
+                    if self._infeasible_may_wait(record):
+                        return False
                     self._fail_task(
                         record,
                         TaskError(
-                            None, spec.name,
-                            "placement group bundle index out of range",
+                            None,
+                            spec.name,
+                            f"infeasible resource request "
+                            f"{spec.resources.to_dict()} on every node in "
+                            f"the cluster",
                         ),
                     )
-                    continue
-                if self.node_id.hex() not in targets:
-                    if record.spillbacks >= self.config.max_task_spillback:
-                        # Routing cache may be stale (group re-placed after a
-                        # node death): drop it and re-resolve via the GCS
-                        # instead of spinning forward/requeue (advisor r1).
-                        self._pg_nodes.pop(raw_strategy.pg_id, None)
-                        record.state = "pg_resolving"
-                        self._queue_pg_resolve(record)
-                    elif record.origin is None:
-                        self._forward_record(record, targets[0])
-                    else:
-                        deferred.append(record)
-                    continue
-                if self._find_local_bundle(raw_strategy, spec.resources) is None:
-                    reason = self._pg_unservable(raw_strategy, spec.resources)
-                    if reason is not None:
-                        self._fail_task(
-                            record, TaskError(None, spec.name, reason)
-                        )
-                    else:
-                        deferred.append(record)  # bundle busy, wait
-                    continue
-            else:
-                strategy = raw_strategy or "DEFAULT"
-                if (
-                    record.origin is None
-                    and self._multi_node
-                    and record.spillbacks < self.config.max_task_spillback
-                    and (
-                        strategy != "DEFAULT"
-                        or not self.node_resources.can_fit(spec.resources)
+                    return True
+                if target != self.node_id.hex():
+                    self._forward_record(record, target)
+                    return True
+            if not self.node_resources.can_fit(spec.resources):
+                if not self.node_resources.is_feasible(spec.resources):
+                    if self._infeasible_may_wait(record):
+                        return False
+                    self._fail_task(
+                        record,
+                        TaskError(
+                            None,
+                            spec.name,
+                            f"infeasible resource request "
+                            f"{spec.resources.to_dict()} on node with "
+                            f"{self.node_resources.total.to_dict()}",
+                        ),
                     )
-                ):
-                    target = pick_node(
-                        spec.resources,
-                        strategy,
-                        self.node_id.hex(),
-                        list(self._cluster_view.values()),
-                        spread_threshold=self.config.scheduler_spread_threshold,
-                    )
-                    if target is None:
-                        if self._infeasible_may_wait(record):
-                            deferred.append(record)
-                            continue
-                        self._fail_task(
-                            record,
-                            TaskError(
-                                None,
-                                spec.name,
-                                f"infeasible resource request "
-                                f"{spec.resources.to_dict()} on every node in "
-                                f"the cluster",
-                            ),
-                        )
-                        continue
-                    if target != self.node_id.hex():
-                        self._forward_record(record, target)
-                        continue
-                if not self.node_resources.can_fit(record.spec.resources):
-                    if not self.node_resources.is_feasible(record.spec.resources):
-                        if self._infeasible_may_wait(record):
-                            deferred.append(record)
-                            continue
-                        self._fail_task(
-                            record,
-                            TaskError(
-                                None,
-                                record.spec.name,
-                                f"infeasible resource request "
-                                f"{record.spec.resources.to_dict()} on node with "
-                                f"{self.node_resources.total.to_dict()}",
-                            ),
-                        )
-                        continue
-                    deferred.append(record)
-                    continue
-            wtype = _task_worker_type(record.spec)
-            worker = self._take_idle_worker(wtype)
-            if worker is None:
-                spawn_needed.add(wtype)
-                deferred.append(record)
-                continue
-            if not self._acquire_for_record(record):
-                # Lost the race (bundle drained between check and acquire).
+                    return True
+                return False
+        wtype = _task_worker_type(spec)
+        worker = self._take_idle_worker(wtype)
+        pipelined = False
+        if worker is None:
+            worker = self._pipeline_candidate(wtype)
+            pipelined = worker is not None
+        if worker is None:
+            spawn_needed.add(wtype)
+            return False
+        if not self._acquire_for_record(record):
+            # Lost the race (bundle drained between check and acquire).
+            if not pipelined:
                 self._idle[worker.worker_type].appendleft(worker.worker_id)
-                deferred.append(record)
-                continue
-            record.resources_held = True
-            record.state = "running"
-            record.worker_id = worker.worker_id
+            return False
+        record.resources_held = True
+        record.state = "running"
+        record.worker_id = worker.worker_id
+        if pipelined:
+            worker.pending.append(record)
+        else:
             worker.state = "busy"
             worker.current = record
-            asyncio.ensure_future(self._send_execute(worker, record.spec))
-        self._ready = deferred
-        for wtype in spawn_needed:
-            self._maybe_spawn_worker(wtype)
+        self._send_execute_to(worker, spec)
+        return True
+
+    def _pipeline_candidate(self, wtype: str) -> Optional[WorkerHandle]:
+        """A busy (non-actor, non-blocked) worker with spare pipeline
+        slots: the next task rides its socket buffer and starts the moment
+        the current one finishes, skipping a dispatch round-trip."""
+        depth = self.config.worker_pipeline_depth
+        if depth <= 1:
+            return None
+        best = None
+        for w in self._workers.values():
+            if (
+                w.state == "busy"
+                and w.worker_type == wtype
+                and w.actor_id is None
+                and w.current is not None
+                and len(w.pending) < depth - 1
+            ):
+                if best is None or len(w.pending) < len(best.pending):
+                    best = w
+        return best
 
     def _take_idle_worker(self, worker_type: str = "cpu") -> Optional[WorkerHandle]:
         pool = self._idle[worker_type]
@@ -1597,9 +1749,7 @@ class NodeManager:
         more worker processes than CPU slots can dispatch is pure thrash
         (ref analogue: worker_pool.h PopWorker-triggered starts bounded by
         maximum_startup_concurrency)."""
-        demand = sum(
-            1 for r in self._ready if _task_worker_type(r.spec) == worker_type
-        )
+        demand = self._ready.count_worker_type(worker_type)
         if demand == 0:
             return
         capacity = len(self._workers) + self._num_starting()
@@ -1625,6 +1775,31 @@ class NodeManager:
             )
         except Exception:
             await self._on_worker_death(worker)
+
+    def _send_execute_to(self, worker: WorkerHandle, spec: TaskSpec):
+        """Ship one execute frame, preserving per-worker frame order: the
+        synchronous fast path only runs while no async send (blob fetch)
+        is still in flight, else a later frame could overtake it."""
+        if (
+            spec.function_id in worker.known_functions
+            and worker.slow_sends == 0
+        ):
+            try:
+                worker.writer.send_nowait(
+                    {"type": "execute", "spec": spec, "function_blob": None}
+                )
+            except Exception:
+                asyncio.ensure_future(self._on_worker_death(worker))
+            return
+
+        async def _ordered():
+            try:
+                await self._send_execute(worker, spec)
+            finally:
+                worker.slow_sends -= 1
+
+        worker.slow_sends += 1
+        asyncio.ensure_future(_ordered())
 
     async def _on_task_done(self, w: WorkerHandle, msg: Dict[str, Any]):
         task_id: TaskID = msg["task_id"]
@@ -1669,8 +1844,17 @@ class NodeManager:
                         self._flush_actor_queue(info)
         else:
             self._release_task_resources(record)
-            w.current = None
-            if w.state != "dead":
+            if w.current is record:
+                # Advance the pipeline: the next task's frame is already in
+                # the worker's socket — it is running now.
+                w.current = w.pending.popleft() if w.pending else None
+            else:
+                # Out-of-order completion (reclaim races): drop by identity.
+                try:
+                    w.pending.remove(record)
+                except ValueError:
+                    w.current = None
+            if w.current is None and w.state != "dead":
                 w.state = "idle"
                 self._idle[w.worker_type].append(w.worker_id)
         self._schedule()
@@ -1870,7 +2054,7 @@ class NodeManager:
         record.state = "running"
         record.worker_id = worker.worker_id
         info.inflight[record.spec.task_id] = record
-        asyncio.ensure_future(self._send_execute(worker, record.spec))
+        self._send_execute_to(worker, record.spec)
 
     def _flush_actor_queue(self, info: ActorInfo):
         while info.queued:
@@ -2555,7 +2739,19 @@ class NodeManager:
             record.state = "cancelled"
             self._fail_task(record, TaskCancelledError(record.spec.name))
             record.state = "cancelled"
-            if worker is not None and worker.proc is not None:
+            if worker is not None and record in worker.pending:
+                # Only QUEUED on the worker (pipelined frame, not yet
+                # executing): reclaim the frame instead of killing the
+                # process — the kill would take down the unrelated task
+                # actually running there.
+                try:
+                    worker.writer.send_nowait(
+                        {"type": "reclaim",
+                         "task_ids": [record.spec.task_id]}
+                    )
+                except Exception:
+                    pass
+            elif worker is not None and worker.proc is not None:
                 try:
                     worker.proc.kill()
                 except Exception:
@@ -2706,7 +2902,49 @@ class NodeManager:
             self._release_task_resources(w.current)
             w.current.bundle_key = bundle_key
             w.state = "blocked"
+            if w.pending:
+                # Pipelined tasks behind a blocked task could DEADLOCK (the
+                # blocked task may be waiting on one of them). Reclaim every
+                # not-yet-started frame; the worker replies with what it
+                # actually pulled back and those requeue elsewhere.
+                ids = [r.spec.task_id for r in w.pending]
+                try:
+                    w.writer.send_nowait(
+                        {"type": "reclaim", "task_ids": ids}
+                    )
+                except Exception:
+                    asyncio.ensure_future(self._on_worker_death(w))
             self._schedule()
+
+    def _on_tasks_reclaimed(self, w: WorkerHandle, msg: Dict[str, Any]):
+        """Worker returned pipelined frames it had not started: requeue
+        them for dispatch elsewhere."""
+        reclaimed = set(msg["task_ids"])
+
+        def _requeue(record: TaskRecord):
+            self._release_task_resources(record)
+            record.worker_id = None
+            if record.state != "cancelled":
+                record.state = "ready"
+                self._ready.append(record)
+
+        kept: Deque[TaskRecord] = deque()
+        for record in w.pending:
+            if record.spec.task_id in reclaimed:
+                _requeue(record)
+            else:
+                kept.append(record)
+        w.pending = kept
+        # Race: a completion that beat this reply may have PROMOTED a
+        # reclaimed task to w.current — the worker will never run it (its
+        # frame left the queue), so it must requeue too or it hangs.
+        while w.current is not None and w.current.spec.task_id in reclaimed:
+            _requeue(w.current)
+            w.current = w.pending.popleft() if w.pending else None
+        if w.current is None and w.state == "busy":
+            w.state = "idle"
+            self._idle[w.worker_type].append(w.worker_id)
+        self._schedule()
 
     def _on_worker_unblocked(self, w: WorkerHandle):
         if w.state == "blocked" and w.current is not None:

@@ -22,6 +22,17 @@ _HEADER = struct.Struct("<I")
 MAX_FRAME = 1 << 31
 
 
+def dumps_msg(message: Any) -> bytes:
+    """Serialize a control message. Hot path uses the C pickler (specs,
+    ids, locations — all plainly picklable, ~5x faster than cloudpickle);
+    cloudpickle only as fallback for payloads that need it (closures,
+    dynamic classes riding inside error values etc.)."""
+    try:
+        return pickle.dumps(message, protocol=5)
+    except Exception:
+        return cloudpickle.dumps(message, protocol=5)
+
+
 class ConnectionClosed(Exception):
     pass
 
@@ -37,7 +48,7 @@ class Connection:
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
 
     def send(self, message: Dict[str, Any]):
-        payload = cloudpickle.dumps(message, protocol=5)
+        payload = dumps_msg(message)
         if len(payload) >= MAX_FRAME:
             raise ValueError("message too large for frame")
         with self._send_lock:
@@ -93,10 +104,18 @@ class AioFramedWriter:
         self._lock = asyncio.Lock()
 
     async def send(self, message: Dict[str, Any]):
-        payload = cloudpickle.dumps(message, protocol=5)
+        payload = dumps_msg(message)
         async with self._lock:
             self._writer.write(_HEADER.pack(len(payload)) + payload)
             await self._writer.drain()
+
+    def send_nowait(self, message: Dict[str, Any]):
+        """Buffered write without awaiting drain — the dispatch hot path
+        (small control frames; the transport's own buffer provides the
+        backpressure boundary). Safe to interleave with send(): write()
+        itself is atomic per call on the loop thread."""
+        payload = dumps_msg(message)
+        self._writer.write(_HEADER.pack(len(payload)) + payload)
 
     def close(self):
         try:

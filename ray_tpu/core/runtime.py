@@ -24,6 +24,15 @@ from .serialization import serialize
 from .task_spec import RefArg, TaskSpec, TaskType, ValueArg
 
 
+def _log_post_error(fut):
+    try:
+        fut.result()
+    except Exception as e:  # pragma: no cover - diagnostics only
+        import sys
+
+        sys.stderr.write(f"[ray_tpu] async control call failed: {e!r}\n")
+
+
 class RefCountTable:
     """Per-process local refcounts with batched delta flushing to the owner
     directory (ref analogue: local refs in reference_count.h, flushed like
@@ -72,6 +81,7 @@ class BaseRuntime:
         self.runtime_env_key: str = ""
         self.current_actor_id: Optional[ActorID] = None
         self._registered_functions: set = set()
+        self._function_ids: Dict[int, str] = {}
         self._flusher_stop = threading.Event()
         self._flusher = threading.Thread(
             target=self._flush_loop, name="ray_tpu-ref-flusher", daemon=True
@@ -221,11 +231,28 @@ class BaseRuntime:
         return spec_args, spec_kwargs, keepalive
 
     def ensure_function(self, fn) -> str:
+        # Identity-keyed fast path: re-pickling the function on every
+        # .remote() call costs more than the whole submit otherwise.
+        function_id = self._function_ids.get(id(fn))
+        if function_id is not None:
+            return function_id
         function_id, blob = export_function(fn)
         if function_id not in self._registered_functions:
             self._register_function_remote(function_id, blob)
             self._registered_functions.add(function_id)
             self.function_cache.add_blob(function_id, blob)
+        # The id() key is only valid while fn is alive; evict the entry on
+        # collection rather than pinning fn (pinning would leak every
+        # dynamically-created function and its captured closure forever).
+        self._function_ids[id(fn)] = function_id
+        try:
+            import weakref
+
+            weakref.finalize(fn, self._function_ids.pop, id(fn), None)
+        except TypeError:
+            # Not weakref-able (rare: builtins/partials): drop the cache
+            # entry immediately — correctness over speed.
+            self._function_ids.pop(id(fn), None)
         return function_id
 
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
@@ -244,6 +271,9 @@ class DriverRuntime(BaseRuntime):
 
     def __init__(self, node_manager, job_id: JobID):
         self._nm = node_manager
+        self._submit_lock = threading.Lock()
+        self._submit_buf: List[TaskSpec] = []
+        self._submit_waking = False
         super().__init__(
             job_id=job_id,
             node_id=node_manager.node_id,
@@ -260,8 +290,42 @@ class DriverRuntime(BaseRuntime):
 
         self._nm._call(_apply())
 
+    def _post(self, coro):
+        """Fire a coroutine onto the node manager's loop without blocking
+        the driver thread (the submit/put hot path — reference analogue:
+        CoreWorker's async SubmitTask, core_worker.cc:1931, which never
+        round-trips to the raylet before returning the ObjectRef).
+        Failures surface through the task/object state, not the call."""
+        fut = self._nm._call(coro)
+        fut.add_done_callback(_log_post_error)
+
     def _submit_spec(self, spec: TaskSpec):
-        self._nm.call_sync(self._nm.submit_task(spec))
+        # Batch bursts of submits into ONE loop wake-up: each
+        # call_soon_threadsafe writes the loop's self-pipe (a syscall that
+        # dominates the submit path on small tasks), so a tight
+        # `[f.remote() for _ in range(n)]` loop pays it once, not n times.
+        with self._submit_lock:
+            self._submit_buf.append(spec)
+            wake = not self._submit_waking
+            self._submit_waking = True
+        if wake:
+            self._nm._loop.call_soon_threadsafe(self._drain_submits)
+
+    def _drain_submits(self):
+        with self._submit_lock:
+            specs = self._submit_buf
+            self._submit_buf = []
+            self._submit_waking = False
+        nm = self._nm
+        for spec in specs:
+            try:
+                nm.submit_task_sync(spec)
+            except Exception as e:  # pragma: no cover - diagnostics only
+                import sys
+
+                sys.stderr.write(
+                    f"[ray_tpu] submit of {spec.name!r} failed: {e!r}\n"
+                )
 
     def _get_locations(self, ids, timeout):
         # asyncio.TimeoutError is TimeoutError on py>=3.11, so callers'
@@ -272,7 +336,7 @@ class DriverRuntime(BaseRuntime):
         return self._nm.call_sync(self._nm.wait_objects(ids, num_returns, timeout))
 
     def _register_put(self, oid: ObjectID, loc: Location):
-        self._nm.call_sync(self._nm.put_object(oid, loc, refs=0))
+        self._post(self._nm.put_object(oid, loc, refs=0))
 
     def _register_function_remote(self, function_id: str, blob: bytes):
         self._nm.call_sync(self._nm.register_function(function_id, blob))

@@ -13,6 +13,7 @@ import json
 import os
 import threading
 import time
+from collections import deque as _deque
 from typing import Any, Dict, List, Optional
 
 import cloudpickle
@@ -26,7 +27,9 @@ class TaskEventBuffer:
     """Per-process span recorder (ref: TaskEventBuffer)."""
 
     def __init__(self, node8: str = "local"):
-        self._events: List[Dict[str, Any]] = []
+        # deque(maxlen=...): eviction at capacity is O(1), a list's pop(0)
+        # would make every task after the cap pay O(n).
+        self._events: Any = _deque(maxlen=MAX_EVENTS_PER_WORKER)
         self._lock = threading.Lock()
         self._last_flush = 0.0
         self._node8 = node8
@@ -35,8 +38,6 @@ class TaskEventBuffer:
     def record(self, name: str, start: float, end: float,
                task_id: str = "") -> None:
         with self._lock:
-            if len(self._events) >= MAX_EVENTS_PER_WORKER:
-                self._events.pop(0)
             self._events.append({
                 "name": name,
                 "ts": start,

@@ -9,7 +9,7 @@ main-thread task queue; replies resolve pending runtime requests.
 from __future__ import annotations
 
 import os
-import queue
+from collections import deque
 import sys
 import threading
 from typing import List
@@ -29,7 +29,12 @@ class Worker:
     def __init__(self, conn: Connection, worker_id: WorkerID):
         self.conn = conn
         self.worker_id = worker_id
-        self.task_queue: "queue.Queue" = queue.Queue()
+        # Reclaimable task queue (deque + condition instead of
+        # queue.Queue): pipelined frames must support removal when the
+        # node manager reclaims not-yet-started tasks from a blocked
+        # worker (see _reader_loop "reclaim").
+        self._tq: "deque" = deque()
+        self._tq_cv = threading.Condition()
         self.actor = ActorContainer()
         self.runtime: WorkerRuntime | None = None
         self._alive = True
@@ -77,26 +82,56 @@ class Worker:
             print(f"ray_tpu worker: runtime_env setup failed: {e!r}",
                   file=sys.stderr)
 
+    def _tq_put(self, msg):
+        with self._tq_cv:
+            self._tq.append(msg)
+            self._tq_cv.notify()
+
+    def _tq_get(self):
+        with self._tq_cv:
+            while not self._tq:
+                self._tq_cv.wait()
+            return self._tq.popleft()
+
     def _reader_loop(self):
         try:
             while self._alive:
                 msg = self.conn.recv()
                 mtype = msg["type"]
                 if mtype == "execute":
-                    self.task_queue.put(msg)
+                    self._tq_put(msg)
                 elif mtype == "reply":
                     self.runtime.handle_reply(msg)
+                elif mtype == "reclaim":
+                    # Hand back pipelined tasks that have NOT started (the
+                    # main thread is blocked or busy): the node manager
+                    # redispatches exactly the ids we confirm.
+                    wanted = set(msg["task_ids"])
+                    removed = []
+                    with self._tq_cv:
+                        kept = deque()
+                        for m in self._tq:
+                            spec = m.get("spec") if m else None
+                            if spec is not None and spec.task_id in wanted:
+                                removed.append(spec.task_id)
+                            else:
+                                kept.append(m)
+                        self._tq.clear()
+                        self._tq.extend(kept)
+                    self.conn.send(
+                        {"type": "reclaimed", "task_ids": removed}
+                    )
                 elif mtype == "kill":
                     self._alive = False
-                    self.task_queue.put(None)
+                    self._tq_put(None)
                     break
         except (ConnectionClosed, OSError):
             self._alive = False
-            self.task_queue.put(None)
+            self._tq_put(None)
 
     def _main_loop(self):
         while self._alive:
-            msg = self.task_queue.get()
+            msg = self._tq_get()
             if msg is None:
                 break
             spec = msg["spec"]
