@@ -209,11 +209,19 @@ class Autoscaler:
                 by_provider[pid] = v
 
         # Booting bookkeeping: a node is no longer booting once its view
-        # registers, the provider lost it, or its boot deadline passed.
+        # registers or the provider lost it. A node that blows its boot
+        # deadline is TERMINATED, not just forgotten — a hung instance
+        # would otherwise leak cost and pin a max_workers slot forever.
         live_set = set(live)
         for nid, (_t, deadline) in list(self._booting.items()):
-            if nid in by_provider or nid not in live_set or now > deadline:
+            if nid in by_provider or nid not in live_set:
                 self._booting.pop(nid, None)
+            elif now > deadline:
+                self._booting.pop(nid, None)
+                try:
+                    self.provider.terminate_node(nid)
+                except Exception:
+                    pass
         booting_capacity = [
             dict(self.config.node_types[t]["resources"])
             for t, _deadline in self._booting.values()

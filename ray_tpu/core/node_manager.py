@@ -165,6 +165,10 @@ class WorkerHandle:
     # fetch in flight). While nonzero the send_nowait fast path is off so
     # frames cannot overtake each other (per-caller actor call order).
     slow_sends: int = 0
+    # Serializes slow sends themselves: two blob-fetching sends would
+    # otherwise race on fetch latency and reorder. FIFO-fair asyncio lock,
+    # acquired in frame-submission order.
+    send_lock: "asyncio.Lock" = field(default_factory=lambda: asyncio.Lock())
     known_functions: Set[str] = field(default_factory=set)
     actor_id: Optional[ActorID] = None
     last_active: float = field(default_factory=time.monotonic)
@@ -755,21 +759,20 @@ class NodeManager:
         if w.actor_id is not None:
             await self._on_actor_worker_death(w)
         elif w.current is not None or w.pending:
-            lost = ([w.current] if w.current is not None else []) + list(
-                w.pending
-            )
+            running = w.current
+            queued = list(w.pending)
             w.current = None
             w.pending.clear()
-            for record in lost:
-                self._release_task_resources(record)
-                if record.state == "cancelled":
+            if running is not None:
+                self._release_task_resources(running)
+                if running.state == "cancelled":
                     pass
-                elif record.spec.retries_left > 0:
-                    record.spec.retries_left -= 1
-                    record.state = "ready"
-                    record.worker_id = None
+                elif running.spec.retries_left > 0:
+                    running.spec.retries_left -= 1
+                    running.state = "ready"
+                    running.worker_id = None
                     self._stats["tasks_retried"] += 1
-                    self._ready.append(record)
+                    self._ready.append(running)
                 else:
                     detail = (
                         "killed by the node memory monitor (out of memory)"
@@ -777,8 +780,17 @@ class NodeManager:
                         else ""
                     )
                     self._fail_task(
-                        record, WorkerCrashedError(record.spec.name, detail)
+                        running, WorkerCrashedError(running.spec.name, detail)
                     )
+            for record in queued:
+                # Pipelined frames never STARTED on this worker — requeue
+                # them without charging a retry (a neighbor's death is not
+                # this task's failure).
+                self._release_task_resources(record)
+                if record.state != "cancelled":
+                    record.state = "ready"
+                    record.worker_id = None
+                    self._ready.append(record)
         elif prev_state in ("busy", "blocked"):
             pass
         if w.proc is not None and w.proc.poll() is None:
@@ -1691,8 +1703,12 @@ class NodeManager:
         worker = self._take_idle_worker(wtype)
         pipelined = False
         if worker is None:
-            worker = self._pipeline_candidate(wtype)
-            pipelined = worker is not None
+            # Prefer a NEW worker while the pool can still grow (pipelining
+            # onto a busy worker would serialize tasks with CPUs free);
+            # pipeline only once the pool is saturated.
+            if not self._can_grow_pool(wtype):
+                worker = self._pipeline_candidate(wtype)
+                pipelined = worker is not None
         if worker is None:
             spawn_needed.add(wtype)
             return False
@@ -1711,6 +1727,21 @@ class NodeManager:
             worker.current = record
         self._send_execute_to(worker, spec)
         return True
+
+    def _can_grow_pool(self, wtype: str) -> bool:
+        """Whether another worker process could still be added and used
+        (mirrors _maybe_spawn_worker's bound: dispatchable slots = CPUs
+        plus blocked workers, capped by max_workers)."""
+        if len(self._workers) + self._num_starting() >= self.config.max_workers:
+            return False
+        cpu_total = max(1, int(self.node_resources.total.get(CPU)))
+        n_blocked = sum(
+            1 for w in self._workers.values() if w.state == "blocked"
+        )
+        active = sum(
+            1 for w in self._workers.values() if w.state != "dead"
+        )
+        return active + self._num_starting() < cpu_total + n_blocked
 
     def _pipeline_candidate(self, wtype: str) -> Optional[WorkerHandle]:
         """A busy (non-actor, non-blocked) worker with spare pipeline
@@ -1793,10 +1824,14 @@ class NodeManager:
             return
 
         async def _ordered():
-            try:
-                await self._send_execute(worker, spec)
-            finally:
-                worker.slow_sends -= 1
+            # The lock is taken before the first await inside, and tasks
+            # start in ensure_future order, so frames go out in submission
+            # order even when blob fetches finish out of order.
+            async with worker.send_lock:
+                try:
+                    await self._send_execute(worker, spec)
+                finally:
+                    worker.slow_sends -= 1
 
         worker.slow_sends += 1
         asyncio.ensure_future(_ordered())

@@ -448,19 +448,23 @@ class ObjectDirectory:
             if self._refcounts[object_id] <= 0:
                 self._zero_since.setdefault(object_id, time.monotonic())
 
-    def collect_garbage(self, grace_s: float):
+    def collect_garbage(self, grace_s: float, limit: int = 4096):
         """Pop and return [(oid, loc)] for entries at refcount <= 0 for
-        longer than ``grace_s`` seconds."""
+        longer than ``grace_s`` seconds. ``limit`` bounds one sweep so a
+        burst of dead objects (a put-heavy benchmark, a dropped dataset)
+        cannot stall the event loop under this lock — the rest goes next
+        sweep."""
         import time
 
         now = time.monotonic()
         out = []
         with self._lock:
-            expired = [
-                oid
-                for oid, t in self._zero_since.items()
-                if now - t >= grace_s and self._refcounts.get(oid, 0) <= 0
-            ]
+            expired = []
+            for oid, t in self._zero_since.items():
+                if now - t >= grace_s and self._refcounts.get(oid, 0) <= 0:
+                    expired.append(oid)
+                    if len(expired) >= limit:
+                        break
             for oid in expired:
                 loc = self._entries.pop(oid, None)
                 self._refcounts.pop(oid, None)

@@ -70,7 +70,7 @@ def run_microbenchmarks(
         )
         results[n] = rate
 
-    # --- object store -----------------------------------------------------
+    # --- object store (small) ---------------------------------------------
     small_ref = ray_tpu.put(b"x")
 
     def get_small():
@@ -82,13 +82,6 @@ def run_microbenchmarks(
         ray_tpu.put(0)
 
     record("single client put calls", put_small)
-
-    arr = np.zeros(payload_mb * 1024 * 1024 // 8, dtype=np.int64)
-
-    def put_large():
-        ray_tpu.put(arr)
-
-    record("single client put gigabytes", put_large, payload_mb / 1024.0)
 
     # --- tasks ------------------------------------------------------------
     @ray_tpu.remote
@@ -129,6 +122,83 @@ def run_microbenchmarks(
 
     record("actor calls with object arg", actor_arg_batch, batch)
 
+    # --- object store (large) — LAST: the ~GB of dead 10 MiB objects this
+    # creates sits at zero refs until the GC grace passes and would spill-
+    # thrash every benchmark that ran after it.
+    arr = np.zeros(payload_mb * 1024 * 1024 // 8, dtype=np.int64)
+
+    def put_large():
+        ray_tpu.put(arr)
+
+    record("single client put gigabytes", put_large, payload_mb / 1024.0)
+
+    return results
+
+
+def run_envelope_probes(
+    *,
+    num_args: int = 1000,
+    num_queued: int = 10_000,
+    num_returns: int = 300,
+    num_get: int = 2000,
+) -> Dict[str, float]:
+    """Scalability-envelope probes (ref: release/benchmarks/README.md —
+    object args to one task, tasks queued on one node, returns from one
+    task, plasma objects in one get). Sized for the sandbox; each scales
+    linearly so the envelope number is rate * published-scale."""
+    import ray_tpu
+
+    results: Dict[str, float] = {}
+
+    # --- N object args to a single task (ref envelope: 10k+) -------------
+    refs = [ray_tpu.put(i) for i in range(num_args)]
+
+    @ray_tpu.remote
+    def count(*xs):
+        return len(xs)
+
+    t0 = time.perf_counter()
+    assert ray_tpu.get(count.remote(*refs), timeout=300) == num_args
+    results[f"{num_args} object args to one task seconds"] = (
+        time.perf_counter() - t0
+    )
+    del refs
+
+    # --- N tasks queued on one node (ref envelope: 1M+) ------------------
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    t0 = time.perf_counter()
+    queued = [noop.remote() for _ in range(num_queued)]
+    submit_dt = time.perf_counter() - t0
+    results[f"{num_queued} queued tasks submit ops/s"] = num_queued / submit_dt
+    ray_tpu.get(queued, timeout=600)
+    results[f"{num_queued} queued tasks drain ops/s"] = num_queued / (
+        time.perf_counter() - t0
+    )
+    del queued
+
+    # --- N returns from a single task (ref envelope: 3k+) ----------------
+    @ray_tpu.remote(num_returns=num_returns)
+    def fan_out():
+        return tuple(range(num_returns))
+
+    t0 = time.perf_counter()
+    out = ray_tpu.get(list(fan_out.remote()), timeout=300)
+    assert len(out) == num_returns
+    results[f"{num_returns} returns from one task seconds"] = (
+        time.perf_counter() - t0
+    )
+
+    # --- N objects in a single get (ref envelope: 10k+) ------------------
+    refs = [ray_tpu.put(i) for i in range(num_get)]
+    t0 = time.perf_counter()
+    vals = ray_tpu.get(refs, timeout=300)
+    assert len(vals) == num_get
+    results[f"{num_get} objects in one get seconds"] = (
+        time.perf_counter() - t0
+    )
     return results
 
 
