@@ -217,11 +217,21 @@ class Autoscaler:
             if nid in by_provider or nid not in live_set:
                 self._booting.pop(nid, None)
             elif now > deadline:
-                self._booting.pop(nid, None)
                 try:
                     self.provider.terminate_node(nid)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # Transient provider failure: keep the entry with a
+                    # short extension so termination retries, and say so —
+                    # silently dropping it would leak the instance.
+                    import sys
+
+                    sys.stderr.write(
+                        f"[autoscaler] terminate of hung node {nid} "
+                        f"failed ({e!r}); will retry\n"
+                    )
+                    self._booting[nid] = (_t, now + 5.0)
+                else:
+                    self._booting.pop(nid, None)
         booting_capacity = [
             dict(self.config.node_types[t]["resources"])
             for t, _deadline in self._booting.values()

@@ -64,6 +64,14 @@ class Config:
     # Chunk size for inter-node object transfer (ref:
     # object_manager_default_chunk_size = 5 MiB).
     object_transfer_chunk_bytes: int = 5 * 1024 * 1024
+    # Admission control for the chunked transfer plane (ref:
+    # pull_manager.h:52 / push_manager.h:30): concurrent large-object
+    # pulls per node, in-flight chunk frames per node (staging memory =
+    # chunks * chunk_bytes), concurrent chunk reads served per node.
+    pull_large_concurrency: int = 2
+    pull_chunks_in_flight: int = 4
+    serve_chunks_in_flight: int = 8
+    pull_chunk_timeout_s: float = 120.0
     # Use the native C++ shared-memory arena store (src/store/) when the
     # extension is importable/buildable; pure-Python per-object shm otherwise.
     use_native_store: bool = True
@@ -102,6 +110,10 @@ class Config:
     # autoscaler/_private/resource_demand_scheduler.py). 0 = fail fast.
     # Set > 0 when running an autoscaler so pending shapes drive upscale.
     infeasible_grace_s: float = 0.0
+    # How long a worker node retries a lost GCS before exiting (head
+    # restart tolerance; ref: gcs_rpc_server_reconnect_timeout_s,
+    # ray_config_def.h:451 — default 60s there).
+    gcs_reconnect_timeout_s: float = 30.0
     # How long a directory miss waits for a location to appear in the GCS
     # object directory before raising ObjectLostError. Generous because a
     # miss may just mean the producing task is still running on its node.

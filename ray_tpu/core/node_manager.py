@@ -322,6 +322,10 @@ class NodeManager:
         self._heartbeat_task: Optional[asyncio.Task] = None
         # NM-process store client for the pull/push data path.
         self.local_store = LocalObjectStore()
+        # Chunked, admission-controlled transfer plane (object_transfer.py).
+        from .object_transfer import ObjectTransfer
+
+        self._transfer = ObjectTransfer(self)
         # Placement-group bundles reserved on this node + pg routing cache.
         self._bundles: Dict[Tuple[str, int], BundleState] = {}
         self._pg_nodes: Dict[str, Dict[int, str]] = {}
@@ -400,13 +404,22 @@ class NodeManager:
             )
             self._apply_cluster_views(reply["nodes"])
         elif self._gcs_address is not None:
-            self._gcs_client = GcsClient(
-                self.node_id, self._gcs_address[0], self._gcs_address[1]
-            )
-            self._gcs_client.on_push = self._on_gcs_push
-            await self._gcs_client.connect()
-            self._gcs = RemoteGcsHandle(self._gcs_client)
-            reply = await self._gcs_client.request(
+            await self._connect_gcs()
+        self._heartbeat_task = asyncio.ensure_future(self._heartbeat_loop())
+        self._gc_task = asyncio.ensure_future(self._gc_loop())
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        self._memmon_task = asyncio.ensure_future(self._memory_monitor_loop())
+
+    async def _connect_gcs(self):
+        """Dial the GCS and register this node (first boot AND after a
+        head restart — registration is idempotent by node id)."""
+        client = GcsClient(
+            self.node_id, self._gcs_address[0], self._gcs_address[1]
+        )
+        client.on_push = self._on_gcs_push
+        await client.connect()
+        try:
+            reply = await client.request(
                 {
                     "op": "register_node",
                     "host": self.node_ip,
@@ -415,11 +428,58 @@ class NodeManager:
                     "labels": self.labels,
                 }
             )
-            self._apply_cluster_views(reply["nodes"])
-        self._heartbeat_task = asyncio.ensure_future(self._heartbeat_loop())
-        self._gc_task = asyncio.ensure_future(self._gc_loop())
-        self._health_task = asyncio.ensure_future(self._health_loop())
-        self._memmon_task = asyncio.ensure_future(self._memory_monitor_loop())
+        except BaseException:
+            # A connected-but-unregistered client must not linger: its
+            # reader task and on_push hook would mutate node state from an
+            # abandoned socket on every retry.
+            client.close()
+            raise
+        self._gcs_client = client
+        self._gcs = RemoteGcsHandle(client)
+        self._apply_cluster_views(reply["nodes"])
+
+    async def _reconnect_gcs(self) -> bool:
+        """Head-restart tolerance (ref analogue: NotifyGCSRestart,
+        node_manager.proto:361 + gcs_rpc_server_reconnect_timeout_s,
+        ray_config_def.h:451): a worker node that loses the GCS retries
+        the address with backoff, re-registers, and re-publishes its local
+        truth — named actors homed here and sealed object locations — so
+        the restarted head rebuilds runtime state from the survivors."""
+        deadline = time.monotonic() + self.config.gcs_reconnect_timeout_s
+        delay = 0.5
+        sys.stderr.write(
+            "[ray_tpu] GCS connection lost; attempting reconnect\n"
+        )
+        while time.monotonic() < deadline and not self._shutdown:
+            try:
+                await self._connect_gcs()
+            except Exception:
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 3.0)
+                continue
+            await self._republish_to_gcs()
+            sys.stderr.write("[ray_tpu] reconnected to restarted GCS\n")
+            return True
+        return False
+
+    async def _republish_to_gcs(self):
+        """After the head restarts from its snapshot, runtime state lives
+        only on surviving nodes: push ours back."""
+        for info in self._actors.values():
+            if info.state not in ("alive", "restarting", "pending"):
+                continue
+            spec = info.creation_spec
+            try:
+                await self._gcs.register_actor_node(
+                    spec.actor_id, self.node_id
+                )
+                if spec.name:
+                    await self._gcs.register_named_actor(
+                        spec.name, spec.actor_id, self.node_id, spec
+                    )
+            except Exception:
+                pass
+        await self._publish_all_sealed()
 
     # ------------------------------------------------------- cluster plumbing
 
@@ -540,9 +600,14 @@ class NodeManager:
                 except Exception:
                     pass
             elif self._gcs_client is not None and self._gcs_client.closed:
-                # The head is gone: a remote node cannot outlive the cluster.
-                sys.stderr.write("[ray_tpu] GCS connection lost; exiting node\n")
-                os._exit(1)
+                # Head gone: try to ride out a GCS restart before giving
+                # up (the node only dies once the reconnect window ends).
+                if not await self._reconnect_gcs():
+                    sys.stderr.write(
+                        "[ray_tpu] GCS gone past reconnect window; "
+                        "exiting node\n"
+                    )
+                    os._exit(1)
 
     async def _health_loop(self):
         """Detect workers that died before registering (e.g. import errors)
@@ -846,7 +911,9 @@ class NodeManager:
             self._on_remote_task_result(msg)
             return None
         if mtype == "pull_object":
-            return await self._serve_pull(msg["object_id"])
+            return await self._transfer.serve_pull(msg)
+        if mtype == "pull_chunk":
+            return await self._transfer.serve_chunk(msg)
         if mtype == "free_object":
             self._remove_ref(msg["object_id"])
             return None
@@ -1078,20 +1145,6 @@ class NodeManager:
         if not fut.done():
             fut.set_result(peer)
         return peer
-
-    async def _serve_pull(self, object_id: ObjectID) -> Dict[str, Any]:
-        loc = self.directory.lookup(object_id)
-        if loc is None or isinstance(loc, RemoteLocation):
-            return {"data": None}
-        try:
-            # Off-loop: a spilled location is a (possibly multi-GB) blocking
-            # disk read; shm reads also copy. Keep the control plane live.
-            data = await self._loop.run_in_executor(
-                None, self.local_store.get_bytes, loc
-            )
-            return {"data": data}
-        except Exception as e:
-            return {"data": None, "error": str(e)}
 
     def _build_dep_locs(self, spec: TaskSpec) -> Dict[ObjectID, Location]:
         """Location hints shipped with a forwarded task so the target can
@@ -2502,24 +2555,20 @@ class NodeManager:
     async def _pull_object(self, oid: ObjectID, loc: RemoteLocation) -> Location:
         try:
             peer = await self._get_peer(loc.node_id)
-            reply = await peer.request(
-                {"type": "pull_object", "object_id": oid}
-            )
+            got = await self._transfer.pull(peer, oid)
         except Exception as e:
             raise ObjectLostError(
-                f"object {oid.hex()} lives on unreachable node "
+                f"object {oid.hex()} unavailable from node "
                 f"{loc.node_id[:8]}: {e}"
             ) from e
-        data = reply.get("data")
-        if data is None:
-            raise ObjectLostError(
-                f"object {oid.hex()} was freed on node {loc.node_id[:8]}"
-                + (f" ({reply['error']})" if reply.get("error") else "")
-            )
-        if len(data) <= self.config.max_inline_object_size:
-            new_loc: Location = InlineLocation(bytes(data))
+        if isinstance(got, (bytes, bytearray, memoryview)):
+            if len(got) <= self.config.max_inline_object_size:
+                new_loc: Location = InlineLocation(bytes(got))
+            else:
+                new_loc = self.local_store.put_raw(oid, got)
         else:
-            new_loc = self.local_store.put_raw(oid, data)
+            # Chunked pull: bytes already landed in the local store.
+            new_loc = got
         self.directory.replace_location(oid, new_loc)
         # The pulled copy is now the locatable one (the source may free and
         # unpublish its copy once the hold is released).

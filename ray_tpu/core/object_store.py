@@ -84,6 +84,43 @@ Location = Union[
 ]
 
 
+class ObjectWriter:
+    """Incremental chunk writer returned by ``SharedStore.create_writer``:
+    space allocated up front, chunks written at their offsets, then sealed
+    (arena) or left in place (shm segment)."""
+
+    def __init__(self, *, kind: str, loc, view: memoryview,
+                 arena=None, raw=None, seg=None):
+        self.kind = kind
+        self.loc = loc
+        self._view = view
+        self._arena = arena
+        self._raw = raw  # arena View (keeps the creator pin)
+        self._seg = seg
+
+    def write(self, offset: int, data) -> None:
+        self._view[offset:offset + len(data)] = data
+
+    def finalize(self):
+        if self.kind == "arena":
+            self._view.release()
+            self._arena.seal(self.loc.oid)
+            self._raw.release()
+        return self.loc
+
+    def abort(self) -> None:
+        try:
+            if self.kind == "arena":
+                self._view.release()
+                self._raw.release()
+                self._arena.abort(self.loc.oid)
+            else:
+                self._seg.close()
+                shared_memory.SharedMemory(name=self.loc.name).unlink()
+        except Exception:
+            pass
+
+
 class _RawPayload:
     """Adapter presenting already-framed object bytes (as pulled from a
     remote node) with the SerializedObject write interface."""
@@ -235,6 +272,63 @@ class LocalObjectStore:
     def put_raw(self, object_id: ObjectID, data) -> Location:
         """Store already-framed object bytes (pulled from a remote node)."""
         return self.put_serialized(object_id, _RawPayload(data))
+
+    def create_writer(self, object_id: ObjectID, size: int) -> "ObjectWriter":
+        """Allocate ``size`` bytes up front and return an incremental
+        writer: chunked pulls land each chunk directly in shared memory,
+        so a 1 GiB transfer needs 1 GiB of store — never a second
+        staging copy (ref analogue: the plasma CreateObject the object
+        manager writes received chunks into, object_buffer_pool.h)."""
+        arena = current_arena()
+        if arena is not None:
+            oid = object_id.binary()
+            try:
+                view = arena.alloc(oid, size)
+            except FileExistsError:
+                arena.delete(oid)
+                try:
+                    view = arena.alloc(oid, size)
+                except (FileExistsError, MemoryError):
+                    view = None
+            except MemoryError:
+                view = None
+            if view is not None:
+                return ObjectWriter(
+                    kind="arena", arena=arena, raw=view,
+                    view=memoryview(view),
+                    loc=ArenaLocation(arena.name, oid, size),
+                )
+        name = _shm_name(object_id)
+        created = True
+        try:
+            seg = shared_memory.SharedMemory(name=name, create=True, size=size)
+        except FileExistsError:
+            seg = _attach_untracked(name)
+            if seg.size < size:
+                seg.close()
+                old = shared_memory.SharedMemory(name=name)
+                old.unlink()
+                old.close()
+                seg = shared_memory.SharedMemory(
+                    name=name, create=True, size=size
+                )
+            else:
+                created = False
+        if created:
+            # Every create=True registers with the resource tracker, which
+            # would unlink the LIVE segment at process exit — untrack it
+            # (the directory owns the lifecycle), in BOTH create branches.
+            try:
+                resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
+            except Exception:
+                pass
+        with self._lock:
+            self._segments[name] = seg
+            self._created[name] = seg
+        return ObjectWriter(
+            kind="shm", seg=seg, view=seg.buf,
+            loc=ShmLocation(name, size),
+        )
 
     def get_bytes(self, loc: Location) -> bytes:
         """Copy out the framed bytes of a local object (the push side of

@@ -1,0 +1,196 @@
+"""Chunked, flow-controlled inter-node object transfer.
+
+Plays the role of the reference's object manager data plane (ref:
+src/ray/object_manager/object_manager.h Push/Pull over
+object_manager.proto:61): large objects move as bounded-size chunks
+(``object_transfer_chunk_bytes``, ref object_manager_default_chunk_size =
+5 MiB, common/ray_config_def.h:362) with admission control on both sides —
+the puller bounds concurrent large pulls and in-flight chunk frames (ref:
+pull_manager.h:52 bundles admitted against available memory), the server
+bounds concurrent chunk reads (ref: push_manager.h:30 rate-limited chunked
+sends). Received chunks land directly in a pre-allocated store block
+(``LocalObjectStore.create_writer``), so a 1 GiB transfer occupies 1 GiB of
+store plus a few staged chunks — never a second whole-object copy, and the
+peer socket interleaves other RPCs between chunks instead of being held
+hostage by one giant frame.
+
+Dedup notes: per-object pull dedup lives in the node manager's ``_pulls``
+future table (one pull per object per node, concurrent requesters share
+it); a broadcast (N nodes pulling one object) therefore issues exactly one
+pull per receiving node, and the source's serve semaphore spreads chunk
+reads across the N peer connections — the role of the reference's
+PushManager dedup.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict
+
+from .ids import ObjectID
+from .object_store import Location
+
+
+class TransferError(Exception):
+    """Data-plane failure; the caller maps it to object recovery."""
+
+
+class ObjectTransfer:
+    """Both halves of the chunk protocol, owned by the node manager."""
+
+    def __init__(self, node_manager):
+        self._nm = node_manager
+        cfg = node_manager.config
+        self.chunk_bytes = int(cfg.object_transfer_chunk_bytes)
+        # Puller-side admission: whole large pulls, then chunk frames.
+        self._pull_slots = asyncio.Semaphore(cfg.pull_large_concurrency)
+        self._chunk_slots = asyncio.Semaphore(cfg.pull_chunks_in_flight)
+        # Server-side: bound concurrent chunk reads (each stages one
+        # chunk_bytes copy + an executor thread).
+        self._serve_slots = asyncio.Semaphore(cfg.serve_chunks_in_flight)
+        self.stats = {"chunks_pulled": 0, "chunks_served": 0,
+                      "chunked_pulls": 0}
+
+    # ------------------------------------------------------------- pull side
+
+    async def pull(self, peer, oid: ObjectID) -> bytes | Location:
+        """Fetch one object from ``peer``. Returns raw framed bytes for
+        small objects (caller stores them) or a ready local Location for
+        chunked large objects (bytes already in the store)."""
+        reply = await peer.request(
+            {"type": "pull_object", "object_id": oid,
+             "max_unchunked": self.chunk_bytes}
+        )
+        data = reply.get("data")
+        if data is not None:
+            return data
+        size = reply.get("size")
+        if not reply.get("chunked") or size is None:
+            raise TransferError(
+                reply.get("error") or "object freed on source"
+            )
+        async with self._pull_slots:
+            self.stats["chunked_pulls"] += 1
+            return await self._pull_chunked(peer, oid, int(size))
+
+    async def _pull_chunked(self, peer, oid: ObjectID, size: int) -> Location:
+        store = self._nm.local_store
+        loop = self._nm._loop
+        writer = await loop.run_in_executor(
+            None, store.create_writer, oid, size
+        )
+        try:
+            chunk = self.chunk_bytes
+
+            async def fetch(offset: int):
+                length = min(chunk, size - offset)
+                async with self._chunk_slots:
+                    reply = await peer.request(
+                        {"type": "pull_chunk", "object_id": oid,
+                         "offset": offset, "length": length},
+                        timeout=self._nm.config.pull_chunk_timeout_s,
+                    )
+                    data = reply.get("data")
+                    if data is None or len(data) != length:
+                        raise TransferError(
+                            reply.get("error")
+                            or f"chunk @{offset} missing from source"
+                        )
+                    # Copy into shared memory off-loop (a 5 MiB memmove
+                    # should not stall the control plane).
+                    await loop.run_in_executor(None, writer.write, offset,
+                                               data)
+                    self.stats["chunks_pulled"] += 1
+
+            tasks = [
+                asyncio.ensure_future(fetch(off))
+                for off in range(0, size, chunk)
+            ]
+            try:
+                await asyncio.gather(*tasks)
+            except BaseException:
+                # Quiesce siblings BEFORE aborting the writer: a fetch
+                # mid-write must not touch the released buffer.
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                raise
+            return await loop.run_in_executor(None, writer.finalize)
+        except BaseException:
+            writer.abort()
+            raise
+
+    # ------------------------------------------------------------ serve side
+
+    async def serve_pull(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """First request of a pull: small objects answer with their bytes
+        (one round trip, as before); large ones advertise chunking."""
+        oid = msg["object_id"]
+        found = self._lookup_local(oid)
+        if found is None:
+            return {"data": None}
+        loc, size = found
+        max_unchunked = int(msg.get("max_unchunked") or 0)
+        if max_unchunked and size > max_unchunked:
+            return {"data": None, "chunked": True, "size": size}
+        try:
+            data = await self._nm._loop.run_in_executor(
+                None, self._nm.local_store.get_bytes, loc
+            )
+            return {"data": data}
+        except Exception as e:
+            return {"data": None, "error": str(e)}
+
+    async def serve_chunk(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        oid = msg["object_id"]
+        offset, length = int(msg["offset"]), int(msg["length"])
+        found = self._lookup_local(oid)
+        if found is None:
+            return {"data": None, "error": "object freed on source"}
+        loc, _size = found
+        async with self._serve_slots:
+            try:
+                data = await self._nm._loop.run_in_executor(
+                    None, self._read_range, loc, offset, length
+                )
+                self.stats["chunks_served"] += 1
+                return {"data": data}
+            except Exception as e:
+                return {"data": None, "error": str(e)}
+
+    def _lookup_local(self, oid: ObjectID):
+        from .object_store import (
+            InlineLocation,
+            RemoteLocation,
+            SpilledLocation,
+        )
+
+        loc = self._nm.directory.lookup(oid)
+        if loc is None or isinstance(loc, RemoteLocation):
+            return None
+        if isinstance(loc, InlineLocation):
+            return loc, len(loc.data)
+        if isinstance(loc, SpilledLocation):
+            import os
+
+            try:
+                return loc, os.path.getsize(loc.path)
+            except OSError:
+                return None
+        return loc, loc.size
+
+    def _read_range(self, loc, offset: int, length: int) -> bytes:
+        from .object_store import SpilledLocation
+
+        if isinstance(loc, SpilledLocation):
+            # Serve spilled objects straight from disk — a ranged read, no
+            # need to restore the whole object into the store first.
+            with open(loc.path, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        view = self._nm.local_store.get_view(loc)
+        try:
+            return bytes(view[offset:offset + length])
+        finally:
+            if hasattr(view, "release"):
+                view.release()

@@ -155,6 +155,159 @@ def forward_with_cache(
     return logits.astype(jnp.float32), KVCache(new_k, new_v, lengths)
 
 
+class PagedKVCache(NamedTuple):
+    """Paged KV cache: a SHARED pool of fixed-size token pages plus a
+    per-slot page table (the TPU-static analogue of vLLM's PagedAttention
+    — no reference counterpart; Ray stops at request batching). Memory is
+    bounded by ``total_pages * page_size`` tokens ACROSS requests instead
+    of ``max_batch * max_len`` each, so one long-context request coexists
+    with many short ones; pages recycle the moment a request finishes.
+    All shapes static for XLA: attention gathers each slot's pages
+    (``k[:, page_table]``) and masks by length — the gather is fused into
+    the attention einsum by XLA, never materialized to HBM twice."""
+
+    k: jax.Array            # [L, P_total, page, Hkv, Dh] shared pool
+    v: jax.Array            # [L, P_total, page, Hkv, Dh]
+    page_table: jax.Array   # [B, P_max] int32 page ids per slot
+    lengths: jax.Array      # [B] int32 valid tokens per slot
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @staticmethod
+    def create(cfg: LlamaConfig, batch: int, total_pages: int,
+               page_size: int, max_pages_per_seq: int) -> "PagedKVCache":
+        shape = (cfg.num_layers, total_pages, page_size,
+                 cfg.num_kv_heads, cfg.dh)
+        return PagedKVCache(
+            k=jnp.zeros(shape, dtype=cfg.dtype),
+            v=jnp.zeros(shape, dtype=cfg.dtype),
+            page_table=jnp.zeros((batch, max_pages_per_seq),
+                                 dtype=jnp.int32),
+            lengths=jnp.zeros((batch,), dtype=jnp.int32),
+        )
+
+
+def _layer_paged_decode(cfg, lp, x, ck, cv, page_table, lengths,
+                        page_ids, offsets, active):
+    """One block, single-token decode against the paged pool. x [B,1,M];
+    ck/cv [P, page, Hkv, Dh]; page_ids/offsets [B] name each slot's write
+    cell for this token (inactive slots scatter to id -1 → dropped)."""
+    B = x.shape[0]
+    page = ck.shape[1]
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = jnp.einsum("bsm,mhd->bshd", h, lp["wq"])
+    k = jnp.einsum("bsm,mhd->bshd", h, lp["wk"])
+    v = jnp.einsum("bsm,mhd->bshd", h, lp["wv"])
+    q_pos = lengths[:, None]
+
+    def rope_rows(x_b, pos_b):
+        return rope(x_b[None], pos_b, cfg.rope_theta)[0]
+
+    q = jax.vmap(rope_rows)(q, q_pos)
+    k = jax.vmap(rope_rows)(k, q_pos)
+    # Scatter this token's KV into each active slot's current page cell.
+    # Inactive slots aim past the pool: -1 would WRAP to the last page
+    # (NumPy semantics) and corrupt it; only >= n is truly dropped.
+    n_pages = ck.shape[0]
+    drop = jnp.where(active, page_ids, n_pages)
+    ck = ck.at[drop, offsets].set(
+        k[:, 0].astype(ck.dtype), mode="drop")
+    cv = cv.at[drop, offsets].set(
+        v[:, 0].astype(cv.dtype), mode="drop")
+    # Gather each slot's pages into its logical [T, Hkv, Dh] view.
+    kp = ck[page_table]  # [B, Pmax, page, Hkv, Dh]
+    vp = cv[page_table]
+    kp = kp.reshape(B, -1, kp.shape[-2], kp.shape[-1])
+    vp = vp.reshape(B, -1, vp.shape[-2], vp.shape[-1])
+    attn = _attend_cached(q, kp, vp, q_pos, lengths + 1, cfg)
+    x = x + jnp.einsum("bshd,hdm->bsm", attn.astype(x.dtype), lp["wo"])
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    if cfg.n_experts > 0:
+        from ..parallel.moe import moe_ffn
+
+        token_mask = jnp.broadcast_to(active[:, None], h.shape[:2])
+        out, _aux = moe_ffn(
+            h, lp["router"], lp["w_up"], lp["w_down"],
+            k=cfg.top_k, capacity_factor=cfg.capacity_factor,
+            w_gate=lp["w_gate"], token_mask=token_mask,
+        )
+        return x + out, ck, cv
+    up = jnp.einsum("bsm,mf->bsf", h, lp["w_up"])
+    gate = jnp.einsum("bsm,mf->bsf", h, lp["w_gate"])
+    h2 = jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up
+    return x + jnp.einsum("bsf,fm->bsm", h2, lp["w_down"]), ck, cv
+
+
+def paged_decode(
+    params: Dict[str, Any],
+    tokens: jax.Array,          # [B] one token per slot
+    cache: PagedKVCache,
+    cfg: LlamaConfig,
+    *,
+    active: jax.Array,          # [B] bool
+) -> Tuple[jax.Array, PagedKVCache]:
+    """One decode step over the paged pool: write each slot's token into
+    its current page cell, attend over its gathered pages, return [B, V]
+    logits and the updated cache."""
+    B = tokens.shape[0]
+    page = cache.page_size
+    page_ids = cache.page_table[jnp.arange(B), cache.lengths // page]
+    offsets = cache.lengths % page
+    x = params["embed"][tokens][:, None].astype(cfg.dtype)
+
+    def body(carry, layer_in):
+        x = carry
+        lp, ck, cv = layer_in
+        x, ck, cv = _layer_paged_decode(
+            cfg, lp, x, ck, cv, cache.page_table, cache.lengths,
+            page_ids, offsets, active,
+        )
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = jnp.einsum("bm,mv->bv", x[:, 0], params["lm_head"])
+    lengths = jnp.where(active, cache.lengths + 1, cache.lengths)
+    return logits.astype(jnp.float32), PagedKVCache(
+        new_k, new_v, cache.page_table, lengths
+    )
+
+
+def paged_prefill(
+    params: Dict[str, Any],
+    tokens: jax.Array,          # [1, S_bucket] padded prompt
+    real_len: jax.Array,        # [] int32 true prompt length
+    cache: PagedKVCache,
+    cfg: LlamaConfig,
+    slot: int | jax.Array,
+    pages: jax.Array,           # [S_bucket // page] page ids for this slot
+) -> Tuple[jax.Array, PagedKVCache]:
+    """Prefill one request through the dense single-row path, then scatter
+    the resulting rows into the slot's pool pages. The bucket length must
+    be a multiple of the page size (buckets are powers of two >= page)."""
+    S = tokens.shape[1]
+    page = cache.page_size
+    small = KVCache.create(cfg, 1, S)
+    logits, small = forward_with_cache(
+        params, tokens, small, cfg,
+        last_index=real_len[None] - 1, append_len=real_len[None],
+    )
+    n = S // page
+    # [L, 1, S, Hkv, Dh] -> [L, n, page, Hkv, Dh] -> scatter at page ids.
+    k_pages = small.k[:, 0].reshape(cfg.num_layers, n, page,
+                                    cfg.num_kv_heads, cfg.dh)
+    v_pages = small.v[:, 0].reshape(cfg.num_layers, n, page,
+                                    cfg.num_kv_heads, cfg.dh)
+    k = cache.k.at[:, pages].set(k_pages.astype(cache.k.dtype))
+    v = cache.v.at[:, pages].set(v_pages.astype(cache.v.dtype))
+    lengths = cache.lengths.at[slot].set(real_len)
+    return logits, PagedKVCache(k, v, cache.page_table, lengths)
+
+
 def sample_logits(logits: jax.Array, rng: jax.Array, *,
                   temperature: float = 0.0, top_k: int = 0) -> jax.Array:
     """Greedy (temperature 0) or temperature/top-k sampling. [B,V] → [B]."""

@@ -356,6 +356,56 @@ class DeploymentHandle:
             model_id=model_id or None,
         )
 
+    def stream(self, *args, **kwargs):
+        """Streaming request: yields response items as the replica
+        produces them (ref analogue: handle.options(stream=True) over the
+        replica's generator path + RESPONSE_STREAMING in proxy.py:1097).
+        Routing (p2c, model affinity, dead-replica retry) happens on the
+        first item; once a replica has started yielding, a mid-stream
+        death surfaces to the caller rather than silently replaying
+        side effects."""
+        import ray_tpu
+
+        model_id = self._model_id
+        state = self._state
+        last_err = None
+        for attempt in range(MAX_DEATH_RETRIES + 1):
+            try:
+                replica = state.pick(model_id or None)
+            except RuntimeError as e:
+                if attempt < MAX_DEATH_RETRIES:
+                    state.force_refresh()
+                    time.sleep(0.05 * (attempt + 1))
+                    continue
+                raise (last_err or e)
+            state.begin(replica)
+            started = False
+            try:
+                gen = replica.handle_request_streaming.options(
+                    num_returns="streaming"
+                ).remote(self._method, args, kwargs, model_id)
+                for ref in gen:
+                    value = ray_tpu.get(ref)
+                    started = True
+                    yield value
+                return
+            except Exception as e:  # noqa: BLE001
+                from ray_tpu.core.exceptions import (
+                    ActorDiedError,
+                    WorkerCrashedError,
+                )
+
+                if isinstance(e, (ActorDiedError, WorkerCrashedError)) \
+                        and not started:
+                    last_err = e
+                    state.evict(replica)
+                    state.force_refresh()
+                    continue
+                raise
+            finally:
+                state.end(replica)
+        raise last_err
+
     # ---- dynamic batching --------------------------------------------------
 
     def _remote_batched(self, args, kwargs) -> ServeFuture:

@@ -47,8 +47,41 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self.do_POST()
 
+    def _stream_reply(self, handle, arg):
+        """Server-sent events: one `data:` frame per item the replica's
+        generator yields, flushed as produced (ref analogue: proxy.py
+        RESPONSE_STREAMING over ASGI; `curl -N` shows tokens live)."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            for item in handle.stream(arg):
+                self.wfile.write(
+                    f"data: {json.dumps(item)}\n\n".encode()
+                )
+                self.wfile.flush()
+            self.wfile.write(b"event: end\ndata: null\n\n")
+            self.wfile.flush()
+        except BrokenPipeError:
+            pass  # client went away mid-stream
+        except Exception as e:  # noqa: BLE001
+            try:
+                self.wfile.write(
+                    f"event: error\ndata: {json.dumps(str(e))}\n\n".encode()
+                )
+                self.wfile.flush()
+            except Exception:
+                pass
+
     def do_POST(self):
-        name = self.path.strip("/").split("/")[0]
+        parts = self.path.strip("/").split("/")
+        name = parts[0]
+        streaming = (
+            (len(parts) > 1 and parts[1] == "stream")
+            or "text/event-stream" in (self.headers.get("Accept") or "")
+        )
         handle = _state.routes.get(name)
         if handle is None:
             # Dynamic discovery: any live deployment is routable without
@@ -85,6 +118,14 @@ class _Handler(BaseHTTPRequestHandler):
             arg = json.loads(raw) if raw else None
         except json.JSONDecodeError:
             self._reply(400, {"error": "invalid JSON body"})
+            return
+        if streaming:
+            # /<name>/<method> routes to that method (e.g. /llm/stream →
+            # the deployment's generator endpoint); bare /<name> with an
+            # SSE Accept header streams __call__'s result as one event.
+            if len(parts) > 1:
+                handle = handle.options(method=parts[1])
+            self._stream_reply(handle, arg)
             return
         try:
             result = handle.remote(arg).result(timeout=60)

@@ -1,20 +1,26 @@
-"""Continuous-batched LLM serving on TPU.
+"""Continuous-batched LLM serving on TPU with a paged KV cache.
 
 The capability the reference lacks (SURVEY.md §7 hard parts: "continuous
 batching + paged KV cache on TPU for Serve; reference has only
-request-level batching"): an engine with a static-shape slotted KV cache
-where requests JOIN and LEAVE the running decode loop — each decode step
-batches every active slot into one [B, 1] forward pass (HBM-bandwidth
-bound; batching amortizes the weight reads), while prefill runs per
-admission. All shapes static for XLA: the cache is [L, B_max, T_max, ...]
-and slot activity is a boolean mask.
+request-level batching"): an engine where requests JOIN and LEAVE the
+running decode loop — each decode step batches every active slot into one
+[B, 1] forward pass (HBM-bandwidth bound; batching amortizes the weight
+reads), while prefill runs per admission into power-of-two length buckets.
+
+KV memory is PAGED (models/generation.py PagedKVCache): a shared pool of
+fixed-size token pages with a per-slot page table. A request reserves only
+the pages its prompt + max_new_tokens need — not a dense max_len row — so
+total KV is bounded by actual demand, long-context requests coexist with
+short ones, and pages recycle the moment a request finishes. Admission
+waits for pages instead of OOMing. All shapes stay static for XLA.
 """
 
 from __future__ import annotations
 
+import math
 import queue
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -30,6 +36,9 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.ttft_s: Optional[float] = None
         self._t0 = None
+        # Incremental consumers (token streaming) read from here; None is
+        # the end-of-stream sentinel.
+        self._live: "queue.Queue[Optional[int]]" = queue.Queue()
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -38,18 +47,30 @@ class _Request:
             raise self.error
         return self.output
 
+    def tokens(self, timeout: Optional[float] = None) -> Iterator[int]:
+        """Yield tokens as the decode loop produces them."""
+        while True:
+            tok = self._live.get(timeout=timeout)
+            if tok is None:
+                if self.error:
+                    raise self.error
+                return
+            yield tok
+
 
 class LLMEngine:
-    """Slotted continuous-batching decode engine over the Llama family."""
+    """Paged continuous-batching decode engine over the Llama family."""
 
     def __init__(self, cfg, params, *, max_batch: int = 8,
-                 max_len: int = 512, temperature: float = 0.0):
+                 max_len: int = 512, temperature: float = 0.0,
+                 page_size: int = 16, total_pages: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
         from ..models.generation import (
-            KVCache,
-            forward_with_cache,
+            PagedKVCache,
+            paged_decode,
+            paged_prefill,
             sample_logits,
         )
 
@@ -58,52 +79,50 @@ class LLMEngine:
         self.max_batch = max_batch
         self.max_len = max_len
         self.temperature = temperature
+        self.page_size = page_size
+        self.max_pages_per_seq = math.ceil(max_len / page_size)
+        # Default pool: enough for every slot at max_len (same worst case
+        # as a dense cache); pass a smaller total_pages to oversubscribe.
+        self.total_pages = total_pages or (
+            max_batch * self.max_pages_per_seq
+        )
         self._jnp = jnp
         self._jax = jax
 
-        self.cache = KVCache.create(cfg, max_batch, max_len)
+        self.cache = PagedKVCache.create(
+            cfg, max_batch, self.total_pages, page_size,
+            self.max_pages_per_seq,
+        )
+        self._free_pages: List[int] = list(range(self.total_pages))
+        self._table = np.zeros(
+            (max_batch, self.max_pages_per_seq), dtype=np.int32
+        )
         self._slot_free = list(range(max_batch))
         self._slot_req: Dict[int, _Request] = {}
+        self._slot_pages: Dict[int, List[int]] = {}
         self._last_tok = np.zeros((max_batch,), dtype=np.int32)
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._waiting: List[_Request] = []  # admitted-but-no-pages
         self._lock = threading.Lock()
         self._stop = False
         self._step_count = 0
 
         def decode_step(params, cache, last_tok, active, key):
-            logits, cache = forward_with_cache(
-                params, last_tok[:, None], cache, cfg, active=active
+            logits, cache = paged_decode(
+                params, last_tok, cache, cfg, active=active
             )
             nxt = sample_logits(logits, key, temperature=temperature)
             return nxt, cache
 
         self._decode = jax.jit(decode_step)
 
-        # Prefill for one slot: compute a single-row cache then scatter its
-        # rows into the big cache at the slot index. Prompts are PADDED to
-        # power-of-two length buckets, so XLA compiles one program per
-        # bucket — O(log max_len) compilations — instead of one per
-        # distinct prompt length (r1 VERDICT weakness #7). last_index /
-        # append_len keep logits and cache lengths exact under padding.
-        def prefill(params, cache, tokens, real_len, slot):
-            from ..models.generation import KVCache as KC
-
-            small = KC.create(cfg, 1, max_len)
-            logits, small = forward_with_cache(
-                params, tokens, small, cfg,
-                last_index=real_len[None] - 1,
-                append_len=real_len,
+        def prefill(params, cache, tokens, real_len, slot, pages):
+            logits, cache = paged_prefill(
+                params, tokens, real_len, cache, cfg, slot, pages
             )
-            k = jax.lax.dynamic_update_slice(
-                cache.k, small.k, (0, slot, 0, 0, 0)
-            )
-            v = jax.lax.dynamic_update_slice(
-                cache.v, small.v, (0, slot, 0, 0, 0)
-            )
-            lengths = cache.lengths.at[slot].set(small.lengths[0])
             nxt = sample_logits(logits, jax.random.PRNGKey(0),
                                 temperature=temperature)
-            return KC(k, v, lengths), nxt[0]
+            return cache, nxt[0]
 
         self._prefill = jax.jit(prefill)
         self._rng = jax.random.PRNGKey(0)
@@ -120,6 +139,14 @@ class LLMEngine:
                 f"engine max_len({self.max_len})"
             )
         req = _Request(prompt, max_new_tokens, eos_token)
+        need = self._pages_needed(req, self._bucket(len(prompt)))
+        if need > self.total_pages:
+            # Unsatisfiable EVER: waiting would head-of-line block the
+            # admission queue forever.
+            raise ValueError(
+                f"request needs {need} pages but the pool has only "
+                f"{self.total_pages} (page_size={self.page_size})"
+            )
         import time
 
         req._t0 = time.perf_counter()
@@ -137,44 +164,85 @@ class LLMEngine:
                 "active_slots": len(self._slot_req),
                 "free_slots": len(self._slot_free),
                 "decode_steps": self._step_count,
+                "free_pages": len(self._free_pages),
+                "total_pages": self.total_pages,
+                "page_size": self.page_size,
             }
 
     def shutdown(self):
         self._stop = True
         self._thread.join(timeout=5)
 
+    # ---- page accounting ---------------------------------------------------
+
+    def _pages_needed(self, req: _Request, bucket: int) -> int:
+        decode_span = math.ceil(
+            (len(req.prompt) + req.max_new_tokens) / self.page_size
+        )
+        return max(bucket // self.page_size, decode_span)
+
+    def _release_slot(self, slot: int):
+        pages = self._slot_pages.pop(slot, [])
+        self._free_pages.extend(pages)
+        self._table[slot, :] = 0
+        self._slot_free.append(slot)
+
     # ---- engine loop -------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        bucket = self.page_size
+        while bucket < n:
+            bucket *= 2
+        return min(bucket, self.max_len)
 
     def _admit(self):
         import time
 
+        jnp = self._jnp
         while self._slot_free:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
+            if self._waiting:
+                req = self._waiting.pop(0)
+            else:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    return
+            real_len = len(req.prompt)
+            bucket = self._bucket(real_len)
+            need = self._pages_needed(req, bucket)
+            if need > len(self._free_pages):
+                # Paged admission control: wait for pages to recycle
+                # instead of OOMing or over-reserving a dense max_len row.
+                self._waiting.insert(0, req)
                 return
             slot = self._slot_free.pop()
-            jnp = self._jnp
-            real_len = len(req.prompt)
-            bucket = 16
-            while bucket < real_len:
-                bucket *= 2
-            bucket = min(bucket, self.max_len)
+            pages = [self._free_pages.pop() for _ in range(need)]
+            self._slot_pages[slot] = pages
+            self._table[slot, :] = 0
+            self._table[slot, :need] = pages
+            prefill_pages = pages[: bucket // self.page_size]
+            self.cache = self.cache._replace(
+                page_table=jnp.asarray(self._table)
+            )
             padded = req.prompt + [0] * (bucket - real_len)
             tokens = jnp.asarray([padded], dtype=jnp.int32)
             try:
                 self.cache, first = self._prefill(
                     self.params, self.cache, tokens,
-                    jnp.asarray(real_len, dtype=jnp.int32), slot
+                    jnp.asarray(real_len, dtype=jnp.int32),
+                    jnp.asarray(slot, dtype=jnp.int32),
+                    jnp.asarray(prefill_pages, dtype=jnp.int32),
                 )
                 first = int(first)
             except Exception as e:  # noqa: BLE001
                 req.error = e
                 req.done.set()
-                self._slot_free.append(slot)
+                req._live.put(None)
+                self._release_slot(slot)
                 continue
             req.ttft_s = time.perf_counter() - req._t0
             req.output.append(first)
+            req._live.put(first)
             with self._lock:
                 self._slot_req[slot] = req
             self._last_tok[slot] = first
@@ -185,8 +253,9 @@ class LLMEngine:
                 or (req.eos_token is not None and tok == req.eos_token)):
             with self._lock:
                 self._slot_req.pop(slot, None)
-            self._slot_free.append(slot)
+            self._release_slot(slot)
             req.done.set()
+            req._live.put(None)
 
     def _loop(self):
         import time
@@ -216,6 +285,7 @@ class LLMEngine:
             for slot, req in active_slots.items():
                 tok = int(nxt[slot])
                 req.output.append(tok)
+                req._live.put(tok)
                 self._last_tok[slot] = tok
                 self._finish_if_done(slot, req, tok)
 
@@ -223,11 +293,15 @@ class LLMEngine:
 class LLMDeployment:
     """Serve deployment wrapping an engine; deploy with
     ray_actor_options={"max_concurrency": N} so concurrent requests join
-    the running decode loop (continuous batching)."""
+    the running decode loop (continuous batching). ``stream`` yields
+    tokens as generated — route it through the proxy's SSE path
+    (``POST /<name>/stream``) for live token streaming."""
 
     def __init__(self, cfg=None, params=None, *, checkpoint_path=None,
                  max_batch: int = 8, max_len: int = 512,
-                 temperature: float = 0.0, seed: int = 0):
+                 temperature: float = 0.0, seed: int = 0,
+                 page_size: int = 16,
+                 total_pages: Optional[int] = None):
         from ..models import LlamaConfig, init_params
 
         if cfg is None:
@@ -241,7 +315,9 @@ class LLMDeployment:
 
             params = init_params(cfg, jax.random.PRNGKey(seed))
         self.engine = LLMEngine(cfg, params, max_batch=max_batch,
-                                max_len=max_len, temperature=temperature)
+                                max_len=max_len, temperature=temperature,
+                                page_size=page_size,
+                                total_pages=total_pages)
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         tokens = self.engine.generate(
@@ -250,6 +326,16 @@ class LLMDeployment:
             request.get("eos_token"),
         )
         return {"tokens": tokens}
+
+    def stream(self, request: Dict[str, Any]):
+        """Generator endpoint: one token per yield, as decoded."""
+        req = self.engine.submit(
+            list(request["prompt"]),
+            int(request.get("max_new_tokens", 32)),
+            request.get("eos_token"),
+        )
+        for tok in req.tokens(timeout=300.0):
+            yield {"token": tok}
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()

@@ -53,6 +53,28 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method: str, args: Tuple,
+                                 kwargs: Dict, model_id: str = ""):
+        """Generator entry: invoked with num_returns="streaming" by the
+        handle so each yielded item seals as its own object and streams to
+        the caller as produced (ref analogue: replica.py
+        call_user_generator + the proxy's RESPONSE_STREAMING path)."""
+        from .multiplex import _set_model_id
+
+        with self._lock:
+            self._num_handled += 1
+            self._ongoing += 1
+        _set_model_id(model_id)
+        try:
+            out = self._resolve(method)(*args, **kwargs)
+            if inspect.isgenerator(out) or hasattr(out, "__next__"):
+                yield from out
+            else:
+                yield out
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     def handle_batch(self, method: str, batched_args: List[Tuple],
                      model_id: str = "") -> List[Any]:
         """One call per batch: user function receives a list of first

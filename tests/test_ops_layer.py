@@ -220,7 +220,9 @@ def test_timeline_export(ray_tpu_start, tmp_path):
     events = []
     while time.monotonic() < deadline:
         events = ray_tpu.timeline(out)
-        if any(e["name"] == "traced_work" for e in events):
+        # Workers flush their buffers independently — wait for ALL four
+        # spans, not the first flusher's subset.
+        if sum(e["name"] == "traced_work" for e in events) >= 4:
             break
         time.sleep(0.2)
     spans = [e for e in events if e["name"] == "traced_work"]
