@@ -465,7 +465,9 @@ class NodeManager:
     async def _republish_to_gcs(self):
         """After the head restarts from its snapshot, runtime state lives
         only on surviving nodes: push ours back."""
-        for info in self._actors.values():
+        # list(): each await below yields the loop to handlers that may
+        # mutate _actors mid-iteration.
+        for info in list(self._actors.values()):
             if info.state not in ("alive", "restarting", "pending"):
                 continue
             spec = info.creation_spec

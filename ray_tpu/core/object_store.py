@@ -239,20 +239,60 @@ class LocalObjectStore:
             # plasma-equivalent of fallback allocation to filesystem shm).
         return self._put_segment(object_id, sobj)
 
-    def _put_arena(self, arena, object_id: ObjectID, sobj: SerializedObject):
-        oid = object_id.binary()
-        size = sobj.total_size
+    @staticmethod
+    def _arena_alloc(arena, oid_bytes: bytes, size: int):
+        """Alloc-or-replace an arena block (same id rewritten on task
+        retry: never trust old contents). None when the arena is full."""
         try:
-            view = arena.alloc(oid, size)
+            return arena.alloc(oid_bytes, size)
         except FileExistsError:
-            # Same id written twice (task retry after a crashed writer):
-            # replace — never trust old contents.
-            arena.delete(oid)
+            arena.delete(oid_bytes)
             try:
-                view = arena.alloc(oid, size)
+                return arena.alloc(oid_bytes, size)
             except (FileExistsError, MemoryError):
                 return None
         except MemoryError:
+            return None
+
+    def _acquire_segment(self, name: str, size: int):
+        """Create (or reuse / grow-by-recreate) a shm segment of at least
+        ``size`` bytes, register it in the local maps, and untrack every
+        freshly-created segment from the multiprocessing resource tracker
+        — otherwise tracker cleanup unlinks LIVE objects at process exit
+        (the directory owns segment lifecycle)."""
+        created = True
+        try:
+            seg = shared_memory.SharedMemory(
+                name=name, create=True, size=size
+            )
+        except FileExistsError:
+            seg = _attach_untracked(name)
+            if seg.size < size:
+                seg.close()
+                old = shared_memory.SharedMemory(name=name)
+                old.unlink()
+                old.close()
+                seg = shared_memory.SharedMemory(
+                    name=name, create=True, size=size
+                )
+            else:
+                created = False
+        if created:
+            try:
+                resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
+            except Exception:
+                pass
+        with self._lock:
+            self._segments[name] = seg
+            if created:
+                self._created[name] = seg
+        return seg
+
+    def _put_arena(self, arena, object_id: ObjectID, sobj: SerializedObject):
+        oid = object_id.binary()
+        size = sobj.total_size
+        view = self._arena_alloc(arena, oid, size)
+        if view is None:
             return None
         try:
             mv = memoryview(view)
@@ -282,16 +322,7 @@ class LocalObjectStore:
         arena = current_arena()
         if arena is not None:
             oid = object_id.binary()
-            try:
-                view = arena.alloc(oid, size)
-            except FileExistsError:
-                arena.delete(oid)
-                try:
-                    view = arena.alloc(oid, size)
-                except (FileExistsError, MemoryError):
-                    view = None
-            except MemoryError:
-                view = None
+            view = self._arena_alloc(arena, oid, size)
             if view is not None:
                 return ObjectWriter(
                     kind="arena", arena=arena, raw=view,
@@ -299,32 +330,7 @@ class LocalObjectStore:
                     loc=ArenaLocation(arena.name, oid, size),
                 )
         name = _shm_name(object_id)
-        created = True
-        try:
-            seg = shared_memory.SharedMemory(name=name, create=True, size=size)
-        except FileExistsError:
-            seg = _attach_untracked(name)
-            if seg.size < size:
-                seg.close()
-                old = shared_memory.SharedMemory(name=name)
-                old.unlink()
-                old.close()
-                seg = shared_memory.SharedMemory(
-                    name=name, create=True, size=size
-                )
-            else:
-                created = False
-        if created:
-            # Every create=True registers with the resource tracker, which
-            # would unlink the LIVE segment at process exit — untrack it
-            # (the directory owns the lifecycle), in BOTH create branches.
-            try:
-                resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
-            except Exception:
-                pass
-        with self._lock:
-            self._segments[name] = seg
-            self._created[name] = seg
+        seg = self._acquire_segment(name, size)
         return ObjectWriter(
             kind="shm", seg=seg, view=seg.buf,
             loc=ShmLocation(name, size),
@@ -340,32 +346,13 @@ class LocalObjectStore:
             view.release()
 
     def _put_segment(self, object_id: ObjectID, sobj: SerializedObject) -> ShmLocation:
+        # Same object id written twice (e.g. a task retry after the first
+        # writer crashed mid-write): _acquire_segment reuses or recreates;
+        # either way the contents are rewritten below.
         name = _shm_name(object_id)
-        size = sobj.total_size
-        try:
-            seg = shared_memory.SharedMemory(name=name, create=True, size=size)
-        except FileExistsError:
-            # Same object id written twice (e.g. a task retry after the first
-            # writer crashed mid-write): never trust the old contents —
-            # rewrite, or recreate if the size doesn't match.
-            seg = _attach_untracked(name)
-            if seg.size < size:
-                seg.close()
-                shared_memory.SharedMemory(name=name).unlink()
-                seg = shared_memory.SharedMemory(name=name, create=True, size=size)
-            sobj.write_into(seg.buf)
-            with self._lock:
-                self._segments[name] = seg
-            return ShmLocation(name, size)
-        try:
-            resource_tracker.unregister(seg._name, "shared_memory")  # noqa: SLF001
-        except Exception:
-            pass
+        seg = self._acquire_segment(name, sobj.total_size)
         sobj.write_into(seg.buf)
-        with self._lock:
-            self._created[name] = seg
-            self._segments[name] = seg
-        return ShmLocation(name, size)
+        return ShmLocation(name, sobj.total_size)
 
     # -- read path ----------------------------------------------------------
 

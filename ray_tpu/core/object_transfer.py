@@ -81,6 +81,10 @@ class ObjectTransfer:
         )
         try:
             chunk = self.chunk_bytes
+            # Executor-thread writes in flight: a cancelled fetch coroutine
+            # does NOT stop its already-running threadpool write, so the
+            # abort path must drain THESE, not just the tasks.
+            write_futs: list = []
 
             async def fetch(offset: int):
                 length = min(chunk, size - offset)
@@ -98,8 +102,11 @@ class ObjectTransfer:
                         )
                     # Copy into shared memory off-loop (a 5 MiB memmove
                     # should not stall the control plane).
-                    await loop.run_in_executor(None, writer.write, offset,
-                                               data)
+                    fut = loop.run_in_executor(
+                        None, writer.write, offset, data
+                    )
+                    write_futs.append(fut)
+                    await fut
                     self.stats["chunks_pulled"] += 1
 
             tasks = [
@@ -109,11 +116,13 @@ class ObjectTransfer:
             try:
                 await asyncio.gather(*tasks)
             except BaseException:
-                # Quiesce siblings BEFORE aborting the writer: a fetch
-                # mid-write must not touch the released buffer.
+                # Quiesce siblings BEFORE aborting the writer: cancel the
+                # coroutines, then wait for every started memcpy — a write
+                # racing abort() would land in freed arena memory.
                 for t in tasks:
                     t.cancel()
                 await asyncio.gather(*tasks, return_exceptions=True)
+                await asyncio.gather(*write_futs, return_exceptions=True)
                 raise
             return await loop.run_in_executor(None, writer.finalize)
         except BaseException:

@@ -218,6 +218,20 @@ def _refresh_loop(state_ref: "weakref.ref[_RouterState]") -> None:
             time.sleep(0.2)
 
 
+def _pick_with_refresh(state: _RouterState, model_id, attempt: int):
+    """Shared pick step: on an empty replica set (stale snapshot /
+    just-created handle) force-refresh and signal retry by returning
+    None; raises only once retries are exhausted."""
+    try:
+        return state.pick(model_id)
+    except RuntimeError:
+        if attempt < MAX_DEATH_RETRIES:
+            state.force_refresh()
+            time.sleep(0.05 * (attempt + 1))
+            return None
+        raise
+
+
 def _route_with_retry(state: _RouterState, submit, deliver, deliver_error,
                       model_id: Optional[str] = None):
     """Shared request path: pick a replica (p2c + model affinity),
@@ -229,17 +243,12 @@ def _route_with_retry(state: _RouterState, submit, deliver, deliver_error,
     last_err: Optional[BaseException] = None
     for attempt in range(MAX_DEATH_RETRIES + 1):
         try:
-            replica = state.pick(model_id)
+            replica = _pick_with_refresh(state, model_id, attempt)
         except RuntimeError as e:
-            # Empty replica set: the local snapshot is stale (evictions,
-            # or a just-created handle racing deploy). Refresh and retry
-            # even on the FIRST attempt; fail only once retries are spent.
-            if attempt < MAX_DEATH_RETRIES:
-                state.force_refresh()
-                time.sleep(0.05 * (attempt + 1))
-                continue
             deliver_error(last_err or e)
             return
+        if replica is None:
+            continue  # refreshed after an empty set; try again
         state.begin(replica)
         try:
             deliver(ray_tpu.get(submit(replica)))
@@ -371,13 +380,13 @@ class DeploymentHandle:
         last_err = None
         for attempt in range(MAX_DEATH_RETRIES + 1):
             try:
-                replica = state.pick(model_id or None)
+                replica = _pick_with_refresh(
+                    state, model_id or None, attempt
+                )
             except RuntimeError as e:
-                if attempt < MAX_DEATH_RETRIES:
-                    state.force_refresh()
-                    time.sleep(0.05 * (attempt + 1))
-                    continue
                 raise (last_err or e)
+            if replica is None:
+                continue  # refreshed after an empty set; try again
             state.begin(replica)
             started = False
             try:

@@ -79,6 +79,14 @@ class LLMEngine:
         self.max_batch = max_batch
         self.max_len = max_len
         self.temperature = temperature
+        if max_len % page_size != 0:
+            # paged_prefill reshapes bucket rows into whole pages; a
+            # clamped bucket that is not a page multiple would blow up
+            # inside the jitted reshape with an opaque XLA error.
+            raise ValueError(
+                f"max_len ({max_len}) must be a multiple of page_size "
+                f"({page_size})"
+            )
         self.page_size = page_size
         self.max_pages_per_seq = math.ceil(max_len / page_size)
         # Default pool: enough for every slot at max_len (same worst case

@@ -1,0 +1,78 @@
+"""Multi-process jax.distributed rendezvous through the trainer
+(VERDICT r2 ask #2b: trainer.py's ``jax.distributed.initialize`` path —
+reserve_coordinator on rank 0's host + KV publication — executed for
+real across two worker processes, on the CPU backend)."""
+
+import math
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+
+@pytest.fixture
+def tpu_labeled_runtime():
+    # A fake TPU resource puts workers on the use_tpu path (worker_type
+    # "tpu", rendezvous enabled). JAX_PLATFORMS=cpu (conftest) keeps the
+    # actual backend virtual.
+    rt = ray_tpu.init(
+        num_cpus=4,
+        resources={"TPU": 2},
+        system_config={
+            "num_prestart_workers": 0,
+            "heartbeat_interval_s": 0.1,
+        },
+    )
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_jax_distributed_rendezvous_two_processes(tpu_labeled_runtime):
+    # Defined INSIDE the test so cloudpickle ships it by value (a
+    # module-level function would pickle by reference to a module the
+    # worker processes cannot import).
+    def distributed_loop(config):
+        import jax
+
+        from ray_tpu.train.session import get_session
+
+        # jax.distributed.initialize already ran in the worker entry
+        # (trainer.py) — the assertion below fails unless the two worker
+        # processes actually rendezvoused.
+        assert jax.process_count() == 2, jax.process_count()
+        n_local = len(jax.local_devices())
+        n_global = len(jax.devices())
+        assert n_global == 2 * n_local, (n_global, n_local)
+
+        # One real cross-process collective over the global mesh.
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(jax.devices(), ("dp",))
+        x = jax.device_put(
+            jnp.ones((n_global,), jnp.float32),
+            NamedSharding(mesh, P("dp")),
+        )
+        total = float(jax.jit(lambda v: v.sum())(x))
+        session = get_session()
+        session.report({
+            "total": total,
+            "processes": jax.process_count(),
+            "rank": session.world_rank,
+        })
+
+    trainer = JaxTrainer(
+        distributed_loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(
+            num_workers=2, use_tpu=True,
+            resources_per_worker={"TPU": 1},
+        ),
+        run_config=RunConfig(name="rendezvous-test"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["processes"] == 2
+    n = result.metrics["total"]
+    assert math.isfinite(n) and n >= 2, n
