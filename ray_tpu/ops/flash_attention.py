@@ -12,9 +12,12 @@ frameworks; this framework owns its compute path. Falls back to the XLA
 einsum implementation (ops/attention.py) off-TPU or for shapes the kernel
 doesn't tile.
 
-Training note: the backward pass recomputes attention with the jnp
-reference implementation under ``jax.custom_vjp`` (flash-style fused
-backward is future work); forward/serving takes the kernel path.
+Training: the backward is a fused Pallas kernel pair (flash attention v2
+backward schedule): the forward additionally emits the per-row logsumexp,
+and two kernels recompute P block-wise in VMEM — one accumulating dQ over
+KV blocks, one accumulating dK/dV over Q blocks — so the S^2 probability
+matrix never hits HBM in either direction. GQA head reduction for dK/dV
+happens outside the kernel (sum over the query heads of each KV group).
 """
 
 from __future__ import annotations
@@ -30,7 +33,7 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
                       causal: bool, q_offset: int, kv_offset: int,
                       block_k: int):
     from jax.experimental import pallas as pl
@@ -78,8 +81,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float,
     acc0 = jnp.zeros((block_q, head_dim), dtype=jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
     # Guard the all-masked case (possible when kv_offset > q positions).
-    out = acc / jnp.where(l == 0.0, 1.0, l)[:, None]
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe[:, None]
     o_ref[0] = out.astype(o_ref.dtype)
+    # Per-row logsumexp: the backward recomputes P = exp(S - lse) from it.
+    lse_ref[0, 0] = m + jnp.log(l_safe)
 
 
 def _flash_fwd(q3, k3, v3, *, heads: int, kv_heads: int, scale: float,
@@ -106,7 +112,12 @@ def _flash_fwd(q3, k3, v3, *, heads: int, kv_heads: int, scale: float,
     )
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            # [bh, 1, sq]: a (1, 1, block) tile satisfies the TPU
+            # (8, 128)-divisible-or-full block rule; flat [bh, sq] can't.
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
@@ -116,10 +127,190 @@ def _flash_fwd(q3, k3, v3, *, heads: int, kv_heads: int, scale: float,
             pl.BlockSpec((1, skv, d), kv_index,
                          memory_space=pltpu.VMEM),
         ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, scale: float, causal: bool,
+                         q_offset: int, kv_offset: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    block_q = q_ref.shape[1]
+    skv = k_ref.shape[1]
+    nk = skv // block_k
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32)        # [Bq, D] (unscaled)
+    do = do_ref[0].astype(jnp.float32)      # [Bq, D]
+    lse = lse_ref[0, 0]                     # [Bq]
+    delta = delta_ref[0, 0]                 # [Bq] = rowsum(dO * O)
+    q_start = q_offset + qi * block_q
+    if causal:
+        last_q = q_start + block_q - 1
+        hi = jnp.clip((last_q - kv_offset) // block_k + 1, 0, nk)
+    else:
+        hi = nk
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k.T) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kv_offset + j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])       # masked entries -> 0
+        dp = do @ v.T                       # [Bq, Bk]
+        ds = p * (dp - delta[:, None])
+        return dq + (ds @ k) * scale
+
+    dq0 = jnp.zeros_like(q)
+    dq = jax.lax.fori_loop(0, hi, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, scale: float, causal: bool,
+                          q_offset: int, kv_offset: int, block_q: int):
+    from jax.experimental import pallas as pl
+
+    block_k = k_ref.shape[1]
+    sq = q_ref.shape[1]
+    nq = sq // block_q
+    ki = pl.program_id(1)
+    head_dim = q_ref.shape[2]
+
+    k = k_ref[0].astype(jnp.float32)        # [Bk, D]
+    v = v_ref[0].astype(jnp.float32)        # [Bk, D]
+    k_start = kv_offset + ki * block_k
+    if causal:
+        # First q block whose LAST position reaches this kv block.
+        lo = jnp.clip((k_start - q_offset) // block_q, 0, nq)
+    else:
+        lo = 0
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q)]
+        s = (q @ k.T) * scale               # [Bq, Bk]
+        if causal:
+            q_pos = q_offset + j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        dk = dk + (ds.T @ q) * scale
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, head_dim), dtype=jnp.float32)
+    dv0 = jnp.zeros((block_k, head_dim), dtype=jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q3, k3, v3, do3, lse, delta, *, heads: int, kv_heads: int,
+               scale: float, causal: bool, q_offset: int, kv_offset: int,
+               block_q: int, block_k: int, interpret: bool = False):
+    """Fused backward. q3/do3: [B*H, Sq, D]; k3/v3: [B*Hkv, Skv, D];
+    lse/delta: [B*H, Sq]. Returns (dq3 [B*H,Sq,D], dk3/dv3 [B*H,Skv,D] —
+    PER QUERY HEAD; the caller sums each KV group's rep heads)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sq, d = q3.shape
+    skv = k3.shape[1]
+    rep = heads // kv_heads
+
+    def kv_index(i, j):
+        b = i // heads
+        h = i % heads
+        return (b * kv_heads + h // rep, 0, 0)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, scale=scale, causal=causal,
+        q_offset=q_offset, kv_offset=kv_offset, block_k=block_k,
+    )
+    dq3 = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, skv, d), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, skv, d), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
-    )(q3, k3, v3)
+    )(q3, k3, v3, do3, lse, delta)
+
+    def kv_blk_index(i, j):
+        b = i // heads
+        h = i % heads
+        return (b * kv_heads + h // rep, j, 0)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, scale=scale, causal=causal,
+        q_offset=q_offset, kv_offset=kv_offset, block_q=block_q,
+    )
+    dk3, dv3 = pl.pallas_call(
+        dkv_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, skv, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, skv, d), v3.dtype),
+        ),
+        grid=(bh, skv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), kv_blk_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), kv_blk_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq3, dk3, dv3
 
 
 def _reference(q, k, v, *, causal, scale, q_offset, kv_offset):
@@ -129,6 +320,12 @@ def _reference(q, k, v, *, causal, scale, q_offset, kv_offset):
                          q_offset=q_offset, kv_offset=kv_offset)
 
 
+def _to_heads3(x):
+    """[B, S, H, D] -> [B*H, S, D]."""
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
 )
@@ -136,11 +333,9 @@ def _flash_attention_core(q, k, v, causal, scale, q_offset, kv_offset,
                           block_q, block_k, interpret=False):
     B, Sq, H, D = q.shape
     Hkv = k.shape[2]
-    q3 = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
-    k3 = k.transpose(0, 2, 1, 3).reshape(B * Hkv, -1, D)
-    v3 = v.transpose(0, 2, 1, 3).reshape(B * Hkv, -1, D)
-    o3 = _flash_fwd(
-        q3, k3, v3, heads=H, kv_heads=Hkv, scale=scale, causal=causal,
+    o3, _lse = _flash_fwd(
+        _to_heads3(q), _to_heads3(k), _to_heads3(v),
+        heads=H, kv_heads=Hkv, scale=scale, causal=causal,
         q_offset=q_offset, kv_offset=kv_offset,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
@@ -149,27 +344,50 @@ def _flash_attention_core(q, k, v, causal, scale, q_offset, kv_offset,
 
 def _core_fwd(q, k, v, causal, scale, q_offset, kv_offset, block_q,
               block_k, interpret=False):
-    out = _flash_attention_core(
-        q, k, v, causal, scale, q_offset, kv_offset, block_q, block_k,
-        interpret,
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    q3, k3, v3 = _to_heads3(q), _to_heads3(k), _to_heads3(v)
+    o3, lse = _flash_fwd(
+        q3, k3, v3, heads=H, kv_heads=Hkv, scale=scale, causal=causal,
+        q_offset=q_offset, kv_offset=kv_offset,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return out, (q, k, v)
+    out = o3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    return out, (q3, k3, v3, o3, lse, B, H, Hkv)
 
 
 def _core_bwd(causal, scale, q_offset, kv_offset, block_q, block_k,
               interpret, res, g):
-    # Rematerialized backward through the XLA reference implementation
-    # (numerically identical attention; O(S^2/blk) peak is confined to
-    # the backward pass).
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference(
-            q_, k_, v_, causal=causal, scale=scale,
-            q_offset=q_offset, kv_offset=kv_offset,
-        ),
-        q, k, v,
+    """Fused flash backward: P recomputed block-wise in VMEM from the
+    saved logsumexp; dK/dV accumulated per query head then summed over
+    each KV group (GQA)."""
+    q3, k3, v3, o3, lse, B, H, Hkv = res
+    Sq, D = q3.shape[1], q3.shape[2]
+    do3 = _to_heads3(g)
+    delta = (do3.astype(jnp.float32) * o3.astype(jnp.float32)).sum(
+        -1
+    )[:, None, :]  # [bh, 1, sq] to match the lse tiling
+    dq3, dk3h, dv3h = _flash_bwd(
+        q3, k3, v3, do3, lse, delta, heads=H, kv_heads=Hkv, scale=scale,
+        causal=causal, q_offset=q_offset, kv_offset=kv_offset,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return vjp(g)
+    rep = H // Hkv
+    Skv = k3.shape[1]
+    dq = dq3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    dk = (
+        dk3h.reshape(B, Hkv, rep, Skv, D)
+        .sum(axis=2)
+        .transpose(0, 2, 1, 3)
+        .astype(k3.dtype)
+    )
+    dv = (
+        dv3h.reshape(B, Hkv, rep, Skv, D)
+        .sum(axis=2)
+        .transpose(0, 2, 1, 3)
+        .astype(v3.dtype)
+    )
+    return dq, dk, dv
 
 
 _flash_attention_core.defvjp(_core_fwd, _core_bwd)

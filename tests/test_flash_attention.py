@@ -77,3 +77,47 @@ def test_flash_fallback_for_odd_shapes():
     ref = mha_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-6)
+
+
+def test_flash_gqa_gradients_match():
+    """Backward with grouped KV heads: dK/dV must sum each group's query
+    heads (the GQA reduction is outside the kernel)."""
+    B, S, H, Hkv, D = 1, 128, 4, 2, 32
+    q = _rand((B, S, H, D), 0)
+    k = _rand((B, S, Hkv, D), 1)
+    v = _rand((B, S, Hkv, D), 2)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True,
+                                interpret=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (mha_attention(q, k, v, causal=True) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5)
+
+
+def test_flash_decode_offset_gradients():
+    """Fused backward with nonzero q_offset (the block-bound math must
+    stay consistent with the forward's)."""
+    B, S, Skv, H, D = 1, 128, 256, 2, 32
+    q = _rand((B, S, H, D), 3)
+    k = _rand((B, Skv, H, D), 4)
+    v = _rand((B, Skv, H, D), 5)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, q_offset=128,
+                               interpret=True).sum()
+
+    def loss_ref(q, k, v):
+        return mha_attention(q, k, v, causal=True, q_offset=128).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
