@@ -57,11 +57,10 @@ class LlamaConfig:
     remat_policy: str = "full"
     # Pallas flash attention kernel on TPU (ops/flash_attention.py);
     # automatically the XLA einsum path off-TPU or for odd shapes.
-    # Off by default for TRAINING: under remat, the kernel's
-    # recompute-based backward costs more than its forward saves.
-    # Inference paths (generation prefill, serving) enable it — forward
-    # only, where the kernel is ~1.5x the XLA path and O(S) memory.
-    use_flash: bool = False
+    # On by default: with the fused Pallas backward (KV-head-grid dK/dV,
+    # GQA reduced in-kernel) flash beats the XLA path for training too —
+    # 0.596 vs 0.532 MFU on the 8B-shaped bench (PERF_r04.json A/B).
+    use_flash: bool = True
 
     @property
     def dh(self) -> int:

@@ -16,8 +16,12 @@ Training: the backward is a fused Pallas kernel pair (flash attention v2
 backward schedule): the forward additionally emits the per-row logsumexp,
 and two kernels recompute P block-wise in VMEM — one accumulating dQ over
 KV blocks, one accumulating dK/dV over Q blocks — so the S^2 probability
-matrix never hits HBM in either direction. GQA head reduction for dK/dV
-happens outside the kernel (sum over the query heads of each KV group).
+matrix never hits HBM in either direction. The dK/dV kernel runs on a
+KV-HEAD grid: all ``rep`` query heads of a GQA group stay resident in
+VMEM and the group reduction happens in the f32 accumulator, so dK/dV is
+written to HBM once per KV head (not per query head + external sum). A
+per-query-head fallback kernel covers shapes whose grouped Q block would
+not fit VMEM.
 """
 
 from __future__ import annotations
@@ -183,8 +187,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, scale: float, causal: bool,
                           q_offset: int, kv_offset: int, block_q: int):
+    """dK/dV on a KV-head grid. q_ref/do_ref hold ALL ``rep`` query heads
+    of this KV group ([rep, Sq, D]); the GQA reduction happens in the f32
+    accumulator so each dK/dV block is written to HBM exactly once."""
     from jax.experimental import pallas as pl
 
+    rep = q_ref.shape[0]
     block_k = k_ref.shape[1]
     sq = q_ref.shape[1]
     nq = sq // block_q
@@ -200,41 +208,51 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         lo = 0
 
-    def body(j, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q)]
-        s = (q @ k.T) * scale               # [Bq, Bk]
-        if causal:
-            q_pos = q_offset + j * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dv = dv + p.T @ do
-        dp = do @ v.T
-        ds = p * (dp - delta[:, None])
-        dk = dk + (ds.T @ q) * scale
-        return dk, dv
+    def body_for_head(r):
+        def body(j, carry):
+            dk, dv = carry
+            q = q_ref[r, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+            do = do_ref[r, pl.ds(j * block_q, block_q), :].astype(
+                jnp.float32)
+            lse = lse_ref[r, 0, pl.ds(j * block_q, block_q)]
+            delta = delta_ref[r, 0, pl.ds(j * block_q, block_q)]
+            s = (q @ k.T) * scale               # [Bq, Bk]
+            if causal:
+                q_pos = q_offset + j * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                k_pos = k_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            dv = dv + p.T @ do
+            dp = do @ v.T
+            ds = p * (dp - delta[:, None])
+            dk = dk + (ds.T @ q) * scale
+            return dk, dv
+        return body
 
-    dk0 = jnp.zeros((block_k, head_dim), dtype=jnp.float32)
-    dv0 = jnp.zeros((block_k, head_dim), dtype=jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
+    dk = jnp.zeros((block_k, head_dim), dtype=jnp.float32)
+    dv = jnp.zeros((block_k, head_dim), dtype=jnp.float32)
+    for r in range(rep):  # static unroll over the group's query heads
+        dk, dv = jax.lax.fori_loop(lo, nq, body_for_head(r), (dk, dv))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# Grouped Q/dO blocks larger than this fall back to the per-head kernel
+# (VMEM is ~16 MiB/core; leave room for K/V blocks, f32 casts and the
+# accumulators).
+_DKV_GROUP_VMEM_BUDGET = 10 * 1024 * 1024
 
 
 def _flash_bwd(q3, k3, v3, do3, lse, delta, *, heads: int, kv_heads: int,
                scale: float, causal: bool, q_offset: int, kv_offset: int,
                block_q: int, block_k: int, interpret: bool = False):
     """Fused backward. q3/do3: [B*H, Sq, D]; k3/v3: [B*Hkv, Skv, D];
-    lse/delta: [B*H, Sq]. Returns (dq3 [B*H,Sq,D], dk3/dv3 [B*H,Skv,D] —
-    PER QUERY HEAD; the caller sums each KV group's rep heads)."""
+    lse/delta: [B*H, 1, Sq]. Returns (dq3 [B*H, Sq, D],
+    dk3/dv3 [B*Hkv, Skv, D] — already reduced over each KV group)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -272,16 +290,58 @@ def _flash_bwd(q3, k3, v3, do3, lse, delta, *, heads: int, kv_heads: int,
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
 
+    bkv = (bh // heads) * kv_heads
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, scale=scale, causal=causal,
+        q_offset=q_offset, kv_offset=kv_offset, block_q=block_q,
+    )
+    grouped_bytes = 2 * rep * sq * d * q3.dtype.itemsize  # q + do resident
+    if grouped_bytes <= _DKV_GROUP_VMEM_BUDGET:
+        # KV-head grid: q3 rows of group g are contiguous ([g*rep,
+        # (g+1)*rep) since g = b*kv_heads + hk and H = kv_heads*rep), so a
+        # [rep, Sq, D] block at block-row g picks exactly the group. The
+        # index maps are constant in j — Q/dO stay VMEM-resident across
+        # the whole KV sweep of a group.
+        dk3, dv3 = pl.pallas_call(
+            dkv_kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((bkv, skv, d), k3.dtype),
+                jax.ShapeDtypeStruct((bkv, skv, d), v3.dtype),
+            ),
+            grid=(bkv, skv // block_k),
+            in_specs=[
+                pl.BlockSpec((rep, sq, d), lambda i, j: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((rep, sq, d), lambda i, j: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((rep, 1, sq), lambda i, j: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((rep, 1, sq), lambda i, j: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                             memory_space=pltpu.VMEM),
+            ),
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse, delta)
+        return dq3, dk3, dv3
+
     def kv_blk_index(i, j):
         b = i // heads
         h = i % heads
         return (b * kv_heads + h // rep, j, 0)
 
-    dkv_kernel = functools.partial(
-        _flash_bwd_dkv_kernel, scale=scale, causal=causal,
-        q_offset=q_offset, kv_offset=kv_offset, block_q=block_q,
-    )
-    dk3, dv3 = pl.pallas_call(
+    # Per-query-head fallback: the grouped kernel with rep=1 blocks
+    # (q_ref.shape[0] == 1) is exactly the per-head computation; the
+    # GQA group sum happens outside.
+    dk3h, dv3h = pl.pallas_call(
         dkv_kernel,
         out_shape=(
             jax.ShapeDtypeStruct((bh, skv, d), k3.dtype),
@@ -310,6 +370,11 @@ def _flash_bwd(q3, k3, v3, do3, lse, delta, *, heads: int, kv_heads: int,
         ),
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
+    b = bh // heads
+    dk3 = dk3h.reshape(b, kv_heads, rep, skv, d).sum(
+        axis=2).reshape(bkv, skv, d).astype(k3.dtype)
+    dv3 = dv3h.reshape(b, kv_heads, rep, skv, d).sum(
+        axis=2).reshape(bkv, skv, d).astype(v3.dtype)
     return dq3, dk3, dv3
 
 
@@ -359,34 +424,23 @@ def _core_fwd(q, k, v, causal, scale, q_offset, kv_offset, block_q,
 def _core_bwd(causal, scale, q_offset, kv_offset, block_q, block_k,
               interpret, res, g):
     """Fused flash backward: P recomputed block-wise in VMEM from the
-    saved logsumexp; dK/dV accumulated per query head then summed over
-    each KV group (GQA)."""
+    saved logsumexp; dK/dV reduced over each GQA group inside the kernel
+    (KV-head grid)."""
     q3, k3, v3, o3, lse, B, H, Hkv = res
     Sq, D = q3.shape[1], q3.shape[2]
     do3 = _to_heads3(g)
     delta = (do3.astype(jnp.float32) * o3.astype(jnp.float32)).sum(
         -1
     )[:, None, :]  # [bh, 1, sq] to match the lse tiling
-    dq3, dk3h, dv3h = _flash_bwd(
+    dq3, dk3, dv3 = _flash_bwd(
         q3, k3, v3, do3, lse, delta, heads=H, kv_heads=Hkv, scale=scale,
         causal=causal, q_offset=q_offset, kv_offset=kv_offset,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    rep = H // Hkv
     Skv = k3.shape[1]
     dq = dq3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
-    dk = (
-        dk3h.reshape(B, Hkv, rep, Skv, D)
-        .sum(axis=2)
-        .transpose(0, 2, 1, 3)
-        .astype(k3.dtype)
-    )
-    dv = (
-        dv3h.reshape(B, Hkv, rep, Skv, D)
-        .sum(axis=2)
-        .transpose(0, 2, 1, 3)
-        .astype(v3.dtype)
-    )
+    dk = dk3.reshape(B, Hkv, Skv, D).transpose(0, 2, 1, 3)
+    dv = dv3.reshape(B, Hkv, Skv, D).transpose(0, 2, 1, 3)
     return dq, dk, dv
 
 
