@@ -40,9 +40,11 @@ class Config:
     num_prestart_workers: int = 2
     # Tasks shipped to a busy worker's socket ahead of its completion
     # (1 = off). Hides the dispatch round-trip between back-to-back small
-    # tasks (ref analogue: max_tasks_in_flight_per_worker pipelining).
+    # tasks (ref analogue: max_tasks_in_flight_per_worker pipelining),
+    # and feeds the execute/done frame coalescing (deeper queue = more
+    # completions per node-manager wakeup on a contended host).
     # Resources stay held while queued; blocking workers are reclaimed.
-    worker_pipeline_depth: int = 2
+    worker_pipeline_depth: int = 8
     # Hard cap on worker processes a node may spawn (includes workers started
     # to relieve blocked-on-get workers).
     max_workers: int = 64

@@ -172,6 +172,12 @@ class WorkerHandle:
     known_functions: Set[str] = field(default_factory=set)
     actor_id: Optional[ActorID] = None
     last_active: float = field(default_factory=time.monotonic)
+    # Execute frames coalesced within one loop iteration and flushed as a
+    # single socket write: on a contended host every write wakes the
+    # worker process and the kernel's wakeup preemption turns per-frame
+    # writes into one context switch per task (the dispatch wall at
+    # PERF_r03's 2.5-3k tasks/s).
+    exec_buf: List[Dict[str, Any]] = field(default_factory=list)
 
 
 class _ReadyQueue:
@@ -288,6 +294,9 @@ class NodeManager:
         # Scheduling state (loop-thread only).
         self._ready = _ReadyQueue(self._sched_class)
         self._sched_pending = False
+        # Workers with buffered execute frames awaiting the end-of-
+        # iteration flush (see _send_execute_to / _flush_execute_bufs).
+        self._exec_dirty: List[WorkerHandle] = []
         self._waiting: Dict[TaskID, Tuple[TaskRecord, Set[ObjectID]]] = {}
         self._dep_index: Dict[ObjectID, Set[TaskID]] = {}
         self._tasks: Dict[TaskID, TaskRecord] = {}
@@ -370,7 +379,17 @@ class NodeManager:
         asyncio.set_event_loop(self._loop)
         self._loop.run_until_complete(self._start_server())
         self._started.set()
-        self._loop.run_forever()
+        profile_to = os.environ.get("RAY_TPU_PROFILE_NM")
+        if profile_to:
+            import cProfile
+
+            pr = cProfile.Profile()
+            pr.enable()
+            self._loop.run_forever()
+            pr.disable()
+            pr.dump_stats(profile_to)
+        else:
+            self._loop.run_forever()
         # Drain pending callbacks after stop().
         self._loop.run_until_complete(self._loop.shutdown_asyncgens())
         self._loop.close()
@@ -755,6 +774,12 @@ class NodeManager:
         w.last_active = time.monotonic()
         if mtype == "task_done":
             await self._on_task_done(w, msg)
+        elif mtype == "task_done_batch":
+            # One wakeup for a burst of completions (the worker coalesces
+            # dones while more queued tasks are waiting); _schedule() is
+            # debounced so the batch costs one dispatch pass.
+            for item in msg["items"]:
+                await self._on_task_done(w, item)
         elif mtype == "submit":
             await self.submit_task(msg["spec"])
         elif mtype == "get_locations":
@@ -1753,33 +1778,36 @@ class NodeManager:
                         ),
                     )
                     return True
+                # Node full: ride an existing same-shape hold instead of
+                # blocking — this is what keeps a saturated node streaming
+                # batches of small tasks through its workers.
+                rider = self._pipeline_candidate(
+                    _task_worker_type(spec), spec
+                )
+                if rider is not None:
+                    return self._dispatch_as_rider(record, rider)
                 return False
         wtype = _task_worker_type(spec)
         worker = self._take_idle_worker(wtype)
-        pipelined = False
         if worker is None:
             # Prefer a NEW worker while the pool can still grow (pipelining
             # onto a busy worker would serialize tasks with CPUs free);
-            # pipeline only once the pool is saturated.
+            # ride a busy worker's hold only once the pool is saturated.
             if not self._can_grow_pool(wtype):
-                worker = self._pipeline_candidate(wtype)
-                pipelined = worker is not None
-        if worker is None:
+                rider = self._pipeline_candidate(wtype, spec)
+                if rider is not None:
+                    return self._dispatch_as_rider(record, rider)
             spawn_needed.add(wtype)
             return False
         if not self._acquire_for_record(record):
             # Lost the race (bundle drained between check and acquire).
-            if not pipelined:
-                self._idle[worker.worker_type].appendleft(worker.worker_id)
+            self._idle[worker.worker_type].appendleft(worker.worker_id)
             return False
         record.resources_held = True
         record.state = "running"
         record.worker_id = worker.worker_id
-        if pipelined:
-            worker.pending.append(record)
-        else:
-            worker.state = "busy"
-            worker.current = record
+        worker.state = "busy"
+        worker.current = record
         self._send_execute_to(worker, spec)
         return True
 
@@ -1798,13 +1826,26 @@ class NodeManager:
         )
         return active + self._num_starting() < cpu_total + n_blocked
 
-    def _pipeline_candidate(self, wtype: str) -> Optional[WorkerHandle]:
-        """A busy (non-actor, non-blocked) worker with spare pipeline
-        slots: the next task rides its socket buffer and starts the moment
-        the current one finishes, skipping a dispatch round-trip."""
+    def _pipeline_candidate(
+        self, wtype: str, spec: TaskSpec
+    ) -> Optional[WorkerHandle]:
+        """A busy (non-actor, non-blocked) worker whose CURRENT task holds
+        the same resource shape: the next task rides that worker's
+        existing resource hold ("lease") and its socket buffer — no
+        per-task acquire/release, no dispatch round-trip (ref analogue:
+        direct_task_transport.cc OnWorkerIdle reusing the leased worker
+        for queued tasks of the same scheduling class)."""
         depth = self.config.worker_pipeline_depth
-        if depth <= 1:
+        if depth <= 1 or spec.task_type != TaskType.NORMAL_TASK:
             return None
+        if isinstance(
+            getattr(spec, "scheduling_strategy", None),
+            PlacementGroupSchedulingStrategy,
+        ):
+            # PG tasks must go through bundle acquisition — a rider would
+            # bypass the bundle's reservation and break PG isolation.
+            return None
+        shape = spec.resources.to_dict()
         best = None
         for w in self._workers.values():
             if (
@@ -1812,11 +1853,27 @@ class NodeManager:
                 and w.worker_type == wtype
                 and w.actor_id is None
                 and w.current is not None
+                and w.current.bundle_key is None
+                and w.current.spec.task_type == TaskType.NORMAL_TASK
                 and len(w.pending) < depth - 1
+                and w.current.spec.resources.to_dict() == shape
             ):
                 if best is None or len(w.pending) < len(best.pending):
                     best = w
         return best
+
+    def _dispatch_as_rider(
+        self, record: TaskRecord, worker: WorkerHandle
+    ) -> bool:
+        """Queue a record onto a busy worker under that worker's existing
+        resource hold. Riders never hold resources themselves; the hold
+        is transferred head-to-head as tasks complete (_on_task_done)."""
+        record.resources_held = False
+        record.state = "running"
+        record.worker_id = worker.worker_id
+        worker.pending.append(record)
+        self._send_execute_to(worker, record.spec)
+        return True
 
     def _take_idle_worker(self, worker_type: str = "cpu") -> Optional[WorkerHandle]:
         pool = self._idle[worker_type]
@@ -1865,18 +1922,24 @@ class NodeManager:
     def _send_execute_to(self, worker: WorkerHandle, spec: TaskSpec):
         """Ship one execute frame, preserving per-worker frame order: the
         synchronous fast path only runs while no async send (blob fetch)
-        is still in flight, else a later frame could overtake it."""
+        is still in flight, else a later frame could overtake it. Fast
+        frames are coalesced per loop iteration and flushed as ONE
+        socket write per worker (_flush_execute_bufs)."""
         if (
             spec.function_id in worker.known_functions
             and worker.slow_sends == 0
         ):
-            try:
-                worker.writer.send_nowait(
-                    {"type": "execute", "spec": spec, "function_blob": None}
-                )
-            except Exception:
-                asyncio.ensure_future(self._on_worker_death(worker))
+            if not worker.exec_buf and not self._exec_dirty:
+                self._loop.call_soon(self._flush_execute_bufs)
+            if not worker.exec_buf:
+                self._exec_dirty.append(worker)
+            worker.exec_buf.append(
+                {"spec": spec, "function_blob": None}
+            )
             return
+        # Slow path (blob fetch): flush this worker's buffered fast
+        # frames NOW so the async frame cannot overtake them.
+        self._flush_worker_exec_buf(worker)
 
         async def _ordered():
             # The lock is taken before the first await inside, and tasks
@@ -1891,11 +1954,78 @@ class NodeManager:
         worker.slow_sends += 1
         asyncio.ensure_future(_ordered())
 
+    def _flush_worker_exec_buf(self, worker: WorkerHandle):
+        buf = worker.exec_buf
+        if not buf:
+            return
+        worker.exec_buf = []
+        msg = (
+            {"type": "execute", **buf[0]}
+            if len(buf) == 1
+            else {"type": "execute_batch", "items": buf}
+        )
+        try:
+            worker.writer.send_nowait(msg)
+        except Exception:
+            asyncio.ensure_future(self._on_worker_death(worker))
+
+    def _flush_execute_bufs(self):
+        dirty = self._exec_dirty
+        self._exec_dirty = []
+        for worker in dirty:
+            self._flush_worker_exec_buf(worker)
+
+    def _advance_worker_pipeline(
+        self, w: WorkerHandle, task_id: TaskID,
+        record: Optional[TaskRecord],
+    ):
+        """Advance current/pending past a completed non-actor task and
+        move the resource hold: the worker's chain rides ONE hold, passed
+        head-to-head so completion costs no release/acquire round trip
+        (ref analogue: direct_task_transport.cc worker-lease reuse)."""
+        if w.current is not None and w.current.spec.task_id == task_id:
+            fin = w.current
+            nxt = w.pending.popleft() if w.pending else None
+            if (
+                fin.resources_held
+                and nxt is not None
+                and not nxt.resources_held
+            ):
+                fin.resources_held = False
+                nxt.resources_held = True
+                nxt.bundle_key = fin.bundle_key
+            else:
+                self._release_task_resources(fin)
+            w.current = nxt
+        elif record is not None:
+            # Out-of-order completion (reclaim/cancel races): drop by
+            # identity; riders hold nothing so release is a no-op.
+            self._release_task_resources(record)
+            try:
+                w.pending.remove(record)
+            except ValueError:
+                w.current = None
+        else:
+            for r in list(w.pending):
+                if r.spec.task_id == task_id:
+                    self._release_task_resources(r)
+                    w.pending.remove(r)
+                    break
+        if w.current is None and w.state != "dead":
+            w.state = "idle"
+            self._idle[w.worker_type].append(w.worker_id)
+
     async def _on_task_done(self, w: WorkerHandle, msg: Dict[str, Any]):
         task_id: TaskID = msg["task_id"]
         record = self._tasks.get(task_id)
         results: List[Tuple[ObjectID, Location]] = msg["results"]
         if record is None:
+            # Cancelled/failed while the done frame was in flight: the
+            # seals already happened (_fail_task), but the worker's
+            # pipeline bookkeeping must still advance or its hold leaks.
+            if w.actor_id is None:
+                self._advance_worker_pipeline(w, task_id, None)
+                self._schedule()
             return
         for oid, loc in results:
             self._seal_object(oid, loc)
@@ -1933,20 +2063,7 @@ class NodeManager:
                         info.state = "alive"
                         self._flush_actor_queue(info)
         else:
-            self._release_task_resources(record)
-            if w.current is record:
-                # Advance the pipeline: the next task's frame is already in
-                # the worker's socket — it is running now.
-                w.current = w.pending.popleft() if w.pending else None
-            else:
-                # Out-of-order completion (reclaim races): drop by identity.
-                try:
-                    w.pending.remove(record)
-                except ValueError:
-                    w.current = None
-            if w.current is None and w.state != "dead":
-                w.state = "idle"
-                self._idle[w.worker_type].append(w.worker_id)
+            self._advance_worker_pipeline(w, task_id, record)
         self._schedule()
 
     def _seal_object(self, oid: ObjectID, loc: Location):
@@ -2829,7 +2946,10 @@ class NodeManager:
                 # Only QUEUED on the worker (pipelined frame, not yet
                 # executing): reclaim the frame instead of killing the
                 # process — the kill would take down the unrelated task
-                # actually running there.
+                # actually running there. Flush buffered execute frames
+                # first so the reclaim cannot overtake this record's own
+                # frame on the socket.
+                self._flush_worker_exec_buf(worker)
                 try:
                     worker.writer.send_nowait(
                         {"type": "reclaim",
@@ -2992,7 +3112,11 @@ class NodeManager:
                 # Pipelined tasks behind a blocked task could DEADLOCK (the
                 # blocked task may be waiting on one of them). Reclaim every
                 # not-yet-started frame; the worker replies with what it
-                # actually pulled back and those requeue elsewhere.
+                # actually pulled back and those requeue elsewhere. Flush
+                # buffered execute frames FIRST: the reclaim must arrive
+                # after them on the socket or it misses frames still in
+                # our buffer (the worker only scans its own queue).
+                self._flush_worker_exec_buf(w)
                 ids = [r.spec.task_id for r in w.pending]
                 try:
                     w.writer.send_nowait(

@@ -455,7 +455,14 @@ class WorkerRuntime(BaseRuntime):
             pending.payload = msg
             pending.event.set()
 
+    # Set by worker_main: flushes buffered task_done frames before any
+    # request that may wait on the node manager (a nested get could
+    # otherwise block on a seal sitting in our own outbound buffer).
+    before_block = None
+
     def request(self, msg: Dict[str, Any], timeout: Optional[float] = None):
+        if self.before_block is not None:
+            self.before_block()
         msg_id = next(self._msg_counter)
         msg["msg_id"] = msg_id
         pending = _PendingReply()

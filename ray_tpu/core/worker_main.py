@@ -25,6 +25,10 @@ from .task_spec import TaskSpec, TaskType
 from . import runtime_context
 
 
+# Completions buffered before a mid-queue flush (see _main_loop).
+_DONE_FLUSH_BATCH = 4
+
+
 class Worker:
     def __init__(self, conn: Connection, worker_id: WorkerID):
         self.conn = conn
@@ -38,6 +42,14 @@ class Worker:
         self.actor = ActorContainer()
         self.runtime: WorkerRuntime | None = None
         self._alive = True
+        # Completed-task messages coalesced while more tasks are queued:
+        # one task_done_batch frame = one node-manager wakeup for the
+        # whole burst (the contended-host dispatch wall; see node_manager
+        # _flush_execute_bufs for the mirror-image direction). Guarded by
+        # _done_lock because the runtime's before-blocking hook may flush
+        # from an actor pool thread.
+        self._done_buf: List[dict] = []
+        self._done_lock = threading.Lock()
         # Threaded actor concurrency (ref analogue: max_concurrency actors
         # via ConcurrencyGroupManager, core_worker/transport/
         # concurrency_group_manager.h): creation tasks with
@@ -56,6 +68,10 @@ class Worker:
             worker_id=self.worker_id,
         )
         runtime_context.set_runtime(self.runtime)
+        # Flush buffered dones before any blocking runtime request: a
+        # nested get could otherwise wait on an object whose seal is
+        # sitting in our own outbound buffer (deadlock).
+        self.runtime.before_block = self._flush_dones
         reader = threading.Thread(target=self._reader_loop, daemon=True)
         reader.start()
         self._main_loop()
@@ -100,6 +116,10 @@ class Worker:
                 mtype = msg["type"]
                 if mtype == "execute":
                     self._tq_put(msg)
+                elif mtype == "execute_batch":
+                    with self._tq_cv:
+                        self._tq.extend(msg["items"])
+                        self._tq_cv.notify()
                 elif mtype == "reply":
                     self.runtime.handle_reply(msg)
                 elif mtype == "reclaim":
@@ -162,12 +182,25 @@ class Worker:
             if self._pool is not None and \
                     spec.task_type == TaskType.ACTOR_TASK:
                 self._pool.submit(
-                    self._run_task, spec, msg.get("function_blob")
+                    self._run_task_direct, spec, msg.get("function_blob")
                 )
                 continue
-            self._run_task(spec, msg.get("function_blob"))
+            done = self._run_task(spec, msg.get("function_blob"))
+            with self._done_lock:
+                self._done_buf.append(done)
+                pending_dones = len(self._done_buf)
+            with self._tq_cv:
+                more = bool(self._tq)
+            # Flush every few completions so the node manager refills our
+            # queue while we chew through the rest, and always when the
+            # queue drains. The constant is deliberately independent of
+            # the node manager's worker_pipeline_depth config (workers
+            # don't see it); 4 keeps refill latency low at any depth.
+            if not more or pending_dones >= _DONE_FLUSH_BATCH:
+                self._flush_dones()
         # Flush refcounts + user metrics before exit (os._exit skips
         # atexit, and the head's accounting must stay sane).
+        self._flush_dones()
         try:
             self.runtime.refs.flush()
         except Exception:
@@ -180,7 +213,23 @@ class Worker:
             pass
         os._exit(0)
 
-    def _run_task(self, spec: TaskSpec, function_blob):
+    def _flush_dones(self):
+        with self._done_lock:
+            buf = self._done_buf
+            self._done_buf = []
+        if not buf:
+            return
+        if len(buf) == 1:
+            self.conn.send(buf[0])
+        else:
+            self.conn.send({"type": "task_done_batch", "items": buf})
+
+    def _run_task_direct(self, spec: TaskSpec, function_blob):
+        """Pool-thread path (concurrent actor methods): completions are
+        sent immediately — there is no queue-drain point to batch on."""
+        self.conn.send(self._run_task(spec, function_blob))
+
+    def _run_task(self, spec: TaskSpec, function_blob) -> dict:
         self._apply_runtime_env(spec.runtime_env_key)
         rt = self.runtime
         cache: FunctionCache = rt.function_cache
@@ -275,19 +324,38 @@ class Worker:
                 )
             except Exception:
                 pass
-        self.conn.send(
-            {
-                "type": "task_done",
-                "task_id": spec.task_id,
-                "results": results,
-                "failed": failed,
-            }
-        )
+        return {
+            "type": "task_done",
+            "task_id": spec.task_id,
+            "results": results,
+            "failed": failed,
+        }
 
 
 def main():
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
     socket_path = os.environ["RAY_TPU_NODE_SOCKET"]
+    profile_to = os.environ.get("RAY_TPU_PROFILE_WORKER")
+    if profile_to:
+        # Per-worker cProfile dump (os._exit skips atexit: dump from the
+        # main loop's exit path via threading.setprofile won't fire, so
+        # hook the Worker main loop exit through sys.settrace-free
+        # profiling of the whole process lifetime).
+        import cProfile
+
+        pr = cProfile.Profile()
+        pr.enable()
+        _orig_exit = os._exit
+
+        def _dump_and_exit(code):
+            pr.disable()
+            try:
+                pr.dump_stats(f"{profile_to}.{os.getpid()}")
+            except Exception:
+                pass
+            _orig_exit(code)
+
+        os._exit = _dump_and_exit
     arena = os.environ.get("RAY_TPU_ARENA")
     if arena:
         from .object_store import init_arena
