@@ -44,7 +44,7 @@ class Config:
     # and feeds the execute/done frame coalescing (deeper queue = more
     # completions per node-manager wakeup on a contended host).
     # Resources stay held while queued; blocking workers are reclaimed.
-    worker_pipeline_depth: int = 8
+    worker_pipeline_depth: int = 32
     # Hard cap on worker processes a node may spawn (includes workers started
     # to relieve blocked-on-get workers).
     max_workers: int = 64

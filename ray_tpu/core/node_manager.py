@@ -242,6 +242,10 @@ class ActorInfo:
     restart_count: int = 0
     name: str = ""
     death_cause: str = ""
+    # Direct-call socket the actor's worker listens on (same-node callers
+    # bypass the node manager for method calls; see worker_main
+    # _start_direct_listener / runtime.DriverRuntime._direct_channel).
+    direct_path: Optional[str] = None
 
 
 class NodeManager:
@@ -817,6 +821,11 @@ class NodeManager:
             await self._handle_kv(w, msg)
         elif mtype == "pg":
             asyncio.ensure_future(self._handle_pg(w, msg))
+        elif mtype == "actor_direct":
+            if w.actor_id is not None:
+                info = self._actors.get(w.actor_id)
+                if info is not None:
+                    info.direct_path = msg["path"]
         elif mtype == "actor_exit":
             await self._on_actor_graceful_exit(w, msg)
         elif mtype == "kill_actor":
@@ -2295,6 +2304,7 @@ class NodeManager:
         )
         if info.state == "dead":
             return
+        info.direct_path = None  # old worker's socket is gone either way
         if not graceful and info.restarts_left != 0 and not self._shutdown:
             info.state = "restarting"
             if info.restarts_left > 0:
@@ -2915,6 +2925,35 @@ class NodeManager:
         return self.call_sync(_del())
 
     # ----------------------------------------------------------- cancellation
+
+    async def get_actor_direct(
+        self, actor_id: ActorID, timeout: float = 30.0
+    ) -> Optional[str]:
+        """Resolve an actor's direct-call socket path for a same-node
+        caller. Returns only once the actor is alive, advertised a path,
+        AND has no node-manager-routed calls queued or in flight — the
+        caller's switch to the direct channel therefore cannot overtake
+        any call routed through here (per-caller actor ordering)."""
+        deadline = self._loop.time() + timeout
+        alive_no_path_since = None
+        while True:
+            info = self._actors.get(actor_id)
+            if info is None or info.state == "dead":
+                return None
+            if info.state == "alive":
+                if info.direct_path is None:
+                    # Worker predates direct support or the advert is in
+                    # flight; give it a moment then report unsupported.
+                    now = self._loop.time()
+                    if alive_no_path_since is None:
+                        alive_no_path_since = now
+                    elif now - alive_no_path_since > 1.0:
+                        return None
+                elif not info.queued and not info.inflight:
+                    return info.direct_path
+            if self._loop.time() > deadline:
+                return None
+            await asyncio.sleep(0.005)
 
     async def cancel_task(self, task_id: TaskID, force: bool = False):
         record = self._tasks.get(task_id)

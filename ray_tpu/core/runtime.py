@@ -154,21 +154,72 @@ class BaseRuntime:
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
         ids = [r.id() for r in ref_list]
-        try:
-            locations = self._get_locations(ids, timeout)
-        except TimeoutError as e:
-            raise GetTimeoutError(
-                f"get() timed out after {timeout}s waiting for {len(ids)} objects"
-            ) from e
+        # Direct-call results resolve from the inline reply (the channel
+        # reader registers them with the NM asynchronously) — the control
+        # plane is off the sync round-trip entirely. Only the driver
+        # runtime opens direct channels; workers take the normal path.
+        direct_vals: Dict[ObjectID, Any] = {}
+        rest_ids = []
+        waiters = getattr(self, "_direct_waiters", None)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if waiters is not None:
+            self._flush_direct()
+        for oid in ids:
+            if oid in direct_vals:
+                continue
+            entry = None
+            if waiters is not None:
+                with self._direct_waiters_lock:
+                    entry = waiters.get(oid)
+            if entry is None:
+                rest_ids.append(oid)
+                continue
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if not entry.event.wait(remaining):
+                raise GetTimeoutError(
+                    f"get() timed out after {timeout}s waiting for a "
+                    f"direct actor call result"
+                )
+            direct_vals[oid] = self._resolve_direct(oid, entry)
+            with self._direct_waiters_lock:
+                waiters.pop(oid, None)
+        if rest_ids:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            try:
+                locations = self._get_locations(rest_ids, remaining)
+            except TimeoutError as e:
+                raise GetTimeoutError(
+                    f"get() timed out after {timeout}s waiting for "
+                    f"{len(rest_ids)} objects"
+                ) from e
+            by_id = dict(locations)
+        else:
+            by_id = {}
         values = []
-        for oid, loc in locations:
-            if loc is None:
-                raise GetTimeoutError(f"object {oid.hex()} unavailable")
-            value = self._read_object(oid, loc, timeout)
+        for oid in ids:
+            if oid in direct_vals:
+                value = direct_vals[oid]
+            else:
+                loc = by_id.get(oid)
+                if loc is None:
+                    raise GetTimeoutError(f"object {oid.hex()} unavailable")
+                value = self._read_object(oid, loc, timeout)
             if isinstance(value, TaskError):
                 raise value.as_raisable()
             values.append(value)
         return values[0] if single else values
+
+    def _resolve_direct(self, oid: ObjectID, entry: _DirectResult):
+        msg = entry.payload
+        for roid, loc in msg.get("results", ()):
+            if roid == oid:
+                return self.store.get_object(loc)
+        # Channel died before the reply arrived.
+        from .exceptions import ActorDiedError
+
+        return ActorDiedError("actor task", msg.get("error", "actor died"))
 
     def _read_object(self, oid: ObjectID, loc: Location, timeout):
         """Read one object, retrying through fresh locations when the
@@ -193,6 +244,8 @@ class BaseRuntime:
         num_returns: int = 1,
         timeout: Optional[float] = None,
     ):
+        if getattr(self, "_direct_waiters", None) is not None:
+            self._flush_direct()
         refs = list(refs)
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
@@ -266,6 +319,136 @@ class BaseRuntime:
         self._flusher_stop.set()
 
 
+class _DirectResult:
+    """Pending direct-call reply: the channel reader fills payload and
+    sets the event; get() resolves from it without touching the NM."""
+
+    __slots__ = ("event", "payload")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.payload = None
+
+
+class _DirectChannel:
+    """Caller side of the direct actor-call transport (ref analogue:
+    direct_actor_task_submitter.h — actor tasks pushed straight to the
+    actor's worker over a dedicated connection; replies carry results
+    inline). One connection + reader thread per (driver, actor)."""
+
+    def __init__(self, rt: "DriverRuntime", actor_id: ActorID, path: str):
+        from .protocol import connect_unix
+
+        self.rt = rt
+        self.actor_id = actor_id
+        self.path = path
+        self.conn = connect_unix(path, timeout=5.0)
+        self.alive = True
+        self.plock = threading.Lock()
+        self.pending: Dict[TaskID, Tuple[ObjectID, _DirectResult, list]] = {}
+        self.out_buf: List[Dict[str, Any]] = []
+        self._fences: Dict[int, threading.Event] = {}
+        self._fence_seq = itertools.count(1)
+        threading.Thread(
+            target=self._reader, name="ray_tpu-direct-reader", daemon=True
+        ).start()
+
+    def submit(self, spec: TaskSpec):
+        """Buffer the call frame; flush() ships the burst as one frame.
+        get()/wait()/fence() and the runtime's periodic flusher are the
+        flush points — a sync caller flushes on its own get, a pipelined
+        burst rides one socket write."""
+        oid = spec.return_ids()[0]
+        entry = _DirectResult()
+        dep_ids = list(spec.dependency_ids())
+        with self.plock:
+            self.pending[spec.task_id] = (oid, entry, dep_ids)
+            self.out_buf.append({"spec": spec, "function_blob": None})
+        self.rt._direct_waiters_put(oid, entry)
+        self.rt._mark_chan_dirty(self)
+        # Return-slot + arg-pin registration: buffered without a loop
+        # wakeup; applied before this call's reply post and before any
+        # ref-delta flush (see _dpost).
+        self.rt._dpost(("reg", spec), wake=False)
+
+    def flush(self):
+        with self.plock:
+            buf = self.out_buf
+            self.out_buf = []
+        if not buf:
+            return
+        msg = (
+            {"type": "execute", **buf[0]} if len(buf) == 1
+            else {"type": "execute_batch", "items": buf}
+        )
+        self.conn.send(msg)
+
+    def fence(self, timeout: float = 5.0) -> bool:
+        """Ack'd once every earlier frame on this connection has been
+        enqueued at the worker — lets a control-plane-routed call be
+        ordered after direct ones."""
+        self.flush()
+        ev = threading.Event()
+        mid = next(self._fence_seq)
+        self._fences[mid] = ev
+        self.conn.send({"type": "fence", "msg_id": mid})
+        return ev.wait(timeout)
+
+    def _on_reply(self, msg):
+        with self.plock:
+            oid, entry, dep_ids = self.pending.pop(
+                msg["task_id"], (None, None, None)
+            )
+        if entry is None:
+            return
+        # Wake the waiter FIRST (on one core every microsecond before the
+        # set() is added to the caller's round trip), then register the
+        # results with the control plane: other consumers and the
+        # location directory stay consistent a beat later.
+        entry.payload = msg
+        entry.event.set()
+        self.rt._dpost(("done", msg["results"], dep_ids or []))
+
+    def _reader(self):
+        from .protocol import ConnectionClosed
+
+        try:
+            while True:
+                msg = self.conn.recv()
+                mtype = msg.get("type")
+                if mtype == "task_done":
+                    self._on_reply(msg)
+                elif mtype == "task_done_batch":
+                    for item in msg["items"]:
+                        self._on_reply(item)
+                elif mtype == "fence_ack":
+                    ev = self._fences.pop(msg.get("msg_id"), None)
+                    if ev is not None:
+                        ev.set()
+        except (ConnectionClosed, OSError, EOFError):
+            pass
+        except Exception:
+            pass
+        self.alive = False
+        with self.plock:
+            pend = list(self.pending.values())
+            self.pending.clear()
+        for _oid, entry, _deps in pend:
+            entry.payload = {
+                "failed": True, "results": [],
+                "error": "actor died (direct channel closed)",
+            }
+            entry.event.set()
+        self.rt._direct_channel_died(self.actor_id)
+
+    def close(self):
+        self.alive = False
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+
 class DriverRuntime(BaseRuntime):
     """Runtime embedded in the driver process; owns the NodeManager."""
 
@@ -274,14 +457,220 @@ class DriverRuntime(BaseRuntime):
         self._submit_lock = threading.Lock()
         self._submit_buf: List[TaskSpec] = []
         self._submit_waking = False
+        # Direct actor-call channels: actor_id bytes -> state dict
+        # {"lock", "status": none|discovering|ready|unsupported,
+        #  "chan", "nm_seq"}. See submit()/_direct_discover for the
+        # ordering-preserving switchover protocol.
+        self._direct_states: Dict[bytes, Dict[str, Any]] = {}
+        self._direct_states_lock = threading.Lock()
+        # oid -> _DirectResult; resolved entries are evicted FIFO beyond
+        # the cap (the object stays resolvable through the directory).
+        from collections import OrderedDict
+
+        self._direct_waiters: "OrderedDict[ObjectID, _DirectResult]" = (
+            OrderedDict()
+        )
+        self._direct_waiters_lock = threading.Lock()
+        # Coalesced NM bookkeeping for direct calls: submit/reply posts
+        # buffer here and drain in ONE loop callback per burst (three
+        # call_soon_threadsafe wakeups per call would cost more than the
+        # direct channel saves on a contended host).
+        self._dpost_lock = threading.Lock()
+        self._dpost_buf: List[tuple] = []
+        self._dpost_waking = False
+        self._dirty_chans: set = set()
+        self._dirty_chans_lock = threading.Lock()
         super().__init__(
             job_id=job_id,
             node_id=node_manager.node_id,
             worker_id=WorkerID.nil(),
         )
 
+    # ---- direct actor transport -------------------------------------------
+
+    _DIRECT_WAITER_CAP = 8192
+
+    def _direct_waiters_put(self, oid: ObjectID, entry: _DirectResult):
+        with self._direct_waiters_lock:
+            self._direct_waiters[oid] = entry
+            if len(self._direct_waiters) > self._DIRECT_WAITER_CAP:
+                # Evict resolved entries from the FIFO front, O(1)
+                # amortized (oldest first; the object stays resolvable
+                # through the directory). Unresolved entries stay — they
+                # are genuinely pending calls and drain on reply/failure.
+                for _ in range(32):
+                    k = next(iter(self._direct_waiters), None)
+                    if k is None or not self._direct_waiters[k].event.is_set():
+                        break
+                    del self._direct_waiters[k]
+
+    def _dpost(self, item: tuple, wake: bool = True):
+        """Queue NM bookkeeping. wake=False defers the drain to the next
+        reply/delta-flush (safe for "reg" items: the buffer is FIFO so a
+        reg always applies before its own call's "done", and
+        _flush_deltas drains first so ref deltas never see a missing
+        entry) — a sync call then costs ONE loop wakeup, not two."""
+        with self._dpost_lock:
+            self._dpost_buf.append(item)
+            if not wake or self._dpost_waking:
+                return
+            self._dpost_waking = True
+        self._nm._loop.call_soon_threadsafe(self._drain_dposts)
+
+    def _drain_dposts(self):
+        with self._dpost_lock:
+            items = self._dpost_buf
+            self._dpost_buf = []
+            self._dpost_waking = False
+        nm = self._nm
+        for item in items:
+            kind = item[0]
+            if kind == "reg":
+                spec = item[1]
+                for oid in spec.return_ids():
+                    nm.directory.add(oid, InlineLocation(b""),
+                                     initial_refs=0)
+                for oid in spec.dependency_ids():
+                    nm.directory.add_ref(oid)
+            else:  # "done"
+                _, results, dep_ids = item
+                for roid, loc in results:
+                    nm.directory.add(roid, loc, initial_refs=0)
+                    nm._seal_object(roid, loc)
+                for oid in dep_ids:
+                    nm._remove_ref(oid, 1)
+
+    def _mark_chan_dirty(self, chan: "_DirectChannel"):
+        with self._dirty_chans_lock:
+            self._dirty_chans.add(chan)
+
+    def _flush_direct(self):
+        if not self._dirty_chans:
+            return
+        with self._dirty_chans_lock:
+            chans = list(self._dirty_chans)
+            self._dirty_chans.clear()
+        for chan in chans:
+            try:
+                chan.flush()
+            except Exception:
+                pass
+
+    def _direct_state(self, actor_id: ActorID) -> Dict[str, Any]:
+        key = actor_id.binary()
+        with self._direct_states_lock:
+            st = self._direct_states.get(key)
+            if st is None:
+                st = {"lock": threading.Lock(), "status": "none",
+                      "chan": None, "nm_seq": 0}
+                self._direct_states[key] = st
+            return st
+
+    def _direct_channel_died(self, actor_id: ActorID):
+        st = self._direct_state(actor_id)
+        with st["lock"]:
+            st["status"] = "none"
+            st["chan"] = None
+
+    def _direct_discover(self, actor_id: ActorID, st: Dict[str, Any]):
+        """Background switchover: resolve the actor's direct socket. The
+        NM only answers once the actor is alive with NO control-plane
+        calls queued/in flight, and we only flip to ready if no new
+        NM-path call raced in (nm_seq unchanged) — so direct frames can
+        never overtake NM-routed ones."""
+        while True:
+            with st["lock"]:
+                seq0 = st["nm_seq"]
+            try:
+                path = self._nm.call_sync(
+                    self._nm.get_actor_direct(actor_id), timeout=40.0
+                )
+            except Exception:
+                path = None
+            if path is None:
+                # Unsupported OR just continuously busy for the whole
+                # wait window: retry on a later submit rather than
+                # pinning the actor to the slow route forever.
+                with st["lock"]:
+                    st["status"] = "unsupported"
+                    st["retry_at"] = time.monotonic() + 10.0
+                return
+            with st["lock"]:
+                if st["nm_seq"] != seq0:
+                    continue  # an NM call raced in; wait for drain again
+                chan = st["chan"]
+                if chan is None or not chan.alive or chan.path != path:
+                    try:
+                        chan = _DirectChannel(self, actor_id, path)
+                    except Exception:
+                        st["status"] = "unsupported"
+                        st["retry_at"] = time.monotonic() + 10.0
+                        return
+                    st["chan"] = chan
+                st["status"] = "ready"
+                return
+
+    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        if spec.task_type == TaskType.ACTOR_TASK and spec.actor_id is not None:
+            # Calls carrying retries keep the NM route: its actor-restart
+            # replay resubmits them in order; a direct channel can only
+            # fail them on worker death.
+            eligible = (not spec.streaming and spec.num_returns == 1
+                        and spec.retries_left == 0)
+            st = self._direct_state(spec.actor_id)
+            chan_for_fence = None
+            spawn_discovery = False
+            with st["lock"]:
+                if eligible and st["status"] == "ready":
+                    chan = st["chan"]
+                    try:
+                        chan.submit(spec)
+                        return [
+                            ObjectRef(oid, _register=True)
+                            for oid in spec.return_ids()
+                        ]
+                    except Exception:
+                        chan.close()
+                        st["status"] = "none"
+                        st["chan"] = None
+                # NM path: bump the sequence so a discovery in flight
+                # cannot flip to ready underneath this call; discovery is
+                # (re)started AFTER the spec is enqueued below, so it
+                # cannot observe the actor idle before this call lands.
+                st["nm_seq"] += 1
+                if st["status"] == "ready":
+                    # Ineligible call interleaving with direct traffic:
+                    # fence so it cannot overtake queued direct frames.
+                    chan_for_fence = st["chan"]
+                if st["status"] in ("none", "ready") or (
+                    st["status"] == "unsupported"
+                    and time.monotonic() >= st.get("retry_at", 0.0)
+                ):
+                    st["status"] = "discovering"
+                    spawn_discovery = True
+            if chan_for_fence is not None and chan_for_fence.alive:
+                try:
+                    chan_for_fence.fence()
+                except Exception:
+                    pass
+            refs = super().submit(spec)
+            if spawn_discovery:
+                # The submit above queued its drain callback on the NM
+                # loop first; the discovery's own loop work is queued
+                # after it, so get_actor_direct sees this call.
+                threading.Thread(
+                    target=self._direct_discover,
+                    args=(spec.actor_id, st),
+                    daemon=True,
+                ).start()
+            return refs
+        return super().submit(spec)
+
     def _flush_deltas(self, deltas: Dict[ObjectID, int]):
         async def _apply():
+            # Direct-call registrations must land before ref deltas (a
+            # deferred "reg" pins args/return slots the deltas refer to).
+            self._drain_dposts()
             for oid, d in deltas.items():
                 if d > 0:
                     self._nm.directory.add_ref(oid, d)
@@ -289,6 +678,18 @@ class DriverRuntime(BaseRuntime):
                     self._nm._remove_ref(oid, -d)
 
         self._nm._call(_apply())
+
+    def _flush_loop(self):
+        # Also the deferral bound for buffered direct-call frames: a
+        # fire-and-forget caller that never gets still has its frames
+        # shipped within one flush interval.
+        cfg = get_config()
+        while not self._flusher_stop.wait(cfg.refcount_flush_interval_s):
+            try:
+                self.refs.flush()
+                self._flush_direct()
+            except Exception:
+                pass
 
     def _post(self, coro):
         """Fire a coroutine onto the node manager's loop without blocking
@@ -430,6 +831,13 @@ class DriverRuntime(BaseRuntime):
 
     def shutdown(self):
         super().shutdown()
+        with self._direct_states_lock:
+            states = list(self._direct_states.values())
+            self._direct_states.clear()
+        for st in states:
+            chan = st.get("chan")
+            if chan is not None:
+                chan.close()
         self.refs.flush()
         self._nm.shutdown()
         self.store.shutdown(unlink_created=True)

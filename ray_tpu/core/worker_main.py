@@ -26,7 +26,7 @@ from . import runtime_context
 
 
 # Completions buffered before a mid-queue flush (see _main_loop).
-_DONE_FLUSH_BATCH = 4
+_DONE_FLUSH_BATCH = 16
 
 
 class Worker:
@@ -50,6 +50,21 @@ class Worker:
         # from an actor pool thread.
         self._done_buf: List[dict] = []
         self._done_lock = threading.Lock()
+        # Direct actor-call channel (ref analogue: direct actor task
+        # submission, core_worker/transport/direct_actor_task_submitter.h
+        # — callers push actor tasks straight to the actor's worker; the
+        # control plane only does lifecycle). The listener starts after a
+        # successful actor creation; frames from caller connections join
+        # the same task queue as node-manager frames, replies return
+        # inline on the calling connection.
+        self._direct_srv = None
+        self._direct_path: str | None = None
+        # Serializes actor-task execution between the main loop and
+        # direct-connection serve threads (concurrency-1 actors execute
+        # direct frames INLINE in the serve thread — one fewer thread
+        # handoff per call; the lock preserves the one-task-at-a-time
+        # actor invariant).
+        self._serial_lock = threading.Lock()
         # Threaded actor concurrency (ref analogue: max_concurrency actors
         # via ConcurrencyGroupManager, core_worker/transport/
         # concurrency_group_manager.h): creation tasks with
@@ -185,7 +200,14 @@ class Worker:
                     self._run_task_direct, spec, msg.get("function_blob")
                 )
                 continue
-            done = self._run_task(spec, msg.get("function_blob"))
+            with self._serial_lock:
+                done = self._run_task(spec, msg.get("function_blob"))
+            if (
+                spec.task_type == TaskType.ACTOR_CREATION_TASK
+                and not done.get("failed")
+                and self._direct_srv is None
+            ):
+                self._start_direct_listener(spec.actor_id)
             with self._done_lock:
                 self._done_buf.append(done)
                 pending_dones = len(self._done_buf)
@@ -195,7 +217,7 @@ class Worker:
             # queue while we chew through the rest, and always when the
             # queue drains. The constant is deliberately independent of
             # the node manager's worker_pipeline_depth config (workers
-            # don't see it); 4 keeps refill latency low at any depth.
+            # don't see it).
             if not more or pending_dones >= _DONE_FLUSH_BATCH:
                 self._flush_dones()
         # Flush refcounts + user metrics before exit (os._exit skips
@@ -212,6 +234,100 @@ class Worker:
         except Exception:
             pass
         os._exit(0)
+
+    def _start_direct_listener(self, actor_id):
+        """Listen for direct caller connections (one UDS per actor
+        worker, beside the node socket) and advertise the path to the
+        node manager, which hands it to callers on the same node."""
+        import socket as _socket
+
+        base = os.environ.get("RAY_TPU_NODE_SOCKET", "/tmp/rtpu")
+        path = f"{base}.d{os.getpid()}"
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        try:
+            srv.bind(path)
+            srv.listen(64)
+        except OSError:
+            return  # no direct path; callers fall back to the NM route
+        self._direct_srv = srv
+        self._direct_path = path
+        threading.Thread(
+            target=self._direct_accept_loop, args=(srv,), daemon=True
+        ).start()
+        self.conn.send({"type": "actor_direct", "path": path})
+
+    def _direct_accept_loop(self, srv):
+        from .protocol import Connection as _Conn
+
+        while self._alive:
+            try:
+                sock, _ = srv.accept()
+            except OSError:
+                return
+            conn = _Conn(sock)
+            threading.Thread(
+                target=self._direct_serve, args=(conn,), daemon=True
+            ).start()
+
+    def _direct_serve(self, conn):
+        """One caller connection: frames execute in submission order —
+        INLINE in this thread for concurrency-1 actors (under the serial
+        lock), via the pool for concurrent actors. Replies batch while a
+        frame batch is being chewed through. A fence frame acks once
+        every earlier frame from this connection has executed — callers
+        use it to order a control-plane-routed call after direct ones."""
+        try:
+            while self._alive:
+                msg = conn.recv()
+                mtype = msg.get("type")
+                if mtype in ("execute", "execute_batch"):
+                    items = (
+                        msg["items"] if mtype == "execute_batch" else [msg]
+                    )
+                    if self._pool is not None:
+                        for m in items:
+                            self._pool.submit(
+                                self._run_direct, conn, m["spec"],
+                                m.get("function_blob"),
+                            )
+                        continue
+                    replies = []
+                    for m in items:
+                        with self._serial_lock:
+                            replies.append(self._run_task(
+                                m["spec"], m.get("function_blob")
+                            ))
+                        if len(replies) >= _DONE_FLUSH_BATCH:
+                            self._send_direct_replies(conn, replies)
+                            replies = []
+                    self._send_direct_replies(conn, replies)
+                elif mtype == "fence":
+                    conn.send({"type": "fence_ack",
+                               "msg_id": msg.get("msg_id")})
+        except (ConnectionClosed, OSError):
+            pass
+
+    def _send_direct_replies(self, conn, replies):
+        if not replies:
+            return
+        try:
+            if len(replies) == 1:
+                conn.send(replies[0])
+            else:
+                conn.send({"type": "task_done_batch", "items": replies})
+        except Exception:
+            pass
+
+    def _run_direct(self, conn, spec, function_blob):
+        done = self._run_task(spec, function_blob)
+        try:
+            conn.send(done)
+        except Exception:
+            pass
 
     def _flush_dones(self):
         with self._done_lock:

@@ -150,6 +150,14 @@ def run_envelope_probes(
 
     results: Dict[str, float] = {}
 
+    # Warm the worker pool first: a cold probe would time worker spawn
+    # (~2s/process on hosts with heavy sitecustomize), not the envelope.
+    @ray_tpu.remote
+    def _warm():
+        return None
+
+    ray_tpu.get([_warm.remote() for _ in range(20)])
+
     # --- N object args to a single task (ref envelope: 10k+) -------------
     refs = [ray_tpu.put(i) for i in range(num_args)]
 
