@@ -2934,7 +2934,8 @@ class NodeManager:
         AND has no node-manager-routed calls queued or in flight — the
         caller's switch to the direct channel therefore cannot overtake
         any call routed through here (per-caller actor ordering)."""
-        deadline = self._loop.time() + timeout
+        start = self._loop.time()
+        deadline = start + timeout
         alive_no_path_since = None
         while True:
             info = self._actors.get(actor_id)
@@ -2951,9 +2952,13 @@ class NodeManager:
                         return None
                 elif not info.queued and not info.inflight:
                     return info.direct_path
-            if self._loop.time() > deadline:
+            now = self._loop.time()
+            if now > deadline:
                 return None
-            await asyncio.sleep(0.005)
+            # Adaptive poll: fine-grained while the drain window is hot
+            # (the common sync case resolves in ms), coarse afterwards so
+            # a long-busy actor does not ride the control loop at 200 Hz.
+            await asyncio.sleep(0.005 if now - start < 0.25 else 0.05)
 
     async def cancel_task(self, task_id: TaskID, force: bool = False):
         record = self._tasks.get(task_id)

@@ -383,16 +383,21 @@ class _DirectChannel:
         )
         self.conn.send(msg)
 
-    def fence(self, timeout: float = 5.0) -> bool:
+    def fence(self, timeout: float = 30.0) -> bool:
         """Ack'd once every earlier frame on this connection has been
-        enqueued at the worker — lets a control-plane-routed call be
-        ordered after direct ones."""
+        EXECUTED at the worker — lets a control-plane-routed call be
+        ordered after direct ones. A False return means the actor stayed
+        busy past the deadline; the caller proceeds best-effort (the
+        alternative is blocking the submitter indefinitely)."""
         self.flush()
         ev = threading.Event()
         mid = next(self._fence_seq)
         self._fences[mid] = ev
         self.conn.send({"type": "fence", "msg_id": mid})
-        return ev.wait(timeout)
+        ok = ev.wait(timeout)
+        if not ok:
+            self._fences.pop(mid, None)
+        return ok
 
     def _on_reply(self, msg):
         with self.plock:
@@ -535,7 +540,9 @@ class DriverRuntime(BaseRuntime):
             else:  # "done"
                 _, results, dep_ids = item
                 for roid, loc in results:
-                    nm.directory.add(roid, loc, initial_refs=0)
+                    # The entry exists from the FIFO-earlier "reg" post;
+                    # _seal_object swaps the placeholder for the real
+                    # location and fires seal events.
                     nm._seal_object(roid, loc)
                 for oid in dep_ids:
                     nm._remove_ref(oid, 1)
@@ -617,6 +624,19 @@ class DriverRuntime(BaseRuntime):
             # fail them on worker death.
             eligible = (not spec.streaming and spec.num_returns == 1
                         and spec.retries_left == 0)
+            if eligible:
+                # A call chained on a still-pending direct result must
+                # not ride the same connection: the worker would execute
+                # it while the dependency's reply (and therefore its
+                # seal) may still be sitting in a reply batch — route it
+                # through the NM, which gates dispatch on sealed deps.
+                waiters = self._direct_waiters
+                for dep in spec.dependency_ids():
+                    with self._direct_waiters_lock:
+                        entry = waiters.get(dep)
+                    if entry is not None and not entry.event.is_set():
+                        eligible = False
+                        break
             st = self._direct_state(spec.actor_id)
             chan_for_fence = None
             spawn_discovery = False
@@ -713,6 +733,10 @@ class DriverRuntime(BaseRuntime):
             self._nm._loop.call_soon_threadsafe(self._drain_submits)
 
     def _drain_submits(self):
+        # Buffered direct-call registrations must land before these
+        # submits: a spec depending on a direct result needs its return
+        # slot in the directory to dep-wait instead of erroring.
+        self._drain_dposts()
         with self._submit_lock:
             specs = self._submit_buf
             self._submit_buf = []

@@ -53,6 +53,8 @@ class ObjectRefGenerator:
         self._completion_ref = completion_ref
         self._next = 0
         self._count: Optional[int] = None
+        # Optional per-item production deadline (serve SSE guard).
+        self.item_timeout_s = None
 
     @property
     def completed(self) -> ObjectRef:
@@ -69,10 +71,23 @@ class ObjectRefGenerator:
         if self._count is not None and self._next >= self._count:
             raise StopIteration
         key = stream_key(self._task_id, self._next)
+        deadline = (
+            None if self.item_timeout_s is None
+            else time.monotonic() + self.item_timeout_s
+        )
         while True:
             blob = rt.kv_get(key)
             if blob is not None:
                 break
+            if deadline is not None and time.monotonic() > deadline:
+                # A wedged producer must not hold consumers (serve proxy
+                # threads) forever — surface a timeout instead.
+                from .exceptions import GetTimeoutError
+
+                raise GetTimeoutError(
+                    f"stream item {self._next} not produced within "
+                    f"{self.item_timeout_s}s"
+                )
             # Surface producer failure instead of hanging: the completion
             # slot seals (with the error) when the task dies.
             import ray_tpu

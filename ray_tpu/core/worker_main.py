@@ -59,6 +59,12 @@ class Worker:
         # inline on the calling connection.
         self._direct_srv = None
         self._direct_path: str | None = None
+        # Per-connection direct reply batches (instance state so the
+        # before-blocking hook can flush them: a direct task that blocks
+        # on a nested get must not strand earlier replies — and their
+        # seals — in a local buffer).
+        self._dr_lock = threading.Lock()
+        self._dr_bufs: dict = {}  # id(conn) -> (conn, [reply, ...])
         # Serializes actor-task execution between the main loop and
         # direct-connection serve threads (concurrency-1 actors execute
         # direct frames INLINE in the serve thread — one fewer thread
@@ -86,7 +92,7 @@ class Worker:
         # Flush buffered dones before any blocking runtime request: a
         # nested get could otherwise wait on an object whose seal is
         # sitting in our own outbound buffer (deadlock).
-        self.runtime.before_block = self._flush_dones
+        self.runtime.before_block = self._flush_before_block
         reader = threading.Thread(target=self._reader_loop, daemon=True)
         reader.start()
         self._main_loop()
@@ -295,32 +301,54 @@ class Worker:
                                 m.get("function_blob"),
                             )
                         continue
-                    replies = []
                     for m in items:
                         with self._serial_lock:
-                            replies.append(self._run_task(
+                            done = self._run_task(
                                 m["spec"], m.get("function_blob")
-                            ))
-                        if len(replies) >= _DONE_FLUSH_BATCH:
-                            self._send_direct_replies(conn, replies)
-                            replies = []
-                    self._send_direct_replies(conn, replies)
+                            )
+                        with self._dr_lock:
+                            _, buf = self._dr_bufs.setdefault(
+                                id(conn), (conn, [])
+                            )
+                            buf.append(done)
+                            n = len(buf)
+                        if n >= _DONE_FLUSH_BATCH:
+                            self._flush_direct_replies(conn)
+                    self._flush_direct_replies(conn)
                 elif mtype == "fence":
                     conn.send({"type": "fence_ack",
                                "msg_id": msg.get("msg_id")})
         except (ConnectionClosed, OSError):
             pass
 
-    def _send_direct_replies(self, conn, replies):
-        if not replies:
-            return
-        try:
-            if len(replies) == 1:
-                conn.send(replies[0])
+    def _flush_direct_replies(self, conn=None):
+        with self._dr_lock:
+            if conn is not None:
+                entries = [self._dr_bufs.pop(id(conn), None)]
             else:
-                conn.send({"type": "task_done_batch", "items": replies})
-        except Exception:
-            pass
+                entries = list(self._dr_bufs.values())
+                self._dr_bufs.clear()
+        for entry in entries:
+            if not entry:
+                continue
+            c, replies = entry
+            if not replies:
+                continue
+            try:
+                if len(replies) == 1:
+                    c.send(replies[0])
+                else:
+                    c.send({"type": "task_done_batch", "items": replies})
+            except Exception:
+                pass
+
+    def _flush_before_block(self):
+        """Runtime before-blocking hook: ship every buffered completion
+        (NM dones AND direct replies) before waiting on the node manager
+        — a nested get must never wait on a seal stranded in our own
+        outbound buffers."""
+        self._flush_dones()
+        self._flush_direct_replies()
 
     def _run_direct(self, conn, spec, function_blob):
         done = self._run_task(spec, function_blob)

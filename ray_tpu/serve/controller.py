@@ -198,7 +198,7 @@ class ServeControllerActor:
         import ray_tpu
 
         try:
-            ray_tpu.get(handle.prepare_shutdown.remote(), timeout=5.0)
+            ray_tpu.get(handle.prepare_shutdown.remote(), timeout=30.0)
         except Exception:
             pass
         self._kill_replica(handle)

@@ -31,6 +31,7 @@ batched forward passes instead of single-row calls.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -39,6 +40,11 @@ import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 MAX_DEATH_RETRIES = 3
+# Per-item deadline for streaming responses (overridable via env);
+# guards proxy/consumer threads against a wedged replica generator.
+STREAM_ITEM_TIMEOUT_S = float(
+    os.environ.get("RAY_TPU_SERVE_STREAM_ITEM_TIMEOUT_S", "120")
+)
 # How long an evicted replica key stays filtered out of snapshots (covers
 # the gap until the controller's health check removes it server-side).
 DEAD_REPLICA_TTL_S = 10.0
@@ -393,8 +399,12 @@ class DeploymentHandle:
                 gen = replica.handle_request_streaming.options(
                     num_returns="streaming"
                 ).remote(self._method, args, kwargs, model_id)
+                # Per-item production deadline: a wedged replica
+                # generator surfaces a timeout instead of pinning the
+                # consumer (e.g. a proxy SSE thread) forever.
+                gen.item_timeout_s = STREAM_ITEM_TIMEOUT_S
                 for ref in gen:
-                    value = ray_tpu.get(ref)
+                    value = ray_tpu.get(ref, timeout=STREAM_ITEM_TIMEOUT_S)
                     started = True
                     yield value
                 return

@@ -109,11 +109,22 @@ class Replica:
     def version(self) -> str:
         return self._version
 
-    def prepare_shutdown(self) -> str:
-        """Drain hook: by the time this call is served, every request queued
-        before the controller retired this replica from the route set has
-        been executed (actor calls from one submitter are ordered)."""
-        return "drained"
+    def prepare_shutdown(self, timeout_s: float = 25.0) -> str:
+        """Drain hook. For concurrency-1 replicas, per-submitter call
+        ordering already guarantees earlier queued requests ran before
+        this one; for concurrent replicas (and long-lived STREAMING
+        generators) it additionally waits until no request is in flight,
+        bounded by ``timeout_s`` (ref analogue: proxy/replica graceful
+        drain on rolling update, serve/_private/proxy.py:1097)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            with self._lock:
+                if self._ongoing == 0:
+                    return "drained"
+            _time.sleep(0.05)
+        return f"timeout ({self._ongoing} ongoing)"
 
     def ping(self) -> str:
         return "pong"

@@ -174,3 +174,30 @@ def test_named_actor_from_second_handle(rt):
     ray_tpu.get(n.setv.remote(7))
     h = ray_tpu.get_actor("direct-named")
     assert ray_tpu.get(h.getv.remote()) == 7
+
+
+def test_chained_pending_direct_result(rt):
+    """A call whose argument is a still-pending direct result routes via
+    the NM (dep-gated) instead of riding the channel — the worker would
+    otherwise execute it while the dependency's seal sits in a reply
+    batch (review finding: chained-call deadlock)."""
+
+    @ray_tpu.remote
+    class Chain:
+        def f(self):
+            return 10
+
+        def g(self, x):
+            return x + 5
+
+    c = Chain.remote()
+    for _ in range(3):
+        ray_tpu.get(c.f.remote())  # engage the direct channel
+    r1 = c.f.remote()
+    r2 = c.g.remote(r1)
+    assert ray_tpu.get(r2, timeout=30) == 15
+    # and a longer chain
+    r = c.f.remote()
+    for _ in range(5):
+        r = c.g.remote(r)
+    assert ray_tpu.get(r, timeout=30) == 35

@@ -1,0 +1,142 @@
+"""Round-2/3 serve done-criterion: an HTTP client streams tokens from a
+2-node cluster WHILE a rolling update replaces the replicas; the
+in-flight stream finishes on the old version (drain) and later requests
+see the new version. Also pins the SSE per-item timeout guard.
+
+Ref analogue: serve/_private/proxy.py streaming + deployment_state.py
+rolling update with graceful drain."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def two_node_serve():
+    c = Cluster(head_resources={"CPU": 2},
+                system_config={"log_to_driver": False})
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(2)
+    yield c
+    serve.shutdown()
+    c.shutdown()
+
+
+def _sse_events(resp):
+    """Parse `data:` frames incrementally from a streaming HTTP response."""
+    buf = b""
+    while True:
+        chunk = resp.read1(4096) if hasattr(resp, "read1") else resp.read(4096)
+        if not chunk:
+            return
+        buf += chunk
+        while b"\n\n" in buf:
+            frame, buf = buf.split(b"\n\n", 1)
+            for line in frame.splitlines():
+                if line.startswith(b"data: "):
+                    yield json.loads(line[6:])
+            if frame.startswith(b"event: end"):
+                return
+
+
+def test_stream_through_rolling_update(two_node_serve):
+    from ray_tpu.serve import http_proxy
+
+    def make(version):
+        @serve.deployment(num_replicas=2)
+        class Tok:
+            def stream(self, n):
+                for i in range(int(n)):
+                    time.sleep(0.12)
+                    yield {"v": version, "i": i}
+
+            def __call__(self, _):
+                return {"v": version}
+
+        return Tok
+
+    serve.run(make("v1").bind(), name="tok")
+    proxies = http_proxy.start_per_node_proxies(port=0)
+    try:
+        assert len(proxies) >= 2, "expected a proxy on every node"
+        ports = [p for _, p in proxies.values()]
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ports[0]}/tok/stream",
+            data=json.dumps(20).encode(),
+            headers={"Content-Type": "application/json",
+                     "Accept": "text/event-stream"},
+        )
+        resp = urllib.request.urlopen(req, timeout=60)
+        events = _sse_events(resp)
+        first = next(events)
+        assert first == {"v": "v1", "i": 0}
+
+        # Mid-stream: roll the deployment to v2 (new code version).
+        serve.run(make("v2").bind(), name="tok")
+
+        rest = list(events)
+        got = [first] + [e for e in rest if e is not None]
+        # The in-flight stream finished on the OLD version — the rolling
+        # update drained the replica instead of killing it mid-stream.
+        assert [e["i"] for e in got] == list(range(20))
+        assert all(e["v"] == "v1" for e in got), got[-3:]
+
+        # New requests (via the OTHER node's proxy) see the new version.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            req2 = urllib.request.Request(
+                f"http://127.0.0.1:{ports[1]}/tok",
+                data=json.dumps(None).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req2, timeout=30) as r2:
+                body = json.loads(r2.read())
+            if body.get("result", {}).get("v") == "v2":
+                break
+            time.sleep(0.25)
+        assert body["result"]["v"] == "v2", body
+    finally:
+        for actor, _ in proxies.values():
+            try:
+                ray_tpu.get(actor.shutdown.remote(), timeout=10)
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+
+
+def test_stream_item_timeout_guard():
+    """A wedged replica generator surfaces a timeout to the consumer
+    instead of pinning it forever (handle.stream item deadline)."""
+    ray_tpu.init(num_cpus=2, system_config={"log_to_driver": False})
+    try:
+        from ray_tpu.serve import handle as handle_mod
+
+        @serve.deployment
+        class Wedge:
+            def stream(self, _):
+                yield {"i": 0}
+                time.sleep(3600)  # never yields again
+                yield {"i": 1}
+
+        h = serve.run(Wedge.bind(), name="wedge").options(method="stream")
+        old = handle_mod.STREAM_ITEM_TIMEOUT_S
+        handle_mod.STREAM_ITEM_TIMEOUT_S = 2.0
+        try:
+            it = h.stream(None)
+            assert next(it) == {"i": 0}
+            t0 = time.time()
+            with pytest.raises(Exception):
+                next(it)
+            assert time.time() - t0 < 30
+        finally:
+            handle_mod.STREAM_ITEM_TIMEOUT_S = old
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
