@@ -74,6 +74,9 @@ class Config:
     pull_chunks_in_flight: int = 4
     serve_chunks_in_flight: int = 8
     pull_chunk_timeout_s: float = 120.0
+    # How long a chunked pull may queue waiting for store memory before
+    # failing (ref: pull retry/backoff bounds in pull_manager.h).
+    pull_admission_timeout_s: float = 60.0
     # Use the native C++ shared-memory arena store (src/store/) when the
     # extension is importable/buildable; pure-Python per-object shm otherwise.
     use_native_store: bool = True

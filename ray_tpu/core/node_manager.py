@@ -2548,31 +2548,34 @@ class NodeManager:
 
     # ----------------------------------------------------------- spilling
 
-    def _maybe_spill(self):
+    def _maybe_spill(self, need: int = 0):
         """Start one spill pass when store usage crosses the high-water
-        mark (ref analogue: LocalObjectManager::SpillObjectUptoMaxThroughput
+        mark, or when a caller explicitly needs ``need`` bytes freed
+        regardless of the mark (pull admission below high water; ref
+        analogue: LocalObjectManager::SpillObjectUptoMaxThroughput
         triggered from the eviction path)."""
         cap = self.directory.capacity_bytes
+        if not self.directory.spill_enabled or self._spilling or cap <= 0:
+            return
         if (
-            not self.directory.spill_enabled
-            or self._spilling
-            or cap <= 0
-            or self.directory.used_bytes
+            need <= 0
+            and self.directory.used_bytes
             <= cap * self.config.spill_high_water_frac
         ):
             return
         self._spilling = True
-        self._spawn_bg(self._spill_pass())
+        self._spawn_bg(self._spill_pass(need))
 
-    async def _spill_pass(self):
-        """Move LRU local objects to disk until under the low-water mark.
+    async def _spill_pass(self, extra_need: int = 0):
+        """Move LRU local objects to disk until under the low-water mark
+        (or until ``extra_need`` bytes are freed, whichever is more).
         Byte IO runs in executor threads; the directory entry swaps via
         compare-and-swap so racing reads/GC stay correct."""
         try:
             target = int(
                 self.directory.capacity_bytes * self.config.spill_low_water_frac
             )
-            need = self.directory.used_bytes - target
+            need = max(self.directory.used_bytes - target, extra_need)
             if need <= 0:
                 return
             for oid, loc in self.directory.spill_candidates(need):

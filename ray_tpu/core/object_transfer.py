@@ -48,8 +48,13 @@ class ObjectTransfer:
         # Server-side: bound concurrent chunk reads (each stages one
         # chunk_bytes copy + an executor thread).
         self._serve_slots = asyncio.Semaphore(cfg.serve_chunks_in_flight)
+        # Memory admission (ref: pull_manager.h:52 — bundles admitted
+        # against available store memory): bytes reserved by in-flight
+        # chunked pulls, counted against store capacity so N admitted
+        # pulls can never exceed what the store can hold.
+        self._inflight_bytes = 0
         self.stats = {"chunks_pulled": 0, "chunks_served": 0,
-                      "chunked_pulls": 0}
+                      "chunked_pulls": 0, "pulls_queued_on_memory": 0}
 
     # ------------------------------------------------------------- pull side
 
@@ -71,7 +76,52 @@ class ObjectTransfer:
             )
         async with self._pull_slots:
             self.stats["chunked_pulls"] += 1
-            return await self._pull_chunked(peer, oid, int(size))
+            await self._admit_bytes(int(size))
+            try:
+                return await self._pull_chunked(peer, oid, int(size))
+            finally:
+                self._inflight_bytes -= int(size)
+
+    async def _admit_bytes(self, size: int):
+        """Queue until the store can hold ``size`` more bytes (spilling
+        cold objects to make room); fail cleanly when the object can
+        never fit (ref: PullManager admission vs available memory)."""
+        d = self._nm.directory
+        cap = d.capacity_bytes
+        if cap > 0 and size > cap:
+            raise TransferError(
+                f"object of {size} bytes exceeds the object store "
+                f"capacity ({cap} bytes); it can never be pulled whole"
+            )
+        # NOTE: directory.used_bytes does not see a transfer's arena
+        # block until finalize registers the object, so the full-size
+        # reservation here is the ONLY meter for in-flight pulls (no
+        # double counting while chunks land).
+        if cap <= 0:
+            self._inflight_bytes += size
+            return
+        loop = self._nm._loop
+        deadline = loop.time() + self._nm.config.pull_admission_timeout_s
+        queued = False
+        while True:
+            free = cap - d.used_bytes - self._inflight_bytes
+            if size <= free:
+                self._inflight_bytes += size
+                return
+            if not queued:
+                queued = True
+                self.stats["pulls_queued_on_memory"] += 1
+            # Ask the spill pass to free exactly what we lack — the
+            # high-water trigger alone would no-op below the mark.
+            self._nm._maybe_spill(need=size - max(free, 0))
+            if loop.time() > deadline:
+                raise TransferError(
+                    f"pull of {size} bytes not admitted within "
+                    f"{self._nm.config.pull_admission_timeout_s}s: store "
+                    f"full ({d.used_bytes}/{cap} used, "
+                    f"{self._inflight_bytes} in flight)"
+                )
+            await asyncio.sleep(0.05)
 
     async def _pull_chunked(self, peer, oid: ObjectID, size: int) -> Location:
         store = self._nm.local_store

@@ -13,6 +13,12 @@ to every worker of the job; supported keys:
 - ``env_vars``: dict injected into every worker's os.environ.
 - ``py_modules``: list of local module directories, each shipped like
   working_dir and added to sys.path.
+- ``pip``: list of requirement strings (or ``{"packages": [...]}``).
+  Each node builds ONE virtualenv per requirements-hash (ref analogue:
+  _private/runtime_env/pip.py + the per-node uri_cache.py) with
+  ``--system-site-packages`` so the base env stays visible; the venv's
+  site-packages is prepended to every worker's sys.path. Concurrent
+  workers race on the same cache entry via build-in-tmp + atomic rename.
 """
 
 from __future__ import annotations
@@ -80,9 +86,94 @@ def publish(runtime_env: Dict[str, Any], kv_put, job_id: str) -> str:
         pkgs.append({"kind": kind, "digest": digest,
                      "name": os.path.basename(os.path.abspath(path))})
     meta["packages"] = pkgs
+    pip_spec = runtime_env.get("pip")
+    if pip_spec:
+        reqs = (list(pip_spec.get("packages", []))
+                if isinstance(pip_spec, dict) else list(pip_spec))
+        shipped = []
+        for r in sorted(reqs):
+            if os.path.isfile(r):
+                # Local wheel/sdist: ship the bytes through the KV so
+                # workers on OTHER nodes can install it too.
+                with open(r, "rb") as f:
+                    blob = f.read()
+                digest = hashlib.sha1(blob).hexdigest()[:16]
+                kv_put(KV_PKG.format(digest), blob)
+                shipped.append({"file": os.path.basename(r),
+                                "digest": digest})
+            else:
+                shipped.append(r)
+        meta["pip"] = shipped
     key = KV_META.format(job_id)
     kv_put(key, cloudpickle.dumps(meta))
     return key
+
+
+def _ensure_pip_env(session_dir: str, reqs: list,
+                    kv_get=None) -> Optional[str]:
+    """Build (or reuse) this node's venv for a requirements set; returns
+    its site-packages path. Cache key = hash of the requirement strings /
+    shipped-file digests (ref: pip.py's hash-keyed per-node
+    environments). Dict entries are KV-shipped local wheels."""
+    import glob
+    import shutil
+    import subprocess
+    import venv
+
+    req_keys = [r if isinstance(r, str) else r["digest"] for r in reqs]
+    digest = hashlib.sha1("\n".join(req_keys).encode()).hexdigest()[:16]
+    dest = os.path.join(session_dir, "runtime_env", "pip", digest)
+
+    def site_packages(base: str) -> Optional[str]:
+        hits = glob.glob(os.path.join(base, "lib", "python*",
+                                      "site-packages"))
+        return hits[0] if hits else None
+
+    if os.path.exists(os.path.join(dest, ".ready")):
+        return site_packages(dest)
+    tmp = dest + f".tmp{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    # system-site-packages: the job ADDS packages; the base env (jax,
+    # numpy, the framework itself) stays importable.
+    venv.create(tmp, with_pip=True, system_site_packages=True)
+    lines = []
+    for r in reqs:
+        if isinstance(r, str):
+            lines.append(r)
+            continue
+        blob = kv_get(KV_PKG.format(r["digest"])) if kv_get else None
+        if blob is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"pip runtime_env: shipped wheel {r['file']} missing "
+                f"from the cluster KV"
+            )
+        local = os.path.join(tmp, r["file"])
+        with open(local, "wb") as f:
+            f.write(blob)
+        lines.append(local)
+    req_file = os.path.join(tmp, "requirements.txt")
+    with open(req_file, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    py = os.path.join(tmp, "bin", "python")
+    proc = subprocess.run(
+        [py, "-m", "pip", "install", "--no-input", "--disable-pip-version-check",
+         "-r", req_file],
+        capture_output=True, text=True, timeout=600,
+    )
+    if proc.returncode != 0:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise RuntimeError(
+            f"pip runtime_env install failed: {proc.stderr[-2000:]}"
+        )
+    with open(os.path.join(tmp, ".ready"), "w") as f:
+        f.write(digest)
+    try:
+        os.rename(tmp, dest)
+    except OSError:
+        shutil.rmtree(tmp, ignore_errors=True)  # raced: another worker won
+    return site_packages(dest)
 
 
 def apply_in_worker(kv_get, session_dir: str, meta_key: str) -> bool:
@@ -118,6 +209,11 @@ def apply_in_worker(kv_get, session_dir: str, meta_key: str) -> bool:
             workdir = dest
         if dest not in sys.path:
             sys.path.insert(0, dest)
+    pip_reqs = meta.get("pip")
+    if pip_reqs:
+        sp = _ensure_pip_env(session_dir, pip_reqs, kv_get)
+        if sp and sp not in sys.path:
+            sys.path.insert(0, sp)
     if workdir is not None:
         try:
             os.chdir(workdir)

@@ -93,6 +93,21 @@ class _Handler(BaseHTTPRequestHandler):
                 "/api/summary/actors": state.summarize_actors,
                 "/api/summary/objects": state.summarize_objects,
             }
+            if path == "/metrics":
+                # Prometheus text exposition (ref analogue:
+                # _private/prometheus_exporter.py endpoint).
+                from .util import prometheus
+
+                body = prometheus.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if path == "/api/metrics":
                 report = metrics.get_metrics_report()
                 self._json({

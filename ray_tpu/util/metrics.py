@@ -132,7 +132,7 @@ class Histogram(_Metric):
         bounds = self._boundaries
 
         def update(cur):
-            cur = cur or {"count": 0, "sum": 0.0,
+            cur = cur or {"count": 0, "sum": 0.0, "bounds": list(bounds),
                           "buckets": [0] * (len(bounds) + 1)}
             cur["count"] += 1
             cur["sum"] += value

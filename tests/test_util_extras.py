@@ -182,3 +182,65 @@ def test_runtime_env_reaches_prestarted_workers(tmp_path):
         assert ray_tpu.get(use_late.remote()) == "late-apply"
     finally:
         ray_tpu.shutdown()
+
+
+def _make_wheel(tmp_path, name="rtpu_demo_pkg", version="0.1"):
+    """Handcraft a minimal pure-python wheel (no network, no build
+    tooling): a zip of <pkg>/__init__.py + .dist-info metadata."""
+    import base64
+    import hashlib
+    import zipfile
+
+    whl = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    dist = f"{name}-{version}.dist-info"
+    files = {
+        f"{name}/__init__.py": b"MAGIC = 'installed-via-pip-runtime-env'\n",
+        f"{dist}/METADATA": (
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n"
+        ).encode(),
+        f"{dist}/WHEEL": (
+            b"Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+            b"Tag: py3-none-any\n"
+        ),
+    }
+    record_lines = []
+    with zipfile.ZipFile(whl, "w") as zf:
+        for arc, data in files.items():
+            zf.writestr(arc, data)
+            digest = base64.urlsafe_b64encode(
+                hashlib.sha256(data).digest()).rstrip(b"=").decode()
+            record_lines.append(f"{arc},sha256={digest},{len(data)}")
+        record_lines.append(f"{dist}/RECORD,,")
+        zf.writestr(f"{dist}/RECORD", "\n".join(record_lines) + "\n")
+    return str(whl)
+
+
+def test_runtime_env_pip_local_wheel(tmp_path):
+    """A job's pip runtime env installs a package absent from the base
+    env into a per-node hash-keyed venv; workers import it (VERDICT r3
+    ask #5; ref: _private/runtime_env/pip.py). Local wheel keeps the
+    sandbox offline."""
+    wheel = _make_wheel(tmp_path)
+    ray_tpu.init(
+        num_cpus=2,
+        runtime_env={"pip": [wheel]},
+        system_config={"log_to_driver": False},
+    )
+    try:
+        @ray_tpu.remote
+        def probe():
+            import rtpu_demo_pkg
+
+            return rtpu_demo_pkg.MAGIC
+
+        assert ray_tpu.get(probe.remote(), timeout=300) == \
+            "installed-via-pip-runtime-env"
+
+        # Second task on the same node reuses the cached venv (fast).
+        import time as _t
+
+        t0 = _t.time()
+        assert ray_tpu.get(probe.remote(), timeout=60)
+        assert _t.time() - t0 < 30
+    finally:
+        ray_tpu.shutdown()
