@@ -20,6 +20,14 @@ import jax.numpy as jnp
 
 from .llama import LlamaConfig, rms_norm, rope
 
+# Paged decode attention implementation choice, read ONCE at import (it
+# is baked into the traced program — flipping the env after the first
+# compile has no effect): default is the XLA gather path, which measured
+# faster in the full decode step (PERF_r04 paged section).
+import os as _os
+
+_USE_PAGED_KERNEL = _os.environ.get("RAY_TPU_PAGED_KERNEL") == "1"
+
 
 class KVCache(NamedTuple):
     k: jax.Array  # [L, B, T, Hkv, Dh]
@@ -41,6 +49,14 @@ def _attend_cached(q, ck, cv, q_pos, lengths, cfg):
     q_pos [B,S]; cache rows >= lengths[b] (post-update) are masked."""
     B, S, H, D = q.shape
     T = ck.shape[1]
+    if S == T and S % 128 == 0 and cfg.use_flash:
+        # Fresh prefill (appending S tokens to an S-long cache implies
+        # start position 0): pure causal self-attention — route through
+        # the flash kernel (GQA handled natively; ~1.5x the XLA einsum
+        # on TPU and O(S) memory). VERDICT r3 ask #7b.
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, ck, cv, causal=True)
     rep = H // ck.shape[2]
     k = jnp.repeat(ck, rep, axis=2)
     v = jnp.repeat(cv, rep, axis=2)
@@ -162,24 +178,31 @@ class PagedKVCache(NamedTuple):
     bounded by ``total_pages * page_size`` tokens ACROSS requests instead
     of ``max_batch * max_len`` each, so one long-context request coexists
     with many short ones; pages recycle the moment a request finishes.
-    All shapes static for XLA: attention gathers each slot's pages
-    (``k[:, page_table]``) and masks by length — the gather is fused into
-    the attention einsum by XLA, never materialized to HBM twice."""
+    All shapes static for XLA. The pool is HEAD-MAJOR
+    ([L, Hkv, P_total, page, Dh]) so the Pallas page-walk kernel blocks
+    on (head, page) without a per-step transpose. Decode attention
+    gathers each slot's pages (``jnp.take(ck, page_table, axis=1)``)
+    into a window bounded by B * Pmax * page tokens — independent of
+    pool size — and masks by length (see _attend_paged for the measured
+    kernel-vs-gather tradeoff)."""
 
-    k: jax.Array            # [L, P_total, page, Hkv, Dh] shared pool
-    v: jax.Array            # [L, P_total, page, Hkv, Dh]
+    k: jax.Array            # [L, Hkv, P_total, page, Dh] shared pool
+    v: jax.Array            # [L, Hkv, P_total, page, Dh]
     page_table: jax.Array   # [B, P_max] int32 page ids per slot
     lengths: jax.Array      # [B] int32 valid tokens per slot
 
     @property
     def page_size(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
     @staticmethod
     def create(cfg: LlamaConfig, batch: int, total_pages: int,
                page_size: int, max_pages_per_seq: int) -> "PagedKVCache":
-        shape = (cfg.num_layers, total_pages, page_size,
-                 cfg.num_kv_heads, cfg.dh)
+        # Head-major pool: the Pallas page-walk kernel blocks on
+        # (head, page) directly — no per-step pool transpose (which
+        # would scale with POOL size and defeat paging).
+        shape = (cfg.num_layers, cfg.num_kv_heads, total_pages,
+                 page_size, cfg.dh)
         return PagedKVCache(
             k=jnp.zeros(shape, dtype=cfg.dtype),
             v=jnp.zeros(shape, dtype=cfg.dtype),
@@ -189,13 +212,64 @@ class PagedKVCache(NamedTuple):
         )
 
 
+def _attend_paged_xla(q, ck, cv, page_table, lengths, cfg):
+    """XLA fallback: gather each slot's pages into its logical
+    [T, Hkv, Dh] view and attend densely (the gather output is small —
+    only the slots' windows, bounded by B * Pmax * page tokens
+    regardless of pool size; the Pallas kernel avoids even that)."""
+    B = q.shape[0]
+    q_pos = lengths[:, None]
+    kp = jnp.take(ck, page_table, axis=1)  # [Hkv, B, Pmax, page, Dh]
+    vp = jnp.take(cv, page_table, axis=1)
+    Hkv, _, Pmax, page, Dh = kp.shape
+    kp = kp.transpose(1, 2, 3, 0, 4).reshape(B, Pmax * page, Hkv, Dh)
+    vp = vp.transpose(1, 2, 3, 0, 4).reshape(B, Pmax * page, Hkv, Dh)
+    return _attend_cached(q, kp, vp, q_pos, lengths + 1, cfg)
+
+
+def _attend_paged(q, ck, cv, page_table, lengths, cfg):
+    """Single-token decode over the paged pool. Default: the XLA gather
+    path — measured on chip (PERF_r04 paged section) its cost is bounded
+    by the attention WINDOW (B * Pmax * page tokens), independent of
+    pool size, and it edges out the Pallas page-walk kernel in the full
+    decode step (2.04 vs 2.35 ms at pool=256 pages). The kernel
+    (ops/paged_attention.py) stays available via
+    RAY_TPU_PAGED_KERNEL=1 for shapes where the gather's window copy
+    dominates (very long windows / tiny batch)."""
+    from ..ops import paged_attention as pa
+
+    page = ck.shape[2]
+    if (
+        _USE_PAGED_KERNEL
+        and cfg.use_flash
+        and pa.on_tpu()
+        and pa.pageable(page, q.shape[-1])
+    ):
+        out = pa.paged_decode_attention(
+            q[:, 0], ck, cv, page_table, lengths
+        )
+        return out[:, None]
+    return _attend_paged_xla(q, ck, cv, page_table, lengths, cfg)
+
+
 def _layer_paged_decode(cfg, lp, x, ck, cv, page_table, lengths,
                         page_ids, offsets, active):
     """One block, single-token decode against the paged pool. x [B,1,M];
-    ck/cv [P, page, Hkv, Dh]; page_ids/offsets [B] name each slot's write
-    cell for this token (inactive slots scatter to id -1 → dropped)."""
+    ck/cv [Hkv, P, page, Dh] (this layer's pool slice, carried by the
+    layer scan); page_ids/offsets [B] name each slot's write cell for
+    this token (inactive slots scatter to id -1 → dropped).
+
+    Measured design note (PERF_r04): three structures were benchmarked
+    on the real chip for the step's pool traffic — (a) this scan over
+    per-layer slices, (b) an unrolled layer loop scattering/gathering
+    the full [L, ...] pool with static layer indices + donation, and
+    (c) the pre-head-major layout with a per-step pool transpose. (a)
+    wins by 10x+ over (b) (XLA lowers the separated-advanced-index
+    full-pool scatters and full-pool custom-call operands poorly) and
+    strictly dominates (c). The residual pool-size dependence of (a) is
+    the scan re-stacking its ys (one pool-sized copy per k/v per step)."""
     B = x.shape[0]
-    page = ck.shape[1]
+    page = ck.shape[2]
     h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
     q = jnp.einsum("bsm,mhd->bshd", h, lp["wq"])
     k = jnp.einsum("bsm,mhd->bshd", h, lp["wk"])
@@ -210,18 +284,13 @@ def _layer_paged_decode(cfg, lp, x, ck, cv, page_table, lengths,
     # Scatter this token's KV into each active slot's current page cell.
     # Inactive slots aim past the pool: -1 would WRAP to the last page
     # (NumPy semantics) and corrupt it; only >= n is truly dropped.
-    n_pages = ck.shape[0]
+    n_pages = ck.shape[1]
     drop = jnp.where(active, page_ids, n_pages)
-    ck = ck.at[drop, offsets].set(
-        k[:, 0].astype(ck.dtype), mode="drop")
-    cv = cv.at[drop, offsets].set(
-        v[:, 0].astype(cv.dtype), mode="drop")
-    # Gather each slot's pages into its logical [T, Hkv, Dh] view.
-    kp = ck[page_table]  # [B, Pmax, page, Hkv, Dh]
-    vp = cv[page_table]
-    kp = kp.reshape(B, -1, kp.shape[-2], kp.shape[-1])
-    vp = vp.reshape(B, -1, vp.shape[-2], vp.shape[-1])
-    attn = _attend_cached(q, kp, vp, q_pos, lengths + 1, cfg)
+    ck = ck.at[:, drop, offsets].set(
+        k[:, 0].astype(ck.dtype).transpose(1, 0, 2), mode="drop")
+    cv = cv.at[:, drop, offsets].set(
+        v[:, 0].astype(cv.dtype).transpose(1, 0, 2), mode="drop")
+    attn = _attend_paged(q, ck, cv, page_table, lengths, cfg)
     x = x + jnp.einsum("bshd,hdm->bsm", attn.astype(x.dtype), lp["wo"])
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
     if cfg.n_experts > 0:
@@ -297,13 +366,15 @@ def paged_prefill(
         last_index=real_len[None] - 1, append_len=real_len[None],
     )
     n = S // page
-    # [L, 1, S, Hkv, Dh] -> [L, n, page, Hkv, Dh] -> scatter at page ids.
-    k_pages = small.k[:, 0].reshape(cfg.num_layers, n, page,
-                                    cfg.num_kv_heads, cfg.dh)
-    v_pages = small.v[:, 0].reshape(cfg.num_layers, n, page,
-                                    cfg.num_kv_heads, cfg.dh)
-    k = cache.k.at[:, pages].set(k_pages.astype(cache.k.dtype))
-    v = cache.v.at[:, pages].set(v_pages.astype(cache.v.dtype))
+    # [L, 1, S, Hkv, Dh] -> [L, Hkv, n, page, Dh] -> scatter at page ids.
+    k_pages = small.k[:, 0].reshape(
+        cfg.num_layers, n, page, cfg.num_kv_heads, cfg.dh
+    ).transpose(0, 3, 1, 2, 4)
+    v_pages = small.v[:, 0].reshape(
+        cfg.num_layers, n, page, cfg.num_kv_heads, cfg.dh
+    ).transpose(0, 3, 1, 2, 4)
+    k = cache.k.at[:, :, pages].set(k_pages.astype(cache.k.dtype))
+    v = cache.v.at[:, :, pages].set(v_pages.astype(cache.v.dtype))
     lengths = cache.lengths.at[slot].set(real_len)
     return logits, PagedKVCache(k, v, cache.page_table, lengths)
 

@@ -1,0 +1,154 @@
+"""Paged-attention decode as a Pallas TPU kernel.
+
+Gather-free decode over a paged KV pool (models/generation.py
+PagedKVCache): instead of materializing each slot's pages with
+``pool[page_table]`` ([B, Pmax, page, Hkv, Dh] in HBM) and attending
+densely, one kernel program per (slot, kv-head) WALKS the slot's page
+table — the grid's page dimension uses scalar-prefetched page ids as the
+pool block index, so each page streams HBM→VMEM exactly once and the
+gathered view never exists. Online softmax accumulates across pages in
+VMEM scratch (flash-attention schedule over the page walk). This is the
+TPU-static analogue of vLLM's PagedAttention kernel; no reference
+counterpart exists (Ray delegates model compute to user code).
+
+Falls back to the XLA gather path off-TPU or for shapes the kernel does
+not tile (models/generation.py keeps that path as `_attend_paged_xla`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _paged_decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, page: int,
+                         scale: float):
+    """Grid (B, Hkv, Pmax); p innermost. q_ref [1, 1, rep, D] (the GQA
+    group's query rows), k_ref/v_ref [1, page, D] = the page the scalar-
+    prefetched table named for (b, p); o_ref [1, 1, rep, D] constant over
+    p. Scratch carries the online-softmax state across the page walk."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]  # keys at positions 0..length are valid
+
+    @pl.when(p * page <= length)
+    def _attend_page():
+        q = q_ref[...].reshape(q_ref.shape[-2:]).astype(
+            jnp.float32) * scale                       # [rep, D]
+        k = k_ref[...].reshape(k_ref.shape[-2:]).astype(jnp.float32)
+        v = v_ref[...].reshape(v_ref.shape[-2:]).astype(jnp.float32)
+        s = q @ k.T                                   # [rep, page]
+        t = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(t <= length, s, _NEG_INF)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        prob = jnp.exp(s - m_new)
+        l_scr[...] = alpha * l_scr[...] + prob.sum(axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + prob @ v
+        m_scr[...] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        l_safe = jnp.where(l_scr[...] == 0.0, 1.0, l_scr[...])
+        o_ref[0, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,           # [B, H, D] one query row per slot
+    k_pool: jax.Array,      # [Hkv, P, page, D] or [L, Hkv, P, page, D]
+    v_pool: jax.Array,      # (with ``layer`` naming the static L index)
+    page_table: jax.Array,  # [B, Pmax] int32
+    lengths: jax.Array,     # [B] int32 — key positions <= lengths[b] attend
+    *,
+    layer: int | None = None,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, H, D] attention outputs. The caller has already
+    scattered the current token's K/V into each slot's page cell (so
+    ``lengths`` is the PRE-increment length and position ``lengths[b]``
+    holds the new token)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    if layer is None:
+        Hkv, P_total, page, _ = k_pool.shape
+
+        def kv_index(b, h, p, pt_ref, len_ref):
+            return (h, pt_ref[b, p], 0, 0)
+
+        kv_block = (1, 1, page, D)
+    else:
+        # Full [L, Hkv, P, page, D] pool with a STATIC layer baked into
+        # the index map: no layer slice is ever materialized for the
+        # custom call (a sliced operand would copy pool/L bytes).
+        _L, Hkv, P_total, page, _ = k_pool.shape
+
+        def kv_index(b, h, p, pt_ref, len_ref):
+            return (layer, h, pt_ref[b, p], 0, 0)
+
+        kv_block = (1, 1, 1, page, D)
+    Pmax = page_table.shape[1]
+    rep = H // Hkv
+    scale = scale if scale is not None else D ** -0.5
+
+    q4 = q.reshape(B, Hkv, rep, D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, Pmax),
+        in_specs=[
+            pl.BlockSpec((1, 1, rep, D),
+                         lambda b, h, p, pt, ln: (b, h, 0, 0)),
+            pl.BlockSpec(kv_block, kv_index),
+            pl.BlockSpec(kv_block, kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rep, D),
+                               lambda b, h, p, pt, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, 1), jnp.float32),   # running max
+            pltpu.VMEM((rep, 1), jnp.float32),   # running denominator
+            pltpu.VMEM((rep, D), jnp.float32),   # output accumulator
+        ],
+    )
+    kernel = functools.partial(_paged_decode_kernel, page=page,
+                               scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep, D), q.dtype),
+        interpret=interpret,
+    )(page_table, lengths, q4, k_pool, v_pool)
+    return out.reshape(B, H, D)
+
+
+def pageable(page: int, head_dim: int) -> bool:
+    """Whether the kernel tiles these shapes (TPU tile rules: head_dim
+    a multiple of 128 for the lane dim, page a multiple of 8 for the
+    sublane dim)."""
+    return head_dim % 128 == 0 and page % 8 == 0
+
+
+def on_tpu() -> bool:
+    from .flash_attention import _on_tpu
+
+    return _on_tpu()

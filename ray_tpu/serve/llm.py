@@ -122,7 +122,10 @@ class LLMEngine:
             nxt = sample_logits(logits, key, temperature=temperature)
             return nxt, cache
 
-        self._decode = jax.jit(decode_step)
+        # Donate the cache: the paged pool updates IN PLACE instead of
+        # being copied every step (a pool-sized copy per step would make
+        # paging cost scale with pool size).
+        self._decode = jax.jit(decode_step, donate_argnums=(1,))
 
         def prefill(params, cache, tokens, real_len, slot, pages):
             logits, cache = paged_prefill(
@@ -132,7 +135,7 @@ class LLMEngine:
                                 temperature=temperature)
             return cache, nxt[0]
 
-        self._prefill = jax.jit(prefill)
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
         self._rng = jax.random.PRNGKey(0)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -188,6 +191,31 @@ class LLMEngine:
             (len(req.prompt) + req.max_new_tokens) / self.page_size
         )
         return max(bucket // self.page_size, decode_span)
+
+    def _reset_cache(self, cause: Exception):
+        """Recover from a failed donated call: the old pool's buffers
+        are gone, so rebuild a fresh cache and fail in-flight requests
+        with the root cause (they cannot be resumed without their KV)."""
+        from ..models.generation import PagedKVCache
+
+        with self._lock:
+            victims = list(self._slot_req.items())
+            self._slot_req.clear()
+            self._slot_free = list(range(self.max_batch))
+            self._free_pages = list(range(self.total_pages))
+            self._slot_pages.clear()
+            self._table[:] = 0
+        for _slot, req in victims:
+            if not req.done.is_set():
+                req.error = RuntimeError(
+                    f"engine cache reset after runtime failure: {cause!r}"
+                )
+                req.done.set()
+                req._live.put(None)
+        self.cache = PagedKVCache.create(
+            self.cfg, self.max_batch, self.total_pages, self.page_size,
+            self.max_pages_per_seq,
+        )
 
     def _release_slot(self, slot: int):
         pages = self._slot_pages.pop(slot, [])
@@ -247,6 +275,11 @@ class LLMEngine:
                 req.done.set()
                 req._live.put(None)
                 self._release_slot(slot)
+                # The cache was DONATED into the failed call — its
+                # buffers may already be invalid. Rebuild the pool and
+                # fail every in-flight request rather than serving from
+                # dead buffers (engine reset; callers see clean errors).
+                self._reset_cache(e)
                 continue
             req.ttft_s = time.perf_counter() - req._t0
             req.output.append(first)

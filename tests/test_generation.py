@@ -114,3 +114,47 @@ def test_bucketed_prefill_matches_exact():
     l2, _ = forward_with_cache(params, nxt, padded_cache, cfg)
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_paged_attention_kernel_matches_gather():
+    """The Pallas page-walk decode kernel (ops/paged_attention.py)
+    matches the XLA gather path bit-for-near: random page tables,
+    lengths spanning page boundaries, GQA groups (VERDICT r3 ask #7)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.generation import _attend_paged_xla
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.ops.paged_attention import paged_decode_attention
+
+    B, H, Hkv, D = 3, 4, 2, 128
+    L, P_total, page, Pmax = 2, 8, 16, 4
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, 1, H, D), jnp.float32)
+    ck = jnp.asarray(rng.randn(L, Hkv, P_total, page, D), jnp.float32)
+    cv = jnp.asarray(rng.randn(L, Hkv, P_total, page, D), jnp.float32)
+    # distinct pages per slot, deliberately out of order
+    page_table = jnp.asarray(
+        [[3, 1, 6, 0], [2, 5, 7, 4], [0, 6, 1, 3]], jnp.int32)
+    lengths = jnp.asarray([0, 17, 63], jnp.int32)  # cell 0 / mid / last
+
+    cfg = LlamaConfig.tiny()
+    for layer in range(L):
+        ref = _attend_paged_xla(q, ck[layer], cv[layer], page_table,
+                                lengths, cfg)
+        out = paged_decode_attention(
+            q[:, 0], ck[layer], cv[layer], page_table, lengths,
+            interpret=True,
+        )[:, None]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        # and the full-pool form with a static layer baked into the
+        # kernel's index map
+        out2 = paged_decode_attention(
+            q[:, 0], ck, cv, page_table, lengths, layer=layer,
+            interpret=True,
+        )[:, None]
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
