@@ -19,6 +19,7 @@ from . import job_submission  # noqa: F401
 from . import util  # noqa: F401
 from . import workflow  # noqa: F401
 from .core import (  # noqa: F401
+    method,
     ActorClass,
     ActorDiedError,
     ActorHandle,

@@ -17,7 +17,7 @@ from .api import (  # noqa: F401
     shutdown,
     wait,
 )
-from .actor import ActorClass, ActorHandle, ActorMethod  # noqa: F401
+from .actor import ActorClass, ActorHandle, ActorMethod, method  # noqa: F401
 from .exceptions import (  # noqa: F401
     ActorDiedError,
     GetTimeoutError,

@@ -20,14 +20,16 @@ from .task_spec import TaskSpec, TaskType
 
 class ActorMethod:
     def __init__(self, actor_handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, concurrency_group: str = ""):
         self._handle = actor_handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def options(self, **opts) -> "ActorMethod":
         return ActorMethod(
-            self._handle, self._method_name, opts.get("num_returns", 1)
+            self._handle, self._method_name, opts.get("num_returns", 1),
+            opts.get("concurrency_group", self._concurrency_group),
         )
 
     def remote(self, *args, **kwargs):
@@ -49,6 +51,10 @@ class ActorMethod:
             name=f"{self._handle._class_name}.{self._method_name}",
             actor_id=self._handle._actor_id,
             method_name=self._method_name,
+            concurrency_group=(
+                self._concurrency_group
+                or self._handle._method_groups.get(self._method_name, "")
+            ),
         )
         refs = rt.submit(spec)
         del keepalive
@@ -64,10 +70,15 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, class_name: str = "",
-                 class_function_id: str = ""):
+                 class_function_id: str = "",
+                 method_groups: Optional[Dict[str, str]] = None):
         self._actor_id = actor_id
         self._class_name = class_name
         self._class_function_id = class_function_id
+        # method name -> concurrency group (from @ray_tpu.method
+        # annotations on the class; ref: concurrency groups declared per
+        # method, core_worker/transport/concurrency_group_manager.h).
+        self._method_groups = dict(method_groups or {})
 
     def __getattr__(self, name: str) -> ActorMethod:
         # "__rtpu_ping__" is the built-in liveness probe every actor answers
@@ -87,7 +98,8 @@ class ActorHandle:
     def __reduce__(self):
         return (
             ActorHandle,
-            (self._actor_id, self._class_name, self._class_function_id),
+            (self._actor_id, self._class_name, self._class_function_id,
+             self._method_groups),
         )
 
 
@@ -118,6 +130,14 @@ class ActorClass:
         # the default is 0 CPUs for a running actor (actor.py: actors don't
         # occupy CPUs after creation unless num_cpus is set explicitly).
         resources = _build_resources(self._options, default_num_cpus=0)
+        groups = self._options.get("concurrency_groups")
+        # Walk the MRO so annotations on inherited methods count too.
+        method_groups = {}
+        for klass in reversed(self._cls.__mro__):
+            for mname, m in vars(klass).items():
+                g = getattr(m, "_rtpu_concurrency_group", "")
+                if g:
+                    method_groups[mname] = g
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             task_type=TaskType.ACTOR_CREATION_TASK,
@@ -132,6 +152,11 @@ class ActorClass:
             runtime_env_key=rt.runtime_env_key,
             max_restarts=max_restarts,
             max_concurrency=self._options.get("max_concurrency", 1),
+            concurrency_groups=dict(groups) if groups else None,
+            method_groups=method_groups or None,
+            allow_out_of_order=bool(
+                self._options.get("allow_out_of_order", False)
+            ),
             scheduling_strategy=self._options.get("scheduling_strategy"),
         )
         rt.submit(spec)
@@ -140,6 +165,7 @@ class ActorClass:
             actor_id,
             class_name=self._cls.__name__,
             class_function_id=function_id,
+            method_groups=method_groups,
         )
 
     def __call__(self, *args, **kwargs):
@@ -149,6 +175,21 @@ class ActorClass:
         )
 
 
+def method(*, concurrency_group: str = ""):
+    """Method annotation (ref analogue: ray.method): declares the
+    concurrency group an actor method executes in. Groups are sized at
+    class level via @ray_tpu.remote(concurrency_groups={...}). (Use
+    ``.options(num_returns=...)`` at the call site for multi-return
+    actor methods.)"""
+
+    def wrap(fn):
+        if concurrency_group:
+            fn._rtpu_concurrency_group = concurrency_group
+        return fn
+
+    return wrap
+
+
 def get_actor(name: str) -> ActorHandle:
     """Look up a named actor (ref analogue: ray.get_actor)."""
     rt = current_runtime()
@@ -156,5 +197,7 @@ def get_actor(name: str) -> ActorHandle:
     if spec is None:
         raise ValueError(f"Failed to look up actor with name '{name}'")
     return ActorHandle(
-        spec.actor_id, class_name=spec.name, class_function_id=spec.function_id
+        spec.actor_id, class_name=spec.name,
+        class_function_id=spec.function_id,
+        method_groups=getattr(spec, "method_groups", None),
     )

@@ -24,6 +24,12 @@ from .serialization import serialize
 from .task_spec import RefArg, TaskSpec, TaskType, ValueArg
 
 
+# Read once at import: whether top-level submits record root spans.
+import os as _os
+
+_TRACE_SUBMITS = _os.environ.get("RAY_TPU_TRACE_SUBMITS") == "1"
+
+
 def _log_post_error(fut):
     try:
         fut.result()
@@ -308,7 +314,36 @@ class BaseRuntime:
             self._function_ids.pop(id(fn), None)
         return function_id
 
+    def _stamp_trace(self, spec: TaskSpec):
+        if spec.trace_ctx is not None:
+            return
+        from .timeline import current_span
+
+        ctx = current_span()
+        if ctx is not None:
+            spec.trace_ctx = ctx
+            return
+        # Top-level submit: this task roots a new trace. With submit
+        # spans enabled (RAY_TPU_TRACE_SUBMITS=1, read at import), the
+        # driver's submit call itself becomes the root span so the
+        # exported tree reads driver-submit -> worker-exec -> nested.
+        trace_id = spec.task_id.hex()[:16]
+        if _TRACE_SUBMITS:
+            from .timeline import get_buffer, new_span_id
+
+            sid = new_span_id()
+            now = time.time()
+            get_buffer().record(
+                f"submit:{spec.name or spec.method_name or 'task'}",
+                now, now, spec.task_id.hex(),
+                trace_id=trace_id, span_id=sid, parent_id="",
+            )
+            spec.trace_ctx = (trace_id, sid)
+        else:
+            spec.trace_ctx = (trace_id, "")
+
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._stamp_trace(spec)
         self._submit_spec(spec)
         return [ObjectRef(oid, _register=True) for oid in spec.return_ids()]
 
@@ -618,6 +653,7 @@ class DriverRuntime(BaseRuntime):
                 return
 
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._stamp_trace(spec)
         if spec.task_type == TaskType.ACTOR_TASK and spec.actor_id is not None:
             # Calls carrying retries keep the NM route: its actor-restart
             # replay resubmits them in order; a direct channel can only

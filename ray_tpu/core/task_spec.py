@@ -65,8 +65,24 @@ class TaskSpec:
     class_name: str = ""  # actor class, for the state API / debugging
     max_restarts: int = 0
     max_concurrency: int = 1
+    # Concurrency groups (ref: concurrency_group_manager.h): creation
+    # tasks carry {group_name: max_concurrency}; method calls carry the
+    # group routing them to that group's executor in the actor worker.
+    concurrency_groups: Optional[Dict[str, int]] = None
+    concurrency_group: str = ""
+    # method name -> group (creation tasks; lets handles recovered via
+    # get_actor route annotated methods correctly).
+    method_groups: Optional[Dict[str, str]] = None
+    # Out-of-order actor execution (ref:
+    # out_of_order_actor_submit_queue.h): independent method calls may
+    # execute as they arrive instead of strictly in submission order.
+    allow_out_of_order: bool = False
     # Owner bookkeeping (worker that submitted the task; nil = driver)
     owner_id: Optional[WorkerID] = None
+    # Tracing context (trace_id, parent_span_id) — stamped at submit,
+    # consumed by the executing worker to parent its span (ref:
+    # tracing_helper.py:165 context injection into the task spec).
+    trace_ctx: Optional[Tuple[str, str]] = None
     # Placement: "DEFAULT" | "SPREAD" | NodeAffinitySchedulingStrategy |
     # NodeLabelSchedulingStrategy (ref analogue: TaskSpec scheduling_strategy
     # in common.proto + util/scheduling_strategies.py)

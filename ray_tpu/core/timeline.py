@@ -22,6 +22,40 @@ KV_PREFIX = "__timeline__/"
 MAX_EVENTS_PER_WORKER = 10_000
 FLUSH_INTERVAL_S = 0.5
 
+# ---------------------------------------------------------------- tracing
+# Span context propagated through TaskSpec.trace_ctx (ref analogue:
+# util/tracing/tracing_helper.py:326 — the reference injects OTel
+# context into the task spec so worker-side spans parent to the
+# caller's). Here: (trace_id, span_id) pairs; submit stamps the current
+# context onto the spec, execution opens a child span and installs
+# itself as the context for nested submits — the exported timeline
+# carries the full driver→worker→nested-task tree in each event's args.
+
+_ctx = threading.local()
+
+
+def new_span_id() -> str:
+    import uuid
+
+    return uuid.uuid4().hex[:16]
+
+
+def current_span():
+    """(trace_id, span_id) of the active span in this thread, or None."""
+    return getattr(_ctx, "span", None)
+
+
+def enter_span(trace_id: str, span_id: str):
+    """Install a span as this thread's context; returns the previous
+    context (pass back to exit_span)."""
+    prev = getattr(_ctx, "span", None)
+    _ctx.span = (trace_id, span_id)
+    return prev
+
+
+def exit_span(prev) -> None:
+    _ctx.span = prev
+
 
 class TaskEventBuffer:
     """Per-process span recorder (ref: TaskEventBuffer)."""
@@ -36,13 +70,17 @@ class TaskEventBuffer:
         self._timer: Optional[threading.Timer] = None
 
     def record(self, name: str, start: float, end: float,
-               task_id: str = "") -> None:
+               task_id: str = "", trace_id: str = "",
+               span_id: str = "", parent_id: str = "") -> None:
         with self._lock:
             self._events.append({
                 "name": name,
                 "ts": start,
                 "dur": end - start,
                 "task_id": task_id,
+                "trace_id": trace_id,
+                "span_id": span_id,
+                "parent_id": parent_id,
             })
         now = time.monotonic()
         if now - self._last_flush > FLUSH_INTERVAL_S:
@@ -120,7 +158,12 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                 "dur": ev["dur"] * 1e6,
                 "pid": f"node:{node8}",
                 "tid": f"worker:{pid}",
-                "args": {"task_id": ev.get("task_id", "")},
+                "args": {
+                    "task_id": ev.get("task_id", ""),
+                    "trace_id": ev.get("trace_id", ""),
+                    "span_id": ev.get("span_id", ""),
+                    "parent_id": ev.get("parent_id", ""),
+                },
             })
     if filename:
         with open(filename, "w") as f:

@@ -76,6 +76,11 @@ class Worker:
         # concurrency_group_manager.h): creation tasks with
         # max_concurrency > 1 switch execution to a thread pool.
         self._pool = None
+        # Concurrency groups: {name: ThreadPoolExecutor} — annotated
+        # methods run in their group's pool, concurrently with other
+        # groups AND with the default path (ref:
+        # concurrency_group_manager.h per-group executors).
+        self._group_pools: dict = {}
 
     def start(self):
         self.conn.send({"type": "register", "worker_id": self.worker_id.hex()})
@@ -136,11 +141,15 @@ class Worker:
                 msg = self.conn.recv()
                 mtype = msg["type"]
                 if mtype == "execute":
-                    self._tq_put(msg)
+                    if not self._route_group(msg):
+                        self._tq_put(msg)
                 elif mtype == "execute_batch":
-                    with self._tq_cv:
-                        self._tq.extend(msg["items"])
-                        self._tq_cv.notify()
+                    rest = [m for m in msg["items"]
+                            if not self._route_group(m)]
+                    if rest:
+                        with self._tq_cv:
+                            self._tq.extend(rest)
+                            self._tq_cv.notify()
                 elif mtype == "reply":
                     self.runtime.handle_reply(msg)
                 elif mtype == "reclaim":
@@ -170,6 +179,24 @@ class Worker:
             self._alive = False
             self._tq_put(None)
 
+    def _route_group(self, m) -> bool:
+        """Reader-thread routing for concurrency-group methods: they
+        must reach their group's pool WITHOUT queueing behind whatever
+        the main thread is executing (that's the whole point of groups).
+        Returns True when the frame was dispatched to a group pool."""
+        spec = m.get("spec") if isinstance(m, dict) else None
+        if (
+            spec is None
+            or spec.task_type != TaskType.ACTOR_TASK
+            or not self._group_pools
+        ):
+            return False
+        gp = self._group_pools.get(getattr(spec, "concurrency_group", ""))
+        if gp is None:
+            return False
+        gp.submit(self._run_task_direct, spec, m.get("function_blob"))
+        return True
+
     def _main_loop(self):
         while self._alive:
             msg = self._tq_get()
@@ -193,19 +220,35 @@ class Worker:
                                 concurrency = 100
                     except Exception:
                         pass
-                if concurrency > 1:
+                if concurrency > 1 or getattr(
+                        spec, "allow_out_of_order", False):
                     from concurrent.futures import ThreadPoolExecutor
 
+                    # Out-of-order actors keep their max_concurrency
+                    # thread count (1 stays serial — only ORDER
+                    # commitment is relaxed, matching the reference's
+                    # out_of_order_actor_submit_queue semantics; true
+                    # parallelism still requires max_concurrency > 1).
                     self._pool = ThreadPoolExecutor(
-                        max_workers=concurrency,
+                        max_workers=max(1, concurrency),
                         thread_name_prefix="actor-concurrency",
                     )
-            if self._pool is not None and \
-                    spec.task_type == TaskType.ACTOR_TASK:
-                self._pool.submit(
-                    self._run_task_direct, spec, msg.get("function_blob")
+            if spec.task_type == TaskType.ACTOR_TASK:
+                gp = self._group_pools.get(
+                    getattr(spec, "concurrency_group", "")
                 )
-                continue
+                if gp is not None:
+                    gp.submit(
+                        self._run_task_direct, spec,
+                        msg.get("function_blob"),
+                    )
+                    continue
+                if self._pool is not None:
+                    self._pool.submit(
+                        self._run_task_direct, spec,
+                        msg.get("function_blob"),
+                    )
+                    continue
             with self._serial_lock:
                 done = self._run_task(spec, msg.get("function_blob"))
             if (
@@ -213,6 +256,19 @@ class Worker:
                 and not done.get("failed")
                 and self._direct_srv is None
             ):
+                # Group pools install only AFTER __init__ succeeded: a
+                # group frame routed earlier would execute against an
+                # actor instance that does not exist yet.
+                if getattr(spec, "concurrency_groups", None):
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._group_pools = {
+                        name: ThreadPoolExecutor(
+                            max_workers=max(1, int(n)),
+                            thread_name_prefix=f"cg-{name}",
+                        )
+                        for name, n in spec.concurrency_groups.items()
+                    }
                 self._start_direct_listener(spec.actor_id)
             with self._done_lock:
                 self._done_buf.append(done)
@@ -286,6 +342,7 @@ class Worker:
         frame batch is being chewed through. A fence frame acks once
         every earlier frame from this connection has executed — callers
         use it to order a control-plane-routed call after direct ones."""
+        group_futs: list = []
         try:
             while self._alive:
                 msg = conn.recv()
@@ -294,6 +351,19 @@ class Worker:
                     items = (
                         msg["items"] if mtype == "execute_batch" else [msg]
                     )
+                    routed = []
+                    for m in items:
+                        gp = self._group_pools.get(
+                            getattr(m["spec"], "concurrency_group", "")
+                        )
+                        if gp is not None:
+                            group_futs.append(gp.submit(
+                                self._run_direct, conn, m["spec"],
+                                m.get("function_blob"),
+                            ))
+                        else:
+                            routed.append(m)
+                    items = routed
                     if self._pool is not None:
                         for m in items:
                             self._pool.submit(
@@ -316,6 +386,15 @@ class Worker:
                             self._flush_direct_replies(conn)
                     self._flush_direct_replies(conn)
                 elif mtype == "fence":
+                    # The ack promises every earlier frame on this
+                    # connection has EXECUTED — including frames handed
+                    # to group pools, which run asynchronously.
+                    for f in group_futs:
+                        try:
+                            f.result(timeout=60)
+                        except Exception:
+                            pass
+                    group_futs.clear()
                     conn.send({"type": "fence_ack",
                                "msg_id": msg.get("msg_id")})
         except (ConnectionClosed, OSError):
@@ -451,6 +530,13 @@ class Worker:
             rt.current_actor_id = spec.actor_id
         import time as _time
 
+        from .timeline import enter_span, exit_span, new_span_id
+
+        ctx = getattr(spec, "trace_ctx", None)
+        trace_id = ctx[0] if ctx else spec.task_id.hex()[:16]
+        parent_id = ctx[1] if ctx else ""
+        span_id = new_span_id()
+        prev_span = enter_span(trace_id, span_id)
         _t0 = _time.time()
         try:
             results, failed = execute_task(
@@ -459,12 +545,15 @@ class Worker:
             )
         finally:
             rt.current_task_id = None
+            exit_span(prev_span)
             try:
                 from .timeline import get_buffer
 
                 get_buffer().record(
                     spec.name or spec.method_name or "task",
                     _t0, _time.time(), spec.task_id.hex(),
+                    trace_id=trace_id, span_id=span_id,
+                    parent_id=parent_id,
                 )
             except Exception:
                 pass

@@ -18,4 +18,6 @@ from .read_api import (  # noqa: F401
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
+    read_tfrecords,
 )

@@ -328,7 +328,10 @@ class Dataset:
         """Streaming execution through the stage pipeline (per-stage
         bounded windows = per-operator backpressure; see
         streaming_executor.py)."""
-        yield from execute(self._sources, self._stages)
+        from .streaming_executor import ExecStats
+
+        self._last_stats = ExecStats()
+        yield from execute(self._sources, self._stages, self._last_stats)
 
     def iter_batches(
         self,
@@ -396,8 +399,14 @@ class Dataset:
 
     def materialize(self) -> "Dataset":
         if self._use_remote():
-            refs = list(execute_refs(self._sources, self._stages))
-            return Dataset._from_refs(refs, _pin=self._pin)
+            from .streaming_executor import ExecStats
+
+            self._last_stats = ExecStats()
+            refs = list(execute_refs(self._sources, self._stages,
+                                     self._last_stats))
+            out = Dataset._from_refs(refs, _pin=self._pin)
+            out._last_stats = self._last_stats
+            return out
         return Dataset.from_blocks(list(self._iter_blocks()))
 
     def take(self, n: int = 20) -> List[Dict[str, Any]]:
@@ -432,6 +441,13 @@ class Dataset:
         return self._materialize_table().to_pandas()
 
     def stats(self) -> str:
+        """Per-stage / per-operator execution stats of the LAST executed
+        pipeline on this dataset (wall per op, rows, bytes, blocks — ref
+        analogue: data/_internal/stats.py ds.stats()); falls back to the
+        static plan description before any execution."""
+        last = getattr(self, "_last_stats", None)
+        if last is not None and last.stage_names:
+            return last.summary()
         nops = sum(
             len(s.ops) if isinstance(s, TaskStage) else 1
             for s in self._stages
@@ -457,6 +473,13 @@ class Dataset:
         from .datasink import write_blocks
 
         return write_blocks(self, path, "json", **kw)
+
+    def write_tfrecords(self, path: str, **kw) -> List[str]:
+        """One TFRecord file of tf.train.Example protos per block (ref:
+        dataset write_tfrecords; codec in data/tfrecords.py)."""
+        from .datasink import write_blocks
+
+        return write_blocks(self, path, "tfrecords", **kw)
 
     def write_numpy(self, path: str, *, column: str = "data") -> List[str]:
         from .datasink import write_blocks

@@ -46,6 +46,14 @@ def _write_block(block, path: str, fmt: str, index: int,
             np.save(fname, next(iter(cols.values())))
         else:
             np.savez(fname, **cols)
+    elif fmt == "tfrecords":
+        from .block import BlockAccessor
+        from .tfrecords import write_example_file
+
+        fname = fname[:-len(".tfrecords")] + ".tfrecord"
+        write_example_file(
+            fname, [dict(r) for r in BlockAccessor(block).iter_rows()]
+        )
     else:
         raise ValueError(f"unknown sink format {fmt!r}")
     return fname
@@ -69,17 +77,19 @@ def write_blocks(dataset, path: str, fmt: str, **write_kwargs) -> List[str]:
     the written file paths."""
     from ..core import runtime_context
     from .context import DataContext
-    from .streaming_executor import execute_refs, _is_ref
+    from .streaming_executor import ExecStats, execute_refs, _is_ref
 
     ctx = DataContext.get_current()
     use_remote = ctx.use_remote_tasks and runtime_context.is_initialized()
     path = os.path.abspath(path)
+    stats = ExecStats()
+    dataset._last_stats = stats
 
     if not use_remote:
         return [
             _write_block(b, path, fmt, i, write_kwargs)
             for i, b in enumerate(
-                execute_refs(dataset._sources, dataset._stages)
+                execute_refs(dataset._sources, dataset._stages, stats)
             )
         ]
 
@@ -88,6 +98,6 @@ def write_blocks(dataset, path: str, fmt: str, **write_kwargs) -> List[str]:
     writer = ray_tpu.remote(_write_block)
     out_refs = []
     for i, item in enumerate(execute_refs(dataset._sources,
-                                          dataset._stages)):
+                                          dataset._stages, stats)):
         out_refs.append(writer.remote(item, path, fmt, i, write_kwargs))
     return ray_tpu.get(out_refs)

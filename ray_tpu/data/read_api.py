@@ -134,6 +134,71 @@ def read_json(paths, **kw) -> Dataset:
     return Dataset([make(p) for p in files])
 
 
+def read_tfrecords(paths, **kw) -> Dataset:
+    """TFRecord files of tf.train.Example protos, one block per file
+    (ref analogue: ray.data.read_tfrecords; parsing is the dependency-
+    free codec in data/tfrecords.py)."""
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            import pyarrow as pa
+
+            from .tfrecords import read_example_file
+
+            rows = read_example_file(path)
+            cols = {}
+            for row in rows:
+                for k in row:
+                    cols.setdefault(k, [])
+            for row in rows:
+                for k in cols:
+                    cols[k].append(row.get(k))
+            return pa.table(cols)
+
+        return read
+
+    return Dataset([make(p) for p in files])
+
+
+def read_sql(sql: str, connection_factory, *,
+             override_num_blocks: int = 1, **kw) -> Dataset:
+    """Run a SQL query through a DBAPI connection factory (ref analogue:
+    ray.data.read_sql — e.g. ``lambda: sqlite3.connect(path)``). With
+    ``override_num_blocks`` > 1 each shard runs the SAME query and keeps
+    every n-th row (portable across DBAPI drivers — no dialect-specific
+    OFFSET syntax; rows must be stably ordered for deterministic
+    sharding, and each shard transfers the full result set — same
+    parallelize-the-transform-not-the-scan tradeoff as the reference's
+    read_sql)."""
+
+    def make(shard, nshards):
+        def read():
+            import pyarrow as pa
+
+            conn = connection_factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                names = [d[0] for d in cur.description]
+                rows = cur.fetchall()
+                if nshards > 1:
+                    rows = rows[shard::nshards]
+                cols = {n: [r[i] for r in rows]
+                        for i, n in enumerate(names)}
+                return pa.table(cols)
+            finally:
+                conn.close()
+
+        return read
+
+    n = max(1, int(override_num_blocks))
+    # builtins.range: this module's ``range`` is the Dataset factory.
+    import builtins
+
+    return Dataset([make(i, n) for i in builtins.range(n)])
+
+
 def read_numpy(paths, **kw) -> Dataset:
     files = _expand_paths(paths)
 

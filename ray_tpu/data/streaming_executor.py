@@ -19,7 +19,7 @@ between stages as ObjectRefs (never gathered on the driver).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterator, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 from .context import DataContext
 
@@ -48,6 +48,70 @@ class ActorStage:
         self.ray_remote_args = ray_remote_args or {}
 
 
+# ---- execution stats (per-operator; ref: data/_internal/stats.py) --------
+
+class ExecStats:
+    """Per-stage / per-operator accounting for ONE execution: each fused
+    task measures its ops' wall time and its output block's rows/bytes
+    in the worker, returning them as a second (tiny) task output; the
+    driver aggregates lazily when Dataset.stats() is called."""
+
+    def __init__(self):
+        self.stage_names: List[str] = []
+        self.stats_refs: List[List[Any]] = []   # per stage: refs/dicts
+        self.blocks: List[int] = []
+        self.wall_s: float = 0.0
+
+    def add_stage(self, name: str) -> int:
+        self.stage_names.append(name)
+        self.stats_refs.append([])
+        self.blocks.append(0)
+        return len(self.stage_names) - 1
+
+    def summary(self) -> str:
+        import ray_tpu
+        from ..core import runtime_context
+
+        lines = []
+        for i, name in enumerate(self.stage_names):
+            raw = self.stats_refs[i]
+            resolved = []
+            for item in raw:
+                if isinstance(item, dict):
+                    resolved.append(item)
+                elif runtime_context.is_initialized():
+                    try:
+                        resolved.append(ray_tpu.get(item, timeout=60))
+                    except Exception:
+                        pass
+            rows = sum(st.get("rows", 0) for st in resolved)
+            nbytes = sum(st.get("bytes", 0) for st in resolved)
+            per_op: Dict[str, float] = {}
+            for st in resolved:
+                for op_name, dur in st.get("ops", []):
+                    per_op[op_name] = per_op.get(op_name, 0.0) + dur
+            lines.append(
+                f"Stage {i} {name}: {self.blocks[i]} blocks, "
+                f"{rows} rows, {nbytes} bytes"
+            )
+            for op_name, dur in per_op.items():
+                lines.append(f"  * {op_name}: {dur * 1e3:.1f}ms")
+        lines.append(f"Total wall: {self.wall_s * 1e3:.1f}ms")
+        return "\n".join(lines)
+
+
+def _block_stats(block, per_op):
+    from .block import BlockAccessor
+
+    acc = BlockAccessor(block)
+    try:
+        rows = acc.num_rows()
+        nbytes = acc.size_bytes()
+    except Exception:
+        rows, nbytes = 0, 0
+    return {"ops": per_op, "rows": rows, "bytes": nbytes}
+
+
 # ---- task bodies (top-level: picklable by function table) ----------------
 
 def _run_chain_from_source(src: Callable[[], Any], ops: List[Any]):
@@ -61,6 +125,34 @@ def _run_chain_on_block(block, ops: List[Any]):
     for op in ops:
         block = op.apply(block)
     return block
+
+
+def _run_chain_from_source_stats(src: Callable[[], Any], ops: List[Any]):
+    import time as _t
+
+    t0 = _t.perf_counter()
+    block = src()
+    per_op = [("read", _t.perf_counter() - t0)]
+    for op in ops:
+        t0 = _t.perf_counter()
+        block = op.apply(block)
+        per_op.append(
+            (type(op).__name__.lstrip("_"), _t.perf_counter() - t0)
+        )
+    return block, _block_stats(block, per_op)
+
+
+def _run_chain_on_block_stats(block, ops: List[Any]):
+    import time as _t
+
+    per_op = []
+    for op in ops:
+        t0 = _t.perf_counter()
+        block = op.apply(block)
+        per_op.append(
+            (type(op).__name__.lstrip("_"), _t.perf_counter() - t0)
+        )
+    return block, _block_stats(block, per_op)
 
 
 class _ActorMapWorker:
@@ -85,9 +177,13 @@ class _ActorMapWorker:
 # ---- local (no-runtime) execution ---------------------------------------
 
 def _execute_local(sources: Sequence[Callable[[], Any]],
-                   stages: Sequence[Any]) -> Iterator[Any]:
+                   stages: Sequence[Any],
+                   stats: Optional["ExecStats"] = None) -> Iterator[Any]:
     from .dataset import _MapBatches
 
+    sidx = -1
+    if stats is not None:
+        sidx = stats.add_stage("LocalPipeline")
     # Instantiate each actor stage's callable once (pool of one).
     insts = {}
     for i, st in enumerate(stages):
@@ -95,28 +191,49 @@ def _execute_local(sources: Sequence[Callable[[], Any]],
             insts[i] = st.fn_cls(*st.fn_constructor_args,
                                  **st.fn_constructor_kwargs)
     for src in sources:
+        import time as _t
+
+        t0 = _t.perf_counter()
         block = src()
+        per_op = [("read", _t.perf_counter() - t0)]
         for i, st in enumerate(stages):
             if isinstance(st, TaskStage):
                 for op in st.ops:
+                    t0 = _t.perf_counter()
                     block = op.apply(block)
+                    per_op.append((type(op).__name__.lstrip("_"),
+                                   _t.perf_counter() - t0))
             else:
                 op = _MapBatches(insts[i], st.batch_format, st.batch_size)
+                t0 = _t.perf_counter()
                 block = op.apply(block)
+                per_op.append(("MapBatches", _t.perf_counter() - t0))
+        if stats is not None:
+            stats.blocks[sidx] += 1
+            stats.stats_refs[sidx].append(_block_stats(block, per_op))
         yield block
 
 
 # ---- distributed execution ----------------------------------------------
 
 def _task_stage_gen(upstream: Iterator[Any], stage: TaskStage,
-                    window: int, first: bool) -> Iterator[Any]:
+                    window: int, first: bool,
+                    stats: Optional[ExecStats] = None,
+                    stage_idx: int = -1) -> Iterator[Any]:
     """Submit one fused task per upstream item; yield result refs in order
-    with at most ``window`` in flight."""
+    with at most ``window`` in flight. With ``stats``, the task returns a
+    second tiny output carrying per-op wall + block rows/bytes."""
     import ray_tpu
 
-    fn = ray_tpu.remote(
-        _run_chain_from_source if first else _run_chain_on_block
-    )
+    if stats is not None:
+        fn = ray_tpu.remote(num_returns=2)(
+            _run_chain_from_source_stats if first
+            else _run_chain_on_block_stats
+        )
+    else:
+        fn = ray_tpu.remote(
+            _run_chain_from_source if first else _run_chain_on_block
+        )
     inflight: List[Any] = []
     up = iter(upstream)
     done = False
@@ -126,13 +243,21 @@ def _task_stage_gen(upstream: Iterator[Any], stage: TaskStage,
             if item is None:
                 done = True
                 break
-            inflight.append(fn.remote(item, stage.ops))
+            if stats is not None:
+                block_ref, stats_ref = fn.remote(item, stage.ops)
+                stats.stats_refs[stage_idx].append(stats_ref)
+                stats.blocks[stage_idx] += 1
+                inflight.append(block_ref)
+            else:
+                inflight.append(fn.remote(item, stage.ops))
         if inflight:
             yield inflight.pop(0)
 
 
 def _actor_stage_gen(upstream: Iterator[Any],
-                     stage: ActorStage) -> Iterator[Any]:
+                     stage: ActorStage,
+                     stats: Optional[ExecStats] = None,
+                     stage_idx: int = -1) -> Iterator[Any]:
     """Round-robin blocks over the actor pool; yield in submission order
     (per-actor queueing keeps each member busy without head-of-line
     blocking the whole pool)."""
@@ -172,6 +297,8 @@ def _actor_stage_gen(upstream: Iterator[Any],
                 # generator closes, and a killed actor can't seal a result
                 # that downstream hasn't consumed yet.
                 ray_tpu.wait([ref], num_returns=1, timeout=None)
+                if stats is not None:
+                    stats.blocks[stage_idx] += 1
                 yield ref
     finally:
         for a in pool:
@@ -182,12 +309,13 @@ def _actor_stage_gen(upstream: Iterator[Any],
 
 
 def execute(sources: Sequence[Callable[[], Any]],
-            stages: Sequence[Any]) -> Iterator[Any]:
+            stages: Sequence[Any],
+            stats: Optional[ExecStats] = None) -> Iterator[Any]:
     """Run the stage pipeline; yields materialized blocks on the driver.
     (Use :func:`execute_refs` to keep results remote.)"""
     import ray_tpu
 
-    for item in execute_refs(sources, stages):
+    for item in execute_refs(sources, stages, stats):
         yield ray_tpu.get(item) if _is_ref(item) else item
 
 
@@ -198,14 +326,21 @@ def _is_ref(x) -> bool:
 
 
 def execute_refs(sources: Sequence[Callable[[], Any]],
-                 stages: Sequence[Any]) -> Iterator[Any]:
+                 stages: Sequence[Any],
+                 stats: Optional[ExecStats] = None) -> Iterator[Any]:
     """Yield per-block results as ObjectRefs (driver never holds data),
-    falling back to local inline execution without a runtime."""
+    falling back to local inline execution without a runtime. Pass an
+    ``ExecStats`` to collect per-stage / per-operator accounting."""
+    import time as _t
+
     ctx = DataContext.get_current()
     from ..core import runtime_context
 
+    t_start = _t.perf_counter()
     if not (ctx.use_remote_tasks and runtime_context.is_initialized()):
-        yield from _execute_local(sources, stages)
+        yield from _execute_local(sources, stages, stats)
+        if stats is not None:
+            stats.wall_s = _t.perf_counter() - t_start
         return
 
     stages = list(stages) or [TaskStage([])]
@@ -213,13 +348,32 @@ def execute_refs(sources: Sequence[Callable[[], Any]],
     first = True
     for i, st in enumerate(stages):
         if isinstance(st, TaskStage):
-            gen = _task_stage_gen(gen, st, ctx.max_in_flight_tasks, first)
+            idx = -1
+            if stats is not None:
+                names = [type(o).__name__.lstrip("_") for o in st.ops]
+                label = "Read->" if first else ""
+                idx = stats.add_stage(
+                    f"TaskStage({label}{'->'.join(names) or 'identity'})"
+                )
+            gen = _task_stage_gen(gen, st, ctx.max_in_flight_tasks,
+                                  first, stats, idx)
         else:
             if first:
-                # Materialize sources into blocks before an actor stage.
+                idx = -1
+                if stats is not None:
+                    idx = stats.add_stage("TaskStage(Read)")
                 gen = _task_stage_gen(
-                    gen, TaskStage([]), ctx.max_in_flight_tasks, True
+                    gen, TaskStage([]), ctx.max_in_flight_tasks, True,
+                    stats, idx,
                 )
-            gen = _actor_stage_gen(gen, st)
+            aidx = -1
+            if stats is not None:
+                aidx = stats.add_stage(
+                    f"ActorStage({st.fn_cls.__name__} x{st.pool_size})"
+                )
+            gen = _actor_stage_gen(gen, st, stats, aidx)
         first = False
-    yield from gen
+    for item in gen:
+        yield item
+    if stats is not None:
+        stats.wall_s = _t.perf_counter() - t_start

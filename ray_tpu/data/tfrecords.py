@@ -1,0 +1,244 @@
+"""TFRecord datasource/sink without a tensorflow dependency.
+
+Ref analogue: python/ray/data/datasource tfrecords reader/writer (the
+reference parses tf.train.Example via TF). Here both halves are
+self-contained:
+
+- Container framing: ``[len:u64le][masked_crc32c(len):u32le][payload]
+  [masked_crc32c(payload):u32le]`` — the standard TFRecord layout, with
+  a table-driven pure-python CRC32C (Castagnoli) and the TF mask so
+  files interoperate with TensorFlow readers.
+- Payloads are tf.train.Example protos; a minimal hand-rolled protobuf
+  codec covers the Example schema (features -> feature map ->
+  bytes_list/float_list/int64_list), which is all the reference's
+  reader handles either.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Dict, Iterator, List
+
+# ---------------------------------------------------------------- crc32c
+
+_CRC_TABLE: List[int] = []
+
+
+def _crc_table() -> List[int]:
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        poly = 0x82F63B78  # Castagnoli, reflected
+        table = []
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------- container
+
+def read_records(path: str, *, verify: bool = True) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) < 12:
+                raise ValueError(f"truncated TFRecord header in {path}")
+            (length,), (lcrc,) = (struct.unpack("<Q", header[:8]),
+                                  struct.unpack("<I", header[8:]))
+            if verify and _masked_crc(header[:8]) != lcrc:
+                raise ValueError(f"corrupt TFRecord length crc in {path}")
+            payload = f.read(length)
+            (pcrc,) = struct.unpack("<I", f.read(4))
+            if verify and _masked_crc(payload) != pcrc:
+                raise ValueError(f"corrupt TFRecord payload crc in {path}")
+            yield payload
+
+
+def write_records(path: str, payloads: Iterator[bytes]) -> int:
+    n = 0
+    with open(path, "wb") as f:
+        for payload in payloads:
+            header = struct.pack("<Q", len(payload))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc(header)))
+            f.write(payload)
+            f.write(struct.pack("<I", _masked_crc(payload)))
+            n += 1
+    return n
+
+
+# ------------------------------------------- minimal tf.train.Example codec
+#
+# Wire schema (all fields are submessages with inner field 1):
+#   Example.features (field 1) -> Features.feature map<string, Feature>
+#   (field 1); each map entry: key (field 1, string), value (field 2,
+#   Feature); Feature is a oneof: bytes_list=1, float_list=2,
+#   int64_list=3; each list's values live in its field 1 (floats fixed32,
+#   int64 varint — packed or repeated).
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: memoryview, pos: int):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, pos
+        shift += 7
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def encode_example(features: Dict[str, Any]) -> bytes:
+    """Build a tf.train.Example proto from {name: value(s)}: bytes/str ->
+    bytes_list, float -> float_list, int -> int64_list (scalars or
+    lists)."""
+    entries = b""
+    for name, value in features.items():
+        vals = list(value) if isinstance(value, (list, tuple)) else [value]
+        # Numpy scalars (arrow/pandas rows) -> native python types.
+        vals = [v.item() if hasattr(v, "item") else v for v in vals]
+        if all(isinstance(v, (bytes, str)) for v in vals):
+            items = b"".join(
+                _len_delim(1, v.encode() if isinstance(v, str) else v)
+                for v in vals
+            )
+            feature = _len_delim(1, items)          # bytes_list
+        elif all(isinstance(v, (bool, int)) for v in vals):
+            # field 1 varint: tag byte 0x08 per value
+            items = b"".join(b"\x08" + _varint(int(v) & ((1 << 64) - 1))
+                             for v in vals)
+            feature = _len_delim(3, items)          # int64_list
+        elif all(isinstance(v, (int, float)) for v in vals):
+            items = b"".join(b"\x0d" + struct.pack("<f", float(v))
+                             for v in vals)          # field 1 fixed32
+            feature = _len_delim(2, items)          # float_list
+        else:
+            raise TypeError(f"unsupported feature type for {name!r}")
+        entry = _len_delim(1, name.encode()) + _len_delim(2, feature)
+        entries += _len_delim(1, entry)
+    features_msg = entries
+    return _len_delim(1, features_msg)
+
+
+def _parse_fields(buf: memoryview) -> Iterator[Any]:
+    pos = 0
+    end = len(buf)
+    while pos < end:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 2:
+            length, pos = _read_varint(buf, pos)
+            yield field, buf[pos:pos + length]
+            pos += length
+        elif wire == 0:
+            val, pos = _read_varint(buf, pos)
+            yield field, val
+        elif wire == 5:
+            yield field, buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            yield field, buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+def _parse_feature(buf: memoryview):
+    for field, payload in _parse_fields(buf):
+        if field == 1:      # bytes_list
+            return [bytes(v) for f, v in _parse_fields(payload) if f == 1]
+        if field == 2:      # float_list (packed or repeated fixed32)
+            vals: List[float] = []
+            for f, v in _parse_fields(payload):
+                if f != 1:
+                    continue
+                if isinstance(v, memoryview) and len(v) == 4:
+                    vals.append(struct.unpack("<f", v)[0])
+                elif isinstance(v, memoryview):  # packed
+                    vals.extend(
+                        struct.unpack(f"<{len(v) // 4}f", v)
+                    )
+            return vals
+        if field == 3:      # int64_list (packed or repeated varint)
+            ints: List[int] = []
+            for f, v in _parse_fields(payload):
+                if f != 1:
+                    continue
+                if isinstance(v, int):
+                    ints.append(v if v < (1 << 63) else v - (1 << 64))
+                else:  # packed varints
+                    pos = 0
+                    while pos < len(v):
+                        val, pos = _read_varint(v, pos)
+                        ints.append(val if val < (1 << 63)
+                                    else val - (1 << 64))
+            return ints
+    return []
+
+
+def decode_example(payload: bytes) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for field, features_msg in _parse_fields(memoryview(payload)):
+        if field != 1:
+            continue
+        for f2, entry in _parse_fields(features_msg):
+            if f2 != 1:
+                continue
+            name = None
+            feature = None
+            for f3, v in _parse_fields(entry):
+                if f3 == 1:
+                    name = bytes(v).decode()
+                elif f3 == 2:
+                    feature = _parse_feature(v)
+            if name is not None:
+                vals = feature or []
+                out[name] = vals[0] if len(vals) == 1 else vals
+    return out
+
+
+# --------------------------------------------------------------- dataset IO
+
+def read_example_file(path: str) -> List[Dict[str, Any]]:
+    return [decode_example(rec) for rec in read_records(path)]
+
+
+def write_example_file(path: str, rows: List[Dict[str, Any]]) -> str:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    write_records(path, (encode_example(r) for r in rows))
+    return path

@@ -314,13 +314,20 @@ class LLMEngine:
             for s in active_slots:
                 active[s] = True
             self._rng, key = jax.random.split(self._rng)
-            nxt, self.cache = self._decode(
-                self.params,
-                self.cache,
-                jnp.asarray(self._last_tok),
-                jnp.asarray(active),
-                key,
-            )
+            try:
+                nxt, self.cache = self._decode(
+                    self.params,
+                    self.cache,
+                    jnp.asarray(self._last_tok),
+                    jnp.asarray(active),
+                    key,
+                )
+            except Exception as e:  # noqa: BLE001
+                # The cache was donated into the failed call — recover
+                # like the prefill path: rebuild the pool, fail in-flight
+                # requests cleanly, keep the loop alive for new work.
+                self._reset_cache(e)
+                continue
             self._step_count += 1
             nxt = np.asarray(nxt)
             for slot, req in active_slots.items():

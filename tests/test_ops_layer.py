@@ -285,3 +285,53 @@ def test_prometheus_metrics_endpoint(ray_tpu_start):
         assert "app_latency_s_count 2" in after
     finally:
         dashboard.stop_dashboard()
+
+
+def test_trace_span_tree(tmp_path):
+    """Spans around submit/execute with context propagated through the
+    TaskSpec: one trace shows a driver-submit -> worker-exec ->
+    nested-task span tree (VERDICT r3 ask #9; ref:
+    util/tracing/tracing_helper.py:326)."""
+    import importlib
+    import os
+    import subprocess
+    import sys
+
+    # RAY_TPU_TRACE_SUBMITS is read at import: run in a fresh process.
+    code = r"""
+import json, sys
+import ray_tpu
+
+ray_tpu.init(num_cpus=2, system_config={"log_to_driver": False})
+
+@ray_tpu.remote
+def child(x):
+    return x + 1
+
+@ray_tpu.remote
+def parent_task(x):
+    return ray_tpu.get(child.remote(x)) * 10
+
+assert ray_tpu.get(parent_task.remote(4), timeout=60) == 50
+trace = ray_tpu.timeline()
+ray_tpu.shutdown()
+json.dump(trace, open(sys.argv[1], "w"))
+"""
+    out = tmp_path / "trace.json"
+    env = dict(os.environ, RAY_TPU_TRACE_SUBMITS="1")
+    subprocess.run([sys.executable, "-c", code, str(out)], check=True,
+                   env=env, timeout=300)
+    trace = json.load(open(out))
+    by_name = {}
+    for ev in trace:
+        by_name.setdefault(ev["name"].split(":")[0], []).append(ev)
+    submit = next(e for e in by_name["submit"]
+                  if "parent_task" in e["name"])
+    parent = by_name["parent_task"][0]
+    kid = by_name["child"][0]
+    tid = submit["args"]["trace_id"]
+    assert tid and parent["args"]["trace_id"] == tid
+    assert kid["args"]["trace_id"] == tid
+    # tree: submit -> parent exec -> child exec
+    assert parent["args"]["parent_id"] == submit["args"]["span_id"]
+    assert kid["args"]["parent_id"] == parent["args"]["span_id"]
