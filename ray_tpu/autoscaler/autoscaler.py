@@ -67,19 +67,28 @@ class Autoscaler:
     process (``start()`` spawns the reconcile thread)."""
 
     def __init__(self, config: Optional[AutoscalerConfig] = None,
-                 provider: Optional[NodeProvider] = None):
-        from ..core.runtime_context import current_runtime
-
+                 provider: Optional[NodeProvider] = None,
+                 *, nodes_fn=None):
         self.config = config or AutoscalerConfig()
-        rt = current_runtime()
-        if provider is None:
-            nm = rt._nm
-            if nm.gcs_service is None:
-                raise RuntimeError("autoscaler must run on the head node")
-            host, port = nm.gcs_service.address
-            provider = LocalNodeProvider(f"{host}:{port}")
+        if nodes_fn is None or provider is None:
+            # Default to the in-process driver runtime (a CLI head
+            # passes nodes_fn + provider explicitly — it has a
+            # NodeManager but no driver runtime).
+            from ..core.runtime_context import current_runtime
+
+            rt = current_runtime()
+            if nodes_fn is None:
+                nodes_fn = rt.nodes
+            if provider is None:
+                nm = rt._nm
+                if nm.gcs_service is None:
+                    raise RuntimeError(
+                        "autoscaler must run on the head node"
+                    )
+                host, port = nm.gcs_service.address
+                provider = LocalNodeProvider(f"{host}:{port}")
         self.provider = provider
-        self._rt = rt
+        self._nodes_fn = nodes_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._pending_since: Optional[float] = None
@@ -200,7 +209,7 @@ class Autoscaler:
         while len(live) < cfg.min_workers:
             live.append(self._launch(self._default_type()))
 
-        views = self._rt.nodes()
+        views = self._nodes_fn()
         alive = [v for v in views if v.get("state") == "alive"]
         by_provider: Dict[str, Dict[str, Any]] = {}
         for v in alive:

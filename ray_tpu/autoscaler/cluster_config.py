@@ -1,0 +1,110 @@
+"""Cluster YAML config: load, validate, and build the autoscaler.
+
+Ref analogue: the reference's cluster YAML + ray-schema.json consumed by
+`ray up` (autoscaler/_private/commands.py). Schema (all keys except
+``provider`` optional):
+
+.. code-block:: yaml
+
+    cluster_name: demo
+    max_workers: 4          # global cap
+    min_workers: 0
+    idle_timeout_s: 60
+    upscale_delay_s: 1.0
+    provider:
+      type: local           # local | ssh
+      # ssh only:
+      # worker_ips: [10.0.0.2, 10.0.0.3]
+      # ssh_user: ubuntu
+      # ssh_key: ~/.ssh/id_rsa
+      # python: python3
+    head:
+      port: 7777
+      num_cpus: 4
+      resources: {TPU: 1}
+    available_node_types:
+      cpu_worker:
+        resources: {CPU: 2}
+        labels: {pool: general}
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+from .autoscaler import Autoscaler, AutoscalerConfig
+from .node_provider import LocalNodeProvider, SSHNodeProvider
+
+_ALLOWED_TOP = {
+    "cluster_name", "max_workers", "min_workers", "idle_timeout_s",
+    "upscale_delay_s", "boot_timeout_s", "infeasible_grace_s",
+    "provider", "head", "available_node_types",
+}
+_ALLOWED_PROVIDER = {"type", "worker_ips", "ssh_user", "ssh_key", "python"}
+_ALLOWED_HEAD = {"port", "num_cpus", "resources", "node_ip"}
+
+
+def load_cluster_config(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(os.path.expanduser(path)) as f:
+        cfg = yaml.safe_load(f) or {}
+    if not isinstance(cfg, dict):
+        raise ValueError(f"cluster config {path} must be a mapping")
+    unknown = set(cfg) - _ALLOWED_TOP
+    if unknown:
+        raise ValueError(
+            f"unknown cluster config keys: {sorted(unknown)} "
+            f"(allowed: {sorted(_ALLOWED_TOP)})"
+        )
+    provider = cfg.setdefault("provider", {"type": "local"})
+    unknown = set(provider) - _ALLOWED_PROVIDER
+    if unknown:
+        raise ValueError(f"unknown provider keys: {sorted(unknown)}")
+    ptype = provider.setdefault("type", "local")
+    if ptype not in ("local", "ssh"):
+        raise ValueError(f"provider.type must be local|ssh, got {ptype!r}")
+    if ptype == "ssh" and not provider.get("worker_ips"):
+        raise ValueError("provider.type=ssh requires provider.worker_ips")
+    head = cfg.get("head") or {}
+    unknown = set(head) - _ALLOWED_HEAD
+    if unknown:
+        raise ValueError(
+            f"unknown head keys: {sorted(unknown)} "
+            f"(allowed: {sorted(_ALLOWED_HEAD)})"
+        )
+    cfg.setdefault("cluster_name", "default")
+    cfg.setdefault("max_workers", 4)
+    cfg.setdefault("min_workers", 0)
+    cfg.setdefault("head", {})
+    for name, nt in (cfg.get("available_node_types") or {}).items():
+        if "resources" not in nt:
+            raise ValueError(f"node type {name!r} needs a resources map")
+    return cfg
+
+
+def build_autoscaler(cfg: Dict[str, Any], gcs_address: str,
+                     *, nodes_fn=None) -> Autoscaler:
+    """Construct (not start) an Autoscaler from a loaded cluster config."""
+    node_types = cfg.get("available_node_types") or None
+    as_cfg = AutoscalerConfig(
+        min_workers=int(cfg.get("min_workers", 0)),
+        max_workers=int(cfg.get("max_workers", 4)),
+        node_types=node_types,
+        idle_timeout_s=float(cfg.get("idle_timeout_s", 10.0)),
+        upscale_delay_s=float(cfg.get("upscale_delay_s", 1.0)),
+        boot_timeout_s=float(cfg.get("boot_timeout_s", 60.0)),
+    )
+    p = cfg["provider"]
+    if p["type"] == "ssh":
+        provider = SSHNodeProvider(
+            gcs_address,
+            worker_ips=list(p["worker_ips"]),
+            ssh_user=p.get("ssh_user", ""),
+            ssh_key=p.get("ssh_key", ""),
+            python=p.get("python", "python3"),
+        )
+    else:
+        provider = LocalNodeProvider(gcs_address)
+    return Autoscaler(as_cfg, provider, nodes_fn=nodes_fn)

@@ -40,14 +40,52 @@ class NodeProvider:
         raise NotImplementedError
 
 
-class LocalNodeProvider(NodeProvider):
+class _SubprocessProvider(NodeProvider):
+    """Shared Popen lifecycle (terminate/reap/shutdown) for providers
+    whose nodes are child processes; subclasses implement create_node."""
+
+    def __init__(self):
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    def _reap(self, provider_node_id: str) -> None:
+        """Forget a node whose process is gone (subclass hook for
+        releasing per-node resources like ssh IPs)."""
+        self._procs.pop(provider_node_id, None)
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        proc = self._procs.get(provider_node_id)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self._reap(provider_node_id)
+
+    def non_terminated_nodes(self) -> List[str]:
+        alive = []
+        for nid, p in list(self._procs.items()):
+            if p.poll() is None:
+                alive.append(nid)
+            else:
+                # Reap dead children so their resources (e.g. an ssh
+                # worker IP) free up instead of leaking forever.
+                self._reap(nid)
+        return alive
+
+    def shutdown(self) -> None:
+        for nid in list(self._procs):
+            self.terminate_node(nid)
+
+
+class LocalNodeProvider(_SubprocessProvider):
     """Launches worker nodes as ``node_main`` subprocesses on this machine
     (the reference's fake_multi_node pattern — also exactly what a
     single-host TPU VM needs)."""
 
     def __init__(self, gcs_address: str):
+        super().__init__()
         self.gcs_address = gcs_address
-        self._procs: Dict[str, subprocess.Popen] = {}
 
     def create_node(self, resources: Dict[str, float],
                     labels: Optional[Dict[str, str]] = None) -> str:
@@ -83,20 +121,78 @@ class LocalNodeProvider(NodeProvider):
         self._procs[node_id] = proc
         return node_id
 
-    def terminate_node(self, provider_node_id: str) -> None:
-        proc = self._procs.pop(provider_node_id, None)
-        if proc is not None and proc.poll() is None:
-            proc.terminate()
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
 
-    def non_terminated_nodes(self) -> List[str]:
-        return [
-            nid for nid, p in self._procs.items() if p.poll() is None
-        ]
+class SSHNodeProvider(_SubprocessProvider):
+    """Launches worker nodes on remote hosts over ssh (ref analogue: the
+    on-prem/"local" provider's ssh command_runner.py — one node process
+    per configured worker IP; no cloud API, the machines already exist).
+    Each create_node takes the next free IP from ``worker_ips``."""
 
-    def shutdown(self) -> None:
-        for nid in list(self._procs):
-            self.terminate_node(nid)
+    def __init__(self, gcs_address: str, *, worker_ips: List[str],
+                 ssh_user: str = "", ssh_key: str = "",
+                 python: str = "python3"):
+        super().__init__()
+        self.gcs_address = gcs_address
+        self.worker_ips = list(worker_ips)
+        self.ssh_user = ssh_user
+        self.ssh_key = ssh_key
+        self.python = python
+        self._ip_of: Dict[str, str] = {}
+
+    def _reap(self, provider_node_id: str) -> None:
+        super()._reap(provider_node_id)
+        self._ip_of.pop(provider_node_id, None)  # free the IP
+
+    def _free_ip(self) -> Optional[str]:
+        used = set(self._ip_of.values())
+        for ip in self.worker_ips:
+            if ip not in used:
+                return ip
+        return None
+
+    def ssh_command(self, ip: str, node_id: str,
+                    resources: Dict[str, float],
+                    labels: Dict[str, str]) -> List[str]:
+        """The exact argv used to start a node on ``ip`` (separated out
+        for tests: the sandbox has no reachable ssh hosts). Creates the
+        remote session dir and forwards the session token when the
+        cluster is token-secured."""
+        from ray_tpu.core.config import get_config
+
+        target = f"{self.ssh_user}@{ip}" if self.ssh_user else ip
+        session_dir = f"/tmp/ray_tpu/{node_id}"
+        env = (
+            f"RAY_TPU_GCS_ADDRESS={self.gcs_address} "
+            f"RAY_TPU_SESSION_DIR={session_dir} "
+            f"RAY_TPU_RESOURCES='{json.dumps(resources)}' "
+            f"RAY_TPU_NODE_LABELS='{json.dumps(labels)}'"
+        )
+        token = get_config().session_token
+        if token:
+            env += f" RAY_TPU_SESSION_TOKEN={token}"
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=accept-new"]
+        if self.ssh_key:
+            cmd += ["-i", os.path.expanduser(self.ssh_key)]
+        cmd += [target,
+                f"mkdir -p {session_dir} && "
+                f"{env} {self.python} -m ray_tpu.core.node_main"]
+        return cmd
+
+    def create_node(self, resources: Dict[str, float],
+                    labels: Optional[Dict[str, str]] = None) -> str:
+        ip = self._free_ip()
+        if ip is None:
+            raise RuntimeError(
+                f"ssh provider exhausted: all {len(self.worker_ips)} "
+                f"worker_ips in use"
+            )
+        node_id = f"ssh-{ip}-{uuid.uuid4().hex[:6]}"
+        labels = dict(labels or {})
+        labels[PROVIDER_NODE_LABEL] = node_id
+        proc = subprocess.Popen(
+            self.ssh_command(ip, node_id, resources, labels),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        self._procs[node_id] = proc
+        self._ip_of[node_id] = ip
+        return node_id

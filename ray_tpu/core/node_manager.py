@@ -526,7 +526,11 @@ class NodeManager:
             "peer_port": self.peer_port,
             "resources_total": self.node_resources.total.to_dict(),
             "resources_available": self.node_resources.available.to_dict(),
-            "pending_tasks": len(self._ready) + len(self._waiting),
+            "pending_tasks": (
+                len(self._ready) + len(self._waiting)
+                + sum(len(w.pending) for w in self._workers.values()
+                      if w.state != "dead")
+            ),
             "is_head": self.is_head,
             "state": "alive",
             "labels": self.labels,
@@ -557,6 +561,23 @@ class NodeManager:
             if key not in counts and len(counts) >= cap:
                 continue
             counts[key] = counts.get(key, 0) + 1
+        # Lease riders: tasks queued in a worker's pipeline have NOT
+        # started — they are latent demand exactly like ready-queue
+        # entries (without this, riding hides parallelizable work from
+        # the autoscaler: 6 queued CPU-seconds on a 1-CPU node would
+        # look satisfied). Report them under their shape.
+        for w in self._workers.values():
+            if w.state == "dead" or not w.pending:
+                continue
+            for rec in w.pending:
+                try:
+                    shape = rec.spec.resources.to_dict()
+                except Exception:
+                    continue
+                key = tuple(sorted(shape.items()))
+                if key not in counts and len(counts) >= cap:
+                    continue
+                counts[key] = counts.get(key, 0) + 1
         return [[dict(k), n] for k, n in counts.items()]
 
     def _on_gcs_node_added(self, entry):
@@ -2941,6 +2962,8 @@ class NodeManager:
         deadline = start + timeout
         alive_no_path_since = None
         while True:
+            if self._shutdown:
+                return None  # don't outlive the loop (pending-task warning)
             info = self._actors.get(actor_id)
             if info is None or info.state == "dead":
                 return None

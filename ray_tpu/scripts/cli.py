@@ -81,6 +81,23 @@ def _run_head_blocking(args) -> int:
         f"head-{int(time.time())}-{uuid.uuid4().hex[:8]}",
     )
     os.makedirs(session_dir, exist_ok=True)
+    cluster_cfg = None
+    if getattr(args, "cluster_config", None):
+        from ray_tpu.autoscaler.cluster_config import load_cluster_config
+
+        cluster_cfg = load_cluster_config(args.cluster_config)
+        # With an autoscaler, shapes no node can serve yet must WAIT for
+        # upscale instead of failing fast (config.infeasible_grace_s).
+        config.infeasible_grace_s = float(
+            cluster_cfg.get("infeasible_grace_s", 120.0)
+        )
+        head = cluster_cfg.get("head") or {}
+        if "port" in head:
+            config.gcs_port = int(head["port"])
+        if "num_cpus" in head:
+            res["CPU"] = float(head["num_cpus"])
+        for k, v in (head.get("resources") or {}).items():
+            res[k] = float(v)
     nm = NodeManager(
         NodeID.from_random(), session_dir, res, config,
         is_head=True, node_ip=args.node_ip, labels=node_tpu_labels(),
@@ -97,6 +114,21 @@ def _run_head_blocking(args) -> int:
     print(f"  or: export RAY_TPU_ADDRESS={address}")
     sys.stdout.flush()
 
+    scaler = None
+    if cluster_cfg is not None:
+        # `rtpu up`: the head hosts the autoscaler (ref: the monitor
+        # process `ray up` starts beside the GCS).
+        from ray_tpu.autoscaler.cluster_config import build_autoscaler
+
+        scaler = build_autoscaler(
+            cluster_cfg, address,
+            nodes_fn=lambda: nm.call_sync(nm.cluster_nodes()),
+        ).start()
+        print(f"autoscaler: min={scaler.config.min_workers} "
+              f"max={scaler.config.max_workers} "
+              f"provider={cluster_cfg['provider']['type']}")
+        sys.stdout.flush()
+
     stop = {"flag": False}
 
     def _term(signum, frame):
@@ -106,6 +138,8 @@ def _run_head_blocking(args) -> int:
     signal.signal(signal.SIGINT, _term)
     while not stop["flag"]:
         time.sleep(0.2)
+    if scaler is not None:
+        scaler.shutdown(terminate_nodes=True)
     nm.shutdown()
     return 0
 
@@ -180,6 +214,61 @@ def cmd_start(args) -> int:
         sys.exit(f"head failed to start; see {log_path}")
     print(f"started node (pid {proc.pid}); logs: {log_path}")
     return 0
+
+
+def cmd_up(args) -> int:
+    """Start a head + autoscaler from a cluster YAML (ref: `ray up`).
+    The head process hosts the autoscaler; workers come from the
+    config's provider on demand."""
+    from ray_tpu.autoscaler.cluster_config import load_cluster_config
+
+    cfg = load_cluster_config(args.cluster_config)  # fail fast on errors
+    # A stale address file (crashed head) or inherited RAY_TPU_ADDRESS
+    # must not masquerade as the new cluster: clear the file and poll IT,
+    # never the env fallback.
+    try:
+        os.unlink(ADDRESS_FILE)
+    except OSError:
+        pass
+    os.makedirs(LOG_DIR, exist_ok=True)
+    log_path = os.path.join(
+        LOG_DIR, f"head-{cfg['cluster_name']}-{int(time.time())}.log"
+    )
+    node_ip = (cfg.get("head") or {}).get("node_ip", args.node_ip)
+    cmd = [sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+           "--block", "--head",
+           "--cluster-config", os.path.abspath(args.cluster_config),
+           "--node-ip", str(node_ip), "--port", str(args.port)]
+    with open(log_path, "ab") as log:
+        proc = subprocess.Popen(cmd, stdout=log,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+    _record_pid("head", proc.pid)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        addr = None
+        try:
+            with open(ADDRESS_FILE) as f:
+                addr = f.read().strip()
+        except OSError:
+            pass
+        if addr:
+            print(f"cluster {cfg['cluster_name']!r} up "
+                  f"(head pid {proc.pid}) at {addr}")
+            print(f"  connect: ray_tpu.init(address={addr!r})")
+            print(f"  logs: {log_path}")
+            return 0
+        if proc.poll() is not None:
+            break
+        time.sleep(0.1)
+    sys.exit(f"cluster failed to start; see {log_path}")
+
+
+def cmd_down(args) -> int:
+    """Tear the cluster down (ref: `ray down`): SIGTERM the head — its
+    autoscaler terminates every provider-launched worker on the way
+    out — then stop any other recorded local processes."""
+    return cmd_stop(args)
 
 
 def cmd_stop(args) -> int:
@@ -362,10 +451,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--resources", default=None, help="JSON dict")
     p.add_argument("--block", action="store_true",
                    help="run in the foreground")
+    p.add_argument("--cluster-config", default=None,
+                   help="cluster YAML; head runs the autoscaler")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("stop", help="stop all locally-started nodes")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("up", help="start a cluster from a YAML config")
+    p.add_argument("cluster_config")
+    p.add_argument("--port", type=int, default=6380)
+    p.add_argument("--node-ip", default="127.0.0.1")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down the cluster")
+    p.add_argument("cluster_config", nargs="?")
+    p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("status", help="cluster summary")
     _add_address(p)

@@ -1,5 +1,6 @@
 """Autoscaler tests (ref analogue: the fake_multi_node autoscaler tests)."""
 
+import os
 import time
 
 import pytest
@@ -108,3 +109,110 @@ def test_autoscaler_respects_min_workers():
         if scaler is not None:
             scaler.shutdown()
         ray_tpu.shutdown()
+
+
+def test_cluster_yaml_validation(tmp_path):
+    """Cluster YAML schema errors fail fast (ref: ray-schema.json)."""
+    import pytest as _pytest
+
+    from ray_tpu.autoscaler.cluster_config import load_cluster_config
+
+    good = tmp_path / "good.yaml"
+    good.write_text(
+        "cluster_name: t\nmax_workers: 2\n"
+        "provider: {type: local}\n"
+        "available_node_types:\n  w:\n    resources: {CPU: 1}\n"
+    )
+    cfg = load_cluster_config(str(good))
+    assert cfg["cluster_name"] == "t" and cfg["max_workers"] == 2
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("cluster_name: t\nworkers_max: 2\n")
+    with _pytest.raises(ValueError, match="unknown cluster config keys"):
+        load_cluster_config(str(bad))
+
+    bad2 = tmp_path / "bad2.yaml"
+    bad2.write_text("provider: {type: gcp}\n")
+    with _pytest.raises(ValueError, match="local|ssh"):
+        load_cluster_config(str(bad2))
+
+    bad3 = tmp_path / "bad3.yaml"
+    bad3.write_text(
+        "provider: {type: local}\n"
+        "available_node_types:\n  w: {labels: {a: b}}\n"
+    )
+    with _pytest.raises(ValueError, match="resources"):
+        load_cluster_config(str(bad3))
+
+
+def test_ssh_provider_command_shape():
+    """SSH provider builds a correct remote-launch argv (no reachable
+    ssh hosts in the sandbox; the command is the contract)."""
+    from ray_tpu.autoscaler.node_provider import SSHNodeProvider
+
+    p = SSHNodeProvider("10.0.0.1:6380", worker_ips=["10.0.0.2"],
+                        ssh_user="ubuntu", ssh_key="~/.ssh/k")
+    cmd = p.ssh_command("10.0.0.2", "ssh-n1", {"CPU": 2.0},
+                        {"pool": "x"})
+    assert cmd[0] == "ssh" and "ubuntu@10.0.0.2" in cmd
+    remote = cmd[-1]
+    assert "RAY_TPU_GCS_ADDRESS=10.0.0.1:6380" in remote
+    assert "RAY_TPU_SESSION_DIR=" in remote and "mkdir -p" in remote
+    assert "ray_tpu.core.node_main" in remote
+    assert '"CPU": 2.0' in remote
+
+
+def test_rtpu_up_down_e2e(tmp_path):
+    """`rtpu up <yaml>` starts a head whose autoscaler launches a
+    provider worker for a demanded shape; `rtpu down` terminates
+    everything (ref: `ray up` / `ray down` over commands.py)."""
+    import subprocess
+    import sys as _sys
+
+    cfg = tmp_path / "cluster.yaml"
+    cfg.write_text(
+        "cluster_name: e2e\n"
+        "max_workers: 1\n"
+        "idle_timeout_s: 60\n"
+        "upscale_delay_s: 0.2\n"
+        "head:\n  num_cpus: 1\n  port: 0\n"
+        "provider: {type: local}\n"
+        "available_node_types:\n"
+        "  gadget_worker:\n"
+        "    resources: {CPU: 1, gadget: 1}\n"
+    )
+    env = dict(os.environ)
+    up = subprocess.run(
+        [_sys.executable, "-m", "ray_tpu.scripts.cli", "up", str(cfg)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert up.returncode == 0, up.stdout + up.stderr
+    try:
+        address = None
+        for line in up.stdout.splitlines():
+            if "address=" in line:
+                address = line.split("address=")[1].strip("')")
+        assert address, up.stdout
+
+        driver = (
+            "import ray_tpu\n"
+            f"ray_tpu.init(address={address!r}, "
+            "system_config={'infeasible_grace_s': 90})\n"
+            "@ray_tpu.remote(resources={'gadget': 1})\n"
+            "def probe():\n"
+            "    return 'scaled'\n"
+            "print(ray_tpu.get(probe.remote(), timeout=90))\n"
+            "ray_tpu.shutdown()\n"
+        )
+        out = subprocess.run(
+            [_sys.executable, "-c", driver], capture_output=True,
+            text=True, timeout=180, env=env,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "scaled" in out.stdout
+    finally:
+        subprocess.run(
+            [_sys.executable, "-m", "ray_tpu.scripts.cli", "down",
+             str(cfg)],
+            capture_output=True, text=True, timeout=60, env=env,
+        )
