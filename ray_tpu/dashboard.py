@@ -93,6 +93,18 @@ class _Handler(BaseHTTPRequestHandler):
                 "/api/summary/actors": state.summarize_actors,
                 "/api/summary/objects": state.summarize_objects,
             }
+            if path == "/api/profile":
+                # On-demand stack-sampling profile of the control plane
+                # (driver + node-manager threads), collapsed-stack format
+                # (ref analogue: dashboard reporter profile_manager.py's
+                # py-spy endpoint — dependency-free equivalent).
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+                seconds = min(30.0, float(q.get("seconds", ["2"])[0]))
+                hz = min(200, int(q.get("hz", ["100"])[0]))
+                self._json(_sample_stacks(seconds, hz))
+                return
             if path == "/metrics":
                 # Prometheus text exposition (ref analogue:
                 # _private/prometheus_exporter.py endpoint).
@@ -128,6 +140,44 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(fn())
         except Exception as e:  # noqa: BLE001
             self._json({"error": repr(e)}, 500)
+
+
+def _sample_stacks(seconds: float, hz: int) -> dict:
+    """Wall-clock stack sampler over every thread in this process;
+    returns {collapsed_stack: sample_count} plus thread names (feed the
+    "stacks" map to any flamegraph renderer)."""
+    import sys
+    import time
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    counts: dict = {}
+    deadline = time.monotonic() + seconds
+    interval = 1.0 / max(1, hz)
+    samples = 0
+    me = threading.get_ident()
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            parts = []
+            f = frame
+            depth = 0
+            while f is not None and depth < 40:
+                code = f.f_code
+                parts.append(
+                    f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                    f"{code.co_name}"
+                )
+                f = f.f_back
+                depth += 1
+            stack = (names.get(tid, str(tid)) + ";"
+                     + ";".join(reversed(parts)))
+            counts[stack] = counts.get(stack, 0) + 1
+        samples += 1
+        time.sleep(interval)
+    return {"seconds": seconds, "hz": hz, "samples": samples,
+            "stacks": dict(sorted(counts.items(),
+                                  key=lambda kv: -kv[1])[:500])}
 
 
 def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:

@@ -488,6 +488,14 @@ class Dataset:
             self.select_columns([column]), path, "npy"
         )
 
+    def to_random_access(self, key: str, *, num_workers: int = 2):
+        """Materialize into a range-partitioned actor pool supporting
+        O(1) point lookups by ``key`` (ref analogue:
+        Dataset.to_random_access_dataset / random_access_dataset.py)."""
+        from .random_access import RandomAccessDataset
+
+        return RandomAccessDataset(self, key, num_workers=num_workers)
+
     # ---- splitting for train ingest ----
 
     def streaming_split(self, n: int, *, equal: bool = True

@@ -1,0 +1,128 @@
+"""Random-access view over a Dataset.
+
+Ref analogue: python/ray/data/random_access_dataset.py
+(RandomAccessDataset) — the dataset is partitioned on a key across a
+pool of actors, each holding its partition in memory with a hash index;
+``get_async``/``multiget`` route keys to the owning actor. The reference
+range-partitions via a global sort; here partitioning is by stable key
+HASH, which serves the same point-lookup API without a distributed sort
+and keeps construction fully remote: one task per input block splits
+rows into per-partition buckets, and each serving actor materializes
+only ITS buckets (the driver handles refs, never rows)."""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+def _hash_key(key) -> int:
+    """Deterministic across processes (unlike builtin hash for str).
+    Numpy scalars normalize to native python first — repr(np.int64(42))
+    differs from repr(42) and would route to the wrong partition."""
+    if hasattr(key, "item"):
+        key = key.item()
+    return int(
+        hashlib.md5(repr(key).encode()).hexdigest()[:8], 16
+    )
+
+
+def _split_block(block, key: str, n: int):
+    """Remote task: bucket one block's rows by key hash (num_returns=n)."""
+    from .block import BlockAccessor
+
+    buckets: List[List[Dict[str, Any]]] = [[] for _ in range(n)]
+    for row in BlockAccessor(block).iter_rows():
+        row = dict(row)
+        buckets[_hash_key(row[key]) % n].append(row)
+    return tuple(buckets) if n > 1 else buckets[0]
+
+
+class _PartitionServer:
+    """One hash partition, indexed by key (actor). ``bucket_lists``
+    arrive as resolved task outputs — the rows travel store-to-actor."""
+
+    def __init__(self, key: str, *bucket_lists):
+        self._index = {}
+        for rows in bucket_lists:
+            for r in rows:
+                self._index[r[key]] = r
+
+    def get(self, key):
+        return self._index.get(key)
+
+    def multiget(self, keys: List[Any]):
+        return [self._index.get(k) for k in keys]
+
+    def stats(self) -> Dict[str, int]:
+        return {"rows": len(self._index)}
+
+
+class RandomAccessDataset:
+    """Built via :meth:`Dataset.to_random_access`."""
+
+    def __init__(self, dataset, key: str, *, num_workers: int = 2):
+        n = max(1, int(num_workers))
+        self._key = key
+        self._n = n
+        splitter = ray_tpu.remote(num_returns=n)(_split_block)
+        block_refs = dataset.materialize()._sources
+        # Each source thunk resolves to a block ref; split remotely.
+        bucket_refs: List[List[Any]] = []  # [block][partition]
+        for src in block_refs:
+            out = splitter.remote(src(), key, n)
+            bucket_refs.append([out] if n == 1 else list(out))
+        server = ray_tpu.remote(_PartitionServer)
+        self._actors = [
+            server.remote(key, *[row_refs[p] for row_refs in bucket_refs])
+            for p in range(n)
+        ]
+        # Readiness gate: constructors hold the rows.
+        ray_tpu.get([a.stats.remote() for a in self._actors])
+
+    def _owner(self, key) -> int:
+        return _hash_key(key) % self._n
+
+    def get_async(self, key):
+        """ObjectRef resolving to the row (or None)."""
+        return self._actors[self._owner(key)].get.remote(key)
+
+    def get(self, key, timeout: Optional[float] = 30.0):
+        return ray_tpu.get(self.get_async(key), timeout=timeout)
+
+    def multiget(self, keys: List[Any],
+                 timeout: Optional[float] = 60.0) -> List[Any]:
+        """Batched lookup: one actor call per owning partition, results
+        re-assembled in input order (ref: multiget batching)."""
+        by_owner: Dict[int, List[int]] = {}
+        for pos, k in enumerate(keys):
+            by_owner.setdefault(self._owner(k), []).append(pos)
+        refs = {
+            owner: self._actors[owner].multiget.remote(
+                [keys[p] for p in positions]
+            )
+            for owner, positions in by_owner.items()
+        }
+        out: List[Any] = [None] * len(keys)
+        for owner, positions in by_owner.items():
+            vals = ray_tpu.get(refs[owner], timeout=timeout)
+            for p, v in zip(positions, vals):
+                out[p] = v
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        per = ray_tpu.get([a.stats.remote() for a in self._actors])
+        return {
+            "num_partitions": len(self._actors),
+            "total_rows": sum(s["rows"] for s in per),
+            "partition_rows": [s["rows"] for s in per],
+        }
+
+    def destroy(self):
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

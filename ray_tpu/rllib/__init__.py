@@ -6,6 +6,7 @@ from .env_runner import EnvRunner  # noqa: F401
 from .policy import MLPPolicy  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
+from .sac import SAC, SACConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .replay_buffers import (  # noqa: F401
     PrioritizedReplayBuffer,

@@ -94,7 +94,16 @@ class Algorithm:
         creator = config.env_creator()
         probe = creator()
         obs_dim = int(np.prod(probe.observation_space.shape))
-        num_actions = int(probe.action_space.n)
+        space = probe.action_space
+        if hasattr(space, "n"):        # Discrete
+            num_actions = int(space.n)
+            self._continuous = False
+            self._action_low = self._action_high = None
+        else:                          # Box (continuous control)
+            num_actions = int(np.prod(space.shape))
+            self._continuous = True
+            self._action_low = np.asarray(space.low, dtype=np.float32)
+            self._action_high = np.asarray(space.high, dtype=np.float32)
         probe.close() if hasattr(probe, "close") else None
         self._obs_dim, self._num_actions = obs_dim, num_actions
 
@@ -111,9 +120,20 @@ class Algorithm:
         ]
         self.learner = self._build_learner(policy_factory())
 
+    def _require_discrete(self):
+        """Guard for discrete-only algorithms: a Box action space must
+        fail fast, not silently train a categorical policy over
+        np.prod(shape) pseudo-actions."""
+        if getattr(self, "_continuous", False):
+            raise ValueError(
+                f"{type(self).__name__} supports discrete action spaces "
+                f"only; use SAC for continuous control"
+            )
+
     def _make_policy_factory(self, obs_dim: int, num_actions: int):
         from .policy import MLPPolicy
 
+        self._require_discrete()
         config = self.config
 
         def policy_factory(obs_dim=obs_dim, num_actions=num_actions,

@@ -122,6 +122,7 @@ class DQNLearner:
 
 class DQN(Algorithm):
     def _make_policy_factory(self, obs_dim: int, num_actions: int):
+        self._require_discrete()
         from .policy import QPolicy
 
         config = self.config

@@ -110,3 +110,40 @@ class QPolicy:
         # (action, logp, value) signature shared with MLPPolicy so runners
         # are interchangeable; Q-learning has no logp/value at sample time.
         return action, 0.0, 0.0
+
+
+class SquashedGaussianPolicy:
+    """Continuous-control actor: tanh-squashed Gaussian over a Box action
+    space, numpy inference for rollouts (ref analogue: the SAC policy's
+    SquashedGaussian action distribution)."""
+
+    def __init__(self, obs_dim: int, act_dim: int, low, high,
+                 hidden: int = 64, seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.low = np.asarray(low, dtype=np.float32)
+        self.high = np.asarray(high, dtype=np.float32)
+        self.weights: Dict[str, List] = {
+            "trunk": init_mlp_params(rng, [obs_dim, hidden, hidden]),
+            "mu": init_mlp_params(rng, [hidden, act_dim]),
+            "log_std": init_mlp_params(rng, [hidden, act_dim]),
+        }
+
+    def set_weights(self, weights):
+        self.weights = weights
+
+    def get_weights(self):
+        return self.weights
+
+    def compute_action(self, obs: np.ndarray, rng: np.random.RandomState):
+        h = obs.reshape(-1)  # flatten multi-dim Box observations
+        for W, b in self.weights["trunk"]:
+            h = np.tanh(h @ W + b)
+        (Wm, bm), = self.weights["mu"]
+        (Ws, bs), = self.weights["log_std"]
+        mu = h @ Wm + bm
+        log_std = np.clip(h @ Ws + bs, -5.0, 2.0)
+        u = np.tanh(mu + np.exp(log_std) * rng.randn(self.act_dim))
+        action = self.low + (u + 1.0) * 0.5 * (self.high - self.low)
+        return action.astype(np.float32), 0.0, 0.0

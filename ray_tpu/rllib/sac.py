@@ -1,0 +1,268 @@
+"""SAC: off-policy maximum-entropy actor-critic for continuous control.
+
+Ref analogue: rllib/algorithms/sac/ (sac.py + sac_torch_policy.py) —
+twin Q networks with polyak-averaged targets, a tanh-squashed Gaussian
+actor, and automatic temperature tuning against a target entropy
+(Haarnoja 2018). Sampling stays on CPU EnvRunner actors; the learner is
+one fused jax update (both critics, the actor, and alpha in a single
+jitted step on the accelerator).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env_runner import NEXT_OBS, TransitionEnvRunner
+from .replay_buffers import ReplayBuffer
+from .sample_batch import ACTIONS, DONES, OBS, REWARDS, SampleBatch
+
+_LOG_STD_MIN, _LOG_STD_MAX = -5.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.buffer_size: int = 100_000
+        self.num_steps_sampled_before_learning_starts: int = 500
+        self.num_updates_per_iteration: int = 64
+        self.tau: float = 0.005          # polyak coefficient
+        self.initial_alpha: float = 0.2
+        self.target_entropy: float | None = None  # default: -act_dim
+
+    def build(self) -> "SAC":
+        return SAC(self.copy())
+
+
+def _mlp_init(rng, sizes):
+    import jax
+    import jax.numpy as jnp
+
+    from .policy import init_mlp_params
+
+    return jax.tree.map(jnp.asarray, init_mlp_params(rng, sizes))
+
+
+class SACLearner:
+    """One jitted step: critic TD update against the entropy-regularized
+    target, actor update through the reparameterized sample, temperature
+    update toward the target entropy, polyak target sync."""
+
+    def __init__(self, policy, cfg, obs_dim: int, act_dim: int,
+                 low: np.ndarray, high: np.ndarray):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        hidden = cfg.hidden_size
+        rng = np.random.RandomState(cfg.seed + 1)
+        self._low = jnp.asarray(low)
+        self._high = jnp.asarray(high)
+        target_entropy = (cfg.target_entropy
+                          if cfg.target_entropy is not None
+                          else -float(act_dim))
+
+        def make_q():
+            return {"trunk": _mlp_init(rng, [obs_dim + act_dim,
+                                             hidden, hidden]),
+                    "q": _mlp_init(rng, [hidden, 1])}
+
+        actor = jax.tree.map(jnp.asarray, policy.get_weights())
+        self._params = {
+            "actor": actor,
+            "q1": make_q(),
+            "q2": make_q(),
+            "log_alpha": jnp.asarray(
+                np.log(cfg.initial_alpha), dtype=jnp.float32
+            ),
+        }
+        # jnp leaves are immutable; sharing them is a correct "copy".
+        self._target = {"q1": self._params["q1"],
+                        "q2": self._params["q2"]}
+        self._tx = optax.adam(cfg.lr)
+        self._opt_state = self._tx.init(self._params)
+        tau = cfg.tau
+        gamma = cfg.gamma
+
+        def mlp(params, x):
+            for W, b in params:
+                x = jnp.tanh(x @ W + b)
+            return x
+
+        def q_val(qp, obs, act):
+            h = mlp(qp["trunk"], jnp.concatenate([obs, act], axis=-1))
+            (W, b), = qp["q"]
+            return (h @ W + b)[..., 0]
+
+        def actor_sample(ap, obs, eps):
+            h = mlp(ap["trunk"], obs)
+            (Wm, bm), = ap["mu"]
+            (Ws, bs), = ap["log_std"]
+            mu = h @ Wm + bm
+            log_std = jnp.clip(h @ Ws + bs, _LOG_STD_MIN, _LOG_STD_MAX)
+            std = jnp.exp(log_std)
+            pre = mu + std * eps
+            u = jnp.tanh(pre)
+            # Gaussian logp + tanh change-of-variables correction.
+            logp = (
+                -0.5 * (((pre - mu) / std) ** 2
+                        + 2 * log_std + np.log(2 * np.pi))
+            ).sum(-1)
+            logp -= (2 * (np.log(2.0) - pre
+                          - jax.nn.softplus(-2 * pre))).sum(-1)
+            return u, logp
+
+        def to_env(u):
+            return self._low + (u + 1.0) * 0.5 * (self._high - self._low)
+
+        def from_env(a):
+            u = (a - self._low) / (self._high - self._low) * 2.0 - 1.0
+            return jnp.clip(u, -0.999, 0.999)
+
+        def losses(params, target, obs, act_env, rew, done, nxt,
+                   eps1, eps2):
+            alpha = jnp.exp(params["log_alpha"])
+            act = from_env(act_env)
+            # Critic target: r + gamma (min target Q - alpha logp).
+            u2, logp2 = actor_sample(params["actor"], nxt, eps2)
+            tq = jnp.minimum(
+                q_val(target["q1"], nxt, u2),
+                q_val(target["q2"], nxt, u2),
+            ) - jax.lax.stop_gradient(alpha) * logp2
+            backup = jax.lax.stop_gradient(
+                rew + gamma * (1.0 - done) * tq
+            )
+            q1 = q_val(params["q1"], obs, act)
+            q2 = q_val(params["q2"], obs, act)
+            critic_loss = ((q1 - backup) ** 2 + (q2 - backup) ** 2).mean()
+            # Actor: maximize min Q of the reparameterized action.
+            u, logp = actor_sample(params["actor"], obs, eps1)
+            q_pi = jnp.minimum(
+                q_val(jax.lax.stop_gradient(params["q1"]), obs, u),
+                q_val(jax.lax.stop_gradient(params["q2"]), obs, u),
+            )
+            actor_loss = (jax.lax.stop_gradient(alpha) * logp
+                          - q_pi).mean()
+            # Temperature toward the target entropy.
+            alpha_loss = -(params["log_alpha"] * jax.lax.stop_gradient(
+                logp + target_entropy
+            )).mean()
+            total = critic_loss + actor_loss + alpha_loss
+            return total, (critic_loss, actor_loss, alpha)
+
+        def update(params, opt_state, target, obs, act, rew, done, nxt,
+                   eps1, eps2):
+            (loss, aux), grads = jax.value_and_grad(
+                losses, has_aux=True
+            )(params, target, obs, act, rew, done, nxt, eps1, eps2)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            target = jax.tree.map(
+                lambda t, p: (1 - tau) * t + tau * p,
+                target, {"q1": params["q1"], "q2": params["q2"]},
+            )
+            return params, opt_state, target, loss, aux
+
+        self._update = jax.jit(update)
+        self._rng = np.random.RandomState(cfg.seed + 2)
+        self._act_dim = act_dim
+
+    def update(self, batch: SampleBatch) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        n = batch.count
+        eps1 = jnp.asarray(
+            self._rng.randn(n, self._act_dim).astype(np.float32))
+        eps2 = jnp.asarray(
+            self._rng.randn(n, self._act_dim).astype(np.float32))
+        (self._params, self._opt_state, self._target, loss,
+         (critic_loss, actor_loss, alpha)) = self._update(
+            self._params, self._opt_state, self._target,
+            jnp.asarray(batch[OBS]),
+            jnp.asarray(batch[ACTIONS], dtype=jnp.float32),
+            jnp.asarray(batch[REWARDS]),
+            jnp.asarray(batch[DONES], dtype=jnp.float32),
+            jnp.asarray(batch[NEXT_OBS]),
+            eps1, eps2,
+        )
+        return {
+            "loss": float(loss),
+            "critic_loss": float(critic_loss),
+            "actor_loss": float(actor_loss),
+            "alpha": float(alpha),
+        }
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params["actor"])
+
+
+class _SACEnvRunner(TransitionEnvRunner):
+    """Transition collection with a stochastic policy (no epsilon)."""
+
+
+class SAC(Algorithm):
+    def _make_policy_factory(self, obs_dim: int, act_dim: int):
+        from .policy import SquashedGaussianPolicy
+
+        config = self.config
+        low, high = self._action_low, self._action_high
+
+        def policy_factory(obs_dim=obs_dim, act_dim=act_dim,
+                           hidden=config.hidden_size, seed=config.seed):
+            return SquashedGaussianPolicy(
+                obs_dim, act_dim, low, high, hidden, seed
+            )
+
+        return policy_factory
+
+    def _runner_class(self):
+        return _SACEnvRunner
+
+    def _build_learner(self, policy):
+        c = self.config
+        self.buffer = ReplayBuffer(c.buffer_size, seed=c.seed)
+        self._env_steps = 0
+        return SACLearner(policy, c, self._obs_dim, self._num_actions,
+                          self._action_low, self._action_high)
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        batches: List[SampleBatch] = ray_tpu.get(
+            [r.sample.remote() for r in self.runners]
+        )
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += b.count
+
+        stats: Dict[str, Any] = {}
+        num_updates = 0
+        if self._env_steps >= c.num_steps_sampled_before_learning_starts:
+            for _ in range(c.num_updates_per_iteration):
+                mb = self.buffer.sample(c.minibatch_size)
+                stats = self.learner.update(mb)
+                num_updates += 1
+            weights = self.learner.get_weights()
+            ray_tpu.get(
+                [r.set_weights.remote(weights) for r in self.runners]
+            )
+
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": self._env_steps,
+            "num_learner_updates": num_updates,
+            "buffer_size": len(self.buffer),
+            **stats,
+        }

@@ -380,3 +380,23 @@ def test_per_operator_stats(ray_tpu_start):
     assert "MapBatches" in report and "FilterRows" in report
     assert "250 rows" in report and "blocks" in report
     assert "Total wall" in report and "bytes" in report
+
+
+def test_random_access_dataset(ray_tpu_start):
+    """to_random_access: range-partitioned actor pool with point lookups
+    and batched multiget (ref: random_access_dataset.py)."""
+    ds = rd.from_items(
+        [{"id": i, "val": i * 10} for i in range(100)],
+        override_num_blocks=4,
+    )
+    ra = ds.to_random_access("id", num_workers=3)
+    try:
+        assert ra.get(42) == {"id": 42, "val": 420}
+        assert ra.get(-5) is None
+        got = ra.multiget([7, 99, 0, 1000, 55])
+        assert [g["val"] if g else None for g in got] == \
+            [70, 990, 0, None, 550]
+        st = ra.stats()
+        assert st["total_rows"] == 100 and st["num_partitions"] == 3
+    finally:
+        ra.destroy()

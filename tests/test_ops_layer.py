@@ -335,3 +335,31 @@ json.dump(trace, open(sys.argv[1], "w"))
     # tree: submit -> parent exec -> child exec
     assert parent["args"]["parent_id"] == submit["args"]["span_id"]
     assert kid["args"]["parent_id"] == parent["args"]["span_id"]
+
+
+def test_profile_endpoint(ray_tpu_start):
+    """/api/profile samples all control-plane threads on demand (ref:
+    dashboard reporter profile_manager.py)."""
+    import urllib.request
+
+    from ray_tpu import dashboard
+
+    port = dashboard.start_dashboard(port=0)
+    try:
+        @ray_tpu.remote
+        def spin(n):
+            return sum(range(n))
+
+        refs = [spin.remote(200_000) for _ in range(50)]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/profile?seconds=1&hz=50",
+                timeout=60) as r:
+            prof = json.loads(r.read())
+        ray_tpu.get(refs, timeout=60)
+        assert prof["samples"] > 10
+        assert prof["stacks"], "no stacks sampled"
+        # the node-manager loop thread must appear
+        assert any(k.startswith("ray_tpu-node-manager")
+                   for k in prof["stacks"])
+    finally:
+        dashboard.stop_dashboard()
