@@ -58,6 +58,20 @@ def init(
     reset_config()
     config = get_config()
     config.apply_overrides(system_config)
+    if address and address.startswith("rtpu://"):
+        # Thin-client mode (ref: ray.init("ray://...") via util/client):
+        # no local node; one TCP connection to the head.
+        from .client import connect
+
+        rt = connect(address)
+        runtime_context.set_runtime(rt)
+        if runtime_env:
+            from . import runtime_env as renv_mod
+
+            rt.runtime_env_key = renv_mod.publish(
+                runtime_env, rt.kv_put, rt.job_id.hex()
+            )
+        return rt
     if object_store_memory is not None:
         config.object_store_memory = object_store_memory
 

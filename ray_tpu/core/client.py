@@ -1,0 +1,206 @@
+"""Ray-Client-style remote driver: ``ray_tpu.init("rtpu://host:port")``.
+
+Ref analogue: python/ray/util/client/ (client worker.py <-> the head's
+proxier/server translating to the real core API; ARCHITECTURE.md). The
+thin client runs NO local node: it discovers the head through the GCS,
+opens one framed TCP connection to the head node manager's peer port,
+and speaks the SAME duplex worker protocol a local worker uses (submit /
+get_locations / wait / kv / refcounts ...). Two extra RPCs cover what a
+remote process cannot do locally: ``fetch_object`` (object bytes come
+over the wire instead of shared memory) and ``put_bytes`` (puts land in
+the head's store). TLS and the session token apply exactly as for
+node-to-node traffic.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional, Tuple
+
+from .config import get_config
+from .ids import JobID, NodeID, WorkerID
+from .object_store import InlineLocation, Location
+from .protocol import Connection, ConnectionClosed
+from .runtime import WorkerRuntime
+from .serialization import deserialize, serialize
+
+
+def _tls_socket(host: str, port: int) -> socket.socket:
+    from .tls import client_ssl_context
+
+    sock = socket.create_connection((host, port), timeout=30)
+    ctx = client_ssl_context()
+    if ctx is not None:
+        sock = ctx.wrap_socket(sock)
+    return sock
+
+
+def _discover_head(host: str, port: int) -> Tuple[str, int]:
+    """Ask the GCS for the head node's peer address."""
+    conn = Connection(_tls_socket(host, port))
+    try:
+        conn.send({
+            "type": "gcs_hello",
+            "node_id": NodeID.from_random().hex(),
+            "token": get_config().session_token,
+        })
+        welcome = conn.recv()
+        if welcome.get("type") != "gcs_welcome":
+            raise ConnectionError(
+                f"GCS refused client: {welcome.get('error')}"
+            )
+        conn.send({"op": "get_nodes", "msg_id": 1})
+        while True:
+            msg = conn.recv()
+            if msg.get("msg_id") == 1:
+                break
+        heads = [n for n in msg["nodes"]
+                 if n.get("is_head") and n.get("state") == "alive"]
+        if not heads:
+            raise ConnectionError("cluster has no alive head node")
+        return heads[0]["host"], int(heads[0]["peer_port"])
+    finally:
+        conn.close()
+
+
+class ClientRuntime(WorkerRuntime):
+    """WorkerRuntime over TCP with remote object IO (no local store)."""
+
+    is_client = True
+
+    def __init__(self, conn: Connection, node_id: NodeID,
+                 worker_id: WorkerID):
+        super().__init__(
+            conn,
+            job_id=JobID.from_random(),
+            node_id=node_id,
+            worker_id=worker_id,
+        )
+        self._alive = True
+        self._reader = threading.Thread(
+            target=self._reader_loop, name="rtpu-client-reader", daemon=True
+        )
+        self._reader.start()
+
+    def _reader_loop(self):
+        while self._alive:
+            try:
+                msg = self._conn.recv()
+            except (ConnectionClosed, OSError):
+                break
+            if msg.get("type") == "reply":
+                self.handle_reply(msg)
+            # execute frames never arrive: the server registers clients
+            # outside the schedulable worker pool.
+
+    # ---- remote object IO --------------------------------------------------
+    # Both directions ride the head's chunked transfer plane (5 MiB
+    # frames, server-side admission) — the same protocol nodes use, so a
+    # multi-GB get/put neither exceeds the frame cap nor stalls the
+    # head's loop on one giant pickle.
+
+    def _put_serialized(self, oid, sobj) -> Location:
+        data = sobj.to_bytes()
+        chunk = get_config().object_transfer_chunk_bytes
+        reply = self.request(
+            {"type": "put_begin", "object_id": oid, "size": len(data)}
+        )
+        if not reply.get("ok"):
+            raise RuntimeError(f"client put failed: {reply.get('error')}")
+        try:
+            for off in range(0, len(data), chunk):
+                reply = self.request(
+                    {"type": "put_chunk", "object_id": oid,
+                     "offset": off, "data": data[off:off + chunk]}
+                )
+                if not reply.get("ok"):
+                    raise RuntimeError(
+                        f"client put failed: {reply.get('error')}"
+                    )
+        except Exception:
+            # The server aborts open writers when the connection drops;
+            # for an in-band failure just surface it (no put_end).
+            raise
+        reply = self.request({"type": "put_end", "object_id": oid})
+        if reply.get("loc") is None:
+            raise RuntimeError(f"client put failed: {reply.get('error')}")
+        return reply["loc"]
+
+    def _store_value(self, oid, value) -> Location:
+        sobj = serialize(value)
+        if sobj.total_size <= get_config().max_inline_object_size:
+            return InlineLocation(sobj.to_bytes())
+        return self._put_serialized(oid, sobj)
+
+    def _fetch_once(self, oid, timeout):
+        chunk = get_config().object_transfer_chunk_bytes
+        reply = self.request(
+            {"type": "pull_object", "object_id": oid,
+             "max_unchunked": chunk},
+            timeout=timeout,
+        )
+        data = reply.get("data")
+        if data is not None:
+            return data
+        if not reply.get("chunked") or reply.get("size") is None:
+            return None
+        size = int(reply["size"])
+        parts = []
+        for off in range(0, size, chunk):
+            r = self.request(
+                {"type": "pull_chunk", "object_id": oid, "offset": off,
+                 "length": min(chunk, size - off)},
+                timeout=timeout,
+            )
+            if r.get("data") is None:
+                return None
+            parts.append(r["data"])
+        return b"".join(parts)
+
+    def _read_object(self, oid, loc, timeout):
+        if isinstance(loc, InlineLocation):
+            return deserialize(memoryview(loc.data))
+        # Retry through fresh locations like the worker path: the object
+        # may spill/move between resolution and the fetch.
+        for _ in range(5):
+            data = self._fetch_once(oid, timeout)
+            if data is not None:
+                return deserialize(memoryview(data))
+            (_, loc), = self._get_locations([oid], timeout)
+            if loc is None:
+                break
+            if isinstance(loc, InlineLocation):
+                return deserialize(memoryview(loc.data))
+        from .exceptions import ObjectLostError
+
+        raise ObjectLostError(
+            f"object {oid.hex()} unavailable to the client"
+        )
+
+    def shutdown(self):
+        self._alive = False
+        super().shutdown()
+        try:
+            self.refs.flush()
+        except Exception:
+            pass
+        self._conn.close()
+
+
+def connect(address: str) -> ClientRuntime:
+    """``address``: "rtpu://host:gcs_port"."""
+    hostport = address[len("rtpu://"):]
+    host, port_s = hostport.rsplit(":", 1)
+    peer_host, peer_port = _discover_head(host, int(port_s))
+    conn = Connection(_tls_socket(peer_host, peer_port))
+    conn.send({
+        "type": "client_hello",
+        "token": get_config().session_token,
+    })
+    wid = WorkerID.from_random()
+    conn.send({"type": "register", "worker_id": wid.hex()})
+    ack = conn.recv()
+    if ack.get("type") != "registered":
+        raise ConnectionError(f"head refused client: {ack}")
+    return ClientRuntime(conn, NodeID.from_hex(ack["node_id"]), wid)

@@ -90,6 +90,12 @@ class Config:
     gcs_storage_path: str = ""
     # Bind/advertise IP for this node (ref: --node-ip-address).
     node_ip: str = "127.0.0.1"
+    # Mutual TLS for GCS/peer TCP channels: set ALL THREE to enable
+    # (ref: RAY_USE_TLS + TLS_SERVER_CERT/KEY/CA_CERT in tls_utils.py).
+    # Env overrides: RAY_TPU_TLS_CERT_PATH / _KEY_PATH / _CA_PATH.
+    tls_cert_path: str = ""
+    tls_key_path: str = ""
+    tls_ca_path: str = ""
     # Shared secret gating GCS/peer TCP connections (hello frames must
     # carry it when set; set RAY_TPU_SESSION_TOKEN on every node). The
     # cross-host framing is pickle: never expose node_ip beyond a trusted

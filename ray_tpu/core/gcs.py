@@ -112,8 +112,11 @@ class GcsService:
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         if self._storage_path:
             self._restore_snapshot()
+        from .tls import server_ssl_context
+
         self._server = await asyncio.start_server(
-            self._handle_connection, host=host, port=port
+            self._handle_connection, host=host, port=port,
+            ssl=server_ssl_context(),
         )
         sock = self._server.sockets[0]
         self.address = sock.getsockname()[:2]
@@ -812,7 +815,11 @@ class GcsClient:
         self.closed = False
 
     async def connect(self):
-        reader, writer = await asyncio.open_connection(self.host, self.port)
+        from .tls import client_ssl_context
+
+        reader, writer = await asyncio.open_connection(
+            self.host, self.port, ssl=client_ssl_context()
+        )
         self._writer = _FramedWriter(writer)
         await self._writer.send(
             {"type": "gcs_hello", "node_id": self.node_id.hex(),

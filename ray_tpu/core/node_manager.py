@@ -172,6 +172,9 @@ class WorkerHandle:
     known_functions: Set[str] = field(default_factory=set)
     actor_id: Optional[ActorID] = None
     last_active: float = field(default_factory=time.monotonic)
+    # Open chunked-put writers from a thin-client connection, keyed by
+    # object id; aborted if the client dies mid-put.
+    client_writers: Dict[ObjectID, Any] = field(default_factory=dict)
     # Execute frames coalesced within one loop iteration and flushed as a
     # single socket write: on a contended host every write wakes the
     # worker process and the kernel's wakeup preemption turns per-frame
@@ -403,8 +406,11 @@ class NodeManager:
             self._handle_connection, path=self.socket_path
         )
         # Peer channel for node<->node traffic (spillback + object pulls).
+        from .tls import server_ssl_context
+
         self._peer_server = await asyncio.start_server(
-            self._handle_peer_connection, host=self.node_ip, port=0
+            self._handle_peer_connection, host=self.node_ip, port=0,
+            ssl=server_ssl_context(),
         )
         self.peer_port = self._peer_server.sockets[0].getsockname()[1]
         if self.is_head:
@@ -863,6 +869,58 @@ class NodeManager:
             await w.writer.send(
                 {"type": "reply", "msg_id": msg["msg_id"], "state": state}
             )
+        elif mtype == "pull_object":
+            # Client-mode read rides the SAME chunked, admission-
+            # controlled transfer plane nodes use (small objects answer
+            # inline; large ones advertise chunking — no multi-GB frames,
+            # no event-loop-sized pickles).
+            reply = await self._transfer.serve_pull(msg)
+            reply.update({"type": "reply", "msg_id": msg["msg_id"]})
+            await w.writer.send(reply)
+        elif mtype == "pull_chunk":
+            reply = await self._transfer.serve_chunk(msg)
+            reply.update({"type": "reply", "msg_id": msg["msg_id"]})
+            await w.writer.send(reply)
+        elif mtype == "put_begin":
+            # Client-mode put: a chunked writer into THIS node's store.
+            try:
+                writer = await self._loop.run_in_executor(
+                    None, self.local_store.create_writer,
+                    msg["object_id"], int(msg["size"]),
+                )
+                w.client_writers[msg["object_id"]] = writer
+                reply = {"ok": True}
+            except Exception as e:  # noqa: BLE001
+                reply = {"ok": False, "error": str(e)}
+            reply.update({"type": "reply", "msg_id": msg["msg_id"]})
+            await w.writer.send(reply)
+        elif mtype == "put_chunk":
+            writer = w.client_writers.get(msg["object_id"])
+            try:
+                if writer is None:
+                    raise RuntimeError("no open writer (put_begin missing)")
+                await self._loop.run_in_executor(
+                    None, writer.write, int(msg["offset"]), msg["data"]
+                )
+                reply = {"ok": True}
+            except Exception as e:  # noqa: BLE001
+                reply = {"ok": False, "error": str(e)}
+            reply.update({"type": "reply", "msg_id": msg["msg_id"]})
+            await w.writer.send(reply)
+        elif mtype == "put_end":
+            writer = w.client_writers.pop(msg["object_id"], None)
+            try:
+                if writer is None:
+                    raise RuntimeError("no open writer (put_begin missing)")
+                loc = await self._loop.run_in_executor(
+                    None, writer.finalize
+                )
+                await self.put_object(msg["object_id"], loc, refs=0)
+                reply = {"loc": loc}
+            except Exception as e:  # noqa: BLE001
+                reply = {"loc": None, "error": str(e)}
+            reply.update({"type": "reply", "msg_id": msg["msg_id"]})
+            await w.writer.send(reply)
         elif mtype == "ping":
             await w.writer.send({"type": "reply", "msg_id": msg["msg_id"]})
         else:
@@ -874,10 +932,18 @@ class NodeManager:
         prev_state = w.state
         w.state = "dead"
         self._workers.pop(w.worker_id, None)
-        try:
-            self._idle[w.worker_type].remove(w.worker_id)
-        except ValueError:
-            pass
+        for writer in w.client_writers.values():
+            try:
+                writer.abort()  # client died mid-put: free the block
+            except Exception:
+                pass
+        w.client_writers.clear()
+        pool = self._idle.get(w.worker_type)
+        if pool is not None:  # "client" handles have no idle pool
+            try:
+                pool.remove(w.worker_id)
+            except ValueError:
+                pass
         if w.actor_id is not None:
             await self._on_actor_worker_death(w)
         elif w.current is not None or w.pending:
@@ -937,11 +1003,17 @@ class NodeManager:
         peer_hex = None
         try:
             hello = await aio_read_frame(reader)
-            if hello.get("type") != "peer_hello":
-                framed.close()
-                return
             expected = self.config.session_token
             if expected and hello.get("token") != expected:
+                framed.close()
+                return
+            if hello.get("type") == "client_hello":
+                # Remote thin driver (ref: util/client proxier): serve
+                # the worker protocol over this TCP connection; the
+                # handle stays OUT of the schedulable pools.
+                await self._serve_client(reader, framed)
+                return
+            if hello.get("type") != "peer_hello":
                 framed.close()
                 return
             peer_hex = hello["node_id"]
@@ -955,6 +1027,30 @@ class NodeManager:
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
         finally:
+            framed.close()
+
+    async def _serve_client(self, reader, framed):
+        handle: Optional[WorkerHandle] = None
+        try:
+            msg = await aio_read_frame(reader)
+            if msg.get("type") != "register":
+                return
+            handle = WorkerHandle(
+                worker_id=WorkerID.from_hex(msg["worker_id"]),
+                writer=framed, worker_type="client", state="client",
+            )
+            await framed.send(
+                {"type": "registered", "node_id": self.node_id.hex()}
+            )
+            while True:
+                msg = await aio_read_frame(reader)
+                await self._dispatch_message(handle, msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                OSError):
+            pass
+        finally:
+            if handle is not None:
+                await self._on_worker_death(handle)
             framed.close()
 
     async def _dispatch_peer(

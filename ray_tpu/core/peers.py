@@ -38,7 +38,11 @@ class PeerClient:
     async def connect(self):
         from .config import get_config
 
-        reader, writer = await asyncio.open_connection(self.host, self.port)
+        from .tls import client_ssl_context
+
+        reader, writer = await asyncio.open_connection(
+            self.host, self.port, ssl=client_ssl_context()
+        )
         self._writer = _FramedWriter(writer)
         await self._writer.send(
             {"type": "peer_hello", "node_id": self.self_hex,

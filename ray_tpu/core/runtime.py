@@ -154,6 +154,11 @@ class BaseRuntime:
         sobj = serialize(value)
         if sobj.total_size <= get_config().max_inline_object_size:
             return InlineLocation(sobj.to_bytes())
+        return self._put_serialized(oid, sobj)
+
+    def _put_serialized(self, oid: ObjectID, sobj) -> Location:
+        """Large-object write path; the thin client overrides this to
+        ship bytes to the head (its local shm is invisible there)."""
         return self.store.put_serialized(oid, sobj)
 
     def get(self, refs, timeout: Optional[float] = None):
@@ -279,7 +284,7 @@ class BaseRuntime:
             if sobj.total_size <= cfg.max_inline_object_size:
                 return ValueArg(sobj.to_bytes())
             oid = self._next_put_id()
-            loc = self.store.put_serialized(oid, sobj)
+            loc = self._put_serialized(oid, sobj)
             self._register_put(oid, loc)
             ref = ObjectRef(oid, _register=True)
             keepalive.append(ref)

@@ -1,0 +1,51 @@
+"""Mutual TLS for the cluster's TCP channels (GCS + peer plane).
+
+Ref analogue: RAY_USE_TLS + TLS_{SERVER_CERT,SERVER_KEY,CA_CERT} wired
+through _private/tls_utils.py onto every gRPC channel
+(src/ray/rpc/grpc_server.h). Here: when ``tls_cert_path``,
+``tls_key_path`` and ``tls_ca_path`` are all configured (or the
+RAY_TPU_TLS_* env vars are set), every GCS and node↔node peer
+connection runs over mutual TLS — servers require client certificates
+signed by the CA, clients verify the server against the same CA.
+Hostname checking is disabled (cluster nodes are addressed by IP; trust
+is CA pinning + client certs, the reference's model). The session-token
+handshake still applies on top.
+
+The pickle framing remains: TLS authenticates peers, it does not make
+pickle safe against a trusted-but-compromised node. Keep cluster
+networks private either way.
+"""
+
+from __future__ import annotations
+
+import ssl
+from typing import Optional
+
+from .config import get_config
+
+
+def tls_enabled() -> bool:
+    cfg = get_config()
+    return bool(cfg.tls_cert_path and cfg.tls_key_path and cfg.tls_ca_path)
+
+
+def server_ssl_context() -> Optional[ssl.SSLContext]:
+    if not tls_enabled():
+        return None
+    cfg = get_config()
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cfg.tls_cert_path, cfg.tls_key_path)
+    ctx.load_verify_locations(cfg.tls_ca_path)
+    ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
+    return ctx
+
+
+def client_ssl_context() -> Optional[ssl.SSLContext]:
+    if not tls_enabled():
+        return None
+    cfg = get_config()
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_cert_chain(cfg.tls_cert_path, cfg.tls_key_path)
+    ctx.load_verify_locations(cfg.tls_ca_path)
+    ctx.check_hostname = False  # nodes are addressed by IP; CA-pinned
+    return ctx

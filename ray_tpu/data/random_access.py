@@ -68,11 +68,13 @@ class RandomAccessDataset:
         self._key = key
         self._n = n
         splitter = ray_tpu.remote(num_returns=n)(_split_block)
-        block_refs = dataset.materialize()._sources
-        # Each source thunk resolves to a block ref; split remotely.
+        from .streaming_executor import execute_refs
+
+        # Block REFS go straight into the splitter tasks — the rows
+        # travel store-to-worker, never through the driver.
         bucket_refs: List[List[Any]] = []  # [block][partition]
-        for src in block_refs:
-            out = splitter.remote(src(), key, n)
+        for item in execute_refs(dataset._sources, dataset._stages):
+            out = splitter.remote(item, key, n)
             bucket_refs.append([out] if n == 1 else list(out))
         server = ray_tpu.remote(_PartitionServer)
         self._actors = [

@@ -115,9 +115,6 @@ class SACLearner:
                           - jax.nn.softplus(-2 * pre))).sum(-1)
             return u, logp
 
-        def to_env(u):
-            return self._low + (u + 1.0) * 0.5 * (self._high - self._low)
-
         def from_env(a):
             u = (a - self._low) / (self._high - self._low) * 2.0 - 1.0
             return jnp.clip(u, -0.999, 0.999)
@@ -209,6 +206,11 @@ class SAC(Algorithm):
     def _make_policy_factory(self, obs_dim: int, act_dim: int):
         from .policy import SquashedGaussianPolicy
 
+        if not getattr(self, "_continuous", False):
+            raise ValueError(
+                "SAC supports Box (continuous) action spaces only; use "
+                "PPO/DQN/IMPALA for discrete envs"
+            )
         config = self.config
         low, high = self._action_low, self._action_high
 
