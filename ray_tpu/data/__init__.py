@@ -20,4 +20,5 @@ from .read_api import (  # noqa: F401
     read_parquet,
     read_sql,
     read_tfrecords,
+    read_webdataset,
 )

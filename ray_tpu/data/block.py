@@ -47,15 +47,39 @@ def from_numpy_dict(data: Dict[str, np.ndarray]) -> Block:
 def from_rows(rows: List[Dict[str, Any]]) -> Block:
     if not rows:
         return pa.table({})
-    cols: Dict[str, list] = {k: [] for k in rows[0]}
+    # Column set = UNION of all rows' keys (rows[0] alone silently drops
+    # fields absent from the first row); absent values become nulls.
+    names: List[str] = []
     for r in rows:
-        for k in cols:
+        for k in r:
+            if k not in names:
+                names.append(k)
+    cols: Dict[str, list] = {k: [] for k in names}
+    for r in rows:
+        for k in names:
             cols[k].append(r.get(k))
+    arrays: Dict[str, Any] = {}
     np_cols = {}
     for k, v in cols.items():
-        arr = np.asarray(v)
-        np_cols[k] = arr
-    return from_numpy_dict(np_cols)
+        if any(isinstance(x, (bytes, bytearray)) for x in v):
+            # Keep bytes as arrow binary: numpy's |S coercion strips
+            # trailing NUL bytes (silent payload corruption).
+            arrays[k] = pa.array(
+                [None if x is None else bytes(x) for x in v],
+                type=pa.binary(),
+            )
+            continue
+        try:
+            np_cols[k] = np.asarray(v)
+        except Exception:
+            arrays[k] = pa.array(v)
+    if not arrays:
+        return from_numpy_dict(np_cols)
+    table = from_numpy_dict(np_cols) if np_cols else pa.table({})
+    for k, arr in arrays.items():
+        table = table.append_column(k, arr)
+    # Preserve the caller's column order.
+    return table.select([n for n in names if n in table.schema.names])
 
 
 class BlockAccessor:

@@ -481,6 +481,12 @@ class Dataset:
 
         return write_blocks(self, path, "tfrecords", **kw)
 
+    def write_webdataset(self, path: str, **kw) -> List[str]:
+        """One WebDataset tar shard per block (ref: write_webdataset)."""
+        from .datasink import write_blocks
+
+        return write_blocks(self, path, "webdataset", **kw)
+
     def write_numpy(self, path: str, *, column: str = "data") -> List[str]:
         from .datasink import write_blocks
 

@@ -46,6 +46,14 @@ def _write_block(block, path: str, fmt: str, index: int,
             np.save(fname, next(iter(cols.values())))
         else:
             np.savez(fname, **cols)
+    elif fmt == "webdataset":
+        from .block import BlockAccessor
+        from .webdataset import write_shard
+
+        fname = fname[:-len(".webdataset")] + ".tar"
+        write_shard(
+            fname, (dict(r) for r in BlockAccessor(block).iter_rows())
+        )
     elif fmt == "tfrecords":
         from .block import BlockAccessor
         from .tfrecords import write_example_file

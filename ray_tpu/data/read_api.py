@@ -199,6 +199,25 @@ def read_sql(sql: str, connection_factory, *,
     return Dataset([make(i, n) for i in builtins.range(n)])
 
 
+def read_webdataset(paths, **kw) -> Dataset:
+    """WebDataset tar shards, one block per shard; samples are rows of
+    {"__key__", <ext>: decoded value} (ref analogue:
+    ray.data.read_webdataset; stdlib-tar codec in data/webdataset.py).
+    Blocks use binary-typed arrow columns and the union of all samples'
+    keys, so ragged payloads and optional fields survive intact."""
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            from .webdataset import read_shard, rows_to_table
+
+            return rows_to_table(read_shard(path))
+
+        return read
+
+    return Dataset([make(p) for p in files])
+
+
 def read_numpy(paths, **kw) -> Dataset:
     files = _expand_paths(paths)
 
