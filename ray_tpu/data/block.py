@@ -63,16 +63,22 @@ def from_rows(rows: List[Dict[str, Any]]) -> Block:
     for k, v in cols.items():
         if any(isinstance(x, (bytes, bytearray)) for x in v):
             # Keep bytes as arrow binary: numpy's |S coercion strips
-            # trailing NUL bytes (silent payload corruption).
+            # trailing NUL bytes (silent payload corruption). Mixed
+            # str values encode (utf-8) rather than crashing.
             arrays[k] = pa.array(
-                [None if x is None else bytes(x) for x in v],
+                [None if x is None
+                 else x.encode() if isinstance(x, str) else bytes(x)
+                 for x in v],
                 type=pa.binary(),
             )
             continue
         try:
             np_cols[k] = np.asarray(v)
+            if np_cols[k].dtype == object:
+                raise TypeError("object dtype: let arrow try")
         except Exception:
-            arrays[k] = pa.array(v)
+            np_cols.pop(k, None)
+            arrays[k] = _build_column(v)
     if not arrays:
         return from_numpy_dict(np_cols)
     table = from_numpy_dict(np_cols) if np_cols else pa.table({})
@@ -80,6 +86,27 @@ def from_rows(rows: List[Dict[str, Any]]) -> Block:
         table = table.append_column(k, arr)
     # Preserve the caller's column order.
     return table.select([n for n in names if n in table.schema.names])
+
+
+def _build_column(values: list) -> "pa.Array":
+    """Robust arrow column: native inference first, then JSON text for
+    nested python values arrow cannot type uniformly, then repr as the
+    last resort — ingest degrades, it never crashes."""
+    import json as _json
+
+    try:
+        return pa.array(values)
+    except (pa.ArrowInvalid, pa.ArrowTypeError, TypeError):
+        pass
+    try:
+        return pa.array(
+            [None if v is None else _json.dumps(v, default=str)
+             for v in values]
+        )
+    except Exception:
+        return pa.array(
+            [None if v is None else repr(v) for v in values]
+        )
 
 
 class BlockAccessor:

@@ -80,34 +80,12 @@ def read_shard(path: str) -> List[Dict[str, Any]]:
 
 
 def rows_to_table(rows: List[Dict[str, Any]]):
-    """Arrow table that PRESERVES webdataset payloads: bytes columns get
-    an explicit binary type (numpy |S coercion strips trailing NULs),
-    the column set is the UNION of every sample's keys (absent fields
-    become nulls, not silent drops), and json values fall back to their
-    JSON text when arrow cannot infer one struct type for the column."""
-    import pyarrow as pa
+    """Arrow table preserving webdataset payloads (delegates to the
+    block layer's from_rows: union of keys, binary-typed bytes columns,
+    JSON-text fallback for values arrow cannot type uniformly)."""
+    from .block import from_rows
 
-    names: List[str] = []
-    for row in rows:
-        for k in row:
-            if k not in names:
-                names.append(k)
-    arrays = {}
-    for name in names:
-        values = [row.get(name) for row in rows]
-        if any(isinstance(v, (bytes, bytearray)) for v in values):
-            arrays[name] = pa.array(
-                [None if v is None else bytes(v) for v in values],
-                type=pa.binary(),
-            )
-            continue
-        try:
-            arrays[name] = pa.array(values)
-        except (pa.ArrowInvalid, pa.ArrowTypeError):
-            arrays[name] = pa.array(
-                [None if v is None else json.dumps(v) for v in values]
-            )
-    return pa.table(arrays)
+    return from_rows(rows)
 
 
 def write_shard(path: str, rows: Iterator[Dict[str, Any]]) -> int:

@@ -6,6 +6,12 @@ from .env_runner import EnvRunner  # noqa: F401
 from .policy import MLPPolicy  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
+from .bc import BC, BCConfig  # noqa: F401
+from .multi_agent import (  # noqa: F401
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from .sac import SAC, SACConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .replay_buffers import (  # noqa: F401

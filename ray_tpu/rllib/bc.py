@@ -1,0 +1,182 @@
+"""BC: offline behavior cloning from a logged-experience Dataset.
+
+Ref analogue: rllib/algorithms/bc (+ the offline data stack in
+rllib/offline/): instead of EnvRunners, training consumes a
+``ray_tpu.data`` Dataset of logged (obs, action) rows — the offline
+pipeline IS the data layer, streaming batches into a jax supervised
+update on the accelerator. Discrete actions train a categorical policy
+(cross-entropy); continuous actions train a squashed-Gaussian mean
+(tanh-MSE), so the resulting weights drop into the same rollout
+policies the online algorithms use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.dataset = None          # ray_tpu.data Dataset of logged rows
+        self.obs_column = "obs"
+        self.action_column = "action"
+        # "discrete" (int actions -> categorical CE) or "continuous"
+        # (float vectors -> tanh-squashed mean regression).
+        self.action_space: str = "discrete"
+        self.num_actions: Optional[int] = None   # discrete only
+        self.action_low = None                   # continuous only
+        self.action_high = None
+
+    def offline_data(self, dataset, *, obs_column="obs",
+                     action_column="action") -> "BCConfig":
+        self.dataset = dataset
+        self.obs_column = obs_column
+        self.action_column = action_column
+        return self
+
+    def build(self) -> "BC":
+        if self.dataset is None:
+            raise ValueError("BCConfig.offline_data(dataset=...) required")
+        return BC(self.copy())
+
+
+class BC:
+    """Offline trainer: train() = one pass of minibatch updates over the
+    dataset's batch iterator."""
+
+    def __init__(self, config: BCConfig):
+        import jax
+
+        self.config = config
+        self.iteration = 0
+        c = config
+        probe = next(iter(
+            c.dataset.iter_batches(batch_size=1, batch_format="numpy")
+        ))
+        obs = np.asarray(probe[c.obs_column])
+        self._obs_dim = int(np.prod(obs.shape[1:]))
+        if c.action_space == "discrete":
+            if c.num_actions is None:
+                raise ValueError("discrete BC needs num_actions")
+            self._act_dim = int(c.num_actions)
+        else:
+            act = np.asarray(probe[c.action_column])
+            self._act_dim = int(np.prod(act.shape[1:])) or 1
+        self._build_learner()
+
+    # ---- learner -----------------------------------------------------------
+
+    def _build_learner(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        c = self.config
+        from .policy import (
+            MLPPolicy,
+            SquashedGaussianPolicy,
+            init_mlp_params,
+        )
+
+        if c.action_space == "discrete":
+            self._policy = MLPPolicy(self._obs_dim, self._act_dim,
+                                     c.hidden_size, c.seed)
+        else:
+            low = (np.asarray(c.action_low, np.float32)
+                   if c.action_low is not None
+                   else -np.ones(self._act_dim, np.float32))
+            high = (np.asarray(c.action_high, np.float32)
+                    if c.action_high is not None
+                    else np.ones(self._act_dim, np.float32))
+            self._policy = SquashedGaussianPolicy(
+                self._obs_dim, self._act_dim, low, high,
+                c.hidden_size, c.seed,
+            )
+            self._low, self._high = jnp.asarray(low), jnp.asarray(high)
+        params = jax.tree.map(jnp.asarray, self._policy.get_weights())
+        self._tx = optax.adam(c.lr)
+        self._params = params
+        self._opt_state = self._tx.init(params)
+        discrete = c.action_space == "discrete"
+
+        def mlp(ps, x):
+            for W, b in ps:
+                x = jnp.tanh(x @ W + b)
+            return x
+
+        def loss_fn(p, obs, act):
+            h = mlp(p["trunk"], obs)
+            if discrete:
+                (W, b), = p["pi"]
+                logits = h @ W + b
+                logp = jax.nn.log_softmax(logits)
+                return -jnp.take_along_axis(
+                    logp, act[:, None].astype(jnp.int32), axis=1
+                ).mean()
+            (Wm, bm), = p["mu"]
+            mu = jnp.tanh(h @ Wm + bm)
+            target = (act - self._low) / (self._high - self._low) * 2 - 1
+            return ((mu - target) ** 2).mean()
+
+        def update(p, opt_state, obs, act):
+            loss, grads = jax.value_and_grad(loss_fn)(p, obs, act)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        self._update = jax.jit(update)
+
+    # ---- training ----------------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        c = self.config
+        self.iteration += 1
+        losses = []
+        rows = 0
+        for batch in c.dataset.iter_batches(
+            batch_size=c.minibatch_size, batch_format="numpy"
+        ):
+            obs = np.asarray(batch[c.obs_column], np.float32)
+            obs = obs.reshape(len(obs), -1)
+            act = np.asarray(batch[c.action_column])
+            self._params, self._opt_state, loss = self._update(
+                self._params, self._opt_state,
+                jnp.asarray(obs), jnp.asarray(act),
+            )
+            losses.append(float(loss))
+            rows += len(obs)
+        return {
+            "training_iteration": self.iteration,
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "num_rows_trained": rows,
+        }
+
+    def get_weights(self):
+        import jax
+
+        weights = jax.tree.map(np.asarray, self._params)
+        if self.config.action_space == "continuous":
+            # BC trains only the mean; rollouts of the cloned policy
+            # should be near-deterministic, so export a tight std
+            # (log_std head -> constant -3) instead of the random init.
+            (W, b), = weights["log_std"]
+            weights["log_std"] = [
+                (np.zeros_like(W), np.full_like(b, -3.0))
+            ]
+        self._policy.set_weights(weights)
+        return weights
+
+    def get_policy(self):
+        """Rollout-ready policy carrying the trained weights."""
+        self.get_weights()
+        return self._policy
+
+    def stop(self):
+        pass

@@ -1,0 +1,249 @@
+"""Multi-agent PPO: several agents per env, policy-mapped learners.
+
+Ref analogue: rllib's MultiAgentEnv + policy mapping
+(rllib/env/multi_agent_env.py, the ``policies`` / ``policy_mapping_fn``
+config): each env step consumes/produces per-agent dicts; a mapping
+function assigns every agent to a policy id; rollouts aggregate
+per-POLICY sample batches and one PPOLearner per policy trains on the
+accelerator. Agents sharing a policy id share weights (the "shared
+policy" pattern); distinct ids train independently.
+
+Env protocol (dict-space, gymnasium-free):
+  reset(seed=None) -> ({agent: obs}, info)
+  step({agent: action}) -> ({agent: obs}, {agent: reward},
+                            {agent: terminated, "__all__": bool},
+                            {agent: truncated, "__all__": bool}, info)
+Agents absent from an obs dict are inactive that step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+from .ppo import PPOLearner
+from .sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    DONES,
+    LOGPS,
+    OBS,
+    RETURNS,
+    REWARDS,
+    SampleBatch,
+    VALUES,
+    compute_gae,
+)
+
+
+class MultiAgentPPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param: float = 0.2
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        # policy_id -> {"obs_dim": int, "num_actions": int}
+        self.policies: Dict[str, Dict[str, int]] = {}
+        self.policy_mapping_fn: Callable[[str], str] = lambda aid: "default"
+
+    def multi_agent(self, *, policies: Dict[str, Dict[str, int]],
+                    policy_mapping_fn: Callable[[str], str]
+                    ) -> "MultiAgentPPOConfig":
+        self.policies = dict(policies)
+        self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def build(self) -> "MultiAgentPPO":
+        if not self.policies:
+            raise ValueError("multi_agent(policies=...) required")
+        return MultiAgentPPO(self.copy())
+
+
+class MultiAgentEnvRunner:
+    """CPU actor: steps a dict-protocol env with one numpy policy per
+    policy id; returns {policy_id: GAE-postprocessed SampleBatch}."""
+
+    def __init__(self, env_creator, policy_factories: Dict[str, Any],
+                 policy_mapping_fn, seed: int = 0,
+                 rollout_fragment_length: int = 200,
+                 gamma: float = 0.99, lam: float = 0.95):
+        self.env = env_creator()
+        self.policies = {pid: f() for pid, f in policy_factories.items()}
+        self.mapping = policy_mapping_fn
+        self.rng = np.random.RandomState(seed)
+        self.fragment = rollout_fragment_length
+        self.gamma = gamma
+        self.lam = lam
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_reward = 0.0
+        self._episode_rewards: List[float] = []
+
+    def set_weights(self, weights: Dict[str, Any]):
+        for pid, w in weights.items():
+            self.policies[pid].set_weights(w)
+
+    def _collect(self):
+        """Per-AGENT transition columns for one fragment. GAE must run
+        over one agent's temporally-adjacent trajectory — interleaving
+        agents in a single column would bootstrap one agent's reward
+        from the OTHER agent's value estimate (the reference
+        postprocesses per (agent, episode) for the same reason)."""
+        cols: Dict[str, Dict[str, list]] = {}
+        for _ in range(self.fragment):
+            actions = {}
+            staged = {}  # agent -> (pid, obs, act, logp, val)
+            for agent, obs in self._obs.items():
+                pid = self.mapping(agent)
+                a, logp, val = self.policies[pid].compute_action(
+                    np.asarray(obs, dtype=np.float32), self.rng
+                )
+                actions[agent] = a
+                staged[agent] = (pid, obs, a, logp, val)
+            nxt, rewards, terms, truncs, _ = self.env.step(actions)
+            done_all = bool(terms.get("__all__") or truncs.get("__all__"))
+            for agent, (pid, obs, a, logp, val) in staged.items():
+                done = bool(
+                    terms.get(agent, False) or truncs.get(agent, False)
+                    or done_all
+                )
+                c = cols.setdefault(agent, {
+                    "pid": pid, "obs": [], "act": [], "rew": [],
+                    "done": [], "logp": [], "val": [],
+                })
+                c["obs"].append(np.asarray(obs, dtype=np.float32))
+                c["act"].append(a)
+                c["rew"].append(float(rewards.get(agent, 0.0)))
+                c["done"].append(done)
+                c["logp"].append(float(logp))
+                c["val"].append(float(val))
+                self._episode_reward += float(rewards.get(agent, 0.0))
+            if done_all:
+                self._episode_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        return cols
+
+    def sample(self) -> Dict[str, SampleBatch]:
+        cols = self._collect()
+        per_policy: Dict[str, List[SampleBatch]] = {}
+        for agent, c in cols.items():
+            if not c["obs"]:
+                continue
+            # Fragment-boundary bootstrap: if this agent's trajectory
+            # ends mid-episode, V(s_T+1) comes from its policy's value
+            # head (dropping it would bias every truncated tail to 0).
+            last_value = 0.0
+            if not c["done"][-1] and agent in self._obs:
+                _, _, last_value = self.policies[c["pid"]].compute_action(
+                    np.asarray(self._obs[agent], dtype=np.float32),
+                    self.rng,
+                )
+            batch = SampleBatch({
+                OBS: np.stack(c["obs"]),
+                ACTIONS: np.asarray(c["act"]),
+                REWARDS: np.asarray(c["rew"], dtype=np.float32),
+                DONES: np.asarray(c["done"]),
+                LOGPS: np.asarray(c["logp"], dtype=np.float32),
+                VALUES: np.asarray(c["val"], dtype=np.float32),
+            })
+            batch.update(compute_gae(
+                batch[REWARDS], batch[VALUES], batch[DONES],
+                float(last_value), gamma=self.gamma, lam=self.lam,
+            ))
+            per_policy.setdefault(c["pid"], []).append(batch)
+        return {pid: SampleBatch.concat(parts)
+                for pid, parts in per_policy.items()}
+
+    def episode_stats(self) -> Dict[str, float]:
+        recent = self._episode_rewards[-20:]
+        return {
+            "episodes_total": len(self._episode_rewards),
+            "episode_reward_mean": (float(np.mean(recent))
+                                    if recent else 0.0),
+        }
+
+
+class MultiAgentPPO:
+    """One PPOLearner per policy id; rollouts on CPU actors."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        import ray_tpu
+        from .policy import MLPPolicy
+
+        self.config = config
+        self.iteration = 0
+        c = config
+
+        def factory_for(spec):
+            def make(obs_dim=spec["obs_dim"],
+                     num_actions=spec["num_actions"],
+                     hidden=c.hidden_size, seed=c.seed):
+                return MLPPolicy(obs_dim, num_actions, hidden, seed)
+
+            return make
+
+        factories = {pid: factory_for(spec)
+                     for pid, spec in c.policies.items()}
+        runner_cls = ray_tpu.remote(MultiAgentEnvRunner)
+        self.runners = [
+            runner_cls.remote(
+                c.env_creator(), factories, c.policy_mapping_fn,
+                seed=c.seed + i,
+                rollout_fragment_length=c.rollout_fragment_length,
+                gamma=c.gamma, lam=c.lambda_,
+            )
+            for i in range(c.num_env_runners)
+        ]
+        self.learners = {
+            pid: PPOLearner(factories[pid](), c.lr, c.clip_param,
+                            c.vf_loss_coeff, c.entropy_coeff)
+            for pid in c.policies
+        }
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        self.iteration += 1
+        per_runner = ray_tpu.get([r.sample.remote() for r in self.runners])
+        merged: Dict[str, List[SampleBatch]] = {}
+        for batches in per_runner:
+            for pid, b in batches.items():
+                merged.setdefault(pid, []).append(b)
+        stats: Dict[str, Any] = {}
+        weights: Dict[str, Any] = {}
+        for pid, parts in merged.items():
+            batch = SampleBatch.concat(parts)
+            out = self.learners[pid].update(
+                batch, epochs=c.num_epochs,
+                minibatch_size=c.minibatch_size, rng=np.random.RandomState(
+                    c.seed + self.iteration),
+            )
+            stats[f"{pid}/loss"] = out["total_loss"]
+            weights[pid] = self.learners[pid].get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners])
+        ep = ray_tpu.get([r.episode_stats.remote() for r in self.runners])
+        means = [s["episode_reward_mean"] for s in ep
+                 if s["episodes_total"] > 0]
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep),
+            **stats,
+        }
+
+    def get_weights(self) -> Dict[str, Any]:
+        return {pid: lr.get_weights() for pid, lr in self.learners.items()}
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
