@@ -3397,6 +3397,23 @@ class NodeManager:
                     pass
             if self._server is not None:
                 self._server.close()
+            # Cancel stragglers (e.g. a get_actor_direct discovery poll
+            # issued via call_sync) so the loop closes without "Task was
+            # destroyed but it is pending" noise — and WAIT for the
+            # cancellations to retire (a finally needing one more await
+            # would otherwise still be pending at loop close).
+            me = asyncio.current_task()
+            others = [t for t in asyncio.all_tasks() if t is not me]
+            for task in others:
+                task.cancel()
+            if others:
+                try:
+                    await asyncio.wait_for(
+                        asyncio.gather(*others, return_exceptions=True),
+                        1.0,
+                    )
+                except Exception:
+                    pass
 
         try:
             self._call(_stop()).result(timeout=5)

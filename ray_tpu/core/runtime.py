@@ -632,7 +632,10 @@ class DriverRuntime(BaseRuntime):
                 path = self._nm.call_sync(
                     self._nm.get_actor_direct(actor_id), timeout=40.0
                 )
-            except Exception:
+            except BaseException:
+                # Includes CancelledError (BaseException): NM shutdown
+                # cancels in-flight loop tasks; this daemon thread must
+                # exit quietly, not print an unhandled traceback.
                 path = None
             if path is None:
                 # Unsupported OR just continuously busy for the whole
