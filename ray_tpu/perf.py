@@ -137,15 +137,16 @@ def run_microbenchmarks(
 
 def run_envelope_probes(
     *,
-    num_args: int = 1000,
-    num_queued: int = 10_000,
-    num_returns: int = 300,
-    num_get: int = 2000,
+    num_args: int = 2000,
+    num_queued: int = 20_000,
+    num_returns: int = 1000,
+    num_get: int = 5000,
 ) -> Dict[str, float]:
     """Scalability-envelope probes (ref: release/benchmarks/README.md —
     object args to one task, tasks queued on one node, returns from one
-    task, plasma objects in one get). Sized for the sandbox; each scales
-    linearly so the envelope number is rate * published-scale."""
+    task, plasma objects in one get). Sandbox-sized but scaled UP each
+    round toward the reference envelope (10k+ args / 1M+ queued / 3k+
+    returns / 10k+ get); r4 doubles r3's scales except returns (3.3x)."""
     import ray_tpu
 
     results: Dict[str, float] = {}
