@@ -2,7 +2,8 @@
 dynamic batching keeps the MXU fed; continuous-batched LLM decode to come
 on top of the same router)."""
 
-from .api import (  # noqa: F401
+from .api import (
+    asgi,  # noqa: F401
     batch,
     delete,
     deployment,

@@ -40,6 +40,7 @@ def _make_handle(name: str, snap: Dict[str, Any],
             name, [], batch_config=batch_config, _state=state
         )
         state.force_refresh()
+        handle.is_asgi = bool(snap.get("is_asgi"))
         return handle
     handle = DeploymentHandle(
         name, snap["replicas"],
@@ -48,6 +49,7 @@ def _make_handle(name: str, snap: Dict[str, Any],
         route_version=snap["version"],
     )
     _states[name] = handle._state
+    handle.is_asgi = bool(snap.get("is_asgi"))
     return handle
 
 
@@ -100,9 +102,30 @@ def run(target: Deployment, *, name: Optional[str] = None,
     snap = ray_tpu.get(controller.get_routing.remote(dep_name))
     handle = _make_handle(dep_name, snap, batch_config)
     port = http_proxy.start_proxy(http_port)
-    http_proxy.register_route(route_prefix or dep_name, handle)
+    http_proxy.register_route(
+        route_prefix or dep_name, handle,
+        asgi=getattr(target.func_or_class, "_rtpu_asgi", False),
+    )
     handle.http_port = port
     return handle
+
+
+def asgi(app_or_factory, *, name: str = "asgi",
+         num_replicas: int = 1,
+         ray_actor_options: Optional[Dict[str, Any]] = None):
+    """Wrap an ASGI-3 application (or zero-arg factory) as a deployment
+    (ref analogue: @serve.ingress(app) with a FastAPI/starlette app —
+    here any ASGI callable, no framework dependency). Route it with
+    serve.run(...); the HTTP proxy forwards raw requests under
+    /<route>/... and relays responses verbatim."""
+    from .asgi_ingress import ASGIReplica
+
+    dep = deployment(ASGIReplica).options(
+        name=name, num_replicas=num_replicas,
+        ray_actor_options={"max_concurrency": 8,
+                           **(ray_actor_options or {})},
+    )
+    return dep.bind(app_or_factory)
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:

@@ -1,0 +1,94 @@
+"""ASGI ingress: serve any ASGI-3 application behind the HTTP proxy.
+
+Ref analogue: serve's FastAPI/ASGI integration (`@serve.ingress(app)` +
+the uvicorn-backed proxy in serve/_private/http_util.py). The image
+ships no uvicorn/starlette, so the bridge is self-contained: each
+replica hosts the user's ASGI app on a private event loop; the per-node
+proxy forwards the RAW request (method, path remainder, query, headers,
+body) and relays the app's response verbatim — any framework speaking
+the ASGI protocol works, no JSON envelope involved.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Callable, Dict, List, Tuple
+
+
+class ASGIReplica:
+    """Deployment class wrapping one ASGI app instance."""
+
+    _rtpu_asgi = True
+
+    def __init__(self, app_factory: Callable[[], Any]):
+        self._app = app_factory() if callable(app_factory) else app_factory
+        self._loop = asyncio.new_event_loop()
+        t = threading.Thread(target=self._loop.run_forever, daemon=True)
+        t.start()
+
+    def handle_http(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """One request through the app. ``request``: {method, path,
+        query_string, headers: [[name, value], ...], body: bytes}.
+        Returns {status, headers: [[name, value], ...], body: bytes}."""
+        fut = asyncio.run_coroutine_threadsafe(
+            self._run_app(request), self._loop
+        )
+        return fut.result(timeout=120)
+
+    async def _run_app(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        scope = {
+            "type": "http",
+            "asgi": {"version": "3.0", "spec_version": "2.3"},
+            "http_version": "1.1",
+            "method": request["method"],
+            "scheme": "http",
+            "path": request["path"],
+            "raw_path": request["path"].encode(),
+            "query_string": request.get("query_string", b"") or b"",
+            "root_path": "",
+            "headers": [
+                (k.lower().encode(), v.encode())
+                for k, v in request.get("headers", [])
+            ],
+            "client": ("127.0.0.1", 0),
+            "server": ("127.0.0.1", 80),
+        }
+        body = request.get("body", b"") or b""
+        sent_body = False
+
+        async def receive():
+            nonlocal sent_body
+            if sent_body:
+                # ASGI spec: after the request body, receive() resolves
+                # only on a real disconnect. Frameworks run disconnect
+                # watchers on it — returning early would cancel their
+                # in-flight responses. Our requests are fully buffered,
+                # so block until the handler is torn down (bounded by
+                # the caller's overall timeout).
+                await asyncio.Future()
+            sent_body = True
+            return {"type": "http.request", "body": body,
+                    "more_body": False}
+
+        status = 500
+        headers: List[Tuple[str, str]] = []
+        chunks: List[bytes] = []
+
+        async def send(message):
+            nonlocal status, headers
+            if message["type"] == "http.response.start":
+                status = int(message["status"])
+                headers = [
+                    (k.decode(), v.decode())
+                    for k, v in message.get("headers", [])
+                ]
+            elif message["type"] == "http.response.body":
+                chunks.append(bytes(message.get("body", b"")))
+
+        await self._app(scope, receive, send)
+        return {"status": status, "headers": headers,
+                "body": b"".join(chunks)}
+
+    def ping(self) -> str:
+        return "ok"

@@ -46,6 +46,7 @@ class _DeploymentState:
         self.ray_actor_options: Dict[str, Any] = {}
         self.batch_config: Optional[Dict[str, Any]] = None
         self.autoscaling: Optional[Dict[str, float]] = None
+        self.is_asgi: bool = False  # raw-HTTP ingress deployment
         self.version: str = ""
         # Live replica handles, each tagged with the version it was
         # started under: list of (handle, version).
@@ -116,6 +117,12 @@ class ServeControllerActor:
             version = hashlib.sha1(
                 blob + repr((init_args, init_kwargs)).encode()
             ).hexdigest()[:12]
+        try:
+            import cloudpickle as _cp
+
+            is_asgi = bool(getattr(_cp.loads(blob), "_rtpu_asgi", False))
+        except Exception:
+            is_asgi = False
 
         with self._lock:
             st = self._deployments.get(name)
@@ -125,6 +132,7 @@ class ServeControllerActor:
                 self._deployments[name] = st
             old_version = st.version
             st.blob = blob
+            st.is_asgi = is_asgi
             st.init_args = init_args
             st.init_kwargs = dict(init_kwargs)
             st.ray_actor_options = dict(ray_actor_options)
@@ -362,6 +370,7 @@ class ServeControllerActor:
                 "version": st.route_version,
                 "replicas": list(st.replicas),
                 "batch_config": st.batch_config,
+                "is_asgi": st.is_asgi,
             }
 
     def listen_for_route_change(self, name: str, known_version: int,
@@ -386,6 +395,7 @@ class ServeControllerActor:
                 "version": st.route_version,
                 "replicas": list(st.replicas),
                 "batch_config": st.batch_config,
+                "is_asgi": st.is_asgi,
             }
 
     def get_replicas(self, name: str) -> List[Any]:

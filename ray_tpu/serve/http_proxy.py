@@ -20,6 +20,7 @@ from .handle import DeploymentHandle
 class _ProxyState:
     def __init__(self):
         self.routes: Dict[str, DeploymentHandle] = {}
+        self.asgi_routes: set = set()  # route names forwarding raw HTTP
 
 
 _state = _ProxyState()
@@ -46,6 +47,53 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, "ok")
         else:
             self.do_POST()
+
+    def do_PUT(self):  # noqa: N802 — stdlib API
+        self.do_POST()
+
+    def do_DELETE(self):  # noqa: N802
+        self.do_POST()
+
+    def do_PATCH(self):  # noqa: N802
+        self.do_POST()
+
+    def _asgi_forward(self, name: str, handle):
+        """Raw HTTP relay to an ASGI deployment (ref: the uvicorn proxy
+        path in serve/_private/http_util.py): everything after /<name>
+        becomes the app's path; the response passes through verbatim."""
+        from urllib.parse import urlparse
+
+        parsed = urlparse(self.path)
+        sub = parsed.path[len(name) + 1:] or "/"
+        if not sub.startswith("/"):
+            sub = "/" + sub
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        request = {
+            "method": self.command,
+            "path": sub,
+            "query_string": (parsed.query or "").encode(),
+            "headers": [[k, v] for k, v in self.headers.items()],
+            "body": body,
+        }
+        try:
+            resp = handle.options(method="handle_http").remote(
+                request
+            ).result(timeout=120)
+        except Exception as e:  # noqa: BLE001
+            self._reply(500, {"error": str(e)})
+            return
+        body = resp.get("body", b"") or b""
+        if isinstance(body, str):
+            body = body.encode()
+        self.send_response(int(resp.get("status", 200)))
+        for k, v in resp.get("headers", []):
+            if k.lower() in ("content-length", "connection"):
+                continue
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _stream_reply(self, handle, arg):
         """Server-sent events: one `data:` frame per item the replica's
@@ -75,8 +123,16 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:
                 pass
 
+    def do_OPTIONS(self):  # noqa: N802 — stdlib API
+        self.do_POST()
+
+    def do_HEAD(self):  # noqa: N802
+        self.do_POST()
+
     def do_POST(self):
-        parts = self.path.strip("/").split("/")
+        from urllib.parse import urlparse
+
+        parts = urlparse(self.path).path.strip("/").split("/")
         name = parts[0]
         streaming = (
             (len(parts) > 1 and parts[1] == "stream")
@@ -103,6 +159,8 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 handle = serve_api.get_deployment_handle(name)
                 _state.routes[name] = handle
+                if getattr(handle, "is_asgi", False):
+                    _state.asgi_routes.add(name)
             except KeyError:
                 self._reply(404, {"error": f"no deployment {name!r}"})
                 return
@@ -111,6 +169,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return
         if handle is None:
             self._reply(404, {"error": f"no deployment {name!r}"})
+            return
+        if name in _state.asgi_routes:
+            self._asgi_forward(name, handle)
             return
         length = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(length) if length else b"null"
@@ -144,8 +205,13 @@ def start_proxy(port: int = 8000) -> int:
     return _server.server_address[1]
 
 
-def register_route(name: str, handle: DeploymentHandle):
+def register_route(name: str, handle: DeploymentHandle,
+                   *, asgi: bool = False):
     _state.routes[name] = handle
+    if asgi:
+        _state.asgi_routes.add(name)
+    else:
+        _state.asgi_routes.discard(name)  # name may be redeployed non-ASGI
 
 
 def stop_proxy():
@@ -155,6 +221,7 @@ def stop_proxy():
         _server = None
         _thread = None
     _state.routes.clear()
+    _state.asgi_routes.clear()
 
 
 # ---------------------------------------------------------- per-node proxy
