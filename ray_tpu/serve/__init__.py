@@ -18,3 +18,7 @@ from .api import (
 )
 from .deployment import AutoscalingConfig, Deployment  # noqa: F401
 from .handle import DeploymentHandle, ServeFuture  # noqa: F401
+from .grpc_ingress import (  # noqa: F401
+    start_grpc_ingress,
+    stop_grpc_ingress,
+)

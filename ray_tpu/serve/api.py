@@ -40,7 +40,7 @@ def _make_handle(name: str, snap: Dict[str, Any],
             name, [], batch_config=batch_config, _state=state
         )
         state.force_refresh()
-        handle.is_asgi = bool(snap.get("is_asgi"))
+        state.is_asgi = bool(snap.get("is_asgi"))
         return handle
     handle = DeploymentHandle(
         name, snap["replicas"],
@@ -49,7 +49,7 @@ def _make_handle(name: str, snap: Dict[str, Any],
         route_version=snap["version"],
     )
     _states[name] = handle._state
-    handle.is_asgi = bool(snap.get("is_asgi"))
+    handle._state.is_asgi = bool(snap.get("is_asgi"))
     return handle
 
 
@@ -97,6 +97,7 @@ def run(target: Deployment, *, name: Optional[str] = None,
             target.ray_actor_options,
             batch_config,
             autoscaling,
+            is_asgi=getattr(target.func_or_class, "_rtpu_asgi", False),
         )
     )
     snap = ray_tpu.get(controller.get_routing.remote(dep_name))

@@ -22,10 +22,33 @@ class ASGIReplica:
     _rtpu_asgi = True
 
     def __init__(self, app_factory: Callable[[], Any]):
-        self._app = app_factory() if callable(app_factory) else app_factory
+        self._app = self._resolve_app(app_factory)
         self._loop = asyncio.new_event_loop()
         t = threading.Thread(target=self._loop.run_forever, daemon=True)
         t.start()
+
+    @staticmethod
+    def _resolve_app(obj):
+        """Accept an ASGI app OR a zero-arg factory. Every ASGI-3 app
+        is itself callable, so "callable == factory" would invoke the
+        app with no arguments; distinguish by arity instead."""
+        import inspect
+
+        try:
+            target = obj if inspect.isfunction(obj) or inspect.ismethod(
+                obj) else getattr(obj, "__call__", obj)
+            params = [
+                pm for pm in inspect.signature(target).parameters.values()
+                if pm.kind in (pm.POSITIONAL_ONLY,
+                               pm.POSITIONAL_OR_KEYWORD)
+                and pm.default is pm.empty
+            ]
+            n_required = len(params)
+        except (TypeError, ValueError):
+            n_required = None
+        if n_required == 0:
+            return obj()  # zero-arg factory
+        return obj        # the app itself (scope, receive, send)
 
     def handle_http(self, request: Dict[str, Any]) -> Dict[str, Any]:
         """One request through the app. ``request``: {method, path,
@@ -47,8 +70,9 @@ class ASGIReplica:
             "raw_path": request["path"].encode(),
             "query_string": request.get("query_string", b"") or b"",
             "root_path": "",
+            # HTTP header bytes are latin-1, not UTF-8 (RFC 9110).
             "headers": [
-                (k.lower().encode(), v.encode())
+                (k.lower().encode("latin-1"), v.encode("latin-1"))
                 for k, v in request.get("headers", [])
             ],
             "client": ("127.0.0.1", 0),
@@ -80,7 +104,7 @@ class ASGIReplica:
             if message["type"] == "http.response.start":
                 status = int(message["status"])
                 headers = [
-                    (k.decode(), v.decode())
+                    (k.decode("latin-1"), v.decode("latin-1"))
                     for k, v in message.get("headers", [])
                 ]
             elif message["type"] == "http.response.body":

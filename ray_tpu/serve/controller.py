@@ -112,17 +112,12 @@ class ServeControllerActor:
                num_replicas: int, ray_actor_options: Dict[str, Any],
                batch_config: Optional[Dict[str, Any]],
                autoscaling: Optional[Dict[str, float]] = None,
-               version: Optional[str] = None) -> List[Any]:
+               version: Optional[str] = None,
+               is_asgi: bool = False) -> List[Any]:
         if version is None:
             version = hashlib.sha1(
                 blob + repr((init_args, init_kwargs)).encode()
             ).hexdigest()[:12]
-        try:
-            import cloudpickle as _cp
-
-            is_asgi = bool(getattr(_cp.loads(blob), "_rtpu_asgi", False))
-        except Exception:
-            is_asgi = False
 
         with self._lock:
             st = self._deployments.get(name)

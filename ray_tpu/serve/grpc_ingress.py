@@ -1,0 +1,119 @@
+"""gRPC ingress: call deployments as gRPC methods.
+
+Ref analogue: serve's gRPC proxy (serve/_private/proxy.py gRPC path +
+src/ray/protobuf/serve.proto). Routing is generic — no protoc step: a
+``GenericRpcHandler`` maps ``/<deployment>/<method>`` to the deployment
+handle's method with RAW request bytes, and replies with the method's
+bytes result (non-bytes results are JSON-encoded). Clients use any gRPC
+stack with identity (de)serializers, or protoc-generated stubs whose
+messages they serialize themselves — the wire contract is bytes in /
+bytes out, exactly what a generated stub produces.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+from .handle import DeploymentHandle
+
+_server = None
+_lock = threading.Lock()
+_routes: Dict[str, DeploymentHandle] = {}
+
+
+def _resolve(name: str) -> Optional[DeploymentHandle]:
+    handle = _routes.get(name)
+    if handle is not None:
+        return handle
+    # Dynamic discovery, mirroring the HTTP proxy: any live deployment
+    # is routable without explicit registration.
+    try:
+        from . import api as serve_api
+
+        handle = serve_api.get_deployment_handle(name)
+    except Exception:
+        return None
+    _routes[name] = handle
+    return handle
+
+
+class _GenericHandler:
+    """grpc.GenericRpcHandler routing /<deployment>/<method>."""
+
+    def service(self, handler_call_details):
+        import grpc
+
+        parts = handler_call_details.method.strip("/").split("/")
+        if len(parts) != 2:
+            return None
+        dep_name, method = parts
+
+        def unary_unary(request: bytes, context):
+            handle = _resolve(dep_name)
+            if handle is None:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"no deployment {dep_name!r}")
+            try:
+                h = handle if method == "__call__" else handle.options(
+                    method=method
+                )
+                result = h.remote(request).result(timeout=120)
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
+                return b""
+            if isinstance(result, (bytes, bytearray)):
+                return bytes(result)
+            return json.dumps(result, default=str).encode()
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary_unary,
+            request_deserializer=None,   # identity: raw bytes
+            response_serializer=None,
+        )
+
+
+# grpc.GenericRpcHandler is an ABC registered at import time; subclass
+# lazily so importing this module does not require grpcio.
+def _make_handler():
+    import grpc
+
+    class Handler(_GenericHandler, grpc.GenericRpcHandler):
+        pass
+
+    return Handler()
+
+
+def start_grpc_ingress(port: int = 0, *, max_workers: int = 8) -> int:
+    """Start (or return) the gRPC ingress; returns the bound port."""
+    global _server
+    from concurrent import futures
+
+    import grpc
+
+    with _lock:
+        if _server is not None:
+            return _server._rtpu_port
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+        )
+        server.add_generic_rpc_handlers((_make_handler(),))
+        bound = server.add_insecure_port(f"127.0.0.1:{port}")
+        server.start()
+        server._rtpu_port = bound
+        _server = server
+        return bound
+
+
+def register_route(name: str, handle: DeploymentHandle):
+    _routes[name] = handle
+
+
+def stop_grpc_ingress():
+    global _server
+    with _lock:
+        if _server is not None:
+            _server.stop(grace=1.0)
+            _server = None
+        _routes.clear()

@@ -71,6 +71,9 @@ class _RouterState:
         # out of controller snapshots until the health checker has had time
         # to remove them server-side (prevents re-routing to a corpse).
         self.dead: Dict[Any, float] = {}
+        # Raw-HTTP (ASGI) deployment? Refreshed by every routing
+        # snapshot so proxies follow protocol changes across redeploys.
+        self.is_asgi: bool = False
         # multiplexed model id -> replica key that last served it.
         self.model_affinity: Dict[str, Any] = {}
         if controller is not None:
@@ -159,6 +162,8 @@ class _RouterState:
     def apply_snapshot(self, snap: Dict[str, Any]) -> None:
         now = time.monotonic()
         with self.lock:
+            if "is_asgi" in snap:
+                self.is_asgi = bool(snap["is_asgi"])
             for k, ts in list(self.dead.items()):
                 if now - ts > DEAD_REPLICA_TTL_S:
                     del self.dead[k]

@@ -159,8 +159,6 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 handle = serve_api.get_deployment_handle(name)
                 _state.routes[name] = handle
-                if getattr(handle, "is_asgi", False):
-                    _state.asgi_routes.add(name)
             except KeyError:
                 self._reply(404, {"error": f"no deployment {name!r}"})
                 return
@@ -170,8 +168,23 @@ class _Handler(BaseHTTPRequestHandler):
         if handle is None:
             self._reply(404, {"error": f"no deployment {name!r}"})
             return
-        if name in _state.asgi_routes:
+        # Protocol decision follows the ROUTING SNAPSHOT (refreshed by
+        # the handle's long-poll), so a redeploy that flips a name
+        # between ASGI and JSON is honored without restarting proxies;
+        # the explicit-registration set covers driver-local routes.
+        is_asgi = (getattr(handle._state, "is_asgi", False)
+                   or name in _state.asgi_routes)
+        if is_asgi:
             self._asgi_forward(name, handle)
+            return
+        if self.command in ("HEAD", "OPTIONS"):
+            # Non-ASGI deployments speak the JSON envelope only; do NOT
+            # execute them on preflight/health probes, and never write a
+            # body to a HEAD response (keep-alive desync).
+            self.send_response(405)
+            self.send_header("Allow", "GET, POST")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
             return
         length = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(length) if length else b"null"

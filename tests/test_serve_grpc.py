@@ -1,0 +1,45 @@
+"""gRPC ingress (ref: serve's gRPC proxy; here a generic bytes-in/
+bytes-out router, serve/grpc_ingress.py)."""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_rt(ray_tpu_start):
+    yield
+    serve.stop_grpc_ingress()
+    serve.shutdown()
+
+
+def test_grpc_ingress_roundtrip(serve_rt):
+    import grpc
+
+    @serve.deployment(num_replicas=2)
+    class Tokenizer:
+        def __call__(self, payload: bytes) -> bytes:
+            return payload.upper()
+
+        def stats(self, payload: bytes):
+            return {"len": len(payload)}  # non-bytes -> JSON over the wire
+
+    serve.run(Tokenizer.bind(), name="tok")
+    port = serve.start_grpc_ingress(0)
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+
+    call = channel.unary_unary("/tok/__call__")
+    assert call(b"shout", timeout=60) == b"SHOUT"
+
+    stats = channel.unary_unary("/tok/stats")
+    assert json.loads(stats(b"abcd", timeout=60)) == {"len": 4}
+
+    # unknown deployment -> NOT_FOUND
+    missing = channel.unary_unary("/nosuch/__call__")
+    with pytest.raises(grpc.RpcError) as ei:
+        missing(b"x", timeout=30)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    channel.close()
