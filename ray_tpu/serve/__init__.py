@@ -20,5 +20,6 @@ from .deployment import AutoscalingConfig, Deployment  # noqa: F401
 from .handle import DeploymentHandle, ServeFuture  # noqa: F401
 from .grpc_ingress import (  # noqa: F401
     start_grpc_ingress,
+    start_per_node_grpc_proxies,
     stop_grpc_ingress,
 )

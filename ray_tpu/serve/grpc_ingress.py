@@ -23,18 +23,32 @@ _lock = threading.Lock()
 _routes: Dict[str, DeploymentHandle] = {}
 
 
+class _ControllerDown(Exception):
+    """Serve isn't running or the controller errored (UNAVAILABLE)."""
+
+
 def _resolve(name: str) -> Optional[DeploymentHandle]:
     handle = _routes.get(name)
     if handle is not None:
         return handle
     # Dynamic discovery, mirroring the HTTP proxy: any live deployment
-    # is routable without explicit registration.
-    try:
-        from . import api as serve_api
+    # is routable — but a stray request must never SPAWN a controller,
+    # and a transient controller failure is UNAVAILABLE, not NOT_FOUND.
+    import ray_tpu
 
+    from . import api as serve_api
+    from .controller import CONTROLLER_NAME
+
+    try:
+        ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        raise _ControllerDown("serve is not running")
+    try:
         handle = serve_api.get_deployment_handle(name)
-    except Exception:
+    except KeyError:
         return None
+    except Exception as e:  # noqa: BLE001
+        raise _ControllerDown(f"controller error: {e}")
     _routes[name] = handle
     return handle
 
@@ -51,7 +65,11 @@ class _GenericHandler:
         dep_name, method = parts
 
         def unary_unary(request: bytes, context):
-            handle = _resolve(dep_name)
+            try:
+                handle = _resolve(dep_name)
+            except _ControllerDown as e:
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                return b""
             if handle is None:
                 context.abort(grpc.StatusCode.NOT_FOUND,
                               f"no deployment {dep_name!r}")
@@ -85,7 +103,8 @@ def _make_handler():
     return Handler()
 
 
-def start_grpc_ingress(port: int = 0, *, max_workers: int = 8) -> int:
+def start_grpc_ingress(port: int = 0, *, host: str = "127.0.0.1",
+                       max_workers: int = 8) -> int:
     """Start (or return) the gRPC ingress; returns the bound port."""
     global _server
     from concurrent import futures
@@ -99,7 +118,13 @@ def start_grpc_ingress(port: int = 0, *, max_workers: int = 8) -> int:
             futures.ThreadPoolExecutor(max_workers=max_workers),
         )
         server.add_generic_rpc_handlers((_make_handler(),))
-        bound = server.add_insecure_port(f"127.0.0.1:{port}")
+        bound = server.add_insecure_port(f"{host}:{port}")
+        if bound == 0:
+            # gRPC signals bind failure by returning port 0, it does
+            # not raise — fail loudly like the HTTP mirror would.
+            raise OSError(
+                f"gRPC ingress could not bind {host}:{port}"
+            )
         server.start()
         server._rtpu_port = bound
         _server = server
@@ -108,6 +133,34 @@ def start_grpc_ingress(port: int = 0, *, max_workers: int = 8) -> int:
 
 def register_route(name: str, handle: DeploymentHandle):
     _routes[name] = handle
+
+
+class GrpcProxyActor:
+    """One gRPC ingress per node (mirror of http_proxy.ProxyActor):
+    routes resolve dynamically through the controller."""
+
+    def __init__(self, port: int = 0):
+        # Per-node ingress serves remote clients: bind all interfaces
+        # (the driver-local default stays loopback).
+        self._port = start_grpc_ingress(port, host="0.0.0.0")
+
+    def port(self) -> int:
+        return self._port
+
+    def ping(self) -> str:
+        return "ok"
+
+    def shutdown(self) -> str:
+        stop_grpc_ingress()
+        return "ok"
+
+
+def start_per_node_grpc_proxies(port: int = 0):
+    """Launch one GrpcProxyActor on every alive node; returns
+    {node_id: (actor, port)}."""
+    from .http_proxy import start_per_node_actors
+
+    return start_per_node_actors(GrpcProxyActor, port)
 
 
 def stop_grpc_ingress():

@@ -263,16 +263,18 @@ class ProxyActor:
         return "ok"
 
 
-def start_per_node_proxies(port: int = 8000):
-    """Launch one ProxyActor on every alive node (node-affinity pinned);
-    returns {node_id: (actor, port)} (ref: proxies on each node serving
-    the same route table)."""
+def start_per_node_actors(actor_cls, port: int,
+                          *, timeout: float = 60.0):
+    """Launch one ingress actor per alive node (node-affinity pinned)
+    and gather their bound ports IN PARALLEL; a node that died since the
+    snapshot is skipped after ``timeout`` instead of hanging startup.
+    Shared by the HTTP and gRPC per-node proxies."""
     import ray_tpu
     from ray_tpu.core.scheduling_strategies import (
         NodeAffinitySchedulingStrategy,
     )
 
-    proxies = {}
+    spawned = {}
     for node in ray_tpu.nodes():
         if not node.get("Alive", False):
             continue
@@ -280,7 +282,23 @@ def start_per_node_proxies(port: int = 8000):
         actor = ray_tpu.remote(
             scheduling_strategy=NodeAffinitySchedulingStrategy(nid),
             max_concurrency=16,
-        )(ProxyActor).remote(port)
-        bound = ray_tpu.get(actor.port.remote())
-        proxies[nid] = (actor, bound)
+        )(actor_cls).remote(port)
+        spawned[nid] = (actor, actor.port.remote())
+    proxies = {}
+    for nid, (actor, port_ref) in spawned.items():
+        try:
+            proxies[nid] = (actor, ray_tpu.get(port_ref,
+                                               timeout=timeout))
+        except Exception:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
     return proxies
+
+
+def start_per_node_proxies(port: int = 8000):
+    """Launch one ProxyActor on every alive node; returns
+    {node_id: (actor, port)} (ref: proxies on each node serving the
+    same route table)."""
+    return start_per_node_actors(ProxyActor, port)

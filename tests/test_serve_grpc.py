@@ -43,3 +43,32 @@ def test_grpc_ingress_roundtrip(serve_rt):
         missing(b"x", timeout=30)
     assert ei.value.code() == grpc.StatusCode.NOT_FOUND
     channel.close()
+
+
+def test_per_node_grpc_proxies(serve_rt):
+    """One gRPC ingress per node with dynamic route discovery (mirror of
+    the per-node HTTP ProxyActor)."""
+    import grpc
+
+    from ray_tpu.serve.grpc_ingress import start_per_node_grpc_proxies
+
+    @serve.deployment
+    def upper(payload: bytes) -> bytes:
+        return payload.upper()
+
+    serve.run(upper.bind(), name="up")
+    proxies = start_per_node_grpc_proxies(port=0)
+    try:
+        assert len(proxies) >= 1
+        for _, port in proxies.values():  # every node's ingress serves
+            channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+            assert channel.unary_unary("/up/__call__")(
+                b"abc", timeout=60) == b"ABC"
+            channel.close()
+    finally:
+        for actor, _ in proxies.values():
+            try:
+                ray_tpu.get(actor.shutdown.remote(), timeout=10)
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
