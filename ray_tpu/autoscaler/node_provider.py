@@ -152,30 +152,35 @@ class SSHNodeProvider(_SubprocessProvider):
 
     def ssh_command(self, ip: str, node_id: str,
                     resources: Dict[str, float],
-                    labels: Dict[str, str]) -> List[str]:
+                    labels: Dict[str, str],
+                    with_token: bool = False) -> List[str]:
         """The exact argv used to start a node on ``ip`` (separated out
         for tests: the sandbox has no reachable ssh hosts). Creates the
-        remote session dir and forwards the session token when the
-        cluster is token-secured."""
-        from ray_tpu.core.config import get_config
+        remote session dir. JSON values are shell-quoted (a resource or
+        label containing a quote must not break the command). When
+        ``with_token``, the remote command reads the session token from
+        its STDIN (``read``) rather than the command line, where
+        `ps`/audit logs on the remote host would expose it — the caller
+        must then write exactly one token line to the child's stdin."""
+        import shlex
 
         target = f"{self.ssh_user}@{ip}" if self.ssh_user else ip
         session_dir = f"/tmp/ray_tpu/{node_id}"
         env = (
-            f"RAY_TPU_GCS_ADDRESS={self.gcs_address} "
-            f"RAY_TPU_SESSION_DIR={session_dir} "
-            f"RAY_TPU_RESOURCES='{json.dumps(resources)}' "
-            f"RAY_TPU_NODE_LABELS='{json.dumps(labels)}'"
+            f"RAY_TPU_GCS_ADDRESS={shlex.quote(self.gcs_address)} "
+            f"RAY_TPU_SESSION_DIR={shlex.quote(session_dir)} "
+            f"RAY_TPU_RESOURCES={shlex.quote(json.dumps(resources))} "
+            f"RAY_TPU_NODE_LABELS={shlex.quote(json.dumps(labels))}"
         )
-        token = get_config().session_token
-        if token:
-            env += f" RAY_TPU_SESSION_TOKEN={token}"
+        launch = (f"mkdir -p {shlex.quote(session_dir)} && "
+                  f"{env} {self.python} -m ray_tpu.core.node_main")
+        if with_token:
+            launch = ('IFS= read -r RAY_TPU_SESSION_TOKEN && '
+                      'export RAY_TPU_SESSION_TOKEN && ' + launch)
         cmd = ["ssh", "-o", "StrictHostKeyChecking=accept-new"]
         if self.ssh_key:
             cmd += ["-i", os.path.expanduser(self.ssh_key)]
-        cmd += [target,
-                f"mkdir -p {session_dir} && "
-                f"{env} {self.python} -m ray_tpu.core.node_main"]
+        cmd += [target, launch]
         return cmd
 
     def create_node(self, resources: Dict[str, float],
@@ -189,10 +194,21 @@ class SSHNodeProvider(_SubprocessProvider):
         node_id = f"ssh-{ip}-{uuid.uuid4().hex[:6]}"
         labels = dict(labels or {})
         labels[PROVIDER_NODE_LABEL] = node_id
+        from ray_tpu.core.config import get_config
+
+        token = get_config().session_token
         proc = subprocess.Popen(
-            self.ssh_command(ip, node_id, resources, labels),
+            self.ssh_command(ip, node_id, resources, labels,
+                             with_token=bool(token)),
+            stdin=subprocess.PIPE if token else subprocess.DEVNULL,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
+        if token:
+            try:  # the remote `read` consumes exactly this one line
+                proc.stdin.write(token.encode() + b"\n")
+                proc.stdin.close()
+            except (BrokenPipeError, OSError):
+                pass
         self._procs[node_id] = proc
         self._ip_of[node_id] = ip
         return node_id

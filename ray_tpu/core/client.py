@@ -119,8 +119,15 @@ class ClientRuntime(WorkerRuntime):
                         f"client put failed: {reply.get('error')}"
                     )
         except Exception:
-            # The server aborts open writers when the connection drops;
-            # for an in-band failure just surface it (no put_end).
+            # In-band failure: tell the server to drop the open writer
+            # (and its reserved store block) rather than leaking it for
+            # the rest of this client session.
+            try:
+                self.request(
+                    {"type": "put_abort", "object_id": oid}, timeout=10
+                )
+            except Exception:
+                pass  # connection death cleans up server-side anyway
             raise
         reply = self.request({"type": "put_end", "object_id": oid})
         if reply.get("loc") is None:

@@ -907,17 +907,40 @@ class NodeManager:
                 reply = {"ok": False, "error": str(e)}
             reply.update({"type": "reply", "msg_id": msg["msg_id"]})
             await w.writer.send(reply)
+        elif mtype == "put_abort":
+            # Client-side failure mid-put: free the reserved block now
+            # instead of holding it until the connection drops.
+            writer = w.client_writers.pop(msg["object_id"], None)
+            if writer is not None:
+                try:
+                    await self._loop.run_in_executor(None, writer.abort)
+                except Exception:
+                    pass
+            await w.writer.send(
+                {"type": "reply", "msg_id": msg["msg_id"], "ok": True}
+            )
         elif mtype == "put_end":
             writer = w.client_writers.pop(msg["object_id"], None)
+            finalized = False
             try:
                 if writer is None:
                     raise RuntimeError("no open writer (put_begin missing)")
                 loc = await self._loop.run_in_executor(
                     None, writer.finalize
                 )
+                finalized = True
                 await self.put_object(msg["object_id"], loc, refs=0)
                 reply = {"loc": loc}
             except Exception as e:  # noqa: BLE001
+                # The writer left client_writers above, so nothing else
+                # can ever free its block — abort it here (only when
+                # finalize itself failed: after a successful seal, abort
+                # would free a block another path may already reference).
+                if writer is not None and not finalized:
+                    try:
+                        await self._loop.run_in_executor(None, writer.abort)
+                    except Exception:
+                        pass
                 reply = {"loc": None, "error": str(e)}
             reply.update({"type": "reply", "msg_id": msg["msg_id"]})
             await w.writer.send(reply)

@@ -172,7 +172,15 @@ def _ensure_pip_env(session_dir: str, reqs: list,
     try:
         os.rename(tmp, dest)
     except OSError:
-        shutil.rmtree(tmp, ignore_errors=True)  # raced: another worker won
+        shutil.rmtree(tmp, ignore_errors=True)
+        # Only a lost race leaves a usable env behind; a non-race rename
+        # failure (cross-device TMPDIR, permissions) must surface instead
+        # of silently running the worker without its pip env.
+        if not os.path.exists(os.path.join(dest, ".ready")):
+            raise RuntimeError(
+                f"pip runtime_env: failed to move built venv into "
+                f"{dest!r} and no concurrent builder produced it"
+            )
     return site_packages(dest)
 
 

@@ -351,6 +351,8 @@ class Worker:
                     items = (
                         msg["items"] if mtype == "execute_batch" else [msg]
                     )
+                    if len(group_futs) > 4096:
+                        group_futs = [f for f in group_futs if not f.done()]
                     routed = []
                     for m in items:
                         gp = self._group_pools.get(
@@ -366,10 +368,10 @@ class Worker:
                     items = routed
                     if self._pool is not None:
                         for m in items:
-                            self._pool.submit(
+                            group_futs.append(self._pool.submit(
                                 self._run_direct, conn, m["spec"],
                                 m.get("function_blob"),
-                            )
+                            ))
                         continue
                     for m in items:
                         with self._serial_lock:
@@ -388,7 +390,8 @@ class Worker:
                 elif mtype == "fence":
                     # The ack promises every earlier frame on this
                     # connection has EXECUTED — including frames handed
-                    # to group pools, which run asynchronously.
+                    # to group pools OR the shared concurrency pool,
+                    # both of which run asynchronously.
                     for f in group_futs:
                         try:
                             f.result(timeout=60)

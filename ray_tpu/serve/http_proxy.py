@@ -38,7 +38,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
-        self.wfile.write(body)
+        # HEAD responses (incl. errors) must never carry a body — a
+        # keep-alive client would parse it as the next response.
+        if self.command != "HEAD":
+            self.wfile.write(body)
 
     def do_GET(self):
         if self.path == "/-/routes":
@@ -93,7 +96,11 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
-        self.wfile.write(body)
+        # A HEAD response carries headers (incl. the Content-Length the
+        # GET would have) but MUST NOT carry a body — writing one
+        # desynchronizes HTTP keep-alive connections.
+        if self.command != "HEAD":
+            self.wfile.write(body)
 
     def _stream_reply(self, handle, arg):
         """Server-sent events: one `data:` frame per item the replica's

@@ -18,17 +18,22 @@ def rt():
 
 def test_io_group_concurrent_with_busy_compute(rt):
     """The done criterion: a group-annotated actor serves its "io" group
-    while a "compute" method is busy."""
+    while a "compute" method is busy. Event-ordered, not wall-clocked:
+    crunch() blocks until an io-group call releases it, so peek()
+    observing "crunching" (and unblock() succeeding at all) proves the
+    io group ran WHILE compute was occupied."""
 
     @ray_tpu.remote(concurrency_groups={"io": 2, "compute": 1})
     class Worker:
         def __init__(self):
             self.state = "idle"
+            self.release = False
 
         @ray_tpu.method(concurrency_group="compute")
-        def crunch(self, seconds):
+        def crunch(self):
             self.state = "crunching"
-            time.sleep(seconds)
+            while not self.release:
+                time.sleep(0.01)
             self.state = "done"
             return "crunched"
 
@@ -36,13 +41,22 @@ def test_io_group_concurrent_with_busy_compute(rt):
         def peek(self):
             return self.state
 
+        @ray_tpu.method(concurrency_group="io")
+        def unblock(self):
+            self.release = True
+            return True
+
     w = Worker.remote()
-    busy = w.crunch.remote(3.0)
-    time.sleep(0.5)
+    busy = w.crunch.remote()
     # io calls answer WHILE compute is busy — and observe its state.
-    t0 = time.time()
-    assert ray_tpu.get(w.peek.remote(), timeout=10) == "crunching"
-    assert time.time() - t0 < 2.0
+    deadline = time.time() + 30
+    state = ray_tpu.get(w.peek.remote(), timeout=10)
+    while state != "crunching" and time.time() < deadline:
+        time.sleep(0.02)
+        state = ray_tpu.get(w.peek.remote(), timeout=10)
+    assert state == "crunching"
+    # crunch can ONLY finish if this io call runs during it.
+    assert ray_tpu.get(w.unblock.remote(), timeout=10) is True
     assert ray_tpu.get(busy, timeout=30) == "crunched"
 
 
@@ -52,23 +66,31 @@ def test_method_options_group_override(rt):
     @ray_tpu.remote(concurrency_groups={"io": 1})
     class W:
         def __init__(self):
-            self.v = 0
+            self.release = False
 
         def slow_default(self):
-            time.sleep(2.0)
+            # Blocks the DEFAULT group until an io-group call releases
+            # it; if fast() were routed to the default group it would
+            # queue behind this forever and the get below would time out.
+            while not self.release:
+                time.sleep(0.01)
             return "slow"
 
         def fast(self):
             return "fast"
 
+        def unblock(self):
+            self.release = True
+            return True
+
     w = W.remote()
     slow = w.slow_default.remote()
-    time.sleep(0.3)
-    t0 = time.time()
     out = ray_tpu.get(
         w.fast.options(concurrency_group="io").remote(), timeout=10
     )
-    assert out == "fast" and time.time() - t0 < 1.5
+    assert out == "fast"
+    ray_tpu.get(w.unblock.options(concurrency_group="io").remote(),
+                timeout=10)
     assert ray_tpu.get(slow, timeout=30) == "slow"
 
 
@@ -80,19 +102,28 @@ def test_out_of_order_independent_methods(rt):
 
     @ray_tpu.remote(allow_out_of_order=True, max_concurrency=2)
     class OOO:
-        def nap(self, s):
-            time.sleep(s)
+        def __init__(self):
+            self.release = False
+
+        def nap(self):
+            # Holds one concurrency slot until unblock() runs; quick()
+            # completing at all proves the later call did not wait
+            # behind this earlier, still-running one.
+            while not self.release:
+                time.sleep(0.01)
             return "napped"
 
         def quick(self):
             return "quick"
 
+        def unblock(self):
+            self.release = True
+            return True
+
     a = OOO.remote()
-    slow = a.nap.remote(3.0)
-    time.sleep(0.3)
-    t0 = time.time()
+    slow = a.nap.remote()
     assert ray_tpu.get(a.quick.remote(), timeout=10) == "quick"
-    assert time.time() - t0 < 2.0  # did not wait behind nap()
+    assert ray_tpu.get(a.unblock.remote(), timeout=10) is True
     assert ray_tpu.get(slow, timeout=30) == "napped"
 
 

@@ -163,24 +163,28 @@ def test_async_actor_concurrent_methods(ray_tpu_start):
     @ray_tpu.remote
     class AsyncWorker:
         def __init__(self):
+            import asyncio
+
             self.calls = 0
+            self.all_in = asyncio.Event()
 
         async def slow_echo(self, x):
             import asyncio
 
+            # Every coroutine parks until all 8 are in flight — only
+            # interleaved execution can complete (event-ordered, no
+            # wall-clock sensitivity under load).
             self.calls += 1
-            await asyncio.sleep(0.4)
+            if self.calls == 8:
+                self.all_in.set()
+            await asyncio.wait_for(self.all_in.wait(), timeout=30)
             return x
 
         def sync_calls(self):
             return self.calls
 
     a = AsyncWorker.remote()
-    t0 = time.monotonic()
     refs = [a.slow_echo.remote(i) for i in range(8)]
     out = ray_tpu.get(refs, timeout=60)
-    elapsed = time.monotonic() - t0
     assert sorted(out) == list(range(8))
-    # Serialized execution would take >= 3.2s; interleaved ~0.4s.
-    assert elapsed < 2.0, elapsed
     assert ray_tpu.get(a.sync_calls.remote()) == 8

@@ -143,16 +143,21 @@ def test_concurrent_actor_pool_direct(rt):
 
     @ray_tpu.remote(max_concurrency=4)
     class Pooled:
-        def block_a_bit(self):
-            time.sleep(0.2)
+        def __init__(self):
+            import threading
+
+            # All four calls must be IN FLIGHT at once to pass the
+            # barrier; serial execution breaks it (no wall clock).
+            self.barrier = threading.Barrier(4)
+
+        def rendezvous(self):
+            self.barrier.wait(timeout=30)
             return "x"
 
     p = Pooled.remote()
-    ray_tpu.get(p.block_a_bit.remote())
-    t0 = time.time()
-    out = ray_tpu.get([p.block_a_bit.remote() for _ in range(4)])
+    out = ray_tpu.get([p.rendezvous.remote() for _ in range(4)],
+                      timeout=60)
     assert out == ["x"] * 4
-    assert time.time() - t0 < 0.75  # ran concurrently, not 4 x 0.2s
 
 
 def test_named_actor_from_second_handle(rt):
