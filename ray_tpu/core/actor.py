@@ -34,7 +34,9 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         rt = current_runtime()
-        spec_args, spec_kwargs, keepalive = rt.prepare_args(args, kwargs)
+        spec_args, spec_kwargs, keepalive, nested = rt.prepare_args(
+            args, kwargs
+        )
         num_returns = self._num_returns
         streaming = num_returns in ("streaming", "dynamic")
         if streaming:
@@ -55,6 +57,7 @@ class ActorMethod:
                 self._concurrency_group
                 or self._handle._method_groups.get(self._method_name, "")
             ),
+            nested_refs=nested,
         )
         refs = rt.submit(spec)
         del keepalive
@@ -123,7 +126,9 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         rt = current_runtime()
         function_id = rt.ensure_function(self._cls)
-        spec_args, spec_kwargs, keepalive = rt.prepare_args(args, kwargs)
+        spec_args, spec_kwargs, keepalive, nested = rt.prepare_args(
+            args, kwargs
+        )
         actor_id = ActorID.from_random()
         max_restarts = self._options.get("max_restarts", 0)
         # Actors hold their resources for their lifetime. Like the reference,
@@ -158,6 +163,7 @@ class ActorClass:
                 self._options.get("allow_out_of_order", False)
             ),
             scheduling_strategy=self._options.get("scheduling_strategy"),
+            nested_refs=nested,
         )
         rt.submit(spec)
         del keepalive

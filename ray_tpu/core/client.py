@@ -23,7 +23,7 @@ from .ids import JobID, NodeID, WorkerID
 from .object_store import InlineLocation, Location
 from .protocol import Connection, ConnectionClosed
 from .runtime import WorkerRuntime
-from .serialization import deserialize, serialize
+from .serialization import deserialize
 
 
 def _tls_socket(host: str, port: int) -> socket.socket:
@@ -133,12 +133,6 @@ class ClientRuntime(WorkerRuntime):
         if reply.get("loc") is None:
             raise RuntimeError(f"client put failed: {reply.get('error')}")
         return reply["loc"]
-
-    def _store_value(self, oid, value) -> Location:
-        sobj = serialize(value)
-        if sobj.total_size <= get_config().max_inline_object_size:
-            return InlineLocation(sobj.to_bytes())
-        return self._put_serialized(oid, sobj)
 
     def _fetch_once(self, oid, timeout):
         chunk = get_config().object_transfer_chunk_bytes

@@ -16,7 +16,7 @@ from .config import get_config
 from .exceptions import TaskError
 from .ids import ObjectID
 from .object_store import InlineLocation, Location
-from .serialization import deserialize, serialize
+from .serialization import deserialize, serialize, serialize_with_refs
 from .task_spec import RefArg, TaskSpec, TaskType, ValueArg
 
 
@@ -48,10 +48,12 @@ def resolve_args(spec: TaskSpec, fetch: Callable[[List[ObjectID]], List[Any]]):
 
 def package_results(
     spec: TaskSpec, value, store_large: Callable[[ObjectID, Any], Location]
-) -> List[Tuple[ObjectID, Location]]:
+) -> Tuple[List[Tuple[ObjectID, Location]], List[Tuple[ObjectID, list]]]:
     """Split the return value into the task's return slots and produce
-    (ObjectID, Location) pairs. ``store_large`` writes one serialized object
-    to shm and returns its location."""
+    (ObjectID, Location) pairs plus, per return, any ObjectRefs found
+    serialized INSIDE it (the containment pins the control plane must
+    hold for the return's lifetime). ``store_large`` writes one
+    serialized object to shm and returns its location."""
     return_ids = spec.return_ids()
     if spec.num_returns == 1:
         values = [value]
@@ -65,13 +67,16 @@ def package_results(
         values = list(value)
     cfg = get_config()
     out: List[Tuple[ObjectID, Location]] = []
+    nested_out: List[Tuple[ObjectID, list]] = []
     for oid, v in zip(return_ids, values):
-        sobj = serialize(v)
+        sobj, nested = serialize_with_refs(v)
+        if nested:
+            nested_out.append((oid, nested))
         if sobj.total_size <= cfg.max_inline_object_size:
             out.append((oid, InlineLocation(sobj.to_bytes())))
         else:
             out.append((oid, store_large(oid, sobj)))
-    return out
+    return out, nested_out
 
 
 class ActorContainer:
@@ -151,8 +156,8 @@ def execute_task(
     store_large: Callable[[ObjectID, Any], Location],
     actor: ActorContainer,
     stream_item: Optional[Callable[[int, Any], None]] = None,
-) -> Tuple[List[Tuple[ObjectID, Location]], bool]:
-    """Run one task; returns (results, failed)."""
+) -> Tuple[List[Tuple[ObjectID, Location]], bool, List[Tuple[ObjectID, list]]]:
+    """Run one task; returns (results, failed, nested-refs-per-return)."""
     try:
         args, kwargs = resolve_args(spec, fetch)
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
@@ -180,7 +185,8 @@ def execute_task(
                 count = 1
             stream_item(count, _STREAM_END)
             value = count
-        return package_results(spec, value, store_large), False
+        results, nested = package_results(spec, value, store_large)
+        return results, False, nested
     except Exception as e:  # noqa: BLE001 — user exceptions become TaskError
         err = e if isinstance(e, TaskError) else TaskError.from_exception(
             e, spec.name or spec.method_name
@@ -192,4 +198,4 @@ def execute_task(
             results = [(oid, loc) for oid in spec.return_ids()]
         else:
             results = [(oid, store_large(oid, sobj)) for oid in spec.return_ids()]
-        return results, True
+        return results, True, []

@@ -361,6 +361,18 @@ class NodeManager:
         self._lineage: Dict[ObjectID, TaskSpec] = {}
         self._reconstructions: Dict[ObjectID, int] = {}
 
+        # Borrower protocol (ref analogue: reference_count.h:61 borrower
+        # tracking). Borrower side: count-only stub entries created when a
+        # ref to an object this node does not own is pinned or held here;
+        # each registers this node with the owner and releases on local GC.
+        self._borrow_stubs: Set[ObjectID] = set()
+        self._borrowed_from: Dict[ObjectID, str] = {}  # oid -> owner hex
+        self._borrow_registering: Set[ObjectID] = set()
+        # Containment pins: container object -> refs serialized inside it
+        # (a put'ed list of refs, a returned dict of refs). Pinned while
+        # the container's entry lives; released when it is collected.
+        self._nested_pins: Dict[ObjectID, List[ObjectID]] = {}
+
         self._stats = {
             "tasks_submitted": 0,
             "tasks_finished": 0,
@@ -821,10 +833,11 @@ class NodeManager:
             await self.put_object(
                 msg["object_id"], msg["loc"], msg.get("refs", 1),
                 pin_if_new=msg.get("pin_if_new", False),
+                nested=msg.get("nested"),
             )
         elif mtype == "add_refs":
             for oid in msg["object_ids"]:
-                self.directory.add_ref(oid)
+                self._pin_ref_bg(oid)
         elif mtype == "remove_refs":
             for oid, count in msg["counts"].items():
                 self._remove_ref(oid, count)
@@ -1092,6 +1105,17 @@ class NodeManager:
             return await self._transfer.serve_chunk(msg)
         if mtype == "free_object":
             self._remove_ref(msg["object_id"])
+            return None
+        if mtype == "register_borrow":
+            # Owner side: a peer node holds live refs to our object; keep
+            # it (and its lineage) until the peer releases the borrow.
+            return {"ok": self.directory.add_borrower(
+                msg["object_id"], msg["borrower"]
+            )}
+        if mtype == "release_borrow":
+            self.directory.remove_borrower(
+                msg["object_id"], msg["borrower"]
+            )
             return None
         if mtype == "kill_actor_peer":
             await self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
@@ -1457,6 +1481,13 @@ class NodeManager:
             peer.close()
         elif peer is not None:
             peer.cancel()
+        # Borrows die with the node: void its registrations in our
+        # borrower sets (owner side) and forget owners that vanished
+        # (borrower side — releases to a ghost would just error).
+        self.directory.drop_borrower_node(node_hex)
+        for oid in [o for o, h in self._borrowed_from.items()
+                    if h == node_hex]:
+            self._borrowed_from.pop(oid, None)
         # Remote actors homed there are gone (mark before requeueing so
         # re-routed actor tasks fail with ActorDiedError, not a plain-worker
         # dispatch). Actor-restart-on-another-node is future work; creations
@@ -1535,11 +1566,12 @@ class NodeManager:
             # state is recovered by actor restart, not task replay).
             for oid in spec.return_ids():
                 self._lineage[oid] = spec
-        # Pin dependencies for the task's lifetime so owners dropping their
-        # refs mid-flight cannot free an argument (ref analogue: submitted
-        # task references in ReferenceCounter).
-        for oid in spec.dependency_ids():
-            self.directory.add_ref(oid)
+        # Pin dependencies AND refs smuggled inside argument values for
+        # the task's lifetime so owners dropping their refs mid-flight
+        # cannot free an argument (ref analogue: submitted task references
+        # + nested ids in ReferenceCounter).
+        for oid in spec.pinned_ids():
+            self._pin_ref_bg(oid)
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             # Register the pending actor synchronously so method calls that
             # land during async placement queue instead of failing (ref
@@ -1558,7 +1590,10 @@ class NodeManager:
             self._waiting[spec.task_id] = (record, missing)
             for oid in missing:
                 self._dep_index.setdefault(oid, set()).add(spec.task_id)
-                if self.directory.lookup(oid) is None:
+                if (self.directory.lookup(oid) is None
+                        or oid in self._borrow_stubs):
+                    # Unknown here — or only a count-only borrow stub
+                    # (the pin above created one): find the real copy.
                     asyncio.ensure_future(self._locate_missing(oid))
                 elif oid in self._lineage:
                     # Entry exists but is unsealed: either its creating task
@@ -1747,7 +1782,15 @@ class NodeManager:
         if nid is None or nid == self.node_id:
             return False
         self._seal_object(oid, RemoteLocation(nid.hex(), 0))
-        self.directory.add_ref(oid)
+        # Any entry for a remotely-owned object is a borrow this node
+        # must register with the owner (owner already resolved — pass it
+        # through instead of repeating the locate RPC). The holder's +1
+        # delta lands BEFORE the blocking lookup that triggered this
+        # (runtimes flush ref deltas ahead of blocking requests on the
+        # same connection), so the count here is already the holder's —
+        # no compensating pin (the old interim scheme's) is needed.
+        self._borrow_stubs.add(oid)
+        await self._register_borrow(oid, owner_hex=nid.hex())
         return True
 
     def _infeasible_may_wait(self, record: TaskRecord) -> bool:
@@ -2168,6 +2211,13 @@ class NodeManager:
         task_id: TaskID = msg["task_id"]
         record = self._tasks.get(task_id)
         results: List[Tuple[ObjectID, Location]] = msg["results"]
+        # Apply the worker's ref deltas FIRST — even for a record already
+        # dropped by cancellation/failure: drain() removed them from the
+        # worker's table, so this frame is their only carrier; dropping
+        # them would desynchronize counts permanently.
+        deltas = msg.get("ref_deltas")
+        if deltas:
+            await self._apply_ref_deltas(deltas)
         if record is None:
             # Cancelled/failed while the done frame was in flight: the
             # seals already happened (_fail_task), but the worker's
@@ -2178,6 +2228,12 @@ class NodeManager:
             return
         for oid, loc in results:
             self._seal_object(oid, loc)
+        # Returns' contained refs BEFORE dropping the task's pins /
+        # notifying the origin: a ref returned inside a container must be
+        # pinned — and any cross-node borrow registered with its owner —
+        # while the submission-time pin still protects the object.
+        for roid, nested in (msg.get("nested") or ()):
+            self._register_nested(roid, nested)
         if msg.get("failed"):
             self._stats["tasks_failed"] += 1
             record.state = "failed"
@@ -2253,7 +2309,7 @@ class NodeManager:
         if record.deps_unpinned:
             return
         record.deps_unpinned = True
-        for oid in record.spec.dependency_ids():
+        for oid in record.spec.pinned_ids():
             self.directory.remove_ref(oid)
 
     def _fail_task(self, record: TaskRecord, error: TaskError):
@@ -2547,7 +2603,8 @@ class NodeManager:
     # ---------------------------------------------------------------- objects
 
     async def put_object(self, object_id: ObjectID, loc: Location,
-                         refs: int = 1, *, pin_if_new: bool = False):
+                         refs: int = 1, *, pin_if_new: bool = False,
+                         nested: Optional[List[ObjectID]] = None):
         # pin_if_new: carry ``refs`` only when the directory has no entry
         # yet (streaming re-seal after a retry — a surviving original entry
         # keeps its original pin; adding more would leak it permanently).
@@ -2555,6 +2612,10 @@ class NodeManager:
             refs = 0
         self.directory.add(object_id, loc, initial_refs=refs)
         self._seal_object(object_id, loc)
+        if nested:
+            # Refs serialized inside the put value stay alive as long as
+            # the containing object does (AddNestedObjectIds analogue).
+            self._register_nested(object_id, nested)
 
     async def get_locations(
         self, object_ids: List[ObjectID], timeout: Optional[float] = None
@@ -2562,8 +2623,10 @@ class NodeManager:
         events = []
         for oid in object_ids:
             if oid not in self._sealed:
-                if self.directory.lookup(oid) is None:
-                    # Never registered here: try the GCS object directory
+                if (self.directory.lookup(oid) is None
+                        or oid in self._borrow_stubs):
+                    # Never registered here (or only as a count-only
+                    # borrow stub): try the GCS object directory
                     # (cross-node borrow), then lineage re-execution, else
                     # fail loudly — waiting would hang forever (ref analogue:
                     # OwnershipBasedObjectDirectory lookup before PullManager
@@ -2890,6 +2953,109 @@ class NodeManager:
     def _remove_ref(self, object_id: ObjectID, count: int = 1):
         self.directory.remove_ref(object_id, count)
 
+    # ------------------------------------------------------ borrower protocol
+
+    def _pin_ref(self, oid: ObjectID, count: int = 1) -> bool:
+        """Stub-aware increment (NM loop only). When this node has no
+        entry for ``oid`` — a ref to an object owned elsewhere — create a
+        count-only borrow stub and register this node as a borrower with
+        the owner (async). Returns True when a NEW stub was created, so
+        completion paths can await the registration explicitly."""
+        created = self.directory.add_ref_or_create(
+            oid, count, InlineLocation(b"")
+        )
+        if created:
+            self._borrow_stubs.add(oid)
+        return created
+
+    def _pin_ref_bg(self, oid: ObjectID, count: int = 1):
+        """_pin_ref + fire-and-forget borrow registration (callers that
+        have no async context)."""
+        if self._pin_ref(oid, count):
+            self._spawn_bg(self._register_borrow(oid))
+
+    async def _register_borrow(self, oid: ObjectID,
+                               owner_hex: Optional[str] = None):
+        """Resolve the owner of a borrow stub through the GCS object
+        directory (unless the caller already knows it) and register this
+        node in its borrower set. Idempotent; a failure leaves the stub
+        unregistered (reads fail loudly if the owner frees it — same
+        contract as an unregistered smuggled ref in the reference before
+        the borrow lands)."""
+        if self._gcs is None or not self._multi_node:
+            return
+        if oid in self._borrowed_from or oid in self._borrow_registering:
+            return
+        if oid not in self._borrow_stubs:
+            return
+        self._borrow_registering.add(oid)
+        try:
+            if owner_hex is None:
+                try:
+                    nid = await self._gcs.locate_object(
+                        oid, timeout=self.config.object_locate_timeout_s
+                    )
+                except Exception:
+                    return
+                if nid is None or nid == self.node_id:
+                    return
+                owner_hex = nid.hex()
+            try:
+                peer = await self._get_peer(owner_hex)
+                reply = await peer.request(
+                    {"type": "register_borrow", "object_id": oid,
+                     "borrower": self.node_id.hex()}
+                )
+            except Exception:
+                return
+            if reply.get("ok"):
+                if oid in self._borrow_stubs:
+                    self._borrowed_from[oid] = owner_hex
+                else:
+                    # The local entry was collected while the
+                    # registration was in flight: undo it at the owner
+                    # now, or the borrow pins the object forever.
+                    self._spawn_bg(self._release_borrow(owner_hex, oid))
+        finally:
+            self._borrow_registering.discard(oid)
+
+    async def _release_borrow(self, owner_hex: str, oid: ObjectID):
+        try:
+            peer = await self._get_peer(owner_hex)
+            await peer.notify(
+                {"type": "release_borrow", "object_id": oid,
+                 "borrower": self.node_id.hex()}
+            )
+        except Exception:
+            pass  # owner gone: nothing to release
+
+    async def _apply_ref_deltas(self, deltas: Dict[ObjectID, int]):
+        """Apply a worker's ref deltas shipped inside its task-completion
+        frame — BEFORE the task's pins are dropped, so a ref the worker
+        still holds (stored in actor state, returned inside a container)
+        is counted, and any new cross-node borrow is REGISTERED with the
+        owner, while the submission-time pin still protects the object."""
+        new_stubs = []
+        for oid, d in deltas.items():
+            if d > 0:
+                if self._pin_ref(oid, d):
+                    new_stubs.append(oid)
+            elif d < 0:
+                self._remove_ref(oid, -d)
+        for oid in new_stubs:
+            await self._register_borrow(oid)
+
+    def _register_nested(self, container: ObjectID,
+                         nested: List[ObjectID]):
+        """Pin refs serialized inside ``container`` until its directory
+        entry is collected (ref analogue: AddNestedObjectIds)."""
+        if not nested:
+            return
+        prior = self._nested_pins.setdefault(container, [])
+        for oid in nested:
+            prior.append(oid)
+            self._pin_ref_bg(oid)
+
     async def _gc_loop(self):
         grace = self.config.gc_grace_period_s
         while not self._shutdown:
@@ -2899,6 +3065,16 @@ class NodeManager:
                 self._seal_events.pop(oid, None)
                 self._lineage.pop(oid, None)
                 self._reconstructions.pop(oid, None)
+                # This node's borrow of the object ends with its entry.
+                self._borrow_stubs.discard(oid)
+                owner_hex = self._borrowed_from.pop(oid, None)
+                if owner_hex is not None:
+                    # _spawn_bg: strong ref + drained at shutdown, so the
+                    # release cannot be dropped mid-flight.
+                    self._spawn_bg(self._release_borrow(owner_hex, oid))
+                # Refs contained in this object lose their containment pin.
+                for nested_oid in self._nested_pins.pop(oid, ()):
+                    self._remove_ref(nested_oid)
                 if isinstance(loc, RemoteLocation):
                     if loc.held:
                         # Release the hold the remote node keeps for us.

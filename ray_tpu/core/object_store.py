@@ -430,10 +430,13 @@ class LocalObjectStore:
 class ObjectDirectory:
     """Node-wide object table kept by the control plane (head process).
 
-    Tracks location, size and per-process reference counts; frees segments
-    when the cluster-wide count drops to zero (ref analogue:
-    ReferenceCounter, src/ray/core_worker/reference_count.h, without
-    borrower/lineage chains — those live in the task manager layer).
+    Tracks location, size, aggregated local reference counts, AND the set
+    of peer nodes borrowing each object (ref analogue: ReferenceCounter,
+    src/ray/core_worker/reference_count.h — local refs + the borrower
+    set). An entry is freed only when its local count is <=0 AND no
+    borrower node is registered; lineage entries keyed on the object
+    survive exactly as long as the entry does, so lineage stays pinned
+    under borrowing.
     """
 
     def __init__(self, capacity_bytes: int):
@@ -447,6 +450,9 @@ class ObjectDirectory:
         self._refcounts: Dict[ObjectID, int] = {}
         self._zero_since: Dict[ObjectID, float] = {}
         self._access: Dict[ObjectID, int] = {}
+        # oid -> set of peer node hexes holding live borrows of this
+        # object (owner-side borrower tracking, reference_count.h:61).
+        self._borrowers: Dict[ObjectID, set] = {}
         self._access_counter = 0
         self._lock = threading.Lock()
 
@@ -517,9 +523,9 @@ class ObjectDirectory:
 
     def remove_ref(self, object_id: ObjectID, count: int = 1):
         """Decrement; collection is deferred to ``collect_garbage`` so that
-        out-of-order refcount flushes from different processes cannot free a
-        still-referenced object (interim scheme until the full borrower
-        protocol of the reference's ReferenceCounter lands)."""
+        out-of-order refcount flushes from different processes cannot free
+        a still-referenced object, and skipped entirely while peer nodes
+        hold registered borrows."""
         import time
 
         with self._lock:
@@ -528,6 +534,76 @@ class ObjectDirectory:
             self._refcounts[object_id] -= count
             if self._refcounts[object_id] <= 0:
                 self._zero_since.setdefault(object_id, time.monotonic())
+
+    # ---- borrower tracking (owner side) -------------------------------
+
+    def has_entry(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._entries
+
+    def add_ref_or_create(self, object_id: ObjectID, count: int,
+                          stub_loc: Location) -> bool:
+        """Increment if the entry exists; otherwise create a count-only
+        borrow stub at ``stub_loc``. Returns True when a stub was created
+        (single lock acquisition — this sits on the task-submit path)."""
+        with self._lock:
+            if object_id in self._refcounts:
+                self._refcounts[object_id] += count
+                if self._refcounts[object_id] > 0:
+                    self._zero_since.pop(object_id, None)
+                return False
+            self._entries[object_id] = stub_loc
+            self._refcounts[object_id] = count
+            self._access_counter += 1
+            self._access[object_id] = self._access_counter
+            if count <= 0:
+                import time
+
+                self._zero_since[object_id] = time.monotonic()
+            return True
+
+    def add_borrower(self, object_id: ObjectID, node_hex: str) -> bool:
+        """Register a peer node as a borrower. False = the object is
+        already gone (the borrower's reads will fail loudly)."""
+        with self._lock:
+            if object_id not in self._entries:
+                return False
+            self._borrowers.setdefault(object_id, set()).add(node_hex)
+            return True
+
+    def remove_borrower(self, object_id: ObjectID, node_hex: str):
+        import time
+
+        with self._lock:
+            s = self._borrowers.get(object_id)
+            if not s:
+                return
+            s.discard(node_hex)
+            if not s:
+                del self._borrowers[object_id]
+                if self._refcounts.get(object_id, 0) <= 0:
+                    # Fresh grace window: the release may race late
+                    # re-borrow registrations.
+                    self._zero_since[object_id] = time.monotonic()
+
+    def drop_borrower_node(self, node_hex: str):
+        """A node died: its borrows are void (ref analogue: borrower
+        cleanup on node removal)."""
+        import time
+
+        with self._lock:
+            for oid in [o for o, s in self._borrowers.items()
+                        if node_hex in s]:
+                s = self._borrowers[oid]
+                s.discard(node_hex)
+                if not s:
+                    del self._borrowers[oid]
+                    if self._refcounts.get(oid, 0) <= 0:
+                        self._zero_since[oid] = time.monotonic()
+
+    def borrower_count(self, object_id: ObjectID) -> int:
+        with self._lock:
+            return len(self._borrowers.get(object_id, ()))
 
     def collect_garbage(self, grace_s: float, limit: int = 4096):
         """Pop and return [(oid, loc)] for entries at refcount <= 0 for
@@ -542,7 +618,9 @@ class ObjectDirectory:
         with self._lock:
             expired = []
             for oid, t in self._zero_since.items():
-                if now - t >= grace_s and self._refcounts.get(oid, 0) <= 0:
+                if (now - t >= grace_s
+                        and self._refcounts.get(oid, 0) <= 0
+                        and oid not in self._borrowers):
                     expired.append(oid)
                     if len(expired) >= limit:
                         break
@@ -551,6 +629,7 @@ class ObjectDirectory:
                 self._refcounts.pop(oid, None)
                 self._zero_since.pop(oid, None)
                 self._access.pop(oid, None)
+                self._borrowers.pop(oid, None)
                 if loc is None:
                     continue
                 if isinstance(loc, (ShmLocation, ArenaLocation)):

@@ -59,6 +59,12 @@ class ObjectRef:
     def __reduce__(self):
         # Deserializing an ObjectRef in another process registers a new
         # local ref there (borrower accounting happens in __init__).
+        # Serializing one inside a value reports the containment to the
+        # active collection frame so the ownership layer can pin it for
+        # the containing object's lifetime (serialization.py).
+        from .serialization import note_serialized_ref
+
+        note_serialized_ref(self._id)
         return (_deserialize_ref, (self._id,))
 
     # Allow `await ref` when used inside async code paths.

@@ -50,7 +50,9 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         rt = current_runtime()
         function_id = rt.ensure_function(self._fn)
-        spec_args, spec_kwargs, keepalive = rt.prepare_args(args, kwargs)
+        spec_args, spec_kwargs, keepalive, nested = rt.prepare_args(
+            args, kwargs
+        )
         num_returns = self._options.get("num_returns", 1)
         streaming = num_returns in ("streaming", "dynamic")
         if streaming:
@@ -72,6 +74,7 @@ class RemoteFunction:
             max_retries=max_retries,
             retries_left=max_retries,
             scheduling_strategy=self._options.get("scheduling_strategy"),
+            nested_refs=nested,
         )
         refs = rt.submit(spec)
         del keepalive  # deps are pinned by the control plane from here on

@@ -20,7 +20,7 @@ from .function_table import FunctionCache, export_function
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import InlineLocation, LocalObjectStore, Location, ShmLocation
 from .reference import ObjectRef, ref_without_registration
-from .serialization import serialize
+from .serialization import serialize, serialize_with_refs
 from .task_spec import RefArg, TaskSpec, TaskType, ValueArg
 
 
@@ -69,6 +69,15 @@ class RefCountTable:
         if deltas:
             self._flush_fn(deltas)
 
+    def drain(self) -> Dict[ObjectID, int]:
+        """Take the pending deltas WITHOUT flushing them — they ride an
+        outbound completion frame instead, so the control plane applies
+        them before dropping the completing task's pins."""
+        with self._lock:
+            deltas = {k: v for k, v in self._deltas.items() if v != 0}
+            self._deltas.clear()
+        return deltas
+
 
 class BaseRuntime:
     """Shared logic: argument preparation, object read path, ref accounting."""
@@ -112,7 +121,8 @@ class BaseRuntime:
     ) -> List[ObjectID]:
         raise NotImplementedError
 
-    def _register_put(self, oid: ObjectID, loc: Location):
+    def _register_put(self, oid: ObjectID, loc: Location,
+                      nested: Optional[List[ObjectID]] = None):
         raise NotImplementedError
 
     def _register_function_remote(self, function_id: str, blob: bytes):
@@ -146,15 +156,16 @@ class BaseRuntime:
 
     def put(self, value) -> ObjectRef:
         oid = self._next_put_id()
-        loc = self._store_value(oid, value)
-        self._register_put(oid, loc)
-        return ObjectRef(oid, _register=True)
-
-    def _store_value(self, oid: ObjectID, value) -> Location:
-        sobj = serialize(value)
+        # Refs serialized inside the value are reported with the put so
+        # the control plane pins them for the containing object's
+        # lifetime (ref analogue: AddNestedObjectIds on Put).
+        sobj, nested = serialize_with_refs(value)
         if sobj.total_size <= get_config().max_inline_object_size:
-            return InlineLocation(sobj.to_bytes())
-        return self._put_serialized(oid, sobj)
+            loc: Location = InlineLocation(sobj.to_bytes())
+        else:
+            loc = self._put_serialized(oid, sobj)
+        self._register_put(oid, loc, nested)
+        return ObjectRef(oid, _register=True)
 
     def _put_serialized(self, oid: ObjectID, sobj) -> Location:
         """Large-object write path; the thin client overrides this to
@@ -272,27 +283,33 @@ class BaseRuntime:
     def prepare_args(self, args: Sequence[Any], kwargs: Dict[str, Any]):
         """Convert call arguments into spec args: ObjectRefs pass by
         reference; large values are promoted to objects (ref analogue:
-        put_threshold inlining in remote_function._remote)."""
+        put_threshold inlining in remote_function._remote). Refs found
+        INSIDE serialized values are returned as ``nested`` — the caller
+        stamps them onto the spec so the control plane pins them for the
+        task's lifetime (for promoted args they ride the promoted
+        object's containment pin instead)."""
         cfg = get_config()
         keepalive = []
+        nested_all: List[ObjectID] = []
 
         def conv(v):
             if isinstance(v, ObjectRef):
                 keepalive.append(v)
                 return RefArg(v.id())
-            sobj = serialize(v)
+            sobj, nested = serialize_with_refs(v)
             if sobj.total_size <= cfg.max_inline_object_size:
+                nested_all.extend(nested)
                 return ValueArg(sobj.to_bytes())
             oid = self._next_put_id()
             loc = self._put_serialized(oid, sobj)
-            self._register_put(oid, loc)
+            self._register_put(oid, loc, nested)
             ref = ObjectRef(oid, _register=True)
             keepalive.append(ref)
             return RefArg(oid)
 
         spec_args = [conv(a) for a in args]
         spec_kwargs = {k: conv(v) for k, v in kwargs.items()}
-        return spec_args, spec_kwargs, keepalive
+        return spec_args, spec_kwargs, keepalive, tuple(nested_all)
 
     def ensure_function(self, fn) -> str:
         # Identity-keyed fast path: re-pickling the function on every
@@ -400,7 +417,7 @@ class _DirectChannel:
         burst rides one socket write."""
         oid = spec.return_ids()[0]
         entry = _DirectResult()
-        dep_ids = list(spec.dependency_ids())
+        dep_ids = list(spec.pinned_ids())
         with self.plock:
             self.pending[spec.task_id] = (oid, entry, dep_ids)
             self.out_buf.append({"spec": spec, "function_blob": None})
@@ -452,7 +469,8 @@ class _DirectChannel:
         # location directory stay consistent a beat later.
         entry.payload = msg
         entry.event.set()
-        self.rt._dpost(("done", msg["results"], dep_ids or []))
+        self.rt._dpost(("done", msg["results"], dep_ids or [],
+                        msg.get("nested")))
 
     def _reader(self):
         from .protocol import ConnectionClosed
@@ -575,15 +593,19 @@ class DriverRuntime(BaseRuntime):
                 for oid in spec.return_ids():
                     nm.directory.add(oid, InlineLocation(b""),
                                      initial_refs=0)
-                for oid in spec.dependency_ids():
-                    nm.directory.add_ref(oid)
+                for oid in spec.pinned_ids():
+                    nm._pin_ref_bg(oid)
             else:  # "done"
-                _, results, dep_ids = item
+                _, results, dep_ids, nested = item
                 for roid, loc in results:
                     # The entry exists from the FIFO-earlier "reg" post;
                     # _seal_object swaps the placeholder for the real
                     # location and fires seal events.
                     nm._seal_object(roid, loc)
+                for roid, inner in (nested or ()):
+                    # Refs inside a direct-call return: pinned at THIS
+                    # node (direct results are owned by the caller's NM).
+                    nm._register_nested(roid, inner)
                 for oid in dep_ids:
                     nm._remove_ref(oid, 1)
 
@@ -737,7 +759,9 @@ class DriverRuntime(BaseRuntime):
             self._drain_dposts()
             for oid, d in deltas.items():
                 if d > 0:
-                    self._nm.directory.add_ref(oid, d)
+                    # Stub-aware: a ref to an object owned by another
+                    # node creates a borrow stub + owner registration.
+                    self._nm._pin_ref_bg(oid, d)
                 else:
                     self._nm._remove_ref(oid, -d)
 
@@ -799,13 +823,17 @@ class DriverRuntime(BaseRuntime):
     def _get_locations(self, ids, timeout):
         # asyncio.TimeoutError is TimeoutError on py>=3.11, so callers'
         # `except TimeoutError` handles loop-side timeouts directly.
+        # Flush ref deltas first so the NM sees this process's holds
+        # (borrow-stub creation) before resolving locations.
+        self.refs.flush()
         return self._nm.call_sync(self._nm.get_locations(ids, timeout))
 
     def _wait(self, ids, num_returns, timeout):
         return self._nm.call_sync(self._nm.wait_objects(ids, num_returns, timeout))
 
-    def _register_put(self, oid: ObjectID, loc: Location):
-        self._post(self._nm.put_object(oid, loc, refs=0))
+    def _register_put(self, oid: ObjectID, loc: Location,
+                      nested: Optional[List[ObjectID]] = None):
+        self._post(self._nm.put_object(oid, loc, refs=0, nested=nested))
 
     def _register_function_remote(self, function_id: str, blob: bytes):
         self._nm.call_sync(self._nm.register_function(function_id, blob))
@@ -964,6 +992,10 @@ class WorkerRuntime(BaseRuntime):
         self._conn.send({"type": "submit", "spec": spec})
 
     def _get_locations(self, ids, timeout):
+        # Ref deltas must land before the lookup: the NM's borrow logic
+        # relies on the holder's +1 arriving ahead of the blocking read
+        # (frames on this connection are processed in order).
+        self.refs.flush()
         self._conn.send({"type": "blocked"})
         try:
             reply = self.request(
@@ -1000,8 +1032,12 @@ class WorkerRuntime(BaseRuntime):
                 pass
         return reply["ready"]
 
-    def _register_put(self, oid: ObjectID, loc: Location):
-        self._conn.send({"type": "put", "object_id": oid, "loc": loc, "refs": 0})
+    def _register_put(self, oid: ObjectID, loc: Location,
+                      nested: Optional[List[ObjectID]] = None):
+        msg = {"type": "put", "object_id": oid, "loc": loc, "refs": 0}
+        if nested:
+            msg["nested"] = nested
+        self._conn.send(msg)
 
     def _register_function_remote(self, function_id: str, blob: bytes):
         self._conn.send(

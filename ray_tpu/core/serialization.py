@@ -19,12 +19,54 @@ from __future__ import annotations
 
 import pickle
 import struct
+import threading
 from typing import List, Tuple
 
 import cloudpickle
 
 MAGIC = 0x52545055  # "RTPU"
 _ALIGN = 64
+
+# ---------------------------------------------------------------- nested refs
+#
+# ObjectRefs pickled INSIDE a value (a ref smuggled in a container arg, a
+# ref stored in a put object, a ref returned from a task) must be visible
+# to the ownership layer or the object they name can be freed while still
+# reachable (ref analogue: the contained-object-ID tracking feeding
+# ReferenceCounter::AddNestedObjectIds, reference_count.h:61). Serializers
+# that need them open a collection frame; ObjectRef.__reduce__ reports
+# into the innermost frame.
+
+_nested = threading.local()
+
+
+def note_serialized_ref(object_id) -> None:
+    """Called by ObjectRef.__reduce__: record that a ref to ``object_id``
+    was embedded in the value currently being serialized (no-op outside a
+    collection frame)."""
+    stack = getattr(_nested, "stack", None)
+    if stack:
+        stack[-1].append(object_id)
+
+
+def serialize_with_refs(obj) -> Tuple["SerializedObject", List]:
+    """Serialize and return (serialized, [contained ObjectIDs])."""
+    stack = getattr(_nested, "stack", None)
+    if stack is None:
+        stack = _nested.stack = []
+    stack.append([])
+    try:
+        sobj = serialize(obj)
+    finally:
+        collected = stack.pop()
+    # De-dup, preserving order (one pin per distinct contained ref).
+    seen = set()
+    out = []
+    for oid in collected:
+        if oid not in seen:
+            seen.add(oid)
+            out.append(oid)
+    return sobj, out
 
 
 def _align(n: int) -> int:

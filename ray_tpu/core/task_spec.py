@@ -87,6 +87,11 @@ class TaskSpec:
     # NodeLabelSchedulingStrategy (ref analogue: TaskSpec scheduling_strategy
     # in common.proto + util/scheduling_strategies.py)
     scheduling_strategy: Any = None
+    # ObjectIDs of refs embedded INSIDE serialized argument values (not
+    # top-level RefArgs): pinned for the task's lifetime like
+    # dependencies, but never resolved to values (ref analogue: nested
+    # ids recorded per task in ReferenceCounter, reference_count.h:61).
+    nested_refs: Tuple[ObjectID, ...] = ()
 
     def return_ids(self) -> Tuple[ObjectID, ...]:
         return tuple(
@@ -97,3 +102,9 @@ class TaskSpec:
         deps = [a.object_id for a in self.args if isinstance(a, RefArg)]
         deps += [a.object_id for a in self.kwargs.values() if isinstance(a, RefArg)]
         return tuple(deps)
+
+    def pinned_ids(self) -> Tuple[ObjectID, ...]:
+        """Everything the control plane holds alive while the task is in
+        flight: resolved dependencies plus refs smuggled inside argument
+        values."""
+        return self.dependency_ids() + tuple(self.nested_refs)

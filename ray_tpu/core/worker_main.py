@@ -250,7 +250,8 @@ class Worker:
                     )
                     continue
             with self._serial_lock:
-                done = self._run_task(spec, msg.get("function_blob"))
+                done = self._run_task(spec, msg.get("function_blob"),
+                                      to_nm=True)
             if (
                 spec.task_type == TaskType.ACTOR_CREATION_TASK
                 and not done.get("failed")
@@ -426,11 +427,16 @@ class Worker:
 
     def _flush_before_block(self):
         """Runtime before-blocking hook: ship every buffered completion
-        (NM dones AND direct replies) before waiting on the node manager
-        — a nested get must never wait on a seal stranded in our own
-        outbound buffers."""
+        (NM dones AND direct replies) AND pending ref deltas before
+        waiting on the node manager — a nested get must never wait on a
+        seal stranded in our own outbound buffers, and the NM's borrow
+        logic needs our +1s applied before it resolves the read."""
         self._flush_dones()
         self._flush_direct_replies()
+        try:
+            self.runtime.refs.flush()
+        except Exception:
+            pass
 
     def _run_direct(self, conn, spec, function_blob):
         done = self._run_task(spec, function_blob)
@@ -455,7 +461,8 @@ class Worker:
         sent immediately — there is no queue-drain point to batch on."""
         self.conn.send(self._run_task(spec, function_blob))
 
-    def _run_task(self, spec: TaskSpec, function_blob) -> dict:
+    def _run_task(self, spec: TaskSpec, function_blob,
+                  to_nm: bool = False) -> dict:
         self._apply_runtime_env(spec.runtime_env_key)
         rt = self.runtime
         cache: FunctionCache = rt.function_cache
@@ -517,15 +524,21 @@ class Worker:
                 except Exception:
                     pass
             oid = stream_item_id(spec.task_id, index)
-            loc = rt.store.put_serialized(oid, _ser(value))
+            from .serialization import serialize_with_refs as _ser_refs
+
+            sobj, nested = _ser_refs(value)
+            loc = rt.store.put_serialized(oid, sobj)
             # Seal with one pinned ref (consumed by the reader's adopt).
             # pin_if_new: if a prior attempt's entry survived in this
             # node's directory (worker crash, store alive), its pin is
             # still held — adding another would leak; if the object died
             # with its node, the fresh entry needs its own pin or the
             # consumer's register/decr coalesce could GC it unread.
-            self.conn.send({"type": "put", "object_id": oid, "loc": loc,
-                            "refs": 1, "pin_if_new": True})
+            msg = {"type": "put", "object_id": oid, "loc": loc,
+                   "refs": 1, "pin_if_new": True}
+            if nested:
+                msg["nested"] = nested
+            self.conn.send(msg)
             rt.kv_put(key, cloudpickle.dumps({"oid": oid.hex()}))
 
         rt.current_task_id = spec.task_id
@@ -542,7 +555,7 @@ class Worker:
         prev_span = enter_span(trace_id, span_id)
         _t0 = _time.time()
         try:
-            results, failed = execute_task(
+            results, failed, nested = execute_task(
                 spec, load_function, fetch, store_large, self.actor,
                 stream_item=stream_item if spec.streaming else None,
             )
@@ -560,12 +573,28 @@ class Worker:
                 )
             except Exception:
                 pass
-        return {
+        done = {
             "type": "task_done",
             "task_id": spec.task_id,
             "results": results,
             "failed": failed,
         }
+        if nested:
+            # Refs serialized inside return values: the NM pins them for
+            # each return's lifetime (AddNestedObjectIds analogue).
+            done["nested"] = nested
+        if to_nm:
+            # Ship this worker's pending ref deltas WITH the completion
+            # so the NM counts refs we still hold (e.g. stored in actor
+            # state) before it drops the task's submission-time pins —
+            # the flush race the old interim scheme papered over with the
+            # GC grace period. Direct-path completions bypass our NM (the
+            # frame goes to the caller), so there the periodic flusher
+            # keeps carrying the deltas to the right directory.
+            deltas = rt.refs.drain()
+            if deltas:
+                done["ref_deltas"] = deltas
+        return done
 
 
 def main():
