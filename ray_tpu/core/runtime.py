@@ -44,11 +44,16 @@ class RefCountTable:
     directory (ref analogue: local refs in reference_count.h, flushed like
     the batched release RPCs)."""
 
-    def __init__(self, flush_fn):
+    def __init__(self, flush_fn, on_zero=None):
         self._local: Dict[ObjectID, int] = {}
         self._deltas: Dict[ObjectID, int] = {}
         self._lock = threading.Lock()
         self._flush_fn = flush_fn
+        # Called (outside the lock) when this process's last local ref
+        # to an object drops — the runtime invalidates its location
+        # cache so a later stale read misses and resolves (and errors)
+        # through the control plane instead of serving freed data.
+        self._on_zero = on_zero
 
     def incr(self, oid: ObjectID):
         with self._lock:
@@ -56,11 +61,15 @@ class RefCountTable:
             self._deltas[oid] = self._deltas.get(oid, 0) + 1
 
     def decr(self, oid: ObjectID):
+        zero = False
         with self._lock:
             self._local[oid] = self._local.get(oid, 0) - 1
             if self._local[oid] <= 0:
                 del self._local[oid]
+                zero = True
             self._deltas[oid] = self._deltas.get(oid, 0) - 1
+        if zero and self._on_zero is not None:
+            self._on_zero(oid)
 
     def flush(self):
         with self._lock:
@@ -88,7 +97,11 @@ class BaseRuntime:
         self.worker_id = worker_id
         self.store = LocalObjectStore()
         self.function_cache = FunctionCache()
-        self.refs = RefCountTable(self._flush_deltas)
+        self._loc_cache: Dict[ObjectID, Location] = {}
+        self.refs = RefCountTable(
+            self._flush_deltas,
+            on_zero=lambda oid: self._loc_cache.pop(oid, None),
+        )
         self._put_counter = itertools.count(1)
         self.current_task_id: Optional[TaskID] = None
         # KV key of this job's published runtime env ("" = none); stamped
@@ -210,7 +223,7 @@ class BaseRuntime:
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
             try:
-                locations = self._get_locations(rest_ids, remaining)
+                locations = self._cached_locations(rest_ids, remaining)
             except TimeoutError as e:
                 raise GetTimeoutError(
                     f"get() timed out after {timeout}s waiting for "
@@ -251,6 +264,9 @@ class BaseRuntime:
             try:
                 return self.store.get_object(loc)
             except (KeyError, FileNotFoundError):
+                # Bypass + invalidate the location cache: the cached
+                # location is exactly what just went stale.
+                self._loc_cache.pop(oid, None)
                 (_, loc), = self._get_locations([oid], timeout)
                 if loc is None:
                     # Permanently gone, not slow: no node holds a copy.
@@ -259,6 +275,52 @@ class BaseRuntime:
                         "remaining location)"
                     ) from None
         return self.store.get_object(loc)
+
+    # ---- location cache ----------------------------------------------------
+    # Objects are immutable and ObjectIDs are never reused, so a resolved
+    # location stays valid until the storage moves (spill/re-home/free) —
+    # and _read_object already retries through a fresh lookup for exactly
+    # those cases. Caching turns the per-call control-plane round trip of
+    # repeated-argument fetches (same ref passed to many actor calls)
+    # into a dict hit.
+
+    _LOC_CACHE_CAP = 8192
+    _LOC_CACHE_INLINE_MAX = 4096  # don't pin big inline blobs in memory
+
+    def _cached_locations(
+        self, ids: List[ObjectID], timeout: Optional[float]
+    ) -> List[Tuple[ObjectID, Location]]:
+        # The borrow protocol requires this process's +1 deltas to land
+        # before any read resolves — including cache-hit reads, where no
+        # control-plane lookup (with its own flush) happens. No-op when
+        # there are no pending deltas.
+        self.refs.flush()
+        cache = self._loc_cache
+        # Snapshot hits while scanning: the cache is shared across
+        # threads (cap clears, stale-read invalidation), so re-reading
+        # it at return time could turn a hit into a spurious miss.
+        hits: Dict[ObjectID, Location] = {}
+        missing: List[ObjectID] = []
+        for i in ids:
+            loc = cache.get(i)
+            if loc is None:
+                missing.append(i)
+            else:
+                hits[i] = loc
+        if missing:
+            fetched = dict(self._get_locations(missing, timeout))
+            if len(cache) + len(fetched) > self._LOC_CACHE_CAP:
+                cache.clear()  # rare; amortized O(1)
+            for i, loc in fetched.items():
+                if loc is None:
+                    continue
+                if (isinstance(loc, InlineLocation)
+                        and len(loc.data) > self._LOC_CACHE_INLINE_MAX):
+                    continue
+                cache[i] = loc
+        else:
+            fetched = {}
+        return [(i, hits.get(i, fetched.get(i))) for i in ids]
 
     def wait(
         self,
@@ -406,6 +468,13 @@ class _DirectChannel:
         self.out_buf: List[Dict[str, Any]] = []
         self._fences: Dict[int, threading.Event] = {}
         self._fence_seq = itertools.count(1)
+        # Call-frame templates (wire-size fast path): the first call of a
+        # given (method, group) shape ships its full spec and registers
+        # it under a small id; subsequent calls ship ~60-byte frames of
+        # (template id, task id, args) — the per-call TaskSpec pickle
+        # (~650 B, ~15 us each way) dominates trivial-call frames.
+        self._templates: Dict[tuple, int] = {}
+        self._template_seq = itertools.count(1)
         threading.Thread(
             target=self._reader, name="ray_tpu-direct-reader", daemon=True
         ).start()
@@ -418,9 +487,29 @@ class _DirectChannel:
         oid = spec.return_ids()[0]
         entry = _DirectResult()
         dep_ids = list(spec.pinned_ids())
+        # Templatable = everything per-call is carried by the compact
+        # frame (task id, args, nested refs). Tracing submit-spans needs
+        # the real trace ctx, so templating is off under that flag.
+        key = (spec.method_name, spec.concurrency_group)
+        frame: Dict[str, Any]
+        if _TRACE_SUBMITS or spec.streaming:
+            frame = {"spec": spec, "function_blob": None}
+        else:
+            tid = self._templates.get(key)
+            if tid is None:
+                tid = next(self._template_seq)
+                self._templates[key] = tid
+                frame = {"spec": spec, "function_blob": None,
+                         "tmpl_reg": tid}
+            else:
+                frame = {"t": tid, "i": spec.task_id.binary()}
+                if spec.args or spec.kwargs:
+                    frame["a"] = (spec.args, spec.kwargs)
+                if spec.nested_refs:
+                    frame["n"] = spec.nested_refs
         with self.plock:
             self.pending[spec.task_id] = (oid, entry, dep_ids)
-            self.out_buf.append({"spec": spec, "function_blob": None})
+            self.out_buf.append(frame)
         self.rt._direct_waiters_put(oid, entry)
         self.rt._mark_chan_dirty(self)
         # Return-slot + arg-pin registration: buffered without a loop
@@ -572,13 +661,26 @@ class DriverRuntime(BaseRuntime):
         reply/delta-flush (safe for "reg" items: the buffer is FIFO so a
         reg always applies before its own call's "done", and
         _flush_deltas drains first so ref deltas never see a missing
-        entry) — a sync call then costs ONE loop wakeup, not two."""
+        entry). wake=True schedules a COALESCED drain a couple of
+        milliseconds out instead of draining immediately: a tight
+        sync-call loop otherwise pays for the previous call's
+        seal/unpin work (GIL-held on the NM loop) inside its own send
+        path — measured ~100us per call on one core. Consumers in other
+        processes see seals at most one coalesce window late."""
         with self._dpost_lock:
             self._dpost_buf.append(item)
             if not wake or self._dpost_waking:
                 return
             self._dpost_waking = True
-        self._nm._loop.call_soon_threadsafe(self._drain_dposts)
+        self._nm._loop.call_soon_threadsafe(self._schedule_dpost_drain)
+
+    _DPOST_COALESCE_S = 0.002
+
+    def _schedule_dpost_drain(self):
+        # On the loop: batch the burst behind a short timer; everything
+        # posted inside the window drains in one pass.
+        self._nm._loop.call_later(self._DPOST_COALESCE_S,
+                                  self._drain_dposts)
 
     def _drain_dposts(self):
         with self._dpost_lock:

@@ -16,7 +16,7 @@ from typing import List
 
 from .executor import ActorContainer, execute_task
 from .function_table import FunctionCache
-from .ids import JobID, NodeID, ObjectID, WorkerID
+from .ids import JobID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import Location
 from .protocol import Connection, ConnectionClosed, connect_unix
 from .runtime import WorkerRuntime
@@ -342,8 +342,39 @@ class Worker:
         lock), via the pool for concurrent actors. Replies batch while a
         frame batch is being chewed through. A fence frame acks once
         every earlier frame from this connection has executed — callers
-        use it to order a control-plane-routed call after direct ones."""
+        use it to order a control-plane-routed call after direct ones.
+
+        Frames come in two shapes: full ({"spec", "function_blob"},
+        optionally registering a template via "tmpl_reg") and compact
+        ({"t": template id, "i": task id bytes, "a": (args, kwargs),
+        "n": nested refs}) — the caller ships each (method, group)
+        shape's spec once and then ~60-byte frames (see
+        _DirectChannel.submit)."""
+        import copy as _copy
+
         group_futs: list = []
+        templates: dict = {}  # per-connection template id -> TaskSpec
+
+        def decode(m):
+            tid = m.get("t")
+            if tid is None:
+                spec = m["spec"]
+                reg = m.get("tmpl_reg")
+                if reg is not None:
+                    templates[reg] = spec
+                return spec, m.get("function_blob")
+            tmpl = templates[tid]
+            spec = _copy.copy(tmpl)
+            spec.task_id = TaskID(m["i"])
+            a = m.get("a")
+            if a is not None:
+                spec.args, spec.kwargs = a
+            else:
+                spec.args, spec.kwargs = [], {}
+            spec.nested_refs = m.get("n", ())
+            spec.trace_ctx = None  # span derives from the new task id
+            return spec, None
+
         try:
             while self._alive:
                 msg = conn.recv()
@@ -356,29 +387,25 @@ class Worker:
                         group_futs = [f for f in group_futs if not f.done()]
                     routed = []
                     for m in items:
+                        spec, blob = decode(m)
                         gp = self._group_pools.get(
-                            getattr(m["spec"], "concurrency_group", "")
+                            getattr(spec, "concurrency_group", "")
                         )
                         if gp is not None:
                             group_futs.append(gp.submit(
-                                self._run_direct, conn, m["spec"],
-                                m.get("function_blob"),
+                                self._run_direct, conn, spec, blob,
                             ))
                         else:
-                            routed.append(m)
-                    items = routed
+                            routed.append((spec, blob))
                     if self._pool is not None:
-                        for m in items:
+                        for spec, blob in routed:
                             group_futs.append(self._pool.submit(
-                                self._run_direct, conn, m["spec"],
-                                m.get("function_blob"),
+                                self._run_direct, conn, spec, blob,
                             ))
                         continue
-                    for m in items:
+                    for spec, blob in routed:
                         with self._serial_lock:
-                            done = self._run_task(
-                                m["spec"], m.get("function_blob")
-                            )
+                            done = self._run_task(spec, blob)
                         with self._dr_lock:
                             _, buf = self._dr_bufs.setdefault(
                                 id(conn), (conn, [])
@@ -484,7 +511,7 @@ class Worker:
 
             # Values come straight from locations; errors raise (propagating
             # dependency failures, matching the reference's semantics).
-            locations = rt._get_locations(ids, None)
+            locations = rt._cached_locations(ids, None)
             values = []
             from .exceptions import TaskError
 
