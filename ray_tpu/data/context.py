@@ -10,9 +10,15 @@ import dataclasses
 class DataContext:
     target_max_block_size: int = 128 * 1024 * 1024
     # Streaming backpressure: max concurrently in-flight block tasks per
-    # operator chain (ref analogue: backpressure policies in
+    # operator chain (ref analogue: ConcurrencyCapBackpressurePolicy in
     # _internal/execution/backpressure_policy/).
     max_in_flight_tasks: int = 8
+    # Resource-aware backpressure (ref analogue: the output-size /
+    # object-store-usage policies): stages stop SUBMITTING new block
+    # tasks while the local object store is fuller than this fraction —
+    # a slow consumer therefore bounds producer memory instead of
+    # filling the store / forcing spills. <= 0 disables.
+    store_usage_cap_fraction: float = 0.8
     # Prefetch depth for iter_batches / device feed.
     prefetch_batches: int = 2
     use_remote_tasks: bool = True

@@ -26,7 +26,14 @@ from .block import (
     normalize_to_block,
 )
 from .context import DataContext
-from .streaming_executor import ActorStage, TaskStage, execute, execute_refs
+from .streaming_executor import (
+    ActorStage,
+    TaskStage,
+    UnionSource,
+    ZipSource,
+    execute,
+    execute_refs,
+)
 
 
 # ----------------------------------------------------------- logical plan
@@ -222,7 +229,9 @@ class Dataset:
         shuffle output is consumed — it keeps the intermediate refs alive
         past this driver frame."""
         single_task = (
-            len(self._stages) == 1 and isinstance(self._stages[0], TaskStage)
+            not self._is_node_plan()
+            and len(self._stages) == 1
+            and isinstance(self._stages[0], TaskStage)
         )
         if single_task and not materialize:
             return self._sources, self._stages[0].ops, None
@@ -267,7 +276,7 @@ class Dataset:
         if self._use_remote():
             import random as _random
 
-            num = max(1, len(self._sources))
+            num = max(1, self.num_blocks())
             return self._shuffled(
                 num, "random",
                 seed if seed is not None else _random.randrange(2 ** 31),
@@ -275,7 +284,7 @@ class Dataset:
         full = self._materialize_table()
         idx = np.random.RandomState(seed).permutation(full.num_rows)
         shuffled = BlockAccessor(full).take_indices(idx)
-        num = max(1, len(self._sources))
+        num = max(1, self.num_blocks())
         return Dataset.from_blocks([shuffled]).repartition(num)
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
@@ -301,11 +310,31 @@ class Dataset:
             idx = idx[::-1]
         return Dataset.from_blocks([BlockAccessor(full).take_indices(idx)])
 
-    def union(self, other: "Dataset") -> "Dataset":
-        a = self.materialize()
-        b = other.materialize()
-        return Dataset(a._sources + b._sources,
-                       _pin=(a._pin, b._pin))
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Streaming concatenation: upstream datasets execute their own
+        chains and their block streams concatenate in order — an
+        operator-DAG fan-in, nothing materializes on the driver (ref:
+        Dataset.union over the executor's operator graph)."""
+        inputs = [self, *others]
+        return Dataset(UnionSource(inputs),
+                       _pin=tuple(d._pin for d in inputs))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Pairwise block zip: block i of ``self`` merges columns with
+        block i of ``other`` (right-side name collisions get a ``_1``
+        suffix). Both datasets must be identically blocked — same block
+        count and per-block row counts (ref: Dataset.zip)."""
+        return Dataset(ZipSource(self, other),
+                       _pin=(self._pin, other._pin))
+
+    def _is_node_plan(self) -> bool:
+        return isinstance(self._sources, (UnionSource, ZipSource))
+
+    def _ensure_flat(self) -> "Dataset":
+        """A dataset whose sources are a flat thunk list — node-sourced
+        plans (union/zip) materialize their blocks first (needed by the
+        source-indexed paths: split, streaming_split, shuffles)."""
+        return self.materialize() if self._is_node_plan() else self
 
     def limit(self, n: int) -> "Dataset":
         out, taken = [], 0
@@ -360,6 +389,16 @@ class Dataset:
                 leftover = acc.slice(start, n)
         if leftover is not None and leftover.num_rows and not drop_last:
             yield batch_to_format(leftover, batch_format)
+
+    def iter_blocks_refs(self) -> Iterator[Any]:
+        """Streaming execution yielding per-block ObjectRefs (the blocks
+        stay in the object store; nothing materializes on the driver) —
+        the consumption surface backpressure acts through."""
+        from .streaming_executor import ExecStats
+
+        self._last_stats = ExecStats()
+        yield from execute_refs(self._sources, self._stages,
+                                self._last_stats)
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for block in self._iter_blocks():
@@ -428,6 +467,10 @@ class Dataset:
         return list(s.names) if s else []
 
     def num_blocks(self) -> int:
+        if isinstance(self._sources, UnionSource):
+            return sum(d.num_blocks() for d in self._sources.datasets)
+        if isinstance(self._sources, ZipSource):
+            return self._sources.left.num_blocks()
         return len(self._sources)
 
     def show(self, n: int = 20):
@@ -452,7 +495,7 @@ class Dataset:
             len(s.ops) if isinstance(s, TaskStage) else 1
             for s in self._stages
         )
-        return (f"Dataset(blocks={len(self._sources)}, "
+        return (f"Dataset(blocks={self.num_blocks()}, "
                 f"stages={len(self._stages)}, ops={nops})")
 
     # ---- write sinks (distributed per-block writes) ----
@@ -510,13 +553,31 @@ class Dataset:
         streaming_split). Shard i consumes source blocks i, i+n, ..."""
         from .iterator import DataIterator
 
-        return [DataIterator(self, shard_index=i, num_shards=n)
+        if self._is_node_plan() and self._use_remote():
+            # DAG plans stream through ONE shared coordinator actor
+            # (executes the plan once, deals blocks round-robin with
+            # bounded buffers) — splitting must not materialize the
+            # upstream (ref: OutputSplitter behind streaming_split).
+            import cloudpickle
+
+            import ray_tpu
+            from .iterator import _SplitCoordinator
+
+            coord = ray_tpu.remote(max_concurrency=n + 1)(
+                _SplitCoordinator
+            ).remote(cloudpickle.dumps(self), n)
+            return [DataIterator(self, shard_index=i, num_shards=n,
+                                 coordinator=coord)
+                    for i in range(n)]
+        flat = self._ensure_flat()
+        return [DataIterator(flat, shard_index=i, num_shards=n)
                 for i in range(n)]
 
     def split(self, n: int) -> List["Dataset"]:
+        flat = self._ensure_flat()
         return [
-            Dataset(self._sources[i::n], list(self._stages),
-                    _pin=self._pin)
+            Dataset(flat._sources[i::n], list(flat._stages),
+                    _pin=flat._pin)
             for i in range(n)
         ]
 

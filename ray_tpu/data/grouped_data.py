@@ -185,7 +185,7 @@ class GroupedData:
 
         ds = self._dataset
         if ds._use_remote():
-            num = max(1, len(ds._sources))
+            num = max(1, ds.num_blocks())
             return ds._shuffled(
                 num, "hash", key, postprocess=_ApplyGroups(fn, key)
             )

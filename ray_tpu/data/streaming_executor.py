@@ -48,6 +48,117 @@ class ActorStage:
         self.ray_remote_args = ray_remote_args or {}
 
 
+# ---- plan DAG nodes (non-linear inputs) ----------------------------------
+#
+# A Dataset's ``sources`` is either a flat list of read thunks (the leaf
+# case) or one of these nodes — making the physical plan an operator DAG
+# rather than a chain (ref analogue: the operator graph in
+# _internal/execution/streaming_executor_state.py, where zip/union are
+# physical operators with multiple input edges).
+
+class UnionSource:
+    """Concatenation of several upstream datasets' block streams, in
+    order (ref: Dataset.union)."""
+
+    def __init__(self, datasets: List[Any]):
+        self.datasets = list(datasets)
+
+
+class ZipSource:
+    """Pairwise block zip of two upstream datasets (ref: Dataset.zip —
+    both sides must have the same number of blocks and row counts per
+    block; a mismatch raises inside the zip task)."""
+
+    def __init__(self, left: Any, right: Any):
+        self.left = left
+        self.right = right
+
+
+def _zip_blocks(left, right):
+    """Column-merge two row-aligned blocks; right-side name collisions
+    get a ``_1`` suffix (matches the reference's zip semantics)."""
+    from .block import BlockAccessor
+
+    la = BlockAccessor(left).to_numpy()
+    ra = BlockAccessor(right).to_numpy()
+    ln = BlockAccessor(left).num_rows()
+    rn = BlockAccessor(right).num_rows()
+    if ln != rn:
+        raise ValueError(
+            f"zip requires row-aligned blocks; got {ln} vs {rn} rows "
+            "(repartition both datasets identically first)"
+        )
+    out = dict(la)
+    for k, v in ra.items():
+        name = k
+        suffix = 1
+        while name in out:  # first free suffix: never clobber a column
+            name = f"{k}_{suffix}"
+            suffix += 1
+        out[name] = v
+    from .block import from_numpy_dict
+
+    return from_numpy_dict(out)
+
+
+# ---- backpressure policies (ref: backpressure_policy/) -------------------
+
+class BackpressurePolicy:
+    """Submission gate consulted by every stage before launching a new
+    block task. ``can_submit`` may return False only while the stage has
+    work in flight (progress is always possible)."""
+
+    def can_submit(self, num_inflight: int) -> bool:
+        raise NotImplementedError
+
+
+class ConcurrencyCapPolicy(BackpressurePolicy):
+    """Bound concurrent block tasks per stage (ref:
+    concurrency_cap_backpressure_policy.py)."""
+
+    def __init__(self, cap: int):
+        self.cap = max(1, cap)
+
+    def can_submit(self, num_inflight: int) -> bool:
+        return num_inflight < self.cap
+
+
+class StoreUsagePolicy(BackpressurePolicy):
+    """Resource-aware: stop submitting while the local object store sits
+    above ``cap_fraction`` of capacity, so a slow consumer bounds
+    producer memory (ref: the reference's output-size/resource
+    backpressure). Always allows the first in-flight task."""
+
+    def __init__(self, cap_fraction: float):
+        self.cap_fraction = cap_fraction
+
+    def _usage(self) -> float:
+        from ..core import runtime_context
+
+        rt = runtime_context.current_runtime_or_none()
+        nm = getattr(rt, "_nm", None)
+        if nm is None:
+            return 0.0
+        d = nm.directory
+        if d.capacity_bytes <= 0:
+            return 0.0
+        return d.used_bytes / d.capacity_bytes
+
+    def can_submit(self, num_inflight: int) -> bool:
+        if num_inflight == 0:
+            return True  # progress guarantee
+        return self._usage() < self.cap_fraction
+
+
+def _default_policies(ctx) -> List[BackpressurePolicy]:
+    out: List[BackpressurePolicy] = [
+        ConcurrencyCapPolicy(ctx.max_in_flight_tasks)
+    ]
+    if ctx.store_usage_cap_fraction > 0:
+        out.append(StoreUsagePolicy(ctx.store_usage_cap_fraction))
+    return out
+
+
 # ---- execution stats (per-operator; ref: data/_internal/stats.py) --------
 
 class ExecStats:
@@ -217,11 +328,12 @@ def _execute_local(sources: Sequence[Callable[[], Any]],
 # ---- distributed execution ----------------------------------------------
 
 def _task_stage_gen(upstream: Iterator[Any], stage: TaskStage,
-                    window: int, first: bool,
+                    policies: List[BackpressurePolicy], first: bool,
                     stats: Optional[ExecStats] = None,
                     stage_idx: int = -1) -> Iterator[Any]:
-    """Submit one fused task per upstream item; yield result refs in order
-    with at most ``window`` in flight. With ``stats``, the task returns a
+    """Submit one fused task per upstream item; yield result refs in
+    order, gating every submission on the backpressure policies
+    (concurrency cap + store usage). With ``stats``, the task returns a
     second tiny output carrying per-op wall + block rows/bytes."""
     import ray_tpu
 
@@ -238,7 +350,9 @@ def _task_stage_gen(upstream: Iterator[Any], stage: TaskStage,
     up = iter(upstream)
     done = False
     while inflight or not done:
-        while not done and len(inflight) < window:
+        while not done and all(
+            p.can_submit(len(inflight)) for p in policies
+        ):
             item = next(up, None)
             if item is None:
                 done = True
@@ -325,29 +439,108 @@ def _is_ref(x) -> bool:
     return isinstance(x, ObjectRef)
 
 
-def execute_refs(sources: Sequence[Callable[[], Any]],
+def _node_ref_stream(node, stats: Optional[ExecStats]) -> Iterator[Any]:
+    """Ref stream for a DAG input node: recursively executes upstream
+    plans and combines their block streams (union = ordered concat, zip
+    = pairwise zip tasks). Upstream datasets run their OWN stage chains
+    — the combined stream then feeds this dataset's stages with
+    first=False (blocks arrive as refs, not source thunks)."""
+    import ray_tpu
+
+    if isinstance(node, UnionSource):
+        idx = -1
+        if stats is not None:
+            idx = stats.add_stage(f"Union(x{len(node.datasets)})")
+        for ds in node.datasets:
+            for ref in execute_refs(ds._sources, ds._stages, None):
+                if stats is not None:
+                    stats.blocks[idx] += 1
+                yield ref if _is_ref(ref) else ray_tpu.put(ref)
+        return
+    if isinstance(node, ZipSource):
+        idx = -1
+        if stats is not None:
+            idx = stats.add_stage("Zip")
+        zipper = ray_tpu.remote(_zip_blocks)
+        left = execute_refs(node.left._sources, node.left._stages, None)
+        right = execute_refs(node.right._sources, node.right._stages, None)
+        while True:
+            l = next(left, None)
+            r = next(right, None)
+            if l is None and r is None:
+                return
+            if l is None or r is None:
+                raise ValueError(
+                    "zip requires datasets with the same number of "
+                    "blocks (repartition them identically first)"
+                )
+            if stats is not None:
+                stats.blocks[idx] += 1
+            yield zipper.remote(l, r)
+        return
+    raise TypeError(f"unknown plan node {type(node).__name__}")
+
+
+def _node_local_blocks(node, stats):
+    """Local (no-runtime) evaluation of a DAG input node."""
+    if isinstance(node, UnionSource):
+        for ds in node.datasets:
+            yield from execute(ds._sources, ds._stages, None)
+        return
+    if isinstance(node, ZipSource):
+        left = list(execute(node.left._sources, node.left._stages, None))
+        right = list(execute(node.right._sources, node.right._stages, None))
+        if len(left) != len(right):
+            raise ValueError(
+                "zip requires datasets with the same number of blocks"
+            )
+        for l, r in zip(left, right):
+            yield _zip_blocks(l, r)
+        return
+    raise TypeError(f"unknown plan node {type(node).__name__}")
+
+
+def execute_refs(sources: Any,
                  stages: Sequence[Any],
                  stats: Optional[ExecStats] = None) -> Iterator[Any]:
     """Yield per-block results as ObjectRefs (driver never holds data),
     falling back to local inline execution without a runtime. Pass an
-    ``ExecStats`` to collect per-stage / per-operator accounting."""
+    ``ExecStats`` to collect per-stage / per-operator accounting.
+    ``sources`` is either a list of read thunks or a plan DAG node
+    (UnionSource/ZipSource) whose upstream datasets execute as their own
+    streaming chains."""
     import time as _t
 
     ctx = DataContext.get_current()
     from ..core import runtime_context
 
     t_start = _t.perf_counter()
+    is_node = isinstance(sources, (UnionSource, ZipSource))
     if not (ctx.use_remote_tasks and runtime_context.is_initialized()):
-        yield from _execute_local(sources, stages, stats)
+        if is_node:
+            # Local mode: upstream blocks materialize inline, then this
+            # plan's stages run over them like pulled blocks.
+            blocks = _node_local_blocks(sources, stats)
+            srcs = [(lambda b=b: b) for b in blocks]
+            yield from _execute_local(srcs, stages, stats)
+        else:
+            yield from _execute_local(sources, stages, stats)
         if stats is not None:
             stats.wall_s = _t.perf_counter() - t_start
         return
 
+    policies = _default_policies(ctx)
     stages = list(stages) or [TaskStage([])]
-    gen: Iterator[Any] = iter(sources)
-    first = True
+    if is_node:
+        gen: Iterator[Any] = _node_ref_stream(sources, stats)
+        first = False  # upstream yields block refs, not source thunks
+    else:
+        gen = iter(sources)
+        first = True
     for i, st in enumerate(stages):
         if isinstance(st, TaskStage):
+            if not st.ops and not first:
+                continue  # identity over an already-ref stream: no hop
             idx = -1
             if stats is not None:
                 names = [type(o).__name__.lstrip("_") for o in st.ops]
@@ -355,16 +548,14 @@ def execute_refs(sources: Sequence[Callable[[], Any]],
                 idx = stats.add_stage(
                     f"TaskStage({label}{'->'.join(names) or 'identity'})"
                 )
-            gen = _task_stage_gen(gen, st, ctx.max_in_flight_tasks,
-                                  first, stats, idx)
+            gen = _task_stage_gen(gen, st, policies, first, stats, idx)
         else:
             if first:
                 idx = -1
                 if stats is not None:
                     idx = stats.add_stage("TaskStage(Read)")
                 gen = _task_stage_gen(
-                    gen, TaskStage([]), ctx.max_in_flight_tasks, True,
-                    stats, idx,
+                    gen, TaskStage([]), policies, True, stats, idx,
                 )
             aidx = -1
             if stats is not None:
