@@ -98,8 +98,13 @@ class Autoscaler:
         # launched that have not registered a cluster view yet. Their
         # capacity counts against demand (or every tick would launch a
         # duplicate), but only until boot_timeout_s — a hung boot must not
-        # mask demand forever.
+        # mask demand forever. A multi-host SLICE stays booting until
+        # EVERY host has registered (partially-registered slices still
+        # contribute their missing hosts as phantom capacity).
         self._booting: Dict[str, Tuple[str, float]] = {}
+        # provider node id -> node-type name for every node THIS process
+        # launched (outlives _booting: idleness needs the host count).
+        self._type_of: Dict[str, str] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -153,7 +158,10 @@ class Autoscaler:
     def _plan_nodes(self, unmet: List[Dict]) -> List[str]:
         """Greedy-pack unmet shapes into fresh nodes of fitting types;
         returns the node-type names to launch. Shapes no type can hold are
-        skipped (they are infeasible, not a scaling problem)."""
+        skipped (they are infeasible, not a scaling problem). A type with
+        ``hosts_per_node`` > 1 is a POD SLICE: one launch opens that many
+        per-host bins (ref analogue: the gcp provider's TPU-slice node
+        types, where one instance is a multi-host gang)."""
         plan: List[str] = []
         open_nodes: List[Tuple[str, Dict[str, float]]] = []
         for shape in unmet:
@@ -168,9 +176,13 @@ class Autoscaler:
             for tname, tcfg in self.config.node_types.items():
                 total = tcfg.get("resources") or {}
                 if _fits(shape, dict(total)):
+                    hosts = max(1, int(tcfg.get("hosts_per_node", 1)))
                     rem = dict(total)
                     _deduct(shape, rem)
                     open_nodes.append((tname, rem))
+                    # The slice's other hosts are fresh bins too.
+                    for _ in range(hosts - 1):
+                        open_nodes.append((tname, dict(total)))
                     plan.append(tname)
                     break
         return plan
@@ -184,7 +196,17 @@ class Autoscaler:
         self._booting[nid] = (
             type_name, time.monotonic() + self.config.boot_timeout_s
         )
+        self._type_of[nid] = type_name
         return nid
+
+    def _hosts_of(self, nid: str) -> int:
+        """Expected host count of a provider node (1 unless it is a
+        multi-host slice we launched)."""
+        tname = self._type_of.get(nid)
+        tcfg = self.config.node_types.get(tname) if tname else None
+        if tcfg is None:
+            return 1
+        return max(1, int(tcfg.get("hosts_per_node", 1)))
 
     def _default_type(self) -> str:
         return next(iter(self.config.node_types))
@@ -211,23 +233,34 @@ class Autoscaler:
 
         views = self._nodes_fn()
         alive = [v for v in views if v.get("state") == "alive"]
-        by_provider: Dict[str, Dict[str, Any]] = {}
+        # One provider node may be a multi-host slice: EVERY host's view
+        # maps back to the same provider id (slice-aware accounting).
+        by_provider: Dict[str, List[Dict[str, Any]]] = {}
         for v in alive:
             pid = (v.get("labels") or {}).get(PROVIDER_NODE_LABEL)
             if pid:
-                by_provider[pid] = v
+                by_provider.setdefault(pid, []).append(v)
 
-        # Booting bookkeeping: a node is no longer booting once its view
-        # registers or the provider lost it. A node that blows its boot
-        # deadline is TERMINATED, not just forgotten — a hung instance
-        # would otherwise leak cost and pin a max_workers slot forever.
+        # Booting bookkeeping: a node is no longer booting once ALL its
+        # hosts registered (a slice's hosts boot staggered — popping on
+        # the first would drop the rest's phantom capacity and launch a
+        # duplicate slice) or the provider lost it. A node that blows
+        # its boot deadline is TERMINATED, not just forgotten — a hung
+        # instance would otherwise leak cost and pin a max_workers slot.
         live_set = set(live)
+        # Maintain the node count locally: with a REST-backed provider
+        # every non_terminated_nodes() is a network round trip, and the
+        # loops below would otherwise issue O(plan + idle nodes) of them
+        # per tick.
+        live_count = len(live)
         for nid, (_t, deadline) in list(self._booting.items()):
-            if nid in by_provider or nid not in live_set:
+            registered = len(by_provider.get(nid, ()))
+            if registered >= self._hosts_of(nid) or nid not in live_set:
                 self._booting.pop(nid, None)
             elif now > deadline:
                 try:
                     self.provider.terminate_node(nid)
+                    live_count -= 1
                 except Exception as e:
                     # Transient provider failure: keep the entry with a
                     # short extension so termination retries, and say so —
@@ -241,38 +274,49 @@ class Autoscaler:
                     self._booting[nid] = (_t, now + 5.0)
                 else:
                     self._booting.pop(nid, None)
-        booting_capacity = [
-            dict(self.config.node_types[t]["resources"])
-            for t, _deadline in self._booting.values()
-            if t in self.config.node_types
-        ]
+                    self._type_of.pop(nid, None)
+        booting_capacity = []
+        for nid, (t, _deadline) in self._booting.items():
+            tcfg = self.config.node_types.get(t)
+            if tcfg is None:
+                continue
+            hosts = max(1, int(tcfg.get("hosts_per_node", 1)))
+            # Only the hosts that have NOT registered yet are phantom;
+            # registered ones already report real capacity.
+            missing = hosts - len(by_provider.get(nid, ()))
+            booting_capacity.extend(
+                dict(tcfg["resources"]) for _ in range(max(0, missing))
+            )
 
         # Upscale by shape: launch node types that fit the unmet demand,
         # sustained past upscale_delay_s.
         unmet = self._unmet_shapes(alive, booting_capacity)
-        if unmet and len(live) < cfg.max_workers:
+        if unmet and live_count < cfg.max_workers:
             if self._pending_since is None:
                 self._pending_since = now
             elif now - self._pending_since >= cfg.upscale_delay_s:
                 for tname in self._plan_nodes(unmet):
-                    if (len(self.provider.non_terminated_nodes())
-                            >= cfg.max_workers):
+                    if live_count >= cfg.max_workers:
                         break
                     self._launch(tname)
+                    live_count += 1
                 self._pending_since = None
         else:
             self._pending_since = None
 
         # Downscale: terminate a worker only when ITS OWN view has been
-        # idle past the timeout (never below min_workers). Nodes that have
-        # not registered a view yet are still booting — treat as busy.
+        # idle past the timeout (never below min_workers). Nodes whose
+        # hosts have not ALL registered yet are still booting — treat as
+        # busy (a slice with one idle registered host must not be torn
+        # down while its other hosts are mid-boot). For a registered
+        # slice, idle means EVERY host is idle.
         for nid in list(live):
-            v = by_provider.get(nid)
-            idle = (
-                v is not None
-                and v.get("pending_tasks", 0) == 0
+            hosts_views = by_provider.get(nid) or []
+            idle = len(hosts_views) >= self._hosts_of(nid) and all(
+                v.get("pending_tasks", 0) == 0
                 and v.get("resources_available", {})
                 == v.get("resources_total", {})
+                for v in hosts_views
             )
             if not idle:
                 self._idle_since.pop(nid, None)
@@ -281,7 +325,8 @@ class Autoscaler:
             if since is None:
                 self._idle_since[nid] = now
             elif now - since >= cfg.idle_timeout_s:
-                if (len(self.provider.non_terminated_nodes())
-                        > cfg.min_workers):
+                if live_count > cfg.min_workers:
                     self.provider.terminate_node(nid)
+                    live_count -= 1
                     self._idle_since.pop(nid, None)
+                    self._type_of.pop(nid, None)

@@ -12,12 +12,18 @@ Ref analogue: the reference's cluster YAML + ray-schema.json consumed by
     idle_timeout_s: 60
     upscale_delay_s: 1.0
     provider:
-      type: local           # local | ssh
+      type: local           # local | ssh | gcp_tpu
       # ssh only:
       # worker_ips: [10.0.0.2, 10.0.0.3]
       # ssh_user: ubuntu
       # ssh_key: ~/.ssh/id_rsa
       # python: python3
+      # gcp_tpu only:
+      # project: my-project
+      # zone: us-central2-b
+      # api_base: https://tpu.googleapis.com/v2   # test override
+      # network: default
+      # setup_commands: ["pip install ray-tpu"]
     head:
       port: 7777
       num_cpus: 4
@@ -26,6 +32,11 @@ Ref analogue: the reference's cluster YAML + ray-schema.json consumed by
       cpu_worker:
         resources: {CPU: 2}
         labels: {pool: general}
+      tpu_v5e_16:                       # gcp_tpu: one node = one SLICE
+        resources: {TPU: 4, CPU: 8}    # PER HOST of the slice
+        hosts_per_node: 4              # v5litepod-16 = 4 hosts
+        accelerator_type: v5litepod-16
+        runtime_version: v2-alpha-tpuv5-lite
 """
 
 from __future__ import annotations
@@ -34,14 +45,21 @@ import os
 from typing import Any, Dict
 
 from .autoscaler import Autoscaler, AutoscalerConfig
-from .node_provider import LocalNodeProvider, SSHNodeProvider
+from .node_provider import (
+    GCPTpuNodeProvider,
+    LocalNodeProvider,
+    SSHNodeProvider,
+)
 
 _ALLOWED_TOP = {
     "cluster_name", "max_workers", "min_workers", "idle_timeout_s",
     "upscale_delay_s", "boot_timeout_s", "infeasible_grace_s",
     "provider", "head", "available_node_types",
 }
-_ALLOWED_PROVIDER = {"type", "worker_ips", "ssh_user", "ssh_key", "python"}
+_ALLOWED_PROVIDER = {
+    "type", "worker_ips", "ssh_user", "ssh_key", "python",
+    "project", "zone", "api_base", "network", "setup_commands",
+}
 _ALLOWED_HEAD = {"port", "num_cpus", "resources", "node_ip"}
 
 
@@ -63,10 +81,18 @@ def load_cluster_config(path: str) -> Dict[str, Any]:
     if unknown:
         raise ValueError(f"unknown provider keys: {sorted(unknown)}")
     ptype = provider.setdefault("type", "local")
-    if ptype not in ("local", "ssh"):
-        raise ValueError(f"provider.type must be local|ssh, got {ptype!r}")
+    if ptype not in ("local", "ssh", "gcp_tpu"):
+        raise ValueError(
+            f"provider.type must be local|ssh|gcp_tpu, got {ptype!r}"
+        )
     if ptype == "ssh" and not provider.get("worker_ips"):
         raise ValueError("provider.type=ssh requires provider.worker_ips")
+    if ptype == "gcp_tpu":
+        for req in ("project", "zone"):
+            if not provider.get(req):
+                raise ValueError(
+                    f"provider.type=gcp_tpu requires provider.{req}"
+                )
     head = cfg.get("head") or {}
     unknown = set(head) - _ALLOWED_HEAD
     if unknown:
@@ -105,6 +131,19 @@ def build_autoscaler(cfg: Dict[str, Any], gcs_address: str,
             ssh_key=p.get("ssh_key", ""),
             python=p.get("python", "python3"),
         )
+    elif p["type"] == "gcp_tpu":
+        provider = GCPTpuNodeProvider(
+            gcs_address,
+            project=p["project"],
+            zone=p["zone"],
+            cluster_name=cfg.get("cluster_name", "rtpu"),
+            api_base=p.get("api_base", "https://tpu.googleapis.com/v2"),
+            network=p.get("network", ""),
+            setup_commands=p.get("setup_commands"),
+        )
+        # The provider needs each type's accelerator/runtime to build
+        # the TPU create request for a launch of that type.
+        provider.node_type_configs = dict(node_types or {})
     else:
         provider = LocalNodeProvider(gcs_address)
     return Autoscaler(as_cfg, provider, nodes_fn=nodes_fn)

@@ -122,6 +122,241 @@ class LocalNodeProvider(_SubprocessProvider):
         return node_id
 
 
+class GCPTpuNodeProvider(NodeProvider):
+    """TPU pod slices on GCE via the Cloud TPU API (ref analogue:
+    autoscaler/_private/gcp/node_provider.py — the TPU-VM path). One
+    provider "node" = ONE pod slice: create_node POSTs a TPU node of
+    the type's ``accelerator_type``; every HOST of the slice runs the
+    same startup script and joins the cluster as a gang, each stamping
+    the shared provider-node id into its labels, so the autoscaler
+    reasons about the slice as a unit (idle only when every host is
+    idle; sized as hosts_per_node bins of per-host resources).
+
+    The HTTP layer is injectable (``http=``) so the whole flow is
+    testable against a fake TPU API; production auth uses the GCE
+    metadata server's default service-account token.
+    """
+
+    def __init__(self, gcs_address: str, *, project: str, zone: str,
+                 cluster_name: str = "rtpu",
+                 api_base: str = "https://tpu.googleapis.com/v2",
+                 network: str = "",
+                 http=None, auth_token_fn=None,
+                 setup_commands: Optional[List[str]] = None):
+        import re
+
+        self.gcs_address = gcs_address
+        self.project = project
+        self.zone = zone
+        # GCP label values must be lowercase [a-z0-9-_]; normalize so an
+        # arbitrary cluster_name cannot 400 every create (the autoscaler
+        # loop would swallow the error and the cluster would silently
+        # never scale).
+        self.cluster_name = re.sub(
+            r"[^a-z0-9-]", "-", cluster_name.lower()
+        )[:40] or "rtpu"
+        self.api_base = api_base.rstrip("/")
+        self.network = network
+        self.setup_commands = list(setup_commands or [])
+        self._http = http or _UrllibHttp(
+            auth_token_fn or _gce_metadata_token
+        )
+        # provider node id -> node-type metadata (accelerator etc.)
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+        # id -> creation time: the list API is eventually consistent, so
+        # a just-created node missing from a listing must not be pruned
+        # (pruning would leak the paid slice at shutdown and relaunch a
+        # duplicate).
+        self._created_at: Dict[str, float] = {}
+        self._list_grace_s = 120.0
+        # Per-launch type config handed in through create_node's labels
+        # channel (the autoscaler passes the node-type name; the YAML
+        # loader registers the full type configs here).
+        self.node_type_configs: Dict[str, Dict[str, Any]] = {}
+
+    # -- REST plumbing ---------------------------------------------------
+
+    def _parent(self) -> str:
+        return (f"{self.api_base}/projects/{self.project}"
+                f"/locations/{self.zone}")
+
+    def _startup_script(self, node_id: str, resources: Dict[str, float],
+                        labels: Dict[str, str]) -> str:
+        """Runs on EVERY host of the slice: join the cluster as one
+        node of the gang. NOTE: the session token (when set) travels
+        through the node's startup-script metadata, which is visible to
+        any principal with TPU viewer permission on the project — scope
+        the project's IAM accordingly, or leave the token unset and rely
+        on network isolation / mTLS (core/tls.py) instead."""
+        import shlex
+
+        from ray_tpu.core.config import get_config
+
+        env = (
+            f"RAY_TPU_GCS_ADDRESS={shlex.quote(self.gcs_address)} "
+            f"RAY_TPU_SESSION_DIR=/tmp/ray_tpu/{node_id} "
+            f"RAY_TPU_RESOURCES={shlex.quote(json.dumps(resources))} "
+            f"RAY_TPU_NODE_LABELS={shlex.quote(json.dumps(labels))}"
+        )
+        token = get_config().session_token
+        if token:
+            env += f" RAY_TPU_SESSION_TOKEN={shlex.quote(token)}"
+        lines = ["#!/bin/bash", "set -e"]
+        lines += self.setup_commands
+        lines += [
+            f"mkdir -p /tmp/ray_tpu/{node_id}",
+            f"{env} python3 -m ray_tpu.core.node_main "
+            f">> /tmp/ray_tpu/{node_id}/node.log 2>&1 &",
+        ]
+        return "\n".join(lines)
+
+    # -- NodeProvider surface --------------------------------------------
+
+    def create_node(self, resources: Dict[str, float],
+                    labels: Optional[Dict[str, str]] = None) -> str:
+        labels = dict(labels or {})
+        type_name = labels.get("rtpu-node-type", "")
+        tcfg = self.node_type_configs.get(type_name, {})
+        accel = tcfg.get("accelerator_type", "v5litepod-4")
+        runtime = tcfg.get("runtime_version", "tpu-ubuntu2204-base")
+        node_id = f"tpu-{self.cluster_name}-{uuid.uuid4().hex[:8]}"
+        labels[PROVIDER_NODE_LABEL] = node_id
+        labels["rtpu-slice"] = node_id
+        body = {
+            "acceleratorType": accel,
+            "runtimeVersion": runtime,
+            "labels": {
+                "rtpu-cluster": self.cluster_name,
+                "rtpu-provider-node-id": node_id,
+            },
+            "metadata": {
+                "startup-script": self._startup_script(
+                    node_id, resources, labels
+                ),
+            },
+        }
+        if self.network:
+            body["networkConfig"] = {"network": self.network}
+        self._http.request(
+            "POST", f"{self._parent()}/nodes?nodeId={node_id}", body
+        )
+        self._nodes[node_id] = {"type": type_name, "accel": accel}
+        self._created_at[node_id] = time.monotonic()
+        return node_id
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        try:
+            self._http.request(
+                "DELETE", f"{self._parent()}/nodes/{provider_node_id}"
+            )
+        finally:
+            self._nodes.pop(provider_node_id, None)
+            self._created_at.pop(provider_node_id, None)
+
+    def non_terminated_nodes(self) -> List[str]:
+        try:
+            resp = self._http.request("GET", f"{self._parent()}/nodes")
+        except Exception:
+            # API blip: report the locally-tracked set rather than
+            # pretending every slice vanished (which would relaunch).
+            return list(self._nodes)
+        now = time.monotonic()
+        out = []
+        for node in (resp or {}).get("nodes", []):
+            nlabels = node.get("labels") or {}
+            if nlabels.get("rtpu-cluster") != self.cluster_name:
+                continue
+            if node.get("state") in ("DELETING", "TERMINATED"):
+                continue
+            nid = nlabels.get("rtpu-provider-node-id") or (
+                node.get("name", "").rsplit("/", 1)[-1]
+            )
+            out.append(nid)
+            self._nodes.setdefault(nid, {})
+        # Drop local records the API no longer reports — EXCEPT nodes
+        # created within the list-consistency grace window (the listing
+        # may simply not surface them yet).
+        listed = set(out)
+        for nid in list(self._nodes):
+            if nid in listed:
+                continue
+            created = self._created_at.get(nid)
+            if created is not None and now - created < self._list_grace_s:
+                out.append(nid)  # still ours; listing just lags
+                continue
+            self._nodes.pop(nid, None)
+            self._created_at.pop(nid, None)
+        return out
+
+    def shutdown(self) -> None:
+        for nid in list(self._nodes):
+            try:
+                self.terminate_node(nid)
+            except Exception:
+                pass
+
+
+class _UrllibHttp:
+    """Minimal JSON-over-HTTP client for the TPU REST API (stdlib only;
+    swap out in tests via GCPTpuNodeProvider(http=...)). The auth token
+    is cached with an expiry — the reconcile loop calls the API every
+    tick, and GCE metadata tokens are valid ~1h."""
+
+    _TOKEN_TTL_S = 600.0
+
+    def __init__(self, token_fn=None):
+        self._token_fn = token_fn
+        self._token = ""
+        self._token_expiry = 0.0
+
+    def _auth(self) -> str:
+        if self._token_fn is None:
+            return ""
+        now = time.monotonic()
+        if now >= self._token_expiry:
+            self._token = self._token_fn() or ""
+            # Failed fetches (empty) retry sooner than good tokens.
+            self._token_expiry = now + (
+                self._TOKEN_TTL_S if self._token else 30.0
+            )
+        return self._token
+
+    def request(self, method: str, url: str, body=None):
+        import urllib.request
+
+        data = None
+        headers = {"Content-Type": "application/json"}
+        tok = self._auth()
+        if tok:
+            headers["Authorization"] = f"Bearer {tok}"
+        if body is not None:
+            data = json.dumps(body).encode()
+        req = urllib.request.Request(
+            url, data=data, method=method, headers=headers
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+
+def _gce_metadata_token() -> str:
+    """Default service-account token from the GCE metadata server
+    (empty off-GCE — requests then go unauthenticated, which only a
+    test/fake endpoint accepts)."""
+    import urllib.request
+
+    try:
+        req = urllib.request.Request(
+            "http://metadata.google.internal/computeMetadata/v1/"
+            "instance/service-accounts/default/token",
+            headers={"Metadata-Flavor": "Google"},
+        )
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            return json.loads(resp.read()).get("access_token", "")
+    except Exception:
+        return ""
+
+
 class SSHNodeProvider(_SubprocessProvider):
     """Launches worker nodes on remote hosts over ssh (ref analogue: the
     on-prem/"local" provider's ssh command_runner.py — one node process
