@@ -59,8 +59,17 @@ def bench_train_loop(config=None):
     backend = jax.default_backend()
     on_accel = backend not in ("cpu",)
     # A/B knobs (PERF harness): flash kernel on/off, remat policy, batch.
+    # Defaults = the measured-best single-chip config (r5 A/B matrix):
+    # remat=dots + unrolled layers + chunked cross-entropy. Unrolling
+    # removes the scan's stacked [L, ...] residual buffers whose
+    # fragmentation OOM'd dots in r4 (46% frag at 10 G HLO temp); the
+    # chunked loss removes the [B, S, V] fp32 logits cliff (b16 ran at
+    # 0.31 MFU in r4, 0.59 now). b8/dots/noscan/chunked: 0.649 MFU vs
+    # r4's 0.596.
     use_flash = os.environ.get("RAY_TPU_BENCH_FLASH", "1") == "1"
-    remat_policy = os.environ.get("RAY_TPU_BENCH_REMAT", "full")
+    remat_policy = os.environ.get("RAY_TPU_BENCH_REMAT", "dots")
+    loss_chunk = int(os.environ.get("RAY_TPU_BENCH_LOSS_CHUNK", "512"))
+    scan_layers = os.environ.get("RAY_TPU_BENCH_SCAN", "0") == "1"
     if on_accel:
         # 8B-shaped layers (Llama-8B geometry), depth cut to fit one
         # chip. Full-depth 8B does not fit a single v5e: 8.0B params ×
@@ -76,6 +85,8 @@ def bench_train_loop(config=None):
             dtype=jnp.bfloat16,
             use_flash=use_flash,
             remat_policy=remat_policy,
+            loss_chunk=loss_chunk,
+            scan_layers=scan_layers,
         )
         batch, seqlen, measure_steps = (
             int(os.environ.get("RAY_TPU_BENCH_BATCH", "8")), 2048,

@@ -199,10 +199,18 @@ class Autoscaler:
         self._type_of[nid] = type_name
         return nid
 
-    def _hosts_of(self, nid: str) -> int:
+    def _hosts_of(self, nid: str, host_views=None) -> int:
         """Expected host count of a provider node (1 unless it is a
-        multi-host slice we launched)."""
+        multi-host slice). Falls back to the 'rtpu-node-type' label the
+        launch stamped into every host's view — so a RESTARTED head,
+        whose process-local _type_of is empty, still sizes adopted
+        slices correctly instead of tearing them down as 1-host nodes."""
         tname = self._type_of.get(nid)
+        if tname is None and host_views:
+            for v in host_views:
+                tname = (v.get("labels") or {}).get("rtpu-node-type")
+                if tname:
+                    break
         tcfg = self.config.node_types.get(tname) if tname else None
         if tcfg is None:
             return 1
@@ -253,9 +261,12 @@ class Autoscaler:
         # loops below would otherwise issue O(plan + idle nodes) of them
         # per tick.
         live_count = len(live)
+        for nid in [n for n in self._type_of if n not in live_set]:
+            self._type_of.pop(nid, None)  # vanished externally: prune
         for nid, (_t, deadline) in list(self._booting.items()):
             registered = len(by_provider.get(nid, ()))
-            if registered >= self._hosts_of(nid) or nid not in live_set:
+            if (registered >= self._hosts_of(nid, by_provider.get(nid))
+                    or nid not in live_set):
                 self._booting.pop(nid, None)
             elif now > deadline:
                 try:
@@ -312,7 +323,9 @@ class Autoscaler:
         # slice, idle means EVERY host is idle.
         for nid in list(live):
             hosts_views = by_provider.get(nid) or []
-            idle = len(hosts_views) >= self._hosts_of(nid) and all(
+            idle = len(hosts_views) >= self._hosts_of(
+                nid, hosts_views
+            ) and all(
                 v.get("pending_tasks", 0) == 0
                 and v.get("resources_available", {})
                 == v.get("resources_total", {})

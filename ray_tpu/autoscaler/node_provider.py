@@ -216,7 +216,15 @@ class GCPTpuNodeProvider(NodeProvider):
                     labels: Optional[Dict[str, str]] = None) -> str:
         labels = dict(labels or {})
         type_name = labels.get("rtpu-node-type", "")
-        tcfg = self.node_type_configs.get(type_name, {})
+        tcfg = self.node_type_configs.get(type_name)
+        if tcfg is None:
+            # Launching unknown (billed!) hardware on a silent fallback
+            # would also desynchronize the autoscaler's hosts_per_node
+            # accounting — fail fast instead.
+            raise ValueError(
+                f"gcp_tpu: no node_type_configs entry for node type "
+                f"{type_name!r} (have {sorted(self.node_type_configs)})"
+            )
         accel = tcfg.get("accelerator_type", "v5litepod-4")
         runtime = tcfg.get("runtime_version", "tpu-ubuntu2204-base")
         node_id = f"tpu-{self.cluster_name}-{uuid.uuid4().hex[:8]}"
@@ -245,13 +253,15 @@ class GCPTpuNodeProvider(NodeProvider):
         return node_id
 
     def terminate_node(self, provider_node_id: str) -> None:
-        try:
-            self._http.request(
-                "DELETE", f"{self._parent()}/nodes/{provider_node_id}"
-            )
-        finally:
-            self._nodes.pop(provider_node_id, None)
-            self._created_at.pop(provider_node_id, None)
+        # Local tracking is dropped only on a SUCCESSFUL delete: a
+        # transient API error must leave the node tracked so shutdown()
+        # (or the next reconcile) retries instead of leaking a billed
+        # slice.
+        self._http.request(
+            "DELETE", f"{self._parent()}/nodes/{provider_node_id}"
+        )
+        self._nodes.pop(provider_node_id, None)
+        self._created_at.pop(provider_node_id, None)
 
     def non_terminated_nodes(self) -> List[str]:
         try:

@@ -25,6 +25,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ..parallel.sharding import with_logical_constraint
 from ..parallel.mesh import mesh_axis_size
@@ -61,6 +62,18 @@ class LlamaConfig:
     # GQA reduced in-kernel) flash beats the XLA path for training too —
     # 0.596 vs 0.532 MFU on the 8B-shaped bench (PERF_r04.json A/B).
     use_flash: bool = True
+    # Cross-entropy sequence chunk: the loss streams over S/chunk slices
+    # so the [B, S, V] float32 logits (4.3 GB at B=16, S=2k, V=32k — and
+    # the backward saves log-softmax residuals of the same size) never
+    # materialize; peak is one [B, chunk, V] slice, recomputed in the
+    # backward (jax.checkpoint per chunk). 0 disables chunking.
+    loss_chunk: int = 512
+    # lax.scan over layers (compile-time O(1) in depth) vs an unrolled
+    # python loop. Unrolled avoids the scan's stacked [L, ...] residual
+    # buffers — at shallow depth that removes the large contiguous
+    # allocations behind the allocator fragmentation that OOMs the
+    # selective-remat policies.
+    scan_layers: bool = True
 
     @property
     def dh(self) -> int:
@@ -222,6 +235,12 @@ def _layer(cfg: LlamaConfig, mesh, positions, x, lp):
         return x, aux
     up = jnp.einsum("bsm,mf->bsf", h, lp["w_up"])
     gate = jnp.einsum("bsm,mf->bsf", h, lp["w_gate"])
+    # Named for the selective "mlp" remat policy: saving these two
+    # outputs (the widest matmuls — ~45% of a layer's forward FLOPs)
+    # removes their backward recompute at a fraction of checkpoint_dots'
+    # footprint (which also saves attention/down/norm outputs).
+    up = checkpoint_name(up, "mlp_up")
+    gate = checkpoint_name(gate, "mlp_gate")
     h = jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up
     h = with_logical_constraint(h, ("batch", "seq", "mlp"), mesh=mesh)
     x = x + jnp.einsum("bsf,fm->bsm", h, lp["w_down"])
@@ -235,6 +254,19 @@ def forward(
     mesh=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (logits [B, S, V] float32, moe_aux_loss scalar)."""
+    x, aux = hidden_forward(params, tokens, cfg, mesh)
+    logits = jnp.einsum("bsm,mv->bsv", x, params["lm_head"])
+    return logits.astype(jnp.float32), aux
+
+
+def hidden_forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,  # [B, S] int32
+    cfg: LlamaConfig,
+    mesh=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Transformer trunk WITHOUT the lm_head projection: returns
+    (hidden [B, S, M] after final_norm, moe_aux_loss scalar)."""
     B, S = tokens.shape
     x = params["embed"][tokens].astype(cfg.dtype)
     x = with_logical_constraint(x, ("batch", "seq", "embed"), mesh=mesh)
@@ -245,14 +277,18 @@ def forward(
         if cfg.remat:
             policy = None
             if cfg.remat_policy == "dots":
-                # Save matmul outputs, recompute only the cheap
-                # elementwise work — less backward recompute where HBM
-                # allows (ref analogue: the scaling playbook's selective
-                # rematerialization).
+                # Save ALL matmul outputs — least recompute, largest
+                # footprint (OOMs the 8B-shaped bench: ~10 G HLO temp).
                 policy = jax.checkpoint_policies.checkpoint_dots
+            elif cfg.remat_policy == "mlp":
+                # Selective (scaling-playbook style): save only the two
+                # widest matmuls' outputs (up/gate, ~45% of forward
+                # FLOPs) and recompute the rest — the best
+                # recompute-per-byte trade on one chip.
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "mlp_up", "mlp_gate"
+                )
             elif cfg.remat_policy == "save_all":
-                # Save every intermediate (no backward recompute) while
-                # keeping scan-over-layers structure.
                 policy = jax.checkpoint_policies.everything_saveable
             fn = jax.checkpoint(
                 lambda x_, lp_: _layer(cfg, mesh, positions, x_, lp_),
@@ -264,10 +300,54 @@ def forward(
         out = with_logical_constraint(out, ("batch", "seq", "embed"), mesh=mesh)
         return out, aux
 
-    x, aux = jax.lax.scan(body, x, params["layers"])
+    if cfg.scan_layers:
+        x, aux = jax.lax.scan(body, x, params["layers"])
+        aux = aux.sum()
+    else:
+        aux = jnp.zeros((), jnp.float32)
+        for i in range(cfg.num_layers):
+            lp = jax.tree.map(lambda p: p[i], params["layers"])
+            x, a = body(x, lp)
+            aux = aux + a
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    logits = jnp.einsum("bsm,mv->bsv", x, params["lm_head"])
-    return logits.astype(jnp.float32), aux.sum()
+    return x, aux
+
+
+def _chunked_nll_sum(x: jax.Array, lm_head: jax.Array,
+                     targets: jax.Array, chunk: int) -> jax.Array:
+    """Total next-token NLL over [B, S] positions, streaming the lm_head
+    projection + log-sum-exp over S/chunk slices so no [B, S, V] tensor
+    ever materializes (the memory cliff behind the batch-16 collapse:
+    the monolithic loss kept logits + log-softmax residuals, ~8.6 GB at
+    B=16). Each chunk is rematerialized in the backward."""
+    B, S, M = x.shape
+    pad = (-S) % chunk
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    nchunks = (S + pad) // chunk
+    # [n, B, C, M] / [n, B, C] views for the scan.
+    xs = x.reshape(B, nchunks, chunk, M).transpose(1, 0, 2, 3)
+    ts = targets.reshape(B, nchunks, chunk).transpose(1, 0, 2)
+    valid = jnp.arange(nchunks * chunk).reshape(nchunks, chunk) < S
+
+    def body(total, inp):
+        xc, tc, mask = inp
+        logits = jnp.einsum(
+            "bcm,mv->bcv", xc, lm_head
+        ).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits, tc[..., None], axis=-1
+        )[..., 0]
+        nll = (lse - tgt) * mask[None, :]
+        return total + nll.sum(), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(body), jnp.zeros((), jnp.float32),
+        (xs, ts, valid),
+    )
+    return total
 
 
 def causal_lm_loss(
@@ -278,9 +358,16 @@ def causal_lm_loss(
     *,
     aux_weight: float = 0.01,
 ) -> jax.Array:
-    """Next-token cross entropy (tokens shifted internally)."""
-    logits, aux = forward(params, tokens[:, :-1], cfg, mesh)
+    """Next-token cross entropy (tokens shifted internally). With
+    cfg.loss_chunk > 0 the head projection + softmax stream over
+    sequence chunks (identical math, a fraction of the peak memory)."""
     targets = tokens[:, 1:]
+    chunk = cfg.loss_chunk
+    if chunk and chunk > 0 and targets.shape[1] > chunk:
+        x, aux = hidden_forward(params, tokens[:, :-1], cfg, mesh)
+        total = _chunked_nll_sum(x, params["lm_head"], targets, chunk)
+        return total / targets.size + aux_weight * aux
+    logits, aux = forward(params, tokens[:, :-1], cfg, mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean() + aux_weight * aux
