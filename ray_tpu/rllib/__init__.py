@@ -14,6 +14,16 @@ from .multi_agent import (  # noqa: F401
 )
 from .sac import SAC, SACConfig  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
+from .appo import APPO, APPOConfig  # noqa: F401
+from .td3 import TD3, TD3Config  # noqa: F401
+from .core import (  # noqa: F401
+    ActorCriticModule,
+    DeterministicActorModule,
+    Learner,
+    LearnerGroup,
+    QModule,
+    RLModule,
+)
 from .replay_buffers import (  # noqa: F401
     PrioritizedReplayBuffer,
     ReplayBuffer,

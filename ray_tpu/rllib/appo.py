@@ -1,0 +1,173 @@
+"""APPO: asynchronous PPO (learner/actor split).
+
+Ref analogue: rllib/algorithms/appo/appo.py — IMPALA's asynchronous
+architecture with PPO's clipped surrogate. EnvRunners sample
+CONTINUOUSLY (each runner always has a sample() in flight; the driver
+never barriers on the slowest); the learner consumes whichever batch
+lands first, corrects for policy lag with clipped importance ratios
+computed against the BEHAVIOR logps recorded at sample time, and
+broadcasts fresh weights every ``broadcast_interval`` updates. The
+learner itself can be a remote actor (LearnerGroup remote mode) so
+sampling and gradient steps overlap — the split the reference's
+Learner/LearnerGroup architecture exists for.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .core import LearnerGroup
+from .ppo import PPOLearner
+from .sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    LOGPS,
+    OBS,
+    RETURNS,
+    SampleBatch,
+)
+
+
+class APPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param: float = 0.3
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        # Clip on the importance ratio against stale behavior policies
+        # (ref: APPO's IS-ratio clipping atop the PPO surrogate).
+        self.is_ratio_clip: float = 2.0
+        # Weights push to runners every N learner updates, not every
+        # update — the async point of the architecture.
+        self.broadcast_interval: int = 4
+        # Batches consumed per train() iteration.
+        self.batches_per_iteration: int = 8
+        # Host the learner in its own actor (overlaps with sampling).
+        self.remote_learner: bool = False
+
+    def build(self) -> "APPO":
+        return APPO(self.copy())
+
+
+class APPOLearner(PPOLearner):
+    """PPO's clipped surrogate with an additional hard clip on the
+    importance ratio: batches arrive from runners up to
+    broadcast_interval updates stale, so unbounded ratios would blow up
+    the surrogate (ref: appo_learner's IS handling). The clip itself
+    lives in PPOLearner.compute_loss (is_ratio_clip) — one loss body,
+    two algorithms."""
+
+    def __init__(self, policy, lr, clip, vf_coeff, ent_coeff,
+                 is_ratio_clip):
+        super().__init__(policy, lr, clip, vf_coeff, ent_coeff,
+                         is_ratio_clip=is_ratio_clip)
+
+
+class APPO(Algorithm):
+    def _build_learner(self, policy):
+        c = self.config
+
+        def factory(weights=policy.get_weights(), c=c):
+            class _W:  # minimal get_weights shim for the factory
+                @staticmethod
+                def get_weights():
+                    return weights
+
+            return APPOLearner(
+                _W, c.lr, c.clip_param, c.vf_loss_coeff,
+                c.entropy_coeff, c.is_ratio_clip,
+            )
+
+        self.learner_group = LearnerGroup(
+            factory, remote=c.remote_learner
+        )
+        self._inflight: Dict[Any, Any] = {}  # sample ref -> runner
+        self._pending_updates: List[Any] = []  # remote-mode stat refs
+        self._updates_since_broadcast = 0
+        self._total_updates = 0
+        return self.learner_group
+
+    def _ensure_sampling(self):
+        """Every runner keeps exactly one sample() in flight."""
+        busy = set(self._inflight.values())
+        for r in self.runners:
+            if r not in busy:
+                self._inflight[r.sample.remote()] = r
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        stats: Dict[str, float] = {}
+        consumed = 0
+        env_steps = 0
+        while consumed < c.batches_per_iteration:
+            self._ensure_sampling()
+            ready, _ = ray_tpu.wait(
+                list(self._inflight), num_returns=1, timeout=60
+            )
+            if not ready:
+                break
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            batch: SampleBatch = ray_tpu.get(ref, timeout=60)
+            # Immediately resubmit THIS runner: sampling never drains.
+            self._inflight[runner.sample.remote()] = runner
+            result = self.learner_group.update_async({
+                "obs": batch[OBS],
+                "actions": np.asarray(batch[ACTIONS], dtype=np.int32),
+                "old_logp": batch[LOGPS],
+                "adv": batch[ADVANTAGES],
+                "returns": batch[RETURNS],
+            })
+            if isinstance(result, dict):
+                stats = result  # local mode runs inline
+            else:
+                # Remote learner: do NOT wait — the gradient step
+                # overlaps with the next ray_tpu.wait on sample refs
+                # (the learner/actor split's point). Stats resolve at
+                # iteration end.
+                self._pending_updates.append(result)
+            consumed += 1
+            env_steps += batch.count
+            self._total_updates += 1
+            self._updates_since_broadcast += 1
+            if self._updates_since_broadcast >= c.broadcast_interval:
+                # The learner actor processes calls in order, so this
+                # weights read queues after every submitted update.
+                weights = self.learner_group.get_weights()
+                for r in self.runners:
+                    r.set_weights.remote(weights)
+                self._updates_since_broadcast = 0
+        if self._pending_updates:
+            # Resolve the async updates' stats (also a barrier that
+            # keeps the pending list bounded per iteration).
+            resolved = ray_tpu.get(self._pending_updates, timeout=300)
+            self._pending_updates.clear()
+            if resolved:
+                stats = resolved[-1]
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners], timeout=60
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        out = {
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": env_steps,
+            "num_learner_updates": self._total_updates,
+        }
+        if isinstance(stats, dict):
+            out.update(stats)
+        return out
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def stop(self):
+        super().stop()
+        if getattr(self, "learner_group", None) is not None:
+            self.learner_group.shutdown()

@@ -1,0 +1,327 @@
+"""RLModule / Learner / LearnerGroup — the pluggable learner layer.
+
+Ref analogue: rllib/core/rl_module/rl_module.py (network container) and
+rllib/core/learner/learner.py:227 (compute_gradients:553,
+apply_gradients:675, update:1227) + learner_group.py:66. Algorithms stop
+hand-rolling jax nets and optimizer plumbing: an RLModule declares the
+parameter pytree + pure forward functions, a Learner subclass implements
+``compute_loss`` and inherits the jitted
+grad/clip/apply/target-polyak update, and a LearnerGroup runs the
+learner locally or inside a remote actor (the learner/actor split APPO
+exercises).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+# ------------------------------------------------------------------ modules
+
+class RLModule:
+    """Owns network construction + pure forward functions (jax). The
+    parameter pytree is plain nested lists/dicts of arrays so the CPU
+    rollout policies (policy.py) can consume the same weights."""
+
+    def init_params(self) -> Any:
+        raise NotImplementedError
+
+    @staticmethod
+    def mlp(params, x):
+        import jax.numpy as jnp
+
+        for W, b in params:
+            x = jnp.tanh(x @ W + b)
+        return x
+
+
+class ActorCriticModule(RLModule):
+    """Discrete actor-critic MLP matching policy.MLPPolicy's pytree
+    (trunk/pi/vf) so learner weights broadcast straight into the numpy
+    rollout policy."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: int = 64,
+                 seed: int = 0):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = hidden
+        self.seed = seed
+
+    def init_params(self):
+        from .policy import MLPPolicy
+
+        return MLPPolicy(
+            self.obs_dim, self.num_actions, self.hidden, self.seed
+        ).get_weights()
+
+    @classmethod
+    def forward(cls, params, obs):
+        """(logits, value) — pure jax."""
+        h = cls.mlp(params["trunk"], obs)
+        (Wp, bp), = params["pi"]
+        (Wv, bv), = params["vf"]
+        return h @ Wp + bp, (h @ Wv + bv)[..., 0]
+
+
+class DeterministicActorModule(RLModule):
+    """Deterministic continuous actor (TD3-style): tanh(mu) scaled to
+    the Box bounds; matches policy.DeterministicPolicy's pytree."""
+
+    def __init__(self, obs_dim: int, act_dim: int, hidden: int = 64,
+                 seed: int = 0):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.hidden = hidden
+        self.seed = seed
+
+    def init_params(self):
+        from .policy import init_mlp_params
+
+        rng = np.random.RandomState(self.seed)
+        return {
+            "trunk": init_mlp_params(
+                rng, [self.obs_dim, self.hidden, self.hidden]
+            ),
+            "mu": init_mlp_params(rng, [self.hidden, self.act_dim]),
+        }
+
+    @classmethod
+    def forward(cls, params, obs):
+        """Action in [-1, 1]^act_dim — pure jax."""
+        import jax.numpy as jnp
+
+        h = cls.mlp(params["trunk"], obs)
+        (Wm, bm), = params["mu"]
+        return jnp.tanh(h @ Wm + bm)
+
+
+class QModule(RLModule):
+    """State-action value MLP: Q(s, a) -> scalar."""
+
+    def __init__(self, obs_dim: int, act_dim: int, hidden: int = 64,
+                 seed: int = 0):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.hidden = hidden
+        self.seed = seed
+
+    def init_params(self):
+        from .policy import init_mlp_params
+
+        rng = np.random.RandomState(self.seed)
+        return {
+            "trunk": init_mlp_params(
+                rng, [self.obs_dim + self.act_dim, self.hidden,
+                      self.hidden]
+            ),
+            "q": init_mlp_params(rng, [self.hidden, 1]),
+        }
+
+    @classmethod
+    def forward(cls, params, obs, act):
+        import jax.numpy as jnp
+
+        h = cls.mlp(params["trunk"], jnp.concatenate([obs, act], -1))
+        (W, b), = params["q"]
+        return (h @ W + b)[..., 0]
+
+
+# ------------------------------------------------------------------ learner
+
+class Learner:
+    """Owns the parameter pytree, the optax optimizer, optional polyak
+    target copies, and ONE jitted update. Subclasses implement
+    ``compute_loss(params, target, batch) -> (loss, stats)`` (pure jax)
+    and inherit everything else (ref: Learner.compute_gradients /
+    apply_gradients / update)."""
+
+    def __init__(self, params, *, lr: float = 3e-4,
+                 grad_clip: Optional[float] = None,
+                 target_keys: Tuple[str, ...] = (),
+                 tau: float = 0.005):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._params = jax.tree.map(jnp.asarray, params)
+        chain = []
+        if grad_clip:
+            chain.append(optax.clip_by_global_norm(grad_clip))
+        chain.append(optax.adam(lr))
+        self._tx = optax.chain(*chain)
+        self._opt_state = self._tx.init(self._params)
+        self._target_keys = tuple(target_keys)
+        self._tau = tau
+        # jnp leaves are immutable; sharing is a correct deep "copy".
+        self._target = {k: self._params[k] for k in self._target_keys}
+        self._jit_update = None  # built lazily (subclass is ready then)
+        self.num_updates = 0
+
+    # -- subclass surface ----------------------------------------------
+
+    def compute_loss(self, params, target, batch):
+        """Pure jax: (scalar loss, {stat: scalar}). ``target`` is the
+        polyak-averaged target subtree dict ({} when target_keys=())."""
+        raise NotImplementedError
+
+    # -- update --------------------------------------------------------
+
+    def _build(self):
+        import jax
+        import optax
+
+        tau = self._tau
+        tkeys = self._target_keys
+
+        def upd(params, opt_state, target, batch):
+            (loss, stats), grads = jax.value_and_grad(
+                self.compute_loss, has_aux=True
+            )(params, target, batch)
+            updates, opt_state = self._tx.update(
+                grads, opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
+            if tkeys:
+                # Entries outside target_keys pass through untouched
+                # (a subclass may maintain them on its own schedule,
+                # e.g. TD3's delayed actor target).
+                target = {
+                    **target,
+                    **{
+                        k: jax.tree.map(
+                            lambda t, p: (1.0 - tau) * t + tau * p,
+                            target[k], params[k],
+                        )
+                        for k in tkeys
+                    },
+                }
+            stats["total_loss"] = loss
+            return params, opt_state, target, stats
+
+        self._jit_update = jax.jit(upd)
+
+    def update_device(self, batch: Dict[str, np.ndarray]
+                      ) -> Dict[str, Any]:
+        """One gradient step; stats stay ON DEVICE (no host sync), so a
+        tight minibatch loop keeps jax's async dispatch pipelined."""
+        import jax.numpy as jnp
+
+        if self._jit_update is None:
+            self._build()
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self._params, self._opt_state, self._target, stats = (
+            self._jit_update(
+                self._params, self._opt_state, self._target, jbatch
+            )
+        )
+        self.num_updates += 1
+        return stats
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        return {k: float(v)
+                for k, v in self.update_device(batch).items()}
+
+    # -- weights -------------------------------------------------------
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params)
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self._params = jax.tree.map(jnp.asarray, weights)
+
+    def get_state(self):
+        import jax
+
+        return {
+            "params": self.get_weights(),
+            "target": jax.tree.map(np.asarray, self._target),
+            "num_updates": self.num_updates,
+        }
+
+
+class _LearnerActor:
+    """Actor wrapper hosting a Learner replica (LearnerGroup remote
+    mode)."""
+
+    def __init__(self, blob: bytes):
+        import cloudpickle
+
+        factory = cloudpickle.loads(blob)
+        self._learner = factory()
+
+    def update(self, batch):
+        return self._learner.update(batch)
+
+    def get_weights(self):
+        return self._learner.get_weights()
+
+    def num_updates(self):
+        return self._learner.num_updates
+
+
+class LearnerGroup:
+    """Run a Learner locally or inside a remote actor (ref:
+    learner_group.py:66 — local vs remote learners; the remote mode is
+    the learner/actor split async algorithms build on). ``update_async``
+    returns a future-like ref in remote mode so sampling continues
+    while the learner steps."""
+
+    def __init__(self, learner_factory: Callable[[], Learner],
+                 *, remote: bool = False,
+                 ray_remote_args: Optional[dict] = None):
+        self._remote = remote
+        if not remote:
+            self._learner = learner_factory()
+            self._actor = None
+        else:
+            import cloudpickle
+
+            import ray_tpu
+
+            blob = cloudpickle.dumps(learner_factory)
+            opts = dict(ray_remote_args or {})
+            cls = (ray_tpu.remote(**opts)(_LearnerActor) if opts
+                   else ray_tpu.remote(_LearnerActor))
+            self._actor = cls.remote(blob)
+            self._learner = None
+
+    @property
+    def is_remote(self) -> bool:
+        return self._remote
+
+    def update(self, batch) -> Dict[str, float]:
+        if self._learner is not None:
+            return self._learner.update(batch)
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.update.remote(batch), timeout=300)
+
+    def update_async(self, batch):
+        """Remote mode: returns the update's result ref immediately.
+        Local mode: runs inline and returns the stats."""
+        if self._learner is not None:
+            return self._learner.update(batch)
+        return self._actor.update.remote(batch)
+
+    def get_weights(self):
+        if self._learner is not None:
+            return self._learner.get_weights()
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.get_weights.remote(), timeout=300)
+
+    def shutdown(self):
+        if self._actor is not None:
+            import ray_tpu
+
+            try:
+                ray_tpu.kill(self._actor)
+            except Exception:
+                pass

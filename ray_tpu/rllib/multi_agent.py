@@ -218,7 +218,7 @@ class MultiAgentPPO:
         weights: Dict[str, Any] = {}
         for pid, parts in merged.items():
             batch = SampleBatch.concat(parts)
-            out = self.learners[pid].update(
+            out = self.learners[pid].update_epochs(
                 batch, epochs=c.num_epochs,
                 minibatch_size=c.minibatch_size, rng=np.random.RandomState(
                     c.seed + self.iteration),

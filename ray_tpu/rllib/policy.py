@@ -112,6 +112,46 @@ class QPolicy:
         return action, 0.0, 0.0
 
 
+class DeterministicPolicy:
+    """Continuous-control deterministic actor (TD3-style): tanh(mu)
+    scaled to the Box bounds, plus Gaussian EXPLORATION noise applied at
+    sample time only (ref analogue: the TD3 policy's deterministic
+    action + GaussianNoise exploration)."""
+
+    def __init__(self, obs_dim: int, act_dim: int, low, high,
+                 hidden: int = 64, seed: int = 0,
+                 exploration_noise: float = 0.1):
+        rng = np.random.RandomState(seed)
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.low = np.asarray(low, dtype=np.float32)
+        self.high = np.asarray(high, dtype=np.float32)
+        self.exploration_noise = exploration_noise
+        self.weights: Dict[str, List] = {
+            "trunk": init_mlp_params(rng, [obs_dim, hidden, hidden]),
+            "mu": init_mlp_params(rng, [hidden, act_dim]),
+        }
+
+    def set_weights(self, weights):
+        self.weights = weights
+
+    def get_weights(self):
+        return self.weights
+
+    def compute_action(self, obs: np.ndarray, rng: np.random.RandomState):
+        h = obs.reshape(-1)
+        for W, b in self.weights["trunk"]:
+            h = np.tanh(h @ W + b)
+        (Wm, bm), = self.weights["mu"]
+        u = np.tanh(h @ Wm + bm)
+        u = np.clip(
+            u + self.exploration_noise * rng.randn(self.act_dim),
+            -1.0, 1.0,
+        )
+        action = self.low + (u + 1.0) * 0.5 * (self.high - self.low)
+        return action.astype(np.float32), 0.0, 0.0
+
+
 class SquashedGaussianPolicy:
     """Continuous-control actor: tanh-squashed Gaussian over a Box action
     space, numpy inference for rollouts (ref analogue: the SAC policy's

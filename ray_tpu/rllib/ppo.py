@@ -13,6 +13,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from .algorithm import Algorithm, AlgorithmConfig
+from .core import ActorCriticModule, Learner
 from .sample_batch import (
     ACTIONS,
     ADVANTAGES,
@@ -34,85 +35,73 @@ class PPOConfig(AlgorithmConfig):
         return PPO(self.copy())
 
 
-class PPOLearner:
-    """jax learner over the numpy policy pytree."""
+class PPOLearner(Learner):
+    """Clipped-surrogate loss on the shared Learner layer (ref:
+    ppo_learner / Learner.compute_loss — the module is the shared
+    ActorCriticModule, the grad/apply plumbing is inherited).
+    ``is_ratio_clip`` (APPO) additionally caps the importance ratio
+    against stale behavior policies before the PPO clip."""
 
     def __init__(self, policy, lr: float, clip: float, vf_coeff: float,
-                 ent_coeff: float):
+                 ent_coeff: float, is_ratio_clip: float = None):
+        super().__init__(policy.get_weights(), lr=lr)
+        self._clip = clip
+        self._vf_coeff = vf_coeff
+        self._ent_coeff = ent_coeff
+        self._is_clip = is_ratio_clip
+
+    def compute_loss(self, params, target, batch):
         import jax
         import jax.numpy as jnp
-        import optax
 
-        self._policy = policy
-        self._tx = optax.adam(lr)
-        self._params = jax.tree.map(jnp.asarray, policy.get_weights())
-        self._opt_state = self._tx.init(self._params)
-
-        def forward(params, obs):
-            h = obs
-            for W, b in params["trunk"]:
-                h = jnp.tanh(h @ W + b)
-            (Wp, bp), = params["pi"]
-            (Wv, bv), = params["vf"]
-            return h @ Wp + bp, (h @ Wv + bv)[..., 0]
-
-        def loss_fn(params, obs, actions, old_logp, adv, returns):
-            logits, values = forward(params, obs)
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, actions[:, None], axis=1
-            )[:, 0]
-            ratio = jnp.exp(logp - old_logp)
-            adv_n = (adv - adv.mean()) / (adv.std() + 1e-8)
-            surr = jnp.minimum(
-                ratio * adv_n,
-                jnp.clip(ratio, 1 - clip, 1 + clip) * adv_n,
-            )
-            pi_loss = -surr.mean()
-            vf_loss = ((values - returns) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
-            return total, {
-                "policy_loss": pi_loss,
-                "vf_loss": vf_loss,
-                "entropy": entropy,
-            }
-
-        def update(params, opt_state, obs, actions, old_logp, adv, returns):
-            (loss, stats), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params, obs, actions, old_logp, adv, returns)
-            updates, opt_state = self._tx.update(grads, opt_state)
-            params = optax.apply_updates(params, updates)
-            stats["total_loss"] = loss
-            return params, opt_state, stats
-
-        self._update = jax.jit(update)
-
-    def update(self, batch: SampleBatch, *, epochs: int,
-               minibatch_size: int, rng: np.random.RandomState
-               ) -> Dict[str, float]:
-        import jax.numpy as jnp
-
+        logits, values = ActorCriticModule.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1
+        )[:, 0]
+        ratio = jnp.exp(logp - batch["old_logp"])
         stats = {}
+        if self._is_clip is not None:
+            # Stale-policy guard FIRST, then the PPO clip (APPO).
+            ratio = jnp.minimum(ratio, self._is_clip)
+            stats["mean_is_ratio"] = ratio.mean()
+        adv = batch["adv"]
+        adv_n = (adv - adv.mean()) / (adv.std() + 1e-8)
+        surr = jnp.minimum(
+            ratio * adv_n,
+            jnp.clip(ratio, 1 - self._clip, 1 + self._clip) * adv_n,
+        )
+        pi_loss = -surr.mean()
+        vf_loss = ((values - batch["returns"]) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = (pi_loss + self._vf_coeff * vf_loss
+                 - self._ent_coeff * entropy)
+        stats.update({
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        })
+        return total, stats
+
+    def update_epochs(self, batch: SampleBatch, *, epochs: int,
+                      minibatch_size: int, rng: np.random.RandomState
+                      ) -> Dict[str, float]:
+        stats: Dict[str, Any] = {}
         for _ in range(epochs):
             shuffled = batch.shuffle(rng)
-            for mb in shuffled.minibatches(min(minibatch_size, batch.count)):
-                self._params, self._opt_state, stats = self._update(
-                    self._params,
-                    self._opt_state,
-                    jnp.asarray(mb[OBS]),
-                    jnp.asarray(mb[ACTIONS], dtype=jnp.int32),
-                    jnp.asarray(mb[LOGPS]),
-                    jnp.asarray(mb[ADVANTAGES]),
-                    jnp.asarray(mb[RETURNS]),
-                )
+            for mb in shuffled.minibatches(
+                min(minibatch_size, batch.count)
+            ):
+                # Device-side stats: ONE host sync after all epochs,
+                # keeping the minibatch loop async-dispatched.
+                stats = self.update_device({
+                    "obs": mb[OBS],
+                    "actions": np.asarray(mb[ACTIONS], dtype=np.int32),
+                    "old_logp": mb[LOGPS],
+                    "adv": mb[ADVANTAGES],
+                    "returns": mb[RETURNS],
+                })
         return {k: float(v) for k, v in stats.items()}
-
-    def get_weights(self):
-        import jax
-
-        return jax.tree.map(np.asarray, self._params)
 
 
 class PPO(Algorithm):
@@ -133,7 +122,7 @@ class PPO(Algorithm):
                 ray_tpu.get([r.sample.remote() for r in self.runners])
             )
         batch = SampleBatch.concat(batches)
-        learner_stats = self.learner.update(
+        learner_stats = self.learner.update_epochs(
             batch, epochs=c.num_epochs, minibatch_size=c.minibatch_size,
             rng=self._rng,
         )

@@ -1,0 +1,267 @@
+"""TD3: twin-delayed deterministic policy gradient (continuous control).
+
+Ref analogue: rllib/algorithms/td3 — DDPG plus the three TD3 fixes
+(Fujimoto 2018): twin critics with min-target, target-policy smoothing
+(clipped noise on the target action), and delayed actor updates. Built
+on the shared Learner layer (core.py): the critic TD loss is
+``compute_loss`` with polyak targets handled by the base class; the
+delayed actor step is a second jitted update applied every
+``policy_delay`` critic steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .core import DeterministicActorModule, Learner, QModule
+from .env_runner import NEXT_OBS, TransitionEnvRunner
+from .replay_buffers import ReplayBuffer
+from .sample_batch import ACTIONS, DONES, OBS, REWARDS, SampleBatch
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size: int = 100_000
+        self.num_steps_sampled_before_learning_starts: int = 500
+        self.num_updates_per_iteration: int = 64
+        self.tau: float = 0.005
+        self.policy_delay: int = 2
+        self.target_noise: float = 0.2
+        self.target_noise_clip: float = 0.5
+        self.exploration_noise: float = 0.1
+
+    def build(self) -> "TD3":
+        return TD3(self.copy())
+
+
+class TD3Learner(Learner):
+    """Critic loss through the shared Learner plumbing; the delayed
+    actor step is its own jitted function updating actor params + its
+    polyak target."""
+
+    def __init__(self, policy, cfg, obs_dim: int, act_dim: int,
+                 low, high):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        seed = cfg.seed
+        params = {
+            "actor": policy.get_weights(),
+            "q1": QModule(obs_dim, act_dim, cfg.hidden_size,
+                          seed + 1).init_params(),
+            "q2": QModule(obs_dim, act_dim, cfg.hidden_size,
+                          seed + 2).init_params(),
+        }
+        # Critic targets polyak in the base update; the ACTOR target is
+        # seeded below and synced ONLY by the delayed actor step — the
+        # base passes non-listed target entries through untouched.
+        super().__init__(params, lr=cfg.lr, target_keys=("q1", "q2"),
+                         tau=cfg.tau)
+        self._target["actor"] = self._params["actor"]
+        # The base optimizer must NOT touch actor params: a shared Adam
+        # would keep applying actor momentum on every critic-only step
+        # (zero grads != zero update under Adam), silently defeating the
+        # delayed-policy mechanism. Mask the actor subtree; the delayed
+        # actor step below has its own optimizer + state.
+        labels = {
+            k: jax.tree.map(
+                lambda _: "frozen" if k == "actor" else "train", v
+            )
+            for k, v in self._params.items()
+        }
+        self._tx = optax.multi_transform(
+            {"train": optax.adam(cfg.lr), "frozen": optax.set_to_zero()},
+            labels,
+        )
+        self._opt_state = self._tx.init(self._params)
+        self._atx = optax.adam(cfg.lr)
+        self._aopt_state = self._atx.init(self._params["actor"])
+        self._gamma = cfg.gamma
+        self._noise = cfg.target_noise
+        self._noise_clip = cfg.target_noise_clip
+        self._low = jnp.asarray(np.asarray(low, np.float32))
+        self._high = jnp.asarray(np.asarray(high, np.float32))
+        self._rng = np.random.RandomState(seed + 3)
+        self._act_dim = act_dim
+        self._jit_actor = None
+
+    # Actions are stored in ENV units; critics consume [-1, 1].
+    def _from_env(self, a):
+        import jax.numpy as jnp
+
+        u = (a - self._low) / (self._high - self._low) * 2.0 - 1.0
+        return jnp.clip(u, -1.0, 1.0)
+
+    def compute_loss(self, params, target, batch):
+        import jax
+        import jax.numpy as jnp
+
+        obs, nxt = batch["obs"], batch["next_obs"]
+        act = self._from_env(batch["actions"])
+        # Target-policy smoothing: clipped noise on the target action.
+        a2 = DeterministicActorModule.forward(target["actor"], nxt)
+        noise = jnp.clip(
+            batch["eps"] * self._noise,
+            -self._noise_clip, self._noise_clip,
+        )
+        a2 = jnp.clip(a2 + noise, -1.0, 1.0)
+        tq = jnp.minimum(
+            QModule.forward(target["q1"], nxt, a2),
+            QModule.forward(target["q2"], nxt, a2),
+        )
+        backup = jax.lax.stop_gradient(
+            batch["rew"] + self._gamma * (1.0 - batch["done"]) * tq
+        )
+        q1 = QModule.forward(params["q1"], obs, act)
+        q2 = QModule.forward(params["q2"], obs, act)
+        critic_loss = ((q1 - backup) ** 2 + (q2 - backup) ** 2).mean()
+        return critic_loss, {
+            "critic_loss": critic_loss,
+            "q1_mean": q1.mean(),
+        }
+
+    def actor_update(self, batch: Dict[str, np.ndarray]
+                     ) -> Dict[str, float]:
+        """Delayed policy step: maximize Q1(s, pi(s)) with the actor's
+        OWN optimizer/state, then polyak-sync the actor target (its only
+        sync point — critic targets sync in the base update)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        if self._jit_actor is None:
+            tau = self._tau
+
+            def aloss(actor, q1, obs):
+                a = DeterministicActorModule.forward(actor, obs)
+                return -QModule.forward(q1, obs, a).mean()
+
+            def upd(actor, aopt_state, q1, atarget, obs):
+                loss, grads = jax.value_and_grad(aloss)(
+                    actor, jax.lax.stop_gradient(q1), obs,
+                )
+                updates, aopt_state = self._atx.update(
+                    grads, aopt_state, actor
+                )
+                actor = optax.apply_updates(actor, updates)
+                atarget = jax.tree.map(
+                    lambda t, p: (1.0 - tau) * t + tau * p,
+                    atarget, actor,
+                )
+                return actor, aopt_state, atarget, loss
+
+            self._jit_actor = jax.jit(upd)
+        actor, self._aopt_state, atarget, loss = self._jit_actor(
+            self._params["actor"], self._aopt_state,
+            self._params["q1"], self._target["actor"],
+            jnp.asarray(batch["obs"]),
+        )
+        self._params = {**self._params, "actor": actor}
+        self._target = {**self._target, "actor": atarget}
+        return {"actor_loss": float(loss)}
+
+    def learn_on_batch(self, batch: SampleBatch, *, do_actor: bool
+                       ) -> Dict[str, float]:
+        n = batch.count
+        eps = self._rng.randn(n, self._act_dim).astype(np.float32)
+        np_batch = {
+            "obs": batch[OBS],
+            "actions": np.asarray(batch[ACTIONS], np.float32),
+            "rew": batch[REWARDS],
+            "done": np.asarray(batch[DONES], np.float32),
+            "next_obs": batch[NEXT_OBS],
+            "eps": eps,
+        }
+        stats = self.update(np_batch)
+        if do_actor:
+            stats.update(self.actor_update(np_batch))
+        return stats
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params["actor"])
+
+
+class _TD3EnvRunner(TransitionEnvRunner):
+    """Transition collection with the deterministic + noise policy."""
+
+
+class TD3(Algorithm):
+    def _make_policy_factory(self, obs_dim: int, act_dim: int):
+        from .policy import DeterministicPolicy
+
+        if not getattr(self, "_continuous", False):
+            raise ValueError(
+                "TD3 supports Box (continuous) action spaces only"
+            )
+        config = self.config
+        low, high = self._action_low, self._action_high
+
+        def policy_factory(obs_dim=obs_dim, act_dim=act_dim,
+                           hidden=config.hidden_size, seed=config.seed,
+                           noise=config.exploration_noise):
+            return DeterministicPolicy(
+                obs_dim, act_dim, low, high, hidden, seed,
+                exploration_noise=noise,
+            )
+
+        return policy_factory
+
+    def _runner_class(self):
+        return _TD3EnvRunner
+
+    def _build_learner(self, policy):
+        c = self.config
+        self.buffer = ReplayBuffer(c.buffer_size, seed=c.seed)
+        self._env_steps = 0
+        return TD3Learner(policy, c, self._obs_dim, self._num_actions,
+                          self._action_low, self._action_high)
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        batches: List[SampleBatch] = ray_tpu.get(
+            [r.sample.remote() for r in self.runners]
+        )
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += b.count
+
+        stats: Dict[str, Any] = {}
+        num_updates = 0
+        if self._env_steps >= c.num_steps_sampled_before_learning_starts:
+            for i in range(c.num_updates_per_iteration):
+                mb = self.buffer.sample(c.minibatch_size)
+                # Merge (not replace): the delayed actor step only runs
+                # every policy_delay updates — its stats must survive
+                # the critic-only updates after it.
+                stats.update(self.learner.learn_on_batch(
+                    mb, do_actor=(i % c.policy_delay == 0)
+                ))
+                num_updates += 1
+            weights = self.learner.get_weights()
+            ray_tpu.get(
+                [r.set_weights.remote(weights) for r in self.runners]
+            )
+
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": self._env_steps,
+            "num_learner_updates": num_updates,
+            "buffer_size": len(self.buffer),
+            **stats,
+        }

@@ -372,3 +372,120 @@ def test_multi_agent_independent_policies(ray_tpu_start):
         assert set(w) == {"p0", "p1"}
     finally:
         algo.stop()
+
+
+def test_appo_async_learns_cartpole(ray_tpu_start):
+    """APPO: asynchronous sampling (runners never barrier) + IS-clipped
+    PPO loss on the shared Learner layer; reward improves (ref:
+    rllib/algorithms/appo)."""
+    pytest.importorskip("gymnasium")
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (
+        APPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, rollout_fragment_length=200)
+        .training(lr=1e-3, batches_per_iteration=6,
+                  broadcast_interval=2)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        first = algo.train()
+        last = {}
+        for _ in range(14):
+            last = algo.train()
+        assert last["num_learner_updates"] > first["num_learner_updates"]
+        assert np.isfinite(last["total_loss"])
+        assert last["mean_is_ratio"] > 0
+        assert last["episode_reward_mean"] > max(
+            40.0, first["episode_reward_mean"] + 15
+        ), (first["episode_reward_mean"], last["episode_reward_mean"])
+    finally:
+        algo.stop()
+
+
+def test_appo_remote_learner_group(ray_tpu_start):
+    """LearnerGroup remote mode: the learner lives in its own actor
+    (the learner/actor split), and training still advances."""
+    pytest.importorskip("gymnasium")
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (
+        APPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, rollout_fragment_length=100)
+        .training(batches_per_iteration=3, remote_learner=True)
+        .build()
+    )
+    try:
+        out = algo.train()
+        assert out["num_learner_updates"] >= 3
+        w = algo.get_weights()
+        assert "pi" in w and "trunk" in w
+    finally:
+        algo.stop()
+
+
+def test_td3_learns_continuous_control(ray_tpu_start):
+    """TD3 on a Box action space: twin critics + delayed deterministic
+    actor move reward toward the a=-x optimum (ref:
+    rllib/algorithms/td3)."""
+    from ray_tpu.rllib import TD3Config
+
+    config = (
+        TD3Config()
+        .environment(_go_to_zero_env)
+        .env_runners(num_env_runners=2, rollout_fragment_length=100)
+        .training(lr=3e-3, minibatch_size=128,
+                  num_updates_per_iteration=60,
+                  num_steps_sampled_before_learning_starts=200,
+                  exploration_noise=0.2)
+    )
+    algo = config.build()
+    try:
+        first = algo.train()
+        last = {}
+        for _ in range(15):
+            last = algo.train()
+        assert last["num_learner_updates"] > 0
+        assert np.isfinite(last["critic_loss"])
+        assert "actor_loss" in last
+        # Convergence measured on this env: -18 -> ~-8 over 16 iters
+        # (episode_reward_mean is a running average and lags).
+        assert last["episode_reward_mean"] > \
+            first["episode_reward_mean"] + 4, (first, last)
+        assert last["episode_reward_mean"] > -12, last
+    finally:
+        algo.stop()
+
+
+def test_learner_layer_unit():
+    """The shared Learner: polyak targets move toward params, grad
+    steps reduce a quadratic loss, weights round-trip."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core import Learner
+
+    class Quad(Learner):
+        def compute_loss(self, params, target, batch):
+            w = params["w"][0][0]
+            loss = ((w - batch["target_w"]) ** 2).sum()
+            return loss, {"dist": loss}
+
+    w0 = np.ones((2, 2), dtype=np.float32)
+    lrn = Quad({"w": [(w0, np.zeros(2, np.float32))]},
+               lr=0.1, target_keys=("w",), tau=0.5)
+    tgt = {"target_w": np.full((2, 2), 3.0, np.float32)}
+    first = lrn.update(tgt)
+    for _ in range(50):
+        last = lrn.update(tgt)
+    assert last["dist"] < first["dist"] * 0.01
+    got = lrn.get_weights()["w"][0][0]
+    np.testing.assert_allclose(got, 3.0, atol=0.2)
+    # target tracked params through polyak updates
+    tw = np.asarray(lrn._target["w"][0][0])
+    np.testing.assert_allclose(tw, got, atol=0.3)
+    # round-trip
+    lrn.set_weights(lrn.get_weights())
+    assert lrn.update(tgt)["dist"] <= last["dist"] * 1.5
