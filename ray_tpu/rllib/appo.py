@@ -52,20 +52,6 @@ class APPOConfig(AlgorithmConfig):
         return APPO(self.copy())
 
 
-class APPOLearner(PPOLearner):
-    """PPO's clipped surrogate with an additional hard clip on the
-    importance ratio: batches arrive from runners up to
-    broadcast_interval updates stale, so unbounded ratios would blow up
-    the surrogate (ref: appo_learner's IS handling). The clip itself
-    lives in PPOLearner.compute_loss (is_ratio_clip) — one loss body,
-    two algorithms."""
-
-    def __init__(self, policy, lr, clip, vf_coeff, ent_coeff,
-                 is_ratio_clip):
-        super().__init__(policy, lr, clip, vf_coeff, ent_coeff,
-                         is_ratio_clip=is_ratio_clip)
-
-
 class APPO(Algorithm):
     def _build_learner(self, policy):
         c = self.config
@@ -76,9 +62,12 @@ class APPO(Algorithm):
                 def get_weights():
                     return weights
 
-            return APPOLearner(
+            # The IS-ratio clip against stale behavior policies lives
+            # directly in PPOLearner.compute_loss (is_ratio_clip): one
+            # loss body serves both algorithms.
+            return PPOLearner(
                 _W, c.lr, c.clip_param, c.vf_loss_coeff,
-                c.entropy_coeff, c.is_ratio_clip,
+                c.entropy_coeff, is_ratio_clip=c.is_ratio_clip,
             )
 
         self.learner_group = LearnerGroup(

@@ -164,10 +164,13 @@ class TD3Learner(Learner):
         )
         self._params = {**self._params, "actor": actor}
         self._target = {**self._target, "actor": atarget}
-        return {"actor_loss": float(loss)}
+        return {"actor_loss": loss}  # device value; caller syncs
 
     def learn_on_batch(self, batch: SampleBatch, *, do_actor: bool
-                       ) -> Dict[str, float]:
+                       ) -> Dict[str, Any]:
+        """One critic step (+ delayed actor step). Stats stay ON DEVICE
+        — the caller float()s once per iteration, so the 64-update inner
+        loop stays async-dispatched (core.py update_device)."""
         n = batch.count
         eps = self._rng.randn(n, self._act_dim).astype(np.float32)
         np_batch = {
@@ -178,15 +181,40 @@ class TD3Learner(Learner):
             "next_obs": batch[NEXT_OBS],
             "eps": eps,
         }
-        stats = self.update(np_batch)
+        stats = self.update_device(np_batch)
         if do_actor:
-            stats.update(self.actor_update(np_batch))
+            stats = {**stats, **self.actor_update(np_batch)}
         return stats
 
     def get_weights(self):
+        """ACTOR weights only — what runners' rollout policy consumes."""
         import jax
 
         return jax.tree.map(np.asarray, self._params["actor"])
+
+    def set_weights(self, weights):
+        """Accepts either a full {actor, q1, q2} tree or (matching
+        get_weights) an actor-only tree, merged into the full params —
+        the inherited round-trip must not drop the critics."""
+        import jax
+        import jax.numpy as jnp
+
+        if isinstance(weights, dict) and "q1" in weights:
+            super().set_weights(weights)
+        else:
+            self._params = {
+                **self._params,
+                "actor": jax.tree.map(jnp.asarray, weights),
+            }
+
+    def get_state(self):
+        import jax
+
+        return {
+            "params": jax.tree.map(np.asarray, self._params),
+            "target": jax.tree.map(np.asarray, self._target),
+            "num_updates": self.num_updates,
+        }
 
 
 class _TD3EnvRunner(TransitionEnvRunner):
@@ -247,6 +275,8 @@ class TD3(Algorithm):
                     mb, do_actor=(i % c.policy_delay == 0)
                 ))
                 num_updates += 1
+            # ONE host sync for the whole update loop.
+            stats = {k: float(v) for k, v in stats.items()}
             weights = self.learner.get_weights()
             ray_tpu.get(
                 [r.set_weights.remote(weights) for r in self.runners]

@@ -53,8 +53,21 @@ def _resolve(name: str) -> Optional[DeploymentHandle]:
     return handle
 
 
+def _encode(item) -> bytes:
+    if isinstance(item, (bytes, bytearray)):
+        return bytes(item)
+    return json.dumps(item, default=str).encode()
+
+
 class _GenericHandler:
-    """grpc.GenericRpcHandler routing /<deployment>/<method>."""
+    """grpc.GenericRpcHandler routing /<deployment>/<method>.
+
+    Methods whose name ends in ``stream`` (case-insensitive — e.g.
+    ``stream``, ``TokenStream``) are SERVER-STREAMING: the replica
+    method must be a generator, and every yielded item becomes one
+    response message (bytes pass through; anything else JSON-encodes) —
+    the gRPC mirror of the HTTP proxy's SSE route (ref: serve's
+    RESPONSE_STREAMING over the gRPC proxy)."""
 
     def service(self, handler_call_details):
         import grpc
@@ -63,16 +76,20 @@ class _GenericHandler:
         if len(parts) != 2:
             return None
         dep_name, method = parts
+        streaming = method.lower().endswith("stream")
 
-        def unary_unary(request: bytes, context):
+        def _handle_or_abort(context):
             try:
                 handle = _resolve(dep_name)
             except _ControllerDown as e:
                 context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-                return b""
             if handle is None:
                 context.abort(grpc.StatusCode.NOT_FOUND,
                               f"no deployment {dep_name!r}")
+            return handle
+
+        def unary_unary(request: bytes, context):
+            handle = _handle_or_abort(context)
             try:
                 h = handle if method == "__call__" else handle.options(
                     method=method
@@ -81,10 +98,23 @@ class _GenericHandler:
             except Exception as e:  # noqa: BLE001
                 context.abort(grpc.StatusCode.INTERNAL, str(e))
                 return b""
-            if isinstance(result, (bytes, bytearray)):
-                return bytes(result)
-            return json.dumps(result, default=str).encode()
+            return _encode(result)
 
+        def unary_stream(request: bytes, context):
+            handle = _handle_or_abort(context)
+            try:
+                it = handle.options(method=method).stream(request)
+                for item in it:
+                    yield _encode(item)
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+        if streaming:
+            return grpc.unary_stream_rpc_method_handler(
+                unary_stream,
+                request_deserializer=None,   # identity: raw bytes
+                response_serializer=None,
+            )
         return grpc.unary_unary_rpc_method_handler(
             unary_unary,
             request_deserializer=None,   # identity: raw bytes
@@ -104,8 +134,18 @@ def _make_handler():
 
 
 def start_grpc_ingress(port: int = 0, *, host: str = "127.0.0.1",
-                       max_workers: int = 8) -> int:
-    """Start (or return) the gRPC ingress; returns the bound port."""
+                       max_workers: int = 8,
+                       max_concurrent_rpcs: Optional[int] = 64) -> int:
+    """Start (or return) the gRPC ingress; returns the bound port.
+
+    Admission is BOUNDED: at most ``max_workers`` RPCs execute while up
+    to ``max_concurrent_rpcs`` are admitted (queued on the pool); beyond
+    that gRPC rejects with RESOURCE_EXHAUSTED instead of stacking
+    unbounded blocked work (ref: the proxy's queue-length admission).
+    When the cluster runs mutual TLS (core/tls.py), the ingress binds a
+    TLS port requiring CA-signed client certificates — the ingress is
+    the one channel a remote attacker actually reaches, so it must not
+    stay plaintext while the control plane is encrypted."""
     global _server
     from concurrent import futures
 
@@ -116,9 +156,27 @@ def start_grpc_ingress(port: int = 0, *, host: str = "127.0.0.1",
             return _server._rtpu_port
         server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
+            maximum_concurrent_rpcs=max_concurrent_rpcs,
         )
         server.add_generic_rpc_handlers((_make_handler(),))
-        bound = server.add_insecure_port(f"{host}:{port}")
+        from ..core.config import get_config
+        from ..core.tls import tls_enabled
+
+        if tls_enabled():
+            cfg = get_config()
+            with open(cfg.tls_key_path, "rb") as f:
+                key = f.read()
+            with open(cfg.tls_cert_path, "rb") as f:
+                crt = f.read()
+            with open(cfg.tls_ca_path, "rb") as f:
+                ca = f.read()
+            creds = grpc.ssl_server_credentials(
+                [(key, crt)], root_certificates=ca,
+                require_client_auth=True,  # mutual, like the cluster
+            )
+            bound = server.add_secure_port(f"{host}:{port}", creds)
+        else:
+            bound = server.add_insecure_port(f"{host}:{port}")
         if bound == 0:
             # gRPC signals bind failure by returning port 0, it does
             # not raise — fail loudly like the HTTP mirror would.

@@ -215,11 +215,54 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(500, {"error": str(e)})
 
 
+class _TLSHTTPServer(ThreadingHTTPServer):
+    """HTTPS ingress with mutual TLS. The LISTENING socket stays plain:
+    each connection is wrapped and handshaken in ITS OWN handler thread
+    with a timeout — wrapping the listener would run handshakes in the
+    single accept loop, letting one stalled client (TCP open, no
+    ClientHello) block the whole ingress."""
+
+    _HANDSHAKE_TIMEOUT_S = 10.0
+
+    def __init__(self, addr, handler, tls_ctx):
+        self._tls_ctx = tls_ctx
+        super().__init__(addr, handler)
+
+    def finish_request(self, request, client_address):
+        request.settimeout(self._HANDSHAKE_TIMEOUT_S)
+        try:
+            request = self._tls_ctx.wrap_socket(
+                request, server_side=True,
+                do_handshake_on_connect=False,
+            )
+            request.do_handshake()
+        except Exception:
+            try:
+                request.close()
+            except Exception:
+                pass
+            return
+        request.settimeout(None)
+        super().finish_request(request, client_address)
+
+
+def _make_http_server(addr) -> ThreadingHTTPServer:
+    """Plain HTTP — or mutual-TLS HTTPS when the cluster runs mTLS
+    (plaintext ingress beside an encrypted control plane would be the
+    one door left open)."""
+    from ..core.tls import server_ssl_context
+
+    ctx = server_ssl_context()
+    if ctx is not None:
+        return _TLSHTTPServer(addr, _Handler, ctx)
+    return ThreadingHTTPServer(addr, _Handler)
+
+
 def start_proxy(port: int = 8000) -> int:
     global _server, _thread
     if _server is not None:
         return _server.server_address[1]
-    _server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    _server = _make_http_server(("127.0.0.1", port))
     _thread = threading.Thread(target=_server.serve_forever, daemon=True)
     _thread.start()
     return _server.server_address[1]
@@ -253,7 +296,7 @@ class ProxyActor:
     routes resolve dynamically through the controller."""
 
     def __init__(self, port: int = 0):
-        self._server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._server = _make_http_server(("0.0.0.0", port))
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True
         )

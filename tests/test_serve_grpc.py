@@ -72,3 +72,160 @@ def test_per_node_grpc_proxies(serve_rt):
                 ray_tpu.kill(actor)
             except Exception:
                 pass
+
+
+def test_grpc_server_streaming(serve_rt):
+    """Server-streaming RPC: a method named *stream yields one response
+    message per generator item (the gRPC mirror of the HTTP SSE route —
+    token streams for LLM serving)."""
+    import grpc
+
+    @serve.deployment
+    class Tok:
+        def stream(self, payload: bytes):
+            for i, ch in enumerate(payload.decode().split(",")):
+                yield {"i": i, "tok": ch}
+
+        def rawstream(self, payload: bytes):
+            yield payload
+            yield payload[::-1]
+
+    serve.run(Tok.bind(), name="gen")
+    port = serve.start_grpc_ingress(0)
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+
+    items = list(channel.unary_stream("/gen/stream")(b"a,b,c", timeout=60))
+    assert [json.loads(x)["tok"] for x in items] == ["a", "b", "c"]
+
+    raw = list(channel.unary_stream("/gen/rawstream")(b"xyz", timeout=60))
+    assert raw == [b"xyz", b"zyx"]  # bytes pass through unencoded
+    channel.close()
+
+
+def test_grpc_ingress_bounded_admission(ray_tpu_start):
+    """Beyond maximum_concurrent_rpcs the server REJECTS with
+    RESOURCE_EXHAUSTED instead of stacking blocked threads (the r4
+    ingress saturated at 8 blocked threads silently)."""
+    import threading
+    import time as _time
+
+    import grpc
+
+    @serve.deployment
+    class Slow:
+        def __call__(self, payload: bytes) -> bytes:
+            _time.sleep(3.0)
+            return b"done"
+
+    serve.run(Slow.bind(), name="slow")
+    port = serve.start_grpc_ingress(0, max_workers=2,
+                                    max_concurrent_rpcs=2)
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        call = channel.unary_unary("/slow/__call__")
+        results = []
+
+        def fire():
+            try:
+                call(b"x", timeout=30)
+                results.append("ok")
+            except grpc.RpcError as e:
+                results.append(e.code())
+
+        ts = [threading.Thread(target=fire) for _ in range(5)]
+        for t in ts:
+            t.start()
+            _time.sleep(0.05)  # admit in order
+        for t in ts:
+            t.join(timeout=60)
+        assert grpc.StatusCode.RESOURCE_EXHAUSTED in results, results
+        assert results.count("ok") >= 2, results
+        channel.close()
+    finally:
+        serve.stop_grpc_ingress()
+        serve.shutdown()
+
+
+def test_ingress_tls(tmp_path, monkeypatch):
+    """With cluster mTLS on, BOTH ingresses serve TLS requiring client
+    certificates: a certified gRPC client round-trips (unary and
+    streaming), an uncertified one is rejected, and the HTTP proxy
+    speaks HTTPS (the ingress must not stay plaintext while the control
+    plane is encrypted)."""
+    import ssl as _ssl
+
+    import grpc
+
+    from test_tls import _make_certs
+
+    crt, key, ca = _make_certs(tmp_path)
+    monkeypatch.setenv("RAY_TPU_TLS_CERT_PATH", crt)
+    monkeypatch.setenv("RAY_TPU_TLS_KEY_PATH", key)
+    monkeypatch.setenv("RAY_TPU_TLS_CA_PATH", ca)
+    from ray_tpu.core.config import reset_config
+
+    reset_config()  # re-read env
+    ray_tpu.init(num_cpus=2, system_config={"log_to_driver": False})
+    try:
+        @serve.deployment
+        class Echo:
+            def __call__(self, payload: bytes) -> bytes:
+                return payload[::-1]
+
+            def stream(self, payload: bytes):
+                yield payload
+                yield b"end"
+
+        handle = serve.run(Echo.bind(), name="echo")
+        gport = serve.start_grpc_ingress(0)
+        with open(ca, "rb") as f:
+            ca_b = f.read()
+        with open(crt, "rb") as f:
+            crt_b = f.read()
+        with open(key, "rb") as f:
+            key_b = f.read()
+        creds = grpc.ssl_channel_credentials(
+            root_certificates=ca_b, private_key=key_b,
+            certificate_chain=crt_b,
+        )
+        # Cluster certs carry the node IP/hostname? Use the override so
+        # verification targets the cert's CN.
+        channel = grpc.secure_channel(
+            f"127.0.0.1:{gport}", creds,
+            options=(("grpc.ssl_target_name_override", "rtpu-node"),),
+        )
+        call = channel.unary_unary("/echo/__call__")
+        assert call(b"abc", timeout=60) == b"cba"
+        items = list(channel.unary_stream("/echo/stream")(b"t", timeout=60))
+        assert items == [b"t", b"end"]
+        channel.close()
+
+        # No client cert -> handshake rejected.
+        bad = grpc.secure_channel(
+            f"127.0.0.1:{gport}",
+            grpc.ssl_channel_credentials(root_certificates=ca_b),
+            options=(("grpc.ssl_target_name_override", "rtpu-node"),),
+        )
+        with pytest.raises(grpc.RpcError):
+            bad.unary_unary("/echo/__call__")(b"x", timeout=10)
+        bad.close()
+
+        # HTTP proxy serves HTTPS with client-cert verification.
+        import http.client
+
+        hport = handle.http_port
+        ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_cert_chain(crt, key)
+        ctx.load_verify_locations(ca)
+        ctx.check_hostname = False
+        conn = http.client.HTTPSConnection(
+            "127.0.0.1", hport, context=ctx, timeout=60
+        )
+        conn.request("GET", "/-/healthz")
+        assert conn.getresponse().status == 200
+        conn.close()
+    finally:
+        serve.stop_grpc_ingress()
+        serve.shutdown()
+        ray_tpu.shutdown()
+        reset_config()
