@@ -64,30 +64,219 @@ def _discover_head(host: str, port: int) -> Tuple[str, int]:
         conn.close()
 
 
+class _ReconnectingConn:
+    """Connection wrapper with transparent redial (ref analogue: the
+    Ray Client worker's reconnect loop, util/client/worker.py). The
+    reader thread drives reconnection on recv failure; senders park on
+    an event until the new connection is up (a locally-FAILED send never
+    reached the server, so resending it is safe). ``on_reconnect`` lets
+    the runtime flag in-flight requests whose replies died with the old
+    socket."""
+
+    def __init__(self, conn: Connection, redial, on_reconnect,
+                 timeout_s: float = 30.0):
+        self._conn = conn
+        self._redial = redial
+        self._on_reconnect = on_reconnect
+        self._timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._ok = threading.Event()
+        self._ok.set()
+        self._dead = False
+
+    def send(self, message):
+        import time
+
+        deadline = time.monotonic() + self._timeout_s + 5
+        while True:
+            conn = self._conn
+            try:
+                return conn.send(message)
+            except (ConnectionClosed, OSError):
+                # The reader notices the break too and redials; wait for
+                # it rather than racing a second reconnect. A LOCALLY
+                # failed send never reached the server, so resending
+                # after the redial is safe for any frame type.
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._ok.wait(remaining) \
+                        or self._dead:
+                    raise ConnectionClosed(
+                        "client connection lost (reconnect failed)"
+                    )
+                if self._conn is conn:
+                    # ok was set but the conn didn't change yet: yield.
+                    time.sleep(0.05)
+
+    def send_nowait(self, message):
+        """Single attempt on the CURRENT connection — raises instead of
+        parking (request() owns its own replay decision, including the
+        pending-table bookkeeping a parked resend would race)."""
+        try:
+            return self._conn.send(message)
+        except OSError as e:
+            raise ConnectionClosed(str(e)) from e
+
+    def wait_ok(self, timeout: float) -> bool:
+        """Block until the transport is usable again (or dead)."""
+        return self._ok.wait(timeout) and not self._dead
+
+    def recv(self):
+        while True:
+            try:
+                return self._conn.recv()
+            except (ConnectionClosed, OSError):
+                if self._dead or not self._reconnect():
+                    raise ConnectionClosed("client connection lost")
+
+    def _reconnect(self) -> bool:
+        import time
+
+        with self._lock:
+            if self._dead:
+                return False
+            self._ok.clear()
+            deadline = time.monotonic() + self._timeout_s
+            while time.monotonic() < deadline and not self._dead:
+                try:
+                    self._conn = self._redial()
+                    break
+                except Exception:
+                    time.sleep(1.0)
+            else:
+                self._dead = True
+                self._ok.set()  # release parked senders into the raise
+                return False
+            self._ok.set()
+        try:
+            self._on_reconnect()
+        except Exception:
+            pass
+        return True
+
+    def close(self):
+        self._dead = True
+        self._ok.set()
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+
+# Request types safe to auto-retry after a reconnect: re-executing them
+# on the server is harmless even if the original WAS processed and only
+# its reply was lost. "submit" qualifies because the server dedups
+# client submissions by task_id (an in-flight task resubmitted after a
+# blip is recognized, not re-queued). Everything else fails with a clear
+# error (the caller cannot know whether the call executed).
+_IDEMPOTENT_TYPES = {
+    "get_locations", "wait", "pull_object", "pull_chunk", "kv",
+    "fetch_function", "get_named_actor", "state", "ping", "put_abort",
+    "submit",
+}
+
+
 class ClientRuntime(WorkerRuntime):
-    """WorkerRuntime over TCP with remote object IO (no local store)."""
+    """WorkerRuntime over TCP with remote object IO (no local store).
+    Survives connection blips: the transport redials and re-registers,
+    in-flight IDEMPOTENT requests replay automatically, and
+    non-idempotent ones fail with a clear error instead of hanging."""
 
     is_client = True
 
     def __init__(self, conn: Connection, node_id: NodeID,
-                 worker_id: WorkerID):
+                 worker_id: WorkerID, redial=None):
+        self._alive = True
+        if redial is not None:
+            conn = _ReconnectingConn(
+                conn, redial, self._flag_pending_lost,
+                timeout_s=get_config().client_reconnect_timeout_s,
+            )
         super().__init__(
             conn,
             job_id=JobID.from_random(),
             node_id=node_id,
             worker_id=worker_id,
         )
-        self._alive = True
         self._reader = threading.Thread(
             target=self._reader_loop, name="rtpu-client-reader", daemon=True
         )
         self._reader.start()
+
+    def _flag_pending_lost(self):
+        """The old socket died with replies in flight: wake every waiter
+        with a conn-lost marker (request() replays idempotent calls)."""
+        with self._pending_lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for p in pending:
+            p.payload = {"type": "reply", "_conn_lost": True}
+            p.event.set()
+
+    def request(self, msg, timeout=None):
+        """Request with reconnect-aware replay. Two distinct failure
+        windows: a LOCAL send failure (frame never left — replayed for
+        any type once the transport is back) and an IN-FLIGHT loss (the
+        old socket died holding the reply — replayed only for idempotent
+        types; others raise, since the call may have executed). Each
+        attempt uses a fresh msg_id registered before its own send, so a
+        replay can never race the pending-table flush."""
+        import time as _time
+
+        from .runtime import _PendingReply
+
+        mtype = msg.get("type")
+        idempotent = mtype in _IDEMPOTENT_TYPES
+        cfg_timeout = get_config().client_reconnect_timeout_s
+        inflight_retries = 0
+        deadline = (None if timeout is None
+                    else _time.monotonic() + timeout + 5)
+        while True:
+            msg_id = next(self._msg_counter)
+            m = dict(msg)
+            m["msg_id"] = msg_id
+            pending = _PendingReply()
+            with self._pending_lock:
+                self._pending[msg_id] = pending
+            try:
+                if isinstance(self._conn, _ReconnectingConn):
+                    self._conn.send_nowait(m)
+                else:
+                    self._conn.send(m)
+            except (ConnectionClosed, OSError):
+                # Never delivered: drop the stillborn pending entry,
+                # wait for the transport, replay (any type).
+                with self._pending_lock:
+                    self._pending.pop(msg_id, None)
+                if not (isinstance(self._conn, _ReconnectingConn)
+                        and self._conn.wait_ok(cfg_timeout + 5)):
+                    raise ConnectionError(
+                        "client connection lost (reconnect failed)"
+                    )
+                continue
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - _time.monotonic()))
+            if not pending.event.wait(remaining):
+                with self._pending_lock:
+                    self._pending.pop(msg_id, None)
+                raise TimeoutError("no reply from node manager")
+            reply = pending.payload
+            if not reply.get("_conn_lost"):
+                return reply
+            inflight_retries += 1
+            if not idempotent or inflight_retries > 3:
+                raise ConnectionError(
+                    f"client connection lost during {mtype!r}; the call "
+                    "may or may not have executed on the cluster"
+                )
 
     def _reader_loop(self):
         while self._alive:
             try:
                 msg = self._conn.recv()
             except (ConnectionClosed, OSError):
+                # _ReconnectingConn only raises once redial failed past
+                # its deadline (or close()): the runtime is dead.
+                self._flag_pending_lost()
                 break
             if msg.get("type") == "reply":
                 self.handle_reply(msg)
@@ -179,29 +368,77 @@ class ClientRuntime(WorkerRuntime):
             f"object {oid.hex()} unavailable to the client"
         )
 
+    def _submit_spec(self, spec):
+        """Client submits are ACKED requests: a fire-and-forget frame
+        that reached the kernel buffer but died in flight during a blip
+        would silently drop the task (the later get would hang). The
+        server dedups by task_id, so the reconnect replay is safe."""
+        spec.owner_id = self.worker_id
+        reply = self.request({"type": "submit", "spec": spec},
+                             timeout=get_config()
+                             .client_reconnect_timeout_s + 30)
+        if not reply.get("ok"):
+            raise RuntimeError(
+                f"submit rejected: {reply.get('error')}"
+            )
+
+    def _flush_deltas(self, deltas):
+        try:
+            super()._flush_deltas(deltas)
+        except Exception:
+            # Undelivered: merge back so the next flush retries instead
+            # of silently desynchronizing the head's refcounts.
+            with self.refs._lock:
+                for oid, d in deltas.items():
+                    self.refs._deltas[oid] = (
+                        self.refs._deltas.get(oid, 0) + d
+                    )
+
     def shutdown(self):
         self._alive = False
         super().shutdown()
-        try:
-            self.refs.flush()
-        except Exception:
-            pass
-        self._conn.close()
+        conn = self._conn
+        # Flush only over a currently-healthy transport: redialing a
+        # gone head for 30s inside shutdown() helps nobody.
+        healthy = (not isinstance(conn, _ReconnectingConn)
+                   or (conn._ok.is_set() and not conn._dead))
+        if healthy:
+            if isinstance(conn, _ReconnectingConn):
+                conn._timeout_s = 0.0  # a drop mid-flush exits fast
+            try:
+                self.refs.flush()
+            except Exception:
+                pass
+        conn.close()
+
+
+def _dial(host: str, port: int, wid: WorkerID):
+    """One registration handshake against the GCS address: rediscovers
+    the head (it may have restarted on another port) and re-registers
+    this client id. Returns (conn, head_node_id)."""
+    peer_host, peer_port = _discover_head(host, port)
+    conn = Connection(_tls_socket(peer_host, peer_port))
+    conn.send({
+        "type": "client_hello",
+        "token": get_config().session_token,
+    })
+    conn.send({"type": "register", "worker_id": wid.hex()})
+    ack = conn.recv()
+    if ack.get("type") != "registered":
+        raise ConnectionError(f"head refused client: {ack}")
+    return conn, NodeID.from_hex(ack["node_id"])
 
 
 def connect(address: str) -> ClientRuntime:
     """``address``: "rtpu://host:gcs_port"."""
     hostport = address[len("rtpu://"):]
     host, port_s = hostport.rsplit(":", 1)
-    peer_host, peer_port = _discover_head(host, int(port_s))
-    conn = Connection(_tls_socket(peer_host, peer_port))
-    conn.send({
-        "type": "client_hello",
-        "token": get_config().session_token,
-    })
+    port = int(port_s)
     wid = WorkerID.from_random()
-    conn.send({"type": "register", "worker_id": wid.hex()})
-    ack = conn.recv()
-    if ack.get("type") != "registered":
-        raise ConnectionError(f"head refused client: {ack}")
-    return ClientRuntime(conn, NodeID.from_hex(ack["node_id"]), wid)
+    conn, node_id = _dial(host, port, wid)
+    return ClientRuntime(
+        conn, node_id, wid,
+        # Redials re-register under the same client id (the server's
+        # old handle died with the old socket).
+        redial=lambda: _dial(host, port, wid)[0],
+    )

@@ -60,6 +60,10 @@ class Config:
     health_check_period_s: float = 5.0
     # Default max task retries on worker crash (ref: task_manager.h retries).
     default_max_retries: int = 3
+    # Thin client (rtpu://): how long the transport keeps redialing after
+    # a connection blip before declaring the runtime dead (ref analogue:
+    # Ray Client's reconnect grace, util/client/worker.py).
+    client_reconnect_timeout_s: float = 30.0
     # Scheduler: spread threshold for the hybrid policy (ref:
     # policy/hybrid_scheduling_policy.h scheduler_spread_threshold).
     scheduler_spread_threshold: float = 0.5

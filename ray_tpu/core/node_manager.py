@@ -824,7 +824,16 @@ class NodeManager:
             for item in msg["items"]:
                 await self._on_task_done(w, item)
         elif mtype == "submit":
-            await self.submit_task(msg["spec"])
+            spec = msg["spec"]
+            # Dedup by task_id: a thin client replaying a submit after a
+            # connection blip must not double-queue the task (the replay
+            # is only ambiguous while the original is still tracked).
+            if spec.task_id not in self._tasks:
+                await self.submit_task(spec)
+            if msg.get("msg_id") is not None:
+                await w.writer.send({
+                    "type": "reply", "msg_id": msg["msg_id"], "ok": True,
+                })
         elif mtype == "get_locations":
             asyncio.ensure_future(self._reply_locations(w, msg))
         elif mtype == "wait":

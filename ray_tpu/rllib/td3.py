@@ -127,7 +127,7 @@ class TD3Learner(Learner):
         }
 
     def actor_update(self, batch: Dict[str, np.ndarray]
-                     ) -> Dict[str, float]:
+                     ) -> Dict[str, Any]:
         """Delayed policy step: maximize Q1(s, pi(s)) with the actor's
         OWN optimizer/state, then polyak-sync the actor target (its only
         sync point — critic targets sync in the base update)."""

@@ -243,7 +243,17 @@ class _TLSHTTPServer(ThreadingHTTPServer):
                 pass
             return
         request.settimeout(None)
-        super().finish_request(request, client_address)
+        try:
+            super().finish_request(request, client_address)
+        finally:
+            # wrap_socket DETACHED the original fd, so socketserver's
+            # shutdown_request/close_request (called with the original
+            # socket object) are no-ops — close the wrapped socket
+            # explicitly or its fd lives until GC.
+            try:
+                request.close()
+            except Exception:
+                pass
 
 
 def _make_http_server(addr) -> ThreadingHTTPServer:
