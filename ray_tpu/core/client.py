@@ -146,11 +146,14 @@ class _ReconnectingConn:
                 self._dead = True
                 self._ok.set()  # release parked senders into the raise
                 return False
+            # Flush the pending table BEFORE releasing parked senders:
+            # a sender woken first could register + send a fresh request
+            # that the flush would then wrongly mark conn-lost.
+            try:
+                self._on_reconnect()
+            except Exception:
+                pass
             self._ok.set()
-        try:
-            self._on_reconnect()
-        except Exception:
-            pass
         return True
 
     def close(self):

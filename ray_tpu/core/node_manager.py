@@ -367,6 +367,11 @@ class NodeManager:
         # each registers this node with the owner and releases on local GC.
         self._borrow_stubs: Set[ObjectID] = set()
         self._borrowed_from: Dict[ObjectID, str] = {}  # oid -> owner hex
+        # Acked client submits already accepted (bounded FIFO): dedups a
+        # reconnect replay even after the task finished and left _tasks.
+        from collections import OrderedDict as _OD
+
+        self._recent_client_submits: "_OD[TaskID, None]" = _OD()
         self._borrow_registering: Set[ObjectID] = set()
         # Containment pins: container object -> refs serialized inside it
         # (a put'ed list of refs, a returned dict of refs). Pinned while
@@ -826,11 +831,21 @@ class NodeManager:
         elif mtype == "submit":
             spec = msg["spec"]
             # Dedup by task_id: a thin client replaying a submit after a
-            # connection blip must not double-queue the task (the replay
-            # is only ambiguous while the original is still tracked).
-            if spec.task_id not in self._tasks:
+            # connection blip must not double-queue the task. Live tasks
+            # dedup against the record table; FAST tasks that finished
+            # during the redial dedup against a bounded recent-ids set
+            # (only acked submits are recorded — fire-and-forget worker
+            # submits never replay).
+            acked = msg.get("msg_id") is not None
+            seen = (spec.task_id in self._tasks
+                    or spec.task_id in self._recent_client_submits)
+            if not seen:
+                if acked:
+                    self._recent_client_submits[spec.task_id] = None
+                    while len(self._recent_client_submits) > 8192:
+                        self._recent_client_submits.popitem(last=False)
                 await self.submit_task(spec)
-            if msg.get("msg_id") is not None:
+            if acked:
                 await w.writer.send({
                     "type": "reply", "msg_id": msg["msg_id"], "ok": True,
                 })

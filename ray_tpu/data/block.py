@@ -128,20 +128,53 @@ class BlockAccessor:
     def schema(self):
         return self.block.schema
 
+    @staticmethod
+    def _one_chunk(col):
+        """ChunkedArray -> Array without copying when single-chunk (the
+        common case for store-read blocks; combine_chunks copies even
+        then)."""
+        if isinstance(col, pa.ChunkedArray):
+            return col.chunk(0) if col.num_chunks == 1 \
+                else col.combine_chunks()
+        return col
+
+    @staticmethod
+    def _arrow_to_numpy(arr) -> np.ndarray:
+        """Arrow array -> numpy, ZERO-COPY when the buffers allow it
+        (primitive dtype, no nulls): the numpy array then views the
+        arrow buffer, which views the shared-memory mapping — the whole
+        read path stays copy-free (SURVEY.md §5.8). Falls back to a
+        copying conversion for nullable/non-primitive columns."""
+        try:
+            return arr.to_numpy(zero_copy_only=True)
+        except Exception:
+            return arr.to_numpy(zero_copy_only=False)
+
     def to_numpy(self) -> Dict[str, np.ndarray]:
         out = {}
         for i, name in enumerate(self.block.schema.names):
-            col = self.block.column(i)
+            col = self._one_chunk(self.block.column(i))
             field = self.block.schema.field(i)
             meta = field.metadata or {}
             if _SHAPE_META in meta:
                 shape = eval(meta[_SHAPE_META].decode())  # noqa: S307 (own metadata)
-                flat = col.combine_chunks().flatten()
-                arr = flat.to_numpy(zero_copy_only=False).reshape(
+                if isinstance(col, pa.FixedSizeListArray):
+                    # .values is a zero-copy view — but it spans the
+                    # WHOLE backing buffer, so apply the array's
+                    # offset/length window (sliced blocks); the window
+                    # slice stays zero-copy. .flatten() would copy.
+                    lsize = col.type.list_size
+                    flat = col.values[
+                        col.offset * lsize:
+                        (col.offset + len(col)) * lsize
+                    ]
+                else:
+                    flat = col.flatten()
+                arr = self._arrow_to_numpy(flat).reshape(
                     (self.block.num_rows,) + tuple(shape)
                 )
             else:
-                arr = col.to_numpy(zero_copy_only=False)
+                arr = self._arrow_to_numpy(col)
             out[name] = arr
         return out
 

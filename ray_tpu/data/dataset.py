@@ -109,6 +109,31 @@ def _apply_chain(source: Callable[[], Block], ops: Sequence[_Op]) -> Block:
     return block
 
 
+# ---------------------------------------------------------- dlpack export
+
+def _dlpack_alias(arr: np.ndarray) -> np.ndarray:
+    """Writable-FLAGGED alias of a store-backed array for DLPack export
+    (SURVEY.md §5.8 zero-copy hand-off). The store's sealed views are
+    readonly, and numpy refuses to export readonly arrays through
+    DLPack (the protocol cannot signal readonly); jax arrays are
+    immutable, so letting jax alias the immutable store page is sound —
+    the flag flip exists ONLY to satisfy the export check. Never write
+    through the returned array. The alias carries a reference chain
+    (jax capsule -> alias -> ctypes buffer -> original array -> store
+    mapping) so the shm pages outlive every consumer."""
+    if arr.flags.writeable:
+        return arr
+    if not arr.flags.c_contiguous:
+        raise ValueError("dlpack export needs a contiguous array")
+    import ctypes
+
+    buf = (ctypes.c_char * arr.nbytes).from_address(
+        arr.ctypes.data
+    )
+    buf._rtpu_pin = arr  # keeps the readonly view (and its mapping) alive
+    return np.frombuffer(buf, dtype=arr.dtype).reshape(arr.shape)
+
+
 # -------------------------------------------------------------- the API
 
 class Dataset:
@@ -369,6 +394,9 @@ class Dataset:
         batch_format: str = "numpy",
         drop_last: bool = False,
     ) -> Iterator[Any]:
+        """NOTE: numpy batches may be READ-ONLY views over the shared
+        object store (the zero-copy read path); copy before mutating in
+        place (``batch["x"] = batch["x"] * s``, not ``*=``)."""
         leftover: Optional[Block] = None
         for block in self._iter_blocks():
             if leftover is not None and leftover.num_rows:
@@ -405,21 +433,56 @@ class Dataset:
             yield from BlockAccessor(block).iter_rows()
 
     def iter_jax_batches(self, *, batch_size: int = 256, device=None,
-                         drop_last: bool = True) -> Iterator[Any]:
+                         drop_last: bool = True,
+                         zero_copy: Optional[bool] = None
+                         ) -> Iterator[Any]:
         """Batches as jax arrays with one-batch device prefetch (the HBM
-        double-buffering path — SURVEY.md §7 phase 8)."""
+        double-buffering path — SURVEY.md §7 phase 8).
+
+        The batch arrays are numpy VIEWS over the shared-memory object
+        store (the store's 64-byte-aligned layout exists for this;
+        SURVEY.md §5.8's zero-copy hand-off). ``zero_copy=True`` imports
+        them into jax via dlpack — NO copy at all on the CPU backend
+        (the jax array aliases the store pages); on accelerators the
+        view feeds ``device_put``'s DMA directly, skipping the
+        staging copy ``jnp.asarray`` of a non-owned buffer can make.
+        Default: dlpack on the CPU backend, device_put elsewhere.
+        NOTE (dlpack aliasing): jax must not be handed writable aliases
+        of live store pages lightly — the store is immutable by
+        contract, so read-only aliasing is sound here."""
         import jax
-
-        def put(batch):
-            return {
-                k: (jax.device_put(v, device) if device else jnp_asarray(v))
-                for k, v in batch.items()
-            }
-
         import jax.numpy as jnp
 
-        def jnp_asarray(v):
+        if zero_copy is None:
+            zero_copy = jax.default_backend() == "cpu" and device is None
+        # dlpack aliasing only lands on HOST memory: with a non-CPU
+        # target (explicit device, or an accelerator default backend)
+        # the data must move — fall through to device_put/asarray so
+        # zero_copy=True cannot silently pin batches to CPU.
+        if zero_copy and (
+            (device is not None
+             and getattr(device, "platform", "cpu") != "cpu")
+            or (device is None and jax.default_backend() != "cpu")
+        ):
+            zero_copy = False
+
+        def convert(v):
+            if zero_copy:
+                try:
+                    # copy=False: alias or raise (never silently copy —
+                    # jax's copying dlpack import is SLOWER than
+                    # asarray, so only the true zero-copy path is worth
+                    # taking). Store buffers are 64-byte aligned by the
+                    # serialization layout precisely for this.
+                    return jnp.from_dlpack(_dlpack_alias(v), copy=False)
+                except Exception:
+                    pass  # non-contiguous/unaligned/exotic: fall through
+            if device is not None:
+                return jax.device_put(v, device)
             return jnp.asarray(v)
+
+        def put(batch):
+            return {k: convert(v) for k, v in batch.items()}
 
         it = self.iter_batches(batch_size=batch_size, drop_last=drop_last)
         prev = None
