@@ -246,6 +246,8 @@ def nodes():
             "NodeID": v["node_id"],
             "Alive": v["state"] == "alive",
             "Resources": v["resources_total"],
+            "Available": v.get("resources_available",
+                               v["resources_total"]),
             "IsHead": v.get("is_head", False),
             "Host": v.get("host"),
             "Labels": v.get("labels", {}),

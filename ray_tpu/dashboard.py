@@ -76,6 +76,19 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             path = self.path.split("?")[0].rstrip("/")
             if path in ("", "/index.html"):
+                # Single-page UI (dashboard_ui.py — the no-build-step
+                # equivalent of the reference's React client); the old
+                # minimal page stays at /simple.
+                from .dashboard_ui import PAGE
+
+                body = PAGE.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if path == "/simple":
                 body = _PAGE.encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/html")

@@ -1,0 +1,247 @@
+"""Dashboard single-page UI (served at /).
+
+Ref analogue: dashboard/client/src/ — the reference ships a 19.5k-LoC
+React app built with npm; this is the no-build-step equivalent: one
+vanilla-JS page with the same information architecture (overview tiles,
+nodes, tasks/actors/objects/workers tables with filtering, user
+metrics, on-demand profiling) over the same ``/api/*`` surface, auto-
+refreshing. No external assets — it works inside an airgapped cluster.
+"""
+
+PAGE = r"""<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+:root { --bg:#0e1117; --panel:#161b24; --line:#242b38; --txt:#dce3ee;
+        --dim:#8b97a8; --acc:#5aa2ff; --ok:#39c07b; --warn:#e6b450;
+        --err:#e5604c; }
+* { box-sizing:border-box; }
+body { margin:0; background:var(--bg); color:var(--txt);
+       font:13px/1.5 system-ui,-apple-system,'Segoe UI',sans-serif; }
+header { display:flex; align-items:center; gap:16px;
+         padding:10px 20px; background:var(--panel);
+         border-bottom:1px solid var(--line); }
+header h1 { font-size:15px; margin:0; font-weight:600; }
+header .sub { color:var(--dim); font-size:12px; }
+nav { display:flex; gap:2px; padding:0 12px; background:var(--panel);
+      border-bottom:1px solid var(--line); }
+nav button { background:none; border:none; color:var(--dim);
+             padding:9px 14px; cursor:pointer; font:inherit;
+             border-bottom:2px solid transparent; }
+nav button.on { color:var(--txt); border-bottom-color:var(--acc); }
+main { padding:16px 20px; max-width:1280px; margin:0 auto; }
+.tiles { display:grid; grid-template-columns:repeat(auto-fill,
+         minmax(170px,1fr)); gap:10px; margin-bottom:16px; }
+.tile { background:var(--panel); border:1px solid var(--line);
+        border-radius:8px; padding:12px 14px; }
+.tile .v { font-size:22px; font-weight:650; }
+.tile .k { color:var(--dim); font-size:11px;
+           text-transform:uppercase; letter-spacing:.05em; }
+table { border-collapse:collapse; width:100%; background:var(--panel);
+        border:1px solid var(--line); border-radius:8px;
+        overflow:hidden; }
+th,td { text-align:left; padding:6px 10px;
+        border-bottom:1px solid var(--line); white-space:nowrap; }
+th { color:var(--dim); font-size:11px; text-transform:uppercase;
+     letter-spacing:.05em; position:sticky; top:0;
+     background:var(--panel); }
+tr:last-child td { border-bottom:none; }
+td.num { font-variant-numeric:tabular-nums; }
+.pill { display:inline-block; padding:1px 8px; border-radius:999px;
+        font-size:11px; }
+.pill.ok { background:rgba(57,192,123,.15); color:var(--ok); }
+.pill.warn { background:rgba(230,180,80,.15); color:var(--warn); }
+.pill.err { background:rgba(229,96,76,.15); color:var(--err); }
+.pill.dim { background:rgba(139,151,168,.15); color:var(--dim); }
+.bar { height:6px; background:var(--line); border-radius:3px;
+       min-width:80px; }
+.bar i { display:block; height:100%; border-radius:3px;
+         background:var(--acc); }
+.controls { display:flex; gap:10px; margin-bottom:10px;
+            align-items:center; }
+input,select { background:var(--panel); color:var(--txt);
+               border:1px solid var(--line); border-radius:6px;
+               padding:5px 9px; font:inherit; }
+button.act { background:var(--acc); color:#fff; border:none;
+             border-radius:6px; padding:6px 12px; cursor:pointer; }
+pre { background:var(--panel); border:1px solid var(--line);
+      border-radius:8px; padding:12px; overflow:auto; }
+.muted { color:var(--dim); }
+#err { color:var(--err); padding:4px 0; }
+</style></head><body>
+<header><h1>ray_tpu</h1><span class="sub" id="clock"></span>
+  <span style="flex:1"></span>
+  <label class="sub"><input type="checkbox" id="auto" checked>
+    auto-refresh</label>
+  <button class="act" onclick="refresh()">refresh</button></header>
+<nav id="nav"></nav>
+<main><div id="err"></div><div id="view"></div></main>
+<script>
+const TABS = ["overview","tasks","actors","objects","workers",
+              "metrics","profile"];
+let tab = location.hash.slice(1) || "overview";
+let D = {nodes:[],tasks:[],actors:[],objects:[],workers:[],
+         tsum:{},asum:{},osum:{},metrics:{}};
+let filter = "";
+
+function h(s){return String(s==null?"":s).replace(/[&<>"]/g,
+  c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));}
+function short(s){s=String(s||"");return s.length>12?s.slice(0,12):s;}
+function mb(b){b=+b||0;return b>1<<30?(b/(1<<30)).toFixed(2)+" GiB":
+  b>1<<20?(b/(1<<20)).toFixed(1)+" MiB":
+  b>1024?(b/1024).toFixed(1)+" KiB":b+" B";}
+function pill(s){const m={alive:"ok",running:"ok",finished:"dim",
+  done:"dim",idle:"dim",pending:"warn",waiting:"warn",queued:"warn",
+  dead:"err",failed:"err",error:"err"};
+  return `<span class="pill ${m[String(s).toLowerCase()]||"dim"}">`+
+         `${h(s)}</span>`;}
+
+async function j(u){const r=await fetch(u);return r.json();}
+async function load(){
+  try{
+    const [nodes,tsum,asum,osum]=await Promise.all([
+      j("/api/nodes"),j("/api/summary/tasks"),
+      j("/api/summary/actors"),j("/api/summary/objects")]);
+    D.nodes=nodes;D.tsum=tsum;D.asum=asum;D.osum=osum;
+    if(tab=="tasks")D.tasks=await j("/api/tasks");
+    if(tab=="actors")D.actors=await j("/api/actors");
+    if(tab=="objects")D.objects=await j("/api/objects");
+    if(tab=="workers")D.workers=await j("/api/workers");
+    if(tab=="metrics")D.metrics=await j("/api/metrics");
+    document.getElementById("err").textContent="";
+  }catch(e){document.getElementById("err").textContent=
+    "fetch failed: "+e;}
+  render();
+}
+
+function table(rows,cols){
+  if(!rows.length)return '<p class="muted">none</p>';
+  const f=filter.toLowerCase();
+  const vis=f?rows.filter(r=>JSON.stringify(r).toLowerCase()
+    .includes(f)):rows;
+  let out="<table><tr>"+cols.map(c=>`<th>${h(c[0])}</th>`).join("")+
+    "</tr>";
+  for(const r of vis.slice(0,500))
+    out+="<tr>"+cols.map(c=>`<td class="${c[2]||""}">${c[1](r)}</td>`)
+      .join("")+"</tr>";
+  out+="</table>";
+  if(vis.length>500)out+=`<p class="muted">showing 500 of `+
+    `${vis.length}</p>`;
+  return out;
+}
+function controls(){return `<div class="controls">
+  <input placeholder="filter…" value="${h(filter)}"
+    oninput="filter=this.value;render()"></div>`;}
+
+function viewOverview(){
+  const alive=D.nodes.filter(n=>n.Alive).length;
+  const res={};const avail={};
+  for(const n of D.nodes){if(!n.Alive)continue;
+    for(const[k,v]of Object.entries(n.Resources||{}))
+      res[k]=(res[k]||0)+v;
+    for(const[k,v]of Object.entries(n.Available||n.ResourcesAvailable
+      ||{}))avail[k]=(avail[k]||0)+v;}
+  const running=D.tsum.running||0,
+        pending=(D.tsum.pending||0)+(D.tsum.queued||0)+
+                (D.tsum.waiting||0);
+  let t=`<div class="tiles">
+    <div class="tile"><div class="v">${alive}</div>
+      <div class="k">alive nodes</div></div>
+    <div class="tile"><div class="v">${running}</div>
+      <div class="k">running tasks</div></div>
+    <div class="tile"><div class="v">${pending}</div>
+      <div class="k">pending tasks</div></div>
+    <div class="tile"><div class="v">${D.asum.alive||0}</div>
+      <div class="k">alive actors</div></div>
+    <div class="tile"><div class="v">${D.osum.total_objects||0}</div>
+      <div class="k">objects</div></div>
+    <div class="tile"><div class="v">`+
+      `${mb(D.osum.total_size_bytes||0)}</div>
+      <div class="k">object bytes</div></div></div>`;
+  t+="<h3>resources</h3><table><tr><th>resource</th><th>used</th>"+
+     "<th>total</th><th></th></tr>";
+  for(const k of Object.keys(res).sort()){
+    const total=res[k],free=avail[k]??total,used=total-free;
+    const pct=total?Math.round(100*used/total):0;
+    t+=`<tr><td>${h(k)}</td><td class="num">${used.toFixed(1)}</td>
+      <td class="num">${total.toFixed(1)}</td>
+      <td><div class="bar"><i style="width:${pct}%"></i></div></td>
+      </tr>`;}
+  t+="</table><h3>nodes</h3>"+table(D.nodes,[
+    ["id",n=>short(n.NodeID)],["state",n=>pill(n.Alive?"alive":"dead")],
+    ["host",n=>h(n.NodeManagerAddress||n.Host||"")],
+    ["head",n=>n.IsHead?"head":""],
+    ["resources",n=>h(Object.entries(n.Resources||{})
+      .map(([k,v])=>`${k}:${v}`).join(" "))],
+  ]);
+  return t;
+}
+function viewTasks(){return controls()+table(D.tasks,[
+  ["task",t=>h(t.name||t.func_or_class_name||"")],
+  ["id",t=>short(t.task_id)],["state",t=>pill(t.state)],
+  ["node",t=>short(t.node_id)],
+  ["type",t=>h(t.type||"")]]);}
+function viewActors(){return controls()+table(D.actors,[
+  ["class",a=>h(a.class_name||"")],["id",a=>short(a.actor_id)],
+  ["state",a=>pill(a.state)],["name",a=>h(a.name||"")],
+  ["node",a=>short(a.node_id)],["pid",a=>h(a.pid||"")]]);}
+function viewObjects(){return controls()+table(D.objects,[
+  ["object",o=>short(o.object_id)],
+  ["size",o=>mb(o.size_bytes),"num"],["where",o=>h(o.where||"")],
+  ["node",o=>short(o.node_id)]]);}
+function viewWorkers(){return controls()+table(D.workers,[
+  ["worker",w=>short(w.worker_id)],["state",w=>pill(w.state)],
+  ["type",w=>h(w.worker_type||"")],["pid",w=>h(w.pid||"")],
+  ["node",w=>short(w.node_id)]]);}
+function viewMetrics(){
+  let t=`<p class="muted">Prometheus exposition at
+    <a href="/metrics" style="color:var(--acc)">/metrics</a></p>`;
+  const names=Object.keys(D.metrics);
+  if(!names.length)return t+'<p class="muted">no user metrics</p>';
+  for(const name of names.sort()){
+    const m=D.metrics[name];
+    t+=`<h3>${h(name)} <span class="muted">(${h(m.type)})</span></h3>`+
+      "<table><tr><th>labels</th><th>value</th></tr>";
+    for(const[k,v]of Object.entries(m.series))
+      t+=`<tr><td>${h(k)}</td><td class="num">`+
+         `${typeof v=="number"?v.toFixed(3):h(JSON.stringify(v))}`+
+         `</td></tr>`;
+    t+="</table>";}
+  return t;
+}
+function viewProfile(){
+  return `<div class="controls">
+    <label>seconds <input id="psec" value="2" size="3"></label>
+    <button class="act" onclick="profile()">sample stacks</button>
+    </div><div id="prof" class="muted">On-demand wall-clock stack
+    sampling of the control plane (collapsed-stack format — paste into
+    any flamegraph renderer).</div>`;
+}
+async function profile(){
+  const el=document.getElementById("prof");
+  el.textContent="sampling…";
+  const s=document.getElementById("psec").value||"2";
+  const d=await j("/api/profile?seconds="+s);
+  const rows=Object.entries(d.stacks||{}).sort((a,b)=>b[1]-a[1]);
+  let t=`<p>${rows.length} distinct stacks, `+
+    `${d.samples||""} samples</p><pre>`;
+  for(const[st,n]of rows.slice(0,40))t+=`${n}\t${h(st)}\n`;
+  el.innerHTML=t+"</pre>";
+}
+
+const VIEWS={overview:viewOverview,tasks:viewTasks,actors:viewActors,
+  objects:viewObjects,workers:viewWorkers,metrics:viewMetrics,
+  profile:viewProfile};
+function render(){
+  document.getElementById("nav").innerHTML=TABS.map(t=>
+    `<button class="${t==tab?"on":""}"
+      onclick="go('${t}')">${t}</button>`).join("");
+  document.getElementById("view").innerHTML=VIEWS[tab]();
+  document.getElementById("clock").textContent=
+    new Date().toLocaleTimeString();
+}
+function go(t){tab=t;location.hash=t;load();}
+function refresh(){load();}
+setInterval(()=>{if(document.getElementById("auto").checked &&
+  tab!="profile")load();},2000);
+load();
+</script></body></html>"""

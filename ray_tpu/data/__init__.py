@@ -8,14 +8,17 @@ from .iterator import DataIterator  # noqa: F401
 from . import preprocessors  # noqa: F401
 from .read_api import (  # noqa: F401
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
     range,
+    read_bigquery,
     read_binary_files,
     read_csv,
     read_images,
     read_json,
+    read_mongo,
     read_numpy,
     read_parquet,
     read_sql,

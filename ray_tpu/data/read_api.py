@@ -273,3 +273,110 @@ def read_images(paths, *, size: Optional[tuple] = None,
         return read
 
     return Dataset([make(p) for p in files])
+
+
+def from_huggingface(hf_dataset, *, override_num_blocks: int = 8
+                     ) -> Dataset:
+    """A HuggingFace ``datasets.Dataset`` (or DatasetDict split) as a
+    Dataset (ref analogue: ray.data.from_huggingface /
+    huggingface_datasource.py). HF datasets are arrow-backed, so blocks
+    are zero-copy slices of the underlying table."""
+    import datasets as hf
+
+    if isinstance(hf_dataset, hf.DatasetDict):
+        raise ValueError(
+            "pass one split, e.g. from_huggingface(ds['train']) "
+            f"(got DatasetDict with splits {list(hf_dataset)})"
+        )
+    table = hf_dataset.data.table if hasattr(
+        hf_dataset.data, "table") else hf_dataset.data
+    table = table.combine_chunks()
+    n = len(table)
+    nb = min(max(1, override_num_blocks), max(1, n))
+    bounds = [n * i // nb for i in builtins.range(nb + 1)]
+    return Dataset([
+        (lambda lo=lo, hi=hi: table.slice(lo, hi - lo))
+        for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo
+    ])
+
+
+def read_bigquery(query: str = None, *, project_id: str = None,
+                  dataset: str = None,
+                  queries: Optional[List[str]] = None,
+                  client_factory=None) -> Dataset:
+    """Read BigQuery into a Dataset (ref analogue: ray.data.read_bigquery
+    / bigquery_datasource.py). ``client_factory`` defaults to
+    ``google.cloud.bigquery.Client(project=project_id)``; inject a fake
+    for tests/offline use. Results arrive as arrow via to_arrow().
+
+    One ``query`` (or ``dataset`` table) = one block. For PARALLEL reads
+    pass ``queries=[...]`` — explicit disjoint shard queries (e.g.
+    partition-date predicates), one block each. Row-offset slicing of a
+    repeated query is deliberately NOT offered: BigQuery result order is
+    unspecified without ORDER BY, so offset shards of independent query
+    jobs can silently overlap or drop rows (and bill N times)."""
+    specs = list(queries or [])
+    if query is not None:
+        specs.insert(0, query)
+    if dataset is not None:
+        specs.insert(0, f"SELECT * FROM `{dataset}`")
+    if not specs:
+        raise ValueError("read_bigquery needs query=, dataset= or queries=")
+
+    def make(sql):
+        def read():
+            if client_factory is not None:
+                client = client_factory()
+            else:
+                from google.cloud import bigquery
+
+                client = bigquery.Client(project=project_id)
+            return client.query(sql).to_arrow()
+
+        return read
+
+    return Dataset([make(s) for s in specs])
+
+
+def read_mongo(uri: str = None, *, database: str, collection: str,
+               query: Optional[Dict[str, Any]] = None,
+               client_factory=None,
+               override_num_blocks: int = 1) -> Dataset:
+    """Read a MongoDB collection into a Dataset (ref analogue:
+    ray.data.read_mongo / mongo_datasource.py). ``client_factory``
+    defaults to ``pymongo.MongoClient(uri)`` (pymongo is an optional
+    dependency); inject a fake for tests/offline use. Shards split by
+    server-side skip/limit over the stably _id-ordered cursor — each
+    shard transfers only ITS contiguous window, and the count query runs
+    once per shard (cheap; index-only)."""
+
+    def make(shard, nshards):
+        def read():
+            if client_factory is not None:
+                client = client_factory()
+            else:
+                try:
+                    import pymongo
+                except ImportError as e:
+                    raise ImportError(
+                        "read_mongo requires the 'pymongo' package "
+                        "(or pass client_factory=)"
+                    ) from e
+                client = pymongo.MongoClient(uri)
+            coll = client[database][collection]
+            q = query or {}
+            cursor = coll.find(q).sort("_id", 1)
+            if nshards > 1:
+                total = coll.count_documents(q)
+                lo = total * shard // nshards
+                hi = total * (shard + 1) // nshards
+                cursor = cursor.skip(lo).limit(hi - lo)
+            docs = list(cursor)
+            for d in docs:
+                d.pop("_id", None)  # ObjectId is not arrow-able
+            return from_rows(docs)
+
+        return read
+
+    n = max(1, int(override_num_blocks))
+    return Dataset([make(i, n) for i in builtins.range(n)])

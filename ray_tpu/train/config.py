@@ -56,6 +56,12 @@ class RunConfig:
     failure_config: FailureConfig = dataclasses.field(
         default_factory=FailureConfig
     )
+    # Tune surface (ref: air RunConfig callbacks/stop): lifecycle hooks
+    # (tune/callback.py — loggers are callbacks) and a declarative stop
+    # condition (tune/stoppers.py — a Stopper, a callable, or a
+    # {metric: threshold} dict).
+    callbacks: Optional[list] = None
+    stop: Any = None
 
 
 @dataclasses.dataclass

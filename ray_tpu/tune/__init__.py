@@ -10,11 +10,18 @@ from ..train.session import (  # noqa: F401  (tune.* == train.* session API)
     get_checkpoint,
     report,
 )
+from .callback import Callback  # noqa: F401
+from .loggers import (  # noqa: F401
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TBXLoggerCallback,
+)
 from .schedulers import (  # noqa: F401
     ASHAScheduler,
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -22,7 +29,17 @@ from .search import (  # noqa: F401
     BasicVariantGenerator,
     BayesOptSearch,
     ConcurrencyLimiter,
+    HyperOptSearch,
+    OptunaSearch,
     Searcher,
+)
+from .stoppers import (  # noqa: F401
+    CombinedStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    Stopper,
+    TimeoutStopper,
+    TrialPlateauStopper,
 )
 from .search_space import (  # noqa: F401
     choice,

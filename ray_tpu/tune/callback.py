@@ -1,0 +1,79 @@
+"""Tune callback hook system.
+
+Ref analogue: python/ray/tune/callback.py Callback (:72) — user hooks
+invoked by the trial controller at experiment/trial lifecycle points.
+Attach via ``RunConfig(callbacks=[...])``; loggers (tune/loggers.py) are
+callbacks too.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class Callback:
+    """Subclass and override the hooks you need. Exceptions raised by a
+    callback are logged and swallowed — observability must never kill
+    the experiment."""
+
+    def setup(self, storage_path: str) -> None:
+        """Once, before any trial starts."""
+
+    def on_trial_start(self, trial_id: str,
+                       config: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_result(self, trial_id: str, config: Dict[str, Any],
+                        result: Dict[str, Any]) -> None:
+        pass
+
+    def on_checkpoint(self, trial_id: str, checkpoint_path: str) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]],
+                          error: Optional[str] = None) -> None:
+        pass
+
+    def on_experiment_end(self, results: List[Any]) -> None:
+        pass
+
+
+class CallbackList:
+    """Fan-out wrapper the Tuner drives; isolates callback failures."""
+
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self._callbacks = list(callbacks or [])
+
+    def __bool__(self):
+        return bool(self._callbacks)
+
+    def _fire(self, hook: str, *args) -> None:
+        import sys
+
+        for cb in self._callbacks:
+            try:
+                getattr(cb, hook)(*args)
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(
+                    f"[tune] callback {type(cb).__name__}.{hook} "
+                    f"raised: {e!r}\n"
+                )
+
+    def setup(self, storage_path):
+        self._fire("setup", storage_path)
+
+    def on_trial_start(self, trial_id, config):
+        self._fire("on_trial_start", trial_id, config)
+
+    def on_trial_result(self, trial_id, config, result):
+        self._fire("on_trial_result", trial_id, config, result)
+
+    def on_checkpoint(self, trial_id, checkpoint_path):
+        self._fire("on_checkpoint", trial_id, checkpoint_path)
+
+    def on_trial_complete(self, trial_id, result, error=None):
+        self._fire("on_trial_complete", trial_id, result, error)
+
+    def on_experiment_end(self, results):
+        self._fire("on_experiment_end", results)

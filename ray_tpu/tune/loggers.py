@@ -1,0 +1,133 @@
+"""Logger callbacks: per-trial CSV / JSONL / TensorBoard output.
+
+Ref analogue: python/ray/tune/logger/ (csv.py CSVLoggerCallback, json.py
+JsonLoggerCallback, tensorboardx.py TBXLoggerCallback). Each trial gets
+``<storage>/<trial_id>/`` with progress.csv, result.json and (with
+tensorboardX installed — it is a baked dependency here) tfevents files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from .callback import Callback
+
+
+def _scrub(v):
+    """JSON/CSV-able scalar (numpy/jax values appear in metrics)."""
+    if hasattr(v, "item"):
+        try:
+            return v.item()
+        except Exception:
+            return repr(v)
+    if isinstance(v, (int, float, str, bool)) or v is None:
+        return v
+    return repr(v)
+
+
+class CSVLoggerCallback(Callback):
+    """progress.csv per trial, one row per reported result; the header
+    is the union of keys seen FIRST — later new keys are ignored (the
+    reference's behavior)."""
+
+    def __init__(self):
+        self._files: Dict[str, Any] = {}
+        self._headers: Dict[str, list] = {}
+        self._storage = ""
+
+    def setup(self, storage_path: str) -> None:
+        self._storage = storage_path
+
+    def on_trial_result(self, trial_id, config, result) -> None:
+        import csv
+
+        f = self._files.get(trial_id)
+        if f is None:
+            d = os.path.join(self._storage, trial_id)
+            os.makedirs(d, exist_ok=True)
+            f = open(os.path.join(d, "progress.csv"), "a", newline="")
+            self._files[trial_id] = f
+            self._headers[trial_id] = sorted(result)
+            csv.writer(f).writerow(self._headers[trial_id])
+        row = [_scrub(result.get(k)) for k in self._headers[trial_id]]
+        csv.writer(f).writerow(row)
+        f.flush()
+
+    def on_trial_complete(self, trial_id, result, error=None) -> None:
+        f = self._files.pop(trial_id, None)
+        if f is not None:
+            f.close()
+
+    def on_experiment_end(self, results) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+
+class JsonLoggerCallback(Callback):
+    """result.json per trial: one JSON object per line per result, plus
+    params.json with the trial's config."""
+
+    def __init__(self):
+        self._storage = ""
+        self._seen: set = set()
+
+    def setup(self, storage_path: str) -> None:
+        self._storage = storage_path
+
+    def _dir(self, trial_id: str) -> str:
+        d = os.path.join(self._storage, trial_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def on_trial_start(self, trial_id, config) -> None:
+        with open(os.path.join(self._dir(trial_id), "params.json"),
+                  "w") as f:
+            json.dump({k: _scrub(v) for k, v in config.items()}, f)
+
+    def on_trial_result(self, trial_id, config, result) -> None:
+        with open(os.path.join(self._dir(trial_id), "result.json"),
+                  "a") as f:
+            f.write(json.dumps(
+                {k: _scrub(v) for k, v in result.items()}
+            ) + "\n")
+
+
+class TBXLoggerCallback(Callback):
+    """TensorBoard scalars via tensorboardX, one SummaryWriter per
+    trial; the step axis is ``training_iteration``."""
+
+    def __init__(self):
+        self._writers: Dict[str, Any] = {}
+        self._storage = ""
+
+    def setup(self, storage_path: str) -> None:
+        self._storage = storage_path
+
+    def on_trial_result(self, trial_id, config, result) -> None:
+        from tensorboardX import SummaryWriter
+
+        w = self._writers.get(trial_id)
+        if w is None:
+            w = SummaryWriter(
+                logdir=os.path.join(self._storage, trial_id)
+            )
+            self._writers[trial_id] = w
+        step = int(result.get("training_iteration", 0))
+        for k, v in result.items():
+            v = _scrub(v)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                w.add_scalar(k, v, global_step=step)
+        w.flush()
+
+    def on_trial_complete(self, trial_id, result, error=None) -> None:
+        w = self._writers.pop(trial_id, None)
+        if w is not None:
+            w.close()
+
+    def on_experiment_end(self, results) -> None:
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()

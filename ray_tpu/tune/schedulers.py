@@ -284,3 +284,109 @@ class PopulationBasedTraining(TrialScheduler):
 
     def on_trial_complete(self, trial_id: str, result: Optional[Dict]):
         self._scores.pop(trial_id, None)
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (ref: tune/schedulers/pb2.py PB2 —
+    Parker-Holder 2020): PBT where EXPLORE is not random perturbation
+    but a GP-bandit suggestion. A Gaussian process is fit over
+    (time, hyperparameters) -> reward improvement across the whole
+    population's history, and the exploited trial's new hyperparameters
+    maximize the GP's UCB within the declared bounds. Continuous
+    hyperparameters only: ``hyperparam_bounds={key: (low, high)}``."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict[str, tuple]] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 2.0,
+                 candidates: int = 128,
+                 seed: int = 0):
+        bounds = hyperparam_bounds or {}
+        # PBT's mutation surface doubles as the resample fallback while
+        # the GP has too little data.
+        super().__init__(
+            metric, mode, time_attr, perturbation_interval,
+            hyperparam_mutations={
+                k: (lambda lo=lo, hi=hi: self._rng.uniform(lo, hi))
+                for k, (lo, hi) in bounds.items()
+            },
+            quantile_fraction=quantile_fraction, seed=seed,
+        )
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in bounds.items()}
+        self.kappa = ucb_kappa
+        self.candidates = candidates
+        # Population history for the GP: rows of
+        # (t, hp..., reward_delta) accumulated from every report.
+        self._gp_rows: List[tuple] = []
+        self._last_val: Dict[str, float] = {}
+        self._last_t: Dict[str, float] = {}
+
+    def on_trial_start(self, trial_id: str, config: Dict[str, Any]):
+        super().on_trial_start(trial_id, config)
+        # A (re)launched trial resumes from a DONOR's checkpoint: its
+        # first post-restart delta would span the jump to the donor's
+        # trajectory and be credited to the fresh hyperparameters,
+        # poisoning the GP — restart the delta bookkeeping instead.
+        self._last_val.pop(trial_id, None)
+        self._last_t.pop(trial_id, None)
+
+    def on_result(self, trial_id: str, result: Dict):
+        t = result.get(self.time_attr, 0)
+        val = result.get(self.metric)
+        if val is not None:
+            v = float(val) if self.mode == "max" else -float(val)
+            prev = self._last_val.get(trial_id)
+            if prev is not None and trial_id in self._configs:
+                cfg = self._configs[trial_id]
+                hp = [float(cfg.get(k, 0.0)) for k in self.bounds]
+                self._gp_rows.append(
+                    (float(self._last_t.get(trial_id, t)), *hp, v - prev)
+                )
+                if len(self._gp_rows) > 512:
+                    self._gp_rows = self._gp_rows[-512:]
+            self._last_val[trial_id] = v
+            self._last_t[trial_id] = float(t)
+        return super().on_result(trial_id, result)
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """GP-UCB over the population's reward-improvement history; PBT
+        perturbation until the GP has enough rows."""
+        if len(self._gp_rows) < 8 or not self.bounds:
+            return super()._explore(config)
+        import numpy as np
+
+        try:
+            from sklearn.gaussian_process import GaussianProcessRegressor
+            from sklearn.gaussian_process.kernels import Matern
+        except Exception:  # pragma: no cover - sklearn is baked in
+            return super()._explore(config)
+        rows = np.asarray(self._gp_rows, dtype=np.float64)
+        X, y = rows[:, :-1], rows[:, -1]
+        # Normalize inputs to the unit box (t by its observed range).
+        keys = list(self.bounds)
+        lo = np.asarray([X[:, 0].min()] + [self.bounds[k][0] for k in keys])
+        hi = np.asarray([max(X[:, 0].max(), lo[0] + 1e-9)]
+                        + [self.bounds[k][1] for k in keys])
+        Xn = (X - lo) / np.maximum(hi - lo, 1e-9)
+        gp = GaussianProcessRegressor(
+            kernel=Matern(nu=2.5), alpha=1e-4, normalize_y=True,
+            random_state=self._np_rng,
+        )
+        gp.fit(Xn, y)
+        t_now = (max(self._last_t.values()) - lo[0]) / max(
+            hi[0] - lo[0], 1e-9
+        )
+        cand = self._np_rng.rand(self.candidates, len(keys))
+        Xc = np.concatenate(
+            [np.full((self.candidates, 1), t_now), cand], axis=1
+        )
+        mu, sigma = gp.predict(Xc, return_std=True)
+        best = int(np.argmax(mu + self.kappa * sigma))
+        out = dict(config)
+        for i, k in enumerate(keys):
+            blo, bhi = self.bounds[k]
+            out[k] = float(blo + cand[best, i] * (bhi - blo))
+        return out

@@ -204,3 +204,151 @@ class ConcurrencyLimiter(Searcher):
                           error: bool = False) -> None:
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class OptunaSearch(Searcher):
+    """Adapter running an Optuna study as the search algorithm (ref:
+    tune/search/optuna/optuna_search.py). Requires the ``optuna``
+    package (not bundled); construction raises a clear error without
+    it. The space is this module's Domain dict — translated to optuna
+    distributions per suggest()."""
+
+    def __init__(self, space: Dict[str, Any], *, metric: str = None,
+                 mode: str = "max", seed: int = 0):
+        try:
+            import optuna
+        except ImportError as e:  # pragma: no cover - optional dep
+            raise ImportError(
+                "OptunaSearch requires the 'optuna' package "
+                "(pip install optuna)"
+            ) from e
+        super().__init__(metric, mode)
+        self._space = space
+        self._study = optuna.create_study(
+            direction="maximize" if mode == "max" else "minimize",
+            sampler=optuna.samplers.TPESampler(seed=seed),
+        )
+        self._trials: Dict[str, Any] = {}
+
+    def _suggest_from_domain(self, ot_trial, key, dom):
+        from .search_space import Choice, LogUniform, RandInt, Uniform
+
+        if isinstance(dom, Uniform):
+            return ot_trial.suggest_float(key, dom.low, dom.high)
+        if isinstance(dom, LogUniform):
+            return ot_trial.suggest_float(key, dom.low, dom.high,
+                                          log=True)
+        if isinstance(dom, RandInt):
+            return ot_trial.suggest_int(key, dom.low, dom.high - 1)
+        if isinstance(dom, Choice):
+            return ot_trial.suggest_categorical(key, list(dom.categories))
+        from .search_space import Domain
+
+        if isinstance(dom, Domain):
+            raise TypeError(
+                f"OptunaSearch does not support {type(dom).__name__} "
+                f"for {key!r} (use uniform/loguniform/randint/choice)"
+            )
+        return dom  # plain constant
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        ot_trial = self._study.ask()
+        self._trials[trial_id] = ot_trial
+        return {
+            k: self._suggest_from_domain(ot_trial, k, dom)
+            for k, dom in self._space.items()
+        }
+
+    def on_trial_complete(self, trial_id: str, result=None, error=False):
+        import optuna
+
+        ot_trial = self._trials.pop(trial_id, None)
+        if ot_trial is None:
+            return
+        if error or not result or self.metric not in result:
+            self._study.tell(
+                ot_trial, state=optuna.trial.TrialState.FAIL
+            )
+            return
+        self._study.tell(ot_trial, float(result[self.metric]))
+
+
+class HyperOptSearch(Searcher):
+    """Adapter over hyperopt's TPE (ref:
+    tune/search/hyperopt/hyperopt_search.py). Requires the
+    ``hyperopt`` package (not bundled)."""
+
+    def __init__(self, space: Dict[str, Any], *, metric: str = None,
+                 mode: str = "max", seed: int = 0):
+        try:
+            import hyperopt  # noqa: F401
+        except ImportError as e:  # pragma: no cover - optional dep
+            raise ImportError(
+                "HyperOptSearch requires the 'hyperopt' package "
+                "(pip install hyperopt)"
+            ) from e
+        import numpy as np
+        from hyperopt import hp
+
+        from .search_space import Choice, LogUniform, RandInt, Uniform
+
+        super().__init__(metric, mode)
+        self._hp_space = {}
+        for k, dom in space.items():
+            if isinstance(dom, Uniform):
+                self._hp_space[k] = hp.uniform(k, dom.low, dom.high)
+            elif isinstance(dom, LogUniform):
+                self._hp_space[k] = hp.loguniform(
+                    k, np.log(dom.low), np.log(dom.high)
+                )
+            elif isinstance(dom, RandInt):
+                self._hp_space[k] = hp.randint(k, dom.low, dom.high)
+            elif isinstance(dom, Choice):
+                self._hp_space[k] = hp.choice(k, list(dom.categories))
+            else:
+                from .search_space import Domain
+
+                if isinstance(dom, Domain):
+                    raise TypeError(
+                        f"HyperOptSearch does not support "
+                        f"{type(dom).__name__} for {k!r} (use uniform/"
+                        f"loguniform/randint/choice)"
+                    )
+                self._hp_space[k] = dom
+        from hyperopt import Trials
+
+        self._ho_trials = Trials()
+        self._rng = np.random.default_rng(seed)
+        self._by_id: Dict[str, int] = {}
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        import hyperopt
+        from hyperopt import tpe
+
+        n = len(self._ho_trials.trials)
+        new = tpe.suggest(
+            [n], hyperopt.Domain(lambda spc: 0, self._hp_space),
+            self._ho_trials,
+            self._rng.integers(2 ** 31),
+        )
+        self._ho_trials.insert_trial_docs(new)
+        self._ho_trials.refresh()
+        self._by_id[trial_id] = n
+        vals = {k: v[0] for k, v in new[0]["misc"]["vals"].items() if v}
+        from hyperopt import space_eval
+
+        return space_eval(self._hp_space, vals)
+
+    def on_trial_complete(self, trial_id: str, result=None, error=False):
+        idx = self._by_id.pop(trial_id, None)
+        if idx is None:
+            return
+        trial = self._ho_trials.trials[idx]
+        if error or not result or self.metric not in result:
+            trial["state"] = 3  # JOB_STATE_ERROR
+        else:
+            val = float(result[self.metric])
+            loss = -val if self.mode == "max" else val
+            trial["result"] = {"loss": loss, "status": "ok"}
+            trial["state"] = 2  # JOB_STATE_DONE
+        self._ho_trials.refresh()

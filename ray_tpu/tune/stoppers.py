@@ -1,0 +1,136 @@
+"""Stoppers: declarative stop conditions evaluated on every result.
+
+Ref analogue: python/ray/tune/stopper/ (maximum_iteration.py,
+timeout.py, experiment_plateau.py, function_stopper.py, stopper.py
+CombinedStopper). Attach via ``RunConfig(stop=...)`` — a Stopper, a
+callable ``(trial_id, result) -> bool``, or a dict of
+``{metric: threshold}`` (stop when every metric reaches its threshold,
+the reference's dict form).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+class Stopper:
+    def __call__(self, trial_id: str, result: Dict[str, Any]) -> bool:
+        """True = stop THIS trial."""
+        raise NotImplementedError
+
+    def stop_all(self) -> bool:
+        """True = stop the WHOLE experiment."""
+        return False
+
+
+class MaximumIterationStopper(Stopper):
+    """Stop each trial after ``max_iter`` reported results (ref:
+    maximum_iteration.py)."""
+
+    def __init__(self, max_iter: int):
+        self._max = max_iter
+
+    def __call__(self, trial_id, result):
+        return result.get("training_iteration", 0) >= self._max
+
+
+class TimeoutStopper(Stopper):
+    """Stop the whole experiment after a wall-clock budget (ref:
+    timeout.py — the budget starts at first use)."""
+
+    def __init__(self, timeout_s: float):
+        self._timeout = timeout_s
+        self._t0: Optional[float] = None
+
+    def __call__(self, trial_id, result):
+        if self._t0 is None:
+            self._t0 = time.monotonic()
+        return False
+
+    def stop_all(self):
+        if self._t0 is None:
+            self._t0 = time.monotonic()
+        return time.monotonic() - self._t0 >= self._timeout
+
+
+class TrialPlateauStopper(Stopper):
+    """Stop a trial whose metric stopped moving: the std of the last
+    ``num_results`` values sits below ``std`` (ref:
+    experiment_plateau.py TrialPlateauStopper)."""
+
+    def __init__(self, metric: str, *, std: float = 0.01,
+                 num_results: int = 4, grace_period: int = 4):
+        self._metric = metric
+        self._std = std
+        self._num = num_results
+        self._grace = grace_period
+        self._window: Dict[str, collections.deque] = {}
+        self._count: Dict[str, int] = {}
+
+    def __call__(self, trial_id, result):
+        v = result.get(self._metric)
+        if v is None:
+            return False
+        w = self._window.setdefault(
+            trial_id, collections.deque(maxlen=self._num)
+        )
+        w.append(float(v))
+        self._count[trial_id] = self._count.get(trial_id, 0) + 1
+        if self._count[trial_id] < self._grace or len(w) < self._num:
+            return False
+        mean = sum(w) / len(w)
+        var = sum((x - mean) ** 2 for x in w) / len(w)
+        return var ** 0.5 <= self._std
+
+
+class FunctionStopper(Stopper):
+    """Wrap a plain ``(trial_id, result) -> bool`` (ref:
+    function_stopper.py)."""
+
+    def __init__(self, fn: Callable[[str, Dict[str, Any]], bool]):
+        self._fn = fn
+
+    def __call__(self, trial_id, result):
+        return bool(self._fn(trial_id, result))
+
+
+class CombinedStopper(Stopper):
+    """OR of several stoppers (ref: stopper.py CombinedStopper)."""
+
+    def __init__(self, *stoppers: Stopper):
+        self._stoppers = stoppers
+
+    def __call__(self, trial_id, result):
+        return any(s(trial_id, result) for s in self._stoppers)
+
+    def stop_all(self):
+        return any(s.stop_all() for s in self._stoppers)
+
+
+class _DictStopper(Stopper):
+    """{metric: threshold}: stop a trial when ANY metric present in the
+    dict reaches its threshold — whichever comes first, matching the
+    reference's dict form (Trial.should_stop; thresholds are >=
+    comparisons)."""
+
+    def __init__(self, spec: Dict[str, float]):
+        self._spec = dict(spec)
+
+    def __call__(self, trial_id, result):
+        return any(
+            m in result and result[m] >= v
+            for m, v in self._spec.items()
+        )
+
+
+def coerce_stopper(stop) -> Optional[Stopper]:
+    """RunConfig(stop=...) accepts a Stopper, a callable, or a dict."""
+    if stop is None or isinstance(stop, Stopper):
+        return stop
+    if isinstance(stop, dict):
+        return _DictStopper(stop)
+    if callable(stop):
+        return FunctionStopper(stop)
+    raise TypeError(f"unsupported stop condition: {type(stop).__name__}")

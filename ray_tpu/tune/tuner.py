@@ -286,12 +286,18 @@ class Tuner:
     def fit(self) -> ResultGrid:
         import ray_tpu
         from ..core.runtime_context import current_runtime
+        from .callback import CallbackList
+        from .stoppers import coerce_stopper
 
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
         storage = self.run_config.storage_path or default_storage_path(
             self.run_config.name
         )
+        callbacks = CallbackList(self.run_config.callbacks)
+        callbacks.setup(storage)
+        stopper = coerce_stopper(self.run_config.stop)
+        stop_everything = {"flag": False}
         search_alg = tc.search_alg
         restored = getattr(self, "_restored_trials", None)
         if search_alg is not None:
@@ -326,6 +332,7 @@ class Tuner:
             trial.state = "running"
             trial.next_seq = 0
             scheduler.on_trial_start(trial.trial_id, trial.config)
+            callbacks.on_trial_start(trial.trial_id, trial.config)
 
         def relaunch_exploit(trial: _Trial, decision: Exploit,
                              donors: Dict[str, _Trial]):
@@ -360,6 +367,24 @@ class Tuner:
                 trial.history.append(metrics)
                 if payload.get("checkpoint_path"):
                     trial.last_checkpoint = payload["checkpoint_path"]
+                    callbacks.on_checkpoint(
+                        trial.trial_id, payload["checkpoint_path"]
+                    )
+                callbacks.on_trial_result(
+                    trial.trial_id, trial.config, metrics
+                )
+                if trial.state == "running" and stopper is not None:
+                    # Declarative stop conditions evaluate BEFORE the
+                    # scheduler (ref: the controller's stopper check).
+                    if stopper(trial.trial_id, metrics):
+                        trial.state = "stopped"
+                        try:
+                            ray_tpu.kill(trial.actor)
+                        except Exception:
+                            pass
+                        return  # results past the stop are dropped
+                    if stopper.stop_all():
+                        stop_everything["flag"] = True
                 if trial.state == "running":
                     decision = scheduler.on_result(trial.trial_id, metrics)
                     if decision == STOP:
@@ -395,6 +420,10 @@ class Tuner:
 
         while (pending or running
                or (search_alg is not None and suggested < tc.num_samples)):
+            if stopper is not None and stopper.stop_all():
+                # Wall-clock style stoppers must fire even while trials
+                # are hung or between reports.
+                stop_everything["flag"] = True
             spawn_from_searcher()
             while pending and len(running) < max_conc:
                 t = pending.pop(0)
@@ -410,6 +439,9 @@ class Tuner:
                 drain(t)
                 if t.state == "stopped":
                     scheduler.on_trial_complete(
+                        t.trial_id, t.history[-1] if t.history else None
+                    )
+                    callbacks.on_trial_complete(
                         t.trial_id, t.history[-1] if t.history else None
                     )
                     if search_alg is not None:
@@ -432,6 +464,11 @@ class Tuner:
                             t.trial_id,
                             t.history[-1] if t.history else None,
                         )
+                        callbacks.on_trial_complete(
+                            t.trial_id,
+                            t.history[-1] if t.history else None,
+                            t.error,
+                        )
                         if search_alg is not None:
                             search_alg.on_trial_complete(
                                 t.trial_id,
@@ -445,6 +482,24 @@ class Tuner:
                 if t.state == "running":
                     still_running.append(t)
             running = still_running
+            if stop_everything["flag"]:
+                # Experiment-wide stop (e.g. TimeoutStopper): tear down
+                # every remaining trial cleanly.
+                for t in running:
+                    drain(t)
+                    t.state = "stopped"
+                    callbacks.on_trial_complete(
+                        t.trial_id, t.history[-1] if t.history else None
+                    )
+                    try:
+                        ray_tpu.kill(t.actor)
+                    except Exception:
+                        pass
+                for t in pending:
+                    t.state = "stopped"
+                running = []
+                pending = []
+                break
             now = time.monotonic()
             if now - last_save > 1.0:
                 self._save_state(storage, trials)
@@ -462,4 +517,6 @@ class Tuner:
             )
             for t in trials
         ]
-        return ResultGrid(results, tc.metric, tc.mode)
+        grid = ResultGrid(results, tc.metric, tc.mode)
+        callbacks.on_experiment_end(results)
+        return grid

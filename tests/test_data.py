@@ -514,3 +514,112 @@ def test_dlpack_alias_pins_and_values(ray_tpu_start):
         pin = getattr(base, "_rtpu_pin", None)
         base = getattr(base, "base", None)
     assert pin is v
+
+
+def test_from_huggingface():
+    """HF datasets are arrow-backed; from_huggingface slices the table
+    zero-copy into blocks (ref: ray.data.from_huggingface)."""
+    import datasets as hf
+
+    ds_hf = hf.Dataset.from_dict(
+        {"text": [f"doc-{i}" for i in range(20)],
+         "label": list(range(20))}
+    )
+    ds = rd.from_huggingface(ds_hf, override_num_blocks=4)
+    assert ds.num_blocks() == 4
+    assert ds.count() == 20
+    rows = ds.take_all()
+    assert rows[0]["text"] == "doc-0" and rows[19]["label"] == 19
+    # split selection guard
+    dd = hf.DatasetDict({"train": ds_hf})
+    with pytest.raises(ValueError, match="split"):
+        rd.from_huggingface(dd)
+
+
+def test_read_bigquery_fake_client():
+    """read_bigquery with an injected client (the real default is
+    google.cloud.bigquery.Client): arrow results shard into blocks."""
+    import pyarrow as pa
+
+    class FakeJob:
+        def __init__(self, sql):
+            self.sql = sql
+
+        def to_arrow(self):
+            return pa.table({"id": list(range(10)),
+                             "v": [i * 2 for i in range(10)]})
+
+    class FakeClient:
+        def query(self, sql):
+            assert "SELECT" in sql
+            return FakeJob(sql)
+
+    ds = rd.read_bigquery("SELECT id, v FROM t",
+                          client_factory=FakeClient)
+    rows = ds.take_all()
+    assert len(rows) == 10
+    assert sorted(r["id"] for r in rows) == list(range(10))
+    assert all(r["v"] == 2 * r["id"] for r in rows)
+    # dataset= form builds the full-table query
+    ds2 = rd.read_bigquery(dataset="proj.ds.table",
+                           client_factory=FakeClient)
+    assert ds2.count() == 10
+    # parallel reads = EXPLICIT disjoint shard queries, one block each
+    ds3 = rd.read_bigquery(
+        queries=["SELECT id, v FROM t WHERE id < 5",
+                 "SELECT id, v FROM t WHERE id >= 5"],
+        client_factory=FakeClient)
+    assert ds3.num_blocks() == 2 and ds3.count() == 20
+
+
+def test_read_mongo_fake_client():
+    """read_mongo with an injected client (pymongo optional): documents
+    shard stably and _id is dropped."""
+
+    class FakeCursor:
+        def __init__(self, docs):
+            self.docs = docs
+
+        def sort(self, key, direction):
+            return FakeCursor(sorted(self.docs, key=lambda d: d[key]))
+
+        def skip(self, n):
+            return FakeCursor(self.docs[n:])
+
+        def limit(self, n):
+            return FakeCursor(self.docs[:n])
+
+        def __iter__(self):
+            return iter(self.docs)
+
+    def _docs(q):
+        docs = [{"_id": i, "kind": "a" if i % 2 else "b", "n": i}
+                for i in range(8)]
+        if q:
+            docs = [d for d in docs if d["kind"] == q["kind"]]
+        return docs
+
+    class FakeColl:
+        def find(self, q):
+            assert q == {} or q == {"kind": "a"}
+            return FakeCursor(_docs(q))
+
+        def count_documents(self, q):
+            return len(_docs(q))
+
+    class FakeClient(dict):
+        def __init__(self):
+            super().__init__(db={"coll": FakeColl()})
+
+        def __getitem__(self, k):
+            return {"coll": FakeColl()}
+
+    ds = rd.read_mongo(database="db", collection="coll",
+                       client_factory=FakeClient,
+                       override_num_blocks=2)
+    rows = ds.take_all()
+    assert len(rows) == 8 and all("_id" not in r for r in rows)
+    ds2 = rd.read_mongo(database="db", collection="coll",
+                        query={"kind": "a"},
+                        client_factory=FakeClient)
+    assert ds2.count() == 4

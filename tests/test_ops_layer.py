@@ -201,7 +201,7 @@ def test_dashboard_serves_state(ray_tpu_start):
         assert summary.get("alive", 0) >= 1
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/", timeout=30) as r:
-            assert b"ray_tpu cluster" in r.read()
+            assert b"ray_tpu" in r.read()
     finally:
         dashboard.stop_dashboard()
 
@@ -363,3 +363,27 @@ def test_profile_endpoint(ray_tpu_start):
                    for k in prof["stacks"])
     finally:
         dashboard.stop_dashboard()
+
+
+def test_dashboard_spa_ui(ray_tpu_start):
+    """The single-page UI serves at / (tabs over the /api surface; ref
+    analogue: dashboard/client/src/), the legacy page stays at /simple,
+    and the nodes API carries the Available resources the overview's
+    usage bars read."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu import dashboard
+
+    port = dashboard.start_dashboard(port=0)
+    page = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/", timeout=30).read().decode()
+    for marker in ("viewOverview", "viewTasks", "viewActors",
+                   "viewMetrics", "/api/profile"):
+        assert marker in page
+    simple = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/simple", timeout=30).read().decode()
+    assert "<html" in simple
+    nodes = _json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/api/nodes", timeout=30).read())
+    assert nodes and "Available" in nodes[0] and "Resources" in nodes[0]
