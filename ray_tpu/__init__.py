@@ -46,6 +46,7 @@ from .core import (  # noqa: F401
     remote,
     shutdown,
     timeline,
+    timeline_otlp,
     wait,
 )
 from .core import (  # noqa: F401
@@ -73,6 +74,7 @@ __all__ = [
     "available_resources",
     "nodes",
     "timeline",
+    "timeline_otlp",
     "kv_put",
     "kv_get",
     "ObjectRef",

@@ -37,5 +37,5 @@ from .scheduling_strategies import (  # noqa: F401
 )
 from .spmd import SpmdActorGroup, SpmdGroupError  # noqa: F401
 from .streaming import ObjectRefGenerator  # noqa: F401
-from .timeline import timeline  # noqa: F401
+from .timeline import timeline, timeline_otlp  # noqa: F401
 from . import tpu  # noqa: F401

@@ -169,3 +169,83 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+# ------------------------------------------------------------- OTLP export
+
+def _otlp_id(raw: str, nbytes: int) -> str:
+    """OTLP span/trace ids are fixed-width lowercase hex (16B trace /
+    8B span); our ids are hex-ish strings of framework origin — hash
+    down/pad deterministically so parent links stay consistent."""
+    import hashlib
+
+    if not raw:
+        return ""
+    h = hashlib.sha256(raw.encode()).hexdigest()
+    return h[: nbytes * 2]
+
+
+def timeline_otlp(endpoint: Optional[str] = None,
+                  filename: Optional[str] = None,
+                  service_name: str = "ray_tpu") -> Dict[str, Any]:
+    """Export every worker's task spans in the OpenTelemetry OTLP/JSON
+    wire format (ref analogue: the reference's opt-in OTel tracing via
+    tracing_helper.py:326 — here the span tree recorded in the task
+    specs exports on demand, dependency-free). Returns the OTLP
+    payload; optionally writes it to ``filename`` and/or POSTs it to an
+    OTLP/HTTP collector ``endpoint`` (".../v1/traces")."""
+    spans = []
+    for ev in timeline():
+        args = ev.get("args", {})
+        trace_id = _otlp_id(args.get("trace_id", ""), 16)
+        span_id = _otlp_id(args.get("span_id", "")
+                           or args.get("task_id", ""), 8)
+        if not trace_id or not span_id:
+            continue
+        start_ns = int(ev["ts"] * 1e3)   # chrome ts is in us
+        end_ns = int((ev["ts"] + ev["dur"]) * 1e3)
+        span = {
+            "traceId": trace_id,
+            "spanId": span_id,
+            "name": ev["name"],
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": [
+                {"key": "ray_tpu.task_id", "value": {
+                    "stringValue": args.get("task_id", "")}},
+                {"key": "ray_tpu.node", "value": {
+                    "stringValue": str(ev.get("pid", ""))}},
+                {"key": "ray_tpu.worker", "value": {
+                    "stringValue": str(ev.get("tid", ""))}},
+            ],
+        }
+        parent = _otlp_id(args.get("parent_id", ""), 8)
+        if parent:
+            span["parentSpanId"] = parent
+        spans.append(span)
+    payload = {
+        "resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": service_name}},
+            ]},
+            "scopeSpans": [{
+                "scope": {"name": "ray_tpu.timeline"},
+                "spans": spans,
+            }],
+        }]
+    }
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(payload, f)
+    if endpoint:
+        import urllib.request
+
+        req = urllib.request.Request(
+            endpoint, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()
+    return payload

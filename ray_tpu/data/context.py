@@ -22,6 +22,9 @@ class DataContext:
     # Prefetch depth for iter_batches / device feed.
     prefetch_batches: int = 2
     use_remote_tasks: bool = True
+    # Shuffle plan: None = auto (push-based merge stage at >=16 input
+    # blocks — ref: _internal/push_based_shuffle.py), True/False forces.
+    push_based_shuffle: "bool | None" = None
 
     _instance = None
 

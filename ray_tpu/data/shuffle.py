@@ -97,6 +97,22 @@ def _shuffle_reduce(postprocess, *blocks) -> Any:
     return block
 
 
+def _shuffle_merge(width: int, *round_parts) -> tuple:
+    """Push-based shuffle's MERGE stage (ref:
+    data/_internal/push_based_shuffle.py): combine one round's map
+    partials for a SLICE of ``width`` reducers into one merged block per
+    reducer. ``round_parts`` arrives flattened as width-sized groups,
+    one group per map task in the round."""
+    merged = []
+    for r in range(width):
+        merged.append(concat_blocks(
+            [round_parts[m * width + r]
+             for m in range(len(round_parts) // width)]
+        ))
+    out = tuple(merged)
+    return out if width > 1 else out[0]
+
+
 def _sample_block(src: Callable[[], Any], ops: List[Any], key: str,
                   max_samples: int) -> np.ndarray:
     block = src()
@@ -129,29 +145,106 @@ class _SortBlock:
 
 def shuffle(sources: Sequence[Callable[[], Any]], ops: List[Any],
             num_reducers: int, assigner: str, arg=None,
-            postprocess=None) -> Tuple[List[Any], List[Any]]:
-    """Two-stage shuffle. Returns (reduce_refs, pin) — ``pin`` holds the
-    intermediate partition refs and must stay referenced until the reduce
-    outputs are consumed (it keeps the distributed partitions alive)."""
+            postprocess=None,
+            push_based: Optional[bool] = None
+            ) -> Tuple[List[Any], List[Any]]:
+    """Distributed shuffle. Returns (reduce_refs, pin) — ``pin`` holds
+    the intermediate refs and must stay referenced until the reduce
+    outputs are consumed.
+
+    Two execution plans (ref: simple_shuffle vs the reference's
+    push_based_shuffle.py / Exoshuffle):
+
+    - SIMPLE (small M): M map tasks x R return slots feed R reduce
+      tasks directly; every reducer fans in M refs and all M x R
+      partials stay live until the last reducer ran.
+    - PUSH-BASED (default at M >= 16, or DataContext.push_based_shuffle
+      / the ``push_based`` arg): maps run in rounds of ~sqrt(M); each
+      round's partials MERGE immediately into per-reducer blocks (merge
+      tasks sliced over the reducer range, pipelining with the next
+      round's maps), so reducer fan-in drops from M to the round count
+      and a round's M x R map partials can be collected as soon as its
+      merges finish instead of living for the whole shuffle.
+    """
+    import math
+
     import ray_tpu
+
+    M = len(sources)
+    if push_based is None:
+        from .context import DataContext
+
+        ctx_flag = DataContext.get_current().push_based_shuffle
+        push_based = (M >= 16) if ctx_flag is None else ctx_flag
 
     map_task = ray_tpu.remote(_shuffle_map).options(
         num_returns=num_reducers
     )
     reduce_task = ray_tpu.remote(_shuffle_reduce)
 
-    part_lists: List[List[Any]] = []
-    for i, src in enumerate(sources):
+    def run_maps(idx_src):
+        i, src = idx_src
         refs = map_task.remote(
             src, ops, assigner, num_reducers,
             (arg ^ i if assigner == "random" else arg),
         )
-        part_lists.append(refs if isinstance(refs, list) else [refs])
+        return refs if isinstance(refs, list) else [refs]
+
+    if not push_based or M < 2:
+        part_lists = [run_maps(x) for x in enumerate(sources)]
+        reduce_refs = [
+            reduce_task.remote(postprocess, *[pl[r] for pl in part_lists])
+            for r in range(num_reducers)
+        ]
+        return reduce_refs, part_lists
+
+    R = num_reducers
+    round_size = max(2, int(math.ceil(math.sqrt(M))))
+    # Slice reducers among merge tasks so one merge's fan-in stays at
+    # round_size x slice_width refs.
+    slice_width = min(R, 8)
+    slices = [(lo, min(lo + slice_width, R))
+              for lo in range(0, R, slice_width)]
+    # merged[round][r] = merged block ref for reducer r in that round.
+    merged_rounds: List[List[Any]] = []
+    pin: List[Any] = []
+    for lo_m in range(0, M, round_size):
+        if len(merged_rounds) >= 2:
+            # THROTTLE: at most two rounds in flight (one merging while
+            # the next maps — the pipeline overlap) before submitting
+            # more, so peak live map partials stay ~2 rounds' worth
+            # instead of all M x R (the plan's whole point; ref: the
+            # reference gates rounds on merge completion too).
+            prev = merged_rounds[-2]
+            ray_tpu.wait(prev, num_returns=len(prev), timeout=None)
+        round_parts = [
+            run_maps((i, sources[i]))
+            for i in range(lo_m, min(lo_m + round_size, M))
+        ]
+        round_merged: List[Any] = [None] * R
+        for lo, hi in slices:
+            width = hi - lo
+            merge = ray_tpu.remote(_shuffle_merge).options(
+                num_returns=width
+            )
+            flat = [pl[r] for pl in round_parts
+                    for r in range(lo, hi)]
+            out = merge.remote(width, *flat)
+            out = out if isinstance(out, list) else [out]
+            for k, r in enumerate(range(lo, hi)):
+                round_merged[r] = out[k]
+        # The map partials are consumed by the merges; dropping our refs
+        # here lets each round's M x R partials be collected as soon as
+        # its merges finish (the merge task specs pin them until then).
+        merged_rounds.append(round_merged)
+        pin.extend(round_merged)
     reduce_refs = [
-        reduce_task.remote(postprocess, *[pl[r] for pl in part_lists])
-        for r in range(num_reducers)
+        reduce_task.remote(
+            postprocess, *[rnd[r] for rnd in merged_rounds]
+        )
+        for r in range(R)
     ]
-    return reduce_refs, part_lists
+    return reduce_refs, pin
 
 
 def sample_sort_boundaries(sources: Sequence[Callable[[], Any]],

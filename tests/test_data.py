@@ -623,3 +623,48 @@ def test_read_mongo_fake_client():
                         query={"kind": "a"},
                         client_factory=FakeClient)
     assert ds2.count() == 4
+
+
+def test_push_based_shuffle_parity(ray_tpu_start):
+    """Push-based shuffle (rounds of maps + merge stage) produces
+    byte-identical results to the simple plan for random_shuffle, sort
+    and repartition (ref: _internal/push_based_shuffle.py)."""
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    n = 200
+    base = rd.from_items(
+        [{"k": i % 7, "v": float(i)} for i in range(n)],
+        override_num_blocks=20,
+    )
+
+    def checksum(ds):
+        rows = ds.take_all()
+        return (sorted(round(r["v"], 6) for r in rows),
+                sorted(r["k"] for r in rows))
+
+    old = ctx.push_based_shuffle
+    try:
+        ctx.push_based_shuffle = False
+        simple_shuf = checksum(base.random_shuffle(seed=7))
+        simple_sorted = [r["v"] for r in base.sort("v").take_all()]
+        simple_rep = checksum(base.repartition(5))
+
+        ctx.push_based_shuffle = True
+        push_shuf = checksum(base.random_shuffle(seed=7))
+        push_sorted = [r["v"] for r in base.sort("v").take_all()]
+        push_rep = checksum(base.repartition(5))
+        push_group = base.groupby("k").map_groups(
+            lambda g: {"k": g["k"][:1], "s": [float(sum(g["v"]))]}
+        ).take_all()
+    finally:
+        ctx.push_based_shuffle = old
+
+    assert push_shuf == simple_shuf
+    assert push_sorted == simple_sorted == sorted(
+        float(i) for i in range(n)
+    )
+    assert push_rep == simple_rep
+    assert sum(r["s"] for r in push_group) == sum(
+        float(i) for i in range(n)
+    )
