@@ -7,6 +7,7 @@ from .policy import MLPPolicy  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
 from .bc import BC, BCConfig  # noqa: F401
+from .cql import CQL, CQLConfig  # noqa: F401
 from .multi_agent import (  # noqa: F401
     MultiAgentEnvRunner,
     MultiAgentPPO,

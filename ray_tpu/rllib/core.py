@@ -246,6 +246,120 @@ class Learner:
         }
 
 
+class TwinCriticLearner(Learner):
+    """Shared machinery for deterministic-actor twin-critic algorithms
+    (TD3, CQL): params {actor, q1, q2}; the critic step runs through
+    ``compute_loss`` with the actor subtree MASKED out of the optimizer
+    (Adam momentum on zero grads would still move frozen params), the
+    actor step maximizes Q1(s, pi(s)) with its OWN optimizer state and
+    polyak-syncs the actor target (its only sync point — critic targets
+    sync in the base update), and weight/state round-trips keep the
+    critics (get_weights returns the actor for rollout policies;
+    set_weights accepts actor-only or full trees)."""
+
+    def __init__(self, actor_params, *, obs_dim: int, act_dim: int,
+                 hidden: int, lr: float, tau: float, seed: int):
+        import jax
+        import optax
+
+        params = {
+            "actor": actor_params,
+            "q1": QModule(obs_dim, act_dim, hidden,
+                          seed + 1).init_params(),
+            "q2": QModule(obs_dim, act_dim, hidden,
+                          seed + 2).init_params(),
+        }
+        # Critic targets polyak in the base update; the ACTOR target is
+        # seeded below and synced ONLY by actor_update (the base passes
+        # non-listed target entries through untouched).
+        super().__init__(params, lr=lr, target_keys=("q1", "q2"),
+                         tau=tau)
+        self._target["actor"] = self._params["actor"]
+        labels = {
+            k: jax.tree.map(
+                lambda _: "frozen" if k == "actor" else "train", v
+            )
+            for k, v in self._params.items()
+        }
+        self._tx = optax.multi_transform(
+            {"train": optax.adam(lr), "frozen": optax.set_to_zero()},
+            labels,
+        )
+        self._opt_state = self._tx.init(self._params)
+        self._atx = optax.adam(lr)
+        self._aopt_state = self._atx.init(self._params["actor"])
+        self._act_dim = act_dim
+        self._jit_actor = None
+
+    def actor_update(self, batch) -> Dict[str, Any]:
+        """Policy step: maximize Q1(s, pi(s)); returns device-valued
+        stats (callers sync once per iteration)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        if self._jit_actor is None:
+            tau = self._tau
+
+            def aloss(actor, q1, obs):
+                a = DeterministicActorModule.forward(actor, obs)
+                return -QModule.forward(q1, obs, a).mean()
+
+            def upd(actor, aopt_state, q1, atarget, obs):
+                loss, grads = jax.value_and_grad(aloss)(
+                    actor, jax.lax.stop_gradient(q1), obs,
+                )
+                updates, aopt_state = self._atx.update(
+                    grads, aopt_state, actor
+                )
+                actor = optax.apply_updates(actor, updates)
+                atarget = jax.tree.map(
+                    lambda t, p: (1.0 - tau) * t + tau * p,
+                    atarget, actor,
+                )
+                return actor, aopt_state, atarget, loss
+
+            self._jit_actor = jax.jit(upd)
+        actor, self._aopt_state, atarget, loss = self._jit_actor(
+            self._params["actor"], self._aopt_state,
+            self._params["q1"], self._target["actor"],
+            jnp.asarray(batch["obs"]),
+        )
+        self._params = {**self._params, "actor": actor}
+        self._target = {**self._target, "actor": atarget}
+        return {"actor_loss": loss}  # device value; caller syncs
+
+    def get_weights(self):
+        """ACTOR weights only — what rollout policies consume."""
+        import jax
+
+        return jax.tree.map(np.asarray, self._params["actor"])
+
+    def set_weights(self, weights):
+        """Accepts either a full {actor, q1, q2} tree or (matching
+        get_weights) an actor-only tree, merged into the full params —
+        the inherited round-trip must not drop the critics."""
+        import jax
+        import jax.numpy as jnp
+
+        if isinstance(weights, dict) and "q1" in weights:
+            super().set_weights(weights)
+        else:
+            self._params = {
+                **self._params,
+                "actor": jax.tree.map(jnp.asarray, weights),
+            }
+
+    def get_state(self):
+        import jax
+
+        return {
+            "params": jax.tree.map(np.asarray, self._params),
+            "target": jax.tree.map(np.asarray, self._target),
+            "num_updates": self.num_updates,
+        }
+
+
 class _LearnerActor:
     """Actor wrapper hosting a Learner replica (LearnerGroup remote
     mode)."""

@@ -16,7 +16,11 @@ from typing import Any, Dict, List
 import numpy as np
 
 from .algorithm import Algorithm, AlgorithmConfig
-from .core import DeterministicActorModule, Learner, QModule
+from .core import (
+    DeterministicActorModule,
+    QModule,
+    TwinCriticLearner,
+)
 from .env_runner import NEXT_OBS, TransitionEnvRunner
 from .replay_buffers import ReplayBuffer
 from .sample_batch import ACTIONS, DONES, OBS, REWARDS, SampleBatch
@@ -39,57 +43,27 @@ class TD3Config(AlgorithmConfig):
         return TD3(self.copy())
 
 
-class TD3Learner(Learner):
-    """Critic loss through the shared Learner plumbing; the delayed
-    actor step is its own jitted function updating actor params + its
-    polyak target."""
+class TD3Learner(TwinCriticLearner):
+    """TD3's critic loss (min-target + target-policy smoothing) on the
+    shared twin-critic machinery (core.py TwinCriticLearner: masked
+    actor subtree, own actor optimizer, critic-preserving round-trips);
+    the actor step is DELAYED by the algorithm loop."""
 
     def __init__(self, policy, cfg, obs_dim: int, act_dim: int,
                  low, high):
-        import jax
         import jax.numpy as jnp
-        import optax
 
-        seed = cfg.seed
-        params = {
-            "actor": policy.get_weights(),
-            "q1": QModule(obs_dim, act_dim, cfg.hidden_size,
-                          seed + 1).init_params(),
-            "q2": QModule(obs_dim, act_dim, cfg.hidden_size,
-                          seed + 2).init_params(),
-        }
-        # Critic targets polyak in the base update; the ACTOR target is
-        # seeded below and synced ONLY by the delayed actor step — the
-        # base passes non-listed target entries through untouched.
-        super().__init__(params, lr=cfg.lr, target_keys=("q1", "q2"),
-                         tau=cfg.tau)
-        self._target["actor"] = self._params["actor"]
-        # The base optimizer must NOT touch actor params: a shared Adam
-        # would keep applying actor momentum on every critic-only step
-        # (zero grads != zero update under Adam), silently defeating the
-        # delayed-policy mechanism. Mask the actor subtree; the delayed
-        # actor step below has its own optimizer + state.
-        labels = {
-            k: jax.tree.map(
-                lambda _: "frozen" if k == "actor" else "train", v
-            )
-            for k, v in self._params.items()
-        }
-        self._tx = optax.multi_transform(
-            {"train": optax.adam(cfg.lr), "frozen": optax.set_to_zero()},
-            labels,
+        super().__init__(
+            policy.get_weights(), obs_dim=obs_dim, act_dim=act_dim,
+            hidden=cfg.hidden_size, lr=cfg.lr, tau=cfg.tau,
+            seed=cfg.seed,
         )
-        self._opt_state = self._tx.init(self._params)
-        self._atx = optax.adam(cfg.lr)
-        self._aopt_state = self._atx.init(self._params["actor"])
         self._gamma = cfg.gamma
         self._noise = cfg.target_noise
         self._noise_clip = cfg.target_noise_clip
         self._low = jnp.asarray(np.asarray(low, np.float32))
         self._high = jnp.asarray(np.asarray(high, np.float32))
-        self._rng = np.random.RandomState(seed + 3)
-        self._act_dim = act_dim
-        self._jit_actor = None
+        self._rng = np.random.RandomState(cfg.seed + 3)
 
     # Actions are stored in ENV units; critics consume [-1, 1].
     def _from_env(self, a):
@@ -126,46 +100,6 @@ class TD3Learner(Learner):
             "q1_mean": q1.mean(),
         }
 
-    def actor_update(self, batch: Dict[str, np.ndarray]
-                     ) -> Dict[str, Any]:
-        """Delayed policy step: maximize Q1(s, pi(s)) with the actor's
-        OWN optimizer/state, then polyak-sync the actor target (its only
-        sync point — critic targets sync in the base update)."""
-        import jax
-        import jax.numpy as jnp
-        import optax
-
-        if self._jit_actor is None:
-            tau = self._tau
-
-            def aloss(actor, q1, obs):
-                a = DeterministicActorModule.forward(actor, obs)
-                return -QModule.forward(q1, obs, a).mean()
-
-            def upd(actor, aopt_state, q1, atarget, obs):
-                loss, grads = jax.value_and_grad(aloss)(
-                    actor, jax.lax.stop_gradient(q1), obs,
-                )
-                updates, aopt_state = self._atx.update(
-                    grads, aopt_state, actor
-                )
-                actor = optax.apply_updates(actor, updates)
-                atarget = jax.tree.map(
-                    lambda t, p: (1.0 - tau) * t + tau * p,
-                    atarget, actor,
-                )
-                return actor, aopt_state, atarget, loss
-
-            self._jit_actor = jax.jit(upd)
-        actor, self._aopt_state, atarget, loss = self._jit_actor(
-            self._params["actor"], self._aopt_state,
-            self._params["q1"], self._target["actor"],
-            jnp.asarray(batch["obs"]),
-        )
-        self._params = {**self._params, "actor": actor}
-        self._target = {**self._target, "actor": atarget}
-        return {"actor_loss": loss}  # device value; caller syncs
-
     def learn_on_batch(self, batch: SampleBatch, *, do_actor: bool
                        ) -> Dict[str, Any]:
         """One critic step (+ delayed actor step). Stats stay ON DEVICE
@@ -185,36 +119,6 @@ class TD3Learner(Learner):
         if do_actor:
             stats = {**stats, **self.actor_update(np_batch)}
         return stats
-
-    def get_weights(self):
-        """ACTOR weights only — what runners' rollout policy consumes."""
-        import jax
-
-        return jax.tree.map(np.asarray, self._params["actor"])
-
-    def set_weights(self, weights):
-        """Accepts either a full {actor, q1, q2} tree or (matching
-        get_weights) an actor-only tree, merged into the full params —
-        the inherited round-trip must not drop the critics."""
-        import jax
-        import jax.numpy as jnp
-
-        if isinstance(weights, dict) and "q1" in weights:
-            super().set_weights(weights)
-        else:
-            self._params = {
-                **self._params,
-                "actor": jax.tree.map(jnp.asarray, weights),
-            }
-
-    def get_state(self):
-        import jax
-
-        return {
-            "params": jax.tree.map(np.asarray, self._params),
-            "target": jax.tree.map(np.asarray, self._target),
-            "num_updates": self.num_updates,
-        }
 
 
 class _TD3EnvRunner(TransitionEnvRunner):
