@@ -4,7 +4,20 @@ CPU EnvRunner actors + jax Learner on the accelerator)."""
 from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from .env_runner import EnvRunner  # noqa: F401
 from .policy import MLPPolicy  # noqa: F401
+from .a2c import A2C, A2CConfig  # noqa: F401
+from .ars import ARS, ARSConfig  # noqa: F401
+from .bandit import (  # noqa: F401
+    Bandit,
+    BanditLinTSConfig,
+    BanditLinUCBConfig,
+)
+from .apex_dqn import ApexDQN, ApexDQNConfig  # noqa: F401
+from .crr import CRR, CRRConfig  # noqa: F401
+from .ddpg import DDPG, DDPGConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
+from .qmix import QMIX, QMIXConfig  # noqa: F401
+from .es import ES, ESConfig  # noqa: F401
+from .marwil import MARWIL, MARWILConfig  # noqa: F401
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
 from .bc import BC, BCConfig  # noqa: F401
 from .cql import CQL, CQLConfig  # noqa: F401

@@ -1,0 +1,116 @@
+"""A2C: synchronous advantage actor-critic.
+
+Ref analogue: rllib/algorithms/a2c (A3C's synchronous variant; the
+reference later moved it to rllib_contrib but ships it in this
+snapshot's algorithm roster). One gradient pass per sampled batch —
+vanilla policy gradient on GAE advantages + value regression + entropy
+bonus, no surrogate clipping and no epoch reuse (that is PPO's
+addition). Shares the ActorCriticModule / Learner layer and the GAE
+EnvRunner plane with PPO.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .core import ActorCriticModule, Learner
+from .sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    OBS,
+    RETURNS,
+    SampleBatch,
+)
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+
+    def build(self) -> "A2C":
+        return A2C(self.copy())
+
+
+class A2CLearner(Learner):
+    """Plain policy-gradient loss: -logp*adv + c_v*mse(V,R) - c_e*H."""
+
+    def __init__(self, policy, lr: float, vf_coeff: float,
+                 ent_coeff: float):
+        super().__init__(policy.get_weights(), lr=lr)
+        self._vf_coeff = vf_coeff
+        self._ent_coeff = ent_coeff
+
+    def compute_loss(self, params, target, batch):
+        import jax
+        import jax.numpy as jnp
+
+        logits, values = ActorCriticModule.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1
+        )[:, 0]
+        adv = batch["adv"]
+        adv_n = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pi_loss = -(logp * adv_n).mean()
+        vf_loss = ((values - batch["returns"]) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = (pi_loss + self._vf_coeff * vf_loss
+                 - self._ent_coeff * entropy)
+        return total, {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+
+class A2C(Algorithm):
+    def _build_learner(self, policy):
+        c = self.config
+        return A2CLearner(policy, c.lr, c.vf_loss_coeff, c.entropy_coeff)
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        batches: List[SampleBatch] = []
+        while sum(b.count for b in batches) < c.train_batch_size:
+            batches.extend(ray_tpu.get(
+                [r.sample.remote() for r in self.runners]
+            ))
+        batch = SampleBatch.concat(batches)
+
+        # ONE synchronous gradient pass over the fresh batch (minibatched
+        # for memory, still a single epoch — on-policy).
+        stats: Dict[str, Any] = {}
+        for mb in batch.minibatches(min(c.minibatch_size, batch.count)):
+            stats = self.update_minibatch(mb)
+        stats = {k: float(v) for k, v in stats.items()}
+
+        weights = self.learner.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners])
+
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": batch.count,
+            **stats,
+        }
+
+    def update_minibatch(self, mb: SampleBatch) -> Dict[str, Any]:
+        return self.learner.update_device({
+            "obs": mb[OBS],
+            "actions": np.asarray(mb[ACTIONS], dtype=np.int32),
+            "adv": mb[ADVANTAGES],
+            "returns": mb[RETURNS],
+        })

@@ -1,0 +1,174 @@
+"""Ape-X DQN: distributed prioritized experience replay.
+
+Ref analogue: rllib/algorithms/apex_dqn (Horgan 2018): the reference's
+architectural changes over DQN, mapped onto this runtime —
+  * the replay buffer becomes a dedicated ACTOR (the reference's
+    ReplayActor shards) so sampling, insertion and priority updates are
+    off the learner's critical path;
+  * EnvRunners explore with a fixed per-worker epsilon LADDER
+    eps_i = base^(1 + 7 i/(N-1)) instead of a global decay schedule;
+  * rollout collection is ASYNC: runner sample futures are re-armed as
+    they land (ray_tpu.wait), while the learner trains on replay
+    minibatches concurrently and pushes td-error priorities back.
+Reuses DQNLearner (double-Q, dueling, n-step via DQNConfig flags).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .dqn import DQN, DQNConfig, DQNLearner, nstep_returns
+from .replay_buffers import PrioritizedReplayBuffer
+from .sample_batch import SampleBatch
+
+
+class _ReplayActor:
+    """Owns the prioritized buffer; all access is actor calls."""
+
+    def __init__(self, capacity: int, alpha: float, beta: float,
+                 seed: int):
+        self._buf = PrioritizedReplayBuffer(
+            capacity, alpha=alpha, beta=beta, seed=seed
+        )
+
+    def add(self, batch: SampleBatch) -> int:
+        self._buf.add(batch)
+        return len(self._buf)
+
+    def sample(self, n: int):
+        if len(self._buf) < n:
+            return None
+        return self._buf.sample(n)
+
+    def update_priorities(self, idx, td):
+        self._buf.update_priorities(idx, td)
+
+    def size(self) -> int:
+        return len(self._buf)
+
+
+class ApexDQNConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 4
+        self.prioritized_replay = True
+        self.epsilon_base: float = 0.4
+        self.epsilon_exponent: float = 7.0
+        self.num_updates_per_iteration = 64
+
+    def build(self) -> "ApexDQN":
+        return ApexDQN(self.copy())
+
+
+class ApexDQN(DQN):
+    def _build_learner(self, policy):
+        import ray_tpu
+
+        c = self.config
+        self._env_steps = 0
+        self._last_target_sync = 0
+        self.replay = ray_tpu.remote(_ReplayActor).remote(
+            c.buffer_size, c.prioritized_replay_alpha,
+            c.prioritized_replay_beta, c.seed,
+        )
+        # Fixed exploration ladder, set once (no decay schedule).
+        n = max(1, len(getattr(self, "runners", [])) or
+                c.num_env_runners)
+        self._ladder = [
+            c.epsilon_base ** (
+                1.0 + c.epsilon_exponent * i / max(1, n - 1)
+            )
+            for i in range(n)
+        ]
+        self._sample_futs: Dict[Any, int] = {}
+        return DQNLearner(policy, c.lr, c.double_q)
+
+    def _arm(self, i: int):
+        self._sample_futs[self.runners[i].sample.remote()] = i
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        if not self._sample_futs:
+            ray_tpu.get([
+                r.set_epsilon.remote(self._ladder[i])
+                for i, r in enumerate(self.runners)
+            ])
+            for i in range(len(self.runners)):
+                self._arm(i)
+
+        # Drain ALL landed rollouts (ASYNC: re-arm immediately), pushing
+        # the n-step-folded transitions into the replay actor. wait()
+        # caps the ready list at num_returns, so block for one and then
+        # sweep the rest non-blockingly.
+        ready, rest = ray_tpu.wait(
+            list(self._sample_futs), num_returns=1, timeout=10.0
+        )
+        if rest:
+            more, _ = ray_tpu.wait(
+                rest, num_returns=len(rest), timeout=0
+            )
+            ready = list(ready) + list(more)
+        add_futs = []
+        for ref in ready:
+            i = self._sample_futs.pop(ref)
+            batch = ray_tpu.get(ref)
+            self._env_steps += batch.count
+            add_futs.append(self.replay.add.remote(
+                nstep_returns(batch, c.n_step, c.gamma)
+            ))
+            self._arm(i)
+        if add_futs:
+            ray_tpu.get(add_futs)
+
+        stats: Dict[str, Any] = {}
+        num_updates = 0
+        buffer_size = ray_tpu.get(self.replay.size.remote())
+        if buffer_size >= c.num_steps_sampled_before_learning_starts:
+            # Pipeline: keep one sample request in flight while the
+            # learner steps on the previous minibatch.
+            pending = self.replay.sample.remote(c.minibatch_size)
+            for _ in range(c.num_updates_per_iteration):
+                mb = ray_tpu.get(pending)
+                pending = self.replay.sample.remote(c.minibatch_size)
+                if mb is None:
+                    break
+                out = self.learner.update(mb)
+                stats["loss"] = out["loss"]
+                self.replay.update_priorities.remote(
+                    mb["batch_indexes"], out["td_error"]
+                )
+                num_updates += 1
+            if (self._env_steps - self._last_target_sync
+                    >= c.target_network_update_freq):
+                self.learner.sync_target()
+                self._last_target_sync = self._env_steps
+            weights = self.learner.get_weights()
+            for r in self.runners:
+                r.set_weights.remote(weights)  # async broadcast
+
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": self._env_steps,
+            "num_learner_updates": num_updates,
+            "buffer_size": buffer_size,
+            **stats,
+        }
+
+    def stop(self):
+        import ray_tpu
+
+        super().stop()
+        try:
+            ray_tpu.kill(self.replay)
+        except Exception:
+            pass

@@ -1,0 +1,40 @@
+"""ARS: augmented random search.
+
+Ref analogue: rllib/algorithms/ars (Mania 2018 "Simple random search
+provides a competitive approach to RL"). Same antithetic
+parameter-space exploration plane as ES (es.py EpisodeEvaluator + seed
+shipping) with ARS's two changes: only the TOP-K directions by
+max(F+, F-) contribute, and the step is normalized by the standard
+deviation of the selected returns instead of rank shaping:
+    theta += alpha / (k * sigma_R) * sum_topk (F+ - F-) * eps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .es import ESConfig, _EvolutionBase
+
+
+class ARSConfig(ESConfig):
+    def __init__(self):
+        super().__init__()
+        self.sigma = 0.1
+        self.step_size = 0.05
+        self.top_directions: int = 8   # k <= episodes_per_batch
+
+    def build(self) -> "ARS":
+        return ARS(self.copy())
+
+
+class ARS(_EvolutionBase):
+    def _apply_update(self, seeds, f_pos, f_neg):
+        c = self.config
+        k = min(c.top_directions, len(seeds))
+        order = np.argsort(np.maximum(f_pos, f_neg))[::-1][:k]
+        used = np.concatenate([f_pos[order], f_neg[order]])
+        sigma_r = float(used.std()) + 1e-8
+        g = np.zeros_like(self.theta)
+        for i in order:
+            g += (f_pos[i] - f_neg[i]) * self._noise(seeds[i])
+        self.theta = self.theta + c.step_size / (k * sigma_r) * g

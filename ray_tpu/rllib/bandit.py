@@ -1,0 +1,167 @@
+"""Contextual bandits: LinUCB and linear Thompson sampling.
+
+Ref analogue: rllib/algorithms/bandit (bandit_linucb.py BanditLinUCB,
+bandit_lints.py BanditLinTS over the DisjointLinearUCB/TS exploration
+models). One-step decision problems: the env's observation is the
+context x, actions are discrete arms, episodes are length-1 (the env
+may also be a plain gymnasium env — only (obs, action, reward) rows
+are consumed; bootstrapping never crosses steps).
+
+Per-arm ridge regression kept in closed form on the driver (numpy —
+these are tiny d x d solves, not MXU work): A_a = I*lam + sum x x^T,
+b_a = sum r x.
+  LinUCB picks argmax_a  theta_a^T x + alpha * sqrt(x^T A_a^-1 x).
+  LinTS  picks argmax_a  theta~^T x,  theta~ ~ N(theta_a, v^2 A_a^-1).
+Exploration state (A, b) lives in the learner; rollout actors get the
+derived (theta, A_inv) matrices broadcast like any policy weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env_runner import TransitionEnvRunner
+from .sample_batch import ACTIONS, OBS, REWARDS, SampleBatch
+
+
+class _LinearBanditPolicy:
+    """Rollout-side arm chooser; numpy, interchangeable with the other
+    policies (compute_action signature)."""
+
+    def __init__(self, num_arms: int, dim: int, *, alpha: float,
+                 ts_scale: float, mode: str, seed: int = 0):
+        self.num_arms = num_arms
+        self.dim = dim
+        self.alpha = alpha
+        self.ts_scale = ts_scale
+        self.mode = mode  # "ucb" | "ts"
+        self.weights = {
+            "theta": np.zeros((num_arms, dim), np.float32),
+            "a_inv": np.stack([np.eye(dim, dtype=np.float32)
+                               for _ in range(num_arms)]),
+        }
+
+    def set_weights(self, weights):
+        self.weights = weights
+
+    def get_weights(self):
+        return self.weights
+
+    def compute_action(self, obs: np.ndarray,
+                       rng: np.random.RandomState):
+        x = np.asarray(obs, np.float32).reshape(-1)
+        theta = self.weights["theta"]
+        a_inv = self.weights["a_inv"]
+        if self.mode == "ucb":
+            mean = theta @ x
+            bonus = np.sqrt(np.einsum("i,aij,j->a", x, a_inv, x))
+            scores = mean + self.alpha * bonus
+        else:
+            scores = np.array([
+                rng.multivariate_normal(
+                    theta[a], (self.ts_scale ** 2) * a_inv[a]
+                ) @ x
+                for a in range(self.num_arms)
+            ])
+        return int(np.argmax(scores)), 0.0, 0.0
+
+
+class BanditConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 1
+        self.rollout_fragment_length = 32
+        self.alpha: float = 1.0        # LinUCB exploration width
+        self.ts_scale: float = 1.0     # LinTS posterior scale
+        self.ridge_lambda: float = 1.0
+        self.mode: str = "ucb"
+
+    def build(self) -> "Bandit":
+        return Bandit(self.copy())
+
+
+class BanditLinUCBConfig(BanditConfig):
+    def __init__(self):
+        super().__init__()
+        self.mode = "ucb"
+
+
+class BanditLinTSConfig(BanditConfig):
+    def __init__(self):
+        super().__init__()
+        self.mode = "ts"
+
+
+class Bandit(Algorithm):
+    """training_step: sample contexts with the current arm posteriors,
+    then fold the (x, a, r) rows into the per-arm ridge state and
+    broadcast fresh (theta, A_inv)."""
+
+    def _make_policy_factory(self, obs_dim: int, num_actions: int):
+        self._require_discrete()
+        c = self.config
+
+        def policy_factory(num_arms=num_actions, dim=obs_dim,
+                           alpha=c.alpha, ts=c.ts_scale, mode=c.mode,
+                           seed=c.seed):
+            return _LinearBanditPolicy(
+                num_arms, dim, alpha=alpha, ts_scale=ts, mode=mode,
+                seed=seed,
+            )
+
+        return policy_factory
+
+    def _runner_class(self):
+        return TransitionEnvRunner
+
+    def _build_learner(self, policy):
+        c = self.config
+        d, k = self._obs_dim, self._num_actions
+        self._A = np.stack([
+            np.eye(d, dtype=np.float64) * c.ridge_lambda
+            for _ in range(k)
+        ])
+        self._b = np.zeros((k, d), np.float64)
+        self._steps = 0
+        self._reward_sum = 0.0
+        return None  # closed-form: no gradient learner
+
+    def get_weights(self):
+        a_inv = np.stack([np.linalg.inv(A) for A in self._A])
+        theta = np.einsum("aij,aj->ai", a_inv, self._b)
+        return {
+            "theta": theta.astype(np.float32),
+            "a_inv": a_inv.astype(np.float32),
+        }
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        batches: List[SampleBatch] = ray_tpu.get(
+            [r.sample.remote() for r in self.runners]
+        )
+        for batch in batches:
+            obs = np.asarray(batch[OBS], np.float64)
+            acts = np.asarray(batch[ACTIONS], np.int64)
+            rews = np.asarray(batch[REWARDS], np.float64)
+            for a in range(self._num_actions):
+                m = acts == a
+                if not m.any():
+                    continue
+                X = obs[m]
+                self._A[a] += X.T @ X
+                self._b[a] += rews[m] @ X
+            self._steps += len(acts)
+            self._reward_sum += float(rews.sum())
+
+        weights = self.get_weights()
+        ray_tpu.get(
+            [r.set_weights.remote(weights) for r in self.runners]
+        )
+        return {
+            "num_env_steps_sampled": self._steps,
+            "mean_reward": self._reward_sum / max(1, self._steps),
+        }

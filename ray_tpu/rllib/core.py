@@ -247,33 +247,35 @@ class Learner:
 
 
 class TwinCriticLearner(Learner):
-    """Shared machinery for deterministic-actor twin-critic algorithms
-    (TD3, CQL): params {actor, q1, q2}; the critic step runs through
-    ``compute_loss`` with the actor subtree MASKED out of the optimizer
-    (Adam momentum on zero grads would still move frozen params), the
-    actor step maximizes Q1(s, pi(s)) with its OWN optimizer state and
-    polyak-syncs the actor target (its only sync point — critic targets
-    sync in the base update), and weight/state round-trips keep the
-    critics (get_weights returns the actor for rollout policies;
-    set_weights accepts actor-only or full trees)."""
+    """Shared machinery for deterministic-actor critic algorithms
+    (TD3, CQL twin; DDPG single): params {actor, q1[, q2, ...]}; the
+    critic step runs through ``compute_loss`` with the actor subtree
+    MASKED out of the optimizer (Adam momentum on zero grads would still
+    move frozen params), the actor step maximizes Q1(s, pi(s)) with its
+    OWN optimizer state and polyak-syncs the actor target (its only sync
+    point — critic targets sync in the base update), and weight/state
+    round-trips keep the critics (get_weights returns the actor for
+    rollout policies; set_weights accepts actor-only or full trees)."""
 
     def __init__(self, actor_params, *, obs_dim: int, act_dim: int,
-                 hidden: int, lr: float, tau: float, seed: int):
+                 hidden: int, lr: float, tau: float, seed: int,
+                 critics: int = 2):
         import jax
         import optax
 
+        qkeys = tuple(f"q{i + 1}" for i in range(critics))
         params = {
             "actor": actor_params,
-            "q1": QModule(obs_dim, act_dim, hidden,
-                          seed + 1).init_params(),
-            "q2": QModule(obs_dim, act_dim, hidden,
-                          seed + 2).init_params(),
+            **{
+                k: QModule(obs_dim, act_dim, hidden,
+                           seed + 1 + i).init_params()
+                for i, k in enumerate(qkeys)
+            },
         }
         # Critic targets polyak in the base update; the ACTOR target is
         # seeded below and synced ONLY by actor_update (the base passes
         # non-listed target entries through untouched).
-        super().__init__(params, lr=lr, target_keys=("q1", "q2"),
-                         tau=tau)
+        super().__init__(params, lr=lr, target_keys=qkeys, tau=tau)
         self._target["actor"] = self._params["actor"]
         labels = {
             k: jax.tree.map(

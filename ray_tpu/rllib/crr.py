@@ -1,0 +1,213 @@
+"""CRR: critic-regularized regression — offline continuous control.
+
+Ref analogue: rllib/algorithms/crr (Wang 2020 "Critic Regularized
+Regression"). Twin critics learn a standard TD backup from the logged
+transitions (no conservative penalty — that is CQL's device); the
+actor is trained by ADVANTAGE-FILTERED behavior cloning: regress
+pi(s) toward the DATASET action, weighted by
+    f(A) = 1[A > 0]          ("binary" mode)
+    f(A) = exp(A / beta)      ("exp" mode, clipped)
+with A(s, a) = Q1(s, a) - Q1(s, pi(s)) — actions the critic scores
+above the current policy pull the policy toward them; worse actions
+are ignored (binary) or exponentially down-weighted. The reference
+trains a stochastic policy; this adaptation regresses the shared
+deterministic actor (core.py DeterministicActorModule), which keeps
+weights drop-in compatible with the TD3/CQL rollout policies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+from .core import (
+    DeterministicActorModule,
+    QModule,
+    TwinCriticLearner,
+)
+
+
+class CRRConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.dataset = None
+        self.obs_column = "obs"
+        self.action_column = "action"
+        self.reward_column = "reward"
+        self.next_obs_column = "next_obs"
+        self.done_column = "done"
+        self.tau: float = 0.005
+        self.weight_type: str = "exp"   # "exp" | "binary"
+        self.beta: float = 1.0          # exp temperature
+        self.epochs_per_iteration: int = 1
+
+    _COLUMN_KEYS = ("obs_column", "action_column", "reward_column",
+                    "next_obs_column", "done_column")
+
+    def offline_data(self, dataset, **columns) -> "CRRConfig":
+        self.dataset = dataset
+        for k, v in columns.items():
+            if k not in self._COLUMN_KEYS:
+                raise ValueError(
+                    f"unknown offline_data column {k!r} "
+                    f"(allowed: {self._COLUMN_KEYS})"
+                )
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "CRR":
+        if self.dataset is None:
+            raise ValueError("CRRConfig.offline_data(dataset=...) "
+                             "required")
+        return CRR(self.copy())
+
+
+class CRRLearner(TwinCriticLearner):
+    """Critic: twin TD toward the target actor's next action (TD3
+    without smoothing). Actor: advantage-weighted regression toward
+    the logged action — overrides the base actor_update (which would
+    maximize Q; CRR explicitly regularizes toward the data instead)."""
+
+    def __init__(self, cfg, obs_dim: int, act_dim: int):
+        super().__init__(
+            DeterministicActorModule(
+                obs_dim, act_dim, cfg.hidden_size, cfg.seed
+            ).init_params(),
+            obs_dim=obs_dim, act_dim=act_dim, hidden=cfg.hidden_size,
+            lr=cfg.lr, tau=cfg.tau, seed=cfg.seed,
+        )
+        self._gamma = cfg.gamma
+        self._beta = cfg.beta
+        self._binary = cfg.weight_type == "binary"
+        self._jit_crr_actor = None
+
+    def compute_loss(self, params, target, batch):
+        import jax
+        import jax.numpy as jnp
+
+        obs, act = batch["obs"], batch["act"]
+        nxt, rew, done = batch["next_obs"], batch["rew"], batch["done"]
+        a2 = DeterministicActorModule.forward(target["actor"], nxt)
+        tq = jnp.minimum(
+            QModule.forward(target["q1"], nxt, a2),
+            QModule.forward(target["q2"], nxt, a2),
+        )
+        backup = jax.lax.stop_gradient(
+            rew + self._gamma * (1.0 - done) * tq
+        )
+        q1 = QModule.forward(params["q1"], obs, act)
+        q2 = QModule.forward(params["q2"], obs, act)
+        td_loss = ((q1 - backup) ** 2 + (q2 - backup) ** 2).mean()
+        return td_loss, {"td_loss": td_loss, "q1_mean": q1.mean()}
+
+    def actor_update(self, batch) -> Dict[str, Any]:
+        """Advantage-weighted regression toward the dataset action."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        if self._jit_crr_actor is None:
+            tau = self._tau
+            binary = self._binary
+            beta = self._beta
+
+            def aloss(actor, q1, obs, act):
+                pi = DeterministicActorModule.forward(actor, obs)
+                adv = (QModule.forward(q1, obs, act)
+                       - QModule.forward(q1, obs, pi))
+                adv = jax.lax.stop_gradient(adv)
+                if binary:
+                    w = (adv > 0).astype(jnp.float32)
+                else:
+                    w = jnp.exp(jnp.clip(adv / beta, -5.0, 5.0))
+                mse = ((pi - act) ** 2).sum(-1)
+                return (w * mse).mean(), w.mean()
+
+            def upd(actor, aopt_state, q1, atarget, obs, act):
+                (loss, wmean), grads = jax.value_and_grad(
+                    aloss, has_aux=True
+                )(actor, jax.lax.stop_gradient(q1), obs, act)
+                updates, aopt_state = self._atx.update(
+                    grads, aopt_state, actor
+                )
+                actor = optax.apply_updates(actor, updates)
+                atarget = jax.tree.map(
+                    lambda t, p: (1.0 - tau) * t + tau * p,
+                    atarget, actor,
+                )
+                return actor, aopt_state, atarget, loss, wmean
+
+            self._jit_crr_actor = jax.jit(upd)
+        actor, self._aopt_state, atarget, loss, wmean = (
+            self._jit_crr_actor(
+                self._params["actor"], self._aopt_state,
+                self._params["q1"], self._target["actor"],
+                jnp.asarray(batch["obs"]), jnp.asarray(batch["act"]),
+            )
+        )
+        self._params = {**self._params, "actor": actor}
+        self._target = {**self._target, "actor": atarget}
+        return {"actor_loss": loss, "mean_weight": wmean}
+
+    def learn_on_batch(self, np_batch) -> Dict[str, Any]:
+        stats = self.update_device(np_batch)
+        return {**stats, **self.actor_update(np_batch)}
+
+
+class CRR:
+    """Offline trainer: epochs of minibatch updates streamed from the
+    Dataset (same driver shape as CQL)."""
+
+    def __init__(self, config: CRRConfig):
+        c = config
+        self.config = c
+        self.iteration = 0
+        probe = next(iter(
+            c.dataset.iter_batches(batch_size=1, batch_format="numpy")
+        ))
+        obs = np.asarray(probe[c.obs_column])
+        act = np.asarray(probe[c.action_column])
+        self._obs_dim = int(np.prod(obs.shape[1:])) or 1
+        self._act_dim = int(np.prod(act.shape[1:])) or 1
+        self.learner = CRRLearner(c, self._obs_dim, self._act_dim)
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        self.iteration += 1
+        stats: Dict[str, Any] = {}
+        updates = 0
+        for _ in range(c.epochs_per_iteration):
+            for batch in c.dataset.iter_batches(
+                batch_size=c.minibatch_size, batch_format="numpy",
+                drop_last=True,
+            ):
+                n = len(batch[c.obs_column])
+                stats = self.learner.learn_on_batch({
+                    "obs": np.asarray(batch[c.obs_column],
+                                      np.float32).reshape(n, -1),
+                    "act": np.asarray(batch[c.action_column],
+                                      np.float32).reshape(n, -1),
+                    "rew": np.asarray(batch[c.reward_column],
+                                      np.float32),
+                    "next_obs": np.asarray(
+                        batch[c.next_obs_column], np.float32
+                    ).reshape(n, -1),
+                    "done": np.asarray(batch[c.done_column],
+                                       np.float32),
+                })
+                updates += 1
+        stats = {k: float(v) for k, v in stats.items()}
+        return {
+            "training_iteration": self.iteration,
+            "num_learner_updates": updates,
+            **stats,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self):
+        pass

@@ -1,0 +1,163 @@
+"""DDPG: deep deterministic policy gradient (continuous control).
+
+Ref analogue: rllib/algorithms/ddpg (Lillicrap 2015) — the TD3
+predecessor: ONE critic, no target-policy smoothing, actor updated
+every critic step. Built on the shared TwinCriticLearner machinery
+(core.py) with ``critics=1``: the critic TD loss backs up through the
+polyak target actor + target critic, the actor step maximizes
+Q(s, pi(s)) with its own optimizer, and rollouts use the same
+Gaussian-noise DeterministicPolicy as TD3.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .core import DeterministicActorModule, QModule, TwinCriticLearner
+from .env_runner import NEXT_OBS, TransitionEnvRunner
+from .replay_buffers import ReplayBuffer
+from .sample_batch import ACTIONS, DONES, OBS, REWARDS, SampleBatch
+
+
+class DDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size: int = 100_000
+        self.num_steps_sampled_before_learning_starts: int = 500
+        self.num_updates_per_iteration: int = 64
+        self.tau: float = 0.005
+        self.exploration_noise: float = 0.1
+
+    def build(self) -> "DDPG":
+        return DDPG(self.copy())
+
+
+class DDPGLearner(TwinCriticLearner):
+    """Single-critic TD loss: backup = r + gamma*(1-d)*Q'(s', pi'(s'))
+    — no twin-min, no smoothing noise (those are TD3's additions)."""
+
+    def __init__(self, policy, cfg, obs_dim: int, act_dim: int,
+                 low, high):
+        import jax.numpy as jnp
+
+        super().__init__(
+            policy.get_weights(), obs_dim=obs_dim, act_dim=act_dim,
+            hidden=cfg.hidden_size, lr=cfg.lr, tau=cfg.tau,
+            seed=cfg.seed, critics=1,
+        )
+        self._gamma = cfg.gamma
+        self._low = jnp.asarray(np.asarray(low, np.float32))
+        self._high = jnp.asarray(np.asarray(high, np.float32))
+
+    # Actions are stored in ENV units; critics consume [-1, 1].
+    def _from_env(self, a):
+        import jax.numpy as jnp
+
+        u = (a - self._low) / (self._high - self._low) * 2.0 - 1.0
+        return jnp.clip(u, -1.0, 1.0)
+
+    def compute_loss(self, params, target, batch):
+        import jax
+        import jax.numpy as jnp
+
+        obs, nxt = batch["obs"], batch["next_obs"]
+        act = self._from_env(batch["actions"])
+        a2 = DeterministicActorModule.forward(target["actor"], nxt)
+        tq = QModule.forward(target["q1"], nxt, a2)
+        backup = jax.lax.stop_gradient(
+            batch["rew"] + self._gamma * (1.0 - batch["done"]) * tq
+        )
+        q = QModule.forward(params["q1"], obs, act)
+        critic_loss = ((q - backup) ** 2).mean()
+        return critic_loss, {
+            "critic_loss": critic_loss,
+            "q_mean": q.mean(),
+        }
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, Any]:
+        """One critic step + one actor step (every step — no delay).
+        Stats stay ON DEVICE; callers float() once per iteration."""
+        np_batch = {
+            "obs": batch[OBS],
+            "actions": np.asarray(batch[ACTIONS], np.float32),
+            "rew": batch[REWARDS],
+            "done": np.asarray(batch[DONES], np.float32),
+            "next_obs": batch[NEXT_OBS],
+        }
+        stats = self.update_device(np_batch)
+        return {**stats, **self.actor_update(np_batch)}
+
+
+class DDPG(Algorithm):
+    def _make_policy_factory(self, obs_dim: int, act_dim: int):
+        from .policy import DeterministicPolicy
+
+        if not getattr(self, "_continuous", False):
+            raise ValueError(
+                "DDPG supports Box (continuous) action spaces only"
+            )
+        config = self.config
+        low, high = self._action_low, self._action_high
+
+        def policy_factory(obs_dim=obs_dim, act_dim=act_dim,
+                           hidden=config.hidden_size, seed=config.seed,
+                           noise=config.exploration_noise):
+            return DeterministicPolicy(
+                obs_dim, act_dim, low, high, hidden, seed,
+                exploration_noise=noise,
+            )
+
+        return policy_factory
+
+    def _runner_class(self):
+        return TransitionEnvRunner
+
+    def _build_learner(self, policy):
+        c = self.config
+        self.buffer = ReplayBuffer(c.buffer_size, seed=c.seed)
+        self._env_steps = 0
+        return DDPGLearner(policy, c, self._obs_dim, self._num_actions,
+                           self._action_low, self._action_high)
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        batches: List[SampleBatch] = ray_tpu.get(
+            [r.sample.remote() for r in self.runners]
+        )
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += b.count
+
+        stats: Dict[str, Any] = {}
+        num_updates = 0
+        if self._env_steps >= c.num_steps_sampled_before_learning_starts:
+            for _ in range(c.num_updates_per_iteration):
+                mb = self.buffer.sample(c.minibatch_size)
+                stats = self.learner.learn_on_batch(mb)
+                num_updates += 1
+            # ONE host sync for the whole update loop.
+            stats = {k: float(v) for k, v in stats.items()}
+            weights = self.learner.get_weights()
+            ray_tpu.get(
+                [r.set_weights.remote(weights) for r in self.runners]
+            )
+
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": self._env_steps,
+            "num_learner_updates": num_updates,
+            "buffer_size": len(self.buffer),
+            **stats,
+        }

@@ -1,10 +1,14 @@
 """DQN: off-policy Q-learning with replay and a target network.
 
 Ref analogue: rllib/algorithms/dqn/ (dqn.py training_step:623, double-Q +
-target network sync, n-step=1) — sampling stays on CPU EnvRunner actors
+target network sync) — sampling stays on CPU EnvRunner actors
 (epsilon-greedy), learning is a jax double-DQN TD update on the
 accelerator, with uniform or prioritized replay
-(utils/replay_buffers/prioritized_replay_buffer.py).
+(utils/replay_buffers/prioritized_replay_buffer.py). The reference's
+Rainbow components ship as config flags: ``dueling`` (Wang 2016
+V + A - mean(A) heads, the reference's `dueling` option) and ``n_step``
+(multi-step TD backup folded into the stored transitions, the
+reference's `n_step` option); double-Q is on by default.
 """
 
 from __future__ import annotations
@@ -31,6 +35,8 @@ class DQNConfig(AlgorithmConfig):
         self.epsilon_final: float = 0.05
         self.epsilon_timesteps: int = 10_000  # linear decay horizon
         self.double_q: bool = True
+        self.dueling: bool = False
+        self.n_step: int = 1
         self.prioritized_replay: bool = False
         self.prioritized_replay_alpha: float = 0.6
         self.prioritized_replay_beta: float = 0.4
@@ -39,10 +45,55 @@ class DQNConfig(AlgorithmConfig):
         return DQN(self.copy())
 
 
-class DQNLearner:
-    """jax double-DQN learner with a lagged target network."""
+DISCOUNT = "discount"  # per-row bootstrap discount gamma^k * (1-done)
 
-    def __init__(self, policy, lr: float, gamma: float, double_q: bool):
+
+def nstep_returns(batch: SampleBatch, n: int, gamma: float
+                  ) -> SampleBatch:
+    """Fold an n-step lookahead into a sequential fragment batch:
+    reward_t <- sum_{k<n} gamma^k r_{t+k}, next_obs_t <- obs_{t+n},
+    and a DISCOUNT column gamma^{k_used}*(1-done) for the bootstrap.
+    The lookahead stops at any EPISODE BOUNDARY — termination or
+    truncation (the runner resets either way; crossing one would blend
+    the next episode into the target) — while the bootstrap mask uses
+    DONES alone, so truncated episodes still bootstrap. The fragment
+    tail bootstraps early (the reference accepts the same
+    fragment-boundary truncation)."""
+    from .env_runner import BOUNDARY
+
+    rew = np.asarray(batch[REWARDS], np.float64)
+    done = np.asarray(batch[DONES], bool)
+    boundary = (np.asarray(batch[BOUNDARY], bool)
+                if BOUNDARY in batch else done)
+    nxt = np.asarray(batch[NEXT_OBS])
+    T = len(rew)
+    r_n = np.zeros(T, np.float32)
+    nxt_n = nxt.copy()
+    disc = np.zeros(T, np.float32)
+    for t in range(T):
+        acc, g = 0.0, 1.0
+        k = 0
+        while True:
+            acc += g * rew[t + k]
+            g *= gamma
+            if boundary[t + k] or k + 1 >= n or t + k + 1 >= T:
+                break
+            k += 1
+        r_n[t] = acc
+        nxt_n[t] = nxt[t + k]
+        disc[t] = 0.0 if done[t + k] else g
+    out = SampleBatch(dict(batch))
+    out[REWARDS] = r_n
+    out[NEXT_OBS] = nxt_n
+    out[DISCOUNT] = disc
+    return out
+
+
+class DQNLearner:
+    """jax double-DQN learner with a lagged target network; plain or
+    dueling heads (the head layout follows the params pytree)."""
+
+    def __init__(self, policy, lr: float, double_q: bool):
         import jax
         import jax.numpy as jnp
         import optax
@@ -56,10 +107,16 @@ class DQNLearner:
             h = obs
             for W, b in params["trunk"]:
                 h = jnp.tanh(h @ W + b)
-            (Wq, bq), = params["q"]
-            return h @ Wq + bq
+            if "q" in params:
+                (Wq, bq), = params["q"]
+                return h @ Wq + bq
+            (Wv, bv), = params["v"]
+            (Wa, ba), = params["a"]
+            v = h @ Wv + bv
+            a = h @ Wa + ba
+            return v + a - a.mean(axis=-1, keepdims=True)
 
-        def loss_fn(params, target, obs, actions, rewards, dones,
+        def loss_fn(params, target, obs, actions, rewards, discount,
                     next_obs, weights):
             q = q_forward(params, obs)
             q_sa = jnp.take_along_axis(q, actions[:, None], axis=1)[:, 0]
@@ -73,16 +130,16 @@ class DQNLearner:
             q_next = jnp.take_along_axis(
                 q_next_target, best[:, None], axis=1
             )[:, 0]
-            targets = rewards + gamma * (1.0 - dones) * q_next
+            targets = rewards + discount * q_next
             td = q_sa - jax.lax.stop_gradient(targets)
             loss = (weights * td * td).mean()
             return loss, td
 
         def update(params, opt_state, target, obs, actions, rewards,
-                   dones, next_obs, weights):
+                   discount, next_obs, weights):
             (loss, td), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
-            )(params, target, obs, actions, rewards, dones, next_obs,
+            )(params, target, obs, actions, rewards, discount, next_obs,
               weights)
             updates, opt_state = self._tx.update(grads, opt_state)
             params = optax.apply_updates(params, updates)
@@ -103,7 +160,7 @@ class DQNLearner:
             jnp.asarray(batch[OBS]),
             jnp.asarray(batch[ACTIONS], dtype=jnp.int32),
             jnp.asarray(batch[REWARDS]),
-            jnp.asarray(batch[DONES], dtype=jnp.float32),
+            jnp.asarray(batch[DISCOUNT]),
             jnp.asarray(batch[NEXT_OBS]),
             w,
         )
@@ -123,13 +180,15 @@ class DQNLearner:
 class DQN(Algorithm):
     def _make_policy_factory(self, obs_dim: int, num_actions: int):
         self._require_discrete()
-        from .policy import QPolicy
+        from .policy import DuelingQPolicy, QPolicy
 
         config = self.config
+        cls = DuelingQPolicy if config.dueling else QPolicy
 
-        def policy_factory(obs_dim=obs_dim, num_actions=num_actions,
+        def policy_factory(cls=cls, obs_dim=obs_dim,
+                           num_actions=num_actions,
                            hidden=config.hidden_size, seed=config.seed):
-            return QPolicy(obs_dim, num_actions, hidden, seed)
+            return cls(obs_dim, num_actions, hidden, seed)
 
         return policy_factory
 
@@ -148,7 +207,7 @@ class DQN(Algorithm):
             self.buffer = ReplayBuffer(c.buffer_size, seed=c.seed)
         self._env_steps = 0
         self._last_target_sync = 0
-        return DQNLearner(policy, c.lr, c.gamma, c.double_q)
+        return DQNLearner(policy, c.lr, c.double_q)
 
     def _epsilon(self) -> float:
         c = self.config
@@ -168,8 +227,8 @@ class DQN(Algorithm):
             [r.sample.remote() for r in self.runners]
         )
         for b in batches:
-            self.buffer.add(b)
             self._env_steps += b.count
+            self.buffer.add(nstep_returns(b, c.n_step, c.gamma))
 
         stats: Dict[str, Any] = {}
         num_updates = 0

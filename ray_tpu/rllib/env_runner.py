@@ -100,6 +100,7 @@ class EnvRunner:
 
 
 NEXT_OBS = "next_obs"
+BOUNDARY = "boundary"  # episode ended here (terminated OR truncated)
 
 
 class TransitionEnvRunner(EnvRunner):
@@ -111,7 +112,8 @@ class TransitionEnvRunner(EnvRunner):
         self.policy.set_epsilon(epsilon)
 
     def sample(self) -> SampleBatch:
-        obs_l, act_l, rew_l, done_l, next_l = [], [], [], [], []
+        obs_l, act_l, rew_l, done_l, next_l, bound_l = \
+            [], [], [], [], [], []
         for _ in range(self.fragment):
             action, _, _ = self.policy.compute_action(
                 np.asarray(self._obs, dtype=np.float32), self.rng
@@ -122,8 +124,10 @@ class TransitionEnvRunner(EnvRunner):
             act_l.append(action)
             rew_l.append(float(reward))
             # Bootstrapping must stop at TERMINATION but not truncation
-            # (time limits are not environment death).
+            # (time limits are not environment death); multi-step
+            # lookaheads must stop at BOTH (the env resets either way).
             done_l.append(bool(terminated))
+            bound_l.append(done)
             next_l.append(np.asarray(nxt, dtype=np.float32).reshape(-1))
             self._episode_reward += float(reward)
             self._episode_len += 1
@@ -139,5 +143,6 @@ class TransitionEnvRunner(EnvRunner):
             ACTIONS: np.asarray(act_l),
             REWARDS: np.asarray(rew_l, dtype=np.float32),
             DONES: np.asarray(done_l),
+            BOUNDARY: np.asarray(bound_l),
             NEXT_OBS: np.stack(next_l),
         })

@@ -1,0 +1,285 @@
+"""ES: evolution strategies (gradient-free, massively parallel).
+
+Ref analogue: rllib/algorithms/es (Salimans 2017 "Evolution Strategies
+as a Scalable Alternative to RL"). The driver holds a flat parameter
+vector theta; each iteration samples antithetic Gaussian perturbation
+pairs, fans their EPISODE evaluations out to CPU actors, and applies
+the score-function estimate
+    g = 1/(n*sigma) * sum_i rank(F_i) * eps_i
+with centered-rank normalization. The classic shared-noise-table trick
+becomes seed shipping: actors receive (seed, sigma) and regenerate
+eps = randn(seed) locally, so the wire carries ints, not parameter
+vectors — the same bandwidth shape the reference's SharedNoiseTable
+achieves (rllib/algorithms/es/es.py noise table + rollout workers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+from .policy import init_mlp_params
+
+
+def flatten_params(tree) -> Tuple[np.ndarray, list]:
+    """Nested {name: [(W, b), ...]} -> (flat float64 vector, spec)."""
+    flat, spec = [], []
+    for name in sorted(tree):
+        for i, (W, b) in enumerate(tree[name]):
+            spec.append((name, i, W.shape, b.shape))
+            flat.append(W.ravel())
+            flat.append(b.ravel())
+    return np.concatenate(flat).astype(np.float64), spec
+
+
+def unflatten_params(vec: np.ndarray, spec: list):
+    tree: Dict[str, list] = {}
+    off = 0
+    for name, i, wshape, bshape in spec:
+        wn = int(np.prod(wshape))
+        bn = int(np.prod(bshape))
+        W = vec[off:off + wn].reshape(wshape).astype(np.float32)
+        b = vec[off + wn:off + wn + bn].reshape(bshape).astype(
+            np.float32)
+        off += wn + bn
+        tree.setdefault(name, []).append((W, b))
+    return tree
+
+
+class DeterministicDiscretePolicy:
+    """argmax-logits MLP policy — ES/ARS evaluate deterministic
+    behavior; exploration comes from parameter-space noise."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: int = 32,
+                 seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.weights = {
+            "trunk": init_mlp_params(rng, [obs_dim, hidden]),
+            "pi": init_mlp_params(rng, [hidden, num_actions]),
+        }
+
+    def set_weights(self, weights):
+        self.weights = weights
+
+    def get_weights(self):
+        return self.weights
+
+    def compute_action(self, obs, rng):
+        h = np.asarray(obs, np.float32).reshape(-1)
+        for W, b in self.weights["trunk"]:
+            h = np.tanh(h @ W + b)
+        (W, b), = self.weights["pi"]
+        return int(np.argmax(h @ W + b)), 0.0, 0.0
+
+
+class EpisodeEvaluator:
+    """CPU actor: evaluates parameter perturbations by full episode.
+    Receives the base theta once per iteration; perturbations arrive as
+    noise SEEDS and are regenerated locally (antithetic +/- pairs)."""
+
+    def __init__(self, env_creator: Callable[[], Any], policy_factory,
+                 spec_blob: bytes, seed: int = 0,
+                 episode_horizon: int = 1000):
+        import pickle
+
+        self.env = env_creator()
+        self.policy = policy_factory()
+        self.spec = pickle.loads(spec_blob)
+        self.horizon = episode_horizon
+        self.rng = np.random.RandomState(seed)
+        self._theta = None
+
+    def set_theta(self, theta: np.ndarray):
+        self._theta = np.asarray(theta, np.float64)
+
+    def _rollout(self, vec: np.ndarray) -> float:
+        self.policy.set_weights(unflatten_params(vec, self.spec))
+        obs, _ = self.env.reset(
+            seed=int(self.rng.randint(2 ** 31 - 1))
+        )
+        total = 0.0
+        for _ in range(self.horizon):
+            action, _, _ = self.policy.compute_action(obs, self.rng)
+            obs, reward, terminated, truncated, _ = self.env.step(action)
+            total += float(reward)
+            if terminated or truncated:
+                break
+        return total
+
+    def evaluate_pairs(self, seeds: List[int], sigma: float
+                       ) -> List[Tuple[int, float, float]]:
+        """[(seed, F(theta + sigma*eps), F(theta - sigma*eps))]."""
+        out = []
+        for s in seeds:
+            eps = np.random.RandomState(s).randn(len(self._theta))
+            out.append((
+                s,
+                self._rollout(self._theta + sigma * eps),
+                self._rollout(self._theta - sigma * eps),
+            ))
+        return out
+
+    def evaluate_theta(self) -> float:
+        return self._rollout(self._theta)
+
+
+def centered_ranks(x: np.ndarray) -> np.ndarray:
+    """Map scores to [-0.5, 0.5] by rank (Salimans 2017 fitness
+    shaping)."""
+    ranks = np.empty(len(x), dtype=np.float64)
+    ranks[np.argsort(x)] = np.arange(len(x))
+    return ranks / (len(x) - 1) - 0.5 if len(x) > 1 else np.zeros(1)
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 2
+        self.episodes_per_batch: int = 16   # antithetic PAIRS / iter
+        self.sigma: float = 0.1             # perturbation stddev
+        self.step_size: float = 0.05        # SGD step on the estimate
+        self.l2_coeff: float = 0.005
+        self.episode_horizon: int = 1000
+        self.hidden_size = 32
+
+    def build(self) -> "ES":
+        return ES(self.copy())
+
+
+class _EvolutionBase:
+    """Shared driver shape for ES and ARS: flat theta + evaluator
+    actors + seed fan-out; subclasses implement _apply_update."""
+
+    def __init__(self, config):
+        import pickle
+
+        import ray_tpu
+
+        self.config = config
+        self.iteration = 0
+        c = config
+        creator = c.env_creator()
+        probe = creator()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        if not hasattr(probe.action_space, "n"):
+            raise ValueError(
+                f"{type(self).__name__} here supports discrete action "
+                f"spaces (parameter-space search over argmax policies)"
+            )
+        num_actions = int(probe.action_space.n)
+        if hasattr(probe, "close"):
+            probe.close()
+
+        def policy_factory(obs_dim=obs_dim, num_actions=num_actions,
+                           hidden=c.hidden_size, seed=c.seed):
+            return DeterministicDiscretePolicy(
+                obs_dim, num_actions, hidden, seed
+            )
+
+        self.theta, self.spec = flatten_params(
+            policy_factory().get_weights()
+        )
+        spec_blob = pickle.dumps(self.spec)
+        evaluator_cls = ray_tpu.remote(EpisodeEvaluator)
+        self.evaluators = [
+            evaluator_cls.remote(
+                creator, policy_factory, spec_blob,
+                seed=c.seed + 1000 * (i + 1),
+                episode_horizon=c.episode_horizon,
+            )
+            for i in range(c.num_env_runners)
+        ]
+        self._seed_rng = np.random.RandomState(c.seed)
+        self._episodes = 0
+
+    def _evaluate_batch(self, num_pairs: int, sigma: float):
+        """Fan seed chunks over evaluators; returns (seeds, F+, F-)."""
+        import ray_tpu
+
+        seeds = self._seed_rng.randint(
+            2 ** 31 - 1, size=num_pairs
+        ).tolist()
+        chunks = np.array_split(seeds, len(self.evaluators))
+        ray_tpu.get([e.set_theta.remote(self.theta)
+                     for e in self.evaluators])
+        results = ray_tpu.get([
+            e.evaluate_pairs.remote([int(s) for s in chunk], sigma)
+            for e, chunk in zip(self.evaluators, chunks)
+            if len(chunk)
+        ])
+        triples = [t for chunk in results for t in chunk]
+        self._episodes += 2 * len(triples)
+        s = [t[0] for t in triples]
+        fp = np.asarray([t[1] for t in triples])
+        fn = np.asarray([t[2] for t in triples])
+        return s, fp, fn
+
+    def _noise(self, seed: int) -> np.ndarray:
+        return np.random.RandomState(seed).randn(len(self.theta))
+
+    def _apply_update(self, seeds, f_pos, f_neg):
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        self.iteration += 1
+        c = self.config
+        seeds, f_pos, f_neg = self._evaluate_batch(
+            c.episodes_per_batch, c.sigma
+        )
+        self._apply_update(seeds, f_pos, f_neg)
+        # Evaluate the CURRENT (unperturbed) theta on one evaluator.
+        ray_tpu.get(
+            [self.evaluators[0].set_theta.remote(self.theta)]
+        )
+        cur = float(ray_tpu.get(
+            self.evaluators[0].evaluate_theta.remote()
+        ))
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": cur,
+            "perturbed_reward_mean": float(
+                np.mean(np.concatenate([f_pos, f_neg]))
+            ),
+            "episodes_total": self._episodes,
+            "theta_norm": float(np.linalg.norm(self.theta)),
+        }
+
+    def get_weights(self):
+        return unflatten_params(self.theta, self.spec)
+
+    def get_policy(self):
+        c = self.config
+        policy = DeterministicDiscretePolicy(1, 1)  # shapes from spec
+        policy.set_weights(self.get_weights())
+        return policy
+
+    def stop(self):
+        import ray_tpu
+
+        for e in self.evaluators:
+            try:
+                ray_tpu.kill(e)
+            except Exception:
+                pass
+
+
+class ES(_EvolutionBase):
+    def _apply_update(self, seeds, f_pos, f_neg):
+        c = self.config
+        # Centered-rank shaping over the 2n returns, folded back to the
+        # antithetic difference per pair.
+        shaped = centered_ranks(np.concatenate([f_pos, f_neg]))
+        n = len(seeds)
+        diff = shaped[:n] - shaped[n:]
+        g = np.zeros_like(self.theta)
+        for s, d in zip(seeds, diff):
+            g += d * self._noise(s)
+        g /= 2 * n * c.sigma
+        self.theta = (
+            (1.0 - c.l2_coeff * c.step_size) * self.theta
+            + c.step_size * g
+        )

@@ -112,6 +112,35 @@ class QPolicy:
         return action, 0.0, 0.0
 
 
+class DuelingQPolicy(QPolicy):
+    """Dueling-architecture Q network (Wang 2016, ref analogue: the
+    reference DQN stack's dueling head): Q(s,a) = V(s) + A(s,a) -
+    mean_a A(s,a); numpy inference, epsilon-greedy shared with
+    QPolicy."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: int = 64,
+                 seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.epsilon = 1.0
+        self.weights: Dict[str, List] = {
+            "trunk": init_mlp_params(rng, [obs_dim, hidden, hidden]),
+            "v": init_mlp_params(rng, [hidden, 1]),
+            "a": init_mlp_params(rng, [hidden, num_actions]),
+        }
+
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        h = obs
+        for W, b in self.weights["trunk"]:
+            h = np.tanh(h @ W + b)
+        (Wv, bv), = self.weights["v"]
+        (Wa, ba), = self.weights["a"]
+        v = h @ Wv + bv
+        a = h @ Wa + ba
+        return v + a - a.mean(axis=-1, keepdims=True)
+
+
 class DeterministicPolicy:
     """Continuous-control deterministic actor (TD3-style): tanh(mu)
     scaled to the Box bounds, plus Gaussian EXPLORATION noise applied at

@@ -1,0 +1,343 @@
+"""RLlib family tests: DDPG, A2C, MARWIL, bandits, ES, ARS.
+
+Each family trains on a seconds-scale toy task that its reference
+analogue (rllib/algorithms/<name>) demonstrably solves; envs live
+inside factories so cloudpickle ships them by value (this test module
+is not importable from worker processes).
+"""
+
+import sys as _sys
+
+import cloudpickle as _cloudpickle
+import numpy as np
+import pytest
+
+# Env factories below are module-level; workers cannot import this test
+# module, so ship everything from it by value.
+_cloudpickle.register_pickle_by_value(_sys.modules[__name__])
+
+
+def _go_to_zero_env():
+    """1-D continuous toy: reward -|x + a|; optimum a = -x."""
+    import numpy as _np
+
+    class _Box:
+        def __init__(self, low, high, shape):
+            self.low = _np.full(shape, low, dtype=_np.float32)
+            self.high = _np.full(shape, high, dtype=_np.float32)
+            self.shape = shape
+
+    class GoToZero:
+        def __init__(self):
+            self.observation_space = _Box(-1.0, 1.0, (1,))
+            self.action_space = _Box(-1.0, 1.0, (1,))
+            self._rng = _np.random.RandomState(0)
+            self._t = 0
+
+        def reset(self, seed=None):
+            if seed is not None:
+                self._rng = _np.random.RandomState(seed)
+            self._t = 0
+            self._x = self._rng.uniform(-1, 1, (1,)).astype("float32")
+            return self._x, {}
+
+        def step(self, action):
+            r = -float(abs(self._x[0] + float(action[0])))
+            self._t += 1
+            self._x = self._rng.uniform(-1, 1, (1,)).astype("float32")
+            return self._x, r, False, self._t >= 50, {}
+
+    return GoToZero()
+
+
+def _sign_env():
+    """Discrete toy: obs=[signal in {-1,+1}]; action must match the
+    sign (+1 reward, else -1); 30-step episodes."""
+    import numpy as _np
+
+    class _Box:
+        def __init__(self, shape):
+            self.shape = shape
+
+    class _Disc:
+        n = 2
+        shape = ()
+
+    class Sign:
+        def __init__(self):
+            self.observation_space = _Box((1,))
+            self.action_space = _Disc()
+            self._rng = _np.random.RandomState(0)
+            self._t = 0
+
+        def _obs(self):
+            self._sig = float(self._rng.choice([-1.0, 1.0]))
+            return _np.asarray([self._sig], "float32")
+
+        def reset(self, seed=None):
+            if seed is not None:
+                self._rng = _np.random.RandomState(seed)
+            self._t = 0
+            return self._obs(), {}
+
+        def step(self, action):
+            want = 1 if self._sig > 0 else 0
+            r = 1.0 if int(action) == want else -1.0
+            self._t += 1
+            return self._obs(), r, False, self._t >= 30, {}
+
+    return Sign()
+
+
+def test_ddpg_learns_continuous_control(ray_tpu_start):
+    """DDPG (single critic, undelayed actor) reaches the a=-x optimum
+    (ref: rllib/algorithms/ddpg)."""
+    from ray_tpu.rllib import DDPGConfig
+
+    config = (
+        DDPGConfig()
+        .environment(_go_to_zero_env)
+        .env_runners(num_env_runners=2, rollout_fragment_length=100)
+        .training(lr=3e-3, minibatch_size=128,
+                  num_updates_per_iteration=60,
+                  num_steps_sampled_before_learning_starts=200,
+                  exploration_noise=0.2)
+    )
+    algo = config.build()
+    try:
+        first = algo.train()
+        last = {}
+        for _ in range(15):
+            last = algo.train()
+        assert last["num_learner_updates"] > 0
+        assert np.isfinite(last["critic_loss"])
+        assert "actor_loss" in last
+        assert last["episode_reward_mean"] > \
+            first["episode_reward_mean"] + 4, (first, last)
+        assert last["episode_reward_mean"] > -12, last
+    finally:
+        algo.stop()
+
+
+def test_a2c_learns_sign_task(ray_tpu_start):
+    """A2C (single-epoch policy gradient) solves sign matching (ref:
+    rllib/algorithms/a2c)."""
+    from ray_tpu.rllib import A2CConfig
+
+    config = (
+        A2CConfig()
+        .environment(_sign_env)
+        .env_runners(num_env_runners=2, rollout_fragment_length=120)
+        .training(lr=5e-3, train_batch_size=240, minibatch_size=240)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    try:
+        best = -31.0
+        for _ in range(20):
+            result = algo.train()
+            if result["episodes_total"] > 0:
+                best = max(best, result["episode_reward_mean"])
+            if best > 24:
+                break
+        # Random play ~0; optimal 30.
+        assert best > 24, best
+    finally:
+        algo.stop()
+
+
+def test_marwil_prefers_high_return_actions(ray_tpu_start):
+    """MARWIL up-weights better-than-average logged actions: when only
+    30% of the logged rows take the (high-return) expert action, BC
+    (beta=0) imitates the 70% majority's mistake while beta>0 recovers
+    the expert (ref: rllib/algorithms/marwil)."""
+    import ray_tpu.data as rd
+    from ray_tpu.rllib import MARWILConfig
+
+    rng = np.random.RandomState(0)
+    n = 2048
+    obs = rng.randn(n, 4).astype("float32")
+    expert = (obs[:, 0] + obs[:, 1] > 0).astype("int64")
+    # 70% of rows log the WRONG action (with its low return).
+    action = np.where(rng.rand(n) < 0.3, expert, 1 - expert)
+    ret = np.where(action == expert, 1.0, -1.0).astype("float32")
+    ds = rd.from_items(
+        [{"obs": obs[i], "action": int(action[i]),
+          "return": float(ret[i])} for i in range(n)],
+        override_num_blocks=4,
+    )
+
+    def accuracy(algo):
+        policy = algo.get_policy()
+        test_obs = rng.randn(512, 4).astype("float32")
+        want = (test_obs[:, 0] + test_obs[:, 1] > 0).astype("int64")
+        logits, _ = policy.logits_and_value(test_obs)
+        return float((logits.argmax(axis=1) == want).mean())
+
+    cfg = MARWILConfig().offline_data(ds).training(
+        lr=5e-3, minibatch_size=256, beta=2.0
+    )
+    cfg.num_actions = 2
+    algo = cfg.build()
+    for _ in range(30):
+        last = algo.train()
+    assert last["num_rows_trained"] == n
+    acc = accuracy(algo)
+    assert acc > 0.85, acc
+
+    # beta=0 is BC: cross-entropy's argmax imitates the 70% majority,
+    # i.e. the WRONG action.
+    cfg0 = MARWILConfig().offline_data(ds).training(
+        lr=5e-3, minibatch_size=256, beta=0.0
+    )
+    cfg0.num_actions = 2
+    bc_like = cfg0.build()
+    for _ in range(30):
+        bc_like.train()
+    bc_acc = accuracy(bc_like)
+    assert bc_acc < 0.5, bc_acc
+    assert acc > bc_acc + 0.3, (acc, bc_acc)
+
+
+def _bandit_env():
+    """Contextual bandit: x ~ unit ball in R^2, 3 arms with fixed
+    weight vectors; reward = theta_a . x (+ noise); 1-step episodes."""
+    import numpy as _np
+
+    class _Box:
+        def __init__(self, shape):
+            self.shape = shape
+
+    class _Disc:
+        n = 3
+        shape = ()
+
+    class LinBandit:
+        THETA = _np.asarray([[1.0, 0.0], [0.0, 1.0], [-0.7, -0.7]])
+
+        def __init__(self):
+            self.observation_space = _Box((2,))
+            self.action_space = _Disc()
+            self._rng = _np.random.RandomState(0)
+
+        def _ctx(self):
+            x = self._rng.randn(2)
+            self._x = (x / _np.linalg.norm(x)).astype("float32")
+            return self._x
+
+        def reset(self, seed=None):
+            if seed is not None:
+                self._rng = _np.random.RandomState(seed)
+            return self._ctx(), {}
+
+        def step(self, action):
+            r = float(self.THETA[int(action)] @ self._x)
+            r += 0.05 * float(self._rng.randn())
+            return self._ctx(), r, True, False, {}
+
+    return LinBandit()
+
+
+@pytest.mark.parametrize("mode", ["ucb", "ts"])
+def test_bandit_linear(ray_tpu_start, mode):
+    """LinUCB/LinTS approach the oracle arm's mean reward (ref:
+    rllib/algorithms/bandit)."""
+    from ray_tpu.rllib import BanditLinTSConfig, BanditLinUCBConfig
+
+    cls = BanditLinUCBConfig if mode == "ucb" else BanditLinTSConfig
+    config = (
+        cls()
+        .environment(_bandit_env)
+        .env_runners(num_env_runners=2, rollout_fragment_length=64)
+    )
+    algo = config.build()
+    try:
+        for _ in range(10):
+            result = algo.train()
+        # Oracle mean = E[max_a theta_a . x] ~ 0.85 on the unit circle;
+        # uniform play ~ 0.04. The cumulative mean lags the converged
+        # policy, so the bar is modest but far above random.
+        assert result["mean_reward"] > 0.5, result
+        w = algo.get_weights()
+        assert w["theta"].shape == (3, 2)
+    finally:
+        algo.stop()
+
+
+def test_es_learns_sign_task(ray_tpu_start):
+    """ES improves the deterministic policy purely by parameter-space
+    search (ref: rllib/algorithms/es)."""
+    from ray_tpu.rllib import ESConfig
+
+    config = (
+        ESConfig()
+        .environment(_sign_env)
+        .env_runners(num_env_runners=2)
+        .debugging(seed=0)
+    )
+    config.episodes_per_batch = 12
+    config.sigma = 0.2
+    config.step_size = 0.2
+    config.episode_horizon = 30
+    algo = config.build()
+    try:
+        best = -31.0
+        for _ in range(25):
+            result = algo.train()
+            best = max(best, result["episode_reward_mean"])
+            if best > 24:
+                break
+        assert best > 24, best
+        assert result["episodes_total"] > 0
+    finally:
+        algo.stop()
+
+
+def test_ars_learns_sign_task(ray_tpu_start):
+    """ARS (top-k directions, std-normalized step) matches ES on the
+    toy task (ref: rllib/algorithms/ars)."""
+    from ray_tpu.rllib import ARSConfig
+
+    config = (
+        ARSConfig()
+        .environment(_sign_env)
+        .env_runners(num_env_runners=2)
+        .debugging(seed=0)
+    )
+    config.episodes_per_batch = 12
+    config.top_directions = 6
+    config.sigma = 0.2
+    config.step_size = 0.2
+    config.episode_horizon = 30
+    algo = config.build()
+    try:
+        best = -31.0
+        for _ in range(25):
+            result = algo.train()
+            best = max(best, result["episode_reward_mean"])
+            if best > 24:
+                break
+        assert best > 24, best
+    finally:
+        algo.stop()
+
+
+def test_flatten_roundtrip():
+    """ES flat-vector codec: unflatten(flatten(t)) == t."""
+    from ray_tpu.rllib.es import flatten_params, unflatten_params
+
+    rng = np.random.RandomState(0)
+    tree = {
+        "trunk": [(rng.randn(3, 4).astype("float32"),
+                   rng.randn(4).astype("float32")),
+                  (rng.randn(4, 2).astype("float32"),
+                   rng.randn(2).astype("float32"))],
+        "pi": [(rng.randn(2, 5).astype("float32"),
+                rng.randn(5).astype("float32"))],
+    }
+    vec, spec = flatten_params(tree)
+    back = unflatten_params(vec, spec)
+    for name in tree:
+        for (W, b), (W2, b2) in zip(tree[name], back[name]):
+            np.testing.assert_allclose(W, W2, rtol=1e-6)
+            np.testing.assert_allclose(b, b2, rtol=1e-6)
