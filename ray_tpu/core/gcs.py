@@ -29,6 +29,112 @@ from .config import Config, get_config
 from .ids import ActorID, NodeID, ObjectID
 from .protocol import AioFramedWriter as _FramedWriter
 from .protocol import aio_read_frame as _read_frame
+from .pubsub import ACTOR_STATE, ERROR_INFO, NODE_STATE, Publisher
+from .rpc import Method, RpcError, ServiceRegistry, ServiceSpec
+
+# Typed service surface (ref analogue: the 11 service blocks of
+# src/ray/protobuf/gcs_service.proto — NodeInfo:643, InternalKV:522,
+# Actor:163, PlacementGroup:400, InternalPubSub:595, ...). The registry
+# validates every inbound frame against these schemas before a handler
+# runs; `rpc_describe` returns them to clients (the .proto equivalent).
+GCS_SERVICES = (
+    ServiceSpec("NodeInfoService", (
+        Method("register_node",
+               request=(("host", "str"), ("peer_port", "int"),
+                        ("resources", "dict"),
+                        ("labels", "dict", False)),
+               reply=(("nodes", "list"),)),
+        Method("heartbeat",
+               request=(("available", "dict"), ("pending", "int"),
+                        ("shapes", "list", False)),
+               notify=True),
+        Method("get_nodes", reply=(("nodes", "list"),)),
+    )),
+    ServiceSpec("InternalKVService", (
+        Method("kv_put",
+               request=(("key", "str"), ("value", "any"),
+                        ("overwrite", "bool", False, True)),
+               reply=(("added", "bool"),)),
+        Method("kv_get",
+               request=(("key", "str"),
+                        ("wait_timeout", "float", False, 0)),
+               reply=(("value", "any"),)),
+        Method("kv_del", request=(("key", "str"),),
+               reply=(("deleted", "bool"),)),
+        Method("kv_keys", request=(("prefix", "str", False, ""),),
+               reply=(("keys", "list"),)),
+    )),
+    ServiceSpec("FunctionService", (
+        Method("register_function",
+               request=(("function_id", "str"), ("blob", "bytes")),
+               reply=(("ok", "bool"),)),
+        Method("fetch_function", request=(("function_id", "str"),),
+               reply=(("blob", "any"),)),
+    )),
+    ServiceSpec("ActorInfoService", (
+        Method("register_named_actor",
+               request=(("name", "str"), ("actor_id", "str"),
+                        ("node_id", "str"), ("spec", "any")),
+               reply=(("added", "bool"),)),
+        Method("get_named_actor", request=(("name", "str"),),
+               reply=(("found", "bool"), ("actor_id", "any"),
+                      ("node_id", "any"), ("spec", "any"))),
+        Method("drop_named_actor",
+               request=(("name", "str"), ("actor_id", "str")),
+               notify=True),
+        Method("register_actor_node",
+               request=(("actor_id", "str"), ("node_id", "str")),
+               notify=True),
+        Method("get_actor_node", request=(("actor_id", "str"),),
+               reply=(("node_id", "any"),)),
+    )),
+    ServiceSpec("ObjectDirectoryService", (
+        Method("publish_object", request=(("object_id", "any"),),
+               notify=True),
+        Method("unpublish_object", request=(("object_id", "any"),),
+               notify=True),
+        Method("locate_object",
+               request=(("object_id", "any"),
+                        ("timeout", "float", False, 0)),
+               reply=(("node_id", "any"),)),
+    )),
+    ServiceSpec("PlacementGroupService", (
+        Method("pg_create",
+               request=(("pg_id", "str"), ("bundles", "list"),
+                        ("strategy", "str"), ("name", "str", False, ""),
+                        ("label_selectors", "list", False)),
+               reply=(("ok", "bool"),)),
+        Method("pg_wait",
+               request=(("pg_id", "str"), ("timeout", "float")),
+               reply=(("ready", "bool"),)),
+        Method("pg_remove", request=(("pg_id", "str"),),
+               reply=(("ok", "bool"),)),
+        Method("pg_get", request=(("pg_id", "str"),),
+               reply=(("state", "str"), ("bundle_nodes", "any"))),
+        Method("pg_table", reply=(("table", "dict"),)),
+    )),
+    ServiceSpec("InternalPubSubService", (
+        Method("psub_subscribe",
+               request=(("subscriber_id", "str"), ("channels", "list")),
+               reply=(("ok", "bool"),)),
+        Method("psub_poll",
+               request=(("subscriber_id", "str"),
+                        ("timeout", "float", False, 30.0),
+                        ("max_events", "int", False, 1000)),
+               reply=(("events", "list"), ("dropped", "int"))),
+        Method("psub_publish",
+               request=(("channel", "str"), ("data", "any"),
+                        ("key", "str", False)),
+               reply=(("seq", "int"),)),
+        Method("psub_unsubscribe",
+               request=(("subscriber_id", "str"),
+                        ("channels", "list", False)),
+               notify=True),
+    )),
+    ServiceSpec("MetaService", (
+        Method("rpc_describe", reply=(("services", "dict"),)),
+    )),
+)
 
 
 @dataclass
@@ -106,6 +212,12 @@ class GcsService:
         # tables restorable across head restarts).
         self._storage_path: str = getattr(config, "gcs_storage_path", "")
         self._dirty = False
+        # General pubsub (ref: src/ray/pubsub/publisher.h) + the typed
+        # service registry all inbound frames dispatch through.
+        self.pubsub = Publisher()
+        self._rpc = ServiceRegistry()
+        for spec in GCS_SERVICES:
+            self._rpc.register(spec, self)
 
     # ------------------------------------------------------------------ boot
 
@@ -318,102 +430,137 @@ class GcsService:
     async def _dispatch(
         self, node_id: NodeID, msg: Dict[str, Any]
     ) -> Optional[Dict[str, Any]]:
-        op = msg["op"]
-        if op == "register_node":
-            return await self.register_node(
-                node_id,
-                msg["host"],
-                msg["peer_port"],
-                msg["resources"],
-                labels=msg.get("labels") or {},
-            )
-        if op == "heartbeat":
-            self.heartbeat(node_id, msg["available"], msg["pending"],
-                           msg.get("shapes"))
-            return None  # fire-and-forget
-        if op == "kv_put":
-            added = self.kv_put(msg["key"], msg["value"], msg.get("overwrite", True))
-            return {"added": added}
-        if op == "kv_get":
-            if msg.get("wait_timeout"):
-                value = await self.kv_wait(msg["key"], msg["wait_timeout"])
-            else:
-                value = self._kv.get(msg["key"])
-            return {"value": value}
-        if op == "kv_del":
-            deleted = self._kv.pop(msg["key"], None) is not None
-            if deleted:
-                self._dirty = True
-            return {"deleted": deleted}
-        if op == "kv_keys":
-            prefix = msg.get("prefix", "")
-            return {"keys": [k for k in self._kv if k.startswith(prefix)]}
-        if op == "register_function":
-            self._functions[msg["function_id"]] = msg["blob"]
+        """Typed dispatch: every inbound frame is validated against the
+        GCS_SERVICES schemas (unknown op / missing field / wrong type
+        raise RpcError back to the caller) and routed to its `_rpc_*`
+        handler by the registry."""
+        return await self._rpc.dispatch(node_id, msg["op"], msg)
+
+    # ------------------------------------------------- typed rpc handlers
+
+    async def _rpc_register_node(self, node_id, host, peer_port,
+                                 resources, labels=None):
+        return await self.register_node(
+            node_id, host, peer_port, resources, labels=labels or {}
+        )
+
+    async def _rpc_heartbeat(self, node_id, available, pending,
+                             shapes=None):
+        self.heartbeat(node_id, available, pending, shapes)
+
+    async def _rpc_get_nodes(self, node_id):
+        return {"nodes": [e.view() for e in self._nodes.values()]}
+
+    async def _rpc_kv_put(self, node_id, key, value, overwrite=True):
+        return {"added": self.kv_put(key, value, overwrite)}
+
+    async def _rpc_kv_get(self, node_id, key, wait_timeout=0):
+        if wait_timeout:
+            return {"value": await self.kv_wait(key, wait_timeout)}
+        return {"value": self._kv.get(key)}
+
+    async def _rpc_kv_del(self, node_id, key):
+        deleted = self._kv.pop(key, None) is not None
+        if deleted:
             self._dirty = True
-            return {"ok": True}
-        if op == "fetch_function":
-            return {"blob": self._functions.get(msg["function_id"])}
-        if op == "register_named_actor":
-            ok = self.register_named_actor(
-                msg["name"],
-                ActorID.from_hex(msg["actor_id"]),
-                NodeID.from_hex(msg["node_id"]),
-                msg["spec"],
+        return {"deleted": deleted}
+
+    async def _rpc_kv_keys(self, node_id, prefix=""):
+        return {"keys": [k for k in self._kv if k.startswith(prefix)]}
+
+    async def _rpc_register_function(self, node_id, function_id, blob):
+        self._functions[function_id] = blob
+        self._dirty = True
+        return {"ok": True}
+
+    async def _rpc_fetch_function(self, node_id, function_id):
+        return {"blob": self._functions.get(function_id)}
+
+    async def _rpc_register_named_actor(self, _ctx, name, actor_id,
+                                        node_id, spec=None):
+        ok = self.register_named_actor(
+            name, ActorID.from_hex(actor_id), NodeID.from_hex(node_id),
+            spec,
+        )
+        return {"added": ok}
+
+    async def _rpc_get_named_actor(self, node_id, name):
+        entry = self._named_actors.get(name)
+        if entry is None:
+            return {"found": False, "actor_id": None, "node_id": None,
+                    "spec": None}
+        aid, nid, spec = entry
+        return {"found": True, "actor_id": aid.hex(),
+                "node_id": nid.hex(), "spec": spec}
+
+    async def _rpc_drop_named_actor(self, node_id, name, actor_id):
+        cur = self._named_actors.get(name)
+        if cur is not None and cur[0].hex() == actor_id:
+            self._named_actors.pop(name, None)
+            self._dirty = True
+            self.pubsub.publish(
+                ACTOR_STATE,
+                {"event": "named_actor_dropped", "name": name,
+                 "actor_id": actor_id},
+                key=name,
             )
-            return {"added": ok}
-        if op == "get_named_actor":
-            entry = self._named_actors.get(msg["name"])
-            if entry is None:
-                return {"found": False}
-            aid, nid, spec = entry
-            return {
-                "found": True,
-                "actor_id": aid.hex(),
-                "node_id": nid.hex(),
-                "spec": spec,
-            }
-        if op == "drop_named_actor":
-            cur = self._named_actors.get(msg["name"])
-            if cur is not None and cur[0].hex() == msg["actor_id"]:
-                self._named_actors.pop(msg["name"], None)
-                self._dirty = True
-            return None
-        if op == "register_actor_node":
-            self._actor_nodes[ActorID.from_hex(msg["actor_id"])] = NodeID.from_hex(
-                msg["node_id"]
-            )
-            return None
-        if op == "get_actor_node":
-            nid = self._actor_nodes.get(ActorID.from_hex(msg["actor_id"]))
-            return {"node_id": nid.hex() if nid else None}
-        if op == "publish_object":
-            self.publish_object(msg["object_id"], node_id)
-            return None
-        if op == "unpublish_object":
-            self.unpublish_object(msg["object_id"], node_id)
-            return None
-        if op == "locate_object":
-            nid = await self.locate_object(msg["object_id"], msg.get("timeout", 0))
-            return {"node_id": nid.hex() if nid else None}
-        if op == "get_nodes":
-            return {"nodes": [e.view() for e in self._nodes.values()]}
-        if op == "pg_create":
-            await self.pg_create(
-                msg["pg_id"], msg["bundles"], msg["strategy"], msg.get("name", ""),
-                label_selectors=msg.get("label_selectors"),
-            )
-            return {"ok": True}
-        if op == "pg_wait":
-            return {"ready": await self.pg_wait(msg["pg_id"], msg["timeout"])}
-        if op == "pg_remove":
-            await self.pg_remove(msg["pg_id"])
-            return {"ok": True}
-        if op == "pg_get":
-            return self.pg_get(msg["pg_id"])
-        if op == "pg_table":
-            return {"table": self.pg_table()}
-        raise RuntimeError(f"unknown GCS op {op}")
+
+    async def _rpc_register_actor_node(self, _ctx, actor_id, node_id):
+        self._actor_nodes[ActorID.from_hex(actor_id)] = \
+            NodeID.from_hex(node_id)
+
+    async def _rpc_get_actor_node(self, node_id, actor_id):
+        nid = self._actor_nodes.get(ActorID.from_hex(actor_id))
+        return {"node_id": nid.hex() if nid else None}
+
+    async def _rpc_publish_object(self, node_id, object_id):
+        self.publish_object(object_id, node_id)
+
+    async def _rpc_unpublish_object(self, node_id, object_id):
+        self.unpublish_object(object_id, node_id)
+
+    async def _rpc_locate_object(self, node_id, object_id, timeout=0):
+        nid = await self.locate_object(object_id, timeout)
+        return {"node_id": nid.hex() if nid else None}
+
+    async def _rpc_pg_create(self, node_id, pg_id, bundles, strategy,
+                             name="", label_selectors=None):
+        await self.pg_create(pg_id, bundles, strategy, name,
+                             label_selectors=label_selectors)
+        return {"ok": True}
+
+    async def _rpc_pg_wait(self, node_id, pg_id, timeout):
+        return {"ready": await self.pg_wait(pg_id, timeout)}
+
+    async def _rpc_pg_remove(self, node_id, pg_id):
+        await self.pg_remove(pg_id)
+        return {"ok": True}
+
+    async def _rpc_pg_get(self, node_id, pg_id):
+        return self.pg_get(pg_id)
+
+    async def _rpc_pg_table(self, node_id):
+        return {"table": self.pg_table()}
+
+    async def _rpc_psub_subscribe(self, node_id, subscriber_id,
+                                  channels):
+        self.pubsub.subscribe(subscriber_id, channels)
+        return {"ok": True}
+
+    async def _rpc_psub_poll(self, node_id, subscriber_id, timeout=30.0,
+                             max_events=1000):
+        return await self.pubsub.poll(subscriber_id, timeout,
+                                      max_events)
+
+    async def _rpc_psub_publish(self, node_id, channel, data, key=None):
+        return {"seq": self.pubsub.publish(channel, data, key=key)}
+
+    async def _rpc_psub_unsubscribe(self, node_id, subscriber_id,
+                                    channels=None):
+        self.pubsub.unsubscribe(subscriber_id, channels)
+
+    async def _rpc_rpc_describe(self, node_id):
+        return {"services": self._rpc.describe()}
 
     # ------------------------------------------------------ placement groups
 
@@ -616,6 +763,10 @@ class GcsService:
         await self._broadcast(
             {"type": "node_added", "node": entry.view()}, exclude=node_id
         )
+        self.pubsub.publish(
+            NODE_STATE, {"event": "added", "node": entry.view()},
+            key=node_id.hex(),
+        )
         if self.on_node_added is not None:
             self.on_node_added(entry)
         # New capacity may unblock pending placement groups.
@@ -658,6 +809,7 @@ class GcsService:
                     continue
                 if now - entry.last_heartbeat > timeout:
                     await self._mark_node_dead(entry, "missed heartbeats")
+            self.pubsub.reap_idle()
 
     async def _mark_node_dead(self, entry: NodeEntry, reason: str):
         entry.state = "dead"
@@ -700,6 +852,12 @@ class GcsService:
                 "dead_actors": [a.hex() for a in dead_actors],
                 "invalid_pgs": invalid_pgs,
             }
+        )
+        self.pubsub.publish(
+            NODE_STATE,
+            {"event": "dead", "node_id": dead_hex, "reason": reason,
+             "dead_actors": [a.hex() for a in dead_actors]},
+            key=dead_hex,
         )
         if invalid_pgs and self.on_pgs_invalidated is not None:
             self.on_pgs_invalidated(invalid_pgs)
@@ -752,6 +910,12 @@ class GcsService:
             return existing[0] == actor_id
         self._named_actors[name] = (actor_id, node_id, spec)
         self._dirty = True
+        self.pubsub.publish(
+            ACTOR_STATE,
+            {"event": "named_actor_registered", "name": name,
+             "actor_id": actor_id.hex(), "node_id": node_id.hex()},
+            key=name,
+        )
         return True
 
     # --------------------------------------------------------------- objects
@@ -955,6 +1119,23 @@ class LocalGcsHandle:
     async def pg_table(self):
         return self._svc.pg_table()
 
+    async def psub_subscribe(self, subscriber_id, channels):
+        self._svc.pubsub.subscribe(subscriber_id, channels)
+
+    async def psub_poll(self, subscriber_id, timeout=30.0,
+                        max_events=1000):
+        return await self._svc.pubsub.poll(subscriber_id, timeout,
+                                           max_events)
+
+    async def psub_publish(self, channel, data, key=None) -> int:
+        return self._svc.pubsub.publish(channel, data, key=key)
+
+    async def psub_unsubscribe(self, subscriber_id, channels=None):
+        self._svc.pubsub.unsubscribe(subscriber_id, channels)
+
+    async def rpc_describe(self):
+        return self._svc._rpc.describe()
+
 
 class RemoteGcsHandle:
     """Remote node manager's view of the GCS over its client connection."""
@@ -1075,3 +1256,36 @@ class RemoteGcsHandle:
 
     async def pg_table(self):
         return (await self._client.request({"op": "pg_table"}))["table"]
+
+    async def psub_subscribe(self, subscriber_id, channels):
+        await self._client.request(
+            {"op": "psub_subscribe", "subscriber_id": subscriber_id,
+             "channels": list(channels)}
+        )
+
+    async def psub_poll(self, subscriber_id, timeout=30.0,
+                        max_events=1000):
+        r = await self._client.request(
+            {"op": "psub_poll", "subscriber_id": subscriber_id,
+             "timeout": timeout, "max_events": max_events},
+            timeout=timeout + 15.0,
+        )
+        return {"events": r["events"], "dropped": r["dropped"]}
+
+    async def psub_publish(self, channel, data, key=None) -> int:
+        r = await self._client.request(
+            {"op": "psub_publish", "channel": channel, "data": data,
+             "key": key}
+        )
+        return r["seq"]
+
+    async def psub_unsubscribe(self, subscriber_id, channels=None):
+        await self._client.notify(
+            {"op": "psub_unsubscribe", "subscriber_id": subscriber_id,
+             "channels": channels, "msg_id": None}
+        )
+
+    async def rpc_describe(self):
+        return (await self._client.request({"op": "rpc_describe"}))[
+            "services"
+        ]

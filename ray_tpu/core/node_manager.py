@@ -883,6 +883,9 @@ class NodeManager:
             self._on_tasks_reclaimed(w, msg)
         elif mtype == "kv":
             await self._handle_kv(w, msg)
+        elif mtype == "pubsub":
+            # Long-polls block; never hold up the worker's message loop.
+            asyncio.ensure_future(self._handle_pubsub(w, msg))
         elif mtype == "pg":
             asyncio.ensure_future(self._handle_pg(w, msg))
         elif mtype == "actor_direct":
@@ -3197,6 +3200,54 @@ class NodeManager:
             prefix = msg.get("prefix", "")
             out["keys"] = [k for k in self._kv if k.startswith(prefix)]
         await w.writer.send(out)
+
+    # -------------------------------------------------------- pubsub proxy
+
+    async def _handle_pubsub(self, w: WorkerHandle, msg):
+        """Driver/worker access to the GCS pubsub (ref analogue: workers
+        reach GCS pubsub through their raylet-side gcs client;
+        gcs_service.proto:595 InternalPubSub). The proxy keeps pubsub on
+        the same authenticated node↔GCS channel everything else uses."""
+        out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
+        try:
+            out.update(await self._pubsub_op(msg))
+        except Exception as e:
+            out["error"] = str(e)
+        try:
+            await w.writer.send(out)
+        except Exception:
+            pass
+
+    async def _pubsub_op(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        if self._gcs is None:
+            raise RuntimeError("pubsub requires the cluster GCS")
+        op = msg["op"]
+        if op == "subscribe":
+            await self._gcs.psub_subscribe(
+                msg["subscriber_id"], msg["channels"]
+            )
+            return {"ok": True}
+        if op == "poll":
+            return await self._gcs.psub_poll(
+                msg["subscriber_id"], msg.get("timeout", 30.0),
+                msg.get("max_events", 1000),
+            )
+        if op == "publish":
+            return {"seq": await self._gcs.psub_publish(
+                msg["channel"], msg["data"], key=msg.get("key")
+            )}
+        if op == "unsubscribe":
+            await self._gcs.psub_unsubscribe(
+                msg["subscriber_id"], msg.get("channels")
+            )
+            return {"ok": True}
+        if op == "describe":
+            return {"services": await self._gcs.rpc_describe()}
+        raise RuntimeError(f"unknown pubsub op {op}")
+
+    def pubsub_op(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Sync entry for the in-process driver runtime."""
+        return self.call_sync(self._pubsub_op(msg))
 
     # ------------------------------------------------- placement-group proxy
 

@@ -1,0 +1,129 @@
+"""General pubsub: channels, per-subscriber queues, long-poll delivery.
+
+Plays the role of the reference's pubsub layer (ref:
+src/ray/pubsub/publisher.h Publisher — per-subscriber long-poll queues
+with bounded buffers; subscriber.h Subscriber; channel ids in
+common.proto's PubsubChannelType: object locations, actor state, node
+state, logs, errors). The GCS owns one ``Publisher``; events flow in
+from the control plane (node joins/deaths, named-actor changes, error
+reports, user publishes) and out through ``poll`` long-polls issued by
+subscribers anywhere in the cluster (drivers reach it through their
+node manager's proxy op).
+
+Delivery semantics match the reference: per-subscriber FIFO with a
+bounded buffer — a subscriber that stops polling loses OLDEST events
+first and the drop is counted, never silently."""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+# Built-in channels (ref: PubsubChannelType in common.proto).
+NODE_STATE = "node_state"
+ACTOR_STATE = "actor_state"
+ERROR_INFO = "error_info"
+LOGS = "logs"
+
+
+class _Subscription:
+    __slots__ = ("channels", "queue", "event", "dropped", "last_poll")
+
+    def __init__(self, channels, maxlen: int):
+        self.channels = set(channels)
+        self.queue: deque = deque(maxlen=maxlen)
+        self.event = asyncio.Event()
+        self.dropped = 0
+        self.last_poll = time.monotonic()
+
+
+class Publisher:
+    """Channel fan-out with per-subscriber bounded FIFO queues."""
+
+    def __init__(self, max_queue: int = 10_000,
+                 idle_timeout_s: float = 300.0):
+        self._subs: Dict[str, _Subscription] = {}
+        self._seq = itertools.count(1)
+        self._max_queue = max_queue
+        self._idle_timeout_s = idle_timeout_s
+
+    def subscribe(self, subscriber_id: str, channels: List[str]) -> None:
+        sub = self._subs.get(subscriber_id)
+        if sub is None:
+            self._subs[subscriber_id] = _Subscription(
+                channels, self._max_queue
+            )
+        else:
+            sub.channels.update(channels)
+
+    def unsubscribe(self, subscriber_id: str,
+                    channels: Optional[List[str]] = None) -> None:
+        sub = self._subs.get(subscriber_id)
+        if sub is None:
+            return
+        if channels is None:
+            self._subs.pop(subscriber_id, None)
+            return
+        sub.channels -= set(channels)
+        if not sub.channels:
+            self._subs.pop(subscriber_id, None)
+
+    def publish(self, channel: str, data: Any,
+                key: Optional[str] = None) -> int:
+        """Fan out to every subscriber of ``channel``; returns the event
+        sequence number (0 when nobody was listening)."""
+        seq = 0
+        event = None
+        for sub in self._subs.values():
+            if channel not in sub.channels:
+                continue
+            if event is None:
+                seq = next(self._seq)
+                event = {"seq": seq, "channel": channel, "key": key,
+                         "data": data, "ts": time.time()}
+            if len(sub.queue) == sub.queue.maxlen:
+                sub.dropped += 1
+            sub.queue.append(event)
+            sub.event.set()
+        return seq
+
+    async def poll(self, subscriber_id: str, timeout: float = 30.0,
+                   max_events: int = 1000) -> Dict[str, Any]:
+        """Long-poll: returns buffered events immediately, else waits up
+        to ``timeout`` for the next publish (ref: the
+        PubsubLongPolling RPC, core_worker.proto:441 /
+        GcsSubscriberPoll, gcs_service.proto:602)."""
+        sub = self._subs.get(subscriber_id)
+        if sub is None:
+            return {"events": [], "dropped": 0, "unknown": True}
+        sub.last_poll = time.monotonic()
+        if not sub.queue:
+            sub.event.clear()
+            try:
+                await asyncio.wait_for(sub.event.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+        events = []
+        while sub.queue and len(events) < max_events:
+            events.append(sub.queue.popleft())
+        dropped, sub.dropped = sub.dropped, 0
+        return {"events": events, "dropped": dropped}
+
+    def reap_idle(self) -> int:
+        """Drop subscriptions that stopped polling (dead clients); the
+        GCS calls this from its health loop."""
+        now = time.monotonic()
+        stale = [sid for sid, sub in self._subs.items()
+                 if now - sub.last_poll > self._idle_timeout_s]
+        for sid in stale:
+            self._subs.pop(sid, None)
+        return len(stale)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "subscribers": len(self._subs),
+            "queued": sum(len(s.queue) for s in self._subs.values()),
+        }

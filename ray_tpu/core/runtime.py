@@ -957,6 +957,9 @@ class DriverRuntime(BaseRuntime):
     def kv_get(self, key: str) -> Optional[bytes]:
         return self._nm.kv_get(key)
 
+    def pubsub_op(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        return self._nm.pubsub_op(dict(msg))
+
     def kv_keys(self, prefix: str = "") -> List[str]:
         return self._nm.kv_keys(prefix)
 
@@ -1160,6 +1163,13 @@ class WorkerRuntime(BaseRuntime):
     def kv_del(self, key: str) -> bool:
         return self.request({"type": "kv", "op": "del",
                              "key": key})["deleted"]
+
+    def pubsub_op(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        timeout = msg.get("timeout", 30.0) + 15.0
+        reply = self.request({**msg, "type": "pubsub"}, timeout=timeout)
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return reply
 
     def get_named_actor_spec(self, name: str):
         reply = self.request({"type": "get_named_actor", "name": name})

@@ -16,12 +16,14 @@ from ray_tpu.core.scheduling_strategies import (  # noqa: F401
     PlacementGroupSchedulingStrategy,
 )
 from . import metrics  # noqa: F401
+from . import pubsub  # noqa: F401
 from . import state  # noqa: F401
 from .actor_pool import ActorPool  # noqa: F401
 from . import queue  # noqa: F401
 
 __all__ = [
     "state",
+    "pubsub",
     "ActorPool",
     "queue",
     "PlacementGroup",

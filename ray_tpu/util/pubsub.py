@@ -1,0 +1,99 @@
+"""Driver/worker-side pubsub subscriber API.
+
+Ref analogue: python/ray/_private/gcs_pubsub.py (GcsSubscriber family —
+the sync long-poll clients the dashboard, log monitor and autoscaler
+use). A ``Subscriber`` registers a server-side queue on the GCS
+publisher (core/pubsub.py) through the node manager's authenticated
+proxy channel and drains it with blocking ``poll`` calls; ``publish``
+fans a user event out to every subscriber of the channel.
+
+Built-in channels: ``node_state`` (node added/dead), ``actor_state``
+(named-actor registered/dropped), ``error_info``, ``logs`` — plus any
+user-chosen channel name.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..core.pubsub import ACTOR_STATE, ERROR_INFO, LOGS, NODE_STATE  # noqa: F401
+
+
+def _runtime():
+    from ..core import runtime_context
+
+    return runtime_context.current_runtime()
+
+
+def publish(channel: str, data: Any, key: Optional[str] = None) -> int:
+    """Publish ``data`` to every subscriber of ``channel``; returns the
+    event's sequence number (0 when nobody is subscribed)."""
+    return _runtime().pubsub_op(
+        {"op": "publish", "channel": channel, "data": data, "key": key}
+    )["seq"]
+
+
+def describe_services() -> Dict[str, Any]:
+    """The GCS's typed service schemas (the .proto equivalent)."""
+    return _runtime().pubsub_op({"op": "describe"})["services"]
+
+
+class Subscriber:
+    """Blocking subscriber over the cluster pubsub.
+
+    >>> sub = Subscriber(channels=["node_state"])
+    >>> events = sub.poll(timeout=5.0)   # [] on timeout
+    >>> sub.close()
+    """
+
+    def __init__(self, channels: List[str],
+                 subscriber_id: Optional[str] = None):
+        self.subscriber_id = subscriber_id or uuid.uuid4().hex
+        self._channels = list(channels)
+        _runtime().pubsub_op({
+            "op": "subscribe", "subscriber_id": self.subscriber_id,
+            "channels": self._channels,
+        })
+        self._closed = False
+        self.dropped_total = 0
+
+    def poll(self, timeout: float = 30.0,
+             max_events: int = 1000) -> List[Dict[str, Any]]:
+        """Long-poll: returns buffered events, or [] after ``timeout``
+        with nothing published. Each event is
+        {seq, channel, key, data, ts}."""
+        if self._closed:
+            raise RuntimeError("subscriber closed")
+        reply = _runtime().pubsub_op({
+            "op": "poll", "subscriber_id": self.subscriber_id,
+            "timeout": timeout, "max_events": max_events,
+        })
+        self.dropped_total += reply.get("dropped", 0)
+        return reply["events"]
+
+    def subscribe(self, channels: List[str]):
+        """Add channels to this subscription."""
+        self._channels.extend(channels)
+        _runtime().pubsub_op({
+            "op": "subscribe", "subscriber_id": self.subscriber_id,
+            "channels": list(channels),
+        })
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            _runtime().pubsub_op({
+                "op": "unsubscribe",
+                "subscriber_id": self.subscriber_id, "channels": None,
+            })
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
