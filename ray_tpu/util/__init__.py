@@ -15,9 +15,13 @@ from ray_tpu.core.scheduling_strategies import (  # noqa: F401
     NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
+from . import check_serialize  # noqa: F401
+from . import iter  # noqa: F401
 from . import metrics  # noqa: F401
+from . import multiprocessing  # noqa: F401
 from . import pubsub  # noqa: F401
 from . import state  # noqa: F401
+from . import tqdm  # noqa: F401
 from .actor_pool import ActorPool  # noqa: F401
 from . import queue  # noqa: F401
 

@@ -1,0 +1,93 @@
+"""Serializability inspector.
+
+Ref analogue: python/ray/util/check_serialize.py
+``inspect_serializability`` — when a task/actor argument fails to
+pickle, walk its closure/attributes and report WHICH inner member is
+the culprit instead of surfacing cloudpickle's opaque error.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional, Set, Tuple
+
+import cloudpickle
+
+
+class FailureTuple:
+    """One unserializable member: the object, its name, and the parent
+    that carried it."""
+
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailureTuple(obj={self.obj!r}, name={self.name!r})"
+
+
+def _try_pickle(obj: Any) -> Optional[Exception]:
+    try:
+        cloudpickle.dumps(obj)
+        return None
+    except Exception as e:
+        return e
+
+
+def _scan_children(obj: Any):
+    """(name, child) pairs worth blaming: closure cells, globals used
+    by the function, instance attributes."""
+    if inspect.isfunction(obj):
+        if obj.__closure__:
+            for name, cell in zip(obj.__code__.co_freevars,
+                                  obj.__closure__):
+                try:
+                    yield name, cell.cell_contents
+                except ValueError:
+                    pass
+        for name in obj.__code__.co_names:
+            if name in obj.__globals__:
+                yield name, obj.__globals__[name]
+    elif hasattr(obj, "__dict__") and isinstance(obj.__dict__, dict):
+        yield from obj.__dict__.items()
+
+
+def inspect_serializability(
+    obj: Any, name: Optional[str] = None, depth: int = 3,
+    _failures: Optional[list] = None, _seen: Optional[Set[int]] = None,
+    print_report: bool = True,
+) -> Tuple[bool, list]:
+    """Returns (serializable, [FailureTuple...]); recursively descends
+    into the members of unserializable objects to find leaf culprits."""
+    top = _failures is None
+    failures = [] if top else _failures
+    seen = set() if _seen is None else _seen
+    name = name or getattr(obj, "__name__", repr(obj)[:40])
+
+    err = _try_pickle(obj)
+    if err is None:
+        return True, failures
+
+    if id(obj) in seen:
+        return False, failures
+    seen.add(id(obj))
+
+    blamed_child = False
+    if depth > 0:
+        for child_name, child in _scan_children(obj):
+            if _try_pickle(child) is not None:
+                blamed_child = True
+                ok, _ = inspect_serializability(
+                    child, name=child_name, depth=depth - 1,
+                    _failures=failures, _seen=seen,
+                    print_report=False,
+                )
+    if not blamed_child:
+        failures.append(FailureTuple(obj, name, parent=None))
+
+    if top and print_report:
+        print(f"Serialization check for {name!r}: FAILED ({err})")
+        for f in failures:
+            print(f"  culprit: {f.name} = {f.obj!r}")
+    return False, failures

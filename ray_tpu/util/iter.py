@@ -1,0 +1,235 @@
+"""ParallelIterator: lazy sharded iterators over cluster actors.
+
+Ref analogue: python/ray/util/iter.py — ``from_items/from_range/
+from_iterators`` build a sharded iterator; ``for_each/filter/batch/
+flatten`` chain lazily; ``gather_sync/gather_async`` materialize shard
+actors and pull items to the driver (sync = round-robin order,
+async = completion order). The heavier data plane lives in
+ray_tpu.data; this is the lightweight actor-iterator utility the
+reference keeps alongside it.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+_STOP = "__parallel_iter_stop__"
+
+
+class _ShardActor:
+    """Owns one shard's source iterator + the op chain."""
+
+    def __init__(self, builder_blob: bytes):
+        import cloudpickle
+
+        builder, ops = cloudpickle.loads(builder_blob)
+        it = iter(builder())
+        for kind, fn in ops:
+            if kind == "for_each":
+                it = map(fn, it)
+            elif kind == "filter":
+                it = filter(fn, it)
+            elif kind == "flatten":
+                it = (x for batch in it for x in batch)
+            elif kind == "batch":
+                it = self._batched(it, fn)
+            else:
+                raise ValueError(f"unknown op {kind}")
+        self._it = it
+
+    @staticmethod
+    def _batched(it: Iterator, n: int) -> Iterator[List[Any]]:
+        while True:
+            chunk = list(itertools.islice(it, n))
+            if not chunk:
+                return
+            yield chunk
+
+    def next_items(self, n: int) -> List[Any]:
+        """Up to n items; trailing _STOP marks exhaustion."""
+        out = list(itertools.islice(self._it, n))
+        if len(out) < n:
+            out.append(_STOP)
+        return out
+
+
+class LocalIterator:
+    """Driver-side iterator over gathered shard output."""
+
+    def __init__(self, gen: Iterable):
+        self._gen = iter(gen)
+
+    def __iter__(self):
+        return self._gen
+
+    def __next__(self):
+        return next(self._gen)
+
+    def take(self, n: int) -> List[Any]:
+        return list(itertools.islice(self._gen, n))
+
+
+class ParallelIterator:
+    def __init__(self, builders: List[Callable[[], Iterable]],
+                 ops: Optional[List] = None):
+        self._builders = builders
+        self._ops = list(ops or [])
+
+    # -- constructors -------------------------------------------------
+
+    @staticmethod
+    def from_items(items: List[Any],
+                   num_shards: int = 2) -> "ParallelIterator":
+        shards: List[List[Any]] = [[] for _ in range(num_shards)]
+        for i, x in enumerate(items):
+            shards[i % num_shards].append(x)
+        return ParallelIterator(
+            [(lambda s=s: list(s)) for s in shards]
+        )
+
+    @staticmethod
+    def from_range(n: int, num_shards: int = 2) -> "ParallelIterator":
+        def make(shard):
+            return lambda: range(shard, n, num_shards)
+
+        return ParallelIterator([make(s) for s in range(num_shards)])
+
+    @staticmethod
+    def from_iterators(generators: List[Callable[[], Iterable]]
+                       ) -> "ParallelIterator":
+        return ParallelIterator(list(generators))
+
+    # -- lazy transforms ----------------------------------------------
+
+    def _chain(self, kind: str, fn) -> "ParallelIterator":
+        return ParallelIterator(self._builders,
+                                self._ops + [(kind, fn)])
+
+    def for_each(self, fn: Callable[[Any], Any]) -> "ParallelIterator":
+        return self._chain("for_each", fn)
+
+    def filter(self, fn: Callable[[Any], bool]) -> "ParallelIterator":
+        return self._chain("filter", fn)
+
+    def batch(self, n: int) -> "ParallelIterator":
+        return self._chain("batch", n)
+
+    def flatten(self) -> "ParallelIterator":
+        return self._chain("flatten", None)
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        """Valid when both sides share the same op chain (gather first
+        otherwise — ops apply per-shard)."""
+        if self._ops != other._ops:
+            raise ValueError(
+                "union requires identical op chains; call gather first"
+            )
+        return ParallelIterator(self._builders + other._builders,
+                                self._ops)
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._builders)
+
+    # -- materialization ----------------------------------------------
+
+    def _spawn(self):
+        import cloudpickle
+
+        import ray_tpu
+
+        actor_cls = ray_tpu.remote(_ShardActor)
+        return [
+            actor_cls.remote(cloudpickle.dumps((b, self._ops)))
+            for b in self._builders
+        ]
+
+    def gather_sync(self, batch: int = 64) -> LocalIterator:
+        """Round-robin over shards, preserving per-shard order."""
+        import ray_tpu
+
+        actors = self._spawn()
+
+        def gen():
+            try:
+                live = {i: a for i, a in enumerate(actors)}
+                buffers = {
+                    i: a.next_items.remote(batch)
+                    for i, a in live.items()
+                }
+                while live:
+                    for i in sorted(list(live)):
+                        if i not in live:
+                            continue
+                        items = ray_tpu.get(buffers[i])
+                        done = items and items[-1] == _STOP
+                        if done:
+                            items = items[:-1]
+                            del live[i]
+                            del buffers[i]
+                        else:
+                            buffers[i] = live[i].next_items.remote(
+                                batch
+                            )
+                        for x in items:
+                            yield x
+            finally:
+                for a in actors:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:
+                        pass
+
+        return LocalIterator(gen())
+
+    def gather_async(self, batch: int = 64) -> LocalIterator:
+        """Completion order across shards (faster shards stream first)."""
+        import ray_tpu
+
+        actors = self._spawn()
+
+        def gen():
+            try:
+                owner = {}
+                for a in actors:
+                    ref = a.next_items.remote(batch)
+                    owner[ref] = a
+                while owner:
+                    ready, _ = ray_tpu.wait(list(owner), num_returns=1)
+                    ref = ready[0]
+                    a = owner.pop(ref)
+                    items = ray_tpu.get(ref)
+                    if items and items[-1] == _STOP:
+                        items = items[:-1]
+                    else:
+                        owner[a.next_items.remote(batch)] = a
+                    for x in items:
+                        yield x
+            finally:
+                for a in actors:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:
+                        pass
+
+        return LocalIterator(gen())
+
+    def take(self, n: int) -> List[Any]:
+        return self.gather_sync().take(n)
+
+    def count(self) -> int:
+        return sum(1 for _ in self.gather_sync())
+
+    def show(self, n: int = 20):
+        for x in self.take(n):
+            print(x)
+
+    def __repr__(self):
+        return (f"ParallelIterator[{self.num_shards} shards, "
+                f"{len(self._ops)} ops]")
+
+
+from_items = ParallelIterator.from_items
+from_range = ParallelIterator.from_range
+from_iterators = ParallelIterator.from_iterators

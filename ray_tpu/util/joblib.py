@@ -1,0 +1,56 @@
+"""joblib backend: scikit-learn parallelism on the cluster.
+
+Ref analogue: python/ray/util/joblib/ (register_ray +
+ray_backend.RayBackend subclassing joblib's MultiprocessingBackend
+over ray.util.multiprocessing.Pool). After ``register_ray()``,
+
+    import joblib
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        GridSearchCV(...).fit(X, y)   # fans out as cluster tasks
+
+any joblib.Parallel user (scikit-learn's n_jobs plumbing included)
+runs its batches as cluster tasks through the Pool shim.
+"""
+
+from __future__ import annotations
+
+
+def register_ray() -> None:
+    """Register the ``"ray_tpu"`` joblib parallel backend."""
+    from joblib._parallel_backends import MultiprocessingBackend
+    from joblib.parallel import register_parallel_backend
+
+    from .multiprocessing import Pool
+
+    class RayTpuBackend(MultiprocessingBackend):
+        supports_timeout = True
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            cpus = max(1, int(
+                ray_tpu.cluster_resources().get("CPU", 1)
+            ))
+            if n_jobs is None or n_jobs == -1:
+                return cpus
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            return min(n_jobs, cpus) if n_jobs > 0 else cpus
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **memmapping_args):
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+        def terminate(self):
+            if getattr(self, "_pool", None) is not None:
+                self._pool.terminate()
+                self._pool = None
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,243 @@
+"""multiprocessing.Pool API over cluster tasks.
+
+Ref analogue: python/ray/util/multiprocessing/pool.py — a drop-in
+``Pool`` whose workers are cluster actors instead of forked processes,
+so a pool can span nodes and survives with the cluster's fault
+handling. API parity targets the stdlib surface the reference covers:
+apply/apply_async, map/map_async, starmap/starmap_async,
+imap/imap_unordered (chunked, lazy), close/terminate/join, context
+manager.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+
+class TimeoutError(Exception):  # noqa: A001 - stdlib-compatible name
+    pass
+
+
+class AsyncResult:
+    """multiprocessing.pool.AsyncResult-compatible handle over object
+    refs; ``_collect`` post-processes the chunked results."""
+
+    def __init__(self, refs: List[Any],
+                 collect: Optional[Callable[[List[Any]], Any]] = None,
+                 callback: Optional[Callable[[Any], None]] = None,
+                 error_callback: Optional[Callable[[Exception], None]]
+                 = None):
+        self._refs = refs
+        self._collect = collect or (lambda parts: parts)
+        self._value = None
+        self._error: Optional[Exception] = None
+        self._done = False
+        self._lock = threading.Lock()
+        self._callback = callback
+        self._error_callback = error_callback
+        if callback is not None or error_callback is not None:
+            # multiprocessing fires callbacks from a result thread the
+            # moment work lands (joblib's dispatch depends on it) — not
+            # lazily inside get().
+            threading.Thread(target=self._resolve, daemon=True).start()
+
+    def _resolve(self, timeout: Optional[float] = None):
+        with self._lock:
+            if self._done:
+                return
+            import ray_tpu
+
+            try:
+                parts = ray_tpu.get(self._refs, timeout=timeout)
+                self._value = self._collect(parts)
+                if self._callback is not None:
+                    self._callback(self._value)
+            except Exception as e:
+                from ray_tpu.core.exceptions import GetTimeoutError
+
+                if isinstance(e, GetTimeoutError):
+                    raise TimeoutError(str(e)) from e
+                self._error = e
+                if self._error_callback is not None:
+                    self._error_callback(e)
+            self._done = True
+
+    def get(self, timeout: Optional[float] = None):
+        self._resolve(timeout)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def wait(self, timeout: Optional[float] = None):
+        try:
+            self._resolve(timeout)
+        except TimeoutError:
+            pass
+
+    def ready(self) -> bool:
+        if self._done:
+            return True
+        import ray_tpu
+
+        ready, _ = ray_tpu.wait(self._refs,
+                                num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self._done:
+            raise ValueError("result is not ready")
+        return self._error is None
+
+
+def _chunks(seq: Sequence, size: int):
+    it = iter(seq)
+    while True:
+        chunk = list(itertools.islice(it, size))
+        if not chunk:
+            return
+        yield chunk
+
+
+class Pool:
+    """Task-backed process pool. ``processes`` bounds in-flight chunks
+    (the cluster scheduler does the real placement)."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = (),
+                 ray_remote_args: Optional[dict] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cpus = ray_tpu.cluster_resources().get("CPU", 1)
+        self._processes = processes or max(1, int(cpus))
+        self._initializer = initializer
+        self._initargs = initargs
+        self._remote_args = dict(ray_remote_args or {})
+        self._closed = False
+
+    # -- internals ----------------------------------------------------
+
+    def _submit_chunk(self, func, chunk, star: bool):
+        import ray_tpu
+
+        initializer = self._initializer
+        initargs = self._initargs
+
+        def run_chunk(items):
+            if initializer is not None:
+                initializer(*initargs)
+            if star:
+                return [func(*args) for args in items]
+            return [func(x) for x in items]
+
+        opts = self._remote_args
+        task = (ray_tpu.remote(**opts)(run_chunk) if opts
+                else ray_tpu.remote(run_chunk))
+        return task.remote(chunk)
+
+    def _map_refs(self, func, iterable, chunksize, star):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [self._submit_chunk(func, c, star)
+                for c in _chunks(items, chunksize)]
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    # -- public api ---------------------------------------------------
+
+    def apply(self, func, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args: tuple = (),
+                    kwds: Optional[dict] = None,
+                    callback=None, error_callback=None) -> AsyncResult:
+        self._check_open()
+        import ray_tpu
+
+        kwds = kwds or {}
+
+        def call():
+            return func(*args, **kwds)
+
+        ref = ray_tpu.remote(call).remote()
+        return AsyncResult([ref], collect=lambda parts: parts[0],
+                           callback=callback,
+                           error_callback=error_callback)
+
+    def map(self, func, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func, iterable: Iterable,
+                  chunksize: Optional[int] = None,
+                  callback=None, error_callback=None) -> AsyncResult:
+        self._check_open()
+        refs = self._map_refs(func, iterable, chunksize, star=False)
+        return AsyncResult(
+            refs,
+            collect=lambda parts: [x for c in parts for x in c],
+            callback=callback, error_callback=error_callback,
+        )
+
+    def starmap(self, func, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        return self.starmap_async(func, iterable, chunksize).get()
+
+    def starmap_async(self, func, iterable: Iterable,
+                      chunksize: Optional[int] = None,
+                      callback=None, error_callback=None) -> AsyncResult:
+        self._check_open()
+        refs = self._map_refs(func, iterable, chunksize, star=True)
+        return AsyncResult(
+            refs,
+            collect=lambda parts: [x for c in parts for x in c],
+            callback=callback, error_callback=error_callback,
+        )
+
+    def imap(self, func, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        """Lazy ordered iterator; chunks resolve as they finish but
+        yield in submission order."""
+        self._check_open()
+        import ray_tpu
+
+        refs = self._map_refs(func, iterable, chunksize, star=False)
+        for ref in refs:
+            for x in ray_tpu.get(ref):
+                yield x
+
+    def imap_unordered(self, func, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        """Lazy unordered iterator: chunks yield in COMPLETION order."""
+        self._check_open()
+        import ray_tpu
+
+        pending = self._map_refs(func, iterable, chunksize, star=False)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            for x in ray_tpu.get(ready[0]):
+                yield x
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

@@ -1,0 +1,176 @@
+"""Distributed progress bars.
+
+Ref analogue: python/ray/experimental/tqdm_ray.py — workers cannot
+draw terminal bars, so a worker-side ``tqdm`` proxy ships structured
+progress updates to the driver, which renders real bars. The
+reference routes updates through magic-token log lines and the log
+monitor; here they ride the cluster pubsub (util/pubsub.py, channel
+``tqdm``) — same shape, authenticated transport.
+
+Worker side:
+    from ray_tpu.util import tqdm as tqdm_ray
+    for x in tqdm_ray.tqdm(items, desc="shard"):
+        ...
+
+Driver side (optional live rendering):
+    with tqdm_ray.driver_progress():
+        ray_tpu.get(futs)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterable, Optional
+
+CHANNEL = "tqdm"
+
+
+class tqdm:  # noqa: N801 - mirrors the tqdm API name
+    """Worker-side progress proxy; publishes rate-limited updates."""
+
+    def __init__(self, iterable: Optional[Iterable] = None,
+                 desc: str = "", total: Optional[int] = None,
+                 position: Optional[int] = None,
+                 flush_interval_s: float = 0.2):
+        self._iterable = iterable
+        self.desc = desc
+        if total is None and iterable is not None:
+            try:
+                total = len(iterable)  # type: ignore[arg-type]
+            except TypeError:
+                total = None
+        self.total = total
+        self.position = position
+        self.n = 0
+        self._bar_id = uuid.uuid4().hex[:12]
+        self._interval = flush_interval_s
+        self._last_flush = 0.0
+        self._closed = False
+        self._flush(force=True)
+
+    def _flush(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_flush < self._interval:
+            return
+        self._last_flush = now
+        try:
+            from .pubsub import publish
+
+            publish(CHANNEL, {
+                "bar_id": self._bar_id, "desc": self.desc,
+                "total": self.total, "n": self.n,
+                "closed": self._closed, "pos": self.position,
+            }, key=self._bar_id)
+        except Exception:
+            pass  # progress must never break the workload
+
+    def update(self, n: int = 1):
+        self.n += n
+        self._flush()
+
+    def set_description(self, desc: str):
+        self.desc = desc
+        self._flush()
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._flush(force=True)
+
+    def __iter__(self):
+        if self._iterable is None:
+            raise TypeError("this tqdm was not given an iterable")
+        try:
+            for x in self._iterable:
+                yield x
+                self.update(1)
+        finally:
+            self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _DriverRenderer:
+    """Subscribes to the tqdm channel and renders real tqdm bars."""
+
+    def __init__(self, render: bool = True):
+        self._render = render
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.bars: Dict[str, Any] = {}
+        self.state: Dict[str, Dict[str, Any]] = {}
+
+    def start(self):
+        from .pubsub import Subscriber
+
+        self._sub = Subscriber(channels=[CHANNEL])
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                events = self._sub.poll(timeout=0.5)
+            except Exception:
+                return
+            for e in events:
+                self._apply(e["data"])
+
+    def _apply(self, d: Dict[str, Any]):
+        bar_id = d["bar_id"]
+        self.state[bar_id] = d
+        if not self._render:
+            return
+        try:
+            import tqdm as real_tqdm
+
+            bar = self.bars.get(bar_id)
+            if bar is None and not d["closed"]:
+                bar = real_tqdm.tqdm(
+                    desc=d["desc"], total=d["total"],
+                    position=d.get("pos"),
+                )
+                self.bars[bar_id] = bar
+            if bar is not None:
+                bar.n = d["n"]
+                bar.set_description(d["desc"], refresh=False)
+                bar.refresh()
+                if d["closed"]:
+                    bar.close()
+                    self.bars.pop(bar_id, None)
+        except Exception:
+            self._render = False
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        try:
+            self._sub.close()
+        except Exception:
+            pass
+        for bar in self.bars.values():
+            try:
+                bar.close()
+            except Exception:
+                pass
+
+
+class driver_progress:  # noqa: N801 - context-manager style
+    """Context manager running the driver-side renderer."""
+
+    def __init__(self, render: bool = True):
+        self._renderer = _DriverRenderer(render)
+
+    def __enter__(self) -> _DriverRenderer:
+        return self._renderer.start()
+
+    def __exit__(self, *exc):
+        self._renderer.stop()

@@ -1,0 +1,166 @@
+"""util misc: multiprocessing Pool, ParallelIterator, joblib backend,
+check_serialize, distributed tqdm.
+
+Ref analogues: python/ray/util/multiprocessing/pool.py, util/iter.py,
+util/joblib/, util/check_serialize.py, experimental/tqdm_ray.py.
+"""
+
+import sys as _sys
+import threading
+import time
+
+import cloudpickle as _cloudpickle
+import pytest
+
+_cloudpickle.register_pickle_by_value(_sys.modules[__name__])
+
+
+def _square(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_pool_map_and_starmap(ray_tpu_start):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(_square, range(10)) == [x * x for x in range(10)]
+        assert pool.map(_square, range(7), chunksize=3) == \
+            [x * x for x in range(7)]
+        assert pool.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(_add, (20, 22)) == 42
+
+
+def test_pool_async_and_imap(ray_tpu_start):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        r = pool.map_async(_square, range(6))
+        assert r.get(timeout=30) == [0, 1, 4, 9, 16, 25]
+        assert r.ready() and r.successful()
+
+        got = list(pool.imap(_square, range(8), chunksize=2))
+        assert got == [x * x for x in range(8)]
+        unordered = sorted(pool.imap_unordered(_square, range(8),
+                                               chunksize=2))
+        assert unordered == sorted(x * x for x in range(8))
+
+        # callbacks fire without an explicit get()
+        hit = threading.Event()
+        pool.apply_async(_add, (1, 1), callback=lambda v: hit.set())
+        assert hit.wait(timeout=30)
+
+
+def test_pool_error_paths(ray_tpu_start):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def boom(x):
+        raise ValueError("boom")
+
+    pool = Pool(processes=1)
+    with pytest.raises(Exception, match="boom"):
+        pool.map(boom, [1])
+    r = pool.map_async(boom, [1])
+    r.wait(timeout=30)
+    assert r.ready() and not r.successful()
+    pool.close()
+    with pytest.raises(ValueError):
+        pool.map(_square, [1])
+    pool.join()
+
+
+def test_parallel_iterator(ray_tpu_start):
+    from ray_tpu.util import iter as par_iter
+
+    it = (par_iter.from_range(20, num_shards=3)
+          .for_each(lambda x: x * 2)
+          .filter(lambda x: x % 4 == 0))
+    got = sorted(it.gather_sync())
+    assert got == sorted(x * 2 for x in range(20) if (x * 2) % 4 == 0)
+
+    # async gather: same multiset, completion order
+    got2 = sorted(it.gather_async())
+    assert got2 == got
+
+    # batch + flatten round-trip
+    b = par_iter.from_items(list(range(10)), num_shards=2).batch(3)
+    batches = list(b.gather_sync())
+    assert all(isinstance(x, list) for x in batches)
+    flat = sorted(par_iter.from_items(list(range(10)), num_shards=2)
+                  .batch(3).flatten().gather_sync())
+    assert flat == list(range(10))
+
+    # union + take + count
+    u = par_iter.from_range(5).union(par_iter.from_range(5))
+    assert u.num_shards == 4
+    assert u.count() == 10
+    assert len(par_iter.from_range(100, num_shards=4).take(7)) == 7
+
+    with pytest.raises(ValueError, match="identical op chains"):
+        par_iter.from_range(5).for_each(lambda x: x).union(
+            par_iter.from_range(5)
+        )
+
+
+def test_check_serialize():
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    ok, failures = inspect_serializability(lambda x: x + 1,
+                                           print_report=False)
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    def uses_lock():
+        return lock
+
+    ok, failures = inspect_serializability(uses_lock,
+                                           print_report=False)
+    assert not ok
+    assert any(f.name == "lock" for f in failures), failures
+
+
+def test_joblib_backend(ray_tpu_start):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(
+            joblib.delayed(_square)(i) for i in range(12)
+        )
+    assert out == [i * i for i in range(12)]
+
+
+def test_tqdm_distributed(ray_tpu_start):
+    """Worker-side tqdm proxies publish progress the driver renderer
+    aggregates (rendering disabled: state only)."""
+    import ray_tpu
+    from ray_tpu.util.tqdm import driver_progress
+
+    @ray_tpu.remote
+    def work(k):
+        from ray_tpu.util.tqdm import tqdm
+
+        total = 0
+        for x in tqdm(range(50), desc=f"job-{k}",
+                      flush_interval_s=0.0):
+            total += x
+        return total
+
+    with driver_progress(render=False) as renderer:
+        out = ray_tpu.get([work.remote(i) for i in range(2)])
+        assert out == [sum(range(50))] * 2
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            done = [s for s in renderer.state.values()
+                    if s["closed"] and s["n"] == 50]
+            if len(done) >= 2:
+                break
+            time.sleep(0.2)
+        closed = [s for s in renderer.state.values() if s["closed"]]
+        assert len(closed) >= 2, renderer.state
+        assert all(s["total"] == 50 for s in closed)
