@@ -13,11 +13,13 @@ from .read_api import (  # noqa: F401
     from_numpy,
     from_pandas,
     range,
+    read_avro,
     read_bigquery,
     read_binary_files,
     read_csv,
     read_images,
     read_json,
+    read_lance,
     read_mongo,
     read_numpy,
     read_parquet,

@@ -1,0 +1,327 @@
+"""Avro Object Container File codec — dependency-free.
+
+Ref analogue: ray.data.read_avro
+(python/ray/data/datasource/avro_datasource.py, which delegates to the
+`fastavro` package). This image ships no avro library, so the codec is
+implemented here against the Avro 1.11 spec: OCF layout
+(magic ``Obj\\x01`` | metadata map with ``avro.schema``/``avro.codec``
+| 16-byte sync marker | blocks of ``count, byte-size, records`` each
+followed by the sync marker), binary encoding (zigzag-varint
+longs, little-endian float/double, length-prefixed bytes/strings),
+``null`` and ``deflate`` codecs, and the schema types the tabular
+layer produces: primitives, records, enums, fixed, arrays, maps and
+unions.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, List, Tuple
+
+MAGIC = b"Obj\x01"
+
+
+# ------------------------------------------------------------ binary layer
+
+
+def _read_long(buf: io.BytesIO) -> int:
+    """Zigzag varint (the avro long/int wire format)."""
+    shift = 0
+    accum = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated avro varint")
+        byte = b[0]
+        accum |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    return (accum >> 1) ^ -(accum & 1)
+
+
+def _write_long(out: io.BytesIO, n: int):
+    n = (n << 1) ^ (n >> 63)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            return
+
+
+def _read_bytes(buf: io.BytesIO) -> bytes:
+    n = _read_long(buf)
+    data = buf.read(n)
+    if len(data) != n:
+        raise EOFError("truncated avro bytes")
+    return data
+
+
+def _write_bytes(out: io.BytesIO, data: bytes):
+    _write_long(out, len(data))
+    out.write(data)
+
+
+def _read_datum(buf: io.BytesIO, schema: Any) -> Any:
+    if isinstance(schema, list):                      # union
+        idx = _read_long(buf)
+        return _read_datum(buf, schema[idx])
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            return {
+                f["name"]: _read_datum(buf, f["type"])
+                for f in schema["fields"]
+            }
+        if t == "array":
+            out: List[Any] = []
+            while True:
+                count = _read_long(buf)
+                if count == 0:
+                    return out
+                if count < 0:
+                    _read_long(buf)  # block byte size, unused
+                    count = -count
+                out.extend(
+                    _read_datum(buf, schema["items"])
+                    for _ in range(count)
+                )
+        if t == "map":
+            m: Dict[str, Any] = {}
+            while True:
+                count = _read_long(buf)
+                if count == 0:
+                    return m
+                if count < 0:
+                    _read_long(buf)
+                    count = -count
+                for _ in range(count):
+                    k = _read_bytes(buf).decode()
+                    m[k] = _read_datum(buf, schema["values"])
+        if t == "enum":
+            return schema["symbols"][_read_long(buf)]
+        if t == "fixed":
+            return buf.read(schema["size"])
+        schema = t                                    # {"type": "long"}
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        return buf.read(1) == b"\x01"
+    if schema in ("int", "long"):
+        return _read_long(buf)
+    if schema == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if schema == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if schema == "bytes":
+        return _read_bytes(buf)
+    if schema == "string":
+        return _read_bytes(buf).decode()
+    raise ValueError(f"unsupported avro type {schema!r}")
+
+
+def _write_datum(out: io.BytesIO, schema: Any, value: Any):
+    if isinstance(schema, list):                      # union
+        for i, branch in enumerate(schema):
+            if _matches(branch, value):
+                _write_long(out, i)
+                _write_datum(out, branch, value)
+                return
+        raise ValueError(f"value {value!r} matches no union branch")
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            for f in schema["fields"]:
+                _write_datum(out, f["type"], value[f["name"]])
+            return
+        if t == "array":
+            if value:
+                _write_long(out, len(value))
+                for item in value:
+                    _write_datum(out, schema["items"], item)
+            _write_long(out, 0)
+            return
+        if t == "map":
+            if value:
+                _write_long(out, len(value))
+                for k, v in value.items():
+                    _write_bytes(out, str(k).encode())
+                    _write_datum(out, schema["values"], v)
+            _write_long(out, 0)
+            return
+        if t == "enum":
+            _write_long(out, schema["symbols"].index(value))
+            return
+        if t == "fixed":
+            out.write(value)
+            return
+        schema = t
+    if schema == "null":
+        return
+    if schema == "boolean":
+        out.write(b"\x01" if value else b"\x00")
+    elif schema in ("int", "long"):
+        _write_long(out, int(value))
+    elif schema == "float":
+        out.write(struct.pack("<f", float(value)))
+    elif schema == "double":
+        out.write(struct.pack("<d", float(value)))
+    elif schema == "bytes":
+        _write_bytes(out, bytes(value))
+    elif schema == "string":
+        _write_bytes(out, str(value).encode())
+    else:
+        raise ValueError(f"unsupported avro type {schema!r}")
+
+
+def _matches(schema: Any, value: Any) -> bool:
+    t = schema["type"] if isinstance(schema, dict) else schema
+    if t == "null":
+        return value is None
+    if value is None:
+        return False
+    if t == "boolean":
+        return isinstance(value, bool)
+    if t in ("int", "long"):
+        return isinstance(value, int) and not isinstance(value, bool)
+    if t in ("float", "double"):
+        return isinstance(value, (int, float))
+    if t == "string":
+        return isinstance(value, str)
+    if t == "bytes":
+        return isinstance(value, (bytes, bytearray))
+    if t == "array":
+        return isinstance(value, list)
+    if t == "map":
+        return isinstance(value, dict)
+    if t == "record":
+        return isinstance(value, dict)
+    return True
+
+
+# --------------------------------------------------------------- container
+
+
+def read_avro_file(path: str) -> List[Dict[str, Any]]:
+    """All records of one OCF file as python dicts."""
+    with open(path, "rb") as f:
+        data = f.read()
+    buf = io.BytesIO(data)
+    if buf.read(4) != MAGIC:
+        raise ValueError(f"{path}: not an avro object container file")
+    meta: Dict[str, bytes] = {}
+    while True:
+        count = _read_long(buf)
+        if count == 0:
+            break
+        if count < 0:
+            _read_long(buf)
+            count = -count
+        for _ in range(count):
+            k = _read_bytes(buf).decode()
+            meta[k] = _read_bytes(buf)
+    schema = json.loads(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"unsupported avro codec {codec!r}")
+    sync = buf.read(16)
+    records: List[Dict[str, Any]] = []
+    while buf.tell() < len(data):
+        count = _read_long(buf)
+        size = _read_long(buf)
+        payload = buf.read(size)
+        if codec == "deflate":
+            payload = zlib.decompress(payload, -15)
+        rbuf = io.BytesIO(payload)
+        records.extend(_read_datum(rbuf, schema) for _ in range(count))
+        if buf.read(16) != sync:
+            raise ValueError(f"{path}: sync marker mismatch")
+    return records
+
+
+def infer_schema(rows: List[Dict[str, Any]],
+                 name: str = "Row") -> Dict[str, Any]:
+    """Record schema from sampled rows; fields seen as None anywhere
+    become ["null", T] unions."""
+    types: Dict[str, set] = {}
+    for row in rows:
+        for k, v in row.items():
+            types.setdefault(k, set()).add(_py_avro_type(v))
+    fields = []
+    for k in sorted(types):
+        ts = types[k]
+        nullable = "null" in ts
+        ts.discard("null")
+        if len(ts) > 1:
+            # int+float widen to double; else fall back to a union
+            if ts <= {"long", "double"}:
+                ts = {"double"}
+        t: Any = sorted(ts)[0] if len(ts) == 1 else sorted(ts)
+        if nullable:
+            t = ["null", t] if not isinstance(t, list) else \
+                ["null"] + t
+        fields.append({"name": k, "type": t})
+    return {"type": "record", "name": name, "fields": fields}
+
+
+def _py_avro_type(v: Any) -> Any:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, int):
+        return "long"
+    if isinstance(v, float):
+        return "double"
+    if isinstance(v, (bytes, bytearray)):
+        return "bytes"
+    if isinstance(v, str):
+        return "string"
+    raise ValueError(
+        f"cannot infer avro type for {type(v).__name__} "
+        f"(convert arrays/objects to lists/dicts with an explicit "
+        f"schema)"
+    )
+
+
+def write_avro_file(path: str, rows: List[Dict[str, Any]],
+                    schema: Dict[str, Any] = None,
+                    codec: str = "deflate"):
+    """One OCF file with a single block."""
+    if schema is None:
+        schema = infer_schema(rows)
+    body = io.BytesIO()
+    for row in rows:
+        _write_datum(body, schema, row)
+    payload = body.getvalue()
+    if codec == "deflate":
+        comp = zlib.compressobj(wbits=-15)
+        payload = comp.compress(payload) + comp.flush()
+    elif codec != "null":
+        raise ValueError(f"unsupported avro codec {codec!r}")
+    sync = os.urandom(16)
+    out = io.BytesIO()
+    out.write(MAGIC)
+    meta: List[Tuple[str, bytes]] = [
+        ("avro.schema", json.dumps(schema).encode()),
+        ("avro.codec", codec.encode()),
+    ]
+    _write_long(out, len(meta))
+    for k, v in meta:
+        _write_bytes(out, k.encode())
+        _write_bytes(out, v)
+    _write_long(out, 0)
+    out.write(sync)
+    _write_long(out, len(rows))
+    _write_long(out, len(payload))
+    out.write(payload)
+    out.write(sync)
+    with open(path, "wb") as f:
+        f.write(out.getvalue())

@@ -587,6 +587,13 @@ class Dataset:
 
         return write_blocks(self, path, "tfrecords", **kw)
 
+    def write_avro(self, path: str, **kw) -> List[str]:
+        """One Avro Object Container File per block (ref:
+        write_avro; codec in data/avro.py)."""
+        from .datasink import write_blocks
+
+        return write_blocks(self, path, "avro", **kw)
+
     def write_webdataset(self, path: str, **kw) -> List[str]:
         """One WebDataset tar shard per block (ref: write_webdataset)."""
         from .datasink import write_blocks

@@ -62,6 +62,15 @@ def _write_block(block, path: str, fmt: str, index: int,
         write_example_file(
             fname, [dict(r) for r in BlockAccessor(block).iter_rows()]
         )
+    elif fmt == "avro":
+        from .avro import write_avro_file
+        from .block import BlockAccessor
+
+        write_avro_file(
+            fname,
+            [_jsonable(r) for r in BlockAccessor(block).iter_rows()],
+            **write_kwargs,
+        )
     else:
         raise ValueError(f"unknown sink format {fmt!r}")
     return fname

@@ -218,6 +218,47 @@ def read_webdataset(paths, **kw) -> Dataset:
     return Dataset([make(p) for p in files])
 
 
+def read_avro(paths, **kw) -> Dataset:
+    """Avro Object Container Files, one block per file (ref analogue:
+    ray.data.read_avro over datasource/avro_datasource.py; the
+    dependency-free codec lives in data/avro.py)."""
+    import pyarrow as pa
+
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            from .avro import read_avro_file
+
+            rows = read_avro_file(path)
+            return pa.Table.from_pylist(rows)
+
+        return read
+
+    return Dataset([make(p) for p in files])
+
+
+def read_lance(uri: str, *, columns=None, **kw) -> Dataset:
+    """Lance datasets via the `lance` package (ref analogue:
+    ray.data.read_lance over datasource/lance_datasource.py, which
+    carries the same dependency)."""
+    try:
+        import lance  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "read_lance requires the `lance` package, which is not "
+            "installed in this environment"
+        ) from e
+
+    def read():
+        import lance
+
+        ds = lance.dataset(uri)
+        return ds.to_table(columns=columns)
+
+    return Dataset([read])
+
+
 def read_numpy(paths, **kw) -> Dataset:
     files = _expand_paths(paths)
 
