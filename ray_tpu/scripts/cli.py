@@ -427,6 +427,52 @@ def cmd_stop_job(args) -> int:
         ray_tpu.shutdown()
 
 
+# --------------------------------------------------------------- serve
+
+def cmd_serve_deploy(args) -> int:
+    """Apply a declarative serve config (ref: `serve deploy`)."""
+    ray_tpu = _attached(args)
+    try:
+        import ray_tpu.serve as serve
+
+        with open(args.config) as f:
+            routes = serve.deploy_config(
+                f.read(), http_port=args.http_port
+            )
+        for app, info in routes.items():
+            print(f"{app}: route=/{info['route_prefix']} "
+                  f"port={info['http_port']} "
+                  f"deployment={info['deployment']}")
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_serve_status(args) -> int:
+    """Per-deployment replica state (ref: `serve status`)."""
+    ray_tpu = _attached(args)
+    try:
+        import ray_tpu.serve as serve
+
+        print(json.dumps(serve.details(), indent=2, default=str))
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_serve_shutdown(args) -> int:
+    """Delete every deployment (ref: `serve shutdown`)."""
+    ray_tpu = _attached(args)
+    try:
+        import ray_tpu.serve as serve
+
+        serve.shutdown()
+        print("serve shut down")
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
 # ---------------------------------------------------------------- main
 
 def _add_address(p):
@@ -501,6 +547,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("job_id")
     _add_address(p)
     p.set_defaults(fn=cmd_stop_job)
+
+    p = sub.add_parser("serve", help="serve: deploy/status/shutdown")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    sp = ssub.add_parser("deploy",
+                         help="apply a declarative serve config YAML")
+    sp.add_argument("config")
+    sp.add_argument("--http-port", type=int, default=8000)
+    _add_address(sp)
+    sp.set_defaults(fn=cmd_serve_deploy)
+    sp = ssub.add_parser("status", help="per-deployment replica state")
+    _add_address(sp)
+    sp.set_defaults(fn=cmd_serve_status)
+    sp = ssub.add_parser("shutdown", help="delete every deployment")
+    _add_address(sp)
+    sp.set_defaults(fn=cmd_serve_shutdown)
 
     args = parser.parse_args(argv)
     if getattr(args, "entrypoint", None):

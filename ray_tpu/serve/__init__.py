@@ -17,6 +17,7 @@ from .api import (
     status,
 )
 from .deployment import AutoscalingConfig, Deployment  # noqa: F401
+from .schema import deploy_config, parse_config  # noqa: F401
 from .handle import DeploymentHandle, ServeFuture  # noqa: F401
 from .grpc_ingress import (  # noqa: F401
     start_grpc_ingress,

@@ -71,28 +71,85 @@ def _get_controller():
     return _controller
 
 
+def _deploy_children(controller, target: Deployment,
+                     stack: tuple = ()) -> tuple:
+    """Deployment-graph build: deploy every Deployment nested in the
+    target's init args (post-order) and swap it for a picklable
+    BoundDeployment the replica resolves to a live handle (ref:
+    serve/_private/deployment_graph_build.py — ``Parent.bind(
+    Child.bind())``)."""
+    from .replica import BoundDeployment
+
+    def resolve(v):
+        if isinstance(v, Deployment):
+            if v.name in stack:
+                raise ValueError(
+                    f"deployment graph cycle through {v.name!r}"
+                )
+            _deploy_one(controller, v.name, v,
+                        stack=stack + (v.name,))
+            return BoundDeployment(v.name)
+        return v
+
+    args = tuple(resolve(a) for a in target._init_args)
+    kwargs = {k: resolve(v) for k, v in target._init_kwargs.items()}
+    return args, kwargs
+
+
+def _deploy_one(controller, dep_name: str, target: Deployment, *,
+                stack: tuple = ()):
+    import ray_tpu
+
+    init_args, init_kwargs = _deploy_children(controller, target, stack)
+    blob = cloudpickle.dumps(target.func_or_class)
+    batch_config = getattr(target.func_or_class, "_serve_batch_config",
+                           None)
+    autoscaling = (
+        dataclasses.asdict(target.autoscaling_config)
+        if target.autoscaling_config is not None else None
+    )
+    ray_tpu.get(
+        controller.deploy.remote(
+            dep_name,
+            blob,
+            init_args,
+            init_kwargs,
+            target.num_replicas,
+            target.ray_actor_options,
+            batch_config,
+            autoscaling,
+            is_asgi=getattr(target.func_or_class, "_rtpu_asgi", False),
+        )
+    )
+
+
 def run(target: Deployment, *, name: Optional[str] = None,
         route_prefix: Optional[str] = None, http_port: int = 0,
         _blocking: bool = False) -> DeploymentHandle:
     """Deploy (or redeploy — rolling, zero-downtime) and return a handle
-    (ref: serve.run). Starts the HTTP proxy lazily on first use;
-    ``http_port=0`` picks a free port."""
+    (ref: serve.run). Nested ``.bind()`` deployments in the target's
+    init args deploy first and arrive in the constructor as live
+    handles (the deployment-graph build). Starts the HTTP proxy lazily
+    on first use; ``http_port=0`` picks a free port."""
     import ray_tpu
 
     controller = _get_controller()
     dep_name = name or target.name
+    init_args, init_kwargs = _deploy_children(
+        controller, target, (dep_name,)
+    )
     blob = cloudpickle.dumps(target.func_or_class)
     batch_config = getattr(target.func_or_class, "_serve_batch_config", None)
     autoscaling = (
         dataclasses.asdict(target.autoscaling_config)
         if target.autoscaling_config is not None else None
     )
-    replicas = ray_tpu.get(
+    ray_tpu.get(
         controller.deploy.remote(
             dep_name,
             blob,
-            target._init_args,
-            target._init_kwargs,
+            init_args,
+            init_kwargs,
             target.num_replicas,
             target.ray_actor_options,
             batch_config,

@@ -19,10 +19,36 @@ from typing import Any, Dict, List, Tuple
 import cloudpickle
 
 
+class BoundDeployment:
+    """Picklable stand-in for a DeploymentHandle riding in init args
+    (live handles carry threads/locks and cannot pickle). Replicas
+    resolve it to a real handle at construction time — this is what
+    makes ``Child.bind()`` inside ``Parent.bind(child)`` work (ref
+    analogue: the deployment-graph build's handle injection,
+    serve/_private/deployment_graph_build.py)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def resolve(self):
+        from .api import get_deployment_handle
+
+        return get_deployment_handle(self.name)
+
+
+def _resolve_bound(value):
+    if isinstance(value, BoundDeployment):
+        return value.resolve()
+    return value
+
+
 class Replica:
     def __init__(self, blob: bytes, init_args, init_kwargs,
                  version: str = ""):
         target = cloudpickle.loads(blob)
+        init_args = tuple(_resolve_bound(a) for a in init_args)
+        init_kwargs = {k: _resolve_bound(v)
+                       for k, v in init_kwargs.items()}
         if inspect.isclass(target):
             self._callable = target(*init_args, **init_kwargs)
             self._is_class = True

@@ -1,0 +1,177 @@
+"""Declarative Serve config: YAML/dict schema + apply.
+
+Ref analogue: python/ray/serve/schema.py (ServeDeploySchema /
+ServeApplicationSchema pydantic models) + the `serve deploy` CLI and
+REST flow (dashboard/modules/serve/). A config names applications by
+``import_path`` ("module:attr" resolving to a bound Deployment),
+optionally overrides per-deployment fields, and is applied with
+``serve.deploy_config`` or `rtpu serve deploy config.yaml`:
+
+    applications:
+      - name: adder
+        route_prefix: /add
+        import_path: my_app:graph
+        deployments:
+          - name: Adder
+            num_replicas: 3
+
+Unknown keys fail validation loudly (the pydantic behavior) rather
+than deploying something other than what the operator wrote.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Dict, List, Optional
+
+_APP_KEYS = {"name", "route_prefix", "import_path", "deployments",
+             "args"}
+_DEP_KEYS = {"name", "num_replicas", "max_concurrent_queries",
+             "ray_actor_options", "autoscaling_config"}
+
+
+@dataclasses.dataclass
+class DeploymentOverride:
+    name: str
+    num_replicas: Optional[int] = None
+    max_concurrent_queries: Optional[int] = None
+    ray_actor_options: Optional[Dict[str, Any]] = None
+    autoscaling_config: Optional[Dict[str, Any]] = None
+
+
+@dataclasses.dataclass
+class ApplicationConfig:
+    name: str
+    import_path: str
+    route_prefix: Optional[str] = None
+    deployments: List[DeploymentOverride] = dataclasses.field(
+        default_factory=list
+    )
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def _validate_keys(d: Dict[str, Any], allowed: set, where: str):
+    unknown = set(d) - allowed
+    if unknown:
+        raise ValueError(
+            f"unknown key(s) {sorted(unknown)} in {where} "
+            f"(allowed: {sorted(allowed)})"
+        )
+
+
+def parse_config(config: Any) -> List[ApplicationConfig]:
+    """dict (or YAML text) -> validated ApplicationConfigs."""
+    if isinstance(config, str):
+        import yaml
+
+        config = yaml.safe_load(config)
+    if not isinstance(config, dict) or "applications" not in config:
+        raise ValueError("serve config must be a mapping with an "
+                         "'applications' list")
+    _validate_keys(config, {"applications"}, "serve config")
+    apps = []
+    for i, app in enumerate(config["applications"]):
+        _validate_keys(app, _APP_KEYS, f"applications[{i}]")
+        if "import_path" not in app:
+            raise ValueError(f"applications[{i}]: import_path required")
+        deps = []
+        for j, dep in enumerate(app.get("deployments") or []):
+            _validate_keys(dep, _DEP_KEYS,
+                           f"applications[{i}].deployments[{j}]")
+            if "name" not in dep:
+                raise ValueError(
+                    f"applications[{i}].deployments[{j}]: name required"
+                )
+            deps.append(DeploymentOverride(**dep))
+        apps.append(ApplicationConfig(
+            name=app.get("name") or app["import_path"],
+            import_path=app["import_path"],
+            route_prefix=app.get("route_prefix"),
+            deployments=deps,
+            args=app.get("args") or {},
+        ))
+    names = [a.name for a in apps]
+    if len(names) != len(set(names)):
+        raise ValueError(f"duplicate application names in {names}")
+    return apps
+
+
+def import_attr(import_path: str):
+    """"pkg.module:attr" -> the attribute (ref:
+    ray._private.utils.import_attr)."""
+    if ":" not in import_path:
+        raise ValueError(
+            f"import_path {import_path!r} must look like "
+            f"'module.sub:attr'"
+        )
+    module_path, attr = import_path.split(":", 1)
+    module = importlib.import_module(module_path)
+    target = module
+    for part in attr.split("."):
+        target = getattr(target, part)
+    return target
+
+
+def _apply_overrides(dep, overrides: List[DeploymentOverride]):
+    """Returns dep with matching override fields applied (the nested
+    graph is walked through init args)."""
+    from .deployment import AutoscalingConfig, Deployment
+
+    by_name = {o.name: o for o in overrides}
+
+    def rebuild(d: Deployment) -> Deployment:
+        o = by_name.get(d.name)
+        out = d.options() if o is None else d.options(**{
+            k: v for k, v in {
+                "num_replicas": o.num_replicas,
+                "max_concurrent_queries": o.max_concurrent_queries,
+                "ray_actor_options": o.ray_actor_options,
+                "autoscaling_config": (
+                    AutoscalingConfig(**o.autoscaling_config)
+                    if o.autoscaling_config is not None else None
+                ),
+            }.items() if v is not None
+        })
+        out._init_args = tuple(
+            rebuild(a) if isinstance(a, Deployment) else a
+            for a in d._init_args
+        )
+        out._init_kwargs = {
+            k: rebuild(v) if isinstance(v, Deployment) else v
+            for k, v in d._init_kwargs.items()
+        }
+        return out
+
+    return rebuild(dep)
+
+
+def deploy_config(config: Any, *, http_port: int = 0) -> Dict[str, Any]:
+    """Apply a declarative config: import each application's target,
+    apply overrides, serve.run it under its route_prefix. Returns
+    {app_name: route}."""
+    from . import api
+    from .deployment import Deployment
+
+    routes: Dict[str, Any] = {}
+    for app in parse_config(config):
+        target = import_attr(app.import_path)
+        if callable(target) and not isinstance(target, Deployment):
+            target = target(**app.args)   # builder function
+        if not isinstance(target, Deployment):
+            raise TypeError(
+                f"{app.import_path} resolved to "
+                f"{type(target).__name__}, expected a Deployment"
+            )
+        target = _apply_overrides(target, app.deployments)
+        handle = api.run(
+            target, name=target.name,
+            route_prefix=app.route_prefix or app.name,
+            http_port=http_port,
+        )
+        routes[app.name] = {
+            "route_prefix": app.route_prefix or app.name,
+            "http_port": handle.http_port,
+            "deployment": target.name,
+        }
+    return routes

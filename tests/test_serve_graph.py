@@ -1,0 +1,146 @@
+"""Serve deployment graphs + declarative config deploy.
+
+Ref analogues: serve/_private/deployment_graph_build.py (nested
+``.bind()`` handle injection), serve/schema.py + the `serve deploy`
+flow (declarative YAML apply).
+"""
+
+import sys
+import textwrap
+import time
+
+import pytest
+
+
+def test_deployment_graph_nested_bind(ray_tpu_start):
+    """Parent.bind(Child.bind()) deploys the child first and hands the
+    parent a LIVE handle at construction."""
+    import ray_tpu.serve as serve
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+    @serve.deployment
+    class Combiner:
+        def __init__(self, doubler, offset):
+            self.doubler = doubler
+            self.offset = offset
+
+        def __call__(self, x):
+            return self.doubler.remote(x).result(timeout=30) + \
+                self.offset
+
+    try:
+        handle = serve.run(Combiner.bind(Doubler.bind(), 5))
+        assert handle.remote(10).result(timeout=60) == 25
+        # Child is an ordinary deployment too: scalable + addressable.
+        status = serve.status()
+        assert "Doubler" in status and "Combiner" in status
+        child = serve.get_deployment_handle("Doubler")
+        assert child.remote(3).result(timeout=30) == 6
+    finally:
+        serve.shutdown()
+
+
+def test_deployment_graph_cycle_rejected(ray_tpu_start):
+    import ray_tpu.serve as serve
+
+    @serve.deployment
+    class A:
+        def __init__(self, other=None):
+            pass
+
+    a = A.bind()
+    a._init_args = (a,)  # self-cycle
+    try:
+        with pytest.raises(ValueError, match="cycle"):
+            serve.run(a)
+    finally:
+        serve.shutdown()
+
+
+def test_parse_config_validation():
+    from ray_tpu.serve.schema import parse_config
+
+    apps = parse_config(textwrap.dedent("""
+        applications:
+          - name: app1
+            route_prefix: add
+            import_path: mod:dep
+            deployments:
+              - name: D
+                num_replicas: 3
+    """))
+    assert apps[0].name == "app1"
+    assert apps[0].deployments[0].num_replicas == 3
+
+    with pytest.raises(ValueError, match="unknown key"):
+        parse_config({"applications": [
+            {"import_path": "m:d", "bogus": 1}
+        ]})
+    with pytest.raises(ValueError, match="import_path required"):
+        parse_config({"applications": [{"name": "x"}]})
+    with pytest.raises(ValueError, match="duplicate application"):
+        parse_config({"applications": [
+            {"name": "a", "import_path": "m:d"},
+            {"name": "a", "import_path": "m:e"},
+        ]})
+    with pytest.raises(ValueError, match="must look like"):
+        from ray_tpu.serve.schema import import_attr
+
+        import_attr("no_colon_here")
+
+
+def test_deploy_config_end_to_end(ray_tpu_start, tmp_path):
+    """YAML -> import_path -> overrides -> running HTTP app."""
+    import urllib.request
+
+    import ray_tpu.serve as serve
+
+    (tmp_path / "demo_serve_app.py").write_text(textwrap.dedent("""
+        import ray_tpu.serve as serve
+
+        @serve.deployment
+        class Adder:
+            def __init__(self, increment):
+                self.increment = increment
+
+            def __call__(self, request):
+                return {"sum": int(request["x"]) + self.increment}
+
+        graph = Adder.bind(7)
+    """))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        routes = serve.deploy_config(textwrap.dedent("""
+            applications:
+              - name: adder
+                route_prefix: add
+                import_path: demo_serve_app:graph
+                deployments:
+                  - name: Adder
+                    num_replicas: 2
+        """))
+        assert routes["adder"]["deployment"] == "Adder"
+        port = routes["adder"]["http_port"]
+        details = serve.details()
+        assert details["Adder"]["target_replicas"] == 2
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/add",
+            data=b'{"x": 35}',
+            headers={"Content-Type": "application/json"},
+        )
+        import json as _json
+
+        body = _json.loads(
+            urllib.request.urlopen(req, timeout=30).read()
+        )
+        # JSON-envelope routes wrap the return value (the ASGI path
+        # returns raw bodies; plain deployments use the envelope).
+        assert body == {"result": {"sum": 42}}, body
+    finally:
+        sys.path.remove(str(tmp_path))
+        serve.shutdown()
