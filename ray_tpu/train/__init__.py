@@ -29,3 +29,4 @@ from .session import (  # noqa: F401
     report,
 )
 from .trainer import JaxTrainer, TrainWorkerGroupError  # noqa: F401
+from .torch import TorchTrainer  # noqa: F401

@@ -1,0 +1,70 @@
+"""TorchTrainer: data-parallel torch training on the cluster.
+
+Ref analogue: python/ray/train/torch/ — TorchTrainer
+(torch_trainer.py:14) over the gloo/nccl process group set up in
+TorchConfig (config.py:62 _setup_torch_process_group) plus the
+train-loop utilities (train_loop_utils.py: prepare_model:74 wraps DDP,
+prepare_data_loader:116 adds a DistributedSampler). On this framework
+torch runs CPU-side (the accelerator path is jax — JaxTrainer); the
+trainer exists so torch-based reference workloads port unchanged:
+same WorkerGroup machinery, same session.report/checkpoint flow, with
+the rendezvous swapped from jax.distributed to a torch gloo group.
+"""
+
+from __future__ import annotations
+
+from .trainer import JaxTrainer
+
+
+class TorchTrainer(JaxTrainer):
+    """Same fit/failure/checkpoint machinery as JaxTrainer; workers
+    rendezvous into a torch.distributed gloo group instead of
+    jax.distributed."""
+
+    _collective_backend = "torch"
+
+
+def get_device():
+    """The device this worker should use (ref:
+    train/torch/train_loop_utils.py get_device) — CPU here; TPU work
+    goes through jax."""
+    import torch
+
+    return torch.device("cpu")
+
+
+def prepare_model(model):
+    """Wrap the model for distributed training (ref: prepare_model,
+    train_loop_utils.py:74,330 — DDP wrap keyed on world size)."""
+    import torch.distributed as dist
+
+    if dist.is_available() and dist.is_initialized() and \
+            dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Shard a DataLoader across workers with a DistributedSampler
+    (ref: prepare_data_loader, train_loop_utils.py:116)."""
+    import torch.distributed as dist
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if isinstance(data_loader.sampler, DistributedSampler):
+        return data_loader
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=DistributedSampler(data_loader.dataset),
+        num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        pin_memory=data_loader.pin_memory,
+        drop_last=data_loader.drop_last,
+    )

@@ -44,19 +44,35 @@ def _train_worker_entry(
     start_checkpoint_path: Optional[str],
     dataset_shards: Dict[str, Any],
     coordinator: Optional[str],
-    use_tpu: bool,
+    backend: Optional[str],
 ):
-    """Runs inside a worker actor process."""
+    """Runs inside a worker actor process. ``backend`` selects the
+    collective rendezvous: "jax" = jax.distributed over the slice,
+    "torch" = torch.distributed gloo process group (the TorchTrainer
+    path, ref: train/torch/config.py _setup_torch_process_group:62),
+    None = no collectives."""
     from ..core.runtime_context import current_runtime
 
-    if coordinator is not None and world_size > 1 and use_tpu:
-        import jax
+    torch_group = False
+    if coordinator is not None and world_size > 1:
+        if backend == "jax":
+            import jax
 
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=world_size,
-            process_id=rank,
-        )
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=world_size,
+                process_id=rank,
+            )
+        elif backend == "torch":
+            import torch.distributed as dist
+
+            dist.init_process_group(
+                "gloo",
+                init_method=f"tcp://{coordinator}",
+                rank=rank,
+                world_size=world_size,
+            )
+            torch_group = True
     fn = cloudpickle.loads(fn_blob)
     start_ckpt = (
         Checkpoint(start_checkpoint_path) if start_checkpoint_path else None
@@ -88,6 +104,13 @@ def _train_worker_entry(
         raise
     finally:
         set_session(None)
+        if torch_group:
+            import torch.distributed as dist
+
+            try:
+                dist.destroy_process_group()
+            except Exception:
+                pass
     return "done"
 
 
@@ -114,6 +137,10 @@ class _RemoteTrainWorker:
 class JaxTrainer:
     """Data-parallel trainer (ref analogue: DataParallelTrainer /
     TorchTrainer, train/data_parallel_trainer.py:432)."""
+
+    # Collective rendezvous flavor for multi-worker runs; the
+    # TorchTrainer subclass (train/torch.py) swaps this for "torch".
+    _collective_backend = "jax"
 
     def __init__(
         self,
@@ -261,10 +288,13 @@ class JaxTrainer:
         try:
             group.wait_ready(timeout=120.0)
             coordinator = None
-            if world > 1 and sc.use_tpu:
+            backend = None
+            if world > 1 and (sc.use_tpu
+                              or self._collective_backend != "jax"):
                 # Rank 0 reserves the rendezvous port on its own host; the
                 # address is published through the control-plane KV
                 # (docstring contract; also consumed by state tooling).
+                backend = self._collective_backend
                 coordinator = ray_tpu.get(
                     group.actors[0].reserve_coordinator.remote()
                 )
@@ -285,7 +315,7 @@ class JaxTrainer:
                         start_ckpt.path if start_ckpt else None,
                         shards[rank],
                         coordinator,
-                        sc.use_tpu,
+                        backend,
                     ),
                     {},
                 )
