@@ -5,7 +5,15 @@ from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from .env_runner import EnvRunner  # noqa: F401
 from .policy import MLPPolicy  # noqa: F401
 from .a2c import A2C, A2CConfig  # noqa: F401
+from .alpha_zero import (  # noqa: F401
+    AlphaZero,
+    AlphaZeroConfig,
+    MCTS,
+    TicTacToe,
+)
 from .ars import ARS, ARSConfig  # noqa: F401
+from .maddpg import MADDPG, MADDPGConfig  # noqa: F401
+from .r2d2 import R2D2, R2D2Config  # noqa: F401
 from .bandit import (  # noqa: F401
     Bandit,
     BanditLinTSConfig,

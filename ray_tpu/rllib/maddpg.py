@@ -1,0 +1,358 @@
+"""MADDPG: multi-agent DDPG with centralized critics.
+
+Ref analogue: rllib/algorithms/maddpg (Lowe 2017 "Multi-Agent
+Actor-Critic for Mixed Cooperative-Competitive Environments").
+Execution is decentralized — each agent's deterministic actor sees
+only its own observation — but training is centralized: every agent's
+critic Q_i(o_all, a_all) conditions on ALL agents' observations and
+actions, with other agents' next actions supplied by their target
+actors. That converts the non-stationary multi-agent problem into a
+stationary one per critic.
+
+Env protocol: the dict convention of multi_agent.py with Box action
+spaces and every agent present each step (fixed team).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+from .core import DeterministicActorModule, Learner, QModule
+from .policy import init_mlp_params
+from .replay_buffers import ReplayBuffer
+from .sample_batch import SampleBatch
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size: int = 50_000
+        self.num_steps_sampled_before_learning_starts: int = 500
+        self.num_updates_per_iteration: int = 48
+        self.tau: float = 0.01
+        self.exploration_noise: float = 0.2
+        # probed/declared dims
+        self.act_dim: int = 0   # per-agent Box action dim (required)
+
+    def build(self) -> "MADDPG":
+        if not self.act_dim:
+            raise ValueError("MADDPGConfig.training(act_dim=...) "
+                             "required")
+        return MADDPG(self.copy())
+
+
+class MADDPGLearner(Learner):
+    """params: {actor_<i>, q_<i>} per agent. The base polyak machinery
+    tracks every subtree; one jitted update per agent pair (critic on
+    the joint transition, actor maximizing its own centralized Q with
+    the OTHER agents' current actions held fixed)."""
+
+    def __init__(self, n_agents: int, obs_dim: int, act_dim: int,
+                 hidden: int, lr: float, tau: float, gamma: float,
+                 seed: int):
+        joint_obs = n_agents * obs_dim
+        joint_act = n_agents * act_dim
+        params: Dict[str, Any] = {}
+        for i in range(n_agents):
+            params[f"actor_{i}"] = DeterministicActorModule(
+                obs_dim, act_dim, hidden, seed + i
+            ).init_params()
+            params[f"q_{i}"] = QModule(
+                joint_obs, joint_act, hidden, seed + 100 + i
+            ).init_params()
+        super().__init__(params, lr=lr, target_keys=tuple(params),
+                         tau=tau)
+        self._n = n_agents
+        self._gamma = gamma
+        self._obs_dim = obs_dim
+        self._act_dim = act_dim
+        self._jit_step = None
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        n, gamma = self._n, self._gamma
+
+        def joint(x):  # [B, A, D] -> [B, A*D]
+            return x.reshape(x.shape[0], -1)
+
+        def critic_loss(params, target, batch):
+            # Next joint action from ALL target actors.
+            a2 = jnp.stack([
+                DeterministicActorModule.forward(
+                    target[f"actor_{j}"], batch["next_obs"][:, j]
+                )
+                for j in range(n)
+            ], axis=1)
+            total = 0.0
+            stats = {}
+            for i in range(n):
+                tq = QModule.forward(
+                    target[f"q_{i}"], joint(batch["next_obs"]),
+                    joint(a2),
+                )
+                backup = jax.lax.stop_gradient(
+                    batch["rew"][:, i]
+                    + gamma * (1.0 - batch["done"]) * tq
+                )
+                q = QModule.forward(
+                    params[f"q_{i}"], joint(batch["obs"]),
+                    joint(batch["actions"]),
+                )
+                li = ((q - backup) ** 2).mean()
+                total = total + li
+                stats[f"critic_loss_{i}"] = li
+            return total, stats
+
+        def actor_loss(params, batch):
+            total = 0.0
+            for i in range(n):
+                acts = [
+                    DeterministicActorModule.forward(
+                        params[f"actor_{j}"], batch["obs"][:, j]
+                    ) if j == i else jax.lax.stop_gradient(
+                        batch["actions"][:, j]
+                    )
+                    for j in range(n)
+                ]
+                a = jnp.stack(acts, axis=1)
+                q = QModule.forward(
+                    jax.lax.stop_gradient(params[f"q_{i}"]),
+                    joint(batch["obs"]), joint(a),
+                )
+                total = total - q.mean()
+            return total
+
+        def step(params, opt_state, target, batch):
+            (closs, stats), cgrads = jax.value_and_grad(
+                critic_loss, has_aux=True
+            )(params, target, batch)
+            aloss, agrads = jax.value_and_grad(actor_loss)(
+                params, batch
+            )
+            grads = jax.tree.map(lambda a, b: a + b, cgrads, agrads)
+            updates, opt_state = self._tx.update(grads, opt_state,
+                                                 params)
+            params = optax.apply_updates(params, updates)
+            tau = self._tau
+            target = jax.tree.map(
+                lambda t, p: (1.0 - tau) * t + tau * p, target, params
+            )
+            stats["actor_loss"] = aloss
+            stats["critic_loss"] = closs
+            return params, opt_state, target, stats
+
+        self._jit_step = jax.jit(step)
+
+    def learn_on_batch(self, np_batch) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        if self._jit_step is None:
+            self._build_step()
+        jb = {k: jnp.asarray(v) for k, v in np_batch.items()}
+        self._params, self._opt_state, self._target, stats = (
+            self._jit_step(self._params, self._opt_state, self._target,
+                           jb)
+        )
+        self.num_updates += 1
+        return stats
+
+    def actor_weights(self) -> List[Any]:
+        import jax
+
+        return [
+            jax.tree.map(np.asarray, self._params[f"actor_{i}"])
+            for i in range(self._n)
+        ]
+
+
+class _MADDPGEnvRunner:
+    """CPU actor: steps the dict env with per-agent deterministic
+    actors + exploration noise; emits joint transitions."""
+
+    def __init__(self, env_creator, agent_ids, obs_dim, act_dim,
+                 low, high, hidden, noise, seed: int = 0,
+                 rollout_fragment_length: int = 200):
+        self.env = env_creator()
+        self.agent_ids = list(agent_ids)
+        rng = np.random.RandomState(seed)
+        self.weights = [
+            {
+                "trunk": init_mlp_params(rng, [obs_dim, hidden, hidden]),
+                "mu": init_mlp_params(rng, [hidden, act_dim]),
+            }
+            for _ in self.agent_ids
+        ]
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+        self.noise = noise
+        self.act_dim = act_dim
+        self.rng = np.random.RandomState(seed)
+        self.fragment = rollout_fragment_length
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_reward = 0.0
+        self._episode_rewards: List[float] = []
+
+    def set_weights(self, weights: List[Any]):
+        self.weights = weights
+
+    def _act(self, i: int, obs: np.ndarray) -> np.ndarray:
+        h = obs.reshape(-1)
+        for W, b in self.weights[i]["trunk"]:
+            h = np.tanh(h @ W + b)
+        (Wm, bm), = self.weights[i]["mu"]
+        u = np.tanh(h @ Wm + bm)
+        u = np.clip(u + self.noise * self.rng.randn(self.act_dim),
+                    -1.0, 1.0)
+        return (self.low + (u + 1.0) * 0.5
+                * (self.high - self.low)).astype(np.float32)
+
+    def _stack(self, obs_dict):
+        return np.stack([
+            np.asarray(obs_dict[a], np.float32).reshape(-1)
+            for a in self.agent_ids
+        ])
+
+    def sample(self) -> SampleBatch:
+        obs_l, act_l, rew_l, done_l, next_l = [], [], [], [], []
+        for _ in range(self.fragment):
+            joint = self._stack(self._obs)
+            # Critics train on [-1,1] actions; env gets scaled ones.
+            unit_actions = []
+            env_actions = {}
+            for i, a in enumerate(self.agent_ids):
+                env_a = self._act(i, joint[i])
+                u = (env_a - self.low) / (self.high - self.low) \
+                    * 2.0 - 1.0
+                unit_actions.append(u.astype(np.float32))
+                env_actions[a] = env_a
+            nxt, rew, term, trunc, _ = self.env.step(env_actions)
+            done = bool(term.get("__all__")) or bool(
+                trunc.get("__all__")
+            )
+            obs_l.append(joint)
+            act_l.append(np.stack(unit_actions))
+            rew_l.append([float(rew[a]) for a in self.agent_ids])
+            done_l.append(bool(term.get("__all__")))
+            next_l.append(self._stack(nxt))
+            self._episode_reward += float(sum(rew.values()))
+            if done:
+                self._episode_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        return SampleBatch({
+            "obs": np.stack(obs_l),            # [T, A, obs]
+            "actions": np.stack(act_l),        # [T, A, act] in [-1,1]
+            "rew": np.asarray(rew_l, np.float32),   # [T, A]
+            "done": np.asarray(done_l, np.float32),
+            "next_obs": np.stack(next_l),
+        })
+
+    def episode_stats(self) -> Dict[str, float]:
+        recent = self._episode_rewards[-20:]
+        return {
+            "episodes_total": len(self._episode_rewards),
+            "episode_reward_mean": float(np.mean(recent))
+            if recent else 0.0,
+        }
+
+
+class MADDPG:
+    def __init__(self, config: MADDPGConfig):
+        import ray_tpu
+
+        self.config = config
+        self.iteration = 0
+        c = config
+        creator = c.env_creator()
+        probe = creator()
+        obs0, _ = probe.reset(seed=0)
+        self.agent_ids = sorted(obs0.keys())
+        n = len(self.agent_ids)
+        obs_dim = int(np.prod(np.asarray(
+            obs0[self.agent_ids[0]]).shape))
+        if hasattr(probe, "close"):
+            probe.close()
+        low = -np.ones(c.act_dim, np.float32)
+        high = np.ones(c.act_dim, np.float32)
+        if hasattr(probe, "action_low"):
+            low = np.asarray(probe.action_low, np.float32)
+            high = np.asarray(probe.action_high, np.float32)
+        self._n, self._obs_dim = n, obs_dim
+
+        runner_cls = ray_tpu.remote(_MADDPGEnvRunner)
+        self.runners = [
+            runner_cls.remote(
+                creator, self.agent_ids, obs_dim, c.act_dim, low, high,
+                c.hidden_size, c.exploration_noise, seed=c.seed + i,
+                rollout_fragment_length=c.rollout_fragment_length,
+            )
+            for i in range(c.num_env_runners)
+        ]
+        self.learner = MADDPGLearner(
+            n, obs_dim, c.act_dim, c.hidden_size, c.lr, c.tau,
+            c.gamma, c.seed,
+        )
+        self.buffer = ReplayBuffer(c.buffer_size, seed=c.seed)
+        self._env_steps = 0
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        self.iteration += 1
+        c = self.config
+        batches = ray_tpu.get([r.sample.remote() for r in self.runners])
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += b.count
+
+        stats: Dict[str, Any] = {}
+        num_updates = 0
+        if self._env_steps >= c.num_steps_sampled_before_learning_starts:
+            for _ in range(c.num_updates_per_iteration):
+                mb = self.buffer.sample(c.minibatch_size)
+                stats = self.learner.learn_on_batch({
+                    "obs": mb["obs"], "actions": mb["actions"],
+                    "rew": mb["rew"], "done": mb["done"],
+                    "next_obs": mb["next_obs"],
+                })
+                num_updates += 1
+            stats = {k: float(v) for k, v in stats.items()}
+            weights = self.learner.actor_weights()
+            ray_tpu.get(
+                [r.set_weights.remote(weights) for r in self.runners]
+            )
+
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": self._env_steps,
+            "num_learner_updates": num_updates,
+            **stats,
+        }
+
+    def get_weights(self):
+        return self.learner.actor_weights()
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,398 @@
+"""R2D2: recurrent experience replay in distributed RL.
+
+Ref analogue: rllib/algorithms/r2d2 (Kapturowski 2019). A partially
+observable env needs memory: the Q-network is an LSTM, replay stores
+fixed-length SEQUENCES with the recurrent state captured at sequence
+start (the paper's "stored state" strategy), and the learner unrolls
+the online and target nets over each sequence with ``lax.scan``,
+applying a masked double-Q TD loss per step. Rollouts run the same
+LSTM cell in numpy, carrying hidden state across env steps and
+resetting it at episode boundaries; sequences never cross an episode
+boundary (short tails are zero-padded and masked).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+from .policy import init_mlp_params
+from .replay_buffers import ReplayBuffer
+from .sample_batch import SampleBatch
+
+
+def _lstm_step_np(w, x, h, c):
+    z = x @ w["wx"] + h @ w["wh"] + w["b"]
+    H = h.shape[-1]
+    i = 1.0 / (1.0 + np.exp(-z[..., :H]))
+    f = 1.0 / (1.0 + np.exp(-z[..., H:2 * H]))
+    g = np.tanh(z[..., 2 * H:3 * H])
+    o = 1.0 / (1.0 + np.exp(-z[..., 3 * H:]))
+    c2 = f * c + i * g
+    h2 = o * np.tanh(c2)
+    return h2, c2
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size: int = 2_000       # sequences
+        self.num_steps_sampled_before_learning_starts: int = 400
+        self.target_network_update_freq: int = 600
+        self.num_updates_per_iteration: int = 24
+        self.seq_len: int = 12
+        self.lstm_size: int = 32
+        self.epsilon_initial: float = 1.0
+        self.epsilon_final: float = 0.05
+        self.epsilon_timesteps: int = 6_000
+        self.minibatch_size = 32            # sequences per batch
+
+    def build(self) -> "R2D2":
+        return R2D2(self.copy())
+
+
+def _init_params(obs_dim: int, num_actions: int, hidden: int,
+                 seed: int) -> Dict[str, Any]:
+    rng = np.random.RandomState(seed)
+    scale = 1.0 / np.sqrt(obs_dim + hidden)
+    return {
+        "wx": (rng.randn(obs_dim, 4 * hidden) * scale
+               ).astype(np.float32),
+        "wh": (rng.randn(hidden, 4 * hidden) * scale
+               ).astype(np.float32),
+        "b": np.zeros(4 * hidden, np.float32),
+        "q": init_mlp_params(rng, [hidden, num_actions]),
+    }
+
+
+class _R2D2Policy:
+    """numpy LSTM inference with carried hidden state."""
+
+    def __init__(self, obs_dim, num_actions, hidden, seed):
+        self.weights = _init_params(obs_dim, num_actions, hidden, seed)
+        self.hidden = hidden
+        self.num_actions = num_actions
+        self.epsilon = 1.0
+        self.reset_state()
+
+    def reset_state(self):
+        self.h = np.zeros(self.hidden, np.float32)
+        self.c = np.zeros(self.hidden, np.float32)
+
+    def set_weights(self, weights):
+        self.weights = weights
+
+    def set_epsilon(self, eps):
+        self.epsilon = float(eps)
+
+    def state(self):
+        return self.h.copy(), self.c.copy()
+
+    def compute_action(self, obs, rng):
+        self.h, self.c = _lstm_step_np(
+            self.weights, np.asarray(obs, np.float32).reshape(-1),
+            self.h, self.c,
+        )
+        if rng.rand() < self.epsilon:
+            return int(rng.randint(self.num_actions)), 0.0, 0.0
+        (Wq, bq), = self.weights["q"]
+        return int(np.argmax(self.h @ Wq + bq)), 0.0, 0.0
+
+
+class _R2D2EnvRunner:
+    """Collects padded fixed-length sequences with stored initial
+    recurrent state; resets the LSTM at episode boundaries."""
+
+    def __init__(self, env_creator, policy_factory, seed=0,
+                 rollout_fragment_length=200, seq_len=12, **_):
+        self.env = env_creator()
+        self.policy = policy_factory()
+        self.rng = np.random.RandomState(seed)
+        self.fragment = rollout_fragment_length
+        self.L = seq_len
+        self._obs, _ = self.env.reset(seed=seed)
+        self.policy.reset_state()
+        self._episode_reward = 0.0
+        self._episode_rewards: List[float] = []
+
+    def set_weights(self, w):
+        self.policy.set_weights(w)
+
+    def set_epsilon(self, e):
+        self.policy.set_epsilon(e)
+
+    def sample(self) -> SampleBatch:
+        L = self.L
+        seqs: List[Dict[str, np.ndarray]] = []
+        cur = self._new_seq()
+        steps = 0
+        while steps < self.fragment:
+            obs = np.asarray(self._obs, np.float32).reshape(-1)
+            a, _, _ = self.policy.compute_action(obs, self.rng)
+            nxt, r, term, trunc, _ = self.env.step(a)
+            done = bool(term or trunc)
+            cur["obs"].append(obs)
+            cur["actions"].append(a)
+            cur["rewards"].append(float(r))
+            cur["dones"].append(bool(term))
+            self._episode_reward += float(r)
+            steps += 1
+            if done:
+                self._episode_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs, _ = self.env.reset()
+                self.policy.reset_state()
+                cur["obs"].append(
+                    np.asarray(nxt, np.float32).reshape(-1)
+                )
+                seqs.append(self._finish(cur, L))
+                cur = self._new_seq()
+            else:
+                self._obs = nxt
+                if len(cur["actions"]) == L:
+                    cur["obs"].append(
+                        np.asarray(self._obs, np.float32).reshape(-1)
+                    )
+                    seqs.append(self._finish(cur, L))
+                    cur = self._new_seq()
+        if cur["actions"]:
+            cur["obs"].append(
+                np.asarray(self._obs, np.float32).reshape(-1)
+            )
+            seqs.append(self._finish(cur, L))
+        return SampleBatch({
+            k: np.stack([s[k] for s in seqs])
+            for k in seqs[0]
+        })
+
+    def _new_seq(self):
+        h, c = self.policy.state()
+        return {"obs": [], "actions": [], "rewards": [], "dones": [],
+                "h0": h, "c0": c}
+
+    def _finish(self, cur, L):
+        n = len(cur["actions"])
+        obs_dim = cur["obs"][0].shape[0]
+        obs = np.zeros((L + 1, obs_dim), np.float32)
+        obs[:n + 1] = np.stack(cur["obs"])
+        out = {
+            "obs": obs,
+            "actions": np.zeros(L, np.int32),
+            "rewards": np.zeros(L, np.float32),
+            "dones": np.zeros(L, np.float32),
+            "mask": np.zeros(L, np.float32),
+            "h0": cur["h0"], "c0": cur["c0"],
+        }
+        out["actions"][:n] = cur["actions"]
+        out["rewards"][:n] = cur["rewards"]
+        out["dones"][:n] = np.asarray(cur["dones"], np.float32)
+        out["mask"][:n] = 1.0
+        return out
+
+    def episode_stats(self) -> Dict[str, float]:
+        recent = self._episode_rewards[-20:]
+        return {
+            "episodes_total": len(self._episode_rewards),
+            "episode_reward_mean": float(np.mean(recent))
+            if recent else 0.0,
+        }
+
+
+class R2D2Learner:
+    """Sequence double-Q learner: lax.scan unroll of online + target
+    LSTMs from the stored initial state, masked TD loss."""
+
+    def __init__(self, obs_dim, num_actions, hidden, lr, gamma, seed):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._tx = optax.adam(lr)
+        self._params = jax.tree.map(
+            jnp.asarray, _init_params(obs_dim, num_actions, hidden,
+                                      seed)
+        )
+        self._target = jax.tree.map(lambda x: x, self._params)
+        self._opt_state = self._tx.init(self._params)
+        H = hidden
+
+        def unroll(w, obs, h0, c0):
+            """obs [B, T, D] -> q [B, T, A]."""
+            def cell(carry, x):
+                h, c = carry
+                z = x @ w["wx"] + h @ w["wh"] + w["b"]
+                i = jax.nn.sigmoid(z[..., :H])
+                f = jax.nn.sigmoid(z[..., H:2 * H])
+                g = jnp.tanh(z[..., 2 * H:3 * H])
+                o = jax.nn.sigmoid(z[..., 3 * H:])
+                c2 = f * c + i * g
+                h2 = o * jnp.tanh(c2)
+                return (h2, c2), h2
+
+            _, hs = jax.lax.scan(
+                cell, (h0, c0), jnp.swapaxes(obs, 0, 1)
+            )
+            hs = jnp.swapaxes(hs, 0, 1)          # [B, T, H]
+            (Wq, bq), = w["q"]
+            return hs @ Wq + bq
+
+        def loss_fn(params, target, batch):
+            q_all = unroll(params, batch["obs"], batch["h0"],
+                           batch["c0"])                    # [B,T+1,A]
+            tq_all = unroll(target, batch["obs"], batch["h0"],
+                            batch["c0"])
+            q_sa = jnp.take_along_axis(
+                q_all[:, :-1], batch["actions"][..., None], axis=-1
+            )[..., 0]                                      # [B,L]
+            best = jnp.argmax(q_all[:, 1:], axis=-1)       # online pick
+            q_next = jnp.take_along_axis(
+                tq_all[:, 1:], best[..., None], axis=-1
+            )[..., 0]
+            y = batch["rewards"] + gamma * (1.0 - batch["dones"]) \
+                * q_next
+            td = (q_sa - jax.lax.stop_gradient(y)) * batch["mask"]
+            loss = (td * td).sum() / jnp.maximum(
+                batch["mask"].sum(), 1.0
+            )
+            return loss
+
+        def update(params, opt_state, target, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, target, batch
+            )
+            updates, opt_state = self._tx.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = jax.jit(update)
+        self._gamma = gamma
+
+    def learn_on_batch(self, mb) -> float:
+        import jax.numpy as jnp
+
+        batch = {
+            "obs": jnp.asarray(mb["obs"]),
+            "actions": jnp.asarray(mb["actions"], jnp.int32),
+            "rewards": jnp.asarray(mb["rewards"]),
+            "dones": jnp.asarray(mb["dones"]),
+            "mask": jnp.asarray(mb["mask"]),
+            "h0": jnp.asarray(mb["h0"]),
+            "c0": jnp.asarray(mb["c0"]),
+        }
+        self._params, self._opt_state, loss = self._update(
+            self._params, self._opt_state, self._target, batch
+        )
+        return float(loss)
+
+    def sync_target(self):
+        import jax
+
+        self._target = jax.tree.map(lambda x: x, self._params)
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params)
+
+
+class R2D2:
+    def __init__(self, config: R2D2Config):
+        import ray_tpu
+
+        self.config = config
+        self.iteration = 0
+        c = config
+        creator = c.env_creator()
+        probe = creator()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        if not hasattr(probe.action_space, "n"):
+            raise ValueError("R2D2 supports discrete action spaces")
+        num_actions = int(probe.action_space.n)
+        if hasattr(probe, "close"):
+            probe.close()
+        self._obs_dim, self._num_actions = obs_dim, num_actions
+
+        def policy_factory(obs_dim=obs_dim, n=num_actions,
+                           hidden=c.lstm_size, seed=c.seed):
+            return _R2D2Policy(obs_dim, n, hidden, seed)
+
+        runner_cls = ray_tpu.remote(_R2D2EnvRunner)
+        self.runners = [
+            runner_cls.remote(
+                creator, policy_factory, seed=c.seed + i,
+                rollout_fragment_length=c.rollout_fragment_length,
+                seq_len=c.seq_len,
+            )
+            for i in range(c.num_env_runners)
+        ]
+        self.learner = R2D2Learner(
+            obs_dim, num_actions, c.lstm_size, c.lr, c.gamma, c.seed
+        )
+        self.buffer = ReplayBuffer(c.buffer_size, seed=c.seed)
+        self._env_steps = 0
+        self._last_target_sync = 0
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._env_steps / max(1, c.epsilon_timesteps))
+        return c.epsilon_initial + frac * (
+            c.epsilon_final - c.epsilon_initial
+        )
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        self.iteration += 1
+        c = self.config
+        eps = self._epsilon()
+        ray_tpu.get([r.set_epsilon.remote(eps) for r in self.runners])
+        batches = ray_tpu.get([r.sample.remote() for r in self.runners])
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += int(b["mask"].sum())
+
+        loss = float("nan")
+        num_updates = 0
+        if self._env_steps >= c.num_steps_sampled_before_learning_starts:
+            for _ in range(c.num_updates_per_iteration):
+                mb = self.buffer.sample(c.minibatch_size)
+                loss = self.learner.learn_on_batch(mb)
+                num_updates += 1
+            if (self._env_steps - self._last_target_sync
+                    >= c.target_network_update_freq):
+                self.learner.sync_target()
+                self._last_target_sync = self._env_steps
+            w = self.learner.get_weights()
+            ray_tpu.get(
+                [r.set_weights.remote(w) for r in self.runners]
+            )
+
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": self._env_steps,
+            "num_learner_updates": num_updates,
+            "epsilon": eps,
+            "loss": loss,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
