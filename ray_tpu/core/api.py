@@ -152,6 +152,17 @@ def shutdown():
     rt = runtime_context.current_runtime_or_none()
     if rt is None:
         return
+    try:
+        # Local-only usage report into the session dir (zero egress;
+        # ref analogue: usage_lib's shutdown report).
+        from ..util import usage_stats
+
+        session_dir = getattr(getattr(rt, "_nm", None),
+                              "session_dir", None)
+        if session_dir:
+            usage_stats.write_report(session_dir)
+    except Exception:
+        pass
     runtime_context.set_runtime(None)
     monitor = getattr(rt, "log_monitor", None)
     if monitor is not None:

@@ -108,6 +108,9 @@ class Config:
     # Echo worker stdout/stderr to the driver with (pid=, node=) prefixes
     # (ref analogue: log_monitor.py + worker log streaming to driver).
     log_to_driver: bool = True
+    # Per-node dashboard agent (logs/stats/profile HTTP endpoints the
+    # head dashboard proxies to; ref analogue: dashboard/agent.py).
+    dashboard_agent: bool = True
     # Load-report period from each node to the GCS (ref analogue:
     # raylet_report_resources_period_ms via the RaySyncer).
     heartbeat_interval_s: float = 0.25

@@ -398,6 +398,16 @@ class NodeManager:
             )
         for _ in range(self.config.num_prestart_workers):
             self._loop.call_soon_threadsafe(self._spawn_worker)
+        self.dashboard_agent = None
+        if getattr(self.config, "dashboard_agent", True):
+            try:
+                from ..dashboard_agent import DashboardAgent
+
+                self.dashboard_agent = DashboardAgent(
+                    self, host=self.node_ip
+                ).start()
+            except Exception:
+                self.dashboard_agent = None
 
     def _run_loop(self):
         asyncio.set_event_loop(self._loop)
@@ -3634,6 +3644,8 @@ class NodeManager:
         if self._shutdown:
             return
         self._shutdown = True
+        if getattr(self, "dashboard_agent", None) is not None:
+            self.dashboard_agent.stop()
 
         async def _stop():
             if self._bg_tasks:

@@ -106,6 +106,38 @@ class _Handler(BaseHTTPRequestHandler):
                 "/api/summary/actors": state.summarize_actors,
                 "/api/summary/objects": state.summarize_objects,
             }
+            if path == "/api/agents":
+                # Registered per-node agents (ref: dashboard head's
+                # DataSource of agent addresses).
+                from .dashboard_agent import agent_addresses
+
+                self._json(agent_addresses())
+                return
+            if path.startswith("/api/agent/"):
+                # Proxy /api/agent/<node_hex>/<rest> to that node's
+                # agent (ref: head -> dashboard_agent fan-out).
+                import urllib.request
+
+                from .dashboard_agent import agent_addresses
+
+                rest = path[len("/api/agent/"):]
+                node_hex, _, sub = rest.partition("/")
+                addr = agent_addresses().get(node_hex)
+                if addr is None:
+                    self._json({"error": f"no agent for {node_hex}"},
+                               404)
+                    return
+                query = self.path.partition("?")[2]
+                url = (f"http://{addr}/api/local/{sub}"
+                       + (f"?{query}" if query else ""))
+                with urllib.request.urlopen(url, timeout=35) as r:
+                    body = r.read()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if path == "/api/profile":
                 # On-demand stack-sampling profile of the control plane
                 # (driver + node-manager threads), collapsed-stack format

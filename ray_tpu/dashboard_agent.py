@@ -1,0 +1,167 @@
+"""Per-node dashboard agent.
+
+Ref analogue: dashboard/agent.py — every node runs an agent the head
+dashboard fans out to for node-local data: log files, process stats,
+and on-demand profiles (the reference spawns it as a separate process
+from the raylet, dashboard/modules/reporter/; here it is an HTTP
+thread inside the node-manager process — same surface, one fewer
+process). The agent registers ``host:port`` under
+``__dashboard_agent__/<node_hex>`` in the cluster KV; the head
+dashboard's ``/api/agent/<node_hex>/<path>`` proxies to it.
+
+Endpoints (all JSON):
+  /api/local/logs              — log files in this node's session dir
+  /api/local/logs/<name>?tail= — tail of one log file
+  /api/local/stats             — process cpu/rss + store/loop stats
+  /api/local/profile?seconds=  — collapsed-stack samples of this node
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+
+class _AgentHandler(BaseHTTPRequestHandler):
+    node_manager = None  # class attr, set at server build
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, payload: Any, code: int = 200):
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — stdlib API
+        from urllib.parse import parse_qs, urlparse
+
+        try:
+            parsed = urlparse(self.path)
+            path = parsed.path.rstrip("/")
+            q = parse_qs(parsed.query)
+            nm = self.node_manager
+            logs_dir = os.path.join(nm.session_dir, "logs")
+            if path == "/api/local/logs":
+                files = []
+                if os.path.isdir(logs_dir):
+                    for name in sorted(os.listdir(logs_dir)):
+                        p = os.path.join(logs_dir, name)
+                        files.append({
+                            "name": name,
+                            "size": os.path.getsize(p),
+                        })
+                self._json({"node_id": nm.node_id.hex(),
+                            "files": files})
+                return
+            if path.startswith("/api/local/logs/"):
+                name = os.path.basename(path.rsplit("/", 1)[-1])
+                p = os.path.join(logs_dir, name)
+                if not os.path.isfile(p):
+                    self._json({"error": f"no log {name}"}, 404)
+                    return
+                tail = int(q.get("tail", ["200"])[0])
+                with open(p, "r", errors="replace") as f:
+                    lines = f.readlines()[-tail:]
+                self._json({"name": name, "lines": lines})
+                return
+            if path == "/api/local/stats":
+                self._json(self._stats())
+                return
+            if path == "/api/local/profile":
+                from .dashboard import _sample_stacks
+
+                seconds = min(30.0, float(q.get("seconds", ["2"])[0]))
+                hz = min(200, int(q.get("hz", ["100"])[0]))
+                self._json(_sample_stacks(seconds, hz))
+                return
+            self._json({"error": f"unknown path {path}"}, 404)
+        except Exception as e:  # noqa: BLE001
+            self._json({"error": repr(e)}, 500)
+
+    def _stats(self) -> dict:
+        """cpu/rss from /proc (psutil-free), plus node-manager gauges
+        (ref: dashboard/modules/reporter's per-node stats)."""
+        nm = self.node_manager
+        out: dict = {"node_id": nm.node_id.hex(), "pid": os.getpid()}
+        try:
+            with open("/proc/self/statm") as f:
+                pages = int(f.read().split()[1])
+            out["rss_bytes"] = pages * os.sysconf("SC_PAGE_SIZE")
+            with open("/proc/self/stat") as f:
+                parts = f.read().split()
+            tick = os.sysconf("SC_CLK_TCK")
+            out["cpu_seconds"] = (int(parts[13]) + int(parts[14])) / tick
+            out["num_threads"] = int(parts[19])
+        except Exception:
+            pass
+        try:
+            out["load_avg"] = list(os.getloadavg())
+        except Exception:
+            pass
+        try:
+            out["num_workers"] = len(nm.workers)
+        except Exception:
+            pass
+        return out
+
+
+class DashboardAgent:
+    def __init__(self, node_manager, host: str = "127.0.0.1",
+                 port: int = 0):
+        handler = type(
+            "_BoundAgentHandler", (_AgentHandler,),
+            {"node_manager": node_manager},
+        )
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="dashboard-agent",
+        )
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._nm = node_manager
+
+    def start(self) -> "DashboardAgent":
+        self._thread.start()
+        # Register in the cluster KV so the head dashboard can proxy.
+        nm = self._nm
+
+        async def register():
+            if nm._gcs is not None:
+                await nm._gcs.kv_put(
+                    f"__dashboard_agent__/{nm.node_id.hex()}",
+                    f"{self.host}:{self.port}".encode(),
+                    True,
+                )
+
+        try:
+            nm.call_sync(register(), timeout=10)
+        except Exception:
+            pass
+        return self
+
+    def stop(self):
+        try:
+            self._server.shutdown()
+        except Exception:
+            pass
+
+
+def agent_addresses() -> dict:
+    """{node_hex: "host:port"} of registered agents (driver-side)."""
+    from .core import runtime_context
+
+    rt = runtime_context.current_runtime()
+    out = {}
+    for key in rt.kv_keys("__dashboard_agent__/"):
+        v = rt.kv_get(key)
+        if v:
+            out[key.rsplit("/", 1)[-1]] = v.decode()
+    return out

@@ -27,3 +27,6 @@ from .read_api import (  # noqa: F401
     read_tfrecords,
     read_webdataset,
 )
+
+from ray_tpu.util import usage_stats as _usage
+_usage.record_library_usage("data")

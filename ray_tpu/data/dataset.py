@@ -432,6 +432,43 @@ class Dataset:
         for block in self._iter_blocks():
             yield from BlockAccessor(block).iter_rows()
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes=None, device=None,
+                           drop_last: bool = False) -> Iterator[Any]:
+        """Batches as {column: torch.Tensor} dicts (ref:
+        iterator.py iter_torch_batches:242). Tensors wrap the numpy
+        batch buffers without copy where torch allows (the store's
+        read-only views are cloned first — torch cannot alias
+        non-writable memory without a warning)."""
+        import numpy as np
+        import torch
+
+        for batch in self.iter_batches(
+            batch_size=batch_size, batch_format="numpy",
+            drop_last=drop_last,
+        ):
+            out = {}
+            for k, v in batch.items():
+                arr = np.ascontiguousarray(v) if not (
+                    isinstance(v, np.ndarray) and v.flags["C_CONTIGUOUS"]
+                ) else v
+                if isinstance(arr, np.ndarray) and \
+                        not arr.flags.writeable:
+                    arr = arr.copy()
+                if arr.dtype == object:
+                    out[k] = list(arr)  # strings/ragged pass through
+                    continue
+                t = torch.from_numpy(arr)
+                if dtypes is not None:
+                    want = (dtypes.get(k) if isinstance(dtypes, dict)
+                            else dtypes)
+                    if want is not None:
+                        t = t.to(want)
+                if device is not None:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def iter_jax_batches(self, *, batch_size: int = 256, device=None,
                          drop_last: bool = True,
                          zero_copy: Optional[bool] = None
@@ -650,6 +687,139 @@ class Dataset:
                     _pin=flat._pin)
             for i in range(n)
         ]
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        """Split at global row offsets (ref: dataset.split_at_indices);
+        materializes block boundaries."""
+        bounds = list(indices) + [None]
+        out: List[List[Block]] = [[] for _ in bounds]
+        row = 0
+        part = 0
+        for block in self._iter_blocks():
+            off = 0
+            while off < block.num_rows:
+                end = bounds[part]
+                if end is None:
+                    out[part].append(block.slice(
+                        off, block.num_rows - off
+                    ))
+                    off = block.num_rows
+                    continue
+                take = min(block.num_rows - off, end - row)
+                if take > 0:
+                    out[part].append(block.slice(off, take))
+                    off += take
+                    row += take
+                if row >= end:
+                    part += 1
+            # blocks exhausted; advance parts with zero-length bounds
+            while bounds[part] is not None and row >= bounds[part]:
+                part += 1
+        from .block import from_numpy_dict
+
+        return [
+            Dataset.from_blocks(blocks or [from_numpy_dict({})],
+                                _pin=self._pin)
+            for blocks in out
+        ]
+
+    def split_proportionately(self, proportions: List[float]
+                              ) -> List["Dataset"]:
+        """Split by fractions; the remainder forms the final split
+        (ref: dataset.split_proportionately)."""
+        if not proportions or sum(proportions) >= 1.0 or \
+                any(p <= 0 for p in proportions):
+            raise ValueError(
+                "proportions must be positive and sum to < 1"
+            )
+        n = self.count()
+        indices, acc = [], 0
+        for p in proportions:
+            acc += int(n * p)
+            indices.append(acc)
+        return self.split_at_indices(indices)
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> List["Dataset"]:
+        """(train, test) split (ref: dataset.train_test_split)."""
+        if not 0 < test_size < 1:
+            raise ValueError("test_size must be in (0, 1)")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        train, test = ds.split_proportionately([1.0 - test_size])
+        return [train, test]
+
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (ref: dataset.random_sample); lazy."""
+        if not 0 <= fraction <= 1:
+            raise ValueError("fraction must be in [0, 1]")
+
+        def sample(batch):
+            import numpy as _np
+
+            rng = (_np.random.default_rng(seed) if seed is not None
+                   else _np.random.default_rng())
+            n = len(next(iter(batch.values()), []))
+            mask = rng.random(n) < fraction
+            return {k: _np.asarray(v)[mask] for k, v in batch.items()}
+
+        return self.map_batches(sample, batch_format="numpy")
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (ref: dataset.unique)."""
+        seen = {}
+        for batch in self.select_columns([column]).iter_batches(
+            batch_format="numpy"
+        ):
+            for v in batch[column]:
+                key = v.item() if hasattr(v, "item") else v
+                seen.setdefault(key, None)
+        return list(seen)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        """Rename columns lazily (ref: dataset.rename_columns)."""
+
+        def rename(batch):
+            return {mapping.get(k, k): v for k, v in batch.items()}
+
+        return self.map_batches(rename, batch_format="numpy")
+
+    # -- column aggregates (ref: dataset.sum/min/max/mean/std) --
+
+    def _agg_column(self, on: str):
+        import numpy as _np
+
+        parts = [
+            _np.asarray(b[on])
+            for b in self.select_columns([on]).iter_batches(
+                batch_format="numpy"
+            )
+            if len(b[on])
+        ]
+        return _np.concatenate(parts) if parts else _np.asarray([])
+
+    def sum(self, on: str):
+        vals = self._agg_column(on)
+        return vals.sum().item() if vals.size else None
+
+    def min(self, on: str):
+        vals = self._agg_column(on)
+        return vals.min().item() if vals.size else None
+
+    def max(self, on: str):
+        vals = self._agg_column(on)
+        return vals.max().item() if vals.size else None
+
+    def mean(self, on: str):
+        vals = self._agg_column(on)
+        return vals.mean().item() if vals.size else None
+
+    def std(self, on: str, ddof: int = 1):
+        vals = self._agg_column(on)
+        return (vals.std(ddof=ddof).item()
+                if vals.size > ddof else None)
 
     def __repr__(self):
         return self.stats()

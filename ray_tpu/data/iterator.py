@@ -122,6 +122,9 @@ class DataIterator:
     def iter_jax_batches(self, **kw) -> Iterator[Any]:
         return self._shard().iter_jax_batches(**kw)
 
+    def iter_torch_batches(self, **kw) -> Iterator[Any]:
+        return self._shard().iter_torch_batches(**kw)
+
     def count(self) -> int:
         return self._shard().count()
 

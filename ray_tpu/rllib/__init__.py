@@ -51,3 +51,6 @@ from .replay_buffers import (  # noqa: F401
     ReplayBuffer,
 )
 from .sample_batch import SampleBatch, compute_gae  # noqa: F401
+
+from ray_tpu.util import usage_stats as _usage
+_usage.record_library_usage("rllib")

@@ -24,3 +24,6 @@ from .grpc_ingress import (  # noqa: F401
     start_per_node_grpc_proxies,
     stop_grpc_ingress,
 )
+
+from ray_tpu.util import usage_stats as _usage
+_usage.record_library_usage("serve")

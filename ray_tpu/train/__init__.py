@@ -30,3 +30,6 @@ from .session import (  # noqa: F401
 )
 from .trainer import JaxTrainer, TrainWorkerGroupError  # noqa: F401
 from .torch import TorchTrainer  # noqa: F401
+
+from ray_tpu.util import usage_stats as _usage
+_usage.record_library_usage("train")

@@ -19,6 +19,7 @@ from .loggers import (  # noqa: F401
 from .schedulers import (  # noqa: F401
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
     HyperBandScheduler,
     MedianStoppingRule,
     PB2,
@@ -32,6 +33,7 @@ from .search import (  # noqa: F401
     HyperOptSearch,
     OptunaSearch,
     Searcher,
+    TPESearch,
 )
 from .stoppers import (  # noqa: F401
     CombinedStopper,
@@ -52,3 +54,6 @@ from .search_space import (  # noqa: F401
     uniform,
 )
 from .tuner import ResultGrid, TrialResult, TuneConfig, Tuner  # noqa: F401
+
+from ray_tpu.util import usage_stats as _usage
+_usage.record_library_usage("tune")

@@ -390,3 +390,38 @@ class PB2(PopulationBasedTraining):
             blo, bhi = self.bounds[k]
             out[k] = float(blo + cand[best, i] * (bhi - blo))
         return out
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """BOHB = HyperBand brackets + model-based sampling (ref:
+    tune/schedulers/hb_bohb.py HyperBandForBOHB, Falkner 2018). The
+    bracket/rung culling is inherited; the coupling is that every rung
+    result is FED BACK to the attached TPESearch (search.py) with its
+    budget, so suggestions for later trials come from the density
+    model instead of the prior — attach the same searcher instance to
+    both Tuner(search_alg=...) and this scheduler."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3,
+                 searcher=None):
+        super().__init__(metric, mode, time_attr, max_t,
+                         reduction_factor)
+        self._searcher = searcher
+        self._configs: Dict[str, Dict] = {}
+
+    def on_trial_start(self, trial_id: str, config: Dict[str, Any]):
+        super().on_trial_start(trial_id, config)
+        self._configs[trial_id] = dict(config)
+
+    def on_result(self, trial_id: str, result: Dict):
+        decision = super().on_result(trial_id, result)
+        if self._searcher is not None and \
+                self.metric in (result or {}):
+            cfg = self._configs.get(trial_id)
+            if cfg is not None:
+                self._searcher.observe(
+                    cfg, result[self.metric],
+                    budget=float(result.get(self.time_attr, 1.0)),
+                )
+        return decision

@@ -352,3 +352,171 @@ class HyperOptSearch(Searcher):
             trial["result"] = {"loss": loss, "status": "ok"}
             trial["state"] = 2  # JOB_STATE_DONE
         self._ho_trials.refresh()
+
+
+class TPESearch(Searcher):
+    """Tree-structured Parzen Estimator search — the sampler BOHB runs
+    inside HyperBand brackets (ref: tune/search/bohb/bohb_search.py
+    TuneBOHB; the reference delegates to hpbandster+ConfigSpace, which
+    are not bundled, so the estimator is implemented here: observations
+    split at the ``gamma`` quantile into good/bad sets, each modeled
+    with a per-dimension kernel density (gaussian KDE for numeric
+    domains in transformed space, smoothed counts for Choice), and the
+    candidate maximizing l_good(x)/l_bad(x) is suggested).
+
+    ``observe(config, score, budget)`` feeds INTERMEDIATE rung results
+    (HyperBandForBOHB calls it), modeling on the largest budget with
+    enough observations — the BOHB rule."""
+
+    def __init__(self, space: Dict[str, Any], *, metric: str,
+                 mode: str = "max", n_initial: int = 8,
+                 gamma: float = 0.25, n_candidates: int = 64,
+                 min_points_in_model: int = 6, seed: int = 0):
+        super().__init__(metric, mode)
+        self.space = space
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.min_points = min_points_in_model
+        self._rng = np.random.RandomState(seed)
+        # (budget -> [(xmap, score)]) ; score already sign-fixed to max.
+        self._obs: Dict[float, List[tuple]] = {}
+        self._num_suggested = 0
+        self._by_trial: Dict[str, Dict[str, Any]] = {}
+
+    # -- transforms per domain ------------------------------------------
+
+    def _to_unit(self, spec, v) -> Optional[float]:
+        from .search_space import Choice, LogUniform, RandInt, Uniform
+        import math as _m
+
+        if isinstance(spec, LogUniform):
+            return ((_m.log(v) - _m.log(spec.low))
+                    / (_m.log(spec.high) - _m.log(spec.low)))
+        if isinstance(spec, Uniform):
+            return (v - spec.low) / (spec.high - spec.low)
+        if isinstance(spec, RandInt):
+            return (v - spec.low) / max(1, spec.high - 1 - spec.low)
+        return None
+
+    def _from_unit(self, spec, u: float):
+        from .search_space import LogUniform, RandInt, Uniform
+        import math as _m
+
+        u = min(1.0, max(0.0, u))
+        if isinstance(spec, LogUniform):
+            return float(_m.exp(
+                _m.log(spec.low)
+                + u * (_m.log(spec.high) - _m.log(spec.low))
+            ))
+        if isinstance(spec, Uniform):
+            return float(spec.low + u * (spec.high - spec.low))
+        if isinstance(spec, RandInt):
+            return int(round(spec.low
+                             + u * max(1, spec.high - 1 - spec.low)))
+        return None
+
+    # -- model ----------------------------------------------------------
+
+    def observe(self, config: Dict[str, Any], score: float,
+                budget: float = 1.0):
+        s = float(score) if self.mode == "max" else -float(score)
+        self._obs.setdefault(float(budget), []).append((dict(config), s))
+
+    def _model_obs(self) -> List[tuple]:
+        for budget in sorted(self._obs, reverse=True):
+            if len(self._obs[budget]) >= self.min_points:
+                return self._obs[budget]
+        # Fall back to everything pooled.
+        return [o for obs in self._obs.values() for o in obs]
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        from .search_space import Choice, Domain, GridSearch
+
+        self._num_suggested += 1
+        obs = self._model_obs()
+        if self._num_suggested <= self.n_initial or \
+                len(obs) < self.min_points:
+            cfg = {
+                k: (v.sample(self._rng) if isinstance(v, Domain)
+                    else v)
+                for k, v in self.space.items()
+            }
+            self._by_trial[trial_id] = cfg
+            return cfg
+        ranked = sorted(obs, key=lambda o: -o[1])
+        n_good = max(2, int(np.ceil(self.gamma * len(ranked))))
+        good = [o[0] for o in ranked[:n_good]]
+        bad = [o[0] for o in ranked[n_good:]] or good
+
+        def kde_ratio(key, spec, value) -> float:
+            u = self._to_unit(spec, value)
+            if u is None:          # Choice: smoothed count ratio
+                cats = spec.categories
+                gcount = sum(1 for g in good if g.get(key) == value)
+                bcount = sum(1 for b in bad if b.get(key) == value)
+                lg = (gcount + 1) / (len(good) + len(cats))
+                lb = (bcount + 1) / (len(bad) + len(cats))
+                return lg / lb
+
+            def kde(points):
+                us = [self._to_unit(spec, p.get(key)) for p in points]
+                us = [x for x in us if x is not None]
+                if not us:
+                    return 1.0
+                bw = max(0.08, np.std(us) * len(us) ** -0.2)
+                d = (np.asarray(us) - u) / bw
+                return float(np.exp(-0.5 * d * d).sum()
+                             / (len(us) * bw)) + 1e-9
+
+            return kde(good) / kde(bad)
+
+        best_cfg, best_score = None, -np.inf
+        for _ in range(self.n_candidates):
+            # Sample each dim from the GOOD model: perturb a random
+            # good observation (numeric) / sample good counts (choice).
+            cand: Dict[str, Any] = {}
+            ratio = 1.0
+            for key, spec in self.space.items():
+                if isinstance(spec, Choice):
+                    weights = np.asarray([
+                        sum(1 for g in good if g.get(key) == c) + 1.0
+                        for c in spec.categories
+                    ])
+                    cand[key] = spec.categories[int(self._rng.choice(
+                        len(spec.categories),
+                        p=weights / weights.sum(),
+                    ))]
+                elif isinstance(spec, GridSearch):
+                    cand[key] = spec.values[
+                        self._rng.randint(len(spec.values))
+                    ]
+                elif isinstance(spec, Domain):
+                    anchor = good[self._rng.randint(len(good))]
+                    u = self._to_unit(spec, anchor.get(key))
+                    if u is None:
+                        cand[key] = spec.sample(self._rng)
+                        continue
+                    u = u + self._rng.randn() * 0.12
+                    cand[key] = self._from_unit(spec, u)
+                else:
+                    cand[key] = spec
+                    continue
+                if isinstance(spec, Domain) and not isinstance(
+                        spec, GridSearch):
+                    ratio *= kde_ratio(key, spec, cand[key])
+            if ratio > best_score:
+                best_cfg, best_score = cand, ratio
+        self._by_trial[trial_id] = best_cfg
+        return best_cfg
+
+    def on_trial_complete(self, trial_id: str, result=None,
+                          error: bool = False):
+        cfg = self._by_trial.pop(trial_id, None)
+        if error or not result or self.metric not in result or \
+                cfg is None:
+            return
+        self.observe(
+            cfg, result[self.metric],
+            budget=result.get("training_iteration", 1.0),
+        )

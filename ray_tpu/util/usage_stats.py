@@ -1,0 +1,89 @@
+"""Usage stats: opt-out usage reporting, local-only.
+
+Ref analogue: python/ray/_private/usage/usage_lib.py — the reference
+collects which libraries/features a cluster used and (opt-out) pings
+a telemetry endpoint. This environment has zero egress, so the report
+is only ever WRITTEN LOCALLY to the session directory at shutdown;
+``RAY_TPU_USAGE_STATS_ENABLED=0`` disables even that. The shape
+mirrors the reference's payload: schema version, runtime versions,
+cluster size, and the set of libraries touched
+(``record_library_usage`` calls are sprinkled the same way).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import threading
+import time
+from typing import Any, Dict, List
+
+_lock = threading.Lock()
+_libraries: set = set()
+_features: set = set()
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") not in (
+        "0", "false", "False",
+    )
+
+
+def record_library_usage(name: str) -> None:
+    """Mark a library as used this session (ref:
+    usage_lib.record_library_usage)."""
+    with _lock:
+        _libraries.add(name)
+
+
+def record_extra_usage_tag(key: str, value: str = "") -> None:
+    with _lock:
+        _features.add(f"{key}={value}" if value else key)
+
+
+def build_report() -> Dict[str, Any]:
+    from .._version import __version__
+
+    report: Dict[str, Any] = {
+        "schema_version": "0.1",
+        "ray_tpu_version": __version__,
+        "python_version": platform.python_version(),
+        "os": platform.system().lower(),
+        "collected_at": time.time(),
+    }
+    try:
+        import jax
+
+        report["jax_version"] = jax.__version__
+    except Exception:
+        pass
+    with _lock:
+        report["libraries_used"] = sorted(_libraries)
+        report["extra_usage_tags"] = sorted(_features)
+    try:
+        from ..core import runtime_context
+
+        if runtime_context.is_initialized():
+            rt = runtime_context.current_runtime()
+            nodes: List[Any] = rt.nodes()
+            report["num_nodes"] = len(nodes)
+            report["total_resources"] = rt.cluster_resources()
+    except Exception:
+        pass
+    return report
+
+
+def write_report(directory: str) -> str:
+    """Write the usage report as JSON (local file; NOTHING is sent
+    anywhere). Returns the path, or "" when disabled."""
+    if not enabled():
+        return ""
+    path = os.path.join(directory, "usage_stats.json")
+    try:
+        os.makedirs(directory, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(build_report(), f, indent=2)
+    except Exception:
+        return ""
+    return path

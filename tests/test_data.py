@@ -724,3 +724,55 @@ def test_push_based_shuffle_parity(ray_tpu_start):
     assert sum(r["s"] for r in push_group) == sum(
         float(i) for i in range(n)
     )
+
+
+def test_split_apis(ray_tpu_start):
+    """split_at_indices / split_proportionately / train_test_split
+    (ref: dataset.split_at_indices etc.)."""
+    ds = rd.range(20, override_num_blocks=3).map_batches(
+        lambda b: {"x": b["id"]}
+    )
+    a, b, c = ds.split_at_indices([5, 12])
+    assert [r["x"] for r in a.take_all()] == list(range(5))
+    assert [r["x"] for r in b.take_all()] == list(range(5, 12))
+    assert [r["x"] for r in c.take_all()] == list(range(12, 20))
+
+    p, q, rest = ds.split_proportionately([0.25, 0.25])
+    assert p.count() == 5 and q.count() == 5 and rest.count() == 10
+
+    train, test = ds.train_test_split(0.3)
+    assert train.count() == 14 and test.count() == 6
+    train_s, test_s = ds.train_test_split(0.3, shuffle=True, seed=0)
+    assert train_s.count() + test_s.count() == 20
+    assert sorted(r["x"] for r in train_s.take_all()) != \
+        list(range(14))  # shuffled
+
+    with pytest.raises(ValueError):
+        ds.split_proportionately([0.7, 0.7])
+    with pytest.raises(ValueError):
+        ds.train_test_split(1.5)
+
+
+def test_sample_unique_rename_aggregates(ray_tpu_start):
+    """random_sample / unique / rename_columns / column aggregates
+    (ref: the same-name Dataset APIs)."""
+    ds = rd.from_items(
+        [{"x": i, "parity": i % 2} for i in range(100)],
+        override_num_blocks=4,
+    )
+    sampled = ds.random_sample(0.3, seed=0)
+    n = sampled.count()
+    assert 10 <= n <= 55, n
+
+    assert sorted(ds.unique("parity")) == [0, 1]
+
+    renamed = ds.rename_columns({"x": "value"})
+    assert "value" in renamed.columns() or \
+        "value" in renamed.take(1)[0]
+
+    assert ds.sum("x") == sum(range(100))
+    assert ds.min("x") == 0 and ds.max("x") == 99
+    assert abs(ds.mean("x") - 49.5) < 1e-9
+    import numpy as _np
+
+    assert abs(ds.std("x") - _np.std(_np.arange(100), ddof=1)) < 1e-6

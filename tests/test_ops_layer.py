@@ -451,3 +451,58 @@ def test_timeline_otlp_export(ray_tpu_start, tmp_path):
     ), "no parent-linked span tree in the export"
     import os
     assert os.path.exists(out)
+
+
+def test_dashboard_agents_and_proxy(ray_tpu_start):
+    """Per-node dashboard agents register in the KV; the head
+    dashboard lists them and proxies logs/stats/profile requests (ref:
+    dashboard/agent.py + the head's agent fan-out)."""
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import dashboard
+    from ray_tpu.dashboard_agent import agent_addresses
+
+    @ray_tpu.remote
+    def noisy():
+        print("agent-log-probe")
+        return 1
+
+    assert ray_tpu.get(noisy.remote()) == 1
+    agents = agent_addresses()
+    assert agents, "no dashboard agents registered"
+    node_hex = next(iter(agents))
+
+    port = dashboard.start_dashboard(port=0)
+    try:
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=35) as r:
+                return json.loads(r.read())
+
+        assert fetch("/api/agents") == agents
+        stats = fetch(f"/api/agent/{node_hex}/stats")
+        assert stats["node_id"] == node_hex
+        assert stats.get("rss_bytes", 0) > 0
+
+        logs = fetch(f"/api/agent/{node_hex}/logs")
+        worker_logs = [f["name"] for f in logs["files"]
+                       if f["name"].startswith("worker-")]
+        assert worker_logs, logs
+        found = False
+        for name in worker_logs:
+            content = fetch(
+                f"/api/agent/{node_hex}/logs/{name}?tail=50"
+            )
+            if any("agent-log-probe" in ln
+                   for ln in content["lines"]):
+                found = True
+                break
+        assert found, "probe line not found in worker logs"
+
+        prof = fetch(
+            f"/api/agent/{node_hex}/profile?seconds=0.3&hz=50"
+        )
+        assert prof["samples"] > 0 and prof["stacks"]
+    finally:
+        dashboard.stop_dashboard()

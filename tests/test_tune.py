@@ -503,3 +503,65 @@ def test_searcher_adapters_gated():
     except ImportError:
         with pytest.raises(ImportError, match="hyperopt"):
             HyperOptSearch(space, metric="score", mode="max")
+
+
+def test_tpe_search_converges(ray_tpu_start, tmp_path):
+    """Native TPE (the BOHB sampler) concentrates samples near the
+    optimum after the random phase (ref: TuneBOHB,
+    tune/search/bohb/bohb_search.py)."""
+    def trainable(config):
+        tune.report({
+            "obj": -(config["x"] - 2.0) ** 2
+            - (0.0 if config["kind"] == "good" else 4.0)
+        })
+
+    search = tune.TPESearch(
+        {"x": tune.uniform(-10.0, 10.0),
+         "kind": tune.choice(["good", "bad"])},
+        metric="obj", mode="max", n_initial=8,
+        min_points_in_model=6, seed=0,
+    )
+    res = Tuner(
+        trainable,
+        tune_config=TuneConfig(
+            num_samples=30, metric="obj", mode="max",
+            search_alg=search, max_concurrent_trials=1,
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    best = res.get_best_result()
+    assert abs(best.config["x"] - 2.0) < 1.0, best.config
+    assert best.config["kind"] == "good"
+    assert best.metrics["obj"] > -1.0
+
+
+def test_bohb_scheduler_feeds_searcher(ray_tpu_start, tmp_path):
+    """HyperBandForBOHB reports every rung result back to the attached
+    TPESearch with its budget (the BOHB coupling, ref:
+    tune/schedulers/hb_bohb.py)."""
+    def trainable(config):
+        for step in range(1, 10):
+            tune.report({"obj": config["x"] * step,
+                         "training_iteration": step})
+
+    search = tune.TPESearch(
+        {"x": tune.uniform(0.0, 1.0)}, metric="obj", mode="max",
+        n_initial=4, seed=0,
+    )
+    scheduler = tune.HyperBandForBOHB(
+        metric="obj", mode="max", max_t=9, reduction_factor=3,
+        searcher=search,
+    )
+    Tuner(
+        trainable,
+        tune_config=TuneConfig(
+            num_samples=8, metric="obj", mode="max",
+            search_alg=search, scheduler=scheduler,
+            max_concurrent_trials=2,
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    # Intermediate budgets observed, not just finals.
+    budgets = set(search._obs)
+    assert len(budgets) > 1, budgets
+    assert sum(len(v) for v in search._obs.values()) >= 8

@@ -164,3 +164,28 @@ def test_tqdm_distributed(ray_tpu_start):
         closed = [s for s in renderer.state.values() if s["closed"]]
         assert len(closed) >= 2, renderer.state
         assert all(s["total"] == 50 for s in closed)
+
+
+def test_usage_stats_local_report(ray_tpu_start, tmp_path, monkeypatch):
+    """Usage report: libraries recorded, written locally, opt-out
+    honored (ref: usage_lib — local-only here, zero egress)."""
+    from ray_tpu.util import usage_stats
+
+    import ray_tpu.data  # noqa: F401 - records "data"
+
+    report = usage_stats.build_report()
+    assert "data" in report["libraries_used"]
+    assert report["ray_tpu_version"]
+    assert report.get("num_nodes", 0) >= 1
+
+    path = usage_stats.write_report(str(tmp_path))
+    assert path
+    import json as _json
+
+    with open(path) as f:
+        on_disk = _json.load(f)
+    assert on_disk["schema_version"] == "0.1"
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    assert usage_stats.write_report(str(tmp_path / "off")) == ""
+    assert not (tmp_path / "off" / "usage_stats.json").exists()
