@@ -23,6 +23,7 @@ from .apex_dqn import ApexDQN, ApexDQNConfig  # noqa: F401
 from .crr import CRR, CRRConfig  # noqa: F401
 from .ddpg import DDPG, DDPGConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
+from .dt import DT, DTConfig  # noqa: F401
 from .qmix import QMIX, QMIXConfig  # noqa: F401
 from .es import ES, ESConfig  # noqa: F401
 from .marwil import MARWIL, MARWILConfig  # noqa: F401

@@ -1,0 +1,325 @@
+"""DT: Decision Transformer — offline RL as sequence modeling.
+
+Ref analogue: rllib/algorithms/dt (Chen 2021). Trajectories become
+token sequences (R_t, s_t, a_t) with returns-to-go; a small causal
+transformer (jax — runs on the accelerator) is trained to predict the
+action at each state token given the preceding context; at inference
+the desired return is supplied as the conditioning R_0 and actions
+are decoded autoregressively, decrementing the return-to-go by
+observed rewards.
+
+Offline input: a ray_tpu.data Dataset of per-step rows carrying
+``episode_id``/``t``/``obs``/``action``/``reward`` columns; the
+driver groups rows into episodes, computes returns-to-go, and samples
+length-K context windows as training batches. Discrete actions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+from .policy import init_mlp_params
+
+
+class DTConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.dataset = None
+        self.obs_column = "obs"
+        self.action_column = "action"
+        self.reward_column = "reward"
+        self.episode_column = "episode_id"
+        self.time_column = "t"
+        self.num_actions: Optional[int] = None
+        self.context_length: int = 8      # K
+        self.embed_dim: int = 64
+        self.num_layers: int = 2
+        self.num_heads: int = 2
+        self.max_ep_len: int = 512
+        self.batches_per_iteration: int = 32
+
+    def offline_data(self, dataset, **columns) -> "DTConfig":
+        self.dataset = dataset
+        allowed = ("obs_column", "action_column", "reward_column",
+                   "episode_column", "time_column")
+        for k, v in columns.items():
+            if k not in allowed:
+                raise ValueError(f"unknown offline_data column {k!r} "
+                                 f"(allowed: {allowed})")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DT":
+        if self.dataset is None:
+            raise ValueError("DTConfig.offline_data(dataset=...) "
+                             "required")
+        if self.num_actions is None:
+            raise ValueError("DTConfig.training(num_actions=...) "
+                             "required (discrete)")
+        return DT(self.copy())
+
+
+def _init_dt_params(cfg: DTConfig, obs_dim: int) -> Dict[str, Any]:
+    rng = np.random.RandomState(cfg.seed)
+    D = cfg.embed_dim
+
+    def lin(n_in, n_out):
+        return init_mlp_params(rng, [n_in, n_out])
+
+    params: Dict[str, Any] = {
+        "state_emb": lin(obs_dim, D),
+        "rtg_emb": lin(1, D),
+        "act_emb": (rng.randn(cfg.num_actions + 1, D)
+                    * 0.02).astype(np.float32),  # +1 = BOS/pad id
+        "time_emb": (rng.randn(cfg.max_ep_len, D)
+                     * 0.02).astype(np.float32),
+        "head": lin(D, cfg.num_actions),
+    }
+    for layer in range(cfg.num_layers):
+        params[f"attn_{layer}"] = {
+            "qkv": lin(D, 3 * D),
+            "proj": lin(D, D),
+        }
+        params[f"mlp_{layer}"] = {
+            "up": lin(D, 4 * D),
+            "down": lin(4 * D, D),
+        }
+    return params
+
+
+class DTLearner:
+    """Jitted causal-transformer action prediction loss."""
+
+    def __init__(self, cfg: DTConfig, obs_dim: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._tx = optax.adam(cfg.lr)
+        self._params = jax.tree.map(
+            jnp.asarray, _init_dt_params(cfg, obs_dim)
+        )
+        self._opt_state = self._tx.init(self._params)
+        D, H = cfg.embed_dim, cfg.num_heads
+        L = cfg.num_layers
+        K = cfg.context_length
+
+        def dense(p, x):
+            (W, b), = p
+            return x @ W + b
+
+        def norm(x):
+            mu = x.mean(-1, keepdims=True)
+            var = x.var(-1, keepdims=True)
+            return (x - mu) * jax.lax.rsqrt(var + 1e-5)
+
+        def block(p_attn, p_mlp, x, mask):
+            B, T, _ = x.shape
+            qkv = dense(p_attn["qkv"], norm(x))
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+
+            def heads(t):
+                return t.reshape(B, T, H, D // H).transpose(0, 2, 1, 3)
+
+            q, k, v = heads(q), heads(k), heads(v)
+            att = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(D // H)
+            att = jnp.where(mask, att, -1e9)
+            att = jax.nn.softmax(att, axis=-1)
+            y = (att @ v).transpose(0, 2, 1, 3).reshape(B, T, D)
+            x = x + dense(p_attn["proj"], y)
+            h = dense(p_mlp["up"], norm(x))
+            x = x + dense(p_mlp["down"], jax.nn.gelu(h))
+            return x
+
+        def forward(p, rtg, obs, act_in, timesteps):
+            """rtg [B,K,1], obs [B,K,Do], act_in [B,K] (previous
+            actions, BOS-shifted) -> logits [B,K,A] at state tokens."""
+            B = obs.shape[0]
+            te = p["time_emb"][timesteps]          # [B,K,D]
+            tok_r = dense(p["rtg_emb"], rtg) + te
+            tok_s = dense(p["state_emb"], obs) + te
+            tok_a = p["act_emb"][act_in] + te
+            # interleave (r, s, a) -> [B, 3K, D]
+            x = jnp.stack([tok_r, tok_s, tok_a], axis=2)
+            x = x.reshape(B, 3 * K, D)
+            T = 3 * K
+            causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+            for layer in range(L):
+                x = block(p[f"attn_{layer}"], p[f"mlp_{layer}"], x,
+                          causal)
+            x = norm(x)
+            # state tokens sit at positions 3t+1
+            s_out = x[:, 1::3]
+            return dense(p["head"], s_out)
+
+        def loss_fn(p, batch):
+            logits = forward(p, batch["rtg"], batch["obs"],
+                             batch["act_in"], batch["timesteps"])
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, batch["actions"][..., None], axis=-1
+            )[..., 0]
+            return (nll * batch["mask"]).sum() / jnp.maximum(
+                batch["mask"].sum(), 1.0
+            )
+
+        def update(p, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        self._update = jax.jit(update)
+        self._forward = jax.jit(forward)
+
+    def train_batch(self, np_batch) -> float:
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in np_batch.items()}
+        self._params, self._opt_state, loss = self._update(
+            self._params, self._opt_state, jb
+        )
+        return float(loss)
+
+    def predict_logits(self, rtg, obs, act_in, timesteps):
+        return np.asarray(self._forward(
+            self._params, rtg, obs, act_in, timesteps
+        ))
+
+
+class DT:
+    def __init__(self, config: DTConfig):
+        c = config
+        self.config = c
+        self.iteration = 0
+        self._rng = np.random.RandomState(c.seed)
+        self._episodes = self._load_episodes()
+        obs0 = self._episodes[0]["obs"]
+        self._obs_dim = int(obs0.shape[-1])
+        self.learner = DTLearner(c, self._obs_dim)
+
+    def _load_episodes(self) -> List[Dict[str, np.ndarray]]:
+        c = self.config
+        by_ep: Dict[Any, List[tuple]] = {}
+        for batch in c.dataset.iter_batches(batch_size=1024,
+                                            batch_format="numpy"):
+            n = len(batch[c.episode_column])
+            for i in range(n):
+                by_ep.setdefault(
+                    batch[c.episode_column][i].item()
+                    if hasattr(batch[c.episode_column][i], "item")
+                    else batch[c.episode_column][i],
+                    [],
+                ).append((
+                    int(batch[c.time_column][i]),
+                    np.asarray(batch[c.obs_column][i],
+                               np.float32).reshape(-1),
+                    int(batch[c.action_column][i]),
+                    float(batch[c.reward_column][i]),
+                ))
+        episodes = []
+        for rows in by_ep.values():
+            rows.sort(key=lambda r: r[0])
+            obs = np.stack([r[1] for r in rows])
+            acts = np.asarray([r[2] for r in rows], np.int32)
+            rews = np.asarray([r[3] for r in rows], np.float32)
+            rtg = np.cumsum(rews[::-1])[::-1].astype(np.float32)
+            episodes.append({"obs": obs, "actions": acts,
+                             "rewards": rews, "rtg": rtg})
+        if not episodes:
+            raise ValueError("offline dataset contains no episodes")
+        return episodes
+
+    def _sample_batch(self) -> Dict[str, np.ndarray]:
+        c = self.config
+        K = c.context_length
+        B = c.minibatch_size
+        bos = c.num_actions   # BOS/pad action id
+        out = {
+            "obs": np.zeros((B, K, self._obs_dim), np.float32),
+            "actions": np.zeros((B, K), np.int32),
+            "act_in": np.full((B, K), bos, np.int32),
+            "rtg": np.zeros((B, K, 1), np.float32),
+            "timesteps": np.zeros((B, K), np.int32),
+            "mask": np.zeros((B, K), np.float32),
+        }
+        for b in range(B):
+            ep = self._episodes[self._rng.randint(len(self._episodes))]
+            T = len(ep["actions"])
+            start = self._rng.randint(T)
+            end = min(T, start + K)
+            n = end - start
+            out["obs"][b, :n] = ep["obs"][start:end]
+            out["actions"][b, :n] = ep["actions"][start:end]
+            if start > 0:
+                out["act_in"][b, 0] = ep["actions"][start - 1]
+            out["act_in"][b, 1:n] = ep["actions"][start:end - 1]
+            out["rtg"][b, :n, 0] = ep["rtg"][start:end]
+            out["timesteps"][b, :n] = np.arange(
+                start, end
+            ) % self.config.max_ep_len
+            out["mask"][b, :n] = 1.0
+        return out
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        c = self.config
+        loss = float("nan")
+        for _ in range(c.batches_per_iteration):
+            loss = self.learner.train_batch(self._sample_batch())
+        return {
+            "training_iteration": self.iteration,
+            "loss": loss,
+            "num_episodes": len(self._episodes),
+        }
+
+    def compute_action(self, history: Dict[str, List[Any]],
+                       target_return: float) -> int:
+        """Next action given the running episode ``history``
+        ({"obs": [...], "actions": [...], "rewards": [...]}) and the
+        conditioning target return (ref: DT inference — rtg decremented
+        by observed rewards)."""
+        c = self.config
+        K = c.context_length
+        obs_hist = [np.asarray(o, np.float32).reshape(-1)
+                    for o in history["obs"]]
+        act_hist = list(history.get("actions", []))
+        rew_hist = list(history.get("rewards", []))
+        rtg = target_return - float(np.sum(rew_hist))
+        t0 = max(0, len(obs_hist) - K)
+        window = obs_hist[t0:]
+        n = len(window)
+        bos = c.num_actions
+        obs = np.zeros((1, K, self._obs_dim), np.float32)
+        act_in = np.full((1, K), bos, np.int32)
+        rtgs = np.zeros((1, K, 1), np.float32)
+        ts = np.zeros((1, K), np.int32)
+        obs[0, :n] = np.stack(window)
+        rtg_seq = []
+        run = target_return
+        for i, r in enumerate(rew_hist):
+            rtg_seq.append(run)
+            run -= r
+        rtg_seq.append(run)
+        rtg_win = rtg_seq[t0:t0 + n]
+        rtgs[0, :len(rtg_win), 0] = rtg_win
+        prev = act_hist[t0 - 1] if t0 > 0 else None
+        if prev is not None:
+            act_in[0, 0] = prev
+        for i, a in enumerate(act_hist[t0:]):
+            if i + 1 < K:
+                act_in[0, i + 1] = a
+        ts[0, :n] = (np.arange(t0, t0 + n) % c.max_ep_len)
+        logits = self.learner.predict_logits(rtgs, obs, act_in, ts)
+        return int(np.argmax(logits[0, n - 1]))
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.learner._params)
+
+    def stop(self):
+        pass
