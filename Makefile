@@ -11,9 +11,19 @@ LDLIBS   := -lpthread -lrt
 STORE_SRC := src/store/rts_store.cc
 EXT       := ray_tpu/_native/_rtstore.so
 
-.PHONY: native native-test clean
+.PHONY: native native-test cpp-client clean
 
 native: $(EXT)
+
+# C++ client frontend (ref analogue: the reference's cpp/ worker API):
+# zero-copy arena object plane + JSON control channel. `make cpp-client`
+# builds the demo driver tests/test_cpp_client.py runs.
+build/rtpu_demo: cpp/rtpu_client.cc cpp/rtpu_demo.cc cpp/rtpu_client.h $(STORE_SRC)
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -Isrc/store -Icpp cpp/rtpu_client.cc cpp/rtpu_demo.cc \
+	  $(STORE_SRC) -o $@ $(LDLIBS)
+
+cpp-client: build/rtpu_demo
 
 $(EXT): $(STORE_SRC) src/store/_rtstore_module.cc src/store/rts_store.h
 	$(CXX) $(CXXFLAGS) -shared -I$(PY_INC) -Isrc/store \

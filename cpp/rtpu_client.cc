@@ -1,0 +1,299 @@
+// C++ client frontend implementation. See rtpu_client.h.
+//
+// JSON handling is deliberately minimal: requests are assembled by
+// string building (all dynamic pieces are hex ids / numbers / caller-
+// provided JSON), replies are scanned with a tiny extractor that
+// handles the flat {"key": value} shapes capi_server.py emits.
+
+#include "rtpu_client.h"
+
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <random>
+
+#include "../src/store/rts_store.h"
+
+namespace rtpu {
+
+namespace {
+
+// Framed-object layout constants (core/serialization.py):
+//   <u32 magic><u32 nbufs><u64 pickle_len>[pad to 16][pickle][pad 64]
+constexpr uint32_t kMagic = 0x52545055;  // "RTPU" — serialization.MAGIC
+constexpr uint64_t kAlign = 64;
+
+uint64_t AlignUp(uint64_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+// Pickled `bytes` object: PROTO 3 | BINBYTES <u32 len> <payload> | STOP
+uint64_t PickledBytesLen(uint64_t n) { return 2 + 5 + n + 1; }
+
+void WritePickledBytes(uint8_t* dst, const void* data, uint64_t n) {
+  dst[0] = 0x80;  // PROTO
+  dst[1] = 3;
+  dst[2] = 'B';  // BINBYTES
+  uint32_t len32 = static_cast<uint32_t>(n);
+  memcpy(dst + 3, &len32, 4);
+  memcpy(dst + 7, data, n);
+  dst[7 + n] = '.';  // STOP
+}
+
+std::string RandomHex(int chars) {
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  static const char* kHex = "0123456789abcdef";
+  std::string out(chars, '0');
+  for (int i = 0; i < chars; i++) out[i] = kHex[rng() & 0xF];
+  return out;
+}
+
+bool HexToBytes(const std::string& hex, uint8_t* out, size_t n) {
+  if (hex.size() != n * 2) return false;
+  auto nib = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+  };
+  for (size_t i = 0; i < n; i++) {
+    int hi = nib(hex[2 * i]), lo = nib(hex[2 * i + 1]);
+    if (hi < 0 || lo < 0) return false;
+    out[i] = static_cast<uint8_t>(hi << 4 | lo);
+  }
+  return true;
+}
+
+// Extract a string value for "key" from a flat JSON object.
+bool JsonStr(const std::string& json, const std::string& key,
+             std::string* out) {
+  std::string pat = "\"" + key + "\"";
+  size_t k = json.find(pat);
+  if (k == std::string::npos) return false;
+  size_t colon = json.find(':', k + pat.size());
+  if (colon == std::string::npos) return false;
+  size_t q1 = json.find('"', colon + 1);
+  if (q1 == std::string::npos) return false;
+  std::string val;
+  for (size_t i = q1 + 1; i < json.size(); i++) {
+    char c = json[i];
+    if (c == '\\' && i + 1 < json.size()) {
+      val += json[++i];
+      continue;
+    }
+    if (c == '"') {
+      *out = val;
+      return true;
+    }
+    val += c;
+  }
+  return false;
+}
+
+}  // namespace
+
+Client::Client(const std::string& session_dir)
+    : session_dir_(session_dir) {}
+
+Client::~Client() {
+  if (fd_ >= 0) close(fd_);
+  if (store_ != nullptr) rts_close(static_cast<rts_store*>(store_));
+}
+
+bool Client::Rpc(const std::string& request, std::string* reply,
+                 std::string* err) {
+  uint32_t len = static_cast<uint32_t>(request.size());
+  if (write(fd_, &len, 4) != 4 ||
+      write(fd_, request.data(), len) != static_cast<ssize_t>(len)) {
+    *err = "capi socket write failed";
+    return false;
+  }
+  uint32_t rlen = 0;
+  size_t got = 0;
+  auto read_exact = [&](void* dst, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t r = read(fd_, static_cast<uint8_t*>(dst) + off, n - off);
+      if (r <= 0) return false;
+      off += static_cast<size_t>(r);
+    }
+    return true;
+  };
+  (void)got;
+  if (!read_exact(&rlen, 4)) {
+    *err = "capi socket read failed";
+    return false;
+  }
+  reply->resize(rlen);
+  if (!read_exact(&(*reply)[0], rlen)) {
+    *err = "capi socket read failed";
+    return false;
+  }
+  std::string e;
+  if (JsonStr(*reply, "error", &e)) {
+    *err = e;
+    return false;
+  }
+  return true;
+}
+
+bool Client::Connect(std::string* err) {
+  std::string sock_path = session_dir_ + "/capi.sock";
+  fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd_ < 0) {
+    *err = "socket() failed";
+    return false;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  snprintf(addr.sun_path, sizeof(addr.sun_path), "%s",
+           sock_path.c_str());
+  if (connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    *err = "connect(" + sock_path + ") failed";
+    return false;
+  }
+  std::string reply;
+  if (!Rpc("{\"op\": \"hello\"}", &reply, err)) return false;
+  JsonStr(reply, "node_id", &node_id_);
+  JsonStr(reply, "arena", &arena_);
+  if (!arena_.empty()) {
+    char cerr[256];
+    store_ = rts_attach(arena_.c_str(), cerr);
+    if (store_ == nullptr) {
+      *err = std::string("arena attach failed: ") + cerr;
+      return false;
+    }
+  }
+  return true;
+}
+
+bool Client::Put(const void* data, uint64_t size, ObjectRef* out,
+                 std::string* err) {
+  if (store_ == nullptr) {
+    *err = "no arena store on this node";
+    return false;
+  }
+  rts_store* s = static_cast<rts_store*>(store_);
+  out->hex = RandomHex(40);
+  uint8_t id[RTS_ID_SIZE];
+  HexToBytes(out->hex, id, RTS_ID_SIZE);
+
+  uint64_t pickle_len = PickledBytesLen(size);
+  uint64_t header_len = 16;  // <u32><u32><u64> for nbufs=0
+  uint64_t total = AlignUp(header_len) + AlignUp(pickle_len);
+  uint64_t off = 0;
+  int rc = rts_alloc_pin(s, id, total, getpid(), &off);
+  if (rc != RTS_OK) {
+    *err = "arena alloc failed rc=" + std::to_string(rc);
+    return false;
+  }
+  uint8_t* dst = rts_base(s) + off;
+  memset(dst, 0, AlignUp(header_len));
+  uint32_t magic = kMagic, nbufs = 0;
+  memcpy(dst, &magic, 4);
+  memcpy(dst + 4, &nbufs, 4);
+  memcpy(dst + 8, &pickle_len, 8);
+  WritePickledBytes(dst + AlignUp(header_len), data, size);
+  rc = rts_seal(s, id);
+  if (rc != RTS_OK) {
+    *err = "seal failed rc=" + std::to_string(rc);
+    return false;
+  }
+  rts_unpin(s, id, getpid());
+
+  std::string reply;
+  std::string req = "{\"op\": \"register_put\", \"object_id\": \"" +
+                    out->hex + "\", \"size\": " +
+                    std::to_string(total) + "}";
+  return Rpc(req, &reply, err);
+}
+
+bool Client::GetBytes(const ObjectRef& ref, const uint8_t** data,
+                      uint64_t* size, std::string* err) {
+  if (store_ == nullptr) {
+    *err = "no arena store";
+    return false;
+  }
+  rts_store* s = static_cast<rts_store*>(store_);
+  uint8_t id[RTS_ID_SIZE];
+  if (!HexToBytes(ref.hex, id, RTS_ID_SIZE)) {
+    *err = "bad object id";
+    return false;
+  }
+  uint64_t off = 0, total = 0;
+  int rc = rts_get_pin(s, id, getpid(), &off, &total);
+  if (rc != RTS_OK) {
+    *err = "object not in local arena rc=" + std::to_string(rc);
+    return false;
+  }
+  const uint8_t* base = rts_base(s) + off;
+  uint32_t magic = 0, nbufs = 0;
+  uint64_t pickle_len = 0;
+  memcpy(&magic, base, 4);
+  memcpy(&nbufs, base + 4, 4);
+  memcpy(&pickle_len, base + 8, 8);
+  const uint8_t* p = base + AlignUp(16);
+  if (magic != kMagic || nbufs != 0 || pickle_len < 8 ||
+      p[0] != 0x80 || p[2] != 'B') {
+    rts_unpin(s, id, getpid());
+    *err = "object is not a native pickled-bytes payload (use GetJson)";
+    return false;
+  }
+  uint32_t len32 = 0;
+  memcpy(&len32, p + 3, 4);
+  *data = p + 7;
+  *size = len32;
+  return true;  // pin held until Release()
+}
+
+void Client::Release(const ObjectRef& ref) {
+  if (store_ == nullptr) return;
+  uint8_t id[RTS_ID_SIZE];
+  if (!HexToBytes(ref.hex, id, RTS_ID_SIZE)) return;
+  rts_unpin(static_cast<rts_store*>(store_), id, getpid());
+}
+
+bool Client::Submit(const std::string& name,
+                    const std::string& args_json, ObjectRef* out,
+                    std::string* err) {
+  std::string reply;
+  std::string req = "{\"op\": \"submit\", \"name\": \"" + name +
+                    "\", \"args\": " + args_json + "}";
+  if (!Rpc(req, &reply, err)) return false;
+  if (!JsonStr(reply, "object_id", &out->hex)) {
+    *err = "submit reply missing object_id: " + reply;
+    return false;
+  }
+  return true;
+}
+
+bool Client::GetJson(const ObjectRef& ref, double timeout_s,
+                     std::string* json_out, std::string* err) {
+  std::string reply;
+  char buf[64];
+  snprintf(buf, sizeof(buf), "%.3f", timeout_s);
+  std::string req = "{\"op\": \"get_value\", \"object_id\": \"" +
+                    ref.hex + "\", \"timeout\": " + buf + "}";
+  if (!Rpc(req, &reply, err)) return false;
+  size_t k = reply.find("\"value\":");
+  if (k == std::string::npos) {
+    *err = "reply missing value: " + reply;
+    return false;
+  }
+  // Value extends to the last '}' minus the trailing req_id field; the
+  // server emits {"value": <json>, "req_id": ...}.
+  size_t end = reply.rfind(", \"req_id\"");
+  if (end == std::string::npos) end = reply.rfind('}');
+  *json_out = reply.substr(k + 8, end - (k + 8));
+  return true;
+}
+
+bool Client::Free(const ObjectRef& ref, std::string* err) {
+  std::string reply;
+  return Rpc("{\"op\": \"free\", \"object_id\": \"" + ref.hex + "\"}",
+             &reply, err);
+}
+
+}  // namespace rtpu

@@ -1,0 +1,76 @@
+// C++ client frontend for the ray_tpu runtime.
+//
+// Ref analogue: the reference's C++ worker API (cpp/include/ray/api.h —
+// ray::Init/Put/Get/Task over the core worker). This client covers the
+// native-interop surface:
+//   * zero-copy object plane: Put/GetBytes go straight to the node's
+//     shared-memory arena (src/store/rts_store.h) — no socket on the
+//     data path;
+//   * control plane: a JSON-framed unix-socket channel to the node
+//     manager (core/capi_server.py) for object registration, task
+//     submission of registered Python entrypoints, and JSON results.
+//
+// Interop contract: Put() frames the payload as a pickled `bytes`
+// object inside the store's framed-object layout, so Python tasks
+// receive native puts as ordinary bytes arguments.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace rtpu {
+
+struct ObjectRef {
+  std::string hex;  // 40-char object id
+};
+
+class Client {
+ public:
+  // session_dir: the node's session directory (capi.sock + arena name
+  // come from the hello handshake).
+  explicit Client(const std::string& session_dir);
+  ~Client();
+
+  bool Connect(std::string* err);
+
+  // Zero-copy put: allocates in the shm arena, frames the payload as a
+  // pickled bytes object, seals, registers with the node manager.
+  bool Put(const void* data, uint64_t size, ObjectRef* out,
+           std::string* err);
+
+  // Zero-copy read of an object PUT BY A NATIVE CLIENT (pickled-bytes
+  // framing). Returns a pointer into the arena (valid while the client
+  // holds the pin; call Release when done).
+  bool GetBytes(const ObjectRef& ref, const uint8_t** data,
+                uint64_t* size, std::string* err);
+  void Release(const ObjectRef& ref);
+
+  // Submit a registered Python entrypoint with JSON-encoded args
+  // (args_json must be a JSON array, e.g. "[1, \"x\"]"; object refs
+  // ride as {"__object_id__": "<hex>"}). Returns the result ref.
+  bool Submit(const std::string& name, const std::string& args_json,
+              ObjectRef* out, std::string* err);
+
+  // Block until the object exists, then fetch its value as JSON.
+  bool GetJson(const ObjectRef& ref, double timeout_s,
+               std::string* json_out, std::string* err);
+
+  // Drop this client's reference.
+  bool Free(const ObjectRef& ref, std::string* err);
+
+  const std::string& node_id() const { return node_id_; }
+
+ private:
+  bool Rpc(const std::string& request, std::string* reply,
+           std::string* err);
+
+  std::string session_dir_;
+  std::string node_id_;
+  std::string arena_;
+  int fd_ = -1;
+  void* store_ = nullptr;  // rts_store*
+  uint64_t req_counter_ = 0;
+};
+
+}  // namespace rtpu

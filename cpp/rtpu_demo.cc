@@ -1,0 +1,75 @@
+// Demo/test driver for the C++ client (cpp/rtpu_client.h), exercised
+// by tests/test_cpp_client.py against a live single-node runtime:
+//   rtpu_demo <session_dir>
+// Performs: hello, zero-copy Put, GetBytes round-trip, Submit of the
+// registered "cpp_add" entrypoint (JSON args), Submit consuming the
+// native put as a task argument, GetJson, Free. Prints one
+// "CPPDEMO <step> OK" line per step; exits nonzero on any failure.
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "rtpu_client.h"
+
+int fail(const std::string& step, const std::string& err) {
+  fprintf(stderr, "CPPDEMO %s FAILED: %s\n", step.c_str(),
+          err.c_str());
+  return 1;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: rtpu_demo <session_dir>\n");
+    return 2;
+  }
+  std::string err;
+  rtpu::Client client(argv[1]);
+  if (!client.Connect(&err)) return fail("connect", err);
+  printf("CPPDEMO connect OK node=%s\n", client.node_id().c_str());
+
+  // Zero-copy put + read-back.
+  const char payload[] = "native payload \x01\x02\x03";
+  rtpu::ObjectRef ref;
+  if (!client.Put(payload, sizeof(payload), &ref, &err))
+    return fail("put", err);
+  const uint8_t* data = nullptr;
+  uint64_t size = 0;
+  if (!client.GetBytes(ref, &data, &size, &err))
+    return fail("get_bytes", err);
+  if (size != sizeof(payload) || memcmp(data, payload, size) != 0)
+    return fail("get_bytes", "payload mismatch");
+  client.Release(ref);
+  printf("CPPDEMO put_get OK bytes=%llu\n",
+         static_cast<unsigned long long>(size));
+
+  // Submit a registered Python entrypoint with JSON args.
+  rtpu::ObjectRef result;
+  if (!client.Submit("cpp_add", "[40, 2]", &result, &err))
+    return fail("submit", err);
+  std::string value;
+  if (!client.GetJson(result, 60.0, &value, &err))
+    return fail("get_json", err);
+  if (value.find("42") == std::string::npos)
+    return fail("get_json", "expected 42, got " + value);
+  printf("CPPDEMO submit OK value=%s\n", value.c_str());
+  if (!client.Free(result, &err)) return fail("free", err);
+
+  // A Python task consuming the NATIVE put as a bytes argument.
+  rtpu::ObjectRef len_result;
+  if (!client.Submit("cpp_len",
+                     "[{\"__object_id__\": \"" + ref.hex + "\"}]",
+                     &len_result, &err))
+    return fail("submit_ref", err);
+  if (!client.GetJson(len_result, 60.0, &value, &err))
+    return fail("get_json_ref", err);
+  char want[16];
+  snprintf(want, sizeof(want), "%zu", sizeof(payload));
+  if (value.find(want) == std::string::npos)
+    return fail("get_json_ref",
+                std::string("expected ") + want + ", got " + value);
+  printf("CPPDEMO submit_ref OK value=%s\n", value.c_str());
+
+  if (!client.Free(ref, &err)) return fail("free_put", err);
+  printf("CPPDEMO all OK\n");
+  return 0;
+}

@@ -1,0 +1,238 @@
+"""C-client API: a JSON-framed control channel for native frontends.
+
+Ref analogue: the reference's C++ worker API (cpp/ — ray::Init/Put/
+Get/Task over the core worker). A native client cannot speak the
+pickle frames the Python workers use, so the node manager serves a
+dedicated unix socket (``capi.sock`` in the session dir) carrying
+``u32-length | UTF-8 JSON`` frames. The DATA plane stays zero-copy:
+clients attach to the node's C++ shm arena (src/store/rts_store.h)
+directly and allocate/seal/read objects there; only control crosses
+this socket.
+
+Ops:
+  hello                          -> {arena, node_id, base}
+  register_put {object_id,size}  -> the client sealed an arena object;
+                                    enters the directory with one
+                                    client-held ref
+  submit {name,args,kwargs}      -> run a REGISTERED entrypoint
+                                    (register_entrypoint below) as a
+                                    normal cluster task
+  wait {object_id,timeout}       -> {ready}
+  get_value {object_id}          -> JSON value (bytes -> {"__bytes_b64__"})
+  free {object_id}               -> drop the client's ref
+
+Interop contract: native Put payloads are framed-pickle `bytes`
+objects (the client emits the 2-opcode pickle; see
+cpp/rtpu_client.cc), so Python tasks receive them as ordinary bytes
+arguments, and anything JSON-encodable round-trips through submit/
+get_value.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import struct
+from typing import Any, Dict
+
+from .ids import ObjectID, TaskID
+from .object_store import ArenaLocation, InlineLocation
+from .resources import ResourceSet
+from .serialization import deserialize, serialize_to_bytes
+from .task_spec import TaskSpec, TaskType, ValueArg
+
+_HEADER = struct.Struct("<I")
+
+CAPI_PREFIX = "__capi__/"
+
+
+def register_entrypoint(name: str, fn) -> str:
+    """Driver-side: expose ``fn`` to native clients under ``name``
+    (ref analogue: cross-language function registration,
+    python/ray/cross_language.py). Returns the function id."""
+    from . import runtime_context
+
+    rt = runtime_context.current_runtime()
+    function_id = rt.ensure_function(fn)
+    rt.kv_put(f"{CAPI_PREFIX}{name}", function_id.encode())
+    return function_id
+
+
+def _jsonable_value(value: Any) -> Any:
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return {"__bytes_b64__": base64.b64encode(bytes(value)).decode()}
+    if isinstance(value, dict):
+        return {k: _jsonable_value(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable_value(v) for v in value]
+    if hasattr(value, "item") and not isinstance(value, (int, float,
+                                                         bool, str)):
+        try:
+            return value.item()
+        except Exception:
+            pass
+    if hasattr(value, "tolist"):
+        return value.tolist()
+    return value
+
+
+def _decode_arg(v: Any) -> Any:
+    if isinstance(v, dict) and "__bytes_b64__" in v and len(v) == 1:
+        return base64.b64decode(v["__bytes_b64__"])
+    return v
+
+
+class CapiServer:
+    def __init__(self, nm):
+        self._nm = nm
+        self._server = None
+        self.path = None
+
+    async def start(self, path: str):
+        self._server = await asyncio.start_unix_server(
+            self._handle, path=path
+        )
+        self.path = path
+
+    def stop(self):
+        if self._server is not None:
+            self._server.close()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter):
+        held: Dict[ObjectID, int] = {}
+        try:
+            while True:
+                try:
+                    head = await reader.readexactly(_HEADER.size)
+                except (asyncio.IncompleteReadError,
+                        ConnectionResetError):
+                    break
+                (length,) = _HEADER.unpack(head)
+                payload = await reader.readexactly(length)
+                try:
+                    msg = json.loads(payload)
+                    reply = await self._dispatch(msg, held)
+                except Exception as e:  # noqa: BLE001 — reply w/ error
+                    reply = {"error": f"{type(e).__name__}: {e}"}
+                reply["req_id"] = (msg.get("req_id")
+                                   if isinstance(msg, dict) else None)
+                out = json.dumps(reply).encode()
+                writer.write(_HEADER.pack(len(out)) + out)
+                await writer.drain()
+        finally:
+            # Connection-death cleanup: drop any refs the client still
+            # holds (mirrors worker-disconnect ref cleanup).
+            if held:
+                await self._nm._apply_ref_deltas(
+                    {oid: -n for oid, n in held.items()}
+                )
+            writer.close()
+
+    async def _dispatch(self, msg: Dict[str, Any],
+                        held: Dict[ObjectID, int]) -> Dict[str, Any]:
+        nm = self._nm
+        op = msg.get("op")
+        if op == "hello":
+            return {
+                "ok": True,
+                "node_id": nm.node_id.hex(),
+                "arena": nm.arena_name or "",
+            }
+        if op == "register_put":
+            oid = ObjectID.from_hex(msg["object_id"])
+            size = int(msg["size"])
+            if not nm.arena_name:
+                raise RuntimeError("node has no arena store")
+            await nm.put_object(
+                oid,
+                ArenaLocation(nm.arena_name, oid.binary(), size),
+                refs=1,
+            )
+            held[oid] = held.get(oid, 0) + 1
+            return {"ok": True}
+        if op == "submit":
+            name = msg["name"]
+            fid_blob = await self._kv_get(f"{CAPI_PREFIX}{name}")
+            if fid_blob is None:
+                raise KeyError(
+                    f"no entrypoint {name!r} registered "
+                    f"(register_entrypoint on a driver first)"
+                )
+            function_id = (fid_blob.decode()
+                           if isinstance(fid_blob, bytes) else fid_blob)
+            args = []
+            for v in msg.get("args", []):
+                if isinstance(v, dict) and "__object_id__" in v:
+                    from .task_spec import RefArg
+
+                    args.append(RefArg(
+                        ObjectID.from_hex(v["__object_id__"])
+                    ))
+                else:
+                    args.append(ValueArg(
+                        serialize_to_bytes(_decode_arg(v))
+                    ))
+            kwargs = {
+                k: ValueArg(serialize_to_bytes(_decode_arg(v)))
+                for k, v in (msg.get("kwargs") or {}).items()
+            }
+            spec = TaskSpec(
+                task_id=TaskID.from_random(),
+                task_type=TaskType.NORMAL_TASK,
+                function_id=function_id,
+                args=args,
+                kwargs=kwargs,
+                num_returns=1,
+                resources=ResourceSet(
+                    msg.get("resources") or {"CPU": 1}
+                ),
+                name=f"capi:{name}",
+            )
+            nm.submit_task_sync(spec)
+            (ret,) = spec.return_ids()
+            # The native caller owns the return ref until free/disconnect
+            # (submit_task_sync already created the return slot).
+            self._nm.directory.add_ref(ret, 1)
+            held[ret] = held.get(ret, 0) + 1
+            return {"task_id": spec.task_id.hex(),
+                    "object_id": ret.hex()}
+        if op == "wait":
+            oid = ObjectID.from_hex(msg["object_id"])
+            ready = await nm.wait_objects(
+                [oid], 1, msg.get("timeout", 60.0)
+            )
+            return {"ready": bool(ready)}
+        if op == "get_value":
+            oid = ObjectID.from_hex(msg["object_id"])
+            ready = await nm.wait_objects(
+                [oid], 1, msg.get("timeout", 60.0)
+            )
+            if not ready:
+                raise TimeoutError(f"object {oid.hex()} not available")
+            loc = nm.directory.lookup(oid)
+            if loc is None:
+                raise KeyError(f"object {oid.hex()} has no location")
+            if isinstance(loc, InlineLocation):
+                value = deserialize(memoryview(loc.data))
+            else:
+                data = nm.local_store.get_bytes(loc)
+                value = deserialize(memoryview(data))
+            from ..core.exceptions import TaskError
+
+            if isinstance(value, TaskError):
+                raise RuntimeError(f"task failed: {value}")
+            return {"value": _jsonable_value(value)}
+        if op == "free":
+            oid = ObjectID.from_hex(msg["object_id"])
+            n = held.pop(oid, 0)
+            if n:
+                await nm._apply_ref_deltas({oid: -n})
+            return {"ok": True}
+        raise ValueError(f"unknown capi op {op!r}")
+
+    async def _kv_get(self, key: str):
+        if self._nm._gcs is not None:
+            return await self._nm._gcs.kv_get(key)
+        return self._nm._kv.get(key)

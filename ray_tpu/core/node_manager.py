@@ -432,6 +432,14 @@ class NodeManager:
         self._server = await asyncio.start_unix_server(
             self._handle_connection, path=self.socket_path
         )
+        # JSON control channel for native (C/C++) clients (ref
+        # analogue: the cpp/ worker API's core-worker channel).
+        from .capi_server import CapiServer
+
+        self.capi_server = CapiServer(self)
+        await self.capi_server.start(
+            os.path.join(self.session_dir, "capi.sock")
+        )
         # Peer channel for node<->node traffic (spillback + object pulls).
         from .tls import server_ssl_context
 
@@ -3646,6 +3654,8 @@ class NodeManager:
         self._shutdown = True
         if getattr(self, "dashboard_agent", None) is not None:
             self.dashboard_agent.stop()
+        if getattr(self, "capi_server", None) is not None:
+            self.capi_server.stop()
 
         async def _stop():
             if self._bg_tasks:
