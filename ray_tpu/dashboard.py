@@ -106,6 +106,17 @@ class _Handler(BaseHTTPRequestHandler):
                 "/api/summary/actors": state.summarize_actors,
                 "/api/summary/objects": state.summarize_objects,
             }
+            if path == "/api/serve":
+                # Serve application state (ref: dashboard/modules/serve
+                # REST surface over the controller).
+                try:
+                    import ray_tpu.serve as serve
+
+                    self._json({"deployments": serve.details()})
+                except Exception as e:
+                    self._json({"deployments": {},
+                                "note": f"serve not running: {e}"})
+                return
             if path == "/api/agents":
                 # Registered per-node agents (ref: dashboard head's
                 # DataSource of agent addresses).

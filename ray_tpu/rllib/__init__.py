@@ -55,3 +55,4 @@ from .sample_batch import SampleBatch, compute_gae  # noqa: F401
 
 from ray_tpu.util import usage_stats as _usage
 _usage.record_library_usage("rllib")
+from .registry import get_algorithm_config, list_algorithms  # noqa: F401,E402

@@ -1,0 +1,85 @@
+"""Algorithm registry: name -> Config class.
+
+Ref analogue: rllib/algorithms/registry.py (get_algorithm_class — the
+lookup behind `rllib train --run PPO`). Names are case-insensitive;
+``get_algorithm_config("ppo")`` returns a fresh config builder.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+
+def _registry() -> Dict[str, Type]:
+    from . import (
+        A2CConfig,
+        AlphaZeroConfig,
+        ApexDQNConfig,
+        APPOConfig,
+        ARSConfig,
+        BanditLinTSConfig,
+        BanditLinUCBConfig,
+        BCConfig,
+        CQLConfig,
+        CRRConfig,
+        DDPGConfig,
+        DQNConfig,
+        DTConfig,
+        ESConfig,
+        IMPALAConfig,
+        MADDPGConfig,
+        MARWILConfig,
+        MultiAgentPPOConfig,
+        PPOConfig,
+        QMIXConfig,
+        R2D2Config,
+        SACConfig,
+        TD3Config,
+    )
+
+    return {
+        "a2c": A2CConfig,
+        "alphazero": AlphaZeroConfig,
+        "alpha_zero": AlphaZeroConfig,
+        "apex": ApexDQNConfig,
+        "apex_dqn": ApexDQNConfig,
+        "appo": APPOConfig,
+        "ars": ARSConfig,
+        "bandit_lints": BanditLinTSConfig,
+        "bandit_linucb": BanditLinUCBConfig,
+        "bc": BCConfig,
+        "cql": CQLConfig,
+        "crr": CRRConfig,
+        "ddpg": DDPGConfig,
+        "dqn": DQNConfig,
+        "dt": DTConfig,
+        "es": ESConfig,
+        "impala": IMPALAConfig,
+        "maddpg": MADDPGConfig,
+        "marwil": MARWILConfig,
+        "multi_agent_ppo": MultiAgentPPOConfig,
+        "ppo": PPOConfig,
+        "qmix": QMIXConfig,
+        "r2d2": R2D2Config,
+        "sac": SACConfig,
+        "td3": TD3Config,
+    }
+
+
+def get_algorithm_config(name: str):
+    """Fresh Config instance for an algorithm name (ref:
+    get_algorithm_class)."""
+    reg = _registry()
+    key = name.lower().replace("-", "_")
+    if key not in reg:
+        raise ValueError(
+            f"unknown algorithm {name!r}; available: "
+            f"{sorted(set(reg))}"
+        )
+    return reg[key]()
+
+
+def list_algorithms() -> List[str]:
+    """Canonical registered names (aliases collapsed)."""
+    return sorted({cls.__name__.replace("Config", "").lower()
+                   for cls in _registry().values()})

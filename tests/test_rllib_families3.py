@@ -253,3 +253,19 @@ def test_decision_transformer_offline(ray_tpu_start):
         )
         correct += int(a == (1 if sig > 0 else 0))
     assert correct / trials > 0.85, correct / trials
+
+
+def test_algorithm_registry():
+    """Name -> Config lookup with aliases (ref:
+    rllib/algorithms/registry.py get_algorithm_class)."""
+    from ray_tpu.rllib import get_algorithm_config, list_algorithms
+
+    algos = list_algorithms()
+    assert len(algos) >= 23, algos
+    for name in ("ppo", "APEX", "alpha-zero", "td3"):
+        cfg = get_algorithm_config(name)
+        assert hasattr(cfg, "build")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="unknown algorithm"):
+        get_algorithm_config("dreamerv9")
