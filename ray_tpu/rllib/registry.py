@@ -80,6 +80,10 @@ def get_algorithm_config(name: str):
 
 
 def list_algorithms() -> List[str]:
-    """Canonical registered names (aliases collapsed)."""
-    return sorted({cls.__name__.replace("Config", "").lower()
-                   for cls in _registry().values()})
+    """Canonical registered names (one resolvable key per algorithm;
+    aliases collapsed to the shortest)."""
+    by_cls: Dict[Type, str] = {}
+    for key, cls in sorted(_registry().items(),
+                           key=lambda kv: (len(kv[0]), kv[0])):
+        by_cls.setdefault(cls, key)
+    return sorted(by_cls.values())
