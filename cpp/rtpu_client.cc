@@ -130,8 +130,13 @@ bool Client::Rpc(const std::string& request, std::string* reply,
     *err = "capi socket read failed";
     return false;
   }
-  std::string e;
-  if (JsonStr(*reply, "error", &e)) {
+  // Server contract: "ok" is always the FIRST key, so failure is
+  // detected from the frame prefix — value payloads containing an
+  // "error" key cannot be mistaken for RPC failures.
+  if (reply->rfind("{\"ok\": false", 0) == 0 ||
+      reply->rfind("{\"ok\":false", 0) == 0) {
+    std::string e;
+    if (!JsonStr(*reply, "error", &e)) e = *reply;
     *err = e;
     return false;
   }

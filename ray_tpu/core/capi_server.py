@@ -111,14 +111,28 @@ class CapiServer:
                     break
                 (length,) = _HEADER.unpack(head)
                 payload = await reader.readexactly(length)
+                # Reply shape contract: "ok" is ALWAYS the first key
+                # ({"ok": true, ...} / {"ok": false, "error": ...}), so
+                # native clients detect failure from the frame prefix
+                # without a full JSON parser.
+                msg: Any = None
                 try:
                     msg = json.loads(payload)
-                    reply = await self._dispatch(msg, held)
+                    body = await self._dispatch(msg, held)
+                    reply = {"ok": True, **body}
                 except Exception as e:  # noqa: BLE001 — reply w/ error
-                    reply = {"error": f"{type(e).__name__}: {e}"}
+                    reply = {"ok": False,
+                             "error": f"{type(e).__name__}: {e}"}
                 reply["req_id"] = (msg.get("req_id")
                                    if isinstance(msg, dict) else None)
-                out = json.dumps(reply).encode()
+                try:
+                    out = json.dumps(reply).encode()
+                except (TypeError, ValueError) as e:
+                    out = json.dumps({
+                        "ok": False,
+                        "error": f"result not JSON-serializable: {e}",
+                        "req_id": reply.get("req_id"),
+                    }).encode()
                 writer.write(_HEADER.pack(len(out)) + out)
                 await writer.drain()
         finally:

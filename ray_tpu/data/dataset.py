@@ -757,11 +757,24 @@ class Dataset:
             raise ValueError("fraction must be in [0, 1]")
 
         def sample(batch):
+            import zlib
+
             import numpy as _np
 
-            rng = (_np.random.default_rng(seed) if seed is not None
-                   else _np.random.default_rng())
             n = len(next(iter(batch.values()), []))
+            if seed is not None:
+                # Derive a per-batch stream by mixing the seed with the
+                # batch CONTENT — the same closure runs in every block's
+                # worker, so reusing `seed` directly would draw the same
+                # mask offsets in every block (position-correlated, not
+                # i.i.d.).
+                first = _np.ascontiguousarray(
+                    next(iter(batch.values()))
+                )
+                salt = zlib.crc32(first.tobytes())
+                rng = _np.random.default_rng((seed, salt))
+            else:
+                rng = _np.random.default_rng()
             mask = rng.random(n) < fraction
             return {k: _np.asarray(v)[mask] for k, v in batch.items()}
 

@@ -408,6 +408,11 @@ class HyperBandForBOHB(HyperBandScheduler):
         super().__init__(metric, mode, time_attr, max_t,
                          reduction_factor)
         self._searcher = searcher
+        if searcher is not None:
+            # The scheduler feeds EVERY rung result (final included);
+            # the searcher's own on_trial_complete must not observe the
+            # final result a second time.
+            searcher.defer_observations()
         self._configs: Dict[str, Dict] = {}
 
     def on_trial_start(self, trial_id: str, config: Dict[str, Any]):
@@ -425,3 +430,6 @@ class HyperBandForBOHB(HyperBandScheduler):
                     budget=float(result.get(self.time_attr, 1.0)),
                 )
         return decision
+
+    def on_trial_complete(self, trial_id: str, result=None):
+        self._configs.pop(trial_id, None)

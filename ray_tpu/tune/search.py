@@ -383,6 +383,13 @@ class TPESearch(Searcher):
         self._obs: Dict[float, List[tuple]] = {}
         self._num_suggested = 0
         self._by_trial: Dict[str, Dict[str, Any]] = {}
+        self._defer_observations = False
+
+    def defer_observations(self):
+        """An attached scheduler (HyperBandForBOHB) will call observe()
+        for every rung result, final included — on_trial_complete must
+        not add the final result a second time."""
+        self._defer_observations = True
 
     # -- transforms per domain ------------------------------------------
 
@@ -514,7 +521,7 @@ class TPESearch(Searcher):
                           error: bool = False):
         cfg = self._by_trial.pop(trial_id, None)
         if error or not result or self.metric not in result or \
-                cfg is None:
+                cfg is None or self._defer_observations:
             return
         self.observe(
             cfg, result[self.metric],
