@@ -12,6 +12,8 @@ from .read_api import (  # noqa: F401
     from_items,
     from_numpy,
     from_pandas,
+    from_tf,
+    from_torch,
     range,
     read_avro,
     read_bigquery,

@@ -218,6 +218,48 @@ def read_webdataset(paths, **kw) -> Dataset:
     return Dataset([make(p) for p in files])
 
 
+def from_torch(torch_dataset, *, override_num_blocks: int = 8
+               ) -> Dataset:
+    """Materialize a torch map-style Dataset (ref:
+    ray.data.from_torch). Rows become {"item": value} with tensors
+    converted to numpy."""
+    import numpy as np
+
+    def to_row(x):
+        if hasattr(x, "numpy"):
+            x = x.numpy()
+        elif isinstance(x, (tuple, list)):
+            x = type(x)(
+                v.numpy() if hasattr(v, "numpy") else v for v in x
+            )
+        return {"item": np.asarray(x) if not isinstance(x, (tuple,
+                                                            list))
+                else x}
+
+    import builtins
+
+    # NOTE: this module shadows builtins.range with the dataset
+    # constructor.
+    rows = [to_row(torch_dataset[i])
+            for i in builtins.range(len(torch_dataset))]
+    return from_items(rows, override_num_blocks=override_num_blocks)
+
+
+def from_tf(tf_dataset, *, override_num_blocks: int = 8) -> Dataset:
+    """Materialize a tf.data.Dataset (ref: ray.data.from_tf);
+    requires tensorflow. Elements become rows: dict elements keep
+    their keys, others land in "item"."""
+    rows = []
+    for elem in tf_dataset:
+        if isinstance(elem, dict):
+            rows.append({k: v.numpy() for k, v in elem.items()})
+        elif isinstance(elem, (tuple, list)):
+            rows.append({"item": [v.numpy() for v in elem]})
+        else:
+            rows.append({"item": elem.numpy()})
+    return from_items(rows, override_num_blocks=override_num_blocks)
+
+
 def read_avro(paths, **kw) -> Dataset:
     """Avro Object Container Files, one block per file (ref analogue:
     ray.data.read_avro over datasource/avro_datasource.py; the

@@ -188,3 +188,78 @@ def list_all(*, storage: Optional[str] = None) -> List[Tuple[str, str]]:
         for wid in sorted(os.listdir(storage)):
             out.append((wid, get_status(wid, storage=storage)["status"]))
     return out
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              storage: Optional[str] = None, input: Any = None):
+    """Start the workflow without blocking; returns an object ref for
+    the root value (ref: workflow.run_async — the reference returns an
+    ObjectRef the same way)."""
+    import ray_tpu
+
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:10]}"
+    blob = cloudpickle.dumps((dag, input))
+
+    def _drive(blob, workflow_id, storage):
+        import cloudpickle as _cp
+
+        from ray_tpu import workflow as wf
+
+        dag, input_val = _cp.loads(blob)
+        return wf.run(dag, workflow_id=workflow_id, storage=storage,
+                      input=input_val)
+
+    task = ray_tpu.remote(_drive)
+    return task.remote(blob, workflow_id, storage)
+
+
+def get_output(workflow_id: str, *,
+               storage: Optional[str] = None) -> Any:
+    """The root step's persisted value of a SUCCEEDED workflow (ref:
+    workflow.get_output)."""
+    storage = storage or _default_storage()
+    status = get_status(workflow_id, storage=storage)
+    if status.get("status") != "SUCCEEDED":
+        raise RuntimeError(
+            f"workflow {workflow_id} is {status.get('status')}, "
+            f"not SUCCEEDED"
+        )
+    with open(os.path.join(storage, workflow_id, "workflow.pkl"),
+              "rb") as f:
+        payload = cloudpickle.load(f)
+    runner = _WorkflowRunner(workflow_id, storage)
+    order = _step_order(payload["dag"])
+    root_step = _step_id(len(order) - 1, order[-1])
+    if not runner.has_step(root_step):
+        raise RuntimeError(f"workflow {workflow_id} has no persisted "
+                           f"root value")
+    return runner.load_step(root_step)
+
+
+def resume_all(*, storage: Optional[str] = None
+               ) -> List[Tuple[str, Any]]:
+    """Resume every workflow that is not SUCCEEDED (ref:
+    workflow.resume_all); returns [(workflow_id, value)] for the ones
+    that completed."""
+    storage = storage or _default_storage()
+    out: List[Tuple[str, Any]] = []
+    for wid, status in list_all(storage=storage):
+        if status in ("SUCCEEDED",):
+            continue
+        try:
+            out.append((wid, resume(wid, storage=storage)))
+        except BaseException:
+            continue  # stays FAILED; caller inspects get_status
+    return out
+
+
+def delete(workflow_id: str, *, storage: Optional[str] = None) -> bool:
+    """Drop a workflow's persisted state (ref: workflow.delete)."""
+    import shutil
+
+    storage = storage or _default_storage()
+    path = os.path.join(storage, workflow_id)
+    if not os.path.isdir(path):
+        return False
+    shutil.rmtree(path)
+    return True

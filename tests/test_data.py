@@ -776,3 +776,17 @@ def test_sample_unique_rename_aggregates(ray_tpu_start):
     import numpy as _np
 
     assert abs(ds.std("x") - _np.std(_np.arange(100), ddof=1)) < 1e-6
+
+
+def test_from_torch(ray_tpu_start):
+    """from_torch materializes a map-style torch dataset (ref:
+    ray.data.from_torch)."""
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import TensorDataset
+
+    tds = TensorDataset(torch.arange(12).float()[:, None] * 2)
+    ds = rd.from_torch(tds, override_num_blocks=3)
+    assert ds.count() == 12
+    rows = ds.take_all()
+    vals = sorted(float(r["item"][0][0]) for r in rows)
+    assert vals == [float(2 * i) for i in range(12)]

@@ -80,3 +80,50 @@ def test_workflow_with_actor_nodes(ray_tpu_start, tmp_path):
     out = workflow.run(dag, workflow_id="wfa", storage=str(tmp_path),
                        input=7)
     assert out == 107
+
+
+def test_workflow_async_output_resume_all_delete(ray_tpu_start,
+                                                 tmp_path):
+    """run_async / get_output / resume_all / delete (ref:
+    workflow/api.py run_async:174, get_output:317, resume_all:499)."""
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    with InputNode() as inp:
+        dag = double.bind(inp)
+
+    ref = workflow.run_async(dag, workflow_id="wfa",
+                             storage=str(tmp_path), input=21)
+    assert ray_tpu.get(ref, timeout=60) == 42
+    assert workflow.get_output("wfa", storage=str(tmp_path)) == 42
+
+    # get_output on a non-succeeded workflow raises clearly.
+    with pytest.raises(RuntimeError, match="NOT_FOUND"):
+        workflow.get_output("missing", storage=str(tmp_path))
+
+    # resume_all picks up interrupted workflows.
+    marker = tmp_path / "fail_once"
+    marker.write_text("x")
+
+    @ray_tpu.remote
+    def flaky(x):
+        if os.path.exists(str(marker)):
+            raise RuntimeError("induced")
+        return x + 1
+
+    with InputNode() as inp:
+        dag2 = flaky.bind(inp)
+    with pytest.raises(Exception):
+        workflow.run(dag2, workflow_id="wfb", storage=str(tmp_path),
+                     input=1)
+    assert workflow.get_status("wfb", storage=str(tmp_path))[
+        "status"] == "FAILED"
+    os.remove(str(marker))
+    done = dict(workflow.resume_all(storage=str(tmp_path)))
+    assert done.get("wfb") == 2
+
+    assert workflow.delete("wfa", storage=str(tmp_path))
+    assert not workflow.delete("wfa", storage=str(tmp_path))
+    assert workflow.get_status("wfa", storage=str(tmp_path))[
+        "status"] == "NOT_FOUND"
