@@ -19,7 +19,10 @@ from .bandit import (  # noqa: F401
     BanditLinTSConfig,
     BanditLinUCBConfig,
 )
+from .apex_ddpg import ApexDDPG, ApexDDPGConfig  # noqa: F401
 from .apex_dqn import ApexDQN, ApexDQNConfig  # noqa: F401
+from .ddppo import DDPPO, DDPPOConfig  # noqa: F401
+from .slateq import SlateQ, SlateQConfig  # noqa: F401
 from .crr import CRR, CRRConfig  # noqa: F401
 from .ddpg import DDPG, DDPGConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401

@@ -1,0 +1,198 @@
+"""DD-PPO: decentralized distributed PPO.
+
+Ref analogue: rllib/algorithms/ddppo (Wijmans 2019). Standard PPO
+ships all rollouts to one central learner; DD-PPO removes that
+bottleneck by giving EVERY rollout worker its own learner — each
+worker samples its env, computes PPO gradients on its OWN batch, and
+the gradients are averaged across workers each round (the reference
+allreduces via torch.distributed inside the workers; here the
+worker-learners return gradient pytrees and the driver averages and
+broadcasts — same data flow, with the driver standing in for the
+allreduce since workers are CPU actors, and on-TPU training goes
+through the SPMD JaxTrainer path instead).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+from .core import ActorCriticModule
+from .env_runner import EnvRunner
+from .ppo import PPOConfig, PPOLearner
+from .sample_batch import ACTIONS, ADVANTAGES, LOGPS, OBS, RETURNS
+
+
+class DDPPOConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 2
+        self.sgd_rounds_per_iteration: int = 4
+
+    def build(self) -> "DDPPO":
+        return DDPPO(self.copy())
+
+
+class _WorkerLearner(EnvRunner):
+    """Rollout worker WITH an embedded PPO learner: samples its env,
+    computes clipped-surrogate gradients on its own fresh batch, and
+    applies externally averaged updates (ref: the per-worker learner
+    in ddppo.py — "no central bottleneck")."""
+
+    def __init__(self, env_creator, policy_factory, *, lr, clip,
+                 vf_coeff, ent_coeff, seed=0,
+                 rollout_fragment_length=200, gamma=0.99, lam=0.95):
+        super().__init__(env_creator, policy_factory, seed,
+                         rollout_fragment_length, gamma, lam)
+        self._learner = PPOLearner(self.policy, lr, clip, vf_coeff,
+                                   ent_coeff)
+        self._grad_fn = None
+        self._np_rng = np.random.RandomState(seed + 7)
+
+    def _build_grad(self):
+        import jax
+
+        learner = self._learner
+
+        def loss(params, batch):
+            total, _ = learner.compute_loss(params, {}, batch)
+            return total
+
+        self._grad_fn = jax.jit(jax.value_and_grad(loss))
+
+    def sample_and_grad(self) -> Dict[str, Any]:
+        """One round: fresh rollout -> gradient pytree on it."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._grad_fn is None:
+            self._build_grad()
+        batch = self.sample()
+        jb = {
+            "obs": jnp.asarray(batch[OBS]),
+            "actions": jnp.asarray(np.asarray(batch[ACTIONS],
+                                              np.int32)),
+            "old_logp": jnp.asarray(batch[LOGPS]),
+            "adv": jnp.asarray(batch[ADVANTAGES]),
+            "returns": jnp.asarray(batch[RETURNS]),
+        }
+        loss, grads = self._grad_fn(self._learner._params, jb)
+        return {
+            "grads": jax.tree.map(np.asarray, grads),
+            "loss": float(loss),
+            "count": batch.count,
+        }
+
+    def apply_gradients(self, avg_grads) -> None:
+        """Apply the averaged gradient with the local optimizer (every
+        worker holds identical params + opt state, so updates stay in
+        lockstep — the DD-PPO invariant)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        learner = self._learner
+        grads = jax.tree.map(jnp.asarray, avg_grads)
+        updates, learner._opt_state = learner._tx.update(
+            grads, learner._opt_state, learner._params
+        )
+        learner._params = optax.apply_updates(learner._params, updates)
+        self.policy.set_weights(
+            jax.tree.map(np.asarray, learner._params)
+        )
+
+    def get_weights(self):
+        return self._learner.get_weights()
+
+
+class DDPPO:
+    def __init__(self, config: DDPPOConfig):
+        import ray_tpu
+
+        self.config = config
+        self.iteration = 0
+        c = config
+        creator = c.env_creator()
+        probe = creator()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        if not hasattr(probe.action_space, "n"):
+            raise ValueError("DDPPO supports discrete action spaces")
+        num_actions = int(probe.action_space.n)
+        if hasattr(probe, "close"):
+            probe.close()
+
+        def policy_factory(obs_dim=obs_dim, num_actions=num_actions,
+                           hidden=c.hidden_size, seed=c.seed):
+            from .policy import MLPPolicy
+
+            # SAME seed everywhere: DD-PPO requires identical initial
+            # params on every worker.
+            return MLPPolicy(obs_dim, num_actions, hidden, seed)
+
+        worker_cls = ray_tpu.remote(_WorkerLearner)
+        self.workers = [
+            worker_cls.remote(
+                creator, policy_factory,
+                lr=c.lr, clip=c.clip_param, vf_coeff=c.vf_loss_coeff,
+                ent_coeff=c.entropy_coeff, seed=c.seed + i,
+                rollout_fragment_length=c.rollout_fragment_length,
+                gamma=c.gamma, lam=c.lambda_,
+            )
+            for i in range(c.num_env_runners)
+        ]
+        self._env_steps = 0
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        self.iteration += 1
+        c = self.config
+        losses: List[float] = []
+        for _ in range(c.sgd_rounds_per_iteration):
+            outs = ray_tpu.get([
+                w.sample_and_grad.remote() for w in self.workers
+            ])
+            self._env_steps += sum(o["count"] for o in outs)
+            losses.append(float(np.mean([o["loss"] for o in outs])))
+            # The stand-in allreduce: average gradient pytrees.
+            grads = [o["grads"] for o in outs]
+
+            def avg(*gs):
+                return np.mean(np.stack(gs), axis=0)
+
+            import jax
+
+            avg_grads = jax.tree.map(avg, *grads)
+            ray_tpu.get([
+                w.apply_gradients.remote(avg_grads)
+                for w in self.workers
+            ])
+
+        ep_stats = ray_tpu.get(
+            [w.episode_stats.remote() for w in self.workers]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": self._env_steps,
+            "loss": losses[-1] if losses else float("nan"),
+        }
+
+    def get_weights(self):
+        import ray_tpu
+
+        return ray_tpu.get(self.workers[0].get_weights.remote())
+
+    def stop(self):
+        import ray_tpu
+
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -111,6 +111,11 @@ class TransitionEnvRunner(EnvRunner):
     def set_epsilon(self, epsilon: float):
         self.policy.set_epsilon(epsilon)
 
+    def set_exploration_noise(self, noise: float):
+        """Gaussian-noise scale for deterministic policies (the Ape-X
+        DDPG ladder)."""
+        self.policy.exploration_noise = float(noise)
+
     def sample(self) -> SampleBatch:
         obs_l, act_l, rew_l, done_l, next_l, bound_l = \
             [], [], [], [], [], []

@@ -14,6 +14,7 @@ def _registry() -> Dict[str, Type]:
     from . import (
         A2CConfig,
         AlphaZeroConfig,
+        ApexDDPGConfig,
         ApexDQNConfig,
         APPOConfig,
         ARSConfig,
@@ -23,6 +24,7 @@ def _registry() -> Dict[str, Type]:
         CQLConfig,
         CRRConfig,
         DDPGConfig,
+        DDPPOConfig,
         DQNConfig,
         DTConfig,
         ESConfig,
@@ -34,6 +36,7 @@ def _registry() -> Dict[str, Type]:
         QMIXConfig,
         R2D2Config,
         SACConfig,
+        SlateQConfig,
         TD3Config,
     )
 
@@ -42,6 +45,7 @@ def _registry() -> Dict[str, Type]:
         "alphazero": AlphaZeroConfig,
         "alpha_zero": AlphaZeroConfig,
         "apex": ApexDQNConfig,
+        "apex_ddpg": ApexDDPGConfig,
         "apex_dqn": ApexDQNConfig,
         "appo": APPOConfig,
         "ars": ARSConfig,
@@ -51,6 +55,7 @@ def _registry() -> Dict[str, Type]:
         "cql": CQLConfig,
         "crr": CRRConfig,
         "ddpg": DDPGConfig,
+        "ddppo": DDPPOConfig,
         "dqn": DQNConfig,
         "dt": DTConfig,
         "es": ESConfig,
@@ -62,6 +67,7 @@ def _registry() -> Dict[str, Type]:
         "qmix": QMIXConfig,
         "r2d2": R2D2Config,
         "sac": SACConfig,
+        "slateq": SlateQConfig,
         "td3": TD3Config,
     }
 

@@ -1,0 +1,384 @@
+"""SlateQ: Q-learning for slate recommendation.
+
+Ref analogue: rllib/algorithms/slateq (Ie 2019 "SlateQ: A Tractable
+Decomposition for Reinforcement Learning with Recommendation Sets").
+The action is a SLATE of k items out of a candidate set; the
+combinatorial Q(s, slate) is decomposed under the single-choice user
+model into per-item values:
+    Q(s, A) = sum_{i in A} P(choice = i | s, A) * Q_item(s, i)
+with P given by a conditional logit over item scores (and a no-click
+option). Q_item is a per-item MLP trained by SARSA-style backup on
+the CLICKED item; slate selection is the top-k items by
+v_i * Q_item(s, i) (the paper's greedy decomposition, optimal for
+the conditional-logit choice model).
+
+Env protocol (recsys convention):
+  reset() -> (user_obs, info)
+  step(slate: list[int]) -> (user_obs, reward, terminated, truncated,
+                             {"clicked": item_id or -1})
+  env.num_items: catalog size; env.slate_size: k;
+  env.item_features: [num_items, d_item] array.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import AlgorithmConfig
+from .policy import init_mlp_params
+from .replay_buffers import ReplayBuffer
+from .sample_batch import SampleBatch
+
+
+class SlateQConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size: int = 20_000
+        self.num_steps_sampled_before_learning_starts: int = 300
+        self.num_updates_per_iteration: int = 32
+        self.target_network_update_freq: int = 500
+        self.epsilon_initial: float = 1.0
+        self.epsilon_final: float = 0.05
+        self.epsilon_timesteps: int = 5_000
+
+    def build(self) -> "SlateQ":
+        return SlateQ(self.copy())
+
+
+def _item_scores(weights, user, items):
+    """Choice-model scores v_i = user . W . item (numpy)."""
+    (W, b), = weights["choice"]
+    return (user @ W + b) @ items.T
+
+
+def _q_items(weights, user, items):
+    """Q_item(s, i) for every item: MLP over [user, item] (numpy)."""
+    n = len(items)
+    x = np.concatenate(
+        [np.repeat(user[None], n, 0), items], axis=1
+    )
+    h = x
+    for W, b in weights["trunk"]:
+        h = np.tanh(h @ W + b)
+    (Wq, bq), = weights["q"]
+    return (h @ Wq + bq)[:, 0]
+
+
+class _SlatePolicy:
+    """Greedy slate by v_i * Q_i with epsilon exploration."""
+
+    def __init__(self, user_dim, item_dim, num_items, slate_size,
+                 hidden, seed):
+        rng = np.random.RandomState(seed)
+        self.weights = {
+            "trunk": init_mlp_params(
+                rng, [user_dim + item_dim, hidden, hidden]
+            ),
+            "q": init_mlp_params(rng, [hidden, 1]),
+            "choice": init_mlp_params(rng, [user_dim, item_dim]),
+        }
+        self.k = slate_size
+        self.num_items = num_items
+        self.epsilon = 1.0
+
+    def set_weights(self, w):
+        self.weights = w
+
+    def get_weights(self):
+        return self.weights
+
+    def set_epsilon(self, e):
+        self.epsilon = float(e)
+
+    def compute_slate(self, user, items, rng) -> List[int]:
+        if rng.rand() < self.epsilon:
+            return list(rng.choice(self.num_items, self.k,
+                                   replace=False))
+        v = _item_scores(self.weights, user, items)
+        q = _q_items(self.weights, user, items)
+        return list(np.argsort(-(v * q))[:self.k])
+
+
+class _SlateEnvRunner:
+    """Steps a recsys env; emits (user, slate, clicked, reward,
+    next_user, done) transitions."""
+
+    def __init__(self, env_creator, policy_factory, seed=0,
+                 rollout_fragment_length=100, **_):
+        self.env = env_creator()
+        self.policy = policy_factory()
+        self.items = np.asarray(self.env.item_features, np.float32)
+        self.rng = np.random.RandomState(seed)
+        self.fragment = rollout_fragment_length
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_reward = 0.0
+        self._episode_rewards: List[float] = []
+
+    def set_weights(self, w):
+        self.policy.set_weights(w)
+
+    def set_epsilon(self, e):
+        self.policy.set_epsilon(e)
+
+    def sample(self) -> SampleBatch:
+        users, slates, clicks, rews, nxts, dones = \
+            [], [], [], [], [], []
+        for _ in range(self.fragment):
+            user = np.asarray(self._obs, np.float32).reshape(-1)
+            slate = self.policy.compute_slate(user, self.items,
+                                              self.rng)
+            nxt, r, term, trunc, info = self.env.step(slate)
+            users.append(user)
+            slates.append(slate)
+            clicks.append(int(info.get("clicked", -1)))
+            rews.append(float(r))
+            nxts.append(np.asarray(nxt, np.float32).reshape(-1))
+            dones.append(bool(term))
+            self._episode_reward += float(r)
+            if term or trunc:
+                self._episode_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        return SampleBatch({
+            "user": np.stack(users),
+            "slate": np.asarray(slates, np.int32),
+            "clicked": np.asarray(clicks, np.int32),
+            "rew": np.asarray(rews, np.float32),
+            "next_user": np.stack(nxts),
+            "done": np.asarray(dones, np.float32),
+        })
+
+    def episode_stats(self) -> Dict[str, float]:
+        recent = self._episode_rewards[-20:]
+        return {
+            "episodes_total": len(self._episode_rewards),
+            "episode_reward_mean": float(np.mean(recent))
+            if recent else 0.0,
+        }
+
+
+class SlateQLearner:
+    """Jitted SARSA-on-clicked-item update with the slate
+    decomposition target."""
+
+    def __init__(self, policy, items: np.ndarray, slate_size: int,
+                 lr: float, gamma: float):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._tx = optax.adam(lr)
+        self._params = jax.tree.map(jnp.asarray, policy.get_weights())
+        self._target = jax.tree.map(lambda x: x, self._params)
+        self._opt_state = self._tx.init(self._params)
+        items_j = jnp.asarray(items)
+        k = slate_size
+
+        def q_items(p, users):
+            """[B, N]: Q_item for every catalog item."""
+            B = users.shape[0]
+            N = items_j.shape[0]
+            u = jnp.repeat(users[:, None, :], N, 1)
+            it = jnp.repeat(items_j[None], B, 0)
+            x = jnp.concatenate([u, it], -1).reshape(B * N, -1)
+            h = x
+            for W, b in p["trunk"]:
+                h = jnp.tanh(h @ W + b)
+            (Wq, bq), = p["q"]
+            return (h @ Wq + bq).reshape(B, N)
+
+        def scores(p, users):
+            (W, b), = p["choice"]
+            return (users @ W + b) @ items_j.T
+
+        def loss_fn(p, tgt, batch):
+            users, clicked = batch["user"], batch["clicked"]
+            # Predicted Q of the CLICKED item (only clicked steps
+            # carry a gradient — the no-click mask).
+            q_all = q_items(p, users)
+            q_c = jnp.take_along_axis(
+                q_all, jnp.maximum(clicked, 0)[:, None], 1
+            )[:, 0]
+            # Target: next greedy slate under the decomposition, its
+            # expected value under the conditional-logit choice model.
+            nq_all = q_items(tgt, batch["next_user"])
+            nv = scores(tgt, batch["next_user"])
+            vq = nv * nq_all
+            top = jax.lax.top_k(vq, k)[1]               # [B, k]
+            v_top = jnp.take_along_axis(nv, top, 1)
+            q_top = jnp.take_along_axis(nq_all, top, 1)
+            # No-click option has score 0 in the logit.
+            ex = jnp.exp(v_top - v_top.max(-1, keepdims=True))
+            denom = ex.sum(-1) + jnp.exp(-v_top.max(-1))
+            slate_value = (ex * q_top).sum(-1) / denom
+            y = batch["rew"] + gamma * (1 - batch["done"]) * \
+                jax.lax.stop_gradient(slate_value)
+            mask = (clicked >= 0).astype(jnp.float32)
+            td = (q_c - y) * mask
+            td_loss = (td * td).sum() / jnp.maximum(mask.sum(), 1.0)
+            # Choice-model MLE on the click logs (the paper trains the
+            # user-choice model separately by maximum likelihood; the
+            # Q loss above never touches the choice head — without
+            # this term the slate ranking would use random scores).
+            v_all = scores(p, users)
+            v_slate = jnp.take_along_axis(v_all, batch["slate"], 1)
+            choice_logits = jnp.concatenate(
+                [v_slate, jnp.zeros_like(v_slate[:, :1])], axis=1
+            )
+            logp = jax.nn.log_softmax(choice_logits)
+            ce = -jnp.take_along_axis(
+                logp, batch["click_pos"][:, None], 1
+            )[:, 0].mean()
+            return td_loss + ce, (td_loss, ce)
+
+        def update(p, opt_state, tgt, batch):
+            (loss, _aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(p, tgt, batch)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        self._update = jax.jit(update)
+
+    def learn_on_batch(self, mb) -> float:
+        import jax.numpy as jnp
+
+        slate = np.asarray(mb["slate"], np.int64)
+        clicked = np.asarray(mb["clicked"], np.int64)
+        # Position of the clicked item within its slate; k = no-click.
+        pos = np.full(len(clicked), slate.shape[1], np.int32)
+        hit = slate == clicked[:, None]
+        rows, cols = np.nonzero(hit)
+        pos[rows] = cols
+        batch = {
+            "user": jnp.asarray(mb["user"]),
+            "slate": jnp.asarray(slate, jnp.int32),
+            "clicked": jnp.asarray(clicked, jnp.int32),
+            "click_pos": jnp.asarray(pos),
+            "rew": jnp.asarray(mb["rew"]),
+            "next_user": jnp.asarray(mb["next_user"]),
+            "done": jnp.asarray(mb["done"]),
+        }
+        self._params, self._opt_state, loss = self._update(
+            self._params, self._opt_state, self._target, batch
+        )
+        return float(loss)
+
+    def sync_target(self):
+        import jax
+
+        self._target = jax.tree.map(lambda x: x, self._params)
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params)
+
+
+class SlateQ:
+    def __init__(self, config: SlateQConfig):
+        import ray_tpu
+
+        self.config = config
+        self.iteration = 0
+        c = config
+        creator = c.env_creator()
+        probe = creator()
+        user0, _ = probe.reset(seed=0)
+        user_dim = int(np.asarray(user0).reshape(-1).shape[0])
+        items = np.asarray(probe.item_features, np.float32)
+        self._slate_size = int(probe.slate_size)
+        num_items = int(probe.num_items)
+        if hasattr(probe, "close"):
+            probe.close()
+
+        def policy_factory(user_dim=user_dim,
+                           item_dim=items.shape[1],
+                           num_items=num_items,
+                           k=self._slate_size,
+                           hidden=c.hidden_size, seed=c.seed):
+            return _SlatePolicy(user_dim, item_dim, num_items, k,
+                                hidden, seed)
+
+        runner_cls = ray_tpu.remote(_SlateEnvRunner)
+        self.runners = [
+            runner_cls.remote(
+                creator, policy_factory, seed=c.seed + i,
+                rollout_fragment_length=c.rollout_fragment_length,
+            )
+            for i in range(c.num_env_runners)
+        ]
+        self.learner = SlateQLearner(
+            policy_factory(), items, self._slate_size, c.lr, c.gamma
+        )
+        self.buffer = ReplayBuffer(c.buffer_size, seed=c.seed)
+        self._env_steps = 0
+        self._last_target_sync = 0
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._env_steps / max(1, c.epsilon_timesteps))
+        return c.epsilon_initial + frac * (
+            c.epsilon_final - c.epsilon_initial
+        )
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        self.iteration += 1
+        c = self.config
+        eps = self._epsilon()
+        ray_tpu.get([r.set_epsilon.remote(eps) for r in self.runners])
+        batches = ray_tpu.get([r.sample.remote() for r in self.runners])
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += b.count
+
+        loss = float("nan")
+        num_updates = 0
+        if self._env_steps >= c.num_steps_sampled_before_learning_starts:
+            for _ in range(c.num_updates_per_iteration):
+                loss = self.learner.learn_on_batch(
+                    self.buffer.sample(c.minibatch_size)
+                )
+                num_updates += 1
+            if (self._env_steps - self._last_target_sync
+                    >= c.target_network_update_freq):
+                self.learner.sync_target()
+                self._last_target_sync = self._env_steps
+            w = self.learner.get_weights()
+            ray_tpu.get(
+                [r.set_weights.remote(w) for r in self.runners]
+            )
+
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": self._env_steps,
+            "num_learner_updates": num_updates,
+            "epsilon": eps,
+            "loss": loss,
+        }
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
