@@ -5,6 +5,7 @@ from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from .env_runner import EnvRunner  # noqa: F401
 from .policy import MLPPolicy  # noqa: F401
 from .a2c import A2C, A2CConfig  # noqa: F401
+from .a3c import A3C, A3CConfig  # noqa: F401
 from .alpha_zero import (  # noqa: F401
     AlphaZero,
     AlphaZeroConfig,
@@ -27,6 +28,7 @@ from .crr import CRR, CRRConfig  # noqa: F401
 from .ddpg import DDPG, DDPGConfig  # noqa: F401
 from .dqn import DQN, DQNConfig  # noqa: F401
 from .dt import DT, DTConfig  # noqa: F401
+from .pg import PG, PGConfig  # noqa: F401
 from .qmix import QMIX, QMIXConfig  # noqa: F401
 from .es import ES, ESConfig  # noqa: F401
 from .marwil import MARWIL, MARWILConfig  # noqa: F401

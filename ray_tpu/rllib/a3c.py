@@ -1,0 +1,186 @@
+"""A3C: asynchronous advantage actor-critic.
+
+Ref analogue: rllib/algorithms/a3c (Mnih 2016). The asynchrony is the
+point: each rollout worker computes actor-critic gradients on its own
+fresh fragment and the central learner applies them AS THEY ARRIVE —
+no barrier, no averaging — then sends that worker the refreshed
+weights. Slow workers therefore compute gradients against slightly
+stale parameters (the HOGWILD-style tolerance the paper relies on).
+Reuses DD-PPO's embedded worker-learner plane with the A2C loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .a2c import A2CLearner
+from .algorithm import AlgorithmConfig
+from .ddppo import _WorkerLearner
+from .sample_batch import ACTIONS, ADVANTAGES, OBS, RETURNS
+
+
+class A3CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.grads_per_iteration: int = 8
+
+    def build(self) -> "A3C":
+        return A3C(self.copy())
+
+
+class _A3CWorker(_WorkerLearner):
+    """Worker computing A2C gradients on its own rollouts."""
+
+    def __init__(self, env_creator, policy_factory, *, lr, vf_coeff,
+                 ent_coeff, seed=0, rollout_fragment_length=200,
+                 gamma=0.99, lam=0.95):
+        # Reuse the DD-PPO worker shell with the A2C loss.
+        super().__init__(
+            env_creator, policy_factory, lr=lr, clip=0.2,
+            vf_coeff=vf_coeff, ent_coeff=ent_coeff, seed=seed,
+            rollout_fragment_length=rollout_fragment_length,
+            gamma=gamma, lam=lam,
+        )
+        self._learner = A2CLearner(self.policy, lr, vf_coeff,
+                                   ent_coeff)
+        self._grad_fn = None
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self._learner._params = jax.tree.map(jnp.asarray, weights)
+        self.policy.set_weights(weights)
+
+
+class A3C:
+    def __init__(self, config: A3CConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        import ray_tpu
+
+        self.config = config
+        self.iteration = 0
+        c = config
+        creator = c.env_creator()
+        probe = creator()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        if not hasattr(probe.action_space, "n"):
+            raise ValueError("A3C supports discrete action spaces")
+        num_actions = int(probe.action_space.n)
+        if hasattr(probe, "close"):
+            probe.close()
+
+        def policy_factory(obs_dim=obs_dim, num_actions=num_actions,
+                           hidden=c.hidden_size, seed=c.seed):
+            from .policy import MLPPolicy
+
+            return MLPPolicy(obs_dim, num_actions, hidden, seed)
+
+        worker_cls = ray_tpu.remote(_A3CWorker)
+        self.workers = [
+            worker_cls.remote(
+                creator, policy_factory,
+                lr=c.lr, vf_coeff=c.vf_loss_coeff,
+                ent_coeff=c.entropy_coeff, seed=c.seed + i,
+                rollout_fragment_length=c.rollout_fragment_length,
+                gamma=c.gamma, lam=c.lambda_,
+            )
+            for i in range(c.num_env_runners)
+        ]
+        # Central parameter server: the driver holds the canonical
+        # params + optimizer and applies gradients as they land.
+        policy = policy_factory()
+        self._params = jax.tree.map(jnp.asarray, policy.get_weights())
+        self._tx = optax.adam(c.lr)
+        self._opt_state = self._tx.init(self._params)
+
+        def apply(params, opt_state, grads):
+            updates, opt_state = self._tx.update(grads, opt_state,
+                                                 params)
+            import optax as _optax
+
+            return _optax.apply_updates(params, updates), opt_state
+
+        self._apply = jax.jit(apply)
+        self._env_steps = 0
+        self._inflight: Dict[Any, int] = {}
+
+    def _weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params)
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        import ray_tpu
+
+        self.iteration += 1
+        c = self.config
+        if not self._inflight:
+            w = self._weights()
+            ray_tpu.get([wk.set_weights.remote(w)
+                         for wk in self.workers])
+            for i, wk in enumerate(self.workers):
+                self._inflight[wk.sample_and_grad.remote()] = i
+
+        losses: List[float] = []
+        applied = 0
+        while applied < c.grads_per_iteration:
+            ready, _ = ray_tpu.wait(
+                list(self._inflight), num_returns=1, timeout=30.0
+            )
+            if not ready:
+                break
+            ref = ready[0]
+            i = self._inflight.pop(ref)
+            out = ray_tpu.get(ref)
+            grads = jax.tree.map(jnp.asarray, out["grads"])
+            # Apply THIS worker's gradient immediately (async,
+            # possibly stale — the A3C contract).
+            self._params, self._opt_state = self._apply(
+                self._params, self._opt_state, grads
+            )
+            self._env_steps += out["count"]
+            losses.append(out["loss"])
+            applied += 1
+            # Refresh only this worker and re-arm it.
+            self.workers[i].set_weights.remote(self._weights())
+            self._inflight[
+                self.workers[i].sample_and_grad.remote()
+            ] = i
+
+        ep_stats = ray_tpu.get(
+            [wk.episode_stats.remote() for wk in self.workers]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled": self._env_steps,
+            "num_grads_applied": applied,
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+        }
+
+    def get_weights(self):
+        return self._weights()
+
+    def stop(self):
+        import ray_tpu
+
+        for wk in self.workers:
+            try:
+                ray_tpu.kill(wk)
+            except Exception:
+                pass

@@ -1,0 +1,64 @@
+"""PG: vanilla policy gradient (REINFORCE).
+
+Ref analogue: rllib/algorithms/pg — the minimal on-policy baseline:
+no critic, no clipping, no epochs; the gradient is
+grad log pi(a|s) * R_t with monte-carlo returns (GAE with lambda=1 /
+values=0 reduces to exactly this, so the runner plane is shared with
+A2C/PPO and the learner drops the value head terms).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .a2c import A2C
+from .algorithm import AlgorithmConfig
+from .core import ActorCriticModule, Learner
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+
+    def build(self) -> "PG":
+        return PG(self.copy())
+
+
+class PGLearner(Learner):
+    """-E[log pi(a|s) * R] — returns as the signal, no baseline."""
+
+    def __init__(self, policy, lr: float):
+        super().__init__(policy.get_weights(), lr=lr)
+
+    def compute_loss(self, params, target, batch):
+        import jax
+        import jax.numpy as jnp
+
+        logits, _ = ActorCriticModule.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1
+        )[:, 0]
+        ret = batch["returns"]
+        ret_n = (ret - ret.mean()) / (ret.std() + 1e-8)
+        pi_loss = -(logp * ret_n).mean()
+        return pi_loss, {"policy_loss": pi_loss}
+
+
+class PG(A2C):
+    """Shares A2C's synchronous driver; only the loss differs."""
+
+    def _build_learner(self, policy):
+        return PGLearner(policy, self.config.lr)
+
+    def update_minibatch(self, mb) -> Dict[str, Any]:
+        from .sample_batch import ACTIONS, OBS, RETURNS
+
+        return self.learner.update_device({
+            "obs": mb[OBS],
+            "actions": np.asarray(mb[ACTIONS], dtype=np.int32),
+            "returns": mb[RETURNS],
+        })

@@ -13,6 +13,7 @@ from typing import Dict, List, Type
 def _registry() -> Dict[str, Type]:
     from . import (
         A2CConfig,
+        A3CConfig,
         AlphaZeroConfig,
         ApexDDPGConfig,
         ApexDQNConfig,
@@ -32,6 +33,7 @@ def _registry() -> Dict[str, Type]:
         MADDPGConfig,
         MARWILConfig,
         MultiAgentPPOConfig,
+        PGConfig,
         PPOConfig,
         QMIXConfig,
         R2D2Config,
@@ -42,6 +44,7 @@ def _registry() -> Dict[str, Type]:
 
     return {
         "a2c": A2CConfig,
+        "a3c": A3CConfig,
         "alphazero": AlphaZeroConfig,
         "alpha_zero": AlphaZeroConfig,
         "apex": ApexDQNConfig,
@@ -63,6 +66,7 @@ def _registry() -> Dict[str, Type]:
         "maddpg": MADDPGConfig,
         "marwil": MARWILConfig,
         "multi_agent_ppo": MultiAgentPPOConfig,
+        "pg": PGConfig,
         "ppo": PPOConfig,
         "qmix": QMIXConfig,
         "r2d2": R2D2Config,

@@ -233,3 +233,57 @@ def test_slateq_learns_recommendation(ray_tpu_start):
         assert np.isfinite(result["loss"])
     finally:
         algo.stop()
+
+
+def test_pg_learns_sign_task(ray_tpu_start):
+    """Vanilla REINFORCE solves sign matching (ref:
+    rllib/algorithms/pg)."""
+    from ray_tpu.rllib import PGConfig
+
+    config = (
+        PGConfig()
+        .environment(_sign_env)
+        .env_runners(num_env_runners=2, rollout_fragment_length=120)
+        .training(lr=5e-3, train_batch_size=240, minibatch_size=240)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    try:
+        best = -31.0
+        for _ in range(25):
+            result = algo.train()
+            if result["episodes_total"] > 0:
+                best = max(best, result["episode_reward_mean"])
+            if best > 24:
+                break
+        assert best > 24, best
+    finally:
+        algo.stop()
+
+
+def test_a3c_learns_sign_task(ray_tpu_start):
+    """A3C: per-worker gradients applied asynchronously as they land
+    (ref: rllib/algorithms/a3c)."""
+    from ray_tpu.rllib import A3CConfig
+
+    config = (
+        A3CConfig()
+        .environment(_sign_env)
+        .env_runners(num_env_runners=2, rollout_fragment_length=120)
+        .training(lr=5e-3)
+        .debugging(seed=0)
+    )
+    config.grads_per_iteration = 6
+    algo = config.build()
+    try:
+        best = -31.0
+        for _ in range(25):
+            result = algo.train()
+            if result["episodes_total"] > 0:
+                best = max(best, result["episode_reward_mean"])
+            if best > 24:
+                break
+        assert best > 24, best
+        assert result["num_grads_applied"] > 0
+    finally:
+        algo.stop()
