@@ -148,31 +148,53 @@ class ObjectRefGenerator:
     def __del__(self):
         """Abandoned mid-stream: release the producer pins of every
         unconsumed item and drop all progress records, so a consumer that
-        stops early doesn't leak object-store memory."""
-        from .runtime_context import current_runtime_or_none
+        stops early doesn't leak object-store memory.
 
-        rt = current_runtime_or_none()
-        if rt is None:
-            return
+        The cleanup does BLOCKING control-plane calls, and __del__ can
+        fire on ANY thread the garbage collector happens to run on —
+        including the node-manager event loop itself (observed: gc
+        during frame pickling on the NM loop → kv_keys → call_sync onto
+        the same loop → the whole runtime deadlocks). So the work is
+        handed to a short-lived daemon thread, never run inline."""
         try:
-            prefix = f"__stream__/{self._task_id.hex()}/"
-            for key in rt.kv_keys(prefix):
-                try:
-                    idx = int(key.rsplit("/", 1)[1])
-                except ValueError:
-                    continue
-                blob = rt.kv_get(key)
-                if blob and idx >= self._next:
-                    payload = cloudpickle.loads(blob)
-                    if "oid" in payload:
-                        rt.refs.decr(ObjectID.from_hex(payload["oid"]))
-                try:
-                    rt.kv_del(key)
-                except Exception:
-                    pass
+            import threading
+
+            from .runtime_context import current_runtime_or_none
+
+            rt = current_runtime_or_none()
+            if rt is None:
+                return
+            threading.Thread(
+                target=_release_abandoned_stream,
+                args=(rt, self._task_id, self._next),
+                name="stream-gc",
+                daemon=True,
+            ).start()
         except Exception:
-            pass
+            pass  # interpreter shutting down / runtime gone
 
     def __repr__(self):
         return (f"ObjectRefGenerator(task={self._task_id.hex()[:8]}, "
                 f"next={self._next})")
+
+
+def _release_abandoned_stream(rt, task_id, next_idx: int) -> None:
+    """Off-thread body of ObjectRefGenerator.__del__ (see there)."""
+    try:
+        prefix = f"__stream__/{task_id.hex()}/"
+        for key in rt.kv_keys(prefix):
+            try:
+                idx = int(key.rsplit("/", 1)[1])
+            except ValueError:
+                continue
+            blob = rt.kv_get(key)
+            if blob and idx >= next_idx:
+                payload = cloudpickle.loads(blob)
+                if "oid" in payload:
+                    rt.refs.decr(ObjectID.from_hex(payload["oid"]))
+            try:
+                rt.kv_del(key)
+            except Exception:
+                pass
+    except Exception:
+        pass

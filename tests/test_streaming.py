@@ -74,3 +74,47 @@ def test_streaming_empty_generator(ray_tpu_start):
             yield 1
 
     assert list(empty.remote()) == []
+
+
+def test_generator_del_on_node_manager_loop_does_not_deadlock(
+        ray_tpu_start):
+    """Regression: gc can fire ObjectRefGenerator.__del__ on ANY
+    thread — including the node-manager event loop (observed mid-frame
+    pickling). The old inline cleanup issued a blocking call_sync back
+    onto that same loop and froze the entire runtime; cleanup now runs
+    on a detached thread, so the loop must stay responsive."""
+    import threading
+
+    from ray_tpu.core.runtime_context import current_runtime
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        for i in range(6):
+            yield i
+
+    g = gen.remote()
+    assert ray_tpu.get(next(g)) == 0
+    assert ray_tpu.get(next(g)) == 1
+
+    nm = current_runtime()._nm
+    ran = threading.Event()
+
+    def fire_del_on_loop():
+        try:
+            g.__del__()  # simulate gc running on the loop thread
+        finally:
+            ran.set()
+
+    nm._loop.call_soon_threadsafe(fire_del_on_loop)
+    assert ran.wait(timeout=10), "__del__ blocked the NM loop"
+    # The loop survived: control-plane ops still complete.
+    import ray_tpu as rt
+
+    assert rt.kv_put("post_del_probe", b"ok")
+    assert rt.kv_get("post_del_probe") == b"ok"
+
+    @ray_tpu.remote
+    def ping():
+        return 41
+
+    assert rt.get(ping.remote(), timeout=30) == 41
