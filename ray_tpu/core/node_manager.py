@@ -3514,11 +3514,12 @@ class NodeManager:
                 "actor_id": w.actor_id.hex() if w.actor_id else None,
             })
         objects = []
-        for oid, size, where in self.directory.entries_view():
+        for oid, size, where, refs in self.directory.entries_view():
             objects.append({
                 "object_id": oid.hex(),
                 "size_bytes": size,
                 "where": where,
+                "refcount": refs,
                 "node_id": node,
             })
         return {

@@ -642,20 +642,24 @@ class ObjectDirectory:
             return len(self._entries)
 
     def entries_view(self):
-        """(object_id, size_bytes, where) rows for the state API."""
+        """(object_id, size_bytes, where, refcount) rows for the state
+        API (the refcount column is what `rtpu memory` surfaces — ref
+        analogue: `ray memory`'s per-object reference table)."""
         with self._lock:
             out = []
             for oid, loc in self._entries.items():
+                refs = self._refcounts.get(oid, 0)
                 if isinstance(loc, (ShmLocation, ArenaLocation)):
-                    out.append((oid, loc.size, "shm"))
+                    out.append((oid, loc.size, "shm", refs))
                 elif isinstance(loc, InlineLocation):
-                    out.append((oid, len(loc.data), "inline"))
+                    out.append((oid, len(loc.data), "inline", refs))
                 elif isinstance(loc, SpilledLocation):
-                    out.append((oid, getattr(loc, "size", 0), "spilled"))
+                    out.append((oid, getattr(loc, "size", 0), "spilled",
+                                refs))
                 elif isinstance(loc, RemoteLocation):
-                    out.append((oid, 0, "remote"))
+                    out.append((oid, 0, "remote", refs))
                 else:
-                    out.append((oid, 0, type(loc).__name__))
+                    out.append((oid, 0, type(loc).__name__, refs))
             return out
 
     def spill_candidates(self, bytes_needed: int):

@@ -427,6 +427,37 @@ def cmd_stop_job(args) -> int:
         ray_tpu.shutdown()
 
 
+def cmd_memory(args) -> int:
+    """Per-object reference table (ref: `ray memory` —
+    _private/internal_api.py memory_summary)."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.util import state as state_api
+
+        rows = state_api.list_objects(limit=args.limit)
+        rows.sort(key=lambda r: -r.get("size_bytes", 0))
+        by_where = {}
+        total = 0
+        for r in rows:
+            by_where.setdefault(r["where"], [0, 0])
+            by_where[r["where"]][0] += 1
+            by_where[r["where"]][1] += r.get("size_bytes", 0)
+            total += r.get("size_bytes", 0)
+        print(f"{'OBJECT ID':42} {'SIZE':>12} {'REFS':>5} "
+              f"{'WHERE':8} NODE")
+        for r in rows[:args.limit]:
+            print(f"{r['object_id']:42} "
+                  f"{r.get('size_bytes', 0):>12} "
+                  f"{r.get('refcount', 0):>5} "
+                  f"{r['where']:8} {r['node_id'][:8]}")
+        print(f"\n{len(rows)} objects, {total / 1e6:.2f} MB total")
+        for where, (n, size) in sorted(by_where.items()):
+            print(f"  {where}: {n} objects, {size / 1e6:.2f} MB")
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
 # --------------------------------------------------------------- serve
 
 def cmd_serve_deploy(args) -> int:
@@ -547,6 +578,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("job_id")
     _add_address(p)
     p.set_defaults(fn=cmd_stop_job)
+
+    p = sub.add_parser("memory", help="per-object reference table")
+    p.add_argument("--limit", type=int, default=50)
+    _add_address(p)
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("serve", help="serve: deploy/status/shutdown")
     ssub = p.add_subparsers(dest="serve_cmd", required=True)

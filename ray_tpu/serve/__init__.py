@@ -16,6 +16,7 @@ from .api import (
     shutdown,
     status,
 )
+from .dag_driver import DAGDriver, json_request  # noqa: F401
 from .deployment import AutoscalingConfig, Deployment  # noqa: F401
 from .schema import deploy_config, parse_config  # noqa: F401
 from .handle import DeploymentHandle, ServeFuture  # noqa: F401

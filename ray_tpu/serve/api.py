@@ -89,6 +89,12 @@ def _deploy_children(controller, target: Deployment,
             _deploy_one(controller, v.name, v,
                         stack=stack + (v.name,))
             return BoundDeployment(v.name)
+        # Deployments may ride inside containers (DAGDriver's
+        # {route: graph} dict is the canonical case).
+        if isinstance(v, dict):
+            return {k: resolve(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(resolve(x) for x in v)
         return v
 
     args = tuple(resolve(a) for a in target._init_args)

@@ -1,0 +1,89 @@
+"""DAGDriver: HTTP ingress deployment routing to deployment graphs.
+
+Ref analogue: serve/drivers.py DAGDriver — one ingress deployment
+that maps route prefixes to bound deployment graphs and applies an
+http adapter to the raw request before calling the matched graph:
+
+    serve.run(DAGDriver.bind({
+        "/add": adder_graph,
+        "/mul": multiplier_graph,
+    }, http_adapter=json_request))
+
+The driver deploys like any other deployment (replicas, autoscaling,
+rolling updates apply); nested graphs deploy first via the
+deployment-graph build and arrive as live handles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from .deployment import Deployment
+
+
+def json_request(request: Any) -> Any:
+    """Default http adapter: pass the parsed JSON body through (ref:
+    serve.http_adapters.json_request)."""
+    return request
+
+
+class _DAGDriverImpl:
+    """The ingress callable: route -> handle dispatch."""
+
+    def __init__(self, routes: Dict[str, Any],
+                 http_adapter: Optional[Callable] = None):
+        # Values arrive as live DeploymentHandles (BoundDeployment
+        # resolution happens in the replica).
+        self._routes = {self._norm(k): v for k, v in routes.items()}
+        self._adapter = http_adapter or json_request
+
+    @staticmethod
+    def _norm(route: str) -> str:
+        return "/" + route.strip("/")
+
+    def __call__(self, request: Any, *, route: str = "") -> Any:
+        """Dispatch ``request`` to the graph mounted at ``route``.
+        With a single mounted route, ``route`` may be omitted."""
+        key = self._norm(route) if route else None
+        if key is None:
+            if len(self._routes) == 1:
+                key = next(iter(self._routes))
+            else:
+                raise ValueError(
+                    f"route required; mounted: "
+                    f"{sorted(self._routes)}"
+                )
+        handle = self._routes.get(key)
+        if handle is None:
+            raise KeyError(
+                f"no graph mounted at {key!r}; mounted: "
+                f"{sorted(self._routes)}"
+            )
+        value = self._adapter(request)
+        return handle.remote(value).result(timeout=120)
+
+    def routes(self) -> list:
+        return sorted(self._routes)
+
+
+class DAGDriver:
+    """Builder: ``DAGDriver.bind({route: graph, ...})`` returns a
+    Deployment whose replicas dispatch to the mounted graphs."""
+
+    @staticmethod
+    def bind(routes: Dict[str, Any],
+             http_adapter: Optional[Callable] = None,
+             **deployment_options: Any) -> Deployment:
+        if not routes:
+            raise ValueError("DAGDriver.bind needs at least one route")
+        for k, v in routes.items():
+            if not isinstance(v, Deployment):
+                raise TypeError(
+                    f"route {k!r} must map to a bound Deployment, "
+                    f"got {type(v).__name__}"
+                )
+        dep = Deployment(
+            _DAGDriverImpl, deployment_options.pop("name", "DAGDriver"),
+            **deployment_options,
+        )
+        return dep.bind(routes, http_adapter=http_adapter)

@@ -39,6 +39,10 @@ class BoundDeployment:
 def _resolve_bound(value):
     if isinstance(value, BoundDeployment):
         return value.resolve()
+    if isinstance(value, dict):
+        return {k: _resolve_bound(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return type(value)(_resolve_bound(v) for v in value)
     return value
 
 

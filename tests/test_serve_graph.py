@@ -144,3 +144,65 @@ def test_deploy_config_end_to_end(ray_tpu_start, tmp_path):
     finally:
         sys.path.remove(str(tmp_path))
         serve.shutdown()
+
+
+def test_dag_driver_multi_route(ray_tpu_start):
+    """DAGDriver: one ingress deployment dispatching to several
+    mounted graphs (ref: serve/drivers.py DAGDriver)."""
+    import ray_tpu.serve as serve
+    from ray_tpu.serve import DAGDriver
+
+    @serve.deployment
+    class Adder:
+        def __init__(self, inc):
+            self.inc = inc
+
+        def __call__(self, x):
+            return x + self.inc
+
+    @serve.deployment
+    class Multiplier:
+        def __call__(self, x):
+            return x * 10
+
+    try:
+        handle = serve.run(DAGDriver.bind({
+            "/add": Adder.bind(5),
+            "/mul": Multiplier.bind(),
+        }))
+        assert handle.remote(7, route="/add").result(timeout=60) == 12
+        assert handle.remote(7, route="mul").result(timeout=60) == 70
+        status = serve.status()
+        assert {"DAGDriver", "Adder", "Multiplier"} <= set(status)
+        # Unknown route raises; missing route on multi-mount raises.
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="no graph mounted"):
+            handle.remote(1, route="/nope").result(timeout=60)
+        with _pytest.raises(Exception, match="route required"):
+            handle.remote(1).result(timeout=60)
+    finally:
+        serve.shutdown()
+
+
+def test_dag_driver_single_route_and_adapter(ray_tpu_start):
+    """Single mount needs no route; the http adapter shapes the
+    payload first."""
+    import ray_tpu.serve as serve
+    from ray_tpu.serve import DAGDriver
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return {"got": x}
+
+    def double_adapter(req):
+        return req * 2
+
+    try:
+        handle = serve.run(DAGDriver.bind(
+            {"/echo": Echo.bind()}, http_adapter=double_adapter
+        ))
+        assert handle.remote(21).result(timeout=60) == {"got": 42}
+    finally:
+        serve.shutdown()
