@@ -15,6 +15,7 @@ from .alpha_zero import (  # noqa: F401
 from .ars import ARS, ARSConfig  # noqa: F401
 from .maddpg import MADDPG, MADDPGConfig  # noqa: F401
 from .r2d2 import R2D2, R2D2Config  # noqa: F401
+from .recurrent_ppo import RecurrentPPO, RecurrentPPOConfig  # noqa: F401
 from .bandit import (  # noqa: F401
     Bandit,
     BanditLinTSConfig,

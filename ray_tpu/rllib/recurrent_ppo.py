@@ -1,0 +1,365 @@
+"""Recurrent PPO: LSTM policies for partially observable tasks.
+
+Ref analogue: the reference PPO's ``use_lstm`` model option
+(rllib/models/ — the LSTM wrapper every on-policy algorithm can turn
+on). The rollout policy is an LSTM actor-critic run in numpy with
+carried hidden state (reset at episode boundaries); replaying uses
+R2D2's stored-state strategy — fragments are chopped into
+fixed-length sequences carrying the recurrent state captured at
+sequence start, never crossing an episode boundary (short tails are
+padded and masked) — and the learner unrolls online with ``lax.scan``
+under a masked PPO clipped-surrogate loss, with GAE computed over the
+original flat fragment before chopping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .policy import init_mlp_params
+from .r2d2 import _lstm_step_np
+from .sample_batch import SampleBatch, compute_gae
+
+
+class RecurrentPPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.clip_param: float = 0.2
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.lstm_size: int = 32
+        self.seq_len: int = 8
+        self.num_epochs = 4
+
+    def build(self) -> "RecurrentPPO":
+        return RecurrentPPO(self.copy())
+
+
+def _init_params(obs_dim, num_actions, hidden, seed):
+    rng = np.random.RandomState(seed)
+    scale = 1.0 / np.sqrt(obs_dim + hidden)
+    return {
+        "wx": (rng.randn(obs_dim, 4 * hidden) * scale
+               ).astype(np.float32),
+        "wh": (rng.randn(hidden, 4 * hidden) * scale
+               ).astype(np.float32),
+        "b": np.zeros(4 * hidden, np.float32),
+        "pi": init_mlp_params(rng, [hidden, num_actions]),
+        "vf": init_mlp_params(rng, [hidden, 1]),
+    }
+
+
+class _LSTMAcPolicy:
+    """numpy LSTM actor-critic with carried hidden state."""
+
+    def __init__(self, obs_dim, num_actions, hidden, seed):
+        self.weights = _init_params(obs_dim, num_actions, hidden, seed)
+        self.hidden = hidden
+        self.num_actions = num_actions
+        self.reset_state()
+
+    def reset_state(self):
+        self.h = np.zeros(self.hidden, np.float32)
+        self.c = np.zeros(self.hidden, np.float32)
+
+    def state(self):
+        return self.h.copy(), self.c.copy()
+
+    def set_weights(self, w):
+        self.weights = w
+
+    def get_weights(self):
+        return self.weights
+
+    def compute_action(self, obs, rng):
+        self.h, self.c = _lstm_step_np(
+            self.weights, np.asarray(obs, np.float32).reshape(-1),
+            self.h, self.c,
+        )
+        (Wp, bp), = self.weights["pi"]
+        (Wv, bv), = self.weights["vf"]
+        logits = self.h @ Wp + bp
+        logits = logits - logits.max()
+        probs = np.exp(logits)
+        probs /= probs.sum()
+        a = int(rng.choice(self.num_actions, p=probs))
+        return a, float(np.log(probs[a] + 1e-12)), \
+            float((self.h @ Wv + bv)[0])
+
+
+class _RecurrentEnvRunner:
+    """On-policy sequence collection: flat fragment stepping (GAE over
+    the flat arrays), then chopped into stored-state sequences."""
+
+    def __init__(self, env_creator, policy_factory, seed=0,
+                 rollout_fragment_length=128, gamma=0.99, lam=0.95,
+                 seq_len=8, **_):
+        self.env = env_creator()
+        self.policy = policy_factory()
+        self.rng = np.random.RandomState(seed)
+        self.fragment = rollout_fragment_length
+        self.gamma, self.lam = gamma, lam
+        self.L = seq_len
+        self._obs, _ = self.env.reset(seed=seed)
+        self.policy.reset_state()
+        self._episode_reward = 0.0
+        self._episode_rewards: List[float] = []
+
+    def set_weights(self, w):
+        self.policy.set_weights(w)
+
+    def sample(self) -> SampleBatch:
+        L = self.L
+        obs_l, act_l, rew_l, done_l, logp_l, val_l = \
+            [], [], [], [], [], []
+        # (start_index, h0, c0) per sequence.
+        seq_marks = [(0, *self.policy.state())]
+        for t in range(self.fragment):
+            obs = np.asarray(self._obs, np.float32).reshape(-1)
+            a, logp, v = self.policy.compute_action(obs, self.rng)
+            nxt, r, term, trunc, _ = self.env.step(a)
+            done = bool(term or trunc)
+            obs_l.append(obs)
+            act_l.append(a)
+            rew_l.append(float(r))
+            done_l.append(bool(term))
+            logp_l.append(logp)
+            val_l.append(v)
+            self._episode_reward += float(r)
+            boundary = False
+            if done:
+                self._episode_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs, _ = self.env.reset()
+                self.policy.reset_state()
+                boundary = True
+            else:
+                self._obs = nxt
+            steps_in_seq = t + 1 - seq_marks[-1][0]
+            if (boundary or steps_in_seq == L) and \
+                    t + 1 < self.fragment:
+                seq_marks.append((t + 1, *self.policy.state()))
+        # Bootstrap value for the fragment tail.
+        last_value = 0.0
+        if not done_l[-1]:
+            h, c = self.policy.state()
+            h2, _ = _lstm_step_np(
+                self.policy.weights,
+                np.asarray(self._obs, np.float32).reshape(-1), h, c,
+            )
+            (Wv, bv), = self.policy.weights["vf"]
+            last_value = float((h2 @ Wv + bv)[0])
+        gae = compute_gae(
+            np.asarray(rew_l, np.float32),
+            np.asarray(val_l, np.float32),
+            np.asarray(done_l), last_value,
+            gamma=self.gamma, lam=self.lam,
+        )
+        # Chop the flat columns into padded stored-state sequences.
+        obs_dim = obs_l[0].shape[0]
+        starts = [m[0] for m in seq_marks] + [self.fragment]
+        seqs = []
+        for i, (start, h0, c0) in enumerate(seq_marks):
+            end = min(starts[i + 1], start + L)
+            n = end - start
+            if n <= 0:
+                continue
+            s = {
+                "obs": np.zeros((L, obs_dim), np.float32),
+                "actions": np.zeros(L, np.int32),
+                "old_logp": np.zeros(L, np.float32),
+                "adv": np.zeros(L, np.float32),
+                "returns": np.zeros(L, np.float32),
+                "mask": np.zeros(L, np.float32),
+                "h0": h0, "c0": c0,
+            }
+            s["obs"][:n] = np.stack(obs_l[start:end])
+            s["actions"][:n] = act_l[start:end]
+            s["old_logp"][:n] = logp_l[start:end]
+            s["adv"][:n] = gae["advantages"][start:end]
+            s["returns"][:n] = gae["returns"][start:end]
+            s["mask"][:n] = 1.0
+            seqs.append(s)
+        return SampleBatch({
+            k: np.stack([s[k] for s in seqs]) for k in seqs[0]
+        })
+
+    def episode_stats(self) -> Dict[str, float]:
+        recent = self._episode_rewards[-20:]
+        return {
+            "episodes_total": len(self._episode_rewards),
+            "episode_reward_mean": float(np.mean(recent))
+            if recent else 0.0,
+        }
+
+
+class RecurrentPPOLearner:
+    """Masked clipped-surrogate loss over scan-unrolled sequences."""
+
+    def __init__(self, obs_dim, num_actions, cfg):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._tx = optax.adam(cfg.lr)
+        self._params = jax.tree.map(
+            jnp.asarray,
+            _init_params(obs_dim, num_actions, cfg.lstm_size,
+                         cfg.seed),
+        )
+        self._opt_state = self._tx.init(self._params)
+        H = cfg.lstm_size
+        clip = cfg.clip_param
+        vf_c, ent_c = cfg.vf_loss_coeff, cfg.entropy_coeff
+
+        def unroll(w, obs, h0, c0):
+            def cell(carry, x):
+                h, c = carry
+                z = x @ w["wx"] + h @ w["wh"] + w["b"]
+                i = jax.nn.sigmoid(z[..., :H])
+                f = jax.nn.sigmoid(z[..., H:2 * H])
+                g = jnp.tanh(z[..., 2 * H:3 * H])
+                o = jax.nn.sigmoid(z[..., 3 * H:])
+                c2 = f * c + i * g
+                h2 = o * jnp.tanh(c2)
+                return (h2, c2), h2
+
+            _, hs = jax.lax.scan(cell, (h0, c0),
+                                 jnp.swapaxes(obs, 0, 1))
+            return jnp.swapaxes(hs, 0, 1)     # [B, T, H]
+
+        def loss_fn(p, batch):
+            hs = unroll(p, batch["obs"], batch["h0"], batch["c0"])
+            (Wp, bp), = p["pi"]
+            (Wv, bv), = p["vf"]
+            logits = hs @ Wp + bp
+            values = (hs @ Wv + bv)[..., 0]
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], -1
+            )[..., 0]
+            mask = batch["mask"]
+            msum = jnp.maximum(mask.sum(), 1.0)
+            adv = batch["adv"]
+            amean = (adv * mask).sum() / msum
+            astd = jnp.sqrt(
+                (((adv - amean) * mask) ** 2).sum() / msum
+            ) + 1e-8
+            adv_n = (adv - amean) / astd
+            ratio = jnp.exp(logp - batch["old_logp"])
+            surr = jnp.minimum(
+                ratio * adv_n,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv_n,
+            )
+            pi_loss = -(surr * mask).sum() / msum
+            vf_loss = (((values - batch["returns"]) ** 2) * mask
+                       ).sum() / msum
+            ent = (-(jnp.exp(logp_all) * logp_all).sum(-1) * mask
+                   ).sum() / msum
+            return pi_loss + vf_c * vf_loss - ent_c * ent
+
+        def update(p, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+            updates, opt_state = self._tx.update(grads, opt_state)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        self._update = jax.jit(update)
+
+    def learn_on_batch(self, mb) -> float:
+        import jax.numpy as jnp
+
+        batch = {k: jnp.asarray(v) for k, v in mb.items()}
+        batch["actions"] = jnp.asarray(mb["actions"], jnp.int32)
+        self._params, self._opt_state, loss = self._update(
+            self._params, self._opt_state, batch
+        )
+        return float(loss)
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params)
+
+
+class RecurrentPPO(Algorithm):
+    def _make_policy_factory(self, obs_dim: int, num_actions: int):
+        self._require_discrete()
+        c = self.config
+
+        def policy_factory(obs_dim=obs_dim, n=num_actions,
+                           hidden=c.lstm_size, seed=c.seed):
+            return _LSTMAcPolicy(obs_dim, n, hidden, seed)
+
+        return policy_factory
+
+    def _runner_class(self):
+        return _RecurrentEnvRunner
+
+    def __init__(self, config):
+        import ray_tpu
+
+        # Custom runner construction (needs seq_len), so build the
+        # gang here instead of the base constructor's loop.
+        self.config = config
+        self.iteration = 0
+        c = config
+        creator = c.env_creator()
+        probe = creator()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        if not hasattr(probe.action_space, "n"):
+            raise ValueError(
+                "RecurrentPPO supports discrete action spaces"
+            )
+        num_actions = int(probe.action_space.n)
+        if hasattr(probe, "close"):
+            probe.close()
+        self._obs_dim, self._num_actions = obs_dim, num_actions
+        self._continuous = False
+
+        policy_factory = self._make_policy_factory(obs_dim,
+                                                   num_actions)
+        runner_cls = ray_tpu.remote(_RecurrentEnvRunner)
+        self.runners = [
+            runner_cls.remote(
+                creator, policy_factory, seed=c.seed + i,
+                rollout_fragment_length=c.rollout_fragment_length,
+                gamma=c.gamma, lam=c.lambda_, seq_len=c.seq_len,
+            )
+            for i in range(c.num_env_runners)
+        ]
+        self.learner = RecurrentPPOLearner(obs_dim, num_actions, c)
+        self._rng = np.random.RandomState(c.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        batches = ray_tpu.get([r.sample.remote() for r in self.runners])
+        batch = SampleBatch.concat(batches)
+        loss = float("nan")
+        for _ in range(c.num_epochs):
+            sh = batch.shuffle(self._rng)
+            for mb in sh.minibatches(
+                max(1, min(c.minibatch_size // c.seq_len, sh.count))
+            ):
+                loss = self.learner.learn_on_batch(dict(mb))
+        w = self.learner.get_weights()
+        ray_tpu.get([r.set_weights.remote(w) for r in self.runners])
+
+        ep_stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self.runners]
+        )
+        means = [s["episode_reward_mean"] for s in ep_stats
+                 if s["episodes_total"] > 0]
+        return {
+            "episode_reward_mean": float(np.mean(means)) if means else 0.0,
+            "episodes_total": sum(s["episodes_total"] for s in ep_stats),
+            "num_env_steps_sampled":
+                self.iteration * c.num_env_runners
+                * c.rollout_fragment_length,
+            "loss": loss,
+        }

@@ -37,6 +37,7 @@ def _registry() -> Dict[str, Type]:
         PPOConfig,
         QMIXConfig,
         R2D2Config,
+        RecurrentPPOConfig,
         SACConfig,
         SlateQConfig,
         TD3Config,
@@ -70,6 +71,8 @@ def _registry() -> Dict[str, Type]:
         "ppo": PPOConfig,
         "qmix": QMIXConfig,
         "r2d2": R2D2Config,
+        "recurrent_ppo": RecurrentPPOConfig,
+        "ppo_lstm": RecurrentPPOConfig,
         "sac": SACConfig,
         "slateq": SlateQConfig,
         "td3": TD3Config,

@@ -287,3 +287,88 @@ def test_a3c_learns_sign_task(ray_tpu_start):
         assert result["num_grads_applied"] > 0
     finally:
         algo.stop()
+
+
+def _memory_env():
+    """POMDP: cue visible only at t=0; every later step rewards the
+    action matching the remembered cue."""
+    import numpy as _np
+
+    class _Box:
+        def __init__(self, shape):
+            self.shape = shape
+
+    class _Disc:
+        n = 2
+        shape = ()
+
+    class Memory:
+        def __init__(self):
+            self.observation_space = _Box((1,))
+            self.action_space = _Disc()
+            self._rng = _np.random.RandomState(0)
+            self._t = 0
+
+        def reset(self, seed=None):
+            if seed is not None:
+                self._rng = _np.random.RandomState(seed)
+            self._t = 0
+            self._cue = float(self._rng.choice([-1.0, 1.0]))
+            return _np.asarray([self._cue], "float32"), {}
+
+        def step(self, action):
+            want = 1 if self._cue > 0 else 0
+            r = 1.0 if int(action) == want else -1.0
+            self._t += 1
+            done = self._t >= 8
+            return _np.asarray([0.0], "float32"), r, False, done, {}
+
+    return Memory()
+
+
+def test_recurrent_ppo_learns_memory_task(ray_tpu_start):
+    """PPO with an LSTM policy (the reference's use_lstm option)
+    solves a memory task feedforward PPO cannot."""
+    from ray_tpu.rllib import RecurrentPPOConfig
+
+    config = (
+        RecurrentPPOConfig()
+        .environment(_memory_env)
+        .env_runners(num_env_runners=2, rollout_fragment_length=128)
+        .training(lr=3e-3, minibatch_size=256, num_epochs=4,
+                  seq_len=8)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    try:
+        best = -9.0
+        for _ in range(40):
+            result = algo.train()
+            if result["episodes_total"] > 0:
+                best = max(best, result["episode_reward_mean"])
+        # Sampling keeps entropy, so judge the learned capability
+        # GREEDILY: at the final step (pure memory, obs is 0) the
+        # argmax action must match the step-0 cue.
+        assert best > 2.5, best  # memoryless caps near ~1
+        from ray_tpu.rllib.r2d2 import _lstm_step_np
+
+        w = algo.learner.get_weights()
+        (Wp, bp), = w["pi"]
+        env = _memory_env()
+        last_correct = 0
+        trials = 60
+        for ep in range(trials):
+            obs, _ = env.reset(seed=2000 + ep)
+            want = 1 if float(obs[0]) > 0 else 0
+            h = np.zeros(len(w["wh"]), np.float32)
+            c = np.zeros(len(w["wh"]), np.float32)
+            for s_i in range(8):
+                h, c = _lstm_step_np(
+                    w, np.asarray(obs, np.float32).reshape(-1), h, c
+                )
+                a = int(np.argmax(h @ Wp + bp))
+                obs, r, te, tr, _ = env.step(a)
+            last_correct += int(a == want)
+        assert last_correct / trials > 0.9, last_correct / trials
+    finally:
+        algo.stop()
