@@ -11,7 +11,12 @@ LDLIBS   := -lpthread -lrt
 STORE_SRC := src/store/rts_store.cc
 EXT       := ray_tpu/_native/_rtstore.so
 
-.PHONY: native native-test cpp-client clean
+.PHONY: native native-test cpp-client clean check-metrics
+
+# Lint every Counter/Gauge/Histogram the package declares at import time
+# (Prometheus-valid names, counters end in _total, no kind conflicts).
+check-metrics:
+	$(PY) tools/check_metric_names.py
 
 native: $(EXT)
 

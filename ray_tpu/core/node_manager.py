@@ -147,6 +147,9 @@ class TaskRecord:
     # Cached scheduling-class key (shape + strategy + worker type); records
     # of one class are interchangeable for capacity decisions.
     sched_class: Optional[Tuple] = None
+    # monotonic time this record was handed to a worker (feeds the
+    # per-task-duration histogram in /metrics).
+    dispatched: Optional[float] = None
 
 
 @dataclass
@@ -385,6 +388,18 @@ class NodeManager:
             "tasks_retried": 0,
             "workers_started": 0,
             "actors_created": 0,
+        }
+        # Dispatch-to-completion wall-time histogram for tasks executed on
+        # this node (rendered as ray_tpu_task_duration_seconds by
+        # util/prometheus._core_lines; ref analogue: the task-duration
+        # metrics in src/ray/stats/metric_defs.h).
+        bounds = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                  60.0]
+        self._task_duration = {
+            "count": 0,
+            "sum": 0.0,
+            "bounds": bounds,
+            "buckets": [0] * (len(bounds) + 1),
         }
 
     # ------------------------------------------------------------------ boot
@@ -2043,6 +2058,7 @@ class NodeManager:
         record.resources_held = True
         record.state = "running"
         record.worker_id = worker.worker_id
+        record.dispatched = time.monotonic()
         worker.state = "busy"
         worker.current = record
         self._send_execute_to(worker, spec)
@@ -2108,6 +2124,7 @@ class NodeManager:
         record.resources_held = False
         record.state = "running"
         record.worker_id = worker.worker_id
+        record.dispatched = time.monotonic()
         worker.pending.append(record)
         self._send_execute_to(worker, record.spec)
         return True
@@ -2285,6 +2302,10 @@ class NodeManager:
         else:
             self._stats["tasks_finished"] += 1
             record.state = "finished"
+        if record.dispatched is not None:
+            self._observe_task_duration(
+                time.monotonic() - record.dispatched
+            )
         if record.origin is not None:
             self._notify_origin(record, failed=bool(msg.get("failed")))
         # Creation-task deps stay pinned while the actor may restart (the
@@ -3446,6 +3467,16 @@ class NodeManager:
             if blob is not None:
                 self._functions[function_id] = blob
         return blob
+
+    def _observe_task_duration(self, seconds: float) -> None:
+        h = self._task_duration
+        h["count"] += 1
+        h["sum"] += seconds
+        for i, b in enumerate(h["bounds"]):
+            if seconds <= b:
+                h["buckets"][i] += 1
+                return
+        h["buckets"][-1] += 1
 
     async def stats(self) -> Dict[str, Any]:
         return {

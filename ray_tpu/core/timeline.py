@@ -40,6 +40,42 @@ def new_span_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def new_trace_id() -> str:
+    import uuid
+
+    return uuid.uuid4().hex  # 32 hex chars, W3C trace-id width
+
+
+def parse_traceparent(header: Optional[str]):
+    """Parse a W3C ``traceparent`` header into (trace_id, span_id), or
+    None if absent/malformed. Lets an upstream service (load balancer,
+    API gateway, another instrumented app) own the trace root so the
+    serve spans join ITS trace instead of starting an orphan one."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    _, trace_id, span_id = parts[0], parts[1].lower(), parts[2].lower()
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """Render our (trace_id, span_id) context as a W3C traceparent value
+    (ids are zero-padded/truncated to wire width)."""
+    tid = (trace_id + "0" * 32)[:32]
+    sid = (span_id + "0" * 16)[:16]
+    return f"00-{tid}-{sid}-01"
+
+
 def current_span():
     """(trace_id, span_id) of the active span in this thread, or None."""
     return getattr(_ctx, "span", None)

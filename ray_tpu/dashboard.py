@@ -177,16 +177,46 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(body)
                 return
             if path == "/api/metrics":
-                report = metrics.get_metrics_report()
+                self._json(_report_json(metrics.get_metrics_report()))
+                return
+            if path == "/api/serve_metrics":
+                # Serve data-path telemetry: the ray_tpu_serve_* series
+                # (latency histograms, ongoing/queue gauges, status
+                # counters) plus deployment state in one payload (ref:
+                # dashboard/modules/serve REST surface).
+                payload = {
+                    "metrics": _report_json(
+                        metrics.get_metrics_report(),
+                        prefix="ray_tpu_serve",
+                    )
+                }
+                try:
+                    import ray_tpu.serve as serve
+
+                    payload["deployments"] = serve.details()
+                except Exception as e:  # noqa: BLE001
+                    payload["deployments"] = {}
+                    payload["note"] = f"serve not running: {e}"
+                self._json(payload)
+                return
+            if path == "/api/devices":
+                # Device telemetry: this process's live JAX device
+                # snapshot + every worker's published ray_tpu_device_*
+                # series (HBM, compiles, collectives). Never IMPORT jax
+                # here: on a TPU host that would seize the chip from a
+                # colocated worker (libtpu is exclusive per process).
+                import sys as _sys
+
+                from .util import device_metrics
+
+                local = (device_metrics.sample()
+                         if "jax" in _sys.modules else [])
                 self._json({
-                    name: {
-                        "type": m["type"],
-                        "series": {
-                            json.dumps(dict(k)): v
-                            for k, v in m["series"].items()
-                        },
-                    }
-                    for name, m in report.items()
+                    "local": local,
+                    "cluster": _report_json(
+                        metrics.get_metrics_report(),
+                        prefix="ray_tpu_device",
+                    ),
                 })
                 return
             fn = routes.get(path)
@@ -196,6 +226,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(fn())
         except Exception as e:  # noqa: BLE001
             self._json({"error": repr(e)}, 500)
+
+
+def _report_json(report: dict, prefix: str = "") -> dict:
+    """Metrics report with JSON-safe series keys, optionally filtered to
+    names starting with ``prefix``."""
+    return {
+        name: {
+            "type": m["type"],
+            "help": m.get("help", ""),
+            "series": {
+                json.dumps(dict(k)): v for k, v in m["series"].items()
+            },
+        }
+        for name, m in report.items()
+        if not prefix or name.startswith(prefix)
+    }
 
 
 def _sample_stacks(seconds: float, hz: int) -> dict:

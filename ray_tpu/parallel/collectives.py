@@ -26,9 +26,22 @@ import jax.numpy as jnp
 AxisName = Union[str, Sequence[str]]
 
 
+def _count(op: str, nbytes=None):
+    """Telemetry tap (ray_tpu_device_collective_*): in-graph ops fire
+    once per TRACE (python runs only while jit traces), host-level ops
+    once per call with payload bytes."""
+    try:
+        from ..util import device_metrics
+
+        device_metrics.record_collective(op, nbytes)
+    except Exception:
+        pass
+
+
 # ---------------------------------------------------------------- in-graph
 
 def allreduce(x, axis: AxisName = "dp", op: str = "sum"):
+    _count("allreduce")
     if op == "sum":
         return jax.lax.psum(x, axis)
     if op == "mean":
@@ -41,21 +54,25 @@ def allreduce(x, axis: AxisName = "dp", op: str = "sum"):
 
 
 def allgather(x, axis: AxisName = "dp", *, tiled: bool = True, gather_dim: int = 0):
+    _count("allgather")
     return jax.lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
 
 
 def reducescatter(x, axis: AxisName = "dp", *, scatter_dim: int = 0):
+    _count("reducescatter")
     return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
 
 
 def broadcast(x, axis: AxisName = "dp", root: int = 0):
     """Every rank takes root's value (in-graph select over axis index)."""
+    _count("broadcast")
     idx = jax.lax.axis_index(axis)
     masked = jnp.where(idx == root, x, jnp.zeros_like(x))
     return jax.lax.psum(masked, axis)
 
 
 def ppermute(x, axis: AxisName, perm):
+    _count("ppermute")
     return jax.lax.ppermute(x, axis, perm)
 
 
@@ -69,6 +86,7 @@ def shift(x, axis: AxisName, offset: int = 1):
 
 def all_to_all(x, axis: AxisName, *, split_axis: int, concat_axis: int,
                tiled: bool = True):
+    _count("all_to_all")
     return jax.lax.all_to_all(
         x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
     )
@@ -104,6 +122,7 @@ class CollectiveGroup:
         return current_runtime()
 
     def barrier(self, timeout_s: float = 120.0):
+        _count("host_barrier")
         rt = self._kv()
         self._epoch += 1
         key = f"__collective__/{self.group_name}/barrier/{self._epoch}/{self.rank}"
@@ -130,7 +149,9 @@ class CollectiveGroup:
         rt = self._kv()
         key = f"__collective__/{self.group_name}/bcast/{self._epoch}"
         if self.rank == root:
-            rt.kv_put(key, cloudpickle.dumps(obj))
+            blob = cloudpickle.dumps(obj)
+            _count("host_broadcast", len(blob))
+            rt.kv_put(key, blob)
             return obj
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:

@@ -458,6 +458,21 @@ def cmd_memory(args) -> int:
         ray_tpu.shutdown()
 
 
+def cmd_metrics(args) -> int:
+    """Dump the Prometheus exposition document (ref: scraping the
+    dashboard's /metrics endpoint, without needing it up): core node
+    counters/histograms of the attached node plus cluster-wide user,
+    serve, and device series aggregated from the KV pipeline."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.util import prometheus
+
+        sys.stdout.write(prometheus.render())
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
 # --------------------------------------------------------------- serve
 
 def cmd_serve_deploy(args) -> int:
@@ -578,6 +593,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("job_id")
     _add_address(p)
     p.set_defaults(fn=cmd_stop_job)
+
+    p = sub.add_parser("metrics",
+                       help="dump the Prometheus exposition text")
+    _add_address(p)
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("memory", help="per-object reference table")
     p.add_argument("--limit", type=int, default=50)

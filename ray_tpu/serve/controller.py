@@ -39,6 +39,7 @@ class _DeploymentState:
     """Target + actual state for one deployment."""
 
     def __init__(self):
+        self.name: str = ""  # deployment name (metric/trace tag)
         self.blob: bytes = b""
         self.init_args = ()
         self.init_kwargs: Dict[str, Any] = {}
@@ -86,7 +87,8 @@ class ServeControllerActor:
         actor_cls = ray_tpu.remote(**opts)(Replica) if opts else \
             ray_tpu.remote(Replica)
         new = [
-            actor_cls.remote(st.blob, st.init_args, st.init_kwargs, version)
+            actor_cls.remote(st.blob, st.init_args, st.init_kwargs,
+                             version, st.name)
             for _ in range(n)
         ]
         # Block until every replica's constructor finished (readiness gate;
@@ -125,6 +127,7 @@ class ServeControllerActor:
             if fresh:
                 st = _DeploymentState()
                 self._deployments[name] = st
+            st.name = name
             old_version = st.version
             st.blob = blob
             st.is_asgi = is_asgi

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, Optional
 
 from .handle import DeploymentHandle
@@ -78,36 +79,98 @@ class _GenericHandler:
         dep_name, method = parts
         streaming = method.lower().endswith("stream")
 
-        def _handle_or_abort(context):
+        def _handle_or_abort(context, status):
             try:
                 handle = _resolve(dep_name)
             except _ControllerDown as e:
+                status[0] = "UNAVAILABLE"
                 context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             if handle is None:
+                status[0] = "NOT_FOUND"
                 context.abort(grpc.StatusCode.NOT_FOUND,
                               f"no deployment {dep_name!r}")
             return handle
 
-        def unary_unary(request: bytes, context):
-            handle = _handle_or_abort(context)
+        def _begin_observation(context):
+            """Root span for the RPC (honoring an incoming traceparent
+            from the gRPC metadata) + e2e latency/status accounting —
+            the gRPC mirror of the HTTP proxy's do_POST wrapper."""
+            from ..core.timeline import (
+                enter_span,
+                exit_span,
+                get_buffer,
+                new_span_id,
+                new_trace_id,
+                parse_traceparent,
+            )
+
+            md = {}
             try:
-                h = handle if method == "__call__" else handle.options(
-                    method=method
+                md = {k: v for k, v in
+                      (context.invocation_metadata() or ())}
+            except Exception:
+                pass
+            parent = parse_traceparent(md.get("traceparent"))
+            trace_id = parent[0] if parent else new_trace_id()
+            span_id = new_span_id()
+            prev = enter_span(trace_id, span_id)
+            started = time.time()
+
+            def finish(status_code: str):
+                from . import _telemetry
+
+                exit_span(prev)
+                ended = time.time()
+                # Unknown services share one label — bounded cardinality
+                # against attacker-chosen method paths.
+                dep_label = (dep_name if status_code != "NOT_FOUND"
+                             else "__unknown__")
+                _telemetry.observe_ingress(
+                    dep_label, "grpc", status_code, started, ended
                 )
-                result = h.remote(request).result(timeout=120)
-            except Exception as e:  # noqa: BLE001
-                context.abort(grpc.StatusCode.INTERNAL, str(e))
-                return b""
-            return _encode(result)
+                try:
+                    get_buffer().record(
+                        f"grpc:{dep_name}", started, ended, "",
+                        trace_id=trace_id, span_id=span_id,
+                        parent_id=parent[1] if parent else "",
+                    )
+                except Exception:
+                    pass
+
+            return finish
+
+        def unary_unary(request: bytes, context):
+            status = ["OK"]
+            finish = _begin_observation(context)
+            try:
+                handle = _handle_or_abort(context, status)
+                try:
+                    h = handle if method == "__call__" else handle.options(
+                        method=method
+                    )
+                    result = h.remote(request).result(timeout=120)
+                except Exception as e:  # noqa: BLE001
+                    status[0] = "INTERNAL"
+                    context.abort(grpc.StatusCode.INTERNAL, str(e))
+                    return b""
+                return _encode(result)
+            finally:
+                finish(status[0])
 
         def unary_stream(request: bytes, context):
-            handle = _handle_or_abort(context)
+            status = ["OK"]
+            finish = _begin_observation(context)
             try:
-                it = handle.options(method=method).stream(request)
-                for item in it:
-                    yield _encode(item)
-            except Exception as e:  # noqa: BLE001
-                context.abort(grpc.StatusCode.INTERNAL, str(e))
+                handle = _handle_or_abort(context, status)
+                try:
+                    it = handle.options(method=method).stream(request)
+                    for item in it:
+                        yield _encode(item)
+                except Exception as e:  # noqa: BLE001
+                    status[0] = "INTERNAL"
+                    context.abort(grpc.StatusCode.INTERNAL, str(e))
+            finally:
+                finish(status[0])
 
         if streaming:
             return grpc.unary_stream_rpc_method_handler(

@@ -203,11 +203,18 @@ def _refresh_loop(state_ref: "weakref.ref[_RouterState]") -> None:
             return
         try:
             with state.lock:
-                total = sum(state.outstanding.values())
+                outstanding = dict(state.outstanding)
                 known = state.route_version
+            total = sum(outstanding.values())
             controller = state.controller
             name = state.deployment_name
             handle_id = state.handle_id
+            # Gauges publish from HERE (~2Hz), not the per-request
+            # begin/end hot path: in-flight/queue-depth need freshness,
+            # not per-event registry traffic under the router lock.
+            from . import _telemetry
+
+            _telemetry.update_router_gauges(name, handle_id, outstanding)
             controller.record_handle_metrics.remote(name, handle_id, total)
             ref = controller.listen_for_route_change.remote(name, known, 0.5)
             del state  # don't pin the state across the blocking poll
@@ -280,7 +287,8 @@ def _route_with_retry(state: _RouterState, submit, deliver, deliver_error,
 
 class _PendingBatch:
     def __init__(self):
-        self.items: List[Tuple[Any, "ServeFuture"]] = []
+        # [(payload, future, caller trace span | None), ...]
+        self.items: List[Tuple[Any, "ServeFuture", Any]] = []
         self.created = time.monotonic()
 
 
@@ -356,25 +364,39 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> ServeFuture:
         if self._batch:
             return self._remote_batched(args, kwargs)
+        from ..core.timeline import current_span
+
         fut = ServeFuture()
+        # The submit happens on a router thread: capture the CALLER's
+        # span here so the replica task parents to the proxy/driver span
+        # instead of rooting an orphan trace (ref: tracing context
+        # stamped onto the task spec at submit).
         threading.Thread(
             target=self._run_with_retry,
-            args=(fut, self._method, args, kwargs),
+            args=(fut, self._method, args, kwargs, current_span()),
             daemon=True,
         ).start()
         return fut
 
-    def _run_with_retry(self, fut: ServeFuture, method, args, kwargs):
+    def _run_with_retry(self, fut: ServeFuture, method, args, kwargs,
+                        span=None):
+        from ..core.timeline import enter_span, exit_span
+
         model_id = self._model_id
-        _route_with_retry(
-            self._state,
-            lambda replica: replica.handle_request.remote(
-                method, args, kwargs, model_id
-            ),
-            fut._set_value,
-            fut._set_error,
-            model_id=model_id or None,
-        )
+        prev = enter_span(*span) if span else None
+        try:
+            _route_with_retry(
+                self._state,
+                lambda replica: replica.handle_request.remote(
+                    method, args, kwargs, model_id, time.time()
+                ),
+                fut._set_value,
+                fut._set_error,
+                model_id=model_id or None,
+            )
+        finally:
+            if span:
+                exit_span(prev)
 
     def stream(self, *args, **kwargs):
         """Streaming request: yields response items as the replica
@@ -403,7 +425,8 @@ class DeploymentHandle:
             try:
                 gen = replica.handle_request_streaming.options(
                     num_returns="streaming"
-                ).remote(self._method, args, kwargs, model_id)
+                ).remote(self._method, args, kwargs, model_id,
+                         time.time())
                 # Per-item production deadline: a wedged replica
                 # generator surfaces a timeout instead of pinning the
                 # consumer (e.g. a proxy SSE thread) forever.
@@ -433,13 +456,17 @@ class DeploymentHandle:
     # ---- dynamic batching --------------------------------------------------
 
     def _remote_batched(self, args, kwargs) -> ServeFuture:
+        from ..core.timeline import current_span
+
         fut = ServeFuture()
         flush: Optional[_PendingBatch] = None
         with self._batch_lock:
             if self._pending is None:
                 self._pending = _PendingBatch()
                 self._start_flusher()
-            self._pending.items.append(((args, kwargs), fut))
+            self._pending.items.append(
+                ((args, kwargs), fut, current_span())
+            )
             if len(self._pending.items) >= self._batch["max_batch_size"]:
                 flush = self._pending
                 self._pending = None
@@ -460,30 +487,40 @@ class DeploymentHandle:
         threading.Thread(target=run, daemon=True).start()
 
     def _flush(self, batch: _PendingBatch):
-        payload = [item for item, _ in batch.items]
+        from ..core.timeline import enter_span, exit_span
+
+        payload = [item for item, _fut, _span in batch.items]
         model_id = self._model_id
+        # A flush carries many callers' requests in one replica call;
+        # parent the batch task to the first item's span (the others
+        # still share its trace through the ingress-side spans).
+        span = next((s for _, _, s in batch.items if s), None)
 
         def deliver(results):
-            for (_, fut), value in zip(batch.items, results):
+            for (_, fut, _s), value in zip(batch.items, results):
                 fut._set_value(value)
 
         def deliver_error(err):
-            for _, fut in batch.items:
+            for _, fut, _s in batch.items:
                 fut._set_error(err)
 
-        threading.Thread(
-            target=_route_with_retry,
-            args=(
-                self._state,
-                lambda replica: replica.handle_batch.remote(
-                    self._method, payload, model_id
-                ),
-                deliver,
-                deliver_error,
-            ),
-            kwargs={"model_id": model_id or None},
-            daemon=True,
-        ).start()
+        def run():
+            prev = enter_span(*span) if span else None
+            try:
+                _route_with_retry(
+                    self._state,
+                    lambda replica: replica.handle_batch.remote(
+                        self._method, payload, model_id, time.time()
+                    ),
+                    deliver,
+                    deliver_error,
+                    model_id=model_id or None,
+                )
+            finally:
+                if span:
+                    exit_span(prev)
+
+        threading.Thread(target=run, daemon=True).start()
 
     # ---- introspection -----------------------------------------------------
 

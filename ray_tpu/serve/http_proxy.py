@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
@@ -31,6 +32,12 @@ _thread: Optional[threading.Thread] = None
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # silence request logging
         pass
+
+    def send_response(self, code, message=None):
+        # Remember the status for the request metrics recorded in
+        # do_POST's finally — covers the JSON, ASGI, and SSE paths.
+        self._obs_status = code
+        super().send_response(code, message)
 
     def _reply(self, code: int, payload):
         body = json.dumps(payload).encode()
@@ -137,10 +144,57 @@ class _Handler(BaseHTTPRequestHandler):
         self.do_POST()
 
     def do_POST(self):
+        """Instrumented ingress entry (ref analogue: the proxy's request
+        span + ray_serve_num_http_requests in serve/_private/proxy.py):
+        opens the request's ROOT span — honoring an incoming W3C
+        ``traceparent`` so an upstream gateway owns the trace — installs
+        it as this thread's context (the handle stamps it onto the task
+        spec, the replica parents to it), and records the e2e latency
+        histogram + status-code counter on the way out."""
+        from urllib.parse import urlparse
+
+        from ..core.timeline import (
+            enter_span,
+            exit_span,
+            get_buffer,
+            new_span_id,
+            new_trace_id,
+            parse_traceparent,
+        )
+        from . import _telemetry
+
+        name = urlparse(self.path).path.strip("/").split("/")[0]
+        parent = parse_traceparent(self.headers.get("traceparent"))
+        trace_id = parent[0] if parent else new_trace_id()
+        span_id = new_span_id()
+        prev = enter_span(trace_id, span_id)
+        started = time.time()
+        try:
+            self._route_request(name)
+        finally:
+            exit_span(prev)
+            ended = time.time()
+            code = getattr(self, "_obs_status", 500)
+            # Unknown routes record under ONE fixed label: attacker- or
+            # crawler-chosen paths must not mint unbounded metric series
+            # (the registry never prunes).
+            dep_label = (name or "/") if code != 404 else "__unknown__"
+            _telemetry.observe_ingress(
+                dep_label, "http", code, started, ended,
+            )
+            try:
+                get_buffer().record(
+                    f"http:{name or '/'}", started, ended, "",
+                    trace_id=trace_id, span_id=span_id,
+                    parent_id=parent[1] if parent else "",
+                )
+            except Exception:
+                pass
+
+    def _route_request(self, name: str):
         from urllib.parse import urlparse
 
         parts = urlparse(self.path).path.strip("/").split("/")
-        name = parts[0]
         streaming = (
             (len(parts) > 1 and parts[1] == "stream")
             or "text/event-stream" in (self.headers.get("Accept") or "")

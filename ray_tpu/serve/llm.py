@@ -122,10 +122,15 @@ class LLMEngine:
             nxt = sample_logits(logits, key, temperature=temperature)
             return nxt, cache
 
+        from ..util.device_metrics import instrumented_jit
+
         # Donate the cache: the paged pool updates IN PLACE instead of
         # being copied every step (a pool-sized copy per step would make
-        # paging cost scale with pool size).
-        self._decode = jax.jit(decode_step, donate_argnums=(1,))
+        # paging cost scale with pool size). Jit through the instrumented
+        # compile path: serving recompiles (shape changes, evictions)
+        # surface as ray_tpu_device_jit_* series instead of silent
+        # latency spikes.
+        self._decode = instrumented_jit(decode_step, donate_argnums=(1,))
 
         def prefill(params, cache, tokens, real_len, slot, pages):
             logits, cache = paged_prefill(
@@ -135,7 +140,7 @@ class LLMEngine:
                                 temperature=temperature)
             return cache, nxt[0]
 
-        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+        self._prefill = instrumented_jit(prefill, donate_argnums=(1,))
         self._rng = jax.random.PRNGKey(0)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import inspect
 import threading
+import time
 from typing import Any, Dict, List, Tuple
 
 import cloudpickle
@@ -48,7 +49,7 @@ def _resolve_bound(value):
 
 class Replica:
     def __init__(self, blob: bytes, init_args, init_kwargs,
-                 version: str = ""):
+                 version: str = "", deployment_name: str = ""):
         target = cloudpickle.loads(blob)
         init_args = tuple(_resolve_bound(a) for a in init_args)
         init_kwargs = {k: _resolve_bound(v)
@@ -61,39 +62,76 @@ class Replica:
             self._is_class = False
         self._num_handled = 0
         self._version = version
+        self._deployment = deployment_name
         self._ongoing = 0
         self._lock = threading.Lock()
+        # Identity tag for the ongoing gauge: gauges merge last-writer-
+        # wins across processes, so replicas of one deployment must not
+        # share a tag set (sum over `replica` for the total).
+        import os
+
+        from ..util import device_metrics
+
+        self._replica_id = f"{device_metrics.node_tag()}:{os.getpid()}"
 
     def _resolve(self, method: str):
         if self._is_class and method != "__call__":
             return getattr(self._callable, method)
         return self._callable
 
-    def handle_request(self, method: str, args: Tuple, kwargs: Dict,
-                       model_id: str = "") -> Any:
-        from .multiplex import _set_model_id
+    def _gauge_tags(self):
+        return {"deployment": self._deployment or "anonymous",
+                "replica": self._replica_id}
+
+    def _begin(self, n: int = 1) -> None:
+        from . import _telemetry
 
         with self._lock:
-            self._num_handled += 1
+            self._num_handled += n
             self._ongoing += 1
+            ongoing = self._ongoing
+        _telemetry.REPLICA_ONGOING.set(float(ongoing),
+                                       tags=self._gauge_tags())
+
+    def _end(self, method: str, submit_ts: float, started: float) -> None:
+        from . import _telemetry
+        from ..util import device_metrics
+
+        with self._lock:
+            self._ongoing -= 1
+            ongoing = self._ongoing
+        _telemetry.REPLICA_ONGOING.set(float(ongoing),
+                                       tags=self._gauge_tags())
+        _telemetry.observe_replica_request(
+            self._deployment, method, submit_ts, started, time.time()
+        )
+        # Natural sampling edge for accelerator state (throttled; no-op
+        # in replicas that never imported jax).
+        device_metrics.maybe_sample()
+
+    def handle_request(self, method: str, args: Tuple, kwargs: Dict,
+                       model_id: str = "", submit_ts: float = 0.0) -> Any:
+        from .multiplex import _set_model_id
+
+        self._begin()
+        started = time.time()
         _set_model_id(model_id)
         try:
             return self._resolve(method)(*args, **kwargs)
         finally:
-            with self._lock:
-                self._ongoing -= 1
+            self._end(method, submit_ts, started)
 
     def handle_request_streaming(self, method: str, args: Tuple,
-                                 kwargs: Dict, model_id: str = ""):
+                                 kwargs: Dict, model_id: str = "",
+                                 submit_ts: float = 0.0):
         """Generator entry: invoked with num_returns="streaming" by the
         handle so each yielded item seals as its own object and streams to
         the caller as produced (ref analogue: replica.py
         call_user_generator + the proxy's RESPONSE_STREAMING path)."""
         from .multiplex import _set_model_id
 
-        with self._lock:
-            self._num_handled += 1
-            self._ongoing += 1
+        self._begin()
+        started = time.time()
         _set_model_id(model_id)
         try:
             out = self._resolve(method)(*args, **kwargs)
@@ -102,18 +140,17 @@ class Replica:
             else:
                 yield out
         finally:
-            with self._lock:
-                self._ongoing -= 1
+            self._end(method, submit_ts, started)
 
     def handle_batch(self, method: str, batched_args: List[Tuple],
-                     model_id: str = "") -> List[Any]:
+                     model_id: str = "",
+                     submit_ts: float = 0.0) -> List[Any]:
         """One call per batch: user function receives a list of first
         positional args and must return a list of equal length."""
         from .multiplex import _set_model_id
 
-        with self._lock:
-            self._num_handled += len(batched_args)
-            self._ongoing += 1
+        self._begin(len(batched_args))
+        started = time.time()
         _set_model_id(model_id)
         try:
             fn = self._resolve(method)
@@ -126,8 +163,7 @@ class Replica:
                 )
             return list(out)
         finally:
-            with self._lock:
-                self._ongoing -= 1
+            self._end(method, submit_ts, started)
 
     def stats(self) -> Dict[str, Any]:
         return {

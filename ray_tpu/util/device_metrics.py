@@ -1,0 +1,205 @@
+"""JAX/TPU device telemetry.
+
+Ref analogue: the reference's per-node metrics agents export GPU/GRAM
+gauges from the resource monitor (src/ray/stats/metric_defs.h) — a
+TPU-native runtime needs the same visibility into the accelerator plane:
+HBM in use/peak/limit per device, jit compile count and cumulative
+compile seconds, and collective traffic. Everything publishes through the
+util/metrics.py KV pipeline, so ``util/prometheus.render()`` exposes the
+series with no extra plumbing, tagged ``{node, device}``.
+
+Sampling is passive and cheap: nothing here imports jax — ``sample()``
+is a no-op unless the calling process already imported it (workers that
+never touch the accelerator pay nothing). Callers on natural edges
+(replica request completion, ``/metrics`` render, ``/api/devices``)
+invoke :func:`maybe_sample`, which throttles to one backend query per
+``min_interval_s`` per process.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .metrics import Counter, Gauge
+
+DEVICE_COUNT = Gauge(
+    "ray_tpu_device_count",
+    "Local JAX devices visible to this process.",
+    tag_keys=("node", "platform"),
+)
+MEMORY_IN_USE = Gauge(
+    "ray_tpu_device_memory_bytes_in_use",
+    "Device (HBM) bytes currently allocated, per device.",
+    tag_keys=("node", "device"),
+)
+MEMORY_PEAK = Gauge(
+    "ray_tpu_device_memory_peak_bytes",
+    "Peak device (HBM) bytes allocated, per device.",
+    tag_keys=("node", "device"),
+)
+MEMORY_LIMIT = Gauge(
+    "ray_tpu_device_memory_limit_bytes",
+    "Device (HBM) capacity visible to the allocator, per device.",
+    tag_keys=("node", "device"),
+)
+JIT_COMPILES = Counter(
+    "ray_tpu_device_jit_compiles_total",
+    "XLA compilations observed through instrumented_jit().",
+    tag_keys=("node", "fn"),
+)
+JIT_COMPILE_SECONDS = Counter(
+    "ray_tpu_device_jit_compile_seconds_total",
+    "Wall seconds spent in calls that triggered an XLA compile.",
+    tag_keys=("node", "fn"),
+)
+COLLECTIVE_CALLS = Counter(
+    "ray_tpu_device_collective_calls_total",
+    "Collective ops issued through parallel.collectives (in-graph ops "
+    "count once per trace, host-level ops once per call).",
+    tag_keys=("node", "op"),
+)
+COLLECTIVE_BYTES = Counter(
+    "ray_tpu_device_collective_bytes_total",
+    "Payload bytes moved by host-level collectives (barrier/broadcast "
+    "over the control-plane KV).",
+    tag_keys=("node", "op"),
+)
+
+_lock = threading.Lock()
+_last_sample = 0.0
+
+
+def node_tag() -> str:
+    """Short hex id of this process's node, or "local" off-cluster."""
+    try:
+        from ..core import runtime_context
+
+        rt = runtime_context.current_runtime_or_none()
+        if rt is not None:
+            return rt.node_id.hex()[:8]
+    except Exception:
+        pass
+    return "local"
+
+
+def _memory_stats(device) -> Optional[Dict[str, Any]]:
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    return stats if isinstance(stats, dict) else None
+
+
+def sample(force: bool = False) -> List[Dict[str, Any]]:
+    """Publish per-device gauges for this process and return the device
+    snapshot (also the payload of the dashboard's ``/api/devices``).
+    Unless ``force``, does nothing in processes that never imported jax
+    — sampling must not be the thing that drags the backend in."""
+    if not force and "jax" not in sys.modules:
+        return []
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    node = node_tag()
+    by_platform: Dict[str, int] = {}
+    out: List[Dict[str, Any]] = []
+    for d in devices:
+        platform = getattr(d, "platform", "unknown")
+        by_platform[platform] = by_platform.get(platform, 0) + 1
+        dev_tag = f"{platform}:{getattr(d, 'id', len(out))}"
+        info: Dict[str, Any] = {"device": dev_tag, "platform": platform}
+        stats = _memory_stats(d)
+        if stats:
+            tags = {"node": node, "device": dev_tag}
+            in_use = stats.get("bytes_in_use")
+            peak = stats.get("peak_bytes_in_use")
+            limit = stats.get("bytes_limit") or stats.get(
+                "bytes_reservable_limit"
+            )
+            if in_use is not None:
+                MEMORY_IN_USE.set(float(in_use), tags=tags)
+                info["bytes_in_use"] = int(in_use)
+            if peak is not None:
+                MEMORY_PEAK.set(float(peak), tags=tags)
+                info["peak_bytes_in_use"] = int(peak)
+            if limit is not None:
+                MEMORY_LIMIT.set(float(limit), tags=tags)
+                info["bytes_limit"] = int(limit)
+        out.append(info)
+    for platform, n in by_platform.items():
+        DEVICE_COUNT.set(float(n), tags={"node": node,
+                                         "platform": platform})
+    return out
+
+
+def maybe_sample(min_interval_s: float = 5.0) -> None:
+    """Throttled :func:`sample` for hot paths (request completion,
+    exposition render): at most one backend query per interval."""
+    global _last_sample
+    now = time.monotonic()
+    with _lock:
+        if now - _last_sample < min_interval_s:
+            return
+        _last_sample = now
+    try:
+        sample()
+    except Exception:
+        pass
+
+
+def record_collective(op: str, nbytes: Optional[int] = None) -> None:
+    """Count one collective op (and payload bytes when known). Called by
+    parallel/collectives.py; in-graph ops fire at trace time."""
+    tags = {"node": node_tag(), "op": op}
+    COLLECTIVE_CALLS.inc(1, tags=tags)
+    if nbytes:
+        COLLECTIVE_BYTES.inc(float(nbytes), tags=tags)
+
+
+def instrumented_jit(fn, **jit_kwargs):
+    """``jax.jit`` with compile telemetry: calls that grow the jitted
+    function's executable cache (a trace+compile happened) bump the
+    compile counter and attribute the call's wall time to cumulative
+    compile seconds. This is the runtime-controlled compile path — the
+    serving stack jits through here so recompiles (new batch shape, new
+    model) are visible in ``/metrics`` instead of silent latency spikes.
+    """
+    import functools
+
+    import jax
+
+    jitted = jax.jit(fn, **jit_kwargs)
+    name = getattr(fn, "__name__", "jit")
+    cache_size = getattr(jitted, "_cache_size", None)
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        before = None
+        if cache_size is not None:
+            try:
+                before = cache_size()
+            except Exception:
+                before = None
+        t0 = time.perf_counter()
+        out = jitted(*args, **kwargs)
+        if before is not None:
+            try:
+                grew = cache_size() - before
+            except Exception:
+                grew = 0
+            if grew > 0:
+                tags = {"node": node_tag(), "fn": name}
+                JIT_COMPILES.inc(grew, tags=tags)
+                JIT_COMPILE_SECONDS.inc(
+                    time.perf_counter() - t0, tags=tags
+                )
+        return out
+
+    wrapped.__wrapped_jit__ = jitted  # AOT API (lower/compile) passthrough
+    return wrapped

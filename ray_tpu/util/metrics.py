@@ -14,6 +14,7 @@ import atexit
 import os
 import threading
 import time
+import warnings
 from typing import Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -27,6 +28,12 @@ class _Registry:
         self.lock = threading.Lock()
         # name -> ("counter"|"gauge"|"histogram", {tags_key: value})
         self.metrics: Dict[str, Tuple[str, Dict]] = {}
+        # name -> (kind, description), recorded at metric construction —
+        # feeds `# HELP` lines and tools/check_metric_names.py.
+        self.meta: Dict[str, Tuple[str, str]] = {}
+        # Names re-declared or re-recorded under a conflicting kind.
+        self.kind_conflicts: Dict[str, Tuple[str, str]] = {}
+        self._warned_kinds: set = set()
         self._flusher: Optional[threading.Thread] = None
         self._dirty = False
 
@@ -38,9 +45,39 @@ class _Registry:
             self._flusher.start()
             atexit.register(self.flush)
 
+    def declare(self, name: str, kind: str, description: str):
+        with self.lock:
+            old = self.meta.get(name)
+            if old is not None and old[0] != kind:
+                self.kind_conflicts[name] = (old[0], kind)
+                self._warn_kind_conflict(name, old[0], kind)
+                return
+            if old is None or (description and not old[1]):
+                self.meta[name] = (kind, description)
+
+    def _warn_kind_conflict(self, name: str, old: str, new: str):
+        # Caller holds self.lock.
+        if name in self._warned_kinds:
+            return
+        self._warned_kinds.add(name)
+        warnings.warn(
+            f"metric {name!r} already registered as a {old}; ignoring "
+            f"records under conflicting kind {new!r} (the series would "
+            f"be corrupted)",
+            UserWarning,
+            stacklevel=3,
+        )
+
     def record(self, name: str, kind: str, tags_key: tuple, update):
         with self.lock:
             kind_, series = self.metrics.setdefault(name, (kind, {}))
+            if kind_ != kind:
+                # A second metric object reused the name with a different
+                # kind: recording its update would write, say, a float
+                # into a histogram series dict. Warn once and drop.
+                self.kind_conflicts[name] = (kind_, kind)
+                self._warn_kind_conflict(name, kind_, kind)
+                return
             series[tags_key] = update(series.get(tags_key))
             self._dirty = True
         self.ensure_flusher()
@@ -64,7 +101,8 @@ class _Registry:
                 return
             self._dirty = False
             snapshot = {
-                name: (kind, dict(series))
+                name: (kind, dict(series),
+                       self.meta.get(name, ("", ""))[1])
                 for name, (kind, series) in self.metrics.items()
             }
         rt.kv_put(
@@ -85,6 +123,7 @@ class _Metric:
         self._description = description
         self._tag_keys = tuple(tag_keys)
         self._default_tags: Dict[str, str] = {}
+        _registry.declare(name, self.KIND, description)
 
     def set_default_tags(self, tags: Dict[str, str]):
         self._default_tags = dict(tags)
@@ -147,6 +186,56 @@ class Histogram(_Metric):
         _registry.record(self._name, self.KIND, self._key(tags), update)
 
 
+def declared_metrics() -> Dict[str, Tuple[str, str]]:
+    """Every metric declared in this process: name -> (kind, description).
+    Data source for tools/check_metric_names.py."""
+    with _registry.lock:
+        return dict(_registry.meta)
+
+
+def declaration_conflicts() -> Dict[str, Tuple[str, str]]:
+    """Names registered under two different kinds: name -> (old, new)."""
+    with _registry.lock:
+        return dict(_registry.kind_conflicts)
+
+
+def _merge_histogram(cur: Dict, value: Dict) -> Dict:
+    """Merge two histogram series points. Identical boundaries sum
+    bucket-wise; DIFFERENT boundaries merge on the union of bounds —
+    each source bucket (b_{i-1}, b_i] lands in the union bucket whose
+    upper edge is exactly b_i, so cumulative counts stay exact at every
+    original boundary. (The old zip() truncated the longer bucket list
+    silently, dropping observations.)"""
+    if cur.get("bounds", []) == value.get("bounds", []):
+        return {
+            "count": cur["count"] + value["count"],
+            "sum": cur["sum"] + value["sum"],
+            "bounds": list(cur.get("bounds", [])),
+            "buckets": [
+                a + b for a, b in zip(cur["buckets"], value["buckets"])
+            ],
+        }
+    bounds = sorted(set(cur.get("bounds", [])) | set(value.get("bounds", [])))
+    index = {b: i for i, b in enumerate(bounds)}
+
+    def rebucket(src: Dict) -> List[float]:
+        out = [0] * (len(bounds) + 1)
+        src_bounds = src.get("bounds", [])
+        for i, c in enumerate(src["buckets"]):
+            if i < len(src_bounds):
+                out[index[src_bounds[i]]] += c
+            else:
+                out[-1] += c  # overflow bucket maps to union overflow
+        return out
+
+    return {
+        "count": cur["count"] + value["count"],
+        "sum": cur["sum"] + value["sum"],
+        "bounds": bounds,
+        "buckets": [a + b for a, b in zip(rebucket(cur), rebucket(value))],
+    }
+
+
 def get_metrics_report() -> Dict[str, Dict]:
     """Aggregate every process's flushed metrics (ref analogue: scraping
     the metrics agents). Counters/histograms sum across processes; gauges
@@ -161,22 +250,22 @@ def get_metrics_report() -> Dict[str, Dict]:
         if blob is None:
             continue
         snapshot = cloudpickle.loads(blob)
-        for name, (kind, series) in snapshot.items():
-            entry = out.setdefault(name, {"type": kind, "series": {}})
+        for name, item in snapshot.items():
+            kind, series = item[0], item[1]
+            help_ = item[2] if len(item) > 2 else ""
+            entry = out.setdefault(
+                name, {"type": kind, "series": {}, "help": ""}
+            )
+            if help_ and not entry.get("help"):
+                entry["help"] = help_
             for tags_key, value in series.items():
                 cur = entry["series"].get(tags_key)
                 if kind == "counter":
                     entry["series"][tags_key] = (cur or 0.0) + value
                 elif kind == "gauge":
                     entry["series"][tags_key] = value
-                else:  # histogram
-                    if cur is None:
-                        entry["series"][tags_key] = dict(value)
-                    else:
-                        cur["count"] += value["count"]
-                        cur["sum"] += value["sum"]
-                        cur["buckets"] = [
-                            a + b for a, b in zip(cur["buckets"],
-                                                  value["buckets"])
-                        ]
+                elif cur is None:  # histogram, first sighting
+                    entry["series"][tags_key] = dict(value)
+                else:
+                    entry["series"][tags_key] = _merge_histogram(cur, value)
     return out

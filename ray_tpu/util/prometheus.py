@@ -24,14 +24,44 @@ from typing import Dict, List, Optional
 CORE_PREFIX = "ray_tpu"
 
 
+def _escape_label_value(v) -> str:
+    # Exposition-format label escaping: backslash, double-quote, AND
+    # newline (a raw newline in a label value corrupts the document).
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    # HELP lines escape backslash and newline only (not quotes).
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(tags) -> str:
     if not tags:
         return ""
     inner = ",".join(
-        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
-        for k, v in tags
+        f'{k}="{_escape_label_value(v)}"' for k, v in tags
     )
     return "{" + inner + "}"
+
+
+def _hist_lines(pname: str, tags, value) -> List[str]:
+    """Cumulative `_bucket{le=...}` series plus `_sum`/`_count` for one
+    histogram series point ({count, sum, bounds, buckets})."""
+    lines: List[str] = []
+
+    def lbl(extra=None):
+        items = list(tags) + ([extra] if extra else [])
+        return _fmt_labels(items)
+
+    cum = 0
+    for b, c in zip(value.get("bounds", []), value["buckets"]):
+        cum += c
+        lines.append(f'{pname}_bucket{lbl(("le", b))} {cum}')
+    lines.append(f'{pname}_bucket{lbl(("le", "+Inf"))} {value["count"]}')
+    lines.append(f"{pname}_sum{lbl()} {value['sum']}")
+    lines.append(f"{pname}_count{lbl()} {value['count']}")
+    return lines
 
 
 def _core_lines(nm) -> List[str]:
@@ -76,6 +106,13 @@ def _core_lines(nm) -> List[str]:
         for key, val in transfer.stats.items():
             emit(f"transfer_{key}_total", "counter", val,
                  "Inter-node object transfer chunk counter.")
+    hist = getattr(nm, "_task_duration", None)
+    if hist is not None:
+        full = f"{CORE_PREFIX}_task_duration_seconds"
+        lines.append(f"# HELP {full} Dispatch-to-completion wall time of "
+                     "tasks executed on this node manager.")
+        lines.append(f"# TYPE {full} histogram")
+        lines += _hist_lines(full, [], hist)
     return lines
 
 
@@ -87,26 +124,15 @@ def _user_lines(report: Dict[str, Dict]) -> List[str]:
                  "histogram": "histogram"}[kind]
         pname = name if kind != "counter" or name.endswith("_total") \
             else f"{name}_total"
+        help_ = m.get("help", "")
+        if help_:
+            lines.append(f"# HELP {pname} {_escape_help(help_)}")
         lines.append(f"# TYPE {pname} {ptype}")
         for tags_key, value in m["series"].items():
-            labels = _fmt_labels(tags_key)
             if kind == "histogram":
-                bounds = value.get("bounds", [])
-                cum = 0
-                for b, c in zip(bounds, value["buckets"]):
-                    cum += c
-                    sep = "," if labels else ""
-                    base = labels[:-1] + sep if labels else "{"
-                    lines.append(
-                        f'{pname}_bucket{base}le="{b}"}} {cum}'
-                    )
-                total = value["count"]
-                base = (labels[:-1] + "," if labels else "{")
-                lines.append(f'{pname}_bucket{base}le="+Inf"}} {total}')
-                lines.append(f"{pname}_sum{labels} {value['sum']}")
-                lines.append(f"{pname}_count{labels} {total}")
+                lines += _hist_lines(pname, tags_key, value)
             else:
-                lines.append(f"{pname}{labels} {value}")
+                lines.append(f"{pname}{_fmt_labels(tags_key)} {value}")
     return lines
 
 
@@ -125,6 +151,14 @@ def render(nm=None) -> str:
             lines += _core_lines(nm)
         except Exception:
             pass
+    try:
+        # Rendering is a natural sampling edge: refresh this process's
+        # device gauges (no-op unless jax is already imported here).
+        from . import device_metrics
+
+        device_metrics.maybe_sample()
+    except Exception:
+        pass
     try:
         lines += _user_lines(user_metrics.get_metrics_report())
     except Exception:

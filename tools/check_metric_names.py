@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""CI lint: validate every metric the package declares at import time.
+
+Imports each ray_tpu submodule (so module-level Counter/Gauge/Histogram
+singletons register in util.metrics' declaration table), then fails on:
+
+- Prometheus-invalid metric names (must match
+  ``[a-zA-Z_:][a-zA-Z0-9_:]*``);
+- counters whose declared name does not end in ``_total`` (the renderer
+  would silently append it, splitting dashboards from code);
+- the same name registered under two conflicting kinds (the series
+  would be corrupted — see util/metrics._Registry.declare).
+
+Run via ``make check-metrics`` or directly. Exits non-zero on failure.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import pkgutil
+import re
+import sys
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+# Modules never imported by the checker: __main__ shims (importing them
+# is harmless but pointless) and entrypoints that exec on import.
+SKIP_SUFFIXES = ("__main__",)
+
+
+def import_package_modules(pkg_name: str = "ray_tpu"):
+    """Import every submodule, tolerating optional-dependency failures
+    (grpc, torch, ...) — a skipped module can't register metrics, so
+    report skips for the log."""
+    # Keep imports off real accelerators when run on a TPU host.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Runnable from the repo root without an installed package.
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    pkg = importlib.import_module(pkg_name)
+    skipped = []
+    for info in pkgutil.walk_packages(pkg.__path__, prefix=f"{pkg_name}."):
+        if info.name.endswith(SKIP_SUFFIXES):
+            continue
+        try:
+            importlib.import_module(info.name)
+        except Exception as e:  # noqa: BLE001 — optional deps, native builds
+            skipped.append((info.name, repr(e)))
+    return skipped
+
+
+def validate(declared, conflicts):
+    """Return a list of human-readable failures."""
+    failures = []
+    for name, (kind, _desc) in sorted(declared.items()):
+        if not NAME_RE.match(name):
+            failures.append(
+                f"{name}: not a valid Prometheus metric name"
+            )
+        if kind == "counter" and not name.endswith("_total"):
+            failures.append(
+                f"{name}: counter name must end with _total "
+                f"(the exposition layer would rename it)"
+            )
+    for name, (old, new) in sorted(conflicts.items()):
+        failures.append(
+            f"{name}: registered as both {old} and {new} — conflicting "
+            f"kinds corrupt the series"
+        )
+    return failures
+
+
+def main() -> int:
+    skipped = import_package_modules()
+    from ray_tpu.util.metrics import (
+        declaration_conflicts,
+        declared_metrics,
+    )
+
+    declared = declared_metrics()
+    failures = validate(declared, declaration_conflicts())
+    for name, err in skipped:
+        print(f"skip {name}: {err}", file=sys.stderr)
+    print(f"checked {len(declared)} declared metric(s), "
+          f"{len(skipped)} module(s) skipped")
+    if failures:
+        for f in failures:
+            print(f"FAIL {f}", file=sys.stderr)
+        return 1
+    print("metric names OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
