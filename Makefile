@@ -11,12 +11,17 @@ LDLIBS   := -lpthread -lrt
 STORE_SRC := src/store/rts_store.cc
 EXT       := ray_tpu/_native/_rtstore.so
 
-.PHONY: native native-test cpp-client clean check-metrics
+.PHONY: native native-test cpp-client clean check-obs check-metrics
 
-# Lint every Counter/Gauge/Histogram the package declares at import time
-# (Prometheus-valid names, counters end in _total, no kind conflicts).
-check-metrics:
+# Observability lint: every Counter/Gauge/Histogram the package declares
+# at import time (Prometheus-valid names, counters end in _total, no
+# kind conflicts) plus every cluster-event emit site (severity/source
+# must resolve to the enums declared in ray_tpu/util/events.py).
+check-obs:
 	$(PY) tools/check_metric_names.py
+
+# Historical alias for check-obs.
+check-metrics: check-obs
 
 native: $(EXT)
 

@@ -19,6 +19,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .node_provider import PROVIDER_NODE_LABEL, LocalNodeProvider, NodeProvider
+from ..util import events as cluster_events
 
 
 def _fits(shape: Dict[str, float], avail: Dict[str, float]) -> bool:
@@ -197,6 +198,13 @@ class Autoscaler:
             type_name, time.monotonic() + self.config.boot_timeout_s
         )
         self._type_of[nid] = type_name
+        cluster_events.emit(
+            cluster_events.INFO, cluster_events.AUTOSCALER,
+            f"scale up: launching node {nid} (type {type_name}, "
+            f"resources {dict(tcfg['resources'])})",
+            custom_fields={"provider_node_id": nid,
+                           "node_type": type_name},
+        )
         return nid
 
     def _hosts_of(self, nid: str, host_views=None) -> int:
@@ -269,6 +277,14 @@ class Autoscaler:
                     or nid not in live_set):
                 self._booting.pop(nid, None)
             elif now > deadline:
+                cluster_events.emit(
+                    cluster_events.WARNING, cluster_events.AUTOSCALER,
+                    f"terminating node {nid}: boot deadline blown "
+                    f"(hung instance would leak cost and pin a "
+                    f"max_workers slot)",
+                    custom_fields={"provider_node_id": nid,
+                                   "reason": "boot_timeout"},
+                )
                 try:
                     self.provider.terminate_node(nid)
                     live_count -= 1
@@ -339,6 +355,14 @@ class Autoscaler:
                 self._idle_since[nid] = now
             elif now - since >= cfg.idle_timeout_s:
                 if live_count > cfg.min_workers:
+                    cluster_events.emit(
+                        cluster_events.INFO, cluster_events.AUTOSCALER,
+                        f"scale down: terminating node {nid} "
+                        f"(idle {now - since:.1f}s)",
+                        custom_fields={"provider_node_id": nid,
+                                       "idle_s": round(now - since, 1),
+                                       "reason": "idle_timeout"},
+                    )
                     self.provider.terminate_node(nid)
                     live_count -= 1
                     self._idle_since.pop(nid, None)

@@ -154,6 +154,19 @@ class Config:
     # system memory usage exceeds this fraction (<= 0 disables).
     memory_usage_threshold: float = 0.95
     memory_monitor_interval_s: float = 0.5
+    # --- cluster event plane (ref analogue: the GCS export-event channel
+    # behind `ray list cluster-events`) ------------------------------------
+    # Per-process ring of not-yet-published events (util/events.py).
+    event_buffer_size: int = 1000
+    # Head-side aggregated store size (events beyond this age out oldest
+    # first, per severity index too).
+    event_store_size: int = 10_000
+    # When set, the head appends every aggregated event to this JSONL
+    # file (external-collector export sink).
+    event_export_path: str = ""
+    # Terminal task records (state/duration/error) each node retains for
+    # the state API after the live record is dropped (failure history).
+    task_history_size: int = 1000
 
     def __post_init__(self):
         for f in dataclasses.fields(self):

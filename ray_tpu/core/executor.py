@@ -156,8 +156,16 @@ def execute_task(
     store_large: Callable[[ObjectID, Any], Location],
     actor: ActorContainer,
     stream_item: Optional[Callable[[int, Any], None]] = None,
-) -> Tuple[List[Tuple[ObjectID, Location]], bool, List[Tuple[ObjectID, list]]]:
-    """Run one task; returns (results, failed, nested-refs-per-return)."""
+) -> Tuple[
+    List[Tuple[ObjectID, Location]],
+    bool,
+    List[Tuple[ObjectID, list]],
+    Optional[Dict[str, str]],
+]:
+    """Run one task; returns (results, failed, nested-refs-per-return,
+    error-info). ``error-info`` is None on success, else
+    {error_type, error_message, traceback} — the structured failure
+    record the node manager retains and the event plane reports."""
     try:
         args, kwargs = resolve_args(spec, fetch)
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
@@ -186,11 +194,17 @@ def execute_task(
             stream_item(count, _STREAM_END)
             value = count
         results, nested = package_results(spec, value, store_large)
-        return results, False, nested
+        return results, False, nested, None
     except Exception as e:  # noqa: BLE001 — user exceptions become TaskError
         err = e if isinstance(e, TaskError) else TaskError.from_exception(
             e, spec.name or spec.method_name
         )
+        cause = err.cause if isinstance(err, TaskError) else None
+        error_info = {
+            "error_type": type(cause if cause is not None else e).__name__,
+            "error_message": str(cause if cause is not None else e)[:500],
+            "traceback": (err.traceback_str or "")[-2000:],
+        }
         cfg = get_config()
         sobj = serialize(err)
         if sobj.total_size <= cfg.max_inline_object_size:
@@ -198,4 +212,4 @@ def execute_task(
             results = [(oid, loc) for oid in spec.return_ids()]
         else:
             results = [(oid, store_large(oid, sobj)) for oid in spec.return_ids()]
-        return results, True, []
+        return results, True, [], error_info

@@ -29,7 +29,13 @@ from .config import Config, get_config
 from .ids import ActorID, NodeID, ObjectID
 from .protocol import AioFramedWriter as _FramedWriter
 from .protocol import aio_read_frame as _read_frame
-from .pubsub import ACTOR_STATE, ERROR_INFO, NODE_STATE, Publisher
+from .pubsub import (
+    ACTOR_STATE,
+    CLUSTER_EVENTS,
+    ERROR_INFO,
+    NODE_STATE,
+    Publisher,
+)
 from .rpc import Method, RpcError, ServiceRegistry, ServiceSpec
 
 # Typed service surface (ref analogue: the 11 service blocks of
@@ -131,6 +137,14 @@ GCS_SERVICES = (
                         ("channels", "list", False)),
                notify=True),
     )),
+    ServiceSpec("EventService", (
+        Method("events_list",
+               request=(("severity", "str", False),
+                        ("source", "str", False),
+                        ("limit", "int", False, 1000)),
+               reply=(("events", "list"), ("total", "int"),
+                      ("dropped", "int"))),
+    )),
     ServiceSpec("MetaService", (
         Method("rpc_describe", reply=(("services", "dict"),)),
     )),
@@ -218,6 +232,20 @@ class GcsService:
         self._rpc = ServiceRegistry()
         for spec in GCS_SERVICES:
             self._rpc.register(spec, self)
+        # Cluster event aggregator (ref analogue: the GCS export-event
+        # buffer behind `ray list cluster-events`): everything published
+        # on the cluster_events channel — by remote nodes, local workers,
+        # or this service itself — lands in the bounded store below via
+        # the aggregator subscription drained in _event_aggregator_loop.
+        from ..util.events import EventStore
+
+        self.events = EventStore(
+            maxlen=getattr(config, "event_store_size", 10_000),
+            jsonl_path=getattr(config, "event_export_path", ""),
+        )
+        self._event_sub_id = "__event_aggregator__"
+        self.pubsub.subscribe(self._event_sub_id, [CLUSTER_EVENTS])
+        self._events_task: Optional[asyncio.Task] = None
 
     # ------------------------------------------------------------------ boot
 
@@ -236,6 +264,53 @@ class GcsService:
         # One coalesced cluster-view broadcast per interval, not one per
         # received heartbeat (which would be O(n^2) messages per interval).
         self._broadcast_task = asyncio.ensure_future(self._broadcast_loop())
+        self._events_task = asyncio.ensure_future(
+            self._event_aggregator_loop()
+        )
+
+    async def _event_aggregator_loop(self):
+        """Drain the cluster_events channel into the head store: events
+        keep pubsub ordering (publish seq) regardless of which node or
+        worker produced them."""
+        while True:
+            try:
+                reply = await self.pubsub.poll(
+                    self._event_sub_id, timeout=30.0, max_events=1000
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                await asyncio.sleep(1.0)
+                continue
+            if reply.get("unknown"):
+                # Subscription reaped (e.g. the loop stalled past the
+                # idle timeout): resubscribe instead of busy-spinning on
+                # instant empty replies.
+                self.pubsub.subscribe(self._event_sub_id, [CLUSTER_EVENTS])
+                await asyncio.sleep(0.5)
+                continue
+            if reply.get("dropped"):
+                self.events.note_dropped(reply["dropped"])
+            batch = []
+            for ev in reply.get("events", ()):
+                data = ev.get("data")
+                batch.extend(data if isinstance(data, list) else [data])
+            if batch:
+                self.events.add_batch(batch)
+
+    def _record_event(self, severity: str, source: str, message: str,
+                      **fields):
+        """GCS-internal emission: publish onto the events channel (the
+        aggregator loop stores it; external followers see it too)."""
+        from ..util.events import make_event
+
+        try:
+            self.pubsub.publish(
+                CLUSTER_EVENTS,
+                make_event(severity, source, message, **fields),
+            )
+        except Exception:
+            pass
 
     async def _broadcast_loop(self):
         while True:
@@ -337,6 +412,9 @@ class GcsService:
 
     def stop(self):
         self._maybe_snapshot(force=True)
+        if self._events_task is not None:
+            self._events_task.cancel()
+        self.events.close()
         if self._health_task is not None:
             self._health_task.cancel()
         if getattr(self, "_broadcast_task", None) is not None:
@@ -559,6 +637,16 @@ class GcsService:
                                     channels=None):
         self.pubsub.unsubscribe(subscriber_id, channels)
 
+    async def _rpc_events_list(self, node_id, severity=None, source=None,
+                               limit=1000):
+        stats = self.events.stats()
+        return {
+            "events": self.events.list(severity=severity, source=source,
+                                       limit=limit),
+            "total": stats["total"],
+            "dropped": stats["dropped"],
+        }
+
     async def _rpc_rpc_describe(self, node_id):
         return {"services": self._rpc.describe()}
 
@@ -767,6 +855,15 @@ class GcsService:
             NODE_STATE, {"event": "added", "node": entry.view()},
             key=node_id.hex(),
         )
+        from ..util import events as _events
+
+        self._record_event(
+            _events.INFO, _events.GCS,
+            f"node {node_id.hex()[:8]} registered "
+            f"(host={host}, resources={dict(resources)})",
+            node_id=node_id.hex(),
+            custom_fields={"host": host, "is_head": is_head},
+        )
         if self.on_node_added is not None:
             self.on_node_added(entry)
         # New capacity may unblock pending placement groups.
@@ -858,6 +955,18 @@ class GcsService:
             {"event": "dead", "node_id": dead_hex, "reason": reason,
              "dead_actors": [a.hex() for a in dead_actors]},
             key=dead_hex,
+        )
+        from ..util import events as _events
+
+        self._record_event(
+            _events.ERROR, _events.GCS,
+            f"node {dead_hex[:8]} died: {reason}",
+            node_id=dead_hex,
+            custom_fields={
+                "reason": reason,
+                "dead_actors": len(dead_actors),
+                "invalidated_pgs": len(invalid_pgs),
+            },
         )
         if invalid_pgs and self.on_pgs_invalidated is not None:
             self.on_pgs_invalidated(invalid_pgs)
@@ -1133,6 +1242,16 @@ class LocalGcsHandle:
     async def psub_unsubscribe(self, subscriber_id, channels=None):
         self._svc.pubsub.unsubscribe(subscriber_id, channels)
 
+    async def events_list(self, severity=None, source=None, limit=1000):
+        stats = self._svc.events.stats()
+        return {
+            "events": self._svc.events.list(
+                severity=severity, source=source, limit=limit
+            ),
+            "total": stats["total"],
+            "dropped": stats["dropped"],
+        }
+
     async def rpc_describe(self):
         return self._svc._rpc.describe()
 
@@ -1284,6 +1403,18 @@ class RemoteGcsHandle:
             {"op": "psub_unsubscribe", "subscriber_id": subscriber_id,
              "channels": channels, "msg_id": None}
         )
+
+    async def events_list(self, severity=None, source=None, limit=1000):
+        msg = {"op": "events_list", "limit": limit}
+        # Optional str fields must be absent, not None, to pass the
+        # request schema's type check.
+        if severity is not None:
+            msg["severity"] = severity
+        if source is not None:
+            msg["source"] = source
+        r = await self._client.request(msg)
+        return {"events": r["events"], "total": r["total"],
+                "dropped": r["dropped"]}
 
     async def rpc_describe(self):
         return (await self._client.request({"op": "rpc_describe"}))[

@@ -28,6 +28,11 @@ class LogMonitor:
         self._out = out or sys.stdout
         self._offsets: Dict[str, int] = {}
         self._partial: Dict[str, bytes] = {}
+        # path -> resolved pid string; a worker's pid never changes, so
+        # one successful lookup is final (without this, every 200 ms poll
+        # rescanned the whole worker table per log file —
+        # O(files x workers) steady-state).
+        self._pids: Dict[str, str] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._node8 = (
@@ -48,7 +53,11 @@ class LogMonitor:
 
     def _pid_for(self, path: str) -> str:
         """Map worker-<id8>.log back to the worker's pid via the node
-        manager's worker table (best effort)."""
+        manager's worker table (best effort); cached per path after the
+        first successful lookup."""
+        cached = self._pids.get(path)
+        if cached is not None:
+            return cached
         if self._nm is None:
             return "?"
         base = os.path.basename(path)
@@ -56,7 +65,9 @@ class LogMonitor:
         try:
             for wid, handle in list(self._nm._workers.items()):
                 if wid.hex().startswith(id8) and handle.proc is not None:
-                    return str(handle.proc.pid)
+                    pid = str(handle.proc.pid)
+                    self._pids[path] = pid
+                    return pid
         except Exception:
             pass
         return "?"

@@ -64,6 +64,7 @@ from .resources import CPU, NodeResources, ResourceSet
 from .scheduling_policy import pick_node
 from .scheduling_strategies import PlacementGroupSchedulingStrategy
 from .task_spec import TaskSpec, TaskType
+from ..util import events as cluster_events
 
 _HEADER = struct.Struct("<I")
 
@@ -381,6 +382,15 @@ class NodeManager:
         # the container's entry lives; released when it is collected.
         self._nested_pins: Dict[ObjectID, List[ObjectID]] = {}
 
+        # Failure history: bounded deque of TERMINAL task records (state,
+        # duration, error type/message) retained after the live record
+        # leaves _tasks, merged into _local_state_snapshot so list_tasks
+        # can answer "what failed" (ref analogue: the task-event buffer
+        # retaining terminal states behind `ray summary tasks`).
+        self._task_history: Deque[Dict[str, Any]] = deque(
+            maxlen=config.task_history_size
+        )
+
         self._stats = {
             "tasks_submitted": 0,
             "tasks_finished": 0,
@@ -488,6 +498,30 @@ class NodeManager:
         self._gc_task = asyncio.ensure_future(self._gc_loop())
         self._health_task = asyncio.ensure_future(self._health_loop())
         self._memmon_task = asyncio.ensure_future(self._memory_monitor_loop())
+        # This process's cluster-event transport: batches publish through
+        # our GCS handle on this loop (node-manager processes have no
+        # driver runtime for events to route through).
+        cluster_events.set_publish_hook(self._publish_event_batch)
+
+    def _publish_event_batch(self, batch: List[Dict[str, Any]]):
+        """events.py flusher-thread entry: ship a drained batch via the
+        GCS pubsub without blocking the flusher."""
+        if self._shutdown or self._gcs is None:
+            raise RuntimeError("node manager not connected")
+        asyncio.run_coroutine_threadsafe(
+            self._publish_events_async(list(batch)), self._loop
+        )
+
+    async def _publish_events_async(self, batch: List[Dict[str, Any]]):
+        for e in batch:
+            if e.get("node_id") is None:
+                e["node_id"] = self.node_id.hex()
+        try:
+            await self._gcs.psub_publish(
+                cluster_events.CLUSTER_EVENTS, batch
+            )
+        except Exception:
+            pass
 
     async def _connect_gcs(self):
         """Dial the GCS and register this node (first boot AND after a
@@ -740,6 +774,14 @@ class NodeManager:
                     f"[ray_tpu] worker {worker_id.hex()[:8]} exited during "
                     f"startup (code {proc.returncode}). Log tail:\n{detail}\n"
                 )
+                cluster_events.emit(
+                    cluster_events.ERROR, cluster_events.WORKER,
+                    f"worker {worker_id.hex()[:8]} exited during startup "
+                    f"(code {proc.returncode})",
+                    node_id=self.node_id.hex(),
+                    custom_fields={"exit_code": proc.returncode,
+                                   "log_tail": detail[-500:]},
+                )
                 if consecutive_failures >= 3:
                     # Workers cannot start at all: fail queued work loudly.
                     while self._ready:
@@ -814,6 +856,13 @@ class NodeManager:
         )
         out.close()
         self._stats["workers_started"] += 1
+        cluster_events.emit(
+            cluster_events.DEBUG, cluster_events.WORKER,
+            f"worker {worker_id.hex()[:8]} spawned "
+            f"(pid {proc.pid}, type {worker_type})",
+            node_id=self.node_id.hex(),
+            custom_fields={"pid": proc.pid, "worker_type": worker_type},
+        )
         # The handle is registered when the worker connects and registers.
         self._pending_procs[worker_id] = proc
         self._pending_types[worker_id] = worker_type
@@ -942,6 +991,10 @@ class NodeManager:
             await w.writer.send(
                 {"type": "reply", "msg_id": msg["msg_id"], "state": state}
             )
+        elif mtype == "events":
+            # Head-store query; the long-path RPC must not stall this
+            # worker's message loop.
+            asyncio.ensure_future(self._handle_events_query(w, msg))
         elif mtype == "pull_object":
             # Client-mode read rides the SAME chunked, admission-
             # controlled transfer plane nodes use (small objects answer
@@ -1028,6 +1081,40 @@ class NodeManager:
         prev_state = w.state
         w.state = "dead"
         self._workers.pop(w.worker_id, None)
+        exit_code = w.proc.poll() if w.proc is not None else None
+        # Intentional kills (ray_tpu.kill(actor), force task-cancel) are
+        # routine API usage, not crashes: keep them out of the ERROR view.
+        graceful = (getattr(w, "_graceful_exit", False)
+                    or getattr(w, "_intentional_kill", False))
+        if w.worker_type == "client":
+            pass  # thin-client disconnects are not worker lifecycle
+        elif graceful or self._shutdown or exit_code in (0, None):
+            # Clean exit / idle reap / node shutdown: routine lifecycle.
+            cluster_events.emit(
+                cluster_events.INFO, cluster_events.WORKER,
+                f"worker {w.worker_id.hex()[:8]} exited"
+                + (f" (code {exit_code})" if exit_code is not None else ""),
+                node_id=self.node_id.hex(),
+                actor_id=w.actor_id.hex() if w.actor_id else None,
+                custom_fields={"exit_code": exit_code,
+                               "graceful": graceful},
+            )
+        else:
+            oom = getattr(w, "_oom_killed", False)
+            cluster_events.emit(
+                cluster_events.ERROR, cluster_events.WORKER,
+                f"worker {w.worker_id.hex()[:8]} crashed "
+                f"(exit code {exit_code})"
+                + (" [killed by memory monitor]" if oom else ""),
+                node_id=self.node_id.hex(),
+                actor_id=w.actor_id.hex() if w.actor_id else None,
+                custom_fields={
+                    "exit_code": exit_code,
+                    "oom_killed": oom,
+                    "running_task": (w.current.spec.name
+                                     if w.current is not None else None),
+                },
+            )
         for writer in w.client_writers.values():
             try:
                 writer.abort()  # client died mid-put: free the block
@@ -1530,6 +1617,10 @@ class NodeManager:
             self._stats["tasks_finished"] += 1
         if record.spec.task_type != TaskType.ACTOR_CREATION_TASK:
             self._unpin_deps(record)
+            # No history row here: the EXECUTING node already retained
+            # the terminal record (with duration + error detail) in its
+            # own _on_task_done/_fail_task — a second row at the origin
+            # would double-count the task cluster-wide.
             self._tasks.pop(record.spec.task_id, None)
 
     async def _on_node_dead_hex(self, node_hex: str, dead_actors=None):
@@ -2311,9 +2402,15 @@ class NodeManager:
         # Creation-task deps stay pinned while the actor may restart (the
         # creation spec re-executes with the same arguments). Terminal
         # normal/actor-task records are dropped to keep the head's memory
-        # bounded (the spec holds serialized args).
+        # bounded (the spec holds serialized args) — their outcome is
+        # retained in the bounded failure history instead.
         if record.spec.task_type != TaskType.ACTOR_CREATION_TASK:
             self._unpin_deps(record)
+            self._record_terminal_task(
+                record,
+                error_type=msg.get("error_type"),
+                error_message=msg.get("error_message"),
+            )
             self._tasks.pop(task_id, None)
         elif msg.get("failed"):
             self._unpin_deps(record)
@@ -2326,6 +2423,19 @@ class NodeManager:
                         info.state = "dead"
                         info.death_cause = "actor constructor failed"
                         info.restarts_left = 0
+                        cluster_events.emit(
+                            cluster_events.ERROR, cluster_events.ACTOR,
+                            f"actor {info.actor_id.hex()[:8]} "
+                            f"({record.spec.class_name}) constructor "
+                            f"failed: "
+                            f"{msg.get('error_type') or 'Exception'}",
+                            node_id=self.node_id.hex(),
+                            actor_id=info.actor_id.hex(),
+                            custom_fields={
+                                "error_type": msg.get("error_type"),
+                                "cause": "constructor failed",
+                            },
+                        )
                         self._fail_actor_queue(info)
                         if info.name:
                             self._named_actors.pop(info.name, None)
@@ -2378,12 +2488,59 @@ class NodeManager:
         for oid in record.spec.pinned_ids():
             self.directory.remove_ref(oid)
 
+    def _record_terminal_task(self, record: TaskRecord, *,
+                              error_type: Optional[str] = None,
+                              error_message: Optional[str] = None):
+        """Retain a terminal task's outcome in the bounded failure
+        history (it is about to leave the live table)."""
+        spec = record.spec
+        dur = (time.monotonic() - record.dispatched
+               if record.dispatched is not None else None)
+        self._task_history.append({
+            "task_id": spec.task_id.hex(),
+            "name": spec.name or spec.method_name or "task",
+            "state": record.state,
+            "type": spec.task_type.name,
+            "node_id": self.node_id.hex(),
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            "duration_s": round(dur, 6) if dur is not None else None,
+            "error_type": error_type,
+            "error_message": (error_message or "")[:500] or None,
+            # retries_left counts DOWN from max_retries as crashes retry:
+            # together they answer "did this task exhaust its retries?".
+            "retry_count": spec.max_retries - spec.retries_left,
+            "retries_left": spec.retries_left,
+            "end_ts": time.time(),
+            "retained": True,
+        })
+
     def _fail_task(self, record: TaskRecord, error: TaskError):
-        record.state = "failed"
+        cancelled = isinstance(error, TaskCancelledError)
+        record.state = "cancelled" if cancelled else "failed"
         self._stats["tasks_failed"] += 1
         self._unpin_deps(record)
+        etype = type(error).__name__
+        detail = (getattr(error, "traceback_str", "") or str(error)).strip()
+        last_line = detail.splitlines()[-1] if detail else ""
         if record.spec.task_type != TaskType.ACTOR_CREATION_TASK:
+            self._record_terminal_task(
+                record, error_type=etype, error_message=detail
+            )
             self._tasks.pop(record.spec.task_id, None)
+        if not cancelled:
+            # System-level failures (worker crash, actor death, node
+            # loss): there is no worker alive to report the traceback, so
+            # the control plane records the ERROR event itself.
+            cluster_events.emit(
+                cluster_events.ERROR, cluster_events.TASK,
+                f"task '{record.spec.name or record.spec.method_name}' "
+                f"failed: {etype}: {last_line}",
+                node_id=self.node_id.hex(),
+                task_id=record.spec.task_id.hex(),
+                actor_id=(record.spec.actor_id.hex()
+                          if record.spec.actor_id else None),
+                custom_fields={"error_type": etype},
+            )
         try:
             from .serialization import serialize
 
@@ -2572,6 +2729,17 @@ class NodeManager:
             if info.restarts_left > 0:
                 info.restarts_left -= 1
             info.restart_count += 1
+            cluster_events.emit(
+                cluster_events.WARNING, cluster_events.ACTOR,
+                f"actor {info.actor_id.hex()[:8]} "
+                f"({info.creation_spec.class_name}) restarting "
+                f"after worker death (restart #{info.restart_count}, "
+                f"{info.restarts_left} left)",
+                node_id=self.node_id.hex(),
+                actor_id=info.actor_id.hex(),
+                custom_fields={"class_name": info.creation_spec.class_name,
+                               "restart_count": info.restart_count},
+            )
             # Actor tasks are NOT retried by default (ref: max_task_retries=0
             # in the reference); interrupted calls fail with ActorDiedError
             # unless they carry retries, in which case they resubmit in order.
@@ -2590,6 +2758,20 @@ class NodeManager:
         else:
             info.state = "dead"
             info.death_cause = cause
+            intentional = graceful or getattr(w, "_intentional_kill", False)
+            cluster_events.emit(
+                cluster_events.INFO if intentional else cluster_events.ERROR,
+                cluster_events.ACTOR,
+                f"actor {info.actor_id.hex()[:8]} "
+                f"({info.creation_spec.class_name}) died: "
+                + ("killed via ray_tpu.kill" if intentional and not graceful
+                   else cause),
+                node_id=self.node_id.hex(),
+                actor_id=info.actor_id.hex(),
+                custom_fields={"class_name": info.creation_spec.class_name,
+                               "cause": cause,
+                               "restart_count": info.restart_count},
+            )
             if creation_pending and creation_record is not None:
                 self._fail_task(
                     creation_record, ActorDiedError(info.creation_spec.name, cause)
@@ -2647,6 +2829,7 @@ class NodeManager:
             except Exception:
                 pass
             if worker.proc is not None:
+                worker._intentional_kill = True
                 try:
                     worker.proc.kill()
                 except Exception:
@@ -2847,6 +3030,7 @@ class NodeManager:
             need = max(self.directory.used_bytes - target, extra_need)
             if need <= 0:
                 return
+            spilled_n = spilled_bytes = 0
             for oid, loc in self.directory.spill_candidates(need):
                 try:
                     data = self.local_store.get_bytes(loc)
@@ -2860,8 +3044,19 @@ class NodeManager:
                     continue  # disk trouble: skip, keep relieving others
                 if self.directory.replace_if(oid, loc, sloc):
                     _free_location(loc)
+                    spilled_n += 1
+                    spilled_bytes += len(data)
                 else:
                     self.spill_manager.delete(sloc)
+            if spilled_n:
+                cluster_events.emit(
+                    cluster_events.INFO, cluster_events.OBJECT_STORE,
+                    f"spilled {spilled_n} object(s) "
+                    f"({spilled_bytes} bytes) to disk",
+                    node_id=self.node_id.hex(),
+                    custom_fields={"objects": spilled_n,
+                                   "bytes": spilled_bytes},
+                )
         finally:
             self._spilling = False
             # Puts/restores that landed mid-pass can leave usage above the
@@ -2899,6 +3094,14 @@ class NodeManager:
             new_loc = self.local_store.put_raw(oid, data)
         if self.directory.replace_if(oid, sloc, new_loc):
             self.spill_manager.delete(sloc)
+            cluster_events.emit(
+                cluster_events.DEBUG, cluster_events.OBJECT_STORE,
+                f"restored object {oid.hex()[:8]} from disk "
+                f"({len(data)} bytes)",
+                node_id=self.node_id.hex(),
+                custom_fields={"object_id": oid.hex(),
+                               "bytes": len(data)},
+            )
             self._maybe_spill()  # restoring may re-cross the high-water mark
             return new_loc
         cur = self.directory.lookup(oid)
@@ -2928,6 +3131,17 @@ class NodeManager:
             sys.stderr.write(
                 f"[ray_tpu] memory pressure ({frac:.0%}): killing task "
                 f"'{record.spec.name}' (worker {worker.worker_id.hex()[:8]})\n"
+            )
+            cluster_events.emit(
+                cluster_events.ERROR, cluster_events.RAYLET,
+                f"memory pressure ({frac:.0%}): OOM-killing task "
+                f"'{record.spec.name}' "
+                f"(worker {worker.worker_id.hex()[:8]}, "
+                f"retries_left={record.spec.retries_left})",
+                node_id=self.node_id.hex(),
+                task_id=record.spec.task_id.hex(),
+                custom_fields={"memory_usage_frac": round(frac, 4),
+                               "retriable": record.spec.retries_left > 0},
             )
             worker._oom_killed = True
             if worker.proc is not None:
@@ -3288,6 +3502,32 @@ class NodeManager:
         """Sync entry for the in-process driver runtime."""
         return self.call_sync(self._pubsub_op(msg))
 
+    # ------------------------------------------------- cluster-event query
+
+    async def _handle_events_query(self, w: WorkerHandle, msg):
+        out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
+        try:
+            out.update(await self._events_list(
+                severity=msg.get("severity"), source=msg.get("source"),
+                limit=msg.get("limit", 1000),
+            ))
+        except Exception as e:
+            out["error"] = str(e)
+        try:
+            await w.writer.send(out)
+        except Exception:
+            pass
+
+    async def _events_list(self, severity=None, source=None,
+                           limit: int = 1000) -> Dict[str, Any]:
+        """Fetch the head aggregator's event store (ref analogue:
+        `ray list cluster-events` hitting the GCS)."""
+        if self._gcs is None:
+            raise RuntimeError("cluster events require the cluster GCS")
+        return await self._gcs.events_list(
+            severity=severity, source=source, limit=limit
+        )
+
     # ------------------------------------------------- placement-group proxy
 
     async def _handle_pg(self, w: WorkerHandle, msg):
@@ -3437,6 +3677,7 @@ class NodeManager:
                 except Exception:
                     pass
             elif worker is not None and worker.proc is not None:
+                worker._intentional_kill = True
                 try:
                     worker.proc.kill()
                 except Exception:
@@ -3521,6 +3762,9 @@ class NodeManager:
                              if rec.spec.actor_id else None),
                 "age_s": round(time.monotonic() - rec.created, 3),
             })
+        # Terminal records retained after leaving the live table: the
+        # failure history list_tasks needs to answer "what failed".
+        tasks.extend(dict(row) for row in self._task_history)
         actors = []
         for aid, info in self._actors.items():
             w = self._workers.get(info.worker_id)
@@ -3683,6 +3927,7 @@ class NodeManager:
     def shutdown(self):
         if self._shutdown:
             return
+        cluster_events.clear_publish_hook(self._publish_event_batch)
         self._shutdown = True
         if getattr(self, "dashboard_agent", None) is not None:
             self.dashboard_agent.stop()

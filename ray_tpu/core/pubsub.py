@@ -27,6 +27,11 @@ NODE_STATE = "node_state"
 ACTOR_STATE = "actor_state"
 ERROR_INFO = "error_info"
 LOGS = "logs"
+# Structured cluster events (ref analogue: the GCS RAY_LOG / export-event
+# channel feeding `ray list cluster-events`). Producers publish batches of
+# event dicts (util/events.make_event); the head GCS aggregates them into
+# its bounded EventStore.
+CLUSTER_EVENTS = "cluster_events"
 
 
 class _Subscription:

@@ -973,6 +973,14 @@ class DriverRuntime(BaseRuntime):
         """Cluster-wide live-state tables (state API backing)."""
         return self._nm.call_sync(self._nm.cluster_state())
 
+    def list_cluster_events(self, severity=None, source=None,
+                            limit: int = 1000) -> Dict[str, Any]:
+        """Head aggregator's structured event store (state API backing
+        for list_cluster_events / `rtpu events`)."""
+        return self._nm.call_sync(
+            self._nm._events_list(severity=severity, source=source,
+                                  limit=limit)
+        )
 
     def cluster_resources(self) -> Dict[str, float]:
         views = self.nodes()
@@ -1177,6 +1185,18 @@ class WorkerRuntime(BaseRuntime):
 
     def cluster_state(self) -> Dict[str, Any]:
         return self.request({"type": "state"}, timeout=30.0)["state"]
+
+    def list_cluster_events(self, severity=None, source=None,
+                            limit: int = 1000) -> Dict[str, Any]:
+        reply = self.request(
+            {"type": "events", "severity": severity, "source": source,
+             "limit": limit},
+            timeout=30.0,
+        )
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return {"events": reply["events"], "total": reply["total"],
+                "dropped": reply["dropped"]}
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self._conn.send({"type": "kill_actor", "actor_id": actor_id,

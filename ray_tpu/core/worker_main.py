@@ -582,7 +582,7 @@ class Worker:
         prev_span = enter_span(trace_id, span_id)
         _t0 = _time.time()
         try:
-            results, failed, nested = execute_task(
+            results, failed, nested, error_info = execute_task(
                 spec, load_function, fetch, store_large, self.actor,
                 stream_item=stream_item if spec.streaming else None,
             )
@@ -606,6 +606,36 @@ class Worker:
             "results": results,
             "failed": failed,
         }
+        if failed and error_info is not None:
+            # Structured failure record: the node manager retains the
+            # error type/message in its terminal-task history, and the
+            # event below carries the traceback's provenance (worker pid
+            # + node) to the cluster event plane.
+            done["error_type"] = error_info["error_type"]
+            done["error_message"] = error_info["error_message"]
+            try:
+                from ..util import events as cluster_events
+
+                cluster_events.emit(
+                    cluster_events.ERROR, cluster_events.TASK,
+                    f"task '{spec.name or spec.method_name}' failed: "
+                    f"{error_info['error_type']}: "
+                    f"{error_info['error_message']}",
+                    task_id=spec.task_id.hex(),
+                    actor_id=(spec.actor_id.hex()
+                              if spec.actor_id else None),
+                    custom_fields={
+                        "error_type": error_info["error_type"],
+                        "traceback": error_info["traceback"],
+                        "worker_pid": os.getpid(),
+                    },
+                )
+                # Publish NOW, not on the 0.25s cadence: the next task on
+                # this worker may os._exit before the flusher ticks, and
+                # a failure event is the one record worth a sync hop.
+                cluster_events.flush()
+            except Exception:
+                pass
         if nested:
             # Refs serialized inside return values: the NM pins them for
             # each return's lifetime (AddNestedObjectIds analogue).

@@ -106,6 +106,20 @@ class _Handler(BaseHTTPRequestHandler):
                 "/api/summary/actors": state.summarize_actors,
                 "/api/summary/objects": state.summarize_objects,
             }
+            if path == "/api/events":
+                # Aggregated cluster event log from the head store (ref:
+                # dashboard events REST surface over the GCS export-event
+                # channel). ?severity=ERROR&source=TASK&limit=200
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+                rows = state.list_cluster_events(
+                    severity=(q.get("severity") or [None])[0],
+                    source=(q.get("source") or [None])[0],
+                    limit=int((q.get("limit") or ["1000"])[0]),
+                )
+                self._json({"events": rows})
+                return
             if path == "/api/serve":
                 # Serve application state (ref: dashboard/modules/serve
                 # REST surface over the controller).

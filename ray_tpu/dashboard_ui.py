@@ -140,9 +140,10 @@ function viewOverview(){
       res[k]=(res[k]||0)+v;
     for(const[k,v]of Object.entries(n.Available||n.ResourcesAvailable
       ||{}))avail[k]=(avail[k]||0)+v;}
-  const running=D.tsum.running||0,
-        pending=(D.tsum.pending||0)+(D.tsum.queued||0)+
-                (D.tsum.waiting||0);
+  const ts=D.tsum.by_state||D.tsum;  // summarize_tasks nests states
+  const running=ts.running||0,
+        pending=(ts.pending||0)+(ts.queued||0)+(ts.waiting||0),
+        failed=D.tsum.failed||ts.failed||0;
   let t=`<div class="tiles">
     <div class="tile"><div class="v">${alive}</div>
       <div class="k">alive nodes</div></div>
@@ -150,6 +151,8 @@ function viewOverview(){
       <div class="k">running tasks</div></div>
     <div class="tile"><div class="v">${pending}</div>
       <div class="k">pending tasks</div></div>
+    <div class="tile"><div class="v">${failed}</div>
+      <div class="k">failed tasks</div></div>
     <div class="tile"><div class="v">${D.asum.alive||0}</div>
       <div class="k">alive actors</div></div>
     <div class="tile"><div class="v">${D.osum.total_objects||0}</div>

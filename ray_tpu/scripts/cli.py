@@ -325,7 +325,8 @@ def cmd_status(args) -> int:
             print(f"  {k}: {avail.get(k, 0):g}/{total[k]:g} available")
         from ray_tpu.util import state as state_api
 
-        print(f"tasks: {state_api.summarize_tasks()}")
+        tasks = state_api.summarize_tasks()
+        print(f"tasks: {tasks['by_state']} ({tasks['failed']} failed)")
         print(f"actors: {state_api.summarize_actors()}")
         return 0
     finally:
@@ -453,6 +454,100 @@ def cmd_memory(args) -> int:
         print(f"\n{len(rows)} objects, {total / 1e6:.2f} MB total")
         for where, (n, size) in sorted(by_where.items()):
             print(f"  {where}: {n} objects, {size / 1e6:.2f} MB")
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def _format_event(e) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(e.get("ts", 0)))
+    node = (e.get("node_id") or "")[:8] or "-"
+    msg = e.get("message", "")
+    return (f"{ts} {e.get('severity', '?'):7s} {e.get('source', '?'):12s} "
+            f"node={node} {msg}")
+
+
+def cmd_events(args) -> int:
+    """Aggregated cluster event log (ref: `ray list cluster-events`),
+    optionally following new events live off the pubsub channel."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.util import state as state_api
+        from ray_tpu.util.pubsub import CLUSTER_EVENTS, Subscriber
+
+        # Subscribe BEFORE fetching the snapshot so events published in
+        # between land in the subscription queue instead of vanishing;
+        # overlap is deduped by event_id below.
+        sub = Subscriber(channels=[CLUSTER_EVENTS]) if args.follow else None
+        rows = state_api.list_cluster_events(
+            severity=args.severity, source=args.source, limit=args.limit
+        )
+        if args.json:
+            print(json.dumps(rows, indent=2, default=str))
+        else:
+            for e in rows:
+                print(_format_event(e))
+        if sub is None:
+            return 0
+        seen = {e.get("event_id") for e in rows}
+        try:
+            while True:
+                for ev in sub.poll(timeout=10.0):
+                    batch = ev["data"]
+                    if not isinstance(batch, list):
+                        batch = [batch]
+                    for e in batch:
+                        if e.get("event_id") in seen:
+                            continue
+                        if args.severity and \
+                                e.get("severity") != args.severity:
+                            continue
+                        if args.source and e.get("source") != args.source:
+                            continue
+                        print(json.dumps(e, default=str) if args.json
+                              else _format_event(e))
+                        sys.stdout.flush()
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            sub.close()
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_summary(args) -> int:
+    """Task/actor/object summaries including the retained failure
+    history (ref: `ray summary tasks`)."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.util import state as state_api
+
+        tasks = state_api.summarize_tasks()
+        if args.json:
+            print(json.dumps({
+                "tasks": tasks,
+                "actors": state_api.summarize_actors(),
+                "objects": state_api.summarize_objects(),
+            }, indent=2, default=str))
+            return 0
+        print(f"tasks: {tasks['total']} total, {tasks['failed']} failed")
+        for st, n in sorted(tasks["by_state"].items()):
+            print(f"  {st}: {n}")
+        if tasks["per_func"]:
+            print(f"{'FUNC':30} {'COUNT':>6} {'FAILED':>6} "
+                  f"{'MEAN(s)':>10} {'MAX(s)':>10}")
+            for name, f in sorted(tasks["per_func"].items()):
+                mean = (f"{f['mean_duration_s']:.4f}"
+                        if f["mean_duration_s"] is not None else "-")
+                mx = (f"{f['max_duration_s']:.4f}"
+                      if f["max_duration_s"] is not None else "-")
+                print(f"{name[:30]:30} {f['count']:>6} {f['failed']:>6} "
+                      f"{mean:>10} {mx:>10}")
+        print(f"actors: {state_api.summarize_actors()}")
+        objs = state_api.summarize_objects()
+        print(f"objects: {objs['total_objects']} "
+              f"({objs['total_size_bytes'] / 1e6:.2f} MB) "
+              f"by_location={objs['by_location']}")
         return 0
     finally:
         ray_tpu.shutdown()
@@ -598,6 +693,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="dump the Prometheus exposition text")
     _add_address(p)
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("events", help="aggregated cluster event log")
+    p.add_argument("--severity", default=None,
+                   choices=["DEBUG", "INFO", "WARNING", "ERROR", "FATAL"])
+    p.add_argument("--source", default=None,
+                   help="filter by event source (GCS, RAYLET, WORKER, "
+                        "TASK, ACTOR, OBJECT_STORE, AUTOSCALER, SERVE, "
+                        "JOB)")
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="stream new events as they are published")
+    p.add_argument("--json", action="store_true")
+    _add_address(p)
+    p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser("summary",
+                       help="task/actor/object summaries incl. failures")
+    p.add_argument("--json", action="store_true")
+    _add_address(p)
+    p.set_defaults(fn=cmd_summary)
 
     p = sub.add_parser("memory", help="per-object reference table")
     p.add_argument("--limit", type=int, default=50)

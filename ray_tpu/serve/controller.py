@@ -25,6 +25,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..util import events as cluster_events
+
 CONTROLLER_NAME = "__serve_controller__"
 CONTROLLER_MAX_CONCURRENCY = 16
 
@@ -143,12 +145,28 @@ class ServeControllerActor:
                 num_replicas = min(max(num_replicas, lo), hi)
             st.target_replicas = num_replicas
 
+        cluster_events.emit(
+            cluster_events.INFO, cluster_events.SERVE,
+            f"deployment '{name}' deploy: version={version} "
+            f"target={num_replicas}"
+            + ("" if fresh else f" (was {old_version or 'unversioned'})"),
+            custom_fields={"deployment": name, "version": version,
+                           "target_replicas": num_replicas,
+                           "fresh": fresh},
+        )
         if fresh or not st.replicas:
             new = self._start_replicas(st, num_replicas, version)
             with self._lock:
                 st.replicas = new
                 st.replica_versions = [version] * len(new)
                 self._bump_route(st)
+            cluster_events.emit(
+                cluster_events.INFO, cluster_events.SERVE,
+                f"deployment '{name}': {len(new)} replica(s) running "
+                f"(version {version})",
+                custom_fields={"deployment": name,
+                               "num_replicas": len(new)},
+            )
             return list(st.replicas)
 
         if old_version == version:
@@ -195,6 +213,13 @@ class ServeControllerActor:
             if victim is not None:
                 # Retired from the route set first; grace period lets
                 # in-flight calls drain before the actor dies.
+                cluster_events.emit(
+                    cluster_events.INFO, cluster_events.SERVE,
+                    f"deployment '{name}' rolling update: replaced one "
+                    f"replica with version {version}",
+                    custom_fields={"deployment": name,
+                                   "version": version},
+                )
                 self._drain_and_kill(victim)
         # Superseded: clean up the orphan we just made.
         for h in new:
@@ -296,6 +321,13 @@ class ServeControllerActor:
             st.upscale_since = None
             st.downscale_since = None
             st.target_replicas = desired
+        cluster_events.emit(
+            cluster_events.INFO, cluster_events.SERVE,
+            f"deployment '{name}' autoscale: {cur} -> {desired} "
+            f"replica(s) (outstanding={total})",
+            custom_fields={"deployment": name, "from": cur,
+                           "to": desired, "outstanding": total},
+        )
         self._converge_count(name)
 
     def _health_check_once(self, name: str) -> None:
@@ -325,6 +357,12 @@ class ServeControllerActor:
                 pass  # slow/busy is not dead
         if not dead:
             return
+        cluster_events.emit(
+            cluster_events.ERROR, cluster_events.SERVE,
+            f"deployment '{name}': {len(dead)} replica(s) died; evicting "
+            f"from the route set and starting replacements",
+            custom_fields={"deployment": name, "dead": len(dead)},
+        )
         dead_ids = {id(r) for r in dead}
         with self._lock:
             st = self._deployments.get(name)
@@ -434,6 +472,13 @@ class ServeControllerActor:
                 st.replica_versions = []
                 self._bump_route(st)
         if st is not None:
+            cluster_events.emit(
+                cluster_events.INFO, cluster_events.SERVE,
+                f"deployment '{name}' deleted "
+                f"({len(victims)} replica(s) retired)",
+                custom_fields={"deployment": name,
+                               "replicas": len(victims)},
+            )
             for h in victims:
                 self._kill_replica(h)
 

@@ -166,6 +166,11 @@ class _GenericHandler:
                     it = handle.options(method=method).stream(request)
                     for item in it:
                         yield _encode(item)
+                except GeneratorExit:
+                    # Client cancelled mid-stream: gRPC closes the
+                    # generator; an aborted partial stream is not an OK.
+                    status[0] = "CANCELLED"
+                    raise
                 except Exception as e:  # noqa: BLE001
                     status[0] = "INTERNAL"
                     context.abort(grpc.StatusCode.INTERNAL, str(e))

@@ -168,6 +168,10 @@ class _Handler(BaseHTTPRequestHandler):
         trace_id = parent[0] if parent else new_trace_id()
         span_id = new_span_id()
         prev = enter_span(trace_id, span_id)
+        # Per-request reset: the handler instance is reused across a
+        # keep-alive connection, so a request that dies before
+        # send_response must not inherit the previous request's status.
+        self._obs_status = 500
         started = time.time()
         try:
             self._route_request(name)

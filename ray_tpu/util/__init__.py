@@ -16,6 +16,7 @@ from ray_tpu.core.scheduling_strategies import (  # noqa: F401
     PlacementGroupSchedulingStrategy,
 )
 from . import check_serialize  # noqa: F401
+from . import events  # noqa: F401
 from . import iter  # noqa: F401
 from . import metrics  # noqa: F401
 from . import multiprocessing  # noqa: F401
@@ -28,6 +29,7 @@ from . import queue  # noqa: F401
 __all__ = [
     "state",
     "pubsub",
+    "events",
     "ActorPool",
     "queue",
     "PlacementGroup",

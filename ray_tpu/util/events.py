@@ -1,0 +1,367 @@
+"""Structured cluster events + failure-history plane.
+
+Ref analogue: the reference's export-event / RAY_LOG channel (ref:
+src/ray/gcs/gcs_server pubsub RAY_LOG + python/ray/util/state
+list_cluster_events): every process records typed lifecycle events
+(node register/death, worker crash, task failure, actor restart, OOM
+kills, autoscaler and serve decisions) into a bounded per-process ring
+buffer; a flusher thread publishes batches through the GCS pubsub
+(channel ``cluster_events``) to the head-side aggregator
+(:class:`EventStore`), which keeps a bounded severity-indexed store and
+an optional JSONL export sink for external collectors.
+
+Emit sites call :func:`emit` with a severity and source from the
+declared enums below — ``tools/check_metric_names.py`` (the
+observability lint, ``make check-obs``) statically validates both at
+every call site.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.pubsub import CLUSTER_EVENTS  # noqa: F401 — re-exported
+
+# --------------------------------------------------------------- enums
+
+# Severities (ref: export_event.proto severity levels).
+DEBUG = "DEBUG"
+INFO = "INFO"
+WARNING = "WARNING"
+ERROR = "ERROR"
+FATAL = "FATAL"
+SEVERITIES = (DEBUG, INFO, WARNING, ERROR, FATAL)
+
+# Event sources (ref analogue: SourceType in export_event.proto — which
+# subsystem recorded the event).
+GCS = "GCS"
+RAYLET = "RAYLET"
+WORKER = "WORKER"
+TASK = "TASK"
+ACTOR = "ACTOR"
+OBJECT_STORE = "OBJECT_STORE"
+AUTOSCALER = "AUTOSCALER"
+SERVE = "SERVE"
+JOB = "JOB"
+SOURCES = (GCS, RAYLET, WORKER, TASK, ACTOR, OBJECT_STORE, AUTOSCALER,
+           SERVE, JOB)
+
+FLUSH_INTERVAL_S = 0.25
+
+
+def make_event(severity: str, source: str, message: str, *,
+               node_id: Optional[str] = None,
+               job_id: Optional[str] = None,
+               task_id: Optional[str] = None,
+               actor_id: Optional[str] = None,
+               custom_fields: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, Any]:
+    """Build one typed event record. Severity/source must come from the
+    declared enums — unknown values raise so emit sites stay lintable."""
+    if severity not in SEVERITIES:
+        raise ValueError(
+            f"unknown event severity {severity!r} (one of {SEVERITIES})"
+        )
+    if source not in SOURCES:
+        raise ValueError(
+            f"unknown event source {source!r} (one of {SOURCES})"
+        )
+    return {
+        "event_id": uuid.uuid4().hex[:16],
+        "ts": time.time(),
+        "severity": severity,
+        "source": source,
+        "message": message,
+        "node_id": node_id,
+        "job_id": job_id,
+        "task_id": task_id,
+        "actor_id": actor_id,
+        "pid": os.getpid(),
+        "custom_fields": dict(custom_fields or {}),
+    }
+
+
+# ---------------------------------------------------- per-process buffer
+
+
+class EventBuffer:
+    """Bounded ring of not-yet-published events. A producer that outruns
+    the flusher loses OLDEST events first and the drop is counted, never
+    silent (same contract as the pubsub subscriber queues)."""
+
+    def __init__(self, maxlen: int = 1000):
+        self._lock = threading.Lock()
+        self._pending: deque = deque(maxlen=maxlen)
+        self._dropped = 0
+
+    def append(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._pending) == self._pending.maxlen:
+                self._dropped += 1
+            self._pending.append(event)
+
+    def drain(self) -> Tuple[List[Dict[str, Any]], int]:
+        """Pop everything buffered; returns (events, dropped-since-last)."""
+        with self._lock:
+            events = list(self._pending)
+            self._pending.clear()
+            dropped, self._dropped = self._dropped, 0
+        return events, dropped
+
+    def requeue(self, events: List[Dict[str, Any]]) -> None:
+        """Put a drained-but-unpublished batch back at the FRONT (the
+        publish failed): order is preserved against newer emits, and any
+        overflow drops oldest-first with the drop counted."""
+        with self._lock:
+            merged = list(events) + list(self._pending)
+            overflow = max(0, len(merged) - (self._pending.maxlen or 0))
+            if overflow:
+                self._dropped += overflow
+                merged = merged[overflow:]
+            self._pending = deque(merged, maxlen=self._pending.maxlen)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+
+class _Emitter:
+    """Module singleton: buffer + lazy flusher thread + transport."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # Serializes flush(): the periodic flusher and explicit flush()
+        # callers (worker failure paths) must not interleave drains, or
+        # batches publish out of order.
+        self._flush_lock = threading.Lock()
+        self._buffer: Optional[EventBuffer] = None
+        self._flusher: Optional[threading.Thread] = None
+        # Installed by a node manager living in this process; publishes a
+        # batch on its own loop (node-manager processes have no driver
+        # runtime to route through).
+        self._publish_hook = None
+
+    def buffer(self) -> EventBuffer:
+        with self.lock:
+            if self._buffer is None:
+                from ..core.config import get_config
+
+                size = getattr(get_config(), "event_buffer_size", 1000)
+                self._buffer = EventBuffer(maxlen=size)
+            return self._buffer
+
+    def ensure_flusher(self):
+        with self.lock:
+            if self._flusher is None:
+                self._flusher = threading.Thread(
+                    target=self._flush_loop, name="ray_tpu-event-flusher",
+                    daemon=True,
+                )
+                self._flusher.start()
+                atexit.register(self.flush)
+
+    def _flush_loop(self):
+        while True:
+            time.sleep(FLUSH_INTERVAL_S)
+            try:
+                self.flush()
+            except Exception:
+                pass
+
+    def flush(self):
+        with self._flush_lock:
+            self._flush_locked()
+
+    def _flush_locked(self):
+        from ..core import runtime_context
+
+        if self._buffer is None:
+            return
+        hook = self._publish_hook
+        rt = runtime_context.current_runtime_or_none()
+        if hook is None and rt is None:
+            # No transport yet (runtime/hook not installed): keep events
+            # in the ring — it bounds retention and counts drops — so
+            # they publish once a connection exists.
+            return
+        batch, dropped = self._buffer.drain()
+        if dropped:
+            batch.append(make_event(
+                WARNING, WORKER,
+                f"event buffer overflow: {dropped} event(s) dropped in "
+                f"pid {os.getpid()}",
+                custom_fields={"dropped": dropped},
+            ))
+        if not batch:
+            return
+        if hook is not None:
+            try:
+                hook(batch)
+                return
+            except Exception:
+                pass  # hook's node manager shut down; try the runtime
+        if rt is None:
+            self._buffer.requeue(batch)
+            return
+        try:
+            rt.pubsub_op({
+                "op": "publish", "channel": CLUSTER_EVENTS, "data": batch,
+            })
+        except Exception:
+            self._buffer.requeue(batch)
+
+
+_emitter = _Emitter()
+
+
+def emit(severity: str, source: str, message: str, *,
+         node_id: Optional[str] = None,
+         job_id: Optional[str] = None,
+         task_id: Optional[str] = None,
+         actor_id: Optional[str] = None,
+         custom_fields: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Record one cluster event; returns the event dict. Buffered
+    locally and published to the head aggregator within
+    ``FLUSH_INTERVAL_S`` (best effort: a process with no cluster
+    connection keeps events in its ring only)."""
+    if node_id is None:
+        from ..core import runtime_context
+
+        rt = runtime_context.current_runtime_or_none()
+        if rt is not None and getattr(rt, "node_id", None) is not None:
+            node_id = rt.node_id.hex()
+    event = make_event(
+        severity, source, message, node_id=node_id, job_id=job_id,
+        task_id=task_id, actor_id=actor_id, custom_fields=custom_fields,
+    )
+    _emitter.buffer().append(event)
+    _emitter.ensure_flusher()
+    return event
+
+
+def flush() -> None:
+    """Publish anything buffered now (tests / shutdown paths)."""
+    _emitter.flush()
+
+
+def set_publish_hook(hook) -> None:
+    """Install the process's publish transport (called by the node
+    manager: batches go out on its loop via the GCS handle)."""
+    _emitter._publish_hook = hook
+
+
+def clear_publish_hook(hook) -> None:
+    """Remove ``hook`` if it is still the installed one (a second node
+    manager in the same process may have replaced it)."""
+    if _emitter._publish_hook == hook:  # == : bound methods compare by
+        _emitter._publish_hook = None   # (instance, func), `is` would not
+
+
+# ------------------------------------------------------ head aggregator
+
+
+class EventStore:
+    """Head-side bounded, severity-indexed event store (ref analogue:
+    the GCS-side buffer behind `ray list cluster-events`). Optionally
+    mirrors every event to a JSONL sink for external collectors."""
+
+    def __init__(self, maxlen: int = 10_000, jsonl_path: str = ""):
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=maxlen)
+        self._by_severity: Dict[str, deque] = {
+            sev: deque(maxlen=maxlen) for sev in SEVERITIES
+        }
+        self._seq = 0
+        self._total = 0
+        self._dropped = 0
+        self._jsonl_path = jsonl_path
+        self._jsonl_file = None
+
+    def add(self, event: Dict[str, Any]) -> None:
+        self.add_batch([event])
+
+    def add_batch(self, events: List[Dict[str, Any]]) -> None:
+        """Ingest a batch under one lock acquisition with a single JSONL
+        flush at the end (per-event flushes would stall the GCS loop the
+        aggregator runs on during event bursts)."""
+        with self._lock:
+            wrote = False
+            for event in events:
+                if not isinstance(event, dict):
+                    continue
+                self._seq += 1
+                self._total += 1
+                event = dict(event)
+                event["seq"] = self._seq
+                self._events.append(event)
+                index = self._by_severity.get(event.get("severity"))
+                if index is not None:
+                    index.append(event)
+                if self._jsonl_path:
+                    wrote |= self._write_jsonl(event)
+            if wrote and self._jsonl_file is not None:
+                try:
+                    self._jsonl_file.flush()
+                except Exception:
+                    self._jsonl_path = ""
+
+    def note_dropped(self, n: int) -> None:
+        with self._lock:
+            self._dropped += n
+
+    def _write_jsonl(self, event: Dict[str, Any]) -> bool:
+        # Caller holds the lock; flushing is the caller's (batched) job.
+        try:
+            if self._jsonl_file is None:
+                d = os.path.dirname(self._jsonl_path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._jsonl_file = open(self._jsonl_path, "a")
+            self._jsonl_file.write(json.dumps(event, default=str) + "\n")
+            return True
+        except Exception:
+            self._jsonl_path = ""  # sink broke: stop retrying per event
+            return False
+
+    def list(self, severity: Optional[str] = None,
+             source: Optional[str] = None,
+             limit: int = 1000) -> List[Dict[str, Any]]:
+        """Events oldest-first, optionally filtered; ``limit`` keeps the
+        NEWEST matches (you page backwards through history)."""
+        with self._lock:
+            if severity is not None:
+                rows = list(self._by_severity.get(severity, ()))
+            else:
+                rows = list(self._events)
+        if source is not None:
+            rows = [e for e in rows if e.get("source") == source]
+        if limit and limit > 0:
+            rows = rows[-limit:]
+        return rows
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "total": self._total,
+                "stored": len(self._events),
+                "dropped": self._dropped,
+                "by_severity": {
+                    sev: len(q) for sev, q in self._by_severity.items()
+                },
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._jsonl_file is not None:
+                try:
+                    self._jsonl_file.close()
+                except Exception:
+                    pass
+                self._jsonl_file = None

@@ -17,7 +17,13 @@ from __future__ import annotations
 import uuid
 from typing import Any, Dict, List, Optional
 
-from ..core.pubsub import ACTOR_STATE, ERROR_INFO, LOGS, NODE_STATE  # noqa: F401
+from ..core.pubsub import (  # noqa: F401
+    ACTOR_STATE,
+    CLUSTER_EVENTS,
+    ERROR_INFO,
+    LOGS,
+    NODE_STATE,
+)
 
 
 def _runtime():

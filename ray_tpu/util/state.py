@@ -2,12 +2,15 @@
 
 Ref analogue: python/ray/util/state/api.py (list_tasks / list_actors /
 list_objects / list_nodes / list_workers / list_placement_groups /
-summarize_*). Backed by a fan-out state query: the local node manager
-merges its own live tables with a ``state_snapshot`` peer RPC to every
-alive node (api.py:1473's StateApiClient → raylet/GCS sources).
+list_cluster_events / summarize_*). Backed by a fan-out state query: the
+local node manager merges its own live tables (plus its bounded
+terminal-task history) with a ``state_snapshot`` peer RPC to every
+alive node (api.py:1473's StateApiClient → raylet/GCS sources);
+cluster events come from the head GCS's aggregated event store.
 
 Every ``list_*`` takes ``filters``: a list of (key, predicate, value)
 tuples with predicate "=" or "!=" (the reference's filter syntax).
+Unsupported predicates raise ``ValueError`` uniformly.
 """
 
 from __future__ import annotations
@@ -19,11 +22,8 @@ from ..core import runtime_context
 Filter = Tuple[str, str, Any]
 
 
-def _query(kind: str, filters: Optional[List[Filter]],
-           limit: int) -> List[Dict[str, Any]]:
-    rt = runtime_context.current_runtime()
-    state = rt.cluster_state()
-    rows = state.get(kind, [])
+def _apply_filters(rows: List[Dict[str, Any]],
+                   filters: Optional[List[Filter]]) -> List[Dict[str, Any]]:
     for key, pred, value in filters or []:
         if pred == "=":
             rows = [r for r in rows if r.get(key) == value]
@@ -31,13 +31,23 @@ def _query(kind: str, filters: Optional[List[Filter]],
             rows = [r for r in rows if r.get(key) != value]
         else:
             raise ValueError(f"unsupported filter predicate {pred!r}")
+    return rows
+
+
+def _query(kind: str, filters: Optional[List[Filter]],
+           limit: int) -> List[Dict[str, Any]]:
+    rt = runtime_context.current_runtime()
+    state = rt.cluster_state()
+    rows = _apply_filters(state.get(kind, []), filters)
     return rows[:limit]
 
 
 def list_tasks(filters: Optional[List[Filter]] = None,
                limit: int = 10_000) -> List[Dict[str, Any]]:
-    """Live task records across the cluster (queued/running/finished-
-    retained; ref: list_tasks)."""
+    """Task records across the cluster: queued/running live rows plus
+    the bounded terminal history (``retained=True`` rows carry
+    state/duration/error_type/error_message after the live record is
+    gone; ref: list_tasks over the task-event buffer)."""
     return _query("tasks", filters, limit)
 
 
@@ -60,28 +70,66 @@ def list_nodes(filters: Optional[List[Filter]] = None,
                limit: int = 10_000) -> List[Dict[str, Any]]:
     import ray_tpu
 
-    rows = ray_tpu.nodes()
-    for key, pred, value in filters or []:
-        if pred == "=":
-            rows = [r for r in rows if r.get(key) == value]
-        elif pred == "!=":
-            rows = [r for r in rows if r.get(key) != value]
+    rows = _apply_filters(ray_tpu.nodes(), filters)
     return rows[:limit]
 
 
-def list_placement_groups(limit: int = 10_000) -> List[Dict[str, Any]]:
+def list_placement_groups(filters: Optional[List[Filter]] = None,
+                          limit: int = 10_000) -> List[Dict[str, Any]]:
     import ray_tpu
 
     table = ray_tpu.util.placement_group_table()
-    return list(table.values())[:limit]
+    rows = _apply_filters(list(table.values()), filters)
+    return rows[:limit]
 
 
-def summarize_tasks() -> Dict[str, int]:
-    """Task counts by state (ref: summarize_tasks)."""
-    out: Dict[str, int] = {}
-    for t in list_tasks():
-        out[t["state"]] = out.get(t["state"], 0) + 1
-    return out
+def list_cluster_events(filters: Optional[List[Filter]] = None,
+                        severity: Optional[str] = None,
+                        source: Optional[str] = None,
+                        limit: int = 1000) -> List[Dict[str, Any]]:
+    """Aggregated cluster events from the head's severity-indexed store
+    (ref: `ray list cluster-events`). ``severity``/``source`` filter
+    server-side; ``filters`` apply the standard (key, pred, value)
+    syntax on top."""
+    rt = runtime_context.current_runtime()
+    reply = rt.list_cluster_events(severity=severity, source=source,
+                                   limit=limit)
+    return _apply_filters(reply["events"], filters)
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Task summary (ref: summarize_tasks): counts by state — including
+    the retained failure history — plus per-function duration stats for
+    terminal tasks."""
+    by_state: Dict[str, int] = {}
+    per_func: Dict[str, Dict[str, Any]] = {}
+    tasks = list_tasks()
+    for t in tasks:
+        by_state[t["state"]] = by_state.get(t["state"], 0) + 1
+        name = t.get("name") or "task"
+        f = per_func.setdefault(name, {
+            "count": 0, "failed": 0, "duration_count": 0,
+            "duration_sum_s": 0.0, "max_duration_s": 0.0,
+        })
+        f["count"] += 1
+        if t["state"] == "failed":
+            f["failed"] += 1
+        dur = t.get("duration_s")
+        if dur is not None:
+            f["duration_count"] += 1
+            f["duration_sum_s"] += dur
+            f["max_duration_s"] = max(f["max_duration_s"], dur)
+    for f in per_func.values():
+        n = f.pop("duration_count")
+        total = f.pop("duration_sum_s")
+        f["mean_duration_s"] = round(total / n, 6) if n else None
+        f["max_duration_s"] = round(f["max_duration_s"], 6) if n else None
+    return {
+        "total": len(tasks),
+        "by_state": by_state,
+        "failed": by_state.get("failed", 0),
+        "per_func": per_func,
+    }
 
 
 def summarize_actors() -> Dict[str, int]:
@@ -95,7 +143,9 @@ def summarize_objects() -> Dict[str, Any]:
     objs = list_objects()
     return {
         "total_objects": len(objs),
-        "total_size_bytes": sum(o["size_bytes"] for o in objs),
+        # In-flight/spilled rows may have no size yet: count them as 0
+        # instead of blowing up the whole summary.
+        "total_size_bytes": sum(o.get("size_bytes") or 0 for o in objs),
         "by_location": {
             where: sum(1 for o in objs if o["where"] == where)
             for where in {o["where"] for o in objs}

@@ -1,0 +1,321 @@
+"""Cluster event log + failure-history plane (ref analogue: the state
+API's cluster-event tests + task-event buffer retention tests):
+emission → pubsub → aggregator ordering, ring-buffer bounds, terminal
+task retention, severity/source filters, JSONL sink round-trip, and the
+state-API satellites."""
+
+import json
+import os
+import time
+import uuid
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import events
+from ray_tpu.util import state as state_api
+
+
+def _poll(fn, timeout=12.0, interval=0.2):
+    """Poll fn() until truthy (events flush on a 0.25s cadence and hop
+    through the pubsub aggregator)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval)
+    return fn()
+
+
+# ------------------------------------------------------- event primitives
+
+
+def test_event_buffer_bounds_and_drop_counting():
+    """Ring buffer keeps the NEWEST maxlen events and counts drops."""
+    buf = events.EventBuffer(maxlen=3)
+    for i in range(5):
+        buf.append(events.make_event(events.INFO, events.TASK, f"m{i}"))
+    assert len(buf) == 3
+    batch, dropped = buf.drain()
+    assert dropped == 2
+    assert [e["message"] for e in batch] == ["m2", "m3", "m4"]
+    # Drain resets both the buffer and the drop counter.
+    assert buf.drain() == ([], 0)
+
+
+def test_make_event_validates_enums():
+    """Unknown severity/source raise (the lint checks the same enums
+    statically at emit sites)."""
+    with pytest.raises(ValueError, match="severity"):
+        events.make_event("LOUD", events.TASK, "x")
+    with pytest.raises(ValueError, match="source"):
+        events.make_event(events.INFO, "KERNEL", "x")
+    e = events.make_event(events.WARNING, events.SERVE, "ok",
+                          custom_fields={"k": 1})
+    assert e["severity"] == "WARNING" and e["source"] == "SERVE"
+    assert e["custom_fields"] == {"k": 1} and e["event_id"]
+
+
+def test_event_store_bounded_and_severity_indexed():
+    store = events.EventStore(maxlen=5)
+    for i in range(8):
+        sev = events.ERROR if i % 2 else events.INFO
+        store.add(events.make_event(sev, events.GCS, f"m{i}"))
+    msgs = [e["message"] for e in store.list()]
+    assert msgs == [f"m{i}" for i in range(3, 8)]  # oldest aged out
+    errs = [e["message"] for e in store.list(severity=events.ERROR)]
+    assert errs == ["m1", "m3", "m5", "m7"]  # index keeps its own window
+    assert store.stats()["total"] == 8
+    assert [e["message"] for e in store.list(limit=2)] == ["m6", "m7"]
+
+
+def test_event_store_jsonl_sink_round_trip(tmp_path):
+    """Every aggregated event lands in the JSONL export sink and parses
+    back with its fields intact."""
+    path = str(tmp_path / "exports" / "events.jsonl")
+    store = events.EventStore(maxlen=100, jsonl_path=path)
+    sent = [
+        events.make_event(events.INFO, events.GCS, "a"),
+        events.make_event(events.ERROR, events.TASK, "b",
+                          task_id="t1", custom_fields={"error_type": "X"}),
+        events.make_event(events.WARNING, events.AUTOSCALER, "c"),
+    ]
+    for e in sent:
+        store.add(e)
+    store.close()
+    with open(path) as f:
+        lines = [json.loads(line) for line in f]
+    assert [e["message"] for e in lines] == ["a", "b", "c"]
+    assert lines[1]["severity"] == "ERROR"
+    assert lines[1]["task_id"] == "t1"
+    assert lines[1]["custom_fields"] == {"error_type": "X"}
+    assert [e["event_id"] for e in lines] == [e["event_id"] for e in sent]
+
+
+# --------------------------------------------------- end-to-end pipeline
+
+
+def test_emission_pubsub_aggregator_ordering(ray_tpu_start):
+    """Events emitted in order arrive at the head store in order (the
+    pubsub seq is the store order)."""
+    marker = uuid.uuid4().hex[:8]
+    for i in range(5):
+        events.emit(events.INFO, events.JOB, f"ordered-{marker}-{i}")
+    events.flush()
+
+    def got():
+        evs = [e for e in state_api.list_cluster_events(source="JOB")
+               if marker in e["message"]]
+        return evs if len(evs) == 5 else None
+
+    evs = _poll(got)
+    assert [e["message"].rsplit("-", 1)[1] for e in evs] == \
+        [str(i) for i in range(5)]
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)
+    # The emitting process's node id was stamped on.
+    assert all(e["node_id"] for e in evs)
+
+
+def test_list_cluster_events_severity_source_filters(ray_tpu_start):
+    marker = uuid.uuid4().hex[:8]
+    events.emit(events.ERROR, events.JOB, f"f-{marker}-err")
+    events.emit(events.INFO, events.JOB, f"f-{marker}-info")
+    events.flush()
+    _poll(lambda: len([e for e in state_api.list_cluster_events(
+        source="JOB") if marker in e["message"]]) == 2 or None)
+    errs = [e for e in state_api.list_cluster_events(severity="ERROR")
+            if marker in e["message"]]
+    assert [e["message"] for e in errs] == [f"f-{marker}-err"]
+    # Generic (key, pred, value) filters compose on top.
+    infos = [e for e in state_api.list_cluster_events(
+        source="JOB", filters=[("severity", "!=", "ERROR")])
+        if marker in e["message"]]
+    assert [e["message"] for e in infos] == [f"f-{marker}-info"]
+    with pytest.raises(ValueError):
+        state_api.list_cluster_events(filters=[("severity", ">", "X")])
+
+
+def test_failed_task_retained_with_error_and_event(ray_tpu_start):
+    """Acceptance: a deliberately failing task yields (1) a retained
+    list_tasks row with error type/message after the live record is
+    gone, (2) a severity-ERROR cluster event, (3) failed counts +
+    per-function duration stats in summarize_tasks."""
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom-xyz")
+
+    @ray_tpu.remote
+    def fine():
+        return 1
+
+    assert ray_tpu.get(fine.remote(), timeout=30) == 1
+    with pytest.raises(Exception, match="kaboom-xyz"):
+        ray_tpu.get(boom.remote(), timeout=30)
+
+    row = _poll(lambda: next(
+        (t for t in state_api.list_tasks()
+         if t.get("retained") and t["state"] == "failed"
+         and t["name"] == "boom"), None))
+    assert row["error_type"] == "ValueError", row
+    assert "kaboom-xyz" in row["error_message"], row
+    assert row["duration_s"] is not None
+    # The live table no longer carries it; only the retained row does.
+    live = [t for t in state_api.list_tasks()
+            if t["name"] == "boom" and not t.get("retained")]
+    assert not live
+
+    ev = _poll(lambda: next(
+        (e for e in state_api.list_cluster_events(severity="ERROR")
+         if e["source"] == "TASK" and "boom" in e["message"]), None))
+    assert "ValueError" in ev["message"]
+    assert ev["task_id"] == row["task_id"]
+    assert "traceback" in ev["custom_fields"]  # provenance travels along
+
+    summ = state_api.summarize_tasks()
+    assert summ["failed"] >= 1
+    assert summ["by_state"]["failed"] >= 1
+    f = summ["per_func"]["boom"]
+    assert f["count"] == 1 and f["failed"] == 1
+    assert f["mean_duration_s"] is not None
+    assert summ["per_func"]["fine"]["failed"] == 0
+
+
+def test_killed_worker_crash_event_and_history(ray_tpu_start):
+    """Acceptance: a killed worker produces a severity-ERROR WORKER
+    event carrying the exit code, and the interrupted task is retained
+    as failed with WorkerCrashedError."""
+
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(17)
+
+    with pytest.raises(Exception):
+        ray_tpu.get(die.remote(), timeout=30)
+
+    row = _poll(lambda: next(
+        (t for t in state_api.list_tasks()
+         if t.get("retained") and t["name"] == "die"), None))
+    assert row["state"] == "failed"
+    assert row["error_type"] == "WorkerCrashedError", row
+    assert row["retries_left"] == 0 and row["retry_count"] == 0, row
+
+    wev = _poll(lambda: next(
+        (e for e in state_api.list_cluster_events(severity="ERROR")
+         if e["source"] == "WORKER" and "crashed" in e["message"]), None))
+    assert wev["custom_fields"]["exit_code"] == 17, wev
+    tev = next(
+        (e for e in state_api.list_cluster_events(severity="ERROR")
+         if e["source"] == "TASK" and "die" in e["message"]), None)
+    assert tev is not None
+
+
+def test_dashboard_events_route(ray_tpu_start):
+    """/api/events serves the aggregated store with query filters."""
+    import urllib.request
+
+    from ray_tpu import dashboard
+
+    marker = uuid.uuid4().hex[:8]
+    events.emit(events.ERROR, events.JOB, f"dash-{marker}")
+    events.flush()
+    _poll(lambda: [e for e in state_api.list_cluster_events(source="JOB")
+                   if marker in e["message"]] or None)
+    port = dashboard.start_dashboard(port=0)
+    try:
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+                return json.loads(r.read())
+
+        evs = fetch("/api/events?severity=ERROR&source=JOB")["events"]
+        assert any(marker in e["message"] for e in evs), evs
+        assert all(e["severity"] == "ERROR" for e in evs)
+        assert fetch("/api/events?limit=1")["events"]
+    finally:
+        dashboard.stop_dashboard()
+
+
+# ------------------------------------------------------ state satellites
+
+
+def test_list_nodes_rejects_unknown_predicate(ray_tpu_start):
+    """list_nodes now matches _query: unsupported predicates raise
+    instead of silently returning unfiltered rows."""
+    assert state_api.list_nodes(filters=[("Alive", "=", True)])
+    with pytest.raises(ValueError, match="predicate"):
+        state_api.list_nodes(filters=[("Alive", ">", 0)])
+
+
+def test_list_placement_groups_accepts_filters(ray_tpu_start):
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=10)
+    try:
+        rows = state_api.list_placement_groups()
+        assert rows
+        created = state_api.list_placement_groups(
+            filters=[("state", "=", "created")]
+        )
+        assert created
+        assert state_api.list_placement_groups(
+            filters=[("state", "=", "no_such_state")]
+        ) == []
+        with pytest.raises(ValueError):
+            state_api.list_placement_groups(filters=[("state", "~", "x")])
+    finally:
+        remove_placement_group(pg)
+
+
+def test_summarize_objects_tolerates_missing_sizes(ray_tpu_start,
+                                                   monkeypatch):
+    """In-flight/spilled rows with size_bytes=None count as 0 instead of
+    raising TypeError."""
+    rows = [
+        {"object_id": "a", "size_bytes": 10, "where": "inline"},
+        {"object_id": "b", "size_bytes": None, "where": "spilled"},
+        {"object_id": "c", "where": "remote"},  # key absent entirely
+    ]
+    monkeypatch.setattr(state_api, "list_objects", lambda: rows)
+    out = state_api.summarize_objects()
+    assert out["total_objects"] == 3
+    assert out["total_size_bytes"] == 10
+    assert out["by_location"] == {"inline": 1, "spilled": 1, "remote": 1}
+
+
+def test_log_monitor_caches_pid_lookup(tmp_path):
+    """_pid_for resolves via the worker table once, then serves the
+    cached pid (the rescan was O(files x workers) every 200 ms)."""
+    from ray_tpu.core.ids import WorkerID
+    from ray_tpu.core.log_monitor import LogMonitor
+
+    class _Proc:
+        pid = 4242
+
+    class _Handle:
+        proc = _Proc()
+
+    class _NodeID:
+        @staticmethod
+        def hex():
+            return "ab" * 16
+
+    wid = WorkerID.from_random()
+
+    class _NM:
+        node_id = _NodeID()
+        _workers = {wid: _Handle()}
+
+    mon = LogMonitor(str(tmp_path), node_manager=_NM())
+    path = os.path.join(str(tmp_path), "logs",
+                        f"worker-{wid.hex()[:8]}.log")
+    assert mon._pid_for(path) == "4242"
+    # Worker left the table (exited): the resolved pid must survive.
+    _NM._workers.clear()
+    assert mon._pid_for(path) == "4242"
+    # An unknown file stays unresolved (and uncached).
+    other = os.path.join(str(tmp_path), "logs", "worker-deadbeef.log")
+    assert mon._pid_for(other) == "?"

@@ -1,8 +1,9 @@
 #!/usr/bin/env python3
-"""CI lint: validate every metric the package declares at import time.
+"""CI observability lint: metric declarations + cluster-event emit sites.
 
-Imports each ray_tpu submodule (so module-level Counter/Gauge/Histogram
-singletons register in util.metrics' declaration table), then fails on:
+Metric pass — imports each ray_tpu submodule (so module-level
+Counter/Gauge/Histogram singletons register in util.metrics' declaration
+table), then fails on:
 
 - Prometheus-invalid metric names (must match
   ``[a-zA-Z_:][a-zA-Z0-9_:]*``);
@@ -11,11 +12,21 @@ singletons register in util.metrics' declaration table), then fails on:
 - the same name registered under two conflicting kinds (the series
   would be corrupted — see util/metrics._Registry.declare).
 
-Run via ``make check-metrics`` or directly. Exits non-zero on failure.
+Event pass — statically scans every ``*.py`` under ray_tpu/ for
+``<events-alias>.emit(...)`` / ``make_event(...)`` calls and validates
+that the severity and source arguments resolve to the enums declared in
+``ray_tpu/util/events.py`` (attribute refs like ``events.ERROR``,
+string literals, and either branch of a conditional expression all
+resolve; an unknown name at an emit site would silently produce a
+ValueError at runtime instead).
+
+Run via ``make check-obs`` (``check-metrics`` is kept as an alias) or
+directly. Exits non-zero on failure.
 """
 
 from __future__ import annotations
 
+import ast
 import importlib
 import os
 import pkgutil
@@ -72,8 +83,89 @@ def validate(declared, conflicts):
     return failures
 
 
+# Module aliases under which ray_tpu code imports util/events.
+_EVENT_ALIASES = ("events", "cluster_events", "_events")
+
+
+def _resolve_enum_arg(node):
+    """Static values an emit-site argument can take: a set of strings,
+    or None when the expression cannot be resolved (a plain variable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id in _EVENT_ALIASES:
+        return {node.attr}
+    if isinstance(node, ast.IfExp):
+        a = _resolve_enum_arg(node.body)
+        b = _resolve_enum_arg(node.orelse)
+        if a is not None and b is not None:
+            return a | b
+        return None
+    return None
+
+
+def _iter_emit_calls(tree):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "emit" and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in _EVENT_ALIASES:
+            yield node
+        elif isinstance(fn, ast.Name) and fn.id == "make_event":
+            yield node
+
+
+def validate_event_sites(pkg_dir, severities, sources):
+    """Return (failures, checked_count) for every events.emit /
+    make_event call under ``pkg_dir``."""
+    failures = []
+    checked = 0
+    for root, _dirs, files in os.walk(pkg_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except SyntaxError as e:
+                failures.append(f"{path}: unparseable ({e})")
+                continue
+            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+            for call in _iter_emit_calls(tree):
+                checked += 1
+                where = f"{rel}:{call.lineno}"
+                args = call.args
+                kwargs = {k.arg: k.value for k in call.keywords if k.arg}
+                for idx, (label, allowed) in enumerate(
+                        (("severity", severities), ("source", sources))):
+                    if idx < len(args):
+                        arg = args[idx]
+                    elif label in kwargs:
+                        arg = kwargs[label]
+                    else:
+                        failures.append(
+                            f"{where}: emit() missing {label} argument"
+                        )
+                        continue
+                    values = _resolve_enum_arg(arg)
+                    if values is None:
+                        continue  # dynamic expression: runtime-checked
+                    for v in values - set(allowed):
+                        failures.append(
+                            f"{where}: {label} {v!r} is not a declared "
+                            f"event {label} (one of {sorted(allowed)})"
+                        )
+    return failures, checked
+
+
 def main() -> int:
     skipped = import_package_modules()
+    from ray_tpu.util.events import SEVERITIES, SOURCES
     from ray_tpu.util.metrics import (
         declaration_conflicts,
         declared_metrics,
@@ -85,11 +177,19 @@ def main() -> int:
         print(f"skip {name}: {err}", file=sys.stderr)
     print(f"checked {len(declared)} declared metric(s), "
           f"{len(skipped)} module(s) skipped")
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    event_failures, n_sites = validate_event_sites(
+        os.path.join(repo_root, "ray_tpu"), SEVERITIES, SOURCES
+    )
+    failures += event_failures
+    print(f"checked {n_sites} event emit site(s)")
+
     if failures:
         for f in failures:
             print(f"FAIL {f}", file=sys.stderr)
         return 1
-    print("metric names OK")
+    print("observability lint OK")
     return 0
 
 
