@@ -167,6 +167,15 @@ class Config:
     # Terminal task records (state/duration/error) each node retains for
     # the state API after the live record is dropped (failure history).
     task_history_size: int = 1000
+    # --- profiling & hang diagnosis (ref analogue: `ray stack` + the
+    # dashboard reporter's profile_manager) -------------------------------
+    # A task running longer than this (seconds) gets its worker's stack
+    # captured and a WARNING cluster event emitted, once per task run
+    # (<= 0 disables the hang/straggler detector).
+    hang_task_warn_s: float = 600.0
+    # Hard cap on dashboard /api/profile sampling duration (seconds);
+    # the sampler itself clamps to util/profiler.MAX_SAMPLE_SECONDS.
+    profile_max_seconds: float = 15.0
 
     def __post_init__(self):
         for f in dataclasses.fields(self):

@@ -145,6 +145,20 @@ GCS_SERVICES = (
                reply=(("events", "list"), ("total", "int"),
                       ("dropped", "int"))),
     )),
+    ServiceSpec("ProfileService", (
+        # Cluster-wide introspection (ref analogue: `ray stack` + the
+        # dashboard reporter's profile endpoints): both fan out over the
+        # node peer channels with a timeout, so a dead node degrades the
+        # reply to a partial result (its hex lands in `errors`), never a
+        # hang.
+        Method("stacks_dump",
+               request=(("timeout", "float", False, 5.0),),
+               reply=(("nodes", "list"), ("errors", "dict"))),
+        Method("profile_run",
+               request=(("seconds", "float", False, 2.0),
+                        ("hz", "int", False, 100)),
+               reply=(("nodes", "list"), ("errors", "dict"))),
+    )),
     ServiceSpec("MetaService", (
         Method("rpc_describe", reply=(("services", "dict"),)),
     )),
@@ -646,6 +660,52 @@ class GcsService:
             "total": stats["total"],
             "dropped": stats["dropped"],
         }
+
+    async def _rpc_stacks_dump(self, node_id, timeout=5.0):
+        return await self._profile_fanout(
+            {"type": "stacks_dump", "timeout": max(0.5, timeout)},
+            per_node_timeout=max(1.0, timeout) + 2.0,
+        )
+
+    async def _rpc_profile_run(self, node_id, seconds=2.0, hz=100):
+        from ..util.profiler import MAX_SAMPLE_SECONDS
+
+        # Nodes clamp to the sampler's hard cap; apply the same cap here
+        # so the per-node wait cannot be inflated past the real
+        # sampling time.
+        seconds = max(0.0, min(float(seconds), MAX_SAMPLE_SECONDS))
+        return await self._profile_fanout(
+            {"type": "profile_run", "seconds": seconds, "hz": hz},
+            per_node_timeout=seconds + 10.0,
+        )
+
+    async def _profile_fanout(self, frame, per_node_timeout: float):
+        """ProfileService core: issue ``frame`` to every alive node over
+        its peer channel concurrently; unreachable/late nodes land in
+        ``errors`` instead of stalling the aggregate reply."""
+        alive = [e for e in self._nodes.values() if e.state == "alive"]
+        errors: Dict[str, str] = {}
+
+        async def query(entry):
+            hex_id = entry.node_id.hex()
+            try:
+                peer = await self._pg_peer(hex_id)
+                reply = await peer.request(
+                    dict(frame), timeout=per_node_timeout
+                )
+                if reply.get("error"):
+                    # The node answered but its dump raised: that's a
+                    # partial result too — it must land in `errors`,
+                    # not silently vanish from both lists.
+                    errors[hex_id] = str(reply["error"])
+                    return None
+                return reply.get("result")
+            except Exception as e:  # noqa: BLE001 — partial > hang
+                errors[hex_id] = str(e) or type(e).__name__
+                return None
+
+        results = await asyncio.gather(*(query(e) for e in alive))
+        return {"nodes": [r for r in results if r], "errors": errors}
 
     async def _rpc_rpc_describe(self, node_id):
         return {"services": self._rpc.describe()}
@@ -1252,6 +1312,14 @@ class LocalGcsHandle:
             "dropped": stats["dropped"],
         }
 
+    async def stacks_dump(self, timeout=5.0):
+        return await self._svc._rpc_stacks_dump(None, timeout=timeout)
+
+    async def profile_run(self, seconds=2.0, hz=100):
+        return await self._svc._rpc_profile_run(
+            None, seconds=seconds, hz=hz
+        )
+
     async def rpc_describe(self):
         return self._svc._rpc.describe()
 
@@ -1415,6 +1483,20 @@ class RemoteGcsHandle:
         r = await self._client.request(msg)
         return {"events": r["events"], "total": r["total"],
                 "dropped": r["dropped"]}
+
+    async def stacks_dump(self, timeout=5.0):
+        r = await self._client.request(
+            {"op": "stacks_dump", "timeout": timeout},
+            timeout=timeout + 15.0,
+        )
+        return {"nodes": r["nodes"], "errors": r["errors"]}
+
+    async def profile_run(self, seconds=2.0, hz=100):
+        r = await self._client.request(
+            {"op": "profile_run", "seconds": seconds, "hz": hz},
+            timeout=seconds + 30.0,
+        )
+        return {"nodes": r["nodes"], "errors": r["errors"]}
 
     async def rpc_describe(self):
         return (await self._client.request({"op": "rpc_describe"}))[

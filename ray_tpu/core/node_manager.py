@@ -151,6 +151,9 @@ class TaskRecord:
     # monotonic time this record was handed to a worker (feeds the
     # per-task-duration histogram in /metrics).
     dispatched: Optional[float] = None
+    # Hang detector bookkeeping: the WARNING event fires once per record
+    # (re-dispatch after a retry resets it with the record state).
+    hang_warned: bool = False
 
 
 @dataclass
@@ -381,6 +384,12 @@ class NodeManager:
         # (a put'ed list of refs, a returned dict of refs). Pinned while
         # the container's entry lives; released when it is collected.
         self._nested_pins: Dict[ObjectID, List[ObjectID]] = {}
+
+        # Profiling plane (ref analogue: `ray stack` + the reporter's
+        # profile_manager): in-flight stack_dump/profile requests to this
+        # node's workers, keyed by req_id (loop-thread only).
+        self._profile_pending: Dict[int, asyncio.Future] = {}
+        self._profile_req_seq = 0
 
         # Failure history: bounded deque of TERMINAL task records (state,
         # duration, error type/message) retained after the live record
@@ -799,6 +808,13 @@ class NodeManager:
                     self._schedule()
             if self._workers:
                 consecutive_failures = 0
+            # Hang/straggler sweep rides the same cadence; detected
+            # records warn via background tasks so the stack capture's
+            # round-trip never stalls this loop.
+            try:
+                await self._check_hung_tasks()
+            except Exception:
+                pass
 
     def _call(self, coro):
         """Run a coroutine on the loop from a foreign thread."""
@@ -995,6 +1011,15 @@ class NodeManager:
             # Head-store query; the long-path RPC must not stall this
             # worker's message loop.
             asyncio.ensure_future(self._handle_events_query(w, msg))
+        elif mtype in ("stack_reply", "profile_reply"):
+            # A worker answering our stack_dump/profile fan-out.
+            fut = self._profile_pending.pop(msg.get("req_id"), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+        elif mtype == "profile":
+            # Cluster stacks/profile query from a worker or thin client;
+            # the fan-out blocks on timeouts, so never inline it here.
+            asyncio.ensure_future(self._handle_profile_query(w, msg))
         elif mtype == "pull_object":
             # Client-mode read rides the SAME chunked, admission-
             # controlled transfer plane nodes use (small objects answer
@@ -1202,6 +1227,15 @@ class NodeManager:
             peer_hex = hello["node_id"]
             while True:
                 msg = await aio_read_frame(reader)
+                if msg.get("type") in ("stacks_dump", "profile_run"):
+                    # Long-running introspection must not head-of-line
+                    # block this channel's read loop (a 15s profile would
+                    # stall every state_snapshot/pg frame behind it);
+                    # replies match by msg_id, so order doesn't matter.
+                    asyncio.ensure_future(self._peer_reply_async(
+                        peer_hex, msg, framed
+                    ))
+                    continue
                 reply = await self._dispatch_peer(peer_hex, msg)
                 if reply is not None:
                     reply["type"] = "reply"
@@ -1211,6 +1245,22 @@ class NodeManager:
             pass
         finally:
             framed.close()
+
+    async def _peer_reply_async(self, peer_hex: str, msg, framed):
+        """Dispatch a slow peer request off the channel's read loop and
+        ship the reply when it completes."""
+        try:
+            reply = await self._dispatch_peer(peer_hex, msg)
+        except Exception as e:  # noqa: BLE001
+            reply = {"error": str(e)}
+        if reply is None:
+            return
+        reply["type"] = "reply"
+        reply["msg_id"] = msg.get("msg_id")
+        try:
+            await framed.send(reply)
+        except Exception:
+            pass
 
     async def _serve_client(self, reader, framed):
         handle: Optional[WorkerHandle] = None
@@ -1285,6 +1335,17 @@ class NodeManager:
             return None
         if mtype == "state_snapshot":
             return {"state": self._local_state_snapshot()}
+        if mtype == "stacks_dump":
+            # GCS ProfileService fan-out: this node's dump (head NM
+            # included — the GCS reaches its own host over the same
+            # peer channel it uses for every other node).
+            return {"result": await self.stacks_dump(
+                timeout=msg.get("timeout", 5.0)
+            )}
+        if mtype == "profile_run":
+            return {"result": await self.profile_run(
+                seconds=msg.get("seconds", 2.0), hz=msg.get("hz", 100)
+            )}
         raise RuntimeError(f"unknown peer message {mtype}")
 
     # ------------------------------------------------------ bundle resources
@@ -2150,6 +2211,7 @@ class NodeManager:
         record.state = "running"
         record.worker_id = worker.worker_id
         record.dispatched = time.monotonic()
+        record.hang_warned = False  # fresh run: the detector re-arms
         worker.state = "busy"
         worker.current = record
         self._send_execute_to(worker, spec)
@@ -2216,6 +2278,7 @@ class NodeManager:
         record.state = "running"
         record.worker_id = worker.worker_id
         record.dispatched = time.monotonic()
+        record.hang_warned = False  # fresh run: the detector re-arms
         worker.pending.append(record)
         self._send_execute_to(worker, record.spec)
         return True
@@ -2410,6 +2473,7 @@ class NodeManager:
                 record,
                 error_type=msg.get("error_type"),
                 error_message=msg.get("error_message"),
+                resource_usage=msg.get("resource_usage"),
             )
             self._tasks.pop(task_id, None)
         elif msg.get("failed"):
@@ -2490,13 +2554,20 @@ class NodeManager:
 
     def _record_terminal_task(self, record: TaskRecord, *,
                               error_type: Optional[str] = None,
-                              error_message: Optional[str] = None):
+                              error_message: Optional[str] = None,
+                              resource_usage: Optional[Dict[str, Any]]
+                              = None):
         """Retain a terminal task's outcome in the bounded failure
         history (it is about to leave the live table)."""
         spec = record.spec
         dur = (time.monotonic() - record.dispatched
                if record.dispatched is not None else None)
+        usage = resource_usage or {}
         self._task_history.append({
+            # Worker-side resource sampler deltas (util/profiler
+            # TaskResourceSampler): CPU seconds burned and peak RSS.
+            "cpu_time_s": usage.get("cpu_s"),
+            "max_rss_bytes": usage.get("max_rss_bytes"),
             "task_id": spec.task_id.hex(),
             "name": spec.name or spec.method_name or "task",
             "state": record.state,
@@ -3528,6 +3599,240 @@ class NodeManager:
             severity=severity, source=source, limit=limit
         )
 
+    # ------------------------------------------------- profiling plane
+
+    def _worker_frame_future(self, w: WorkerHandle,
+                             frame: Dict[str, Any]):
+        """Send one stack_dump/profile frame to a worker and return
+        (req_id, future) for its reply — the single place that owns the
+        pending-table bookkeeping. (None, None) if the send failed.
+        Loop-thread only."""
+        self._profile_req_seq += 1
+        req_id = self._profile_req_seq
+        fut: asyncio.Future = self._loop.create_future()
+        self._profile_pending[req_id] = fut
+        try:
+            w.writer.send_nowait({**frame, "req_id": req_id})
+        except Exception:
+            self._profile_pending.pop(req_id, None)
+            return None, None
+        return req_id, fut
+
+    def _profile_fanout_workers(self, frame: Dict[str, Any]):
+        """Send a stack_dump/profile frame to every live worker; returns
+        [(handle, req_id, future), ...] for the replies. Loop-thread
+        only."""
+        waits = []
+        for w in list(self._workers.values()):
+            if w.state in ("dead", "client") or w.worker_type == "client":
+                continue
+            req_id, fut = self._worker_frame_future(w, frame)
+            if fut is not None:
+                waits.append((w, req_id, fut))
+        return waits
+
+    async def _gather_profile_replies(self, waits, timeout: float):
+        """Await the fan-out replies; a worker that never answers (dead,
+        wedged reader) is dropped from the result instead of hanging the
+        whole dump. Returns (replies, missing_worker_hexes)."""
+        if waits:
+            await asyncio.wait([f for _, _, f in waits], timeout=timeout)
+        replies, missing = [], []
+        for w, req_id, fut in waits:
+            if fut.done():
+                replies.append(fut.result())
+            else:
+                self._profile_pending.pop(req_id, None)
+                missing.append(w.worker_id.hex())
+        return replies, missing
+
+    async def stacks_dump(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """One-shot stack dump of this node: the node-manager process
+        plus every live worker (ref analogue: `ray stack` against one
+        node). Workers that do not answer within ``timeout`` degrade to
+        a partial result listed under ``missing_workers``."""
+        from ..util import profiler
+
+        procs = [{
+            "pid": os.getpid(),
+            "kind": "node_manager",
+            "worker_id": None,
+            "threads": profiler.dump_stacks(),
+        }]
+        waits = self._profile_fanout_workers({"type": "stack_dump"})
+        replies, missing = await self._gather_profile_replies(
+            waits, timeout
+        )
+        for r in replies:
+            procs.append({
+                "pid": r.get("pid"),
+                "kind": "worker",
+                "worker_id": r.get("worker_id"),
+                "threads": r.get("threads", []),
+            })
+        return {
+            "node_id": self.node_id.hex(),
+            "is_head": self.is_head,
+            "procs": procs,
+            "missing_workers": missing,
+        }
+
+    async def profile_run(self, seconds: float = 2.0,
+                          hz: int = 100) -> Dict[str, Any]:
+        """Timed sampling profile of this node: the node-manager process
+        (sampled OFF this event loop, in the default executor) plus
+        every live worker, merged to collapsed-stack counts keyed
+        ``pid:<pid>(<kind>);<thread>;<frames...>``."""
+        from ..util import profiler
+
+        seconds = max(0.0, min(float(seconds),
+                               profiler.MAX_SAMPLE_SECONDS))
+        hz = max(1, min(int(hz), profiler.MAX_SAMPLE_HZ))
+        local_fut = self._loop.run_in_executor(
+            None, profiler.sample, seconds, hz
+        )
+        waits = self._profile_fanout_workers(
+            {"type": "profile", "seconds": seconds, "hz": hz}
+        )
+        # Gather runs CONCURRENTLY with the local sample: its timeout
+        # clock starts now, so a wedged worker bounds the whole node
+        # reply at ~seconds+5 — within the GCS's per-node timeout —
+        # instead of 2*seconds+5, which would drop the node (and every
+        # healthy worker's samples) from the cluster reply.
+        gather_task = asyncio.ensure_future(
+            self._gather_profile_replies(waits, seconds + 5.0)
+        )
+        local = await local_fut
+        replies, missing = await gather_task
+        counts: Dict[str, int] = {}
+        samples = local.get("samples", 0)
+
+        def fold(pid, kind, src):
+            prefix = f"pid:{pid}({kind})"
+            for stack, n in (src or {}).items():
+                key = f"{prefix};{stack}"
+                counts[key] = counts.get(key, 0) + n
+
+        fold(os.getpid(), "node_manager", local.get("counts"))
+        for r in replies:
+            fold(r.get("pid"), "worker", r.get("counts"))
+            samples += r.get("samples", 0)
+        return {
+            "node_id": self.node_id.hex(),
+            "is_head": self.is_head,
+            "seconds": seconds,
+            "hz": hz,
+            "counts": counts,
+            "samples": samples,
+            "missing_workers": missing,
+        }
+
+    async def cluster_stacks(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """Cluster-wide stack dump via the GCS ProfileService (falls
+        back to this node alone in GCS-less unit setups)."""
+        if self._gcs is None:
+            return {"nodes": [await self.stacks_dump(timeout)],
+                    "errors": {}}
+        return await self._gcs.stacks_dump(timeout=timeout)
+
+    async def cluster_profile(self, seconds: float = 2.0,
+                              hz: int = 100) -> Dict[str, Any]:
+        """Cluster-wide sampling profile via the GCS ProfileService."""
+        if self._gcs is None:
+            return {"nodes": [await self.profile_run(seconds, hz)],
+                    "errors": {}}
+        return await self._gcs.profile_run(seconds=seconds, hz=hz)
+
+    async def _handle_profile_query(self, w: WorkerHandle, msg):
+        out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
+        try:
+            if msg.get("op") == "stacks":
+                out["result"] = await self.cluster_stacks(
+                    timeout=msg.get("timeout", 5.0)
+                )
+            elif msg.get("op") == "run":
+                out["result"] = await self.cluster_profile(
+                    seconds=msg.get("seconds", 2.0),
+                    hz=msg.get("hz", 100),
+                )
+            else:
+                out["error"] = f"unknown profile op {msg.get('op')!r}"
+        except Exception as e:  # noqa: BLE001
+            out["error"] = str(e)
+        try:
+            await w.writer.send(out)
+        except Exception:
+            pass
+
+    # ---------------------------------------------------- hang detector
+
+    async def _check_hung_tasks(self):
+        """Flag tasks running longer than ``hang_task_warn_s``: capture
+        the owning worker's stack and emit a WARNING cluster event (ref
+        analogue: the reference's "task is hung" debugging loop — `ray
+        stack` by hand — folded into the control plane)."""
+        thresh = getattr(self.config, "hang_task_warn_s", 0.0)
+        if thresh <= 0:
+            return
+        now = time.monotonic()
+        for record in list(self._tasks.values()):
+            if (
+                record.state != "running"
+                or record.hang_warned
+                or record.dispatched is None
+                or now - record.dispatched < thresh
+            ):
+                continue
+            worker = self._workers.get(record.worker_id)
+            if worker is None or worker.current is not record:
+                # Pipelined rider still queued on its worker: it is not
+                # EXECUTING yet — warning now would blame it for the
+                # head task's runtime and capture the wrong stack.
+                continue
+            record.hang_warned = True
+            self._spawn_bg(self._warn_hung_task(
+                record, now - record.dispatched, thresh
+            ))
+
+    async def _warn_hung_task(self, record: TaskRecord, elapsed: float,
+                              thresh: float):
+        from ..util import profiler
+
+        worker = self._workers.get(record.worker_id)
+        stack_text = ""
+        worker_pid = None
+        if worker is not None and worker.state != "dead":
+            worker_pid = worker.proc.pid if worker.proc else None
+            req_id, fut = self._worker_frame_future(
+                worker, {"type": "stack_dump"}
+            )
+            if fut is not None:
+                try:
+                    reply = await asyncio.wait_for(fut, timeout=2.0)
+                    stack_text = profiler.format_stack_text(
+                        reply.get("threads", [])
+                    )
+                except Exception:
+                    self._profile_pending.pop(req_id, None)
+        name = record.spec.name or record.spec.method_name or "task"
+        captured = ("worker stack captured" if stack_text
+                    else "worker stack capture failed")
+        cluster_events.emit(
+            cluster_events.WARNING, cluster_events.TASK,
+            f"task '{name}' has been running for {elapsed:.1f}s "
+            f"(> hang_task_warn_s={thresh:g}); {captured}",
+            node_id=self.node_id.hex(),
+            task_id=record.spec.task_id.hex(),
+            actor_id=(record.spec.actor_id.hex()
+                      if record.spec.actor_id else None),
+            custom_fields={
+                "elapsed_s": round(elapsed, 3),
+                "threshold_s": thresh,
+                "worker_pid": worker_pid,
+                "stack": stack_text[:8000],
+            },
+        )
+
     # ------------------------------------------------- placement-group proxy
 
     async def _handle_pg(self, w: WorkerHandle, msg):
@@ -3778,16 +4083,35 @@ class NodeManager:
                 "restart_count": info.restart_count,
                 "pending_calls": len(info.queued) + len(info.inflight),
             })
+        from ..util.profiler import process_stats
+
         workers = []
+        now = time.monotonic()
         for wid, w in self._workers.items():
-            workers.append({
+            pid = w.proc.pid if w.proc else None
+            row = {
                 "worker_id": wid.hex(),
-                "pid": w.proc.pid if w.proc else None,
+                "pid": pid,
                 "state": w.state,
                 "worker_type": w.worker_type,
                 "node_id": node,
                 "actor_id": w.actor_id.hex() if w.actor_id else None,
-            })
+                # Current activity ("what is it doing right now"):
+                # running task + live cpu/rss from /proc.
+                "current_task": (w.current.spec.name
+                                 or w.current.spec.method_name
+                                 if w.current is not None else None),
+                "current_task_id": (w.current.spec.task_id.hex()
+                                    if w.current is not None else None),
+                "running_for_s": (
+                    round(now - w.current.dispatched, 3)
+                    if w.current is not None
+                    and w.current.dispatched is not None else None
+                ),
+            }
+            if pid is not None:
+                row.update(process_stats(pid))
+            workers.append(row)
         objects = []
         for oid, size, where, refs in self.directory.entries_view():
             objects.append({

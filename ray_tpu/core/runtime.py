@@ -982,6 +982,23 @@ class DriverRuntime(BaseRuntime):
                                   limit=limit)
         )
 
+    def cluster_stacks(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """Cluster-wide stack dumps via the GCS ProfileService (backing
+        for util/profiler.cluster_stacks / `rtpu stack`)."""
+        return self._nm.call_sync(
+            self._nm.cluster_stacks(timeout=timeout),
+            timeout=timeout + 15.0,
+        )
+
+    def cluster_profile(self, seconds: float = 2.0,
+                        hz: int = 100) -> Dict[str, Any]:
+        """Cluster-wide sampling profile (backing for
+        util/profiler.cluster_profile / `rtpu profile`)."""
+        return self._nm.call_sync(
+            self._nm.cluster_profile(seconds=seconds, hz=hz),
+            timeout=min(float(seconds), 30.0) + 30.0,
+        )
+
     def cluster_resources(self) -> Dict[str, float]:
         views = self.nodes()
         if len(views) <= 1:
@@ -1197,6 +1214,26 @@ class WorkerRuntime(BaseRuntime):
             raise RuntimeError(reply["error"])
         return {"events": reply["events"], "total": reply["total"],
                 "dropped": reply["dropped"]}
+
+    def cluster_stacks(self, timeout: float = 5.0) -> Dict[str, Any]:
+        reply = self.request(
+            {"type": "profile", "op": "stacks", "timeout": timeout},
+            timeout=timeout + 15.0,
+        )
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return reply["result"]
+
+    def cluster_profile(self, seconds: float = 2.0,
+                        hz: int = 100) -> Dict[str, Any]:
+        reply = self.request(
+            {"type": "profile", "op": "run", "seconds": seconds,
+             "hz": hz},
+            timeout=min(float(seconds), 30.0) + 30.0,
+        )
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return reply["result"]
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self._conn.send({"type": "kill_actor", "actor_id": actor_id,

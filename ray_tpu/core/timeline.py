@@ -142,6 +142,14 @@ class TaskEventBuffer:
     def flush(self) -> None:
         from . import runtime_context
 
+        # An explicit flush supersedes the deferred one: cancel it so no
+        # Timer fires into a torn-down interpreter at shutdown (same
+        # contract as metrics.py's flusher; a timer that already fired
+        # cancels as a no-op).
+        with self._lock:
+            timer, self._timer = self._timer, None
+        if timer is not None:
+            timer.cancel()
         rt = runtime_context.current_runtime_or_none()
         if rt is None:
             return
@@ -164,11 +172,16 @@ def get_buffer() -> TaskEventBuffer:
     if _buffer is None:
         # Scope the KV key by node id: pids collide across hosts, and the
         # chrome trace groups rows by node.
+        import atexit
+
         from . import runtime_context
 
         rt = runtime_context.current_runtime_or_none()
         node8 = rt.node_id.hex()[:8] if rt is not None else "local"
         _buffer = TaskEventBuffer(node8)
+        # Tail spans from short-lived workers must not be lost to the
+        # throttle window (metrics.py registers the same way).
+        atexit.register(_buffer.flush)
     return _buffer
 
 

@@ -171,6 +171,20 @@ class Worker:
                     self.conn.send(
                         {"type": "reclaimed", "task_ids": removed}
                     )
+                elif mtype == "stack_dump":
+                    # Answered HERE, on the reader thread: the whole
+                    # point is seeing what the (possibly wedged) main
+                    # thread is doing right now — queueing the request
+                    # behind it would deadlock the diagnosis.
+                    self._reply_stack_dump(msg)
+                elif mtype == "profile":
+                    # Timed sampling must not stall the reader loop for
+                    # its full duration (replies/reclaims keep flowing);
+                    # a dedicated thread samples and ships the result.
+                    threading.Thread(
+                        target=self._profile_and_reply, args=(msg,),
+                        name="ray_tpu-profile", daemon=True,
+                    ).start()
                 elif mtype == "kill":
                     self._alive = False
                     self._tq_put(None)
@@ -178,6 +192,45 @@ class Worker:
         except (ConnectionClosed, OSError):
             self._alive = False
             self._tq_put(None)
+
+    def _reply_stack_dump(self, msg):
+        from ..util import profiler
+
+        try:
+            threads = profiler.dump_stacks()
+        except Exception:  # noqa: BLE001 — diagnosis must not kill us
+            threads = []
+        try:
+            self.conn.send({
+                "type": "stack_reply",
+                "req_id": msg.get("req_id"),
+                "pid": os.getpid(),
+                "worker_id": self.worker_id.hex(),
+                "threads": threads,
+            })
+        except Exception:
+            pass
+
+    def _profile_and_reply(self, msg):
+        from ..util import profiler
+
+        try:
+            prof = profiler.sample(
+                msg.get("seconds", 2.0), msg.get("hz", 100)
+            )
+        except Exception:  # noqa: BLE001
+            prof = {"counts": {}, "samples": 0}
+        try:
+            self.conn.send({
+                "type": "profile_reply",
+                "req_id": msg.get("req_id"),
+                "pid": os.getpid(),
+                "worker_id": self.worker_id.hex(),
+                "counts": prof.get("counts", {}),
+                "samples": prof.get("samples", 0),
+            })
+        except Exception:
+            pass
 
     def _route_group(self, m) -> bool:
         """Reader-thread routing for concurrency-group methods: they
@@ -581,6 +634,12 @@ class Worker:
         span_id = new_span_id()
         prev_span = enter_span(trace_id, span_id)
         _t0 = _time.time()
+        # Per-task CPU/RSS deltas for the terminal task record (the
+        # "where did the step time go" companion to the duration the
+        # node manager already histograms).
+        from ..util.profiler import TaskResourceSampler
+
+        _rsamp = TaskResourceSampler().start()
         try:
             results, failed, nested, error_info = execute_task(
                 spec, load_function, fetch, store_large, self.actor,
@@ -606,6 +665,10 @@ class Worker:
             "results": results,
             "failed": failed,
         }
+        try:
+            done["resource_usage"] = _rsamp.finish()
+        except Exception:
+            pass
         if failed and error_info is not None:
             # Structured failure record: the node manager retains the
             # error type/message in its terminal-task history, and the

@@ -11,6 +11,7 @@ dependencies — the data layer is the same fan-out state query the CLI and
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
@@ -163,17 +164,91 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
-            if path == "/api/profile":
-                # On-demand stack-sampling profile of the control plane
-                # (driver + node-manager threads), collapsed-stack format
-                # (ref analogue: dashboard reporter profile_manager.py's
-                # py-spy endpoint — dependency-free equivalent).
+            if path == "/api/stacks":
+                # Cluster-wide one-shot stack dumps: head + every node
+                # manager + every live worker, via the GCS
+                # ProfileService (ref analogue: `ray stack`).
                 from urllib.parse import parse_qs, urlparse
 
+                from .core import runtime_context
+                from .util import profiler
+
                 q = parse_qs(urlparse(self.path).query)
-                seconds = min(30.0, float(q.get("seconds", ["2"])[0]))
-                hz = min(200, int(q.get("hz", ["100"])[0]))
-                self._json(_sample_stacks(seconds, hz))
+                try:
+                    timeout = float(q.get("timeout", ["5"])[0])
+                except (TypeError, ValueError):
+                    self._json({"error": "timeout must be numeric"}, 400)
+                    return
+                rt = runtime_context.current_runtime_or_none()
+                if rt is None or not hasattr(rt, "cluster_stacks"):
+                    # No cluster runtime: this process's threads only.
+                    self._json({"nodes": [{
+                        "node_id": "local", "is_head": True,
+                        "procs": [{"pid": os.getpid(), "kind": "driver",
+                                   "worker_id": None,
+                                   "threads": profiler.dump_stacks()}],
+                    }], "errors": {}})
+                    return
+                self._json(rt.cluster_stacks(timeout=min(timeout, 30.0)))
+                return
+            if path == "/api/profile":
+                # Cluster-wide sampled wall-clock profile (ref analogue:
+                # dashboard reporter profile_manager.py's py-spy
+                # endpoint, generalized to every node + worker). Each
+                # node samples OFF its event loop; this process's share
+                # comes from a dedicated sampler thread — never the
+                # request thread (make check-obs lints for that).
+                from urllib.parse import parse_qs, urlparse
+
+                from .core import runtime_context
+                from .core.config import get_config
+                from .util import profiler
+
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    seconds = float(q.get("seconds", ["2"])[0])
+                    hz = int(q.get("hz", ["100"])[0])
+                except (TypeError, ValueError):
+                    self._json(
+                        {"error": "seconds and hz must be numeric"}, 400
+                    )
+                    return
+                cap = getattr(get_config(), "profile_max_seconds", 15.0)
+                seconds = max(0.1, min(seconds, cap))
+                hz = max(1, min(hz, profiler.MAX_SAMPLE_HZ))
+
+                def top_stacks(counts, n=500):
+                    # Bound the JSON payload: the heaviest stacks are
+                    # the ones a flamegraph reader cares about.
+                    return dict(sorted(counts.items(),
+                                       key=lambda kv: -kv[1])[:n])
+
+                rt = runtime_context.current_runtime_or_none()
+                if rt is None or not hasattr(rt, "cluster_profile"):
+                    # Same response shape as the cluster path: top-level
+                    # counts/samples plus per-node metadata.
+                    prof = profiler.sample_in_thread(seconds, hz)
+                    self._json({
+                        "nodes": [{"node_id": "local",
+                                   "samples": prof["samples"]}],
+                        "errors": {},
+                        "counts": top_stacks(prof["counts"]),
+                        "samples": prof["samples"],
+                    })
+                    return
+                reply = rt.cluster_profile(seconds=seconds, hz=hz)
+                merged = profiler.merge_cluster_profile(reply)
+                self._json({
+                    # Per-node counts fold into the merged map; shipping
+                    # them twice would double an already-large payload.
+                    "nodes": [
+                        {k: v for k, v in n.items() if k != "counts"}
+                        for n in reply.get("nodes", [])
+                    ],
+                    "errors": reply.get("errors", {}),
+                    "counts": top_stacks(merged["counts"]),
+                    "samples": merged["samples"],
+                })
                 return
             if path == "/metrics":
                 # Prometheus text exposition (ref analogue:
@@ -256,44 +331,6 @@ def _report_json(report: dict, prefix: str = "") -> dict:
         for name, m in report.items()
         if not prefix or name.startswith(prefix)
     }
-
-
-def _sample_stacks(seconds: float, hz: int) -> dict:
-    """Wall-clock stack sampler over every thread in this process;
-    returns {collapsed_stack: sample_count} plus thread names (feed the
-    "stacks" map to any flamegraph renderer)."""
-    import sys
-    import time
-
-    names = {t.ident: t.name for t in threading.enumerate()}
-    counts: dict = {}
-    deadline = time.monotonic() + seconds
-    interval = 1.0 / max(1, hz)
-    samples = 0
-    me = threading.get_ident()
-    while time.monotonic() < deadline:
-        for tid, frame in sys._current_frames().items():
-            if tid == me:
-                continue
-            parts = []
-            f = frame
-            depth = 0
-            while f is not None and depth < 40:
-                code = f.f_code
-                parts.append(
-                    f"{code.co_filename.rsplit('/', 1)[-1]}:"
-                    f"{code.co_name}"
-                )
-                f = f.f_back
-                depth += 1
-            stack = (names.get(tid, str(tid)) + ";"
-                     + ";".join(reversed(parts)))
-            counts[stack] = counts.get(stack, 0) + 1
-        samples += 1
-        time.sleep(interval)
-    return {"seconds": seconds, "hz": hz, "samples": samples,
-            "stacks": dict(sorted(counts.items(),
-                                  key=lambda kv: -kv[1])[:500])}
 
 
 def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:

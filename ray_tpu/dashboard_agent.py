@@ -14,6 +14,7 @@ Endpoints (all JSON):
   /api/local/logs/<name>?tail= — tail of one log file
   /api/local/stats             — process cpu/rss + store/loop stats
   /api/local/profile?seconds=  — collapsed-stack samples of this node
+  /api/local/stacks            — one-shot stack dump of this process
 """
 
 from __future__ import annotations
@@ -75,11 +76,28 @@ class _AgentHandler(BaseHTTPRequestHandler):
                 self._json(self._stats())
                 return
             if path == "/api/local/profile":
-                from .dashboard import _sample_stacks
+                # Sampler runs on its own thread (util/profiler), never
+                # this request thread (make check-obs lints for that).
+                from .util import profiler
 
-                seconds = min(30.0, float(q.get("seconds", ["2"])[0]))
-                hz = min(200, int(q.get("hz", ["100"])[0]))
-                self._json(_sample_stacks(seconds, hz))
+                try:
+                    seconds = min(profiler.MAX_SAMPLE_SECONDS,
+                                  float(q.get("seconds", ["2"])[0]))
+                    hz = min(profiler.MAX_SAMPLE_HZ,
+                             int(q.get("hz", ["100"])[0]))
+                except (TypeError, ValueError):
+                    self._json(
+                        {"error": "seconds and hz must be numeric"}, 400
+                    )
+                    return
+                self._json(profiler.sample_in_thread(seconds, hz))
+                return
+            if path == "/api/local/stacks":
+                from .util import profiler
+
+                self._json({"node_id": nm.node_id.hex(),
+                            "pid": os.getpid(),
+                            "threads": profiler.dump_stacks()})
                 return
             self._json({"error": f"unknown path {path}"}, 404)
         except Exception as e:  # noqa: BLE001

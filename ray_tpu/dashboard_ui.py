@@ -216,17 +216,23 @@ function viewProfile(){
     <label>seconds <input id="psec" value="2" size="3"></label>
     <button class="act" onclick="profile()">sample stacks</button>
     </div><div id="prof" class="muted">On-demand wall-clock stack
-    sampling of the control plane (collapsed-stack format — paste into
-    any flamegraph renderer).</div>`;
+    sampling of the whole cluster — every node manager and worker
+    (collapsed-stack format — paste into any flamegraph
+    renderer).</div>`;
 }
 async function profile(){
   const el=document.getElementById("prof");
   el.textContent="sampling…";
   const s=document.getElementById("psec").value||"2";
   const d=await j("/api/profile?seconds="+s);
-  const rows=Object.entries(d.stacks||{}).sort((a,b)=>b[1]-a[1]);
+  const rows=Object.entries(d.counts||{}).sort((a,b)=>b[1]-a[1]);
   let t=`<p>${rows.length} distinct stacks, `+
-    `${d.samples||""} samples</p><pre>`;
+    `${d.samples||""} samples across `+
+    `${(d.nodes||[]).length} node(s)</p>`;
+  const errs=Object.entries(d.errors||{});
+  if(errs.length)t+=`<p class="muted">partial: `+
+    errs.map(([n,e])=>`${n.slice(0,8)}: ${h(e)}`).join(", ")+`</p>`;
+  t+="<pre>";
   for(const[st,n]of rows.slice(0,40))t+=`${n}\t${h(st)}\n`;
   el.innerHTML=t+"</pre>";
 }

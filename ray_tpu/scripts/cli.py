@@ -435,25 +435,105 @@ def cmd_memory(args) -> int:
     try:
         from ray_tpu.util import state as state_api
 
-        rows = state_api.list_objects(limit=args.limit)
-        rows.sort(key=lambda r: -r.get("size_bytes", 0))
+        # Fetch the full table, sort once, slice once for display: the
+        # TOTAL accounting below must also cover objects beyond the
+        # display limit (the old path truncated before sorting AND again
+        # after, so the biggest objects could be cut and the totals
+        # lied). Explicit high limit: list_objects' default 10k cap
+        # would silently reintroduce the undercount on big clusters.
+        rows = state_api.list_objects(limit=10_000_000)
+        rows.sort(key=lambda r: -(r.get("size_bytes") or 0))
         by_where = {}
         total = 0
         for r in rows:
+            size = r.get("size_bytes") or 0
             by_where.setdefault(r["where"], [0, 0])
             by_where[r["where"]][0] += 1
-            by_where[r["where"]][1] += r.get("size_bytes", 0)
-            total += r.get("size_bytes", 0)
+            by_where[r["where"]][1] += size
+            total += size
+        shown = rows[:args.limit]
         print(f"{'OBJECT ID':42} {'SIZE':>12} {'REFS':>5} "
               f"{'WHERE':8} NODE")
-        for r in rows[:args.limit]:
+        for r in shown:
             print(f"{r['object_id']:42} "
-                  f"{r.get('size_bytes', 0):>12} "
+                  f"{r.get('size_bytes') or 0:>12} "
                   f"{r.get('refcount', 0):>5} "
                   f"{r['where']:8} {r['node_id'][:8]}")
-        print(f"\n{len(rows)} objects, {total / 1e6:.2f} MB total")
+        label = f"TOTAL ({len(rows)} objects, {len(shown)} shown)"
+        print(f"{label:42} {total:>12}")
         for where, (n, size) in sorted(by_where.items()):
             print(f"  {where}: {n} objects, {size / 1e6:.2f} MB")
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_stack(args) -> int:
+    """Cluster-wide stack dumps: head + every node manager + every live
+    worker (ref: `ray stack`, generalized past the local node)."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.util import profiler
+
+        reply = profiler.cluster_stacks(timeout=args.timeout)
+        if args.json:
+            print(json.dumps(reply, indent=2, default=str))
+            return 0
+        for node in reply.get("nodes", ()):
+            node_hex = node.get("node_id", "")
+            if args.node and not node_hex.startswith(args.node):
+                continue
+            head = " (head)" if node.get("is_head") else ""
+            print(f"=== node {node_hex[:8]}{head}")
+            for proc in node.get("procs", ()):
+                wid = proc.get("worker_id") or ""
+                if args.worker and not wid.startswith(args.worker):
+                    continue
+                tag = f" worker={wid[:8]}" if wid else ""
+                print(f"--- pid {proc.get('pid')} "
+                      f"[{proc.get('kind')}]{tag}")
+                print(profiler.format_stack_text(
+                    proc.get("threads", [])
+                ))
+            for wid in node.get("missing_workers", ()):
+                print(f"--- worker={wid[:8]}: no reply (dead or wedged)")
+        for node_hex, err in (reply.get("errors") or {}).items():
+            print(f"=== node {node_hex[:8]}: unreachable ({err})",
+                  file=sys.stderr)
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_profile(args) -> int:
+    """Cluster-wide sampled wall-clock profile, exported as folded
+    collapsed stacks or speedscope JSON (ref: the dashboard reporter's
+    py-spy profiles, dependency-free and cluster-wide)."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.util import profiler
+
+        reply = profiler.cluster_profile(seconds=args.seconds,
+                                         hz=args.hz)
+        merged = profiler.merge_cluster_profile(reply)
+        for node_hex, err in merged["errors"].items():
+            print(f"node {node_hex[:8]}: unreachable ({err})",
+                  file=sys.stderr)
+        if args.format == "speedscope":
+            out = json.dumps(profiler.to_speedscope(
+                merged["counts"],
+                name=f"rtpu profile ({args.seconds}s @ {args.hz}Hz)",
+            ))
+        else:
+            out = profiler.to_folded(merged["counts"])
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(out)
+            print(f"wrote {merged['samples']} samples across "
+                  f"{len(reply.get('nodes', []))} node(s) to "
+                  f"{args.output}", file=sys.stderr)
+        else:
+            sys.stdout.write(out)
         return 0
     finally:
         ray_tpu.shutdown()
@@ -718,6 +798,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--limit", type=int, default=50)
     _add_address(p)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("stack",
+                       help="stack dumps of every process in the cluster")
+    p.add_argument("--node", default=None,
+                   help="only this node (hex id prefix)")
+    p.add_argument("--worker", default=None,
+                   help="only this worker (hex id prefix)")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.add_argument("--json", action="store_true")
+    _add_address(p)
+    p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("profile",
+                       help="sampled wall-clock profile of the cluster")
+    p.add_argument("--seconds", type=float, default=2.0)
+    p.add_argument("--hz", type=int, default=100)
+    p.add_argument("--format", choices=["folded", "speedscope"],
+                   default="folded")
+    p.add_argument("-o", "--output", default=None,
+                   help="write to FILE instead of stdout")
+    _add_address(p)
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("serve", help="serve: deploy/status/shutdown")
     ssub = p.add_subparsers(dest="serve_cmd", required=True)

@@ -1,0 +1,319 @@
+"""Cluster-wide profiling & hang-diagnosis plane.
+
+Ref analogue: ``ray stack`` (python/ray/scripts) + the dashboard
+reporter's ``profile_manager.py`` (py-spy wall profiles of any worker)
+— here a dependency-free in-process sampler built on
+``sys._current_frames()`` + ``threading.enumerate()``. Three layers sit
+on top of this module:
+
+- workers answer ``stack_dump``/``profile`` control frames on their
+  reader thread (core/worker_main.py);
+- each node manager fans a request out to its live workers plus itself
+  and merges the replies (core/node_manager.py ``stacks_dump`` /
+  ``profile_run``);
+- the GCS ``ProfileService`` RPC fans out cluster-wide over the
+  existing node peer channels with a timeout, so dead nodes degrade
+  the reply to a partial result instead of a hang (core/gcs.py).
+
+Surfaces: ``rtpu stack`` / ``rtpu profile``, dashboard ``/api/stacks``
++ ``/api/profile``, and the :func:`cluster_stacks` /
+:func:`cluster_profile` helpers below.
+
+Profiles aggregate to collapsed-stack counts and export as folded text
+(:func:`to_folded`, flamegraph.pl-compatible) or speedscope JSON
+(:func:`to_speedscope`). :class:`TaskResourceSampler` is the light
+per-task CPU/RSS delta sampler workers attach to terminal task records.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# Frames deeper than this are truncated in dumps and samples (a runaway
+# recursion should not turn one sample into megabytes of strings).
+MAX_STACK_DEPTH = 60
+
+# Hard ceilings every entry point clamps to — a typo'd ?seconds=3000
+# must not pin a sampling thread (or a dashboard request) for an hour.
+MAX_SAMPLE_SECONDS = 30.0
+MAX_SAMPLE_HZ = 250
+
+
+def _frame_label(frame) -> str:
+    code = frame.f_code
+    return f"{code.co_filename.rsplit('/', 1)[-1]}:{code.co_name}"
+
+
+# ------------------------------------------------------ one-shot dumps
+
+
+def dump_stacks() -> List[Dict[str, Any]]:
+    """Stack dump of every thread in this process (ref: ``ray stack``).
+
+    Returns plain dicts (picklable for the control-plane frames):
+    ``{"thread_id", "name", "daemon", "frames": [{"file", "line",
+    "function"}, ...]}`` with frames outermost-first.
+    """
+    names = {}
+    for t in threading.enumerate():
+        names[t.ident] = (t.name, t.daemon)
+    threads = []
+    for tid, frame in sys._current_frames().items():
+        frames = []
+        f = frame
+        depth = 0
+        while f is not None and depth < MAX_STACK_DEPTH:
+            code = f.f_code
+            frames.append({
+                "file": code.co_filename,
+                "line": f.f_lineno,
+                "function": code.co_name,
+            })
+            f = f.f_back
+            depth += 1
+        frames.reverse()  # outermost first, like a traceback
+        name, daemon = names.get(tid, (str(tid), False))
+        threads.append({
+            "thread_id": tid,
+            "name": name,
+            "daemon": daemon,
+            "frames": frames,
+        })
+    threads.sort(key=lambda t: t["name"])
+    return threads
+
+
+def format_stack_text(threads: List[Dict[str, Any]]) -> str:
+    """Human/log rendering of a :func:`dump_stacks` result (one thread
+    header + one indented line per frame, innermost last — the same
+    shape as a traceback, so eyes trained on those parse it)."""
+    out = []
+    for t in threads:
+        daemon = " daemon" if t.get("daemon") else ""
+        out.append(f"Thread {t['thread_id']} ({t['name']}){daemon}:")
+        for fr in t.get("frames", ()):
+            out.append(
+                f"  File \"{fr['file']}\", line {fr['line']}, "
+                f"in {fr['function']}"
+            )
+    return "\n".join(out)
+
+
+# ------------------------------------------------- sampling profiles
+
+
+def sample(seconds: float, hz: int = 100,
+           _stop: Optional[threading.Event] = None) -> Dict[str, Any]:
+    """Wall-clock stack sampling of every thread in this process,
+    aggregated to collapsed-stack counts.
+
+    Returns ``{"counts": {"<thread>;<f0>;<f1>;...": n}, "samples": N,
+    "seconds": s, "hz": hz, "pid": pid}`` — keys are root-first folded
+    stacks prefixed with the thread name. The calling thread excludes
+    itself (it would only ever observe this loop).
+    """
+    seconds = max(0.0, min(float(seconds), MAX_SAMPLE_SECONDS))
+    hz = max(1, min(int(hz), MAX_SAMPLE_HZ))
+    interval = 1.0 / hz
+    counts: Dict[str, int] = {}
+    me = threading.get_ident()
+    samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            parts = []
+            f = frame
+            depth = 0
+            while f is not None and depth < MAX_STACK_DEPTH:
+                parts.append(_frame_label(f))
+                f = f.f_back
+                depth += 1
+            stack = (names.get(tid, str(tid)) + ";"
+                     + ";".join(reversed(parts)))
+            counts[stack] = counts.get(stack, 0) + 1
+        samples += 1
+        if _stop is not None and _stop.is_set():
+            break
+        time.sleep(interval)
+    return {"counts": counts, "samples": samples, "seconds": seconds,
+            "hz": hz, "pid": os.getpid()}
+
+
+def sample_in_thread(seconds: float, hz: int = 100) -> Dict[str, Any]:
+    """Run :func:`sample` on a dedicated thread and wait for the result.
+
+    This is the entry point request handlers (dashboard, agent) must
+    use: the sampling loop never runs ON the caller's thread, so the
+    caller shows up in the profile like any other thread instead of
+    polluting every sample with its own loop (``make check-obs`` lints
+    dashboard handlers for direct ``sample``/``_sample_stacks`` calls).
+    """
+    out: Dict[str, Any] = {}
+
+    def run():
+        out.update(sample(seconds, hz))
+
+    t = threading.Thread(target=run, name="ray_tpu-profiler", daemon=True)
+    t.start()
+    t.join(min(float(seconds), MAX_SAMPLE_SECONDS) + 10.0)
+    return out or {"counts": {}, "samples": 0, "seconds": seconds,
+                   "hz": hz, "pid": os.getpid()}
+
+
+# --------------------------------------------------------- exporters
+
+
+def to_folded(counts: Dict[str, int]) -> str:
+    """Collapsed-stack ("folded") text: ``stack count`` per line,
+    heaviest first — pipe straight into flamegraph.pl / speedscope."""
+    lines = [f"{stack} {n}"
+             for stack, n in sorted(counts.items(), key=lambda kv: -kv[1])]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def to_speedscope(counts: Dict[str, int],
+                  name: str = "ray_tpu profile") -> Dict[str, Any]:
+    """Speedscope file-format JSON (one "sampled" profile; weights are
+    sample counts). Round-trips through ``json.dumps``/``loads`` and
+    opens directly at speedscope.app."""
+    frame_index: Dict[str, int] = {}
+    frames: List[Dict[str, str]] = []
+    samples: List[List[int]] = []
+    weights: List[int] = []
+    for stack, weight in sorted(counts.items(), key=lambda kv: -kv[1]):
+        idxs = []
+        for part in stack.split(";"):
+            if not part:
+                continue
+            idx = frame_index.get(part)
+            if idx is None:
+                idx = frame_index[part] = len(frames)
+                frames.append({"name": part})
+            idxs.append(idx)
+        samples.append(idxs)
+        weights.append(int(weight))
+    total = sum(weights)
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": frames},
+        "profiles": [{
+            "type": "sampled",
+            "name": name,
+            "unit": "none",
+            "startValue": 0,
+            "endValue": total,
+            "samples": samples,
+            "weights": weights,
+        }],
+        "name": name,
+        "activeProfileIndex": 0,
+        "exporter": "ray_tpu.util.profiler",
+    }
+
+
+def merge_cluster_profile(reply: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge a cluster ``profile_run`` reply (per-node payloads) into
+    one counts dict, prefixing each stack with its node so one
+    flamegraph shows the whole cluster."""
+    counts: Dict[str, int] = {}
+    samples = 0
+    for node in reply.get("nodes", ()):
+        node8 = (node.get("node_id") or "?")[:8]
+        for stack, n in (node.get("counts") or {}).items():
+            key = f"node:{node8};{stack}"
+            counts[key] = counts.get(key, 0) + n
+        samples += node.get("samples", 0)
+    return {"counts": counts, "samples": samples,
+            "errors": dict(reply.get("errors") or {})}
+
+
+# ------------------------------------------------ per-task resources
+
+
+class TaskResourceSampler:
+    """CPU-time + RSS delta of one task execution (ref analogue: the
+    reporter's per-worker cpu/mem stats, scoped to a task). ``os.times``
+    is process-wide, which is exactly right for single-task-at-a-time
+    workers and an honest upper bound for concurrent actors."""
+
+    __slots__ = ("_t0", "_rss0")
+
+    def start(self) -> "TaskResourceSampler":
+        t = os.times()
+        self._t0 = t.user + t.system
+        self._rss0 = _max_rss_bytes()
+        return self
+
+    def finish(self) -> Dict[str, Any]:
+        t = os.times()
+        rss = _max_rss_bytes()
+        return {
+            "cpu_s": round(max(0.0, t.user + t.system - self._t0), 6),
+            "max_rss_bytes": rss,
+            "rss_delta_bytes": max(0, rss - self._rss0),
+        }
+
+
+def _max_rss_bytes() -> int:
+    try:
+        import resource
+
+        # ru_maxrss is KiB on Linux, bytes on macOS.
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return rss if sys.platform == "darwin" else rss * 1024
+    except Exception:
+        return 0
+
+
+def process_stats(pid: int) -> Dict[str, Any]:
+    """Live cpu-seconds + RSS of another process from /proc (psutil-free;
+    feeds the ``list_workers()`` activity columns). Empty dict off-Linux
+    or for a process that already exited."""
+    out: Dict[str, Any] = {}
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().rsplit(")", 1)[-1].split()
+        tick = os.sysconf("SC_CLK_TCK")
+        # utime/stime are fields 14/15 of the full line = 11/12 here
+        # (the split above dropped pid and (comm)).
+        out["cpu_seconds"] = round((int(parts[11]) + int(parts[12])) / tick, 3)
+        with open(f"/proc/{pid}/statm") as f:
+            pages = int(f.read().split()[1])
+        out["rss_bytes"] = pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        pass
+    return out
+
+
+# ------------------------------------------------- cluster entrypoints
+
+
+def cluster_stacks(timeout: float = 5.0) -> Dict[str, Any]:
+    """Stack dumps of every process in the cluster: head + every node
+    manager + every live worker (ref: ``ray stack``, cluster-wide).
+    Returns ``{"nodes": [{"node_id", "is_head", "procs": [{"pid",
+    "kind", "worker_id", "threads"}]}], "errors": {node_hex: reason}}``
+    — unreachable nodes land in ``errors``, never hang the call."""
+    from ..core import runtime_context
+
+    rt = runtime_context.current_runtime()
+    return rt.cluster_stacks(timeout=timeout)
+
+
+def cluster_profile(seconds: float = 2.0, hz: int = 100) -> Dict[str, Any]:
+    """Sampled wall-clock profile of every process in the cluster over
+    ``seconds``. Per-node payloads carry collapsed-stack counts keyed
+    ``pid:<pid>(<kind>);<thread>;<frames...>``; merge with
+    :func:`merge_cluster_profile`, export with :func:`to_folded` /
+    :func:`to_speedscope`."""
+    from ..core import runtime_context
+
+    rt = runtime_context.current_runtime()
+    return rt.cluster_profile(seconds=seconds, hz=hz)

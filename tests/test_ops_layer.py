@@ -357,10 +357,13 @@ def test_profile_endpoint(ray_tpu_start):
             prof = json.loads(r.read())
         ray_tpu.get(refs, timeout=60)
         assert prof["samples"] > 10
-        assert prof["stacks"], "no stacks sampled"
+        # Cluster-wide shape now: merged collapsed-stack counts keyed
+        # node:<hex>;pid:<pid>(<kind>);<thread>;<frames...>.
+        assert prof["counts"], "no stacks sampled"
+        assert prof["nodes"] and prof["errors"] == {}
         # the node-manager loop thread must appear
-        assert any(k.startswith("ray_tpu-node-manager")
-                   for k in prof["stacks"])
+        assert any(";ray_tpu-node-manager;" in k
+                   for k in prof["counts"])
     finally:
         dashboard.stop_dashboard()
 
@@ -503,7 +506,7 @@ def test_dashboard_agents_and_proxy(ray_tpu_start):
         prof = fetch(
             f"/api/agent/{node_hex}/profile?seconds=0.3&hz=50"
         )
-        assert prof["samples"] > 0 and prof["stacks"]
+        assert prof["samples"] > 0 and prof["counts"]
     finally:
         dashboard.stop_dashboard()
 

@@ -1,0 +1,414 @@
+"""Cluster-wide profiling & hang-diagnosis plane (ref analogue: `ray
+stack` + the dashboard reporter's profile_manager tests): the
+dependency-free sampler primitives, folded/speedscope exporters,
+cluster-wide stack/profile fan-out over the GCS ProfileService, the
+hang/straggler detector's WARNING event, worker activity columns, and
+the dashboard/CLI satellites."""
+
+import json
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import profiler
+from ray_tpu.util import state as state_api
+
+
+def _poll(fn, timeout=15.0, interval=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval)
+    return fn()
+
+
+# ------------------------------------------------------ sampler primitives
+
+
+def _busy_marker_fn(stop):
+    x = 0
+    while not stop.is_set():
+        x += 1
+    return x
+
+
+def test_dump_stacks_sees_named_thread():
+    stop = threading.Event()
+    t = threading.Thread(target=_busy_marker_fn, args=(stop,),
+                         name="busy-marker", daemon=True)
+    t.start()
+    try:
+        threads = profiler.dump_stacks()
+        names = {th["name"] for th in threads}
+        assert "MainThread" in names
+        busy = next(th for th in threads if th["name"] == "busy-marker")
+        assert any(fr["function"] == "_busy_marker_fn"
+                   for fr in busy["frames"])
+        # Frames are outermost-first with file/line/function populated.
+        assert all({"file", "line", "function"} <= set(fr)
+                   for fr in busy["frames"])
+        text = profiler.format_stack_text(threads)
+        assert "busy-marker" in text and "_busy_marker_fn" in text
+    finally:
+        stop.set()
+
+
+def test_sample_produces_collapsed_stacks_for_busy_thread():
+    stop = threading.Event()
+    t = threading.Thread(target=_busy_marker_fn, args=(stop,),
+                         name="busy-sampled", daemon=True)
+    t.start()
+    try:
+        prof = profiler.sample(0.4, hz=200)
+    finally:
+        stop.set()
+    assert prof["samples"] > 0
+    assert prof["counts"], "busy thread must yield non-empty counts"
+    hits = [s for s in prof["counts"]
+            if s.startswith("busy-sampled;") and "_busy_marker_fn" in s]
+    assert hits, prof["counts"]
+    # Folded text: "stack count" per line, heaviest first.
+    folded = profiler.to_folded(prof["counts"])
+    first = folded.splitlines()[0].rsplit(" ", 1)
+    assert first[1].isdigit()
+    assert int(first[1]) == max(prof["counts"].values())
+
+
+def test_speedscope_export_round_trips_through_json():
+    counts = {"main;a.py:f;a.py:g": 7, "main;a.py:f": 3,
+              "worker;b.py:h": 2}
+    doc = json.loads(json.dumps(profiler.to_speedscope(counts)))
+    assert doc["$schema"] == \
+        "https://www.speedscope.app/file-format-schema.json"
+    frames = doc["shared"]["frames"]
+    prof = doc["profiles"][0]
+    assert prof["type"] == "sampled"
+    assert len(prof["samples"]) == len(prof["weights"]) == 3
+    assert sorted(prof["weights"], reverse=True) == prof["weights"]
+    assert sum(prof["weights"]) == prof["endValue"] == 12
+    for stack_idxs in prof["samples"]:
+        for idx in stack_idxs:
+            assert 0 <= idx < len(frames)
+    # Shared frames dedupe: "a.py:f" appears in two stacks, once here.
+    names = [f["name"] for f in frames]
+    assert names.count("a.py:f") == 1
+
+
+def test_task_resource_sampler_and_process_stats():
+    s = profiler.TaskResourceSampler().start()
+    x = sum(i * i for i in range(200_000))
+    assert x > 0
+    usage = s.finish()
+    assert usage["cpu_s"] >= 0.0
+    assert usage["max_rss_bytes"] > 0
+    import os
+
+    stats = profiler.process_stats(os.getpid())
+    assert stats.get("rss_bytes", 0) > 0
+    assert stats.get("cpu_seconds", -1) >= 0
+    # A dead pid degrades to an empty dict, never raises.
+    assert profiler.process_stats(2 ** 30) == {}
+
+
+# --------------------------------------------------- cluster fan-out
+
+
+@pytest.fixture
+def hang_cluster():
+    """Single-node runtime with a hair-trigger hang detector."""
+    rt = ray_tpu.init(
+        num_cpus=4,
+        system_config={
+            "num_prestart_workers": 2,
+            "hang_task_warn_s": 0.5,
+        },
+    )
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def two_node_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(
+        head_resources={"CPU": 2},
+        system_config={"num_prestart_workers": 1,
+                       "default_max_retries": 0},
+    )
+    c.add_node(num_cpus=1, resources={"gadget": 1})
+    yield c
+    c.shutdown()
+
+
+def test_cluster_stacks_two_nodes_head_and_every_worker(two_node_cluster):
+    """Acceptance: `rtpu stack` on a 2-node in-process cluster returns
+    stack dumps for the head and every live worker."""
+    import os as _os
+
+    @ray_tpu.remote(resources={"gadget": 1})
+    def remote_pid():
+        import os
+
+        return os.getpid()
+
+    @ray_tpu.remote
+    def head_pid():
+        import os
+
+        return os.getpid()
+
+    rpid = ray_tpu.get(remote_pid.remote(), timeout=60)
+    hpid = ray_tpu.get(head_pid.remote(), timeout=60)
+    assert rpid != _os.getpid()
+
+    known = {w["pid"] for w in state_api.list_workers()
+             if w.get("pid") is not None}
+    reply = profiler.cluster_stacks(timeout=10.0)
+    assert reply["errors"] == {}
+    nodes = reply["nodes"]
+    assert len(nodes) == 2
+    heads = [n for n in nodes if n["is_head"]]
+    assert len(heads) == 1
+    # Every node contributes its node-manager process with live threads.
+    for n in nodes:
+        kinds = [p["kind"] for p in n["procs"]]
+        assert "node_manager" in kinds
+        for p in n["procs"]:
+            assert p["threads"], p
+            assert any(t["frames"] for t in p["threads"])
+    worker_pids = {p["pid"] for n in nodes for p in n["procs"]
+                   if p["kind"] == "worker"}
+    # Every live worker answered — including the one on the second node.
+    assert known <= worker_pids
+    assert rpid in worker_pids and hpid in worker_pids
+
+
+def test_cluster_profile_speedscope_valid(two_node_cluster):
+    """Acceptance: `rtpu profile --seconds 1 --format speedscope` emits
+    valid speedscope JSON (same pipeline: cluster_profile → merge →
+    to_speedscope)."""
+
+    @ray_tpu.remote
+    def warmup():
+        return 1
+
+    @ray_tpu.remote
+    def burn(seconds):
+        end = time.monotonic() + seconds
+        x = 0
+        while time.monotonic() < end:
+            x += 1
+        return x
+
+    assert ray_tpu.get(warmup.remote(), timeout=60) == 1
+    ref = burn.remote(3.0)
+    time.sleep(0.3)  # let the burn frame reach its worker
+    reply = profiler.cluster_profile(seconds=1.0, hz=150)
+    assert ray_tpu.get(ref, timeout=60) > 0
+    assert reply["errors"] == {}
+    assert len(reply["nodes"]) == 2
+    merged = profiler.merge_cluster_profile(reply)
+    assert merged["samples"] > 0
+    assert merged["counts"]
+    # Keys carry node + process provenance end to end.
+    assert all(k.startswith("node:") and ";pid:" in k
+               for k in merged["counts"])
+    # The burning worker shows up in somebody's samples.
+    assert any("burn" in k for k in merged["counts"]), \
+        list(merged["counts"])[:10]
+    doc = json.loads(json.dumps(profiler.to_speedscope(
+        merged["counts"], name="test profile"
+    )))
+    prof = doc["profiles"][0]
+    assert doc["shared"]["frames"] and prof["samples"]
+    assert len(prof["samples"]) == len(prof["weights"])
+    assert prof["endValue"] == sum(prof["weights"]) > 0
+
+
+def test_hang_detector_emits_warning_with_stack(hang_cluster):
+    """Acceptance: a task exceeding hang_task_warn_s produces a WARNING
+    cluster event containing a captured stack."""
+
+    @ray_tpu.remote
+    def slow_squat():
+        time.sleep(3)
+        return 41
+
+    ref = slow_squat.remote()
+    ev = _poll(lambda: next(
+        (e for e in state_api.list_cluster_events(severity="WARNING")
+         if e["source"] == "TASK" and "hang_task_warn_s" in e["message"]
+         and "slow_squat" in e["message"]), None))
+    assert ev is not None
+    cf = ev["custom_fields"]
+    assert cf["elapsed_s"] >= 0.5
+    assert cf["threshold_s"] == 0.5
+    assert cf["stack"], "worker stack must be captured"
+    assert "slow_squat" in cf["stack"]
+    # The task itself is unharmed — the detector only observes.
+    assert ray_tpu.get(ref, timeout=30) == 41
+    # One warning per run, not one per sweep.
+    time.sleep(1.2)
+    warns = [e for e in state_api.list_cluster_events(severity="WARNING")
+             if "slow_squat" in e.get("message", "")]
+    assert len(warns) == 1
+
+
+def test_list_workers_carries_current_activity(hang_cluster):
+
+    @ray_tpu.remote
+    def slow_visible():
+        time.sleep(2)
+        return 1
+
+    ref = slow_visible.remote()
+
+    def busy_row():
+        rows = [w for w in state_api.list_workers()
+                if w.get("current_task") == "slow_visible"]
+        return rows[0] if rows else None
+
+    row = _poll(busy_row, timeout=10.0)
+    assert row is not None
+    assert row["current_task_id"]
+    assert row["running_for_s"] >= 0
+    # Live /proc stats for the worker process.
+    assert row.get("rss_bytes", 0) > 0
+    assert row.get("cpu_seconds", -1) >= 0
+    assert ray_tpu.get(ref, timeout=30) == 1
+
+
+def test_terminal_task_record_carries_resource_usage(hang_cluster):
+
+    @ray_tpu.remote
+    def crunch():
+        return sum(i * i for i in range(400_000))
+
+    assert ray_tpu.get(crunch.remote(), timeout=30) > 0
+    row = _poll(lambda: next(
+        (t for t in state_api.list_tasks()
+         if t.get("retained") and t["name"] == "crunch"), None))
+    assert row["cpu_time_s"] is not None and row["cpu_time_s"] >= 0
+    assert row["max_rss_bytes"] and row["max_rss_bytes"] > 0
+
+
+# ------------------------------------------------------ dashboard plane
+
+
+def test_dashboard_stacks_and_profile_routes(hang_cluster):
+    import urllib.error
+    import urllib.request
+
+    from ray_tpu import dashboard
+
+    port = dashboard.start_dashboard(port=0)
+    try:
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=60) as r:
+                return json.loads(r.read())
+
+        stacks = fetch("/api/stacks")
+        assert stacks["nodes"]
+        procs = stacks["nodes"][0]["procs"]
+        assert any(p["kind"] == "node_manager" for p in procs)
+
+        prof = fetch("/api/profile?seconds=0.3&hz=50")
+        assert "counts" in prof and prof["nodes"]
+
+        # Non-numeric query params are a clean 400, not a traceback.
+        for bad in ("/api/profile?seconds=abc",
+                    "/api/profile?seconds=1&hz=fast"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                fetch(bad)
+            assert err.value.code == 400
+            assert "numeric" in json.loads(err.value.read())["error"]
+    finally:
+        dashboard.stop_dashboard()
+
+
+# --------------------------------------------------------- satellites
+
+
+def test_timeline_deferred_timer_cancelled_on_flush():
+    from ray_tpu.core.timeline import TaskEventBuffer
+
+    buf = TaskEventBuffer("t")
+    now = time.time()
+    buf.record("a", now, now + 0.1)      # immediate flush path
+    buf.record("b", now, now + 0.1)      # throttled: arms the timer
+    assert buf._timer is not None
+    timer = buf._timer
+    buf.flush()
+    assert buf._timer is None
+    assert not timer.is_alive() or timer.finished.is_set()
+
+
+def test_cmd_memory_sorts_once_and_reports_total(monkeypatch, capsys):
+    from ray_tpu.scripts import cli
+
+    rows = [
+        {"object_id": "aa", "size_bytes": 100, "refcount": 1,
+         "where": "shm", "node_id": "n1" * 8},
+        {"object_id": "bb", "size_bytes": None, "refcount": 1,
+         "where": "spilled", "node_id": "n1" * 8},
+        {"object_id": "cc", "size_bytes": 900, "refcount": 2,
+         "where": "shm", "node_id": "n1" * 8},
+        {"object_id": "dd", "size_bytes": 500, "refcount": 1,
+         "where": "inline", "node_id": "n1" * 8},
+    ]
+
+    class _FakeRayTpu:
+        @staticmethod
+        def shutdown():
+            pass
+
+    monkeypatch.setattr(cli, "_attached", lambda args: _FakeRayTpu)
+    monkeypatch.setattr(
+        "ray_tpu.util.state.list_objects",
+        lambda limit=10_000: list(rows),
+    )
+
+    class _Args:
+        limit = 2
+
+    assert cli.cmd_memory(_Args()) == 0
+    out = capsys.readouterr().out
+    lines = out.splitlines()
+    # Sorted by size desc, sliced once to the display limit: the two
+    # BIGGEST objects are shown, the rest only count toward TOTAL.
+    assert "cc" in lines[1] and "dd" in lines[2]
+    assert "aa" not in out and "bb" not in out
+    total_line = next(line for line in lines if "TOTAL" in line)
+    # TOTAL covers ALL 4 objects (1500 bytes), not just the 2 shown.
+    assert "4 objects" in total_line and "2 shown" in total_line
+    assert "1500" in total_line
+
+
+def test_cli_stack_and_profile_parsers():
+    """The new subcommands parse their documented flags (handlers are
+    mocked out so nothing attaches to a cluster)."""
+    import unittest.mock as mock
+
+    from ray_tpu.scripts import cli
+
+    with mock.patch.object(cli, "cmd_stack",
+                           side_effect=lambda a: 0) as mstack:
+        assert cli.main(["stack", "--worker", "abcd",
+                         "--timeout", "3", "--json"]) == 0
+        ns = mstack.call_args[0][0]
+    assert ns.worker == "abcd" and ns.timeout == 3.0 and ns.json
+
+    with mock.patch.object(cli, "cmd_profile",
+                           side_effect=lambda a: 0) as mprof:
+        assert cli.main(["profile", "--seconds", "1",
+                         "--format", "speedscope",
+                         "-o", "/tmp/x.json"]) == 0
+        ns = mprof.call_args[0][0]
+    assert ns.seconds == 1.0 and ns.format == "speedscope"
+    assert ns.output == "/tmp/x.json"

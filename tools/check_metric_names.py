@@ -18,7 +18,18 @@ that the severity and source arguments resolve to the enums declared in
 ``ray_tpu/util/events.py`` (attribute refs like ``events.ERROR``,
 string literals, and either branch of a conditional expression all
 resolve; an unknown name at an emit site would silently produce a
-ValueError at runtime instead).
+ValueError at runtime instead). Profiler emit sites (the hang/straggler
+detector's WARNING events in core/node_manager.py) go through the same
+validation.
+
+Profiler pass — (a) the config keys the profiling/hang-diagnosis plane
+documents (``hang_task_warn_s``, ``profile_max_seconds``) must exist as
+fields on ``core.config.Config`` so the README/emit sites cannot drift
+from the flag table; (b) no dashboard HTTP handler (``do_GET``/
+``do_POST`` in dashboard.py / dashboard_agent.py) may call a blocking
+sampler (``profiler.sample`` / legacy ``_sample_stacks``) on the
+request thread — handlers must use ``profiler.sample_in_thread`` or
+the cluster fan-out, which sample off-thread.
 
 Run via ``make check-obs`` (``check-metrics`` is kept as an alias) or
 directly. Exits non-zero on failure.
@@ -163,6 +174,76 @@ def validate_event_sites(pkg_dir, severities, sources):
     return failures, checked
 
 
+# Config keys the profiling & hang-diagnosis plane documents; each must
+# be a real field on core.config.Config (a typo'd getattr default would
+# otherwise silently disable the knob).
+PROFILER_CONFIG_KEYS = ("hang_task_warn_s", "profile_max_seconds")
+
+# Callables that sample for a full wall-clock duration. Calling one of
+# these from a dashboard request handler blocks (and self-pollutes) the
+# request thread; handlers must use sample_in_thread / cluster fan-out.
+BLOCKING_SAMPLERS = {"_sample_stacks"}
+BLOCKING_SAMPLER_ATTRS = {("profiler", "sample")}
+
+
+def validate_profiler_config():
+    import dataclasses
+
+    from ray_tpu.core.config import Config
+
+    fields = {f.name for f in dataclasses.fields(Config)}
+    return [
+        f"core/config.py: profiler config key {key!r} missing from "
+        f"Config (documented knob drifted from the flag table)"
+        for key in PROFILER_CONFIG_KEYS if key not in fields
+    ]
+
+
+def _is_blocking_sampler_call(node):
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id in BLOCKING_SAMPLERS:
+        return True
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in BLOCKING_SAMPLERS:
+            return True
+        if isinstance(fn.value, ast.Name) and \
+                (fn.value.id, fn.attr) in BLOCKING_SAMPLER_ATTRS:
+            return True
+    return False
+
+
+def validate_dashboard_handlers(pkg_dir):
+    """Flag blocking sampler calls inside dashboard request handlers
+    (any function named do_GET/do_POST in the dashboard modules)."""
+    failures = []
+    checked = 0
+    for fname in ("dashboard.py", "dashboard_agent.py"):
+        path = os.path.join(pkg_dir, fname)
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            failures.append(f"{path}: unparseable ({e})")
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef) or \
+                    node.name not in ("do_GET", "do_POST"):
+                continue
+            checked += 1
+            for call in ast.walk(node):
+                if isinstance(call, ast.Call) and \
+                        _is_blocking_sampler_call(call):
+                    failures.append(
+                        f"ray_tpu/{fname}:{call.lineno}: handler "
+                        f"{node.name} calls a blocking sampler on the "
+                        f"request thread (use profiler.sample_in_thread "
+                        f"or the cluster profile fan-out)"
+                    )
+    return failures, checked
+
+
 def main() -> int:
     skipped = import_package_modules()
     from ray_tpu.util.events import SEVERITIES, SOURCES
@@ -184,6 +265,15 @@ def main() -> int:
     )
     failures += event_failures
     print(f"checked {n_sites} event emit site(s)")
+
+    failures += validate_profiler_config()
+    print(f"checked {len(PROFILER_CONFIG_KEYS)} profiler config key(s)")
+    handler_failures, n_handlers = validate_dashboard_handlers(
+        os.path.join(repo_root, "ray_tpu")
+    )
+    failures += handler_failures
+    print(f"checked {n_handlers} dashboard handler(s) for blocking "
+          f"samplers")
 
     if failures:
         for f in failures:
