@@ -11,7 +11,7 @@ LDLIBS   := -lpthread -lrt
 STORE_SRC := src/store/rts_store.cc
 EXT       := ray_tpu/_native/_rtstore.so
 
-.PHONY: native native-test cpp-client clean check-obs check-metrics
+.PHONY: native native-test cpp-client clean check-obs check-metrics perf-transfer
 
 # Observability lint: every Counter/Gauge/Histogram the package declares
 # at import time (Prometheus-valid names, counters end in _total, no
@@ -22,6 +22,11 @@ check-obs:
 
 # Historical alias for check-obs.
 check-metrics: check-obs
+
+# Cross-node transfer bench: 2-node loopback, 256 MiB object through the
+# striped data plane, JSON GB/s + concurrent control-plane ping p99.
+perf-transfer:
+	JAX_PLATFORMS=cpu $(PY) tools/run_transfer_bench.py
 
 native: $(EXT)
 

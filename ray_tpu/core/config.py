@@ -78,6 +78,19 @@ class Config:
     pull_chunks_in_flight: int = 4
     serve_chunks_in_flight: int = 8
     pull_chunk_timeout_s: float = 120.0
+    # --- striped data-plane transfer (core/data_channel.py) ---------------
+    # Raw stream sockets opened lazily per peer for object payload; a large
+    # pull is striped across the pool so every stream stays busy (ref
+    # analogue: the dedicated ObjectManager RPC channel carrying chunked
+    # Push/Pull off the raylet control connection, object_manager.proto:61).
+    # 0 disables the data plane: transfers ride the control-plane chunk
+    # protocol (also the automatic fallback on any data-channel error).
+    transfer_streams_per_peer: int = 3
+    # Connect + handshake budget for one data channel.
+    transfer_connect_timeout_s: float = 10.0
+    # Per-socket-window idle timeout while streaming a range (a stalled
+    # stream fails the pull over to the control-plane protocol).
+    transfer_io_timeout_s: float = 120.0
     # How long a chunked pull may queue waiting for store memory before
     # failing (ref: pull retry/backoff bounds in pull_manager.h).
     pull_admission_timeout_s: float = 60.0

@@ -1,0 +1,528 @@
+"""Striped zero-copy object-transfer data plane.
+
+Plays the role of the reference's dedicated ObjectManager RPC channel
+(ref: src/ray/object_manager/object_manager.h — chunked Push/Pull rides
+its own gRPC server, object_manager.proto:61, NOT the raylet control
+connection): object payload moves over a small pool of raw stream
+sockets per peer, leaving the pickled control channel free for leases,
+heartbeats and task results.
+
+Wire format is length-prefixed BINARY — this module must stay pickle-free
+(tools/check_metric_names.py lints the import list):
+
+  hello     C->S  ``RTPD | u8 ver | u8 idlen | id | u16 toklen | token``
+  hello-ack S->C  ``u8 status``            (0 = accepted, else closed)
+  request   C->S  ``u8 op | u8 oidlen | u64 offset | u64 length | oid``
+  response  S->C  ``u8 status | u64 length`` then exactly ``length`` raw
+                  payload bytes (status 0) or a utf-8 error (status 1)
+
+Zero-copy on both ends: the server answers a range request with
+``socket.sendall`` over memoryview slices of the store's sealed buffer
+(no ``bytes()`` staging), and the client ``recv_into``s straight into the
+``ObjectWriter``'s pre-allocated shared-memory view. One request covers a
+whole stripe — the per-chunk request/reply round trips of the control
+protocol disappear.
+
+All I/O here is blocking-socket code driven from executor threads; the
+asyncio control loop never blocks on payload bytes.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+MAGIC = b"RTPD"
+VERSION = 1
+
+OP_PULL_RANGE = 1
+
+STATUS_OK = 0
+STATUS_ERROR = 1
+
+_HELLO_FIXED = struct.Struct("!4sBB")      # magic, version, idlen
+_HELLO_TOKEN = struct.Struct("!H")         # token length
+_HELLO_ACK = struct.Struct("!B")           # status
+_REQUEST = struct.Struct("!BBQQ")          # op, oidlen, offset, length
+_RESPONSE = struct.Struct("!BQ")           # status, length
+
+# recv_into window: large enough to amortize syscalls, small enough to
+# keep the io-timeout granular.
+_RECV_WINDOW = 1 << 20
+_MAX_ERROR_BYTES = 1 << 16
+
+
+class DataChannelError(Exception):
+    """Data-plane failure; the caller falls back to the control-plane
+    chunk protocol (mixed-version peers, dead data servers, mid-stream
+    resets all land here)."""
+
+
+def _tune(sock: socket.socket) -> None:
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass
+    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, opt, 1 << 21)
+        except OSError:
+            pass
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` completely from the socket — the zero-copy receive
+    half (payload lands directly in shared memory)."""
+    got = 0
+    total = len(view)
+    while got < total:
+        n = sock.recv_into(view[got:], min(total - got, _RECV_WINDOW))
+        if n == 0:
+            raise DataChannelError(
+                f"data channel closed mid-range ({got}/{total} bytes)"
+            )
+        got += n
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return bytes(buf)
+
+
+class DataChannel:
+    """One client-side stream socket, usable for sequential range pulls.
+    NOT thread-safe — the pool hands a channel to one stripe worker at a
+    time."""
+
+    def __init__(self, host: str, port: int, self_hex: str, token: str,
+                 *, connect_timeout: float, io_timeout: float):
+        self.host = host
+        self.port = port
+        self.closed = False
+        # Pool bookkeeping: True once handed out from the idle list (a
+        # reused channel may have been closed server-side while idle —
+        # the stripe worker retries those once on a fresh channel), and
+        # the monotonic release time for the idle TTL.
+        self.reused = False
+        self.last_release = 0.0
+        sock = socket.create_connection((host, port),
+                                        timeout=connect_timeout)
+        try:
+            from .tls import client_ssl_context
+
+            ctx = client_ssl_context()
+            if ctx is not None:
+                sock = ctx.wrap_socket(sock)
+            _tune(sock)
+            node = self_hex.encode("ascii")
+            token_b = token.encode("utf-8")
+            sock.sendall(
+                _HELLO_FIXED.pack(MAGIC, VERSION, len(node)) + node
+                + _HELLO_TOKEN.pack(len(token_b)) + token_b
+            )
+            (status,) = _HELLO_ACK.unpack(_recv_exact(sock, _HELLO_ACK.size))
+            if status != STATUS_OK:
+                raise DataChannelError(
+                    f"data channel to {host}:{port} rejected "
+                    f"(status {status})"
+                )
+            sock.settimeout(io_timeout)
+        except Exception:
+            sock.close()
+            raise
+        self._sock = sock
+
+    def pull_range(self, oid: bytes, offset: int, length: int,
+                   view: memoryview) -> None:
+        """Request ``(oid, offset, length)`` and land the payload in
+        ``view[offset:offset+length]`` via ``recv_into`` — no staging
+        copy."""
+        sock = self._sock
+        try:
+            sock.sendall(
+                _REQUEST.pack(OP_PULL_RANGE, len(oid), offset, length) + oid
+            )
+            status, resp_len = _RESPONSE.unpack(
+                _recv_exact(sock, _RESPONSE.size)
+            )
+            if status != STATUS_OK:
+                msg = _recv_exact(
+                    sock, min(resp_len, _MAX_ERROR_BYTES)
+                ).decode("utf-8", "replace")
+                raise DataChannelError(f"source refused range: {msg}")
+            if resp_len != length:
+                raise DataChannelError(
+                    f"source answered {resp_len} bytes for a {length}-byte "
+                    f"range request"
+                )
+            _recv_exact_into(sock, view[offset:offset + length])
+        except DataChannelError:
+            self.close()
+            raise
+        except (OSError, ValueError) as e:
+            self.close()
+            raise DataChannelError(str(e)) from e
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class DataChannelPool:
+    """Lazy per-peer pool of at most ``max_streams`` channels. Stripe
+    workers borrow a channel for one range and return it; channels that
+    erred are closed instead of returned. Thread-safe (workers run on
+    executor threads)."""
+
+    def __init__(self, host: str, port: int, self_hex: str, token: str,
+                 *, max_streams: int, connect_timeout: float,
+                 io_timeout: float):
+        self.host = host
+        self.port = port
+        self._self_hex = self_hex
+        self._token = token
+        self._max = max(1, int(max_streams))
+        self._connect_timeout = connect_timeout
+        self._io_timeout = io_timeout
+        # Idle channels older than this are discarded at acquire: the
+        # SERVER closes connections idle past its io timeout, so a
+        # long-idle pooled channel is likely already dead (kept well
+        # under the symmetric io_timeout default).
+        self._idle_ttl = max(5.0, io_timeout / 4.0)
+        self._lock = threading.Condition()
+        self._idle: List[DataChannel] = []
+        self._all: List[DataChannel] = []  # idle + borrowed, for close()
+        self._live = 0
+        self.closed = False
+
+    def acquire(self, timeout: float) -> DataChannel:
+        with self._lock:
+            deadline = None
+            while True:
+                if self.closed:
+                    raise DataChannelError("data channel pool closed")
+                if self._idle:
+                    import time
+
+                    ch = self._idle.pop()
+                    stale = (ch.closed
+                             or time.monotonic() - ch.last_release
+                             > self._idle_ttl)
+                    if not stale:
+                        ch.reused = True
+                        return ch
+                    ch.close()
+                    if ch in self._all:
+                        self._all.remove(ch)
+                    self._live -= 1
+                    continue
+                if self._live < self._max:
+                    self._live += 1
+                    break
+                import time
+
+                if deadline is None:
+                    deadline = time.monotonic() + timeout
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._lock.wait(remaining):
+                    raise DataChannelError(
+                        "timed out waiting for a free data channel"
+                    )
+        try:
+            ch = DataChannel(
+                self.host, self.port, self._self_hex, self._token,
+                connect_timeout=self._connect_timeout,
+                io_timeout=self._io_timeout,
+            )
+        except Exception:
+            with self._lock:
+                self._live -= 1
+                self._lock.notify()
+            raise
+        with self._lock:
+            if self.closed:
+                ch.close()
+                self._live -= 1
+                self._lock.notify()
+                raise DataChannelError("data channel pool closed")
+            self._all.append(ch)
+        return ch
+
+    def release(self, ch: DataChannel) -> None:
+        import time
+
+        with self._lock:
+            if ch.closed or self.closed:
+                ch.close()
+                if ch in self._all:
+                    self._all.remove(ch)
+                self._live -= 1
+            else:
+                ch.last_release = time.monotonic()
+                self._idle.append(ch)
+            self._lock.notify()
+
+    def discard(self, ch: DataChannel) -> None:
+        ch.close()
+        with self._lock:
+            if ch in self._all:
+                self._all.remove(ch)
+            self._live -= 1
+            self._lock.notify()
+
+    def close(self) -> None:
+        """Close every socket — including ones currently borrowed, so
+        in-flight stripe workers blocked in recv error out promptly
+        (peer death must not hang a pull for the io timeout)."""
+        with self._lock:
+            self.closed = True
+            for ch in self._all:
+                ch.close()
+            self._all.clear()
+            self._idle.clear()
+            self._lock.notify_all()
+
+
+# --------------------------------------------------------------- server
+
+
+class DataPlaneServer:
+    """Threaded accept loop serving range requests straight from the
+    store. ``open_range(oid, offset, length)`` (supplied by the transfer
+    plane) returns one of:
+
+      ("view", memoryview, release)  — sealed shared-memory range; sent
+                                       as ``sendall`` over slices, zero
+                                       userspace copies;
+      ("file", path)                 — spilled object; streamed from disk
+                                       through a reusable window buffer;
+
+    or raises ``KeyError``/``OSError`` (relayed as an error frame — the
+    puller falls back or re-resolves)."""
+
+    def __init__(self, host: str, token: str, open_range: Callable,
+                 *, chunk_bytes: int, max_streams: int,
+                 on_served: Optional[Callable[[int], None]] = None,
+                 on_range_done: Optional[Callable[[int], None]] = None,
+                 io_timeout: float = 120.0):
+        self.host = host
+        self._token = token
+        self._open_range = open_range
+        self._chunk = max(64 * 1024, int(chunk_bytes))
+        self._sem = threading.BoundedSemaphore(max(1, int(max_streams)))
+        self._on_served = on_served
+        self._on_range_done = on_range_done
+        self._io_timeout = io_timeout
+        self._listener: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_seq = 0
+        self._lock = threading.Lock()
+        self._stopped = False
+        self.port = 0
+
+    def start(self) -> int:
+        self._stopped = False
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((self.host, 0))
+        listener.listen(64)
+        self._listener = listener
+        self.port = listener.getsockname()[1]
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="rtpu-data-accept", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._stopped = True
+        listener, self._listener = self._listener, None
+        if listener is not None:
+            # A thread blocked in accept() is NOT woken by close() on
+            # Linux — shutdown() makes accept return EINVAL immediately
+            # (without it every node-manager teardown ate the full join
+            # timeout, ~2s per session across the whole test suite).
+            try:
+                listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    # ----------------------------------------------------------- internals
+
+    def _accept_loop(self) -> None:
+        listener = self._listener
+        from .tls import server_ssl_context
+
+        ctx = server_ssl_context()
+        while not self._stopped:
+            try:
+                sock, _addr = listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._conn_seq += 1
+                key = self._conn_seq
+                self._conns[key] = sock
+            # TLS wrap (and its handshake) happens on the CONNECTION
+            # thread, never here — a client stalling mid-handshake must
+            # not block every other accept.
+            threading.Thread(
+                target=self._serve_conn, args=(key, sock, ctx),
+                name="rtpu-data-serve", daemon=True,
+            ).start()
+
+    def _serve_conn(self, key: int, sock: socket.socket, ctx) -> None:
+        try:
+            # Timeout BEFORE the TLS handshake so a stalled peer is
+            # bounded by io_timeout, then wrap.
+            sock.settimeout(self._io_timeout)
+            if ctx is not None:
+                sock = ctx.wrap_socket(sock, server_side=True)
+                with self._lock:
+                    if key in self._conns:
+                        self._conns[key] = sock
+            _tune(sock)
+            if not self._handshake(sock):
+                return
+            while not self._stopped:
+                try:
+                    head = _recv_exact(sock, _REQUEST.size)
+                except DataChannelError:
+                    return  # clean close between requests
+                op, oidlen, offset, length = _REQUEST.unpack(head)
+                oid = _recv_exact(sock, oidlen)
+                if op != OP_PULL_RANGE:
+                    self._send_error(sock, f"unknown op {op}")
+                    return
+                if not self._serve_range(sock, oid, offset, length):
+                    return
+        except (OSError, DataChannelError, ValueError):
+            pass
+        finally:
+            with self._lock:
+                self._conns.pop(key, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _handshake(self, sock: socket.socket) -> bool:
+        magic, version, idlen = _HELLO_FIXED.unpack(
+            _recv_exact(sock, _HELLO_FIXED.size)
+        )
+        if magic != MAGIC or version != VERSION:
+            return False
+        _recv_exact(sock, idlen)  # peer node id (informational)
+        (toklen,) = _HELLO_TOKEN.unpack(_recv_exact(sock, _HELLO_TOKEN.size))
+        token = _recv_exact(sock, toklen).decode("utf-8", "replace")
+        if self._token and token != self._token:
+            sock.sendall(_HELLO_ACK.pack(STATUS_ERROR))
+            return False
+        sock.sendall(_HELLO_ACK.pack(STATUS_OK))
+        return True
+
+    def _send_error(self, sock: socket.socket, msg: str) -> None:
+        payload = msg.encode("utf-8")[:_MAX_ERROR_BYTES]
+        sock.sendall(_RESPONSE.pack(STATUS_ERROR, len(payload)) + payload)
+
+    def _serve_range(self, sock: socket.socket, oid: bytes,
+                     offset: int, length: int) -> bool:
+        """Stream one range; returns False when the connection must die
+        (payload already partially written — the frame cannot be
+        re-synchronized)."""
+        with self._sem:
+            try:
+                source = self._open_range(oid, offset, length)
+            except Exception as e:  # noqa: BLE001 — relayed to the puller
+                self._send_error(sock, str(e))
+                return True
+            if source[0] == "view":
+                _kind, view, release = source
+                try:
+                    sock.sendall(_RESPONSE.pack(STATUS_OK, length))
+                    # sendall over memoryview slices: payload goes from
+                    # shared memory to the socket with no bytes() copy.
+                    for off in range(0, length, self._chunk):
+                        sock.sendall(view[off:min(off + self._chunk, length)])
+                        if self._on_served is not None:
+                            self._on_served(
+                                min(self._chunk, length - off)
+                            )
+                finally:
+                    release()
+                if self._on_range_done is not None:
+                    self._on_range_done(length)
+                return True
+            _kind, path = source
+            buf = bytearray(self._chunk)
+            bview = memoryview(buf)
+            # Open BEFORE the OK header: a spill file freed between
+            # resolution and here must answer as an error frame on a
+            # live connection, not a mid-stream teardown.
+            try:
+                f = open(path, "rb")
+            except OSError as e:
+                self._send_error(sock, str(e))
+                return True
+            with f:
+                sock.sendall(_RESPONSE.pack(STATUS_OK, length))
+                f.seek(offset)
+                remaining = length
+                while remaining:
+                    n = f.readinto(bview[:min(self._chunk, remaining)])
+                    if not n:
+                        # File truncated under us: kill the connection —
+                        # the client's short read fails the stripe over
+                        # to the control plane.
+                        return False
+                    sock.sendall(bview[:n])
+                    remaining -= n
+                    if self._on_served is not None:
+                        self._on_served(n)
+            if self._on_range_done is not None:
+                self._on_range_done(length)
+            return True
+
+
+def plan_stripes(size: int, streams: int, chunk_bytes: int
+                 ) -> List[Tuple[int, int]]:
+    """Split ``[0, size)`` into at most ``streams`` contiguous ranges,
+    each a multiple of ``chunk_bytes`` (except the tail) so stripe seams
+    stay chunk-aligned. Objects a single chunk long get one stripe —
+    striping only pays when every stream has real work."""
+    if size <= 0:
+        return []
+    streams = max(1, int(streams))
+    chunks_total = -(-size // chunk_bytes)
+    streams = min(streams, chunks_total)
+    chunks_per = -(-chunks_total // streams)
+    span = chunks_per * chunk_bytes
+    out = []
+    off = 0
+    while off < size:
+        ln = min(span, size - off)
+        out.append((off, ln))
+        off += ln
+    return out

@@ -42,6 +42,7 @@ from .exceptions import (
     WorkerCrashedError,
 )
 from .gcs import GcsClient, GcsService, LocalGcsHandle, RemoteGcsHandle
+from .rpc import RpcError
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import (
     ArenaLocation,
@@ -63,7 +64,7 @@ from .protocol import AioFramedWriter, aio_read_frame
 from .resources import CPU, NodeResources, ResourceSet
 from .scheduling_policy import pick_node
 from .scheduling_strategies import PlacementGroupSchedulingStrategy
-from .task_spec import TaskSpec, TaskType
+from .task_spec import TaskSpec, TaskType, intern_spec
 from ..util import events as cluster_events
 
 _HEADER = struct.Struct("<I")
@@ -125,9 +126,18 @@ def _task_worker_type(spec: TaskSpec) -> str:
 _read_frame = aio_read_frame
 _FramedWriter = AioFramedWriter
 
+# Shared empty-location placeholder for pre-registered return slots and
+# borrow stubs (frozen dataclass — one instance serves every record).
+_RETURN_PLACEHOLDER = InlineLocation(b"")
 
-@dataclass
+
+@dataclass(slots=True)
 class TaskRecord:
+    """Queue-resident task bookkeeping. ``slots=True``: a 1M-deep queue
+    holds 1M of these, and the per-instance ``__dict__`` was the single
+    largest slice of the 4.4 GB driver RSS the r5 envelope probe
+    measured (PERF_r05.json)."""
+
     spec: TaskSpec
     state: str = "waiting"  # waiting | ready | running | forwarded | finished | failed | cancelled
     worker_id: Optional[WorkerID] = None
@@ -337,6 +347,11 @@ class NodeManager:
         self._gcs_address = gcs_address
         self.peer_port: int = 0
         self._peer_server: Optional[asyncio.AbstractServer] = None
+        # Striped transfer data plane (core/data_channel.py): raw-socket
+        # listener advertised to pullers via the pull_object locate
+        # reply; 0 = disabled (control-plane chunks only).
+        self.data_port: int = 0
+        self._data_server = None
         self._cluster_view: Dict[str, Dict[str, Any]] = {}  # hex -> view
         self._peers: Dict[str, PeerClient] = {}
         self._forwarded: Dict[TaskID, TaskRecord] = {}
@@ -482,6 +497,27 @@ class NodeManager:
             ssl=server_ssl_context(),
         )
         self.peer_port = self._peer_server.sockets[0].getsockname()[1]
+        # Data plane: object payload rides dedicated raw stream sockets
+        # (length-prefixed binary, zero-copy both ends) so a gigabyte
+        # pull never holds the pickled control channel. Failure to start
+        # is non-fatal — transfers then ride the chunk fallback.
+        if self.config.transfer_streams_per_peer > 0:
+            try:
+                from .data_channel import DataPlaneServer
+
+                self._data_server = DataPlaneServer(
+                    self.node_ip, self.config.session_token,
+                    self._transfer.open_range,
+                    chunk_bytes=self.config.object_transfer_chunk_bytes,
+                    max_streams=self.config.serve_chunks_in_flight,
+                    on_served=self._transfer.on_range_served,
+                    on_range_done=self._transfer.on_range_done,
+                    io_timeout=self.config.transfer_io_timeout_s,
+                )
+                self.data_port = self._data_server.start()
+            except Exception:
+                self._data_server = None
+                self.data_port = 0
         if self.is_head:
             self.gcs_service = GcsService(self.config, self._loop)
             await self.gcs_service.start(
@@ -1296,10 +1332,17 @@ class NodeManager:
         if mtype == "task_result":
             self._on_remote_task_result(msg)
             return None
-        if mtype == "pull_object":
-            return await self._transfer.serve_pull(msg)
-        if mtype == "pull_chunk":
-            return await self._transfer.serve_chunk(msg)
+        if mtype in ("pull_object", "pull_chunk"):
+            # Typed boundary: the transfer service's schemas validate the
+            # frame before the handler runs (rpc.py ServiceRegistry). A
+            # malformed frame fails THIS request with an error reply —
+            # never the whole shared peer channel.
+            try:
+                return await self._transfer.rpc.dispatch(
+                    peer_hex, mtype, msg
+                )
+            except RpcError as e:
+                return {"data": None, "error": str(e)}
         if mtype == "free_object":
             self._remove_ref(msg["object_id"])
             return None
@@ -1693,6 +1736,9 @@ class NodeManager:
             peer.close()
         elif peer is not None:
             peer.cancel()
+        # Its data channels are dead sockets: close them so in-flight
+        # stripe reads error out now instead of at the io timeout.
+        self._transfer.drop_peer(node_hex)
         # Borrows die with the node: void its registrations in our
         # borrower sets (owner side) and forget owners that vanished
         # (borrower side — releases to a ghost would just error).
@@ -1762,12 +1808,17 @@ class NodeManager:
         Never awaits — the driver's batched submit drain calls it straight
         from a loop callback."""
         self._stats["tasks_submitted"] += 1
+        # Unpickled specs carry fresh copies of descriptors every call of
+        # a function repeats; intern them so a deep queue stores each once.
+        intern_spec(spec)
         record = TaskRecord(spec=spec, origin=origin)
         self._tasks[spec.task_id] = record
         for oid in spec.return_ids():
             # Return slots exist in the directory from submission time so
-            # consumers can hold refs before the task runs.
-            self.directory.add(oid, InlineLocation(b""), initial_refs=0)
+            # consumers can hold refs before the task runs. One shared
+            # placeholder instance — a 1M-deep queue creates 1M slots,
+            # and the location is frozen anyway.
+            self.directory.add(oid, _RETURN_PLACEHOLDER, initial_refs=0)
         if (
             origin is None
             and spec.task_type == TaskType.NORMAL_TASK
@@ -3313,7 +3364,7 @@ class NodeManager:
         the owner (async). Returns True when a NEW stub was created, so
         completion paths can await the registration explicitly."""
         created = self.directory.add_ref_or_create(
-            oid, count, InlineLocation(b"")
+            oid, count, _RETURN_PLACEHOLDER
         )
         if created:
             self._borrow_stubs.add(oid)
@@ -4257,6 +4308,12 @@ class NodeManager:
             self.dashboard_agent.stop()
         if getattr(self, "capi_server", None) is not None:
             self.capi_server.stop()
+        # Data plane first: closing the listener + channel sockets makes
+        # in-flight stripe workers error out instead of blocking the io
+        # pool through the loop teardown below.
+        if getattr(self, "_data_server", None) is not None:
+            self._data_server.stop()
+        self._transfer.close()
 
         async def _stop():
             if self._bg_tasks:

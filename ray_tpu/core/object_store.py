@@ -29,18 +29,18 @@ class ObjectStoreFullError(Exception):
     pass
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class InlineLocation:
     data: bytes
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class ShmLocation:
     name: str
     size: int
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class ArenaLocation:
     """Object stored in the node's native C++ arena store (src/store/).
 
@@ -52,7 +52,7 @@ class ArenaLocation:
     size: int
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class RemoteLocation:
     """Object whose bytes live on another node; resolved by pulling over the
     peer channel and re-homing locally (ref analogue: an object-directory
@@ -68,7 +68,7 @@ class RemoteLocation:
     held: bool = False
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class SpilledLocation:
     """Object whose bytes were spilled to external storage under memory
     pressure; restored into the store on next access (ref analogue: a
@@ -100,6 +100,14 @@ class ObjectWriter:
 
     def write(self, offset: int, data) -> None:
         self._view[offset:offset + len(data)] = data
+
+    def readinto_view(self, offset: int, length: int) -> memoryview:
+        """Writable window over ``[offset, offset+length)`` of the
+        pre-allocated block: the data-plane receiver ``recv_into``s
+        payload straight off the socket into shared memory — no staging
+        bytes object, no second memmove (the zero-copy receive half of
+        core/data_channel.py)."""
+        return self._view[offset:offset + length]
 
     def finalize(self):
         if self.kind == "arena":
@@ -344,6 +352,22 @@ class LocalObjectStore:
             return bytes(view)
         finally:
             view.release()
+
+    def get_view_range(self, loc: Location, offset: int, length: int):
+        """``(memoryview, release)`` over one byte range of a sealed
+        object — the zero-copy send half of the transfer data plane
+        (``socket.sendall`` on the slice moves shm bytes to the NIC with
+        no ``bytes()`` staging). ``release`` drops both the slice and
+        the underlying view/pin; call it once the send completes."""
+        view = self.get_view(loc)
+        sub = view[offset:offset + length]
+
+        def release():
+            sub.release()
+            if hasattr(view, "release"):
+                view.release()
+
+        return sub, release
 
     def _put_segment(self, object_id: ObjectID, sobj: SerializedObject) -> ShmLocation:
         # Same object id written twice (e.g. a task retry after the first

@@ -1,34 +1,98 @@
-"""Chunked, flow-controlled inter-node object transfer.
+"""Inter-node object transfer: striped data plane + chunked control fallback.
 
 Plays the role of the reference's object manager data plane (ref:
 src/ray/object_manager/object_manager.h Push/Pull over
-object_manager.proto:61): large objects move as bounded-size chunks
-(``object_transfer_chunk_bytes``, ref object_manager_default_chunk_size =
-5 MiB, common/ray_config_def.h:362) with admission control on both sides —
-the puller bounds concurrent large pulls and in-flight chunk frames (ref:
-pull_manager.h:52 bundles admitted against available memory), the server
-bounds concurrent chunk reads (ref: push_manager.h:30 rate-limited chunked
-sends). Received chunks land directly in a pre-allocated store block
-(``LocalObjectStore.create_writer``), so a 1 GiB transfer occupies 1 GiB of
-store plus a few staged chunks — never a second whole-object copy, and the
-peer socket interleaves other RPCs between chunks instead of being held
-hostage by one giant frame.
+object_manager.proto:61). Two paths:
+
+**Striped data plane (default).** Object payload moves over a small pool
+of raw stream sockets per peer (core/data_channel.py,
+``transfer_streams_per_peer``), opened lazily beside the control channel.
+One request advertises ``(oid, offset, length)`` and the source streams
+the whole range back in a length-prefixed binary frame — no pickle, no
+per-chunk round trips. Large pulls are striped across the pool so every
+stream stays busy, the server sends straight from the store's sealed
+memoryview (``sendall`` on slices, zero staging copies) and the receiver
+``recv_into``s directly into the ``ObjectWriter``'s pre-allocated
+shared-memory view. The control socket carries only the initial locate
+round trip, so peer RPCs keep flowing while gigabytes move.
+
+**Control-plane chunk protocol (fallback).** The previous pickled
+request/response chunks (``pull_chunk``), kept for mixed-version peers,
+dead data servers and degraded networks: any data-channel error fails the
+pull over to this path (and emits a WARNING OBJECT_STORE event) instead
+of failing the object.
+
+Admission control is unchanged (ref: pull_manager.h:52 bundles admitted
+against available memory): the puller bounds concurrent large pulls and
+reserves whole-object bytes against store capacity before any socket
+opens; the server bounds concurrent range reads. Small objects still
+answer inline on the control channel in one round trip.
 
 Dedup notes: per-object pull dedup lives in the node manager's ``_pulls``
 future table (one pull per object per node, concurrent requesters share
-it); a broadcast (N nodes pulling one object) therefore issues exactly one
-pull per receiving node, and the source's serve semaphore spreads chunk
-reads across the N peer connections — the role of the reference's
-PushManager dedup.
+it); a broadcast (N nodes pulling one object) therefore issues exactly
+one pull per receiving node — the role of the reference's PushManager
+dedup.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Dict
+import pickle
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
 
+from ..util import events as cluster_events
+from ..util.metrics import Counter, Gauge, Histogram
+from .data_channel import DataChannelError, DataChannelPool, plan_stripes
 from .ids import ObjectID
 from .object_store import Location
+from .rpc import Method, ServiceRegistry, ServiceSpec
+
+# Observability riders on the PR 1-3 planes: byte/second series per
+# direction (pull|serve) and plane (stream|control), per-peer in-flight
+# gauges, and fallback counters. Rendered by `rtpu metrics` via the
+# util/metrics KV pipeline; tools/check_metric_names.py lints the names.
+TRANSFER_BYTES = Counter(
+    "ray_tpu_object_transfer_bytes_total",
+    "Object payload bytes moved between nodes.",
+    tag_keys=("node", "direction", "plane"),
+)
+TRANSFER_SECONDS = Histogram(
+    "ray_tpu_object_transfer_seconds",
+    "Wall seconds per completed large-object transfer.",
+    boundaries=[0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0],
+    tag_keys=("node", "direction", "plane"),
+)
+TRANSFER_INFLIGHT = Gauge(
+    "ray_tpu_object_transfer_inflight",
+    "Large-object pulls currently streaming, per source peer.",
+    tag_keys=("node", "peer"),
+)
+TRANSFER_FALLBACKS = Counter(
+    "ray_tpu_object_transfer_fallbacks_total",
+    "Pulls that fell back from the striped data plane to the "
+    "control-plane chunk protocol.",
+    tag_keys=("node",),
+)
+
+# Typed peer-service boundary (ref analogue: ObjectManagerService in
+# object_manager.proto): the control-plane half of the transfer protocol,
+# validated at dispatch so malformed peer frames fail loudly at the
+# boundary instead of as KeyErrors inside a handler.
+TRANSFER_SERVICE = ServiceSpec("ObjectTransferService", (
+    Method("pull_object",
+           request=(("object_id", "id"),
+                    ("max_unchunked", "int", False, 0)),
+           reply=(("data", "any"), ("chunked", "bool"), ("size", "int"),
+                  ("data_port", "int"), ("error", "str"))),
+    Method("pull_chunk",
+           request=(("object_id", "id"), ("offset", "int"),
+                    ("length", "int")),
+           reply=(("data", "any"), ("error", "str"))),
+))
 
 
 class TransferError(Exception):
@@ -36,25 +100,110 @@ class TransferError(Exception):
 
 
 class ObjectTransfer:
-    """Both halves of the chunk protocol, owned by the node manager."""
+    """Both halves of the transfer protocol, owned by the node manager."""
 
     def __init__(self, node_manager):
         self._nm = node_manager
         cfg = node_manager.config
         self.chunk_bytes = int(cfg.object_transfer_chunk_bytes)
+        self.streams_per_peer = int(cfg.transfer_streams_per_peer)
         # Puller-side admission: whole large pulls, then chunk frames.
         self._pull_slots = asyncio.Semaphore(cfg.pull_large_concurrency)
         self._chunk_slots = asyncio.Semaphore(cfg.pull_chunks_in_flight)
-        # Server-side: bound concurrent chunk reads (each stages one
-        # chunk_bytes copy + an executor thread).
+        # Server-side: bound concurrent control-plane chunk reads (each
+        # stages one chunk_bytes buffer + an executor thread).
         self._serve_slots = asyncio.Semaphore(cfg.serve_chunks_in_flight)
         # Memory admission (ref: pull_manager.h:52 — bundles admitted
         # against available store memory): bytes reserved by in-flight
         # chunked pulls, counted against store capacity so N admitted
         # pulls can never exceed what the store can hold.
         self._inflight_bytes = 0
-        self.stats = {"chunks_pulled": 0, "chunks_served": 0,
-                      "chunked_pulls": 0, "pulls_queued_on_memory": 0}
+        self._stats_lock = threading.Lock()
+        self.stats = {
+            "chunks_pulled": 0, "chunks_served": 0,
+            "chunked_pulls": 0, "pulls_queued_on_memory": 0,
+            # Data-plane counters (stripe = one range request on one
+            # stream; ranges_served counts the server side).
+            "striped_pulls": 0, "fallback_pulls": 0, "ranges_served": 0,
+            "bytes_pulled_stream": 0, "bytes_served_stream": 0,
+        }
+        # Stripe workers + fallback memmoves run here, NOT on the shared
+        # default executor — a pull must never starve writer finalization
+        # or spill IO of threads.
+        self._io_pool = ThreadPoolExecutor(
+            max_workers=max(4, self.streams_per_peer
+                            * int(cfg.pull_large_concurrency) + 2),
+            thread_name_prefix="rtpu-xfer",
+        )
+        # Lazily-opened data-channel pools, one per source peer.
+        self._pools: Dict[str, DataChannelPool] = {}
+        self._pools_lock = threading.Lock()
+        self._inflight_peers: Dict[str, int] = {}
+        self._closed = False
+        # Typed dispatch for the control-plane methods (node_manager
+        # routes peer pull_object/pull_chunk frames through this).
+        self.rpc = ServiceRegistry()
+        self.rpc.register(TRANSFER_SERVICE, self)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self):
+        """Node shutdown: kill every data channel (borrowed ones too, so
+        stripe workers blocked in recv error out) and the io pool."""
+        self._closed = True
+        with self._pools_lock:
+            pools = list(self._pools.values())
+            self._pools.clear()
+        for pool in pools:
+            pool.close()
+        self._io_pool.shutdown(wait=False)
+
+    def drop_peer(self, peer_hex: str):
+        """Peer death (channel lifecycle rider): its data channels are
+        dead sockets — close them so in-flight stripes fail fast to the
+        (also-dead) control path and the pull surfaces ObjectLostError.
+        The per-peer in-flight row is retired too (zeroed in the metrics
+        KV, pruned locally) so peer churn cannot grow the gauge table
+        without bound."""
+        with self._pools_lock:
+            pool = self._pools.pop(peer_hex, None)
+        if pool is not None:
+            pool.close()
+        peer_tag = peer_hex[:8]
+        with self._stats_lock:
+            had = self._inflight_peers.pop(peer_tag, None)
+        if had:
+            try:
+                TRANSFER_INFLIGHT.set(
+                    0.0, tags={"node": self._node_tag(),
+                               "peer": peer_tag}
+                )
+            except Exception:
+                pass
+
+    def _bump(self, key: str, n: int = 1):
+        with self._stats_lock:
+            self.stats[key] += n
+
+    def _node_tag(self) -> str:
+        return self._nm.node_id.hex()[:8]
+
+    def _set_inflight(self, peer_tag: str, delta: int):
+        with self._stats_lock:
+            cur = self._inflight_peers.get(peer_tag, 0) + delta
+            self._inflight_peers[peer_tag] = max(0, cur)
+            val = self._inflight_peers[peer_tag]
+        try:
+            TRANSFER_INFLIGHT.set(
+                float(val), tags={"node": self._node_tag(),
+                                  "peer": peer_tag}
+            )
+        except Exception:
+            pass
+
+    def inflight_by_peer(self) -> Dict[str, int]:
+        with self._stats_lock:
+            return {k: v for k, v in self._inflight_peers.items() if v}
 
     # ------------------------------------------------------------- pull side
 
@@ -74,13 +223,26 @@ class ObjectTransfer:
             raise TransferError(
                 reply.get("error") or "object freed on source"
             )
+        size = int(size)
         async with self._pull_slots:
-            self.stats["chunked_pulls"] += 1
-            await self._admit_bytes(int(size))
+            self._bump("chunked_pulls")
+            await self._admit_bytes(size)
+            t0 = time.perf_counter()
             try:
-                return await self._pull_chunked(peer, oid, int(size))
+                loc, plane = await self._pull_into_store(
+                    peer, reply, oid, size
+                )
             finally:
-                self._inflight_bytes -= int(size)
+                self._inflight_bytes -= size
+            try:
+                tags = {"node": self._node_tag(), "direction": "pull",
+                        "plane": plane}
+                TRANSFER_BYTES.inc(float(size), tags=tags)
+                TRANSFER_SECONDS.observe(time.perf_counter() - t0,
+                                         tags=tags)
+            except Exception:
+                pass
+            return loc
 
     async def _admit_bytes(self, size: int):
         """Queue until the store can hold ``size`` more bytes (spilling
@@ -110,7 +272,7 @@ class ObjectTransfer:
                 return
             if not queued:
                 queued = True
-                self.stats["pulls_queued_on_memory"] += 1
+                self._bump("pulls_queued_on_memory")
             # Ask the spill pass to free exactly what we lack — the
             # high-water trigger alone would no-op below the mark.
             self._nm._maybe_spill(need=size - max(free, 0))
@@ -123,67 +285,241 @@ class ObjectTransfer:
                 )
             await asyncio.sleep(0.05)
 
-    async def _pull_chunked(self, peer, oid: ObjectID, size: int) -> Location:
+    async def _pull_into_store(self, peer, reply: Dict[str, Any],
+                               oid: ObjectID, size: int):
+        """Allocate the destination block and fill it — striped data
+        plane first, control-plane chunks on any data-channel failure.
+        Returns ``(Location, plane)``."""
         store = self._nm.local_store
         loop = self._nm._loop
         writer = await loop.run_in_executor(
             None, store.create_writer, oid, size
         )
         try:
-            chunk = self.chunk_bytes
-            # Executor-thread writes in flight: a cancelled fetch coroutine
-            # does NOT stop its already-running threadpool write, so the
-            # abort path must drain THESE, not just the tasks.
-            write_futs: list = []
-
-            async def fetch(offset: int):
-                length = min(chunk, size - offset)
-                async with self._chunk_slots:
-                    reply = await peer.request(
-                        {"type": "pull_chunk", "object_id": oid,
-                         "offset": offset, "length": length},
-                        timeout=self._nm.config.pull_chunk_timeout_s,
-                    )
-                    data = reply.get("data")
-                    if data is None or len(data) != length:
-                        raise TransferError(
-                            reply.get("error")
-                            or f"chunk @{offset} missing from source"
+            plane = "control"
+            data_port = int(reply.get("data_port") or 0)
+            if data_port and self.streams_per_peer > 0 and not self._closed:
+                try:
+                    await self._pull_striped(peer, data_port, oid, size,
+                                             writer)
+                    plane = "stream"
+                except (DataChannelError, TransferError, OSError,
+                        ConnectionError) as e:
+                    # Mixed-version peer, dead data server, mid-stream
+                    # reset: fall back to the chunk protocol. Offsets
+                    # already landed are simply rewritten — chunk writes
+                    # are idempotent.
+                    self._bump("fallback_pulls")
+                    try:
+                        TRANSFER_FALLBACKS.inc(
+                            tags={"node": self._node_tag()}
                         )
-                    # Copy into shared memory off-loop (a 5 MiB memmove
-                    # should not stall the control plane).
-                    fut = loop.run_in_executor(
-                        None, writer.write, offset, data
+                    except Exception:
+                        pass
+                    cluster_events.emit(
+                        cluster_events.WARNING, cluster_events.OBJECT_STORE,
+                        f"TRANSFER fallback: striped pull of "
+                        f"{oid.hex()[:8]} ({size} B) from peer "
+                        f"{peer.peer_hex[:8]} failed ({e}); retrying over "
+                        f"the control-plane chunk protocol",
+                        node_id=self._nm.node_id.hex(),
+                        custom_fields={"object_id": oid.hex(),
+                                       "bytes": size,
+                                       "peer": peer.peer_hex,
+                                       "error": str(e)},
                     )
-                    write_futs.append(fut)
-                    await fut
-                    self.stats["chunks_pulled"] += 1
-
-            tasks = [
-                asyncio.ensure_future(fetch(off))
-                for off in range(0, size, chunk)
-            ]
-            try:
-                await asyncio.gather(*tasks)
-            except BaseException:
-                # Quiesce siblings BEFORE aborting the writer: cancel the
-                # coroutines, then wait for every started memcpy — a write
-                # racing abort() would land in freed arena memory.
-                for t in tasks:
-                    t.cancel()
-                await asyncio.gather(*tasks, return_exceptions=True)
-                await asyncio.gather(*write_futs, return_exceptions=True)
-                raise
-            return await loop.run_in_executor(None, writer.finalize)
+                    await self._pull_chunked_into(peer, oid, size, writer)
+            else:
+                await self._pull_chunked_into(peer, oid, size, writer)
+            loc = await loop.run_in_executor(None, writer.finalize)
+            return loc, plane
         except BaseException:
             writer.abort()
             raise
 
+    # ---- striped data plane -----------------------------------------------
+
+    def _get_pool(self, peer, data_port: int) -> DataChannelPool:
+        cfg = self._nm.config
+        with self._pools_lock:
+            pool = self._pools.get(peer.peer_hex)
+            if pool is not None and (
+                    pool.closed or pool.port != data_port
+                    or pool.host != peer.host):
+                # Source restarted its data server (new port) or the old
+                # pool died: start fresh — recovery is automatic because
+                # every pull re-learns the port from the locate reply.
+                pool.close()
+                pool = None
+            if pool is None:
+                pool = DataChannelPool(
+                    peer.host, data_port, self._nm.node_id.hex(),
+                    cfg.session_token,
+                    max_streams=self.streams_per_peer,
+                    connect_timeout=cfg.transfer_connect_timeout_s,
+                    io_timeout=cfg.transfer_io_timeout_s,
+                )
+                self._pools[peer.peer_hex] = pool
+            return pool
+
+    def _drop_pool(self, peer_hex: str, pool: DataChannelPool):
+        with self._pools_lock:
+            if self._pools.get(peer_hex) is pool:
+                del self._pools[peer_hex]
+
+    async def _pull_striped(self, peer, data_port: int, oid: ObjectID,
+                            size: int, writer):
+        """Stream ``[0, size)`` into the writer's shared-memory view,
+        striped across the peer's data-channel pool. All socket IO runs
+        on the transfer io pool; the control loop only awaits."""
+        pool = self._get_pool(peer, data_port)
+        stripes = plan_stripes(size, self.streams_per_peer,
+                               self.chunk_bytes)
+        view = writer.readinto_view(0, size)
+        oid_b = oid.binary()
+        peer_tag = peer.peer_hex[:8]
+        loop = self._nm._loop
+        self._set_inflight(peer_tag, +1)
+        try:
+            futs = [
+                loop.run_in_executor(
+                    self._io_pool, self._stripe_worker, pool, oid_b,
+                    off, length, view,
+                )
+                for off, length in stripes
+            ]
+            try:
+                await asyncio.gather(*futs)
+            except asyncio.CancelledError:
+                # Hard abort (caller gone / shutdown): kill the pool so
+                # sibling workers blocked in recv error out NOW, then
+                # drain every worker before the caller may abort the
+                # writer — a recv_into racing abort() would land bytes
+                # in freed arena memory.
+                pool.close()
+                await asyncio.gather(*futs, return_exceptions=True)
+                self._drop_pool(peer.peer_hex, pool)
+                raise
+            except BaseException:
+                # One stripe failed: its worker already discarded its own
+                # channel. Do NOT close the shared pool — a concurrent
+                # pull from the same peer may be streaming healthily on
+                # it, and collateral closes would cascade every pull onto
+                # the slow fallback. Drain the sibling workers (each is
+                # bounded by the io timeout) before the writer can be
+                # aborted.
+                await asyncio.gather(*futs, return_exceptions=True)
+                raise
+        finally:
+            self._set_inflight(peer_tag, -1)
+            view.release()
+        self._bump("striped_pulls")
+        self._bump("bytes_pulled_stream", size)
+
+    def _stripe_worker(self, pool: DataChannelPool, oid_b: bytes,
+                       offset: int, length: int, view: memoryview):
+        """Executor-thread body: borrow a channel, stream one stripe
+        directly into the destination view. The acquire wait is bounded
+        by the IO timeout, not the connect timeout — waiting for a busy
+        channel means another stripe is mid-transfer, which is
+        data-volume-bound."""
+        ch = pool.acquire(timeout=self._nm.config.transfer_io_timeout_s)
+        try:
+            ch.pull_range(oid_b, offset, length, view)
+        except DataChannelError:
+            was_reused = ch.reused
+            pool.discard(ch)
+            if not was_reused:
+                raise
+            # A REUSED idle channel may have been closed server-side
+            # (the server's io timeout reaps idle connections): retry
+            # exactly once on a fresh channel before failing the stripe
+            # over to the control plane. Offsets are idempotent, so a
+            # partial first attempt is simply overwritten.
+            ch = pool.acquire(
+                timeout=self._nm.config.transfer_io_timeout_s
+            )
+            try:
+                ch.pull_range(oid_b, offset, length, view)
+            except BaseException:
+                pool.discard(ch)
+                raise
+            pool.release(ch)
+            return
+        except BaseException:
+            pool.discard(ch)
+            raise
+        pool.release(ch)
+
+    # ---- control-plane fallback -------------------------------------------
+
+    async def _pull_chunked_into(self, peer, oid: ObjectID, size: int,
+                                 writer):
+        """The pre-data-plane protocol: per-chunk request/reply frames
+        over the control channel, staged through the executor into the
+        writer. Kept as the universal fallback."""
+        loop = self._nm._loop
+        chunk = self.chunk_bytes
+        # Executor-thread writes in flight: a cancelled fetch coroutine
+        # does NOT stop its already-running threadpool write, so the
+        # abort path must drain THESE, not just the tasks.
+        write_futs: list = []
+
+        async def fetch(offset: int):
+            length = min(chunk, size - offset)
+            async with self._chunk_slots:
+                reply = await peer.request(
+                    {"type": "pull_chunk", "object_id": oid,
+                     "offset": offset, "length": length},
+                    timeout=self._nm.config.pull_chunk_timeout_s,
+                )
+                data = reply.get("data")
+                if data is None or len(data) != length:
+                    raise TransferError(
+                        reply.get("error")
+                        or f"chunk @{offset} missing from source"
+                    )
+                # Copy into shared memory off-loop (a 5 MiB memmove
+                # should not stall the control plane).
+                fut = loop.run_in_executor(
+                    self._io_pool, writer.write, offset, data
+                )
+                write_futs.append(fut)
+                await fut
+                self._bump("chunks_pulled")
+
+        tasks = [
+            asyncio.ensure_future(fetch(off))
+            for off in range(0, size, chunk)
+        ]
+        try:
+            await asyncio.gather(*tasks)
+        except BaseException:
+            # Quiesce siblings BEFORE the caller aborts the writer:
+            # cancel the coroutines, then wait for every started memcpy.
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            await asyncio.gather(*write_futs, return_exceptions=True)
+            raise
+
     # ------------------------------------------------------------ serve side
+
+    async def _rpc_pull_object(self, _ctx, object_id, max_unchunked):
+        return await self.serve_pull(
+            {"object_id": object_id, "max_unchunked": max_unchunked}
+        )
+
+    async def _rpc_pull_chunk(self, _ctx, object_id, offset, length):
+        return await self.serve_chunk(
+            {"object_id": object_id, "offset": offset, "length": length}
+        )
 
     async def serve_pull(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         """First request of a pull: small objects answer with their bytes
-        (one round trip, as before); large ones advertise chunking."""
+        (one round trip, as before); large ones advertise chunking plus
+        this node's data-plane port (absent/0 = control chunks only, the
+        mixed-version escape hatch)."""
         oid = msg["object_id"]
         found = self._lookup_local(oid)
         if found is None:
@@ -191,7 +527,11 @@ class ObjectTransfer:
         loc, size = found
         max_unchunked = int(msg.get("max_unchunked") or 0)
         if max_unchunked and size > max_unchunked:
-            return {"data": None, "chunked": True, "size": size}
+            out = {"data": None, "chunked": True, "size": size}
+            data_port = int(getattr(self._nm, "data_port", 0) or 0)
+            if data_port:
+                out["data_port"] = data_port
+            return out
         try:
             data = await self._nm._loop.run_in_executor(
                 None, self._nm.local_store.get_bytes, loc
@@ -201,6 +541,11 @@ class ObjectTransfer:
             return {"data": None, "error": str(e)}
 
     async def serve_chunk(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Control-plane chunk read (fallback path + thin clients). The
+        payload rides as an in-band ``pickle.PickleBuffer`` over the
+        store's memoryview slice — the frame encoder serializes straight
+        from shared memory, no ``bytes()`` staging copy; the buffer (and
+        its store pin) is released when the sent frame is dropped."""
         oid = msg["object_id"]
         offset, length = int(msg["offset"]), int(msg["length"])
         found = self._lookup_local(oid)
@@ -212,10 +557,12 @@ class ObjectTransfer:
                 data = await self._nm._loop.run_in_executor(
                     None, self._read_range, loc, offset, length
                 )
-                self.stats["chunks_served"] += 1
+                self._bump("chunks_served")
                 return {"data": data}
             except Exception as e:
                 return {"data": None, "error": str(e)}
+
+    # ---- local range resolution (shared by both planes) -------------------
 
     def _lookup_local(self, oid: ObjectID):
         from .object_store import (
@@ -238,7 +585,50 @@ class ObjectTransfer:
                 return None
         return loc, loc.size
 
-    def _read_range(self, loc, offset: int, length: int) -> bytes:
+    def open_range(self, oid_bytes: bytes, offset: int, length: int):
+        """DataPlaneServer source hook (server threads): resolve one
+        sealed byte range. Returns ``("view", memoryview, release)`` for
+        store-resident objects or ``("file", path)`` for spilled ones;
+        raises for unknown/out-of-range requests (relayed as an error
+        frame)."""
+        from .object_store import SpilledLocation
+
+        oid = ObjectID(oid_bytes)
+        found = self._lookup_local(oid)
+        if found is None:
+            raise KeyError(f"object {oid.hex()[:8]} freed on source")
+        loc, size = found
+        if offset < 0 or length < 0 or offset + length > size:
+            raise ValueError(
+                f"range [{offset}, {offset + length}) outside object of "
+                f"{size} bytes"
+            )
+        if isinstance(loc, SpilledLocation):
+            # Ranged read straight from disk — no need to restore the
+            # whole object into the store first.
+            return ("file", loc.path)
+        view, release = self._nm.local_store.get_view_range(
+            loc, offset, length
+        )
+        return ("view", view, release)
+
+    def on_range_served(self, nbytes: int):
+        """DataPlaneServer progress hook: serve-side byte accounting."""
+        with self._stats_lock:
+            self.stats["bytes_served_stream"] += nbytes
+
+    def on_range_done(self, nbytes: int):
+        self._bump("ranges_served")
+        try:
+            TRANSFER_BYTES.inc(
+                float(nbytes),
+                tags={"node": self._node_tag(), "direction": "serve",
+                      "plane": "stream"},
+            )
+        except Exception:
+            pass
+
+    def _read_range(self, loc, offset: int, length: int):
         from .object_store import SpilledLocation
 
         if isinstance(loc, SpilledLocation):
@@ -249,7 +639,12 @@ class ObjectTransfer:
                 return f.read(length)
         view = self._nm.local_store.get_view(loc)
         try:
-            return bytes(view[offset:offset + length])
+            # In-band PickleBuffer: the encoder copies once, shm -> frame
+            # (the old bytes(view[...]) staged a second, whole-chunk
+            # copy). The slice holds its own buffer reference, so the
+            # parent view releases immediately; the slice's pin drops
+            # with the reply frame.
+            return pickle.PickleBuffer(view[offset:offset + length])
         finally:
             if hasattr(view, "release"):
                 view.release()

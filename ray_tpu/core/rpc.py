@@ -20,10 +20,15 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
-# Accepted spellings for schema types. ``None`` = any value.
+from .ids import BaseID
+
+# Accepted spellings for schema types. ``None`` = any value. ``id``
+# accepts any fixed-width cluster identifier (ObjectID, TaskID, ... —
+# the transfer/peer services carry them as first-class values, not hex).
 _TYPE_NAMES = {
     "str": str, "bytes": bytes, "int": int, "float": (int, float),
     "bool": bool, "dict": dict, "list": list, "any": None,
+    "id": BaseID,
 }
 
 

@@ -11,6 +11,7 @@ so a function is pickled once per cluster, not once per call.
 from __future__ import annotations
 
 import enum
+import sys
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -24,7 +25,7 @@ class TaskType(enum.Enum):
     ACTOR_TASK = 2
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class RefArg:
     """A top-level ObjectRef argument: resolved to its value by the executing
     worker before the function runs (nested refs pass through untouched, same
@@ -33,13 +34,18 @@ class RefArg:
     object_id: ObjectID
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class ValueArg:
     data: bytes  # framed SerializedObject bytes
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskSpec:
+    """``slots=True`` across spec/arg records: a 1M-deep task queue holds
+    one of each per task, and their per-instance ``__dict__``s were a
+    leading slice of the 4.4 GB driver RSS the r5 envelope probe
+    measured (PERF_r05.json)."""
+
     task_id: TaskID
     task_type: TaskType
     function_id: str  # content hash into the cluster function table
@@ -108,3 +114,73 @@ class TaskSpec:
         flight: resolved dependencies plus refs smuggled inside argument
         values."""
         return self.dependency_ids() + tuple(self.nested_refs)
+
+
+# Owner/actor IDs repeated by every call of a hot function: a bounded
+# canonicalization table collapses the per-spec copies unpickling creates
+# (1M queued tasks from one driver otherwise hold 1M identical WorkerID
+# objects). Cleared wholesale on overflow — correctness never depends on
+# a hit.
+_ID_INTERN_MAX = 4096
+_id_intern: Dict[bytes, Any] = {}
+
+
+def _intern_id(obj):
+    if obj is None:
+        return None
+    key = obj.binary()
+    cached = _id_intern.get(key)
+    if cached is not None and type(cached) is type(obj):
+        return cached
+    if len(_id_intern) >= _ID_INTERN_MAX:
+        _id_intern.clear()
+    _id_intern[key] = obj
+    return obj
+
+
+def intern_spec(spec: TaskSpec) -> TaskSpec:
+    """Dedup the fields every record of a hot function repeats — string
+    descriptors via ``sys.intern`` plus owner/actor ids via the table
+    above. Unpickling (worker submits, peer forwards, client replays)
+    materializes fresh copies per spec; the node manager interns at its
+    submit/forward entry points so a deep queue stores each descriptor
+    once (the 1M-queued-task driver footprint satellite)."""
+    spec.function_id = sys.intern(spec.function_id)
+    if spec.name:
+        spec.name = sys.intern(spec.name)
+    if spec.method_name:
+        spec.method_name = sys.intern(spec.method_name)
+    if spec.class_name:
+        spec.class_name = sys.intern(spec.class_name)
+    if spec.concurrency_group:
+        spec.concurrency_group = sys.intern(spec.concurrency_group)
+    if spec.runtime_env_key:
+        spec.runtime_env_key = sys.intern(spec.runtime_env_key)
+    spec.owner_id = _intern_id(spec.owner_id)
+    spec.actor_id = _intern_id(spec.actor_id)
+    spec.resources = _intern_resources(spec.resources)
+    return spec
+
+
+# Resource shapes repeat across every call of a function: canonicalize
+# identical sets so 1M queued noop tasks share ONE {"CPU": 1} ResourceSet
+# instead of holding a dict each. Safe because the scheduler treats a
+# spec's ResourceSet as immutable (arithmetic returns new sets).
+_RES_INTERN_MAX = 512
+_res_intern: Dict[tuple, Any] = {}
+
+
+def _intern_resources(res):
+    if res is None:
+        return None
+    try:
+        key = tuple(sorted(res._amounts.items()))
+    except AttributeError:
+        return res
+    cached = _res_intern.get(key)
+    if cached is not None:
+        return cached
+    if len(_res_intern) >= _RES_INTERN_MAX:
+        _res_intern.clear()
+    _res_intern[key] = res
+    return res

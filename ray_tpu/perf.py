@@ -135,6 +135,18 @@ def run_microbenchmarks(
     return results
 
 
+def driver_rss_bytes() -> int:
+    """Resident set size of this (driver) process. Recorded around the
+    queued-task probe so the footprint of a deep queue shows up in the
+    perf JSON next to its throughput (delegates to the profiler plane's
+    /proc reader rather than growing a second parser)."""
+    import os
+
+    from .util.profiler import process_stats
+
+    return int(process_stats(os.getpid()).get("rss_bytes", 0))
+
+
 def run_envelope_probes(
     *,
     num_args: int = 10_000,
@@ -180,10 +192,15 @@ def run_envelope_probes(
     def noop():
         return None
 
+    rss_before = driver_rss_bytes()
     t0 = time.perf_counter()
     queued = [noop.remote() for _ in range(num_queued)]
     submit_dt = time.perf_counter() - t0
     results[f"{num_queued} queued tasks submit ops/s"] = num_queued / submit_dt
+    results[f"{num_queued} queued tasks rss before gb"] = rss_before / 1e9
+    results[f"{num_queued} queued tasks rss after submit gb"] = (
+        driver_rss_bytes() / 1e9
+    )
     ray_tpu.get(queued, timeout=600)
     results[f"{num_queued} queued tasks drain ops/s"] = num_queued / (
         time.perf_counter() - t0

@@ -637,15 +637,53 @@ def cmd_metrics(args) -> int:
     """Dump the Prometheus exposition document (ref: scraping the
     dashboard's /metrics endpoint, without needing it up): core node
     counters/histograms of the attached node plus cluster-wide user,
-    serve, and device series aggregated from the KV pipeline."""
+    serve, and device series aggregated from the KV pipeline (the
+    ``ray_tpu_object_transfer_*`` data-plane series ride the same
+    document). ``--transfers`` prints the object-transfer plane as a
+    human-readable section instead."""
     ray_tpu = _attached(args)
     try:
         from ray_tpu.util import prometheus
 
+        if getattr(args, "transfers", False):
+            _print_transfer_section()
+            return 0
         sys.stdout.write(prometheus.render())
         return 0
     finally:
         ray_tpu.shutdown()
+
+
+def _print_transfer_section() -> None:
+    """Transfers section of `rtpu metrics`: the attached node's transfer
+    plane at a glance — per-plane byte counters, stripe/fallback counts,
+    and per-peer in-flight pulls."""
+    from ray_tpu.core.runtime_context import current_runtime
+
+    nm = getattr(current_runtime(), "_nm", None)
+    transfer = getattr(nm, "_transfer", None) if nm is not None else None
+    if transfer is None:
+        print("transfers: no local node manager attached")
+        return
+    st = dict(transfer.stats)
+    print("transfers:")
+    print(f"  data plane    : port={getattr(nm, 'data_port', 0) or 'off'} "
+          f"streams/peer={transfer.streams_per_peer}")
+    print(f"  pulls         : striped={st['striped_pulls']} "
+          f"fallback={st['fallback_pulls']} "
+          f"chunked_total={st['chunked_pulls']} "
+          f"queued_on_memory={st['pulls_queued_on_memory']}")
+    print(f"  bytes         : pulled_stream={st['bytes_pulled_stream']} "
+          f"served_stream={st['bytes_served_stream']}")
+    print(f"  control plane : chunks_pulled={st['chunks_pulled']} "
+          f"chunks_served={st['chunks_served']}")
+    print(f"  ranges_served : {st['ranges_served']}")
+    inflight = transfer.inflight_by_peer()
+    if inflight:
+        for peer, n in sorted(inflight.items()):
+            print(f"  in-flight     : peer={peer} pulls={n}")
+    else:
+        print("  in-flight     : none")
 
 
 # --------------------------------------------------------------- serve
@@ -771,6 +809,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p = sub.add_parser("metrics",
                        help="dump the Prometheus exposition text")
+    p.add_argument("--transfers", action="store_true",
+                   help="print the object-transfer plane section "
+                        "(human-readable) instead of the full document")
     _add_address(p)
     p.set_defaults(fn=cmd_metrics)
 

@@ -136,6 +136,46 @@ class _Metric:
         return tuple(sorted(merged.items()))
 
 
+class _BoundCounter:
+    """Pre-resolved (name, tags-key) counter handle — see
+    ``_Metric.with_tags``."""
+
+    __slots__ = ("_name", "_key")
+
+    def __init__(self, name: str, key: tuple):
+        self._name = name
+        self._key = key
+
+    def inc(self, value: float = 1.0):
+        _registry.record(
+            self._name, "counter", self._key,
+            lambda cur: (cur or 0.0) + value,
+        )
+
+
+class _BoundGauge:
+    __slots__ = ("_name", "_key")
+
+    def __init__(self, name: str, key: tuple):
+        self._name = name
+        self._key = key
+
+    def set(self, value: float):
+        _registry.record(self._name, "gauge", self._key, lambda cur: value)
+
+
+class _BoundHistogram:
+    __slots__ = ("_name", "_key", "_bounds")
+
+    def __init__(self, name: str, key: tuple, bounds: List[float]):
+        self._name = name
+        self._key = key
+        self._bounds = bounds
+
+    def observe(self, value: float):
+        Histogram._observe(self._name, self._bounds, self._key, value)
+
+
 class Counter(_Metric):
     KIND = "counter"
 
@@ -146,6 +186,12 @@ class Counter(_Metric):
             lambda cur: (cur or 0.0) + value,
         )
 
+    def with_tags(self, **tags) -> _BoundCounter:
+        """Resolve the tag set ONCE and return a slim recorder: hot
+        paths (per-token decode taps, per-stripe transfer accounting)
+        skip the dict merge + sort every ``inc`` otherwise pays."""
+        return _BoundCounter(self._name, self._key(tags))
+
 
 class Gauge(_Metric):
     KIND = "gauge"
@@ -154,6 +200,10 @@ class Gauge(_Metric):
         _registry.record(
             self._name, self.KIND, self._key(tags), lambda cur: value
         )
+
+    def with_tags(self, **tags) -> _BoundGauge:
+        """Pre-resolved handle; see ``Counter.with_tags``."""
+        return _BoundGauge(self._name, self._key(tags))
 
 
 class Histogram(_Metric):
@@ -168,8 +218,15 @@ class Histogram(_Metric):
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None):
-        bounds = self._boundaries
+        self._observe(self._name, self._boundaries, self._key(tags), value)
 
+    def with_tags(self, **tags) -> _BoundHistogram:
+        """Pre-resolved handle; see ``Counter.with_tags``."""
+        return _BoundHistogram(self._name, self._key(tags),
+                               self._boundaries)
+
+    @staticmethod
+    def _observe(name: str, bounds: List[float], key: tuple, value: float):
         def update(cur):
             cur = cur or {"count": 0, "sum": 0.0, "bounds": list(bounds),
                           "buckets": [0] * (len(bounds) + 1)}
@@ -183,7 +240,7 @@ class Histogram(_Metric):
                 cur["buckets"][-1] += 1
             return cur
 
-        _registry.record(self._name, self.KIND, self._key(tags), update)
+        _registry.record(name, "histogram", key, update)
 
 
 def declared_metrics() -> Dict[str, Tuple[str, str]]:

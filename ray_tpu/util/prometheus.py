@@ -105,7 +105,22 @@ def _core_lines(nm) -> List[str]:
     if transfer is not None:
         for key, val in transfer.stats.items():
             emit(f"transfer_{key}_total", "counter", val,
-                 "Inter-node object transfer chunk counter.")
+                 "Inter-node object transfer counter (chunk = control "
+                 "plane, range/stripe = data plane).")
+        # Per-peer in-flight streamed pulls of THIS node (the
+        # cluster-wide KV series covers driver-resident processes; this
+        # keeps the attached node authoritative even where the KV
+        # pipeline has no runtime to flush through).
+        inflight = getattr(transfer, "inflight_by_peer", None)
+        if callable(inflight):
+            rows = sorted(inflight().items())
+            if rows:
+                full = f"{CORE_PREFIX}_transfer_inflight_pulls"
+                lines.append(f"# HELP {full} Large-object pulls currently "
+                             "streaming, per source peer.")
+                lines.append(f"# TYPE {full} gauge")
+                for peer, n in rows:
+                    lines.append(f'{full}{{peer="{peer}"}} {n}')
     hist = getattr(nm, "_task_duration", None)
     if hist is not None:
         full = f"{CORE_PREFIX}_task_duration_seconds"

@@ -43,12 +43,15 @@ def test_chunked_pull_roundtrip(cluster):
     expected = rng.randint(0, 255, size=CHUNK * 3 + 12345, dtype=np.uint8)
     assert got.shape == expected.shape
     assert np.array_equal(got, expected)
-    # The transfer really took the multi-chunk path (>= 4 chunks).
+    # The transfer really took the large-object path: striped over the
+    # data plane (default) or >= 4 control-plane chunks (fallback).
     from ray_tpu.core.runtime_context import current_runtime
 
     stats = current_runtime()._nm._transfer.stats
     assert stats["chunked_pulls"] >= 1, stats
-    assert stats["chunks_pulled"] >= 4, stats
+    assert (stats["striped_pulls"] >= 1
+            and stats["bytes_pulled_stream"] >= CHUNK * 3) \
+        or stats["chunks_pulled"] >= 4, stats
 
 
 def test_chunked_broadcast_to_multiple_nodes(cluster):

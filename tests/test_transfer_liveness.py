@@ -1,0 +1,108 @@
+"""Control-plane liveness while the transfer data plane streams a large
+object (own module: the shared test_transfer cluster must be torn down
+before this test builds one with a raised node-death timeout)."""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+CHUNK = 256 * 1024
+
+
+def _nm():
+    from ray_tpu.core.runtime_context import current_runtime
+
+    return current_runtime()._nm
+
+
+def test_control_plane_live_during_large_pull():
+    """Peer-channel RPCs stay fast while a large object streams: the
+    data plane keeps payload OFF the control socket, so state_snapshot
+    round trips must not queue behind gigabytes (acceptance: p99 under
+    50 ms; the old protocol serialized 5 MiB pickle frames ahead of
+    every RPC). Own cluster: failure detection is not under test, so
+    the death timeout is raised — CPU-starved heartbeats on a saturated
+    CI box must not fail the latency measurement with a dead node. The
+    measurement itself retries once: p99 over ~100 samples on a shared
+    2-core CI host carries scheduler noise that is not a product
+    regression."""
+    c = Cluster(
+        head_resources={"CPU": 2},
+        system_config={
+            "num_prestart_workers": 1,
+            "default_max_retries": 0,
+            "object_transfer_chunk_bytes": CHUNK,
+            "node_death_timeout_s": 15.0,
+            "log_to_driver": False,
+        },
+    )
+    try:
+        _control_plane_liveness_body(c)
+    finally:
+        c.shutdown()
+
+
+def _measure_pull_with_rpcs(nm, produce, nbytes, peer_hex):
+    """One measured pull: stream ``nbytes`` from the peer while hammering
+    its control channel with state_snapshot RPCs; returns sorted
+    latencies (ms)."""
+    ref = produce.remote()
+    ray_tpu.wait([ref], timeout=120)
+
+    latencies = []
+    stop = threading.Event()
+
+    async def one_rpc():
+        peer = await nm._get_peer(peer_hex)
+        t0 = time.perf_counter()
+        await peer.request({"type": "state_snapshot"}, timeout=30)
+        return (time.perf_counter() - t0) * 1e3
+
+    def rpc_loop():
+        while not stop.is_set() and len(latencies) < 200:
+            fut = asyncio.run_coroutine_threadsafe(one_rpc(), nm._loop)
+            latencies.append(fut.result(timeout=30))
+
+    t = threading.Thread(target=rpc_loop)
+    t.start()
+    got = ray_tpu.get(ref, timeout=300)
+    stop.set()
+    t.join(timeout=60)
+    assert got.nbytes == nbytes
+    del got, ref
+    latencies.sort()
+    return latencies
+
+
+def _control_plane_liveness_body(cluster):
+    cluster.add_node(num_cpus=2, resources={"gadget": 2})
+    nm = _nm()
+    nbytes = 128 * 1024 * 1024
+
+    @ray_tpu.remote(resources={"gadget": 1})
+    def produce():
+        return np.ones(nbytes // 8, dtype=np.int64)
+
+    ray_tpu.get(produce.remote(), timeout=180)  # warm
+    peer_hex = next(h for h in nm._cluster_view
+                    if h != nm.node_id.hex())
+
+    p99 = None
+    for attempt in range(2):
+        latencies = _measure_pull_with_rpcs(nm, produce, nbytes, peer_hex)
+        assert len(latencies) >= 20, "not enough concurrent RPC samples"
+        p99 = latencies[min(len(latencies) - 1,
+                            int(len(latencies) * 0.99))]
+        if p99 < 50.0:
+            break
+    assert p99 is not None and p99 < 50.0, (
+        f"peer-channel RPC p99 {p99:.1f} ms during a {nbytes >> 20} MiB "
+        f"pull (both attempts)"
+    )
+    st = nm._transfer.stats
+    assert st["striped_pulls"] >= 1, st

@@ -179,6 +179,83 @@ def validate_event_sites(pkg_dir, severities, sources):
 # otherwise silently disable the knob).
 PROFILER_CONFIG_KEYS = ("hang_task_warn_s", "profile_max_seconds")
 
+# The object-transfer data plane's metric surface (core/object_transfer.py)
+# with the kind each must be declared under — the README documents these
+# names, so a rename/kind change must fail CI, not dashboards.
+TRANSFER_METRICS = {
+    "ray_tpu_object_transfer_bytes_total": "counter",
+    "ray_tpu_object_transfer_seconds": "histogram",
+    "ray_tpu_object_transfer_inflight": "gauge",
+    "ray_tpu_object_transfer_fallbacks_total": "counter",
+}
+
+# Config keys the transfer plane documents (README "Object transfer
+# plane" knobs).
+TRANSFER_CONFIG_KEYS = (
+    "transfer_streams_per_peer", "object_transfer_chunk_bytes",
+    "transfer_connect_timeout_s", "transfer_io_timeout_s",
+)
+
+
+def validate_transfer_metrics(declared):
+    failures = []
+    for name, kind in sorted(TRANSFER_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: transfer data-plane metric not declared "
+                f"(core/object_transfer.py drifted from the documented "
+                f"surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    return failures
+
+
+def validate_transfer_config():
+    import dataclasses
+
+    from ray_tpu.core.config import Config
+
+    fields = {f.name for f in dataclasses.fields(Config)}
+    return [
+        f"core/config.py: transfer config key {key!r} missing from "
+        f"Config (documented knob drifted from the flag table)"
+        for key in TRANSFER_CONFIG_KEYS if key not in fields
+    ]
+
+
+def validate_data_channel_pickle_free(pkg_dir):
+    """The data plane's whole point is no pickle on the chunk path: flag
+    any pickle/cloudpickle import in core/data_channel.py."""
+    path = os.path.join(pkg_dir, "core", "data_channel.py")
+    if not os.path.isfile(path):
+        return [f"{path}: missing (data plane deleted without updating "
+                f"the lint?)"]
+    with open(path) as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            return [f"{path}: unparseable ({e})"]
+    banned = {"pickle", "cloudpickle", "_pickle"}
+    failures = []
+    for node in ast.walk(tree):
+        names = []
+        if isinstance(node, ast.Import):
+            names = [a.name.split(".")[0] for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            names = [node.module.split(".")[0]]
+        for name in names:
+            if name in banned:
+                failures.append(
+                    f"ray_tpu/core/data_channel.py:{node.lineno}: imports "
+                    f"{name!r} — the data plane must stay pickle-free "
+                    f"(binary frames only)"
+                )
+    return failures
+
 # Callables that sample for a full wall-clock duration. Calling one of
 # these from a dashboard request handler blocks (and self-pollutes) the
 # request thread; handlers must use sample_in_thread / cluster fan-out.
@@ -268,6 +345,15 @@ def main() -> int:
 
     failures += validate_profiler_config()
     print(f"checked {len(PROFILER_CONFIG_KEYS)} profiler config key(s)")
+
+    failures += validate_transfer_metrics(declared)
+    failures += validate_transfer_config()
+    failures += validate_data_channel_pickle_free(
+        os.path.join(repo_root, "ray_tpu")
+    )
+    print(f"checked {len(TRANSFER_METRICS)} transfer metric name(s), "
+          f"{len(TRANSFER_CONFIG_KEYS)} transfer config key(s), "
+          f"data_channel pickle ban")
     handler_failures, n_handlers = validate_dashboard_handlers(
         os.path.join(repo_root, "ray_tpu")
     )
