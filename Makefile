@@ -11,7 +11,7 @@ LDLIBS   := -lpthread -lrt
 STORE_SRC := src/store/rts_store.cc
 EXT       := ray_tpu/_native/_rtstore.so
 
-.PHONY: native native-test cpp-client clean check-obs check-metrics perf-transfer
+.PHONY: native native-test cpp-client clean check-obs check-metrics perf-transfer perf-actor
 
 # Observability lint: every Counter/Gauge/Histogram the package declares
 # at import time (Prometheus-valid names, counters end in _total, no
@@ -27,6 +27,12 @@ check-metrics: check-obs
 # striped data plane, JSON GB/s + concurrent control-plane ping p99.
 perf-transfer:
 	JAX_PLATFORMS=cpu $(PY) tools/run_transfer_bench.py
+
+# Direct actor-call plane bench: loaded + unloaded sync round-trips over
+# the direct channel vs the NM-mediated path, fallback-injection
+# recovery, and the rpc dispatch micro-bench — recorded to PERF_r07.json.
+perf-actor:
+	JAX_PLATFORMS=cpu $(PY) tools/run_actor_bench.py PERF_r07.json
 
 native: $(EXT)
 

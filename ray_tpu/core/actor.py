@@ -2,8 +2,11 @@
 
 Ref analogue: python/ray/actor.py — ActorClass (:489) created by @remote on a
 class, ActorHandle (:113) with ActorMethod proxies; method calls become
-ACTOR_TASK specs routed through the control plane to the actor's dedicated
-worker, which executes them in submission order.
+ACTOR_TASK specs. In steady state the runtime routes them over the
+direct actor-call plane (a persistent framed channel straight to the
+actor's worker, sequence-ordered per handle — see runtime._DirectChannel);
+the node manager is only involved for creation, restart and failure, and
+as the transparent per-call fallback path.
 """
 
 from __future__ import annotations
@@ -89,7 +92,14 @@ class ActorHandle:
         # python machinery, not remote methods.
         if name.startswith("_") and name != "__rtpu_ping__":
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        method = ActorMethod(self, name)
+        # Cache on the instance: ``a.ping.remote()`` in a tight loop
+        # otherwise allocates a fresh proxy per call (measurable on the
+        # direct-plane hot path). Instance attributes bypass __getattr__
+        # on the next access; __reduce__ rebuilds handles without the
+        # cache, so serialized handles stay slim.
+        self.__dict__[name] = method
+        return method
 
     @property
     def actor_id(self) -> ActorID:

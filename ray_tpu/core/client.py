@@ -174,7 +174,7 @@ class _ReconnectingConn:
 _IDEMPOTENT_TYPES = {
     "get_locations", "wait", "pull_object", "pull_chunk", "kv",
     "fetch_function", "get_named_actor", "state", "ping", "put_abort",
-    "submit",
+    "submit", "get_actor_direct",
 }
 
 
@@ -182,9 +182,16 @@ class ClientRuntime(WorkerRuntime):
     """WorkerRuntime over TCP with remote object IO (no local store).
     Survives connection blips: the transport redials and re-registers,
     in-flight IDEMPOTENT requests replay automatically, and
-    non-idempotent ones fail with a clear error instead of hanging."""
+    non-idempotent ones fail with a clear error instead of hanging.
+    Actor calls ride the direct plane too — the client dials the actor
+    worker's advertised TCP endpoint, so steady-state calls skip the
+    head NM; inline results resolve from the reply, larger ones pull
+    through the head's transfer plane (no shared memory here)."""
 
     is_client = True
+    # No same-node shared memory: only inline direct results resolve
+    # from the reply; everything else redirects to the pull path.
+    _direct_store_readable = False
 
     def __init__(self, conn: Connection, node_id: NodeID,
                  worker_id: WorkerID, redial=None):
@@ -229,6 +236,10 @@ class ClientRuntime(WorkerRuntime):
 
         mtype = msg.get("type")
         idempotent = mtype in _IDEMPOTENT_TYPES
+        # Same FIFO discipline as the worker runtime: buffered
+        # direct-call registrations reach the head before any request
+        # that may resolve against them.
+        self._direct_flush_side(force=True)
         cfg_timeout = get_config().client_reconnect_timeout_s
         inflight_retries = 0
         deadline = (None if timeout is None

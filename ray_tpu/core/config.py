@@ -180,6 +180,25 @@ class Config:
     # Terminal task records (state/duration/error) each node retains for
     # the state API after the live record is dropped (failure history).
     task_history_size: int = 1000
+    # --- direct actor-call plane (ref analogue: direct actor task
+    # submission, core_worker/transport/direct_actor_task_submitter.h:
+    # once an actor is alive, callers push method calls straight to its
+    # worker over a persistent framed channel; the node manager only
+    # handles creation, restart and failure) ----------------------------
+    # Master switch; off = every actor call routes through the node
+    # manager (also the automatic per-call fallback on channel error,
+    # actor restart, or protocol-version mismatch).
+    direct_actor_calls: bool = True
+    # How long one background discovery waits for the actor's NM-side
+    # call queue to drain before reporting the actor unsupported for
+    # direct calls (retried on a later submit).
+    direct_resolve_timeout_s: float = 40.0
+    # Worker->NM completion-notification debouncing: flush when this many
+    # direct completions have buffered, or when the oldest buffered
+    # record is older than the flush interval (the ticker bound; a
+    # blocking runtime request flushes immediately either way).
+    direct_done_flush_batch: int = 16
+    direct_done_flush_ms: float = 50.0
     # --- profiling & hang diagnosis (ref analogue: `ray stack` + the
     # dashboard reporter's profile_manager) -------------------------------
     # A task running longer than this (seconds) gets its worker's stack

@@ -262,10 +262,15 @@ class ActorInfo:
     restart_count: int = 0
     name: str = ""
     death_cause: str = ""
-    # Direct-call socket the actor's worker listens on (same-node callers
+    # Direct-call endpoints the actor's worker listens on (callers
     # bypass the node manager for method calls; see worker_main
-    # _start_direct_listener / runtime.DriverRuntime._direct_channel).
+    # _start_direct_listener / runtime._DirectChannel): a unix socket
+    # for same-node callers, a TLS-aware TCP (host, port) for remote
+    # workers and thin clients, and the worker's direct protocol
+    # version (mismatched callers stay on the NM route).
     direct_path: Optional[str] = None
+    direct_addr: Optional[Tuple[str, int]] = None
+    direct_ver: int = 1
 
 
 class NodeManager:
@@ -422,6 +427,12 @@ class NodeManager:
             "tasks_retried": 0,
             "workers_started": 0,
             "actors_created": 0,
+            # Direct actor-call plane: completions reported by this
+            # node's actor workers via direct_done_batch notifications,
+            # and the number of batch frames that carried them (the
+            # ratio shows the debounce coalescing under load).
+            "direct_calls_done": 0,
+            "direct_done_batches": 0,
         }
         # Dispatch-to-completion wall-time histogram for tasks executed on
         # this node (rendered as ray_tpu_task_duration_seconds by
@@ -877,6 +888,17 @@ class NodeManager:
         env["RAY_TPU_NODE_SOCKET"] = self.socket_path
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_WORKER_TYPE"] = worker_type
+        # Direct actor-call plane: the worker's TCP listener binds this
+        # node's advertised IP, and its hello handshake + TLS wrap need
+        # the session security config even when it was set through
+        # system_config rather than the environment.
+        env["RAY_TPU_NODE_IP"] = self.node_ip
+        if self.config.session_token:
+            env["RAY_TPU_SESSION_TOKEN"] = self.config.session_token
+        if self.config.tls_cert_path:
+            env["RAY_TPU_TLS_CERT_PATH"] = self.config.tls_cert_path
+            env["RAY_TPU_TLS_KEY_PATH"] = self.config.tls_key_path
+            env["RAY_TPU_TLS_CA_PATH"] = self.config.tls_ca_path
         # Task print() output must reach the log file (and the driver's log
         # monitor) as it happens, not at process exit.
         env["PYTHONUNBUFFERED"] = "1"
@@ -1027,6 +1049,31 @@ class NodeManager:
                 info = self._actors.get(w.actor_id)
                 if info is not None:
                     info.direct_path = msg["path"]
+                    addr = msg.get("addr")
+                    info.direct_addr = tuple(addr) if addr else None
+                    info.direct_ver = msg.get("ver", 1)
+        elif mtype == "get_actor_direct":
+            # Endpoint resolution long-polls the actor's drain window;
+            # never inline it on this worker's message loop.
+            asyncio.ensure_future(self._reply_actor_direct(w, msg))
+        elif mtype == "direct_side":
+            # Caller-side bookkeeping for direct calls (the worker/client
+            # mirror of the driver's dpost drain): return-slot
+            # placeholders + arg pins at submit, seals/nested/unpins at
+            # completion — one coalesced frame per burst.
+            for oid in msg.get("returns", ()):
+                self.directory.add(oid, _RETURN_PLACEHOLDER,
+                                   initial_refs=0)
+            for oid in msg.get("pins", ()):
+                self._pin_ref_bg(oid)
+            for oid, loc in msg.get("seals", ()):
+                self._seal_object(oid, loc)
+            for roid, inner in msg.get("nested", ()):
+                self._register_nested(roid, inner)
+            for oid, count in (msg.get("unpin") or {}).items():
+                self._remove_ref(oid, count)
+        elif mtype == "direct_done_batch":
+            await self._on_direct_done_batch(w, msg)
         elif mtype == "actor_exit":
             await self._on_actor_graceful_exit(w, msg)
         elif mtype == "kill_actor":
@@ -1263,11 +1310,13 @@ class NodeManager:
             peer_hex = hello["node_id"]
             while True:
                 msg = await aio_read_frame(reader)
-                if msg.get("type") in ("stacks_dump", "profile_run"):
-                    # Long-running introspection must not head-of-line
-                    # block this channel's read loop (a 15s profile would
-                    # stall every state_snapshot/pg frame behind it);
-                    # replies match by msg_id, so order doesn't matter.
+                if msg.get("type") in ("stacks_dump", "profile_run",
+                                       "get_actor_direct_peer"):
+                    # Long-running introspection/resolution must not
+                    # head-of-line block this channel's read loop (a 15s
+                    # profile or a direct-endpoint drain wait would stall
+                    # every state_snapshot/pg frame behind it); replies
+                    # match by msg_id, so order doesn't matter.
                     asyncio.ensure_future(self._peer_reply_async(
                         peer_hex, msg, framed
                     ))
@@ -1376,6 +1425,13 @@ class NodeManager:
         if mtype == "release_bundle":
             self._release_bundle(msg["pg_id"], msg["index"])
             return None
+        if mtype == "get_actor_direct_peer":
+            # A remote caller resolving one of our actors' direct
+            # endpoints (the UDS path is useless off-node, but the
+            # caller filters by node id; the TCP addr is the payload).
+            return {"direct": await self.get_actor_direct(
+                msg["actor_id"], timeout=msg.get("timeout", 30.0)
+            )}
         if mtype == "state_snapshot":
             return {"state": self._local_state_snapshot()}
         if mtype == "stacks_dump":
@@ -2501,13 +2557,20 @@ class NodeManager:
         # while the submission-time pin still protects the object.
         for roid, nested in (msg.get("nested") or ()):
             self._register_nested(roid, nested)
+        # A "duplicate" completion is an NM-path replay of a direct call
+        # the worker already executed (and already reported through its
+        # direct_done_batch notification): the record still finishes,
+        # but stats/duration/history were counted once already.
+        duplicate = bool(msg.get("duplicate"))
         if msg.get("failed"):
-            self._stats["tasks_failed"] += 1
+            if not duplicate:
+                self._stats["tasks_failed"] += 1
             record.state = "failed"
         else:
-            self._stats["tasks_finished"] += 1
+            if not duplicate:
+                self._stats["tasks_finished"] += 1
             record.state = "finished"
-        if record.dispatched is not None:
+        if record.dispatched is not None and not duplicate:
             self._observe_task_duration(
                 time.monotonic() - record.dispatched
             )
@@ -2520,12 +2583,13 @@ class NodeManager:
         # retained in the bounded failure history instead.
         if record.spec.task_type != TaskType.ACTOR_CREATION_TASK:
             self._unpin_deps(record)
-            self._record_terminal_task(
-                record,
-                error_type=msg.get("error_type"),
-                error_message=msg.get("error_message"),
-                resource_usage=msg.get("resource_usage"),
-            )
+            if not duplicate:
+                self._record_terminal_task(
+                    record,
+                    error_type=msg.get("error_type"),
+                    error_message=msg.get("error_message"),
+                    resource_usage=msg.get("resource_usage"),
+                )
             self._tasks.pop(task_id, None)
         elif msg.get("failed"):
             self._unpin_deps(record)
@@ -2560,6 +2624,56 @@ class NodeManager:
                         self._flush_actor_queue(info)
         else:
             self._advance_worker_pipeline(w, task_id, record)
+        self._schedule()
+
+    async def _on_direct_done_batch(self, w: WorkerHandle, msg):
+        """Completion notifications for calls executed over the direct
+        actor-call plane (the worker already replied to the caller
+        inline): the NM-side _on_task_done bookkeeping still fires here
+        — ref deltas, seals for third-party consumers, holds for remote
+        callers' RemoteLocation entries, duration telemetry and the
+        terminal task history — one debounced batch frame per burst
+        (see worker_main._note_direct_done)."""
+        items = msg.get("items", ())
+        self._stats["direct_done_batches"] += 1
+        self._stats["direct_calls_done"] += len(items)
+        for item in items:
+            deltas = item.get("ref_deltas")
+            if deltas:
+                await self._apply_ref_deltas(deltas)
+            held = item.get("held")
+            for oid, loc in item["results"]:
+                self._seal_object(oid, loc)
+                if held and not isinstance(loc, InlineLocation):
+                    # The caller's node sealed a held RemoteLocation for
+                    # this result; keep our copy until it frees it.
+                    self.directory.add_ref(oid)
+            dur = item.get("duration_s")
+            if dur is not None:
+                self._observe_task_duration(dur)
+            if item.get("failed"):
+                self._stats["tasks_failed"] += 1
+            else:
+                self._stats["tasks_finished"] += 1
+            self._task_history.append({
+                "task_id": item["task_id"].hex(),
+                "name": item.get("name") or "task",
+                "state": "failed" if item.get("failed") else "finished",
+                "type": "ACTOR_TASK",
+                "via": "direct",
+                "node_id": self.node_id.hex(),
+                "actor_id": item.get("actor_id"),
+                "duration_s": round(dur, 6) if dur is not None else None,
+                "error_type": item.get("error_type"),
+                "error_message": (item.get("error_message") or "")[:500]
+                                 or None,
+                "cpu_time_s": None,
+                "max_rss_bytes": None,
+                "retry_count": 0,
+                "retries_left": 0,
+                "end_ts": time.time(),
+                "retained": True,
+            })
         self._schedule()
 
     def _seal_object(self, oid: ObjectID, loc: Location):
@@ -2798,6 +2912,19 @@ class NodeManager:
             self._fail_task(record, ActorDiedError(spec.name, cause))
             return
         if info.state in ("pending", "restarting"):
+            if getattr(spec, "direct_replay", False):
+                # A direct-channel call interrupted by the actor's death:
+                # fails like NM-routed in-flight calls do on restart —
+                # replaying it into the restarted actor would re-execute
+                # an interrupted (possibly non-idempotent) method.
+                self._fail_task(
+                    record,
+                    ActorDiedError(
+                        spec.name,
+                        "actor restarting (interrupted direct call)",
+                    ),
+                )
+                return
             info.queued.append(spec)
             record.state = "queued"
             return
@@ -2845,7 +2972,10 @@ class NodeManager:
         )
         if info.state == "dead":
             return
-        info.direct_path = None  # old worker's socket is gone either way
+        # Old worker's direct endpoints are gone either way; callers'
+        # channels die with the sockets and re-resolve after restart.
+        info.direct_path = None
+        info.direct_addr = None
         if not graceful and info.restarts_left != 0 and not self._shutdown:
             info.state = "restarting"
             if info.restarts_left > 0:
@@ -3957,12 +4087,29 @@ class NodeManager:
 
     async def get_actor_direct(
         self, actor_id: ActorID, timeout: float = 30.0
-    ) -> Optional[str]:
-        """Resolve an actor's direct-call socket path for a same-node
-        caller. Returns only once the actor is alive, advertised a path,
+    ) -> Optional[Dict[str, Any]]:
+        """Resolve an actor's direct-call endpoint descriptor
+        ({"path": uds, "addr": (host, port), "ver", "node"}). A local
+        actor answers only once it is alive, has advertised endpoints,
         AND has no node-manager-routed calls queued or in flight — the
         caller's switch to the direct channel therefore cannot overtake
-        any call routed through here (per-caller actor ordering)."""
+        any call routed through here (per-caller actor ordering). An
+        actor homed on a peer node resolves through that node's NM,
+        which applies the same drain gate."""
+        if actor_id not in self._actors:
+            home = self._actor_homes.get(actor_id)
+            if home and home != "dead":
+                try:
+                    peer = await self._get_peer(home)
+                    reply = await peer.request(
+                        {"type": "get_actor_direct_peer",
+                         "actor_id": actor_id, "timeout": timeout},
+                        timeout=timeout + 10.0,
+                    )
+                    return reply.get("direct")
+                except Exception:
+                    return None
+            return None
         start = self._loop.time()
         deadline = start + timeout
         alive_no_path_since = None
@@ -3973,7 +4120,7 @@ class NodeManager:
             if info is None or info.state == "dead":
                 return None
             if info.state == "alive":
-                if info.direct_path is None:
+                if info.direct_path is None and info.direct_addr is None:
                     # Worker predates direct support or the advert is in
                     # flight; give it a moment then report unsupported.
                     now = self._loop.time()
@@ -3982,7 +4129,12 @@ class NodeManager:
                     elif now - alive_no_path_since > 1.0:
                         return None
                 elif not info.queued and not info.inflight:
-                    return info.direct_path
+                    return {
+                        "path": info.direct_path,
+                        "addr": info.direct_addr,
+                        "ver": info.direct_ver,
+                        "node": self.node_id.hex(),
+                    }
             now = self._loop.time()
             if now > deadline:
                 return None
@@ -3990,6 +4142,21 @@ class NodeManager:
             # (the common sync case resolves in ms), coarse afterwards so
             # a long-busy actor does not ride the control loop at 200 Hz.
             await asyncio.sleep(0.005 if now - start < 0.25 else 0.05)
+
+    async def _reply_actor_direct(self, w: WorkerHandle, msg):
+        """Worker/client-side get_actor_direct request: long-polls the
+        drain window off the message loop and replies when resolved."""
+        try:
+            desc = await self.get_actor_direct(
+                msg["actor_id"], timeout=float(msg.get("timeout") or 30.0)
+            )
+        except Exception:
+            desc = None
+        try:
+            await w.writer.send({"type": "reply", "msg_id": msg["msg_id"],
+                                 "direct": desc})
+        except Exception:
+            pass
 
     async def cancel_task(self, task_id: TaskID, force: bool = False):
         record = self._tasks.get(task_id)

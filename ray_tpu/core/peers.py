@@ -30,6 +30,7 @@ class PeerClient:
         self._reader_task: Optional[asyncio.Task] = None
         self._pending: Dict[int, asyncio.Future] = {}
         self._msg_counter = 0
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.closed = False
         self.on_push: Optional[
             Callable[[str, Dict[str, Any]], Awaitable[None]]
@@ -40,6 +41,7 @@ class PeerClient:
 
         from .tls import client_ssl_context
 
+        self._loop = asyncio.get_running_loop()
         reader, writer = await asyncio.open_connection(
             self.host, self.port, ssl=client_ssl_context()
         )
@@ -71,6 +73,19 @@ class PeerClient:
         msg["msg_id"] = msg_id
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
+        # close() sets ``closed`` BEFORE snapshotting _pending, so if a
+        # foreign-thread close ran between the check above and the
+        # insert (and its snapshot therefore missed this future), the
+        # re-check below must observe closed — without it the future is
+        # stranded and the caller rides out the full timeout.
+        if self.closed:
+            self._pending.pop(msg_id, None)
+            if fut.done():
+                fut.exception()  # retrieve, avoid the never-retrieved warn
+            else:
+                fut.cancel()  # close()'s sweep skips done futures
+            raise ConnectionError(
+                f"peer {self.peer_hex[:8]} connection lost")
         await self._writer.send(msg)
         try:
             return await asyncio.wait_for(fut, timeout)
@@ -83,14 +98,42 @@ class PeerClient:
         await self._writer.send(msg)
 
     def close(self):
+        """Tear down the channel and fail every pending request() future
+        IMMEDIATELY — a caller must never ride out its full request
+        timeout (60s default) just because the peer died first. Safe
+        from any thread: when called off the owning event loop (node
+        death handling, shutdown paths), the futures are completed via
+        call_soon_threadsafe so their waiters actually wake — a bare
+        set_exception from a foreign thread marks the future without
+        waking the parked coroutine until the loop happens to spin."""
+        if self.closed:
+            return
         self.closed = True
-        for fut in self._pending.values():
-            if not fut.done():
-                fut.set_exception(
-                    ConnectionError(f"peer {self.peer_hex[:8]} connection lost")
-                )
+        pending = list(self._pending.values())
         self._pending.clear()
-        if self._reader_task is not None:
-            self._reader_task.cancel()
-        if self._writer is not None:
-            self._writer.close()
+        err = ConnectionError(f"peer {self.peer_hex[:8]} connection lost")
+        reader_task = self._reader_task
+        writer = self._writer
+
+        def _teardown():
+            for fut in pending:
+                if not fut.done():
+                    fut.set_exception(err)
+            # Task.cancel() and transport teardown are loop-owned state:
+            # they run HERE (on the owning loop when called off-loop) so
+            # the cancellation is actually processed instead of sitting
+            # unobserved until the loop happens to wake.
+            if reader_task is not None:
+                reader_task.cancel()
+            if writer is not None:
+                writer.close()
+
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if self._loop is not None and running is not self._loop \
+                and not self._loop.is_closed():
+            self._loop.call_soon_threadsafe(_teardown)
+        else:
+            _teardown()

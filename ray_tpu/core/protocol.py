@@ -14,12 +14,27 @@ import pickle
 import socket
 import struct
 import threading
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import cloudpickle
 
 _HEADER = struct.Struct("<I")
 MAX_FRAME = 1 << 31
+
+# Direct actor-call channel protocol version (caller <-> actor worker,
+# runtime._DirectChannel <-> worker_main._direct_serve). Bumped whenever
+# the frame shapes change; a mismatch at the hello handshake makes the
+# caller fall back to the node-manager-mediated submit path instead of
+# speaking a frame dialect the worker does not understand.
+DIRECT_PROTO_VER = 2
+
+# Per-channel cap on unanswered direct calls. A failing channel replays
+# every unanswered call over the NM route and relies on the worker's
+# executed-task dedup cache (worker_main._direct_seen) to keep methods
+# exactly-once — so the caller must never have more calls outstanding
+# than that cache can remember. submit() blocks (backpressure) once the
+# cap is hit; the worker cache is sized at several multiples of this.
+DIRECT_MAX_UNANSWERED = 1024
 
 
 def dumps_msg(message: Any) -> bytes:
@@ -77,6 +92,12 @@ class Connection:
             chunks.append(chunk)
             remaining -= len(chunk)
         return b"".join(chunks)
+
+    def settimeout(self, timeout: Optional[float]):
+        """Bound subsequent send/recv calls (a timeout surfaces as
+        ConnectionClosed). Used to bound handshakes with a peer that
+        accepted the connection but may never reply."""
+        self._sock.settimeout(timeout)
 
     def close(self):
         try:

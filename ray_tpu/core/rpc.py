@@ -62,10 +62,47 @@ def _fields(spec: Sequence) -> Tuple[Field, ...]:
     return tuple(out)
 
 
+def _compile_request_validator(op: str, fields: Tuple[Field, ...]):
+    """Compile a method's request schema ONCE into a closure over a flat
+    field plan. The generic path re-resolved _TYPE_NAMES and rebuilt the
+    per-field dispatch on every call — measurable on hot RPC surfaces
+    (the transfer plane's pull_object/pull_chunk fire per chunk). The
+    compiled validator raises the same RpcError texts."""
+    plan = tuple(
+        (f.name, f.required, f.default, _TYPE_NAMES[f.type], f.type)
+        for f in fields
+    )
+
+    def validate(msg: Dict[str, Any]) -> Dict[str, Any]:
+        kwargs = {}
+        for name, required, default, expected, tname in plan:
+            if name not in msg:
+                if required:
+                    raise RpcError(f"{op}: missing required field {name!r}")
+                kwargs[name] = default
+                continue
+            value = msg[name]
+            if value is None:
+                if required:
+                    raise RpcError(f"{op}: field {name!r} is None")
+            elif expected is not None and not isinstance(value, expected):
+                raise RpcError(
+                    f"{op}: field {name!r} expects {tname}, got "
+                    f"{type(value).__name__}"
+                )
+            kwargs[name] = value
+        return kwargs
+
+    return validate
+
+
 @dataclass(frozen=True)
 class Method:
     """One RPC. ``handler`` names the coroutine method on the service
-    implementation; ``notify`` marks one-way (no reply) calls."""
+    implementation; ``notify`` marks one-way (no reply) calls. The
+    request schema is compiled to a validator at construction — the
+    dispatch/stub hot paths call it instead of re-walking Field specs
+    per message."""
 
     name: str
     request: Tuple[Field, ...] = ()
@@ -78,6 +115,14 @@ class Method:
         object.__setattr__(self, "reply", _fields(self.reply))
         if not self.handler:
             object.__setattr__(self, "handler", f"_rpc_{self.name}")
+        object.__setattr__(
+            self, "validate_request",
+            _compile_request_validator(self.name, self.request),
+        )
+        object.__setattr__(
+            self, "request_names",
+            frozenset(f.name for f in self.request),
+        )
 
 
 @dataclass(frozen=True)
@@ -98,18 +143,21 @@ class ServiceRegistry:
     """Server side: validating dispatch over registered services."""
 
     def __init__(self):
-        self._methods: Dict[str, Tuple[ServiceSpec, Method, Any]] = {}
+        # op -> (spec, method, impl, bound handler): the handler is
+        # resolved once at registration, not getattr'd per dispatch.
+        self._methods: Dict[str, Tuple[ServiceSpec, Method, Any, Any]] = {}
 
     def register(self, spec: ServiceSpec, impl: Any):
         for m in spec.methods:
             if m.name in self._methods:
                 raise ValueError(f"duplicate rpc method {m.name!r}")
-            if not callable(getattr(impl, m.handler, None)):
+            handler = getattr(impl, m.handler, None)
+            if not callable(handler):
                 raise ValueError(
                     f"{spec.name}.{m.name}: implementation has no "
                     f"coroutine {m.handler!r}"
                 )
-            self._methods[m.name] = (spec, m, impl)
+            self._methods[m.name] = (spec, m, impl, handler)
 
     def lookup(self, op: str) -> Optional[Method]:
         entry = self._methods.get(op)
@@ -117,28 +165,15 @@ class ServiceRegistry:
 
     async def dispatch(self, ctx: Any, op: str,
                        msg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
-        """Validate ``msg`` against the method's request schema and call
-        the handler as ``handler(ctx, **fields)``. Returns the reply
-        dict (None for notify methods)."""
+        """Validate ``msg`` against the method's COMPILED request
+        validator and call the pre-bound handler as
+        ``handler(ctx, **fields)``. Returns the reply dict (None for
+        notify methods)."""
         entry = self._methods.get(op)
         if entry is None:
             raise RpcError(f"unknown rpc method {op!r}")
-        _, method, impl = entry
-        kwargs = {}
-        for f in method.request:
-            if f.name not in msg:
-                if f.required:
-                    raise RpcError(
-                        f"{op}: missing required field {f.name!r}"
-                    )
-                kwargs[f.name] = f.default
-                continue
-            value = msg[f.name]
-            err = f.check(value)
-            if err:
-                raise RpcError(f"{op}: {err}")
-            kwargs[f.name] = value
-        result = await getattr(impl, method.handler)(ctx, **kwargs)
+        _, method, _, handler = entry
+        result = await handler(ctx, **method.validate_request(msg))
         if method.notify:
             return None
         return result if result is not None else {}
@@ -146,7 +181,7 @@ class ServiceRegistry:
     def describe(self) -> Dict[str, Any]:
         """Introspectable service listing (the .proto equivalent)."""
         services: Dict[str, Any] = {}
-        for spec, m, _ in self._methods.values():
+        for spec, m, _, _ in self._methods.values():
             svc = services.setdefault(spec.name, {})
             svc[m.name] = {
                 "request": [
@@ -177,30 +212,45 @@ class ServiceStub:
 
     def _make(self, method: Method) -> Callable:
         transport = self._transport
+        # Compile the field plan once per stub method: the per-call loop
+        # touches only local tuples (no Field attribute chasing, no
+        # per-call name-set construction for the unknown-field check).
+        plan = tuple(
+            (f.name, f.required, _TYPE_NAMES[f.type], f.type)
+            for f in method.request
+        )
+        known = method.request_names
+        op = method.name
+        notify = method.notify
 
         async def call(_timeout: float = 30.0, **kwargs):
-            msg: Dict[str, Any] = {"op": method.name}
-            for f in method.request:
-                if f.name not in kwargs:
-                    if f.required:
+            msg: Dict[str, Any] = {"op": op}
+            for name, required, expected, tname in plan:
+                if name not in kwargs:
+                    if required:
                         raise RpcError(
-                            f"{method.name}: missing required field "
-                            f"{f.name!r}"
+                            f"{op}: missing required field {name!r}"
                         )
                     continue
-                err = f.check(kwargs[f.name])
-                if err:
-                    raise RpcError(f"{method.name}: {err}")
-                msg[f.name] = kwargs[f.name]
-            unknown = set(kwargs) - {f.name for f in method.request}
-            if unknown:
+                value = kwargs[name]
+                if value is None:
+                    if required:
+                        raise RpcError(f"{op}: field {name!r} is None")
+                elif expected is not None and not isinstance(value, expected):
+                    raise RpcError(
+                        f"{op}: field {name!r} expects {tname}, got "
+                        f"{type(value).__name__}"
+                    )
+                msg[name] = value
+            if len(kwargs) > len(msg) - 1:
+                unknown = set(kwargs) - known
                 raise RpcError(
-                    f"{method.name}: unknown fields {sorted(unknown)}"
+                    f"{op}: unknown fields {sorted(unknown)}"
                 )
-            if method.notify:
+            if notify:
                 msg["msg_id"] = None
                 return await transport.notify(msg)
             return await transport.request(msg, timeout=_timeout)
 
-        call.__name__ = method.name
+        call.__name__ = op
         return call

@@ -19,6 +19,7 @@ from .exceptions import GetTimeoutError, ObjectLostError, TaskError
 from .function_table import FunctionCache, export_function
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import InlineLocation, LocalObjectStore, Location, ShmLocation
+from .protocol import DIRECT_MAX_UNANSWERED, DIRECT_PROTO_VER
 from .reference import ObjectRef, ref_without_registration
 from .serialization import serialize, serialize_with_refs
 from .task_spec import RefArg, TaskSpec, TaskType, ValueArg
@@ -28,6 +29,42 @@ from .task_spec import RefArg, TaskSpec, TaskType, ValueArg
 import os as _os
 
 _TRACE_SUBMITS = _os.environ.get("RAY_TPU_TRACE_SUBMITS") == "1"
+
+
+# ---- direct actor-call metrics (ISSUE 5 surface) --------------------------
+# Declared at import so tools/check_metric_names.py sees them; handles are
+# pre-bound once so the per-call hot path never rebuilds tag dicts (same
+# discipline as the transfer plane's with_tags handles).
+from ..util.metrics import Counter as _MetricCounter
+from ..util.metrics import Gauge as _MetricGauge
+from ..util.metrics import Histogram as _MetricHistogram
+
+_ACTOR_CALL_SECONDS = _MetricHistogram(
+    "ray_tpu_actor_call_seconds",
+    "Actor method-call round-trip latency from submit to completion "
+    "reply over the direct actor-call plane, seconds",
+    boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.05, 0.25, 1.0],
+    tag_keys=("mode",),
+)
+_ACTOR_CALL_INFLIGHT = _MetricGauge(
+    "ray_tpu_actor_call_inflight",
+    "Direct actor calls currently awaiting their completion reply",
+    tag_keys=("pid",),
+)
+_ACTOR_CALL_FALLBACKS = _MetricCounter(
+    "ray_tpu_actor_call_fallbacks_total",
+    "Direct-eligible actor calls routed through the node-manager path "
+    "instead (reason=channel_error|unsupported|version_mismatch)",
+    tag_keys=("reason",),
+)
+_CALL_SECONDS_DIRECT = _ACTOR_CALL_SECONDS.with_tags(mode="direct")
+_CALL_INFLIGHT = _ACTOR_CALL_INFLIGHT.with_tags(pid=str(_os.getpid()))
+_FALLBACK_CHANNEL = _ACTOR_CALL_FALLBACKS.with_tags(reason="channel_error")
+_FALLBACK_UNSUPPORTED = _ACTOR_CALL_FALLBACKS.with_tags(reason="unsupported")
+_FALLBACK_VERSION = _ACTOR_CALL_FALLBACKS.with_tags(
+    reason="version_mismatch"
+)
 
 
 def _log_post_error(fut):
@@ -89,7 +126,17 @@ class RefCountTable:
 
 
 class BaseRuntime:
-    """Shared logic: argument preparation, object read path, ref accounting."""
+    """Shared logic: argument preparation, object read path, ref
+    accounting, and the direct actor-call plane (driver, worker and
+    thin-client runtimes all route eligible actor calls straight to the
+    actor's worker; the node manager only does creation/restart/failure
+    — ref analogue: direct_actor_task_submitter.h)."""
+
+    # Subclasses that speak the direct actor-call plane flip this on.
+    _direct_capable = False
+    # Whether this process can read same-node shared-memory result
+    # locations (the thin client cannot — it pulls over the wire).
+    _direct_store_readable = True
 
     def __init__(self, job_id: JobID, node_id: NodeID, worker_id: WorkerID):
         self.job_id = job_id
@@ -110,6 +157,24 @@ class BaseRuntime:
         self.current_actor_id: Optional[ActorID] = None
         self._registered_functions: set = set()
         self._function_ids: Dict[int, str] = {}
+        # ---- direct actor-call plane state (before the flusher starts:
+        # _flush_loop touches these) -----------------------------------
+        from collections import OrderedDict as _OD
+
+        # actor_id bytes -> {"lock", "status": none|discovering|ready|
+        # unsupported, "chan", "nm_seq"} — the ordering-preserving
+        # switchover state machine (see _submit_actor_task).
+        self._direct_states: Dict[bytes, Dict[str, Any]] = {}
+        self._direct_states_lock = threading.Lock()
+        # oid -> _DirectResult; resolved entries are evicted FIFO beyond
+        # the cap (the object stays resolvable through the directory).
+        self._direct_waiters: "_OD[ObjectID, _DirectResult]" = _OD()
+        self._direct_waiters_lock = threading.Lock()
+        self._dirty_chans: set = set()
+        self._dirty_chans_lock = threading.Lock()
+        # Local mirror of the fallback counter for cheap introspection
+        # (rtpu metrics --actors / run_actor_bench).
+        self._direct_fallbacks = 0
         self._flusher_stop = threading.Event()
         self._flusher = threading.Thread(
             target=self._flush_loop, name="ray_tpu-ref-flusher", daemon=True
@@ -153,10 +218,18 @@ class BaseRuntime:
         self.refs.decr(oid)
 
     def _flush_loop(self):
+        # Also the deferral bound for buffered direct-call frames and NM
+        # side-bookkeeping: a fire-and-forget caller that never gets
+        # still has its frames shipped within one flush interval.
         cfg = get_config()
         while not self._flusher_stop.wait(cfg.refcount_flush_interval_s):
             try:
                 self.refs.flush()
+                self._direct_flush_side(force=True)
+                self._flush_direct()
+                if self._direct_states:
+                    _CALL_INFLIGHT.set(self._direct_inflight())
+                    self._direct_prune_states()
             except Exception:
                 pass
 
@@ -191,21 +264,20 @@ class BaseRuntime:
         ids = [r.id() for r in ref_list]
         # Direct-call results resolve from the inline reply (the channel
         # reader registers them with the NM asynchronously) — the control
-        # plane is off the sync round-trip entirely. Only the driver
-        # runtime opens direct channels; workers take the normal path.
+        # plane is off the sync round-trip entirely. Entries flagged for
+        # redirect (replayed over the NM path after a channel death, or
+        # bytes not readable from this process) fall through to the
+        # regular location path below.
         direct_vals: Dict[ObjectID, Any] = {}
         rest_ids = []
-        waiters = getattr(self, "_direct_waiters", None)
+        waiters = self._direct_waiters
         deadline = None if timeout is None else time.monotonic() + timeout
-        if waiters is not None:
-            self._flush_direct()
+        self._flush_direct()
         for oid in ids:
             if oid in direct_vals:
                 continue
-            entry = None
-            if waiters is not None:
-                with self._direct_waiters_lock:
-                    entry = waiters.get(oid)
+            with self._direct_waiters_lock:
+                entry = waiters.get(oid)
             if entry is None:
                 rest_ids.append(oid)
                 continue
@@ -216,10 +288,17 @@ class BaseRuntime:
                     f"get() timed out after {timeout}s waiting for a "
                     f"direct actor call result"
                 )
-            direct_vals[oid] = self._resolve_direct(oid, entry)
+            value = self._resolve_direct(oid, entry)
             with self._direct_waiters_lock:
                 waiters.pop(oid, None)
+            if value is _REDIRECT:
+                rest_ids.append(oid)
+            else:
+                direct_vals[oid] = value
         if rest_ids:
+            # Side bookkeeping (seals/unpins for just-resolved replies)
+            # must reach the NM before the location lookups below.
+            self._direct_flush_side(force=True)
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
             try:
@@ -248,9 +327,18 @@ class BaseRuntime:
 
     def _resolve_direct(self, oid: ObjectID, entry: _DirectResult):
         msg = entry.payload
+        if msg.get("redirect"):
+            # Replayed over the NM path after a channel death: the
+            # replayed task's seal resolves it through the directory.
+            return _REDIRECT
         for roid, loc in msg.get("results", ()):
             if roid == oid:
-                return self.store.get_object(loc)
+                if isinstance(loc, InlineLocation) or entry.readable:
+                    return self.store.get_object(loc)
+                # Shared-memory/remote bytes this process cannot map:
+                # resolve through the location path (client pulls over
+                # the wire; remote callers pull via their NM).
+                return _REDIRECT
         # Channel died before the reply arrived.
         from .exceptions import ActorDiedError
 
@@ -328,12 +416,29 @@ class BaseRuntime:
         num_returns: int = 1,
         timeout: Optional[float] = None,
     ):
-        if getattr(self, "_direct_waiters", None) is not None:
-            self._flush_direct()
+        self._flush_direct()
         refs = list(refs)
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
-        ready_ids = set(self._wait([r.id() for r in refs], num_returns, timeout))
+        # Direct results whose reply already landed are ready NOW: count
+        # them from the waiter table so wait() on direct calls does not
+        # round-trip the control plane (whose seal may trail the reply
+        # by one completion-notification debounce window).
+        ready_ids: set = set()
+        with self._direct_waiters_lock:
+            for r in refs:
+                e = self._direct_waiters.get(r.id())
+                if (e is not None and e.event.is_set()
+                        and e.payload is not None
+                        and not e.payload.get("redirect")):
+                    ready_ids.add(r.id())
+        if len(ready_ids) < num_returns:
+            rest = [r.id() for r in refs if r.id() not in ready_ids]
+            if rest:
+                ready_ids |= set(self._wait(
+                    rest, min(num_returns - len(ready_ids), len(rest)),
+                    timeout,
+                ))
         ready, not_ready = [], []
         for r in refs:
             (ready if r.id() in ready_ids and len(ready) < num_returns
@@ -428,46 +533,531 @@ class BaseRuntime:
 
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
         self._stamp_trace(spec)
+        if (
+            self._direct_capable
+            and spec.task_type == TaskType.ACTOR_TASK
+            and spec.actor_id is not None
+            and get_config().direct_actor_calls
+        ):
+            return self._submit_actor_task(spec)
         self._submit_spec(spec)
         return [ObjectRef(oid, _register=True) for oid in spec.return_ids()]
+
+    # ---- direct actor-call plane -------------------------------------------
+
+    _DIRECT_WAITER_CAP = 8192
+
+    def _submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        """Route an actor call: over the direct channel when one is
+        ready and the call is eligible, else through the NM path — with
+        the switchover discipline that preserves per-handle ordering
+        (direct frames can never overtake NM-routed calls and vice
+        versa; see _direct_discover)."""
+        # Calls carrying retries keep the NM route: its actor-restart
+        # replay resubmits them in order; a direct channel can only
+        # fail them on worker death.
+        eligible = (not spec.streaming and spec.num_returns == 1
+                    and spec.retries_left == 0)
+        if eligible:
+            # A call chained on a still-pending direct result must not
+            # ride the same connection: the worker would execute it
+            # while the dependency's reply (and therefore its seal) may
+            # still be sitting in a reply batch — route it through the
+            # NM, which gates dispatch on sealed deps. One lock
+            # round-trip for the whole dependency scan (per-call hot
+            # path; the reader contends on this lock at full call rate).
+            waiters = self._direct_waiters
+            with self._direct_waiters_lock:
+                for dep in spec.dependency_ids():
+                    entry = waiters.get(dep)
+                    if entry is not None and not entry.event.is_set():
+                        eligible = False
+                        break
+        st = self._direct_state(spec.actor_id)
+        chan_for_fence = None
+        wait_drained = None
+        spawn_discovery = False
+        with st["lock"]:
+            if eligible and st["status"] == "ready":
+                chan = st["chan"]
+                try:
+                    self._direct_stamp_owner(spec)
+                    chan.submit(spec)
+                    return [
+                        ObjectRef(oid, _register=True)
+                        for oid in spec.return_ids()
+                    ]
+                except Exception:
+                    # Dead channel: the reader's failure path replays
+                    # its pending calls over the NM route; this call
+                    # must queue AFTER them (see wait below). Close the
+                    # raw socket (NOT chan.close(), which marks the
+                    # teardown deliberate and fails instead of
+                    # replaying) so a wedged reader wakes now.
+                    try:
+                        chan.conn.close()
+                    except Exception:
+                        pass
+                    st["status"] = "none"
+                    st["chan"] = None
+                    wait_drained = chan
+                    self._direct_fallbacks += 1
+                    _FALLBACK_CHANNEL.inc()
+            # NM path: bump the sequence so a discovery in flight cannot
+            # flip to ready underneath this call; discovery is
+            # (re)started AFTER the spec is enqueued below, so it cannot
+            # observe the actor idle before this call lands.
+            st["nm_seq"] += 1
+            if st["status"] == "ready":
+                # Ineligible call interleaving with direct traffic:
+                # fence so it cannot overtake queued direct frames.
+                chan_for_fence = st["chan"]
+            if st["status"] in ("none", "ready") or (
+                st["status"] == "unsupported"
+                and time.monotonic() >= st.get("retry_at", 0.0)
+            ):
+                st["status"] = "discovering"
+                spawn_discovery = True
+        if chan_for_fence is not None and chan_for_fence.alive:
+            try:
+                chan_for_fence.fence()
+            except Exception:
+                # The channel died mid-fence: its failure path is about
+                # to replay the queued direct calls over the NM route —
+                # order this call behind those replays, exactly like the
+                # died-before-fence branch below.
+                wait_drained = chan_for_fence
+        elif chan_for_fence is not None:
+            # The ready channel died before we could fence it: order
+            # behind its failure replays instead.
+            wait_drained = chan_for_fence
+        if wait_drained is not None:
+            wait_drained.drained.wait(15.0)
+        self._submit_spec(spec)
+        refs = [ObjectRef(oid, _register=True) for oid in spec.return_ids()]
+        if spawn_discovery:
+            # The submit above reached the NM first; the discovery's own
+            # control-plane work is processed after it, so the resolve
+            # sees this call queued.
+            threading.Thread(
+                target=self._direct_discover,
+                args=(spec.actor_id, st),
+                daemon=True,
+            ).start()
+        return refs
+
+    def _direct_state(self, actor_id: ActorID) -> Dict[str, Any]:
+        key = actor_id.binary()
+        with self._direct_states_lock:
+            st = self._direct_states.get(key)
+            if st is None:
+                st = {"lock": threading.Lock(), "status": "none",
+                      "chan": None, "nm_seq": 0}
+                self._direct_states[key] = st
+            # Touched-at stamp: the pruner must never delete an entry a
+            # submitter just fetched (it would act on the orphan — a
+            # second channel to the same actor, sequences split).
+            st["touched"] = time.monotonic()
+            return st
+
+    def _direct_discover(self, actor_id: ActorID, st: Dict[str, Any]):
+        """Background switchover: resolve the actor's direct endpoint.
+        The actor's home NM only answers once the actor is alive with NO
+        control-plane calls queued/in flight, and we only flip to ready
+        if no new NM-path call raced in (nm_seq unchanged) — so direct
+        frames can never overtake NM-routed ones."""
+        timeout = get_config().direct_resolve_timeout_s
+        while True:
+            with st["lock"]:
+                seq0 = st["nm_seq"]
+            try:
+                desc = self._direct_resolve(actor_id, timeout)
+            except BaseException:
+                # Includes CancelledError (BaseException): NM shutdown
+                # cancels in-flight loop tasks; this daemon thread must
+                # exit quietly, not print an unhandled traceback.
+                desc = None
+            if not desc:
+                # Unsupported OR just continuously busy for the whole
+                # wait window: retry on a later submit rather than
+                # pinning the actor to the slow route forever.
+                with st["lock"]:
+                    st["status"] = "unsupported"
+                    st["retry_at"] = time.monotonic() + 10.0
+                return
+            with st["lock"]:
+                if st["nm_seq"] != seq0:
+                    continue  # an NM call raced in; wait for drain again
+                chan = st["chan"]
+                need_new = (chan is None or not chan.alive
+                            or chan.desc != desc)
+            if need_new:
+                # Dial OUTSIDE the state lock: a TCP+TLS handshake must
+                # not block submitters on st["lock"].
+                try:
+                    chan = _DirectChannel(self, actor_id, desc)
+                except _DirectVersionMismatch:
+                    with st["lock"]:
+                        st["status"] = "unsupported"
+                        st["retry_at"] = time.monotonic() + 30.0
+                    self._direct_fallbacks += 1
+                    _FALLBACK_VERSION.inc()
+                    return
+                except Exception:
+                    with st["lock"]:
+                        st["status"] = "unsupported"
+                        st["retry_at"] = time.monotonic() + 10.0
+                    self._direct_fallbacks += 1
+                    _FALLBACK_UNSUPPORTED.inc()
+                    return
+            with st["lock"]:
+                if st["nm_seq"] != seq0:
+                    if need_new:
+                        chan.close()
+                    continue  # raced again; re-verify the drain
+                st["chan"] = chan
+                st["status"] = "ready"
+                return
+
+    def _direct_channel_failed(self, chan: "_DirectChannel"):
+        """The channel died (worker exit, socket error, injected fault):
+        fall back transparently. Still-unanswered calls replay through
+        the NM-mediated path IN SEQUENCE ORDER — the worker dedups
+        replayed task ids it already executed, and the NM route gates
+        ordering on its own actor queue — so per-handle call order
+        survives the failover. get()/wait() waiters parked on a replayed
+        call are redirected to the regular location path, where the
+        replayed task's seal (or failure) resolves them. A channel WE
+        closed (shutdown, explicit teardown) fails its pending calls
+        instead: the runtime is going away, replaying would resurrect
+        work the caller is abandoning."""
+        st = self._direct_state(chan.actor_id)
+        with chan.plock:
+            chan.failed = True  # later submits raise instead of stranding
+            pend = list(chan.pending.values())
+            chan.pending.clear()
+            chan.out_buf = []
+            chan._pending_cv.notify_all()  # wake a capped submitter
+        try:
+            if chan.closed_by_us:
+                for call in pend:
+                    call.entry.payload = {
+                        "failed": True, "results": [],
+                        "error": "actor died (direct channel closed)",
+                    }
+                    call.entry.event.set()
+                return
+            if not pend:
+                return
+            pend.sort(key=lambda c: c.seq)
+            self._direct_fallbacks += len(pend)
+            _FALLBACK_CHANNEL.inc(len(pend))
+            for call in pend:
+                # Wake parked waiters into the location path BEFORE the
+                # NM resubmit: the placeholder from the direct
+                # registration is already in the directory, so the
+                # redirected read blocks on the replayed task's seal.
+                call.entry.payload = {"redirect": True}
+                call.entry.event.set()
+                with self._direct_waiters_lock:
+                    self._direct_waiters.pop(call.oid, None)
+                # The direct registration pinned the args; the NM
+                # resubmit pins them again — release the direct pin.
+                self._direct_on_replay(call.dep_ids)
+                # Marked so the NM fails it (like an interrupted
+                # NM-routed call) if the actor itself died rather than
+                # just the channel.
+                call.spec.direct_replay = True
+                try:
+                    self._submit_spec(call.spec)
+                except Exception:
+                    pass
+        finally:
+            # Flip the state only AFTER the replays are queued and set
+            # ``drained``: a submitter racing the failure (its send
+            # raised, or it found the dead channel under the state lock)
+            # parks on drained before its own NM submit, so per-handle
+            # order survives the failover window.
+            with st["lock"]:
+                if st.get("chan") is chan:
+                    st["status"] = "none"
+                    st["chan"] = None
+            chan.drained.set()
+
+    def _direct_waiters_put(self, oid: ObjectID, entry: _DirectResult):
+        with self._direct_waiters_lock:
+            self._direct_waiters[oid] = entry
+            if len(self._direct_waiters) > self._DIRECT_WAITER_CAP:
+                # Evict resolved entries from the FIFO front (oldest
+                # first; the object stays resolvable through the
+                # directory). Unresolved entries are genuinely pending
+                # calls — SKIP them rather than stop, so one slow
+                # in-flight call cannot pin the table's growth under
+                # fire-and-forget load. The scan is bounded, keeping
+                # each insert O(1) amortized.
+                drop = [
+                    k
+                    for k in itertools.islice(iter(self._direct_waiters), 64)
+                    if self._direct_waiters[k].event.is_set()
+                ]
+                for k in drop:
+                    del self._direct_waiters[k]
+
+    def _mark_chan_dirty(self, chan: "_DirectChannel"):
+        with self._dirty_chans_lock:
+            self._dirty_chans.add(chan)
+
+    def _flush_direct(self):
+        if not self._dirty_chans:
+            return
+        with self._dirty_chans_lock:
+            chans = list(self._dirty_chans)
+            self._dirty_chans.clear()
+        for chan in chans:
+            try:
+                chan.flush()
+            except Exception:
+                pass
+
+    _DIRECT_STATE_CAP = 1024
+
+    def _direct_prune_states(self):
+        """Long-lived drivers/serve controllers churn through actors
+        (rolling replica generations); their channel-less state entries
+        would otherwise accumulate forever and stretch every flusher
+        walk. Dropping an idle entry is safe: the next call to that
+        actor recreates it and re-runs the drain-gated discovery."""
+        if len(self._direct_states) <= self._DIRECT_STATE_CAP:
+            return
+        cutoff = time.monotonic() - 60.0
+        with self._direct_states_lock:
+            for key, st in list(self._direct_states.items()):
+                # Only prune entries idle for a while: a submitter that
+                # fetched an entry uses it within microseconds, so the
+                # idle window guarantees nobody is holding it outside
+                # the states lock.
+                if (st.get("chan") is None
+                        and st.get("status") in ("none", "unsupported")
+                        and st.get("touched", 0.0) < cutoff):
+                    del self._direct_states[key]
+                    if len(self._direct_states) <= self._DIRECT_STATE_CAP:
+                        break
+
+    def _direct_inflight(self) -> int:
+        n = 0
+        with self._direct_states_lock:
+            chans = [st.get("chan") for st in self._direct_states.values()]
+        for chan in chans:
+            if chan is not None:
+                with chan.plock:
+                    n += len(chan.pending) + len(chan.out_buf)
+        return n
+
+    def direct_stats(self) -> Dict[str, Any]:
+        """Caller-side direct-plane snapshot (rtpu metrics --actors and
+        tools/run_actor_bench.py)."""
+        chans = []
+        with self._direct_states_lock:
+            states = {k: dict(v) for k, v in self._direct_states.items()}
+        calls = 0
+        for key, st in states.items():
+            chan = st.get("chan")
+            if chan is not None:
+                calls += chan.calls
+            chans.append({
+                "actor_id": key.hex(),
+                "status": st.get("status"),
+                "remote": bool(chan is not None and chan.remote),
+                "calls": chan.calls if chan is not None else 0,
+            })
+        return {
+            "channels": chans,
+            "calls": calls,
+            "inflight": self._direct_inflight(),
+            "fallbacks": self._direct_fallbacks,
+        }
+
+    # Subclass hooks for the direct plane. The base implementations are
+    # inert so non-capable runtimes cost nothing.
+
+    def _direct_resolve(self, actor_id: ActorID,
+                        timeout: float) -> Optional[Dict[str, Any]]:
+        """Resolve the actor's direct endpoint descriptor ({"path",
+        "addr", "ver", "node"}) via this runtime's control plane; None =
+        unsupported/busy."""
+        return None
+
+    def _direct_stamp_owner(self, spec: TaskSpec):
+        pass
+
+    def _direct_on_reg(self, spec: TaskSpec):
+        """Register return slots + pin args with this runtime's NM."""
+
+    def _direct_on_done(self, msg: Dict[str, Any], dep_ids: list,
+                        chan: "_DirectChannel"):
+        """Seal results / register nested refs / unpin args."""
+
+    def _direct_on_replay(self, dep_ids: list):
+        """Release the direct registration's arg pins before an NM-path
+        replay re-pins them."""
+
+    def _direct_flush_side(self, force: bool = False):
+        """Flush buffered NM side-bookkeeping (worker/client runtimes)."""
 
     def new_task_id(self) -> TaskID:
         return TaskID.from_random()
 
     def shutdown(self):
         self._flusher_stop.set()
+        with self._direct_states_lock:
+            states = list(self._direct_states.values())
+            self._direct_states.clear()
+        for st in states:
+            chan = st.get("chan")
+            if chan is not None:
+                chan.close()
 
 
 class _DirectResult:
     """Pending direct-call reply: the channel reader fills payload and
-    sets the event; get() resolves from it without touching the NM."""
+    sets the event; get() resolves from it without touching the NM.
+    ``readable`` records whether shared-memory result locations in the
+    reply are readable from this process (same node, store attached);
+    when False, non-inline results resolve through the regular location
+    path instead."""
 
-    __slots__ = ("event", "payload")
+    __slots__ = ("event", "payload", "readable")
 
-    def __init__(self):
+    def __init__(self, readable: bool = True):
         self.event = threading.Event()
         self.payload = None
+        self.readable = readable
+
+
+# Sentinel: this oid must resolve through the location path after all
+# (replayed over the NM route, or bytes not readable from this process).
+_REDIRECT = object()
+
+
+class _DirectVersionMismatch(ConnectionError):
+    """The actor's worker speaks a different direct-channel protocol
+    version; the caller stays on the NM-mediated path."""
+
+
+class _PendingCall:
+    __slots__ = ("oid", "entry", "dep_ids", "spec", "t0", "seq")
+
+    def __init__(self, oid, entry, dep_ids, spec, t0, seq):
+        self.oid = oid
+        self.entry = entry
+        self.dep_ids = dep_ids
+        self.spec = spec
+        self.t0 = t0
+        self.seq = seq
 
 
 class _DirectChannel:
     """Caller side of the direct actor-call transport (ref analogue:
     direct_actor_task_submitter.h — actor tasks pushed straight to the
     actor's worker over a dedicated connection; replies carry results
-    inline). One connection + reader thread per (driver, actor)."""
+    inline). One connection + reader thread per (runtime, actor): a unix
+    socket when the actor lives on this node, a TLS-aware TCP channel
+    (the worker advertises both) otherwise — so workers, serve replicas
+    and thin clients all ride the same plane. Every call frame carries a
+    per-handle monotonic sequence number ``q``; the worker executes in
+    sequence order and buffers out-of-order arrivals. On ANY channel
+    error the runtime replays still-unanswered calls through the
+    NM-mediated submit path in sequence order (the worker dedups task
+    ids it already executed), so fallback is transparent."""
 
-    def __init__(self, rt: "DriverRuntime", actor_id: ActorID, path: str):
-        from .protocol import connect_unix
+    def __init__(self, rt: "BaseRuntime", actor_id: ActorID,
+                 desc: Dict[str, Any]):
+        from .protocol import Connection, connect_unix
 
         self.rt = rt
         self.actor_id = actor_id
-        self.path = path
-        self.conn = connect_unix(path, timeout=5.0)
+        self.desc = desc
+        self.node_hex = desc.get("node") or rt.node_id.hex()
+        self.remote = self.node_hex != rt.node_id.hex()
+        ver = desc.get("ver", 1)
+        if ver != DIRECT_PROTO_VER:
+            raise _DirectVersionMismatch(
+                f"worker speaks direct protocol v{ver}, "
+                f"caller v{DIRECT_PROTO_VER}"
+            )
+        path = desc.get("path")
+        addr = desc.get("addr")
+        # The unix socket only exists on the actor's host. A thin client
+        # shares the HEAD's node id, so the node check alone cannot tell
+        # a co-located client from one on another machine — require the
+        # path to actually exist here before dialing it, else use TCP.
+        if path and not self.remote and _os.path.exists(path):
+            self.conn = connect_unix(path, timeout=5.0)
+        elif addr:
+            import socket as _socket
+
+            from .tls import client_ssl_context
+
+            sock = _socket.create_connection(
+                (addr[0], int(addr[1])),
+                timeout=get_config().transfer_connect_timeout_s,
+            )
+            ctx = client_ssl_context()
+            if ctx is not None:
+                sock = ctx.wrap_socket(sock)
+            sock.settimeout(None)
+            self.conn = Connection(sock)
+        else:
+            raise ConnectionError("actor advertised no direct endpoint")
+        # Hello/welcome handshake: session token, protocol version and
+        # the caller's node (the worker holds non-inline results for
+        # remote callers until their RemoteLocation entry is collected).
+        # Bounded: a worker that accepted the connection but never
+        # replies (wedged, SIGSTOPped, half-open socket) must fail the
+        # dial — discovery then retries via the unsupported path —
+        # rather than pin this discovery thread forever.
+        self.conn.settimeout(10.0)
+        self.conn.send({
+            "type": "direct_hello", "ver": DIRECT_PROTO_VER,
+            "token": get_config().session_token,
+            "actor_id": actor_id.hex(), "node": rt.node_id.hex(),
+        })
+        welcome = self.conn.recv()
+        self.conn.settimeout(None)
+        if welcome.get("type") != "direct_welcome" or not welcome.get("ok"):
+            self.conn.close()
+            err = welcome.get("error", "refused")
+            if "version" in str(err):
+                raise _DirectVersionMismatch(err)
+            raise ConnectionError(f"direct hello refused: {err}")
+        # Can this process read same-node shared-memory result locations?
+        self.store_readable = (not self.remote) and rt._direct_store_readable
         self.alive = True
+        self.closed_by_us = False
+        # Set UNDER plock by the failure path before it drains pending:
+        # a submitter that appended earlier is in the drained set (and
+        # replays); one that arrives later sees the flag and raises —
+        # without this, a submit racing the drain could strand a call
+        # that is never sent, never replayed and never failed.
+        self.failed = False
+        # Set once the failure path has finished replaying/failing this
+        # channel's pending calls: a submitter racing the failure parks
+        # on it so its NM-path submit cannot overtake the replays.
+        self.drained = threading.Event()
         self.plock = threading.Lock()
-        self.pending: Dict[TaskID, Tuple[ObjectID, _DirectResult, list]] = {}
+        # Wakes a submitter blocked on the unanswered-call cap (see
+        # submit) when replies drain pending or the channel fails.
+        self._pending_cv = threading.Condition(self.plock)
+        # Serializes pop-buffer + socket-send so a fence frame can never
+        # overtake frames a concurrent flush already popped but had not
+        # yet written (the fence promise covers every EARLIER call).
+        self._flush_lock = threading.Lock()
+        self.pending: Dict[TaskID, _PendingCall] = {}
         self.out_buf: List[Dict[str, Any]] = []
         self._fences: Dict[int, threading.Event] = {}
         self._fence_seq = itertools.count(1)
+        # Per-handle monotonic call sequence (stamped as "q" on frames).
+        self._seq = itertools.count(1)
         # Call-frame templates (wire-size fast path): the first call of a
         # given (method, group) shape ships its full spec and registers
         # it under a small id; subsequent calls ship ~60-byte frames of
@@ -475,6 +1065,7 @@ class _DirectChannel:
         # (~650 B, ~15 us each way) dominates trivial-call frames.
         self._templates: Dict[tuple, int] = {}
         self._template_seq = itertools.count(1)
+        self.calls = 0
         threading.Thread(
             target=self._reader, name="ray_tpu-direct-reader", daemon=True
         ).start()
@@ -484,8 +1075,24 @@ class _DirectChannel:
         get()/wait()/fence() and the runtime's periodic flusher are the
         flush points — a sync caller flushes on its own get, a pipelined
         burst rides one socket write."""
+        if not self.alive:
+            raise ConnectionError("direct channel closed")
+        # Backpressure: a channel death replays every unanswered call
+        # over the NM route, relying on the worker's replay-dedup cache
+        # to keep methods exactly-once — so unanswered calls must never
+        # outgrow what that cache can remember. Submitters are
+        # serialized per channel (the actor state lock), so one blocked
+        # waiter here is the only writer.
+        with self.plock:
+            full = len(self.pending) >= DIRECT_MAX_UNANSWERED
+        if full:
+            self.flush()  # the calls we wait on must reach the worker
+            with self._pending_cv:
+                while (len(self.pending) >= DIRECT_MAX_UNANSWERED
+                       and not self.failed and self.alive):
+                    self._pending_cv.wait(0.25)
         oid = spec.return_ids()[0]
-        entry = _DirectResult()
+        entry = _DirectResult(readable=self.store_readable)
         dep_ids = list(spec.pinned_ids())
         # Templatable = everything per-call is carried by the compact
         # frame (task id, args, nested refs). Tracing submit-spans needs
@@ -508,58 +1115,90 @@ class _DirectChannel:
                 if spec.nested_refs:
                     frame["n"] = spec.nested_refs
         with self.plock:
-            self.pending[spec.task_id] = (oid, entry, dep_ids)
+            if self.failed:
+                raise ConnectionError("direct channel failed")
+            seq = next(self._seq)
+            frame["q"] = seq
+            self.pending[spec.task_id] = _PendingCall(
+                oid, entry, dep_ids, spec, time.monotonic(), seq
+            )
             self.out_buf.append(frame)
+            self.calls += 1
         self.rt._direct_waiters_put(oid, entry)
         self.rt._mark_chan_dirty(self)
-        # Return-slot + arg-pin registration: buffered without a loop
-        # wakeup; applied before this call's reply post and before any
-        # ref-delta flush (see _dpost).
-        self.rt._dpost(("reg", spec), wake=False)
+        # Return-slot + arg-pin registration with the caller's NM:
+        # buffered/coalesced (see the runtime's _direct_on_reg hook);
+        # applied before this call's completion post and before any
+        # ref-delta flush.
+        self.rt._direct_on_reg(spec)
 
-    def flush(self):
-        with self.plock:
-            buf = self.out_buf
-            self.out_buf = []
-        if not buf:
-            return
-        msg = (
-            {"type": "execute", **buf[0]} if len(buf) == 1
-            else {"type": "execute_batch", "items": buf}
-        )
-        self.conn.send(msg)
+    def flush(self, _trailer: Optional[Dict[str, Any]] = None):
+        with self._flush_lock:
+            with self.plock:
+                buf = self.out_buf
+                self.out_buf = []
+            if buf:
+                msg = (
+                    {"type": "execute", **buf[0]} if len(buf) == 1
+                    else {"type": "execute_batch", "items": buf}
+                )
+                self.conn.send(msg)
+            if _trailer is not None:
+                self.conn.send(_trailer)
 
     def fence(self, timeout: float = 30.0) -> bool:
         """Ack'd once every earlier frame on this connection has been
         EXECUTED at the worker — lets a control-plane-routed call be
-        ordered after direct ones. A False return means the actor stayed
-        busy past the deadline; the caller proceeds best-effort (the
-        alternative is blocking the submitter indefinitely)."""
-        self.flush()
+        ordered after direct ones. The fence frame rides the flush lock
+        as a trailer, so it goes out strictly after every frame buffered
+        (or mid-send in a concurrent flush) before it. A False return
+        means the actor stayed busy past the deadline; the caller
+        proceeds best-effort (the alternative is blocking the submitter
+        indefinitely)."""
         ev = threading.Event()
         mid = next(self._fence_seq)
         self._fences[mid] = ev
-        self.conn.send({"type": "fence", "msg_id": mid})
+        self.flush(_trailer={"type": "fence", "msg_id": mid})
         ok = ev.wait(timeout)
         if not ok:
             self._fences.pop(mid, None)
+        if self.failed or not self.alive:
+            # The reader sets every fence event when the channel dies, so
+            # a True wait can mean "channel died", not "frames executed".
+            # Raise so the caller parks on the failure replays (drained)
+            # instead of letting its NM-routed call overtake them.
+            raise ConnectionError("direct channel died during fence")
         return ok
 
     def _on_reply(self, msg):
         with self.plock:
-            oid, entry, dep_ids = self.pending.pop(
-                msg["task_id"], (None, None, None)
-            )
-        if entry is None:
+            call = self.pending.pop(msg["task_id"], None)
+            self._pending_cv.notify_all()
+        if call is None:
             return
+        if self.remote:
+            # The bytes live in the actor node's store: non-inline result
+            # locations become RemoteLocation entries here, resolved over
+            # the transfer plane. held=True — the worker's NM took a hold
+            # for this caller; local GC releases it via free_object.
+            from .object_store import RemoteLocation
+
+            msg["results"] = [
+                (roid,
+                 loc if isinstance(loc, InlineLocation)
+                 else RemoteLocation(self.node_hex,
+                                     getattr(loc, "size", 0), held=True))
+                for roid, loc in msg.get("results", ())
+            ]
         # Wake the waiter FIRST (on one core every microsecond before the
         # set() is added to the caller's round trip), then register the
         # results with the control plane: other consumers and the
         # location directory stay consistent a beat later.
+        entry = call.entry
         entry.payload = msg
         entry.event.set()
-        self.rt._dpost(("done", msg["results"], dep_ids or [],
-                        msg.get("nested")))
+        _CALL_SECONDS_DIRECT.observe(time.monotonic() - call.t0)
+        self.rt._direct_on_done(msg, call.dep_ids, self)
 
     def _reader(self):
         from .protocol import ConnectionClosed
@@ -570,9 +1209,11 @@ class _DirectChannel:
                 mtype = msg.get("type")
                 if mtype == "task_done":
                     self._on_reply(msg)
+                    self.rt._direct_flush_side()
                 elif mtype == "task_done_batch":
                     for item in msg["items"]:
                         self._on_reply(item)
+                    self.rt._direct_flush_side()
                 elif mtype == "fence_ack":
                     ev = self._fences.pop(msg.get("msg_id"), None)
                     if ev is not None:
@@ -582,18 +1223,13 @@ class _DirectChannel:
         except Exception:
             pass
         self.alive = False
-        with self.plock:
-            pend = list(self.pending.values())
-            self.pending.clear()
-        for _oid, entry, _deps in pend:
-            entry.payload = {
-                "failed": True, "results": [],
-                "error": "actor died (direct channel closed)",
-            }
-            entry.event.set()
-        self.rt._direct_channel_died(self.actor_id)
+        for ev in list(self._fences.values()):
+            ev.set()
+        self._fences.clear()
+        self.rt._direct_channel_failed(self)
 
     def close(self):
+        self.closed_by_us = True
         self.alive = False
         try:
             self.conn.close()
@@ -604,25 +1240,13 @@ class _DirectChannel:
 class DriverRuntime(BaseRuntime):
     """Runtime embedded in the driver process; owns the NodeManager."""
 
+    _direct_capable = True
+
     def __init__(self, node_manager, job_id: JobID):
         self._nm = node_manager
         self._submit_lock = threading.Lock()
         self._submit_buf: List[TaskSpec] = []
         self._submit_waking = False
-        # Direct actor-call channels: actor_id bytes -> state dict
-        # {"lock", "status": none|discovering|ready|unsupported,
-        #  "chan", "nm_seq"}. See submit()/_direct_discover for the
-        # ordering-preserving switchover protocol.
-        self._direct_states: Dict[bytes, Dict[str, Any]] = {}
-        self._direct_states_lock = threading.Lock()
-        # oid -> _DirectResult; resolved entries are evicted FIFO beyond
-        # the cap (the object stays resolvable through the directory).
-        from collections import OrderedDict
-
-        self._direct_waiters: "OrderedDict[ObjectID, _DirectResult]" = (
-            OrderedDict()
-        )
-        self._direct_waiters_lock = threading.Lock()
         # Coalesced NM bookkeeping for direct calls: submit/reply posts
         # buffer here and drain in ONE loop callback per burst (three
         # call_soon_threadsafe wakeups per call would cost more than the
@@ -630,31 +1254,33 @@ class DriverRuntime(BaseRuntime):
         self._dpost_lock = threading.Lock()
         self._dpost_buf: List[tuple] = []
         self._dpost_waking = False
-        self._dirty_chans: set = set()
-        self._dirty_chans_lock = threading.Lock()
         super().__init__(
             job_id=job_id,
             node_id=node_manager.node_id,
             worker_id=WorkerID.nil(),
         )
 
-    # ---- direct actor transport -------------------------------------------
+    # ---- direct actor transport hooks (in-process NM: loop posts) ---------
 
-    _DIRECT_WAITER_CAP = 8192
+    def _direct_resolve(self, actor_id: ActorID, timeout: float):
+        return self._nm.call_sync(
+            self._nm.get_actor_direct(actor_id, timeout=timeout),
+            timeout=timeout + 10.0,
+        )
 
-    def _direct_waiters_put(self, oid: ObjectID, entry: _DirectResult):
-        with self._direct_waiters_lock:
-            self._direct_waiters[oid] = entry
-            if len(self._direct_waiters) > self._DIRECT_WAITER_CAP:
-                # Evict resolved entries from the FIFO front, O(1)
-                # amortized (oldest first; the object stays resolvable
-                # through the directory). Unresolved entries stay — they
-                # are genuinely pending calls and drain on reply/failure.
-                for _ in range(32):
-                    k = next(iter(self._direct_waiters), None)
-                    if k is None or not self._direct_waiters[k].event.is_set():
-                        break
-                    del self._direct_waiters[k]
+    def _direct_on_reg(self, spec: TaskSpec):
+        # Buffered without a loop wakeup; applied before this call's
+        # reply post and before any ref-delta flush (see _dpost).
+        self._dpost(("reg", spec), wake=False)
+
+    def _direct_on_done(self, msg, dep_ids, chan):
+        self._dpost(("done", msg["results"], dep_ids or [],
+                     msg.get("nested")))
+
+    def _direct_on_replay(self, dep_ids):
+        # Unpin-only post: empty results, no nested — releases the
+        # direct registration's arg pins before the NM resubmit re-pins.
+        self._dpost(("done", [], dep_ids, None))
 
     def _dpost(self, item: tuple, wake: bool = True):
         """Queue NM bookkeeping. wake=False defers the drain to the next
@@ -711,149 +1337,6 @@ class DriverRuntime(BaseRuntime):
                 for oid in dep_ids:
                     nm._remove_ref(oid, 1)
 
-    def _mark_chan_dirty(self, chan: "_DirectChannel"):
-        with self._dirty_chans_lock:
-            self._dirty_chans.add(chan)
-
-    def _flush_direct(self):
-        if not self._dirty_chans:
-            return
-        with self._dirty_chans_lock:
-            chans = list(self._dirty_chans)
-            self._dirty_chans.clear()
-        for chan in chans:
-            try:
-                chan.flush()
-            except Exception:
-                pass
-
-    def _direct_state(self, actor_id: ActorID) -> Dict[str, Any]:
-        key = actor_id.binary()
-        with self._direct_states_lock:
-            st = self._direct_states.get(key)
-            if st is None:
-                st = {"lock": threading.Lock(), "status": "none",
-                      "chan": None, "nm_seq": 0}
-                self._direct_states[key] = st
-            return st
-
-    def _direct_channel_died(self, actor_id: ActorID):
-        st = self._direct_state(actor_id)
-        with st["lock"]:
-            st["status"] = "none"
-            st["chan"] = None
-
-    def _direct_discover(self, actor_id: ActorID, st: Dict[str, Any]):
-        """Background switchover: resolve the actor's direct socket. The
-        NM only answers once the actor is alive with NO control-plane
-        calls queued/in flight, and we only flip to ready if no new
-        NM-path call raced in (nm_seq unchanged) — so direct frames can
-        never overtake NM-routed ones."""
-        while True:
-            with st["lock"]:
-                seq0 = st["nm_seq"]
-            try:
-                path = self._nm.call_sync(
-                    self._nm.get_actor_direct(actor_id), timeout=40.0
-                )
-            except BaseException:
-                # Includes CancelledError (BaseException): NM shutdown
-                # cancels in-flight loop tasks; this daemon thread must
-                # exit quietly, not print an unhandled traceback.
-                path = None
-            if path is None:
-                # Unsupported OR just continuously busy for the whole
-                # wait window: retry on a later submit rather than
-                # pinning the actor to the slow route forever.
-                with st["lock"]:
-                    st["status"] = "unsupported"
-                    st["retry_at"] = time.monotonic() + 10.0
-                return
-            with st["lock"]:
-                if st["nm_seq"] != seq0:
-                    continue  # an NM call raced in; wait for drain again
-                chan = st["chan"]
-                if chan is None or not chan.alive or chan.path != path:
-                    try:
-                        chan = _DirectChannel(self, actor_id, path)
-                    except Exception:
-                        st["status"] = "unsupported"
-                        st["retry_at"] = time.monotonic() + 10.0
-                        return
-                    st["chan"] = chan
-                st["status"] = "ready"
-                return
-
-    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
-        self._stamp_trace(spec)
-        if spec.task_type == TaskType.ACTOR_TASK and spec.actor_id is not None:
-            # Calls carrying retries keep the NM route: its actor-restart
-            # replay resubmits them in order; a direct channel can only
-            # fail them on worker death.
-            eligible = (not spec.streaming and spec.num_returns == 1
-                        and spec.retries_left == 0)
-            if eligible:
-                # A call chained on a still-pending direct result must
-                # not ride the same connection: the worker would execute
-                # it while the dependency's reply (and therefore its
-                # seal) may still be sitting in a reply batch — route it
-                # through the NM, which gates dispatch on sealed deps.
-                waiters = self._direct_waiters
-                for dep in spec.dependency_ids():
-                    with self._direct_waiters_lock:
-                        entry = waiters.get(dep)
-                    if entry is not None and not entry.event.is_set():
-                        eligible = False
-                        break
-            st = self._direct_state(spec.actor_id)
-            chan_for_fence = None
-            spawn_discovery = False
-            with st["lock"]:
-                if eligible and st["status"] == "ready":
-                    chan = st["chan"]
-                    try:
-                        chan.submit(spec)
-                        return [
-                            ObjectRef(oid, _register=True)
-                            for oid in spec.return_ids()
-                        ]
-                    except Exception:
-                        chan.close()
-                        st["status"] = "none"
-                        st["chan"] = None
-                # NM path: bump the sequence so a discovery in flight
-                # cannot flip to ready underneath this call; discovery is
-                # (re)started AFTER the spec is enqueued below, so it
-                # cannot observe the actor idle before this call lands.
-                st["nm_seq"] += 1
-                if st["status"] == "ready":
-                    # Ineligible call interleaving with direct traffic:
-                    # fence so it cannot overtake queued direct frames.
-                    chan_for_fence = st["chan"]
-                if st["status"] in ("none", "ready") or (
-                    st["status"] == "unsupported"
-                    and time.monotonic() >= st.get("retry_at", 0.0)
-                ):
-                    st["status"] = "discovering"
-                    spawn_discovery = True
-            if chan_for_fence is not None and chan_for_fence.alive:
-                try:
-                    chan_for_fence.fence()
-                except Exception:
-                    pass
-            refs = super().submit(spec)
-            if spawn_discovery:
-                # The submit above queued its drain callback on the NM
-                # loop first; the discovery's own loop work is queued
-                # after it, so get_actor_direct sees this call.
-                threading.Thread(
-                    target=self._direct_discover,
-                    args=(spec.actor_id, st),
-                    daemon=True,
-                ).start()
-            return refs
-        return super().submit(spec)
-
     def _flush_deltas(self, deltas: Dict[ObjectID, int]):
         async def _apply():
             # Direct-call registrations must land before ref deltas (a
@@ -868,18 +1351,6 @@ class DriverRuntime(BaseRuntime):
                     self._nm._remove_ref(oid, -d)
 
         self._nm._call(_apply())
-
-    def _flush_loop(self):
-        # Also the deferral bound for buffered direct-call frames: a
-        # fire-and-forget caller that never gets still has its frames
-        # shipped within one flush interval.
-        cfg = get_config()
-        while not self._flusher_stop.wait(cfg.refcount_flush_interval_s):
-            try:
-                self.refs.flush()
-                self._flush_direct()
-            except Exception:
-                pass
 
     def _post(self, coro):
         """Fire a coroutine onto the node manager's loop without blocking
@@ -923,12 +1394,19 @@ class DriverRuntime(BaseRuntime):
                 )
 
     def _get_locations(self, ids, timeout):
-        # asyncio.TimeoutError is TimeoutError on py>=3.11, so callers'
-        # `except TimeoutError` handles loop-side timeouts directly.
         # Flush ref deltas first so the NM sees this process's holds
         # (borrow-stub creation) before resolving locations.
         self.refs.flush()
-        return self._nm.call_sync(self._nm.get_locations(ids, timeout))
+        import asyncio
+
+        try:
+            return self._nm.call_sync(self._nm.get_locations(ids, timeout))
+        except asyncio.TimeoutError as e:
+            # py<3.11: asyncio.TimeoutError is NOT builtin TimeoutError,
+            # so normalize at the boundary — callers' `except
+            # TimeoutError` (get()'s GetTimeoutError translation) must
+            # see loop-side timeouts on every supported version.
+            raise TimeoutError(str(e)) from e
 
     def _wait(self, ids, num_returns, timeout):
         return self._nm.call_sync(self._nm.wait_objects(ids, num_returns, timeout))
@@ -1056,14 +1534,7 @@ class DriverRuntime(BaseRuntime):
         return self._nm.call_sync(self._nm.pg_op({"op": "table"}))["table"]
 
     def shutdown(self):
-        super().shutdown()
-        with self._direct_states_lock:
-            states = list(self._direct_states.values())
-            self._direct_states.clear()
-        for st in states:
-            chan = st.get("chan")
-            if chan is not None:
-                chan.close()
+        super().shutdown()  # closes direct channels
         self.refs.flush()
         self._nm.shutdown()
         self.store.shutdown(unlink_created=True)
@@ -1072,14 +1543,108 @@ class DriverRuntime(BaseRuntime):
 class WorkerRuntime(BaseRuntime):
     """Runtime inside a worker process; all control-plane calls go over the
     node socket (duplex: replies are matched by msg_id by the reader thread,
-    which runs in worker_main)."""
+    which runs in worker_main). Actor calls ride the direct plane: the
+    runtime resolves the actor's endpoint through its NM once, then
+    speaks straight to the actor's worker — this is how serve replicas
+    and nested actor calls skip the per-call NM hops."""
+
+    _direct_capable = True
 
     def __init__(self, conn, job_id: JobID, node_id: NodeID, worker_id: WorkerID):
         self._conn = conn
         self._msg_counter = itertools.count(1)
         self._pending: Dict[int, _PendingReply] = {}
         self._pending_lock = threading.Lock()
+        # Direct-plane NM side-bookkeeping, coalesced into ONE
+        # ``direct_side`` frame per burst (mirror of the driver's dpost
+        # buffer; set up BEFORE super().__init__ starts the flusher).
+        self._direct_side_lock = threading.Lock()
+        self._direct_regs: List[Tuple[list, list]] = []
+        self._direct_seals: List[tuple] = []
+        self._direct_nested: List[tuple] = []
+        self._direct_unpins: Dict[ObjectID, int] = {}
+        self._direct_side_first = 0.0
         super().__init__(job_id=job_id, node_id=node_id, worker_id=worker_id)
+
+    # ---- direct actor transport hooks (over the node socket) ---------------
+
+    _DIRECT_SIDE_MAX = 32
+    _DIRECT_SIDE_AGE_S = 0.002
+
+    def _direct_stamp_owner(self, spec: TaskSpec):
+        spec.owner_id = self.worker_id
+
+    def _direct_resolve(self, actor_id: ActorID, timeout: float):
+        reply = self.request(
+            {"type": "get_actor_direct", "actor_id": actor_id,
+             "timeout": timeout},
+            timeout=timeout + 15.0,
+        )
+        return reply.get("direct")
+
+    def _direct_side_mark_first(self):
+        # Caller holds _direct_side_lock.
+        if not (self._direct_regs or self._direct_seals
+                or self._direct_nested or self._direct_unpins):
+            self._direct_side_first = time.monotonic()
+
+    def _direct_on_reg(self, spec: TaskSpec):
+        with self._direct_side_lock:
+            self._direct_side_mark_first()
+            self._direct_regs.append(
+                (list(spec.return_ids()), list(spec.pinned_ids()))
+            )
+
+    def _direct_on_done(self, msg, dep_ids, chan):
+        with self._direct_side_lock:
+            self._direct_side_mark_first()
+            if chan.remote:
+                # The actor lives on another node: register the results
+                # here as RemoteLocation seals (already rewritten by the
+                # channel) so local consumers resolve and pull them.
+                self._direct_seals.extend(msg.get("results", ()))
+            for item in (msg.get("nested") or ()):
+                self._direct_nested.append(item)
+            for oid in dep_ids:
+                self._direct_unpins[oid] = self._direct_unpins.get(oid, 0) + 1
+
+    def _direct_on_replay(self, dep_ids):
+        with self._direct_side_lock:
+            self._direct_side_mark_first()
+            for oid in dep_ids:
+                self._direct_unpins[oid] = self._direct_unpins.get(oid, 0) + 1
+        self._direct_flush_side(force=True)
+
+    def _direct_flush_side(self, force: bool = False):
+        with self._direct_side_lock:
+            n = (len(self._direct_regs) + len(self._direct_seals)
+                 + len(self._direct_nested) + len(self._direct_unpins))
+            if not n:
+                return
+            if (not force and n < self._DIRECT_SIDE_MAX
+                    and time.monotonic() - self._direct_side_first
+                    < self._DIRECT_SIDE_AGE_S):
+                return
+            regs, self._direct_regs = self._direct_regs, []
+            seals, self._direct_seals = self._direct_seals, []
+            nested, self._direct_nested = self._direct_nested, []
+            unpins, self._direct_unpins = self._direct_unpins, {}
+        msg: Dict[str, Any] = {"type": "direct_side"}
+        if regs:
+            msg["returns"] = [oid for ret, _ in regs for oid in ret]
+            pins = [oid for _, p in regs for oid in p]
+            if pins:
+                msg["pins"] = pins
+        if seals:
+            msg["seals"] = seals
+        if nested:
+            msg["nested"] = nested
+        if unpins:
+            msg["unpin"] = unpins
+        try:
+            self._conn.send(msg)
+        except Exception:
+            pass
 
     # Called by worker_main's reader thread.
     def handle_reply(self, msg: Dict[str, Any]):
@@ -1097,6 +1662,10 @@ class WorkerRuntime(BaseRuntime):
     def request(self, msg: Dict[str, Any], timeout: Optional[float] = None):
         if self.before_block is not None:
             self.before_block()
+        # Direct-call registrations must reach the NM before any request
+        # that may resolve against them (a dep lookup racing an unsent
+        # return-slot placeholder would miss and go to object location).
+        self._direct_flush_side(force=True)
         msg_id = next(self._msg_counter)
         msg["msg_id"] = msg_id
         pending = _PendingReply()
@@ -1110,6 +1679,10 @@ class WorkerRuntime(BaseRuntime):
         return pending.payload
 
     def _flush_deltas(self, deltas: Dict[ObjectID, int]):
+        # Direct-call registrations land first (same discipline as the
+        # driver's dpost drain): the deltas may refer to return slots or
+        # arg pins a buffered reg creates.
+        self._direct_flush_side(force=True)
         adds = [oid for oid, d in deltas.items() for _ in range(max(0, d))]
         removes = {oid: -d for oid, d in deltas.items() if d < 0}
         if adds:
@@ -1119,6 +1692,11 @@ class WorkerRuntime(BaseRuntime):
 
     def _submit_spec(self, spec: TaskSpec):
         spec.owner_id = self.worker_id
+        # FIFO discipline on the node socket: buffered direct-call
+        # registrations land before this submit, so a spec depending on
+        # a direct result dep-waits on its placeholder instead of
+        # falling into the object-locate path.
+        self._direct_flush_side(force=True)
         self._conn.send({"type": "submit", "spec": spec})
 
     def _get_locations(self, ids, timeout):

@@ -83,6 +83,14 @@ class TaskSpec:
     # out_of_order_actor_submit_queue.h): independent method calls may
     # execute as they arrive instead of strictly in submission order.
     allow_out_of_order: bool = False
+    # NM-path replay of a call whose direct channel died mid-flight
+    # (runtime._direct_channel_failed). If the actor itself is not alive
+    # when the replay arrives, the call FAILS like any NM-routed call
+    # interrupted by actor death — replays must not re-execute
+    # interrupted methods into a restarted actor (at-most-once across
+    # restarts; a channel-only fault with the worker alive still
+    # replays, deduped by task id at the worker).
+    direct_replay: bool = False
     # Owner bookkeeping (worker that submitted the task; nil = driver)
     owner_id: Optional[WorkerID] = None
     # Tracing context (trace_id, parent_span_id) — stamped at submit,

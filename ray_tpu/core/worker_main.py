@@ -12,6 +12,7 @@ import os
 from collections import deque
 import sys
 import threading
+import time
 from typing import List
 
 from .executor import ActorContainer, execute_task
@@ -50,15 +51,36 @@ class Worker:
         # from an actor pool thread.
         self._done_buf: List[dict] = []
         self._done_lock = threading.Lock()
-        # Direct actor-call channel (ref analogue: direct actor task
+        # Direct actor-call channels (ref analogue: direct actor task
         # submission, core_worker/transport/direct_actor_task_submitter.h
         # — callers push actor tasks straight to the actor's worker; the
-        # control plane only does lifecycle). The listener starts after a
-        # successful actor creation; frames from caller connections join
-        # the same task queue as node-manager frames, replies return
-        # inline on the calling connection.
+        # control plane only does lifecycle). The listeners start after a
+        # successful actor creation: a unix socket for same-node callers
+        # AND a TLS-aware TCP endpoint for remote workers/thin clients;
+        # frames execute in per-connection sequence order (out-of-order
+        # arrivals buffered), replies return inline on the calling
+        # connection.
         self._direct_srv = None
+        self._direct_tcp_srv = None
         self._direct_path: str | None = None
+        self._direct_addr: tuple | None = None
+        # Lightweight completion notifications to the node manager for
+        # direct executions: the NM's _on_task_done bookkeeping (seals
+        # for third-party consumers, task history, telemetry) still
+        # fires, one debounced direct_done_batch frame per burst.
+        self._nm_done_buf: List[dict] = []
+        self._nm_done_lock = threading.Lock()
+        self._nm_done_first = 0.0
+        self._done_flush_batch = _DONE_FLUSH_BATCH
+        self._done_flush_age = 0.05
+        # Recently-executed direct task ids -> completion record: an
+        # NM-path replay after a channel death (reply lost in flight)
+        # returns the recorded completion instead of double-executing
+        # actor state (per-handle ordering + exactly-once surface).
+        from collections import OrderedDict
+
+        self._direct_seen: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._direct_seen_lock = threading.Lock()
         # Per-connection direct reply batches (instance state so the
         # before-blocking hook can flush them: a direct task that blocks
         # on a nested get must not strand earlier replies — and their
@@ -352,11 +374,20 @@ class Worker:
         os._exit(0)
 
     def _start_direct_listener(self, actor_id):
-        """Listen for direct caller connections (one UDS per actor
-        worker, beside the node socket) and advertise the path to the
-        node manager, which hands it to callers on the same node."""
+        """Listen for direct caller connections and advertise the
+        endpoints to the node manager: one UDS beside the node socket
+        for same-node callers, plus a TLS-aware TCP endpoint so remote
+        workers and thin clients ride the same plane. The NM hands the
+        descriptor to callers through get_actor_direct."""
         import socket as _socket
 
+        from .config import get_config
+        from .protocol import DIRECT_PROTO_VER
+
+        cfg = get_config()
+        self._done_flush_batch = max(1, int(cfg.direct_done_flush_batch))
+        self._done_flush_age = max(0.001, cfg.direct_done_flush_ms / 1e3)
+        self._direct_actor_id = actor_id.hex() if actor_id else None
         base = os.environ.get("RAY_TPU_NODE_SOCKET", "/tmp/rtpu")
         path = f"{base}.d{os.getpid()}"
         try:
@@ -372,30 +403,82 @@ class Worker:
         self._direct_srv = srv
         self._direct_path = path
         threading.Thread(
-            target=self._direct_accept_loop, args=(srv,), daemon=True
+            target=self._direct_accept_loop, args=(srv, False), daemon=True
         ).start()
-        self.conn.send({"type": "actor_direct", "path": path})
+        # TCP endpoint (best effort — the UDS plane works without it).
+        host = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
+        try:
+            tcp = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+            tcp.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            tcp.bind((host, 0))
+            tcp.listen(64)
+            self._direct_tcp_srv = tcp
+            self._direct_addr = (host, tcp.getsockname()[1])
+            threading.Thread(
+                target=self._direct_accept_loop, args=(tcp, True),
+                daemon=True,
+            ).start()
+        except OSError:
+            self._direct_addr = None
+        threading.Thread(
+            target=self._nm_done_ticker, daemon=True
+        ).start()
+        self.conn.send({
+            "type": "actor_direct", "path": path,
+            "addr": self._direct_addr, "ver": DIRECT_PROTO_VER,
+        })
 
-    def _direct_accept_loop(self, srv):
-        from .protocol import Connection as _Conn
-
+    def _direct_accept_loop(self, srv, tls: bool):
         while self._alive:
             try:
                 sock, _ = srv.accept()
             except OSError:
                 return
-            conn = _Conn(sock)
             threading.Thread(
-                target=self._direct_serve, args=(conn,), daemon=True
+                target=self._direct_conn_entry, args=(sock, tls),
+                daemon=True,
             ).start()
 
+    def _direct_conn_entry(self, sock, tls: bool):
+        from .protocol import Connection as _Conn
+
+        try:
+            if tls:
+                # TLS wrap (and its handshake) on the CONNECTION thread:
+                # a caller stalling mid-handshake must not block accepts.
+                from .tls import server_ssl_context
+
+                ctx = server_ssl_context()
+                if ctx is not None:
+                    sock.settimeout(30.0)
+                    sock = ctx.wrap_socket(sock, server_side=True)
+                    sock.settimeout(None)
+            conn = _Conn(sock)
+        except (OSError, ValueError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        self._direct_serve(conn)
+
     def _direct_serve(self, conn):
-        """One caller connection: frames execute in submission order —
-        INLINE in this thread for concurrency-1 actors (under the serial
-        lock), via the pool for concurrent actors. Replies batch while a
-        frame batch is being chewed through. A fence frame acks once
-        every earlier frame from this connection has executed — callers
-        use it to order a control-plane-routed call after direct ones.
+        """One caller connection: frames execute in SEQUENCE order ("q",
+        per-handle monotonic) — INLINE in this thread for concurrency-1
+        actors (under the serial lock), via the pool for concurrent
+        actors. Out-of-order arrivals are buffered until the gap fills;
+        frames below the expected sequence are duplicates of calls that
+        already executed and are dropped. Replies batch while a frame
+        batch is being chewed through. A fence frame acks once every
+        earlier frame from this connection has executed — callers use it
+        to order a control-plane-routed call after direct ones.
+
+        The connection opens with a direct_hello/direct_welcome
+        handshake carrying the session token, the protocol version (a
+        mismatch is refused — the caller falls back to the NM route) and
+        the caller's node id (non-inline results for remote callers get
+        a refcount hold at this node until the caller's RemoteLocation
+        entry is collected).
 
         Frames come in two shapes: full ({"spec", "function_blob"},
         optionally registering a template via "tmpl_reg") and compact
@@ -405,8 +488,67 @@ class Worker:
         _DirectChannel.submit)."""
         import copy as _copy
 
+        from .config import get_config
+        from .protocol import DIRECT_PROTO_VER
+
+        try:
+            # Bounded: a caller that connected but never says hello must
+            # not pin this connection thread forever.
+            conn.settimeout(30.0)
+            hello = conn.recv()
+            conn.settimeout(None)
+        except (ConnectionClosed, OSError):
+            return
+        if hello.get("type") != "direct_hello":
+            conn.close()
+            return
+        token = get_config().session_token
+        if token and hello.get("token") != token:
+            try:
+                conn.send({"type": "direct_welcome", "ok": False,
+                           "error": "bad session token"})
+            except Exception:
+                pass
+            conn.close()
+            return
+        if hello.get("ver") != DIRECT_PROTO_VER:
+            try:
+                conn.send({
+                    "type": "direct_welcome", "ok": False,
+                    "error": f"direct protocol version mismatch "
+                             f"(worker v{DIRECT_PROTO_VER})",
+                })
+            except Exception:
+                pass
+            conn.close()
+            return
+        want = hello.get("actor_id")
+        if want is not None and want != getattr(
+                self, "_direct_actor_id", None):
+            # Stale endpoint: the caller resolved a descriptor whose
+            # pid/port has been recycled by a worker hosting a DIFFERENT
+            # actor. Refuse so the caller falls back to the NM route and
+            # re-resolves — silently accepting would execute methods
+            # against the wrong actor's state.
+            try:
+                conn.send({"type": "direct_welcome", "ok": False,
+                           "error": "actor mismatch (stale endpoint)"})
+            except Exception:
+                pass
+            conn.close()
+            return
+        node_hex = self.runtime.node_id.hex() if self.runtime else None
+        remote = hello.get("node") not in (None, node_hex)
+        try:
+            conn.send({"type": "direct_welcome", "ok": True,
+                       "ver": DIRECT_PROTO_VER})
+        except Exception:
+            return
+
         group_futs: list = []
         templates: dict = {}  # per-connection template id -> TaskSpec
+        expected = 1          # next sequence number to execute
+        parked: dict = {}     # seq -> buffered out-of-order frame
 
         def decode(m):
             tid = m.get("t")
@@ -428,6 +570,24 @@ class Worker:
             spec.trace_ctx = None  # span derives from the new task id
             return spec, None
 
+        def in_seq_order(items):
+            """Admit frames in sequence order; buffer gaps, drop
+            duplicates (seq below expected = already executed)."""
+            nonlocal expected
+            run = []
+            for m in items:
+                q = m.get("q")
+                if q is None or q == expected:
+                    run.append(m)
+                    if q is not None:
+                        expected += 1
+                        while expected in parked:
+                            run.append(parked.pop(expected))
+                            expected += 1
+                elif q > expected:
+                    parked[q] = m  # out-of-order arrival: buffer
+            return run
+
         try:
             while self._alive:
                 msg = conn.recv()
@@ -436,29 +596,33 @@ class Worker:
                     items = (
                         msg["items"] if mtype == "execute_batch" else [msg]
                     )
+                    if len(parked) > 4096:
+                        return  # runaway gap: drop the connection
                     if len(group_futs) > 4096:
                         group_futs = [f for f in group_futs if not f.done()]
                     routed = []
-                    for m in items:
+                    for m in in_seq_order(items):
                         spec, blob = decode(m)
                         gp = self._group_pools.get(
                             getattr(spec, "concurrency_group", "")
                         )
                         if gp is not None:
                             group_futs.append(gp.submit(
-                                self._run_direct, conn, spec, blob,
+                                self._run_direct, conn, spec, blob, remote,
                             ))
                         else:
                             routed.append((spec, blob))
                     if self._pool is not None:
                         for spec, blob in routed:
                             group_futs.append(self._pool.submit(
-                                self._run_direct, conn, spec, blob,
+                                self._run_direct, conn, spec, blob, remote,
                             ))
                         continue
                     for spec, blob in routed:
                         with self._serial_lock:
-                            done = self._run_task(spec, blob)
+                            done = self._run_task(spec, blob,
+                                                  sample_resources=False)
+                        self._note_direct_done(done, spec, remote)
                         with self._dr_lock:
                             _, buf = self._dr_bufs.setdefault(
                                 id(conn), (conn, [])
@@ -507,23 +671,102 @@ class Worker:
 
     def _flush_before_block(self):
         """Runtime before-blocking hook: ship every buffered completion
-        (NM dones AND direct replies) AND pending ref deltas before
-        waiting on the node manager — a nested get must never wait on a
-        seal stranded in our own outbound buffers, and the NM's borrow
-        logic needs our +1s applied before it resolves the read."""
+        (NM dones, direct replies AND direct completion notifications)
+        plus pending ref deltas before waiting on the node manager — a
+        nested get must never wait on a seal stranded in our own
+        outbound buffers, and the NM's borrow logic needs our +1s
+        applied before it resolves the read."""
         self._flush_dones()
         self._flush_direct_replies()
+        self._flush_nm_dones(force=True)
         try:
             self.runtime.refs.flush()
         except Exception:
             pass
 
-    def _run_direct(self, conn, spec, function_blob):
-        done = self._run_task(spec, function_blob)
+    def _run_direct(self, conn, spec, function_blob, remote=False):
+        done = self._run_task(spec, function_blob, sample_resources=False)
+        self._note_direct_done(done, spec, remote)
         try:
             conn.send(done)
         except Exception:
             pass
+
+    def _note_direct_done(self, done: dict, spec, remote: bool):
+        """Queue the lightweight completion notification the node
+        manager needs for its _on_task_done bookkeeping (seals for
+        third-party consumers, duration telemetry, task history) —
+        debounced into direct_done_batch frames so a call burst costs
+        one NM wakeup, not one per completion. Also records the
+        completion for NM-path replay dedup (see _run_task)."""
+        if done.get("duplicate"):
+            return  # dedup-cache hit: already noted by the original run
+        tid = done["task_id"].binary()
+        with self._direct_seen_lock:
+            self._direct_seen[tid] = done
+            # Invariant: the cache must cover every call a failing
+            # channel could replay. Callers cap unanswered calls per
+            # channel at DIRECT_MAX_UNANSWERED (protocol.py), so 8192
+            # covers several simultaneously-failing callers before an
+            # eviction could surface as a double execution.
+            while len(self._direct_seen) > 8192:
+                self._direct_seen.popitem(last=False)
+        item = {
+            "task_id": done["task_id"],
+            "results": done["results"],
+            "failed": done.get("failed", False),
+            "duration_s": done.get("duration_s"),
+            "name": spec.name or spec.method_name or "task",
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+        }
+        if done.get("failed"):
+            item["error_type"] = done.get("error_type")
+            item["error_message"] = done.get("error_message")
+        if remote:
+            # Non-inline results leave on the caller's RemoteLocation
+            # entry; the NM holds them until the caller frees its copy.
+            item["held"] = True
+        # Ride the worker's pending ref deltas with the notification
+        # (same carrier discipline as NM-path task_done frames).
+        deltas = self.runtime.refs.drain()
+        if deltas:
+            item["ref_deltas"] = deltas
+        with self._nm_done_lock:
+            if not self._nm_done_buf:
+                self._nm_done_first = time.monotonic()
+            self._nm_done_buf.append(item)
+            n = len(self._nm_done_buf)
+        if remote or n >= self._done_flush_batch:
+            # Remote callers pull non-inline results the moment the
+            # reply lands: their seal (and hold) must reach our NM
+            # BEFORE the reply can trigger the pull, so remote
+            # completions flush eagerly instead of debouncing.
+            self._flush_nm_dones(force=True)
+
+    def _flush_nm_dones(self, force: bool = False):
+        with self._nm_done_lock:
+            n = len(self._nm_done_buf)
+            if not n:
+                return
+            if (not force
+                    and n < self._done_flush_batch
+                    and time.monotonic() - self._nm_done_first
+                    < self._done_flush_age):
+                return
+            buf = self._nm_done_buf
+            self._nm_done_buf = []
+        try:
+            self.conn.send({"type": "direct_done_batch", "items": buf})
+        except Exception:
+            pass
+
+    def _nm_done_ticker(self):
+        """Age bound for buffered completion notifications: a caller
+        that stops calling still gets its last completions' seals and
+        telemetry to the NM within one flush interval."""
+        while self._alive:
+            time.sleep(self._done_flush_age)
+            self._flush_nm_dones()
 
     def _flush_dones(self):
         with self._done_lock:
@@ -542,7 +785,26 @@ class Worker:
         self.conn.send(self._run_task(spec, function_blob))
 
     def _run_task(self, spec: TaskSpec, function_blob,
-                  to_nm: bool = False) -> dict:
+                  to_nm: bool = False, sample_resources: bool = True) -> dict:
+        if spec.task_type == TaskType.ACTOR_TASK:
+            with self._direct_seen_lock:
+                cached = self._direct_seen.get(spec.task_id.binary())
+            if cached is not None:
+                # NM-path replay of a call the direct plane already ran
+                # (the channel died holding the reply): return the
+                # recorded completion instead of double-executing actor
+                # state — per-handle ordering survives the failover
+                # with exactly-once method execution. Marked duplicate
+                # so the NM skips stats/duration/history it already
+                # counted from the direct_done_batch notification.
+                done = dict(cached)
+                done.pop("ref_deltas", None)
+                done["duplicate"] = True
+                if to_nm:
+                    deltas = self.runtime.refs.drain()
+                    if deltas:
+                        done["ref_deltas"] = deltas
+                return done
         self._apply_runtime_env(spec.runtime_env_key)
         rt = self.runtime
         cache: FunctionCache = rt.function_cache
@@ -634,12 +896,18 @@ class Worker:
         span_id = new_span_id()
         prev_span = enter_span(trace_id, span_id)
         _t0 = _time.time()
+        _m0 = _time.monotonic()
         # Per-task CPU/RSS deltas for the terminal task record (the
         # "where did the step time go" companion to the duration the
-        # node manager already histograms).
-        from ..util.profiler import TaskResourceSampler
+        # node manager already histograms). Direct hot-path calls skip
+        # the sampler: its two /proc reads cost ~20us per call — real
+        # money at 5k calls/s — and sub-millisecond actor methods have
+        # no step time to attribute anyway.
+        _rsamp = None
+        if sample_resources:
+            from ..util.profiler import TaskResourceSampler
 
-        _rsamp = TaskResourceSampler().start()
+            _rsamp = TaskResourceSampler().start()
         try:
             results, failed, nested, error_info = execute_task(
                 spec, load_function, fetch, store_large, self.actor,
@@ -664,11 +932,13 @@ class Worker:
             "task_id": spec.task_id,
             "results": results,
             "failed": failed,
+            "duration_s": _time.monotonic() - _m0,
         }
-        try:
-            done["resource_usage"] = _rsamp.finish()
-        except Exception:
-            pass
+        if _rsamp is not None:
+            try:
+                done["resource_usage"] = _rsamp.finish()
+            except Exception:
+                pass
         if failed and error_info is not None:
             # Structured failure record: the node manager retains the
             # error type/message in its terminal-task history, and the

@@ -639,8 +639,9 @@ def cmd_metrics(args) -> int:
     counters/histograms of the attached node plus cluster-wide user,
     serve, and device series aggregated from the KV pipeline (the
     ``ray_tpu_object_transfer_*`` data-plane series ride the same
-    document). ``--transfers`` prints the object-transfer plane as a
-    human-readable section instead."""
+    document). ``--transfers`` prints the object-transfer plane and
+    ``--actors`` the direct actor-call plane as human-readable sections
+    instead."""
     ray_tpu = _attached(args)
     try:
         from ray_tpu.util import prometheus
@@ -648,10 +649,69 @@ def cmd_metrics(args) -> int:
         if getattr(args, "transfers", False):
             _print_transfer_section()
             return 0
+        if getattr(args, "actors", False):
+            _print_actor_section()
+            return 0
         sys.stdout.write(prometheus.render())
         return 0
     finally:
         ray_tpu.shutdown()
+
+
+def _print_actor_section() -> None:
+    """Actors section of `rtpu metrics`: the direct actor-call plane at
+    a glance. The cluster block aggregates the ``ray_tpu_actor_call_*``
+    series every caller process flushes through the KV metrics pipeline
+    (so it shows real traffic even though this CLI attaches as a fresh,
+    idle driver); the per-process block is THIS process's caller-side
+    channel state, useful when run inside an actual driver."""
+    from ray_tpu.core.runtime_context import current_runtime
+    from ray_tpu.util.metrics import get_metrics_report
+
+    print("direct actor-call plane:")
+    try:
+        report = get_metrics_report()
+    except Exception:
+        report = {}
+    calls = sum(
+        v.get("count", 0)
+        for v in report.get("ray_tpu_actor_call_seconds", {})
+        .get("series", {}).values()
+        if isinstance(v, dict)
+    )
+    inflight = sum(
+        v for v in report.get("ray_tpu_actor_call_inflight", {})
+        .get("series", {}).values()
+        if isinstance(v, (int, float))
+    )
+    fb = report.get("ray_tpu_actor_call_fallbacks_total", {}).get(
+        "series", {})
+    fb_total = sum(v for v in fb.values() if isinstance(v, (int, float)))
+    print(f"  cluster       : calls={int(calls)} inflight={int(inflight)} "
+          f"fallbacks={int(fb_total)}")
+    for tags_key, v in sorted(fb.items()):
+        labels = ",".join(f"{k}={val}" for k, val in tags_key)
+        print(f"  fallbacks     : {labels or 'untagged'} = {int(v)}")
+
+    rt = current_runtime()
+    st = rt.direct_stats()
+    print(f"  this process  : calls={st['calls']} "
+          f"inflight={st['inflight']} fallbacks={st['fallbacks']}")
+    nm = getattr(rt, "_nm", None)
+    if nm is not None:
+        s = nm._stats
+        dones = s.get("direct_calls_done", 0)
+        batches = s.get("direct_done_batches", 0)
+        coalesce = f"{dones / batches:.1f}x" if batches else "-"
+        print(f"  this node nm  : dones={dones} batches={batches} "
+              f"coalesce={coalesce}")
+    if st["channels"]:
+        for ch in st["channels"]:
+            print(f"  channel       : actor={ch['actor_id'][:8]} "
+                  f"status={ch['status']} remote={ch['remote']} "
+                  f"calls={ch['calls']}")
+    else:
+        print("  channel       : none")
 
 
 def _print_transfer_section() -> None:
@@ -811,6 +871,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="dump the Prometheus exposition text")
     p.add_argument("--transfers", action="store_true",
                    help="print the object-transfer plane section "
+                        "(human-readable) instead of the full document")
+    p.add_argument("--actors", action="store_true",
+                   help="print the direct actor-call plane section "
                         "(human-readable) instead of the full document")
     _add_address(p)
     p.set_defaults(fn=cmd_metrics)

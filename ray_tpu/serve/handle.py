@@ -27,6 +27,16 @@ Dynamic batching lives here too (ref analogue: serve/batching.py
 _BatchQueue:65): requests buffer until max_batch_size or batch_wait_timeout_s
 and flush as ONE replica call — on TPU this is what keeps the MXU fed with
 batched forward passes instead of single-row calls.
+
+HOT PATH CONTRACT: replicas are plain actor handles, so every
+``replica.handle_request.remote(...)`` + ``ray_tpu.get(...)`` pair rides
+the direct actor-call plane (runtime._DirectChannel) once the replica's
+channel engages — a steady-state request is submit -> framed channel ->
+inline reply, with NO node-manager round-trip. Blocking NM calls
+(``force_refresh``, ``call_sync``, KV ops, ...) are allowed ONLY inside
+except-handler recovery blocks (dead replica, stale route); the
+``make check-obs`` lint (tools/check_metric_names.py
+validate_serve_hot_path) enforces this for the request-path functions.
 """
 
 from __future__ import annotations

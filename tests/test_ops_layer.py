@@ -533,3 +533,53 @@ def test_memory_state_refcounts(ray_tpu_start):
     assert mine and mine[0]["refcount"] >= 1, mine
     assert mine[0]["size_bytes"] > 0
     assert all("refcount" in r for r in rows)
+
+
+def test_direct_done_batch_coalescing(ray_tpu_start):
+    """Direct actor-call completion notifications (worker -> NM) are
+    debounced: a pipelined burst of direct calls must reach the node
+    manager in direct_done_batch frames carrying MANY completions each,
+    not one frame per call (the same coalescing discipline as
+    task_done_batch on the NM-routed path)."""
+    from ray_tpu.core.runtime_context import current_runtime
+
+    @ray_tpu.remote
+    class Echo:
+        def ping(self, i):
+            return i
+
+    e = Echo.remote()
+    rt = current_runtime()
+    # Engage the direct channel (discovery flips ready once the NM-path
+    # queue drains between calls).
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        ray_tpu.get(e.ping.remote(0))
+        st = rt._direct_states.get(e.actor_id.binary())
+        if st is not None and st["status"] == "ready":
+            break
+        time.sleep(0.02)
+    assert st is not None and st["status"] == "ready", st
+    nm = rt._nm
+    base_items = nm._stats["direct_calls_done"]
+    base_frames = nm._stats["direct_done_batches"]
+    # Pipelined load: submit a burst, then resolve — the worker chews
+    # through the whole batch and coalesces its notifications.
+    for _ in range(3):
+        assert ray_tpu.get(
+            [e.ping.remote(i) for i in range(64)], timeout=60
+        ) == list(range(64))
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        items = nm._stats["direct_calls_done"] - base_items
+        if items >= 3 * 64:
+            break
+        time.sleep(0.1)
+    items = nm._stats["direct_calls_done"] - base_items
+    frames = nm._stats["direct_done_batches"] - base_frames
+    assert items >= 3 * 64, (items, frames)
+    # Coalescing under load: far fewer frames than completions.
+    assert frames <= items // 4, (
+        f"{frames} direct_done_batch frames for {items} completions — "
+        "the worker->NM notification plane is not coalescing"
+    )

@@ -256,6 +256,120 @@ def validate_data_channel_pickle_free(pkg_dir):
                 )
     return failures
 
+# The direct actor-call plane's metric surface (core/runtime.py) with
+# the kind each must be declared under — README documents these names,
+# so a rename/kind change must fail CI, not dashboards.
+ACTOR_METRICS = {
+    "ray_tpu_actor_call_seconds": "histogram",
+    "ray_tpu_actor_call_inflight": "gauge",
+    "ray_tpu_actor_call_fallbacks_total": "counter",
+}
+
+# Config keys the direct actor-call plane documents (README knobs).
+ACTOR_CONFIG_KEYS = (
+    "direct_actor_calls", "direct_resolve_timeout_s",
+    "direct_done_flush_batch", "direct_done_flush_ms",
+)
+
+
+def validate_actor_metrics(declared):
+    failures = []
+    for name, kind in sorted(ACTOR_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: direct actor-call metric not declared "
+                f"(core/runtime.py drifted from the documented surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    return failures
+
+
+def validate_actor_config():
+    import dataclasses
+
+    from ray_tpu.core.config import Config
+
+    fields = {f.name for f in dataclasses.fields(Config)}
+    return [
+        f"core/config.py: direct actor-call config key {key!r} missing "
+        f"from Config (documented knob drifted from the flag table)"
+        for key in ACTOR_CONFIG_KEYS if key not in fields
+    ]
+
+
+# ---- serve handle hot-path lint ------------------------------------------
+# The serve request hot path must stay free of blocking node-manager
+# round-trips: with the direct actor-call plane, a steady-state request
+# is submit -> direct channel -> inline reply; one stray control-plane
+# call per request would reintroduce the NM as the serving bottleneck.
+# Calls to these names are allowed ONLY inside except-handler recovery
+# blocks of the hot-path functions below.
+SERVE_HOT_PATH_FUNCS = {
+    "remote", "_remote_batched", "_run_with_retry", "_flush",
+    "_route_with_retry", "_pick_with_refresh", "pick", "begin", "end",
+}
+SERVE_BLOCKING_NM_CALLS = {
+    "force_refresh", "call_sync", "request", "kv_get", "kv_put",
+    "kv_keys", "pubsub_op", "get_named_actor", "cluster_state", "nodes",
+}
+
+
+def _call_name(node: ast.Call):
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def validate_serve_hot_path(pkg_dir):
+    """Flag blocking NM round-trips outside except-handler recovery in
+    serve/handle.py's per-request hot path."""
+    path = os.path.join(pkg_dir, "serve", "handle.py")
+    if not os.path.isfile(path):
+        return [f"{path}: missing (serve handle moved without updating "
+                f"the lint?)"], 0
+    with open(path) as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            return [f"{path}: unparseable ({e})"], 0
+    failures = []
+    checked = 0
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in SERVE_HOT_PATH_FUNCS:
+            continue
+        checked += 1
+        # Every call node living under an except handler is recovery
+        # code (dead-replica refresh etc.) and exempt.
+        recovery_calls = set()
+        for handler in ast.walk(node):
+            if isinstance(handler, ast.ExceptHandler):
+                for call in ast.walk(handler):
+                    if isinstance(call, ast.Call):
+                        recovery_calls.add(id(call))
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call) or id(call) in recovery_calls:
+                continue
+            name = _call_name(call)
+            if name in SERVE_BLOCKING_NM_CALLS:
+                failures.append(
+                    f"ray_tpu/serve/handle.py:{call.lineno}: hot-path "
+                    f"function {node.name} calls blocking NM round-trip "
+                    f"{name}() outside except-handler recovery (the "
+                    f"direct actor-call plane keeps steady-state serve "
+                    f"requests off the node manager)"
+                )
+    return failures, checked
+
+
 # Callables that sample for a full wall-clock duration. Calling one of
 # these from a dashboard request handler blocks (and self-pollutes) the
 # request thread; handlers must use sample_in_thread / cluster fan-out.
@@ -354,6 +468,16 @@ def main() -> int:
     print(f"checked {len(TRANSFER_METRICS)} transfer metric name(s), "
           f"{len(TRANSFER_CONFIG_KEYS)} transfer config key(s), "
           f"data_channel pickle ban")
+    failures += validate_actor_metrics(declared)
+    failures += validate_actor_config()
+    serve_failures, n_hot = validate_serve_hot_path(
+        os.path.join(repo_root, "ray_tpu")
+    )
+    failures += serve_failures
+    print(f"checked {len(ACTOR_METRICS)} actor-call metric name(s), "
+          f"{len(ACTOR_CONFIG_KEYS)} direct-plane config key(s), "
+          f"{n_hot} serve hot-path function(s) for blocking NM "
+          f"round-trips")
     handler_failures, n_handlers = validate_dashboard_handlers(
         os.path.join(repo_root, "ray_tpu")
     )

@@ -1,0 +1,287 @@
+"""Direct actor-call plane bench (PERF_r07): sync actor round-trips
+measured unloaded and under a pipelined background call stream, over the
+direct channel AND over the NM-mediated path (direct_actor_calls=0) in
+fresh sessions — the before/after this plane exists for. Also injects a
+channel death mid-run to prove transparent NM-path fallback + automatic
+re-engagement (zero steady-state fallbacks on either side of the fault),
+and runs the rpc dispatch micro-bench guarding the compiled-validator
+satellite.
+
+Usage: python tools/run_actor_bench.py [out.json] [--calls N]
+
+`make perf-actor` runs the default configuration and records
+PERF_r07.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _engage(ray_tpu, handle, call, deadline_s=20.0):
+    from ray_tpu.core.runtime_context import current_runtime
+
+    rt = current_runtime()
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        ray_tpu.get(call())
+        st = rt._direct_states.get(handle.actor_id.binary())
+        if st is not None and st["status"] == "ready":
+            return st
+        time.sleep(0.02)
+    return None
+
+
+def _sync_rtt(ray_tpu, call, calls: int, windows: int = 3):
+    """Timed sync round-trips over several windows (scheduler-noise
+    tails on small shared boxes swing single-window means by 2x; the
+    per-window best and the pooled p50 are the stable statistics)."""
+    per = max(1, calls // windows)
+    lat = []
+    rates = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(per):
+            c0 = time.perf_counter()
+            ray_tpu.get(call())
+            lat.append(time.perf_counter() - c0)
+        rates.append(per / (time.perf_counter() - t0))
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    return {
+        "ops_s_best": round(max(rates), 1),
+        "ops_s_mean": round(sum(rates) / len(rates), 1),
+        "p50_us": round(p50 * 1e6, 1),
+        "p99_us": round(
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e6, 1
+        ),
+        "p50_implied_ops_s": round(1.0 / p50, 1),
+    }
+
+
+def _measure_mode(direct: bool, calls: int):
+    """One fresh session: unloaded + loaded sync RTT (loaded = a
+    background thread streaming 64-deep pipelined bursts at a second
+    actor), plus the plane's own counters when direct is on."""
+    import ray_tpu
+
+    os.environ["RAY_TPU_DIRECT_ACTOR_CALLS"] = "1" if direct else "0"
+    from ray_tpu.core.config import reset_config
+
+    reset_config()
+    ray_tpu.init(num_cpus=2, system_config={"log_to_driver": False})
+    out = {}
+    try:
+        @ray_tpu.remote
+        class P:
+            def ping(self):
+                return b"ok"
+
+        @ray_tpu.remote
+        class Q:
+            def ping(self):
+                return b"ok"
+
+        p, q = P.remote(), Q.remote()
+        ray_tpu.get([p.ping.remote(), q.ping.remote()])
+        if direct:
+            assert _engage(ray_tpu, p, lambda: p.ping.remote()) is not None
+            assert _engage(ray_tpu, q, lambda: q.ping.remote()) is not None
+        else:
+            for _ in range(100):
+                ray_tpu.get(p.ping.remote())
+
+        out["unloaded"] = _sync_rtt(ray_tpu, lambda: p.ping.remote(),
+                                    calls)
+
+        stop = threading.Event()
+        bg_count = [0]
+
+        def load():
+            while not stop.is_set():
+                ray_tpu.get([q.ping.remote() for _ in range(64)],
+                            timeout=120)
+                bg_count[0] += 64
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        out["loaded"] = _sync_rtt(ray_tpu, lambda: p.ping.remote(), calls)
+        stop.set()
+        t.join(timeout=30)
+        out["loaded"]["background_calls"] = bg_count[0]
+
+        if direct:
+            from ray_tpu.core.runtime_context import current_runtime
+
+            rt = current_runtime()
+            stats = rt.direct_stats()
+            out["direct_stats"] = {
+                "calls": stats["calls"],
+                "fallbacks_steady_state": stats["fallbacks"],
+            }
+            nm = rt._nm
+            out["nm_completion_batches"] = {
+                "direct_calls_done": nm._stats["direct_calls_done"],
+                "direct_done_batches": nm._stats["direct_done_batches"],
+            }
+
+            # ---- injected channel death: transparent fallback --------
+            st = rt._direct_states.get(p.actor_id.binary())
+            before = rt._direct_fallbacks
+            refs = [p.ping.remote() for _ in range(10)]
+            st["chan"].conn.close()
+            refs += [p.ping.remote() for _ in range(10)]
+            vals = ray_tpu.get(refs, timeout=60)
+            recovered = _engage(ray_tpu, p, lambda: p.ping.remote())
+            steady = rt._direct_fallbacks
+            for _ in range(50):
+                ray_tpu.get(p.ping.remote())
+            out["fault_injection"] = {
+                "calls_survived": sum(1 for v in vals if v == b"ok"),
+                "fallback_calls": rt._direct_fallbacks - before
+                if recovered is None else steady - before,
+                "re_engaged": recovered is not None,
+                "fallbacks_after_recovery":
+                    rt._direct_fallbacks - steady,
+            }
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_DIRECT_ACTOR_CALLS", None)
+        reset_config()
+    return out
+
+
+def _rpc_dispatch_bench(n: int = 50_000):
+    """Compiled-validator dispatch throughput (server hot path)."""
+    import asyncio
+
+    from ray_tpu.core.rpc import Method, ServiceRegistry, ServiceSpec
+
+    class Impl:
+        async def _rpc_probe(self, ctx, object_id, offset, length):
+            return {"data": None}
+
+    spec = ServiceSpec("bench", (
+        Method("probe", request=(("object_id", "bytes"),
+                                 ("offset", "int"),
+                                 ("length", "int", False, 0))),
+    ))
+    reg = ServiceRegistry()
+    reg.register(spec, Impl())
+    msg = {"object_id": b"x" * 20, "offset": 0, "length": 4096}
+
+    async def run():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            await reg.dispatch(None, "probe", msg)
+        return n / (time.perf_counter() - t0)
+
+    loop = asyncio.new_event_loop()
+    try:
+        ops = loop.run_until_complete(run())
+    finally:
+        loop.close()
+    return round(ops, 1)
+
+
+def main():
+    args = sys.argv[1:]
+    out_path = None
+    calls = 3000
+    i = 0
+    while i < len(args):
+        if args[i] == "--calls":
+            calls = int(args[i + 1])
+            i += 2
+        else:
+            out_path = args[i]
+            i += 1
+
+    result = {
+        "note": (
+            "Round-7 record for the direct actor-call plane. direct vs "
+            "nm_path run the SAME build in fresh sessions with the "
+            "plane on/off (RAY_TPU_DIRECT_ACTOR_CALLS) — the NM-path "
+            "numbers are the before this plane exists for. loaded = "
+            "sync round-trips while a second actor serves a 64-deep "
+            "pipelined background stream."
+        ),
+        "config": {"physical_cores": os.cpu_count(), "calls": calls},
+    }
+    result["direct"] = _measure_mode(direct=True, calls=calls)
+    result["nm_path"] = _measure_mode(direct=False, calls=calls)
+    d, n = result["direct"], result["nm_path"]
+    result["speedup_direct_vs_nm"] = {
+        "unloaded_ops": round(
+            d["unloaded"]["ops_s_best"]
+            / max(1e-9, n["unloaded"]["ops_s_best"]), 2
+        ),
+        "loaded_ops": round(
+            d["loaded"]["ops_s_best"]
+            / max(1e-9, n["loaded"]["ops_s_best"]), 2
+        ),
+        "unloaded_p50": round(
+            n["unloaded"]["p50_us"] / max(1e-9, d["unloaded"]["p50_us"]),
+            2,
+        ),
+        "loaded_p50": round(
+            n["loaded"]["p50_us"] / max(1e-9, d["loaded"]["p50_us"]), 2
+        ),
+    }
+    result["rpc_dispatch_ops_s"] = _rpc_dispatch_bench()
+    batches = d.get("nm_completion_batches", {})
+    n_done = batches.get("direct_calls_done", 0)
+    n_batches = max(1, batches.get("direct_done_batches", 1))
+    fi = d.get("fault_injection", {})
+    result["satellite_guards"] = {
+        "rpc_dispatch_ops_s": result["rpc_dispatch_ops_s"],
+        "rpc_note": (
+            "compiled per-method request validators + pre-bound "
+            "handlers (core/rpc.py); guard: dispatch of a 3-field "
+            "method must stay >=500k/s on this box"
+        ),
+        "direct_done_coalescing": {
+            "items": n_done,
+            "batches": n_batches,
+            "calls_per_batch": round(n_done / n_batches, 1),
+        },
+    }
+    result["acceptance"] = {
+        "reference_bar": ">=5.0k/s loaded sync actor RTT (reference box)",
+        "same_box_result": (
+            f"direct plane {result['speedup_direct_vs_nm']['loaded_ops']}x "
+            f"the NM path on loaded ops "
+            f"({d['loaded']['ops_s_best']} vs {n['loaded']['ops_s_best']}/s), "
+            f"{result['speedup_direct_vs_nm']['unloaded_ops']}x unloaded; "
+            f"loaded p50 {d['loaded']['p50_us']}us vs NM "
+            f"{n['loaded']['p50_us']}us"
+        ),
+        "fallback_pulls_steady_state": d.get("direct_stats", {}).get(
+            "fallbacks_steady_state"),
+        "injected_channel_death": (
+            f"{fi.get('calls_survived')}/20 calls survive in submission "
+            f"order (worker-side task-id dedup = exactly-once), "
+            f"re_engaged={fi.get('re_engaged')}, "
+            f"{fi.get('fallbacks_after_recovery')} fallbacks after recovery"
+        ),
+    }
+
+    text = json.dumps(result, indent=1)
+    print(text)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()
