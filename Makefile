@@ -11,7 +11,7 @@ LDLIBS   := -lpthread -lrt
 STORE_SRC := src/store/rts_store.cc
 EXT       := ray_tpu/_native/_rtstore.so
 
-.PHONY: native native-test cpp-client clean check-obs check-metrics perf-transfer perf-actor
+.PHONY: native native-test cpp-client clean check-obs check-metrics perf-transfer perf-actor chaos
 
 # Observability lint: every Counter/Gauge/Histogram the package declares
 # at import time (Prometheus-valid names, counters end in _total, no
@@ -22,6 +22,15 @@ check-obs:
 
 # Historical alias for check-obs.
 check-metrics: check-obs
+
+# Chaos plane acceptance suite: the full fault-injection partition
+# matrix (every registered point proves its advertised degradation path
+# with exactly-once semantics) plus the drain + rolling-restart tests
+# (every worker node of a live 3-node cluster replaced under a serving
+# deployment with zero failed requests).
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q \
+	  -p no:cacheprovider
 
 # Cross-node transfer bench: 2-node loopback, 256 MiB object through the
 # striped data plane, JSON GB/s + concurrent control-plane ping p99.

@@ -33,6 +33,7 @@ from .core import (  # noqa: F401
     available_resources,
     cancel,
     cluster_resources,
+    drain_node,
     get,
     get_actor,
     get_runtime_context,
@@ -73,6 +74,7 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "nodes",
+    "drain_node",
     "timeline",
     "timeline_otlp",
     "kv_put",

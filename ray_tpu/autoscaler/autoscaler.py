@@ -124,6 +124,121 @@ class Autoscaler:
     def num_workers(self) -> int:
         return len(self.provider.non_terminated_nodes())
 
+    # -- rolling replacement -------------------------------------------------
+
+    def rolling_restart(self, *, drain_timeout: Optional[float] = None,
+                        drain_fn=None,
+                        register_timeout: Optional[float] = None
+                        ) -> List[Tuple[str, str]]:
+        """Zero-downtime rolling replacement of every provider node (ref
+        analogue: kuberay's rolling upgrade — drain, then delete): for
+        each node, launch a same-type replacement, wait for all its
+        hosts to register, drain every host of the old node
+        (``ray_tpu.drain_node`` unless ``drain_fn(node_hex, timeout)``
+        is supplied — a CLI head without a driver runtime passes its
+        own), then terminate the old provider node. Returns
+        ``[(old_provider_id, new_provider_id), ...]``."""
+        import sys
+
+        if drain_fn is None:
+            from ..core.api import drain_node as _api_drain
+
+            def drain_fn(node_hex, timeout=None):
+                return _api_drain(node_hex, timeout=timeout)
+
+        if register_timeout is None:
+            register_timeout = self.config.boot_timeout_s
+        replaced: List[Tuple[str, str]] = []
+        for nid in list(self.provider.non_terminated_nodes()):
+            tname = self._type_of.get(nid) or self._default_type()
+            new_id = self._launch(tname)
+            deadline = time.monotonic() + register_timeout
+            hosts = max(1, int(
+                self.config.node_types.get(tname, {})
+                .get("hosts_per_node", 1)
+            ))
+            views: List[Dict[str, Any]] = []
+            while time.monotonic() < deadline:
+                views = [v for v in self._nodes_fn()
+                         if v.get("state") == "alive"
+                         and (v.get("labels") or {})
+                         .get(PROVIDER_NODE_LABEL) == new_id]
+                if len(views) >= hosts:
+                    break
+                time.sleep(0.25)
+            if len(views) < hosts:
+                # The replacement never (fully) registered — draining
+                # the old node now would shrink capacity one node per
+                # iteration, the opposite of zero-downtime. Reap the
+                # failed replacement and abort the roll.
+                try:
+                    self.provider.terminate_node(new_id)
+                except Exception:
+                    pass
+                cluster_events.emit(
+                    cluster_events.WARNING, cluster_events.AUTOSCALER,
+                    f"rolling restart aborted: replacement {new_id} for "
+                    f"{nid} registered {len(views)}/{hosts} host(s) "
+                    f"within {register_timeout}s",
+                    custom_fields={"old": nid, "new": new_id,
+                                   "node_type": tname},
+                )
+                raise RuntimeError(
+                    f"rolling restart aborted at node {nid}: replacement "
+                    f"{new_id} registered {len(views)}/{hosts} host(s) "
+                    f"within {register_timeout}s "
+                    f"({len(replaced)} node(s) already replaced)"
+                )
+            # Drain every host the old provider node registered.
+            for v in self._nodes_fn():
+                if (v.get("labels") or {}).get(PROVIDER_NODE_LABEL) \
+                        != nid or v.get("state") != "alive":
+                    continue
+                try:
+                    drain_fn(v["node_id"], timeout=drain_timeout)
+                except Exception as e:  # noqa: BLE001
+                    from ..core.api import DrainRefusedError
+
+                    if isinstance(e, DrainRefusedError):
+                        # Refused by policy (the node hosts the serve
+                        # controller): it is healthy — terminating it
+                        # anyway would behead serve, the exact outcome
+                        # the refusal guards against. Reap the spare
+                        # replacement and abort the roll.
+                        try:
+                            self.provider.terminate_node(new_id)
+                        except Exception:
+                            pass
+                        cluster_events.emit(
+                            cluster_events.WARNING,
+                            cluster_events.AUTOSCALER,
+                            f"rolling restart aborted at {nid}: {e}",
+                            custom_fields={"old": nid, "new": new_id},
+                        )
+                        raise
+                    # A wedged/dead node must still be replaceable:
+                    # keep rolling and terminate it undrained.
+                    sys.stderr.write(
+                        f"[autoscaler] drain of {v['node_id'][:8]} "
+                        f"failed ({e!r}); terminating anyway\n"
+                    )
+            try:
+                self.provider.terminate_node(nid)
+            except Exception:
+                pass
+            self._type_of.pop(nid, None)
+            self._booting.pop(nid, None)
+            self._idle_since.pop(nid, None)
+            cluster_events.emit(
+                cluster_events.INFO, cluster_events.AUTOSCALER,
+                f"rolling restart: node {nid} drained and replaced by "
+                f"{new_id} (type {tname})",
+                custom_fields={"old": nid, "new": new_id,
+                               "node_type": tname},
+            )
+            replaced.append((nid, new_id))
+        return replaced
+
     # -- demand -------------------------------------------------------------
 
     def _unmet_shapes(self, alive: List[Dict[str, Any]],

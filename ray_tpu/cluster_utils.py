@@ -18,7 +18,7 @@ import sys
 import tempfile
 import time
 import uuid
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from . import core as _core  # noqa: F401  (ensures package import order)
@@ -31,6 +31,7 @@ class NodeHandle:
     session_dir: str
     resources: Dict[str, float]
     node_id_hex: Optional[str] = None
+    labels: Dict[str, str] = field(default_factory=dict)
 
 
 class Cluster:
@@ -97,7 +98,8 @@ class Cluster:
             start_new_session=True,
         )
         log.close()
-        handle = NodeHandle(proc=proc, session_dir=session_dir, resources=res)
+        handle = NodeHandle(proc=proc, session_dir=session_dir,
+                            resources=res, labels=dict(labels or {}))
         self._nodes.append(handle)
         if wait:
             self.wait_for_nodes(len(self._nodes) + 1)
@@ -144,6 +146,43 @@ class Cluster:
             handle.proc.wait(timeout=10)
         except Exception:
             handle.proc.kill()
+
+    # ------------------------------------------------------- rolling restart
+
+    def rolling_restart(
+        self,
+        *,
+        drain_timeout: Optional[float] = None,
+    ) -> list:
+        """Zero-downtime rolling node replacement (ref analogue: kuberay's
+        drain-based rolling upgrade): for each worker node, in order —
+        (1) start a same-shape replacement and wait for it to register,
+        (2) drain the old node (``ray_tpu.drain_node``: schedulers stop
+        targeting it, serve replicas surge-migrate, in-flight work
+        finishes, primary object copies replicate off), (3) the drained
+        node exits cleanly and is reaped. A live serve deployment keeps
+        answering throughout. Returns ``[(old_hex, new_hex), ...]``."""
+        import ray_tpu
+
+        replaced = []
+        for handle in list(self._nodes):
+            old_hex = handle.node_id_hex
+            res = dict(handle.resources)
+            num_cpus = res.pop("CPU", 1)
+            new = self.add_node(num_cpus=num_cpus,
+                                resources=res or None,
+                                labels=handle.labels or None)
+            ray_tpu.drain_node(old_hex, timeout=drain_timeout)
+            # The drained node exits on its own; give it a moment, then
+            # reap whatever is left (remove_node tolerates an already-
+            # exited process).
+            try:
+                handle.proc.wait(timeout=30)
+            except Exception:
+                pass
+            self.remove_node(handle, graceful=True)
+            replaced.append((old_hex, new.node_id_hex))
+        return replaced
 
     # --------------------------------------------------------------- teardown
 

@@ -4,6 +4,7 @@ from .api import (  # noqa: F401
     available_resources,
     cancel,
     cluster_resources,
+    drain_node,
     get,
     get_actor,
     init,

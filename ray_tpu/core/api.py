@@ -256,6 +256,7 @@ def nodes():
         {
             "NodeID": v["node_id"],
             "Alive": v["state"] == "alive",
+            "State": v.get("state"),
             "Resources": v["resources_total"],
             "Available": v.get("resources_available",
                                v["resources_total"]),
@@ -265,3 +266,118 @@ def nodes():
         }
         for v in rt.nodes()
     ]
+
+
+class DrainRefusedError(RuntimeError):
+    """The drain was refused by policy (head node, or the node hosts
+    the serve controller) — the node is healthy and untouched. Rolling
+    restarts must NOT fall back to terminating such a node."""
+
+
+def drain_node(node_id: str, timeout: Optional[float] = None
+               ) -> Dict[str, Any]:
+    """Drain ``node_id`` (full hex or unique prefix) and retire it with
+    zero downtime (ref analogue: the GCS DrainNode RPC behind kuberay's
+    drain-before-delete). Three phases: (1) the GCS marks the node
+    draining — schedulers everywhere stop targeting it while in-flight
+    traffic keeps flowing; (2) if a serve controller exists, its
+    replicas on that node are surge-replaced elsewhere and gracefully
+    drained; (3) the node finishes in-flight work, replicates primary
+    object copies off-node, acks, and exits — consumers re-locate via
+    the GCS, and anything that missed the window replays via lineage.
+
+    Returns the drain report ``{"ok", "replicated",
+    "leftover_actors", ...}``; raises on an unknown/ambiguous node or a
+    failed drain."""
+    rt = runtime_context.current_runtime()
+    nm = getattr(rt, "_nm", None)
+    if nm is None:
+        raise RuntimeError(
+            "drain_node needs a cluster-attached driver (thin clients "
+            "cannot drive drains)"
+        )
+    if timeout is None:
+        timeout = get_config().drain_timeout_s
+    matches = sorted({
+        v["node_id"] for v in rt.nodes()
+        if v["node_id"].startswith(node_id) and v.get("state") != "dead"
+    })
+    if not matches:
+        raise ValueError(f"no live node matches {node_id!r}")
+    if len(matches) > 1:
+        raise ValueError(
+            f"node id prefix {node_id!r} is ambiguous: "
+            f"{[m[:12] for m in matches]}"
+        )
+    full = matches[0]
+    # Snapshot the node's actors BEFORE phase 1: once the node is
+    # draining it leaves the alive-state fan-out, so the serve
+    # controller could no longer resolve which replicas live there.
+    from ..util import state as state_api
+
+    try:
+        rows = [a for a in state_api.list_actors()
+                if a.get("node_id") == full]
+        on_node = [a["actor_id"] for a in rows]
+        from ..serve.controller import CONTROLLER_NAME
+
+        if any(a.get("name") == CONTROLLER_NAME for a in rows):
+            # The controller is pinned to its creating driver's node;
+            # draining that node would kill the serve control plane
+            # (no autoscaling/health/rollouts, and the next deploy
+            # would orphan the running replicas under a fresh empty
+            # controller). Refuse instead of silently beheading serve.
+            raise DrainRefusedError(
+                f"node {full[:8]} hosts the serve controller — drain "
+                f"refused (shut serve down or deploy from another "
+                f"node first)"
+            )
+    except RuntimeError:
+        raise
+    except Exception as e:
+        # Swallowing this would silently skip serve-replica migration
+        # and let replicas die with the node while the drain reports
+        # ok — abort before phase "begin" instead (nothing to roll
+        # back yet).
+        raise RuntimeError(
+            f"drain of {full[:8]} aborted: could not snapshot the "
+            f"node's actors for serve migration ({e!r})"
+        ) from e
+    reply = nm.call_sync(
+        nm._gcs.drain_node(full, phase="begin"), timeout=30.0
+    )
+    if not reply.get("ok"):
+        raise RuntimeError(f"drain begin failed: {reply.get('error')}")
+    # From here a failure must roll the node back to "alive": a node
+    # left "draining" is reachable but unschedulable forever (silent
+    # capacity loss with no operator undo).
+    try:
+        if on_node:
+            # Serve replicas migrate via the controller's drain
+            # machinery (surge a replacement, bump the route set, drain
+            # the victim).
+            try:
+                from ..serve.controller import CONTROLLER_NAME
+
+                controller = get_actor(CONTROLLER_NAME)
+                get(controller.drain_replicas.remote(on_node),
+                    timeout=timeout)
+            except ValueError:
+                pass  # no serve controller in this cluster
+        reply = nm.call_sync(
+            nm._gcs.drain_node(full, phase="finish", timeout=timeout),
+            timeout=timeout + 30.0,
+        )
+        if not reply.get("ok"):
+            raise RuntimeError(
+                f"drain of node {full[:8]} failed: {reply.get('error')}"
+            )
+    except BaseException:
+        try:
+            nm.call_sync(
+                nm._gcs.drain_node(full, phase="abort"), timeout=30.0
+            )
+        except Exception:
+            pass  # best effort — the original failure is what matters
+        raise
+    return reply

@@ -129,20 +129,28 @@ class _ReconnectingConn:
                     raise ConnectionClosed("client connection lost")
 
     def _reconnect(self) -> bool:
-        import time
+        from ..util.backoff import Backoff
 
         with self._lock:
             if self._dead:
                 return False
             self._ok.clear()
-            deadline = time.monotonic() + self._timeout_s
-            while time.monotonic() < deadline and not self._dead:
+            # Jittered exponential redial (was a fixed 1s sleep): fast
+            # recovery from a blip, spaced-out attempts against a head
+            # that stays down, and no thundering herd when many clients
+            # lose the same head at once.
+            wait = Backoff(base=0.25, factor=1.7, max_delay=2.0,
+                           jitter=0.3, deadline_s=self._timeout_s)
+            redialed = False
+            while not self._dead:
                 try:
                     self._conn = self._redial()
+                    redialed = True
                     break
                 except Exception:
-                    time.sleep(1.0)
-            else:
+                    if not wait.sleep():
+                        break
+            if not redialed:
                 self._dead = True
                 self._ok.set()  # release parked senders into the raise
                 return False

@@ -199,6 +199,12 @@ class Config:
     # blocking runtime request flushes immediately either way).
     direct_done_flush_batch: int = 16
     direct_done_flush_ms: float = 50.0
+    # --- drain & rolling replacement (ref analogue: the DrainNode RPC +
+    # kuberay's drain-before-delete, node_manager.proto DrainRaylet) ----
+    # Budget for one node drain: in-flight work must finish and primary
+    # object copies must replicate off-node inside this window; past it
+    # the node exits anyway and lineage re-execution covers the rest.
+    drain_timeout_s: float = 60.0
     # --- profiling & hang diagnosis (ref analogue: `ray stack` + the
     # dashboard reporter's profile_manager) -------------------------------
     # A task running longer than this (seconds) gets its worker's stack

@@ -32,7 +32,10 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
+
+from ..util import faults
 
 MAGIC = b"RTPD"
 VERSION = 1
@@ -142,6 +145,14 @@ class DataChannel:
         copy."""
         sock = self._sock
         try:
+            # Chaos plane: an injected error (InjectedFault is an
+            # OSError) lands in the handler below exactly like a
+            # mid-stream reset — the stripe fails over to the
+            # control-plane chunk protocol.
+            delay = faults.fire(faults.DATA_CHANNEL_IO,
+                                peer=f"{self.host}:{self.port}")
+            if delay:
+                time.sleep(delay)
             sock.sendall(
                 _REQUEST.pack(OP_PULL_RANGE, len(oid), offset, length) + oid
             )

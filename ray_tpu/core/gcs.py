@@ -25,6 +25,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
+from ..util import faults
 from .config import Config, get_config
 from .ids import ActorID, NodeID, ObjectID
 from .protocol import AioFramedWriter as _FramedWriter
@@ -49,12 +50,35 @@ GCS_SERVICES = (
                request=(("host", "str"), ("peer_port", "int"),
                         ("resources", "dict"),
                         ("labels", "dict", False)),
-               reply=(("nodes", "list"),)),
+               reply=(("nodes", "list"), ("chaos", "dict", False))),
         Method("heartbeat",
                request=(("available", "dict"), ("pending", "int"),
                         ("shapes", "list", False)),
                notify=True),
         Method("get_nodes", reply=(("nodes", "list"),)),
+        # Drain lifecycle (ref analogue: the DrainNode GCS RPC behind
+        # kuberay's drain-before-delete): "begin" marks the node
+        # draining (schedulers stop targeting it), "finish" tells the
+        # node to run its drain state machine and exit; "full" = both;
+        # "abort" rolls a draining node back to alive/schedulable.
+        Method("drain_node",
+               request=(("node_id", "str"),
+                        ("phase", "str", False, "full"),
+                        ("timeout", "float", False, 60.0)),
+               reply=(("ok", "bool"), ("error", "str"),
+                      ("replicated", "int", False, 0),
+                      ("leftover_actors", "int", False, 0))),
+    )),
+    ServiceSpec("ChaosService", (
+        # Cluster-wide deterministic fault injection (util/faults.py):
+        # arm replaces the whole plan and pushes it to every node
+        # manager + worker; disarm arms the empty plan.
+        Method("chaos_arm",
+               request=(("specs", "list"),),
+               reply=(("gen", "int"),)),
+        Method("chaos_disarm", reply=(("gen", "int"),)),
+        Method("chaos_list",
+               reply=(("specs", "list"), ("gen", "int"))),
     )),
     ServiceSpec("InternalKVService", (
         Method("kv_put",
@@ -233,6 +257,18 @@ class GcsService:
         self.on_node_dead: Optional[Callable[[NodeEntry], None]] = None
         self.on_load_update: Optional[Callable[[Dict[str, Any]], None]] = None
         self.on_pgs_invalidated: Optional[Callable[[List[str]], None]] = None
+        self.on_node_draining: Optional[Callable[[NodeEntry], None]] = None
+        self.on_node_undrain: Optional[Callable[[NodeEntry], None]] = None
+        self.on_chaos_update: Optional[
+            Callable[[List[Dict[str, Any]], int], None]
+        ] = None
+
+        # Chaos plane: the armed fault-injection plan, pushed to every
+        # node (chaos_update broadcast) and handed to late joiners in
+        # their register_node reply.
+        self.chaos_specs: List[Dict[str, Any]] = []
+        self.chaos_gen = 0
+        self._chaos_spec_seq = 0
 
         self._health_task: Optional[asyncio.Task] = None
         # Durable-table persistence (ref analogue: gcs_storage /
@@ -494,7 +530,10 @@ class GcsService:
             if node_id is not None:
                 self._conns.pop(node_id, None)
                 entry = self._nodes.get(node_id)
-                if entry is not None and entry.state == "alive":
+                # "alive" OR "draining": a drained node's clean exit
+                # still needs the death cleanup (location/actor purge +
+                # broadcast) — everything it owned already migrated.
+                if entry is not None and entry.state != "dead":
                     await self._mark_node_dead(entry, "connection closed")
 
     @staticmethod
@@ -502,6 +541,11 @@ class GcsService:
         op = msg.get("op")
         return (
             op == "pg_wait"
+            # drain_node phase=finish awaits the target node's whole
+            # drain state machine (up to drain_timeout_s); inline it
+            # would stall this connection's heartbeat reads and the
+            # health sweep would declare the CALLER dead mid-drain.
+            or op == "drain_node"
             or (op == "kv_get" and msg.get("wait_timeout"))
             or (op == "locate_object" and msg.get("timeout"))
         )
@@ -542,6 +586,154 @@ class GcsService:
 
     async def _rpc_get_nodes(self, node_id):
         return {"nodes": [e.view() for e in self._nodes.values()]}
+
+    async def _rpc_drain_node(self, _ctx, node_id, phase="full",
+                              timeout=60.0):
+        from ..util import events as _events
+
+        try:
+            nid = NodeID.from_hex(node_id)
+        except Exception:
+            return {"ok": False, "error": f"bad node id {node_id!r}"}
+        entry = self._nodes.get(nid)
+        if entry is None or entry.state == "dead":
+            return {"ok": False,
+                    "error": f"node {node_id[:8]} unknown or dead"}
+        if entry.is_head:
+            return {"ok": False, "error": "refusing to drain the head "
+                                          "node (it hosts the GCS)"}
+        if phase not in ("begin", "finish", "full", "abort"):
+            return {"ok": False, "error": f"unknown phase {phase!r}"}
+        if phase == "abort":
+            await self._drain_rollback(entry, node_id)
+            return {"ok": True, "error": ""}
+        if phase in ("begin", "full") and entry.state != "draining":
+            # Phase 1: the node becomes unschedulable everywhere while
+            # staying reachable (peers mark it draining, the pg placer
+            # and pick_node skip non-alive views), so replacements land
+            # elsewhere while in-flight traffic keeps flowing.
+            entry.state = "draining"
+            await self._broadcast(
+                {"type": "node_draining", "node_id": node_id}
+            )
+            self.pubsub.publish(
+                NODE_STATE,
+                {"event": "draining", "node_id": node_id},
+                key=node_id,
+            )
+            self._record_event(
+                _events.INFO, _events.GCS,
+                f"node {node_id[:8]} draining",
+                node_id=node_id,
+            )
+            if self.on_node_draining is not None:
+                self.on_node_draining(entry)
+        if phase in ("finish", "full"):
+            # Phase 2: the node runs its drain state machine (finish
+            # in-flight work, replicate primary object copies off-node)
+            # and exits cleanly after acking.
+            try:
+                peer = await self._pg_peer(node_id)
+                reply = await peer.request(
+                    {"type": "drain", "timeout": timeout},
+                    timeout=timeout + 15.0,
+                )
+            except Exception as e:  # noqa: BLE001 — reported, not raised
+                if phase == "full":
+                    # One-shot callers have no begin/finish/abort
+                    # sequence of their own: roll the node back here so
+                    # a failed full drain never strands it "draining".
+                    await self._drain_rollback(entry, node_id)
+                return {"ok": False, "error": str(e) or type(e).__name__}
+            if phase == "full" and not reply.get("ok"):
+                await self._drain_rollback(entry, node_id)
+            self._record_event(
+                _events.INFO, _events.GCS,
+                f"node {node_id[:8]} drained "
+                f"(replicated {reply.get('replicated', 0)} object(s), "
+                f"{reply.get('leftover_actors', 0)} actor(s) left)",
+                node_id=node_id,
+                custom_fields={
+                    "replicated": reply.get("replicated", 0),
+                    "leftover_actors": reply.get("leftover_actors", 0),
+                },
+            )
+            return {"ok": bool(reply.get("ok")),
+                    "error": str(reply.get("error") or ""),
+                    "replicated": int(reply.get("replicated") or 0),
+                    "leftover_actors":
+                        int(reply.get("leftover_actors") or 0)}
+        return {"ok": True, "error": ""}
+
+    async def _drain_rollback(self, entry, node_id: str) -> None:
+        """Roll a draining node back to alive/schedulable (a failed
+        drain must never strand a node "draining" forever — reachable
+        but excluded from pick_node/place_bundles, silent capacity
+        loss with no operator undo)."""
+        from ..util import events as _events
+
+        if entry.state != "draining":
+            return
+        entry.state = "alive"
+        await self._broadcast(
+            {"type": "node_undrain", "node_id": node_id}
+        )
+        self.pubsub.publish(
+            NODE_STATE,
+            {"event": "undrain", "node_id": node_id},
+            key=node_id,
+        )
+        self._record_event(
+            _events.WARNING, _events.GCS,
+            f"node {node_id[:8]} drain aborted — back to alive",
+            node_id=node_id,
+        )
+        if self.on_node_undrain is not None:
+            self.on_node_undrain(entry)
+
+    async def _rpc_chaos_arm(self, _ctx, specs):
+        from ..util import events as _events
+        from ..util import faults
+
+        normalized = [faults.validate_spec(s) for s in (specs or [])]
+        self.chaos_gen += 1
+        # Stamp each spec with a stable id: entries retained across an
+        # append (the CLI re-arms current-plan + new-spec) keep their
+        # id, so apply_plan preserves their hit/fire counters and an
+        # exhausted once/max_fires spec does NOT fire again just
+        # because an unrelated spec was armed. Brand-new entries (no
+        # id, or an id the current plan doesn't hold) get a fresh one
+        # and start from zero.
+        known = {s.get("id") for s in self.chaos_specs}
+        for s in normalized:
+            if s.get("id") is None or s["id"] not in known:
+                s["id"] = f"cs{self.chaos_gen}-{self._chaos_spec_seq}"
+                self._chaos_spec_seq += 1
+        self.chaos_specs = normalized
+        # This (head) process arms immediately; remote nodes via the
+        # broadcast; the head's workers via the on_chaos_update hook.
+        faults.apply_plan(normalized, self.chaos_gen)
+        await self._broadcast({
+            "type": "chaos_update", "specs": normalized,
+            "gen": self.chaos_gen,
+        })
+        if self.on_chaos_update is not None:
+            self.on_chaos_update(normalized, self.chaos_gen)
+        self._record_event(
+            _events.WARNING if normalized else _events.INFO,
+            _events.GCS,
+            f"chaos plan armed: {len(normalized)} spec(s) "
+            f"(gen {self.chaos_gen})" if normalized
+            else f"chaos plan disarmed (gen {self.chaos_gen})",
+            custom_fields={"specs": normalized, "gen": self.chaos_gen},
+        )
+        return {"gen": self.chaos_gen}
+
+    async def _rpc_chaos_disarm(self, _ctx):
+        return await self._rpc_chaos_arm(_ctx, [])
+
+    async def _rpc_chaos_list(self, _ctx):
+        return {"specs": list(self.chaos_specs), "gen": self.chaos_gen}
 
     async def _rpc_kv_put(self, node_id, key, value, overwrite=True):
         return {"added": self.kv_put(key, value, overwrite)}
@@ -738,6 +930,8 @@ class GcsService:
         pg["placing"] = True
         try:
             reqs = [ResourceSet(b) for b in pg["bundles"]]
+            # place_bundles filters to state == "alive" itself; draining
+            # and dead nodes never receive new bundles.
             chosen = place_bundles(
                 reqs, pg["strategy"], self.nodes_view(),
                 label_selectors=pg.get("label_selectors"),
@@ -868,7 +1062,9 @@ class GcsService:
         if peer is not None and not peer.closed:
             return peer
         entry = self._nodes.get(NodeID.from_hex(node_hex))
-        if entry is None or entry.state != "alive":
+        # Draining nodes stay reachable: the drain RPC itself and any
+        # in-flight PG release must still get through.
+        if entry is None or entry.state not in ("alive", "draining"):
             raise ConnectionError(f"node {node_hex[:8]} not alive")
         fut: asyncio.Future = self._loop.create_future()
         self._pg_peers[node_hex] = fut
@@ -928,7 +1124,13 @@ class GcsService:
             self.on_node_added(entry)
         # New capacity may unblock pending placement groups.
         asyncio.ensure_future(self._retry_pending_pgs())
-        return {"nodes": [e.view() for e in self._nodes.values()]}
+        return {
+            "nodes": [e.view() for e in self._nodes.values()],
+            # Late joiners arm the current chaos plan immediately (an
+            # empty plan disarms — correct after a head restart too).
+            "chaos": {"specs": list(self.chaos_specs),
+                      "gen": self.chaos_gen},
+        }
 
     async def _retry_pending_pgs(self):
         for pg_id, pg in list(self._pgs.items()):
@@ -1107,11 +1309,18 @@ class GcsService:
             self._object_nodes.pop(object_id, None)
 
     def _pick_object_node(self, object_id: ObjectID) -> Optional[NodeID]:
-        for nid in self._object_nodes.get(object_id, ()):  # any alive replica
+        best = None
+        for nid in self._object_nodes.get(object_id, ()):  # any live replica
             entry = self._nodes.get(nid)
-            if entry is not None and entry.state == "alive":
+            if entry is None:
+                continue
+            if entry.state == "alive":
                 return nid
-        return None
+            if entry.state == "draining" and best is None:
+                # Still readable, but prefer a replica that will outlive
+                # the drain when one exists.
+                best = nid
+        return best
 
     async def locate_object(
         self, object_id: ObjectID, timeout: float = 0
@@ -1128,6 +1337,15 @@ class GcsService:
 
     def nodes_view(self) -> List[Dict[str, Any]]:
         return [e.view() for e in self._nodes.values()]
+
+
+# Ops the gcs_rpc injection point never faults: the chaos plane's own
+# control traffic and node registration. Without this, arming gcs_rpc
+# with mode=always leaves no working path to disarm (every disarm RPC
+# and every re-register after a drop self-faults until head restart).
+_GCS_RPC_FAULT_EXEMPT_OPS = frozenset(
+    {"chaos_arm", "chaos_disarm", "chaos_list", "register_node"}
+)
 
 
 class GcsClient:
@@ -1185,6 +1403,17 @@ class GcsClient:
     async def request(self, msg: Dict[str, Any], timeout: float = 30.0):
         if self.closed or self._writer is None:
             raise ConnectionError("GCS connection lost")
+        # Chaos plane: an injected error here surfaces exactly like a
+        # lost GCS round trip (callers retry/backoff or reconnect).
+        # Chaos-control and registration ops are exempt: faulting
+        # chaos_disarm would make an armed cluster un-disarmable, and
+        # faulting register_node would keep a partitioned node from
+        # ever rejoining to receive the disarm — the kill switch must
+        # always work.
+        if msg.get("op") not in _GCS_RPC_FAULT_EXEMPT_OPS:
+            delay = faults.fire(faults.GCS_RPC, op=msg.get("op"))
+            if delay:
+                await asyncio.sleep(delay)
         self._msg_counter += 1
         msg_id = self._msg_counter
         msg["msg_id"] = msg_id
@@ -1311,6 +1540,20 @@ class LocalGcsHandle:
             "total": stats["total"],
             "dropped": stats["dropped"],
         }
+
+    async def drain_node(self, node_id, phase="full", timeout=60.0):
+        return await self._svc._rpc_drain_node(
+            None, node_id, phase=phase, timeout=timeout
+        )
+
+    async def chaos_arm(self, specs):
+        return await self._svc._rpc_chaos_arm(None, specs)
+
+    async def chaos_disarm(self):
+        return await self._svc._rpc_chaos_disarm(None)
+
+    async def chaos_list(self):
+        return await self._svc._rpc_chaos_list(None)
 
     async def stacks_dump(self, timeout=5.0):
         return await self._svc._rpc_stacks_dump(None, timeout=timeout)
@@ -1483,6 +1726,30 @@ class RemoteGcsHandle:
         r = await self._client.request(msg)
         return {"events": r["events"], "total": r["total"],
                 "dropped": r["dropped"]}
+
+    async def drain_node(self, node_id, phase="full", timeout=60.0):
+        r = await self._client.request(
+            {"op": "drain_node", "node_id": node_id, "phase": phase,
+             "timeout": timeout},
+            timeout=timeout + 30.0,
+        )
+        return {"ok": r["ok"], "error": r["error"],
+                "replicated": r.get("replicated", 0),
+                "leftover_actors": r.get("leftover_actors", 0)}
+
+    async def chaos_arm(self, specs):
+        return {"gen": (await self._client.request(
+            {"op": "chaos_arm", "specs": list(specs)}
+        ))["gen"]}
+
+    async def chaos_disarm(self):
+        return {"gen": (await self._client.request(
+            {"op": "chaos_disarm"}
+        ))["gen"]}
+
+    async def chaos_list(self):
+        r = await self._client.request({"op": "chaos_list"})
+        return {"specs": r["specs"], "gen": r["gen"]}
 
     async def stacks_dump(self, timeout=5.0):
         r = await self._client.request(

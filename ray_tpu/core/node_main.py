@@ -60,6 +60,11 @@ def main() -> int:
 
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
+    # Drain lifecycle: once the node manager finished its drain state
+    # machine (gcs.drain_node / `rtpu drain`), the process exits cleanly
+    # — the GCS sees the connection close and runs the death cleanup on
+    # a node that no longer owns anything.
+    nm.on_drain_complete = stop.set
     stop.wait()
     nm.shutdown()
     return 0

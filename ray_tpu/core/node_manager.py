@@ -66,6 +66,8 @@ from .scheduling_policy import pick_node
 from .scheduling_strategies import PlacementGroupSchedulingStrategy
 from .task_spec import TaskSpec, TaskType, intern_spec
 from ..util import events as cluster_events
+from ..util import faults
+from ..util.backoff import Backoff
 
 _HEADER = struct.Struct("<I")
 
@@ -319,6 +321,16 @@ class NodeManager:
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = threading.Event()
         self._shutdown = False
+        # Drain lifecycle (gcs.drain_node): once draining, this node is
+        # unschedulable cluster-wide, finishes in-flight work, replicates
+        # primary object copies off-node, then exits cleanly.
+        self._draining = False
+        # Host-process hook (node_main): called once the drain state
+        # machine finished and the ack is on the wire — the process
+        # should exit.
+        self.on_drain_complete = None
+        # Chaos plane: node-filtered specs need to know where they run.
+        faults.set_local_node(node_id.hex())
 
         # Scheduling state (loop-thread only).
         self._ready = _ReadyQueue(self._sched_class)
@@ -538,6 +550,9 @@ class NodeManager:
             self.gcs_service.on_node_dead = self._on_gcs_node_dead
             self.gcs_service.on_load_update = self._on_gcs_load_update
             self.gcs_service.on_pgs_invalidated = self._invalidate_pgs
+            self.gcs_service.on_node_draining = self._on_gcs_node_draining
+            self.gcs_service.on_node_undrain = self._on_gcs_node_undrain
+            self.gcs_service.on_chaos_update = self._on_gcs_chaos_update
             self._gcs = LocalGcsHandle(self.gcs_service)
             reply = await self.gcs_service.register_node(
                 self.node_id,
@@ -606,6 +621,10 @@ class NodeManager:
         self._gcs_client = client
         self._gcs = RemoteGcsHandle(client)
         self._apply_cluster_views(reply["nodes"])
+        # Late joiner / reconnect: adopt the head's current chaos plan
+        # (empty = disarm — correct after a head restart too).
+        chaos = reply.get("chaos") or {}
+        faults.apply_plan(chaos.get("specs") or [], chaos.get("gen"))
 
     async def _reconnect_gcs(self) -> bool:
         """Head-restart tolerance (ref analogue: NotifyGCSRestart,
@@ -614,17 +633,19 @@ class NodeManager:
         the address with backoff, re-registers, and re-publishes its local
         truth — named actors homed here and sealed object locations — so
         the restarted head rebuilds runtime state from the survivors."""
-        deadline = time.monotonic() + self.config.gcs_reconnect_timeout_s
-        delay = 0.5
+        wait = Backoff(
+            base=0.5, factor=1.5, max_delay=3.0, jitter=0.2,
+            deadline_s=self.config.gcs_reconnect_timeout_s,
+        )
         sys.stderr.write(
             "[ray_tpu] GCS connection lost; attempting reconnect\n"
         )
-        while time.monotonic() < deadline and not self._shutdown:
+        while not wait.expired and not self._shutdown:
             try:
                 await self._connect_gcs()
             except Exception:
-                await asyncio.sleep(delay)
-                delay = min(delay * 1.5, 3.0)
+                if not await wait.async_sleep():
+                    break
                 continue
             await self._republish_to_gcs()
             sys.stderr.write("[ray_tpu] reconnected to restarted GCS\n")
@@ -660,7 +681,11 @@ class NodeManager:
 
     def _apply_cluster_views(self, views):
         for v in views:
-            if v["state"] == "alive":
+            if v["state"] in ("alive", "draining"):
+                # Draining nodes stay REACHABLE (they push replicas at
+                # us and answer pulls until exit) — a late joiner must
+                # keep them in view or _get_peer fails mid-drain; the
+                # schedulers already skip any non-"alive" state.
                 self._cluster_view[v["node_id"]] = v
             else:
                 self._cluster_view.pop(v["node_id"], None)
@@ -678,7 +703,9 @@ class NodeManager:
                       if w.state != "dead")
             ),
             "is_head": self.is_head,
-            "state": "alive",
+            # Draining: still reachable, never schedulable (pick_node /
+            # place_bundles filter to state == "alive").
+            "state": "draining" if self._draining else "alive",
             "labels": self.labels,
         }
         if include_shapes:
@@ -749,6 +776,57 @@ class NodeManager:
             self._on_node_dead_hex(entry.node_id.hex(), dead_actors=None)
         )
 
+    def _on_gcs_node_draining(self, entry):
+        """Head-side hook for the GCS drain RPC (remote nodes learn via
+        the node_draining broadcast)."""
+        self._on_peer_draining(entry.node_id.hex())
+
+    def _on_peer_draining(self, node_hex: str):
+        """A node began draining: keep it REACHABLE (in-flight actor
+        traffic and the drain RPC itself still flow) but unschedulable —
+        pick_node/place_bundles skip non-alive views, so marking the
+        view is enough to stop new forwards/creations landing there."""
+        if node_hex == self.node_id.hex():
+            self._draining = True
+            return
+        view = self._cluster_view.get(node_hex)
+        if view is not None:
+            view["state"] = "draining"
+
+    def _on_gcs_node_undrain(self, entry):
+        """Head-side hook for a drain rollback (remote nodes learn via
+        the node_undrain broadcast)."""
+        self._on_peer_undrain(entry.node_id.hex())
+
+    def _on_peer_undrain(self, node_hex: str):
+        """A drain was aborted: the node rejoins the schedulable pool."""
+        if node_hex == self.node_id.hex():
+            self._draining = False
+            return
+        view = self._cluster_view.get(node_hex)
+        if view is not None and view.get("state") == "draining":
+            view["state"] = "alive"
+
+    def _on_gcs_chaos_update(self, specs, gen):
+        """Head-side hook: the GCS applied the plan in this process
+        already; forward it to this node's workers."""
+        asyncio.ensure_future(self._broadcast_chaos_to_workers(specs, gen))
+
+    def _apply_chaos(self, specs, gen):
+        faults.apply_plan(specs or [], gen)
+        asyncio.ensure_future(self._broadcast_chaos_to_workers(specs, gen))
+
+    async def _broadcast_chaos_to_workers(self, specs, gen):
+        frame = {"type": "chaos_update", "specs": list(specs or []),
+                 "gen": gen}
+        for w in list(self._workers.values()):
+            if w.state == "dead" or w.worker_type == "client":
+                continue
+            try:
+                await w.writer.send(dict(frame))
+            except Exception:
+                pass
+
     def _on_gcs_load_update(self, msg):
         self._apply_cluster_views(msg["nodes"])
 
@@ -764,33 +842,55 @@ class NodeManager:
             await self._on_node_dead_hex(
                 msg["node_id"], dead_actors=msg.get("dead_actors")
             )
+        elif mtype == "node_draining":
+            self._on_peer_draining(msg["node_id"])
+        elif mtype == "node_undrain":
+            self._on_peer_undrain(msg["node_id"])
+        elif mtype == "chaos_update":
+            self._apply_chaos(msg.get("specs") or [], msg.get("gen"))
 
     async def _heartbeat_loop(self):
         interval = self.config.heartbeat_interval_s
         while not self._shutdown:
             await asyncio.sleep(interval)
+            # Chaos plane: a suppressed heartbeat looks exactly like a
+            # lost load report — the GCS death sweep eventually declares
+            # this node dead. Only the SEND is faulted: the reconnect
+            # branch below stays live, so after the death broadcast the
+            # node re-registers and receives the current plan (a
+            # disarmed plan heals it; an armed one keeps it flapping,
+            # which is what a heartbeat-only partition really does).
+            suppressed = False
+            try:
+                delay = faults.fire(faults.HEARTBEAT)
+                if delay:
+                    await asyncio.sleep(delay)
+            except faults.InjectedFault:
+                suppressed = True
             view = self._local_view(include_shapes=True)
             self._cluster_view[view["node_id"]] = view
             if self.is_head and self.gcs_service is not None:
-                self.gcs_service.heartbeat(
-                    self.node_id,
-                    view["resources_available"],
-                    view["pending_tasks"],
-                    view.get("pending_shapes"),
-                )
-            elif self._gcs_client is not None and not self._gcs_client.closed:
-                try:
-                    await self._gcs_client.notify(
-                        {
-                            "op": "heartbeat",
-                            "available": view["resources_available"],
-                            "pending": view["pending_tasks"],
-                            "shapes": view.get("pending_shapes"),
-                            "msg_id": None,
-                        }
+                if not suppressed:
+                    self.gcs_service.heartbeat(
+                        self.node_id,
+                        view["resources_available"],
+                        view["pending_tasks"],
+                        view.get("pending_shapes"),
                     )
-                except Exception:
-                    pass
+            elif self._gcs_client is not None and not self._gcs_client.closed:
+                if not suppressed:
+                    try:
+                        await self._gcs_client.notify(
+                            {
+                                "op": "heartbeat",
+                                "available": view["resources_available"],
+                                "pending": view["pending_tasks"],
+                                "shapes": view.get("pending_shapes"),
+                                "msg_id": None,
+                            }
+                        )
+                    except Exception:
+                        pass
             elif self._gcs_client is not None and self._gcs_client.closed:
                 # Head gone: try to ride out a GCS restart before giving
                 # up (the node only dies once the reconnect window ends).
@@ -880,6 +980,20 @@ class NodeManager:
 
     async def _spawn_worker_async(self, worker_type: str = "cpu") -> WorkerID:
         worker_id = WorkerID.from_random()
+        try:
+            # Chaos plane: a suppressed spawn releases its starting slot
+            # so the next scheduler pass simply retries (the advertised
+            # degradation for worker_spawn).
+            delay = faults.fire(faults.WORKER_SPAWN,
+                                worker_type=worker_type)
+            if delay:
+                await asyncio.sleep(delay)
+        except faults.InjectedFault:
+            self._starting_workers[worker_type] = max(
+                0, self._starting_workers[worker_type] - 1
+            )
+            self._schedule()
+            return worker_id
         log_path = os.path.join(self.session_dir, "logs")
         os.makedirs(log_path, exist_ok=True)
         out = open(os.path.join(log_path, f"worker-{worker_id.hex()[:8]}.log"), "wb")
@@ -961,7 +1075,14 @@ class NodeManager:
             self._workers[worker_id] = handle
             self._starting_workers[wtype] = max(0, self._starting_workers[wtype] - 1)
             self._idle[wtype].append(worker_id)
-            await framed.send({"type": "registered", "node_id": self.node_id.hex()})
+            await framed.send({
+                "type": "registered", "node_id": self.node_id.hex(),
+                # Workers born under an armed chaos plan adopt it with
+                # their registration ack (updates arrive as
+                # chaos_update frames).
+                "chaos": {"specs": faults.current_plan(),
+                          "gen": faults.generation()},
+            })
             self._schedule()
             while True:
                 msg = await _read_frame(reader)
@@ -1311,7 +1432,8 @@ class NodeManager:
             while True:
                 msg = await aio_read_frame(reader)
                 if msg.get("type") in ("stacks_dump", "profile_run",
-                                       "get_actor_direct_peer"):
+                                       "get_actor_direct_peer",
+                                       "drain", "replicate_object"):
                     # Long-running introspection/resolution must not
                     # head-of-line block this channel's read loop (a 15s
                     # profile or a direct-endpoint drain wait would stall
@@ -1432,6 +1554,15 @@ class NodeManager:
             return {"direct": await self.get_actor_direct(
                 msg["actor_id"], timeout=msg.get("timeout", 30.0)
             )}
+        if mtype == "replicate_object":
+            # Drain rider: the draining node asks us to adopt a primary
+            # copy before it exits; we pull it over the normal transfer
+            # plane and publish the new location.
+            return await self._replicate_in(peer_hex, msg["object_id"])
+        if mtype == "drain":
+            return await self._handle_drain_request(
+                msg.get("timeout") or self.config.drain_timeout_s
+            )
         if mtype == "state_snapshot":
             return {"state": self._local_state_snapshot()}
         if mtype == "stacks_dump":
@@ -1671,6 +1802,11 @@ class NodeManager:
     def _forward_record(self, record: TaskRecord, target_hex: str):
         record.state = "forwarded"
         record.target = target_hex
+        # The grace window measures CONTINUOUS infeasibility: a task
+        # that found a target is feasible again, so a later requeue
+        # (forward failure, peer partition) restarts the clock instead
+        # of inheriting an already-expired one.
+        record.infeasible_since = None
         record.spillbacks += 1
         self._forwarded[record.spec.task_id] = record
         dep_locs = self._build_dep_locs(record.spec)
@@ -1852,6 +1988,185 @@ class NodeManager:
                     ),
                 )
         self._schedule()
+
+    # ------------------------------------------------------------------ drain
+
+    async def _handle_drain_request(self, timeout: float) -> Dict[str, Any]:
+        """Drain state machine (ref analogue: DrainRaylet +
+        local_object_manager spill-before-exit). By the time this runs,
+        phase "begin" already made the node unschedulable cluster-wide
+        (peers mark the view draining; serve replicas were migrated by
+        the controller). Here: (1) let in-flight local work finish,
+        bounded by ``timeout`` — whatever misses the window replays via
+        lineage after the death broadcast; (2) replicate primary object
+        copies to surviving nodes so consumers re-locate instead of
+        reconstructing; (3) ack, flush events, and fire
+        ``on_drain_complete`` so the host process exits cleanly."""
+        self._draining = True
+        cluster_events.emit(
+            cluster_events.INFO, cluster_events.RAYLET,
+            f"node {self.node_id.hex()[:8]} drain started "
+            f"(timeout {timeout:.0f}s)",
+            node_id=self.node_id.hex(),
+        )
+        loop = self._loop
+        deadline = loop.time() + max(1.0, float(timeout))
+        wait = Backoff(base=0.05, factor=1.3, max_delay=0.5, jitter=0.0)
+        while loop.time() < deadline:
+            busy = bool(self._ready) or any(
+                (w.current is not None or w.pending)
+                for w in self._workers.values()
+                if w.state != "dead" and w.worker_type != "client"
+                and w.actor_id is None
+            )
+            if not busy:
+                break
+            await asyncio.sleep(wait.next_delay())
+        replicated = await self._replicate_for_drain(deadline)
+        leftover = [
+            info for info in self._actors.values()
+            if info.state in ("alive", "pending", "restarting")
+        ]
+        if leftover:
+            cluster_events.emit(
+                cluster_events.WARNING, cluster_events.RAYLET,
+                f"node {self.node_id.hex()[:8]} draining with "
+                f"{len(leftover)} live actor(s) — they die with the "
+                f"node (callers see ActorDiedError)",
+                node_id=self.node_id.hex(),
+                custom_fields={"leftover_actors": len(leftover)},
+            )
+        cluster_events.emit(
+            cluster_events.INFO, cluster_events.RAYLET,
+            f"node {self.node_id.hex()[:8]} drained: replicated "
+            f"{replicated} object(s), {len(leftover)} actor(s) left",
+            node_id=self.node_id.hex(),
+            custom_fields={"replicated": replicated,
+                           "leftover_actors": len(leftover)},
+        )
+        # Ship the tail of the event ring while the transport is up —
+        # the process exits right after the ack.
+        try:
+            cluster_events.flush()
+        except Exception:
+            pass
+        if self.on_drain_complete is not None:
+            # After the ack frame is on the wire (the reply is sent by
+            # the peer handler right after this returns).
+            loop.call_later(0.5, self._fire_drain_complete)
+        return {"ok": True, "replicated": replicated,
+                "leftover_actors": len(leftover), "error": ""}
+
+    def _fire_drain_complete(self):
+        if not self._draining:
+            # The drain was aborted between our ack and this timer (ack
+            # reply lost → GCS reported failure → phase="abort" rolled
+            # us back to alive): exiting now would kill a node the
+            # operator was just told is back in service.
+            return
+        try:
+            if self.on_drain_complete is not None:
+                self.on_drain_complete()
+        except Exception:
+            pass
+
+    async def _replicate_for_drain(self, deadline: float) -> int:
+        """Push every primary (locally-stored, sealed) object copy to a
+        surviving node before exit (ref analogue: the reference's
+        drain-time object spilling; here the replica is re-homed into a
+        peer's store and published, so borrowers re-locate through the
+        GCS instead of pulling from a ghost)."""
+        me = self.node_id.hex()
+        # Only durable nodes may adopt primary copies: a 0-resource
+        # view is an ephemeral attach driver (the `rtpu drain` CLI
+        # itself registers one and shuts it down right after the
+        # drain) — re-homing an object's only copy there loses it.
+        targets = [
+            h for h, v in self._cluster_view.items()
+            if h != me and v.get("state", "alive") == "alive"
+            and any(amt > 0 for amt in
+                    (v.get("resources_total") or {}).values())
+        ]
+        if not targets:
+            return 0
+        # Fan out with a bounded window: sequential one-request-at-a-
+        # time replication caps throughput at one object per round trip
+        # and an object-heavy node blows the drain deadline with most
+        # of its store abandoned to lineage re-execution. The target
+        # side already spawns replicate_object off its dispatch loop,
+        # so a window of pulls overlaps cleanly.
+        sem = asyncio.Semaphore(8)
+        count = 0
+        cut_off = 0
+        failed = 0
+
+        async def _push(oid: ObjectID, first: int) -> None:
+            nonlocal count, cut_off, failed
+            async with sem:
+                # One retry on the next target: a single full/flaky
+                # peer must not silently strand every object that
+                # round-robin happened to assign to it.
+                for attempt in range(2):
+                    if self._loop.time() >= deadline:
+                        cut_off += 1
+                        return
+                    target = targets[(first + attempt) % len(targets)]
+                    try:
+                        peer = await self._get_peer(target)
+                        reply = await peer.request(
+                            {"type": "replicate_object",
+                             "object_id": oid},
+                            timeout=min(30.0, max(
+                                5.0, deadline - self._loop.time()
+                            )),
+                        )
+                        if reply.get("ok"):
+                            count += 1
+                            return
+                    except Exception:
+                        continue
+                failed += 1
+
+        pushes = []
+        i = 0
+        for oid in list(self._sealed):
+            loc = self.directory.lookup(oid)
+            if loc is None or isinstance(loc, RemoteLocation):
+                continue
+            pushes.append(_push(oid, i))
+            i += 1
+        if pushes:
+            await asyncio.gather(*pushes)
+        if cut_off or failed:
+            cluster_events.emit(
+                cluster_events.WARNING, cluster_events.RAYLET,
+                f"drain replication incomplete: {count} object(s) "
+                f"replicated, {failed} failed on every target, "
+                f"{cut_off} abandoned at the deadline; lineage covers "
+                f"the rest",
+                node_id=me,
+            )
+        return count
+
+    async def _replicate_in(self, source_hex: str,
+                            oid: ObjectID) -> Dict[str, Any]:
+        """Adopt a primary copy from a draining peer: pull it over the
+        normal transfer plane (data-plane stripes, chunk fallback) and
+        publish the new location."""
+        loc = self.directory.lookup(oid)
+        if loc is not None and not isinstance(loc, RemoteLocation):
+            return {"ok": True}
+        if loc is None:
+            self.directory.add(
+                oid, RemoteLocation(source_hex, 0), initial_refs=0
+            )
+            loc = self.directory.lookup(oid)
+        try:
+            new_loc = await self._ensure_local(oid, loc)
+            self._seal_object(oid, new_loc)
+            return {"ok": True}
+        except Exception as e:  # noqa: BLE001 — reported to the drainer
+            return {"ok": False, "error": str(e) or type(e).__name__}
 
     # ------------------------------------------------------------- scheduling
 
@@ -4469,6 +4784,13 @@ class NodeManager:
     def shutdown(self):
         if self._shutdown:
             return
+        # Ship the event ring's tail while this process's transport is
+        # still installed — after clear_publish_hook the buffered events
+        # (crash-adjacent ERROR/CHAOS context included) have no way out.
+        try:
+            cluster_events.flush()
+        except Exception:
+            pass
         cluster_events.clear_publish_hook(self._publish_event_batch)
         self._shutdown = True
         if getattr(self, "dashboard_agent", None) is not None:

@@ -99,6 +99,40 @@ class TransferError(Exception):
     """Data-plane failure; the caller maps it to object recovery."""
 
 
+class ProgressDeadline:
+    """Admission deadline that RESETS whenever the watched meter moves
+    toward admission. The old fixed deadline counted from request
+    arrival, so a big pull queued behind a slow-but-live drain (bytes
+    visibly being freed the whole time) was spuriously failed at
+    ``pull_admission_timeout_s`` even though it was seconds from
+    admission; the timeout now only fires after a full window with NO
+    progress (ref: pull_manager.h's retry timer resetting on activity).
+    """
+
+    def __init__(self, timeout_s: float, clock=time.monotonic):
+        self._timeout = timeout_s
+        self._clock = clock
+        self._deadline = clock() + timeout_s
+        self._best: Optional[float] = None
+
+    def note(self, meter: float) -> None:
+        """Feed the progress meter (here: free store bytes); any
+        improvement over the current baseline restarts the timeout
+        window. A DROP lowers the baseline without resetting: when a
+        sibling pull admits and consumes the freed bytes, later
+        freeing must count as fresh progress, not be hidden under the
+        all-time peak."""
+        if self._best is None or meter > self._best:
+            self._best = meter
+            self._deadline = self._clock() + self._timeout
+        elif meter < self._best:
+            self._best = meter
+
+    @property
+    def expired(self) -> bool:
+        return self._clock() > self._deadline
+
+
 class ObjectTransfer:
     """Both halves of the transfer protocol, owned by the node manager."""
 
@@ -262,11 +296,23 @@ class ObjectTransfer:
         if cap <= 0:
             self._inflight_bytes += size
             return
+        from ..util.backoff import Backoff
+
         loop = self._nm._loop
-        deadline = loop.time() + self._nm.config.pull_admission_timeout_s
+        timeout_s = self._nm.config.pull_admission_timeout_s
+        deadline = ProgressDeadline(timeout_s, clock=loop.time)
+        # Hard backstop: progress resets are bounded — store churn
+        # (siblings admitting and freeing in a cycle that never opens
+        # `size` bytes) must not keep this request parked forever.
+        hard_deadline = loop.time() + 10.0 * timeout_s
+        wait = Backoff(base=0.02, factor=1.5, max_delay=0.25, jitter=0.0)
         queued = False
         while True:
             free = cap - d.used_bytes - self._inflight_bytes
+            # Any growth in free bytes (a sibling pull finalized, a
+            # spill landed) is progress: the admission window restarts
+            # instead of counting from request arrival.
+            deadline.note(free)
             if size <= free:
                 self._inflight_bytes += size
                 return
@@ -276,14 +322,15 @@ class ObjectTransfer:
             # Ask the spill pass to free exactly what we lack — the
             # high-water trigger alone would no-op below the mark.
             self._nm._maybe_spill(need=size - max(free, 0))
-            if loop.time() > deadline:
+            if deadline.expired or loop.time() >= hard_deadline:
                 raise TransferError(
                     f"pull of {size} bytes not admitted within "
-                    f"{self._nm.config.pull_admission_timeout_s}s: store "
-                    f"full ({d.used_bytes}/{cap} used, "
+                    f"{timeout_s}s of the last progress (hard cap "
+                    f"{10.0 * timeout_s}s): store full "
+                    f"({d.used_bytes}/{cap} used, "
                     f"{self._inflight_bytes} in flight)"
                 )
-            await asyncio.sleep(0.05)
+            await asyncio.sleep(wait.next_delay())
 
     async def _pull_into_store(self, peer, reply: Dict[str, Any],
                                oid: ObjectID, size: int):

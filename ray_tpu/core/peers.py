@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Awaitable, Callable, Dict, Optional
 
+from ..util import faults
 from .protocol import AioFramedWriter as _FramedWriter
 from .protocol import aio_read_frame as _read_frame
 
@@ -68,6 +69,12 @@ class PeerClient:
     async def request(self, msg: Dict[str, Any], timeout: float = 60.0):
         if self.closed or self._writer is None:
             raise ConnectionError(f"peer {self.peer_hex[:8]} unreachable")
+        # Chaos plane: an injected error here is indistinguishable from
+        # a dropped peer frame (callers retry, spill back, or degrade).
+        delay = faults.fire(faults.PEER_SEND, peer=self.peer_hex[:8],
+                            op=msg.get("type"))
+        if delay:
+            await asyncio.sleep(delay)
         self._msg_counter += 1
         msg_id = self._msg_counter
         msg["msg_id"] = msg_id
@@ -95,6 +102,10 @@ class PeerClient:
     async def notify(self, msg: Dict[str, Any]):
         if self.closed or self._writer is None:
             raise ConnectionError(f"peer {self.peer_hex[:8]} unreachable")
+        delay = faults.fire(faults.PEER_SEND, peer=self.peer_hex[:8],
+                            op=msg.get("type"))
+        if delay:
+            await asyncio.sleep(delay)
         await self._writer.send(msg)
 
     def close(self):

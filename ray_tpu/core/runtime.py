@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..util import faults
+from ..util.backoff import Backoff
 from .config import get_config
 from .exceptions import GetTimeoutError, ObjectLostError, TaskError
 from .function_table import FunctionCache, export_function
@@ -646,6 +648,21 @@ class BaseRuntime:
             ).start()
         return refs
 
+    def _direct_retry_later(self, st: Dict[str, Any],
+                            min_delay: float = 0.0) -> None:
+        """Schedule the next direct-endpoint re-resolution with shared
+        jittered exponential backoff (util/backoff.py) instead of the
+        old fixed 10s/30s sleeps: repeated failures (actor restarting,
+        endpoint unreachable, injected chaos) space out instead of
+        hammering the NM resolve path in lockstep."""
+        bo = st.get("resolve_backoff")
+        if bo is None:
+            bo = st["resolve_backoff"] = Backoff(
+                base=1.0, factor=2.0, max_delay=30.0, jitter=0.25
+            )
+        st["retry_at"] = time.monotonic() + max(min_delay,
+                                                bo.next_delay())
+
     def _direct_state(self, actor_id: ActorID) -> Dict[str, Any]:
         key = actor_id.binary()
         with self._direct_states_lock:
@@ -683,7 +700,7 @@ class BaseRuntime:
                 # pinning the actor to the slow route forever.
                 with st["lock"]:
                     st["status"] = "unsupported"
-                    st["retry_at"] = time.monotonic() + 10.0
+                    self._direct_retry_later(st)
                 return
             with st["lock"]:
                 if st["nm_seq"] != seq0:
@@ -697,16 +714,18 @@ class BaseRuntime:
                 try:
                     chan = _DirectChannel(self, actor_id, desc)
                 except _DirectVersionMismatch:
+                    # A version skew won't heal quickly: floor the
+                    # backoff at its cap.
                     with st["lock"]:
                         st["status"] = "unsupported"
-                        st["retry_at"] = time.monotonic() + 30.0
+                        self._direct_retry_later(st, min_delay=30.0)
                     self._direct_fallbacks += 1
                     _FALLBACK_VERSION.inc()
                     return
                 except Exception:
                     with st["lock"]:
                         st["status"] = "unsupported"
-                        st["retry_at"] = time.monotonic() + 10.0
+                        self._direct_retry_later(st)
                     self._direct_fallbacks += 1
                     _FALLBACK_UNSUPPORTED.inc()
                     return
@@ -717,6 +736,9 @@ class BaseRuntime:
                     continue  # raced again; re-verify the drain
                 st["chan"] = chan
                 st["status"] = "ready"
+                bo = st.get("resolve_backoff")
+                if bo is not None:
+                    bo.reset()  # healthy again: next failure backs off
                 return
 
     def _direct_channel_failed(self, chan: "_DirectChannel"):
@@ -1138,6 +1160,20 @@ class _DirectChannel:
                 buf = self.out_buf
                 self.out_buf = []
             if buf:
+                # Chaos plane: sever the transport like a real network
+                # fault — the send below fails, the reader dies, and
+                # the failure path replays every unanswered call over
+                # the NM route exactly-once (worker-side task-id dedup).
+                try:
+                    delay = faults.fire(faults.DIRECT_CHANNEL_IO,
+                                        actor=self.actor_id.hex()[:8])
+                    if delay:
+                        time.sleep(delay)
+                except faults.InjectedFault:
+                    try:
+                        self.conn.close()
+                    except Exception:
+                        pass
                 msg = (
                     {"type": "execute", **buf[0]} if len(buf) == 1
                     else {"type": "execute_batch", "items": buf}

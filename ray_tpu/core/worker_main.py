@@ -109,6 +109,13 @@ class Worker:
         ack = self.conn.recv()
         assert ack["type"] == "registered", ack
         node_id = NodeID.from_hex(ack["node_id"])
+        # Chaos plane: adopt the cluster's armed plan at birth (updates
+        # arrive as chaos_update frames on the reader loop).
+        from ..util import faults
+
+        faults.set_local_node(node_id.hex())
+        chaos = ack.get("chaos") or {}
+        faults.apply_plan(chaos.get("specs") or [], chaos.get("gen"))
         self.runtime = WorkerRuntime(
             self.conn,
             job_id=JobID.nil(),
@@ -207,6 +214,11 @@ class Worker:
                         target=self._profile_and_reply, args=(msg,),
                         name="ray_tpu-profile", daemon=True,
                     ).start()
+                elif mtype == "chaos_update":
+                    from ..util import faults
+
+                    faults.apply_plan(msg.get("specs") or [],
+                                      msg.get("gen"))
                 elif mtype == "kill":
                     self._alive = False
                     self._tq_put(None)
@@ -1026,7 +1038,18 @@ def main():
             )
     conn = connect_unix(socket_path)
     worker = Worker(conn, worker_id)
-    worker.start()
+    try:
+        worker.start()
+    finally:
+        # Ship the event ring's tail (task failures, CHAOS firings)
+        # while the runtime transport still exists — worker exits often
+        # end in os._exit, which skips atexit.
+        try:
+            from ..util import events as _events
+
+            _events.flush()
+        except Exception:
+            pass
 
 
 if __name__ == "__main__":

@@ -746,6 +746,93 @@ def _print_transfer_section() -> None:
         print("  in-flight     : none")
 
 
+# ------------------------------------------------------- chaos & drain
+
+def cmd_drain(args) -> int:
+    """Drain a node and retire it with zero downtime (serve replicas
+    migrate, in-flight work finishes, primary object copies replicate
+    off-node, then the node exits — ref: the DrainNode RPC behind
+    kuberay's drain-before-delete)."""
+    ray_tpu = _attached(args)
+    try:
+        reply = ray_tpu.drain_node(args.node, timeout=args.timeout)
+        print(f"node {args.node} drained: "
+              f"replicated {reply.get('replicated', 0)} object(s), "
+              f"{reply.get('leftover_actors', 0)} actor(s) died with "
+              f"the node")
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def _gcs_handle():
+    from ray_tpu.core.runtime_context import current_runtime
+
+    nm = getattr(current_runtime(), "_nm", None)
+    if nm is None:
+        raise SystemExit(
+            "rtpu chaos needs a cluster-attached head/driver address "
+            "(thin rtpu:// clients cannot drive the chaos plane)"
+        )
+    return nm, nm._gcs
+
+
+def cmd_chaos(args) -> int:
+    """Deterministic cluster-wide fault injection (util/faults.py):
+    ``arm`` appends one spec to the armed plan and pushes it to every
+    node + worker; ``disarm`` clears the plan; ``list`` shows it."""
+    ray_tpu = _attached(args)
+    try:
+        nm, gcs = _gcs_handle()
+        if args.chaos_cmd == "list":
+            reply = nm.call_sync(gcs.chaos_list(), timeout=30)
+            if args.json:
+                print(json.dumps(reply, indent=2))
+            else:
+                print(f"chaos plan gen {reply['gen']}: "
+                      f"{len(reply['specs'])} spec(s)")
+                for s in reply["specs"]:
+                    extra = []
+                    if s.get("mode") == "every":
+                        extra.append(f"n={s['n']}")
+                    if s.get("mode") == "once" and s.get("n", 1) != 1:
+                        extra.append(f"after={s['n']}")
+                    if s.get("mode") == "prob":
+                        extra.append(f"p={s['p']} seed={s.get('seed')}")
+                    if s.get("action") == "latency":
+                        extra.append(f"delay={s['delay_s']}s")
+                    if s.get("max_fires"):
+                        extra.append(f"max_fires={s['max_fires']}")
+                    if s.get("node"):
+                        extra.append(f"node={s['node'][:8]}")
+                    print(f"  {s['point']:18s} {s['mode']:6s} "
+                          f"{s['action']:9s} {' '.join(extra)}")
+            return 0
+        if args.chaos_cmd == "disarm":
+            reply = nm.call_sync(gcs.chaos_disarm(), timeout=30)
+            print(f"chaos disarmed (gen {reply['gen']})")
+            return 0
+        # arm: append one spec to the current plan.
+        spec = {
+            "point": args.point,
+            "mode": args.mode,
+            "action": args.action,
+            "n": args.n,
+            "p": args.p,
+            "seed": args.seed,
+            "delay_s": args.delay,
+            "max_fires": args.max_fires,
+            "node": args.node or "",
+        }
+        current = nm.call_sync(gcs.chaos_list(), timeout=30)["specs"]
+        specs = ([] if args.replace else list(current)) + [spec]
+        reply = nm.call_sync(gcs.chaos_arm(specs), timeout=30)
+        print(f"chaos armed: {len(specs)} spec(s) (gen {reply['gen']})")
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
 # --------------------------------------------------------------- serve
 
 def cmd_serve_deploy(args) -> int:
@@ -884,7 +971,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--source", default=None,
                    help="filter by event source (GCS, RAYLET, WORKER, "
                         "TASK, ACTOR, OBJECT_STORE, AUTOSCALER, SERVE, "
-                        "JOB)")
+                        "JOB, CHAOS)")
     p.add_argument("--limit", type=int, default=100)
     p.add_argument("--follow", "-f", action="store_true",
                    help="stream new events as they are published")
@@ -924,6 +1011,52 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="write to FILE instead of stdout")
     _add_address(p)
     p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("drain",
+                       help="drain a node and retire it (zero downtime)")
+    p.add_argument("node", help="node id (full hex or unique prefix)")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="drain budget in seconds "
+                        "(default: drain_timeout_s)")
+    _add_address(p)
+    p.set_defaults(fn=cmd_drain)
+
+    p = sub.add_parser("chaos",
+                       help="deterministic cluster-wide fault injection")
+    csub = p.add_subparsers(dest="chaos_cmd", required=True)
+    cp = csub.add_parser("arm", help="arm one injection spec "
+                                     "(appends to the current plan)")
+    cp.add_argument("--point", required=True,
+                    help="injection point (peer_send, data_channel_io, "
+                         "direct_channel_io, gcs_rpc, worker_spawn, "
+                         "heartbeat)")
+    cp.add_argument("--mode", default="always",
+                    choices=["always", "once", "every", "prob"])
+    cp.add_argument("--action", default="error",
+                    choices=["error", "partition", "latency"])
+    cp.add_argument("--n", type=int, default=1,
+                    help="every: period; once: fire on the Nth hit")
+    cp.add_argument("--p", type=float, default=1.0,
+                    help="prob: firing probability")
+    cp.add_argument("--seed", type=int, default=None,
+                    help="prob: RNG seed (deterministic replay)")
+    cp.add_argument("--delay", type=float, default=0.0,
+                    help="latency: injected delay in seconds")
+    cp.add_argument("--max-fires", type=int, default=0,
+                    help="stop firing after this many (0 = unbounded)")
+    cp.add_argument("--node", default=None,
+                    help="only fire on this node (hex id prefix)")
+    cp.add_argument("--replace", action="store_true",
+                    help="replace the whole plan instead of appending")
+    _add_address(cp)
+    cp.set_defaults(fn=cmd_chaos)
+    cp = csub.add_parser("disarm", help="clear the armed plan")
+    _add_address(cp)
+    cp.set_defaults(fn=cmd_chaos)
+    cp = csub.add_parser("list", help="show the armed plan")
+    cp.add_argument("--json", action="store_true")
+    _add_address(cp)
+    cp.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser("serve", help="serve: deploy/status/shutdown")
     ssub = p.add_subparsers(dest="serve_cmd", required=True)

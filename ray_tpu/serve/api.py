@@ -62,9 +62,28 @@ def _get_controller():
     try:
         _controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
-        _controller = ray_tpu.remote(ServeControllerActor).options(
+        opts: Dict[str, Any] = dict(
             name=CONTROLLER_NAME,
             max_concurrency=CONTROLLER_MAX_CONCURRENCY,
+        )
+        # Pin the controller to the creating driver's node (normally
+        # the head): the control plane must survive worker-node drains
+        # and rolling restarts. soft=True keeps 0-CPU attach drivers
+        # (`rtpu serve deploy`) working — placement falls back to the
+        # default policy when this node is infeasible.
+        try:
+            from ray_tpu.core.runtime_context import current_runtime
+            from ray_tpu.core.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy,
+            )
+
+            opts["scheduling_strategy"] = NodeAffinitySchedulingStrategy(
+                current_runtime().node_id.hex(), soft=True
+            )
+        except Exception:
+            pass
+        _controller = ray_tpu.remote(ServeControllerActor).options(
+            **opts
         ).remote()
         # Wait until the controller is live before first use.
         ray_tpu.get(_controller.list_deployments.remote())

@@ -261,6 +261,64 @@ class ServeControllerActor:
         for h in victims:
             self._drain_and_kill(h)
 
+    def drain_replicas(self, actor_ids: List[str]) -> Dict[str, int]:
+        """Node-drain migration (ref analogue: deployment_state.py's
+        drain-based replica migration behind the GCS DrainNode RPC):
+        surge-replace every replica whose actor id is in ``actor_ids``
+        (a draining node's), bump the route set so handles stop picking
+        the victims, then gracefully drain and kill them. The route set
+        never drops below target, so in-flight traffic always has
+        somewhere to go — the same zero-downtime discipline as a
+        rolling update."""
+        wanted = set(actor_ids)
+        moved: Dict[str, int] = {}
+        for name in list(self._deployments):
+            with self._lock:
+                st = self._deployments.get(name)
+                if st is None:
+                    continue
+                victims = [r for r in st.replicas
+                           if r._actor_id.hex() in wanted]
+                version = st.version
+            if not victims:
+                continue
+            # Surge first: replacements come up (placed off the draining
+            # node — it is unschedulable by now) before any victim
+            # leaves the route set.
+            new = self._start_replicas(st, len(victims), version)
+            victim_ids = {id(r) for r in victims}
+            with self._lock:
+                if self._deployments.get(name) is not st \
+                        or st.version != version:
+                    orphans = new  # superseded mid-drain
+                else:
+                    keep = [
+                        (r, v) for r, v in zip(st.replicas,
+                                               st.replica_versions)
+                        if id(r) not in victim_ids
+                    ]
+                    st.replicas = [r for r, _ in keep] + new
+                    st.replica_versions = (
+                        [v for _, v in keep] + [version] * len(new)
+                    )
+                    self._bump_route(st)
+                    orphans = []
+            if orphans:
+                for h in orphans:
+                    self._kill_replica(h)
+                continue
+            cluster_events.emit(
+                cluster_events.INFO, cluster_events.SERVE,
+                f"deployment '{name}' drain: migrating "
+                f"{len(victims)} replica(s) off a draining node",
+                custom_fields={"deployment": name,
+                               "migrated": len(victims)},
+            )
+            for h in victims:
+                self._drain_and_kill(h)
+            moved[name] = len(victims)
+        return moved
+
     def scale(self, name: str, num_replicas: int) -> List[Any]:
         with self._lock:
             st = self._deployments[name]

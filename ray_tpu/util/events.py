@@ -50,8 +50,10 @@ OBJECT_STORE = "OBJECT_STORE"
 AUTOSCALER = "AUTOSCALER"
 SERVE = "SERVE"
 JOB = "JOB"
+# Fault-injection firings (util/faults.py — the chaos plane).
+CHAOS = "CHAOS"
 SOURCES = (GCS, RAYLET, WORKER, TASK, ACTOR, OBJECT_STORE, AUTOSCALER,
-           SERVE, JOB)
+           SERVE, JOB, CHAOS)
 
 FLUSH_INTERVAL_S = 0.25
 
@@ -165,7 +167,6 @@ class _Emitter:
                     daemon=True,
                 )
                 self._flusher.start()
-                atexit.register(self.flush)
 
     def _flush_loop(self):
         while True:
@@ -219,6 +220,16 @@ class _Emitter:
 
 
 _emitter = _Emitter()
+
+# Final flush at interpreter exit (mirrors timeline.py's atexit flush):
+# the flusher thread is a daemon, so without this the ring's last
+# ``FLUSH_INTERVAL_S`` of events — exactly the crash-adjacent
+# CHAOS/ERROR tail a postmortem needs — died with the process.
+# Registered at import (not first emit) so it runs LAST in atexit's
+# LIFO order, i.e. after user atexit hooks that may still emit; the
+# shutdown paths (node manager, worker main) additionally flush
+# explicitly while their transport is still up.
+atexit.register(lambda: _emitter.flush())
 
 
 def emit(severity: str, source: str, message: str, *,

@@ -1,0 +1,303 @@
+"""Deterministic cluster-wide fault injection — the chaos plane.
+
+Ref analogue: the reference treats failure handling as a subsystem, not
+a test trick (SURVEY §5: heartbeat/death broadcast in the GCS, bounded
+pull retry in ``pull_manager.h``, chaos tests driven by
+``_private/test_utils.py`` resource killers). This module gives every
+degradation path in ray_tpu a first-class, *deterministic* trigger:
+
+- **Injection points** are declared once in :data:`FAULT_POINTS`; each
+  subsystem calls :func:`fire` at exactly the place where a real
+  network/process fault would surface (``tools/check_metric_names.py``
+  lints that every registered point has a firing site and every firing
+  site names a registered point).
+- **Disarmed is free**: with no plan armed, :func:`fire` is one tuple
+  truth-test — safe on the direct-call and data-plane hot paths.
+- **Armed cluster-wide**: a plan (list of specs, see
+  :func:`validate_spec`) is armed through the GCS ``ChaosService`` and
+  pushed to every node manager and worker (``chaos_update`` frames);
+  late joiners receive it in their registration reply. ``rtpu chaos
+  arm/disarm/list`` is the operator surface.
+- **Deterministic schedules**: ``once`` (the Nth eligible hit),
+  ``every`` (every Nth hit), ``prob`` (seeded RNG), ``always`` —
+  per-process counters, so a seeded run replays identically.
+- **Observable**: every firing publishes a WARNING CHAOS cluster event
+  (PR-2 event plane), so ``rtpu events --source CHAOS`` shows exactly
+  what was injected where.
+
+Actions: ``error``/``partition`` raise :class:`InjectedFault` (a
+``ConnectionError``, so existing failure paths treat it as a real
+transport fault); ``latency`` returns a delay the call site sleeps.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+# ------------------------------------------------------ injection points
+
+PEER_SEND = "peer_send"
+DATA_CHANNEL_IO = "data_channel_io"
+DIRECT_CHANNEL_IO = "direct_channel_io"
+GCS_RPC = "gcs_rpc"
+WORKER_SPAWN = "worker_spawn"
+HEARTBEAT = "heartbeat"
+
+# name -> (description, advertised degradation path). The lint enforces
+# exactly-once registration here and at least one fire() site per name.
+FAULT_POINTS: Dict[str, str] = {
+    PEER_SEND: "node<->node peer control-channel request/notify "
+               "(degradation: spillback retry, peer fast-fail, partial "
+               "profile fan-out)",
+    DATA_CHANNEL_IO: "striped data-plane range pull "
+                     "(degradation: fall back to control-plane chunks)",
+    DIRECT_CHANNEL_IO: "direct actor-call channel send "
+                       "(degradation: exactly-once replay over the NM "
+                       "route, channel re-engages)",
+    GCS_RPC: "node-manager -> GCS request "
+             "(degradation: caller-side retry/backoff, reconnect window)",
+    WORKER_SPAWN: "worker process spawn "
+                  "(degradation: scheduler retries the spawn on the "
+                  "next pass)",
+    HEARTBEAT: "node load-report heartbeat "
+               "(degradation: GCS declares the node dead; lineage "
+               "re-executes lost objects, node re-registers when the "
+               "partition heals)",
+}
+
+MODES = ("always", "once", "every", "prob")
+ACTIONS = ("error", "partition", "latency")
+
+
+class InjectedFault(ConnectionError):
+    """Raised at an armed injection point. A ``ConnectionError`` so the
+    surrounding failure handling treats it exactly like a real
+    transport fault (that is the point: the *recovery* code runs)."""
+
+
+class _ArmedSpec:
+    """Per-process state of one armed spec (hit/fire counters + RNG)."""
+
+    __slots__ = ("point", "mode", "action", "n", "p", "seed", "delay_s",
+                 "max_fires", "node", "hits", "fires", "rng", "spec_dict")
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.spec_dict = dict(spec)
+        self.point = spec["point"]
+        self.mode = spec["mode"]
+        self.action = spec["action"]
+        self.n = int(spec.get("n", 1))
+        self.p = float(spec.get("p", 1.0))
+        self.seed = spec.get("seed")
+        self.delay_s = float(spec.get("delay_s", 0.0))
+        self.max_fires = int(spec.get("max_fires", 0))
+        self.node = spec.get("node") or ""
+        self.hits = 0
+        self.fires = 0
+        self.rng = random.Random(self.seed)
+
+
+def validate_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize one chaos spec; raises ``ValueError`` on anything the
+    registry does not declare (the GCS validates at arm time so a typo
+    fails the ``rtpu chaos arm`` call, not silently no-ops forever).
+
+    Fields: ``point`` (required, a registered injection point),
+    ``mode`` (default ``always``), ``action`` (default ``error``),
+    ``n`` (every-Nth), ``p`` + ``seed`` (probabilistic), ``delay_s``
+    (latency action), ``max_fires`` (0 = unbounded), ``node`` (hex
+    prefix — only processes on that node fire)."""
+    if not isinstance(spec, dict):
+        raise ValueError(f"chaos spec must be a dict, got {type(spec)}")
+    point = spec.get("point")
+    if point not in FAULT_POINTS:
+        raise ValueError(
+            f"unknown injection point {point!r} "
+            f"(one of {sorted(FAULT_POINTS)})"
+        )
+    mode = spec.get("mode", "always")
+    if mode not in MODES:
+        raise ValueError(f"unknown chaos mode {mode!r} (one of {MODES})")
+    action = spec.get("action", "error")
+    if action not in ACTIONS:
+        raise ValueError(
+            f"unknown chaos action {action!r} (one of {ACTIONS})"
+        )
+    out = {
+        "point": point,
+        "mode": mode,
+        "action": action,
+        "n": max(1, int(spec.get("n", 1))),
+        "p": min(1.0, max(0.0, float(spec.get("p", 1.0)))),
+        "seed": spec.get("seed"),
+        "delay_s": max(0.0, float(spec.get("delay_s", 0.0))),
+        "max_fires": max(0, int(spec.get("max_fires", 0))),
+        "node": str(spec.get("node") or ""),
+        # Stable identity stamped by the GCS at arm time (None for
+        # direct local plans): entries retained across a plan append
+        # keep their counters in apply_plan.
+        "id": spec.get("id"),
+    }
+    if action == "latency" and out["delay_s"] <= 0:
+        raise ValueError("latency action needs delay_s > 0")
+    return out
+
+
+# ------------------------------------------------------- armed plan state
+
+_lock = threading.Lock()
+# () when disarmed — fire()'s whole hot-path cost is this truth test.
+_armed: Tuple[_ArmedSpec, ...] = ()
+_plan: List[Dict[str, Any]] = []
+_gen = 0
+_local_node = ""
+
+
+def set_local_node(node_hex: str) -> None:
+    """Record which node this process belongs to (``node``-filtered
+    specs only fire on matching nodes)."""
+    global _local_node
+    _local_node = node_hex or ""
+
+
+def apply_plan(specs: List[Dict[str, Any]],
+               gen: Optional[int] = None) -> None:
+    """Install ``specs`` as THIS process's armed plan (replacing any
+    previous one). Specs WITHOUT an ``id`` (direct local plans) always
+    start from zero — determinism: re-applying an identical seeded
+    plan replays identically. Specs WITH an ``id`` (stamped by the GCS
+    at arm time) that match a currently-armed entry keep that entry's
+    counters/RNG, so appending a new spec to the cluster plan never
+    resurrects an already-exhausted ``once``/``max_fires`` spec.
+    Invalid specs are dropped rather than poisoning the rest (the GCS
+    already validated at arm time; this guards skewed senders)."""
+    global _armed, _plan, _gen
+    normalized = []
+    for spec in specs or []:
+        try:
+            normalized.append(validate_spec(spec))
+        except ValueError:
+            continue
+    with _lock:
+        retained: Dict[Any, _ArmedSpec] = {
+            a.spec_dict["id"]: a for a in _armed
+            if a.spec_dict.get("id") is not None
+        }
+        new_armed = []
+        for s in normalized:
+            old = retained.get(s["id"]) if s.get("id") is not None else None
+            if old is not None and old.spec_dict == s:
+                new_armed.append(old)
+            else:
+                new_armed.append(_ArmedSpec(s))
+        _plan = normalized
+        _armed = tuple(new_armed)
+        if gen is not None:
+            _gen = int(gen)
+        else:
+            _gen += 1
+
+
+def clear() -> None:
+    """Disarm every injection point in this process."""
+    apply_plan([])
+
+
+def current_plan() -> List[Dict[str, Any]]:
+    with _lock:
+        return [dict(s) for s in _plan]
+
+
+def generation() -> int:
+    return _gen
+
+
+def armed() -> bool:
+    return bool(_armed)
+
+
+def fired_counts() -> Dict[str, int]:
+    """Per-point firing counts in THIS process (tests/diagnostics)."""
+    with _lock:
+        out: Dict[str, int] = {}
+        for a in _armed:
+            out[a.point] = out.get(a.point, 0) + a.fires
+        return out
+
+
+# ----------------------------------------------------------------- firing
+
+
+def fire(point: str, **ctx: Any) -> float:
+    """The injection point hook. Returns a latency delay in seconds
+    (0.0 almost always; the call site sleeps it in its own idiom —
+    ``time.sleep`` on threads, ``asyncio.sleep`` on loops) or raises
+    :class:`InjectedFault` for error/partition actions. Disarmed cost:
+    one truth test."""
+    if not _armed:
+        return 0.0
+    return _fire_armed(point, ctx)
+
+
+def _fire_armed(point: str, ctx: Dict[str, Any]) -> float:
+    to_fire: List[_ArmedSpec] = []
+    with _lock:
+        for a in _armed:
+            if a.point != point:
+                continue
+            if a.node and not _local_node.startswith(a.node):
+                continue
+            a.hits += 1
+            if a.max_fires and a.fires >= a.max_fires:
+                continue
+            if a.mode == "always":
+                hit = True
+            elif a.mode == "once":
+                hit = a.fires == 0 and a.hits >= a.n
+            elif a.mode == "every":
+                hit = a.hits % a.n == 0
+            else:  # prob
+                hit = a.rng.random() < a.p
+            if hit:
+                a.fires += 1
+                to_fire.append(a)
+    if not to_fire:
+        return 0.0
+    delay = 0.0
+    fault: Optional[_ArmedSpec] = None
+    for a in to_fire:
+        _emit_chaos_event(a, ctx)
+        if a.action == "latency":
+            delay = max(delay, a.delay_s)
+        else:
+            fault = a
+    if fault is not None:
+        raise InjectedFault(
+            f"injected {fault.action} at {point} "
+            f"(mode={fault.mode}, fire #{fault.fires})"
+        )
+    return delay
+
+
+def _emit_chaos_event(a: _ArmedSpec, ctx: Dict[str, Any]) -> None:
+    """Every firing is a first-class cluster event: `rtpu events
+    --source CHAOS` reconstructs exactly what was injected where."""
+    from . import events
+
+    try:
+        fields: Dict[str, Any] = {
+            "point": a.point, "action": a.action, "mode": a.mode,
+            "fire_number": a.fires, "hits": a.hits,
+        }
+        for k, v in ctx.items():
+            fields.setdefault(k, v)
+        events.emit(
+            events.WARNING, events.CHAOS,
+            f"CHAOS fired: {a.action} at {a.point} "
+            f"(mode={a.mode}, fire #{a.fires})",
+            custom_fields=fields,
+        )
+    except Exception:
+        pass  # injection must never fail because observability did

@@ -18,6 +18,11 @@ import sys
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long multi-node chaos/drain tests (tier-1 runs -m 'not "
+        "slow'; `make chaos` runs them)",
+    )
     if os.environ.get("PALLAS_AXON_POOL_IPS") and not os.environ.get(
         "RAY_TPU_TEST_REEXEC"
     ):

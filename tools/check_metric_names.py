@@ -301,6 +301,182 @@ def validate_actor_config():
     ]
 
 
+# ---- chaos plane lint ----------------------------------------------------
+# util/faults.py is the single registry of injection points. The lint
+# enforces: (a) every point CONSTANT maps 1:1 onto a FAULT_POINTS key
+# (each name registered exactly once — a duplicate or orphan constant
+# would silently split the plan from the firing sites); (b) every
+# registered point has at least one faults.fire() site in the package
+# (a point with no firing site is dead chaos surface); (c) every
+# fire() site names a registered point (a typo'd point would no-op
+# forever); (d) every firing is observable: the central emitter in
+# util/faults.py publishes under the CHAOS source, which must be a
+# declared event source enum; (e) the drain config knob the README
+# documents exists on Config.
+
+DRAIN_CONFIG_KEYS = ("drain_timeout_s",)
+
+
+def _parse_fault_registry(faults_path):
+    """Return (constants {NAME: value}, registered point names,
+    failures) from util/faults.py's module-level declarations."""
+    failures = []
+    with open(faults_path) as f:
+        tree = ast.parse(f.read(), filename=faults_path)
+    constants = {}
+    registered = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name.isupper() and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str) \
+                    and name not in ("MODES", "ACTIONS"):
+                constants[name] = node.value.value
+        if isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.target.id == "FAULT_POINTS" and \
+                isinstance(node.value, ast.Dict):
+            for key in node.value.keys:
+                if isinstance(key, ast.Name):
+                    registered.append(key.id)
+                elif isinstance(key, ast.Constant):
+                    registered.append(key.value)
+    if not registered:
+        failures.append(
+            "util/faults.py: FAULT_POINTS registry not found (chaos "
+            "plane deleted without updating the lint?)"
+        )
+    return constants, registered, failures
+
+
+def validate_fault_points(pkg_dir):
+    """Chaos-plane lint: registry 1:1, every point fired somewhere,
+    every fire() site names a registered point, firings observable."""
+    faults_path = os.path.join(pkg_dir, "util", "faults.py")
+    if not os.path.isfile(faults_path):
+        return [f"{faults_path}: missing (chaos plane deleted without "
+                f"updating the lint?)"], 0
+    constants, registered, failures = _parse_fault_registry(faults_path)
+
+    # (a) exactly-once registration: constants <-> FAULT_POINTS keys.
+    point_values = {}
+    for cname in registered:
+        value = constants.get(cname, cname)
+        if value in point_values:
+            failures.append(
+                f"util/faults.py: injection point {value!r} registered "
+                f"more than once in FAULT_POINTS"
+            )
+        point_values[value] = cname
+    for cname, value in constants.items():
+        if cname not in registered:
+            failures.append(
+                f"util/faults.py: point constant {cname} = {value!r} "
+                f"is not registered in FAULT_POINTS"
+            )
+
+    # (b)+(c) every fire() site names a registered point; every point
+    # has at least one site outside util/faults.py.
+    fired = {}
+    checked = 0
+    for root, _dirs, files in os.walk(pkg_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            if os.path.abspath(path) == os.path.abspath(faults_path):
+                continue
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    failures.append(f"{path}: unparseable ({e})")
+                    continue
+            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if not (isinstance(fn, ast.Attribute) and fn.attr == "fire"
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id == "faults"):
+                    continue
+                checked += 1
+                where = f"{rel}:{node.lineno}"
+                if not node.args:
+                    failures.append(f"{where}: faults.fire() with no "
+                                    f"injection point argument")
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Attribute) and \
+                        isinstance(arg.value, ast.Name) and \
+                        arg.value.id == "faults":
+                    if arg.attr not in constants:
+                        failures.append(
+                            f"{where}: faults.fire(faults.{arg.attr}) "
+                            f"names an undeclared point constant"
+                        )
+                    else:
+                        fired.setdefault(constants[arg.attr], []).append(where)
+                elif isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    if arg.value not in point_values:
+                        failures.append(
+                            f"{where}: faults.fire({arg.value!r}) names "
+                            f"an unregistered injection point"
+                        )
+                    else:
+                        fired.setdefault(arg.value, []).append(where)
+                else:
+                    failures.append(
+                        f"{where}: faults.fire() point must be a "
+                        f"faults.CONSTANT or string literal (dynamic "
+                        f"points defeat the registry lint)"
+                    )
+    for value in point_values:
+        if value not in fired:
+            failures.append(
+                f"util/faults.py: injection point {value!r} has no "
+                f"faults.fire() site anywhere in the package (dead "
+                f"chaos surface)"
+            )
+
+    # (d) every firing is observable: the central emitter publishes
+    # under the CHAOS source, and CHAOS is a declared source enum.
+    from ray_tpu.util.events import SOURCES
+
+    if "CHAOS" not in SOURCES:
+        failures.append(
+            "util/events.py: CHAOS missing from SOURCES — chaos "
+            "firings would raise at emit time instead of publishing"
+        )
+    with open(faults_path) as f:
+        src = f.read()
+    if "events.CHAOS" not in src:
+        failures.append(
+            "util/faults.py: the firing path no longer emits under "
+            "events.CHAOS — every injection must stay observable via "
+            "`rtpu events --source CHAOS`"
+        )
+    return failures, checked
+
+
+def validate_drain_config():
+    import dataclasses
+
+    from ray_tpu.core.config import Config
+
+    fields = {f.name for f in dataclasses.fields(Config)}
+    return [
+        f"core/config.py: drain config key {key!r} missing from Config "
+        f"(documented knob drifted from the flag table)"
+        for key in DRAIN_CONFIG_KEYS if key not in fields
+    ]
+
+
 # ---- serve handle hot-path lint ------------------------------------------
 # The serve request hot path must stay free of blocking node-manager
 # round-trips: with the direct actor-call plane, a steady-state request
@@ -484,6 +660,15 @@ def main() -> int:
     failures += handler_failures
     print(f"checked {n_handlers} dashboard handler(s) for blocking "
           f"samplers")
+
+    fault_failures, n_fire = validate_fault_points(
+        os.path.join(repo_root, "ray_tpu")
+    )
+    failures += fault_failures
+    failures += validate_drain_config()
+    print(f"checked {n_fire} faults.fire() site(s) against the "
+          f"injection-point registry, {len(DRAIN_CONFIG_KEYS)} drain "
+          f"config key(s)")
 
     if failures:
         for f in failures:
