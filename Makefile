@@ -11,7 +11,7 @@ LDLIBS   := -lpthread -lrt
 STORE_SRC := src/store/rts_store.cc
 EXT       := ray_tpu/_native/_rtstore.so
 
-.PHONY: native native-test cpp-client clean check-obs check-metrics perf-transfer perf-actor chaos
+.PHONY: native native-test cpp-client clean check-obs check-metrics perf-transfer perf-actor chaos overload
 
 # Observability lint: every Counter/Gauge/Histogram the package declares
 # at import time (Prometheus-valid names, counters end in _total, no
@@ -31,6 +31,15 @@ check-metrics: check-obs
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q \
 	  -p no:cacheprovider
+
+# Overload-control acceptance: the request-robustness test matrix
+# (deadline refusal/cancellation, adaptive shedding, breaker
+# open/half-open/close under chaos-armed latency on one replica) plus
+# the closed-loop overload bench recorded to OVERLOAD_r01.json.
+overload:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_overload.py -q \
+	  -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) tools/run_overload_bench.py OVERLOAD_r01.json
 
 # Cross-node transfer bench: 2-node loopback, 256 MiB object through the
 # striped data plane, JSON GB/s + concurrent control-plane ping p99.

@@ -14,6 +14,7 @@ from __future__ import annotations
 import functools
 from typing import Any, Dict, Optional
 
+from ..util.overload import ambient_deadline as _ambient_deadline
 from .config import get_config
 from .ids import ActorID, TaskID
 from .remote_function import _build_resources
@@ -61,6 +62,7 @@ class ActorMethod:
                 or self._handle._method_groups.get(self._method_name, "")
             ),
             nested_refs=nested,
+            deadline_ts=_ambient_deadline(),
         )
         refs = rt.submit(spec)
         del keepalive

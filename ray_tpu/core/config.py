@@ -205,6 +205,39 @@ class Config:
     # object copies must replicate off-node inside this window; past it
     # the node exits anyway and lineage re-execution covers the rest.
     drain_timeout_s: float = 60.0
+    # --- serve overload control (ref analogue: serve's request_timeout_s
+    # + proxy queue-length admission; AIMD/breaker/retry-budget patterns
+    # per util/overload.py) ------------------------------------------------
+    # Default end-to-end budget for one serve request: seeds the deadline
+    # that propagates ingress -> handle -> replica (and nested calls) —
+    # the single source of truth behind every serve-path timeout.
+    serve_default_request_timeout_s: float = 120.0
+    # Proxy admission: AIMD concurrency ceiling per deployment at each
+    # ingress process, and the bounded wait queue behind it (requests
+    # beyond limit+queue shed with 503 + Retry-After; queued requests
+    # are evicted by age when their deadline expires).
+    serve_proxy_concurrency: int = 128
+    serve_shed_queue_len: int = 64
+    # Latency floor feeding the AIMD limiters (proxy + replica): a
+    # completion is an overload signal (limit shrinks multiplicatively)
+    # when slower than max(this, 2x the service's rolling latency
+    # baseline) — degradation vs the service's own normal, so a
+    # slow-but-healthy deployment still grows its limit additively.
+    serve_aimd_latency_target_s: float = 2.0
+    # Per-replica circuit breaker: error-rate threshold over the rolling
+    # window, minimum observations before it can trip, and the base
+    # open-state delay before the first half-open probe (doubles with
+    # jitter on every failed probe, util/backoff.py).
+    serve_breaker_error_threshold: float = 0.5
+    serve_breaker_min_volume: int = 5
+    serve_breaker_open_s: float = 1.0
+    # A replica whose breaker handles report OPEN continuously for this
+    # long is ejected by the controller through the drain machinery
+    # (surge-replace, then drain + kill). <= 0 disables ejection.
+    serve_breaker_eject_s: float = 30.0
+    # Retry-budget deposit per first-try request (retries spend 1 token
+    # each): handle retry volume stays <= this fraction of traffic.
+    serve_retry_budget_ratio: float = 0.2
     # --- profiling & hang diagnosis (ref analogue: `ray stack` + the
     # dashboard reporter's profile_manager) -------------------------------
     # A task running longer than this (seconds) gets its worker's stack

@@ -166,7 +166,19 @@ def execute_task(
     error-info). ``error-info`` is None on success, else
     {error_type, error_message, traceback} — the structured failure
     record the node manager retains and the event plane reports."""
+    from ..util import overload
+
+    deadline_ts = getattr(spec, "deadline_ts", 0.0) or 0.0
+    # Install the request's deadline as this thread's ambient budget so
+    # user code hits cooperative cancellation points and NESTED submits
+    # inherit the remaining budget (deadline propagation).
+    prev_deadline = overload.set_ambient_deadline(deadline_ts)
     try:
+        # Refuse-before-execute: an expired request must never occupy
+        # this worker (it spent its budget queued — the caller already
+        # gave up on it).
+        if deadline_ts:
+            overload.check_deadline(spec.name or spec.method_name or "task")
         args, kwargs = resolve_args(spec, fetch)
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             cls = load_function(spec.function_id)
@@ -186,6 +198,13 @@ def execute_task(
             count = 0
             if inspect.isgenerator(value) or hasattr(value, "__next__"):
                 for item in value:
+                    # Item seams are the cancellation points of a
+                    # streaming task: a stream that outlives its budget
+                    # stops HERE instead of generating into the void.
+                    if deadline_ts:
+                        overload.check_deadline(
+                            spec.name or spec.method_name or "stream"
+                        )
                     stream_item(count, item)
                     count += 1
             elif value is not None:
@@ -213,3 +232,5 @@ def execute_task(
         else:
             results = [(oid, store_large(oid, sobj)) for oid in spec.return_ids()]
         return results, True, [], error_info
+    finally:
+        overload.set_ambient_deadline(prev_deadline)

@@ -26,7 +26,7 @@ MAX_FRAME = 1 << 31
 # the frame shapes change; a mismatch at the hello handshake makes the
 # caller fall back to the node-manager-mediated submit path instead of
 # speaking a frame dialect the worker does not understand.
-DIRECT_PROTO_VER = 2
+DIRECT_PROTO_VER = 3  # v3: compact call frames carry "d" (deadline_ts)
 
 # Per-channel cap on unanswered direct calls. A failing channel replays
 # every unanswered call over the NM route and relies on the worker's

@@ -10,6 +10,7 @@ from __future__ import annotations
 import functools
 from typing import Any, Dict, Optional
 
+from ..util.overload import ambient_deadline as _ambient_deadline
 from .config import get_config
 from .ids import TaskID
 from .resources import CPU, ResourceSet
@@ -75,6 +76,7 @@ class RemoteFunction:
             retries_left=max_retries,
             scheduling_strategy=self._options.get("scheduling_strategy"),
             nested_refs=nested,
+            deadline_ts=_ambient_deadline(),
         )
         refs = rt.submit(spec)
         del keepalive  # deps are pinned by the control plane from here on

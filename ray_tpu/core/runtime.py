@@ -1136,6 +1136,11 @@ class _DirectChannel:
                     frame["a"] = (spec.args, spec.kwargs)
                 if spec.nested_refs:
                     frame["n"] = spec.nested_refs
+                if spec.deadline_ts:
+                    # Per-call deadline must ride the compact frame too:
+                    # the worker's template copy carries the FIRST
+                    # call's value, not this one's.
+                    frame["d"] = spec.deadline_ts
         with self.plock:
             if self.failed:
                 raise ConnectionError("direct channel failed")

@@ -97,6 +97,13 @@ class TaskSpec:
     # consumed by the executing worker to parent its span (ref:
     # tracing_helper.py:165 context injection into the task spec).
     trace_ctx: Optional[Tuple[str, str]] = None
+    # Absolute wall-clock deadline (time.time() seconds; 0 = none).
+    # Stamped at submit from the caller's ambient deadline
+    # (util/overload.py) and re-installed around execution on the
+    # worker, so a request's remaining budget propagates through nested
+    # calls; the worker REFUSES an already-expired task before running
+    # it (ref analogue: serve's end-to-end request_timeout_s).
+    deadline_ts: float = 0.0
     # Placement: "DEFAULT" | "SPREAD" | NodeAffinitySchedulingStrategy |
     # NodeLabelSchedulingStrategy (ref analogue: TaskSpec scheduling_strategy
     # in common.proto + util/scheduling_strategies.py)

@@ -580,6 +580,9 @@ class Worker:
                 spec.args, spec.kwargs = [], {}
             spec.nested_refs = m.get("n", ())
             spec.trace_ctx = None  # span derives from the new task id
+            # Always reset: the template was copied from the FIRST call
+            # of this shape and carries that call's deadline.
+            spec.deadline_ts = m.get("d", 0.0)
             return spec, None
 
         def in_seq_order(items):

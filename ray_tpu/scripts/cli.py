@@ -652,10 +652,73 @@ def cmd_metrics(args) -> int:
         if getattr(args, "actors", False):
             _print_actor_section()
             return 0
+        if getattr(args, "serve", False):
+            _print_serve_section()
+            return 0
         sys.stdout.write(prometheus.render())
         return 0
     finally:
         ray_tpu.shutdown()
+
+
+def _print_serve_section() -> None:
+    """Serve overload-control plane of `rtpu metrics`: shed / deadline /
+    breaker / retry counters aggregated cluster-wide from the KV metrics
+    pipeline (every proxy, handle and replica process flushes into it),
+    plus request/status totals for context."""
+    from ray_tpu.util.metrics import get_metrics_report
+
+    try:
+        report = get_metrics_report()
+    except Exception:
+        report = {}
+
+    def series(name):
+        return report.get(name, {}).get("series", {})
+
+    def by_tag(name, key):
+        out = {}
+        for tags_key, v in series(name).items():
+            if not isinstance(v, (int, float)):
+                continue
+            tags = dict(tags_key)
+            label = ",".join(
+                f"{k}={tags[k]}" for k in sorted(tags) if k != key
+            )
+            out.setdefault(tags.get(key, "?"), {})[label] = v
+        return out
+
+    print("serve overload control:")
+    req = series("ray_tpu_serve_requests_total")
+    total = sum(v for v in req.values() if isinstance(v, (int, float)))
+    print(f"  requests      : total={int(total)}")
+    for scope, rows in sorted(by_tag("ray_tpu_serve_shed_total",
+                                     "scope").items()):
+        n = int(sum(rows.values()))
+        print(f"  shed          : scope={scope} total={n}")
+    for where, rows in sorted(
+            by_tag("ray_tpu_serve_deadline_exceeded_total",
+                   "where").items()):
+        n = int(sum(rows.values()))
+        print(f"  deadline      : where={where} total={n}")
+    retries = sum(
+        v for v in series("ray_tpu_serve_retries_total").values()
+        if isinstance(v, (int, float))
+    )
+    print(f"  retries       : total={int(retries)}")
+    breaker = series("ray_tpu_serve_breaker_state")
+    state_names = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
+    shown = 0
+    for tags_key, v in sorted(breaker.items()):
+        if not isinstance(v, (int, float)):
+            continue
+        tags = dict(tags_key)
+        print(f"  breaker       : deployment={tags.get('deployment', '?')} "
+              f"replica={tags.get('replica', '?')} "
+              f"state={state_names.get(float(v), v)}")
+        shown += 1
+    if not shown:
+        print("  breaker       : no non-default states recorded")
 
 
 def _print_actor_section() -> None:
@@ -962,6 +1025,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--actors", action="store_true",
                    help="print the direct actor-call plane section "
                         "(human-readable) instead of the full document")
+    p.add_argument("--serve", action="store_true",
+                   help="print the serve overload-control section "
+                        "(shed/deadline/breaker/retry counters) instead "
+                        "of the full document")
     _add_address(p)
     p.set_defaults(fn=cmd_metrics)
 

@@ -79,6 +79,34 @@ REPLICA_ONGOING = Gauge(
     "for the deployment total).",
     tag_keys=("deployment", "replica"),
 )
+# --- overload-control plane (util/overload.py mechanisms) -----------------
+SHED_TOTAL = Counter(
+    "ray_tpu_serve_shed_total",
+    "Requests shed by overload control before execution "
+    "(scope: proxy=ingress admission gate, replica=adaptive "
+    "concurrency limit, router=all replica breakers open, "
+    "retry_budget=retry suppressed).",
+    tag_keys=("deployment", "scope"),
+)
+DEADLINE_EXCEEDED_TOTAL = Counter(
+    "ray_tpu_serve_deadline_exceeded_total",
+    "Requests whose end-to-end deadline budget expired "
+    "(where: replica=refused/cancelled on the replica, "
+    "caller=timed out waiting, ingress=observed at the proxy).",
+    tag_keys=("deployment", "where"),
+)
+BREAKER_STATE = Gauge(
+    "ray_tpu_serve_breaker_state",
+    "Per-replica circuit-breaker state as seen by one handle's router "
+    "(0=closed, 1=half-open, 2=open; identity tags `handle`+`replica` — "
+    "max over `handle` for a replica's worst view).",
+    tag_keys=("deployment", "handle", "replica"),
+)
+RETRIES_TOTAL = Counter(
+    "ray_tpu_serve_retries_total",
+    "Handle-level request retries spent from the retry budget.",
+    tag_keys=("deployment",),
+)
 
 
 def observe_ingress(deployment: str, protocol: str, code,
@@ -100,6 +128,36 @@ def update_router_gauges(deployment: str, handle_id: str,
     ONGOING_REQUESTS.set(float(sum(outstanding.values())), tags=tags)
     QUEUE_DEPTH.set(
         float(max(outstanding.values(), default=0)), tags=tags
+    )
+
+
+def observe_shed(deployment: str, scope: str) -> None:
+    """One request shed before execution (proxy gate, replica limiter,
+    all-breakers-open router, or a suppressed retry)."""
+    SHED_TOTAL.inc(1, tags={"deployment": deployment or "anonymous",
+                            "scope": scope})
+
+
+def observe_deadline_exceeded(deployment: str, where: str) -> None:
+    DEADLINE_EXCEEDED_TOTAL.inc(
+        1, tags={"deployment": deployment or "anonymous", "where": where}
+    )
+
+
+def observe_retry(deployment: str) -> None:
+    RETRIES_TOTAL.inc(1, tags={"deployment": deployment or "anonymous"})
+
+
+def record_breaker_state(deployment: str, handle_id: str, replica: str,
+                         state: str) -> None:
+    """Published on breaker TRANSITIONS only (open/half-open/close are
+    rare), not per request."""
+    from ..util.overload import BREAKER_STATE_VALUES
+
+    BREAKER_STATE.set(
+        BREAKER_STATE_VALUES.get(state, 0.0),
+        tags={"deployment": deployment or "anonymous",
+              "handle": handle_id, "replica": replica},
     )
 
 

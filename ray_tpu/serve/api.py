@@ -144,6 +144,7 @@ def _deploy_one(controller, dep_name: str, target: Deployment, *,
             batch_config,
             autoscaling,
             is_asgi=getattr(target.func_or_class, "_rtpu_asgi", False),
+            max_concurrent_queries=target.max_concurrent_queries,
         )
     )
 
@@ -180,6 +181,7 @@ def run(target: Deployment, *, name: Optional[str] = None,
             batch_config,
             autoscaling,
             is_asgi=getattr(target.func_or_class, "_rtpu_asgi", False),
+            max_concurrent_queries=target.max_concurrent_queries,
         )
     )
     snap = ray_tpu.get(controller.get_routing.remote(dep_name))

@@ -53,11 +53,34 @@ class ASGIReplica:
     def handle_http(self, request: Dict[str, Any]) -> Dict[str, Any]:
         """One request through the app. ``request``: {method, path,
         query_string, headers: [[name, value], ...], body: bytes}.
-        Returns {status, headers: [[name, value], ...], body: bytes}."""
+        Returns {status, headers: [[name, value], ...], body: bytes}.
+
+        The wait is bounded by the request's remaining deadline budget
+        (installed around execution from the call frame's deadline) —
+        the ``serve_default_request_timeout_s`` knob seeds it when the
+        client sent no explicit budget."""
+        import concurrent.futures
+
+        from ..core.config import get_config
+        from ..core.exceptions import DeadlineExceededError
+        from ..util import overload
+
         fut = asyncio.run_coroutine_threadsafe(
             self._run_app(request), self._loop
         )
-        return fut.result(timeout=120)
+        try:
+            return fut.result(timeout=overload.remaining(
+                get_config().serve_default_request_timeout_s
+            ))
+        except concurrent.futures.TimeoutError:
+            # On py3.10 this is NOT the builtin TimeoutError: translate
+            # so the proxy's 504 mapping (and the breaker's infra-fault
+            # accounting) see a deadline expiry, not a generic error.
+            fut.cancel()
+            raise DeadlineExceededError(
+                "ASGI app response exceeded the request's deadline "
+                "budget"
+            )
 
     async def _run_app(self, request: Dict[str, Any]) -> Dict[str, Any]:
         scope = {

@@ -51,6 +51,13 @@ class _DeploymentState:
         self.autoscaling: Optional[Dict[str, float]] = None
         self.is_asgi: bool = False  # raw-HTTP ingress deployment
         self.version: str = ""
+        # Ceiling for each replica's adaptive concurrency limiter.
+        self.max_concurrent_queries: int = 8
+        # replica actor hex -> {"since": ts, "last": ts, "state": str}
+        # from handle routers reporting non-closed circuit breakers; a
+        # replica continuously OPEN past serve_breaker_eject_s is
+        # ejected through the drain machinery.
+        self.breaker_reports: Dict[str, Dict[str, Any]] = {}
         # Live replica handles, each tagged with the version it was
         # started under: list of (handle, version).
         self.replicas: List[Any] = []
@@ -73,6 +80,9 @@ class ServeControllerActor:
         self._lock = threading.RLock()
         self._route_cond = threading.Condition(self._lock)
         self._stopped = False
+        # Runtime override of serve_breaker_eject_s (ops/test hook; the
+        # config knob seeds this process's default when None).
+        self._breaker_eject_override: Optional[float] = None
         self._reconciler = threading.Thread(
             target=self._reconcile_loop, daemon=True
         )
@@ -90,7 +100,7 @@ class ServeControllerActor:
             ray_tpu.remote(Replica)
         new = [
             actor_cls.remote(st.blob, st.init_args, st.init_kwargs,
-                             version, st.name)
+                             version, st.name, st.max_concurrent_queries)
             for _ in range(n)
         ]
         # Block until every replica's constructor finished (readiness gate;
@@ -117,7 +127,8 @@ class ServeControllerActor:
                batch_config: Optional[Dict[str, Any]],
                autoscaling: Optional[Dict[str, float]] = None,
                version: Optional[str] = None,
-               is_asgi: bool = False) -> List[Any]:
+               is_asgi: bool = False,
+               max_concurrent_queries: int = 8) -> List[Any]:
         if version is None:
             version = hashlib.sha1(
                 blob + repr((init_args, init_kwargs)).encode()
@@ -139,6 +150,7 @@ class ServeControllerActor:
             st.batch_config = batch_config
             st.autoscaling = dict(autoscaling) if autoscaling else None
             st.version = version
+            st.max_concurrent_queries = max(1, int(max_concurrent_queries))
             if st.autoscaling:
                 lo = int(st.autoscaling.get("min_replicas", 1))
                 hi = int(st.autoscaling.get("max_replicas", num_replicas))
@@ -338,6 +350,84 @@ class ServeControllerActor:
             if st is not None:
                 st.handle_metrics[handle_id] = (outstanding, time.monotonic())
 
+    # Report gaps longer than this end a breaker-open episode (the
+    # handle's breaker closed, or the handle died).
+    BREAKER_REPORT_STALE_S = 5.0
+
+    def report_breakers(self, name: str, handle_id: str,
+                        open_map: Dict[str, str]) -> None:
+        """Handle routers report replicas whose circuit breakers are not
+        closed ({replica actor hex: state}). The reconcile loop ejects
+        replicas continuously OPEN past ``serve_breaker_eject_s``
+        through the drain machinery (ref analogue: deployment_state.py
+        health-based replica replacement, envoy outlier ejection)."""
+        now = time.monotonic()
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return
+            for replica_hex, state_name in open_map.items():
+                rec = st.breaker_reports.get(replica_hex)
+                if rec is None or \
+                        now - rec["last"] > self.BREAKER_REPORT_STALE_S:
+                    st.breaker_reports[replica_hex] = {
+                        "since": now, "last": now, "state": state_name,
+                    }
+                else:
+                    rec["last"] = now
+                    rec["state"] = state_name
+
+    def set_breaker_eject_s(self, seconds: float) -> str:
+        """Override the breaker-ejection threshold at runtime (ops/test
+        hook; serve_breaker_eject_s seeds the default)."""
+        self._breaker_eject_override = float(seconds)
+        return "ok"
+
+    def _eject_broken_once(self, name: str) -> None:
+        """Replace replicas whose breakers have been reported OPEN
+        continuously for serve_breaker_eject_s, via the PR 6 drain
+        machinery (surge-replace, route-set swap, graceful drain+kill)."""
+        from ..core.config import get_config
+
+        eject_s = (self._breaker_eject_override
+                   if self._breaker_eject_override is not None
+                   else get_config().serve_breaker_eject_s)
+        if eject_s <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None or not st.replicas:
+                return
+            live = {r._actor_id.hex() for r in st.replicas}
+            # Age out reports for gone replicas / healed breakers.
+            for hex_id in list(st.breaker_reports):
+                rec = st.breaker_reports[hex_id]
+                if hex_id not in live or \
+                        now - rec["last"] > 6 * self.BREAKER_REPORT_STALE_S:
+                    del st.breaker_reports[hex_id]
+            victims = [
+                hex_id for hex_id, rec in st.breaker_reports.items()
+                if rec["state"] == "open"
+                and now - rec["since"] >= eject_s
+                and now - rec["last"] <= self.BREAKER_REPORT_STALE_S
+            ]
+            # Never eject below one live replica per surge step; the
+            # drain path surges first, so all victims are safe to hand
+            # over at once.
+            if not victims:
+                return
+            for hex_id in victims:
+                del st.breaker_reports[hex_id]
+        cluster_events.emit(
+            cluster_events.WARNING, cluster_events.SERVE,
+            f"deployment '{name}': ejecting {len(victims)} "
+            f"persistently-unhealthy replica(s) (circuit breaker open "
+            f"> {eject_s:.0f}s); surge-replacing via drain",
+            custom_fields={"deployment": name, "ejected": len(victims)},
+        )
+        self.drain_replicas(victims)
+
     def _autoscale_once(self, name: str) -> None:
         import math
 
@@ -451,6 +541,7 @@ class ServeControllerActor:
                     self._autoscale_once(name)
                     if check_health:
                         self._health_check_once(name)
+                        self._eject_broken_once(name)
             except Exception:
                 pass
             time.sleep(RECONCILE_INTERVAL_S)

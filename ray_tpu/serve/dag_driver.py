@@ -60,7 +60,17 @@ class _DAGDriverImpl:
                 f"{sorted(self._routes)}"
             )
         value = self._adapter(request)
-        return handle.remote(value).result(timeout=120)
+        # The nested graph call runs under THIS request's remaining
+        # deadline budget (ambient, installed from the call frame on
+        # the driver replica); the serve_default_request_timeout_s knob
+        # seeds it when no budget arrived — deadline propagation keeps
+        # multi-hop graphs inside one end-to-end budget.
+        from ..core.config import get_config
+        from ..util import overload
+
+        return handle.remote(value).result(timeout=overload.remaining(
+            get_config().serve_default_request_timeout_s
+        ))
 
     def routes(self) -> list:
         return sorted(self._routes)

@@ -17,11 +17,39 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..core.exceptions import DeadlineExceededError, OverloadedError
+from ..util import overload
 from .handle import DeploymentHandle
 
 _server = None
 _lock = threading.Lock()
 _routes: Dict[str, DeploymentHandle] = {}
+
+
+def _make_gate(name: str) -> overload.AdmissionGate:
+    """Per-deployment admission gate (mirror of the HTTP proxy's):
+    sheds map to RESOURCE_EXHAUSTED instead of queueing."""
+    from ..core.config import get_config
+
+    return overload.gate_from_config(get_config())
+
+
+_gates = overload.GateRegistry(_make_gate)
+
+
+def _rpc_deadline(context) -> float:
+    """Absolute deadline for one RPC: the client's gRPC deadline when
+    set (context.time_remaining()), else the configured serve default."""
+    from ..core.config import get_config
+
+    budget = get_config().serve_default_request_timeout_s
+    try:
+        tr = context.time_remaining()
+        if tr is not None:
+            budget = min(budget, max(0.001, tr))
+    except Exception:
+        pass
+    return time.time() + budget
 
 
 class _ControllerDown(Exception):
@@ -139,29 +167,77 @@ class _GenericHandler:
 
             return finish
 
+        def _admit_or_abort(context, status):
+            """Overload admission (shed BEFORE dispatch) + deadline
+            computation; aborts with RESOURCE_EXHAUSTED on shed."""
+            from . import _telemetry
+
+            deadline_ts = _rpc_deadline(context)
+            gate = _gates.get(dep_name)
+            try:
+                gate.acquire(deadline_ts=deadline_ts)
+            except OverloadedError as e:
+                _telemetry.observe_shed(dep_name, "proxy")
+                status[0] = "RESOURCE_EXHAUSTED"
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            return gate, deadline_ts
+
         def unary_unary(request: bytes, context):
+            from . import _telemetry
+
             status = ["OK"]
             finish = _begin_observation(context)
             try:
                 handle = _handle_or_abort(context, status)
+                gate, deadline_ts = _admit_or_abort(context, status)
+                t0 = time.monotonic()
+                prev_dl = overload.set_ambient_deadline(deadline_ts)
                 try:
                     h = handle if method == "__call__" else handle.options(
                         method=method
                     )
-                    result = h.remote(request).result(timeout=120)
+                    result = h.remote(request).result(
+                        timeout=overload.remaining(120.0)
+                    )
+                except OverloadedError as e:
+                    status[0] = "RESOURCE_EXHAUSTED"
+                    context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                  str(e))
+                    return b""
+                except (DeadlineExceededError, TimeoutError) as e:
+                    _telemetry.observe_deadline_exceeded(
+                        dep_name, "ingress"
+                    )
+                    status[0] = "DEADLINE_EXCEEDED"
+                    context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                  str(e))
+                    return b""
                 except Exception as e:  # noqa: BLE001
                     status[0] = "INTERNAL"
                     context.abort(grpc.StatusCode.INTERNAL, str(e))
                     return b""
+                finally:
+                    overload.set_ambient_deadline(prev_dl)
+                    # Only downstream pushback shrinks the gate; a
+                    # DEADLINE_EXCEEDED means the client's budget was
+                    # too small, not that the server is overloaded.
+                    gate.release(time.monotonic() - t0,
+                                 overloaded=status[0] ==
+                                 "RESOURCE_EXHAUSTED")
                 return _encode(result)
             finally:
                 finish(status[0])
 
         def unary_stream(request: bytes, context):
+            from . import _telemetry
+
             status = ["OK"]
             finish = _begin_observation(context)
             try:
                 handle = _handle_or_abort(context, status)
+                gate, deadline_ts = _admit_or_abort(context, status)
+                t0 = time.monotonic()
+                prev_dl = overload.set_ambient_deadline(deadline_ts)
                 try:
                     it = handle.options(method=method).stream(request)
                     for item in it:
@@ -171,9 +247,28 @@ class _GenericHandler:
                     # generator; an aborted partial stream is not an OK.
                     status[0] = "CANCELLED"
                     raise
+                except OverloadedError as e:
+                    status[0] = "RESOURCE_EXHAUSTED"
+                    context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                  str(e))
+                except (DeadlineExceededError, TimeoutError) as e:
+                    _telemetry.observe_deadline_exceeded(
+                        dep_name, "ingress"
+                    )
+                    status[0] = "DEADLINE_EXCEEDED"
+                    context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                  str(e))
                 except Exception as e:  # noqa: BLE001
                     status[0] = "INTERNAL"
                     context.abort(grpc.StatusCode.INTERNAL, str(e))
+                finally:
+                    overload.set_ambient_deadline(prev_dl)
+                    # Only downstream pushback shrinks the gate; a
+                    # DEADLINE_EXCEEDED means the client's budget was
+                    # too small, not that the server is overloaded.
+                    gate.release(time.monotonic() - t0,
+                                 overloaded=status[0] ==
+                                 "RESOURCE_EXHAUSTED")
             finally:
                 finish(status[0])
 
@@ -296,3 +391,4 @@ def stop_grpc_ingress():
             _server.stop(grace=1.0)
             _server = None
         _routes.clear()
+        _gates.clear()

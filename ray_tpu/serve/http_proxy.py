@@ -10,11 +10,14 @@ dependency; the stdlib server keeps ingress dependency-free.)
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from ..core.exceptions import DeadlineExceededError, OverloadedError
+from ..util import overload
 from .handle import DeploymentHandle
 
 
@@ -27,6 +30,38 @@ class _ProxyState:
 _state = _ProxyState()
 _server: Optional[ThreadingHTTPServer] = None
 _thread: Optional[threading.Thread] = None
+
+
+def _make_gate(name: str) -> overload.AdmissionGate:
+    """Per-deployment admission gate; sheds map to 503 + Retry-After
+    (ref analogue: the proxy's queue-length admission)."""
+    from ..core.config import get_config
+
+    return overload.gate_from_config(get_config())
+
+
+_gates = overload.GateRegistry(_make_gate)
+
+
+def _request_deadline(headers) -> float:
+    """Absolute deadline for one ingress request: an explicit
+    ``X-Request-Timeout-S`` budget when the client sent one, else the
+    ``serve_default_request_timeout_s`` knob — the single source of
+    truth that seeds deadline propagation through handle and replica."""
+    from ..core.config import get_config
+
+    default = get_config().serve_default_request_timeout_s
+    budget = default
+    raw = headers.get("X-Request-Timeout-S")
+    if raw:
+        try:
+            # Clients may only SHORTEN the budget (mirror of the gRPC
+            # path): an unclamped header would let one client pin proxy
+            # threads and admission slots for arbitrarily long.
+            budget = min(default, max(0.001, float(raw)))
+        except ValueError:
+            pass
+    return time.time() + budget
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -87,9 +122,22 @@ class _Handler(BaseHTTPRequestHandler):
             "body": body,
         }
         try:
+            # Bounded by the request's remaining deadline budget
+            # (installed by _route_request; the config default seeds it).
             resp = handle.options(method="handle_http").remote(
                 request
-            ).result(timeout=120)
+            ).result(timeout=overload.remaining(120.0))
+        except OverloadedError as e:
+            # Shed downstream (replica limiter / breakers) — counted at
+            # its shed site; here it just maps to 503 + Retry-After.
+            self._reply_overloaded(e)
+            return
+        except (DeadlineExceededError, TimeoutError) as e:
+            from . import _telemetry
+
+            _telemetry.observe_deadline_exceeded(name, "ingress")
+            self._reply(504, {"error": str(e)})
+            return
         except Exception as e:  # noqa: BLE001
             self._reply(500, {"error": str(e)})
             return
@@ -239,10 +287,7 @@ class _Handler(BaseHTTPRequestHandler):
         # the explicit-registration set covers driver-local routes.
         is_asgi = (getattr(handle._state, "is_asgi", False)
                    or name in _state.asgi_routes)
-        if is_asgi:
-            self._asgi_forward(name, handle)
-            return
-        if self.command in ("HEAD", "OPTIONS"):
+        if not is_asgi and self.command in ("HEAD", "OPTIONS"):
             # Non-ASGI deployments speak the JSON envelope only; do NOT
             # execute them on preflight/health probes, and never write a
             # body to a HEAD response (keep-alive desync).
@@ -251,26 +296,79 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "0")
             self.end_headers()
             return
-        length = int(self.headers.get("Content-Length") or 0)
-        raw = self.rfile.read(length) if length else b"null"
+        # ---- overload control: deadline + admission ------------------
+        # Shed BEFORE dispatch: past the adaptive concurrency limit and
+        # the bounded queue, the request never reaches a handle thread.
+        from . import _telemetry
+
+        deadline_ts = _request_deadline(self.headers)
+        gate = _gates.get(name)
         try:
-            arg = json.loads(raw) if raw else None
-        except json.JSONDecodeError:
-            self._reply(400, {"error": "invalid JSON body"})
+            gate.acquire(deadline_ts=deadline_ts)
+        except OverloadedError as e:
+            _telemetry.observe_shed(name, "proxy")
+            self._reply_overloaded(e)
             return
-        if streaming:
-            # /<name>/<method> routes to that method (e.g. /llm/stream →
-            # the deployment's generator endpoint); bare /<name> with an
-            # SSE Accept header streams __call__'s result as one event.
-            if len(parts) > 1:
-                handle = handle.options(method=parts[1])
-            self._stream_reply(handle, arg)
-            return
+        t0 = time.monotonic()
+        prev_dl = overload.set_ambient_deadline(deadline_ts)
         try:
-            result = handle.remote(arg).result(timeout=60)
-            self._reply(200, {"result": result})
-        except Exception as e:  # noqa: BLE001
-            self._reply(500, {"error": str(e)})
+            if is_asgi:
+                self._asgi_forward(name, handle)
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b"null"
+            try:
+                arg = json.loads(raw) if raw else None
+            except json.JSONDecodeError:
+                self._reply(400, {"error": "invalid JSON body"})
+                return
+            if streaming:
+                # /<name>/<method> routes to that method (e.g.
+                # /llm/stream → the deployment's generator endpoint);
+                # bare /<name> with an SSE Accept header streams
+                # __call__'s result as one event.
+                if len(parts) > 1:
+                    handle = handle.options(method=parts[1])
+                self._stream_reply(handle, arg)
+                return
+            try:
+                result = handle.remote(arg).result(
+                    timeout=overload.remaining(60.0)
+                )
+                self._reply(200, {"result": result})
+            except OverloadedError as e:
+                # Shed downstream (replica limiter / all breakers open).
+                self._reply_overloaded(e)
+            except (DeadlineExceededError, TimeoutError) as e:
+                _telemetry.observe_deadline_exceeded(name, "ingress")
+                self._reply(504, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001
+                self._reply(500, {"error": str(e)})
+        finally:
+            overload.set_ambient_deadline(prev_dl)
+            code = getattr(self, "_obs_status", 500)
+            # Only downstream pushback (503: replica shed / breakers
+            # open) shrinks the gate. A 504 means the CLIENT's budget
+            # was too small — one client sending tiny X-Request-
+            # Timeout-S values must not collapse the shared limit.
+            gate.release(time.monotonic() - t0,
+                         overloaded=code == 503)
+
+    def _reply_overloaded(self, e: OverloadedError):
+        """503 + Retry-After (integer seconds, RFC 9110). The request
+        body may be unread at this point: close the connection so a
+        keep-alive client cannot desync on the stray bytes."""
+        body = json.dumps({"error": str(e)}).encode()
+        self.send_response(503)
+        retry_after = getattr(e, "retry_after_s", 1.0)
+        self.send_header("Retry-After",
+                         str(max(1, int(math.ceil(retry_after)))))
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.close_connection = True
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
 
 
 class _TLSHTTPServer(ThreadingHTTPServer):
@@ -353,6 +451,7 @@ def stop_proxy():
         _thread = None
     _state.routes.clear()
     _state.asgi_routes.clear()
+    _gates.clear()
 
 
 # ---------------------------------------------------------- per-node proxy

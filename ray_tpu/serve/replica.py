@@ -49,7 +49,8 @@ def _resolve_bound(value):
 
 class Replica:
     def __init__(self, blob: bytes, init_args, init_kwargs,
-                 version: str = "", deployment_name: str = ""):
+                 version: str = "", deployment_name: str = "",
+                 max_concurrent: int = 8):
         target = cloudpickle.loads(blob)
         init_args = tuple(_resolve_bound(a) for a in init_args)
         init_kwargs = {k: _resolve_bound(v)
@@ -70,9 +71,22 @@ class Replica:
         # share a tag set (sum over `replica` for the total).
         import os
 
+        from ..core.config import get_config
         from ..util import device_metrics
+        from ..util.overload import AIMDLimiter
 
         self._replica_id = f"{device_metrics.node_tag()}:{os.getpid()}"
+        # Adaptive admission: the deployment's max_concurrent_queries is
+        # the ceiling; observed latency shrinks the admitted concurrency
+        # below it (AIMD), and excess sheds with OverloadedError so the
+        # handle routes it to a less-loaded replica instead of queueing
+        # it here (ref analogue: replica-side max_ongoing_requests).
+        self._limiter = AIMDLimiter(
+            initial=max(1, int(max_concurrent)),
+            min_limit=1,
+            max_limit=max(1, int(max_concurrent)),
+            latency_target_s=get_config().serve_aimd_latency_target_s,
+        )
 
     def _resolve(self, method: str):
         if self._is_class and method != "__call__":
@@ -109,16 +123,63 @@ class Replica:
         # in replicas that never imported jax).
         device_metrics.maybe_sample()
 
+    def _admit(self, method: str) -> None:
+        """Overload-control entry run before ANY user code: refuse
+        deadline-expired work (it spent its budget queued — a dead
+        request must never occupy the TPU), then enforce the adaptive
+        concurrency limit (shed -> the handle retries a less-loaded
+        replica)."""
+        from ..core.exceptions import OverloadedError
+        from ..util import overload
+        from . import _telemetry
+
+        overload.check_deadline(f"{self._deployment or 'replica'}.{method}")
+        if not self._limiter.try_acquire():
+            _telemetry.observe_shed(self._deployment, "replica")
+            raise OverloadedError(
+                f"replica {self._replica_id} of "
+                f"{self._deployment or 'anonymous'!r} at adaptive "
+                f"concurrency limit {self._limiter.limit}",
+                retry_after_s=max(
+                    0.1, self._limiter.ewma_latency_s or 0.5
+                ),
+            )
+
+    def _chaos(self, method: str) -> None:
+        """Chaos injection point INSIDE the measured request window, so
+        an armed latency/error spec degrades this replica exactly like a
+        slow or faulty one — feeding the caller's breaker and this
+        replica's AIMD limiter (scope to one replica via
+        ``match={"replica": <id>}``)."""
+        from ..util import faults
+
+        delay = faults.fire(
+            faults.SERVE_REPLICA,
+            deployment=self._deployment or "anonymous",
+            replica=self._replica_id, method=method,
+        )
+        if delay:
+            time.sleep(delay)
+
     def handle_request(self, method: str, args: Tuple, kwargs: Dict,
                        model_id: str = "", submit_ts: float = 0.0) -> Any:
+        from ..util import overload
         from .multiplex import _set_model_id
 
+        self._admit(method)
         self._begin()
         started = time.time()
         _set_model_id(model_id)
         try:
+            self._chaos(method)
+            # Injected (or real queueing) latency may have spent the
+            # budget: cancel before user code runs, not after.
+            overload.check_deadline(
+                f"{self._deployment or 'replica'}.{method}"
+            )
             return self._resolve(method)(*args, **kwargs)
         finally:
+            self._limiter.release(time.time() - started)
             self._end(method, submit_ts, started)
 
     def handle_request_streaming(self, method: str, args: Tuple,
@@ -127,19 +188,25 @@ class Replica:
         """Generator entry: invoked with num_returns="streaming" by the
         handle so each yielded item seals as its own object and streams to
         the caller as produced (ref analogue: replica.py
-        call_user_generator + the proxy's RESPONSE_STREAMING path)."""
+        call_user_generator + the proxy's RESPONSE_STREAMING path).
+        Deadline enforcement between items happens at the executor's
+        stream-item seams (core/executor.py), so an expired stream stops
+        producing instead of generating into the void."""
         from .multiplex import _set_model_id
 
+        self._admit(method)
         self._begin()
         started = time.time()
         _set_model_id(model_id)
         try:
+            self._chaos(method)
             out = self._resolve(method)(*args, **kwargs)
             if inspect.isgenerator(out) or hasattr(out, "__next__"):
                 yield from out
             else:
                 yield out
         finally:
+            self._limiter.release(time.time() - started)
             self._end(method, submit_ts, started)
 
     def handle_batch(self, method: str, batched_args: List[Tuple],
@@ -149,10 +216,12 @@ class Replica:
         positional args and must return a list of equal length."""
         from .multiplex import _set_model_id
 
+        self._admit(method)
         self._begin(len(batched_args))
         started = time.time()
         _set_model_id(model_id)
         try:
+            self._chaos(method)
             fn = self._resolve(method)
             items = [a[0][0] if a[0] else None for a in batched_args]
             out = fn(items)
@@ -163,6 +232,7 @@ class Replica:
                 )
             return list(out)
         finally:
+            self._limiter.release(time.time() - started)
             self._end(method, submit_ts, started)
 
     def stats(self) -> Dict[str, Any]:
@@ -170,6 +240,9 @@ class Replica:
             "num_handled": self._num_handled,
             "ongoing": self._ongoing,
             "version": self._version,
+            "replica_id": self._replica_id,
+            "concurrency_limit": self._limiter.limit,
+            "sheds": self._limiter.sheds,
         }
 
     def version(self) -> str:

@@ -44,6 +44,7 @@ DIRECT_CHANNEL_IO = "direct_channel_io"
 GCS_RPC = "gcs_rpc"
 WORKER_SPAWN = "worker_spawn"
 HEARTBEAT = "heartbeat"
+SERVE_REPLICA = "serve_replica"
 
 # name -> (description, advertised degradation path). The lint enforces
 # exactly-once registration here and at least one fire() site per name.
@@ -65,6 +66,12 @@ FAULT_POINTS: Dict[str, str] = {
                "(degradation: GCS declares the node dead; lineage "
                "re-executes lost objects, node re-registers when the "
                "partition heals)",
+    SERVE_REPLICA: "serve replica request execution "
+                   "(degradation: handle retries another replica under "
+                   "the retry budget, the sick replica's circuit "
+                   "breaker opens, proxies shed under sustained "
+                   "latency; scope to one replica via "
+                   "match={'replica': ...})",
 }
 
 MODES = ("always", "once", "every", "prob")
@@ -81,7 +88,8 @@ class _ArmedSpec:
     """Per-process state of one armed spec (hit/fire counters + RNG)."""
 
     __slots__ = ("point", "mode", "action", "n", "p", "seed", "delay_s",
-                 "max_fires", "node", "hits", "fires", "rng", "spec_dict")
+                 "max_fires", "node", "match", "hits", "fires", "rng",
+                 "spec_dict")
 
     def __init__(self, spec: Dict[str, Any]):
         self.spec_dict = dict(spec)
@@ -94,6 +102,7 @@ class _ArmedSpec:
         self.delay_s = float(spec.get("delay_s", 0.0))
         self.max_fires = int(spec.get("max_fires", 0))
         self.node = spec.get("node") or ""
+        self.match = dict(spec.get("match") or {})
         self.hits = 0
         self.fires = 0
         self.rng = random.Random(self.seed)
@@ -108,7 +117,10 @@ def validate_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
     ``mode`` (default ``always``), ``action`` (default ``error``),
     ``n`` (every-Nth), ``p`` + ``seed`` (probabilistic), ``delay_s``
     (latency action), ``max_fires`` (0 = unbounded), ``node`` (hex
-    prefix — only processes on that node fire)."""
+    prefix — only processes on that node fire), ``match`` ({ctx key:
+    value prefix} — the fire site's context must match every entry,
+    e.g. ``{"replica": "nodehex:pid"}`` scopes a serve_replica spec to
+    ONE replica of a deployment)."""
     if not isinstance(spec, dict):
         raise ValueError(f"chaos spec must be a dict, got {type(spec)}")
     point = spec.get("point")
@@ -135,6 +147,9 @@ def validate_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
         "delay_s": max(0.0, float(spec.get("delay_s", 0.0))),
         "max_fires": max(0, int(spec.get("max_fires", 0))),
         "node": str(spec.get("node") or ""),
+        "match": {
+            str(k): str(v) for k, v in (spec.get("match") or {}).items()
+        },
         # Stable identity stamped by the GCS at arm time (None for
         # direct local plans): entries retained across a plan append
         # keep their counters in apply_plan.
@@ -249,6 +264,11 @@ def _fire_armed(point: str, ctx: Dict[str, Any]) -> float:
                 continue
             if a.node and not _local_node.startswith(a.node):
                 continue
+            if a.match and not all(
+                str(ctx.get(k, "")).startswith(v)
+                for k, v in a.match.items()
+            ):
+                continue  # fire-site context doesn't match the scope
             a.hits += 1
             if a.max_fires and a.fires >= a.max_fires:
                 continue

@@ -1,0 +1,523 @@
+"""Request-robustness / overload-control tests.
+
+The acceptance story (ISSUE 7): with chaos-armed latency injection on
+one replica of a 2-replica deployment under sustained load, the sick
+replica's circuit breaker opens and traffic shifts (goodput of
+in-deadline requests >= 95%), deadline-expired requests are provably
+never executed replica-side, the proxy sheds with 503 + Retry-After
+instead of queueing unboundedly, and half-open probes re-admit the
+replica after heal.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core.exceptions import DeadlineExceededError, OverloadedError
+from ray_tpu.util import faults, overload
+
+# ------------------------------------------------------ primitive units
+
+
+def test_aimd_limiter_adapts():
+    t = [0.0]
+    lim = overload.AIMDLimiter(
+        initial=4, min_limit=1, max_limit=8, latency_target_s=0.5,
+        decrease_interval_s=0.0, clock=lambda: t[0],
+    )
+    assert lim.limit == 4
+    # Steady latency ABOVE the absolute target is this service's
+    # normal (a 3s TPU forward pass): the baseline learns it and the
+    # limit still grows — slow-but-healthy must not collapse to min.
+    for _ in range(20):
+        if lim.try_acquire():
+            t[0] += 0.1
+            lim.release(1.0)
+    assert lim.limit >= 4
+    # DEGRADATION vs the service's own baseline shrinks
+    # multiplicatively (queueing inflates latency well past 2x).
+    for _ in range(6):
+        if lim.try_acquire():
+            t[0] += 0.1
+            lim.release(5.0)
+    assert lim.limit < 4
+    floor = lim.limit
+    # ...recovery grows back additively (bounded by max).
+    for _ in range(200):
+        if lim.try_acquire():
+            lim.release(1.0)
+    assert lim.limit > floor
+    assert lim.limit <= 8
+    # An explicit overload signal decreases without any latency sample.
+    before = lim.limit
+    lim.on_reject()
+    assert lim.limit < before or lim.limit == 1
+    # Saturation sheds.
+    lim2 = overload.AIMDLimiter(initial=1, max_limit=1)
+    assert lim2.try_acquire()
+    assert not lim2.try_acquire()
+    assert lim2.sheds == 1
+
+
+def test_admission_gate_sheds_full_queue_and_evicts_by_age():
+    gate = overload.AdmissionGate(
+        overload.AIMDLimiter(initial=1, max_limit=1), max_queue=0
+    )
+    gate.acquire()  # takes the only slot
+    # Queue bound 0: the next request sheds immediately, pre-queue.
+    with pytest.raises(OverloadedError) as ei:
+        gate.acquire()
+    assert ei.value.retry_after_s > 0
+    assert gate.shed_full == 1
+    gate.release(0.01)
+
+    # Queue bound 1: the request queues, then is EVICTED BY AGE the
+    # moment its deadline passes (age-based eviction behind the gate).
+    gate2 = overload.AdmissionGate(
+        overload.AIMDLimiter(initial=1, max_limit=1), max_queue=1
+    )
+    gate2.acquire()
+    t0 = time.monotonic()
+    with pytest.raises(OverloadedError):
+        gate2.acquire(deadline_ts=time.time() + 0.15)
+    assert 0.1 <= time.monotonic() - t0 < 2.0
+    assert gate2.shed_expired == 1
+
+
+def test_circuit_breaker_open_probe_close_cycle():
+    t = [0.0]
+    transitions = []
+    br = overload.CircuitBreaker(
+        error_threshold=0.5, min_volume=4, open_base_s=1.0,
+        clock=lambda: t[0], seed=7, on_transition=transitions.append,
+    )
+    assert br.allow()
+    for _ in range(4):
+        br.record(False)
+    assert br.state == "open"
+    assert not br.allow()
+    assert br.opens == 1
+    assert not br.probe_due()  # backoff window still running
+    t[0] += 2.0  # past base delay (+25% jitter bound)
+    assert br.probe_due()
+    br.begin_probe()
+    assert br.state == "half_open"
+    assert not br.probe_due()  # probe claimed, not yet timed out
+    br.record(False)  # failed probe -> back open, longer delay
+    assert br.state == "open"
+    t[0] += 4.0
+    assert br.probe_due()
+    br.begin_probe()
+    br.record(True)  # successful probe -> closed, window cleared
+    assert br.state == "closed"
+    assert br.allow()
+    assert transitions[0] == "open" and transitions[-1] == "closed"
+
+
+def test_retry_budget_caps_amplification():
+    b = overload.RetryBudget(ratio=0.5, reserve=1.0, cap=10.0)
+    assert b.try_spend()
+    assert not b.try_spend()  # reserve exhausted
+    for _ in range(3):
+        b.record_request()  # deposits 0.5 each -> 1.5 tokens
+    assert b.try_spend()
+    assert b.try_spend() is False  # 0.5 left < 1 retry
+
+
+def test_deadline_scope_and_check():
+    assert overload.ambient_deadline() == 0.0
+    with overload.deadline_scope(time.time() + 5.0):
+        assert overload.remaining() > 4.0
+        overload.check_deadline("fine")
+        with overload.deadline_scope(time.time() - 1.0):
+            with pytest.raises(DeadlineExceededError):
+                overload.check_deadline("expired")
+        assert overload.remaining() > 4.0  # restored
+    assert overload.ambient_deadline() == 0.0
+    assert overload.remaining(42.0) == 42.0  # default when none
+
+
+def test_chaos_match_scopes_to_context():
+    faults.apply_plan([{
+        "point": "serve_replica", "mode": "always", "action": "error",
+        "match": {"replica": "node1:11"},
+    }])
+    try:
+        # Non-matching context: no fire.
+        assert faults.fire("serve_replica", replica="node2:99") == 0.0
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("serve_replica", replica="node1:11")
+    finally:
+        faults.clear()
+
+
+# ------------------------------------------------- cluster-level matrix
+
+
+@pytest.fixture
+def serve_cluster(ray_tpu_start):
+    yield ray_tpu_start
+    try:
+        _arm([])
+    except Exception:
+        pass
+    faults.clear()
+    serve.shutdown()
+
+
+def _nm():
+    from ray_tpu.core.runtime_context import current_runtime
+
+    return current_runtime()._nm
+
+
+def _arm(specs):
+    nm = _nm()
+    return nm.call_sync(nm._gcs.chaos_arm(specs), timeout=30)
+
+
+def test_deadline_propagates_to_tasks_and_refuses_expired(serve_cluster):
+    """Core plane: a task submitted under an expired ambient budget is
+    refused worker-side (never executes); a live budget propagates into
+    the executing task (nested calls inherit it)."""
+    marker = "/tmp/rtpu_overload_marker_%d" % os.getpid()
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @ray_tpu.remote
+    def side_effect():
+        with open(marker, "a") as f:
+            f.write("ran\n")
+        return overload.ambient_deadline()
+
+    with overload.deadline_scope(time.time() - 0.5):
+        ref = side_effect.remote()
+    with pytest.raises(DeadlineExceededError):
+        ray_tpu.get(ref, timeout=30)
+    assert not os.path.exists(marker), "expired task must never execute"
+
+    dl = time.time() + 30.0
+    with overload.deadline_scope(dl):
+        seen = ray_tpu.get(side_effect.remote(), timeout=30)
+    assert abs(seen - dl) < 1e-6, "deadline must propagate into the task"
+    assert os.path.exists(marker)
+
+
+def test_deadline_rides_direct_plane_compact_frames(serve_cluster):
+    """Templated (compact) direct-plane call frames must carry each
+    call's OWN deadline, not the template registrant's."""
+
+    @ray_tpu.remote
+    class Probe:
+        def deadline(self):
+            return overload.ambient_deadline()
+
+    p = Probe.remote()
+    dl1 = time.time() + 50.0
+    dl2 = time.time() + 99.0
+    with overload.deadline_scope(dl1):
+        ref1 = p.deadline.remote()  # registers the template
+    with overload.deadline_scope(dl2):
+        ref2 = p.deadline.remote()  # compact frame
+    assert abs(ray_tpu.get(ref1, timeout=30) - dl1) < 1e-6
+    assert abs(ray_tpu.get(ref2, timeout=30) - dl2) < 1e-6
+
+
+def test_expired_serve_request_cancelled_replica_side(serve_cluster):
+    """A serve request queued behind a slow one past its budget is
+    refused BEFORE user code runs (provably never executes)."""
+    marker = "/tmp/rtpu_overload_serve_%d" % os.getpid()
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @serve.deployment(num_replicas=1)
+    class Slowish:
+        def __call__(self, req):
+            with open(marker, "a") as f:
+                f.write(f"{req['id']}\n")
+                f.flush()
+            time.sleep(req.get("sleep", 0))
+            return req["id"]
+
+    handle = serve.run(Slowish.bind(), name="slowish")
+    # Occupy the single replica...
+    f1 = handle.remote({"id": "blocker", "sleep": 1.0})
+    time.sleep(0.15)
+    # ...then queue a request whose budget dies while it waits.
+    with overload.deadline_scope(time.time() + 0.3):
+        f2 = handle.remote({"id": "expired", "sleep": 0})
+    assert f1.result(timeout=30) == "blocker"
+    with pytest.raises(DeadlineExceededError):
+        f2.result(timeout=30)
+    time.sleep(0.3)
+    executed = open(marker).read() if os.path.exists(marker) else ""
+    assert "expired" not in executed, \
+        "deadline-expired request must never reach user code"
+
+
+def test_streaming_cancelled_mid_flight_on_deadline(serve_cluster):
+    """A streaming response that outlives its budget stops producing at
+    an item seam instead of generating to completion."""
+    marker = "/tmp/rtpu_overload_stream_%d" % os.getpid()
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @serve.deployment(num_replicas=1)
+    class Tokens:
+        def gen(self, _):
+            for i in range(50):
+                with open(marker, "a") as f:
+                    f.write(f"{i}\n")
+                    f.flush()
+                time.sleep(0.1)
+                yield i
+
+    handle = serve.run(Tokens.bind(), name="tokens")
+    got = []
+    with overload.deadline_scope(time.time() + 0.45):
+        with pytest.raises(Exception):
+            for item in handle.options(method="gen").stream(None):
+                got.append(item)
+    assert 1 <= len(got) < 50, got
+    time.sleep(0.5)  # generator must be dead, not still producing
+    n_before = len(open(marker).read().splitlines())
+    time.sleep(0.5)
+    n_after = len(open(marker).read().splitlines())
+    assert n_after == n_before < 50, "generator kept running past cancel"
+
+
+def test_replica_sheds_past_adaptive_limit(serve_cluster):
+    """A replica at its concurrency ceiling refuses with
+    OverloadedError (shed, not queue) and the shed counter moves."""
+
+    @serve.deployment(num_replicas=1, max_concurrent_queries=1,
+                      ray_actor_options={"max_concurrency": 8})
+    class OneAtATime:
+        def __call__(self, _):
+            time.sleep(0.4)
+            return "ok"
+
+    handle = serve.run(OneAtATime.bind(), name="one-at-a-time")
+    futs = [handle.remote(None) for _ in range(6)]
+    results, errors = [], []
+    for f in futs:
+        try:
+            results.append(f.result(timeout=30))
+        except OverloadedError as e:
+            errors.append(e)
+    assert results, "some requests must be served"
+    assert errors, "excess concurrency must shed with OverloadedError"
+    assert all(e.retry_after_s > 0 for e in errors)
+
+
+def test_proxy_sheds_with_503_and_retry_after(serve_cluster):
+    """Past the proxy's AIMD limit + bounded queue, HTTP ingress sheds
+    with 503 + Retry-After before queueing."""
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    old = (cfg.serve_proxy_concurrency, cfg.serve_shed_queue_len)
+    cfg.serve_proxy_concurrency, cfg.serve_shed_queue_len = 2, 0
+    try:
+        from ray_tpu.serve import http_proxy
+
+        http_proxy._gates.clear()  # rebuild gates under the test knobs
+
+        @serve.deployment(num_replicas=1,
+                          ray_actor_options={"max_concurrency": 8})
+        class Slow:
+            def __call__(self, _):
+                time.sleep(0.6)
+                return "ok"
+
+        handle = serve.run(Slow.bind(), name="slowdep")
+        port = handle.http_port
+
+        codes, retry_afters = [], []
+        lock = threading.Lock()
+
+        def hit():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/slowdep",
+                data=b"null",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    with lock:
+                        codes.append(resp.status)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    codes.append(e.code)
+                    if e.code == 503:
+                        retry_afters.append(e.headers.get("Retry-After"))
+
+        threads = [threading.Thread(target=hit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert codes.count(200) >= 1, codes
+        assert codes.count(503) >= 1, codes
+        assert retry_afters and all(
+            ra is not None and int(ra) >= 1 for ra in retry_afters
+        ), retry_afters
+    finally:
+        cfg.serve_proxy_concurrency, cfg.serve_shed_queue_len = old
+        from ray_tpu.serve import http_proxy
+
+        http_proxy._gates.clear()
+
+
+def test_breaker_opens_shifts_traffic_and_recovers(serve_cluster):
+    """THE acceptance scenario: chaos-armed latency on one replica of a
+    2-replica deployment under sustained deadlined load -> the sick
+    replica's breaker opens, traffic shifts (goodput >= 95%), expired
+    requests never execute user code; after heal, half-open probes
+    re-admit the replica."""
+    marker = "/tmp/rtpu_overload_breaker_%d" % os.getpid()
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=4,
+                      ray_actor_options={"max_concurrency": 4})
+    class Echo:
+        def __call__(self, req):
+            with open(marker, "a") as f:
+                f.write(f"{req}\n")
+                f.flush()
+            return os.getpid()
+
+    handle = serve.run(Echo.bind(), name="breaker-echo")
+    state = handle._state
+
+    # Warm both replicas, learn their identities.
+    pids = {handle.remote(f"warm-{i}").result(timeout=30)
+            for i in range(8)}
+    assert len(pids) == 2
+    stats = [ray_tpu.get(r.stats.remote(), timeout=30)
+             for r in list(state.replicas)]
+    sick_id = stats[0]["replica_id"]
+
+    # Inject 0.6s latency into ONE replica only (match-scoped).
+    _arm([{"point": "serve_replica", "mode": "always",
+           "action": "latency", "delay_s": 0.6,
+           "match": {"replica": sick_id}}])
+
+    # Wait until the armed plan has propagated to the workers.
+    @ray_tpu.remote
+    def current_plan():
+        from ray_tpu.util import faults as f
+
+        return f.current_plan()
+
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if ray_tpu.get(current_plan.remote(), timeout=30):
+            break
+        time.sleep(0.1)
+
+    def drive(n, budget_s, tag):
+        """n requests under budget_s each; returns (ok, expired)."""
+        ok, expired = [], []
+        for i in range(n):
+            with overload.deadline_scope(time.time() + budget_s):
+                fut = handle.remote(f"{tag}-{i}")
+            try:
+                ok.append(fut.result(timeout=30))
+            except (DeadlineExceededError, TimeoutError):
+                expired.append(f"{tag}-{i}")
+            except OverloadedError:
+                expired.append(f"{tag}-{i}")
+        return ok, expired
+
+    # Phase 1 (warmup): the sick replica eats its requests' budgets;
+    # failures feed its breaker until it opens. Drive until it does
+    # (bounded): the warm phase left successes in the rolling window
+    # that the failures must outweigh first.
+    t0 = time.time()
+    while time.time() - t0 < 30.0:
+        drive(6, 0.35, "warmup")
+        if any(br.state == "open" for br in state.breakers.values()):
+            break
+    breaker_states = {
+        (k.hex() if hasattr(k, "hex") else str(k)): br.state
+        for k, br in state.breakers.items()
+    }
+    assert "open" in breaker_states.values(), breaker_states
+
+    # Phase 2 (steady): breaker open -> traffic on the healthy replica;
+    # goodput of in-deadline requests >= 95% (the occasional half-open
+    # probe may still burn one request on the sick replica — that's the
+    # probe doing its job, and it's why the phase is 60 requests wide).
+    ok, expired = drive(60, 0.35, "steady")
+    goodput = len(ok) / 60.0
+    assert goodput >= 0.95, (goodput, expired)
+    assert len(set(ok)) == 1, "traffic must have shifted off the sick one"
+
+    # Expired requests provably never executed user code.
+    executed = open(marker).read()
+    for rid in expired:
+        assert rid not in executed, f"expired request {rid} executed"
+
+    # Phase 3 (heal): disarm, wait out the open window, drive probes —
+    # the breaker closes and BOTH replicas serve again.
+    _arm([])
+    deadline = time.time() + 30
+    healed = False
+    while time.time() < deadline:
+        ok, _ = drive(6, 2.0, "heal")
+        if len({pid for pid in ok}) == 2:
+            healed = True
+            break
+        time.sleep(0.5)
+    assert healed, "half-open probes must re-admit the healed replica"
+    assert all(br.state == "closed" for br in state.breakers.values())
+
+
+def test_controller_ejects_persistently_open_replica(serve_cluster):
+    """Replicas whose breakers stay open are ejected through the drain
+    machinery (surge-replace): the controller swaps in a fresh replica
+    and retires the sick one."""
+    import ray_tpu as rt
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    @serve.deployment(num_replicas=2)
+    def echo(x):
+        return x
+
+    handle = serve.run(echo.bind(), name="ejectable")
+    controller = rt.get_actor(CONTROLLER_NAME)
+    routing = rt.get(controller.get_routing.remote("ejectable"),
+                     timeout=30)
+    victim_hex = routing["replicas"][0]._actor_id.hex()
+
+    # Shrink the ejection threshold inside the controller process.
+    rt.get(controller.set_breaker_eject_s.remote(0.5), timeout=30)
+    # Report the victim's breaker OPEN continuously (fresh reports with
+    # an old first-seen), like a handle's refresh loop would.
+    for _ in range(6):
+        rt.get(controller.report_breakers.remote(
+            "ejectable", "test-handle", {victim_hex: "open"}
+        ), timeout=30)
+        time.sleep(0.3)
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        routing = rt.get(controller.get_routing.remote("ejectable"),
+                         timeout=30)
+        hexes = {r._actor_id.hex() for r in routing["replicas"]}
+        if victim_hex not in hexes and len(hexes) == 2:
+            break
+        time.sleep(0.3)
+    else:
+        raise AssertionError("sick replica was never ejected/replaced")
+    # Deployment still answers.
+    assert handle.remote("alive").result(timeout=30) == "alive"

@@ -477,6 +477,100 @@ def validate_drain_config():
     ]
 
 
+# ---- serve overload-control lint -----------------------------------------
+# The request-robustness plane's metric surface (serve/_telemetry.py)
+# and config knobs (README documents both; a rename must fail CI).
+
+OVERLOAD_METRICS = {
+    "ray_tpu_serve_shed_total": "counter",
+    "ray_tpu_serve_deadline_exceeded_total": "counter",
+    "ray_tpu_serve_breaker_state": "gauge",
+    "ray_tpu_serve_retries_total": "counter",
+}
+
+OVERLOAD_CONFIG_KEYS = (
+    "serve_default_request_timeout_s", "serve_proxy_concurrency",
+    "serve_shed_queue_len", "serve_aimd_latency_target_s",
+    "serve_breaker_error_threshold", "serve_breaker_min_volume",
+    "serve_breaker_open_s", "serve_breaker_eject_s",
+    "serve_retry_budget_ratio",
+)
+
+
+def validate_overload_metrics(declared):
+    failures = []
+    for name, kind in sorted(OVERLOAD_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: serve overload-control metric not declared "
+                f"(serve/_telemetry.py drifted from the documented "
+                f"surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    return failures
+
+
+def validate_overload_config():
+    import dataclasses
+
+    from ray_tpu.core.config import Config
+
+    fields = {f.name for f in dataclasses.fields(Config)}
+    return [
+        f"core/config.py: serve overload config key {key!r} missing "
+        f"from Config (documented knob drifted from the flag table)"
+        for key in OVERLOAD_CONFIG_KEYS if key not in fields
+    ]
+
+
+# The serve REQUEST-PATH modules (control-plane waits in controller.py /
+# api.py — deploys, drains, health checks — are exempt: they are not
+# bounded by a request's budget).
+SERVE_REQUEST_PATH_FILES = (
+    "asgi_ingress.py", "dag_driver.py", "grpc_ingress.py",
+    "http_proxy.py", "handle.py",
+)
+
+
+def validate_serve_no_hardcoded_timeouts(pkg_dir):
+    """The serve request path's timeouts derive from ONE source of
+    truth (serve_default_request_timeout_s seeding the deadline budget,
+    util/overload.remaining() at wait sites). Flag any ``timeout=<num>``
+    literal >= 30s creeping back into request-path calls."""
+    failures = []
+    checked = 0
+    serve_dir = os.path.join(pkg_dir, "serve")
+    for fname in SERVE_REQUEST_PATH_FILES:
+        path = os.path.join(serve_dir, fname)
+        with open(path) as f:
+            try:
+                tree = ast.parse(f.read(), filename=path)
+            except SyntaxError as e:
+                failures.append(f"{path}: unparseable ({e})")
+                continue
+        checked += 1
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "timeout" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, (int, float)) and \
+                        kw.value.value >= 30:
+                    failures.append(
+                        f"ray_tpu/serve/{fname}:{node.lineno}: "
+                        f"hard-coded timeout={kw.value.value} — serve "
+                        f"request-path waits must derive from the "
+                        f"deadline budget (util/overload.remaining) "
+                        f"seeded by serve_default_request_timeout_s"
+                    )
+    return failures, checked
+
+
 # ---- serve handle hot-path lint ------------------------------------------
 # The serve request hot path must stay free of blocking node-manager
 # round-trips: with the direct actor-call plane, a steady-state request
@@ -669,6 +763,16 @@ def main() -> int:
     print(f"checked {n_fire} faults.fire() site(s) against the "
           f"injection-point registry, {len(DRAIN_CONFIG_KEYS)} drain "
           f"config key(s)")
+
+    failures += validate_overload_metrics(declared)
+    failures += validate_overload_config()
+    timeout_failures, n_serve_files = validate_serve_no_hardcoded_timeouts(
+        os.path.join(repo_root, "ray_tpu")
+    )
+    failures += timeout_failures
+    print(f"checked {len(OVERLOAD_METRICS)} overload metric name(s), "
+          f"{len(OVERLOAD_CONFIG_KEYS)} overload config key(s), "
+          f"{n_serve_files} serve module(s) for hard-coded timeouts")
 
     if failures:
         for f in failures:

@@ -1,0 +1,231 @@
+#!/usr/bin/env python3
+"""Overload-control bench: the ISSUE-7 acceptance scenario, measured.
+
+Boots a single-node runtime, deploys a 2-replica deployment, then:
+
+  phase 1 (baseline)  closed-loop load, generous budget -> goodput/p99
+  phase 2 (chaos)     `serve_replica` latency armed on ONE replica
+                      (match-scoped), sustained load under a tight
+                      per-request deadline -> the sick replica's breaker
+                      opens, traffic shifts, goodput recovers; accepted
+                      requests keep a bounded p99 (shed, don't queue)
+  phase 3 (heal)      disarm -> half-open probes re-admit the replica;
+                      recovery time until both replicas serve again
+
+Writes a JSON record (argv[1], default stdout) with an `acceptance`
+block the overload test matrix mirrors.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def pctl(values, p):
+    if not values:
+        return None
+    vs = sorted(values)
+    return vs[min(len(vs) - 1, int(p / 100.0 * len(vs)))]
+
+
+def drive(handle, n, budget_s, concurrency=8):
+    """Closed-loop load: n requests under budget_s each; returns
+    (ok_results, failures, latencies_of_ok)."""
+    from ray_tpu.core.exceptions import (
+        DeadlineExceededError,
+        OverloadedError,
+    )
+    from ray_tpu.util import overload
+
+    ok, failures, lats = [], [], []
+    lock = threading.Lock()
+    it = iter(range(n))
+
+    def worker():
+        while True:
+            with lock:
+                try:
+                    i = next(it)
+                except StopIteration:
+                    return
+            t0 = time.monotonic()
+            with overload.deadline_scope(time.time() + budget_s):
+                fut = handle.remote(i)
+            try:
+                pid = fut.result(timeout=30)
+                with lock:
+                    ok.append(pid)
+                    lats.append(time.monotonic() - t0)
+            except (DeadlineExceededError, OverloadedError,
+                    TimeoutError) as e:
+                with lock:
+                    failures.append(type(e).__name__)
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return ok, failures, lats
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu import serve
+
+    record = {
+        "bench": "overload_control",
+        "config": {
+            "replicas": 2, "chaos_delay_s": 0.5, "tight_budget_s": 0.3,
+            "baseline_budget_s": 2.0,
+        },
+    }
+    ray_tpu.init(num_cpus=4, system_config={"log_to_driver": False})
+    try:
+        @serve.deployment(num_replicas=2, max_concurrent_queries=4,
+                          ray_actor_options={"max_concurrency": 4})
+        class Echo:
+            def __call__(self, i):
+                return os.getpid()
+
+        handle = serve.run(Echo.bind(), name="overload-bench")
+        state = handle._state
+
+        # ---- phase 1: baseline --------------------------------------
+        ok, failures, lats = drive(handle, 80, 2.0)
+        record["baseline"] = {
+            "requests": 80, "ok": len(ok), "failed": len(failures),
+            "goodput": len(ok) / 80.0,
+            "p50_ms": round(1e3 * pctl(lats, 50), 2),
+            "p99_ms": round(1e3 * pctl(lats, 99), 2),
+            "replicas_seen": len(set(ok)),
+        }
+
+        # ---- phase 2: chaos latency on one replica ------------------
+        stats = [ray_tpu.get(r.stats.remote(), timeout=30)
+                 for r in list(state.replicas)]
+        sick_id = stats[0]["replica_id"]
+        nm = ray_tpu.core.runtime_context.current_runtime()._nm
+        nm.call_sync(nm._gcs.chaos_arm([{
+            "point": "serve_replica", "mode": "always",
+            "action": "latency", "delay_s": 0.5,
+            "match": {"replica": sick_id},
+        }]), timeout=30)
+        time.sleep(1.0)  # plan propagation
+
+        # Warmup is SEQUENTIAL: under concurrency, p2c's queue-depth
+        # signal already steers around the slow replica (depth masks
+        # sickness); depth-0 traffic is what drives failures into the
+        # breaker. Drive until it opens (bounded): the baseline phase
+        # left a window of successes the failures must outweigh, so
+        # time-to-open is itself a bench output.
+        t_open0 = time.monotonic()
+        w_ok, w_fail = [], []
+        time_to_open_s = None
+        while time.monotonic() - t_open0 < 30.0:
+            o, f, _ = drive(handle, 6, 0.3, concurrency=1)
+            w_ok += o
+            w_fail += f
+            if any(br.state == "open"
+                   for br in state.breakers.values()):
+                time_to_open_s = time.monotonic() - t_open0
+                break
+        breaker_states = {
+            (k.hex() if hasattr(k, "hex") else str(k)): br.state
+            for k, br in state.breakers.items()
+        }
+        s_ok, s_fail, s_lats = drive(handle, 120, 0.3)  # steady
+        record["chaos"] = {
+            "sick_replica": sick_id,
+            "warmup": {"ok": len(w_ok), "failed": len(w_fail)},
+            "time_to_breaker_open_s": (
+                round(time_to_open_s, 2)
+                if time_to_open_s is not None else None
+            ),
+            "breaker_states_after_warmup": breaker_states,
+            "steady": {
+                "requests": 120, "ok": len(s_ok),
+                "failed": len(s_fail),
+                "goodput": len(s_ok) / 120.0,
+                "accepted_p99_ms": round(1e3 * pctl(s_lats, 99), 2),
+                "replicas_seen": len(set(s_ok)),
+            },
+        }
+
+        # ---- phase 3: heal ------------------------------------------
+        nm.call_sync(nm._gcs.chaos_arm([]), timeout=30)
+        t_heal = time.monotonic()
+        recovered_s = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            h_ok, _, _ = drive(handle, 8, 2.0, concurrency=4)
+            if len(set(h_ok)) == 2:
+                recovered_s = time.monotonic() - t_heal
+                break
+            time.sleep(0.5)
+        record["heal"] = {
+            "recovered": recovered_s is not None,
+            "recovery_s": (round(recovered_s, 2)
+                           if recovered_s is not None else None),
+            "breaker_states": {
+                (k.hex() if hasattr(k, "hex") else str(k)): br.state
+                for k, br in state.breakers.items()
+            },
+        }
+
+        # ---- overload counters from the metrics pipeline ------------
+        from ray_tpu.util.metrics import get_metrics_report
+
+        report = get_metrics_report()
+
+        def total(name):
+            return sum(
+                v for v in report.get(name, {}).get("series", {}).values()
+                if isinstance(v, (int, float))
+            )
+
+        record["counters"] = {
+            "shed_total": total("ray_tpu_serve_shed_total"),
+            "deadline_exceeded_total":
+                total("ray_tpu_serve_deadline_exceeded_total"),
+            "retries_total": total("ray_tpu_serve_retries_total"),
+        }
+
+        steady = record["chaos"]["steady"]
+        record["acceptance"] = {
+            "breaker_opened":
+                "open" in record["chaos"]
+                ["breaker_states_after_warmup"].values(),
+            "steady_goodput_ge_95pct": steady["goodput"] >= 0.95,
+            "accepted_p99_bounded":
+                steady["accepted_p99_ms"] is not None
+                and steady["accepted_p99_ms"] < 1000.0,
+            "healed_replica_readmitted": record["heal"]["recovered"],
+        }
+        record["ok"] = all(record["acceptance"].values())
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+    out = json.dumps(record, indent=2, sort_keys=True)
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            f.write(out + "\n")
+        print(f"wrote {sys.argv[1]}")
+    print(out)
+    return 0 if record.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
