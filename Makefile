@@ -10,8 +10,10 @@ LDLIBS   := -lpthread -lrt
 
 STORE_SRC := src/store/rts_store.cc
 EXT       := ray_tpu/_native/_rtstore.so
+PUMP_SRC  := src/pump/rts_pump.cc
+PUMP_EXT  := ray_tpu/_native/_rtpump.so
 
-.PHONY: native native-test cpp-client clean check-obs check-metrics perf-transfer perf-actor chaos overload
+.PHONY: native native-test cpp-client clean check-obs check-metrics perf-transfer perf-actor perf-native chaos overload
 
 # Observability lint: every Counter/Gauge/Histogram the package declares
 # at import time (Prometheus-valid names, counters end in _total, no
@@ -47,12 +49,19 @@ perf-transfer:
 	JAX_PLATFORMS=cpu $(PY) tools/run_transfer_bench.py
 
 # Direct actor-call plane bench: loaded + unloaded sync round-trips over
-# the direct channel vs the NM-mediated path, fallback-injection
-# recovery, and the rpc dispatch micro-bench — recorded to PERF_r07.json.
+# the direct channel (native pump engaged AND RTPU_NO_NATIVE=1 fallback)
+# vs the NM-mediated path, fallback-injection recovery, and the rpc
+# dispatch micro-bench — merged into PERF_r08.json.
 perf-actor:
-	JAX_PLATFORMS=cpu $(PY) tools/run_actor_bench.py PERF_r07.json
+	JAX_PLATFORMS=cpu $(PY) tools/run_actor_bench.py PERF_r08.json
 
-native: $(EXT)
+# Native frame-pump bench: codec microbench vs pickle on the compact
+# call frame, pump framing throughput, and the queued-task drain probe
+# — merged into PERF_r08.json beside the perf-actor record.
+perf-native:
+	JAX_PLATFORMS=cpu $(PY) tools/run_native_bench.py PERF_r08.json
+
+native: $(EXT) $(PUMP_EXT)
 
 # C++ client frontend (ref analogue: the reference's cpp/ worker API):
 # zero-copy arena object plane + JSON control channel. `make cpp-client`
@@ -68,20 +77,32 @@ $(EXT): $(STORE_SRC) src/store/_rtstore_module.cc src/store/rts_store.h
 	$(CXX) $(CXXFLAGS) -shared -I$(PY_INC) -Isrc/store \
 	  $(STORE_SRC) src/store/_rtstore_module.cc -o $@ $(LDLIBS)
 
+$(PUMP_EXT): $(PUMP_SRC) src/pump/_rtpump_module.cc src/pump/rts_pump.h
+	$(CXX) $(CXXFLAGS) -shared -I$(PY_INC) -Isrc/pump \
+	  $(PUMP_SRC) src/pump/_rtpump_module.cc -o $@ $(LDLIBS)
+
 build/rts_store_test: $(STORE_SRC) src/store/rts_store_test.cc src/store/rts_store.h
 	@mkdir -p build
 	$(CXX) $(CXXFLAGS) -Isrc/store $(STORE_SRC) src/store/rts_store_test.cc \
 	  -o $@ $(LDLIBS)
 
-native-test: build/rts_store_test
+build/rts_pump_test: $(PUMP_SRC) src/pump/rts_pump_test.cc src/pump/rts_pump.h
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -Isrc/pump $(PUMP_SRC) src/pump/rts_pump_test.cc \
+	  -o $@ $(LDLIBS)
+
+# CI-ready native gate: every C++ unit test (store + pump) plain AND
+# under both sanitizers — any report fails the target (halt_on_error).
+native-test: build/rts_store_test build/rts_pump_test native-tsan native-asan
 	./build/rts_store_test
+	./build/rts_pump_test
 
 clean:
-	rm -rf build $(EXT)
+	rm -rf build $(EXT) $(PUMP_EXT)
 
-# Sanitizer builds of the store test (ref analogue: the reference's
+# Sanitizer builds of the C++ unit tests (ref analogue: the reference's
 # TSAN/ASAN CI jobs over the C++ core). `make native-tsan native-asan`
-# runs the full store test under each sanitizer.
+# runs the store AND pump tests under each sanitizer.
 build/rts_store_test_tsan: $(STORE_SRC) src/store/rts_store_test.cc src/store/rts_store.h
 	@mkdir -p build
 	$(CXX) $(CXXFLAGS) -fsanitize=thread -Isrc/store $(STORE_SRC) \
@@ -92,10 +113,22 @@ build/rts_store_test_asan: $(STORE_SRC) src/store/rts_store_test.cc src/store/rt
 	$(CXX) $(CXXFLAGS) -fsanitize=address,undefined -Isrc/store $(STORE_SRC) \
 	  src/store/rts_store_test.cc -o $@ $(LDLIBS)
 
-native-tsan: build/rts_store_test_tsan
-	TSAN_OPTIONS=halt_on_error=1 ./build/rts_store_test_tsan
+build/rts_pump_test_tsan: $(PUMP_SRC) src/pump/rts_pump_test.cc src/pump/rts_pump.h
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -fsanitize=thread -Isrc/pump $(PUMP_SRC) \
+	  src/pump/rts_pump_test.cc -o $@ $(LDLIBS)
 
-native-asan: build/rts_store_test_asan
-	ASAN_OPTIONS=detect_leaks=1 ./build/rts_store_test_asan
+build/rts_pump_test_asan: $(PUMP_SRC) src/pump/rts_pump_test.cc src/pump/rts_pump.h
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -fsanitize=address,undefined -Isrc/pump $(PUMP_SRC) \
+	  src/pump/rts_pump_test.cc -o $@ $(LDLIBS)
+
+native-tsan: build/rts_store_test_tsan build/rts_pump_test_tsan
+	TSAN_OPTIONS=halt_on_error=1 ./build/rts_store_test_tsan
+	TSAN_OPTIONS=halt_on_error=1 ./build/rts_pump_test_tsan
+
+native-asan: build/rts_store_test_asan build/rts_pump_test_asan
+	ASAN_OPTIONS=detect_leaks=1:halt_on_error=1 ./build/rts_store_test_asan
+	ASAN_OPTIONS=detect_leaks=1:halt_on_error=1 ./build/rts_pump_test_asan
 
 sanitize: native-tsan native-asan

@@ -1,32 +1,38 @@
 """Loader for ray_tpu's native (C++) components.
 
-The CPython extension ``_rtstore`` (shared-memory object store, see
-src/store/) is built in-place by the repo Makefile. On first import, if the
-.so is missing and a toolchain is available, we build it on demand; callers
-fall back to the pure-Python store when the native module is unavailable, so
-the framework works (slower) on machines without g++.
+Two CPython extensions are built in-place by the repo Makefile:
+
+* ``_rtstore`` — shared-memory object store (src/store/)
+* ``_rtpump``  — direct-plane frame pump: framed-channel I/O, call-frame
+  codec, per-channel seq dispatch (src/pump/)
+
+On first import, if a .so is missing and a toolchain is available, we build
+on demand; callers fall back to the pure-Python implementations when a
+native module is unavailable, so the framework works (slower) on machines
+without g++. ``RAY_TPU_NO_NATIVE_BUILD=1`` suppresses the on-demand build;
+``RTPU_NO_NATIVE=1`` makes the frame-pump callers ignore the extension even
+when present (see core/frame_pump.py).
 """
 
 from __future__ import annotations
 
+import importlib
 import os
 import subprocess
 import sys
 import threading
 
 _lock = threading.Lock()
-_rtstore_mod = None
+_mods: dict = {}
 _build_attempted = False
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
 
 
-def _try_import():
+def _try_import(name: str):
     try:
-        from . import _rtstore  # type: ignore
-
-        return _rtstore
+        return importlib.import_module(f".{name}", __name__)
     except ImportError:
         return None
 
@@ -46,18 +52,31 @@ def _try_build() -> bool:
         return False
 
 
-def load_rtstore():
-    """Return the _rtstore extension module, building it if needed, or None."""
-    global _rtstore_mod, _build_attempted
+def _load(name: str):
+    """Return the named extension module, building once if needed."""
+    global _build_attempted
     with _lock:
-        if _rtstore_mod is not None:
-            return _rtstore_mod
-        _rtstore_mod = _try_import()
-        if _rtstore_mod is None and not _build_attempted:
+        mod = _mods.get(name)
+        if mod is not None:
+            return mod
+        mod = _try_import(name)
+        if mod is None and not _build_attempted:
             _build_attempted = True
             if os.environ.get("RAY_TPU_NO_NATIVE_BUILD") != "1" and _try_build():
-                _rtstore_mod = _try_import()
-        return _rtstore_mod
+                mod = _try_import(name)
+        if mod is not None:
+            _mods[name] = mod
+        return mod
+
+
+def load_rtstore():
+    """The _rtstore extension module, building it if needed, or None."""
+    return _load("_rtstore")
+
+
+def load_rtpump():
+    """The _rtpump extension module, building it if needed, or None."""
+    return _load("_rtpump")
 
 
 def native_store_available() -> bool:

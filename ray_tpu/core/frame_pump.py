@@ -1,0 +1,630 @@
+"""Bindings for the native frame pump (src/pump/) + its pure-Python mirror.
+
+Three surfaces, all with the PR 4/PR 5 fallback discipline (missing
+``.so``, codec version mismatch, or any pump error drops the channel back
+to the pure-Python path, counted in ``ray_tpu_native_fallbacks_total``):
+
+* **Framed-channel pump** — :class:`NativeFramedConnection` wraps an
+  already-handshaken :class:`~.protocol.Connection`: reads are buffered and
+  GIL-released in C (one ``read(2)`` slices out many frames), a burst of
+  queued small frames coalesces into one ``writev(2)`` with zero
+  concatenation copies.
+* **Call-frame codec** — the direct plane's hot dialect (compact call
+  frames, task_done/completion batches, fence/ack) encodes straight
+  to/from C structs, no pickle. Native frames start with ``MAGIC`` (0xA7),
+  which no pickle payload can start with (protocol 2+ pickles begin with
+  0x80), so pickle and native frames interleave on one channel and
+  ``protocol.loads_msg`` sniffs the dialect per frame. The byte layout is
+  mirrored here in pure Python (``py_encode_* / py_decode``) — the fuzz
+  parity test in tests/test_native_pump.py holds the two byte-identical.
+* **Seq dispatch queue** — the per-channel monotonic-sequence admission
+  state (out-of-order parking, replay-duplicate drop) runs in the
+  extension; :class:`PySeqQueue` is the drop-in fallback.
+
+``RTPU_NO_NATIVE=1`` disables all of it (the direct plane then runs the
+pure-Python pickle dialect end to end). This module is pickle-banned the
+same way core/data_channel.py is (tools/check_metric_names.py): generic
+control messages keep riding protocol.dumps_msg at the call sites.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..util.metrics import Counter as _MetricCounter
+from ..util.metrics import Gauge as _MetricGauge
+from .protocol import (MAX_FRAME, Connection, ConnectionClosed,
+                       dumps_msg, loads_msg)
+
+MAGIC = 0xA7
+CODEC_VER = 1
+
+F_CALL = 0x01
+F_DONE = 0x02
+F_DONE_BATCH = 0x03
+F_FENCE = 0x04
+F_FENCE_ACK = 0x05
+
+_ARG_REF = 0
+_ARG_VALUE = 1
+_HAS_ARGS = 0x01
+_HAS_NESTED = 0x02
+
+# ---- metric surface (declared at import for tools/check_metric_names.py) ---
+
+_NATIVE_FALLBACKS = _MetricCounter(
+    "ray_tpu_native_fallbacks_total",
+    "Channels (or frames) that dropped from the native frame pump back "
+    "to the pure-Python path "
+    "(reason=disabled|unavailable|no_peer|tls|pump_error|codec_error)",
+    tag_keys=("reason",),
+)
+_PUMP_CHANNELS = _MetricGauge(
+    "ray_tpu_native_pump_channels",
+    "Channels currently running on the native frame pump in this process",
+    tag_keys=("pid",),
+)
+_FALLBACK = {
+    reason: _NATIVE_FALLBACKS.with_tags(reason=reason)
+    for reason in ("disabled", "unavailable", "no_peer", "tls",
+                   "pump_error", "codec_error")
+}
+_PUMP_GAUGE = _PUMP_CHANNELS.with_tags(pid=str(os.getpid()))
+
+_engaged_lock = threading.Lock()
+_engaged_count = 0
+# Process-local mirrors for cheap introspection (bench/tests).
+_fallback_counts: Dict[str, int] = {}
+
+
+def count_fallback(reason: str) -> None:
+    """One channel (or frame) fell back to the pure-Python path."""
+    handle = _FALLBACK.get(reason)
+    if handle is not None:
+        handle.inc()
+    else:  # pragma: no cover - unknown reason still counted
+        _NATIVE_FALLBACKS.inc(tags={"reason": reason})
+    with _engaged_lock:
+        _fallback_counts[reason] = _fallback_counts.get(reason, 0) + 1
+
+
+def _engaged_delta(delta: int) -> None:
+    global _engaged_count
+    with _engaged_lock:
+        _engaged_count += delta
+        _PUMP_GAUGE.set(_engaged_count)
+
+
+def pump_stats() -> Dict[str, Any]:
+    """Process-local snapshot (tools/run_actor_bench.py, tests)."""
+    with _engaged_lock:
+        return {
+            "engaged_channels": _engaged_count,
+            "fallbacks": dict(_fallback_counts),
+            "native_loaded": _mod is not None,
+        }
+
+
+# ---- native module loading -------------------------------------------------
+
+_mod = None
+_load_tried = False
+_load_lock = threading.Lock()
+
+
+def disabled() -> bool:
+    return os.environ.get("RTPU_NO_NATIVE") == "1"
+
+
+def _module():
+    """The _rtpump extension with codec types registered, or None."""
+    global _mod, _load_tried
+    if _mod is not None or _load_tried:
+        return _mod
+    with _load_lock:
+        if _load_tried:
+            return _mod
+        from .._native import load_rtpump
+
+        mod = load_rtpump()
+        if mod is not None:
+            from .ids import ObjectID, TaskID
+            from .object_store import InlineLocation
+            from .task_spec import RefArg, ValueArg
+
+            mod.register_types(RefArg, ValueArg, ObjectID, TaskID,
+                               InlineLocation)
+        _mod = mod
+        _load_tried = True
+        return _mod
+
+
+def available() -> bool:
+    """Native pump usable in this process (RTPU_NO_NATIVE honored)."""
+    if disabled():
+        return False
+    return _module() is not None
+
+
+def advertised_ver() -> int:
+    """The codec version to advertise in the direct hello ("npv");
+    0 = this side will not speak the native dialect."""
+    return CODEC_VER if available() else 0
+
+
+# ---- codec dispatch (native when loaded, mirror otherwise) -----------------
+
+
+def encode_call(tmpl: int, task_id: bytes, seq: int, deadline: float,
+                args, kwargs, nested) -> Optional[bytes]:
+    m = _module()
+    if m is not None:
+        return m.encode_call(tmpl, task_id, seq, deadline, args, kwargs,
+                             nested)
+    return py_encode_call(tmpl, task_id, seq, deadline, args, kwargs, nested)
+
+
+def encode_done(done: Dict[str, Any]) -> Optional[bytes]:
+    m = _module()
+    if m is not None:
+        return m.encode_done(done)
+    return py_encode_done(done)
+
+
+def encode_done_batch(items: List[Dict[str, Any]]) -> Optional[bytes]:
+    m = _module()
+    if m is not None:
+        return m.encode_done_batch(items)
+    return py_encode_done_batch(items)
+
+
+def encode_fence(msg_id: int) -> bytes:
+    m = _module()
+    if m is not None:
+        return m.encode_fence(msg_id)
+    return py_encode_fence(msg_id)
+
+
+def encode_fence_ack(msg_id: int) -> bytes:
+    m = _module()
+    if m is not None:
+        return m.encode_fence_ack(msg_id)
+    return py_encode_fence_ack(msg_id)
+
+
+def decode(payload: bytes) -> Dict[str, Any]:
+    m = _module()
+    if m is not None:
+        return m.decode(payload)
+    return py_decode(payload)
+
+
+def new_seq_queue():
+    m = _module()
+    if m is not None:
+        return m.seq_queue()
+    return PySeqQueue()
+
+
+# ---- pure-Python codec mirror ----------------------------------------------
+# Byte-identical to the C encoders (fuzz-checked); little-endian structs.
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_F64 = struct.Struct("<d")
+_CALL_HDR = struct.Struct("<BBIQ")  # magic, type, tmpl, seq
+
+
+def _py_lower_arg(out: bytearray, arg) -> bool:
+    from .task_spec import RefArg, ValueArg
+
+    if type(arg) is RefArg:
+        raw = arg.object_id.binary()
+        out.append(_ARG_REF)
+        out += _U32.pack(len(raw))
+        out += raw
+        return True
+    if type(arg) is ValueArg and type(arg.data) is bytes:
+        out.append(_ARG_VALUE)
+        out += _U32.pack(len(arg.data))
+        out += arg.data
+        return True
+    return False
+
+
+def py_encode_call(tmpl, task_id, seq, deadline, args, kwargs,
+                   nested) -> Optional[bytes]:
+    from .ids import ObjectID
+
+    if len(task_id) > 255:
+        return None
+    has_args = bool(args) or bool(kwargs)
+    has_nested = bool(nested)
+    out = bytearray(_CALL_HDR.pack(MAGIC, F_CALL, tmpl, seq))
+    out.append(len(task_id))
+    out += task_id
+    out += _F64.pack(deadline)
+    out.append((_HAS_ARGS if has_args else 0)
+               | (_HAS_NESTED if has_nested else 0))
+    if has_args:
+        if not isinstance(args, list) or (
+                kwargs is not None and not isinstance(kwargs, dict)):
+            return None
+        out += _U32.pack(len(args))
+        for a in args:
+            if not _py_lower_arg(out, a):
+                return None
+        out += _U32.pack(len(kwargs) if kwargs else 0)
+        for k, v in (kwargs or {}).items():
+            if not isinstance(k, str):
+                return None
+            kb = k.encode("utf-8")
+            if len(kb) > 0xFFFF:
+                return None
+            out += _U16.pack(len(kb))
+            out += kb
+            if not _py_lower_arg(out, v):
+                return None
+    if has_nested:
+        if not isinstance(nested, tuple):
+            return None
+        out += _U32.pack(len(nested))
+        for oid in nested:
+            if type(oid) is not ObjectID:
+                return None
+            raw = oid.binary()
+            if len(raw) > 255:
+                return None
+            out.append(len(raw))
+            out += raw
+    return bytes(out)
+
+
+_DONE_KEYS = {"type", "task_id", "results", "failed", "duration_s",
+              "duplicate"}
+
+
+def _py_done_body(out: bytearray, done: Dict[str, Any]) -> bool:
+    from .ids import ObjectID, TaskID
+    from .object_store import InlineLocation
+
+    if not isinstance(done, dict) or not _DONE_KEYS.issuperset(done):
+        return False
+    if done.get("type") != "task_done" or done.get("failed"):
+        return False
+    task_id = done.get("task_id")
+    results = done.get("results")
+    if type(task_id) is not TaskID or not isinstance(results, list):
+        return False
+    raw = task_id.binary()
+    if len(raw) > 255:
+        return False
+    out.append(len(raw))
+    out += raw
+    out.append(0)  # flags: failed dones stay on the pickle dialect
+    out += _F64.pack(float(done.get("duration_s", 0.0)))
+    out += _U32.pack(len(results))
+    for pair in results:
+        if not isinstance(pair, tuple) or len(pair) != 2:
+            return False
+        oid, loc = pair
+        if type(oid) is not ObjectID or type(loc) is not InlineLocation:
+            return False
+        oraw = oid.binary()
+        if len(oraw) > 255 or type(loc.data) is not bytes:
+            return False
+        out.append(len(oraw))
+        out += oraw
+        out += _U32.pack(len(loc.data))
+        out += loc.data
+    return True
+
+
+def py_encode_done(done: Dict[str, Any]) -> Optional[bytes]:
+    out = bytearray((MAGIC, F_DONE))
+    if not _py_done_body(out, done):
+        return None
+    return bytes(out)
+
+
+def py_encode_done_batch(items: List[Dict[str, Any]]) -> Optional[bytes]:
+    out = bytearray((MAGIC, F_DONE_BATCH))
+    out += _U32.pack(len(items))
+    for done in items:
+        if not _py_done_body(out, done):
+            return None
+    return bytes(out)
+
+
+def py_encode_fence(msg_id: int) -> bytes:
+    return bytes((MAGIC, F_FENCE)) + _U64.pack(msg_id)
+
+
+def py_encode_fence_ack(msg_id: int) -> bytes:
+    return bytes((MAGIC, F_FENCE_ACK)) + _U64.pack(msg_id)
+
+
+class _Cursor:
+    __slots__ = ("b", "pos")
+
+    def __init__(self, b: bytes):
+        self.b = b
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.b):
+            raise ValueError("malformed native frame")
+        out = self.b[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def u16(self) -> int:
+        return _U16.unpack(self.take(2))[0]
+
+    def u32(self) -> int:
+        return _U32.unpack(self.take(4))[0]
+
+    def u64(self) -> int:
+        return _U64.unpack(self.take(8))[0]
+
+    def f64(self) -> float:
+        return _F64.unpack(self.take(8))[0]
+
+
+def _py_read_arg(c: _Cursor):
+    from .ids import ObjectID
+    from .task_spec import RefArg, ValueArg
+
+    kind = c.u8()
+    raw = c.take(c.u32())
+    if kind == _ARG_REF:
+        return RefArg(ObjectID(raw))
+    if kind == _ARG_VALUE:
+        return ValueArg(raw)
+    raise ValueError("malformed native frame")
+
+
+def _py_decode_call(c: _Cursor) -> Dict[str, Any]:
+    from .ids import ObjectID
+
+    tmpl = c.u32()
+    seq = c.u64()
+    tid = c.take(c.u8())
+    deadline = c.f64()
+    flags = c.u8()
+    out: Dict[str, Any] = {"type": "execute", "t": tmpl, "i": tid, "q": seq}
+    if deadline != 0.0:
+        out["d"] = deadline
+    if flags & _HAS_ARGS:
+        args = [_py_read_arg(c) for _ in range(c.u32())]
+        kwargs = {}
+        for _ in range(c.u32()):
+            key = c.take(c.u16()).decode("utf-8")
+            kwargs[key] = _py_read_arg(c)
+        out["a"] = (args, kwargs)
+    if flags & _HAS_NESTED:
+        out["n"] = tuple(
+            ObjectID(c.take(c.u8())) for _ in range(c.u32())
+        )
+    return out
+
+
+def _py_decode_done(c: _Cursor) -> Dict[str, Any]:
+    from .ids import ObjectID, TaskID
+    from .object_store import InlineLocation
+
+    tid = TaskID(c.take(c.u8()))
+    flags = c.u8()
+    duration = c.f64()
+    results = []
+    for _ in range(c.u32()):
+        oid = ObjectID(c.take(c.u8()))
+        results.append((oid, InlineLocation(c.take(c.u32()))))
+    return {
+        "type": "task_done",
+        "task_id": tid,
+        "results": results,
+        "failed": bool(flags & 0x01),
+        "duration_s": duration,
+    }
+
+
+def py_decode(payload: bytes) -> Dict[str, Any]:
+    c = _Cursor(bytes(payload))
+    if c.u8() != MAGIC:
+        raise ValueError("malformed native frame")
+    ftype = c.u8()
+    if ftype == F_CALL:
+        return _py_decode_call(c)
+    if ftype == F_DONE:
+        return _py_decode_done(c)
+    if ftype == F_DONE_BATCH:
+        return {
+            "type": "task_done_batch",
+            "items": [_py_decode_done(c) for _ in range(c.u32())],
+        }
+    if ftype == F_FENCE:
+        return {"type": "fence", "msg_id": c.u64()}
+    if ftype == F_FENCE_ACK:
+        return {"type": "fence_ack", "msg_id": c.u64()}
+    raise ValueError("malformed native frame")
+
+
+# ---- sequence dispatch fallback --------------------------------------------
+
+
+class PySeqQueue:
+    """Pure-Python mirror of the extension's SeqQueue: in-order
+    admission, out-of-order parking, duplicate drop (seq below
+    ``expected`` = a frame that already executed before a failover)."""
+
+    __slots__ = ("expected", "_parked")
+
+    def __init__(self):
+        self.expected = 1
+        self._parked: Dict[int, Any] = {}
+
+    def push(self, seq: int, item) -> List[Any]:
+        if seq < self.expected:
+            return []  # duplicate of an executed frame: drop
+        if seq != self.expected:
+            # Keep the FIRST delivery of a parked seq (matches the
+            # extension: a re-delivered parked seq is a duplicate).
+            self._parked.setdefault(seq, item)
+            return []
+        out = [item]
+        self.expected += 1
+        while self.expected in self._parked:
+            out.append(self._parked.pop(self.expected))
+            self.expected += 1
+        return out
+
+    @property
+    def parked(self) -> int:
+        return len(self._parked)
+
+
+# ---- native framed connection ----------------------------------------------
+
+
+class NativeFramedConnection(Connection):
+    """A :class:`Connection` whose framing runs in the C pump. Adopted
+    from a plain Connection AFTER its handshake completed (nothing else
+    may touch the socket afterwards — the pump reads ahead). recv()
+    decodes through protocol.loads_msg, so pickle and native frames mix
+    freely on the wire."""
+
+    native = True
+
+    def __init__(self, conn: Connection):
+        mod = _module()
+        if mod is None:
+            raise RuntimeError("native pump unavailable")
+        sock = conn._sock
+        if sock.gettimeout() is not None:
+            # The pump drives the raw fd: it must stay in blocking mode
+            # (Python socket timeouts flip the fd non-blocking).
+            sock.settimeout(None)
+        self._sock = sock
+        self._send_lock = conn._send_lock
+        self._recv_lock = conn._recv_lock
+        self._chan = mod.chan(sock.fileno())
+        self._closed = False
+        _engaged_delta(+1)
+
+    def send(self, message: Dict[str, Any]):
+        payload = dumps_msg(message)
+        if len(payload) >= MAX_FRAME:
+            raise ValueError("message too large for frame")
+        with self._send_lock:
+            try:
+                self._chan.send(payload)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
+
+    def send_payloads(self, payloads: List[bytes]):
+        """Ship a burst of already-encoded frame payloads in one
+        coalesced writev — the flush path of the direct channel."""
+        with self._send_lock:
+            try:
+                self._chan.send_many(payloads)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
+
+    def recv(self) -> Dict[str, Any]:
+        with self._recv_lock:
+            try:
+                payload = self._chan.recv()
+            except (ConnectionError, TimeoutError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
+        return loads_msg(payload)
+
+    def buffered(self) -> int:
+        """Bytes read ahead of the consumed frames (reply-batching
+        probe: 0 = no more frames immediately available)."""
+        try:
+            return self._chan.buffered()
+        except ValueError:
+            return 0
+
+    def has_frame(self) -> bool:
+        """A COMPLETE frame is already buffered — recv() cannot block.
+        Lets the worker drain an arrived-together burst before
+        executing, without ever waiting on a partial frame."""
+        try:
+            return self._chan.has_frame()
+        except ValueError:
+            return False
+
+    def pump_io_stats(self) -> Dict[str, int]:
+        return self._chan.stats()
+
+    def inflight_add(self, delta: int) -> int:
+        """Atomic per-channel counter in the extension (delta 0 reads).
+        NOT the DIRECT_MAX_UNANSWERED authority — the pending table is
+        (replay correctness depends on it); this exists for external
+        accounting that must not take Python locks."""
+        return self._chan.inflight_add(delta)
+
+    def settimeout(self, timeout: Optional[float]):
+        # SO_RCVTIMEO keeps the fd blocking (socket.settimeout would
+        # flip it non-blocking and break the C read loop).
+        tv = struct.pack("ll", int(timeout or 0),
+                         int(((timeout or 0) % 1) * 1e6))
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            _engaged_delta(-1)
+        # shutdown(2) reaches every dup of the socket description, so a
+        # reader blocked in the pump wakes; the pump's dup fd itself is
+        # closed at Chan dealloc (never while a recv may be in flight).
+        try:
+            self._chan.shutdown()
+        except Exception:
+            pass
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def wrap_connection(conn: Connection) -> Optional[NativeFramedConnection]:
+    """Adopt ``conn`` onto the native pump, or None (with the fallback
+    counted) when the pump cannot engage: knob off, .so missing, or a
+    TLS socket (the pump moves raw fd bytes; TLS framing must stay in
+    Python)."""
+    if disabled():
+        count_fallback("disabled")
+        return None
+    if _module() is None:
+        count_fallback("unavailable")
+        return None
+    sock = getattr(conn, "_sock", None)
+    if sock is None or not isinstance(sock, socket.socket):
+        count_fallback("tls")
+        return None
+    try:
+        import ssl
+
+        if isinstance(sock, ssl.SSLSocket):
+            count_fallback("tls")
+            return None
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        return NativeFramedConnection(conn)
+    except Exception:
+        count_fallback("pump_error")
+        return None

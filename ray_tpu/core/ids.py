@@ -22,24 +22,36 @@ _ID_SIZE = 16
 # re-drawn after fork, so child processes never share a sequence) at
 # dict-increment cost. IDs shorter than 12 bytes keep plain urandom —
 # too few prefix bits to be collision-safe (JobID; rare anyway).
-_SEED = {"pid": None, "prefix": b""}
+_SEED = {"prefix": b""}
 _counter = itertools.count(1)
+
+
+def _reseed():
+    # After fork the child must never share the parent's sequence.
+    # Registered as a fork hook instead of a per-call getpid() check:
+    # getpid is a real syscall (~4us on sandboxed kernels) and this sits
+    # on the task-submit hot path (every actor call mints a TaskID).
+    global _counter
+    _SEED["prefix"] = b""
+    _counter = itertools.count(1)
+
+
+os.register_at_fork(after_in_child=_reseed)
 
 
 def _fast_unique(size: int) -> bytes:
     if size < 12:
         return os.urandom(size)
-    pid = os.getpid()
-    if _SEED["pid"] != pid:
-        _SEED["prefix"] = os.urandom(24)
-        _SEED["pid"] = pid
-    return _SEED["prefix"][: size - 8] + next(_counter).to_bytes(8, "little")
+    prefix = _SEED["prefix"]
+    if not prefix:
+        prefix = _SEED["prefix"] = os.urandom(24)
+    return prefix[: size - 8] + next(_counter).to_bytes(8, "little")
 
 
 class BaseID:
     """Immutable fixed-width binary identifier."""
 
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
     SIZE = _ID_SIZE
 
     def __init__(self, id_bytes: bytes):
@@ -48,6 +60,7 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
             )
         self._bytes = bytes(id_bytes)
+        self._hash = None
 
     @classmethod
     def from_random(cls):
@@ -71,7 +84,13 @@ class BaseID:
         return self._bytes == b"\x00" * self.SIZE
 
     def __hash__(self):
-        return hash(self._bytes)
+        # Memoized: ids key the directory/refcount/pending tables and get
+        # hashed tens of times per task across the control plane — the
+        # NM-loop profile showed 33 hash() calls per drained task.
+        h = self._hash
+        if h is None:
+            h = self._hash = hash(self._bytes)
+        return h
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes

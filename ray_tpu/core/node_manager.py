@@ -3465,9 +3465,19 @@ class NodeManager:
                     # it when the entry was unsealed by a node death.
                     await self._reconstruct_object(oid)
         if events:
-            waiters = [ev.wait() for ev in events if not ev.is_set()]
-            if waiters:
-                await asyncio.wait_for(asyncio.gather(*waiters), timeout)
+            if any(not ev.is_set() for ev in events):
+                # ONE task for the whole set (wait_for wraps the helper
+                # once) instead of gather's Task per object: a deep
+                # drain get() used to mint 1M asyncio Tasks here.
+                # Sequential awaits are equivalent — every event must be
+                # set before returning, and they fire independently of
+                # the await order.
+                async def _wait_all(evs=events):
+                    for ev in evs:
+                        if not ev.is_set():
+                            await ev.wait()
+
+                await asyncio.wait_for(_wait_all(), timeout)
         out: List[Tuple[ObjectID, Location]] = []
         for oid in object_ids:
             loc = self.directory.lookup(oid)

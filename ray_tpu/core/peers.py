@@ -106,6 +106,13 @@ class PeerClient:
                             op=msg.get("type"))
         if delay:
             await asyncio.sleep(delay)
+        # Deliberately the awaited send (write + drain), NOT the
+        # buffered send_nowait fast path: callers' recovery logic
+        # depends on transport errors propagating from here (e.g. the
+        # NM's _forward_send requeues a forwarded task when notify
+        # raises — a buffered write on a broken transport logs and
+        # drops, silently losing the task), and drain() is the only
+        # backpressure bound against a stalled peer.
         await self._writer.send(msg)
 
     def close(self):

@@ -48,6 +48,24 @@ def dumps_msg(message: Any) -> bytes:
         return cloudpickle.dumps(message, protocol=5)
 
 
+# First byte of a native-codec frame (core/frame_pump.py). A pickle
+# payload can never start with it (protocol 2+ pickles begin with 0x80),
+# so the two dialects interleave safely on one framed channel.
+_NATIVE_MAGIC = 0xA7
+
+
+def loads_msg(payload: bytes) -> Any:
+    """Decode one frame payload, sniffing the dialect: native-codec
+    frames (compact direct-plane dialect, see core/frame_pump.py) by
+    their magic byte, everything else pickle. Both dialects produce the
+    same dict shapes, so readers cannot tell them apart."""
+    if payload and payload[0] == _NATIVE_MAGIC:
+        from .frame_pump import decode
+
+        return decode(payload)
+    return pickle.loads(payload)
+
+
 class ConnectionClosed(Exception):
     pass
 
@@ -61,23 +79,45 @@ class Connection:
         self._recv_lock = threading.Lock()
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 20)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
+        # Scatter-gather send of header+payload without the per-frame
+        # concatenation copy. TLS sockets have no sendmsg (bytes must
+        # pass through the SSL layer) and keep the sendall path.
+        self._can_sendmsg = hasattr(sock, "sendmsg") and not isinstance(
+            sock, _ssl_socket_types()
+        )
 
     def send(self, message: Dict[str, Any]):
         payload = dumps_msg(message)
         if len(payload) >= MAX_FRAME:
             raise ValueError("message too large for frame")
+        header = _HEADER.pack(len(payload))
         with self._send_lock:
             try:
-                self._sock.sendall(_HEADER.pack(len(payload)) + payload)
+                if self._can_sendmsg:
+                    self._send_vec(header, payload)
+                else:
+                    self._sock.sendall(header + payload)
             except (BrokenPipeError, ConnectionResetError, OSError) as e:
                 raise ConnectionClosed(str(e)) from e
+
+    def _send_vec(self, header: bytes, payload: bytes):
+        """Two-element sendmsg with partial-write continuation (sendmsg
+        may stop mid-vector under backpressure)."""
+        bufs = [memoryview(header), memoryview(payload)]
+        while bufs:
+            sent = self._sock.sendmsg(bufs)
+            while bufs and sent >= len(bufs[0]):
+                sent -= len(bufs[0])
+                bufs.pop(0)
+            if sent and bufs:
+                bufs[0] = bufs[0][sent:]
 
     def recv(self) -> Dict[str, Any]:
         with self._recv_lock:
             header = self._recv_exact(_HEADER.size)
             (length,) = _HEADER.unpack(header)
             payload = self._recv_exact(length)
-        return pickle.loads(payload)
+        return loads_msg(payload)
 
     def _recv_exact(self, n: int) -> bytes:
         chunks = []
@@ -107,12 +147,21 @@ class Connection:
         self._sock.close()
 
 
+def _ssl_socket_types() -> tuple:
+    try:
+        import ssl
+
+        return (ssl.SSLSocket,)
+    except ImportError:  # pragma: no cover - ssl is stdlib
+        return ()
+
+
 async def aio_read_frame(reader) -> Dict[str, Any]:
     """Asyncio-side frame reader (node manager / GCS / peer loops)."""
     header = await reader.readexactly(_HEADER.size)
     (length,) = _HEADER.unpack(header)
     payload = await reader.readexactly(length)
-    return pickle.loads(payload)
+    return loads_msg(payload)
 
 
 class AioFramedWriter:
@@ -124,19 +173,33 @@ class AioFramedWriter:
         self._writer = writer
         self._lock = asyncio.Lock()
 
+    # Above this, header+payload ship as two transport writes (skipping
+    # the concatenation copy); below it, one write — an empty transport
+    # buffer flushes each write() with its own send syscall, so splitting
+    # small frames would double the syscall count for a ~100-byte copy.
+    _TWO_WRITE_MIN = 1 << 16
+
+    def _write_frame(self, payload: bytes):
+        if len(payload) >= self._TWO_WRITE_MIN:
+            self._writer.write(_HEADER.pack(len(payload)))
+            self._writer.write(payload)
+        else:
+            self._writer.write(_HEADER.pack(len(payload)) + payload)
+
     async def send(self, message: Dict[str, Any]):
         payload = dumps_msg(message)
         async with self._lock:
-            self._writer.write(_HEADER.pack(len(payload)) + payload)
+            self._write_frame(payload)
             await self._writer.drain()
 
     def send_nowait(self, message: Dict[str, Any]):
         """Buffered write without awaiting drain — the dispatch hot path
         (small control frames; the transport's own buffer provides the
-        backpressure boundary). Safe to interleave with send(): write()
-        itself is atomic per call on the loop thread."""
+        backpressure boundary). Safe to interleave with send(): the
+        frame's writes happen under the loop thread before any await
+        point."""
         payload = dumps_msg(message)
-        self._writer.write(_HEADER.pack(len(payload)) + payload)
+        self._write_frame(payload)
 
     def close(self):
         try:

@@ -10,9 +10,14 @@ boundary instead of as a KeyError deep in a handler), the client side
 gets generated stubs, and the whole surface is introspectable
 (``describe()`` — the proto-file equivalent).
 
-The wire format stays the framed-pickle dict of protocol.py — schemas
-type the *boundary*, they do not change the encoding (the reference
-splits these the same way: protobuf describes, gRPC/HTTP2 carries).
+The default wire format is the framed-pickle dict of protocol.py —
+schemas type the *boundary*, they do not change the encoding (the
+reference splits these the same way: protobuf describes, gRPC/HTTP2
+carries). Channels MAY additionally negotiate the native frame-pump
+codec for their hot dialect (core/frame_pump.py; versioned via
+``negotiate_codec`` below, sniffed per frame by protocol.loads_msg) —
+both dialects decode to the same dict shapes, so handlers and stubs
+never see the difference.
 """
 
 from __future__ import annotations
@@ -137,6 +142,19 @@ class ServiceSpec:
 
 class RpcError(Exception):
     pass
+
+
+def negotiate_codec(offered: Any, supported: int) -> int:
+    """Version handshake for an optional binary frame codec riding a
+    framed channel (the direct plane's native pump dialect, "npv" in the
+    hello/welcome): each side advertises the codec version it speaks
+    (0/absent = pickle only) and a side may EMIT native frames only when
+    the peer offered exactly its own version. Returns the agreed version
+    (0 = stay on pickle). Strict equality, not min(): codec layouts are
+    not negotiable ranges, and a skewed peer must land on the always-
+    correct pickle dialect, mirroring DIRECT_PROTO_VER's fallback
+    discipline."""
+    return supported if supported and offered == supported else 0
 
 
 class ServiceRegistry:

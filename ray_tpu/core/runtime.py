@@ -21,7 +21,8 @@ from .exceptions import GetTimeoutError, ObjectLostError, TaskError
 from .function_table import FunctionCache, export_function
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import InlineLocation, LocalObjectStore, Location, ShmLocation
-from .protocol import DIRECT_MAX_UNANSWERED, DIRECT_PROTO_VER
+from .protocol import DIRECT_MAX_UNANSWERED, DIRECT_PROTO_VER, dumps_msg
+from . import frame_pump
 from .reference import ObjectRef, ref_without_registration
 from .serialization import serialize, serialize_with_refs
 from .task_spec import RefArg, TaskSpec, TaskType, ValueArg
@@ -274,8 +275,18 @@ class BaseRuntime:
         rest_ids = []
         waiters = self._direct_waiters
         deadline = None if timeout is None else time.monotonic() + timeout
-        self._flush_direct()
-        for oid in ids:
+        if not waiters:
+            # No direct calls outstanding anywhere: skip the per-oid
+            # waiter-table lock round (a 1M-ref drain get() would take
+            # the lock a million times for guaranteed misses). Entries
+            # only appear from this process's own direct submits, so the
+            # emptiness check cannot race a reply this get() cares about.
+            rest_ids = ids
+            ids_iter = ()
+        else:
+            ids_iter = ids
+        flushed: set = set()
+        for oid in ids_iter:
             if oid in direct_vals:
                 continue
             with self._direct_waiters_lock:
@@ -283,6 +294,17 @@ class BaseRuntime:
             if entry is None:
                 rest_ids.append(oid)
                 continue
+            if not entry.event.is_set() and entry.chan is not None \
+                    and entry.chan not in flushed:
+                # Flush exactly the channel carrying this call — NOT
+                # every dirty channel: a sync caller must not do an
+                # unrelated pipelined stream's writev on its own round
+                # trip (the periodic flusher bounds those).
+                flushed.add(entry.chan)
+                try:
+                    entry.chan.flush()
+                except Exception:
+                    pass
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
             if not entry.event.wait(remaining):
@@ -298,8 +320,12 @@ class BaseRuntime:
             else:
                 direct_vals[oid] = value
         if rest_ids:
-            # Side bookkeeping (seals/unpins for just-resolved replies)
-            # must reach the NM before the location lookups below.
+            # Falling through to the control plane: every buffered direct
+            # frame must be out first (an NM-routed read may dep-wait on
+            # a buffered call's seal), and side bookkeeping (seals/unpins
+            # for just-resolved replies) must reach the NM before the
+            # location lookups below.
+            self._flush_direct()
             self._direct_flush_side(force=True)
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
@@ -399,15 +425,21 @@ class BaseRuntime:
                 hits[i] = loc
         if missing:
             fetched = dict(self._get_locations(missing, timeout))
-            if len(cache) + len(fetched) > self._LOC_CACHE_CAP:
-                cache.clear()  # rare; amortized O(1)
-            for i, loc in fetched.items():
-                if loc is None:
-                    continue
-                if (isinstance(loc, InlineLocation)
-                        and len(loc.data) > self._LOC_CACHE_INLINE_MAX):
-                    continue
-                cache[i] = loc
+            if len(missing) > self._LOC_CACHE_CAP:
+                # A batch larger than the cache would only churn it
+                # (insert + wholesale clear, nothing survives for reuse)
+                # — the 1M-task drain get() pays real money here.
+                pass
+            else:
+                if len(cache) + len(fetched) > self._LOC_CACHE_CAP:
+                    cache.clear()  # rare; amortized O(1)
+                for i, loc in fetched.items():
+                    if loc is None:
+                        continue
+                    if (isinstance(loc, InlineLocation)
+                            and len(loc.data) > self._LOC_CACHE_INLINE_MAX):
+                        continue
+                    cache[i] = loc
         else:
             fetched = {}
         return [(i, hits.get(i, fetched.get(i))) for i in ids]
@@ -946,14 +978,18 @@ class _DirectResult:
     ``readable`` records whether shared-memory result locations in the
     reply are readable from this process (same node, store attached);
     when False, non-inline results resolve through the regular location
-    path instead."""
+    path instead. ``chan`` is the channel whose out_buf may still hold
+    the call's frame — get() flushes exactly that channel instead of
+    every dirty one (a sync caller must not pay for an unrelated
+    pipelined stream's writev on its own round trip)."""
 
-    __slots__ = ("event", "payload", "readable")
+    __slots__ = ("event", "payload", "readable", "chan")
 
-    def __init__(self, readable: bool = True):
+    def __init__(self, readable: bool = True, chan=None):
         self.event = threading.Event()
         self.payload = None
         self.readable = readable
+        self.chan = chan
 
 
 # Sentinel: this oid must resolve through the location path after all
@@ -1034,13 +1070,26 @@ class _DirectChannel:
         # Hello/welcome handshake: session token, protocol version and
         # the caller's node (the worker holds non-inline results for
         # remote callers until their RemoteLocation entry is collected).
+        # "npv" advertises the native frame-pump codec version (0 = this
+        # side will speak pickle only); both sides must agree before
+        # either emits a native frame, and the magic-byte sniff in
+        # loads_msg keeps a half-engaged channel correct regardless.
         # Bounded: a worker that accepted the connection but never
         # replies (wedged, SIGSTOPped, half-open socket) must fail the
         # dial — discovery then retries via the unsupported path —
         # rather than pin this discovery thread forever.
+        import ssl as _ssl
+
+        # TLS channels never speak the native dialect (the pump moves
+        # raw fd bytes below the SSL layer): advertise npv=0 so the
+        # worker doesn't engage either, and count the fallback as what
+        # it is.
+        sock_pumpable = not isinstance(self.conn._sock, _ssl.SSLSocket)
+        my_npv = frame_pump.advertised_ver() if sock_pumpable else 0
         self.conn.settimeout(10.0)
         self.conn.send({
             "type": "direct_hello", "ver": DIRECT_PROTO_VER,
+            "npv": my_npv,
             "token": get_config().session_token,
             "actor_id": actor_id.hex(), "node": rt.node_id.hex(),
         })
@@ -1052,6 +1101,29 @@ class _DirectChannel:
             if "version" in str(err):
                 raise _DirectVersionMismatch(err)
             raise ConnectionError(f"direct hello refused: {err}")
+        # Engage the native pump: framing moves into the extension
+        # (buffered GIL-released reads, coalesced writev bursts) and the
+        # hot call frames use the compact codec. Any engage failure is
+        # counted in ray_tpu_native_fallbacks_total and the channel
+        # simply stays on the pure-Python pickle path.
+        from .rpc import negotiate_codec
+
+        self.native = False
+        if not frame_pump.advertised_ver():
+            # Knob off or .so missing: this channel runs pure-Python.
+            frame_pump.count_fallback(
+                "disabled" if frame_pump.disabled() else "unavailable"
+            )
+        elif not sock_pumpable:
+            frame_pump.count_fallback("tls")
+        elif negotiate_codec(welcome.get("npv"),
+                             frame_pump.advertised_ver()):
+            wrapped = frame_pump.wrap_connection(self.conn)
+            if wrapped is not None:
+                self.conn = wrapped
+                self.native = True
+        else:
+            frame_pump.count_fallback("no_peer")
         # Can this process read same-node shared-memory result locations?
         self.store_readable = (not self.remote) and rt._direct_store_readable
         self.alive = True
@@ -1102,11 +1174,12 @@ class _DirectChannel:
         # Backpressure: a channel death replays every unanswered call
         # over the NM route, relying on the worker's replay-dedup cache
         # to keep methods exactly-once — so unanswered calls must never
-        # outgrow what that cache can remember. Submitters are
+        # outgrow what that cache can remember. The pending table is the
+        # single authority (replay needs it anyway); len() is
+        # GIL-atomic, so the pre-check skips the lock. Submitters are
         # serialized per channel (the actor state lock), so one blocked
         # waiter here is the only writer.
-        with self.plock:
-            full = len(self.pending) >= DIRECT_MAX_UNANSWERED
+        full = len(self.pending) >= DIRECT_MAX_UNANSWERED
         if full:
             self.flush()  # the calls we wait on must reach the worker
             with self._pending_cv:
@@ -1114,13 +1187,14 @@ class _DirectChannel:
                        and not self.failed and self.alive):
                     self._pending_cv.wait(0.25)
         oid = spec.return_ids()[0]
-        entry = _DirectResult(readable=self.store_readable)
+        entry = _DirectResult(readable=self.store_readable, chan=self)
         dep_ids = list(spec.pinned_ids())
         # Templatable = everything per-call is carried by the compact
         # frame (task id, args, nested refs). Tracing submit-spans needs
         # the real trace ctx, so templating is off under that flag.
         key = (spec.method_name, spec.concurrency_group)
-        frame: Dict[str, Any]
+        frame: Optional[Dict[str, Any]]
+        tmpl: Optional[int] = None
         if _TRACE_SUBMITS or spec.streaming:
             frame = {"spec": spec, "function_blob": None}
         else:
@@ -1130,6 +1204,12 @@ class _DirectChannel:
                 self._templates[key] = tid
                 frame = {"spec": spec, "function_blob": None,
                          "tmpl_reg": tid}
+            elif self.native:
+                # Compact frame on the native codec: encoded (seq and
+                # all) under plock below, straight to bytes — no dict,
+                # no pickle.
+                frame = None
+                tmpl = tid
             else:
                 frame = {"t": tid, "i": spec.task_id.binary()}
                 if spec.args or spec.kwargs:
@@ -1145,11 +1225,34 @@ class _DirectChannel:
             if self.failed:
                 raise ConnectionError("direct channel failed")
             seq = next(self._seq)
-            frame["q"] = seq
+            out: Any
+            if frame is None:
+                try:
+                    out = frame_pump.encode_call(
+                        tmpl, spec.task_id.binary(), seq,
+                        spec.deadline_ts or 0.0, spec.args, spec.kwargs,
+                        spec.nested_refs,
+                    )
+                except Exception:
+                    frame_pump.count_fallback("codec_error")
+                    out = None
+                if out is None:
+                    # Unencodable shape: this one frame rides pickle.
+                    out = {"t": tmpl, "i": spec.task_id.binary(),
+                           "q": seq}
+                    if spec.args or spec.kwargs:
+                        out["a"] = (spec.args, spec.kwargs)
+                    if spec.nested_refs:
+                        out["n"] = spec.nested_refs
+                    if spec.deadline_ts:
+                        out["d"] = spec.deadline_ts
+            else:
+                frame["q"] = seq
+                out = frame
             self.pending[spec.task_id] = _PendingCall(
                 oid, entry, dep_ids, spec, time.monotonic(), seq
             )
-            self.out_buf.append(frame)
+            self.out_buf.append(out)
             self.calls += 1
         self.rt._direct_waiters_put(oid, entry)
         self.rt._mark_chan_dirty(self)
@@ -1179,6 +1282,27 @@ class _DirectChannel:
                         self.conn.close()
                     except Exception:
                         pass
+            if self.native:
+                # Native pump: every buffered frame (codec bytes and the
+                # occasional pickled dict) ships as its own message, the
+                # whole burst coalesced into one writev. The worker's
+                # seq queue reconstitutes ordering; reply batching keys
+                # off its read-ahead buffer instead of batch framing.
+                if buf or _trailer is not None:
+                    payloads = [
+                        f if type(f) is bytes
+                        else dumps_msg({"type": "execute", **f})
+                        for f in buf
+                    ]
+                    if _trailer is not None:
+                        if _trailer.get("type") == "fence":
+                            payloads.append(frame_pump.encode_fence(
+                                _trailer["msg_id"]))
+                        else:
+                            payloads.append(dumps_msg(_trailer))
+                    self.conn.send_payloads(payloads)
+                return
+            if buf:
                 msg = (
                     {"type": "execute", **buf[0]} if len(buf) == 1
                     else {"type": "execute_batch", "items": buf}

@@ -15,6 +15,7 @@ import threading
 import time
 from typing import List
 
+from . import frame_pump
 from .executor import ActorContainer, execute_task
 from .function_table import FunctionCache
 from .ids import JobID, NodeID, ObjectID, TaskID, WorkerID
@@ -108,6 +109,14 @@ class Worker:
         self.conn.send({"type": "register", "worker_id": self.worker_id.hex()})
         ack = self.conn.recv()
         assert ack["type"] == "registered", ack
+        # Move the node socket's framing onto the native pump (payloads
+        # stay pickle, so the asyncio node manager needs no negotiation):
+        # buffered GIL-released reads slice an execute_batch burst out of
+        # one read(2), sends skip the per-frame concatenation. Falls back
+        # to the plain Connection silently (counted) when unavailable.
+        wrapped = frame_pump.wrap_connection(self.conn)
+        if wrapped is not None:
+            self.conn = wrapped
         node_id = NodeID.from_hex(ack["node_id"])
         # Chaos plane: adopt the cluster's armed plan at birth (updates
         # arrive as chaos_update frames on the reader loop).
@@ -472,9 +481,9 @@ class Worker:
             except OSError:
                 pass
             return
-        self._direct_serve(conn)
+        self._direct_serve(conn, tls=tls)
 
-    def _direct_serve(self, conn):
+    def _direct_serve(self, conn, tls: bool = False):
         """One caller connection: frames execute in SEQUENCE order ("q",
         per-handle monotonic) — INLINE in this thread for concurrency-1
         actors (under the serial lock), via the pool for concurrent
@@ -551,16 +560,33 @@ class Worker:
             return
         node_hex = self.runtime.node_id.hex() if self.runtime else None
         remote = hello.get("node") not in (None, node_hex)
+        # Native frame-pump negotiation: agree only when the caller
+        # advertised our codec version AND the pump can engage here.
+        # The magic-byte sniff in loads_msg keeps a half-engaged channel
+        # correct either way — npv only gates who EMITS native frames.
+        from .rpc import negotiate_codec
+
+        want_native = not tls and bool(negotiate_codec(
+            hello.get("npv"), frame_pump.advertised_ver()
+        ))
         try:
             conn.send({"type": "direct_welcome", "ok": True,
-                       "ver": DIRECT_PROTO_VER})
+                       "ver": DIRECT_PROTO_VER,
+                       "npv": frame_pump.CODEC_VER if want_native else 0})
         except Exception:
             return
+        if want_native:
+            wrapped = frame_pump.wrap_connection(conn)
+            if wrapped is not None:
+                conn = wrapped
 
         group_futs: list = []
         templates: dict = {}  # per-connection template id -> TaskSpec
-        expected = 1          # next sequence number to execute
-        parked: dict = {}     # seq -> buffered out-of-order frame
+        # Per-channel monotonic-seq dispatch: in-order admission,
+        # out-of-order parking, replay-duplicate drop — in the extension
+        # when available (frames execute without re-entering Python for
+        # the bookkeeping), PySeqQueue otherwise.
+        seqq = frame_pump.new_seq_queue()
 
         def decode(m):
             tid = m.get("t")
@@ -586,32 +612,67 @@ class Worker:
             return spec, None
 
         def in_seq_order(items):
-            """Admit frames in sequence order; buffer gaps, drop
-            duplicates (seq below expected = already executed)."""
-            nonlocal expected
+            """Admit frames in sequence order through the dispatch
+            queue; out-of-order arrivals park, duplicates (seq below
+            expected = already executed) drop."""
             run = []
             for m in items:
                 q = m.get("q")
-                if q is None or q == expected:
+                if q is None:
                     run.append(m)
-                    if q is not None:
-                        expected += 1
-                        while expected in parked:
-                            run.append(parked.pop(expected))
-                            expected += 1
-                elif q > expected:
-                    parked[q] = m  # out-of-order arrival: buffer
+                else:
+                    run.extend(seqq.push(q, m))
             return run
+
+        # Native channels deliver a pipelined burst as individual frames
+        # (the caller coalesces them into one writev, not one batch
+        # message): drain every COMPLETE frame already buffered BEFORE
+        # executing, so an arrived-together burst processes — and
+        # answers — as one batch, while a frame arriving mid-execution
+        # can never defer an already-finished call's reply behind its
+        # own (possibly long) execution.
+        has_frame = (conn.has_frame if getattr(conn, "native", False)
+                     else lambda: False)
+
+        def ack_fence(msg_id):
+            # The ack promises every earlier frame on this connection
+            # has EXECUTED — including frames handed to group pools OR
+            # the shared concurrency pool, both of which run
+            # asynchronously.
+            for f in group_futs:
+                try:
+                    f.result(timeout=60)
+                except Exception:
+                    pass
+            group_futs.clear()
+            self._flush_direct_replies(conn)
+            if getattr(conn, "native", False):
+                conn.send_payloads([frame_pump.encode_fence_ack(msg_id)])
+            else:
+                conn.send({"type": "fence_ack", "msg_id": msg_id})
 
         try:
             while self._alive:
                 msg = conn.recv()
-                mtype = msg.get("type")
-                if mtype in ("execute", "execute_batch"):
-                    items = (
-                        msg["items"] if mtype == "execute_batch" else [msg]
-                    )
-                    if len(parked) > 4096:
+                items: list = []
+                fences: list = []
+                while True:
+                    mtype = msg.get("type")
+                    if mtype == "execute":
+                        items.append(msg)
+                    elif mtype == "execute_batch":
+                        items.extend(msg["items"])
+                    elif mtype == "fence":
+                        # Acked after this gather executes: the frames
+                        # collected before it are exactly its "earlier"
+                        # frames (later ones executing too only makes
+                        # the promise stronger).
+                        fences.append(msg.get("msg_id"))
+                    if not has_frame():
+                        break
+                    msg = conn.recv()
+                if items:
+                    if seqq.parked > 4096:
                         return  # runaway gap: drop the connection
                     if len(group_futs) > 4096:
                         group_futs = [f for f in group_futs if not f.done()]
@@ -632,34 +693,23 @@ class Worker:
                             group_futs.append(self._pool.submit(
                                 self._run_direct, conn, spec, blob, remote,
                             ))
-                        continue
-                    for spec, blob in routed:
-                        with self._serial_lock:
-                            done = self._run_task(spec, blob,
-                                                  sample_resources=False)
-                        self._note_direct_done(done, spec, remote)
-                        with self._dr_lock:
-                            _, buf = self._dr_bufs.setdefault(
-                                id(conn), (conn, [])
-                            )
-                            buf.append(done)
-                            n = len(buf)
-                        if n >= _DONE_FLUSH_BATCH:
-                            self._flush_direct_replies(conn)
-                    self._flush_direct_replies(conn)
-                elif mtype == "fence":
-                    # The ack promises every earlier frame on this
-                    # connection has EXECUTED — including frames handed
-                    # to group pools OR the shared concurrency pool,
-                    # both of which run asynchronously.
-                    for f in group_futs:
-                        try:
-                            f.result(timeout=60)
-                        except Exception:
-                            pass
-                    group_futs.clear()
-                    conn.send({"type": "fence_ack",
-                               "msg_id": msg.get("msg_id")})
+                    else:
+                        for spec, blob in routed:
+                            with self._serial_lock:
+                                done = self._run_task(
+                                    spec, blob, sample_resources=False)
+                            self._note_direct_done(done, spec, remote)
+                            with self._dr_lock:
+                                _, buf = self._dr_bufs.setdefault(
+                                    id(conn), (conn, [])
+                                )
+                                buf.append(done)
+                                n = len(buf)
+                            if n >= _DONE_FLUSH_BATCH:
+                                self._flush_direct_replies(conn)
+                        self._flush_direct_replies(conn)
+                for msg_id in fences:
+                    ack_fence(msg_id)
         except (ConnectionClosed, OSError):
             pass
 
@@ -677,12 +727,26 @@ class Worker:
             if not replies:
                 continue
             try:
-                if len(replies) == 1:
-                    c.send(replies[0])
-                else:
-                    c.send({"type": "task_done_batch", "items": replies})
+                self._send_replies(c, replies)
             except Exception:
                 pass
+
+    def _send_replies(self, c, replies):
+        """Ship a reply burst: the native codec (one bytes frame, no
+        pickle) when the channel is on the pump and every reply has the
+        compact shape; the pickle dialect otherwise."""
+        if getattr(c, "native", False):
+            payload = (
+                frame_pump.encode_done(replies[0]) if len(replies) == 1
+                else frame_pump.encode_done_batch(replies)
+            )
+            if payload is not None:
+                c.send_payloads([payload])
+                return
+        if len(replies) == 1:
+            c.send(replies[0])
+        else:
+            c.send({"type": "task_done_batch", "items": replies})
 
     def _flush_before_block(self):
         """Runtime before-blocking hook: ship every buffered completion
@@ -703,7 +767,7 @@ class Worker:
         done = self._run_task(spec, function_blob, sample_resources=False)
         self._note_direct_done(done, spec, remote)
         try:
-            conn.send(done)
+            self._send_replies(conn, [done])
         except Exception:
             pass
 

@@ -1,0 +1,1071 @@
+// CPython binding for the native frame pump (rts_pump.h).
+//
+// Exposes:
+//   Chan     — framed-channel pump over a dup of a socket fd: buffered
+//              GIL-released reads (one read(2) yields many frames), batch
+//              sends coalesced into writev(2), plus the caller-side
+//              unanswered-call accounting (atomic inflight counter).
+//   SeqQueue — the per-channel monotonic-seq dispatch queue (in-order
+//              admission, out-of-order parking, duplicate drop) holding
+//              Python frame objects.
+//   codec    — encode_call / encode_done / encode_done_batch /
+//              encode_fence / encode_fence_ack / decode for the direct
+//              plane's hot frame dialect. decode() rebuilds the SAME dict
+//              shapes pickle produced, so the channel readers cannot tell
+//              the dialects apart; unsupported shapes make the encoders
+//              return None and the caller falls back to pickle for that
+//              frame. Python-side classes (RefArg, ValueArg, ObjectID,
+//              TaskID, InlineLocation) are injected once via
+//              register_types() — this module never imports pickle.
+//
+// pybind11 is not available in this environment; plain CPython C API.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <string.h>
+
+#include "rts_pump.h"
+
+namespace {
+
+// ---- registered Python types + interned keys -------------------------------
+
+PyObject* g_refarg = nullptr;
+PyObject* g_valuearg = nullptr;
+PyObject* g_objectid = nullptr;
+PyObject* g_taskid = nullptr;
+PyObject* g_inlineloc = nullptr;
+
+PyObject* s_type;
+PyObject* s_t;
+PyObject* s_i;
+PyObject* s_q;
+PyObject* s_a;
+PyObject* s_n;
+PyObject* s_d;
+PyObject* s_task_id;
+PyObject* s_results;
+PyObject* s_failed;
+PyObject* s_duration_s;
+PyObject* s_items;
+PyObject* s_msg_id;
+PyObject* s_duplicate;
+PyObject* s_object_id;
+PyObject* s_data;
+PyObject* s_bytes_attr;  // "_bytes" (BaseID slot)
+PyObject* v_execute;
+PyObject* v_task_done;
+PyObject* v_task_done_batch;
+PyObject* v_fence;
+PyObject* v_fence_ack;
+
+PyObject* py_types_registered_err() {
+  PyErr_SetString(PyExc_RuntimeError,
+                  "_rtpump.register_types() has not been called");
+  return nullptr;
+}
+
+// ---- Chan ------------------------------------------------------------------
+
+struct ChanObject {
+  PyObject_HEAD
+  rtp_chan* chan;
+};
+
+extern PyTypeObject ChanType;
+extern PyTypeObject SeqQueueType;
+
+void Chan_dealloc(ChanObject* self) {
+  if (self->chan) {
+    rtp_chan_free(self->chan);
+    self->chan = nullptr;
+  }
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+int chan_check(ChanObject* self) {
+  if (!self->chan) {
+    PyErr_SetString(PyExc_ValueError, "pump channel is closed");
+    return -1;
+  }
+  return 0;
+}
+
+PyObject* chan_raise(int rc) {
+  switch (rc) {
+    case RTP_EOF:
+      PyErr_SetString(PyExc_ConnectionError, "pump channel closed");
+      break;
+    case RTP_AGAIN:
+      PyErr_SetString(PyExc_TimeoutError, "pump channel timed out");
+      break;
+    default:
+      if (errno)
+        PyErr_SetFromErrno(PyExc_OSError);
+      else
+        PyErr_SetString(PyExc_OSError, "pump channel I/O error");
+  }
+  return nullptr;
+}
+
+PyObject* Chan_recv(ChanObject* self, PyObject*) {
+  if (chan_check(self) != 0) return nullptr;
+  const uint8_t* ptr = nullptr;
+  uint32_t len = 0;
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = rtp_chan_next(self->chan, &ptr, &len);
+  Py_END_ALLOW_THREADS
+  if (rc == RTP_OK)
+    return PyBytes_FromStringAndSize((const char*)ptr, (Py_ssize_t)len);
+  if (rc == RTP_BIG) {
+    PyObject* out = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)len);
+    if (!out) return nullptr;
+    uint8_t* dst = (uint8_t*)PyBytes_AS_STRING(out);
+    Py_BEGIN_ALLOW_THREADS
+    rc = rtp_chan_read_exact(self->chan, dst, len);
+    Py_END_ALLOW_THREADS
+    if (rc != RTP_OK) {
+      // A failure (even a timeout) mid-oversized-payload loses stream
+      // framing — the consumed bytes are gone. Surface it as a dead
+      // channel, never a resumable timeout.
+      Py_DECREF(out);
+      PyErr_SetString(PyExc_ConnectionError,
+                      "pump channel broken mid-frame");
+      return nullptr;
+    }
+    return out;
+  }
+  return chan_raise(rc);
+}
+
+PyObject* Chan_send(ChanObject* self, PyObject* arg) {
+  if (chan_check(self) != 0) return nullptr;
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) return nullptr;
+  struct iovec iov = {view.buf, (size_t)view.len};
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = rtp_chan_sendv(self->chan, &iov, 1);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&view);
+  if (rc != RTP_OK) return chan_raise(rc);
+  Py_RETURN_NONE;
+}
+
+PyObject* Chan_send_many(ChanObject* self, PyObject* arg) {
+  if (chan_check(self) != 0) return nullptr;
+  PyObject* fast = PySequence_Fast(arg, "send_many expects a sequence");
+  if (!fast) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  if (n == 0) {
+    Py_DECREF(fast);
+    Py_RETURN_NONE;
+  }
+  Py_buffer* views = (Py_buffer*)PyMem_Malloc(sizeof(Py_buffer) * (size_t)n);
+  struct iovec* iov =
+      (struct iovec*)PyMem_Malloc(sizeof(struct iovec) * (size_t)n);
+  if (!views || !iov) {
+    PyMem_Free(views);
+    PyMem_Free(iov);
+    Py_DECREF(fast);
+    return PyErr_NoMemory();
+  }
+  Py_ssize_t got = 0;
+  for (; got < n; ++got) {
+    PyObject* item = PySequence_Fast_GET_ITEM(fast, got);
+    if (PyObject_GetBuffer(item, &views[got], PyBUF_SIMPLE) != 0) break;
+    iov[got].iov_base = views[got].buf;
+    iov[got].iov_len = (size_t)views[got].len;
+  }
+  int rc = RTP_OK;
+  if (got == n) {
+    Py_BEGIN_ALLOW_THREADS
+    rc = rtp_chan_sendv(self->chan, iov, (int)n);
+    Py_END_ALLOW_THREADS
+  }
+  for (Py_ssize_t i = 0; i < got; ++i) PyBuffer_Release(&views[i]);
+  PyMem_Free(views);
+  PyMem_Free(iov);
+  bool buf_err = got != n;
+  Py_DECREF(fast);
+  if (buf_err) return nullptr;
+  if (rc != RTP_OK) return chan_raise(rc);
+  Py_RETURN_NONE;
+}
+
+PyObject* Chan_shutdown(ChanObject* self, PyObject*) {
+  if (self->chan) rtp_chan_shutdown(self->chan);
+  Py_RETURN_NONE;
+}
+
+PyObject* Chan_buffered(ChanObject* self, PyObject*) {
+  if (chan_check(self) != 0) return nullptr;
+  return PyLong_FromSize_t(rtp_chan_buffered(self->chan));
+}
+
+PyObject* Chan_has_frame(ChanObject* self, PyObject*) {
+  if (chan_check(self) != 0) return nullptr;
+  return PyBool_FromLong(rtp_chan_has_frame(self->chan));
+}
+
+PyObject* Chan_fileno(ChanObject* self, PyObject*) {
+  if (chan_check(self) != 0) return nullptr;
+  return PyLong_FromLong(rtp_chan_fd(self->chan));
+}
+
+PyObject* Chan_inflight_add(ChanObject* self, PyObject* arg) {
+  if (chan_check(self) != 0) return nullptr;
+  long long d = PyLong_AsLongLong(arg);
+  if (d == -1 && PyErr_Occurred()) return nullptr;
+  return PyLong_FromLongLong(rtp_chan_inflight_add(self->chan, d));
+}
+
+PyObject* Chan_stats(ChanObject* self, PyObject*) {
+  if (chan_check(self) != 0) return nullptr;
+  static const char* names[6] = {"frames_in",     "frames_out",
+                                 "bytes_in",      "bytes_out",
+                                 "read_syscalls", "write_syscalls"};
+  PyObject* d = PyDict_New();
+  if (!d) return nullptr;
+  for (int i = 0; i < 6; ++i) {
+    PyObject* v = PyLong_FromLongLong(rtp_chan_counter(self->chan, i));
+    if (!v || PyDict_SetItemString(d, names[i], v) != 0) {
+      Py_XDECREF(v);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(v);
+  }
+  return d;
+}
+
+PyMethodDef Chan_methods[] = {
+    {"recv", (PyCFunction)Chan_recv, METH_NOARGS,
+     "recv() -> bytes payload of the next frame (GIL released; raises "
+     "ConnectionError on close, TimeoutError on SO_RCVTIMEO expiry)"},
+    {"send", (PyCFunction)Chan_send, METH_O,
+     "send(payload) -> frame the payload and write it (writev, no copy)"},
+    {"send_many", (PyCFunction)Chan_send_many, METH_O,
+     "send_many([payloads]) -> coalesced writev of the whole burst"},
+    {"shutdown", (PyCFunction)Chan_shutdown, METH_NOARGS,
+     "shutdown() -> shutdown(2) the socket (wakes a blocked reader)"},
+    {"buffered", (PyCFunction)Chan_buffered, METH_NOARGS,
+     "buffered() -> bytes already read past the consumed frames"},
+    {"has_frame", (PyCFunction)Chan_has_frame, METH_NOARGS,
+     "has_frame() -> a COMPLETE frame is buffered (recv cannot block)"},
+    {"fileno", (PyCFunction)Chan_fileno, METH_NOARGS, ""},
+    {"inflight_add", (PyCFunction)Chan_inflight_add, METH_O,
+     "inflight_add(delta) -> new value of the atomic unanswered-call "
+     "counter (delta 0 reads)"},
+    {"stats", (PyCFunction)Chan_stats, METH_NOARGS,
+     "stats() -> {frames_in, frames_out, bytes_in, bytes_out, "
+     "read_syscalls, write_syscalls}"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject ChanType = {PyVarObject_HEAD_INIT(nullptr, 0)};
+
+PyObject* mod_chan(PyObject*, PyObject* args) {
+  int fd;
+  unsigned long long bufcap = 0;
+  if (!PyArg_ParseTuple(args, "i|K", &fd, &bufcap)) return nullptr;
+  rtp_chan* c = rtp_chan_new(fd, (size_t)bufcap);
+  if (!c) {
+    PyErr_SetFromErrno(PyExc_OSError);
+    return nullptr;
+  }
+  ChanObject* self = PyObject_New(ChanObject, &ChanType);
+  if (!self) {
+    rtp_chan_free(c);
+    return nullptr;
+  }
+  self->chan = c;
+  return (PyObject*)self;
+}
+
+// ---- SeqQueue --------------------------------------------------------------
+
+struct SeqQueueObject {
+  PyObject_HEAD
+  rtp_seqq* q;
+};
+
+void seqq_drop_pyobj(void* item) { Py_DECREF((PyObject*)item); }
+
+void SeqQueue_dealloc(SeqQueueObject* self) {
+  if (self->q) {
+    rtp_seqq_free(self->q, seqq_drop_pyobj);
+    self->q = nullptr;
+  }
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+PyObject* SeqQueue_push(SeqQueueObject* self, PyObject* args) {
+  unsigned long long seq;
+  PyObject* item;
+  if (!PyArg_ParseTuple(args, "KO", &seq, &item)) return nullptr;
+  int dup = 0;
+  Py_INCREF(item);  // the queue owns one ref while parked/ready
+  int n = rtp_seqq_push(self->q, seq, item, &dup);
+  if (dup) Py_DECREF(item);  // dropped: already executed
+  PyObject* out = PyList_New(n);
+  if (!out) return nullptr;
+  for (int i = 0; i < n; ++i) {
+    PyObject* o = (PyObject*)rtp_seqq_pop(self->q);
+    PyList_SET_ITEM(out, i, o);  // steals the queue's ref
+  }
+  return out;
+}
+
+PyObject* SeqQueue_expected(SeqQueueObject* self, void*) {
+  return PyLong_FromUnsignedLongLong(rtp_seqq_expected(self->q));
+}
+
+PyObject* SeqQueue_parked(SeqQueueObject* self, void*) {
+  return PyLong_FromSize_t(rtp_seqq_parked(self->q));
+}
+
+PyMethodDef SeqQueue_methods[] = {
+    {"push", (PyCFunction)SeqQueue_push, METH_VARARGS,
+     "push(seq, frame) -> [frames now runnable in order] (empty for a "
+     "parked out-of-order arrival or a dropped duplicate)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyGetSetDef SeqQueue_getset[] = {
+    {"expected", (getter)SeqQueue_expected, nullptr,
+     "next sequence number to execute", nullptr},
+    {"parked", (getter)SeqQueue_parked, nullptr,
+     "buffered out-of-order frames", nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr},
+};
+
+PyTypeObject SeqQueueType = {PyVarObject_HEAD_INIT(nullptr, 0)};
+
+PyObject* mod_seq_queue(PyObject*, PyObject*) {
+  rtp_seqq* q = rtp_seqq_new();
+  if (!q) return PyErr_NoMemory();
+  SeqQueueObject* self = PyObject_New(SeqQueueObject, &SeqQueueType);
+  if (!self) {
+    rtp_seqq_free(q, nullptr);
+    return nullptr;
+  }
+  self->q = q;
+  return (PyObject*)self;
+}
+
+// ---- codec -----------------------------------------------------------------
+
+// Append one bytes-like attr (already a bytes object) with u32 length.
+int put_sized_bytes(rtp_wbuf* b, PyObject* bytes_obj) {
+  char* p;
+  Py_ssize_t n;
+  if (PyBytes_AsStringAndSize(bytes_obj, &p, &n) != 0) return -1;
+  if (rtp_put_u32(b, (uint32_t)n) != RTP_OK ||
+      rtp_wbuf_put(b, p, (size_t)n) != RTP_OK) {
+    PyErr_NoMemory();
+    return -1;
+  }
+  return 0;
+}
+
+// Lower one arg (RefArg | ValueArg). Returns 0 ok, 1 unsupported, -1 error.
+int put_arg(rtp_wbuf* b, PyObject* arg) {
+  if ((PyObject*)Py_TYPE(arg) == g_refarg) {
+    PyObject* oid = PyObject_GetAttr(arg, s_object_id);
+    if (!oid) return -1;
+    PyObject* raw = PyObject_GetAttr(oid, s_bytes_attr);
+    Py_DECREF(oid);
+    if (!raw) return -1;
+    int rc = (rtp_put_u8(b, RTP_ARG_REF) != RTP_OK) ||
+             (put_sized_bytes(b, raw) != 0);
+    Py_DECREF(raw);
+    return rc ? -1 : 0;
+  }
+  if ((PyObject*)Py_TYPE(arg) == g_valuearg) {
+    PyObject* data = PyObject_GetAttr(arg, s_data);
+    if (!data) return -1;
+    if (!PyBytes_Check(data)) {
+      Py_DECREF(data);
+      return 1;
+    }
+    int rc = (rtp_put_u8(b, RTP_ARG_VALUE) != RTP_OK) ||
+             (put_sized_bytes(b, data) != 0);
+    Py_DECREF(data);
+    return rc ? -1 : 0;
+  }
+  return 1;  // unknown arg shape: caller falls back to pickle
+}
+
+PyObject* wbuf_to_bytes(rtp_wbuf* b) {
+  PyObject* out = PyBytes_FromStringAndSize((const char*)b->p,
+                                            (Py_ssize_t)b->len);
+  rtp_wbuf_freebuf(b);
+  return out;
+}
+
+// encode_call(tmpl, task_id_bytes, seq, deadline, args, kwargs, nested)
+//   -> bytes | None (unsupported shape)
+PyObject* mod_encode_call(PyObject*, PyObject* args) {
+  unsigned int tmpl;
+  Py_buffer tid;
+  unsigned long long seq;
+  double deadline;
+  PyObject *a_args, *a_kwargs, *nested;
+  if (!PyArg_ParseTuple(args, "Iy*KdOOO", &tmpl, &tid, &seq, &deadline,
+                        &a_args, &a_kwargs, &nested))
+    return nullptr;
+  if (!g_refarg) {
+    PyBuffer_Release(&tid);
+    return py_types_registered_err();
+  }
+  if (tid.len > 255 || (a_args != Py_None && !PyList_Check(a_args)) ||
+      (a_kwargs != Py_None && !PyDict_Check(a_kwargs)) ||
+      (nested != Py_None && !PyTuple_Check(nested))) {
+    PyBuffer_Release(&tid);
+    Py_RETURN_NONE;
+  }
+  int has_args = (a_args != Py_None && PyList_GET_SIZE(a_args) > 0) ||
+                 (a_kwargs != Py_None && PyDict_GET_SIZE(a_kwargs) > 0);
+  int has_nested = nested != Py_None && PyTuple_GET_SIZE(nested) > 0;
+  rtp_wbuf b;
+  if (rtp_wbuf_init(&b, 128) != RTP_OK) {
+    PyBuffer_Release(&tid);
+    return PyErr_NoMemory();
+  }
+  rtp_put_u8(&b, RTP_MAGIC);
+  rtp_put_u8(&b, RTP_F_CALL);
+  rtp_put_u32(&b, tmpl);
+  rtp_put_u64(&b, seq);
+  rtp_put_u8(&b, (uint8_t)tid.len);
+  rtp_wbuf_put(&b, tid.buf, (size_t)tid.len);
+  PyBuffer_Release(&tid);
+  rtp_put_f64(&b, deadline);
+  uint8_t flags = (has_args ? RTP_CALL_HAS_ARGS : 0) |
+                  (has_nested ? RTP_CALL_HAS_NESTED : 0);
+  rtp_put_u8(&b, flags);
+  if (has_args) {
+    if (a_args == Py_None || !PyList_Check(a_args) ||
+        (a_kwargs != Py_None && !PyDict_Check(a_kwargs)))
+      goto unsupported;
+    {
+      Py_ssize_t na = PyList_GET_SIZE(a_args);
+      rtp_put_u32(&b, (uint32_t)na);
+      for (Py_ssize_t i = 0; i < na; ++i) {
+        int rc = put_arg(&b, PyList_GET_ITEM(a_args, i));
+        if (rc < 0) goto error;
+        if (rc > 0) goto unsupported;
+      }
+      Py_ssize_t nk =
+          a_kwargs == Py_None ? 0 : PyDict_GET_SIZE(a_kwargs);
+      rtp_put_u32(&b, (uint32_t)nk);
+      if (nk) {
+        PyObject *key, *value;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(a_kwargs, &pos, &key, &value)) {
+          if (!PyUnicode_Check(key)) goto unsupported;
+          Py_ssize_t klen;
+          const char* kutf = PyUnicode_AsUTF8AndSize(key, &klen);
+          if (!kutf) goto error;
+          if (klen > 0xffff) goto unsupported;
+          rtp_put_u16(&b, (uint16_t)klen);
+          rtp_wbuf_put(&b, kutf, (size_t)klen);
+          int rc = put_arg(&b, value);
+          if (rc < 0) goto error;
+          if (rc > 0) goto unsupported;
+        }
+      }
+    }
+  }
+  if (has_nested) {
+    Py_ssize_t nn = PyTuple_GET_SIZE(nested);
+    rtp_put_u32(&b, (uint32_t)nn);
+    for (Py_ssize_t i = 0; i < nn; ++i) {
+      PyObject* oid = PyTuple_GET_ITEM(nested, i);
+      if ((PyObject*)Py_TYPE(oid) != g_objectid) goto unsupported;
+      PyObject* raw = PyObject_GetAttr(oid, s_bytes_attr);
+      if (!raw) goto error;
+      char* p;
+      Py_ssize_t n;
+      if (PyBytes_AsStringAndSize(raw, &p, &n) != 0 || n > 255) {
+        Py_DECREF(raw);
+        goto unsupported;
+      }
+      rtp_put_u8(&b, (uint8_t)n);
+      rtp_wbuf_put(&b, p, (size_t)n);
+      Py_DECREF(raw);
+    }
+  }
+  return wbuf_to_bytes(&b);
+unsupported:
+  rtp_wbuf_freebuf(&b);
+  Py_RETURN_NONE;
+error:
+  rtp_wbuf_freebuf(&b);
+  return nullptr;
+}
+
+// Append one task_done body. Returns 0 ok, 1 unsupported, -1 error.
+int put_done_body(rtp_wbuf* b, PyObject* done) {
+  if (!PyDict_Check(done)) return 1;
+  // Reject any key outside the hot success/failure shape — extra
+  // bookkeeping (nested refs, error strings, resource usage) rides the
+  // pickle dialect instead.
+  PyObject *key, *value;
+  Py_ssize_t pos = 0;
+  PyObject* task_id = nullptr;
+  PyObject* results = nullptr;
+  int failed = 0;
+  double duration = 0.0;
+  while (PyDict_Next(done, &pos, &key, &value)) {
+    if (!PyUnicode_Check(key)) return 1;
+    if (PyUnicode_Compare(key, s_type) == 0) {
+      if (PyUnicode_Compare(value, v_task_done) != 0) return 1;
+    } else if (PyUnicode_Compare(key, s_task_id) == 0) {
+      task_id = value;
+    } else if (PyUnicode_Compare(key, s_results) == 0) {
+      results = value;
+    } else if (PyUnicode_Compare(key, s_failed) == 0) {
+      failed = PyObject_IsTrue(value);
+      if (failed < 0) return -1;
+    } else if (PyUnicode_Compare(key, s_duration_s) == 0) {
+      duration = PyFloat_AsDouble(value);
+      if (duration == -1.0 && PyErr_Occurred()) return -1;
+    } else if (PyUnicode_Compare(key, s_duplicate) == 0) {
+      // Replay-dedup marker: semantically inert for the caller; drop.
+    } else {
+      if (PyErr_Occurred()) return -1;
+      return 1;
+    }
+  }
+  if (PyErr_Occurred()) return -1;
+  if (!task_id || !results || failed) return 1;
+  if ((PyObject*)Py_TYPE(task_id) != g_taskid) return 1;
+  if (!PyList_Check(results)) return 1;
+  PyObject* raw = PyObject_GetAttr(task_id, s_bytes_attr);
+  if (!raw) return -1;
+  char* p;
+  Py_ssize_t n;
+  if (PyBytes_AsStringAndSize(raw, &p, &n) != 0 || n > 255) {
+    Py_DECREF(raw);
+    return 1;
+  }
+  rtp_put_u8(b, (uint8_t)n);
+  rtp_wbuf_put(b, p, (size_t)n);
+  Py_DECREF(raw);
+  rtp_put_u8(b, 0);  // flags: failed dones stay on the pickle dialect
+  rtp_put_f64(b, duration);
+  Py_ssize_t nr = PyList_GET_SIZE(results);
+  rtp_put_u32(b, (uint32_t)nr);
+  for (Py_ssize_t i = 0; i < nr; ++i) {
+    PyObject* pair = PyList_GET_ITEM(results, i);
+    if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) return 1;
+    PyObject* oid = PyTuple_GET_ITEM(pair, 0);
+    PyObject* loc = PyTuple_GET_ITEM(pair, 1);
+    if ((PyObject*)Py_TYPE(oid) != g_objectid ||
+        (PyObject*)Py_TYPE(loc) != g_inlineloc)
+      return 1;
+    PyObject* oraw = PyObject_GetAttr(oid, s_bytes_attr);
+    if (!oraw) return -1;
+    char* op;
+    Py_ssize_t on;
+    if (PyBytes_AsStringAndSize(oraw, &op, &on) != 0 || on > 255) {
+      Py_DECREF(oraw);
+      return 1;
+    }
+    rtp_put_u8(b, (uint8_t)on);
+    rtp_wbuf_put(b, op, (size_t)on);
+    Py_DECREF(oraw);
+    PyObject* data = PyObject_GetAttr(loc, s_data);
+    if (!data) return -1;
+    if (!PyBytes_Check(data)) {
+      Py_DECREF(data);
+      return 1;
+    }
+    int rc = put_sized_bytes(b, data);
+    Py_DECREF(data);
+    if (rc != 0) return -1;
+  }
+  return 0;
+}
+
+PyObject* mod_encode_done(PyObject*, PyObject* done) {
+  if (!g_taskid) return py_types_registered_err();
+  rtp_wbuf b;
+  if (rtp_wbuf_init(&b, 128) != RTP_OK) return PyErr_NoMemory();
+  rtp_put_u8(&b, RTP_MAGIC);
+  rtp_put_u8(&b, RTP_F_DONE);
+  int rc = put_done_body(&b, done);
+  if (rc < 0) {
+    rtp_wbuf_freebuf(&b);
+    return nullptr;
+  }
+  if (rc > 0) {
+    rtp_wbuf_freebuf(&b);
+    Py_RETURN_NONE;
+  }
+  return wbuf_to_bytes(&b);
+}
+
+PyObject* mod_encode_done_batch(PyObject*, PyObject* arg) {
+  if (!g_taskid) return py_types_registered_err();
+  PyObject* fast = PySequence_Fast(arg, "encode_done_batch expects a list");
+  if (!fast) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  rtp_wbuf b;
+  if (rtp_wbuf_init(&b, 256) != RTP_OK) {
+    Py_DECREF(fast);
+    return PyErr_NoMemory();
+  }
+  rtp_put_u8(&b, RTP_MAGIC);
+  rtp_put_u8(&b, RTP_F_DONE_BATCH);
+  rtp_put_u32(&b, (uint32_t)n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    int rc = put_done_body(&b, PySequence_Fast_GET_ITEM(fast, i));
+    if (rc != 0) {
+      rtp_wbuf_freebuf(&b);
+      Py_DECREF(fast);
+      if (rc < 0) return nullptr;
+      Py_RETURN_NONE;  // one unsupported item: whole batch rides pickle
+    }
+  }
+  Py_DECREF(fast);
+  return wbuf_to_bytes(&b);
+}
+
+PyObject* encode_fence_frame(uint8_t ftype, PyObject* arg) {
+  unsigned long long mid = PyLong_AsUnsignedLongLong(arg);
+  if (mid == (unsigned long long)-1 && PyErr_Occurred()) return nullptr;
+  rtp_wbuf b;
+  if (rtp_wbuf_init(&b, 16) != RTP_OK) return PyErr_NoMemory();
+  rtp_put_u8(&b, RTP_MAGIC);
+  rtp_put_u8(&b, ftype);
+  rtp_put_u64(&b, mid);
+  return wbuf_to_bytes(&b);
+}
+
+PyObject* mod_encode_fence(PyObject*, PyObject* arg) {
+  return encode_fence_frame(RTP_F_FENCE, arg);
+}
+
+PyObject* mod_encode_fence_ack(PyObject*, PyObject* arg) {
+  return encode_fence_frame(RTP_F_FENCE_ACK, arg);
+}
+
+PyObject* decode_err() {
+  PyErr_SetString(PyExc_ValueError, "malformed native frame");
+  return nullptr;
+}
+
+// Build one arg object from the cursor. Returns new ref or nullptr.
+PyObject* read_arg(rtp_rbuf* r) {
+  uint8_t kind;
+  uint32_t len;
+  const uint8_t* p;
+  if (rtp_get_u8(r, &kind) != RTP_OK || rtp_get_u32(r, &len) != RTP_OK ||
+      rtp_get_ref(r, &p, len) != RTP_OK)
+    return decode_err();
+  PyObject* raw = PyBytes_FromStringAndSize((const char*)p, (Py_ssize_t)len);
+  if (!raw) return nullptr;
+  PyObject* out = nullptr;
+  if (kind == RTP_ARG_REF) {
+    PyObject* oid = PyObject_CallOneArg(g_objectid, raw);
+    Py_DECREF(raw);
+    if (!oid) return nullptr;
+    out = PyObject_CallOneArg(g_refarg, oid);
+    Py_DECREF(oid);
+  } else if (kind == RTP_ARG_VALUE) {
+    out = PyObject_CallOneArg(g_valuearg, raw);
+    Py_DECREF(raw);
+  } else {
+    Py_DECREF(raw);
+    return decode_err();
+  }
+  return out;
+}
+
+PyObject* decode_call(rtp_rbuf* r) {
+  uint32_t tmpl;
+  uint64_t seq;
+  uint8_t idlen, flags;
+  const uint8_t* idp;
+  double deadline;
+  if (rtp_get_u32(r, &tmpl) != RTP_OK || rtp_get_u64(r, &seq) != RTP_OK ||
+      rtp_get_u8(r, &idlen) != RTP_OK ||
+      rtp_get_ref(r, &idp, idlen) != RTP_OK ||
+      rtp_get_f64(r, &deadline) != RTP_OK || rtp_get_u8(r, &flags) != RTP_OK)
+    return decode_err();
+  PyObject* out = PyDict_New();
+  if (!out) return nullptr;
+  PyObject* tid = PyBytes_FromStringAndSize((const char*)idp, idlen);
+  PyObject* tmpl_o = PyLong_FromUnsignedLong(tmpl);
+  PyObject* seq_o = PyLong_FromUnsignedLongLong(seq);
+  if (!tid || !tmpl_o || !seq_o || PyDict_SetItem(out, s_type, v_execute) ||
+      PyDict_SetItem(out, s_t, tmpl_o) || PyDict_SetItem(out, s_i, tid) ||
+      PyDict_SetItem(out, s_q, seq_o))
+    goto error;
+  Py_CLEAR(tid);
+  Py_CLEAR(tmpl_o);
+  Py_CLEAR(seq_o);
+  if (deadline != 0.0) {
+    PyObject* d = PyFloat_FromDouble(deadline);
+    if (!d || PyDict_SetItem(out, s_d, d)) {
+      Py_XDECREF(d);
+      goto error;
+    }
+    Py_DECREF(d);
+  }
+  if (flags & RTP_CALL_HAS_ARGS) {
+    uint32_t na;
+    if (rtp_get_u32(r, &na) != RTP_OK) {
+      decode_err();
+      goto error;
+    }
+    PyObject* args_list = PyList_New((Py_ssize_t)na);
+    if (!args_list) goto error;
+    for (uint32_t i = 0; i < na; ++i) {
+      PyObject* a = read_arg(r);
+      if (!a) {
+        Py_DECREF(args_list);
+        goto error;
+      }
+      PyList_SET_ITEM(args_list, i, a);
+    }
+    uint32_t nk;
+    if (rtp_get_u32(r, &nk) != RTP_OK) {
+      Py_DECREF(args_list);
+      decode_err();
+      goto error;
+    }
+    PyObject* kw = PyDict_New();
+    if (!kw) {
+      Py_DECREF(args_list);
+      goto error;
+    }
+    for (uint32_t i = 0; i < nk; ++i) {
+      uint16_t klen;
+      const uint8_t* kp;
+      if (rtp_get_u16(r, &klen) != RTP_OK ||
+          rtp_get_ref(r, &kp, klen) != RTP_OK) {
+        Py_DECREF(args_list);
+        Py_DECREF(kw);
+        decode_err();
+        goto error;
+      }
+      PyObject* key =
+          PyUnicode_DecodeUTF8((const char*)kp, klen, nullptr);
+      PyObject* v = key ? read_arg(r) : nullptr;
+      if (!key || !v || PyDict_SetItem(kw, key, v)) {
+        Py_XDECREF(key);
+        Py_XDECREF(v);
+        Py_DECREF(args_list);
+        Py_DECREF(kw);
+        goto error;
+      }
+      Py_DECREF(key);
+      Py_DECREF(v);
+    }
+    PyObject* a_pair = PyTuple_Pack(2, args_list, kw);
+    Py_DECREF(args_list);
+    Py_DECREF(kw);
+    if (!a_pair || PyDict_SetItem(out, s_a, a_pair)) {
+      Py_XDECREF(a_pair);
+      goto error;
+    }
+    Py_DECREF(a_pair);
+  }
+  if (flags & RTP_CALL_HAS_NESTED) {
+    uint32_t nn;
+    if (rtp_get_u32(r, &nn) != RTP_OK) {
+      decode_err();
+      goto error;
+    }
+    PyObject* tup = PyTuple_New((Py_ssize_t)nn);
+    if (!tup) goto error;
+    for (uint32_t i = 0; i < nn; ++i) {
+      uint8_t olen;
+      const uint8_t* op;
+      if (rtp_get_u8(r, &olen) != RTP_OK ||
+          rtp_get_ref(r, &op, olen) != RTP_OK) {
+        Py_DECREF(tup);
+        decode_err();
+        goto error;
+      }
+      PyObject* raw = PyBytes_FromStringAndSize((const char*)op, olen);
+      PyObject* oid = raw ? PyObject_CallOneArg(g_objectid, raw) : nullptr;
+      Py_XDECREF(raw);
+      if (!oid) {
+        Py_DECREF(tup);
+        goto error;
+      }
+      PyTuple_SET_ITEM(tup, i, oid);
+    }
+    if (PyDict_SetItem(out, s_n, tup)) {
+      Py_DECREF(tup);
+      goto error;
+    }
+    Py_DECREF(tup);
+  }
+  return out;
+error:
+  Py_XDECREF(tid);
+  Py_XDECREF(tmpl_o);
+  Py_XDECREF(seq_o);
+  Py_DECREF(out);
+  return nullptr;
+}
+
+PyObject* decode_done_body(rtp_rbuf* r) {
+  uint8_t idlen, flags;
+  const uint8_t* idp;
+  double duration;
+  uint32_t nr;
+  if (rtp_get_u8(r, &idlen) != RTP_OK ||
+      rtp_get_ref(r, &idp, idlen) != RTP_OK ||
+      rtp_get_u8(r, &flags) != RTP_OK || rtp_get_f64(r, &duration) != RTP_OK ||
+      rtp_get_u32(r, &nr) != RTP_OK)
+    return decode_err();
+  PyObject* raw = PyBytes_FromStringAndSize((const char*)idp, idlen);
+  PyObject* tid = raw ? PyObject_CallOneArg(g_taskid, raw) : nullptr;
+  Py_XDECREF(raw);
+  if (!tid) return nullptr;
+  PyObject* results = PyList_New((Py_ssize_t)nr);
+  if (!results) {
+    Py_DECREF(tid);
+    return nullptr;
+  }
+  for (uint32_t i = 0; i < nr; ++i) {
+    uint8_t olen;
+    const uint8_t* op;
+    uint32_t dlen;
+    const uint8_t* dp;
+    if (rtp_get_u8(r, &olen) != RTP_OK ||
+        rtp_get_ref(r, &op, olen) != RTP_OK ||
+        rtp_get_u32(r, &dlen) != RTP_OK ||
+        rtp_get_ref(r, &dp, dlen) != RTP_OK) {
+      Py_DECREF(tid);
+      Py_DECREF(results);
+      return decode_err();
+    }
+    PyObject* oraw = PyBytes_FromStringAndSize((const char*)op, olen);
+    PyObject* oid = oraw ? PyObject_CallOneArg(g_objectid, oraw) : nullptr;
+    Py_XDECREF(oraw);
+    PyObject* draw = PyBytes_FromStringAndSize((const char*)dp,
+                                               (Py_ssize_t)dlen);
+    PyObject* loc = draw ? PyObject_CallOneArg(g_inlineloc, draw) : nullptr;
+    Py_XDECREF(draw);
+    PyObject* pair = (oid && loc) ? PyTuple_Pack(2, oid, loc) : nullptr;
+    Py_XDECREF(oid);
+    Py_XDECREF(loc);
+    if (!pair) {
+      Py_DECREF(tid);
+      Py_DECREF(results);
+      return nullptr;
+    }
+    PyList_SET_ITEM(results, i, pair);
+  }
+  PyObject* out = PyDict_New();
+  PyObject* dur = PyFloat_FromDouble(duration);
+  if (!out || !dur || PyDict_SetItem(out, s_type, v_task_done) ||
+      PyDict_SetItem(out, s_task_id, tid) ||
+      PyDict_SetItem(out, s_results, results) ||
+      PyDict_SetItem(out, s_failed,
+                     (flags & RTP_DONE_FAILED) ? Py_True : Py_False) ||
+      PyDict_SetItem(out, s_duration_s, dur)) {
+    Py_XDECREF(out);
+    Py_XDECREF(dur);
+    Py_DECREF(tid);
+    Py_DECREF(results);
+    return nullptr;
+  }
+  Py_DECREF(dur);
+  Py_DECREF(tid);
+  Py_DECREF(results);
+  return out;
+}
+
+PyObject* decode_fence(rtp_rbuf* r, PyObject* type_value) {
+  uint64_t mid;
+  if (rtp_get_u64(r, &mid) != RTP_OK) return decode_err();
+  PyObject* out = PyDict_New();
+  PyObject* m = PyLong_FromUnsignedLongLong(mid);
+  if (!out || !m || PyDict_SetItem(out, s_type, type_value) ||
+      PyDict_SetItem(out, s_msg_id, m)) {
+    Py_XDECREF(out);
+    Py_XDECREF(m);
+    return nullptr;
+  }
+  Py_DECREF(m);
+  return out;
+}
+
+PyObject* mod_decode(PyObject*, PyObject* arg) {
+  if (!g_refarg) return py_types_registered_err();
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) return nullptr;
+  rtp_rbuf r = {(const uint8_t*)view.buf, (size_t)view.len, 0};
+  uint8_t magic, ftype;
+  PyObject* out = nullptr;
+  if (rtp_get_u8(&r, &magic) != RTP_OK || magic != RTP_MAGIC ||
+      rtp_get_u8(&r, &ftype) != RTP_OK) {
+    PyBuffer_Release(&view);
+    return decode_err();
+  }
+  switch (ftype) {
+    case RTP_F_CALL:
+      out = decode_call(&r);
+      break;
+    case RTP_F_DONE:
+      out = decode_done_body(&r);
+      break;
+    case RTP_F_DONE_BATCH: {
+      uint32_t n;
+      if (rtp_get_u32(&r, &n) != RTP_OK) {
+        out = decode_err();
+        break;
+      }
+      PyObject* items = PyList_New((Py_ssize_t)n);
+      if (!items) break;
+      bool ok = true;
+      for (uint32_t i = 0; i < n && ok; ++i) {
+        PyObject* d = decode_done_body(&r);
+        if (!d) {
+          ok = false;
+          break;
+        }
+        PyList_SET_ITEM(items, i, d);
+      }
+      if (!ok) {
+        Py_DECREF(items);
+        break;
+      }
+      out = PyDict_New();
+      if (!out || PyDict_SetItem(out, s_type, v_task_done_batch) ||
+          PyDict_SetItem(out, s_items, items)) {
+        Py_XDECREF(out);
+        out = nullptr;
+      }
+      Py_DECREF(items);
+      break;
+    }
+    case RTP_F_FENCE:
+      out = decode_fence(&r, v_fence);
+      break;
+    case RTP_F_FENCE_ACK:
+      out = decode_fence(&r, v_fence_ack);
+      break;
+    default:
+      out = decode_err();
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+PyObject* mod_register_types(PyObject*, PyObject* args) {
+  PyObject *refarg, *valuearg, *objectid, *taskid, *inlineloc;
+  if (!PyArg_ParseTuple(args, "OOOOO", &refarg, &valuearg, &objectid,
+                        &taskid, &inlineloc))
+    return nullptr;
+  Py_INCREF(refarg);
+  Py_XDECREF(g_refarg);
+  g_refarg = refarg;
+  Py_INCREF(valuearg);
+  Py_XDECREF(g_valuearg);
+  g_valuearg = valuearg;
+  Py_INCREF(objectid);
+  Py_XDECREF(g_objectid);
+  g_objectid = objectid;
+  Py_INCREF(taskid);
+  Py_XDECREF(g_taskid);
+  g_taskid = taskid;
+  Py_INCREF(inlineloc);
+  Py_XDECREF(g_inlineloc);
+  g_inlineloc = inlineloc;
+  Py_RETURN_NONE;
+}
+
+PyMethodDef module_methods[] = {
+    {"chan", mod_chan, METH_VARARGS,
+     "chan(fd, bufcap=0) -> Chan (dups fd; bufcap 0 = 256 KiB)"},
+    {"seq_queue", mod_seq_queue, METH_NOARGS, "seq_queue() -> SeqQueue"},
+    {"register_types", mod_register_types, METH_VARARGS,
+     "register_types(RefArg, ValueArg, ObjectID, TaskID, InlineLocation)"},
+    {"encode_call", mod_encode_call, METH_VARARGS,
+     "encode_call(tmpl, task_id, seq, deadline, args, kwargs, nested) -> "
+     "bytes | None (unsupported shape: caller falls back to pickle)"},
+    {"encode_done", mod_encode_done, METH_O,
+     "encode_done(task_done_dict) -> bytes | None"},
+    {"encode_done_batch", mod_encode_done_batch, METH_O,
+     "encode_done_batch([task_done_dict, ...]) -> bytes | None"},
+    {"encode_fence", mod_encode_fence, METH_O,
+     "encode_fence(msg_id) -> bytes"},
+    {"encode_fence_ack", mod_encode_fence_ack, METH_O,
+     "encode_fence_ack(msg_id) -> bytes"},
+    {"decode", mod_decode, METH_O,
+     "decode(payload) -> frame dict (same shapes the pickle dialect "
+     "produces); raises ValueError on a malformed frame"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef rtpump_module = {
+    PyModuleDef_HEAD_INIT,
+    "_rtpump",
+    "Native frame pump: framed-channel I/O, call-frame codec, per-channel "
+    "seq dispatch.",
+    -1,
+    module_methods,
+};
+
+bool init_strings() {
+  struct {
+    PyObject** slot;
+    const char* text;
+  } strs[] = {
+      {&s_type, "type"},       {&s_t, "t"},
+      {&s_i, "i"},             {&s_q, "q"},
+      {&s_a, "a"},             {&s_n, "n"},
+      {&s_d, "d"},             {&s_task_id, "task_id"},
+      {&s_results, "results"}, {&s_failed, "failed"},
+      {&s_duration_s, "duration_s"}, {&s_items, "items"},
+      {&s_msg_id, "msg_id"},   {&s_duplicate, "duplicate"},
+      {&s_object_id, "object_id"},   {&s_data, "data"},
+      {&s_bytes_attr, "_bytes"},     {&v_execute, "execute"},
+      {&v_task_done, "task_done"},
+      {&v_task_done_batch, "task_done_batch"},
+      {&v_fence, "fence"},     {&v_fence_ack, "fence_ack"},
+  };
+  for (auto& e : strs) {
+    *e.slot = PyUnicode_InternFromString(e.text);
+    if (!*e.slot) return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__rtpump(void) {
+  ChanType.tp_name = "_rtpump.Chan";
+  ChanType.tp_basicsize = sizeof(ChanObject);
+  ChanType.tp_dealloc = (destructor)Chan_dealloc;
+  ChanType.tp_flags = Py_TPFLAGS_DEFAULT;
+  ChanType.tp_methods = Chan_methods;
+  SeqQueueType.tp_name = "_rtpump.SeqQueue";
+  SeqQueueType.tp_basicsize = sizeof(SeqQueueObject);
+  SeqQueueType.tp_dealloc = (destructor)SeqQueue_dealloc;
+  SeqQueueType.tp_flags = Py_TPFLAGS_DEFAULT;
+  SeqQueueType.tp_methods = SeqQueue_methods;
+  SeqQueueType.tp_getset = SeqQueue_getset;
+  if (PyType_Ready(&ChanType) < 0 || PyType_Ready(&SeqQueueType) < 0)
+    return nullptr;
+  if (!init_strings()) return nullptr;
+  PyObject* m = PyModule_Create(&rtpump_module);
+  if (!m) return nullptr;
+  PyModule_AddIntConstant(m, "MAGIC", RTP_MAGIC);
+  PyModule_AddIntConstant(m, "CODEC_VER", RTP_CODEC_VER);
+  Py_INCREF(&ChanType);
+  PyModule_AddObject(m, "Chan", (PyObject*)&ChanType);
+  Py_INCREF(&SeqQueueType);
+  PyModule_AddObject(m, "SeqQueue", (PyObject*)&SeqQueueType);
+  return m;
+}

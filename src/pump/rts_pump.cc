@@ -1,0 +1,334 @@
+// Framed-channel pump + sequence dispatch queue (see rts_pump.h).
+
+#include "rts_pump.h"
+
+#include <errno.h>
+#include <limits.h>
+#include <stdlib.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <map>
+#include <new>
+#include <vector>
+
+#ifndef IOV_MAX
+#define IOV_MAX 1024
+#endif
+
+namespace {
+constexpr size_t kDefaultBufCap = 256 * 1024;
+constexpr uint32_t kMaxFrame = 0x7fffffffu;  // protocol.py MAX_FRAME
+}  // namespace
+
+// ---- framed channel --------------------------------------------------------
+
+struct rtp_chan {
+  int fd;
+  uint8_t* buf;
+  size_t cap;
+  size_t start;  // first unconsumed byte
+  size_t end;    // one past last valid byte
+  // RTP_BIG bookkeeping: bytes of the oversized payload not yet drained.
+  uint32_t big_remaining;
+  std::atomic<int64_t> counters[6];
+  std::atomic<int64_t> inflight;
+};
+
+rtp_chan* rtp_chan_new(int fd, size_t bufcap) {
+  int dupfd = dup(fd);
+  if (dupfd < 0) return nullptr;
+  rtp_chan* c = new (std::nothrow) rtp_chan();
+  if (!c) {
+    close(dupfd);
+    return nullptr;
+  }
+  c->fd = dupfd;
+  c->cap = bufcap ? bufcap : kDefaultBufCap;
+  c->buf = (uint8_t*)malloc(c->cap);
+  if (!c->buf) {
+    close(dupfd);
+    delete c;
+    return nullptr;
+  }
+  c->start = c->end = 0;
+  c->big_remaining = 0;
+  for (auto& a : c->counters) a.store(0, std::memory_order_relaxed);
+  c->inflight.store(0, std::memory_order_relaxed);
+  return c;
+}
+
+void rtp_chan_free(rtp_chan* c) {
+  if (!c) return;
+  if (c->fd >= 0) close(c->fd);
+  free(c->buf);
+  delete c;
+}
+
+void rtp_chan_shutdown(rtp_chan* c) {
+  if (c && c->fd >= 0) shutdown(c->fd, SHUT_RDWR);
+}
+
+int rtp_chan_fd(const rtp_chan* c) { return c->fd; }
+
+size_t rtp_chan_buffered(const rtp_chan* c) { return c->end - c->start; }
+
+int rtp_chan_has_frame(const rtp_chan* c) {
+  if (c->big_remaining) return 0;
+  size_t have = c->end - c->start;
+  if (have < 4) return 0;
+  const uint8_t* h = c->buf + c->start;
+  uint32_t n = (uint32_t)h[0] | ((uint32_t)h[1] << 8) |
+               ((uint32_t)h[2] << 16) | ((uint32_t)h[3] << 24);
+  return (size_t)n + 4 <= have;
+}
+
+int64_t rtp_chan_counter(const rtp_chan* c, int which) {
+  if (which < 0 || which > 5) return 0;
+  return c->counters[which].load(std::memory_order_relaxed);
+}
+
+int64_t rtp_chan_inflight_add(rtp_chan* c, int64_t delta) {
+  if (delta == 0) return c->inflight.load(std::memory_order_relaxed);
+  return c->inflight.fetch_add(delta, std::memory_order_relaxed) + delta;
+}
+
+static int chan_errno_status() {
+  if (errno == EAGAIN || errno == EWOULDBLOCK) return RTP_AGAIN;
+  if (errno == EPIPE || errno == ECONNRESET || errno == EBADF) return RTP_EOF;
+  return RTP_ERR;
+}
+
+// Refill: ensure at least `need` unconsumed bytes are buffered, compacting
+// or growing nothing — `need` is always <= cap here (caller guarantees).
+static int chan_fill(rtp_chan* c, size_t need) {
+  while (c->end - c->start < need) {
+    if (c->start > 0 && c->end + 1 > c->cap) {
+      // Compact so the tail of the buffer is free for the read below.
+      size_t n = c->end - c->start;
+      memmove(c->buf, c->buf + c->start, n);
+      c->start = 0;
+      c->end = n;
+    }
+    size_t room = c->cap - c->end;
+    if (room == 0) {
+      // Caller asked for more than fits contiguously: compact first.
+      size_t n = c->end - c->start;
+      memmove(c->buf, c->buf + c->start, n);
+      c->start = 0;
+      c->end = n;
+      room = c->cap - c->end;
+      if (room == 0) return RTP_ERR;  // need > cap: caller bug
+    }
+    ssize_t got;
+    do {
+      got = read(c->fd, c->buf + c->end, room);
+    } while (got < 0 && errno == EINTR);
+    if (got == 0) return RTP_EOF;
+    if (got < 0) return chan_errno_status();
+    c->end += (size_t)got;
+    c->counters[2].fetch_add(got, std::memory_order_relaxed);
+    c->counters[4].fetch_add(1, std::memory_order_relaxed);
+  }
+  return RTP_OK;
+}
+
+int rtp_chan_next(rtp_chan* c, const uint8_t** ptr, uint32_t* len) {
+  if (c->big_remaining) return RTP_ERR;  // previous RTP_BIG not drained
+  int rc = chan_fill(c, 4);
+  if (rc != RTP_OK) return rc;
+  const uint8_t* h = c->buf + c->start;
+  uint32_t n = (uint32_t)h[0] | ((uint32_t)h[1] << 8) |
+               ((uint32_t)h[2] << 16) | ((uint32_t)h[3] << 24);
+  if (n > kMaxFrame) return RTP_ERR;
+  if ((size_t)n + 4 > c->cap) {
+    // Oversized frame: hand back the length; the caller drains the
+    // payload straight into its own (e.g. PyBytes) buffer.
+    c->start += 4;
+    c->big_remaining = n;
+    *len = n;
+    return RTP_BIG;
+  }
+  rc = chan_fill(c, (size_t)n + 4);
+  if (rc != RTP_OK) return rc;
+  *ptr = c->buf + c->start + 4;
+  *len = n;
+  c->start += (size_t)n + 4;
+  if (c->start == c->end) c->start = c->end = 0;
+  c->counters[0].fetch_add(1, std::memory_order_relaxed);
+  return RTP_OK;
+}
+
+int rtp_chan_read_exact(rtp_chan* c, uint8_t* dst, uint32_t len) {
+  // Serve from the buffer first (the header read may have pulled in part
+  // of the payload), then read the remainder directly into dst.
+  // big_remaining is decremented as bytes are consumed, so a failure
+  // mid-payload leaves consistent accounting (the caller treats a
+  // partial oversized read as a dead channel either way — the consumed
+  // bytes are gone).
+  uint32_t want = len;
+  int big = c->big_remaining != 0;
+  size_t have = c->end - c->start;
+  if (have) {
+    size_t take = have < want ? have : want;
+    memcpy(dst, c->buf + c->start, take);
+    c->start += take;
+    if (c->start == c->end) c->start = c->end = 0;
+    dst += take;
+    want -= (uint32_t)take;
+    if (big) c->big_remaining -= (uint32_t)take;
+  }
+  while (want) {
+    ssize_t got;
+    do {
+      got = read(c->fd, dst, want);
+    } while (got < 0 && errno == EINTR);
+    if (got == 0) return RTP_EOF;
+    if (got < 0) return chan_errno_status();
+    dst += got;
+    want -= (uint32_t)got;
+    if (big) c->big_remaining -= (uint32_t)got;
+    c->counters[2].fetch_add(got, std::memory_order_relaxed);
+    c->counters[4].fetch_add(1, std::memory_order_relaxed);
+  }
+  c->counters[0].fetch_add(1, std::memory_order_relaxed);
+  return RTP_OK;
+}
+
+static int writev_all(rtp_chan* c, struct iovec* iov, int cnt) {
+  while (cnt > 0) {
+    int batch = cnt < IOV_MAX ? cnt : IOV_MAX;
+    ssize_t sent;
+    do {
+      sent = writev(c->fd, iov, batch);
+    } while (sent < 0 && errno == EINTR);
+    if (sent < 0) return chan_errno_status();
+    c->counters[3].fetch_add(sent, std::memory_order_relaxed);
+    c->counters[5].fetch_add(1, std::memory_order_relaxed);
+    // Advance past fully-written iovecs; trim a partially-written one.
+    while (cnt > 0 && (size_t)sent >= iov->iov_len) {
+      sent -= iov->iov_len;
+      ++iov;
+      --cnt;
+    }
+    if (cnt > 0 && sent > 0) {
+      iov->iov_base = (uint8_t*)iov->iov_base + sent;
+      iov->iov_len -= (size_t)sent;
+    }
+  }
+  return RTP_OK;
+}
+
+int rtp_chan_sendv(rtp_chan* c, const struct iovec* payloads, int n) {
+  if (n <= 0) return RTP_OK;
+  std::vector<uint8_t> headers((size_t)n * 4);
+  std::vector<struct iovec> iov((size_t)n * 2);
+  for (int i = 0; i < n; ++i) {
+    size_t len = payloads[i].iov_len;
+    if (len > kMaxFrame) return RTP_ERR;
+    uint8_t* h = headers.data() + (size_t)i * 4;
+    h[0] = (uint8_t)(len & 0xff);
+    h[1] = (uint8_t)((len >> 8) & 0xff);
+    h[2] = (uint8_t)((len >> 16) & 0xff);
+    h[3] = (uint8_t)((len >> 24) & 0xff);
+    iov[(size_t)i * 2] = {h, 4};
+    iov[(size_t)i * 2 + 1] = payloads[i];
+  }
+  int rc = writev_all(c, iov.data(), n * 2);
+  if (rc == RTP_OK)
+    c->counters[1].fetch_add(n, std::memory_order_relaxed);
+  return rc;
+}
+
+// ---- sequence dispatch queue ----------------------------------------------
+
+struct rtp_seqq {
+  uint64_t expected = 1;
+  std::map<uint64_t, void*> parked;
+  std::vector<void*> ready;
+  size_t ready_pos = 0;
+};
+
+rtp_seqq* rtp_seqq_new(void) { return new (std::nothrow) rtp_seqq(); }
+
+void rtp_seqq_free(rtp_seqq* q, void (*drop)(void*)) {
+  if (!q) return;
+  if (drop) {
+    for (auto& kv : q->parked) drop(kv.second);
+    for (size_t i = q->ready_pos; i < q->ready.size(); ++i) drop(q->ready[i]);
+  }
+  delete q;
+}
+
+int rtp_seqq_push(rtp_seqq* q, uint64_t seq, void* item, int* dup) {
+  *dup = 0;
+  if (seq < q->expected) {
+    *dup = 1;  // already executed (failover replay duplicate): drop
+    return 0;
+  }
+  if (seq != q->expected) {
+    // Out-of-order arrival: buffer until the gap fills. A seq already
+    // parked is a duplicate delivery — report it as such (inserting
+    // would silently drop the prior item without its drop callback).
+    if (!q->parked.emplace(seq, item).second) {
+      *dup = 1;
+      return 0;
+    }
+    return 0;
+  }
+  if (q->ready_pos == q->ready.size()) {
+    q->ready.clear();
+    q->ready_pos = 0;
+  }
+  size_t before = q->ready.size();
+  q->ready.push_back(item);
+  q->expected += 1;
+  auto it = q->parked.begin();
+  while (it != q->parked.end() && it->first == q->expected) {
+    q->ready.push_back(it->second);
+    q->expected += 1;
+    it = q->parked.erase(it);
+  }
+  return (int)(q->ready.size() - before);
+}
+
+void* rtp_seqq_pop(rtp_seqq* q) {
+  if (q->ready_pos >= q->ready.size()) return nullptr;
+  return q->ready[q->ready_pos++];
+}
+
+uint64_t rtp_seqq_expected(const rtp_seqq* q) { return q->expected; }
+
+size_t rtp_seqq_parked(const rtp_seqq* q) { return q->parked.size(); }
+
+// ---- write buffer ----------------------------------------------------------
+
+int rtp_wbuf_init(rtp_wbuf* b, size_t cap) {
+  if (cap < 64) cap = 64;
+  b->p = (uint8_t*)malloc(cap);
+  if (!b->p) return RTP_ERR;
+  b->len = 0;
+  b->cap = cap;
+  return RTP_OK;
+}
+
+void rtp_wbuf_freebuf(rtp_wbuf* b) {
+  free(b->p);
+  b->p = nullptr;
+  b->len = b->cap = 0;
+}
+
+int rtp_wbuf_put(rtp_wbuf* b, const void* src, size_t n) {
+  if (b->len + n > b->cap) {
+    size_t cap = b->cap * 2;
+    while (cap < b->len + n) cap *= 2;
+    uint8_t* p = (uint8_t*)realloc(b->p, cap);
+    if (!p) return RTP_ERR;
+    b->p = p;
+    b->cap = cap;
+  }
+  memcpy(b->p + b->len, src, n);
+  b->len += n;
+  return RTP_OK;
+}

@@ -1,0 +1,216 @@
+// Native frame pump for the direct actor-call plane (ISSUE 8).
+//
+// Three pieces, mirrored by the pure-Python fallback in
+// ray_tpu/core/frame_pump.py (byte-identical codec, same semantics):
+//
+//   rtp_chan  — a framed-channel read/write pump that OWNS a dup of the
+//               socket fd: buffered reads slice many `u32-LE length |
+//               payload` frames out of one read(2); batch sends coalesce a
+//               burst of queued small frames into as few writev(2) calls
+//               as possible (two iovec entries per frame: header+payload,
+//               zero concatenation copies). The CPython binding releases
+//               the GIL around every syscall.
+//   rtp_seqq  — the per-channel monotonic-sequence dispatch queue:
+//               in-order admission, out-of-order parking, duplicate drop
+//               (seq below expected = a frame the worker already executed,
+//               replayed after a channel failover).
+//   wire      — byte-layout primitives for the compact call-frame codec
+//               (constants + append/read helpers shared by the CPython
+//               module, the C++ unit tests, and — layout-wise — the
+//               Python mirror). Native frames start with RTP_MAGIC, which
+//               can never collide with a pickle payload (protocol 2+
+//               pickles start with 0x80), so pickle and native frames
+//               interleave safely on one channel.
+//
+// Threading contract (matches how the Python side drives it): ONE reader
+// thread may sit in rtp_chan_next/rtp_chan_read_exact while any number of
+// sender threads — serialized by the caller's send lock — use
+// rtp_chan_sendv. rtp_chan_shutdown may be called from any thread to wake
+// a blocked reader. The inflight counter is atomic (the caller-side
+// DIRECT_MAX_UNANSWERED backpressure accounting).
+
+#ifndef RTS_PUMP_H_
+#define RTS_PUMP_H_
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/uio.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---- wire constants --------------------------------------------------------
+
+#define RTP_MAGIC 0xA7u      // first byte of every native frame payload
+#define RTP_CODEC_VER 1u     // negotiated as "npv" in the direct hello
+
+#define RTP_F_CALL 0x01u       // compact direct call frame
+#define RTP_F_DONE 0x02u       // task_done reply
+#define RTP_F_DONE_BATCH 0x03u // u32 count + concatenated DONE bodies
+#define RTP_F_FENCE 0x04u      // u64 msg_id
+#define RTP_F_FENCE_ACK 0x05u  // u64 msg_id
+
+#define RTP_ARG_REF 0u    // RefArg(ObjectID)
+#define RTP_ARG_VALUE 1u  // ValueArg(bytes)
+
+#define RTP_CALL_HAS_ARGS 0x01u
+#define RTP_CALL_HAS_NESTED 0x02u
+#define RTP_DONE_FAILED 0x01u
+
+// ---- status codes ----------------------------------------------------------
+
+enum {
+  RTP_OK = 0,
+  RTP_BIG = 1,     // frame larger than the buffer: drain via read_exact
+  RTP_EOF = -1,    // orderly close / shutdown
+  RTP_ERR = -2,    // I/O error (errno set) or protocol violation
+  RTP_AGAIN = -3,  // SO_RCVTIMEO/SO_SNDTIMEO expired
+};
+
+// ---- framed channel --------------------------------------------------------
+
+typedef struct rtp_chan rtp_chan;
+
+// Dups `fd` (the Python socket keeps its own); bufcap 0 = default 256 KiB.
+rtp_chan* rtp_chan_new(int fd, size_t bufcap);
+void rtp_chan_free(rtp_chan* c);
+// shutdown(2) on the shared socket description: wakes a blocked reader on
+// every dup. Safe from any thread, idempotent.
+void rtp_chan_shutdown(rtp_chan* c);
+int rtp_chan_fd(const rtp_chan* c);
+
+// Next frame. RTP_OK: *ptr (into the internal buffer, valid until the next
+// next/read_exact call) and *len are set. RTP_BIG: only *len is set — the
+// payload exceeds the internal buffer and MUST be drained with
+// rtp_chan_read_exact(len) before the next frame. RTP_EOF/RTP_ERR/RTP_AGAIN
+// as above.
+int rtp_chan_next(rtp_chan* c, const uint8_t** ptr, uint32_t* len);
+int rtp_chan_read_exact(rtp_chan* c, uint8_t* dst, uint32_t len);
+// Bytes already buffered beyond the consumed frames (a cheap "is another
+// frame likely immediately available" probe for reply-batching decisions).
+size_t rtp_chan_buffered(const rtp_chan* c);
+// Whether a COMPLETE frame (header + full payload) is already buffered —
+// a recv is then guaranteed not to block. Oversized (RTP_BIG) frames
+// never satisfy this.
+int rtp_chan_has_frame(const rtp_chan* c);
+
+// Send `n` payloads as framed messages, coalesced: headers are stack
+// iovecs interleaved with the payload iovecs and the whole batch goes out
+// in as few writev calls as IOV_MAX allows. Returns RTP_OK / RTP_ERR /
+// RTP_EOF (EPIPE) / RTP_AGAIN.
+int rtp_chan_sendv(rtp_chan* c, const struct iovec* payloads, int n);
+
+// Stats counters: which = 0 frames_in, 1 frames_out, 2 bytes_in,
+// 3 bytes_out, 4 read_syscalls, 5 write_syscalls.
+int64_t rtp_chan_counter(const rtp_chan* c, int which);
+// Caller-side unanswered-call accounting (DIRECT_MAX_UNANSWERED
+// backpressure): atomic add, returns the new value. delta 0 reads.
+int64_t rtp_chan_inflight_add(rtp_chan* c, int64_t delta);
+
+// ---- sequence dispatch queue ----------------------------------------------
+
+typedef struct rtp_seqq rtp_seqq;
+
+rtp_seqq* rtp_seqq_new(void);
+// drop() is called on every still-parked/ready item (the binding DECREFs).
+void rtp_seqq_free(rtp_seqq* q, void (*drop)(void* item));
+// Push one frame. Returns the number of items now runnable in order
+// (pop them with rtp_seqq_pop); 0 with *dup=1 for a duplicate (seq below
+// expected); 0 with *dup=0 for an out-of-order frame that was parked.
+int rtp_seqq_push(rtp_seqq* q, uint64_t seq, void* item, int* dup);
+void* rtp_seqq_pop(rtp_seqq* q);
+uint64_t rtp_seqq_expected(const rtp_seqq* q);
+size_t rtp_seqq_parked(const rtp_seqq* q);
+
+// ---- byte-layout primitives ------------------------------------------------
+// Little-endian throughout; f64 is IEEE-754 bits moved through a u64.
+
+typedef struct {
+  uint8_t* p;
+  size_t len;
+  size_t cap;
+} rtp_wbuf;
+
+int rtp_wbuf_init(rtp_wbuf* b, size_t cap);
+void rtp_wbuf_freebuf(rtp_wbuf* b);
+int rtp_wbuf_put(rtp_wbuf* b, const void* src, size_t n);
+
+static inline int rtp_put_u8(rtp_wbuf* b, uint8_t v) {
+  return rtp_wbuf_put(b, &v, 1);
+}
+static inline int rtp_put_u16(rtp_wbuf* b, uint16_t v) {
+  uint8_t t[2] = {(uint8_t)(v & 0xff), (uint8_t)(v >> 8)};
+  return rtp_wbuf_put(b, t, 2);
+}
+static inline int rtp_put_u32(rtp_wbuf* b, uint32_t v) {
+  uint8_t t[4];
+  for (int i = 0; i < 4; ++i) t[i] = (uint8_t)(v >> (8 * i));
+  return rtp_wbuf_put(b, t, 4);
+}
+static inline int rtp_put_u64(rtp_wbuf* b, uint64_t v) {
+  uint8_t t[8];
+  for (int i = 0; i < 8; ++i) t[i] = (uint8_t)(v >> (8 * i));
+  return rtp_wbuf_put(b, t, 8);
+}
+static inline int rtp_put_f64(rtp_wbuf* b, double v) {
+  uint64_t bits;
+  memcpy(&bits, &v, 8);
+  return rtp_put_u64(b, bits);
+}
+
+typedef struct {
+  const uint8_t* p;
+  size_t len;
+  size_t pos;
+} rtp_rbuf;
+
+static inline int rtp_get(rtp_rbuf* r, void* dst, size_t n) {
+  if (r->pos + n > r->len) return RTP_ERR;
+  memcpy(dst, r->p + r->pos, n);
+  r->pos += n;
+  return RTP_OK;
+}
+static inline int rtp_get_u8(rtp_rbuf* r, uint8_t* v) {
+  return rtp_get(r, v, 1);
+}
+static inline int rtp_get_u16(rtp_rbuf* r, uint16_t* v) {
+  uint8_t t[2];
+  if (rtp_get(r, t, 2) != RTP_OK) return RTP_ERR;
+  *v = (uint16_t)(t[0] | (t[1] << 8));
+  return RTP_OK;
+}
+static inline int rtp_get_u32(rtp_rbuf* r, uint32_t* v) {
+  uint8_t t[4];
+  if (rtp_get(r, t, 4) != RTP_OK) return RTP_ERR;
+  *v = 0;
+  for (int i = 3; i >= 0; --i) *v = (*v << 8) | t[i];
+  return RTP_OK;
+}
+static inline int rtp_get_u64(rtp_rbuf* r, uint64_t* v) {
+  uint8_t t[8];
+  if (rtp_get(r, t, 8) != RTP_OK) return RTP_ERR;
+  *v = 0;
+  for (int i = 7; i >= 0; --i) *v = (*v << 8) | t[i];
+  return RTP_OK;
+}
+static inline int rtp_get_f64(rtp_rbuf* r, double* v) {
+  uint64_t bits;
+  if (rtp_get_u64(r, &bits) != RTP_OK) return RTP_ERR;
+  memcpy(v, &bits, 8);
+  return RTP_OK;
+}
+// Borrow `n` bytes without copying (pointer into the frame).
+static inline int rtp_get_ref(rtp_rbuf* r, const uint8_t** dst, size_t n) {
+  if (r->pos + n > r->len) return RTP_ERR;
+  *dst = r->p + r->pos;
+  r->pos += n;
+  return RTP_OK;
+}
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // RTS_PUMP_H_

@@ -1,0 +1,273 @@
+// Assert-based unit test for the frame pump (run via `make native-test`;
+// also compiled under TSAN/ASAN by `make native-tsan` / `make native-asan`).
+#include "rts_pump.h"
+
+#include <assert.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+// ---- framing: single frames, batches, buffered slicing ---------------------
+
+static void test_framing_roundtrip() {
+  int fds[2];
+  assert(socketpair(AF_UNIX, SOCK_STREAM, 0, fds) == 0);
+  rtp_chan* tx = rtp_chan_new(fds[0], 0);
+  rtp_chan* rx = rtp_chan_new(fds[1], 4096);
+  assert(tx && rx);
+  close(fds[0]);
+  close(fds[1]);  // the chans own dups
+
+  // A batch of small frames goes out coalesced and arrives intact.
+  const char* msgs[] = {"alpha", "b", "", "delta-delta-delta"};
+  struct iovec iov[4];
+  for (int i = 0; i < 4; ++i) {
+    iov[i].iov_base = (void*)msgs[i];
+    iov[i].iov_len = strlen(msgs[i]);
+  }
+  assert(rtp_chan_sendv(tx, iov, 4) == RTP_OK);
+  // One writev for the whole burst (8 iovecs < IOV_MAX).
+  assert(rtp_chan_counter(tx, 5) == 1);
+  assert(rtp_chan_counter(tx, 1) == 4);
+
+  for (int i = 0; i < 4; ++i) {
+    const uint8_t* p;
+    uint32_t n;
+    assert(rtp_chan_next(rx, &p, &n) == RTP_OK);
+    assert(n == strlen(msgs[i]));
+    assert(memcmp(p, msgs[i], n) == 0);
+  }
+  // The 4-frame burst was buffered by the first read(2).
+  assert(rtp_chan_counter(rx, 4) == 1);
+  assert(rtp_chan_counter(rx, 0) == 4);
+  assert(rtp_chan_buffered(rx) == 0);
+
+  // Oversized frame (> rx buffer cap): RTP_BIG + read_exact drain.
+  size_t big_n = 16000;
+  uint8_t* big = (uint8_t*)malloc(big_n);
+  for (size_t i = 0; i < big_n; ++i) big[i] = (uint8_t)(i * 7);
+  struct iovec bv = {big, big_n};
+  assert(rtp_chan_sendv(tx, &bv, 1) == RTP_OK);
+  const uint8_t* p;
+  uint32_t n;
+  int rc = rtp_chan_next(rx, &p, &n);
+  assert(rc == RTP_BIG && n == big_n);
+  uint8_t* got = (uint8_t*)malloc(big_n);
+  assert(rtp_chan_read_exact(rx, got, n) == RTP_OK);
+  assert(memcmp(big, got, big_n) == 0);
+  free(big);
+  free(got);
+
+  // EOF after peer shutdown.
+  rtp_chan_shutdown(tx);
+  assert(rtp_chan_next(rx, &p, &n) == RTP_EOF);
+  rtp_chan_free(tx);
+  rtp_chan_free(rx);
+}
+
+// ---- threaded pump: writer floods, reader drains (TSAN coverage) -----------
+
+struct pump_thread_arg {
+  rtp_chan* chan;
+  int frames;
+};
+
+static void* writer_main(void* argp) {
+  pump_thread_arg* a = (pump_thread_arg*)argp;
+  uint8_t payload[512];
+  for (int i = 0; i < a->frames; ++i) {
+    memset(payload, i & 0xff, sizeof(payload));
+    struct iovec iov[8];
+    int burst = 1 + (i % 8);
+    for (int j = 0; j < burst; ++j) {
+      iov[j].iov_base = payload;
+      iov[j].iov_len = (size_t)(1 + ((i + j) % sizeof(payload)));
+    }
+    if (rtp_chan_sendv(a->chan, iov, burst) != RTP_OK) return (void*)1;
+    i += burst - 1;
+    rtp_chan_inflight_add(a->chan, burst);
+  }
+  rtp_chan_shutdown(a->chan);
+  return nullptr;
+}
+
+static void test_threaded_pump() {
+  int fds[2];
+  assert(socketpair(AF_UNIX, SOCK_STREAM, 0, fds) == 0);
+  // Small send buffer to force partial writev paths.
+  int snd = 8192;
+  setsockopt(fds[0], SOL_SOCKET, SO_SNDBUF, &snd, sizeof(snd));
+  rtp_chan* tx = rtp_chan_new(fds[0], 0);
+  rtp_chan* rx = rtp_chan_new(fds[1], 8192);
+  close(fds[0]);
+  close(fds[1]);
+  pump_thread_arg arg = {tx, 4000};
+  pthread_t th;
+  assert(pthread_create(&th, nullptr, writer_main, &arg) == 0);
+  int64_t frames = 0;
+  for (;;) {
+    const uint8_t* p;
+    uint32_t n;
+    int rc = rtp_chan_next(rx, &p, &n);
+    if (rc == RTP_EOF) break;
+    assert(rc == RTP_OK);
+    assert(n >= 1 && n <= 512);
+    ++frames;
+    rtp_chan_inflight_add(rx, 1);
+  }
+  void* wret = nullptr;
+  pthread_join(th, &wret);
+  assert(wret == nullptr);
+  assert(frames == rtp_chan_counter(tx, 1));
+  assert(frames == rtp_chan_counter(rx, 0));
+  assert(rtp_chan_inflight_add(rx, 0) == frames);
+  rtp_chan_free(tx);
+  rtp_chan_free(rx);
+}
+
+// ---- sequence dispatch queue ----------------------------------------------
+
+static void test_seqq() {
+  rtp_seqq* q = rtp_seqq_new();
+  int dup = 0;
+  // In-order admission.
+  assert(rtp_seqq_push(q, 1, (void*)1, &dup) == 1 && !dup);
+  assert(rtp_seqq_pop(q) == (void*)1);
+  assert(rtp_seqq_pop(q) == nullptr);
+  // Out-of-order parking: 4 and 3 park until 2 fills the gap.
+  assert(rtp_seqq_push(q, 4, (void*)4, &dup) == 0 && !dup);
+  assert(rtp_seqq_push(q, 3, (void*)3, &dup) == 0 && !dup);
+  assert(rtp_seqq_parked(q) == 2);
+  assert(rtp_seqq_push(q, 2, (void*)2, &dup) == 3 && !dup);
+  assert(rtp_seqq_pop(q) == (void*)2);
+  assert(rtp_seqq_pop(q) == (void*)3);
+  assert(rtp_seqq_pop(q) == (void*)4);
+  assert(rtp_seqq_parked(q) == 0);
+  assert(rtp_seqq_expected(q) == 5);
+  // Duplicate drop (failover replay of an already-executed seq).
+  assert(rtp_seqq_push(q, 2, (void*)2, &dup) == 0 && dup == 1);
+  assert(rtp_seqq_expected(q) == 5);
+  // Random-permutation drain stays totally ordered.
+  uint64_t order[64];
+  for (int i = 0; i < 64; ++i) order[i] = 5 + (uint64_t)i;
+  srand(1234);
+  for (int i = 63; i > 0; --i) {
+    int j = rand() % (i + 1);
+    uint64_t t = order[i];
+    order[i] = order[j];
+    order[j] = t;
+  }
+  uint64_t next_expect = 5;
+  int drained = 0;
+  for (int i = 0; i < 64; ++i) {
+    int n = rtp_seqq_push(q, order[i], (void*)(uintptr_t)order[i], &dup);
+    assert(!dup);
+    for (int k = 0; k < n; ++k) {
+      void* item = rtp_seqq_pop(q);
+      assert((uint64_t)(uintptr_t)item == next_expect);
+      ++next_expect;
+      ++drained;
+    }
+  }
+  assert(drained == 64 && rtp_seqq_parked(q) == 0);
+  // Duplicate delivery of a still-PARKED seq: reported as duplicate,
+  // the FIRST delivery stays parked (no silent overwrite/leak).
+  assert(rtp_seqq_push(q, 100, (void*)100, &dup) == 0 && !dup);
+  assert(rtp_seqq_push(q, 100, (void*)999, &dup) == 0 && dup == 1);
+  assert(rtp_seqq_parked(q) == 1);
+  // Fill the gap up to 99; when it closes, the retained first delivery
+  // of 100 (value 100, not the duplicate's 999) drains last.
+  uint64_t last = 0;
+  for (uint64_t s = rtp_seqq_expected(q); s < 100; ++s) {
+    int n = rtp_seqq_push(q, s, (void*)(uintptr_t)s, &dup);
+    for (int k = 0; k < n; ++k)
+      last = (uint64_t)(uintptr_t)rtp_seqq_pop(q);
+  }
+  assert(rtp_seqq_expected(q) == 101);
+  assert(last == 100);
+  assert(rtp_seqq_parked(q) == 0);
+  rtp_seqq_free(q, nullptr);
+}
+
+static int g_dropped = 0;
+static void count_drop(void*) { ++g_dropped; }
+
+static void test_seqq_drop() {
+  rtp_seqq* q = rtp_seqq_new();
+  int dup;
+  rtp_seqq_push(q, 5, (void*)5, &dup);  // parked
+  rtp_seqq_push(q, 1, (void*)1, &dup);  // ready, never popped
+  rtp_seqq_free(q, count_drop);
+  assert(g_dropped == 2);  // parked + unpopped ready both released
+}
+
+// ---- wire primitives: the codec byte layout the Python mirror matches ------
+
+static void test_wire_layout() {
+  rtp_wbuf b;
+  assert(rtp_wbuf_init(&b, 8) == RTP_OK);  // tiny: forces growth
+  rtp_put_u8(&b, RTP_MAGIC);
+  rtp_put_u8(&b, RTP_F_CALL);
+  rtp_put_u32(&b, 7);              // tmpl id
+  rtp_put_u64(&b, 0x1122334455ull);  // seq
+  rtp_put_u8(&b, 16);
+  uint8_t id[16];
+  for (int i = 0; i < 16; ++i) id[i] = (uint8_t)i;
+  rtp_wbuf_put(&b, id, 16);
+  rtp_put_f64(&b, 1234.5);
+  rtp_put_u8(&b, RTP_CALL_HAS_NESTED);
+  rtp_put_u32(&b, 1);
+  rtp_put_u8(&b, 16);
+  rtp_wbuf_put(&b, id, 16);
+
+  // Fixed prefix bytes (guards the little-endian layout the Python
+  // mirror in frame_pump.py hard-codes with struct '<').
+  assert(b.p[0] == 0xA7 && b.p[1] == 0x01);
+  assert(b.p[2] == 7 && b.p[3] == 0 && b.p[4] == 0 && b.p[5] == 0);
+  assert(b.p[6] == 0x55 && b.p[7] == 0x44 && b.p[8] == 0x33);
+
+  rtp_rbuf r = {b.p, b.len, 0};
+  uint8_t u8;
+  uint32_t u32;
+  uint64_t u64;
+  double f64;
+  assert(rtp_get_u8(&r, &u8) == RTP_OK && u8 == RTP_MAGIC);
+  assert(rtp_get_u8(&r, &u8) == RTP_OK && u8 == RTP_F_CALL);
+  assert(rtp_get_u32(&r, &u32) == RTP_OK && u32 == 7);
+  assert(rtp_get_u64(&r, &u64) == RTP_OK && u64 == 0x1122334455ull);
+  assert(rtp_get_u8(&r, &u8) == RTP_OK && u8 == 16);
+  const uint8_t* ref;
+  assert(rtp_get_ref(&r, &ref, 16) == RTP_OK && memcmp(ref, id, 16) == 0);
+  assert(rtp_get_f64(&r, &f64) == RTP_OK && f64 == 1234.5);
+  assert(rtp_get_u8(&r, &u8) == RTP_OK && u8 == RTP_CALL_HAS_NESTED);
+  assert(rtp_get_u32(&r, &u32) == RTP_OK && u32 == 1);
+  assert(rtp_get_u8(&r, &u8) == RTP_OK && u8 == 16);
+  assert(rtp_get_ref(&r, &ref, 16) == RTP_OK);
+  assert(r.pos == r.len);
+  // Truncated read fails cleanly.
+  assert(rtp_get_u32(&r, &u32) == RTP_ERR);
+  rtp_wbuf_freebuf(&b);
+
+  // u16 round trip (kwarg key length field).
+  rtp_wbuf b2;
+  assert(rtp_wbuf_init(&b2, 8) == RTP_OK);
+  rtp_put_u16(&b2, 0xBEEF);
+  rtp_rbuf r2 = {b2.p, b2.len, 0};
+  uint16_t u16;
+  assert(rtp_get_u16(&r2, &u16) == RTP_OK && u16 == 0xBEEF);
+  assert(b2.p[0] == 0xEF && b2.p[1] == 0xBE);
+  rtp_wbuf_freebuf(&b2);
+}
+
+int main() {
+  test_framing_roundtrip();
+  test_threaded_pump();
+  test_seqq();
+  test_seqq_drop();
+  test_wire_layout();
+  printf("rts_pump_test OK\n");
+  return 0;
+}

@@ -227,13 +227,12 @@ def validate_transfer_config():
     ]
 
 
-def validate_data_channel_pickle_free(pkg_dir):
-    """The data plane's whole point is no pickle on the chunk path: flag
-    any pickle/cloudpickle import in core/data_channel.py."""
-    path = os.path.join(pkg_dir, "core", "data_channel.py")
+def _pickle_ban(path, rel, why):
+    """Flag any pickle/cloudpickle import in ``path`` (AST-level, so
+    aliasing can't hide one)."""
     if not os.path.isfile(path):
-        return [f"{path}: missing (data plane deleted without updating "
-                f"the lint?)"]
+        return [f"{path}: missing (module deleted without updating the "
+                f"lint?)"]
     with open(path) as f:
         try:
             tree = ast.parse(f.read(), filename=path)
@@ -249,10 +248,70 @@ def validate_data_channel_pickle_free(pkg_dir):
             names = [node.module.split(".")[0]]
         for name in names:
             if name in banned:
+                failures.append(f"{rel}:{node.lineno}: imports {name!r} — "
+                                f"{why}")
+    return failures
+
+
+def validate_data_channel_pickle_free(pkg_dir):
+    """The data plane's whole point is no pickle on the chunk path: flag
+    any pickle/cloudpickle import in core/data_channel.py."""
+    return _pickle_ban(
+        os.path.join(pkg_dir, "core", "data_channel.py"),
+        "ray_tpu/core/data_channel.py",
+        "the data plane must stay pickle-free (binary frames only)",
+    )
+
+
+# ---- native frame-pump lint -----------------------------------------------
+# The pump's metric surface (core/frame_pump.py) — README documents these
+# names; the bench's satellite_guards block reads the fallback counter.
+NATIVE_METRICS = {
+    "ray_tpu_native_fallbacks_total": "counter",
+    "ray_tpu_native_pump_channels": "gauge",
+}
+
+
+def validate_native_pump(pkg_dir, repo_root, declared):
+    """(a) fallback counter + engaged/active gauge are declared with the
+    documented kinds; (b) the pump bindings module is pickle-banned like
+    data_channel.py — the codec's whole point is no pickle on the hot
+    dialect (generic control frames delegate to protocol.dumps_msg at
+    call sites); (c) the C++ binding never imports a pickle module
+    either."""
+    failures = []
+    for name, kind in sorted(NATIVE_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: native frame-pump metric not declared "
+                f"(core/frame_pump.py drifted from the documented surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    failures += _pickle_ban(
+        os.path.join(pkg_dir, "core", "frame_pump.py"),
+        "ray_tpu/core/frame_pump.py",
+        "the native pump bindings must stay pickle-free (the codec "
+        "replaces pickle on the hot dialect; generic frames go through "
+        "protocol.dumps_msg at the call sites)",
+    )
+    module_cc = os.path.join(repo_root, "src", "pump", "_rtpump_module.cc")
+    if not os.path.isfile(module_cc):
+        failures.append(f"{module_cc}: missing (pump deleted without "
+                        f"updating the lint?)")
+    else:
+        with open(module_cc) as f:
+            src = f.read()
+        for needle in ("PyImport_ImportModule(\"pickle\"",
+                       "PyImport_ImportModule(\"cloudpickle\"",
+                       "PyImport_ImportModule(\"_pickle\""):
+            if needle in src:
                 failures.append(
-                    f"ray_tpu/core/data_channel.py:{node.lineno}: imports "
-                    f"{name!r} — the data plane must stay pickle-free "
-                    f"(binary frames only)"
+                    f"src/pump/_rtpump_module.cc: {needle}...) — the "
+                    f"native codec must not round-trip through pickle"
                 )
     return failures
 
@@ -740,6 +799,11 @@ def main() -> int:
           f"data_channel pickle ban")
     failures += validate_actor_metrics(declared)
     failures += validate_actor_config()
+    failures += validate_native_pump(
+        os.path.join(repo_root, "ray_tpu"), repo_root, declared
+    )
+    print(f"checked {len(NATIVE_METRICS)} native-pump metric name(s), "
+          f"frame_pump + _rtpump_module pickle bans")
     serve_failures, n_hot = validate_serve_hot_path(
         os.path.join(repo_root, "ray_tpu")
     )

@@ -1,16 +1,19 @@
-"""Direct actor-call plane bench (PERF_r07): sync actor round-trips
-measured unloaded and under a pipelined background call stream, over the
-direct channel AND over the NM-mediated path (direct_actor_calls=0) in
-fresh sessions — the before/after this plane exists for. Also injects a
-channel death mid-run to prove transparent NM-path fallback + automatic
-re-engagement (zero steady-state fallbacks on either side of the fault),
-and runs the rpc dispatch micro-bench guarding the compiled-validator
-satellite.
+"""Direct actor-call plane bench (PERF_r08): sync actor round-trips
+measured unloaded and under a pipelined background call stream — with
+the native frame pump engaged (default), with the pump forced off
+(RTPU_NO_NATIVE=1: the pure-Python fallback mode, recorded side by side
+so a regression in EITHER mode is caught by the bench record itself),
+and over the NM-mediated path (direct_actor_calls=0) in fresh sessions.
+Also injects a channel death mid-run to prove transparent NM-path
+fallback + automatic re-engagement (zero steady-state fallbacks on
+either side of the fault), and runs the rpc dispatch micro-bench
+guarding the compiled-validator satellite.
 
 Usage: python tools/run_actor_bench.py [out.json] [--calls N]
 
-`make perf-actor` runs the default configuration and records
-PERF_r07.json.
+`make perf-actor` runs the default configuration and MERGES the record
+into PERF_r08.json (make perf-native writes its sections into the same
+file).
 """
 
 from __future__ import annotations
@@ -68,13 +71,16 @@ def _sync_rtt(ray_tpu, call, calls: int, windows: int = 3):
     }
 
 
-def _measure_mode(direct: bool, calls: int):
+def _measure_mode(direct: bool, calls: int, native: bool = True):
     """One fresh session: unloaded + loaded sync RTT (loaded = a
     background thread streaming 64-deep pipelined bursts at a second
-    actor), plus the plane's own counters when direct is on."""
+    actor), plus the plane's own counters when direct is on. ``native``
+    False forces RTPU_NO_NATIVE=1 — the pure-Python fallback mode."""
     import ray_tpu
 
     os.environ["RAY_TPU_DIRECT_ACTOR_CALLS"] = "1" if direct else "0"
+    if not native:
+        os.environ["RTPU_NO_NATIVE"] = "1"
     from ray_tpu.core.config import reset_config
 
     reset_config()
@@ -121,13 +127,22 @@ def _measure_mode(direct: bool, calls: int):
         out["loaded"]["background_calls"] = bg_count[0]
 
         if direct:
+            from ray_tpu.core import frame_pump
             from ray_tpu.core.runtime_context import current_runtime
 
             rt = current_runtime()
             stats = rt.direct_stats()
+            pump = frame_pump.pump_stats()
+            st_p = rt._direct_states.get(p.actor_id.binary())
             out["direct_stats"] = {
                 "calls": stats["calls"],
                 "fallbacks_steady_state": stats["fallbacks"],
+            }
+            out["native_pump"] = {
+                "engaged": bool(st_p and st_p.get("chan")
+                                and st_p["chan"].native),
+                "engaged_channels": pump["engaged_channels"],
+                "native_fallbacks_total": pump["fallbacks"],
             }
             nm = rt._nm
             out["nm_completion_batches"] = {
@@ -157,6 +172,8 @@ def _measure_mode(direct: bool, calls: int):
     finally:
         ray_tpu.shutdown()
         os.environ.pop("RAY_TPU_DIRECT_ACTOR_CALLS", None)
+        if not native:
+            os.environ.pop("RTPU_NO_NATIVE", None)
         reset_config()
     return out
 
@@ -207,18 +224,26 @@ def main():
             out_path = args[i]
             i += 1
 
-    result = {
-        "note": (
-            "Round-7 record for the direct actor-call plane. direct vs "
-            "nm_path run the SAME build in fresh sessions with the "
-            "plane on/off (RAY_TPU_DIRECT_ACTOR_CALLS) — the NM-path "
-            "numbers are the before this plane exists for. loaded = "
-            "sync round-trips while a second actor serves a 64-deep "
-            "pipelined background stream."
-        ),
-        "config": {"physical_cores": os.cpu_count(), "calls": calls},
-    }
+    result = {}
+    if out_path and os.path.exists(out_path):
+        # PERF_r08.json is shared with `make perf-native`: merge.
+        try:
+            with open(out_path) as f:
+                result = json.load(f)
+        except Exception:
+            result = {}
+    result["note"] = (
+        "Round-8 record for the direct actor-call plane on the native "
+        "frame pump. direct (pump engaged), direct_fallback "
+        "(RTPU_NO_NATIVE=1: pure-Python dialect) and nm_path "
+        "(RAY_TPU_DIRECT_ACTOR_CALLS=0) run the SAME build in fresh "
+        "sessions. loaded = sync round-trips while a second actor "
+        "serves a 64-deep pipelined background stream."
+    )
+    result["config"] = {"physical_cores": os.cpu_count(), "calls": calls}
     result["direct"] = _measure_mode(direct=True, calls=calls)
+    result["direct_fallback"] = _measure_mode(direct=True, calls=calls,
+                                              native=False)
     result["nm_path"] = _measure_mode(direct=False, calls=calls)
     d, n = result["direct"], result["nm_path"]
     result["speedup_direct_vs_nm"] = {
@@ -243,6 +268,7 @@ def main():
     n_done = batches.get("direct_calls_done", 0)
     n_batches = max(1, batches.get("direct_done_batches", 1))
     fi = d.get("fault_injection", {})
+    fb = result["direct_fallback"]
     result["satellite_guards"] = {
         "rpc_dispatch_ops_s": result["rpc_dispatch_ops_s"],
         "rpc_note": (
@@ -255,7 +281,45 @@ def main():
             "batches": n_batches,
             "calls_per_batch": round(n_done / n_batches, 1),
         },
+        "native_vs_fallback": {
+            # Both modes recorded side by side: a regression in EITHER
+            # the native pump or the pure-Python fallback path is caught
+            # by this record itself.
+            "native_loaded_ops_s": d["loaded"]["ops_s_best"],
+            "fallback_loaded_ops_s": fb["loaded"]["ops_s_best"],
+            "native_unloaded_ops_s": d["unloaded"]["ops_s_best"],
+            "fallback_unloaded_ops_s": fb["unloaded"]["ops_s_best"],
+            "native_engaged": d.get("native_pump", {}).get("engaged"),
+            "fallback_mode_forced": bool(
+                not fb.get("native_pump", {}).get("engaged", False)
+            ),
+            "ray_tpu_native_fallbacks_total": d.get(
+                "native_pump", {}).get("native_fallbacks_total"),
+        },
     }
+    vs_r07 = {}
+    r07_path = os.path.join(_REPO, "PERF_r07.json")
+    if os.path.exists(r07_path):
+        try:
+            with open(r07_path) as f:
+                r07 = json.load(f)
+            vs_r07 = {
+                "r07_loaded_ops_s": r07["direct"]["loaded"]["ops_s_best"],
+                "r07_unloaded_ops_s":
+                    r07["direct"]["unloaded"]["ops_s_best"],
+                "loaded_ops_vs_r07": round(
+                    d["loaded"]["ops_s_best"]
+                    / r07["direct"]["loaded"]["ops_s_best"], 2),
+                "unloaded_ops_vs_r07": round(
+                    d["unloaded"]["ops_s_best"]
+                    / r07["direct"]["unloaded"]["ops_s_best"], 2),
+                "loaded_p50_vs_r07": round(
+                    r07["direct"]["loaded"]["p50_us"]
+                    / max(1e-9, d["loaded"]["p50_us"]), 2),
+                "target": ">=2x r07 loaded ops",
+            }
+        except Exception:
+            pass
     result["acceptance"] = {
         "reference_bar": ">=5.0k/s loaded sync actor RTT (reference box)",
         "same_box_result": (
@@ -266,6 +330,7 @@ def main():
             f"loaded p50 {d['loaded']['p50_us']}us vs NM "
             f"{n['loaded']['p50_us']}us"
         ),
+        "vs_perf_r07": vs_r07,
         "fallback_pulls_steady_state": d.get("direct_stats", {}).get(
             "fallbacks_steady_state"),
         "injected_channel_death": (
