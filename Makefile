@@ -13,17 +13,29 @@ EXT       := ray_tpu/_native/_rtstore.so
 PUMP_SRC  := src/pump/rts_pump.cc
 PUMP_EXT  := ray_tpu/_native/_rtpump.so
 
-.PHONY: native native-test cpp-client clean check-obs check-metrics perf-transfer perf-actor perf-native chaos overload
+.PHONY: native native-test native-ubsan cpp-client clean check check-obs check-metrics rtlint perf-transfer perf-actor perf-native chaos overload
 
-# Observability lint: every Counter/Gauge/Histogram the package declares
-# at import time (Prometheus-valid names, counters end in _total, no
-# kind conflicts) plus every cluster-event emit site (severity/source
-# must resolve to the enums declared in ray_tpu/util/events.py).
+# Static analysis: the rtlint distributed-invariant analyzer (pass
+# catalog: python -m tools.rtlint --list). Exits non-zero on any
+# finding that is neither baselined (tools/rtlint/baseline.json) nor
+# pragma-suppressed (# rtlint: disable=<pass>).
+rtlint:
+	$(PY) -m tools.rtlint
+
+# Observability lint (the "obs" pass group of rtlint; the old
+# tools/check_metric_names.py entry point remains as an alias shim):
+# every Counter/Gauge/Histogram the package declares at import time
+# plus event emit sites, chaos registry, pickle bans, serve hot path.
 check-obs:
-	$(PY) tools/check_metric_names.py
+	$(PY) -m tools.rtlint --passes obs
 
 # Historical alias for check-obs.
 check-metrics: check-obs
+
+# CI umbrella: the full static-analysis plane + the sanitized native
+# build/tests. Tier-1 docs point here. (rtlint already includes the
+# obs pass group, so check-obs is not repeated.)
+check: rtlint native-test
 
 # Chaos plane acceptance suite: the full fault-injection partition
 # matrix (every registered point proves its advertised degradation path
@@ -92,8 +104,9 @@ build/rts_pump_test: $(PUMP_SRC) src/pump/rts_pump_test.cc src/pump/rts_pump.h
 	  -o $@ $(LDLIBS)
 
 # CI-ready native gate: every C++ unit test (store + pump) plain AND
-# under both sanitizers — any report fails the target (halt_on_error).
-native-test: build/rts_store_test build/rts_pump_test native-tsan native-asan
+# under all three sanitizers — any report fails the target
+# (halt_on_error / -fno-sanitize-recover).
+native-test: build/rts_store_test build/rts_pump_test native-tsan native-asan native-ubsan
 	./build/rts_store_test
 	./build/rts_pump_test
 
@@ -131,4 +144,22 @@ native-asan: build/rts_store_test_asan build/rts_pump_test_asan
 	ASAN_OPTIONS=detect_leaks=1:halt_on_error=1 ./build/rts_store_test_asan
 	ASAN_OPTIONS=detect_leaks=1:halt_on_error=1 ./build/rts_pump_test_asan
 
-sanitize: native-tsan native-asan
+# Standalone UBSAN builds (the ASAN combo above folds undefined in, but
+# a dedicated -fsanitize=undefined build catches UB that ASAN's shadow
+# memory masks, and -fno-sanitize-recover=undefined turns every report
+# into a hard failure instead of a log line).
+build/rts_store_test_ubsan: $(STORE_SRC) src/store/rts_store_test.cc src/store/rts_store.h
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -fsanitize=undefined -fno-sanitize-recover=undefined \
+	  -Isrc/store $(STORE_SRC) src/store/rts_store_test.cc -o $@ $(LDLIBS)
+
+build/rts_pump_test_ubsan: $(PUMP_SRC) src/pump/rts_pump_test.cc src/pump/rts_pump.h
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -fsanitize=undefined -fno-sanitize-recover=undefined \
+	  -Isrc/pump $(PUMP_SRC) src/pump/rts_pump_test.cc -o $@ $(LDLIBS)
+
+native-ubsan: build/rts_store_test_ubsan build/rts_pump_test_ubsan
+	UBSAN_OPTIONS=print_stacktrace=1:halt_on_error=1 ./build/rts_store_test_ubsan
+	UBSAN_OPTIONS=print_stacktrace=1:halt_on_error=1 ./build/rts_pump_test_ubsan
+
+sanitize: native-tsan native-asan native-ubsan

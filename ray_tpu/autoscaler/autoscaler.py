@@ -349,8 +349,15 @@ class Autoscaler:
         while not self._stop.is_set():
             try:
                 self._reconcile_once()
-            except Exception:
-                pass
+            except Exception as e:
+                # A provider/API error silently stalling scale-up was an
+                # rtlint swallowed-failure finding: every failed
+                # reconcile now leaves a cluster event before retrying.
+                cluster_events.emit(
+                    cluster_events.WARNING, cluster_events.AUTOSCALER,
+                    f"autoscaler reconcile failed: {e!r}",
+                    custom_fields={"error_type": type(e).__name__},
+                )
             self._stop.wait(cfg.interval_s)
 
     def _reconcile_once(self) -> None:

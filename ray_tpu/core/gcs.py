@@ -379,25 +379,24 @@ class GcsService:
     def mark_dirty(self):
         self._dirty = True
 
-    def _maybe_snapshot(self, *, force: bool = False):
+    def _maybe_snapshot(self):
         """Rate-limited; the table COPY happens on the loop (consistent
         view) but pickling + file I/O run in the default executor so a
-        busy KV channel can't stall the control plane."""
+        busy KV channel can't stall the control plane. The shutdown path
+        uses :meth:`_snapshot_final` instead — keeping the inline write
+        out of this method means the loop-side callers provably never
+        touch the filesystem (rtlint loop-blocking)."""
         if not self._storage_path or not self._dirty:
             return
         now = time.monotonic()
-        if not force:
-            if getattr(self, "_snapshot_inflight", False):
-                return
-            if now - getattr(self, "_last_snapshot", 0.0) < \
-                    self.SNAPSHOT_MIN_INTERVAL_S:
-                return
+        if getattr(self, "_snapshot_inflight", False):
+            return
+        if now - getattr(self, "_last_snapshot", 0.0) < \
+                self.SNAPSHOT_MIN_INTERVAL_S:
+            return
         self._dirty = False
         self._last_snapshot = now
         snap = self._build_snapshot()
-        if force:
-            self._persist_snapshot(snap)
-            return
         self._snapshot_inflight = True
 
         def write():
@@ -410,6 +409,15 @@ class GcsService:
             self._loop.run_in_executor(None, write)
         except Exception:
             self._snapshot_inflight = False
+
+    def _snapshot_final(self):
+        """Synchronous last snapshot on shutdown (stop() runs off the
+        serving path; durability beats latency here)."""
+        if not self._storage_path or not self._dirty:
+            return
+        self._dirty = False
+        self._last_snapshot = time.monotonic()
+        self._persist_snapshot(self._build_snapshot())
 
     def _build_snapshot(self):
         return {
@@ -443,7 +451,9 @@ class GcsService:
         import pickle
 
         try:
-            with open(self._storage_path, "rb") as f:
+            # Boot path: start() restores BEFORE the server accepts its
+            # first connection, so there is nothing to stall yet.
+            with open(self._storage_path, "rb") as f:  # rtlint: disable=loop-blocking
                 snap = pickle.load(f)
         except FileNotFoundError:
             return
@@ -461,7 +471,7 @@ class GcsService:
         )
 
     def stop(self):
-        self._maybe_snapshot(force=True)
+        self._snapshot_final()
         if self._events_task is not None:
             self._events_task.cancel()
         self.events.close()

@@ -100,11 +100,26 @@ def _free_location(loc) -> None:
             pass
 
 
+def _read_text_tail(path: str, nbytes: int) -> str:
+    """Last ``nbytes`` of a text file via seek (bounded read — never the
+    whole file). Executor-thread helper for crash diagnosis; '' on any
+    I/O error."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - nbytes))
+            return f.read(nbytes).decode("utf-8", errors="replace")
+    except OSError:
+        return ""
+
+
 def _system_memory_usage_fraction() -> float:
     """System memory usage in [0, 1] from /proc/meminfo (ref analogue:
     MemoryMonitor::GetMemoryBytes, common/memory_monitor.h)."""
     info = {}
-    with open("/proc/meminfo") as f:
+    # procfs is memory-backed: this "file" read never touches disk.
+    with open("/proc/meminfo") as f:  # rtlint: disable=loop-blocking
         for line in f:
             key, _, rest = line.partition(":")
             try:
@@ -909,6 +924,12 @@ class NodeManager:
         while not self._shutdown:
             await asyncio.sleep(0.5)
             for worker_id, proc in list(self._pending_procs.items()):
+                if worker_id not in self._pending_procs:
+                    # The log-tail await below yields the loop: a later
+                    # snapshot entry may have registered (and been
+                    # popped) during an earlier iteration's hop — its
+                    # accounting already happened at registration.
+                    continue
                 if proc.poll() is None:
                     continue
                 self._pending_procs.pop(worker_id, None)
@@ -920,12 +941,12 @@ class NodeManager:
                 log = os.path.join(
                     self.session_dir, "logs", f"worker-{worker_id.hex()[:8]}.log"
                 )
-                detail = ""
-                try:
-                    with open(log, "r") as f:
-                        detail = f.read()[-2000:]
-                except OSError:
-                    pass
+                # Crash diagnosis reads the log tail off the loop: the
+                # old inline read pulled the WHOLE file through the loop
+                # thread (rtlint loop-blocking).
+                detail = await self._loop.run_in_executor(
+                    None, _read_text_tail, log, 2000
+                )
                 sys.stderr.write(
                     f"[ray_tpu] worker {worker_id.hex()[:8]} exited during "
                     f"startup (code {proc.returncode}). Log tail:\n{detail}\n"
@@ -994,9 +1015,6 @@ class NodeManager:
             )
             self._schedule()
             return worker_id
-        log_path = os.path.join(self.session_dir, "logs")
-        os.makedirs(log_path, exist_ok=True)
-        out = open(os.path.join(log_path, f"worker-{worker_id.hex()[:8]}.log"), "wb")
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_NODE_SOCKET"] = self.socket_path
@@ -1035,14 +1053,35 @@ class NodeManager:
             env.pop("PALLAS_AXON_POOL_IPS", None)
             if env.get("JAX_PLATFORMS", "") in ("", "axon", "tpu"):
                 env["JAX_PLATFORMS"] = "cpu"
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env,
-            stdout=out,
-            stderr=subprocess.STDOUT,
-            start_new_session=True,
-        )
-        out.close()
+        # Type registered BEFORE the executor hop: a worker that boots
+        # fast enough to register during the await below must pop its
+        # real type (and decrement the right starting slot), not the
+        # "cpu" default.
+        self._pending_types[worker_id] = worker_type
+        # fork+exec and the log-file open are milliseconds of blocking
+        # syscalls — off the loop (rtlint loop-blocking), so a spawn
+        # burst can't stall heartbeats/dispatch for the whole batch.
+        try:
+            proc = await self._loop.run_in_executor(
+                None, self._spawn_worker_proc, worker_id, env
+            )
+        except OSError as e:
+            # Spawn itself failed (unwritable log dir, EMFILE, ENOMEM):
+            # release the starting slot so the scheduler retries instead
+            # of waiting forever on a worker that never forked.
+            self._pending_types.pop(worker_id, None)
+            self._starting_workers[worker_type] = max(
+                0, self._starting_workers[worker_type] - 1
+            )
+            cluster_events.emit(
+                cluster_events.WARNING, cluster_events.WORKER,
+                f"worker spawn failed before exec: {e!r}",
+                node_id=self.node_id.hex(),
+                custom_fields={"worker_type": worker_type,
+                               "error_type": type(e).__name__},
+            )
+            self._schedule()
+            return worker_id
         self._stats["workers_started"] += 1
         cluster_events.emit(
             cluster_events.DEBUG, cluster_events.WORKER,
@@ -1051,10 +1090,52 @@ class NodeManager:
             node_id=self.node_id.hex(),
             custom_fields={"pid": proc.pid, "worker_type": worker_type},
         )
-        # The handle is registered when the worker connects and registers.
+        if worker_id in self._workers:
+            # Registration won the race against this resume: attach the
+            # proc to the live handle (shutdown waits on it) instead of
+            # parking a stale entry the health loop would misread as a
+            # startup crash when the worker eventually exits.
+            self._workers[worker_id].proc = proc
+            return worker_id
+        if worker_id not in self._pending_types:
+            # Registered AND died during the hop: registration consumed
+            # the type entry and _on_worker_death already did the death
+            # accounting. Reap the exit status here; parking the proc
+            # would make the health loop double-count the death as a
+            # startup crash.
+            proc.poll()
+            return worker_id
+        if self._shutdown:
+            # Spawned into a closing node: the shutdown sweep already
+            # drained _pending_procs, so reap the orphan here.
+            try:
+                proc.terminate()
+            except OSError:
+                pass  # already dead: nothing to reap
+            self._pending_types.pop(worker_id, None)
+            return worker_id
+        # The handle is registered when the worker connects and
+        # registers (_pending_types was set before the executor hop).
         self._pending_procs[worker_id] = proc
-        self._pending_types[worker_id] = worker_type
         return worker_id
+
+    def _spawn_worker_proc(self, worker_id: WorkerID, env) -> "subprocess.Popen":
+        """Blocking half of the worker spawn (log dir/file + fork+exec);
+        runs in the loop's default executor, never on the loop."""
+        log_path = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_path, exist_ok=True)
+        out = open(os.path.join(
+            log_path, f"worker-{worker_id.hex()[:8]}.log"), "wb")
+        try:
+            return subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        finally:
+            out.close()
 
     async def _handle_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -4146,7 +4227,8 @@ class NodeManager:
         replies, missing = [], []
         for w, req_id, fut in waits:
             if fut.done():
-                replies.append(fut.result())
+                # done() checked: result() returns immediately.
+                replies.append(fut.result())  # rtlint: disable=loop-blocking
             else:
                 self._profile_pending.pop(req_id, None)
                 missing.append(w.worker_id.hex())

@@ -242,8 +242,15 @@ class ServeControllerActor:
 
         try:
             ray_tpu.get(handle.prepare_shutdown.remote(), timeout=30.0)
-        except Exception:
-            pass
+        except Exception as e:
+            # The replica is killed regardless, but a shutdown hook that
+            # failed (or timed out with requests in flight) must leave a
+            # trace — those are the requests that died with it.
+            cluster_events.emit(
+                cluster_events.WARNING, cluster_events.SERVE,
+                f"replica prepare_shutdown failed before kill: {e!r}",
+                custom_fields={"error_type": type(e).__name__},
+            )
         self._kill_replica(handle)
 
     def _converge_count(self, name: str) -> None:
@@ -542,8 +549,16 @@ class ServeControllerActor:
                     if check_health:
                         self._health_check_once(name)
                         self._eject_broken_once(name)
-            except Exception:
-                pass
+            except Exception as e:
+                # A reconcile crash silently freezing autoscaling +
+                # health checks was rtlint's top swallowed-failure
+                # finding: surface every iteration's failure as a
+                # cluster event, then keep reconciling.
+                cluster_events.emit(
+                    cluster_events.WARNING, cluster_events.SERVE,
+                    f"serve controller reconcile iteration failed: {e!r}",
+                    custom_fields={"error_type": type(e).__name__},
+                )
             time.sleep(RECONCILE_INTERVAL_S)
 
     # ---- handle-facing query API -------------------------------------------

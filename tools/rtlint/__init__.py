@@ -1,0 +1,18 @@
+"""rtlint: distributed-invariant static analysis for the ray_tpu repo.
+
+A multi-pass AST analyzer (see tools/rtlint/core.py for the framework,
+tools/rtlint/passes/ for the catalog) enforcing the invariants the
+framework's planes rest on:
+
+* ``loop-blocking``   — nothing blocks the NM/GCS asyncio loops;
+* ``lock-order``      — the lock graph over core/+util/ stays acyclic;
+* ``codec-mirror``    — the C codec and its Python mirror agree;
+* ``swallowed-failure`` — control planes never eat exceptions silently;
+* ``obs-*``           — the migrated observability lint (metrics,
+  events, chaos registry, pickle bans, serve hot path).
+
+Run: ``python -m tools.rtlint`` (or ``make rtlint`` / ``make check``).
+"""
+
+from .core import Context, Finding, Pass  # noqa: F401
+from .cli import main  # noqa: F401

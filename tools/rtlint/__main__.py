@@ -1,0 +1,15 @@
+"""``python -m tools.rtlint`` entry point."""
+
+import os
+import sys
+
+# Runnable from anywhere: the repo root (three levels up) must be
+# importable for the obs passes' package import.
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.rtlint.cli import main  # noqa: E402
+
+sys.exit(main())

@@ -1,0 +1,167 @@
+"""rtlint CLI: run passes, apply pragmas + baseline, report, exit code.
+
+Usage:
+    python -m tools.rtlint                  # every pass
+    python -m tools.rtlint --passes obs     # one group (or name,name)
+    python -m tools.rtlint --list           # pass catalog
+    python -m tools.rtlint --update-baseline
+
+Exit 0 when every finding is baselined or pragma-suppressed; 1 when new
+findings exist (or an unknown pass was requested).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from .core import (Context, Finding, Pass, load_baseline, save_baseline,
+                   split_baselined, suppressed_by_pragma)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_passes() -> List[Pass]:
+    from .passes import ALL_PASSES
+
+    return [cls() for cls in ALL_PASSES]
+
+
+def select_passes(passes: List[Pass], spec: str) -> List[Pass]:
+    """Comma-separated pass names and/or group names; 'all' = everything.
+    Raises ValueError on an unknown token."""
+    if not spec or spec == "all":
+        return passes
+    by_name = {p.name: p for p in passes}
+    groups = {p.group for p in passes}
+    out: List[Pass] = []
+    for token in (t.strip() for t in spec.split(",")):
+        if not token:
+            continue
+        if token in by_name:
+            if by_name[token] not in out:
+                out.append(by_name[token])
+        elif token in groups:
+            for p in passes:
+                if p.group == token and p not in out:
+                    out.append(p)
+        else:
+            known = sorted(by_name) + sorted(groups)
+            raise ValueError(
+                f"unknown pass or group {token!r} (known: "
+                f"{', '.join(known)})")
+    return out
+
+
+def run_passes(ctx: Context, passes: List[Pass],
+               verbose: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in passes:
+        try:
+            found = p.run(ctx)
+        except Exception as e:  # a crashed pass must fail loudly, not 0
+            found = [Finding(p.name, f"tools/rtlint/passes/{p.name}", 0,
+                             f"pass crashed: {e!r}", key=f"crash:{p.name}")]
+        findings.extend(found)
+        if verbose:
+            extra = f" ({p.stats})" if p.stats else ""
+            print(f"rtlint: {p.name}: {len(found)} finding(s){extra}")
+    return findings
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rtlint",
+        description="distributed-invariant static analysis for ray_tpu")
+    parser.add_argument("--passes", default="all",
+                        help="comma-separated pass or group names "
+                             "(default: all; groups: core, obs)")
+    parser.add_argument("--root", default=_repo_root(),
+                        help="repo root to analyze")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline file (checked-in suppressions)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (report everything)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current findings")
+    parser.add_argument("--list", action="store_true",
+                        help="list passes and exit")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="suppress per-pass progress lines")
+    args = parser.parse_args(argv)
+
+    passes = build_passes()
+    if args.list:
+        width = max(len(p.name) for p in passes)
+        for p in passes:
+            print(f"{p.name:<{width}}  [{p.group}]  {p.description}")
+        return 0
+
+    try:
+        selected = select_passes(passes, args.passes)
+    except ValueError as e:
+        print(f"rtlint: {e}", file=sys.stderr)
+        return 1
+
+    ctx = Context(args.root)
+    findings = run_passes(ctx, selected, verbose=not args.quiet)
+
+    kept: List[Finding] = []
+    n_pragma = 0
+    for f in findings:
+        if suppressed_by_pragma(ctx, f):
+            n_pragma += 1
+        else:
+            kept.append(f)
+
+    if args.update_baseline:
+        # A crashed pass analyzed nothing: baselining its crash marker
+        # would make it exit 0 forever. Fix the pass first.
+        crashed = [f for f in kept if f.key.startswith("crash:")]
+        if crashed:
+            for f in crashed:
+                print(f"rtlint: refusing to baseline {f.message}",
+                      file=sys.stderr)
+            return 1
+        # A subset run only refreshes its own passes' entries; recorded
+        # debt of passes that did not run is carried forward untouched.
+        ran = {p.name for p in selected}
+        keep = {fp: n for fp, n in load_baseline(args.baseline).items()
+                if fp[0] not in ran}
+        save_baseline(args.baseline, kept, ctx, keep=keep)
+        print(f"rtlint: baseline rewritten with {len(kept)} finding(s) "
+              f"from {len(ran)} pass(es), {len(keep)} carried-forward "
+              f"entr{'y' if len(keep) == 1 else 'ies'} -> "
+              f"{args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, baselined = split_baselined(ctx, kept, baseline)
+
+    for f in new:
+        print(f"{f.location()}: [{f.pass_name}] {f.message}")
+        if f.hint:
+            print(f"    hint: {f.hint}")
+    summary = (f"rtlint: {len(new)} new finding(s), "
+               f"{len(baselined)} baselined, {n_pragma} pragma-suppressed "
+               f"({len(selected)} pass(es))")
+    print(summary, file=sys.stderr if new else sys.stdout)
+    if new:
+        print("rtlint: fix the findings, pragma them with a reason "
+              "(# rtlint: disable=<pass>), or run "
+              "python -m tools.rtlint --update-baseline and justify the "
+              "baseline growth in your PR", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,262 @@
+"""rtlint framework core: findings, pass protocol, pragmas, baseline.
+
+The distributed-invariant analyzer for this repo (tools/rtlint) is a
+multi-pass AST lint in the spirit of large-scale lint frameworks
+(Fixit/clang-tidy), rebuilt for a Python+C-extension codebase. Each pass
+checks one invariant the planes rely on (nothing blocks the NM loop,
+locks nest in one order, the native codec and its Python mirror agree,
+control planes never swallow failures, the observability surface does
+not drift). This module is dependency-free and import-cheap: passes that
+need the ray_tpu package import it lazily inside run().
+
+Suppression model, outermost to innermost:
+
+* **Baseline** (``tools/rtlint/baseline.json``): pre-existing findings,
+  checked in so CI fails only on NEW findings. Entries are fingerprints
+  of (pass, file, normalized source line) with an occurrence count —
+  line-number free, so unrelated edits don't invalidate them. Refresh
+  with ``python -m tools.rtlint --update-baseline``; policy: a baseline
+  entry is a debt marker, never an endorsement — shrink it, don't grow
+  it, and justify additions in the PR that adds them.
+* **Inline pragma**: ``# rtlint: disable=<pass>[,<pass>...]`` on the
+  finding's line (or the line directly above it) suppresses those
+  passes there; ``disable=all`` suppresses every pass on that line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+PRAGMA_RE = re.compile(r"#\s*rtlint:\s*disable=([\w,\- ]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation. ``key`` is the baseline fingerprint component;
+    when empty it defaults to the stripped source text of ``line`` (or
+    the message for findings without a resolvable line)."""
+
+    pass_name: str
+    path: str  # repo-relative, "/"-separated
+    line: int
+    message: str
+    hint: str = ""
+    key: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}" if self.line else self.path
+
+
+class Pass:
+    """Base class for analysis passes.
+
+    Subclasses set ``name`` (kebab-case, used in pragmas/baseline/CLI),
+    ``group`` ("core" for the distributed-invariant passes, "obs" for
+    the migrated observability lint) and implement :meth:`run`. A pass
+    may set ``self.stats`` during run() to a short human string
+    summarizing coverage ("checked N emit sites")."""
+
+    name: str = ""
+    group: str = "core"
+    description: str = ""
+
+    def __init__(self) -> None:
+        self.stats: str = ""
+
+    def run(self, ctx: "Context") -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class Context:
+    """Shared per-run state: repo root, parsed-file caches, one-shot
+    memo (used by the obs passes to import the package exactly once)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._sources: Dict[str, Optional[str]] = {}
+        self._trees: Dict[str, Optional[ast.AST]] = {}
+        self._memo: Dict[str, Any] = {}
+        # Parse failures surface as findings on whichever pass hit them.
+        self.parse_errors: Dict[str, str] = {}
+
+    def path(self, rel: str) -> str:
+        return os.path.join(self.root, *rel.split("/"))
+
+    def exists(self, rel: str) -> bool:
+        return os.path.isfile(self.path(rel))
+
+    def source(self, rel: str) -> Optional[str]:
+        if rel not in self._sources:
+            try:
+                with open(self.path(rel), "r", encoding="utf-8",
+                          errors="replace") as f:
+                    self._sources[rel] = f.read()
+            except OSError:
+                self._sources[rel] = None
+        return self._sources[rel]
+
+    def lines(self, rel: str) -> List[str]:
+        src = self.source(rel)
+        return src.splitlines() if src is not None else []
+
+    def tree(self, rel: str) -> Optional[ast.AST]:
+        if rel not in self._trees:
+            src = self.source(rel)
+            if src is None:
+                self._trees[rel] = None
+            else:
+                try:
+                    self._trees[rel] = ast.parse(src, filename=rel)
+                except SyntaxError as e:
+                    self._trees[rel] = None
+                    self.parse_errors[rel] = str(e)
+        return self._trees[rel]
+
+    def py_files(self, *subdirs: str) -> List[str]:
+        """Repo-relative paths of every .py file under the subdirs."""
+        out: List[str] = []
+        for sub in subdirs:
+            base = self.path(sub)
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        full = os.path.join(dirpath, fname)
+                        out.append(
+                            os.path.relpath(full, self.root).replace(
+                                os.sep, "/"))
+        return out
+
+    def once(self, key: str, fn: Callable[[], Any]) -> Any:
+        if key not in self._memo:
+            self._memo[key] = fn()
+        return self._memo[key]
+
+
+# ---- pragmas ---------------------------------------------------------------
+
+
+def _pragmas_for(ctx: Context, rel: str) -> Dict[int, set]:
+    """{line_number: {pass names (or 'all')}} for one file, cached."""
+
+    def build() -> Dict[int, set]:
+        out: Dict[int, set] = {}
+        for i, text in enumerate(ctx.lines(rel), start=1):
+            m = PRAGMA_RE.search(text)
+            if m:
+                names = {p.strip() for p in m.group(1).split(",") if
+                         p.strip()}
+                out[i] = names
+        return out
+
+    return ctx.once(f"pragmas:{rel}", build)
+
+
+def suppressed_by_pragma(ctx: Context, finding: Finding) -> bool:
+    """A pragma on the finding's line, or on the line directly above it
+    (for lines that end in a string/expression where a trailing comment
+    won't fit), suppresses it."""
+    if not finding.line:
+        return False
+    pragmas = _pragmas_for(ctx, finding.path)
+    for ln in (finding.line, finding.line - 1):
+        names = pragmas.get(ln)
+        if names and ("all" in names or finding.pass_name in names):
+            return True
+    return False
+
+
+# ---- baseline --------------------------------------------------------------
+
+BASELINE_POLICY = (
+    "Pre-existing findings only. A baseline entry is a debt marker, not "
+    "an endorsement: shrink this file, never grow it without justifying "
+    "the addition in the PR. Entries fingerprint (pass, file, stripped "
+    "source line) with an occurrence count, so they survive unrelated "
+    "line moves. Refresh: python -m tools.rtlint --update-baseline"
+)
+
+
+def finding_key(ctx: Context, finding: Finding) -> str:
+    if finding.key:
+        return finding.key
+    if finding.line:
+        lines = ctx.lines(finding.path)
+        if 0 < finding.line <= len(lines):
+            text = lines[finding.line - 1].strip()
+            if text:
+                return text
+    return finding.message
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """{(pass, path, key): allowed_count}. Missing file = empty."""
+    if not os.path.isfile(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str], int] = {}
+    for entry in data.get("entries", []):
+        fp = (entry["pass"], entry["path"], entry["key"])
+        out[fp] = out.get(fp, 0) + int(entry.get("count", 1))
+    return out
+
+
+def save_baseline(path: str, findings: Iterable[Finding], ctx: Context,
+                  keep: Optional[Dict[Tuple[str, str, str], int]] = None,
+                  ) -> None:
+    """Write the baseline from ``findings``; ``keep`` carries forward
+    entries of passes that did NOT run (a subset --update-baseline must
+    not wipe the other passes' recorded debt)."""
+    counts: Dict[Tuple[str, str, str], int] = dict(keep or {})
+    for f in findings:
+        fp = (f.pass_name, f.path, finding_key(ctx, f))
+        counts[fp] = counts.get(fp, 0) + 1
+    entries = [
+        {"pass": p, "path": rel, "key": key, "count": n}
+        for (p, rel, key), n in sorted(counts.items())
+    ]
+    with open(path, "w") as f:
+        json.dump({"version": 1, "policy": BASELINE_POLICY,
+                   "entries": entries}, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def split_baselined(ctx: Context, findings: List[Finding],
+                    baseline: Dict[Tuple[str, str, str], int],
+                    ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined): each fingerprint consumes baseline budget in
+    source order; overflow beyond the recorded count is NEW."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        fp = (f.pass_name, f.path, finding_key(ctx, f))
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# ---- shared AST helpers used by several passes -----------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+

@@ -1,0 +1,20 @@
+"""Pass catalog. Order here is execution + report order."""
+
+from .loop_blocking import LoopBlockingPass
+from .lock_order import LockOrderPass
+from .codec_mirror import CodecMirrorPass
+from .swallowed_failure import SwallowedFailurePass
+from .obs import (ObsChaosPass, ObsEventsPass, ObsMetricsPass,
+                  ObsPicklePass, ObsServePass)
+
+ALL_PASSES = (
+    LoopBlockingPass,
+    LockOrderPass,
+    CodecMirrorPass,
+    SwallowedFailurePass,
+    ObsMetricsPass,
+    ObsEventsPass,
+    ObsChaosPass,
+    ObsPicklePass,
+    ObsServePass,
+)

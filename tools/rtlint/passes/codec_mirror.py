@@ -1,0 +1,269 @@
+"""codec-mirror: the C codec and its Python mirror cannot skew silently.
+
+The direct call plane speaks one binary dialect from two
+implementations: ``src/pump/rts_pump.h`` + ``_rtpump_module.cc`` (native)
+and ``ray_tpu/core/frame_pump.py`` (pure-Python mirror, also the decoder
+of record when the .so is absent). The fuzz parity test catches byte
+skew — but only where the fuzzer reaches, and only when the native build
+runs in CI. This pass cross-checks the constants and the dialect
+vocabulary token-by-token (clang-free: ``#define`` regex on the C side,
+AST constants + string-literal scan on the Python side), so renaming a
+field key, re-numbering a frame tag, or bumping one side's codec version
+fails fast:
+
+* magic byte: ``RTP_MAGIC`` == ``frame_pump.MAGIC`` ==
+  ``protocol._NATIVE_MAGIC`` (the dialect sniff byte);
+* codec version: ``RTP_CODEC_VER`` == ``frame_pump.CODEC_VER``;
+* frame-type tags and arg/flag constants (``RTP_F_*``, ``RTP_ARG_*``,
+  ``RTP_CALL_HAS_*``) == the mirror's ``F_*`` / ``_ARG_*`` / ``_HAS_*``;
+* every dict key/value the C module interns for the dialect ("q", "d",
+  "task_id", "execute", ...) appears as a string literal in the mirror,
+  and vice versa for the mirror's wire-dict keys;
+* ``DIRECT_PROTO_VER`` discipline: the hello/welcome handshake sites in
+  runtime.py and worker_main.py must reference the protocol.py constant
+  (a hard-coded ``"ver": <int>`` would fork the handshake), and both
+  sides must negotiate "npv".
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from ..core import Context, Finding, Pass
+
+H_PATH = "src/pump/rts_pump.h"
+CC_PATH = "src/pump/_rtpump_module.cc"
+MIRROR_PATH = "ray_tpu/core/frame_pump.py"
+PROTO_PATH = "ray_tpu/core/protocol.py"
+RUNTIME_PATH = "ray_tpu/core/runtime.py"
+WORKER_PATH = "ray_tpu/core/worker_main.py"
+
+_DEFINE_RE = re.compile(
+    r"^\s*#\s*define\s+RTP_(\w+)\s+(0[xX][0-9a-fA-F]+|\d+)u?\b",
+    re.MULTILINE)
+# The module's interned-string table: {&s_q, "q"} / {&v_execute, "execute"}.
+_INTERN_RE = re.compile(r"\{\s*&[sv]_(\w+)\s*,\s*\"([^\"]+)\"\s*\}")
+
+# C #define name -> Python mirror constant name.
+CONST_MAP = {
+    "MAGIC": "MAGIC",
+    "CODEC_VER": "CODEC_VER",
+    "F_CALL": "F_CALL",
+    "F_DONE": "F_DONE",
+    "F_DONE_BATCH": "F_DONE_BATCH",
+    "F_FENCE": "F_FENCE",
+    "F_FENCE_ACK": "F_FENCE_ACK",
+    "ARG_REF": "_ARG_REF",
+    "ARG_VALUE": "_ARG_VALUE",
+    "CALL_HAS_ARGS": "_HAS_ARGS",
+    "CALL_HAS_NESTED": "_HAS_NESTED",
+}
+
+# Interned names that are NOT dialect vocabulary (CPython plumbing).
+_INTERN_SKIP = {"bytes_attr"}
+
+# Wire-dict keys the mirror produces/consumes; each must be interned on
+# the C side or the native decoder emits differently-shaped dicts.
+MIRROR_WIRE_KEYS = ("type", "t", "i", "q", "a", "n", "d", "task_id",
+                    "results", "failed", "duration_s", "items", "msg_id")
+MIRROR_WIRE_VALUES = ("execute", "task_done", "task_done_batch", "fence",
+                      "fence_ack")
+
+
+def _module_int_consts(tree: ast.AST) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, int):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _string_literals(tree: ast.AST) -> Set[str]:
+    return {
+        node.value for node in ast.walk(tree)
+        if isinstance(node, ast.Constant) and isinstance(node.value, str)
+    }
+
+
+def _attribute_names(tree: ast.AST) -> Set[str]:
+    """Attribute names the mirror touches: C-side interns that exist to
+    read Python object attributes (arg.object_id, loc.data) appear in
+    the mirror as attribute access, not string literals."""
+    return {node.attr for node in ast.walk(tree)
+            if isinstance(node, ast.Attribute)}
+
+
+def _assign_line(ctx: Context, rel: str, name: str) -> int:
+    tree = ctx.tree(rel)
+    if tree is None:
+        return 0
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == name:
+            return node.lineno
+    return 0
+
+
+class CodecMirrorPass(Pass):
+    name = "codec-mirror"
+    group = "core"
+    description = ("native codec (src/pump) and its Python mirror "
+                   "(core/frame_pump.py) must agree token-for-token")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        h_src = ctx.source(H_PATH)
+        cc_src = ctx.source(CC_PATH)
+        mirror_tree = ctx.tree(MIRROR_PATH)
+        proto_tree = ctx.tree(PROTO_PATH)
+        for rel, present in ((H_PATH, h_src), (CC_PATH, cc_src),
+                             (MIRROR_PATH, mirror_tree),
+                             (PROTO_PATH, proto_tree)):
+            if present is None:
+                findings.append(Finding(
+                    self.name, rel, 0,
+                    "codec surface file missing/unparseable (moved "
+                    "without updating rtlint?)",
+                    key=f"missing:{rel}"))
+        if any(x is None for x in (h_src, cc_src, mirror_tree, proto_tree)):
+            return findings
+
+        c_defs = {m.group(1): int(m.group(2), 0)
+                  for m in _DEFINE_RE.finditer(h_src)}
+        py_consts = _module_int_consts(mirror_tree)
+        proto_consts = _module_int_consts(proto_tree)
+
+        n_checked = 0
+        # -- numeric constants ------------------------------------------------
+        for c_name, py_name in CONST_MAP.items():
+            n_checked += 1
+            cv = c_defs.get(c_name)
+            pv = py_consts.get(py_name)
+            if cv is None:
+                findings.append(Finding(
+                    self.name, H_PATH, 0,
+                    f"RTP_{c_name} missing from {H_PATH} (renamed "
+                    f"without updating the mirror check?)",
+                    key=f"c-missing:{c_name}"))
+                continue
+            if pv is None:
+                findings.append(Finding(
+                    self.name, MIRROR_PATH, 0,
+                    f"{py_name} missing from the Python mirror "
+                    f"({H_PATH} defines RTP_{c_name}={cv:#x})",
+                    key=f"py-missing:{py_name}"))
+                continue
+            if cv != pv:
+                findings.append(Finding(
+                    self.name, MIRROR_PATH,
+                    _assign_line(ctx, MIRROR_PATH, py_name),
+                    f"codec drift: {py_name}={pv:#x} but "
+                    f"RTP_{c_name}={cv:#x} in {H_PATH} — the two "
+                    f"dialect implementations no longer agree",
+                    hint="change both sides in the same commit (the "
+                         "wire format is one artifact with two "
+                         "implementations)",
+                    key=f"drift:{c_name}"))
+
+        # -- protocol.py's sniff byte -----------------------------------------
+        n_checked += 1
+        sniff = proto_consts.get("_NATIVE_MAGIC")
+        if sniff is None:
+            findings.append(Finding(
+                self.name, PROTO_PATH, 0,
+                "_NATIVE_MAGIC missing from protocol.py (loads_msg can "
+                "no longer sniff the native dialect)",
+                key="sniff-missing"))
+        elif sniff != c_defs.get("MAGIC"):
+            findings.append(Finding(
+                self.name, PROTO_PATH,
+                _assign_line(ctx, PROTO_PATH, "_NATIVE_MAGIC"),
+                f"protocol._NATIVE_MAGIC={sniff:#x} but "
+                f"RTP_MAGIC={c_defs.get('MAGIC'):#x}: loads_msg would "
+                f"route native frames into pickle.loads",
+                key="drift:sniff"))
+
+        # -- dialect vocabulary ----------------------------------------------
+        mirror_vocab = _string_literals(mirror_tree) | \
+            _attribute_names(mirror_tree)
+        interned = {name: value
+                    for name, value in _INTERN_RE.findall(cc_src)
+                    if name not in _INTERN_SKIP}
+        for name, value in sorted(interned.items()):
+            n_checked += 1
+            if value not in mirror_vocab:
+                findings.append(Finding(
+                    self.name, CC_PATH, 0,
+                    f"C module interns dialect token \"{value}\" "
+                    f"(s_{name}) but the Python mirror never mentions "
+                    f"it — decoded dicts would differ between "
+                    f"implementations",
+                    key=f"intern:{value}"))
+        interned_values = set(interned.values())
+        for key in MIRROR_WIRE_KEYS + MIRROR_WIRE_VALUES:
+            n_checked += 1
+            if key not in interned_values:
+                findings.append(Finding(
+                    self.name, MIRROR_PATH, 0,
+                    f"mirror wire token \"{key}\" is not interned by "
+                    f"{CC_PATH} — the native decoder cannot produce "
+                    f"the same dict shape",
+                    key=f"mirror-token:{key}"))
+
+        # -- DIRECT_PROTO_VER handshake discipline ----------------------------
+        if "DIRECT_PROTO_VER" not in proto_consts:
+            findings.append(Finding(
+                self.name, PROTO_PATH, 0,
+                "DIRECT_PROTO_VER missing from protocol.py",
+                key="dpv-missing"))
+        for rel in (RUNTIME_PATH, WORKER_PATH):
+            tree = ctx.tree(rel)
+            if tree is None:
+                continue
+            src = ctx.source(rel) or ""
+            n_checked += 1
+            if "DIRECT_PROTO_VER" not in src:
+                findings.append(Finding(
+                    self.name, rel, 0,
+                    "handshake module no longer references "
+                    "DIRECT_PROTO_VER — version negotiation forked "
+                    "from protocol.py",
+                    key=f"dpv-ref:{rel}"))
+            if "npv" not in src:
+                findings.append(Finding(
+                    self.name, rel, 0,
+                    "handshake module no longer negotiates \"npv\" — "
+                    "the native codec version cannot be agreed, both "
+                    "sides would assume",
+                    key=f"npv-ref:{rel}"))
+            findings.extend(self._hardcoded_ver(rel, tree))
+
+        self.stats = f"cross-checked {n_checked} dialect token(s)"
+        return findings
+
+    def _hardcoded_ver(self, rel: str, tree: ast.AST) -> List[Finding]:
+        """A dict literal {'ver': <int const>} at a handshake site pins
+        the protocol version outside protocol.py."""
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value == "ver" and \
+                        isinstance(v, ast.Constant) and \
+                        isinstance(v.value, int):
+                    out.append(Finding(
+                        self.name, rel, v.lineno,
+                        f"hard-coded \"ver\": {v.value} in a handshake "
+                        f"frame — must reference "
+                        f"protocol.DIRECT_PROTO_VER",
+                        hint="import DIRECT_PROTO_VER and use it; a "
+                             "literal silently forks the version check",
+                    ))
+        return out

@@ -1,0 +1,393 @@
+"""lock-order: the lock-acquisition graph must stay acyclic.
+
+Extracts every ``threading.Lock/RLock/Condition/Semaphore`` the core and
+util packages create (module-level and ``self._x = threading.Lock()``
+attributes), then builds the acquired-while-holding graph from:
+
+* lexical ``with`` nesting inside one function;
+* explicit ``.acquire()`` calls made while a ``with`` block holds
+  another lock;
+* one-hop call expansion: while holding L, calling ``self.m()`` (same
+  class) or ``f()`` (same module) adds L -> every lock that callee
+  acquires anywhere in its own intra-module call tree.
+
+``Condition(existing_lock)`` aliases to the wrapped lock (one identity —
+``with cv:`` and ``with lock:`` are the same acquisition). Two failure
+shapes are reported:
+
+* **self-deadlock**: a non-reentrant Lock re-acquired while already
+  held (L -> L). With ``threading.Lock`` this is not an ordering bug, it
+  is a guaranteed hang on first execution of that path.
+* **order inversion**: a cycle L1 -> L2 -> ... -> L1 across sites; two
+  threads entering from different ends deadlock under load.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Context, Finding, Pass, dotted_name
+
+DEFAULT_SCAN = ("ray_tpu/core", "ray_tpu/util")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_REENTRANT_CTORS = {"RLock"}
+
+# (module, class or "", attr) — one lock identity.
+LockId = Tuple[str, str, str]
+
+
+def _lock_ctor(node: ast.AST) -> Optional[str]:
+    """'Lock'/'RLock'/... when node is a threading.<ctor>() call."""
+    if not isinstance(node, ast.Call):
+        return None
+    dotted = dotted_name(node.func)
+    if dotted is None:
+        return None
+    parts = dotted.split(".")
+    if parts[-1] in _LOCK_CTORS and (
+            len(parts) == 1 or parts[-2] in ("threading", "th")):
+        return parts[-1]
+    return None
+
+
+class _ModuleLocks:
+    """Lock declarations + aliases for one module."""
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.locks: Dict[Tuple[str, str], LockId] = {}  # (cls, attr) -> id
+        self.reentrant: Set[LockId] = set()
+        self.alias: Dict[LockId, LockId] = {}
+
+    def canon(self, lock: LockId) -> LockId:
+        while lock in self.alias:
+            lock = self.alias[lock]
+        return lock
+
+    def declare(self, cls: str, attr: str, ctor: str,
+                cond_of: Optional[str]) -> None:
+        lock: LockId = (self.rel, cls, attr)
+        self.locks[(cls, attr)] = lock
+        if ctor in _REENTRANT_CTORS:
+            self.reentrant.add(lock)
+        if ctor == "Condition" and cond_of is not None and \
+                (cls, cond_of) in self.locks:
+            # Condition(existing) shares the wrapped lock's identity;
+            # Condition() owns a fresh (R)Lock. Conditions default to
+            # RLock semantics only for their own implicit lock.
+            self.alias[lock] = self.locks[(cls, cond_of)]
+        elif ctor == "Condition" and cond_of is None:
+            self.reentrant.add(lock)
+
+    def lookup(self, cls: str, attr: str) -> Optional[LockId]:
+        lock = self.locks.get((cls, attr))
+        if lock is None and cls:
+            lock = self.locks.get(("", attr))  # module-level fallback
+        return self.canon(lock) if lock is not None else None
+
+
+def _collect_declarations(rel: str, tree: ast.AST) -> _ModuleLocks:
+    decls = _ModuleLocks(rel)
+
+    def scan_assign(target: ast.AST, value: ast.AST, cls: str) -> None:
+        ctor = _lock_ctor(value)
+        if ctor is None:
+            return
+        cond_of = None
+        if ctor == "Condition" and isinstance(value, ast.Call) and \
+                value.args:
+            arg = value.args[0]
+            if isinstance(arg, ast.Attribute) and \
+                    isinstance(arg.value, ast.Name) and \
+                    arg.value.id == "self":
+                cond_of = arg.attr
+            elif isinstance(arg, ast.Name):
+                cond_of = arg.id
+        if isinstance(target, ast.Name):
+            decls.declare(cls, target.id, ctor, cond_of)
+        elif isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            decls.declare(cls, target.attr, ctor, cond_of)
+
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            scan_assign(node.targets[0], node.value, "")
+        elif isinstance(node, ast.ClassDef):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    scan_assign(sub.targets[0], sub.value, node.name)
+    return decls
+
+
+def _lock_expr(decls: _ModuleLocks, cls: str,
+               node: ast.AST) -> Optional[LockId]:
+    """Resolve ``self._x`` / bare ``_x`` to a declared lock id."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return decls.lookup(cls, node.attr)
+    if isinstance(node, ast.Name):
+        return decls.lookup("", node.id)
+    return None
+
+
+class _Edge:
+    __slots__ = ("holder", "acquired", "rel", "line", "via")
+
+    def __init__(self, holder: LockId, acquired: LockId, rel: str,
+                 line: int, via: str):
+        self.holder = holder
+        self.acquired = acquired
+        self.rel = rel
+        self.line = line
+        self.via = via
+
+
+def _fmt(lock: LockId) -> str:
+    rel, cls, attr = lock
+    mod = rel.rsplit("/", 1)[-1]
+    return f"{mod}:{cls + '.' if cls else ''}{attr}"
+
+
+class _ModuleAnalysis:
+    """Builds edges for one module."""
+
+    def __init__(self, rel: str, tree: ast.AST, decls: _ModuleLocks):
+        self.rel = rel
+        self.tree = tree
+        self.decls = decls
+        self.funcs: Dict[Tuple[str, str], ast.AST] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs[("", node.name)] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.funcs[(node.name, sub.name)] = sub
+        self._acq_memo: Dict[Tuple[str, str], Set[LockId]] = {}
+        self.edges: List[_Edge] = []
+        self.self_deadlocks: List[_Edge] = []
+
+    # -- what locks does a function (transitively) acquire? ------------------
+
+    def acquired_in(self, key: Tuple[str, str],
+                    _seen: Optional[Set] = None) -> Set[LockId]:
+        if key in self._acq_memo:
+            return self._acq_memo[key]
+        seen = _seen if _seen is not None else set()
+        if key in seen:
+            return set()
+        seen.add(key)
+        func = self.funcs.get(key)
+        out: Set[LockId] = set()
+        if func is None:
+            return out
+        cls = key[0]
+        for node in ast.walk(func):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lock = _lock_expr(self.decls, cls, item.context_expr)
+                    if lock is not None:
+                        out.add(lock)
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and fn.attr == "acquire":
+                    lock = _lock_expr(self.decls, cls, fn.value)
+                    if lock is not None:
+                        out.add(lock)
+                else:
+                    callee = self._callee_key(cls, node)
+                    if callee is not None:
+                        out |= self.acquired_in(callee, seen)
+        if _seen is None:
+            self._acq_memo[key] = out
+        return out
+
+    def _callee_key(self, cls: str,
+                    call: ast.Call) -> Optional[Tuple[str, str]]:
+        fn = call.func
+        if isinstance(fn, ast.Name) and ("", fn.id) in self.funcs:
+            return ("", fn.id)
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and fn.value.id == "self" \
+                and cls and (cls, fn.attr) in self.funcs:
+            return (cls, fn.attr)
+        return None
+
+    # -- edge extraction -----------------------------------------------------
+
+    def analyze(self) -> None:
+        for key, func in self.funcs.items():
+            self._walk(key[0], key[1],
+                       list(ast.iter_child_nodes(func)), [])
+
+    def _note(self, held: List[LockId], acquired: LockId, line: int,
+              via: str) -> None:
+        for holder in held:
+            edge = _Edge(holder, acquired, self.rel, line, via)
+            if holder == acquired:
+                if acquired not in self.decls.reentrant:
+                    self.self_deadlocks.append(edge)
+            else:
+                self.edges.append(edge)
+
+    def _walk(self, cls: str, fname: str, nodes: List[ast.AST],
+              held: List[LockId]) -> None:
+        for child in nodes:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue  # nested defs run on their own schedule
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                acquired: List[LockId] = []
+                for item in child.items:
+                    lock = _lock_expr(self.decls, cls, item.context_expr)
+                    if lock is not None:
+                        self._note(held + acquired, lock, child.lineno,
+                                   f"with in {fname}")
+                        acquired.append(lock)
+                self._walk(cls, fname, child.body, held + acquired)
+                continue
+            if isinstance(child, ast.Call):
+                fn = child.func
+                if isinstance(fn, ast.Attribute) and fn.attr == "acquire":
+                    lock = _lock_expr(self.decls, cls, fn.value)
+                    if lock is not None and held:
+                        self._note(held, lock, child.lineno,
+                                   f"acquire() in {fname}")
+                elif held:
+                    callee = self._callee_key(cls, child)
+                    if callee is not None:
+                        for lock in self.acquired_in(callee):
+                            self._note(held, lock, child.lineno,
+                                       f"{fname} -> {callee[1]}()")
+            self._walk(cls, fname, list(ast.iter_child_nodes(child)),
+                       held)
+
+
+class LockOrderPass(Pass):
+    name = "lock-order"
+    group = "core"
+    description = ("lock-acquisition graph over core/ + util/ must be "
+                   "acyclic (no order inversions, no self-deadlocks)")
+
+    scan_dirs = DEFAULT_SCAN
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        edges: List[_Edge] = []
+        n_locks = 0
+        for rel in ctx.py_files(*self.scan_dirs):
+            tree = ctx.tree(rel)
+            if tree is None:
+                if rel in ctx.parse_errors:
+                    findings.append(Finding(
+                        self.name, rel, 0,
+                        f"unparseable ({ctx.parse_errors[rel]})"))
+                continue
+            decls = _collect_declarations(rel, tree)
+            n_locks += len(decls.locks)
+            analysis = _ModuleAnalysis(rel, tree, decls)
+            analysis.analyze()
+            edges.extend(analysis.edges)
+            for edge in analysis.self_deadlocks:
+                findings.append(Finding(
+                    self.name, edge.rel, edge.line,
+                    f"non-reentrant lock {_fmt(edge.acquired)} "
+                    f"re-acquired while already held ({edge.via}) — "
+                    f"guaranteed deadlock on this path",
+                    hint="make the inner path lock-free, or split the "
+                         "method into a _locked variant",
+                ))
+        findings.extend(self._cycle_findings(edges))
+        self.stats = (f"{n_locks} lock site(s), "
+                      f"{len(edges)} nesting edge(s)")
+        return findings
+
+    def _cycle_findings(self, edges: List[_Edge]) -> List[Finding]:
+        graph: Dict[LockId, Set[LockId]] = {}
+        witness: Dict[Tuple[LockId, LockId], _Edge] = {}
+        for e in edges:
+            graph.setdefault(e.holder, set()).add(e.acquired)
+            graph.setdefault(e.acquired, set())
+            witness.setdefault((e.holder, e.acquired), e)
+        sccs = _tarjan(graph)
+        findings: List[Finding] = []
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            scc_set = set(scc)
+            cyc_edges = sorted(
+                (e for (h, a), e in witness.items()
+                 if h in scc_set and a in scc_set),
+                key=lambda e: (e.rel, e.line))
+            order = " , ".join(
+                f"{_fmt(e.holder)} -> {_fmt(e.acquired)} "
+                f"({e.rel.rsplit('/', 1)[-1]}:{e.line}, {e.via})"
+                for e in cyc_edges)
+            anchor = cyc_edges[0]
+            findings.append(Finding(
+                self.name, anchor.rel, anchor.line,
+                f"lock-order inversion between "
+                f"{', '.join(sorted(_fmt(l) for l in scc))}: {order}",
+                hint="pick one global order for these locks and make "
+                     "every path acquire in it (release before calling "
+                     "into the other lock's owner)",
+                key="cycle:" + "|".join(sorted(_fmt(l) for l in scc)),
+            ))
+        return findings
+
+
+def _tarjan(graph: Dict[LockId, Set[LockId]]) -> List[List[LockId]]:
+    index: Dict[LockId, int] = {}
+    low: Dict[LockId, int] = {}
+    on_stack: Set[LockId] = set()
+    stack: List[LockId] = []
+    out: List[List[LockId]] = []
+    counter = [0]
+
+    def strongconnect(v: LockId) -> None:
+        # Iterative Tarjan (module graphs are small, but recursion depth
+        # should not depend on repo size).
+        work = [(v, iter(graph.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(graph.get(w, ()))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                out.append(scc)
+
+    for v in list(graph):
+        if v not in index:
+            strongconnect(v)
+    return out

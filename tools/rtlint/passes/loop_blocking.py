@@ -1,0 +1,277 @@
+"""loop-blocking: no blocking call reachable from an event-loop context.
+
+The node manager and GCS are single asyncio loops; one synchronous
+``time.sleep`` / ``subprocess`` / socket read / lock wait anywhere in a
+coroutine (or in a sync helper a coroutine calls) stalls heartbeats,
+dispatch and every peer RPC at once — exactly the GIL-handoff chains the
+PERF_r08 loaded-RTT record is bounded by.
+
+Roots are every ``async def`` in the event-loop modules plus every sync
+function registered as a loop callback (``call_soon``/``call_later``/
+``add_done_callback``). From each root the pass walks the intra-module
+call graph (bare-name calls to module functions, ``self.method()`` calls
+within the class) and flags blocking calls anywhere on the path. Calls
+handed to an executor (``run_in_executor``, ``asyncio.to_thread``,
+pool ``submit``, ``threading.Thread``) pass function references, not
+calls, so they never enter the walk. Awaited calls are async by
+construction and exempt from the attribute-based rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Context, Finding, Pass, dotted_name
+
+# Event-loop host modules: every async def here runs on a loop whose
+# stall is cluster-visible (heartbeats, dispatch, peer RPC).
+EVENT_LOOP_MODULES = (
+    "ray_tpu/core/node_manager.py",
+    "ray_tpu/core/gcs.py",
+)
+
+# Dotted-name calls that block the calling thread outright.
+BLOCKING_DOTTED = {
+    "time.sleep": "sleeps the loop thread",
+    "subprocess.Popen": "fork+exec on the loop thread",
+    "subprocess.run": "runs a child process to completion on the loop",
+    "subprocess.call": "runs a child process to completion on the loop",
+    "subprocess.check_call": "runs a child process to completion on the loop",
+    "subprocess.check_output": "runs a child process to completion on the "
+                               "loop",
+    "socket.create_connection": "synchronous TCP connect",
+    "os.makedirs": "filesystem metadata I/O on the loop thread",
+    "os.replace": "filesystem I/O on the loop thread",
+    "shutil.rmtree": "recursive filesystem I/O on the loop thread",
+}
+
+# The open() builtin: file I/O on the loop thread.
+BLOCKING_BUILTINS = {"open": "file I/O on the loop thread"}
+
+# Method names that block when NOT awaited (socket/framed-connection
+# reads and writes, synchronous request round-trips, thread joins,
+# threading.Event/Condition waits, Future.result).
+BLOCKING_ATTRS = {
+    "accept": "blocking socket accept",
+    "recv": "blocking socket/framed-connection read",
+    "recvfrom": "blocking socket read",
+    "sendall": "blocking socket write",
+    "communicate": "blocks until the child process exits",
+    "call_sync": "synchronous loop round-trip (deadlocks from the loop "
+                 "itself)",
+    "result": "blocks on a concurrent future",
+}
+
+# .acquire() with no timeout= and no explicit non-blocking flag.
+_ACQUIRE = "acquire"
+
+# Callback-registering attributes whose function-reference argument runs
+# on the loop thread: those references become reachability roots.
+CALLBACK_REGISTRARS = {"call_soon", "call_soon_threadsafe", "call_later",
+                       "call_at", "add_done_callback"}
+
+
+def _is_awaited(parents: Dict[int, ast.AST], call: ast.Call) -> bool:
+    parent = parents.get(id(call))
+    return isinstance(parent, ast.Await) and parent.value is call
+
+
+class _FuncInfo:
+    __slots__ = ("node", "cls", "name")
+
+    def __init__(self, node, cls: Optional[str]):
+        self.node = node
+        self.cls = cls
+        self.name = node.name
+
+
+def _index_module(tree: ast.AST) -> Dict[Tuple[Optional[str], str],
+                                         _FuncInfo]:
+    """{(class_or_None, func_name): info} for the module's defs."""
+    out: Dict[Tuple[Optional[str], str], _FuncInfo] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[(None, node.name)] = _FuncInfo(node, None)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[(node.name, sub.name)] = _FuncInfo(sub, node.name)
+    return out
+
+
+def _body_nodes(func: ast.AST):
+    """The function's statements, descending into nested *async* defs
+    (they are scheduled on the same loop) but not nested sync defs
+    (executor/thread targets) or lambdas/classes."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.FunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+class LoopBlockingPass(Pass):
+    name = "loop-blocking"
+    group = "core"
+    description = ("blocking calls reachable from asyncio event-loop "
+                   "handlers in the NM/GCS")
+
+    modules = EVENT_LOOP_MODULES
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        n_roots = n_visited = 0
+        for rel in self.modules:
+            tree = ctx.tree(rel)
+            if tree is None:
+                if ctx.exists(rel) or rel in ctx.parse_errors:
+                    findings.append(Finding(
+                        self.name, rel, 0,
+                        f"unparseable event-loop module "
+                        f"({ctx.parse_errors.get(rel, 'missing')})"))
+                continue
+            r, v, f = self._run_module(ctx, rel, tree)
+            n_roots += r
+            n_visited += v
+            findings.extend(f)
+        self.stats = (f"walked {n_visited} function(s) from {n_roots} "
+                      f"event-loop root(s)")
+        return findings
+
+    # ---- per-module analysis ----------------------------------------------
+
+    def _run_module(self, ctx: Context, rel: str, tree: ast.AST):
+        funcs = _index_module(tree)
+        # Roots: async defs + sync functions registered as loop callbacks.
+        roots: List[Tuple[Optional[str], str]] = [
+            key for key, info in funcs.items()
+            if isinstance(info.node, ast.AsyncFunctionDef)
+        ]
+        callback_names = self._callback_targets(tree)
+        for key, info in funcs.items():
+            if isinstance(info.node, ast.FunctionDef) and \
+                    info.name in callback_names and key not in roots:
+                roots.append(key)
+
+        findings: List[Finding] = []
+        seen_sites: Set[Tuple[int, str]] = set()
+        visited_all: Set[Tuple[Optional[str], str]] = set()
+        for root in roots:
+            visited: Set[Tuple[Optional[str], str]] = set()
+            stack: List[Tuple[Tuple[Optional[str], str], List[str]]] = [
+                (root, [funcs[root].name])
+            ]
+            while stack:
+                key, path = stack.pop()
+                if key in visited:
+                    continue
+                visited.add(key)
+                visited_all.add(key)
+                info = funcs[key]
+                for site_line, label, why in self._blocking_sites(info.node):
+                    dedup = (site_line, label)
+                    if dedup in seen_sites:
+                        continue
+                    seen_sites.add(dedup)
+                    chain = " -> ".join(path)
+                    findings.append(Finding(
+                        self.name, rel, site_line,
+                        f"blocking call {label} on the event loop "
+                        f"(reachable via {chain})",
+                        hint=f"{why}; run it in an executor "
+                             f"(loop.run_in_executor / asyncio.to_thread) "
+                             f"or use the async equivalent",
+                    ))
+                for callee in self._callees(info, funcs):
+                    if callee not in visited:
+                        stack.append(
+                            (callee, path + [funcs[callee].name]))
+        return len(roots), len(visited_all), findings
+
+    def _callback_targets(self, tree: ast.AST) -> Set[str]:
+        """Bare method/function names passed to loop-callback
+        registrars anywhere in the module."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in CALLBACK_REGISTRARS:
+                for arg in node.args:
+                    name = None
+                    if isinstance(arg, ast.Attribute):
+                        name = arg.attr
+                    elif isinstance(arg, ast.Name):
+                        name = arg.id
+                    if name:
+                        out.add(name)
+        return out
+
+    def _callees(self, info: _FuncInfo,
+                 funcs: Dict[Tuple[Optional[str], str], _FuncInfo]):
+        """Intra-module call edges: f() to module functions,
+        self.m() to same-class methods. Awaited calls traverse too —
+        an awaited coroutine runs on the same loop."""
+        out = []
+        for node in _body_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name):
+                key = (None, fn.id)
+                if key in funcs:
+                    out.append(key)
+            elif isinstance(fn, ast.Attribute) and \
+                    isinstance(fn.value, ast.Name) and \
+                    fn.value.id == "self" and info.cls is not None:
+                key = (info.cls, fn.attr)
+                if key in funcs:
+                    out.append(key)
+        return out
+
+    def _blocking_sites(self, func: ast.AST):
+        """(line, label, why) for each blocking call lexically in
+        ``func`` (nested async defs included, sync defs skipped)."""
+        parents: Dict[int, ast.AST] = {}
+        nodes = list(_body_nodes(func))
+        for node in nodes:
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        # Await nodes' parent map must include func's direct children.
+        for child in ast.iter_child_nodes(func):
+            parents.setdefault(id(child), func)
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            if dotted in BLOCKING_DOTTED:
+                yield (node.lineno, f"{dotted}()", BLOCKING_DOTTED[dotted])
+                continue
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in BLOCKING_BUILTINS:
+                yield (node.lineno, f"{node.func.id}()",
+                       BLOCKING_BUILTINS[node.func.id])
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            if _is_awaited(parents, node):
+                continue  # awaited = coroutine, not a blocking call
+            attr = node.func.attr
+            if attr == _ACQUIRE:
+                kw = {k.arg for k in node.keywords}
+                # acquire(False) / acquire(blocking=False) / a timeout
+                # bound it; a bare acquire() parks the loop thread.
+                if not node.args and not ({"timeout", "blocking"} & kw):
+                    yield (node.lineno, ".acquire() without timeout",
+                           "unbounded lock wait on the loop thread")
+                continue
+            if attr in BLOCKING_ATTRS:
+                # asyncio.sleep / loop-native waits arrive awaited and
+                # were already exempted above.
+                base = dotted_name(node.func.value) or ""
+                label = f".{attr}() on {base}" if base else f".{attr}()"
+                yield (node.lineno, label, BLOCKING_ATTRS[attr])

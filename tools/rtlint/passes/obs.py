@@ -1,0 +1,885 @@
+"""obs-*: the observability lint, migrated from tools/check_metric_names.py.
+
+One analyzer, one baseline, one exit code: the metric/event/profiler/
+chaos/overload/pickle-ban validators that used to live in a standalone
+script are first-class rtlint passes under the "obs" group.
+``tools/check_metric_names.py`` remains as a thin alias shim
+(``python -m tools.rtlint --passes obs``) so `make check-obs` and older
+automation keep working.
+
+The validator functions keep their original names and (repo-root
+parameterized) signatures — they are imported by the shim and by
+tests/test_observability.py — and each Pass below adapts one validator
+family's failure strings into rtlint findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+import pkgutil
+import re
+import sys
+from typing import List
+
+from ..core import Context, Finding, Pass
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+# Modules never imported by the checker: __main__ shims (importing them
+# is harmless but pointless) and entrypoints that exec on import.
+SKIP_SUFFIXES = ("__main__",)
+
+
+def import_package_modules(pkg_name: str = "ray_tpu", repo_root=None):
+    """Import every submodule, tolerating optional-dependency failures
+    (grpc, torch, ...) — a skipped module can't register metrics, so
+    report skips for the log."""
+    # Keep imports off real accelerators when run on a TPU host.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Runnable from the repo root without an installed package.
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    pkg = importlib.import_module(pkg_name)
+    skipped = []
+    for info in pkgutil.walk_packages(pkg.__path__, prefix=f"{pkg_name}."):
+        if info.name.endswith(SKIP_SUFFIXES):
+            continue
+        try:
+            importlib.import_module(info.name)
+        except Exception as e:  # noqa: BLE001 — optional deps, native builds
+            skipped.append((info.name, repr(e)))
+    return skipped
+
+
+def validate(declared, conflicts):
+    """Return a list of human-readable failures."""
+    failures = []
+    for name, (kind, _desc) in sorted(declared.items()):
+        if not NAME_RE.match(name):
+            failures.append(
+                f"{name}: not a valid Prometheus metric name"
+            )
+        if kind == "counter" and not name.endswith("_total"):
+            failures.append(
+                f"{name}: counter name must end with _total "
+                f"(the exposition layer would rename it)"
+            )
+    for name, (old, new) in sorted(conflicts.items()):
+        failures.append(
+            f"{name}: registered as both {old} and {new} — conflicting "
+            f"kinds corrupt the series"
+        )
+    return failures
+
+
+# Module aliases under which ray_tpu code imports util/events.
+_EVENT_ALIASES = ("events", "cluster_events", "_events")
+
+
+def _resolve_enum_arg(node):
+    """Static values an emit-site argument can take: a set of strings,
+    or None when the expression cannot be resolved (a plain variable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id in _EVENT_ALIASES:
+        return {node.attr}
+    if isinstance(node, ast.IfExp):
+        a = _resolve_enum_arg(node.body)
+        b = _resolve_enum_arg(node.orelse)
+        if a is not None and b is not None:
+            return a | b
+        return None
+    return None
+
+
+def _iter_emit_calls(tree):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "emit" and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in _EVENT_ALIASES:
+            yield node
+        elif isinstance(fn, ast.Name) and fn.id == "make_event":
+            yield node
+
+
+def validate_event_sites(pkg_dir, severities, sources):
+    """Return (failures, checked_count) for every events.emit /
+    make_event call under ``pkg_dir``."""
+    failures = []
+    checked = 0
+    for root, _dirs, files in os.walk(pkg_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except SyntaxError as e:
+                failures.append(f"{path}: unparseable ({e})")
+                continue
+            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+            for call in _iter_emit_calls(tree):
+                checked += 1
+                where = f"{rel}:{call.lineno}"
+                args = call.args
+                kwargs = {k.arg: k.value for k in call.keywords if k.arg}
+                for idx, (label, allowed) in enumerate(
+                        (("severity", severities), ("source", sources))):
+                    if idx < len(args):
+                        arg = args[idx]
+                    elif label in kwargs:
+                        arg = kwargs[label]
+                    else:
+                        failures.append(
+                            f"{where}: emit() missing {label} argument"
+                        )
+                        continue
+                    values = _resolve_enum_arg(arg)
+                    if values is None:
+                        continue  # dynamic expression: runtime-checked
+                    for v in values - set(allowed):
+                        failures.append(
+                            f"{where}: {label} {v!r} is not a declared "
+                            f"event {label} (one of {sorted(allowed)})"
+                        )
+    return failures, checked
+
+
+# Config keys the profiling & hang-diagnosis plane documents; each must
+# be a real field on core.config.Config (a typo'd getattr default would
+# otherwise silently disable the knob).
+PROFILER_CONFIG_KEYS = ("hang_task_warn_s", "profile_max_seconds")
+
+# The object-transfer data plane's metric surface (core/object_transfer.py)
+# with the kind each must be declared under — the README documents these
+# names, so a rename/kind change must fail CI, not dashboards.
+TRANSFER_METRICS = {
+    "ray_tpu_object_transfer_bytes_total": "counter",
+    "ray_tpu_object_transfer_seconds": "histogram",
+    "ray_tpu_object_transfer_inflight": "gauge",
+    "ray_tpu_object_transfer_fallbacks_total": "counter",
+}
+
+# Config keys the transfer plane documents (README "Object transfer
+# plane" knobs).
+TRANSFER_CONFIG_KEYS = (
+    "transfer_streams_per_peer", "object_transfer_chunk_bytes",
+    "transfer_connect_timeout_s", "transfer_io_timeout_s",
+)
+
+
+def validate_transfer_metrics(declared):
+    failures = []
+    for name, kind in sorted(TRANSFER_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: transfer data-plane metric not declared "
+                f"(core/object_transfer.py drifted from the documented "
+                f"surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    return failures
+
+
+def _config_fields():
+    import dataclasses
+
+    from ray_tpu.core.config import Config
+
+    return {f.name for f in dataclasses.fields(Config)}
+
+
+def validate_transfer_config():
+    fields = _config_fields()
+    return [
+        f"core/config.py: transfer config key {key!r} missing from "
+        f"Config (documented knob drifted from the flag table)"
+        for key in TRANSFER_CONFIG_KEYS if key not in fields
+    ]
+
+
+def _pickle_ban(path, rel, why):
+    """Flag any pickle/cloudpickle import in ``path`` (AST-level, so
+    aliasing can't hide one)."""
+    if not os.path.isfile(path):
+        return [f"{path}: missing (module deleted without updating the "
+                f"lint?)"]
+    with open(path) as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            return [f"{path}: unparseable ({e})"]
+    banned = {"pickle", "cloudpickle", "_pickle"}
+    failures = []
+    for node in ast.walk(tree):
+        names = []
+        if isinstance(node, ast.Import):
+            names = [a.name.split(".")[0] for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            names = [node.module.split(".")[0]]
+        for name in names:
+            if name in banned:
+                failures.append(f"{rel}:{node.lineno}: imports {name!r} — "
+                                f"{why}")
+    return failures
+
+
+def validate_data_channel_pickle_free(pkg_dir):
+    """The data plane's whole point is no pickle on the chunk path: flag
+    any pickle/cloudpickle import in core/data_channel.py."""
+    return _pickle_ban(
+        os.path.join(pkg_dir, "core", "data_channel.py"),
+        "ray_tpu/core/data_channel.py",
+        "the data plane must stay pickle-free (binary frames only)",
+    )
+
+
+# ---- native frame-pump lint -----------------------------------------------
+# The pump's metric surface (core/frame_pump.py) — README documents these
+# names; the bench's satellite_guards block reads the fallback counter.
+NATIVE_METRICS = {
+    "ray_tpu_native_fallbacks_total": "counter",
+    "ray_tpu_native_pump_channels": "gauge",
+}
+
+
+def validate_native_pump_metrics(declared):
+    """Fallback counter + engaged/active gauge are declared with the
+    documented kinds."""
+    failures = []
+    for name, kind in sorted(NATIVE_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: native frame-pump metric not declared "
+                f"(core/frame_pump.py drifted from the documented surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    return failures
+
+
+def validate_native_pump_pickle(pkg_dir, repo_root):
+    """(a) the pump bindings module is pickle-banned like
+    data_channel.py — the codec's whole point is no pickle on the hot
+    dialect (generic control frames delegate to protocol.dumps_msg at
+    call sites); (b) the C++ binding never imports a pickle module
+    either."""
+    failures = _pickle_ban(
+        os.path.join(pkg_dir, "core", "frame_pump.py"),
+        "ray_tpu/core/frame_pump.py",
+        "the native pump bindings must stay pickle-free (the codec "
+        "replaces pickle on the hot dialect; generic frames go through "
+        "protocol.dumps_msg at the call sites)",
+    )
+    module_cc = os.path.join(repo_root, "src", "pump", "_rtpump_module.cc")
+    if not os.path.isfile(module_cc):
+        failures.append(f"{module_cc}: missing (pump deleted without "
+                        f"updating the lint?)")
+    else:
+        with open(module_cc) as f:
+            src = f.read()
+        for needle in ("PyImport_ImportModule(\"pickle\"",
+                       "PyImport_ImportModule(\"cloudpickle\"",
+                       "PyImport_ImportModule(\"_pickle\""):
+            if needle in src:
+                failures.append(
+                    f"src/pump/_rtpump_module.cc: {needle}...) — the "
+                    f"native codec must not round-trip through pickle"
+                )
+    return failures
+
+
+def validate_native_pump(pkg_dir, repo_root, declared):
+    """Back-compat aggregate (external callers of the old script API):
+    metric kinds + both pickle bans."""
+    return (validate_native_pump_metrics(declared)
+            + validate_native_pump_pickle(pkg_dir, repo_root))
+
+# The direct actor-call plane's metric surface (core/runtime.py) with
+# the kind each must be declared under — README documents these names,
+# so a rename/kind change must fail CI, not dashboards.
+ACTOR_METRICS = {
+    "ray_tpu_actor_call_seconds": "histogram",
+    "ray_tpu_actor_call_inflight": "gauge",
+    "ray_tpu_actor_call_fallbacks_total": "counter",
+}
+
+# Config keys the direct actor-call plane documents (README knobs).
+ACTOR_CONFIG_KEYS = (
+    "direct_actor_calls", "direct_resolve_timeout_s",
+    "direct_done_flush_batch", "direct_done_flush_ms",
+)
+
+
+def validate_actor_metrics(declared):
+    failures = []
+    for name, kind in sorted(ACTOR_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: direct actor-call metric not declared "
+                f"(core/runtime.py drifted from the documented surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    return failures
+
+
+def validate_actor_config():
+    fields = _config_fields()
+    return [
+        f"core/config.py: direct actor-call config key {key!r} missing "
+        f"from Config (documented knob drifted from the flag table)"
+        for key in ACTOR_CONFIG_KEYS if key not in fields
+    ]
+
+
+# ---- chaos plane lint ----------------------------------------------------
+# util/faults.py is the single registry of injection points. The lint
+# enforces: (a) every point CONSTANT maps 1:1 onto a FAULT_POINTS key
+# (each name registered exactly once — a duplicate or orphan constant
+# would silently split the plan from the firing sites); (b) every
+# registered point has at least one faults.fire() site in the package
+# (a point with no firing site is dead chaos surface); (c) every
+# fire() site names a registered point (a typo'd point would no-op
+# forever); (d) every firing is observable: the central emitter in
+# util/faults.py publishes under the CHAOS source, which must be a
+# declared event source enum; (e) the drain config knob the README
+# documents exists on Config.
+
+DRAIN_CONFIG_KEYS = ("drain_timeout_s",)
+
+
+def _parse_fault_registry(faults_path):
+    """Return (constants {NAME: value}, registered point names,
+    failures) from util/faults.py's module-level declarations."""
+    failures = []
+    with open(faults_path) as f:
+        tree = ast.parse(f.read(), filename=faults_path)
+    constants = {}
+    registered = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name.isupper() and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str) \
+                    and name not in ("MODES", "ACTIONS"):
+                constants[name] = node.value.value
+        if isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.target.id == "FAULT_POINTS" and \
+                isinstance(node.value, ast.Dict):
+            for key in node.value.keys:
+                if isinstance(key, ast.Name):
+                    registered.append(key.id)
+                elif isinstance(key, ast.Constant):
+                    registered.append(key.value)
+    if not registered:
+        failures.append(
+            "util/faults.py: FAULT_POINTS registry not found (chaos "
+            "plane deleted without updating the lint?)"
+        )
+    return constants, registered, failures
+
+
+def validate_fault_points(pkg_dir):
+    """Chaos-plane lint: registry 1:1, every point fired somewhere,
+    every fire() site names a registered point, firings observable."""
+    faults_path = os.path.join(pkg_dir, "util", "faults.py")
+    if not os.path.isfile(faults_path):
+        return [f"{faults_path}: missing (chaos plane deleted without "
+                f"updating the lint?)"], 0
+    constants, registered, failures = _parse_fault_registry(faults_path)
+
+    # (a) exactly-once registration: constants <-> FAULT_POINTS keys.
+    point_values = {}
+    for cname in registered:
+        value = constants.get(cname, cname)
+        if value in point_values:
+            failures.append(
+                f"util/faults.py: injection point {value!r} registered "
+                f"more than once in FAULT_POINTS"
+            )
+        point_values[value] = cname
+    for cname, value in constants.items():
+        if cname not in registered:
+            failures.append(
+                f"util/faults.py: point constant {cname} = {value!r} "
+                f"is not registered in FAULT_POINTS"
+            )
+
+    # (b)+(c) every fire() site names a registered point; every point
+    # has at least one site outside util/faults.py.
+    fired = {}
+    checked = 0
+    for root, _dirs, files in os.walk(pkg_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            if os.path.abspath(path) == os.path.abspath(faults_path):
+                continue
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    failures.append(f"{path}: unparseable ({e})")
+                    continue
+            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if not (isinstance(fn, ast.Attribute) and fn.attr == "fire"
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id == "faults"):
+                    continue
+                checked += 1
+                where = f"{rel}:{node.lineno}"
+                if not node.args:
+                    failures.append(f"{where}: faults.fire() with no "
+                                    f"injection point argument")
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Attribute) and \
+                        isinstance(arg.value, ast.Name) and \
+                        arg.value.id == "faults":
+                    if arg.attr not in constants:
+                        failures.append(
+                            f"{where}: faults.fire(faults.{arg.attr}) "
+                            f"names an undeclared point constant"
+                        )
+                    else:
+                        fired.setdefault(constants[arg.attr], []).append(where)
+                elif isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    if arg.value not in point_values:
+                        failures.append(
+                            f"{where}: faults.fire({arg.value!r}) names "
+                            f"an unregistered injection point"
+                        )
+                    else:
+                        fired.setdefault(arg.value, []).append(where)
+                else:
+                    failures.append(
+                        f"{where}: faults.fire() point must be a "
+                        f"faults.CONSTANT or string literal (dynamic "
+                        f"points defeat the registry lint)"
+                    )
+    for value in point_values:
+        if value not in fired:
+            failures.append(
+                f"util/faults.py: injection point {value!r} has no "
+                f"faults.fire() site anywhere in the package (dead "
+                f"chaos surface)"
+            )
+
+    # (d) every firing is observable: the central emitter publishes
+    # under the CHAOS source, and CHAOS is a declared source enum.
+    from ray_tpu.util.events import SOURCES
+
+    if "CHAOS" not in SOURCES:
+        failures.append(
+            "util/events.py: CHAOS missing from SOURCES — chaos "
+            "firings would raise at emit time instead of publishing"
+        )
+    with open(faults_path) as f:
+        src = f.read()
+    if "events.CHAOS" not in src:
+        failures.append(
+            "util/faults.py: the firing path no longer emits under "
+            "events.CHAOS — every injection must stay observable via "
+            "`rtpu events --source CHAOS`"
+        )
+    return failures, checked
+
+
+def validate_drain_config():
+    fields = _config_fields()
+    return [
+        f"core/config.py: drain config key {key!r} missing from Config "
+        f"(documented knob drifted from the flag table)"
+        for key in DRAIN_CONFIG_KEYS if key not in fields
+    ]
+
+
+# ---- serve overload-control lint -----------------------------------------
+# The request-robustness plane's metric surface (serve/_telemetry.py)
+# and config knobs (README documents both; a rename must fail CI).
+
+OVERLOAD_METRICS = {
+    "ray_tpu_serve_shed_total": "counter",
+    "ray_tpu_serve_deadline_exceeded_total": "counter",
+    "ray_tpu_serve_breaker_state": "gauge",
+    "ray_tpu_serve_retries_total": "counter",
+}
+
+OVERLOAD_CONFIG_KEYS = (
+    "serve_default_request_timeout_s", "serve_proxy_concurrency",
+    "serve_shed_queue_len", "serve_aimd_latency_target_s",
+    "serve_breaker_error_threshold", "serve_breaker_min_volume",
+    "serve_breaker_open_s", "serve_breaker_eject_s",
+    "serve_retry_budget_ratio",
+)
+
+
+def validate_overload_metrics(declared):
+    failures = []
+    for name, kind in sorted(OVERLOAD_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: serve overload-control metric not declared "
+                f"(serve/_telemetry.py drifted from the documented "
+                f"surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    return failures
+
+
+def validate_overload_config():
+    fields = _config_fields()
+    return [
+        f"core/config.py: serve overload config key {key!r} missing "
+        f"from Config (documented knob drifted from the flag table)"
+        for key in OVERLOAD_CONFIG_KEYS if key not in fields
+    ]
+
+
+# The serve REQUEST-PATH modules (control-plane waits in controller.py /
+# api.py — deploys, drains, health checks — are exempt: they are not
+# bounded by a request's budget).
+SERVE_REQUEST_PATH_FILES = (
+    "asgi_ingress.py", "dag_driver.py", "grpc_ingress.py",
+    "http_proxy.py", "handle.py",
+)
+
+
+def validate_serve_no_hardcoded_timeouts(pkg_dir):
+    """The serve request path's timeouts derive from ONE source of
+    truth (serve_default_request_timeout_s seeding the deadline budget,
+    util/overload.remaining() at wait sites). Flag any ``timeout=<num>``
+    literal >= 30s creeping back into request-path calls."""
+    failures = []
+    checked = 0
+    serve_dir = os.path.join(pkg_dir, "serve")
+    for fname in SERVE_REQUEST_PATH_FILES:
+        path = os.path.join(serve_dir, fname)
+        with open(path) as f:
+            try:
+                tree = ast.parse(f.read(), filename=path)
+            except SyntaxError as e:
+                failures.append(f"{path}: unparseable ({e})")
+                continue
+        checked += 1
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "timeout" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, (int, float)) and \
+                        kw.value.value >= 30:
+                    failures.append(
+                        f"ray_tpu/serve/{fname}:{node.lineno}: "
+                        f"hard-coded timeout={kw.value.value} — serve "
+                        f"request-path waits must derive from the "
+                        f"deadline budget (util/overload.remaining) "
+                        f"seeded by serve_default_request_timeout_s"
+                    )
+    return failures, checked
+
+
+# ---- serve handle hot-path lint ------------------------------------------
+# The serve request hot path must stay free of blocking node-manager
+# round-trips: with the direct actor-call plane, a steady-state request
+# is submit -> direct channel -> inline reply; one stray control-plane
+# call per request would reintroduce the NM as the serving bottleneck.
+# Calls to these names are allowed ONLY inside except-handler recovery
+# blocks of the hot-path functions below.
+SERVE_HOT_PATH_FUNCS = {
+    "remote", "_remote_batched", "_run_with_retry", "_flush",
+    "_route_with_retry", "_pick_with_refresh", "pick", "begin", "end",
+}
+SERVE_BLOCKING_NM_CALLS = {
+    "force_refresh", "call_sync", "request", "kv_get", "kv_put",
+    "kv_keys", "pubsub_op", "get_named_actor", "cluster_state", "nodes",
+}
+
+
+def _call_name(node: ast.Call):
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def validate_serve_hot_path(pkg_dir):
+    """Flag blocking NM round-trips outside except-handler recovery in
+    serve/handle.py's per-request hot path."""
+    path = os.path.join(pkg_dir, "serve", "handle.py")
+    if not os.path.isfile(path):
+        return [f"{path}: missing (serve handle moved without updating "
+                f"the lint?)"], 0
+    with open(path) as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            return [f"{path}: unparseable ({e})"], 0
+    failures = []
+    checked = 0
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in SERVE_HOT_PATH_FUNCS:
+            continue
+        checked += 1
+        # Every call node living under an except handler is recovery
+        # code (dead-replica refresh etc.) and exempt.
+        recovery_calls = set()
+        for handler in ast.walk(node):
+            if isinstance(handler, ast.ExceptHandler):
+                for call in ast.walk(handler):
+                    if isinstance(call, ast.Call):
+                        recovery_calls.add(id(call))
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call) or id(call) in recovery_calls:
+                continue
+            name = _call_name(call)
+            if name in SERVE_BLOCKING_NM_CALLS:
+                failures.append(
+                    f"ray_tpu/serve/handle.py:{call.lineno}: hot-path "
+                    f"function {node.name} calls blocking NM round-trip "
+                    f"{name}() outside except-handler recovery (the "
+                    f"direct actor-call plane keeps steady-state serve "
+                    f"requests off the node manager)"
+                )
+    return failures, checked
+
+
+# Callables that sample for a full wall-clock duration. Calling one of
+# these from a dashboard request handler blocks (and self-pollutes) the
+# request thread; handlers must use sample_in_thread / cluster fan-out.
+BLOCKING_SAMPLERS = {"_sample_stacks"}
+BLOCKING_SAMPLER_ATTRS = {("profiler", "sample")}
+
+
+def validate_profiler_config():
+    fields = _config_fields()
+    return [
+        f"core/config.py: profiler config key {key!r} missing from "
+        f"Config (documented knob drifted from the flag table)"
+        for key in PROFILER_CONFIG_KEYS if key not in fields
+    ]
+
+
+def _is_blocking_sampler_call(node):
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id in BLOCKING_SAMPLERS:
+        return True
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in BLOCKING_SAMPLERS:
+            return True
+        if isinstance(fn.value, ast.Name) and \
+                (fn.value.id, fn.attr) in BLOCKING_SAMPLER_ATTRS:
+            return True
+    return False
+
+
+def validate_dashboard_handlers(pkg_dir):
+    """Flag blocking sampler calls inside dashboard request handlers
+    (any function named do_GET/do_POST in the dashboard modules)."""
+    failures = []
+    checked = 0
+    for fname in ("dashboard.py", "dashboard_agent.py"):
+        path = os.path.join(pkg_dir, fname)
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            failures.append(f"{path}: unparseable ({e})")
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef) or \
+                    node.name not in ("do_GET", "do_POST"):
+                continue
+            checked += 1
+            for call in ast.walk(node):
+                if isinstance(call, ast.Call) and \
+                        _is_blocking_sampler_call(call):
+                    failures.append(
+                        f"ray_tpu/{fname}:{call.lineno}: handler "
+                        f"{node.name} calls a blocking sampler on the "
+                        f"request thread (use profiler.sample_in_thread "
+                        f"or the cluster profile fan-out)"
+                    )
+    return failures, checked
+
+
+# ---- rtlint pass adapters --------------------------------------------------
+
+_LOC_RE = re.compile(r"^(\S+?\.(?:py|cc|h)):(\d+): ?(.*)$", re.DOTALL)
+_FILE_RE = re.compile(r"^(\S+?\.(?:py|cc|h)): ?(.*)$", re.DOTALL)
+
+
+def _to_findings(pass_name: str, failures: List[str], ctx: Context,
+                 default_path: str) -> List[Finding]:
+    """Adapt validator failure strings ("path:line: msg" / "path: msg" /
+    free text) into findings. The message doubles as the baseline key:
+    validator output is stable and line numbers inside it are part of
+    the failure identity."""
+    out = []
+    for failure in failures:
+        path, line, msg = default_path, 0, failure
+        m = _LOC_RE.match(failure)
+        if m:
+            path, line, msg = m.group(1), int(m.group(2)), m.group(3)
+        else:
+            m = _FILE_RE.match(failure)
+            if m:
+                path, msg = m.group(1), m.group(2)
+        if os.path.isabs(path):
+            path = os.path.relpath(path, ctx.root).replace(os.sep, "/")
+        out.append(Finding(pass_name, path, line, msg, key=failure))
+    return out
+
+
+def _obs_state(ctx: Context):
+    """Import the package once per run; share declared metrics + skip
+    list between the obs passes."""
+
+    def build():
+        skipped = import_package_modules(repo_root=ctx.root)
+        from ray_tpu.util.metrics import (
+            declaration_conflicts,
+            declared_metrics,
+        )
+
+        return {
+            "skipped": skipped,
+            "declared": declared_metrics(),
+            "conflicts": declaration_conflicts(),
+        }
+
+    return ctx.once("obs-state", build)
+
+
+class ObsMetricsPass(Pass):
+    name = "obs-metrics"
+    group = "obs"
+    description = ("declared metric names/kinds + documented metric "
+                   "surfaces and config knobs (transfer/actor/native/"
+                   "overload/profiler/drain)")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        state = _obs_state(ctx)
+        declared = state["declared"]
+        failures = validate(declared, state["conflicts"])
+        failures += validate_transfer_metrics(declared)
+        failures += validate_actor_metrics(declared)
+        failures += validate_overload_metrics(declared)
+        failures += validate_native_pump_metrics(declared)
+        failures += validate_transfer_config()
+        failures += validate_actor_config()
+        failures += validate_overload_config()
+        failures += validate_profiler_config()
+        failures += validate_drain_config()
+        self.stats = (f"{len(declared)} declared metric(s), "
+                      f"{len(state['skipped'])} module(s) skipped at "
+                      f"import")
+        return _to_findings(self.name, failures, ctx,
+                            "ray_tpu/util/metrics.py")
+
+
+class ObsEventsPass(Pass):
+    name = "obs-events"
+    group = "obs"
+    description = "event emit sites resolve to declared severity/source"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        _obs_state(ctx)
+        from ray_tpu.util.events import SEVERITIES, SOURCES
+
+        failures, checked = validate_event_sites(
+            os.path.join(ctx.root, "ray_tpu"), SEVERITIES, SOURCES)
+        self.stats = f"checked {checked} emit site(s)"
+        return _to_findings(self.name, failures, ctx,
+                            "ray_tpu/util/events.py")
+
+
+class ObsChaosPass(Pass):
+    name = "obs-chaos"
+    group = "obs"
+    description = ("chaos injection-point registry 1:1 with fire() "
+                   "sites, firings observable")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        _obs_state(ctx)
+        failures, checked = validate_fault_points(
+            os.path.join(ctx.root, "ray_tpu"))
+        self.stats = f"checked {checked} faults.fire() site(s)"
+        return _to_findings(self.name, failures, ctx,
+                            "ray_tpu/util/faults.py")
+
+
+class ObsPicklePass(Pass):
+    name = "obs-pickle"
+    group = "obs"
+    description = "pickle bans on the data plane + native pump bindings"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        pkg_dir = os.path.join(ctx.root, "ray_tpu")
+        failures = validate_data_channel_pickle_free(pkg_dir)
+        failures += validate_native_pump_pickle(pkg_dir, ctx.root)
+        self.stats = ("checked data_channel + frame_pump + "
+                      "_rtpump_module pickle bans")
+        return _to_findings(self.name, failures, ctx,
+                            "ray_tpu/core/data_channel.py")
+
+
+class ObsServePass(Pass):
+    name = "obs-serve"
+    group = "obs"
+    description = ("serve hot path NM-free + no hard-coded request-path "
+                   "timeouts + dashboard handlers non-blocking")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        pkg_dir = os.path.join(ctx.root, "ray_tpu")
+        failures, n_hot = validate_serve_hot_path(pkg_dir)
+        t_failures, n_files = validate_serve_no_hardcoded_timeouts(pkg_dir)
+        d_failures, n_handlers = validate_dashboard_handlers(pkg_dir)
+        self.stats = (f"{n_hot} hot-path func(s), {n_files} serve "
+                      f"module(s), {n_handlers} dashboard handler(s)")
+        return _to_findings(self.name, failures + t_failures + d_failures,
+                            ctx, "ray_tpu/serve/handle.py")

@@ -1,0 +1,144 @@
+"""swallowed-failure: control planes must never eat an exception silently.
+
+A bare/broad ``except`` on a control-plane module that neither
+re-raises, emits a cluster event, fires a metric, nor logs at WARNING+
+turns a real failure (reconcile crash, replica shutdown refusal, node
+terminate error) into silence — the exact failure mode the PR 2 event
+plane exists to prevent. Data-plane/hot-path modules are out of scope
+(their narrow ``except: pass`` cleanup idioms are deliberate and
+latency-bound); the control-plane module list below is explicit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Context, Finding, Pass
+
+# Control-plane modules: code whose failures steer the cluster (not a
+# request). Additions welcome; hot-path modules stay out by design.
+CONTROL_PLANE_MODULES = (
+    "ray_tpu/core/gcs.py",
+    "ray_tpu/core/node_manager.py",
+    "ray_tpu/core/worker_main.py",
+    "ray_tpu/core/peers.py",
+    "ray_tpu/serve/controller.py",
+    "ray_tpu/autoscaler/autoscaler.py",
+    "ray_tpu/autoscaler/node_provider.py",
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+# Handler body constructs that surface the failure.
+_LOG_METHODS = {"warning", "error", "exception", "critical", "fatal"}
+_METRIC_METHODS = {"inc", "observe", "set"}
+_EVENT_ALIASES = {"events", "cluster_events", "_events"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e for e in t.elts]
+    else:
+        names = [t]
+    for e in names:
+        if isinstance(e, ast.Name) and e.id in _BROAD:
+            return True
+        if isinstance(e, ast.Attribute) and e.attr in _BROAD:
+            return True
+    return False
+
+
+def _handler_path_nodes(handler: ast.ExceptHandler):
+    """Nodes that execute on the handler's own path: skips nested
+    function/lambda bodies (deferred code) and nested except-handlers
+    (an inner handler's log/raise surfaces the INNER failure, not this
+    one — `except Exception: try: cleanup() except OSError: log(...)`
+    still swallows the original exception)."""
+    stack = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef,
+                             ast.ExceptHandler)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _surfaces_failure(handler: ast.ExceptHandler) -> Optional[str]:
+    """The first failure-surfacing construct in the handler body, or
+    None when the exception is swallowed."""
+    for node in _handler_path_nodes(handler):
+        if isinstance(node, ast.Raise):
+            return "raise"
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if fn.attr == "emit" and isinstance(base, ast.Name) and \
+                    base.id in _EVENT_ALIASES:
+                return "event"
+            if fn.attr in _LOG_METHODS:
+                return "log"
+            if fn.attr in _METRIC_METHODS:
+                return "metric"
+            if fn.attr == "write" and isinstance(base, ast.Attribute) \
+                    and base.attr == "stderr":
+                return "stderr"
+        elif isinstance(fn, ast.Name):
+            if fn.id == "make_event":
+                return "event"
+            if fn.id == "print":
+                for kw in node.keywords:
+                    if kw.arg == "file":
+                        return "stderr"
+    return None
+
+
+class SwallowedFailurePass(Pass):
+    name = "swallowed-failure"
+    group = "core"
+    description = ("broad excepts on control-plane modules must "
+                   "re-raise, emit an event, fire a metric, or log")
+
+    modules = CONTROL_PLANE_MODULES
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        checked = 0
+        for rel in self.modules:
+            tree = ctx.tree(rel)
+            if tree is None:
+                if ctx.exists(rel) or rel in ctx.parse_errors:
+                    findings.append(Finding(
+                        self.name, rel, 0,
+                        f"unparseable control-plane module "
+                        f"({ctx.parse_errors.get(rel, 'missing')})"))
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not _is_broad(node):
+                    continue
+                checked += 1
+                if _surfaces_failure(node) is None:
+                    what = ("bare except" if node.type is None
+                            else "broad except")
+                    findings.append(Finding(
+                        self.name, rel, node.lineno,
+                        f"{what} swallows the failure on a "
+                        f"control-plane module (no raise, no cluster "
+                        f"event, no metric, no WARNING+ log)",
+                        hint="emit a WARNING cluster event (util/"
+                             "events.emit) or re-raise; if this except "
+                             "is genuinely benign, say why with "
+                             "# rtlint: disable=swallowed-failure",
+                    ))
+        self.stats = f"checked {checked} broad except handler(s)"
+        return findings
