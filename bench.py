@@ -3,9 +3,12 @@
 Measures steady-state training throughput of the flagship Llama model
 THROUGH THE FRAMEWORK: a JaxTrainer gang (1 TPU worker actor) trains on
 batches streamed by ray_tpu.data's iter_jax_batches device-prefetch path,
-reporting through the session channel — the same path a user's training
-job takes (VERDICT r1: the bench must exercise the framework, not raw
-jax). Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+stepping through the fused compiled train step
+(ray_tpu/train/compiled_step.py: pjit + donation + chunked-scan
+schedule), reporting through the session channel — the same path a
+user's training job takes (VERDICT r1: the bench must exercise the
+framework, not raw jax). Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline"}.
 
 The reference publishes no TPU tokens/sec numbers (BASELINE.md — published
 set is empty; north-star metrics are established by our own harness), so
@@ -17,6 +20,16 @@ On the accelerator the model is 8B-SHAPED: Llama-8B layer geometry
 (hidden 4096, intermediate 14336, 32 heads / 8 KV heads) with the layer
 count cut to fit one chip's HBM alongside optimizer state — per-layer MXU
 utilization (what MFU measures) is that of the 8B flagship.
+
+A/B matrix mode (``RAY_TPU_BENCH_AB=1``, `make perf-train`): sweeps
+scan × chunk-size × remat-policy × donation × depth, one fresh worker
+gang per row (a clean chip between rows — an OOM row cannot poison the
+next), and writes per-config rows (tokens/s, MFU, peak HBM, allocator
+fragmentation from ``device.memory_stats()``) plus the machine-picked
+winners into ``BENCH_AB.json``. The default single-config run stays
+byte-compatible with the existing harness and — when a sweep record for
+THIS backend exists — runs the sweep's best config instead of the
+hand-picked default (env knobs still win).
 """
 
 from __future__ import annotations
@@ -29,6 +42,8 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+AB_OUT_DEFAULT = os.path.join(_REPO, "BENCH_AB.json")
+
 
 def peak_flops_per_chip(backend: str) -> float:
     if backend == "tpu" or backend == "axon":
@@ -36,6 +51,72 @@ def peak_flops_per_chip(backend: str) -> float:
         # for unknown TPU generations.
         return 197e12
     return 1e12  # CPU placeholder so MFU stays finite in dev runs
+
+
+def _resolve_knobs(config, backend: str, on_accel: bool):
+    """Layered config resolution, most-specific first: an explicit AB-row
+    dict (sweep mode) > env knobs > the machine-picked best from a prior
+    sweep of the SAME backend > defaults."""
+    config = config or {}
+    row = config.get("row") or {}
+    ab = config.get("ab_best") or {}
+    ab_cfg = ab.get("config") or {} if ab.get("backend") == backend else {}
+
+    def get(key, env, default):
+        if key in row:
+            return row[key]
+        v = os.environ.get(env)
+        if v is not None:
+            return v
+        if key in ab_cfg:
+            return ab_cfg[key]
+        return default
+
+    knobs = {
+        "flash": str(get("flash", "RAY_TPU_BENCH_FLASH", "1")) == "1",
+        "remat": str(get("remat", "RAY_TPU_BENCH_REMAT", "dots")),
+        "loss_chunk": int(get("loss_chunk", "RAY_TPU_BENCH_LOSS_CHUNK",
+                              "512")),
+        # Scan is the default-on path now: with the layer-chunked
+        # schedule (scan_chunk) the compiled program at chunk=L is the
+        # old unrolled winner, and smaller chunks are what full depth
+        # needs. RAY_TPU_BENCH_SCAN=0 forces the python-unrolled loop.
+        "scan": str(get("scan", "RAY_TPU_BENCH_SCAN", "1")) == "1",
+        "scan_chunk": int(get("scan_chunk", "RAY_TPU_BENCH_SCAN_CHUNK",
+                              "0")),
+        "layers": int(get("layers", "RAY_TPU_BENCH_LAYERS",
+                          "4" if on_accel else "2")),
+        "batch": int(get("batch", "RAY_TPU_BENCH_BATCH",
+                         "8" if on_accel else "4")),
+        "steps": int(get("steps", "RAY_TPU_BENCH_STEPS",
+                         "16" if on_accel else "3")),
+        "donate": str(get("donate", "RAY_TPU_BENCH_DONATE", "1")) == "1",
+    }
+    layers_clamped = False
+    if not on_accel:
+        # Tiny-geometry dev shapes; keep the schedule knobs meaningful.
+        clamped = min(knobs["layers"], 4)
+        layers_clamped = clamped != knobs["layers"]
+        knobs["layers"] = clamped
+        knobs["steps"] = min(knobs["steps"], 4)
+    if knobs["scan"]:
+        k = knobs["scan_chunk"]
+        if k <= 0 or (layers_clamped and knobs["layers"] % k):
+            # Auto (or a requested chunk invalidated by the dev-shape
+            # depth clamp): the largest divisor <= 4. At bench depth
+            # (L=4) that is K=L — one chunk, which XLA's while-loop
+            # simplifier turns into the straight-line (unrolled)
+            # program; at real depth it caps the unrolled chunk body
+            # while shrinking the stacked residuals by 4x. An
+            # EXPLICITLY requested non-divisor passes through untouched
+            # so scan_chunks() raises rather than silently measuring a
+            # different schedule than the env asked for.
+            if k <= 0:
+                k = min(knobs["layers"], 4)
+            while knobs["layers"] % k:
+                k -= 1  # nearest divisor below; terminates at 1
+        knobs["scan_chunk"] = k
+    return knobs
 
 
 def bench_train_loop(config=None):
@@ -46,62 +127,59 @@ def bench_train_loop(config=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
-    import optax
+    import optax  # noqa: F401  (the step owns the optimizer)
 
     from ray_tpu import train as rt_train
-    from ray_tpu.models import (
-        LlamaConfig,
-        causal_lm_loss,
-        init_params,
-        num_params,
-    )
+    from ray_tpu.models import LlamaConfig
+    from ray_tpu.train.compiled_step import CompiledTrainStep
 
     backend = jax.default_backend()
     on_accel = backend not in ("cpu",)
-    # A/B knobs (PERF harness): flash kernel on/off, remat policy, batch.
-    # Defaults = the measured-best single-chip config (r5 A/B matrix):
-    # remat=dots + unrolled layers + chunked cross-entropy. Unrolling
-    # removes the scan's stacked [L, ...] residual buffers whose
-    # fragmentation OOM'd dots in r4 (46% frag at 10 G HLO temp); the
-    # chunked loss removes the [B, S, V] fp32 logits cliff (b16 ran at
-    # 0.31 MFU in r4, 0.59 now). b8/dots/noscan/chunked: 0.649 MFU vs
-    # r4's 0.596.
-    use_flash = os.environ.get("RAY_TPU_BENCH_FLASH", "1") == "1"
-    remat_policy = os.environ.get("RAY_TPU_BENCH_REMAT", "dots")
-    loss_chunk = int(os.environ.get("RAY_TPU_BENCH_LOSS_CHUNK", "512"))
-    scan_layers = os.environ.get("RAY_TPU_BENCH_SCAN", "0") == "1"
+    knobs = _resolve_knobs(config, backend, on_accel)
     if on_accel:
         # 8B-shaped layers (Llama-8B geometry), depth cut to fit one
         # chip. Full-depth 8B does not fit a single v5e: 8.0B params ×
         # (2 bf16 param + 2 bf16 grad + 4 adamw m/v bf16) ≈ 64 GB vs
         # 16 GB HBM; 4 layers ≈ 1.14B params ≈ 9.2 GB + activations.
+        # The ab_matrix's depth ladder finds the deepest scan-chunked
+        # config that still fits beside the optimizer state.
         cfg = LlamaConfig(
             vocab_size=32_768,
             hidden_size=4096,
             intermediate_size=14_336,
-            num_layers=4,
+            num_layers=knobs["layers"],
             num_heads=32,
             num_kv_heads=8,
             dtype=jnp.bfloat16,
-            use_flash=use_flash,
-            remat_policy=remat_policy,
-            loss_chunk=loss_chunk,
-            scan_layers=scan_layers,
+            use_flash=knobs["flash"],
+            remat_policy=knobs["remat"],
+            loss_chunk=knobs["loss_chunk"],
+            scan_layers=knobs["scan"],
+            scan_chunk=knobs["scan_chunk"] if knobs["scan"] else 0,
         )
-        batch, seqlen, measure_steps = (
-            int(os.environ.get("RAY_TPU_BENCH_BATCH", "8")), 2048,
-            int(os.environ.get("RAY_TPU_BENCH_STEPS", "16")))
+        batch, seqlen = knobs["batch"], 2048
     else:
         cfg = LlamaConfig(
             vocab_size=1024, hidden_size=128, intermediate_size=256,
-            num_layers=2, num_heads=4, num_kv_heads=2, dtype=jnp.float32,
+            num_layers=knobs["layers"], num_heads=4, num_kv_heads=2,
+            dtype=jnp.float32,
+            use_flash=knobs["flash"],
+            remat_policy=knobs["remat"],
+            loss_chunk=0,
+            scan_layers=knobs["scan"],
+            scan_chunk=knobs["scan_chunk"] if knobs["scan"] else 0,
         )
-        batch, seqlen, measure_steps = 4, 256, 3
+        batch, seqlen = knobs["batch"], 256
+    measure_steps = knobs["steps"]
 
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    p_count = num_params(params)
-    tx = optax.adamw(1e-3)
-    opt_state = tx.init(params)
+    # The fused step: fwd/bwd/optimizer (+ GSPMD collectives under a
+    # mesh) in ONE donated XLA program; params + optimizer state
+    # materialize via the step's compiled init so every persistent
+    # buffer gets its final, donation-friendly layout in one allocator
+    # pass (no host-staged arrays fragmenting the arena).
+    step = CompiledTrainStep(cfg, donate=knobs["donate"])
+    params, opt_state = step.init(jax.random.PRNGKey(0))
+    p_count = step.num_params(params)
 
     # Ingest through the framework: a Dataset of synthetic token batches
     # streamed via iter_jax_batches (HBM double-buffering path).
@@ -116,16 +194,6 @@ def bench_train_loop(config=None):
         0, cfg.vocab_size, size=(num_batches * batch, seqlen + 1)
     ).astype(np.int32)
     ds = rd.from_numpy(all_tokens, column="tokens")
-
-    def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: causal_lm_loss(p, tokens, cfg)
-        )(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    step = jax.jit(train_step, donate_argnums=(0, 1))
 
     it = ds.iter_jax_batches(batch_size=batch, drop_last=True)
     # Warmup/compile. A host read of the loss (not just block_until_ready)
@@ -163,29 +231,200 @@ def bench_train_loop(config=None):
         cfg.num_heads * cfg.dh
     )
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip(backend)
+    hbm = step.memory_snapshot()  # allocator probe: live/peak/frag
     rt_train.report({
         "tokens_per_sec": tokens_per_sec,
         "mfu": mfu,
         "backend": backend,
         "num_params": p_count,
         "steps": steps_done,
+        "config": {
+            "scan": int(knobs["scan"]),
+            "scan_chunk": knobs["scan_chunk"] if knobs["scan"] else 0,
+            "remat": knobs["remat"],
+            "donate": int(knobs["donate"]),
+            "layers": cfg.num_layers,
+            "batch": batch,
+            "flash": int(knobs["flash"]),
+            "loss_chunk": cfg.loss_chunk,
+        },
+        "hbm": hbm,
+        "compile": step.compile_stats(),
     })
 
 
-def main():
+def _fit_once(train_loop_config=None):
+    """One JaxTrainer gang (fresh worker process = fresh chip state)
+    running the bench loop; returns the Result."""
+    from ray_tpu.train import (
+        FailureConfig, JaxTrainer, RunConfig, ScalingConfig,
+    )
+
+    trainer = JaxTrainer(
+        bench_train_loop,
+        train_loop_config=train_loop_config,
+        scaling_config=ScalingConfig(num_workers=1, use_tpu=True),
+        run_config=RunConfig(
+            name="bench",
+            failure_config=FailureConfig(max_failures=0),
+        ),
+    )
+    return trainer.fit()
+
+
+def _load_ab_best():
+    """The machine-picked best config from a prior sweep, if recorded."""
+    path = os.environ.get("RAY_TPU_BENCH_AB_OUT", AB_OUT_DEFAULT)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        best = rec.get("best") or {}
+        if rec.get("backend") and best.get("config"):
+            return {"backend": rec["backend"], "config": best["config"]}
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def ab_rows():
+    """The sweep: scan × chunk × remat × donation × depth. Headline
+    contenders at bench depth first, then the full-depth viability
+    ladder (the deepest 8B-shaped stack that fits 16 GB HBM beside
+    adamw state — 32 true layers is ~64 GB and can never fit one v5e,
+    so depth itself is a swept dimension)."""
+    return [
+        {"label": "unrolled dots (r5 winner)",
+         "scan": 0, "remat": "dots", "layers": 4},
+        {"label": "chunked scan K=L (degenerate==unrolled)",
+         "scan": 1, "scan_chunk": 4, "remat": "dots", "layers": 4},
+        {"label": "chunked scan K=2",
+         "scan": 1, "scan_chunk": 2, "remat": "dots", "layers": 4},
+        {"label": "classic scan K=1 (r5 OOM row)",
+         "scan": 1, "scan_chunk": 1, "remat": "dots", "layers": 4},
+        {"label": "chunked scan K=2, donation OFF",
+         "scan": 1, "scan_chunk": 2, "remat": "dots", "layers": 4,
+         "donate": 0},
+        {"label": "depth 6, K=2, dots",
+         "scan": 1, "scan_chunk": 2, "remat": "dots", "layers": 6},
+        {"label": "depth 6, K=2, mlp",
+         "scan": 1, "scan_chunk": 2, "remat": "mlp", "layers": 6},
+        {"label": "depth 6, K=3, mlp",
+         "scan": 1, "scan_chunk": 3, "remat": "mlp", "layers": 6},
+        {"label": "depth 8, K=2, mlp",
+         "scan": 1, "scan_chunk": 2, "remat": "mlp", "layers": 8},
+        {"label": "depth 8, K=2, full",
+         "scan": 1, "scan_chunk": 2, "remat": "full", "layers": 8},
+        {"label": "depth 8, K=4, full",
+         "scan": 1, "scan_chunk": 4, "remat": "full", "layers": 8},
+        {"label": "depth 8, classic scan, full (control)",
+         "scan": 1, "scan_chunk": 1, "remat": "full", "layers": 8},
+    ]
+
+
+def _row_record(row, result):
+    rec = {"label": row.get("label", ""), "requested": row}
+    err = result.error
+    if err is not None:
+        msg = str(err)
+        rec["ok"] = False
+        rec["oom"] = ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                      or "out of memory" in msg)
+        rec["error"] = msg[-400:]
+        return rec
+    m = result.metrics
+    hbm = m.get("hbm") or {}
+    rec.update({
+        "ok": True,
+        "config": m.get("config", {}),
+        "tokens_per_sec": round(m["tokens_per_sec"], 2),
+        "mfu": round(m["mfu"], 4),
+        "num_params": m.get("num_params"),
+        "peak_hbm_gb": (round(hbm["peak_bytes_in_use"] / 2**30, 3)
+                        if "peak_bytes_in_use" in hbm else None),
+        "fragmentation_pct": (round(100 * hbm["fragmentation"], 1)
+                              if "fragmentation" in hbm else None),
+        "hbm": hbm,
+        "backend": m.get("backend"),
+    })
+    return rec
+
+
+def main_ab() -> None:
+    """A/B matrix mode: every row on a fresh gang, rows + machine-picked
+    winners written to BENCH_AB.json (and echoed as they land)."""
     import ray_tpu
-    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    rows = ab_rows()
+    limit = int(os.environ.get("RAY_TPU_BENCH_AB_ROWS", "0"))
+    if limit > 0:
+        rows = rows[:limit]
+    steps = os.environ.get("RAY_TPU_BENCH_AB_STEPS", "8")
+    out_path = os.environ.get("RAY_TPU_BENCH_AB_OUT", AB_OUT_DEFAULT)
+
+    ray_tpu.init(num_cpus=2, num_tpus=1,
+                 system_config={"log_to_driver": False})
+    records = []
+    try:
+        for i, row in enumerate(rows):
+            row = dict(row)
+            row.setdefault("steps", int(steps))
+            result = _fit_once({"row": row})
+            rec = _row_record(row, result)
+            records.append(rec)
+            print(f"[ab {i + 1}/{len(rows)}] {rec['label']}: "
+                  + (f"mfu={rec['mfu']} tok/s={rec['tokens_per_sec']} "
+                     f"peak={rec['peak_hbm_gb']}GB "
+                     f"frag={rec['fragmentation_pct']}%"
+                     if rec["ok"] else
+                     ("OOM" if rec.get("oom") else "ERROR")),
+                  file=sys.stderr)
+    finally:
+        ray_tpu.shutdown()
+
+    ok = [r for r in records if r["ok"]]
+    backend = ok[0]["backend"] if ok else None
+    best = max(ok, key=lambda r: r["mfu"], default=None)
+    # Deepest viable scan config (full-depth winner): most layers first,
+    # then MFU — the row that proves the scan path survives real depth.
+    scan_ok = [r for r in ok if r["config"].get("scan")]
+    best_full = max(
+        scan_ok, key=lambda r: (r["config"].get("layers", 0), r["mfu"]),
+        default=None,
+    )
+    record = {
+        "metric": "llama_train_ab_matrix",
+        "backend": backend,
+        "rows": records,
+        "best": best,
+        "best_full_depth": best_full,
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "llama_train_ab_matrix",
+        "rows": len(records),
+        "ok": len(ok),
+        "best_mfu": best["mfu"] if best else None,
+        "best_full_depth_layers": (best_full["config"].get("layers")
+                                   if best_full else None),
+        "best_full_depth_mfu": best_full["mfu"] if best_full else None,
+        "out": out_path,
+    }))
+
+
+def main():
+    if os.environ.get("RAY_TPU_BENCH_AB") == "1":
+        return main_ab()
+    import ray_tpu
 
     # The driver must not initialize jax (the worker owns the chip).
     ray_tpu.init(num_cpus=2, num_tpus=1,
                  system_config={"log_to_driver": False})
     try:
-        trainer = JaxTrainer(
-            bench_train_loop,
-            scaling_config=ScalingConfig(num_workers=1, use_tpu=True),
-            run_config=RunConfig(name="bench"),
-        )
-        result = trainer.fit()
+        ab_best = _load_ab_best()
+        cfg = {"ab_best": ab_best} if ab_best else None
+        result = _fit_once(cfg)
         if result.error is not None:
             raise result.error
         m = result.metrics

@@ -173,8 +173,12 @@ class Autoscaler:
                 # failed replacement and abort the roll.
                 try:
                     self.provider.terminate_node(new_id)
-                except Exception:
-                    pass
+                except Exception as e:
+                    sys.stderr.write(
+                        f"[autoscaler] terminate of failed replacement "
+                        f"{new_id} also failed ({e!r}); instance may be "
+                        f"leaked\n"
+                    )
                 cluster_events.emit(
                     cluster_events.WARNING, cluster_events.AUTOSCALER,
                     f"rolling restart aborted: replacement {new_id} for "
@@ -207,8 +211,12 @@ class Autoscaler:
                         # replacement and abort the roll.
                         try:
                             self.provider.terminate_node(new_id)
-                        except Exception:
-                            pass
+                        except Exception as te:
+                            sys.stderr.write(
+                                f"[autoscaler] terminate of spare "
+                                f"replacement {new_id} failed ({te!r}); "
+                                f"instance may be leaked\n"
+                            )
                         cluster_events.emit(
                             cluster_events.WARNING,
                             cluster_events.AUTOSCALER,
@@ -224,8 +232,11 @@ class Autoscaler:
                     )
             try:
                 self.provider.terminate_node(nid)
-            except Exception:
-                pass
+            except Exception as e:
+                sys.stderr.write(
+                    f"[autoscaler] terminate of drained node {nid} "
+                    f"failed ({e!r}); instance may be leaked\n"
+                )
             self._type_of.pop(nid, None)
             self._booting.pop(nid, None)
             self._idle_since.pop(nid, None)

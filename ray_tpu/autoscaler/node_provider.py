@@ -266,9 +266,14 @@ class GCPTpuNodeProvider(NodeProvider):
     def non_terminated_nodes(self) -> List[str]:
         try:
             resp = self._http.request("GET", f"{self._parent()}/nodes")
-        except Exception:
+        except Exception as e:
             # API blip: report the locally-tracked set rather than
-            # pretending every slice vanished (which would relaunch).
+            # pretending every slice vanished (which would relaunch) —
+            # but a provider API outage must be visible while it lasts.
+            sys.stderr.write(
+                f"[node_provider] WARNING: TPU API list failed "
+                f"({type(e).__name__}: {e}); serving cached node set\n"
+            )
             return list(self._nodes)
         now = time.monotonic()
         out = []
@@ -302,8 +307,11 @@ class GCPTpuNodeProvider(NodeProvider):
         for nid in list(self._nodes):
             try:
                 self.terminate_node(nid)
-            except Exception:
-                pass
+            except Exception as e:
+                sys.stderr.write(
+                    f"[node_provider] WARNING: terminate of {nid} at "
+                    f"shutdown failed ({e!r}); instance may be leaked\n"
+                )
 
 
 class _UrllibHttp:
@@ -363,7 +371,10 @@ def _gce_metadata_token() -> str:
         )
         with urllib.request.urlopen(req, timeout=2) as resp:
             return json.loads(resp.read()).get("access_token", "")
-    except Exception:
+    # Off-GCE (dev boxes, CI) the metadata server does not exist and an
+    # empty token is the designed answer; logging here would fire on
+    # every reconcile tick of every non-GCE run.
+    except Exception:  # rtlint: disable=swallowed-failure
         return ""
 
 

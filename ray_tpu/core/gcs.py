@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
@@ -329,7 +330,11 @@ class GcsService:
                 )
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as e:
+                sys.stderr.write(
+                    f"[gcs] WARNING: event aggregator poll failed "
+                    f"({type(e).__name__}: {e}); retrying\n"
+                )
                 await asyncio.sleep(1.0)
                 continue
             if reply.get("unknown"):
@@ -359,8 +364,12 @@ class GcsService:
                 CLUSTER_EVENTS,
                 make_event(severity, source, message, **fields),
             )
-        except Exception:
-            pass
+        except Exception as e:
+            # The event plane itself failing must not be invisible.
+            sys.stderr.write(
+                f"[gcs] WARNING: event publish failed "
+                f"({type(e).__name__}: {e}); dropped {source} event\n"
+            )
 
     async def _broadcast_loop(self):
         while True:
@@ -407,8 +416,12 @@ class GcsService:
 
         try:
             self._loop.run_in_executor(None, write)
-        except Exception:
+        except Exception as e:
             self._snapshot_inflight = False
+            sys.stderr.write(
+                f"[gcs] WARNING: could not schedule snapshot write "
+                f"({type(e).__name__}: {e})\n"
+            )
 
     def _snapshot_final(self):
         """Synchronous last snapshot on shutdown (stop() runs off the
@@ -440,8 +453,13 @@ class GcsService:
             with open(tmp, "wb") as f:
                 pickle.dump(snap, f)
             os.replace(tmp, self._storage_path)
-        except Exception:
-            pass
+        except Exception as e:
+            # A silently failing snapshot means a head restart loses the
+            # KV/actor tables with no warning beforehand.
+            sys.stderr.write(
+                f"[gcs] WARNING: snapshot persist to "
+                f"{self._storage_path} failed ({type(e).__name__}: {e})\n"
+            )
 
     def _restore_snapshot(self):
         """Reload durable tables after a head restart (ref:
@@ -457,7 +475,12 @@ class GcsService:
                 snap = pickle.load(f)
         except FileNotFoundError:
             return
-        except Exception:
+        except Exception as e:
+            sys.stderr.write(
+                f"[gcs] WARNING: snapshot restore from "
+                f"{self._storage_path} failed ({type(e).__name__}: {e}); "
+                f"starting with empty durable tables\n"
+            )
             return
         self._kv.update(snap.get("kv", {}))
         self._functions.update(snap.get("functions", {}))
@@ -515,7 +538,10 @@ class GcsService:
                          "error": "bad or missing session token (set "
                                   "RAY_TPU_SESSION_TOKEN on every node)"}
                     )
-                except Exception:
+                # Courtesy reply to a client we are rejecting anyway; it
+                # hanging up first changes nothing (the refusal is
+                # already printed above).
+                except Exception:  # rtlint: disable=swallowed-failure
                     pass
                 framed.close()
                 return
@@ -563,15 +589,24 @@ class GcsService:
     async def _dispatch_and_reply(self, node_id, msg, framed):
         try:
             reply = await self._dispatch(node_id, msg)
-        except Exception as e:
+        # Surfaced to the caller: handler exceptions travel back in the
+        # reply's error field and raise RuntimeError at the call site.
+        except Exception as e:  # rtlint: disable=swallowed-failure
             reply = {"error": str(e)}
         if reply is not None:
             reply["type"] = "reply"
             reply["msg_id"] = msg.get("msg_id")
             try:
                 await framed.send(reply)
-            except Exception:
-                pass
+            except Exception as e:
+                # Lost reply to a live caller = silent client timeout;
+                # make the drop visible (dead conns are reaped by the
+                # reader loop right after).
+                sys.stderr.write(
+                    f"[gcs] WARNING: reply send to node "
+                    f"{node_id.hex()[:8]} failed "
+                    f"({type(e).__name__}: {e})\n"
+                )
 
     async def _dispatch(
         self, node_id: NodeID, msg: Dict[str, Any]
@@ -603,7 +638,8 @@ class GcsService:
 
         try:
             nid = NodeID.from_hex(node_id)
-        except Exception:
+        # Reported, not raised: the refusal travels in the RPC reply.
+        except Exception:  # rtlint: disable=swallowed-failure
             return {"ok": False, "error": f"bad node id {node_id!r}"}
         entry = self._nodes.get(nid)
         if entry is None or entry.state == "dead":
@@ -648,6 +684,7 @@ class GcsService:
                     {"type": "drain", "timeout": timeout},
                     timeout=timeout + 15.0,
                 )
+            # rtlint: disable=swallowed-failure — reported in the reply
             except Exception as e:  # noqa: BLE001 — reported, not raised
                 if phase == "full":
                     # One-shot callers have no begin/finish/abort
@@ -902,6 +939,7 @@ class GcsService:
                     errors[hex_id] = str(reply["error"])
                     return None
                 return reply.get("result")
+            # rtlint: disable=swallowed-failure — recorded in `errors`
             except Exception as e:  # noqa: BLE001 — partial > hang
                 errors[hex_id] = str(e) or type(e).__name__
                 return None
@@ -970,7 +1008,13 @@ class GcsService:
                         ok = False
                         break
                     prepared.append(idx)
-                except Exception:
+                except Exception as e:
+                    self._record_event(
+                        "WARNING", "GCS",
+                        f"placement group {pg_id[:8]} bundle {idx} "
+                        f"prepare failed on node {node_hex[:8]} "
+                        f"({type(e).__name__}: {e}); re-placing",
+                    )
                     ok = False
                     break
             # Removed (or node lost) while the prepares were in flight?
@@ -985,8 +1029,14 @@ class GcsService:
                     await peer.notify(
                         {"type": "commit_bundle", "pg_id": pg_id, "index": idx}
                     )
-                except Exception:
-                    pass
+                except Exception as e:
+                    self._record_event(
+                        "WARNING", "GCS",
+                        f"placement group {pg_id[:8]} bundle {idx} "
+                        f"commit notify to node {node_hex[:8]} failed "
+                        f"({type(e).__name__}: {e}); node-death "
+                        f"re-placement will recover it",
+                    )
             if self._pgs.get(pg_id, {}).get("state") != "pending":
                 await self._release_prepared(pg_id, chosen, prepared)
                 return
@@ -1003,7 +1053,10 @@ class GcsService:
                 await peer.notify(
                     {"type": "release_bundle", "pg_id": pg_id, "index": idx}
                 )
-            except Exception:
+            # Best-effort release toward a node that likely just died
+            # (that is why we are rolling back); its reservations die
+            # with it, and a live node re-syncs on the next placement.
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
 
     async def pg_wait(self, pg_id: str, timeout: float) -> bool:
@@ -1035,7 +1088,10 @@ class GcsService:
                 await peer.notify(
                     {"type": "release_bundle", "pg_id": pg_id, "index": idx}
                 )
-            except Exception:
+            # Best-effort: the PG is already marked removed; a node that
+            # missed the release reclaims the bundle when it next syncs
+            # (or is dead and needs no release at all).
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
 
     def pg_get(self, pg_id: str) -> Dict[str, Any]:
@@ -1253,7 +1309,10 @@ class GcsService:
                 continue
             try:
                 await conn.send(msg)
-            except Exception:
+            # Broadcasts are idempotent state pushes re-sent every
+            # heartbeat interval; a dead conn is detected and reaped by
+            # its reader loop, which also fires the node-death path.
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
 
     # -------------------------------------------------------------------- kv
@@ -1443,7 +1502,9 @@ class GcsClient:
             return
         try:
             await self._writer.send(msg)
-        except Exception:
+        # Surfaced through the closed flag: the next request() fails
+        # fast and the owner's reconnect path (jittered backoff) logs.
+        except Exception:  # rtlint: disable=swallowed-failure
             self.closed = True
 
     def close(self):

@@ -74,6 +74,16 @@ class LlamaConfig:
     # allocations behind the allocator fragmentation that OOMs the
     # selective-remat policies.
     scan_layers: bool = True
+    # Layers per scan step (the full-depth schedule). 0/1 scans one layer
+    # at a time (the classic stacked-scan path). K>1 scans over L/K
+    # chunks of K layers, unrolled inside the chunk body with ONE
+    # jax.checkpoint (remat_policy) around the chunk: the scan's stacked
+    # residual buffers shrink from [L, ...] to [L/K, ...] — the
+    # allocation that drove the 43-46% allocator fragmentation OOMs on
+    # selective-remat policies at real depth — while the per-chunk
+    # unroll keeps the remat policy's save-set (dots/mlp outputs) local
+    # to one chunk. K must divide num_layers.
+    scan_chunk: int = 0
 
     @property
     def dh(self) -> int:
@@ -259,6 +269,37 @@ def forward(
     return logits.astype(jnp.float32), aux
 
 
+def remat_policy(cfg: LlamaConfig):
+    """The jax.checkpoint policy selected by cfg.remat_policy."""
+    if cfg.remat_policy == "dots":
+        # Save ALL matmul outputs — least recompute, largest
+        # footprint (OOMs the 8B-shaped bench: ~10 G HLO temp).
+        return jax.checkpoint_policies.checkpoint_dots
+    if cfg.remat_policy == "mlp":
+        # Selective (scaling-playbook style): save only the two
+        # widest matmuls' outputs (up/gate, ~45% of forward
+        # FLOPs) and recompute the rest — the best
+        # recompute-per-byte trade on one chip.
+        return jax.checkpoint_policies.save_only_these_names(
+            "mlp_up", "mlp_gate"
+        )
+    if cfg.remat_policy == "save_all":
+        return jax.checkpoint_policies.everything_saveable
+    return None
+
+
+def scan_chunks(cfg: LlamaConfig) -> Tuple[int, int]:
+    """(layers_per_chunk, num_chunks) for the scan schedule. Validates
+    that scan_chunk divides num_layers — a ragged final chunk would need
+    its own compiled body, defeating the scan's O(1)-in-depth compile."""
+    K = max(1, cfg.scan_chunk or 1)
+    if cfg.num_layers % K:
+        raise ValueError(
+            f"scan_chunk={K} must divide num_layers={cfg.num_layers}"
+        )
+    return K, cfg.num_layers // K
+
+
 def hidden_forward(
     params: Dict[str, Any],
     tokens: jax.Array,  # [B, S] int32
@@ -271,37 +312,57 @@ def hidden_forward(
     x = params["embed"][tokens].astype(cfg.dtype)
     x = with_logical_constraint(x, ("batch", "seq", "embed"), mesh=mesh)
     positions = jnp.arange(S)
+    policy = remat_policy(cfg)
 
     def body(x, lp):
-        fn = _layer
         if cfg.remat:
-            policy = None
-            if cfg.remat_policy == "dots":
-                # Save ALL matmul outputs — least recompute, largest
-                # footprint (OOMs the 8B-shaped bench: ~10 G HLO temp).
-                policy = jax.checkpoint_policies.checkpoint_dots
-            elif cfg.remat_policy == "mlp":
-                # Selective (scaling-playbook style): save only the two
-                # widest matmuls' outputs (up/gate, ~45% of forward
-                # FLOPs) and recompute the rest — the best
-                # recompute-per-byte trade on one chip.
-                policy = jax.checkpoint_policies.save_only_these_names(
-                    "mlp_up", "mlp_gate"
-                )
-            elif cfg.remat_policy == "save_all":
-                policy = jax.checkpoint_policies.everything_saveable
             fn = jax.checkpoint(
                 lambda x_, lp_: _layer(cfg, mesh, positions, x_, lp_),
                 policy=policy,
             )
             out, aux = fn(x, lp)
         else:
-            out, aux = fn(cfg, mesh, positions, x, lp)
+            out, aux = _layer(cfg, mesh, positions, x, lp)
         out = with_logical_constraint(out, ("batch", "seq", "embed"), mesh=mesh)
         return out, aux
 
     if cfg.scan_layers:
-        x, aux = jax.lax.scan(body, x, params["layers"])
+        K, n_chunks = scan_chunks(cfg)
+        if K == 1:
+            x, aux = jax.lax.scan(body, x, params["layers"])
+        else:
+            # Layer-chunked schedule: scan over [L/K, ...] stacks of
+            # K-layer chunks. ONE checkpoint per chunk (the policy's
+            # save-set covers the whole unrolled chunk body), and the
+            # carry re-annotated each step so GSPMD keeps the scan body's
+            # layout resident instead of resharding per iteration.
+            chunked = jax.tree.map(
+                lambda p: p.reshape((n_chunks, K) + p.shape[1:]),
+                params["layers"],
+            )
+
+            def chunk_fn(x_, cp):
+                aux = jnp.zeros((), dtype=jnp.float32)
+                for k in range(K):
+                    lp = jax.tree.map(lambda p: p[k], cp)
+                    x_, a = _layer(cfg, mesh, positions, x_, lp)
+                    aux = aux + a
+                return x_, aux
+
+            if cfg.remat:
+                chunk_fn = jax.checkpoint(chunk_fn, policy=policy)
+
+            def chunk_body(x_, cp):
+                x_ = with_logical_constraint(
+                    x_, ("batch", "seq", "embed"), mesh=mesh
+                )
+                out, aux = chunk_fn(x_, cp)
+                out = with_logical_constraint(
+                    out, ("batch", "seq", "embed"), mesh=mesh
+                )
+                return out, aux
+
+            x, aux = jax.lax.scan(chunk_body, x, chunked)
         aux = aux.sum()
     else:
         aux = jnp.zeros((), jnp.float32)

@@ -17,10 +17,12 @@ from .mesh import (  # noqa: F401
 )
 from .sharding import (  # noqa: F401
     DEFAULT_RULES,
+    constrain_pytree,
     logical_to_spec,
     named_sharding,
     prune_spec,
     shard_pytree,
+    tree_shardings,
     with_logical_constraint,
 )
 from .collectives import (  # noqa: F401

@@ -121,6 +121,37 @@ def _current_mesh() -> Optional[Mesh]:
         return None
 
 
+def is_logical_axes(x) -> bool:
+    """True for a leaf of a logical-axes pytree: a tuple of axis names
+    (str) and Nones — e.g. ("layers", "embed", "heads", "head_dim")."""
+    return isinstance(x, tuple) and all(
+        e is None or isinstance(e, str) for e in x
+    )
+
+
+def tree_shardings(mesh: Mesh, logical_axes_tree, rules=DEFAULT_RULES):
+    """Map a pytree of logical-axis tuples to a matching pytree of
+    NamedShardings (the in/out_shardings argument shape pjit wants).
+    Tuples of axis names are leaves here, not nested pytrees."""
+    return jax.tree.map(
+        lambda axes: named_sharding(mesh, axes, rules),
+        logical_axes_tree,
+        is_leaf=is_logical_axes,
+    )
+
+
+def constrain_pytree(tree, mesh: Mesh, logical_axes_tree,
+                     rules=DEFAULT_RULES):
+    """with_sharding_constraint over a whole pytree of traced values —
+    the in-graph counterpart of :func:`shard_pytree` (used to pin params
+    and optimizer state inside a compiled init so every buffer
+    materializes with its final layout)."""
+    shardings = tree_shardings(mesh, logical_axes_tree, rules)
+    return jax.tree.map(
+        jax.lax.with_sharding_constraint, tree, shardings
+    )
+
+
 def shard_pytree(tree, mesh: Mesh, logical_axes_tree, rules=DEFAULT_RULES):
     """Device-put a pytree of host arrays onto the mesh according to a
     matching pytree of logical-axis tuples."""

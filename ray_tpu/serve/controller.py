@@ -113,8 +113,14 @@ class ServeControllerActor:
 
         try:
             ray_tpu.kill(handle)
-        except Exception:
-            pass
+        except Exception as e:
+            # A replica we failed to kill may keep serving a retired
+            # version (or leak a worker) — say so instead of hiding it.
+            cluster_events.emit(
+                cluster_events.WARNING, cluster_events.SERVE,
+                f"replica kill failed ({type(e).__name__}: {e}); the "
+                f"worker may be leaked",
+            )
 
     def _bump_route(self, st: _DeploymentState) -> None:
         st.route_version += 1
@@ -508,8 +514,11 @@ class ServeControllerActor:
                 ray_tpu.get(ref, timeout=HEALTH_CHECK_TIMEOUT_S)
             except (ActorDiedError, WorkerCrashedError):
                 dead.append(r)
-            except Exception:
-                pass  # slow/busy is not dead
+            # Health-probe timeout on a live actor: slow/busy is not
+            # dead, and eviction on slowness is the breaker's job
+            # (serve_breaker_*), not the health checker's.
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass
         if not dead:
             return
         cluster_events.emit(

@@ -31,5 +31,17 @@ from .session import (  # noqa: F401
 from .trainer import JaxTrainer, TrainWorkerGroupError  # noqa: F401
 from .torch import TorchTrainer  # noqa: F401
 
+
+def __getattr__(name):
+    # CompiledTrainStep lives behind a lazy hook: compiled_step.py
+    # imports jax/optax at module scope, and `import ray_tpu.train` must
+    # stay backend-free (session plumbing runs in every train worker,
+    # including cpu-only ones that never touch the accelerator).
+    if name == "CompiledTrainStep":
+        from .compiled_step import CompiledTrainStep
+
+        return CompiledTrainStep
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 from ray_tpu.util import usage_stats as _usage
 _usage.record_library_usage("train")

@@ -99,17 +99,31 @@ def _train_worker_entry(
                     {"rank": rank, "error": repr(e)}
                 ),
             )
-        except Exception:
-            pass
+        except Exception as kv_err:
+            # The task error itself re-raises below; what is lost here
+            # is only the PROMPT surfacing through the KV error key —
+            # the driver then learns of the failure at join time. Note
+            # the delay on the worker's stderr (shipped to worker logs).
+            import sys
+
+            print(
+                f"[ray_tpu.train] WARNING: rank {rank} could not "
+                f"publish its error key ({kv_err!r}); failure will "
+                f"surface at gang join instead",
+                file=sys.stderr,
+            )
         raise
     finally:
         set_session(None)
         if torch_group:
             import torch.distributed as dist
 
+            # Teardown of a rendezvous that may already be half-dead
+            # (peer ranks crashed): the run's outcome is decided by now;
+            # a destroy failure changes nothing for the caller.
             try:
                 dist.destroy_process_group()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
     return "done"
 

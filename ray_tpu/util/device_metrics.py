@@ -45,6 +45,14 @@ MEMORY_LIMIT = Gauge(
     "Device (HBM) capacity visible to the allocator, per device.",
     tag_keys=("node", "device"),
 )
+MEMORY_FRAGMENTATION = Gauge(
+    "ray_tpu_device_memory_fragmentation_ratio",
+    "Allocator fragmentation per device: reserved-but-not-live fraction "
+    "of the arena (1 - live/reserved at peak). High values mean the "
+    "allocator holds far more HBM than live buffers need — the failure "
+    "mode that OOMs deep scan schedules.",
+    tag_keys=("node", "device"),
+)
 JIT_COMPILES = Counter(
     "ray_tpu_device_jit_compiles_total",
     "XLA compilations observed through instrumented_jit().",
@@ -93,6 +101,66 @@ def _memory_stats(device) -> Optional[Dict[str, Any]]:
     return stats if isinstance(stats, dict) else None
 
 
+def fragmentation_from_stats(stats: Dict[str, Any]) -> Optional[float]:
+    """Allocator fragmentation ratio from a PJRT ``memory_stats()`` dict,
+    or None when the backend exposes too little. Preference order:
+
+    1. ``peak_bytes_in_use`` vs ``peak_bytes_reserved`` — the reserved
+       arena the allocator grew to versus the live bytes it actually
+       held at peak (the "43-46% fragmentation" number in XLA's own OOM
+       diagnostics).
+    2. ``bytes_in_use`` vs ``bytes_reserved`` — the instantaneous pair.
+    3. ``largest_free_block_bytes`` vs free bytes under ``bytes_limit``
+       — how shattered the remaining arena is.
+    """
+    peak_live = stats.get("peak_bytes_in_use")
+    peak_reserved = stats.get("peak_bytes_reserved")
+    if peak_reserved and peak_live is not None and peak_reserved > 0:
+        return max(0.0, 1.0 - float(peak_live) / float(peak_reserved))
+    live = stats.get("bytes_in_use")
+    reserved = stats.get("bytes_reserved")
+    if reserved and live is not None and reserved > 0:
+        return max(0.0, 1.0 - float(live) / float(reserved))
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    largest_free = stats.get("largest_free_block_bytes")
+    if limit and live is not None and largest_free is not None:
+        free = float(limit) - float(live)
+        if free > 0:
+            return max(0.0, 1.0 - float(largest_free) / free)
+    return None
+
+
+def hbm_snapshot(device=None) -> Dict[str, Any]:
+    """One device's allocator state as a plain dict — the bench's
+    fragmentation probe (recorded into BENCH ab_matrix rows) and the
+    payload behind the fragmentation gauge. Empty dict when the backend
+    exposes no memory_stats (CPU)."""
+    if device is None:
+        try:
+            import jax
+
+            device = jax.local_devices()[0]
+        except Exception:
+            return {}
+    stats = _memory_stats(device)
+    if not stats:
+        return {}
+    out: Dict[str, Any] = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_reserved",
+                "peak_bytes_reserved", "bytes_limit",
+                "bytes_reservable_limit", "largest_free_block_bytes",
+                "largest_alloc_size", "num_allocs"):
+        if key in stats:
+            try:
+                out[key] = int(stats[key])
+            except (TypeError, ValueError):
+                pass
+    frag = fragmentation_from_stats(stats)
+    if frag is not None:
+        out["fragmentation"] = round(frag, 4)
+    return out
+
+
 def sample(force: bool = False) -> List[Dict[str, Any]]:
     """Publish per-device gauges for this process and return the device
     snapshot (also the payload of the dashboard's ``/api/devices``).
@@ -131,6 +199,10 @@ def sample(force: bool = False) -> List[Dict[str, Any]]:
             if limit is not None:
                 MEMORY_LIMIT.set(float(limit), tags=tags)
                 info["bytes_limit"] = int(limit)
+            frag = fragmentation_from_stats(stats)
+            if frag is not None:
+                MEMORY_FRAGMENTATION.set(frag, tags=tags)
+                info["fragmentation"] = round(frag, 4)
         out.append(info)
     for platform, n in by_platform.items():
         DEVICE_COUNT.set(float(n), tags={"node": node,
@@ -162,13 +234,21 @@ def record_collective(op: str, nbytes: Optional[int] = None) -> None:
         COLLECTIVE_BYTES.inc(float(nbytes), tags=tags)
 
 
-def instrumented_jit(fn, **jit_kwargs):
+def instrumented_jit(fn, *, sample_memory: bool = False, **jit_kwargs):
     """``jax.jit`` with compile telemetry: calls that grow the jitted
     function's executable cache (a trace+compile happened) bump the
     compile counter and attribute the call's wall time to cumulative
     compile seconds. This is the runtime-controlled compile path — the
     serving stack jits through here so recompiles (new batch shape, new
     model) are visible in ``/metrics`` instead of silent latency spikes.
+
+    ``sample_memory=True`` additionally publishes the per-device HBM
+    gauges (in-use / peak / limit / fragmentation) right after every
+    compile and, throttled through :func:`maybe_sample`, on steady-state
+    calls — the train-step wiring, so ``rtpu metrics`` shows train
+    compile cache behavior AND the step's device footprint. It defaults
+    off: the decode hot loop calls this wrapper once per generated token
+    and must not pay a lock per call (the 695→652 tok/s regression).
 
     The wrapper sits INSIDE decode hot loops (one call per generated
     token), so the steady-state tap is kept minimal: metric handles and
@@ -189,10 +269,19 @@ def instrumented_jit(fn, **jit_kwargs):
 
     if cache_size is None:
         # No cache introspection on this jax version: passthrough, zero
-        # per-call overhead.
-        wrapped = functools.wraps(fn)(
-            lambda *args, **kwargs: jitted(*args, **kwargs)
-        )
+        # per-call overhead (memory still sampled on the throttled path
+        # when requested — train steps are seconds-long, the lock is
+        # noise there).
+        if sample_memory:
+            @functools.wraps(fn)
+            def wrapped(*args, **kwargs):
+                out = jitted(*args, **kwargs)
+                maybe_sample()
+                return out
+        else:
+            wrapped = functools.wraps(fn)(
+                lambda *args, **kwargs: jitted(*args, **kwargs)
+            )
         wrapped.__wrapped_jit__ = jitted
         return wrapped
 
@@ -227,6 +316,15 @@ def instrumented_jit(fn, **jit_kwargs):
                 state[2] = JIT_COMPILE_SECONDS.with_tags(**tags)
             state[1].inc(after - before)
             state[2].inc(time.perf_counter() - t0)
+            if sample_memory:
+                # Fresh executable: its arena reservation is the
+                # interesting datapoint — publish unconditionally.
+                try:
+                    sample(force=True)
+                except Exception:
+                    pass
+        elif sample_memory:
+            maybe_sample()
         return out
 
     wrapped.__wrapped_jit__ = jitted  # AOT API (lower/compile) passthrough

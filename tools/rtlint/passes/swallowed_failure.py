@@ -26,6 +26,9 @@ CONTROL_PLANE_MODULES = (
     "ray_tpu/serve/controller.py",
     "ray_tpu/autoscaler/autoscaler.py",
     "ray_tpu/autoscaler/node_provider.py",
+    # Train control plane: gang orchestration failures steer a whole
+    # training run (restart-from-checkpoint, rendezvous teardown).
+    "ray_tpu/train/trainer.py",
 )
 
 _BROAD = {"Exception", "BaseException"}
@@ -34,6 +37,10 @@ _BROAD = {"Exception", "BaseException"}
 _LOG_METHODS = {"warning", "error", "exception", "critical", "fatal"}
 _METRIC_METHODS = {"inc", "observe", "set"}
 _EVENT_ALIASES = {"events", "cluster_events", "_events"}
+# GCS-internal emission path: GcsService._record_event publishes a
+# make_event onto the cluster-events channel (the head IS the
+# aggregator — it cannot ride util/events' flush-to-head loop).
+_EVENT_METHODS = {"_record_event", "record_event"}
 
 
 def _is_broad(handler: ast.ExceptHandler) -> bool:
@@ -83,6 +90,8 @@ def _surfaces_failure(handler: ast.ExceptHandler) -> Optional[str]:
             base = fn.value
             if fn.attr == "emit" and isinstance(base, ast.Name) and \
                     base.id in _EVENT_ALIASES:
+                return "event"
+            if fn.attr in _EVENT_METHODS:
                 return "event"
             if fn.attr in _LOG_METHODS:
                 return "log"
