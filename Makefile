@@ -13,7 +13,7 @@ EXT       := ray_tpu/_native/_rtstore.so
 PUMP_SRC  := src/pump/rts_pump.cc
 PUMP_EXT  := ray_tpu/_native/_rtpump.so
 
-.PHONY: native native-test native-ubsan cpp-client clean check check-obs check-metrics rtlint perf-transfer perf-actor perf-native perf-train train-smoke chaos overload
+.PHONY: native native-test native-ubsan cpp-client clean check check-slow check-obs check-metrics rtlint perf-transfer perf-actor perf-native perf-train train-smoke train-chaos chaos overload
 
 # Static analysis: the rtlint distributed-invariant analyzer (pass
 # catalog: python -m tools.rtlint --list). Exits non-zero on any
@@ -51,6 +51,24 @@ perf-train:
 # (rtlint already includes the obs pass group, so check-obs is not
 # repeated.)
 check: rtlint native-test train-smoke
+
+# Slow tier of `make check`: the multi-minute acceptance suites — the
+# chaos partition matrix, the overload closed loop, and the elastic
+# train-gang chaos run (gang restart + checkpoint fallback + rolling
+# restart under an active fit -> MULTICHIP_r06.json).
+check-slow: check chaos overload train-chaos
+
+# Elastic gang lifecycle acceptance: multi-process jax.distributed
+# rendezvous (2 procs x 4 virtual devices, GCS-KV-brokered
+# coordinator), rank killed mid-step -> restart from the last COMMITTED
+# checkpoint (trajectory must match an uninterrupted run), a
+# checkpoint_io fault during save -> fall back to the previous commit,
+# and Cluster.rolling_restart() under an active fit (<= 1 step lost).
+# Records MULTICHIP_r06.json.
+train-chaos:
+	JAX_PLATFORMS=cpu $(PY) tools/run_train_chaos.py MULTICHIP_r06.json
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_train_elastic.py -q \
+	  -p no:cacheprovider
 
 # Chaos plane acceptance suite: the full fault-injection partition
 # matrix (every registered point proves its advertised degradation path

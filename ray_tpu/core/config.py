@@ -205,6 +205,15 @@ class Config:
     # object copies must replicate off-node inside this window; past it
     # the node exits anyway and lineage re-execution covers the rest.
     drain_timeout_s: float = 60.0
+    # --- elastic train gang lifecycle (train/trainer.py supervisor) ------
+    # A rank whose GCS-KV heartbeat is older than this is declared
+    # dead/hung and the supervisor aborts the WHOLE gang promptly
+    # (surviving ranks stuck in a collective are killed rather than
+    # waiting out the collective timeout), then restarts from the last
+    # committed checkpoint bounded by FailureConfig.max_failures.
+    train_rank_timeout_s: float = 30.0
+    # How often each rank publishes its heartbeat + step counter.
+    train_heartbeat_interval_s: float = 2.0
     # --- serve overload control (ref analogue: serve's request_timeout_s
     # + proxy queue-length admission; AIMD/breaker/retry-budget patterns
     # per util/overload.py) ------------------------------------------------

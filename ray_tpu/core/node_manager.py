@@ -606,8 +606,11 @@ class NodeManager:
             await self._gcs.psub_publish(
                 cluster_events.CLUSTER_EVENTS, batch
             )
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(
+                f"[ray_tpu] node {self.node_id.hex()[:8]}: cluster-event "
+                f"publish failed ({e!r}); {len(batch)} event(s) dropped\n"
+            )
 
     async def _connect_gcs(self):
         """Dial the GCS and register this node (first boot AND after a
@@ -658,7 +661,9 @@ class NodeManager:
         while not wait.expired and not self._shutdown:
             try:
                 await self._connect_gcs()
-            except Exception:
+            # The retry loop IS the handler (jittered backoff, deadline
+            # bounded); final expiry is reported after the loop.
+            except Exception:  # rtlint: disable=swallowed-failure
                 if not await wait.async_sleep():
                     break
                 continue
@@ -684,8 +689,13 @@ class NodeManager:
                     await self._gcs.register_named_actor(
                         spec.name, spec.actor_id, self.node_id, spec
                     )
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(
+                    f"[ray_tpu] node {self.node_id.hex()[:8]}: actor "
+                    f"{spec.actor_id.hex()[:8]} re-registration after "
+                    f"reconnect failed ({e!r}); named lookups may miss "
+                    f"it until the next reconnect\n"
+                )
         await self._publish_all_sealed()
 
     # ------------------------------------------------------- cluster plumbing
@@ -743,7 +753,9 @@ class NodeManager:
         for rec, _missing in self._waiting.values():
             try:
                 shape = rec.spec.resources.to_dict()
-            except Exception:
+            # A malformed shape only drops one row from the autoscaler
+            # demand report; the task itself is untouched.
+            except Exception:  # rtlint: disable=swallowed-failure
                 continue
             key = tuple(sorted(shape.items()))
             if key not in counts and len(counts) >= cap:
@@ -760,7 +772,8 @@ class NodeManager:
             for rec in w.pending:
                 try:
                     shape = rec.spec.resources.to_dict()
-                except Exception:
+                # Same contract as the waiting-queue rows above.
+                except Exception:  # rtlint: disable=swallowed-failure
                     continue
                 key = tuple(sorted(shape.items()))
                 if key not in counts and len(counts) >= cap:
@@ -778,13 +791,22 @@ class NodeManager:
         self._schedule()
 
     async def _publish_all_sealed(self):
+        failed = 0
         for oid in list(self._sealed):
             loc = self.directory.lookup(oid)
             if loc is not None and not isinstance(loc, RemoteLocation):
                 try:
                     await self._gcs.publish_object(oid, self.node_id)
-                except Exception:
-                    pass
+                # Aggregated into ONE stderr warning below the loop.
+                except Exception:  # rtlint: disable=swallowed-failure
+                    failed += 1
+        if failed:
+            sys.stderr.write(
+                f"[ray_tpu] node {self.node_id.hex()[:8]}: {failed} "
+                f"sealed object(s) failed to re-publish after reconnect; "
+                f"remote consumers may need the next reconnect to "
+                f"locate them\n"
+            )
 
     def _on_gcs_node_dead(self, entry):
         asyncio.ensure_future(
@@ -800,9 +822,14 @@ class NodeManager:
         """A node began draining: keep it REACHABLE (in-flight actor
         traffic and the drain RPC itself still flow) but unschedulable —
         pick_node/place_bundles skip non-alive views, so marking the
-        view is enough to stop new forwards/creations landing there."""
+        view is enough to stop new forwards/creations landing there.
+        When the draining node is THIS one, local workers are told too
+        (``node_draining`` frames → core/preemption.py), so cooperative
+        tenants — above all a train gang — checkpoint at their next
+        step boundary and surrender the node instead of dying with it."""
         if node_hex == self.node_id.hex():
             self._draining = True
+            asyncio.ensure_future(self._broadcast_drain_to_workers(True))
             return
         view = self._cluster_view.get(node_hex)
         if view is not None:
@@ -817,10 +844,26 @@ class NodeManager:
         """A drain was aborted: the node rejoins the schedulable pool."""
         if node_hex == self.node_id.hex():
             self._draining = False
+            asyncio.ensure_future(self._broadcast_drain_to_workers(False))
             return
         view = self._cluster_view.get(node_hex)
         if view is not None and view.get("state") == "draining":
             view["state"] = "alive"
+
+    async def _broadcast_drain_to_workers(self, draining: bool):
+        """Forward this node's drain state to every local worker
+        process (the worker-side signal behind TrainSession.preemption)."""
+        frame = {
+            "type": "node_draining" if draining else "node_undrain",
+            "node_id": self.node_id.hex(),
+        }
+        for w in list(self._workers.values()):
+            if w.state == "dead" or w.worker_type == "client":
+                continue
+            try:
+                await w.writer.send(dict(frame))
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass  # dying worker; the drain proceeds regardless
 
     def _on_gcs_chaos_update(self, specs, gen):
         """Head-side hook: the GCS applied the plan in this process
@@ -839,7 +882,9 @@ class NodeManager:
                 continue
             try:
                 await w.writer.send(dict(frame))
-            except Exception:
+            # Dying worker: it re-adopts the current plan in its next
+            # registration reply; nothing to do here.
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
 
     def _on_gcs_load_update(self, msg):
@@ -981,8 +1026,14 @@ class NodeManager:
             # round-trip never stalls this loop.
             try:
                 await self._check_hung_tasks()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                if not getattr(self, "_hang_sweep_warned", False):
+                    self._hang_sweep_warned = True
+                    sys.stderr.write(
+                        f"[ray_tpu] node {self.node_id.hex()[:8]}: "
+                        f"hang-diagnosis sweep failed ({e!r}); further "
+                        f"failures suppressed\n"
+                    )
 
     def _call(self, coro):
         """Run a coroutine on the loop from a foreign thread."""
@@ -1326,7 +1377,8 @@ class NodeManager:
                 )
                 w.client_writers[msg["object_id"]] = writer
                 reply = {"ok": True}
-            except Exception as e:  # noqa: BLE001
+            # Reply-carried: the client sees and raises the error.
+            except Exception as e:  # rtlint: disable=swallowed-failure
                 reply = {"ok": False, "error": str(e)}
             reply.update({"type": "reply", "msg_id": msg["msg_id"]})
             await w.writer.send(reply)
@@ -1339,7 +1391,8 @@ class NodeManager:
                     None, writer.write, int(msg["offset"]), msg["data"]
                 )
                 reply = {"ok": True}
-            except Exception as e:  # noqa: BLE001
+            # Reply-carried: the client sees and raises the error.
+            except Exception as e:  # rtlint: disable=swallowed-failure
                 reply = {"ok": False, "error": str(e)}
             reply.update({"type": "reply", "msg_id": msg["msg_id"]})
             await w.writer.send(reply)
@@ -1367,7 +1420,8 @@ class NodeManager:
                 finalized = True
                 await self.put_object(msg["object_id"], loc, refs=0)
                 reply = {"loc": loc}
-            except Exception as e:  # noqa: BLE001
+            # Reply-carried: the client sees and raises the error.
+            except Exception as e:  # rtlint: disable=swallowed-failure
                 # The writer left client_writers above, so nothing else
                 # can ever free its block — abort it here (only when
                 # finalize itself failed: after a successful seal, abort
@@ -1539,7 +1593,8 @@ class NodeManager:
         ship the reply when it completes."""
         try:
             reply = await self._dispatch_peer(peer_hex, msg)
-        except Exception as e:  # noqa: BLE001
+        # Reply-carried: the requesting peer sees and handles the error.
+        except Exception as e:  # rtlint: disable=swallowed-failure
             reply = {"error": str(e)}
         if reply is None:
             return
@@ -2084,6 +2139,10 @@ class NodeManager:
         reconstructing; (3) ack, flush events, and fire
         ``on_drain_complete`` so the host process exits cleanly."""
         self._draining = True
+        # Idempotent re-signal: a phase="finish"-only caller (or a lost
+        # begin-phase frame) must still give cooperative tenants their
+        # preemption window before the in-flight wait below starts.
+        await self._broadcast_drain_to_workers(True)
         cluster_events.emit(
             cluster_events.INFO, cluster_events.RAYLET,
             f"node {self.node_id.hex()[:8]} drain started "
@@ -2094,11 +2153,18 @@ class NodeManager:
         deadline = loop.time() + max(1.0, float(timeout))
         wait = Backoff(base=0.05, factor=1.3, max_delay=0.5, jitter=0.0)
         while loop.time() < deadline:
+            # In-flight work: queued/running tasks, plus RUNNING actor
+            # methods (w.current on an actor worker) — a preempted train
+            # gang is mid-checkpoint inside one of those; killing it at
+            # the first sweep would waste the cooperative window the
+            # node_draining broadcast just opened. Queued-but-unstarted
+            # actor calls are NOT waited for (the actor dies with the
+            # node either way).
             busy = bool(self._ready) or any(
-                (w.current is not None or w.pending)
+                (w.current is not None
+                 or (w.pending and w.actor_id is None))
                 for w in self._workers.values()
                 if w.state != "dead" and w.worker_type != "client"
-                and w.actor_id is None
             )
             if not busy:
                 break
@@ -2246,7 +2312,9 @@ class NodeManager:
             new_loc = await self._ensure_local(oid, loc)
             self._seal_object(oid, new_loc)
             return {"ok": True}
-        except Exception as e:  # noqa: BLE001 — reported to the drainer
+        # Reply-carried: the drainer counts this object as failed and
+        # reports it in the drain WARNING.
+        except Exception as e:  # rtlint: disable=swallowed-failure
             return {"ok": False, "error": str(e) or type(e).__name__}
 
     # ------------------------------------------------------------- scheduling
@@ -4056,12 +4124,14 @@ class NodeManager:
             await w.writer.send(
                 {"type": "reply", "msg_id": msg["msg_id"], "timeout": True}
             )
-        except Exception as e:  # connection gone etc.
+        # Reply-carried; the nested send races the worker's death —
+        # a dead requester needs no reply.
+        except Exception as e:  # rtlint: disable=swallowed-failure
             try:
                 await w.writer.send(
                     {"type": "reply", "msg_id": msg["msg_id"], "error": str(e)}
                 )
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
 
     async def _reply_wait(self, w: WorkerHandle, msg):
@@ -4092,7 +4162,8 @@ class NodeManager:
                     out["deleted"] = await self._gcs.kv_del(msg["key"])
                 elif op == "keys":
                     out["keys"] = await self._gcs.kv_keys(msg.get("prefix", ""))
-            except Exception as e:
+            # Reply-carried: the worker's kv call raises it.
+            except Exception as e:  # rtlint: disable=swallowed-failure
                 out["error"] = str(e)
             await w.writer.send(out)
             return
@@ -4122,12 +4193,13 @@ class NodeManager:
         out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
         try:
             out.update(await self._pubsub_op(msg))
-        except Exception as e:
+        # Reply-carried: pubsub_op raises it caller-side.
+        except Exception as e:  # rtlint: disable=swallowed-failure
             out["error"] = str(e)
         try:
             await w.writer.send(out)
-        except Exception:
-            pass
+        except Exception:  # rtlint: disable=swallowed-failure
+            pass  # dead requester needs no reply
 
     async def _pubsub_op(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         if self._gcs is None:
@@ -4169,12 +4241,13 @@ class NodeManager:
                 severity=msg.get("severity"), source=msg.get("source"),
                 limit=msg.get("limit", 1000),
             ))
-        except Exception as e:
+        # Reply-carried: list_cluster_events raises it caller-side.
+        except Exception as e:  # rtlint: disable=swallowed-failure
             out["error"] = str(e)
         try:
             await w.writer.send(out)
-        except Exception:
-            pass
+        except Exception:  # rtlint: disable=swallowed-failure
+            pass  # dead requester needs no reply
 
     async def _events_list(self, severity=None, source=None,
                            limit: int = 1000) -> Dict[str, Any]:
@@ -4345,12 +4418,13 @@ class NodeManager:
                 )
             else:
                 out["error"] = f"unknown profile op {msg.get('op')!r}"
-        except Exception as e:  # noqa: BLE001
+        # Reply-carried: the rtpu profile caller shows it.
+        except Exception as e:  # rtlint: disable=swallowed-failure
             out["error"] = str(e)
         try:
             await w.writer.send(out)
-        except Exception:
-            pass
+        except Exception:  # rtlint: disable=swallowed-failure
+            pass  # dead requester needs no reply
 
     # ---------------------------------------------------- hang detector
 
@@ -4427,12 +4501,13 @@ class NodeManager:
         out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
         try:
             out.update(await self.pg_op(msg))
-        except Exception as e:
+        # Reply-carried: the placement-group API raises it caller-side.
+        except Exception as e:  # rtlint: disable=swallowed-failure
             out["error"] = str(e)
         try:
             await w.writer.send(out)
-        except Exception:
-            pass
+        except Exception:  # rtlint: disable=swallowed-failure
+            pass  # dead requester needs no reply
 
     async def pg_op(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         if self._gcs is None:

@@ -1,0 +1,49 @@
+"""Worker-side preemption signal: the drain plane's hook into user code.
+
+When a node begins draining (``gcs.drain_node`` phase "begin"), its node
+manager forwards a ``node_draining`` frame to every local worker process
+(worker_main's reader loop calls :func:`signal_local_drain`). Long-running
+worker code — above all the train gang (``TrainSession.preemption``) —
+polls :func:`local_drain` at its own safe points (step boundaries) and
+winds down cooperatively: checkpoint, report, surrender the node. A
+drain rollback (``node_undrain``) clears the signal.
+
+This module is deliberately tiny and dependency-free: it is imported on
+the worker's reader thread and inside training loops.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+_lock = threading.Lock()
+_drain_node_hex: Optional[str] = None
+_drain_since: float = 0.0
+
+
+def signal_local_drain(node_hex: str) -> None:
+    """This worker's host node began draining."""
+    global _drain_node_hex, _drain_since
+    with _lock:
+        if _drain_node_hex is None:
+            _drain_since = time.time()
+        _drain_node_hex = node_hex or "?"
+
+
+def clear_local_drain() -> None:
+    """The drain was aborted (``node_undrain``): back to normal."""
+    global _drain_node_hex, _drain_since
+    with _lock:
+        _drain_node_hex = None
+        _drain_since = 0.0
+
+
+def local_drain() -> Optional[dict]:
+    """``{"node_id", "since"}`` when this worker's node is draining,
+    else ``None``. Cheap enough to poll every training step."""
+    with _lock:
+        if _drain_node_hex is None:
+            return None
+        return {"node_id": _drain_node_hex, "since": _drain_since}

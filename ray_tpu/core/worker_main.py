@@ -228,6 +228,19 @@ class Worker:
 
                     faults.apply_plan(msg.get("specs") or [],
                                       msg.get("gen"))
+                elif mtype == "node_draining":
+                    # This worker's host is surrendering: raise the
+                    # cooperative preemption signal long-running code
+                    # (TrainSession.preemption) polls at safe points.
+                    from . import preemption
+
+                    preemption.signal_local_drain(
+                        msg.get("node_id") or ""
+                    )
+                elif mtype == "node_undrain":
+                    from . import preemption
+
+                    preemption.clear_local_drain()
                 elif mtype == "kill":
                     self._alive = False
                     self._tq_put(None)
@@ -241,7 +254,9 @@ class Worker:
 
         try:
             threads = profiler.dump_stacks()
-        except Exception:  # noqa: BLE001 — diagnosis must not kill us
+        # Diagnosis must not kill us: an empty reply IS the signal the
+        # NM-side merge shows for a sampler that failed here.
+        except Exception:  # rtlint: disable=swallowed-failure
             threads = []
         try:
             self.conn.send({
@@ -251,7 +266,9 @@ class Worker:
                 "worker_id": self.worker_id.hex(),
                 "threads": threads,
             })
-        except Exception:
+        # Reply to a dying node socket: the NM treats the missing reply
+        # as missing_workers (partial diagnosis, not a hang).
+        except Exception:  # rtlint: disable=swallowed-failure
             pass
 
     def _profile_and_reply(self, msg):
@@ -261,7 +278,9 @@ class Worker:
             prof = profiler.sample(
                 msg.get("seconds", 2.0), msg.get("hz", 100)
             )
-        except Exception:  # noqa: BLE001
+        # Same diagnostics contract: a zero-sample reply marks this
+        # worker's sampler as failed in the cluster-wide merge.
+        except Exception:  # rtlint: disable=swallowed-failure
             prof = {"counts": {}, "samples": 0}
         try:
             self.conn.send({
@@ -272,7 +291,9 @@ class Worker:
                 "counts": prof.get("counts", {}),
                 "samples": prof.get("samples", 0),
             })
-        except Exception:
+        # Same contract as the stack reply: a dead conn degrades the
+        # fan-out to a partial profile, never an error loop here.
+        except Exception:  # rtlint: disable=swallowed-failure
             pass
 
     def _route_group(self, m) -> bool:
@@ -314,8 +335,14 @@ class Worker:
                             cls = cache.load(spec.function_id)
                             if ActorContainer.class_is_async(cls):
                                 concurrency = 100
-                    except Exception:
-                        pass
+                    except Exception as e:  # noqa: BLE001
+                        # A failed async-class probe silently pins the
+                        # actor to serial execution — worth a breadcrumb.
+                        print(
+                            f"ray_tpu worker: async-actor detection "
+                            f"failed ({e!r}); actor runs serial",
+                            file=sys.stderr,
+                        )
                 if concurrency > 1 or getattr(
                         spec, "allow_out_of_order", False):
                     from concurrent.futures import ThreadPoolExecutor
@@ -384,14 +411,17 @@ class Worker:
         self._flush_dones()
         try:
             self.runtime.refs.flush()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            print(f"ray_tpu worker: exit refcount flush failed ({e!r}); "
+                  f"head-side release relies on worker-death cleanup",
+                  file=sys.stderr)
         try:
             from ..util.metrics import _registry
 
             _registry.flush()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            print(f"ray_tpu worker: exit metrics flush failed ({e!r})",
+                  file=sys.stderr)
         os._exit(0)
 
     def _start_direct_listener(self, actor_id):
@@ -528,7 +558,9 @@ class Worker:
             try:
                 conn.send({"type": "direct_welcome", "ok": False,
                            "error": "bad session token"})
-            except Exception:
+            # Refusal to a conn that died first: same outcome (no
+            # direct channel), caller stays on the NM route.
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
             conn.close()
             return
@@ -539,7 +571,9 @@ class Worker:
                     "error": f"direct protocol version mismatch "
                              f"(worker v{DIRECT_PROTO_VER})",
                 })
-            except Exception:
+            # As above: a lost refusal just leaves the caller on the
+            # NM fallback route.
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
             conn.close()
             return
@@ -554,7 +588,9 @@ class Worker:
             try:
                 conn.send({"type": "direct_welcome", "ok": False,
                            "error": "actor mismatch (stale endpoint)"})
-            except Exception:
+            # Lost refusal == refused: the caller times out and
+            # re-resolves through the NM either way.
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
             conn.close()
             return
@@ -573,7 +609,9 @@ class Worker:
             conn.send({"type": "direct_welcome", "ok": True,
                        "ver": DIRECT_PROTO_VER,
                        "npv": frame_pump.CODEC_VER if want_native else 0})
-        except Exception:
+        # Caller hung up before the welcome: nothing to serve; its
+        # submit path falls back to the NM route and retries.
+        except Exception:  # rtlint: disable=swallowed-failure
             return
         if want_native:
             wrapped = frame_pump.wrap_connection(conn)
@@ -642,7 +680,9 @@ class Worker:
             for f in group_futs:
                 try:
                     f.result(timeout=60)
-                except Exception:
+                # The task's own failure already shipped in its reply
+                # frame; the fence only needs "finished", not "ok".
+                except Exception:  # rtlint: disable=swallowed-failure
                     pass
             group_futs.clear()
             self._flush_direct_replies(conn)
@@ -728,7 +768,10 @@ class Worker:
                 continue
             try:
                 self._send_replies(c, replies)
-            except Exception:
+            # Dead direct channel: the caller detects the death and
+            # replays unanswered calls over the NM route (exactly-once
+            # via the replay-dedup cache) — the reply is not lost.
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
 
     def _send_replies(self, c, replies):
@@ -760,15 +803,18 @@ class Worker:
         self._flush_nm_dones(force=True)
         try:
             self.runtime.refs.flush()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            print(f"ray_tpu worker: pre-block refcount flush failed "
+                  f"({e!r}); a borrowed-object release may be delayed",
+                  file=sys.stderr)
 
     def _run_direct(self, conn, spec, function_blob, remote=False):
         done = self._run_task(spec, function_blob, sample_resources=False)
         self._note_direct_done(done, spec, remote)
         try:
             self._send_replies(conn, [done])
-        except Exception:
+        # Same NM-replay contract as the batched reply path above.
+        except Exception:  # rtlint: disable=swallowed-failure
             pass
 
     def _note_direct_done(self, done: dict, spec, remote: bool):
@@ -836,7 +882,9 @@ class Worker:
             self._nm_done_buf = []
         try:
             self.conn.send({"type": "direct_done_batch", "items": buf})
-        except Exception:
+        # Node socket gone == this worker is dying; the NM's worker-
+        # death cleanup reconciles the unflushed completions.
+        except Exception:  # rtlint: disable=swallowed-failure
             pass
 
     def _nm_done_ticker(self):
@@ -942,7 +990,9 @@ class Worker:
                 try:
                     if cloudpickle.loads(prior).get("consumed"):
                         return
-                except Exception:
+                # Unreadable tombstone: treat as not-consumed and
+                # re-seal below — idempotent either way.
+                except Exception:  # rtlint: disable=swallowed-failure
                     pass
             oid = stream_item_id(spec.task_id, index)
             from .serialization import serialize_with_refs as _ser_refs
@@ -1004,7 +1054,8 @@ class Worker:
                     trace_id=trace_id, span_id=span_id,
                     parent_id=parent_id,
                 )
-            except Exception:
+            # Observability must never fail the task it observes.
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
         done = {
             "type": "task_done",
@@ -1016,7 +1067,8 @@ class Worker:
         if _rsamp is not None:
             try:
                 done["resource_usage"] = _rsamp.finish()
-            except Exception:
+            # A failed usage sample only blanks one telemetry row.
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
         if failed and error_info is not None:
             # Structured failure record: the node manager retains the
@@ -1046,8 +1098,11 @@ class Worker:
                 # this worker may os._exit before the flusher ticks, and
                 # a failure event is the one record worth a sync hop.
                 cluster_events.flush()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                # The failure still ships in the task_done frame; only
+                # the event-plane copy is lost — note it for the logs.
+                print(f"ray_tpu worker: failure-event publish failed "
+                      f"({e!r})", file=sys.stderr)
         if nested:
             # Refs serialized inside return values: the NM pins them for
             # each return's lifetime (AddNestedObjectIds analogue).
@@ -1085,7 +1140,9 @@ def main():
             pr.disable()
             try:
                 pr.dump_stats(f"{profile_to}.{os.getpid()}")
-            except Exception:
+            # Diagnostics-only path (RAY_TPU_PROFILE_WORKERS): a failed
+            # dump must not change the worker's exit code.
+            except Exception:  # rtlint: disable=swallowed-failure
                 pass
             _orig_exit(code)
 
@@ -1115,7 +1172,9 @@ def main():
             from ..util import events as _events
 
             _events.flush()
-        except Exception:
+        # Transport already gone at teardown: the ring's tail is lost
+        # with the process either way; nothing actionable here.
+        except Exception:  # rtlint: disable=swallowed-failure
             pass
 
 

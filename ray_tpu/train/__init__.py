@@ -5,7 +5,11 @@ session functions report/get_checkpoint/get_dataset_shard/get_world_rank,
 Checkpoint, ScalingConfig/RunConfig/CheckpointConfig/FailureConfig, Result.
 """
 
-from .checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    Checkpoint,
+    CheckpointManager,
+    latest_committed,
+)
 from .gbdt import GBDTTrainer  # noqa: F401
 from .predictor import (  # noqa: F401
     BatchPredictor,
@@ -21,11 +25,13 @@ from .config import (  # noqa: F401
     ScalingConfig,
 )
 from .session import (  # noqa: F401
+    PreemptionSignal,
     get_checkpoint,
     get_dataset_shard,
     get_session,
     get_world_rank,
     get_world_size,
+    preemption_requested,
     report,
 )
 from .trainer import JaxTrainer, TrainWorkerGroupError  # noqa: F401

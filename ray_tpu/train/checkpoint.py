@@ -1,9 +1,31 @@
-"""Checkpoints: directory-based with orbax-backed pytree save/restore.
+"""Checkpoints: directory-based, orbax-backed, crash-safe.
 
 Ref analogue: python/ray/train/_checkpoint.py Checkpoint (:55 — a directory
 plus a filesystem abstraction) and _internal/storage.py StorageContext. On
 TPU the pytree payloads go through orbax (tensorstore) so sharded arrays
 save/restore correctly across meshes.
+
+Commit protocol (the crash-safety contract every consumer relies on):
+
+1. ``from_pytree`` writes EVERYTHING — orbax payload, ``metadata.json``,
+   and a ``COMMITTED`` manifest (step, world size, per-file sizes) —
+   into a ``.tmp-`` sibling directory, fsyncs it, then atomically
+   renames it into place and fsyncs the parent. A crash at ANY point
+   leaves either the previous state or a ``.tmp-`` orphan that no
+   restore path will ever pick up; it can never poison "latest".
+2. ``is_committed`` verifies the manifest and every listed file's size,
+   so a torn directory (partial copy, truncated tensorstore file) reads
+   as uncommitted — corrupt and uncommitted are the same thing to
+   restore.
+3. ``latest_committed(storage_dir)`` scans newest-first and falls back
+   past corrupt/uncommitted entries; :class:`CheckpointManager` prune
+   never deletes the only committed entry and never deletes a
+   checkpoint until a NEWER one has committed (a concurrently-resuming
+   worker may still be restoring from it).
+
+The ``checkpoint_io`` chaos point (util/faults.py) fires at the top of
+both save and restore so the whole protocol is testable under injected
+I/O failures.
 """
 
 from __future__ import annotations
@@ -13,7 +35,47 @@ import os
 import shutil
 import tempfile
 import time
-from typing import Any, Dict, Optional
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..util import faults
+
+# Commit marker + manifest, written last inside the staging directory so
+# the atomic rename is the single commit point.
+COMMIT_MANIFEST = "COMMITTED"
+_TMP_PREFIX = ".tmp-"
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without O_RDONLY dir opens — best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _walk_files(root: str) -> List[Tuple[str, int]]:
+    """(relpath, size) for every regular file under ``root``."""
+    out: List[Tuple[str, int]] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in files:
+            full = os.path.join(dirpath, fname)
+            out.append((
+                os.path.relpath(full, root).replace(os.sep, "/"),
+                os.path.getsize(full),
+            ))
+    return sorted(out)
 
 
 class Checkpoint:
@@ -28,24 +90,89 @@ class Checkpoint:
 
     @classmethod
     def from_pytree(cls, tree: Any, path: str, *,
-                    metadata: Optional[Dict] = None) -> "Checkpoint":
-        """Save a jax pytree (params/opt state/step...) with orbax."""
+                    metadata: Optional[Dict] = None,
+                    step: Optional[int] = None,
+                    world_size: Optional[int] = None) -> "Checkpoint":
+        """Save a jax pytree (params/opt state/step...) with orbax,
+        atomically: payload + metadata + COMMITTED manifest are staged
+        in a ``.tmp-`` sibling and renamed into place in one step. A
+        crash mid-save leaves no visible (and no half-committed)
+        checkpoint at ``path``."""
         import orbax.checkpoint as ocp
 
         path = os.path.abspath(path)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.join(path, "pytree"), tree, force=True)
-        ckptr.wait_until_finished()
-        if metadata:
-            with open(os.path.join(path, "metadata.json"), "w") as f:
-                json.dump(metadata, f)
+        parent = os.path.dirname(path) or "."
+        os.makedirs(parent, exist_ok=True)
+        # Chaos: an injected checkpoint_io failure surfaces exactly like
+        # a real storage fault at the start of the write window — the
+        # staging dir is cleaned up and nothing commits.
+        delay = faults.fire(faults.CHECKPOINT_IO, op="save", path=path)
+        if delay:
+            time.sleep(delay)
+        tmp = os.path.join(
+            parent,
+            f"{_TMP_PREFIX}{os.path.basename(path)}-{uuid.uuid4().hex[:8]}",
+        )
+        try:
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(os.path.join(tmp, "pytree"), tree, force=True)
+            ckptr.wait_until_finished()
+            # Metadata rides INSIDE the atomic commit: there is no
+            # window where the payload exists but metadata() would
+            # silently return {} (the pre-commit-protocol ordering bug).
+            if metadata:
+                with open(os.path.join(tmp, "metadata.json"), "w") as f:
+                    json.dump(metadata, f)
+            manifest = {
+                "step": int(step) if step is not None else None,
+                "world_size": int(world_size) if world_size else None,
+                "ts": time.time(),
+                "files": {rel: size for rel, size in _walk_files(tmp)},
+            }
+            with open(os.path.join(tmp, COMMIT_MANIFEST), "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            for rel, _size in _walk_files(tmp):
+                if rel != COMMIT_MANIFEST:
+                    _fsync_file(os.path.join(tmp, rel))
+            for dirpath, _dirs, _files in os.walk(tmp):
+                _fsync_dir(dirpath)
+            # Re-saving over an existing checkpoint keeps the old one
+            # until the replacement is fully staged. The aside name
+            # does NOT carry the .tmp- prefix on purpose: the moved
+            # directory is still a complete COMMITTED checkpoint, and a
+            # crash between the two renames must leave it DISCOVERABLE
+            # by latest_committed (same manifest step, slightly odd
+            # name) — never lost. Success deletes it below.
+            replaced = None
+            if os.path.exists(path):
+                replaced = (
+                    f"{path}.replaced-{uuid.uuid4().hex[:8]}"
+                )
+                os.rename(path, replaced)
+            try:
+                os.rename(tmp, path)  # THE commit point
+            except BaseException:
+                if replaced:
+                    os.rename(replaced, path)  # restore the original
+                raise
+            _fsync_dir(parent)
+            if replaced:
+                shutil.rmtree(replaced, ignore_errors=True)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
         return cls(path)
 
     def as_pytree(self, target: Optional[Any] = None) -> Any:
         """Restore the pytree; ``target`` provides structure/shardings."""
         import orbax.checkpoint as ocp
 
+        delay = faults.fire(faults.CHECKPOINT_IO, op="restore",
+                            path=self.path)
+        if delay:
+            time.sleep(delay)
         ckptr = ocp.StandardCheckpointer()
         item = os.path.join(self.path, "pytree")
         if target is not None:
@@ -59,6 +186,38 @@ class Checkpoint:
                 return json.load(f)
         return {}
 
+    def manifest(self) -> Dict:
+        """The COMMITTED manifest ({} when uncommitted/unreadable)."""
+        p = os.path.join(self.path, COMMIT_MANIFEST)
+        try:
+            with open(p) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def is_committed(self) -> bool:
+        """True when the COMMITTED manifest exists AND every file it
+        lists is present with the recorded size — a torn directory
+        (truncated tensorstore file, partial copy) reads as
+        uncommitted, so restore falls back past it."""
+        manifest = self.manifest()
+        if not manifest:
+            return False
+        for rel, size in (manifest.get("files") or {}).items():
+            if rel == COMMIT_MANIFEST:
+                continue
+            full = os.path.join(self.path, rel)
+            try:
+                if os.path.getsize(full) != int(size):
+                    return False
+            except OSError:
+                return False
+        return True
+
+    @property
+    def step(self) -> Optional[int]:
+        return self.manifest().get("step")
+
     def to_directory(self, dest: str) -> str:
         shutil.copytree(self.path, dest, dirs_exist_ok=True)
         return dest
@@ -67,9 +226,45 @@ class Checkpoint:
         return f"Checkpoint({self.path})"
 
 
+def latest_committed(storage_dir: str) -> Optional[Checkpoint]:
+    """Newest COMMITTED checkpoint under ``storage_dir`` (by manifest
+    step, then mtime), scanning newest-first and falling back past
+    corrupt, uncommitted, and ``.tmp-`` staging directories. The
+    restart path's source of truth: a crash can strand torn state on
+    disk, but never make this return it."""
+    try:
+        names = os.listdir(storage_dir)
+    except OSError:
+        return None
+    candidates = []
+    for name in names:
+        if name.startswith(_TMP_PREFIX):
+            continue  # an interrupted save's staging orphan
+        path = os.path.join(storage_dir, name)
+        if not os.path.isdir(path):
+            continue
+        ckpt = Checkpoint(path)
+        manifest = ckpt.manifest()
+        step = manifest.get("step")
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = 0.0
+        candidates.append((
+            step if isinstance(step, int) else -1, mtime, ckpt
+        ))
+    candidates.sort(key=lambda c: (c[0], c[1]), reverse=True)
+    for _step, _mtime, ckpt in candidates:
+        if ckpt.is_committed():
+            return ckpt
+    return None
+
+
 class CheckpointManager:
     """Tracks reported checkpoints, retains top-k by score (ref:
-    train/_internal/checkpoint_manager.py)."""
+    train/_internal/checkpoint_manager.py) under the commit-protocol
+    safety rules: the only committed entry is never pruned, and no
+    entry is deleted until a NEWER checkpoint has committed."""
 
     def __init__(self, storage_dir: str, num_to_keep: Optional[int] = None,
                  score_attribute: Optional[str] = None, score_order: str = "max"):
@@ -92,22 +287,65 @@ class CheckpointManager:
     def _prune(self):
         if self.num_to_keep is None or len(self._entries) <= self.num_to_keep:
             return
+        committed = [e for e in self._entries if e[2].is_committed()]
+        committed_steps = [e[1] for e in committed]
+        newest_committed = (max(committed, key=lambda e: e[1])
+                            if committed else None)
+        best = None
+        scored = [e for e in self._entries if e[0] is not None]
+        if scored:
+            pick = max if self.score_order == "max" else min
+            best = pick(scored, key=lambda e: e[0])
+
+        def deletable(entry) -> bool:
+            # Safety over budget, in order: (1) the newest committed
+            # entry is what a concurrently-resuming worker restores
+            # from — deletable only once an even newer checkpoint has
+            # COMMITTED (an uncommitted "newer" save never justifies
+            # deleting the committed fallback beneath it); (2) the
+            # best-scored entry is the Result's checkpoint; (3) any
+            # other entry needs a newer committed successor before its
+            # directory can go. num_to_keep may be overshot while these
+            # protections hold — the next commit rebalances.
+            if entry is newest_committed or entry is best:
+                return False
+            return any(cs > entry[1] for cs in committed_steps)
+
         def sort_key(e):
             score, step, _ = e
             if score is None:
-                return step  # fall back to recency
-            return score if self.score_order == "max" else -score
+                return (step, step)  # fall back to recency
+            ordered = score if self.score_order == "max" else -score
+            # Ties (and score-free runs) evict oldest-step first.
+            return (ordered, step)
 
-        self._entries.sort(key=sort_key)
-        while len(self._entries) > self.num_to_keep:
-            _, _, ckpt = self._entries.pop(0)
-            shutil.rmtree(ckpt.path, ignore_errors=True)
+        evictable = [e for e in sorted(self._entries, key=sort_key)
+                     if deletable(e)]
+        for entry in evictable:
+            if len(self._entries) <= self.num_to_keep:
+                break
+            self._entries.remove(entry)
+            shutil.rmtree(entry[2].path, ignore_errors=True)
 
     @property
     def latest(self) -> Optional[Checkpoint]:
+        """Newest usable checkpoint: committed entries win; an
+        uncommitted newest (its save failed or is still in flight)
+        never shadows the committed one beneath it."""
+        return self.latest_committed or self._newest_any
+
+    @property
+    def _newest_any(self) -> Optional[Checkpoint]:
         if not self._entries:
             return None
         return max(self._entries, key=lambda e: e[1])[2]
+
+    @property
+    def latest_committed(self) -> Optional[Checkpoint]:
+        committed = [e for e in self._entries if e[2].is_committed()]
+        if not committed:
+            return None
+        return max(committed, key=lambda e: e[1])[2]
 
     @property
     def best(self) -> Optional[Checkpoint]:

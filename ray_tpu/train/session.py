@@ -5,11 +5,27 @@ Ref analogue: python/ray/train/_internal/session.py _TrainSession (:109) —
 accessors. Reports stream to the driver through the control-plane KV store
 (sequence-numbered keys) instead of the reference's in-actor queue, so the
 trainer can poll while the worker's actor method is still running.
+
+Elastic-gang surface (PR 11):
+
+- Every rank publishes a heartbeat + step counter to GCS KV
+  (``__train__/<run>/<rank>/hb``) from a background thread; the driver-
+  side gang supervisor declares a rank dead/hung when its heartbeat goes
+  stale past ``train_rank_timeout_s`` and aborts the whole gang.
+- :func:`preemption_requested` / ``TrainSession.preemption`` surface a
+  :class:`PreemptionSignal` when the gang must checkpoint and surrender
+  a draining node: the local signal arrives as a ``node_draining`` frame
+  (core/preemption.py), and the first rank to see it raises a gang-wide
+  KV flag so every rank winds down at the SAME step boundary (a lone
+  rank exiting mid-collective would hang the survivors).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
+import threading
+import time
 from typing import Any, Dict, Optional
 
 import cloudpickle
@@ -17,6 +33,18 @@ import cloudpickle
 from .checkpoint import Checkpoint
 
 _session: Optional["TrainSession"] = None
+
+
+@dataclasses.dataclass
+class PreemptionSignal:
+    """The gang is being preempted: checkpoint at the next step boundary
+    and return from the train loop — the supervisor restarts the run
+    from the last committed checkpoint on surviving/replacement nodes
+    WITHOUT consuming a FailureConfig.max_failures budget slot."""
+
+    node_id: str          # the draining node (hex; "?" when unknown)
+    since: float          # when the drain was first observed
+    rank: int             # rank that first raised the gang-wide flag
 
 
 class TrainSession:
@@ -38,14 +66,34 @@ class TrainSession:
         self.dataset_shards = dataset_shards or {}
         self.trial_info = trial_info or {}
         self._seq = 0
+        # Step counter the heartbeat thread ships: report() advances it
+        # (preferring an explicit metrics["step"]), so the supervisor
+        # sees both liveness AND progress per rank.
+        self.step = 0
+        self._preempt: Optional[PreemptionSignal] = None
+        self._preempt_local = False  # this rank raised the gang flag
+        self._preempt_checked = 0.0
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
 
     def _kv(self):
         from ..core.runtime_context import current_runtime
 
         return current_runtime()
 
+    # ------------------------------------------------------------- report
+
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None):
+        from ..util import faults
+
+        # Chaos: a rank "killed mid-step" — the injected ConnectionError
+        # escapes the train loop exactly like a real crash, the error
+        # key publishes, and the supervisor aborts + restarts the gang.
+        delay = faults.fire(faults.TRAIN_WORKER, rank=str(self.world_rank),
+                            run=self.run_id)
+        if delay:
+            time.sleep(delay)
         payload = {
             "metrics": dict(metrics),
             "checkpoint_path": checkpoint.path if checkpoint else None,
@@ -57,6 +105,147 @@ class TrainSession:
             cloudpickle.dumps(payload),
         )
         self._seq += 1
+        step = metrics.get("step")
+        self.step = int(step) if isinstance(step, (int, float)) \
+            else self.step + 1
+
+    # --------------------------------------------------------- heartbeats
+
+    def heartbeat_key(self) -> str:
+        return f"__train__/{self.run_id}/{self.world_rank}/hb"
+
+    def publish_heartbeat(self) -> None:
+        self._kv().kv_put(
+            self.heartbeat_key(),
+            cloudpickle.dumps({"ts": time.time(), "step": self.step,
+                               "rank": self.world_rank}),
+        )
+
+    def start_heartbeats(self, interval_s: float) -> None:
+        """Background per-rank heartbeat through GCS KV. Connection is
+        thread-safe (protocol.Connection send lock), so this rides the
+        same node socket as report()."""
+        if self._hb_thread is not None:
+            return
+
+        def loop():
+            warned = False
+            while not self._hb_stop.wait(interval_s):
+                try:
+                    self.publish_heartbeat()
+                    warned = False
+                except Exception as e:  # noqa: BLE001
+                    # Keep beating through transient control-plane
+                    # blips (GCS failover window, reconnect): a
+                    # permanently-exited heartbeat thread would get a
+                    # HEALTHY rank declared dead train_rank_timeout_s
+                    # later. If the failure persists that long, the
+                    # rank really is unreachable and the supervisor's
+                    # verdict is correct.
+                    if not warned:
+                        warned = True
+                        import sys
+
+                        print(
+                            f"[ray_tpu.train] rank {self.world_rank}: "
+                            f"heartbeat publish failed ({e!r}); "
+                            f"retrying every {interval_s}s",
+                            file=sys.stderr,
+                        )
+
+        try:
+            self.publish_heartbeat()
+        except Exception:
+            pass  # first beat best-effort; the thread keeps trying
+        self._hb_thread = threading.Thread(
+            target=loop, name="ray_tpu-train-hb", daemon=True
+        )
+        self._hb_thread.start()
+
+    def stop_heartbeats(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
+        # Final beat with the FINAL step counter: a rank that finishes
+        # faster than one heartbeat interval would otherwise leave a
+        # step-0 beat behind, hiding the gang's real progress from the
+        # supervisor's divergence (hung-rank) detector.
+        try:
+            self.publish_heartbeat()
+        except Exception:
+            pass  # socket already down; the rank is done either way
+
+    # --------------------------------------------------------- preemption
+
+    def _preempt_flag_key(self) -> str:
+        return f"__train__/{self.run_id}/preempt"
+
+    @property
+    def preemption(self) -> Optional[PreemptionSignal]:
+        """The gang-wide preemption signal, or None. Poll at step
+        boundaries: when set, save a checkpoint, report it, and return
+        from the train loop. Sources, in order: (1) this worker's node
+        began draining (node_draining frame -> core/preemption.py) —
+        the first rank to see it raises the gang-wide KV flag; (2) the
+        KV flag raised by another rank (or the supervisor). An aborted
+        drain RETRACTS the signal: node_undrain clears the local flag,
+        the raising rank deletes the gang flag, and every rank's next
+        poll sees the retraction — a rolled-back drain costs at most
+        one step-boundary wobble, not a whole-gang restart."""
+        from ..core import preemption as _local
+
+        local = _local.local_drain()
+        if local is not None:
+            if not self._preempt_local:
+                sig = PreemptionSignal(node_id=local["node_id"],
+                                       since=local["since"],
+                                       rank=self.world_rank)
+                try:
+                    self._kv().kv_put(
+                        self._preempt_flag_key(),
+                        cloudpickle.dumps(dataclasses.asdict(sig)),
+                        overwrite=False,
+                    )
+                except Exception:
+                    pass  # advisory; the drain timeout still bounds us
+                self._preempt = sig
+                self._preempt_local = True
+            return self._preempt
+        if self._preempt_local:
+            # We raised the gang flag for a drain that has since been
+            # aborted (node_undrain): retract it for the whole gang.
+            try:
+                self._kv().kv_del(self._preempt_flag_key())
+            except Exception:
+                pass  # stale flag worst-case costs one gang restart
+            self._preempt = None
+            self._preempt_local = False
+        # Gang-wide flag: throttled KV poll (discovery AND
+        # retraction-tracking) so a tight step loop doesn't hammer the
+        # control plane.
+        now = time.monotonic()
+        if now - self._preempt_checked < 0.2:
+            return self._preempt
+        self._preempt_checked = now
+        try:
+            blob = self._kv().kv_get(self._preempt_flag_key())
+        except Exception:
+            return self._preempt
+        if blob is None:
+            self._preempt = None
+        elif self._preempt is None:
+            try:
+                self._preempt = PreemptionSignal(**cloudpickle.loads(blob))
+            except Exception:
+                self._preempt = PreemptionSignal(
+                    node_id="?", since=time.time(), rank=-1)
+        return self._preempt
+
+    def preemption_requested(self) -> bool:
+        return self.preemption is not None
+
+    # ------------------------------------------------------------- misc
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.start_checkpoint
@@ -104,6 +293,12 @@ def get_world_rank() -> int:
 
 def get_world_size() -> int:
     return get_session().world_size
+
+
+def preemption_requested() -> bool:
+    """True when the gang must checkpoint and surrender its node(s) —
+    check at step boundaries; see TrainSession.preemption."""
+    return get_session().preemption_requested()
 
 
 def get_trial_name() -> str:

@@ -52,8 +52,11 @@ SERVE = "SERVE"
 JOB = "JOB"
 # Fault-injection firings (util/faults.py — the chaos plane).
 CHAOS = "CHAOS"
+# Train gang lifecycle (train/trainer.py supervisor: rank death/hang,
+# gang aborts, restart-from-checkpoint, cooperative preemption).
+TRAIN = "TRAIN"
 SOURCES = (GCS, RAYLET, WORKER, TASK, ACTOR, OBJECT_STORE, AUTOSCALER,
-           SERVE, JOB, CHAOS)
+           SERVE, JOB, CHAOS, TRAIN)
 
 FLUSH_INTERVAL_S = 0.25
 

@@ -45,6 +45,8 @@ GCS_RPC = "gcs_rpc"
 WORKER_SPAWN = "worker_spawn"
 HEARTBEAT = "heartbeat"
 SERVE_REPLICA = "serve_replica"
+TRAIN_WORKER = "train_worker"
+CHECKPOINT_IO = "checkpoint_io"
 
 # name -> (description, advertised degradation path). The lint enforces
 # exactly-once registration here and at least one fire() site per name.
@@ -72,6 +74,17 @@ FAULT_POINTS: Dict[str, str] = {
                    "breaker opens, proxies shed under sustained "
                    "latency; scope to one replica via "
                    "match={'replica': ...})",
+    TRAIN_WORKER: "train worker step boundary (session.report) "
+                  "(degradation: the rank dies mid-step, the gang "
+                  "supervisor aborts the whole gang and restarts it "
+                  "from the last committed checkpoint, bounded by "
+                  "FailureConfig.max_failures; scope to one rank via "
+                  "match={'rank': ...})",
+    CHECKPOINT_IO: "checkpoint save/restore I/O "
+                   "(degradation: the half-written .tmp- directory "
+                   "never becomes a committed checkpoint; restore "
+                   "falls back to the previous committed entry; "
+                   "scope via match={'op': 'save'|'restore'})",
 }
 
 MODES = ("always", "once", "every", "prob")

@@ -1,0 +1,485 @@
+"""Elastic gang lifecycle: crash-safe checkpoint commit protocol,
+supervisor-driven gang abort/restart (dead + hung ranks, chaos
+train_worker/checkpoint_io faults), and drain-aware cooperative
+preemption composed into Cluster.rolling_restart()."""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train as rt_train
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.checkpoint import (
+    COMMIT_MANIFEST,
+    CheckpointManager,
+    latest_committed,
+)
+from ray_tpu.util import faults
+
+
+# --------------------------------------------------------------- helpers
+
+
+def _make_committed(path, step, value=1.0):
+    import jax.numpy as jnp
+
+    return Checkpoint.from_pytree(
+        {"w": jnp.asarray([value]), "step": jnp.asarray(step)},
+        str(path), metadata={"step": step}, step=step, world_size=1,
+    )
+
+
+def _train_events(reason=None, timeout=5.0):
+    """TRAIN cluster events (polling past the event ring's flush
+    latency); optionally filtered on custom_fields.reason."""
+    from ray_tpu.util.state import list_cluster_events
+
+    deadline = time.time() + timeout
+    while True:
+        evts = [e for e in list_cluster_events(source="TRAIN")
+                if reason is None
+                or (e.get("custom_fields") or {}).get("reason") == reason]
+        if evts or time.time() >= deadline:
+            return evts
+        time.sleep(0.1)
+
+
+def _arm(specs):
+    from ray_tpu.core.runtime_context import current_runtime
+
+    nm = current_runtime()._nm
+    return nm.call_sync(nm._gcs.chaos_arm(specs), timeout=30)
+
+
+# ------------------------------------------------- commit protocol units
+
+
+def test_from_pytree_commits_atomically(tmp_path):
+    ckpt = _make_committed(tmp_path / "ck", step=7, value=3.0)
+    assert ckpt.is_committed()
+    assert os.path.exists(os.path.join(ckpt.path, COMMIT_MANIFEST))
+    manifest = ckpt.manifest()
+    assert manifest["step"] == 7
+    assert manifest["world_size"] == 1
+    assert manifest["files"], "manifest must list the payload files"
+    # Metadata rides inside the atomic commit.
+    assert ckpt.metadata() == {"step": 7}
+    # No staging orphans survive a successful commit.
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp-")]
+    import numpy as np
+
+    restored = ckpt.as_pytree()
+    np.testing.assert_allclose(np.asarray(restored["w"]), [3.0])
+
+
+def test_failed_save_leaves_nothing_visible(tmp_path):
+    """A checkpoint_io fault mid-save must leave NO directory at the
+    target path and no half-committed state a restore could pick up —
+    the crash-mid-save that used to poison 'latest'."""
+    faults.apply_plan([{"point": "checkpoint_io", "mode": "always",
+                        "match": {"op": "save"}}])
+    try:
+        with pytest.raises(faults.InjectedFault):
+            _make_committed(tmp_path / "ck", step=1)
+    finally:
+        faults.clear()
+    assert not os.path.exists(tmp_path / "ck")
+    assert latest_committed(str(tmp_path)) is None
+
+
+def test_restore_falls_back_past_corrupt_and_uncommitted(tmp_path):
+    _make_committed(tmp_path / "checkpoint_000001", step=1, value=1.0)
+    good = _make_committed(tmp_path / "checkpoint_000002", step=2, value=2.0)
+    corrupt = _make_committed(tmp_path / "checkpoint_000003", step=3)
+    uncommitted = _make_committed(tmp_path / "checkpoint_000004", step=4)
+
+    # Corrupt the newest-but-one: truncate a manifest-listed file.
+    rel = next(r for r in corrupt.manifest()["files"]
+               if r != COMMIT_MANIFEST)
+    with open(os.path.join(corrupt.path, rel), "w") as f:
+        f.write("")
+    assert not corrupt.is_committed()
+    # And strip the newest's commit marker entirely.
+    os.remove(os.path.join(uncommitted.path, COMMIT_MANIFEST))
+    # A stale staging dir must be ignored too.
+    os.makedirs(tmp_path / ".tmp-checkpoint_000005-dead")
+
+    found = latest_committed(str(tmp_path))
+    assert found is not None and found.path == good.path
+    import numpy as np
+
+    np.testing.assert_allclose(
+        np.asarray(found.as_pytree()["w"]), [2.0])
+
+
+def test_manager_retention_edge_cases(tmp_path):
+    # Score ties at num_to_keep=2: the protected best (first maximal)
+    # and the newest committed survive; the unprotected middle goes.
+    m = CheckpointManager(str(tmp_path / "a"), num_to_keep=2,
+                          score_attribute="acc", score_order="max")
+    cks = [_make_committed(tmp_path / "a" / f"ck{i}", step=i)
+           for i in range(3)]
+    for i, ck in enumerate(cks):
+        m.register(ck, {"acc": 0.5}, step=i)
+    assert not os.path.exists(cks[1].path)
+    assert os.path.exists(cks[0].path) and os.path.exists(cks[2].path)
+
+    # Missing score attribute falls back to recency retention.
+    m2 = CheckpointManager(str(tmp_path / "b"), num_to_keep=1,
+                           score_attribute="absent", score_order="max")
+    b0 = _make_committed(tmp_path / "b" / "ck0", step=0)
+    b1 = _make_committed(tmp_path / "b" / "ck1", step=1)
+    m2.register(b0, {"loss": 1.0}, step=0)
+    m2.register(b1, {"loss": 0.5}, step=1)
+    assert not os.path.exists(b0.path)
+    assert m2.latest.path == b1.path
+
+    # num_to_keep=1 with a scored-worse newcomer: BOTH survive — the
+    # best entry is the Result's checkpoint, the newest committed is
+    # the restart source; budget overshoots rather than delete either.
+    m3 = CheckpointManager(str(tmp_path / "c"), num_to_keep=1,
+                           score_attribute="acc", score_order="max")
+    c0 = _make_committed(tmp_path / "c" / "ck0", step=0)
+    c1 = _make_committed(tmp_path / "c" / "ck1", step=1)
+    m3.register(c0, {"acc": 0.9}, step=0)
+    m3.register(c1, {"acc": 0.1}, step=1)
+    assert os.path.exists(c0.path) and os.path.exists(c1.path)
+    assert m3.best.path == c0.path
+    assert m3.latest_committed.path == c1.path
+
+
+def test_prune_never_deletes_only_committed(tmp_path):
+    """Uncommitted newer checkpoints never justify deleting the
+    committed entry a resuming worker may be restoring from."""
+    m = CheckpointManager(str(tmp_path), num_to_keep=1)
+    committed = _make_committed(tmp_path / "ck0", step=0)
+    m.register(committed, {}, step=0)
+    for i in (1, 2):
+        p = tmp_path / f"ck{i}"
+        os.makedirs(p)
+        with open(p / "payload", "w") as f:
+            f.write("not committed")
+        m.register(Checkpoint(str(p)), {}, step=i)
+    # Over budget (3 entries, keep 1) but nothing newer has COMMITTED:
+    # the committed entry must survive, and latest must point at it.
+    assert os.path.exists(committed.path)
+    assert m.latest_committed.path == committed.path
+    assert m.latest.path == committed.path
+    # Once a newer checkpoint commits, the old entries become deletable.
+    newer = _make_committed(tmp_path / "ck3", step=3)
+    m.register(newer, {}, step=3)
+    assert os.path.exists(newer.path)
+    assert not os.path.exists(committed.path)
+    assert len(m._entries) == 1
+
+
+# ------------------------------------------------------ supervisor (gang)
+
+
+def _make_elastic_loop():
+    """Factory: the returned closure pickles BY VALUE (a module-level
+    function in a tests file would pickle by reference to a module the
+    worker processes cannot import)."""
+
+    def _elastic_loop(config):
+        """Deterministic resumable loop: w += 1 per step, committed
+        checkpoint every step (rank 0), optional crash/hang injection."""
+        import os as _os
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from ray_tpu import train as _train
+        from ray_tpu.train import Checkpoint as _Ckpt
+
+        sess = _train.get_session()
+        start = sess.get_checkpoint()
+        if start is not None:
+            state = start.as_pytree()
+            w = float(jnp.asarray(state["w"])[0])
+            start_step = int(state["step"]) + 1
+        else:
+            w, start_step = 0.0, 0
+        total = config.get("steps", 4)
+        for step in range(start_step, total):
+            if sess.preemption_requested():
+                break
+            marker = config.get("crash_marker")
+            if (marker and step == config.get("crash_step")
+                    and sess.world_rank == config.get("crash_rank", 0)
+                    and not _os.path.exists(marker)):
+                open(marker, "w").close()
+                if config.get("crash_kind") == "exit":
+                    _os._exit(1)
+                elif config.get("crash_kind") == "hang":
+                    _time.sleep(600)
+            w += 1.0
+            ckpt = None
+            if sess.world_rank == 0:
+                ckpt = _Ckpt.from_pytree(
+                    {"w": jnp.asarray([w]), "step": jnp.asarray(step)},
+                    sess.checkpoint_dir(step),
+                    step=step, world_size=sess.world_size,
+                )
+            _train.report({"step": step, "w": w}, checkpoint=ckpt)
+            _time.sleep(config.get("step_sleep", 0.0))
+
+    return _elastic_loop
+
+
+def test_gang_restart_on_dead_rank(ray_tpu_start, tmp_path):
+    """Rank 0 of a gang=2 dies hard (os._exit) mid-run: the supervisor
+    aborts the whole gang, restarts from the last committed checkpoint,
+    and the run completes with the exact resumed state."""
+    marker = str(tmp_path / "crashed")
+    result = JaxTrainer(
+        _make_elastic_loop(),
+        train_loop_config={"steps": 4, "crash_marker": marker,
+                           "crash_step": 2, "crash_rank": 0,
+                           "crash_kind": "exit"},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            storage_path=str(tmp_path / "run"),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    ).fit()
+    assert result.error is None, result.error
+    assert os.path.exists(marker)
+    assert result.metrics["step"] == 3
+    assert result.metrics["w"] == 4.0  # resumed, not recomputed
+    assert result.checkpoint is not None and result.checkpoint.is_committed()
+    assert _train_events(), "expected TRAIN cluster events"
+
+
+def test_gang_abort_on_hung_rank(ray_tpu_start, tmp_path):
+    """A rank that hangs between collectives (process alive, heartbeat
+    flowing, step counter frozen while the gang moves on) is detected
+    within train_rank_timeout_s and the gang is killed + restarted —
+    not left to wait out a collective timeout."""
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    old = cfg.train_rank_timeout_s
+    cfg.train_rank_timeout_s = 4.0
+    marker = str(tmp_path / "hung")
+    t0 = time.monotonic()
+    try:
+        result = JaxTrainer(
+            _make_elastic_loop(),
+            train_loop_config={"steps": 4, "crash_marker": marker,
+                               "crash_step": 1, "crash_rank": 0,
+                               "crash_kind": "hang"},
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                storage_path=str(tmp_path / "run"),
+                failure_config=FailureConfig(max_failures=1),
+            ),
+        ).fit()
+    finally:
+        cfg.train_rank_timeout_s = old
+    elapsed = time.monotonic() - t0
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 3
+    # The hung rank slept 600s; finishing fast proves the prompt kill.
+    assert elapsed < 120, f"gang waited out the hang: {elapsed:.0f}s"
+    evts = _train_events(reason="hang")
+    assert evts, "expected a WARNING TRAIN gang-abort event (hang)"
+
+
+def test_chaos_kill_mid_step_matches_uninterrupted(ray_tpu_start, tmp_path):
+    """THE acceptance run: gang=2 multi-process JaxTrainer, rank 1
+    killed mid-step via the train_worker fault point, restart from the
+    last committed checkpoint — final loss/step trajectory matches an
+    uninterrupted run's."""
+    from ray_tpu.core.runtime_context import current_runtime
+
+    steps = 8
+    baseline = JaxTrainer(
+        _make_elastic_loop(),
+        train_loop_config={"steps": steps, "step_sleep": 0.15},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path / "base")),
+    ).fit()
+    assert baseline.error is None, baseline.error
+
+    # Chaos run: discover the attempt's run id from its heartbeat keys,
+    # then arm a once-spec scoped to {rank 1, THAT run} — rank 1's
+    # second matching report raises an injected ConnectionError (a rank
+    # killed mid-step). The restarted attempt has a fresh run id, so
+    # the spec can never re-fire against it.
+    holder = {}
+    rt = current_runtime()
+    known = {k.split("/")[1] for k in rt.kv_keys("__train__/")
+             if len(k.split("/")) >= 2}
+
+    def run_chaotic():
+        holder["result"] = JaxTrainer(
+            _make_elastic_loop(),
+            train_loop_config={"steps": steps, "step_sleep": 0.15},
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                storage_path=str(tmp_path / "chaos"),
+                failure_config=FailureConfig(max_failures=1),
+            ),
+        ).fit()
+
+    t = threading.Thread(target=run_chaotic, daemon=True)
+    t.start()
+    run_id = None
+    deadline = time.time() + 30
+    while run_id is None and time.time() < deadline:
+        for key in rt.kv_keys("__train__/"):
+            parts = key.split("/")
+            if len(parts) >= 2 and parts[1] and parts[1] not in known:
+                run_id = parts[1]
+                break
+        time.sleep(0.05)
+    assert run_id, "no train run appeared in KV"
+    try:
+        _arm([{"point": "train_worker", "mode": "once", "n": 2,
+               "match": {"rank": "1", "run": run_id}}])
+        t.join(timeout=150)
+    finally:
+        _arm([])
+        faults.clear()
+    assert not t.is_alive(), "chaotic fit did not finish"
+    chaotic = holder["result"]
+    assert chaotic.error is None, chaotic.error
+    assert chaotic.metrics["step"] == baseline.metrics["step"]
+    assert chaotic.metrics["w"] == baseline.metrics["w"]
+    assert chaotic.checkpoint is not None and chaotic.checkpoint.is_committed()
+    # The injected kill is observable end to end: CHAOS firing + TRAIN
+    # restart events.
+    assert _train_events(), "expected TRAIN restart events"
+
+
+def test_chaos_checkpoint_io_falls_back_to_previous_commit(
+        ray_tpu_start, tmp_path):
+    """A checkpoint_io fault during save crashes the attempt; the gang
+    restarts from the PREVIOUS committed checkpoint (the torn save
+    never became 'latest') and completes."""
+    # Fires on the 4th save (step 3) of the single-rank run: committed
+    # steps 0-2 exist, so the restart resumes at step 3 and the fresh
+    # process makes only 2 more saves — below the once-spec's threshold.
+    _arm([{"point": "checkpoint_io", "mode": "once", "n": 4,
+           "match": {"op": "save"}}])
+    try:
+        result = JaxTrainer(
+            _make_elastic_loop(),
+            train_loop_config={"steps": 5},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                storage_path=str(tmp_path / "run"),
+                failure_config=FailureConfig(max_failures=1),
+            ),
+        ).fit()
+    finally:
+        _arm([])
+        faults.clear()
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 4
+    assert result.metrics["w"] == 5.0
+    final = latest_committed(str(tmp_path / "run"))
+    assert final is not None and final.manifest()["step"] == 4
+
+
+def test_preemption_signal_surfaces_in_session(ray_tpu_start):
+    from ray_tpu.core import preemption
+    from ray_tpu.train.session import TrainSession
+
+    sess = TrainSession(run_id="t1", world_rank=0, world_size=1,
+                        storage_dir="/tmp", start_checkpoint=None)
+    try:
+        assert sess.preemption is None
+        preemption.signal_local_drain("abcd1234")
+        sig = sess.preemption
+        assert sig is not None and sig.node_id == "abcd1234"
+        assert sess.preemption_requested()
+        # The gang-wide KV flag went up for the other ranks.
+        other = TrainSession(run_id="t1", world_rank=1, world_size=2,
+                             storage_dir="/tmp", start_checkpoint=None)
+        # Clear the (process-local) drain flag so `other` exercises the
+        # gang-wide KV path, not its own local branch.
+        preemption.clear_local_drain()
+        deadline = time.time() + 5
+        got = None
+        while time.time() < deadline and got is None:
+            other._preempt_checked = 0.0
+            got = other.preemption
+        assert got is not None and got.rank == 0
+        # Aborted drain (node_undrain): the raising rank retracts the
+        # gang flag and every rank's next poll sees the rollback — a
+        # rolled-back drain must not cost a whole-gang restart.
+        assert sess.preemption is None  # rank 0: local cleared -> retract
+        other._preempt_checked = 0.0
+        assert other.preemption is None
+    finally:
+        preemption.clear_local_drain()
+
+
+# ------------------------------------------- drain + rolling restart e2e
+
+
+@pytest.mark.slow
+def test_rolling_restart_under_active_fit_loses_at_most_one_step():
+    """ROADMAP item 3's second half: a rolling node replacement under an
+    active JaxTrainer.fit() — the gang sees node_draining, checkpoints
+    at the next step boundary, surrenders the node, and restarts on the
+    replacement, losing at most one step of work."""
+    from ray_tpu.cluster_utils import Cluster
+
+    with Cluster(head_resources={"CPU": 2}) as cluster:
+        cluster.add_node(num_cpus=4, resources={"trainer": 4})
+        steps = 24
+        inner = _make_elastic_loop()
+
+        def loop(config):
+            inner({"steps": 24, "step_sleep": 0.6})
+
+        holder = {}
+
+        def run_fit():
+            holder["result"] = JaxTrainer(
+                loop,
+                train_loop_config={},
+                scaling_config=ScalingConfig(
+                    num_workers=2,
+                    resources_per_worker={"CPU": 1, "trainer": 1},
+                ),
+                run_config=RunConfig(
+                    name="rolling-fit",
+                    failure_config=FailureConfig(max_failures=0),
+                ),
+            ).fit()
+
+        t = threading.Thread(target=run_fit, daemon=True)
+        t.start()
+        # Let the gang make some progress, then replace its node WHILE
+        # the loop is still running (the whole point of the test).
+        time.sleep(5.0)
+        replaced = cluster.rolling_restart()
+        assert len(replaced) == 1
+        t.join(timeout=180)
+        assert not t.is_alive(), "fit() did not finish after the roll"
+        result = holder["result"]
+        assert result.error is None, result.error
+        assert result.metrics["step"] == steps - 1
+        # Deterministic loop: w == step+1 everywhere proves resume-from-
+        # checkpoint; max one step re-executed == at most one step lost.
+        history = result.metrics_history
+        steps_seen = [m["step"] for m in history]
+        assert all(m["w"] == m["step"] + 1.0 for m in history)
+        dupes = len(steps_seen) - len(set(steps_seen))
+        assert dupes <= 1, f"lost more than one step: {steps_seen}"
+        evts = _train_events()
+        assert any("preempt" in e["message"] for e in evts), evts

@@ -573,6 +573,50 @@ def validate_overload_config():
     ]
 
 
+# ---- elastic train gang lint ----------------------------------------------
+# The train supervisor's metric surface (train/_telemetry.py) and config
+# knobs (README "Elastic & fault-tolerant training"); a rename/kind
+# change must fail CI, not dashboards.
+
+TRAIN_METRICS = {
+    "ray_tpu_train_restarts_total": "counter",
+    "ray_tpu_train_gang_aborts_total": "counter",
+    "ray_tpu_train_recovery_seconds": "histogram",
+    "ray_tpu_train_preemptions_total": "counter",
+    "ray_tpu_train_gang_size": "gauge",
+}
+
+TRAIN_CONFIG_KEYS = (
+    "train_rank_timeout_s", "train_heartbeat_interval_s",
+)
+
+
+def validate_train_metrics(declared):
+    failures = []
+    for name, kind in sorted(TRAIN_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: train gang-lifecycle metric not declared "
+                f"(train/_telemetry.py drifted from the documented "
+                f"surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    return failures
+
+
+def validate_train_config():
+    fields = _config_fields()
+    return [
+        f"core/config.py: train gang config key {key!r} missing from "
+        f"Config (documented knob drifted from the flag table)"
+        for key in TRAIN_CONFIG_KEYS if key not in fields
+    ]
+
+
 # The serve REQUEST-PATH modules (control-plane waits in controller.py /
 # api.py — deploys, drains, health checks — are exempt: they are not
 # bounded by a request's budget).
@@ -810,11 +854,13 @@ class ObsMetricsPass(Pass):
         failures += validate_actor_metrics(declared)
         failures += validate_overload_metrics(declared)
         failures += validate_native_pump_metrics(declared)
+        failures += validate_train_metrics(declared)
         failures += validate_transfer_config()
         failures += validate_actor_config()
         failures += validate_overload_config()
         failures += validate_profiler_config()
         failures += validate_drain_config()
+        failures += validate_train_config()
         self.stats = (f"{len(declared)} declared metric(s), "
                       f"{len(state['skipped'])} module(s) skipped at "
                       f"import")
