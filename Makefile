@@ -94,17 +94,19 @@ perf-transfer:
 	JAX_PLATFORMS=cpu $(PY) tools/run_transfer_bench.py
 
 # Direct actor-call plane bench: loaded + unloaded sync round-trips over
-# the direct channel (native pump engaged AND RTPU_NO_NATIVE=1 fallback)
-# vs the NM-mediated path, fallback-injection recovery, and the rpc
-# dispatch micro-bench — merged into PERF_r08.json.
+# the GIL-free dispatch core (native pump + pending/waiter tables
+# engaged AND RTPU_NO_NATIVE=1 fallback) vs the NM-mediated path, the
+# per-phase GIL-handoff probe, the 1M-queued drain row with driver RSS,
+# fallback-injection recovery, and the rpc dispatch micro-bench —
+# merged into PERF_r09.json.
 perf-actor:
-	JAX_PLATFORMS=cpu $(PY) tools/run_actor_bench.py PERF_r08.json
+	JAX_PLATFORMS=cpu $(PY) tools/run_actor_bench.py PERF_r09.json
 
 # Native frame-pump bench: codec microbench vs pickle on the compact
 # call frame, pump framing throughput, and the queued-task drain probe
-# — merged into PERF_r08.json beside the perf-actor record.
+# — merged into PERF_r09.json beside the perf-actor record.
 perf-native:
-	JAX_PLATFORMS=cpu $(PY) tools/run_native_bench.py PERF_r08.json
+	JAX_PLATFORMS=cpu $(PY) tools/run_native_bench.py PERF_r09.json
 
 native: $(EXT) $(PUMP_EXT)
 
@@ -138,7 +140,12 @@ build/rts_pump_test: $(PUMP_SRC) src/pump/rts_pump_test.cc src/pump/rts_pump.h
 
 # CI-ready native gate: every C++ unit test (store + pump) plain AND
 # under all three sanitizers — any report fails the target
-# (halt_on_error / -fno-sanitize-recover).
+# (halt_on_error / -fno-sanitize-recover). The pump test includes the
+# ISSUE 12 pending-table stress (a pipelined submitter parked on the
+# backpressure condvar vs a completer applying DONE frames, then an
+# injected channel death mid-stream with exactly-once accounting) —
+# the TSAN/ASAN/UBSAN builds are the lock-discipline gate for the
+# GIL-free dispatch core.
 native-test: build/rts_store_test build/rts_pump_test native-tsan native-asan native-ubsan
 	./build/rts_store_test
 	./build/rts_pump_test

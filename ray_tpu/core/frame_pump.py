@@ -29,6 +29,7 @@ control messages keep riding protocol.dumps_msg at the call sites.
 
 from __future__ import annotations
 
+import itertools
 import os
 import socket
 import struct
@@ -60,7 +61,8 @@ _NATIVE_FALLBACKS = _MetricCounter(
     "ray_tpu_native_fallbacks_total",
     "Channels (or frames) that dropped from the native frame pump back "
     "to the pure-Python path "
-    "(reason=disabled|unavailable|no_peer|tls|pump_error|codec_error)",
+    "(reason=disabled|unavailable|no_peer|tls|pump_error|codec_error"
+    "|table_error)",
     tag_keys=("reason",),
 )
 _PUMP_CHANNELS = _MetricGauge(
@@ -71,7 +73,7 @@ _PUMP_CHANNELS = _MetricGauge(
 _FALLBACK = {
     reason: _NATIVE_FALLBACKS.with_tags(reason=reason)
     for reason in ("disabled", "unavailable", "no_peer", "tls",
-                   "pump_error", "codec_error")
+                   "pump_error", "codec_error", "table_error")
 }
 _PUMP_GAUGE = _PUMP_CHANNELS.with_tags(pid=str(os.getpid()))
 
@@ -208,6 +210,39 @@ def new_seq_queue():
     if m is not None:
         return m.seq_queue()
     return PySeqQueue()
+
+
+def new_pending_table():
+    """Per-channel pending/replay table for the direct caller: native
+    (GIL-free pops, condvar backpressure, seq-ordered drain) when the
+    extension is loaded and the knob is on; :class:`PyPendingTable`
+    otherwise. ANY native construction error drops to the mirror,
+    counted as a ``table_error`` fallback — the two run the exact same
+    semantics (the fuzz test in tests/test_native_pump.py holds them
+    equivalent over random interleavings)."""
+    if not disabled():
+        m = _module()
+        if m is not None:
+            try:
+                return m.pending_table()
+            except Exception:
+                count_fallback("table_error")
+    return PyPendingTable()
+
+
+def new_waiter_table(cap: int = 8192):
+    """The runtime's oid -> waiter-entry directory: native (single
+    C-call operations, GIL-atomic — no Python lock round per call) or
+    the :class:`PyWaiterTable` mirror, same fallback ladder as
+    :func:`new_pending_table`."""
+    if not disabled():
+        m = _module()
+        if m is not None:
+            try:
+                return m.waiter_table(cap)
+            except Exception:
+                count_fallback("table_error")
+    return PyWaiterTable(cap)
 
 
 # ---- pure-Python codec mirror ----------------------------------------------
@@ -492,6 +527,154 @@ class PySeqQueue:
         return len(self._parked)
 
 
+# ---- pending/replay table fallback -----------------------------------------
+
+
+class PyPendingTable:
+    """Pure-Python mirror of the extension's PendingTable: the caller-
+    side unanswered-call bookkeeping of one direct channel (task-id ->
+    submit seq), with the DIRECT_MAX_UNANSWERED backpressure wait and
+    the seq-ordered failover drain. Behavior-identical to the native
+    table so ``RTPU_NO_NATIVE=1`` and TLS channels run the exact same
+    semantics (equivalence is fuzz-checked)."""
+
+    native = False
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._by_tid: Dict[bytes, int] = {}
+        self._failed = False
+        self._stats = {"adds": 0, "pops": 0, "applies": 0, "wakeups": 0,
+                       "misses": 0}
+
+    @property
+    def failed(self) -> bool:
+        with self._lock:
+            return self._failed
+
+    def add(self, tid: bytes, seq: int) -> int:
+        with self._lock:
+            self._by_tid[tid] = seq
+            self._stats["adds"] += 1
+            return len(self._by_tid)
+
+    def pop(self, tid: bytes) -> Optional[int]:
+        with self._lock:
+            seq = self._by_tid.pop(tid, None)
+            if seq is None:
+                self._stats["misses"] += 1
+                return None
+            self._stats["pops"] += 1
+            self._stats["wakeups"] += 1
+            self._not_full.notify_all()
+            return seq
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._by_tid)
+
+    def __len__(self) -> int:
+        return self.size()
+
+    def wait_below(self, cap: int, timeout_s: float) -> int:
+        with self._lock:
+            if len(self._by_tid) >= cap and not self._failed:
+                self._not_full.wait(timeout_s)
+            return len(self._by_tid)
+
+    def fail(self) -> None:
+        with self._lock:
+            self._failed = True
+            self._not_full.notify_all()
+
+    def drain(self) -> List[bytes]:
+        with self._lock:
+            out = sorted(self._by_tid.items(), key=lambda kv: kv[1])
+            self._by_tid.clear()
+            self._not_full.notify_all()
+            return [tid for tid, _seq in out]
+
+    def apply_done(self, payload: bytes) -> int:
+        """Pop every task id carried by a native DONE/DONE_BATCH
+        payload (0 for any other payload; ValueError on a malformed done
+        frame — mirroring the native parser)."""
+        if len(payload) < 2 or payload[0] != MAGIC or \
+                payload[1] not in (F_DONE, F_DONE_BATCH):
+            return 0
+        c = _Cursor(bytes(payload))
+        c.pos = 2
+        n = 1 if payload[1] == F_DONE else c.u32()
+        applied = 0
+        for _ in range(n):
+            tid = c.take(c.u8())
+            c.u8()  # flags
+            c.f64()  # duration
+            for _r in range(c.u32()):
+                c.take(c.u8())  # oid
+                c.take(c.u32())  # inline data
+            self.pop(tid)
+            applied += 1
+        with self._lock:
+            self._stats["applies"] += 1
+        return applied
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+
+# ---- waiter table fallback --------------------------------------------------
+
+
+class PyWaiterTable:
+    """Pure-Python mirror of the extension's WaiterTable: oid bytes ->
+    waiter entry in FIFO insertion order, with resolved-entry eviction
+    beyond ``cap`` (scan the 64 oldest, evict the resolved ones — one
+    slow in-flight call cannot pin the table's growth)."""
+
+    native = False
+
+    def __init__(self, cap: int = 8192):
+        from collections import OrderedDict
+
+        self._cap = max(1, int(cap))
+        self._lock = threading.Lock()
+        self._od: "OrderedDict[bytes, Any]" = OrderedDict()
+        self._resolved: set = set()
+
+    def put(self, key: bytes, entry) -> None:
+        with self._lock:
+            self._od[key] = entry
+            self._resolved.discard(key)
+            if len(self._od) > self._cap:
+                drop = [
+                    k for k in itertools.islice(iter(self._od), 64)
+                    if k in self._resolved
+                ]
+                for k in drop:
+                    del self._od[k]
+                    self._resolved.discard(k)
+
+    def get(self, key: bytes):
+        with self._lock:
+            return self._od.get(key)
+
+    def pop(self, key: bytes):
+        with self._lock:
+            self._resolved.discard(key)
+            return self._od.pop(key, None)
+
+    def mark_resolved(self, key: bytes) -> None:
+        with self._lock:
+            if key in self._od:
+                self._resolved.add(key)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._od)
+
+
 # ---- native framed connection ----------------------------------------------
 
 
@@ -546,6 +729,32 @@ class NativeFramedConnection(Connection):
             except (ConnectionError, TimeoutError, OSError) as e:
                 raise ConnectionClosed(str(e)) from e
         return loads_msg(payload)
+
+    def recv_burst(self, pending=None) -> Tuple[List[Dict[str, Any]],
+                                                List[bytes]]:
+        """Drain an arrived-together burst in ONE Python entry: the
+        first read blocks GIL-released, then every COMPLETE buffered
+        frame is sliced without re-entering Python. Native
+        DONE/DONE_BATCH frames are applied to ``pending`` (a native
+        PendingTable) and returned decoded in the first list; every
+        other payload returns raw in the second for the caller's
+        per-dialect dispatch. This is the GIL-free dispatch core's read
+        side (ISSUE 12): one interpreter entry per burst, not per
+        frame."""
+        with self._recv_lock:
+            try:
+                return self._chan.recv_burst(pending)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
+
+    def recv_many(self) -> List[bytes]:
+        """Raw burst drain (worker side): blocking first read plus
+        every buffered complete frame, one Python entry per burst."""
+        with self._recv_lock:
+            try:
+                return self._chan.recv_many()
+            except (ConnectionError, TimeoutError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
 
     def buffered(self) -> int:
         """Bytes read ahead of the consumed frames (reply-batching

@@ -36,6 +36,14 @@ DIRECT_PROTO_VER = 3  # v3: compact call frames carry "d" (deadline_ts)
 # cap is hit; the worker cache is sized at several multiples of this.
 DIRECT_MAX_UNANSWERED = 1024
 
+# Wait slice for a submitter parked on the unanswered-call cap. The
+# parked thread blocks on the pending table's OWN condition variable
+# (native: a C condvar with the GIL released; mirror: a
+# threading.Condition) and is signalled by the reader's completion pops
+# — the slice only bounds how often it re-checks channel liveness, so
+# a death that loses the wakeup cannot strand the submitter.
+DIRECT_BACKPRESSURE_WAIT_S = 0.25
+
 
 def dumps_msg(message: Any) -> bytes:
     """Serialize a control message. Hot path uses the C pickler (specs,

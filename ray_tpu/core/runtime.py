@@ -21,7 +21,8 @@ from .exceptions import GetTimeoutError, ObjectLostError, TaskError
 from .function_table import FunctionCache, export_function
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from .object_store import InlineLocation, LocalObjectStore, Location, ShmLocation
-from .protocol import DIRECT_MAX_UNANSWERED, DIRECT_PROTO_VER, dumps_msg
+from .protocol import (DIRECT_BACKPRESSURE_WAIT_S, DIRECT_MAX_UNANSWERED,
+                       DIRECT_PROTO_VER, dumps_msg)
 from . import frame_pump
 from .reference import ObjectRef, ref_without_registration
 from .serialization import serialize, serialize_with_refs
@@ -162,17 +163,19 @@ class BaseRuntime:
         self._function_ids: Dict[int, str] = {}
         # ---- direct actor-call plane state (before the flusher starts:
         # _flush_loop touches these) -----------------------------------
-        from collections import OrderedDict as _OD
-
         # actor_id bytes -> {"lock", "status": none|discovering|ready|
         # unsupported, "chan", "nm_seq"} — the ordering-preserving
         # switchover state machine (see _submit_actor_task).
         self._direct_states: Dict[bytes, Dict[str, Any]] = {}
         self._direct_states_lock = threading.Lock()
-        # oid -> _DirectResult; resolved entries are evicted FIFO beyond
-        # the cap (the object stays resolvable through the directory).
-        self._direct_waiters: "_OD[ObjectID, _DirectResult]" = _OD()
-        self._direct_waiters_lock = threading.Lock()
+        # oid bytes -> _DirectResult, in the native WaiterTable when the
+        # extension is loaded (every op is one GIL-atomic C call — no
+        # Python lock round per submit/get/wait) or its PyWaiterTable
+        # mirror. Resolved entries are evicted FIFO beyond the cap (the
+        # object stays resolvable through the directory).
+        self._direct_waiters = frame_pump.new_waiter_table(
+            self._DIRECT_WAITER_CAP
+        )
         self._dirty_chans: set = set()
         self._dirty_chans_lock = threading.Lock()
         # Local mirror of the fallback counter for cheap introspection
@@ -275,12 +278,12 @@ class BaseRuntime:
         rest_ids = []
         waiters = self._direct_waiters
         deadline = None if timeout is None else time.monotonic() + timeout
-        if not waiters:
+        if not len(waiters):
             # No direct calls outstanding anywhere: skip the per-oid
-            # waiter-table lock round (a 1M-ref drain get() would take
-            # the lock a million times for guaranteed misses). Entries
-            # only appear from this process's own direct submits, so the
-            # emptiness check cannot race a reply this get() cares about.
+            # waiter-table probes (a 1M-ref drain get() would probe a
+            # million times for guaranteed misses). Entries only appear
+            # from this process's own direct submits, so the emptiness
+            # check cannot race a reply this get() cares about.
             rest_ids = ids
             ids_iter = ()
         else:
@@ -289,8 +292,7 @@ class BaseRuntime:
         for oid in ids_iter:
             if oid in direct_vals:
                 continue
-            with self._direct_waiters_lock:
-                entry = waiters.get(oid)
+            entry = waiters.get(oid.binary())
             if entry is None:
                 rest_ids.append(oid)
                 continue
@@ -313,8 +315,7 @@ class BaseRuntime:
                     f"direct actor call result"
                 )
             value = self._resolve_direct(oid, entry)
-            with self._direct_waiters_lock:
-                waiters.pop(oid, None)
+            waiters.pop(oid.binary())
             if value is _REDIRECT:
                 rest_ids.append(oid)
             else:
@@ -459,9 +460,10 @@ class BaseRuntime:
         # round-trip the control plane (whose seal may trail the reply
         # by one completion-notification debounce window).
         ready_ids: set = set()
-        with self._direct_waiters_lock:
+        waiters = self._direct_waiters
+        if len(waiters):
             for r in refs:
-                e = self._direct_waiters.get(r.id())
+                e = waiters.get(r.id().binary())
                 if (e is not None and e.event.is_set()
                         and e.payload is not None
                         and not e.payload.get("redirect")):
@@ -597,13 +599,13 @@ class BaseRuntime:
             # ride the same connection: the worker would execute it
             # while the dependency's reply (and therefore its seal) may
             # still be sitting in a reply batch — route it through the
-            # NM, which gates dispatch on sealed deps. One lock
-            # round-trip for the whole dependency scan (per-call hot
-            # path; the reader contends on this lock at full call rate).
+            # NM, which gates dispatch on sealed deps. Each probe is one
+            # GIL-atomic table call (per-call hot path; the old Python
+            # lock here contended with the reader at full call rate).
             waiters = self._direct_waiters
-            with self._direct_waiters_lock:
+            if len(waiters):
                 for dep in spec.dependency_ids():
-                    entry = waiters.get(dep)
+                    entry = waiters.get(dep.binary())
                     if entry is not None and not entry.event.is_set():
                         eligible = False
                         break
@@ -788,10 +790,27 @@ class BaseRuntime:
         st = self._direct_state(chan.actor_id)
         with chan.plock:
             chan.failed = True  # later submits raise instead of stranding
-            pend = list(chan.pending.values())
-            chan.pending.clear()
             chan.out_buf = []
-            chan._pending_cv.notify_all()  # wake a capped submitter
+        # Wake a capped submitter (it re-checks chan.failed), then
+        # snapshot + clear the pending table in seq order — the replay
+        # contract: still-unanswered calls resubmit in the exact order
+        # they were sequenced, worker-side task-id dedup keeps them
+        # exactly-once.
+        chan.table.fail()
+        tids = chan.table.drain()
+        calls = chan._calls
+        pend = [c for c in (calls.pop(t, None) for t in tids)
+                if c is not None]
+        # Any call still in _calls was popped from the table by a burst
+        # the reader never delivered to Python (a native error between
+        # the GIL-free completion application and the waiter wakeups, or
+        # a batch malformed past its first bodies): the table alone
+        # cannot replay it, so sweep the rich-state dict too — _calls is
+        # the authority for WHAT replays, the table only for the order.
+        if calls:
+            pend.extend(calls.values())
+            calls.clear()
+            pend.sort(key=lambda c: c.seq)
         try:
             if chan.closed_by_us:
                 for call in pend:
@@ -800,10 +819,10 @@ class BaseRuntime:
                         "error": "actor died (direct channel closed)",
                     }
                     call.entry.event.set()
+                    self._direct_waiters.mark_resolved(call.oid.binary())
                 return
             if not pend:
                 return
-            pend.sort(key=lambda c: c.seq)
             self._direct_fallbacks += len(pend)
             _FALLBACK_CHANNEL.inc(len(pend))
             for call in pend:
@@ -813,8 +832,7 @@ class BaseRuntime:
                 # redirected read blocks on the replayed task's seal.
                 call.entry.payload = {"redirect": True}
                 call.entry.event.set()
-                with self._direct_waiters_lock:
-                    self._direct_waiters.pop(call.oid, None)
+                self._direct_waiters.pop(call.oid.binary())
                 # The direct registration pinned the args; the NM
                 # resubmit pins them again — release the direct pin.
                 self._direct_on_replay(call.dep_ids)
@@ -839,23 +857,21 @@ class BaseRuntime:
             chan.drained.set()
 
     def _direct_waiters_put(self, oid: ObjectID, entry: _DirectResult):
-        with self._direct_waiters_lock:
-            self._direct_waiters[oid] = entry
-            if len(self._direct_waiters) > self._DIRECT_WAITER_CAP:
-                # Evict resolved entries from the FIFO front (oldest
-                # first; the object stays resolvable through the
-                # directory). Unresolved entries are genuinely pending
-                # calls — SKIP them rather than stop, so one slow
-                # in-flight call cannot pin the table's growth under
-                # fire-and-forget load. The scan is bounded, keeping
-                # each insert O(1) amortized.
-                drop = [
-                    k
-                    for k in itertools.islice(iter(self._direct_waiters), 64)
-                    if self._direct_waiters[k].event.is_set()
-                ]
-                for k in drop:
-                    del self._direct_waiters[k]
+        # The table evicts RESOLVED entries from the FIFO front beyond
+        # its cap (oldest first; the object stays resolvable through
+        # the directory). Unresolved entries are genuinely pending
+        # calls and are skipped, so one slow in-flight call cannot pin
+        # the table's growth under fire-and-forget load. "Resolved" is
+        # stamped by mark_resolved at reply/failure time — the table
+        # never has to call back into Python to probe an Event.
+        key = oid.binary()
+        self._direct_waiters.put(key, entry)
+        if entry.event.is_set():
+            # The reply (or failure) beat this put: its mark_resolved
+            # found no entry and no-op'd. Re-stamp after insertion, or
+            # a fire-and-forget entry would sit unresolved forever and
+            # wedge the FIFO eviction scan once 64 such pile up.
+            self._direct_waiters.mark_resolved(key)
 
     def _mark_chan_dirty(self, chan: "_DirectChannel"):
         with self._dirty_chans_lock:
@@ -903,8 +919,10 @@ class BaseRuntime:
             chans = [st.get("chan") for st in self._direct_states.values()]
         for chan in chans:
             if chan is not None:
-                with chan.plock:
-                    n += len(chan.pending) + len(chan.out_buf)
+                # Both reads are single GIL-atomic ops; the table size
+                # lives off the GIL entirely (no plock round — the
+                # flusher must not contend with the submit hot path).
+                n += len(chan.table) + len(chan.out_buf)
         return n
 
     def direct_stats(self) -> Dict[str, Any]:
@@ -914,21 +932,41 @@ class BaseRuntime:
         with self._direct_states_lock:
             states = {k: dict(v) for k, v in self._direct_states.items()}
         calls = 0
+        py_entries = 0
+        frames_in = 0
+        completions = 0
+        native_tables = 0
         for key, st in states.items():
             chan = st.get("chan")
+            probe = chan.gil_probe() if chan is not None else {}
             if chan is not None:
                 calls += chan.calls
+                py_entries += probe.get("py_entries", 0)
+                frames_in += probe.get("frames_in", 0)
+                completions += probe.get("pending_table", {}).get("pops", 0)
+                if getattr(chan.table, "native", False):
+                    native_tables += 1
             chans.append({
                 "actor_id": key.hex(),
                 "status": st.get("status"),
                 "remote": bool(chan is not None and chan.remote),
                 "calls": chan.calls if chan is not None else 0,
+                **probe,
             })
         return {
             "channels": chans,
             "calls": calls,
             "inflight": self._direct_inflight(),
             "fallbacks": self._direct_fallbacks,
+            # GIL-handoff probe (ISSUE 12): interpreter entries the
+            # channel readers made vs frames they received — the
+            # dispatch core's burst coalescing makes entries << frames.
+            "gil_probe": {
+                "py_entries": py_entries,
+                "frames_in": frames_in,
+                "completions": completions,
+                "native_tables": native_tables,
+            },
         }
 
     # Subclass hooks for the direct plane. The base implementations are
@@ -1139,14 +1177,24 @@ class _DirectChannel:
         # on it so its NM-path submit cannot overtake the replays.
         self.drained = threading.Event()
         self.plock = threading.Lock()
-        # Wakes a submitter blocked on the unanswered-call cap (see
-        # submit) when replies drain pending or the channel fails.
-        self._pending_cv = threading.Condition(self.plock)
         # Serializes pop-buffer + socket-send so a fence frame can never
         # overtake frames a concurrent flush already popped but had not
         # yet written (the fence promise covers every EARLIER call).
         self._flush_lock = threading.Lock()
-        self.pending: Dict[TaskID, _PendingCall] = {}
+        # The pending/replay table: task-id -> submit seq, off the GIL
+        # in the extension (ISSUE 12). The DIRECT_MAX_UNANSWERED
+        # backpressure waits on ITS condvar (GIL released), the pump's
+        # reader pops it per completion without entering Python, and
+        # failover replay snapshots it in seq order. The rich per-call
+        # state (waiter entry, spec for replay, arg pins, t0) stays in
+        # _calls — a plain dict keyed by task-id bytes whose pops happen
+        # only on the reader thread (GIL-atomic; no lock round).
+        self.table = frame_pump.new_pending_table()
+        self._calls: Dict[bytes, _PendingCall] = {}
+        # GIL-handoff probe: interpreter entries the reader made vs
+        # frames received (see gil_probe()).
+        self.py_entries = 0
+        self.frames_rx = 0
         self.out_buf: List[Dict[str, Any]] = []
         self._fences: Dict[int, threading.Event] = {}
         self._fence_seq = itertools.count(1)
@@ -1175,17 +1223,19 @@ class _DirectChannel:
         # over the NM route, relying on the worker's replay-dedup cache
         # to keep methods exactly-once — so unanswered calls must never
         # outgrow what that cache can remember. The pending table is the
-        # single authority (replay needs it anyway); len() is
-        # GIL-atomic, so the pre-check skips the lock. Submitters are
+        # single authority (replay needs it anyway); its size read is
+        # one atomic call. The wait parks on the TABLE's condition
+        # (native: GIL released in the extension; the reader's GIL-free
+        # pops signal it) — never while holding plock. Submitters are
         # serialized per channel (the actor state lock), so one blocked
         # waiter here is the only writer.
-        full = len(self.pending) >= DIRECT_MAX_UNANSWERED
+        full = len(self.table) >= DIRECT_MAX_UNANSWERED
         if full:
             self.flush()  # the calls we wait on must reach the worker
-            with self._pending_cv:
-                while (len(self.pending) >= DIRECT_MAX_UNANSWERED
-                       and not self.failed and self.alive):
-                    self._pending_cv.wait(0.25)
+            while (len(self.table) >= DIRECT_MAX_UNANSWERED
+                   and not self.failed and self.alive):
+                self.table.wait_below(DIRECT_MAX_UNANSWERED,
+                                      DIRECT_BACKPRESSURE_WAIT_S)
         oid = spec.return_ids()[0]
         entry = _DirectResult(readable=self.store_readable, chan=self)
         dep_ids = list(spec.pinned_ids())
@@ -1249,9 +1299,11 @@ class _DirectChannel:
             else:
                 frame["q"] = seq
                 out = frame
-            self.pending[spec.task_id] = _PendingCall(
+            tidb = spec.task_id.binary()
+            self._calls[tidb] = _PendingCall(
                 oid, entry, dep_ids, spec, time.monotonic(), seq
             )
+            self.table.add(tidb, seq)
             self.out_buf.append(out)
             self.calls += 1
         self.rt._direct_waiters_put(oid, entry)
@@ -1335,10 +1387,15 @@ class _DirectChannel:
             raise ConnectionError("direct channel died during fence")
         return ok
 
-    def _on_reply(self, msg):
-        with self.plock:
-            call = self.pending.pop(msg["task_id"], None)
-            self._pending_cv.notify_all()
+    def _on_reply(self, msg, popped: bool = False):
+        """Apply one completion. ``popped=True`` on the burst path: the
+        pump already removed the entry from the pending table (GIL-free,
+        backpressure signalled) before Python was entered; only the
+        rich-state pop and the waiter wakeup remain."""
+        tidb = msg["task_id"].binary()
+        if not popped:
+            self.table.pop(tidb)
+        call = self._calls.pop(tidb, None)
         if call is None:
             return
         if self.remote:
@@ -1362,27 +1419,81 @@ class _DirectChannel:
         entry = call.entry
         entry.payload = msg
         entry.event.set()
+        self.rt._direct_waiters.mark_resolved(call.oid.binary())
         _CALL_SECONDS_DIRECT.observe(time.monotonic() - call.t0)
         self.rt._direct_on_done(msg, call.dep_ids, self)
 
-    def _reader(self):
-        from .protocol import ConnectionClosed
+    def gil_probe(self) -> Dict[str, int]:
+        """Interpreter entries the reader made vs frames received —
+        the ISSUE 12 probe run_actor_bench.py records per phase."""
+        out = {"py_entries": self.py_entries, "frames_in": self.frames_rx}
+        try:
+            out["frames_in"] = self.conn.pump_io_stats()["frames_in"]
+        except Exception:
+            pass
+        try:
+            out["pending_table"] = self.table.stats()
+        except Exception:
+            pass
+        return out
 
+    def _dispatch(self, msg):
+        mtype = msg.get("type")
+        if mtype == "task_done":
+            self._on_reply(msg)
+            self.rt._direct_flush_side()
+        elif mtype == "task_done_batch":
+            for item in msg["items"]:
+                self._on_reply(item)
+            self.rt._direct_flush_side()
+        elif mtype == "fence_ack":
+            ev = self._fences.pop(msg.get("msg_id"), None)
+            if ev is not None:
+                ev.set()
+
+    def _reader(self):
+        from .protocol import ConnectionClosed, loads_msg
+
+        # Burst mode (the GIL-free dispatch core, ISSUE 12): the pump
+        # reads a whole arrived-together burst and applies its native
+        # completions to the pending table BEFORE re-entering Python —
+        # one interpreter entry per burst, waiter wakeups delivered as
+        # one coalesced batch. Needs the native channel AND the native
+        # table; any non-connection error drops this channel to the
+        # per-frame mirror path (counted), never to a wrong answer.
+        use_burst = bool(self.native
+                         and getattr(self.table, "native", False)
+                         and hasattr(self.conn, "recv_burst"))
         try:
             while True:
-                msg = self.conn.recv()
-                mtype = msg.get("type")
-                if mtype == "task_done":
-                    self._on_reply(msg)
-                    self.rt._direct_flush_side()
-                elif mtype == "task_done_batch":
-                    for item in msg["items"]:
-                        self._on_reply(item)
-                    self.rt._direct_flush_side()
-                elif mtype == "fence_ack":
-                    ev = self._fences.pop(msg.get("msg_id"), None)
-                    if ev is not None:
-                        ev.set()
+                if use_burst:
+                    try:
+                        dones, others = self.conn.recv_burst(self.table)
+                    except (ConnectionClosed, OSError, EOFError):
+                        raise
+                    except Exception:
+                        # A native error here may have consumed frames
+                        # whose completions were already popped from the
+                        # pending table — continuing on this channel
+                        # would strand them. Fail the channel instead:
+                        # the failure path sweeps _calls (not just the
+                        # table) and replays everything unanswered over
+                        # the NM route exactly-once.
+                        frame_pump.count_fallback("pump_error")
+                        raise
+                    self.py_entries += 1
+                    self.frames_rx += len(others) + (1 if dones else 0)
+                    for item in dones:
+                        self._on_reply(item, popped=True)
+                    for payload in others:
+                        self._dispatch(loads_msg(payload))
+                    if dones:
+                        self.rt._direct_flush_side()
+                else:
+                    msg = self.conn.recv()
+                    self.py_entries += 1
+                    self.frames_rx += 1
+                    self._dispatch(msg)
         except (ConnectionClosed, OSError, EOFError):
             pass
         except Exception:

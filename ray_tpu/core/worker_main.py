@@ -668,9 +668,18 @@ class Worker:
         # executing, so an arrived-together burst processes — and
         # answers — as one batch, while a frame arriving mid-execution
         # can never defer an already-finished call's reply behind its
-        # own (possibly long) execution.
-        has_frame = (conn.has_frame if getattr(conn, "native", False)
-                     else lambda: False)
+        # own (possibly long) execution. recv_many does the whole drain
+        # in ONE interpreter entry (first read blocks GIL-released,
+        # buffered frames slice out in C) — the worker-side half of the
+        # ISSUE 12 GIL-handoff cut.
+        if getattr(conn, "native", False):
+            from .protocol import loads_msg as _loads
+
+            def recv_batch():
+                return [_loads(p) for p in conn.recv_many()]
+        else:
+            def recv_batch():
+                return [conn.recv()]
 
         def ack_fence(msg_id):
             # The ack promises every earlier frame on this connection
@@ -693,10 +702,9 @@ class Worker:
 
         try:
             while self._alive:
-                msg = conn.recv()
                 items: list = []
                 fences: list = []
-                while True:
+                for msg in recv_batch():
                     mtype = msg.get("type")
                     if mtype == "execute":
                         items.append(msg)
@@ -708,9 +716,6 @@ class Worker:
                         # frames (later ones executing too only makes
                         # the promise stronger).
                         fences.append(msg.get("msg_id"))
-                    if not has_frame():
-                        break
-                    msg = conn.recv()
                 if items:
                     if seqq.parked > 4096:
                         return  # runaway gap: drop the connection

@@ -768,6 +768,12 @@ def _print_actor_section() -> None:
         coalesce = f"{dones / batches:.1f}x" if batches else "-"
         print(f"  this node nm  : dones={dones} batches={batches} "
               f"coalesce={coalesce}")
+    gp = st.get("gil_probe")
+    if gp and gp.get("frames_in"):
+        print(f"  gil probe     : py_entries={gp['py_entries']} "
+              f"frames_in={gp['frames_in']} "
+              f"completions={gp.get('completions', 0)} "
+              f"native_tables={gp.get('native_tables', 0)}")
     if st["channels"]:
         for ch in st["channels"]:
             print(f"  channel       : actor={ch['actor_id'][:8]} "

@@ -129,8 +129,13 @@ class LLMEngine:
         # paging cost scale with pool size). Jit through the instrumented
         # compile path: serving recompiles (shape changes, evictions)
         # surface as ray_tpu_device_jit_* series instead of silent
-        # latency spikes.
-        self._decode = instrumented_jit(decode_step, donate_argnums=(1,))
+        # latency spikes. The per-token tap rides a ring flushed once
+        # every 64 steps (and at every burst boundary — see _loop /
+        # stats), not per token: polling the executable cache around
+        # every [B,1] decode step was the remaining slice of the
+        # 695→652 tok/s regression (PERF_r06, partially recovered).
+        self._decode = instrumented_jit(decode_step, donate_argnums=(1,),
+                                        tap_stride=64)
 
         def prefill(params, cache, tokens, real_len, slot, pages):
             logits, cache = paged_prefill(
@@ -175,6 +180,9 @@ class LLMEngine:
         return self.submit(prompt, max_new_tokens, eos_token).result(timeout)
 
     def stats(self) -> Dict[str, Any]:
+        # Telemetry read: publish whatever the decode tap ring has
+        # accumulated so /metrics never lags a long burst.
+        self._decode.flush_taps()
         with self._lock:
             return {
                 "active_slots": len(self._slot_req),
@@ -188,6 +196,10 @@ class LLMEngine:
     def shutdown(self):
         self._stop = True
         self._thread.join(timeout=5)
+        try:
+            self._decode.flush_taps()
+        except Exception:
+            pass
 
     # ---- page accounting ---------------------------------------------------
 
@@ -313,6 +325,9 @@ class LLMEngine:
             with self._lock:
                 active_slots = dict(self._slot_req)
             if not active_slots:
+                # Burst boundary: the decode loop went idle — flush the
+                # batched metric taps accumulated over the burst.
+                self._decode.flush_taps()
                 time.sleep(0.002)
                 continue
             active = np.zeros((self.max_batch,), dtype=bool)

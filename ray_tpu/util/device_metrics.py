@@ -234,7 +234,8 @@ def record_collective(op: str, nbytes: Optional[int] = None) -> None:
         COLLECTIVE_BYTES.inc(float(nbytes), tags=tags)
 
 
-def instrumented_jit(fn, *, sample_memory: bool = False, **jit_kwargs):
+def instrumented_jit(fn, *, sample_memory: bool = False,
+                     tap_stride: int = 1, **jit_kwargs):
     """``jax.jit`` with compile telemetry: calls that grow the jitted
     function's executable cache (a trace+compile happened) bump the
     compile counter and attribute the call's wall time to cumulative
@@ -250,14 +251,25 @@ def instrumented_jit(fn, *, sample_memory: bool = False, **jit_kwargs):
     off: the decode hot loop calls this wrapper once per generated token
     and must not pay a lock per call (the 695→652 tok/s regression).
 
+    ``tap_stride=N`` (N>1) batches the per-call tap into a ring flushed
+    once every N calls — the decode-loop wiring (ISSUE 12 satellite):
+    instead of polling the executable cache around EVERY token step,
+    the wrapper accumulates the window's slowest call and polls once
+    per flush. A compile inside the window is still detected (cache
+    growth is persistent) and its wall time attributed from the
+    window's slowest call — which IS the compiling call, orders of
+    magnitude over a steady step. ``wrapped.flush_taps()`` forces a
+    flush at a burst boundary (the serve engine calls it when the
+    decode loop goes idle), so telemetry lags by at most one burst,
+    never indefinitely.
+
     The wrapper sits INSIDE decode hot loops (one call per generated
     token), so the steady-state tap is kept minimal: metric handles and
     tags resolve once (``with_tags`` bound recorders, created lazily on
     the first compile — by then the runtime's node id is known), and the
-    executable-cache size is polled once per call against a remembered
-    value instead of twice around it. The serve regression traced to
-    exactly this tap (695 -> 652 tok/s when it re-resolved handles per
-    token).
+    executable-cache size is polled against a remembered value instead
+    of twice around each call. The serve regression traced to exactly
+    this tap (695 -> 652 tok/s when it re-resolved handles per token).
     """
     import functools
 
@@ -283,18 +295,58 @@ def instrumented_jit(fn, *, sample_memory: bool = False, **jit_kwargs):
                 lambda *args, **kwargs: jitted(*args, **kwargs)
             )
         wrapped.__wrapped_jit__ = jitted
+        wrapped.flush_taps = lambda: None
         return wrapped
 
-    # [last_seen_cache_size, bound_compiles, bound_seconds]; a mutable
-    # cell instead of nonlocal keeps the closure allocation-free per call.
-    state = [None, None, None]
+    # [last_seen_cache_size, bound_compiles, bound_seconds, countdown,
+    # window_max_dt]; a mutable cell instead of nonlocal keeps the
+    # closure allocation-free per call. The flush (stride boundary OR
+    # an external stats()/shutdown thread) serializes on _flush_lock so
+    # two concurrent flushes cannot double-count a compile against the
+    # same stale before-size — the per-call path stays lock-free.
+    state = [None, None, None, tap_stride, 0.0]
+    _flush_lock = threading.Lock()
+
+    def _flush_taps():
+        """Poll the executable cache once for the whole window and
+        publish any compile it detected. Safe to call from any thread
+        at any burst boundary; resets the ring."""
+        with _flush_lock:
+            _flush_taps_locked()
+
+    def _flush_taps_locked():
+        state[3] = tap_stride
+        before = state[0]
+        if before is None or before < 0:
+            return
+        try:
+            after = cache_size()
+        except Exception:
+            state[0] = -1
+            return
+        state[0] = after
+        window_dt, state[4] = state[4], 0.0
+        if after > before:
+            if state[1] is None:
+                tags = {"node": node_tag(), "fn": name}
+                state[1] = JIT_COMPILES.with_tags(**tags)
+                state[2] = JIT_COMPILE_SECONDS.with_tags(**tags)
+            state[1].inc(after - before)
+            state[2].inc(window_dt)
+            if sample_memory:
+                try:
+                    sample(force=True)
+                except Exception:
+                    pass
+        elif sample_memory:
+            maybe_sample()
 
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
         before = state[0]
         if before is None:
             try:
-                before = cache_size()
+                before = state[0] = cache_size()
             except Exception:
                 # Introspection broken: record nothing, stop polling.
                 state[0] = -1
@@ -303,29 +355,14 @@ def instrumented_jit(fn, *, sample_memory: bool = False, **jit_kwargs):
             return jitted(*args, **kwargs)
         t0 = time.perf_counter()
         out = jitted(*args, **kwargs)
-        try:
-            after = cache_size()
-        except Exception:
-            state[0] = -1
-            return out
-        state[0] = after
-        if after > before:
-            if state[1] is None:
-                tags = {"node": node_tag(), "fn": name}
-                state[1] = JIT_COMPILES.with_tags(**tags)
-                state[2] = JIT_COMPILE_SECONDS.with_tags(**tags)
-            state[1].inc(after - before)
-            state[2].inc(time.perf_counter() - t0)
-            if sample_memory:
-                # Fresh executable: its arena reservation is the
-                # interesting datapoint — publish unconditionally.
-                try:
-                    sample(force=True)
-                except Exception:
-                    pass
-        elif sample_memory:
-            maybe_sample()
+        dt = time.perf_counter() - t0
+        if dt > state[4]:
+            state[4] = dt
+        state[3] -= 1
+        if state[3] <= 0:
+            _flush_taps()
         return out
 
     wrapped.__wrapped_jit__ = jitted  # AOT API (lower/compile) passthrough
+    wrapped.flush_taps = _flush_taps
     return wrapped

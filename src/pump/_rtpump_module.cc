@@ -18,11 +18,33 @@
 //              TaskID, InlineLocation) are injected once via
 //              register_types() — this module never imports pickle.
 //
+//   PendingTable — the caller-side pending/replay table of one direct
+//              channel off the GIL (ISSUE 12): task-id -> seq map with
+//              native condvar backpressure (wait_below releases the
+//              GIL), seq-ordered failover drain, and GIL-free
+//              completion application from DONE/DONE_BATCH payloads.
+//   WaiterTable — the runtime's oid -> waiter-entry directory without a
+//              Python lock round per call: every operation is one C
+//              call (GIL-atomic), with the FIFO resolved-entry eviction
+//              of the old OrderedDict path preserved.
+//   Chan.recv_burst / recv_many — drain an arrived-together burst of
+//              frames in ONE Python entry: the first read blocks with
+//              the GIL released, buffered complete frames are sliced
+//              out without re-entering Python between them, and
+//              recv_burst applies native completions to a PendingTable
+//              before the GIL is retaken.
+//
 // pybind11 is not available in this environment; plain CPython C API.
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
 #include <string.h>
+
+#include <deque>
+#include <new>
+#include <string>
+#include <unordered_map>
+#include <vector>
 
 #include "rts_pump.h"
 
@@ -240,7 +262,21 @@ PyObject* Chan_stats(ChanObject* self, PyObject*) {
   return d;
 }
 
+// Implemented after the codec section (they reuse decode_done_body and
+// the PendingTable type defined below).
+PyObject* Chan_recv_burst(ChanObject* self, PyObject* args);
+PyObject* Chan_recv_many(ChanObject* self, PyObject* args);
+
 PyMethodDef Chan_methods[] = {
+    {"recv_burst", (PyCFunction)Chan_recv_burst, METH_VARARGS,
+     "recv_burst(pending=None, max_frames=1024) -> (dones, others): "
+     "blocking first read then every buffered complete frame, ONE "
+     "Python entry for the burst. Native DONE/DONE_BATCH payloads are "
+     "applied to the pending table and decoded into the dones list "
+     "(flattened); every other payload returns raw in others."},
+    {"recv_many", (PyCFunction)Chan_recv_many, METH_VARARGS,
+     "recv_many(max_frames=1024) -> [payload, ...]: blocking first "
+     "read then every buffered complete frame, one Python entry"},
     {"recv", (PyCFunction)Chan_recv, METH_NOARGS,
      "recv() -> bytes payload of the next frame (GIL released; raises "
      "ConnectionError on close, TimeoutError on SO_RCVTIMEO expiry)"},
@@ -352,6 +388,369 @@ PyObject* mod_seq_queue(PyObject*, PyObject*) {
     return nullptr;
   }
   self->q = q;
+  return (PyObject*)self;
+}
+
+// ---- PendingTable ----------------------------------------------------------
+
+struct PendObject {
+  PyObject_HEAD
+  rtp_pend* p;
+};
+
+extern PyTypeObject PendType;
+
+void Pend_dealloc(PendObject* self) {
+  if (self->p) {
+    rtp_pend_free(self->p);
+    self->p = nullptr;
+  }
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+PyObject* Pend_add(PendObject* self, PyObject* args) {
+  Py_buffer tid;
+  unsigned long long seq;
+  if (!PyArg_ParseTuple(args, "y*K", &tid, &seq)) return nullptr;
+  size_t n = rtp_pend_add(self->p, (const uint8_t*)tid.buf,
+                          (size_t)tid.len, seq);
+  PyBuffer_Release(&tid);
+  return PyLong_FromSize_t(n);
+}
+
+PyObject* Pend_pop(PendObject* self, PyObject* arg) {
+  Py_buffer tid;
+  if (PyObject_GetBuffer(arg, &tid, PyBUF_SIMPLE) != 0) return nullptr;
+  uint64_t seq = 0;
+  int found = rtp_pend_pop(self->p, (const uint8_t*)tid.buf,
+                           (size_t)tid.len, &seq);
+  PyBuffer_Release(&tid);
+  if (!found) Py_RETURN_NONE;
+  return PyLong_FromUnsignedLongLong(seq);
+}
+
+PyObject* Pend_size(PendObject* self, PyObject*) {
+  return PyLong_FromSize_t(rtp_pend_size(self->p));
+}
+
+Py_ssize_t Pend_len(PendObject* self) {
+  return (Py_ssize_t)rtp_pend_size(self->p);
+}
+
+PyObject* Pend_wait_below(PendObject* self, PyObject* args) {
+  unsigned long long cap;
+  double timeout_s;
+  if (!PyArg_ParseTuple(args, "Kd", &cap, &timeout_s)) return nullptr;
+  int ms = (int)(timeout_s * 1000.0);
+  if (ms < 0) ms = 0;
+  size_t n;
+  Py_BEGIN_ALLOW_THREADS
+  n = rtp_pend_wait_below(self->p, (size_t)cap, ms);
+  Py_END_ALLOW_THREADS
+  return PyLong_FromSize_t(n);
+}
+
+PyObject* Pend_fail(PendObject* self, PyObject*) {
+  rtp_pend_fail(self->p);
+  Py_RETURN_NONE;
+}
+
+PyObject* Pend_drain(PendObject* self, PyObject*) {
+  size_t n = rtp_pend_drain_begin(self->p);
+  PyObject* out = PyList_New((Py_ssize_t)n);
+  if (!out) return nullptr;
+  const uint8_t* tid;
+  size_t tid_len;
+  uint64_t seq;
+  Py_ssize_t i = 0;
+  while (rtp_pend_drain_next(self->p, &tid, &tid_len, &seq)) {
+    if (i >= (Py_ssize_t)n) break;  // cannot happen: drain is exclusive
+    PyObject* b = PyBytes_FromStringAndSize((const char*)tid,
+                                            (Py_ssize_t)tid_len);
+    if (!b) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i++, b);
+  }
+  if (i != (Py_ssize_t)n && PyList_SetSlice(out, i, (Py_ssize_t)n,
+                                            nullptr) != 0) {
+    Py_DECREF(out);
+    return nullptr;
+  }
+  return out;
+}
+
+PyObject* Pend_apply_done(PendObject* self, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) return nullptr;
+  int n;
+  Py_BEGIN_ALLOW_THREADS
+  n = rtp_pend_apply_done(self->p, (const uint8_t*)view.buf,
+                          (size_t)view.len);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&view);
+  if (n < 0) {
+    PyErr_SetString(PyExc_ValueError, "malformed native frame");
+    return nullptr;
+  }
+  return PyLong_FromLong(n);
+}
+
+PyObject* Pend_stats(PendObject* self, PyObject*) {
+  static const char* names[5] = {"adds", "pops", "applies", "wakeups",
+                                 "misses"};
+  PyObject* d = PyDict_New();
+  if (!d) return nullptr;
+  for (int i = 0; i < 5; ++i) {
+    PyObject* v = PyLong_FromLongLong(rtp_pend_counter(self->p, i));
+    if (!v || PyDict_SetItemString(d, names[i], v) != 0) {
+      Py_XDECREF(v);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(v);
+  }
+  return d;
+}
+
+PyObject* Pend_native(PendObject*, void*) { Py_RETURN_TRUE; }
+
+PyObject* Pend_failed(PendObject* self, void*) {
+  return PyBool_FromLong(rtp_pend_failed(self->p));
+}
+
+PyMethodDef Pend_methods[] = {
+    {"add", (PyCFunction)Pend_add, METH_VARARGS,
+     "add(task_id, seq) -> new table size"},
+    {"pop", (PyCFunction)Pend_pop, METH_O,
+     "pop(task_id) -> seq | None (wakes a capped submitter)"},
+    {"size", (PyCFunction)Pend_size, METH_NOARGS, "size() -> int"},
+    {"wait_below", (PyCFunction)Pend_wait_below, METH_VARARGS,
+     "wait_below(cap, timeout_s) -> size at wake (GIL released; wakes "
+     "early when the table fails or drains below cap)"},
+    {"fail", (PyCFunction)Pend_fail, METH_NOARGS,
+     "fail() -> mark failed and wake every capped submitter"},
+    {"drain", (PyCFunction)Pend_drain, METH_NOARGS,
+     "drain() -> [task_id, ...] snapshot in seq order; table cleared"},
+    {"apply_done", (PyCFunction)Pend_apply_done, METH_O,
+     "apply_done(payload) -> entries popped from a DONE/DONE_BATCH "
+     "frame (0 for non-done payloads; GIL released)"},
+    {"stats", (PyCFunction)Pend_stats, METH_NOARGS,
+     "stats() -> {adds, pops, applies, wakeups, misses}"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyGetSetDef Pend_getset[] = {
+    {"native", (getter)Pend_native, nullptr,
+     "True: this table runs in the extension", nullptr},
+    {"failed", (getter)Pend_failed, nullptr,
+     "the table was marked failed (channel death)", nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr},
+};
+
+PySequenceMethods Pend_as_sequence = {};
+
+PyTypeObject PendType = {PyVarObject_HEAD_INIT(nullptr, 0)};
+
+PyObject* mod_pending_table(PyObject*, PyObject*) {
+  rtp_pend* p = rtp_pend_new();
+  if (!p) return PyErr_NoMemory();
+  PendObject* self = PyObject_New(PendObject, &PendType);
+  if (!self) {
+    rtp_pend_free(p);
+    return nullptr;
+  }
+  self->p = p;
+  return (PyObject*)self;
+}
+
+// ---- WaiterTable -----------------------------------------------------------
+//
+// oid bytes -> waiter entry (an arbitrary Python object), FIFO-ordered
+// with resolved-entry eviction beyond a cap: the native replacement for
+// runtime.py's OrderedDict + threading.Lock pair. Every operation is a
+// single C call, so the GIL itself provides the atomicity the Python
+// lock used to — no lock round per submit/get/wait.
+
+struct WtEntry {
+  std::string key;
+  PyObject* obj;
+  bool resolved;
+  bool dead;
+};
+
+struct WaiterObject {
+  PyObject_HEAD
+  std::unordered_map<std::string, WtEntry*>* map;
+  std::deque<WtEntry*>* fifo;
+  Py_ssize_t cap;
+  Py_ssize_t dead_count;  // tombstones still sitting in the fifo
+};
+
+extern PyTypeObject WaiterType;
+
+void Waiter_dealloc(WaiterObject* self) {
+  if (self->fifo) {
+    for (WtEntry* e : *self->fifo) {
+      if (!e->dead) Py_XDECREF(e->obj);
+      delete e;
+    }
+    delete self->fifo;
+    self->fifo = nullptr;
+  }
+  delete self->map;
+  self->map = nullptr;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+// Drop dead tombstones off the FIFO front so eviction scans stay O(64);
+// when mid-queue tombstones outnumber live entries (one stuck call at
+// the front would otherwise let them accumulate forever), rebuild the
+// deque — amortized O(1) per pop.
+void waiter_compact(WaiterObject* self) {
+  while (!self->fifo->empty() && self->fifo->front()->dead) {
+    delete self->fifo->front();
+    self->fifo->pop_front();
+    --self->dead_count;
+  }
+  if (self->dead_count > (Py_ssize_t)self->map->size() + 64) {
+    std::deque<WtEntry*> keep;
+    for (WtEntry* e : *self->fifo) {
+      if (e->dead)
+        delete e;
+      else
+        keep.push_back(e);
+    }
+    self->fifo->swap(keep);
+    self->dead_count = 0;
+  }
+}
+
+PyObject* Waiter_put(WaiterObject* self, PyObject* args) {
+  Py_buffer key;
+  PyObject* obj;
+  if (!PyArg_ParseTuple(args, "y*O", &key, &obj)) return nullptr;
+  std::string k((const char*)key.buf, (size_t)key.len);
+  PyBuffer_Release(&key);
+  waiter_compact(self);
+  auto it = self->map->find(k);
+  if (it != self->map->end()) {
+    // Same key again keeps its FIFO position (OrderedDict semantics).
+    PyObject* old = it->second->obj;
+    Py_INCREF(obj);
+    it->second->obj = obj;
+    it->second->resolved = false;
+    Py_DECREF(old);
+    Py_RETURN_NONE;
+  }
+  WtEntry* e = new (std::nothrow) WtEntry{std::move(k), obj, false, false};
+  if (!e) return PyErr_NoMemory();
+  Py_INCREF(obj);
+  self->fifo->push_back(e);
+  (*self->map)[e->key] = e;
+  if ((Py_ssize_t)self->map->size() > self->cap) {
+    // Evict RESOLVED entries from the FIFO front (bounded scan, oldest
+    // first); unresolved entries are live calls and are skipped.
+    std::vector<PyObject*> drop;
+    int scanned = 0;
+    for (WtEntry* cand : *self->fifo) {
+      if (cand->dead) continue;
+      if (++scanned > 64) break;
+      if (cand->resolved) {
+        drop.push_back(cand->obj);
+        cand->dead = true;
+        ++self->dead_count;
+        self->map->erase(cand->key);
+      }
+    }
+    waiter_compact(self);
+    for (PyObject* o : drop) Py_DECREF(o);
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject* Waiter_get(WaiterObject* self, PyObject* arg) {
+  Py_buffer key;
+  if (PyObject_GetBuffer(arg, &key, PyBUF_SIMPLE) != 0) return nullptr;
+  auto it = self->map->find(
+      std::string((const char*)key.buf, (size_t)key.len));
+  PyBuffer_Release(&key);
+  if (it == self->map->end()) Py_RETURN_NONE;
+  Py_INCREF(it->second->obj);
+  return it->second->obj;
+}
+
+PyObject* Waiter_pop(WaiterObject* self, PyObject* arg) {
+  Py_buffer key;
+  if (PyObject_GetBuffer(arg, &key, PyBUF_SIMPLE) != 0) return nullptr;
+  auto it = self->map->find(
+      std::string((const char*)key.buf, (size_t)key.len));
+  PyBuffer_Release(&key);
+  if (it == self->map->end()) Py_RETURN_NONE;
+  WtEntry* e = it->second;
+  self->map->erase(it);
+  e->dead = true;
+  ++self->dead_count;
+  PyObject* obj = e->obj;  // transfer the table's ref to the caller
+  waiter_compact(self);
+  return obj;
+}
+
+PyObject* Waiter_mark_resolved(WaiterObject* self, PyObject* arg) {
+  Py_buffer key;
+  if (PyObject_GetBuffer(arg, &key, PyBUF_SIMPLE) != 0) return nullptr;
+  auto it = self->map->find(
+      std::string((const char*)key.buf, (size_t)key.len));
+  PyBuffer_Release(&key);
+  if (it != self->map->end()) it->second->resolved = true;
+  Py_RETURN_NONE;
+}
+
+Py_ssize_t Waiter_len(WaiterObject* self) {
+  return (Py_ssize_t)self->map->size();
+}
+
+PyObject* Waiter_native(WaiterObject*, void*) { Py_RETURN_TRUE; }
+
+PyMethodDef Waiter_methods[] = {
+    {"put", (PyCFunction)Waiter_put, METH_VARARGS,
+     "put(key, entry) -> None (evicts resolved entries beyond cap)"},
+    {"get", (PyCFunction)Waiter_get, METH_O, "get(key) -> entry | None"},
+    {"pop", (PyCFunction)Waiter_pop, METH_O, "pop(key) -> entry | None"},
+    {"mark_resolved", (PyCFunction)Waiter_mark_resolved, METH_O,
+     "mark_resolved(key) -> None (entry becomes evictable)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyGetSetDef Waiter_getset[] = {
+    {"native", (getter)Waiter_native, nullptr,
+     "True: this table runs in the extension", nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr},
+};
+
+PySequenceMethods Waiter_as_sequence = {};
+
+PyTypeObject WaiterType = {PyVarObject_HEAD_INIT(nullptr, 0)};
+
+PyObject* mod_waiter_table(PyObject*, PyObject* args) {
+  Py_ssize_t cap = 8192;
+  if (!PyArg_ParseTuple(args, "|n", &cap)) return nullptr;
+  if (cap < 1) cap = 1;
+  WaiterObject* self = PyObject_New(WaiterObject, &WaiterType);
+  if (!self) return nullptr;
+  self->map = new (std::nothrow) std::unordered_map<std::string, WtEntry*>();
+  self->fifo = new (std::nothrow) std::deque<WtEntry*>();
+  self->cap = cap;
+  self->dead_count = 0;
+  if (!self->map || !self->fifo) {
+    delete self->map;
+    delete self->fifo;
+    self->map = nullptr;
+    self->fifo = nullptr;
+    Py_DECREF(self);
+    return PyErr_NoMemory();
+  }
   return (PyObject*)self;
 }
 
@@ -885,6 +1284,174 @@ PyObject* decode_done_body(rtp_rbuf* r) {
   return out;
 }
 
+// ---- burst receive ---------------------------------------------------------
+
+// Read every available frame into `out` without the GIL: the first read
+// blocks; afterwards only COMPLETE buffered frames are sliced (never a
+// partial — the loop cannot stall mid-burst). An error after the first
+// frame returns what was collected; the stream error surfaces on the
+// next call.
+int burst_read_frames(rtp_chan* c, std::vector<std::string>& out,
+                      unsigned long max_frames) {
+  bool first = true;
+  while (out.size() < max_frames) {
+    if (!first && !rtp_chan_has_frame(c)) break;
+    const uint8_t* ptr = nullptr;
+    uint32_t len = 0;
+    int rc = rtp_chan_next(c, &ptr, &len);
+    if (rc == RTP_BIG) {
+      std::string buf;
+      buf.resize(len);
+      rc = rtp_chan_read_exact(c, (uint8_t*)&buf[0], len);
+      if (rc != RTP_OK)
+        // Mid-payload failure: framing is lost; big_remaining stays
+        // nonzero so the NEXT read reports the dead channel.
+        return first ? RTP_ERR : RTP_OK;
+      out.push_back(std::move(buf));
+    } else if (rc == RTP_OK) {
+      out.emplace_back((const char*)ptr, (size_t)len);
+    } else {
+      return first ? rc : RTP_OK;
+    }
+    first = false;
+  }
+  return RTP_OK;
+}
+
+bool payload_is_done(const std::string& s) {
+  return s.size() >= 2 && (uint8_t)s[0] == RTP_MAGIC &&
+         ((uint8_t)s[1] == RTP_F_DONE || (uint8_t)s[1] == RTP_F_DONE_BATCH);
+}
+
+PyObject* Chan_recv_burst(ChanObject* self, PyObject* args) {
+  PyObject* pend_obj = Py_None;
+  unsigned long max_frames = 1024;
+  if (!PyArg_ParseTuple(args, "|Ok", &pend_obj, &max_frames)) return nullptr;
+  rtp_pend* pend = nullptr;
+  if (pend_obj != Py_None) {
+    if (!PyObject_TypeCheck(pend_obj, &PendType)) {
+      PyErr_SetString(PyExc_TypeError,
+                      "recv_burst expects a _rtpump.PendingTable or None");
+      return nullptr;
+    }
+    pend = ((PendObject*)pend_obj)->p;
+  }
+  if (chan_check(self) != 0) return nullptr;
+  if (!g_taskid) return py_types_registered_err();
+  std::vector<std::string> frames;
+  std::vector<const std::string*> dones;
+  std::vector<const std::string*> others;
+  int rc = RTP_OK;
+  bool oom = false;
+  Py_BEGIN_ALLOW_THREADS
+  try {
+    rc = burst_read_frames(self->chan, frames, max_frames);
+    if (rc == RTP_OK) {
+      for (const std::string& f : frames) {
+        if (payload_is_done(f)) {
+          // GIL-free completion application: the pending table's pops
+          // (and the backpressure condvar signal) happen HERE, before
+          // Python is entered at all. A malformed frame falls to the
+          // others list, where the Python-side decode raises and the
+          // channel fails exactly as the per-frame path would.
+          if (pend != nullptr &&
+              rtp_pend_apply_done(pend, (const uint8_t*)f.data(),
+                                  f.size()) < 0) {
+            others.push_back(&f);
+            continue;
+          }
+          dones.push_back(&f);
+        } else {
+          others.push_back(&f);
+        }
+      }
+    }
+  } catch (...) {
+    oom = true;
+  }
+  Py_END_ALLOW_THREADS
+  if (oom) return PyErr_NoMemory();
+  if (rc != RTP_OK) return chan_raise(rc);
+  PyObject* done_list = PyList_New(0);
+  if (!done_list) return nullptr;
+  for (const std::string* f : dones) {
+    rtp_rbuf r = {(const uint8_t*)f->data(), f->size(), 2};  // skip magic+type
+    if ((uint8_t)(*f)[1] == RTP_F_DONE) {
+      PyObject* d = decode_done_body(&r);
+      if (!d || PyList_Append(done_list, d) != 0) {
+        Py_XDECREF(d);
+        Py_DECREF(done_list);
+        return nullptr;
+      }
+      Py_DECREF(d);
+    } else {
+      uint32_t n = 0;
+      if (rtp_get_u32(&r, &n) != RTP_OK) {
+        Py_DECREF(done_list);
+        return decode_err();
+      }
+      for (uint32_t i = 0; i < n; ++i) {
+        PyObject* d = decode_done_body(&r);
+        if (!d || PyList_Append(done_list, d) != 0) {
+          Py_XDECREF(d);
+          Py_DECREF(done_list);
+          return nullptr;
+        }
+        Py_DECREF(d);
+      }
+    }
+  }
+  PyObject* other_list = PyList_New((Py_ssize_t)others.size());
+  if (!other_list) {
+    Py_DECREF(done_list);
+    return nullptr;
+  }
+  for (size_t i = 0; i < others.size(); ++i) {
+    PyObject* b = PyBytes_FromStringAndSize(others[i]->data(),
+                                            (Py_ssize_t)others[i]->size());
+    if (!b) {
+      Py_DECREF(done_list);
+      Py_DECREF(other_list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(other_list, (Py_ssize_t)i, b);
+  }
+  PyObject* out = PyTuple_Pack(2, done_list, other_list);
+  Py_DECREF(done_list);
+  Py_DECREF(other_list);
+  return out;
+}
+
+PyObject* Chan_recv_many(ChanObject* self, PyObject* args) {
+  unsigned long max_frames = 1024;
+  if (!PyArg_ParseTuple(args, "|k", &max_frames)) return nullptr;
+  if (chan_check(self) != 0) return nullptr;
+  std::vector<std::string> frames;
+  int rc = RTP_OK;
+  bool oom = false;
+  Py_BEGIN_ALLOW_THREADS
+  try {
+    rc = burst_read_frames(self->chan, frames, max_frames);
+  } catch (...) {
+    oom = true;
+  }
+  Py_END_ALLOW_THREADS
+  if (oom) return PyErr_NoMemory();
+  if (rc != RTP_OK) return chan_raise(rc);
+  PyObject* out = PyList_New((Py_ssize_t)frames.size());
+  if (!out) return nullptr;
+  for (size_t i = 0; i < frames.size(); ++i) {
+    PyObject* b = PyBytes_FromStringAndSize(frames[i].data(),
+                                            (Py_ssize_t)frames[i].size());
+    if (!b) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, b);
+  }
+  return out;
+}
+
 PyObject* decode_fence(rtp_rbuf* r, PyObject* type_value) {
   uint64_t mid;
   if (rtp_get_u64(r, &mid) != RTP_OK) return decode_err();
@@ -989,6 +1556,12 @@ PyMethodDef module_methods[] = {
     {"chan", mod_chan, METH_VARARGS,
      "chan(fd, bufcap=0) -> Chan (dups fd; bufcap 0 = 256 KiB)"},
     {"seq_queue", mod_seq_queue, METH_NOARGS, "seq_queue() -> SeqQueue"},
+    {"pending_table", mod_pending_table, METH_NOARGS,
+     "pending_table() -> PendingTable (caller-side pending/replay "
+     "bookkeeping off the GIL)"},
+    {"waiter_table", mod_waiter_table, METH_VARARGS,
+     "waiter_table(cap=8192) -> WaiterTable (oid -> waiter directory, "
+     "FIFO resolved-entry eviction beyond cap)"},
     {"register_types", mod_register_types, METH_VARARGS,
      "register_types(RefArg, ValueArg, ObjectID, TaskID, InlineLocation)"},
     {"encode_call", mod_encode_call, METH_VARARGS,
@@ -1056,7 +1629,24 @@ PyMODINIT_FUNC PyInit__rtpump(void) {
   SeqQueueType.tp_flags = Py_TPFLAGS_DEFAULT;
   SeqQueueType.tp_methods = SeqQueue_methods;
   SeqQueueType.tp_getset = SeqQueue_getset;
-  if (PyType_Ready(&ChanType) < 0 || PyType_Ready(&SeqQueueType) < 0)
+  Pend_as_sequence.sq_length = (lenfunc)Pend_len;
+  PendType.tp_name = "_rtpump.PendingTable";
+  PendType.tp_basicsize = sizeof(PendObject);
+  PendType.tp_dealloc = (destructor)Pend_dealloc;
+  PendType.tp_flags = Py_TPFLAGS_DEFAULT;
+  PendType.tp_methods = Pend_methods;
+  PendType.tp_getset = Pend_getset;
+  PendType.tp_as_sequence = &Pend_as_sequence;
+  Waiter_as_sequence.sq_length = (lenfunc)Waiter_len;
+  WaiterType.tp_name = "_rtpump.WaiterTable";
+  WaiterType.tp_basicsize = sizeof(WaiterObject);
+  WaiterType.tp_dealloc = (destructor)Waiter_dealloc;
+  WaiterType.tp_flags = Py_TPFLAGS_DEFAULT;
+  WaiterType.tp_methods = Waiter_methods;
+  WaiterType.tp_getset = Waiter_getset;
+  WaiterType.tp_as_sequence = &Waiter_as_sequence;
+  if (PyType_Ready(&ChanType) < 0 || PyType_Ready(&SeqQueueType) < 0 ||
+      PyType_Ready(&PendType) < 0 || PyType_Ready(&WaiterType) < 0)
     return nullptr;
   if (!init_strings()) return nullptr;
   PyObject* m = PyModule_Create(&rtpump_module);
@@ -1067,5 +1657,9 @@ PyMODINIT_FUNC PyInit__rtpump(void) {
   PyModule_AddObject(m, "Chan", (PyObject*)&ChanType);
   Py_INCREF(&SeqQueueType);
   PyModule_AddObject(m, "SeqQueue", (PyObject*)&SeqQueueType);
+  Py_INCREF(&PendType);
+  PyModule_AddObject(m, "PendingTable", (PyObject*)&PendType);
+  Py_INCREF(&WaiterType);
+  PyModule_AddObject(m, "WaiterTable", (PyObject*)&WaiterType);
   return m;
 }

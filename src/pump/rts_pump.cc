@@ -9,8 +9,13 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <map>
+#include <mutex>
 #include <new>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
 #ifndef IOV_MAX
@@ -239,6 +244,185 @@ int rtp_chan_sendv(rtp_chan* c, const struct iovec* payloads, int n) {
   if (rc == RTP_OK)
     c->counters[1].fetch_add(n, std::memory_order_relaxed);
   return rc;
+}
+
+// ---- pending/replay table --------------------------------------------------
+
+struct rtp_pend {
+  std::mutex mu;
+  std::condition_variable not_full;
+  // tid -> seq for O(1) completion pops; seq -> tid for the seq-ordered
+  // failover drain. Seqs are unique per channel (monotonic submit
+  // counter), so the two maps stay in lockstep.
+  std::unordered_map<std::string, uint64_t> by_tid;
+  std::map<uint64_t, std::string> by_seq;
+  // Drain snapshot (seq order) handed out through the iterator pair.
+  std::vector<std::pair<uint64_t, std::string>> drain;
+  size_t drain_pos = 0;
+  bool failed = false;
+  // The smallest cap any submitter is currently waiting under; pops
+  // only notify when they cross it (uncontended pops skip the syscall).
+  size_t wait_cap = 0;
+  std::atomic<int64_t> counters[5];
+  rtp_pend() {
+    for (auto& a : counters) a.store(0, std::memory_order_relaxed);
+  }
+};
+
+rtp_pend* rtp_pend_new(void) { return new (std::nothrow) rtp_pend(); }
+
+void rtp_pend_free(rtp_pend* p) { delete p; }
+
+size_t rtp_pend_add(rtp_pend* p, const uint8_t* tid, size_t tid_len,
+                    uint64_t seq) {
+  std::lock_guard<std::mutex> g(p->mu);
+  std::string key((const char*)tid, tid_len);
+  auto it = p->by_tid.find(key);
+  if (it != p->by_tid.end()) p->by_seq.erase(it->second);
+  p->by_tid[key] = seq;
+  p->by_seq[seq] = std::move(key);
+  p->counters[RTP_PEND_ADDS].fetch_add(1, std::memory_order_relaxed);
+  return p->by_tid.size();
+}
+
+static void pend_pop_locked(rtp_pend* p,
+                            std::unordered_map<std::string,
+                                               uint64_t>::iterator it) {
+  p->by_seq.erase(it->second);
+  p->by_tid.erase(it);
+  p->counters[RTP_PEND_POPS].fetch_add(1, std::memory_order_relaxed);
+  if (p->wait_cap && p->by_tid.size() < p->wait_cap) {
+    p->counters[RTP_PEND_WAKEUPS].fetch_add(1, std::memory_order_relaxed);
+    p->not_full.notify_all();
+  }
+}
+
+int rtp_pend_pop(rtp_pend* p, const uint8_t* tid, size_t tid_len,
+                 uint64_t* seq) {
+  std::lock_guard<std::mutex> g(p->mu);
+  auto it = p->by_tid.find(std::string((const char*)tid, tid_len));
+  if (it == p->by_tid.end()) {
+    p->counters[RTP_PEND_MISSES].fetch_add(1, std::memory_order_relaxed);
+    return 0;
+  }
+  if (seq) *seq = it->second;
+  pend_pop_locked(p, it);
+  return 1;
+}
+
+size_t rtp_pend_size(const rtp_pend* p) {
+  std::lock_guard<std::mutex> g(const_cast<rtp_pend*>(p)->mu);
+  return p->by_tid.size();
+}
+
+size_t rtp_pend_wait_below(rtp_pend* p, size_t cap, int timeout_ms) {
+  std::unique_lock<std::mutex> g(p->mu);
+  if (p->by_tid.size() < cap || p->failed) return p->by_tid.size();
+  if (p->wait_cap == 0 || cap < p->wait_cap) p->wait_cap = cap;
+  // wait_until on system_clock (NOT wait_for): libstdc++ lowers
+  // wait_for to pthread_cond_clockwait, which the TSAN runtime used by
+  // `make native-tsan` does not intercept — its lock bookkeeping then
+  // reports phantom double-locks. timedwait is intercepted everywhere.
+  auto deadline = std::chrono::system_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  p->not_full.wait_until(g, deadline,
+                         [&] { return p->by_tid.size() < cap || p->failed; });
+  if (p->wait_cap == cap) p->wait_cap = 0;
+  return p->by_tid.size();
+}
+
+void rtp_pend_fail(rtp_pend* p) {
+  std::lock_guard<std::mutex> g(p->mu);
+  p->failed = true;
+  p->not_full.notify_all();
+}
+
+int rtp_pend_failed(const rtp_pend* p) {
+  std::lock_guard<std::mutex> g(const_cast<rtp_pend*>(p)->mu);
+  return p->failed ? 1 : 0;
+}
+
+size_t rtp_pend_drain_begin(rtp_pend* p) {
+  std::lock_guard<std::mutex> g(p->mu);
+  p->drain.clear();
+  p->drain_pos = 0;
+  p->drain.reserve(p->by_seq.size());
+  for (auto& kv : p->by_seq) p->drain.emplace_back(kv.first, kv.second);
+  p->by_seq.clear();
+  p->by_tid.clear();
+  // A capped submitter must wake: the table just emptied (it re-checks
+  // the channel's failed flag before trusting the headroom).
+  p->not_full.notify_all();
+  return p->drain.size();
+}
+
+int rtp_pend_drain_next(rtp_pend* p, const uint8_t** tid, size_t* tid_len,
+                        uint64_t* seq) {
+  std::lock_guard<std::mutex> g(p->mu);
+  if (p->drain_pos >= p->drain.size()) return 0;
+  auto& e = p->drain[p->drain_pos++];
+  *seq = e.first;
+  *tid = (const uint8_t*)e.second.data();
+  *tid_len = e.second.size();
+  return 1;
+}
+
+// Walk one DONE body (without the magic/type prefix), popping its task
+// id. Returns RTP_OK and advances *r, or RTP_ERR on malformed bytes.
+static int pend_apply_body(rtp_pend* p, rtp_rbuf* r) {
+  uint8_t idlen, flags;
+  const uint8_t* idp;
+  double duration;
+  uint32_t nr;
+  if (rtp_get_u8(r, &idlen) != RTP_OK ||
+      rtp_get_ref(r, &idp, idlen) != RTP_OK ||
+      rtp_get_u8(r, &flags) != RTP_OK ||
+      rtp_get_f64(r, &duration) != RTP_OK ||
+      rtp_get_u32(r, &nr) != RTP_OK)
+    return RTP_ERR;
+  (void)flags;
+  (void)duration;
+  for (uint32_t i = 0; i < nr; ++i) {
+    uint8_t olen;
+    uint32_t dlen;
+    const uint8_t* skip;
+    if (rtp_get_u8(r, &olen) != RTP_OK ||
+        rtp_get_ref(r, &skip, olen) != RTP_OK ||
+        rtp_get_u32(r, &dlen) != RTP_OK ||
+        rtp_get_ref(r, &skip, dlen) != RTP_OK)
+      return RTP_ERR;
+  }
+  uint64_t seq;
+  rtp_pend_pop(p, idp, idlen, &seq);
+  return RTP_OK;
+}
+
+int rtp_pend_apply_done(rtp_pend* p, const uint8_t* payload, size_t len) {
+  rtp_rbuf r = {payload, len, 0};
+  uint8_t magic, ftype;
+  if (rtp_get_u8(&r, &magic) != RTP_OK || magic != RTP_MAGIC) return 0;
+  if (rtp_get_u8(&r, &ftype) != RTP_OK) return 0;
+  int applied = 0;
+  if (ftype == RTP_F_DONE) {
+    if (pend_apply_body(p, &r) != RTP_OK) return -1;
+    applied = 1;
+  } else if (ftype == RTP_F_DONE_BATCH) {
+    uint32_t n;
+    if (rtp_get_u32(&r, &n) != RTP_OK) return -1;
+    for (uint32_t i = 0; i < n; ++i) {
+      if (pend_apply_body(p, &r) != RTP_OK) return -1;
+      ++applied;
+    }
+  } else {
+    return 0;  // call/fence/ack frames: not completion traffic
+  }
+  p->counters[RTP_PEND_APPLIES].fetch_add(1, std::memory_order_relaxed);
+  return applied;
+}
+
+int64_t rtp_pend_counter(const rtp_pend* p, int which) {
+  if (which < 0 || which > 4) return 0;
+  return p->counters[which].load(std::memory_order_relaxed);
 }
 
 // ---- sequence dispatch queue ----------------------------------------------

@@ -109,6 +109,69 @@ int64_t rtp_chan_counter(const rtp_chan* c, int which);
 // backpressure): atomic add, returns the new value. delta 0 reads.
 int64_t rtp_chan_inflight_add(rtp_chan* c, int64_t delta);
 
+// ---- pending/replay table (ISSUE 12) ---------------------------------------
+//
+// The caller-side unanswered-call bookkeeping of one direct channel,
+// sharded off the GIL: task-id -> submit sequence number, with the
+// DIRECT_MAX_UNANSWERED backpressure wait as a native condition
+// variable (the submitter blocks GIL-released until the reader's pops
+// bring the table below the cap) and a seq-ordered drain snapshot for
+// the failover replay path. rtp_pend_apply_done applies a whole
+// DONE/DONE_BATCH frame payload — every contained task id popped, the
+// condvar signalled once — without entering Python at all; this is how
+// the pump's reader updates the table without taking the GIL per frame.
+//
+// Thread contract: any number of submitter threads (serialized by the
+// caller's channel lock) add/wait; ONE reader thread pops/applies;
+// fail/drain may come from any thread. All ops lock the table's own
+// mutex — never the GIL.
+
+typedef struct rtp_pend rtp_pend;
+
+// Pending-table stats counters for rtp_pend_counter(): adds, pops,
+// native frame applies (DONE/DONE_BATCH parsed off-GIL), condvar
+// wakeups delivered to capped submitters, and pops that found no entry
+// (pickle-dialect replies already handled in Python, or replays).
+enum {
+  RTP_PEND_ADDS = 0,
+  RTP_PEND_POPS = 1,
+  RTP_PEND_APPLIES = 2,
+  RTP_PEND_WAKEUPS = 3,
+  RTP_PEND_MISSES = 4,
+};
+
+rtp_pend* rtp_pend_new(void);
+void rtp_pend_free(rtp_pend* p);
+// Insert (tid, seq). Returns the new size. Duplicate tids overwrite
+// (cannot happen on a live channel: task ids are unique per submit).
+size_t rtp_pend_add(rtp_pend* p, const uint8_t* tid, size_t tid_len,
+                    uint64_t seq);
+// Remove one entry; 1 + *seq set when found, 0 otherwise. Signals a
+// capped submitter when the table drops below its wait cap.
+int rtp_pend_pop(rtp_pend* p, const uint8_t* tid, size_t tid_len,
+                 uint64_t* seq);
+size_t rtp_pend_size(const rtp_pend* p);
+// Block (caller must NOT hold the GIL) until size < cap, the table is
+// failed, or timeout_ms elapses. Returns the size observed at wake.
+size_t rtp_pend_wait_below(rtp_pend* p, size_t cap, int timeout_ms);
+// Mark failed and wake every waiter: the channel died, submitters must
+// re-check their channel state instead of sleeping out the timeout.
+void rtp_pend_fail(rtp_pend* p);
+int rtp_pend_failed(const rtp_pend* p);
+// Failover drain: atomically snapshot + clear, entries surfaced in seq
+// order through the iterator pair. Begin returns the snapshot length;
+// each next fills (*tid,*tid_len,*seq) until it returns 0. Only one
+// drain may be in progress (the failure path is single-threaded).
+size_t rtp_pend_drain_begin(rtp_pend* p);
+int rtp_pend_drain_next(rtp_pend* p, const uint8_t** tid, size_t* tid_len,
+                        uint64_t* seq);
+// Parse a native DONE/DONE_BATCH frame payload and pop every contained
+// task id (GIL-free completion application). Returns the number of
+// entries popped, or -1 on a malformed frame. Non-done native frames
+// and pickle payloads return 0 untouched.
+int rtp_pend_apply_done(rtp_pend* p, const uint8_t* payload, size_t len);
+int64_t rtp_pend_counter(const rtp_pend* p, int which);
+
 // ---- sequence dispatch queue ----------------------------------------------
 
 typedef struct rtp_seqq rtp_seqq;

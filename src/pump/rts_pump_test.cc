@@ -4,11 +4,14 @@
 
 #include <assert.h>
 #include <pthread.h>
+#include <sched.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <unistd.h>
+
+#include <atomic>
 
 // ---- framing: single frames, batches, buffered slicing ---------------------
 
@@ -262,12 +265,135 @@ static void test_wire_layout() {
   rtp_wbuf_freebuf(&b2);
 }
 
+// ---- pending/replay table ---------------------------------------------------
+
+static void make_tid(uint8_t* tid, uint64_t seq) {
+  memset(tid, 0, 16);
+  memcpy(tid, &seq, sizeof(seq));
+}
+
+// Encode a minimal DONE frame payload for task id `tid` (no results).
+static size_t make_done_frame(uint8_t* out, const uint8_t* tid) {
+  size_t n = 0;
+  out[n++] = RTP_MAGIC;
+  out[n++] = RTP_F_DONE;
+  out[n++] = 16;
+  memcpy(out + n, tid, 16);
+  n += 16;
+  out[n++] = 0;  // flags
+  memset(out + n, 0, 8);  // duration f64 = 0
+  n += 8;
+  memset(out + n, 0, 4);  // result count u32 = 0
+  n += 4;
+  return n;
+}
+
+static void test_pend_basic() {
+  rtp_pend* p = rtp_pend_new();
+  uint8_t tid[16];
+  for (uint64_t s = 1; s <= 10; ++s) {
+    make_tid(tid, s);
+    assert(rtp_pend_add(p, tid, 16, s) == s);
+  }
+  assert(rtp_pend_size(p) == 10);
+  // Pop out of order; misses counted, not fatal.
+  make_tid(tid, 5);
+  uint64_t seq = 0;
+  assert(rtp_pend_pop(p, tid, 16, &seq) == 1 && seq == 5);
+  assert(rtp_pend_pop(p, tid, 16, &seq) == 0);
+  // Completion application straight from a DONE frame payload.
+  make_tid(tid, 7);
+  uint8_t frame[64];
+  size_t fn = make_done_frame(frame, tid);
+  assert(rtp_pend_apply_done(p, frame, fn) == 1);
+  assert(rtp_pend_apply_done(p, frame, fn) == 1);  // miss: still parses
+  assert(rtp_pend_counter(p, RTP_PEND_MISSES) >= 2);
+  assert(rtp_pend_apply_done(p, frame, 4) == -1);  // truncated: malformed
+  assert(rtp_pend_size(p) == 8);
+  // Drain surfaces the remainder in seq order.
+  assert(rtp_pend_drain_begin(p) == 8);
+  uint64_t last = 0;
+  const uint8_t* dt;
+  size_t dl;
+  while (rtp_pend_drain_next(p, &dt, &dl, &seq)) {
+    assert(dl == 16);
+    assert(seq > last);
+    last = seq;
+  }
+  assert(last == 10 && rtp_pend_size(p) == 0);
+  rtp_pend_free(p);
+}
+
+// Stress: a pipelined submitter thread blocked on the backpressure cap
+// while a completer thread applies DONE frames, then an injected
+// channel death (fail + drain) releases the submitter — the
+// TSAN/ASAN/UBSAN builds of this test are the `make native-test` gate
+// for the GIL-free dispatch core's locking.
+struct pend_stress_arg {
+  rtp_pend* p;
+  int total;
+  int cap;
+  std::atomic<int> submitted;
+};
+
+static void* pend_submitter_main(void* argp) {
+  pend_stress_arg* a = (pend_stress_arg*)argp;
+  uint8_t tid[16];
+  for (int i = 1; i <= a->total; ++i) {
+    while (rtp_pend_size(a->p) >= (size_t)a->cap && !rtp_pend_failed(a->p))
+      rtp_pend_wait_below(a->p, (size_t)a->cap, 50);
+    if (rtp_pend_failed(a->p)) break;
+    make_tid(tid, (uint64_t)i);
+    rtp_pend_add(a->p, tid, 16, (uint64_t)i);
+    a->submitted.store(i, std::memory_order_release);
+  }
+  return nullptr;
+}
+
+static void test_pend_stress_death() {
+  rtp_pend* p = rtp_pend_new();
+  pend_stress_arg a = {p, 100000, 64, {0}};
+  pthread_t sub;
+  pthread_create(&sub, nullptr, pend_submitter_main, &a);
+  // Completer: apply DONE frames for roughly half the stream, then
+  // inject a channel death mid-pipeline.
+  uint8_t tid[16], frame[64];
+  for (uint64_t s = 1; s <= 50000; ++s) {
+    make_tid(tid, s);
+    size_t fn = make_done_frame(frame, tid);
+    // Spin until the submitter catches up (the table is the only
+    // synchronization, as in the real reader).
+    while (a.submitted.load(std::memory_order_acquire) < (int)s)
+      sched_yield();
+    assert(rtp_pend_apply_done(p, frame, fn) == 1);
+  }
+  rtp_pend_fail(p);  // injected death: capped submitter must wake NOW
+  pthread_join(sub, nullptr);
+  // Exactly-once accounting: every add is either popped or drained.
+  size_t remaining = rtp_pend_drain_begin(p);
+  int64_t adds = rtp_pend_counter(p, RTP_PEND_ADDS);
+  int64_t pops = rtp_pend_counter(p, RTP_PEND_POPS);
+  assert(adds == pops + (int64_t)remaining);
+  assert(pops == 50000);
+  // Drain order is seq order even after the chaos.
+  uint64_t last = 0, seq;
+  const uint8_t* dt;
+  size_t dl;
+  while (rtp_pend_drain_next(p, &dt, &dl, &seq)) {
+    assert(seq > last);
+    last = seq;
+  }
+  rtp_pend_free(p);
+}
+
 int main() {
   test_framing_roundtrip();
   test_threaded_pump();
   test_seqq();
   test_seqq_drop();
   test_wire_layout();
+  test_pend_basic();
+  test_pend_stress_death();
   printf("rts_pump_test OK\n");
   return 0;
 }

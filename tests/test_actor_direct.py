@@ -82,6 +82,83 @@ def test_channel_death_replays_in_order(rt):
     assert runtime._direct_fallbacks == steady  # zero steady-state fallbacks
 
 
+def test_backpressure_cap_and_death_through_pending_table(rt, monkeypatch):
+    """ISSUE 12: the pending/replay table enforces the unanswered-call
+    cap (a pipelined stream far deeper than the cap completes — the
+    submitter parks on the table's condvar, the reader's completion
+    pops release it) and a channel killed while calls are parked
+    replays them exactly-once in order. Runs on whichever table the
+    build provides (native or PyPendingTable) — the semantics must be
+    identical."""
+    from ray_tpu.core import runtime as rt_mod
+
+    monkeypatch.setattr(rt_mod, "DIRECT_MAX_UNANSWERED", 8)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    st = _engage(c, lambda: c.inc.remote())
+    chan = st["chan"]
+    base = ray_tpu.get(c.inc.remote(), timeout=30)
+    # 64-deep pipeline against a cap of 8: submit() must park and
+    # resume repeatedly; the table can never exceed the cap.
+    refs = [c.inc.remote() for _ in range(64)]
+    assert len(chan.table) <= 8
+    vals = ray_tpu.get(refs, timeout=60)
+    assert vals == list(range(base + 1, base + 65))
+    assert len(chan.table) == 0
+    stats = _runtime().direct_stats()
+    assert stats["gil_probe"]["py_entries"] > 0
+    # Now kill the socket with calls in flight: drain() snapshots in
+    # seq order, the NM replay keeps them exactly-once.
+    refs = [c.inc.remote() for _ in range(20)]
+    chan.conn.close()
+    refs += [c.inc.remote() for _ in range(5)]
+    vals = ray_tpu.get(refs, timeout=60)
+    assert vals == list(range(base + 65, base + 90))
+    st2 = _engage(c, lambda: c.inc.remote())
+    assert st2["chan"] is not chan
+
+
+def test_failure_sweeps_calls_popped_but_undelivered(rt):
+    """A native burst can pop completions from the pending table and
+    then die before Python ever sees them. The failure path must
+    replay from the rich-state dict (_calls), not the table alone —
+    otherwise those calls are never resolved and never replayed."""
+
+    @ray_tpu.remote
+    class Slow:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            time.sleep(0.05)
+            self.n += 1
+            return self.n
+
+    s = Slow.remote()
+    st = _engage(s, lambda: s.inc.remote())
+    chan = st["chan"]
+    base = ray_tpu.get(s.inc.remote(), timeout=30)
+    refs = [s.inc.remote() for _ in range(10)]
+    # Simulate the undelivered-burst window: drop some in-flight task
+    # ids from the table (as a dying recv_burst would), then sever the
+    # channel. The sweep in _direct_channel_failed must still replay
+    # every call exactly-once in order.
+    for call in list(chan._calls.values())[:3]:
+        chan.table.pop(call.spec.task_id.binary())
+    chan.conn.close()
+    vals = ray_tpu.get(refs, timeout=60)
+    assert vals == list(range(base + 1, base + 11))
+
+
 def test_actor_restart_reresolves_endpoint(rt):
     """Worker death with restarts left: calls fall back to the NM route
     (which queues through the restart), and the handle re-resolves the

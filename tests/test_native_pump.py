@@ -166,6 +166,186 @@ def test_seq_queue_native_matches_python():
         assert out_nat == out_py == list(range(1, 65))
 
 
+def _done_for(tid: bytes, rng):
+    results = [
+        (ObjectID(rng.randbytes(20)),
+         InlineLocation(rng.randbytes(rng.randrange(0, 100))))
+        for _ in range(rng.randrange(0, 3))
+    ]
+    return {
+        "type": "task_done",
+        "task_id": TaskID(tid),
+        "results": results,
+        "failed": False,
+        "duration_s": rng.random(),
+    }
+
+
+@needs_native
+def test_pending_table_native_matches_python_fuzz():
+    """Random interleavings of submit / complete (direct pop AND
+    DONE/DONE_BATCH frame application) / duplicate completion /
+    backpressure probe / death-drain: the extension table and
+    PyPendingTable stay observationally identical — sizes, pop results,
+    wait outcomes, and seq-ordered drain snapshots all match."""
+    mod = frame_pump._module()
+    rng = random.Random(0xF00D)
+    for _round in range(12):
+        nat, py = mod.pending_table(), frame_pump.PyPendingTable()
+        live = []
+        seq = 0
+        for _op in range(400):
+            r = rng.random()
+            if r < 0.45 or not live:
+                seq += 1
+                tid = rng.randbytes(16)
+                live.append(tid)
+                assert nat.add(tid, seq) == py.add(tid, seq)
+            elif r < 0.70:
+                tid = live.pop(rng.randrange(len(live)))
+                if rng.random() < 0.5:
+                    assert nat.pop(tid) == py.pop(tid)
+                else:
+                    done = _done_for(tid, rng)
+                    payload = (mod.encode_done(done)
+                               if rng.random() < 0.5
+                               else mod.encode_done_batch([done]))
+                    # Byte-identical payloads both directions feed the
+                    # same native application path.
+                    assert payload == (
+                        frame_pump.py_encode_done(done)
+                        if payload[1] == frame_pump.F_DONE
+                        else frame_pump.py_encode_done_batch([done]))
+                    assert nat.apply_done(payload) == py.apply_done(payload)
+            elif r < 0.80:
+                # Unknown/duplicate completion: a miss on both sides.
+                tid = rng.randbytes(16)
+                assert nat.pop(tid) is None and py.pop(tid) is None
+            elif r < 0.92:
+                assert (nat.wait_below(1 << 30, 0.0)
+                        == py.wait_below(1 << 30, 0.0)
+                        == len(live))
+                assert len(nat) == len(py) == len(live)
+            else:
+                # Injected channel death: drain snapshots must be
+                # byte-identical AND in seq order on both sides.
+                assert nat.drain() == py.drain()
+                live.clear()
+        assert nat.drain() == py.drain()
+        assert len(nat) == len(py) == 0
+        ns, ps = nat.stats(), py.stats()
+        assert set(ns) == set(ps) == {"adds", "pops", "applies",
+                                      "wakeups", "misses"}
+        assert ns["adds"] == ps["adds"] and ns["misses"] == ps["misses"]
+
+
+@needs_native
+def test_pending_table_backpressure_cap():
+    """wait_below parks (GIL released) until a completion pops the
+    table below the cap — and fail() releases a parked submitter
+    immediately, the injected-channel-death contract."""
+    import threading
+
+    mod = frame_pump._module()
+    for table in (mod.pending_table(), frame_pump.PyPendingTable()):
+        for i in range(8):
+            table.add(b"%016d" % i, i + 1)
+        t0 = time.perf_counter()
+        assert table.wait_below(8, 0.05) == 8  # times out at the cap
+        assert time.perf_counter() - t0 >= 0.04
+
+        released = threading.Event()
+
+        def parked():
+            while table.size() >= 8 and not table.failed:
+                table.wait_below(8, 5.0)
+            released.set()
+
+        t = threading.Thread(target=parked, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not released.is_set()
+        table.pop(b"%016d" % 0)  # completion signals the condvar
+        assert released.wait(2.0), "pop did not wake the capped submitter"
+        # Refill to the cap, then kill the channel: fail() must release.
+        table.add(b"%016d" % 99, 100)
+        released.clear()
+        t = threading.Thread(target=parked, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        table.fail()
+        assert released.wait(2.0), "fail() did not wake the submitter"
+
+
+@needs_native
+def test_waiter_table_native_matches_python():
+    """Random put/get/pop/mark_resolved with a small cap: the native
+    WaiterTable and PyWaiterTable agree on membership, identity of the
+    returned entries, and the resolved-FIFO eviction discipline."""
+    mod = frame_pump._module()
+    rng = random.Random(0xBEEF)
+    nat, py = mod.waiter_table(16), frame_pump.PyWaiterTable(16)
+    keys = [rng.randbytes(20) for _ in range(200)]
+    entries = {k: object() for k in keys}
+    inserted = []
+    for k in keys:
+        r = rng.random()
+        if r < 0.6:
+            nat.put(k, entries[k])
+            py.put(k, entries[k])
+            inserted.append(k)
+            if rng.random() < 0.7:
+                nat.mark_resolved(k)
+                py.mark_resolved(k)
+        elif inserted:
+            probe = rng.choice(inserted)
+            if r < 0.8:
+                assert nat.get(probe) is py.get(probe)
+            else:
+                assert nat.pop(probe) is py.pop(probe)
+        assert len(nat) == len(py)
+    for k in keys:
+        assert nat.get(k) is py.get(k)
+
+
+@needs_native
+def test_recv_burst_applies_and_splits(ray_tpu_start=None):
+    """recv_burst: one call drains an arrived-together burst, applies
+    native completions to the pending table off-GIL, and hands back
+    non-done payloads raw (pickle frames, fences) for Python dispatch."""
+    from ray_tpu.core.protocol import dumps_msg
+
+    mod = frame_pump._module()
+    a, b = socket.socketpair()
+    try:
+        ca, cb = mod.chan(a.fileno()), mod.chan(b.fileno())
+        rng = random.Random(3)
+        table = mod.pending_table()
+        d1, d2 = _done_for(b"A" * 16, rng), _done_for(b"B" * 16, rng)
+        table.add(b"A" * 16, 1)
+        table.add(b"B" * 16, 2)
+        table.add(b"C" * 16, 3)
+        ca.send_many([
+            mod.encode_done(d1),
+            dumps_msg({"type": "fence_ack", "msg_id": 5}),
+            mod.encode_done_batch([d2]),
+            mod.encode_fence(9),
+        ])
+        dones, others = cb.recv_burst(table)
+        assert [d["task_id"] for d in dones] == [d1["task_id"],
+                                                 d2["task_id"]]
+        assert len(others) == 2
+        assert table.size() == 1 and table.pop(b"C" * 16) == 3
+        assert table.stats()["applies"] == 2
+        # recv_many: raw payloads in arrival order, one Python entry.
+        ca.send_many([mod.encode_fence(1), mod.encode_fence(2)])
+        msgs = cb.recv_many()
+        assert [frame_pump.py_decode(m)["msg_id"] for m in msgs] == [1, 2]
+    finally:
+        a.close()
+        b.close()
+
+
 @needs_native
 def test_chan_framing_roundtrip():
     """Framed pump over a socketpair: coalesced batch send, interleaved

@@ -210,6 +210,64 @@ def test_lock_order_reentrant_and_ordered_nesting_clean(tmp_path):
     assert _run_pass(FixtureLockPass(), tmp_path) == []
 
 
+def test_lock_order_native_wait_under_lock(tmp_path):
+    # ISSUE 12 convention: the pending table's wait_below (a native
+    # condvar signalled by the reader's completion path) must be
+    # entered lock-free — direct and one-call-hop violations flagged,
+    # the lock-free shape clean.
+    _write(tmp_path, "pkg/mod.py", """\
+        import threading
+
+        class Chan:
+            def __init__(self, table):
+                self._lock = threading.Lock()
+                self.table = table
+
+            def bad_direct(self):
+                with self._lock:
+                    self.table.wait_below(1024, 0.25)
+
+            def bad_one_hop(self):
+                with self._lock:
+                    self._park()
+
+            def _park(self):
+                self.table.wait_below(1024, 0.25)
+
+            def good(self):
+                self._park()
+                with self._lock:
+                    pass
+    """)
+    findings = _run_pass(FixtureLockPass(), tmp_path)
+    assert len(findings) == 2
+    assert all("native dispatch-core wait" in f.message for f in findings)
+    assert any("native wait inside" in f.message for f in findings)
+
+
+def test_codec_mirror_detects_table_api_drift():
+    """Deleting a shared dispatch-table method from the mirror (or its
+    C binding) is a finding — the two implementations are one API."""
+    from tools.rtlint.passes import codec_mirror as cm
+
+    class Probe(CodecMirrorPass):
+        pass
+
+    ctx = Context(REPO_ROOT)
+    saved = cm.TABLE_API
+    try:
+        cm.TABLE_API = dict(saved)
+        cm.TABLE_API["PyPendingTable"] = saved["PyPendingTable"] + (
+            "not_a_real_method",
+        )
+        findings = Probe().run(ctx)
+        keys = {f.key for f in findings}
+        assert "table-method:PyPendingTable.not_a_real_method" in keys
+        assert "table-native:not_a_real_method" in keys
+    finally:
+        cm.TABLE_API = saved
+
+
 def test_lock_order_condition_alias_inversion(tmp_path):
     # with cv: nests _b, elsewhere with _b: nests the *aliased* lock —
     # the alias map must fold cv onto _a for the cycle to appear.

@@ -71,6 +71,20 @@ MIRROR_WIRE_KEYS = ("type", "t", "i", "q", "a", "n", "d", "task_id",
 MIRROR_WIRE_VALUES = ("execute", "task_done", "task_done_batch", "fence",
                       "fence_ack")
 
+# The GIL-free dispatch tables (ISSUE 12) are one API with two
+# implementations: the extension types (PendingTable / WaiterTable in
+# _rtpump_module.cc) and the frame_pump.py mirrors. runtime.py calls
+# through whichever new_*_table() returned, so a method renamed on one
+# side strands the other at runtime — every name must exist in both.
+TABLE_API = {
+    "PyPendingTable": ("add", "pop", "size", "wait_below", "fail",
+                       "drain", "apply_done", "stats"),
+    "PyWaiterTable": ("put", "get", "pop", "mark_resolved"),
+}
+# The pending-table stats keys the bench's GIL-handoff probe reads;
+# the C binding's Pend_stats table and the mirror must agree.
+PEND_STATS_KEYS = ("adds", "pops", "applies", "wakeups", "misses")
+
 
 def _module_int_consts(tree: ast.AST) -> Dict[str, int]:
     out: Dict[str, int] = {}
@@ -215,6 +229,54 @@ class CodecMirrorPass(Pass):
                     f"{CC_PATH} — the native decoder cannot produce "
                     f"the same dict shape",
                     key=f"mirror-token:{key}"))
+
+        # -- dispatch-table API mirror (pending/waiter tables) ----------------
+        mirror_methods: Dict[str, Set[str]] = {}
+        for node in mirror_tree.body:
+            if isinstance(node, ast.ClassDef) and node.name in TABLE_API:
+                mirror_methods[node.name] = {
+                    sub.name for sub in node.body
+                    if isinstance(sub, ast.FunctionDef)
+                }
+        for cls, methods in TABLE_API.items():
+            n_checked += 1
+            if cls not in mirror_methods:
+                findings.append(Finding(
+                    self.name, MIRROR_PATH, 0,
+                    f"{cls} missing from the Python mirror — the "
+                    f"RTPU_NO_NATIVE/TLS fallback ladder has no "
+                    f"implementation to land on",
+                    key=f"table-missing:{cls}"))
+                continue
+            for meth in methods:
+                n_checked += 1
+                if meth not in mirror_methods[cls]:
+                    findings.append(Finding(
+                        self.name, MIRROR_PATH, 0,
+                        f"{cls}.{meth} missing from the mirror but part "
+                        f"of the shared dispatch-table API",
+                        key=f"table-method:{cls}.{meth}"))
+                if f"\"{meth}\"" not in cc_src:
+                    findings.append(Finding(
+                        self.name, CC_PATH, 0,
+                        f"dispatch-table method \"{meth}\" is not bound "
+                        f"by {CC_PATH} — the native and mirror table "
+                        f"APIs drifted",
+                        key=f"table-native:{meth}"))
+        for key in PEND_STATS_KEYS:
+            n_checked += 1
+            if f"\"{key}\"" not in cc_src:
+                findings.append(Finding(
+                    self.name, CC_PATH, 0,
+                    f"pending-table stats key \"{key}\" missing from "
+                    f"the C binding (the GIL-handoff probe reads it)",
+                    key=f"pend-stats-c:{key}"))
+            if key not in _string_literals(mirror_tree):
+                findings.append(Finding(
+                    self.name, MIRROR_PATH, 0,
+                    f"pending-table stats key \"{key}\" missing from "
+                    f"the mirror's stats surface",
+                    key=f"pend-stats-py:{key}"))
 
         # -- DIRECT_PROTO_VER handshake discipline ----------------------------
         if "DIRECT_PROTO_VER" not in proto_consts:

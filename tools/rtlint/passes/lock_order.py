@@ -12,7 +12,7 @@ attributes), then builds the acquired-while-holding graph from:
   acquires anywhere in its own intra-module call tree.
 
 ``Condition(existing_lock)`` aliases to the wrapped lock (one identity —
-``with cv:`` and ``with lock:`` are the same acquisition). Two failure
+``with cv:`` and ``with lock:`` are the same acquisition). Three failure
 shapes are reported:
 
 * **self-deadlock**: a non-reentrant Lock re-acquired while already
@@ -20,6 +20,14 @@ shapes are reported:
   is a guaranteed hang on first execution of that path.
 * **order inversion**: a cycle L1 -> L2 -> ... -> L1 across sites; two
   threads entering from different ends deadlock under load.
+* **native wait under lock**: the GIL-free dispatch core's blocking
+  waits (``.wait_below(...)`` on the pending table — ISSUE 12) invoked
+  while a Python lock is held, directly or one call hop away. The
+  table's condvar is signalled by the native dispatch/reader side,
+  whose completion application hands results back through Python
+  callbacks that may need that same lock — the convention is that the
+  backpressure wait is entered lock-free, and this pass machine-checks
+  it.
 """
 
 from __future__ import annotations
@@ -34,6 +42,11 @@ DEFAULT_SCAN = ("ray_tpu/core", "ray_tpu/util")
 _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
                "BoundedSemaphore"}
 _REENTRANT_CTORS = {"RLock"}
+
+# Blocking waits on the native dispatch core (extension condvars whose
+# signallers run off the GIL and re-enter Python to deliver results):
+# these must never be entered while holding a Python lock.
+_NATIVE_WAITS = {"wait_below"}
 
 # (module, class or "", attr) — one lock identity.
 LockId = Tuple[str, str, str]
@@ -169,8 +182,11 @@ class _ModuleAnalysis:
                                         ast.AsyncFunctionDef)):
                         self.funcs[(node.name, sub.name)] = sub
         self._acq_memo: Dict[Tuple[str, str], Set[LockId]] = {}
+        self._wait_memo: Dict[Tuple[str, str], bool] = {}
         self.edges: List[_Edge] = []
         self.self_deadlocks: List[_Edge] = []
+        # (holder lock, rel, line, via) for native waits under a lock.
+        self.native_wait_sites: List[Tuple[LockId, str, int, str]] = []
 
     # -- what locks does a function (transitively) acquire? ------------------
 
@@ -205,6 +221,35 @@ class _ModuleAnalysis:
                         out |= self.acquired_in(callee, seen)
         if _seen is None:
             self._acq_memo[key] = out
+        return out
+
+    def waits_native_in(self, key: Tuple[str, str],
+                        _seen: Optional[Set] = None) -> bool:
+        """Does this function (transitively, intra-module) block on a
+        native dispatch-core wait?"""
+        if key in self._wait_memo:
+            return self._wait_memo[key]
+        seen = _seen if _seen is not None else set()
+        if key in seen:
+            return False
+        seen.add(key)
+        func = self.funcs.get(key)
+        out = False
+        if func is not None:
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and \
+                        fn.attr in _NATIVE_WAITS:
+                    out = True
+                    break
+                callee = self._callee_key(key[0], node)
+                if callee is not None and self.waits_native_in(callee, seen):
+                    out = True
+                    break
+        if _seen is None:
+            self._wait_memo[key] = out
         return out
 
     def _callee_key(self, cls: str,
@@ -258,12 +303,24 @@ class _ModuleAnalysis:
                     if lock is not None and held:
                         self._note(held, lock, child.lineno,
                                    f"acquire() in {fname}")
+                elif held and isinstance(fn, ast.Attribute) and \
+                        fn.attr in _NATIVE_WAITS:
+                    for holder in held:
+                        self.native_wait_sites.append(
+                            (holder, self.rel, child.lineno,
+                             f".{fn.attr}() in {fname}"))
                 elif held:
                     callee = self._callee_key(cls, child)
                     if callee is not None:
                         for lock in self.acquired_in(callee):
                             self._note(held, lock, child.lineno,
                                        f"{fname} -> {callee[1]}()")
+                        if self.waits_native_in(callee):
+                            for holder in held:
+                                self.native_wait_sites.append(
+                                    (holder, self.rel, child.lineno,
+                                     f"{fname} -> {callee[1]}() "
+                                     f"(native wait inside)"))
             self._walk(cls, fname, list(ast.iter_child_nodes(child)),
                        held)
 
@@ -301,6 +358,18 @@ class LockOrderPass(Pass):
                     f"guaranteed deadlock on this path",
                     hint="make the inner path lock-free, or split the "
                          "method into a _locked variant",
+                ))
+            for holder, wrel, wline, via in analysis.native_wait_sites:
+                findings.append(Finding(
+                    self.name, wrel, wline,
+                    f"native dispatch-core wait entered while holding "
+                    f"{_fmt(holder)} ({via}) — the pending-table "
+                    f"condvar is signalled by the reader's completion "
+                    f"path, which may need that lock (lock-free "
+                    f"backpressure convention, ISSUE 12)",
+                    hint="release the lock before parking on "
+                         "wait_below(); the table's own mutex is the "
+                         "only synchronization the wait needs",
                 ))
         findings.extend(self._cycle_findings(edges))
         self.stats = (f"{n_locks} lock site(s), "

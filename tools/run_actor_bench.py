@@ -1,18 +1,26 @@
-"""Direct actor-call plane bench (PERF_r08): sync actor round-trips
+"""Direct actor-call plane bench (PERF_r09): sync actor round-trips
 measured unloaded and under a pipelined background call stream — with
-the native frame pump engaged (default), with the pump forced off
-(RTPU_NO_NATIVE=1: the pure-Python fallback mode, recorded side by side
-so a regression in EITHER mode is caught by the bench record itself),
-and over the NM-mediated path (direct_actor_calls=0) in fresh sessions.
-Also injects a channel death mid-run to prove transparent NM-path
-fallback + automatic re-engagement (zero steady-state fallbacks on
-either side of the fault), and runs the rpc dispatch micro-bench
-guarding the compiled-validator satellite.
+the native frame pump + GIL-free dispatch core engaged (default), with
+the pump forced off (RTPU_NO_NATIVE=1: the pure-Python fallback mode,
+recorded side by side so a regression in EITHER mode is caught by the
+bench record itself), and over the NM-mediated path
+(direct_actor_calls=0) in fresh sessions. Also injects a channel death
+mid-run to prove transparent NM-path fallback + automatic
+re-engagement (zero steady-state fallbacks on either side of the
+fault), and runs the rpc dispatch micro-bench guarding the
+compiled-validator satellite.
+
+New in r09 (ISSUE 12): a per-phase GIL-handoff probe — interpreter
+entries the channel readers made vs frames received, proving where the
+cycles went (one Python entry per burst, not per frame) — and a
+1M-queued-task drain row that records the driver's RSS beside the
+drain rate.
 
 Usage: python tools/run_actor_bench.py [out.json] [--calls N]
+       [--queued N]
 
 `make perf-actor` runs the default configuration and MERGES the record
-into PERF_r08.json (make perf-native writes its sections into the same
+into PERF_r09.json (make perf-native writes its sections into the same
 file).
 """
 
@@ -106,8 +114,38 @@ def _measure_mode(direct: bool, calls: int, native: bool = True):
             for _ in range(100):
                 ray_tpu.get(p.ping.remote())
 
+        def gil_probe():
+            if not direct:
+                return None
+            from ray_tpu.core.runtime_context import current_runtime
+
+            return dict(current_runtime().direct_stats()["gil_probe"])
+
+        def probe_delta(before, after):
+            if not before or not after:
+                return None
+            entries = after["py_entries"] - before["py_entries"]
+            frames = after["frames_in"] - before["frames_in"]
+            comps = (after.get("completions", 0)
+                     - before.get("completions", 0))
+            return {
+                "py_entries": entries,
+                "frames_in": frames,
+                "completions": comps,
+                # < 1.0 = the dispatch core coalesced: fewer interpreter
+                # entries than frames received / completions applied
+                # (the ISSUE 12 bar). Replies already batched into one
+                # DONE_BATCH frame show up in entries_per_completion.
+                "entries_per_frame": round(entries / frames, 3)
+                if frames else None,
+                "entries_per_completion": round(entries / comps, 3)
+                if comps else None,
+            }
+
+        g0 = gil_probe()
         out["unloaded"] = _sync_rtt(ray_tpu, lambda: p.ping.remote(),
                                     calls)
+        g1 = gil_probe()
 
         stop = threading.Event()
         bg_count = [0]
@@ -121,10 +159,18 @@ def _measure_mode(direct: bool, calls: int, native: bool = True):
         t = threading.Thread(target=load, daemon=True)
         t.start()
         time.sleep(0.5)
+        g2 = gil_probe()
         out["loaded"] = _sync_rtt(ray_tpu, lambda: p.ping.remote(), calls)
+        g3 = gil_probe()
         stop.set()
         t.join(timeout=30)
         out["loaded"]["background_calls"] = bg_count[0]
+        if direct:
+            out["gil_handoff"] = {
+                "unloaded": probe_delta(g0, g1),
+                "loaded": probe_delta(g2, g3),
+                "native_tables": (g3 or {}).get("native_tables"),
+            }
 
         if direct:
             from ray_tpu.core import frame_pump
@@ -178,6 +224,60 @@ def _measure_mode(direct: bool, calls: int, native: bool = True):
     return out
 
 
+def _rss_bytes() -> int:
+    """Current driver RSS (VmRSS, not the ru_maxrss peak: the drain bar
+    is about what the steady submit path HOLDS, not what a transient
+    spike touched)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+def queued_drain_row(n: int):
+    """The 1M-queued-task envelope with the driver-footprint bar: submit
+    N noops, record RSS right after the submit burst (when the pending
+    bookkeeping peaks) and again after the drain, plus the drain rate.
+    GC grace widened as in run_native_bench.py (flush-lag race on
+    shares-throttled boxes, unrelated to what this row measures)."""
+    import resource
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, system_config={"log_to_driver": False,
+                                            "gc_grace_period_s": 120.0})
+    try:
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        ray_tpu.get([noop.remote() for _ in range(20)])
+        t0 = time.perf_counter()
+        queued = [noop.remote() for _ in range(n)]
+        submit_dt = time.perf_counter() - t0
+        rss_after_submit = _rss_bytes()
+        ray_tpu.get(queued, timeout=1200)
+        total_dt = time.perf_counter() - t0
+        rss_after_drain = _rss_bytes()
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        return {
+            "num_queued": n,
+            "submit_ops_s": round(n / submit_dt, 1),
+            "drain_ops_s": round(n / total_dt, 1),
+            "driver_rss_after_submit_gb": round(rss_after_submit / 1e9, 3),
+            "driver_rss_after_drain_gb": round(rss_after_drain / 1e9, 3),
+            "driver_rss_peak_gb": round(peak / 1e9, 3),
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
 def _rpc_dispatch_bench(n: int = 50_000):
     """Compiled-validator dispatch throughput (server hot path)."""
     import asyncio
@@ -215,10 +315,14 @@ def main():
     args = sys.argv[1:]
     out_path = None
     calls = 3000
+    queued = 1_000_000
     i = 0
     while i < len(args):
         if args[i] == "--calls":
             calls = int(args[i + 1])
+            i += 2
+        elif args[i] == "--queued":
+            queued = int(args[i + 1])
             i += 2
         else:
             out_path = args[i]
@@ -233,18 +337,24 @@ def main():
         except Exception:
             result = {}
     result["note"] = (
-        "Round-8 record for the direct actor-call plane on the native "
-        "frame pump. direct (pump engaged), direct_fallback "
-        "(RTPU_NO_NATIVE=1: pure-Python dialect) and nm_path "
-        "(RAY_TPU_DIRECT_ACTOR_CALLS=0) run the SAME build in fresh "
-        "sessions. loaded = sync round-trips while a second actor "
-        "serves a 64-deep pipelined background stream."
+        "Round-9 record for the direct actor-call plane on the GIL-free "
+        "dispatch core (ISSUE 12: pending/replay table, waiter wakeups "
+        "and completion application in the rts_pump extension). direct "
+        "(pump + native tables engaged), direct_fallback "
+        "(RTPU_NO_NATIVE=1: pure-Python mirror tables + pickle dialect) "
+        "and nm_path (RAY_TPU_DIRECT_ACTOR_CALLS=0) run the SAME build "
+        "in fresh sessions. loaded = sync round-trips while a second "
+        "actor serves a 64-deep pipelined background stream. "
+        "gil_handoff = interpreter entries the channel readers made vs "
+        "frames received, per phase."
     )
-    result["config"] = {"physical_cores": os.cpu_count(), "calls": calls}
+    result["config"] = {"physical_cores": os.cpu_count(), "calls": calls,
+                        "queued": queued}
     result["direct"] = _measure_mode(direct=True, calls=calls)
     result["direct_fallback"] = _measure_mode(direct=True, calls=calls,
                                               native=False)
     result["nm_path"] = _measure_mode(direct=False, calls=calls)
+    result["queued_drain_1m"] = queued_drain_row(queued)
     d, n = result["direct"], result["nm_path"]
     result["speedup_direct_vs_nm"] = {
         "unloaded_ops": round(
@@ -297,31 +407,43 @@ def main():
                 "native_pump", {}).get("native_fallbacks_total"),
         },
     }
-    vs_r07 = {}
-    r07_path = os.path.join(_REPO, "PERF_r07.json")
-    if os.path.exists(r07_path):
+    vs_r08 = {}
+    r08_path = os.path.join(_REPO, "PERF_r08.json")
+    if os.path.exists(r08_path):
         try:
-            with open(r07_path) as f:
-                r07 = json.load(f)
-            vs_r07 = {
-                "r07_loaded_ops_s": r07["direct"]["loaded"]["ops_s_best"],
-                "r07_unloaded_ops_s":
-                    r07["direct"]["unloaded"]["ops_s_best"],
-                "loaded_ops_vs_r07": round(
+            with open(r08_path) as f:
+                r08 = json.load(f)
+            drain08 = r08.get("native_queued_task_drain", {})
+            vs_r08 = {
+                "r08_loaded_ops_s": r08["direct"]["loaded"]["ops_s_best"],
+                "r08_unloaded_ops_s":
+                    r08["direct"]["unloaded"]["ops_s_best"],
+                "loaded_ops_vs_r08": round(
                     d["loaded"]["ops_s_best"]
-                    / r07["direct"]["loaded"]["ops_s_best"], 2),
-                "unloaded_ops_vs_r07": round(
+                    / r08["direct"]["loaded"]["ops_s_best"], 2),
+                "unloaded_ops_vs_r08": round(
                     d["unloaded"]["ops_s_best"]
-                    / r07["direct"]["unloaded"]["ops_s_best"], 2),
-                "loaded_p50_vs_r07": round(
-                    r07["direct"]["loaded"]["p50_us"]
+                    / r08["direct"]["unloaded"]["ops_s_best"], 2),
+                "loaded_p50_vs_r08": round(
+                    r08["direct"]["loaded"]["p50_us"]
                     / max(1e-9, d["loaded"]["p50_us"]), 2),
-                "target": ">=2x r07 loaded ops",
+                "r08_drain_ops_s": drain08.get("drain_ops_s"),
+                "r08_driver_rss_gb": drain08.get(
+                    "driver_rss_after_submit_gb"),
             }
         except Exception:
             pass
+    drain = result.get("queued_drain_1m", {})
+    gh = d.get("gil_handoff", {}) or {}
+    loaded_ratio = round(
+        d["loaded"]["ops_s_best"]
+        / max(1e-9, fb["loaded"]["ops_s_best"]), 2)
     result["acceptance"] = {
-        "reference_bar": ">=5.0k/s loaded sync actor RTT (reference box)",
+        "round6_bars": (
+            "loaded in-suite >=5k/s; 1M-drain >=15k ops/s; driver RSS "
+            "<=1.5 GB; native loaded RTT >=1.8x forced-fallback; "
+            "steady-state native_fallbacks 0; 20/20 exactly-once replay"
+        ),
         "same_box_result": (
             f"direct plane {result['speedup_direct_vs_nm']['loaded_ops']}x "
             f"the NM path on loaded ops "
@@ -330,7 +452,14 @@ def main():
             f"loaded p50 {d['loaded']['p50_us']}us vs NM "
             f"{n['loaded']['p50_us']}us"
         ),
-        "vs_perf_r07": vs_r07,
+        "native_vs_forced_fallback_loaded": loaded_ratio,
+        "drain_1m": {
+            "drain_ops_s": drain.get("drain_ops_s"),
+            "driver_rss_after_submit_gb": drain.get(
+                "driver_rss_after_submit_gb"),
+        },
+        "gil_handoff_loaded": gh.get("loaded"),
+        "vs_perf_r08": vs_r08,
         "fallback_pulls_steady_state": d.get("direct_stats", {}).get(
             "fallbacks_steady_state"),
         "injected_channel_death": (
