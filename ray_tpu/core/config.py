@@ -256,6 +256,18 @@ class Config:
     # Hard cap on dashboard /api/profile sampling duration (seconds);
     # the sampler itself clamps to util/profiler.MAX_SAMPLE_SECONDS.
     profile_max_seconds: float = 15.0
+    # --- request waterfalls & flight recorder (util/flight_recorder.py) --
+    # Per-process ring of retained request records (tail sampling keeps
+    # only slow/shed/expired/errored/chaos-hit requests).
+    flight_recorder_size: int = 256
+    # Slowness floor: a request is retained as "slow" when it exceeds
+    # max(this, the recorder's rolling ~p99 of recent durations).
+    flight_recorder_slow_s: float = 1.0
+    # Dapper-style span sampling for the direct-call CLIENT span: record
+    # the call:<method> round-trip span for every Nth call per channel
+    # (1 = every call). Context propagation is unaffected — ids always
+    # ride the frames, so worker-side spans stay parented regardless.
+    trace_client_span_every: int = 8
 
     def __post_init__(self):
         for f in dataclasses.fields(self):

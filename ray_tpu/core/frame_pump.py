@@ -42,7 +42,14 @@ from .protocol import (MAX_FRAME, Connection, ConnectionClosed,
                        dumps_msg, loads_msg)
 
 MAGIC = 0xA7
-CODEC_VER = 1
+# v2 adds the optional _HAS_TRACE block on F_CALL ((trace_id, span_id)
+# utf-8 strings right after the flags byte). Peers negotiate
+# min(offered, supported) via "npv", so a v2 side facing a v1 peer emits
+# v1 frames (trace=None) — the flag never reaches a decoder that cannot
+# read it.
+CODEC_VER = 2
+# Lowest negotiated version whose call frames may carry trace context.
+TRACE_MIN_VER = 2
 
 F_CALL = 0x01
 F_DONE = 0x02
@@ -54,6 +61,7 @@ _ARG_REF = 0
 _ARG_VALUE = 1
 _HAS_ARGS = 0x01
 _HAS_NESTED = 0x02
+_HAS_TRACE = 0x04
 
 # ---- metric surface (declared at import for tools/check_metric_names.py) ---
 
@@ -162,12 +170,16 @@ def advertised_ver() -> int:
 
 
 def encode_call(tmpl: int, task_id: bytes, seq: int, deadline: float,
-                args, kwargs, nested) -> Optional[bytes]:
+                args, kwargs, nested, trace=None) -> Optional[bytes]:
+    """``trace`` is a (trace_id, span_id) str 2-tuple carried on codec
+    v2 call frames, or None; callers MUST pass None on channels whose
+    negotiated npv is below :data:`TRACE_MIN_VER`."""
     m = _module()
     if m is not None:
         return m.encode_call(tmpl, task_id, seq, deadline, args, kwargs,
-                             nested)
-    return py_encode_call(tmpl, task_id, seq, deadline, args, kwargs, nested)
+                             nested, trace)
+    return py_encode_call(tmpl, task_id, seq, deadline, args, kwargs,
+                          nested, trace)
 
 
 def encode_done(done: Dict[str, Any]) -> Optional[bytes]:
@@ -273,11 +285,23 @@ def _py_lower_arg(out: bytearray, arg) -> bool:
 
 
 def py_encode_call(tmpl, task_id, seq, deadline, args, kwargs,
-                   nested) -> Optional[bytes]:
+                   nested, trace=None) -> Optional[bytes]:
     from .ids import ObjectID
 
     if len(task_id) > 255:
         return None
+    trace_parts = None
+    if trace is not None:
+        if not isinstance(trace, tuple) or len(trace) != 2:
+            return None
+        trace_parts = []
+        for part in trace:
+            if not isinstance(part, str):
+                return None
+            raw = part.encode("utf-8")
+            if len(raw) > 255:
+                return None
+            trace_parts.append(raw)
     has_args = bool(args) or bool(kwargs)
     has_nested = bool(nested)
     out = bytearray(_CALL_HDR.pack(MAGIC, F_CALL, tmpl, seq))
@@ -285,7 +309,12 @@ def py_encode_call(tmpl, task_id, seq, deadline, args, kwargs,
     out += task_id
     out += _F64.pack(deadline)
     out.append((_HAS_ARGS if has_args else 0)
-               | (_HAS_NESTED if has_nested else 0))
+               | (_HAS_NESTED if has_nested else 0)
+               | (_HAS_TRACE if trace_parts is not None else 0))
+    if trace_parts is not None:
+        for raw in trace_parts:
+            out.append(len(raw))
+            out += raw
     if has_args:
         if not isinstance(args, list) or (
                 kwargs is not None and not isinstance(kwargs, dict)):
@@ -438,6 +467,10 @@ def _py_decode_call(c: _Cursor) -> Dict[str, Any]:
     out: Dict[str, Any] = {"type": "execute", "t": tmpl, "i": tid, "q": seq}
     if deadline != 0.0:
         out["d"] = deadline
+    if flags & _HAS_TRACE:
+        trace_id = c.take(c.u8()).decode("utf-8")
+        span_id = c.take(c.u8()).decode("utf-8")
+        out["tc"] = (trace_id, span_id)
     if flags & _HAS_ARGS:
         args = [_py_read_arg(c) for _ in range(c.u32())]
         kwargs = {}

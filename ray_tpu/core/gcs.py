@@ -183,6 +183,12 @@ GCS_SERVICES = (
                request=(("seconds", "float", False, 2.0),
                         ("hz", "int", False, 100)),
                reply=(("nodes", "list"), ("errors", "dict"))),
+        Method("traces_dump",
+               # Flight-recorder fan-out (util/flight_recorder.py): each
+               # node returns its tail-sampled request-record ring.
+               request=(("reason", "str", False, ""),
+                        ("limit", "int", False, 200)),
+               reply=(("nodes", "list"), ("errors", "dict"))),
     )),
     ServiceSpec("MetaService", (
         Method("rpc_describe", reply=(("services", "dict"),)),
@@ -918,6 +924,12 @@ class GcsService:
             per_node_timeout=seconds + 10.0,
         )
 
+    async def _rpc_traces_dump(self, node_id, reason="", limit=200):
+        return await self._profile_fanout(
+            {"type": "traces_dump", "reason": reason, "limit": limit},
+            per_node_timeout=10.0,
+        )
+
     async def _profile_fanout(self, frame, per_node_timeout: float):
         """ProfileService core: issue ``frame`` to every alive node over
         its peer channel concurrently; unreachable/late nodes land in
@@ -1634,6 +1646,11 @@ class LocalGcsHandle:
             None, seconds=seconds, hz=hz
         )
 
+    async def traces_dump(self, reason="", limit=200):
+        return await self._svc._rpc_traces_dump(
+            None, reason=reason, limit=limit
+        )
+
     async def rpc_describe(self):
         return self._svc._rpc.describe()
 
@@ -1833,6 +1850,13 @@ class RemoteGcsHandle:
         r = await self._client.request(
             {"op": "profile_run", "seconds": seconds, "hz": hz},
             timeout=seconds + 30.0,
+        )
+        return {"nodes": r["nodes"], "errors": r["errors"]}
+
+    async def traces_dump(self, reason="", limit=200):
+        r = await self._client.request(
+            {"op": "traces_dump", "reason": reason, "limit": limit},
+            timeout=30.0,
         )
         return {"nodes": r["nodes"], "errors": r["errors"]}
 

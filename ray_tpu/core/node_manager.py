@@ -1567,6 +1567,7 @@ class NodeManager:
             while True:
                 msg = await aio_read_frame(reader)
                 if msg.get("type") in ("stacks_dump", "profile_run",
+                                       "traces_dump",
                                        "get_actor_direct_peer",
                                        "drain", "replicate_object"):
                     # Long-running introspection/resolution must not
@@ -1711,6 +1712,13 @@ class NodeManager:
         if mtype == "profile_run":
             return {"result": await self.profile_run(
                 seconds=msg.get("seconds", 2.0), hz=msg.get("hz", 100)
+            )}
+        if mtype == "traces_dump":
+            # GCS ProfileService fan-out: this node's flight-recorder
+            # ring (same reach discipline as stacks_dump).
+            return {"result": self.traces_dump(
+                reason=msg.get("reason") or None,
+                limit=msg.get("limit", 200),
             )}
         raise RuntimeError(f"unknown peer message {mtype}")
 
@@ -4404,6 +4412,31 @@ class NodeManager:
                     "errors": {}}
         return await self._gcs.profile_run(seconds=seconds, hz=hz)
 
+    def traces_dump(self, reason: Optional[str] = None,
+                    limit: int = 200) -> Dict[str, Any]:
+        """This node's tail-sampled flight-recorder ring (the node
+        manager shares a process with the driver/head ingress, so the
+        proxy's retained requests live here; worker rings mirror through
+        the cluster KV)."""
+        from ..util import flight_recorder
+
+        rec = flight_recorder.get_recorder()
+        return {
+            "node_id": self.node_id.hex(),
+            "is_head": self.is_head,
+            "records": rec.list(reason=reason, limit=limit),
+            "stats": rec.stats(),
+        }
+
+    async def cluster_traces(self, reason: Optional[str] = None,
+                             limit: int = 200) -> Dict[str, Any]:
+        """Cluster-wide flight-recorder dump via the GCS fan-out."""
+        if self._gcs is None:
+            return {"nodes": [self.traces_dump(reason, limit)],
+                    "errors": {}}
+        return await self._gcs.traces_dump(reason=reason or "",
+                                           limit=limit)
+
     async def _handle_profile_query(self, w: WorkerHandle, msg):
         out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
         try:
@@ -4415,6 +4448,11 @@ class NodeManager:
                 out["result"] = await self.cluster_profile(
                     seconds=msg.get("seconds", 2.0),
                     hz=msg.get("hz", 100),
+                )
+            elif msg.get("op") == "traces":
+                out["result"] = await self.cluster_traces(
+                    reason=msg.get("reason") or None,
+                    limit=msg.get("limit", 200),
                 )
             else:
                 out["error"] = f"unknown profile op {msg.get('op')!r}"

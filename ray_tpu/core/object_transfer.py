@@ -38,6 +38,7 @@ dedup.
 from __future__ import annotations
 
 import asyncio
+import functools
 import pickle
 import threading
 import time
@@ -419,6 +420,8 @@ class ObjectTransfer:
         """Stream ``[0, size)`` into the writer's shared-memory view,
         striped across the peer's data-channel pool. All socket IO runs
         on the transfer io pool; the control loop only awaits."""
+        from .timeline import current_span, get_buffer, new_span_id
+
         pool = self._get_pool(peer, data_port)
         stripes = plan_stripes(size, self.streams_per_peer,
                                self.chunk_bytes)
@@ -426,12 +429,19 @@ class ObjectTransfer:
         oid_b = oid.binary()
         peer_tag = peer.peer_hex[:8]
         loop = self._nm._loop
+        # Data-plane span: the pull (and each stripe under it) lands in
+        # the waterfall. The NM loop has no ambient request context, so
+        # a pull outside any traced request roots on the object id —
+        # still joinable by name from the timeline.
+        pull_ctx = current_span() or (oid.hex()[:32], "")
+        pull_sid = new_span_id()
+        pull_t0 = time.time()
         self._set_inflight(peer_tag, +1)
         try:
             futs = [
                 loop.run_in_executor(
                     self._io_pool, self._stripe_worker, pool, oid_b,
-                    off, length, view,
+                    off, length, view, (pull_ctx[0], pull_sid),
                 )
                 for off, length in stripes
             ]
@@ -460,16 +470,50 @@ class ObjectTransfer:
         finally:
             self._set_inflight(peer_tag, -1)
             view.release()
+            try:
+                # Record OFF the event loop: TaskEventBuffer.record may
+                # inline-flush to the cluster KV, which blocks — fine on
+                # an io-pool thread, a deadlock on the NM loop.
+                loop.run_in_executor(self._io_pool, functools.partial(
+                    get_buffer().record,
+                    f"pull:{oid.hex()[:8]}", pull_t0, time.time(), "",
+                    trace_id=pull_ctx[0], span_id=pull_sid,
+                    parent_id=pull_ctx[1],
+                ))
+            # Observability must never fail the pull it observes.
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass
         self._bump("striped_pulls")
         self._bump("bytes_pulled_stream", size)
 
     def _stripe_worker(self, pool: DataChannelPool, oid_b: bytes,
-                       offset: int, length: int, view: memoryview):
+                       offset: int, length: int, view: memoryview,
+                       span_parent=None):
         """Executor-thread body: borrow a channel, stream one stripe
         directly into the destination view. The acquire wait is bounded
         by the IO timeout, not the connect timeout — waiting for a busy
         channel means another stripe is mid-transfer, which is
         data-volume-bound."""
+        t0 = time.time()
+        try:
+            self._stripe_pull(pool, oid_b, offset, length, view)
+        finally:
+            if span_parent is not None:
+                try:
+                    from .timeline import get_buffer, new_span_id
+
+                    get_buffer().record(
+                        f"stripe:+{offset}", t0, time.time(), "",
+                        trace_id=span_parent[0],
+                        span_id=new_span_id(),
+                        parent_id=span_parent[1],
+                    )
+                # As above: a lost stripe span only blanks telemetry.
+                except Exception:  # rtlint: disable=swallowed-failure
+                    pass
+
+    def _stripe_pull(self, pool: DataChannelPool, oid_b: bytes,
+                     offset: int, length: int, view: memoryview):
         ch = pool.acquire(timeout=self._nm.config.transfer_io_timeout_s)
         try:
             ch.pull_range(oid_b, offset, length, view)

@@ -147,14 +147,17 @@ class RpcError(Exception):
 def negotiate_codec(offered: Any, supported: int) -> int:
     """Version handshake for an optional binary frame codec riding a
     framed channel (the direct plane's native pump dialect, "npv" in the
-    hello/welcome): each side advertises the codec version it speaks
-    (0/absent = pickle only) and a side may EMIT native frames only when
-    the peer offered exactly its own version. Returns the agreed version
-    (0 = stay on pickle). Strict equality, not min(): codec layouts are
-    not negotiable ranges, and a skewed peer must land on the always-
-    correct pickle dialect, mirroring DIRECT_PROTO_VER's fallback
-    discipline."""
-    return supported if supported and offered == supported else 0
+    hello/welcome): each side advertises the HIGHEST codec version it
+    speaks (0/absent = pickle only) and both sides settle on
+    ``min(offered, supported)`` — codec v2 is a strict superset of v1
+    (the trace block is flag-gated and only emitted at npv >= 2), so a
+    skewed pair lands on the older dialect rather than dropping to
+    pickle. Returns the agreed version (0 = stay on pickle); anything
+    that is not a positive int offer negotiates to 0, mirroring
+    DIRECT_PROTO_VER's fallback discipline."""
+    if not supported or not isinstance(offered, int) or offered < 1:
+        return 0
+    return min(offered, supported)
 
 
 class ServiceRegistry:

@@ -1147,6 +1147,11 @@ class _DirectChannel:
         from .rpc import negotiate_codec
 
         self.native = False
+        # Agreed codec version (0 = pickle only): gates which FEATURES
+        # of the native dialect this side may emit — trace context rides
+        # call frames only at npv >= frame_pump.TRACE_MIN_VER, so a v1
+        # peer keeps working (traceless) instead of dropping to pickle.
+        self.npv = 0
         if not frame_pump.advertised_ver():
             # Knob off or .so missing: this channel runs pure-Python.
             frame_pump.count_fallback(
@@ -1160,6 +1165,8 @@ class _DirectChannel:
             if wrapped is not None:
                 self.conn = wrapped
                 self.native = True
+                self.npv = negotiate_codec(welcome.get("npv"),
+                                           frame_pump.advertised_ver())
         else:
             frame_pump.count_fallback("no_peer")
         # Can this process read same-node shared-memory result locations?
@@ -1200,6 +1207,11 @@ class _DirectChannel:
         self._fence_seq = itertools.count(1)
         # Per-handle monotonic call sequence (stamped as "q" on frames).
         self._seq = itertools.count(1)
+        # Dapper-style client-span sampling: record the call:<method>
+        # round-trip span (and its latency exemplar) for every Nth call.
+        self._span_every = max(
+            1, int(getattr(get_config(), "trace_client_span_every", 8))
+        )
         # Call-frame templates (wire-size fast path): the first call of a
         # given (method, group) shape ships its full spec and registers
         # it under a small id; subsequent calls ship ~60-byte frames of
@@ -1271,17 +1283,28 @@ class _DirectChannel:
                     # the worker's template copy carries the FIRST
                     # call's value, not this one's.
                     frame["d"] = spec.deadline_ts
+                if spec.trace_ctx is not None:
+                    # Trace context likewise: the template copy carries
+                    # the FIRST call's ctx — without this, the compact
+                    # dialect severs the proxy→replica→nested tree.
+                    frame["tc"] = spec.trace_ctx
         with self.plock:
             if self.failed:
                 raise ConnectionError("direct channel failed")
             seq = next(self._seq)
             out: Any
             if frame is None:
+                # Trace context rides the native call frame only on
+                # channels that negotiated codec v2+; a v1 peer gets
+                # byte-identical v1 frames (traceless) instead.
+                trace = (spec.trace_ctx
+                         if self.npv >= frame_pump.TRACE_MIN_VER
+                         else None)
                 try:
                     out = frame_pump.encode_call(
                         tmpl, spec.task_id.binary(), seq,
                         spec.deadline_ts or 0.0, spec.args, spec.kwargs,
-                        spec.nested_refs,
+                        spec.nested_refs, trace,
                     )
                 except Exception:
                     frame_pump.count_fallback("codec_error")
@@ -1296,6 +1319,8 @@ class _DirectChannel:
                         out["n"] = spec.nested_refs
                     if spec.deadline_ts:
                         out["d"] = spec.deadline_ts
+                    if spec.trace_ctx is not None:
+                        out["tc"] = spec.trace_ctx
             else:
                 frame["q"] = seq
                 out = frame
@@ -1420,7 +1445,27 @@ class _DirectChannel:
         entry.payload = msg
         entry.event.set()
         self.rt._direct_waiters.mark_resolved(call.oid.binary())
-        _CALL_SECONDS_DIRECT.observe(time.monotonic() - call.t0)
+        dur = time.monotonic() - call.t0
+        ctx = getattr(call.spec, "trace_ctx", None)
+        if ctx is not None and call.seq % self._span_every == 0:
+            # Sampled client-side round-trip span + metric exemplar: the
+            # queue-wait/execution split lives in the worker's spans;
+            # this one bounds the whole submit→reply window and links
+            # the latency histogram bucket to a retrievable trace id.
+            _CALL_SECONDS_DIRECT.observe(dur, exemplar=ctx[0])
+            try:
+                from .timeline import record_span
+
+                end = time.time()
+                record_span(
+                    f"call:{call.spec.method_name or 'task'}",
+                    end - dur, end, parent=(ctx[0], ctx[1]),
+                )
+            # Observability must never fail the call it observes.
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass
+        else:
+            _CALL_SECONDS_DIRECT.observe(dur)
         self.rt._direct_on_done(msg, call.dep_ids, self)
 
     def gil_probe(self) -> Dict[str, int]:
@@ -1753,6 +1798,15 @@ class DriverRuntime(BaseRuntime):
             timeout=min(float(seconds), 30.0) + 30.0,
         )
 
+    def cluster_traces(self, reason: Optional[str] = None,
+                       limit: int = 200) -> Dict[str, Any]:
+        """Cluster-wide flight-recorder dump (backing for `rtpu trace` /
+        dashboard /api/traces, via the GCS ProfileService fan-out)."""
+        return self._nm.call_sync(
+            self._nm.cluster_traces(reason=reason, limit=limit),
+            timeout=30.0,
+        )
+
     def cluster_resources(self) -> Dict[str, float]:
         views = self.nodes()
         if len(views) <= 1:
@@ -2073,6 +2127,17 @@ class WorkerRuntime(BaseRuntime):
         reply = self.request(
             {"type": "profile", "op": "stacks", "timeout": timeout},
             timeout=timeout + 15.0,
+        )
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return reply["result"]
+
+    def cluster_traces(self, reason: Optional[str] = None,
+                       limit: int = 200) -> Dict[str, Any]:
+        reply = self.request(
+            {"type": "profile", "op": "traces", "reason": reason or "",
+             "limit": limit},
+            timeout=45.0,
         )
         if reply.get("error"):
             raise RuntimeError(reply["error"])

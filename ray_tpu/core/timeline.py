@@ -34,6 +34,24 @@ FLUSH_INTERVAL_S = 0.5
 
 _ctx = threading.local()
 
+# Span RECORDING kill switch (context propagation is unaffected — ids
+# still ride the frames so remote spans stay parented). RAY_TPU_NO_TRACE=1
+# disables recording process-wide; tools/run_actor_bench.py's
+# tracing-overhead row flips it at runtime via set_enabled().
+_ENABLED = os.environ.get("RAY_TPU_NO_TRACE") != "1"
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip span recording; returns the previous state."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(on)
+    return prev
+
 
 # Span-id minting is on the per-task execution hot path (worker_main
 # stamps one per task): uuid4 costs an os.urandom syscall per id (~50us
@@ -115,6 +133,38 @@ def exit_span(prev) -> None:
     _ctx.span = prev
 
 
+def span_event(name: str) -> None:
+    """Zero-duration marker span parented to the thread's ACTIVE span —
+    how point decisions (admission-gate sheds, breaker trips, deadline
+    expiries, chaos firings) land inside a request's waterfall. No-op
+    without an active span or with recording disabled: markers annotate
+    a request tree, they never root an orphan one."""
+    if not _ENABLED:
+        return
+    ctx = current_span()
+    if ctx is None:
+        return
+    now = time.time()
+    get_buffer().record(name, now, now, "", trace_id=ctx[0],
+                        span_id=new_span_id(), parent_id=ctx[1])
+
+
+def record_span(name: str, start: float, end: float,
+                parent: Optional[tuple] = None) -> Optional[str]:
+    """Record one completed span under ``parent`` ((trace_id, span_id),
+    default: the thread's active context). Returns the new span id, or
+    None when nothing was recorded (no context / recording disabled)."""
+    if not _ENABLED:
+        return None
+    ctx = parent if parent is not None else current_span()
+    if ctx is None:
+        return None
+    sid = new_span_id()
+    get_buffer().record(name, start, end, "", trace_id=ctx[0],
+                        span_id=sid, parent_id=ctx[1])
+    return sid
+
+
 class TaskEventBuffer:
     """Per-process span recorder (ref: TaskEventBuffer)."""
 
@@ -130,6 +180,8 @@ class TaskEventBuffer:
     def record(self, name: str, start: float, end: float,
                task_id: str = "", trace_id: str = "",
                span_id: str = "", parent_id: str = "") -> None:
+        if not _ENABLED:
+            return
         with self._lock:
             self._events.append({
                 "name": name,

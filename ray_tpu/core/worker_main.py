@@ -602,13 +602,18 @@ class Worker:
         # correct either way — npv only gates who EMITS native frames.
         from .rpc import negotiate_codec
 
-        want_native = not tls and bool(negotiate_codec(
+        agreed_npv = 0 if tls else negotiate_codec(
             hello.get("npv"), frame_pump.advertised_ver()
-        ))
+        )
+        want_native = bool(agreed_npv)
         try:
+            # Echo the AGREED version (min of the two offers), not our
+            # own: a v2 worker facing a v1 caller replies npv=1 so both
+            # sides emit v1 frames — the caller's trace block (v2) never
+            # reaches a decoder that cannot read it.
             conn.send({"type": "direct_welcome", "ok": True,
                        "ver": DIRECT_PROTO_VER,
-                       "npv": frame_pump.CODEC_VER if want_native else 0})
+                       "npv": agreed_npv})
         # Caller hung up before the welcome: nothing to serve; its
         # submit path falls back to the NM route and retries.
         except Exception:  # rtlint: disable=swallowed-failure
@@ -643,7 +648,12 @@ class Worker:
             else:
                 spec.args, spec.kwargs = [], {}
             spec.nested_refs = m.get("n", ())
-            spec.trace_ctx = None  # span derives from the new task id
+            # Codec v2 / compact-dict frames carry the caller's trace
+            # context as "tc"; without it the span derives from the new
+            # task id (a fresh root — exactly the severed-tree bug this
+            # field exists to prevent).
+            tc = m.get("tc")
+            spec.trace_ctx = tuple(tc) if tc else None
             # Always reset: the template was copied from the FIRST call
             # of this shape and carries that call's deadline.
             spec.deadline_ts = m.get("d", 0.0)
@@ -721,6 +731,10 @@ class Worker:
                         return  # runaway gap: drop the connection
                     if len(group_futs) > 4096:
                         group_futs = [f for f in group_futs if not f.done()]
+                    # Frame-arrival stamp: execution start minus this is
+                    # the call's queue wait (seq parking + pool queueing),
+                    # recorded as its own span beside the execution span.
+                    recv_ts = time.time()
                     routed = []
                     for m in in_seq_order(items):
                         spec, blob = decode(m)
@@ -730,6 +744,7 @@ class Worker:
                         if gp is not None:
                             group_futs.append(gp.submit(
                                 self._run_direct, conn, spec, blob, remote,
+                                recv_ts,
                             ))
                         else:
                             routed.append((spec, blob))
@@ -737,12 +752,14 @@ class Worker:
                         for spec, blob in routed:
                             group_futs.append(self._pool.submit(
                                 self._run_direct, conn, spec, blob, remote,
+                                recv_ts,
                             ))
                     else:
                         for spec, blob in routed:
                             with self._serial_lock:
                                 done = self._run_task(
-                                    spec, blob, sample_resources=False)
+                                    spec, blob, sample_resources=False,
+                                    queued_ts=recv_ts)
                             self._note_direct_done(done, spec, remote)
                             with self._dr_lock:
                                 _, buf = self._dr_bufs.setdefault(
@@ -813,8 +830,10 @@ class Worker:
                   f"({e!r}); a borrowed-object release may be delayed",
                   file=sys.stderr)
 
-    def _run_direct(self, conn, spec, function_blob, remote=False):
-        done = self._run_task(spec, function_blob, sample_resources=False)
+    def _run_direct(self, conn, spec, function_blob, remote=False,
+                    queued_ts: float = 0.0):
+        done = self._run_task(spec, function_blob, sample_resources=False,
+                              queued_ts=queued_ts)
         self._note_direct_done(done, spec, remote)
         try:
             self._send_replies(conn, [done])
@@ -917,7 +936,8 @@ class Worker:
         self.conn.send(self._run_task(spec, function_blob))
 
     def _run_task(self, spec: TaskSpec, function_blob,
-                  to_nm: bool = False, sample_resources: bool = True) -> dict:
+                  to_nm: bool = False, sample_resources: bool = True,
+                  queued_ts: float = 0.0) -> dict:
         if spec.task_type == TaskType.ACTOR_TASK:
             with self._direct_seen_lock:
                 cached = self._direct_seen.get(spec.task_id.binary())
@@ -1059,6 +1079,16 @@ class Worker:
                     trace_id=trace_id, span_id=span_id,
                     parent_id=parent_id,
                 )
+                if queued_ts and _t0 > queued_ts:
+                    # Queue-wait half of the direct-call server split:
+                    # frame arrival -> execution start (seq parking +
+                    # pool queueing), a sibling of the execution span.
+                    get_buffer().record(
+                        f"queue:{spec.name or spec.method_name or 'task'}",
+                        queued_ts, _t0, spec.task_id.hex(),
+                        trace_id=trace_id, span_id=new_span_id(),
+                        parent_id=parent_id,
+                    )
             # Observability must never fail the task it observes.
             except Exception:  # rtlint: disable=swallowed-failure
                 pass

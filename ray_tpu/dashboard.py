@@ -250,6 +250,29 @@ class _Handler(BaseHTTPRequestHandler):
                     "samples": merged["samples"],
                 })
                 return
+            if path == "/api/traces":
+                # Tail-sampled flight recorder (util/flight_recorder.py):
+                # retained request records cluster-wide, or one trace's
+                # full waterfall. ?reason=slow|shed|expired|error|chaos
+                # &limit=200, or ?trace_id=<id>.
+                from urllib.parse import parse_qs, urlparse
+
+                from .util import flight_recorder
+
+                q = parse_qs(urlparse(self.path).query)
+                trace_id = (q.get("trace_id") or [None])[0]
+                if trace_id:
+                    self._json(flight_recorder.waterfall(trace_id))
+                    return
+                self._json({
+                    "records": flight_recorder.list_cluster(
+                        reason=(q.get("reason") or [None])[0],
+                        limit=int((q.get("limit") or ["200"])[0]),
+                    ),
+                    "slow_threshold_s": flight_recorder.get_recorder()
+                    .stats()["slow_threshold_s"],
+                })
+                return
             if path == "/metrics":
                 # Prometheus text exposition (ref analogue:
                 # _private/prometheus_exporter.py endpoint).

@@ -543,8 +543,12 @@ def _format_event(e) -> str:
     ts = time.strftime("%H:%M:%S", time.localtime(e.get("ts", 0)))
     node = (e.get("node_id") or "")[:8] or "-"
     msg = e.get("message", "")
+    # Events emitted inside an active span carry the request's trace id:
+    # copy it straight into `rtpu trace <id>` for the full waterfall.
+    trace = e.get("trace_id")
+    suffix = f" trace={trace}" if trace else ""
     return (f"{ts} {e.get('severity', '?'):7s} {e.get('source', '?'):12s} "
-            f"node={node} {msg}")
+            f"node={node} {msg}{suffix}")
 
 
 def cmd_events(args) -> int:
@@ -591,6 +595,51 @@ def cmd_events(args) -> int:
             return 0
         finally:
             sub.close()
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_trace(args) -> int:
+    """Tail-sampled flight recorder: list retained request records
+    (slow / shed / deadline-expired / errored / chaos-hit) aggregated
+    cluster-wide, or — with a trace id — print that request's full
+    waterfall joined from the span timeline."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.util import flight_recorder
+
+        if args.trace_id:
+            tree = flight_recorder.waterfall(args.trace_id)
+            if args.json:
+                print(json.dumps(tree, indent=2, default=str))
+            else:
+                print(flight_recorder.format_waterfall(tree))
+            return 0
+        reason = None
+        for flag, value in (("slow", "slow"), ("errors", "error"),
+                            ("shed", "shed"), ("expired", "expired"),
+                            ("chaos", "chaos")):
+            if getattr(args, flag, False):
+                reason = value
+        rows = flight_recorder.list_cluster(reason=reason,
+                                            limit=args.limit)
+        if args.json:
+            print(json.dumps(rows, indent=2, default=str))
+            return 0
+        if not rows:
+            print("flight recorder: no retained requests"
+                  + (f" (reason={reason})" if reason else ""))
+            return 0
+        print(f"{'WHEN':8} {'REASON':8} {'STATUS':18} {'MS':>9} "
+              f"{'TRACE':32} NAME")
+        for r in rows:
+            when = time.strftime("%H:%M:%S", time.localtime(r["ts"]))
+            print(f"{when:8} {r['reason']:8} {r['status'][:18]:18} "
+                  f"{r['duration_s'] * 1e3:>9.1f} "
+                  f"{(r.get('trace_id') or '-'):32} {r['name']}")
+        print(f"({len(rows)} record(s); `rtpu trace <trace-id>` for a "
+              f"waterfall)")
+        return 0
     finally:
         ray_tpu.shutdown()
 
@@ -1051,6 +1100,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--json", action="store_true")
     _add_address(p)
     p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser("trace",
+                       help="tail-sampled request waterfalls (flight "
+                            "recorder)")
+    p.add_argument("trace_id", nargs="?", default=None,
+                   help="print this trace's waterfall instead of the "
+                        "retained-request list")
+    p.add_argument("--slow", action="store_true",
+                   help="only requests retained as slow (rolling ~p99)")
+    p.add_argument("--errors", action="store_true",
+                   help="only errored requests")
+    p.add_argument("--shed", action="store_true",
+                   help="only overload-shed requests")
+    p.add_argument("--expired", action="store_true",
+                   help="only deadline-expired requests")
+    p.add_argument("--chaos", action="store_true",
+                   help="only chaos-hit records")
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--json", action="store_true")
+    _add_address(p)
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("summary",
                        help="task/actor/object summaries incl. failures")

@@ -110,11 +110,15 @@ RETRIES_TOTAL = Counter(
 
 
 def observe_ingress(deployment: str, protocol: str, code,
-                    started: float, ended: Optional[float] = None) -> None:
-    """One finished ingress request: latency histogram + status counter."""
+                    started: float, ended: Optional[float] = None,
+                    trace_id: Optional[str] = None) -> None:
+    """One finished ingress request: latency histogram + status counter.
+    ``trace_id`` lands as the bucket's OpenMetrics exemplar, so
+    `rtpu metrics` → offending trace is one hop."""
     ended = time.time() if ended is None else ended
     tags = {"deployment": deployment, "protocol": protocol}
-    REQUEST_LATENCY.observe(max(0.0, ended - started), tags=tags)
+    REQUEST_LATENCY.observe(max(0.0, ended - started), tags=tags,
+                            exemplar=trace_id)
     REQUESTS_TOTAL.inc(1, tags={**tags, "code": str(code)})
 
 
@@ -133,15 +137,23 @@ def update_router_gauges(deployment: str, handle_id: str,
 
 def observe_shed(deployment: str, scope: str) -> None:
     """One request shed before execution (proxy gate, replica limiter,
-    all-breakers-open router, or a suppressed retry)."""
+    all-breakers-open router, or a suppressed retry). Inside an active
+    request span the decision also lands as a zero-duration span event,
+    so the shed shows up in the request's recorded waterfall."""
+    from ..core.timeline import span_event
+
     SHED_TOTAL.inc(1, tags={"deployment": deployment or "anonymous",
                             "scope": scope})
+    span_event(f"shed:{scope}:{deployment or 'anonymous'}")
 
 
 def observe_deadline_exceeded(deployment: str, where: str) -> None:
+    from ..core.timeline import span_event
+
     DEADLINE_EXCEEDED_TOTAL.inc(
         1, tags={"deployment": deployment or "anonymous", "where": where}
     )
+    span_event(f"deadline:{where}:{deployment or 'anonymous'}")
 
 
 def observe_retry(deployment: str) -> None:
@@ -151,7 +163,9 @@ def observe_retry(deployment: str) -> None:
 def record_breaker_state(deployment: str, handle_id: str, replica: str,
                          state: str) -> None:
     """Published on breaker TRANSITIONS only (open/half-open/close are
-    rare), not per request."""
+    rare), not per request. A transition observed during a traced
+    request additionally lands as a span event in its waterfall."""
+    from ..core.timeline import span_event
     from ..util.overload import BREAKER_STATE_VALUES
 
     BREAKER_STATE.set(
@@ -159,6 +173,7 @@ def record_breaker_state(deployment: str, handle_id: str, replica: str,
         tags={"deployment": deployment or "anonymous",
               "handle": handle_id, "replica": replica},
     )
+    span_event(f"breaker:{state}:{replica}")
 
 
 def observe_replica_request(deployment: str, method: str,

@@ -126,6 +126,7 @@ class _GenericHandler:
             from ..core.timeline import (
                 enter_span,
                 exit_span,
+                format_traceparent,
                 get_buffer,
                 new_span_id,
                 new_trace_id,
@@ -143,9 +144,21 @@ class _GenericHandler:
             span_id = new_span_id()
             prev = enter_span(trace_id, span_id)
             started = time.time()
+            # The RPC's trace id returns to the caller as trailing
+            # metadata (the gRPC mirror of the HTTP traceparent response
+            # header): a user-visible DEADLINE_EXCEEDED / RESOURCE_
+            # EXHAUSTED correlates to its recorded waterfall in one hop.
+            try:
+                context.set_trailing_metadata((
+                    ("traceparent",
+                     format_traceparent(trace_id, span_id)),
+                ))
+            except Exception:
+                pass
 
             def finish(status_code: str):
                 from . import _telemetry
+                from ..util import flight_recorder
 
                 exit_span(prev)
                 ended = time.time()
@@ -154,7 +167,8 @@ class _GenericHandler:
                 dep_label = (dep_name if status_code != "NOT_FOUND"
                              else "__unknown__")
                 _telemetry.observe_ingress(
-                    dep_label, "grpc", status_code, started, ended
+                    dep_label, "grpc", status_code, started, ended,
+                    trace_id=trace_id,
                 )
                 try:
                     get_buffer().record(
@@ -164,6 +178,16 @@ class _GenericHandler:
                     )
                 except Exception:
                     pass
+                reason = {
+                    "RESOURCE_EXHAUSTED": "shed",
+                    "DEADLINE_EXCEEDED": "expired",
+                    "INTERNAL": "error",
+                    "UNAVAILABLE": "error",
+                }.get(status_code)
+                flight_recorder.observe_request(
+                    f"grpc:{dep_name}", trace_id, started, ended,
+                    status=status_code, reason=reason, surface="grpc",
+                )
 
             return finish
 

@@ -73,6 +73,16 @@ class _Handler(BaseHTTPRequestHandler):
         # do_POST's finally — covers the JSON, ASGI, and SSE paths.
         self._obs_status = code
         super().send_response(code, message)
+        # Every response names its trace (W3C traceparent), so a
+        # user-visible 504/503 correlates to its recorded waterfall
+        # (`rtpu trace <id>`) in one hop. ONE site covers the JSON,
+        # ASGI, SSE, and overload-shed reply paths.
+        trace = getattr(self, "_obs_trace", None)
+        if trace is not None:
+            from ..core.timeline import format_traceparent
+
+            self.send_header("traceparent",
+                             format_traceparent(trace[0], trace[1]))
 
     def _reply(self, code: int, payload):
         body = json.dumps(payload).encode()
@@ -86,6 +96,11 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
 
     def do_GET(self):
+        if self.path in ("/-/routes", "/-/healthz"):
+            # Not a traced request: clear any trace left by an earlier
+            # request on this keep-alive connection so the header
+            # cannot name a stale waterfall.
+            self._obs_trace = None
         if self.path == "/-/routes":
             self._reply(200, sorted(_state.routes))
         elif self.path == "/-/healthz":
@@ -220,6 +235,7 @@ class _Handler(BaseHTTPRequestHandler):
         # keep-alive connection, so a request that dies before
         # send_response must not inherit the previous request's status.
         self._obs_status = 500
+        self._obs_trace = (trace_id, span_id)
         started = time.time()
         try:
             self._route_request(name)
@@ -233,6 +249,7 @@ class _Handler(BaseHTTPRequestHandler):
             dep_label = (name or "/") if code != 404 else "__unknown__"
             _telemetry.observe_ingress(
                 dep_label, "http", code, started, ended,
+                trace_id=trace_id,
             )
             try:
                 get_buffer().record(
@@ -242,6 +259,22 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             except Exception:
                 pass
+            # Tail-sampled flight recorder: keep the full record for
+            # shed (503), deadline-expired (504), errored, or
+            # rolling-p99-slow requests; everything else is dropped.
+            from ..util import flight_recorder
+
+            reason = None
+            if code == 503:
+                reason = "shed"
+            elif code == 504:
+                reason = "expired"
+            elif code >= 500:
+                reason = "error"
+            flight_recorder.observe_request(
+                f"http:{name or '/'}", trace_id, started, ended,
+                status=code, reason=reason, surface="http",
+            )
 
     def _route_request(self, name: str):
         from urllib.parse import urlparse

@@ -100,6 +100,9 @@ class Checkpoint:
         checkpoint at ``path``."""
         import orbax.checkpoint as ocp
 
+        from ..core.timeline import record_span
+
+        save_t0 = time.time()
         path = os.path.abspath(path)
         parent = os.path.dirname(path) or "."
         os.makedirs(parent, exist_ok=True)
@@ -163,21 +166,41 @@ class Checkpoint:
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
+        # Checkpoint-save span in the rank's waterfall (the gang's
+        # restart analysis reads save/restore windows beside the steps).
+        try:
+            record_span(f"ckpt_save:{os.path.basename(path)}",
+                        save_t0, time.time())
+        # A lost span only blanks telemetry, never a commit.
+        except Exception:  # rtlint: disable=swallowed-failure
+            pass
         return cls(path)
 
     def as_pytree(self, target: Optional[Any] = None) -> Any:
         """Restore the pytree; ``target`` provides structure/shardings."""
         import orbax.checkpoint as ocp
 
+        from ..core.timeline import record_span
+
+        t0 = time.time()
         delay = faults.fire(faults.CHECKPOINT_IO, op="restore",
                             path=self.path)
         if delay:
             time.sleep(delay)
         ckptr = ocp.StandardCheckpointer()
         item = os.path.join(self.path, "pytree")
-        if target is not None:
-            return ckptr.restore(item, target)
-        return ckptr.restore(item)
+        try:
+            if target is not None:
+                return ckptr.restore(item, target)
+            return ckptr.restore(item)
+        finally:
+            try:
+                record_span(
+                    f"ckpt_restore:{os.path.basename(self.path)}",
+                    t0, time.time())
+            # A lost span only blanks telemetry, never a restore.
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass
 
     def metadata(self) -> Dict:
         p = os.path.join(self.path, "metadata.json")

@@ -160,8 +160,23 @@ class CompiledTrainStep:
 
     def __call__(self, params, opt_state, tokens):
         """One fused step: returns (params, opt_state, loss). The input
-        params/opt_state buffers are DONATED — dead after the call."""
-        return self._step(params, opt_state, tokens)
+        params/opt_state buffers are DONATED — dead after the call.
+        Each step records a ``train_step`` span under the rank's active
+        trace (no-op outside one), so a gang's waterfall shows step
+        cadence beside checkpoint save/restore windows."""
+        import time as _time
+
+        from ..core.timeline import record_span
+
+        t0 = _time.time()
+        try:
+            return self._step(params, opt_state, tokens)
+        finally:
+            try:
+                record_span("train_step", t0, _time.time())
+            # A lost span only blanks telemetry, never a step.
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass
 
     # ------------------------------------------------------ diagnostics
 

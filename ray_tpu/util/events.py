@@ -66,10 +66,14 @@ def make_event(severity: str, source: str, message: str, *,
                job_id: Optional[str] = None,
                task_id: Optional[str] = None,
                actor_id: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               span_id: Optional[str] = None,
                custom_fields: Optional[Dict[str, Any]] = None
                ) -> Dict[str, Any]:
     """Build one typed event record. Severity/source must come from the
-    declared enums — unknown values raise so emit sites stay lintable."""
+    declared enums — unknown values raise so emit sites stay lintable.
+    ``trace_id``/``span_id`` link the event into a request waterfall
+    (emit() fills them from the thread's active span automatically)."""
     if severity not in SEVERITIES:
         raise ValueError(
             f"unknown event severity {severity!r} (one of {SEVERITIES})"
@@ -88,6 +92,8 @@ def make_event(severity: str, source: str, message: str, *,
         "job_id": job_id,
         "task_id": task_id,
         "actor_id": actor_id,
+        "trace_id": trace_id,
+        "span_id": span_id,
         "pid": os.getpid(),
         "custom_fields": dict(custom_fields or {}),
     }
@@ -251,9 +257,22 @@ def emit(severity: str, source: str, message: str, *,
         rt = runtime_context.current_runtime_or_none()
         if rt is not None and getattr(rt, "node_id", None) is not None:
             node_id = rt.node_id.hex()
+    # Events emitted inside an active span carry its trace context, so
+    # `rtpu events` rows correlate 1:1 with recorded request waterfalls
+    # (CHAOS firings, TRAIN gang aborts, SERVE ejections...).
+    trace_id = span_id = None
+    try:
+        from ..core.timeline import current_span
+
+        ctx = current_span()
+        if ctx is not None:
+            trace_id, span_id = ctx[0], (ctx[1] or None)
+    except Exception:
+        pass
     event = make_event(
         severity, source, message, node_id=node_id, job_id=job_id,
-        task_id=task_id, actor_id=actor_id, custom_fields=custom_fields,
+        task_id=task_id, actor_id=actor_id, trace_id=trace_id,
+        span_id=span_id, custom_fields=custom_fields,
     )
     _emitter.buffer().append(event)
     _emitter.ensure_flusher()

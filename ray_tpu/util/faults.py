@@ -326,11 +326,19 @@ def _emit_chaos_event(a: _ArmedSpec, ctx: Dict[str, Any]) -> None:
         }
         for k, v in ctx.items():
             fields.setdefault(k, v)
-        events.emit(
+        event = events.emit(
             events.WARNING, events.CHAOS,
             f"CHAOS fired: {a.action} at {a.point} "
             f"(mode={a.mode}, fire #{a.fires})",
             custom_fields=fields,
+        )
+        # Tail retention: a chaos-hit request must stay retrievable from
+        # the flight recorder even if its request side never completes.
+        from . import flight_recorder
+
+        flight_recorder.note_chaos(
+            a.point, trace_id=event.get("trace_id") or "",
+            detail=f"{a.action} mode={a.mode} fire#{a.fires}",
         )
     except Exception:
         pass  # injection must never fail because observability did

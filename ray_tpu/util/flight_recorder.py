@@ -1,0 +1,371 @@
+"""Tail-sampled flight recorder for request waterfalls.
+
+Ref analogue: Dapper-style always-on sampling with tail retention — the
+trace plane records spans for every request (core/timeline.py), but FULL
+request records are kept only for the requests worth a postmortem: slow
+(beyond a rolling ~p99 threshold), shed by overload control, expired
+deadlines, errored, or chaos-hit. Each process keeps a bounded ring
+(:class:`FlightRecorder`); retained records also flush to the cluster KV
+(``__flightrec__/<node8>/<pid>``, the timeline/metrics pipeline pattern)
+so worker-side retention is visible cluster-wide.
+
+Surfaces: ``rtpu trace [--slow|--errors|--shed|--chaos]``, dashboard
+``/api/traces``, and the GCS ``ProfileService.traces_dump`` fan-out
+(core/gcs.py) that collects every node manager's ring like
+``stacks_dump`` does. :func:`waterfall` joins a retained record back to
+its spans in the timeline KV — the one-hop path from a recorded request
+to its full proxy→replica→nested tree.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from .metrics import Counter, Gauge
+
+KV_PREFIX = "__flightrec__/"
+FLUSH_INTERVAL_S = 1.0
+
+# Retention reasons, in severity order for display. "slow" is decided by
+# the rolling threshold; the rest are asserted by the observing surface.
+REASONS = ("chaos", "error", "expired", "shed", "slow")
+
+# ---- metric surface (validated by the rtlint obs pass) ---------------------
+
+_REQUESTS_TOTAL = Counter(
+    "ray_tpu_trace_requests_total",
+    "Requests observed by the flight recorder, retained or not "
+    "(surface=http|grpc|actor|other).",
+    tag_keys=("surface",),
+)
+_RETAINED_TOTAL = Counter(
+    "ray_tpu_trace_retained_total",
+    "Requests whose record was retained by the tail-sampled flight "
+    "recorder (reason=slow|shed|expired|error|chaos).",
+    tag_keys=("reason",),
+)
+_ENTRIES = Gauge(
+    "ray_tpu_flight_recorder_entries",
+    "Request records currently held in this process's flight-recorder "
+    "ring.",
+    tag_keys=("pid",),
+)
+_ENTRIES_GAUGE = _ENTRIES.with_tags(pid=str(os.getpid()))
+_RETAINED = {r: _RETAINED_TOTAL.with_tags(reason=r) for r in REASONS}
+
+
+class FlightRecorder:
+    """Per-process bounded ring of retained request records plus the
+    rolling latency window backing the "slow" decision."""
+
+    def __init__(self, size: int = 256, slow_floor_s: float = 1.0):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(8, int(size)))
+        # Recent request durations (retained or not): the ~p99 estimate
+        # is the sorted 99th of this window, floored by slow_floor_s so
+        # a quiet service doesn't retain its every request.
+        self._durations: deque = deque(maxlen=512)
+        self._slow_floor_s = float(slow_floor_s)
+        self._dirty = False
+        self._flusher: Optional[threading.Thread] = None
+
+    # -- retention decision --------------------------------------------------
+
+    def slow_threshold_s(self) -> float:
+        with self._lock:
+            window = sorted(self._durations)
+        if len(window) < 50:
+            return self._slow_floor_s
+        p99 = window[min(len(window) - 1, int(len(window) * 0.99))]
+        return max(self._slow_floor_s, p99)
+
+    def observe(self, name: str, trace_id: str, started: float,
+                ended: float, *, status: Any = "ok",
+                reason: Optional[str] = None, detail: str = "",
+                surface: str = "other") -> Optional[Dict[str, Any]]:
+        """One completed request. ``reason`` asserts retention
+        (shed/expired/error/chaos); with reason=None the rolling slow
+        threshold decides. Returns the retained record, or None."""
+        duration = max(0.0, ended - started)
+        _REQUESTS_TOTAL.inc(1, tags={"surface": surface})
+        with self._lock:
+            self._durations.append(duration)
+        if reason is None and duration > self.slow_threshold_s():
+            reason = "slow"
+        if reason is None:
+            return None
+        return self._retain({
+            "id": uuid.uuid4().hex[:16],
+            "ts": started,
+            "duration_s": round(duration, 6),
+            "trace_id": trace_id or "",
+            "name": name,
+            "status": str(status),
+            "reason": reason,
+            "detail": detail,
+            "surface": surface,
+            "pid": os.getpid(),
+            "node": _node8(),
+        })
+
+    def note_chaos(self, point: str, trace_id: str = "",
+                   detail: str = "") -> Dict[str, Any]:
+        """A chaos injection fired inside (or near) a request: retain a
+        record immediately — the request side may never complete."""
+        now = time.time()
+        return self._retain({
+            "id": uuid.uuid4().hex[:16],
+            "ts": now,
+            "duration_s": 0.0,
+            "trace_id": trace_id or "",
+            "name": f"chaos:{point}",
+            "status": "chaos",
+            "reason": "chaos",
+            "detail": detail,
+            "surface": "chaos",
+            "pid": os.getpid(),
+            "node": _node8(),
+        })
+
+    def _retain(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        handle = _RETAINED.get(record["reason"])
+        if handle is not None:
+            handle.inc()
+        else:  # pragma: no cover - unknown reason still counted
+            _RETAINED_TOTAL.inc(1, tags={"reason": record["reason"]})
+        with self._lock:
+            self._ring.append(record)
+            self._dirty = True
+            n = len(self._ring)
+        _ENTRIES_GAUGE.set(float(n))
+        # NEVER flush inline: retain sites include chaos firings on the
+        # NM/GCS event loops, where a blocking kv_put round-trip would
+        # deadlock the loop it needs to answer. The KV mirror runs on a
+        # dedicated flusher thread (metrics.py's pattern).
+        self._ensure_flusher()
+        return record
+
+    def _ensure_flusher(self) -> None:
+        with self._lock:
+            if self._flusher is not None:
+                return
+            self._flusher = threading.Thread(
+                target=self._flush_loop,
+                name="ray_tpu-flightrec-flusher", daemon=True,
+            )
+            self._flusher.start()
+
+    def _flush_loop(self) -> None:
+        while True:
+            time.sleep(FLUSH_INTERVAL_S)
+            try:
+                self.maybe_flush()
+            except Exception:
+                pass
+
+    # -- read side -----------------------------------------------------------
+
+    def list(self, reason: Optional[str] = None,
+             limit: int = 100) -> List[Dict[str, Any]]:
+        """Retained records oldest-first; ``limit`` keeps the newest."""
+        with self._lock:
+            rows = list(self._ring)
+        if reason:
+            rows = [r for r in rows if r.get("reason") == reason]
+        if limit and limit > 0:
+            rows = rows[-limit:]
+        return rows
+
+    def stats(self) -> Dict[str, Any]:
+        threshold = self.slow_threshold_s()
+        with self._lock:
+            return {
+                "entries": len(self._ring),
+                "window": len(self._durations),
+                "slow_threshold_s": round(threshold, 6),
+            }
+
+    # -- KV mirror -----------------------------------------------------------
+
+    def maybe_flush(self) -> None:
+        """Mirror the ring to the cluster KV if dirty. Runs on the
+        flusher thread (or a test caller) — never on a request path or
+        an event loop: kv_put blocks."""
+        with self._lock:
+            if not self._dirty:
+                return
+            self._dirty = False
+            rows = list(self._ring)
+        from ..core import runtime_context
+
+        rt = runtime_context.current_runtime_or_none()
+        if rt is None:
+            with self._lock:
+                self._dirty = True  # retry once a runtime exists
+            return
+        try:
+            rt.kv_put(f"{KV_PREFIX}{_node8()}/{os.getpid()}",
+                      cloudpickle.dumps(rows))
+        except Exception:
+            with self._lock:
+                self._dirty = True
+
+
+def _node8() -> str:
+    from ..core import runtime_context
+
+    rt = runtime_context.current_runtime_or_none()
+    if rt is not None and getattr(rt, "node_id", None) is not None:
+        return rt.node_id.hex()[:8]
+    return "local"
+
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                from ..core.config import get_config
+
+                cfg = get_config()
+                _recorder = FlightRecorder(
+                    size=getattr(cfg, "flight_recorder_size", 256),
+                    slow_floor_s=getattr(cfg, "flight_recorder_slow_s",
+                                         1.0),
+                )
+    return _recorder
+
+
+def observe_request(name: str, trace_id: str, started: float,
+                    ended: float, *, status: Any = "ok",
+                    reason: Optional[str] = None, detail: str = "",
+                    surface: str = "other") -> Optional[Dict[str, Any]]:
+    """Module-level convenience over :meth:`FlightRecorder.observe`;
+    never raises — the recorder must not fail the request it records."""
+    try:
+        return get_recorder().observe(
+            name, trace_id, started, ended, status=status, reason=reason,
+            detail=detail, surface=surface,
+        )
+    except Exception:
+        return None
+
+
+def note_chaos(point: str, trace_id: str = "", detail: str = "") -> None:
+    try:
+        get_recorder().note_chaos(point, trace_id=trace_id, detail=detail)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------- aggregation
+
+def list_cluster(reason: Optional[str] = None, limit: int = 200,
+                 include_gcs: bool = True) -> List[Dict[str, Any]]:
+    """Retained records cluster-wide: this process's ring, every ring
+    mirrored to the KV (workers/replicas), and — when a GCS is reachable
+    — the ``traces_dump`` fan-out over the node peer channels (the
+    ProfileService pattern; unreachable nodes degrade to a partial
+    result). Deduped by record id, oldest-first, newest ``limit`` kept."""
+    from ..core import runtime_context
+
+    rows: Dict[str, Dict[str, Any]] = {}
+
+    def absorb(batch):
+        for r in batch or ():
+            if isinstance(r, dict) and r.get("id"):
+                rows[r["id"]] = r
+
+    absorb(get_recorder().list(limit=0))
+    rt = runtime_context.current_runtime_or_none()
+    if rt is not None:
+        try:
+            for key in rt.kv_keys(KV_PREFIX):
+                blob = rt.kv_get(key)
+                if blob is not None:
+                    absorb(cloudpickle.loads(blob))
+        except Exception:
+            pass
+        if include_gcs and hasattr(rt, "cluster_traces"):
+            try:
+                reply = rt.cluster_traces()
+                for node in reply.get("nodes", ()):
+                    absorb(node.get("records"))
+            except Exception:
+                pass
+    out = sorted(rows.values(), key=lambda r: r.get("ts", 0.0))
+    if reason:
+        out = [r for r in out if r.get("reason") == reason]
+    if limit and limit > 0:
+        out = out[-limit:]
+    return out
+
+
+def waterfall(trace_id: str) -> Dict[str, Any]:
+    """Join one trace id back to its spans: every timeline event across
+    the cluster carrying ``trace_id``, sorted by start time, plus any
+    retained flight-recorder records for it."""
+    from ..core.timeline import timeline as _cluster_spans
+
+    spans = [
+        {
+            "name": ev["name"],
+            "start": ev["ts"] / 1e6,
+            "duration_s": ev["dur"] / 1e6,
+            "span_id": ev["args"].get("span_id", ""),
+            "parent_id": ev["args"].get("parent_id", ""),
+            "task_id": ev["args"].get("task_id", ""),
+            "where": f"{ev.get('pid', '')}/{ev.get('tid', '')}",
+        }
+        for ev in _cluster_spans()
+        if ev.get("args", {}).get("trace_id") == trace_id
+    ]
+    spans.sort(key=lambda s: s["start"])
+    records = [r for r in list_cluster(limit=0, include_gcs=False)
+               if r.get("trace_id") == trace_id]
+    return {"trace_id": trace_id, "spans": spans, "records": records}
+
+
+def format_waterfall(tree: Dict[str, Any]) -> str:
+    """Render a waterfall as indented text (parents before children,
+    indent by parent-link depth; offsets relative to the first span)."""
+    spans = tree.get("spans", [])
+    if not spans:
+        return f"trace {tree.get('trace_id', '?')}: no spans recorded"
+    by_id = {s["span_id"]: s for s in spans if s.get("span_id")}
+
+    def depth(s, seen=None):
+        seen = seen or set()
+        d = 0
+        parent = s.get("parent_id")
+        while parent and parent in by_id and parent not in seen:
+            seen.add(parent)
+            d += 1
+            parent = by_id[parent].get("parent_id")
+        return d
+
+    t0 = spans[0]["start"]
+    lines = [f"trace {tree['trace_id']} ({len(spans)} span(s))"]
+    for s in spans:
+        indent = "  " * (1 + depth(s))
+        off_ms = (s["start"] - t0) * 1e3
+        dur_ms = s["duration_s"] * 1e3
+        lines.append(f"{indent}{s['name']}  +{off_ms:.1f}ms "
+                     f"{dur_ms:.1f}ms  [{s['where']}]")
+    for r in tree.get("records", ()):
+        lines.append(f"  retained: reason={r['reason']} "
+                     f"status={r['status']} "
+                     f"duration={r['duration_s'] * 1e3:.1f}ms "
+                     f"({r['name']})")
+    return "\n".join(lines)

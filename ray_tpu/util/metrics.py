@@ -15,7 +15,7 @@ import os
 import threading
 import time
 import warnings
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
@@ -172,8 +172,9 @@ class _BoundHistogram:
         self._key = key
         self._bounds = bounds
 
-    def observe(self, value: float):
-        Histogram._observe(self._name, self._bounds, self._key, value)
+    def observe(self, value: float, exemplar: Optional[str] = None):
+        Histogram._observe(self._name, self._bounds, self._key, value,
+                           exemplar)
 
 
 class Counter(_Metric):
@@ -217,8 +218,13 @@ class Histogram(_Metric):
                                   [0.01, 0.1, 1.0, 10.0, 100.0])
 
     def observe(self, value: float,
-                tags: Optional[Dict[str, str]] = None):
-        self._observe(self._name, self._boundaries, self._key(tags), value)
+                tags: Optional[Dict[str, str]] = None,
+                exemplar: Optional[str] = None):
+        """``exemplar`` is a trace id attached to the bucket this value
+        lands in (OpenMetrics exemplar: latest observation wins) — the
+        one-hop link from a latency bucket to a recorded waterfall."""
+        self._observe(self._name, self._boundaries, self._key(tags),
+                      value, exemplar)
 
     def with_tags(self, **tags) -> _BoundHistogram:
         """Pre-resolved handle; see ``Counter.with_tags``."""
@@ -226,18 +232,27 @@ class Histogram(_Metric):
                                self._boundaries)
 
     @staticmethod
-    def _observe(name: str, bounds: List[float], key: tuple, value: float):
+    def _observe(name: str, bounds: List[float], key: tuple, value: float,
+                 exemplar: Optional[str] = None):
+        ex_ts = time.time() if exemplar else 0.0
+
         def update(cur):
             cur = cur or {"count": 0, "sum": 0.0, "bounds": list(bounds),
                           "buckets": [0] * (len(bounds) + 1)}
-            cur["count"] += 1
-            cur["sum"] += value
+            le: Any = "+Inf"
             for i, b in enumerate(bounds):
                 if value <= b:
                     cur["buckets"][i] += 1
+                    le = b
                     break
             else:
                 cur["buckets"][-1] += 1
+            cur["count"] += 1
+            cur["sum"] += value
+            if exemplar:
+                cur.setdefault("exemplars", {})[le] = {
+                    "trace_id": exemplar, "value": value, "ts": ex_ts,
+                }
             return cur
 
         _registry.record(name, "histogram", key, update)
@@ -262,7 +277,9 @@ def _merge_histogram(cur: Dict, value: Dict) -> Dict:
     each source bucket (b_{i-1}, b_i] lands in the union bucket whose
     upper edge is exactly b_i, so cumulative counts stay exact at every
     original boundary. (The old zip() truncated the longer bucket list
-    silently, dropping observations.)"""
+    silently, dropping observations.) Exemplars are keyed by their `le`
+    bound, so they merge independently of rebucketing — the newest
+    observation per bound wins, matching OpenMetrics semantics."""
     if cur.get("bounds", []) == value.get("bounds", []):
         return {
             "count": cur["count"] + value["count"],
@@ -271,6 +288,7 @@ def _merge_histogram(cur: Dict, value: Dict) -> Dict:
             "buckets": [
                 a + b for a, b in zip(cur["buckets"], value["buckets"])
             ],
+            **_merged_exemplars(cur, value),
         }
     bounds = sorted(set(cur.get("bounds", [])) | set(value.get("bounds", [])))
     index = {b: i for i, b in enumerate(bounds)}
@@ -290,7 +308,24 @@ def _merge_histogram(cur: Dict, value: Dict) -> Dict:
         "sum": cur["sum"] + value["sum"],
         "bounds": bounds,
         "buckets": [a + b for a, b in zip(rebucket(cur), rebucket(value))],
+        **_merged_exemplars(cur, value),
     }
+
+
+def _merged_exemplars(cur: Dict, value: Dict) -> Dict:
+    """Union of two histogram points' exemplar maps (newest ts wins per
+    `le` key); {} when neither side carries any — the merged point then
+    has no "exemplars" key at all, like an unobserved series."""
+    a = cur.get("exemplars") or {}
+    b = value.get("exemplars") or {}
+    if not a and not b:
+        return {}
+    merged = dict(a)
+    for le, ex in b.items():
+        old = merged.get(le)
+        if old is None or ex.get("ts", 0.0) >= old.get("ts", 0.0):
+            merged[le] = ex
+    return {"exemplars": merged}
 
 
 def get_metrics_report() -> Dict[str, Dict]:

@@ -45,9 +45,22 @@ def _fmt_labels(tags) -> str:
     return "{" + inner + "}"
 
 
+def _exemplar_suffix(value, le) -> str:
+    """OpenMetrics exemplar rendering for one bucket: `` # {trace_id=
+    "..."} <value> <ts>`` — the one-hop link from a latency bucket to a
+    recorded request waterfall (`rtpu trace <id>`). Empty string when
+    the bucket has no exemplar, which standard Prometheus text-format
+    consumers simply never see."""
+    ex = (value.get("exemplars") or {}).get(le)
+    if not ex or not ex.get("trace_id"):
+        return ""
+    return (f' # {{trace_id="{_escape_label_value(ex["trace_id"])}"}} '
+            f'{ex.get("value", 0.0)} {ex.get("ts", 0.0)}')
+
+
 def _hist_lines(pname: str, tags, value) -> List[str]:
     """Cumulative `_bucket{le=...}` series plus `_sum`/`_count` for one
-    histogram series point ({count, sum, bounds, buckets})."""
+    histogram series point ({count, sum, bounds, buckets[, exemplars]})."""
     lines: List[str] = []
 
     def lbl(extra=None):
@@ -57,8 +70,10 @@ def _hist_lines(pname: str, tags, value) -> List[str]:
     cum = 0
     for b, c in zip(value.get("bounds", []), value["buckets"]):
         cum += c
-        lines.append(f'{pname}_bucket{lbl(("le", b))} {cum}')
-    lines.append(f'{pname}_bucket{lbl(("le", "+Inf"))} {value["count"]}')
+        lines.append(f'{pname}_bucket{lbl(("le", b))} {cum}'
+                     f'{_exemplar_suffix(value, b)}')
+    lines.append(f'{pname}_bucket{lbl(("le", "+Inf"))} {value["count"]}'
+                 f'{_exemplar_suffix(value, "+Inf")}')
     lines.append(f"{pname}_sum{lbl()} {value['sum']}")
     lines.append(f"{pname}_count{lbl()} {value['count']}")
     return lines

@@ -65,6 +65,7 @@ PyObject* s_q;
 PyObject* s_a;
 PyObject* s_n;
 PyObject* s_d;
+PyObject* s_tc;
 PyObject* s_task_id;
 PyObject* s_results;
 PyObject* s_failed;
@@ -804,20 +805,48 @@ PyObject* wbuf_to_bytes(rtp_wbuf* b) {
   return out;
 }
 
-// encode_call(tmpl, task_id_bytes, seq, deadline, args, kwargs, nested)
-//   -> bytes | None (unsupported shape)
+// encode_call(tmpl, task_id_bytes, seq, deadline, args, kwargs, nested,
+//             trace=None) -> bytes | None (unsupported shape)
+// `trace` is a (trace_id, span_id) str 2-tuple (codec v2, RTP_CALL_HAS_TRACE)
+// or None; callers pass None on channels that negotiated npv < 2.
 PyObject* mod_encode_call(PyObject*, PyObject* args) {
   unsigned int tmpl;
   Py_buffer tid;
   unsigned long long seq;
   double deadline;
   PyObject *a_args, *a_kwargs, *nested;
-  if (!PyArg_ParseTuple(args, "Iy*KdOOO", &tmpl, &tid, &seq, &deadline,
-                        &a_args, &a_kwargs, &nested))
+  PyObject* trace = Py_None;
+  if (!PyArg_ParseTuple(args, "Iy*KdOOO|O", &tmpl, &tid, &seq, &deadline,
+                        &a_args, &a_kwargs, &nested, &trace))
     return nullptr;
   if (!g_refarg) {
     PyBuffer_Release(&tid);
     return py_types_registered_err();
+  }
+  const char* trace_utf[2] = {nullptr, nullptr};
+  Py_ssize_t trace_len[2] = {0, 0};
+  int has_trace = trace != Py_None;
+  if (has_trace) {
+    if (!PyTuple_Check(trace) || PyTuple_GET_SIZE(trace) != 2) {
+      PyBuffer_Release(&tid);
+      Py_RETURN_NONE;
+    }
+    for (int i = 0; i < 2; ++i) {
+      PyObject* part = PyTuple_GET_ITEM(trace, i);
+      if (!PyUnicode_Check(part)) {
+        PyBuffer_Release(&tid);
+        Py_RETURN_NONE;
+      }
+      trace_utf[i] = PyUnicode_AsUTF8AndSize(part, &trace_len[i]);
+      if (!trace_utf[i]) {
+        PyBuffer_Release(&tid);
+        return nullptr;
+      }
+      if (trace_len[i] > 255) {
+        PyBuffer_Release(&tid);
+        Py_RETURN_NONE;
+      }
+    }
   }
   if (tid.len > 255 || (a_args != Py_None && !PyList_Check(a_args)) ||
       (a_kwargs != Py_None && !PyDict_Check(a_kwargs)) ||
@@ -842,8 +871,15 @@ PyObject* mod_encode_call(PyObject*, PyObject* args) {
   PyBuffer_Release(&tid);
   rtp_put_f64(&b, deadline);
   uint8_t flags = (has_args ? RTP_CALL_HAS_ARGS : 0) |
-                  (has_nested ? RTP_CALL_HAS_NESTED : 0);
+                  (has_nested ? RTP_CALL_HAS_NESTED : 0) |
+                  (has_trace ? RTP_CALL_HAS_TRACE : 0);
   rtp_put_u8(&b, flags);
+  if (has_trace) {
+    for (int i = 0; i < 2; ++i) {
+      rtp_put_u8(&b, (uint8_t)trace_len[i]);
+      rtp_wbuf_put(&b, trace_utf[i], (size_t)trace_len[i]);
+    }
+  }
   if (has_args) {
     if (a_args == Py_None || !PyList_Check(a_args) ||
         (a_kwargs != Py_None && !PyDict_Check(a_kwargs)))
@@ -1114,6 +1150,31 @@ PyObject* decode_call(rtp_rbuf* r) {
       goto error;
     }
     Py_DECREF(d);
+  }
+  if (flags & RTP_CALL_HAS_TRACE) {
+    PyObject* parts[2] = {nullptr, nullptr};
+    bool tc_ok = true;
+    for (int i = 0; i < 2 && tc_ok; ++i) {
+      uint8_t tlen;
+      const uint8_t* tp;
+      if (rtp_get_u8(r, &tlen) != RTP_OK ||
+          rtp_get_ref(r, &tp, tlen) != RTP_OK) {
+        decode_err();
+        tc_ok = false;
+        break;
+      }
+      parts[i] = PyUnicode_DecodeUTF8((const char*)tp, tlen, nullptr);
+      if (!parts[i]) tc_ok = false;
+    }
+    PyObject* tc =
+        tc_ok ? PyTuple_Pack(2, parts[0], parts[1]) : nullptr;
+    Py_XDECREF(parts[0]);
+    Py_XDECREF(parts[1]);
+    if (!tc || PyDict_SetItem(out, s_tc, tc)) {
+      Py_XDECREF(tc);
+      goto error;
+    }
+    Py_DECREF(tc);
   }
   if (flags & RTP_CALL_HAS_ARGS) {
     uint32_t na;
@@ -1565,8 +1626,9 @@ PyMethodDef module_methods[] = {
     {"register_types", mod_register_types, METH_VARARGS,
      "register_types(RefArg, ValueArg, ObjectID, TaskID, InlineLocation)"},
     {"encode_call", mod_encode_call, METH_VARARGS,
-     "encode_call(tmpl, task_id, seq, deadline, args, kwargs, nested) -> "
-     "bytes | None (unsupported shape: caller falls back to pickle)"},
+     "encode_call(tmpl, task_id, seq, deadline, args, kwargs, nested, "
+     "trace=None) -> bytes | None (unsupported shape: caller falls back "
+     "to pickle; trace = (trace_id, span_id) strs, codec v2 only)"},
     {"encode_done", mod_encode_done, METH_O,
      "encode_done(task_done_dict) -> bytes | None"},
     {"encode_done_batch", mod_encode_done_batch, METH_O,
@@ -1598,7 +1660,8 @@ bool init_strings() {
       {&s_type, "type"},       {&s_t, "t"},
       {&s_i, "i"},             {&s_q, "q"},
       {&s_a, "a"},             {&s_n, "n"},
-      {&s_d, "d"},             {&s_task_id, "task_id"},
+      {&s_d, "d"},             {&s_tc, "tc"},
+      {&s_task_id, "task_id"},
       {&s_results, "results"}, {&s_failed, "failed"},
       {&s_duration_s, "duration_s"}, {&s_items, "items"},
       {&s_msg_id, "msg_id"},   {&s_duplicate, "duplicate"},

@@ -44,7 +44,12 @@ extern "C" {
 // ---- wire constants --------------------------------------------------------
 
 #define RTP_MAGIC 0xA7u      // first byte of every native frame payload
-#define RTP_CODEC_VER 1u     // negotiated as "npv" in the direct hello
+// Negotiated as "npv" in the direct hello/welcome. v2 adds the optional
+// RTP_CALL_HAS_TRACE block on F_CALL (trace_id + span_id strings after
+// the flags byte); both sides speak min(offered, supported), so a v2
+// encoder facing a v1 peer emits v1 frames (no trace flag) and the
+// layouts stay compatible.
+#define RTP_CODEC_VER 2u
 
 #define RTP_F_CALL 0x01u       // compact direct call frame
 #define RTP_F_DONE 0x02u       // task_done reply
@@ -57,6 +62,10 @@ extern "C" {
 
 #define RTP_CALL_HAS_ARGS 0x01u
 #define RTP_CALL_HAS_NESTED 0x02u
+// Codec v2: (trace_id, span_id) ride the call frame as two u8-length-
+// prefixed utf-8 strings immediately after the flags byte. Emitted only
+// on channels that negotiated npv >= 2 — a v1 decoder never sees the bit.
+#define RTP_CALL_HAS_TRACE 0x04u
 #define RTP_DONE_FAILED 0x01u
 
 // ---- status codes ----------------------------------------------------------

@@ -48,7 +48,14 @@ def _rand_call(rng):
     nested = tuple(
         ObjectID(rng.randbytes(20)) for _ in range(rng.randrange(0, 3))
     )
-    return tmpl, tid, seq, deadline, args, kwargs, nested
+    # Codec v2: call frames may carry (trace_id, span_id); a parentless
+    # root stamps an empty span id, so fuzz that shape too.
+    trace = rng.choice([
+        None,
+        (rng.randbytes(16).hex(), rng.randbytes(8).hex()),
+        (rng.randbytes(16).hex(), ""),
+    ])
+    return tmpl, tid, seq, deadline, args, kwargs, nested, trace
 
 
 def _rand_done(rng):
@@ -73,10 +80,12 @@ def test_codec_parity_fuzz():
     mod = frame_pump._module()
     rng = random.Random(0xC0DEC)
     for _ in range(300):
-        tmpl, tid, seq, deadline, args, kwargs, nested = _rand_call(rng)
-        nat = mod.encode_call(tmpl, tid, seq, deadline, args, kwargs, nested)
+        (tmpl, tid, seq, deadline, args, kwargs, nested,
+         trace) = _rand_call(rng)
+        nat = mod.encode_call(tmpl, tid, seq, deadline, args, kwargs,
+                              nested, trace)
         pyb = frame_pump.py_encode_call(tmpl, tid, seq, deadline, args,
-                                        kwargs, nested)
+                                        kwargs, nested, trace)
         assert nat == pyb
         d_nat = mod.decode(pyb)
         d_py = frame_pump.py_decode(nat)
@@ -89,6 +98,10 @@ def test_codec_parity_fuzz():
             assert got_args == args and got_kwargs == kwargs
         if nested:
             assert d_nat["n"] == nested
+        if trace is None:
+            assert "tc" not in d_nat
+        else:
+            assert d_nat["tc"] == trace
 
         done = _rand_done(rng)
         nat = mod.encode_done(done)

@@ -1,0 +1,543 @@
+"""ISSUE 14 — request waterfalls v2: trace context in the native codec
+(severed-tree regression), span coverage, tail-sampled flight recorder,
+and metric exemplars.
+
+The core regression test: with the native pump engaged and the
+call-frame TEMPLATE path active (i.e. NOT the first call of a shape —
+that one ships the full pickled spec and was never broken), a serve
+request must still produce ONE connected trace tree
+proxy → replica → nested call. The same tree must hold under
+``RTPU_NO_NATIVE=1`` (pure-Python compact dict frames) and across a
+v1-peer version skew (traceless but functional)."""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core import frame_pump
+# ray_tpu.core re-exports the timeline() FUNCTION under this name; the
+# tests need the module.
+from ray_tpu.core import timeline as _pkg_timeline  # noqa: F401
+import ray_tpu.core.timeline
+timeline = sys.modules["ray_tpu.core.timeline"]
+from ray_tpu.core.rpc import negotiate_codec
+from ray_tpu.util import events, flight_recorder
+from ray_tpu.util import prometheus as prom
+from ray_tpu.util.metrics import _merge_histogram
+
+needs_native = pytest.mark.skipif(
+    not frame_pump.available(), reason="native pump extension unavailable"
+)
+
+
+@pytest.fixture
+def serve_cluster(ray_tpu_start):
+    yield ray_tpu_start
+    serve.shutdown()
+
+
+# --------------------------------------------------------- codec + handshake
+
+
+def test_negotiate_codec_version_skew():
+    """min(offered, supported) with a pickle fallback for junk offers:
+    a v2 caller and a v1 worker settle on v1 (traceless native frames),
+    never on a dialect one side cannot decode."""
+    assert negotiate_codec(2, 2) == 2
+    assert negotiate_codec(1, 2) == 1  # v1 peer: settle on v1
+    assert negotiate_codec(2, 1) == 1  # we are the v1 side
+    assert negotiate_codec(0, 2) == 0
+    assert negotiate_codec(None, 2) == 0
+    assert negotiate_codec("2", 2) == 0
+    assert negotiate_codec(2, 0) == 0
+
+
+def test_traceless_v2_frame_is_v1_layout():
+    """v1-peer-skew parity at the byte level: a v2 encoder with
+    trace=None emits exactly the v1 frame layout (hand-packed here), so
+    a v1 decoder reads it unchanged."""
+    import struct
+
+    tid = b"T" * 16
+    frame = frame_pump.py_encode_call(5, tid, 9, 2.5, None, None, None)
+    manual = (struct.pack("<BBIQ", frame_pump.MAGIC, frame_pump.F_CALL,
+                          5, 9)
+              + bytes([16]) + tid + struct.pack("<d", 2.5) + b"\x00")
+    assert frame == manual
+    assert "tc" not in frame_pump.py_decode(frame)
+
+
+def test_trace_block_roundtrip_python_mirror():
+    tr = ("a" * 32, "b" * 16)
+    frame = frame_pump.py_encode_call(5, b"T" * 16, 9, 0.0, None, None,
+                                      None, tr)
+    assert frame_pump.py_decode(frame)["tc"] == tr
+    # Root context: empty parent span id survives the wire.
+    frame = frame_pump.py_encode_call(5, b"T" * 16, 9, 0.0, None, None,
+                                      None, ("c" * 32, ""))
+    assert frame_pump.py_decode(frame)["tc"] == ("c" * 32, "")
+    # Unsupported trace shapes refuse (the call falls back to pickle).
+    for bad in (("x" * 300, "y"), ("only",), (b"bytes", "y"), "not-a-tuple"):
+        assert frame_pump.py_encode_call(
+            1, b"T" * 16, 1, 0.0, None, None, None, bad) is None
+
+
+@needs_native
+def test_trace_block_native_refuses_same_shapes():
+    mod = frame_pump._module()
+    for bad in (("x" * 300, "y"), ("only",), (b"bytes", "y"), "not-a-tuple"):
+        assert mod.encode_call(1, b"T" * 16, 1, 0.0, None, None, None,
+                               bad) is None
+
+
+@needs_native
+def test_trace_codec_parity_fuzz():
+    """Dedicated trace-focused fuzz beside test_native_pump's general
+    one: trace present/absent/empty-span, both encoders byte-identical,
+    both decoders agree."""
+    mod = frame_pump._module()
+    rng = random.Random(0x7ACE)
+    for _ in range(200):
+        trace = rng.choice([
+            None,
+            (rng.randbytes(16).hex(), rng.randbytes(8).hex()),
+            (rng.randbytes(16).hex(), ""),
+            ("", ""),
+        ])
+        tid = rng.randbytes(16)
+        nat = mod.encode_call(7, tid, 3, 0.0, None, None, None, trace)
+        pyb = frame_pump.py_encode_call(7, tid, 3, 0.0, None, None, None,
+                                        trace)
+        assert nat == pyb
+        d = mod.decode(pyb)
+        assert d == frame_pump.py_decode(nat)
+        if trace is None:
+            assert "tc" not in d
+        else:
+            assert d["tc"] == trace
+
+
+# ------------------------------------------------------------ span plumbing
+
+
+def test_events_carry_trace_context():
+    prev = timeline.enter_span("t" * 32, "s" * 16)
+    try:
+        e = events.make_event(events.INFO, events.WORKER, "probe")
+        assert e["trace_id"] is None  # make_event stays pure
+        e = events.emit(events.INFO, events.WORKER, "probe")
+    finally:
+        timeline.exit_span(prev)
+    assert e["trace_id"] == "t" * 32
+    assert e["span_id"] == "s" * 16
+    outside = events.emit(events.INFO, events.WORKER, "probe2")
+    assert outside["trace_id"] is None
+
+
+def test_span_event_requires_active_span():
+    buf = timeline.get_buffer()
+    with buf._lock:
+        before = len(buf._events)
+    timeline.span_event("orphan:marker")  # no active span: no record
+    with buf._lock:
+        assert len(buf._events) == before
+    prev = timeline.enter_span("t" * 32, "s" * 16)
+    try:
+        timeline.span_event("shed:test:unit")
+    finally:
+        timeline.exit_span(prev)
+    with buf._lock:
+        evs = list(buf._events)
+    marker = [e for e in evs if e["name"] == "shed:test:unit"]
+    assert marker and marker[-1]["trace_id"] == "t" * 32
+    assert marker[-1]["parent_id"] == "s" * 16
+
+
+def test_set_enabled_disables_recording():
+    buf = timeline.get_buffer()
+    prev = timeline.set_enabled(False)
+    try:
+        with buf._lock:
+            before = len(buf._events)
+        buf.record("off:probe", 0.0, 1.0, "")
+        with buf._lock:
+            assert len(buf._events) == before
+    finally:
+        timeline.set_enabled(prev)
+
+
+# ------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_tail_retention():
+    rec = flight_recorder.FlightRecorder(size=32, slow_floor_s=0.5)
+    t0 = time.time()
+    # Fast, healthy request: dropped.
+    assert rec.observe("http:x", "tid-fast", t0, t0 + 0.01,
+                       status=200, surface="http") is None
+    # Asserted reasons always retain.
+    shed = rec.observe("http:x", "tid-shed", t0, t0 + 0.01, status=503,
+                       reason="shed", surface="http")
+    assert shed and shed["reason"] == "shed"
+    exp = rec.observe("http:x", "tid-exp", t0, t0 + 0.02, status=504,
+                      reason="expired", surface="http")
+    assert exp and exp["reason"] == "expired"
+    err = rec.observe("grpc:y", "tid-err", t0, t0 + 0.02,
+                      status="INTERNAL", reason="error", surface="grpc")
+    assert err and err["reason"] == "error"
+    # Slow beyond the floor retains without an asserted reason.
+    slow = rec.observe("http:x", "tid-slow", t0, t0 + 2.0, status=200,
+                       surface="http")
+    assert slow and slow["reason"] == "slow"
+    # Chaos note retains immediately.
+    rec.note_chaos("direct_channel_io", trace_id="tid-chaos")
+    rows = rec.list()
+    assert [r["trace_id"] for r in rows] == [
+        "tid-shed", "tid-exp", "tid-err", "tid-slow", "tid-chaos"]
+    assert [r["trace_id"] for r in rec.list(reason="shed")] == ["tid-shed"]
+    assert [r["trace_id"] for r in rec.list(reason="chaos")] == ["tid-chaos"]
+    assert rec.stats()["entries"] == 5
+
+
+def test_flight_recorder_slow_threshold_tracks_p99():
+    rec = flight_recorder.FlightRecorder(size=32, slow_floor_s=0.01)
+    t0 = time.time()
+    # 100 requests around 100ms: the rolling ~p99 rises above the floor,
+    # so a 120ms request is NOT slow but a 500ms one is.
+    for i in range(100):
+        rec.observe("x", f"t{i}", t0, t0 + 0.1, status=200)
+    assert rec.slow_threshold_s() >= 0.099
+    assert rec.observe("x", "mid", t0, t0 + 0.10, status=200) is None
+    kept = rec.observe("x", "outlier", t0, t0 + 0.5, status=200)
+    assert kept and kept["reason"] == "slow"
+
+
+# ------------------------------------------------------------- exemplars
+
+
+def test_histogram_exemplar_exposition():
+    value = {
+        "count": 3, "sum": 0.9, "bounds": [0.1, 1.0],
+        "buckets": [1, 2, 0],
+        "exemplars": {1.0: {"trace_id": "abc123", "value": 0.3,
+                            "ts": 1690000000.0}},
+    }
+    lines = prom._hist_lines("m_seconds", [("deployment", "d")], value)
+    joined = "\n".join(lines)
+    assert ('m_seconds_bucket{deployment="d",le="1.0"} 3 '
+            '# {trace_id="abc123"} 0.3 1690000000.0') in joined
+    # Buckets without exemplars render plain.
+    assert 'le="0.1"} 1\n' in joined + "\n"
+
+
+def test_histogram_exemplar_merge_newest_wins():
+    a = {"count": 1, "sum": 0.2, "bounds": [1.0], "buckets": [1, 0],
+         "exemplars": {1.0: {"trace_id": "old", "value": 0.2, "ts": 1.0}}}
+    b = {"count": 1, "sum": 0.3, "bounds": [1.0], "buckets": [1, 0],
+         "exemplars": {1.0: {"trace_id": "new", "value": 0.3, "ts": 2.0}}}
+    merged = _merge_histogram(a, b)
+    assert merged["count"] == 2
+    assert merged["exemplars"][1.0]["trace_id"] == "new"
+    # Differing bounds rebucket but exemplars survive keyed by `le`.
+    c = {"count": 1, "sum": 0.4, "bounds": [0.5, 1.0], "buckets": [0, 1, 0],
+         "exemplars": {0.5: {"trace_id": "c", "value": 0.4, "ts": 3.0}}}
+    merged = _merge_histogram(merged, c)
+    assert merged["exemplars"][1.0]["trace_id"] == "new"
+    assert merged["exemplars"][0.5]["trace_id"] == "c"
+    # No exemplars on either side -> no key at all.
+    plain = _merge_histogram(
+        {"count": 1, "sum": 0.1, "bounds": [1.0], "buckets": [1, 0]},
+        {"count": 1, "sum": 0.1, "bounds": [1.0], "buckets": [1, 0]})
+    assert "exemplars" not in plain
+
+
+def test_serve_latency_exemplar_lands_in_exposition(serve_cluster):
+    from ray_tpu.serve import _telemetry
+
+    _telemetry.observe_ingress("exdep", "http", 200, time.time() - 0.05,
+                               trace_id="facefeed" * 4)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        doc = prom.render()
+        if 'trace_id="facefeed' in doc:
+            break
+        time.sleep(0.3)
+    assert 'trace_id="facefeed' in doc
+    assert "ray_tpu_serve_request_latency_seconds_bucket" in doc
+
+
+# ------------------------------------------------- e2e: connected tree
+
+
+def _post(port, route, payload, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{route}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+def _spans_for(trace_id, deadline_s=20.0):
+    """Poll the cluster span timeline until the full tree for trace_id
+    arrived (worker buffers flush on a 0.5s cadence)."""
+    deadline = time.time() + deadline_s
+    spans = []
+    while time.time() < deadline:
+        spans = [ev for ev in timeline.timeline()
+                 if ev["args"].get("trace_id") == trace_id]
+        names = {ev["name"] for ev in spans}
+        if (any(n.startswith("http:") for n in names)
+                and any(n.endswith("handle_request")
+                        and not n.startswith("queue:") for n in names)
+                and any(n.endswith(".work") for n in names)
+                and any(n.startswith("queue:") for n in names)):
+            return spans
+        time.sleep(0.4)
+    return spans
+
+
+def test_connected_trace_tree(serve_cluster):
+    """THE severed-tree regression: after the call-frame template is
+    warm (request >= 2 rides the compact/native dialect), a serve
+    request still yields one connected proxy → replica → nested tree,
+    and the response's traceparent header names that same trace."""
+
+    @serve.deployment
+    class Parent:
+        def __init__(self):
+            @ray_tpu.remote
+            class Nested:
+                def work(self, x):
+                    return x + 1
+
+            self.nested = Nested.remote()
+
+        def __call__(self, x):
+            return ray_tpu.get(self.nested.work.remote(x))
+
+    from ray_tpu.core.runtime_context import current_runtime
+
+    handle = serve.run(Parent.bind(), name="par", route_prefix="par")
+    port = handle.http_port
+    # Wait for the replica's DIRECT channel (discovery is async; until
+    # then requests ride the NM path, which was never severed), then
+    # warm the template path: the FIRST direct call of a shape ships the
+    # full pickled spec — only LATER calls ride the compact frame this
+    # PR fixes.
+    rt = current_runtime()
+    deadline = time.time() + 30
+    i = 0
+    while time.time() < deadline:
+        body, headers = _post(port, "par", i)
+        assert body == {"result": i + 1}
+        i += 1
+        if any(st.get("status") == "ready"
+               for st in rt._direct_states.values()):
+            break
+        time.sleep(0.05)
+    assert any(st.get("status") == "ready"
+               for st in rt._direct_states.values()), (
+        "direct channel never engaged")
+    for _ in range(2):
+        body, headers = _post(port, "par", i)
+        assert body == {"result": i + 1}
+        i += 1
+    tp = headers.get("traceparent", "")
+    assert tp.startswith("00-"), f"no traceparent response header: {headers}"
+    trace_id = tp.split("-")[1]
+
+    spans = _spans_for(trace_id)
+    by_name = {}
+    for ev in spans:
+        by_name.setdefault(ev["name"], []).append(ev)
+    assert any(n.startswith("http:") for n in by_name), (
+        f"no ingress root span for {trace_id}: {sorted(by_name)}")
+    root = next(v[0] for k, v in by_name.items() if k.startswith("http:"))
+    assert root["args"]["parent_id"] == ""
+    replica_names = [n for n in by_name
+                     if n.endswith("handle_request")
+                     and not n.startswith("queue:")]
+    assert replica_names, (
+        f"replica span missing — tree severed at the codec: "
+        f"{sorted(by_name)}")
+    replica = by_name[replica_names[0]][-1]
+    assert replica["args"]["parent_id"] == root["args"]["span_id"], (
+        "replica span not parented to the ingress root")
+    nested_names = [n for n in by_name if n.endswith(".work")]
+    assert nested_names, (
+        f"nested span missing — tree severed below the replica: "
+        f"{sorted(by_name)}")
+    nested = by_name[nested_names[0]][-1]
+    assert nested["args"]["parent_id"] == replica["args"]["span_id"], (
+        "nested span not parented to the replica span")
+    # Queue-wait/execution split: the replica call carries a sibling
+    # queue: span under the same parent.
+    queue_names = [n for n in by_name if n.startswith("queue:")
+                   and n.endswith("handle_request")]
+    assert queue_names, f"no queue-wait span: {sorted(by_name)}"
+    q = by_name[queue_names[0]][-1]
+    assert q["args"]["parent_id"] == root["args"]["span_id"]
+
+
+@needs_native
+def test_connected_tree_channel_negotiated_v2(serve_cluster):
+    """The tree test above plus the explicit channel assertion: the
+    replica's direct channel engaged the pump AND negotiated codec v2
+    (trace context rides the native frames, not pickle)."""
+    from ray_tpu.core.runtime_context import current_runtime
+
+    @serve.deployment
+    def echo(x):
+        return x
+
+    handle = serve.run(echo.bind(), name="npv2", route_prefix="npv2")
+    rt = current_runtime()
+    # Direct-channel discovery is async: keep issuing requests until a
+    # channel is ready and pump-engaged (the _engage idiom).
+    native = []
+    deadline = time.time() + 30
+    i = 0
+    while time.time() < deadline and not native:
+        body, _headers = _post(handle.http_port, "npv2", i)
+        assert body == {"result": i}
+        i += 1
+        native = [
+            st for st in rt._direct_states.values()
+            if st.get("status") == "ready" and st.get("chan") is not None
+            and getattr(st["chan"], "native", False)
+        ]
+        time.sleep(0.05)
+    assert native, "no direct channel engaged the native pump"
+    assert any(getattr(st["chan"], "npv", 0) >= frame_pump.TRACE_MIN_VER
+               for st in native), (
+        "native channel negotiated npv < 2: trace context cannot ride "
+        "the codec")
+
+
+def test_connected_trace_tree_forced_fallback():
+    """RTPU_NO_NATIVE=1: the same connected tree over the pure-Python
+    compact dict frames (the 'tc' field on the pickle dialect)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["RTPU_NO_NATIVE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_trace_waterfalls.py::test_connected_trace_tree",
+         "-q", "-p", "no:cacheprovider"],
+        cwd=repo, env=env, capture_output=True, timeout=300, text=True,
+    )
+    assert proc.returncode == 0, (
+        f"connected-tree test failed under RTPU_NO_NATIVE=1:\n"
+        f"{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}"
+    )
+
+
+# ------------------------------------- recorder surfaces on a live cluster
+
+
+def test_flight_recorder_cluster_surfaces(serve_cluster):
+    """A shed record is retrievable through every surface: the local
+    ring, the KV-merged list_cluster, the GCS traces_dump fan-out, and
+    the waterfall join."""
+    from ray_tpu.core.runtime_context import current_runtime
+
+    t0 = time.time()
+    trace_id = "beadfeed" * 4
+    prev = timeline.enter_span(trace_id, "")
+    try:
+        timeline.record_span("http:probe", t0, t0 + 0.01,
+                             parent=(trace_id, ""))
+    finally:
+        timeline.exit_span(prev)
+    flight_recorder.observe_request(
+        "http:probe", trace_id, t0, t0 + 0.01, status=503,
+        reason="shed", surface="http",
+    )
+    rows = flight_recorder.list_cluster(reason="shed", limit=50)
+    assert any(r["trace_id"] == trace_id for r in rows)
+    reply = current_runtime().cluster_traces(reason="shed")
+    assert reply.get("errors") == {}
+    found = [r for node in reply["nodes"]
+             for r in node.get("records", ())
+             if r.get("trace_id") == trace_id]
+    assert found, f"traces_dump fan-out missed the record: {reply}"
+    tree = flight_recorder.waterfall(trace_id)
+    assert any(s["name"] == "http:probe" for s in tree["spans"])
+    assert any(r["reason"] == "shed" for r in tree["records"])
+    text = flight_recorder.format_waterfall(tree)
+    assert trace_id in text and "http:probe" in text
+
+
+def test_shed_request_retained_with_trace(serve_cluster):
+    """End to end through the proxy: an admission-gate shed (503) leaves
+    a retrievable flight-recorder record whose trace id matches the
+    traceparent the CLIENT saw on the 503 response, with the gate's
+    decision recorded as a span event in the request's waterfall."""
+    from ray_tpu.serve import http_proxy
+    from ray_tpu.util import overload
+
+    @serve.deployment
+    def slowpoke(x):
+        time.sleep(0.2)
+        return x
+
+    handle = serve.run(slowpoke.bind(), name="shedme",
+                       route_prefix="shedme")
+    port = handle.http_port
+    # Force the gate shut: a permanently-full limiter + empty queue.
+    gate = http_proxy._gates.get("shedme")
+    tiny = overload.AdmissionGate(
+        overload.AIMDLimiter(initial=1, min_limit=1, max_limit=1),
+        max_queue=0,
+    )
+    tiny.limiter._inflight = 1
+    http_proxy._gates._gates["shedme"] = tiny
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/shedme",
+            data=json.dumps(1).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=30)
+        err = exc_info.value
+        assert err.code == 503
+        assert err.headers.get("Retry-After")
+        tp = err.headers.get("traceparent", "")
+        assert tp.startswith("00-")
+        trace_id = tp.split("-")[1]
+    finally:
+        http_proxy._gates._gates["shedme"] = gate
+    # The record lands in do_POST's finally, which runs just after the
+    # client got its 503: poll briefly instead of racing it.
+    deadline = time.time() + 10
+    rows = []
+    while time.time() < deadline:
+        rows = flight_recorder.list_cluster(reason="shed", limit=50,
+                                            include_gcs=False)
+        if any(r["trace_id"] == trace_id for r in rows):
+            break
+        time.sleep(0.2)
+    assert any(r["trace_id"] == trace_id for r in rows), (
+        f"shed request {trace_id} not retained: {rows[-5:]}")
+    # The admission-gate decision is a span event in the waterfall.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        tree = flight_recorder.waterfall(trace_id)
+        if any(s["name"].startswith("shed:proxy")
+               for s in tree["spans"]):
+            break
+        time.sleep(0.4)
+    assert any(s["name"].startswith("shed:proxy")
+               for s in tree["spans"]), tree["spans"]

@@ -59,6 +59,7 @@ CONST_MAP = {
     "ARG_VALUE": "_ARG_VALUE",
     "CALL_HAS_ARGS": "_HAS_ARGS",
     "CALL_HAS_NESTED": "_HAS_NESTED",
+    "CALL_HAS_TRACE": "_HAS_TRACE",
 }
 
 # Interned names that are NOT dialect vocabulary (CPython plumbing).
@@ -66,7 +67,8 @@ _INTERN_SKIP = {"bytes_attr"}
 
 # Wire-dict keys the mirror produces/consumes; each must be interned on
 # the C side or the native decoder emits differently-shaped dicts.
-MIRROR_WIRE_KEYS = ("type", "t", "i", "q", "a", "n", "d", "task_id",
+# "tc" is the codec-v2 trace-context tuple on call frames (PR 14).
+MIRROR_WIRE_KEYS = ("type", "t", "i", "q", "a", "n", "d", "tc", "task_id",
                     "results", "failed", "duration_s", "items", "msg_id")
 MIRROR_WIRE_VALUES = ("execute", "task_done", "task_done_batch", "fence",
                       "fence_ack")

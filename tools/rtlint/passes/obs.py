@@ -591,6 +591,50 @@ TRAIN_CONFIG_KEYS = (
 )
 
 
+# ---- request-waterfall / flight-recorder lint ------------------------------
+# The trace plane's metric surface (util/flight_recorder.py) and config
+# knobs (README "Request waterfalls & flight recorder"); a rename/kind
+# change must fail CI, not dashboards.
+
+TRACE_METRICS = {
+    "ray_tpu_trace_requests_total": "counter",
+    "ray_tpu_trace_retained_total": "counter",
+    "ray_tpu_flight_recorder_entries": "gauge",
+}
+
+TRACE_CONFIG_KEYS = (
+    "flight_recorder_size", "flight_recorder_slow_s",
+    "trace_client_span_every",
+)
+
+
+def validate_trace_metrics(declared):
+    failures = []
+    for name, kind in sorted(TRACE_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: flight-recorder metric not declared "
+                f"(util/flight_recorder.py drifted from the documented "
+                f"surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    return failures
+
+
+def validate_trace_config():
+    fields = _config_fields()
+    return [
+        f"core/config.py: trace/flight-recorder config key {key!r} "
+        f"missing from Config (documented knob drifted from the flag "
+        f"table)"
+        for key in TRACE_CONFIG_KEYS if key not in fields
+    ]
+
+
 def validate_train_metrics(declared):
     failures = []
     for name, kind in sorted(TRAIN_METRICS.items()):
@@ -855,12 +899,14 @@ class ObsMetricsPass(Pass):
         failures += validate_overload_metrics(declared)
         failures += validate_native_pump_metrics(declared)
         failures += validate_train_metrics(declared)
+        failures += validate_trace_metrics(declared)
         failures += validate_transfer_config()
         failures += validate_actor_config()
         failures += validate_overload_config()
         failures += validate_profiler_config()
         failures += validate_drain_config()
         failures += validate_train_config()
+        failures += validate_trace_config()
         self.stats = (f"{len(declared)} declared metric(s), "
                       f"{len(state['skipped'])} module(s) skipped at "
                       f"import")

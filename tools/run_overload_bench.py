@@ -199,8 +199,34 @@ def main():
             "retries_total": total("ray_tpu_serve_retries_total"),
         }
 
+        # ---- flight recorder + exemplars (ISSUE 14 acceptance) -------
+        # A chaos/overload run must leave shed/expired/chaos-hit
+        # requests retrievable from the tail-sampled flight recorder,
+        # with trace-id exemplars present in the exposition document.
+        from ray_tpu.util import flight_recorder, prometheus
+
+        retained = flight_recorder.list_cluster(limit=0,
+                                                include_gcs=False)
+        by_reason: dict = {}
+        for r in retained:
+            by_reason[r["reason"]] = by_reason.get(r["reason"], 0) + 1
+        doc = prometheus.render()
+        record["flight_recorder"] = {
+            "retained_total": len(retained),
+            "by_reason": by_reason,
+            "slow_threshold_s": flight_recorder.get_recorder()
+            .stats()["slow_threshold_s"],
+            "exemplars_in_exposition": doc.count("# {trace_id="),
+        }
+
         steady = record["chaos"]["steady"]
         record["acceptance"] = {
+            "flight_recorder_retained_shed_or_chaos": bool(
+                by_reason.get("shed") or by_reason.get("chaos")
+                or by_reason.get("expired")
+            ),
+            "exemplars_present":
+                record["flight_recorder"]["exemplars_in_exposition"] > 0,
             "breaker_opened":
                 "open" in record["chaos"]
                 ["breaker_states_after_warmup"].values(),
