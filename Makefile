@@ -75,9 +75,16 @@ train-chaos:
 # with exactly-once semantics) plus the drain + rolling-restart tests
 # (every worker node of a live 3-node cluster replaced under a serving
 # deployment with zero failed requests).
+# The fencing half (tests/test_fencing.py + tools/run_fence_chaos.py)
+# proves the asymmetric-partition scenario end to end — sticky
+# heartbeat partition, node fenced at a membership epoch, actor
+# restarted on a survivor with zero double-executions and zero stale
+# results, zombie self-termination + fresh-incarnation rejoin — and
+# records the numbers into OVERLOAD_r02.json.
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q \
-	  -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py \
+	  tests/test_fencing.py -q -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) tools/run_fence_chaos.py OVERLOAD_r02.json
 
 # Overload-control acceptance: the request-robustness test matrix
 # (deadline refusal/cancellation, adaptive shedding, breaker

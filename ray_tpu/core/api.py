@@ -263,6 +263,11 @@ def nodes():
             "IsHead": v.get("is_head", False),
             "Host": v.get("host"),
             "Labels": v.get("labels", {}),
+            # Membership-fence plane (core/fencing.py): which
+            # registration of this node id the row describes, and the
+            # cluster epoch the view was taken at.
+            "Incarnation": v.get("incarnation", 1),
+            "Epoch": v.get("epoch", 0),
         }
         for v in rt.nodes()
     ]

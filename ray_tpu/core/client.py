@@ -300,8 +300,21 @@ class ClientRuntime(WorkerRuntime):
                 # its deadline (or close()): the runtime is dead.
                 self._flag_pending_lost()
                 break
-            if msg.get("type") == "reply":
+            mtype = msg.get("type")
+            if mtype == "reply":
                 self.handle_reply(msg)
+            elif mtype == "node_fenced":
+                # Membership fence forwarded by the head NM: tear down
+                # our direct channels to the fenced node (a thin
+                # client's TCP channel to a zombie's actor stays
+                # healthy under an asymmetric partition otherwise).
+                try:
+                    self.fence_node(msg.get("node_id") or "",
+                                    int(msg.get("epoch") or 0))
+                # Channels die on next use; the hello-side incarnation
+                # check still fences re-resolution.
+                except Exception:  # rtlint: disable=swallowed-failure
+                    pass
             # execute frames never arrive: the server registers clients
             # outside the schedulable worker pool.
 

@@ -205,6 +205,13 @@ class Config:
     # object copies must replicate off-node inside this window; past it
     # the node exits anyway and lineage re-execution covers the rest.
     drain_timeout_s: float = 60.0
+    # --- split-brain fencing (core/fencing.py + the GCS epoch plane) ----
+    # Grace a fenced (zombie) node gives its workers between the
+    # cooperative "kill" frame and the hard SIGKILL while
+    # self-terminating: long enough to flush completion buffers and the
+    # event ring's tail, short enough that the stale actor incarnations
+    # cannot keep serving cached direct channels.
+    fence_kill_grace_s: float = 1.0
     # --- elastic train gang lifecycle (train/trainer.py supervisor) ------
     # A rank whose GCS-KV heartbeat is older than this is declared
     # dead/hung and the supervisor aborts the WHOLE gang promptly

@@ -1,0 +1,74 @@
+"""Split-brain fencing: shared metric surface + fence-state helpers.
+
+Ref analogue: the reference fences node death instead of merely
+observing it — the GCS stamps membership changes (``NotifyGCSRestart``,
+the node-death broadcast in gcs_node_manager) and a raylet that learns
+it was declared dead kills itself rather than rejoining as a zombie.
+Here the mechanism spans four layers:
+
+- **Membership epochs** (core/gcs.py): a monotonic cluster epoch bumped
+  on every node death and registration, persisted in the GCS snapshot.
+  Every node-death broadcast doubles as a ``node_fenced(node, epoch)``
+  fence decision.
+- **Incarnations**: each node registration and each actor start/restart
+  gets a GCS-assigned incarnation. ``get_actor_direct`` resolution
+  returns the actor incarnation and the direct hello/welcome handshake
+  carries and validates it — a caller holding a cached endpoint to a
+  stale incarnation is refused and re-resolves through the NM.
+- **Fence broadcast** (core/node_manager.py): receiving NMs tear down
+  direct channels and peer/data pools to the fenced node, park
+  in-flight direct calls into the exactly-once NM replay path (where
+  calls bound to the fenced incarnation are REFUSED, never re-executed
+  into the new incarnation), and drop subsequent peer frames from the
+  fenced incarnation.
+- **Zombie self-termination** (core/node_manager.py): a node whose
+  re-register reply says "you were declared dead at epoch E" kills its
+  workers (the stale actor incarnations die with them), skips its
+  sealed-object republish, and rejoins as a fresh incarnation with
+  empty state.
+
+The metrics below are the fence plane's documented surface
+(tools/rtlint validates names/kinds); they are declared here — one
+light module importable from the GCS, NM, worker and runtime sides —
+so every layer increments the same series.
+"""
+
+from __future__ import annotations
+
+from ..util.metrics import Counter as _Counter
+
+# Fence decisions observed by this process: the GCS declaring a node
+# dead at an epoch (kind="node_fenced"), an NM tearing down channels on
+# receipt of the broadcast (kind="channel_teardown"), a peer frame from
+# a fenced incarnation dropped (kind="peer_refused").
+FENCE_EVENTS = _Counter(
+    "ray_tpu_fence_events_total",
+    "Membership-fence decisions: node fenced at an epoch, fence-driven "
+    "channel teardowns, peer frames refused from fenced incarnations",
+    tag_keys=("kind",),
+)
+
+# Calls refused because they crossed an incarnation boundary: a
+# direct-channel replay bound to a fenced incarnation refused at the NM
+# (where="replay"), or a direct hello naming a stale actor incarnation
+# refused at the worker (where="hello").
+FENCE_REFUSED = _Counter(
+    "ray_tpu_fence_refused_calls_total",
+    "Actor calls refused at an incarnation boundary instead of risking "
+    "double execution (replay onto a restarted actor, stale hello)",
+    tag_keys=("where",),
+)
+
+# Zombie self-terminations: this node learned it was declared dead
+# while partitioned and killed its workers before rejoining fresh.
+ZOMBIE_KILLS = _Counter(
+    "ray_tpu_fence_zombie_kills_total",
+    "Times this node self-terminated its workers after learning it was "
+    "declared dead at an earlier membership epoch (zombie fencing)",
+)
+
+EVENT_NODE_FENCED = FENCE_EVENTS.with_tags(kind="node_fenced")
+EVENT_CHANNEL_TEARDOWN = FENCE_EVENTS.with_tags(kind="channel_teardown")
+EVENT_PEER_REFUSED = FENCE_EVENTS.with_tags(kind="peer_refused")
+REFUSED_REPLAY = FENCE_REFUSED.with_tags(where="replay")
+REFUSED_HELLO = FENCE_REFUSED.with_tags(where="hello")

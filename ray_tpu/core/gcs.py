@@ -51,7 +51,15 @@ GCS_SERVICES = (
                request=(("host", "str"), ("peer_port", "int"),
                         ("resources", "dict"),
                         ("labels", "dict", False)),
-               reply=(("nodes", "list"), ("chaos", "dict", False))),
+               # epoch/incarnation/fenced_at: the membership-fence
+               # plane (core/fencing.py). fenced_at != 0 tells a
+               # re-registering node it was declared dead at that epoch
+               # while partitioned — it must self-terminate its old
+               # incarnation's workers before resuming.
+               reply=(("nodes", "list"), ("chaos", "dict", False),
+                      ("epoch", "int", False, 0),
+                      ("incarnation", "int", False, 1),
+                      ("fenced_at", "int", False, 0))),
         Method("heartbeat",
                request=(("available", "dict"), ("pending", "int"),
                         ("shapes", "list", False)),
@@ -114,8 +122,13 @@ GCS_SERVICES = (
                request=(("name", "str"), ("actor_id", "str")),
                notify=True),
         Method("register_actor_node",
-               request=(("actor_id", "str"), ("node_id", "str")),
-               notify=True),
+               # No longer a notify: the reply carries the GCS-assigned
+               # actor incarnation (bumped on every start/restart when
+               # the caller passes none; a reconnect re-registration
+               # passes its existing incarnation to keep it).
+               request=(("actor_id", "str"), ("node_id", "str"),
+                        ("incarnation", "int", False, 0)),
+               reply=(("incarnation", "int"),)),
         Method("get_actor_node", request=(("actor_id", "str"),),
                reply=(("node_id", "any"),)),
     )),
@@ -214,6 +227,10 @@ class NodeEntry:
     state: str = "alive"  # alive | dead
     last_heartbeat: float = field(default_factory=time.monotonic)
     labels: Dict[str, str] = field(default_factory=dict)
+    # Membership-fence plane: which registration of this node id this
+    # entry is (a zombie rejoin gets a fresh one; stale-incarnation
+    # traffic is refused by peers and workers).
+    incarnation: int = 1
 
     def view(self) -> Dict[str, Any]:
         return {
@@ -227,6 +244,7 @@ class NodeEntry:
             "is_head": self.is_head,
             "state": self.state,
             "labels": self.labels,
+            "incarnation": self.incarnation,
         }
 
 
@@ -266,6 +284,12 @@ class GcsService:
         self.on_pgs_invalidated: Optional[Callable[[List[str]], None]] = None
         self.on_node_draining: Optional[Callable[[NodeEntry], None]] = None
         self.on_node_undrain: Optional[Callable[[NodeEntry], None]] = None
+        # Fence decision hook (head NM): tear down local direct
+        # channels to the fenced node and forward node_fenced frames to
+        # this node's workers (remote NMs learn via the broadcast).
+        self.on_node_fenced: Optional[
+            Callable[[NodeEntry, int], None]
+        ] = None
         self.on_chaos_update: Optional[
             Callable[[List[Dict[str, Any]], int], None]
         ] = None
@@ -276,6 +300,19 @@ class GcsService:
         self.chaos_specs: List[Dict[str, Any]] = []
         self.chaos_gen = 0
         self._chaos_spec_seq = 0
+
+        # Membership-fence plane (core/fencing.py): the monotonic
+        # cluster epoch bumps on EVERY node death and registration and
+        # is persisted in the snapshot (monotonic across head
+        # restarts). Node/actor incarnation counters make every
+        # registration distinguishable from its predecessors;
+        # _fenced_nodes remembers "declared dead at epoch E" until the
+        # node re-registers, so the rejoin reply can tell a zombie to
+        # self-terminate its old incarnation.
+        self.cluster_epoch = 0
+        self._node_incarnations: Dict[str, int] = {}  # node hex -> last
+        self._actor_incarnations: Dict[str, int] = {}  # actor hex -> last
+        self._fenced_nodes: Dict[str, int] = {}  # node hex -> epoch
 
         self._health_task: Optional[asyncio.Task] = None
         # Durable-table persistence (ref analogue: gcs_storage /
@@ -447,6 +484,13 @@ class GcsService:
                 for name, (aid, nid, spec) in self._named_actors.items()
             },
             "job_counter": self._job_counter,
+            # Fence plane: the epoch and incarnation counters must stay
+            # monotonic across head restarts, or a post-restart
+            # registration could reuse an incarnation a stale channel
+            # still names (the exact confusion fencing exists to stop).
+            "cluster_epoch": self.cluster_epoch,
+            "node_incarnations": dict(self._node_incarnations),
+            "actor_incarnations": dict(self._actor_incarnations),
         }
 
     def _persist_snapshot(self, snap):
@@ -498,6 +542,17 @@ class GcsService:
         self._job_counter = max(
             self._job_counter, snap.get("job_counter", 0)
         )
+        self.cluster_epoch = max(
+            self.cluster_epoch, int(snap.get("cluster_epoch", 0))
+        )
+        for hex_id, inc in (snap.get("node_incarnations") or {}).items():
+            self._node_incarnations[hex_id] = max(
+                self._node_incarnations.get(hex_id, 0), int(inc)
+            )
+        for hex_id, inc in (snap.get("actor_incarnations") or {}).items():
+            self._actor_incarnations[hex_id] = max(
+                self._actor_incarnations.get(hex_id, 0), int(inc)
+            )
 
     def stop(self):
         self._snapshot_final()
@@ -842,9 +897,35 @@ class GcsService:
                 key=name,
             )
 
-    async def _rpc_register_actor_node(self, _ctx, actor_id, node_id):
-        self._actor_nodes[ActorID.from_hex(actor_id)] = \
-            NodeID.from_hex(node_id)
+    async def _rpc_register_actor_node(self, _ctx, actor_id, node_id,
+                                       incarnation=0):
+        return {
+            "incarnation": self.register_actor_node(
+                ActorID.from_hex(actor_id), NodeID.from_hex(node_id),
+                incarnation=incarnation,
+            )
+        }
+
+    def register_actor_node(self, actor_id: ActorID, node_id: NodeID,
+                            incarnation: int = 0) -> int:
+        """Record the actor's home and assign its incarnation: 0 (the
+        default, a fresh start or restart) bumps the actor's counter —
+        every start across the whole cluster lifetime gets a distinct,
+        monotonically increasing incarnation; a nonzero value is a
+        reconnect re-registration keeping the incarnation it already
+        runs as (the counter only ratchets up)."""
+        hex_id = actor_id.hex()
+        if incarnation:
+            inc = int(incarnation)
+            if inc > self._actor_incarnations.get(hex_id, 0):
+                self._actor_incarnations[hex_id] = inc
+                self._dirty = True
+        else:
+            inc = self._actor_incarnations.get(hex_id, 0) + 1
+            self._actor_incarnations[hex_id] = inc
+            self._dirty = True
+        self._actor_nodes[actor_id] = node_id
+        return inc
 
     async def _rpc_get_actor_node(self, node_id, actor_id):
         nid = self._actor_nodes.get(ActorID.from_hex(actor_id))
@@ -1172,6 +1253,18 @@ class GcsService:
         is_head: bool = False,
         labels: Optional[Dict[str, str]] = None,
     ) -> Dict[str, Any]:
+        hex_id = node_id.hex()
+        # Membership-fence bookkeeping: every registration bumps the
+        # cluster epoch and gets the next incarnation of this node id.
+        # A node previously declared dead learns so via fenced_at in
+        # the reply (and must self-terminate its old incarnation's
+        # workers before resuming); its fence record clears here — the
+        # fresh incarnation is a first-class member again.
+        self.cluster_epoch += 1
+        incarnation = self._node_incarnations.get(hex_id, 0) + 1
+        self._node_incarnations[hex_id] = incarnation
+        fenced_at = self._fenced_nodes.pop(hex_id, 0)
+        self._dirty = True
         entry = NodeEntry(
             node_id=node_id,
             host=host,
@@ -1180,23 +1273,31 @@ class GcsService:
             resources_available=dict(resources),
             is_head=is_head,
             labels=labels or {},
+            incarnation=incarnation,
         )
         self._nodes[node_id] = entry
         await self._broadcast(
-            {"type": "node_added", "node": entry.view()}, exclude=node_id
+            {"type": "node_added", "node": entry.view(),
+             "epoch": self.cluster_epoch}, exclude=node_id
         )
         self.pubsub.publish(
             NODE_STATE, {"event": "added", "node": entry.view()},
-            key=node_id.hex(),
+            key=hex_id,
         )
         from ..util import events as _events
 
         self._record_event(
-            _events.INFO, _events.GCS,
-            f"node {node_id.hex()[:8]} registered "
-            f"(host={host}, resources={dict(resources)})",
-            node_id=node_id.hex(),
-            custom_fields={"host": host, "is_head": is_head},
+            _events.WARNING if fenced_at else _events.INFO,
+            _events.NODE if fenced_at else _events.GCS,
+            f"node {hex_id[:8]} registered as incarnation "
+            f"{incarnation} (epoch {self.cluster_epoch})"
+            + (f" — rejoin after fence at epoch {fenced_at}"
+               if fenced_at else f" (host={host})"),
+            node_id=hex_id,
+            custom_fields={"host": host, "is_head": is_head,
+                           "incarnation": incarnation,
+                           "epoch": self.cluster_epoch,
+                           "fenced_at": fenced_at},
         )
         if self.on_node_added is not None:
             self.on_node_added(entry)
@@ -1208,6 +1309,9 @@ class GcsService:
             # empty plan disarms — correct after a head restart too).
             "chaos": {"specs": list(self.chaos_specs),
                       "gen": self.chaos_gen},
+            "epoch": self.cluster_epoch,
+            "incarnation": incarnation,
+            "fenced_at": fenced_at,
         }
 
     async def _retry_pending_pgs(self):
@@ -1230,7 +1334,8 @@ class GcsService:
 
     async def _broadcast_load(self):
         views = [e.view() for e in self._nodes.values() if e.state == "alive"]
-        msg = {"type": "cluster_load", "nodes": views}
+        msg = {"type": "cluster_load", "nodes": views,
+               "epoch": self.cluster_epoch}
         await self._broadcast(msg)
         if self.on_load_update is not None:
             self.on_load_update(msg)
@@ -1250,6 +1355,18 @@ class GcsService:
 
     async def _mark_node_dead(self, entry: NodeEntry, reason: str):
         entry.state = "dead"
+        # Fence the death at a new membership epoch: peers must stop
+        # trusting this incarnation NOW (tear down direct/data channels,
+        # refuse its frames), and if the node is actually alive behind
+        # an asymmetric partition, its eventual re-register reply will
+        # carry this epoch so it self-terminates instead of resuming.
+        self.cluster_epoch += 1
+        dead_hex = entry.node_id.hex()
+        self._fenced_nodes[dead_hex] = self.cluster_epoch
+        self._dirty = True
+        from . import fencing as _fencing
+
+        _fencing.EVENT_NODE_FENCED.inc()
         conn = self._conns.pop(entry.node_id, None)
         if conn is not None:
             conn.close()
@@ -1274,13 +1391,24 @@ class GcsService:
         # void (ref analogue: GcsPlacementGroupManager::OnNodeDead
         # rescheduling).
         invalid_pgs: List[str] = []
-        dead_hex = entry.node_id.hex()
         for pg_id, pg in self._pgs.items():
             if pg["state"] == "created" and pg["nodes"] and dead_hex in pg["nodes"]:
                 pg["state"] = "pending"
                 pg["nodes"] = None
                 pg["event"] = asyncio.Event()
                 invalid_pgs.append(pg_id)
+        # Fence broadcast rides the same channel as node_draining: every
+        # peer NM tears down its direct channels and data pools to the
+        # fenced node and refuses the fenced incarnation's frames. Sent
+        # BEFORE node_dead so teardown precedes the death cleanup.
+        await self._broadcast(
+            {
+                "type": "node_fenced",
+                "node_id": dead_hex,
+                "epoch": self.cluster_epoch,
+                "incarnation": entry.incarnation,
+            }
+        )
         await self._broadcast(
             {
                 "type": "node_dead",
@@ -1288,6 +1416,7 @@ class GcsService:
                 "reason": reason,
                 "dead_actors": [a.hex() for a in dead_actors],
                 "invalid_pgs": invalid_pgs,
+                "epoch": self.cluster_epoch,
             }
         )
         self.pubsub.publish(
@@ -1298,6 +1427,18 @@ class GcsService:
         )
         from ..util import events as _events
 
+        self._record_event(
+            _events.WARNING, _events.NODE,
+            f"FENCE: node {dead_hex[:8]} (incarnation "
+            f"{entry.incarnation}) fenced at epoch "
+            f"{self.cluster_epoch}: {reason}",
+            node_id=dead_hex,
+            custom_fields={
+                "reason": reason,
+                "epoch": self.cluster_epoch,
+                "incarnation": entry.incarnation,
+            },
+        )
         self._record_event(
             _events.ERROR, _events.GCS,
             f"node {dead_hex[:8]} died: {reason}",
@@ -1310,6 +1451,11 @@ class GcsService:
         )
         if invalid_pgs and self.on_pgs_invalidated is not None:
             self.on_pgs_invalidated(invalid_pgs)
+        # Fence teardown BEFORE the death cleanup: the head's direct
+        # channels to the fenced node must stop carrying calls before
+        # replay/restart bookkeeping runs.
+        if self.on_node_fenced is not None:
+            self.on_node_fenced(entry, self.cluster_epoch)
         if self.on_node_dead is not None:
             self.on_node_dead(entry)
         if invalid_pgs:
@@ -1373,6 +1519,13 @@ class GcsService:
     # --------------------------------------------------------------- objects
 
     def publish_object(self, object_id: ObjectID, node_id: NodeID):
+        # Fence guard: a location claim from a node we do not currently
+        # hold alive is a stale republish from a fenced incarnation (or
+        # a ghost) — recording it would resurrect a location consumers
+        # already recovered away from.
+        entry = self._nodes.get(node_id)
+        if entry is None or entry.state == "dead":
+            return
         self._object_nodes.setdefault(object_id, set()).add(node_id)
         ev = self._object_events.pop(object_id, None)
         if ev is not None:
@@ -1417,7 +1570,13 @@ class GcsService:
         return self._pick_object_node(object_id)
 
     def nodes_view(self) -> List[Dict[str, Any]]:
-        return [e.view() for e in self._nodes.values()]
+        views = [e.view() for e in self._nodes.values()]
+        for v in views:
+            # Cluster epoch stamped per row so every nodes() consumer
+            # (rtpu nodes, /api/nodes, thin clients) sees it without a
+            # second RPC.
+            v["epoch"] = self.cluster_epoch
+        return views
 
 
 # Ops the gcs_rpc injection point never faults: the chaos plane's own
@@ -1567,8 +1726,11 @@ class LocalGcsHandle:
         if cur is not None and cur[0] == actor_id:
             self._svc._named_actors.pop(name, None)
 
-    async def register_actor_node(self, actor_id, node_id):
-        self._svc._actor_nodes[actor_id] = node_id
+    async def register_actor_node(self, actor_id, node_id,
+                                  incarnation: int = 0) -> int:
+        return self._svc.register_actor_node(
+            actor_id, node_id, incarnation=incarnation
+        )
 
     async def get_actor_node(self, actor_id):
         return self._svc._actor_nodes.get(actor_id)
@@ -1721,11 +1883,13 @@ class RemoteGcsHandle:
              "msg_id": None}
         )
 
-    async def register_actor_node(self, actor_id, node_id):
-        await self._client.notify(
+    async def register_actor_node(self, actor_id, node_id,
+                                  incarnation: int = 0) -> int:
+        r = await self._client.request(
             {"op": "register_actor_node", "actor_id": actor_id.hex(),
-             "node_id": node_id.hex(), "msg_id": None}
+             "node_id": node_id.hex(), "incarnation": incarnation}
         )
+        return int(r.get("incarnation") or 0)
 
     async def get_actor_node(self, actor_id):
         r = await self._client.request(

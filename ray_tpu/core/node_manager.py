@@ -58,6 +58,7 @@ from .object_store import (
     shutdown_arena,
 )
 from .spilling import SpillManager
+from . import fencing as _fencing
 from .peers import PeerClient
 from .placement_group import BundleState
 from .protocol import AioFramedWriter, aio_read_frame
@@ -288,6 +289,12 @@ class ActorInfo:
     direct_path: Optional[str] = None
     direct_addr: Optional[Tuple[str, int]] = None
     direct_ver: int = 1
+    # GCS-assigned incarnation of the CURRENT start of this actor
+    # (bumped on every start/restart cluster-wide). Resolution returns
+    # it, the direct hello carries it, and the worker refuses a
+    # mismatch — a cached endpoint to a stale incarnation can never
+    # execute against the wrong actor state (split-brain fencing).
+    incarnation: int = 0
 
 
 class NodeManager:
@@ -388,6 +395,34 @@ class NodeManager:
         self._peers: Dict[str, PeerClient] = {}
         self._forwarded: Dict[TaskID, TaskRecord] = {}
         self._actor_homes: Dict[ActorID, str] = {}  # hex node or "dead"
+        # Membership-fence plane (core/fencing.py). incarnation/epoch
+        # come from the GCS register reply; _fenced_nodes holds peers
+        # the GCS declared dead (their frames are refused and our
+        # channels to them torn down) until a fresh incarnation of the
+        # same node id rejoins; _fenced_self_epoch makes the zombie
+        # self-termination idempotent per fence decision.
+        self.incarnation = 0
+        self.cluster_epoch = 0
+        self._fenced_nodes: Dict[str, int] = {}  # hex -> fence epoch
+        self._fenced_self_epoch = 0
+        # Hook the co-resident driver runtime installs so a fence
+        # broadcast tears down ITS direct channels to the fenced node
+        # (worker/client runtimes learn via forwarded node_fenced
+        # frames instead).
+        self.on_node_fenced_runtime = None
+        # Restart-elsewhere: the ORIGIN node of a restartable actor
+        # creation (max_restarts != 0) pins the creation spec + a
+        # restart budget, and re-places the actor on a surviving node
+        # when its home is fenced (ref analogue:
+        # GcsActorManager::OnNodeDead rescheduling).
+        self._actor_creations: Dict[ActorID, TaskSpec] = {}
+        self._actor_restart_budget: Dict[ActorID, int] = {}
+        # Calls parked while a fenced actor restarts elsewhere: ONE
+        # ordered queue per actor, drained FIFO once the new home
+        # resolves — independent per-record polls would re-route them
+        # in arbitrary order and break per-caller actor-call ordering
+        # across the restart boundary.
+        self._fence_parked: Dict[ActorID, List[TaskRecord]] = {}
         self._pulls: Dict[ObjectID, asyncio.Future] = {}
         self._heartbeat_task: Optional[asyncio.Task] = None
         # NM-process store client for the pull/push data path.
@@ -568,6 +603,7 @@ class NodeManager:
             self.gcs_service.on_node_draining = self._on_gcs_node_draining
             self.gcs_service.on_node_undrain = self._on_gcs_node_undrain
             self.gcs_service.on_chaos_update = self._on_gcs_chaos_update
+            self.gcs_service.on_node_fenced = self._on_gcs_node_fenced
             self._gcs = LocalGcsHandle(self.gcs_service)
             reply = await self.gcs_service.register_node(
                 self.node_id,
@@ -577,6 +613,8 @@ class NodeManager:
                 is_head=True,
                 labels=self.labels,
             )
+            self.incarnation = int(reply.get("incarnation") or 1)
+            self.cluster_epoch = int(reply.get("epoch") or 0)
             self._apply_cluster_views(reply["nodes"])
         elif self._gcs_address is not None:
             await self._connect_gcs()
@@ -638,11 +676,25 @@ class NodeManager:
             raise
         self._gcs_client = client
         self._gcs = RemoteGcsHandle(client)
+        prev_incarnation = self.incarnation
+        self.incarnation = int(reply.get("incarnation") or 1)
+        self.cluster_epoch = max(
+            self.cluster_epoch, int(reply.get("epoch") or 0)
+        )
         self._apply_cluster_views(reply["nodes"])
         # Late joiner / reconnect: adopt the head's current chaos plan
         # (empty = disarm — correct after a head restart too).
         chaos = reply.get("chaos") or {}
         faults.apply_plan(chaos.get("specs") or [], chaos.get("gen"))
+        fenced_at = int(reply.get("fenced_at") or 0)
+        if fenced_at and prev_incarnation:
+            # The reply says this node was declared dead at epoch
+            # fenced_at while we were partitioned: the registration that
+            # just happened is a FRESH incarnation, and the old one's
+            # workers (stale actor incarnations, stale sealed objects)
+            # must die before we resume — rejoining a split brain as-is
+            # would double-execute calls and resurrect stale locations.
+            await self._zombie_self_fence(fenced_at)
 
     async def _reconnect_gcs(self) -> bool:
         """Head-restart tolerance (ref analogue: NotifyGCSRestart,
@@ -682,8 +734,12 @@ class NodeManager:
                 continue
             spec = info.creation_spec
             try:
+                # Reconnect re-registration: pass the incarnation we
+                # already run as — the GCS must NOT mint a new one (the
+                # actor did not restart, the head did).
                 await self._gcs.register_actor_node(
-                    spec.actor_id, self.node_id
+                    spec.actor_id, self.node_id,
+                    incarnation=info.incarnation,
                 )
                 if spec.name:
                     await self._gcs.register_named_actor(
@@ -697,6 +753,92 @@ class NodeManager:
                     f"it until the next reconnect\n"
                 )
         await self._publish_all_sealed()
+
+    async def _zombie_self_fence(self, epoch: int):
+        """This node learned it was declared dead at ``epoch`` while it
+        was (asymmetrically) partitioned. The cluster has moved on:
+        peers tore down their channels, restartable actors restarted
+        elsewhere, lineage re-executed what we owned. Resuming the old
+        identity would split the brain — callers holding cached direct
+        endpoints would execute against stale actor incarnations and
+        our sealed-object republish would resurrect locations consumers
+        already recovered away from. So: kill the workers (the stale
+        incarnations die with them), drop queued work and local state,
+        and continue as the fresh incarnation the re-register reply
+        assigned — empty, but a first-class member again."""
+        if self._fenced_self_epoch >= epoch:
+            return  # already fenced for this (or a later) decision
+        self._fenced_self_epoch = epoch
+        _fencing.ZOMBIE_KILLS.inc()
+        workers = [
+            w for w in self._workers.values()
+            if w.state != "dead" and w.worker_type != "client"
+        ]
+        cluster_events.emit(
+            cluster_events.WARNING, cluster_events.NODE,
+            f"node {self.node_id.hex()[:8]} was declared dead at epoch "
+            f"{epoch} while partitioned: terminating "
+            f"{len(workers)} worker(s) and rejoining as incarnation "
+            f"{self.incarnation} with empty state (zombie fencing)",
+            node_id=self.node_id.hex(),
+            custom_fields={"epoch": epoch,
+                           "incarnation": self.incarnation,
+                           "workers_killed": len(workers)},
+        )
+        # Mark every actor dead BEFORE the kills so the worker-death
+        # handler cannot restart a stale incarnation locally.
+        for info in self._actors.values():
+            if info.state == "dead":
+                continue
+            info.state = "dead"
+            info.death_cause = "node fenced (zombie incarnation terminated)"
+            info.restarts_left = 0
+            for rec in list(info.inflight.values()):
+                self._fail_task(
+                    rec, ActorDiedError(rec.spec.name, info.death_cause)
+                )
+            info.inflight.clear()
+            self._fail_actor_queue(info, info.death_cause)
+        # Cooperative kill first (lets completion buffers and the event
+        # ring's tail flush), hard kill whatever outlives the grace.
+        for w in workers:
+            w._intentional_kill = True
+            try:
+                await w.writer.send({"type": "kill"})
+            # Dying worker — the hard kill below covers it.
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass
+        grace = max(
+            0.0, float(getattr(self.config, "fence_kill_grace_s", 1.0))
+        )
+        deadline = self._loop.time() + grace
+        while self._loop.time() < deadline and any(
+            w.proc is not None and w.proc.poll() is None for w in workers
+        ):
+            await asyncio.sleep(0.05)
+        for w in workers:
+            if w.proc is not None and w.proc.poll() is None:
+                try:
+                    w.proc.kill()
+                # Already reaped between the poll and the kill.
+                except Exception:  # rtlint: disable=swallowed-failure
+                    pass
+        # Stale state must not resurrect: nothing sealed here is
+        # publishable (consumers re-located or re-executed during the
+        # fence window), queued work was already re-executed by its
+        # owners' lineage after the death broadcast, and remote-actor
+        # routing caches re-resolve through the GCS.
+        self._sealed.clear()
+        self._ready = _ReadyQueue(self._sched_class)
+        self._waiting.clear()
+        self._dep_index.clear()
+        self._named_actors.clear()
+        self._actor_homes.clear()
+        try:
+            cluster_events.flush()
+        # Event transport mid-reconnect: the ring keeps the record.
+        except Exception:  # rtlint: disable=swallowed-failure
+            pass
 
     # ------------------------------------------------------- cluster plumbing
 
@@ -712,8 +854,16 @@ class NodeManager:
                 # keep them in view or _get_peer fails mid-drain; the
                 # schedulers already skip any non-"alive" state.
                 self._cluster_view[v["node_id"]] = v
+                # A live view of a previously fenced node id is a FRESH
+                # incarnation rejoining (the GCS only re-admits after
+                # re-registration, and the zombie self-terminated its
+                # old incarnation first): stop refusing its frames.
+                self._fenced_nodes.pop(v["node_id"], None)
             else:
                 self._cluster_view.pop(v["node_id"], None)
+            epoch = v.get("epoch")
+            if epoch:
+                self.cluster_epoch = max(self.cluster_epoch, int(epoch))
 
     def _local_view(self, include_shapes: bool = False) -> Dict[str, Any]:
         view = {
@@ -732,6 +882,8 @@ class NodeManager:
             # place_bundles filter to state == "alive").
             "state": "draining" if self._draining else "alive",
             "labels": self.labels,
+            "incarnation": self.incarnation,
+            "epoch": self.cluster_epoch,
         }
         if include_shapes:
             # O(queue) — heartbeat-rate only, never per _schedule pass.
@@ -812,6 +964,67 @@ class NodeManager:
         asyncio.ensure_future(
             self._on_node_dead_hex(entry.node_id.hex(), dead_actors=None)
         )
+
+    def _on_gcs_node_fenced(self, entry, epoch: int):
+        """Head-side hook for the GCS fence decision (remote nodes
+        learn via the node_fenced broadcast)."""
+        self._on_node_fenced(entry.node_id.hex(), epoch,
+                             getattr(entry, "incarnation", 0))
+
+    def _on_node_fenced(self, node_hex: str, epoch: int,
+                        incarnation: int = 0):
+        """The GCS fenced ``node_hex`` at membership epoch ``epoch``:
+        stop trusting that incarnation NOW. Our direct channels to it
+        are torn down (the co-resident driver runtime via the installed
+        hook, worker/client runtimes via forwarded node_fenced frames);
+        the reader failure path parks their in-flight calls into the
+        exactly-once NM replay path, where calls bound to the fenced
+        incarnation are REFUSED rather than re-executed. Subsequent
+        peer frames from the fenced node are dropped until a fresh
+        incarnation of it rejoins."""
+        if epoch:
+            self.cluster_epoch = max(self.cluster_epoch, int(epoch))
+        if node_hex == self.node_id.hex():
+            # We can still hear the GCS but IT declared US dead (e.g. a
+            # one-way partition where only our sends are lost): fence
+            # ourselves now; the reconnect loop re-registers fresh.
+            asyncio.ensure_future(
+                self._zombie_self_fence(epoch or self.cluster_epoch)
+            )
+            return
+        self._fenced_nodes[node_hex] = epoch
+        _fencing.EVENT_CHANNEL_TEARDOWN.inc()
+        hook = self.on_node_fenced_runtime
+        if hook is not None:
+            try:
+                hook(node_hex, epoch)
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(
+                    f"[ray_tpu] node {self.node_id.hex()[:8]}: driver "
+                    f"fence hook failed ({e!r}); its direct channels "
+                    f"to {node_hex[:8]} die on next use instead\n"
+                )
+        asyncio.ensure_future(
+            self._broadcast_fence_to_workers(node_hex, epoch)
+        )
+
+    async def _broadcast_fence_to_workers(self, node_hex: str,
+                                          epoch: int):
+        """Forward the fence decision to every local worker AND thin
+        client: their runtimes hold their own direct channels to the
+        fenced node's actors (healthy sockets under an asymmetric
+        partition — they would keep executing calls on the stale
+        incarnation without this)."""
+        frame = {"type": "node_fenced", "node_id": node_hex,
+                 "epoch": epoch}
+        for w in list(self._workers.values()):
+            if w.state == "dead":
+                continue
+            try:
+                await w.writer.send(dict(frame))
+            # Dying worker/client: its channels die with the process.
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass
 
     def _on_gcs_node_draining(self, entry):
         """Head-side hook for the GCS drain RPC (remote nodes learn via
@@ -897,6 +1110,11 @@ class NodeManager:
             self._schedule()
         elif mtype == "cluster_load":
             self._apply_cluster_views(msg["nodes"])
+        elif mtype == "node_fenced":
+            self._on_node_fenced(
+                msg["node_id"], int(msg.get("epoch") or 0),
+                int(msg.get("incarnation") or 0),
+            )
         elif mtype == "node_dead":
             self._invalidate_pgs(msg.get("invalid_pgs") or [])
             await self._on_node_dead_hex(
@@ -1446,6 +1664,21 @@ class NodeManager:
         w.state = "dead"
         self._workers.pop(w.worker_id, None)
         exit_code = w.proc.poll() if w.proc is not None else None
+        if exit_code is None and w.proc is not None and not self._shutdown:
+            # The socket closes BEFORE the kernel finishes the exit, so
+            # an immediate poll() often races to None and a real crash
+            # classifies as a routine lifecycle event (the PR 14 tier-1
+            # flake). Reap off the loop for a bounded window so the
+            # exit code (or signal class) is actually captured.
+            def _reap():
+                try:
+                    return w.proc.wait(timeout=2.0)
+                # Still running past the window (or already reaped):
+                # fall back to the None classification below.
+                except Exception:  # rtlint: disable=swallowed-failure
+                    return w.proc.poll()
+
+            exit_code = await self._loop.run_in_executor(None, _reap)
         # Intentional kills (ray_tpu.kill(actor), force task-cancel) are
         # routine API usage, not crashes: keep them out of the ERROR view.
         graceful = (getattr(w, "_graceful_exit", False)
@@ -1564,8 +1797,22 @@ class NodeManager:
                 framed.close()
                 return
             peer_hex = hello["node_id"]
+            if peer_hex in self._fenced_nodes:
+                # Fenced incarnation dialing in: refuse — its frames
+                # (task results, locates, seal pushes) name state the
+                # cluster already recovered away from. A fresh
+                # incarnation is unfenced at re-registration.
+                _fencing.EVENT_PEER_REFUSED.inc()
+                framed.close()
+                return
             while True:
                 msg = await aio_read_frame(reader)
+                if peer_hex in self._fenced_nodes:
+                    # Fenced mid-connection: drop the frame and the
+                    # channel (the zombie's healthy socket must not
+                    # keep feeding us stale results/locates).
+                    _fencing.EVENT_PEER_REFUSED.inc()
+                    break
                 if msg.get("type") in ("stacks_dump", "profile_run",
                                        "traces_dump",
                                        "get_actor_direct_peer",
@@ -1899,6 +2146,11 @@ class NodeManager:
         return None
 
     async def _get_peer(self, peer_hex: str) -> PeerClient:
+        if peer_hex in self._fenced_nodes:
+            raise ConnectionError(
+                f"node {peer_hex[:8]} fenced at epoch "
+                f"{self._fenced_nodes[peer_hex]}"
+            )
         peer = self._peers.get(peer_hex)
         if isinstance(peer, asyncio.Future):
             # A concurrent caller is connecting: share its connection so
@@ -2084,8 +2336,9 @@ class NodeManager:
             self._borrowed_from.pop(oid, None)
         # Remote actors homed there are gone (mark before requeueing so
         # re-routed actor tasks fail with ActorDiedError, not a plain-worker
-        # dispatch). Actor-restart-on-another-node is future work; creations
-        # still in flight do retry elsewhere below.
+        # dispatch). Restartable creations this node owns re-place on a
+        # surviving node below (_restart_actor_elsewhere); creations
+        # still in flight also retry elsewhere.
         if dead_actors is None:
             dead_actors = [
                 aid.hex() for aid, h in self._actor_homes.items() if h == node_hex
@@ -2094,6 +2347,14 @@ class NodeManager:
             aid = ActorID.from_hex(aid_hex)
             if self._actor_homes.get(aid) == node_hex:
                 self._actor_homes[aid] = "dead"
+        # Restart-elsewhere: creations this node owns whose home was
+        # just fenced re-place on a surviving node, within the pinned
+        # restart budget (ref analogue: GcsActorManager::OnNodeDead
+        # rescheduling dead actors onto live raylets).
+        for aid_hex in dead_actors:
+            aid = ActorID.from_hex(aid_hex)
+            if aid in self._actor_creations:
+                self._spawn_bg(self._restart_actor_elsewhere(aid))
         # Objects whose only known copy was on the dead node: unseal the
         # ones whose lineage we own so the next consumer (or a dependency
         # resolution) re-executes the creating task instead of pulling from
@@ -2132,6 +2393,50 @@ class NodeManager:
                     ),
                 )
         self._schedule()
+
+    async def _restart_actor_elsewhere(self, aid: ActorID):
+        """Re-place an owned restartable actor whose home node was
+        fenced: re-submit the pinned creation spec so the scheduler
+        picks a surviving node, under the pinned restart budget. The
+        fresh placement gets a NEW GCS-assigned incarnation, so any
+        caller still holding the fenced incarnation's endpoint is
+        refused at the hello and re-resolves. Calls parked on the
+        "dead" home re-route via _route_actor_via_gcs once the new home
+        registers; direct-replay calls bound to the fenced incarnation
+        stay REFUSED (a restarted actor has no replay-dedup cache —
+        executing them could double-execute)."""
+        spec = self._actor_creations.get(aid)
+        if spec is None:
+            return
+        if self._actor_homes.get(aid) != "dead":
+            return  # recovered (or restarted) already
+        budget = self._actor_restart_budget.get(aid, 0)
+        if budget == 0:
+            cluster_events.emit(
+                cluster_events.ERROR, cluster_events.ACTOR,
+                f"actor {aid.hex()[:8]} ({spec.class_name}) died with "
+                f"its fenced node and has no restarts left",
+                node_id=self.node_id.hex(), actor_id=aid.hex(),
+            )
+            return
+        if budget > 0:
+            self._actor_restart_budget[aid] = budget - 1
+        cluster_events.emit(
+            cluster_events.WARNING, cluster_events.ACTOR,
+            f"actor {aid.hex()[:8]} ({spec.class_name}) restarting on a "
+            f"surviving node after its home was fenced "
+            f"({'unlimited' if budget < 0 else budget - 1} restart(s) "
+            f"left)",
+            node_id=self.node_id.hex(), actor_id=aid.hex(),
+            custom_fields={"class_name": spec.class_name},
+        )
+        oid = spec.return_ids()[0]
+        ev = self._seal_events.get(oid)
+        if ev is not None:
+            ev.clear()
+        self._sealed.discard(oid)
+        self._actor_homes.pop(aid, None)
+        await self.submit_task(spec)
 
     # ------------------------------------------------------------------ drain
 
@@ -2369,6 +2674,15 @@ class NodeManager:
             # analogue: RegisterActor before CreateActor,
             # gcs_actor_manager.cc:255).
             self._pre_register_actor(spec)
+            if origin is None and spec.max_restarts != 0:
+                # This node OWNS a restartable creation: pin the spec +
+                # a restart budget so a fenced home node re-places the
+                # actor on a survivor (setdefault: a restart
+                # re-submission must not refill the budget).
+                self._actor_creations[spec.actor_id] = spec
+                self._actor_restart_budget.setdefault(
+                    spec.actor_id, spec.max_restarts
+                )
         if spec.task_type == TaskType.ACTOR_TASK:
             # Actor tasks never wait for deps here: the actor's worker
             # resolves arguments at execution, which preserves per-caller
@@ -2489,12 +2803,53 @@ class NodeManager:
     def _route_actor_task_cluster(self, record: TaskRecord):
         """Route an actor call to wherever the actor lives."""
         spec = record.spec
+        parked = self._fence_parked.get(spec.actor_id)
+        if parked is not None and not getattr(spec, "direct_replay",
+                                              False):
+            # A restart-elsewhere drain is pending for this actor:
+            # queue behind the already-parked calls so per-caller order
+            # survives the fence window (routing directly would let
+            # this call overtake them).
+            parked.append(record)
+            record.state = "queued"
+            return
         info = self._actors.get(spec.actor_id)
         if info is not None:
             self._route_actor_task(record)
             return
         home = self._actor_homes.get(spec.actor_id)
         if home == "dead":
+            if getattr(spec, "direct_replay", False):
+                # A direct-channel call parked by the fence: the old
+                # incarnation may have executed it (reply lost in the
+                # partition) and the restarted incarnation has no
+                # replay-dedup record of it — REFUSE rather than risk a
+                # double execution on the new incarnation.
+                _fencing.REFUSED_REPLAY.inc()
+                self._fail_task(
+                    record,
+                    ActorDiedError(
+                        spec.name,
+                        "fenced: direct-call replay bound to a dead "
+                        "incarnation refused",
+                    ),
+                )
+                return
+            if (spec.actor_id in self._actor_creations
+                    and self._actor_restart_budget.get(spec.actor_id, 0)
+                    != 0):
+                # Restart-elsewhere is in flight (kicked by the fence):
+                # park the call in the actor's ordered queue; the drain
+                # re-routes the whole queue FIFO once the new home
+                # resolves.
+                record.state = "queued"
+                q = self._fence_parked.setdefault(spec.actor_id, [])
+                q.append(record)
+                if len(q) == 1:
+                    self._spawn_bg(
+                        self._drain_fence_parked(spec.actor_id)
+                    )
+                return
             self._fail_task(
                 record, ActorDiedError(spec.name, "actor's node died")
             )
@@ -2508,6 +2863,53 @@ class NodeManager:
             )
             return
         asyncio.ensure_future(self._route_actor_via_gcs(record))
+
+    async def _drain_fence_parked(self, aid: ActorID):
+        """Resolve the restarted actor's new home and re-route the
+        parked queue FIFO (one drain task per actor; new calls keep
+        appending to the queue until it empties, so nothing overtakes).
+        The final drain is synchronous — no await between forwards —
+        so a call routed right after cannot interleave."""
+        deadline = time.monotonic() + self.config.object_locate_timeout_s
+        while True:
+            if self._shutdown:
+                self._fence_parked.pop(aid, None)
+                return
+            if self._actors.get(aid) is not None:
+                for rec in self._fence_parked.pop(aid, []):
+                    if rec.state != "cancelled":
+                        self._route_actor_task(rec)
+                return
+            home = self._actor_homes.get(aid)
+            if home is not None and home != "dead":
+                for rec in self._fence_parked.pop(aid, []):
+                    if rec.state != "cancelled":
+                        self._forward_record(rec, home)
+                return
+            nid = None
+            if self._gcs is not None:
+                try:
+                    nid = await self._gcs.get_actor_node(aid)
+                # Poll loop IS the handler (GCS blip -> next round).
+                except Exception:  # rtlint: disable=swallowed-failure
+                    nid = None
+            if (nid is not None and nid != self.node_id
+                    and nid.hex() not in self._fenced_nodes):
+                if self._actor_homes.get(aid) in (None, "dead"):
+                    self._actor_homes[aid] = nid.hex()
+                continue  # drained via the home branch next iteration
+            if time.monotonic() > deadline:
+                for rec in self._fence_parked.pop(aid, []):
+                    if rec.state != "cancelled":
+                        self._fail_task(
+                            rec,
+                            ActorDiedError(
+                                rec.spec.name,
+                                "actor not found after fence restart",
+                            ),
+                        )
+                return
+            await asyncio.sleep(0.05)
 
     async def _route_actor_via_gcs(self, record: TaskRecord):
         """Handle deserialized on a node that has never seen this actor:
@@ -3287,10 +3689,9 @@ class NodeManager:
                 name=spec.name,
             )
             self._actors[spec.actor_id] = info
-        if self._gcs is not None:
-            asyncio.ensure_future(
-                self._gcs.register_actor_node(spec.actor_id, self.node_id)
-            )
+        # Home + incarnation registration happens inside _place_actor
+        # (the GCS assigns the incarnation the creation spec carries to
+        # the worker — registering here too would mint a second one).
         asyncio.ensure_future(self._place_actor(info, record))
 
     async def _claim_actor_name(self, spec: TaskSpec) -> bool:
@@ -3311,6 +3712,29 @@ class NodeManager:
 
     async def _place_actor(self, info: ActorInfo, record: TaskRecord):
         spec = info.creation_spec
+        # Every start/restart gets a GCS-assigned incarnation (the same
+        # call records this node as the actor's home). The creation
+        # spec carries it to the worker, which refuses direct hellos
+        # naming any other incarnation — the fencing half of the direct
+        # plane's stale-endpoint discipline.
+        if self._gcs is not None:
+            try:
+                info.incarnation = await self._gcs.register_actor_node(
+                    spec.actor_id, self.node_id
+                )
+            except Exception as e:  # noqa: BLE001
+                # GCS unreachable mid-placement: fall back to a local
+                # bump so restarts still move forward; the reconnect
+                # republish ratchets the GCS counter up to ours.
+                info.incarnation = max(1, info.incarnation + 1)
+                sys.stderr.write(
+                    f"[ray_tpu] actor {spec.actor_id.hex()[:8]} "
+                    f"incarnation assignment via GCS failed ({e!r}); "
+                    f"using local {info.incarnation}\n"
+                )
+        else:
+            info.incarnation = max(1, info.incarnation + 1)
+        spec.actor_incarnation = info.incarnation
         if spec.name:
             if not await self._claim_actor_name(spec):
                 self._fail_task(
@@ -3399,6 +3823,25 @@ class NodeManager:
                 return
             info.queued.append(spec)
             record.state = "queued"
+            return
+        if (getattr(spec, "direct_replay", False)
+                and spec.actor_incarnation
+                and info.incarnation
+                and spec.actor_incarnation != info.incarnation):
+            # Replay bound to an EARLIER incarnation of a now-alive
+            # actor (restarted before the replay landed): the new
+            # incarnation's replay-dedup cache knows nothing of the old
+            # channel's calls — refuse instead of double-executing.
+            _fencing.REFUSED_REPLAY.inc()
+            self._fail_task(
+                record,
+                ActorDiedError(
+                    spec.name,
+                    f"fenced: replay bound to incarnation "
+                    f"{spec.actor_incarnation}, actor is now "
+                    f"incarnation {info.incarnation}",
+                ),
+            )
             return
         self._forward_actor_task(info, record)
 
@@ -3528,6 +3971,11 @@ class NodeManager:
         w._graceful_exit = True
 
     async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        if no_restart:
+            # An intentional permanent kill also retires the owner-side
+            # restart-elsewhere pin (no fence may resurrect it).
+            self._actor_creations.pop(actor_id, None)
+            self._actor_restart_budget.pop(actor_id, None)
         info = self._actors.get(actor_id)
         if info is None:
             home = self._actor_homes.get(actor_id)
@@ -4654,6 +5102,11 @@ class NodeManager:
                         "addr": info.direct_addr,
                         "ver": info.direct_ver,
                         "node": self.node_id.hex(),
+                        # Incarnation rides the descriptor into the
+                        # direct hello; the worker refuses a mismatch
+                        # (fencing: a recycled endpoint or restarted
+                        # actor can never serve a stale resolution).
+                        "inc": info.incarnation,
                     }
             now = self._loop.time()
             if now > deadline:

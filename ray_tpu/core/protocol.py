@@ -26,7 +26,11 @@ MAX_FRAME = 1 << 31
 # the frame shapes change; a mismatch at the hello handshake makes the
 # caller fall back to the node-manager-mediated submit path instead of
 # speaking a frame dialect the worker does not understand.
-DIRECT_PROTO_VER = 3  # v3: compact call frames carry "d" (deadline_ts)
+# v3: compact call frames carry "d" (deadline_ts); v4: the hello
+# carries the actor incarnation ("inc") the caller resolved and the
+# worker refuses a mismatch (split-brain fencing — a cached endpoint to
+# a stale incarnation must re-resolve through the NM, never execute).
+DIRECT_PROTO_VER = 4
 
 # Per-channel cap on unanswered direct calls. A failing channel replays
 # every unanswered call over the NM route and relies on the worker's

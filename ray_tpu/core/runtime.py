@@ -838,8 +838,12 @@ class BaseRuntime:
                 self._direct_on_replay(call.dep_ids)
                 # Marked so the NM fails it (like an interrupted
                 # NM-routed call) if the actor itself died rather than
-                # just the channel.
+                # just the channel — and bound to the incarnation this
+                # channel spoke to, so a replay can never land on a
+                # RESTARTED incarnation (whose dedup cache knows
+                # nothing of this channel's calls: double execution).
                 call.spec.direct_replay = True
+                call.spec.actor_incarnation = chan.incarnation
                 try:
                     self._submit_spec(call.spec)
                 except Exception:
@@ -855,6 +859,40 @@ class BaseRuntime:
                     st["status"] = "none"
                     st["chan"] = None
             chan.drained.set()
+
+    def fence_node(self, node_hex: str, epoch: int = 0):
+        """Membership fence: tear down every direct channel this
+        runtime holds to actors on ``node_hex``. Under an asymmetric
+        partition the sockets are perfectly healthy — without this the
+        caller keeps executing calls on the fenced incarnation while
+        the cluster restarts the actor elsewhere (split brain). The raw
+        socket close (NOT chan.close(), which marks the teardown
+        deliberate and FAILS pending calls) wakes the reader's failure
+        path, which parks in-flight calls into the exactly-once NM
+        replay route — where replays bound to the fenced incarnation
+        are refused and fresh calls re-resolve to the new one."""
+        if not node_hex:
+            return
+        with self._direct_states_lock:
+            states = list(self._direct_states.values())
+        torn = 0
+        for st in states:
+            chan = st.get("chan")
+            if chan is None or not chan.alive:
+                continue
+            if chan.node_hex != node_hex:
+                continue
+            torn += 1
+            try:
+                chan.conn.close()
+            # Racing its own death: the reader's failure path runs
+            # either way.
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass
+        if torn:
+            from . import fencing as _fencing
+
+            _fencing.EVENT_CHANNEL_TEARDOWN.inc(torn)
 
     def _direct_waiters_put(self, oid: ObjectID, entry: _DirectResult):
         # The table evicts RESOLVED entries from the FIFO front beyond
@@ -1124,12 +1162,17 @@ class _DirectChannel:
         # it is.
         sock_pumpable = not isinstance(self.conn._sock, _ssl.SSLSocket)
         my_npv = frame_pump.advertised_ver() if sock_pumpable else 0
+        # Incarnation from the NM resolution: the worker refuses a
+        # mismatch (fencing — this channel can only ever speak to the
+        # exact actor start the control plane resolved).
+        self.incarnation = int(desc.get("inc") or 0)
         self.conn.settimeout(10.0)
         self.conn.send({
             "type": "direct_hello", "ver": DIRECT_PROTO_VER,
             "npv": my_npv,
             "token": get_config().session_token,
             "actor_id": actor_id.hex(), "node": rt.node_id.hex(),
+            "inc": self.incarnation,
         })
         welcome = self.conn.recv()
         self.conn.settimeout(None)
@@ -1580,6 +1623,10 @@ class DriverRuntime(BaseRuntime):
             node_id=node_manager.node_id,
             worker_id=WorkerID.nil(),
         )
+        # Membership fence hook: a node_fenced decision tears down this
+        # runtime's direct channels to the fenced node (workers/clients
+        # get forwarded node_fenced frames instead).
+        node_manager.on_node_fenced_runtime = self.fence_node
 
     # ---- direct actor transport hooks (in-process NM: loop posts) ---------
 

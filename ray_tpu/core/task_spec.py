@@ -91,6 +91,14 @@ class TaskSpec:
     # restarts; a channel-only fault with the worker alive still
     # replays, deduped by task id at the worker).
     direct_replay: bool = False
+    # Actor incarnation this spec is bound to (0 = unbound). On an
+    # ACTOR_CREATION_TASK: the GCS-assigned incarnation being started
+    # (the worker adopts it for direct-hello validation). On a
+    # direct-replay ACTOR_TASK: the incarnation the failed channel
+    # spoke to — the home NM REFUSES the replay if the live actor's
+    # incarnation differs (a restarted actor has no replay-dedup cache;
+    # re-executing a possibly-executed call there would double-execute).
+    actor_incarnation: int = 0
     # Owner bookkeeping (worker that submitted the task; nil = driver)
     owner_id: Optional[WorkerID] = None
     # Tracing context (trace_id, parent_span_id) — stamped at submit,

@@ -228,6 +228,24 @@ class Worker:
 
                     faults.apply_plan(msg.get("specs") or [],
                                       msg.get("gen"))
+                elif mtype == "node_fenced":
+                    # Membership fence: the GCS declared a node dead at
+                    # an epoch. Our runtime may hold healthy direct
+                    # channels to actors on it (asymmetric partition) —
+                    # tear them down so in-flight calls park into the
+                    # exactly-once NM replay path instead of executing
+                    # on the fenced incarnation.
+                    try:
+                        self.runtime.fence_node(
+                            msg.get("node_id") or "",
+                            int(msg.get("epoch") or 0),
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        print(
+                            f"ray_tpu worker: fence teardown failed "
+                            f"({e!r}); channels die on next use",
+                            file=sys.stderr,
+                        )
                 elif mtype == "node_draining":
                     # This worker's host is surrendering: raise the
                     # cooperative preemption signal long-running code
@@ -393,7 +411,10 @@ class Worker:
                         )
                         for name, n in spec.concurrency_groups.items()
                     }
-                self._start_direct_listener(spec.actor_id)
+                self._start_direct_listener(
+                    spec.actor_id,
+                    getattr(spec, "actor_incarnation", 0),
+                )
             with self._done_lock:
                 self._done_buf.append(done)
                 pending_dones = len(self._done_buf)
@@ -424,7 +445,7 @@ class Worker:
                   file=sys.stderr)
         os._exit(0)
 
-    def _start_direct_listener(self, actor_id):
+    def _start_direct_listener(self, actor_id, incarnation: int = 0):
         """Listen for direct caller connections and advertise the
         endpoints to the node manager: one UDS beside the node socket
         for same-node callers, plus a TLS-aware TCP endpoint so remote
@@ -439,6 +460,11 @@ class Worker:
         self._done_flush_batch = max(1, int(cfg.direct_done_flush_batch))
         self._done_flush_age = max(0.001, cfg.direct_done_flush_ms / 1e3)
         self._direct_actor_id = actor_id.hex() if actor_id else None
+        # GCS-assigned incarnation of THIS start of the actor (stamped
+        # on the creation spec by the home NM): hellos naming any other
+        # incarnation are refused — split-brain fencing's guarantee
+        # that a stale resolution can never execute here.
+        self._direct_incarnation = int(incarnation or 0)
         base = os.environ.get("RAY_TPU_NODE_SOCKET", "/tmp/rtpu")
         path = f"{base}.d{os.getpid()}"
         try:
@@ -594,6 +620,29 @@ class Worker:
                 pass
             conn.close()
             return
+        want_inc = hello.get("inc")
+        my_inc = getattr(self, "_direct_incarnation", 0)
+        if want_inc and my_inc and int(want_inc) != my_inc:
+            # Incarnation fencing: the caller resolved an EARLIER (or,
+            # under a split brain, a later) start of this actor — its
+            # per-handle sequences and replay-dedup assumptions belong
+            # to a different incarnation's state. Refuse; the caller
+            # invalidates its endpoint cache and re-resolves through
+            # the NM, exactly like the stale-pid refusal above.
+            from . import fencing as _fencing
+
+            _fencing.REFUSED_HELLO.inc()
+            try:
+                conn.send({
+                    "type": "direct_welcome", "ok": False,
+                    "error": f"incarnation mismatch (caller resolved "
+                             f"{want_inc}, actor is {my_inc})",
+                })
+            # Lost refusal == refused, as above.
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass
+            conn.close()
+            return
         node_hex = self.runtime.node_id.hex() if self.runtime else None
         remote = hello.get("node") not in (None, node_hex)
         # Native frame-pump negotiation: agree only when the caller
@@ -613,7 +662,8 @@ class Worker:
             # reaches a decoder that cannot read it.
             conn.send({"type": "direct_welcome", "ok": True,
                        "ver": DIRECT_PROTO_VER,
-                       "npv": agreed_npv})
+                       "npv": agreed_npv,
+                       "inc": getattr(self, "_direct_incarnation", 0)})
         # Caller hung up before the welcome: nothing to serve; its
         # submit path falls back to the NM route and retries.
         except Exception:  # rtlint: disable=swallowed-failure

@@ -169,8 +169,11 @@ function viewOverview(){
       <td class="num">${total.toFixed(1)}</td>
       <td><div class="bar"><i style="width:${pct}%"></i></div></td>
       </tr>`;}
-  t+="</table><h3>nodes</h3>"+table(D.nodes,[
-    ["id",n=>short(n.NodeID)],["state",n=>pill(n.Alive?"alive":"dead")],
+  const epoch=Math.max(0,...D.nodes.map(n=>n.Epoch||0));
+  t+=`</table><h3>nodes (membership epoch ${epoch})</h3>`+table(D.nodes,[
+    ["id",n=>short(n.NodeID)],["state",n=>pill(n.State||
+      (n.Alive?"alive":"dead"))],
+    ["inc",n=>h(n.Incarnation||1)],
     ["host",n=>h(n.NodeManagerAddress||n.Host||"")],
     ["head",n=>n.IsHead?"head":""],
     ["resources",n=>h(Object.entries(n.Resources||{})

@@ -333,6 +333,34 @@ def cmd_status(args) -> int:
         ray_tpu.shutdown()
 
 
+def cmd_nodes(args) -> int:
+    """Node table with the membership-fence columns: cluster epoch,
+    per-node incarnation, state (ref: `ray list nodes`, plus the fence
+    plane's epoch/incarnation surface)."""
+    ray_tpu = _attached(args)
+    try:
+        rows = ray_tpu.nodes()
+        if args.json:
+            print(json.dumps(rows, indent=2, default=str))
+            return 0
+        epoch = max((int(r.get("Epoch") or 0) for r in rows), default=0)
+        print(f"cluster epoch: {epoch}")
+        print(f"{'node':10s} {'state':9s} {'inc':>4s} {'head':5s} "
+              f"{'host':16s} resources")
+        for r in sorted(rows, key=lambda r: not r.get("IsHead", False)):
+            print(
+                f"{r['NodeID'][:8]:10s} "
+                f"{(r.get('State') or ('alive' if r['Alive'] else 'dead')):9s} "
+                f"{int(r.get('Incarnation') or 1):4d} "
+                f"{'yes' if r.get('IsHead') else 'no':5s} "
+                f"{str(r.get('Host') or ''):16s} "
+                f"{r.get('Resources')}"
+            )
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_state(args) -> int:
     """List live tasks/actors/objects/workers/nodes (ref: `ray list`)."""
     ray_tpu = _attached(args)
@@ -1042,6 +1070,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_address(p)
     p.set_defaults(fn=cmd_status)
 
+    p = sub.add_parser("nodes",
+                       help="node table with membership epoch + "
+                            "incarnations (fence plane)")
+    p.add_argument("--json", action="store_true")
+    _add_address(p)
+    p.set_defaults(fn=cmd_nodes)
+
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("kind", choices=["tasks", "actors", "objects",
                                     "workers", "nodes"])
@@ -1093,7 +1128,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--source", default=None,
                    help="filter by event source (GCS, RAYLET, WORKER, "
                         "TASK, ACTOR, OBJECT_STORE, AUTOSCALER, SERVE, "
-                        "JOB, CHAOS)")
+                        "JOB, CHAOS, TRAIN, NODE)")
     p.add_argument("--limit", type=int, default=100)
     p.add_argument("--follow", "-f", action="store_true",
                    help="stream new events as they are published")

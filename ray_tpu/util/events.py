@@ -55,8 +55,12 @@ CHAOS = "CHAOS"
 # Train gang lifecycle (train/trainer.py supervisor: rank death/hang,
 # gang aborts, restart-from-checkpoint, cooperative preemption).
 TRAIN = "TRAIN"
+# Cluster membership lifecycle (core/fencing.py + the GCS epoch plane):
+# FENCE decisions — node fenced at an epoch, zombie self-termination,
+# fresh-incarnation rejoin — surfaced via `rtpu events --source NODE`.
+NODE = "NODE"
 SOURCES = (GCS, RAYLET, WORKER, TASK, ACTOR, OBJECT_STORE, AUTOSCALER,
-           SERVE, JOB, CHAOS, TRAIN)
+           SERVE, JOB, CHAOS, TRAIN, NODE)
 
 FLUSH_INTERVAL_S = 0.25
 

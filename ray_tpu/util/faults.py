@@ -28,6 +28,19 @@ degradation path in ray_tpu a first-class, *deterministic* trigger:
 Actions: ``error``/``partition`` raise :class:`InjectedFault` (a
 ``ConnectionError``, so existing failure paths treat it as a real
 transport fault); ``latency`` returns a delay the call site sleeps.
+
+``partition`` is STICKY where ``error`` is per-schedule: once a
+partition spec fires, the whole (point, matched-context) scope the
+spec names is down — every subsequent hit matching the spec's
+``node``/``match`` scope fails immediately, WITHOUT consuming the
+spec's mode counters, until the plan is disarmed or replaced (the
+"heal"). That is what a real partition is: a link that stays down, not
+a link that drops every Nth frame. A ``mode="once"`` partition
+therefore models "the network cable is cut at hit N and stays cut",
+while ``mode="once"`` error models a single dropped frame. Sticky
+refires raise but do not re-emit a CHAOS event per hit (the arm and
+the first firing are the observable records; at heartbeat rates
+per-hit events would flood the store).
 """
 
 from __future__ import annotations
@@ -65,9 +78,13 @@ FAULT_POINTS: Dict[str, str] = {
                   "(degradation: scheduler retries the spawn on the "
                   "next pass)",
     HEARTBEAT: "node load-report heartbeat "
-               "(degradation: GCS declares the node dead; lineage "
-               "re-executes lost objects, node re-registers when the "
-               "partition heals)",
+               "(degradation: the GCS FENCES the node at a new "
+               "membership epoch — node_fenced broadcast, peers tear "
+               "down direct/data channels and refuse the fenced "
+               "incarnation's frames, restartable actors restart on "
+               "surviving nodes, lineage re-executes lost objects; on "
+               "heal the zombie self-terminates its workers and "
+               "re-registers as a fresh incarnation with empty state)",
     SERVE_REPLICA: "serve replica request execution "
                    "(degradation: handle retries another replica under "
                    "the retry budget, the sick replica's circuit "
@@ -102,7 +119,7 @@ class _ArmedSpec:
 
     __slots__ = ("point", "mode", "action", "n", "p", "seed", "delay_s",
                  "max_fires", "node", "match", "hits", "fires", "rng",
-                 "spec_dict")
+                 "spec_dict", "partitioned", "sticky_hits")
 
     def __init__(self, spec: Dict[str, Any]):
         self.spec_dict = dict(spec)
@@ -119,6 +136,12 @@ class _ArmedSpec:
         self.hits = 0
         self.fires = 0
         self.rng = random.Random(self.seed)
+        # Sticky partition state: once a partition spec fires, the
+        # whole (point, match-scope) it names is DOWN — every
+        # subsequent matching hit fails without consuming hits/fires,
+        # until disarm/heal replaces the armed plan.
+        self.partitioned = False
+        self.sticky_hits = 0
 
 
 def validate_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
@@ -271,6 +294,7 @@ def fire(point: str, **ctx: Any) -> float:
 
 def _fire_armed(point: str, ctx: Dict[str, Any]) -> float:
     to_fire: List[_ArmedSpec] = []
+    sticky: Optional[_ArmedSpec] = None
     with _lock:
         for a in _armed:
             if a.point != point:
@@ -282,6 +306,14 @@ def _fire_armed(point: str, ctx: Dict[str, Any]) -> float:
                 for k, v in a.match.items()
             ):
                 continue  # fire-site context doesn't match the scope
+            if a.action == "partition" and a.partitioned:
+                # Sticky: after the first (scheduled) fire, every
+                # subsequent hit matching this spec's scope fails
+                # WITHOUT consuming mode counters — the cut link stays
+                # cut until disarm/heal replaces the armed plan.
+                a.sticky_hits += 1
+                sticky = a
+                continue
             a.hits += 1
             if a.max_fires and a.fires >= a.max_fires:
                 continue
@@ -295,8 +327,18 @@ def _fire_armed(point: str, ctx: Dict[str, Any]) -> float:
                 hit = a.rng.random() < a.p
             if hit:
                 a.fires += 1
+                if a.action == "partition":
+                    a.partitioned = True
                 to_fire.append(a)
     if not to_fire:
+        if sticky is not None:
+            # No event per sticky refire (the first firing was the
+            # observable record; at heartbeat rates per-hit events
+            # would flood the store).
+            raise InjectedFault(
+                f"injected partition at {point} (sticky, "
+                f"hit #{sticky.sticky_hits} after fire #{sticky.fires})"
+            )
         return 0.0
     delay = 0.0
     fault: Optional[_ArmedSpec] = None
@@ -306,6 +348,8 @@ def _fire_armed(point: str, ctx: Dict[str, Any]) -> float:
             delay = max(delay, a.delay_s)
         else:
             fault = a
+    if fault is None and sticky is not None:
+        fault = sticky
     if fault is not None:
         raise InjectedFault(
             f"injected {fault.action} at {point} "

@@ -107,6 +107,67 @@ def test_schedules_are_deterministic():
     assert faults.fire(faults.PEER_SEND) == 0.0  # disarmed: free no-op
 
 
+def test_partition_is_sticky_until_disarm():
+    """partition != error: after the scheduled first fire, EVERY
+    subsequent matching hit fails — without consuming mode counters —
+    until the plan is disarmed (a cut cable stays cut)."""
+    try:
+        # mode=once error: exactly one failure, then clean.
+        faults.apply_plan([{"point": "peer_send", "mode": "once",
+                            "n": 2, "action": "error"}])
+        pattern = []
+        for _ in range(6):
+            try:
+                faults.fire(faults.PEER_SEND)
+                pattern.append(0)
+            except faults.InjectedFault:
+                pattern.append(1)
+        assert pattern == [0, 1, 0, 0, 0, 0]
+
+        # mode=once partition: fires at hit 2 and STAYS down.
+        faults.apply_plan([{"point": "peer_send", "mode": "once",
+                            "n": 2, "action": "partition"}])
+        pattern = []
+        for _ in range(6):
+            try:
+                faults.fire(faults.PEER_SEND)
+                pattern.append(0)
+            except faults.InjectedFault:
+                pattern.append(1)
+        assert pattern == [0, 1, 1, 1, 1, 1]
+        # Sticky refires did not consume the schedule: one real fire.
+        assert faults.fired_counts() == {"peer_send": 1}
+
+        # Disarm heals; re-arming the same spec starts clean.
+        faults.clear()
+        assert faults.fire(faults.PEER_SEND) == 0.0
+    finally:
+        faults.clear()
+
+
+def test_partition_sticky_is_scoped_to_matched_context():
+    """The sticky state covers exactly the spec's (point, match)
+    scope: cutting the link to one peer leaves other peers' traffic
+    flowing, and every hit inside the scope fails once cut."""
+    try:
+        faults.apply_plan([{"point": "peer_send", "mode": "once",
+                            "action": "partition",
+                            "match": {"peer": "aa"}}])
+        with pytest.raises(faults.InjectedFault):
+            faults.fire(faults.PEER_SEND, peer="aabbccdd")
+        # Same matched context: sticky.
+        with pytest.raises(faults.InjectedFault):
+            faults.fire(faults.PEER_SEND, peer="aabbccdd")
+        # Context outside the scope: traffic flows.
+        assert faults.fire(faults.PEER_SEND, peer="ffee0011") == 0.0
+        # Any context INSIDE the cut scope fails too (the scope IS the
+        # partitioned link).
+        with pytest.raises(faults.InjectedFault):
+            faults.fire(faults.PEER_SEND, peer="aabb9999")
+    finally:
+        faults.clear()
+
+
 def test_append_preserves_exhausted_spec_counters():
     """Re-arming a plan that RETAINS a spec (same GCS-stamped id, as
     the CLI's append flow does) keeps that spec's counters: an

@@ -306,6 +306,14 @@ class CodecMirrorPass(Pass):
                     "the native codec version cannot be agreed, both "
                     "sides would assume",
                     key=f"npv-ref:{rel}"))
+            if '"inc"' not in src:
+                findings.append(Finding(
+                    self.name, rel, 0,
+                    "handshake module no longer carries/validates the "
+                    "actor incarnation (\"inc\") — the split-brain "
+                    "fence would silently stop refusing stale "
+                    "endpoints (DIRECT_PROTO_VER v4 contract)",
+                    key=f"inc-ref:{rel}"))
             findings.extend(self._hardcoded_ver(rel, tree))
 
         self.stats = f"cross-checked {n_checked} dialect token(s)"

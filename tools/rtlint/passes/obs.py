@@ -591,6 +591,45 @@ TRAIN_CONFIG_KEYS = (
 )
 
 
+# ---- split-brain fencing lint ---------------------------------------------
+# The membership-fence plane's metric surface (core/fencing.py) and
+# config knobs (README "Membership epochs & fencing"); a rename/kind
+# change must fail CI, not dashboards.
+
+FENCE_METRICS = {
+    "ray_tpu_fence_events_total": "counter",
+    "ray_tpu_fence_refused_calls_total": "counter",
+    "ray_tpu_fence_zombie_kills_total": "counter",
+}
+
+FENCE_CONFIG_KEYS = ("fence_kill_grace_s",)
+
+
+def validate_fence_metrics(declared):
+    failures = []
+    for name, kind in sorted(FENCE_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: fence-plane metric not declared "
+                f"(core/fencing.py drifted from the documented surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    return failures
+
+
+def validate_fence_config():
+    fields = _config_fields()
+    return [
+        f"core/config.py: fence config key {key!r} missing from Config "
+        f"(documented knob drifted from the flag table)"
+        for key in FENCE_CONFIG_KEYS if key not in fields
+    ]
+
+
 # ---- request-waterfall / flight-recorder lint ------------------------------
 # The trace plane's metric surface (util/flight_recorder.py) and config
 # knobs (README "Request waterfalls & flight recorder"); a rename/kind
@@ -900,6 +939,7 @@ class ObsMetricsPass(Pass):
         failures += validate_native_pump_metrics(declared)
         failures += validate_train_metrics(declared)
         failures += validate_trace_metrics(declared)
+        failures += validate_fence_metrics(declared)
         failures += validate_transfer_config()
         failures += validate_actor_config()
         failures += validate_overload_config()
@@ -907,6 +947,7 @@ class ObsMetricsPass(Pass):
         failures += validate_drain_config()
         failures += validate_train_config()
         failures += validate_trace_config()
+        failures += validate_fence_config()
         self.stats = (f"{len(declared)} declared metric(s), "
                       f"{len(state['skipped'])} module(s) skipped at "
                       f"import")

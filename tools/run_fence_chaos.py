@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""Split-brain fencing chaos run: the ISSUE-15 acceptance scenario,
+measured.
+
+Boots a head + 2 worker nodes, places a counting actor on node B,
+engages the direct channel, then arms a STICKY heartbeat partition on
+B only (asymmetric: B's peer/direct planes stay healthy). Measures:
+
+  time_to_fence_s      chaos armed -> GCS fence decision (node dead)
+  time_to_restart_s    fence -> first result from the restarted
+                        incarnation on the surviving node
+  calls_refused        fenced in-flight calls refused at an
+                        incarnation boundary (errors seen by the
+                        pipelined caller)
+  calls_replayed       calls parked during the fence window that
+                        re-routed onto the new incarnation
+  double_executions    tokens executed more than once on the restarted
+                        incarnation (MUST be 0)
+  stale_results        results from the fenced incarnation observed
+                        after the restarted one answered (MUST be 0)
+  heal                 zombie rejoin: fresh node incarnation + NODE
+                        events for the fence and the self-termination
+
+Writes a JSON record (argv[1], default stdout) with an `acceptance`
+block tests/test_fencing.py mirrors.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core import runtime_context
+    from ray_tpu.util import faults
+    from ray_tpu.util import state as state_api
+
+    rec = {"bench": "fence_chaos", "ts": time.time()}
+    c = Cluster(
+        head_resources={"CPU": 2},
+        system_config={
+            "num_prestart_workers": 0,
+            "heartbeat_interval_s": 0.2,
+            "gcs_health_check_period_s": 0.2,
+            "node_death_timeout_s": 1.5,
+            "fence_kill_grace_s": 0.5,
+            "log_to_driver": False,
+        },
+    )
+    try:
+        b = c.add_node(num_cpus=1, resources={"gadget": 1})
+        target = b.node_id_hex
+
+        @ray_tpu.remote(resources={"gadget": 1}, max_restarts=2)
+        class Counter:
+            def __init__(self):
+                self.marker = uuid.uuid4().hex
+                self.tokens = []
+
+            def inc(self, token):
+                self.tokens.append(token)
+                return (self.marker, len(self.tokens))
+
+            def log(self):
+                return (self.marker, list(self.tokens))
+
+        a = Counter.remote()
+        runtime = runtime_context.current_runtime()
+        key = a.actor_id.binary()
+        deadline = time.time() + 30
+        warm = 0
+        while time.time() < deadline:
+            ray_tpu.get(a.inc.remote(f"warm-{warm}"), timeout=30)
+            warm += 1
+            st = runtime._direct_states.get(key)
+            if st is not None and st["status"] == "ready":
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("direct channel never engaged")
+        rec["direct_incarnation"] = st["chan"].incarnation
+
+        c.add_node(num_cpus=1, resources={"gadget": 1})
+        c.wait_for_nodes(3)
+
+        nm = runtime._nm
+        nm.call_sync(nm._gcs.chaos_arm(
+            [{"point": "heartbeat", "mode": "once",
+              "action": "partition", "node": target}]
+        ), timeout=30)
+        t_armed = time.monotonic()
+
+        results, errors = [], []
+        stop = threading.Event()
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                refs = [a.inc.remote(f"t{i}-{j}") for j in range(4)]
+                i += 1
+                for r in refs:
+                    try:
+                        results.append(
+                            (time.monotonic(),
+                             ray_tpu.get(r, timeout=30))
+                        )
+                    except Exception as e:  # noqa: BLE001 — recorded
+                        errors.append(repr(e))
+                time.sleep(0.02)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+
+        deadline = time.time() + 30
+        t_fenced = None
+        while time.time() < deadline:
+            views = {v["NodeID"]: v for v in ray_tpu.nodes()}
+            if views.get(target, {}).get("State") == "dead":
+                t_fenced = time.monotonic()
+                break
+            time.sleep(0.05)
+        if t_fenced is None:
+            raise RuntimeError("node never fenced")
+        rec["time_to_fence_s"] = round(t_fenced - t_armed, 3)
+
+        first_marker = results[0][1][0] if results else None
+        deadline = time.time() + 60
+        t_restarted = None
+        while time.time() < deadline:
+            if results and results[-1][1][0] != first_marker:
+                t_restarted = time.monotonic()
+                break
+            time.sleep(0.1)
+        if t_restarted is None:
+            raise RuntimeError("actor never restarted elsewhere")
+        rec["time_to_restart_s"] = round(t_restarted - t_fenced, 3)
+
+        time.sleep(1.5)
+        stop.set()
+        t.join(timeout=30)
+
+        markers = [m for _, (m, _n) in results]
+        new_marker = next(m for m in markers if m != first_marker)
+        switch = markers.index(new_marker)
+        stale = sum(1 for m in markers[switch:] if m == first_marker)
+        marker2, log2 = ray_tpu.get(a.log.remote(), timeout=60)
+        doubles = len(log2) - len(set(log2))
+        new_counts = [n for _, (m, n) in results if m == new_marker]
+        rec.update({
+            "calls_ok_old_incarnation": sum(
+                1 for m in markers if m == first_marker),
+            "calls_ok_new_incarnation": len(new_counts),
+            "calls_refused": len(errors),
+            "calls_replayed": len(new_counts),
+            "double_executions": doubles,
+            "stale_results": stale,
+            "new_incarnation_count_monotonic":
+                new_counts == sorted(set(new_counts)),
+        })
+
+        # Heal: zombie self-terminates and rejoins fresh.
+        nm.call_sync(nm._gcs.chaos_arm([]), timeout=30)
+        t_heal0 = time.monotonic()
+        deadline = time.time() + 60
+        rejoin = None
+        while time.time() < deadline:
+            rows = {v["NodeID"]: v for v in ray_tpu.nodes()}
+            row = rows.get(target)
+            if (row and row.get("State") == "alive"
+                    and int(row.get("Incarnation") or 1) >= 2):
+                rejoin = row
+                break
+            time.sleep(0.1)
+        node_events = state_api.list_cluster_events(source="NODE")
+        rec["heal"] = {
+            "rejoined": rejoin is not None,
+            "rejoin_incarnation": (
+                int(rejoin.get("Incarnation")) if rejoin else None),
+            "time_to_rejoin_s": (
+                round(time.monotonic() - t_heal0, 3) if rejoin else None),
+            "fence_events": sum(
+                1 for e in node_events if "FENCE" in e["message"]),
+            "zombie_kill_events": sum(
+                1 for e in node_events if "declared dead" in e["message"]),
+        }
+        post_marker, _ = ray_tpu.get(a.inc.remote("post-heal"),
+                                     timeout=60)
+        rec["acceptance"] = {
+            "zero_double_executions": doubles == 0,
+            "zero_stale_results": stale == 0,
+            "restarted_on_survivor": marker2 == new_marker,
+            "ordered_counts_on_new_incarnation":
+                rec["new_incarnation_count_monotonic"],
+            "zombie_rejoined_fresh_incarnation": rejoin is not None,
+            "fence_events_observable":
+                rec["heal"]["fence_events"] >= 1
+                and rec["heal"]["zombie_kill_events"] >= 1,
+            "serves_after_heal": post_marker == new_marker,
+        }
+        ok = all(rec["acceptance"].values())
+        rec["ok"] = ok
+    finally:
+        try:
+            nm = runtime_context.current_runtime()._nm
+            nm.call_sync(nm._gcs.chaos_arm([]), timeout=10)
+        except Exception:
+            pass
+        faults.clear()
+        c.shutdown()
+
+    out = json.dumps(rec, indent=2, sort_keys=True)
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            f.write(out + "\n")
+    print(out)
+    return 0 if rec.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
