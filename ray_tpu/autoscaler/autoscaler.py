@@ -353,6 +353,26 @@ class Autoscaler:
     def _default_type(self) -> str:
         return next(iter(self.config.node_types))
 
+    def _slo_burn_active(self) -> bool:
+        """True while ANY deployment's SLO burn alert fires (read from
+        the head engine's `__slo_status__` KV blob). Scale-down is held
+        during a burn: removing capacity mid-incident deepens the very
+        alert the serve controller is scaling up to clear."""
+        from ..core.runtime_context import current_runtime_or_none
+        from ..util import slo
+
+        rt = current_runtime_or_none()
+        if rt is None:
+            return False
+        try:
+            status = slo.read_status(rt.kv_get)
+        except Exception:  # rtlint: disable=swallowed-failure
+            return False  # no SLO plane (older head): no hold
+        return any(
+            v for dep in status.values() if isinstance(dep, dict)
+            for k, v in dep.items() if k.endswith("_burn_active")
+        )
+
     # -- reconcile ----------------------------------------------------------
 
     def _loop(self) -> None:
@@ -469,7 +489,11 @@ class Autoscaler:
         # hosts have not ALL registered yet are still booting — treat as
         # busy (a slice with one idle registered host must not be torn
         # down while its other hosts are mid-boot). For a registered
-        # slice, idle means EVERY host is idle.
+        # slice, idle means EVERY host is idle. While any deployment's
+        # SLO error budget is burning, idle nodes are kept warm — the
+        # idle timer keeps running, so capacity releases the moment the
+        # burn clears.
+        slo_hold = self._slo_burn_active()
         for nid in list(live):
             hosts_views = by_provider.get(nid) or []
             idle = len(hosts_views) >= self._hosts_of(
@@ -487,6 +511,8 @@ class Autoscaler:
             if since is None:
                 self._idle_since[nid] = now
             elif now - since >= cfg.idle_timeout_s:
+                if slo_hold:
+                    continue
                 if live_count > cfg.min_workers:
                     cluster_events.emit(
                         cluster_events.INFO, cluster_events.AUTOSCALER,

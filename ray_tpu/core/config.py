@@ -275,6 +275,19 @@ class Config:
     # (1 = every call). Context propagation is unaffected — ids always
     # ride the frames, so worker-side spans stay parented regardless.
     trace_client_span_every: int = 8
+    # --- SLO plane (util/tsdb.py + util/slo.py, evaluated in the head
+    # GCS) ----------------------------------------------------------------
+    # Ring size of every TSDB series: at the ~0.5 s KV flush cadence the
+    # default holds ~34 min of history (burn windows longer than the
+    # ring clamp to available history). Head memory is bounded by
+    # tsdb_max_series * tsdb_samples_per_series.
+    tsdb_samples_per_series: int = 4096
+    # Low-cardinality guard: new series beyond this cap are dropped and
+    # counted (tsdb stats "dropped"), never silently absorbed.
+    tsdb_max_series: int = 2000
+    # How often the GCS evaluates declared SLO specs against the TSDB
+    # (goodput, burn rates, alert transitions).
+    slo_eval_interval_s: float = 5.0
 
     def __post_init__(self):
         for f in dataclasses.fields(self):

@@ -203,6 +203,21 @@ GCS_SERVICES = (
                         ("limit", "int", False, 200)),
                reply=(("nodes", "list"), ("errors", "dict"))),
     )),
+    ServiceSpec("MetricsService", (
+        # SLO plane (util/tsdb.py + util/slo.py): the head GCS samples
+        # the `__metrics__` KV pipeline into a bounded in-process TSDB
+        # and evaluates declared SLO specs on it; these RPCs expose the
+        # history + verdicts to the dashboard/CLI without a collector.
+        Method("timeseries_query",
+               request=(("name", "str", False, ""),
+                        ("tags", "dict", False),
+                        ("since", "float", False, 0.0),
+                        ("limit", "int", False, 0)),
+               reply=(("series", "list"), ("names", "list"),
+                      ("stats", "dict"))),
+        Method("slo_status",
+               reply=(("deployments", "dict"), ("ts", "float"))),
+    )),
     ServiceSpec("MetaService", (
         Method("rpc_describe", reply=(("services", "dict"),)),
     )),
@@ -340,6 +355,23 @@ class GcsService:
         self._event_sub_id = "__event_aggregator__"
         self.pubsub.subscribe(self._event_sub_id, [CLUSTER_EVENTS])
         self._events_task: Optional[asyncio.Task] = None
+        # SLO plane: bounded TSDB fed by the `__metrics__` KV pipeline
+        # (no new wire protocol — _metrics_sample_loop aggregates the
+        # flushed blobs already in self._kv) + the burn-rate engine
+        # evaluating declared specs on it.
+        from ..util.slo import SloEngine
+        from ..util.tsdb import TSDB
+
+        self.tsdb = TSDB(
+            samples_per_series=getattr(
+                config, "tsdb_samples_per_series", 4096),
+            max_series=getattr(config, "tsdb_max_series", 2000),
+        )
+        self.slo_engine = SloEngine(emit_event=self._emit_slo_event)
+        self._metrics_task: Optional[asyncio.Task] = None
+        # `__metrics__` keys first seen orphaned (writer dead/stale) at
+        # a monotonic time; reaped after the grace window.
+        self._metrics_orphans: Dict[str, float] = {}
 
     # ------------------------------------------------------------------ boot
 
@@ -360,6 +392,9 @@ class GcsService:
         self._broadcast_task = asyncio.ensure_future(self._broadcast_loop())
         self._events_task = asyncio.ensure_future(
             self._event_aggregator_loop()
+        )
+        self._metrics_task = asyncio.ensure_future(
+            self._metrics_sample_loop()
         )
 
     async def _event_aggregator_loop(self):
@@ -556,6 +591,8 @@ class GcsService:
 
     def stop(self):
         self._snapshot_final()
+        if self._metrics_task is not None:
+            self._metrics_task.cancel()
         if self._events_task is not None:
             self._events_task.cancel()
         self.events.close()
@@ -986,6 +1023,143 @@ class GcsService:
             "total": stats["total"],
             "dropped": stats["dropped"],
         }
+
+    # ----------------------------------------------------------- SLO plane
+
+    # A `__metrics__` blob whose writer looks dead must stay orphaned
+    # this long (monotonic) before it is reaped — a process mid-GC-pause
+    # or briefly partitioned resumes refreshing its ts and is spared.
+    METRICS_GC_GRACE_S = 10.0
+    # A v2 blob whose embedded ts is older than this is a dead pid's
+    # leftover (live processes refresh every PROC_SAMPLE_INTERVAL_S).
+    METRICS_STALE_S = 30.0
+
+    async def _metrics_sample_loop(self):
+        """Ingest tick: aggregate the flushed `__metrics__` KV blobs
+        into the TSDB each KV flush interval (the pipeline IS the wire
+        protocol), reap dead writers' blobs, and evaluate declared SLO
+        specs every ``slo_eval_interval_s``."""
+        from ..util import metrics as user_metrics
+
+        interval = user_metrics.FLUSH_INTERVAL_S
+        eval_interval = max(interval, float(getattr(
+            self.config, "slo_eval_interval_s", 5.0)))
+        last_eval = 0.0
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                now = time.time()
+                self._sample_metrics_once(now)
+                if time.monotonic() - last_eval >= eval_interval:
+                    last_eval = time.monotonic()
+                    self._evaluate_slo(now)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                sys.stderr.write(
+                    f"[gcs] WARNING: metrics sample tick failed "
+                    f"({type(e).__name__}: {e}); retrying\n"
+                )
+
+    def _sample_metrics_once(self, now: float) -> Dict[str, Dict]:
+        """One pass over the `__metrics__` keys: decode each blob once,
+        GC orphans (dead node, stale ts, corrupt), aggregate the live
+        ones, append one TSDB sample per series."""
+        from ..util import metrics as user_metrics
+
+        prefix = user_metrics.KV_PREFIX
+        alive = {e.node_id.hex() for e in self._nodes.values()
+                 if e.state == "alive"}
+        mono = time.monotonic()
+        report: Dict[str, Dict] = {}
+        for key in [k for k in self._kv if k.startswith(prefix)]:
+            rel = key[len(prefix):]
+            node_hex = rel.split("/", 1)[0] if "/" in rel else ""
+            snapshot = None
+            ts = 0.0
+            try:
+                snapshot, ts = user_metrics.decode_snapshot(self._kv[key])
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass  # corrupt blob: treated as orphaned below (GC'd)
+            orphaned = (
+                snapshot is None
+                or (node_hex and node_hex not in alive)
+                or (ts and now - ts > self.METRICS_STALE_S)
+            )
+            if not orphaned:
+                self._metrics_orphans.pop(key, None)
+                user_metrics.merge_snapshot(report, snapshot)
+                continue
+            # Orphans stop aggregating immediately (ghost gauges must
+            # not skew the report) but are only DELETED past the grace
+            # window — a writer that resumes clears the timer.
+            first = self._metrics_orphans.setdefault(key, mono)
+            if mono - first >= self.METRICS_GC_GRACE_S:
+                self._kv.pop(key, None)
+                self._metrics_orphans.pop(key, None)
+        for key in [k for k in self._metrics_orphans
+                    if k not in self._kv]:
+            self._metrics_orphans.pop(key, None)
+        self.tsdb.ingest_report(report, now)
+        return report
+
+    def _evaluate_slo(self, now: float) -> None:
+        import json
+
+        from ..util import slo as slo_mod
+
+        specs = slo_mod.decode_specs({
+            k: v for k, v in self._kv.items()
+            if k.startswith(slo_mod.SPEC_PREFIX)
+        })
+        status = self.slo_engine.evaluate(self.tsdb, specs, now)
+        self.kv_put(slo_mod.STATUS_KEY,
+                    json.dumps(status, default=str).encode(), True)
+        self._publish_head_metrics()
+
+    def _publish_head_metrics(self) -> None:
+        """A standalone head (no driver runtime in this process) has no
+        flusher transport for the ray_tpu_slo_* gauges the engine just
+        set — write the registry snapshot into the KV table directly
+        (pid-scoped key, fresh ts, so the GC above keeps it)."""
+        from ..core import runtime_context
+        from ..util import metrics as user_metrics
+
+        if runtime_context.current_runtime_or_none() is not None:
+            return  # the normal flusher owns this process's blob
+        try:
+            import cloudpickle
+
+            self._kv[f"{user_metrics.KV_PREFIX}{os.getpid()}"] = \
+                cloudpickle.dumps({
+                    "v": 2, "ts": time.time(), "pid": os.getpid(),
+                    "node": "", "metrics": user_metrics.local_snapshot(),
+                })
+        except Exception:  # rtlint: disable=swallowed-failure
+            pass  # exposition-only convenience; the RPC path still works
+
+    def _emit_slo_event(self, severity: str, message: str,
+                        fields: Dict[str, Any]) -> None:
+        from ..util import events as events_mod
+
+        self._record_event(severity, events_mod.SLO, message,
+                           custom_fields=fields)
+
+    async def _rpc_timeseries_query(self, node_id, name="", tags=None,
+                                    since=0.0, limit=0):
+        if not name:
+            # Discovery form: what series exist + store accounting.
+            return {"series": [], "names": self.tsdb.names(),
+                    "stats": self.tsdb.stats()}
+        return {
+            "series": self.tsdb.query(name, tags=tags or None,
+                                      since=since, limit=limit),
+            "names": [], "stats": self.tsdb.stats(),
+        }
+
+    async def _rpc_slo_status(self, node_id):
+        return {"deployments": dict(self.slo_engine.status),
+                "ts": time.time()}
 
     async def _rpc_stacks_dump(self, node_id, timeout=5.0):
         return await self._profile_fanout(
@@ -1786,6 +1960,15 @@ class LocalGcsHandle:
             "dropped": stats["dropped"],
         }
 
+    async def timeseries_query(self, name="", tags=None, since=0.0,
+                               limit=0):
+        return await self._svc._rpc_timeseries_query(
+            None, name=name, tags=tags, since=since, limit=limit
+        )
+
+    async def slo_status(self):
+        return await self._svc._rpc_slo_status(None)
+
     async def drain_node(self, node_id, phase="full", timeout=60.0):
         return await self._svc._rpc_drain_node(
             None, node_id, phase=phase, timeout=timeout
@@ -1978,6 +2161,22 @@ class RemoteGcsHandle:
         r = await self._client.request(msg)
         return {"events": r["events"], "total": r["total"],
                 "dropped": r["dropped"]}
+
+    async def timeseries_query(self, name="", tags=None, since=0.0,
+                               limit=0):
+        msg = {"op": "timeseries_query", "name": name, "since": since,
+               "limit": limit}
+        # Optional dict field must be absent, not None, to pass the
+        # request schema's type check.
+        if tags is not None:
+            msg["tags"] = tags
+        r = await self._client.request(msg)
+        return {"series": r["series"], "names": r["names"],
+                "stats": r["stats"]}
+
+    async def slo_status(self):
+        r = await self._client.request({"op": "slo_status"})
+        return {"deployments": r["deployments"], "ts": r["ts"]}
 
     async def drain_node(self, node_id, phase="full", timeout=60.0):
         r = await self._client.request(

@@ -1565,6 +1565,10 @@ class NodeManager:
             # Head-store query; the long-path RPC must not stall this
             # worker's message loop.
             asyncio.ensure_future(self._handle_events_query(w, msg))
+        elif mtype == "timeseries":
+            asyncio.ensure_future(self._handle_timeseries_query(w, msg))
+        elif mtype == "slo":
+            asyncio.ensure_future(self._handle_slo_query(w, msg))
         elif mtype in ("stack_reply", "profile_reply"):
             # A worker answering our stack_dump/profile fan-out.
             fut = self._profile_pending.pop(msg.get("req_id"), None)
@@ -4714,6 +4718,48 @@ class NodeManager:
         return await self._gcs.events_list(
             severity=severity, source=source, limit=limit
         )
+
+    async def _handle_timeseries_query(self, w: WorkerHandle, msg):
+        out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
+        try:
+            out.update(await self._timeseries_query(
+                name=msg.get("name", ""), tags=msg.get("tags"),
+                since=msg.get("since", 0.0), limit=msg.get("limit", 0),
+            ))
+        # Reply-carried: timeseries_query raises it caller-side.
+        except Exception as e:  # rtlint: disable=swallowed-failure
+            out["error"] = str(e)
+        try:
+            await w.writer.send(out)
+        except Exception:  # rtlint: disable=swallowed-failure
+            pass  # dead requester needs no reply
+
+    async def _handle_slo_query(self, w: WorkerHandle, msg):
+        out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
+        try:
+            out.update(await self._slo_status())
+        # Reply-carried: slo_status raises it caller-side.
+        except Exception as e:  # rtlint: disable=swallowed-failure
+            out["error"] = str(e)
+        try:
+            await w.writer.send(out)
+        except Exception:  # rtlint: disable=swallowed-failure
+            pass  # dead requester needs no reply
+
+    async def _timeseries_query(self, name="", tags=None, since=0.0,
+                                limit: int = 0) -> Dict[str, Any]:
+        """Query the head TSDB (ref analogue: the dashboard hitting the
+        metrics head)."""
+        if self._gcs is None:
+            raise RuntimeError("timeseries require the cluster GCS")
+        return await self._gcs.timeseries_query(
+            name=name, tags=tags, since=since, limit=limit
+        )
+
+    async def _slo_status(self) -> Dict[str, Any]:
+        if self._gcs is None:
+            raise RuntimeError("SLO status requires the cluster GCS")
+        return await self._gcs.slo_status()
 
     # ------------------------------------------------- profiling plane
 

@@ -1828,6 +1828,20 @@ class DriverRuntime(BaseRuntime):
                                   limit=limit)
         )
 
+    def timeseries_query(self, name: str = "", tags=None,
+                         since: float = 0.0,
+                         limit: int = 0) -> Dict[str, Any]:
+        """Head TSDB query (backing for /api/timeseries, `rtpu top`,
+        `rtpu slo`). Empty name lists series names + store stats."""
+        return self._nm.call_sync(
+            self._nm._timeseries_query(name=name, tags=tags,
+                                       since=since, limit=limit)
+        )
+
+    def slo_status(self) -> Dict[str, Any]:
+        """The SLO engine's latest per-deployment evaluation."""
+        return self._nm.call_sync(self._nm._slo_status())
+
     def cluster_stacks(self, timeout: float = 5.0) -> Dict[str, Any]:
         """Cluster-wide stack dumps via the GCS ProfileService (backing
         for util/profiler.cluster_stacks / `rtpu stack`)."""
@@ -2169,6 +2183,25 @@ class WorkerRuntime(BaseRuntime):
             raise RuntimeError(reply["error"])
         return {"events": reply["events"], "total": reply["total"],
                 "dropped": reply["dropped"]}
+
+    def timeseries_query(self, name: str = "", tags=None,
+                         since: float = 0.0,
+                         limit: int = 0) -> Dict[str, Any]:
+        reply = self.request(
+            {"type": "timeseries", "name": name, "tags": tags,
+             "since": since, "limit": limit},
+            timeout=30.0,
+        )
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return {"series": reply["series"], "names": reply["names"],
+                "stats": reply["stats"]}
+
+    def slo_status(self) -> Dict[str, Any]:
+        reply = self.request({"type": "slo"}, timeout=30.0)
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return {"deployments": reply["deployments"], "ts": reply["ts"]}
 
     def cluster_stacks(self, timeout: float = 5.0) -> Dict[str, Any]:
         reply = self.request(

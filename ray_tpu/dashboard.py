@@ -311,6 +311,40 @@ class _Handler(BaseHTTPRequestHandler):
                     payload["note"] = f"serve not running: {e}"
                 self._json(payload)
                 return
+            if path == "/api/timeseries":
+                # Head TSDB query (ref: dashboard Grafana-backed charts,
+                # served here from the in-process ring-buffer store).
+                # ?name=...&since=...&limit=...&tag.deployment=echo ;
+                # without a name: series names + store accounting.
+                from urllib.parse import parse_qs, urlparse
+
+                from .core import runtime_context
+
+                q = parse_qs(urlparse(self.path).query)
+                tags = {k[len("tag."):]: v[0] for k, v in q.items()
+                        if k.startswith("tag.") and v}
+                rt = runtime_context.current_runtime_or_none()
+                if rt is None or not hasattr(rt, "timeseries_query"):
+                    self._json({"error": "no runtime attached"}, 503)
+                    return
+                self._json(rt.timeseries_query(
+                    name=(q.get("name") or [""])[0],
+                    tags=tags or None,
+                    since=float((q.get("since") or ["0"])[0]),
+                    limit=int((q.get("limit") or ["0"])[0]),
+                ))
+                return
+            if path == "/api/slo":
+                # The SLO engine's latest per-deployment evaluation
+                # (goodput, burn rates, budget, alert state).
+                from .core import runtime_context
+
+                rt = runtime_context.current_runtime_or_none()
+                if rt is None or not hasattr(rt, "slo_status"):
+                    self._json({"error": "no runtime attached"}, 503)
+                    return
+                self._json(rt.slo_status())
+                return
             if path == "/api/devices":
                 # Device telemetry: this process's live JAX device
                 # snapshot + every worker's published ray_tpu_device_*

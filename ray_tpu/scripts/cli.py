@@ -710,6 +710,25 @@ def cmd_summary(args) -> int:
         ray_tpu.shutdown()
 
 
+def _watch_loop(render, interval: Optional[float]) -> int:
+    """Shared render loop of `rtpu top` / `rtpu slo` / `rtpu metrics
+    --watch`: repaint every ``interval`` seconds until ^C exits cleanly
+    (one shot when ``interval`` is falsy). The ANSI home+clear repaint
+    keeps a live view flicker-free without curses."""
+    if not interval:
+        render()
+        return 0
+    try:
+        while True:
+            sys.stdout.write("\x1b[H\x1b[2J")
+            render()
+            sys.stdout.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        print()
+        return 0
+
+
 def cmd_metrics(args) -> int:
     """Dump the Prometheus exposition document (ref: scraping the
     dashboard's /metrics endpoint, without needing it up): core node
@@ -718,22 +737,223 @@ def cmd_metrics(args) -> int:
     ``ray_tpu_object_transfer_*`` data-plane series ride the same
     document). ``--transfers`` prints the object-transfer plane and
     ``--actors`` the direct actor-call plane as human-readable sections
-    instead."""
+    instead; ``--watch N`` refreshes the chosen view every N seconds."""
     ray_tpu = _attached(args)
     try:
         from ray_tpu.util import prometheus
 
-        if getattr(args, "transfers", False):
-            _print_transfer_section()
-            return 0
-        if getattr(args, "actors", False):
-            _print_actor_section()
-            return 0
-        if getattr(args, "serve", False):
-            _print_serve_section()
-            return 0
-        sys.stdout.write(prometheus.render())
-        return 0
+        def render():
+            if getattr(args, "transfers", False):
+                _print_transfer_section()
+            elif getattr(args, "actors", False):
+                _print_actor_section()
+            elif getattr(args, "serve", False):
+                _print_serve_section()
+            else:
+                sys.stdout.write(prometheus.render())
+
+        return _watch_loop(render, getattr(args, "watch", None))
+    finally:
+        ray_tpu.shutdown()
+
+
+def _ts_increase(rows: List[List[float]], window_s: float,
+                 idx: int = 1) -> tuple:
+    """(increase, span_s) of one TSDB sample list over the trailing
+    window — reset robust like TSDB.delta, computed client-side from
+    the raw ``[ts, ...]`` rows the query RPC returns."""
+    if len(rows) < 2:
+        return 0.0, 0.0
+    start = rows[-1][0] - window_s
+    win: List[List[float]] = []
+    for r in rows:
+        if r[0] < start:
+            win[:] = [r]
+        else:
+            win.append(r)
+    if len(win) < 2:
+        return 0.0, 0.0
+    inc = sum(max(0.0, b[idx] - a[idx]) for a, b in zip(win, win[1:]))
+    return inc, max(win[-1][0] - win[0][0], 1e-9)
+
+
+def _ts_group(series: List[dict], key: str) -> dict:
+    """Group a timeseries_query result by one tag value."""
+    out: dict = {}
+    for s in series:
+        tags = dict(tuple(kv) for kv in s.get("tags", []))
+        out.setdefault(tags.get(key, ""), []).append(s)
+    return out
+
+
+def _render_top(rt, window_s: float) -> None:
+    def query(name, tags=None):
+        try:
+            return rt.timeseries_query(name=name, tags=tags)["series"]
+        except Exception:
+            return []
+
+    try:
+        stats = rt.timeseries_query()["stats"]
+    except Exception:
+        stats = {}
+    try:
+        nodes = [n for n in rt.nodes() if n.get("state") == "alive"]
+    except Exception:
+        nodes = []
+    print(f"rtpu top — {time.strftime('%H:%M:%S')}   "
+          f"nodes={len(nodes)}   tsdb: {stats.get('series', 0)}/"
+          f"{stats.get('max_series', '?')} series, "
+          f"{stats.get('samples', 0)} samples, "
+          f"dropped={stats.get('dropped', 0)}")
+
+    # Per-node resources: CPU via counter->rate of the per-process cpu
+    # seconds, RSS as the latest per-process sum, HBM from the device
+    # gauges (absent off-TPU).
+    cpu_by = _ts_group(query("ray_tpu_process_cpu_seconds_total"), "node")
+    rss_by = _ts_group(query("ray_tpu_process_rss_bytes"), "node")
+    hbm_by = _ts_group(query("ray_tpu_device_memory_bytes_in_use"),
+                       "node")
+    print(f"\n{'NODE':14} {'PROCS':>5} {'CPU%':>7} {'RSS(MB)':>9} "
+          f"{'HBM(MB)':>9}")
+    for node in sorted(set(cpu_by) | set(rss_by)):
+        inc = span = 0.0
+        for s in cpu_by.get(node, ()):
+            i, sp = _ts_increase(s["samples"], window_s)
+            inc += i
+            span = max(span, sp)
+        cpu_pct = 100.0 * inc / span if span else 0.0
+        rss = sum(s["samples"][-1][1] for s in rss_by.get(node, ())
+                  if s["samples"])
+        hbm = sum(s["samples"][-1][1] for s in hbm_by.get(node, ())
+                  if s["samples"])
+        nprocs = max(len(cpu_by.get(node, ())),
+                     len(rss_by.get(node, ())))
+        hbm_s = f"{hbm / 1e6:>9.1f}" if hbm else f"{'-':>9}"
+        print(f"{(node or '<head>')[:14]:14} {nprocs:>5} {cpu_pct:>7.1f} "
+              f"{rss / 1e6:>9.1f} {hbm_s}")
+
+    # Serve data path per deployment: qps + p99 from the processing
+    # histogram, shed rate from the shed counter.
+    lat_by = _ts_group(
+        query("ray_tpu_serve_replica_processing_seconds"), "deployment")
+    shed_by = _ts_group(query("ray_tpu_serve_shed_total"), "deployment")
+    if lat_by or shed_by:
+        print(f"\n{'DEPLOYMENT':20} {'QPS':>8} {'p99(ms)':>9} "
+              f"{'SHED/s':>8}")
+    for dep in sorted(set(lat_by) | set(shed_by)):
+        inc = span = 0.0
+        for s in lat_by.get(dep, ()):
+            i, sp = _ts_increase(s["samples"], window_s)
+            inc += i
+            span = max(span, sp)
+        qps = inc / span if span else 0.0
+        shed = shed_span = 0.0
+        for s in shed_by.get(dep, ()):
+            i, sp = _ts_increase(s["samples"], window_s)
+            shed += i
+            shed_span = max(shed_span, sp)
+        shed_rate = shed / shed_span if shed_span else 0.0
+        p99 = None
+        try:
+            from ray_tpu.util.metrics import get_metrics_report
+            from ray_tpu.util.tsdb import quantile_from_histogram
+
+            h = (get_metrics_report()
+                 .get("ray_tpu_serve_replica_processing_seconds", {})
+                 .get("series", {}))
+            bounds: List[float] = []
+            buckets: List[float] = []
+            for tags_key, v in h.items():
+                if dict(tags_key).get("deployment") != dep:
+                    continue
+                if not isinstance(v, dict):
+                    continue
+                if not bounds:
+                    bounds = list(v.get("bounds", ()))
+                    buckets = list(v.get("buckets", ()))
+                elif list(v.get("bounds", ())) == bounds:
+                    buckets = [a + b for a, b in
+                               zip(buckets, v.get("buckets", ()))]
+            if bounds:
+                p99 = quantile_from_histogram(bounds, buckets, 0.99)
+        except Exception:
+            p99 = None
+        p99_s = f"{p99 * 1e3:>9.1f}" if p99 is not None else f"{'-':>9}"
+        print(f"{dep[:20]:20} {qps:>8.1f} {p99_s} {shed_rate:>8.2f}")
+
+    # Dispatch plane: direct actor-call ops/s across the cluster.
+    inc = span = 0.0
+    for s in query("ray_tpu_actor_call_seconds"):
+        i, sp = _ts_increase(s["samples"], window_s)
+        inc += i
+        span = max(span, sp)
+    if span:
+        print(f"\ndispatch: {inc / span:.1f} actor-call ops/s "
+              f"(last {int(window_s)}s)")
+
+
+def cmd_top(args) -> int:
+    """Live refreshing cluster view (ref: `ray status` + the dashboard
+    front page, in a terminal): per-node CPU/RSS/HBM from the head
+    TSDB, serve qps/p99/shed per deployment, dispatch ops/s."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.core import runtime_context
+
+        rt = runtime_context.current_runtime()
+        interval = None if getattr(args, "once", False) else args.interval
+        return _watch_loop(
+            lambda: _render_top(rt, float(args.window)), interval)
+    finally:
+        ray_tpu.shutdown()
+
+
+def _render_slo(rt, as_json: bool) -> None:
+    try:
+        status = rt.slo_status()
+    except Exception as e:
+        print(f"slo status unavailable: {e}")
+        return
+    deployments = status.get("deployments", {})
+    if as_json:
+        print(json.dumps(status, indent=2, sort_keys=True))
+        return
+    if not deployments:
+        print("no SLOs declared (serve.deploy(..., slo={...}))")
+        return
+    print(f"{'DEPLOYMENT':20} {'WINDOW':>8} {'GOODPUT':>9} "
+          f"{'BURN':>7}  ALERTS")
+    for dep, st in sorted(deployments.items()):
+        alerts = ",".join(
+            p[:-len("_burn_active")] for p, v in sorted(st.items())
+            if p.endswith("_burn_active") and v
+        ) or "-"
+        first = True
+        windows = st.get("goodput", {})
+        for w in sorted(windows, key=lambda x: float(x)):
+            g = windows[w]
+            b = st.get("burn", {}).get(w, 0.0)
+            print(f"{(dep if first else '')[:20]:20} {w + 's':>8} "
+                  f"{g:>9.4f} {b:>7.2f}  "
+                  f"{alerts if first else ''}")
+            first = False
+        rem = st.get("budget_remaining")
+        if rem is not None:
+            print(f"{'':20} budget remaining: {rem:.4f}")
+
+
+def cmd_slo(args) -> int:
+    """Per-deployment SLO status: goodput SLIs, multi-window error-
+    budget burn rates, alert state (the engine's latest evaluation)."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.core import runtime_context
+
+        rt = runtime_context.current_runtime()
+        return _watch_loop(
+            lambda: _render_slo(rt, getattr(args, "json", False)),
+            getattr(args, "watch", None))
     finally:
         ray_tpu.shutdown()
 
@@ -1119,8 +1339,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="print the serve overload-control section "
                         "(shed/deadline/breaker/retry counters) instead "
                         "of the full document")
+    p.add_argument("--watch", type=float, default=None, metavar="N",
+                   help="refresh the chosen view every N seconds "
+                        "(^C exits)")
     _add_address(p)
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("top",
+                       help="live cluster view: per-node CPU/RSS/HBM, "
+                            "serve qps/p99/shed, dispatch ops/s")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds")
+    p.add_argument("--window", type=float, default=30.0,
+                   help="trailing window for rates (seconds)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit")
+    _add_address(p)
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("slo",
+                       help="per-deployment SLO status: goodput, "
+                            "error-budget burn rates, alert state")
+    p.add_argument("--watch", type=float, default=None, metavar="N",
+                   help="refresh every N seconds (^C exits)")
+    p.add_argument("--json", action="store_true")
+    _add_address(p)
+    p.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser("events", help="aggregated cluster event log")
     p.add_argument("--severity", default=None,

@@ -145,6 +145,7 @@ def _deploy_one(controller, dep_name: str, target: Deployment, *,
             autoscaling,
             is_asgi=getattr(target.func_or_class, "_rtpu_asgi", False),
             max_concurrent_queries=target.max_concurrent_queries,
+            slo=target.slo,
         )
     )
 
@@ -182,6 +183,7 @@ def run(target: Deployment, *, name: Optional[str] = None,
             autoscaling,
             is_asgi=getattr(target.func_or_class, "_rtpu_asgi", False),
             max_concurrent_queries=target.max_concurrent_queries,
+            slo=target.slo,
         )
     )
     snap = ray_tpu.get(controller.get_routing.remote(dep_name))

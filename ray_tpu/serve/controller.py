@@ -35,6 +35,12 @@ RECONCILE_INTERVAL_S = 0.2
 METRIC_STALENESS_S = 2.0
 HEALTH_CHECK_PERIOD_S = 1.0
 HEALTH_CHECK_TIMEOUT_S = 2.0
+# How long one read of the SLO engine's `__slo_status__` blob serves the
+# autoscaler loop (the engine only refreshes it every slo_eval_interval_s).
+SLO_STATUS_TTL_S = 1.0
+# Downscaling is held while ANY window still burns faster than this —
+# scale-in during recovery re-lights the very alert that just cleared.
+SLO_DOWNSCALE_BURN_MAX = 0.5
 
 
 class _DeploymentState:
@@ -49,6 +55,8 @@ class _DeploymentState:
         self.ray_actor_options: Dict[str, Any] = {}
         self.batch_config: Optional[Dict[str, Any]] = None
         self.autoscaling: Optional[Dict[str, float]] = None
+        # Normalized SLO spec (util/slo.normalize_spec output) or None.
+        self.slo: Optional[Dict[str, Any]] = None
         self.is_asgi: bool = False  # raw-HTTP ingress deployment
         self.version: str = ""
         # Ceiling for each replica's adaptive concurrency limiter.
@@ -83,6 +91,9 @@ class ServeControllerActor:
         # Runtime override of serve_breaker_eject_s (ops/test hook; the
         # config knob seeds this process's default when None).
         self._breaker_eject_override: Optional[float] = None
+        # Cached `__slo_status__` read for the autoscale loop.
+        self._slo_status: Dict[str, Any] = {}
+        self._slo_status_ts: float = 0.0
         self._reconciler = threading.Thread(
             target=self._reconcile_loop, daemon=True
         )
@@ -134,7 +145,14 @@ class ServeControllerActor:
                autoscaling: Optional[Dict[str, float]] = None,
                version: Optional[str] = None,
                is_asgi: bool = False,
-               max_concurrent_queries: int = 8) -> List[Any]:
+               max_concurrent_queries: int = 8,
+               slo: Optional[Dict[str, Any]] = None) -> List[Any]:
+        from ..util import slo as slo_mod
+
+        # Validate at deploy time — a typo'd spec must fail the deploy
+        # (the ValueError propagates to the caller through ray_tpu.get),
+        # not silently disable the objective at eval time.
+        slo_spec = slo_mod.normalize_spec(slo) if slo is not None else None
         if version is None:
             version = hashlib.sha1(
                 blob + repr((init_args, init_kwargs)).encode()
@@ -155,6 +173,7 @@ class ServeControllerActor:
             st.ray_actor_options = dict(ray_actor_options)
             st.batch_config = batch_config
             st.autoscaling = dict(autoscaling) if autoscaling else None
+            st.slo = slo_spec
             st.version = version
             st.max_concurrent_queries = max(1, int(max_concurrent_queries))
             if st.autoscaling:
@@ -163,6 +182,7 @@ class ServeControllerActor:
                 num_replicas = min(max(num_replicas, lo), hi)
             st.target_replicas = num_replicas
 
+        self._publish_slo_spec(name, slo_spec)
         cluster_events.emit(
             cluster_events.INFO, cluster_events.SERVE,
             f"deployment '{name}' deploy: version={version} "
@@ -441,6 +461,51 @@ class ServeControllerActor:
         )
         self.drain_replicas(victims)
 
+    def _publish_slo_spec(self, name: str,
+                          spec: Optional[Dict[str, Any]]) -> None:
+        """(Un)declare the deployment's SLO to the head engine via the
+        `__slo__/<name>` KV key (util/slo reads it each eval tick)."""
+        import json
+
+        from ..core import runtime_context
+        from ..util import slo as slo_mod
+
+        rt = runtime_context.current_runtime_or_none()
+        if rt is None:
+            return  # unit-tested outside a cluster: nothing to publish to
+        key = f"{slo_mod.SPEC_PREFIX}{name}"
+        try:
+            if spec is None:
+                rt.kv_del(key)
+            else:
+                rt.kv_put(key, json.dumps(spec).encode())
+        except Exception as e:
+            # A lost spec means silent non-evaluation — surface it.
+            cluster_events.emit(
+                cluster_events.WARNING, cluster_events.SERVE,
+                f"deployment '{name}': SLO spec publish failed "
+                f"({type(e).__name__}: {e})",
+                custom_fields={"deployment": name},
+            )
+
+    def _slo_status_cached(self) -> Dict[str, Any]:
+        """The engine's `__slo_status__` blob, re-read at most every
+        SLO_STATUS_TTL_S (callers hold self._lock)."""
+        now = time.monotonic()
+        if now - self._slo_status_ts >= self.SLO_STATUS_TTL_S:
+            from ..core import runtime_context
+            from ..util import slo as slo_mod
+
+            self._slo_status_ts = now
+            rt = runtime_context.current_runtime_or_none()
+            self._slo_status = (
+                slo_mod.read_status(rt.kv_get) if rt is not None else {}
+            )
+        return self._slo_status
+
+    SLO_STATUS_TTL_S = SLO_STATUS_TTL_S
+    SLO_DOWNSCALE_BURN_MAX = SLO_DOWNSCALE_BURN_MAX
+
     def _autoscale_once(self, name: str) -> None:
         import math
 
@@ -461,6 +526,24 @@ class ServeControllerActor:
                 max(desired, int(cfg.get("min_replicas", 1))),
                 int(cfg.get("max_replicas", cur)),
             )
+            # SLO signal beside queue depth: a firing fast pair means the
+            # latency objective is burning NOW — add capacity even if the
+            # queues look fine; and never scale in while any window still
+            # burns (the cleared alert would re-light).
+            slo_reason = None
+            slo_state = (self._slo_status_cached().get(name)
+                         if st.slo is not None else None)
+            if slo_state:
+                burns = [float(b) for b in
+                         (slo_state.get("burn") or {}).values()]
+                burn_max = max(burns) if burns else 0.0
+                if slo_state.get("fast_burn_active"):
+                    boosted = min(cur + 1, int(cfg.get("max_replicas", cur)))
+                    if boosted > desired:
+                        desired = boosted
+                        slo_reason = "slo_burn"
+                if desired < cur and burn_max > self.SLO_DOWNSCALE_BURN_MAX:
+                    desired = cur
             if desired > cur:
                 st.downscale_since = None
                 if st.upscale_since is None:
@@ -485,9 +568,12 @@ class ServeControllerActor:
         cluster_events.emit(
             cluster_events.INFO, cluster_events.SERVE,
             f"deployment '{name}' autoscale: {cur} -> {desired} "
-            f"replica(s) (outstanding={total})",
+            f"replica(s) (outstanding={total})"
+            + (f" [{slo_reason}]" if slo_reason else ""),
             custom_fields={"deployment": name, "from": cur,
-                           "to": desired, "outstanding": total},
+                           "to": desired, "outstanding": total,
+                           **({"reason": slo_reason} if slo_reason
+                              else {})},
         )
         self._converge_count(name)
 
@@ -631,6 +717,7 @@ class ServeControllerActor:
                     "version": st.version,
                     "replica_versions": list(st.replica_versions),
                     "autoscaling": st.autoscaling,
+                    "slo": st.slo,
                     "route_version": st.route_version,
                 }
                 for name, st in self._deployments.items()
@@ -645,6 +732,7 @@ class ServeControllerActor:
                 st.replica_versions = []
                 self._bump_route(st)
         if st is not None:
+            self._publish_slo_spec(name, None)
             cluster_events.emit(
                 cluster_events.INFO, cluster_events.SERVE,
                 f"deployment '{name}' deleted "

@@ -32,6 +32,7 @@ class Deployment:
         max_concurrent_queries: int = 8,
         ray_actor_options: Optional[Dict[str, Any]] = None,
         autoscaling_config: Optional[AutoscalingConfig] = None,
+        slo: Optional[Dict[str, Any]] = None,
     ):
         self.func_or_class = func_or_class
         self.name = name
@@ -39,6 +40,9 @@ class Deployment:
         self.max_concurrent_queries = max_concurrent_queries
         self.ray_actor_options = ray_actor_options or {}
         self.autoscaling_config = autoscaling_config
+        # SLO spec dict (util/slo.normalize_spec keys); validated at
+        # deploy time by the controller, evaluated by the head GCS.
+        self.slo = slo
         self._init_args: Tuple = ()
         self._init_kwargs: Dict[str, Any] = {}
 
@@ -56,6 +60,7 @@ class Deployment:
             autoscaling_config=kw.pop(
                 "autoscaling_config", self.autoscaling_config
             ),
+            slo=kw.pop("slo", self.slo),
         )
         if kw:
             raise TypeError(f"unknown deployment options: {list(kw)}")

@@ -28,7 +28,7 @@ from typing import Any, Dict, List, Optional
 _APP_KEYS = {"name", "route_prefix", "import_path", "deployments",
              "args"}
 _DEP_KEYS = {"name", "num_replicas", "max_concurrent_queries",
-             "ray_actor_options", "autoscaling_config"}
+             "ray_actor_options", "autoscaling_config", "slo"}
 
 
 @dataclasses.dataclass
@@ -38,6 +38,7 @@ class DeploymentOverride:
     max_concurrent_queries: Optional[int] = None
     ray_actor_options: Optional[Dict[str, Any]] = None
     autoscaling_config: Optional[Dict[str, Any]] = None
+    slo: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -131,6 +132,7 @@ def _apply_overrides(dep, overrides: List[DeploymentOverride]):
                     AutoscalingConfig(**o.autoscaling_config)
                     if o.autoscaling_config is not None else None
                 ),
+                "slo": o.slo,
             }.items() if v is not None
         })
         out._init_args = tuple(

@@ -59,8 +59,12 @@ TRAIN = "TRAIN"
 # FENCE decisions — node fenced at an epoch, zombie self-termination,
 # fresh-incarnation rejoin — surfaced via `rtpu events --source NODE`.
 NODE = "NODE"
+# SLO plane (util/slo.py evaluated in the head GCS): error-budget
+# burn-rate alert transitions — WARNING on crossing, INFO on clearing,
+# deduped while the condition persists.
+SLO = "SLO"
 SOURCES = (GCS, RAYLET, WORKER, TASK, ACTOR, OBJECT_STORE, AUTOSCALER,
-           SERVE, JOB, CHAOS, TRAIN, NODE)
+           SERVE, JOB, CHAOS, TRAIN, NODE, SLO)
 
 FLUSH_INTERVAL_S = 0.25
 

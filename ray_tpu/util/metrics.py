@@ -21,6 +21,12 @@ import cloudpickle
 
 FLUSH_INTERVAL_S = 0.5
 KV_PREFIX = "__metrics__/"
+# Every PROC_SAMPLE_INTERVAL_S the flusher re-records this process's
+# cpu/rss gauges, which (a) feeds the per-node rows of `rtpu top` and
+# (b) acts as a liveness refresh: the v2 snapshot's `ts` stays fresh
+# while the process lives, so the head-side GC (core/gcs.py) can reap
+# blobs whose writer died without aggregating ghosts forever.
+PROC_SAMPLE_INTERVAL_S = 5.0
 
 
 class _Registry:
@@ -83,9 +89,14 @@ class _Registry:
         self.ensure_flusher()
 
     def _flush_loop(self):
+        last_proc = 0.0
         while True:
             time.sleep(FLUSH_INTERVAL_S)
             try:
+                now = time.monotonic()
+                if now - last_proc >= PROC_SAMPLE_INTERVAL_S:
+                    last_proc = now
+                    _sample_process_stats()
                 self.flush()
             except Exception:
                 pass
@@ -105,9 +116,18 @@ class _Registry:
                        self.meta.get(name, ("", ""))[1])
                 for name, (kind, series) in self.metrics.items()
             }
+        # v2 envelope: the writer's node scopes the key (one node's
+        # blobs GC together when it dies) and `ts` dates the snapshot
+        # (a stale ts marks a dead pid's blob for head-side GC).
+        node = getattr(rt, "node_id", None)
+        node_hex = node.hex() if hasattr(node, "hex") else ""
+        suffix = f"{node_hex}/{os.getpid()}" if node_hex else str(os.getpid())
         rt.kv_put(
-            f"{KV_PREFIX}{os.getpid()}",
-            cloudpickle.dumps(snapshot),
+            f"{KV_PREFIX}{suffix}",
+            cloudpickle.dumps({
+                "v": 2, "ts": time.time(), "pid": os.getpid(),
+                "node": node_hex, "metrics": snapshot,
+            }),
         )
 
 
@@ -258,6 +278,23 @@ class Histogram(_Metric):
         _registry.record(name, "histogram", key, update)
 
 
+# Per-process resource series, recorded by the flusher's periodic
+# liveness sample (`_sample_process_stats`). Identity tags (node, pid)
+# keep writers distinct; sum over pid for a node's total RSS, rate the
+# cpu counter for CPU%.
+PROCESS_CPU = Counter(
+    "ray_tpu_process_cpu_seconds_total",
+    "Cumulative CPU seconds (user+sys) of one ray_tpu process.",
+    tag_keys=("node", "pid"),
+)
+PROCESS_RSS = Gauge(
+    "ray_tpu_process_rss_bytes",
+    "Resident set size of one ray_tpu process.",
+    tag_keys=("node", "pid"),
+)
+_last_cpu_seconds = 0.0
+
+
 def declared_metrics() -> Dict[str, Tuple[str, str]]:
     """Every metric declared in this process: name -> (kind, description).
     Data source for tools/check_metric_names.py."""
@@ -328,6 +365,95 @@ def _merged_exemplars(cur: Dict, value: Dict) -> Dict:
     return {"exemplars": merged}
 
 
+def _sample_process_stats() -> None:
+    """Record this process's cpu/rss (from /proc, psutil-free) into the
+    standard pipeline — the per-node resource rows of `rtpu top` and
+    the head TSDB derive CPU use via counter->rate (no-op off Linux)."""
+    from ..core import runtime_context
+
+    rt = runtime_context.current_runtime_or_none()
+    node = getattr(rt, "node_id", None) if rt is not None else None
+    tags = {"node": node.hex() if hasattr(node, "hex") else "",
+            "pid": str(os.getpid())}
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        PROCESS_RSS.set(pages * os.sysconf("SC_PAGE_SIZE"), tags=tags)
+        with open("/proc/self/stat") as f:
+            parts = f.read().split()
+        tick = os.sysconf("SC_CLK_TCK")
+        cpu = (int(parts[13]) + int(parts[14])) / tick
+    except Exception:
+        return
+    global _last_cpu_seconds
+    if cpu > _last_cpu_seconds:
+        PROCESS_CPU.inc(cpu - _last_cpu_seconds, tags=tags)
+        _last_cpu_seconds = cpu
+
+
+def decode_snapshot(blob: bytes) -> Tuple[Dict, float]:
+    """One flushed KV blob -> (metrics dict, snapshot ts). Accepts both
+    the v2 envelope and the pre-envelope bare dict (ts 0.0: age
+    unknown, exempt from staleness GC)."""
+    snapshot = cloudpickle.loads(blob)
+    if isinstance(snapshot, dict) and snapshot.get("v") == 2:
+        return snapshot.get("metrics") or {}, float(snapshot.get("ts", 0.0))
+    return snapshot, 0.0
+
+
+def merge_snapshot(out: Dict[str, Dict], snapshot: Dict) -> None:
+    """Fold one process snapshot into a report accumulator: counters and
+    histograms sum across processes; gauges keep the latest write per
+    tag set (identity tags keep writers distinct — see _telemetry)."""
+    for name, item in snapshot.items():
+        kind, series = item[0], item[1]
+        help_ = item[2] if len(item) > 2 else ""
+        entry = out.setdefault(
+            name, {"type": kind, "series": {}, "help": ""}
+        )
+        if help_ and not entry.get("help"):
+            entry["help"] = help_
+        for tags_key, value in series.items():
+            cur = entry["series"].get(tags_key)
+            if kind == "counter":
+                entry["series"][tags_key] = (cur or 0.0) + value
+            elif kind == "gauge":
+                entry["series"][tags_key] = value
+            elif cur is None:  # histogram, first sighting
+                entry["series"][tags_key] = dict(value)
+            else:
+                entry["series"][tags_key] = _merge_histogram(cur, value)
+
+
+def aggregate_blobs(blobs) -> Dict[str, Dict]:
+    """Aggregate an iterable of flushed KV blobs into one report dict.
+    Shared by the driver-side report below and the head GCS's TSDB
+    sampler (core/gcs.py), which reads its KV table directly. Corrupt
+    blobs are skipped — one wedged writer must not blind the report."""
+    out: Dict[str, Dict] = {}
+    for blob in blobs:
+        if not blob:
+            continue
+        try:
+            snapshot, _ts = decode_snapshot(blob)
+        except Exception:
+            continue
+        merge_snapshot(out, snapshot)
+    return out
+
+
+def local_snapshot() -> Dict[str, Tuple]:
+    """This process's registry in flushed-snapshot form, without going
+    through (or requiring) a runtime. The head GCS uses it to publish
+    its own ray_tpu_slo_* gauges when it runs standalone."""
+    with _registry.lock:
+        return {
+            name: (kind, dict(series),
+                   _registry.meta.get(name, ("", ""))[1])
+            for name, (kind, series) in _registry.metrics.items()
+        }
+
+
 def get_metrics_report() -> Dict[str, Dict]:
     """Aggregate every process's flushed metrics (ref analogue: scraping
     the metrics agents). Counters/histograms sum across processes; gauges
@@ -336,28 +462,6 @@ def get_metrics_report() -> Dict[str, Dict]:
 
     rt = runtime_context.current_runtime()
     _registry.flush()
-    out: Dict[str, Dict] = {}
-    for key in rt.kv_keys(KV_PREFIX):
-        blob = rt.kv_get(key)
-        if blob is None:
-            continue
-        snapshot = cloudpickle.loads(blob)
-        for name, item in snapshot.items():
-            kind, series = item[0], item[1]
-            help_ = item[2] if len(item) > 2 else ""
-            entry = out.setdefault(
-                name, {"type": kind, "series": {}, "help": ""}
-            )
-            if help_ and not entry.get("help"):
-                entry["help"] = help_
-            for tags_key, value in series.items():
-                cur = entry["series"].get(tags_key)
-                if kind == "counter":
-                    entry["series"][tags_key] = (cur or 0.0) + value
-                elif kind == "gauge":
-                    entry["series"][tags_key] = value
-                elif cur is None:  # histogram, first sighting
-                    entry["series"][tags_key] = dict(value)
-                else:
-                    entry["series"][tags_key] = _merge_histogram(cur, value)
-    return out
+    return aggregate_blobs(
+        rt.kv_get(key) for key in rt.kv_keys(KV_PREFIX)
+    )

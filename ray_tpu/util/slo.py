@@ -1,0 +1,284 @@
+"""Per-deployment SLO engine: goodput SLIs + error-budget burn rates.
+
+Ref analogue: the multi-window, multi-burn-rate alerting pattern of the
+Google SRE workbook (ch. 5), applied to the serve telemetry the
+`__metrics__` KV pipeline already aggregates. The head GCS evaluates
+every declared spec against the in-process TSDB (util/tsdb.py) each
+``slo_eval_interval_s``:
+
+- **goodput SLI** over a window: requests that completed successfully
+  AND within ``latency_target_s``, over all requests (sheds, deadline
+  kills, and non-2xx responses count as bad);
+- **objective**: the spec's two halves combine additively — allowed
+  badness is ``(1 - availability) + (1 - latency_percentile)``, i.e.
+  a p99<=500ms + 99.9% availability spec tolerates 1.1% bad requests;
+- **burn rate**: ``(1 - goodput) / (1 - objective)`` — 1.0 means the
+  error budget is being spent exactly at the sustainable pace;
+- **multi-window alerts**: a pair fires only when BOTH its short and
+  long windows exceed the threshold (fast 5m/1h @ 14.4x for paging,
+  slow 30m/6h @ 6x for ticketing), deduped while the condition
+  persists: one WARNING ``SLO`` cluster event on crossing, one INFO on
+  clearing, nothing in between.
+
+Specs are declared at ``serve.deploy(..., slo={...})``; the controller
+publishes them under ``__slo__/<deployment>`` in the cluster KV, and
+the engine publishes its status back under ``__slo_status__`` where the
+controller's autoscaling loop and the cluster Autoscaler read it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .metrics import Gauge
+from .tsdb import TSDB, fraction_le, quantile_from_histogram  # noqa: F401
+
+# KV keys of the spec/status exchange (controller <-> GCS engine).
+SPEC_PREFIX = "__slo__/"
+STATUS_KEY = "__slo_status__"
+
+# Latency SLI sources, most- to least-preferred: the ingress histogram
+# sees end-to-end latency but only exists for HTTP/gRPC traffic; the
+# replica-processing histogram covers handle-driven deployments too
+# (chaos-injected replica latency lands inside its measured window).
+LATENCY_SOURCES = (
+    "ray_tpu_serve_request_latency_seconds",
+    "ray_tpu_serve_replica_processing_seconds",
+)
+REQUESTS_TOTAL = "ray_tpu_serve_requests_total"
+SHED_TOTAL = "ray_tpu_serve_shed_total"
+DEADLINE_TOTAL = "ray_tpu_serve_deadline_exceeded_total"
+
+GOODPUT_RATIO = Gauge(
+    "ray_tpu_slo_goodput_ratio",
+    "Fraction of requests meeting the deployment's SLO over one "
+    "evaluation window (1.0 with no traffic).",
+    tag_keys=("deployment", "window"),
+)
+BURN_RATE = Gauge(
+    "ray_tpu_slo_burn_rate",
+    "Error-budget burn rate over one evaluation window (1.0 = spending "
+    "the budget exactly at the sustainable pace).",
+    tag_keys=("deployment", "window"),
+)
+BUDGET_REMAINING = Gauge(
+    "ray_tpu_slo_budget_remaining",
+    "Fraction of the error budget left over the longest window "
+    "(clamped to [0, 1]).",
+    tag_keys=("deployment",),
+)
+
+_SPEC_KEYS = {
+    "latency_target_s", "latency_percentile", "availability",
+    "windows", "burn_thresholds",
+}
+DEFAULT_WINDOWS = {"fast": (300.0, 3600.0), "slow": (1800.0, 21600.0)}
+DEFAULT_THRESHOLDS = {"fast": 14.4, "slow": 6.0}
+
+
+def normalize_spec(slo: Dict[str, Any]) -> Dict[str, Any]:
+    """Validate + default one ``serve.deploy(..., slo={...})`` spec.
+    Raises ValueError at deploy time, not eval time — a typo'd key must
+    fail the deploy, not silently disable the objective."""
+    if not isinstance(slo, dict):
+        raise ValueError(f"slo spec must be a dict, got {type(slo).__name__}")
+    unknown = set(slo) - _SPEC_KEYS
+    if unknown:
+        raise ValueError(
+            f"unknown slo spec key(s) {sorted(unknown)} "
+            f"(allowed: {sorted(_SPEC_KEYS)})"
+        )
+    target = float(slo.get("latency_target_s", 0.5))
+    pctl = float(slo.get("latency_percentile", 0.99))
+    avail = float(slo.get("availability", 0.999))
+    if target <= 0:
+        raise ValueError("latency_target_s must be > 0")
+    if not 0.0 < pctl <= 1.0:
+        raise ValueError("latency_percentile must be in (0, 1]")
+    if not 0.0 < avail <= 1.0:
+        raise ValueError("availability must be in (0, 1]")
+    windows: Dict[str, Tuple[float, float]] = {}
+    for pair, default in DEFAULT_WINDOWS.items():
+        w = (slo.get("windows") or {}).get(pair, default)
+        short, long_ = float(w[0]), float(w[1])
+        if not 0 < short < long_:
+            raise ValueError(
+                f"windows[{pair!r}] must be [short, long] with "
+                f"0 < short < long, got {list(w)}"
+            )
+        windows[pair] = (short, long_)
+    thresholds = {
+        pair: float((slo.get("burn_thresholds") or {}).get(pair, default))
+        for pair, default in DEFAULT_THRESHOLDS.items()
+    }
+    objective = max(0.0, avail + pctl - 1.0)
+    return {
+        "latency_target_s": target,
+        "latency_percentile": pctl,
+        "availability": avail,
+        "objective": objective,
+        "windows": {k: list(v) for k, v in windows.items()},
+        "burn_thresholds": thresholds,
+    }
+
+
+class SloEngine:
+    """Evaluate declared specs against a TSDB; dedup alert events.
+
+    ``emit_event(severity, message, custom_fields)`` is the event
+    transport (the GCS wires it to its cluster-event recorder; unit
+    tests pass a list collector).
+    """
+
+    def __init__(self, emit_event: Optional[Callable] = None):
+        self._emit = emit_event
+        # (deployment, pair) -> True while the alert condition holds.
+        self._active: Dict[Tuple[str, str], bool] = {}
+        self.status: Dict[str, Dict[str, Any]] = {}
+
+    # -- SLI math ------------------------------------------------------------
+
+    def _window_sli(self, tsdb: TSDB, deployment: str, spec: Dict,
+                    window_s: float, now: float) -> Tuple[float, float]:
+        """(goodput, total_requests) over one window."""
+        tags = {"deployment": deployment}
+        lat = None
+        for source in LATENCY_SOURCES:
+            lat = tsdb.hist_delta(source, tags, window_s, now)
+            if lat is not None and lat["count"] > 0:
+                break
+        count = lat["count"] if lat else 0.0
+        good = count
+        if lat and count > 0:
+            frac = fraction_le(lat["bounds"], lat["buckets"],
+                               spec["latency_target_s"])
+            if frac is not None:
+                good = count * frac
+        bad_extra = 0.0
+        for name in (SHED_TOTAL, DEADLINE_TOTAL):
+            bad_extra += tsdb.delta(name, tags, window_s, now) or 0.0
+        # Non-2xx ingress responses that DID reach the latency histogram
+        # (5xx at the proxy): count them as bad on top of slowness.
+        errors = 0.0
+        for row in tsdb.query(REQUESTS_TOTAL, tags):
+            row_tags = dict(row["tags"])
+            code = str(row_tags.get("code", ""))
+            if code and not (code.startswith("2") or
+                             code.lower() in ("ok", "200")):
+                errors += tsdb.delta(
+                    REQUESTS_TOTAL, dict(row_tags), window_s, now) or 0.0
+        good = max(0.0, good - errors)
+        total = count + bad_extra
+        if total <= 0:
+            return 1.0, 0.0
+        return min(1.0, good / total), total
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self, tsdb: TSDB, specs: Dict[str, Dict[str, Any]],
+                 now: float) -> Dict[str, Dict[str, Any]]:
+        """One eval tick over every declared spec; returns (and retains
+        as ``self.status``) the per-deployment status map the KV blob /
+        ``slo_status`` RPC / autoscalers consume."""
+        status: Dict[str, Dict[str, Any]] = {}
+        for dep, spec in sorted(specs.items()):
+            status[dep] = self._evaluate_one(tsdb, dep, spec, now)
+        # Deployments whose spec vanished: clear alert state + gauges.
+        for dep, pair in [k for k in self._active if k[0] not in specs]:
+            self._active.pop((dep, pair), None)
+        self.status = status
+        return status
+
+    def _evaluate_one(self, tsdb: TSDB, dep: str, spec: Dict,
+                      now: float) -> Dict[str, Any]:
+        budget = max(1e-9, 1.0 - spec["objective"])
+        goodput: Dict[str, float] = {}
+        burn: Dict[str, float] = {}
+        totals: Dict[str, float] = {}
+        window_set = sorted({w for pair in spec["windows"].values()
+                             for w in pair})
+        for w in window_set:
+            g, total = self._window_sli(tsdb, dep, spec, w, now)
+            key = str(int(w))
+            goodput[key] = round(g, 6)
+            burn[key] = round((1.0 - g) / budget, 4)
+            totals[key] = total
+            tags = {"deployment": dep, "window": key}
+            GOODPUT_RATIO.set(goodput[key], tags=tags)
+            BURN_RATE.set(burn[key], tags=tags)
+        longest = str(int(window_set[-1])) if window_set else None
+        remaining = 1.0
+        if longest is not None:
+            remaining = min(1.0, max(0.0, 1.0 - burn[longest]))
+        BUDGET_REMAINING.set(remaining, tags={"deployment": dep})
+
+        out: Dict[str, Any] = {
+            "spec": spec, "goodput": goodput, "burn": burn,
+            "budget_remaining": round(remaining, 6), "ts": now,
+        }
+        for pair, (short, long_) in spec["windows"].items():
+            thr = spec["burn_thresholds"][pair]
+            b_short = burn[str(int(short))]
+            b_long = burn[str(int(long_))]
+            firing = b_short > thr and b_long > thr
+            out[f"{pair}_burn_active"] = firing
+            self._transition(dep, pair, firing, thr, b_short, b_long)
+        return out
+
+    def _transition(self, dep: str, pair: str, firing: bool,
+                    thr: float, b_short: float, b_long: float) -> None:
+        was = self._active.get((dep, pair), False)
+        if firing == was:
+            return  # condition persists (or stays clear): stay silent
+        self._active[(dep, pair)] = firing
+        if self._emit is None:
+            return
+        fields = {"deployment": dep, "pair": pair, "threshold": thr,
+                  "burn_short": b_short, "burn_long": b_long}
+        if firing:
+            self._emit(
+                "WARNING",
+                f"SLO burn-rate alert: deployment {dep!r} {pair} pair "
+                f"burning at {b_short:.1f}x/{b_long:.1f}x "
+                f"(threshold {thr}x)",
+                fields,
+            )
+        else:
+            self._emit(
+                "INFO",
+                f"SLO burn-rate alert cleared: deployment {dep!r} "
+                f"{pair} pair back to {b_short:.1f}x/{b_long:.1f}x",
+                fields,
+            )
+
+
+def decode_specs(kv_items: Dict[str, bytes]) -> Dict[str, Dict[str, Any]]:
+    """``{key: blob}`` for keys under SPEC_PREFIX -> {deployment: spec}.
+    Specs are JSON (the controller writes them; a corrupt blob is
+    skipped, not fatal — the deploy-time validation already ran)."""
+    specs: Dict[str, Dict[str, Any]] = {}
+    for key, blob in kv_items.items():
+        dep = key[len(SPEC_PREFIX):]
+        try:
+            spec = json.loads(blob.decode())
+        except Exception:
+            continue
+        if isinstance(spec, dict) and "objective" in spec:
+            specs[dep] = spec
+    return specs
+
+
+def read_status(kv_get: Callable[[str], Optional[bytes]]
+                ) -> Dict[str, Dict[str, Any]]:
+    """Decode the engine's published status blob via any kv_get-shaped
+    callable (driver runtime, worker runtime, controller actor). {}
+    when absent or unreadable."""
+    try:
+        blob = kv_get(STATUS_KEY)
+        if not blob:
+            return {}
+        status = json.loads(blob.decode())
+        return status if isinstance(status, dict) else {}
+    except Exception:
+        return {}

@@ -1,0 +1,314 @@
+"""Head-side bounded time-series store.
+
+Ref analogue: the reference keeps per-series history in its
+dashboard/metrics-agent plane (Prometheus behind `ray metrics`); here a
+small in-process TSDB lives inside the head GCS so trend queries — "p99
+over the last 5 minutes", "shed rate over the last hour" — need no
+external collector. The `__metrics__` KV pipeline is the ingest: each
+GCS sampling tick aggregates the flushed per-process snapshots
+(util/metrics.py) and appends one sample per live series.
+
+Memory is hard-bounded in both dimensions:
+
+- ``samples_per_series``: each series is a ring (deque maxlen) — old
+  samples fall off, the store never grows with uptime;
+- ``max_series``: a low-cardinality guard — ingest for a NEW series
+  beyond the cap is dropped and counted (``stats()["dropped"]``), never
+  silently absorbed, so a tag-explosion bug degrades visibly instead of
+  eating the head's RAM.
+
+Derivation helpers turn the raw cumulative samples into the quantities
+dashboards and the SLO engine actually want: counter→``rate`` (reset
+robust: negative steps are treated as process restarts and clamped),
+histogram-delta→``quantile``/``fraction_le`` via the shared
+:func:`quantile_from_histogram`.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# Sentinel bound for the overflow bucket in bound-keyed delta maps.
+INF = float("inf")
+
+
+def quantile_from_histogram(bounds: List[float], buckets: List[float],
+                            q: float) -> Optional[float]:
+    """Prometheus-style ``histogram_quantile``: ``buckets`` are the
+    per-bucket (non-cumulative) counts for ``len(bounds) + 1`` buckets
+    (the last is the +Inf overflow). Linear interpolation inside the
+    containing bucket; an answer landing in the overflow bucket clamps
+    to the highest finite bound (the honest "at least this much")."""
+    total = sum(buckets)
+    if total <= 0:
+        return None
+    q = min(1.0, max(0.0, q))
+    rank = q * total
+    cum = 0.0
+    for i, count in enumerate(buckets):
+        if count <= 0:
+            continue
+        if cum + count >= rank:
+            if i >= len(bounds):  # overflow bucket
+                return bounds[-1] if bounds else None
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - cum) / count
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        cum += count
+    return bounds[-1] if bounds else None
+
+
+def fraction_le(bounds: List[float], buckets: List[float],
+                x: float) -> Optional[float]:
+    """Fraction of observations <= ``x`` (the latency-goodness SLI),
+    linearly interpolated inside the bucket containing ``x``. The
+    overflow bucket counts as entirely above any finite ``x``."""
+    total = sum(buckets)
+    if total <= 0:
+        return None
+    cum = 0.0
+    for i, b in enumerate(bounds):
+        lo = bounds[i - 1] if i > 0 else 0.0
+        if x >= b:
+            cum += buckets[i]
+            continue
+        if x > lo and b > lo:
+            cum += buckets[i] * (x - lo) / (b - lo)
+        break
+    return min(1.0, cum / total)
+
+
+class _Series:
+    __slots__ = ("kind", "samples")
+
+    def __init__(self, kind: str, maxlen: int):
+        self.kind = kind
+        # scalar sample: (ts, value);
+        # histogram sample: (ts, count, sum, bounds_tuple, buckets_tuple)
+        self.samples: deque = deque(maxlen=maxlen)
+
+
+class TSDB:
+    def __init__(self, samples_per_series: int = 240,
+                 max_series: int = 2000):
+        self.samples_per_series = max(2, int(samples_per_series))
+        self.max_series = max(1, int(max_series))
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, tuple], _Series] = {}
+        self._dropped = 0  # samples refused by the series cap
+
+    # -- ingest --------------------------------------------------------------
+
+    def ingest(self, name: str, kind: str, tags_key: tuple, value: Any,
+               ts: float) -> bool:
+        """Append one sample; returns False when the series cap dropped
+        it. ``value`` is the cumulative counter value, the gauge value,
+        or a histogram point ({count, sum, bounds, buckets})."""
+        key = (name, tuple(tags_key))
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                if len(self._series) >= self.max_series:
+                    self._dropped += 1
+                    return False
+                s = _Series(kind, self.samples_per_series)
+                self._series[key] = s
+            if kind == "histogram":
+                s.samples.append((
+                    ts, float(value.get("count", 0)),
+                    float(value.get("sum", 0.0)),
+                    tuple(value.get("bounds", ())),
+                    tuple(value.get("buckets", ())),
+                ))
+            else:
+                try:
+                    s.samples.append((ts, float(value)))
+                except (TypeError, ValueError):
+                    return False
+            return True
+
+    def ingest_report(self, report: Dict[str, Dict], ts: float) -> None:
+        """One sampling tick over a ``get_metrics_report()``-shaped
+        aggregate: every (name, tags) series gets one sample."""
+        for name, m in report.items():
+            kind = m.get("type", "gauge")
+            for tags_key, value in m.get("series", {}).items():
+                self.ingest(name, kind, tags_key, value, ts)
+
+    def forget(self, name: str, tags: Optional[Dict[str, str]] = None
+               ) -> int:
+        """Drop matching series (used when the source — a deployment, a
+        dead node's processes — goes away); returns the count removed."""
+        with self._lock:
+            victims = [k for k in self._series
+                       if k[0] == name and self._tags_match(k[1], tags)]
+            for k in victims:
+                del self._series[k]
+            return len(victims)
+
+    # -- query ---------------------------------------------------------------
+
+    @staticmethod
+    def _tags_match(tags_key: tuple, tags: Optional[Dict[str, str]]
+                    ) -> bool:
+        if not tags:
+            return True
+        have = dict(tags_key)
+        return all(have.get(k) == v for k, v in tags.items())
+
+    def _matching(self, name: str, tags: Optional[Dict[str, str]]
+                  ) -> List[Tuple[tuple, _Series]]:
+        with self._lock:
+            return [(k[1], s) for k, s in self._series.items()
+                    if k[0] == name and self._tags_match(k[1], tags)]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted({k[0] for k in self._series})
+
+    def query(self, name: str, tags: Optional[Dict[str, str]] = None,
+              since: float = 0.0, limit: int = 0) -> List[Dict[str, Any]]:
+        """Raw samples for every matching series, JSON-shaped: scalar
+        samples as ``[ts, value]`` pairs, histogram samples as
+        ``[ts, count, sum]`` triples (bucket vectors stay head-side —
+        consumers wanting quantiles use the derivation RPC fields)."""
+        out = []
+        for tags_key, s in self._matching(name, tags):
+            with self._lock:
+                samples = list(s.samples)
+            if since:
+                samples = [p for p in samples if p[0] >= since]
+            if limit and limit > 0:
+                samples = samples[-limit:]
+            rows: List[List[float]] = []
+            for p in samples:
+                if s.kind == "histogram":
+                    rows.append([p[0], p[1], p[2]])
+                else:
+                    rows.append([p[0], p[1]])
+            out.append({"name": name, "kind": s.kind,
+                        "tags": [list(kv) for kv in tags_key],
+                        "samples": rows})
+        return out
+
+    def latest(self, name: str, tags: Optional[Dict[str, str]] = None
+               ) -> Optional[float]:
+        """Newest scalar value summed across matching series (gauge
+        semantics: sum over identity tags for the total)."""
+        total, seen = 0.0, False
+        for _tags_key, s in self._matching(name, tags):
+            with self._lock:
+                if s.samples and s.kind != "histogram":
+                    total += s.samples[-1][1]
+                    seen = True
+        return total if seen else None
+
+    @staticmethod
+    def _window_samples(samples: List[tuple], start: float) -> List[tuple]:
+        """Samples at/after ``start`` plus the one immediately before it
+        (the baseline a delta needs)."""
+        out: List[tuple] = []
+        for p in samples:
+            if p[0] < start:
+                out[:] = [p]  # keep only the newest pre-window sample
+            else:
+                out.append(p)
+        return out
+
+    def rate(self, name: str, tags: Optional[Dict[str, str]] = None,
+             window_s: float = 60.0,
+             now: Optional[float] = None) -> Optional[float]:
+        """Per-second increase over the window, summed across matching
+        counter series. Negative steps (process restart zeroed the
+        cumulative value) contribute nothing instead of poisoning the
+        rate."""
+        delta = self.delta(name, tags, window_s, now)
+        if delta is None:
+            return None
+        return delta / max(window_s, 1e-9)
+
+    def delta(self, name: str, tags: Optional[Dict[str, str]] = None,
+              window_s: float = 60.0,
+              now: Optional[float] = None) -> Optional[float]:
+        """Total increase over the window (reset robust), summed across
+        matching series; None when no series has >= 2 window samples."""
+        total, seen = 0.0, False
+        for _tags_key, s in self._matching(name, tags):
+            with self._lock:
+                samples = list(s.samples)
+            if now is None and samples:
+                now = samples[-1][0]
+            win = self._window_samples(samples, (now or 0.0) - window_s)
+            if len(win) < 2:
+                continue
+            seen = True
+            for prev, cur in zip(win, win[1:]):
+                total += max(0.0, cur[1] - prev[1])
+        return total if seen else None
+
+    def hist_delta(self, name: str,
+                   tags: Optional[Dict[str, str]] = None,
+                   window_s: float = 60.0, now: Optional[float] = None
+                   ) -> Optional[Dict[str, Any]]:
+        """Histogram increase over the window, merged across matching
+        series into one bound-keyed delta: ``{count, sum, bounds,
+        buckets}``. Consecutive samples whose bounds differ (a
+        rebucketing merge upstream) are skipped for the bucket vector
+        but still contribute count/sum."""
+        by_bound: Dict[float, float] = {}
+        count = sum_ = 0.0
+        seen = False
+        for _tags_key, s in self._matching(name, tags):
+            if s.kind != "histogram":
+                continue
+            with self._lock:
+                samples = list(s.samples)
+            if now is None and samples:
+                now = samples[-1][0]
+            win = self._window_samples(samples, (now or 0.0) - window_s)
+            if len(win) < 2:
+                continue
+            seen = True
+            for prev, cur in zip(win, win[1:]):
+                count += max(0.0, cur[1] - prev[1])
+                sum_ += max(0.0, cur[2] - prev[2])
+                if prev[3] != cur[3]:
+                    continue
+                bounds = cur[3]
+                for i, (a, b) in enumerate(zip(prev[4], cur[4])):
+                    bound = bounds[i] if i < len(bounds) else INF
+                    inc = max(0.0, b - a)
+                    if inc:
+                        by_bound[bound] = by_bound.get(bound, 0.0) + inc
+        if not seen:
+            return None
+        bounds = sorted(b for b in by_bound if b != INF)
+        buckets = [by_bound.get(b, 0.0) for b in bounds]
+        buckets.append(by_bound.get(INF, 0.0))
+        return {"count": count, "sum": sum_, "bounds": bounds,
+                "buckets": buckets}
+
+    def quantile(self, name: str, q: float,
+                 tags: Optional[Dict[str, str]] = None,
+                 window_s: float = 60.0,
+                 now: Optional[float] = None) -> Optional[float]:
+        d = self.hist_delta(name, tags, window_s, now)
+        if d is None:
+            return None
+        return quantile_from_histogram(d["bounds"], d["buckets"], q)
+
+    # -- accounting ----------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "series": len(self._series),
+                "samples": sum(len(s.samples)
+                               for s in self._series.values()),
+                "max_series": self.max_series,
+                "samples_per_series": self.samples_per_series,
+                "dropped": self._dropped,
+            }

@@ -1,0 +1,419 @@
+"""SLO plane tests (ISSUE 16): TSDB ring/rate/quantile units, series-cap
+drop accounting, multi-window burn-rate math against a synthetic trace,
+SLO alert-event dedup, `__metrics__` blob GC, and the e2e acceptance
+scenario — chaos-injected latency on one replica drives a fast-window
+burn alert and an SLO-signalled scale-up; heal decays the burn and the
+deployment scales back.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import slo
+from ray_tpu.util import state as state_api
+from ray_tpu.util.tsdb import TSDB, fraction_le, quantile_from_histogram
+
+
+def _poll(fn, timeout=15.0, interval=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval)
+    return fn()
+
+
+# ------------------------------------------------------------ TSDB units
+
+
+def test_tsdb_ring_bound_and_query_shapes():
+    tsdb = TSDB(samples_per_series=8, max_series=10)
+    for i in range(50):
+        tsdb.ingest("c", "counter", (("a", "1"),), float(i), 100.0 + i)
+    st = tsdb.stats()
+    assert st["series"] == 1
+    assert st["samples"] == 8  # ring dropped the old 42
+    rows = tsdb.query("c")
+    assert len(rows) == 1
+    assert rows[0]["tags"] == [["a", "1"]]
+    assert rows[0]["samples"][0] == [142.0, 42.0]  # oldest survivor
+    assert rows[0]["samples"][-1] == [149.0, 49.0]
+    # since/limit trims.
+    assert len(tsdb.query("c", since=148.0)[0]["samples"]) == 2
+    assert len(tsdb.query("c", limit=3)[0]["samples"]) == 3
+    assert tsdb.names() == ["c"]
+    assert tsdb.latest("c") == 49.0
+
+
+def test_tsdb_series_cap_drops_counted():
+    tsdb = TSDB(samples_per_series=4, max_series=3)
+    for i in range(5):
+        tsdb.ingest("g", "gauge", (("i", str(i)),), 1.0, float(i))
+    st = tsdb.stats()
+    assert st["series"] == 3
+    assert st["dropped"] == 2
+    # Existing series still ingest under the cap.
+    assert tsdb.ingest("g", "gauge", (("i", "0"),), 2.0, 9.0)
+    assert tsdb.stats()["dropped"] == 2
+    # Memory bound: series x samples_per_series is the hard ceiling.
+    for i in range(100):
+        tsdb.ingest("g", "gauge", (("i", "0"),), float(i), 10.0 + i)
+    assert tsdb.stats()["samples"] <= 3 * 4
+
+
+def test_tsdb_rate_counter_reset_robust():
+    tsdb = TSDB()
+    # 1/s counter that resets (process restart) mid-window.
+    for ts, v in [(0, 0.0), (10, 10.0), (20, 20.0), (30, 0.0),
+                  (40, 10.0)]:
+        tsdb.ingest("c", "counter", (), v, float(ts))
+    # Increase: 10 + 10 + (reset: clamped to 0) + 10 = 30 over 40s.
+    assert tsdb.delta("c", window_s=40.0, now=40.0) == 30.0
+    assert tsdb.rate("c", window_s=40.0, now=40.0) == pytest.approx(0.75)
+    # No samples in window -> None, not 0.
+    assert tsdb.delta("missing", window_s=10.0, now=40.0) is None
+
+
+def test_quantile_and_fraction_helpers():
+    bounds = [0.1, 0.5, 1.0]
+    buckets = [50.0, 30.0, 15.0, 5.0]  # last = +Inf overflow
+    assert quantile_from_histogram(bounds, buckets, 0.5) == \
+        pytest.approx(0.1)
+    # p80 = exactly the 0.5 bound (50+30 of 100).
+    assert quantile_from_histogram(bounds, buckets, 0.8) == \
+        pytest.approx(0.5)
+    # Overflow clamps to the last finite bound.
+    assert quantile_from_histogram(bounds, buckets, 0.999) == 1.0
+    assert quantile_from_histogram(bounds, [0, 0, 0, 0], 0.5) is None
+    assert fraction_le(bounds, buckets, 0.5) == pytest.approx(0.8)
+    # Interpolated inside the (0.1, 0.5] bucket.
+    assert fraction_le(bounds, buckets, 0.3) == pytest.approx(0.65)
+    assert fraction_le(bounds, buckets, 99.0) == pytest.approx(
+        0.95, abs=1e-6)
+
+
+def test_tsdb_hist_delta_window_quantile():
+    tsdb = TSDB()
+    bounds = (0.1, 1.0)
+    cum = [0.0, 0.0, 0.0]
+    count = total = 0.0
+    for i in range(20):
+        fast = i < 10  # first 10s fast, last 10s slow
+        cum[0 if fast else 1] += 10
+        count += 10
+        total += 10 * (0.05 if fast else 0.5)
+        tsdb.ingest(
+            "h", "histogram", (("deployment", "d"),),
+            {"count": count, "sum": total, "bounds": bounds,
+             "buckets": list(cum)},
+            float(i),
+        )
+    # Window over the slow half only.
+    q = tsdb.quantile("h", 0.5, {"deployment": "d"}, window_s=9.0,
+                      now=19.0)
+    assert q is not None and q > 0.1
+    d = tsdb.hist_delta("h", {"deployment": "d"}, window_s=9.0, now=19.0)
+    # 10 in-window samples plus the pre-window baseline -> 10 deltas.
+    assert d["count"] == pytest.approx(100.0)
+    # Nearly all window mass sits above the 0.1 bound (a sliver leaks
+    # below via linear interpolation: empty buckets drop out of the
+    # delta map, widening the containing bucket).
+    assert fraction_le(d["bounds"], d["buckets"], 0.1) < 0.15
+
+
+# ------------------------------------------------------------ spec + engine
+
+
+def test_normalize_spec_validates_and_defaults():
+    spec = slo.normalize_spec({})
+    assert spec["latency_target_s"] == 0.5
+    assert spec["objective"] == pytest.approx(0.999 + 0.99 - 1.0)
+    assert spec["windows"]["fast"] == [300.0, 3600.0]
+    assert spec["burn_thresholds"]["slow"] == 6.0
+    with pytest.raises(ValueError):
+        slo.normalize_spec({"latency_target": 0.5})  # typo'd key
+    with pytest.raises(ValueError):
+        slo.normalize_spec({"latency_percentile": 1.5})
+    with pytest.raises(ValueError):
+        slo.normalize_spec({"windows": {"fast": [10, 5]}})
+    with pytest.raises(ValueError):
+        slo.normalize_spec("p99<0.5s")  # not a dict
+
+
+def _synthetic_trace(tsdb, t0, ticks, bad, bounds, state):
+    """Append `ticks` x 0.5s of traffic: 5 requests per tick, all fast
+    (first bucket) or all slow (third bucket)."""
+    for i in range(ticks):
+        state["cum"][2 if bad else 0] += 5
+        state["count"] += 5
+        state["sum"] += 5 * (0.7 if bad else 0.05)
+        tsdb.ingest(
+            "ray_tpu_serve_replica_processing_seconds", "histogram",
+            (("deployment", "d"), ("method", "__call__")),
+            {"count": state["count"], "sum": state["sum"],
+             "bounds": bounds, "buckets": list(state["cum"])},
+            t0 + i * 0.5,
+        )
+    return t0 + ticks * 0.5
+
+
+def test_burn_rate_windows_and_event_dedup():
+    """Multi-window math on a synthetic trace: good traffic burns ~0;
+    an outage fires BOTH pairs (short AND long over threshold) exactly
+    once; recovery clears the fast pair (short windows decay first)
+    while the slow pair keeps firing — and repeated evaluation while a
+    condition persists emits nothing new."""
+    tsdb = TSDB()
+    spec = slo.normalize_spec({
+        "latency_target_s": 0.1,
+        "windows": {"fast": [10, 20], "slow": [30, 60]},
+    })
+    emitted = []
+    eng = slo.SloEngine(
+        emit_event=lambda sev, msg, f: emitted.append((sev, f)))
+    bounds = (0.05, 0.1, 1.0)
+    st = {"cum": [0.0, 0.0, 0.0, 0.0], "count": 0.0, "sum": 0.0}
+    budget = 1.0 - spec["objective"]
+
+    # 60s of good traffic: goodput 1.0, burn 0, no events.
+    t = _synthetic_trace(tsdb, 1000.0, 120, False, bounds, st)
+    status = eng.evaluate(tsdb, {"d": spec}, t)
+    assert status["d"]["goodput"]["10"] == pytest.approx(1.0)
+    assert status["d"]["burn"]["60"] == pytest.approx(0.0)
+    assert status["d"]["budget_remaining"] == pytest.approx(1.0)
+    assert not status["d"]["fast_burn_active"]
+    assert emitted == []
+
+    # 10s outage: every request lands over the target.
+    t = _synthetic_trace(tsdb, t, 20, True, bounds, st)
+    status = eng.evaluate(tsdb, {"d": spec}, t)
+    # Short fast window (10s) is ~all bad; long fast window (20s) half
+    # bad — both far over the 14.4x page threshold for a 98.9% budget.
+    assert status["d"]["burn"]["10"] == pytest.approx(
+        1.0 / budget, rel=0.15)
+    assert status["d"]["burn"]["20"] == pytest.approx(
+        0.5 / budget, rel=0.15)
+    assert status["d"]["fast_burn_active"]
+    assert status["d"]["slow_burn_active"]
+    assert status["d"]["budget_remaining"] < 1.0
+    warns = [(sev, f) for sev, f in emitted if sev == "WARNING"]
+    assert sorted(f["pair"] for _, f in warns) == ["fast", "slow"]
+    # Condition persists: re-evaluation stays silent (dedup).
+    eng.evaluate(tsdb, {"d": spec}, t)
+    eng.evaluate(tsdb, {"d": spec}, t)
+    assert len(emitted) == 2
+
+    # 25s of recovery: the fast pair's windows (10/20s) are clean again
+    # -> one INFO clear; the slow 30/60s windows still see the outage
+    # -> slow keeps firing, silently.
+    t = _synthetic_trace(tsdb, t, 50, False, bounds, st)
+    status = eng.evaluate(tsdb, {"d": spec}, t)
+    assert not status["d"]["fast_burn_active"]
+    assert status["d"]["slow_burn_active"]
+    clears = [(sev, f) for sev, f in emitted if sev == "INFO"]
+    assert [f["pair"] for _, f in clears] == ["fast"]
+    assert len(emitted) == 3
+
+    # A vanished spec drops its alert state (no stale clears later).
+    eng.evaluate(tsdb, {}, t)
+    assert eng.status == {}
+    assert len(emitted) == 3
+
+
+def test_decode_specs_and_read_status_tolerate_garbage():
+    good = slo.normalize_spec({"latency_target_s": 0.2})
+    import json
+
+    items = {
+        f"{slo.SPEC_PREFIX}ok": json.dumps(good).encode(),
+        f"{slo.SPEC_PREFIX}corrupt": b"\x80not-json",
+        f"{slo.SPEC_PREFIX}unnormalized": b"{}",  # no objective
+    }
+    specs = slo.decode_specs(items)
+    assert list(specs) == ["ok"]
+    assert specs["ok"]["latency_target_s"] == 0.2
+    assert slo.read_status(lambda k: None) == {}
+    assert slo.read_status(lambda k: b"junk{") == {}
+
+
+# ----------------------------------------------------- cluster integration
+
+
+def test_metrics_blob_gc_and_timeseries_rpc(ray_tpu_start):
+    """The head sampler GCs `__metrics__` blobs whose writer is dead
+    (unknown node / stale ts) after the grace window, keeps live ones,
+    and serves the TSDB over the timeseries_query RPC."""
+    import cloudpickle
+
+    from ray_tpu.core.gcs import GcsService
+    from ray_tpu.core.runtime_context import current_runtime
+    from ray_tpu.util.metrics import KV_PREFIX
+
+    rt = current_runtime()
+    old_grace = GcsService.METRICS_GC_GRACE_S
+    GcsService.METRICS_GC_GRACE_S = 0.5
+    try:
+        dead_key = f"{KV_PREFIX}deadbeef00/12345"
+        rt.kv_put(dead_key, cloudpickle.dumps({
+            "v": 2, "ts": time.time(), "pid": 12345,
+            "node": "deadbeef00",
+            "metrics": {"ghost_gauge": ("gauge", {(): 1.0}, "")},
+        }))
+        stale_key = f"{KV_PREFIX}54321"
+        rt.kv_put(stale_key, cloudpickle.dumps({
+            "v": 2, "ts": time.time() - 3600.0, "pid": 54321, "node": "",
+            "metrics": {},
+        }))
+        assert _poll(
+            lambda: dead_key not in rt.kv_keys(KV_PREFIX)
+            and stale_key not in rt.kv_keys(KV_PREFIX)
+        ), "dead writers' blobs must be reaped past the grace window"
+        # A live writer's blob shows up (proc-stats sampler / head
+        # publisher cadence is ~5s) and survives the same GC passes.
+        assert _poll(lambda: rt.kv_keys(KV_PREFIX), timeout=20.0)
+        # And the sampler has been feeding the TSDB: discovery form.
+        disc = _poll(lambda: (rt.timeseries_query() or {})
+                     if (rt.timeseries_query().get("names")) else None)
+        assert disc["stats"]["series"] >= 1
+        assert disc["stats"]["dropped"] == 0
+        name = disc["names"][0]
+        series = rt.timeseries_query(name=name)["series"]
+        assert series and series[0]["samples"]
+    finally:
+        GcsService.METRICS_GC_GRACE_S = old_grace
+
+
+@pytest.fixture
+def slo_cluster():
+    """Cluster with a fast SLO eval cadence for the e2e loop."""
+    from ray_tpu import serve
+    from ray_tpu.util import faults
+
+    rt = ray_tpu.init(
+        num_cpus=4,
+        system_config={
+            "num_prestart_workers": 2,
+            "slo_eval_interval_s": 0.5,
+        },
+    )
+    yield rt
+    try:
+        nm = rt._nm
+        nm.call_sync(nm._gcs.chaos_arm([]), timeout=30)
+    except Exception:
+        pass
+    faults.clear()
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_slo_e2e_chaos_burn_alert_scale_up_and_recovery(slo_cluster):
+    """THE acceptance loop: latency chaos on the only replica of an
+    SLO'd deployment -> goodput collapses -> the fast burn pair fires
+    (WARNING `SLO` event, nominally within 2 eval intervals of the
+    window filling) -> the controller scales up on the SLO signal
+    (queue depth alone would never trigger here); disarm -> burn decays
+    -> INFO clear and the deployment scales back down."""
+    import threading
+
+    from ray_tpu import serve
+    from ray_tpu.serve.deployment import AutoscalingConfig
+
+    rt = slo_cluster
+
+    @serve.deployment(
+        num_replicas=1, max_concurrent_queries=4,
+        ray_actor_options={"max_concurrency": 4},
+        autoscaling_config=AutoscalingConfig(
+            min_replicas=1, max_replicas=3,
+            # Queue depth can't ask for more capacity: any upscale must
+            # come from the SLO burn signal.
+            target_ongoing_requests=1000.0,
+            upscale_delay_s=0.5, downscale_delay_s=1.0,
+        ),
+        slo={
+            "latency_target_s": 0.1,
+            "windows": {"fast": [2.0, 4.0], "slow": [3.0, 6.0]},
+            # The slow (ticket) pair is effectively disabled so the
+            # test exercises exactly one alert pair.
+            "burn_thresholds": {"fast": 1.5, "slow": 1e9},
+        },
+    )
+    class Echo:
+        def __call__(self, req):
+            return req
+
+    handle = serve.run(Echo.bind(), name="slo-echo")
+    assert serve.details()["slo-echo"]["slo"]["latency_target_s"] == 0.1
+
+    stop = threading.Event()
+
+    def drive():
+        i = 0
+        while not stop.is_set():
+            futs = [handle.remote(i + j) for j in range(3)]
+            for f in futs:
+                try:
+                    f.result(timeout=30)
+                except Exception:
+                    pass
+            i += 3
+
+    driver = threading.Thread(target=drive, daemon=True)
+    driver.start()
+    try:
+        # Baseline: traffic meets the target, no burn, no alert.
+        status = _poll(
+            lambda: (rt.slo_status()["deployments"] or {}).get("slo-echo")
+        )
+        assert status, "engine must evaluate the declared spec"
+        assert not status["fast_burn_active"]
+
+        # Inject 0.5s latency into the (only) replica.
+        stats = ray_tpu.get(
+            [r.stats.remote() for r in list(handle._state.replicas)],
+            timeout=30,
+        )
+        sick_id = stats[0]["replica_id"]
+        nm = rt._nm
+        nm.call_sync(nm._gcs.chaos_arm([{
+            "point": "serve_replica", "mode": "always",
+            "action": "latency", "delay_s": 0.5,
+            "match": {"replica": sick_id},
+        }]), timeout=30)
+
+        # Fast-window burn alert fires as a WARNING `SLO` event...
+        ev = _poll(lambda: [
+            e for e in state_api.list_cluster_events(source="SLO")
+            if e["severity"] == "WARNING"
+            and e.get("custom_fields", {}).get("pair") == "fast"
+        ], timeout=20.0)
+        assert ev, "fast burn alert must fire under injected latency"
+        assert ev[0]["custom_fields"]["deployment"] == "slo-echo"
+        # ...the burn gauges ride the normal metrics pipeline...
+        status = rt.slo_status()["deployments"]["slo-echo"]
+        assert status["fast_burn_active"]
+        assert max(status["burn"].values()) > 1.5
+        # ...and the controller scales up on the SLO signal.
+        assert _poll(
+            lambda: serve.details()["slo-echo"]["target_replicas"] >= 2,
+            timeout=20.0,
+        ), "controller must add capacity on a fast-window burn"
+
+        # Heal: burn decays, the alert clears, capacity returns.
+        nm.call_sync(nm._gcs.chaos_arm([]), timeout=30)
+        assert _poll(lambda: [
+            e for e in state_api.list_cluster_events(source="SLO")
+            if e["severity"] == "INFO"
+            and e.get("custom_fields", {}).get("pair") == "fast"
+        ], timeout=30.0), "alert must clear after heal"
+        assert _poll(
+            lambda: serve.details()["slo-echo"]["target_replicas"] == 1,
+            timeout=30.0,
+        ), "capacity must return once the burn is gone"
+    finally:
+        stop.set()
+        driver.join(timeout=10)

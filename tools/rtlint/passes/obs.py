@@ -630,6 +630,56 @@ def validate_fence_config():
     ]
 
 
+# ---- SLO plane lint --------------------------------------------------------
+# The SLO plane's metric surface (util/slo.py gauges set by the head
+# engine) and config knobs (README "SLO & capacity observability"); a
+# rename/kind change must fail CI, not dashboards.
+
+SLO_METRICS = {
+    "ray_tpu_slo_goodput_ratio": "gauge",
+    "ray_tpu_slo_burn_rate": "gauge",
+    "ray_tpu_slo_budget_remaining": "gauge",
+}
+
+SLO_CONFIG_KEYS = ("tsdb_samples_per_series", "tsdb_max_series",
+                   "slo_eval_interval_s")
+
+
+def validate_slo_metrics(declared):
+    failures = []
+    for name, kind in sorted(SLO_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: SLO-plane metric not declared "
+                f"(util/slo.py drifted from the documented surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    # Alert transitions publish under the SLO source — a missing enum
+    # entry would raise at emit time instead of publishing the event.
+    from ray_tpu.util.events import SOURCES
+
+    if "SLO" not in SOURCES:
+        failures.append(
+            "util/events.py: SLO missing from SOURCES — burn-rate "
+            "alert transitions would raise at emit time instead of "
+            "publishing"
+        )
+    return failures
+
+
+def validate_slo_config():
+    fields = _config_fields()
+    return [
+        f"core/config.py: SLO-plane config key {key!r} missing from "
+        f"Config (documented knob drifted from the flag table)"
+        for key in SLO_CONFIG_KEYS if key not in fields
+    ]
+
+
 # ---- request-waterfall / flight-recorder lint ------------------------------
 # The trace plane's metric surface (util/flight_recorder.py) and config
 # knobs (README "Request waterfalls & flight recorder"); a rename/kind
@@ -940,6 +990,7 @@ class ObsMetricsPass(Pass):
         failures += validate_train_metrics(declared)
         failures += validate_trace_metrics(declared)
         failures += validate_fence_metrics(declared)
+        failures += validate_slo_metrics(declared)
         failures += validate_transfer_config()
         failures += validate_actor_config()
         failures += validate_overload_config()
@@ -948,6 +999,7 @@ class ObsMetricsPass(Pass):
         failures += validate_train_config()
         failures += validate_trace_config()
         failures += validate_fence_config()
+        failures += validate_slo_config()
         self.stats = (f"{len(declared)} declared metric(s), "
                       f"{len(state['skipped'])} module(s) skipped at "
                       f"import")

@@ -219,8 +219,46 @@ def main():
             "exemplars_in_exposition": doc.count("# {trace_id="),
         }
 
+        # ---- TSDB/SLO head overhead (ISSUE 16 acceptance) ------------
+        # The head's SLO plane rides every metrics flush tick: aggregate
+        # the live KV blobs -> TSDB ingest, plus a spec evaluation each
+        # slo_eval_interval_s. Replay that work synchronously against
+        # the real post-bench report and assert the eval loop costs
+        # < 2% of one CPU at the real cadence.
+        from ray_tpu.util import slo as slo_mod
+        from ray_tpu.util.metrics import FLUSH_INTERVAL_S
+        from ray_tpu.util.tsdb import TSDB
+
+        tsdb = TSDB()
+        engine = slo_mod.SloEngine()
+        spec = slo_mod.normalize_spec({"latency_target_s": 0.5})
+        now0 = time.time()
+        ticks = 200
+        t0 = time.process_time()
+        for i in range(ticks):
+            tsdb.ingest_report(report, now0 + i * FLUSH_INTERVAL_S)
+        ingest_cpu = time.process_time() - t0
+        t0 = time.process_time()
+        evals = 20
+        for i in range(evals):
+            engine.evaluate(tsdb, {"noisy": spec},
+                            now0 + ticks * FLUSH_INTERVAL_S)
+        eval_cpu = time.process_time() - t0
+        # CPU fraction at the real cadence: one ingest per flush tick,
+        # one evaluation per slo_eval_interval_s (default 5 s).
+        frac = (ingest_cpu / ticks) / FLUSH_INTERVAL_S \
+            + (eval_cpu / evals) / 5.0
+        record["tsdb_overhead"] = {
+            "series": tsdb.stats()["series"],
+            "ingest_ms_per_tick": round(1e3 * ingest_cpu / ticks, 3),
+            "eval_ms_per_eval": round(1e3 * eval_cpu / evals, 3),
+            "head_cpu_fraction": round(frac, 5),
+        }
+
         steady = record["chaos"]["steady"]
         record["acceptance"] = {
+            "tsdb_overhead_lt_2pct":
+                record["tsdb_overhead"]["head_cpu_fraction"] < 0.02,
             "flight_recorder_retained_shed_or_chaos": bool(
                 by_reason.get("shed") or by_reason.get("chaos")
                 or by_reason.get("expired")
