@@ -13,7 +13,7 @@ EXT       := ray_tpu/_native/_rtstore.so
 PUMP_SRC  := src/pump/rts_pump.cc
 PUMP_EXT  := ray_tpu/_native/_rtpump.so
 
-.PHONY: native native-test native-ubsan cpp-client clean check check-slow check-obs check-metrics rtlint perf-transfer perf-actor perf-native perf-train train-smoke train-chaos chaos overload
+.PHONY: native native-test native-ubsan cpp-client clean check check-slow check-obs check-metrics rtlint perf-transfer perf-actor perf-native perf-dispatch perf-train train-smoke train-chaos chaos overload
 
 # Static analysis: the rtlint distributed-invariant analyzer (pass
 # catalog: python -m tools.rtlint --list). Exits non-zero on any
@@ -114,6 +114,13 @@ perf-actor:
 # — merged into PERF_r09.json beside the perf-actor record.
 perf-native:
 	JAX_PLATFORMS=cpu $(PY) tools/run_native_bench.py PERF_r09.json
+
+# Control-plane dispatch bench: per-op stage p50/p99 for the NM/GCS
+# frame loops under a mixed workload (the numbers `rtpu rpc` shows),
+# event-loop lag + GIL-proxy series liveness, and the obs_overhead row
+# (instrumented vs RTPU_NO_DISPATCH_OBS=1, bar <= 3%).
+perf-dispatch:
+	JAX_PLATFORMS=cpu $(PY) tools/run_dispatch_bench.py PERF_r10_baseline.json
 
 native: $(EXT) $(PUMP_EXT)
 

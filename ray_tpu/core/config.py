@@ -288,6 +288,16 @@ class Config:
     # How often the GCS evaluates declared SLO specs against the TSDB
     # (goodput, burn rates, alert transitions).
     slo_eval_interval_s: float = 5.0
+    # --- control-plane dispatch observability (util/dispatch_obs.py +
+    # util/loop_monitor.py) ------------------------------------------------
+    # A control-plane op (NM/GCS frame dispatch) whose total recv->reply
+    # time exceeds this is marked with a span_event and retained by the
+    # flight recorder under reason "slow_op" (<= 0 disables retention).
+    rpc_slow_op_s: float = 0.25
+    # An event loop whose watchdog tick is overdue by more than this
+    # emits one deduped WARNING SYSTEM event carrying the stalled loop
+    # thread's stack (<= 0 disables the stall alarm, lag gauges remain).
+    loop_stall_warn_s: float = 1.0
 
     def __post_init__(self):
         for f in dataclasses.fields(self):

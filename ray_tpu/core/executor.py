@@ -117,6 +117,11 @@ class ActorContainer:
                 name="ray_tpu-actor-asyncio", daemon=True,
             )
             t.start()
+            # Lag watchdog: a CPU-bound await-free method on this loop
+            # stalls every other concurrent call of the async actor.
+            from ..util import loop_monitor
+
+            loop_monitor.attach("actor_asyncio", self._loop)
         self.instance = cls(*args, **kwargs)
 
     def call(self, method_name: str, args, kwargs):

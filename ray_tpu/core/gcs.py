@@ -26,7 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
-from ..util import faults
+from ..util import dispatch_obs, faults, loop_monitor
 from .config import Config, get_config
 from .ids import ActorID, NodeID, ObjectID
 from .protocol import AioFramedWriter as _FramedWriter
@@ -212,9 +212,15 @@ GCS_SERVICES = (
                request=(("name", "str", False, ""),
                         ("tags", "dict", False),
                         ("since", "float", False, 0.0),
-                        ("limit", "int", False, 0)),
+                        ("limit", "int", False, 0),
+                        # Head-side histogram derivation: quantile > 0
+                        # asks for the q-quantile (plus count/sum) of
+                        # the merged bucket deltas over the trailing
+                        # window — buckets never leave the head.
+                        ("quantile", "float", False, 0.0),
+                        ("window", "float", False, 60.0)),
                reply=(("series", "list"), ("names", "list"),
-                      ("stats", "dict"))),
+                      ("stats", "dict"), ("derived", "dict", False))),
         Method("slo_status",
                reply=(("deployments", "dict"), ("ts", "float"))),
     )),
@@ -396,6 +402,10 @@ class GcsService:
         self._metrics_task = asyncio.ensure_future(
             self._metrics_sample_loop()
         )
+        # Second watchdog on the head's shared loop: same thread as the
+        # NM's "nm" monitor, but scoped so a head stall is attributable
+        # to the GCS plane in `rtpu rpc` output.
+        loop_monitor.attach("gcs", asyncio.get_event_loop())
 
     async def _event_aggregator_loop(self):
         """Drain the cluster_events channel into the head store: events
@@ -591,6 +601,7 @@ class GcsService:
 
     def stop(self):
         self._snapshot_final()
+        loop_monitor.detach("gcs")
         if self._metrics_task is not None:
             self._metrics_task.cancel()
         if self._events_task is not None:
@@ -648,15 +659,18 @@ class GcsService:
             await framed.send({"type": "gcs_welcome"})
             while True:
                 msg = await _read_frame(reader)
+                recv_ts = time.monotonic()
                 if self._is_blocking_op(msg):
                     # Long-poll ops must not stall this connection's
                     # dispatch loop (heartbeats arrive on the same socket;
                     # stalling them would false-positive the health sweep).
                     asyncio.ensure_future(
-                        self._dispatch_and_reply(node_id, msg, framed)
+                        self._dispatch_and_reply(node_id, msg, framed,
+                                                 recv_ts)
                     )
                 else:
-                    await self._dispatch_and_reply(node_id, msg, framed)
+                    await self._dispatch_and_reply(node_id, msg, framed,
+                                                   recv_ts)
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
         finally:
@@ -684,36 +698,46 @@ class GcsService:
             or (op == "locate_object" and msg.get("timeout"))
         )
 
-    async def _dispatch_and_reply(self, node_id, msg, framed):
+    async def _dispatch_and_reply(self, node_id, msg, framed,
+                                  recv_ts=None):
+        clock = dispatch_obs.op_clock("gcs", msg.get("op"), recv_ts)
+        replied = False
         try:
-            reply = await self._dispatch(node_id, msg)
-        # Surfaced to the caller: handler exceptions travel back in the
-        # reply's error field and raise RuntimeError at the call site.
-        except Exception as e:  # rtlint: disable=swallowed-failure
-            reply = {"error": str(e)}
-        if reply is not None:
-            reply["type"] = "reply"
-            reply["msg_id"] = msg.get("msg_id")
             try:
-                await framed.send(reply)
-            except Exception as e:
-                # Lost reply to a live caller = silent client timeout;
-                # make the drop visible (dead conns are reaped by the
-                # reader loop right after).
-                sys.stderr.write(
-                    f"[gcs] WARNING: reply send to node "
-                    f"{node_id.hex()[:8]} failed "
-                    f"({type(e).__name__}: {e})\n"
-                )
+                reply = await self._dispatch(node_id, msg, clock)
+            # Surfaced to the caller: handler exceptions travel back in
+            # the reply's error field and raise RuntimeError at the call
+            # site.
+            except Exception as e:  # rtlint: disable=swallowed-failure
+                reply = {"error": str(e)}
+            if reply is not None:
+                reply["type"] = "reply"
+                reply["msg_id"] = msg.get("msg_id")
+                replied = True
+                try:
+                    await framed.send(reply)
+                except Exception as e:
+                    # Lost reply to a live caller = silent client timeout;
+                    # make the drop visible (dead conns are reaped by the
+                    # reader loop right after).
+                    sys.stderr.write(
+                        f"[gcs] WARNING: reply send to node "
+                        f"{node_id.hex()[:8]} failed "
+                        f"({type(e).__name__}: {e})\n"
+                    )
+        finally:
+            if clock is not None:
+                clock.done(replied=replied)
 
     async def _dispatch(
-        self, node_id: NodeID, msg: Dict[str, Any]
+        self, node_id: NodeID, msg: Dict[str, Any], clock=None
     ) -> Optional[Dict[str, Any]]:
         """Typed dispatch: every inbound frame is validated against the
         GCS_SERVICES schemas (unknown op / missing field / wrong type
         raise RpcError back to the caller) and routed to its `_rpc_*`
         handler by the registry."""
-        return await self._rpc.dispatch(node_id, msg["op"], msg)
+        return await self._rpc.dispatch(node_id, msg["op"], msg,
+                                        clock=clock)
 
     # ------------------------------------------------- typed rpc handlers
 
@@ -1146,16 +1170,35 @@ class GcsService:
                            custom_fields=fields)
 
     async def _rpc_timeseries_query(self, node_id, name="", tags=None,
-                                    since=0.0, limit=0):
+                                    since=0.0, limit=0, quantile=0.0,
+                                    window=60.0):
         if not name:
             # Discovery form: what series exist + store accounting.
             return {"series": [], "names": self.tsdb.names(),
                     "stats": self.tsdb.stats()}
-        return {
+        out = {
             "series": self.tsdb.query(name, tags=tags or None,
                                       since=since, limit=limit),
             "names": [], "stats": self.tsdb.stats(),
         }
+        if quantile and quantile > 0.0:
+            window = max(1.0, float(window))
+            d = self.tsdb.hist_delta(name, tags=tags or None,
+                                     window_s=window) or {}
+            from ..util.tsdb import quantile_from_histogram
+
+            qv = None
+            if d.get("buckets"):
+                qv = quantile_from_histogram(d["bounds"], d["buckets"],
+                                             quantile)
+            out["derived"] = {
+                "quantile": qv,
+                "q": float(quantile),
+                "count": d.get("count", 0),
+                "sum": d.get("sum", 0.0),
+                "window_s": window,
+            }
+        return out
 
     async def _rpc_slo_status(self, node_id):
         return {"deployments": dict(self.slo_engine.status),
@@ -1961,9 +2004,10 @@ class LocalGcsHandle:
         }
 
     async def timeseries_query(self, name="", tags=None, since=0.0,
-                               limit=0):
+                               limit=0, quantile=0.0, window=60.0):
         return await self._svc._rpc_timeseries_query(
-            None, name=name, tags=tags, since=since, limit=limit
+            None, name=name, tags=tags, since=since, limit=limit,
+            quantile=quantile, window=window
         )
 
     async def slo_status(self):
@@ -2163,16 +2207,19 @@ class RemoteGcsHandle:
                 "dropped": r["dropped"]}
 
     async def timeseries_query(self, name="", tags=None, since=0.0,
-                               limit=0):
+                               limit=0, quantile=0.0, window=60.0):
         msg = {"op": "timeseries_query", "name": name, "since": since,
-               "limit": limit}
+               "limit": limit, "quantile": quantile, "window": window}
         # Optional dict field must be absent, not None, to pass the
         # request schema's type check.
         if tags is not None:
             msg["tags"] = tags
         r = await self._client.request(msg)
-        return {"series": r["series"], "names": r["names"],
-                "stats": r["stats"]}
+        out = {"series": r["series"], "names": r["names"],
+               "stats": r["stats"]}
+        if r.get("derived") is not None:
+            out["derived"] = r["derived"]
+        return out
 
     async def slo_status(self):
         r = await self._client.request({"op": "slo_status"})

@@ -28,6 +28,11 @@ class LogMonitor:
         self._out = out or sys.stdout
         self._offsets: Dict[str, int] = {}
         self._partial: Dict[str, bytes] = {}
+        # path -> (mtime_ns, size) at the last poll: an unchanged stat
+        # pair means nothing new to read, so the steady-state tick does
+        # ONE os.stat per file and no opens (previously every tick
+        # re-read bookkeeping for every file regardless of activity).
+        self._stats: Dict[str, tuple] = {}
         # path -> resolved pid string; a worker's pid never changes, so
         # one successful lookup is final (without this, every 200 ms poll
         # rescanned the whole worker table per log file —
@@ -88,10 +93,21 @@ class LogMonitor:
     def _poll_once(self) -> None:
         for path in glob.glob(os.path.join(self._dir, "worker-*.log")):
             try:
-                size = os.path.getsize(path)
+                st = os.stat(path)
             except OSError:
                 continue
+            stat_pair = (st.st_mtime_ns, st.st_size)
+            if self._stats.get(path) == stat_pair:
+                continue
+            self._stats[path] = stat_pair
+            size = st.st_size
             offset = self._offsets.get(path, 0)
+            if size < offset:
+                # Truncated/rotated in place: restart from the top (the
+                # old tail bytes are gone; a buffered partial line with
+                # them).
+                offset = self._offsets[path] = 0
+                self._partial.pop(path, None)
             if size <= offset:
                 continue
             try:

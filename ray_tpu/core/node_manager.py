@@ -66,6 +66,7 @@ from .resources import CPU, NodeResources, ResourceSet
 from .scheduling_policy import pick_node
 from .scheduling_strategies import PlacementGroupSchedulingStrategy
 from .task_spec import TaskSpec, TaskType, intern_spec
+from ..util import dispatch_obs, loop_monitor
 from ..util import events as cluster_events
 from ..util import faults
 from ..util.backoff import Backoff
@@ -554,6 +555,12 @@ class NodeManager:
         self._server = await asyncio.start_unix_server(
             self._handle_connection, path=self.socket_path
         )
+        # Loop-health watchdog + GIL probe: the NM loop is the node's
+        # control plane — a stall here stalls every worker frame.
+        loop_monitor.attach("nm", self._loop)
+        from ..util import profiler as _profiler
+
+        _profiler.start_gil_monitor()
         # JSON control channel for native (C/C++) clients (ref
         # analogue: the cpp/ worker API's core-worker channel).
         from .capi_server import CapiServer
@@ -1436,7 +1443,8 @@ class NodeManager:
             self._schedule()
             while True:
                 msg = await _read_frame(reader)
-                await self._dispatch_message(handle, msg)
+                await self._dispatch_message(handle, msg,
+                                             time.monotonic())
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
         finally:
@@ -1444,7 +1452,30 @@ class NodeManager:
                 await self._on_worker_death(handle)
             framed.close()
 
-    async def _dispatch_message(self, w: WorkerHandle, msg: Dict[str, Any]):
+    async def _dispatch_message(self, w: WorkerHandle, msg: Dict[str, Any],
+                                recv_ts: Optional[float] = None):
+        """Stage-clocked entry for every worker/client frame: queue-wait
+        is recv->here, the handler stage covers the branch body, and
+        branches that reply stamp handler_done via _send_reply so the
+        flush shows up as reply_send. Deferred branches hand their clock
+        to _bg_op and close it when the background handler finishes."""
+        clock = dispatch_obs.op_clock("nm", msg.get("type"), recv_ts)
+        if clock is not None:
+            clock.start()
+        try:
+            await self._dispatch_message_op(w, msg, clock)
+        finally:
+            if clock is not None and not clock.deferred:
+                clock.done()
+
+    async def _send_reply(self, clock, w: WorkerHandle,
+                          payload: Dict[str, Any]):
+        if clock is not None:
+            clock.handler_done()
+        await w.writer.send(payload)
+
+    async def _dispatch_message_op(self, w: WorkerHandle,
+                                   msg: Dict[str, Any], clock=None):
         mtype = msg["type"]
         w.last_active = time.monotonic()
         if mtype == "task_done":
@@ -1473,13 +1504,13 @@ class NodeManager:
                         self._recent_client_submits.popitem(last=False)
                 await self.submit_task(spec)
             if acked:
-                await w.writer.send({
+                await self._send_reply(clock, w, {
                     "type": "reply", "msg_id": msg["msg_id"], "ok": True,
                 })
         elif mtype == "get_locations":
-            asyncio.ensure_future(self._reply_locations(w, msg))
+            self._bg_op(clock, self._reply_locations(w, msg))
         elif mtype == "wait":
-            asyncio.ensure_future(self._reply_wait(w, msg))
+            self._bg_op(clock, self._reply_wait(w, msg))
         elif mtype == "put":
             await self.put_object(
                 msg["object_id"], msg["loc"], msg.get("refs", 1),
@@ -1493,7 +1524,8 @@ class NodeManager:
             for oid, count in msg["counts"].items():
                 self._remove_ref(oid, count)
         elif mtype == "fetch_function":
-            await w.writer.send(
+            await self._send_reply(
+                clock, w,
                 {
                     "type": "reply",
                     "msg_id": msg["msg_id"],
@@ -1512,9 +1544,9 @@ class NodeManager:
             await self._handle_kv(w, msg)
         elif mtype == "pubsub":
             # Long-polls block; never hold up the worker's message loop.
-            asyncio.ensure_future(self._handle_pubsub(w, msg))
+            self._bg_op(clock, self._handle_pubsub(w, msg))
         elif mtype == "pg":
-            asyncio.ensure_future(self._handle_pg(w, msg))
+            self._bg_op(clock, self._handle_pg(w, msg))
         elif mtype == "actor_direct":
             if w.actor_id is not None:
                 info = self._actors.get(w.actor_id)
@@ -1526,7 +1558,7 @@ class NodeManager:
         elif mtype == "get_actor_direct":
             # Endpoint resolution long-polls the actor's drain window;
             # never inline it on this worker's message loop.
-            asyncio.ensure_future(self._reply_actor_direct(w, msg))
+            self._bg_op(clock, self._reply_actor_direct(w, msg))
         elif mtype == "direct_side":
             # Caller-side bookkeeping for direct calls (the worker/client
             # mirror of the driver's dpost drain): return-slot
@@ -1553,22 +1585,24 @@ class NodeManager:
             await self.cancel_task(msg["task_id"], msg.get("force", False))
         elif mtype == "get_named_actor":
             spec = await self.get_named_actor(msg["name"])
-            await w.writer.send(
+            await self._send_reply(
+                clock, w,
                 {"type": "reply", "msg_id": msg["msg_id"], "spec": spec}
             )
         elif mtype == "state":
             state = await self.cluster_state()
-            await w.writer.send(
+            await self._send_reply(
+                clock, w,
                 {"type": "reply", "msg_id": msg["msg_id"], "state": state}
             )
         elif mtype == "events":
             # Head-store query; the long-path RPC must not stall this
             # worker's message loop.
-            asyncio.ensure_future(self._handle_events_query(w, msg))
+            self._bg_op(clock, self._handle_events_query(w, msg))
         elif mtype == "timeseries":
-            asyncio.ensure_future(self._handle_timeseries_query(w, msg))
+            self._bg_op(clock, self._handle_timeseries_query(w, msg))
         elif mtype == "slo":
-            asyncio.ensure_future(self._handle_slo_query(w, msg))
+            self._bg_op(clock, self._handle_slo_query(w, msg))
         elif mtype in ("stack_reply", "profile_reply"):
             # A worker answering our stack_dump/profile fan-out.
             fut = self._profile_pending.pop(msg.get("req_id"), None)
@@ -1577,7 +1611,7 @@ class NodeManager:
         elif mtype == "profile":
             # Cluster stacks/profile query from a worker or thin client;
             # the fan-out blocks on timeouts, so never inline it here.
-            asyncio.ensure_future(self._handle_profile_query(w, msg))
+            self._bg_op(clock, self._handle_profile_query(w, msg))
         elif mtype == "pull_object":
             # Client-mode read rides the SAME chunked, admission-
             # controlled transfer plane nodes use (small objects answer
@@ -1585,11 +1619,11 @@ class NodeManager:
             # no event-loop-sized pickles).
             reply = await self._transfer.serve_pull(msg)
             reply.update({"type": "reply", "msg_id": msg["msg_id"]})
-            await w.writer.send(reply)
+            await self._send_reply(clock, w, reply)
         elif mtype == "pull_chunk":
             reply = await self._transfer.serve_chunk(msg)
             reply.update({"type": "reply", "msg_id": msg["msg_id"]})
-            await w.writer.send(reply)
+            await self._send_reply(clock, w, reply)
         elif mtype == "put_begin":
             # Client-mode put: a chunked writer into THIS node's store.
             try:
@@ -1603,7 +1637,7 @@ class NodeManager:
             except Exception as e:  # rtlint: disable=swallowed-failure
                 reply = {"ok": False, "error": str(e)}
             reply.update({"type": "reply", "msg_id": msg["msg_id"]})
-            await w.writer.send(reply)
+            await self._send_reply(clock, w, reply)
         elif mtype == "put_chunk":
             writer = w.client_writers.get(msg["object_id"])
             try:
@@ -1617,7 +1651,7 @@ class NodeManager:
             except Exception as e:  # rtlint: disable=swallowed-failure
                 reply = {"ok": False, "error": str(e)}
             reply.update({"type": "reply", "msg_id": msg["msg_id"]})
-            await w.writer.send(reply)
+            await self._send_reply(clock, w, reply)
         elif mtype == "put_abort":
             # Client-side failure mid-put: free the reserved block now
             # instead of holding it until the connection drops.
@@ -1627,7 +1661,8 @@ class NodeManager:
                     await self._loop.run_in_executor(None, writer.abort)
                 except Exception:
                     pass
-            await w.writer.send(
+            await self._send_reply(
+                clock, w,
                 {"type": "reply", "msg_id": msg["msg_id"], "ok": True}
             )
         elif mtype == "put_end":
@@ -1655,9 +1690,10 @@ class NodeManager:
                         pass
                 reply = {"loc": None, "error": str(e)}
             reply.update({"type": "reply", "msg_id": msg["msg_id"]})
-            await w.writer.send(reply)
+            await self._send_reply(clock, w, reply)
         elif mtype == "ping":
-            await w.writer.send({"type": "reply", "msg_id": msg["msg_id"]})
+            await self._send_reply(
+                clock, w, {"type": "reply", "msg_id": msg["msg_id"]})
         else:
             raise RuntimeError(f"unknown message type {mtype}")
 
@@ -1780,6 +1816,24 @@ class NodeManager:
         task.add_done_callback(self._bg_tasks.discard)
         return task
 
+    def _bg_op(self, clock, coro) -> asyncio.Task:
+        """ensure_future for a deferred frame op, keeping its stage
+        clock honest: the clock re-stamps start when the background
+        handler actually runs (so loop scheduling delay lands in
+        queue_wait, not handler) and closes when it finishes."""
+        if clock is None:
+            return asyncio.ensure_future(coro)
+        clock.deferred = True
+
+        async def _run():
+            clock.start()
+            try:
+                await coro
+            finally:
+                clock.done()
+
+        return asyncio.ensure_future(_run())
+
     # ------------------------------------------------------------ peer plane
 
     async def _handle_peer_connection(self, reader, writer):
@@ -1817,6 +1871,9 @@ class NodeManager:
                     # keep feeding us stale results/locates).
                     _fencing.EVENT_PEER_REFUSED.inc()
                     break
+                recv_ts = time.monotonic()
+                clock = dispatch_obs.op_clock("peer", msg.get("type"),
+                                              recv_ts)
                 if msg.get("type") in ("stacks_dump", "profile_run",
                                        "traces_dump",
                                        "get_actor_direct_peer",
@@ -1826,21 +1883,31 @@ class NodeManager:
                     # profile or a direct-endpoint drain wait would stall
                     # every state_snapshot/pg frame behind it); replies
                     # match by msg_id, so order doesn't matter.
-                    asyncio.ensure_future(self._peer_reply_async(
-                        peer_hex, msg, framed
+                    self._bg_op(clock, self._peer_reply_async(
+                        peer_hex, msg, framed, clock
                     ))
                     continue
-                reply = await self._dispatch_peer(peer_hex, msg)
-                if reply is not None:
-                    reply["type"] = "reply"
-                    reply["msg_id"] = msg.get("msg_id")
-                    await framed.send(reply)
+                if clock is not None:
+                    clock.start()
+                try:
+                    reply = await self._dispatch_peer(peer_hex, msg,
+                                                      clock)
+                    if reply is not None:
+                        if clock is not None:
+                            clock.handler_done()
+                        reply["type"] = "reply"
+                        reply["msg_id"] = msg.get("msg_id")
+                        await framed.send(reply)
+                finally:
+                    if clock is not None:
+                        clock.done()
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
         finally:
             framed.close()
 
-    async def _peer_reply_async(self, peer_hex: str, msg, framed):
+    async def _peer_reply_async(self, peer_hex: str, msg, framed,
+                                clock=None):
         """Dispatch a slow peer request off the channel's read loop and
         ship the reply when it completes."""
         try:
@@ -1850,6 +1917,8 @@ class NodeManager:
             reply = {"error": str(e)}
         if reply is None:
             return
+        if clock is not None:
+            clock.handler_done()
         reply["type"] = "reply"
         reply["msg_id"] = msg.get("msg_id")
         try:
@@ -1872,7 +1941,8 @@ class NodeManager:
             )
             while True:
                 msg = await aio_read_frame(reader)
-                await self._dispatch_message(handle, msg)
+                await self._dispatch_message(handle, msg,
+                                             time.monotonic())
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 OSError):
             pass
@@ -1882,7 +1952,7 @@ class NodeManager:
             framed.close()
 
     async def _dispatch_peer(
-        self, peer_hex: str, msg: Dict[str, Any]
+        self, peer_hex: str, msg: Dict[str, Any], clock=None
     ) -> Optional[Dict[str, Any]]:
         mtype = msg["type"]
         if mtype == "forward_task":
@@ -1898,7 +1968,7 @@ class NodeManager:
             # never the whole shared peer channel.
             try:
                 return await self._transfer.rpc.dispatch(
-                    peer_hex, mtype, msg
+                    peer_hex, mtype, msg, clock=clock
                 )
             except RpcError as e:
                 return {"data": None, "error": str(e)}
@@ -4725,6 +4795,8 @@ class NodeManager:
             out.update(await self._timeseries_query(
                 name=msg.get("name", ""), tags=msg.get("tags"),
                 since=msg.get("since", 0.0), limit=msg.get("limit", 0),
+                quantile=msg.get("quantile", 0.0),
+                window=msg.get("window", 60.0),
             ))
         # Reply-carried: timeseries_query raises it caller-side.
         except Exception as e:  # rtlint: disable=swallowed-failure
@@ -4747,13 +4819,16 @@ class NodeManager:
             pass  # dead requester needs no reply
 
     async def _timeseries_query(self, name="", tags=None, since=0.0,
-                                limit: int = 0) -> Dict[str, Any]:
+                                limit: int = 0, quantile: float = 0.0,
+                                window: float = 60.0) -> Dict[str, Any]:
         """Query the head TSDB (ref analogue: the dashboard hitting the
-        metrics head)."""
+        metrics head). ``quantile`` > 0 adds a head-derived histogram
+        quantile over the trailing ``window`` seconds."""
         if self._gcs is None:
             raise RuntimeError("timeseries require the cluster GCS")
         return await self._gcs.timeseries_query(
-            name=name, tags=tags, since=since, limit=limit
+            name=name, tags=tags, since=since, limit=limit,
+            quantile=quantile, window=window
         )
 
     async def _slo_status(self) -> Dict[str, Any]:
@@ -5562,6 +5637,9 @@ class NodeManager:
                 except Exception:
                     pass
 
+        # Cancel the watchdog tick while the loop still runs, so a
+        # closed loop never holds a stale callback.
+        loop_monitor.detach("nm")
         try:
             self._call(_stop()).result(timeout=5)
         except Exception:

@@ -184,17 +184,26 @@ class ServiceRegistry:
         entry = self._methods.get(op)
         return entry[1] if entry else None
 
-    async def dispatch(self, ctx: Any, op: str,
-                       msg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    async def dispatch(self, ctx: Any, op: str, msg: Dict[str, Any],
+                       clock: Any = None) -> Optional[Dict[str, Any]]:
         """Validate ``msg`` against the method's COMPILED request
         validator and call the pre-bound handler as
         ``handler(ctx, **fields)``. Returns the reply dict (None for
-        notify methods)."""
+        notify methods). ``clock`` is an optional
+        util/dispatch_obs.OpClock: handler start/end are stamped here
+        (validation counts as handler work); the caller owning the
+        reply frame closes it."""
         entry = self._methods.get(op)
         if entry is None:
             raise RpcError(f"unknown rpc method {op!r}")
         _, method, _, handler = entry
-        result = await handler(ctx, **method.validate_request(msg))
+        if clock is not None:
+            clock.start()
+        try:
+            result = await handler(ctx, **method.validate_request(msg))
+        finally:
+            if clock is not None:
+                clock.handler_done()
         if method.notify:
             return None
         return result if result is not None else {}

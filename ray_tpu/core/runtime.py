@@ -1829,13 +1829,16 @@ class DriverRuntime(BaseRuntime):
         )
 
     def timeseries_query(self, name: str = "", tags=None,
-                         since: float = 0.0,
-                         limit: int = 0) -> Dict[str, Any]:
+                         since: float = 0.0, limit: int = 0,
+                         quantile: float = 0.0,
+                         window: float = 60.0) -> Dict[str, Any]:
         """Head TSDB query (backing for /api/timeseries, `rtpu top`,
-        `rtpu slo`). Empty name lists series names + store stats."""
+        `rtpu slo`, `rtpu rpc`). Empty name lists series names + store
+        stats; quantile > 0 adds a head-derived histogram quantile."""
         return self._nm.call_sync(
             self._nm._timeseries_query(name=name, tags=tags,
-                                       since=since, limit=limit)
+                                       since=since, limit=limit,
+                                       quantile=quantile, window=window)
         )
 
     def slo_status(self) -> Dict[str, Any]:
@@ -2185,17 +2188,22 @@ class WorkerRuntime(BaseRuntime):
                 "dropped": reply["dropped"]}
 
     def timeseries_query(self, name: str = "", tags=None,
-                         since: float = 0.0,
-                         limit: int = 0) -> Dict[str, Any]:
+                         since: float = 0.0, limit: int = 0,
+                         quantile: float = 0.0,
+                         window: float = 60.0) -> Dict[str, Any]:
         reply = self.request(
             {"type": "timeseries", "name": name, "tags": tags,
-             "since": since, "limit": limit},
+             "since": since, "limit": limit, "quantile": quantile,
+             "window": window},
             timeout=30.0,
         )
         if reply.get("error"):
             raise RuntimeError(reply["error"])
-        return {"series": reply["series"], "names": reply["names"],
-                "stats": reply["stats"]}
+        out = {"series": reply["series"], "names": reply["names"],
+               "stats": reply["stats"]}
+        if reply.get("derived") is not None:
+            out["derived"] = reply["derived"]
+        return out
 
     def slo_status(self) -> Dict[str, Any]:
         reply = self.request({"type": "slo"}, timeout=30.0)

@@ -132,6 +132,12 @@ class Worker:
             worker_id=self.worker_id,
         )
         runtime_context.set_runtime(self.runtime)
+        # GIL-contention proxy: workers run user code, so their
+        # ray_tpu_gil_wait_ratio{pid} series is where a CPU-bound task
+        # holding the GIL shows up.
+        from ..util import profiler
+
+        profiler.start_gil_monitor()
         # Flush buffered dones before any blocking runtime request: a
         # nested get could otherwise wait on an object whose seal is
         # sitting in our own outbound buffer (deadlock).

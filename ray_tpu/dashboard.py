@@ -345,6 +345,42 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 self._json(rt.slo_status())
                 return
+            if path == "/api/dispatch":
+                # Control-plane dispatch health: the raw series behind
+                # `rtpu rpc` — per-op stage histograms, backlog/
+                # inflight gauges, loop lag, GIL ratio. ?window=60
+                # controls the p99 derivation window.
+                from urllib.parse import parse_qs, urlparse
+
+                from .core import runtime_context
+
+                rt = runtime_context.current_runtime_or_none()
+                if rt is None or not hasattr(rt, "timeseries_query"):
+                    self._json({"error": "no runtime attached"}, 503)
+                    return
+                q = parse_qs(urlparse(self.path).query)
+                window = float((q.get("window") or ["60"])[0])
+                payload = {}
+                for key, name in (
+                        ("rpc", "ray_tpu_rpc_server_seconds"),
+                        ("backlog", "ray_tpu_rpc_backlog"),
+                        ("inflight", "ray_tpu_rpc_inflight"),
+                        ("loop_lag", "ray_tpu_event_loop_lag_seconds"),
+                        ("gil", "ray_tpu_gil_wait_ratio")):
+                    try:
+                        payload[key] = rt.timeseries_query(
+                            name=name)["series"]
+                    except Exception:  # noqa: BLE001
+                        payload[key] = []
+                try:
+                    payload["p99"] = rt.timeseries_query(
+                        name="ray_tpu_rpc_server_seconds",
+                        tags={"stage": "handler"},
+                        quantile=0.99, window=window).get("derived")
+                except Exception:  # noqa: BLE001
+                    payload["p99"] = None
+                self._json(payload)
+                return
             if path == "/api/devices":
                 # Device telemetry: this process's live JAX device
                 # snapshot + every worker's published ray_tpu_device_*

@@ -646,7 +646,7 @@ def cmd_trace(args) -> int:
         reason = None
         for flag, value in (("slow", "slow"), ("errors", "error"),
                             ("shed", "shed"), ("expired", "expired"),
-                            ("chaos", "chaos")):
+                            ("chaos", "chaos"), ("slow_ops", "slow_op")):
             if getattr(args, flag, False):
                 reason = value
         rows = flight_recorder.list_cluster(reason=reason,
@@ -892,6 +892,43 @@ def _render_top(rt, window_s: float) -> None:
         print(f"\ndispatch: {inc / span:.1f} actor-call ops/s "
               f"(last {int(window_s)}s)")
 
+    # Control plane: per-service frame-dispatch rate + backlog, and
+    # event-loop health (`rtpu rpc` breaks this down per op).
+    svc_rate = {}
+    for svc, series in _ts_group(
+            query("ray_tpu_rpc_server_seconds"), "service").items():
+        inc = span = 0.0
+        for s in series:
+            tags = dict(tuple(kv) for kv in s.get("tags", []))
+            if tags.get("stage") != "handler":
+                continue
+            i, sp = _ts_increase(s["samples"], window_s)
+            inc += i
+            span = max(span, sp)
+        if span:
+            svc_rate[svc] = inc / span
+    if svc_rate:
+        backlog = {svc: (series[-1]["samples"][-1][1]
+                         if series and series[-1]["samples"] else 0.0)
+                   for svc, series in _ts_group(
+                       query("ray_tpu_rpc_backlog"), "service").items()}
+        print("control plane: " + "  ".join(
+            f"{svc}={rate:.0f} ops/s"
+            + (f" (backlog {backlog[svc]:.0f})"
+               if backlog.get(svc) else "")
+            for svc, rate in sorted(svc_rate.items())))
+    lag_bits = []
+    for loop_name, series in sorted(_ts_group(
+            query("ray_tpu_event_loop_lag_seconds"), "loop").items()):
+        worst = max((s["samples"][-1][1] for s in series
+                     if s["samples"]), default=0.0)
+        lag_bits.append(f"{loop_name} {worst * 1e3:.1f}ms")
+    gil = [s["samples"][-1][1]
+           for s in query("ray_tpu_gil_wait_ratio") if s["samples"]]
+    if lag_bits or gil:
+        gil_s = (f"   gil wait ratio max {max(gil):.2f}" if gil else "")
+        print("loops: " + ", ".join(lag_bits) + gil_s)
+
 
 def cmd_top(args) -> int:
     """Live refreshing cluster view (ref: `ray status` + the dashboard
@@ -905,6 +942,129 @@ def cmd_top(args) -> int:
         interval = None if getattr(args, "once", False) else args.interval
         return _watch_loop(
             lambda: _render_top(rt, float(args.window)), interval)
+    finally:
+        ray_tpu.shutdown()
+
+
+def _render_rpc(rt, window_s: float, top_n: int,
+                as_json: bool = False) -> None:
+    """Per-op control-plane dispatch table from the head TSDB: qps +
+    per-stage means client-side from the raw count/sum rows, p50/p99
+    head-derived from the merged bucket deltas (buckets never leave
+    the head)."""
+    try:
+        series = rt.timeseries_query(
+            name="ray_tpu_rpc_server_seconds")["series"]
+    except Exception as e:
+        print(f"rpc stats unavailable: {e}")
+        return
+    by_op: dict = {}
+    for s in series:
+        tags = dict(tuple(kv) for kv in s.get("tags", []))
+        key = (tags.get("service", ""), tags.get("op", ""))
+        by_op.setdefault(key, {}).setdefault(
+            tags.get("stage", ""), []).append(s)
+    rows = []
+    for (service, op), stages in by_op.items():
+        row = {"service": service, "op": op, "qps": 0.0}
+        for stage in ("queue_wait", "handler", "reply_send"):
+            inc = sum_inc = span = 0.0
+            for s in stages.get(stage, ()):
+                i, sp = _ts_increase(s["samples"], window_s, idx=1)
+                si, _ = _ts_increase(s["samples"], window_s, idx=2)
+                inc += i
+                sum_inc += si
+                span = max(span, sp)
+            row[stage + "_ms"] = (sum_inc / inc * 1e3) if inc else 0.0
+            if stage == "handler" and span:
+                row["qps"] = inc / span
+                row["calls"] = inc
+        rows.append(row)
+    rows.sort(key=lambda r: -r["qps"])
+    if top_n and top_n > 0:
+        rows = rows[:top_n]
+    # Quantiles only for the displayed rows (one derivation RPC per op).
+    for row in rows:
+        for q, key in ((0.5, "p50_ms"), (0.99, "p99_ms")):
+            row[key] = None
+            try:
+                d = rt.timeseries_query(
+                    name="ray_tpu_rpc_server_seconds",
+                    tags={"service": row["service"], "op": row["op"],
+                          "stage": "handler"},
+                    quantile=q, window=window_s).get("derived") or {}
+                if d.get("quantile") is not None:
+                    row[key] = d["quantile"] * 1e3
+            except Exception:
+                pass
+
+    def latest_by(name, key):
+        try:
+            got = rt.timeseries_query(name=name)["series"]
+        except Exception:
+            return {}
+        out = {}
+        for s in got:
+            tags = dict(tuple(kv) for kv in s.get("tags", []))
+            if s["samples"]:
+                k = tags.get(key, "")
+                out[k] = max(out.get(k, 0.0), s["samples"][-1][1])
+        return out
+
+    backlog = latest_by("ray_tpu_rpc_backlog", "service")
+    inflight = latest_by("ray_tpu_rpc_inflight", "service")
+    lag = latest_by("ray_tpu_event_loop_lag_seconds", "loop")
+    gil = latest_by("ray_tpu_gil_wait_ratio", "pid")
+    if as_json:
+        print(json.dumps({"ops": rows, "backlog": backlog,
+                          "inflight": inflight, "loop_lag_s": lag,
+                          "gil_wait_ratio": gil},
+                         indent=2, sort_keys=True))
+        return
+    print(f"rtpu rpc — {time.strftime('%H:%M:%S')}   window "
+          f"{int(window_s)}s")
+    if not rows:
+        print("no control-plane ops recorded yet")
+    else:
+        print(f"\n{'SERVICE':8} {'OP':22} {'QPS':>8} {'p50(ms)':>8} "
+              f"{'p99(ms)':>8} {'q-wait':>7} {'handler':>8} "
+              f"{'reply':>6}")
+        for r in rows:
+            p50 = f"{r['p50_ms']:>8.2f}" if r.get("p50_ms") is not None \
+                else f"{'-':>8}"
+            p99 = f"{r['p99_ms']:>8.2f}" if r.get("p99_ms") is not None \
+                else f"{'-':>8}"
+            print(f"{r['service'][:8]:8} {r['op'][:22]:22} "
+                  f"{r['qps']:>8.1f} {p50} {p99} "
+                  f"{r['queue_wait_ms']:>7.2f} {r['handler_ms']:>8.2f} "
+                  f"{r['reply_send_ms']:>6.2f}")
+    if backlog or inflight:
+        print("\nbacklog:  " + "  ".join(
+            f"{svc}={int(v)}" for svc, v in sorted(backlog.items()))
+            + "   inflight:  " + "  ".join(
+            f"{svc}={int(v)}" for svc, v in sorted(inflight.items())))
+    if lag:
+        print("loop lag: " + "  ".join(
+            f"{name}={v * 1e3:.1f}ms" for name, v in sorted(lag.items())))
+    if gil:
+        print("gil wait: " + "  ".join(
+            f"pid {pid}={v:.2f}" for pid, v in sorted(gil.items())))
+
+
+def cmd_rpc(args) -> int:
+    """Control-plane dispatch stats: per-op qps + stage latency
+    breakdown (queue-wait/handler/reply-send) from the
+    ``ray_tpu_rpc_server_seconds`` histograms, plus backlog/inflight
+    gauges, event-loop lag, and the GIL-contention proxy."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.core import runtime_context
+
+        rt = runtime_context.current_runtime()
+        return _watch_loop(
+            lambda: _render_rpc(rt, float(args.window), args.top,
+                                as_json=getattr(args, "json", False)),
+            getattr(args, "watch", None))
     finally:
         ray_tpu.shutdown()
 
@@ -1357,6 +1517,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_address(p)
     p.set_defaults(fn=cmd_top)
 
+    p = sub.add_parser("rpc",
+                       help="control-plane dispatch stats: per-op "
+                            "qps/p50/p99 + stage breakdown, backlog, "
+                            "loop lag, GIL ratio")
+    p.add_argument("--top", type=int, default=15, metavar="N",
+                   help="show the N busiest ops (default 15)")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="trailing window for rates/quantiles (seconds)")
+    p.add_argument("--watch", type=float, default=None, metavar="N",
+                   help="refresh every N seconds (^C exits)")
+    p.add_argument("--json", action="store_true")
+    _add_address(p)
+    p.set_defaults(fn=cmd_rpc)
+
     p = sub.add_parser("slo",
                        help="per-deployment SLO status: goodput, "
                             "error-budget burn rates, alert state")
@@ -1396,6 +1570,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="only deadline-expired requests")
     p.add_argument("--chaos", action="store_true",
                    help="only chaos-hit records")
+    p.add_argument("--slow-ops", action="store_true",
+                   help="only control-plane ops slower than "
+                        "rpc_slow_op_s (NM/GCS dispatch stalls)")
     p.add_argument("--limit", type=int, default=100)
     p.add_argument("--json", action="store_true")
     _add_address(p)

@@ -26,6 +26,11 @@ class ASGIReplica:
         self._loop = asyncio.new_event_loop()
         t = threading.Thread(target=self._loop.run_forever, daemon=True)
         t.start()
+        # Lag watchdog: a blocking route handler stalls every in-flight
+        # request multiplexed onto this replica's loop.
+        from ..util import loop_monitor
+
+        loop_monitor.attach("serve_asgi", self._loop)
 
     @staticmethod
     def _resolve_app(obj):

@@ -1,0 +1,250 @@
+"""Control-plane dispatch instrumentation (ref analogue: the
+event_stats_ per-handler timers gRPC servers keep in `grpc_server.h` /
+`core_worker.cc`).
+
+Every NM/GCS frame op is clocked through three stages:
+
+  queue-wait   frame recv -> handler start (time spent behind other
+               frames / waiting for a loop slot; deferred ops fold
+               their ensure_future scheduling delay in here too)
+  handler      handler start -> handler return
+  reply-send   handler return -> reply frame flushed (replying ops only)
+
+into ``ray_tpu_rpc_server_seconds{service,op,stage}`` histograms, with
+``ray_tpu_rpc_inflight{service}`` (ops whose handler has started but not
+finished) and ``ray_tpu_rpc_backlog{service}`` (received but not yet
+started — the queue the 29 ms loaded p99 hides in). Handler-stage
+observations carry the active trace id as an OpenMetrics exemplar, and
+any op whose total recv->done time exceeds ``rpc_slow_op_s`` drops a
+``span_event`` marker plus a flight-recorder record (reason "slow_op")
+so ``rtpu trace --slow-ops`` joins control-plane stalls to waterfalls.
+
+The whole plane is a single in-process kill switch away:
+``RTPU_NO_DISPATCH_OBS=1`` makes ``op_clock`` return None and every
+caller degrades to zero-overhead no-ops (the bench's ``obs_overhead``
+row measures exactly this delta).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+from .metrics import Gauge, Histogram
+
+# Kill switch, read once at import: the bench flips it per-session via a
+# fresh interpreter, so a cached check is both correct and free.
+ENABLED = os.environ.get("RTPU_NO_DISPATCH_OBS", "") not in ("1", "true")
+
+STAGES = ("queue_wait", "handler", "reply_send")
+
+# Control-plane ops live in the 100 us .. tens-of-ms band; the upper
+# buckets exist so a stalled loop is still representable.
+_BOUNDARIES = [0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+               0.05, 0.1, 0.25, 0.5, 1.0, 2.5]
+
+SERVER_SECONDS = Histogram(
+    "ray_tpu_rpc_server_seconds",
+    "Server-side control-plane dispatch stage latency "
+    "(stage=queue_wait|handler|reply_send, service=nm|gcs|peer).",
+    boundaries=_BOUNDARIES,
+    tag_keys=("service", "op", "stage"),
+)
+INFLIGHT = Gauge(
+    "ray_tpu_rpc_inflight",
+    "Control-plane ops whose handler is currently executing, per "
+    "service.",
+    tag_keys=("service",),
+)
+BACKLOG = Gauge(
+    "ray_tpu_rpc_backlog",
+    "Control-plane ops received but not yet started (queued behind the "
+    "event loop), per service.",
+    tag_keys=("service",),
+)
+
+# Bound-handle caches: with_tags resolves the tag-key tuple once; the
+# dispatch hot path then only does a dict lookup per stage.
+_stage_handles: Dict[Tuple[str, str], tuple] = {}
+_service_gauges: Dict[str, tuple] = {}
+# service -> [inflight, backlog, last_publish_ts, pub_inflight,
+# pub_backlog]. The int pair is authoritative; the gauge publishes are
+# throttled (every registry set takes the metrics lock — at 4 sets per
+# op that was a measurable slice of the dispatch hot path).
+_counts: Dict[str, list] = {}
+_GAUGE_MIN_INTERVAL_S = 0.05
+
+
+def _handles(service: str, op: str) -> tuple:
+    key = (service, op)
+    h = _stage_handles.get(key)
+    if h is None:
+        h = tuple(SERVER_SECONDS.with_tags(service=service, op=op,
+                                           stage=s) for s in STAGES)
+        _stage_handles[key] = h
+    return h
+
+
+def _gauges(service: str) -> tuple:
+    g = _service_gauges.get(service)
+    if g is None:
+        g = (INFLIGHT.with_tags(service=service),
+             BACKLOG.with_tags(service=service))
+        _service_gauges[service] = g
+        _counts[service] = [0, 0, 0.0, 0, 0]
+    return g
+
+
+def _publish(service: str, now: float) -> None:
+    """Throttled gauge publish: push when the window elapsed, or when
+    the counts differ from the published pair AND are back to zero (so
+    an idle plane never shows a stale nonzero backlog). The TSDB only
+    samples every flush interval anyway — intermediate flickers carry
+    no information."""
+    c = _counts[service]
+    changed = c[0] != c[3] or c[1] != c[4]
+    if not changed:
+        return
+    if now - c[2] < _GAUGE_MIN_INTERVAL_S and (c[0] or c[1]):
+        return
+    c[2] = now
+    c[3], c[4] = c[0], c[1]
+    inflight, backlog = _service_gauges[service]
+    inflight.set(float(c[0]))
+    backlog.set(float(c[1]))
+
+
+# Lazily-bound collaborators (resolved once, then plain globals on the
+# hot path). NOTE: core/__init__ re-exports a timeline() API function
+# that shadows the module on attribute access — bind from the module.
+_current_span = None
+_span_event = None
+_get_config = None
+
+
+def _resolve_lazy() -> None:
+    global _current_span, _span_event, _get_config
+    from ..core.config import get_config
+    from ..core.timeline import current_span, span_event
+    _current_span, _span_event = current_span, span_event
+    _get_config = get_config
+
+
+class OpClock:
+    """One frame op's stage clock. Lifecycle: construct at frame recv
+    (op enters the backlog) -> ``start()`` when the handler begins (may
+    be re-stamped by a deferred wrapper; only the last stamp counts) ->
+    ``handler_done()`` when the handler returns -> ``done()`` after the
+    reply (if any) is flushed. Never raises into the dispatch path."""
+
+    __slots__ = ("service", "op", "recv_ts", "deferred",
+                 "_t_start", "_t_handler", "_closed")
+
+    def __init__(self, service: str, op: str, recv_ts: Optional[float]):
+        self.service = service
+        self.op = op or "?"
+        self.recv_ts = recv_ts if recv_ts is not None else time.monotonic()
+        # Set by the NM when it hands the op to ensure_future: tells the
+        # inline dispatch path NOT to close the clock — the wrapped
+        # coroutine owns it from then on.
+        self.deferred = False
+        self._t_start: Optional[float] = None
+        self._t_handler: Optional[float] = None
+        self._closed = False
+        _gauges(service)
+        _counts[service][1] += 1
+        _publish(service, self.recv_ts)
+
+    def start(self) -> None:
+        first = self._t_start is None
+        self._t_start = time.monotonic()
+        if first:
+            c = _counts[self.service]
+            c[1] -= 1
+            c[0] += 1
+            _publish(self.service, self._t_start)
+
+    def handler_done(self) -> None:
+        self._t_handler = time.monotonic()
+
+    def done(self, replied: Optional[bool] = None,
+             trace_id: Optional[str] = None) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if replied is None:
+            # Default heuristic for frame loops that only stamp
+            # handler_done() right before flushing a reply frame (the
+            # NM's inline branches): an explicit stamp means a reply
+            # followed.
+            replied = self._t_handler is not None
+        end = time.monotonic()
+        t_start = self._t_start if self._t_start is not None else end
+        t_handler = self._t_handler if self._t_handler is not None else end
+        c = _counts[self.service]
+        if self._t_start is None:
+            # Never started (e.g. connection died while queued): the op
+            # leaves the backlog, not the inflight count.
+            c[1] -= 1
+        else:
+            c[0] -= 1
+        _publish(self.service, end)
+        try:
+            if _current_span is None:
+                _resolve_lazy()
+            if trace_id is None:
+                span = _current_span()
+                if span is not None:
+                    trace_id = span[0]
+            qh, hh, rh = _handles(self.service, self.op)
+            qh.observe(max(0.0, t_start - self.recv_ts))
+            hh.observe(max(0.0, t_handler - t_start), exemplar=trace_id)
+            if replied:
+                rh.observe(max(0.0, end - t_handler))
+            total = end - self.recv_ts
+            slow = _slow_op_s()
+            if slow > 0 and total > slow:
+                name = f"{self.service}.{self.op}"
+                _span_event(f"slow_op:{name}")
+                from . import flight_recorder
+                flight_recorder.observe_request(
+                    name, trace_id or "", end - total, end,
+                    status="slow", reason="slow_op",
+                    detail=(f"queue_wait={t_start - self.recv_ts:.4f}s "
+                            f"handler={t_handler - t_start:.4f}s"),
+                    surface="rpc")
+        except Exception:  # pragma: no cover - telemetry must not break ops
+            pass
+
+
+# (config object, value): get_config() returns the same object for a
+# session, so an identity hit skips the float/attr work per op.
+_slow_conf: tuple = (None, 0.0)
+
+
+def _slow_op_s() -> float:
+    global _slow_conf
+    try:
+        if _get_config is None:
+            _resolve_lazy()
+        cfg = _get_config()
+        cached = _slow_conf
+        if cached[0] is cfg:
+            return cached[1]
+        v = float(cfg.rpc_slow_op_s)
+        _slow_conf = (cfg, v)
+        return v
+    except Exception:  # pragma: no cover
+        return 0.0
+
+
+def op_clock(service: str, op: str,
+             recv_ts: Optional[float] = None) -> Optional[OpClock]:
+    """Clock for one received frame op, or None when the plane is
+    disabled (callers treat a None clock as a full no-op)."""
+    if not ENABLED:
+        return None
+    try:
+        return OpClock(service, op, recv_ts)
+    except Exception:  # pragma: no cover - telemetry must not break ops
+        return None

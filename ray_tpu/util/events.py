@@ -63,8 +63,13 @@ NODE = "NODE"
 # burn-rate alert transitions — WARNING on crossing, INFO on clearing,
 # deduped while the condition persists.
 SLO = "SLO"
+# Process self-health (util/loop_monitor.py watchdogs): event-loop
+# stalls — WARNING when a loop's watchdog tick is overdue past
+# loop_stall_warn_s, deduped per stall episode, payload carries the
+# stalled thread's stack and the running asyncio task name.
+SYSTEM = "SYSTEM"
 SOURCES = (GCS, RAYLET, WORKER, TASK, ACTOR, OBJECT_STORE, AUTOSCALER,
-           SERVE, JOB, CHAOS, TRAIN, NODE, SLO)
+           SERVE, JOB, CHAOS, TRAIN, NODE, SLO, SYSTEM)
 
 FLUSH_INTERVAL_S = 0.25
 

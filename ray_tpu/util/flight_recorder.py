@@ -34,8 +34,9 @@ KV_PREFIX = "__flightrec__/"
 FLUSH_INTERVAL_S = 1.0
 
 # Retention reasons, in severity order for display. "slow" is decided by
-# the rolling threshold; the rest are asserted by the observing surface.
-REASONS = ("chaos", "error", "expired", "shed", "slow")
+# the rolling threshold; "slow_op" is a control-plane op that exceeded
+# rpc_slow_op_s; the rest are asserted by the observing surface.
+REASONS = ("chaos", "error", "expired", "shed", "slow", "slow_op")
 
 # ---- metric surface (validated by the rtlint obs pass) ---------------------
 

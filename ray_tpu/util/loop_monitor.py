@@ -1,0 +1,217 @@
+"""Event-loop lag watchdogs (ref analogue: the raylet's
+``event_stats`` loop-lag probes / Python's ``asyncio`` debug-mode slow
+callback log, made continuous and exported as telemetry).
+
+Each asyncio loop the system owns attaches one :class:`LoopMonitor`: a
+self-scheduling ``call_later`` tick that does nothing but stamp the
+clock (the tick MUST stay non-blocking — this module is in rtlint's
+loop-blocking root set). A single shared daemon thread scans every
+monitor ~5x/s and
+
+- publishes ``ray_tpu_event_loop_lag_seconds{loop,pid}``: the max of
+  the recent observed tick lag and the LIVE overdue time, so an
+  ongoing stall is visible in ``rtpu rpc --watch`` while it happens,
+  not only after the loop recovers;
+- on overdue > ``loop_stall_warn_s`` emits ONE deduped WARNING
+  ``SYSTEM`` event per stall episode, carrying the stalled loop
+  thread's stack (util/profiler.thread_stack) and the asyncio task
+  running on it — the dedup flag clears when the tick resumes.
+
+The registry also answers :func:`thread_annotations` so ``rtpu stack``
+can name the loop and current task for event-loop threads.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .metrics import Gauge
+
+SCAN_INTERVAL_S = 0.2
+
+LOOP_LAG = Gauge(
+    "ray_tpu_event_loop_lag_seconds",
+    "Scheduling lag of an owned asyncio event loop: max of the recent "
+    "watchdog tick lag and the current overdue time (loop=nm|gcs|"
+    "serve_asgi|actor_asyncio|...).",
+    tag_keys=("loop", "pid"),
+)
+
+_lock = threading.Lock()
+_monitors: Dict[str, "LoopMonitor"] = {}
+_watchdog: Optional[threading.Thread] = None
+
+
+class LoopMonitor:
+    """Watchdog state for one loop. All mutation of the stamp fields
+    happens on the monitored loop's own thread; the watchdog thread
+    only reads (benign races — a torn read costs one scan's sample)."""
+
+    def __init__(self, name: str, loop: asyncio.AbstractEventLoop,
+                 interval_s: float = 0.25):
+        self.name = name
+        self.loop = loop
+        self.interval_s = float(interval_s)
+        self.thread_id: Optional[int] = None
+        self.last_tick: float = time.monotonic()
+        self.max_lag: float = 0.0          # worst tick lag since last scan
+        self.stalled = False               # inside a stall episode?
+        self.stopped = False
+        self._handle = None
+        self._gauge = LOOP_LAG.with_tags(loop=name, pid=str(os.getpid()))
+        try:
+            loop.call_soon_threadsafe(self._tick)
+        except RuntimeError:  # loop already closed
+            self.stopped = True
+
+    # -- on the monitored loop (must never block) -----------------------
+
+    def _tick(self) -> None:
+        if self.stopped:
+            self._handle = None
+            return
+        now = time.monotonic()
+        if self.thread_id is None:
+            self.thread_id = threading.get_ident()
+        lag = now - self.last_tick - self.interval_s
+        if lag > self.max_lag:
+            self.max_lag = lag
+        self.last_tick = now
+        self.stalled = False
+        self._handle = self.loop.call_later(self.interval_s, self._tick)
+
+    # -- on the watchdog thread -----------------------------------------
+
+    def _scan(self, now: float) -> None:
+        overdue = now - self.last_tick - self.interval_s
+        lag = max(0.0, self.max_lag, overdue)
+        self.max_lag = 0.0
+        self._gauge.set(round(lag, 6))
+        warn_s = _stall_warn_s()
+        if warn_s > 0 and overdue > warn_s and not self.stalled:
+            self.stalled = True  # dedup until the tick resumes
+            self._emit_stall(overdue)
+
+    def current_task_name(self) -> Optional[str]:
+        try:
+            task = asyncio.tasks._current_tasks.get(self.loop)
+            return task.get_name() if task is not None else None
+        except Exception:
+            return None
+
+    def _emit_stall(self, overdue: float) -> None:
+        try:
+            from . import events, profiler
+            stack = (profiler.thread_stack(self.thread_id)
+                     if self.thread_id else None)
+            stack_text = (profiler.format_stack_text([stack])
+                          if stack else "<thread not yet identified>")
+            task = self.current_task_name()
+            events.emit(
+                events.WARNING, events.SYSTEM,
+                f"event loop '{self.name}' stalled: watchdog tick "
+                f"overdue {overdue:.2f}s"
+                + (f" (task {task})" if task else ""),
+                custom_fields={
+                    "loop": self.name,
+                    "overdue_s": round(overdue, 3),
+                    "asyncio_task": task or "",
+                    "stack": stack_text,
+                },
+            )
+        except Exception:  # pragma: no cover - telemetry must not raise
+            pass
+
+    # -- detach ----------------------------------------------------------
+
+    def stop(self) -> None:
+        """Cancel the pending tick so a closed loop holds no stale
+        callback (safe from any thread; idempotent)."""
+        self.stopped = True
+
+        def _cancel():
+            if self._handle is not None:
+                self._handle.cancel()
+                self._handle = None
+
+        try:
+            if not self.loop.is_closed():
+                self.loop.call_soon_threadsafe(_cancel)
+        except RuntimeError:
+            pass
+
+
+def _stall_warn_s() -> float:
+    try:
+        from ..core.config import get_config
+        return float(get_config().loop_stall_warn_s)
+    except Exception:  # pragma: no cover
+        return 0.0
+
+
+def _watchdog_loop() -> None:
+    while True:
+        time.sleep(SCAN_INTERVAL_S)
+        now = time.monotonic()
+        with _lock:
+            monitors = [m for m in _monitors.values() if not m.stopped]
+        for m in monitors:
+            try:
+                m._scan(now)
+            except Exception:  # pragma: no cover
+                pass
+
+
+def attach(name: str, loop: asyncio.AbstractEventLoop,
+           interval_s: float = 0.25) -> LoopMonitor:
+    """Attach (idempotently, by name) a watchdog to ``loop`` and make
+    sure the shared scan thread runs."""
+    global _watchdog
+    with _lock:
+        existing = _monitors.get(name)
+        if existing is not None and not existing.stopped:
+            return existing
+        m = LoopMonitor(name, loop, interval_s)
+        _monitors[name] = m
+        if _watchdog is None:
+            _watchdog = threading.Thread(
+                target=_watchdog_loop,
+                name="ray_tpu-loop-watchdog", daemon=True)
+            _watchdog.start()
+    return m
+
+
+def detach(name: str) -> None:
+    with _lock:
+        m = _monitors.pop(name, None)
+    if m is not None:
+        m.stop()
+
+
+def monitors() -> Dict[str, "LoopMonitor"]:
+    with _lock:
+        return dict(_monitors)
+
+
+def thread_annotations() -> Dict[int, Dict[str, Any]]:
+    """{thread_id: {"loop": name, "asyncio_task": name-or-None}} for
+    every live monitored loop — consumed by profiler.dump_stacks so
+    ``rtpu stack`` names the handler a stalled loop is stuck in."""
+    out: Dict[int, Dict[str, Any]] = {}
+    with _lock:
+        ms = list(_monitors.values())
+    for m in sorted(ms, key=lambda m: m.name):
+        if m.stopped or m.thread_id is None:
+            continue
+        prev = out.get(m.thread_id)
+        if prev is not None:
+            # Several monitors can watch one loop (single-node mode
+            # runs the GCS on the NM's loop): one annotation, all names.
+            prev["loop"] += f"+{m.name}"
+            continue
+        out[m.thread_id] = {"loop": m.name,
+                            "asyncio_task": m.current_task_name()}
+    return out

@@ -51,38 +51,71 @@ def _frame_label(frame) -> str:
 # ------------------------------------------------------ one-shot dumps
 
 
+def _walk_frames(frame) -> List[Dict[str, Any]]:
+    frames = []
+    f = frame
+    depth = 0
+    while f is not None and depth < MAX_STACK_DEPTH:
+        code = f.f_code
+        frames.append({
+            "file": code.co_filename,
+            "line": f.f_lineno,
+            "function": code.co_name,
+        })
+        f = f.f_back
+        depth += 1
+    frames.reverse()  # outermost first, like a traceback
+    return frames
+
+
+def thread_stack(thread_id: int) -> Optional[Dict[str, Any]]:
+    """One thread's current stack in :func:`dump_stacks` record shape,
+    or None if the thread is gone (used by the loop-stall watchdog to
+    capture exactly the stalled loop's thread)."""
+    frame = sys._current_frames().get(thread_id)
+    if frame is None:
+        return None
+    name, daemon = str(thread_id), False
+    for t in threading.enumerate():
+        if t.ident == thread_id:
+            name, daemon = t.name, t.daemon
+            break
+    return {"thread_id": thread_id, "name": name, "daemon": daemon,
+            "frames": _walk_frames(frame)}
+
+
 def dump_stacks() -> List[Dict[str, Any]]:
     """Stack dump of every thread in this process (ref: ``ray stack``).
 
     Returns plain dicts (picklable for the control-plane frames):
     ``{"thread_id", "name", "daemon", "frames": [{"file", "line",
-    "function"}, ...]}`` with frames outermost-first.
+    "function"}, ...]}`` with frames outermost-first. Threads running a
+    monitored asyncio loop additionally carry ``loop`` (the monitor
+    name) and ``asyncio_task`` (the task currently executing, if any)
+    so a stalled-loop stack names the offending handler.
     """
     names = {}
     for t in threading.enumerate():
         names[t.ident] = (t.name, t.daemon)
+    try:
+        from . import loop_monitor
+        annotations = loop_monitor.thread_annotations()
+    except Exception:  # pragma: no cover
+        annotations = {}
     threads = []
     for tid, frame in sys._current_frames().items():
-        frames = []
-        f = frame
-        depth = 0
-        while f is not None and depth < MAX_STACK_DEPTH:
-            code = f.f_code
-            frames.append({
-                "file": code.co_filename,
-                "line": f.f_lineno,
-                "function": code.co_name,
-            })
-            f = f.f_back
-            depth += 1
-        frames.reverse()  # outermost first, like a traceback
         name, daemon = names.get(tid, (str(tid), False))
-        threads.append({
+        rec = {
             "thread_id": tid,
             "name": name,
             "daemon": daemon,
-            "frames": frames,
-        })
+            "frames": _walk_frames(frame),
+        }
+        ann = annotations.get(tid)
+        if ann:
+            rec["loop"] = ann.get("loop")
+            rec["asyncio_task"] = ann.get("asyncio_task")
+        threads.append(rec)
     threads.sort(key=lambda t: t["name"])
     return threads
 
@@ -94,7 +127,12 @@ def format_stack_text(threads: List[Dict[str, Any]]) -> str:
     out = []
     for t in threads:
         daemon = " daemon" if t.get("daemon") else ""
-        out.append(f"Thread {t['thread_id']} ({t['name']}){daemon}:")
+        loop = ""
+        if t.get("loop"):
+            task = t.get("asyncio_task")
+            loop = (f" [loop {t['loop']}"
+                    + (f", task {task}" if task else "") + "]")
+        out.append(f"Thread {t['thread_id']} ({t['name']}){daemon}{loop}:")
         for fr in t.get("frames", ()):
             out.append(
                 f"  File \"{fr['file']}\", line {fr['line']}, "
@@ -330,3 +368,81 @@ def cluster_profile(seconds: float = 2.0, hz: int = 100) -> Dict[str, Any]:
 
     rt = runtime_context.current_runtime()
     return rt.cluster_profile(seconds=seconds, hz=hz)
+
+
+# ------------------------------------------------- GIL contention proxy
+
+
+from .metrics import Gauge as _Gauge  # noqa: E402 - no import cycle
+
+GIL_WAIT_RATIO = _Gauge(
+    "ray_tpu_gil_wait_ratio",
+    "Sampled GIL-contention proxy: mean thread-wakeup overshoot of a "
+    "short sleep, normalized by sys.getswitchinterval() and clamped "
+    "to [0, 1]. ~0 idle; rises toward 1 as CPU-bound threads keep the "
+    "GIL held past the switch interval.",
+    tag_keys=("pid",),
+)
+
+
+class GilMonitor:
+    """Cheap periodic GIL-contention probe.
+
+    A ``time.sleep(probe)`` wakeup cannot re-enter Python until the GIL
+    is reacquired, so ``measured - requested`` approximates the GIL
+    wait this thread just paid. N probes every ``interval_s``, mean
+    overshoot divided by ``sys.getswitchinterval()`` (the cadence at
+    which a holder is asked to release), clamped to [0, 1] and
+    published as ``ray_tpu_gil_wait_ratio{pid}``. Probe cost is
+    N * probe_s of SLEEP per interval — idle CPU, not work.
+    """
+
+    PROBE_S = 0.001
+    PROBES = 10
+
+    def __init__(self, interval_s: float = 2.0):
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_ratio = 0.0
+        self._gauge = GIL_WAIT_RATIO.with_tags(pid=str(os.getpid()))
+
+    def start(self) -> "GilMonitor":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="ray_tpu-gil-probe", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def sample_once(self) -> float:
+        switch = max(1e-6, sys.getswitchinterval())
+        excess = 0.0
+        for _ in range(self.PROBES):
+            t0 = time.monotonic()
+            time.sleep(self.PROBE_S)
+            excess += max(0.0, time.monotonic() - t0 - self.PROBE_S)
+        ratio = min(1.0, (excess / self.PROBES) / switch)
+        self.last_ratio = ratio
+        self._gauge.set(round(ratio, 4))
+        return ratio
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:  # pragma: no cover
+                pass
+
+
+_gil_monitor: Optional[GilMonitor] = None
+
+
+def start_gil_monitor(interval_s: float = 2.0) -> GilMonitor:
+    """Idempotent per-process starter (NM + workers call this)."""
+    global _gil_monitor
+    if _gil_monitor is None:
+        _gil_monitor = GilMonitor(interval_s).start()
+    return _gil_monitor

@@ -29,6 +29,9 @@ from ..core import Context, Finding, Pass, dotted_name
 EVENT_LOOP_MODULES = (
     "ray_tpu/core/node_manager.py",
     "ray_tpu/core/gcs.py",
+    # The loop monitor's tick callback runs ON every watched loop — a
+    # blocking call there would manufacture the very stalls it reports.
+    "ray_tpu/util/loop_monitor.py",
 )
 
 # Dotted-name calls that block the calling thread outright.

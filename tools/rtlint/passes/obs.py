@@ -680,6 +680,66 @@ def validate_slo_config():
     ]
 
 
+# ---- control-plane dispatch lint -------------------------------------------
+# The dispatch-observability surface (util/dispatch_obs.py stage
+# histograms + util/loop_monitor.py lag gauge + util/profiler.py GIL
+# proxy) and its config knobs (README "Control-plane observability");
+# PERF_r10 baselines and `rtpu rpc` both read these names.
+
+DISPATCH_METRICS = {
+    "ray_tpu_rpc_server_seconds": "histogram",
+    "ray_tpu_rpc_inflight": "gauge",
+    "ray_tpu_rpc_backlog": "gauge",
+    "ray_tpu_event_loop_lag_seconds": "gauge",
+    "ray_tpu_gil_wait_ratio": "gauge",
+}
+
+DISPATCH_CONFIG_KEYS = ("rpc_slow_op_s", "loop_stall_warn_s")
+
+
+def validate_dispatch_metrics(declared):
+    failures = []
+    for name, kind in sorted(DISPATCH_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: dispatch-plane metric not declared "
+                f"(util/dispatch_obs.py / loop_monitor.py / "
+                f"profiler.py drifted from the documented surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    # Loop-stall warnings publish under the SYSTEM source; slow ops
+    # retain under the flight recorder's slow_op reason — a missing
+    # enum entry would raise (or silently skip counting) at the emit
+    # site instead of surfacing the stall.
+    from ray_tpu.util.events import SOURCES
+    from ray_tpu.util.flight_recorder import REASONS
+
+    if "SYSTEM" not in SOURCES:
+        failures.append(
+            "util/events.py: SYSTEM missing from SOURCES — loop-stall "
+            "warnings would raise at emit time instead of publishing"
+        )
+    if "slow_op" not in REASONS:
+        failures.append(
+            "util/flight_recorder.py: slow_op missing from REASONS — "
+            "slow control-plane ops would not be retained or counted"
+        )
+    return failures
+
+
+def validate_dispatch_config():
+    fields = _config_fields()
+    return [
+        f"core/config.py: dispatch-plane config key {key!r} missing "
+        f"from Config (documented knob drifted from the flag table)"
+        for key in DISPATCH_CONFIG_KEYS if key not in fields
+    ]
+
+
 # ---- request-waterfall / flight-recorder lint ------------------------------
 # The trace plane's metric surface (util/flight_recorder.py) and config
 # knobs (README "Request waterfalls & flight recorder"); a rename/kind
@@ -991,6 +1051,7 @@ class ObsMetricsPass(Pass):
         failures += validate_trace_metrics(declared)
         failures += validate_fence_metrics(declared)
         failures += validate_slo_metrics(declared)
+        failures += validate_dispatch_metrics(declared)
         failures += validate_transfer_config()
         failures += validate_actor_config()
         failures += validate_overload_config()
@@ -1000,6 +1061,7 @@ class ObsMetricsPass(Pass):
         failures += validate_trace_config()
         failures += validate_fence_config()
         failures += validate_slo_config()
+        failures += validate_dispatch_config()
         self.stats = (f"{len(declared)} declared metric(s), "
                       f"{len(state['skipped'])} module(s) skipped at "
                       f"import")

