@@ -298,6 +298,19 @@ class Config:
     # emits one deduped WARNING SYSTEM event carrying the stalled loop
     # thread's stack (<= 0 disables the stall alarm, lag gauges remain).
     loop_stall_warn_s: float = 1.0
+    # --- data-plane observability (util/data_obs.py: object census,
+    # leak detection, transfer-stall watchdogs) ---------------------------
+    # A sealed object older than this with zero live references (or
+    # whose owner is dead/fenced) is flagged as leaked by the head-side
+    # census sweep: one deduped WARNING OBJECT_STORE event per offender
+    # plus the ray_tpu_object_leaked_* gauges (<= 0 disables the sweep).
+    object_leak_warn_s: float = 300.0
+    # An in-flight pull with no byte progress for longer than this
+    # publishes a live ray_tpu_object_transfer_stalled{peer} gauge, one
+    # deduped WARNING OBJECT_STORE event, and a flight-recorder record
+    # (reason "stalled_pull") joinable from `rtpu trace --stalled`
+    # (<= 0 disables the watchdog, progress accounting remains).
+    transfer_stall_warn_s: float = 10.0
 
     def __post_init__(self):
         for f in dataclasses.fields(self):

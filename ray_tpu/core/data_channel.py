@@ -75,9 +75,13 @@ def _tune(sock: socket.socket) -> None:
             pass
 
 
-def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+def _recv_exact_into(sock: socket.socket, view: memoryview,
+                     progress=None) -> None:
     """Fill ``view`` completely from the socket — the zero-copy receive
-    half (payload lands directly in shared memory)."""
+    half (payload lands directly in shared memory). ``progress`` (if
+    given) is called with each recv window's byte count so the stall
+    watchdog and link-bandwidth accounting see partial progress while a
+    large range is still streaming."""
     got = 0
     total = len(view)
     while got < total:
@@ -87,6 +91,8 @@ def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
                 f"data channel closed mid-range ({got}/{total} bytes)"
             )
         got += n
+        if progress is not None:
+            progress(n)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -139,10 +145,11 @@ class DataChannel:
         self._sock = sock
 
     def pull_range(self, oid: bytes, offset: int, length: int,
-                   view: memoryview) -> None:
+                   view: memoryview, progress=None) -> None:
         """Request ``(oid, offset, length)`` and land the payload in
         ``view[offset:offset+length]`` via ``recv_into`` — no staging
-        copy."""
+        copy. ``progress`` is forwarded to the recv loop (per-window
+        byte callbacks for the stall watchdog / link accounting)."""
         sock = self._sock
         try:
             # Chaos plane: an injected error (InjectedFault is an
@@ -169,7 +176,8 @@ class DataChannel:
                     f"source answered {resp_len} bytes for a {length}-byte "
                     f"range request"
                 )
-            _recv_exact_into(sock, view[offset:offset + length])
+            _recv_exact_into(sock, view[offset:offset + length],
+                             progress=progress)
         except DataChannelError:
             self.close()
             raise

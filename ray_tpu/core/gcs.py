@@ -203,6 +203,16 @@ GCS_SERVICES = (
                         ("limit", "int", False, 200)),
                reply=(("nodes", "list"), ("errors", "dict"))),
     )),
+    ServiceSpec("ObjectService", (
+        # Data-plane census (ref analogue: `ray memory` over the GCS
+        # object-location table): every node returns its bounded object
+        # index — (oid, size, state, owner, refcount, age) rows plus
+        # store/spill totals and in-flight pull snapshots — over the
+        # same partial-tolerant peer fan-out the profile dumps use.
+        Method("objects_census",
+               request=(("limit", "int", False, 500),),
+               reply=(("nodes", "list"), ("errors", "dict"))),
+    )),
     ServiceSpec("MetricsService", (
         # SLO plane (util/tsdb.py + util/slo.py): the head GCS samples
         # the `__metrics__` KV pipeline into a bounded in-process TSDB
@@ -1228,6 +1238,12 @@ class GcsService:
             per_node_timeout=10.0,
         )
 
+    async def _rpc_objects_census(self, node_id, limit=500):
+        return await self._profile_fanout(
+            {"type": "objects_census", "limit": int(limit)},
+            per_node_timeout=10.0,
+        )
+
     async def _profile_fanout(self, frame, per_node_timeout: float):
         """ProfileService core: issue ``frame`` to every alive node over
         its peer channel concurrently; unreachable/late nodes land in
@@ -2040,6 +2056,9 @@ class LocalGcsHandle:
             None, reason=reason, limit=limit
         )
 
+    async def objects_census(self, limit=500):
+        return await self._svc._rpc_objects_census(None, limit=limit)
+
     async def rpc_describe(self):
         return self._svc._rpc.describe()
 
@@ -2266,6 +2285,13 @@ class RemoteGcsHandle:
     async def traces_dump(self, reason="", limit=200):
         r = await self._client.request(
             {"op": "traces_dump", "reason": reason, "limit": limit},
+            timeout=30.0,
+        )
+        return {"nodes": r["nodes"], "errors": r["errors"]}
+
+    async def objects_census(self, limit=500):
+        r = await self._client.request(
+            {"op": "objects_census", "limit": limit},
             timeout=30.0,
         )
         return {"nodes": r["nodes"], "errors": r["errors"]}

@@ -474,6 +474,14 @@ class NodeManager:
         self._profile_pending: Dict[int, asyncio.Future] = {}
         self._profile_req_seq = 0
 
+        # Head-side leak sweep (util/data_obs.py): oids already warned
+        # this leak episode (pruned when the object stops looking
+        # leaked, so GC clears the dedup and a fresh leak warns again)
+        # plus the one-sweep-in-flight guard.
+        self._leak_warned: Set[str] = set()
+        self._leak_last_sweep = 0.0
+        self._leak_sweep_task: Optional[asyncio.Task] = None
+
         # Failure history: bounded deque of TERMINAL task records (state,
         # duration, error type/message) retained after the live record
         # leaves _tasks, merged into _local_state_snapshot so list_tasks
@@ -1259,6 +1267,18 @@ class NodeManager:
                         f"hang-diagnosis sweep failed ({e!r}); further "
                         f"failures suppressed\n"
                     )
+            # Data-plane stall watchdog rides the same 0.5 s cadence:
+            # publishes the live stalled{peer} gauge and emits one
+            # deduped WARNING + flight-recorder record per stall
+            # episode (check_stalls itself never raises).
+            transfer = getattr(self, "_transfer", None)
+            if transfer is not None:
+                transfer.check_stalls()
+            # Head-side leak sweep: kicks a background census fan-out
+            # when due (the fan-out can wait out a dead node's timeout,
+            # so it never rides this loop inline).
+            if self.is_head:
+                self._maybe_leak_sweep()
 
     def _call(self, coro):
         """Run a coroutine on the loop from a foreign thread."""
@@ -1875,7 +1895,7 @@ class NodeManager:
                 clock = dispatch_obs.op_clock("peer", msg.get("type"),
                                               recv_ts)
                 if msg.get("type") in ("stacks_dump", "profile_run",
-                                       "traces_dump",
+                                       "traces_dump", "objects_census",
                                        "get_actor_direct_peer",
                                        "drain", "replicate_object"):
                     # Long-running introspection/resolution must not
@@ -2040,6 +2060,12 @@ class NodeManager:
             return {"result": self.traces_dump(
                 reason=msg.get("reason") or None,
                 limit=msg.get("limit", 200),
+            )}
+        if mtype == "objects_census":
+            # GCS ObjectService fan-out: this node's bounded object
+            # index + store/spill totals (same reach discipline).
+            return {"result": self.objects_census(
+                limit=msg.get("limit", 500)
             )}
         raise RuntimeError(f"unknown peer message {mtype}")
 
@@ -2692,7 +2718,8 @@ class NodeManager:
             return {"ok": True}
         if loc is None:
             self.directory.add(
-                oid, RemoteLocation(source_hex, 0), initial_refs=0
+                oid, RemoteLocation(source_hex, 0), initial_refs=0,
+                owner="replica",
             )
             loc = self.directory.lookup(oid)
         try:
@@ -2725,7 +2752,8 @@ class NodeManager:
             # consumers can hold refs before the task runs. One shared
             # placeholder instance — a 1M-deep queue creates 1M slots,
             # and the location is frozen anyway.
-            self.directory.add(oid, _RETURN_PLACEHOLDER, initial_refs=0)
+            self.directory.add(oid, _RETURN_PLACEHOLDER, initial_refs=0,
+                               owner=getattr(spec, "name", "") or "task")
         if (
             origin is None
             and spec.task_type == TaskType.NORMAL_TASK
@@ -4105,7 +4133,7 @@ class NodeManager:
         # keeps its original pin; adding more would leak it permanently).
         if pin_if_new and self.directory.lookup(object_id) is not None:
             refs = 0
-        self.directory.add(object_id, loc, initial_refs=refs)
+        self.directory.add(object_id, loc, initial_refs=refs, owner="put")
         self._seal_object(object_id, loc)
         if nested:
             # Refs serialized inside the put value stay alive as long as
@@ -5006,6 +5034,142 @@ class NodeManager:
         return await self._gcs.traces_dump(reason=reason or "",
                                            limit=limit)
 
+    def objects_census(self, limit: int = 500) -> Dict[str, Any]:
+        """This node's slice of the cluster object census (ref analogue:
+        the GCS object table + local_object_manager stats, merged): the
+        directory's per-object rows enriched with a coarse lifecycle
+        state (in-memory / spilled / inflight / remote), the borrow
+        owner's node hex where known, plus store/spill/pull accounting
+        so the head can aggregate without a second round trip."""
+        rows = self.directory.census_rows(limit=limit)
+        transfer = getattr(self, "_transfer", None)
+        inflight = (transfer.inflight_pulls()
+                    if transfer is not None else [])
+        pulling = {p.get("oid") for p in inflight}
+        for r in rows:
+            where = r["where"]
+            if where in ("shm", "inline", "arena"):
+                r["state"] = "in-memory"
+            elif where == "spilled":
+                r["state"] = "spilled"
+            elif where == "remote":
+                r["state"] = ("inflight" if r["object_id"] in pulling
+                              else "remote")
+            else:
+                r["state"] = where
+            owner_hex = self._borrowed_from.get(
+                ObjectID.from_hex(r["object_id"]))
+            if owner_hex:
+                r["owner_node"] = owner_hex
+        spill = getattr(self, "spill_manager", None)
+        return {
+            "node_id": self.node_id.hex(),
+            "is_head": self.is_head,
+            "objects": rows,
+            "used_bytes": self.directory.used_bytes,
+            "capacity_bytes": self.directory.capacity_bytes,
+            "num_objects": self.directory.num_objects(),
+            "spilled_bytes": (spill.used_bytes() if spill is not None
+                              else 0),
+            "inflight_pulls": inflight,
+        }
+
+    async def cluster_objects(self, limit: int = 500) -> Dict[str, Any]:
+        """Cluster-wide object census via the GCS fan-out (same
+        partial-tolerant shape as cluster_stacks/cluster_traces)."""
+        if self._gcs is None:
+            return {"nodes": [self.objects_census(limit)], "errors": {}}
+        return await self._gcs.objects_census(limit=limit)
+
+    # ---------------------------------------------------- leak detection
+
+    def _maybe_leak_sweep(self) -> None:
+        """Kick one background leak sweep when due (head only). Cadence
+        scales with the warn threshold so a leak is flagged within
+        ``object_leak_warn_s`` of crossing it without hammering the
+        census fan-out on the default 5-minute threshold."""
+        from ..util import data_obs
+
+        warn_s = getattr(self.config, "object_leak_warn_s", 0.0)
+        if warn_s <= 0 or not data_obs.ENABLED:
+            return
+        if (self._leak_sweep_task is not None
+                and not self._leak_sweep_task.done()):
+            return
+        interval = max(0.5, min(warn_s / 2.0, 30.0))
+        now = time.monotonic()
+        if now - self._leak_last_sweep < interval:
+            return
+        self._leak_last_sweep = now
+        self._leak_sweep_task = asyncio.ensure_future(self._leak_sweep())
+
+    async def _leak_sweep(self) -> None:
+        """One head-side leak pass over the cluster census: a sealed
+        object is leaked when it has sat at zero live refs past
+        ``object_leak_warn_s``, or when it is a borrow whose owner node
+        is dead/fenced. Publishes the leak gauges every pass (so GC
+        clears them) and emits ONE deduped WARNING OBJECT_STORE event
+        per offender per episode. Never raises."""
+        from ..util import data_obs
+
+        try:
+            warn_s = float(self.config.object_leak_warn_s)
+            census = await self.cluster_objects(limit=2000)
+            me = self.node_id.hex()
+            leaked = []  # (holder node hex, row, why)
+            for node in census.get("nodes", []):
+                holder = node.get("node_id", "")
+                for r in node.get("objects", []):
+                    if r.get("state") == "inflight":
+                        continue
+                    why = ""
+                    zero = r.get("zero_ref_s")
+                    if zero is not None and zero > warn_s:
+                        why = f"zero refs for {zero:.0f}s"
+                    owner_node = r.get("owner_node")
+                    if not why and owner_node and owner_node != me:
+                        view = self._cluster_view.get(owner_node)
+                        state = (view or {}).get("state", "dead")
+                        if (owner_node in self._fenced_nodes
+                                or state not in ("alive", "draining")):
+                            why = (f"owner node {owner_node[:8]} is "
+                                   f"{state}")
+                    if why:
+                        leaked.append((holder, r, why))
+            data_obs.set_leaked(
+                len(leaked),
+                sum(r.get("size_bytes") or 0 for _, r, _ in leaked),
+            )
+            current = set()
+            for holder, r, why in leaked:
+                oid = r["object_id"]
+                current.add(oid)
+                if oid in self._leak_warned:
+                    continue
+                self._leak_warned.add(oid)
+                cluster_events.emit(
+                    cluster_events.WARNING, cluster_events.OBJECT_STORE,
+                    f"LEAK suspected: object {oid[:8]} "
+                    f"({r.get('size_bytes') or 0} bytes, "
+                    f"owner {r.get('owner') or '?'}) on node "
+                    f"{holder[:8]}: {why}",
+                    node_id=holder,
+                    custom_fields={
+                        "object_id": oid,
+                        "size_bytes": r.get("size_bytes") or 0,
+                        "owner": r.get("owner") or "",
+                        "state": r.get("state") or "",
+                        "age_s": r.get("age_s"),
+                        "why": why,
+                    },
+                )
+            # Offenders that stopped looking leaked (GC'd, or refs
+            # re-appeared) leave the dedup set: a future re-leak of the
+            # same oid warns again instead of staying silent forever.
+            self._leak_warned &= current
+        except Exception:  # rtlint: disable=swallowed-failure
+            pass  # telemetry sweep must never take the loop down
+
     async def _handle_profile_query(self, w: WorkerHandle, msg):
         out: Dict[str, Any] = {"type": "reply", "msg_id": msg["msg_id"]}
         try:
@@ -5022,6 +5186,10 @@ class NodeManager:
                 out["result"] = await self.cluster_traces(
                     reason=msg.get("reason") or None,
                     limit=msg.get("limit", 200),
+                )
+            elif msg.get("op") == "objects":
+                out["result"] = await self.cluster_objects(
+                    limit=msg.get("limit", 500)
                 )
             else:
                 out["error"] = f"unknown profile op {msg.get('op')!r}"
@@ -5430,6 +5598,10 @@ class NodeManager:
                 "object_id": oid.hex(),
                 "size_bytes": size,
                 "where": where,
+                "state": ("in-memory"
+                          if where in ("shm", "inline", "arena")
+                          else where),
+                "owner": self.directory.owner_of(oid),
                 "refcount": refs,
                 "node_id": node,
             })

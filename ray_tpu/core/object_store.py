@@ -24,6 +24,11 @@ from typing import Dict, Optional, Union
 from .ids import ObjectID
 from .serialization import SerializedObject, deserialize
 
+# Census bookkeeping (creation ts + owner labels) rides the data-obs
+# kill switch: RTPU_NO_DATA_OBS=1 drops it to zero cost and the census
+# degrades to age-less rows.
+from ..util.data_obs import ENABLED as _CENSUS
+
 
 class ObjectStoreFullError(Exception):
     pass
@@ -477,10 +482,17 @@ class ObjectDirectory:
         # oid -> set of peer node hexes holding live borrows of this
         # object (owner-side borrower tracking, reference_count.h:61).
         self._borrowers: Dict[ObjectID, set] = {}
+        # Census sidecars (util/data_obs.py plane): wall-clock creation
+        # ts + a free-form owner label ("task name" for returns, "put"
+        # for driver puts, ...). Only populated while the data-obs plane
+        # is enabled — the census degrades to age-less rows otherwise.
+        self._created_ts: Dict[ObjectID, float] = {}
+        self._owners: Dict[ObjectID, str] = {}
         self._access_counter = 0
         self._lock = threading.Lock()
 
-    def add(self, object_id: ObjectID, loc: Location, initial_refs: int = 1):
+    def add(self, object_id: ObjectID, loc: Location, initial_refs: int = 1,
+            owner: str = ""):
         with self._lock:
             if object_id in self._entries:
                 self._refcounts[object_id] += initial_refs
@@ -501,6 +513,12 @@ class ObjectDirectory:
             self._refcounts[object_id] = initial_refs
             self._access_counter += 1
             self._access[object_id] = self._access_counter
+            if _CENSUS:
+                import time
+
+                self._created_ts[object_id] = time.time()
+                if owner:
+                    self._owners[object_id] = owner
             if initial_refs <= 0:
                 import time
 
@@ -580,6 +598,11 @@ class ObjectDirectory:
             self._refcounts[object_id] = count
             self._access_counter += 1
             self._access[object_id] = self._access_counter
+            if _CENSUS:
+                import time
+
+                self._created_ts[object_id] = time.time()
+                self._owners[object_id] = "borrow"
             if count <= 0:
                 import time
 
@@ -654,6 +677,8 @@ class ObjectDirectory:
                 self._zero_since.pop(oid, None)
                 self._access.pop(oid, None)
                 self._borrowers.pop(oid, None)
+                self._created_ts.pop(oid, None)
+                self._owners.pop(oid, None)
                 if loc is None:
                     continue
                 if isinstance(loc, (ShmLocation, ArenaLocation)):
@@ -685,6 +710,62 @@ class ObjectDirectory:
                 else:
                     out.append((oid, 0, type(loc).__name__, refs))
             return out
+
+    def set_owner(self, object_id: ObjectID, owner: str) -> None:
+        """Stamp the census owner label (first writer wins: the creation
+        site knows the producer; later relabels would lie)."""
+        if not _CENSUS or not owner:
+            return
+        with self._lock:
+            if object_id in self._entries:
+                self._owners.setdefault(object_id, owner)
+
+    def owner_of(self, object_id: ObjectID) -> str:
+        """The census owner label, or "" (plane off / never stamped)."""
+        return self._owners.get(object_id, "")
+
+    def census_rows(self, limit: int = 0) -> list:
+        """Bounded per-object census rows for the cluster object census
+        (ref analogue: the ObjectTableData the GCS object table serves).
+        Each row: oid hex, size, where, refcount, borrower count, owner
+        label, created wall ts (None when the data-obs plane is off),
+        and how long the entry has sat at zero refs. ``limit`` keeps the
+        reply frame bounded — largest entries win the cut."""
+        import time
+
+        now_w, now_m = time.time(), time.monotonic()
+        with self._lock:
+            rows = []
+            for oid, loc in self._entries.items():
+                if isinstance(loc, (ShmLocation, ArenaLocation)):
+                    size, where = loc.size, "shm"
+                elif isinstance(loc, InlineLocation):
+                    size, where = len(loc.data), "inline"
+                elif isinstance(loc, SpilledLocation):
+                    size, where = getattr(loc, "size", 0), "spilled"
+                elif isinstance(loc, RemoteLocation):
+                    size, where = getattr(loc, "size", 0), "remote"
+                else:
+                    size, where = 0, type(loc).__name__
+                created = self._created_ts.get(oid)
+                zero = self._zero_since.get(oid)
+                rows.append({
+                    "object_id": oid.hex(),
+                    "size_bytes": size,
+                    "where": where,
+                    "refcount": self._refcounts.get(oid, 0),
+                    "borrowers": len(self._borrowers.get(oid, ())),
+                    "owner": self._owners.get(oid, ""),
+                    "created_ts": created,
+                    "age_s": (round(now_w - created, 3)
+                              if created is not None else None),
+                    "zero_ref_s": (round(now_m - zero, 3)
+                                   if zero is not None else None),
+                })
+        if limit and len(rows) > limit:
+            rows.sort(key=lambda r: -(r["size_bytes"] or 0))
+            rows = rows[:limit]
+        return rows
 
     def spill_candidates(self, bytes_needed: int):
         """Least-recently-accessed local shared-memory objects summing to at

@@ -45,6 +45,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
+from ..util import data_obs
 from ..util import events as cluster_events
 from ..util.metrics import Counter, Gauge, Histogram
 from .data_channel import DataChannelError, DataChannelPool, plan_stripes
@@ -175,6 +176,11 @@ class ObjectTransfer:
         self._pools_lock = threading.Lock()
         self._inflight_peers: Dict[str, int] = {}
         self._closed = False
+        # Data-obs plane (util/data_obs.py): per-pull progress records
+        # feeding the stall watchdog + the (src,dst) link-bandwidth
+        # matrix. None when RTPU_NO_DATA_OBS=1 — every touch point
+        # treats a None tracker as a full no-op.
+        self._tracker = data_obs.pull_tracker()
         # Typed dispatch for the control-plane methods (node_manager
         # routes peer pull_object/pull_chunk frames through this).
         self.rpc = ServiceRegistry()
@@ -223,6 +229,21 @@ class ObjectTransfer:
     def _node_tag(self) -> str:
         return self._nm.node_id.hex()[:8]
 
+    def _progress_cb(self, prog, peer_tag: str):
+        """Per-recv-window byte callback for one pull: advances the
+        stall-watchdog record and feeds the (src,dst) link matrix. None
+        when the data-obs plane is off (callers pass it straight through
+        to the channel layer, which treats None as a no-op)."""
+        if prog is None:
+            return None
+        dst = self._node_tag()
+
+        def _advance(n: int, _p=prog, _src=peer_tag, _dst=dst) -> None:
+            _p.advance(n)
+            data_obs.record_link_bytes(_src, _dst, n)
+
+        return _advance
+
     def _set_inflight(self, peer_tag: str, delta: int):
         with self._stats_lock:
             cur = self._inflight_peers.get(peer_tag, 0) + delta
@@ -240,6 +261,60 @@ class ObjectTransfer:
         with self._stats_lock:
             return {k: v for k, v in self._inflight_peers.items() if v}
 
+    def inflight_pulls(self) -> list:
+        """Progress snapshots of every in-flight pull (oid, peer, bytes
+        moved, age, idle time, stall flag) — the census / `rtpu
+        transfers` inflight-aging table. Empty when the data-obs plane
+        is off."""
+        return self._tracker.inflight() if self._tracker is not None \
+            else []
+
+    def check_stalls(self) -> None:
+        """Stall-watchdog sweep, driven by the node manager's periodic
+        loop: publish the live per-peer stalled gauge, and for every
+        pull that JUST crossed ``transfer_stall_warn_s`` with no byte
+        progress emit one deduped WARNING OBJECT_STORE event plus a
+        flight-recorder record (reason "stalled_pull") joinable from
+        ``rtpu trace`` — the record's trace id is the one the pull's
+        data-plane spans root on. Never raises."""
+        if self._tracker is None:
+            return
+        try:
+            stall_s = float(getattr(self._nm.config,
+                                    "transfer_stall_warn_s", 0.0))
+        except Exception:
+            stall_s = 0.0
+        for p in self._tracker.sweep(stall_s):
+            try:
+                snap = p.snapshot()
+                oid8 = p.oid[:8]
+                detail = (f"moved {snap['bytes_moved']}/{snap['size']} B, "
+                          f"idle {snap['idle_s']:.1f}s "
+                          f"(> transfer_stall_warn_s={stall_s:g}) "
+                          f"{p.detail}").strip()
+                cluster_events.emit(
+                    cluster_events.WARNING, cluster_events.OBJECT_STORE,
+                    f"TRANSFER stalled: pull of {oid8} from peer "
+                    f"{p.peer} has made no byte progress — {detail}",
+                    node_id=self._nm.node_id.hex(),
+                    custom_fields={"object_id": p.oid, "peer": p.peer,
+                                   "bytes_moved": snap["bytes_moved"],
+                                   "size": snap["size"],
+                                   "idle_s": snap["idle_s"]},
+                )
+                from ..util import flight_recorder
+
+                now = time.time()
+                flight_recorder.observe_request(
+                    f"pull:{oid8}", p.oid[:32],
+                    now - snap["age_s"], now,
+                    status="stalled", reason="stalled_pull",
+                    detail=f"peer={p.peer} {detail}",
+                    surface="data")
+            # Telemetry must never fail the pulls it watches.
+            except Exception:  # rtlint: disable=swallowed-failure
+                pass
+
     # ------------------------------------------------------------- pull side
 
     async def pull(self, peer, oid: ObjectID) -> bytes | Location:
@@ -252,6 +327,13 @@ class ObjectTransfer:
         )
         data = reply.get("data")
         if data is not None:
+            # Small inline answer: still link traffic for the matrix.
+            try:
+                data_obs.record_link_bytes(
+                    peer.peer_hex[:8], self._node_tag(), len(data),
+                    flush=True)
+            except Exception:
+                pass
             return data
         size = reply.get("size")
         if not reply.get("chunked") or size is None:
@@ -263,12 +345,20 @@ class ObjectTransfer:
             self._bump("chunked_pulls")
             await self._admit_bytes(size)
             t0 = time.perf_counter()
+            prog = (self._tracker.start(oid.hex(), peer.peer_hex[:8],
+                                        size)
+                    if self._tracker is not None else None)
             try:
                 loc, plane = await self._pull_into_store(
-                    peer, reply, oid, size
+                    peer, reply, oid, size, prog
                 )
             finally:
                 self._inflight_bytes -= size
+                if self._tracker is not None:
+                    self._tracker.finish(prog)
+                    data_obs.record_link_bytes(
+                        peer.peer_hex[:8], self._node_tag(), 0,
+                        flush=True)
             try:
                 tags = {"node": self._node_tag(), "direction": "pull",
                         "plane": plane}
@@ -334,10 +424,11 @@ class ObjectTransfer:
             await asyncio.sleep(wait.next_delay())
 
     async def _pull_into_store(self, peer, reply: Dict[str, Any],
-                               oid: ObjectID, size: int):
+                               oid: ObjectID, size: int, prog=None):
         """Allocate the destination block and fill it — striped data
         plane first, control-plane chunks on any data-channel failure.
-        Returns ``(Location, plane)``."""
+        Returns ``(Location, plane)``. ``prog`` is the pull's data-obs
+        progress record (None when the plane is off)."""
         store = self._nm.local_store
         loop = self._nm._loop
         writer = await loop.run_in_executor(
@@ -349,7 +440,7 @@ class ObjectTransfer:
             if data_port and self.streams_per_peer > 0 and not self._closed:
                 try:
                     await self._pull_striped(peer, data_port, oid, size,
-                                             writer)
+                                             writer, prog)
                     plane = "stream"
                 except (DataChannelError, TransferError, OSError,
                         ConnectionError) as e:
@@ -376,9 +467,11 @@ class ObjectTransfer:
                                        "peer": peer.peer_hex,
                                        "error": str(e)},
                     )
-                    await self._pull_chunked_into(peer, oid, size, writer)
+                    await self._pull_chunked_into(peer, oid, size, writer,
+                                                  prog)
             else:
-                await self._pull_chunked_into(peer, oid, size, writer)
+                await self._pull_chunked_into(peer, oid, size, writer,
+                                              prog)
             loc = await loop.run_in_executor(None, writer.finalize)
             return loc, plane
         except BaseException:
@@ -416,7 +509,7 @@ class ObjectTransfer:
                 del self._pools[peer_hex]
 
     async def _pull_striped(self, peer, data_port: int, oid: ObjectID,
-                            size: int, writer):
+                            size: int, writer, prog=None):
         """Stream ``[0, size)`` into the writer's shared-memory view,
         striped across the peer's data-channel pool. All socket IO runs
         on the transfer io pool; the control loop only awaits."""
@@ -429,6 +522,9 @@ class ObjectTransfer:
         oid_b = oid.binary()
         peer_tag = peer.peer_hex[:8]
         loop = self._nm._loop
+        progress = self._progress_cb(prog, peer_tag)
+        if prog is not None:
+            prog.detail = f"stripes={len(stripes)} port={data_port}"
         # Data-plane span: the pull (and each stripe under it) lands in
         # the waterfall. The NM loop has no ambient request context, so
         # a pull outside any traced request roots on the object id —
@@ -441,7 +537,7 @@ class ObjectTransfer:
             futs = [
                 loop.run_in_executor(
                     self._io_pool, self._stripe_worker, pool, oid_b,
-                    off, length, view, (pull_ctx[0], pull_sid),
+                    off, length, view, (pull_ctx[0], pull_sid), progress,
                 )
                 for off, length in stripes
             ]
@@ -488,7 +584,7 @@ class ObjectTransfer:
 
     def _stripe_worker(self, pool: DataChannelPool, oid_b: bytes,
                        offset: int, length: int, view: memoryview,
-                       span_parent=None):
+                       span_parent=None, progress=None):
         """Executor-thread body: borrow a channel, stream one stripe
         directly into the destination view. The acquire wait is bounded
         by the IO timeout, not the connect timeout — waiting for a busy
@@ -496,7 +592,7 @@ class ObjectTransfer:
         data-volume-bound."""
         t0 = time.time()
         try:
-            self._stripe_pull(pool, oid_b, offset, length, view)
+            self._stripe_pull(pool, oid_b, offset, length, view, progress)
         finally:
             if span_parent is not None:
                 try:
@@ -513,10 +609,11 @@ class ObjectTransfer:
                     pass
 
     def _stripe_pull(self, pool: DataChannelPool, oid_b: bytes,
-                     offset: int, length: int, view: memoryview):
+                     offset: int, length: int, view: memoryview,
+                     progress=None):
         ch = pool.acquire(timeout=self._nm.config.transfer_io_timeout_s)
         try:
-            ch.pull_range(oid_b, offset, length, view)
+            ch.pull_range(oid_b, offset, length, view, progress=progress)
         except DataChannelError:
             was_reused = ch.reused
             pool.discard(ch)
@@ -531,7 +628,8 @@ class ObjectTransfer:
                 timeout=self._nm.config.transfer_io_timeout_s
             )
             try:
-                ch.pull_range(oid_b, offset, length, view)
+                ch.pull_range(oid_b, offset, length, view,
+                              progress=progress)
             except BaseException:
                 pool.discard(ch)
                 raise
@@ -545,12 +643,15 @@ class ObjectTransfer:
     # ---- control-plane fallback -------------------------------------------
 
     async def _pull_chunked_into(self, peer, oid: ObjectID, size: int,
-                                 writer):
+                                 writer, prog=None):
         """The pre-data-plane protocol: per-chunk request/reply frames
         over the control channel, staged through the executor into the
         writer. Kept as the universal fallback."""
         loop = self._nm._loop
         chunk = self.chunk_bytes
+        progress = self._progress_cb(prog, peer.peer_hex[:8])
+        if prog is not None:
+            prog.detail = "plane=control"
         # Executor-thread writes in flight: a cancelled fetch coroutine
         # does NOT stop its already-running threadpool write, so the
         # abort path must drain THESE, not just the tasks.
@@ -578,6 +679,8 @@ class ObjectTransfer:
                 write_futs.append(fut)
                 await fut
                 self._bump("chunks_pulled")
+                if progress is not None:
+                    progress(length)
 
         tasks = [
             asyncio.ensure_future(fetch(off))

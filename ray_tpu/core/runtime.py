@@ -1871,6 +1871,15 @@ class DriverRuntime(BaseRuntime):
             timeout=30.0,
         )
 
+    def cluster_objects(self, limit: int = 500) -> Dict[str, Any]:
+        """Cluster-wide object census (backing for `rtpu objects` /
+        `rtpu memory` / dashboard /api/objects, via the GCS
+        ObjectService fan-out)."""
+        return self._nm.call_sync(
+            self._nm.cluster_objects(limit=limit),
+            timeout=30.0,
+        )
+
     def cluster_resources(self) -> Dict[str, float]:
         views = self.nodes()
         if len(views) <= 1:
@@ -2237,6 +2246,15 @@ class WorkerRuntime(BaseRuntime):
             {"type": "profile", "op": "run", "seconds": seconds,
              "hz": hz},
             timeout=min(float(seconds), 30.0) + 30.0,
+        )
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return reply["result"]
+
+    def cluster_objects(self, limit: int = 500) -> Dict[str, Any]:
+        reply = self.request(
+            {"type": "profile", "op": "objects", "limit": limit},
+            timeout=45.0,
         )
         if reply.get("error"):
             raise RuntimeError(reply["error"])

@@ -9,15 +9,39 @@ the storage unit is one file per object under ``session_dir/spill/`` (the
 reference fuses small objects into batch files; our small objects are inline
 in the control plane and never spill, so per-object files stay few and
 large).
+
+Observability (util/data_obs.py, gated by RTPU_NO_DATA_OBS): every write
+and restore bumps the ``ray_tpu_spill_{ops,bytes}_total{op}`` churn
+counters and records a ``spill:<oid8>`` / ``restore:<oid8>`` timeline
+span rooted on the request context when one is active, else on the oid
+itself — the same join-by-oid convention the stripe spans use.
 """
 
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional
 
 from .ids import ObjectID
 from .object_store import SpilledLocation
+from ..util import data_obs
+
+
+def _spill_span(name: str, oid_hex: str, start: float) -> None:
+    """Record one spill-plane span (never raises; no-op when either the
+    data-obs plane or timeline recording is off)."""
+    if not data_obs.ENABLED:
+        return
+    try:
+        from .timeline import current_span, get_buffer, new_span_id
+
+        ctx = current_span() or (oid_hex[:32], "")
+        get_buffer().record(name, start, time.time(), "",
+                            trace_id=ctx[0], span_id=new_span_id(),
+                            parent_id=ctx[1])
+    except Exception:  # pragma: no cover - telemetry must not break IO
+        pass
 
 
 class SpillManager:
@@ -28,6 +52,9 @@ class SpillManager:
     def __init__(self, spill_dir: str):
         self.spill_dir = spill_dir
         self._made = False
+        # In-memory running total, updated on write/delete: the census
+        # reads it from the event loop, where a listdir walk would block.
+        self._used = 0
 
     def _path(self, oid: ObjectID) -> str:
         return os.path.join(self.spill_dir, oid.hex())
@@ -38,30 +65,37 @@ class SpillManager:
         if not self._made:
             os.makedirs(self.spill_dir, exist_ok=True)
             self._made = True
+        start = time.time()
         path = self._path(oid)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, path)  # atomic: readers never see partial spills
+        self._used += len(data)
+        oid_hex = oid.hex()
+        data_obs.record_spill("spill", len(data))
+        _spill_span(f"spill:{oid_hex[:8]}", oid_hex, start)
         return SpilledLocation(path, len(data))
 
     def read(self, loc: SpilledLocation) -> bytes:
+        start = time.time()
         with open(loc.path, "rb") as f:
-            return f.read()
+            data = f.read()
+        oid_hex = os.path.basename(loc.path)
+        data_obs.record_spill("restore", len(data))
+        _spill_span(f"restore:{oid_hex[:8]}", oid_hex, start)
+        return data
 
     def delete(self, loc: SpilledLocation) -> None:
         try:
             os.remove(loc.path)
+            self._used -= getattr(loc, "size", 0)
+            if self._used < 0:
+                self._used = 0
         except FileNotFoundError:
             pass
 
     def used_bytes(self) -> int:
         if not self._made or not os.path.isdir(self.spill_dir):
             return 0
-        total = 0
-        for name in os.listdir(self.spill_dir):
-            try:
-                total += os.path.getsize(os.path.join(self.spill_dir, name))
-            except OSError:
-                pass
-        return total
+        return self._used

@@ -97,11 +97,39 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if path == "/api/objects":
+                # Cluster object census via the GCS ObjectService
+                # fan-out, flattened to one row per object (size,
+                # state, owner, refs, age + holder node) so the table
+                # shape stays what the UI always consumed. Falls back
+                # to the flat list_objects table when no runtime is
+                # attached. ?limit=500 bounds the per-node rows.
+                from urllib.parse import parse_qs, urlparse
+
+                from .core import runtime_context
+
+                rt = runtime_context.current_runtime_or_none()
+                if rt is not None and hasattr(rt, "cluster_objects"):
+                    q = parse_qs(urlparse(self.path).query)
+                    census = rt.cluster_objects(
+                        limit=int((q.get("limit") or ["500"])[0])
+                    )
+                    rows = []
+                    for node in census.get("nodes", ()):
+                        for r in node.get("objects", ()):
+                            r = dict(r)
+                            r["node_id"] = node.get("node_id", "")
+                            rows.append(r)
+                    rows.sort(
+                        key=lambda r: -(r.get("size_bytes") or 0))
+                    self._json(rows)
+                    return
+                self._json(state.list_objects())
+                return
             routes = {
                 "/api/nodes": state.list_nodes,
                 "/api/tasks": state.list_tasks,
                 "/api/actors": state.list_actors,
-                "/api/objects": state.list_objects,
                 "/api/workers": state.list_workers,
                 "/api/summary/tasks": state.summarize_tasks,
                 "/api/summary/actors": state.summarize_actors,

@@ -192,7 +192,11 @@ function viewActors(){return controls()+table(D.actors,[
   ["node",a=>short(a.node_id)],["pid",a=>h(a.pid||"")]]);}
 function viewObjects(){return controls()+table(D.objects,[
   ["object",o=>short(o.object_id)],
-  ["size",o=>mb(o.size_bytes),"num"],["where",o=>h(o.where||"")],
+  ["size",o=>mb(o.size_bytes),"num"],
+  ["state",o=>h(o.state||o.where||"")],
+  ["owner",o=>h(o.owner||"")],
+  ["refs",o=>h(o.refcount==null?"":o.refcount),"num"],
+  ["age(s)",o=>h(o.age_s==null?"":o.age_s),"num"],
   ["node",o=>short(o.node_id)]]);}
 function viewWorkers(){return controls()+table(D.workers,[
   ["worker",w=>short(w.worker_id)],["state",w=>pill(w.state)],

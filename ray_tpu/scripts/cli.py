@@ -456,42 +456,233 @@ def cmd_stop_job(args) -> int:
         ray_tpu.shutdown()
 
 
+def _census_rows(census: dict) -> List[dict]:
+    """Flatten a cluster_objects reply into per-object rows stamped
+    with their holder node's hex id."""
+    rows: List[dict] = []
+    for node in census.get("nodes", ()):
+        node_hex = node.get("node_id", "")
+        for r in node.get("objects", ()):
+            r = dict(r)
+            r["node_id"] = node_hex
+            rows.append(r)
+    return rows
+
+
+def _census_footer(census: dict) -> None:
+    """Shared store/spill totals + unreachable-node footer of
+    `rtpu memory` / `rtpu objects`."""
+    used = cap = spilled = pulls = 0
+    for node in census.get("nodes", ()):
+        used += node.get("used_bytes") or 0
+        cap += node.get("capacity_bytes") or 0
+        spilled += node.get("spilled_bytes") or 0
+        pulls += len(node.get("inflight_pulls") or ())
+    print(f"store: {used / 1e6:.2f}/{cap / 1e6:.2f} MB used, "
+          f"{spilled / 1e6:.2f} MB spilled, {pulls} pull(s) in flight")
+    for node_hex, err in (census.get("errors") or {}).items():
+        print(f"node {node_hex[:8]}: unreachable ({err})")
+
+
 def cmd_memory(args) -> int:
-    """Per-object reference table (ref: `ray memory` —
-    _private/internal_api.py memory_summary)."""
+    """Cluster object-store memory view (ref: `ray memory` —
+    _private/internal_api.py memory_summary), census-backed: every
+    node's object index merged, with lifecycle state + producer owner
+    per row and totals by state / by owner."""
     ray_tpu = _attached(args)
     try:
-        from ray_tpu.util import state as state_api
+        from ray_tpu.core import runtime_context
 
-        # Fetch the full table, sort once, slice once for display: the
-        # TOTAL accounting below must also cover objects beyond the
-        # display limit (the old path truncated before sorting AND again
-        # after, so the biggest objects could be cut and the totals
-        # lied). Explicit high limit: list_objects' default 10k cap
-        # would silently reintroduce the undercount on big clusters.
-        rows = state_api.list_objects(limit=10_000_000)
-        rows.sort(key=lambda r: -(r.get("size_bytes") or 0))
-        by_where = {}
-        total = 0
-        for r in rows:
-            size = r.get("size_bytes") or 0
-            by_where.setdefault(r["where"], [0, 0])
-            by_where[r["where"]][0] += 1
-            by_where[r["where"]][1] += size
-            total += size
-        shown = rows[:args.limit]
-        print(f"{'OBJECT ID':42} {'SIZE':>12} {'REFS':>5} "
-              f"{'WHERE':8} NODE")
-        for r in shown:
-            print(f"{r['object_id']:42} "
-                  f"{r.get('size_bytes') or 0:>12} "
-                  f"{r.get('refcount', 0):>5} "
-                  f"{r['where']:8} {r['node_id'][:8]}")
-        label = f"TOTAL ({len(rows)} objects, {len(shown)} shown)"
-        print(f"{label:42} {total:>12}")
-        for where, (n, size) in sorted(by_where.items()):
-            print(f"  {where}: {n} objects, {size / 1e6:.2f} MB")
-        return 0
+        rt = runtime_context.current_runtime()
+
+        def render():
+            try:
+                census = rt.cluster_objects(limit=10_000)
+            except Exception as e:
+                print(f"object census unavailable: {e}")
+                return
+            rows = _census_rows(census)
+            rows.sort(key=lambda r: -(r.get("size_bytes") or 0))
+            by_state: dict = {}
+            by_owner: dict = {}
+            total = 0
+            for r in rows:
+                size = r.get("size_bytes") or 0
+                st = r.get("state") or r.get("where") or "?"
+                e = by_state.setdefault(st, [0, 0])
+                e[0] += 1
+                e[1] += size
+                o = by_owner.setdefault(r.get("owner") or "?", [0, 0])
+                o[0] += 1
+                o[1] += size
+                total += size
+            shown = rows[:args.limit]
+            print(f"{'OBJECT ID':42} {'SIZE':>12} {'REFS':>5} "
+                  f"{'STATE':9} {'OWNER':16} NODE")
+            for r in shown:
+                print(f"{r['object_id']:42} "
+                      f"{r.get('size_bytes') or 0:>12} "
+                      f"{r.get('refcount', 0):>5} "
+                      f"{(r.get('state') or r.get('where') or '?'):9} "
+                      f"{(r.get('owner') or '?')[:16]:16} "
+                      f"{r['node_id'][:8]}")
+            label = f"TOTAL ({len(rows)} objects, {len(shown)} shown)"
+            print(f"{label:42} {total:>12}")
+            for st, (n, size) in sorted(by_state.items()):
+                print(f"  {st}: {n} objects, {size / 1e6:.2f} MB")
+            owners = sorted(by_owner.items(), key=lambda kv: -kv[1][1])
+            if owners:
+                print("by owner: " + "  ".join(
+                    f"{name}={n}/{size / 1e6:.2f}MB"
+                    for name, (n, size) in owners[:8]))
+            _census_footer(census)
+
+        return _watch_loop(render, getattr(args, "watch", None))
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_objects(args) -> int:
+    """Cluster object census (ref: the GCS object table + `ray memory`,
+    merged): top-N objects by size, the zero-ref leak candidates, or
+    the spilled set — cluster-wide via the ObjectService fan-out."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.core import runtime_context
+
+        rt = runtime_context.current_runtime()
+
+        def render():
+            try:
+                census = rt.cluster_objects(limit=10_000)
+            except Exception as e:
+                print(f"object census unavailable: {e}")
+                return
+            rows = _census_rows(census)
+            if args.leaked:
+                rows = [r for r in rows
+                        if r.get("zero_ref_s") is not None]
+                rows.sort(key=lambda r: -(r.get("zero_ref_s") or 0))
+                title = "zero-ref (leak-candidate) objects"
+            elif args.spilled:
+                rows = [r for r in rows if r.get("state") == "spilled"]
+                rows.sort(key=lambda r: -(r.get("size_bytes") or 0))
+                title = "spilled objects"
+            else:
+                rows.sort(key=lambda r: -(r.get("size_bytes") or 0))
+                title = "objects by size"
+            shown = rows[:args.top]
+            if args.json:
+                print(json.dumps({"objects": shown,
+                                  "total": len(rows),
+                                  "errors": census.get("errors") or {}},
+                                 indent=2, default=str))
+                return
+            print(f"{title} ({len(shown)}/{len(rows)} shown)")
+            print(f"{'OBJECT ID':42} {'SIZE':>12} {'STATE':9} "
+                  f"{'REFS':>5} {'OWNER':16} {'AGE(s)':>8} "
+                  f"{'0REF(s)':>8} NODE")
+            for r in shown:
+                age = r.get("age_s")
+                zero = r.get("zero_ref_s")
+                print(f"{r['object_id']:42} "
+                      f"{r.get('size_bytes') or 0:>12} "
+                      f"{(r.get('state') or r.get('where') or '?'):9} "
+                      f"{r.get('refcount', 0):>5} "
+                      f"{(r.get('owner') or '?')[:16]:16} "
+                      f"{age if age is not None else '-':>8} "
+                      f"{zero if zero is not None else '-':>8} "
+                      f"{r['node_id'][:8]}")
+            _census_footer(census)
+
+        return _watch_loop(render, getattr(args, "watch", None))
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_transfers(args) -> int:
+    """Data-plane transfer view: the per-link bandwidth matrix derived
+    from ``ray_tpu_transfer_link_bytes_total`` in the head TSDB, spill
+    churn, live stall gauges, and the in-flight pull aging table from
+    the object census."""
+    ray_tpu = _attached(args)
+    try:
+        from ray_tpu.core import runtime_context
+
+        rt = runtime_context.current_runtime()
+        window_s = float(args.window)
+
+        def query(name):
+            try:
+                return rt.timeseries_query(name=name)["series"]
+            except Exception:
+                return []
+
+        def render():
+            print(f"rtpu transfers — {time.strftime('%H:%M:%S')} "
+                  f"(window {int(window_s)}s)")
+            links = []
+            for s in query("ray_tpu_transfer_link_bytes_total"):
+                tags = dict(tuple(kv) for kv in s.get("tags", []))
+                inc, span = _ts_increase(s["samples"], window_s)
+                last = s["samples"][-1][1] if s["samples"] else 0
+                links.append((tags.get("src", "?"), tags.get("dst", "?"),
+                              inc / span if span else 0.0, last))
+            if links:
+                print(f"\n{'SRC':10} {'DST':10} {'MB/s':>9} "
+                      f"{'TOTAL(MB)':>11}")
+                for src, dst, rate, total in sorted(
+                        links, key=lambda l: -l[2]):
+                    print(f"{src[:10]:10} {dst[:10]:10} "
+                          f"{rate / 1e6:>9.2f} {total / 1e6:>11.2f}")
+            else:
+                print("no link traffic recorded")
+            spill_bits = []
+            for s in query("ray_tpu_spill_bytes_total"):
+                tags = dict(tuple(kv) for kv in s.get("tags", []))
+                inc, span = _ts_increase(s["samples"], window_s)
+                if span and inc:
+                    spill_bits.append(f"{tags.get('op', '?')} "
+                                      f"{inc / span / 1e6:.2f} MB/s")
+            if spill_bits:
+                print("spill churn: " + ", ".join(spill_bits))
+            stalled = [(dict(tuple(kv) for kv in s.get("tags", []))
+                        .get("peer", "?"), s["samples"][-1][1])
+                       for s in query("ray_tpu_object_transfer_stalled")
+                       if s["samples"] and s["samples"][-1][1] > 0]
+            if stalled:
+                print("STALLED: " + ", ".join(
+                    f"{int(n)} pull(s) from {peer}"
+                    for peer, n in stalled))
+            try:
+                census = rt.cluster_objects(limit=1)
+            except Exception as e:
+                print(f"inflight pulls unavailable: {e}")
+                return
+            pulls = []
+            for node in census.get("nodes", ()):
+                for p in node.get("inflight_pulls", ()):
+                    pulls.append((node.get("node_id", ""), p))
+            if pulls:
+                pulls.sort(key=lambda np: -(np[1].get("age_s") or 0))
+                print(f"\n{'OBJECT':18} {'PEER':10} {'SIZE':>12} "
+                      f"{'MOVED%':>7} {'AGE(s)':>8} {'IDLE(s)':>8} "
+                      f"{'STATE':8} DEST")
+                for node_hex, p in pulls:
+                    size = p.get("size") or 0
+                    pct = (100.0 * (p.get("bytes_moved") or 0) / size
+                           if size else 0.0)
+                    state = "STALLED" if p.get("stalled") else "moving"
+                    print(f"{(p.get('oid') or '?')[:18]:18} "
+                          f"{(p.get('peer') or '?')[:10]:10} "
+                          f"{size:>12} {pct:>7.1f} "
+                          f"{p.get('age_s', 0):>8.1f} "
+                          f"{p.get('idle_s', 0):>8.1f} "
+                          f"{state:8} {node_hex[:8]}")
+            else:
+                print("no pulls in flight")
+
+        return _watch_loop(render, getattr(args, "watch", None))
     finally:
         ray_tpu.shutdown()
 
@@ -646,7 +837,8 @@ def cmd_trace(args) -> int:
         reason = None
         for flag, value in (("slow", "slow"), ("errors", "error"),
                             ("shed", "shed"), ("expired", "expired"),
-                            ("chaos", "chaos"), ("slow_ops", "slow_op")):
+                            ("chaos", "chaos"), ("slow_ops", "slow_op"),
+                            ("stalled", "stalled_pull")):
             if getattr(args, flag, False):
                 reason = value
         rows = flight_recorder.list_cluster(reason=reason,
@@ -928,6 +1120,41 @@ def _render_top(rt, window_s: float) -> None:
     if lag_bits or gil:
         gil_s = (f"   gil wait ratio max {max(gil):.2f}" if gil else "")
         print("loops: " + ", ".join(lag_bits) + gil_s)
+
+    # Data plane: aggregate link bandwidth + spill churn + the live
+    # stall/leak gauges (`rtpu transfers` / `rtpu objects` break these
+    # down per link / per object).
+    inc = span = 0.0
+    for s in query("ray_tpu_transfer_link_bytes_total"):
+        i, sp = _ts_increase(s["samples"], window_s)
+        inc += i
+        span = max(span, sp)
+    spill = spill_span = 0.0
+    for s in query("ray_tpu_spill_bytes_total"):
+        i, sp = _ts_increase(s["samples"], window_s)
+        spill += i
+        spill_span = max(spill_span, sp)
+    stalled = sum(s["samples"][-1][1]
+                  for s in query("ray_tpu_object_transfer_stalled")
+                  if s["samples"])
+    leaked = max((s["samples"][-1][1]
+                  for s in query("ray_tpu_object_leaked_total")
+                  if s["samples"]), default=0.0)
+    leaked_b = max((s["samples"][-1][1]
+                    for s in query("ray_tpu_object_leaked_bytes")
+                    if s["samples"]), default=0.0)
+    bits = []
+    if span and inc:
+        bits.append(f"links {inc / span / 1e6:.1f} MB/s")
+    if spill_span and spill:
+        bits.append(f"spill {spill / spill_span / 1e6:.1f} MB/s")
+    if stalled:
+        bits.append(f"STALLED pulls {int(stalled)}")
+    if leaked:
+        bits.append(f"leaked {int(leaked)} obj "
+                    f"({leaked_b / 1e6:.1f} MB)")
+    if bits:
+        print("data plane: " + ", ".join(bits))
 
 
 def cmd_top(args) -> int:
@@ -1573,6 +1800,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--slow-ops", action="store_true",
                    help="only control-plane ops slower than "
                         "rpc_slow_op_s (NM/GCS dispatch stalls)")
+    p.add_argument("--stalled", action="store_true",
+                   help="only stalled data-plane pulls (no byte "
+                        "progress past transfer_stall_warn_s)")
     p.add_argument("--limit", type=int, default=100)
     p.add_argument("--json", action="store_true")
     _add_address(p)
@@ -1584,10 +1814,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_address(p)
     p.set_defaults(fn=cmd_summary)
 
-    p = sub.add_parser("memory", help="per-object reference table")
+    p = sub.add_parser("memory",
+                       help="cluster object-store memory view "
+                            "(census-backed reference table)")
     p.add_argument("--limit", type=int, default=50)
+    p.add_argument("--watch", type=float, default=None, metavar="N",
+                   help="refresh every N seconds (^C exits)")
     _add_address(p)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("objects",
+                       help="cluster object census: top-N by size, "
+                            "leak candidates, spilled set")
+    p.add_argument("--top", type=int, default=20, metavar="N",
+                   help="show the N top objects (default 20)")
+    p.add_argument("--leaked", action="store_true",
+                   help="only zero-ref (leak-candidate) objects, "
+                        "oldest first")
+    p.add_argument("--spilled", action="store_true",
+                   help="only spilled objects")
+    p.add_argument("--watch", type=float, default=None, metavar="N",
+                   help="refresh every N seconds (^C exits)")
+    p.add_argument("--json", action="store_true")
+    _add_address(p)
+    p.set_defaults(fn=cmd_objects)
+
+    p = sub.add_parser("transfers",
+                       help="data plane: per-link bandwidth matrix, "
+                            "spill churn, in-flight pull aging")
+    p.add_argument("--window", type=float, default=30.0,
+                   help="trailing window for rates (seconds)")
+    p.add_argument("--watch", type=float, default=None, metavar="N",
+                   help="refresh every N seconds (^C exits)")
+    _add_address(p)
+    p.set_defaults(fn=cmd_transfers)
 
     p = sub.add_parser("stack",
                        help="stack dumps of every process in the cluster")

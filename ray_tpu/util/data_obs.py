@@ -1,0 +1,285 @@
+"""Data-plane observability (ref analogue: the object manager's
+ObjectStoreRunner stats + pull_manager.h's retry/progress bookkeeping,
+surfaced instead of buried).
+
+Three instruments over the L2 object layer:
+
+  leak gauges      the head census sweep publishes how many sealed
+                   objects are older than ``object_leak_warn_s`` with
+                   zero live refs (or a dead/fenced owner), and their
+                   byte total, via ``ray_tpu_object_leaked_total`` /
+                   ``ray_tpu_object_leaked_bytes``.
+  stall watchdog   every in-flight pull carries (started_ts,
+                   bytes_moved, last_progress_ts); a pull with no byte
+                   progress past ``transfer_stall_warn_s`` raises the
+                   LIVE ``ray_tpu_object_transfer_stalled{peer}`` gauge
+                   (visible WHILE stuck), emits one deduped WARNING
+                   OBJECT_STORE event per stall episode, and drops a
+                   flight-recorder record (reason "stalled_pull") so
+                   ``rtpu trace --stalled`` joins data-plane stalls to
+                   request waterfalls.
+  link matrix      per-(src,dst) byte counters
+                   (``ray_tpu_transfer_link_bytes_total{src,dst}``)
+                   feed the head TSDB so ``rtpu transfers`` /
+                   ``rtpu top`` can derive per-link bandwidth; spill
+                   churn rides ``ray_tpu_spill_ops_total{op}`` /
+                   ``ray_tpu_spill_bytes_total{op}`` next to the
+                   ``spill:<oid8>``/``restore:<oid8>`` timeline spans.
+
+The whole plane is one in-process kill switch away:
+``RTPU_NO_DATA_OBS=1`` makes every tracker factory return None and
+every caller degrades to zero-overhead no-ops (the transfer bench's
+``obs_overhead`` row measures exactly this delta, bar <= 3%).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from .metrics import Counter, Gauge
+
+# Kill switch, read once at import: the bench flips it per-session via a
+# fresh interpreter, so a cached check is both correct and free.
+ENABLED = os.environ.get("RTPU_NO_DATA_OBS", "") not in ("1", "true")
+
+LEAKED_TOTAL = Gauge(
+    "ray_tpu_object_leaked_total",
+    "Sealed objects the head census sweep currently considers leaked "
+    "(zero live refs past object_leak_warn_s, or a dead/fenced owner).",
+)
+LEAKED_BYTES = Gauge(
+    "ray_tpu_object_leaked_bytes",
+    "Byte total of the objects currently flagged leaked by the head "
+    "census sweep.",
+)
+TRANSFER_STALLED = Gauge(
+    "ray_tpu_object_transfer_stalled",
+    "In-flight pulls from this peer with no byte progress for longer "
+    "than transfer_stall_warn_s (live while stuck, zero on recovery).",
+    tag_keys=("peer",),
+)
+LINK_BYTES = Counter(
+    "ray_tpu_transfer_link_bytes_total",
+    "Data-plane bytes moved per directed (src,dst) node-id link; rate "
+    "over the head TSDB gives per-link bandwidth.",
+    tag_keys=("src", "dst"),
+)
+SPILL_OPS = Counter(
+    "ray_tpu_spill_ops_total",
+    "Spill-plane operations (op=spill|restore) — churn counter for the "
+    "disk tier.",
+    tag_keys=("op",),
+)
+SPILL_BYTES = Counter(
+    "ray_tpu_spill_bytes_total",
+    "Bytes written to (op=spill) or read back from (op=restore) the "
+    "spill tier.",
+    tag_keys=("op",),
+)
+
+# Bound-handle caches (with_tags resolves the tag tuple once; the hot
+# path then only does a dict lookup).
+_link_handles: Dict[Tuple[str, str], object] = {}
+_stalled_handles: Dict[str, object] = {}
+_spill_handles: Dict[str, Tuple[object, object]] = {}
+# Link-byte publishes are batched: stripe workers add to an int pending
+# map under a lock, and a publish drains it at most every
+# _LINK_MIN_INTERVAL_S. A counter inc takes the registry lock — at one
+# inc per 1 MiB recv window that was a measurable slice of the stripe
+# hot path.
+_link_pending: Dict[Tuple[str, str], int] = {}
+_link_lock = threading.Lock()
+_link_last_pub = 0.0
+_LINK_MIN_INTERVAL_S = 0.2
+
+
+def record_link_bytes(src: str, dst: str, nbytes: int,
+                      flush: bool = False) -> None:
+    """Account data-plane bytes moved over the directed (src,dst) link.
+    Batched: counter publishes happen at most every 0.2 s per process,
+    or immediately with ``flush=True`` (end of a pull). Never raises."""
+    if not ENABLED or (nbytes <= 0 and not flush):
+        return
+    global _link_last_pub
+    try:
+        now = time.monotonic()
+        with _link_lock:
+            if nbytes > 0:
+                key = (src[:16] or "?", dst[:16] or "?")
+                _link_pending[key] = (_link_pending.get(key, 0)
+                                      + int(nbytes))
+            if not _link_pending:
+                return
+            if not flush and now - _link_last_pub < _LINK_MIN_INTERVAL_S:
+                return
+            _link_last_pub = now
+            drained = dict(_link_pending)
+            _link_pending.clear()
+        for k, v in drained.items():
+            h = _link_handles.get(k)
+            if h is None:
+                h = LINK_BYTES.with_tags(src=k[0], dst=k[1])
+                _link_handles[k] = h
+            h.inc(v)
+    except Exception:  # pragma: no cover - telemetry must not break pulls
+        pass
+
+
+def record_spill(op: str, nbytes: int) -> None:
+    """Account one spill-plane operation (op=spill|restore)."""
+    if not ENABLED:
+        return
+    try:
+        h = _spill_handles.get(op)
+        if h is None:
+            h = (SPILL_OPS.with_tags(op=op), SPILL_BYTES.with_tags(op=op))
+            _spill_handles[op] = h
+        h[0].inc(1)
+        h[1].inc(max(0, int(nbytes)))
+    except Exception:  # pragma: no cover
+        pass
+
+
+def set_stalled(peer: str, count: int) -> None:
+    """Publish the live per-peer stalled-pull gauge (0 clears it)."""
+    if not ENABLED:
+        return
+    try:
+        key = peer[:64] or "?"
+        h = _stalled_handles.get(key)
+        if h is None:
+            h = TRANSFER_STALLED.with_tags(peer=key)
+            _stalled_handles[key] = h
+        h.set(float(count))
+    except Exception:  # pragma: no cover
+        pass
+
+
+def set_leaked(count: int, nbytes: int) -> None:
+    """Publish the head census sweep's current leak verdict."""
+    if not ENABLED:
+        return
+    try:
+        LEAKED_TOTAL.set(float(count))
+        LEAKED_BYTES.set(float(nbytes))
+    except Exception:  # pragma: no cover
+        pass
+
+
+class PullProgress:
+    """One in-flight pull's progress record: (started_ts, bytes_moved,
+    last_progress_ts) plus the stall episode flag the watchdog dedupes
+    on. Stripe workers bump it from executor threads — the int/float
+    stores are GIL-atomic, and the watchdog only reads, so no lock."""
+
+    __slots__ = ("oid", "peer", "size", "started_ts", "bytes_moved",
+                 "last_progress_ts", "stalled", "detail", "_id")
+
+    def __init__(self, oid: str, peer: str, size: int):
+        now = time.monotonic()
+        self.oid = oid
+        self.peer = peer
+        self.size = int(size)
+        self.started_ts = now
+        self.bytes_moved = 0
+        self.last_progress_ts = now
+        # Set by the watchdog when the stall WARNING for this pull has
+        # fired; byte progress clears it (so a re-stall warns again).
+        self.stalled = False
+        # Free-form stripe detail for the flight-recorder record.
+        self.detail = ""
+
+    def advance(self, nbytes: int) -> None:
+        self.bytes_moved += int(nbytes)
+        self.last_progress_ts = time.monotonic()
+        self.stalled = False
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        return {
+            "oid": self.oid,
+            "peer": self.peer,
+            "size": self.size,
+            "bytes_moved": self.bytes_moved,
+            "age_s": round(now - self.started_ts, 3),
+            "idle_s": round(now - self.last_progress_ts, 3),
+            "stalled": self.stalled,
+        }
+
+
+class PullTracker:
+    """Registry of in-flight PullProgress records for one transfer
+    manager, plus the stall watchdog sweep (driven by the owner's
+    existing periodic loop — no thread of its own)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pulls: Dict[int, PullProgress] = {}
+        self._next = 0
+        # peer -> stalled count last published (so recovery publishes 0
+        # exactly once instead of spamming the gauge forever).
+        self._published: Dict[str, int] = {}
+
+    def start(self, oid: str, peer: str, size: int) -> PullProgress:
+        p = PullProgress(oid, peer, size)
+        with self._lock:
+            self._next += 1
+            p_id = self._next
+            self._pulls[p_id] = p
+        p._id = p_id  # type: ignore[attr-defined]
+        return p
+
+    def finish(self, p: Optional[PullProgress]) -> None:
+        if p is None:
+            return
+        with self._lock:
+            self._pulls.pop(getattr(p, "_id", -1), None)
+
+    def inflight(self) -> list:
+        with self._lock:
+            pulls = list(self._pulls.values())
+        return [p.snapshot() for p in pulls]
+
+    def sweep(self, stall_warn_s: float) -> list:
+        """One watchdog pass: publish per-peer stalled gauges (live
+        while stuck, back to zero on recovery) and return the pulls
+        that JUST entered a stall episode (caller emits the deduped
+        WARNING + flight-recorder record). Never raises."""
+        newly_stalled = []
+        try:
+            now = time.monotonic()
+            with self._lock:
+                pulls = list(self._pulls.values())
+            counts: Dict[str, int] = {}
+            for p in pulls:
+                idle = now - p.last_progress_ts
+                if stall_warn_s > 0 and idle > stall_warn_s:
+                    counts[p.peer] = counts.get(p.peer, 0) + 1
+                    if not p.stalled:
+                        p.stalled = True
+                        newly_stalled.append(p)
+            with self._lock:
+                for peer in set(self._published) | set(counts):
+                    n = counts.get(peer, 0)
+                    if self._published.get(peer) != n:
+                        set_stalled(peer, n)
+                        if n:
+                            self._published[peer] = n
+                        else:
+                            self._published.pop(peer, None)
+        except Exception:  # pragma: no cover - telemetry must not break
+            pass
+        return newly_stalled
+
+
+def pull_tracker() -> Optional[PullTracker]:
+    """Tracker factory, or None when the plane is disabled (callers
+    treat a None tracker as a full no-op)."""
+    if not ENABLED:
+        return None
+    try:
+        return PullTracker()
+    except Exception:  # pragma: no cover
+        return None

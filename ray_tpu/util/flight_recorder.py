@@ -35,8 +35,11 @@ FLUSH_INTERVAL_S = 1.0
 
 # Retention reasons, in severity order for display. "slow" is decided by
 # the rolling threshold; "slow_op" is a control-plane op that exceeded
-# rpc_slow_op_s; the rest are asserted by the observing surface.
-REASONS = ("chaos", "error", "expired", "shed", "slow", "slow_op")
+# rpc_slow_op_s; "stalled_pull" is a data-plane pull with no byte
+# progress past transfer_stall_warn_s; the rest are asserted by the
+# observing surface.
+REASONS = ("chaos", "error", "expired", "shed", "slow", "slow_op",
+           "stalled_pull")
 
 # ---- metric surface (validated by the rtlint obs pass) ---------------------
 

@@ -141,6 +141,13 @@ def summarize_actors() -> Dict[str, int]:
 
 def summarize_objects() -> Dict[str, Any]:
     objs = list_objects()
+    by_state: Dict[str, int] = {}
+    by_owner: Dict[str, int] = {}
+    for o in objs:
+        st = o.get("state") or o.get("where") or "?"
+        by_state[st] = by_state.get(st, 0) + 1
+        owner = o.get("owner") or "?"
+        by_owner[owner] = by_owner.get(owner, 0) + 1
     return {
         "total_objects": len(objs),
         # In-flight/spilled rows may have no size yet: count them as 0
@@ -150,4 +157,8 @@ def summarize_objects() -> Dict[str, Any]:
             where: sum(1 for o in objs if o["where"] == where)
             for where in {o["where"] for o in objs}
         },
+        # Lifecycle + producer breakdowns from the census enrichment
+        # (owner "?" = pre-census rows or the plane disabled).
+        "by_state": by_state,
+        "by_owner": by_owner,
     }

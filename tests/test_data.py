@@ -156,6 +156,7 @@ def test_trainer_dataset_integration(ray_tpu_start, tmp_path):
     assert result.metrics["rows"] == 20
 
 
+@pytest.mark.slow
 def test_distributed_shuffle_and_sort(ray_tpu_start):
     """random_shuffle / sort / repartition run as two-stage shuffles over
     remote tasks: partitions live in the object store, not the driver."""
@@ -297,6 +298,7 @@ def test_groupby_distributed_combiners(ray_tpu_start):
     assert all(c == 200 for c in counts.values())
 
 
+@pytest.mark.slow
 def test_map_groups_via_hash_shuffle(ray_tpu_start):
     ds = rd.range(100, override_num_blocks=5).map_batches(
         lambda b: {"k": b["id"] % 4, "v": b["id"]}
@@ -681,6 +683,7 @@ def test_read_mongo_fake_client():
     assert ds2.count() == 4
 
 
+@pytest.mark.slow
 def test_push_based_shuffle_parity(ray_tpu_start):
     """Push-based shuffle (rounds of maps + merge stage) produces
     byte-identical results to the simple plan for random_shuffle, sort

@@ -2,6 +2,7 @@
 python/ray/train/tests/test_xgboost_trainer.py + test_batch_predictor)."""
 
 import numpy as np
+import pytest
 
 import ray_tpu
 from ray_tpu import data as rd
@@ -39,6 +40,7 @@ def test_gbdt_train_and_predict(ray_tpu_start, tmp_path):
     assert list(preds) == [1, 0]
 
 
+@pytest.mark.slow
 def test_batch_predictor_over_dataset(ray_tpu_start, tmp_path):
     ds = _make_ds()
     result = GBDTTrainer(

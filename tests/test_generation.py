@@ -40,6 +40,7 @@ def test_cached_prefill_matches_forward():
     assert list(np.asarray(cache.lengths)) == [8, 8]
 
 
+@pytest.mark.slow
 def test_generate_matches_naive():
     cfg = LlamaConfig.tiny()
     params = init_params(cfg, jax.random.PRNGKey(0))
@@ -65,6 +66,7 @@ def test_decode_respects_active_mask():
     )
 
 
+@pytest.mark.slow
 def test_moe_cached_decode_matches_naive():
     """MoE (Mixtral-style) models decode through the KV cache (r1 gap:
     generation.py raised NotImplementedError for MoE)."""

@@ -84,6 +84,7 @@ def test_llama_sharded_matches_single_device():
                                atol=3e-4, rtol=3e-4)
 
 
+@pytest.mark.slow
 def test_resnet18_forward_and_grad():
     import optax
 

@@ -531,6 +531,7 @@ def test_chaos_direct_channel_io_fires_through_pump(ray_tpu_start):
 
 
 @pytest.mark.parametrize("suite", ["tests/test_actor_direct.py"])
+@pytest.mark.slow
 def test_forced_fallback_runs_direct_suite_pure_python(suite):
     """RTPU_NO_NATIVE=1 must leave the whole direct-plane suite green on
     the pure-Python path — the fallback is a first-class mode, not a

@@ -1,0 +1,394 @@
+"""Data-plane observability (util/data_obs.py + the GCS ObjectService):
+cluster object census, leak detection, transfer-stall watchdogs, and the
+per-link bandwidth matrix.
+
+Acceptance bars exercised here (ISSUE: data-plane observability):
+  - census fan-out degrades to a PARTIAL reply when a node dies (never
+    a hang), and rows carry state/owner/age enrichment;
+  - the head leak sweep flags an orphaned object within
+    ``object_leak_warn_s`` with exactly ONE deduped WARNING, and the
+    leak gauges clear on GC;
+  - a chaos-stalled pull raises the LIVE stalled{peer} gauge WHILE the
+    pull is stuck, emits one deduped WARNING, drops a flight-recorder
+    record (reason ``stalled_pull``) joinable by the pull's oid, and
+    the gauge returns to zero on recovery;
+  - pulled bytes land in the (src,dst) link-bandwidth matrix;
+  - a mid-pull data-channel death leaves every inflight gauge at zero
+    (satellite: object_transfer error-path accounting audit);
+  - ``RTPU_NO_DATA_OBS=1`` turns the whole plane into a no-op.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import faults
+
+CHUNK = 256 * 1024  # shrink chunks so 1 MiB objects stripe
+
+STALL_WARN_S = 0.5
+STALL_DELAY_S = 4.0
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(
+        head_resources={"CPU": 2},
+        system_config={
+            "num_prestart_workers": 1,
+            "default_max_retries": 0,
+            "object_transfer_chunk_bytes": CHUNK,
+            "transfer_stall_warn_s": STALL_WARN_S,
+            "object_leak_warn_s": 1.0,
+            # GC must not race the leak sweep: zero-ref entries stay
+            # put so the sweep (not the collector) decides their fate.
+            "gc_grace_period_s": 600.0,
+            "log_to_driver": False,
+        },
+    )
+    c.add_node(num_cpus=1, resources={"gadget": 1})
+    yield c
+    try:
+        _arm([])
+    except Exception:
+        pass
+    faults.clear()
+    c.shutdown()
+
+
+def _nm():
+    from ray_tpu.core.runtime_context import current_runtime
+
+    return current_runtime()._nm
+
+
+def _rt():
+    from ray_tpu.core.runtime_context import current_runtime
+
+    return current_runtime()
+
+
+def _arm(specs):
+    nm = _nm()
+    return nm.call_sync(nm._gcs.chaos_arm(specs), timeout=30)
+
+
+def _poll(fn, timeout=15.0, interval=0.05):
+    """Poll ``fn`` until truthy or timeout; returns the last value."""
+    deadline = time.monotonic() + timeout
+    val = fn()
+    while not val and time.monotonic() < deadline:
+        time.sleep(interval)
+        val = fn()
+    return val
+
+
+def _series(name):
+    """This process's live series for one metric: {tags_key: value}.
+    Reads the in-process registry directly — the head NM shares the
+    test process, so data-plane gauges are visible without the KV
+    pipeline's flush latency."""
+    from ray_tpu.util.metrics import _registry
+
+    with _registry.lock:
+        _kind, series = _registry.metrics.get(name, ("", {}))
+        return dict(series)
+
+
+def _object_events(substr, timeout=0.0):
+    """OBJECT_STORE WARNINGs whose message contains ``substr``."""
+    from ray_tpu.util.state import list_cluster_events
+
+    def fetch():
+        return [e for e in list_cluster_events(source="OBJECT_STORE")
+                if e.get("severity") == "WARNING"
+                and substr in (e.get("message") or "")]
+
+    if timeout:
+        return _poll(fetch, timeout=timeout)
+    return fetch()
+
+
+# ------------------------------------------------------------------ census
+
+
+def test_census_rows_states_owners_and_totals(cluster):
+    """cluster_objects merges every node's index with lifecycle state,
+    producer owner, and store totals."""
+    ref = ray_tpu.put(np.zeros(1 << 20, dtype=np.uint8))
+
+    @ray_tpu.remote(resources={"gadget": 0.1})
+    def make():
+        return b"x" * 4096
+
+    got = ray_tpu.get(make.remote())
+    assert got == b"x" * 4096
+    census = _rt().cluster_objects(limit=100)
+    assert census["errors"] == {}
+    assert len(census["nodes"]) == 2
+    rows = [r for n in census["nodes"] for r in n["objects"]]
+    owners = {r["owner"] for r in rows}
+    assert "put" in owners and "make" in owners
+    assert all(r["state"] for r in rows)
+    assert any(r["state"] == "in-memory" and r["size_bytes"] >= (1 << 20)
+               for r in rows)
+    # Age enrichment live while the plane is on.
+    assert all(r["age_s"] is not None for r in rows)
+    head = next(n for n in census["nodes"] if n["is_head"])
+    assert head["used_bytes"] >= (1 << 20)
+    assert head["capacity_bytes"] >= 0
+    del ref
+
+
+def test_census_partial_when_node_dies(cluster):
+    """A dead node degrades the census to a partial reply — its hex in
+    ``errors`` or gone from ``nodes`` — instead of hanging the call."""
+
+    @ray_tpu.remote(resources={"gadget": 0.1})
+    def touch():
+        return 1
+
+    assert ray_tpu.get(touch.remote()) == 1
+    assert len(_rt().cluster_objects(limit=10)["nodes"]) == 2
+    cluster.remove_node(cluster._nodes[0])
+    t0 = time.monotonic()
+    census = _rt().cluster_objects(limit=10)
+    assert time.monotonic() - t0 < 25.0  # partial, never a hang
+    head_rows = [n for n in census["nodes"] if n["is_head"]]
+    assert len(head_rows) == 1
+    # The dead node either already left the alive set or landed in
+    # errors — both are partial results, not a hang.
+    assert len(census["nodes"]) == 1 or census["errors"]
+
+
+# ---------------------------------------------------------- leak detection
+
+
+def test_leak_detector_fires_once_and_clears_on_gc(cluster):
+    """An orphaned sealed object (zero refs, nobody collecting it) is
+    flagged within object_leak_warn_s: leak gauges rise, exactly one
+    WARNING fires, and GC clears the gauges."""
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_store import InlineLocation
+
+    nm = _nm()
+    oid = ObjectID.from_random()
+    nm.directory.add(oid, InlineLocation(b"z" * 2048), initial_refs=0,
+                     owner="orphan")
+
+    evts = _object_events("LEAK suspected", timeout=15.0)
+    assert evts, "leak sweep never flagged the orphan"
+    assert any((e.get("custom_fields") or {}).get("object_id")
+               == oid.hex() for e in evts)
+    leaked = _poll(lambda: [v for v in
+                            _series("ray_tpu_object_leaked_total")
+                            .values() if v], timeout=10.0)
+    assert leaked and max(leaked) >= 1
+    bytes_vals = _series("ray_tpu_object_leaked_bytes").values()
+    assert max(bytes_vals) >= 2048
+
+    # Deduped: two more sweep periods must not re-warn the same oid.
+    time.sleep(1.5)
+    n_before = len([e for e in _object_events("LEAK suspected")
+                    if (e.get("custom_fields") or {}).get("object_id")
+                    == oid.hex()])
+    assert n_before == 1
+
+    # GC the orphan: the next sweep publishes zero.
+    nm.directory.collect_garbage(0.0)
+    cleared = _poll(
+        lambda: all(v == 0 for v in
+                    _series("ray_tpu_object_leaked_total").values()),
+        timeout=10.0,
+    )
+    assert cleared, _series("ray_tpu_object_leaked_total")
+
+
+# ------------------------------------------------------------ stall watchdog
+
+
+def test_stalled_pull_live_gauge_warning_and_flight_record(cluster):
+    """Sticky data-channel latency stalls a pull: the stalled{peer}
+    gauge is nonzero WHILE the pull is stuck, exactly one WARNING
+    fires, a flight-recorder record (reason stalled_pull) joins by the
+    pull's oid, and the gauge returns to zero after recovery."""
+    nbytes = 1 << 20
+
+    @ray_tpu.remote(resources={"gadget": 1})
+    def produce():
+        return np.ones(nbytes, dtype=np.uint8)
+
+    _arm([{"point": "data_channel_io", "mode": "always",
+           "action": "latency", "delay_s": STALL_DELAY_S}])
+
+    result = {}
+
+    def puller():
+        result["data"] = ray_tpu.get(produce.remote(), timeout=120)
+
+    th = threading.Thread(target=puller)
+    th.start()
+    try:
+        # LIVE while stuck: the gauge must rise before the pull ends.
+        stalled = _poll(
+            lambda: (not th.is_alive() or
+                     any(v >= 1 for v in
+                         _series("ray_tpu_object_transfer_stalled")
+                         .values())),
+            timeout=STALL_DELAY_S + 20.0,
+        )
+        assert stalled
+        assert th.is_alive(), \
+            "pull finished before the watchdog could be observed"
+        assert any(v >= 1 for v in
+                   _series("ray_tpu_object_transfer_stalled").values())
+        # The census inflight table shows the same stall.
+        pulls = _nm()._transfer.inflight_pulls()
+        assert pulls and any(p["stalled"] for p in pulls)
+    finally:
+        th.join(timeout=120)
+    assert result["data"].nbytes == nbytes  # recovered, byte-exact
+
+    evts = _object_events("TRANSFER stalled", timeout=15.0)
+    assert len(evts) == 1, [e.get("message") for e in evts]
+    oid_hex = (evts[0].get("custom_fields") or {}).get("object_id")
+    assert oid_hex
+
+    from ray_tpu.util import flight_recorder
+
+    recs = _poll(lambda: flight_recorder.list_cluster(
+        reason="stalled_pull", limit=50), timeout=10.0)
+    assert recs, "no stalled_pull flight-recorder record"
+    rec = next(r for r in recs if oid_hex[:8] in r["name"])
+    assert rec["trace_id"] == oid_hex[:32]  # joinable via `rtpu trace`
+    assert "peer=" in (rec.get("detail") or "")
+
+    _arm([])
+    cleared = _poll(
+        lambda: all(v == 0 for v in
+                    _series("ray_tpu_object_transfer_stalled")
+                    .values()),
+        timeout=10.0,
+    )
+    assert cleared, _series("ray_tpu_object_transfer_stalled")
+    assert _nm()._transfer.inflight_pulls() == []
+
+
+# ------------------------------------------------------- bandwidth matrix
+
+
+def test_link_bandwidth_matrix_accounts_pulled_bytes(cluster):
+    """A cross-node pull lands its payload in the directed (src,dst)
+    link counter feeding `rtpu transfers`."""
+    nbytes = 1 << 20
+
+    @ray_tpu.remote(resources={"gadget": 1})
+    def produce():
+        return np.full(nbytes, 7, dtype=np.uint8)
+
+    got = ray_tpu.get(produce.remote(), timeout=120)
+    assert got.nbytes == nbytes
+    nm = _nm()
+    dst = nm.node_id.hex()[:8]
+    series = _series("ray_tpu_transfer_link_bytes_total")
+    moved = {}
+    for tags_key, val in series.items():
+        tags = dict(tags_key)
+        moved[(tags.get("src"), tags.get("dst"))] = val
+    into_head = {k: v for k, v in moved.items() if k[1] == dst}
+    assert into_head, f"no link series toward {dst}: {moved}"
+    assert sum(into_head.values()) >= nbytes
+
+
+# --------------------------------------- satellite: error-path accounting
+
+
+def test_channel_death_mid_pull_releases_inflight_gauges(cluster):
+    """Killing the striped data plane mid-pull (partition injection)
+    falls back to control-plane chunks AND leaves every inflight meter
+    at zero — no leaked _set_inflight/_inflight_bytes bookkeeping."""
+    nbytes = 1 << 20
+
+    @ray_tpu.remote(resources={"gadget": 1})
+    def produce():
+        rng = np.random.RandomState(7)
+        return rng.randint(0, 255, size=nbytes, dtype=np.uint8)
+
+    nm = _nm()
+    st = nm._transfer.stats
+    fallbacks_before = st["fallback_pulls"]
+    _arm([{"point": "data_channel_io", "mode": "always",
+           "action": "partition"}])
+    got = ray_tpu.get(produce.remote(), timeout=120)
+    rng = np.random.RandomState(7)
+    assert np.array_equal(got, rng.randint(0, 255, size=nbytes,
+                                           dtype=np.uint8))
+    assert st["fallback_pulls"] > fallbacks_before, st
+
+    assert nm._transfer._inflight_bytes == 0
+    assert nm._transfer.inflight_by_peer() == {}
+    assert nm._transfer.inflight_pulls() == []
+    # The per-peer inflight gauge series all ended back at zero.
+    assert all(v == 0 for v in
+               _series("ray_tpu_object_transfer_inflight").values())
+
+
+# ------------------------------------------------------------- kill switch
+
+
+def test_no_data_obs_env_disables_the_plane():
+    """RTPU_NO_DATA_OBS=1: factories return None, publishes no-op (no
+    series materialize), census rows degrade to age-less/owner-less."""
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import ray_tpu
+from ray_tpu.util import data_obs
+
+assert data_obs.ENABLED is False
+assert data_obs.pull_tracker() is None
+data_obs.record_link_bytes("a", "b", 123, flush=True)
+data_obs.record_spill("spill", 456)
+data_obs.set_stalled("p", 3)
+data_obs.set_leaked(1, 2)
+from ray_tpu.util.metrics import _registry
+for name in ("ray_tpu_transfer_link_bytes_total",
+             "ray_tpu_object_transfer_stalled",
+             "ray_tpu_object_leaked_total",
+             "ray_tpu_spill_ops_total"):
+    assert name not in _registry.metrics, name
+
+from ray_tpu.cluster_utils import Cluster
+
+c = Cluster(head_resources={"CPU": 1},
+            system_config={"log_to_driver": False})
+try:
+    ref = ray_tpu.put(b"x" * 100_000)
+    from ray_tpu.core.runtime_context import current_runtime
+
+    nm = current_runtime()._nm
+    assert nm._transfer is None or nm._transfer._tracker is None
+    census = current_runtime().cluster_objects(limit=10)
+    rows = [r for n in census["nodes"] for r in n["objects"]]
+    assert rows
+    assert all(r["created_ts"] is None and r["age_s"] is None
+               and r["owner"] == "" for r in rows)
+finally:
+    c.shutdown()
+print("NOOP_OK")
+"""
+    env = dict(os.environ)
+    env["RTPU_NO_DATA_OBS"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=180,
+    )
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "NOOP_OK" in out.stdout

@@ -352,30 +352,44 @@ def test_timeline_deferred_timer_cancelled_on_flush():
 def test_cmd_memory_sorts_once_and_reports_total(monkeypatch, capsys):
     from ray_tpu.scripts import cli
 
-    rows = [
-        {"object_id": "aa", "size_bytes": 100, "refcount": 1,
-         "where": "shm", "node_id": "n1" * 8},
-        {"object_id": "bb", "size_bytes": None, "refcount": 1,
-         "where": "spilled", "node_id": "n1" * 8},
-        {"object_id": "cc", "size_bytes": 900, "refcount": 2,
-         "where": "shm", "node_id": "n1" * 8},
-        {"object_id": "dd", "size_bytes": 500, "refcount": 1,
-         "where": "inline", "node_id": "n1" * 8},
-    ]
+    census = {
+        "nodes": [{
+            "node_id": "n1" * 16,
+            "used_bytes": 1500, "capacity_bytes": 10_000,
+            "spilled_bytes": 0, "inflight_pulls": [],
+            "objects": [
+                {"object_id": "aa", "size_bytes": 100, "refcount": 1,
+                 "state": "in-memory", "owner": "put"},
+                {"object_id": "bb", "size_bytes": None, "refcount": 1,
+                 "state": "spilled", "owner": ""},
+                {"object_id": "cc", "size_bytes": 900, "refcount": 2,
+                 "state": "in-memory", "owner": "make"},
+                {"object_id": "dd", "size_bytes": 500, "refcount": 1,
+                 "state": "in-memory", "owner": "put"},
+            ],
+        }],
+        "errors": {"f0" * 16: "peer unreachable"},
+    }
 
     class _FakeRayTpu:
         @staticmethod
         def shutdown():
             pass
 
+    class _FakeRuntime:
+        @staticmethod
+        def cluster_objects(limit=10_000):
+            return census
+
     monkeypatch.setattr(cli, "_attached", lambda args: _FakeRayTpu)
     monkeypatch.setattr(
-        "ray_tpu.util.state.list_objects",
-        lambda limit=10_000: list(rows),
+        "ray_tpu.core.runtime_context.current_runtime",
+        lambda: _FakeRuntime,
     )
 
     class _Args:
         limit = 2
+        watch = None
 
     assert cli.cmd_memory(_Args()) == 0
     out = capsys.readouterr().out
@@ -383,11 +397,17 @@ def test_cmd_memory_sorts_once_and_reports_total(monkeypatch, capsys):
     # Sorted by size desc, sliced once to the display limit: the two
     # BIGGEST objects are shown, the rest only count toward TOTAL.
     assert "cc" in lines[1] and "dd" in lines[2]
-    assert "aa" not in out and "bb" not in out
+    assert "aa" not in lines[1] and "bb" not in out
     total_line = next(line for line in lines if "TOTAL" in line)
     # TOTAL covers ALL 4 objects (1500 bytes), not just the 2 shown.
     assert "4 objects" in total_line and "2 shown" in total_line
     assert "1500" in total_line
+    # Census enrichment: per-state totals, per-owner aggregation, the
+    # shared store footer, and unreachable nodes degrade visibly.
+    assert "in-memory: 3 objects" in out
+    assert "by owner:" in out and "make=1/" in out
+    assert "store:" in out
+    assert "node f0f0f0f0: unreachable" in out
 
 
 def test_cli_stack_and_profile_parsers():

@@ -158,6 +158,7 @@ def test_borrow_chain_second_hop(edge_cluster):
     assert isinstance(out, np.ndarray) and out.shape == (300_000,)
 
 
+@pytest.mark.slow
 def test_borrow_then_owner_node_dies():
     """The owner node dies while a borrow is live: the borrower's read
     must fail CLEANLY (or reconstruct) — never hang (ref analogue:

@@ -86,6 +86,7 @@ def test_replay_buffers_unit():
     assert "weights" in mb and mb["weights"].max() <= 1.0
 
 
+@pytest.mark.slow
 def test_dqn_learns_cartpole(ray_tpu_start):
     pytest.importorskip("gymnasium")
     from ray_tpu.rllib import DQNConfig
@@ -122,6 +123,7 @@ def test_dqn_learns_cartpole(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_impala_learns_cartpole(ray_tpu_start):
     pytest.importorskip("gymnasium")
     from ray_tpu.rllib import IMPALAConfig
@@ -197,6 +199,7 @@ def _go_to_zero_env():
     return GoToZero()
 
 
+@pytest.mark.slow
 def test_sac_learns_continuous_control(ray_tpu_start):
     """SAC on a Box action space: reward improves toward the a=-x optimum
     (ref analogue: rllib/algorithms/sac)."""
@@ -227,6 +230,7 @@ def test_sac_learns_continuous_control(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_bc_offline_discrete(ray_tpu_start):
     """Offline behavior cloning from a ray_tpu.data Dataset: the cloned
     policy reproduces a deterministic expert (ref: rllib/algorithms/bc
@@ -261,6 +265,7 @@ def test_bc_offline_discrete(ray_tpu_start):
     assert (got == want).mean() > 0.9, (got[:10], want[:10])
 
 
+@pytest.mark.slow
 def test_bc_offline_continuous(ray_tpu_start):
     """Continuous BC: squashed-mean regression toward a = -obs."""
     import ray_tpu.data as rd
@@ -320,6 +325,7 @@ def _two_team_env():
     return TwoTeam()
 
 
+@pytest.mark.slow
 def test_multi_agent_ppo_shared_policy(ray_tpu_start):
     """Multi-agent PPO with a shared policy learns the signal-matching
     task (ref: MultiAgentEnv + policy_mapping_fn)."""
@@ -349,6 +355,7 @@ def test_multi_agent_ppo_shared_policy(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_multi_agent_independent_policies(ray_tpu_start):
     """Distinct policy ids train independent weights."""
     from ray_tpu.rllib import MultiAgentPPOConfig
@@ -374,6 +381,7 @@ def test_multi_agent_independent_policies(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_appo_async_learns_cartpole(ray_tpu_start):
     """APPO: asynchronous sampling (runners never barrier) + IS-clipped
     PPO loss on the shared Learner layer; reward improves (ref:
@@ -405,6 +413,7 @@ def test_appo_async_learns_cartpole(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_appo_remote_learner_group(ray_tpu_start):
     """LearnerGroup remote mode: the learner lives in its own actor
     (the learner/actor split), and training still advances."""
@@ -427,6 +436,7 @@ def test_appo_remote_learner_group(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_td3_learns_continuous_control(ray_tpu_start):
     """TD3 on a Box action space: twin critics + delayed deterministic
     actor move reward toward the a=-x optimum (ref:
@@ -491,6 +501,7 @@ def test_learner_layer_unit():
     assert lrn.update(tgt)["dist"] <= last["dist"] * 1.5
 
 
+@pytest.mark.slow
 def test_cql_offline_continuous(ray_tpu_start):
     """CQL trains offline from a transitions Dataset: TD loss falls, the
     conservative penalty is active, and the learned deterministic actor

@@ -89,6 +89,7 @@ def _sign_env():
     return Sign()
 
 
+@pytest.mark.slow
 def test_ddpg_learns_continuous_control(ray_tpu_start):
     """DDPG (single critic, undelayed actor) reaches the a=-x optimum
     (ref: rllib/algorithms/ddpg)."""
@@ -119,6 +120,7 @@ def test_ddpg_learns_continuous_control(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_a2c_learns_sign_task(ray_tpu_start):
     """A2C (single-epoch policy gradient) solves sign matching (ref:
     rllib/algorithms/a2c)."""
@@ -146,6 +148,7 @@ def test_a2c_learns_sign_task(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_marwil_prefers_high_return_actions(ray_tpu_start):
     """MARWIL up-weights better-than-average logged actions: when only
     30% of the logged rows take the (high-return) expert action, BC
@@ -264,6 +267,7 @@ def test_bandit_linear(ray_tpu_start, mode):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_es_learns_sign_task(ray_tpu_start):
     """ES improves the deterministic policy purely by parameter-space
     search (ref: rllib/algorithms/es)."""
@@ -293,6 +297,7 @@ def test_es_learns_sign_task(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_ars_learns_sign_task(ray_tpu_start):
     """ARS (top-k directions, std-normalized step) matches ES on the
     toy task (ref: rllib/algorithms/ars)."""

@@ -4,6 +4,7 @@ import sys as _sys
 
 import cloudpickle as _cloudpickle
 import numpy as np
+import pytest
 
 # Env factories are module-level; workers cannot import this test
 # module, so ship everything from it by value.
@@ -94,6 +95,7 @@ def test_nstep_returns_unit():
     np.testing.assert_allclose(tr[NEXT_OBS][1], nxt[2])
 
 
+@pytest.mark.slow
 def test_dqn_dueling_nstep_learns(ray_tpu_start):
     """DQN with dueling heads + 3-step returns still learns the sign
     task (ref: the reference DQN's `dueling` and `n_step` options)."""
@@ -122,6 +124,7 @@ def test_dqn_dueling_nstep_learns(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_apex_dqn_learns(ray_tpu_start):
     """Ape-X: replay actor + epsilon ladder + async rollouts learn the
     sign task (ref: rllib/algorithms/apex_dqn)."""
@@ -192,6 +195,7 @@ def _coop_env():
     return Coop()
 
 
+@pytest.mark.slow
 def test_qmix_learns_cooperative_task(ray_tpu_start):
     """QMIX: shared utility net + monotonic mixer solves the
     cooperative sign task (ref: rllib/algorithms/qmix)."""
@@ -222,6 +226,7 @@ def test_qmix_learns_cooperative_task(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_crr_offline_continuous(ray_tpu_start):
     """CRR: advantage-filtered regression distills a better-than-
     behavior policy from noisy logged data (ref:

@@ -4,6 +4,7 @@ import sys as _sys
 
 import cloudpickle as _cloudpickle
 import numpy as np
+import pytest
 
 _cloudpickle.register_pickle_by_value(_sys.modules[__name__])
 
@@ -47,6 +48,7 @@ def _coop_push_env():
     return CoopPush()
 
 
+@pytest.mark.slow
 def test_maddpg_learns_cooperative_control(ray_tpu_start):
     """MADDPG: centralized critics + decentralized actors drive the
     shared reward toward 0 (ref: rllib/algorithms/maddpg)."""
@@ -116,6 +118,7 @@ def _memory_env():
     return Memory()
 
 
+@pytest.mark.slow
 def test_r2d2_learns_memory_task(ray_tpu_start):
     """R2D2's LSTM + stored-state sequence replay solves a task that
     requires memory (ref: rllib/algorithms/r2d2)."""
@@ -147,6 +150,7 @@ def test_r2d2_learns_memory_task(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_alpha_zero_tictactoe(ray_tpu_start):
     """AlphaZero self-play on TicTacToe: losses fall, the RAW policy
     (no search) learns sensible openings, and MCTS play never loses to
@@ -200,6 +204,7 @@ def test_alpha_zero_tictactoe(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_decision_transformer_offline(ray_tpu_start):
     """DT conditioned on HIGH return imitates the good behavior in a
     mixed-quality offline dataset; conditioned evaluation beats the

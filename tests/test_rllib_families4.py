@@ -4,6 +4,7 @@ import sys as _sys
 
 import cloudpickle as _cloudpickle
 import numpy as np
+import pytest
 
 _cloudpickle.register_pickle_by_value(_sys.modules[__name__])
 
@@ -80,6 +81,7 @@ def _sign_env():
     return Sign()
 
 
+@pytest.mark.slow
 def test_apex_ddpg_learns(ray_tpu_start):
     """Ape-X DDPG: replay actor + noise ladder + async rollouts on
     continuous control (ref: rllib/algorithms/apex_ddpg)."""
@@ -109,6 +111,7 @@ def test_apex_ddpg_learns(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_ddppo_learns_sign_task(ray_tpu_start):
     """DD-PPO: per-worker learners with averaged gradients stay in
     lockstep and learn (ref: rllib/algorithms/ddppo)."""
@@ -203,6 +206,7 @@ def _recsys_env():
     return RecSys()
 
 
+@pytest.mark.slow
 def test_slateq_learns_recommendation(ray_tpu_start):
     """SlateQ's decomposition learns to fill slates with high-value
     items (ref: rllib/algorithms/slateq)."""
@@ -261,6 +265,7 @@ def test_pg_learns_sign_task(ray_tpu_start):
         algo.stop()
 
 
+@pytest.mark.slow
 def test_a3c_learns_sign_task(ray_tpu_start):
     """A3C: per-worker gradients applied asynchronously as they land
     (ref: rllib/algorithms/a3c)."""
@@ -326,6 +331,7 @@ def _memory_env():
     return Memory()
 
 
+@pytest.mark.slow
 def test_recurrent_ppo_learns_memory_task(ray_tpu_start):
     """PPO with an LSTM policy (the reference's use_lstm option)
     solves a memory task feedforward PPO cannot."""

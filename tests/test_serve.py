@@ -107,6 +107,7 @@ def test_deployment_error_propagates(serve_cluster):
         handle.remote(1).result(timeout=30)
 
 
+@pytest.mark.slow
 def test_autoscaling_up_and_down(serve_cluster):
     """AutoscalingConfig drives the replica count from handle queue depth
     (ref: autoscaling_policy.py): load pushes replicas up to max, idleness

@@ -32,6 +32,7 @@ def test_engine_single_request_matches_generate(tiny_model):
         engine.shutdown()
 
 
+@pytest.mark.slow
 def test_engine_concurrent_requests_continuous_batching(tiny_model):
     cfg, params = tiny_model
     engine = LLMEngine(cfg, params, max_batch=4, max_len=64)
@@ -79,6 +80,7 @@ def test_engine_ttft_recorded(tiny_model):
         engine.shutdown()
 
 
+@pytest.mark.slow
 def test_llm_serve_deployment(ray_tpu_start):
     import ray_tpu
     from ray_tpu import serve
@@ -159,6 +161,7 @@ def test_engine_token_streaming(tiny_model):
         engine.shutdown()
 
 
+@pytest.mark.slow
 def test_llm_serve_sse_streaming(ray_tpu_start):
     """End-to-end: HTTP proxy streams SSE tokens from the LLM decode loop
     as they are generated (VERDICT r2 ask #4)."""

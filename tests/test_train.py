@@ -116,6 +116,7 @@ def test_trainer_error_surfaces(ray_tpu_start, tmp_path):
     assert "exploded" in str(result.error)
 
 
+@pytest.mark.slow
 def test_resnet_cifar_e2e(ray_tpu_start, tmp_path):
     """The PR1 reference config: ResNet-18, synthetic CIFAR-10, 1 CPU worker
     (BASELINE.json configs[0]) — loss must decrease."""

@@ -261,6 +261,7 @@ def test_gang_restart_on_dead_rank(ray_tpu_start, tmp_path):
     assert _train_events(), "expected TRAIN cluster events"
 
 
+@pytest.mark.slow
 def test_gang_abort_on_hung_rank(ray_tpu_start, tmp_path):
     """A rank that hangs between collectives (process alive, heartbeat
     flowing, step counter frozen while the gang moves on) is detected
@@ -296,6 +297,7 @@ def test_gang_abort_on_hung_rank(ray_tpu_start, tmp_path):
     assert evts, "expected a WARNING TRAIN gang-abort event (hang)"
 
 
+@pytest.mark.slow
 def test_chaos_kill_mid_step_matches_uninterrupted(ray_tpu_start, tmp_path):
     """THE acceptance run: gang=2 multi-process JaxTrainer, rank 1
     killed mid-step via the train_worker fault point, restart from the

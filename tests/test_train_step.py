@@ -26,6 +26,7 @@ def _loss_and_grads(cfg, params, tokens):
 
 # ------------------------------------------------------------- parity
 
+@pytest.mark.slow
 def test_scan_chunk_parity_loss_and_grads():
     """Every scan schedule (classic K=1, chunked K=2, degenerate K=L) and
     the unrolled loop compute bitwise-close loss AND grads: the chunk
@@ -98,6 +99,7 @@ def test_compiled_step_smoke_and_compile_cache():
     assert step.num_params(params) > 0
 
 
+@pytest.mark.slow
 def test_compiled_step_donation_off():
     cfg = _tiny(depth=2, scan_layers=True, scan_chunk=2)
     step = CompiledTrainStep(cfg, donate=False)
@@ -108,6 +110,7 @@ def test_compiled_step_donation_off():
     assert step.token_sharding() is None
 
 
+@pytest.mark.slow
 def test_compiled_step_chunked_matches_unrolled_training():
     """Three steps of chunked-scan training == three steps of unrolled
     training from the same init (the whole fused program is schedule-
@@ -135,6 +138,7 @@ def test_compiled_step_chunked_matches_unrolled_training():
 
 # ----------------------------------------------------- sharded (mesh)
 
+@pytest.mark.slow
 def test_compiled_step_sharded_matches_single_device():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")

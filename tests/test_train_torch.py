@@ -18,6 +18,7 @@ from ray_tpu.train import RunConfig, ScalingConfig, TorchTrainer
 _cloudpickle.register_pickle_by_value(_sys.modules[__name__])
 
 
+@pytest.mark.slow
 def test_torch_trainer_ddp_allreduce(ray_tpu_start, tmp_path):
     """Two workers join one gloo group; DDP averages gradients so both
     ranks hold identical updated weights after a step on different
@@ -93,6 +94,7 @@ def test_torch_trainer_single_worker_no_group(ray_tpu_start, tmp_path):
     assert result.metrics["ok"] == 1
 
 
+@pytest.mark.slow
 def test_torch_prepare_data_loader(ray_tpu_start, tmp_path):
     """prepare_data_loader shards the dataset: each rank sees half."""
     pytest.importorskip("torch")

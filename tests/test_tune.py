@@ -61,6 +61,7 @@ def test_tuner_trial_error_isolated(ray_tpu_start, tmp_path):
     assert grid.get_best_result().config["x"] == 2
 
 
+@pytest.mark.slow
 def test_asha_early_stops_bad_trials(ray_tpu_start, tmp_path):
     def trainable(config):
         import time
@@ -117,6 +118,7 @@ def test_hyperband_bracket_culling_unit():
             == CONTINUE
 
 
+@pytest.mark.slow
 def test_hyperband_integration(ray_tpu_start, tmp_path):
     """End-to-end HyperBand run: the best config wins."""
     def trainable(config):
@@ -139,6 +141,7 @@ def test_hyperband_integration(ray_tpu_start, tmp_path):
     assert best.config["q"] == 1.0
 
 
+@pytest.mark.slow
 def test_pbt_exploits_and_mutates(ray_tpu_start, tmp_path):
     """PBT: bottom-quantile trials restart from a top trial's checkpoint
     with mutated hyperparameters and end up beating their original
@@ -196,6 +199,7 @@ def test_pbt_exploits_and_mutates(ray_tpu_start, tmp_path):
     assert best.metrics["score"] > 10.0
 
 
+@pytest.mark.slow
 def test_tuner_restore_resumes_incomplete(ray_tpu_start, tmp_path):
     """Tuner.restore: completed trials keep results; interrupted ones
     re-run from their last checkpoint (ref: Tuner.restore)."""
@@ -256,6 +260,7 @@ def test_tuner_restore_resumes_incomplete(ray_tpu_start, tmp_path):
     assert by_tag["a"].metrics["start"] == 2  # resumed, not restarted
 
 
+@pytest.mark.slow
 def test_bayesopt_search_converges(ray_tpu_start, tmp_path):
     """GP-EI search concentrates samples near the optimum of a smooth
     1-D objective (ref: BayesOptSearch)."""
@@ -445,6 +450,7 @@ def test_pb2_gp_explore_unit():
     assert out["lr"] > 0.03, out
 
 
+@pytest.mark.slow
 def test_pb2_integration(ray_tpu_start, tmp_path):
     """PB2 drives exploit/explore end to end (checkpoint handoff like
     PBT, GP-suggested configs within bounds)."""
@@ -505,6 +511,7 @@ def test_searcher_adapters_gated():
             HyperOptSearch(space, metric="score", mode="max")
 
 
+@pytest.mark.slow
 def test_tpe_search_converges(ray_tpu_start, tmp_path):
     """Native TPE (the BOHB sampler) concentrates samples near the
     optimum after the random phase (ref: TuneBOHB,
@@ -535,6 +542,7 @@ def test_tpe_search_converges(ray_tpu_start, tmp_path):
     assert best.metrics["obj"] > -1.0
 
 
+@pytest.mark.slow
 def test_bohb_scheduler_feeds_searcher(ray_tpu_start, tmp_path):
     """HyperBandForBOHB reports every rung result back to the attached
     TPESearch with its budget (the BOHB coupling, ref:

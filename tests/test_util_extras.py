@@ -215,6 +215,7 @@ def _make_wheel(tmp_path, name="rtpu_demo_pkg", version="0.1"):
     return str(whl)
 
 
+@pytest.mark.slow
 def test_runtime_env_pip_local_wheel(tmp_path):
     """A job's pip runtime env installs a package absent from the base
     env into a per-node hash-keyed venv; workers import it (VERDICT r3

@@ -22,11 +22,13 @@ if _ROOT not in sys.path:
 # Re-export the migrated validators (tests/test_observability.py calls
 # validate(); external tooling may use the others).
 from tools.rtlint.passes.obs import (  # noqa: E402,F401
-    ACTOR_CONFIG_KEYS, ACTOR_METRICS, DRAIN_CONFIG_KEYS, NATIVE_METRICS,
+    ACTOR_CONFIG_KEYS, ACTOR_METRICS, DATA_OBS_CONFIG_KEYS,
+    DATA_OBS_METRICS, DRAIN_CONFIG_KEYS, NATIVE_METRICS,
     OVERLOAD_CONFIG_KEYS, OVERLOAD_METRICS, PROFILER_CONFIG_KEYS,
     TRANSFER_CONFIG_KEYS, TRANSFER_METRICS, import_package_modules,
     validate, validate_actor_config, validate_actor_metrics,
     validate_dashboard_handlers, validate_data_channel_pickle_free,
+    validate_data_obs_config, validate_data_obs_metrics,
     validate_drain_config, validate_event_sites, validate_fault_points,
     validate_native_pump, validate_overload_config,
     validate_overload_metrics, validate_profiler_config,

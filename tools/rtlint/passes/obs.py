@@ -740,6 +740,62 @@ def validate_dispatch_config():
     ]
 
 
+# ---- data-plane observability lint -----------------------------------------
+# The object census / leak / stall / bandwidth surface (util/data_obs.py
+# gauges + counters set by object_transfer.py, spilling.py and the head
+# leak sweep) and its config knobs (README "Data-plane observability");
+# `rtpu objects` / `rtpu transfers` and the bench's obs_overhead row all
+# read these names, so a rename/kind change must fail CI, not dashboards.
+
+DATA_OBS_METRICS = {
+    "ray_tpu_object_leaked_total": "gauge",
+    "ray_tpu_object_leaked_bytes": "gauge",
+    "ray_tpu_object_transfer_stalled": "gauge",
+    "ray_tpu_transfer_link_bytes_total": "counter",
+    "ray_tpu_spill_ops_total": "counter",
+    "ray_tpu_spill_bytes_total": "counter",
+}
+
+DATA_OBS_CONFIG_KEYS = ("object_leak_warn_s", "transfer_stall_warn_s")
+
+
+def validate_data_obs_metrics(declared):
+    failures = []
+    for name, kind in sorted(DATA_OBS_METRICS.items()):
+        got = declared.get(name)
+        if got is None:
+            failures.append(
+                f"{name}: data-plane observability metric not declared "
+                f"(util/data_obs.py drifted from the documented surface)"
+            )
+        elif got[0] != kind:
+            failures.append(
+                f"{name}: declared as {got[0]}, documented as {kind}"
+            )
+    # Stalled pulls retain under the flight recorder's stalled_pull
+    # reason (joined by `rtpu trace --stalled`) — a missing enum entry
+    # would silently drop the record instead of retaining it.
+    from ray_tpu.util.flight_recorder import REASONS
+
+    if "stalled_pull" not in REASONS:
+        failures.append(
+            "util/flight_recorder.py: stalled_pull missing from REASONS "
+            "— stalled transfers would not be retained or joinable from "
+            "`rtpu trace --stalled`"
+        )
+    return failures
+
+
+def validate_data_obs_config():
+    fields = _config_fields()
+    return [
+        f"core/config.py: data-plane observability config key {key!r} "
+        f"missing from Config (documented knob drifted from the flag "
+        f"table)"
+        for key in DATA_OBS_CONFIG_KEYS if key not in fields
+    ]
+
+
 # ---- request-waterfall / flight-recorder lint ------------------------------
 # The trace plane's metric surface (util/flight_recorder.py) and config
 # knobs (README "Request waterfalls & flight recorder"); a rename/kind
@@ -1052,6 +1108,7 @@ class ObsMetricsPass(Pass):
         failures += validate_fence_metrics(declared)
         failures += validate_slo_metrics(declared)
         failures += validate_dispatch_metrics(declared)
+        failures += validate_data_obs_metrics(declared)
         failures += validate_transfer_config()
         failures += validate_actor_config()
         failures += validate_overload_config()
@@ -1062,6 +1119,7 @@ class ObsMetricsPass(Pass):
         failures += validate_fence_config()
         failures += validate_slo_config()
         failures += validate_dispatch_config()
+        failures += validate_data_obs_config()
         self.stats = (f"{len(declared)} declared metric(s), "
                       f"{len(state['skipped'])} module(s) skipped at "
                       f"import")

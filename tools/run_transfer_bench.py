@@ -6,14 +6,20 @@ plane keeps the peer channel responsive (the round-5 number this plane
 replaces: 0.25 GB/s with pulls riding the pickled control socket).
 
 Usage: python tools/run_transfer_bench.py [out.json] [--mb N] [--runs N]
+                                          [--skip-overhead]
 
-`make perf-transfer` runs the default 256 MiB configuration.
+`make perf-transfer` runs the default 256 MiB configuration, including
+the ``obs_overhead`` row: a second bench in a fresh interpreter with
+``RTPU_NO_DATA_OBS=1`` (the data-plane observability kill switch is
+read once at import) gives the no-instrumentation baseline, and the
+enabled-vs-disabled best-rate delta is asserted <= 3% in-bench.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -100,10 +106,51 @@ def run(payload_mb: int = 256, runs: int = 3, ping_count: int = 200):
     return out
 
 
+OBS_OVERHEAD_BUDGET_PCT = 3.0
+
+
+def measure_obs_overhead(result, payload_mb: int, runs: int):
+    """Re-run the bench in a fresh interpreter with the data-plane
+    observability kill switch on (ENABLED is read once at import, so a
+    subprocess is the only honest baseline) and append the
+    enabled-vs-disabled delta as the ``obs_overhead`` row. The best
+    rate is the comparison basis — loopback means are noisier than the
+    instrument cost being measured."""
+    env = dict(os.environ)
+    env["RTPU_NO_DATA_OBS"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--mb", str(payload_mb), "--runs", str(runs), "--skip-overhead"],
+        env=env, capture_output=True, text=True, timeout=1800,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"RTPU_NO_DATA_OBS=1 baseline bench failed:\n"
+            f"{proc.stderr[-2000:]}"
+        )
+    baseline = json.loads(proc.stdout)
+    on, off = result["gbps_best"], baseline["gbps_best"]
+    overhead_pct = max(0.0, (off - on) / off * 100.0)
+    result["obs_overhead"] = {
+        "gbps_on": on,
+        "gbps_off": off,
+        "overhead_pct": round(overhead_pct, 2),
+        "budget_pct": OBS_OVERHEAD_BUDGET_PCT,
+        "ok": overhead_pct <= OBS_OVERHEAD_BUDGET_PCT,
+    }
+    assert overhead_pct <= OBS_OVERHEAD_BUDGET_PCT, (
+        f"data-plane observability costs {overhead_pct:.2f}% of transfer "
+        f"throughput (budget {OBS_OVERHEAD_BUDGET_PCT}%): "
+        f"{on} GB/s instrumented vs {off} GB/s with RTPU_NO_DATA_OBS=1"
+    )
+
+
 def main():
     args = sys.argv[1:]
     out_path = None
     payload_mb, runs = 256, 3
+    skip_overhead = False
     i = 0
     while i < len(args):
         a = args[i]
@@ -111,9 +158,14 @@ def main():
             payload_mb = int(args[i + 1]); i += 2
         elif a == "--runs":
             runs = int(args[i + 1]); i += 2
+        elif a == "--skip-overhead":
+            skip_overhead = True; i += 1
         else:
             out_path = a; i += 1
     result = run(payload_mb=payload_mb, runs=runs)
+    if not skip_overhead and os.environ.get("RTPU_NO_DATA_OBS") not in \
+            ("1", "true"):
+        measure_obs_overhead(result, payload_mb, runs)
     text = json.dumps(result, indent=1)
     print(text)
     if out_path:
